@@ -1,0 +1,51 @@
+"""skypilot_tpu: a TPU-native AI-infrastructure control plane.
+
+Public SDK surface (reference analog: sky/__init__.py:90-120 re-exports).
+"""
+from skypilot_tpu.dag import Dag
+from skypilot_tpu.execution import exec  # pylint: disable=redefined-builtin
+from skypilot_tpu.execution import launch
+from skypilot_tpu.optimizer import Optimizer, OptimizeTarget
+from skypilot_tpu.resources import Resources
+from skypilot_tpu.task import Task
+from skypilot_tpu.core import (
+    autostop,
+    cancel,
+    cost_report,
+    down,
+    job_status,
+    queue,
+    start,
+    status,
+    stop,
+    tail_logs,
+)
+# `skypilot_tpu.check` stays a module (skypilot_tpu.check.check() to probe
+# credentials) — mirroring the reference, where sky.check is the module.
+from skypilot_tpu import check  # noqa: F401
+from skypilot_tpu.tpu import TpuSlice, parse_tpu_accelerator
+
+__version__ = '0.1.0'
+
+__all__ = [
+    'Dag',
+    'Optimizer',
+    'OptimizeTarget',
+    'Resources',
+    'Task',
+    'TpuSlice',
+    'autostop',
+    'cancel',
+    'check',
+    'cost_report',
+    'down',
+    'exec',
+    'job_status',
+    'launch',
+    'parse_tpu_accelerator',
+    'queue',
+    'start',
+    'status',
+    'stop',
+    'tail_logs',
+]

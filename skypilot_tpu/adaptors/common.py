@@ -1,0 +1,37 @@
+"""Lazy SDK imports (reference analog: sky/adaptors/common.py:10)."""
+from __future__ import annotations
+
+import importlib
+import threading
+from typing import Any, Optional
+
+
+class LazyImport:
+    """Defer a module import until first attribute access.
+
+    Keeps `import skypilot_tpu` fast and lets clouds whose SDKs are absent
+    stay registered (errors surface only when actually used).
+    """
+
+    def __init__(self, module_name: str,
+                 import_error_message: Optional[str] = None):
+        self._module_name = module_name
+        self._module: Any = None
+        self._error_message = import_error_message
+        self._lock = threading.Lock()
+
+    def _load(self) -> Any:
+        if self._module is None:
+            with self._lock:
+                if self._module is None:
+                    try:
+                        self._module = importlib.import_module(
+                            self._module_name)
+                    except ImportError as e:
+                        msg = self._error_message or (
+                            f'Failed to import {self._module_name!r}.')
+                        raise ImportError(msg) from e
+        return self._module
+
+    def __getattr__(self, item: str) -> Any:
+        return getattr(self._load(), item)

@@ -1,0 +1,63 @@
+"""GCP auth/session helpers (reference analog: sky/adaptors/gcp.py).
+
+Uses application-default credentials via google.auth; all TPU control-plane
+calls go through plain REST (tpu.googleapis.com) with a bearer token, so no
+heavy discovery client is needed.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional, Tuple
+
+from skypilot_tpu.adaptors import common
+
+google_auth = common.LazyImport(
+    'google.auth', 'google-auth is required for GCP support.')
+google_auth_transport = common.LazyImport('google.auth.transport.requests')
+
+_token_lock = threading.Lock()
+_cached_token: Optional[str] = None
+_cached_expiry: float = 0.0
+_cached_project: Optional[str] = None
+
+
+def get_project_id() -> str:
+    _, project = _credentials()
+    if not project:
+        raise RuntimeError(
+            'No GCP project configured. Set GOOGLE_CLOUD_PROJECT or run '
+            '`gcloud config set project <id>`.')
+    return project
+
+
+def _credentials() -> Tuple[object, Optional[str]]:
+    import os
+    creds, project = google_auth.default(
+        scopes=['https://www.googleapis.com/auth/cloud-platform'])
+    project = os.environ.get('GOOGLE_CLOUD_PROJECT', project)
+    return creds, project
+
+
+def get_access_token() -> str:
+    """Cached ADC bearer token, refreshed ahead of expiry."""
+    global _cached_token, _cached_expiry
+    with _token_lock:
+        if _cached_token is not None and time.time() < _cached_expiry - 120:
+            return _cached_token
+        creds, _ = _credentials()
+        request = google_auth_transport.Request()
+        creds.refresh(request)
+        _cached_token = creds.token
+        expiry = getattr(creds, 'expiry', None)
+        if expiry is not None:
+            # google-auth expiry datetimes are naive UTC; attach the UTC
+            # tzinfo before .timestamp() or local-time skew poisons the
+            # cache window.
+            from datetime import timezone
+            if expiry.tzinfo is None:
+                expiry = expiry.replace(tzinfo=timezone.utc)
+            _cached_expiry = expiry.timestamp()
+        else:
+            _cached_expiry = time.time() + 1800
+        return _cached_token

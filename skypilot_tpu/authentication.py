@@ -1,0 +1,47 @@
+"""SSH keypair management for cluster access.
+
+Reference analog: sky/authentication.py (keypair generation + per-cloud key
+upload). GCP TPU VMs receive the public key through instance metadata
+('ssh-keys'), which the TPU VM guest agent installs for the login user.
+"""
+from __future__ import annotations
+
+import os
+import stat
+import subprocess
+from typing import Tuple
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+SSH_DIR = '~/.skytpu/ssh'
+PRIVATE_KEY_PATH = f'{SSH_DIR}/skytpu-key'
+PUBLIC_KEY_PATH = f'{SSH_DIR}/skytpu-key.pub'
+SSH_USER = 'skytpu'
+
+
+def get_or_generate_keys() -> Tuple[str, str]:
+    """Return (private, public) key paths, generating once if absent."""
+    private = os.path.expanduser(PRIVATE_KEY_PATH)
+    public = os.path.expanduser(PUBLIC_KEY_PATH)
+    if not os.path.exists(private):
+        os.makedirs(os.path.dirname(private), exist_ok=True)
+        subprocess.run(
+            ['ssh-keygen', '-t', 'ed25519', '-N', '', '-q', '-f', private,
+             '-C', 'skytpu'],
+            check=True)
+        os.chmod(private, stat.S_IRUSR | stat.S_IWUSR)
+        logger.debug(f'Generated cluster SSH keypair at {private}.')
+    return private, public
+
+
+def public_key_openssh() -> str:
+    _, public = get_or_generate_keys()
+    with open(public, 'r', encoding='utf-8') as f:
+        return f.read().strip()
+
+
+def gcp_ssh_keys_metadata() -> str:
+    """Value for GCP instance metadata key 'ssh-keys'."""
+    return f'{SSH_USER}:{public_key_openssh()}'

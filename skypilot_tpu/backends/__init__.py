@@ -1,0 +1,5 @@
+from skypilot_tpu.backends.backend import Backend  # noqa: F401
+from skypilot_tpu.backends.slice_backend import (  # noqa: F401
+    SliceResourceHandle,
+    TpuSliceBackend,
+)

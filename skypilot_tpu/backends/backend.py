@@ -1,0 +1,61 @@
+"""Abstract Backend: cluster lifecycle + job execution API.
+
+Reference analog: sky/backends/backend.py:48-162 (provision / sync_workdir /
+sync_file_mounts / setup / execute / teardown).
+"""
+from __future__ import annotations
+
+import typing
+from typing import Any, Dict, Generic, Optional, TypeVar
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import task as task_lib
+
+_HandleType = TypeVar('_HandleType')
+
+
+class Backend(Generic[_HandleType]):
+    NAME = 'backend'
+
+    # --- Cluster lifecycle -------------------------------------------------
+    def provision(self,
+                  task: 'task_lib.Task',
+                  to_provision: Optional['resources_lib.Resources'],
+                  dryrun: bool,
+                  cluster_name: str,
+                  retry_until_up: bool = False) -> Optional[_HandleType]:
+        raise NotImplementedError
+
+    def sync_workdir(self, handle: _HandleType, workdir: str) -> None:
+        raise NotImplementedError
+
+    def sync_file_mounts(self, handle: _HandleType,
+                         all_file_mounts: Optional[Dict[str, str]],
+                         storage_mounts: Optional[Dict[str, Any]]) -> None:
+        raise NotImplementedError
+
+    def setup(self, handle: _HandleType, task: 'task_lib.Task',
+              detach_setup: bool = False) -> None:
+        raise NotImplementedError
+
+    # --- Job execution -----------------------------------------------------
+    def execute(self, handle: _HandleType, task: 'task_lib.Task',
+                detach_run: bool = False) -> Optional[int]:
+        """Submit the task; returns job id (None for dryrun)."""
+        raise NotImplementedError
+
+    def tail_logs(self, handle: _HandleType, job_id: Optional[int],
+                  follow: bool = True) -> int:
+        raise NotImplementedError
+
+    # --- Teardown ----------------------------------------------------------
+    def teardown(self, handle: _HandleType, terminate: bool = False) -> None:
+        raise NotImplementedError
+
+    def post_execute(self, handle: _HandleType, down: bool) -> None:
+        del handle, down
+
+    def register_info(self, **kwargs) -> None:
+        """Optimizer → backend info channel (analog backend.py register_info)."""
+        del kwargs

@@ -1,0 +1,463 @@
+"""TpuSliceBackend: the cluster-lifecycle + gang-execution heart, Ray-free.
+
+Reference analog: sky/backends/cloud_vm_ray_backend.py (6.5k LoC):
+- `RetryingVmProvisioner:1293` → `_FailoverProvisioner` here (region/cloud
+  failover + blocklist; the per-zone loop lives in provisioner.bulk_provision)
+- `RayCodeGen:344` (placement-group gang scheduling) → job-spec JSON executed
+  by skylet/slice_driver.py on the head host (SPMD gang, no Ray)
+- `CloudVmRayResourceHandle:2407` (pickled) → `SliceResourceHandle` (JSON)
+- `_execute_task_n_nodes:6439` TPU-pod host fan-out → ordered_instances() of
+  the slice (hosts are first-class, no num_ips_per_node fixup needed)
+"""
+from __future__ import annotations
+
+import base64
+import json
+import os
+import typing
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_state
+from skypilot_tpu import provision
+from skypilot_tpu import sky_logging
+from skypilot_tpu.backends import backend as backend_lib
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision import provisioner as provisioner_lib
+from skypilot_tpu.utils import command_runner as command_runner_lib
+from skypilot_tpu.utils import locks
+from skypilot_tpu.utils import subprocess_utils
+from skypilot_tpu.utils import timeline
+from skypilot_tpu.utils.status_lib import ClusterStatus, JobStatus
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import task as task_lib
+
+logger = sky_logging.init_logger(__name__)
+
+WORKDIR_NAME = 'skytpu_workdir'
+
+
+class SliceResourceHandle:
+    """JSON-serializable record of a live cluster (analog :2407)."""
+
+    def __init__(self, *, cluster_name: str, cloud: str, region: str,
+                 zone: Optional[str],
+                 launched_resources: Dict[str, Any],
+                 provider_config: Dict[str, Any]):
+        self.cluster_name = cluster_name
+        self.cloud = cloud
+        self.region = region
+        self.zone = zone
+        self.launched_resources = launched_resources
+        self.provider_config = provider_config
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            'cluster_name': self.cluster_name,
+            'cloud': self.cloud,
+            'region': self.region,
+            'zone': self.zone,
+            'launched_resources': self.launched_resources,
+            'provider_config': self.provider_config,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> 'SliceResourceHandle':
+        return cls(cluster_name=d['cluster_name'], cloud=d['cloud'],
+                   region=d['region'], zone=d.get('zone'),
+                   launched_resources=d.get('launched_resources', {}),
+                   provider_config=d.get('provider_config', {}))
+
+    def get_cluster_info(self) -> provision_common.ClusterInfo:
+        return provision.get_cluster_info(self.cloud, self.region,
+                                          self.cluster_name,
+                                          self.provider_config)
+
+    def launched_resources_obj(self) -> 'resources_lib.Resources':
+        from skypilot_tpu import resources as resources_lib
+        res = resources_lib.Resources.from_yaml_config(
+            self.launched_resources)
+        assert isinstance(res, resources_lib.Resources)
+        return res
+
+    @property
+    def num_hosts(self) -> int:
+        res = self.launched_resources_obj()
+        return res.tpu.total_hosts if res.tpu else 1
+
+
+class _FailoverProvisioner:
+    """Region/cloud failover with blocklist (analog RetryingVmProvisioner:1293).
+
+    Zone-level failover happens inside provisioner.bulk_provision; when a
+    whole region is exhausted the failed resources are blocklisted and the
+    optimizer re-runs to pick the next region/cloud (FailoverCloudErrorHandler
+    analog: error classification happens in the provisioners themselves).
+    """
+
+    def __init__(self, cluster_name: str):
+        self._cluster_name = cluster_name
+        self._history: List[Exception] = []
+
+    def provision_with_failover(
+        self, to_provision: 'resources_lib.Resources',
+        task: 'task_lib.Task',
+        ports_to_open: Optional[List[str]],
+    ) -> 'tuple[provision_common.ProvisionRecord, resources_lib.Resources]':
+        from skypilot_tpu import optimizer as optimizer_lib
+        from skypilot_tpu import dag as dag_lib
+        blocked: List['resources_lib.Resources'] = []
+        current = to_provision
+        while True:
+            cloud = current.cloud
+            assert cloud is not None
+            regions = cloud.regions_with_offering(current)
+            for region in regions:
+                try:
+                    record = provisioner_lib.bulk_provision(
+                        cloud, region.name, self._cluster_name, current,
+                        ports_to_open=ports_to_open)
+                    return record, current.copy(region=region.name,
+                                                zone=record.zone)
+                except exceptions.ResourcesUnavailableError as e:
+                    self._history.extend(e.failover_history)
+                    if e.no_failover:
+                        raise
+                    logger.warning(
+                        f'Region {region.name} exhausted; failing over.')
+            # Whole cloud exhausted for this resource: blocklist and re-plan.
+            blocked.append(current.copy(region=None, zone=None))
+            mini_dag = dag_lib.Dag()
+            mini_dag.add(task)
+            try:
+                optimizer_lib.Optimizer.optimize(
+                    mini_dag, blocked_resources=blocked, quiet=True)
+            except exceptions.ResourcesUnavailableError as e:
+                raise exceptions.ResourcesUnavailableError(
+                    f'Failed to provision {self._cluster_name!r} on all '
+                    f'feasible clouds/regions/zones.',
+                    failover_history=self._history) from e
+            assert task.best_resources is not None
+            current = task.best_resources
+
+
+class TpuSliceBackend(backend_lib.Backend[SliceResourceHandle]):
+    """Provisions TPU slices and gang-executes jobs on them."""
+
+    NAME = 'tpuslice'
+
+    # ------------------------------------------------------------------
+    # Provision
+    # ------------------------------------------------------------------
+    @timeline.event
+    def provision(self,
+                  task: 'task_lib.Task',
+                  to_provision: Optional['resources_lib.Resources'],
+                  dryrun: bool,
+                  cluster_name: str,
+                  retry_until_up: bool = False
+                  ) -> Optional[SliceResourceHandle]:
+        assert to_provision is not None and to_provision.is_launchable(), (
+            'provision requires launchable resources (run the optimizer '
+            'first).')
+        if dryrun:
+            logger.info(f'Dryrun: would provision {to_provision!r} as '
+                        f'{cluster_name!r}.')
+            return None
+        with locks.cluster_status_lock(cluster_name, timeout=600):
+            existing = global_state.get_cluster(cluster_name)
+            if existing is not None and existing['status'] == ClusterStatus.UP:
+                handle = SliceResourceHandle.from_dict(existing['handle'])
+                launched = handle.launched_resources_obj()
+                if not to_provision.less_demanding_than(launched):
+                    raise exceptions.ResourcesMismatchError(
+                        f'Cluster {cluster_name!r} exists with '
+                        f'{launched.format_brief()}, which cannot serve '
+                        f'{to_provision.format_brief()}. Use a new cluster '
+                        f'name or `skytpu down {cluster_name}` first.')
+                logger.info(f'Reusing existing cluster {cluster_name!r}.')
+                return handle
+
+            record, final_res = _FailoverProvisioner(
+                cluster_name).provision_with_failover(
+                    to_provision, task, ports_to_open=to_provision.ports)
+            handle = SliceResourceHandle(
+                cluster_name=cluster_name,
+                cloud=record.provider_name,
+                region=record.region,
+                zone=record.zone,
+                launched_resources=final_res.to_yaml_config(),
+                provider_config=final_res.make_deploy_variables(
+                    record.region, [record.zone] if record.zone else [],
+                    cluster_name),
+            )
+            global_state.add_or_update_cluster(cluster_name,
+                                               handle.to_dict(),
+                                               ClusterStatus.INIT,
+                                               is_launch=True)
+            cluster_info = handle.get_cluster_info()
+            provisioner_lib.wait_for_connection(cluster_info)
+            provisioner_lib.post_provision_runtime_setup(
+                cluster_name, cluster_info)
+            # Arm autostop if requested.
+            autostop = final_res.autostop
+            if autostop is not None:
+                self.set_autostop(handle, autostop['idle_minutes'],
+                                  autostop['down'])
+            global_state.add_or_update_cluster(cluster_name,
+                                               handle.to_dict(),
+                                               ClusterStatus.UP)
+            logger.info(f'Cluster {cluster_name!r} is UP '
+                        f'({cluster_info.num_instances} hosts).')
+            return handle
+
+    # ------------------------------------------------------------------
+    # Sync / setup
+    # ------------------------------------------------------------------
+    def _runners(self, handle: SliceResourceHandle
+                 ) -> List[command_runner_lib.CommandRunner]:
+        return provisioner_lib.get_command_runners(handle.get_cluster_info())
+
+    @timeline.event
+    def sync_workdir(self, handle: SliceResourceHandle, workdir: str) -> None:
+        runners = self._runners(handle)
+
+        def _sync(runner: command_runner_lib.CommandRunner) -> None:
+            runner.rsync(os.path.join(os.path.expanduser(workdir), ''),
+                         f'{WORKDIR_NAME}/', up=True,
+                         excludes=['.git'])
+
+        logger.info(f'Syncing workdir {workdir!r} to '
+                    f'{len(runners)} host(s)...')
+        subprocess_utils.run_in_parallel(_sync, runners)
+
+    @timeline.event
+    def sync_file_mounts(self, handle: SliceResourceHandle,
+                         all_file_mounts: Optional[Dict[str, str]],
+                         storage_mounts: Optional[Dict[str, Any]]) -> None:
+        if all_file_mounts:
+            runners = self._runners(handle)
+            for dst, src in all_file_mounts.items():
+                def _sync(runner: command_runner_lib.CommandRunner,
+                          dst=dst, src=src) -> None:
+                    runner.rsync(os.path.expanduser(src), dst, up=True)
+
+                subprocess_utils.run_in_parallel(_sync, runners)
+        if storage_mounts:
+            from skypilot_tpu.data import storage as storage_lib
+            storage_lib.execute_storage_mounts(handle, storage_mounts)
+
+    @timeline.event
+    def setup(self, handle: SliceResourceHandle, task: 'task_lib.Task',
+              detach_setup: bool = False) -> None:
+        if task.setup is None:
+            return
+        runners = self._runners(handle)
+        setup_log = os.path.expanduser(
+            f'~/.skytpu/logs/{handle.cluster_name}/setup.log')
+        logger.info(f'Running setup on {len(runners)} host(s)...')
+
+        def _setup(runner: command_runner_lib.CommandRunner) -> None:
+            cmd = f'cd {WORKDIR_NAME} 2>/dev/null; {task.setup}'
+            rc = runner.run(cmd, env=task.envs_and_secrets,
+                            log_path=setup_log)
+            if rc != 0:
+                raise exceptions.ClusterSetupError(
+                    f'Setup failed on {runner.node_id} (exit {rc}). '
+                    f'See {setup_log}.')
+
+        subprocess_utils.run_in_parallel(_setup, runners)
+
+    # ------------------------------------------------------------------
+    # Execute (gang)
+    # ------------------------------------------------------------------
+    def _head_runner(self, cluster_info: provision_common.ClusterInfo
+                     ) -> command_runner_lib.CommandRunner:
+        return provisioner_lib.get_command_runners(cluster_info)[0]
+
+    def _remote_py(self, cluster_info: provision_common.ClusterInfo) -> str:
+        return provisioner_lib.remote_python(cluster_info)
+
+    def _run_on_head_json(self, cluster_info, cmd: str) -> Dict[str, Any]:
+        head = self._head_runner(cluster_info)
+        rc, stdout, _ = head.run(cmd, require_outputs=True,
+                                 log_path='/dev/null')
+        if rc != 0:
+            raise exceptions.CommandError(rc, cmd, stdout)
+        line = stdout.strip().splitlines()[-1] if stdout.strip() else '{}'
+        return json.loads(line)
+
+    @timeline.event
+    def execute(self, handle: SliceResourceHandle, task: 'task_lib.Task',
+                detach_run: bool = False) -> Optional[int]:
+        if task.run is None:
+            logger.info('Task has no run command; nothing to execute.')
+            return None
+        assert isinstance(task.run, str), (
+            'callable run sections are executed via the python API only.')
+        cluster_info = handle.get_cluster_info()
+        py = self._remote_py(cluster_info)
+        launched = handle.launched_resources_obj()
+        sl = launched.tpu
+
+        # 1. Register the job in the on-cluster queue.
+        from skypilot_tpu.utils import common_utils
+        import shlex
+        add_cmd = (f'{py} -m skypilot_tpu.skylet.job_lib add '
+                   f'--name {shlex.quote(task.name or "task")} '
+                   f'--user {shlex.quote(common_utils.get_user())} '
+                   f'--run-cmd {shlex.quote(task.run[:500])} '
+                   f'--num-hosts {handle.num_hosts}')
+        job_id = int(self._run_on_head_json(cluster_info, add_cmd)['job_id'])
+
+        # 2. Build the gang job spec (the RayCodeGen analog).
+        hosts: List[Dict[str, Any]] = []
+        for inst in cluster_info.ordered_instances():
+            if cluster_info.provider_name == 'local':
+                host_dir = cluster_info.host_dirs[inst.instance_id]
+                hosts.append({
+                    'kind': 'local',
+                    'ip': inst.internal_ip,
+                    'slice_index': inst.slice_index,
+                    'worker_id': inst.worker_id,
+                    'workdir': os.path.join(host_dir, WORKDIR_NAME),
+                })
+            else:
+                hosts.append({
+                    'kind': 'ssh',
+                    'ip': inst.get_feasible_ip(),
+                    'slice_index': inst.slice_index,
+                    'worker_id': inst.worker_id,
+                    'workdir': f'~/{WORKDIR_NAME}',
+                    'ssh': {
+                        'user': cluster_info.ssh_user,
+                        'ip': inst.get_feasible_ip(),
+                        'port': inst.ssh_port,
+                        # Head-to-worker hops reuse the cluster key, which
+                        # runtime setup installs at this fixed path.
+                        'private_key': '~/.ssh/skytpu-cluster-key',
+                    },
+                })
+        spec = {
+            'job_id': job_id,
+            'cluster_name': handle.cluster_name,
+            'hosts': hosts,
+            'run_cmd': task.run,
+            'envs': task.envs_and_secrets,
+            'chips_per_host': sl.chips_per_host if sl else 1,
+            'num_slices': sl.num_slices if sl else 1,
+        }
+
+        # 3. Ship the spec to the head host and start the driver detached.
+        head = self._head_runner(cluster_info)
+        spec_b64 = base64.b64encode(json.dumps(spec).encode()).decode()
+        remote_spec = f'/tmp/skytpu_job_{handle.cluster_name}_{job_id}.json'
+        write_cmd = f'echo {spec_b64} | base64 -d > {remote_spec}'
+        rc = head.run(write_cmd, log_path='/dev/null')
+        if rc != 0:
+            raise exceptions.CommandError(rc, 'ship job spec', '')
+        driver_cmd = (f'{py} -m skypilot_tpu.skylet.slice_driver '
+                      f'--spec {remote_spec}')
+        head.run(driver_cmd, detach=True,
+                 log_path=os.path.expanduser(
+                     f'~/.skytpu/logs/{handle.cluster_name}/'
+                     f'driver_{job_id}.log'))
+        logger.info(f'Job {job_id} submitted on {handle.cluster_name!r} '
+                    f'({len(hosts)} host(s), gang-scheduled).')
+        if not detach_run:
+            self.tail_logs(handle, job_id, follow=True)
+        return job_id
+
+    # ------------------------------------------------------------------
+    # Logs / queue / cancel
+    # ------------------------------------------------------------------
+    def tail_logs(self, handle: SliceResourceHandle, job_id: Optional[int],
+                  follow: bool = True) -> int:
+        cluster_info = handle.get_cluster_info()
+        py = self._remote_py(cluster_info)
+        head = self._head_runner(cluster_info)
+        if job_id is None:
+            jobs = self.queue(handle)
+            if not jobs:
+                logger.info('No jobs on this cluster.')
+                return 0
+            job_id = jobs[0]['job_id']
+        cmd = (f'{py} -m skypilot_tpu.skylet.log_lib --job-id {job_id}'
+               f'{" --follow" if follow else ""}')
+        rc = head.run(cmd, stream_logs=True, log_path='/dev/null')
+        return int(rc)
+
+    def queue(self, handle: SliceResourceHandle) -> List[Dict[str, Any]]:
+        cluster_info = handle.get_cluster_info()
+        py = self._remote_py(cluster_info)
+        out = self._run_on_head_json(
+            cluster_info, f'{py} -m skypilot_tpu.skylet.job_lib list')
+        return out.get('jobs', [])
+
+    def cancel_jobs(self, handle: SliceResourceHandle,
+                    job_ids: Optional[List[int]] = None) -> List[int]:
+        cluster_info = handle.get_cluster_info()
+        py = self._remote_py(cluster_info)
+        if job_ids is None:
+            jobs = self.queue(handle)
+            job_ids = [
+                j['job_id'] for j in jobs
+                if not JobStatus(j['status']).is_terminal()
+            ]
+        cancelled = []
+        for jid in job_ids:
+            out = self._run_on_head_json(
+                cluster_info,
+                f'{py} -m skypilot_tpu.skylet.job_lib cancel --job-id {jid}')
+            if out.get('cancelled'):
+                cancelled.append(jid)
+        return cancelled
+
+    def job_status(self, handle: SliceResourceHandle,
+                   job_id: int) -> Optional[JobStatus]:
+        cluster_info = handle.get_cluster_info()
+        py = self._remote_py(cluster_info)
+        out = self._run_on_head_json(
+            cluster_info,
+            f'{py} -m skypilot_tpu.skylet.job_lib status --job-id {job_id}')
+        return JobStatus(out['status']) if out.get('status') else None
+
+    # ------------------------------------------------------------------
+    # Autostop / teardown
+    # ------------------------------------------------------------------
+    def set_autostop(self, handle: SliceResourceHandle,
+                     idle_minutes: Optional[int], down: bool) -> None:
+        cluster_info = handle.get_cluster_info()
+        py = self._remote_py(cluster_info)
+        import shlex
+        code = (
+            'from skypilot_tpu.skylet import autostop_lib; '
+            f'autostop_lib.set_autostop({idle_minutes!r}, {down!r}, '
+            f'{handle.cloud!r}, {handle.region!r}, '
+            f'{handle.cluster_name!r})')
+        head = self._head_runner(cluster_info)
+        rc = head.run(f'{py} -c {shlex.quote(code)}', log_path='/dev/null')
+        if rc != 0:
+            raise exceptions.ClusterSetupError(
+                f'Failed to set autostop on {handle.cluster_name}.')
+        global_state.set_cluster_autostop(
+            handle.cluster_name,
+            None if idle_minutes is None else {'idle_minutes': idle_minutes,
+                                               'down': down})
+
+    @timeline.event
+    def teardown(self, handle: SliceResourceHandle,
+                 terminate: bool = False) -> None:
+        with locks.cluster_status_lock(handle.cluster_name, timeout=600):
+            provisioner_lib.teardown_cluster(
+                handle.cloud, handle.region, handle.cluster_name,
+                handle.provider_config, terminate=terminate)
+            if terminate:
+                global_state.remove_cluster(handle.cluster_name)
+            else:
+                global_state.set_cluster_status(handle.cluster_name,
+                                                ClusterStatus.STOPPED)
+        logger.info(f'Cluster {handle.cluster_name!r} '
+                    f'{"terminated" if terminate else "stopped"}.')

@@ -1,0 +1,18 @@
+"""Catalog: TPU slice offerings, pricing, regions/zones.
+
+Reference analog: sky/catalog/ (common.py CSV cache + gcp_catalog.py TPU
+entries). The reference fetches hosted CSVs at runtime
+(sky/catalog/common.py:211); we ship a static CSV in-package (zero egress)
+with the same query surface.
+"""
+from skypilot_tpu.catalog.tpu_catalog import (  # noqa: F401
+    list_accelerators,
+    get_hourly_cost,
+    get_regions,
+    get_zones,
+    validate_region_zone,
+    get_host_vm_spec,
+    accelerator_in_region_or_zone,
+    HostVmSpec,
+    InstanceTypeInfo,
+)

@@ -1,0 +1,201 @@
+"""TPU catalog queries over the in-package static CSV.
+
+Reference analogs:
+- sky/catalog/common.py (CSV load/caching, per-cloud lazy load)
+- sky/catalog/gcp_catalog.py:255-277 (TPU-VM price = TPU chip price only; the
+  host VM is free for TPU-VM architecture — same policy here)
+- sky/catalog/gcp_catalog.py:476-556 (TPU/GPU dataframe split; we are TPU-only)
+
+Pricing data is approximate public GCP on-demand/spot per-chip-hour pricing;
+the CSV is the single source of truth and trivially replaceable.
+"""
+from __future__ import annotations
+
+import csv
+import dataclasses
+import functools
+import os
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.tpu import topology
+
+_CSV_PATH = os.path.join(os.path.dirname(__file__), 'data', 'tpu_catalog.csv')
+
+
+@dataclasses.dataclass(frozen=True)
+class CatalogRow:
+    generation: str
+    region: str
+    zone: str
+    price_per_chip_hour: float
+    spot_price_per_chip_hour: float
+    max_chips: int
+
+
+@dataclasses.dataclass(frozen=True)
+class HostVmSpec:
+    """The host VM shape bundled with each TPU host (not separately billed).
+
+    Reference analog: sky/clouds/gcp.py:739-768 TPU host vCPU/mem fixups.
+    """
+    vcpus: int
+    memory_gb: int
+
+
+# Approximate public TPU-VM host shapes per generation.
+_HOST_VMS: Dict[str, HostVmSpec] = {
+    'v2': HostVmSpec(96, 335),
+    'v3': HostVmSpec(96, 335),
+    'v4': HostVmSpec(240, 407),
+    'v5e': HostVmSpec(224, 400),
+    'v5p': HostVmSpec(208, 448),
+    'v6e': HostVmSpec(180, 720),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class InstanceTypeInfo:
+    """One catalog offering: a slice shape in a zone with pricing."""
+    accelerator_name: str
+    generation: str
+    num_chips: int
+    topology: str
+    num_hosts: int
+    region: str
+    zone: str
+    price: float          # $/hour for the whole slice, on-demand
+    spot_price: float
+
+
+@functools.lru_cache(maxsize=1)
+def _load_rows(csv_path: str = _CSV_PATH) -> List[CatalogRow]:
+    rows: List[CatalogRow] = []
+    with open(csv_path, 'r', encoding='utf-8') as f:
+        for rec in csv.DictReader(f):
+            rows.append(
+                CatalogRow(
+                    generation=rec['generation'],
+                    region=rec['region'],
+                    zone=rec['zone'],
+                    price_per_chip_hour=float(rec['price_per_chip_hour']),
+                    spot_price_per_chip_hour=float(
+                        rec['spot_price_per_chip_hour']),
+                    max_chips=int(rec['max_chips']),
+                ))
+    return rows
+
+
+def _rows_for(generation: str,
+              region: Optional[str] = None,
+              zone: Optional[str] = None) -> List[CatalogRow]:
+    out = []
+    for row in _load_rows():
+        if row.generation != generation:
+            continue
+        if region is not None and row.region != region:
+            continue
+        if zone is not None and row.zone != zone:
+            continue
+        out.append(row)
+    return out
+
+
+def get_regions(tpu_slice: topology.TpuSlice) -> List[str]:
+    """Regions offering this slice shape (capacity-aware), cheapest first."""
+    rows = [r for r in _rows_for(tpu_slice.generation)
+            if r.max_chips >= tpu_slice.total_chips]
+    seen: Dict[str, float] = {}
+    for r in rows:
+        seen.setdefault(r.region, r.price_per_chip_hour)
+    return sorted(seen, key=lambda reg: seen[reg])
+
+
+def get_zones(tpu_slice: topology.TpuSlice, region: str) -> List[str]:
+    return [r.zone for r in _rows_for(tpu_slice.generation, region=region)
+            if r.max_chips >= tpu_slice.total_chips]
+
+
+def accelerator_in_region_or_zone(tpu_slice: topology.TpuSlice,
+                                  region: Optional[str] = None,
+                                  zone: Optional[str] = None) -> bool:
+    rows = _rows_for(tpu_slice.generation, region=region, zone=zone)
+    return any(r.max_chips >= tpu_slice.total_chips for r in rows)
+
+
+def validate_region_zone(region: Optional[str],
+                         zone: Optional[str]) -> Tuple[Optional[str], Optional[str]]:
+    """Check (region, zone) exist in the catalog; infer region from zone."""
+    if region is None and zone is None:
+        return None, None
+    rows = _load_rows()
+    if zone is not None:
+        matches = [r for r in rows if r.zone == zone]
+        if not matches:
+            raise ValueError(f'Zone {zone!r} not found in catalog.')
+        inferred = matches[0].region
+        if region is not None and region != inferred:
+            raise ValueError(
+                f'Zone {zone!r} is in region {inferred!r}, not {region!r}.')
+        return inferred, zone
+    if not any(r.region == region for r in rows):
+        raise ValueError(f'Region {region!r} not found in catalog.')
+    return region, None
+
+
+def get_hourly_cost(tpu_slice: topology.TpuSlice,
+                    use_spot: bool = False,
+                    region: Optional[str] = None,
+                    zone: Optional[str] = None) -> float:
+    """$/hour for the whole (multi-)slice. Host VMs are free with TPU-VM
+
+    (reference policy: sky/catalog/gcp_catalog.py:255-277).
+    """
+    rows = _rows_for(tpu_slice.generation, region=region, zone=zone)
+    rows = [r for r in rows if r.max_chips >= tpu_slice.total_chips]
+    if not rows:
+        where = zone or region or 'any region'
+        raise exceptions.ResourcesUnavailableError(
+            f'No catalog entry for {tpu_slice.name} in {where}.')
+    per_chip = min((r.spot_price_per_chip_hour if use_spot
+                    else r.price_per_chip_hour) for r in rows)
+    return per_chip * tpu_slice.total_chips
+
+
+def get_host_vm_spec(generation: str) -> HostVmSpec:
+    return _HOST_VMS[generation]
+
+
+def list_accelerators(
+        name_filter: Optional[str] = None,
+        region_filter: Optional[str] = None,
+        max_chips: Optional[int] = None) -> Dict[str, List[InstanceTypeInfo]]:
+    """All offerings, keyed by canonical accelerator name.
+
+    Backs the `skytpu show-tpus` CLI (reference: `sky show-gpus`,
+    sky/client/cli/command.py:3547).
+    """
+    out: Dict[str, List[InstanceTypeInfo]] = {}
+    for gen in topology.GENERATIONS:
+        for sl in topology.legal_slices(gen):
+            if max_chips is not None and sl.num_chips > max_chips:
+                continue
+            if name_filter is not None and name_filter not in sl.name:
+                continue
+            for row in _rows_for(gen, region=region_filter):
+                if row.max_chips < sl.num_chips:
+                    continue
+                out.setdefault(sl.name, []).append(
+                    InstanceTypeInfo(
+                        accelerator_name=sl.name,
+                        generation=gen,
+                        num_chips=sl.num_chips,
+                        topology=sl.topology_str,
+                        num_hosts=sl.num_hosts,
+                        region=row.region,
+                        zone=row.zone,
+                        price=row.price_per_chip_hour * sl.num_chips,
+                        spot_price=(row.spot_price_per_chip_hour *
+                                    sl.num_chips),
+                    ))
+    return out

@@ -1,0 +1,72 @@
+"""Credential probing + enabled-cloud cache.
+
+Reference analog: sky/check.py (`check_capability`,
+`get_cached_enabled_clouds_or_refresh`).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils import registry
+
+logger = sky_logging.init_logger(__name__)
+
+_CACHE_PATH = os.path.expanduser('~/.skytpu/enabled_clouds.json')
+_CACHE_TTL_SECONDS = 12 * 3600
+
+
+def check(quiet: bool = False, clouds: Optional[List[str]] = None
+          ) -> List[str]:
+    """Probe credentials for each registered cloud; persist enabled list."""
+    results: List[Tuple[str, bool, Optional[str]]] = []
+    names = clouds or registry.CLOUD_REGISTRY.keys()
+    for name in names:
+        cloud_cls = registry.CLOUD_REGISTRY.type_from_str(name)
+        try:
+            ok, reason = cloud_cls.check_credentials()
+        except Exception as e:  # pylint: disable=broad-except
+            ok, reason = False, str(e)
+        results.append((name, ok, reason))
+    enabled = [name for name, ok, _ in results if ok]
+    os.makedirs(os.path.dirname(_CACHE_PATH), exist_ok=True)
+    with open(_CACHE_PATH, 'w', encoding='utf-8') as f:
+        json.dump({'enabled': enabled, 'ts': time.time()}, f)
+    if not quiet:
+        for name, ok, reason in results:
+            mark = '\x1b[32m✔\x1b[0m' if ok else '\x1b[31m✗\x1b[0m'
+            line = f'  {mark} {name}'
+            if not ok and reason:
+                line += f': {reason}'
+            sky_logging.print_status(line)
+    return enabled
+
+
+def get_cached_enabled_clouds_or_refresh(
+        raise_if_no_cloud_access: bool = False) -> List[cloud_lib.Cloud]:
+    enabled: Optional[List[str]] = None
+    if os.path.exists(_CACHE_PATH):
+        try:
+            with open(_CACHE_PATH, 'r', encoding='utf-8') as f:
+                payload = json.load(f)
+            if time.time() - payload.get('ts', 0) < _CACHE_TTL_SECONDS:
+                enabled = payload.get('enabled')
+        except (json.JSONDecodeError, OSError):
+            enabled = None
+    if enabled is None:
+        enabled = check(quiet=True)
+    clouds = []
+    for name in enabled:
+        if name in registry.CLOUD_REGISTRY:
+            c = registry.CLOUD_REGISTRY.from_str(name)
+            assert c is not None
+            clouds.append(c)
+    if raise_if_no_cloud_access and not clouds:
+        raise exceptions.NoCloudAccessError(
+            'No cloud is enabled. Run `skytpu check` for details.')
+    return clouds

@@ -1,0 +1,289 @@
+"""The `skytpu` CLI.
+
+Reference analog: sky/client/cli/command.py (launch:1009, exec:1200,
+status:1710, queue:2171, logs:2258, cancel:2397, stop:2524, start:2734,
+down:2944, check:3482, show_gpus:3547 → show-tpus here). Commands route
+through the local SDK by default; `--server` routes through a running API
+server (client/sdk.py).
+"""
+from __future__ import annotations
+
+import os
+import sys
+from typing import List, Optional, Tuple
+
+import click
+
+import skypilot_tpu as sky
+from skypilot_tpu import check as check_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.catalog import tpu_catalog
+from skypilot_tpu.utils import common_utils
+
+logger = sky_logging.init_logger(__name__)
+
+
+def _load_task(entrypoint: str, env: Tuple[str, ...],
+               overrides: dict) -> sky.Task:
+    env_overrides = {}
+    for item in env:
+        if '=' not in item:
+            raise click.UsageError(f'--env expects KEY=VALUE, got {item!r}')
+        k, v = item.split('=', 1)
+        env_overrides[k] = v
+    try:
+        if entrypoint.endswith(('.yaml', '.yml')) and os.path.exists(
+                entrypoint):
+            task = sky.Task.from_yaml(entrypoint, env_overrides or None)
+        else:
+            # Inline command entrypoint.
+            task = sky.Task(run=entrypoint, envs=env_overrides or None)
+        if overrides:
+            task.set_resources_override(
+                {k: v for k, v in overrides.items() if v is not None})
+    except (exceptions.SkyTpuError, ValueError) as e:
+        raise click.ClickException(str(e)) from e
+    return task
+
+
+def _resource_options(fn):
+    fn = click.option('--accelerators', '--tpu', 'accelerators',
+                      default=None,
+                      help='TPU slice, e.g. tpu-v5p-128.')(fn)
+    fn = click.option('--cloud', default=None)(fn)
+    fn = click.option('--region', default=None)(fn)
+    fn = click.option('--zone', default=None)(fn)
+    fn = click.option('--use-spot/--no-use-spot', 'use_spot', default=None)(fn)
+    return fn
+
+
+@click.group()
+@click.version_option(sky.__version__, '--version', '-v')
+def cli():
+    """skytpu: TPU-native cloud AI orchestration."""
+
+
+@cli.command()
+@click.argument('entrypoint', required=True)
+@click.option('--cluster', '-c', default=None, help='Cluster name.')
+@click.option('--detach-run', '-d', is_flag=True, default=False)
+@click.option('--dryrun', is_flag=True, default=False)
+@click.option('--down', is_flag=True, default=False,
+              help='Tear down the cluster when the job finishes.')
+@click.option('--env', multiple=True, help='KEY=VALUE task env overrides.')
+@click.option('--retry-until-up', is_flag=True, default=False)
+@click.option('--no-setup', is_flag=True, default=False)
+@_resource_options
+def launch(entrypoint: str, cluster: Optional[str], detach_run: bool,
+           dryrun: bool, down: bool, env: Tuple[str, ...],
+           retry_until_up: bool, no_setup: bool, **overrides):
+    """Launch a task (provision a TPU slice if needed) from YAML or command."""
+    task = _load_task(entrypoint, env, overrides)
+    try:
+        job_id, handle = sky.launch(task, cluster_name=cluster,
+                                    dryrun=dryrun, detach_run=detach_run,
+                                    down=down, retry_until_up=retry_until_up,
+                                    no_setup=no_setup)
+    except exceptions.SkyTpuError as e:
+        raise click.ClickException(str(e)) from e
+    if handle is not None and job_id is not None:
+        click.echo(f'Job {job_id} on cluster {handle.cluster_name!r}.')
+
+
+@cli.command(name='exec')
+@click.argument('cluster', required=True)
+@click.argument('entrypoint', required=True)
+@click.option('--detach-run', '-d', is_flag=True, default=False)
+@click.option('--env', multiple=True)
+def exec_cmd(cluster: str, entrypoint: str, detach_run: bool,
+             env: Tuple[str, ...]):
+    """Run a task on an existing cluster (no provision/setup)."""
+    task = _load_task(entrypoint, env, {})
+    try:
+        job_id, _ = sky.exec(task, cluster, detach_run=detach_run)
+    except exceptions.SkyTpuError as e:
+        raise click.ClickException(str(e)) from e
+    click.echo(f'Job {job_id} on cluster {cluster!r}.')
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1)
+@click.option('--refresh', '-r', is_flag=True, default=False)
+def status(clusters: Tuple[str, ...], refresh: bool):
+    """Show clusters."""
+    records = sky.status(list(clusters) or None, refresh=refresh)
+    if not records:
+        click.echo('No existing clusters.')
+        return
+    rows = []
+    import time
+    for r in records:
+        handle = r.get('handle') or {}
+        res_cfg = handle.get('launched_resources') or {}
+        acc = res_cfg.get('accelerators', '-')
+        spot = ' [spot]' if res_cfg.get('use_spot') else ''
+        age = common_utils.format_duration(
+            max(0.0, time.time() - (r.get('launched_at') or 0)))
+        rows.append((r['name'], f"{handle.get('cloud', '-')}", f'{acc}{spot}',
+                     r.get('handle', {}).get('zone') or '-', age,
+                     r['status'].colored_str()))
+    header = ('NAME', 'CLOUD', 'RESOURCES', 'ZONE', 'AGE', 'STATUS')
+    widths = [max(len(header[i]), *(len(str(r[i])) for r in rows))
+              for i in range(len(header))]
+    click.echo('  '.join(h.ljust(w) for h, w in zip(header, widths)))
+    for r in rows:
+        click.echo('  '.join(str(c).ljust(w) for c, w in zip(r, widths)))
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def down(clusters: Tuple[str, ...], yes: bool):
+    """Terminate cluster(s)."""
+    if not yes:
+        click.confirm(f'Terminate {", ".join(clusters)}?', abort=True)
+    for name in clusters:
+        try:
+            sky.down(name)
+        except exceptions.SkyTpuError as e:
+            raise click.ClickException(str(e)) from e
+
+
+@cli.command()
+@click.argument('clusters', nargs=-1, required=True)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def stop(clusters: Tuple[str, ...], yes: bool):
+    """Stop cluster(s) (TPU generations that support stop)."""
+    if not yes:
+        click.confirm(f'Stop {", ".join(clusters)}?', abort=True)
+    for name in clusters:
+        try:
+            sky.stop(name)
+        except exceptions.SkyTpuError as e:
+            raise click.ClickException(str(e)) from e
+
+
+@cli.command()
+@click.argument('cluster', required=True)
+def start(cluster: str):
+    """Restart a stopped cluster."""
+    try:
+        sky.start(cluster)
+    except exceptions.SkyTpuError as e:
+        raise click.ClickException(str(e)) from e
+
+
+@cli.command()
+@click.argument('cluster', required=True)
+@click.option('--idle-minutes', '-i', type=int, default=5)
+@click.option('--down', 'down_after', is_flag=True, default=False)
+@click.option('--cancel', 'cancel_flag', is_flag=True, default=False,
+              help='Disable autostop.')
+def autostop(cluster: str, idle_minutes: int, down_after: bool,
+             cancel_flag: bool):
+    """Configure idleness autostop for a cluster."""
+    try:
+        sky.autostop(cluster, None if cancel_flag else idle_minutes,
+                     down_after)
+    except exceptions.SkyTpuError as e:
+        raise click.ClickException(str(e)) from e
+
+
+@cli.command()
+@click.argument('cluster', required=True)
+def queue(cluster: str):
+    """Show the job queue of a cluster."""
+    try:
+        jobs = sky.queue(cluster)
+    except exceptions.SkyTpuError as e:
+        raise click.ClickException(str(e)) from e
+    if not jobs:
+        click.echo('No jobs.')
+        return
+    header = ('ID', 'NAME', 'USER', 'STATUS', 'HOSTS')
+    click.echo('  '.join(header))
+    for j in jobs:
+        click.echo(f"{j['job_id']}  {j['job_name']}  {j['username']}  "
+                   f"{j['status']}  {j['num_hosts']}")
+
+
+@cli.command()
+@click.argument('cluster', required=True)
+@click.argument('job_id', required=False, type=int)
+@click.option('--no-follow', is_flag=True, default=False)
+def logs(cluster: str, job_id: Optional[int], no_follow: bool):
+    """Tail the logs of a job."""
+    try:
+        rc = sky.tail_logs(cluster, job_id, follow=not no_follow)
+    except exceptions.SkyTpuError as e:
+        raise click.ClickException(str(e)) from e
+    sys.exit(rc)
+
+
+@cli.command()
+@click.argument('cluster', required=True)
+@click.argument('job_ids', nargs=-1, type=int)
+@click.option('--all', '-a', 'all_jobs', is_flag=True, default=False)
+def cancel(cluster: str, job_ids: Tuple[int, ...], all_jobs: bool):
+    """Cancel job(s)."""
+    if not job_ids and not all_jobs:
+        raise click.UsageError('Pass job ids or --all.')
+    try:
+        done = sky.cancel(cluster, None if all_jobs else list(job_ids))
+    except exceptions.SkyTpuError as e:
+        raise click.ClickException(str(e)) from e
+    click.echo(f'Cancelled: {done}')
+
+
+@cli.command()
+def check():
+    """Probe cloud credentials and show enabled clouds."""
+    enabled = check_lib.check()
+    if not enabled:
+        click.echo('No cloud enabled.')
+        sys.exit(1)
+
+
+@cli.command(name='show-tpus')
+@click.option('--name-filter', default=None)
+@click.option('--region', default=None)
+@click.option('--all-regions', is_flag=True, default=False)
+def show_tpus(name_filter: Optional[str], region: Optional[str],
+              all_regions: bool):
+    """List TPU slice offerings and pricing (analog: sky show-gpus)."""
+    offerings = tpu_catalog.list_accelerators(name_filter=name_filter,
+                                              region_filter=region)
+    header = ('SLICE', 'CHIPS', 'TOPOLOGY', 'HOSTS', 'REGION',
+              '$/HR', 'SPOT $/HR')
+    click.echo('  '.join(h.ljust(12) for h in header))
+    for name in sorted(offerings,
+                       key=lambda n: (offerings[n][0].generation,
+                                      offerings[n][0].num_chips)):
+        infos = offerings[name]
+        shown = infos if all_regions else infos[:1]
+        for info in shown:
+            row = (name, str(info.num_chips), info.topology,
+                   str(info.num_hosts), info.region,
+                   f'{info.price:.2f}', f'{info.spot_price:.2f}')
+            click.echo('  '.join(c.ljust(12) for c in row))
+
+
+@cli.command(name='cost-report')
+def cost_report():
+    """Show the cost of past clusters."""
+    rows = sky.cost_report()
+    if not rows:
+        click.echo('No history.')
+        return
+    for r in rows:
+        dur = common_utils.format_duration(r.get('duration_seconds') or 0)
+        click.echo(f"{r['name']}: {dur}, ${r.get('cost') or 0:.2f}")
+
+
+def main():
+    return cli()
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,12 @@
+"""Per-cloud policy: feasibility, deploy variables, credentials.
+
+Reference analog: sky/clouds/ (abstract Cloud at sky/clouds/cloud.py:140).
+"""
+from skypilot_tpu.clouds.cloud import (  # noqa: F401
+    Cloud,
+    CloudImplementationFeatures,
+    Region,
+    Zone,
+)
+from skypilot_tpu.clouds.gcp import GCP  # noqa: F401
+from skypilot_tpu.clouds.local import Local  # noqa: F401

@@ -1,0 +1,169 @@
+"""Abstract Cloud: capability probing, feasibility, deploy variables.
+
+Reference analog: sky/clouds/cloud.py — `Cloud:140` with
+`regions_with_offering:188`, `make_deploy_resources_variables:311`,
+`get_feasible_launchable_resources:428`, `check_credentials:497`, and the
+capability enum `CloudImplementationFeatures:33`.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import typing
+from typing import Any, Dict, Iterator, List, Optional, Set, Tuple
+
+from skypilot_tpu.utils import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+
+class CloudImplementationFeatures(enum.Enum):
+    """Capabilities a cloud may or may not implement.
+
+    Reference analog: sky/clouds/cloud.py:33. The execution layer checks the
+    requested features against `unsupported_features`; unsupported ones fail
+    fast with a clear message instead of mid-provision.
+    """
+    MULTI_HOST = 'multi_host'
+    MULTI_SLICE = 'multi_slice'          # DCN-connected slices (MEGASCALE)
+    SPOT_INSTANCE = 'spot_instance'
+    STOP = 'stop'
+    AUTOSTOP = 'autostop'
+    OPEN_PORTS = 'open_ports'
+    STORAGE_MOUNTING = 'storage_mounting'
+    QUEUED_RESOURCES = 'queued_resources'  # GCP queued-resources / DWS
+
+
+@dataclasses.dataclass(frozen=True)
+class Zone:
+    name: str
+
+
+@dataclasses.dataclass(frozen=True)
+class Region:
+    name: str
+    zones: Tuple[Zone, ...] = ()
+
+
+class Cloud:
+    """Base class. Subclasses register via @registry.CLOUD_REGISTRY.register."""
+
+    _REPR = 'Cloud'
+
+    # ------------------------------------------------------------------
+    # Identity / capability
+    # ------------------------------------------------------------------
+    @classmethod
+    def canonical_name(cls) -> str:
+        return cls.__name__.lower()
+
+    def __repr__(self) -> str:
+        return self._REPR
+
+    def is_same_cloud(self, other: 'Cloud') -> bool:
+        return isinstance(other, type(self))
+
+    @classmethod
+    def unsupported_features(
+            cls, resources: 'resources_lib.Resources'
+    ) -> Dict[CloudImplementationFeatures, str]:
+        """Feature -> reason string for everything this cloud cannot do."""
+        raise NotImplementedError
+
+    @classmethod
+    def check_features_are_supported(
+            cls, resources: 'resources_lib.Resources',
+            requested: Set[CloudImplementationFeatures]) -> None:
+        unsupported = cls.unsupported_features(resources)
+        bad = {f: unsupported[f] for f in requested if f in unsupported}
+        if bad:
+            table = '; '.join(f'{f.value}: {reason}'
+                              for f, reason in bad.items())
+            raise NotImplementedError(
+                f'{cls.__name__} does not support the requested features — '
+                f'{table}')
+
+    # ------------------------------------------------------------------
+    # Offerings / feasibility
+    # ------------------------------------------------------------------
+    def regions_with_offering(self, resources: 'resources_lib.Resources'
+                              ) -> List[Region]:
+        """Regions (with zones) that can host `resources`, cheapest first.
+
+        Reference analog: sky/clouds/cloud.py:188.
+        """
+        raise NotImplementedError
+
+    def zones_provision_loop(
+            self, *, region: str,
+            resources: 'resources_lib.Resources') -> Iterator[List[Zone]]:
+        """Yield zone batches to try within a region during failover."""
+        raise NotImplementedError
+
+    def get_feasible_launchable_resources(
+            self, resources: 'resources_lib.Resources'
+    ) -> Tuple[List['resources_lib.Resources'], List[str]]:
+        """(feasible concrete candidates, fuzzy near-miss names).
+
+        Reference analog: sky/clouds/cloud.py:428.
+        """
+        raise NotImplementedError
+
+    def validate_region_zone(
+            self, region: typing.Optional[str], zone: typing.Optional[str]
+    ) -> Tuple[typing.Optional[str], typing.Optional[str]]:
+        """Validate/canonicalize a (region, zone) pair for this cloud."""
+        from skypilot_tpu.catalog import tpu_catalog
+        return tpu_catalog.validate_region_zone(region, zone)
+
+    # ------------------------------------------------------------------
+    # Pricing
+    # ------------------------------------------------------------------
+    def instance_cost(self, resources: 'resources_lib.Resources',
+                      seconds: float) -> float:
+        hours = seconds / 3600.0
+        return self.hourly_cost(resources) * hours
+
+    def hourly_cost(self, resources: 'resources_lib.Resources') -> float:
+        raise NotImplementedError
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        """Egress $ for moving data out of this cloud."""
+        return 0.0
+
+    # ------------------------------------------------------------------
+    # Deployment
+    # ------------------------------------------------------------------
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources', region: str,
+            zones: Optional[List[str]],
+            cluster_name: str) -> Dict[str, Any]:
+        """Cloud-specific variables consumed by the provisioner.
+
+        Reference analog: sky/clouds/cloud.py:311 +
+        sky/clouds/gcp.py:509-545 (tpu_vm/tpu_type/tpu_node_name vars).
+        """
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    # Credentials
+    # ------------------------------------------------------------------
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        """(ok, reason-if-not). Reference analog: cloud.py:497."""
+        raise NotImplementedError
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        """Local credential files to sync onto clusters (dst -> src)."""
+        return {}
+
+
+def cloud_in_iterable(cloud: Cloud, clouds: typing.Iterable[Cloud]) -> bool:
+    return any(cloud.is_same_cloud(c) for c in clouds)
+
+
+def get_cloud(name: str) -> Cloud:
+    cloud = registry.CLOUD_REGISTRY.from_str(name)
+    assert cloud is not None
+    return cloud

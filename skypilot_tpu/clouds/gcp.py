@@ -1,0 +1,190 @@
+"""GCP as a TPU cloud: feasibility, deploy vars, credentials.
+
+Reference analog: sky/clouds/gcp.py — but where the reference buries TPU
+handling in special cases of a GPU-centric cloud (`gcp.py:509-545` deploy
+vars, `:717-741` TPU-VM pseudo-instance-type, `:1095-1101` spot-TPU cleanup
+flag), here TPU slices are the primary schedulable resource and the deploy
+variables speak slice language (accelerator_type, topology, hosts,
+runtime_version, queued-resource usage).
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import typing
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu.catalog import tpu_catalog
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.tpu import topology
+from skypilot_tpu.utils import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+_CREDENTIAL_HINT = (
+    'Run `gcloud auth application-default login`, or set '
+    'GOOGLE_APPLICATION_CREDENTIALS to a service-account key.')
+
+# Generations GCP exposes via the queued-resources API (required for v5p+
+# and recommended for all multi-host slices).
+_QUEUED_RESOURCE_GENERATIONS = frozenset({'v5e', 'v5p', 'v6e'})
+
+
+@registry.CLOUD_REGISTRY.register
+class GCP(cloud_lib.Cloud):
+    """Google Cloud TPU slices (tpu.googleapis.com v2 API)."""
+
+    _REPR = 'GCP'
+
+    @classmethod
+    def unsupported_features(
+            cls, resources: 'resources_lib.Resources'
+    ) -> Dict[cloud_lib.CloudImplementationFeatures, str]:
+        unsupported: Dict[cloud_lib.CloudImplementationFeatures, str] = {}
+        sl = resources.tpu
+        if sl is not None and not sl.gen.supports_stop:
+            unsupported[cloud_lib.CloudImplementationFeatures.STOP] = (
+                f'TPU {sl.generation} VMs cannot be stopped; only '
+                f'terminated. Use `down` instead of `stop`.')
+            unsupported[cloud_lib.CloudImplementationFeatures.AUTOSTOP] = (
+                f'autostop requires stop support, unavailable on '
+                f'{sl.generation}.')
+        return unsupported
+
+    # ------------------------------------------------------------------
+    # Offerings
+    # ------------------------------------------------------------------
+    def regions_with_offering(self, resources: 'resources_lib.Resources'
+                              ) -> List[cloud_lib.Region]:
+        sl = resources.tpu
+        assert sl is not None
+        if resources.region is not None:
+            region_names = [resources.region]
+        else:
+            region_names = tpu_catalog.get_regions(sl)
+        regions = []
+        for rname in region_names:
+            zones = tpu_catalog.get_zones(sl, rname)
+            if resources.zone is not None:
+                zones = [z for z in zones if z == resources.zone]
+            if zones:
+                regions.append(
+                    cloud_lib.Region(
+                        rname, tuple(cloud_lib.Zone(z) for z in zones)))
+        return regions
+
+    def zones_provision_loop(
+            self, *, region: str, resources: 'resources_lib.Resources'
+    ) -> Iterator[List[cloud_lib.Zone]]:
+        # TPU slices are zonal: try one zone at a time.
+        sl = resources.tpu
+        assert sl is not None
+        for z in tpu_catalog.get_zones(sl, region):
+            if resources.zone is not None and z != resources.zone:
+                continue
+            yield [cloud_lib.Zone(z)]
+
+    def get_feasible_launchable_resources(
+            self, resources: 'resources_lib.Resources'
+    ) -> Tuple[List['resources_lib.Resources'], List[str]]:
+        if resources.accelerators is None:
+            # CPU-only task: not a TPU slice; GCP TPU cloud offers nothing.
+            return [], []
+        sl = resources.tpu
+        if sl is None:
+            # GPU-era accelerator name: infeasible, suggest TPU swap-ins.
+            fuzzy = [s.name for s in topology.legal_slices('v5e')[:4]]
+            fuzzy += [s.name for s in topology.legal_slices('v5p')[:2]]
+            return [], fuzzy
+        if not tpu_catalog.accelerator_in_region_or_zone(
+                sl, resources.region, resources.zone):
+            return [], [f'{sl.name} in other regions']
+        launchable = resources.copy(cloud=self)
+        return [launchable], []
+
+    # ------------------------------------------------------------------
+    # Pricing
+    # ------------------------------------------------------------------
+    def hourly_cost(self, resources: 'resources_lib.Resources') -> float:
+        sl = resources.tpu
+        assert sl is not None
+        return tpu_catalog.get_hourly_cost(sl, use_spot=resources.use_spot,
+                                           region=resources.region,
+                                           zone=resources.zone)
+
+    def get_egress_cost(self, num_gigabytes: float) -> float:
+        # Approximate tiered GCP internet egress (analog: sky/clouds/gcp.py).
+        if num_gigabytes <= 0:
+            return 0.0
+        if num_gigabytes <= 1024:
+            return 0.12 * num_gigabytes
+        if num_gigabytes <= 10240:
+            return 0.11 * num_gigabytes
+        return 0.08 * num_gigabytes
+
+    # ------------------------------------------------------------------
+    # Deploy variables
+    # ------------------------------------------------------------------
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources', region: str,
+            zones: Optional[List[str]], cluster_name: str) -> Dict[str, Any]:
+        sl = resources.tpu
+        assert sl is not None, 'GCP deploy requires a concrete TPU slice'
+        args = resources.accelerator_args
+        runtime_version = args.get('runtime_version',
+                                   sl.gen.default_runtime_version)
+        use_queued = bool(
+            args.get('use_queued_resources',
+                     sl.generation in _QUEUED_RESOURCE_GENERATIONS))
+        return {
+            'cloud': 'gcp',
+            'region': region,
+            'zones': zones or [],
+            'tpu_generation': sl.generation,
+            'accelerator_type': sl.gcp_accelerator_type,
+            'topology': sl.topology_str,
+            'num_hosts': sl.num_hosts,
+            'num_slices': sl.num_slices,
+            'runtime_version': runtime_version,
+            'use_spot': resources.use_spot,
+            'use_queued_resources': use_queued,
+            'reserved': bool(args.get('reserved', False)),
+            'disk_size_gb': resources.disk_size,
+            'labels': resources.labels,
+            'ports': resources.ports,
+            'cluster_name': cluster_name,
+            'project_id': os.environ.get('GOOGLE_CLOUD_PROJECT', ''),
+            'network': args.get('network', 'default'),
+        }
+
+    # ------------------------------------------------------------------
+    # Credentials
+    # ------------------------------------------------------------------
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        adc = os.environ.get('GOOGLE_APPLICATION_CREDENTIALS')
+        if adc and os.path.exists(os.path.expanduser(adc)):
+            return True, None
+        default_adc = os.path.expanduser(
+            '~/.config/gcloud/application_default_credentials.json')
+        if os.path.exists(default_adc):
+            return True, None
+        try:
+            proc = subprocess.run(
+                ['gcloud', 'auth', 'list', '--format=value(account)'],
+                capture_output=True, text=True, timeout=10, check=False)
+            if proc.returncode == 0 and proc.stdout.strip():
+                return True, None
+        except (FileNotFoundError, subprocess.TimeoutExpired):
+            pass
+        return False, f'No GCP credentials found. {_CREDENTIAL_HINT}'
+
+    def get_credential_file_mounts(self) -> Dict[str, str]:
+        out = {}
+        default_adc = os.path.expanduser(
+            '~/.config/gcloud/application_default_credentials.json')
+        if os.path.exists(default_adc):
+            out['~/.config/gcloud/application_default_credentials.json'] = (
+                default_adc)
+        return out

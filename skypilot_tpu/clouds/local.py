@@ -1,0 +1,133 @@
+"""Local "cloud": fabricated TPU slices backed by local processes.
+
+This is the in-process fake cloud the test strategy requires (SURVEY.md §4
+takeaway: "add a fake TPU provisioner ... as the equivalent of
+`enable_all_clouds`"). Every slice "host" is a directory under
+~/.skytpu/local_cloud/<cluster>/host<i> plus commands executed locally, so
+the full launch→setup→gang-exec→logs→down path runs hermetically in CI with
+zero cloud credentials. JAX jobs run on whatever local backend exists
+(CPU with xla_force_host_platform_device_count, or the one real chip).
+
+It intentionally implements the same Cloud/provision interfaces as GCP so
+the backend cannot special-case it.
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.tpu import topology
+from skypilot_tpu.utils import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+LOCAL_CLOUD_ROOT = os.path.expanduser('~/.skytpu/local_cloud')
+
+# The fake capacity the local cloud advertises, mirroring the catalog's shape:
+# every generation is available in one fake region with two zones (two zones
+# so failover paths are exercisable by fault injection).
+LOCAL_REGION = 'local'
+LOCAL_ZONES = ('local-a', 'local-b')
+# Cap fabricated slices so tests don't spawn hundreds of processes.
+MAX_LOCAL_CHIPS = 64
+
+# Fault injection hook: map zone name -> exception to raise at provision time
+# (set by tests / chaos tooling via skypilot_tpu.provision.local.instance).
+PROVISION_FAULTS: Dict[str, Any] = {}
+
+
+@registry.CLOUD_REGISTRY.register
+class Local(cloud_lib.Cloud):
+    """Fabricated TPU slices on localhost (hermetic end-to-end testing)."""
+
+    _REPR = 'Local'
+
+    @classmethod
+    def unsupported_features(
+            cls, resources: 'resources_lib.Resources'
+    ) -> Dict[cloud_lib.CloudImplementationFeatures, str]:
+        return {
+            cloud_lib.CloudImplementationFeatures.STORAGE_MOUNTING:
+                'local cloud has no object store; use workdir sync.',
+        }
+
+    def regions_with_offering(self, resources: 'resources_lib.Resources'
+                              ) -> List[cloud_lib.Region]:
+        sl = resources.tpu
+        if sl is None or sl.total_chips > MAX_LOCAL_CHIPS:
+            return []
+        if resources.region is not None and resources.region != LOCAL_REGION:
+            return []
+        zones = tuple(
+            cloud_lib.Zone(z) for z in LOCAL_ZONES
+            if resources.zone is None or resources.zone == z)
+        return [cloud_lib.Region(LOCAL_REGION, zones)] if zones else []
+
+    def zones_provision_loop(
+            self, *, region: str, resources: 'resources_lib.Resources'
+    ) -> Iterator[List[cloud_lib.Zone]]:
+        del region
+        for z in LOCAL_ZONES:
+            if resources.zone is not None and z != resources.zone:
+                continue
+            yield [cloud_lib.Zone(z)]
+
+    def get_feasible_launchable_resources(
+            self, resources: 'resources_lib.Resources'
+    ) -> Tuple[List['resources_lib.Resources'], List[str]]:
+        sl = resources.tpu
+        if sl is None:
+            return [], []
+        if sl.total_chips > MAX_LOCAL_CHIPS:
+            return [], [f'local supports ≤{MAX_LOCAL_CHIPS} chips']
+        if resources.region is not None and resources.region != LOCAL_REGION:
+            return [], []
+        return [resources.copy(cloud=self, region=LOCAL_REGION)], []
+
+    def hourly_cost(self, resources: 'resources_lib.Resources') -> float:
+        # Nominal nonzero pricing so the optimizer can rank local below
+        # real clouds only when real clouds are enabled.
+        sl = resources.tpu
+        assert sl is not None
+        per_chip = 0.01 if not resources.use_spot else 0.005
+        return per_chip * sl.total_chips
+
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources', region: str,
+            zones: Optional[List[str]], cluster_name: str) -> Dict[str, Any]:
+        sl = resources.tpu
+        assert sl is not None
+        return {
+            'cloud': 'local',
+            'region': region,
+            'zones': zones or list(LOCAL_ZONES),
+            'tpu_generation': sl.generation,
+            'accelerator_type': sl.gcp_accelerator_type,
+            'topology': sl.topology_str,
+            'num_hosts': sl.num_hosts,
+            'num_slices': sl.num_slices,
+            'use_spot': resources.use_spot,
+            'cluster_name': cluster_name,
+            'root_dir': LOCAL_CLOUD_ROOT,
+        }
+
+    def validate_region_zone(self, region: Optional[str],
+                             zone: Optional[str]
+                             ) -> Tuple[Optional[str], Optional[str]]:
+        if zone is not None:
+            if zone not in LOCAL_ZONES:
+                raise ValueError(
+                    f'Zone {zone!r} unknown to local cloud; '
+                    f'zones: {LOCAL_ZONES}')
+            return LOCAL_REGION, zone
+        if region is not None and region != LOCAL_REGION:
+            raise ValueError(f'Local cloud has a single region '
+                             f'{LOCAL_REGION!r}, got {region!r}.')
+        return region, zone
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        return True, None

@@ -1,0 +1,123 @@
+"""Layered immutable config.
+
+Reference analog: sky/skypilot_config.py — server config → user
+~/.skytpu/config.yaml → project ./.skytpu.yaml → per-task `config:` overrides,
+merged once at import and exposed via `get_nested`. A contextvar overlay
+supports per-request overrides inside the async API server
+(reference: sky/utils/context.py).
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import copy
+import os
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.utils import common_utils
+
+logger = sky_logging.init_logger(__name__)
+
+USER_CONFIG_PATH = '~/.skytpu/config.yaml'
+PROJECT_CONFIG_NAME = '.skytpu.yaml'
+ENV_VAR_CONFIG_PATH = 'SKYTPU_CONFIG'
+
+_global_config: Optional[Dict[str, Any]] = None
+_load_lock = threading.Lock()
+_override_var: contextvars.ContextVar[Optional[Dict[str, Any]]] = (
+    contextvars.ContextVar('skytpu_config_override', default=None))
+
+
+def _merge_dicts(base: Dict[str, Any], override: Dict[str, Any]
+                 ) -> Dict[str, Any]:
+    """Recursive dict merge; override wins; lists replace wholesale."""
+    out = dict(base)
+    for k, v in override.items():
+        if (k in out and isinstance(out[k], dict) and isinstance(v, dict)):
+            out[k] = _merge_dicts(out[k], v)
+        else:
+            out[k] = copy.deepcopy(v)
+    return out
+
+
+def _load_layers() -> Dict[str, Any]:
+    layers: List[str] = []
+    env_path = os.environ.get(ENV_VAR_CONFIG_PATH)
+    if env_path:
+        layers.append(os.path.expanduser(env_path))
+    else:
+        layers.append(os.path.expanduser(USER_CONFIG_PATH))
+        layers.append(os.path.join(os.getcwd(), PROJECT_CONFIG_NAME))
+    merged: Dict[str, Any] = {}
+    for path in layers:
+        if os.path.exists(path):
+            try:
+                merged = _merge_dicts(merged, common_utils.read_yaml(path))
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning(f'Failed to load config {path}: {e}')
+    return merged
+
+
+def _config() -> Dict[str, Any]:
+    global _global_config
+    if _global_config is None:
+        with _load_lock:
+            if _global_config is None:
+                _global_config = _load_layers()
+    override = _override_var.get()
+    if override:
+        return _merge_dicts(_global_config, override)
+    return _global_config
+
+
+def reload_config() -> None:
+    global _global_config
+    with _load_lock:
+        _global_config = None
+
+
+def get_nested(keys: Iterable[str], default_value: Any = None) -> Any:
+    """config.get_nested(('provision', 'max_retries'), 3)
+
+    Reference analog: sky/skypilot_config.py:311.
+    """
+    cur: Any = _config()
+    for k in keys:
+        if not isinstance(cur, dict) or k not in cur:
+            return default_value
+        cur = cur[k]
+    return cur
+
+
+def to_dict() -> Dict[str, Any]:
+    return copy.deepcopy(_config())
+
+
+@contextlib.contextmanager
+def override(config_dict: Optional[Dict[str, Any]]):
+    """Per-request config overlay (analog: sky/utils/context.py usage)."""
+    if not config_dict:
+        yield
+        return
+    current = _override_var.get() or {}
+    token = _override_var.set(_merge_dicts(current, config_dict))
+    try:
+        yield
+    finally:
+        _override_var.reset(token)
+
+
+def get_effective_region_config(cloud: str, region: Optional[str],
+                                keys: Tuple[str, ...],
+                                default_value: Any = None) -> Any:
+    """Cloud/region-scoped lookup (analog: skypilot_config.py:339):
+
+    {cloud}.{key} overridden by {cloud}.regions.{region}.{key}.
+    """
+    base = get_nested((cloud,) + keys, default_value)
+    if region is None:
+        return base
+    region_val = get_nested((cloud, 'regions', region) + keys, None)
+    return base if region_val is None else region_val

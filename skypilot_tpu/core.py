@@ -1,0 +1,166 @@
+"""Server-side implementations of status/start/stop/down/queue/cancel/logs.
+
+Reference analog: sky/core.py (`status:99`, `start:525`, `down:603`,
+`queue:806`, `cancel:900`, `tail_logs:997`) + the status-refresh logic of
+sky/backends/backend_utils.py:2278.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_state
+from skypilot_tpu import provision
+from skypilot_tpu import sky_logging
+from skypilot_tpu.backends import slice_backend
+from skypilot_tpu.utils import locks
+from skypilot_tpu.utils.status_lib import ClusterStatus
+
+logger = sky_logging.init_logger(__name__)
+
+
+def _handle_of(record: Dict[str, Any]) -> slice_backend.SliceResourceHandle:
+    return slice_backend.SliceResourceHandle.from_dict(record['handle'])
+
+
+def _refresh_one(record: Dict[str, Any]) -> Dict[str, Any]:
+    """Reconcile DB status with the cloud's view (backend_utils.py:2278)."""
+    name = record['name']
+    handle = _handle_of(record)
+    try:
+        statuses = provision.query_instances(handle.cloud, handle.region,
+                                             name, handle.provider_config)
+    except exceptions.ClusterDoesNotExist:
+        statuses = {}
+    except Exception as e:  # pylint: disable=broad-except
+        # Transient cloud-API failure: keep the record untouched rather than
+        # dropping a possibly-live (billing!) slice from the DB.
+        logger.warning(f'Status refresh for {name} failed (keeping current '
+                       f'state): {e}')
+        return record
+    if not statuses:
+        # Cloud says gone (e.g. preempted spot slice): drop from DB.
+        global_state.remove_cluster(name)
+        record = dict(record)
+        record['status'] = None
+        return record
+    values = set(statuses.values())
+    if values == {'running'} or values == {'READY'}:
+        new_status = ClusterStatus.UP
+    elif values <= {'stopped', 'STOPPED', 'STOPPING'}:
+        new_status = ClusterStatus.STOPPED
+    else:
+        new_status = ClusterStatus.INIT
+    if new_status != record['status']:
+        global_state.set_cluster_status(name, new_status)
+        record = dict(record)
+        record['status'] = new_status
+    return record
+
+
+def status(cluster_names: Optional[List[str]] = None,
+           refresh: bool = False) -> List[Dict[str, Any]]:
+    records = global_state.get_clusters()
+    if cluster_names:
+        records = [r for r in records if r['name'] in cluster_names]
+    if refresh:
+        refreshed = []
+        for r in records:
+            with locks.cluster_status_lock(r['name']):
+                r = _refresh_one(r)
+            if r['status'] is not None:
+                refreshed.append(r)
+        records = refreshed
+    return records
+
+
+def _get_up_handle(cluster_name: str) -> slice_backend.SliceResourceHandle:
+    record = global_state.get_cluster(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} not found.')
+    if record['status'] != ClusterStatus.UP:
+        raise exceptions.ClusterNotUpError(
+            f'Cluster {cluster_name!r} is {record["status"].value}, not UP.')
+    return _handle_of(record)
+
+
+def start(cluster_name: str) -> None:
+    """Restart a STOPPED cluster (reference analog: core.py:525)."""
+    record = global_state.get_cluster(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} not found.')
+    handle = _handle_of(record)
+    from skypilot_tpu.provision import common as provision_common
+    from skypilot_tpu.provision import provisioner as provisioner_lib
+    config = provision_common.ProvisionConfig(
+        provider_config=handle.provider_config,
+        authentication_config={},
+        count=1,
+        tags={},
+        resume_stopped_nodes=True,
+    )
+    provision.run_instances(handle.cloud, handle.region, handle.zone or '',
+                            cluster_name, config)
+    cluster_info = handle.get_cluster_info()
+    provisioner_lib.wait_for_connection(cluster_info)
+    provisioner_lib.post_provision_runtime_setup(cluster_name, cluster_info)
+    global_state.set_cluster_status(cluster_name, ClusterStatus.UP)
+
+
+def stop(cluster_name: str) -> None:
+    record = global_state.get_cluster(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} not found.')
+    handle = _handle_of(record)
+    backend = slice_backend.TpuSliceBackend()
+    backend.teardown(handle, terminate=False)
+
+
+def down(cluster_name: str) -> None:
+    record = global_state.get_cluster(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} not found.')
+    handle = _handle_of(record)
+    backend = slice_backend.TpuSliceBackend()
+    backend.teardown(handle, terminate=True)
+
+
+def autostop(cluster_name: str, idle_minutes: Optional[int],
+             down_after: bool = False) -> None:
+    handle = _get_up_handle(cluster_name)
+    backend = slice_backend.TpuSliceBackend()
+    backend.set_autostop(handle, idle_minutes, down_after)
+
+
+def queue(cluster_name: str) -> List[Dict[str, Any]]:
+    handle = _get_up_handle(cluster_name)
+    backend = slice_backend.TpuSliceBackend()
+    return backend.queue(handle)
+
+
+def cancel(cluster_name: str,
+           job_ids: Optional[List[int]] = None) -> List[int]:
+    handle = _get_up_handle(cluster_name)
+    backend = slice_backend.TpuSliceBackend()
+    return backend.cancel_jobs(handle, job_ids)
+
+
+def tail_logs(cluster_name: str, job_id: Optional[int] = None,
+              follow: bool = True) -> int:
+    handle = _get_up_handle(cluster_name)
+    backend = slice_backend.TpuSliceBackend()
+    return backend.tail_logs(handle, job_id, follow=follow)
+
+
+def job_status(cluster_name: str, job_id: int):
+    handle = _get_up_handle(cluster_name)
+    backend = slice_backend.TpuSliceBackend()
+    return backend.job_status(handle, job_id)
+
+
+def cost_report() -> List[Dict[str, Any]]:
+    return global_state.get_cost_report()

@@ -1,0 +1,96 @@
+"""DAG of tasks (networkx digraph) + chain helpers.
+
+Reference analog: sky/dag.py:11.
+"""
+from __future__ import annotations
+
+import threading
+import typing
+from typing import List, Optional
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import task as task_lib
+
+
+class Dag:
+    """A directed acyclic graph of Tasks; most user flows are 1-task dags."""
+
+    def __init__(self, name: Optional[str] = None) -> None:
+        import networkx as nx
+        self.name = name
+        self.graph = nx.DiGraph()
+        self.tasks: List['task_lib.Task'] = []
+        self.policy_applied = False
+
+    def add(self, task: 'task_lib.Task') -> None:
+        self.graph.add_node(task)
+        self.tasks.append(task)
+
+    def remove(self, task: 'task_lib.Task') -> None:
+        self.graph.remove_node(task)
+        self.tasks.remove(task)
+
+    def add_edge(self, op1: 'task_lib.Task', op2: 'task_lib.Task') -> None:
+        assert op1 in self.graph.nodes and op2 in self.graph.nodes
+        self.graph.add_edge(op1, op2)
+
+    def __len__(self) -> int:
+        return len(self.tasks)
+
+    def __enter__(self) -> 'Dag':
+        push_dag(self)
+        return self
+
+    def __exit__(self, *args) -> None:
+        pop_dag()
+
+    def __repr__(self) -> str:
+        task_info = ', '.join(repr(t) for t in self.tasks)
+        return f'DAG:\n {task_info}'
+
+    def get_graph(self):
+        return self.graph
+
+    def is_chain(self) -> bool:
+        """True for linear pipelines (enables the DP optimizer path)."""
+        import networkx as nx
+        nodes = list(self.graph.nodes)
+        if len(nodes) <= 1:
+            return True
+        out_degrees = [self.graph.out_degree(n) for n in nodes]
+        in_degrees = [self.graph.in_degree(n) for n in nodes]
+        return (nx.is_weakly_connected(self.graph) and
+                all(d <= 1 for d in out_degrees) and
+                all(d <= 1 for d in in_degrees))
+
+    def topological_order(self) -> List['task_lib.Task']:
+        import networkx as nx
+        return list(nx.topological_sort(self.graph))
+
+    def validate(self) -> None:
+        import networkx as nx
+        if not nx.is_directed_acyclic_graph(self.graph):
+            raise ValueError('DAG has a cycle.')
+
+
+class _DagContext(threading.local):
+    """`with Dag() as dag:` registration context (analog sky/dag.py)."""
+
+    def __init__(self):
+        super().__init__()
+        self._stack: List[Dag] = []
+
+    def push(self, dag: Dag) -> None:
+        self._stack.append(dag)
+
+    def pop(self) -> Dag:
+        return self._stack.pop()
+
+    def current(self) -> Optional[Dag]:
+        return self._stack[-1] if self._stack else None
+
+
+_dag_context = _DagContext()
+push_dag = _dag_context.push
+pop_dag = _dag_context.pop
+get_current_dag = _dag_context.current

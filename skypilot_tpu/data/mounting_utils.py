@@ -1,0 +1,29 @@
+"""Mount/copy command builders (reference analog: sky/data/mounting_utils.py)."""
+from __future__ import annotations
+
+import shlex
+
+_GCSFUSE_FLAGS = '--implicit-dirs --dir-mode 777 --file-mode 666'
+
+
+def gsutil_copy_command(bucket_url: str, dst: str) -> str:
+    dst_q = shlex.quote(dst)
+    return (f'mkdir -p {dst_q} && '
+            f'gsutil -m rsync -r {shlex.quote(bucket_url)} {dst_q}')
+
+
+def gcsfuse_mount_command(bucket_url: str, dst: str,
+                          cached: bool = False) -> str:
+    assert bucket_url.startswith('gs://'), bucket_url
+    bucket = bucket_url[len('gs://'):].split('/')[0]
+    dst_q = shlex.quote(dst)
+    flags = _GCSFUSE_FLAGS
+    if cached:
+        flags += ' --file-cache-max-size-mb 10240 --cache-dir /tmp/gcsfuse_cache'
+    return (f'mkdir -p {dst_q} && '
+            f'(mountpoint -q {dst_q} || '
+            f'gcsfuse {flags} {shlex.quote(bucket)} {dst_q})')
+
+
+def fusermount_unmount_command(dst: str) -> str:
+    return f'fusermount -u {shlex.quote(dst)} || umount {shlex.quote(dst)}'

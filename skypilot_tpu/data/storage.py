@@ -1,0 +1,143 @@
+"""Storage abstraction: buckets synced/mounted onto clusters (GCS-first).
+
+Reference analog: sky/data/storage.py (`Storage:560`, `AbstractStore:320`,
+GcsStore:2149, modes MOUNT/COPY/MOUNT_CACHED at StorageMode:306). Round-1
+scope: GCS + local-dir stores with COPY and MOUNT modes; mounting uses
+gcsfuse when present (mounting_utils builds the commands). S3-compatible
+stores are registered but gated on credentials.
+"""
+from __future__ import annotations
+
+import enum
+import os
+import subprocess
+import typing
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_state
+from skypilot_tpu import sky_logging
+from skypilot_tpu.data import mounting_utils
+from skypilot_tpu.utils import subprocess_utils
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.backends import slice_backend
+
+logger = sky_logging.init_logger(__name__)
+
+
+class StorageMode(enum.Enum):
+    COPY = 'COPY'            # one-shot sync onto host disk
+    MOUNT = 'MOUNT'          # FUSE mount (gcsfuse)
+    MOUNT_CACHED = 'MOUNT_CACHED'
+
+
+class StoreType(enum.Enum):
+    GCS = 'gcs'
+    S3 = 's3'
+    LOCAL = 'local'
+
+    @classmethod
+    def from_source(cls, source: str) -> 'StoreType':
+        if source.startswith('gs://'):
+            return cls.GCS
+        if source.startswith(('s3://', 'r2://')):
+            return cls.S3
+        return cls.LOCAL
+
+
+class Storage:
+    """A named bucket (or local dir) attachable to clusters."""
+
+    def __init__(self, name: Optional[str] = None,
+                 source: Optional[str] = None,
+                 mode: StorageMode = StorageMode.COPY,
+                 persistent: bool = True):
+        if name is None and source is None:
+            raise exceptions.StorageError(
+                'Storage needs a name or a source.')
+        self.source = source
+        self.mode = mode
+        self.persistent = persistent
+        self.store_type = (StoreType.from_source(source)
+                           if source else StoreType.GCS)
+        if name is None:
+            assert source is not None
+            name = source.rstrip('/').split('/')[-1]
+        self.name = name
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'Storage':
+        mode = StorageMode(str(config.get('mode', 'COPY')).upper())
+        return cls(name=config.get('name'), source=config.get('source'),
+                   mode=mode,
+                   persistent=bool(config.get('persistent', True)))
+
+    def bucket_url(self) -> str:
+        if self.store_type == StoreType.GCS:
+            if self.source and self.source.startswith('gs://'):
+                return self.source
+            return f'gs://{self.name}'
+        if self.store_type == StoreType.S3:
+            assert self.source is not None
+            return self.source
+        assert self.source is not None
+        return self.source
+
+    # -- local operations (control-plane side) --------------------------
+    def upload_local_source(self) -> None:
+        """If source is a local dir, sync it into the bucket (gsutil)."""
+        if self.store_type != StoreType.LOCAL or self.source is None:
+            return
+        target = f'gs://{self.name}'
+        cmd = ['gsutil', '-m', 'rsync', '-r',
+               os.path.expanduser(self.source), target]
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              check=False)
+        if proc.returncode != 0:
+            raise exceptions.StorageUploadError(
+                f'gsutil rsync failed: {proc.stderr}')
+        self.store_type = StoreType.GCS
+        self.source = target
+
+    def record(self) -> None:
+        global_state.add_or_update_storage(
+            self.name, {
+                'source': self.source,
+                'mode': self.mode.value,
+                'store_type': self.store_type.value,
+            }, 'READY')
+
+    def delete(self) -> None:
+        global_state.remove_storage(self.name)
+
+
+def execute_storage_mounts(handle: 'slice_backend.SliceResourceHandle',
+                           storage_mounts: Dict[str, Any]) -> None:
+    """Realize each `file_mounts: {dst: {source, mode}}` storage entry on
+    every host of the cluster."""
+    from skypilot_tpu.provision import provisioner as provisioner_lib
+    cluster_info = handle.get_cluster_info()
+    runners = provisioner_lib.get_command_runners(cluster_info)
+    for dst, raw in storage_mounts.items():
+        storage = Storage.from_yaml_config(raw if isinstance(raw, dict)
+                                           else {'source': raw})
+        if cluster_info.provider_name == 'local':
+            logger.warning(f'Skipping storage mount {dst} on local cloud '
+                           f'(no object-store access).')
+            continue
+        if storage.mode == StorageMode.COPY:
+            cmd = mounting_utils.gsutil_copy_command(storage.bucket_url(), dst)
+        else:
+            cmd = mounting_utils.gcsfuse_mount_command(
+                storage.bucket_url(), dst,
+                cached=storage.mode == StorageMode.MOUNT_CACHED)
+
+        def _mount(runner, cmd=cmd, dst=dst) -> None:
+            rc = runner.run(cmd, log_path='/dev/null')
+            if rc != 0:
+                raise exceptions.StorageError(
+                    f'Failed to realize storage mount {dst} on '
+                    f'{runner.node_id}.')
+
+        subprocess_utils.run_in_parallel(_mount, runners)

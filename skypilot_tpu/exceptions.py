@@ -1,0 +1,203 @@
+"""Error taxonomy for the control plane.
+
+Reference analog: sky/exceptions.py (error classes carrying failover history
+so the provisioner can report every zone/region it tried).
+"""
+from __future__ import annotations
+
+import enum
+from typing import Any, Dict, List, Optional
+
+
+class SkyTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+# ---------------------------------------------------------------------------
+# Resource / optimizer errors
+# ---------------------------------------------------------------------------
+class ResourcesUnavailableError(SkyTpuError):
+    """No cloud/zone could satisfy the request.
+
+    Carries the per-location failure history accumulated during failover, the
+    analog of sky/exceptions.py ResourcesUnavailableError.failover_history.
+    """
+
+    def __init__(self, message: str,
+                 failover_history: Optional[List[Exception]] = None,
+                 no_failover: bool = False) -> None:
+        super().__init__(message)
+        self.failover_history: List[Exception] = failover_history or []
+        self.no_failover = no_failover
+
+    def with_failover_history(
+            self, history: List[Exception]) -> 'ResourcesUnavailableError':
+        self.failover_history = history
+        return self
+
+
+class ResourcesMismatchError(SkyTpuError):
+    """Requested resources conflict with what a cluster actually has."""
+
+
+class InvalidTopologyError(SkyTpuError):
+    """A TPU slice spec does not correspond to a legal ICI topology."""
+
+
+class NoCloudAccessError(SkyTpuError):
+    """No cloud is enabled / credentials missing."""
+
+
+# ---------------------------------------------------------------------------
+# Provisioning errors
+# ---------------------------------------------------------------------------
+class ProvisionError(SkyTpuError):
+    """Raised by provision implementations; carries per-zone detail."""
+
+    def __init__(self, message: str, errors: Optional[List[Dict[str, Any]]] = None):
+        super().__init__(message)
+        # List of {'code', 'domain', 'message'} dicts, one per underlying
+        # cloud error (analog: sky/provision/common.py ProvisionerError).
+        self.errors = errors or []
+
+
+class InsufficientCapacityError(ProvisionError):
+    """Stockout: the zone has no capacity for the requested slice."""
+
+
+class QuotaExceededError(ProvisionError):
+    """Project quota would be exceeded in this region."""
+
+
+class ClusterSetupError(SkyTpuError):
+    """Runtime setup (agent install, env bootstrap) failed on some host."""
+
+
+class CommandError(SkyTpuError):
+    """A remote command exited non-zero."""
+
+    def __init__(self, returncode: int, command: str, error_msg: str,
+                 detailed_reason: Optional[str] = None) -> None:
+        self.returncode = returncode
+        self.command = command
+        self.error_msg = error_msg
+        self.detailed_reason = detailed_reason
+        super().__init__(
+            f'Command {command[:100]!r} failed with return code {returncode}: '
+            f'{error_msg}')
+
+
+# ---------------------------------------------------------------------------
+# Cluster / job lifecycle errors
+# ---------------------------------------------------------------------------
+class ClusterNotUpError(SkyTpuError):
+    """Operation requires an UP cluster."""
+
+
+class ClusterDoesNotExist(SkyTpuError):
+    """Named cluster not found in the state DB."""
+
+
+class ClusterOwnerIdentityMismatchError(SkyTpuError):
+    """Cluster belongs to a different user identity."""
+
+
+class JobNotFoundError(SkyTpuError):
+    """Job id not present in the on-cluster queue."""
+
+
+class JobExitNonZeroError(SkyTpuError):
+    """The user job exited with a non-zero status."""
+
+
+class ManagedJobReachedMaxRetriesError(SkyTpuError):
+    """Managed job recovery gave up after max retries."""
+
+
+class ManagedJobStatusError(SkyTpuError):
+    """Managed job is in an unexpected state."""
+
+
+class ServeUserTerminatedError(SkyTpuError):
+    """Service was torn down by the user while an operation was in flight."""
+
+
+class RequestCancelled(SkyTpuError):
+    """An API-server request was cancelled by the client."""
+
+
+class ApiServerConnectionError(SkyTpuError):
+    """Client could not reach the API server."""
+
+    def __init__(self, server_url: str) -> None:
+        super().__init__(
+            f'Could not connect to API server at {server_url}. '
+            f'Start one with `skytpu api start`.')
+        self.server_url = server_url
+
+
+class StorageError(SkyTpuError):
+    """Bucket/storage related failures."""
+
+
+class StorageBucketCreateError(StorageError):
+    pass
+
+
+class StorageBucketGetError(StorageError):
+    pass
+
+
+class StorageUploadError(StorageError):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# Error serialization over the client/server boundary
+# ---------------------------------------------------------------------------
+class ErrorCode(enum.Enum):
+    UNKNOWN = 'unknown'
+    RESOURCES_UNAVAILABLE = 'resources_unavailable'
+    CLUSTER_NOT_FOUND = 'cluster_not_found'
+    CLUSTER_NOT_UP = 'cluster_not_up'
+    JOB_NOT_FOUND = 'job_not_found'
+    COMMAND_FAILED = 'command_failed'
+    REQUEST_CANCELLED = 'request_cancelled'
+    INVALID_ARGUMENT = 'invalid_argument'
+
+
+_CODE_TO_EXC = {
+    ErrorCode.RESOURCES_UNAVAILABLE: ResourcesUnavailableError,
+    ErrorCode.CLUSTER_NOT_FOUND: ClusterDoesNotExist,
+    ErrorCode.CLUSTER_NOT_UP: ClusterNotUpError,
+    ErrorCode.JOB_NOT_FOUND: JobNotFoundError,
+    ErrorCode.COMMAND_FAILED: CommandError,
+    ErrorCode.REQUEST_CANCELLED: RequestCancelled,
+}
+
+_EXC_TO_CODE = {v: k for k, v in _CODE_TO_EXC.items()}
+
+
+def serialize_exception(exc: BaseException) -> Dict[str, Any]:
+    """JSON-safe encoding of an exception for the request DB / wire."""
+    code = ErrorCode.UNKNOWN
+    for klass, c in _EXC_TO_CODE.items():
+        if isinstance(exc, klass):
+            code = c
+            break
+    return {
+        'type': type(exc).__name__,
+        'code': code.value,
+        'message': str(exc),
+    }
+
+
+def deserialize_exception(payload: Dict[str, Any]) -> Exception:
+    try:
+        code = ErrorCode(payload.get('code', 'unknown'))
+    except ValueError:
+        code = ErrorCode.UNKNOWN
+    if code is ErrorCode.COMMAND_FAILED:
+        return CommandError(1, '<remote>', payload.get('message', ''))
+    klass = _CODE_TO_EXC.get(code, SkyTpuError)
+    return klass(payload.get('message', ''))

@@ -1,0 +1,201 @@
+"""Task life-cycle driver: OPTIMIZE → PROVISION → SYNC → SETUP → EXEC → DOWN.
+
+Reference analog: sky/execution.py (`Stage:40`, `_execute:104`,
+`_execute_dag:231`, `launch:529`, `exec:726`).
+"""
+from __future__ import annotations
+
+import enum
+import typing
+from typing import List, Optional, Tuple
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_state
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.backends import slice_backend
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils.status_lib import ClusterStatus
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+logger = sky_logging.init_logger(__name__)
+
+
+class Stage(enum.Enum):
+    OPTIMIZE = enum.auto()
+    PROVISION = enum.auto()
+    SYNC_WORKDIR = enum.auto()
+    SYNC_FILE_MOUNTS = enum.auto()
+    SETUP = enum.auto()
+    EXEC = enum.auto()
+    DOWN = enum.auto()
+
+
+def _as_dag(entrypoint) -> dag_lib.Dag:
+    if isinstance(entrypoint, dag_lib.Dag):
+        return entrypoint
+    assert isinstance(entrypoint, task_lib.Task), entrypoint
+    dag = dag_lib.Dag()
+    dag.add(entrypoint)
+    return dag
+
+
+def _execute(
+    task: task_lib.Task,
+    *,
+    cluster_name: str,
+    stages: List[Stage],
+    dryrun: bool = False,
+    detach_run: bool = False,
+    optimize_target: optimizer_lib.OptimizeTarget = (
+        optimizer_lib.OptimizeTarget.COST),
+    down: bool = False,
+    retry_until_up: bool = False,
+) -> Tuple[Optional[int], Optional[slice_backend.SliceResourceHandle]]:
+    """Run the requested stages for a single task. Returns (job_id, handle)."""
+    from skypilot_tpu import config as config_lib
+    with config_lib.override(task.config_overrides):
+        return _execute_inner(
+            task, cluster_name=cluster_name, stages=stages, dryrun=dryrun,
+            detach_run=detach_run, optimize_target=optimize_target,
+            down=down, retry_until_up=retry_until_up)
+
+
+def _execute_inner(
+    task: task_lib.Task,
+    *,
+    cluster_name: str,
+    stages: List[Stage],
+    dryrun: bool,
+    detach_run: bool,
+    optimize_target: optimizer_lib.OptimizeTarget,
+    down: bool,
+    retry_until_up: bool,
+) -> Tuple[Optional[int], Optional[slice_backend.SliceResourceHandle]]:
+    backend = slice_backend.TpuSliceBackend()
+
+    if Stage.OPTIMIZE in stages:
+        dag = _as_dag(task)
+        optimizer_lib.Optimizer.optimize(dag, minimize=optimize_target,
+                                         quiet=dryrun)
+
+    to_provision = task.best_resources
+    if to_provision is None:
+        res_list = task.resources_list()
+        if res_list and res_list[0].is_launchable():
+            to_provision = res_list[0]
+        else:
+            raise exceptions.ResourcesUnavailableError(
+                'Task has no launchable resources; run with OPTIMIZE or '
+                'pass a concrete cloud + TPU slice.')
+
+    handle: Optional[slice_backend.SliceResourceHandle] = None
+    if Stage.PROVISION in stages:
+        # Fail fast on features the chosen cloud cannot deliver (e.g.
+        # autostop on a TPU generation without stop support).
+        assert to_provision.cloud is not None
+        type(to_provision.cloud).check_features_are_supported(
+            to_provision, to_provision.get_required_cloud_features())
+        handle = backend.provision(task, to_provision, dryrun=dryrun,
+                                   cluster_name=cluster_name,
+                                   retry_until_up=retry_until_up)
+    if dryrun or handle is None:
+        logger.info('Dryrun complete.')
+        return None, None
+
+    if Stage.SYNC_WORKDIR in stages and task.workdir is not None:
+        backend.sync_workdir(handle, task.workdir)
+    if Stage.SYNC_FILE_MOUNTS in stages and (task.file_mounts or
+                                             task.storage_mounts):
+        backend.sync_file_mounts(handle, task.file_mounts,
+                                 task.storage_mounts)
+    if Stage.SETUP in stages:
+        backend.setup(handle, task)
+
+    job_id: Optional[int] = None
+    if Stage.EXEC in stages:
+        job_id = backend.execute(handle, task, detach_run=detach_run)
+
+    if Stage.DOWN in stages and down:
+        backend.teardown(handle, terminate=True)
+    return job_id, handle
+
+
+def launch(
+    entrypoint,
+    cluster_name: Optional[str] = None,
+    *,
+    dryrun: bool = False,
+    detach_run: bool = False,
+    down: bool = False,
+    optimize_target: optimizer_lib.OptimizeTarget = (
+        optimizer_lib.OptimizeTarget.COST),
+    retry_until_up: bool = False,
+    no_setup: bool = False,
+) -> Tuple[Optional[int], Optional[slice_backend.SliceResourceHandle]]:
+    """Provision (or reuse) a cluster and run the task on it.
+
+    Reference analog: sky/execution.py:529.
+    """
+    dag = _as_dag(entrypoint)
+    if len(dag.tasks) != 1:
+        raise NotImplementedError(
+            'Multi-task DAG launch goes through the managed-jobs plane '
+            '(skytpu jobs launch); `launch` takes a single task.')
+    task = dag.tasks[0]
+    if cluster_name is None:
+        cluster_name = common_utils.generate_cluster_name()
+    common_utils.check_cluster_name_is_valid(cluster_name)
+    stages = [
+        Stage.OPTIMIZE, Stage.PROVISION, Stage.SYNC_WORKDIR,
+        Stage.SYNC_FILE_MOUNTS, Stage.SETUP, Stage.EXEC, Stage.DOWN,
+    ]
+    if no_setup:
+        stages.remove(Stage.SETUP)
+    return _execute(task, cluster_name=cluster_name, stages=stages,
+                    dryrun=dryrun, detach_run=detach_run,
+                    optimize_target=optimize_target, down=down,
+                    retry_until_up=retry_until_up)
+
+
+def exec(  # pylint: disable=redefined-builtin
+    entrypoint,
+    cluster_name: str,
+    *,
+    detach_run: bool = False,
+    dryrun: bool = False,
+) -> Tuple[Optional[int], Optional[slice_backend.SliceResourceHandle]]:
+    """Run a task on an existing cluster, skipping provision/setup.
+
+    Reference analog: sky/execution.py:726.
+    """
+    dag = _as_dag(entrypoint)
+    assert len(dag.tasks) == 1
+    task = dag.tasks[0]
+    record = global_state.get_cluster(cluster_name)
+    if record is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Cluster {cluster_name!r} does not exist; use launch.')
+    if record['status'] != ClusterStatus.UP:
+        raise exceptions.ClusterNotUpError(
+            f'Cluster {cluster_name!r} is {record["status"].value}.')
+    handle = slice_backend.SliceResourceHandle.from_dict(record['handle'])
+    launched = handle.launched_resources_obj()
+    for want in task.resources_list():
+        if not want.less_demanding_than(launched):
+            raise exceptions.ResourcesMismatchError(
+                f'Task requires {want.format_brief()}, but cluster has '
+                f'{launched.format_brief()}.')
+    task.best_resources = launched
+    backend = slice_backend.TpuSliceBackend()
+    if dryrun:
+        logger.info(f'Dryrun: would exec on {cluster_name!r}.')
+        return None, handle
+    if task.workdir is not None:
+        backend.sync_workdir(handle, task.workdir)
+    job_id = backend.execute(handle, task, detach_run=detach_run)
+    return job_id, handle

@@ -1,0 +1,229 @@
+"""Control-plane state DB: clusters, handles, launch history.
+
+Reference analog: sky/global_user_state.py (SQLAlchemy sqlite with pickled
+cluster handles, tables at :72-93). Plain sqlite3 here (no SQLAlchemy in the
+image); handles are JSON, not pickles, so the DB is inspectable and
+version-tolerant.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils.status_lib import ClusterStatus
+
+_DB_PATH_ENV = 'SKYTPU_STATE_DB'
+_local = threading.local()
+
+
+def _db_path() -> str:
+    path = os.environ.get(_DB_PATH_ENV, '~/.skytpu/state.db')
+    path = os.path.expanduser(path)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    return path
+
+
+def _conn() -> sqlite3.Connection:
+    # One connection per thread; sqlite locks handle cross-process safety.
+    conn = getattr(_local, 'conn', None)
+    if conn is None or getattr(_local, 'path', None) != _db_path():
+        conn = sqlite3.connect(_db_path(), timeout=30)
+        conn.execute('PRAGMA journal_mode=WAL')
+        _create_tables(conn)
+        _local.conn = conn
+        _local.path = _db_path()
+    return conn
+
+
+def _create_tables(conn: sqlite3.Connection) -> None:
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS clusters (
+            name TEXT PRIMARY KEY,
+            launched_at REAL,
+            handle TEXT,
+            last_use TEXT,
+            status TEXT,
+            autostop TEXT,
+            owner TEXT,
+            launch_cost REAL DEFAULT 0.0
+        )""")
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS cluster_history (
+            row_id INTEGER PRIMARY KEY AUTOINCREMENT,
+            name TEXT,
+            launched_at REAL,
+            duration_seconds REAL,
+            resources TEXT,
+            cost REAL,
+            user TEXT
+        )""")
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS storage (
+            name TEXT PRIMARY KEY,
+            launched_at REAL,
+            handle TEXT,
+            status TEXT
+        )""")
+    conn.commit()
+
+
+# ---------------------------------------------------------------------------
+# Clusters
+# ---------------------------------------------------------------------------
+def add_or_update_cluster(cluster_name: str,
+                          handle: Dict[str, Any],
+                          status: ClusterStatus,
+                          is_launch: bool = False) -> None:
+    conn = _conn()
+    now = time.time()
+    existing = get_cluster(cluster_name)
+    launched_at = (now if is_launch or existing is None
+                   else existing['launched_at'])
+    conn.execute(
+        'INSERT INTO clusters (name, launched_at, handle, last_use, status, '
+        'owner) VALUES (?, ?, ?, ?, ?, ?) '
+        'ON CONFLICT(name) DO UPDATE SET handle=excluded.handle, '
+        'status=excluded.status, last_use=excluded.last_use, '
+        'launched_at=excluded.launched_at',
+        (cluster_name, launched_at, json.dumps(handle),
+         common_utils.get_user(), status.value, common_utils.get_user_hash()))
+    conn.commit()
+
+
+def set_cluster_status(cluster_name: str, status: ClusterStatus) -> None:
+    conn = _conn()
+    conn.execute('UPDATE clusters SET status = ? WHERE name = ?',
+                 (status.value, cluster_name))
+    conn.commit()
+
+
+def set_cluster_autostop(cluster_name: str,
+                         autostop: Optional[Dict[str, Any]]) -> None:
+    conn = _conn()
+    conn.execute('UPDATE clusters SET autostop = ? WHERE name = ?',
+                 (json.dumps(autostop) if autostop else None, cluster_name))
+    conn.commit()
+
+
+def get_cluster(cluster_name: str) -> Optional[Dict[str, Any]]:
+    conn = _conn()
+    conn.row_factory = sqlite3.Row
+    row = conn.execute('SELECT * FROM clusters WHERE name = ?',
+                       (cluster_name,)).fetchone()
+    conn.row_factory = None
+    return _cluster_row_to_dict(row) if row else None
+
+
+def get_clusters() -> List[Dict[str, Any]]:
+    conn = _conn()
+    conn.row_factory = sqlite3.Row
+    rows = conn.execute(
+        'SELECT * FROM clusters ORDER BY launched_at DESC').fetchall()
+    conn.row_factory = None
+    return [_cluster_row_to_dict(r) for r in rows]
+
+
+def _cluster_row_to_dict(row: sqlite3.Row) -> Dict[str, Any]:
+    d = dict(row)
+    d['handle'] = json.loads(d['handle']) if d.get('handle') else None
+    d['status'] = ClusterStatus(d['status'])
+    if d.get('autostop'):
+        d['autostop'] = json.loads(d['autostop'])
+    return d
+
+
+def remove_cluster(cluster_name: str) -> None:
+    cluster = get_cluster(cluster_name)
+    conn = _conn()
+    if cluster is not None:
+        duration = time.time() - (cluster['launched_at'] or time.time())
+        handle = cluster.get('handle') or {}
+        conn.execute(
+            'INSERT INTO cluster_history (name, launched_at, '
+            'duration_seconds, resources, cost, user) '
+            'VALUES (?, ?, ?, ?, ?, ?)',
+            (cluster_name, cluster['launched_at'], duration,
+             json.dumps(handle.get('launched_resources')),
+             _estimate_cost(handle, duration), cluster.get('last_use')))
+    conn.execute('DELETE FROM clusters WHERE name = ?', (cluster_name,))
+    conn.commit()
+
+
+def _estimate_cost(handle: Dict[str, Any], duration_seconds: float) -> float:
+    res_cfg = (handle or {}).get('launched_resources')
+    if not res_cfg:
+        return 0.0
+    try:
+        from skypilot_tpu import resources as resources_lib
+        res = resources_lib.Resources.from_yaml_config(res_cfg)
+        if isinstance(res, resources_lib.Resources):
+            return res.get_cost(duration_seconds)
+    except Exception:  # pylint: disable=broad-except
+        pass
+    return 0.0
+
+
+def get_cost_report() -> List[Dict[str, Any]]:
+    conn = _conn()
+    conn.row_factory = sqlite3.Row
+    rows = conn.execute('SELECT * FROM cluster_history '
+                        'ORDER BY launched_at DESC').fetchall()
+    conn.row_factory = None
+    out = []
+    for r in rows:
+        d = dict(r)
+        if d.get('resources'):
+            d['resources'] = json.loads(d['resources'])
+        out.append(d)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Storage
+# ---------------------------------------------------------------------------
+def add_or_update_storage(name: str, handle: Dict[str, Any],
+                          status: str) -> None:
+    conn = _conn()
+    conn.execute(
+        'INSERT INTO storage (name, launched_at, handle, status) '
+        'VALUES (?, ?, ?, ?) ON CONFLICT(name) DO UPDATE SET '
+        'handle=excluded.handle, status=excluded.status',
+        (name, time.time(), json.dumps(handle), status))
+    conn.commit()
+
+
+def get_storage(name: str) -> Optional[Dict[str, Any]]:
+    conn = _conn()
+    conn.row_factory = sqlite3.Row
+    row = conn.execute('SELECT * FROM storage WHERE name = ?',
+                       (name,)).fetchone()
+    conn.row_factory = None
+    if row is None:
+        return None
+    d = dict(row)
+    d['handle'] = json.loads(d['handle']) if d.get('handle') else None
+    return d
+
+
+def get_storages() -> List[Dict[str, Any]]:
+    conn = _conn()
+    conn.row_factory = sqlite3.Row
+    rows = conn.execute('SELECT * FROM storage').fetchall()
+    conn.row_factory = None
+    out = []
+    for r in rows:
+        d = dict(r)
+        d['handle'] = json.loads(d['handle']) if d.get('handle') else None
+        out.append(d)
+    return out
+
+
+def remove_storage(name: str) -> None:
+    conn = _conn()
+    conn.execute('DELETE FROM storage WHERE name = ?', (name,))
+    conn.commit()

@@ -1,0 +1,301 @@
+"""Cost/time-optimal assignment of concrete TPU slices to DAG tasks.
+
+Reference analog: sky/optimizer.py (`Optimizer.optimize:109`,
+`_optimize_by_dp:429`, `_optimize_by_ilp:490`,
+`_estimate_nodes_cost_or_time:239`, `_optimize_dag:1035`).
+
+Differences:
+- Candidate enumeration is slice-shape aware: a partial request like
+  `accelerators: tpu-v5p-128` fans out across regions/spot choices, and the
+  feasibility check knows which chip counts form legal ICI tori
+  (skypilot_tpu/tpu/topology.py) — the reference delegates this entirely to
+  catalog string matches.
+- The general-DAG path uses exact enumeration with branch-and-bound up to a
+  size limit, then greedy (no ILP dependency in this environment). DAGs here
+  are small (pipelines of a few stages), so exact search is the common case.
+- The time model is analytical for TPU: if a task carries
+  `estimated_total_flops`, runtime ≈ flops / (slice peak FLOPs × assumed
+  MFU); egress cost between stages uses cloud egress pricing.
+"""
+from __future__ import annotations
+
+import collections
+import enum
+import typing
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu import check as check_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils import timeline
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import dag as dag_lib
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import task as task_lib
+
+logger = sky_logging.init_logger(__name__)
+
+# Assumed model FLOPs utilization when converting FLOPs → runtime. Only used
+# for *relative* ranking of slice shapes, so the absolute value is not load-
+# bearing.
+_ASSUMED_MFU = 0.4
+_DEFAULT_TASK_SECONDS = 3600.0
+# Exact-search budget: beyond this many assignment combinations fall back to
+# per-node greedy.
+_EXACT_SEARCH_LIMIT = 200_000
+
+
+class OptimizeTarget(enum.Enum):
+    COST = 'cost'
+    TIME = 'time'
+
+
+class Optimizer:
+
+    @staticmethod
+    @timeline.event
+    def optimize(dag: 'dag_lib.Dag',
+                 minimize: OptimizeTarget = OptimizeTarget.COST,
+                 blocked_resources: Optional[
+                     List['resources_lib.Resources']] = None,
+                 quiet: bool = False) -> 'dag_lib.Dag':
+        """Assign `task.best_resources` for every task in the dag."""
+        dag.validate()
+        candidates = _enumerate_candidates(dag, blocked_resources or [])
+        if dag.is_chain():
+            assignment, objective = _optimize_by_dp(dag, candidates, minimize)
+        else:
+            assignment, objective = _optimize_general(dag, candidates,
+                                                      minimize)
+        for task, res in assignment.items():
+            task.best_resources = res
+        if not quiet:
+            _print_plan(dag, assignment, objective, minimize)
+        return dag
+
+
+# ---------------------------------------------------------------------------
+# Candidate enumeration
+# ---------------------------------------------------------------------------
+def _estimate_seconds(task: 'task_lib.Task',
+                      res: 'resources_lib.Resources') -> float:
+    flops = getattr(task, 'estimated_total_flops', None)
+    if flops and res.tpu is not None:
+        peak = res.tpu.peak_bf16_tflops * 1e12
+        return max(1.0, flops / (peak * _ASSUMED_MFU))
+    if task.estimated_duration_seconds is not None:
+        return task.estimated_duration_seconds
+    return _DEFAULT_TASK_SECONDS
+
+
+def _candidate_cost_time(task: 'task_lib.Task',
+                         res: 'resources_lib.Resources'
+                         ) -> Tuple[float, float]:
+    seconds = _estimate_seconds(task, res)
+    return res.get_cost(seconds), seconds
+
+
+def _is_blocked(res: 'resources_lib.Resources',
+                blocked: List['resources_lib.Resources']) -> bool:
+    return any(b.less_demanding_than(res) for b in blocked)
+
+
+def _enumerate_candidates(
+    dag: 'dag_lib.Dag', blocked: List['resources_lib.Resources']
+) -> Dict['task_lib.Task', List['resources_lib.Resources']]:
+    enabled = check_lib.get_cached_enabled_clouds_or_refresh(
+        raise_if_no_cloud_access=True)
+    per_task: Dict['task_lib.Task', List['resources_lib.Resources']] = {}
+    for task in dag.tasks:
+        cands: List['resources_lib.Resources'] = []
+        fuzzy: List[str] = []
+        for want in task.resources_list():
+            clouds_to_try: List[cloud_lib.Cloud]
+            if want.cloud is not None:
+                if not cloud_lib.cloud_in_iterable(want.cloud, enabled):
+                    fuzzy.append(f'{want.cloud} not enabled')
+                    continue
+                clouds_to_try = [want.cloud]
+            else:
+                clouds_to_try = enabled
+            for cloud in clouds_to_try:
+                feasible, near = cloud.get_feasible_launchable_resources(want)
+                fuzzy.extend(near)
+                for res in feasible:
+                    if not _is_blocked(res, blocked):
+                        cands.append(res)
+        if not cands:
+            hint = ''
+            if fuzzy:
+                uniq = sorted(set(fuzzy))[:6]
+                hint = f' Did you mean / try: {", ".join(uniq)}?'
+            raise exceptions.ResourcesUnavailableError(
+                f'No feasible resources for {task!r} among enabled clouds '
+                f'{[repr(c) for c in enabled]}.{hint}')
+        per_task[task] = cands
+    return per_task
+
+
+# ---------------------------------------------------------------------------
+# Chain DP (analog: sky/optimizer.py:429)
+# ---------------------------------------------------------------------------
+def _edge_cost(parent_res: 'resources_lib.Resources',
+               child_res: 'resources_lib.Resources',
+               gigabytes: float) -> float:
+    """Egress $ if a stage boundary crosses clouds/regions."""
+    if gigabytes <= 0 or parent_res.cloud is None or child_res.cloud is None:
+        return 0.0
+    same_cloud = parent_res.cloud.is_same_cloud(child_res.cloud)
+    if same_cloud and parent_res.region == child_res.region:
+        return 0.0
+    if same_cloud:
+        return parent_res.cloud.get_egress_cost(gigabytes) * 0.1
+    return parent_res.cloud.get_egress_cost(gigabytes)
+
+
+def _objective(task: 'task_lib.Task', res: 'resources_lib.Resources',
+               minimize: OptimizeTarget) -> float:
+    cost, seconds = _candidate_cost_time(task, res)
+    return cost if minimize is OptimizeTarget.COST else seconds
+
+
+def _optimize_by_dp(
+    dag: 'dag_lib.Dag',
+    candidates: Dict['task_lib.Task', List['resources_lib.Resources']],
+    minimize: OptimizeTarget,
+) -> Tuple[Dict['task_lib.Task', 'resources_lib.Resources'], float]:
+    order = dag.topological_order()
+    # best[i][res] = (objective-so-far, chosen res of predecessor)
+    prev_best: Dict['resources_lib.Resources', Tuple[float, Optional[
+        'resources_lib.Resources']]] = {None: (0.0, None)}  # type: ignore
+    choices: List[Dict] = []
+    for i, task in enumerate(order):
+        cur: Dict['resources_lib.Resources', Tuple[float, Optional[
+            'resources_lib.Resources']]] = {}
+        parent_gb = 0.0
+        if i > 0:
+            parent_gb = float(
+                getattr(order[i - 1], 'estimated_output_gb', 0.0) or 0.0)
+        for res in candidates[task]:
+            node_obj = _objective(task, res, minimize)
+            best_val, best_prev = float('inf'), None
+            for prev_res, (prev_val, _) in prev_best.items():
+                edge = 0.0
+                if prev_res is not None and minimize is OptimizeTarget.COST:
+                    edge = _edge_cost(prev_res, res, parent_gb)
+                total = prev_val + node_obj + edge
+                if total < best_val:
+                    best_val, best_prev = total, prev_res
+            cur[res] = (best_val, best_prev)
+        choices.append(cur)
+        prev_best = cur
+    # Backtrack.
+    assignment: Dict['task_lib.Task', 'resources_lib.Resources'] = {}
+    best_res = min(prev_best, key=lambda r: prev_best[r][0])
+    objective = prev_best[best_res][0]
+    for i in range(len(order) - 1, -1, -1):
+        assignment[order[i]] = best_res
+        best_res = choices[i][best_res][1]
+    return assignment, objective
+
+
+# ---------------------------------------------------------------------------
+# General DAG: exact search with pruning, greedy fallback
+# (reference uses ILP via pulp, sky/optimizer.py:490)
+# ---------------------------------------------------------------------------
+def _optimize_general(
+    dag: 'dag_lib.Dag',
+    candidates: Dict['task_lib.Task', List['resources_lib.Resources']],
+    minimize: OptimizeTarget,
+) -> Tuple[Dict['task_lib.Task', 'resources_lib.Resources'], float]:
+    order = dag.topological_order()
+    sizes = [len(candidates[t]) for t in order]
+    total = 1
+    for s in sizes:
+        total *= s
+        if total > _EXACT_SEARCH_LIMIT:
+            break
+    if total > _EXACT_SEARCH_LIMIT:
+        assignment = {
+            t: min(candidates[t], key=lambda r: _objective(t, r, minimize))
+            for t in order
+        }
+        objective = sum(
+            _objective(t, r, minimize) for t, r in assignment.items())
+        return assignment, objective
+
+    graph = dag.get_graph()
+    best_assignment: Dict = {}
+    best_obj = float('inf')
+    cur: Dict['task_lib.Task', 'resources_lib.Resources'] = {}
+
+    # Lower bound per remaining task for pruning.
+    node_min = {
+        t: min(_objective(t, r, minimize) for r in candidates[t])
+        for t in order
+    }
+
+    def dfs(i: int, acc: float) -> None:
+        nonlocal best_obj, best_assignment
+        if acc + sum(node_min[t] for t in order[i:]) >= best_obj:
+            return
+        if i == len(order):
+            best_obj = acc
+            best_assignment = dict(cur)
+            return
+        task = order[i]
+        scored = sorted(candidates[task],
+                        key=lambda r: _objective(task, r, minimize))
+        for res in scored:
+            obj = _objective(task, res, minimize)
+            edge = 0.0
+            if minimize is OptimizeTarget.COST:
+                for parent in graph.predecessors(task):
+                    if parent in cur:
+                        gb = float(
+                            getattr(parent, 'estimated_output_gb', 0.0) or 0.0)
+                        edge += _edge_cost(cur[parent], res, gb)
+            cur[task] = res
+            dfs(i + 1, acc + obj + edge)
+            del cur[task]
+
+    dfs(0, 0.0)
+    return best_assignment, best_obj
+
+
+# ---------------------------------------------------------------------------
+# Plan printing (analog: the reference's optimizer table)
+# ---------------------------------------------------------------------------
+def _print_plan(dag: 'dag_lib.Dag', assignment: Dict, objective: float,
+                minimize: OptimizeTarget) -> None:
+    rows = []
+    for task in dag.topological_order():
+        res = assignment[task]
+        cost, seconds = _candidate_cost_time(task, res)
+        sl = res.tpu
+        rows.append((
+            task.name or '-',
+            repr(res.cloud),
+            sl.name if sl else '-',
+            sl.topology_str if sl else '-',
+            str(sl.total_hosts if sl else 1),
+            res.region or '-',
+            'spot' if res.use_spot else 'on-demand',
+            f'${cost:.2f}',
+            f'{seconds / 3600:.1f}h',
+        ))
+    header = ('TASK', 'CLOUD', 'SLICE', 'ICI TOPO', 'HOSTS', 'REGION',
+              'BILLING', 'EST.COST', 'EST.TIME')
+    widths = [
+        max(len(header[i]), *(len(r[i]) for r in rows))
+        for i in range(len(header))
+    ]
+    lines = ['  '.join(h.ljust(w) for h, w in zip(header, widths))]
+    for r in rows:
+        lines.append('  '.join(c.ljust(w) for c, w in zip(r, widths)))
+    unit = '$' if minimize is OptimizeTarget.COST else 's'
+    sky_logging.print_status(
+        f'Optimizer plan (minimizing {minimize.value}, objective '
+        f'{objective:.2f}{unit}):\n' + '\n'.join(lines))

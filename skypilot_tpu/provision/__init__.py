@@ -1,0 +1,95 @@
+"""Stable per-cloud provisioning function API.
+
+Reference analog: sky/provision/__init__.py — every operation is a module
+function dispatched by cloud name (`_route_to_cloud_impl:44`), the cleanest
+seam in the reference (SURVEY.md §7.4): `run_instances:178`,
+`terminate_instances:197`, `wait_instances:266`, `get_cluster_info:273`.
+Here the unit of provisioning is a *TPU slice* (atomic multi-host gang), not
+a VM: `run_instances` creates all `num_slices` slices of a cluster.
+"""
+from __future__ import annotations
+
+import functools
+import importlib
+import typing
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.provision import common
+from skypilot_tpu.utils import timeline
+
+ProvisionConfig = common.ProvisionConfig
+ProvisionRecord = common.ProvisionRecord
+ClusterInfo = common.ClusterInfo
+InstanceInfo = common.InstanceInfo
+
+_SUPPORTED_CLOUDS = ('gcp', 'local')
+
+
+def _route_to_cloud_impl(fn):
+
+    @functools.wraps(fn)
+    def _wrapper(cloud_name: str, *args, **kwargs):
+        cloud_name = cloud_name.lower()
+        if cloud_name not in _SUPPORTED_CLOUDS:
+            raise ValueError(f'No provisioner for cloud {cloud_name!r}; '
+                             f'supported: {_SUPPORTED_CLOUDS}')
+        module = importlib.import_module(
+            f'skypilot_tpu.provision.{cloud_name}.instance')
+        impl = getattr(module, fn.__name__)
+        return impl(*args, **kwargs)
+
+    return _wrapper
+
+
+@_route_to_cloud_impl
+@timeline.event
+def run_instances(region: str, zone: str, cluster_name: str,
+                  config: ProvisionConfig) -> ProvisionRecord:
+    """Create (or reuse) the slice(s) for a cluster in one zone. Atomic per
+    slice: either every host of a slice exists or the call raises."""
+    raise AssertionError('dispatched')
+
+
+@_route_to_cloud_impl
+def wait_instances(region: str, cluster_name: str,
+                   state: Optional[str] = None) -> None:
+    """Block until all slice hosts reach `state` (default: running)."""
+    raise AssertionError('dispatched')
+
+
+@_route_to_cloud_impl
+def stop_instances(region: str, cluster_name: str,
+                   provider_config: Optional[Dict[str, Any]] = None) -> None:
+    raise AssertionError('dispatched')
+
+
+@_route_to_cloud_impl
+def terminate_instances(region: str, cluster_name: str,
+                        provider_config: Optional[Dict[str, Any]] = None
+                        ) -> None:
+    raise AssertionError('dispatched')
+
+
+@_route_to_cloud_impl
+def query_instances(region: str, cluster_name: str,
+                    provider_config: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, Optional[str]]:
+    """instance_id -> cloud-reported status string (None = missing)."""
+    raise AssertionError('dispatched')
+
+
+@_route_to_cloud_impl
+def get_cluster_info(region: str, cluster_name: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> ClusterInfo:
+    raise AssertionError('dispatched')
+
+
+@_route_to_cloud_impl
+def open_ports(region: str, cluster_name: str, ports: List[str]) -> None:
+    raise AssertionError('dispatched')
+
+
+@_route_to_cloud_impl
+def cleanup_ports(region: str, cluster_name: str, ports: List[str]) -> None:
+    raise AssertionError('dispatched')

@@ -1,0 +1,87 @@
+"""Shared provisioning dataclasses.
+
+Reference analog: sky/provision/common.py (ProvisionConfig, ProvisionRecord,
+ClusterInfo, InstanceInfo). TPU-native addition: an instance is a *slice
+host* and knows its (slice_index, worker_id) coordinates, which the runtime
+turns into TPU_WORKER_ID / MEGASCALE_SLICE_ID env.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional
+
+
+@dataclasses.dataclass
+class ProvisionConfig:
+    """Everything a provisioner needs to create one cluster's slices."""
+    provider_config: Dict[str, Any]      # cloud deploy vars (from the Cloud)
+    authentication_config: Dict[str, Any]
+    count: int                           # number of slices (num_slices)
+    tags: Dict[str, str]
+    resume_stopped_nodes: bool = True
+    ports_to_open_on_launch: Optional[List[str]] = None
+
+
+@dataclasses.dataclass
+class ProvisionRecord:
+    provider_name: str
+    region: str
+    zone: Optional[str]
+    cluster_name: str
+    resumed_instance_ids: List[str]
+    created_instance_ids: List[str]
+
+    def is_instance_just_booted(self, instance_id: str) -> bool:
+        return (instance_id in self.resumed_instance_ids or
+                instance_id in self.created_instance_ids)
+
+
+@dataclasses.dataclass
+class InstanceInfo:
+    """One slice host."""
+    instance_id: str
+    internal_ip: str
+    external_ip: Optional[str]
+    ssh_port: int = 22
+    slice_index: int = 0                 # which slice (multi-slice jobs)
+    worker_id: int = 0                   # TPU worker index within the slice
+    tags: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    def get_feasible_ip(self) -> str:
+        return self.external_ip or self.internal_ip
+
+
+@dataclasses.dataclass
+class ClusterInfo:
+    """Topology-aware cluster description returned by get_cluster_info."""
+    provider_name: str
+    instances: Dict[str, InstanceInfo]
+    head_instance_id: Optional[str]
+    provider_config: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    ssh_user: str = 'skytpu'
+    # Local-cloud only: per-host working directories standing in for VMs.
+    host_dirs: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+    def ordered_instances(self) -> List[InstanceInfo]:
+        """Hosts in gang order: slice-major, worker-minor; head first within
+        its coordinates (head is always slice 0, worker 0)."""
+        return sorted(self.instances.values(),
+                      key=lambda i: (i.slice_index, i.worker_id))
+
+    def get_head_instance(self) -> Optional[InstanceInfo]:
+        if self.head_instance_id is None:
+            return None
+        return self.instances.get(self.head_instance_id)
+
+    def get_worker_instances(self) -> List[InstanceInfo]:
+        return [
+            i for i in self.ordered_instances()
+            if i.instance_id != self.head_instance_id
+        ]
+
+    def ip_list(self) -> List[str]:
+        return [i.get_feasible_ip() for i in self.ordered_instances()]
+
+    @property
+    def num_instances(self) -> int:
+        return len(self.instances)

@@ -1,0 +1,189 @@
+"""Thin REST client for the Cloud TPU v2 API (tpu.googleapis.com).
+
+Reference analog: sky/provision/gcp/instance_utils.py `GCPTPUVMInstance:1205`
+— which builds URLs like `https://tpu.googleapis.com/v2/projects/.../nodes`
+(`:1219-1223`) and polls long-running operations (`:1231`). This client covers
+both direct Node CRUD and the queued-resources API (required for v5p/DWS,
+reference build plan SURVEY.md §7.4).
+
+Error mapping: HTTP / operation errors are classified into the taxonomy the
+failover loop understands (stockout vs quota vs hard error).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import requests
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.adaptors import gcp as gcp_adaptor
+
+logger = sky_logging.init_logger(__name__)
+
+_API_ROOT = 'https://tpu.googleapis.com/v2'
+_TIMEOUT = 60
+_OPERATION_POLL_SECONDS = 5
+_OPERATION_TIMEOUT_SECONDS = 1800
+
+_STOCKOUT_MARKERS = (
+    'no more capacity', 'out of capacity', 'resource_exhausted',
+    'insufficient capacity', 'stockout', 'does not have enough resources',
+)
+_QUOTA_MARKERS = ('quota', 'rate limit')
+
+
+def _headers() -> Dict[str, str]:
+    return {
+        'Authorization': f'Bearer {gcp_adaptor.get_access_token()}',
+        'Content-Type': 'application/json',
+    }
+
+
+def _classify_error(status_code: int, message: str) -> exceptions.ProvisionError:
+    low = message.lower()
+    if any(m in low for m in _STOCKOUT_MARKERS) or status_code == 429:
+        return exceptions.InsufficientCapacityError(message)
+    if any(m in low for m in _QUOTA_MARKERS) or status_code == 403:
+        return exceptions.QuotaExceededError(message)
+    return exceptions.ProvisionError(message)
+
+
+def _request(method: str, url: str, *,
+             json_body: Optional[Dict[str, Any]] = None,
+             params: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    resp = requests.request(method, url, headers=_headers(), json=json_body,
+                            params=params, timeout=_TIMEOUT)
+    if resp.status_code == 404:
+        raise exceptions.ClusterDoesNotExist(f'{url} -> 404: {resp.text}')
+    if resp.status_code >= 400:
+        raise _classify_error(resp.status_code,
+                              f'{method} {url} -> {resp.status_code}: '
+                              f'{resp.text}')
+    if not resp.text:
+        return {}
+    return resp.json()
+
+
+def _parent(project: str, zone: str) -> str:
+    return f'projects/{project}/locations/{zone}'
+
+
+def wait_operation(operation_name: str,
+                   timeout: float = _OPERATION_TIMEOUT_SECONDS
+                   ) -> Dict[str, Any]:
+    """Poll a long-running TPU operation until done (analog :1231)."""
+    url = f'{_API_ROOT}/{operation_name}'
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        op = _request('GET', url)
+        if op.get('done'):
+            if 'error' in op:
+                err = op['error']
+                raise _classify_error(
+                    int(err.get('code', 500)),
+                    err.get('message', str(err)))
+            return op.get('response', {})
+        time.sleep(_OPERATION_POLL_SECONDS)
+    raise exceptions.ProvisionError(
+        f'TPU operation {operation_name} timed out after {timeout}s.')
+
+
+# ---------------------------------------------------------------------------
+# Node API (direct create — v2/v3/v4 and on-demand v5e/v6e)
+# ---------------------------------------------------------------------------
+def create_node(project: str, zone: str, node_id: str,
+                body: Dict[str, Any]) -> Dict[str, Any]:
+    url = f'{_API_ROOT}/{_parent(project, zone)}/nodes'
+    op = _request('POST', url, json_body=body, params={'nodeId': node_id})
+    return wait_operation(op['name'])
+
+
+def get_node(project: str, zone: str, node_id: str) -> Dict[str, Any]:
+    url = f'{_API_ROOT}/{_parent(project, zone)}/nodes/{node_id}'
+    return _request('GET', url)
+
+
+def list_nodes(project: str, zone: str) -> List[Dict[str, Any]]:
+    url = f'{_API_ROOT}/{_parent(project, zone)}/nodes'
+    out: List[Dict[str, Any]] = []
+    page_token: Optional[str] = None
+    while True:
+        params = {'pageToken': page_token} if page_token else None
+        resp = _request('GET', url, params=params)
+        out.extend(resp.get('nodes', []))
+        page_token = resp.get('nextPageToken')
+        if not page_token:
+            return out
+
+
+def delete_node(project: str, zone: str, node_id: str) -> None:
+    url = f'{_API_ROOT}/{_parent(project, zone)}/nodes/{node_id}'
+    try:
+        op = _request('DELETE', url)
+    except exceptions.ClusterDoesNotExist:
+        return
+    wait_operation(op['name'])
+
+
+def stop_node(project: str, zone: str, node_id: str) -> None:
+    url = f'{_API_ROOT}/{_parent(project, zone)}/nodes/{node_id}:stop'
+    op = _request('POST', url, json_body={})
+    wait_operation(op['name'])
+
+
+def start_node(project: str, zone: str, node_id: str) -> None:
+    url = f'{_API_ROOT}/{_parent(project, zone)}/nodes/{node_id}:start'
+    op = _request('POST', url, json_body={})
+    wait_operation(op['name'])
+
+
+# ---------------------------------------------------------------------------
+# Queued-resources API (v5e/v5p/v6e preferred path; spot + reservations)
+# ---------------------------------------------------------------------------
+def create_queued_resource(project: str, zone: str, qr_id: str,
+                           body: Dict[str, Any]) -> Dict[str, Any]:
+    url = f'{_API_ROOT}/{_parent(project, zone)}/queuedResources'
+    return _request('POST', url, json_body=body,
+                    params={'queuedResourceId': qr_id})
+
+
+def get_queued_resource(project: str, zone: str,
+                        qr_id: str) -> Dict[str, Any]:
+    url = f'{_API_ROOT}/{_parent(project, zone)}/queuedResources/{qr_id}'
+    return _request('GET', url)
+
+
+def delete_queued_resource(project: str, zone: str, qr_id: str,
+                           force: bool = True) -> None:
+    url = f'{_API_ROOT}/{_parent(project, zone)}/queuedResources/{qr_id}'
+    try:
+        op = _request('DELETE', url, params={'force': str(force).lower()})
+    except exceptions.ClusterDoesNotExist:
+        return
+    wait_operation(op['name'])
+
+
+def wait_queued_resource_active(project: str, zone: str, qr_id: str,
+                                timeout: float,
+                                poll_seconds: float = 15.0) -> Dict[str, Any]:
+    """Wait until a queued resource reaches ACTIVE (slice fully allocated).
+
+    FAILED/SUSPENDED states map to stockout-class errors so the zone-failover
+    loop moves on rather than hanging (reference hard part (b), SURVEY.md §7).
+    """
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        qr = get_queued_resource(project, zone, qr_id)
+        state = qr.get('state', {}).get('state', 'UNKNOWN')
+        if state == 'ACTIVE':
+            return qr
+        if state in ('FAILED', 'SUSPENDED'):
+            detail = qr.get('state', {})
+            raise exceptions.InsufficientCapacityError(
+                f'Queued resource {qr_id} entered {state}: {detail}')
+        time.sleep(poll_seconds)
+    raise exceptions.InsufficientCapacityError(
+        f'Queued resource {qr_id} not ACTIVE within {timeout}s '
+        f'(still waiting for capacity).')

@@ -1,0 +1,180 @@
+"""Local provisioner: fabricated slice hosts as directories + metadata.
+
+The in-process fake-TPU provisioner called for by SURVEY.md §4 ("add a fake
+TPU provisioner ... as the equivalent of `enable_all_clouds`"). A "host" is
+a directory under LOCAL_CLOUD_ROOT/<cluster>/slice<j>-host<i> with a
+metadata.json; commands addressed to it run as local subprocesses chdir'ed
+into that directory. Supports the same function API as the GCP provisioner
+so the backend is cloud-agnostic, plus zone fault injection for failover
+tests (clouds/local.PROVISION_FAULTS).
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.clouds import local as local_cloud
+from skypilot_tpu.provision import common
+
+_STATUS_RUNNING = 'running'
+_STATUS_STOPPED = 'stopped'
+
+
+def _cluster_dir(cluster_name: str) -> str:
+    return os.path.join(local_cloud.LOCAL_CLOUD_ROOT, cluster_name)
+
+
+def _meta_path(cluster_name: str) -> str:
+    return os.path.join(_cluster_dir(cluster_name), 'metadata.json')
+
+
+def _load_meta(cluster_name: str) -> Optional[Dict[str, Any]]:
+    try:
+        with open(_meta_path(cluster_name), 'r', encoding='utf-8') as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+
+
+def _save_meta(cluster_name: str, meta: Dict[str, Any]) -> None:
+    os.makedirs(_cluster_dir(cluster_name), exist_ok=True)
+    with open(_meta_path(cluster_name), 'w', encoding='utf-8') as f:
+        json.dump(meta, f, indent=2)
+
+
+def run_instances(region: str, zone: str, cluster_name: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    fault = local_cloud.PROVISION_FAULTS.get(zone)
+    if fault is not None:
+        if isinstance(fault, Exception):
+            raise fault
+        raise exceptions.InsufficientCapacityError(
+            f'[fault-injection] zone {zone} has no capacity.')
+
+    pc = config.provider_config
+    num_hosts = int(pc['num_hosts'])
+    num_slices = int(pc.get('num_slices', 1))
+
+    meta = _load_meta(cluster_name)
+    created: List[str] = []
+    resumed: List[str] = []
+    if meta is not None and meta.get('status') == _STATUS_RUNNING:
+        # Idempotent re-provision of an existing cluster.
+        pass
+    elif meta is not None and meta.get('status') == _STATUS_STOPPED:
+        meta['status'] = _STATUS_RUNNING
+        resumed = list(meta['instances'])
+        _save_meta(cluster_name, meta)
+    else:
+        instances: Dict[str, Dict[str, Any]] = {}
+        for j in range(num_slices):
+            for i in range(num_hosts):
+                iid = f'{cluster_name}-slice{j}-host{i}'
+                host_dir = os.path.join(_cluster_dir(cluster_name), iid)
+                os.makedirs(host_dir, exist_ok=True)
+                instances[iid] = {
+                    'slice_index': j,
+                    'worker_id': i,
+                    'dir': host_dir,
+                }
+                created.append(iid)
+        meta = {
+            'status': _STATUS_RUNNING,
+            'zone': zone,
+            'provider_config': pc,
+            'instances': instances,
+            'created_at': time.time(),
+        }
+        _save_meta(cluster_name, meta)
+    return common.ProvisionRecord(
+        provider_name='local',
+        region=region,
+        zone=zone,
+        cluster_name=cluster_name,
+        resumed_instance_ids=resumed,
+        created_instance_ids=created,
+    )
+
+
+def wait_instances(region: str, cluster_name: str,
+                   state: Optional[str] = None) -> None:
+    del region
+    meta = _load_meta(cluster_name)
+    want = state or _STATUS_RUNNING
+    if meta is None or meta.get('status') != want:
+        raise exceptions.ProvisionError(
+            f'Cluster {cluster_name} is not {want}.')
+
+
+def stop_instances(region: str, cluster_name: str,
+                   provider_config=None) -> None:
+    del region, provider_config
+    meta = _load_meta(cluster_name)
+    if meta is None:
+        return
+    meta['status'] = _STATUS_STOPPED
+    _save_meta(cluster_name, meta)
+
+
+def terminate_instances(region: str, cluster_name: str,
+                        provider_config=None) -> None:
+    del region, provider_config
+    cdir = _cluster_dir(cluster_name)
+    if os.path.isdir(cdir):
+        shutil.rmtree(cdir, ignore_errors=True)
+
+
+def query_instances(region: str, cluster_name: str,
+                    provider_config=None) -> Dict[str, Optional[str]]:
+    del region, provider_config
+    meta = _load_meta(cluster_name)
+    if meta is None:
+        return {}
+    return {iid: meta['status'] for iid in meta['instances']}
+
+
+def get_cluster_info(region: str, cluster_name: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    del provider_config
+    meta = _load_meta(cluster_name)
+    if meta is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'Local cluster {cluster_name} not found.')
+    instances: Dict[str, common.InstanceInfo] = {}
+    host_dirs: Dict[str, str] = {}
+    head_id: Optional[str] = None
+    for iid, rec in meta['instances'].items():
+        info = common.InstanceInfo(
+            instance_id=iid,
+            internal_ip='127.0.0.1',
+            external_ip='127.0.0.1',
+            slice_index=rec['slice_index'],
+            worker_id=rec['worker_id'],
+        )
+        instances[iid] = info
+        host_dirs[iid] = rec['dir']
+        if rec['slice_index'] == 0 and rec['worker_id'] == 0:
+            head_id = iid
+    return common.ClusterInfo(
+        provider_name='local',
+        instances=instances,
+        head_instance_id=head_id,
+        provider_config=meta.get('provider_config', {}),
+        ssh_user=os.environ.get('USER', 'skytpu'),
+        host_dirs=host_dirs,
+    )
+
+
+def open_ports(region: str, cluster_name: str, ports: List[str],
+               provider_config=None) -> None:
+    del region, cluster_name, ports, provider_config  # localhost: open
+
+
+def cleanup_ports(region: str, cluster_name: str, ports: List[str],
+                  provider_config=None) -> None:
+    del region, cluster_name, ports, provider_config

@@ -1,0 +1,215 @@
+"""Provision orchestration: zone loop, runtime bootstrap, teardown.
+
+Reference analog: sky/provision/provisioner.py (`bulk_provision:121` with
+per-zone retry, `teardown_cluster:234`, `wait_for_ssh:387`,
+`post_provision_runtime_setup:727`) + sky/provision/instance_setup.py
+(parallel-SSH runtime bootstrap; ray head/worker start at :290/:333 — here
+replaced by the skylet daemon + slice driver, no Ray).
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+import typing
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import provision
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision import common
+from skypilot_tpu.utils import command_runner as command_runner_lib
+from skypilot_tpu.utils import subprocess_utils
+from skypilot_tpu.utils import timeline
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu.clouds import cloud as cloud_lib
+
+logger = sky_logging.init_logger(__name__)
+
+_CONNECTION_WAIT_SECONDS = 300
+_CONNECTION_POLL_SECONDS = 5
+
+
+@timeline.event
+def bulk_provision(
+    cloud: 'cloud_lib.Cloud',
+    region: str,
+    cluster_name: str,
+    resources: 'resources_lib.Resources',
+    ports_to_open: Optional[List[str]] = None,
+) -> common.ProvisionRecord:
+    """Try each zone of `region` until one yields the whole slice gang.
+
+    Raises ResourcesUnavailableError carrying per-zone failure history when
+    the region is exhausted (fed into the caller's region/cloud failover).
+    """
+    cloud_name = repr(cloud).lower()
+    errors: List[Exception] = []
+    for zones in cloud.zones_provision_loop(region=region,
+                                            resources=resources):
+        zone = zones[0].name
+        deploy_vars = resources.make_deploy_variables(
+            region, [z.name for z in zones], cluster_name)
+        config = common.ProvisionConfig(
+            provider_config=deploy_vars,
+            authentication_config={},
+            count=resources.tpu.num_slices if resources.tpu else 1,
+            tags={'skytpu-cluster': cluster_name},
+            ports_to_open_on_launch=ports_to_open,
+        )
+        try:
+            logger.info(f'Provisioning {cluster_name!r} '
+                        f'({resources.tpu.name if resources.tpu else "cpu"}) '
+                        f'in {zone}...')
+            record = provision.run_instances(cloud_name, region, zone,
+                                             cluster_name, config)
+            provision.wait_instances(cloud_name, region, cluster_name)
+            if ports_to_open:
+                provision.open_ports(cloud_name, region, cluster_name,
+                                     ports_to_open)
+            return record
+        except (exceptions.InsufficientCapacityError,
+                exceptions.QuotaExceededError,
+                exceptions.ProvisionError) as e:
+            logger.warning(f'  zone {zone}: {type(e).__name__}: {e}')
+            errors.append(e)
+            # Leave nothing half-created in the failed zone.
+            try:
+                provision.terminate_instances(cloud_name, region,
+                                              cluster_name, deploy_vars)
+            except Exception as cleanup_err:  # pylint: disable=broad-except
+                logger.debug(f'  cleanup after failure: {cleanup_err}')
+            continue
+    raise exceptions.ResourcesUnavailableError(
+        f'All zones in {cloud_name}/{region} failed for {cluster_name}.',
+        failover_history=errors)
+
+
+def get_command_runners(
+        cluster_info: common.ClusterInfo
+) -> List[command_runner_lib.CommandRunner]:
+    """One runner per slice host, gang order (slice-major, worker-minor)."""
+    runners: List[command_runner_lib.CommandRunner] = []
+    for inst in cluster_info.ordered_instances():
+        if cluster_info.provider_name == 'local':
+            runners.append(
+                command_runner_lib.LocalProcessCommandRunner(
+                    inst.instance_id,
+                    cluster_info.host_dirs[inst.instance_id]))
+        else:
+            from skypilot_tpu import authentication
+            runners.append(
+                command_runner_lib.SSHCommandRunner(
+                    inst.instance_id,
+                    inst.get_feasible_ip(),
+                    cluster_info.ssh_user,
+                    ssh_private_key=authentication.PRIVATE_KEY_PATH,
+                    port=inst.ssh_port,
+                ))
+    return runners
+
+
+@timeline.event
+def wait_for_connection(cluster_info: common.ClusterInfo,
+                        timeout: float = _CONNECTION_WAIT_SECONDS) -> None:
+    """Block until every host accepts commands (analog wait_for_ssh:387)."""
+    runners = get_command_runners(cluster_info)
+    deadline = time.time() + timeout
+
+    def _wait_one(runner: command_runner_lib.CommandRunner) -> None:
+        while True:
+            if runner.check_connection():
+                return
+            if time.time() > deadline:
+                raise exceptions.ClusterSetupError(
+                    f'Host {runner.node_id} unreachable after {timeout}s.')
+            time.sleep(_CONNECTION_POLL_SECONDS)
+
+    subprocess_utils.run_in_parallel(_wait_one, runners)
+
+
+_REMOTE_PKG_DIR = 'skytpu_pkg'
+
+
+def remote_python(cluster_info: common.ClusterInfo) -> str:
+    """The python invocation able to import skypilot_tpu on cluster hosts.
+
+    Local cloud: this interpreter (PYTHONPATH injected by the runner). SSH
+    clusters: python3 with the shipped package dir on PYTHONPATH (the
+    reference ships a wheel instead — wheel_utils.py:295; a plain rsync'd
+    package tree avoids the build step and version skew).
+    """
+    if cluster_info.provider_name == 'local':
+        return sys.executable
+    return f'PYTHONPATH="$HOME/{_REMOTE_PKG_DIR}:$PYTHONPATH" python3'
+
+
+def _ship_package(runners: List[command_runner_lib.CommandRunner]) -> None:
+    """Copy the skypilot_tpu package onto every non-local host."""
+    import skypilot_tpu
+    pkg_dir = os.path.dirname(os.path.abspath(skypilot_tpu.__file__))
+
+    def _ship(runner: command_runner_lib.CommandRunner) -> None:
+        runner.run(f'mkdir -p ~/{_REMOTE_PKG_DIR}', log_path='/dev/null')
+        runner.rsync(pkg_dir, f'~/{_REMOTE_PKG_DIR}/skypilot_tpu', up=True,
+                     excludes=['__pycache__', '*.pyc'])
+
+    subprocess_utils.run_in_parallel(_ship, runners)
+
+
+@timeline.event
+def post_provision_runtime_setup(cluster_name: str,
+                                 cluster_info: common.ClusterInfo) -> None:
+    """Bootstrap every host: runtime dir + skylet daemon on the head.
+
+    Reference analog: post_provision_runtime_setup (provisioner.py:727) →
+    instance_setup.setup_runtime_on_cluster/ray start — minus Ray: the gang
+    runner is the slice driver, so host bootstrap is just directories, env
+    and the skylet daemon.
+    """
+    runners = get_command_runners(cluster_info)
+    py = remote_python(cluster_info)
+    if cluster_info.provider_name != 'local':
+        _ship_package(runners)
+        # The head fans jobs out to workers over SSH (slice_driver): give it
+        # the cluster key at the fixed path the driver expects.
+        from skypilot_tpu import authentication
+        private, _ = authentication.get_or_generate_keys()
+        head = runners[0]
+        head.run('mkdir -p ~/.ssh && chmod 700 ~/.ssh', log_path='/dev/null')
+        head.rsync(private, '~/.ssh/skytpu-cluster-key', up=True)
+        head.run('chmod 600 ~/.ssh/skytpu-cluster-key', log_path='/dev/null')
+
+    def _setup_host(runner: command_runner_lib.CommandRunner) -> None:
+        rc = runner.run('mkdir -p "${SKYTPU_RUNTIME_DIR:-$HOME/.skytpu_runtime}" '
+                        '&& mkdir -p skytpu_workdir',
+                        log_path='/dev/null')
+        if rc != 0:
+            raise exceptions.ClusterSetupError(
+                f'Runtime dir creation failed on {runner.node_id}.')
+
+    subprocess_utils.run_in_parallel(_setup_host, runners)
+
+    # Start skylet on the head host (idempotent: kill stale one first).
+    head = runners[0]
+    skylet_cmd = (
+        f'pkill -f "skypilot_tpu.skylet.skylet" 2>/dev/null; '
+        f'{py} -m skypilot_tpu.skylet.skylet')
+    head.run(skylet_cmd, detach=True,
+             log_path=os.path.join('/tmp', f'skytpu_skylet_{cluster_name}.log'))
+    logger.debug(f'skylet started on {head.node_id}.')
+
+
+@timeline.event
+def teardown_cluster(cloud_name: str, region: str, cluster_name: str,
+                     provider_config: Optional[Dict[str, Any]] = None,
+                     terminate: bool = True) -> None:
+    """Analog: provisioner.py:234."""
+    if terminate:
+        provision.terminate_instances(cloud_name, region, cluster_name,
+                                      provider_config)
+    else:
+        provision.stop_instances(cloud_name, region, cluster_name,
+                                 provider_config)

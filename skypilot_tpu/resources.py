@@ -1,0 +1,429 @@
+"""Resource spec: what hardware a task wants, validated and canonicalized.
+
+Reference analog: sky/resources.py (`Resources:119`, `_set_accelerators:773`,
+`get_cost:1514`, `less_demanding_than:1643`, `make_deploy_variables:1541`).
+
+TPU-native differences: `accelerators: tpu-v5p-128` parses into a typed
+`TpuSlice` (generation, chip count, ICI topology, host fan-out) instead of an
+opaque string routed through GCP-specific fixups; `accelerator_args` gains
+`topology` (ICI layout override) and `num_slices` (DCN multi-slice) in
+addition to the reference's `runtime_version`.
+"""
+from __future__ import annotations
+
+import textwrap
+from typing import Any, Dict, List, Optional, Set, Union
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.catalog import tpu_catalog
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.tpu import topology
+from skypilot_tpu.utils import registry
+
+logger = sky_logging.init_logger(__name__)
+
+DEFAULT_DISK_SIZE_GB = 100
+
+_RESOURCES_FIELDS = frozenset({
+    'cloud', 'accelerators', 'accelerator_args', 'use_spot', 'spot_recovery',
+    'region', 'zone', 'cpus', 'memory', 'disk_size', 'disk_tier', 'ports',
+    'image_id', 'labels', 'autostop', 'any_of', 'ordered',
+})
+
+
+class Resources:
+    """An (optionally partial) hardware requirement.
+
+    A Resources is *launchable* when it names a cloud and a concrete TPU
+    slice; the optimizer turns partial specs into launchable ones.
+    """
+
+    def __init__(
+        self,
+        cloud: Optional[Union[str, cloud_lib.Cloud]] = None,
+        accelerators: Optional[str] = None,
+        accelerator_args: Optional[Dict[str, Any]] = None,
+        use_spot: Optional[bool] = None,
+        spot_recovery: Optional[str] = None,
+        region: Optional[str] = None,
+        zone: Optional[str] = None,
+        cpus: Optional[Union[int, str]] = None,
+        memory: Optional[Union[int, str]] = None,
+        disk_size: Optional[int] = None,
+        disk_tier: Optional[str] = None,
+        ports: Optional[Union[int, str, List[Union[int, str]]]] = None,
+        image_id: Optional[str] = None,
+        labels: Optional[Dict[str, str]] = None,
+        autostop: Optional[Union[int, bool, Dict[str, Any]]] = None,
+    ):
+        self._cloud: Optional[cloud_lib.Cloud] = None
+        if cloud is not None:
+            if isinstance(cloud, str):
+                cloud = registry.CLOUD_REGISTRY.from_str(cloud)
+            self._cloud = cloud
+
+        self._use_spot_specified = use_spot is not None
+        self._use_spot = bool(use_spot) if use_spot is not None else False
+        self._spot_recovery = spot_recovery
+
+        self._region: Optional[str] = None
+        self._zone: Optional[str] = None
+        self._set_region_zone(region, zone)
+
+        self._cpus = None if cpus is None else str(cpus)
+        self._memory = None if memory is None else str(memory)
+        self._disk_size = disk_size if disk_size is not None else DEFAULT_DISK_SIZE_GB
+        self._disk_tier = disk_tier
+        self._image_id = image_id
+        self._labels = dict(labels) if labels else {}
+        self._set_ports(ports)
+        self._set_autostop(autostop)
+
+        self._accelerator_args: Dict[str, Any] = dict(accelerator_args or {})
+        self._tpu: Optional[topology.TpuSlice] = None
+        self._accelerators_str: Optional[str] = None
+        self._set_accelerators(accelerators)
+
+    # ------------------------------------------------------------------
+    # Field setters / validation
+    # ------------------------------------------------------------------
+    def _set_accelerators(self, accelerators: Optional[str]) -> None:
+        """Parse accelerator spec (analog: sky/resources.py:773)."""
+        if accelerators is None:
+            return
+        if isinstance(accelerators, dict):
+            # {name: count} style from YAML; TPU names embed the count.
+            if len(accelerators) != 1:
+                raise ValueError(
+                    f'Expected a single accelerator entry, got {accelerators}')
+            name, cnt = next(iter(accelerators.items()))
+            if topology.is_tpu_accelerator(str(name)):
+                if cnt not in (1, None):
+                    raise ValueError(
+                        f'TPU slices embed their size in the name (e.g. '
+                        f'tpu-v5p-128); got count {cnt} for {name}.')
+                accelerators = name
+            else:
+                # GPU-era '{A100: 8}' spec: keep as an opaque string.
+                accelerators = name if cnt in (1, None) else f'{name}:{cnt}'
+        accelerators = str(accelerators).strip()
+        self._accelerators_str = accelerators
+        if topology.is_tpu_accelerator(accelerators):
+            topo_override = self._accelerator_args.get('topology')
+            sl = topology.parse_tpu_accelerator(accelerators, topo_override)
+            num_slices = int(self._accelerator_args.get('num_slices', 1))
+            if num_slices > 1:
+                sl = topology.TpuSlice(
+                    sl.generation, sl.count, sl.num_chips, sl.topology,
+                    sl.num_hosts, num_slices)
+            self._tpu = sl
+        # Non-TPU names (GPU-era YAMLs) parse but stay non-launchable; the
+        # optimizer reports them infeasible with a TPU swap-in hint, so
+        # reference recipes fail at optimize time with guidance, not at parse.
+
+    def _set_region_zone(self, region: Optional[str],
+                         zone: Optional[str]) -> None:
+        if region is None and zone is None:
+            return
+        if self._cloud is not None:
+            self._region, self._zone = self._cloud.validate_region_zone(
+                region, zone)
+        else:
+            self._region, self._zone = tpu_catalog.validate_region_zone(
+                region, zone)
+
+    def _set_ports(self, ports) -> None:
+        if ports is None:
+            self._ports: List[str] = []
+            return
+        if not isinstance(ports, list):
+            ports = [ports]
+        self._ports = [str(p) for p in ports]
+
+    def _set_autostop(self, autostop) -> None:
+        # Canonical form: None or {'idle_minutes': int, 'down': bool}.
+        if autostop is None or autostop is False:
+            self._autostop: Optional[Dict[str, Any]] = None
+        elif autostop is True:
+            self._autostop = {'idle_minutes': 5, 'down': False}
+        elif isinstance(autostop, int):
+            self._autostop = {'idle_minutes': autostop, 'down': False}
+        elif isinstance(autostop, dict):
+            self._autostop = {
+                'idle_minutes': int(autostop.get('idle_minutes', 5)),
+                'down': bool(autostop.get('down', False)),
+            }
+        else:
+            raise ValueError(f'Invalid autostop spec: {autostop!r}')
+
+    # ------------------------------------------------------------------
+    # Accessors
+    # ------------------------------------------------------------------
+    @property
+    def cloud(self) -> Optional[cloud_lib.Cloud]:
+        return self._cloud
+
+    @property
+    def tpu(self) -> Optional[topology.TpuSlice]:
+        return self._tpu
+
+    @property
+    def accelerators(self) -> Optional[str]:
+        return self._tpu.name if self._tpu is not None else self._accelerators_str
+
+    @property
+    def accelerator_args(self) -> Dict[str, Any]:
+        return dict(self._accelerator_args)
+
+    @property
+    def use_spot(self) -> bool:
+        return self._use_spot
+
+    @property
+    def use_spot_specified(self) -> bool:
+        return self._use_spot_specified
+
+    @property
+    def spot_recovery(self) -> Optional[str]:
+        return self._spot_recovery
+
+    @property
+    def region(self) -> Optional[str]:
+        return self._region
+
+    @property
+    def zone(self) -> Optional[str]:
+        return self._zone
+
+    @property
+    def cpus(self) -> Optional[str]:
+        return self._cpus
+
+    @property
+    def memory(self) -> Optional[str]:
+        return self._memory
+
+    @property
+    def disk_size(self) -> int:
+        return self._disk_size
+
+    @property
+    def disk_tier(self) -> Optional[str]:
+        return self._disk_tier
+
+    @property
+    def ports(self) -> List[str]:
+        return list(self._ports)
+
+    @property
+    def image_id(self) -> Optional[str]:
+        return self._image_id
+
+    @property
+    def labels(self) -> Dict[str, str]:
+        return dict(self._labels)
+
+    @property
+    def autostop(self) -> Optional[Dict[str, Any]]:
+        return dict(self._autostop) if self._autostop else None
+
+    @property
+    def num_hosts(self) -> int:
+        """Worker VMs this resource spans (1 if no TPU yet)."""
+        return self._tpu.total_hosts if self._tpu is not None else 1
+
+    def is_launchable(self) -> bool:
+        return self._cloud is not None and self._tpu is not None
+
+    # ------------------------------------------------------------------
+    # Copy / comparison
+    # ------------------------------------------------------------------
+    def copy(self, **override) -> 'Resources':
+        cfg = dict(
+            cloud=self._cloud,
+            accelerators=self.accelerators,
+            accelerator_args=self._accelerator_args or None,
+            use_spot=self._use_spot if self._use_spot_specified else None,
+            spot_recovery=self._spot_recovery,
+            region=self._region,
+            zone=self._zone,
+            cpus=self._cpus,
+            memory=self._memory,
+            disk_size=self._disk_size,
+            disk_tier=self._disk_tier,
+            ports=self._ports or None,
+            image_id=self._image_id,
+            labels=self._labels or None,
+            autostop=self._autostop,
+        )
+        cfg.update(override)
+        return Resources(**cfg)
+
+    def less_demanding_than(self, other: 'Resources') -> bool:
+        """True if `other` (a cluster's resources) can serve this request.
+
+        Reference analog: sky/resources.py:1643 — used by `exec` to check a
+        task fits an existing cluster.
+        """
+        if self._cloud is not None and (other.cloud is None or
+                                        not self._cloud.is_same_cloud(
+                                            other.cloud)):
+            return False
+        if self._region is not None and self._region != other.region:
+            return False
+        if self._zone is not None and self._zone != other.zone:
+            return False
+        if self._use_spot_specified and self._use_spot != other.use_spot:
+            return False
+        if self._tpu is not None:
+            if other.tpu is None:
+                return False
+            if (self._tpu.generation != other.tpu.generation or
+                    self._tpu.total_chips > other.tpu.total_chips):
+                return False
+        return True
+
+    def __eq__(self, other: object) -> bool:
+        if not isinstance(other, Resources):
+            return NotImplemented
+        return self.to_yaml_config() == other.to_yaml_config()
+
+    def __hash__(self) -> int:
+        import json
+        return hash(json.dumps(self.to_yaml_config(), sort_keys=True))
+
+    # ------------------------------------------------------------------
+    # Cost & deploy
+    # ------------------------------------------------------------------
+    def get_cost(self, seconds: float) -> float:
+        """$ to run for `seconds` (analog: sky/resources.py:1514)."""
+        if self._tpu is None:
+            return 0.0
+        if self._cloud is not None:
+            hourly = self._cloud.hourly_cost(self)
+        else:
+            hourly = tpu_catalog.get_hourly_cost(
+                self._tpu, use_spot=self._use_spot, region=self._region,
+                zone=self._zone)
+        return hourly * seconds / 3600.0
+
+    def get_required_cloud_features(
+            self) -> Set[cloud_lib.CloudImplementationFeatures]:
+        feats: Set[cloud_lib.CloudImplementationFeatures] = set()
+        if self._use_spot:
+            feats.add(cloud_lib.CloudImplementationFeatures.SPOT_INSTANCE)
+        if self._tpu is not None and self._tpu.is_multi_host:
+            feats.add(cloud_lib.CloudImplementationFeatures.MULTI_HOST)
+        if self._tpu is not None and self._tpu.num_slices > 1:
+            feats.add(cloud_lib.CloudImplementationFeatures.MULTI_SLICE)
+        if self._ports:
+            feats.add(cloud_lib.CloudImplementationFeatures.OPEN_PORTS)
+        if self._autostop is not None:
+            feats.add(cloud_lib.CloudImplementationFeatures.AUTOSTOP)
+            if not self._autostop.get('down', False):
+                feats.add(cloud_lib.CloudImplementationFeatures.STOP)
+        return feats
+
+    def make_deploy_variables(self, region: str, zones: Optional[List[str]],
+                              cluster_name: str) -> Dict[str, Any]:
+        """Analog: sky/resources.py:1541 → cloud.make_deploy_resources_variables."""
+        assert self._cloud is not None, 'Resources must be launchable'
+        return self._cloud.make_deploy_resources_variables(
+            self, region, zones, cluster_name)
+
+    # ------------------------------------------------------------------
+    # YAML round trip
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_yaml_config(
+            cls, config: Optional[Dict[str, Any]]
+    ) -> Union['Resources', List['Resources'], Set['Resources']]:
+        """Build from a task-YAML `resources:` section.
+
+        Supports `any_of:` / `ordered:` candidate lists like the reference.
+        """
+        if config is None:
+            return Resources()
+        config = dict(config)
+        unknown = set(config) - _RESOURCES_FIELDS
+        if unknown:
+            raise ValueError(
+                f'Unknown resources fields: {sorted(unknown)}. '
+                f'Valid: {sorted(_RESOURCES_FIELDS)}')
+        any_of = config.pop('any_of', None)
+        ordered = config.pop('ordered', None)
+        if any_of is not None and ordered is not None:
+            raise ValueError('Specify only one of any_of / ordered.')
+
+        def _one(override: Dict[str, Any]) -> 'Resources':
+            merged = {**config, **override}
+            return cls(
+                cloud=merged.get('cloud'),
+                accelerators=merged.get('accelerators'),
+                accelerator_args=merged.get('accelerator_args'),
+                use_spot=merged.get('use_spot'),
+                spot_recovery=merged.get('spot_recovery'),
+                region=merged.get('region'),
+                zone=merged.get('zone'),
+                cpus=merged.get('cpus'),
+                memory=merged.get('memory'),
+                disk_size=merged.get('disk_size'),
+                disk_tier=merged.get('disk_tier'),
+                ports=merged.get('ports'),
+                image_id=merged.get('image_id'),
+                labels=merged.get('labels'),
+                autostop=merged.get('autostop'),
+            )
+
+        if any_of is not None:
+            return {_one(o or {}) for o in any_of}
+        if ordered is not None:
+            return [_one(o or {}) for o in ordered]
+        return _one({})
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        cfg: Dict[str, Any] = {}
+
+        def add(key: str, value: Any) -> None:
+            if value is not None and value != {} and value != []:
+                cfg[key] = value
+
+        add('cloud', None if self._cloud is None else repr(self._cloud).lower())
+        add('accelerators', self.accelerators)
+        add('accelerator_args', self._accelerator_args or None)
+        if self._use_spot_specified:
+            add('use_spot', self._use_spot)
+        add('spot_recovery', self._spot_recovery)
+        add('region', self._region)
+        add('zone', self._zone)
+        add('cpus', self._cpus)
+        add('memory', self._memory)
+        if self._disk_size != DEFAULT_DISK_SIZE_GB:
+            add('disk_size', self._disk_size)
+        add('disk_tier', self._disk_tier)
+        add('ports', self._ports or None)
+        add('image_id', self._image_id)
+        add('labels', self._labels or None)
+        add('autostop', self._autostop)
+        return cfg
+
+    def __repr__(self) -> str:
+        parts = []
+        if self._cloud is not None:
+            parts.append(repr(self._cloud))
+        if self._tpu is not None:
+            parts.append(self._tpu.name)
+            if self._use_spot:
+                parts.append('[Spot]')
+        if self._region:
+            parts.append(self._region)
+        if not parts:
+            return '<Resources: empty>'
+        return '<Resources: ' + ' '.join(parts) + '>'
+
+    def format_brief(self) -> str:
+        acc = self.accelerators or 'cpu'
+        spot = '[spot]' if self._use_spot else ''
+        cloud = repr(self._cloud).lower() if self._cloud else '?'
+        return f'{cloud}:{acc}{spot}'

@@ -1,0 +1,77 @@
+"""Logger setup with env-controlled verbosity.
+
+Reference analog: sky/sky_logging.py (init_logger, is_silent).
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import sys
+import threading
+
+FORMAT = '%(levelname).1s %(asctime)s %(filename)s:%(lineno)d] %(message)s'
+DATE_FORMAT = '%m-%d %H:%M:%S'
+
+_root_name = 'skypilot_tpu'
+_setup_lock = threading.Lock()
+_initialized = False
+
+_silent = threading.local()
+
+
+def _debug_enabled() -> bool:
+    return os.environ.get('SKYTPU_DEBUG', '0') == '1'
+
+
+class _NoPrefixFormatter(logging.Formatter):
+    """INFO lines go out bare (user-facing); others keep the full prefix."""
+
+    def format(self, record: logging.LogRecord) -> str:
+        if record.levelno == logging.INFO and not _debug_enabled():
+            return record.getMessage()
+        return super().format(record)
+
+
+def _setup_root() -> None:
+    global _initialized
+    with _setup_lock:
+        if _initialized:
+            return
+        root = logging.getLogger(_root_name)
+        root.setLevel(logging.DEBUG if _debug_enabled() else logging.INFO)
+        handler = logging.StreamHandler(sys.stdout)
+        handler.setFormatter(_NoPrefixFormatter(FORMAT, DATE_FORMAT))
+        handler.setLevel(logging.DEBUG if _debug_enabled() else logging.INFO)
+        root.addHandler(handler)
+        root.propagate = False
+        _initialized = True
+
+
+def init_logger(name: str) -> logging.Logger:
+    _setup_root()
+    return logging.getLogger(name)
+
+
+def is_silent() -> bool:
+    return getattr(_silent, 'value', False)
+
+
+@contextlib.contextmanager
+def silent():
+    """Suppress INFO output inside the block (used by nested SDK calls)."""
+    old = getattr(_silent, 'value', False)
+    _silent.value = True
+    root = logging.getLogger(_root_name)
+    old_level = root.level
+    root.setLevel(logging.WARNING)
+    try:
+        yield
+    finally:
+        _silent.value = old
+        root.setLevel(old_level)
+
+
+def print_status(msg: str) -> None:
+    if not is_silent():
+        print(msg, flush=True)

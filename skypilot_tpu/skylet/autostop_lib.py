@@ -1,0 +1,53 @@
+"""Autostop bookkeeping on the cluster (reference analog: sky/skylet/autostop_lib.py)."""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+from skypilot_tpu.skylet import constants
+from skypilot_tpu.skylet import job_lib
+
+_CONFIG_FILE = 'autostop.json'
+
+
+def _path() -> str:
+    return os.path.join(job_lib.runtime_dir(), _CONFIG_FILE)
+
+
+def set_autostop(idle_minutes: Optional[int], down: bool,
+                 cloud: str, region: str, cluster_name: str) -> None:
+    """idle_minutes None disables autostop."""
+    payload = {
+        'idle_minutes': idle_minutes,
+        'down': down,
+        'cloud': cloud,
+        'region': region,
+        'cluster_name': cluster_name,
+        'set_at': time.time(),
+    }
+    os.makedirs(job_lib.runtime_dir(), exist_ok=True)
+    with open(_path(), 'w', encoding='utf-8') as f:
+        json.dump(payload, f)
+
+
+def get_autostop_config() -> Optional[Dict[str, Any]]:
+    try:
+        with open(_path(), 'r', encoding='utf-8') as f:
+            cfg = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return None
+    if cfg.get('idle_minutes') is None:
+        return None
+    return cfg
+
+
+def is_idle_past_threshold() -> bool:
+    cfg = get_autostop_config()
+    if cfg is None:
+        return False
+    if job_lib.has_active_jobs():
+        return False
+    last = max(job_lib.last_activity_time(), cfg.get('set_at', 0.0))
+    return (time.time() - last) > cfg['idle_minutes'] * 60

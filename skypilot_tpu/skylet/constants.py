@@ -1,0 +1,83 @@
+"""Runtime constants + the per-host env contract.
+
+Reference analog: sky/skylet/constants.py — notably the rank/IP env contract
+at `:388-393` (SKYPILOT_NODE_RANK/NODE_IPS/NUM_NODES/NUM_GPUS_PER_NODE),
+which GPU-era recipes (torchrun rendezvous etc.) depend on. We export BOTH
+the reference-compatible SKYPILOT_* names (north-star: reference llm/ YAMLs
+run unmodified) and TPU/JAX-native names (TPU_WORKER_ID, MEGASCALE_*,
+coordinator address for jax.distributed.initialize).
+"""
+from __future__ import annotations
+
+from typing import Dict, List
+
+SKYTPU_RUNTIME_DIR_ENV = 'SKYTPU_RUNTIME_DIR'
+DEFAULT_RUNTIME_DIR = '~/.skytpu_runtime'
+
+JOB_LOG_DIR = 'logs'            # under runtime dir: logs/<job_id>/
+JOBS_DB = 'jobs.db'
+DRIVER_LOG = 'driver.log'
+RANK_LOG_FMT = 'rank{rank}.log'
+
+# Default port for jax.distributed coordinator (on slice-0 host-0).
+JAX_COORDINATOR_PORT = 8476
+# Port for the skylet agent's health/gRPC endpoint.
+SKYLET_PORT = 8475
+
+# --- Reference-compatible env (sky/skylet/constants.py:388-393) ---
+SKYPILOT_NODE_RANK = 'SKYPILOT_NODE_RANK'
+SKYPILOT_NODE_IPS = 'SKYPILOT_NODE_IPS'
+SKYPILOT_NUM_NODES = 'SKYPILOT_NUM_NODES'
+SKYPILOT_NUM_GPUS_PER_NODE = 'SKYPILOT_NUM_GPUS_PER_NODE'
+SKYPILOT_TASK_ID = 'SKYPILOT_TASK_ID'
+
+# --- TPU-native env ---
+SKYTPU_NODE_RANK = 'SKYTPU_NODE_RANK'
+SKYTPU_JOB_ID = 'SKYTPU_JOB_ID'
+SKYTPU_CLUSTER_NAME = 'SKYTPU_CLUSTER_NAME'
+
+
+def gang_env(*,
+             rank: int,
+             ips: List[str],
+             num_hosts: int,
+             chips_per_host: int,
+             job_id: int,
+             cluster_name: str,
+             slice_index: int = 0,
+             num_slices: int = 1,
+             hosts_per_slice: int = 1,
+             coordinator_ip: str = '127.0.0.1') -> Dict[str, str]:
+    """The full per-host env block for one gang member.
+
+    - SKYPILOT_*: GPU-era contract (NUM_GPUS_PER_NODE carries chips/host so
+      `torchrun --nproc_per_node $SKYPILOT_NUM_GPUS_PER_NODE` keeps working).
+    - TPU_WORKER_*: what libtpu/torch-xla expect on TPU VMs.
+    - MEGASCALE_*: DCN multi-slice wiring for JAX (num_slices > 1).
+    """
+    worker_id = rank % hosts_per_slice if hosts_per_slice else rank
+    env = {
+        SKYPILOT_NODE_RANK: str(rank),
+        SKYPILOT_NODE_IPS: '\n'.join(ips),
+        SKYPILOT_NUM_NODES: str(num_hosts),
+        SKYPILOT_NUM_GPUS_PER_NODE: str(chips_per_host),
+        SKYTPU_NODE_RANK: str(rank),
+        SKYTPU_JOB_ID: str(job_id),
+        SKYPILOT_TASK_ID: f'{cluster_name}-{job_id}',
+        SKYTPU_CLUSTER_NAME: cluster_name,
+        # TPU VM worker identity (within the slice).
+        'TPU_WORKER_ID': str(worker_id),
+        'TPU_WORKER_HOSTNAMES': ','.join(
+            ips[slice_index * hosts_per_slice:
+                (slice_index + 1) * hosts_per_slice]),
+        # jax.distributed.initialize() picks these up.
+        'SKYTPU_COORDINATOR_ADDRESS':
+            f'{coordinator_ip}:{JAX_COORDINATOR_PORT}',
+    }
+    if num_slices > 1:
+        env.update({
+            'MEGASCALE_COORDINATOR_ADDRESS': coordinator_ip,
+            'MEGASCALE_NUM_SLICES': str(num_slices),
+            'MEGASCALE_SLICE_ID': str(slice_index),
+        })
+    return env

@@ -1,0 +1,70 @@
+"""Periodic skylet events (reference analog: sky/skylet/events.py)."""
+from __future__ import annotations
+
+import os
+import time
+import traceback
+from typing import Any, Dict
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.skylet import autostop_lib
+from skypilot_tpu.skylet import job_lib
+
+logger = sky_logging.init_logger(__name__)
+
+
+class SkyletEvent:
+    """Base periodic event (events.py:37-ish in the reference)."""
+    EVENT_INTERVAL_SECONDS = 60
+
+    def __init__(self) -> None:
+        self._last_run = 0.0
+
+    def maybe_run(self) -> None:
+        now = time.time()
+        if now - self._last_run < self.EVENT_INTERVAL_SECONDS:
+            return
+        self._last_run = now
+        try:
+            self._run()
+        except Exception:  # pylint: disable=broad-except
+            logger.error(f'{type(self).__name__} failed:\n'
+                         f'{traceback.format_exc()}')
+
+    def _run(self) -> None:
+        raise NotImplementedError
+
+
+class AutostopEvent(SkyletEvent):
+    """Self-teardown when idle (reference analog: events.py:160)."""
+    EVENT_INTERVAL_SECONDS = 60
+
+    def _run(self) -> None:
+        cfg = autostop_lib.get_autostop_config()
+        if cfg is None or not autostop_lib.is_idle_past_threshold():
+            return
+        logger.info(
+            f'Cluster idle past {cfg["idle_minutes"]}min; '
+            f'{"terminating" if cfg.get("down") else "stopping"}.')
+        self._teardown(cfg)
+
+    def _teardown(self, cfg: Dict[str, Any]) -> None:
+        from skypilot_tpu import provision
+        cloud = cfg['cloud']
+        region = cfg['region']
+        cluster = cfg['cluster_name']
+        if cfg.get('down'):
+            provision.terminate_instances(cloud, region, cluster)
+        else:
+            provision.stop_instances(cloud, region, cluster)
+
+
+class JobHeartbeatEvent(SkyletEvent):
+    """Touch a heartbeat file so the control plane can detect dead agents
+    (backs the failure-detection path of managed jobs)."""
+    EVENT_INTERVAL_SECONDS = 30
+
+    def _run(self) -> None:
+        path = os.path.join(job_lib.runtime_dir(), 'skylet.heartbeat')
+        with open(path, 'w', encoding='utf-8') as f:
+            f.write(str(time.time()))

@@ -1,0 +1,202 @@
+"""On-cluster job queue in sqlite, with a CLI for remote invocation.
+
+Reference analog: sky/skylet/job_lib.py (`JobStatus:157`,
+`JobScheduler:279`/`FIFOScheduler:358`). The DB lives on the head host under
+$SKYTPU_RUNTIME_DIR/jobs.db; the control plane talks to it by running
+`python -m skypilot_tpu.skylet.job_lib <op> --json ...` through the cluster's
+command runner (the reference's codegen-over-SSH pattern,
+cloud_vm_ray_backend.py:4299), so the same path works for local and SSH
+clusters.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.skylet import constants
+from skypilot_tpu.utils.status_lib import JobStatus
+
+
+def runtime_dir() -> str:
+    return os.path.expanduser(
+        os.environ.get(constants.SKYTPU_RUNTIME_DIR_ENV,
+                       constants.DEFAULT_RUNTIME_DIR))
+
+
+def _db_path() -> str:
+    d = runtime_dir()
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, constants.JOBS_DB)
+
+
+def _conn() -> sqlite3.Connection:
+    conn = sqlite3.connect(_db_path(), timeout=30)
+    conn.execute('PRAGMA journal_mode=WAL')
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS jobs (
+            job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+            job_name TEXT,
+            username TEXT,
+            submitted_at REAL,
+            started_at REAL,
+            ended_at REAL,
+            status TEXT,
+            run_cmd TEXT,
+            num_hosts INTEGER,
+            log_dir TEXT,
+            pid INTEGER
+        )""")
+    return conn
+
+
+def add_job(job_name: str, username: str, run_cmd: str,
+            num_hosts: int) -> int:
+    with _conn() as conn:
+        cur = conn.execute(
+            'INSERT INTO jobs (job_name, username, submitted_at, status, '
+            'run_cmd, num_hosts, log_dir) VALUES (?, ?, ?, ?, ?, ?, ?)',
+            (job_name, username, time.time(), JobStatus.INIT.value, run_cmd,
+             num_hosts, ''))
+        job_id = cur.lastrowid
+        assert job_id is not None
+        log_dir = os.path.join(runtime_dir(), constants.JOB_LOG_DIR,
+                               str(job_id))
+        os.makedirs(log_dir, exist_ok=True)
+        conn.execute('UPDATE jobs SET log_dir = ? WHERE job_id = ?',
+                     (log_dir, job_id))
+        return job_id
+
+
+def set_status(job_id: int, status: JobStatus,
+               pid: Optional[int] = None) -> None:
+    with _conn() as conn:
+        sets = ['status = ?']
+        vals: List[Any] = [status.value]
+        if status is JobStatus.RUNNING:
+            sets.append('started_at = ?')
+            vals.append(time.time())
+        if status.is_terminal():
+            sets.append('ended_at = ?')
+            vals.append(time.time())
+        if pid is not None:
+            sets.append('pid = ?')
+            vals.append(pid)
+        vals.append(job_id)
+        conn.execute(f'UPDATE jobs SET {", ".join(sets)} WHERE job_id = ?',
+                     vals)
+
+
+def get_job(job_id: int) -> Optional[Dict[str, Any]]:
+    with _conn() as conn:
+        conn.row_factory = sqlite3.Row
+        row = conn.execute('SELECT * FROM jobs WHERE job_id = ?',
+                           (job_id,)).fetchone()
+        return dict(row) if row else None
+
+
+def get_status(job_id: int) -> Optional[JobStatus]:
+    job = get_job(job_id)
+    return JobStatus(job['status']) if job else None
+
+
+def list_jobs(all_users: bool = True,
+              username: Optional[str] = None) -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        conn.row_factory = sqlite3.Row
+        if all_users or username is None:
+            rows = conn.execute(
+                'SELECT * FROM jobs ORDER BY job_id DESC').fetchall()
+        else:
+            rows = conn.execute(
+                'SELECT * FROM jobs WHERE username = ? ORDER BY job_id DESC',
+                (username,)).fetchall()
+        return [dict(r) for r in rows]
+
+
+def cancel_job(job_id: int) -> bool:
+    """Terminate the driver process tree; mark CANCELLED."""
+    job = get_job(job_id)
+    if job is None:
+        return False
+    status = JobStatus(job['status'])
+    if status.is_terminal():
+        return False
+    pid = job.get('pid')
+    if pid:
+        from skypilot_tpu.utils import subprocess_utils
+        subprocess_utils.kill_process_daemon(int(pid))
+    set_status(job_id, JobStatus.CANCELLED)
+    return True
+
+
+def last_activity_time() -> float:
+    """Most recent job activity, for autostop idleness tracking
+    (reference analog: job_lib.py:927 is_cluster_idle)."""
+    with _conn() as conn:
+        row = conn.execute(
+            'SELECT MAX(submitted_at), MAX(ended_at) FROM jobs').fetchone()
+    candidates = [t for t in row if t is not None] if row else []
+    return max(candidates) if candidates else 0.0
+
+
+def has_active_jobs() -> bool:
+    terminal = tuple(s.value for s in JobStatus.terminal_statuses())
+    with _conn() as conn:
+        placeholders = ','.join('?' * len(terminal))
+        row = conn.execute(
+            f'SELECT COUNT(*) FROM jobs WHERE status NOT IN ({placeholders})',
+            terminal).fetchone()
+    return bool(row and row[0] > 0)
+
+
+def log_dir_for(job_id: int) -> str:
+    return os.path.join(runtime_dir(), constants.JOB_LOG_DIR, str(job_id))
+
+
+# ---------------------------------------------------------------------------
+# CLI for remote codegen: every op prints one JSON line to stdout.
+# ---------------------------------------------------------------------------
+def _main() -> None:
+    parser = argparse.ArgumentParser(prog='job_lib')
+    sub = parser.add_subparsers(dest='op', required=True)
+
+    p_add = sub.add_parser('add')
+    p_add.add_argument('--name', required=True)
+    p_add.add_argument('--user', required=True)
+    p_add.add_argument('--run-cmd', required=True)
+    p_add.add_argument('--num-hosts', type=int, default=1)
+
+    p_status = sub.add_parser('status')
+    p_status.add_argument('--job-id', type=int, required=True)
+
+    sub.add_parser('list')
+
+    p_cancel = sub.add_parser('cancel')
+    p_cancel.add_argument('--job-id', type=int, required=True)
+
+    sub.add_parser('idle-info')
+
+    args = parser.parse_args()
+    if args.op == 'add':
+        job_id = add_job(args.name, args.user, args.run_cmd, args.num_hosts)
+        print(json.dumps({'job_id': job_id}))
+    elif args.op == 'status':
+        status = get_status(args.job_id)
+        print(json.dumps({'status': status.value if status else None}))
+    elif args.op == 'list':
+        print(json.dumps({'jobs': list_jobs()}))
+    elif args.op == 'cancel':
+        print(json.dumps({'cancelled': cancel_job(args.job_id)}))
+    elif args.op == 'idle-info':
+        print(json.dumps({
+            'active': has_active_jobs(),
+            'last_activity': last_activity_time(),
+        }))
+
+
+if __name__ == '__main__':
+    _main()

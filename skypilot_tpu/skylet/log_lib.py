@@ -1,0 +1,70 @@
+"""Log tailing/following for on-cluster job logs.
+
+Reference analog: sky/skylet/log_lib.py (tailing used by `sky logs`). Invoked
+remotely via `python -m skypilot_tpu.skylet.log_lib --job-id N [--follow]`,
+which streams logs/<job>/run.log to stdout until the job reaches a terminal
+state.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from typing import Optional
+
+from skypilot_tpu.skylet import job_lib
+from skypilot_tpu.utils.status_lib import JobStatus
+
+_POLL_SECONDS = 0.25
+_WAIT_FOR_LOG_SECONDS = 30
+
+
+def tail_job_logs(job_id: int, follow: bool = True,
+                  out=sys.stdout) -> Optional[JobStatus]:
+    log_path = os.path.join(job_lib.log_dir_for(job_id), 'run.log')
+    deadline = time.time() + _WAIT_FOR_LOG_SECONDS
+    while not os.path.exists(log_path):
+        status = job_lib.get_status(job_id)
+        if status is not None and status.is_terminal():
+            break
+        if not follow or time.time() > deadline:
+            break
+        time.sleep(_POLL_SECONDS)
+    if not os.path.exists(log_path):
+        print(f'[skytpu] no logs for job {job_id}.', file=out)
+        return job_lib.get_status(job_id)
+    with open(log_path, 'r', encoding='utf-8', errors='replace') as f:
+        while True:
+            line = f.readline()
+            if line:
+                out.write(line)
+                out.flush()
+                continue
+            status = job_lib.get_status(job_id)
+            if not follow:
+                return status
+            if status is None or status.is_terminal():
+                # Drain whatever raced in after the status flip.
+                rest = f.read()
+                if rest:
+                    out.write(rest)
+                    out.flush()
+                return status
+            time.sleep(_POLL_SECONDS)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(prog='log_lib')
+    parser.add_argument('--job-id', type=int, required=True)
+    parser.add_argument('--follow', action='store_true')
+    args = parser.parse_args()
+    status = tail_job_logs(args.job_id, follow=args.follow)
+    if status is not None:
+        print(f'[skytpu] job {args.job_id} finished: {status.value}',
+              file=sys.stderr)
+    sys.exit(0 if status in (JobStatus.SUCCEEDED, None) else 100)
+
+
+if __name__ == '__main__':
+    main()

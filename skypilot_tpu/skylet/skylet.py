@@ -1,0 +1,39 @@
+"""The skylet daemon: periodic events on the head host.
+
+Reference analog: sky/skylet/skylet.py:83 (event loop; the reference also
+hosts a gRPC server — here remote ops go through the job_lib/log_lib CLIs
+over the command runner, which serves the same purpose with one fewer moving
+part; a C++ agent is the planned upgrade path).
+
+Run detached by the provisioner's runtime setup:
+    python -m skypilot_tpu.skylet.skylet &
+"""
+from __future__ import annotations
+
+import os
+import time
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.skylet import events
+from skypilot_tpu.skylet import job_lib
+
+logger = sky_logging.init_logger(__name__)
+
+_LOOP_SECONDS = 5.0
+
+
+def main() -> None:
+    pid_path = os.path.join(job_lib.runtime_dir(), 'skylet.pid')
+    os.makedirs(job_lib.runtime_dir(), exist_ok=True)
+    with open(pid_path, 'w', encoding='utf-8') as f:
+        f.write(str(os.getpid()))
+    evs = [events.AutostopEvent(), events.JobHeartbeatEvent()]
+    logger.info(f'skylet started (pid {os.getpid()}).')
+    while True:
+        for ev in evs:
+            ev.maybe_run()
+        time.sleep(_LOOP_SECONDS)
+
+
+if __name__ == '__main__':
+    main()

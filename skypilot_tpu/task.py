@@ -1,0 +1,267 @@
+"""Declarative Task: resources, setup/run, envs, mounts, YAML round-trip.
+
+Reference analog: sky/task.py (`Task:226`, `from_yaml_config:527`,
+`set_resources:1128`). The YAML surface keeps the reference's field names
+(`resources`, `num_nodes`, `setup`, `run`, `envs`, `secrets`, `workdir`,
+`file_mounts`, `config`) so reference task YAMLs parse unchanged; `num_nodes`
+is optional for TPU tasks because the slice shape already fixes the host
+fan-out (a mismatch is an error, not silently ignored).
+"""
+from __future__ import annotations
+
+import os
+import re
+from typing import Any, Callable, Dict, List, Optional, Set, Tuple, Union
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import sky_logging
+from skypilot_tpu.utils import common_utils
+
+logger = sky_logging.init_logger(__name__)
+
+_VALID_NAME_REGEX = re.compile(r'^[a-zA-Z0-9]+(?:[._-]{1,2}[a-zA-Z0-9]+)*$')
+_VALID_ENV_VAR = re.compile(r'^[a-zA-Z_][a-zA-Z0-9_]*$')
+
+_TASK_YAML_FIELDS = frozenset({
+    'name', 'resources', 'num_nodes', 'workdir', 'setup', 'run', 'envs',
+    'secrets', 'file_mounts', 'config', 'service',
+})
+
+ResourcesSpec = Union[resources_lib.Resources,
+                      List[resources_lib.Resources],
+                      Set[resources_lib.Resources]]
+
+_RunFn = Callable[[int, List[str]], Optional[str]]
+
+
+class Task:
+    """A coarse-grained stage of computation on one TPU slice (or CPU node)."""
+
+    def __init__(
+        self,
+        name: Optional[str] = None,
+        *,
+        setup: Optional[str] = None,
+        run: Optional[Union[str, _RunFn]] = None,
+        envs: Optional[Dict[str, str]] = None,
+        secrets: Optional[Dict[str, str]] = None,
+        workdir: Optional[str] = None,
+        num_nodes: Optional[int] = None,
+    ):
+        self.name = name
+        if name is not None and not _VALID_NAME_REGEX.fullmatch(name):
+            raise ValueError(f'Invalid task name {name!r}.')
+        self.setup = setup
+        self.run = run
+        self.workdir = workdir
+        self._envs = dict(envs or {})
+        self._secrets = dict(secrets or {})
+        for key in list(self._envs) + list(self._secrets):
+            if not _VALID_ENV_VAR.fullmatch(key):
+                raise ValueError(f'Invalid env var name {key!r}.')
+        self._num_nodes = num_nodes
+        self.resources: ResourcesSpec = resources_lib.Resources()
+        self.file_mounts: Dict[str, str] = {}
+        self.storage_mounts: Dict[str, Any] = {}
+        # Per-task config overrides ('config:' section).
+        self.config_overrides: Dict[str, Any] = {}
+        self.service_spec: Optional[Dict[str, Any]] = None
+        self.best_resources: Optional[resources_lib.Resources] = None
+        self.estimated_duration_seconds: Optional[float] = None
+
+    # ------------------------------------------------------------------
+    # Construction
+    # ------------------------------------------------------------------
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any],
+                         env_overrides: Optional[Dict[str, str]] = None
+                         ) -> 'Task':
+        config = dict(config or {})
+        unknown = set(config) - _TASK_YAML_FIELDS
+        if unknown:
+            raise ValueError(
+                f'Unknown task fields: {sorted(unknown)}. '
+                f'Valid: {sorted(_TASK_YAML_FIELDS)}')
+        envs = dict(config.get('envs') or {})
+        if env_overrides:
+            envs.update(env_overrides)
+        # ${VAR} substitution in setup/run using envs, like the reference's
+        # env interpolation.
+        task = cls(
+            name=config.get('name'),
+            setup=config.get('setup'),
+            run=config.get('run'),
+            envs=envs,
+            secrets=dict(config.get('secrets') or {}),
+            workdir=config.get('workdir'),
+            num_nodes=config.get('num_nodes'),
+        )
+        task.set_resources(
+            resources_lib.Resources.from_yaml_config(config.get('resources')))
+        file_mounts = config.get('file_mounts') or {}
+        plain_mounts: Dict[str, str] = {}
+        for dst, src in file_mounts.items():
+            if isinstance(src, dict):
+                # storage mount spec: {name:, source:, mode:, store:}
+                task.storage_mounts[dst] = src
+            else:
+                plain_mounts[dst] = src
+        if plain_mounts:
+            task.set_file_mounts(plain_mounts)
+        task.config_overrides = dict(config.get('config') or {})
+        task.service_spec = config.get('service')
+        task.validate()
+        return task
+
+    @classmethod
+    def from_yaml(cls, yaml_path: str,
+                  env_overrides: Optional[Dict[str, str]] = None) -> 'Task':
+        config = common_utils.read_yaml(os.path.expanduser(yaml_path))
+        if not isinstance(config, dict):
+            raise ValueError(f'{yaml_path} is not a YAML mapping.')
+        return cls.from_yaml_config(config, env_overrides)
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        cfg: Dict[str, Any] = {}
+        if self.name:
+            cfg['name'] = self.name
+        res = self.resources
+        if isinstance(res, resources_lib.Resources):
+            cfg['resources'] = res.to_yaml_config()
+        elif isinstance(res, list):
+            cfg['resources'] = {'ordered': [r.to_yaml_config() for r in res]}
+        else:
+            cfg['resources'] = {'any_of': [r.to_yaml_config() for r in res]}
+        if self._num_nodes is not None:
+            cfg['num_nodes'] = self._num_nodes
+        if self.workdir is not None:
+            cfg['workdir'] = self.workdir
+        if self.setup is not None:
+            cfg['setup'] = self.setup
+        if isinstance(self.run, str):
+            cfg['run'] = self.run
+        if self._envs:
+            cfg['envs'] = dict(self._envs)
+        if self._secrets:
+            cfg['secrets'] = dict(self._secrets)
+        mounts: Dict[str, Any] = dict(self.file_mounts)
+        mounts.update(self.storage_mounts)
+        if mounts:
+            cfg['file_mounts'] = mounts
+        if self.config_overrides:
+            cfg['config'] = dict(self.config_overrides)
+        if self.service_spec:
+            cfg['service'] = dict(self.service_spec)
+        return cfg
+
+    # ------------------------------------------------------------------
+    # Setters (builder style, like the reference)
+    # ------------------------------------------------------------------
+    def set_resources(self, resources: ResourcesSpec) -> 'Task':
+        self.resources = resources
+        return self
+
+    def set_resources_override(self, override: Dict[str, Any]) -> 'Task':
+        res = self.resources
+        if isinstance(res, resources_lib.Resources):
+            self.resources = res.copy(**override)
+        elif isinstance(res, list):
+            self.resources = [r.copy(**override) for r in res]
+        else:
+            self.resources = {r.copy(**override) for r in res}
+        return self
+
+    def set_file_mounts(self, file_mounts: Optional[Dict[str, str]]) -> 'Task':
+        if file_mounts is None:
+            self.file_mounts = {}
+            return self
+        for dst, src in file_mounts.items():
+            if src.startswith(('gs://', 's3://', 'r2://')):
+                self.storage_mounts[dst] = {'source': src, 'mode': 'COPY'}
+            else:
+                self.file_mounts[dst] = src
+        return self
+
+    def update_envs(self, envs: Dict[str, str]) -> 'Task':
+        self._envs.update(envs)
+        return self
+
+    @property
+    def envs(self) -> Dict[str, str]:
+        return dict(self._envs)
+
+    @property
+    def secrets(self) -> Dict[str, str]:
+        return dict(self._secrets)
+
+    @property
+    def envs_and_secrets(self) -> Dict[str, str]:
+        out = dict(self._envs)
+        out.update(self._secrets)
+        return out
+
+    # ------------------------------------------------------------------
+    # Node/host accounting
+    # ------------------------------------------------------------------
+    @property
+    def num_nodes(self) -> int:
+        """Host count: from the TPU slice if concrete, else num_nodes field."""
+        res = self._any_resources()
+        if res is not None and res.tpu is not None:
+            return res.tpu.total_hosts
+        return self._num_nodes or 1
+
+    def _any_resources(self) -> Optional[resources_lib.Resources]:
+        res = self.resources
+        if isinstance(res, resources_lib.Resources):
+            return res
+        for r in res:
+            return r
+        return None
+
+    def resources_list(self) -> List[resources_lib.Resources]:
+        res = self.resources
+        if isinstance(res, resources_lib.Resources):
+            return [res]
+        return list(res)
+
+    # ------------------------------------------------------------------
+    # Validation
+    # ------------------------------------------------------------------
+    def validate(self) -> None:
+        self.validate_run()
+        self.validate_workdir()
+        self._validate_num_nodes()
+
+    def validate_run(self) -> None:
+        if self.run is not None and not isinstance(self.run, str) and not callable(self.run):
+            raise ValueError('run must be a shell string or a callable.')
+
+    def validate_workdir(self) -> None:
+        if self.workdir is None:
+            return
+        workdir = os.path.expanduser(self.workdir)
+        if not os.path.isdir(workdir):
+            raise ValueError(f'workdir {self.workdir!r} is not a directory.')
+
+    def _validate_num_nodes(self) -> None:
+        if self._num_nodes is None:
+            return
+        if self._num_nodes < 1:
+            raise ValueError(f'num_nodes must be >= 1, got {self._num_nodes}')
+        for res in self.resources_list():
+            if res.tpu is not None and res.tpu.total_hosts != self._num_nodes:
+                raise exceptions.ResourcesMismatchError(
+                    f'num_nodes={self._num_nodes} conflicts with '
+                    f'{res.tpu.name}, which spans {res.tpu.total_hosts} '
+                    f'host(s). Drop num_nodes — the slice shape determines '
+                    f'the host fan-out.')
+
+    def __repr__(self) -> str:
+        label = self.name or 'unnamed'
+        res = self.resources_list()
+        res_str = res[0].format_brief() if res else '?'
+        if len(res) > 1:
+            res_str += f' (+{len(res) - 1} candidates)'
+        return f'Task({label}, {res_str}, nodes={self.num_nodes})'

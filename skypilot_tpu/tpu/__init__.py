@@ -1,0 +1,9 @@
+"""TPU hardware model: generations, slice topologies, ICI/DCN facts."""
+from skypilot_tpu.tpu.topology import (  # noqa: F401
+    TpuGeneration,
+    TpuSlice,
+    GENERATIONS,
+    parse_tpu_accelerator,
+    legal_slices,
+    generation_from_device_kind,
+)

@@ -1,0 +1,288 @@
+"""First-class TPU slice model: generations, legal ICI topologies, host counts.
+
+This is the net-new core the reference lacks: SkyPilot treats a TPU only as an
+opaque accelerator string handled inside GCP-specific code
+(sky/clouds/utils/gcp_utils.py:30-57 `is_tpu/is_tpu_vm_pod`,
+sky/clouds/gcp.py:509-545 deploy vars). Here the slice is a typed resource the
+optimizer and provisioner reason about directly: chip count, ICI topology,
+host fan-out, HBM and peak-FLOPs capacity, multi-slice (DCN) counts.
+
+Naming convention accepted everywhere: `tpu-v5p-128` (reference style,
+sky/resources.py `accelerators: tpu-v6e-8`) or the GCP accelerator-type style
+`v5litepod-8` / `v5p-128` / `v6e-8`.
+
+Count-unit subtlety (mirrors GCP): for v2/v3/v4/v5p the number in the name is
+*TensorCores* (chips x 2 for v4/v5p, x2 for v2/v3); for v5e (v5litepod) and
+v6e it is *chips*. `TpuSlice.num_chips` is always chips.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu import exceptions
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuGeneration:
+    """Static facts about one TPU generation."""
+    name: str                       # 'v4', 'v5e', ...
+    gcp_prefix: str                 # accelerator-type prefix, e.g. 'v5litepod'
+    cores_per_chip: int             # TensorCores per chip
+    count_unit: str                 # 'cores' | 'chips' (what the name counts)
+    default_chips_per_host: int
+    hbm_gib_per_chip: int
+    peak_bf16_tflops_per_chip: float
+    ici_dims: int                   # 2 = 2D torus, 3 = 3D torus
+    ici_gbps_per_link: float        # per-direction per-link bandwidth (GB/s)
+    default_runtime_version: str
+    supports_stop: bool             # GCP allows stopping TPU VMs for these
+
+
+# Peak-FLOPs / HBM numbers are the public per-chip specs; ICI link bandwidths
+# are public approximations used only by the optimizer's time model.
+GENERATIONS: Dict[str, TpuGeneration] = {
+    'v2': TpuGeneration('v2', 'v2', 2, 'cores', 4, 16, 45.0, 2, 62.5,
+                        'tpu-ubuntu2204-base', False),
+    'v3': TpuGeneration('v3', 'v3', 2, 'cores', 4, 32, 123.0, 2, 81.25,
+                        'tpu-ubuntu2204-base', False),
+    'v4': TpuGeneration('v4', 'v4', 2, 'cores', 4, 32, 275.0, 3, 50.0,
+                        'tpu-ubuntu2204-base', True),
+    'v5e': TpuGeneration('v5e', 'v5litepod', 1, 'chips', 8, 16, 197.0, 2, 50.0,
+                         'v2-alpha-tpuv5-lite', True),
+    'v5p': TpuGeneration('v5p', 'v5p', 2, 'cores', 4, 95, 459.0, 3, 100.0,
+                         'v2-alpha-tpuv5', True),
+    'v6e': TpuGeneration('v6e', 'v6e', 1, 'chips', 8, 32, 918.0, 2, 100.0,
+                         'v2-alpha-tpuv6e', True),
+}
+
+# Legal slice shapes per generation: name-count -> (chips, topology, hosts).
+# Encodes the public GCP slice tables. Multi-host v5e/v6e slices use 4-chip
+# hosts; single-host ones pack up to 8 chips on one host.
+_Shape = Tuple[int, Tuple[int, ...], int]
+
+
+def chips_of(topology: Tuple[int, ...]) -> int:
+    n = 1
+    for d in topology:
+        n *= d
+    return n
+
+
+def _v4_like_shapes(max_chips: int, cores_per_chip: int = 2) -> Dict[int, _Shape]:
+    """3D-torus generations (v4/v5p): name counts TensorCores, 4 chips/host."""
+    shapes: Dict[int, _Shape] = {}
+    # Canonical cube-ish topologies doubling the longest-dim each step.
+    topo = [2, 2, 1]
+    chips = 4
+    while chips <= max_chips:
+        t = tuple(sorted(topo))
+        shapes[chips * cores_per_chip] = (chips, t, max(1, chips // 4))
+        # grow smallest dimension by 2x
+        i = topo.index(min(topo))
+        topo[i] *= 2
+        chips *= 2
+    return shapes
+
+
+_V5E_SHAPES: Dict[int, _Shape] = {
+    1: (1, (1, 1), 1),
+    2: (2, (1, 2), 1),
+    4: (4, (2, 2), 1),
+    8: (8, (2, 4), 1),
+    16: (16, (4, 4), 4),
+    32: (32, (4, 8), 8),
+    64: (64, (8, 8), 16),
+    128: (128, (8, 16), 32),
+    256: (256, (16, 16), 64),
+}
+
+_V6E_SHAPES: Dict[int, _Shape] = dict(_V5E_SHAPES)  # same public table
+
+_V2_SHAPES: Dict[int, _Shape] = {
+    8: (4, (2, 2), 1),
+    32: (16, (4, 4), 4),
+    128: (64, (8, 8), 16),
+    256: (128, (8, 16), 32),
+    512: (256, (16, 16), 64),
+}
+
+_V3_SHAPES: Dict[int, _Shape] = {
+    8: (4, (2, 2), 1),
+    32: (16, (4, 4), 4),
+    64: (32, (4, 8), 8),
+    128: (64, (8, 8), 16),
+    256: (128, (8, 16), 32),
+    512: (256, (16, 16), 64),
+    1024: (512, (16, 32), 128),
+    2048: (1024, (32, 32), 256),
+}
+
+_SHAPES: Dict[str, Dict[int, _Shape]] = {
+    'v2': _V2_SHAPES,
+    'v3': _V3_SHAPES,
+    'v4': _v4_like_shapes(4096),
+    'v5e': _V5E_SHAPES,
+    'v5p': _v4_like_shapes(6144),
+    'v6e': _V6E_SHAPES,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class TpuSlice:
+    """A concrete, schedulable TPU slice (possibly multi-host, multi-slice).
+
+    `num_slices > 1` models DCN-connected multi-slice jobs (MEGASCALE): the
+    provisioner allocates `num_slices` independent slices in one zone and the
+    runtime wires `MEGASCALE_*` env for cross-slice DCN collectives
+    (SURVEY.md section 5 'Distributed comm backend').
+    """
+    generation: str                  # key into GENERATIONS
+    count: int                       # the number in the accelerator name
+    num_chips: int                   # chips per slice
+    topology: Tuple[int, ...]        # ICI torus dims, e.g. (4, 4, 8)
+    num_hosts: int                   # worker VMs per slice
+    num_slices: int = 1              # DCN-connected slices
+
+    @property
+    def gen(self) -> TpuGeneration:
+        return GENERATIONS[self.generation]
+
+    @property
+    def name(self) -> str:
+        base = f'tpu-{self.generation}-{self.count}'
+        if self.num_slices > 1:
+            return f'{base}x{self.num_slices}'
+        return base
+
+    @property
+    def gcp_accelerator_type(self) -> str:
+        return f'{self.gen.gcp_prefix}-{self.count}'
+
+    @property
+    def topology_str(self) -> str:
+        return 'x'.join(str(d) for d in self.topology)
+
+    @property
+    def is_multi_host(self) -> bool:
+        return self.num_hosts > 1
+
+    @property
+    def total_chips(self) -> int:
+        return self.num_chips * self.num_slices
+
+    @property
+    def total_hosts(self) -> int:
+        return self.num_hosts * self.num_slices
+
+    @property
+    def chips_per_host(self) -> int:
+        return self.num_chips // self.num_hosts
+
+    @property
+    def peak_bf16_tflops(self) -> float:
+        return self.gen.peak_bf16_tflops_per_chip * self.total_chips
+
+    @property
+    def hbm_gib(self) -> int:
+        return self.gen.hbm_gib_per_chip * self.total_chips
+
+    def __str__(self) -> str:
+        return (f'{self.name} ({self.total_chips} chips, '
+                f'{self.topology_str} ICI, {self.total_hosts} hosts)')
+
+
+_TPU_NAME_RE = re.compile(
+    r'^(?:tpu-)?(?P<gen>v2|v3|v4|v5e|v5litepod|v5p|v6e)-(?P<count>\d+)'
+    r'(?:x(?P<slices>\d+))?$', re.IGNORECASE)
+
+
+def is_tpu_accelerator(name: str) -> bool:
+    return _TPU_NAME_RE.fullmatch(name.strip()) is not None
+
+
+def parse_tpu_accelerator(name: str,
+                          topology: Optional[str] = None) -> TpuSlice:
+    """Parse 'tpu-v5p-128', 'v5litepod-8', 'tpu-v6e-256x4' into a TpuSlice.
+
+    `topology` optionally overrides the canonical topology for generations
+    with multiple legal layouts for the same chip count (v4/v5p allow e.g.
+    4x4x8 vs 2x8x16); it must multiply to the same chip count.
+    """
+    m = _TPU_NAME_RE.fullmatch(name.strip())
+    if m is None:
+        raise exceptions.InvalidTopologyError(
+            f'Not a TPU accelerator name: {name!r}. Expected e.g. '
+            f'tpu-v5p-128, v5litepod-8, tpu-v6e-256x4.')
+    gen = m.group('gen').lower()
+    if gen == 'v5litepod':
+        gen = 'v5e'
+    count = int(m.group('count'))
+    num_slices = int(m.group('slices') or 1)
+    shapes = _SHAPES[gen]
+    if count not in shapes:
+        raise exceptions.InvalidTopologyError(
+            f'{name!r}: no legal {gen} slice with count {count}. '
+            f'Legal counts: {sorted(shapes)}')
+    chips, topo, hosts = shapes[count]
+    if topology is not None:
+        custom = tuple(int(d) for d in topology.lower().split('x'))
+        if chips_of(custom) != chips:
+            raise exceptions.InvalidTopologyError(
+                f'Topology {topology} has {chips_of(custom)} chips; '
+                f'{name} requires {chips}.')
+        if len(custom) != GENERATIONS[gen].ici_dims:
+            raise exceptions.InvalidTopologyError(
+                f'{gen} slices use {GENERATIONS[gen].ici_dims}D ICI tori; '
+                f'got topology {topology}.')
+        topo = custom
+    return TpuSlice(generation=gen, count=count, num_chips=chips,
+                    topology=topo, num_hosts=hosts, num_slices=num_slices)
+
+
+def legal_slices(generation: str) -> List[TpuSlice]:
+    """All legal single-slice shapes for a generation, smallest first."""
+    if generation not in _SHAPES:
+        raise exceptions.InvalidTopologyError(
+            f'Unknown TPU generation {generation!r}. '
+            f'Known: {sorted(GENERATIONS)}')
+    out = []
+    for count in sorted(_SHAPES[generation]):
+        chips, topo, hosts = _SHAPES[generation][count]
+        out.append(TpuSlice(generation, count, chips, topo, hosts))
+    return out
+
+
+_DEVICE_KIND_TO_GEN = {
+    'tpu v2': 'v2',
+    'tpu v3': 'v3',
+    'tpu v4': 'v4',
+    'tpu v5 lite': 'v5e',
+    'tpu v5': 'v5p',
+    'tpu v5p': 'v5p',
+    'tpu v6 lite': 'v6e',
+    'tpu v6e': 'v6e',
+    'tpu7x': 'v6e',
+}
+
+
+def generation_from_device_kind(device_kind: str) -> Optional[str]:
+    """Map jax.devices()[i].device_kind to a generation ('TPU v5 lite'→v5e)."""
+    k = device_kind.lower().strip()
+    if k in _DEVICE_KIND_TO_GEN:
+        return _DEVICE_KIND_TO_GEN[k]
+    for prefix, gen in sorted(_DEVICE_KIND_TO_GEN.items(),
+                              key=lambda kv: -len(kv[0])):
+        if k.startswith(prefix):
+            return gen
+    return None
+
+
+def peak_flops_for_device(device) -> float:
+    """Best-effort peak bf16 FLOP/s for a jax device (for MFU accounting)."""
+    gen = generation_from_device_kind(getattr(device, 'device_kind', ''))
+    if gen is None:
+        # CPU or unknown: use a nominal 1 TFLOP/s so MFU math stays defined.
+        return 1e12
+    return GENERATIONS[gen].peak_bf16_tflops_per_chip * 1e12

@@ -1,0 +1,40 @@
+"""Canonical accelerator names.
+
+Reference analog: sky/utils/accelerator_registry.py — canonicalizes user
+accelerator strings. Here TPUs are the first-class citizens; a small GPU
+passthrough list is kept so GPU-era task YAMLs parse (the optimizer will then
+report them infeasible on TPU-only clouds rather than erroring at parse time).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from skypilot_tpu.tpu import topology
+
+_PASSTHROUGH_GPUS = {
+    'a100', 'a100-80gb', 'h100', 'h200', 'b200', 'l4', 'l40s', 'v100', 't4',
+    'a10g', 'p100', 'k80',
+}
+
+
+def is_schedulable_non_gpu_accelerator(name: str) -> bool:
+    return topology.is_tpu_accelerator(name)
+
+
+def canonicalize_accelerator_name(name: str) -> str:
+    """'V5LITEPOD-8' -> 'tpu-v5e-8'; GPU names lowercased unchanged."""
+    stripped = name.strip()
+    if topology.is_tpu_accelerator(stripped):
+        return topology.parse_tpu_accelerator(stripped).name
+    low = stripped.lower()
+    if low in _PASSTHROUGH_GPUS:
+        return low.upper() if not low.startswith('tpu') else low
+    return stripped
+
+
+def infer_tpu_slice(name: str,
+                    topology_override: Optional[str] = None
+                    ) -> Optional[topology.TpuSlice]:
+    if not topology.is_tpu_accelerator(name):
+        return None
+    return topology.parse_tpu_accelerator(name, topology_override)

@@ -1,0 +1,242 @@
+"""Command runners: execute/rsync on slice hosts over SSH or locally.
+
+Reference analog: sky/utils/command_runner.py (`CommandRunner:179`,
+`SSHCommandRunner:599` with ControlMaster multiplexing,
+`LocalProcessCommandRunner:1161`). The local runner is first-class here (it
+backs the fake-TPU local cloud), not just a dev convenience: it chdir's into
+a per-host directory and injects per-host env so one machine can faithfully
+emulate every host of a slice.
+"""
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+import tempfile
+from typing import Dict, List, Optional, Tuple, Union
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.utils import subprocess_utils
+
+logger = sky_logging.init_logger(__name__)
+
+SSH_OPTIONS = [
+    '-o', 'StrictHostKeyChecking=no',
+    '-o', 'UserKnownHostsFile=/dev/null',
+    '-o', 'IdentitiesOnly=yes',
+    '-o', 'ConnectTimeout=30',
+    '-o', 'ServerAliveInterval=20',
+    '-o', 'ServerAliveCountMax=3',
+    '-o', 'LogLevel=ERROR',
+    # ControlMaster multiplexing: one TCP/auth handshake per host.
+    '-o', 'ControlMaster=auto',
+    '-o', 'ControlPersist=120s',
+]
+
+
+def ssh_options_list(ssh_private_key: Optional[str],
+                     control_path: Optional[str]) -> List[str]:
+    opts = list(SSH_OPTIONS)
+    if ssh_private_key:
+        opts += ['-i', os.path.expanduser(ssh_private_key)]
+    if control_path:
+        os.makedirs(control_path, exist_ok=True)
+        opts += ['-o', f'ControlPath={control_path}/%C']
+    return opts
+
+
+def _python_copy(src: str, dst: str,
+                 excludes: Optional[List[str]] = None) -> None:
+    """shutil fallback when rsync is not installed (local runner only).
+
+    Mirrors rsync's trailing-slash semantics: 'src/' copies contents into
+    dst; 'src' copies the directory itself under dst.
+    """
+    import fnmatch
+    import shutil
+
+    def _ignored(name: str) -> bool:
+        return any(fnmatch.fnmatch(name, pat) for pat in excludes or [])
+
+    ignore = (lambda d, names: {n for n in names if _ignored(n)})
+    if os.path.isdir(src):
+        target = dst if src.endswith('/') else os.path.join(
+            dst, os.path.basename(src.rstrip('/')))
+        shutil.copytree(src, target, dirs_exist_ok=True, ignore=ignore)
+    else:
+        os.makedirs(os.path.dirname(dst) or '.', exist_ok=True)
+        if dst.endswith('/'):
+            os.makedirs(dst, exist_ok=True)
+            dst = os.path.join(dst, os.path.basename(src))
+        shutil.copy2(src, dst)
+
+
+class CommandRunner:
+    """Abstract: run a command 'on' a host, rsync files to/from it."""
+
+    def __init__(self, node_id: str):
+        self.node_id = node_id
+
+    def run(self,
+            cmd: Union[str, List[str]],
+            *,
+            env: Optional[Dict[str, str]] = None,
+            log_path: str = '/dev/null',
+            stream_logs: bool = False,
+            require_outputs: bool = False,
+            cwd: Optional[str] = None,
+            detach: bool = False) -> Union[int, Tuple[int, str, str]]:
+        raise NotImplementedError
+
+    def rsync(self, source: str, target: str, *, up: bool,
+              excludes: Optional[List[str]] = None) -> None:
+        raise NotImplementedError
+
+    def check_connection(self) -> bool:
+        try:
+            rc = self.run('true', log_path='/dev/null')
+            return rc == 0
+        except Exception:  # pylint: disable=broad-except
+            return False
+
+    @staticmethod
+    def _env_prefix(env: Optional[Dict[str, str]]) -> str:
+        if not env:
+            return ''
+        parts = [f'export {k}={shlex.quote(str(v))};' for k, v in env.items()]
+        return ' '.join(parts) + ' '
+
+
+class LocalProcessCommandRunner(CommandRunner):
+    """Run in a local subprocess chdir'ed into the host's directory."""
+
+    def __init__(self, node_id: str, host_dir: str,
+                 base_env: Optional[Dict[str, str]] = None):
+        super().__init__(node_id)
+        self.host_dir = host_dir
+        self._base_env = dict(base_env or {})
+
+    def run(self, cmd, *, env=None, log_path='/dev/null', stream_logs=False,
+            require_outputs=False, cwd=None, detach=False):
+        if isinstance(cmd, list):
+            cmd = ' '.join(shlex.quote(c) for c in cmd)
+        full_env = dict(os.environ)
+        full_env.update(self._base_env)
+        full_env.update(env or {})
+        full_env['SKYTPU_RUNTIME_DIR'] = os.path.join(self.host_dir,
+                                                      '.skytpu_runtime')
+        # Make skypilot_tpu importable in host subprocesses even when the
+        # package is not pip-installed (the local-cloud analog of the
+        # reference shipping its wheel to clusters, wheel_utils.py:295).
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        existing_pp = full_env.get('PYTHONPATH', '')
+        if repo_root not in existing_pp.split(os.pathsep):
+            full_env['PYTHONPATH'] = (
+                f'{repo_root}{os.pathsep}{existing_pp}' if existing_pp
+                else repo_root)
+        workdir = cwd or self.host_dir
+        os.makedirs(workdir, exist_ok=True)
+        if detach:
+            log_path = os.path.expanduser(log_path)
+            os.makedirs(os.path.dirname(log_path) or '.', exist_ok=True)
+            with open(log_path, 'ab') as log_file:
+                proc = subprocess.Popen(
+                    cmd, shell=True, stdout=log_file,
+                    stderr=subprocess.STDOUT, cwd=workdir, env=full_env,
+                    start_new_session=True)
+            return proc.pid if require_outputs is False else (0, str(proc.pid), '')
+        return subprocess_utils.run_with_log(
+            cmd, log_path, stream_logs=stream_logs, env=full_env,
+            cwd=workdir, shell=True, require_outputs=require_outputs)
+
+    def rsync(self, source: str, target: str, *, up: bool,
+              excludes: Optional[List[str]] = None) -> None:
+        src = os.path.expanduser(source)
+        if up:
+            dst = os.path.join(self.host_dir, target.lstrip('/').replace(
+                '~/', ''))
+        else:
+            src, dst = os.path.join(self.host_dir,
+                                    source.lstrip('/').replace('~/', '')), (
+                                        os.path.expanduser(target))
+        os.makedirs(os.path.dirname(dst.rstrip('/')) or '.', exist_ok=True)
+        if subprocess_utils.command_exists('rsync'):
+            cmd = ['rsync', '-a', '--delete']
+            for ex in excludes or []:
+                cmd += ['--exclude', ex]
+            cmd += [src, dst]
+            proc = subprocess.run(cmd, capture_output=True, text=True,
+                                  check=False)
+            if proc.returncode != 0:
+                raise exceptions.CommandError(proc.returncode, ' '.join(cmd),
+                                              proc.stderr)
+            return
+        _python_copy(src, dst, excludes)
+
+
+class SSHCommandRunner(CommandRunner):
+    """SSH/rsync to a real slice host (reference analog SSHCommandRunner:599)."""
+
+    def __init__(self,
+                 node_id: str,
+                 ip: str,
+                 ssh_user: str,
+                 ssh_private_key: Optional[str] = None,
+                 port: int = 22,
+                 ssh_proxy_command: Optional[str] = None):
+        super().__init__(node_id)
+        self.ip = ip
+        self.ssh_user = ssh_user
+        self.ssh_private_key = ssh_private_key
+        self.port = port
+        self.ssh_proxy_command = ssh_proxy_command
+        self._control_path = os.path.join(
+            tempfile.gettempdir(), 'skytpu_ssh_control')
+
+    def _ssh_base(self) -> List[str]:
+        base = ['ssh'] + ssh_options_list(self.ssh_private_key,
+                                          self._control_path)
+        base += ['-p', str(self.port)]
+        if self.ssh_proxy_command:
+            base += ['-o', f'ProxyCommand={self.ssh_proxy_command}']
+        base += [f'{self.ssh_user}@{self.ip}']
+        return base
+
+    def run(self, cmd, *, env=None, log_path='/dev/null', stream_logs=False,
+            require_outputs=False, cwd=None, detach=False):
+        if isinstance(cmd, list):
+            cmd = ' '.join(shlex.quote(c) for c in cmd)
+        prefix = self._env_prefix(env)
+        if cwd:
+            prefix += f'cd {shlex.quote(cwd)}; '
+        remote = f'bash --login -c {shlex.quote(prefix + cmd)}'
+        if detach:
+            remote = (f'nohup bash --login -c {shlex.quote(prefix + cmd)} '
+                      f'> /tmp/skytpu_detach.log 2>&1 & echo $!')
+        full = self._ssh_base() + [remote]
+        return subprocess_utils.run_with_log(
+            full, log_path, stream_logs=stream_logs,
+            require_outputs=require_outputs, shell=False)
+
+    def rsync(self, source: str, target: str, *, up: bool,
+              excludes: Optional[List[str]] = None) -> None:
+        ssh_cmd = ' '.join(
+            ['ssh'] + ssh_options_list(self.ssh_private_key,
+                                       self._control_path) +
+            ['-p', str(self.port)])
+        cmd = ['rsync', '-a', '--delete', '-e', ssh_cmd]
+        for ex in excludes or []:
+            cmd += ['--exclude', ex]
+        if up:
+            cmd += [os.path.expanduser(source),
+                    f'{self.ssh_user}@{self.ip}:{target}']
+        else:
+            cmd += [f'{self.ssh_user}@{self.ip}:{source}',
+                    os.path.expanduser(target)]
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              check=False)
+        if proc.returncode != 0:
+            raise exceptions.CommandError(proc.returncode, ' '.join(cmd),
+                                          proc.stderr)

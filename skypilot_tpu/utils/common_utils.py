@@ -1,0 +1,186 @@
+"""Small shared helpers: ids, users, yaml, retries, validation.
+
+Reference analog: sky/utils/common_utils.py.
+"""
+from __future__ import annotations
+
+import functools
+import getpass
+import hashlib
+import json
+import os
+import random
+import re
+import socket
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional, TypeVar, Union
+
+import yaml
+
+_USER_HASH_FILE = os.path.expanduser('~/.skytpu/user_hash')
+USER_HASH_LENGTH = 8
+CLUSTER_NAME_VALID_REGEX = re.compile(r'^[a-zA-Z]([-_.a-zA-Z0-9]*[a-zA-Z0-9])?$')
+
+F = TypeVar('F', bound=Callable)
+
+
+def get_user_hash() -> str:
+    """Stable per-user hash, persisted under ~/.skytpu (analog of ~/.sky)."""
+    env = os.environ.get('SKYTPU_USER_HASH')
+    if env:
+        return env[:USER_HASH_LENGTH]
+    if os.path.exists(_USER_HASH_FILE):
+        with open(_USER_HASH_FILE, 'r', encoding='utf-8') as f:
+            h = f.read().strip()
+            if h:
+                return h[:USER_HASH_LENGTH]
+    h = hashlib.md5(
+        f'{get_user()}@{socket.gethostname()}'.encode()).hexdigest()[:USER_HASH_LENGTH]
+    os.makedirs(os.path.dirname(_USER_HASH_FILE), exist_ok=True)
+    with open(_USER_HASH_FILE, 'w', encoding='utf-8') as f:
+        f.write(h)
+    return h
+
+
+def get_user() -> str:
+    try:
+        return getpass.getuser()
+    except Exception:  # pylint: disable=broad-except
+        return os.environ.get('USER', 'unknown')
+
+
+def get_usage_run_id() -> str:
+    return str(uuid.uuid4())
+
+
+def base36(n: int) -> str:
+    chars = '0123456789abcdefghijklmnopqrstuvwxyz'
+    if n == 0:
+        return '0'
+    out = []
+    while n:
+        n, r = divmod(n, 36)
+        out.append(chars[r])
+    return ''.join(reversed(out))
+
+
+def generate_cluster_name(prefix: str = 'sky') -> str:
+    return f'{prefix}-{base36(random.getrandbits(40))}'
+
+
+def check_cluster_name_is_valid(name: str) -> None:
+    if not name or CLUSTER_NAME_VALID_REGEX.fullmatch(name) is None:
+        raise ValueError(
+            f'Cluster name {name!r} is invalid: must match '
+            f'{CLUSTER_NAME_VALID_REGEX.pattern}')
+
+
+def read_yaml(path: str) -> Dict[str, Any]:
+    with open(path, 'r', encoding='utf-8') as f:
+        return yaml.safe_load(f) or {}
+
+
+def read_yaml_all(path: str) -> List[Dict[str, Any]]:
+    with open(path, 'r', encoding='utf-8') as f:
+        configs = list(yaml.safe_load_all(f))
+    return [c for c in configs if c is not None] or [{}]
+
+
+def dump_yaml(path: str, config: Union[Dict[str, Any], List[Dict[str, Any]]]) -> None:
+    with open(path, 'w', encoding='utf-8') as f:
+        f.write(dump_yaml_str(config))
+
+
+def dump_yaml_str(config: Union[Dict[str, Any], List[Dict[str, Any]]]) -> str:
+    class _Dumper(yaml.SafeDumper):
+        pass
+
+    _Dumper.add_representer(
+        tuple, lambda dumper, data: dumper.represent_list(list(data)))
+    if isinstance(config, list):
+        return yaml.dump_all(config, Dumper=_Dumper, sort_keys=False,
+                             default_flow_style=False)
+    return yaml.dump(config, Dumper=_Dumper, sort_keys=False,
+                     default_flow_style=False)
+
+
+def retry(max_retries: int = 3, initial_backoff: float = 1.0,
+          max_backoff: float = 30.0,
+          exceptions: tuple = (Exception,)) -> Callable[[F], F]:
+    """Exponential-backoff retry decorator with jitter."""
+
+    def decorator(fn: F) -> F:
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            backoff = initial_backoff
+            for attempt in range(max_retries):
+                try:
+                    return fn(*args, **kwargs)
+                except exceptions:
+                    if attempt == max_retries - 1:
+                        raise
+                    time.sleep(backoff + random.uniform(0, backoff * 0.1))
+                    backoff = min(backoff * 2, max_backoff)
+            raise AssertionError('unreachable')
+
+        return wrapper  # type: ignore[return-value]
+
+    return decorator
+
+
+class Backoff:
+    """Iterative exponential backoff (analog: sky/utils/common_utils.Backoff)."""
+
+    def __init__(self, initial: float = 1.0, max_value: float = 30.0,
+                 multiplier: float = 1.6):
+        self._value = initial
+        self._max = max_value
+        self._multiplier = multiplier
+
+    def current_backoff(self) -> float:
+        v = self._value
+        self._value = min(self._value * self._multiplier, self._max)
+        return v + random.uniform(0, 0.1 * v)
+
+
+def format_float(x: Union[int, float], precision: int = 2) -> str:
+    if isinstance(x, int) or float(x).is_integer():
+        return str(int(x))
+    return f'{x:.{precision}f}'
+
+
+def format_duration(seconds: float) -> str:
+    seconds = int(seconds)
+    if seconds < 60:
+        return f'{seconds}s'
+    mins, secs = divmod(seconds, 60)
+    if mins < 60:
+        return f'{mins}m {secs}s'
+    hours, mins = divmod(mins, 60)
+    if hours < 24:
+        return f'{hours}h {mins}m'
+    days, hours = divmod(hours, 24)
+    return f'{days}d {hours}h'
+
+
+def json_dumps_compact(obj: Any) -> str:
+    return json.dumps(obj, separators=(',', ':'), sort_keys=True)
+
+
+def fill_template(template: str, variables: Dict[str, Any]) -> str:
+    """Render a jinja2 template string."""
+    import jinja2  # lazy: keep import cost off the hot path
+    return jinja2.Template(template, undefined=jinja2.StrictUndefined).render(
+        **variables)
+
+
+def truncate_long_string(s: str, max_length: int = 60) -> str:
+    if len(s) <= max_length:
+        return s
+    return s[:max_length - 3] + '...'
+
+
+def make_decorator_passthrough(fn):
+    return fn

@@ -1,0 +1,28 @@
+"""Boolean environment toggles (analog: sky/utils/env_options.py)."""
+from __future__ import annotations
+
+import enum
+import os
+
+
+class Options(enum.Enum):
+    """Each member is (env var name, default)."""
+    IS_DEVELOPER = ('SKYTPU_DEV', False)
+    SHOW_DEBUG_INFO = ('SKYTPU_DEBUG', False)
+    DISABLE_LOGGING = ('SKYTPU_DISABLE_USAGE_COLLECTION', False)
+    MINIMIZE_LOGGING = ('SKYTPU_MINIMIZE_LOGGING', True)
+    SUPPRESS_SENSITIVE_LOG = ('SKYTPU_SUPPRESS_SENSITIVE_LOG', False)
+    RUNNING_IN_BUFFER = ('SKYTPU_RUNNING_IN_BUFFER', False)
+
+    def __init__(self, env_var: str, default: bool):
+        self.env_var = env_var
+        self.default = default
+
+    def get(self) -> bool:
+        v = os.environ.get(self.env_var)
+        if v is None:
+            return self.default
+        return v.lower() in ('1', 'true', 'yes')
+
+    def __bool__(self) -> bool:
+        return self.get()

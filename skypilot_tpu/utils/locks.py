@@ -1,0 +1,74 @@
+"""Distributed locks guarding cluster state transitions.
+
+Reference analog: sky/utils/locks.py — filelock-based per-cluster locks (the
+reference additionally supports postgres advisory locks; we use filelock only,
+which is correct for a single API server host).
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+import filelock
+
+LOCK_DIR = os.path.expanduser('~/.skytpu/locks')
+
+
+class LockTimeout(RuntimeError):
+    pass
+
+
+def get_lock_path(lock_id: str) -> str:
+    os.makedirs(LOCK_DIR, exist_ok=True)
+    safe = lock_id.replace('/', '_')
+    return os.path.join(LOCK_DIR, f'.{safe}.lock')
+
+
+def get_lock(lock_id: str, timeout: Optional[float] = None) -> 'DistributedLock':
+    return DistributedLock(lock_id, timeout=timeout)
+
+
+class DistributedLock:
+    """Context-manager lock keyed by string id (per-cluster, per-request...)."""
+
+    def __init__(self, lock_id: str, timeout: Optional[float] = None):
+        self.lock_id = lock_id
+        self._timeout = -1 if timeout is None else timeout
+        self._lock = filelock.FileLock(get_lock_path(lock_id))
+        self._acquired_at: Optional[float] = None
+
+    def acquire(self) -> None:
+        try:
+            self._lock.acquire(timeout=self._timeout)
+            self._acquired_at = time.time()
+        except filelock.Timeout as e:
+            raise LockTimeout(
+                f'Timed out waiting for lock {self.lock_id!r}; another '
+                f'operation on the same cluster may be in progress.') from e
+
+    def release(self) -> None:
+        if self._lock.is_locked:
+            self._lock.release()
+        self._acquired_at = None
+
+    def held_for(self) -> float:
+        if self._acquired_at is None:
+            return 0.0
+        return time.time() - self._acquired_at
+
+    def __enter__(self) -> 'DistributedLock':
+        self.acquire()
+        return self
+
+    def __exit__(self, *args) -> None:
+        self.release()
+
+
+def cluster_status_lock(cluster_name: str,
+                        timeout: Optional[float] = 20.0) -> DistributedLock:
+    """Lock serializing status refresh/provision/teardown for one cluster.
+
+    Reference analog: cloud_vm_ray_backend.py:3586 CLUSTER_STATUS lock.
+    """
+    return DistributedLock(f'cluster_status.{cluster_name}', timeout=timeout)

@@ -1,0 +1,72 @@
+"""Name → implementation registries for clouds, backends, jobs strategies.
+
+Reference analog: sky/utils/registry.py (CLOUD_REGISTRY / BACKEND_REGISTRY
+decorators). Same shape: a dict-like registry populated by a class decorator,
+with alias support and case-insensitive lookup.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Generic, List, Optional, Type, TypeVar
+
+T = TypeVar('T')
+
+
+class Registry(Generic[T]):
+
+    def __init__(self, registry_name: str):
+        self._name = registry_name
+        self._entries: Dict[str, Type[T]] = {}
+        self._aliases: Dict[str, str] = {}
+
+    def register(self, cls: Optional[Type[T]] = None, *,
+                 name: Optional[str] = None,
+                 aliases: Optional[List[str]] = None) -> Callable:
+        def _do(c: Type[T]) -> Type[T]:
+            key = (name or c.__name__).lower()
+            if key in self._entries:
+                raise ValueError(
+                    f'{self._name} registry already has an entry for {key!r}')
+            self._entries[key] = c
+            for alias in aliases or []:
+                self._aliases[alias.lower()] = key
+            return c
+
+        if cls is not None:
+            return _do(cls)
+        return _do
+
+    def from_str(self, name: Optional[str]) -> Optional[T]:
+        if name is None:
+            return None
+        key = name.lower()
+        key = self._aliases.get(key, key)
+        if key not in self._entries:
+            raise ValueError(
+                f'{self._name} {name!r} is not registered. '
+                f'Available: {sorted(self._entries)}')
+        return self._entries[key]()
+
+    def type_from_str(self, name: str) -> Type[T]:
+        key = self._aliases.get(name.lower(), name.lower())
+        if key not in self._entries:
+            raise ValueError(
+                f'{self._name} {name!r} is not registered. '
+                f'Available: {sorted(self._entries)}')
+        return self._entries[key]
+
+    def keys(self) -> List[str]:
+        return sorted(self._entries)
+
+    def values(self) -> List[Type[T]]:
+        return [self._entries[k] for k in sorted(self._entries)]
+
+    def __contains__(self, name: str) -> bool:
+        key = name.lower()
+        return key in self._entries or key in self._aliases
+
+
+CLOUD_REGISTRY: Registry = Registry('Cloud')
+BACKEND_REGISTRY: Registry = Registry('Backend')
+JOBS_RECOVERY_STRATEGY_REGISTRY: Registry = Registry('RecoveryStrategy')
+LB_POLICY_REGISTRY: Registry = Registry('LoadBalancingPolicy')
+AUTOSCALER_REGISTRY: Registry = Registry('Autoscaler')

@@ -1,0 +1,57 @@
+"""Cluster/job status enums shared across layers.
+
+Reference analogs: sky/utils/status_lib.py (ClusterStatus, StatusVersion) and
+sky/skylet/job_lib.py:157 (JobStatus).
+"""
+from __future__ import annotations
+
+import enum
+
+
+class ClusterStatus(enum.Enum):
+    """Lifecycle state of a cluster (a TPU slice + its hosts)."""
+    INIT = 'INIT'          # provisioning in progress or unknown/interrupted
+    UP = 'UP'              # all hosts up, runtime (agent) healthy
+    STOPPED = 'STOPPED'    # hosts stopped (TPU slices: only supported some gens)
+
+    def colored_str(self) -> str:
+        color = {
+            ClusterStatus.INIT: '\x1b[33m',     # yellow
+            ClusterStatus.UP: '\x1b[32m',       # green
+            ClusterStatus.STOPPED: '\x1b[36m',  # cyan
+        }[self]
+        return f'{color}{self.value}\x1b[0m'
+
+
+class JobStatus(enum.Enum):
+    """On-cluster job queue states (analog: sky/skylet/job_lib.py:157)."""
+    INIT = 'INIT'
+    PENDING = 'PENDING'
+    SETTING_UP = 'SETTING_UP'
+    RUNNING = 'RUNNING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    FAILED_SETUP = 'FAILED_SETUP'
+    FAILED_DRIVER = 'FAILED_DRIVER'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in _TERMINAL_JOB_STATUSES
+
+    @classmethod
+    def terminal_statuses(cls):
+        return list(_TERMINAL_JOB_STATUSES)
+
+    def colored_str(self) -> str:
+        color = '\x1b[32m' if self is JobStatus.SUCCEEDED else (
+            '\x1b[31m' if self in _TERMINAL_JOB_STATUSES else '\x1b[33m')
+        return f'{color}{self.value}\x1b[0m'
+
+
+_TERMINAL_JOB_STATUSES = frozenset({
+    JobStatus.SUCCEEDED,
+    JobStatus.FAILED,
+    JobStatus.FAILED_SETUP,
+    JobStatus.FAILED_DRIVER,
+    JobStatus.CANCELLED,
+})

@@ -1,0 +1,146 @@
+"""Subprocess helpers: parallel map, process-tree kill, streamed run.
+
+Reference analog: sky/utils/subprocess_utils.py.
+"""
+from __future__ import annotations
+
+import os
+import shlex
+import signal
+import subprocess
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
+
+import psutil
+
+from skypilot_tpu import exceptions
+
+
+def get_parallel_threads(n_tasks: int, max_workers: int = 32) -> int:
+    cpus = os.cpu_count() or 4
+    return max(1, min(n_tasks, max_workers, cpus * 4))
+
+
+def run_in_parallel(fn: Callable, args: Sequence[Any],
+                    num_threads: Optional[int] = None) -> List[Any]:
+    """Map fn over args with a thread pool; re-raises the first exception."""
+    args = list(args)
+    if not args:
+        return []
+    if len(args) == 1:
+        return [fn(args[0])]
+    workers = num_threads or get_parallel_threads(len(args))
+    with ThreadPoolExecutor(max_workers=workers) as pool:
+        return list(pool.map(fn, args))
+
+
+def run(cmd: Union[str, List[str]], **kwargs) -> subprocess.CompletedProcess:
+    shell = isinstance(cmd, str)
+    return subprocess.run(cmd, shell=shell, check=True, **kwargs)
+
+
+def run_no_outputs(cmd: Union[str, List[str]], **kwargs):
+    return run(cmd, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+               **kwargs)
+
+
+def run_with_log(cmd: Union[str, List[str]],
+                 log_path: str,
+                 *,
+                 stream_logs: bool = False,
+                 env: Optional[dict] = None,
+                 cwd: Optional[str] = None,
+                 shell: bool = False,
+                 require_outputs: bool = False) -> Union[int, Tuple[int, str, str]]:
+    """Run cmd, teeing combined stdout/stderr to log_path.
+
+    Reference analog: sky/skylet/log_lib.py run_with_log. Returns the exit code
+    (and outputs if require_outputs).
+    """
+    log_path = os.path.expanduser(log_path)
+    os.makedirs(os.path.dirname(log_path) or '.', exist_ok=True)
+    stdout_buf: List[str] = []
+    with open(log_path, 'a', encoding='utf-8') as log_file:
+        proc = subprocess.Popen(
+            cmd,
+            shell=shell if isinstance(cmd, list) else True,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            bufsize=1,
+            env=env,
+            cwd=cwd,
+            start_new_session=True,
+        )
+        assert proc.stdout is not None
+        for line in proc.stdout:
+            log_file.write(line)
+            log_file.flush()
+            if require_outputs:
+                stdout_buf.append(line)
+            if stream_logs:
+                print(line, end='', flush=True)
+        proc.wait()
+    if require_outputs:
+        return proc.returncode, ''.join(stdout_buf), ''
+    return proc.returncode
+
+def kill_children_processes(parent_pid: Optional[int] = None,
+                            force: bool = False) -> None:
+    """Kill the full process tree below parent_pid (default: this process)."""
+    parent_pid = parent_pid or os.getpid()
+    try:
+        parent = psutil.Process(parent_pid)
+    except psutil.NoSuchProcess:
+        return
+    children = parent.children(recursive=True)
+    sig = signal.SIGKILL if force else signal.SIGTERM
+    for child in children:
+        try:
+            child.send_signal(sig)
+        except psutil.NoSuchProcess:
+            pass
+    _, alive = psutil.wait_procs(children, timeout=5)
+    for child in alive:
+        try:
+            child.kill()
+        except psutil.NoSuchProcess:
+            pass
+
+
+def kill_process_daemon(pid: int) -> None:
+    """Terminate pid and its subtree, escalating to SIGKILL."""
+    try:
+        proc = psutil.Process(pid)
+    except psutil.NoSuchProcess:
+        return
+    procs = proc.children(recursive=True) + [proc]
+    for p in procs:
+        try:
+            p.terminate()
+        except psutil.NoSuchProcess:
+            pass
+    _, alive = psutil.wait_procs(procs, timeout=5)
+    for p in alive:
+        try:
+            p.kill()
+        except psutil.NoSuchProcess:
+            pass
+
+
+def command_exists(name: str) -> bool:
+    return subprocess.call(f'command -v {shlex.quote(name)}',
+                           shell=True,
+                           stdout=subprocess.DEVNULL,
+                           stderr=subprocess.DEVNULL) == 0
+
+
+def wait_until(predicate: Callable[[], bool], timeout: float,
+               interval: float = 1.0, desc: str = 'condition') -> None:
+    start = time.time()
+    while time.time() - start < timeout:
+        if predicate():
+            return
+        time.sleep(interval)
+    raise TimeoutError(f'Timed out after {timeout}s waiting for {desc}.')

@@ -1,0 +1,88 @@
+"""Chrome trace-event profiling of control-plane operations.
+
+Reference analog: sky/utils/timeline.py — events are recorded when
+SKYTPU_TIMELINE_FILE_PATH is set and written as a Chrome trace JSON
+(chrome://tracing / perfetto loadable). Decorate hot control-plane functions
+with @timeline.event.
+"""
+from __future__ import annotations
+
+import atexit
+import functools
+import json
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+_EVENTS: List[Dict[str, Any]] = []
+_LOCK = threading.Lock()
+_ENABLED: Optional[bool] = None
+
+
+def _enabled() -> bool:
+    global _ENABLED
+    if _ENABLED is None:
+        _ENABLED = bool(os.environ.get('SKYTPU_TIMELINE_FILE_PATH'))
+        if _ENABLED:
+            atexit.register(save_timeline)
+    return _ENABLED
+
+
+class Event:
+    """Context manager emitting a begin/end ('B'/'E') trace-event pair."""
+
+    def __init__(self, name: str, message: Optional[str] = None):
+        self._name = name
+        self._message = message
+
+    def _record(self, phase: str) -> None:
+        event = {
+            'name': self._name,
+            'ph': phase,
+            'ts': f'{time.time() * 10 ** 6: .3f}',
+            'pid': str(os.getpid()),
+            'tid': str(threading.get_ident()),
+        }
+        if self._message is not None:
+            event['args'] = {'message': self._message}
+        with _LOCK:
+            _EVENTS.append(event)
+
+    def __enter__(self):
+        if _enabled():
+            self._record('B')
+        return self
+
+    def __exit__(self, *args):
+        if _enabled():
+            self._record('E')
+
+
+def event(fn: Optional[Callable] = None, name: Optional[str] = None):
+    """Decorator recording the wrapped call as a timeline event."""
+
+    def _decorate(func: Callable) -> Callable:
+        event_name = name or f'{func.__module__}.{func.__qualname__}'
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            with Event(event_name):
+                return func(*args, **kwargs)
+
+        return wrapper
+
+    if fn is not None:
+        return _decorate(fn)
+    return _decorate
+
+
+def save_timeline() -> None:
+    path = os.environ.get('SKYTPU_TIMELINE_FILE_PATH')
+    if not path or not _EVENTS:
+        return
+    with _LOCK:
+        payload = {'traceEvents': list(_EVENTS)}
+    os.makedirs(os.path.dirname(os.path.expanduser(path)) or '.', exist_ok=True)
+    with open(os.path.expanduser(path), 'w', encoding='utf-8') as f:
+        json.dump(payload, f)

@@ -1,0 +1,48 @@
+"""Test config: force a virtual 8-device CPU mesh before jax is imported.
+
+This mirrors the reference's `enable_all_clouds` philosophy
+(tests/common_test_fixtures.py:176-236): everything runs hermetically with
+zero cloud credentials. Compute-path tests get 8 virtual CPU devices so
+multi-chip sharding is exercised without TPU hardware.
+"""
+import os
+import sys
+
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+_flags = os.environ.get('XLA_FLAGS', '')
+if '--xla_force_host_platform_device_count' not in _flags:
+    os.environ['XLA_FLAGS'] = (
+        _flags + ' --xla_force_host_platform_device_count=8').strip()
+os.environ.setdefault('SKYTPU_USER_HASH', 'testhash')
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def enable_local_cloud(monkeypatch):
+    """Analog of the reference's enable_all_clouds fixture: only the Local
+    (fabricated TPU) cloud is enabled, no credential probing, no disk cache."""
+    from skypilot_tpu import check as check_lib
+    from skypilot_tpu.clouds import local as local_cloud
+
+    monkeypatch.setattr(
+        check_lib, 'get_cached_enabled_clouds_or_refresh',
+        lambda raise_if_no_cloud_access=False: [local_cloud.Local()])
+    yield
+
+
+@pytest.fixture
+def isolated_state(tmp_path, monkeypatch):
+    """Point all on-disk state (~/.skytpu) into a temp dir."""
+    home = tmp_path / 'home'
+    home.mkdir()
+    monkeypatch.setenv('HOME', str(home))
+    # Modules capture expanded paths at import; patch the key ones.
+    from skypilot_tpu.utils import locks
+    monkeypatch.setattr(locks, 'LOCK_DIR', str(home / '.skytpu/locks'))
+    from skypilot_tpu.clouds import local as local_cloud
+    monkeypatch.setattr(local_cloud, 'LOCAL_CLOUD_ROOT',
+                        str(home / '.skytpu/local_cloud'))
+    yield home

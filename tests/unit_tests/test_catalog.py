@@ -1,0 +1,56 @@
+"""Tests for the TPU catalog."""
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.catalog import tpu_catalog
+from skypilot_tpu.tpu import topology
+
+
+def _slice(name):
+    return topology.parse_tpu_accelerator(name)
+
+
+class TestCatalog:
+
+    def test_regions_cheapest_first(self):
+        regions = tpu_catalog.get_regions(_slice('tpu-v5e-8'))
+        assert 'us-west4' in regions
+        # eu region is priced higher → later.
+        assert regions.index('us-west4') < regions.index('europe-west4')
+
+    def test_capacity_filter(self):
+        # v5e max 256 chips; a 256-chip slice fits, nothing larger exists.
+        assert tpu_catalog.get_regions(_slice('tpu-v5e-256'))
+        big = _slice('tpu-v5e-256x4')  # 1024 chips via multislice
+        assert tpu_catalog.get_regions(big) == []
+
+    def test_hourly_cost_spot_discount(self):
+        sl = _slice('tpu-v5p-8')
+        od = tpu_catalog.get_hourly_cost(sl, use_spot=False)
+        spot = tpu_catalog.get_hourly_cost(sl, use_spot=True)
+        assert od == pytest.approx(4.20 * 4)     # 4 chips
+        assert spot < od
+
+    def test_cost_unknown_region(self):
+        with pytest.raises(exceptions.ResourcesUnavailableError):
+            tpu_catalog.get_hourly_cost(_slice('tpu-v4-8'),
+                                        region='us-west4')
+
+    def test_validate_region_zone(self):
+        region, zone = tpu_catalog.validate_region_zone(None, 'us-west4-a')
+        assert region == 'us-west4' and zone == 'us-west4-a'
+        with pytest.raises(ValueError):
+            tpu_catalog.validate_region_zone('us-east1', 'us-west4-a')
+        with pytest.raises(ValueError):
+            tpu_catalog.validate_region_zone(None, 'nope-zone')
+
+    def test_list_accelerators(self):
+        offerings = tpu_catalog.list_accelerators(name_filter='v6e-8')
+        assert 'tpu-v6e-8' in offerings
+        infos = offerings['tpu-v6e-8']
+        assert all(i.num_chips == 8 for i in infos)
+        assert any(i.region == 'us-east5' for i in infos)
+
+    def test_host_vm_spec(self):
+        spec = tpu_catalog.get_host_vm_spec('v5p')
+        assert spec.vcpus > 0 and spec.memory_gb > 0

@@ -1,0 +1,83 @@
+"""Tests for status refresh + autostop plumbing."""
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import core
+from skypilot_tpu import global_state
+from skypilot_tpu import provision
+from skypilot_tpu.utils.status_lib import ClusterStatus
+
+
+@pytest.mark.usefixtures('enable_local_cloud', 'isolated_state')
+class TestStatusRefresh:
+
+    def _launch(self, name):
+        task = sky.Task(name='t', run='echo hi')
+        task.set_resources(sky.Resources(accelerators='tpu-v5e-8',
+                                         autostop=1))
+        job_id, handle = sky.launch(task, cluster_name=name, detach_run=True)
+        return job_id, handle
+
+    def test_autostop_armed_on_launch(self):
+        # Regression: set_autostop shell quoting used to collapse and fail
+        # every autostop-enabled launch.
+        _, handle = self._launch('t-as')
+        try:
+            record = global_state.get_cluster('t-as')
+            assert record['autostop'] == {'idle_minutes': 1, 'down': False}
+            info = handle.get_cluster_info()
+            import json
+            import os
+            host_dir = list(info.host_dirs.values())[0]
+            cfg_path = os.path.join(host_dir, '.skytpu_runtime',
+                                    'autostop.json')
+            deadline = time.time() + 10
+            while not os.path.exists(cfg_path) and time.time() < deadline:
+                time.sleep(0.2)
+            cfg = json.load(open(cfg_path))
+            assert cfg['idle_minutes'] == 1
+            assert cfg['cluster_name'] == 't-as'
+        finally:
+            sky.down('t-as')
+
+    def test_refresh_keeps_record_on_transient_error(self):
+        # Regression: a flaky query_instances must NOT drop a live cluster.
+        self._launch('t-keep')
+        try:
+            def _boom(*args, **kwargs):
+                raise RuntimeError('transient API error')
+
+            with pytest.MonkeyPatch.context() as mp:
+                mp.setattr(provision, 'query_instances', _boom)
+                records = core.status(['t-keep'], refresh=True)
+                assert records and records[0]['name'] == 't-keep'
+                assert global_state.get_cluster('t-keep') is not None
+        finally:
+            sky.down('t-keep')
+
+    def test_refresh_drops_vanished_cluster(self):
+        self._launch('t-gone')
+        try:
+            with pytest.MonkeyPatch.context() as mp:
+                mp.setattr(provision, 'query_instances', lambda *a, **k: {})
+                records = core.status(['t-gone'], refresh=True)
+                assert records == []
+                assert global_state.get_cluster('t-gone') is None
+        finally:
+            # Cluster dir still exists on the fake cloud; clean it directly.
+            from skypilot_tpu.provision.local import instance as local_inst
+            local_inst.terminate_instances('local', 't-gone')
+
+    def test_refresh_stopped_status(self):
+        self._launch('t-stopped')
+        try:
+            sky.stop('t-stopped')
+            records = core.status(['t-stopped'], refresh=True)
+            assert records[0]['status'] == ClusterStatus.STOPPED
+            sky.start('t-stopped')
+            records = core.status(['t-stopped'], refresh=True)
+            assert records[0]['status'] == ClusterStatus.UP
+        finally:
+            sky.down('t-stopped')

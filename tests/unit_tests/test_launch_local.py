@@ -1,0 +1,166 @@
+"""End-to-end launch → exec → logs → down on the local fake-TPU cloud.
+
+This is the hermetic equivalent of the reference's smoke tests
+(tests/test_smoke.py) — a full control-plane pass with zero credentials.
+"""
+import os
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_state
+from skypilot_tpu.clouds import local as local_cloud
+from skypilot_tpu.utils.status_lib import ClusterStatus, JobStatus
+
+
+def _wait_job(cluster, job_id, timeout=60):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        status = sky.job_status(cluster, job_id)
+        if status is not None and status.is_terminal():
+            return status
+        time.sleep(0.5)
+    raise TimeoutError(f'job {job_id} still not terminal')
+
+
+@pytest.mark.usefixtures('enable_local_cloud', 'isolated_state')
+class TestLaunchLocal:
+
+    def test_launch_single_host(self, tmp_path):
+        task = sky.Task(name='hello', run='echo "hello from $SKYTPU_NODE_RANK"')
+        task.set_resources(sky.Resources(accelerators='tpu-v5e-8'))
+        job_id, handle = sky.launch(task, cluster_name='t-single',
+                                    detach_run=True)
+        try:
+            assert job_id == 1
+            assert handle is not None
+            status = _wait_job('t-single', job_id)
+            assert status == JobStatus.SUCCEEDED
+            records = sky.status(['t-single'])
+            assert records[0]['status'] == ClusterStatus.UP
+        finally:
+            sky.down('t-single')
+        assert global_state.get_cluster('t-single') is None
+
+    def test_gang_multihost_env_contract(self, tmp_path):
+        # v5e-16 → 4 hosts; every rank reports its identity, all must run.
+        out_marker = tmp_path / 'ranks'
+        out_marker.mkdir()
+        task = sky.Task(
+            name='gang',
+            run=(f'echo "rank=$SKYPILOT_NODE_RANK '
+                 f'worker=$TPU_WORKER_ID '
+                 f'nodes=$SKYPILOT_NUM_NODES '
+                 f'chips=$SKYPILOT_NUM_GPUS_PER_NODE" '
+                 f'> {out_marker}/rank_$SKYPILOT_NODE_RANK.txt'))
+        task.set_resources(sky.Resources(accelerators='tpu-v5e-16'))
+        job_id, _ = sky.launch(task, cluster_name='t-gang', detach_run=True)
+        try:
+            status = _wait_job('t-gang', job_id)
+            assert status == JobStatus.SUCCEEDED
+            files = sorted(os.listdir(out_marker))
+            assert len(files) == 4
+            content0 = (out_marker / 'rank_0.txt').read_text()
+            assert 'nodes=4' in content0
+            assert 'chips=4' in content0          # multi-host v5e: 4 chips/host
+        finally:
+            sky.down('t-gang')
+
+    def test_gang_failure_kills_all(self, tmp_path):
+        task = sky.Task(
+            name='failgang',
+            run='if [ "$SKYPILOT_NODE_RANK" = "1" ]; then exit 3; fi; '
+                'sleep 30')
+        task.set_resources(sky.Resources(accelerators='tpu-v5e-16'))
+        start = time.time()
+        job_id, _ = sky.launch(task, cluster_name='t-fail', detach_run=True)
+        try:
+            status = _wait_job('t-fail', job_id)
+            assert status == JobStatus.FAILED
+            # Gang semantics: surviving ranks were killed, not waited out.
+            assert time.time() - start < 25
+        finally:
+            sky.down('t-fail')
+
+    def test_exec_on_existing_and_queue(self):
+        task = sky.Task(name='first', run='echo one')
+        task.set_resources(sky.Resources(accelerators='tpu-v5e-8'))
+        job1, _ = sky.launch(task, cluster_name='t-exec', detach_run=True)
+        try:
+            _wait_job('t-exec', job1)
+            task2 = sky.Task(name='second', run='echo two')
+            task2.set_resources(sky.Resources(accelerators='tpu-v5e-8'))
+            job2, _ = sky.exec(task2, 't-exec', detach_run=True)
+            assert job2 == 2
+            _wait_job('t-exec', job2)
+            jobs = sky.queue('t-exec')
+            assert {j['job_name'] for j in jobs} == {'first', 'second'}
+        finally:
+            sky.down('t-exec')
+
+    def test_exec_mismatch_rejected(self):
+        task = sky.Task(name='small', run='echo hi')
+        task.set_resources(sky.Resources(accelerators='tpu-v5e-8'))
+        job_id, _ = sky.launch(task, cluster_name='t-mismatch',
+                               detach_run=True)
+        try:
+            _wait_job('t-mismatch', job_id)
+            big = sky.Task(name='big', run='echo hi')
+            big.set_resources(sky.Resources(accelerators='tpu-v5e-32'))
+            with pytest.raises(exceptions.ResourcesMismatchError):
+                sky.exec(big, 't-mismatch')
+        finally:
+            sky.down('t-mismatch')
+
+    def test_cancel(self):
+        task = sky.Task(name='sleeper', run='sleep 300')
+        task.set_resources(sky.Resources(accelerators='tpu-v5e-8'))
+        job_id, _ = sky.launch(task, cluster_name='t-cancel',
+                               detach_run=True)
+        try:
+            deadline = time.time() + 30
+            while sky.job_status('t-cancel', job_id) != JobStatus.RUNNING:
+                assert time.time() < deadline
+                time.sleep(0.3)
+            cancelled = sky.cancel('t-cancel', [job_id])
+            assert cancelled == [job_id]
+            assert sky.job_status('t-cancel',
+                                  job_id) == JobStatus.CANCELLED
+        finally:
+            sky.down('t-cancel')
+
+    def test_zone_failover(self):
+        # Fault-inject zone local-a: provisioning must fail over to local-b.
+        local_cloud.PROVISION_FAULTS['local-a'] = (
+            exceptions.InsufficientCapacityError('[test] stockout'))
+        try:
+            task = sky.Task(name='fo', run='echo ok')
+            task.set_resources(sky.Resources(accelerators='tpu-v5e-8'))
+            job_id, handle = sky.launch(task, cluster_name='t-failover',
+                                        detach_run=True)
+            assert handle.zone == 'local-b'
+            _wait_job('t-failover', job_id)
+        finally:
+            local_cloud.PROVISION_FAULTS.clear()
+            sky.down('t-failover')
+
+    def test_workdir_sync(self, tmp_path):
+        wd = tmp_path / 'wd'
+        wd.mkdir()
+        (wd / 'data.txt').write_text('payload42')
+        task = sky.Task(name='wd', run='cat data.txt', workdir=str(wd))
+        task.set_resources(sky.Resources(accelerators='tpu-v5e-8'))
+        job_id, handle = sky.launch(task, cluster_name='t-wd',
+                                    detach_run=True)
+        try:
+            status = _wait_job('t-wd', job_id)
+            assert status == JobStatus.SUCCEEDED
+            info = handle.get_cluster_info()
+            host_dir = list(info.host_dirs.values())[0]
+            log = os.path.join(host_dir, '.skytpu_runtime', 'logs',
+                               str(job_id), 'run.log')
+            assert 'payload42' in open(log).read()
+        finally:
+            sky.down('t-wd')

@@ -1,0 +1,110 @@
+"""Optimizer dry-run tests (analog: tests/test_optimizer_dryruns.py)."""
+import pytest
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import optimizer as optimizer_lib
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.optimizer import OptimizeTarget
+
+
+def _tpu_task(name, acc, **res_kwargs):
+    t = task_lib.Task(name=name, run='echo hi')
+    t.set_resources(resources_lib.Resources(accelerators=acc, **res_kwargs))
+    return t
+
+
+@pytest.mark.usefixtures('enable_local_cloud')
+class TestOptimizer:
+
+    def test_single_task(self):
+        dag = dag_lib.Dag()
+        dag.add(_tpu_task('t', 'tpu-v5e-8'))
+        optimizer_lib.Optimizer.optimize(dag, quiet=True)
+        best = dag.tasks[0].best_resources
+        assert best is not None and best.is_launchable()
+        assert best.tpu.name == 'tpu-v5e-8'
+
+    def test_spot_cheaper_wins_cost(self):
+        dag = dag_lib.Dag()
+        t = task_lib.Task(name='t', run='x')
+        t.set_resources({
+            resources_lib.Resources(accelerators='tpu-v5e-8', use_spot=True),
+            resources_lib.Resources(accelerators='tpu-v5e-8', use_spot=False),
+        })
+        dag.add(t)
+        optimizer_lib.Optimizer.optimize(dag, OptimizeTarget.COST, quiet=True)
+        assert t.best_resources.use_spot
+
+    def test_time_prefers_bigger_slice(self):
+        dag = dag_lib.Dag()
+        t = task_lib.Task(name='t', run='x')
+        t.estimated_total_flops = 1e18
+        t.set_resources({
+            resources_lib.Resources(accelerators='tpu-v5e-8'),
+            resources_lib.Resources(accelerators='tpu-v5e-32'),
+        })
+        dag.add(t)
+        optimizer_lib.Optimizer.optimize(dag, OptimizeTarget.TIME, quiet=True)
+        assert t.best_resources.tpu.num_chips == 32
+
+    def test_cost_prefers_smaller_slice(self):
+        dag = dag_lib.Dag()
+        t = task_lib.Task(name='t', run='x')
+        t.set_resources({
+            resources_lib.Resources(accelerators='tpu-v5e-8'),
+            resources_lib.Resources(accelerators='tpu-v5e-32'),
+        })
+        dag.add(t)
+        optimizer_lib.Optimizer.optimize(dag, OptimizeTarget.COST, quiet=True)
+        assert t.best_resources.tpu.num_chips == 8
+
+    def test_infeasible_gpu(self):
+        dag = dag_lib.Dag()
+        dag.add(_tpu_task('t', 'A100'))
+        with pytest.raises(exceptions.ResourcesUnavailableError):
+            optimizer_lib.Optimizer.optimize(dag, quiet=True)
+
+    def test_too_big_for_local(self):
+        dag = dag_lib.Dag()
+        dag.add(_tpu_task('t', 'tpu-v5p-512'))  # 256 chips > local cap
+        with pytest.raises(exceptions.ResourcesUnavailableError):
+            optimizer_lib.Optimizer.optimize(dag, quiet=True)
+
+    def test_chain_dp(self):
+        dag = dag_lib.Dag()
+        a = _tpu_task('a', 'tpu-v5e-8')
+        b = _tpu_task('b', 'tpu-v5e-8')
+        dag.add(a)
+        dag.add(b)
+        dag.add_edge(a, b)
+        assert dag.is_chain()
+        optimizer_lib.Optimizer.optimize(dag, quiet=True)
+        assert a.best_resources is not None
+        assert b.best_resources is not None
+
+    def test_general_dag(self):
+        dag = dag_lib.Dag()
+        a = _tpu_task('a', 'tpu-v5e-8')
+        b = _tpu_task('b', 'tpu-v5e-8')
+        c = _tpu_task('c', 'tpu-v5e-8')
+        d = _tpu_task('d', 'tpu-v5e-8')
+        for t in (a, b, c, d):
+            dag.add(t)
+        dag.add_edge(a, b)
+        dag.add_edge(a, c)
+        dag.add_edge(b, d)
+        dag.add_edge(c, d)
+        assert not dag.is_chain()
+        optimizer_lib.Optimizer.optimize(dag, quiet=True)
+        assert all(t.best_resources is not None for t in dag.tasks)
+
+    def test_blocked_resources(self):
+        dag = dag_lib.Dag()
+        dag.add(_tpu_task('t', 'tpu-v5e-8'))
+        blocked = [resources_lib.Resources(cloud='local',
+                                           accelerators='tpu-v5e-8')]
+        with pytest.raises(exceptions.ResourcesUnavailableError):
+            optimizer_lib.Optimizer.optimize(dag, quiet=True,
+                                             blocked_resources=blocked)

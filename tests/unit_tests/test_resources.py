@@ -1,0 +1,109 @@
+"""Tests for Resources parsing/validation (analog: tests/unit_tests/test_resources.py)."""
+import pytest
+
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu.clouds import cloud as cloud_lib
+
+
+class TestResources:
+
+    def test_tpu_parse(self):
+        r = resources_lib.Resources(accelerators='tpu-v5p-128')
+        assert r.tpu is not None
+        assert r.tpu.num_chips == 64
+        assert r.num_hosts == 16
+        assert not r.is_launchable()     # no cloud yet
+
+    def test_launchable_with_cloud(self):
+        r = resources_lib.Resources(cloud='gcp', accelerators='tpu-v6e-8')
+        assert r.is_launchable()
+
+    def test_accelerator_args_topology(self):
+        r = resources_lib.Resources(
+            accelerators='tpu-v4-128',
+            accelerator_args={'topology': '4x4x4'})
+        assert r.tpu.topology == (4, 4, 4)
+
+    def test_num_slices(self):
+        r = resources_lib.Resources(
+            accelerators='tpu-v5e-256',
+            accelerator_args={'num_slices': 2})
+        assert r.tpu.num_slices == 2
+        assert r.tpu.total_chips == 512
+
+    def test_gpu_name_not_launchable(self):
+        r = resources_lib.Resources(accelerators='A100')
+        assert r.tpu is None
+        assert not r.is_launchable()
+
+    def test_gpu_dict_spec(self):
+        r = resources_lib.Resources(accelerators={'A100': 8})
+        assert r.accelerators == 'A100:8'
+
+    def test_region_zone_validation(self):
+        r = resources_lib.Resources(accelerators='tpu-v5e-8',
+                                    zone='us-west4-a')
+        assert r.region == 'us-west4'
+        with pytest.raises(ValueError):
+            resources_lib.Resources(zone='bogus-zone-1')
+
+    def test_yaml_round_trip(self):
+        r = resources_lib.Resources(
+            cloud='gcp', accelerators='tpu-v5p-8', use_spot=True,
+            region='us-east5', disk_size=256,
+            accelerator_args={'runtime_version': 'v2-alpha-tpuv5'})
+        cfg = r.to_yaml_config()
+        r2 = resources_lib.Resources.from_yaml_config(cfg)
+        assert r2.to_yaml_config() == cfg
+        assert r == r2
+
+    def test_any_of(self):
+        got = resources_lib.Resources.from_yaml_config({
+            'any_of': [{'accelerators': 'tpu-v5e-8'},
+                       {'accelerators': 'tpu-v6e-8'}],
+            'use_spot': True,
+        })
+        assert isinstance(got, set) and len(got) == 2
+        assert all(r.use_spot for r in got)
+
+    def test_ordered(self):
+        got = resources_lib.Resources.from_yaml_config({
+            'ordered': [{'accelerators': 'tpu-v6e-8'},
+                        {'accelerators': 'tpu-v5e-8'}],
+        })
+        assert isinstance(got, list)
+        assert got[0].tpu.generation == 'v6e'
+
+    def test_unknown_field(self):
+        with pytest.raises(ValueError, match='Unknown resources fields'):
+            resources_lib.Resources.from_yaml_config({'acelerators': 'x'})
+
+    def test_less_demanding_than(self):
+        small = resources_lib.Resources(accelerators='tpu-v5e-8')
+        big = resources_lib.Resources(cloud='gcp', accelerators='tpu-v5e-16')
+        assert small.less_demanding_than(big)
+        assert not big.less_demanding_than(small)
+        other_gen = resources_lib.Resources(cloud='gcp',
+                                            accelerators='tpu-v4-16')
+        assert not small.less_demanding_than(other_gen)
+
+    def test_cost(self):
+        r = resources_lib.Resources(cloud='gcp', accelerators='tpu-v5e-8')
+        one_hour = r.get_cost(3600)
+        assert one_hour == pytest.approx(1.20 * 8)
+
+    def test_required_features(self):
+        r = resources_lib.Resources(accelerators='tpu-v5p-128', use_spot=True)
+        feats = r.get_required_cloud_features()
+        assert cloud_lib.CloudImplementationFeatures.MULTI_HOST in feats
+        assert cloud_lib.CloudImplementationFeatures.SPOT_INSTANCE in feats
+
+    def test_autostop_forms(self):
+        assert resources_lib.Resources(autostop=True).autostop == {
+            'idle_minutes': 5, 'down': False}
+        assert resources_lib.Resources(autostop=10).autostop == {
+            'idle_minutes': 10, 'down': False}
+        assert resources_lib.Resources(
+            autostop={'idle_minutes': 3, 'down': True}).autostop == {
+                'idle_minutes': 3, 'down': True}
+        assert resources_lib.Resources().autostop is None

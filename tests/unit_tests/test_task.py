@@ -1,0 +1,103 @@
+"""Tests for Task YAML round trip and validation."""
+import textwrap
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import task as task_lib
+
+
+def _yaml_task(tmp_path, content):
+    p = tmp_path / 'task.yaml'
+    p.write_text(textwrap.dedent(content))
+    return task_lib.Task.from_yaml(str(p))
+
+
+class TestTaskYaml:
+
+    def test_basic(self, tmp_path):
+        t = _yaml_task(
+            tmp_path, """\
+            name: train
+            resources:
+              accelerators: tpu-v5e-16
+              use_spot: true
+            setup: pip list
+            run: python train.py
+            envs:
+              MODEL: llama3-8b
+            """)
+        assert t.name == 'train'
+        assert t.num_nodes == 4       # from slice shape
+        assert t.envs['MODEL'] == 'llama3-8b'
+
+    def test_num_nodes_conflict(self, tmp_path):
+        with pytest.raises(exceptions.ResourcesMismatchError):
+            _yaml_task(
+                tmp_path, """\
+                resources:
+                  accelerators: tpu-v5e-16
+                num_nodes: 2
+                """)
+
+    def test_num_nodes_matching_ok(self, tmp_path):
+        t = _yaml_task(
+            tmp_path, """\
+            resources:
+              accelerators: tpu-v5e-16
+            num_nodes: 4
+            """)
+        assert t.num_nodes == 4
+
+    def test_unknown_field(self, tmp_path):
+        with pytest.raises(ValueError, match='Unknown task fields'):
+            _yaml_task(tmp_path, 'runn: echo hi\n')
+
+    def test_round_trip(self, tmp_path):
+        t = _yaml_task(
+            tmp_path, """\
+            name: rt
+            resources:
+              accelerators: tpu-v6e-8
+            run: echo hi
+            envs:
+              A: b
+            """)
+        cfg = t.to_yaml_config()
+        t2 = task_lib.Task.from_yaml_config(cfg)
+        assert t2.to_yaml_config() == cfg
+
+    def test_env_overrides(self, tmp_path):
+        t = _yaml_task(
+            tmp_path, """\
+            run: echo $A
+            envs:
+              A: original
+            """)
+        assert t.envs['A'] == 'original'
+        t2 = task_lib.Task.from_yaml_config(t.to_yaml_config(),
+                                            env_overrides={'A': 'new'})
+        assert t2.envs['A'] == 'new'
+
+    def test_storage_mount_split(self, tmp_path):
+        t = _yaml_task(
+            tmp_path, """\
+            run: ls /data
+            file_mounts:
+              /data: gs://my-bucket/data
+              /ckpt:
+                source: gs://ckpts
+                mode: MOUNT
+            """)
+        assert '/ckpt' in t.storage_mounts
+        assert '/data' in t.storage_mounts   # gs:// URL auto-detected
+        assert t.file_mounts == {}
+
+    def test_invalid_env_name(self):
+        with pytest.raises(ValueError):
+            task_lib.Task(envs={'1BAD': 'x'})
+
+    def test_cpu_task_defaults(self):
+        t = task_lib.Task(run='echo hi')
+        assert t.num_nodes == 1
+        assert t.resources_list()[0].tpu is None

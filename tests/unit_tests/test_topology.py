@@ -1,0 +1,89 @@
+"""Tests for the TPU slice/topology model."""
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.tpu import topology
+
+
+class TestParse:
+
+    def test_basic_v5p(self):
+        sl = topology.parse_tpu_accelerator('tpu-v5p-128')
+        assert sl.generation == 'v5p'
+        assert sl.count == 128
+        assert sl.num_chips == 64          # v5p counts TensorCores
+        assert sl.num_hosts == 16          # 4 chips/host
+        assert sl.num_slices == 1
+        assert len(sl.topology) == 3
+
+    def test_gcp_style_name(self):
+        sl = topology.parse_tpu_accelerator('v5litepod-8')
+        assert sl.generation == 'v5e'
+        assert sl.num_chips == 8
+        assert sl.num_hosts == 1
+
+    def test_v5e_multihost(self):
+        sl = topology.parse_tpu_accelerator('tpu-v5e-16')
+        assert sl.num_chips == 16
+        assert sl.num_hosts == 4           # multi-host v5e = 4 chips/host
+        assert sl.topology == (4, 4)
+
+    def test_v6e_single_host(self):
+        sl = topology.parse_tpu_accelerator('tpu-v6e-8')
+        assert sl.num_hosts == 1
+        assert sl.chips_per_host == 8
+
+    def test_v4(self):
+        sl = topology.parse_tpu_accelerator('tpu-v4-8')
+        assert sl.num_chips == 4
+        assert sl.num_hosts == 1
+        assert sl.topology == (1, 2, 2)
+
+    def test_multislice(self):
+        sl = topology.parse_tpu_accelerator('tpu-v6e-256x4')
+        assert sl.num_slices == 4
+        assert sl.total_chips == 1024
+        assert sl.total_hosts == 256
+        assert sl.name == 'tpu-v6e-256x4'
+
+    def test_topology_override(self):
+        sl = topology.parse_tpu_accelerator('tpu-v4-128', topology='4x4x4')
+        assert sl.topology == (4, 4, 4)
+        assert sl.num_chips == 64
+
+    def test_topology_override_wrong_chips(self):
+        with pytest.raises(exceptions.InvalidTopologyError):
+            topology.parse_tpu_accelerator('tpu-v4-128', topology='2x2x2')
+
+    def test_illegal_count(self):
+        with pytest.raises(exceptions.InvalidTopologyError):
+            topology.parse_tpu_accelerator('tpu-v5e-13')
+
+    def test_not_tpu(self):
+        assert not topology.is_tpu_accelerator('A100')
+        with pytest.raises(exceptions.InvalidTopologyError):
+            topology.parse_tpu_accelerator('A100:8')
+
+
+class TestFacts:
+
+    def test_peak_flops(self):
+        sl = topology.parse_tpu_accelerator('tpu-v6e-8')
+        assert sl.peak_bf16_tflops == pytest.approx(918.0 * 8)
+
+    def test_legal_slices_sorted(self):
+        slices = topology.legal_slices('v5e')
+        chips = [s.num_chips for s in slices]
+        assert chips == sorted(chips)
+        assert chips[0] == 1 and chips[-1] == 256
+
+    def test_device_kind_mapping(self):
+        assert topology.generation_from_device_kind('TPU v5 lite') == 'v5e'
+        assert topology.generation_from_device_kind('TPU v4') == 'v4'
+        assert topology.generation_from_device_kind('cpu') is None
+
+    def test_all_shapes_consistent(self):
+        for gen in topology.GENERATIONS:
+            for sl in topology.legal_slices(gen):
+                assert topology.chips_of(sl.topology) == sl.num_chips
+                assert sl.num_chips % sl.num_hosts == 0
