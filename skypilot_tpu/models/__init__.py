@@ -1,0 +1,22 @@
+"""Model zoo: TPU-first implementations (pure JAX pytrees + pjit sharding).
+
+Reference analog: the `llm/` recipe directory — but where the reference
+launches external torch code, these are native models the framework can
+train/serve directly. `get_config(name)` resolves preset names.
+"""
+from skypilot_tpu.models import llama
+
+_PRESETS = {}
+_PRESETS.update(llama.PRESETS)
+
+
+def get_config(name: str):
+    key = name.lower().replace('_', '-')
+    if key not in _PRESETS:
+        raise ValueError(f'Unknown model preset {name!r}; '
+                         f'known: {sorted(_PRESETS)}')
+    return _PRESETS[key]
+
+
+def list_presets():
+    return sorted(_PRESETS)

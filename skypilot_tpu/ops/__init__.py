@@ -1,0 +1,12 @@
+"""TPU-native op library: norms, rotary embeddings, attention dispatch.
+
+The hot ops are written so XLA tiles them onto the MXU (bf16 einsums, static
+shapes) with fp32 accumulation where it matters; Pallas kernels
+(flash/ring attention) live beside the XLA reference implementations and are
+selected via `attention(..., impl=...)`.
+"""
+from skypilot_tpu.ops.norms import rms_norm
+from skypilot_tpu.ops.rotary import apply_rope, rope_frequencies
+from skypilot_tpu.ops.attention import attention
+
+__all__ = ['rms_norm', 'apply_rope', 'rope_frequencies', 'attention']

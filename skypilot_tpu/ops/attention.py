@@ -1,0 +1,131 @@
+"""Attention dispatch: XLA reference impl, Pallas flash kernel, ring (CP).
+
+Layouts: q [B, S, H, D]; k, v [B, T, KH, D] with H = KH * G (grouped-query).
+Scores accumulate in fp32; output is returned in q.dtype (bf16 on TPU so the
+MXU does the contractions).
+
+impl:
+  'xla'   — einsum + masked softmax; XLA fuses well for moderate S.
+  'flash' — Pallas TPU flash-attention kernel (ops/pallas/flash_attention.py);
+            falls back to 'xla' off-TPU.
+  'ring'  — context-parallel ring attention over the 'sequence' mesh axis
+            (ops/ring_attention.py); requires being inside shard_map.
+  'auto'  — 'flash' on TPU when shapes allow, else 'xla'.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -2.0 ** 30  # large-but-finite: keeps softmax fp32-safe
+
+
+def _repeat_kv(h: jnp.ndarray, groups: int) -> jnp.ndarray:
+    if groups == 1:
+        return h
+    b, t, kh, d = h.shape
+    return jnp.broadcast_to(h[:, :, :, None, :],
+                            (b, t, kh, groups, d)).reshape(b, t, kh * groups, d)
+
+
+def xla_attention(q: jnp.ndarray,
+                  k: jnp.ndarray,
+                  v: jnp.ndarray,
+                  *,
+                  causal: bool = True,
+                  q_offset: int | jnp.ndarray = 0,
+                  kv_offset: int | jnp.ndarray = 0,
+                  segment_ids: Optional[jnp.ndarray] = None,
+                  softmax_scale: Optional[float] = None) -> jnp.ndarray:
+    """Reference attention. q [B,S,H,D], k/v [B,T,KH,D] → [B,S,H,D].
+
+    q_offset/kv_offset are the global positions of q[:,0]/k[:,0] — used both
+    for decode (q_offset=cache_len) and for context-parallel shards.
+    """
+    b, s, h, d = q.shape
+    t, kh = k.shape[1], k.shape[2]
+    groups = h // kh
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+
+    qf = (q * scale).astype(q.dtype)
+    # [B,S,KH,G,D] x [B,T,KH,D] -> [B,KH,G,S,T]
+    qg = qf.reshape(b, s, kh, groups, d)
+    scores = jnp.einsum('bskgd,btkd->bkgst', qg, k,
+                        preferred_element_type=jnp.float32)
+
+    mask = None
+    if causal:
+        q_pos = jnp.arange(s) + q_offset
+        kv_pos = jnp.arange(t) + kv_offset
+        mask = q_pos[:, None] >= kv_pos[None, :]          # [S,T]
+        mask = mask[None, None, None, :, :]
+    if segment_ids is not None:
+        q_seg, kv_seg = segment_ids
+        seg_mask = (q_seg[:, :, None] == kv_seg[:, None, :])  # [B,S,T]
+        seg_mask = seg_mask[:, None, None, :, :]
+        mask = seg_mask if mask is None else jnp.logical_and(mask, seg_mask)
+    if mask is not None:
+        scores = jnp.where(mask, scores, NEG_INF)
+
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum('bkgst,btkd->bskgd', probs, v)
+    return out.reshape(b, s, h, d)
+
+
+def attention(q: jnp.ndarray,
+              k: jnp.ndarray,
+              v: jnp.ndarray,
+              *,
+              impl: str = 'auto',
+              causal: bool = True,
+              q_offset: int | jnp.ndarray = 0,
+              kv_offset: int | jnp.ndarray = 0,
+              segment_ids: Optional[jnp.ndarray] = None,
+              softmax_scale: Optional[float] = None) -> jnp.ndarray:
+    # The Pallas kernel supports neither position offsets nor segment ids;
+    # anything non-trivial routes to the XLA reference implementation.
+    trivial = (isinstance(q_offset, int) and q_offset == 0 and
+               isinstance(kv_offset, int) and kv_offset == 0 and
+               segment_ids is None)
+    if impl == 'auto':
+        impl = 'flash' if (_on_tpu() and _flash_ok(q, k) and trivial) \
+            else 'xla'
+    elif impl == 'flash' and not trivial:
+        impl = 'xla'
+    if impl == 'xla':
+        return xla_attention(q, k, v, causal=causal, q_offset=q_offset,
+                             kv_offset=kv_offset, segment_ids=segment_ids,
+                             softmax_scale=softmax_scale)
+    if impl == 'flash':
+        from skypilot_tpu.ops.pallas import flash_attention
+        return flash_attention.flash_attention(
+            q, k, v, causal=causal, softmax_scale=softmax_scale,
+            interpret=not _on_tpu())
+    if impl == 'ring':
+        try:
+            from skypilot_tpu.ops import ring_attention
+        except ImportError as e:
+            raise NotImplementedError(
+                'ring attention requires skypilot_tpu.ops.ring_attention '
+                '(context-parallel path)') from e
+        return ring_attention.ring_attention(
+            q, k, v, axis_name='sequence', causal=causal,
+            softmax_scale=softmax_scale)
+    raise ValueError(f'Unknown attention impl {impl!r}')
+
+
+@functools.lru_cache(maxsize=1)
+def _on_tpu() -> bool:
+    try:
+        return jax.devices()[0].platform == 'tpu'
+    except Exception:  # pylint: disable=broad-except
+        return False
+
+
+def _flash_ok(q, k) -> bool:
+    # Pallas kernel wants lane-aligned head_dim and block-divisible seq lens.
+    d = q.shape[-1]
+    return d % 128 == 0 and q.shape[1] % 128 == 0 and k.shape[1] % 128 == 0

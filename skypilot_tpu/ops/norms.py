@@ -1,0 +1,18 @@
+"""RMSNorm with fp32 statistics, bf16 in/out (XLA fuses this into one pass)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, scale: jnp.ndarray, eps: float = 1e-5,
+             scale_plus_one: bool = False) -> jnp.ndarray:
+    """y = x / rms(x) * scale, computed in fp32, returned in x.dtype."""
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    normed = xf * jax.lax.rsqrt(var + eps)
+    s = scale.astype(jnp.float32)
+    if scale_plus_one:
+        s = s + 1.0
+    return (normed * s).astype(dtype)

@@ -1,0 +1,6 @@
+"""Pallas TPU kernels — the framework's native compute components.
+
+These replace what GPU frameworks ship as CUDA kernels: flash attention
+(fwd+bwd), and the building blocks for ring attention's per-step compute.
+All kernels run in interpret mode on CPU for hermetic tests.
+"""

@@ -1,0 +1,339 @@
+"""Flash attention (fwd + bwd) as Pallas TPU kernels.
+
+Own-design kernels following the standard online-softmax tiling:
+  - fwd: grid (B, H, nq, nk), kv innermost; VMEM scratch accumulators
+    (acc, m, l) persist across the kv dimension; causal blocks above the
+    diagonal are skipped with `pl.when`.
+  - bwd: two kernels — dq with grid (B, H, nq, nk) and dkv with grid
+    (B, H, nk, nq) — both recompute p = exp(s - lse) from the saved
+    log-sum-exp, so no S×S tensor ever hits HBM.
+  - GQA: kv blocks are index-mapped per q-head (h → h // group) in fwd/dq;
+    dkv produces per-q-head dk/dv which the wrapper group-sums.
+
+Layouts: wrapper takes [B, S, H, D] (model layout), kernels run [B, H, S, D].
+Row statistics (m, l, lse, delta) are lane-replicated [.., S, 128] f32 —
+the Mosaic-friendly layout for per-row scalars.
+
+Residual memory: lse + delta cost 2·B·H·S·128·4 bytes; for context-parallel
+long sequences each device only holds its S/cp shard (ring attention calls
+this kernel per shard), keeping that bounded.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+NEG_INF = -2.0 ** 30
+
+
+def _block_size(s: int, preferred: int) -> int:
+    for cand in (preferred, 512, 256, 128):
+        if cand <= s and s % cand == 0:
+            return cand
+    raise ValueError(f'seq len {s} must be a multiple of 128')
+
+
+def _lane_tile(x: jnp.ndarray, n: int) -> jnp.ndarray:
+    """Tile a (rows, LANES) lane-replicated stat out to (rows, n)."""
+    assert n % LANES == 0
+    return jnp.tile(x, (1, n // LANES))
+
+
+def _causal_mask(s: jnp.ndarray, qi, ki, block_q: int, block_k: int):
+    rows = jax.lax.broadcasted_iota(jnp.int32, s.shape, 0) + qi * block_q
+    cols = jax.lax.broadcasted_iota(jnp.int32, s.shape, 1) + ki * block_k
+    return jnp.where(cols <= rows, s, NEG_INF)
+
+
+# ---------------------------------------------------------------------------
+# Forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
+                *, causal: bool, block_q: int, block_k: int, nk: int):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    if causal:
+        relevant = (qi + 1) * block_q > ki * block_k
+        last_ki = ((qi + 1) * block_q - 1) // block_k
+    else:
+        relevant = True
+        last_ki = nk - 1
+
+    @pl.when(relevant)
+    def _body():
+        q = q_ref[0, 0]                                    # (Bq, D)
+        k = k_ref[0, 0]                                    # (Bk, D)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+
+        m_prev = m_scr[...]                                # (Bq, LANES)
+        l_prev = l_scr[...]
+        m_cur = jnp.max(s, axis=1)[:, None]                # (Bq, 1)
+        m_next = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_next)
+        p = jnp.exp(s - _lane_tile(m_next, s.shape[1]))
+        l_next = l_prev * alpha + jnp.sum(p, axis=1)[:, None]
+        m_scr[...] = m_next
+        l_scr[...] = l_next
+
+        v = v_ref[0, 0]                                    # (Bk, D)
+        pv = jax.lax.dot(p.astype(v.dtype), v,
+                         preferred_element_type=jnp.float32)
+        acc_scr[...] = acc_scr[...] * _lane_tile(alpha, acc_scr.shape[1]) + pv
+
+    @pl.when(ki == last_ki)
+    def _finalize():
+        l = l_scr[...]
+        l_inv = jnp.where(l == 0.0, 1.0, 1.0 / l)
+        o_ref[0, 0] = (acc_scr[...] *
+                       _lane_tile(l_inv, acc_scr.shape[1])).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_scr[...] + jnp.log(jnp.maximum(l, 1e-30))
+
+
+def _fwd(q, k, v, causal, block_q, block_k, interpret):
+    b, h, s, d = q.shape
+    kh, t = k.shape[1], k.shape[2]
+    g = h // kh
+    bq, bk = _block_size(s, block_q), _block_size(t, block_k)
+    nq, nk = s // bq, t // bk
+
+    grid = (b, h, nq, nk)
+    kernel = functools.partial(_fwd_kernel, causal=causal, block_q=bq,
+                               block_k=bk, nk=nk)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h_, qi, ki: (b_, h_ // g, ki, 0)),
+            pl.BlockSpec((1, 1, bk, d),
+                         lambda b_, h_, qi, ki: (b_, h_ // g, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+            pl.BlockSpec((1, 1, bq, LANES),
+                         lambda b_, h_, qi, ki: (b_, h_, qi, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, s, d), q.dtype),
+            jax.ShapeDtypeStruct((b, h, s, LANES), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, LANES), jnp.float32),
+            pltpu.VMEM((bq, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=('parallel', 'parallel', 'parallel',
+                                 'arbitrary')),
+        interpret=interpret,
+    )(q, k, v)
+    return out, lse
+
+
+# ---------------------------------------------------------------------------
+# Backward
+# ---------------------------------------------------------------------------
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+               dq_scr, *, causal: bool, block_q: int, block_k: int, nk: int):
+    qi, ki = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    if causal:
+        relevant = (qi + 1) * block_q > ki * block_k
+        last_ki = ((qi + 1) * block_q - 1) // block_k
+    else:
+        relevant = True
+        last_ki = nk - 1
+
+    @pl.when(relevant)
+    def _body():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        p = jnp.exp(s - _lane_tile(lse_ref[0, 0], s.shape[1]))
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - _lane_tile(delta_ref[0, 0], s.shape[1]))
+        dq_scr[...] += jax.lax.dot(ds.astype(k.dtype), k,
+                                   preferred_element_type=jnp.float32)
+
+    @pl.when(ki == last_ki)
+    def _finalize():
+        dq_ref[0, 0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr,
+                *, causal: bool, block_q: int, block_k: int, nq: int):
+    ki, qi = pl.program_id(2), pl.program_id(3)
+
+    @pl.when(qi == (ki * block_k) // block_q if causal else qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    relevant = (qi + 1) * block_q > ki * block_k if causal else True
+
+    @pl.when(relevant)
+    def _body():
+        q = q_ref[0, 0]
+        k = k_ref[0, 0]
+        v = v_ref[0, 0]
+        do = do_ref[0, 0]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            s = _causal_mask(s, qi, ki, block_q, block_k)
+        p = jnp.exp(s - _lane_tile(lse_ref[0, 0], s.shape[1]))
+        # dv += pᵀ · do  (contract the q dim without materialising pᵀ)
+        dv_scr[...] += jax.lax.dot_general(
+            p.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - _lane_tile(delta_ref[0, 0], s.shape[1]))
+        dk_scr[...] += jax.lax.dot_general(
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _finalize():
+        dk_ref[0, 0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, out, lse, do, causal, block_q, block_k, interpret):
+    b, h, s, d = q.shape
+    kh, t = k.shape[1], k.shape[2]
+    g = h // kh
+    bq, bk = _block_size(s, block_q), _block_size(t, block_k)
+    nq, nk = s // bq, t // bk
+
+    delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
+                    axis=-1, keepdims=True)                  # [B,H,S,1]
+    delta = jnp.broadcast_to(delta, (b, h, s, LANES))
+
+    q_spec = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0))
+    kv_spec = pl.BlockSpec((1, 1, bk, d),
+                           lambda b_, h_, qi, ki: (b_, h_ // g, ki, 0))
+    stat_spec = pl.BlockSpec((1, 1, bq, LANES),
+                             lambda b_, h_, qi, ki: (b_, h_, qi, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, causal=causal, block_q=bq, block_k=bk,
+                          nk=nk),
+        grid=(b, h, nq, nk),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, stat_spec, stat_spec],
+        out_specs=[q_spec],
+        out_shape=[jax.ShapeDtypeStruct((b, h, s, d), q.dtype)],
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=('parallel', 'parallel', 'parallel',
+                                 'arbitrary')),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)[0]
+
+    # dkv grid: (B, H, nk, nq) — q innermost, kv-block accumulators.
+    q_spec2 = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, ki, qi: (b_, h_, qi, 0))
+    kv_spec2 = pl.BlockSpec((1, 1, bk, d),
+                            lambda b_, h_, ki, qi: (b_, h_ // g, ki, 0))
+    kv_out_spec2 = pl.BlockSpec((1, 1, bk, d),
+                                lambda b_, h_, ki, qi: (b_, h_, ki, 0))
+    stat_spec2 = pl.BlockSpec((1, 1, bq, LANES),
+                              lambda b_, h_, ki, qi: (b_, h_, qi, 0))
+    dk_exp, dv_exp = pl.pallas_call(
+        functools.partial(_dkv_kernel, causal=causal, block_q=bq, block_k=bk,
+                          nq=nq),
+        grid=(b, h, nk, nq),
+        in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, stat_spec2,
+                  stat_spec2],
+        out_specs=[kv_out_spec2, kv_out_spec2],
+        out_shape=[jax.ShapeDtypeStruct((b, h, t, d), k.dtype),
+                   jax.ShapeDtypeStruct((b, h, t, d), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
+                        pltpu.VMEM((bk, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=('parallel', 'parallel', 'parallel',
+                                 'arbitrary')),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+
+    if g > 1:
+        dk = dk_exp.reshape(b, kh, g, t, d).sum(axis=2)
+        dv = dv_exp.reshape(b, kh, g, t, d).sum(axis=2)
+    else:
+        dk, dv = dk_exp, dv_exp
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# Public wrapper ([B, S, H, D] layout, custom VJP)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, block_q, block_k, interpret):
+    out, _ = _fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret):
+    out, lse = _fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_bwd_rule(causal, block_q, block_k, interpret, res, do):
+    q, k, v, out, lse = res
+    dq, dk, dv = _bwd(q, k, v, out, lse, do, causal, block_q, block_k,
+                      interpret)
+    return dq, dk, dv
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(q: jnp.ndarray,
+                    k: jnp.ndarray,
+                    v: jnp.ndarray,
+                    *,
+                    causal: bool = True,
+                    softmax_scale: Optional[float] = None,
+                    block_q: int = 512,
+                    block_k: int = 512,
+                    interpret: bool = False) -> jnp.ndarray:
+    """q [B,S,H,D], k/v [B,T,KH,D] → [B,S,H,D]; differentiable."""
+    d = q.shape[-1]
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+    # Pre-scale q: s = (scale·q)·kᵀ, and dk = dsᵀ·(scale·q) comes out right;
+    # dq needs the extra `scale` which the chain rule applies automatically
+    # through this multiplication.
+    qh = (q * scale).swapaxes(1, 2)                 # [B,H,S,D]
+    kh_ = k.swapaxes(1, 2)                          # [B,KH,T,D]
+    vh = v.swapaxes(1, 2)
+    out = _flash(qh, kh_, vh, causal, block_q, block_k, interpret)
+    return out.swapaxes(1, 2)

@@ -1,0 +1,57 @@
+"""Rotary position embeddings (RoPE), Llama-3 scaling supported.
+
+Computed on the fly from integer positions so context-parallel shards can
+pass their own (global) position offsets — required by ring attention where
+each sequence shard sees positions [i*S/cp, (i+1)*S/cp).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int,
+                     positions: jnp.ndarray,
+                     theta: float = 10000.0,
+                     scaling: Optional[dict] = None
+                     ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Return (sin, cos) of shape positions.shape + (head_dim // 2,), fp32.
+
+    `scaling`: optional llama-3.1 style NTK config with keys
+    {factor, low_freq_factor, high_freq_factor, original_max_position}.
+    """
+    half = head_dim // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    if scaling:
+        factor = float(scaling['factor'])
+        low = float(scaling.get('low_freq_factor', 1.0))
+        high = float(scaling.get('high_freq_factor', 4.0))
+        orig = float(scaling.get('original_max_position', 8192))
+        wavelen = 2.0 * jnp.pi / freqs
+        ratio = orig / wavelen
+        smooth = jnp.clip((ratio - low) / (high - low), 0.0, 1.0)
+        scaled = freqs / factor
+        freqs = jnp.where(ratio < low, scaled,
+                          jnp.where(ratio > high, freqs,
+                                    (1 - smooth) * scaled + smooth * freqs))
+    angles = positions.astype(jnp.float32)[..., None] * freqs
+    return jnp.sin(angles), jnp.cos(angles)
+
+
+def apply_rope(x: jnp.ndarray, sin: jnp.ndarray,
+               cos: jnp.ndarray) -> jnp.ndarray:
+    """Rotate x [..., S, H, D] by per-position (sin, cos) [..., S, D/2].
+
+    Uses the split-halves convention (HF Llama): x = [x1, x2],
+    out = [x1*cos - x2*sin, x2*cos + x1*sin]. fp32 rotate, cast back.
+    """
+    dtype = x.dtype
+    half = x.shape[-1] // 2
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    # sin/cos are [..., S, D/2]; insert the heads axis.
+    s = sin[..., None, :]
+    c = cos[..., None, :]
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(dtype)

@@ -1,0 +1,10 @@
+"""Parallelism layer: named meshes, logical sharding rules, SPMD helpers."""
+from skypilot_tpu.parallel.mesh import (MESH_AXES, MeshSpec, build_mesh,
+                                        single_device_mesh)
+from skypilot_tpu.parallel.sharding import (DEFAULT_RULES, Rules, constrain,
+                                            shardings_like, tree_shardings)
+
+__all__ = [
+    'MESH_AXES', 'MeshSpec', 'build_mesh', 'single_device_mesh',
+    'DEFAULT_RULES', 'Rules', 'constrain', 'shardings_like', 'tree_shardings',
+]

@@ -1,0 +1,151 @@
+"""Logical-axis sharding rules → PartitionSpecs (GSPMD style).
+
+Model code names tensor dims with *logical* axes ('batch', 'embed', 'heads',
+...); a rule table maps each logical axis to zero or more mesh axes. This is
+the MaxText/flax `logical_axis_rules` pattern, implemented standalone so the
+models stay pure JAX pytrees.
+
+The reference has no analog (parallelism lives in launched recipes, SURVEY
+§2.11); this module is the TPU-native replacement for torchrun+NCCL wiring.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence, Tuple, Union
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+AxisRule = Tuple[str, Union[None, str, Tuple[str, ...]]]
+
+# Default rule table. Order matters only for readability; lookups are exact.
+#   - 'batch' spans data+fsdp (pure DP when fsdp=1, else ZeRO-style).
+#   - params' 'embed'/'mlp'/'heads' shard over fsdp/tensor → per-layer
+#     all-gather under scan (FSDP) + megatron-style TP contractions.
+#   - 'seq' is the context-parallel axis (ring attention, §ops/ring_attention).
+DEFAULT_RULES: Tuple[AxisRule, ...] = (
+    ('batch', ('data', 'fsdp')),
+    ('seq', 'sequence'),
+    ('embed', 'fsdp'),
+    ('heads', 'tensor'),
+    ('kv_heads', 'tensor'),
+    ('mlp', 'tensor'),
+    ('vocab', 'tensor'),
+    ('expert', 'expert'),
+    ('layers', None),
+    ('stage', 'stage'),
+    ('act_embed', None),
+    ('act_heads', 'tensor'),
+    ('head_dim', None),
+    ('norm', None),
+)
+
+
+class Rules:
+    """Immutable logical→mesh axis mapping with overrides."""
+
+    def __init__(self, rules: Sequence[AxisRule] = DEFAULT_RULES):
+        self._map: Dict[str, Union[None, Tuple[str, ...]]] = {}
+        for name, axes in rules:
+            if axes is None:
+                self._map[name] = None
+            elif isinstance(axes, str):
+                self._map[name] = (axes,)
+            else:
+                self._map[name] = tuple(axes)
+
+    def override(self, **kwargs) -> 'Rules':
+        new = Rules(())
+        new._map = dict(self._map)
+        for name, axes in kwargs.items():
+            if axes is None or isinstance(axes, tuple):
+                new._map[name] = axes
+            else:
+                new._map[name] = (axes,)
+        return new
+
+    def mesh_axes(self, logical: Optional[str]) -> Union[None, Tuple[str, ...]]:
+        if logical is None:
+            return None
+        if logical not in self._map:
+            raise KeyError(f'No sharding rule for logical axis {logical!r}; '
+                           f'known: {sorted(self._map)}')
+        return self._map[logical]
+
+    def spec(self, *logical_axes: Optional[str],
+             mesh: Optional[Mesh] = None) -> PartitionSpec:
+        """PartitionSpec for a tensor whose dims have these logical names.
+
+        If `mesh` is given, mesh axes of size 1 are dropped (cosmetic) and a
+        mesh axis is dropped when it does not divide — divisibility is
+        enforced at the call site instead (models validate their configs).
+        """
+        entries = []
+        used = set()
+        for name in logical_axes:
+            axes = self.mesh_axes(name)
+            if axes is None:
+                entries.append(None)
+                continue
+            kept = []
+            for ax in axes:
+                if ax in used:
+                    raise ValueError(
+                        f'Mesh axis {ax!r} used twice in spec for '
+                        f'{logical_axes}')
+                if mesh is not None and mesh.shape.get(ax, 1) == 1:
+                    continue
+                used.add(ax)
+                kept.append(ax)
+            if not kept:
+                entries.append(None)
+            elif len(kept) == 1:
+                entries.append(kept[0])
+            else:
+                entries.append(tuple(kept))
+        while entries and entries[-1] is None:
+            entries.pop()
+        return PartitionSpec(*entries)
+
+    def sharding(self, mesh: Mesh, *logical_axes: Optional[str]) -> NamedSharding:
+        return NamedSharding(mesh, self.spec(*logical_axes, mesh=mesh))
+
+
+def constrain(x: jax.Array, *logical_axes: Optional[str],
+              rules: Rules) -> jax.Array:
+    """with_sharding_constraint by logical names; no-op outside a mesh ctx."""
+    spec = rules.spec(*logical_axes)
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except (ValueError, RuntimeError):
+        # Not under a mesh context (e.g. pure single-device eager) — skip.
+        return x
+
+
+def tree_shardings(mesh: Mesh, spec_tree):
+    """Map a pytree of PartitionSpec → pytree of NamedSharding."""
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s),
+        spec_tree,
+        is_leaf=lambda s: isinstance(s, PartitionSpec))
+
+
+def shardings_like(mesh: Mesh, spec_tree, shape_tree):
+    """Shardings for an arbitrary pytree (e.g. optax state) by matching leaf
+    shapes against a reference (params) tree.
+
+    optax states embed copies of the param tree (mu/nu) plus scalars; leaves
+    whose shape matches a param leaf inherit its spec, scalars and unknown
+    shapes are replicated.
+    """
+    by_shape: Dict[Tuple[int, ...], PartitionSpec] = {}
+    for spec, leaf in zip(
+            jax.tree.leaves(spec_tree,
+                            is_leaf=lambda s: isinstance(s, PartitionSpec)),
+            jax.tree.leaves(shape_tree)):
+        by_shape.setdefault(tuple(leaf.shape), spec)
+
+    def _leaf(leaf):
+        spec = by_shape.get(tuple(getattr(leaf, 'shape', ())), PartitionSpec())
+        return NamedSharding(mesh, spec)
+
+    return _leaf

@@ -1,0 +1,6 @@
+"""Training layer: sharded train state, pjit train step, data pipeline."""
+from skypilot_tpu.train.train_lib import (TrainState, cross_entropy_loss,
+                                          make_train_step, init_train_state)
+
+__all__ = ['TrainState', 'cross_entropy_loss', 'make_train_step',
+           'init_train_state']

@@ -1,0 +1,67 @@
+"""Pallas flash-attention kernel vs XLA reference (interpret mode on CPU)."""
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.ops.attention import xla_attention
+from skypilot_tpu.ops.pallas.flash_attention import flash_attention
+
+B, S, H, KH, D = 1, 256, 4, 2, 128
+
+
+@pytest.fixture(scope='module')
+def qkv():
+    kq, kk, kv = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(kq, (B, S, H, D)).astype(jnp.bfloat16)
+    k = jax.random.normal(kk, (B, S, KH, D)).astype(jnp.bfloat16)
+    v = jax.random.normal(kv, (B, S, KH, D)).astype(jnp.bfloat16)
+    return q, k, v
+
+
+FLASH = functools.partial(flash_attention, interpret=True, block_q=128,
+                          block_k=128)
+
+
+def _err(a, b):
+    return float(jnp.max(jnp.abs(a.astype(jnp.float32) -
+                                 b.astype(jnp.float32))))
+
+
+@pytest.mark.parametrize('causal', [True, False])
+def test_forward_matches_reference(qkv, causal):
+    q, k, v = qkv
+    ref = xla_attention(q, k, v, causal=causal)
+    out = FLASH(q, k, v, causal=causal)
+    assert out.shape == ref.shape
+    assert _err(ref, out) < 3e-2
+
+
+def test_backward_matches_reference(qkv):
+    q, k, v = qkv
+
+    def loss(fn, q, k, v):
+        return (fn(q, k, v, causal=True).astype(jnp.float32) ** 2).sum()
+
+    gr = jax.grad(functools.partial(loss, xla_attention),
+                  argnums=(0, 1, 2))(q, k, v)
+    gf = jax.grad(functools.partial(loss, FLASH), argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gr, gf):
+        mag = float(jnp.max(jnp.abs(a.astype(jnp.float32)))) + 1e-6
+        assert _err(a, b) / mag < 2e-2
+
+
+def test_mha_no_gqa(qkv):
+    q, _, _ = qkv
+    kk, kv = jax.random.split(jax.random.PRNGKey(1))
+    k = jax.random.normal(kk, (B, S, H, D)).astype(jnp.bfloat16)
+    v = jax.random.normal(kv, (B, S, H, D)).astype(jnp.bfloat16)
+    assert _err(xla_attention(q, k, v), FLASH(q, k, v)) < 3e-2
+
+
+def test_bad_seq_len_raises(qkv):
+    q, k, v = qkv
+    with pytest.raises(ValueError):
+        FLASH(q[:, :100], k[:, :100], v[:, :100])

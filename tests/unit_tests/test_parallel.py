@@ -1,0 +1,69 @@
+"""Tests for the mesh/sharding layer (8 virtual CPU devices)."""
+import jax
+import pytest
+from jax.sharding import PartitionSpec
+
+from skypilot_tpu.parallel import MeshSpec, Rules, build_mesh
+from skypilot_tpu.parallel.mesh import MESH_AXES
+
+
+class TestMeshSpec:
+
+    def test_fill_axis(self):
+        assert MeshSpec(data=2, fsdp=-1, tensor=2).sizes(8) == (
+            2, 1, 2, 1, 1, 2)
+
+    def test_explicit(self):
+        assert MeshSpec(data=1, fsdp=8).sizes(8)[2] == 8
+
+    def test_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            MeshSpec(data=3, fsdp=1).sizes(8)
+
+    def test_two_fill_raises(self):
+        with pytest.raises(ValueError):
+            MeshSpec(data=-1, fsdp=-1).sizes(8)
+
+    def test_build_mesh_cpu(self):
+        mesh = build_mesh(MeshSpec(data=2, fsdp=2, tensor=2), platform='cpu')
+        assert mesh.axis_names == MESH_AXES
+        assert mesh.shape['data'] == 2
+        assert mesh.shape['tensor'] == 2
+        assert mesh.devices.size == 8
+
+    def test_nontrivial_axes(self):
+        spec = MeshSpec(data=2, fsdp=-1)
+        assert spec.nontrivial_axes(8) == ('data', 'fsdp')
+
+
+class TestRules:
+
+    def test_default_batch(self):
+        r = Rules()
+        assert r.spec('batch', 'seq') == PartitionSpec(('data', 'fsdp'),
+                                                       'sequence')
+
+    def test_trailing_none_trimmed(self):
+        r = Rules()
+        assert r.spec('embed', 'norm') == PartitionSpec('fsdp')
+
+    def test_override(self):
+        r = Rules().override(embed=None, batch='data')
+        assert r.spec('embed') == PartitionSpec()
+        assert r.spec('batch') == PartitionSpec('data')
+
+    def test_unknown_axis_raises(self):
+        with pytest.raises(KeyError):
+            Rules().spec('nope')
+
+    def test_mesh_size1_dropped(self):
+        mesh = build_mesh(MeshSpec(fsdp=8), platform='cpu')
+        r = Rules()
+        # tensor axis has size 1 → dropped from the spec.
+        assert r.spec('mlp', mesh=mesh) == PartitionSpec()
+        assert r.spec('embed', mesh=mesh) == PartitionSpec('fsdp')
+
+    def test_duplicate_mesh_axis_raises(self):
+        r = Rules().override(seq='fsdp')
+        with pytest.raises(ValueError):
+            r.spec('embed', 'seq')
