@@ -2,12 +2,16 @@
 
 Reference analog: the `llm/` recipe directory — but where the reference
 launches external torch code, these are native models the framework can
-train/serve directly. `get_config(name)` resolves preset names.
+train/serve directly. `get_config(name)` resolves preset names;
+`module_for(cfg)` maps a config to its model module (init_params /
+param_specs / forward / validate_divisibility).
 """
 from skypilot_tpu.models import llama
+from skypilot_tpu.models import moe
 
 _PRESETS = {}
 _PRESETS.update(llama.PRESETS)
+_PRESETS.update(moe.PRESETS)
 
 
 def get_config(name: str):
@@ -20,3 +24,12 @@ def get_config(name: str):
 
 def list_presets():
     return sorted(_PRESETS)
+
+
+def module_for(cfg):
+    """Model module implementing this config (most-derived class wins)."""
+    if isinstance(cfg, moe.MoEConfig):
+        return moe
+    if isinstance(cfg, llama.LlamaConfig):
+        return llama
+    raise TypeError(f'No model module for config type {type(cfg)!r}')

@@ -45,6 +45,8 @@ class LlamaConfig:
     remat: str = 'full'                     # 'none' | 'dots' | 'full'
     attention_impl: str = 'auto'            # ops.attention impl
     scan_layers: bool = True
+    pipeline_stages: int = 1                # >1: GPipe over the 'stage' axis
+    num_microbatches: int = 1               # PP microbatches (divides batch)
 
     @property
     def hd(self) -> int:
@@ -123,6 +125,8 @@ def param_specs(cfg: LlamaConfig,
                 rules: Optional[sharding_lib.Rules] = None) -> Params:
     """Pytree of PartitionSpec mirroring init_params' structure."""
     r = rules or sharding_lib.Rules()
+    if cfg.pipeline_stages > 1:
+        r = r.override(layers='stage')
     s = r.spec
     specs: Params = {
         'embed': s('vocab', 'embed'),
@@ -163,14 +167,67 @@ def validate_divisibility(cfg: LlamaConfig, mesh_shape: Dict[str, int]):
 # Forward
 # ---------------------------------------------------------------------------
 
-def _layer(x: jnp.ndarray, lp: Params, cfg: LlamaConfig,
-           rules: sharding_lib.Rules, sin: jnp.ndarray, cos: jnp.ndarray,
-           q_offset) -> jnp.ndarray:
+def _pipelined_layers(x, layers, layer_fn, cfg: LlamaConfig):
+    """GPipe the layer stack over the 'stage' mesh axis (parallel.pipeline)."""
+    from jax.sharding import PartitionSpec as P
+    from skypilot_tpu.parallel import pipeline as pipeline_lib
+    if cfg.attention_impl == 'ring':
+        raise NotImplementedError(
+            'pipeline_stages>1 with ring attention would nest the sequence '
+            'shard_map inside the stage shard_map — not supported yet')
     b, s_len, d = x.shape
+    m = cfg.num_microbatches
+    if b % m != 0:
+        raise ValueError(f'batch {b} not divisible by num_microbatches {m}')
+    if cfg.n_layers % cfg.pipeline_stages != 0:
+        raise ValueError(f'n_layers {cfg.n_layers} not divisible by '
+                         f'pipeline_stages {cfg.pipeline_stages}')
+    # f32 at the shard_map boundary off-TPU only: the replicated input's
+    # cotangent is a psum over 'stage', and XLA CPU crashes promoting bf16
+    # all-reduces. On TPU keep bf16 (half the boundary/psum traffic).
+    from skypilot_tpu.ops.attention import _on_tpu
+    boundary_dtype = x.dtype if _on_tpu() else jnp.float32
+
+    xm = x.reshape(m, b // m, s_len, d).astype(boundary_dtype)
+
+    def sm_fn(layers_local, xm_local):
+        out = pipeline_lib.pipeline_apply(layer_fn, layers_local,
+                                          xm_local.astype(x.dtype))
+        return out.astype(boundary_dtype)
+
+    out = jax.shard_map(sm_fn, in_specs=(P('stage'), P()), out_specs=P(),
+                        axis_names={'stage'}, check_vma=False)(layers, xm)
+    return out.reshape(b, s_len, d).astype(x.dtype)
+
+
+def _ring_attention_sharded(q, k, v):
+    """Context-parallel attention: manual only over the 'sequence' mesh axis
+    (shard_map), GSPMD keeps handling batch/tensor axes."""
+    from skypilot_tpu.ops import ring_attention as ring_lib
+    from skypilot_tpu.ops.attention import _on_tpu
+    from jax.sharding import PartitionSpec as P
+    import functools as _ft
+    fn = _ft.partial(ring_lib.ring_attention, causal=True,
+                     interpret=not _on_tpu())
+    spec = P(None, 'sequence')
+    # check_vma=False: the causal 'skip' branch returns constants that the
+    # varying-axis checker would reject; semantics are still per-shard.
+    return jax.shard_map(fn, in_specs=(spec, spec, spec), out_specs=spec,
+                         axis_names={'sequence'}, check_vma=False)(q, k, v)
+
+
+def attention_block(x: jnp.ndarray, lp: Params, cfg: LlamaConfig,
+                    rules: sharding_lib.Rules, sin: jnp.ndarray,
+                    cos: jnp.ndarray, q_offset,
+                    norm_key: str = 'attn_norm') -> jnp.ndarray:
+    """Pre-norm attention sublayer (shared by the dense and MoE models):
+    rms_norm → qkv → rope → attention (xla/flash/ring) → wo. Returns the
+    residual branch (caller adds it to x)."""
+    b, s_len, _ = x.shape
     hd = cfg.hd
     con = functools.partial(sharding_lib.constrain, rules=rules)
 
-    h = norms.rms_norm(x, lp['attn_norm'], cfg.rms_eps)
+    h = norms.rms_norm(x, lp[norm_key], cfg.rms_eps)
     q = jnp.einsum('bsd,dh->bsh', h, lp['wq'].astype(cfg.dtype))
     kk = jnp.einsum('bsd,dh->bsh', h, lp['wk'].astype(cfg.dtype))
     vv = jnp.einsum('bsd,dh->bsh', h, lp['wv'].astype(cfg.dtype))
@@ -180,12 +237,22 @@ def _layer(x: jnp.ndarray, lp: Params, cfg: LlamaConfig,
     q = con(q, 'batch', 'seq', 'act_heads', 'head_dim')
     q = rotary.apply_rope(q, sin, cos)
     kk = rotary.apply_rope(kk, sin, cos)
-    out = _attention(q, kk, vv, impl=cfg.attention_impl,
-                                  causal=True, q_offset=q_offset,
-                                  kv_offset=q_offset)
+    if cfg.attention_impl == 'ring':
+        out = _ring_attention_sharded(q, kk, vv)
+    else:
+        out = _attention(q, kk, vv, impl=cfg.attention_impl,
+                         causal=True, q_offset=q_offset,
+                         kv_offset=q_offset)
     out = out.reshape(b, s_len, cfg.n_heads * hd)
     attn_out = jnp.einsum('bsh,hd->bsd', out, lp['wo'].astype(cfg.dtype))
-    x = x + con(attn_out, 'batch', 'seq', 'act_embed')
+    return con(attn_out, 'batch', 'seq', 'act_embed')
+
+
+def _layer(x: jnp.ndarray, lp: Params, cfg: LlamaConfig,
+           rules: sharding_lib.Rules, sin: jnp.ndarray, cos: jnp.ndarray,
+           q_offset) -> jnp.ndarray:
+    con = functools.partial(sharding_lib.constrain, rules=rules)
+    x = x + attention_block(x, lp, cfg, rules, sin, cos, q_offset)
 
     h = norms.rms_norm(x, lp['mlp_norm'], cfg.rms_eps)
     gate = jnp.einsum('bsd,df->bsf', h, lp['w_gate'].astype(cfg.dtype))
@@ -234,7 +301,9 @@ def forward(params: Params,
         policy = getattr(jax.checkpoint_policies, policy_name)
         layer_fn = jax.checkpoint(layer_fn, policy=policy)
 
-    if cfg.scan_layers:
+    if cfg.pipeline_stages > 1:
+        x = _pipelined_layers(x, params['layers'], layer_fn, cfg)
+    elif cfg.scan_layers:
         def body(carry, lp):
             return layer_fn(carry, lp), None
         x, _ = jax.lax.scan(body, x, params['layers'])

@@ -1,0 +1,263 @@
+"""Mixture-of-Experts decoder (Mixtral-family), TPU-first with expert
+parallelism.
+
+Reference analog: the reference only *launches* MoE models via recipes
+(llm/mixtral/, llm/dbrx/ — SURVEY §2.11); here the model is native.
+
+Design: GShard/Switch-style dense dispatch — routing is expressed as
+einsums against one-hot dispatch/combine tensors with a static per-expert
+capacity, so the whole MoE layer is static-shaped and XLA turns the
+dispatch contractions into all-to-alls over the 'expert' mesh axis.
+Top-k routing with a load-balance auxiliary loss; experts are SwiGLU FFNs
+stacked on a leading expert dim sharded over 'expert'.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.models import llama as llama_lib
+from skypilot_tpu.ops import norms, rotary
+from skypilot_tpu.parallel import sharding as sharding_lib
+
+Params = Dict[str, Any]
+
+# train_lib contract: forward(..., return_aux=True) yields (logits, aux).
+HAS_AUX = True
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig(llama_lib.LlamaConfig):
+    n_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    router_aux_weight: float = 0.02
+    # Tokens are routed within fixed-size groups along the sequence (GShard),
+    # so dispatch tensors stay O(S·C_group) instead of O(S²·K/E).
+    router_group_size: int = 2048
+
+    @property
+    def num_params(self) -> int:
+        hd = self.hd
+        a = 2 + 2 * (self.n_kv_heads / self.n_heads)
+        attn = int(a * self.dim * self.n_heads * hd)
+        moe = self.n_experts * 3 * self.dim * self.ffn_dim
+        router = self.dim * self.n_experts
+        per_layer = attn + moe + router + 2 * self.dim
+        embed = self.vocab_size * self.dim * (1 if self.tie_embeddings else 2)
+        return self.n_layers * per_layer + embed + self.dim
+
+    @property
+    def active_params(self) -> int:
+        """Params touched per token (for MFU accounting)."""
+        hd = self.hd
+        a = 2 + 2 * (self.n_kv_heads / self.n_heads)
+        attn = int(a * self.dim * self.n_heads * hd)
+        moe = self.top_k * 3 * self.dim * self.ffn_dim
+        per_layer = attn + moe + self.dim * self.n_experts + 2 * self.dim
+        embed = self.vocab_size * self.dim * (1 if self.tie_embeddings else 2)
+        return self.n_layers * per_layer + embed + self.dim
+
+
+PRESETS: Dict[str, MoEConfig] = {
+    'moe-debug': MoEConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
+                           n_kv_heads=2, ffn_dim=128, max_seq_len=128,
+                           rope_theta=10000.0, remat='none', n_experts=4,
+                           top_k=2),
+    'mixtral-8x7b': MoEConfig(vocab_size=32000, dim=4096, n_layers=32,
+                              n_heads=32, n_kv_heads=8, ffn_dim=14336,
+                              rope_theta=1e6, max_seq_len=32768, n_experts=8,
+                              top_k=2),
+    # ~1B-active MoE for single-chip benchmarking.
+    'moe-1b': MoEConfig(vocab_size=32768, dim=1024, n_layers=12, n_heads=8,
+                        n_kv_heads=4, ffn_dim=4096, max_seq_len=4096,
+                        tie_embeddings=True, n_experts=8, top_k=2),
+}
+
+
+def capacity(cfg: MoEConfig, seq_len: int) -> int:
+    c = int(cfg.capacity_factor * seq_len * cfg.top_k / cfg.n_experts)
+    return max(8, ((c + 7) // 8) * 8)
+
+
+# ---------------------------------------------------------------------------
+# Params
+# ---------------------------------------------------------------------------
+
+def init_params(rng: jax.Array, cfg: MoEConfig) -> Params:
+    hd = cfg.hd
+    k = iter(jax.random.split(rng, 16))
+    init = jax.nn.initializers.normal(stddev=0.02, dtype=cfg.param_dtype)
+    trunc = jax.nn.initializers.variance_scaling(
+        1.0, 'fan_in', 'truncated_normal', dtype=cfg.param_dtype)
+    L, D, F, E = cfg.n_layers, cfg.dim, cfg.ffn_dim, cfg.n_experts
+    params: Params = {
+        'embed': init(next(k), (cfg.vocab_size, D)),
+        'layers': {
+            'attn_norm': jnp.ones((L, D), cfg.param_dtype),
+            'wq': trunc(next(k), (L, D, cfg.n_heads * hd)),
+            'wk': trunc(next(k), (L, D, cfg.n_kv_heads * hd)),
+            'wv': trunc(next(k), (L, D, cfg.n_kv_heads * hd)),
+            'wo': trunc(next(k), (L, cfg.n_heads * hd, D)),
+            'moe_norm': jnp.ones((L, D), cfg.param_dtype),
+            'router': init(next(k), (L, D, E)),
+            'w_gate': trunc(next(k), (L, E, D, F)),
+            'w_up': trunc(next(k), (L, E, D, F)),
+            'w_down': trunc(next(k), (L, E, F, D)),
+        },
+        'final_norm': jnp.ones((D,), cfg.param_dtype),
+    }
+    if not cfg.tie_embeddings:
+        params['lm_head'] = init(next(k), (D, cfg.vocab_size))
+    return params
+
+
+def param_specs(cfg: MoEConfig,
+                rules: Optional[sharding_lib.Rules] = None) -> Params:
+    r = rules or sharding_lib.Rules()
+    if cfg.pipeline_stages > 1:
+        r = r.override(layers='stage')
+    s = r.spec
+    specs: Params = {
+        'embed': s('vocab', 'embed'),
+        'layers': {
+            'attn_norm': s('layers', 'norm'),
+            'wq': s('layers', 'embed', 'heads'),
+            'wk': s('layers', 'embed', 'kv_heads'),
+            'wv': s('layers', 'embed', 'kv_heads'),
+            'wo': s('layers', 'heads', 'embed'),
+            'moe_norm': s('layers', 'norm'),
+            'router': s('layers', 'embed', 'norm'),
+            'w_gate': s('layers', 'expert', 'embed', 'mlp'),
+            'w_up': s('layers', 'expert', 'embed', 'mlp'),
+            'w_down': s('layers', 'expert', 'mlp', 'embed'),
+        },
+        'final_norm': s('norm'),
+    }
+    if not cfg.tie_embeddings:
+        specs['lm_head'] = s('embed', 'vocab')
+    return specs
+
+
+def validate_divisibility(cfg: MoEConfig, mesh_shape: Dict[str, int]):
+    llama_lib.validate_divisibility(cfg, mesh_shape)
+    ep = mesh_shape.get('expert', 1)
+    if ep > 1 and cfg.n_experts % ep != 0:
+        raise ValueError(f'n_experts={cfg.n_experts} not divisible by '
+                         f'expert axis {ep}')
+
+
+# ---------------------------------------------------------------------------
+# MoE FFN
+# ---------------------------------------------------------------------------
+
+def moe_ffn(x: jnp.ndarray, lp: Params, cfg: MoEConfig,
+            rules: sharding_lib.Rules) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """x [B,S,D] → (y [B,S,D], aux_loss scalar). Routes within fixed-size
+    sequence groups so all dispatch tensors are linear in S."""
+    b, s_len, d = x.shape
+    e, k = cfg.n_experts, cfg.top_k
+    gs = min(cfg.router_group_size, s_len)
+    if s_len % gs != 0:
+        gs = s_len                     # fall back to one group
+    g = s_len // gs
+    c = capacity(cfg, gs)
+    con = functools.partial(sharding_lib.constrain, rules=rules)
+
+    xg = x.reshape(b, g, gs, d)
+    logits = jnp.einsum('bgtd,de->bgte', xg.astype(jnp.float32),
+                        lp['router'].astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)                   # [B,G,T,E]
+    gate_w, gate_idx = jax.lax.top_k(probs, k)                # [B,G,T,K]
+    gate_w = gate_w / jnp.maximum(gate_w.sum(-1, keepdims=True), 1e-9)
+
+    # Load-balance aux (Switch): E · Σ_e f_e · p̄_e over the top-1 choice.
+    top1 = jax.nn.one_hot(gate_idx[..., 0], e, dtype=jnp.float32)
+    aux = e * jnp.sum(top1.mean((0, 1, 2)) * probs.mean((0, 1, 2)))
+
+    # Static-capacity dispatch: each (token, choice)'s buffer slot in its
+    # expert comes from a cumulative count within the group.
+    onehot = jax.nn.one_hot(gate_idx, e, dtype=jnp.float32)   # [B,G,T,K,E]
+    flat = onehot.reshape(b, g, gs * k, e)
+    pos = jnp.cumsum(flat, axis=2) - flat
+    pos = pos.reshape(b, g, gs, k, e)
+    keep = (pos < c) * onehot                                 # drop overflow
+    slot = jax.nn.one_hot(pos, c, dtype=jnp.float32) * keep[..., None]
+    dispatch = slot.sum(3)                                    # [B,G,T,E,C]
+    combine = jnp.einsum('bgtk,bgtkec->bgtec',
+                         gate_w.astype(jnp.float32), slot)
+
+    xin = jnp.einsum('bgtec,bgtd->ebgcd', dispatch.astype(cfg.dtype), xg)
+    xin = con(xin, 'expert', 'batch', None, None, 'act_embed')
+    gate = jnp.einsum('ebgcd,edf->ebgcf', xin, lp['w_gate'].astype(cfg.dtype))
+    up = jnp.einsum('ebgcd,edf->ebgcf', xin, lp['w_up'].astype(cfg.dtype))
+    inner = jax.nn.silu(gate) * up
+    inner = con(inner, 'expert', 'batch', None, None, 'mlp')
+    out = jnp.einsum('ebgcf,efd->ebgcd', inner,
+                     lp['w_down'].astype(cfg.dtype))          # [E,B,G,C,D]
+    y = jnp.einsum('bgtec,ebgcd->bgtd', combine.astype(cfg.dtype), out)
+    return con(y.reshape(b, s_len, d), 'batch', 'seq', 'act_embed'), aux
+
+
+def _layer(carry, lp, cfg: MoEConfig, rules, sin, cos, q_offset):
+    x, aux_sum = carry
+    x = x + llama_lib.attention_block(x, lp, cfg, rules, sin, cos, q_offset)
+    h = norms.rms_norm(x, lp['moe_norm'], cfg.rms_eps)
+    y, aux = moe_ffn(h, lp, cfg, rules)
+    return (x + y, aux_sum + aux)
+
+
+def forward(params: Params,
+            tokens: jnp.ndarray,
+            cfg: MoEConfig,
+            rules: Optional[sharding_lib.Rules] = None,
+            positions: Optional[jnp.ndarray] = None,
+            q_offset: int | jnp.ndarray = 0,
+            return_aux: bool = False):
+    """tokens [B,S] → logits [B,S,V] fp32 (+ router aux loss if asked)."""
+    if cfg.pipeline_stages > 1:
+        raise NotImplementedError('pipeline parallelism for MoE layers is '
+                                  'not wired yet (aux-loss carry)')
+    rules = rules or sharding_lib.Rules()
+    con = functools.partial(sharding_lib.constrain, rules=rules)
+    b, s_len = tokens.shape
+    tokens = con(tokens, 'batch', 'seq')
+    x = jnp.take(params['embed'], tokens, axis=0).astype(cfg.dtype)
+    x = con(x, 'batch', 'seq', 'act_embed')
+
+    if positions is None:
+        positions = jnp.arange(s_len) + q_offset
+    sin, cos = rotary.rope_frequencies(cfg.hd, positions, cfg.rope_theta,
+                                       cfg.rope_scaling)
+
+    layer_fn = functools.partial(_layer, cfg=cfg, rules=rules, sin=sin,
+                                 cos=cos, q_offset=q_offset)
+    policy_name = llama_lib._REMAT_POLICIES[cfg.remat]
+    if policy_name is not None:
+        policy = getattr(jax.checkpoint_policies, policy_name)
+        layer_fn = jax.checkpoint(layer_fn, policy=policy)
+
+    aux0 = jnp.zeros((), jnp.float32)
+    if cfg.scan_layers:
+        def body(carry, lp):
+            return layer_fn(carry, lp), None
+        (x, aux), _ = jax.lax.scan(body, (x, aux0), params['layers'])
+    else:
+        carry = (x, aux0)
+        for i in range(cfg.n_layers):
+            lp = jax.tree.map(lambda p: p[i], params['layers'])
+            carry = layer_fn(carry, lp)
+        x, aux = carry
+
+    x = norms.rms_norm(x, params['final_norm'], cfg.rms_eps)
+    head = (params['embed'].T if cfg.tie_embeddings else params['lm_head'])
+    logits = jnp.einsum('bsd,dv->bsv', x, head.astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
+    logits = con(logits, 'batch', 'seq', 'vocab')
+    if return_aux:
+        return logits, cfg.router_aux_weight * aux / cfg.n_layers
+    return logits

@@ -23,28 +23,8 @@ import jax.numpy as jnp
 NEG_INF = -2.0 ** 30  # large-but-finite: keeps softmax fp32-safe
 
 
-def _repeat_kv(h: jnp.ndarray, groups: int) -> jnp.ndarray:
-    if groups == 1:
-        return h
-    b, t, kh, d = h.shape
-    return jnp.broadcast_to(h[:, :, :, None, :],
-                            (b, t, kh, groups, d)).reshape(b, t, kh * groups, d)
-
-
-def xla_attention(q: jnp.ndarray,
-                  k: jnp.ndarray,
-                  v: jnp.ndarray,
-                  *,
-                  causal: bool = True,
-                  q_offset: int | jnp.ndarray = 0,
-                  kv_offset: int | jnp.ndarray = 0,
-                  segment_ids: Optional[jnp.ndarray] = None,
-                  softmax_scale: Optional[float] = None) -> jnp.ndarray:
-    """Reference attention. q [B,S,H,D], k/v [B,T,KH,D] → [B,S,H,D].
-
-    q_offset/kv_offset are the global positions of q[:,0]/k[:,0] — used both
-    for decode (q_offset=cache_len) and for context-parallel shards.
-    """
+def _xla_attention_impl(q, k, v, causal, q_offset, kv_offset, segment_ids,
+                        softmax_scale, return_lse):
     b, s, h, d = q.shape
     t, kh = k.shape[1], k.shape[2]
     groups = h // kh
@@ -70,9 +50,40 @@ def xla_attention(q: jnp.ndarray,
     if mask is not None:
         scores = jnp.where(mask, scores, NEG_INF)
 
-    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    out = jnp.einsum('bkgst,btkd->bskgd', probs, v)
-    return out.reshape(b, s, h, d)
+    if return_lse:
+        lse = jax.nn.logsumexp(scores, axis=-1)           # [B,KH,G,S]
+        probs = jnp.exp(scores - lse[..., None]).astype(q.dtype)
+    else:
+        lse = None
+        probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    out = jnp.einsum('bkgst,btkd->bskgd', probs, v).reshape(b, s, h, d)
+    if return_lse:
+        return out, lse.transpose(0, 3, 1, 2).reshape(b, s, h)
+    return out
+
+
+def xla_attention(q: jnp.ndarray,
+                  k: jnp.ndarray,
+                  v: jnp.ndarray,
+                  *,
+                  causal: bool = True,
+                  q_offset: int | jnp.ndarray = 0,
+                  kv_offset: int | jnp.ndarray = 0,
+                  segment_ids: Optional[jnp.ndarray] = None,
+                  softmax_scale: Optional[float] = None) -> jnp.ndarray:
+    """Reference attention. q [B,S,H,D], k/v [B,T,KH,D] → [B,S,H,D].
+
+    q_offset/kv_offset are the global positions of q[:,0]/k[:,0] — used both
+    for decode (q_offset=cache_len) and for context-parallel shards.
+    """
+    return _xla_attention_impl(q, k, v, causal, q_offset, kv_offset,
+                               segment_ids, softmax_scale, return_lse=False)
+
+
+def xla_attention_lse(q, k, v, *, causal: bool = True, softmax_scale=None):
+    """Reference attention that also returns lse [B,S,H] (for ring/CP)."""
+    return _xla_attention_impl(q, k, v, causal, 0, 0, None, softmax_scale,
+                               return_lse=True)
 
 
 def attention(q: jnp.ndarray,

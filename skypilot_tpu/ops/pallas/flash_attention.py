@@ -228,7 +228,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0, 0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, out, lse, do, causal, block_q, block_k, interpret):
+def _bwd(q, k, v, out, lse, do, causal, block_q, block_k, interpret,
+         dlse=None):
     b, h, s, d = q.shape
     kh, t = k.shape[1], k.shape[2]
     g = h // kh
@@ -237,6 +238,8 @@ def _bwd(q, k, v, out, lse, do, causal, block_q, block_k, interpret):
 
     delta = jnp.sum(out.astype(jnp.float32) * do.astype(jnp.float32),
                     axis=-1, keepdims=True)                  # [B,H,S,1]
+    if dlse is not None:
+        delta = delta - dlse.astype(jnp.float32)[..., None]
     delta = jnp.broadcast_to(delta, (b, h, s, LANES))
 
     q_spec = pl.BlockSpec((1, 1, bq, d), lambda b_, h_, qi, ki: (b_, h_, qi, 0))
@@ -293,28 +296,54 @@ def _bwd(q, k, v, out, lse, do, causal, block_q, block_k, interpret):
 
 
 # ---------------------------------------------------------------------------
-# Public wrapper ([B, S, H, D] layout, custom VJP)
+# Public wrappers ([B, S, H, D] layout, custom VJP)
 # ---------------------------------------------------------------------------
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def _flash(q, k, v, causal, block_q, block_k, interpret):
-    out, _ = _fwd(q, k, v, causal, block_q, block_k, interpret)
-    return out
+    out, lse = _fwd(q, k, v, causal, block_q, block_k, interpret)
+    return out, lse[..., 0]                         # lse compact [B,H,S]
 
 
 def _flash_fwd_rule(q, k, v, causal, block_q, block_k, interpret):
     out, lse = _fwd(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v, out, lse)
+    return (out, lse[..., 0]), (q, k, v, out, lse)
 
 
-def _flash_bwd_rule(causal, block_q, block_k, interpret, res, do):
+def _flash_bwd_rule(causal, block_q, block_k, interpret, res, cts):
     q, k, v, out, lse = res
+    do, dlse = cts
+    # d lse_i enters as ds += p · dlse_i, which folds into the delta term:
+    # ds = p (dp − (delta − dlse)).
     dq, dk, dv = _bwd(q, k, v, out, lse, do, causal, block_q, block_k,
-                      interpret)
+                      interpret, dlse=dlse)
     return dq, dk, dv
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention_lse(q: jnp.ndarray,
+                        k: jnp.ndarray,
+                        v: jnp.ndarray,
+                        *,
+                        causal: bool = True,
+                        softmax_scale: Optional[float] = None,
+                        block_q: int = 512,
+                        block_k: int = 512,
+                        interpret: bool = False):
+    """Like flash_attention but also returns lse [B,S,H] (f32) — the
+    per-row log-sum-exp needed to combine partial attentions (ring/CP)."""
+    d = q.shape[-1]
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+    # Pre-scale q: s = (scale·q)·kᵀ, and dk = dsᵀ·(scale·q) comes out right;
+    # dq needs the extra `scale` which the chain rule applies automatically
+    # through this multiplication.
+    qh = (q * scale).swapaxes(1, 2)                 # [B,H,S,D]
+    kh_ = k.swapaxes(1, 2)                          # [B,KH,T,D]
+    vh = v.swapaxes(1, 2)
+    out, lse = _flash(qh, kh_, vh, causal, block_q, block_k, interpret)
+    return out.swapaxes(1, 2), lse.swapaxes(1, 2)
 
 
 def flash_attention(q: jnp.ndarray,
@@ -327,13 +356,8 @@ def flash_attention(q: jnp.ndarray,
                     block_k: int = 512,
                     interpret: bool = False) -> jnp.ndarray:
     """q [B,S,H,D], k/v [B,T,KH,D] → [B,S,H,D]; differentiable."""
-    d = q.shape[-1]
-    scale = softmax_scale if softmax_scale is not None else d ** -0.5
-    # Pre-scale q: s = (scale·q)·kᵀ, and dk = dsᵀ·(scale·q) comes out right;
-    # dq needs the extra `scale` which the chain rule applies automatically
-    # through this multiplication.
-    qh = (q * scale).swapaxes(1, 2)                 # [B,H,S,D]
-    kh_ = k.swapaxes(1, 2)                          # [B,KH,T,D]
-    vh = v.swapaxes(1, 2)
-    out = _flash(qh, kh_, vh, causal, block_q, block_k, interpret)
-    return out.swapaxes(1, 2)
+    out, _ = flash_attention_lse(q, k, v, causal=causal,
+                                 softmax_scale=softmax_scale,
+                                 block_q=block_q, block_k=block_k,
+                                 interpret=interpret)
+    return out

@@ -1,0 +1,106 @@
+"""Ring attention: exact context-parallel attention over the 'sequence' axis.
+
+The long-context strategy the reference lacks entirely (SURVEY §2.11: SP/CP
+"absent in reference") and a TPU-native design: K/V shards rotate around the
+ICI ring via `lax.ppermute` while every device computes flash-attention
+partials against its resident Q shard; partials merge with the numerically
+stable log-sum-exp rule. Communication rides nearest-neighbour ICI links and
+overlaps with the per-step kernel, so attention scales to sequence lengths
+far beyond one chip's HBM.
+
+Must be called INSIDE `shard_map` with q/k/v sharded on their sequence dim
+over `axis_name`. RoPE must already be applied with *global* positions
+(the model does this naturally: sin/cos are sharded alongside the tokens).
+
+Causal layout note: plain sequential sharding makes causal load imbalanced
+(shard i only attends i+1 of n steps); `zigzag=True` is reserved for the
+balanced layout (future work).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -2.0 ** 30
+
+
+def _combine(o: jnp.ndarray, lse: jnp.ndarray, o_i: jnp.ndarray,
+             lse_i: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Merge two normalized attention partials via their log-sum-exps.
+
+    o, o_i: [B,S,H,D] (f32); lse, lse_i: [B,S,H].
+    """
+    m = jnp.maximum(lse, lse_i)
+    w = jnp.exp(lse - m)[..., None]
+    w_i = jnp.exp(lse_i - m)[..., None]
+    denom = w + w_i
+    o_new = (o * w + o_i.astype(jnp.float32) * w_i) / denom
+    lse_new = m + jnp.log(denom[..., 0])
+    return o_new, lse_new
+
+
+def _partial(q, k, v, causal: bool, softmax_scale, interpret: bool):
+    """(out [B,S,H,D], lse [B,S,H]) for one ring step."""
+    from skypilot_tpu.ops.attention import _flash_ok, xla_attention_lse
+    use_flash = (not interpret and _flash_ok(q, k))
+    if use_flash:
+        from skypilot_tpu.ops.pallas import flash_attention as fa
+        return fa.flash_attention_lse(q, k, v, causal=causal,
+                                      softmax_scale=softmax_scale)
+    return xla_attention_lse(q, k, v, causal=causal,
+                             softmax_scale=softmax_scale)
+
+
+def ring_attention(q: jnp.ndarray,
+                   k: jnp.ndarray,
+                   v: jnp.ndarray,
+                   *,
+                   axis_name: str = 'sequence',
+                   causal: bool = True,
+                   softmax_scale: Optional[float] = None,
+                   interpret: bool = False) -> jnp.ndarray:
+    """Exact attention over a sequence-sharded q/k/v. Call inside shard_map.
+
+    q [B,Sl,H,D], k/v [B,Sl,KH,D] — Sl is the per-device shard. Returns the
+    local output shard [B,Sl,H,D] in q.dtype.
+    """
+    n = jax.lax.axis_size(axis_name)
+    me = jax.lax.axis_index(axis_name)
+    b, sl, h, d = q.shape
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    part = functools.partial(_partial, softmax_scale=softmax_scale,
+                             interpret=interpret)
+
+    o0 = jnp.zeros((b, sl, h, d), jnp.float32)
+    lse0 = jnp.full((b, sl, h), NEG_INF, jnp.float32)
+
+    def body(carry, i):
+        o, lse, k_c, v_c = carry
+        src = (me - i) % n                     # whose kv shard we hold now
+
+        if causal:
+            def diag(_):
+                return part(q, k_c, v_c, causal=True)
+
+            def earlier(_):
+                return part(q, k_c, v_c, causal=False)
+
+            def skip(_):
+                return (jnp.zeros((b, sl, h, d), q.dtype),
+                        jnp.full((b, sl, h), NEG_INF, jnp.float32))
+
+            idx = jnp.where(src == me, 1, jnp.where(src < me, 0, 2))
+            o_i, lse_i = jax.lax.switch(idx, [earlier, diag, skip], None)
+        else:
+            o_i, lse_i = part(q, k_c, v_c, causal=False)
+
+        o, lse = _combine(o, lse, o_i, lse_i)
+        k_c = jax.lax.ppermute(k_c, axis_name, perm)
+        v_c = jax.lax.ppermute(v_c, axis_name, perm)
+        return (o, lse, k_c, v_c), None
+
+    (o, _, _, _), _ = jax.lax.scan(body, (o0, lse0, k, v), jnp.arange(n))
+    return o.astype(q.dtype)

@@ -1,0 +1,73 @@
+"""Pipeline parallelism over the 'stage' mesh axis (GPipe schedule).
+
+TPU-native PP: layer stacks are sharded across stages, activations rotate
+stage→stage via `lax.ppermute` (nearest-neighbour ICI), and a `lax.scan`
+over the M + n - 1 time steps drives the schedule — no Python-level loops,
+one compiled program. The bubble fraction is (n-1)/(M+n-1); pick
+num_microbatches >= 4·stages for ~90% utilisation.
+
+Reference analog: none — SkyPilot delegates PP to torch recipes
+(SURVEY §2.11); this is the native replacement.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def pipeline_apply(layer_fn: Callable[[jnp.ndarray, Any], jnp.ndarray],
+                   local_layers: Any,
+                   x_microbatches: jnp.ndarray,
+                   *,
+                   axis_name: str = 'stage') -> jnp.ndarray:
+    """Run a pipelined stack of layers. Call INSIDE shard_map.
+
+    layer_fn(x, layer_params) -> x : one layer step.
+    local_layers: pytree whose leaves are [L_local, ...] stacks (this
+        stage's shard of the full layer stack).
+    x_microbatches: [M, mb, S, D] — full input, replicated across stages.
+    Returns [M, mb, S, D] on every stage (broadcast from the last stage).
+    """
+    n = jax.lax.axis_size(axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    m = x_microbatches.shape[0]
+    steps = m + n - 1
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def local_stack(x):
+        def body(carry, lp):
+            return layer_fn(carry, lp), None
+        out, _ = jax.lax.scan(body, x, local_layers)
+        return out
+
+    state0 = jnp.zeros_like(x_microbatches[0])
+    outputs0 = jnp.zeros_like(x_microbatches)
+
+    def step(carry, t):
+        state, outputs = carry
+        inject = x_microbatches[jnp.clip(t, 0, m - 1)]
+        cur = jnp.where(stage == 0, inject, state)
+        y = local_stack(cur)
+        widx = t - (n - 1)
+        do_write = jnp.logical_and(stage == n - 1, widx >= 0)
+        write_slot = jnp.clip(widx, 0, m - 1)
+        updated = jax.lax.dynamic_update_index_in_dim(
+            outputs, y.astype(outputs.dtype), write_slot, 0)
+        outputs = jnp.where(do_write, updated, outputs)
+        state = jax.lax.ppermute(y, axis_name, perm)
+        return (state, outputs), None
+
+    (_, outputs), _ = jax.lax.scan(step, (state0, outputs0),
+                                   jnp.arange(steps))
+    # Broadcast the last stage's outputs to all stages. Off-TPU the psum
+    # runs in f32: XLA CPU's AllReducePromotion pass crashes on bf16
+    # all-reduce (compiler bug).
+    dtype = outputs.dtype
+    outputs = jnp.where(stage == n - 1, outputs, jnp.zeros_like(outputs))
+    if jax.default_backend() != 'tpu' and dtype == jnp.bfloat16:
+        return jax.lax.psum(outputs.astype(jnp.float32),
+                            axis_name).astype(dtype)
+    return jax.lax.psum(outputs, axis_name)

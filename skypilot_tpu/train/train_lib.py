@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from skypilot_tpu import models as models_lib
 from skypilot_tpu.models import llama
 from skypilot_tpu.parallel import mesh as mesh_lib
 from skypilot_tpu.parallel import sharding as sharding_lib
@@ -61,15 +62,16 @@ def default_optimizer(learning_rate: float = 3e-4,
     )
 
 
-def state_shardings(cfg: llama.LlamaConfig, mesh: Mesh,
+def state_shardings(cfg: 'llama.LlamaConfig', mesh: Mesh,
                     tx: optax.GradientTransformation,
                     rules: Optional[sharding_lib.Rules] = None) -> TrainState:
     """TrainState-shaped pytree of NamedShardings (for jit in/out)."""
     rules = rules or sharding_lib.Rules()
-    specs = llama.param_specs(cfg, rules)
+    mod = models_lib.module_for(cfg)
+    specs = mod.param_specs(cfg, rules)
     p_shard = sharding_lib.tree_shardings(mesh, specs)
     param_shapes = jax.eval_shape(
-        functools.partial(llama.init_params, cfg=cfg),
+        functools.partial(mod.init_params, cfg=cfg),
         jax.random.PRNGKey(0))
     opt_shapes = jax.eval_shape(tx.init, param_shapes)
     leaf_to_sharding = sharding_lib.shardings_like(mesh, specs, param_shapes)
@@ -78,14 +80,16 @@ def state_shardings(cfg: llama.LlamaConfig, mesh: Mesh,
                       params=p_shard, opt_state=opt_shard)
 
 
-def init_train_state(rng: jax.Array, cfg: llama.LlamaConfig, mesh: Mesh,
+def init_train_state(rng: jax.Array, cfg: 'llama.LlamaConfig', mesh: Mesh,
                      tx: optax.GradientTransformation,
                      rules: Optional[sharding_lib.Rules] = None) -> TrainState:
     """Materialise params + opt state directly sharded on the mesh."""
     shardings = state_shardings(cfg, mesh, tx, rules)
 
+    mod = models_lib.module_for(cfg)
+
     def _init(r):
-        params = llama.init_params(r, cfg)
+        params = mod.init_params(r, cfg)
         return TrainState(step=jnp.zeros((), jnp.int32), params=params,
                           opt_state=tx.init(params))
 
@@ -95,7 +99,7 @@ def init_train_state(rng: jax.Array, cfg: llama.LlamaConfig, mesh: Mesh,
         return jax.jit(_init, out_shardings=out_shardings)(rng)
 
 
-def make_train_step(cfg: llama.LlamaConfig, mesh: Mesh,
+def make_train_step(cfg: 'llama.LlamaConfig', mesh: Mesh,
                     tx: optax.GradientTransformation,
                     rules: Optional[sharding_lib.Rules] = None
                     ) -> Callable[[TrainState, Batch],
@@ -107,6 +111,7 @@ def make_train_step(cfg: llama.LlamaConfig, mesh: Mesh,
     """
     rules = rules or sharding_lib.Rules()
     shardings = state_shardings(cfg, mesh, tx, rules)
+    mod = models_lib.module_for(cfg)
 
     def step_fn(state: TrainState, batch: Batch):
         tokens = batch['tokens']
@@ -114,12 +119,16 @@ def make_train_step(cfg: llama.LlamaConfig, mesh: Mesh,
         mask = batch.get('loss_mask')
 
         def loss_fn(params):
-            logits = llama.forward(params, inputs, cfg, rules)
+            if getattr(mod, 'HAS_AUX', False):
+                logits, aux = mod.forward(params, inputs, cfg, rules,
+                                          return_aux=True)
+            else:
+                logits, aux = mod.forward(params, inputs, cfg, rules), 0.0
             loss, denom = cross_entropy_loss(logits, targets, mask)
-            return loss, denom
+            return loss + aux, (loss, denom)
 
-        (loss, denom), grads = jax.value_and_grad(loss_fn,
-                                                  has_aux=True)(state.params)
+        (_, (loss, denom)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         gnorm = optax.global_norm(grads)

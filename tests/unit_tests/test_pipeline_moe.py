@@ -1,0 +1,128 @@
+"""Pipeline parallelism (GPipe over 'stage') and MoE/expert parallelism."""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import get_config, llama, moe
+from skypilot_tpu.parallel import MeshSpec, build_mesh
+from skypilot_tpu.parallel.mesh import use_mesh
+from skypilot_tpu.train import train_lib
+
+CFG = llama.PRESETS['llama-debug']
+MOE_CFG = moe.PRESETS['moe-debug']
+
+
+class TestPipeline:
+
+    def test_pp_forward_matches_dense(self):
+        params = llama.init_params(jax.random.PRNGKey(0), CFG)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                    CFG.vocab_size, jnp.int32)
+        ref = np.asarray(llama.forward(params, tokens, CFG))
+        cfg_pp = dataclasses.replace(CFG, pipeline_stages=2,
+                                     num_microbatches=2)
+        mesh = build_mesh(MeshSpec(fsdp=1, stage=2, tensor=2, data=2),
+                          devices=jax.devices('cpu'))
+        with use_mesh(mesh):
+            out = np.asarray(
+                jax.jit(lambda p, t: llama.forward(p, t, cfg_pp))(params,
+                                                                  tokens))
+        np.testing.assert_allclose(ref, out, atol=2e-2, rtol=2e-2)
+
+    def test_pp_grads_match_dense(self):
+        params = llama.init_params(jax.random.PRNGKey(0), CFG)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                    CFG.vocab_size, jnp.int32)
+        cfg_pp = dataclasses.replace(CFG, pipeline_stages=2,
+                                     num_microbatches=2)
+
+        def loss(p, c):
+            return (llama.forward(p, tokens, c).astype(jnp.float32)**2).mean()
+
+        g_ref = jax.grad(lambda p: loss(p, CFG))(params)
+        mesh = build_mesh(MeshSpec(fsdp=1, stage=2, tensor=2, data=2),
+                          devices=jax.devices('cpu'))
+        with use_mesh(mesh):
+            g_pp = jax.jit(jax.grad(lambda p: loss(p, cfg_pp)))(params)
+        err = max(jax.tree.leaves(jax.tree.map(
+            lambda a, b: float(jnp.max(jnp.abs(a - b))), g_ref, g_pp)))
+        assert err < 1e-3
+
+    def test_pp_validation(self):
+        cfg_bad = dataclasses.replace(CFG, pipeline_stages=3,
+                                      num_microbatches=2)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg_bad)
+        tokens = jnp.zeros((4, 16), jnp.int32)
+        mesh = build_mesh(MeshSpec(fsdp=1, stage=2, data=4),
+                          devices=jax.devices('cpu'))
+        with pytest.raises(ValueError, match='divisible'):
+            with use_mesh(mesh):
+                jax.jit(lambda p, t: llama.forward(p, t, cfg_bad))(params,
+                                                                   tokens)
+
+
+class TestMoE:
+
+    def test_presets(self):
+        assert get_config('mixtral-8x7b').n_experts == 8
+        assert MOE_CFG.active_params < MOE_CFG.num_params
+
+    def test_forward_shape(self):
+        params = moe.init_params(jax.random.PRNGKey(0), MOE_CFG)
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        logits, aux = moe.forward(params, tokens, MOE_CFG, return_aux=True)
+        assert logits.shape == (2, 16, MOE_CFG.vocab_size)
+        assert float(aux) > 0.0
+
+    def test_causality(self):
+        params = moe.init_params(jax.random.PRNGKey(0), MOE_CFG)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (1, 16), 0,
+                                    MOE_CFG.vocab_size, jnp.int32)
+        la = moe.forward(params, tokens, MOE_CFG)
+        tokens_b = tokens.at[0, 10].set((tokens[0, 10] + 1) %
+                                        MOE_CFG.vocab_size)
+        lb = moe.forward(params, tokens_b, MOE_CFG)
+        np.testing.assert_allclose(np.asarray(la[0, :10]),
+                                   np.asarray(lb[0, :10]), atol=1e-3)
+
+    def test_ep_train_loss_decreases(self):
+        mesh = build_mesh(MeshSpec(fsdp=1, expert=4, tensor=2),
+                          devices=jax.devices('cpu'))
+        moe.validate_divisibility(MOE_CFG, dict(mesh.shape))
+        tx = train_lib.default_optimizer(learning_rate=1e-2, warmup_steps=1,
+                                         total_steps=100)
+        state = train_lib.init_train_state(jax.random.PRNGKey(0), MOE_CFG,
+                                           mesh, tx)
+        step = train_lib.make_train_step(MOE_CFG, mesh, tx)
+        batch = train_lib.synthetic_batch(jax.random.PRNGKey(1), 4, 32,
+                                          MOE_CFG.vocab_size)
+        state, m0 = step(state, batch)
+        for _ in range(5):
+            state, m = step(state, batch)
+        assert float(m['loss']) < float(m0['loss'])
+        spec = state.params['layers']['w_gate'].sharding.spec
+        assert 'expert' in jax.tree.leaves(tuple(spec))
+
+    def test_ep_matches_single_device(self):
+        params = moe.init_params(jax.random.PRNGKey(0), MOE_CFG)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 32), 0,
+                                    MOE_CFG.vocab_size, jnp.int32)
+        ref = np.asarray(moe.forward(params, tokens, MOE_CFG))
+        mesh = build_mesh(MeshSpec(fsdp=1, expert=4, tensor=2),
+                          devices=jax.devices('cpu'))
+        with use_mesh(mesh):
+            out = np.asarray(
+                jax.jit(lambda p, t: moe.forward(p, t, MOE_CFG))(params,
+                                                                 tokens))
+        np.testing.assert_allclose(ref, out, atol=3e-2, rtol=3e-2)
+
+    def test_capacity_rounding(self):
+        assert moe.capacity(MOE_CFG, 32) >= 8
+        assert moe.capacity(MOE_CFG, 32) % 8 == 0
+
+    def test_validate_divisibility(self):
+        with pytest.raises(ValueError, match='n_experts'):
+            moe.validate_divisibility(MOE_CFG, {'expert': 3})
