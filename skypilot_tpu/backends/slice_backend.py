@@ -436,7 +436,7 @@ class TpuSliceBackend(backend_lib.Backend[SliceResourceHandle]):
             'from skypilot_tpu.skylet import autostop_lib; '
             f'autostop_lib.set_autostop({idle_minutes!r}, {down!r}, '
             f'{handle.cloud!r}, {handle.region!r}, '
-            f'{handle.cluster_name!r})')
+            f'{handle.cluster_name!r}, {handle.provider_config!r})')
         head = self._head_runner(cluster_info)
         rc = head.run(f'{py} -c {shlex.quote(code)}', log_path='/dev/null')
         if rc != 0:

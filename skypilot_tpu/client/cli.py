@@ -281,6 +281,63 @@ def cost_report():
         click.echo(f"{r['name']}: {dur}, ${r.get('cost') or 0:.2f}")
 
 
+@cli.group()
+def api():
+    """Manage the API server (reference: `sky api`)."""
+
+
+@api.command(name='start')
+@click.option('--host', default='127.0.0.1')
+@click.option('--port', type=int, default=46580)
+@click.option('--foreground', is_flag=True, default=False)
+def api_start(host: str, port: int, foreground: bool):
+    """Start the API server (daemonized unless --foreground)."""
+    from skypilot_tpu.client import sdk
+    url = sdk.api_start(host, port, foreground=foreground)
+    click.echo(f'API server running at {url}')
+
+
+@api.command(name='stop')
+def api_stop():
+    """Stop the API server."""
+    from skypilot_tpu.client import sdk
+    click.echo('stopped' if sdk.api_stop() else 'not running')
+
+
+@api.command(name='status')
+@click.option('--limit', type=int, default=30)
+def api_status(limit: int):
+    """Show the API server and its recent requests."""
+    from skypilot_tpu.client import sdk
+    info = sdk.api_info()
+    click.echo(f"server: {info.get('status')} "
+               f"{info.get('url', '')} {info.get('version', '')}")
+    if info.get('status') != 'healthy':
+        return
+    for r in sdk.api_list_requests()[:limit]:
+        click.echo(f"{r['request_id']}  {r['name']:<18} {r['status']}")
+
+
+@api.command(name='logs')
+@click.argument('request_id', required=True)
+def api_logs(request_id: str):
+    """Stream a request's log."""
+    from skypilot_tpu.client import sdk
+    try:
+        sdk.stream_and_get(request_id)
+    except sdk.RequestFailedError as e:
+        raise click.ClickException(str(e))
+
+
+@api.command(name='cancel')
+@click.argument('request_id', required=True)
+def api_cancel(request_id: str):
+    """Cancel a queued/running request."""
+    from skypilot_tpu.client import sdk
+    click.echo('cancelled' if sdk.api_cancel(request_id) else
+               'not cancellable')
+
+
 def main():
     return cli()
 

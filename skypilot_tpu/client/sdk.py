@@ -1,0 +1,233 @@
+"""Client SDK for the API server (reference analog: sky/client/sdk.py —
+every call → HTTP POST → RequestId; stream_and_get to follow).
+
+Two modes:
+  - direct (default): the top-level `skypilot_tpu.*` functions run
+    in-process — hermetic, no server needed.
+  - server: these functions POST to a running API server and poll/stream
+    the persisted request. Activated by SKYTPU_API_SERVER_URL or a healthy
+    endpoint recorded by `skytpu api start`.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+import requests as requests_http
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.server import requests_lib as server_requests
+
+logger = sky_logging.init_logger(__name__)
+
+DEFAULT_URL = 'http://127.0.0.1:46580'
+
+
+class ApiError(Exception):
+    pass
+
+
+class RequestFailedError(ApiError):
+    def __init__(self, request_id: str, error: str):
+        super().__init__(f'request {request_id} failed:\n{error}')
+        self.request_id = request_id
+        self.server_error = error
+
+
+def endpoint_file() -> str:
+    return os.path.join(server_requests.server_dir(), 'endpoint')
+
+
+def api_server_url(required: bool = False) -> Optional[str]:
+    url = os.environ.get('SKYTPU_API_SERVER_URL')
+    if not url and os.path.exists(endpoint_file()):
+        with open(endpoint_file(), 'r', encoding='utf-8') as f:
+            url = f.read().strip()
+    if url and _healthy(url):
+        return url
+    if required:
+        raise ApiError(
+            'No healthy API server. Start one with `skytpu api start` or '
+            'set SKYTPU_API_SERVER_URL.')
+    return None
+
+
+def _healthy(url: str) -> bool:
+    try:
+        r = requests_http.get(f'{url}/api/v1/health', timeout=2)
+        return r.status_code == 200
+    except requests_http.RequestException:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Server lifecycle
+# ---------------------------------------------------------------------------
+
+def api_start(host: str = '127.0.0.1', port: int = 46580,
+              foreground: bool = False) -> str:
+    url = f'http://{host}:{port}'
+    if _healthy(url):
+        return url
+    if foreground:
+        from skypilot_tpu.server import server as server_lib
+        server_lib.run(host, port)
+        return url
+    log = os.path.join(server_requests.server_dir(), 'server.log')
+    with open(log, 'a', encoding='utf-8') as f:
+        subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.server.server',
+             '--host', host, '--port', str(port)],
+            stdout=f, stderr=f, start_new_session=True)
+    for _ in range(50):
+        if _healthy(url):
+            return url
+        time.sleep(0.2)
+    raise ApiError(f'API server failed to start; see {log}')
+
+
+def api_stop() -> bool:
+    pid_file = os.path.join(server_requests.server_dir(), 'server.pid')
+    if not os.path.exists(pid_file):
+        return False
+    with open(pid_file, 'r', encoding='utf-8') as f:
+        pid = int(f.read().strip() or 0)
+    try:
+        os.kill(pid, 15)
+    except (OSError, ProcessLookupError):
+        return False
+    for p in (pid_file, endpoint_file()):
+        try:
+            os.remove(p)
+        except OSError:
+            pass
+    return True
+
+
+def api_info() -> Dict[str, Any]:
+    url = api_server_url()
+    if url is None:
+        return {'status': 'stopped'}
+    r = requests_http.get(f'{url}/api/v1/health', timeout=5)
+    info = r.json()
+    info['url'] = url
+    return info
+
+
+# ---------------------------------------------------------------------------
+# Request plumbing
+# ---------------------------------------------------------------------------
+
+def submit(name: str, payload: Dict[str, Any],
+           url: Optional[str] = None) -> str:
+    url = url or api_server_url(required=True)
+    r = requests_http.post(f'{url}/api/v1/{name}', json=payload, timeout=30)
+    if r.status_code != 200:
+        raise ApiError(f'{name}: HTTP {r.status_code}: {r.text}')
+    return r.json()['request_id']
+
+
+def get(request_id: str, url: Optional[str] = None) -> Any:
+    """Block until the request finishes; return its result (or raise)."""
+    url = url or api_server_url(required=True)
+    while True:
+        r = requests_http.get(f'{url}/api/v1/get',
+                              params={'request_id': request_id, 'wait': '1'},
+                              timeout=300)
+        if r.status_code == 404:
+            raise ApiError(f'no request {request_id}')
+        rec = r.json()
+        status = server_requests.RequestStatus(rec['status'])
+        if status.is_terminal():
+            break
+    if status == server_requests.RequestStatus.SUCCEEDED:
+        return rec['result']
+    if status == server_requests.RequestStatus.CANCELLED:
+        raise ApiError(f'request {request_id} was cancelled')
+    raise RequestFailedError(request_id, rec.get('error') or '')
+
+
+def stream_and_get(request_id: str, url: Optional[str] = None,
+                   out=None) -> Any:
+    """Stream the request's log to `out` (stdout default), then get()."""
+    url = url or api_server_url(required=True)
+    out = out or sys.stdout
+    with requests_http.get(f'{url}/api/v1/stream',
+                           params={'request_id': request_id},
+                           stream=True, timeout=None) as r:
+        for chunk in r.iter_content(chunk_size=None, decode_unicode=True):
+            if chunk:
+                out.write(chunk)
+                out.flush()
+    return get(request_id, url)
+
+
+def api_cancel(request_id: str, url: Optional[str] = None) -> bool:
+    url = url or api_server_url(required=True)
+    r = requests_http.post(f'{url}/api/v1/request_cancel',
+                           json={'request_id': request_id}, timeout=30)
+    return bool(r.json().get('cancelled'))
+
+
+def api_list_requests(url: Optional[str] = None) -> List[Dict[str, Any]]:
+    url = url or api_server_url(required=True)
+    return requests_http.get(f'{url}/api/v1/requests', timeout=30).json()
+
+
+# ---------------------------------------------------------------------------
+# Typed RPCs (server-mode equivalents of the top-level SDK calls)
+# ---------------------------------------------------------------------------
+
+def launch(task, cluster_name: Optional[str] = None, *,
+           detach_run: bool = True, down: bool = False, dryrun: bool = False,
+           retry_until_up: bool = False, stream: bool = True) -> Any:
+    payload = {'task': task.to_yaml_config(), 'cluster_name': cluster_name,
+               'detach_run': detach_run, 'down': down, 'dryrun': dryrun,
+               'retry_until_up': retry_until_up}
+    rid = submit('launch', payload)
+    return stream_and_get(rid) if stream else get(rid)
+
+
+def exec(task, cluster_name: str, *,  # pylint: disable=redefined-builtin
+         detach_run: bool = True) -> Any:
+    rid = submit('exec', {'task': task.to_yaml_config(),
+                          'cluster_name': cluster_name,
+                          'detach_run': detach_run})
+    return get(rid)
+
+
+def status(cluster_names: Optional[List[str]] = None,
+           refresh: bool = False) -> Any:
+    return get(submit('status', {'cluster_names': cluster_names,
+                                 'refresh': refresh}))
+
+
+def queue(cluster_name: str) -> Any:
+    return get(submit('queue', {'cluster_name': cluster_name}))
+
+
+def down(cluster_name: str) -> Any:
+    return get(submit('down', {'cluster_name': cluster_name}))
+
+
+def stop(cluster_name: str) -> Any:
+    return get(submit('stop', {'cluster_name': cluster_name}))
+
+
+def start(cluster_name: str) -> Any:
+    return get(submit('start', {'cluster_name': cluster_name}))
+
+
+def cancel(cluster_name: str, job_ids: Optional[List[int]] = None) -> Any:
+    return get(submit('cancel', {'cluster_name': cluster_name,
+                                 'job_ids': job_ids}))
+
+
+def tail_logs(cluster_name: str, job_id: Optional[int] = None,
+              follow: bool = True) -> Any:
+    rid = submit('logs', {'cluster_name': cluster_name, 'job_id': job_id,
+                          'follow': follow})
+    return stream_and_get(rid)

@@ -121,7 +121,13 @@ def _execute_inner(
         job_id = backend.execute(handle, task, detach_run=detach_run)
 
     if Stage.DOWN in stages and down:
-        backend.teardown(handle, terminate=True)
+        if detach_run and job_id is not None:
+            # The job is still running — tearing down now would kill it.
+            # Arm autostop-down instead: the skylet terminates the slice
+            # once the job queue drains (reference: `--down` rides autostop).
+            backend.set_autostop(handle, 0, down=True)
+        else:
+            backend.teardown(handle, terminate=True)
     return job_id, handle
 
 

@@ -1,0 +1,8 @@
+"""API server plane: persisted async requests over aiohttp.
+
+Reference analog: sky/server/ (FastAPI app server.py:702-2087, request
+executor sky/server/requests/executor.py). Same architecture, TPU-repo
+dependencies: aiohttp instead of FastAPI/uvicorn, one subprocess per
+request (isolation + per-request logs + kill-based cancellation), sqlite
+request records so `skytpu api logs/get` can replay any request.
+"""

@@ -1,0 +1,89 @@
+"""Request scheduler: claims NEW requests and spawns runner subprocesses.
+
+Reference analog: sky/server/requests/executor.py (RequestQueue:112,
+RequestWorker:168, LONG/SHORT schedule types with guaranteed+burstable
+parallelism executor.py:173-188). Here: a scheduler thread per schedule
+type; LONG requests (launch/down/...) get a bounded pool so provisioning
+bursts cannot starve the box, SHORT requests (status/queue/...) a wider one.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.server import requests_lib
+
+logger = sky_logging.init_logger(__name__)
+
+LONG_PARALLELISM = max(2, min(8, (os.cpu_count() or 4) // 2))
+SHORT_PARALLELISM = 16
+
+
+class Scheduler:
+
+    def __init__(self) -> None:
+        self._procs: Dict[str, List[subprocess.Popen]] = {
+            requests_lib.LONG: [], requests_lib.SHORT: []}
+        self._limits = {requests_lib.LONG: LONG_PARALLELISM,
+                        requests_lib.SHORT: SHORT_PARALLELISM}
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    def start(self) -> None:
+        for sched_type in (requests_lib.LONG, requests_lib.SHORT):
+            t = threading.Thread(target=self._loop, args=(sched_type,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _loop(self, sched_type: str) -> None:
+        procs = self._procs[sched_type]
+        limit = self._limits[sched_type]
+        while not self._stop.is_set():
+            procs[:] = [p for p in procs if p.poll() is None]
+            spawned = False
+            if len(procs) < limit:
+                rec = requests_lib.next_pending(sched_type)
+                if rec is not None:
+                    procs.append(self._spawn(rec))
+                    spawned = True
+            if not spawned:
+                time.sleep(0.2)
+
+    def _spawn(self, rec) -> subprocess.Popen:
+        logger.info(f'request {rec["request_id"]} ({rec["name"]}) starting')
+        return subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.server.request_runner',
+             '--request-id', rec['request_id']],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+            start_new_session=True)
+
+
+def cancel_request(request_id: str) -> bool:
+    """Kill the runner (if running) and mark the record CANCELLED."""
+    rec = requests_lib.get(request_id)
+    if rec is None:
+        return False
+    status = requests_lib.RequestStatus(rec['status'])
+    if status.is_terminal():
+        return False
+    pid = rec.get('pid')
+    if pid:
+        try:
+            os.killpg(pid, signal.SIGTERM)
+        except (OSError, ProcessLookupError):
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except OSError:
+                pass
+    requests_lib.set_cancelled(rec['request_id'])
+    return True

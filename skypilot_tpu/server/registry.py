@@ -1,0 +1,133 @@
+"""Request name → handler mapping (reference analog: the per-endpoint
+bodies in sky/server/server.py routed into sky/execution.py / sky/core.py).
+
+Handlers take the JSON payload dict and return a JSON-able result. They run
+inside the per-request runner subprocess, so blocking is fine.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+from skypilot_tpu.server import requests_lib
+
+
+def _launch(payload: Dict[str, Any]) -> Dict[str, Any]:
+    import skypilot_tpu as sky
+    task = sky.Task.from_yaml_config(payload['task'])
+    job_id, handle = sky.launch(
+        task,
+        cluster_name=payload.get('cluster_name'),
+        dryrun=payload.get('dryrun', False),
+        detach_run=payload.get('detach_run', True),
+        down=payload.get('down', False),
+        retry_until_up=payload.get('retry_until_up', False),
+        no_setup=payload.get('no_setup', False),
+    )
+    return {'job_id': job_id,
+            'cluster_name': handle.cluster_name if handle else None}
+
+
+def _exec(payload: Dict[str, Any]) -> Dict[str, Any]:
+    import skypilot_tpu as sky
+    task = sky.Task.from_yaml_config(payload['task'])
+    job_id, handle = sky.exec(task, payload['cluster_name'],
+                              detach_run=payload.get('detach_run', True))
+    return {'job_id': job_id,
+            'cluster_name': handle.cluster_name if handle else None}
+
+
+def _status(payload: Dict[str, Any]) -> Any:
+    from skypilot_tpu import core
+    records = core.status(payload.get('cluster_names'),
+                          refresh=payload.get('refresh', False))
+    out = []
+    for r in records:
+        r = dict(r)
+        r.pop('handle', None)          # not JSON-able; CLI renders the rest
+        out.append(r)
+    return out
+
+
+def _start(payload):
+    from skypilot_tpu import core
+    core.start(payload['cluster_name'])
+    return {'cluster_name': payload['cluster_name']}
+
+
+def _stop(payload):
+    from skypilot_tpu import core
+    core.stop(payload['cluster_name'])
+    return {'cluster_name': payload['cluster_name']}
+
+
+def _down(payload):
+    from skypilot_tpu import core
+    core.down(payload['cluster_name'])
+    return {'cluster_name': payload['cluster_name']}
+
+
+def _autostop(payload):
+    from skypilot_tpu import core
+    core.autostop(payload['cluster_name'], payload.get('idle_minutes'),
+                  payload.get('down', False))
+    return {}
+
+
+def _queue(payload):
+    from skypilot_tpu import core
+    return core.queue(payload['cluster_name'])
+
+
+def _cancel(payload):
+    from skypilot_tpu import core
+    return {'cancelled': core.cancel(payload['cluster_name'],
+                                     payload.get('job_ids'))}
+
+
+def _logs(payload):
+    """Job logs print to this request's own log file; the client streams
+    them via /api/v1/stream?request_id=... (reference: sky api logs)."""
+    from skypilot_tpu import core
+    rc = core.tail_logs(payload['cluster_name'], payload.get('job_id'),
+                        follow=payload.get('follow', False))
+    return {'returncode': rc}
+
+
+def _check(payload):
+    from skypilot_tpu import check as check_lib
+    clouds = check_lib.check(quiet=True)
+    return {'enabled_clouds': [str(c) for c in clouds]}
+
+
+def _cost_report(payload):
+    from skypilot_tpu import core
+    return core.cost_report()
+
+
+def _list_accelerators(payload):
+    import dataclasses
+    from skypilot_tpu.catalog import tpu_catalog
+    offers = tpu_catalog.list_accelerators(
+        name_filter=payload.get('name_filter'),
+        region_filter=payload.get('region_filter'),
+        max_chips=payload.get('max_chips'))
+    return {name: [dataclasses.asdict(o) for o in infos]
+            for name, infos in offers.items()}
+
+
+# name -> (handler, schedule_type)
+HANDLERS: Dict[str, Tuple[Callable[[Dict[str, Any]], Any], str]] = {
+    'launch': (_launch, requests_lib.LONG),
+    'exec': (_exec, requests_lib.LONG),
+    'start': (_start, requests_lib.LONG),
+    'stop': (_stop, requests_lib.LONG),
+    'down': (_down, requests_lib.LONG),
+    'status': (_status, requests_lib.SHORT),
+    'autostop': (_autostop, requests_lib.SHORT),
+    'queue': (_queue, requests_lib.SHORT),
+    'cancel': (_cancel, requests_lib.SHORT),
+    'logs': (_logs, requests_lib.SHORT),
+    'check': (_check, requests_lib.SHORT),
+    'cost_report': (_cost_report, requests_lib.SHORT),
+    'list_accelerators': (_list_accelerators, requests_lib.SHORT),
+}

@@ -1,0 +1,47 @@
+"""Per-request runner subprocess (reference analog: the executor worker
+process in sky/server/requests/executor.py — here one process per request,
+which gives isolation, per-request logs and kill()-based cancellation).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import traceback
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(prog='request_runner')
+    parser.add_argument('--request-id', required=True)
+    args = parser.parse_args()
+
+    from skypilot_tpu.server import registry, requests_lib
+
+    rec = requests_lib.get(args.request_id)
+    if rec is None:
+        print(f'unknown request {args.request_id}', file=sys.stderr)
+        sys.exit(2)
+
+    log = open(requests_lib.log_path(rec['request_id']), 'a', buffering=1,
+               encoding='utf-8')
+    os.dup2(log.fileno(), sys.stdout.fileno())
+    os.dup2(log.fileno(), sys.stderr.fileno())
+
+    requests_lib.set_running(rec['request_id'], os.getpid())
+    handler, _ = registry.HANDLERS[rec['name']]
+    try:
+        result = handler(rec['payload'])
+    except SystemExit as e:
+        if e.code in (None, 0):
+            requests_lib.set_result(rec['request_id'], None)
+            return
+        requests_lib.set_failed(rec['request_id'], f'exit code {e.code}')
+        raise
+    except BaseException:  # pylint: disable=broad-except
+        requests_lib.set_failed(rec['request_id'], traceback.format_exc())
+        sys.exit(1)
+    requests_lib.set_result(rec['request_id'], result)
+
+
+if __name__ == '__main__':
+    main()

@@ -1,0 +1,156 @@
+"""Persisted API request records (reference analog: sky/server/requests/requests.py).
+
+Each RPC becomes a row; the executor runs it in a subprocess; the row
+carries status, JSON payload/result, the runner pid (for cancellation) and
+the per-request log path (for `skytpu api logs`).
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import sqlite3
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+SHORT = 'SHORT'
+LONG = 'LONG'
+
+
+class RequestStatus(str, enum.Enum):
+    NEW = 'NEW'
+    RUNNING = 'RUNNING'
+    SUCCEEDED = 'SUCCEEDED'
+    FAILED = 'FAILED'
+    CANCELLED = 'CANCELLED'
+
+    def is_terminal(self) -> bool:
+        return self in (RequestStatus.SUCCEEDED, RequestStatus.FAILED,
+                        RequestStatus.CANCELLED)
+
+
+def server_dir() -> str:
+    d = os.path.expanduser(os.environ.get('SKYTPU_SERVER_DIR',
+                                          '~/.skytpu/api_server'))
+    os.makedirs(d, exist_ok=True)
+    os.makedirs(os.path.join(d, 'logs'), exist_ok=True)
+    return d
+
+
+def _db_path() -> str:
+    return os.path.join(server_dir(), 'requests.db')
+
+
+def _conn() -> sqlite3.Connection:
+    conn = sqlite3.connect(_db_path(), timeout=30.0)
+    conn.execute('PRAGMA journal_mode=WAL')
+    conn.execute("""CREATE TABLE IF NOT EXISTS requests (
+        request_id TEXT PRIMARY KEY,
+        name TEXT,
+        payload TEXT,
+        status TEXT,
+        schedule_type TEXT,
+        result TEXT,
+        error TEXT,
+        pid INTEGER,
+        user TEXT,
+        created_at REAL,
+        started_at REAL,
+        finished_at REAL)""")
+    return conn
+
+
+def log_path(request_id: str) -> str:
+    return os.path.join(server_dir(), 'logs', f'{request_id}.log')
+
+
+def create(name: str, payload: Dict[str, Any], schedule_type: str = LONG,
+           user: str = '') -> str:
+    request_id = uuid.uuid4().hex[:16]
+    with _conn() as conn:
+        conn.execute(
+            'INSERT INTO requests (request_id, name, payload, status, '
+            'schedule_type, user, created_at) VALUES (?,?,?,?,?,?,?)',
+            (request_id, name, json.dumps(payload), RequestStatus.NEW.value,
+             schedule_type, user, time.time()))
+    return request_id
+
+
+def get(request_id: str) -> Optional[Dict[str, Any]]:
+    with _conn() as conn:
+        row = conn.execute(
+            'SELECT request_id, name, payload, status, schedule_type, '
+            'result, error, pid, user, created_at, started_at, finished_at '
+            'FROM requests WHERE request_id LIKE ?',
+            (request_id + '%',)).fetchone()
+    if row is None:
+        return None
+    keys = ['request_id', 'name', 'payload', 'status', 'schedule_type',
+            'result', 'error', 'pid', 'user', 'created_at', 'started_at',
+            'finished_at']
+    rec = dict(zip(keys, row))
+    rec['payload'] = json.loads(rec['payload']) if rec['payload'] else {}
+    rec['result'] = json.loads(rec['result']) if rec['result'] else None
+    return rec
+
+
+def list_requests(limit: int = 100) -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        rows = conn.execute(
+            'SELECT request_id, name, status, user, created_at, finished_at '
+            'FROM requests ORDER BY created_at DESC LIMIT ?',
+            (limit,)).fetchall()
+    keys = ['request_id', 'name', 'status', 'user', 'created_at',
+            'finished_at']
+    return [dict(zip(keys, r)) for r in rows]
+
+
+def next_pending(schedule_type: str) -> Optional[Dict[str, Any]]:
+    """Atomically claim the oldest NEW request of this schedule type."""
+    with _conn() as conn:
+        row = conn.execute(
+            'SELECT request_id FROM requests WHERE status=? AND '
+            'schedule_type=? ORDER BY created_at LIMIT 1',
+            (RequestStatus.NEW.value, schedule_type)).fetchone()
+        if row is None:
+            return None
+        # Claim: NEW -> RUNNING happens in the runner; mark as claimed by
+        # setting started_at so the scheduler does not double-spawn.
+        cur = conn.execute(
+            'UPDATE requests SET started_at=? WHERE request_id=? AND '
+            'started_at IS NULL', (time.time(), row[0]))
+        if cur.rowcount == 0:
+            return None
+    return get(row[0])
+
+
+def set_running(request_id: str, pid: int) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'UPDATE requests SET status=?, pid=? WHERE request_id=?',
+            (RequestStatus.RUNNING.value, pid, request_id))
+
+
+def set_result(request_id: str, result: Any) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'UPDATE requests SET status=?, result=?, finished_at=? '
+            'WHERE request_id=?',
+            (RequestStatus.SUCCEEDED.value, json.dumps(result), time.time(),
+             request_id))
+
+
+def set_failed(request_id: str, error: str) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'UPDATE requests SET status=?, error=?, finished_at=? '
+            'WHERE request_id=?',
+            (RequestStatus.FAILED.value, error, time.time(), request_id))
+
+
+def set_cancelled(request_id: str) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'UPDATE requests SET status=?, finished_at=? WHERE request_id=?',
+            (RequestStatus.CANCELLED.value, time.time(), request_id))

@@ -1,0 +1,150 @@
+"""aiohttp API server (reference analog: sky/server/server.py FastAPI app).
+
+Endpoints (all JSON):
+  GET  /api/v1/health                  — liveness + version
+  POST /api/v1/{name}                  — enqueue request → {request_id}
+  GET  /api/v1/get?request_id=&wait=1  — request record (optionally block)
+  GET  /api/v1/stream?request_id=      — chunked log streaming (follows
+                                         until the request finishes)
+  GET  /api/v1/requests                — list request records
+  POST /api/v1/request_cancel          — cancel {request_id}
+
+Run: `skytpu api start` (daemonized) or
+`python -m skypilot_tpu.server.server --port 46580` (foreground).
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+from typing import Any
+
+from aiohttp import web
+
+import skypilot_tpu
+from skypilot_tpu import sky_logging
+from skypilot_tpu.server import executor, registry, requests_lib
+
+logger = sky_logging.init_logger(__name__)
+
+DEFAULT_PORT = 46580
+
+
+def _json(data: Any, status: int = 200) -> web.Response:
+    return web.json_response(data, status=status)
+
+
+async def health(request: web.Request) -> web.Response:
+    return _json({'status': 'healthy', 'version': skypilot_tpu.__version__,
+                  'commit': os.environ.get('SKYTPU_COMMIT', 'dev')})
+
+
+async def submit(request: web.Request) -> web.Response:
+    name = request.match_info['name']
+    if name not in registry.HANDLERS:
+        return _json({'error': f'unknown request name {name!r}'}, status=404)
+    try:
+        payload = await request.json()
+    except json.JSONDecodeError:
+        payload = {}
+    _, sched_type = registry.HANDLERS[name]
+    request_id = requests_lib.create(name, payload, sched_type,
+                                     user=request.headers.get('X-User', ''))
+    return _json({'request_id': request_id})
+
+
+async def get_request(request: web.Request) -> web.Response:
+    request_id = request.query.get('request_id', '')
+    wait = request.query.get('wait', '0') == '1'
+    rec = requests_lib.get(request_id)
+    if rec is None:
+        return _json({'error': f'no request {request_id!r}'}, status=404)
+    while wait and not requests_lib.RequestStatus(rec['status']).is_terminal():
+        await asyncio.sleep(0.2)
+        rec = requests_lib.get(request_id)
+    return _json(rec)
+
+
+async def stream(request: web.Request) -> web.StreamResponse:
+    request_id = request.query.get('request_id', '')
+    rec = requests_lib.get(request_id)
+    if rec is None:
+        return _json({'error': f'no request {request_id!r}'}, status=404)
+    request_id = rec['request_id']
+    path = requests_lib.log_path(request_id)
+
+    resp = web.StreamResponse(
+        headers={'Content-Type': 'text/plain; charset=utf-8'})
+    await resp.prepare(request)
+    pos = 0
+    while True:
+        chunk = b''
+        if os.path.exists(path):
+            with open(path, 'rb') as f:
+                f.seek(pos)
+                chunk = f.read()
+                pos = f.tell()
+        if chunk:
+            await resp.write(chunk)
+        rec = requests_lib.get(request_id)
+        if rec is None or requests_lib.RequestStatus(
+                rec['status']).is_terminal():
+            # Drain whatever arrived between the read and the status check.
+            if os.path.exists(path):
+                with open(path, 'rb') as f:
+                    f.seek(pos)
+                    tail = f.read()
+                if tail:
+                    await resp.write(tail)
+            break
+        await asyncio.sleep(0.2)
+    await resp.write_eof()
+    return resp
+
+
+async def list_requests(request: web.Request) -> web.Response:
+    limit = int(request.query.get('limit', '100'))
+    return _json(requests_lib.list_requests(limit))
+
+
+async def request_cancel(request: web.Request) -> web.Response:
+    payload = await request.json()
+    ok = executor.cancel_request(payload.get('request_id', ''))
+    return _json({'cancelled': ok})
+
+
+def build_app() -> web.Application:
+    app = web.Application()
+    app.router.add_get('/api/v1/health', health)
+    app.router.add_get('/api/v1/get', get_request)
+    app.router.add_get('/api/v1/stream', stream)
+    app.router.add_get('/api/v1/requests', list_requests)
+    app.router.add_post('/api/v1/request_cancel', request_cancel)
+    app.router.add_post('/api/v1/{name}', submit)
+    return app
+
+
+def run(host: str = '127.0.0.1', port: int = DEFAULT_PORT) -> None:
+    sched = executor.Scheduler()
+    sched.start()
+    app = build_app()
+    d = requests_lib.server_dir()
+    with open(os.path.join(d, 'endpoint'), 'w', encoding='utf-8') as f:
+        f.write(f'http://{host}:{port}')
+    with open(os.path.join(d, 'server.pid'), 'w', encoding='utf-8') as f:
+        f.write(str(os.getpid()))
+    logger.info(f'API server on http://{host}:{port}')
+    web.run_app(app, host=host, port=port, print=None)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(prog='skytpu-api-server')
+    parser.add_argument('--host', default='127.0.0.1')
+    parser.add_argument('--port', type=int, default=DEFAULT_PORT)
+    args = parser.parse_args()
+    run(args.host, args.port)
+
+
+if __name__ == '__main__':
+    main()

@@ -17,14 +17,21 @@ def _path() -> str:
 
 
 def set_autostop(idle_minutes: Optional[int], down: bool,
-                 cloud: str, region: str, cluster_name: str) -> None:
-    """idle_minutes None disables autostop."""
+                 cloud: str, region: str, cluster_name: str,
+                 provider_config: Optional[Dict[str, Any]] = None) -> None:
+    """idle_minutes None disables autostop.
+
+    provider_config (zones, project, ...) is persisted so the self-teardown
+    can locate its own instances — without it, per-cloud terminate/stop
+    finds no nodes and the slice keeps billing.
+    """
     payload = {
         'idle_minutes': idle_minutes,
         'down': down,
         'cloud': cloud,
         'region': region,
         'cluster_name': cluster_name,
+        'provider_config': provider_config or {},
         'set_at': time.time(),
     }
     os.makedirs(job_lib.runtime_dir(), exist_ok=True)

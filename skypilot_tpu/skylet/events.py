@@ -53,10 +53,11 @@ class AutostopEvent(SkyletEvent):
         cloud = cfg['cloud']
         region = cfg['region']
         cluster = cfg['cluster_name']
+        pc = cfg.get('provider_config') or None
         if cfg.get('down'):
-            provision.terminate_instances(cloud, region, cluster)
+            provision.terminate_instances(cloud, region, cluster, pc)
         else:
-            provision.stop_instances(cloud, region, cluster)
+            provision.stop_instances(cloud, region, cluster, pc)
 
 
 class JobHeartbeatEvent(SkyletEvent):

@@ -55,10 +55,26 @@ def _build_rank_command(host: Dict[str, Any], run_cmd: str,
     assert host['kind'] == 'ssh', host
     ssh = host['ssh']
     from skypilot_tpu.utils import command_runner
-    base = ['ssh'] + command_runner.ssh_options_list(
+    # -tt: force a TTY so the remote session gets SIGHUP (killing the whole
+    # remote process group) when the local ssh client is terminated by the
+    # gang teardown — without it, killing the client orphans the rank.
+    base = ['ssh', '-tt'] + command_runner.ssh_options_list(
         ssh.get('private_key'), None) + ['-p', str(ssh.get('port', 22))]
     base.append(f'{ssh["user"]}@{ssh["ip"]}')
     base.append(f'bash --login -c {shlex.quote(inner)}')
+    return base
+
+
+def _remote_cleanup_cmd(host: Dict[str, Any], job_id: int) -> Optional[List[str]]:
+    """Best-effort remote kill of a rank's process tree (no-TTY fallback)."""
+    if host.get('kind') != 'ssh':
+        return None
+    ssh = host['ssh']
+    from skypilot_tpu.utils import command_runner
+    base = ['ssh'] + command_runner.ssh_options_list(
+        ssh.get('private_key'), None) + ['-p', str(ssh.get('port', 22))]
+    base.append(f'{ssh["user"]}@{ssh["ip"]}')
+    base.append(f'pkill -TERM -f "SKYTPU_JOB_ID={job_id};" || true')
     return base
 
 
@@ -155,6 +171,13 @@ def run_gang(spec: Dict[str, Any]) -> int:
                                     other.proc.terminate()
                                 except OSError:
                                     pass
+                                cleanup = _remote_cleanup_cmd(
+                                    hosts[other.rank], job_id)
+                                if cleanup is not None:
+                                    subprocess.Popen(
+                                        cleanup,
+                                        stdout=subprocess.DEVNULL,
+                                        stderr=subprocess.DEVNULL)
             if pending:
                 time.sleep(0.2)
         # All rank processes have exited, so each pump hits stdout EOF and

@@ -25,7 +25,7 @@ _VALID_ENV_VAR = re.compile(r'^[a-zA-Z_][a-zA-Z0-9_]*$')
 
 _TASK_YAML_FIELDS = frozenset({
     'name', 'resources', 'num_nodes', 'workdir', 'setup', 'run', 'envs',
-    'secrets', 'file_mounts', 'config', 'service',
+    'secrets', 'file_mounts', 'config', 'service', 'estimated',
 })
 
 ResourcesSpec = Union[resources_lib.Resources,
@@ -68,7 +68,13 @@ class Task:
         self.config_overrides: Dict[str, Any] = {}
         self.service_spec: Optional[Dict[str, Any]] = None
         self.best_resources: Optional[resources_lib.Resources] = None
+        # Optimizer time/egress model inputs (YAML `estimated:` section):
+        #   duration_seconds — wall-clock guess for TIME optimization;
+        #   total_flops — model FLOPs, converted to time per candidate slice;
+        #   output_gb — data shipped to children (egress cost on DAG edges).
         self.estimated_duration_seconds: Optional[float] = None
+        self.estimated_total_flops: Optional[float] = None
+        self.estimated_output_gb: float = 0.0
 
     # ------------------------------------------------------------------
     # Construction
@@ -111,6 +117,19 @@ class Task:
             task.set_file_mounts(plain_mounts)
         task.config_overrides = dict(config.get('config') or {})
         task.service_spec = config.get('service')
+        est = config.get('estimated') or {}
+        if not isinstance(est, dict):
+            raise ValueError("'estimated:' must be a mapping with any of "
+                             "duration_seconds/total_flops/output_gb")
+        unknown_est = set(est) - {'duration_seconds', 'total_flops',
+                                  'output_gb'}
+        if unknown_est:
+            raise ValueError(f'Unknown estimated fields: {sorted(unknown_est)}')
+        if est.get('duration_seconds') is not None:
+            task.estimated_duration_seconds = float(est['duration_seconds'])
+        if est.get('total_flops') is not None:
+            task.estimated_total_flops = float(est['total_flops'])
+        task.estimated_output_gb = float(est.get('output_gb') or 0.0)
         task.validate()
         return task
 
@@ -153,6 +172,15 @@ class Task:
             cfg['config'] = dict(self.config_overrides)
         if self.service_spec:
             cfg['service'] = dict(self.service_spec)
+        est: Dict[str, Any] = {}
+        if self.estimated_duration_seconds is not None:
+            est['duration_seconds'] = self.estimated_duration_seconds
+        if self.estimated_total_flops is not None:
+            est['total_flops'] = self.estimated_total_flops
+        if self.estimated_output_gb:
+            est['output_gb'] = self.estimated_output_gb
+        if est:
+            cfg['estimated'] = est
         return cfg
 
     # ------------------------------------------------------------------

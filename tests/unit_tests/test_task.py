@@ -101,3 +101,24 @@ class TestTaskYaml:
         t = task_lib.Task(run='echo hi')
         assert t.num_nodes == 1
         assert t.resources_list()[0].tpu is None
+
+
+def test_estimated_section_round_trip():
+    from skypilot_tpu.task import Task
+    cfg = {
+        'name': 'est',
+        'run': 'echo hi',
+        'estimated': {'total_flops': 1e18, 'output_gb': 2.5},
+    }
+    t = Task.from_yaml_config(cfg)
+    assert t.estimated_total_flops == 1e18
+    assert t.estimated_output_gb == 2.5
+    out = t.to_yaml_config()
+    assert out['estimated'] == {'total_flops': 1e18, 'output_gb': 2.5}
+
+
+def test_estimated_section_unknown_field():
+    import pytest
+    from skypilot_tpu.task import Task
+    with pytest.raises(ValueError, match='estimated'):
+        Task.from_yaml_config({'run': 'x', 'estimated': {'zap': 1}})
