@@ -10,18 +10,133 @@ docs/source/reference/tpu.rst:100-118). Model FLOPs/sample =
 (6N + 6·L·S·H·hd)·S ≈ 4.46e14 → 26.6 TFLOP/s/chip on v6e (918 peak bf16)
 = **2.90% MFU**. vs_baseline = our_mfu / 2.90 (MFU is chip-neutral, so the
 comparison holds on whatever generation this runs on).
+
+Robustness: TPU backend init through the tunnel can fail transiently
+(UNAVAILABLE) or hang when a stale process still holds the chip. A failed
+init is cached for the life of the process, so the measurement runs in a
+CHILD process and the parent retries with backoff, diagnosing (and, for
+obviously-stale bench processes, killing) chip holders between attempts.
 """
 import dataclasses
 import json
 import os
+import signal
+import subprocess
 import sys
 import time
 
-import jax
-import jax.numpy as jnp
-
 BASELINE_MFU_PCT = 2.90
+CHILD_ENV = 'SKYTPU_BENCH_CHILD'
+PROBE_ENV = 'SKYTPU_BENCH_PROBE'
+ATTEMPT_TIMEOUT_S = int(os.environ.get('SKYTPU_BENCH_ATTEMPT_TIMEOUT', '600'))
+PROBE_TIMEOUT_S = int(os.environ.get('SKYTPU_BENCH_PROBE_TIMEOUT', '120'))
+BACKOFFS_S = (5, 15, 30, 60)
 
+
+# ---------------------------------------------------------------------------
+# Parent: retry supervisor
+# ---------------------------------------------------------------------------
+
+def _chip_holder_pids():
+    """PIDs (other than ours/our ancestors) that look like stale TPU users:
+    python processes with libtpu mapped or /dev/accel open."""
+    me = os.getpid()
+    ancestors = set()
+    pid = me
+    for _ in range(10):
+        try:
+            with open(f'/proc/{pid}/stat') as f:
+                # comm may contain spaces/parens; fields after the LAST ')'
+                # are fixed-position (state ppid ...).
+                pid = int(f.read().rsplit(')', 1)[1].split()[1])
+        except (OSError, ValueError, IndexError):
+            break
+        ancestors.add(pid)
+    holders = []
+    for entry in os.listdir('/proc'):
+        if not entry.isdigit():
+            continue
+        pid = int(entry)
+        if pid == me or pid in ancestors:
+            continue
+        try:
+            with open(f'/proc/{pid}/maps') as f:
+                maps = f.read()
+        except OSError:
+            continue
+        if 'libtpu' in maps or '/dev/accel' in maps or '/dev/vfio' in maps:
+            try:
+                with open(f'/proc/{pid}/cmdline') as f:
+                    cmd = f.read().replace('\0', ' ').strip()
+            except OSError:
+                cmd = '?'
+            holders.append((pid, cmd))
+    return holders
+
+
+def _diagnose_and_reap():
+    holders = _chip_holder_pids()
+    for pid, cmd in holders:
+        print(f'[bench] chip holder: pid={pid} cmd={cmd!r}', file=sys.stderr)
+        # Only reap processes that are clearly stale: bench/dryrun children
+        # that have been ORPHANED (reparented to init) — a live concurrent
+        # run still has its supervisor as parent and is left alone.
+        stale = ('bench.py' in cmd or '__graft_entry__' in cmd)
+        try:
+            with open(f'/proc/{pid}/stat') as f:
+                ppid = int(f.read().rsplit(')', 1)[1].split()[1])
+        except (OSError, ValueError, IndexError):
+            ppid = -1
+        if stale and ppid == 1:
+            print(f'[bench] killing orphaned bench process {pid}',
+                  file=sys.stderr)
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+    if not holders:
+        print('[bench] no local chip holders found '
+              '(failure may be on the tunnel/server side)', file=sys.stderr)
+
+
+def _run_child(extra_env, timeout_s) -> int:
+    env = dict(os.environ, **extra_env)
+    try:
+        return subprocess.run([sys.executable, os.path.abspath(__file__)],
+                              env=env, timeout=timeout_s).returncode
+    except subprocess.TimeoutExpired:
+        return 124
+
+
+def supervise() -> int:
+    attempts = 1 + len(BACKOFFS_S)
+    for i in range(attempts):
+        t0 = time.time()
+        # Phase 1: cheap backend-init probe under a short timeout. A hung
+        # init (stale chip holder / dead tunnel) burns 2 minutes here, not
+        # the full measurement budget.
+        rc = _run_child({PROBE_ENV: '1'}, PROBE_TIMEOUT_S)
+        if rc == 0:
+            # Phase 2: the measurement (fresh process re-inits the backend).
+            rc = _run_child({CHILD_ENV: '1'}, ATTEMPT_TIMEOUT_S)
+            if rc == 0:
+                return 0
+        print(f'[bench] attempt {i + 1}/{attempts} failed rc={rc} '
+              f'after {time.time() - t0:.0f}s', file=sys.stderr)
+        if i < attempts - 1:
+            _diagnose_and_reap()
+            backoff = BACKOFFS_S[i]
+            print(f'[bench] retrying in {backoff}s', file=sys.stderr)
+            time.sleep(backoff)
+    print('[bench] FAILED: could not initialize the TPU and measure MFU '
+          f'after {attempts} attempts. See diagnostics above.',
+          file=sys.stderr)
+    return 1
+
+
+# ---------------------------------------------------------------------------
+# Child: the actual measurement
+# ---------------------------------------------------------------------------
 
 def _peak_tflops(device) -> float:
     from skypilot_tpu.tpu import topology
@@ -52,11 +167,37 @@ def model_flops_per_token(cfg, seq_len: int) -> float:
         cfg.n_heads * cfg.hd
 
 
-def main():
+def _get_device():
+    """Resolve the bench device with a clear error path.
+
+    A bare `jax.devices()` goes through the default-backend resolution hook,
+    which initializes the TPU plugin — that can raise UNAVAILABLE
+    transiently or hang outright when the chip is held elsewhere. When the
+    user pinned JAX_PLATFORMS to cpu (dev boxes), go straight to the CPU
+    backend, which skips the TPU plugin entirely."""
+    import jax
+    plat = os.environ.get('JAX_PLATFORMS', '')
+    if plat and 'tpu' not in plat and 'axon' not in plat:
+        # The axon site hook force-registers its plugin in jax_platforms;
+        # only an explicit config update keeps `backends()` from booting it.
+        try:
+            jax.config.update('jax_platforms', plat)
+        except Exception:
+            pass
+        return jax.devices(plat.split(',')[0])[0]
+    try:
+        return jax.devices()[0]
+    except RuntimeError as e:
+        print(f'[bench] TPU backend init failed: {e}', file=sys.stderr)
+        raise SystemExit(2)
+
+
+def run_bench():
+    import jax
     from skypilot_tpu.parallel import MeshSpec, build_mesh
     from skypilot_tpu.train import train_lib
 
-    device = jax.devices()[0]
+    device = _get_device()
     on_tpu = device.platform == 'tpu'
     cfg, batch_size, seq_len = _bench_config(on_tpu)
     mesh = build_mesh(MeshSpec(fsdp=1), devices=[device])
@@ -95,8 +236,15 @@ def main():
         'value': round(mfu_pct, 2),
         'unit': '%',
         'vs_baseline': round(mfu_pct / BASELINE_MFU_PCT, 2),
-    }))
+    }), flush=True)
 
 
 if __name__ == '__main__':
-    main()
+    if os.environ.get(PROBE_ENV) == '1':
+        dev = _get_device()
+        print(f'[bench] backend ok: {dev.device_kind} ({dev.platform})',
+              file=sys.stderr)
+    elif os.environ.get(CHILD_ENV) == '1':
+        run_bench()
+    else:
+        sys.exit(supervise())
