@@ -282,6 +282,96 @@ def cost_report():
 
 
 @cli.group()
+def jobs():
+    """Managed jobs: auto-recovery from TPU spot preemption
+    (reference: `sky jobs`)."""
+
+
+@jobs.command(name='launch')
+@click.argument('entrypoint', required=True)
+@click.option('--name', '-n', default=None, help='Managed job name.')
+@click.option('--env', multiple=True, help='KEY=VALUE task env overrides.')
+@click.option('--detach-run', '-d', is_flag=True, default=False,
+              help='Return immediately instead of streaming logs.')
+@_resource_options
+def jobs_launch(entrypoint: str, name: Optional[str], env: Tuple[str, ...],
+                detach_run: bool, **overrides):
+    """Submit a managed job (controller handles recovery)."""
+    from skypilot_tpu import jobs as jobs_lib
+    task = _load_task(entrypoint, env, overrides)
+    try:
+        job_id = jobs_lib.launch(task, name=name)
+    except (exceptions.SkyTpuError, ValueError) as e:
+        raise click.ClickException(str(e)) from e
+    click.echo(f'Managed job {job_id} submitted.')
+    if not detach_run:
+        jobs_lib.tail_logs(job_id, follow=True)
+
+
+@jobs.command(name='queue')
+@click.option('--skip-finished', '-s', is_flag=True, default=False)
+def jobs_queue(skip_finished: bool):
+    """Show managed jobs."""
+    from skypilot_tpu import jobs as jobs_lib
+    rows = jobs_lib.queue(skip_finished=skip_finished)
+    if not rows:
+        click.echo('No managed jobs.')
+        return
+    import time as time_lib
+    header = ('ID', 'NAME', 'STATUS', 'CLUSTER', '#RECOVERIES', 'AGE')
+    click.echo('  '.join(h.ljust(12) for h in header))
+    for j in rows:
+        age = common_utils.format_duration(
+            max(0.0, time_lib.time() - (j['submitted_at'] or 0)))
+        # Pad by the *visible* status width; colored_str adds ANSI escapes.
+        status_cell = (j['status'].colored_str() +
+                       ' ' * max(0, 12 - len(j['status'].value)))
+        click.echo('  '.join((str(j['job_id']).ljust(12),
+                              str(j['name']).ljust(12), status_cell,
+                              str(j['cluster_name'] or '-').ljust(12),
+                              str(j['recovery_count']).ljust(12), age)))
+
+
+@jobs.command(name='logs')
+@click.argument('job_id', required=False, type=int)
+@click.option('--no-follow', is_flag=True, default=False)
+@click.option('--controller', is_flag=True, default=False,
+              help="Show the job's controller log instead.")
+def jobs_logs(job_id: Optional[int], no_follow: bool, controller: bool):
+    """Tail a managed job's logs (survives preemption/teardown)."""
+    from skypilot_tpu import jobs as jobs_lib
+    try:
+        rc = jobs_lib.tail_logs(job_id, follow=not no_follow,
+                                controller=controller)
+    except exceptions.SkyTpuError as e:
+        raise click.ClickException(str(e)) from e
+    sys.exit(rc)
+
+
+@jobs.command(name='cancel')
+@click.argument('job_ids', nargs=-1, type=int)
+@click.option('--name', '-n', default=None)
+@click.option('--all', '-a', 'all_jobs', is_flag=True, default=False)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def jobs_cancel(job_ids: Tuple[int, ...], name: Optional[str],
+                all_jobs: bool, yes: bool):
+    """Cancel managed job(s)."""
+    from skypilot_tpu import jobs as jobs_lib
+    if not (job_ids or name or all_jobs):
+        raise click.UsageError('Pass job ids, --name, or --all.')
+    if not yes:
+        what = 'ALL managed jobs' if all_jobs else (
+            f'jobs {list(job_ids)}{f" named {name!r}" if name else ""}')
+        click.confirm(f'Cancel {what}?', abort=True)
+    try:
+        done = jobs_lib.cancel(job_ids=list(job_ids) or None, name=name,
+                               all_jobs=all_jobs)
+    except (exceptions.SkyTpuError, ValueError) as e:
+        raise click.ClickException(str(e)) from e
+    click.echo(f'Cancellation requested: {done}')
+
+
+@cli.group()
 def api():
     """Manage the API server (reference: `sky api`)."""
 

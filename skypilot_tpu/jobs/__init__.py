@@ -1,0 +1,16 @@
+"""Managed jobs plane: submit → controller → launch/monitor/recover.
+
+Reference analog: sky/jobs/ (controller.py, recovery_strategy.py,
+scheduler.py, state.py). TPU-first redesign: controllers are detached local
+processes next to the API server (no dedicated controller cluster to
+provision), and preemption recovery knows the TPU wrinkle that a preempted
+spot slice must be deleted before it can be recreated
+(sky/clouds/gcp.py:1095-1101).
+"""
+from skypilot_tpu.jobs.core import cancel
+from skypilot_tpu.jobs.core import launch
+from skypilot_tpu.jobs.core import queue
+from skypilot_tpu.jobs.core import tail_logs
+from skypilot_tpu.jobs.state import ManagedJobStatus
+
+__all__ = ['launch', 'queue', 'cancel', 'tail_logs', 'ManagedJobStatus']

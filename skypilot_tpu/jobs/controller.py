@@ -1,0 +1,225 @@
+"""Per-job controller: launch → monitor → recover loop.
+
+Reference analog: sky/jobs/controller.py (the asyncio controller driving
+launch/monitor/recover on a controller cluster). Redesigned as one plain
+detached process per managed job running next to the API server: TPU slices
+are atomic gang resources, so there is no per-node bookkeeping that would
+justify an asyncio fan-out, and a process boundary means a crashed
+controller can never corrupt its siblings (the scheduler enforces the
+parallelism cap, scheduler.py).
+
+The monitor loop's liveness check is two-level, in this order:
+1. cluster liveness via provision.query_instances — a preempted/deleted
+   slice (the spot case) means RECOVERING regardless of last job status;
+2. on-cluster job status via the skylet queue — SUCCEEDED/FAILED only count
+   when the cluster itself is still alive.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import time
+import traceback
+from typing import Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_state
+from skypilot_tpu import provision
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.backends import slice_backend
+from skypilot_tpu.jobs import recovery_strategy
+from skypilot_tpu.jobs import state
+from skypilot_tpu.utils.status_lib import JobStatus
+
+logger = sky_logging.init_logger(__name__)
+
+# Seconds between monitor polls (reference: JOB_STATUS_CHECK_GAP ~ 15-30s;
+# kept low and env-tunable so hermetic tests run in seconds).
+POLL_SECONDS = float(os.environ.get('SKYTPU_JOBS_POLL_SECONDS', '10'))
+
+
+def _generate_cluster_name(job_id: int, name: str) -> str:
+    safe = ''.join(c if c.isalnum() or c == '-' else '-' for c in name.lower())
+    return f'jobs-{safe[:20].strip("-") or "job"}-{job_id}'
+
+
+class JobsController:
+    """Drives one managed job to a terminal state."""
+
+    def __init__(self, job_id: int):
+        self.job_id = job_id
+        record = state.get_job(job_id)
+        if record is None:
+            raise exceptions.ManagedJobStatusError(
+                f'Managed job {job_id} not found.')
+        self.record = record
+        self.task = task_lib.Task.from_yaml_config(record['task_config'])
+        self.cluster_name = record['cluster_name'] or _generate_cluster_name(
+            job_id, record['name'] or 'job')
+        self.strategy = recovery_strategy.StrategyExecutor.make(
+            self.cluster_name, self.task, job_id)
+
+    # ------------------------------------------------------------------
+    def _cluster_alive(self) -> bool:
+        """Cloud-truth liveness of the job's slice (preemption detector)."""
+        record = global_state.get_cluster(self.cluster_name)
+        if record is None:
+            return False
+        handle = slice_backend.SliceResourceHandle.from_dict(record['handle'])
+        try:
+            statuses = provision.query_instances(handle.cloud, handle.region,
+                                                 self.cluster_name,
+                                                 handle.provider_config)
+        except exceptions.ClusterDoesNotExist:
+            return False
+        except Exception as e:  # pylint: disable=broad-except
+            # Transient cloud API failure: do NOT treat as preemption — a
+            # false positive would tear down a healthy (billing) slice.
+            logger.warning(f'liveness probe failed (assuming alive): {e}')
+            return True
+        if not statuses:
+            return False
+        return all(s in ('running', 'READY') for s in statuses.values())
+
+    def _job_status(self, cluster_job_id: Optional[int]
+                    ) -> Optional[JobStatus]:
+        if cluster_job_id is None or self.strategy.handle is None:
+            return None
+        try:
+            return self.strategy.backend.job_status(self.strategy.handle,
+                                                    cluster_job_id)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'job status probe failed: {e}')
+            return None
+
+    def _mirror_logs(self, cluster_job_id: Optional[int]) -> None:
+        """Copy the aggregated run log off the cluster so `jobs logs` works
+        even after the slice is preempted/torn down."""
+        if cluster_job_id is None or self.strategy.handle is None:
+            return
+        try:
+            info = self.strategy.handle.get_cluster_info()
+            from skypilot_tpu.provision import provisioner as provisioner_lib
+            runner = provisioner_lib.get_command_runners(info)[0]
+            remote = (f'.skytpu_runtime/logs/{cluster_job_id}/run.log'
+                      if info.provider_name == 'local' else
+                      f'~/.skytpu_runtime/logs/{cluster_job_id}/run.log')
+            runner.rsync(remote, state.job_log_path(self.job_id), up=False)
+        except Exception:  # pylint: disable=broad-except
+            pass  # best-effort; the log may not exist yet
+
+    # ------------------------------------------------------------------
+    def _handle_user_code_failure(self, job_status: JobStatus) -> bool:
+        """Returns True if the job was restarted (max_restarts_on_errors)."""
+        max_restarts = self.record['max_restarts_on_errors'] or 0
+        if (job_status is JobStatus.FAILED and
+                state.bump_restart_on_error(self.job_id) <= max_restarts):
+            logger.info(f'[job {self.job_id}] user code failed; restarting '
+                        f'(max_restarts_on_errors={max_restarts}).')
+            state.set_recovering(self.job_id)
+            new_id = self.strategy.recover()
+            state.set_recovered(self.job_id, new_id)
+            return True
+        return False
+
+    def run(self) -> None:
+        job_id = self.job_id
+        state.set_starting(job_id, self.cluster_name)
+        logger.info(f'[job {job_id}] launching as {self.cluster_name!r}')
+        try:
+            cluster_job_id = self.strategy.launch()
+        except exceptions.ResourcesUnavailableError as e:
+            state.set_terminal(job_id, state.ManagedJobStatus.
+                               FAILED_NO_RESOURCE, failure_reason=str(e))
+            return
+        except Exception as e:  # pylint: disable=broad-except
+            state.set_terminal(job_id,
+                               state.ManagedJobStatus.FAILED_PRECHECKS,
+                               failure_reason=f'{type(e).__name__}: {e}')
+            return
+        state.set_started(job_id, cluster_job_id)
+
+        while True:
+            time.sleep(POLL_SECONDS)
+
+            if state.cancel_was_requested(job_id):
+                state.set_cancelling(job_id)
+                logger.info(f'[job {job_id}] cancelling')
+                try:
+                    if self.strategy.handle is not None:
+                        self.strategy.backend.cancel_jobs(
+                            self.strategy.handle,
+                            [cluster_job_id]
+                            if cluster_job_id is not None else None)
+                except Exception:  # pylint: disable=broad-except
+                    pass
+                self.strategy.terminate_cluster()
+                state.set_terminal(job_id, state.ManagedJobStatus.CANCELLED)
+                return
+
+            if not self._cluster_alive():
+                # Preemption (or external down). Recover: delete the dead
+                # slice, relaunch with the strategy's placement policy.
+                logger.info(f'[job {job_id}] cluster lost — recovering')
+                state.set_recovering(job_id)
+                try:
+                    cluster_job_id = self.strategy.recover()
+                except exceptions.ManagedJobReachedMaxRetriesError as e:
+                    state.set_terminal(
+                        job_id, state.ManagedJobStatus.FAILED_NO_RESOURCE,
+                        failure_reason=str(e))
+                    return
+                state.set_recovered(job_id, cluster_job_id)
+                continue
+
+            job_status = self._job_status(cluster_job_id)
+            # Mirror logs every poll: after a preemption the slice (and its
+            # logs) are gone, so the last pre-preemption copy is what
+            # `jobs logs` can still serve.
+            self._mirror_logs(cluster_job_id)
+            if job_status is None or not job_status.is_terminal():
+                continue
+            if job_status is JobStatus.SUCCEEDED:
+                self.strategy.terminate_cluster()
+                state.set_terminal(job_id, state.ManagedJobStatus.SUCCEEDED)
+                return
+            if job_status is JobStatus.CANCELLED:
+                self.strategy.terminate_cluster()
+                state.set_terminal(job_id, state.ManagedJobStatus.CANCELLED)
+                return
+            if self._handle_user_code_failure(job_status):
+                continue
+            # Real failure on a live cluster: keep the cluster for debugging
+            # only if the user asked (not yet supported) — default teardown.
+            self.strategy.terminate_cluster()
+            failed_status = (state.ManagedJobStatus.FAILED_SETUP
+                             if job_status is JobStatus.FAILED_SETUP else
+                             state.ManagedJobStatus.FAILED)
+            state.set_terminal(
+                job_id, failed_status,
+                failure_reason=f'on-cluster job status: {job_status.value}')
+            return
+
+
+def main(job_id: int) -> None:
+    try:
+        JobsController(job_id).run()
+    except Exception as e:  # pylint: disable=broad-except
+        traceback.print_exc()
+        try:
+            state.set_terminal(job_id,
+                               state.ManagedJobStatus.FAILED_CONTROLLER,
+                               failure_reason=f'{type(e).__name__}: {e}')
+        except Exception:  # pylint: disable=broad-except
+            pass
+    finally:
+        # Free our scheduler slot and let the next PENDING job start.
+        from skypilot_tpu.jobs import scheduler
+        scheduler.maybe_schedule()
+
+
+if __name__ == '__main__':
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--job-id', type=int, required=True)
+    main(parser.parse_args().job_id)

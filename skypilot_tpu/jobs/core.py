@@ -1,0 +1,156 @@
+"""User-facing managed-jobs API: launch/queue/cancel/logs.
+
+Reference analog: sky/jobs/ client+server core (jobs launch wraps the task
+for the controller; queue/cancel/logs talk to controller state).
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import Any, Dict, List, Optional, Union
+
+from skypilot_tpu import dag as dag_lib
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.jobs import recovery_strategy
+from skypilot_tpu.jobs import scheduler
+from skypilot_tpu.jobs import state
+
+logger = sky_logging.init_logger(__name__)
+
+
+def launch(entrypoint: Union[task_lib.Task, dag_lib.Dag],
+           name: Optional[str] = None) -> int:
+    """Submit a managed job; returns its managed-job id immediately.
+
+    The controller process owns the whole lifecycle from here: provisioning
+    (with failover), monitoring, preemption recovery, teardown.
+    """
+    if isinstance(entrypoint, dag_lib.Dag):
+        if len(entrypoint.tasks) != 1:
+            raise NotImplementedError(
+                'Multi-task managed jobs (pipelines) are not supported yet.')
+        task = entrypoint.tasks[0]
+    else:
+        task = entrypoint
+    task.validate()
+    # Fail fast on an unknown recovery strategy (before the controller is
+    # off in its own process where the error is only visible in logs).
+    recovery_strategy.StrategyExecutor.make('prevalidate', task, job_id=0)
+    job_name = name or task.name or 'unnamed'
+    job_id = state.submit(
+        job_name, task.to_yaml_config(),
+        strategy=_strategy_name(task),
+        max_restarts_on_errors=_max_restarts(task))
+    scheduler.maybe_schedule()
+    logger.info(f'Managed job {job_id} ({job_name!r}) submitted.')
+    return job_id
+
+
+def _strategy_name(task: task_lib.Task) -> str:
+    for res in task.resources_list():
+        if res.spot_recovery is not None:
+            return res.spot_recovery.lower()
+    return recovery_strategy.DEFAULT_RECOVERY_STRATEGY
+
+
+def _max_restarts(task: task_lib.Task) -> int:
+    # YAML: resources.job_recovery could grow {max_restarts_on_errors: N};
+    # until then a task env opt-in keeps the knob reachable.
+    try:
+        return int(task.envs_and_secrets.get(
+            'SKYTPU_MAX_RESTARTS_ON_ERRORS', '0'))
+    except ValueError:
+        return 0
+
+
+def queue(name: Optional[str] = None,
+          skip_finished: bool = False) -> List[Dict[str, Any]]:
+    jobs = state.get_jobs(name)
+    if skip_finished:
+        jobs = [j for j in jobs if not j['status'].is_terminal()]
+    return jobs
+
+
+def cancel(job_ids: Optional[List[int]] = None,
+           name: Optional[str] = None,
+           all_jobs: bool = False) -> List[int]:
+    """Request cancellation; controllers notice within one poll interval."""
+    if not (job_ids or name or all_jobs):
+        raise ValueError('Specify job ids, a name, or all_jobs=True.')
+    targets: List[Dict[str, Any]] = []
+    if all_jobs:
+        targets = state.nonterminal_jobs()
+    else:
+        if job_ids:
+            for jid in job_ids:
+                job = state.get_job(jid)
+                if job is None:
+                    raise exceptions.JobNotFoundError(
+                        f'Managed job {jid} not found.')
+                targets.append(job)
+        if name:
+            targets.extend(j for j in state.get_jobs(name)
+                           if not j['status'].is_terminal())
+    cancelled = []
+    for job in targets:
+        if job['status'].is_terminal():
+            continue
+        if job['status'] is state.ManagedJobStatus.PENDING:
+            # No controller yet: terminal-ize directly.
+            state.set_terminal(job['job_id'],
+                               state.ManagedJobStatus.CANCELLED)
+        else:
+            state.request_cancel(job['job_id'])
+        cancelled.append(job['job_id'])
+    return cancelled
+
+
+def tail_logs(job_id: Optional[int] = None, follow: bool = True,
+              controller: bool = False) -> int:
+    """Stream a managed job's logs.
+
+    While the cluster is up this streams live from the cluster; otherwise it
+    falls back to the controller-mirrored copy (which survives preemption
+    and teardown). `controller=True` shows the controller's own log.
+    """
+    if job_id is None:
+        jobs = state.get_jobs()
+        if not jobs:
+            logger.info('No managed jobs.')
+            return 0
+        job_id = jobs[0]['job_id']
+    job = state.get_job(job_id)
+    if job is None:
+        raise exceptions.JobNotFoundError(f'Managed job {job_id} not found.')
+
+    path = (state.controller_log_path(job_id) if controller
+            else state.job_log_path(job_id))
+    if not controller and job['status'] is state.ManagedJobStatus.RUNNING:
+        # Live stream straight from the cluster.
+        from skypilot_tpu import core as core_lib
+        try:
+            return core_lib.tail_logs(job['cluster_name'],
+                                      job['cluster_job_id'], follow=follow)
+        except exceptions.SkyTpuError:
+            pass  # cluster just went away — fall back to the mirror
+    return _tail_file(path, follow=follow, job_id=job_id)
+
+
+def _tail_file(path: str, follow: bool, job_id: int) -> int:
+    if not os.path.exists(path):
+        logger.info(f'No logs yet for managed job {job_id}.')
+        return 0
+    with open(path, 'r', encoding='utf-8', errors='replace') as f:
+        while True:
+            chunk = f.read()
+            if chunk:
+                print(chunk, end='', flush=True)
+            if not follow:
+                return 0
+            job = state.get_job(job_id)
+            if job is None or job['status'].is_terminal():
+                print(f.read(), end='', flush=True)
+                return 0
+            time.sleep(0.5)

@@ -1,0 +1,189 @@
+"""Launch/recovery/termination strategies for managed-job clusters.
+
+Reference analog: sky/jobs/recovery_strategy.py (`StrategyExecutor:60`,
+`FailoverStrategyExecutor:606`, `EagerFailoverStrategyExecutor:706`).
+
+Strategy selection comes from the task's resources
+(`job_recovery`/`spot_recovery: FAILOVER | EAGER_NEXT_REGION`). The TPU
+wrinkle baked into `recover()`: a preempted spot TPU slice is NOT reusable —
+GCP leaves the dead slice resource behind and it must be deleted before a
+slice with the same name can be recreated (sky/clouds/gcp.py:1095-1101), so
+every recovery is terminate-then-relaunch, never restart.
+"""
+from __future__ import annotations
+
+import time
+import typing
+from typing import Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.backends import slice_backend
+from skypilot_tpu.jobs import state
+from skypilot_tpu.utils import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import task as task_lib
+
+logger = sky_logging.init_logger(__name__)
+
+DEFAULT_RECOVERY_STRATEGY = 'failover'
+
+# Gap between failed relaunch attempts while recovering. Tests shrink this.
+RETRY_GAP_SECONDS = 20
+# Max full failover rounds while recovering before giving up; None = forever
+# (the reference retries forever; we bound it but keep it high).
+MAX_RECOVERY_ROUNDS = 720
+
+
+class StrategyExecutor:
+    """Handles launching, recovery and termination of one job's cluster."""
+
+    def __init__(self, cluster_name: str, task: 'task_lib.Task',
+                 job_id: int) -> None:
+        self.cluster_name = cluster_name
+        self.task = task
+        self.job_id = job_id
+        self.handle: Optional[slice_backend.SliceResourceHandle] = None
+        self.backend = slice_backend.TpuSliceBackend()
+
+    # ------------------------------------------------------------------
+    @classmethod
+    def make(cls, cluster_name: str, task: 'task_lib.Task',
+             job_id: int) -> 'StrategyExecutor':
+        """Pick the strategy from the task's resources (job_recovery)."""
+        from skypilot_tpu import resources as resources_lib
+        name = None
+        for res in task.resources_list():
+            assert isinstance(res, resources_lib.Resources)
+            if res.spot_recovery is not None:
+                if name is not None and name != res.spot_recovery:
+                    raise ValueError(
+                        'All resource candidates must agree on job_recovery; '
+                        f'got {name!r} and {res.spot_recovery!r}.')
+                name = res.spot_recovery
+        name = name or DEFAULT_RECOVERY_STRATEGY
+        strategy_cls = registry.JOBS_RECOVERY_STRATEGY_REGISTRY.type_from_str(
+            name)
+        return strategy_cls(cluster_name, task, job_id)
+
+    # ------------------------------------------------------------------
+    def launch(self) -> Optional[int]:
+        """First launch. Returns the on-cluster job id.
+
+        Raises ResourcesUnavailableError if every failover target is
+        exhausted (→ FAILED_NO_RESOURCE) and other exceptions for
+        precheck-class failures (→ FAILED_PRECHECKS).
+        """
+        job_id_on_cluster = self._launch_once()
+        return job_id_on_cluster
+
+    def recover(self) -> Optional[int]:
+        """Relaunch after preemption. Returns the new on-cluster job id.
+
+        Subclasses encode *where* to retry first. Common contract:
+        terminate the dead slice, then relaunch (possibly elsewhere).
+        """
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    def _launch_once(self,
+                     resources_override: Optional[dict] = None
+                     ) -> Optional[int]:
+        """One launch attempt end-to-end (provision → sync → setup → exec)."""
+        from skypilot_tpu import execution
+        from skypilot_tpu import task as task_lib_mod
+        task = self.task
+        if resources_override:
+            # Clone the task with pinned/relaxed placement for this attempt.
+            cfg = task.to_yaml_config()
+            task = task_lib_mod.Task.from_yaml_config(cfg)
+            new_res = [
+                r.copy(**resources_override) for r in task.resources_list()
+            ]
+            task.set_resources(new_res if len(new_res) > 1 else new_res[0])
+        job_id, handle = execution.launch(
+            task, cluster_name=self.cluster_name, detach_run=True)
+        assert handle is not None
+        self.handle = handle
+        return job_id
+
+    def terminate_cluster(self, max_retries: int = 3) -> None:
+        """Delete the job's slice. Preempted spot TPUs MUST be deleted
+        before a relaunch can reuse the name (clouds/gcp.py:1095-1101);
+        termination of an already-gone cluster is a no-op."""
+        from skypilot_tpu import global_state
+        for attempt in range(max_retries):
+            try:
+                record = global_state.get_cluster(self.cluster_name)
+                if record is None:
+                    return
+                handle = slice_backend.SliceResourceHandle.from_dict(
+                    record['handle'])
+                self.backend.teardown(handle, terminate=True)
+                return
+            except Exception as e:  # pylint: disable=broad-except
+                if attempt == max_retries - 1:
+                    logger.warning(
+                        f'Failed to terminate {self.cluster_name}: {e}')
+                    return
+                time.sleep(min(2 ** attempt, 10))
+
+    def _relaunch_with_failover(
+            self, try_same_placement_first: bool) -> Optional[int]:
+        """Shared recovery loop: optional same-placement fast path, then
+        unconstrained failover, retrying with a gap until something lands."""
+        launched_cloud = self.handle.cloud if self.handle else None
+        launched_region = self.handle.region if self.handle else None
+        launched_zone = self.handle.zone if self.handle else None
+        for round_idx in range(MAX_RECOVERY_ROUNDS):
+            # The dead slice blocks name reuse: always delete first.
+            self.terminate_cluster()
+            if try_same_placement_first and launched_region is not None:
+                # Same region/zone first: data/ckpt egress stays local and
+                # capacity often returns to the same zone first.
+                try:
+                    # Pin cloud too: region/zone names only validate against
+                    # the cloud that owns them.
+                    return self._launch_once(resources_override={
+                        'cloud': launched_cloud,
+                        'region': launched_region,
+                        'zone': launched_zone,
+                    })
+                except exceptions.ResourcesUnavailableError:
+                    logger.info(
+                        f'[job {self.job_id}] same-placement relaunch in '
+                        f'{launched_region} failed; trying full failover.')
+                    self.terminate_cluster()
+            try:
+                # Unconstrained: let the optimizer pick anywhere feasible.
+                return self._launch_once(resources_override={
+                    'region': None, 'zone': None,
+                })
+            except exceptions.ResourcesUnavailableError:
+                logger.info(
+                    f'[job {self.job_id}] recovery round {round_idx + 1} '
+                    f'found no capacity anywhere; retrying in '
+                    f'{RETRY_GAP_SECONDS}s.')
+                time.sleep(RETRY_GAP_SECONDS)
+        raise exceptions.ManagedJobReachedMaxRetriesError(
+            f'Recovery of job {self.job_id} gave up after '
+            f'{MAX_RECOVERY_ROUNDS} failover rounds.')
+
+
+@registry.JOBS_RECOVERY_STRATEGY_REGISTRY.register(name='failover')
+class FailoverStrategyExecutor(StrategyExecutor):
+    """Retry the original placement first, then fail over anywhere
+    (reference default: recovery_strategy.py:606)."""
+
+    def recover(self) -> Optional[int]:
+        return self._relaunch_with_failover(try_same_placement_first=True)
+
+
+@registry.JOBS_RECOVERY_STRATEGY_REGISTRY.register(name='eager_next_region')
+class EagerFailoverStrategyExecutor(StrategyExecutor):
+    """Skip the preempted placement: a zone that just preempted us is the
+    least likely to have spot capacity (recovery_strategy.py:706)."""
+
+    def recover(self) -> Optional[int]:
+        return self._relaunch_with_failover(try_same_placement_first=False)

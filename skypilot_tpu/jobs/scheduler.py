@@ -1,0 +1,90 @@
+"""Controller-process scheduler: parallelism cap + spawn.
+
+Reference analog: sky/jobs/scheduler.py (`maybe_start_controllers:267`,
+`submit_job:323`) — there, controller *coroutines* inside a controller
+cluster; here, detached local processes (see controller.py docstring for
+why). The cap bounds concurrent provisioning fan-out, not job count: PENDING
+jobs wait in the DB and every controller exit re-runs the scheduler.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+from typing import Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.jobs import state
+from skypilot_tpu.utils import locks
+
+logger = sky_logging.init_logger(__name__)
+
+
+def _max_parallel() -> int:
+    from skypilot_tpu import config as config_lib
+    return int(
+        os.environ.get('SKYTPU_JOBS_MAX_PARALLEL',
+                       config_lib.get_nested(('jobs', 'max_parallel'), 8)))
+
+
+def _pid_alive(pid: Optional[int]) -> bool:
+    if not pid:
+        return False
+    try:
+        os.kill(pid, 0)
+        return True
+    except (OSError, ProcessLookupError):
+        return False
+
+
+def _spawn_controller(job_id: int) -> int:
+    log_path = state.controller_log_path(job_id)
+    env = dict(os.environ)
+    # Controllers import the package the same way this process does.
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    pp = env.get('PYTHONPATH', '')
+    if repo_root not in pp.split(os.pathsep):
+        env['PYTHONPATH'] = f'{repo_root}{os.pathsep}{pp}' if pp else repo_root
+    with open(log_path, 'ab') as log_file:
+        proc = subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.jobs.controller',
+             '--job-id', str(job_id)],
+            stdout=log_file, stderr=subprocess.STDOUT, env=env,
+            start_new_session=True)
+    return proc.pid
+
+
+def maybe_schedule() -> None:
+    """Start controllers for PENDING jobs up to the parallelism cap.
+
+    Called after every submit and from every controller's exit path, so a
+    full queue drains itself without a daemon. Idempotent and cheap.
+    """
+    with locks.cluster_status_lock('jobs-scheduler', timeout=60):
+        alive = 0
+        pending = []
+        for job in state.nonterminal_jobs():
+            if job['status'] is state.ManagedJobStatus.PENDING:
+                if _pid_alive(job['controller_pid']):
+                    alive += 1  # spawned, controller hasn't set STARTING yet
+                else:
+                    pending.append(job)
+            elif _pid_alive(job['controller_pid']):
+                alive += 1
+            # Non-terminal with a dead controller and not PENDING: the
+            # controller crashed hard (kill -9 / reboot). Mark it so it
+            # doesn't count against the cap forever.
+            elif job['status'] is not state.ManagedJobStatus.PENDING:
+                state.set_terminal(
+                    job['job_id'], state.ManagedJobStatus.FAILED_CONTROLLER,
+                    failure_reason='controller process died')
+        cap = _max_parallel()
+        for job in pending:
+            if alive >= cap:
+                break
+            pid = _spawn_controller(job['job_id'])
+            state.set_controller_pid(job['job_id'], pid)
+            alive += 1
+            logger.info(f'Started controller pid={pid} for managed job '
+                        f'{job["job_id"]}.')

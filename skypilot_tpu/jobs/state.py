@@ -1,0 +1,251 @@
+"""Managed-job state machine + sqlite store (control-plane side).
+
+Reference analog: sky/jobs/state.py (`ManagedJobStatus:377`, the spot table,
+schedule state). One row per managed job; the controller process drives the
+status through PENDING → STARTING → RUNNING → (RECOVERING → RUNNING)* →
+terminal. Unlike the on-cluster JobStatus (skylet/job_lib.py), which resets
+on every recovery, a managed job has exactly one ManagedJobStatus for its
+whole life.
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+_DB_PATH_ENV = 'SKYTPU_JOBS_DB'
+
+
+class ManagedJobStatus(enum.Enum):
+    """Serverless-style status of a managed job (state.py:377 analog).
+
+    Mapping from the on-cluster JobStatus each time the cluster is alive:
+      INIT/PENDING/SETTING_UP → RUNNING (cluster is dedicated to the job)
+      RUNNING                 → RUNNING
+      SUCCEEDED               → SUCCEEDED
+      FAILED / FAILED_SETUP   → FAILED / FAILED_SETUP
+    Cluster gone while non-terminal → RECOVERING.
+    """
+    # Waiting for a controller slot (scheduler parallelism limit).
+    PENDING = 'PENDING'
+    # Controller is provisioning the cluster for the first time.
+    STARTING = 'STARTING'
+    # Submitted to the cluster; setting up or running.
+    RUNNING = 'RUNNING'
+    # Cluster was preempted/lost; controller is relaunching (failover).
+    RECOVERING = 'RECOVERING'
+    # User requested cancel; controller is tearing down.
+    CANCELLING = 'CANCELLING'
+    # Terminal:
+    SUCCEEDED = 'SUCCEEDED'
+    CANCELLED = 'CANCELLED'
+    FAILED = 'FAILED'                    # user code exited non-zero
+    FAILED_SETUP = 'FAILED_SETUP'        # setup section failed
+    FAILED_PRECHECKS = 'FAILED_PRECHECKS'  # task invalid / optimizer error
+    FAILED_NO_RESOURCE = 'FAILED_NO_RESOURCE'  # exhausted every failover target
+    FAILED_CONTROLLER = 'FAILED_CONTROLLER'  # controller itself crashed
+
+    def is_terminal(self) -> bool:
+        return self in _TERMINAL
+
+    def is_failed(self) -> bool:
+        return self in _FAILED
+
+    def colored_str(self) -> str:
+        if self is ManagedJobStatus.SUCCEEDED:
+            color = '\x1b[32m'
+        elif self in _FAILED or self is ManagedJobStatus.CANCELLED:
+            color = '\x1b[31m'
+        else:
+            color = '\x1b[33m'
+        return f'{color}{self.value}\x1b[0m'
+
+
+_TERMINAL = frozenset({
+    ManagedJobStatus.SUCCEEDED,
+    ManagedJobStatus.CANCELLED,
+    ManagedJobStatus.FAILED,
+    ManagedJobStatus.FAILED_SETUP,
+    ManagedJobStatus.FAILED_PRECHECKS,
+    ManagedJobStatus.FAILED_NO_RESOURCE,
+    ManagedJobStatus.FAILED_CONTROLLER,
+})
+_FAILED = frozenset({
+    ManagedJobStatus.FAILED,
+    ManagedJobStatus.FAILED_SETUP,
+    ManagedJobStatus.FAILED_PRECHECKS,
+    ManagedJobStatus.FAILED_NO_RESOURCE,
+    ManagedJobStatus.FAILED_CONTROLLER,
+})
+
+
+def _db_path() -> str:
+    path = os.path.expanduser(
+        os.environ.get(_DB_PATH_ENV, '~/.skytpu/managed_jobs.db'))
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    return path
+
+
+def _conn() -> sqlite3.Connection:
+    conn = sqlite3.connect(_db_path(), timeout=30)
+    conn.execute('PRAGMA journal_mode=WAL')
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS jobs (
+            job_id INTEGER PRIMARY KEY AUTOINCREMENT,
+            name TEXT,
+            task_config TEXT,
+            status TEXT,
+            strategy TEXT,
+            submitted_at REAL,
+            started_at REAL,
+            ended_at REAL,
+            last_recovered_at REAL,
+            recovery_count INTEGER DEFAULT 0,
+            restarts_on_errors INTEGER DEFAULT 0,
+            max_restarts_on_errors INTEGER DEFAULT 0,
+            cluster_name TEXT,
+            cluster_job_id INTEGER,
+            failure_reason TEXT,
+            controller_pid INTEGER,
+            cancel_requested INTEGER DEFAULT 0
+        )""")
+    return conn
+
+
+def controller_log_path(job_id: int) -> str:
+    d = os.path.expanduser('~/.skytpu/jobs')
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, f'controller_{job_id}.log')
+
+
+def job_log_path(job_id: int) -> str:
+    """Mirrored user-job output (rank-0), streamed by `jobs logs`."""
+    d = os.path.expanduser('~/.skytpu/jobs')
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, f'run_{job_id}.log')
+
+
+# ---------------------------------------------------------------------------
+# Writes
+# ---------------------------------------------------------------------------
+def submit(name: str, task_config: Dict[str, Any], strategy: str,
+           max_restarts_on_errors: int = 0) -> int:
+    with _conn() as conn:
+        cur = conn.execute(
+            'INSERT INTO jobs (name, task_config, status, strategy, '
+            'submitted_at, max_restarts_on_errors) VALUES (?, ?, ?, ?, ?, ?)',
+            (name, json.dumps(task_config), ManagedJobStatus.PENDING.value,
+             strategy, time.time(), max_restarts_on_errors))
+        assert cur.lastrowid is not None
+        return cur.lastrowid
+
+
+def _update(job_id: int, **cols: Any) -> None:
+    sets = ', '.join(f'{k} = ?' for k in cols)
+    with _conn() as conn:
+        conn.execute(f'UPDATE jobs SET {sets} WHERE job_id = ?',
+                     (*cols.values(), job_id))
+
+
+def set_controller_pid(job_id: int, pid: int) -> None:
+    _update(job_id, controller_pid=pid)
+
+
+def set_starting(job_id: int, cluster_name: str) -> None:
+    _update(job_id, status=ManagedJobStatus.STARTING.value,
+            cluster_name=cluster_name)
+
+
+def set_started(job_id: int, cluster_job_id: Optional[int]) -> None:
+    job = get_job(job_id)
+    started = job['started_at'] if job and job['started_at'] else time.time()
+    _update(job_id, status=ManagedJobStatus.RUNNING.value,
+            started_at=started, cluster_job_id=cluster_job_id)
+
+
+def set_recovering(job_id: int) -> None:
+    _update(job_id, status=ManagedJobStatus.RECOVERING.value)
+
+
+def set_recovered(job_id: int, cluster_job_id: Optional[int]) -> None:
+    job = get_job(job_id)
+    count = (job['recovery_count'] if job else 0) + 1
+    _update(job_id, status=ManagedJobStatus.RUNNING.value,
+            last_recovered_at=time.time(), recovery_count=count,
+            cluster_job_id=cluster_job_id)
+
+
+def bump_restart_on_error(job_id: int) -> int:
+    job = get_job(job_id)
+    count = (job['restarts_on_errors'] if job else 0) + 1
+    _update(job_id, restarts_on_errors=count)
+    return count
+
+
+def set_cancelling(job_id: int) -> None:
+    _update(job_id, status=ManagedJobStatus.CANCELLING.value)
+
+
+def set_terminal(job_id: int, status: ManagedJobStatus,
+                 failure_reason: Optional[str] = None) -> None:
+    assert status.is_terminal(), status
+    _update(job_id, status=status.value, ended_at=time.time(),
+            failure_reason=failure_reason)
+
+
+def request_cancel(job_id: int) -> None:
+    _update(job_id, cancel_requested=1)
+
+
+# ---------------------------------------------------------------------------
+# Reads
+# ---------------------------------------------------------------------------
+def _row_to_dict(row: sqlite3.Row) -> Dict[str, Any]:
+    d = dict(row)
+    d['status'] = ManagedJobStatus(d['status'])
+    d['task_config'] = (json.loads(d['task_config'])
+                        if d.get('task_config') else {})
+    return d
+
+
+def get_job(job_id: int) -> Optional[Dict[str, Any]]:
+    with _conn() as conn:
+        conn.row_factory = sqlite3.Row
+        row = conn.execute('SELECT * FROM jobs WHERE job_id = ?',
+                           (job_id,)).fetchone()
+        return _row_to_dict(row) if row else None
+
+
+def get_jobs(name: Optional[str] = None) -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        conn.row_factory = sqlite3.Row
+        if name is None:
+            rows = conn.execute(
+                'SELECT * FROM jobs ORDER BY job_id DESC').fetchall()
+        else:
+            rows = conn.execute(
+                'SELECT * FROM jobs WHERE name = ? ORDER BY job_id DESC',
+                (name,)).fetchall()
+        return [_row_to_dict(r) for r in rows]
+
+
+def cancel_was_requested(job_id: int) -> bool:
+    with _conn() as conn:
+        row = conn.execute(
+            'SELECT cancel_requested FROM jobs WHERE job_id = ?',
+            (job_id,)).fetchone()
+    return bool(row and row[0])
+
+
+def nonterminal_jobs() -> List[Dict[str, Any]]:
+    terminal = tuple(s.value for s in _TERMINAL)
+    with _conn() as conn:
+        conn.row_factory = sqlite3.Row
+        ph = ','.join('?' * len(terminal))
+        rows = conn.execute(
+            f'SELECT * FROM jobs WHERE status NOT IN ({ph}) '
+            f'ORDER BY job_id', terminal).fetchall()
+        return [_row_to_dict(r) for r in rows]

@@ -104,6 +104,42 @@ def _cost_report(payload):
     return core.cost_report()
 
 
+def _jobs_launch(payload):
+    import skypilot_tpu as sky
+    from skypilot_tpu import jobs
+    task = sky.Task.from_yaml_config(payload['task'])
+    job_id = jobs.launch(task, name=payload.get('name'))
+    return {'job_id': job_id}
+
+
+def _jobs_queue(payload):
+    from skypilot_tpu import jobs
+    rows = jobs.queue(name=payload.get('name'),
+                      skip_finished=payload.get('skip_finished', False))
+    out = []
+    for r in rows:
+        r = dict(r)
+        r['status'] = r['status'].value
+        r.pop('task_config', None)
+        out.append(r)
+    return out
+
+
+def _jobs_cancel(payload):
+    from skypilot_tpu import jobs
+    return {'cancelled': jobs.cancel(job_ids=payload.get('job_ids'),
+                                     name=payload.get('name'),
+                                     all_jobs=payload.get('all', False))}
+
+
+def _jobs_logs(payload):
+    from skypilot_tpu import jobs
+    rc = jobs.tail_logs(payload.get('job_id'),
+                        follow=payload.get('follow', False),
+                        controller=payload.get('controller', False))
+    return {'returncode': rc}
+
+
 def _list_accelerators(payload):
     import dataclasses
     from skypilot_tpu.catalog import tpu_catalog
@@ -130,4 +166,11 @@ HANDLERS: Dict[str, Tuple[Callable[[Dict[str, Any]], Any], str]] = {
     'check': (_check, requests_lib.SHORT),
     'cost_report': (_cost_report, requests_lib.SHORT),
     'list_accelerators': (_list_accelerators, requests_lib.SHORT),
+    # Managed jobs plane (reference: sky/jobs/server/ routes). jobs_launch is
+    # SHORT because it only writes the DB row and spawns the controller —
+    # provisioning happens in the controller process, not the request worker.
+    'jobs_launch': (_jobs_launch, requests_lib.SHORT),
+    'jobs_queue': (_jobs_queue, requests_lib.SHORT),
+    'jobs_cancel': (_jobs_cancel, requests_lib.SHORT),
+    'jobs_logs': (_jobs_logs, requests_lib.SHORT),
 }

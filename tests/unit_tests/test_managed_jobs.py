@@ -1,0 +1,143 @@
+"""Managed jobs plane: controller lifecycle + preemption recovery, hermetic.
+
+The reference validates preemption recovery only against real spot clusters
+(tests/smoke_tests/test_managed_job.py); here the Local fake-TPU cloud makes
+it a unit test: "preemption" = deleting the fabricated slice out from under
+the controller, exactly what a spot reclaim looks like to the control plane
+(cloud says the instances are gone, sky/jobs/controller.py's monitor loop).
+"""
+import os
+import shutil
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import global_state
+from skypilot_tpu.clouds import local as local_cloud
+from skypilot_tpu.jobs import core as jobs_core
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.jobs.state import ManagedJobStatus
+
+
+@pytest.fixture
+def jobs_env(enable_local_cloud, isolated_state, monkeypatch):
+    """Fast controller polling + DB isolation, inherited by controller
+    subprocesses through the environment."""
+    monkeypatch.setenv('SKYTPU_JOBS_POLL_SECONDS', '0.3')
+    yield isolated_state
+
+
+def _wait_status(job_id, statuses, timeout=90):
+    deadline = time.time() + timeout
+    seen = None
+    while time.time() < deadline:
+        job = jobs_state.get_job(job_id)
+        assert job is not None
+        seen = job['status']
+        if seen in statuses:
+            return job
+        time.sleep(0.2)
+    raise TimeoutError(f'job {job_id} stuck in {seen}, wanted {statuses}')
+
+
+def _preempt(cluster_name):
+    """Simulate a spot reclaim: the cloud-side slice vanishes; the control
+    plane's DB still believes the cluster is UP."""
+    shutil.rmtree(os.path.join(local_cloud.LOCAL_CLOUD_ROOT, cluster_name))
+
+
+def _task(name, run):
+    task = sky.Task(name=name, run=run)
+    task.set_resources(sky.Resources(accelerators='tpu-v5e-8', use_spot=True))
+    return task
+
+
+@pytest.mark.usefixtures('jobs_env')
+class TestManagedJobs:
+
+    def test_success_lifecycle(self):
+        job_id = jobs_core.launch(_task('ok', 'echo managed-done'))
+        job = _wait_status(job_id, {ManagedJobStatus.SUCCEEDED})
+        assert job['recovery_count'] == 0
+        # Cluster is torn down after success.
+        assert global_state.get_cluster(job['cluster_name']) is None
+        # The run log was mirrored before teardown.
+        log = jobs_state.job_log_path(job_id)
+        assert os.path.exists(log)
+        assert 'managed-done' in open(log).read()
+
+    def test_preemption_recovery(self, tmp_path):
+        marker = tmp_path / 'recovered.marker'
+        # First run: marker absent → hang (simulating a long training job).
+        # Post-recovery run: marker present → finish successfully.
+        job_id = jobs_core.launch(_task(
+            'recover',
+            f'if [ -f {marker} ]; then echo after-recovery; '
+            f'else sleep 60; fi'))
+        job = _wait_status(job_id, {ManagedJobStatus.RUNNING})
+        cluster_name = job['cluster_name']
+        assert global_state.get_cluster(cluster_name) is not None
+
+        marker.write_text('now finish')
+        _preempt(cluster_name)
+
+        # RUNNING → RECOVERING → RUNNING → SUCCEEDED with the SAME cluster
+        # name (the dead slice was deleted, then recreated).
+        _wait_status(job_id,
+                     {ManagedJobStatus.RECOVERING, ManagedJobStatus.RUNNING,
+                      ManagedJobStatus.SUCCEEDED})
+        job = _wait_status(job_id, {ManagedJobStatus.SUCCEEDED})
+        assert job['recovery_count'] == 1
+        assert job['last_recovered_at'] is not None
+        assert job['cluster_name'] == cluster_name
+        assert global_state.get_cluster(cluster_name) is None
+
+    def test_user_code_failure_is_not_recovered(self):
+        job_id = jobs_core.launch(_task('boom', 'exit 7'))
+        job = _wait_status(job_id, {ManagedJobStatus.FAILED})
+        assert job['recovery_count'] == 0
+        assert global_state.get_cluster(job['cluster_name']) is None
+
+    def test_cancel_running_job(self):
+        job_id = jobs_core.launch(_task('sleeper', 'sleep 300'))
+        job = _wait_status(job_id, {ManagedJobStatus.RUNNING})
+        jobs_core.cancel(job_ids=[job_id])
+        job = _wait_status(job_id, {ManagedJobStatus.CANCELLED})
+        assert global_state.get_cluster(job['cluster_name']) is None
+
+    def test_cancel_pending_job_needs_no_controller(self, monkeypatch):
+        # Cap at 0 controllers: the job must stay PENDING, and cancel must
+        # work straight from the DB.
+        monkeypatch.setenv('SKYTPU_JOBS_MAX_PARALLEL', '0')
+        job_id = jobs_core.launch(_task('never', 'echo no'))
+        assert jobs_state.get_job(job_id)['status'] is ManagedJobStatus.PENDING
+        jobs_core.cancel(job_ids=[job_id])
+        assert (jobs_state.get_job(job_id)['status'] is
+                ManagedJobStatus.CANCELLED)
+
+    def test_strategy_selection_from_yaml(self):
+        task = _task('strat', 'echo hi')
+        task.set_resources(sky.Resources(accelerators='tpu-v5e-8',
+                                         use_spot=True,
+                                         spot_recovery='EAGER_NEXT_REGION'))
+        job_id = jobs_core.launch(task)
+        job = _wait_status(job_id, {ManagedJobStatus.SUCCEEDED})
+        assert job['strategy'] == 'eager_next_region'
+
+    def test_unknown_strategy_rejected_at_submit(self):
+        task = _task('bad', 'echo hi')
+        task.set_resources(sky.Resources(accelerators='tpu-v5e-8',
+                                         spot_recovery='NO_SUCH_STRATEGY'))
+        with pytest.raises(ValueError, match='not registered'):
+            jobs_core.launch(task)
+        assert jobs_state.get_jobs() == []  # nothing half-submitted
+
+    def test_queue_and_scheduler_cap(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_JOBS_MAX_PARALLEL', '1')
+        ids = [jobs_core.launch(_task(f'q{i}', 'echo hi')) for i in range(3)]
+        for jid in ids:
+            _wait_status(jid, {ManagedJobStatus.SUCCEEDED}, timeout=120)
+        rows = jobs_core.queue()
+        assert [r['job_id'] for r in rows] == list(reversed(ids))
+        assert all(r['status'] is ManagedJobStatus.SUCCEEDED for r in rows)
