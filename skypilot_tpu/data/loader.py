@@ -1,0 +1,95 @@
+"""Tokenized streaming data loader for the native trainer.
+
+The reference delegates data loading to HF `datasets` inside workload
+recipes (llm/llama-3_1-finetuning/lora.yaml); this framework owns the
+trainer, so it needs a loader with two properties the recipes get for free:
+
+1. **Step-indexed determinism** — batch k is a pure function of (data, k),
+   so a job recovered at step k continues the exact token stream instead of
+   restarting it (checkpoint/resume contract, train/checkpoints.py).
+2. **Host-local shards** — each host materialises only the rows of the
+   global batch it owns, then assembles a global jax.Array over the mesh
+   (no host-0 fan-out over DCN).
+
+Tokenization is byte-level by default (hermetic, no downloads); pass an HF
+tokenizer name to use transformers when available.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+BYTE_VOCAB = 256
+
+
+def tokenize_text(text: str, tokenizer: Optional[str] = None) -> np.ndarray:
+    """Text → int32 token ids. Default: raw UTF-8 bytes (vocab 256)."""
+    if tokenizer is None:
+        return np.frombuffer(text.encode('utf-8'), dtype=np.uint8).astype(
+            np.int32)
+    from transformers import AutoTokenizer  # lazy; needs local cache
+    tok = AutoTokenizer.from_pretrained(tokenizer)
+    return np.asarray(tok(text)['input_ids'], dtype=np.int32)
+
+
+def load_tokens(path: str, tokenizer: Optional[str] = None) -> np.ndarray:
+    """Load a corpus: .bin/.npy = pre-tokenized; anything else = text."""
+    path = os.path.expanduser(path)
+    if path.endswith('.npy'):
+        return np.load(path, mmap_mode='r')
+    if path.endswith('.bin'):
+        # uint16 memmap, the common pre-tokenized format (e.g. nanoGPT-style
+        # corpora); uint16 caps vocab at 65535 which covers every preset.
+        return np.memmap(path, dtype=np.uint16, mode='r')
+    with open(path, 'r', encoding='utf-8', errors='replace') as f:
+        return tokenize_text(f.read(), tokenizer)
+
+
+def batch_at_step(tokens: np.ndarray, step: int, batch_size: int,
+                  seq_len: int) -> np.ndarray:
+    """The deterministic indexer: global batch for `step`, shape [B, S+1].
+
+    Rows stride through the corpus with wraparound; consecutive steps read
+    consecutive windows, and (tokens, step) fully determines the batch.
+    """
+    n = len(tokens)
+    need = seq_len + 1
+    if n < need + 1:
+        raise ValueError(f'Corpus has {n} tokens; need > {need}.')
+    usable = n - need
+    starts = (np.arange(batch_size, dtype=np.int64) * usable // batch_size +
+              step * seq_len) % usable
+    out = np.empty((batch_size, need), dtype=np.int32)
+    for i, s in enumerate(starts):
+        out[i] = tokens[s:s + need]
+    return out
+
+
+def token_batches(tokens: np.ndarray, batch_size: int, seq_len: int,
+                  start_step: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+    """Infinite stream of {'tokens': [B, S+1]} starting at `start_step`."""
+    step = start_step
+    while True:
+        yield {'tokens': batch_at_step(tokens, step, batch_size, seq_len)}
+        step += 1
+
+
+def shard_batch(batch: Dict[str, np.ndarray], mesh) -> Dict:
+    """Host batch → global jax.Array sharded along the batch axes.
+
+    Single-process: jax.device_put with the batch sharding. Multi-host:
+    each process contributes its local rows
+    (jax.make_array_from_process_local_data handles the assembly over ICI
+    addressing, nothing crosses DCN).
+    """
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+    sharding = NamedSharding(mesh, PartitionSpec(('data', 'fsdp'),))
+    if jax.process_count() == 1:
+        return {k: jax.device_put(v, sharding) for k, v in batch.items()}
+    return {
+        k: jax.make_array_from_process_local_data(sharding, v)
+        for k, v in batch.items()
+    }

@@ -55,6 +55,7 @@ def _execute(
         optimizer_lib.OptimizeTarget.COST),
     down: bool = False,
     retry_until_up: bool = False,
+    blocked_resources: Optional[List['resources_lib.Resources']] = None,
 ) -> Tuple[Optional[int], Optional[slice_backend.SliceResourceHandle]]:
     """Run the requested stages for a single task. Returns (job_id, handle)."""
     from skypilot_tpu import config as config_lib
@@ -62,7 +63,8 @@ def _execute(
         return _execute_inner(
             task, cluster_name=cluster_name, stages=stages, dryrun=dryrun,
             detach_run=detach_run, optimize_target=optimize_target,
-            down=down, retry_until_up=retry_until_up)
+            down=down, retry_until_up=retry_until_up,
+            blocked_resources=blocked_resources)
 
 
 def _execute_inner(
@@ -75,12 +77,14 @@ def _execute_inner(
     optimize_target: optimizer_lib.OptimizeTarget,
     down: bool,
     retry_until_up: bool,
+    blocked_resources: Optional[List['resources_lib.Resources']] = None,
 ) -> Tuple[Optional[int], Optional[slice_backend.SliceResourceHandle]]:
     backend = slice_backend.TpuSliceBackend()
 
     if Stage.OPTIMIZE in stages:
         dag = _as_dag(task)
         optimizer_lib.Optimizer.optimize(dag, minimize=optimize_target,
+                                         blocked_resources=blocked_resources,
                                          quiet=dryrun)
 
     to_provision = task.best_resources
@@ -142,8 +146,13 @@ def launch(
         optimizer_lib.OptimizeTarget.COST),
     retry_until_up: bool = False,
     no_setup: bool = False,
+    blocked_resources: Optional[List['resources_lib.Resources']] = None,
 ) -> Tuple[Optional[int], Optional[slice_backend.SliceResourceHandle]]:
     """Provision (or reuse) a cluster and run the task on it.
+
+    `blocked_resources` excludes placements from the optimizer's choice —
+    the managed-jobs eager-failover strategy uses it to avoid the region
+    that just preempted the job.
 
     Reference analog: sky/execution.py:529.
     """
@@ -165,7 +174,8 @@ def launch(
     return _execute(task, cluster_name=cluster_name, stages=stages,
                     dryrun=dryrun, detach_run=detach_run,
                     optimize_target=optimize_target, down=down,
-                    retry_until_up=retry_until_up)
+                    retry_until_up=retry_until_up,
+                    blocked_resources=blocked_resources)
 
 
 def exec(  # pylint: disable=redefined-builtin

@@ -110,8 +110,22 @@ class JobsController:
             pass  # best-effort; the log may not exist yet
 
     # ------------------------------------------------------------------
-    def _handle_user_code_failure(self, job_status: JobStatus) -> bool:
-        """Returns True if the job was restarted (max_restarts_on_errors)."""
+    def _do_cancel(self, cluster_job_id) -> None:
+        state.set_cancelling(self.job_id)
+        logger.info(f'[job {self.job_id}] cancelling')
+        try:
+            if self.strategy.handle is not None:
+                self.strategy.backend.cancel_jobs(
+                    self.strategy.handle,
+                    [cluster_job_id] if cluster_job_id is not None else None)
+        except Exception:  # pylint: disable=broad-except
+            pass
+        self.strategy.terminate_cluster()
+        state.set_terminal(self.job_id, state.ManagedJobStatus.CANCELLED)
+
+    def _handle_user_code_failure(self, job_status: JobStatus,
+                                  cluster_job_id):
+        """(restarted, new_cluster_job_id) under max_restarts_on_errors."""
         max_restarts = self.record['max_restarts_on_errors'] or 0
         if (job_status is JobStatus.FAILED and
                 state.bump_restart_on_error(self.job_id) <= max_restarts):
@@ -120,12 +134,16 @@ class JobsController:
             state.set_recovering(self.job_id)
             new_id = self.strategy.recover()
             state.set_recovered(self.job_id, new_id)
-            return True
-        return False
+            return True, new_id
+        return False, cluster_job_id
 
     def run(self) -> None:
         job_id = self.job_id
-        state.set_starting(job_id, self.cluster_name)
+        if not state.set_starting(job_id, self.cluster_name):
+            # The job reached a terminal state (e.g. cancelled while
+            # PENDING) before this controller got going: nothing to do.
+            logger.info(f'[job {job_id}] already terminal; controller exits.')
+            return
         logger.info(f'[job {job_id}] launching as {self.cluster_name!r}')
         try:
             cluster_job_id = self.strategy.launch()
@@ -138,24 +156,16 @@ class JobsController:
                                state.ManagedJobStatus.FAILED_PRECHECKS,
                                failure_reason=f'{type(e).__name__}: {e}')
             return
-        state.set_started(job_id, cluster_job_id)
+        if not state.set_started(job_id, cluster_job_id):
+            # Cancelled while we were provisioning: clean up and bow out.
+            self.strategy.terminate_cluster()
+            return
 
         while True:
             time.sleep(POLL_SECONDS)
 
             if state.cancel_was_requested(job_id):
-                state.set_cancelling(job_id)
-                logger.info(f'[job {job_id}] cancelling')
-                try:
-                    if self.strategy.handle is not None:
-                        self.strategy.backend.cancel_jobs(
-                            self.strategy.handle,
-                            [cluster_job_id]
-                            if cluster_job_id is not None else None)
-                except Exception:  # pylint: disable=broad-except
-                    pass
-                self.strategy.terminate_cluster()
-                state.set_terminal(job_id, state.ManagedJobStatus.CANCELLED)
+                self._do_cancel(cluster_job_id)
                 return
 
             if not self._cluster_alive():
@@ -169,6 +179,9 @@ class JobsController:
                     state.set_terminal(
                         job_id, state.ManagedJobStatus.FAILED_NO_RESOURCE,
                         failure_reason=str(e))
+                    return
+                except recovery_strategy.JobCancelledDuringRecovery:
+                    self._do_cancel(cluster_job_id)
                     return
                 state.set_recovered(job_id, cluster_job_id)
                 continue
@@ -188,7 +201,13 @@ class JobsController:
                 self.strategy.terminate_cluster()
                 state.set_terminal(job_id, state.ManagedJobStatus.CANCELLED)
                 return
-            if self._handle_user_code_failure(job_status):
+            try:
+                restarted, cluster_job_id = self._handle_user_code_failure(
+                    job_status, cluster_job_id)
+            except recovery_strategy.JobCancelledDuringRecovery:
+                self._do_cancel(cluster_job_id)
+                return
+            if restarted:
                 continue
             # Real failure on a live cluster: keep the cluster for debugging
             # only if the user asked (not yet supported) — default teardown.

@@ -94,15 +94,20 @@ def cancel(job_ids: Optional[List[int]] = None,
             targets.extend(j for j in state.get_jobs(name)
                            if not j['status'].is_terminal())
     cancelled = []
+    cancelled_set = set()
     for job in targets:
-        if job['status'].is_terminal():
+        if job['status'].is_terminal() or job['job_id'] in cancelled_set:
             continue
+        # Set the flag first: a controller that won the PENDING→STARTING
+        # race still sees it on its next poll.
+        state.request_cancel(job['job_id'])
         if job['status'] is state.ManagedJobStatus.PENDING:
-            # No controller yet: terminal-ize directly.
+            # No controller yet (usually): terminal-ize directly. If a
+            # controller slipped in, the guarded write is a no-op and the
+            # flag above does the job.
             state.set_terminal(job['job_id'],
                                state.ManagedJobStatus.CANCELLED)
-        else:
-            state.request_cancel(job['job_id'])
+        cancelled_set.add(job['job_id'])
         cancelled.append(job['job_id'])
     return cancelled
 
@@ -125,20 +130,41 @@ def tail_logs(job_id: Optional[int] = None, follow: bool = True,
     if job is None:
         raise exceptions.JobNotFoundError(f'Managed job {job_id} not found.')
 
-    path = (state.controller_log_path(job_id) if controller
-            else state.job_log_path(job_id))
-    if not controller and job['status'] is state.ManagedJobStatus.RUNNING:
-        # Live stream straight from the cluster.
-        from skypilot_tpu import core as core_lib
-        try:
-            return core_lib.tail_logs(job['cluster_name'],
-                                      job['cluster_job_id'], follow=follow)
-        except exceptions.SkyTpuError:
-            pass  # cluster just went away — fall back to the mirror
-    return _tail_file(path, follow=follow, job_id=job_id)
+    if controller:
+        return _tail_file(state.controller_log_path(job_id), follow=follow,
+                          job_id=job_id)
+    from skypilot_tpu import core as core_lib
+    while True:
+        job = state.get_job(job_id)
+        assert job is not None
+        if (job['status'] is state.ManagedJobStatus.RUNNING and
+                job['cluster_job_id'] is not None):
+            try:
+                # Live stream from the cluster; blocks until the on-cluster
+                # job ends (or the slice is preempted mid-stream).
+                rc = core_lib.tail_logs(job['cluster_name'],
+                                        job['cluster_job_id'], follow=follow)
+                job = state.get_job(job_id)
+                if not follow or job is None or job['status'].is_terminal():
+                    return rc
+                continue  # preempted mid-stream: wait for the recovery
+            except exceptions.SkyTpuError:
+                pass  # cluster just went away — recovery or teardown
+        if job['status'].is_terminal() or not follow:
+            # Mirrored copy survives preemption and teardown.
+            return _tail_file(state.job_log_path(job_id), follow=False,
+                              job_id=job_id)
+        time.sleep(0.5)  # PENDING/STARTING/RECOVERING: wait for a cluster
 
 
 def _tail_file(path: str, follow: bool, job_id: int) -> int:
+    # In follow mode the file may not exist yet (controller log right after
+    # submit): wait for it instead of returning before the job even starts.
+    while follow and not os.path.exists(path):
+        job = state.get_job(job_id)
+        if job is None or job['status'].is_terminal():
+            break
+        time.sleep(0.5)
     if not os.path.exists(path):
         logger.info(f'No logs yet for managed job {job_id}.')
         return 0

@@ -29,6 +29,11 @@ logger = sky_logging.init_logger(__name__)
 
 DEFAULT_RECOVERY_STRATEGY = 'failover'
 
+
+class JobCancelledDuringRecovery(Exception):
+    """Raised out of recover() when the user cancels mid-failover, so the
+    controller can stop burning provisioning attempts immediately."""
+
 # Gap between failed relaunch attempts while recovering. Tests shrink this.
 RETRY_GAP_SECONDS = 20
 # Max full failover rounds while recovering before giving up; None = forever
@@ -88,7 +93,8 @@ class StrategyExecutor:
 
     # ------------------------------------------------------------------
     def _launch_once(self,
-                     resources_override: Optional[dict] = None
+                     resources_override: Optional[dict] = None,
+                     blocked_resources: Optional[list] = None
                      ) -> Optional[int]:
         """One launch attempt end-to-end (provision → sync → setup → exec)."""
         from skypilot_tpu import execution
@@ -103,7 +109,8 @@ class StrategyExecutor:
             ]
             task.set_resources(new_res if len(new_res) > 1 else new_res[0])
         job_id, handle = execution.launch(
-            task, cluster_name=self.cluster_name, detach_run=True)
+            task, cluster_name=self.cluster_name, detach_run=True,
+            blocked_resources=blocked_resources)
         assert handle is not None
         self.handle = handle
         return job_id
@@ -129,14 +136,21 @@ class StrategyExecutor:
                     return
                 time.sleep(min(2 ** attempt, 10))
 
+    def _check_cancel(self) -> None:
+        if self.job_id and state.cancel_was_requested(self.job_id):
+            raise JobCancelledDuringRecovery(
+                f'job {self.job_id} cancelled during recovery')
+
     def _relaunch_with_failover(
             self, try_same_placement_first: bool) -> Optional[int]:
         """Shared recovery loop: optional same-placement fast path, then
-        unconstrained failover, retrying with a gap until something lands."""
+        avoid-the-preempted-region, then unconstrained, retrying with a gap
+        until something lands. Aborts promptly on user cancel."""
         launched_cloud = self.handle.cloud if self.handle else None
         launched_region = self.handle.region if self.handle else None
         launched_zone = self.handle.zone if self.handle else None
         for round_idx in range(MAX_RECOVERY_ROUNDS):
+            self._check_cancel()
             # The dead slice blocks name reuse: always delete first.
             self.terminate_cluster()
             if try_same_placement_first and launched_region is not None:
@@ -155,6 +169,23 @@ class StrategyExecutor:
                         f'[job {self.job_id}] same-placement relaunch in '
                         f'{launched_region} failed; trying full failover.')
                     self.terminate_cluster()
+            elif launched_region is not None:
+                # Eager next-region: exclude the placement that just
+                # preempted us — it is the least likely to have spot
+                # capacity right now (recovery_strategy.py:706 analog).
+                from skypilot_tpu import resources as resources_lib
+                blocked = [resources_lib.Resources(cloud=launched_cloud,
+                                                   region=launched_region)]
+                try:
+                    return self._launch_once(
+                        resources_override={'region': None, 'zone': None},
+                        blocked_resources=blocked)
+                except exceptions.ResourcesUnavailableError:
+                    logger.info(
+                        f'[job {self.job_id}] no capacity outside '
+                        f'{launched_region}; allowing it again.')
+                    self.terminate_cluster()
+            self._check_cancel()
             try:
                 # Unconstrained: let the optimizer pick anywhere feasible.
                 return self._launch_once(resources_override={

@@ -55,6 +55,24 @@ def _spawn_controller(job_id: int) -> int:
     return proc.pid
 
 
+def _teardown_orphan(cluster_name: Optional[str]) -> None:
+    """Best-effort teardown of a cluster whose controller died."""
+    if not cluster_name:
+        return
+    try:
+        from skypilot_tpu import global_state
+        from skypilot_tpu.backends import slice_backend
+        record = global_state.get_cluster(cluster_name)
+        if record is None:
+            return
+        handle = slice_backend.SliceResourceHandle.from_dict(
+            record['handle'])
+        slice_backend.TpuSliceBackend().teardown(handle, terminate=True)
+        logger.info(f'Tore down orphaned cluster {cluster_name!r}.')
+    except Exception as e:  # pylint: disable=broad-except
+        logger.warning(f'Failed to tear down orphan {cluster_name!r}: {e}')
+
+
 def maybe_schedule() -> None:
     """Start controllers for PENDING jobs up to the parallelism cap.
 
@@ -74,11 +92,13 @@ def maybe_schedule() -> None:
                 alive += 1
             # Non-terminal with a dead controller and not PENDING: the
             # controller crashed hard (kill -9 / reboot). Mark it so it
-            # doesn't count against the cap forever.
+            # doesn't count against the cap forever — and tear down its
+            # cluster, or the orphaned slice bills forever with no owner.
             elif job['status'] is not state.ManagedJobStatus.PENDING:
                 state.set_terminal(
                     job['job_id'], state.ManagedJobStatus.FAILED_CONTROLLER,
                     failure_reason='controller process died')
+                _teardown_orphan(job.get('cluster_name'))
         cap = _max_parallel()
         for job in pending:
             if alive >= cap:

@@ -150,32 +150,53 @@ def _update(job_id: int, **cols: Any) -> None:
                      (*cols.values(), job_id))
 
 
+_TERMINAL_VALUES = tuple(s.value for s in _TERMINAL)
+_NOT_TERMINAL_SQL = ('status NOT IN (%s)' %
+                     ','.join('?' * len(_TERMINAL_VALUES)))
+
+
+def _update_live(job_id: int, **cols: Any) -> bool:
+    """Guarded transition: only applies while the job is non-terminal.
+
+    Returns False when the row was already terminal — e.g. a job cancelled
+    while PENDING must NOT be resurrected by its late-spawning controller.
+    """
+    sets = ', '.join(f'{k} = ?' for k in cols)
+    with _conn() as conn:
+        cur = conn.execute(
+            f'UPDATE jobs SET {sets} WHERE job_id = ? AND '
+            f'{_NOT_TERMINAL_SQL}',
+            (*cols.values(), job_id, *_TERMINAL_VALUES))
+        return cur.rowcount > 0
+
+
 def set_controller_pid(job_id: int, pid: int) -> None:
     _update(job_id, controller_pid=pid)
 
 
-def set_starting(job_id: int, cluster_name: str) -> None:
-    _update(job_id, status=ManagedJobStatus.STARTING.value,
-            cluster_name=cluster_name)
+def set_starting(job_id: int, cluster_name: str) -> bool:
+    return _update_live(job_id, status=ManagedJobStatus.STARTING.value,
+                        cluster_name=cluster_name)
 
 
-def set_started(job_id: int, cluster_job_id: Optional[int]) -> None:
+def set_started(job_id: int, cluster_job_id: Optional[int]) -> bool:
     job = get_job(job_id)
     started = job['started_at'] if job and job['started_at'] else time.time()
-    _update(job_id, status=ManagedJobStatus.RUNNING.value,
-            started_at=started, cluster_job_id=cluster_job_id)
+    return _update_live(job_id, status=ManagedJobStatus.RUNNING.value,
+                        started_at=started, cluster_job_id=cluster_job_id)
 
 
-def set_recovering(job_id: int) -> None:
-    _update(job_id, status=ManagedJobStatus.RECOVERING.value)
+def set_recovering(job_id: int) -> bool:
+    return _update_live(job_id,
+                        status=ManagedJobStatus.RECOVERING.value)
 
 
-def set_recovered(job_id: int, cluster_job_id: Optional[int]) -> None:
+def set_recovered(job_id: int, cluster_job_id: Optional[int]) -> bool:
     job = get_job(job_id)
     count = (job['recovery_count'] if job else 0) + 1
-    _update(job_id, status=ManagedJobStatus.RUNNING.value,
-            last_recovered_at=time.time(), recovery_count=count,
-            cluster_job_id=cluster_job_id)
+    return _update_live(job_id, status=ManagedJobStatus.RUNNING.value,
+                        last_recovered_at=time.time(), recovery_count=count,
+                        cluster_job_id=cluster_job_id)
 
 
 def bump_restart_on_error(job_id: int) -> int:
@@ -185,15 +206,17 @@ def bump_restart_on_error(job_id: int) -> int:
     return count
 
 
-def set_cancelling(job_id: int) -> None:
-    _update(job_id, status=ManagedJobStatus.CANCELLING.value)
+def set_cancelling(job_id: int) -> bool:
+    return _update_live(job_id,
+                        status=ManagedJobStatus.CANCELLING.value)
 
 
 def set_terminal(job_id: int, status: ManagedJobStatus,
-                 failure_reason: Optional[str] = None) -> None:
+                 failure_reason: Optional[str] = None) -> bool:
+    """First terminal status wins; a later writer cannot overwrite it."""
     assert status.is_terminal(), status
-    _update(job_id, status=status.value, ended_at=time.time(),
-            failure_reason=failure_reason)
+    return _update_live(job_id, status=status.value, ended_at=time.time(),
+                        failure_reason=failure_reason)
 
 
 def request_cancel(job_id: int) -> None:

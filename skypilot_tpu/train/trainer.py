@@ -1,0 +1,207 @@
+"""Training driver: config → mesh → (resume|init) → step loop → checkpoints.
+
+This is the native replacement for what the reference hands to torch-xla +
+HF Trainer in its TPU recipe (examples/tpu/v6e/train-llama3-8b.yaml,
+docs/source/reference/tpu.rst:100-118): one process per host, SPMD over the
+slice, periodic async checkpoints, resume-from-latest. Run on a cluster via
+a task YAML whose `run:` is `python -m skypilot_tpu.train.trainer ...` —
+the gang env contract (skylet/constants.py) provides coordinator/worker-id
+for jax.distributed on multi-host slices.
+"""
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+from skypilot_tpu import sky_logging
+
+# Fixed name, not __name__: under `python -m` this module is '__main__',
+# which would fall outside the 'skypilot_tpu' logging root (no handler).
+logger = sky_logging.init_logger('skypilot_tpu.train.trainer')
+
+
+@dataclasses.dataclass
+class TrainerConfig:
+    model: str = 'llama-debug'          # models preset name
+    model_overrides: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    mesh: Dict[str, int] = dataclasses.field(default_factory=dict)
+    batch_size: int = 8
+    seq_len: int = 512
+    total_steps: int = 100
+    learning_rate: float = 3e-4
+    warmup_steps: int = 10
+    log_every: int = 10
+    data_path: Optional[str] = None     # None → synthetic tokens
+    tokenizer: Optional[str] = None
+    ckpt_dir: Optional[str] = None
+    ckpt_every: int = 50
+
+
+def maybe_init_distributed() -> None:
+    """Initialise jax.distributed on multi-host slices from the gang env
+    (skylet/constants.py gang_env: coordinator + TPU_WORKER_ID)."""
+    import jax
+    coordinator = os.environ.get('SKYTPU_COORDINATOR_ADDRESS')
+    num_procs = int(os.environ.get('SKYTPU_NUM_PROCESSES', '1'))
+    if coordinator and num_procs > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_procs,
+            process_id=int(os.environ.get('TPU_WORKER_ID', '0')))
+
+
+def _model_config(tcfg: TrainerConfig):
+    from skypilot_tpu.models import llama, moe
+    presets = dict(llama.PRESETS)
+    presets.update(moe.PRESETS)
+    if tcfg.model not in presets:
+        raise ValueError(f'Unknown model preset {tcfg.model!r}; '
+                         f'available: {sorted(presets)}')
+    cfg = presets[tcfg.model]
+    if tcfg.model_overrides:
+        cfg = dataclasses.replace(cfg, **tcfg.model_overrides)
+    return cfg
+
+
+def _batch_iter(tcfg: TrainerConfig, vocab_size: int, start_step: int,
+                mesh) -> Iterator[Dict[str, Any]]:
+    from skypilot_tpu.data import loader
+    if tcfg.data_path is None:
+        # Synthetic stream, still step-indexed for resume determinism.
+        import numpy as np
+        rng = np.random.default_rng(0)
+        base = rng.integers(0, vocab_size,
+                            size=(max(4 * tcfg.batch_size * tcfg.seq_len,
+                                      tcfg.seq_len + 2),), dtype=np.int64)
+        tokens = base.astype(np.int32)
+    else:
+        tokens = loader.load_tokens(tcfg.data_path, tcfg.tokenizer)
+        if tokens.max() >= vocab_size:
+            raise ValueError(
+                f'Corpus has token id {int(tokens.max())} but the model '
+                f'vocab is {vocab_size}. Pick a bigger-vocab preset or a '
+                f'matching tokenizer.')
+    step = start_step
+    while True:
+        batch = loader.batch_at_step(tokens, step, tcfg.batch_size,
+                                     tcfg.seq_len)
+        yield loader.shard_batch({'tokens': batch}, mesh)
+        step += 1
+
+
+def train(tcfg: TrainerConfig) -> List[Dict[str, float]]:
+    """Run the loop; returns per-log-interval metrics (loss, step time)."""
+    import jax
+    from skypilot_tpu.parallel import MeshSpec, build_mesh
+    from skypilot_tpu.train import train_lib
+
+    maybe_init_distributed()
+    cfg = _model_config(tcfg)
+    mesh = build_mesh(MeshSpec(**tcfg.mesh) if tcfg.mesh else MeshSpec())
+    tx = train_lib.default_optimizer(learning_rate=tcfg.learning_rate,
+                                     warmup_steps=tcfg.warmup_steps,
+                                     total_steps=tcfg.total_steps)
+
+    batch_shards = mesh.shape['data'] * mesh.shape['fsdp']
+    if tcfg.batch_size % batch_shards != 0:
+        raise ValueError(
+            f'batch_size={tcfg.batch_size} must be divisible by '
+            f'data*fsdp={batch_shards} (the batch-dim mesh axes).')
+
+    ckpt = None
+    start_step = 0
+    if tcfg.ckpt_dir:
+        from skypilot_tpu.train import checkpoints
+        state, start_step, ckpt = checkpoints.restore_or_init(
+            tcfg.ckpt_dir, cfg, mesh, tx)
+    else:
+        state = train_lib.init_train_state(jax.random.PRNGKey(0), cfg, mesh,
+                                           tx)
+    step_fn = train_lib.make_train_step(cfg, mesh, tx)
+    batches = _batch_iter(tcfg, cfg.vocab_size, start_step, mesh)
+
+    history: List[Dict[str, float]] = []
+    t_last = time.perf_counter()
+    steps_since_log = 0
+    try:
+        for step in range(start_step, tcfg.total_steps):
+            state, metrics = step_fn(state, next(batches))
+            steps_since_log += 1
+            if (step + 1) % tcfg.log_every == 0 or step + 1 == \
+                    tcfg.total_steps:
+                loss = float(metrics['loss'])   # device sync point
+                now = time.perf_counter()
+                rec = {
+                    'step': step + 1,
+                    'loss': round(loss, 4),
+                    'sec_per_step': round(
+                        (now - t_last) / steps_since_log, 4),
+                }
+                t_last = now
+                steps_since_log = 0
+                history.append(rec)
+                logger.info(json.dumps(rec))
+            if ckpt is not None and (step + 1) % tcfg.ckpt_every == 0:
+                ckpt.save(state, step + 1)
+        if ckpt is not None:
+            ckpt.save(state, tcfg.total_steps)
+    finally:
+        if ckpt is not None:
+            # Exit flush barrier: async saves must be durable before the
+            # job exits (the MOUNT_CACHED-flush analog).
+            ckpt.close()
+    return history
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(prog='skytpu-trainer')
+    parser.add_argument('--model', default='llama-debug')
+    parser.add_argument('--model-override', action='append', default=[],
+                        help='key=value on the model config (repeatable).')
+    parser.add_argument('--mesh', default='',
+                        help='axis=N comma list, e.g. data=2,fsdp=4')
+    parser.add_argument('--batch-size', type=int, default=8)
+    parser.add_argument('--seq-len', type=int, default=512)
+    parser.add_argument('--steps', type=int, default=100)
+    parser.add_argument('--lr', type=float, default=3e-4)
+    parser.add_argument('--log-every', type=int, default=10)
+    parser.add_argument('--data', default=None)
+    parser.add_argument('--tokenizer', default=None)
+    parser.add_argument('--ckpt-dir', default=None)
+    parser.add_argument('--ckpt-every', type=int, default=50)
+    args = parser.parse_args()
+
+    def _parse_kv(items):
+        out = {}
+        for item in items:
+            k, v = item.split('=', 1)
+            try:
+                out[k] = int(v)
+            except ValueError:
+                try:
+                    out[k] = float(v)
+                except ValueError:
+                    out[k] = v
+        return out
+
+    mesh = {}
+    if args.mesh:
+        for part in args.mesh.split(','):
+            k, v = part.split('=')
+            mesh[k] = int(v)
+    tcfg = TrainerConfig(
+        model=args.model, model_overrides=_parse_kv(args.model_override),
+        mesh=mesh, batch_size=args.batch_size, seq_len=args.seq_len,
+        total_steps=args.steps, learning_rate=args.lr,
+        log_every=args.log_every, data_path=args.data,
+        tokenizer=args.tokenizer, ckpt_dir=args.ckpt_dir,
+        ckpt_every=args.ckpt_every)
+    train(tcfg)
+
+
+if __name__ == '__main__':
+    main()

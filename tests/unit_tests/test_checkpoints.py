@@ -1,0 +1,167 @@
+"""Checkpoint save/restore + trainer resume continuity on a CPU mesh.
+
+The recovery story (VERDICT item 3): train N steps, "die", restore, and the
+loss curve must CONTINUE — identical to an uninterrupted run — not restart.
+That holds only if (a) params/opt-state/step round-trip exactly with their
+shardings and (b) the data stream is step-indexed (data/loader.py).
+"""
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from skypilot_tpu.data import loader
+from skypilot_tpu.models import llama
+from skypilot_tpu.parallel import MeshSpec, build_mesh
+from skypilot_tpu.train import checkpoints, train_lib, trainer
+
+
+@pytest.fixture(scope='module')
+def setup():
+    cfg = dataclasses.replace(llama.PRESETS['llama-debug'], n_layers=1,
+                              dim=32, ffn_dim=64, max_seq_len=64)
+    mesh = build_mesh(MeshSpec(data=2, fsdp=2, tensor=2))
+    tx = train_lib.default_optimizer(warmup_steps=2, total_steps=100)
+    step_fn = train_lib.make_train_step(cfg, mesh, tx)
+    return cfg, mesh, tx, step_fn
+
+
+def _batch(step, cfg):
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, cfg.vocab_size, size=(4096,)).astype(np.int32)
+    return {'tokens': loader.batch_at_step(tokens, step, 8, 32)}
+
+
+class TestCheckpointRoundtrip:
+
+    def test_save_restore_exact(self, setup, tmp_path):
+        cfg, mesh, tx, step_fn = setup
+        state = train_lib.init_train_state(jax.random.PRNGKey(0), cfg, mesh,
+                                           tx)
+        state, _ = step_fn(state, _batch(0, cfg))
+        with checkpoints.Checkpointer(str(tmp_path / 'ckpt')) as ckpt:
+            saved_step = ckpt.save(state, wait=True)
+            assert saved_step == 1
+            restored, step = ckpt.restore(cfg, mesh, tx)
+        assert step == 1
+        assert int(jax.device_get(restored.step)) == 1
+        jax.tree.map(np.testing.assert_array_equal,
+                     jax.device_get(state.params),
+                     jax.device_get(restored.params))
+        jax.tree.map(np.testing.assert_array_equal,
+                     jax.device_get(state.opt_state),
+                     jax.device_get(restored.opt_state))
+        # Restored arrays carry the mesh shardings, not replicated copies.
+        flat = jax.tree.leaves(restored.params)
+        assert any(not s.sharding.is_fully_replicated for s in flat)
+
+    def test_restore_on_different_topology(self, setup, tmp_path):
+        """Recovery may land on a different slice shape: save under
+        (data=2,fsdp=2,tensor=2), restore under (data=2,fsdp=4) — values
+        must match and shardings must follow the NEW mesh."""
+        cfg, mesh, tx, step_fn = setup
+        state = train_lib.init_train_state(jax.random.PRNGKey(0), cfg, mesh,
+                                           tx)
+        with checkpoints.Checkpointer(str(tmp_path / 'topo')) as ckpt:
+            ckpt.save(state, wait=True)
+            new_mesh = build_mesh(MeshSpec(data=2, fsdp=4, tensor=1))
+            restored, _ = ckpt.restore(cfg, new_mesh, tx)
+        jax.tree.map(np.testing.assert_array_equal,
+                     jax.device_get(state.params),
+                     jax.device_get(restored.params))
+        for leaf in jax.tree.leaves(restored.params):
+            assert leaf.sharding.mesh.shape == dict(new_mesh.shape)
+
+    def test_max_to_keep_and_latest(self, setup, tmp_path):
+        cfg, mesh, tx, step_fn = setup
+        state = train_lib.init_train_state(jax.random.PRNGKey(0), cfg, mesh,
+                                           tx)
+        with checkpoints.Checkpointer(str(tmp_path / 'gc'),
+                                      max_to_keep=2) as ckpt:
+            for s in (1, 2, 3):
+                ckpt.save(state, s, wait=True)
+            assert ckpt.latest_step() == 3
+            assert ckpt.all_steps() == [2, 3]
+
+    def test_resume_continues_loss_curve(self, setup, tmp_path):
+        """2 straight steps vs (1 step → save → die → restore → 1 step):
+        identical losses, because state AND data stream resume exactly."""
+        cfg, mesh, tx, step_fn = setup
+
+        def fresh():
+            return train_lib.init_train_state(jax.random.PRNGKey(0), cfg,
+                                              mesh, tx)
+
+        # Uninterrupted run.
+        state = fresh()
+        losses_a = []
+        for k in range(2):
+            state, m = step_fn(state, _batch(k, cfg))
+            losses_a.append(float(m['loss']))
+
+        # Interrupted + resumed run.
+        state = fresh()
+        state, m = step_fn(state, _batch(0, cfg))
+        with checkpoints.Checkpointer(str(tmp_path / 'resume')) as ckpt:
+            ckpt.save(state, wait=True)
+        del state
+        state, start = checkpoints.Checkpointer(
+            str(tmp_path / 'resume')).restore(cfg, mesh, tx)
+        assert start == 1
+        state, m = step_fn(state, _batch(start, cfg))
+        np.testing.assert_allclose(float(m['loss']), losses_a[1],
+                                   rtol=1e-5)
+
+
+class TestLoader:
+
+    def test_batch_at_step_deterministic(self):
+        tokens = np.arange(10000, dtype=np.int32)
+        b1 = loader.batch_at_step(tokens, 7, 4, 128)
+        b2 = loader.batch_at_step(tokens, 7, 4, 128)
+        np.testing.assert_array_equal(b1, b2)
+        assert b1.shape == (4, 129)
+        # Consecutive steps advance the stream.
+        b3 = loader.batch_at_step(tokens, 8, 4, 128)
+        assert not np.array_equal(b1, b3)
+
+    def test_text_roundtrip(self, tmp_path):
+        p = tmp_path / 'corpus.txt'
+        p.write_text('hello tpu world, ' * 500)
+        tokens = loader.load_tokens(str(p))
+        assert tokens.dtype == np.int32
+        assert tokens.max() < 256
+        batch = loader.batch_at_step(tokens, 0, 2, 64)
+        assert batch.shape == (2, 65)
+
+
+class TestTrainerResume:
+
+    def test_trainer_end_to_end_resume(self, tmp_path):
+        """Full trainer API: run 4 steps with ckpt_every=2, kill after it
+        wrote step 2, rerun → resumes at 2, and the merged loss history
+        matches an uninterrupted 4-step run."""
+        corpus = tmp_path / 'data.txt'
+        corpus.write_text('the quick brown fox jumps over the lazy dog. '
+                          * 400)
+        common = dict(
+            model='llama-debug',
+            model_overrides={'n_layers': 1, 'dim': 32, 'ffn_dim': 64,
+                             'max_seq_len': 64},
+            mesh={'data': 2, 'fsdp': 2, 'tensor': 2},
+            batch_size=4, seq_len=32, log_every=1,
+            data_path=str(corpus),
+        )
+        # Uninterrupted reference run (no checkpointing).
+        ref = trainer.train(trainer.TrainerConfig(total_steps=4, **common))
+
+        ckpt_dir = str(tmp_path / 'ck')
+        first = trainer.train(trainer.TrainerConfig(
+            total_steps=2, ckpt_dir=ckpt_dir, ckpt_every=2, **common))
+        resumed = trainer.train(trainer.TrainerConfig(
+            total_steps=4, ckpt_dir=ckpt_dir, ckpt_every=2, **common))
+        assert [r['step'] for r in resumed] == [3, 4]
+        merged = [r['loss'] for r in first + resumed]
+        np.testing.assert_allclose(merged, [r['loss'] for r in ref],
+                                   rtol=1e-4)
