@@ -192,6 +192,57 @@ def _get_device():
         raise SystemExit(2)
 
 
+def run_decode_bench():
+    """Secondary benchmark (SKYTPU_BENCH_METRIC=decode): single-chip greedy
+    decode tokens/s + TTFT on the ~1B flagship-mini. The reference's serve
+    numbers live in examples/tpu/v6e/README.md:119-127 (JetStream/vLLM)."""
+    import jax
+    import jax.numpy as jnp
+    from skypilot_tpu.models import decode, llama
+
+    device = _get_device()
+    on_tpu = device.platform == 'tpu'
+    cfg = (llama.PRESETS['llama-1b'] if on_tpu else
+           llama.PRESETS['llama-debug'])
+    batch = int(os.environ.get('SKYTPU_BENCH_DECODE_BATCH', '8'))
+    prompt_len = int(os.environ.get('SKYTPU_BENCH_PROMPT', '512'))
+    new_tokens = int(os.environ.get('SKYTPU_BENCH_NEW_TOKENS', '128'))
+    params = jax.jit(lambda r: decode.cast_params_for_decode(
+        llama.init_params(r, cfg), cfg))(jax.random.PRNGKey(0))
+    prompt = jnp.zeros((batch, prompt_len), jnp.int32)
+
+    def run():
+        return decode.generate(params, prompt, cfg, new_tokens,
+                               max_len=prompt_len + new_tokens)
+
+    prefill_jit = jax.jit(
+        lambda p, t: jnp.argmax(
+            decode.prefill(p, t, cfg, prompt_len + new_tokens)[0], -1))
+    # Warm up both jits; sync via host transfer — block_until_ready is
+    # unreliable through remote-device tunnels (see run_bench).
+    int(prefill_jit(params, prompt)[0])
+    int(run()[0, -1])
+    # TTFT: prefill + first-token argmax, compile excluded.
+    t0 = time.perf_counter()
+    int(prefill_jit(params, prompt)[0])
+    ttft_ms = (time.perf_counter() - t0) * 1e3
+    # Steady-state decode throughput.
+    t0 = time.perf_counter()
+    int(run()[0, -1])
+    dt = time.perf_counter() - t0
+    tok_s = batch * new_tokens / dt
+    print(f'decode: device={device.device_kind} params='
+          f'{cfg.num_params/1e6:.0f}M batch={batch} prompt={prompt_len} '
+          f'new={new_tokens} ttft={ttft_ms:.1f}ms tok/s={tok_s:.0f}',
+          file=sys.stderr)
+    print(json.dumps({
+        'metric': 'decode_tokens_per_s',
+        'value': round(tok_s, 1),
+        'unit': 'tok/s',
+        'vs_baseline': None,   # reference publishes no 1B-decode number
+    }), flush=True)
+
+
 def run_bench():
     import jax
     from skypilot_tpu.parallel import MeshSpec, build_mesh
@@ -245,6 +296,9 @@ if __name__ == '__main__':
         print(f'[bench] backend ok: {dev.device_kind} ({dev.platform})',
               file=sys.stderr)
     elif os.environ.get(CHILD_ENV) == '1':
-        run_bench()
+        if os.environ.get('SKYTPU_BENCH_METRIC') == 'decode':
+            run_decode_bench()
+        else:
+            run_bench()
     else:
         sys.exit(supervise())

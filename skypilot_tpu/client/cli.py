@@ -372,6 +372,64 @@ def jobs_cancel(job_ids: Tuple[int, ...], name: Optional[str],
 
 
 @cli.group()
+def serve():
+    """Replicated serving with autoscaling (reference: `sky serve`)."""
+
+
+@serve.command(name='up')
+@click.argument('entrypoint', required=True)
+@click.option('--service-name', '-n', default=None)
+@click.option('--env', multiple=True)
+def serve_up(entrypoint: str, service_name: Optional[str],
+             env: Tuple[str, ...]):
+    """Bring up a service from a task YAML with a `service:` section."""
+    from skypilot_tpu import serve as serve_lib
+    task = _load_task(entrypoint, env, {})
+    try:
+        info = serve_lib.up(task, service_name=service_name)
+    except (exceptions.SkyTpuError, ValueError) as e:
+        raise click.ClickException(str(e)) from e
+    click.echo(f"Service {info['name']!r} starting at {info['endpoint']} "
+               f"(watch: skytpu serve status).")
+
+
+@serve.command(name='status')
+@click.argument('service_names', nargs=-1)
+def serve_status(service_names: Tuple[str, ...]):
+    """Show services and their replicas."""
+    from skypilot_tpu import serve as serve_lib
+    records = serve_lib.status(list(service_names) or None)
+    if not records:
+        click.echo('No services.')
+        return
+    for r in records:
+        click.echo(f"{r['name']}  {r['status'].colored_str()}  "
+                   f"{r['endpoint']}")
+        for rep in r['replicas']:
+            click.echo(f"  replica {rep['replica_id']}  "
+                       f"{rep['status'].colored_str()}  {rep['url']}  "
+                       f"({rep['cluster_name']})")
+        if r.get('failure_reason'):
+            click.echo(f"  failure: {r['failure_reason']}")
+
+
+@serve.command(name='down')
+@click.argument('service_name', required=True)
+@click.option('--purge', is_flag=True, default=False,
+              help='Also delete the service record.')
+@click.option('--yes', '-y', is_flag=True, default=False)
+def serve_down(service_name: str, purge: bool, yes: bool):
+    """Tear down a service and all its replicas."""
+    from skypilot_tpu import serve as serve_lib
+    if not yes:
+        click.confirm(f'Tear down service {service_name!r}?', abort=True)
+    try:
+        serve_lib.down(service_name, purge=purge)
+    except exceptions.SkyTpuError as e:
+        raise click.ClickException(str(e)) from e
+
+
+@cli.group()
 def api():
     """Manage the API server (reference: `sky api`)."""
 

@@ -162,6 +162,13 @@ def launch(
             'Multi-task DAG launch goes through the managed-jobs plane '
             '(skytpu jobs launch); `launch` takes a single task.')
     task = dag.tasks[0]
+    if task.service_spec is not None:
+        # A `service:` section means replicas/autoscaling/LB — silently
+        # launching one bare cluster would ignore all of it.
+        raise ValueError(
+            "Task has a 'service:' section; use `skytpu serve up` "
+            "(skypilot_tpu.serve.up) to deploy it, or remove the section "
+            "to launch it as a plain cluster.")
     if cluster_name is None:
         cluster_name = common_utils.generate_cluster_name()
     common_utils.check_cluster_name_is_valid(cluster_name)

@@ -139,6 +139,7 @@ def tail_logs(job_id: Optional[int] = None, follow: bool = True,
         assert job is not None
         if (job['status'] is state.ManagedJobStatus.RUNNING and
                 job['cluster_job_id'] is not None):
+            recoveries_before = job['recovery_count']
             try:
                 # Live stream from the cluster; blocks until the on-cluster
                 # job ends (or the slice is preempted mid-stream).
@@ -147,7 +148,17 @@ def tail_logs(job_id: Optional[int] = None, follow: bool = True,
                 job = state.get_job(job_id)
                 if not follow or job is None or job['status'].is_terminal():
                     return rc
-                continue  # preempted mid-stream: wait for the recovery
+                # The on-cluster job ended but the managed job hasn't been
+                # finalised yet (controller polls every POLL_SECONDS). Wait
+                # for either the terminal flip or a recovery — re-streaming
+                # immediately would replay the whole log in a tight loop.
+                while (job is not None and not job['status'].is_terminal()
+                       and job['recovery_count'] == recoveries_before):
+                    time.sleep(0.5)
+                    job = state.get_job(job_id)
+                if job is None or job['status'].is_terminal():
+                    return rc
+                continue  # recovered onto a fresh cluster: stream it
             except exceptions.SkyTpuError:
                 pass  # cluster just went away — recovery or teardown
         if job['status'].is_terminal() or not follow:

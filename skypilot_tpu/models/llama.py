@@ -27,6 +27,16 @@ Params = Dict[str, Any]
 
 
 @dataclasses.dataclass(frozen=True)
+class RopeScaling:
+    """Llama-3.1 NTK rope scaling; frozen so configs stay hashable (decode
+    jits with the config as a static argument)."""
+    factor: float = 8.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position: int = 8192
+
+
+@dataclasses.dataclass(frozen=True)
 class LlamaConfig:
     vocab_size: int = 32000
     dim: int = 4096
@@ -36,7 +46,7 @@ class LlamaConfig:
     head_dim: Optional[int] = None          # default dim // n_heads
     ffn_dim: int = 14336
     rope_theta: float = 500000.0
-    rope_scaling: Optional[dict] = None     # llama-3.1 NTK dict
+    rope_scaling: Optional[RopeScaling] = None  # accepts a dict in __init__
     rms_eps: float = 1e-5
     max_seq_len: int = 8192
     tie_embeddings: bool = False
@@ -47,6 +57,11 @@ class LlamaConfig:
     scan_layers: bool = True
     pipeline_stages: int = 1                # >1: GPipe over the 'stage' axis
     num_microbatches: int = 1               # PP microbatches (divides batch)
+
+    def __post_init__(self):
+        if isinstance(self.rope_scaling, dict):
+            object.__setattr__(self, 'rope_scaling',
+                               RopeScaling(**self.rope_scaling))
 
     @property
     def hd(self) -> int:

@@ -24,6 +24,9 @@ def rope_frequencies(head_dim: int,
     half = head_dim // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
     if scaling:
+        if not isinstance(scaling, dict):   # models.llama.RopeScaling
+            import dataclasses as _dc
+            scaling = _dc.asdict(scaling)
         factor = float(scaling['factor'])
         low = float(scaling.get('low_freq_factor', 1.0))
         high = float(scaling.get('high_freq_factor', 4.0))

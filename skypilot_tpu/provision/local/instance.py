@@ -110,12 +110,44 @@ def wait_instances(region: str, cluster_name: str,
             f'Cluster {cluster_name} is not {want}.')
 
 
+def _kill_cluster_processes(cluster_name: str) -> None:
+    """SIGKILL every process running 'on' this fabricated cluster.
+
+    A real slice teardown/preemption kills its processes with it; the fake
+    cloud must too, or gang jobs and serve replicas outlive their cluster
+    (and keep ports bound across hermetic tests). Host processes are
+    identified by the SKYTPU_RUNTIME_DIR env the command runner injects,
+    which embeds the cluster directory path.
+    """
+    import signal
+    cdir = os.path.abspath(_cluster_dir(cluster_name)) + os.sep
+    me = os.getpid()
+    try:
+        proc_entries = os.listdir('/proc')
+    except OSError:
+        return   # no procfs (macOS dev box): accept the process leak
+    for entry in proc_entries:
+        if not entry.isdigit() or int(entry) == me:
+            continue
+        try:
+            with open(f'/proc/{entry}/environ', 'rb') as f:
+                env = f.read().decode('utf-8', errors='replace')
+        except OSError:
+            continue
+        if cdir in env:
+            try:
+                os.kill(int(entry), signal.SIGKILL)
+            except OSError:
+                pass
+
+
 def stop_instances(region: str, cluster_name: str,
                    provider_config=None) -> None:
     del region, provider_config
     meta = _load_meta(cluster_name)
     if meta is None:
         return
+    _kill_cluster_processes(cluster_name)
     meta['status'] = _STATUS_STOPPED
     _save_meta(cluster_name, meta)
 
@@ -123,6 +155,7 @@ def stop_instances(region: str, cluster_name: str,
 def terminate_instances(region: str, cluster_name: str,
                         provider_config=None) -> None:
     del region, provider_config
+    _kill_cluster_processes(cluster_name)
     cdir = _cluster_dir(cluster_name)
     if os.path.isdir(cdir):
         shutil.rmtree(cdir, ignore_errors=True)

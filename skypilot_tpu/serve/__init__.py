@@ -1,0 +1,15 @@
+"""Serve plane: replicated serving with autoscaling + load balancing.
+
+Reference analog: sky/serve/ (service.py, replica_managers.py,
+autoscalers.py, load_balancer.py). TPU-first redesign notes:
+- controller + load balancer run in ONE detached process per service (the
+  LB is asyncio; the control loop is a thread) next to the API server — no
+  dedicated controller cluster to provision.
+- each replica is a TPU slice cluster launched through the normal
+  execution path, so replicas inherit provisioning failover for free.
+"""
+from skypilot_tpu.serve.core import down
+from skypilot_tpu.serve.core import status
+from skypilot_tpu.serve.core import up
+
+__all__ = ['up', 'down', 'status']

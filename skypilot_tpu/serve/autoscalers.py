@@ -1,0 +1,102 @@
+"""Autoscalers: request-rate scaling with hysteresis.
+
+Reference analog: sky/serve/autoscalers.py (`Autoscaler:116`,
+`_AutoscalerWithHysteresis:369`, `RequestRateAutoscaler:455`). The decision
+function is pure — (request timestamps, ready count, now) → target — so it
+unit-tests with synthetic clocks, no clusters.
+"""
+from __future__ import annotations
+
+import math
+import time
+from typing import Deque, List, Optional
+
+from collections import deque
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.serve import service_spec as spec_lib
+from skypilot_tpu.utils import registry
+
+logger = sky_logging.init_logger(__name__)
+
+# Sliding window over which QPS is measured (reference default 60s).
+QPS_WINDOW_SECONDS = 60.0
+
+
+class Autoscaler:
+
+    def __init__(self, policy: spec_lib.ReplicaPolicy):
+        self.policy = policy
+
+    def record_request(self, now: Optional[float] = None) -> None:
+        """Called by the load balancer on every proxied request."""
+
+    def target_replicas(self, now: Optional[float] = None) -> int:
+        raise NotImplementedError
+
+    @classmethod
+    def make(cls, policy: spec_lib.ReplicaPolicy) -> 'Autoscaler':
+        if policy.autoscaling_enabled:
+            return registry.AUTOSCALER_REGISTRY.type_from_str(
+                'request_rate')(policy)
+        return FixedAutoscaler(policy)
+
+
+class FixedAutoscaler(Autoscaler):
+    """Static replica count (service.replicas: N)."""
+
+    def target_replicas(self, now: Optional[float] = None) -> int:
+        return self.policy.min_replicas
+
+
+@registry.AUTOSCALER_REGISTRY.register(name='request_rate')
+class RequestRateAutoscaler(Autoscaler):
+    """target = ceil(qps / target_qps_per_replica), with hysteresis: the
+    raw target must hold for upscale_delay_seconds (or
+    downscale_delay_seconds) before the decision changes — absorbing bursts
+    without flapping replicas whose provision time is minutes."""
+
+    def __init__(self, policy: spec_lib.ReplicaPolicy):
+        super().__init__(policy)
+        assert policy.autoscaling_enabled
+        self._timestamps: Deque[float] = deque()
+        self._current_target = policy.min_replicas
+        # (proposed_target, since_when) while a change is pending.
+        self._pending: Optional[tuple] = None
+
+    def record_request(self, now: Optional[float] = None) -> None:
+        now = time.time() if now is None else now
+        self._timestamps.append(now)
+
+    def _qps(self, now: float) -> float:
+        cutoff = now - QPS_WINDOW_SECONDS
+        while self._timestamps and self._timestamps[0] < cutoff:
+            self._timestamps.popleft()
+        return len(self._timestamps) / QPS_WINDOW_SECONDS
+
+    def _raw_target(self, now: float) -> int:
+        qps = self._qps(now)
+        assert self.policy.target_qps_per_replica is not None
+        want = math.ceil(qps / self.policy.target_qps_per_replica)
+        lo = self.policy.min_replicas
+        hi = self.policy.max_replicas or lo
+        return max(lo, min(hi, want))
+
+    def target_replicas(self, now: Optional[float] = None) -> int:
+        now = time.time() if now is None else now
+        raw = self._raw_target(now)
+        if raw == self._current_target:
+            self._pending = None
+            return self._current_target
+        if self._pending is None or self._pending[0] != raw:
+            self._pending = (raw, now)
+            return self._current_target
+        delay = (self.policy.upscale_delay_seconds
+                 if raw > self._current_target else
+                 self.policy.downscale_delay_seconds)
+        if now - self._pending[1] >= delay:
+            logger.info(f'Autoscaler: {self._current_target} → {raw} '
+                        f'replicas (held {now - self._pending[1]:.0f}s).')
+            self._current_target = raw
+            self._pending = None
+        return self._current_target

@@ -1,0 +1,161 @@
+"""Per-service controller process: reconcile loop + load balancer.
+
+Reference analog: sky/serve/service.py + controller.py — there, controller
+and LB are separate processes on a controller cluster; here one detached
+process runs both (reconcile loop in a thread, LB on the asyncio loop),
+because a process boundary between two components that share only the
+ready-replica list buys nothing but IPC.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import threading
+import time
+import traceback
+
+from aiohttp import web
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.serve import autoscalers as autoscaler_lib
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve import replica_managers
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve import service_spec as spec_lib
+from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
+
+logger = sky_logging.init_logger('skypilot_tpu.serve.controller')
+
+RECONCILE_SECONDS = float(os.environ.get('SKYTPU_SERVE_SYNC_SECONDS', '5'))
+
+
+class ServiceController:
+
+    def __init__(self, service_name: str):
+        record = serve_state.get_service(service_name)
+        if record is None:
+            raise ValueError(f'Service {service_name!r} not found.')
+        self.name = service_name
+        self.record = record
+        self.spec = spec_lib.ServiceSpec.from_yaml_config(record['spec'])
+        task_cfg = dict(record['task_config'])
+        task_cfg.pop('service', None)
+        self.task = task_lib.Task.from_yaml_config(task_cfg)
+        self.autoscaler = autoscaler_lib.Autoscaler.make(self.spec.policy)
+        self.manager = replica_managers.ReplicaManager(service_name,
+                                                       self.task, self.spec)
+        self.lb = lb_lib.LoadBalancer(self.spec.load_balancing_policy,
+                                      self.autoscaler)
+        self._stop = threading.Event()
+
+    # ------------------------------------------------------------------
+    def _reconcile_loop(self) -> None:
+        serve_state.set_service_status(self.name,
+                                       ServiceStatus.REPLICA_INIT)
+        while not self._stop.is_set():
+            try:
+                record = serve_state.get_service(self.name)
+                if record is None or record['status'] in (
+                        ServiceStatus.SHUTTING_DOWN, ServiceStatus.SHUTDOWN):
+                    break
+                target = self.autoscaler.target_replicas()
+                self.manager.reconcile(target)
+                if self.manager.permanently_failed:
+                    self.manager.terminate_all()
+                    serve_state.set_service_status(
+                        self.name, ServiceStatus.FAILED,
+                        failure_reason=self.manager.permanently_failed)
+                    logger.warning(f'Service {self.name!r} FAILED: '
+                                   f'{self.manager.permanently_failed}')
+                    break
+                ready = self.manager.ready_urls()
+                self.lb.set_ready_replicas(ready)
+                status = (ServiceStatus.READY if ready else
+                          ServiceStatus.REPLICA_INIT)
+                if record['status'] is not status:
+                    serve_state.set_service_status(self.name, status)
+            except Exception:  # pylint: disable=broad-except
+                logger.warning('reconcile error:\n' + traceback.format_exc())
+            self._stop.wait(RECONCILE_SECONDS)
+
+    # ------------------------------------------------------------------
+    def run(self) -> None:
+        serve_state.update_service(self.name, controller_pid=os.getpid())
+        loop_thread = threading.Thread(target=self._reconcile_loop,
+                                       daemon=True)
+        loop_thread.start()
+        lb_port = int(self.record['lb_port'])
+        logger.info(f'Service {self.name!r}: load balancer on :{lb_port}, '
+                    f'policy={self.spec.load_balancing_policy}.')
+        try:
+            web.run_app(self.lb.build_app(), host='0.0.0.0', port=lb_port,
+                        print=None, handle_signals=True)
+        finally:
+            self._stop.set()
+            loop_thread.join(timeout=10)
+
+
+def shutdown_service(service_name: str) -> None:
+    """Tear down every replica, then mark SHUTDOWN (runs in the `serve
+    down` caller, not the controller, so it works when the controller is
+    already dead)."""
+    record = serve_state.get_service(service_name)
+    if record is None:
+        return
+    serve_state.set_service_status(service_name,
+                                   ServiceStatus.SHUTTING_DOWN)
+    # Stop the controller first so it cannot relaunch what we delete.
+    # SIGTERM, wait (aiohttp graceful shutdown + in-flight launch threads
+    # can hold it for a while), then SIGKILL — a live controller racing the
+    # teardown below would resurrect replicas.
+    pid = record.get('controller_pid')
+    if pid:
+        pid = int(pid)
+        try:
+            os.kill(pid, 15)
+            for _ in range(75):           # up to 15s graceful
+                os.kill(pid, 0)
+                time.sleep(0.2)
+            os.kill(pid, 9)
+        except (OSError, ProcessLookupError):
+            pass
+    spec = spec_lib.ServiceSpec.from_yaml_config(record['spec'])
+    task_cfg = dict(record['task_config'])
+    task_cfg.pop('service', None)
+    task = task_lib.Task.from_yaml_config(task_cfg)
+    manager = replica_managers.ReplicaManager(service_name, task, spec)
+    manager.terminate_all()
+    # A launch thread that survived the SIGTERM window may have registered
+    # a cluster after terminate_all enumerated the table: sweep any cluster
+    # named like this service's replicas.
+    from skypilot_tpu import global_state
+    from skypilot_tpu.backends import slice_backend
+    prefix = f'{service_name}-replica-'
+    for cluster in global_state.get_clusters():
+        if cluster['name'].startswith(prefix):
+            try:
+                handle = slice_backend.SliceResourceHandle.from_dict(
+                    cluster['handle'])
+                slice_backend.TpuSliceBackend().teardown(handle,
+                                                         terminate=True)
+            except Exception as e:  # pylint: disable=broad-except
+                logger.warning(f'Orphan sweep of {cluster["name"]}: {e}')
+    serve_state.set_service_status(service_name, ServiceStatus.SHUTDOWN)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--service', required=True)
+    args = parser.parse_args()
+    try:
+        ServiceController(args.service).run()
+    except Exception as e:  # pylint: disable=broad-except
+        traceback.print_exc()
+        serve_state.set_service_status(
+            args.service, ServiceStatus.FAILED,
+            failure_reason=f'{type(e).__name__}: {e}')
+
+
+if __name__ == '__main__':
+    main()

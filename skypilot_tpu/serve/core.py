@@ -1,0 +1,136 @@
+"""User-facing serve API: up / status / down.
+
+Reference analog: sky/serve client+server core (`sky serve up/status/down`).
+"""
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu import task as task_lib
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve import service_spec as spec_lib
+from skypilot_tpu.serve.serve_state import ServiceStatus
+
+logger = sky_logging.init_logger(__name__)
+
+DEFAULT_LB_PORT_START = 30001
+
+
+def _free_port(start: int) -> int:
+    for port in range(start, start + 200):
+        with socket.socket() as s:
+            try:
+                s.bind(('127.0.0.1', port))
+                return port
+            except OSError:
+                continue
+    raise RuntimeError('No free port for the load balancer.')
+
+
+def _spawn_controller(service_name: str) -> int:
+    log_path = serve_state.controller_log_path(service_name)
+    env = dict(os.environ)
+    repo_root = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    pp = env.get('PYTHONPATH', '')
+    if repo_root not in pp.split(os.pathsep):
+        env['PYTHONPATH'] = f'{repo_root}{os.pathsep}{pp}' if pp else repo_root
+    with open(log_path, 'ab') as log_file:
+        proc = subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.serve.controller',
+             '--service', service_name],
+            stdout=log_file, stderr=subprocess.STDOUT, env=env,
+            start_new_session=True)
+    return proc.pid
+
+
+def up(task: task_lib.Task, service_name: Optional[str] = None,
+       lb_port: Optional[int] = None) -> Dict[str, Any]:
+    """Bring up a service; returns {name, endpoint} immediately (replicas
+    come up asynchronously — watch `serve status`)."""
+    if task.service_spec is None:
+        raise ValueError(
+            "Task has no 'service:' section; add one (readiness_probe, "
+            "replicas/replica_policy, ports) to serve it.")
+    spec = spec_lib.ServiceSpec.from_yaml_config(task.service_spec)
+    name = service_name or task.name or 'service'
+    existing = serve_state.get_service(name)
+    if existing is not None and not existing['status'].is_terminal():
+        raise ValueError(
+            f'Service {name!r} already exists ({existing["status"].value}). '
+            f'Tear it down first with `skytpu serve down {name}`.')
+    if existing is not None:
+        serve_state.remove_service(name)
+    if lb_port is None:
+        lb_port = _free_port(DEFAULT_LB_PORT_START)
+    if not serve_state.add_service(name, task.to_yaml_config(),
+                                   spec.to_yaml_config(), lb_port):
+        # Lost a concurrent-up race: a second controller would fight the
+        # winner over the LB port and clobber its status.
+        raise ValueError(f'Service {name!r} was just created by another '
+                         f'request; check `skytpu serve status`.')
+    pid = _spawn_controller(name)
+    serve_state.update_service(name, controller_pid=pid)
+    endpoint = f'http://127.0.0.1:{lb_port}'
+    logger.info(f'Service {name!r} starting; endpoint {endpoint} '
+                f'(controller pid {pid}).')
+    return {'name': name, 'endpoint': endpoint}
+
+
+def status(service_names: Optional[List[str]] = None
+           ) -> List[Dict[str, Any]]:
+    records = serve_state.get_services()
+    if service_names:
+        records = [r for r in records if r['name'] in service_names]
+    out = []
+    for r in records:
+        replicas = serve_state.get_replicas(r['name'])
+        out.append({
+            'name': r['name'],
+            'status': r['status'],
+            'endpoint': f"http://127.0.0.1:{r['lb_port']}",
+            'created_at': r['created_at'],
+            'failure_reason': r.get('failure_reason'),
+            'replicas': [{
+                'replica_id': rep['replica_id'],
+                'status': rep['status'],
+                'url': rep['url'],
+                'cluster_name': rep['cluster_name'],
+            } for rep in replicas],
+        })
+    return out
+
+
+def down(service_name: str, purge: bool = False) -> None:
+    from skypilot_tpu.serve import controller as controller_lib
+    record = serve_state.get_service(service_name)
+    if record is None:
+        raise exceptions.ServeUserTerminatedError(
+            f'Service {service_name!r} not found.')
+    controller_lib.shutdown_service(service_name)
+    if purge:
+        serve_state.remove_service(service_name)
+    logger.info(f'Service {service_name!r} torn down.')
+
+
+def wait_until(service_name: str, statuses, timeout: float = 120.0
+               ) -> ServiceStatus:
+    """Test/automation helper: block until the service hits a status."""
+    deadline = time.time() + timeout
+    last = None
+    while time.time() < deadline:
+        record = serve_state.get_service(service_name)
+        if record is not None:
+            last = record['status']
+            if last in statuses:
+                return last
+        time.sleep(0.3)
+    raise TimeoutError(
+        f'service {service_name} stuck in {last}, wanted {statuses}')

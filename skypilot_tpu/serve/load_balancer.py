@@ -1,0 +1,100 @@
+"""HTTP load balancer: reverse proxy with per-request replica selection.
+
+Reference analog: sky/serve/load_balancer.py (FastAPI proxy). aiohttp here
+(already the API server's stack). The LB runs inside the service controller
+process (serve/controller.py) and is told the ready-replica set after every
+reconcile pass; it feeds request timestamps to the autoscaler.
+
+Control endpoints live under /-/lb/ (anything else is proxied verbatim):
+  GET /-/lb/health → {ready_replicas: N}
+"""
+from __future__ import annotations
+
+import asyncio
+import typing
+from typing import List, Optional
+
+import aiohttp
+from aiohttp import web
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.serve import load_balancing_policies as lb_policies
+from skypilot_tpu.utils import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu.serve import autoscalers
+
+logger = sky_logging.init_logger(__name__)
+
+_HOP_HEADERS = {'connection', 'keep-alive', 'transfer-encoding', 'upgrade',
+                'proxy-authenticate', 'proxy-authorization', 'te',
+                'trailers', 'host', 'content-length'}
+
+
+class LoadBalancer:
+
+    def __init__(self, policy_name: str,
+                 autoscaler: Optional['autoscalers.Autoscaler'] = None):
+        self.policy: lb_policies.LoadBalancingPolicy = (
+            registry.LB_POLICY_REGISTRY.type_from_str(policy_name)())
+        self.autoscaler = autoscaler
+        self._session: Optional[aiohttp.ClientSession] = None
+
+    def set_ready_replicas(self, urls: List[str]) -> None:
+        self.policy.set_ready_replicas(urls)
+
+    # ------------------------------------------------------------------
+    async def _proxy(self, request: web.Request) -> web.StreamResponse:
+        if self.autoscaler is not None:
+            self.autoscaler.record_request()
+        target = self.policy.select()
+        if target is None:
+            return web.json_response(
+                {'error': 'no ready replicas'}, status=503)
+        if self._session is None:
+            self._session = aiohttp.ClientSession(
+                timeout=aiohttp.ClientTimeout(total=300))
+        url = target.rstrip('/') + request.rel_url.path_qs
+        headers = {k: v for k, v in request.headers.items()
+                   if k.lower() not in _HOP_HEADERS}
+        body = await request.read()
+        self.policy.request_started(target)
+        try:
+            async with self._session.request(request.method, url,
+                                             headers=headers,
+                                             data=body) as upstream:
+                resp = web.StreamResponse(status=upstream.status)
+                for k, v in upstream.headers.items():
+                    if k.lower() not in _HOP_HEADERS:
+                        resp.headers[k] = v
+                await resp.prepare(request)
+                # Stream the body through: LLM replies are long and
+                # incremental (SSE/chunked) — never buffer them whole.
+                async for chunk in upstream.content.iter_chunked(16384):
+                    await resp.write(chunk)
+                await resp.write_eof()
+                return resp
+        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            return web.json_response(
+                {'error': f'upstream {target} failed: {e}'}, status=502)
+        finally:
+            self.policy.request_finished(target)
+
+    async def _health(self, request: web.Request) -> web.Response:
+        del request
+        ready = len(self.policy._replicas)  # pylint: disable=protected-access
+        return web.json_response({'ready_replicas': ready})
+
+    # ------------------------------------------------------------------
+    def build_app(self) -> web.Application:
+        app = web.Application()
+        app.router.add_get('/-/lb/health', self._health)
+        app.router.add_route('*', '/{tail:.*}', self._proxy)
+
+        async def _cleanup(app_):
+            del app_
+            if self._session is not None:
+                await self._session.close()
+
+        app.on_cleanup.append(_cleanup)
+        return app

@@ -1,0 +1,67 @@
+"""Per-request replica selection policies.
+
+Reference analog: sky/serve/load_balancing_policies.py
+(`RoundRobinPolicy:85`, `LeastLoadPolicy:111` — the default).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from typing import Dict, List, Optional
+
+from skypilot_tpu.utils import registry
+
+
+class LoadBalancingPolicy:
+    """Tracks the ready-replica set and picks a target per request."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._replicas: List[str] = []       # replica URLs
+        self._in_flight: Dict[str, int] = {}
+
+    def set_ready_replicas(self, urls: List[str]) -> None:
+        with self._lock:
+            self._replicas = list(urls)
+            self._in_flight = {
+                u: self._in_flight.get(u, 0) for u in urls
+            }
+
+    def select(self) -> Optional[str]:
+        raise NotImplementedError
+
+    def request_started(self, url: str) -> None:
+        with self._lock:
+            self._in_flight[url] = self._in_flight.get(url, 0) + 1
+
+    def request_finished(self, url: str) -> None:
+        with self._lock:
+            if url in self._in_flight:
+                self._in_flight[url] = max(0, self._in_flight[url] - 1)
+
+
+@registry.LB_POLICY_REGISTRY.register(name='round_robin')
+class RoundRobinPolicy(LoadBalancingPolicy):
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._counter = itertools.count()
+
+    def select(self) -> Optional[str]:
+        with self._lock:
+            if not self._replicas:
+                return None
+            return self._replicas[next(self._counter) % len(self._replicas)]
+
+
+@registry.LB_POLICY_REGISTRY.register(name='least_load')
+class LeastLoadPolicy(LoadBalancingPolicy):
+    """Route to the replica with the fewest in-flight requests (reference
+    default — best for LLM serving where request cost varies wildly)."""
+
+    def select(self) -> Optional[str]:
+        with self._lock:
+            if not self._replicas:
+                return None
+            return min(self._replicas,
+                       key=lambda u: self._in_flight.get(u, 0))

@@ -1,0 +1,269 @@
+"""Replica lifecycle: launch as clusters, probe readiness, recover failures.
+
+Reference analog: sky/serve/replica_managers.py (`ReplicaManager:626`,
+`SkyPilotReplicaManager:680`). Each replica is an ordinary cluster named
+`<service>-replica-<id>` launched through execution.launch, so it inherits
+provisioning failover; the serve-specific logic here is readiness probing,
+failure/preemption classing, and replace-don't-restart recovery.
+
+Replica addressing: the replica task gets `SKYTPU_SERVE_PORT` injected. On
+real clouds every replica has its own head IP and the service port is
+uniform; on the local fake cloud all replicas share 127.0.0.1, so each gets
+base_port + replica_id (that offset is what makes hermetic multi-replica
+tests possible on one machine).
+"""
+from __future__ import annotations
+
+import threading
+import time
+import typing
+from typing import Dict, List, Optional
+from urllib import error as urlerror
+from urllib import request as urlrequest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_state
+from skypilot_tpu import provision
+from skypilot_tpu import sky_logging
+from skypilot_tpu.backends import slice_backend
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve import service_spec as spec_lib
+from skypilot_tpu.serve.serve_state import ReplicaStatus
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import task as task_lib
+
+logger = sky_logging.init_logger(__name__)
+
+# A replica whose probe fails this many consecutive times is replaced.
+MAX_CONSECUTIVE_PROBE_FAILURES = 3
+# Consecutive probe-failure replacements (no READY in between) before the
+# service is declared FAILED instead of churning clusters forever.
+MAX_REPLACEMENTS_BEFORE_FAILED = 3
+
+
+def probe_url(url: str, path: str, timeout: float) -> bool:
+    try:
+        with urlrequest.urlopen(url.rstrip('/') + path,
+                                timeout=timeout) as resp:
+            return 200 <= resp.status < 400
+    except (urlerror.URLError, OSError, ValueError):
+        return False
+
+
+class ReplicaManager:
+    """Drives the replica set of one service toward a target count."""
+
+    def __init__(self, service_name: str, task: 'task_lib.Task',
+                 spec: spec_lib.ServiceSpec):
+        self.service_name = service_name
+        self.task = task
+        self.spec = spec
+        self.backend = slice_backend.TpuSliceBackend()
+        self._launch_threads: Dict[int, threading.Thread] = {}
+        # One decision for env injection AND probe URLs (they must agree).
+        self._local_ports = self._is_local()
+        # Consecutive probe-failure replacements with no READY in between:
+        # when this passes the cap, the app is broken, not unlucky.
+        self._probe_failure_streak = 0
+        self.permanently_failed: Optional[str] = None
+
+    # ------------------------------------------------------------------
+    # Launch / terminate
+    # ------------------------------------------------------------------
+    def _cluster_name(self, replica_id: int) -> str:
+        return f'{self.service_name}-replica-{replica_id}'
+
+    def _replica_task(self, replica_id: int) -> 'task_lib.Task':
+        from skypilot_tpu import task as task_lib_mod
+        cfg = self.task.to_yaml_config()
+        cfg.pop('service', None)
+        task = task_lib_mod.Task.from_yaml_config(cfg)
+        port = self.spec.port
+        task.update_envs({
+            'SKYTPU_SERVE_PORT': str(port + replica_id
+                                     if self._local_ports else port),
+            'SKYTPU_SERVE_REPLICA_ID': str(replica_id),
+        })
+        return task
+
+    def _is_local(self) -> bool:
+        """Will replicas land on the local fake cloud (shared 127.0.0.1)?
+
+        Must be decided BEFORE launch (the port env ships with the task),
+        so when the task doesn't pin a cloud, infer from the enabled set:
+        only-local-enabled (the hermetic test environment) → local ports.
+        A mixed environment where the optimizer still picks local accepts a
+        port collision across co-hosted replicas — a documented limit of
+        the fake cloud, not of real deployments."""
+        from skypilot_tpu import resources as resources_lib
+        for res in self.task.resources_list():
+            assert isinstance(res, resources_lib.Resources)
+            if res.cloud is not None:
+                return str(res.cloud).lower() == 'local'
+        from skypilot_tpu import check as check_lib
+        enabled = check_lib.get_cached_enabled_clouds_or_refresh()
+        return len(enabled) == 1 and str(enabled[0]).lower() == 'local'
+
+    def _replica_url(self, replica_id: int,
+                     handle: slice_backend.SliceResourceHandle) -> str:
+        info = handle.get_cluster_info()
+        head = info.ordered_instances()[0]
+        port = self.spec.port
+        # Must mirror the SKYTPU_SERVE_PORT decision in _replica_task —
+        # the probe has to knock where the app was told to listen.
+        if self._local_ports:
+            return f'http://127.0.0.1:{port + replica_id}'
+        ip = head.external_ip or head.internal_ip
+        return f'http://{ip}:{port}'
+
+    def scale_up(self, n: int = 1) -> List[int]:
+        """Launch n replicas asynchronously; returns their ids."""
+        ids = []
+        for _ in range(n):
+            rid = serve_state.next_replica_id(self.service_name)
+            serve_state.upsert_replica(
+                self.service_name, rid,
+                cluster_name=self._cluster_name(rid),
+                status=ReplicaStatus.PROVISIONING.value, url='')
+            t = threading.Thread(target=self._launch_one, args=(rid,),
+                                 daemon=True)
+            self._launch_threads[rid] = t
+            t.start()
+            ids.append(rid)
+        return ids
+
+    def _launch_one(self, replica_id: int) -> None:
+        from skypilot_tpu import execution
+        name = self._cluster_name(replica_id)
+        try:
+            task = self._replica_task(replica_id)
+            _, handle = execution.launch(task, cluster_name=name,
+                                         detach_run=True)
+            assert handle is not None
+            serve_state.upsert_replica(
+                self.service_name, replica_id, cluster_name=name,
+                status=ReplicaStatus.STARTING.value,
+                url=self._replica_url(replica_id, handle))
+            logger.info(f'Replica {replica_id} of {self.service_name} '
+                        f'provisioned at {name}.')
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'Replica {replica_id} launch failed: {e}')
+            serve_state.set_replica_status(self.service_name, replica_id,
+                                           ReplicaStatus.FAILED)
+
+    def terminate_replica(self, replica_id: int,
+                          status: ReplicaStatus = ReplicaStatus.SHUTTING_DOWN
+                          ) -> None:
+        serve_state.set_replica_status(self.service_name, replica_id, status)
+        name = self._cluster_name(replica_id)
+        try:
+            record = global_state.get_cluster(name)
+            if record is not None:
+                handle = slice_backend.SliceResourceHandle.from_dict(
+                    record['handle'])
+                self.backend.teardown(handle, terminate=True)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'Teardown of replica {replica_id} failed: {e}')
+        serve_state.remove_replica(self.service_name, replica_id)
+
+    def terminate_all(self) -> None:
+        for rep in serve_state.get_replicas(self.service_name):
+            self.terminate_replica(rep['replica_id'])
+
+    # ------------------------------------------------------------------
+    # Probe / reconcile
+    # ------------------------------------------------------------------
+    def _cluster_gone(self, replica_id: int) -> bool:
+        name = self._cluster_name(replica_id)
+        record = global_state.get_cluster(name)
+        if record is None:
+            return True
+        handle = slice_backend.SliceResourceHandle.from_dict(
+            record['handle'])
+        try:
+            statuses = provision.query_instances(handle.cloud, handle.region,
+                                                 name,
+                                                 handle.provider_config)
+        except exceptions.ClusterDoesNotExist:
+            return True
+        except Exception:  # pylint: disable=broad-except
+            return False   # transient API error ≠ preemption
+        return not statuses or not all(
+            s in ('running', 'READY') for s in statuses.values())
+
+    def reconcile(self, target: int) -> None:
+        """One control-loop pass: probe replicas, replace the dead, scale
+        toward `target`."""
+        replicas = serve_state.get_replicas(self.service_name)
+        now = time.time()
+        alive: List[dict] = []
+        for rep in replicas:
+            rid, status = rep['replica_id'], rep['status']
+            if status in (ReplicaStatus.PROVISIONING,
+                          ReplicaStatus.SHUTTING_DOWN):
+                alive.append(rep)   # in flight; count toward target
+                continue
+            if self._cluster_gone(rid):
+                logger.info(f'Replica {rid} lost (preemption/teardown) — '
+                            f'replacing.')
+                self.terminate_replica(rid, ReplicaStatus.PREEMPTED)
+                continue
+            if status in (ReplicaStatus.STARTING, ReplicaStatus.READY,
+                          ReplicaStatus.NOT_READY):
+                probe = self.spec.readiness_probe
+                in_grace = (status is ReplicaStatus.STARTING and
+                            now - (rep['launched_at'] or 0) <
+                            probe.initial_delay_seconds)
+                if probe_url(rep['url'], probe.path, probe.timeout_seconds):
+                    serve_state.reset_replica_failures(self.service_name,
+                                                       rid)
+                    self._probe_failure_streak = 0
+                    if status is not ReplicaStatus.READY:
+                        serve_state.set_replica_status(
+                            self.service_name, rid, ReplicaStatus.READY)
+                        logger.info(f'Replica {rid} is READY.')
+                elif not in_grace:
+                    fails = serve_state.bump_replica_failures(
+                        self.service_name, rid)
+                    if fails >= MAX_CONSECUTIVE_PROBE_FAILURES:
+                        logger.info(f'Replica {rid} failed {fails} probes — '
+                                    f'replacing.')
+                        self.terminate_replica(rid, ReplicaStatus.FAILED)
+                        self._probe_failure_streak += 1
+                        continue
+                    if status is ReplicaStatus.READY:
+                        serve_state.set_replica_status(
+                            self.service_name, rid, ReplicaStatus.NOT_READY)
+                alive.append(rep)
+            elif status is ReplicaStatus.FAILED:
+                # Launch thread already marked it; clean up and replace via
+                # the scale-up below.
+                self.terminate_replica(rid, ReplicaStatus.FAILED)
+        # A broken app fails probes on every fresh replica: without a cap
+        # the loop launches and tears down (billing!) slices forever. The
+        # streak resets on any successful probe, so preemption-replacement
+        # churn doesn't trip it.
+        cap = max(MAX_REPLACEMENTS_BEFORE_FAILED, 2 * target)
+        if self._probe_failure_streak >= cap:
+            self.permanently_failed = (
+                f'{self._probe_failure_streak} consecutive replicas failed '
+                f'readiness probes — the app never comes up; check the '
+                f'run command and readiness_probe.')
+            return
+        # Scale toward target.
+        if len(alive) < target:
+            self.scale_up(target - len(alive))
+        elif len(alive) > target:
+            # Prefer shedding not-ready replicas, newest first.
+            order = sorted(
+                alive,
+                key=lambda r: (r['status'] is ReplicaStatus.READY,
+                               -r['replica_id']))
+            for rep in order[:len(alive) - target]:
+                logger.info(f'Scaling down replica {rep["replica_id"]}.')
+                self.terminate_replica(rep['replica_id'])
+
+    def ready_urls(self) -> List[str]:
+        return [r['url'] for r in serve_state.get_replicas(self.service_name)
+                if r['status'] is ReplicaStatus.READY and r['url']]

@@ -1,0 +1,221 @@
+"""Service + replica state DB (control-plane side).
+
+Reference analog: sky/serve/serve_state.py (service/replica tables).
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import sqlite3
+import time
+from typing import Any, Dict, List, Optional
+
+_DB_PATH_ENV = 'SKYTPU_SERVE_DB'
+
+
+class ServiceStatus(enum.Enum):
+    CONTROLLER_INIT = 'CONTROLLER_INIT'
+    REPLICA_INIT = 'REPLICA_INIT'    # controller up, no replica READY yet
+    READY = 'READY'                  # ≥1 replica READY behind the LB
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+    FAILED = 'FAILED'
+    SHUTDOWN = 'SHUTDOWN'            # terminal
+
+    def is_terminal(self) -> bool:
+        return self in (ServiceStatus.SHUTDOWN, ServiceStatus.FAILED)
+
+    def colored_str(self) -> str:
+        color = {'READY': '\x1b[32m', 'FAILED': '\x1b[31m'}.get(
+            self.value, '\x1b[33m')
+        return f'{color}{self.value}\x1b[0m'
+
+
+class ReplicaStatus(enum.Enum):
+    PROVISIONING = 'PROVISIONING'
+    STARTING = 'STARTING'            # cluster up, app not ready yet
+    READY = 'READY'
+    NOT_READY = 'NOT_READY'          # probe failing; grace period
+    FAILED = 'FAILED'
+    PREEMPTED = 'PREEMPTED'
+    SHUTTING_DOWN = 'SHUTTING_DOWN'
+
+    def is_serving(self) -> bool:
+        return self is ReplicaStatus.READY
+
+    def colored_str(self) -> str:
+        color = {'READY': '\x1b[32m', 'FAILED': '\x1b[31m',
+                 'PREEMPTED': '\x1b[31m'}.get(self.value, '\x1b[33m')
+        return f'{color}{self.value}\x1b[0m'
+
+
+def _db_path() -> str:
+    path = os.path.expanduser(
+        os.environ.get(_DB_PATH_ENV, '~/.skytpu/serve.db'))
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    return path
+
+
+def _conn() -> sqlite3.Connection:
+    conn = sqlite3.connect(_db_path(), timeout=30)
+    conn.execute('PRAGMA journal_mode=WAL')
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS services (
+            name TEXT PRIMARY KEY,
+            task_config TEXT,
+            spec TEXT,
+            status TEXT,
+            lb_port INTEGER,
+            controller_pid INTEGER,
+            created_at REAL,
+            failure_reason TEXT
+        )""")
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS replicas (
+            service TEXT,
+            replica_id INTEGER,
+            cluster_name TEXT,
+            status TEXT,
+            url TEXT,
+            launched_at REAL,
+            consecutive_failures INTEGER DEFAULT 0,
+            PRIMARY KEY (service, replica_id)
+        )""")
+    return conn
+
+
+def controller_log_path(service: str) -> str:
+    d = os.path.expanduser('~/.skytpu/serve')
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, f'controller_{service}.log')
+
+
+# ---------------------------------------------------------------------------
+# Services
+# ---------------------------------------------------------------------------
+def add_service(name: str, task_config: Dict[str, Any],
+                spec: Dict[str, Any], lb_port: int) -> bool:
+    with _conn() as conn:
+        try:
+            conn.execute(
+                'INSERT INTO services (name, task_config, spec, status, '
+                'lb_port, created_at) VALUES (?, ?, ?, ?, ?, ?)',
+                (name, json.dumps(task_config), json.dumps(spec),
+                 ServiceStatus.CONTROLLER_INIT.value, lb_port, time.time()))
+            return True
+        except sqlite3.IntegrityError:
+            return False
+
+
+def update_service(name: str, **cols: Any) -> None:
+    sets = ', '.join(f'{k} = ?' for k in cols)
+    with _conn() as conn:
+        conn.execute(f'UPDATE services SET {sets} WHERE name = ?',
+                     (*cols.values(), name))
+
+
+def set_service_status(name: str, status: ServiceStatus,
+                       failure_reason: Optional[str] = None) -> None:
+    update_service(name, status=status.value, failure_reason=failure_reason)
+
+
+def get_service(name: str) -> Optional[Dict[str, Any]]:
+    with _conn() as conn:
+        conn.row_factory = sqlite3.Row
+        row = conn.execute('SELECT * FROM services WHERE name = ?',
+                           (name,)).fetchone()
+        return _service_row(row) if row else None
+
+
+def get_services() -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        conn.row_factory = sqlite3.Row
+        rows = conn.execute(
+            'SELECT * FROM services ORDER BY created_at').fetchall()
+        return [_service_row(r) for r in rows]
+
+
+def remove_service(name: str) -> None:
+    with _conn() as conn:
+        conn.execute('DELETE FROM services WHERE name = ?', (name,))
+        conn.execute('DELETE FROM replicas WHERE service = ?', (name,))
+
+
+def _service_row(row: sqlite3.Row) -> Dict[str, Any]:
+    d = dict(row)
+    d['status'] = ServiceStatus(d['status'])
+    d['task_config'] = json.loads(d['task_config'] or '{}')
+    d['spec'] = json.loads(d['spec'] or '{}')
+    return d
+
+
+# ---------------------------------------------------------------------------
+# Replicas
+# ---------------------------------------------------------------------------
+def upsert_replica(service: str, replica_id: int, **cols: Any) -> None:
+    cols.setdefault('launched_at', time.time())
+    names = ', '.join(cols)
+    ph = ', '.join('?' * len(cols))
+    updates = ', '.join(f'{k}=excluded.{k}' for k in cols)
+    with _conn() as conn:
+        conn.execute(
+            f'INSERT INTO replicas (service, replica_id, {names}) '
+            f'VALUES (?, ?, {ph}) '
+            f'ON CONFLICT(service, replica_id) DO UPDATE SET {updates}',
+            (service, replica_id, *cols.values()))
+
+
+def set_replica_status(service: str, replica_id: int,
+                       status: ReplicaStatus) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'UPDATE replicas SET status = ? WHERE service = ? AND '
+            'replica_id = ?', (status.value, service, replica_id))
+
+
+def bump_replica_failures(service: str, replica_id: int) -> int:
+    with _conn() as conn:
+        conn.execute(
+            'UPDATE replicas SET consecutive_failures = '
+            'consecutive_failures + 1 WHERE service = ? AND replica_id = ?',
+            (service, replica_id))
+        row = conn.execute(
+            'SELECT consecutive_failures FROM replicas WHERE service = ? '
+            'AND replica_id = ?', (service, replica_id)).fetchone()
+        return int(row[0]) if row else 0
+
+
+def reset_replica_failures(service: str, replica_id: int) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'UPDATE replicas SET consecutive_failures = 0 WHERE '
+            'service = ? AND replica_id = ?', (service, replica_id))
+
+
+def remove_replica(service: str, replica_id: int) -> None:
+    with _conn() as conn:
+        conn.execute(
+            'DELETE FROM replicas WHERE service = ? AND replica_id = ?',
+            (service, replica_id))
+
+
+def get_replicas(service: str) -> List[Dict[str, Any]]:
+    with _conn() as conn:
+        conn.row_factory = sqlite3.Row
+        rows = conn.execute(
+            'SELECT * FROM replicas WHERE service = ? ORDER BY replica_id',
+            (service,)).fetchall()
+        out = []
+        for r in rows:
+            d = dict(r)
+            d['status'] = ReplicaStatus(d['status'])
+            out.append(d)
+        return out
+
+
+def next_replica_id(service: str) -> int:
+    with _conn() as conn:
+        row = conn.execute(
+            'SELECT MAX(replica_id) FROM replicas WHERE service = ?',
+            (service,)).fetchone()
+    return (int(row[0]) if row and row[0] is not None else 0) + 1

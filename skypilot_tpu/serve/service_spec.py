@@ -1,0 +1,132 @@
+"""`service:` YAML section → typed spec.
+
+Reference analog: sky/serve/service_spec.py (readiness probe, replica
+policy, ports). Field names follow the reference so its service YAMLs parse
+unchanged:
+
+service:
+  readiness_probe: /health            # or {path:, initial_delay_seconds:,
+                                      #     timeout_seconds:}
+  replicas: 2                         # static count, OR:
+  replica_policy:
+    min_replicas: 1
+    max_replicas: 4
+    target_qps_per_replica: 10
+    upscale_delay_seconds: 300
+    downscale_delay_seconds: 1200
+  ports: 8000                         # port the replica app listens on
+  load_balancing_policy: least_load   # or round_robin
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+_SERVICE_FIELDS = frozenset({
+    'readiness_probe', 'replicas', 'replica_policy', 'ports',
+    'load_balancing_policy',
+})
+_POLICY_FIELDS = frozenset({
+    'min_replicas', 'max_replicas', 'target_qps_per_replica',
+    'upscale_delay_seconds', 'downscale_delay_seconds',
+})
+
+
+@dataclasses.dataclass
+class ReadinessProbe:
+    path: str = '/'
+    initial_delay_seconds: float = 60.0
+    timeout_seconds: float = 15.0
+
+
+@dataclasses.dataclass
+class ReplicaPolicy:
+    min_replicas: int = 1
+    max_replicas: Optional[int] = None      # None → fixed at min_replicas
+    target_qps_per_replica: Optional[float] = None
+    upscale_delay_seconds: float = 300.0
+    downscale_delay_seconds: float = 1200.0
+
+    @property
+    def autoscaling_enabled(self) -> bool:
+        return (self.max_replicas is not None and
+                self.target_qps_per_replica is not None)
+
+
+@dataclasses.dataclass
+class ServiceSpec:
+    readiness_probe: ReadinessProbe
+    policy: ReplicaPolicy
+    port: int = 8000
+    load_balancing_policy: str = 'least_load'
+
+    @classmethod
+    def from_yaml_config(cls, config: Dict[str, Any]) -> 'ServiceSpec':
+        config = dict(config or {})
+        unknown = set(config) - _SERVICE_FIELDS
+        if unknown:
+            raise ValueError(f'Unknown service fields: {sorted(unknown)}. '
+                             f'Valid: {sorted(_SERVICE_FIELDS)}')
+        probe_cfg = config.get('readiness_probe', '/')
+        if isinstance(probe_cfg, str):
+            probe = ReadinessProbe(path=probe_cfg)
+        else:
+            probe = ReadinessProbe(
+                path=probe_cfg.get('path', '/'),
+                initial_delay_seconds=float(
+                    probe_cfg.get('initial_delay_seconds', 60.0)),
+                timeout_seconds=float(probe_cfg.get('timeout_seconds', 15.0)))
+
+        pol_cfg = dict(config.get('replica_policy') or {})
+        unknown = set(pol_cfg) - _POLICY_FIELDS
+        if unknown:
+            raise ValueError(
+                f'Unknown replica_policy fields: {sorted(unknown)}')
+        if 'replicas' in config and pol_cfg:
+            raise ValueError("Use either 'replicas' (static) or "
+                             "'replica_policy', not both.")
+        if 'replicas' in config:
+            policy = ReplicaPolicy(min_replicas=int(config['replicas']))
+        else:
+            policy = ReplicaPolicy(
+                min_replicas=int(pol_cfg.get('min_replicas', 1)),
+                max_replicas=(int(pol_cfg['max_replicas'])
+                              if 'max_replicas' in pol_cfg else None),
+                target_qps_per_replica=(
+                    float(pol_cfg['target_qps_per_replica'])
+                    if 'target_qps_per_replica' in pol_cfg else None),
+                upscale_delay_seconds=float(
+                    pol_cfg.get('upscale_delay_seconds', 300.0)),
+                downscale_delay_seconds=float(
+                    pol_cfg.get('downscale_delay_seconds', 1200.0)))
+        if policy.max_replicas is not None and \
+                policy.max_replicas < policy.min_replicas:
+            raise ValueError('max_replicas < min_replicas')
+
+        ports = config.get('ports', 8000)
+        lb = config.get('load_balancing_policy', 'least_load')
+        # Importing the policies module is what populates the registry.
+        from skypilot_tpu.serve import load_balancing_policies  # noqa: F401
+        from skypilot_tpu.utils import registry
+        if lb.lower() not in registry.LB_POLICY_REGISTRY:
+            raise ValueError(
+                f'Unknown load_balancing_policy {lb!r}; available: '
+                f'{registry.LB_POLICY_REGISTRY.keys()}')
+        return cls(readiness_probe=probe, policy=policy, port=int(ports),
+                   load_balancing_policy=lb.lower())
+
+    def to_yaml_config(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            'readiness_probe': dataclasses.asdict(self.readiness_probe),
+            'ports': self.port,
+            'load_balancing_policy': self.load_balancing_policy,
+        }
+        pol = self.policy
+        if pol.autoscaling_enabled or pol.max_replicas is not None:
+            out['replica_policy'] = {
+                k: v for k, v in dataclasses.asdict(pol).items()
+                if v is not None
+            }
+        else:
+            out['replicas'] = pol.min_replicas
+        return out

@@ -140,6 +140,31 @@ def _jobs_logs(payload):
     return {'returncode': rc}
 
 
+def _serve_up(payload):
+    import skypilot_tpu as sky
+    from skypilot_tpu import serve
+    task = sky.Task.from_yaml_config(payload['task'])
+    return serve.up(task, service_name=payload.get('service_name'))
+
+
+def _serve_status(payload):
+    from skypilot_tpu import serve
+    out = []
+    for r in serve.status(payload.get('service_names')):
+        r = dict(r)
+        r['status'] = r['status'].value
+        r['replicas'] = [dict(rep, status=rep['status'].value)
+                         for rep in r['replicas']]
+        out.append(r)
+    return out
+
+
+def _serve_down(payload):
+    from skypilot_tpu import serve
+    serve.down(payload['service_name'], purge=payload.get('purge', False))
+    return {'service_name': payload['service_name']}
+
+
 def _list_accelerators(payload):
     import dataclasses
     from skypilot_tpu.catalog import tpu_catalog
@@ -173,4 +198,10 @@ HANDLERS: Dict[str, Tuple[Callable[[Dict[str, Any]], Any], str]] = {
     'jobs_queue': (_jobs_queue, requests_lib.SHORT),
     'jobs_cancel': (_jobs_cancel, requests_lib.SHORT),
     'jobs_logs': (_jobs_logs, requests_lib.SHORT),
+    # Serve plane (reference: sky/serve/server/ routes). serve_up only
+    # records state + spawns the controller, so SHORT; serve_down tears
+    # down replicas synchronously, so LONG.
+    'serve_up': (_serve_up, requests_lib.SHORT),
+    'serve_status': (_serve_status, requests_lib.SHORT),
+    'serve_down': (_serve_down, requests_lib.LONG),
 }

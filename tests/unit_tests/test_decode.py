@@ -1,0 +1,92 @@
+"""KV-cache decode correctness: incremental == full forward.
+
+The decode engine (models/decode.py) must produce exactly the tokens a
+naive re-run-the-whole-prefix forward pass would pick — that equivalence is
+the whole correctness contract of the cache.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import decode, llama
+
+
+@pytest.fixture(scope='module')
+def model():
+    # fp32 so reduction-order differences between the cached and full paths
+    # cannot flip an argmax (bf16 is exercised implicitly on TPU runs).
+    cfg = dataclasses.replace(
+        llama.PRESETS['llama-debug'], dtype=jnp.float32,
+        rope_scaling=dict(factor=2.0))   # scaling on: hashability + math
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+class TestDecode:
+
+    def test_prefill_matches_forward_logits(self, model):
+        cfg, params = model
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 10), 0,
+                                    cfg.vocab_size, dtype=jnp.int32)
+        full = llama.forward(params, tokens, cfg)          # [B, S, V]
+        last, cache = decode.prefill(params, tokens, cfg, max_len=32)
+        np.testing.assert_allclose(np.asarray(last),
+                                   np.asarray(full[:, -1]), rtol=2e-4,
+                                   atol=2e-4)
+        assert int(cache.length) == 10
+        assert cache.k.shape == (cfg.n_layers, 2, 32, cfg.n_kv_heads, cfg.hd)
+
+    def test_decode_step_matches_forward(self, model):
+        """Each incremental step's logits == full forward at that position."""
+        cfg, params = model
+        b, s0, steps = 2, 6, 5
+        tokens = jax.random.randint(jax.random.PRNGKey(2), (b, s0), 0,
+                                    cfg.vocab_size, dtype=jnp.int32)
+        logits, cache = decode.prefill(params, tokens, cfg, max_len=32)
+        seq = tokens
+        for _ in range(steps):
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+            full = llama.forward(params, seq, cfg)
+            logits, cache = decode.decode_step(params, nxt, cache, cfg)
+            np.testing.assert_allclose(np.asarray(logits),
+                                       np.asarray(full[:, -1]), rtol=2e-4,
+                                       atol=2e-4)
+
+    def test_generate_greedy_matches_naive(self, model):
+        """generate() == token-by-token full-forward argmax."""
+        cfg, params = model
+        prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 8), 0,
+                                    cfg.vocab_size, dtype=jnp.int32)
+        n_new = 6
+        got = decode.generate(params, prompt, cfg, n_new)
+        assert got.shape == (2, n_new)
+
+        seq = prompt
+        for _ in range(n_new):
+            logits = llama.forward(params, seq, cfg)
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(seq[:, 8:]))
+
+    def test_generate_eos_padding(self, model):
+        cfg, params = model
+        prompt = jnp.zeros((1, 4), jnp.int32)
+        out = decode.generate(params, prompt, cfg, 8, eos_id=None)
+        # Re-run with the first generated token as eos: everything after
+        # must be eos-padded.
+        eos = int(out[0, 0])
+        out2 = decode.generate(params, prompt, cfg, 8, eos_id=eos)
+        assert np.asarray(out2[0] == eos).all()
+
+    def test_generate_temperature_sampling_runs(self, model):
+        cfg, params = model
+        prompt = jnp.zeros((2, 4), jnp.int32)
+        out = decode.generate(params, prompt, cfg, 5, temperature=1.0,
+                              rng=jax.random.PRNGKey(7))
+        assert out.shape == (2, 5)
+        assert int(out.max()) < cfg.vocab_size

@@ -1,0 +1,258 @@
+"""Serve plane: spec parsing, autoscaler hysteresis (pure), LB policies
+(pure), and the full controller/replica/LB loop hermetically on the local
+fake-TPU cloud (reference validates this only against real clusters,
+tests/smoke_tests/test_sky_serve.py).
+"""
+import json
+import time
+import urllib.request
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu.serve import autoscalers, load_balancing_policies
+from skypilot_tpu.serve import core as serve_core
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve import service_spec as spec_lib
+from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
+
+
+# ---------------------------------------------------------------------------
+# Pure-logic tiers
+# ---------------------------------------------------------------------------
+class TestServiceSpec:
+
+    def test_parse_full(self):
+        spec = spec_lib.ServiceSpec.from_yaml_config({
+            'readiness_probe': {'path': '/health',
+                                'initial_delay_seconds': 5},
+            'replica_policy': {'min_replicas': 1, 'max_replicas': 4,
+                               'target_qps_per_replica': 10,
+                               'upscale_delay_seconds': 2,
+                               'downscale_delay_seconds': 4},
+            'ports': 9001,
+            'load_balancing_policy': 'round_robin',
+        })
+        assert spec.readiness_probe.path == '/health'
+        assert spec.policy.autoscaling_enabled
+        assert spec.port == 9001
+        # Round-trips.
+        again = spec_lib.ServiceSpec.from_yaml_config(spec.to_yaml_config())
+        assert again.policy.max_replicas == 4
+
+    def test_static_replicas(self):
+        spec = spec_lib.ServiceSpec.from_yaml_config({'replicas': 3})
+        assert spec.policy.min_replicas == 3
+        assert not spec.policy.autoscaling_enabled
+
+    def test_rejects_unknown_fields_and_bad_policy(self):
+        with pytest.raises(ValueError, match='Unknown service fields'):
+            spec_lib.ServiceSpec.from_yaml_config({'replica_count': 2})
+        with pytest.raises(ValueError, match='load_balancing_policy'):
+            spec_lib.ServiceSpec.from_yaml_config(
+                {'load_balancing_policy': 'magic'})
+
+
+class TestAutoscaler:
+
+    def _scaler(self):
+        policy = spec_lib.ReplicaPolicy(
+            min_replicas=1, max_replicas=5, target_qps_per_replica=2,
+            upscale_delay_seconds=10, downscale_delay_seconds=30)
+        return autoscalers.RequestRateAutoscaler(policy)
+
+    def test_scale_up_needs_sustained_load(self):
+        s = self._scaler()
+        t0 = 1000.0
+        # 8 qps → raw target 4, but only after the upscale delay holds.
+        for i in range(480):
+            s.record_request(t0 + i * 0.125)
+        assert s.target_replicas(t0 + 60) == 1          # proposal starts
+        assert s.target_replicas(t0 + 65) == 1          # still holding
+        for i in range(80):                             # keep qps up
+            s.record_request(t0 + 60 + i * 0.125)
+        assert s.target_replicas(t0 + 71) == 4          # delay elapsed
+
+    def test_burst_is_absorbed(self):
+        s = self._scaler()
+        t0 = 1000.0
+        for i in range(100):
+            s.record_request(t0 + i * 0.01)             # 1s burst
+        assert s.target_replicas(t0 + 2) == 1           # proposal pending
+        # Load vanished before the delay elapsed → proposal resets.
+        assert s.target_replicas(t0 + 70) == 1
+        assert s._pending is None
+
+    def test_scale_down_slower_than_up(self):
+        s = self._scaler()
+        s._current_target = 4
+        t0 = 2000.0
+        assert s.target_replicas(t0) == 4               # 0 qps → raw 1
+        assert s.target_replicas(t0 + 20) == 4          # < downscale delay
+        assert s.target_replicas(t0 + 31) == 1          # elapsed
+
+    def test_bounds(self):
+        s = self._scaler()
+        t0 = 3000.0
+        for i in range(6000):
+            s.record_request(t0 + (i % 600) * 0.1)      # 100 qps → raw 50
+        s._pending = (5, t0 - 100)
+        assert s._raw_target(t0 + 60) == 5              # capped at max
+
+
+class TestLBPolicies:
+
+    def test_round_robin_cycles(self):
+        p = load_balancing_policies.RoundRobinPolicy()
+        p.set_ready_replicas(['a', 'b', 'c'])
+        picks = [p.select() for _ in range(6)]
+        assert picks == ['a', 'b', 'c', 'a', 'b', 'c']
+
+    def test_least_load_prefers_idle(self):
+        p = load_balancing_policies.LeastLoadPolicy()
+        p.set_ready_replicas(['a', 'b'])
+        p.request_started('a')
+        p.request_started('a')
+        p.request_started('b')
+        assert p.select() == 'b'
+        p.request_finished('a')
+        p.request_finished('a')
+        assert p.select() == 'a'
+
+    def test_empty_set(self):
+        p = load_balancing_policies.LeastLoadPolicy()
+        assert p.select() is None
+
+
+# ---------------------------------------------------------------------------
+# Hermetic end-to-end on the local cloud
+# ---------------------------------------------------------------------------
+# The replica app: a stdlib HTTP server on $SKYTPU_SERVE_PORT that answers
+# /health and /, tagging responses with its replica id.
+_REPLICA_APP = (
+    'python -c "'
+    'import http.server, os, json\n'
+    'rid = os.environ.get(\'SKYTPU_SERVE_REPLICA_ID\', \'?\')\n'
+    'class H(http.server.BaseHTTPRequestHandler):\n'
+    '    def do_GET(self):\n'
+    '        body = json.dumps({\'replica\': rid,\'path\': self.path}).encode()\n'
+    '        self.send_response(200)\n'
+    '        self.send_header(\'Content-Type\',\'application/json\')\n'
+    '        self.end_headers()\n'
+    '        self.wfile.write(body)\n'
+    '    def log_message(self, *a): pass\n'
+    'http.server.HTTPServer((\'127.0.0.1\', '
+    'int(os.environ[\'SKYTPU_SERVE_PORT\'])), H).serve_forever()"'
+)
+
+
+def _service_task(replicas=2):
+    task = sky.Task(name='svc', run=_REPLICA_APP)
+    task.set_resources(sky.Resources(accelerators='tpu-v5e-8'))
+    task.service_spec = {
+        # Grace long enough for the app to boot on a loaded CI machine —
+        # probes during grace still flip READY as soon as the app is up.
+        'readiness_probe': {'path': '/health', 'initial_delay_seconds': 30,
+                            'timeout_seconds': 2},
+        'replicas': replicas,
+        'ports': 31800,
+        # round_robin so serial test traffic provably hits every replica
+        # (least_load sends serial idle-time requests to one replica).
+        'load_balancing_policy': 'round_robin',
+    }
+    return task
+
+
+def _get(url, timeout=5):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return json.loads(resp.read())
+
+
+def _wait_ready_replicas(name, count, timeout=120):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        ready = [r for r in serve_state.get_replicas(name)
+                 if r['status'] is ReplicaStatus.READY]
+        if len(ready) >= count:
+            return ready
+        time.sleep(0.5)
+    raise TimeoutError(
+        f'{name}: replicas {serve_state.get_replicas(name)}')
+
+
+@pytest.fixture
+def serve_env(enable_local_cloud, isolated_state, monkeypatch):
+    monkeypatch.setenv('SKYTPU_SERVE_SYNC_SECONDS', '0.5')
+    yield isolated_state
+
+
+@pytest.mark.usefixtures('serve_env')
+class TestServeEndToEnd:
+
+    def test_up_ready_balance_recover_down(self):
+        info = serve_core.up(_service_task(replicas=2))
+        name = info['name']
+        try:
+            serve_core.wait_until(name, {ServiceStatus.READY}, timeout=120)
+            _wait_ready_replicas(name, 2)
+
+            # Requests round-trip through the LB and hit BOTH replicas
+            # (least-load with idle replicas alternates under serial load).
+            seen = {_get(info['endpoint'] + '/infer')['replica']
+                    for _ in range(8)}
+            assert seen == {'1', '2'}
+
+            # Kill replica 1's cluster out from under the service
+            # (spot preemption): the manager must replace it.
+            import shutil, os
+            from skypilot_tpu.clouds import local as local_cloud
+            rep1 = serve_state.get_replicas(name)[0]
+            shutil.rmtree(os.path.join(local_cloud.LOCAL_CLOUD_ROOT,
+                                       rep1['cluster_name']))
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                reps = serve_state.get_replicas(name)
+                ready = [r for r in reps
+                         if r['status'] is ReplicaStatus.READY]
+                if (len(ready) == 2 and
+                        any(r['replica_id'] > 2 for r in ready)):
+                    break
+                time.sleep(0.5)
+            else:
+                raise TimeoutError(f'no recovery: '
+                                   f'{serve_state.get_replicas(name)}')
+            # Service kept serving through it all.
+            assert _get(info['endpoint'] + '/health')['path'] == '/health'
+        finally:
+            serve_core.down(name)
+        # Everything is gone: replicas deleted, service terminal.
+        assert serve_state.get_replicas(name) == []
+        record = serve_state.get_service(name)
+        assert record['status'] is ServiceStatus.SHUTDOWN
+
+    def test_broken_app_fails_service_instead_of_churning(self):
+        """A run command that never serves must end in FAILED with the
+        clusters cleaned up — not an infinite provision/teardown loop."""
+        task = sky.Task(name='broken', run='exit 1')
+        task.set_resources(sky.Resources(accelerators='tpu-v5e-8'))
+        task.service_spec = {
+            'readiness_probe': {'path': '/health',
+                                'initial_delay_seconds': 1,
+                                'timeout_seconds': 1},
+            'replicas': 1,
+            'ports': 31950,
+        }
+        info = serve_core.up(task)
+        try:
+            status = serve_core.wait_until(
+                info['name'], {ServiceStatus.FAILED}, timeout=120)
+            assert status is ServiceStatus.FAILED
+            record = serve_state.get_service(info['name'])
+            assert 'readiness' in (record['failure_reason'] or '')
+            assert serve_state.get_replicas(info['name']) == []
+        finally:
+            serve_core.down(info['name'])
+
+    def test_plain_launch_rejects_service_yaml(self):
+        with pytest.raises(ValueError, match='serve up'):
+            sky.launch(_service_task(), cluster_name='nope')
