@@ -14,6 +14,7 @@ from __future__ import annotations
 import base64
 import json
 import os
+import time
 import typing
 from typing import Any, Dict, List, Optional
 
@@ -180,9 +181,26 @@ class TpuSliceBackend(backend_lib.Backend[SliceResourceHandle]):
                 logger.info(f'Reusing existing cluster {cluster_name!r}.')
                 return handle
 
-            record, final_res = _FailoverProvisioner(
-                cluster_name).provision_with_failover(
-                    to_provision, task, ports_to_open=to_provision.ports)
+            # retry_until_up: when every cloud/region/zone is exhausted,
+            # sleep and restart the whole failover sweep instead of failing
+            # (reference: `sky launch --retry-until-up`). Gap is env-tunable
+            # so tests don't wait minutes.
+            gap = float(os.environ.get('SKYTPU_RETRY_UNTIL_UP_GAP', '60'))
+            while True:
+                try:
+                    record, final_res = _FailoverProvisioner(
+                        cluster_name).provision_with_failover(
+                            to_provision, task,
+                            ports_to_open=to_provision.ports)
+                    break
+                except exceptions.ResourcesUnavailableError as e:
+                    if not retry_until_up or e.no_failover:
+                        raise
+                    logger.warning(
+                        f'No capacity anywhere for {cluster_name!r}; '
+                        f'--retry-until-up: retrying in {gap:.0f}s '
+                        f'({len(e.failover_history)} failures so far).')
+                    time.sleep(gap)
             handle = SliceResourceHandle(
                 cluster_name=cluster_name,
                 cloud=record.provider_name,
@@ -340,6 +358,14 @@ class TpuSliceBackend(backend_lib.Backend[SliceResourceHandle]):
                         'private_key': '~/.ssh/skytpu-cluster-key',
                     },
                 })
+        # Exit flush barrier for MOUNT_CACHED storage (reference:
+        # cloud_vm_ray_backend.py:763-790): the driver runs these on every
+        # host after the gang succeeds, before the job is marked done.
+        epilogue: List[str] = []
+        if task.storage_mounts:
+            from skypilot_tpu.data import storage as storage_lib
+            epilogue = list(storage_lib.flush_commands(
+                handle, task.storage_mounts).values())
         spec = {
             'job_id': job_id,
             'cluster_name': handle.cluster_name,
@@ -348,6 +374,7 @@ class TpuSliceBackend(backend_lib.Backend[SliceResourceHandle]):
             'envs': task.envs_and_secrets,
             'chips_per_host': sl.chips_per_host if sl else 1,
             'num_slices': sl.num_slices if sl else 1,
+            'epilogue_cmds': epilogue,
         }
 
         # 3. Ship the spec to the head host and start the driver detached.

@@ -26,6 +26,19 @@ logger = sky_logging.init_logger(__name__)
 DEFAULT_URL = 'http://127.0.0.1:46580'
 
 
+def _headers() -> dict:
+    """Bearer auth when the server requires it (server/_api_token)."""
+    token = os.environ.get('SKYTPU_API_TOKEN', '')
+    if not token:
+        try:
+            with open(os.path.expanduser('~/.skytpu/api_token'), 'r',
+                      encoding='utf-8') as f:
+                token = f.read().strip()
+        except OSError:
+            token = ''
+    return {'Authorization': f'Bearer {token}'} if token else {}
+
+
 class ApiError(Exception):
     pass
 
@@ -124,7 +137,8 @@ def api_info() -> Dict[str, Any]:
 def submit(name: str, payload: Dict[str, Any],
            url: Optional[str] = None) -> str:
     url = url or api_server_url(required=True)
-    r = requests_http.post(f'{url}/api/v1/{name}', json=payload, timeout=30)
+    r = requests_http.post(f'{url}/api/v1/{name}', json=payload,
+                            headers=_headers(), timeout=30)
     if r.status_code != 200:
         raise ApiError(f'{name}: HTTP {r.status_code}: {r.text}')
     return r.json()['request_id']
@@ -136,7 +150,7 @@ def get(request_id: str, url: Optional[str] = None) -> Any:
     while True:
         r = requests_http.get(f'{url}/api/v1/get',
                               params={'request_id': request_id, 'wait': '1'},
-                              timeout=300)
+                              headers=_headers(), timeout=300)
         if r.status_code == 404:
             raise ApiError(f'no request {request_id}')
         rec = r.json()
@@ -157,7 +171,8 @@ def stream_and_get(request_id: str, url: Optional[str] = None,
     out = out or sys.stdout
     with requests_http.get(f'{url}/api/v1/stream',
                            params={'request_id': request_id},
-                           stream=True, timeout=None) as r:
+                           headers=_headers(), stream=True,
+                           timeout=None) as r:
         for chunk in r.iter_content(chunk_size=None, decode_unicode=True):
             if chunk:
                 out.write(chunk)
@@ -168,13 +183,15 @@ def stream_and_get(request_id: str, url: Optional[str] = None,
 def api_cancel(request_id: str, url: Optional[str] = None) -> bool:
     url = url or api_server_url(required=True)
     r = requests_http.post(f'{url}/api/v1/request_cancel',
-                           json={'request_id': request_id}, timeout=30)
+                           json={'request_id': request_id},
+                           headers=_headers(), timeout=30)
     return bool(r.json().get('cancelled'))
 
 
 def api_list_requests(url: Optional[str] = None) -> List[Dict[str, Any]]:
     url = url or api_server_url(required=True)
-    return requests_http.get(f'{url}/api/v1/requests', timeout=30).json()
+    return requests_http.get(f'{url}/api/v1/requests',
+                             headers=_headers(), timeout=30).json()
 
 
 # ---------------------------------------------------------------------------

@@ -49,10 +49,11 @@ class Local(cloud_lib.Cloud):
     def unsupported_features(
             cls, resources: 'resources_lib.Resources'
     ) -> Dict[cloud_lib.CloudImplementationFeatures, str]:
-        return {
-            cloud_lib.CloudImplementationFeatures.STORAGE_MOUNTING:
-                'local cloud has no object store; use workdir sync.',
-        }
+        # Storage mounting IS supported: local-dir sources realize as
+        # copies/symlinks/write-back caches under each fabricated host
+        # (data/storage.py mount_command_for), making the MOUNT_CACHED
+        # flush-barrier contract hermetically testable.
+        return {}
 
     def regions_with_offering(self, resources: 'resources_lib.Resources'
                               ) -> List[cloud_lib.Region]:

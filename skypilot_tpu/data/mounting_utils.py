@@ -27,3 +27,84 @@ def gcsfuse_mount_command(bucket_url: str, dst: str,
 
 def fusermount_unmount_command(dst: str) -> str:
     return f'fusermount -u {shlex.quote(dst)} || umount {shlex.quote(dst)}'
+
+
+# --- MOUNT_CACHED (write-back cache + exit flush barrier) ------------------
+# Reference contract: sky/data/storage.py StorageMode.MOUNT_CACHED + the
+# flush-before-exit script injected into every job
+# (sky/backends/cloud_vm_ray_backend.py:763-790). GCS impl: rclone with a
+# writes VFS cache; the flush barrier polls the VFS queue until drained.
+
+_RCLONE_CACHE_DIR = '/tmp/skytpu_rclone_cache'
+_RCLONE_LOG_DIR = '/tmp/skytpu_rclone_logs'
+_RCLONE_POLL_SECONDS = 5
+
+
+def _mount_tag(dst: str) -> str:
+    return dst.strip('/').replace('/', '_') or 'root'
+
+
+def rclone_mount_command(bucket_url: str, dst: str) -> str:
+    assert bucket_url.startswith('gs://'), bucket_url
+    remote = bucket_url[len('gs://'):]
+    dst_q = shlex.quote(dst)
+    log = f'{_RCLONE_LOG_DIR}/{_mount_tag(dst)}.log'
+    # -v so the periodic "vfs cache: cleaned:" lines land in the log —
+    # that's what the flush barrier greps (uploaded files stay in the cache
+    # dir until --vfs-cache-max-age, so cache-dir emptiness can NOT signal
+    # drain; the reference uses the same log-grep contract,
+    # cloud_vm_ray_backend.py:763-790).
+    return (
+        f'mkdir -p {dst_q} {_RCLONE_CACHE_DIR}/{_mount_tag(dst)} '
+        f'{_RCLONE_LOG_DIR} && '
+        f'(mountpoint -q {dst_q} || '
+        f'rclone mount :gcs:{shlex.quote(remote)} {dst_q} --daemon -v '
+        f'--vfs-cache-mode writes '
+        f'--vfs-cache-poll-interval {_RCLONE_POLL_SECONDS}s '
+        f'--cache-dir {_RCLONE_CACHE_DIR}/{_mount_tag(dst)} '
+        f'--log-file {log} --gcs-env-auth)')
+
+
+def rclone_flush_command(dst: str, timeout_s: int = 600) -> str:
+    """Block until this mount's write-back queue drains: the latest
+    'vfs cache: cleaned:' log line must report nothing in use/uploading."""
+    log = f'{_RCLONE_LOG_DIR}/{_mount_tag(dst)}.log'
+    return (
+        f'sync; '
+        f'if [ ! -f {log} ]; then exit 0; fi; '
+        f'deadline=$(( $(date +%s) + {timeout_s} )); '
+        f'sleep 1; '
+        f'while true; do '
+        f'  tac {log} | grep -m1 "vfs cache: cleaned:" | '
+        f'    grep -q "in use 0, to upload 0, uploading 0" && exit 0; '
+        f'  if [ $(date +%s) -gt $deadline ]; then '
+        f'    echo "[flush] timed out draining write-back cache for '
+        f'{shlex.quote(dst)}"; exit 1; '
+        f'  fi; sleep {_RCLONE_POLL_SECONDS}; '
+        f'done')
+
+
+# --- Local fake-cloud mounts (hermetic miniature of the same contract) -----
+
+def local_copy_command(source: str, dst: str) -> str:
+    return (f'mkdir -p {shlex.quote(dst)} && '
+            f'cp -r {shlex.quote(source)}/. {shlex.quote(dst)}/')
+
+
+def local_link_command(source: str, dst: str) -> str:
+    """MOUNT on the local cloud: a symlink is a faithful passthrough-FUSE
+    stand-in (writes land in the 'bucket' immediately)."""
+    dst_q = shlex.quote(dst)
+    return (f'mkdir -p $(dirname {dst_q}) && '
+            f'ln -sfn {shlex.quote(source)} {dst_q}')
+
+
+def local_cached_mount_command(source: str, dst: str) -> str:
+    """MOUNT_CACHED locally: populate a host-local cache dir; writes stay
+    local until the flush barrier pushes them back."""
+    return local_copy_command(source, dst)
+
+
+def local_cached_flush_command(source: str, dst: str) -> str:
+    return (f'mkdir -p {shlex.quote(source)} && '
+            f'cp -r {shlex.quote(dst)}/. {shlex.quote(source)}/')

@@ -112,6 +112,54 @@ class Storage:
         global_state.remove_storage(self.name)
 
 
+def resolve_local_dst(runner, dst: str) -> str:
+    """On the local fake cloud, mount paths land inside the host's workdir
+    so jobs reach them with the same relative paths they would use on a
+    real VM's home-relative mounts."""
+    from skypilot_tpu.utils import command_runner as cr
+    if isinstance(runner, cr.LocalProcessCommandRunner):
+        rel = dst.lstrip('/').replace('~/', '')
+        return os.path.join(runner.host_dir, 'skytpu_workdir', rel)
+    return dst
+
+
+def mount_command_for(storage: Storage, dst: str, local: bool) -> str:
+    """The command realizing one mount on one host."""
+    if local:
+        source = os.path.expanduser(storage.source or '')
+        if storage.store_type != StoreType.LOCAL:
+            raise exceptions.StorageError(
+                f'Local cloud can only mount local-dir sources, got '
+                f'{storage.source!r}.')
+        if storage.mode == StorageMode.MOUNT:
+            return mounting_utils.local_link_command(source, dst)
+        if storage.mode == StorageMode.MOUNT_CACHED:
+            return mounting_utils.local_cached_mount_command(source, dst)
+        return mounting_utils.local_copy_command(source, dst)
+    if storage.mode == StorageMode.COPY:
+        return mounting_utils.gsutil_copy_command(storage.bucket_url(), dst)
+    if storage.mode == StorageMode.MOUNT_CACHED:
+        return mounting_utils.rclone_mount_command(storage.bucket_url(), dst)
+    return mounting_utils.gcsfuse_mount_command(storage.bucket_url(), dst)
+
+
+def flush_command_for(storage: Storage, dst: str,
+                      local: bool) -> Optional[str]:
+    """The exit-barrier command for one mount (None = nothing to flush).
+
+    Reference analog: the MOUNT_CACHED flush script injected into every job
+    (cloud_vm_ray_backend.py:763-790) — a recovered job resumes from the
+    checkpoint only if the pre-preemption write actually reached the
+    bucket.
+    """
+    if storage.mode is not StorageMode.MOUNT_CACHED:
+        return None
+    if local:
+        source = os.path.expanduser(storage.source or '')
+        return mounting_utils.local_cached_flush_command(source, dst)
+    return mounting_utils.rclone_flush_command(dst)
+
+
 def execute_storage_mounts(handle: 'slice_backend.SliceResourceHandle',
                            storage_mounts: Dict[str, Any]) -> None:
     """Realize each `file_mounts: {dst: {source, mode}}` storage entry on
@@ -119,21 +167,14 @@ def execute_storage_mounts(handle: 'slice_backend.SliceResourceHandle',
     from skypilot_tpu.provision import provisioner as provisioner_lib
     cluster_info = handle.get_cluster_info()
     runners = provisioner_lib.get_command_runners(cluster_info)
+    local = cluster_info.provider_name == 'local'
     for dst, raw in storage_mounts.items():
         storage = Storage.from_yaml_config(raw if isinstance(raw, dict)
                                            else {'source': raw})
-        if cluster_info.provider_name == 'local':
-            logger.warning(f'Skipping storage mount {dst} on local cloud '
-                           f'(no object-store access).')
-            continue
-        if storage.mode == StorageMode.COPY:
-            cmd = mounting_utils.gsutil_copy_command(storage.bucket_url(), dst)
-        else:
-            cmd = mounting_utils.gcsfuse_mount_command(
-                storage.bucket_url(), dst,
-                cached=storage.mode == StorageMode.MOUNT_CACHED)
 
-        def _mount(runner, cmd=cmd, dst=dst) -> None:
+        def _mount(runner, storage=storage, dst=dst) -> None:
+            resolved = resolve_local_dst(runner, dst) if local else dst
+            cmd = mount_command_for(storage, resolved, local)
             rc = runner.run(cmd, log_path='/dev/null')
             if rc != 0:
                 raise exceptions.StorageError(
@@ -141,3 +182,29 @@ def execute_storage_mounts(handle: 'slice_backend.SliceResourceHandle',
                     f'{runner.node_id}.')
 
         subprocess_utils.run_in_parallel(_mount, runners)
+
+
+def flush_commands(handle: 'slice_backend.SliceResourceHandle',
+                   storage_mounts: Dict[str, Any]) -> Dict[str, str]:
+    """{dst: flush command} for a task's MOUNT_CACHED mounts.
+
+    The slice driver runs these on every host (from the job's workdir, so
+    local-cloud paths are workdir-relative) after the gang succeeds — the
+    exit barrier that makes cached writes durable before teardown.
+    """
+    cluster_info = handle.get_cluster_info()
+    local = cluster_info.provider_name == 'local'
+    out: Dict[str, str] = {}
+    for dst, raw in storage_mounts.items():
+        storage = Storage.from_yaml_config(raw if isinstance(raw, dict)
+                                           else {'source': raw})
+        if local:
+            # The job's cwd is the host workdir; mounts live under it
+            # (resolve_local_dst), so the relative path works on any host.
+            rel = dst.lstrip('/').replace('~/', '')
+            cmd = flush_command_for(storage, rel, local=True)
+        else:
+            cmd = flush_command_for(storage, dst, local=False)
+        if cmd is not None:
+            out[dst] = cmd
+    return out

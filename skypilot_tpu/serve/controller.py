@@ -112,12 +112,29 @@ def shutdown_service(service_name: str) -> None:
     pid = record.get('controller_pid')
     if pid:
         pid = int(pid)
+
+        def _dead(p: int) -> bool:
+            # Reap if it's our child (a zombie still answers kill(p, 0)).
+            try:
+                wpid, _ = os.waitpid(p, os.WNOHANG)
+                if wpid == p:
+                    return True
+            except ChildProcessError:
+                pass          # not our child: signal-0 probe below decides
+            try:
+                os.kill(p, 0)
+                return False
+            except (OSError, ProcessLookupError):
+                return True
+
         try:
             os.kill(pid, 15)
             for _ in range(75):           # up to 15s graceful
-                os.kill(pid, 0)
+                if _dead(pid):
+                    break
                 time.sleep(0.2)
-            os.kill(pid, 9)
+            else:
+                os.kill(pid, 9)
         except (OSError, ProcessLookupError):
             pass
     spec = spec_lib.ServiceSpec.from_yaml_config(record['spec'])

@@ -238,8 +238,11 @@ class ReplicaManager:
                 alive.append(rep)
             elif status is ReplicaStatus.FAILED:
                 # Launch thread already marked it; clean up and replace via
-                # the scale-up below.
+                # the scale-up below. Launch failures count toward the
+                # permanent-failure cap exactly like probe failures — an
+                # unprovisionable service must not churn clusters forever.
                 self.terminate_replica(rid, ReplicaStatus.FAILED)
+                self._probe_failure_streak += 1
         # A broken app fails probes on every fresh replica: without a cap
         # the loop launches and tears down (billing!) slices forever. The
         # streak resets on any successful probe, so preemption-replacement
@@ -248,7 +251,7 @@ class ReplicaManager:
         if self._probe_failure_streak >= cap:
             self.permanently_failed = (
                 f'{self._probe_failure_streak} consecutive replicas failed '
-                f'readiness probes — the app never comes up; check the '
+                f'to launch or pass readiness probes; check the resources, '
                 f'run command and readiness_probe.')
             return
         # Scale toward target.

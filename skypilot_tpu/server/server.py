@@ -29,10 +29,41 @@ from skypilot_tpu.server import executor, registry, requests_lib
 logger = sky_logging.init_logger(__name__)
 
 DEFAULT_PORT = 46580
+_SERVER_START_TIME = 0.0
+_GC_INTERVAL_SECONDS = 3600.0
 
 
 def _json(data: Any, status: int = 200) -> web.Response:
     return web.json_response(data, status=status)
+
+
+def _api_token() -> str:
+    """Optional bearer-token auth (reference analog: sky/server/auth/).
+
+    Empty string = auth disabled (the local single-user default). Set
+    SKYTPU_API_TOKEN (or write ~/.skytpu/api_token) when exposing the
+    server beyond localhost.
+    """
+    token = os.environ.get('SKYTPU_API_TOKEN', '')
+    if token:
+        return token
+    path = os.path.expanduser('~/.skytpu/api_token')
+    try:
+        with open(path, 'r', encoding='utf-8') as f:
+            return f.read().strip()
+    except OSError:
+        return ''
+
+
+@web.middleware
+async def auth_middleware(request: web.Request, handler):
+    token = request.app['api_token']
+    if token and request.path != '/api/v1/health':
+        import hmac
+        got = request.headers.get('Authorization', '')
+        if not hmac.compare_digest(got, f'Bearer {token}'):
+            return _json({'error': 'unauthorized'}, status=401)
+    return await handler(request)
 
 
 async def health(request: web.Request) -> web.Response:
@@ -108,6 +139,56 @@ async def list_requests(request: web.Request) -> web.Response:
     return _json(requests_lib.list_requests(limit))
 
 
+async def metrics(request: web.Request) -> web.Response:
+    """Prometheus text exposition (reference: sky/metrics/utils.py:47-146).
+    Hand-formatted — the format is trivial and it keeps the server
+    dependency-free."""
+    del request
+    import time as time_lib
+    snap = requests_lib.metrics_snapshot()
+    lines = [
+        '# HELP skytpu_uptime_seconds API server uptime.',
+        '# TYPE skytpu_uptime_seconds gauge',
+        f'skytpu_uptime_seconds {time_lib.time() - _SERVER_START_TIME:.1f}',
+        '# HELP skytpu_requests_total API requests by name and status.',
+        '# TYPE skytpu_requests_total counter',
+    ]
+    for name, status, count in snap['counts']:
+        lines.append(f'skytpu_requests_total{{name="{name}",'
+                     f'status="{status}"}} {count}')
+    lines += [
+        '# HELP skytpu_request_duration_seconds_sum Total request runtime.',
+        '# TYPE skytpu_request_duration_seconds_sum counter',
+    ]
+    for name, count, total in snap['durations']:
+        lines.append(
+            f'skytpu_request_duration_seconds_sum{{name="{name}"}} '
+            f'{total:.3f}')
+        lines.append(
+            f'skytpu_request_duration_seconds_count{{name="{name}"}} '
+            f'{count}')
+    return web.Response(text='\n'.join(lines) + '\n',
+                        content_type='text/plain')
+
+
+async def _gc_loop(app: web.Application) -> None:
+    while True:
+        try:
+            n = requests_lib.gc_requests()
+            if n:
+                logger.info(f'request GC: pruned {n} old records')
+        except asyncio.CancelledError:
+            return
+        except Exception as e:  # pylint: disable=broad-except
+            # e.g. transient 'database is locked': never let one bad pass
+            # kill GC for the server's lifetime.
+            logger.warning(f'request GC pass failed (will retry): {e}')
+        try:
+            await asyncio.sleep(_GC_INTERVAL_SECONDS)
+        except asyncio.CancelledError:
+            return
+
+
 async def request_cancel(request: web.Request) -> web.Response:
     payload = await request.json()
     ok = executor.cancel_request(payload.get('request_id', ''))
@@ -115,13 +196,27 @@ async def request_cancel(request: web.Request) -> web.Response:
 
 
 def build_app() -> web.Application:
-    app = web.Application()
+    global _SERVER_START_TIME
+    import time as time_lib
+    _SERVER_START_TIME = time_lib.time()
+    app = web.Application(middlewares=[auth_middleware])
+    app['api_token'] = _api_token()
     app.router.add_get('/api/v1/health', health)
     app.router.add_get('/api/v1/get', get_request)
     app.router.add_get('/api/v1/stream', stream)
     app.router.add_get('/api/v1/requests', list_requests)
+    app.router.add_get('/api/v1/metrics', metrics)
     app.router.add_post('/api/v1/request_cancel', request_cancel)
     app.router.add_post('/api/v1/{name}', submit)
+
+    async def _start_gc(app_):
+        app_['gc_task'] = asyncio.create_task(_gc_loop(app_))
+
+    async def _stop_gc(app_):
+        app_['gc_task'].cancel()
+
+    app.on_startup.append(_start_gc)
+    app.on_cleanup.append(_stop_gc)
     return app
 
 

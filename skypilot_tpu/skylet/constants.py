@@ -70,9 +70,12 @@ def gang_env(*,
         'TPU_WORKER_HOSTNAMES': ','.join(
             ips[slice_index * hosts_per_slice:
                 (slice_index + 1) * hosts_per_slice]),
-        # jax.distributed.initialize() picks these up.
+        # jax.distributed.initialize() picks these up (train/trainer.py
+        # maybe_init_distributed): process_id = global rank, num_processes
+        # = all hosts across all slices.
         'SKYTPU_COORDINATOR_ADDRESS':
             f'{coordinator_ip}:{JAX_COORDINATOR_PORT}',
+        'SKYTPU_NUM_PROCESSES': str(num_hosts),
     }
     if num_slices > 1:
         env.update({

@@ -187,6 +187,22 @@ def run_gang(spec: Dict[str, Any]) -> int:
             t.join()
 
     if failed_rank is None:
+        # Storage flush barrier (MOUNT_CACHED): run the epilogue on every
+        # host; a failed flush fails the job — a checkpoint that never
+        # reached the bucket must not look like a success.
+        epilogue_cmds: List[str] = spec.get('epilogue_cmds') or []
+        for cmd in epilogue_cmds:
+            for rank, host in enumerate(hosts):
+                full = _build_rank_command(host, cmd, {'SKYTPU_EPILOGUE': '1'})
+                proc = subprocess.run(full, stdout=subprocess.PIPE,
+                                      stderr=subprocess.STDOUT, text=True,
+                                      check=False)
+                if proc.returncode != 0:
+                    with open(agg_path, 'a', encoding='utf-8') as agg:
+                        agg.write(f'[driver] flush barrier failed on rank '
+                                  f'{rank}: {proc.stdout}\n')
+                    job_lib.set_status(job_id, JobStatus.FAILED)
+                    return proc.returncode
         job_lib.set_status(job_id, JobStatus.SUCCEEDED)
         return 0
     job_lib.set_status(job_id, JobStatus.FAILED)

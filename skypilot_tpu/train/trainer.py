@@ -48,10 +48,12 @@ def maybe_init_distributed() -> None:
     coordinator = os.environ.get('SKYTPU_COORDINATOR_ADDRESS')
     num_procs = int(os.environ.get('SKYTPU_NUM_PROCESSES', '1'))
     if coordinator and num_procs > 1:
+        # SKYTPU_NODE_RANK is the global rank across all slices;
+        # TPU_WORKER_ID is slice-local and would collide on multi-slice.
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=num_procs,
-            process_id=int(os.environ.get('TPU_WORKER_ID', '0')))
+            process_id=int(os.environ.get('SKYTPU_NODE_RANK', '0')))
 
 
 def _model_config(tcfg: TrainerConfig):

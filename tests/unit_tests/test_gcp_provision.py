@@ -1,0 +1,312 @@
+"""GCP TPU provisioning against a fake tpu.googleapis.com.
+
+The fake sits at the `requests.request` seam, so everything above it — URL
+construction, operation polling, error classification, the zone-failover
+loop — is the real production code (reference pattern:
+tests/test_optimizer_dryruns.py's mocked-cloud dryruns, and
+GCPTPUVMInstance flows in sky/provision/gcp/instance_utils.py:1205,1338).
+"""
+import json
+import re
+from typing import Dict
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision.gcp import instance as gcp_instance
+from skypilot_tpu.provision.gcp import tpu_api
+
+
+class FakeResponse:
+
+    def __init__(self, status_code: int, body):
+        self.status_code = status_code
+        self._body = body
+        self.text = json.dumps(body) if isinstance(body, dict) else str(body)
+
+    def json(self):
+        return self._body
+
+
+class FakeTpuService:
+    """In-memory model of the TPU v2 REST API: nodes, LRO operations,
+    queued resources, programmable per-zone failures."""
+
+    def __init__(self):
+        self.nodes: Dict[str, Dict] = {}       # 'zone/name' -> node
+        self.qrs: Dict[str, Dict] = {}         # 'zone/name' -> qr
+        self.zone_errors: Dict[str, FakeResponse] = {}
+        self.op_error_message: Dict[str, str] = {}  # zone -> op error
+        self.qr_final_state: str = 'ACTIVE'
+        self.deleted_qrs = []
+        self.deleted_nodes = []
+        self.calls = []
+
+    # -- helpers --
+    def _make_node(self, zone, name, body):
+        workers = int(body.get('_workers', 2))
+        return {
+            'name': f'projects/p/locations/{zone}/nodes/{name}',
+            'state': 'READY',
+            'labels': body.get('labels', {}),
+            'networkEndpoints': [
+                {'ipAddress': f'10.0.{i}.2',
+                 'accessConfig': {'externalIp': f'34.1.{i}.2'}}
+                for i in range(workers)
+            ],
+        }
+
+    # -- the requests.request replacement --
+    def request(self, method, url, headers=None, json=None, params=None,
+                timeout=None):
+        del headers, timeout
+        self.calls.append((method, url))
+        m = re.match(
+            r'https://tpu\.googleapis\.com/v2/projects/(?P<p>[^/]+)/'
+            r'locations/(?P<zone>[^/]+)/(?P<rest>.*)', url)
+        if m is None:
+            # operation polling: /v2/<operation-name>
+            op = re.match(r'https://tpu\.googleapis\.com/v2/(?P<op>.+)', url)
+            assert op, url
+            zone = op.group('op').split('/')[3]
+            if zone in self.op_error_message:
+                return FakeResponse(200, {
+                    'done': True,
+                    'error': {'code': 8,
+                              'message': self.op_error_message[zone]},
+                })
+            return FakeResponse(200, {'done': True, 'response': {}})
+        zone, rest = m.group('zone'), m.group('rest')
+
+        if rest.startswith('operations/'):
+            if zone in self.op_error_message:
+                return FakeResponse(200, {
+                    'done': True,
+                    'error': {'code': 8,
+                              'message': self.op_error_message[zone]},
+                })
+            return FakeResponse(200, {'done': True, 'response': {}})
+        if method == 'POST' and rest == 'nodes':
+            if zone in self.zone_errors:
+                return self.zone_errors[zone]
+            name = params['nodeId']
+            if zone not in self.op_error_message:
+                self.nodes[f'{zone}/{name}'] = self._make_node(
+                    zone, name, json or {})
+            return FakeResponse(200, {
+                'name': f'projects/p/locations/{zone}/operations/op-{name}'})
+        if rest == 'nodes' and method == 'GET':
+            nodes = [n for k, n in self.nodes.items()
+                     if k.startswith(f'{zone}/')]
+            return FakeResponse(200, {'nodes': nodes})
+        nm = re.match(r'nodes/(?P<name>[^:/]+)(?P<verb>:stop|:start)?$', rest)
+        if nm:
+            key = f'{zone}/{nm.group("name")}'
+            if method == 'GET':
+                if key not in self.nodes:
+                    return FakeResponse(404, {'error': 'not found'})
+                return FakeResponse(200, self.nodes[key])
+            if method == 'DELETE':
+                if key not in self.nodes:
+                    return FakeResponse(404, {'error': 'not found'})
+                del self.nodes[key]
+                self.deleted_nodes.append(key)
+                return FakeResponse(200, {
+                    'name': f'projects/p/locations/{zone}/operations/del'})
+            if nm.group('verb') == ':stop':
+                self.nodes[key]['state'] = 'STOPPED'
+                return FakeResponse(200, {
+                    'name': f'projects/p/locations/{zone}/operations/stop'})
+            if nm.group('verb') == ':start':
+                self.nodes[key]['state'] = 'READY'
+                return FakeResponse(200, {
+                    'name': f'projects/p/locations/{zone}/operations/start'})
+        if rest == 'queuedResources' and method == 'POST':
+            if zone in self.zone_errors:
+                return self.zone_errors[zone]
+            name = params['queuedResourceId']
+            self.qrs[f'{zone}/{name}'] = {
+                'state': {'state': self.qr_final_state}}
+            if self.qr_final_state == 'ACTIVE':
+                node_spec = json['tpu']['nodeSpec'][0]
+                self.nodes[f'{zone}/{name}'] = self._make_node(
+                    zone, name, node_spec['node'])
+            return FakeResponse(200, {})
+        qm = re.match(r'queuedResources/(?P<name>[^/]+)$', rest)
+        if qm:
+            key = f'{zone}/{qm.group("name")}'
+            if method == 'GET':
+                if key not in self.qrs:
+                    return FakeResponse(404, {'error': 'not found'})
+                return FakeResponse(200, self.qrs[key])
+            if method == 'DELETE':
+                if key not in self.qrs:
+                    return FakeResponse(404, {'error': 'not found'})
+                del self.qrs[key]
+                self.deleted_qrs.append(key)
+                return FakeResponse(200, {
+                    'name': f'projects/p/locations/{zone}/operations/qdel'})
+        raise AssertionError(f'fake API: unhandled {method} {url}')
+
+
+@pytest.fixture
+def fake_tpu(monkeypatch):
+    svc = FakeTpuService()
+    monkeypatch.setattr(tpu_api.requests, 'request', svc.request)
+    monkeypatch.setattr(tpu_api, '_headers', lambda: {})
+    monkeypatch.setattr(gcp_instance, '_ssh_keys_metadata',
+                        lambda: 'skytpu:ssh-ed25519 AAAA fake')
+    monkeypatch.setattr(tpu_api, '_OPERATION_POLL_SECONDS', 0)
+    yield svc
+
+
+def _config(zone='us-central2-b', num_slices=1, use_qr=False, spot=False,
+            workers=2):
+    return provision_common.ProvisionConfig(
+        provider_config={
+            'project_id': 'p',
+            'zones': [zone],
+            'accelerator_type': 'v4-16',
+            'tpu_generation': 'v4',
+            'runtime_version': 'tpu-ubuntu2204-base',
+            'num_slices': num_slices,
+            'use_queued_resources': use_qr,
+            'use_spot': spot,
+            '_workers': workers,
+        },
+        authentication_config={},
+        count=num_slices,
+        tags={},
+    )
+
+
+class TestGcpProvision:
+
+    def test_create_poll_ready_and_cluster_info(self, fake_tpu):
+        record = gcp_instance.run_instances('us-central2', 'us-central2-b',
+                                            'train', _config())
+        assert record.created_instance_ids == ['train-0']
+        statuses = gcp_instance.query_instances(
+            'us-central2', 'train', {'project_id': 'p',
+                                     'zones': ['us-central2-b']})
+        assert statuses == {'train-0': 'READY'}
+        info = gcp_instance.get_cluster_info(
+            'us-central2', 'train', {'project_id': 'p',
+                                     'zones': ['us-central2-b']})
+        insts = info.ordered_instances()
+        assert [(i.slice_index, i.worker_id) for i in insts] == [(0, 0),
+                                                                 (0, 1)]
+        assert insts[0].external_ip == '34.1.0.2'
+        assert info.head_instance_id == insts[0].instance_id
+
+    def test_multislice_creates_one_node_per_slice(self, fake_tpu):
+        record = gcp_instance.run_instances('us-central2', 'us-central2-b',
+                                            'ms', _config(num_slices=2))
+        assert record.created_instance_ids == ['ms-0', 'ms-1']
+        info = gcp_instance.get_cluster_info(
+            'us-central2', 'ms', {'project_id': 'p',
+                                  'zones': ['us-central2-b']})
+        assert [(i.slice_index, i.worker_id)
+                for i in info.ordered_instances()] == [
+                    (0, 0), (0, 1), (1, 0), (1, 1)]
+
+    def test_stockout_http_is_classified(self, fake_tpu):
+        fake_tpu.zone_errors['us-central2-b'] = FakeResponse(
+            429, {'error': 'There is no more capacity in the zone'})
+        with pytest.raises(exceptions.InsufficientCapacityError):
+            gcp_instance.run_instances('us-central2', 'us-central2-b',
+                                       'oops', _config())
+
+    def test_quota_403_is_classified(self, fake_tpu):
+        fake_tpu.zone_errors['us-central2-b'] = FakeResponse(
+            403, {'error': 'Quota exceeded for TPUV4CoresPerProject'})
+        with pytest.raises(exceptions.QuotaExceededError):
+            gcp_instance.run_instances('us-central2', 'us-central2-b',
+                                       'oops', _config())
+
+    def test_operation_error_stockout_classified(self, fake_tpu):
+        # Create succeeds at the HTTP layer; the LRO comes back failed.
+        fake_tpu.op_error_message['us-central2-b'] = (
+            'Resource exhausted: out of capacity')
+        with pytest.raises(exceptions.InsufficientCapacityError):
+            gcp_instance.run_instances('us-central2', 'us-central2-b',
+                                       'oops', _config())
+
+    def test_queued_resource_active_flow(self, fake_tpu):
+        record = gcp_instance.run_instances(
+            'us-central2', 'us-central2-b', 'qr',
+            _config(use_qr=True, spot=True))
+        assert record.created_instance_ids == ['qr-0']
+        assert 'us-central2-b/qr-0' in fake_tpu.qrs
+
+    def test_queued_resource_denied_is_stockout(self, fake_tpu):
+        fake_tpu.qr_final_state = 'FAILED'
+        with pytest.raises(exceptions.InsufficientCapacityError):
+            gcp_instance.run_instances(
+                'us-central2', 'us-central2-b', 'qr2',
+                _config(use_qr=True))
+
+    def test_terminate_deletes_qr_then_node(self, fake_tpu):
+        gcp_instance.run_instances('us-central2', 'us-central2-b', 'bye',
+                                   _config(use_qr=True))
+        gcp_instance.terminate_instances(
+            'us-central2', 'bye', {'project_id': 'p',
+                                   'zones': ['us-central2-b']})
+        # The spot-TPU cleanup contract (clouds/gcp.py:1095-1101 analog):
+        # delete the queued resource (force) AND the node.
+        assert fake_tpu.deleted_qrs == ['us-central2-b/bye-0']
+        assert fake_tpu.deleted_nodes == ['us-central2-b/bye-0']
+        assert gcp_instance.query_instances(
+            'us-central2', 'bye', {'project_id': 'p',
+                                   'zones': ['us-central2-b']}) == {}
+
+    def test_stop_resume_cycle(self, fake_tpu):
+        gcp_instance.run_instances('us-central2', 'us-central2-b', 'sr',
+                                   _config())
+        gcp_instance.stop_instances('us-central2', 'sr',
+                                    {'project_id': 'p',
+                                     'zones': ['us-central2-b']})
+        assert fake_tpu.nodes['us-central2-b/sr-0']['state'] == 'STOPPED'
+        cfg = _config()
+        cfg = provision_common.ProvisionConfig(
+            provider_config=cfg.provider_config,
+            authentication_config={}, count=1, tags={},
+            resume_stopped_nodes=True)
+        record = gcp_instance.run_instances('us-central2', 'us-central2-b',
+                                            'sr', cfg)
+        assert record.resumed_instance_ids == ['sr-0']
+        assert fake_tpu.nodes['us-central2-b/sr-0']['state'] == 'READY'
+
+    def test_idempotent_reprovision(self, fake_tpu):
+        gcp_instance.run_instances('us-central2', 'us-central2-b', 'idem',
+                                   _config())
+        record = gcp_instance.run_instances('us-central2', 'us-central2-b',
+                                            'idem', _config())
+        assert record.created_instance_ids == []   # already READY
+
+
+class TestZoneFailoverLoop:
+    """The bulk_provision zone loop over the real GCP Cloud object: zone 1
+    stockout → zone 2 lands (reference: RetryingVmProvisioner:1341)."""
+
+    def test_failover_to_second_zone(self, fake_tpu, monkeypatch):
+        from skypilot_tpu import resources as resources_lib
+        from skypilot_tpu.clouds import gcp as gcp_cloud
+        from skypilot_tpu.provision import provisioner
+
+        # v3 in us-central1 is the catalog's multi-zone offering.
+        res = resources_lib.Resources(cloud='gcp', accelerators='tpu-v3-8')
+        cloud = res.cloud
+        regions = cloud.regions_with_offering(res)
+        region = next(r for r in regions if len(r.zones) >= 2)
+        z1, z2 = region.zones[0].name, region.zones[1].name
+        fake_tpu.zone_errors[z1] = FakeResponse(
+            429, {'error': 'no more capacity'})
+        monkeypatch.setattr(
+            'skypilot_tpu.provision.gcp.instance._ssh_keys_metadata',
+            lambda: 'skytpu:ssh-ed25519 AAAA fake')
+        monkeypatch.setenv('GOOGLE_CLOUD_PROJECT', 'p')
+        record = provisioner.bulk_provision(cloud, region.name, 'fo', res)
+        assert record.zone == z2
+        assert f'{z2}/fo-0' in fake_tpu.nodes

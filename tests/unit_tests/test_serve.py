@@ -146,6 +146,14 @@ _REPLICA_APP = (
 )
 
 
+def _worker_port_base() -> int:
+    """Unique port range per pytest-xdist worker (gw0, gw1, ...)."""
+    import os as _os
+    worker = _os.environ.get('PYTEST_XDIST_WORKER', 'gw0')
+    idx = int(worker[2:]) if worker[2:].isdigit() else 0
+    return 31800 + 100 * idx
+
+
 def _service_task(replicas=2):
     task = sky.Task(name='svc', run=_REPLICA_APP)
     task.set_resources(sky.Resources(accelerators='tpu-v5e-8'))
@@ -155,7 +163,7 @@ def _service_task(replicas=2):
         'readiness_probe': {'path': '/health', 'initial_delay_seconds': 30,
                             'timeout_seconds': 2},
         'replicas': replicas,
-        'ports': 31800,
+        'ports': _worker_port_base(),
         # round_robin so serial test traffic provably hits every replica
         # (least_load sends serial idle-time requests to one replica).
         'load_balancing_policy': 'round_robin',
@@ -190,7 +198,8 @@ def serve_env(enable_local_cloud, isolated_state, monkeypatch):
 class TestServeEndToEnd:
 
     def test_up_ready_balance_recover_down(self):
-        info = serve_core.up(_service_task(replicas=2))
+        info = serve_core.up(_service_task(replicas=2),
+                             lb_port=_worker_port_base() + 50)
         name = info['name']
         try:
             serve_core.wait_until(name, {ServiceStatus.READY}, timeout=120)
@@ -240,9 +249,9 @@ class TestServeEndToEnd:
                                 'initial_delay_seconds': 1,
                                 'timeout_seconds': 1},
             'replicas': 1,
-            'ports': 31950,
+            'ports': _worker_port_base() + 60,
         }
-        info = serve_core.up(task)
+        info = serve_core.up(task, lb_port=_worker_port_base() + 51)
         try:
             status = serve_core.wait_until(
                 info['name'], {ServiceStatus.FAILED}, timeout=120)

@@ -1,0 +1,123 @@
+"""API server: endpoint surface, auth, metrics, request GC.
+
+Reference analog: tests/test_api.py (FastAPI testclient against the real
+app with the executor mocked) — here aiohttp's test utilities against the
+real app, requests executed inline instead of in runner subprocesses.
+"""
+import asyncio
+import json
+import time
+
+import pytest
+from aiohttp.test_utils import TestClient
+from aiohttp.test_utils import TestServer as AioTestServer
+
+from skypilot_tpu.server import requests_lib
+from skypilot_tpu.server import server as server_lib
+
+
+@pytest.fixture
+def isolated_server(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKYTPU_SERVER_DIR', str(tmp_path / 'srv'))
+    monkeypatch.delenv('SKYTPU_API_TOKEN', raising=False)
+    yield tmp_path
+
+
+def _run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def _with_client(fn, token_env=None, monkeypatch=None):
+    async def inner():
+        if token_env and monkeypatch:
+            monkeypatch.setenv('SKYTPU_API_TOKEN', token_env)
+        app = server_lib.build_app()
+        client = TestClient(AioTestServer(app))
+        await client.start_server()
+        try:
+            return await fn(client)
+        finally:
+            await client.close()
+    return _run(inner())
+
+
+@pytest.mark.usefixtures('isolated_server')
+class TestApiServer:
+
+    def test_health_and_unknown_route(self):
+        async def fn(client):
+            r = await client.get('/api/v1/health')
+            assert r.status == 200
+            body = await r.json()
+            assert body['status'] == 'healthy'
+            r = await client.post('/api/v1/definitely_not_a_thing', json={})
+            assert r.status == 404
+        _with_client(fn)
+
+    def test_submit_creates_request_record(self):
+        async def fn(client):
+            r = await client.post('/api/v1/status', json={})
+            assert r.status == 200
+            rid = (await r.json())['request_id']
+            rec = requests_lib.get(rid)
+            assert rec['name'] == 'status'
+            assert rec['status'] == 'NEW'
+        _with_client(fn)
+
+    def test_auth_rejects_without_token(self, monkeypatch):
+        async def fn(client):
+            r = await client.get('/api/v1/health')     # health stays open
+            assert r.status == 200
+            r = await client.post('/api/v1/status', json={})
+            assert r.status == 401
+            r = await client.post(
+                '/api/v1/status', json={},
+                headers={'Authorization': 'Bearer sekrit'})
+            assert r.status == 200
+            r = await client.post(
+                '/api/v1/status', json={},
+                headers={'Authorization': 'Bearer wrong'})
+            assert r.status == 401
+        _with_client(fn, token_env='sekrit', monkeypatch=monkeypatch)
+
+    def test_metrics_exposition(self):
+        requests_lib.create('launch', {}, requests_lib.LONG)
+
+        async def fn(client):
+            r = await client.get('/api/v1/metrics')
+            assert r.status == 200
+            text = await r.text()
+            assert 'skytpu_uptime_seconds' in text
+            assert 'skytpu_requests_total{name="launch",status="NEW"} 1' \
+                in text
+        _with_client(fn)
+
+
+@pytest.mark.usefixtures('isolated_server')
+class TestRequestGC:
+
+    def test_gc_prunes_old_terminal_requests(self):
+        old = requests_lib.create('status', {}, requests_lib.SHORT)
+        requests_lib.set_result(old, {'ok': True})
+        fresh = requests_lib.create('status', {}, requests_lib.SHORT)
+        requests_lib.set_result(fresh, {'ok': True})
+        live = requests_lib.create('launch', {}, requests_lib.LONG)
+        # Log files exist for the old one.
+        with open(requests_lib.log_path(old), 'w') as f:
+            f.write('log')
+        # Age the old record.
+        import sqlite3, os
+        conn = sqlite3.connect(requests_lib._db_path())
+        conn.execute('UPDATE requests SET finished_at = ? WHERE request_id = ?',
+                     (time.time() - 100000, old))
+        conn.commit()
+        n = requests_lib.gc_requests(max_age_seconds=24 * 3600)
+        assert n == 1
+        assert requests_lib.get(old) is None
+        assert requests_lib.get(fresh) is not None
+        assert requests_lib.get(live) is not None       # non-terminal kept
+        assert not os.path.exists(requests_lib.log_path(old))

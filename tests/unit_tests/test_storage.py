@@ -1,0 +1,108 @@
+"""Storage modes on the local cloud + mount command builders.
+
+The load-bearing behavior is MOUNT_CACHED's exit flush barrier (reference:
+cloud_vm_ray_backend.py:763-790): a checkpoint written to a cached mount
+must be durable in the 'bucket' once the job reports SUCCEEDED — that is
+what makes managed-job recovery resume instead of restart.
+"""
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu.data import mounting_utils
+from skypilot_tpu.data.storage import Storage, StorageMode, StoreType
+from skypilot_tpu.utils.status_lib import JobStatus
+
+
+class TestCommandBuilders:
+
+    def test_gcsfuse_mount(self):
+        cmd = mounting_utils.gcsfuse_mount_command('gs://bkt/sub', '/data')
+        assert 'gcsfuse' in cmd and 'bkt' in cmd and '/data' in cmd
+        assert 'mountpoint -q' in cmd          # idempotent
+
+    def test_rclone_cached_mount_and_flush(self):
+        cmd = mounting_utils.rclone_mount_command('gs://bkt', '/out')
+        assert '--vfs-cache-mode writes' in cmd
+        assert '--log-file' in cmd     # the flush barrier greps this log
+        flush = mounting_utils.rclone_flush_command('/out')
+        # Drains by watching the 'vfs cache: cleaned' log line, NOT the
+        # cache dir (uploaded files linger there until vfs-cache-max-age).
+        assert 'vfs cache: cleaned' in flush
+        assert 'to upload 0, uploading 0' in flush
+
+    def test_storage_yaml_modes(self):
+        s = Storage.from_yaml_config({'source': 'gs://b',
+                                      'mode': 'mount_cached'})
+        assert s.mode is StorageMode.MOUNT_CACHED
+        assert s.store_type is StoreType.GCS
+        assert s.bucket_url() == 'gs://b'
+
+
+def _wait_job(cluster, job_id, timeout=60):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        status = sky.job_status(cluster, job_id)
+        if status is not None and status.is_terminal():
+            return status
+        time.sleep(0.5)
+    raise TimeoutError(f'job {job_id} not terminal')
+
+
+@pytest.mark.usefixtures('enable_local_cloud', 'isolated_state')
+class TestLocalStorageMounts:
+
+    def _launch(self, name, run, mounts):
+        task = sky.Task(name=name, run=run)
+        task.set_resources(sky.Resources(accelerators='tpu-v5e-8'))
+        task.storage_mounts = mounts
+        return sky.launch(task, cluster_name=name, detach_run=True)
+
+    def test_copy_mode(self, tmp_path):
+        src = tmp_path / 'bucket'
+        src.mkdir()
+        (src / 'data.txt').write_text('payload')
+        job_id, handle = self._launch(
+            't-copy', 'cat inputs/data.txt',
+            {'/inputs': {'source': str(src), 'mode': 'COPY'}})
+        try:
+            assert _wait_job('t-copy', job_id) == JobStatus.SUCCEEDED
+        finally:
+            sky.down('t-copy')
+
+    def test_mount_passthrough_writes(self, tmp_path):
+        """MOUNT: writes appear in the source immediately (FUSE analog)."""
+        src = tmp_path / 'bucket'
+        src.mkdir()
+        job_id, _ = self._launch(
+            't-mount', 'echo live > outputs/now.txt',
+            {'/outputs': {'source': str(src), 'mode': 'MOUNT'}})
+        try:
+            assert _wait_job('t-mount', job_id) == JobStatus.SUCCEEDED
+            assert (src / 'now.txt').read_text().strip() == 'live'
+        finally:
+            sky.down('t-mount')
+
+    def test_mount_cached_flush_barrier(self, tmp_path):
+        """MOUNT_CACHED: the write is NOT in the bucket while the job runs;
+        it IS there once the job is SUCCEEDED (the flush barrier ran)."""
+        src = tmp_path / 'bucket'
+        src.mkdir()
+        (src / 'step0.ckpt').write_text('initial')
+        job_id, _ = self._launch(
+            't-cached',
+            # Write the checkpoint, then linger so we can observe the
+            # pre-flush window.
+            'cat ckpts/step0.ckpt > /dev/null && '
+            'echo step100 > ckpts/step100.ckpt && sleep 3',
+            {'/ckpts': {'source': str(src), 'mode': 'MOUNT_CACHED'}})
+        try:
+            # While running: cached write is host-local only.
+            time.sleep(2.0)
+            assert not (src / 'step100.ckpt').exists()
+            assert _wait_job('t-cached', job_id) == JobStatus.SUCCEEDED
+            # After success: the barrier pushed it back to the bucket.
+            assert (src / 'step100.ckpt').read_text().strip() == 'step100'
+        finally:
+            sky.down('t-cached')
