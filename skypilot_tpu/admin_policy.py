@@ -1,0 +1,92 @@
+"""Pluggable org policy hook: mutate/validate every user request.
+
+Reference analog: sky/admin_policy.py (`UserRequest` → `MutatedUserRequest`
+through a deployment-configured policy class). Configured via
+`admin_policy: mypkg.mymodule.MyPolicy` in ~/.skytpu/config.yaml; applied
+at the entry of launch/exec/jobs-launch/serve-up, before the optimizer.
+
+Typical uses: force spot for cost control, pin regions for data residency,
+inject labels for billing attribution, reject oversized slices.
+
+Policies MUST be idempotent (same contract as the reference): recovery and
+replica relaunches re-enter execution.launch, so a policy may see a task it
+already mutated.
+"""
+from __future__ import annotations
+
+import dataclasses
+import importlib
+import typing
+from typing import Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import task as task_lib
+
+logger = sky_logging.init_logger(__name__)
+
+
+@dataclasses.dataclass
+class UserRequest:
+    """What the policy sees: the task plus the operation being requested."""
+    task: 'task_lib.Task'
+    operation: str                 # 'launch' | 'exec' | 'jobs.launch' | ...
+    cluster_name: Optional[str] = None
+    dryrun: bool = False
+
+
+@dataclasses.dataclass
+class MutatedUserRequest:
+    task: 'task_lib.Task'
+
+
+class AdminPolicy:
+    """Subclass and point `admin_policy:` config at it."""
+
+    def validate_and_mutate(self, request: UserRequest) -> MutatedUserRequest:
+        raise NotImplementedError
+
+
+class PolicyRejectedError(exceptions.SkyTpuError):
+    """Raised by policies to reject a request outright."""
+
+
+def _load_policy() -> Optional[AdminPolicy]:
+    from skypilot_tpu import config as config_lib
+    path = config_lib.get_nested(('admin_policy',), None)
+    if not path:
+        return None
+    module_name, _, cls_name = str(path).rpartition('.')
+    if not module_name:
+        raise ValueError(
+            f'admin_policy must be a full dotted path, got {path!r}')
+    try:
+        module = importlib.import_module(module_name)
+        cls = getattr(module, cls_name)
+    except (ImportError, AttributeError) as e:
+        raise ValueError(f'Cannot load admin_policy {path!r}: {e}') from e
+    policy = cls()
+    if not isinstance(policy, AdminPolicy):
+        raise ValueError(f'{path} is not an AdminPolicy subclass.')
+    return policy
+
+
+def apply(task: 'task_lib.Task', operation: str,
+          cluster_name: Optional[str] = None,
+          dryrun: bool = False) -> 'task_lib.Task':
+    """Run the configured policy (no-op when none is configured)."""
+    policy = _load_policy()
+    if policy is None:
+        return task
+    request = UserRequest(task=task, operation=operation,
+                          cluster_name=cluster_name, dryrun=dryrun)
+    mutated = policy.validate_and_mutate(request)
+    if not isinstance(mutated, MutatedUserRequest):
+        raise ValueError(
+            f'{type(policy).__name__}.validate_and_mutate must return a '
+            f'MutatedUserRequest, got {type(mutated).__name__}.')
+    logger.debug(f'admin policy {type(policy).__name__} applied to '
+                 f'{operation}.')
+    return mutated.task

@@ -37,7 +37,7 @@ if typing.TYPE_CHECKING:
 
 logger = sky_logging.init_logger(__name__)
 
-WORKDIR_NAME = 'skytpu_workdir'
+from skypilot_tpu.skylet.constants import WORKDIR_NAME  # noqa: E402
 
 
 class SliceResourceHandle:
@@ -256,10 +256,35 @@ class TpuSliceBackend(backend_lib.Backend[SliceResourceHandle]):
                          all_file_mounts: Optional[Dict[str, str]],
                          storage_mounts: Optional[Dict[str, Any]]) -> None:
         if all_file_mounts:
+            from skypilot_tpu import cloud_stores
+            from skypilot_tpu.data import storage as storage_lib
             runners = self._runners(handle)
             for dst, src in all_file_mounts.items():
+                store = cloud_stores.get_storage_from_path(src)
+                if store is not None:
+                    # URL source (gs://, s3://, https://): each host pulls
+                    # it directly — no control-plane round trip. On the
+                    # local fake cloud the path lands inside the host's
+                    # workdir, where the job's cwd is.
+                    def _fetch(runner: command_runner_lib.CommandRunner,
+                               store=store, src=src, dst=dst) -> None:
+                        resolved = storage_lib.resolve_local_dst(runner, dst)
+                        cmd = store.make_sync_command(src, resolved)
+                        rc = runner.run(cmd, log_path='/dev/null')
+                        if rc != 0:
+                            raise exceptions.StorageError(
+                                f'Failed to fetch file mount {dst} on '
+                                f'{runner.node_id}.')
+
+                    subprocess_utils.run_in_parallel(_fetch, runners)
+                    continue
+
                 def _sync(runner: command_runner_lib.CommandRunner,
                           dst=dst, src=src) -> None:
+                    if isinstance(runner,
+                                  command_runner_lib.LocalProcessCommandRunner):
+                        from skypilot_tpu.skylet import constants
+                        dst = f'{WORKDIR_NAME}/{constants.workdir_rel(dst)}'
                     runner.rsync(os.path.expanduser(src), dst, up=True)
 
                 subprocess_utils.run_in_parallel(_sync, runners)

@@ -1,0 +1,1 @@
+"""Catalog regeneration tools (reference analog: sky/catalog/data_fetchers/)."""

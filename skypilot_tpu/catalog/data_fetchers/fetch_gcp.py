@@ -1,0 +1,155 @@
+"""Regenerate tpu_catalog.csv from live GCP APIs.
+
+Reference analog: sky/catalog/data_fetchers/fetch_gcp.py — which scrapes
+the Cloud Billing Catalog for the TPU service (service id E000-3F24-B8AA,
+fetch_gcp.py:38) and hardcodes prices GCP hides (v3 pods, :50-58). Same
+sources here, emitting this framework's slice-first schema
+(generation,chips,topology,hosts,region,zone,price,spot_price).
+
+Run: python -m skypilot_tpu.catalog.data_fetchers.fetch_gcp \
+        [--output tpu_catalog.csv]
+Needs ADC credentials with cloudbilling + tpu API access; the seed CSV in
+catalog/data/ is the checked-in fallback so the framework works offline.
+"""
+from __future__ import annotations
+
+import argparse
+import collections
+import csv
+import re
+import sys
+from typing import Dict, Iterable, List, Tuple
+
+import requests
+
+from skypilot_tpu.adaptors import gcp as gcp_adaptor
+from skypilot_tpu.tpu import topology as topo_lib
+
+# Cloud Billing Catalog service id for Cloud TPU (fetch_gcp.py:38 analog).
+TPU_BILLING_SERVICE_ID = 'E000-3F24-B8AA'
+_BILLING_URL = (f'https://cloudbilling.googleapis.com/v1/services/'
+                f'{TPU_BILLING_SERVICE_ID}/skus')
+_TPU_LOCATIONS_URL = 'https://tpu.googleapis.com/v2/projects/{project}/locations'
+_TPU_TYPES_URL = ('https://tpu.googleapis.com/v2/projects/{project}/'
+                  'locations/{zone}/acceleratorTypes')
+
+_SKU_RE = re.compile(
+    r'Tpu[- ]?(?P<gen>v\d+[ep]?)\s*(?P<pod>pod)?', re.IGNORECASE)
+
+
+def _headers() -> Dict[str, str]:
+    return {'Authorization': f'Bearer {gcp_adaptor.get_access_token()}'}
+
+
+def _paged(url: str, item_key: str, params=None) -> Iterable[dict]:
+    token = None
+    while True:
+        p = dict(params or {})
+        if token:
+            p['pageToken'] = token
+        resp = requests.get(url, headers=_headers(), params=p, timeout=60)
+        resp.raise_for_status()
+        data = resp.json()
+        yield from data.get(item_key, [])
+        token = data.get('nextPageToken')
+        if not token:
+            return
+
+
+def fetch_hourly_prices() -> Dict[Tuple[str, str, bool], float]:
+    """{(generation, region, is_spot): $/chip-hour} from the billing SKUs."""
+    prices: Dict[Tuple[str, str, bool], float] = {}
+    for sku in _paged(_BILLING_URL, 'skus'):
+        desc = sku.get('description', '')
+        m = _SKU_RE.search(desc)
+        if not m:
+            continue
+        gen = m.group('gen').lower()
+        spot = 'preemptible' in desc.lower() or 'spot' in desc.lower()
+        for region in sku.get('serviceRegions', []):
+            for pricing in sku.get('pricingInfo', []):
+                expr = pricing.get('pricingExpression', {})
+                for rate in expr.get('tieredRates', []):
+                    unit = rate.get('unitPrice', {})
+                    dollars = (float(unit.get('units', 0)) +
+                               float(unit.get('nanos', 0)) / 1e9)
+                    if dollars > 0:
+                        prices[(gen, region, spot)] = dollars
+    return prices
+
+
+def fetch_zone_types(project: str) -> Dict[str, List[str]]:
+    """{zone: [acceleratorType, ...]} from the TPU locations API."""
+    out: Dict[str, List[str]] = collections.defaultdict(list)
+    url = _TPU_LOCATIONS_URL.format(project=project)
+    for loc in _paged(url, 'locations'):
+        zone = loc['locationId']
+        try:
+            types_url = _TPU_TYPES_URL.format(project=project, zone=zone)
+            for t in _paged(types_url, 'acceleratorTypes'):
+                out[zone].append(t['type'])
+        except requests.HTTPError:
+            continue
+    return dict(out)
+
+
+def build_rows(prices: Dict[Tuple[str, str, bool], float],
+               zone_types: Dict[str, List[str]]) -> List[dict]:
+    rows = []
+    for zone, types in sorted(zone_types.items()):
+        region = zone.rsplit('-', 1)[0]
+        for acc_type in sorted(set(types)):
+            # acc_type like 'v5litepod-16' / 'v4-8' — same grammar the
+            # user-facing names use, so one parser covers both.
+            try:
+                sl = topo_lib.parse_tpu_accelerator(acc_type)
+            except Exception:  # pylint: disable=broad-except
+                print(f'skip unknown accelerator type {acc_type!r}',
+                      file=sys.stderr)
+                continue
+            on_demand = prices.get((sl.generation, region, False))
+            spot = prices.get((sl.generation, region, True))
+            if on_demand is None:
+                continue
+            rows.append({
+                'generation': sl.generation,
+                'chips': sl.total_chips,
+                'topology': sl.topology_str,
+                'hosts': sl.total_hosts,
+                'region': region,
+                'zone': zone,
+                'price': round(on_demand * sl.total_chips, 2),
+                'spot_price': round((spot or on_demand * 0.4) *
+                                    sl.total_chips, 2),
+            })
+    return rows
+
+
+def write_csv(rows: List[dict], path: str) -> None:
+    fields = ['generation', 'chips', 'topology', 'hosts', 'region', 'zone',
+              'price', 'spot_price']
+    with open(path, 'w', newline='', encoding='utf-8') as f:
+        writer = csv.DictWriter(f, fieldnames=fields)
+        writer.writeheader()
+        writer.writerows(rows)
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(prog='fetch_gcp')
+    parser.add_argument('--output', default='tpu_catalog.csv')
+    parser.add_argument('--project', default=None)
+    args = parser.parse_args()
+    project = args.project or gcp_adaptor.get_project_id()
+    prices = fetch_hourly_prices()
+    zone_types = fetch_zone_types(project)
+    rows = build_rows(prices, zone_types)
+    if not rows:
+        print('No rows fetched; keeping the existing catalog.',
+              file=sys.stderr)
+        sys.exit(1)
+    write_csv(rows, args.output)
+    print(f'Wrote {len(rows)} rows to {args.output}')
+
+
+if __name__ == '__main__':
+    main()

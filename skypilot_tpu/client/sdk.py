@@ -153,6 +153,8 @@ def get(request_id: str, url: Optional[str] = None) -> Any:
                               headers=_headers(), timeout=300)
         if r.status_code == 404:
             raise ApiError(f'no request {request_id}')
+        if r.status_code != 200:
+            raise ApiError(f'get: HTTP {r.status_code}: {r.text}')
         rec = r.json()
         status = server_requests.RequestStatus(rec['status'])
         if status.is_terminal():
@@ -185,13 +187,18 @@ def api_cancel(request_id: str, url: Optional[str] = None) -> bool:
     r = requests_http.post(f'{url}/api/v1/request_cancel',
                            json={'request_id': request_id},
                            headers=_headers(), timeout=30)
+    if r.status_code != 200:
+        raise ApiError(f'cancel: HTTP {r.status_code}: {r.text}')
     return bool(r.json().get('cancelled'))
 
 
 def api_list_requests(url: Optional[str] = None) -> List[Dict[str, Any]]:
     url = url or api_server_url(required=True)
-    return requests_http.get(f'{url}/api/v1/requests',
-                             headers=_headers(), timeout=30).json()
+    r = requests_http.get(f'{url}/api/v1/requests', headers=_headers(),
+                          timeout=30)
+    if r.status_code != 200:
+        raise ApiError(f'requests: HTTP {r.status_code}: {r.text}')
+    return r.json()
 
 
 # ---------------------------------------------------------------------------

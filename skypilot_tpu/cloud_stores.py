@@ -1,0 +1,73 @@
+"""CloudStorage helpers: fetch a URL source onto cluster hosts.
+
+Reference analog: sky/cloud_stores.py (gsutil/aws-s3-cp/curl command
+builders used by file_mounts with bucket/URL sources). The seam is a
+command string executed on each host, so one implementation serves SSH and
+local clusters alike.
+"""
+from __future__ import annotations
+
+import shlex
+from typing import Optional
+
+from skypilot_tpu import exceptions
+
+
+class CloudStorage:
+    """Command builders for one URL scheme."""
+
+    def make_sync_command(self, source: str, destination: str) -> str:
+        """One command that works whether `source` is an object or a
+        prefix — string heuristics can't tell them apart, the storage
+        service can (the reference resolves this by listing; here the
+        object-copy attempt is the existence probe: it fails fast on a
+        prefix, and the dir sync fails fast on an object)."""
+        raise NotImplementedError
+
+
+class GcsCloudStorage(CloudStorage):
+
+    def make_sync_command(self, source: str, destination: str) -> str:
+        src = shlex.quote(source.rstrip('/'))
+        dst = shlex.quote(destination)
+        return (f'mkdir -p $(dirname {dst}) && '
+                f'(gsutil cp {src} {dst} 2>/dev/null || '
+                f'(mkdir -p {dst} && gsutil -m rsync -r {src} {dst}))')
+
+
+class S3CloudStorage(CloudStorage):
+
+    def make_sync_command(self, source: str, destination: str) -> str:
+        # cp first: `aws s3 sync` on an object key silently copies nothing,
+        # so it must be the fallback, never the probe.
+        src = shlex.quote(source.rstrip('/'))
+        dst = shlex.quote(destination)
+        return (f'mkdir -p $(dirname {dst}) && '
+                f'(aws s3 cp {src} {dst} 2>/dev/null || '
+                f'(mkdir -p {dst} && aws s3 sync {src} {dst}))')
+
+
+class HttpCloudStorage(CloudStorage):
+
+    def make_sync_command(self, source: str, destination: str) -> str:
+        dst = shlex.quote(destination)
+        return (f'mkdir -p $(dirname {dst}) && '
+                f'(command -v curl >/dev/null && '
+                f'curl -fsSL {shlex.quote(source)} -o {dst} || '
+                f'wget -q {shlex.quote(source)} -O {dst})')
+
+
+_REGISTRY = {
+    'gs://': GcsCloudStorage(),
+    's3://': S3CloudStorage(),
+    'http://': HttpCloudStorage(),
+    'https://': HttpCloudStorage(),
+}
+
+
+def get_storage_from_path(url: str) -> Optional[CloudStorage]:
+    """The CloudStorage for a URL, or None for plain local paths."""
+    for prefix, store in _REGISTRY.items():
+        if url.startswith(prefix):
+            return store
+    return None

@@ -1,0 +1,7 @@
+"""Web dashboard (reference analog: sky/dashboard — a Next.js SPA).
+
+Redesigned as a single static page + one read-only JSON endpoint served by
+the API server itself: the reference ships 2.1 MB of compiled JS to render
+four tables; a self-contained page with fetch()+setInterval renders the
+same live view with zero build step and zero dependencies.
+"""

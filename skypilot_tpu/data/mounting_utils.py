@@ -12,17 +12,15 @@ def gsutil_copy_command(bucket_url: str, dst: str) -> str:
             f'gsutil -m rsync -r {shlex.quote(bucket_url)} {dst_q}')
 
 
-def gcsfuse_mount_command(bucket_url: str, dst: str,
-                          cached: bool = False) -> str:
+def gcsfuse_mount_command(bucket_url: str, dst: str) -> str:
+    """MOUNT mode: plain gcsfuse passthrough (MOUNT_CACHED is rclone's
+    write-back cache below, not a gcsfuse flag)."""
     assert bucket_url.startswith('gs://'), bucket_url
     bucket = bucket_url[len('gs://'):].split('/')[0]
     dst_q = shlex.quote(dst)
-    flags = _GCSFUSE_FLAGS
-    if cached:
-        flags += ' --file-cache-max-size-mb 10240 --cache-dir /tmp/gcsfuse_cache'
     return (f'mkdir -p {dst_q} && '
             f'(mountpoint -q {dst_q} || '
-            f'gcsfuse {flags} {shlex.quote(bucket)} {dst_q})')
+            f'gcsfuse {_GCSFUSE_FLAGS} {shlex.quote(bucket)} {dst_q})')
 
 
 def fusermount_unmount_command(dst: str) -> str:
@@ -59,23 +57,28 @@ def rclone_mount_command(bucket_url: str, dst: str) -> str:
         f'{_RCLONE_LOG_DIR} && '
         f'(mountpoint -q {dst_q} || '
         f'rclone mount :gcs:{shlex.quote(remote)} {dst_q} --daemon -v '
-        f'--vfs-cache-mode writes '
+        f'--vfs-cache-mode writes --vfs-write-back 1s '
         f'--vfs-cache-poll-interval {_RCLONE_POLL_SECONDS}s '
         f'--cache-dir {_RCLONE_CACHE_DIR}/{_mount_tag(dst)} '
         f'--log-file {log} --gcs-env-auth)')
 
 
 def rclone_flush_command(dst: str, timeout_s: int = 600) -> str:
-    """Block until this mount's write-back queue drains: the latest
-    'vfs cache: cleaned:' log line must report nothing in use/uploading."""
+    """Block until this mount's write-back queue drains.
+
+    Only 'vfs cache: cleaned:' lines appended AFTER the barrier started
+    count — a pre-write all-zeros line must not let a just-written
+    checkpoint be declared durable (the 1s --vfs-write-back on the mount
+    bounds how long queueing of the final write can lag)."""
     log = f'{_RCLONE_LOG_DIR}/{_mount_tag(dst)}.log'
     return (
         f'sync; '
         f'if [ ! -f {log} ]; then exit 0; fi; '
+        f'start_line=$(wc -l < {log}); '
         f'deadline=$(( $(date +%s) + {timeout_s} )); '
-        f'sleep 1; '
         f'while true; do '
-        f'  tac {log} | grep -m1 "vfs cache: cleaned:" | '
+        f'  tail -n +$(( start_line + 1 )) {log} | '
+        f'    grep "vfs cache: cleaned:" | tail -n 1 | '
         f'    grep -q "in use 0, to upload 0, uploading 0" && exit 0; '
         f'  if [ $(date +%s) -gt $deadline ]; then '
         f'    echo "[flush] timed out draining write-back cache for '

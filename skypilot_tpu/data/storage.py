@@ -116,10 +116,11 @@ def resolve_local_dst(runner, dst: str) -> str:
     """On the local fake cloud, mount paths land inside the host's workdir
     so jobs reach them with the same relative paths they would use on a
     real VM's home-relative mounts."""
+    from skypilot_tpu.skylet import constants
     from skypilot_tpu.utils import command_runner as cr
     if isinstance(runner, cr.LocalProcessCommandRunner):
-        rel = dst.lstrip('/').replace('~/', '')
-        return os.path.join(runner.host_dir, 'skytpu_workdir', rel)
+        return os.path.join(runner.host_dir, constants.WORKDIR_NAME,
+                            constants.workdir_rel(dst))
     return dst
 
 
@@ -201,8 +202,9 @@ def flush_commands(handle: 'slice_backend.SliceResourceHandle',
         if local:
             # The job's cwd is the host workdir; mounts live under it
             # (resolve_local_dst), so the relative path works on any host.
-            rel = dst.lstrip('/').replace('~/', '')
-            cmd = flush_command_for(storage, rel, local=True)
+            from skypilot_tpu.skylet import constants
+            cmd = flush_command_for(storage, constants.workdir_rel(dst),
+                                    local=True)
         else:
             cmd = flush_command_for(storage, dst, local=False)
         if cmd is not None:

@@ -135,6 +135,10 @@ def _execute_inner(
     return job_id, handle
 
 
+from skypilot_tpu.usage import usage_lib
+
+
+@usage_lib.tracked('launch')
 def launch(
     entrypoint,
     cluster_name: Optional[str] = None,
@@ -162,6 +166,9 @@ def launch(
             'Multi-task DAG launch goes through the managed-jobs plane '
             '(skytpu jobs launch); `launch` takes a single task.')
     task = dag.tasks[0]
+    from skypilot_tpu import admin_policy
+    task = admin_policy.apply(task, 'launch', cluster_name=cluster_name,
+                              dryrun=dryrun)
     if task.service_spec is not None:
         # A `service:` section means replicas/autoscaling/LB — silently
         # launching one bare cluster would ignore all of it.
@@ -199,6 +206,9 @@ def exec(  # pylint: disable=redefined-builtin
     dag = _as_dag(entrypoint)
     assert len(dag.tasks) == 1
     task = dag.tasks[0]
+    from skypilot_tpu import admin_policy
+    task = admin_policy.apply(task, 'exec', cluster_name=cluster_name,
+                              dryrun=dryrun)
     record = global_state.get_cluster(cluster_name)
     if record is None:
         raise exceptions.ClusterDoesNotExist(

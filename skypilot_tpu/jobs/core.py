@@ -20,6 +20,10 @@ from skypilot_tpu.jobs import state
 logger = sky_logging.init_logger(__name__)
 
 
+from skypilot_tpu.usage import usage_lib
+
+
+@usage_lib.tracked('jobs.launch')
 def launch(entrypoint: Union[task_lib.Task, dag_lib.Dag],
            name: Optional[str] = None) -> int:
     """Submit a managed job; returns its managed-job id immediately.
@@ -35,6 +39,8 @@ def launch(entrypoint: Union[task_lib.Task, dag_lib.Dag],
     else:
         task = entrypoint
     task.validate()
+    from skypilot_tpu import admin_policy
+    task = admin_policy.apply(task, 'jobs.launch')
     # Fail fast on an unknown recovery strategy (before the controller is
     # off in its own process where the error is only visible in logs).
     recovery_strategy.StrategyExecutor.make('prevalidate', task, job_id=0)

@@ -51,6 +51,10 @@ def _spawn_controller(service_name: str) -> int:
     return proc.pid
 
 
+from skypilot_tpu.usage import usage_lib
+
+
+@usage_lib.tracked('serve.up')
 def up(task: task_lib.Task, service_name: Optional[str] = None,
        lb_port: Optional[int] = None) -> Dict[str, Any]:
     """Bring up a service; returns {name, endpoint} immediately (replicas
@@ -59,6 +63,8 @@ def up(task: task_lib.Task, service_name: Optional[str] = None,
         raise ValueError(
             "Task has no 'service:' section; add one (readiness_probe, "
             "replicas/replica_policy, ports) to serve it.")
+    from skypilot_tpu import admin_policy
+    task = admin_policy.apply(task, 'serve.up', cluster_name=service_name)
     spec = spec_lib.ServiceSpec.from_yaml_config(task.service_spec)
     name = service_name or task.name or 'service'
     existing = serve_state.get_service(name)

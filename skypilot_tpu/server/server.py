@@ -58,7 +58,11 @@ def _api_token() -> str:
 @web.middleware
 async def auth_middleware(request: web.Request, handler):
     token = request.app['api_token']
-    if token and request.path != '/api/v1/health':
+    # The HTML shell is public (it holds no data); its data endpoint and
+    # everything else stays behind the token (/dashboard?token=... wires
+    # the header in client-side).
+    open_paths = ('/api/v1/health', '/dashboard')
+    if token and request.path not in open_paths:
         import hmac
         got = request.headers.get('Authorization', '')
         if not hmac.compare_digest(got, f'Bearer {token}'):
@@ -171,6 +175,59 @@ async def metrics(request: web.Request) -> web.Response:
                         content_type='text/plain')
 
 
+async def dashboard_page(request: web.Request) -> web.Response:
+    del request
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), 'dashboard', 'index.html')
+    with open(path, 'r', encoding='utf-8') as f:
+        return web.Response(text=f.read(), content_type='text/html')
+
+
+async def dashboard_summary(request: web.Request) -> web.Response:
+    """Read-only snapshot for the dashboard: direct sqlite reads (fast, no
+    request queue round-trip)."""
+    del request
+    from skypilot_tpu import global_state
+    clusters = []
+    for r in global_state.get_clusters():
+        handle = r.get('handle') or {}
+        res = handle.get('launched_resources') or {}
+        clusters.append({
+            'name': r['name'],
+            'resources': res.get('accelerators', '-') + (
+                ' [spot]' if res.get('use_spot') else ''),
+            'cloud': handle.get('cloud', '-'),
+            'zone': handle.get('zone') or '-',
+            'status': r['status'].value,
+            'launched_at': r.get('launched_at'),
+        })
+    from skypilot_tpu.jobs import state as jobs_state
+    jobs = [{
+        'job_id': j['job_id'], 'name': j['name'],
+        'status': j['status'].value, 'cluster_name': j['cluster_name'],
+        'recovery_count': j['recovery_count'],
+        'submitted_at': j['submitted_at'],
+    } for j in jobs_state.get_jobs()[:50]]
+    from skypilot_tpu.serve import serve_state
+    services = []
+    for s in serve_state.get_services():
+        reps = serve_state.get_replicas(s['name'])
+        services.append({
+            'name': s['name'], 'status': s['status'].value,
+            'endpoint': f"http://127.0.0.1:{s['lb_port']}",
+            'ready_replicas': sum(
+                1 for r in reps
+                if r['status'] is serve_state.ReplicaStatus.READY),
+            'total_replicas': len(reps),
+        })
+    return _json({
+        'clusters': clusters,
+        'jobs': jobs,
+        'services': services,
+        'requests': requests_lib.list_requests(20),
+    })
+
+
 async def _gc_loop(app: web.Application) -> None:
     while True:
         try:
@@ -207,6 +264,8 @@ def build_app() -> web.Application:
     app.router.add_get('/api/v1/requests', list_requests)
     app.router.add_get('/api/v1/metrics', metrics)
     app.router.add_post('/api/v1/request_cancel', request_cancel)
+    app.router.add_get('/dashboard', dashboard_page)
+    app.router.add_get('/dashboard/api/summary', dashboard_summary)
     app.router.add_post('/api/v1/{name}', submit)
 
     async def _start_gc(app_):

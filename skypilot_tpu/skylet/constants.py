@@ -13,6 +13,17 @@ from typing import Dict, List
 
 SKYTPU_RUNTIME_DIR_ENV = 'SKYTPU_RUNTIME_DIR'
 DEFAULT_RUNTIME_DIR = '~/.skytpu_runtime'
+# Per-host job working directory (synced workdir lands here; jobs run with
+# this as cwd). Single source of truth — backend sync, storage mount
+# resolution and flush commands must all agree on it.
+WORKDIR_NAME = 'skytpu_workdir'
+
+
+def workdir_rel(dst: str) -> str:
+    """Mount/file destination → path relative to the job's workdir (the
+    local fake cloud maps cluster-absolute paths under each host's
+    workdir so jobs address them with the same relative paths)."""
+    return dst.lstrip('/').replace('~/', '')
 
 JOB_LOG_DIR = 'logs'            # under runtime dir: logs/<job_id>/
 JOBS_DB = 'jobs.db'

@@ -188,21 +188,41 @@ def run_gang(spec: Dict[str, Any]) -> int:
 
     if failed_rank is None:
         # Storage flush barrier (MOUNT_CACHED): run the epilogue on every
-        # host; a failed flush fails the job — a checkpoint that never
-        # reached the bucket must not look like a success.
+        # host in parallel (each flush may block minutes draining its
+        # write-back queue; serially that would multiply by host count).
+        # A failed flush fails the job — a checkpoint that never reached
+        # the bucket must not look like a success.
         epilogue_cmds: List[str] = spec.get('epilogue_cmds') or []
-        for cmd in epilogue_cmds:
-            for rank, host in enumerate(hosts):
-                full = _build_rank_command(host, cmd, {'SKYTPU_EPILOGUE': '1'})
-                proc = subprocess.run(full, stdout=subprocess.PIPE,
-                                      stderr=subprocess.STDOUT, text=True,
-                                      check=False)
-                if proc.returncode != 0:
+        if epilogue_cmds:
+            results: Dict[int, 'tuple[int, str]'] = {}
+
+            def _flush_host(rank: int, host: Dict[str, Any]) -> None:
+                for cmd in epilogue_cmds:
+                    full = _build_rank_command(host, cmd,
+                                               {'SKYTPU_EPILOGUE': '1'})
+                    proc = subprocess.run(full, stdout=subprocess.PIPE,
+                                          stderr=subprocess.STDOUT,
+                                          text=True, check=False)
+                    if proc.returncode != 0:
+                        results[rank] = (proc.returncode, proc.stdout)
+                        return
+                results[rank] = (0, '')
+
+            flush_threads = [
+                threading.Thread(target=_flush_host, args=(rank, host))
+                for rank, host in enumerate(hosts)
+            ]
+            for t in flush_threads:
+                t.start()
+            for t in flush_threads:
+                t.join()
+            for rank, (rc, out) in sorted(results.items()):
+                if rc != 0:
                     with open(agg_path, 'a', encoding='utf-8') as agg:
                         agg.write(f'[driver] flush barrier failed on rank '
-                                  f'{rank}: {proc.stdout}\n')
+                                  f'{rank}: {out}\n')
                     job_lib.set_status(job_id, JobStatus.FAILED)
-                    return proc.returncode
+                    return rc
         job_lib.set_status(job_id, JobStatus.SUCCEEDED)
         return 0
     job_lib.set_status(job_id, JobStatus.FAILED)

@@ -1,0 +1,5 @@
+"""Usage telemetry (reference analog: sky/usage/)."""
+from skypilot_tpu.usage.usage_lib import record_event
+from skypilot_tpu.usage.usage_lib import tracked
+
+__all__ = ['record_event', 'tracked']

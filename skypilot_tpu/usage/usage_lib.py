@@ -1,0 +1,121 @@
+"""Usage telemetry: local JSONL event log, optional remote shipping.
+
+Reference analog: sky/usage/usage_lib.py (events → Grafana Loki, heartbeat
+via a skylet event). Redesigned local-first: every tracked entrypoint
+appends one JSON line to ~/.skytpu/usage/events.jsonl (rotated by size);
+if SKYTPU_USAGE_ENDPOINT is set, events are also POSTed best-effort.
+Disable entirely with SKYTPU_DISABLE_USAGE=1.
+
+Privacy: events carry operation name, duration, outcome, resource *shape*
+(generation/chips/spot) and a stable anonymous user hash — never task
+commands, env values, or paths.
+"""
+from __future__ import annotations
+
+import contextlib
+import functools
+import json
+import os
+import time
+from typing import Any, Dict, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.utils import common_utils
+
+logger = sky_logging.init_logger(__name__)
+
+_MAX_LOG_BYTES = 8 * 1024 * 1024
+
+
+def _enabled() -> bool:
+    return os.environ.get('SKYTPU_DISABLE_USAGE', '0') != '1'
+
+
+def _log_path() -> str:
+    d = os.path.expanduser('~/.skytpu/usage')
+    os.makedirs(d, exist_ok=True)
+    return os.path.join(d, 'events.jsonl')
+
+
+def _rotate(path: str) -> None:
+    try:
+        if os.path.getsize(path) > _MAX_LOG_BYTES:
+            os.replace(path, path + '.1')
+    except OSError:
+        pass
+
+
+def resource_shape(task) -> Optional[Dict[str, Any]]:
+    """The privacy-safe slice of a task's resources."""
+    try:
+        res = task.resources_list()[0]
+        if res.tpu is None:
+            return None
+        return {
+            'generation': res.tpu.generation,
+            'chips': res.tpu.total_chips,
+            'num_slices': res.tpu.num_slices,
+            'spot': res.use_spot,
+        }
+    except Exception:  # pylint: disable=broad-except
+        return None
+
+
+def record_event(operation: str, *, duration_s: Optional[float] = None,
+                 outcome: str = 'ok', error_type: Optional[str] = None,
+                 resources: Optional[Dict[str, Any]] = None) -> None:
+    if not _enabled():
+        return
+    event = {
+        'ts': time.time(),
+        'op': operation,
+        'outcome': outcome,
+        'user': common_utils.get_user_hash(),
+    }
+    if duration_s is not None:
+        event['duration_s'] = round(duration_s, 3)
+    if error_type:
+        event['error'] = error_type
+    if resources:
+        event['resources'] = resources
+    try:
+        path = _log_path()
+        _rotate(path)
+        with open(path, 'a', encoding='utf-8') as f:
+            f.write(json.dumps(event) + '\n')
+    except OSError:
+        pass
+    endpoint = os.environ.get('SKYTPU_USAGE_ENDPOINT')
+    if endpoint:
+        with contextlib.suppress(Exception):
+            import requests
+            requests.post(endpoint, json=event, timeout=2)
+
+
+def tracked(operation: str):
+    """Decorator: time + outcome-class the wrapped entrypoint."""
+
+    def deco(fn):
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not _enabled():
+                return fn(*args, **kwargs)
+            t0 = time.time()
+            resources = None
+            if args:
+                resources = resource_shape(args[0])
+            try:
+                out = fn(*args, **kwargs)
+            except BaseException as e:
+                record_event(operation, duration_s=time.time() - t0,
+                             outcome='error', error_type=type(e).__name__,
+                             resources=resources)
+                raise
+            record_event(operation, duration_s=time.time() - t0,
+                         resources=resources)
+            return out
+
+        return wrapper
+
+    return deco

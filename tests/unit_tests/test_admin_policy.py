@@ -1,0 +1,68 @@
+"""Admin policy hook: mutation and rejection at every entry point.
+
+Reference analog: sky/admin_policy.py + tests of UserRequest mutation.
+"""
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import admin_policy
+from skypilot_tpu import config as config_lib
+
+
+class ForceSpotPolicy(admin_policy.AdminPolicy):
+    """Example org policy: all workloads run on spot."""
+
+    def validate_and_mutate(self, request):
+        task = request.task
+        res = [r.copy(use_spot=True) for r in task.resources_list()]
+        task.set_resources(res if len(res) > 1 else res[0])
+        return admin_policy.MutatedUserRequest(task=task)
+
+
+class RejectBigSlicesPolicy(admin_policy.AdminPolicy):
+
+    def validate_and_mutate(self, request):
+        for res in request.task.resources_list():
+            if res.tpu is not None and res.tpu.total_chips > 8:
+                raise admin_policy.PolicyRejectedError(
+                    f'{res.tpu.name}: slices over 8 chips need approval.')
+        return admin_policy.MutatedUserRequest(task=request.task)
+
+
+def _task():
+    task = sky.Task(name='t', run='echo hi')
+    task.set_resources(sky.Resources(accelerators='tpu-v5e-16'))
+    return task
+
+
+class TestAdminPolicy:
+
+    def test_no_policy_is_noop(self):
+        task = _task()
+        assert admin_policy.apply(task, 'launch') is task
+
+    def test_mutating_policy(self):
+        with config_lib.override(
+                {'admin_policy':
+                 f'{__name__}.ForceSpotPolicy'}):
+            task = admin_policy.apply(_task(), 'launch')
+        assert all(r.use_spot for r in task.resources_list())
+
+    def test_rejecting_policy(self):
+        with config_lib.override(
+                {'admin_policy': f'{__name__}.RejectBigSlicesPolicy'}):
+            with pytest.raises(admin_policy.PolicyRejectedError,
+                               match='need approval'):
+                admin_policy.apply(_task(), 'launch')
+
+    def test_bad_policy_path(self):
+        with config_lib.override({'admin_policy': 'nonexistent.mod.Cls'}):
+            with pytest.raises(ValueError, match='Cannot load'):
+                admin_policy.apply(_task(), 'launch')
+
+    def test_launch_applies_policy(self, enable_local_cloud, isolated_state):
+        """The hook is wired into execution.launch, not just importable."""
+        with config_lib.override(
+                {'admin_policy': f'{__name__}.RejectBigSlicesPolicy'}):
+            with pytest.raises(admin_policy.PolicyRejectedError):
+                sky.launch(_task(), cluster_name='t-policy', dryrun=True)

@@ -1,0 +1,116 @@
+"""cloud_stores URL fetches + usage telemetry.
+
+Reference analogs: sky/cloud_stores.py, sky/usage/usage_lib.py.
+"""
+import json
+import os
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import cloud_stores
+from skypilot_tpu.usage import usage_lib
+
+
+class TestCloudStores:
+
+    def test_scheme_dispatch(self):
+        assert isinstance(cloud_stores.get_storage_from_path('gs://b/x'),
+                          cloud_stores.GcsCloudStorage)
+        assert isinstance(cloud_stores.get_storage_from_path('s3://b/x'),
+                          cloud_stores.S3CloudStorage)
+        assert isinstance(
+            cloud_stores.get_storage_from_path('https://h/f.bin'),
+            cloud_stores.HttpCloudStorage)
+        assert cloud_stores.get_storage_from_path('/local/path') is None
+
+    def test_command_shapes(self):
+        gcs = cloud_stores.get_storage_from_path('gs://b/dir')
+        cmd = gcs.make_sync_command('gs://b/dir', '/data')
+        # Object-or-prefix agnostic: cp probe first, rsync fallback.
+        assert 'gsutil cp' in cmd and 'gsutil -m rsync -r' in cmd
+        s3 = cloud_stores.get_storage_from_path('s3://b/key')
+        cmd = s3.make_sync_command('s3://b/key', '/data')
+        assert cmd.index('aws s3 cp') < cmd.index('aws s3 sync')
+        http = cloud_stores.get_storage_from_path('https://h/f.bin')
+        cmd = http.make_sync_command('https://h/f.bin', '/data/f.bin')
+        assert 'curl' in cmd and 'wget' in cmd   # fallback chain
+
+    def test_url_file_mount_on_local_cluster(self, enable_local_cloud,
+                                             isolated_state, tmp_path,
+                                             monkeypatch):
+        """file_mounts with an https:// source runs the fetch command on
+        each host (served by a local HTTP server)."""
+        import functools
+        import http.server
+        import threading
+        src_dir = tmp_path / 'www'
+        src_dir.mkdir()
+        (src_dir / 'weights.bin').write_text('W' * 64)
+        handler = functools.partial(
+            http.server.SimpleHTTPRequestHandler, directory=str(src_dir))
+        httpd = http.server.HTTPServer(('127.0.0.1', 0), handler)
+        threading.Thread(target=httpd.serve_forever, daemon=True).start()
+        port = httpd.server_address[1]
+        try:
+            task = sky.Task(
+                name='urlmount',
+                run='test -s fetched/weights.bin && echo got-it')
+            task.set_resources(sky.Resources(accelerators='tpu-v5e-8'))
+            task.file_mounts = {
+                'fetched/weights.bin':
+                    f'http://127.0.0.1:{port}/weights.bin'}
+            job_id, handle = sky.launch(task, cluster_name='t-url',
+                                        detach_run=True)
+            import time
+            from skypilot_tpu.utils.status_lib import JobStatus
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                st = sky.job_status('t-url', job_id)
+                if st is not None and st.is_terminal():
+                    break
+                time.sleep(0.5)
+            assert st == JobStatus.SUCCEEDED
+        finally:
+            httpd.shutdown()
+            sky.down('t-url')
+
+
+class TestUsage:
+
+    def test_events_are_recorded_and_private(self, tmp_path, monkeypatch):
+        monkeypatch.setenv('HOME', str(tmp_path))
+        monkeypatch.delenv('SKYTPU_DISABLE_USAGE', raising=False)
+
+        @usage_lib.tracked('unit.op')
+        def op(task, fail=False):
+            if fail:
+                raise RuntimeError('boom secret-path=/home/me')
+            return 42
+
+        task = sky.Task(name='t', run='echo SECRET')
+        task.set_resources(sky.Resources(accelerators='tpu-v5e-16',
+                                         use_spot=True))
+        assert op(task) == 42
+        with pytest.raises(RuntimeError):
+            op(task, fail=True)
+
+        path = os.path.join(str(tmp_path), '.skytpu/usage/events.jsonl')
+        lines = [json.loads(l) for l in open(path)]
+        assert len(lines) == 2
+        ok, err = lines
+        assert ok['op'] == 'unit.op' and ok['outcome'] == 'ok'
+        assert ok['resources'] == {'generation': 'v5e', 'chips': 16,
+                                   'num_slices': 1, 'spot': True}
+        assert err['outcome'] == 'error'
+        assert err['error'] == 'RuntimeError'
+        # Privacy: no command text or error message content is recorded.
+        raw = open(path).read()
+        assert 'SECRET' not in raw and 'secret-path' not in raw
+
+    def test_disable_flag(self, tmp_path, monkeypatch):
+        monkeypatch.setenv('HOME', str(tmp_path))
+        monkeypatch.setenv('SKYTPU_DISABLE_USAGE', '1')
+        usage_lib.record_event('x')
+        assert not os.path.exists(
+            os.path.join(str(tmp_path), '.skytpu/usage/events.jsonl'))

@@ -84,6 +84,17 @@ class TestApiServer:
             assert r.status == 401
         _with_client(fn, token_env='sekrit', monkeypatch=monkeypatch)
 
+    def test_dashboard_page_and_summary(self):
+        async def fn(client):
+            r = await client.get('/dashboard')
+            assert r.status == 200
+            assert 'skytpu' in await r.text()
+            r = await client.get('/dashboard/api/summary')
+            assert r.status == 200
+            body = await r.json()
+            assert set(body) == {'clusters', 'jobs', 'services', 'requests'}
+        _with_client(fn)
+
     def test_metrics_exposition(self):
         requests_lib.create('launch', {}, requests_lib.LONG)
 
