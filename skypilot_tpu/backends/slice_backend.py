@@ -367,6 +367,23 @@ class TpuSliceBackend(backend_lib.Backend[SliceResourceHandle]):
                     'worker_id': inst.worker_id,
                     'workdir': os.path.join(host_dir, WORKDIR_NAME),
                 })
+            elif cluster_info.provider_name == 'kubernetes':
+                # Pods have no sshd: the head fans out via kubectl exec
+                # (the pod name IS the address; podIP only feeds the gang
+                # env for jax.distributed).
+                pc = cluster_info.provider_config or {}
+                hosts.append({
+                    'kind': 'k8s',
+                    'ip': inst.internal_ip,
+                    'slice_index': inst.slice_index,
+                    'worker_id': inst.worker_id,
+                    'workdir': f'/root/{WORKDIR_NAME}',
+                    'k8s': {
+                        'pod': inst.instance_id,
+                        'namespace': pc.get('namespace', 'default'),
+                        'context': pc.get('context'),
+                    },
+                })
             else:
                 hosts.append({
                     'kind': 'ssh',

@@ -9,4 +9,5 @@ from skypilot_tpu.clouds.cloud import (  # noqa: F401
     Zone,
 )
 from skypilot_tpu.clouds.gcp import GCP  # noqa: F401
+from skypilot_tpu.clouds.kubernetes import Kubernetes  # noqa: F401
 from skypilot_tpu.clouds.local import Local  # noqa: F401

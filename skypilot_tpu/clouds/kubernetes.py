@@ -1,0 +1,161 @@
+"""Kubernetes as a cloud: TPU slices as pod gangs on GKE node pools.
+
+Reference analog: sky/clouds/kubernetes.py (:1264) + GKE TPU detection
+(sky/clouds/utils/gcp_utils.py:43, provision/kubernetes/utils.py: label
+keys `cloud.google.com/gke-tpu-accelerator` / `gke-tpu-topology`, resource
+key `google.com/tpu`). Redesigned slice-first: one TPU slice = one gang of
+pods (one pod per TPU host) pinned to a matching GKE TPU node pool; the
+gang env (TPU_WORKER_ID / hostnames) comes from the same slice runtime as
+TPU VMs, so jobs cannot tell the difference.
+
+Feasibility is live, not catalog-based (reference kubernetes_catalog.py
+pattern): `kubectl get nodes` label introspection decides which slice
+shapes this cluster can host.
+"""
+from __future__ import annotations
+
+import typing
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+# generation -> GKE accelerator label value (cloud.google.com/gke-tpu-accelerator)
+GKE_TPU_ACCELERATOR = {
+    'v4': 'tpu-v4-podslice',
+    'v5e': 'tpu-v5-lite-podslice',
+    'v5p': 'tpu-v5p-slice',
+    'v6e': 'tpu-v6e-slice',
+}
+GENERATION_OF_GKE_ACCELERATOR = {v: k for k, v in GKE_TPU_ACCELERATOR.items()}
+
+TPU_LABEL_KEY = 'cloud.google.com/gke-tpu-accelerator'
+TPU_TOPOLOGY_LABEL_KEY = 'cloud.google.com/gke-tpu-topology'
+TPU_RESOURCE_KEY = 'google.com/tpu'
+
+KUBERNETES_REGION = 'kubernetes'
+
+
+@registry.CLOUD_REGISTRY.register(aliases=['k8s'])
+class Kubernetes(cloud_lib.Cloud):
+    """GKE TPU node pools behind the standard Cloud interface."""
+
+    _REPR = 'Kubernetes'
+
+    @classmethod
+    def unsupported_features(
+            cls, resources: 'resources_lib.Resources'
+    ) -> Dict[cloud_lib.CloudImplementationFeatures, str]:
+        return {
+            cloud_lib.CloudImplementationFeatures.STOP:
+                'pods are deleted, not stopped; re-launch to resume.',
+            cloud_lib.CloudImplementationFeatures.AUTOSTOP:
+                'use autodown (delete) — pods cannot stop.',
+        }
+
+    # ------------------------------------------------------------------
+    # Live cluster introspection (the "catalog")
+    # ------------------------------------------------------------------
+    @classmethod
+    def _tpu_node_pools(cls) -> List[Dict[str, Any]]:
+        """[{generation, topology, chips_per_node, count}] from node labels."""
+        from skypilot_tpu.provision.kubernetes import instance as k8s_instance
+        return k8s_instance.list_tpu_node_pools()
+
+    def _fits(self, sl, pools: List[Dict[str, Any]]) -> bool:
+        for pool in pools:
+            if (pool['generation'] == sl.generation and
+                    pool['topology'] == sl.topology_str and
+                    pool['count'] >= sl.num_hosts * sl.num_slices):
+                return True
+        return False
+
+    def regions_with_offering(self, resources: 'resources_lib.Resources'
+                              ) -> List[cloud_lib.Region]:
+        sl = resources.tpu
+        if sl is None:
+            return []
+        if resources.region not in (None, KUBERNETES_REGION):
+            return []
+        try:
+            pools = self._tpu_node_pools()
+        except Exception:  # pylint: disable=broad-except
+            return []
+        if not self._fits(sl, pools):
+            return []
+        return [cloud_lib.Region(KUBERNETES_REGION,
+                                 (cloud_lib.Zone(KUBERNETES_REGION),))]
+
+    def zones_provision_loop(
+            self, *, region: str, resources: 'resources_lib.Resources'
+    ) -> Iterator[List[cloud_lib.Zone]]:
+        del region, resources
+        yield [cloud_lib.Zone(KUBERNETES_REGION)]
+
+    def get_feasible_launchable_resources(
+            self, resources: 'resources_lib.Resources'
+    ) -> Tuple[List['resources_lib.Resources'], List[str]]:
+        sl = resources.tpu
+        if sl is None:
+            return [], []
+        if resources.region not in (None, KUBERNETES_REGION):
+            return [], []
+        try:
+            pools = self._tpu_node_pools()
+        except Exception as e:  # pylint: disable=broad-except
+            return [], [f'kubernetes: {e}']
+        if not self._fits(sl, pools):
+            have = {f"{p['generation']}:{p['topology']}x{p['count']}"
+                    for p in pools}
+            return [], [f'kubernetes: no TPU node pool fits '
+                        f'{sl.name} (have: {sorted(have) or "none"})']
+        return [resources.copy(cloud=self, region=KUBERNETES_REGION)], []
+
+    def hourly_cost(self, resources: 'resources_lib.Resources') -> float:
+        # In-cluster capacity is sunk cost; report 0 so the optimizer
+        # prefers an existing cluster over provisioning cloud slices
+        # (reference models k8s as free for the same reason).
+        del resources
+        return 0.0
+
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources', region: str,
+            zones: Optional[List[str]], cluster_name: str) -> Dict[str, Any]:
+        sl = resources.tpu
+        assert sl is not None
+        from skypilot_tpu import config as config_lib
+        return {
+            'cloud': 'kubernetes',
+            'namespace': config_lib.get_nested(
+                ('kubernetes', 'namespace'), 'default'),
+            'context': config_lib.get_nested(
+                ('kubernetes', 'context'), None),
+            'image': config_lib.get_nested(
+                ('kubernetes', 'image'),
+                'python:3.11-slim'),
+            'tpu_generation': sl.generation,
+            'gke_accelerator': GKE_TPU_ACCELERATOR[sl.generation],
+            'topology': sl.topology_str,
+            'num_hosts': sl.num_hosts,
+            'num_slices': sl.num_slices,
+            'chips_per_host': sl.chips_per_host,
+            'cluster_name': cluster_name,
+        }
+
+    def validate_region_zone(self, region: Optional[str],
+                             zone: Optional[str]
+                             ) -> Tuple[Optional[str], Optional[str]]:
+        for val in (region, zone):
+            if val is not None and val != KUBERNETES_REGION:
+                raise ValueError(
+                    f'Kubernetes has a single pseudo-region '
+                    f'{KUBERNETES_REGION!r}; got {val!r}.')
+        return region, zone
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        from skypilot_tpu.provision.kubernetes import instance as k8s_instance
+        return k8s_instance.check_credentials()

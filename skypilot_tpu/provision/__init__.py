@@ -22,7 +22,7 @@ ProvisionRecord = common.ProvisionRecord
 ClusterInfo = common.ClusterInfo
 InstanceInfo = common.InstanceInfo
 
-_SUPPORTED_CLOUDS = ('gcp', 'local')
+_SUPPORTED_CLOUDS = ('gcp', 'local', 'kubernetes')
 
 
 def _route_to_cloud_impl(fn):
@@ -52,7 +52,8 @@ def run_instances(region: str, zone: str, cluster_name: str,
 
 @_route_to_cloud_impl
 def wait_instances(region: str, cluster_name: str,
-                   state: Optional[str] = None) -> None:
+                   state: Optional[str] = None,
+                   provider_config: Optional[Dict[str, Any]] = None) -> None:
     """Block until all slice hosts reach `state` (default: running)."""
     raise AssertionError('dispatched')
 

@@ -171,10 +171,11 @@ def _locate(
 
 
 def wait_instances(region: str, cluster_name: str,
-                   state: Optional[str] = None) -> None:
+                   state: Optional[str] = None,
+                   provider_config=None) -> None:
     # Node create/start operations are waited on synchronously in
     # run_instances; nothing further to poll.
-    del region, cluster_name, state
+    del region, cluster_name, state, provider_config
 
 
 def stop_instances(region: str, cluster_name: str,

@@ -1,0 +1,1 @@
+"""Kubernetes provisioner (reference analog: sky/provision/kubernetes/)."""

@@ -101,8 +101,9 @@ def run_instances(region: str, zone: str, cluster_name: str,
 
 
 def wait_instances(region: str, cluster_name: str,
-                   state: Optional[str] = None) -> None:
-    del region
+                   state: Optional[str] = None,
+                   provider_config=None) -> None:
+    del region, provider_config
     meta = _load_meta(cluster_name)
     want = state or _STATUS_RUNNING
     if meta is None or meta.get('status') != want:

@@ -65,7 +65,8 @@ def bulk_provision(
                         f'in {zone}...')
             record = provision.run_instances(cloud_name, region, zone,
                                              cluster_name, config)
-            provision.wait_instances(cloud_name, region, cluster_name)
+            provision.wait_instances(cloud_name, region, cluster_name,
+                                     provider_config=deploy_vars)
             if ports_to_open:
                 provision.open_ports(cloud_name, region, cluster_name,
                                      ports_to_open)
@@ -98,6 +99,13 @@ def get_command_runners(
                 command_runner_lib.LocalProcessCommandRunner(
                     inst.instance_id,
                     cluster_info.host_dirs[inst.instance_id]))
+        elif cluster_info.provider_name == 'kubernetes':
+            pc = cluster_info.provider_config or {}
+            runners.append(
+                command_runner_lib.KubernetesCommandRunner(
+                    inst.instance_id, pod_name=inst.instance_id,
+                    namespace=pc.get('namespace', 'default'),
+                    context=pc.get('context')))
         else:
             from skypilot_tpu import authentication
             runners.append(

@@ -52,6 +52,16 @@ def _build_rank_command(host: Dict[str, Any], run_cmd: str,
     inner = f'{exports} cd {shlex.quote(host.get("workdir", "~"))} 2>/dev/null; {run_cmd}'
     if host['kind'] == 'local':
         return ['bash', '-c', inner]
+    if host['kind'] == 'k8s':
+        # kubectl exec from the head pod (in-cluster service account) or
+        # wherever the driver runs with a kubeconfig.
+        k8s = host['k8s']
+        cmd = ['kubectl']
+        if k8s.get('context'):
+            cmd += ['--context', k8s['context']]
+        cmd += ['-n', k8s.get('namespace', 'default'),
+                'exec', k8s['pod'], '--', '/bin/sh', '-c', inner]
+        return cmd
     assert host['kind'] == 'ssh', host
     ssh = host['ssh']
     from skypilot_tpu.utils import command_runner
@@ -67,6 +77,15 @@ def _build_rank_command(host: Dict[str, Any], run_cmd: str,
 
 def _remote_cleanup_cmd(host: Dict[str, Any], job_id: int) -> Optional[List[str]]:
     """Best-effort remote kill of a rank's process tree (no-TTY fallback)."""
+    if host.get('kind') == 'k8s':
+        k8s = host['k8s']
+        cmd = ['kubectl']
+        if k8s.get('context'):
+            cmd += ['--context', k8s['context']]
+        cmd += ['-n', k8s.get('namespace', 'default'), 'exec', k8s['pod'],
+                '--', '/bin/sh', '-c',
+                f'pkill -TERM -f "SKYTPU_JOB_ID={job_id};" || true']
+        return cmd
     if host.get('kind') != 'ssh':
         return None
     ssh = host['ssh']

@@ -176,6 +176,80 @@ class LocalProcessCommandRunner(CommandRunner):
         _python_copy(src, dst, excludes)
 
 
+class KubernetesCommandRunner(CommandRunner):
+    """kubectl exec/cp transport to a pod (reference analog
+    KubernetesCommandRunner:909)."""
+
+    def __init__(self, node_id: str, pod_name: str,
+                 namespace: str = 'default',
+                 context: Optional[str] = None):
+        super().__init__(node_id)
+        self.pod_name = pod_name
+        self.namespace = namespace
+        self.context = context
+
+    # Pods run as root (the default images used by the k8s cloud); kubectl
+    # cp/exec never expand '~', so remote paths resolve against this HOME.
+    REMOTE_HOME = '/root'
+
+    def _base(self) -> List[str]:
+        cmd = ['kubectl']
+        if self.context:
+            cmd += ['--context', self.context]
+        cmd += ['-n', self.namespace]
+        return cmd
+
+    @classmethod
+    def _remote_path(cls, path: str) -> str:
+        """'~/x' and bare-relative paths → under the pod's HOME (kubectl
+        treats '~' literally and relative paths against the container cwd,
+        which is rarely HOME)."""
+        if path == '~':
+            return cls.REMOTE_HOME
+        if path.startswith('~/'):
+            return cls.REMOTE_HOME + path[1:]
+        if not path.startswith('/'):
+            return f'{cls.REMOTE_HOME}/{path}'
+        return path
+
+    def run(self, cmd, *, env=None, log_path='/dev/null', stream_logs=False,
+            require_outputs=False, cwd=None, detach=False):
+        if isinstance(cmd, list):
+            cmd = ' '.join(shlex.quote(c) for c in cmd)
+        prefix = f'cd {self.REMOTE_HOME}; ' + self._env_prefix(env)
+        if cwd:
+            prefix += f'cd {shlex.quote(cwd)}; '
+        inner = prefix + cmd
+        if detach:
+            inner = (f'nohup sh -c {shlex.quote(inner)} '
+                     f'>/tmp/skytpu_detach.log 2>&1 & echo $!')
+        full = self._base() + ['exec', self.pod_name, '--', '/bin/sh',
+                               '-c', inner]
+        return subprocess_utils.run_with_log(
+            full, log_path, stream_logs=stream_logs,
+            require_outputs=require_outputs, shell=False)
+
+    def rsync(self, source: str, target: str, *, up: bool,
+              excludes: Optional[List[str]] = None) -> None:
+        del excludes   # kubectl cp has no exclude support
+        pod = f'{self.namespace}/{self.pod_name}'
+        if up:
+            remote = self._remote_path(target)
+            # kubectl cp does not create parent dirs.
+            self.run(f'mkdir -p {shlex.quote(os.path.dirname(remote) or "/")}',
+                     log_path='/dev/null')
+            args = [os.path.expanduser(source), f'{pod}:{remote}']
+        else:
+            args = [f'{pod}:{self._remote_path(source)}',
+                    os.path.expanduser(target)]
+        full = self._base() + ['cp'] + args
+        proc = subprocess.run(full, capture_output=True, text=True,
+                              check=False)
+        if proc.returncode != 0:
+            raise exceptions.CommandError(proc.returncode, ' '.join(full),
+                                          proc.stderr)
+
+
 class SSHCommandRunner(CommandRunner):
     """SSH/rsync to a real slice host (reference analog SSHCommandRunner:599)."""
 

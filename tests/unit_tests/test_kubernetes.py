@@ -1,0 +1,217 @@
+"""Kubernetes cloud (GKE TPU) against an in-memory fake kubectl.
+
+Reference analog: the mocked k8s label detectors in the reference's
+enable_all_clouds fixture (tests/common_test_fixtures.py) + GKE TPU labels
+(provision/kubernetes/utils.py: gke-tpu-accelerator/topology,
+google.com/tpu).
+"""
+import json
+
+import pytest
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu.clouds import kubernetes as k8s_cloud
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision.kubernetes import instance as k8s_instance
+
+
+class FakeKubectl:
+    """In-memory cluster: nodes with TPU labels + a pod table."""
+
+    def __init__(self, nodes=None):
+        self.nodes = nodes or []
+        self.pods = {}
+        self.fail_apply_after = None   # int → fail the Nth apply
+        self._applies = 0
+        self.schedulable = True
+
+    def node(self, gen, topo, chips=4):
+        acc = k8s_cloud.GKE_TPU_ACCELERATOR[gen]
+        self.nodes.append({
+            'metadata': {'labels': {
+                k8s_cloud.TPU_LABEL_KEY: acc,
+                k8s_cloud.TPU_TOPOLOGY_LABEL_KEY: topo,
+            }},
+            'status': {'allocatable': {k8s_cloud.TPU_RESOURCE_KEY:
+                                       str(chips)}},
+        })
+        return self
+
+    def __call__(self, args, *, context=None, namespace=None,
+                 input_json=None, timeout=60):
+        if args[:2] == ['config', 'current-context']:
+            return 'fake-context\n'
+        if args[:2] == ['get', 'nodes']:
+            return json.dumps({'items': self.nodes})
+        if args[:2] == ['get', 'pods']:
+            selector = args[args.index('-l') + 1]
+            cluster = selector.split('=', 1)[1]
+            items = [p for p in self.pods.values()
+                     if p['metadata']['labels'].get('skytpu-cluster') ==
+                     cluster]
+            return json.dumps({'items': items})
+        if args[:2] == ['apply', '-f']:
+            self._applies += 1
+            if (self.fail_apply_after is not None and
+                    self._applies > self.fail_apply_after):
+                raise exceptions.InsufficientCapacityError(
+                    '0/4 nodes available: Insufficient google.com/tpu')
+            pod = dict(input_json)
+            pod.setdefault('status', {'phase': 'Running',
+                                      'podIP': '10.8.0.%d' % self._applies})
+            self.pods[pod['metadata']['name']] = pod
+            return '{}'
+        if args[0] == 'delete' and 'pod' in args[1]:
+            if args[1] == 'pods':   # by selector
+                selector = args[args.index('-l') + 1]
+                cluster = selector.split('=', 1)[1]
+                self.pods = {
+                    n: p for n, p in self.pods.items()
+                    if p['metadata']['labels'].get('skytpu-cluster') !=
+                    cluster}
+            else:
+                self.pods.pop(args[2] if args[1] == 'pod' else args[1], None)
+            return '{}'
+        raise AssertionError(f'fake kubectl: unhandled {args}')
+
+
+@pytest.fixture
+def fake_k8s(monkeypatch):
+    fake = FakeKubectl()
+    monkeypatch.setattr(k8s_instance, '_kubectl', fake)
+    yield fake
+
+
+def _config(num_hosts=4, num_slices=1, gen='v5e', topo='4x4'):
+    return provision_common.ProvisionConfig(
+        provider_config={
+            'namespace': 'default', 'context': None,
+            'gke_accelerator': k8s_cloud.GKE_TPU_ACCELERATOR[gen],
+            'topology': topo, 'tpu_generation': gen,
+            'num_hosts': num_hosts, 'num_slices': num_slices,
+            'chips_per_host': 4,
+        },
+        authentication_config={}, count=num_slices, tags={})
+
+
+class TestKubernetesCloud:
+
+    def test_node_pool_introspection(self, fake_k8s):
+        fake_k8s.node('v5e', '4x4').node('v5e', '4x4').node('v4', '2x2x2')
+        pools = k8s_instance.list_tpu_node_pools()
+        by_key = {(p['generation'], p['topology']): p for p in pools}
+        assert by_key[('v5e', '4x4')]['count'] == 2
+        assert by_key[('v4', '2x2x2')]['count'] == 1
+
+    def test_feasibility(self, fake_k8s):
+        for _ in range(4):
+            fake_k8s.node('v5e', '4x4')
+        cloud = k8s_cloud.Kubernetes()
+        # v5e-16 topology 4x4 = 4 hosts → fits the 4-node pool.
+        ok = resources_lib.Resources(accelerators='tpu-v5e-16')
+        feasible, _ = cloud.get_feasible_launchable_resources(ok)
+        assert len(feasible) == 1
+        assert feasible[0].region == k8s_cloud.KUBERNETES_REGION
+        # v5e-32 needs 8 hosts → no pool fits; reason names the gap.
+        big = resources_lib.Resources(accelerators='tpu-v5e-32')
+        feasible, hints = cloud.get_feasible_launchable_resources(big)
+        assert feasible == []
+        assert any('no TPU node pool fits' in h for h in hints)
+
+    def test_gang_provision_and_info(self, fake_k8s):
+        record = k8s_instance.run_instances(
+            'kubernetes', 'kubernetes', 'train', _config(num_hosts=4))
+        assert len(record.created_instance_ids) == 4
+        pod = fake_k8s.pods['train-s0-w0']
+        sel = pod['spec']['nodeSelector']
+        assert sel[k8s_cloud.TPU_LABEL_KEY] == 'tpu-v5-lite-podslice'
+        assert sel[k8s_cloud.TPU_TOPOLOGY_LABEL_KEY] == '4x4'
+        req = pod['spec']['containers'][0]['resources']['requests']
+        assert req[k8s_cloud.TPU_RESOURCE_KEY] == '4'
+
+        k8s_instance.wait_instances('kubernetes', 'train',
+                                    provider_config=_config().provider_config)
+        statuses = k8s_instance.query_instances(
+            'kubernetes', 'train', _config().provider_config)
+        assert set(statuses.values()) == {'running'}
+        info = k8s_instance.get_cluster_info(
+            'kubernetes', 'train', _config().provider_config)
+        order = [(i.slice_index, i.worker_id)
+                 for i in info.ordered_instances()]
+        assert order == [(0, 0), (0, 1), (0, 2), (0, 3)]
+        assert info.head_instance_id == 'train-s0-w0'
+
+    def test_partial_gang_is_rolled_back(self, fake_k8s):
+        fake_k8s.fail_apply_after = 2
+        with pytest.raises(exceptions.InsufficientCapacityError):
+            k8s_instance.run_instances('kubernetes', 'kubernetes', 'gang',
+                                       _config(num_hosts=4))
+        # Atomicity: the 2 successfully-created pods were deleted again.
+        assert not [p for p in fake_k8s.pods
+                    if p.startswith('gang-')]
+
+    def test_terminate_by_label(self, fake_k8s):
+        k8s_instance.run_instances('kubernetes', 'kubernetes', 'bye',
+                                   _config(num_hosts=2))
+        k8s_instance.run_instances('kubernetes', 'kubernetes', 'keep',
+                                   _config(num_hosts=2))
+        k8s_instance.terminate_instances('kubernetes', 'bye',
+                                         _config().provider_config)
+        assert not [p for p in fake_k8s.pods if p.startswith('bye-')]
+        assert len([p for p in fake_k8s.pods if p.startswith('keep-')]) == 2
+
+    def test_unschedulable_is_stockout_after_grace(self, fake_k8s,
+                                                   monkeypatch):
+        k8s_instance.run_instances('kubernetes', 'kubernetes', 'stuck',
+                                   _config(num_hosts=1))
+        pod = fake_k8s.pods['stuck-s0-w0']
+        pod['status'] = {'phase': 'Pending', 'conditions': [{
+            'type': 'PodScheduled', 'status': 'False',
+            'reason': 'Unschedulable',
+            'message': '0/4 nodes have enough google.com/tpu',
+        }]}
+        # Grace 0 → classified immediately (with grace it would keep
+        # polling, giving autoscaling node pools time to scale up).
+        monkeypatch.setattr(k8s_instance,
+                            '_UNSCHEDULABLE_GRACE_SECONDS', 0)
+        with pytest.raises(exceptions.InsufficientCapacityError,
+                           match='google.com/tpu'):
+            k8s_instance.wait_instances(
+                'kubernetes', 'stuck',
+                provider_config=_config().provider_config)
+
+    def test_dead_pod_is_recreated_on_relaunch(self, fake_k8s):
+        k8s_instance.run_instances('kubernetes', 'kubernetes', 'c1',
+                                   _config(num_hosts=1))
+        fake_k8s.pods['c1-s0-w0']['status'] = {'phase': 'Failed'}
+        record = k8s_instance.run_instances('kubernetes', 'kubernetes',
+                                            'c1', _config(num_hosts=1))
+        assert record.created_instance_ids == ['c1-s0-w0']
+        assert fake_k8s.pods['c1-s0-w0']['status']['phase'] == 'Running'
+
+    def test_k8s_runner_remote_paths(self):
+        from skypilot_tpu.utils import command_runner
+        r = command_runner.KubernetesCommandRunner
+        assert r._remote_path('~/skytpu_pkg') == '/root/skytpu_pkg'
+        assert r._remote_path('skytpu_workdir/') == '/root/skytpu_workdir/'
+        assert r._remote_path('/abs/path') == '/abs/path'
+
+    def test_job_spec_uses_k8s_kind(self, fake_k8s):
+        """The gang driver must address pods via kubectl exec, not ssh
+        (pods have no sshd)."""
+        k8s_instance.run_instances('kubernetes', 'kubernetes', 'spec',
+                                   _config(num_hosts=2))
+        info = k8s_instance.get_cluster_info(
+            'kubernetes', 'spec', _config().provider_config)
+        from skypilot_tpu.skylet import slice_driver
+        host = {
+            'kind': 'k8s', 'ip': '10.8.0.1', 'slice_index': 0,
+            'worker_id': 0, 'workdir': '/root/skytpu_workdir',
+            'k8s': {'pod': 'spec-s0-w0', 'namespace': 'default',
+                    'context': None},
+        }
+        cmd = slice_driver._build_rank_command(host, 'echo hi', {'A': '1'})
+        assert cmd[:1] == ['kubectl']
+        assert 'exec' in cmd and 'spec-s0-w0' in cmd
+        assert info.provider_name == 'kubernetes'
