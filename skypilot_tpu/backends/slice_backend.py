@@ -368,22 +368,29 @@ class TpuSliceBackend(backend_lib.Backend[SliceResourceHandle]):
                     'workdir': os.path.join(host_dir, WORKDIR_NAME),
                 })
             elif cluster_info.provider_name == 'kubernetes':
-                # Pods have no sshd: the head fans out via kubectl exec
-                # (the pod name IS the address; podIP only feeds the gang
-                # env for jax.distributed).
+                # Pods have no sshd. The driver runs ON the head pod: its
+                # own rank is a plain local process (no kubectl needed —
+                # covers every single-host slice with the stock image);
+                # peer pods are reached via in-cluster kubectl exec, which
+                # requires the image to ship kubectl and the pod's service
+                # account to grant pods/exec (documented multi-host
+                # requirement). No --context: client-side kubeconfig
+                # context names mean nothing inside the cluster.
                 pc = cluster_info.provider_config or {}
-                hosts.append({
-                    'kind': 'k8s',
+                is_head = (inst.slice_index == 0 and inst.worker_id == 0)
+                host: Dict[str, Any] = {
+                    'kind': 'local' if is_head else 'k8s',
                     'ip': inst.internal_ip,
                     'slice_index': inst.slice_index,
                     'worker_id': inst.worker_id,
                     'workdir': f'/root/{WORKDIR_NAME}',
-                    'k8s': {
+                }
+                if not is_head:
+                    host['k8s'] = {
                         'pod': inst.instance_id,
                         'namespace': pc.get('namespace', 'default'),
-                        'context': pc.get('context'),
-                    },
-                })
+                    }
+                hosts.append(host)
             else:
                 hosts.append({
                     'kind': 'ssh',

@@ -296,11 +296,36 @@ def jobs():
 @_resource_options
 def jobs_launch(entrypoint: str, name: Optional[str], env: Tuple[str, ...],
                 detach_run: bool, **overrides):
-    """Submit a managed job (controller handles recovery)."""
+    """Submit a managed job — single task, or a multi-document YAML
+    pipeline (stages run in order, one recovery-managed job)."""
     from skypilot_tpu import jobs as jobs_lib
-    task = _load_task(entrypoint, env, overrides)
+    entry = None
+    if entrypoint.endswith(('.yaml', '.yml')) and os.path.exists(entrypoint):
+        with open(entrypoint, 'r', encoding='utf-8') as f:
+            is_pipeline = f.read().count('\n---') > 0
+        if is_pipeline:
+            from skypilot_tpu import dag as dag_lib
+            env_overrides = {}
+            for item in env:
+                if '=' not in item:
+                    raise click.UsageError(
+                        f'--env expects KEY=VALUE, got {item!r}')
+                k, v = item.split('=', 1)
+                env_overrides[k] = v
+            try:
+                entry = dag_lib.load_chain_dag_from_yaml(
+                    entrypoint, env_overrides or None)
+                active = {k: v for k, v in overrides.items()
+                          if v is not None}
+                if active:
+                    for t in entry.tasks:
+                        t.set_resources_override(active)
+            except (exceptions.SkyTpuError, ValueError) as e:
+                raise click.ClickException(str(e)) from e
+    if entry is None:
+        entry = _load_task(entrypoint, env, overrides)
     try:
-        job_id = jobs_lib.launch(task, name=name)
+        job_id = jobs_lib.launch(entry, name=name)
     except (exceptions.SkyTpuError, ValueError) as e:
         raise click.ClickException(str(e)) from e
     click.echo(f'Managed job {job_id} submitted.')

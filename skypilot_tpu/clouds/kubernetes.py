@@ -54,16 +54,27 @@ class Kubernetes(cloud_lib.Cloud):
                 'pods are deleted, not stopped; re-launch to resume.',
             cloud_lib.CloudImplementationFeatures.AUTOSTOP:
                 'use autodown (delete) — pods cannot stop.',
+            cloud_lib.CloudImplementationFeatures.OPEN_PORTS:
+                'external exposure needs a Service/Ingress; not wired yet.',
         }
 
     # ------------------------------------------------------------------
     # Live cluster introspection (the "catalog")
     # ------------------------------------------------------------------
     @classmethod
+    def _configured_context(cls) -> Optional[str]:
+        from skypilot_tpu import config as config_lib
+        return config_lib.get_nested(('kubernetes', 'context'), None)
+
+    @classmethod
     def _tpu_node_pools(cls) -> List[Dict[str, Any]]:
-        """[{generation, topology, chips_per_node, count}] from node labels."""
+        """[{generation, topology, chips_per_node, count}] from node labels.
+
+        Uses the CONFIGURED context — feasibility must look at the same
+        cluster provisioning will target, not whatever the kubeconfig's
+        current context happens to be."""
         from skypilot_tpu.provision.kubernetes import instance as k8s_instance
-        return k8s_instance.list_tpu_node_pools()
+        return k8s_instance.list_tpu_node_pools(cls._configured_context())
 
     def _fits(self, sl, pools: List[Dict[str, Any]]) -> bool:
         for pool in pools:

@@ -73,6 +73,42 @@ class Dag:
             raise ValueError('DAG has a cycle.')
 
 
+def load_chain_dag_from_yaml(path: str,
+                             env_overrides: Optional[dict] = None) -> 'Dag':
+    """Multi-document YAML → linear pipeline Dag (reference format:
+    an optional first doc holding just `name:`, then one doc per task,
+    chained in order)."""
+    import yaml
+
+    from skypilot_tpu import task as task_lib_mod
+    with open(path, 'r', encoding='utf-8') as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    if not docs:
+        raise ValueError(f'{path}: no YAML documents.')
+    for doc in docs:
+        if not isinstance(doc, dict):
+            raise ValueError(
+                f'{path}: every pipeline document must be a mapping, got '
+                f'{type(doc).__name__}.')
+    dag = Dag()
+    if set(docs[0].keys()) <= {'name'}:
+        dag.name = docs[0].get('name')
+        docs = docs[1:]
+    if not docs:
+        raise ValueError(f'{path}: pipeline has a name but no task '
+                         f'documents.')
+    prev = None
+    for doc in docs:
+        task = task_lib_mod.Task.from_yaml_config(doc, env_overrides)
+        dag.add(task)
+        if prev is not None:
+            dag.add_edge(prev, task)
+        prev = task
+    if dag.name is None and dag.tasks:
+        dag.name = dag.tasks[0].name
+    return dag
+
+
 class _DagContext(threading.local):
     """`with Dag() as dag:` registration context (analog sky/dag.py)."""
 

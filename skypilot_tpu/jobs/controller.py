@@ -54,11 +54,23 @@ class JobsController:
             raise exceptions.ManagedJobStatusError(
                 f'Managed job {job_id} not found.')
         self.record = record
-        self.task = task_lib.Task.from_yaml_config(record['task_config'])
-        self.cluster_name = record['cluster_name'] or _generate_cluster_name(
-            job_id, record['name'] or 'job')
-        self.strategy = recovery_strategy.StrategyExecutor.make(
-            self.cluster_name, self.task, job_id)
+        cfg = record['task_config']
+        if 'pipeline' in cfg:
+            # Chained multi-task job (reference: pipeline managed jobs):
+            # stages run in order, each on its own (possibly differently
+            # shaped) cluster, all under ONE ManagedJobStatus.
+            self.tasks = [task_lib.Task.from_yaml_config(c)
+                          for c in cfg['pipeline']]
+        else:
+            self.tasks = [task_lib.Task.from_yaml_config(cfg)]
+        base = _generate_cluster_name(job_id, record['name'] or 'job')
+        self._base_cluster_name = record['cluster_name'] or base
+        # task/cluster_name/strategy are per-stage state, owned by run().
+
+    def _stage_cluster_name(self, index: int) -> str:
+        if len(self.tasks) == 1:
+            return self._base_cluster_name
+        return f'{self._base_cluster_name}-t{index}'
 
     # ------------------------------------------------------------------
     def _cluster_alive(self) -> bool:
@@ -139,34 +151,54 @@ class JobsController:
 
     def run(self) -> None:
         job_id = self.job_id
-        if not state.set_starting(job_id, self.cluster_name):
+        if not state.set_starting(job_id, self._stage_cluster_name(0)):
             # The job reached a terminal state (e.g. cancelled while
             # PENDING) before this controller got going: nothing to do.
             logger.info(f'[job {job_id}] already terminal; controller exits.')
             return
+        for index, task in enumerate(self.tasks):
+            state.set_current_task(job_id, index)
+            self.task = task
+            self.cluster_name = self._stage_cluster_name(index)
+            self.strategy = recovery_strategy.StrategyExecutor.make(
+                self.cluster_name, task, job_id)
+            if len(self.tasks) > 1:
+                logger.info(f'[job {job_id}] pipeline stage '
+                            f'{index + 1}/{len(self.tasks)}')
+            if not self._run_one_task():
+                return   # terminal status already recorded
+        state.set_terminal(job_id, state.ManagedJobStatus.SUCCEEDED)
+
+    def _run_one_task(self) -> bool:
+        """Drive one (stage's) task to completion on its own cluster.
+
+        Returns True when the stage SUCCEEDED (pipeline continues); False
+        when a terminal ManagedJobStatus was already recorded.
+        """
+        job_id = self.job_id
         logger.info(f'[job {job_id}] launching as {self.cluster_name!r}')
         try:
             cluster_job_id = self.strategy.launch()
         except exceptions.ResourcesUnavailableError as e:
             state.set_terminal(job_id, state.ManagedJobStatus.
                                FAILED_NO_RESOURCE, failure_reason=str(e))
-            return
+            return False
         except Exception as e:  # pylint: disable=broad-except
             state.set_terminal(job_id,
                                state.ManagedJobStatus.FAILED_PRECHECKS,
                                failure_reason=f'{type(e).__name__}: {e}')
-            return
+            return False
         if not state.set_started(job_id, cluster_job_id):
             # Cancelled while we were provisioning: clean up and bow out.
             self.strategy.terminate_cluster()
-            return
+            return False
 
         while True:
             time.sleep(POLL_SECONDS)
 
             if state.cancel_was_requested(job_id):
                 self._do_cancel(cluster_job_id)
-                return
+                return False
 
             if not self._cluster_alive():
                 # Preemption (or external down). Recover: delete the dead
@@ -179,10 +211,10 @@ class JobsController:
                     state.set_terminal(
                         job_id, state.ManagedJobStatus.FAILED_NO_RESOURCE,
                         failure_reason=str(e))
-                    return
+                    return False
                 except recovery_strategy.JobCancelledDuringRecovery:
                     self._do_cancel(cluster_job_id)
-                    return
+                    return False
                 state.set_recovered(job_id, cluster_job_id)
                 continue
 
@@ -195,18 +227,17 @@ class JobsController:
                 continue
             if job_status is JobStatus.SUCCEEDED:
                 self.strategy.terminate_cluster()
-                state.set_terminal(job_id, state.ManagedJobStatus.SUCCEEDED)
-                return
+                return True
             if job_status is JobStatus.CANCELLED:
                 self.strategy.terminate_cluster()
                 state.set_terminal(job_id, state.ManagedJobStatus.CANCELLED)
-                return
+                return False
             try:
                 restarted, cluster_job_id = self._handle_user_code_failure(
                     job_status, cluster_job_id)
             except recovery_strategy.JobCancelledDuringRecovery:
                 self._do_cancel(cluster_job_id)
-                return
+                return False
             if restarted:
                 continue
             # Real failure on a live cluster: keep the cluster for debugging
@@ -218,7 +249,7 @@ class JobsController:
             state.set_terminal(
                 job_id, failed_status,
                 failure_reason=f'on-cluster job status: {job_status.value}')
-            return
+            return False
 
 
 def main(job_id: int) -> None:

@@ -31,24 +31,33 @@ def launch(entrypoint: Union[task_lib.Task, dag_lib.Dag],
     The controller process owns the whole lifecycle from here: provisioning
     (with failover), monitoring, preemption recovery, teardown.
     """
-    if isinstance(entrypoint, dag_lib.Dag):
-        if len(entrypoint.tasks) != 1:
-            raise NotImplementedError(
-                'Multi-task managed jobs (pipelines) are not supported yet.')
-        task = entrypoint.tasks[0]
-    else:
-        task = entrypoint
-    task.validate()
     from skypilot_tpu import admin_policy
-    task = admin_policy.apply(task, 'jobs.launch')
-    # Fail fast on an unknown recovery strategy (before the controller is
-    # off in its own process where the error is only visible in logs).
-    recovery_strategy.StrategyExecutor.make('prevalidate', task, job_id=0)
-    job_name = name or task.name or 'unnamed'
+    if isinstance(entrypoint, dag_lib.Dag):
+        if not entrypoint.is_chain():
+            raise NotImplementedError(
+                'Managed pipelines must be linear chains; general DAGs '
+                'are not supported.')
+        tasks = entrypoint.topological_order() or entrypoint.tasks
+        pipeline_name = entrypoint.name
+    else:
+        tasks = [entrypoint]
+        pipeline_name = None
+    tasks = [admin_policy.apply(t, 'jobs.launch') for t in tasks]
+    for t in tasks:
+        t.validate()
+        # Fail fast on an unknown recovery strategy (before the controller
+        # is off in its own process, where errors are only visible in logs).
+        recovery_strategy.StrategyExecutor.make('prevalidate', t, job_id=0)
+    job_name = (name or pipeline_name or tasks[0].name or 'unnamed')
+    if len(tasks) == 1:
+        task_config = tasks[0].to_yaml_config()
+    else:
+        task_config = {'pipeline': [t.to_yaml_config() for t in tasks]}
     job_id = state.submit(
-        job_name, task.to_yaml_config(),
-        strategy=_strategy_name(task),
-        max_restarts_on_errors=_max_restarts(task))
+        job_name, task_config,
+        strategy=_strategy_name(tasks[0]),
+        max_restarts_on_errors=_max_restarts(tasks[0]),
+        num_tasks=len(tasks))
     scheduler.maybe_schedule()
     logger.info(f'Managed job {job_id} ({job_name!r}) submitted.')
     return job_id

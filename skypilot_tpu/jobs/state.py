@@ -110,8 +110,17 @@ def _conn() -> sqlite3.Connection:
             cluster_job_id INTEGER,
             failure_reason TEXT,
             controller_pid INTEGER,
-            cancel_requested INTEGER DEFAULT 0
+            cancel_requested INTEGER DEFAULT 0,
+            current_task INTEGER DEFAULT 0,
+            num_tasks INTEGER DEFAULT 1
         )""")
+    # Older DBs predate the pipeline columns.
+    for col, default in (('current_task', 0), ('num_tasks', 1)):
+        try:
+            conn.execute(f'ALTER TABLE jobs ADD COLUMN {col} INTEGER '
+                         f'DEFAULT {default}')
+        except sqlite3.OperationalError:
+            pass   # already present
     return conn
 
 
@@ -132,15 +141,22 @@ def job_log_path(job_id: int) -> str:
 # Writes
 # ---------------------------------------------------------------------------
 def submit(name: str, task_config: Dict[str, Any], strategy: str,
-           max_restarts_on_errors: int = 0) -> int:
+           max_restarts_on_errors: int = 0, num_tasks: int = 1) -> int:
+    """task_config: one task dict, or {'pipeline': [task dicts]} for
+    chained multi-task jobs (reference: pipeline managed jobs)."""
     with _conn() as conn:
         cur = conn.execute(
             'INSERT INTO jobs (name, task_config, status, strategy, '
-            'submitted_at, max_restarts_on_errors) VALUES (?, ?, ?, ?, ?, ?)',
+            'submitted_at, max_restarts_on_errors, num_tasks) '
+            'VALUES (?, ?, ?, ?, ?, ?, ?)',
             (name, json.dumps(task_config), ManagedJobStatus.PENDING.value,
-             strategy, time.time(), max_restarts_on_errors))
+             strategy, time.time(), max_restarts_on_errors, num_tasks))
         assert cur.lastrowid is not None
         return cur.lastrowid
+
+
+def set_current_task(job_id: int, index: int) -> None:
+    _update(job_id, current_task=index)
 
 
 def _update(job_id: int, **cols: Any) -> None:

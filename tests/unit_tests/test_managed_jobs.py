@@ -133,6 +133,44 @@ class TestManagedJobs:
             jobs_core.launch(task)
         assert jobs_state.get_jobs() == []  # nothing half-submitted
 
+    def test_pipeline_stages_run_in_order(self, tmp_path):
+        """A 3-stage chain: each stage appends to a shared file; stages get
+        their own clusters; one SUCCEEDED at the end."""
+        import skypilot_tpu.dag as dag_lib
+        log = tmp_path / 'order.txt'
+        dag = dag_lib.Dag(name='pipe')
+        prev = None
+        for i, stage in enumerate(('prep', 'train', 'eval')):
+            t = _task(stage, f'echo {stage} >> {log}')
+            dag.add(t)
+            if prev is not None:
+                dag.add_edge(prev, t)
+            prev = t
+        job_id = jobs_core.launch(dag)
+        job = _wait_status(job_id, {ManagedJobStatus.SUCCEEDED},
+                           timeout=150)
+        assert job['num_tasks'] == 3
+        assert job['current_task'] == 2
+        assert log.read_text().split() == ['prep', 'train', 'eval']
+        # Every stage cluster was torn down.
+        assert global_state.get_clusters() == []
+
+    def test_pipeline_stage_failure_stops_chain(self, tmp_path):
+        import skypilot_tpu.dag as dag_lib
+        log = tmp_path / 'order.txt'
+        dag = dag_lib.Dag(name='failpipe')
+        t1 = _task('ok', f'echo one >> {log}')
+        t2 = _task('bad', 'exit 3')
+        t3 = _task('never', f'echo three >> {log}')
+        for t in (t1, t2, t3):
+            dag.add(t)
+        dag.add_edge(t1, t2)
+        dag.add_edge(t2, t3)
+        job_id = jobs_core.launch(dag)
+        job = _wait_status(job_id, {ManagedJobStatus.FAILED}, timeout=150)
+        assert job['current_task'] == 1       # died on stage 2
+        assert log.read_text().split() == ['one']
+
     def test_queue_and_scheduler_cap(self, monkeypatch):
         monkeypatch.setenv('SKYTPU_JOBS_MAX_PARALLEL', '1')
         ids = [jobs_core.launch(_task(f'q{i}', 'echo hi')) for i in range(3)]
