@@ -24,14 +24,19 @@ from skypilot_tpu.utils import common_utils
 logger = sky_logging.init_logger(__name__)
 
 
-def _load_task(entrypoint: str, env: Tuple[str, ...],
-               overrides: dict) -> sky.Task:
+def _parse_env_overrides(env: Tuple[str, ...]) -> dict:
     env_overrides = {}
     for item in env:
         if '=' not in item:
             raise click.UsageError(f'--env expects KEY=VALUE, got {item!r}')
         k, v = item.split('=', 1)
         env_overrides[k] = v
+    return env_overrides
+
+
+def _load_task(entrypoint: str, env: Tuple[str, ...],
+               overrides: dict) -> sky.Task:
+    env_overrides = _parse_env_overrides(env)
     try:
         if entrypoint.endswith(('.yaml', '.yml')) and os.path.exists(
                 entrypoint):
@@ -110,9 +115,12 @@ def exec_cmd(cluster: str, entrypoint: str, detach_run: bool,
 @cli.command()
 @click.argument('clusters', nargs=-1)
 @click.option('--refresh', '-r', is_flag=True, default=False)
-def status(clusters: Tuple[str, ...], refresh: bool):
-    """Show clusters."""
-    records = sky.status(list(clusters) or None, refresh=refresh)
+@click.option('--all-workspaces', '-u', is_flag=True, default=False,
+              help='Show clusters from every workspace.')
+def status(clusters: Tuple[str, ...], refresh: bool, all_workspaces: bool):
+    """Show clusters (active workspace only; see `workspace:` config)."""
+    records = sky.status(list(clusters) or None, refresh=refresh,
+                         all_workspaces=all_workspaces)
     if not records:
         click.echo('No existing clusters.')
         return
@@ -305,13 +313,7 @@ def jobs_launch(entrypoint: str, name: Optional[str], env: Tuple[str, ...],
             is_pipeline = f.read().count('\n---') > 0
         if is_pipeline:
             from skypilot_tpu import dag as dag_lib
-            env_overrides = {}
-            for item in env:
-                if '=' not in item:
-                    raise click.UsageError(
-                        f'--env expects KEY=VALUE, got {item!r}')
-                k, v = item.split('=', 1)
-                env_overrides[k] = v
+            env_overrides = _parse_env_overrides(env)
             try:
                 entry = dag_lib.load_chain_dag_from_yaml(
                     entrypoint, env_overrides or None)

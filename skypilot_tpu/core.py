@@ -59,10 +59,16 @@ def _refresh_one(record: Dict[str, Any]) -> Dict[str, Any]:
 
 
 def status(cluster_names: Optional[List[str]] = None,
-           refresh: bool = False) -> List[Dict[str, Any]]:
+           refresh: bool = False,
+           all_workspaces: bool = False) -> List[Dict[str, Any]]:
+    from skypilot_tpu import workspaces
     records = global_state.get_clusters()
     if cluster_names:
+        # Explicit names bypass the workspace filter — a user asking for a
+        # cluster by name should always find it.
         records = [r for r in records if r['name'] in cluster_names]
+    else:
+        records = workspaces.filter_records(records, all_workspaces)
     if refresh:
         refreshed = []
         for r in records:

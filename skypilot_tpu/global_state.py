@@ -50,8 +50,13 @@ def _create_tables(conn: sqlite3.Connection) -> None:
             status TEXT,
             autostop TEXT,
             owner TEXT,
-            launch_cost REAL DEFAULT 0.0
+            launch_cost REAL DEFAULT 0.0,
+            workspace TEXT
         )""")
+    try:
+        conn.execute('ALTER TABLE clusters ADD COLUMN workspace TEXT')
+    except sqlite3.OperationalError:
+        pass   # pre-workspace DBs
     conn.execute("""
         CREATE TABLE IF NOT EXISTS cluster_history (
             row_id INTEGER PRIMARY KEY AUTOINCREMENT,
@@ -84,14 +89,16 @@ def add_or_update_cluster(cluster_name: str,
     existing = get_cluster(cluster_name)
     launched_at = (now if is_launch or existing is None
                    else existing['launched_at'])
+    from skypilot_tpu import workspaces
     conn.execute(
         'INSERT INTO clusters (name, launched_at, handle, last_use, status, '
-        'owner) VALUES (?, ?, ?, ?, ?, ?) '
+        'owner, workspace) VALUES (?, ?, ?, ?, ?, ?, ?) '
         'ON CONFLICT(name) DO UPDATE SET handle=excluded.handle, '
         'status=excluded.status, last_use=excluded.last_use, '
         'launched_at=excluded.launched_at',
         (cluster_name, launched_at, json.dumps(handle),
-         common_utils.get_user(), status.value, common_utils.get_user_hash()))
+         common_utils.get_user(), status.value, common_utils.get_user_hash(),
+         workspaces.get_active_workspace()))
     conn.commit()
 
 

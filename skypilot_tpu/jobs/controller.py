@@ -157,9 +157,9 @@ class JobsController:
             logger.info(f'[job {job_id}] already terminal; controller exits.')
             return
         for index, task in enumerate(self.tasks):
-            state.set_current_task(job_id, index)
             self.task = task
             self.cluster_name = self._stage_cluster_name(index)
+            state.set_current_task(job_id, index, self.cluster_name)
             self.strategy = recovery_strategy.StrategyExecutor.make(
                 self.cluster_name, task, job_id)
             if len(self.tasks) > 1:

@@ -155,8 +155,15 @@ def submit(name: str, task_config: Dict[str, Any], strategy: str,
         return cur.lastrowid
 
 
-def set_current_task(job_id: int, index: int) -> None:
-    _update(job_id, current_task=index)
+def set_current_task(job_id: int, index: int,
+                     cluster_name: Optional[str] = None) -> None:
+    """Advance the pipeline stage pointer; cluster_name must track the
+    stage's cluster or orphan-teardown and log streaming act on a dead
+    name."""
+    if cluster_name is not None:
+        _update(job_id, current_task=index, cluster_name=cluster_name)
+    else:
+        _update(job_id, current_task=index)
 
 
 def _update(job_id: int, **cols: Any) -> None:

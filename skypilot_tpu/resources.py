@@ -25,11 +25,11 @@ logger = sky_logging.init_logger(__name__)
 
 DEFAULT_DISK_SIZE_GB = 100
 
-_RESOURCES_FIELDS = frozenset({
-    'cloud', 'accelerators', 'accelerator_args', 'use_spot', 'spot_recovery',
-    'region', 'zone', 'cpus', 'memory', 'disk_size', 'disk_tier', 'ports',
-    'image_id', 'labels', 'autostop', 'any_of', 'ordered',
-})
+# Single source of truth for valid YAML fields: the declarative schema
+# (utils/schemas.py). Diverging hand-maintained lists caused real bugs.
+from skypilot_tpu.utils import schemas as _schemas
+
+_RESOURCES_FIELDS = frozenset(_schemas.RESOURCES_SCHEMA)
 
 
 class Resources:
@@ -363,7 +363,10 @@ class Resources:
                 accelerators=merged.get('accelerators'),
                 accelerator_args=merged.get('accelerator_args'),
                 use_spot=merged.get('use_spot'),
-                spot_recovery=merged.get('spot_recovery'),
+                # job_recovery is the reference's newer name for the same
+                # knob; accept both.
+                spot_recovery=(merged.get('job_recovery') or
+                               merged.get('spot_recovery')),
                 region=merged.get('region'),
                 zone=merged.get('zone'),
                 cpus=merged.get('cpus'),

@@ -84,11 +84,10 @@ class Task:
                          env_overrides: Optional[Dict[str, str]] = None
                          ) -> 'Task':
         config = dict(config or {})
-        unknown = set(config) - _TASK_YAML_FIELDS
-        if unknown:
-            raise ValueError(
-                f'Unknown task fields: {sorted(unknown)}. '
-                f'Valid: {sorted(_TASK_YAML_FIELDS)}')
+        # Shape validation first: dotted-path type errors beat tracebacks
+        # from half-built objects (utils/schemas.py).
+        from skypilot_tpu.utils import schemas
+        schemas.validate_task_config(config)
         envs = dict(config.get('envs') or {})
         if env_overrides:
             envs.update(env_overrides)
@@ -117,14 +116,8 @@ class Task:
             task.set_file_mounts(plain_mounts)
         task.config_overrides = dict(config.get('config') or {})
         task.service_spec = config.get('service')
+        # Shape/unknown-key checks already ran in validate_task_config.
         est = config.get('estimated') or {}
-        if not isinstance(est, dict):
-            raise ValueError("'estimated:' must be a mapping with any of "
-                             "duration_seconds/total_flops/output_gb")
-        unknown_est = set(est) - {'duration_seconds', 'total_flops',
-                                  'output_gb'}
-        if unknown_est:
-            raise ValueError(f'Unknown estimated fields: {sorted(unknown_est)}')
         if est.get('duration_seconds') is not None:
             task.estimated_duration_seconds = float(est['duration_seconds'])
         if est.get('total_flops') is not None:

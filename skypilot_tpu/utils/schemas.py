@@ -1,0 +1,132 @@
+"""Declarative YAML validation for user-facing configs.
+
+Reference analog: sky/utils/schemas.py (1.8k LoC of JSON-schema). Lean
+engine with the same job: reject wrong shapes/types with a dotted-path
+message BEFORE objects are half-built, so users see
+`resources.accelerators: expected str, got int` instead of a traceback.
+Semantic validation (legal topologies, zone names, ...) stays in the
+constructors — schemas check shape, not meaning.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple, Type, Union
+
+
+@dataclasses.dataclass(frozen=True)
+class Field:
+    types: Tuple[Type, ...]
+    required: bool = False
+    choices: Optional[Tuple[Any, ...]] = None
+    # For dict fields: per-key schema ('*' = any key) of nested Fields.
+    nested: Optional[Dict[str, 'Field']] = None
+
+
+def _type_name(types: Tuple[Type, ...]) -> str:
+    return ' or '.join(t.__name__ for t in types)
+
+
+def validate(config: Any, schema: Dict[str, Field], path: str = '') -> None:
+    """Raise ValueError on the first shape violation (dotted path)."""
+    if not isinstance(config, dict):
+        raise ValueError(f'{path or "config"}: expected a mapping, got '
+                         f'{type(config).__name__}.')
+    unknown = set(config) - set(schema)
+    if unknown and '*' not in schema:
+        raise ValueError(
+            f'{path + "." if path else ""}{sorted(unknown)[0]}: unknown '
+            f'field. Valid: {sorted(k for k in schema if k != "*")}')
+    for key, field in schema.items():
+        if key == '*':
+            continue
+        here = f'{path}.{key}' if path else key
+        if key not in config or config[key] is None:
+            if field.required:
+                raise ValueError(f'{here}: required field is missing.')
+            continue
+        value = config[key]
+        if bool not in field.types and isinstance(value, bool) and \
+                int in field.types:
+            raise ValueError(f'{here}: expected '
+                             f'{_type_name(field.types)}, got bool.')
+        if not isinstance(value, field.types):
+            raise ValueError(f'{here}: expected {_type_name(field.types)}, '
+                             f'got {type(value).__name__} ({value!r}).')
+        if field.choices is not None and value not in field.choices:
+            raise ValueError(f'{here}: must be one of {field.choices}, '
+                             f'got {value!r}.')
+        if field.nested is not None and isinstance(value, dict):
+            validate(value, field.nested, here)
+    if '*' in schema:
+        wildcard = schema['*']
+        for key, value in config.items():
+            if key in schema:
+                continue
+            here = f'{path}.{key}' if path else key
+            if value is None:
+                continue
+            if bool not in wildcard.types and isinstance(value, bool) and \
+                    int in wildcard.types:
+                raise ValueError(f'{here}: expected '
+                                 f'{_type_name(wildcard.types)}, got bool.')
+            if not isinstance(value, wildcard.types):
+                raise ValueError(
+                    f'{here}: expected {_type_name(wildcard.types)}, got '
+                    f'{type(value).__name__}.')
+
+
+_STR = (str,)
+_NUM = (int, float)
+_STR_NUM = (str, int, float)
+
+RESOURCES_SCHEMA: Dict[str, Field] = {
+    'cloud': Field(_STR),
+    'accelerators': Field((str, dict)),
+    'accelerator_args': Field((dict,), nested={'*': Field((str, int))}),
+    'use_spot': Field((bool,)),
+    'spot_recovery': Field(_STR),
+    'job_recovery': Field(_STR),
+    'region': Field(_STR),
+    'zone': Field(_STR),
+    'cpus': Field(_STR_NUM),
+    'memory': Field(_STR_NUM),
+    'disk_size': Field((int,)),
+    'disk_tier': Field(_STR),
+    'ports': Field((int, str, list)),
+    'image_id': Field(_STR),
+    'labels': Field((dict,), nested={'*': Field(_STR_NUM)}),
+    'autostop': Field((int, bool, dict)),
+    'any_of': Field((list,)),
+    'ordered': Field((list,)),
+}
+
+TASK_SCHEMA: Dict[str, Field] = {
+    'name': Field(_STR),
+    'resources': Field((dict,)),
+    'num_nodes': Field((int,)),
+    'workdir': Field(_STR),
+    'setup': Field(_STR),
+    'run': Field(_STR),
+    'envs': Field((dict,), nested={'*': Field(_STR_NUM + (bool,))}),
+    'secrets': Field((dict,), nested={'*': Field(_STR_NUM)}),
+    'file_mounts': Field((dict,)),
+    'config': Field((dict,)),
+    'service': Field((dict,)),
+    'estimated': Field((dict,), nested={
+        'duration_seconds': Field(_NUM),
+        'total_flops': Field(_NUM),
+        'output_gb': Field(_NUM),
+    }),
+}
+
+
+def validate_task_config(config: Dict[str, Any]) -> None:
+    validate(config, TASK_SCHEMA)
+    res = config.get('resources')
+    if isinstance(res, dict):
+        if 'any_of' in res or 'ordered' in res:
+            key = 'any_of' if 'any_of' in res else 'ordered'
+            for i, sub in enumerate(res.get(key) or []):
+                validate(sub, RESOURCES_SCHEMA, f'resources.{key}[{i}]')
+        else:
+            validate(res, RESOURCES_SCHEMA, 'resources')

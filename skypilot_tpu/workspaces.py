@@ -1,0 +1,34 @@
+"""Workspaces: namespace clusters/jobs/services per project or team.
+
+Reference analog: sky/workspaces/ (812 LoC multi-tenant admin). Lean
+redesign: the active workspace is a config value (`workspace:` in
+~/.skytpu/config.yaml, or SKYTPU_WORKSPACE env — env wins so one shell can
+switch per-command); every cluster launched is stamped with it, and
+status/listings filter to the active workspace by default. 'default' is
+the workspace when none is configured, so single-tenant users never see
+the feature.
+"""
+from __future__ import annotations
+
+import os
+
+DEFAULT_WORKSPACE = 'default'
+
+
+def get_active_workspace() -> str:
+    env = os.environ.get('SKYTPU_WORKSPACE')
+    if env:
+        return env
+    from skypilot_tpu import config as config_lib
+    return str(config_lib.get_nested(('workspace',), DEFAULT_WORKSPACE))
+
+
+def filter_records(records, all_workspaces: bool = False):
+    """Keep records belonging to the active workspace. Records written
+    before workspaces existed (workspace=None) always show."""
+    if all_workspaces:
+        return records
+    active = get_active_workspace()
+    return [r for r in records
+            if r.get('workspace') is None          # pre-workspace records
+            or r['workspace'] == active]

@@ -81,3 +81,30 @@ class TestStatusRefresh:
             assert records[0]['status'] == ClusterStatus.UP
         finally:
             sky.down('t-stopped')
+
+
+@pytest.mark.usefixtures('enable_local_cloud', 'isolated_state')
+class TestWorkspaces:
+    """Workspace stamping + filtering (reference analog: sky/workspaces/)."""
+
+    def _launch(self, name):
+        task = sky.Task(name='t', run='echo hi')
+        task.set_resources(sky.Resources(accelerators='tpu-v5e-8'))
+        sky.launch(task, cluster_name=name, detach_run=True)
+
+    def test_status_filters_by_active_workspace(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_WORKSPACE', 'team-a')
+        self._launch('ws-a')
+        monkeypatch.setenv('SKYTPU_WORKSPACE', 'team-b')
+        self._launch('ws-b')
+        try:
+            assert [r['name'] for r in core.status()] == ['ws-b']
+            monkeypatch.setenv('SKYTPU_WORKSPACE', 'team-a')
+            assert [r['name'] for r in core.status()] == ['ws-a']
+            both = {r['name'] for r in core.status(all_workspaces=True)}
+            assert both == {'ws-a', 'ws-b'}
+            # Explicit names bypass the filter.
+            assert core.status(['ws-b'])[0]['name'] == 'ws-b'
+        finally:
+            sky.down('ws-a')
+            sky.down('ws-b')

@@ -1,0 +1,55 @@
+"""YAML shape validation (utils/schemas.py — sky/utils/schemas.py analog)."""
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu.utils import schemas
+
+
+class TestSchemas:
+
+    def test_valid_full_task(self):
+        sky.Task.from_yaml_config({
+            'name': 't',
+            'resources': {'accelerators': 'tpu-v5e-8', 'use_spot': True,
+                          'accelerator_args': {'num_slices': 2},
+                          'labels': {'team': 'ml'}},
+            'run': 'echo hi',
+            'envs': {'A': 1, 'B': 'x'},
+            'estimated': {'total_flops': 1e18},
+        })
+
+    def test_unknown_field_names_the_path(self):
+        with pytest.raises(ValueError, match='resourcs: unknown field'):
+            sky.Task.from_yaml_config({'resourcs': {}, 'run': 'x'})
+
+    def test_wrong_type_names_path_and_types(self):
+        with pytest.raises(ValueError,
+                           match='resources.use_spot: expected bool'):
+            sky.Task.from_yaml_config({
+                'resources': {'accelerators': 'tpu-v5e-8',
+                              'use_spot': 'yes'},
+                'run': 'x'})
+
+    def test_nested_dict_values_checked(self):
+        with pytest.raises(ValueError, match='envs.A: expected'):
+            sky.Task.from_yaml_config({'run': 'x', 'envs': {'A': ['no']}})
+
+    def test_bool_is_not_int(self):
+        with pytest.raises(ValueError, match='num_nodes: expected int'):
+            sky.Task.from_yaml_config({'run': 'x', 'num_nodes': True})
+
+    def test_any_of_resources_validated(self):
+        with pytest.raises(ValueError,
+                           match=r'resources.any_of\[1\].region'):
+            sky.Task.from_yaml_config({
+                'run': 'x',
+                'resources': {'any_of': [
+                    {'accelerators': 'tpu-v5e-8'},
+                    {'accelerators': 'tpu-v4-8', 'region': 7},
+                ]}})
+
+    def test_estimated_fields(self):
+        with pytest.raises(ValueError,
+                           match='estimated.duration_seconds: expected'):
+            schemas.validate_task_config(
+                {'run': 'x', 'estimated': {'duration_seconds': 'long'}})

@@ -50,7 +50,7 @@ class TestTaskYaml:
         assert t.num_nodes == 4
 
     def test_unknown_field(self, tmp_path):
-        with pytest.raises(ValueError, match='Unknown task fields'):
+        with pytest.raises(ValueError, match='runn: unknown field'):
             _yaml_task(tmp_path, 'runn: echo hi\n')
 
     def test_round_trip(self, tmp_path):
