@@ -224,9 +224,15 @@ def exec(task, cluster_name: str, *,  # pylint: disable=redefined-builtin
 
 
 def status(cluster_names: Optional[List[str]] = None,
-           refresh: bool = False) -> Any:
-    return get(submit('status', {'cluster_names': cluster_names,
-                                 'refresh': refresh}))
+           refresh: bool = False, all_workspaces: bool = False) -> Any:
+    from skypilot_tpu import workspaces
+    return get(submit('status', {
+        'cluster_names': cluster_names,
+        'refresh': refresh,
+        'all_workspaces': all_workspaces,
+        # The server filters by the CLIENT's workspace, not its own env.
+        'workspace': workspaces.get_active_workspace(),
+    }))
 
 
 def queue(cluster_name: str) -> Any:

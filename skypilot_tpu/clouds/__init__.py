@@ -11,3 +11,4 @@ from skypilot_tpu.clouds.cloud import (  # noqa: F401
 from skypilot_tpu.clouds.gcp import GCP  # noqa: F401
 from skypilot_tpu.clouds.kubernetes import Kubernetes  # noqa: F401
 from skypilot_tpu.clouds.local import Local  # noqa: F401
+from skypilot_tpu.clouds.ssh import Ssh  # noqa: F401

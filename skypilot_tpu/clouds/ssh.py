@@ -1,0 +1,167 @@
+"""BYO-machine pools: existing SSH-reachable hosts as a cloud.
+
+Reference analog: sky/ssh_node_pools/ (pools from
+~/.sky/ssh_node_pools.yaml). Pools here are TPU-first: a pool declares the
+slice its hosts form (reserved TPU-VMs managed outside any cloud console,
+lab machines, ...), and 'provisioning' is allocation from the pool:
+
+~/.skytpu/ssh_node_pools.yaml:
+    my-v4-pool:
+      user: ubuntu
+      identity_file: ~/.ssh/id_ed25519
+      accelerator: tpu-v4-16        # optional: slice the hosts form
+      hosts: [10.0.0.1, 10.0.0.2]
+
+Each pool is a zone of the single 'ssh' region; allocation state lives in
+~/.skytpu/ssh_pool_state.json so concurrent clusters can't double-book a
+host.
+"""
+from __future__ import annotations
+
+import os
+import typing
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.utils import registry
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+SSH_REGION = 'ssh'
+POOLS_PATH = '~/.skytpu/ssh_node_pools.yaml'
+
+
+def load_pools() -> Dict[str, Dict[str, Any]]:
+    import yaml
+    path = os.path.expanduser(POOLS_PATH)
+    if not os.path.exists(path):
+        return {}
+    with open(path, 'r', encoding='utf-8') as f:
+        data = yaml.safe_load(f) or {}
+    if not isinstance(data, dict):
+        raise ValueError(f'{POOLS_PATH} must map pool names to configs.')
+    return data
+
+
+@registry.CLOUD_REGISTRY.register(name='ssh')
+class Ssh(cloud_lib.Cloud):
+    """Pools of pre-existing SSH hosts behind the Cloud interface."""
+
+    _REPR = 'SSH'
+
+    @classmethod
+    def unsupported_features(
+            cls, resources: 'resources_lib.Resources'
+    ) -> Dict[cloud_lib.CloudImplementationFeatures, str]:
+        return {
+            cloud_lib.CloudImplementationFeatures.STOP:
+                'BYO machines are not stopped; down releases them to the '
+                'pool.',
+            cloud_lib.CloudImplementationFeatures.AUTOSTOP:
+                'autostop would stop machines this framework does not own.',
+            cloud_lib.CloudImplementationFeatures.OPEN_PORTS:
+                'firewalling BYO machines is out of scope.',
+        }
+
+    # ------------------------------------------------------------------
+    def _matching_pools(self, resources: 'resources_lib.Resources'
+                        ) -> List[str]:
+        from skypilot_tpu.provision.ssh import instance as ssh_instance
+        sl = resources.tpu
+        # Load both files once; the optimizer calls this several times per
+        # launch attempt.
+        pools = load_pools()
+        alloc_state = ssh_instance.load_allocations()
+        out = []
+        for name, pool in pools.items():
+            acc = pool.get('accelerator')
+            if sl is not None:
+                if acc is None:
+                    continue
+                from skypilot_tpu.tpu import topology
+                try:
+                    pool_sl = topology.parse_tpu_accelerator(str(acc))
+                except Exception:  # pylint: disable=broad-except
+                    continue
+                if (pool_sl.generation != sl.generation or
+                        pool_sl.num_chips != sl.num_chips):
+                    continue
+                needed = sl.total_hosts
+            else:
+                needed = 1
+            free = ssh_instance.free_hosts(name, pool_cfg=pool,
+                                           state=alloc_state)
+            if len(free) >= needed:
+                out.append(name)
+        return out
+
+    def regions_with_offering(self, resources: 'resources_lib.Resources'
+                              ) -> List[cloud_lib.Region]:
+        if resources.region not in (None, SSH_REGION):
+            return []
+        pools = self._matching_pools(resources)
+        if resources.zone is not None:
+            pools = [p for p in pools if p == resources.zone]
+        if not pools:
+            return []
+        return [cloud_lib.Region(
+            SSH_REGION, tuple(cloud_lib.Zone(p) for p in pools))]
+
+    def zones_provision_loop(
+            self, *, region: str, resources: 'resources_lib.Resources'
+    ) -> Iterator[List[cloud_lib.Zone]]:
+        del region
+        for pool in self._matching_pools(resources):
+            if resources.zone is not None and pool != resources.zone:
+                continue
+            yield [cloud_lib.Zone(pool)]
+
+    def get_feasible_launchable_resources(
+            self, resources: 'resources_lib.Resources'
+    ) -> Tuple[List['resources_lib.Resources'], List[str]]:
+        if resources.region not in (None, SSH_REGION):
+            return [], []
+        pools = self._matching_pools(resources)
+        if not pools:
+            want = resources.tpu.name if resources.tpu else 'cpu'
+            return [], [f'ssh: no pool with free capacity for {want} '
+                        f'(pools: {sorted(load_pools()) or "none"})']
+        return [resources.copy(cloud=self, region=SSH_REGION)], []
+
+    def hourly_cost(self, resources: 'resources_lib.Resources') -> float:
+        del resources
+        return 0.0   # sunk cost, like kubernetes
+
+    def make_deploy_resources_variables(
+            self, resources: 'resources_lib.Resources', region: str,
+            zones: Optional[List[str]], cluster_name: str) -> Dict[str, Any]:
+        sl = resources.tpu
+        return {
+            'cloud': 'ssh',
+            'pools': zones or list(load_pools()),
+            # Per-slice host count: the provision layer multiplies by
+            # num_slices itself (same contract as local.py).
+            'num_hosts': sl.num_hosts if sl else 1,
+            'num_slices': sl.num_slices if sl else 1,
+            'chips_per_host': sl.chips_per_host if sl else 1,
+            'cluster_name': cluster_name,
+        }
+
+    def validate_region_zone(self, region: Optional[str],
+                             zone: Optional[str]
+                             ) -> Tuple[Optional[str], Optional[str]]:
+        if region is not None and region != SSH_REGION:
+            raise ValueError(f"ssh cloud's region is {SSH_REGION!r}, got "
+                             f'{region!r}.')
+        if zone is not None and zone not in load_pools():
+            raise ValueError(f'Unknown ssh pool {zone!r}; pools: '
+                             f'{sorted(load_pools())}')
+        return region, zone
+
+    @classmethod
+    def check_credentials(cls) -> Tuple[bool, Optional[str]]:
+        pools = load_pools()
+        if not pools:
+            return False, f'No pools configured in {POOLS_PATH}.'
+        return True, None

@@ -60,7 +60,11 @@ def _refresh_one(record: Dict[str, Any]) -> Dict[str, Any]:
 
 def status(cluster_names: Optional[List[str]] = None,
            refresh: bool = False,
-           all_workspaces: bool = False) -> List[Dict[str, Any]]:
+           all_workspaces: bool = False,
+           workspace: Optional[str] = None) -> List[Dict[str, Any]]:
+    """`workspace` overrides the active-workspace resolution — the API
+    server passes the CLIENT's workspace here, since its own env is
+    meaningless for the caller."""
     from skypilot_tpu import workspaces
     records = global_state.get_clusters()
     if cluster_names:
@@ -68,7 +72,8 @@ def status(cluster_names: Optional[List[str]] = None,
         # cluster by name should always find it.
         records = [r for r in records if r['name'] in cluster_names]
     else:
-        records = workspaces.filter_records(records, all_workspaces)
+        records = workspaces.filter_records(records, all_workspaces,
+                                            workspace=workspace)
     if refresh:
         refreshed = []
         for r in records:

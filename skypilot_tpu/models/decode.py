@@ -126,32 +126,47 @@ def decode_step(params, token: jnp.ndarray, cache: KVCache,
                 cfg: llama.LlamaConfig,
                 rules: Optional[sharding_lib.Rules] = None
                 ) -> Tuple[jnp.ndarray, KVCache]:
-    """One incremental step. token [B] int32 → (logits [B, vocab], cache)."""
+    """One incremental step. token [B] int32 → (logits [B, vocab], cache).
+
+    The cache rides the scan CARRY (updated with per-layer
+    dynamic_update_slice), not the xs→ys stream: stacking per-layer ys
+    would rewrite the entire [L,B,T,KH,hd] cache every token — at 1B scale
+    that's ~2x the weight-read traffic, and decode is HBM-bound. Carry
+    threading is linear, so XLA keeps the updates in place.
+    """
     del rules
     b = token.shape[0]
-    t = cache.k.shape[2]
     length = cache.length
     x = jnp.take(params['embed'], token[:, None], axis=0).astype(cfg.dtype)
     sin, cos = rotary.rope_frequencies(cfg.hd, length[None], cfg.rope_theta,
                                        cfg.rope_scaling)
 
     def body(carry, xs):
-        lp, k_l, v_l = xs
-        q, k_new, v_new = _qkv(carry, lp, cfg, sin, cos)
-        # Insert the new token's K/V at `length` (static-shape update).
-        k_l = jax.lax.dynamic_update_slice(k_l, k_new, (0, length, 0, 0))
-        v_l = jax.lax.dynamic_update_slice(v_l, v_new, (0, length, 0, 0))
+        x_c, k_cache, v_cache = carry
+        lp, layer_idx = xs
+        q, k_new, v_new = _qkv(x_c, lp, cfg, sin, cos)
+        # Insert the new token's K/V at (layer_idx, :, length).
+        k_cache = jax.lax.dynamic_update_slice(
+            k_cache, k_new[None], (layer_idx, 0, length, 0, 0))
+        v_cache = jax.lax.dynamic_update_slice(
+            v_cache, v_new[None], (layer_idx, 0, length, 0, 0))
+        k_l = jax.lax.dynamic_index_in_dim(k_cache, layer_idx, axis=0,
+                                           keepdims=False)
+        v_l = jax.lax.dynamic_index_in_dim(v_cache, layer_idx, axis=0,
+                                           keepdims=False)
         # q_offset=length masks kv positions > length, so the zero padding
         # beyond the valid prefix never contributes.
         out = _attention(q, k_l, v_l, impl='xla', causal=True,
                          q_offset=length, kv_offset=0)
         out = out.reshape(b, 1, cfg.n_heads * cfg.hd)
-        carry = carry + jnp.einsum('bsh,hd->bsd', out,
-                                   lp['wo'].astype(cfg.dtype))
-        carry = carry + _mlp(carry, lp, cfg)
-        return carry, (k_l, v_l)
+        x_c = x_c + jnp.einsum('bsh,hd->bsd', out,
+                               lp['wo'].astype(cfg.dtype))
+        x_c = x_c + _mlp(x_c, lp, cfg)
+        return (x_c, k_cache, v_cache), None
 
-    x, (ks, vs) = jax.lax.scan(body, x, (params['layers'], cache.k, cache.v))
+    layer_ids = jnp.arange(cfg.n_layers, dtype=jnp.int32)
+    (x, ks, vs), _ = jax.lax.scan(
+        body, (x, cache.k, cache.v), (params['layers'], layer_ids))
     logits = _unembed(x, params, cfg)
     new_cache = KVCache(k=ks, v=vs, length=length + 1)
     return logits[:, 0], new_cache

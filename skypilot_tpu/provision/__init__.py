@@ -22,7 +22,7 @@ ProvisionRecord = common.ProvisionRecord
 ClusterInfo = common.ClusterInfo
 InstanceInfo = common.InstanceInfo
 
-_SUPPORTED_CLOUDS = ('gcp', 'local', 'kubernetes')
+_SUPPORTED_CLOUDS = ('gcp', 'local', 'kubernetes', 'ssh')
 
 
 def _route_to_cloud_impl(fn):

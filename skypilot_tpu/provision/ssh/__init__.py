@@ -1,0 +1,1 @@
+"""SSH pool provisioner (reference analog: sky/ssh_node_pools/)."""

@@ -1,0 +1,166 @@
+"""SSH-pool 'provisioning': allocate/release hosts from BYO pools.
+
+Provisioning creates nothing — it reserves hosts in a local allocation
+file (~/.skytpu/ssh_pool_state.json) under a file lock, so two launches
+cannot double-book a machine. terminate releases the hosts back.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu.provision import common
+from skypilot_tpu.utils import locks
+
+_STATE_PATH = '~/.skytpu/ssh_pool_state.json'
+
+
+def _state_path() -> str:
+    path = os.path.expanduser(_STATE_PATH)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    return path
+
+
+def _load_state() -> Dict[str, Any]:
+    try:
+        with open(_state_path(), 'r', encoding='utf-8') as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {'allocations': {}}
+
+
+def _save_state(state: Dict[str, Any]) -> None:
+    with open(_state_path(), 'w', encoding='utf-8') as f:
+        json.dump(state, f, indent=2)
+
+
+def _pool_config(pool: str) -> Dict[str, Any]:
+    from skypilot_tpu.clouds import ssh as ssh_cloud
+    pools = ssh_cloud.load_pools()
+    if pool not in pools:
+        raise exceptions.ProvisionError(f'Unknown ssh pool {pool!r}.')
+    return pools[pool]
+
+
+def load_allocations() -> Dict[str, Any]:
+    """Public read of the allocation state (callers may cache it across
+    several free_hosts calls)."""
+    return _load_state()
+
+
+def free_hosts(pool: str, pool_cfg: Optional[Dict[str, Any]] = None,
+               state: Optional[Dict[str, Any]] = None) -> List[str]:
+    """Hosts of `pool` not allocated to any cluster."""
+    cfg = pool_cfg if pool_cfg is not None else _pool_config(pool)
+    state = state if state is not None else _load_state()
+    taken = set()
+    for alloc in state['allocations'].values():
+        if alloc['pool'] == pool:
+            taken.update(alloc['hosts'])
+    return [h for h in cfg.get('hosts', []) if str(h) not in taken]
+
+
+def run_instances(region: str, zone: str, cluster_name: str,
+                  config: common.ProvisionConfig) -> common.ProvisionRecord:
+    pool = zone
+    pc = config.provider_config
+    num_hosts = int(pc.get('num_hosts', 1)) * int(pc.get('num_slices', 1))
+    with locks.cluster_status_lock('ssh-pool-alloc', timeout=60):
+        state = _load_state()
+        existing = state['allocations'].get(cluster_name)
+        if existing is not None:
+            return common.ProvisionRecord(
+                provider_name='ssh', region=region, zone=existing['pool'],
+                cluster_name=cluster_name, resumed_instance_ids=[],
+                created_instance_ids=[])
+        free = free_hosts(pool)
+        if len(free) < num_hosts:
+            raise exceptions.InsufficientCapacityError(
+                f'Pool {pool!r} has {len(free)} free host(s); need '
+                f'{num_hosts}.')
+        hosts = [str(h) for h in free[:num_hosts]]
+        state['allocations'][cluster_name] = {'pool': pool, 'hosts': hosts}
+        _save_state(state)
+    return common.ProvisionRecord(
+        provider_name='ssh', region=region, zone=pool,
+        cluster_name=cluster_name, resumed_instance_ids=[],
+        created_instance_ids=hosts)
+
+
+def wait_instances(region: str, cluster_name: str,
+                   state: Optional[str] = None,
+                   provider_config=None) -> None:
+    del region, cluster_name, state, provider_config  # hosts pre-exist
+
+
+def stop_instances(region: str, cluster_name: str,
+                   provider_config=None) -> None:
+    raise exceptions.ProvisionError(
+        'BYO ssh hosts cannot be stopped; use down to release them.')
+
+
+def terminate_instances(region: str, cluster_name: str,
+                        provider_config=None) -> None:
+    del region, provider_config
+    with locks.cluster_status_lock('ssh-pool-alloc', timeout=60):
+        state = _load_state()
+        state['allocations'].pop(cluster_name, None)
+        _save_state(state)
+
+
+def query_instances(region: str, cluster_name: str,
+                    provider_config=None) -> Dict[str, Optional[str]]:
+    del region, provider_config
+    alloc = _load_state()['allocations'].get(cluster_name)
+    if alloc is None:
+        return {}
+    return {h: 'running' for h in alloc['hosts']}
+
+
+def get_cluster_info(region: str, cluster_name: str,
+                     provider_config: Optional[Dict[str, Any]] = None
+                     ) -> common.ClusterInfo:
+    del region
+    alloc = _load_state()['allocations'].get(cluster_name)
+    if alloc is None:
+        raise exceptions.ClusterDoesNotExist(
+            f'No ssh-pool allocation for {cluster_name!r}.')
+    pool_cfg = _pool_config(alloc['pool'])
+    pc = provider_config or {}
+    hosts_per_slice = max(1, int(pc.get('num_hosts', len(alloc['hosts']))))
+    instances: Dict[str, common.InstanceInfo] = {}
+    head_id = None
+    for i, host in enumerate(alloc['hosts']):
+        iid = f'{cluster_name}-{i}'
+        info = common.InstanceInfo(
+            instance_id=iid,
+            internal_ip=host,
+            external_ip=host,
+            slice_index=i // hosts_per_slice,
+            worker_id=i % hosts_per_slice,
+            ssh_port=int(pool_cfg.get('port', 22)),
+        )
+        instances[iid] = info
+        if head_id is None:
+            head_id = iid
+    return common.ClusterInfo(
+        provider_name='ssh',
+        instances=instances,
+        head_instance_id=head_id,
+        provider_config=dict(pc, pool=alloc['pool'],
+                             identity_file=pool_cfg.get('identity_file'),
+                             ssh_user=pool_cfg.get('user', 'root')),
+        ssh_user=pool_cfg.get('user', 'root'),
+    )
+
+
+def open_ports(region: str, cluster_name: str, ports: List[str],
+               provider_config=None) -> None:
+    del region, cluster_name, ports, provider_config
+
+
+def cleanup_ports(region: str, cluster_name: str, ports: List[str],
+                  provider_config=None) -> None:
+    del region, cluster_name, ports, provider_config

@@ -39,7 +39,10 @@ def _exec(payload: Dict[str, Any]) -> Dict[str, Any]:
 def _status(payload: Dict[str, Any]) -> Any:
     from skypilot_tpu import core
     records = core.status(payload.get('cluster_names'),
-                          refresh=payload.get('refresh', False))
+                          refresh=payload.get('refresh', False),
+                          all_workspaces=payload.get('all_workspaces',
+                                                     False),
+                          workspace=payload.get('workspace'))
     out = []
     for r in records:
         r = dict(r)

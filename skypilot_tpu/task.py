@@ -23,11 +23,6 @@ logger = sky_logging.init_logger(__name__)
 _VALID_NAME_REGEX = re.compile(r'^[a-zA-Z0-9]+(?:[._-]{1,2}[a-zA-Z0-9]+)*$')
 _VALID_ENV_VAR = re.compile(r'^[a-zA-Z_][a-zA-Z0-9_]*$')
 
-_TASK_YAML_FIELDS = frozenset({
-    'name', 'resources', 'num_nodes', 'workdir', 'setup', 'run', 'envs',
-    'secrets', 'file_mounts', 'config', 'service', 'estimated',
-})
-
 ResourcesSpec = Union[resources_lib.Resources,
                       List[resources_lib.Resources],
                       Set[resources_lib.Resources]]

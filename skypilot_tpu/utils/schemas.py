@@ -124,9 +124,9 @@ def validate_task_config(config: Dict[str, Any]) -> None:
     validate(config, TASK_SCHEMA)
     res = config.get('resources')
     if isinstance(res, dict):
-        if 'any_of' in res or 'ordered' in res:
-            key = 'any_of' if 'any_of' in res else 'ordered'
+        # Base fields validate even alongside any_of/ordered (they are the
+        # shared defaults every candidate inherits).
+        validate(res, RESOURCES_SCHEMA, 'resources')
+        for key in ('any_of', 'ordered'):
             for i, sub in enumerate(res.get(key) or []):
                 validate(sub, RESOURCES_SCHEMA, f'resources.{key}[{i}]')
-        else:
-            validate(res, RESOURCES_SCHEMA, 'resources')

@@ -23,12 +23,13 @@ def get_active_workspace() -> str:
     return str(config_lib.get_nested(('workspace',), DEFAULT_WORKSPACE))
 
 
-def filter_records(records, all_workspaces: bool = False):
-    """Keep records belonging to the active workspace. Records written
-    before workspaces existed (workspace=None) always show."""
+def filter_records(records, all_workspaces: bool = False,
+                   workspace=None):
+    """Keep records belonging to the active (or given) workspace. Records
+    written before workspaces existed (workspace=None) always show."""
     if all_workspaces:
         return records
-    active = get_active_workspace()
+    active = workspace or get_active_workspace()
     return [r for r in records
             if r.get('workspace') is None          # pre-workspace records
             or r['workspace'] == active]

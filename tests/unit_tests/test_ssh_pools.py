@@ -1,0 +1,82 @@
+"""BYO SSH node pools: allocation, feasibility, release.
+
+Reference analog: sky/ssh_node_pools/ (pools from ~/.sky/ssh_node_pools.yaml).
+"""
+import pytest
+import yaml
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import resources as resources_lib
+from skypilot_tpu.clouds import ssh as ssh_cloud
+from skypilot_tpu.provision import common as provision_common
+from skypilot_tpu.provision.ssh import instance as ssh_instance
+
+
+@pytest.fixture
+def pools(tmp_path, monkeypatch):
+    home = tmp_path / 'home'
+    (home / '.skytpu').mkdir(parents=True)
+    monkeypatch.setenv('HOME', str(home))
+    from skypilot_tpu.utils import locks
+    monkeypatch.setattr(locks, 'LOCK_DIR', str(home / '.skytpu/locks'))
+    cfg = {
+        'v4-pool': {
+            'user': 'ubuntu',
+            'identity_file': '~/.ssh/key',
+            'accelerator': 'tpu-v4-16',
+            'hosts': ['10.0.0.1', '10.0.0.2'],
+        },
+        'cpu-pool': {'user': 'root', 'hosts': ['10.1.0.1']},
+    }
+    with open(home / '.skytpu/ssh_node_pools.yaml', 'w') as f:
+        yaml.safe_dump(cfg, f)
+    yield cfg
+
+
+def _cfg(num_hosts=2):
+    return provision_common.ProvisionConfig(
+        provider_config={'num_hosts': num_hosts, 'num_slices': 1},
+        authentication_config={}, count=1, tags={})
+
+
+@pytest.mark.usefixtures('pools')
+class TestSshPools:
+
+    def test_feasibility_matches_pool_accelerator(self):
+        cloud = ssh_cloud.Ssh()
+        ok = resources_lib.Resources(accelerators='tpu-v4-16')
+        feasible, _ = cloud.get_feasible_launchable_resources(ok)
+        assert len(feasible) == 1
+        no = resources_lib.Resources(accelerators='tpu-v5e-8')
+        feasible, hints = cloud.get_feasible_launchable_resources(no)
+        assert feasible == [] and 'no pool' in hints[0]
+
+    def test_allocate_info_release(self):
+        record = ssh_instance.run_instances('ssh', 'v4-pool', 'c1', _cfg())
+        assert record.created_instance_ids == ['10.0.0.1', '10.0.0.2']
+        info = ssh_instance.get_cluster_info(
+            'ssh', 'c1', {'num_hosts': 2})
+        insts = info.ordered_instances()
+        assert [i.internal_ip for i in insts] == ['10.0.0.1', '10.0.0.2']
+        assert [(i.slice_index, i.worker_id) for i in insts] == [(0, 0),
+                                                                 (0, 1)]
+        assert info.ssh_user == 'ubuntu'
+        # Pool exhausted: a second 2-host cluster is stockout → failover.
+        with pytest.raises(exceptions.InsufficientCapacityError):
+            ssh_instance.run_instances('ssh', 'v4-pool', 'c2', _cfg())
+        ssh_instance.terminate_instances('ssh', 'c1')
+        assert ssh_instance.free_hosts('v4-pool') == ['10.0.0.1', '10.0.0.2']
+
+    def test_idempotent_reprovision(self):
+        ssh_instance.run_instances('ssh', 'v4-pool', 'c1', _cfg())
+        record = ssh_instance.run_instances('ssh', 'v4-pool', 'c1', _cfg())
+        assert record.created_instance_ids == []
+        assert ssh_instance.query_instances('ssh', 'c1') == {
+            '10.0.0.1': 'running', '10.0.0.2': 'running'}
+
+    def test_credentials_require_pools(self, monkeypatch, tmp_path):
+        ok, _ = ssh_cloud.Ssh.check_credentials()
+        assert ok
+        monkeypatch.setenv('HOME', str(tmp_path / 'empty'))
+        ok, reason = ssh_cloud.Ssh.check_credentials()
+        assert not ok and 'No pools' in reason
