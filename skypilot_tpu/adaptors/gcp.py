@@ -23,6 +23,12 @@ _cached_project: Optional[str] = None
 
 
 def get_project_id() -> str:
+    import os
+    # Env wins without touching ADC: resolving credentials just to read a
+    # project id fails on boxes that set the env var but have no ADC.
+    env_project = os.environ.get('GOOGLE_CLOUD_PROJECT')
+    if env_project:
+        return env_project
     _, project = _credentials()
     if not project:
         raise RuntimeError(

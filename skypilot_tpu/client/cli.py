@@ -457,6 +457,57 @@ def serve_down(service_name: str, purge: bool, yes: bool):
 
 
 @cli.group()
+def volumes():
+    """Network volumes (persistent disks) for clusters
+    (reference: `sky volume`)."""
+
+
+@volumes.command(name='apply')
+@click.argument('name', required=True)
+@click.option('--size', type=int, required=True, help='Size in GiB.')
+@click.option('--zone', required=True)
+@click.option('--type', 'disk_type', default='pd-balanced')
+def volumes_apply(name: str, size: int, zone: str, disk_type: str):
+    """Create (or adopt) a persistent disk."""
+    from skypilot_tpu import volumes as volumes_lib
+    try:
+        info = volumes_lib.apply(name, size, zone, disk_type)
+    except exceptions.SkyTpuError as e:
+        raise click.ClickException(str(e)) from e
+    click.echo(f"Volume {info['name']!r}: {info['size_gb']} GiB "
+               f"{info['disk_type']} in {info['zone']}.")
+
+
+@volumes.command(name='ls')
+def volumes_ls():
+    """List volumes."""
+    from skypilot_tpu import volumes as volumes_lib
+    rows = volumes_lib.ls()
+    if not rows:
+        click.echo('No volumes.')
+        return
+    for r in rows:
+        h = r['handle'] or {}
+        click.echo(f"{r['name']}  {h.get('size_gb', '?')}GiB  "
+                   f"{h.get('disk_type', '?')}  {h.get('zone', '?')}  "
+                   f"{r['status']}")
+
+
+@volumes.command(name='delete')
+@click.argument('name', required=True)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def volumes_delete(name: str, yes: bool):
+    """Delete a volume."""
+    from skypilot_tpu import volumes as volumes_lib
+    if not yes:
+        click.confirm(f'Delete volume {name!r}?', abort=True)
+    try:
+        volumes_lib.delete(name)
+    except exceptions.SkyTpuError as e:
+        raise click.ClickException(str(e)) from e
+
+
+@cli.group()
 def api():
     """Manage the API server (reference: `sky api`)."""
 

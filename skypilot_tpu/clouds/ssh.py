@@ -161,7 +161,10 @@ class Ssh(cloud_lib.Cloud):
 
     @classmethod
     def check_credentials(cls) -> Tuple[bool, Optional[str]]:
-        pools = load_pools()
+        try:
+            pools = load_pools()
+        except ValueError as e:
+            return False, str(e)
         if not pools:
             return False, f'No pools configured in {POOLS_PATH}.'
         return True, None

@@ -87,6 +87,29 @@ def rclone_flush_command(dst: str, timeout_s: int = 600) -> str:
         f'done')
 
 
+# --- Attached persistent disks (volumes) -----------------------------------
+
+def volume_mount_command(volume_name: str, mount_path: str) -> str:
+    """Format-if-blank + mount an attached GCP PD on a TPU-VM host.
+
+    The disk surfaces as /dev/disk/by-id/google-<name>; mkfs only runs on
+    a blank disk so existing data survives re-attachment.
+    """
+    dev = f'/dev/disk/by-id/google-{volume_name}'
+    mp = shlex.quote(mount_path)
+    return (
+        f'if [ -e {dev} ]; then '
+        f'  sudo blkid {dev} >/dev/null 2>&1 || '
+        f'    sudo mkfs.ext4 -m 0 -F {dev}; '
+        f'  sudo mkdir -p {mp}; '
+        f'  mountpoint -q {mp} || '
+        f'    sudo mount -o discard,defaults {dev} {mp}; '
+        f'  sudo chmod 777 {mp}; '
+        f'else '
+        f'  echo "[skytpu] volume device {dev} not attached" >&2; exit 1; '
+        f'fi')
+
+
 # --- Local fake-cloud mounts (hermetic miniature of the same contract) -----
 
 def local_copy_command(source: str, dst: str) -> str:

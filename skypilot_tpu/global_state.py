@@ -74,6 +74,13 @@ def _create_tables(conn: sqlite3.Connection) -> None:
             handle TEXT,
             status TEXT
         )""")
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS volumes (
+            name TEXT PRIMARY KEY,
+            created_at REAL,
+            handle TEXT,
+            status TEXT
+        )""")
     conn.commit()
 
 
@@ -233,4 +240,50 @@ def get_storages() -> List[Dict[str, Any]]:
 def remove_storage(name: str) -> None:
     conn = _conn()
     conn.execute('DELETE FROM storage WHERE name = ?', (name,))
+    conn.commit()
+
+
+# ---------------------------------------------------------------------------
+# Volumes
+# ---------------------------------------------------------------------------
+def add_or_update_volume(name: str, handle: Dict[str, Any],
+                         status: str) -> None:
+    conn = _conn()
+    conn.execute(
+        'INSERT INTO volumes (name, created_at, handle, status) '
+        'VALUES (?, ?, ?, ?) ON CONFLICT(name) DO UPDATE SET '
+        'handle=excluded.handle, status=excluded.status',
+        (name, time.time(), json.dumps(handle), status))
+    conn.commit()
+
+
+def get_volume(name: str) -> Optional[Dict[str, Any]]:
+    conn = _conn()
+    conn.row_factory = sqlite3.Row
+    row = conn.execute('SELECT * FROM volumes WHERE name = ?',
+                       (name,)).fetchone()
+    conn.row_factory = None
+    if row is None:
+        return None
+    d = dict(row)
+    d['handle'] = json.loads(d['handle']) if d.get('handle') else None
+    return d
+
+
+def get_volumes() -> List[Dict[str, Any]]:
+    conn = _conn()
+    conn.row_factory = sqlite3.Row
+    rows = conn.execute('SELECT * FROM volumes ORDER BY created_at').fetchall()
+    conn.row_factory = None
+    out = []
+    for r in rows:
+        d = dict(r)
+        d['handle'] = json.loads(d['handle']) if d.get('handle') else None
+        out.append(d)
+    return out
+
+
+def remove_volume(name: str) -> None:
+    conn = _conn()
+    conn.execute('DELETE FROM volumes WHERE name = ?', (name,))
     conn.commit()

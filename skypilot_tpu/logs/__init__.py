@@ -1,0 +1,4 @@
+"""External log shipping (reference analog: sky/logs/)."""
+from skypilot_tpu.logs.agents import setup_command_for_config
+
+__all__ = ['setup_command_for_config']

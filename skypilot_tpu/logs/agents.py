@@ -1,0 +1,96 @@
+"""Log-shipping agents: stream job logs off the cluster hosts.
+
+Reference analog: sky/logs/{gcp,aws}.py — fluentbit configs installed at
+provision time (instance_setup.setup_logging_on_cluster:610). Same hook
+here (provisioner.post_provision_runtime_setup): when the user configures
+
+    logs:
+      store: gcp            # or aws
+      # optional extra labels attached to every record
+      labels: {team: ml}
+
+every host gets a fluent-bit tail → cloud-logging pipeline over
+~/.skytpu_runtime/logs/**. Hosts without fluent-bit log a warning and
+continue — shipping is best-effort observability, never a launch blocker.
+"""
+from __future__ import annotations
+
+import shlex
+from typing import Any, Dict, Optional
+
+_FLUENTBIT_CONF = """\
+[SERVICE]
+    flush 5
+    daemon off
+[INPUT]
+    name tail
+    path {log_glob}
+    tag skytpu.*
+    refresh_interval 10
+[FILTER]
+    name record_modifier
+    match *
+    record cluster {cluster_name}
+{extra_records}
+[OUTPUT]
+{output}
+"""
+
+_GCP_OUTPUT = """\
+    name stackdriver
+    match *
+    resource global
+"""
+
+_AWS_OUTPUT = """\
+    name cloudwatch_logs
+    match *
+    region {region}
+    log_group_name skytpu-{cluster_name}
+    log_stream_prefix host-
+    auto_create_group true
+"""
+
+
+def _conf(store: str, cluster_name: str,
+          labels: Optional[Dict[str, Any]] = None,
+          region: str = 'us-central1') -> str:
+    extra = '\n'.join(f'    record {k} {v}'
+                      for k, v in (labels or {}).items())
+    if store == 'gcp':
+        output = _GCP_OUTPUT
+    elif store == 'aws':
+        output = _AWS_OUTPUT.format(region=region,
+                                    cluster_name=cluster_name)
+    else:
+        raise ValueError(f'Unknown log store {store!r}; '
+                         f"supported: 'gcp', 'aws'.")
+    return _FLUENTBIT_CONF.format(
+        log_glob='$HOME/.skytpu_runtime/logs/*/*.log',
+        cluster_name=cluster_name,
+        extra_records=extra,
+        output=output)
+
+
+def setup_command_for_config(config: Optional[Dict[str, Any]],
+                             cluster_name: str) -> Optional[str]:
+    """The per-host command installing + starting the shipping agent, or
+    None when `logs:` is not configured."""
+    if not config or not config.get('store'):
+        return None
+    conf = _conf(str(config['store']).lower(), cluster_name,
+                 labels=config.get('labels'),
+                 region=str(config.get('region', 'us-central1')))
+    conf_q = shlex.quote(conf)
+    # [f]luent-bit: the bracket keeps pkill from matching (and killing)
+    # the shell executing this very command.
+    return (
+        'if command -v fluent-bit >/dev/null 2>&1; then '
+        f'  printf %s {conf_q} > $HOME/.skytpu_fluentbit.conf && '
+        '  pkill -f "[f]luent-bit.*skytpu_fluentbit" 2>/dev/null; '
+        '  nohup fluent-bit -c $HOME/.skytpu_fluentbit.conf '
+        '    > /tmp/skytpu_fluentbit.log 2>&1 & '
+        'else '
+        '  echo "[skytpu] fluent-bit not installed; log shipping skipped" '
+        '    >&2; '
+        'fi')

@@ -75,6 +75,10 @@ def _node_body(pc: Dict[str, Any], cluster_name: str) -> Dict[str, Any]:
         },
         'dataDisks': [],
     }
+    volume_names = pc.get('volumes') or []
+    if volume_names:
+        from skypilot_tpu.volumes import core as volumes_core
+        body['dataDisks'] = volumes_core.data_disks_for(volume_names)
     topo = pc.get('topology')
     if topo and pc.get('tpu_generation') in ('v4', 'v5p'):
         # Non-default 3D layouts need AcceleratorConfig instead of type.

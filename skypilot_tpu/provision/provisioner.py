@@ -108,12 +108,18 @@ def get_command_runners(
                     context=pc.get('context')))
         else:
             from skypilot_tpu import authentication
+            # BYO ssh pools connect with the POOL's key (nothing installs
+            # the framework key on machines we don't provision).
+            pc = cluster_info.provider_config or {}
+            private_key = (pc.get('identity_file')
+                           if cluster_info.provider_name == 'ssh' else
+                           authentication.PRIVATE_KEY_PATH)
             runners.append(
                 command_runner_lib.SSHCommandRunner(
                     inst.instance_id,
                     inst.get_feasible_ip(),
                     cluster_info.ssh_user,
-                    ssh_private_key=authentication.PRIVATE_KEY_PATH,
+                    ssh_private_key=private_key,
                     port=inst.ssh_port,
                 ))
     return runners
@@ -199,6 +205,42 @@ def post_provision_runtime_setup(cluster_name: str,
                 f'Runtime dir creation failed on {runner.node_id}.')
 
     subprocess_utils.run_in_parallel(_setup_host, runners)
+
+    # Attached volumes: format-if-blank + mount at the task's paths (the
+    # node API only attaches the raw device).
+    volumes_map = (cluster_info.provider_config or {}).get('volumes_map')
+    if volumes_map:
+        from skypilot_tpu.data import mounting_utils
+        mount_cmds = [
+            mounting_utils.volume_mount_command(name, mount_path)
+            for mount_path, name in volumes_map.items()
+        ]
+
+        def _mount_volumes(runner: command_runner_lib.CommandRunner) -> None:
+            for cmd in mount_cmds:
+                rc = runner.run(cmd, log_path='/dev/null')
+                if rc != 0:
+                    raise exceptions.ClusterSetupError(
+                        f'Volume mount failed on {runner.node_id}.')
+
+        subprocess_utils.run_in_parallel(_mount_volumes, runners)
+
+    # External log shipping, if configured (reference analog:
+    # instance_setup.setup_logging_on_cluster:610).
+    from skypilot_tpu import config as config_lib
+    from skypilot_tpu.logs import agents as log_agents
+    ship_cmd = log_agents.setup_command_for_config(
+        config_lib.get_nested(('logs',), None), cluster_name)
+    if ship_cmd is not None:
+        def _ship_logs(runner: command_runner_lib.CommandRunner) -> None:
+            try:
+                runner.run(ship_cmd, log_path='/dev/null')
+            except Exception as e:  # pylint: disable=broad-except
+                # Best-effort observability — never a launch blocker.
+                logger.warning(f'log shipping setup on {runner.node_id} '
+                               f'failed: {e}')
+
+        subprocess_utils.run_in_parallel(_ship_logs, runners)
 
     # Start skylet on the head host (idempotent: kill stale one first).
     head = runners[0]

@@ -56,6 +56,7 @@ class Resources:
         image_id: Optional[str] = None,
         labels: Optional[Dict[str, str]] = None,
         autostop: Optional[Union[int, bool, Dict[str, Any]]] = None,
+        volumes: Optional[Dict[str, str]] = None,
     ):
         self._cloud: Optional[cloud_lib.Cloud] = None
         if cloud is not None:
@@ -77,6 +78,9 @@ class Resources:
         self._disk_tier = disk_tier
         self._image_id = image_id
         self._labels = dict(labels) if labels else {}
+        # {mount_path: volume_name} — persistent disks attached to every
+        # host at provision (reference analog: sky/volumes/).
+        self._volumes = dict(volumes) if volumes else {}
         self._set_ports(ports)
         self._set_autostop(autostop)
 
@@ -169,6 +173,10 @@ class Resources:
         return self._tpu
 
     @property
+    def volumes(self) -> Dict[str, str]:
+        return dict(self._volumes)
+
+    @property
     def accelerators(self) -> Optional[str]:
         return self._tpu.name if self._tpu is not None else self._accelerators_str
 
@@ -256,6 +264,7 @@ class Resources:
             image_id=self._image_id,
             labels=self._labels or None,
             autostop=self._autostop,
+            volumes=self._volumes or None,
         )
         cfg.update(override)
         return Resources(**cfg)
@@ -367,6 +376,7 @@ class Resources:
                 # knob; accept both.
                 spot_recovery=(merged.get('job_recovery') or
                                merged.get('spot_recovery')),
+                volumes=merged.get('volumes'),
                 region=merged.get('region'),
                 zone=merged.get('zone'),
                 cpus=merged.get('cpus'),
@@ -409,6 +419,7 @@ class Resources:
         add('image_id', self._image_id)
         add('labels', self._labels or None)
         add('autostop', self._autostop)
+        add('volumes', self._volumes or None)
         return cfg
 
     def __repr__(self) -> str:

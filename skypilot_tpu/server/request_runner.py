@@ -30,7 +30,14 @@ def main() -> None:
     requests_lib.set_running(rec['request_id'], os.getpid())
     handler, _ = registry.HANDLERS[rec['name']]
     try:
-        result = handler(rec['payload'])
+        # Per-request config isolation (reference analog:
+        # sky/utils/context.py contextvars): the client's config overrides
+        # apply to THIS request only — the subprocess boundary guarantees
+        # no bleed into sibling requests.
+        from skypilot_tpu import config as config_lib
+        payload = rec['payload']
+        with config_lib.override(payload.get('_config_overrides') or {}):
+            result = handler(payload)
     except SystemExit as e:
         if e.code in (None, 0):
             requests_lib.set_result(rec['request_id'], None)

@@ -57,14 +57,34 @@ def _api_token() -> str:
 
 @web.middleware
 async def auth_middleware(request: web.Request, handler):
-    token = request.app['api_token']
     # The HTML shell is public (it holds no data); its data endpoint and
-    # everything else stays behind the token (/dashboard?token=... wires
-    # the header in client-side).
+    # everything else stays behind auth (/dashboard?token=... wires the
+    # header in client-side).
     open_paths = ('/api/v1/health', '/dashboard')
+    got = request.headers.get('Authorization', '')
+
+    # Multi-user mode (users file present): token → user, with role
+    # enforcement on request submission (sky/users RBAC analog).
+    users = request.app['users']
+    if users:
+        if request.path in open_paths:
+            return await handler(request)
+        from skypilot_tpu.users import rbac
+        user = rbac.resolve_user(got, users)
+        if user is None:
+            return _json({'error': 'unauthorized'}, status=401)
+        name = request.match_info.get('name')
+        if (name is not None and request.method == 'POST' and
+                not user.role.may_submit(name)):
+            return _json({'error': f'role {user.role.value!r} may not '
+                                   f'submit {name!r}'}, status=403)
+        request['user'] = user
+        return await handler(request)
+
+    # Single shared-token mode.
+    token = request.app['api_token']
     if token and request.path not in open_paths:
         import hmac
-        got = request.headers.get('Authorization', '')
         if not hmac.compare_digest(got, f'Bearer {token}'):
             return _json({'error': 'unauthorized'}, status=401)
     return await handler(request)
@@ -84,8 +104,10 @@ async def submit(request: web.Request) -> web.Response:
     except json.JSONDecodeError:
         payload = {}
     _, sched_type = registry.HANDLERS[name]
+    user = request.get('user')
+    user_name = user.name if user else request.headers.get('X-User', '')
     request_id = requests_lib.create(name, payload, sched_type,
-                                     user=request.headers.get('X-User', ''))
+                                     user=user_name)
     return _json({'request_id': request_id})
 
 
@@ -258,6 +280,8 @@ def build_app() -> web.Application:
     _SERVER_START_TIME = time_lib.time()
     app = web.Application(middlewares=[auth_middleware])
     app['api_token'] = _api_token()
+    from skypilot_tpu.users import rbac
+    app['users'] = rbac.load_users()
     app.router.add_get('/api/v1/health', health)
     app.router.add_get('/api/v1/get', get_request)
     app.router.add_get('/api/v1/stream', stream)

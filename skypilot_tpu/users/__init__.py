@@ -1,0 +1,5 @@
+"""Users + role-based access control (reference analog: sky/users/)."""
+from skypilot_tpu.users.rbac import Role
+from skypilot_tpu.users.rbac import resolve_user
+
+__all__ = ['Role', 'resolve_user']

@@ -1,0 +1,90 @@
+"""Token→user resolution + role enforcement for the API server.
+
+Reference analog: sky/users/ (casbin RBAC, RoleName at sky/users/rbac.py:43)
+— redesigned to a declarative users file, no policy engine:
+
+~/.skytpu/server_users.yaml:
+    users:
+      - name: alice
+        token: a-long-random-string
+        role: admin          # admin | user | viewer
+      - name: bob
+        token: another-long-random-string
+        role: viewer
+
+Roles: admin = everything; user = everything except user management;
+viewer = read-only requests. When the users file is absent, the server
+falls back to the single shared token (SKYTPU_API_TOKEN) or open local
+mode — multi-user is opt-in.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import hmac
+import os
+from typing import Dict, Optional
+
+USERS_PATH = '~/.skytpu/server_users.yaml'
+
+# Handler names a viewer may invoke (read-only surface).
+READ_ONLY_REQUESTS = frozenset({
+    'status', 'queue', 'logs', 'check', 'cost_report', 'list_accelerators',
+    'jobs_queue', 'jobs_logs', 'serve_status',
+})
+
+
+class Role(enum.Enum):
+    ADMIN = 'admin'
+    USER = 'user'
+    VIEWER = 'viewer'
+
+    def may_submit(self, request_name: str) -> bool:
+        if self in (Role.ADMIN, Role.USER):
+            return True
+        return request_name in READ_ONLY_REQUESTS
+
+
+@dataclasses.dataclass(frozen=True)
+class User:
+    name: str
+    role: Role
+
+
+def load_users(path: Optional[str] = None) -> Dict[str, User]:
+    """{token: User} from the users file; {} when multi-user is off."""
+    import yaml
+    path = os.path.expanduser(path or USERS_PATH)
+    if not os.path.exists(path):
+        return {}
+    with open(path, 'r', encoding='utf-8') as f:
+        data = yaml.safe_load(f) or {}
+    out: Dict[str, User] = {}
+    for entry in data.get('users') or []:
+        token = str(entry.get('token', ''))
+        if not token:
+            continue
+        raw_role = str(entry.get('role', 'user')).lower()
+        try:
+            role = Role(raw_role)
+        except ValueError as e:
+            raise ValueError(
+                f'{USERS_PATH}: user {entry.get("name", "?")!r} has '
+                f'unknown role {raw_role!r}; valid: '
+                f'{[r.value for r in Role]}') from e
+        out[token] = User(name=str(entry.get('name', 'unnamed')), role=role)
+    return out
+
+
+def resolve_user(authorization_header: str,
+                 users: Optional[Dict[str, User]] = None) -> Optional[User]:
+    """Bearer token → User (constant-time compare), or None."""
+    if users is None:
+        users = load_users()
+    if not authorization_header.startswith('Bearer '):
+        return None
+    token = authorization_header[len('Bearer '):]
+    for known, user in users.items():
+        if hmac.compare_digest(token, known):
+            return user
+    return None

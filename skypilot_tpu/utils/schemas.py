@@ -96,6 +96,7 @@ RESOURCES_SCHEMA: Dict[str, Field] = {
     'image_id': Field(_STR),
     'labels': Field((dict,), nested={'*': Field(_STR_NUM)}),
     'autostop': Field((int, bool, dict)),
+    'volumes': Field((dict,), nested={'*': Field(_STR)}),
     'any_of': Field((list,)),
     'ordered': Field((list,)),
 }

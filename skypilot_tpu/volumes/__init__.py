@@ -1,0 +1,6 @@
+"""Network volumes (reference analog: sky/volumes/)."""
+from skypilot_tpu.volumes.core import apply
+from skypilot_tpu.volumes.core import delete
+from skypilot_tpu.volumes.core import ls
+
+__all__ = ['apply', 'ls', 'delete']

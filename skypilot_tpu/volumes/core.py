@@ -1,0 +1,126 @@
+"""Volume CRUD: persistent disks attachable to TPU-VM clusters.
+
+Reference analog: sky/volumes/ (`sky volume apply/ls/delete`, 772 LoC).
+GCP persistent disks via the compute REST API (same thin-client pattern as
+provision/gcp/tpu_api.py); volume records live in the control-plane DB so
+`skytpu volumes ls` works offline. Tasks attach volumes with
+
+    volumes:
+      /mnt/data: my-volume
+
+which lands in the TPU node body's dataDisks at provision time
+(provision/gcp/instance._node_body).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+import requests
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import global_state
+from skypilot_tpu import sky_logging
+from skypilot_tpu.adaptors import gcp as gcp_adaptor
+
+logger = sky_logging.init_logger(__name__)
+
+_COMPUTE_ROOT = 'https://compute.googleapis.com/compute/v1'
+_TIMEOUT = 60
+
+
+def _headers() -> Dict[str, str]:
+    return {'Authorization': f'Bearer {gcp_adaptor.get_access_token()}',
+            'Content-Type': 'application/json'}
+
+
+def _request(method: str, url: str,
+             json_body: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    resp = requests.request(method, url, headers=_headers(), json=json_body,
+                            timeout=_TIMEOUT)
+    if resp.status_code == 404:
+        raise exceptions.ClusterDoesNotExist(f'{url} -> 404')
+    if resp.status_code >= 400:
+        raise exceptions.StorageError(
+            f'{method} {url} -> {resp.status_code}: {resp.text}')
+    return resp.json() if resp.text else {}
+
+
+def _wait_zone_op(project: str, zone: str, op_name: str,
+                  timeout: float = 300) -> None:
+    url = f'{_COMPUTE_ROOT}/projects/{project}/zones/{zone}/operations/{op_name}'
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        op = _request('GET', url)
+        if op.get('status') == 'DONE':
+            if op.get('error'):
+                raise exceptions.StorageError(str(op['error']))
+            return
+        time.sleep(2)
+    raise exceptions.StorageError(f'operation {op_name} timed out')
+
+
+def apply(name: str, size_gb: int, zone: str,
+          disk_type: str = 'pd-balanced',
+          project: Optional[str] = None) -> Dict[str, Any]:
+    """Create (or adopt, if it already exists) a persistent disk."""
+    project = project or gcp_adaptor.get_project_id()
+    url = f'{_COMPUTE_ROOT}/projects/{project}/zones/{zone}/disks'
+    try:
+        _request('GET', f'{url}/{name}')
+        logger.info(f'Volume {name!r} already exists in {zone}; adopting.')
+    except exceptions.ClusterDoesNotExist:
+        body = {
+            'name': name,
+            'sizeGb': str(size_gb),
+            'type': f'projects/{project}/zones/{zone}/diskTypes/{disk_type}',
+            'labels': {'skytpu-volume': name},
+        }
+        op = _request('POST', url, json_body=body)
+        _wait_zone_op(project, zone, op['name'])
+        logger.info(f'Volume {name!r} ({size_gb} GiB {disk_type}) created '
+                    f'in {zone}.')
+    handle = {'project': project, 'zone': zone, 'size_gb': size_gb,
+              'disk_type': disk_type}
+    global_state.add_or_update_volume(name, handle, 'READY')
+    return {'name': name, **handle}
+
+
+def ls() -> List[Dict[str, Any]]:
+    return global_state.get_volumes()
+
+
+def delete(name: str) -> None:
+    record = global_state.get_volume(name)
+    if record is None:
+        raise exceptions.StorageError(f'Volume {name!r} not found.')
+    handle = record['handle'] or {}
+    project, zone = handle.get('project'), handle.get('zone')
+    if project and zone:
+        url = (f'{_COMPUTE_ROOT}/projects/{project}/zones/{zone}/'
+               f'disks/{name}')
+        try:
+            op = _request('DELETE', url)
+            _wait_zone_op(project, zone, op['name'])
+        except exceptions.ClusterDoesNotExist:
+            pass   # already gone on the cloud side
+    global_state.remove_volume(name)
+    logger.info(f'Volume {name!r} deleted.')
+
+
+def data_disks_for(volume_names: List[str]) -> List[Dict[str, Any]]:
+    """dataDisks entries for a TPU node body (read-write, keep on delete)."""
+    disks = []
+    for name in volume_names:
+        record = global_state.get_volume(name)
+        if record is None:
+            raise exceptions.StorageError(
+                f'Volume {name!r} not found; create it with '
+                f'`skytpu volumes apply`.')
+        handle = record['handle'] or {}
+        disks.append({
+            'sourceDisk': (f'projects/{handle["project"]}/zones/'
+                           f'{handle["zone"]}/disks/{name}'),
+            'mode': 'READ_WRITE',
+        })
+    return disks

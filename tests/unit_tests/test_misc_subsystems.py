@@ -114,3 +114,114 @@ class TestUsage:
         usage_lib.record_event('x')
         assert not os.path.exists(
             os.path.join(str(tmp_path), '.skytpu/usage/events.jsonl'))
+
+
+class TestLogShipping:
+    """sky/logs analog: fluent-bit command generation + provision hook."""
+
+    def test_no_config_no_command(self):
+        from skypilot_tpu.logs import agents
+        assert agents.setup_command_for_config(None, 'c') is None
+        assert agents.setup_command_for_config({}, 'c') is None
+
+    def test_gcp_and_aws_configs(self):
+        from skypilot_tpu.logs import agents
+        cmd = agents.setup_command_for_config(
+            {'store': 'gcp', 'labels': {'team': 'ml'}}, 'train-1')
+        assert 'stackdriver' in cmd and 'record team ml' in cmd
+        assert 'fluent-bit not installed' in cmd   # graceful degrade
+        cmd = agents.setup_command_for_config(
+            {'store': 'aws', 'region': 'us-east-1'}, 'train-1')
+        assert 'cloudwatch_logs' in cmd and 'us-east-1' in cmd
+        with pytest.raises(ValueError, match='Unknown log store'):
+            agents.setup_command_for_config({'store': 'datadog'}, 'c')
+
+    def test_provision_hook_runs_on_all_hosts(self, enable_local_cloud,
+                                              isolated_state):
+        """With `logs:` configured, every host of a launch runs the agent
+        setup (fluent-bit is absent here, so it degrades to the warning —
+        asserting the hook fired, not the agent)."""
+        from skypilot_tpu import config as config_lib
+        task = sky.Task(name='ls', run='echo hi')
+        task.set_resources(sky.Resources(accelerators='tpu-v5e-16'))
+        task.config_overrides = {'logs': {'store': 'gcp'}}
+        with config_lib.override({'logs': {'store': 'gcp'}}):
+            job_id, handle = sky.launch(task, cluster_name='t-logs',
+                                        detach_run=True)
+        try:
+            info = handle.get_cluster_info()
+            # The conf write is gated on fluent-bit presence; the hook
+            # itself ran if the command executed without failing launch.
+            assert len(info.ordered_instances()) == 4
+        finally:
+            sky.down('t-logs')
+
+
+class TestVolumes:
+    """Volume CRUD against a fake compute API + node-body attachment."""
+
+    @pytest.fixture
+    def fake_compute(self, tmp_path, monkeypatch):
+        monkeypatch.setenv('HOME', str(tmp_path))
+        monkeypatch.setenv('GOOGLE_CLOUD_PROJECT', 'p')
+        from skypilot_tpu.volumes import core as vc
+        disks = {}
+
+        def fake_request(method, url, json_body=None):
+            parts = url.split('/')
+            if '/operations/' in url:
+                return {'status': 'DONE'}
+            if method == 'GET' and parts[-2] == 'disks':
+                if parts[-1] not in disks:
+                    from skypilot_tpu import exceptions
+                    raise exceptions.ClusterDoesNotExist(url)
+                return disks[parts[-1]]
+            if method == 'POST' and parts[-1] == 'disks':
+                disks[json_body['name']] = json_body
+                return {'name': 'op-create'}
+            if method == 'DELETE':
+                disks.pop(parts[-1], None)
+                return {'name': 'op-delete'}
+            raise AssertionError(f'unhandled {method} {url}')
+
+        monkeypatch.setattr(vc, '_request', fake_request)
+        monkeypatch.setattr(vc, '_wait_zone_op',
+                            lambda *a, **k: None)
+        yield disks
+
+    def test_apply_ls_attach_delete(self, fake_compute):
+        from skypilot_tpu import volumes as volumes_lib
+        from skypilot_tpu.volumes import core as vc
+        info = volumes_lib.apply('data-1', 200, 'us-central2-b')
+        assert info['zone'] == 'us-central2-b'
+        assert 'data-1' in fake_compute
+        assert [v['name'] for v in volumes_lib.ls()] == ['data-1']
+        disks = vc.data_disks_for(['data-1'])
+        assert disks[0]['sourceDisk'].endswith(
+            'zones/us-central2-b/disks/data-1')
+        # Applying again adopts, not recreates.
+        volumes_lib.apply('data-1', 200, 'us-central2-b')
+        volumes_lib.delete('data-1')
+        assert volumes_lib.ls() == []
+        assert 'data-1' not in fake_compute
+
+    def test_attach_unknown_volume_fails(self, fake_compute):
+        from skypilot_tpu import exceptions
+        from skypilot_tpu.volumes import core as vc
+        with pytest.raises(exceptions.StorageError, match='not found'):
+            vc.data_disks_for(['ghost'])
+
+    def test_resources_yaml_roundtrip(self):
+        import skypilot_tpu as sky
+        res = sky.Resources.from_yaml_config({
+            'accelerators': 'tpu-v5p-8',
+            'volumes': {'/mnt/data': 'data-1'}})
+        assert res.volumes == {'/mnt/data': 'data-1'}
+        assert res.to_yaml_config()['volumes'] == {'/mnt/data': 'data-1'}
+
+    def test_volume_mount_command(self):
+        from skypilot_tpu.data import mounting_utils
+        cmd = mounting_utils.volume_mount_command('data-1', '/mnt/data')
+        assert '/dev/disk/by-id/google-data-1' in cmd
+        assert 'mkfs.ext4' in cmd and 'blkid' in cmd   # format only if blank
+        assert 'mount -o discard,defaults' in cmd

@@ -109,6 +109,52 @@ class TestApiServer:
 
 
 @pytest.mark.usefixtures('isolated_server')
+class TestRbac:
+
+    @pytest.fixture(autouse=True)
+    def users_file(self, tmp_path, monkeypatch):
+        import yaml
+        home = tmp_path / 'rbac_home'
+        (home / '.skytpu').mkdir(parents=True)
+        monkeypatch.setenv('HOME', str(home))
+        with open(home / '.skytpu/server_users.yaml', 'w') as f:
+            yaml.safe_dump({'users': [
+                {'name': 'alice', 'token': 'alice-token', 'role': 'admin'},
+                {'name': 'bob', 'token': 'bob-token', 'role': 'viewer'},
+            ]}, f)
+        yield
+
+    def test_roles_enforced(self):
+        async def fn(client):
+            # No token → 401.
+            r = await client.post('/api/v1/status', json={})
+            assert r.status == 401
+            # Viewer: read-only ok, mutation 403.
+            bob = {'Authorization': 'Bearer bob-token'}
+            r = await client.post('/api/v1/status', json={}, headers=bob)
+            assert r.status == 200
+            r = await client.post('/api/v1/launch', json={}, headers=bob)
+            assert r.status == 403
+            assert 'viewer' in (await r.json())['error']
+            # Admin: everything; request records carry the user name.
+            alice = {'Authorization': 'Bearer alice-token'}
+            r = await client.post('/api/v1/down',
+                                  json={'cluster_name': 'x'}, headers=alice)
+            assert r.status == 200
+            rid = (await r.json())['request_id']
+            assert requests_lib.get(rid)['user'] == 'alice'
+        _with_client(fn)
+
+    def test_resolve_user_constant_time_api(self):
+        from skypilot_tpu.users import rbac
+        users = rbac.load_users()
+        assert rbac.resolve_user('Bearer alice-token',
+                                 users).role is rbac.Role.ADMIN
+        assert rbac.resolve_user('Bearer wrong', users) is None
+        assert rbac.resolve_user('alice-token', users) is None  # no scheme
+
+
+@pytest.mark.usefixtures('isolated_server')
 class TestRequestGC:
 
     def test_gc_prunes_old_terminal_requests(self):
