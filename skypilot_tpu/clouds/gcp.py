@@ -152,7 +152,6 @@ class GCP(cloud_lib.Cloud):
             'reserved': bool(args.get('reserved', False)),
             'disk_size_gb': resources.disk_size,
             'labels': resources.labels,
-            'volumes': list(resources.volumes.values()),
             'volumes_map': resources.volumes,
             'ports': resources.ports,
             'cluster_name': cluster_name,

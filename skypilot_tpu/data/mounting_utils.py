@@ -89,25 +89,30 @@ def rclone_flush_command(dst: str, timeout_s: int = 600) -> str:
 
 # --- Attached persistent disks (volumes) -----------------------------------
 
-def volume_mount_command(volume_name: str, mount_path: str) -> str:
-    """Format-if-blank + mount an attached GCP PD on a TPU-VM host.
+def volume_mount_command(disk_index: int, mount_path: str,
+                         read_only: bool = False) -> str:
+    """Format-if-blank + mount the `disk_index`-th attached data disk.
 
-    The disk surfaces as /dev/disk/by-id/google-<name>; mkfs only runs on
-    a blank disk so existing data survives re-attachment.
+    The TPU API's AttachedDisk has no deviceName field, so GCE names data
+    disks positionally: /dev/disk/by-id/google-persistent-disk-<N> with
+    N=0 the boot disk — the first dataDisks entry is N=1. mkfs only runs
+    on a blank disk (and never on read-only attachments) so existing data
+    survives re-attachment. The command's exit status reflects the MOUNT,
+    not the trailing chmod.
     """
-    dev = f'/dev/disk/by-id/google-{volume_name}'
+    dev = f'/dev/disk/by-id/google-persistent-disk-{disk_index + 1}'
     mp = shlex.quote(mount_path)
+    opts = 'ro' if read_only else 'discard,defaults'
+    fmt = ('true' if read_only else
+           f'sudo blkid {dev} >/dev/null 2>&1 || '
+           f'sudo mkfs.ext4 -m 0 -F {dev}')
+    chmod = '' if read_only else f' && sudo chmod 777 {mp}'
     return (
-        f'if [ -e {dev} ]; then '
-        f'  sudo blkid {dev} >/dev/null 2>&1 || '
-        f'    sudo mkfs.ext4 -m 0 -F {dev}; '
-        f'  sudo mkdir -p {mp}; '
-        f'  mountpoint -q {mp} || '
-        f'    sudo mount -o discard,defaults {dev} {mp}; '
-        f'  sudo chmod 777 {mp}; '
-        f'else '
+        f'if [ ! -e {dev} ]; then '
         f'  echo "[skytpu] volume device {dev} not attached" >&2; exit 1; '
-        f'fi')
+        f'fi && ({fmt}) && sudo mkdir -p {mp} && '
+        f'(mountpoint -q {mp} || sudo mount -o {opts} {dev} {mp})'
+        f'{chmod}')
 
 
 # --- Local fake-cloud mounts (hermetic miniature of the same contract) -----

@@ -66,7 +66,9 @@ def _conf(store: str, cluster_name: str,
         raise ValueError(f'Unknown log store {store!r}; '
                          f"supported: 'gcp', 'aws'.")
     return _FLUENTBIT_CONF.format(
-        log_glob='$HOME/.skytpu_runtime/logs/*/*.log',
+        # Placeholder expanded by the shell at install time — fluent-bit
+        # does not expand $HOME in config values.
+        log_glob='__SKYTPU_HOME__/.skytpu_runtime/logs/*/*.log',
         cluster_name=cluster_name,
         extra_records=extra,
         output=output)
@@ -86,7 +88,8 @@ def setup_command_for_config(config: Optional[Dict[str, Any]],
     # the shell executing this very command.
     return (
         'if command -v fluent-bit >/dev/null 2>&1; then '
-        f'  printf %s {conf_q} > $HOME/.skytpu_fluentbit.conf && '
+        f'  printf %s {conf_q} | sed "s|__SKYTPU_HOME__|$HOME|g" '
+        '    > $HOME/.skytpu_fluentbit.conf && '
         '  pkill -f "[f]luent-bit.*skytpu_fluentbit" 2>/dev/null; '
         '  nohup fluent-bit -c $HOME/.skytpu_fluentbit.conf '
         '    > /tmp/skytpu_fluentbit.log 2>&1 & '

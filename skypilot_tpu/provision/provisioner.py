@@ -208,12 +208,19 @@ def post_provision_runtime_setup(cluster_name: str,
 
     # Attached volumes: format-if-blank + mount at the task's paths (the
     # node API only attaches the raw device).
-    volumes_map = (cluster_info.provider_config or {}).get('volumes_map')
+    pc_cfg = cluster_info.provider_config or {}
+    volumes_map = pc_cfg.get('volumes_map')
     if volumes_map:
         from skypilot_tpu.data import mounting_utils
+        multi_host = (int(pc_cfg.get('num_hosts', 1)) > 1 or
+                      int(pc_cfg.get('num_slices', 1)) > 1)
+        # Same sorted-by-mount-path order as the dataDisks list in
+        # provision/gcp/instance._node_body: index i ↔ device
+        # google-persistent-disk-(i+1).
         mount_cmds = [
-            mounting_utils.volume_mount_command(name, mount_path)
-            for mount_path, name in volumes_map.items()
+            mounting_utils.volume_mount_command(i, mount_path,
+                                                read_only=multi_host)
+            for i, mount_path in enumerate(sorted(volumes_map))
         ]
 
         def _mount_volumes(runner: command_runner_lib.CommandRunner) -> None:

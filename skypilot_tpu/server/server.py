@@ -73,11 +73,14 @@ async def auth_middleware(request: web.Request, handler):
         user = rbac.resolve_user(got, users)
         if user is None:
             return _json({'error': 'unauthorized'}, status=401)
-        name = request.match_info.get('name')
-        if (name is not None and request.method == 'POST' and
-                not user.role.may_submit(name)):
-            return _json({'error': f'role {user.role.value!r} may not '
-                                   f'submit {name!r}'}, status=403)
+        if request.method == 'POST':
+            # Fixed-path mutations (request_cancel) gate exactly like named
+            # request submissions — a viewer must not cancel others' work.
+            name = request.match_info.get('name') or \
+                request.path.rsplit('/', 1)[-1]
+            if not user.role.may_submit(name):
+                return _json({'error': f'role {user.role.value!r} may not '
+                                       f'submit {name!r}'}, status=403)
         request['user'] = user
         return await handler(request)
 

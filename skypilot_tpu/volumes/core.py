@@ -67,8 +67,13 @@ def apply(name: str, size_gb: int, zone: str,
     project = project or gcp_adaptor.get_project_id()
     url = f'{_COMPUTE_ROOT}/projects/{project}/zones/{zone}/disks'
     try:
-        _request('GET', f'{url}/{name}')
-        logger.info(f'Volume {name!r} already exists in {zone}; adopting.')
+        existing = _request('GET', f'{url}/{name}')
+        # Adopt the disk AS IT IS — recording the requested size/type for
+        # a pre-existing disk would lie to `volumes ls`.
+        size_gb = int(existing.get('sizeGb', size_gb))
+        disk_type = existing.get('type', disk_type).rsplit('/', 1)[-1]
+        logger.info(f'Volume {name!r} already exists in {zone} '
+                    f'({size_gb} GiB {disk_type}); adopting.')
     except exceptions.ClusterDoesNotExist:
         body = {
             'name': name,
@@ -108,8 +113,14 @@ def delete(name: str) -> None:
     logger.info(f'Volume {name!r} deleted.')
 
 
-def data_disks_for(volume_names: List[str]) -> List[Dict[str, Any]]:
-    """dataDisks entries for a TPU node body (read-write, keep on delete)."""
+def data_disks_for(volume_names: List[str],
+                   read_only: bool = False) -> List[Dict[str, Any]]:
+    """dataDisks entries for a TPU node body.
+
+    `read_only=True` for multi-host slices / multislice clusters: a
+    non-multi-writer PD can only attach READ_WRITE to a single host, so
+    multi-host attachments must be READ_ONLY or GCP rejects the create.
+    """
     disks = []
     for name in volume_names:
         record = global_state.get_volume(name)
@@ -121,6 +132,6 @@ def data_disks_for(volume_names: List[str]) -> List[Dict[str, Any]]:
         disks.append({
             'sourceDisk': (f'projects/{handle["project"]}/zones/'
                            f'{handle["zone"]}/disks/{name}'),
-            'mode': 'READ_WRITE',
+            'mode': 'READ_ONLY' if read_only else 'READ_WRITE',
         })
     return disks

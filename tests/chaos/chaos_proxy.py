@@ -1,0 +1,97 @@
+"""TCP chaos proxy: forwards client↔server traffic, killing every Nth
+connection mid-flight.
+
+Reference analog: tests/chaos/chaos_proxy.py — placed between the client
+and the API server to prove the control plane degrades cleanly (clear
+errors, no corrupted state) under network faults.
+"""
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Optional
+
+
+class ChaosProxy:
+
+    def __init__(self, upstream_host: str, upstream_port: int,
+                 kill_every: int = 3):
+        """Every `kill_every`-th connection is accepted then torn down
+        after the first payload bytes flow — the nastiest failure point."""
+        self.upstream = (upstream_host, upstream_port)
+        self.kill_every = kill_every
+        self._count = 0
+        self._lock = threading.Lock()
+        self._listener: Optional[socket.socket] = None
+        self._stop = threading.Event()
+        self.port: Optional[int] = None
+
+    # ------------------------------------------------------------------
+    def start(self) -> int:
+        self._listener = socket.socket()
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind(('127.0.0.1', 0))
+        self._listener.listen(32)
+        self.port = self._listener.getsockname()[1]
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+        return self.port
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+    # ------------------------------------------------------------------
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                client, _ = self._listener.accept()
+            except OSError:
+                return
+            with self._lock:
+                self._count += 1
+                doomed = (self._count % self.kill_every == 0)
+            threading.Thread(target=self._handle,
+                             args=(client, doomed), daemon=True).start()
+
+    def _handle(self, client: socket.socket, doomed: bool) -> None:
+        try:
+            upstream = socket.create_connection(self.upstream, timeout=10)
+        except OSError:
+            client.close()
+            return
+
+        def pump(src, dst, kill_after_first: bool):
+            try:
+                while True:
+                    data = src.recv(65536)
+                    if not data:
+                        break
+                    dst.sendall(data)
+                    if kill_after_first:
+                        # Chaos: first bytes made it through, then the
+                        # connection dies (RST via SO_LINGER 0).
+                        for s in (client, upstream):
+                            try:
+                                s.setsockopt(
+                                    socket.SOL_SOCKET, socket.SO_LINGER,
+                                    b'\x01\x00\x00\x00\x00\x00\x00\x00')
+                                s.close()
+                            except OSError:
+                                pass
+                        return
+            except OSError:
+                pass
+            finally:
+                for s in (client, upstream):
+                    try:
+                        s.shutdown(socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+
+        threading.Thread(target=pump, args=(upstream, client, False),
+                         daemon=True).start()
+        pump(client, upstream, doomed)

@@ -221,7 +221,15 @@ class TestVolumes:
 
     def test_volume_mount_command(self):
         from skypilot_tpu.data import mounting_utils
-        cmd = mounting_utils.volume_mount_command('data-1', '/mnt/data')
-        assert '/dev/disk/by-id/google-data-1' in cmd
+        # Positional device naming: the TPU API has no deviceName, so the
+        # i-th data disk is google-persistent-disk-(i+1) (boot disk is 0).
+        cmd = mounting_utils.volume_mount_command(0, '/mnt/data')
+        assert '/dev/disk/by-id/google-persistent-disk-1' in cmd
         assert 'mkfs.ext4' in cmd and 'blkid' in cmd   # format only if blank
         assert 'mount -o discard,defaults' in cmd
+        # A failed mount must fail the command (chmod can't mask it).
+        assert not cmd.rstrip().endswith(';')
+        ro = mounting_utils.volume_mount_command(1, '/mnt/data',
+                                                 read_only=True)
+        assert 'google-persistent-disk-2' in ro
+        assert 'mount -o ro' in ro and 'mkfs' not in ro
