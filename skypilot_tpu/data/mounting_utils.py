@@ -107,12 +107,16 @@ def volume_mount_command(disk_index: int, mount_path: str,
            f'sudo blkid {dev} >/dev/null 2>&1 || '
            f'sudo mkfs.ext4 -m 0 -F {dev}')
     chmod = '' if read_only else f' && sudo chmod 777 {mp}'
+    ro_hint = ('' if not read_only else
+               ' || { echo "[skytpu] read-only mount failed — a blank '
+               'volume has no filesystem; format it by attaching to a '
+               'single-host cluster once" >&2; exit 1; }')
     return (
         f'if [ ! -e {dev} ]; then '
         f'  echo "[skytpu] volume device {dev} not attached" >&2; exit 1; '
         f'fi && ({fmt}) && sudo mkdir -p {mp} && '
         f'(mountpoint -q {mp} || sudo mount -o {opts} {dev} {mp})'
-        f'{chmod}')
+        f'{ro_hint}{chmod}')
 
 
 # --- Local fake-cloud mounts (hermetic miniature of the same contract) -----

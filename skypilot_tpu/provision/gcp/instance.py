@@ -75,16 +75,11 @@ def _node_body(pc: Dict[str, Any], cluster_name: str) -> Dict[str, Any]:
         },
         'dataDisks': [],
     }
-    volumes_map = pc.get('volumes_map') or {}
-    if volumes_map:
+    if pc.get('volumes_map'):
         from skypilot_tpu.volumes import core as volumes_core
-        multi_host = (int(pc.get('num_hosts', 1)) > 1 or
-                      int(pc.get('num_slices', 1)) > 1)
-        # Sorted by mount path: the SAME order post-provision mounting
-        # uses to map positional device names back to volumes.
-        names = [volumes_map[k] for k in sorted(volumes_map)]
+        names, _, read_only = volumes_core.attachment_plan(pc)
         body['dataDisks'] = volumes_core.data_disks_for(
-            names, read_only=multi_host)
+            names, read_only=read_only)
     topo = pc.get('topology')
     if topo and pc.get('tpu_generation') in ('v4', 'v5p'):
         # Non-default 3D layouts need AcceleratorConfig instead of type.

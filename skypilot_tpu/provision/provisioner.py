@@ -209,18 +209,16 @@ def post_provision_runtime_setup(cluster_name: str,
     # Attached volumes: format-if-blank + mount at the task's paths (the
     # node API only attaches the raw device).
     pc_cfg = cluster_info.provider_config or {}
-    volumes_map = pc_cfg.get('volumes_map')
-    if volumes_map:
+    if pc_cfg.get('volumes_map'):
         from skypilot_tpu.data import mounting_utils
-        multi_host = (int(pc_cfg.get('num_hosts', 1)) > 1 or
-                      int(pc_cfg.get('num_slices', 1)) > 1)
-        # Same sorted-by-mount-path order as the dataDisks list in
-        # provision/gcp/instance._node_body: index i ↔ device
-        # google-persistent-disk-(i+1).
+        from skypilot_tpu.volumes import core as volumes_core
+        # attachment_plan is the single ordering/read-only authority shared
+        # with the attach side: index i ↔ device google-persistent-disk-(i+1).
+        _, mounts, read_only = volumes_core.attachment_plan(pc_cfg)
         mount_cmds = [
             mounting_utils.volume_mount_command(i, mount_path,
-                                                read_only=multi_host)
-            for i, mount_path in enumerate(sorted(volumes_map))
+                                                read_only=read_only)
+            for i, mount_path in enumerate(mounts)
         ]
 
         def _mount_volumes(runner: command_runner_lib.CommandRunner) -> None:

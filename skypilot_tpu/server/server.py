@@ -120,8 +120,12 @@ async def get_request(request: web.Request) -> web.Response:
     rec = requests_lib.get(request_id)
     if rec is None:
         return _json({'error': f'no request {request_id!r}'}, status=404)
+    # Adaptive backoff: snappy for short requests, 1 Hz for long ones —
+    # a fixed 0.2s poll per waiting client hammers sqlite under load.
+    delay = 0.1
     while wait and not requests_lib.RequestStatus(rec['status']).is_terminal():
-        await asyncio.sleep(0.2)
+        await asyncio.sleep(delay)
+        delay = min(delay * 1.5, 1.0)
         rec = requests_lib.get(request_id)
     return _json(rec)
 
@@ -138,6 +142,7 @@ async def stream(request: web.Request) -> web.StreamResponse:
         headers={'Content-Type': 'text/plain; charset=utf-8'})
     await resp.prepare(request)
     pos = 0
+    delay = 0.1
     while True:
         chunk = b''
         if os.path.exists(path):
@@ -158,7 +163,12 @@ async def stream(request: web.Request) -> web.StreamResponse:
                 if tail:
                     await resp.write(tail)
             break
-        await asyncio.sleep(0.2)
+        # Back off while idle; reset to snappy when bytes flow.
+        if chunk:
+            delay = 0.1
+        else:
+            delay = min(delay * 1.5, 1.0)
+        await asyncio.sleep(delay)
     await resp.write_eof()
     return resp
 

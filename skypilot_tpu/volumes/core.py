@@ -113,6 +113,30 @@ def delete(name: str) -> None:
     logger.info(f'Volume {name!r} deleted.')
 
 
+def attachment_plan(provider_config: Dict[str, Any]
+                    ) -> 'tuple[List[str], List[str], bool]':
+    """Single source of truth for volume attachment: (volume names in
+    attach order, mount paths in the same order, read_only).
+
+    Both the attach side (dataDisks, provision/gcp/instance) and the mount
+    side (device index ↔ mount path, provisioner) derive from THIS — they
+    must agree exactly or devices map to the wrong paths.
+    """
+    volumes_map = provider_config.get('volumes_map') or {}
+    mounts = sorted(volumes_map)
+    names = [volumes_map[m] for m in mounts]
+    read_only = (int(provider_config.get('num_hosts', 1)) > 1 or
+                 int(provider_config.get('num_slices', 1)) > 1)
+    if names and read_only:
+        logger.warning(
+            'Multi-host slices attach volumes READ_ONLY (GCP rejects '
+            'multi-attach READ_WRITE on plain persistent disks): '
+            f'{names} will be mounted read-only. Jobs writing to them '
+            'will get EROFS — write checkpoints to storage mounts '
+            '(gs:// MOUNT/MOUNT_CACHED) instead.')
+    return names, mounts, read_only
+
+
 def data_disks_for(volume_names: List[str],
                    read_only: bool = False) -> List[Dict[str, Any]]:
     """dataDisks entries for a TPU node body.

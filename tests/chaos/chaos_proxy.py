@@ -76,9 +76,10 @@ class ChaosProxy:
                         # connection dies (RST via SO_LINGER 0).
                         for s in (client, upstream):
                             try:
+                                import struct
                                 s.setsockopt(
                                     socket.SOL_SOCKET, socket.SO_LINGER,
-                                    b'\x01\x00\x00\x00\x00\x00\x00\x00')
+                                    struct.pack('ii', 1, 0))
                                 s.close()
                             except OSError:
                                 pass

@@ -262,6 +262,62 @@ class TestServeEndToEnd:
         finally:
             serve_core.down(info['name'])
 
+    def test_serve_native_decode_engine(self):
+        """The full serving story on one box: a replica running the REAL
+        decode engine (llama-debug on CPU), probed ready, queried through
+        the load balancer, returning generated tokens."""
+        engine = (
+            'python -c "\n'
+            'import json, os\n'
+            'from http.server import BaseHTTPRequestHandler, HTTPServer\n'
+            'import jax, jax.numpy as jnp\n'
+            'jax.config.update(\'jax_platforms\', \'cpu\')\n'
+            'from skypilot_tpu.models import decode, llama\n'
+            'cfg = llama.PRESETS[\'llama-debug\']\n'
+            'params = llama.init_params(jax.random.PRNGKey(0), cfg)\n'
+            'decode.generate(params, jnp.zeros((1, 4), jnp.int32), cfg, 2)\n'
+            'class H(BaseHTTPRequestHandler):\n'
+            '    def do_GET(self):\n'
+            '        self.send_response(200); self.end_headers()\n'
+            '        self.wfile.write(b\'ok\')\n'
+            '    def do_POST(self):\n'
+            '        body = json.loads(self.rfile.read(\n'
+            '            int(self.headers[\'Content-Length\'])))\n'
+            '        prompt = jnp.asarray([body[\'tokens\']], jnp.int32)\n'
+            '        out = decode.generate(params, prompt, cfg,\n'
+            '                              int(body[\'max_new_tokens\']))\n'
+            '        self.send_response(200); self.end_headers()\n'
+            '        self.wfile.write(json.dumps(\n'
+            '            {\'tokens\': out[0].tolist()}).encode())\n'
+            '    def log_message(self, *a): pass\n'
+            'HTTPServer((\'127.0.0.1\', '
+            'int(os.environ[\'SKYTPU_SERVE_PORT\'])), H).serve_forever()"'
+        )
+        task = sky.Task(name='llm', run=engine)
+        task.set_resources(sky.Resources(accelerators='tpu-v5e-8'))
+        task.service_spec = {
+            'readiness_probe': {'path': '/health',
+                                'initial_delay_seconds': 60,
+                                'timeout_seconds': 3},
+            'replicas': 1,
+            'ports': _worker_port_base() + 70,
+        }
+        info = serve_core.up(task, lb_port=_worker_port_base() + 52)
+        try:
+            serve_core.wait_until(info['name'], {ServiceStatus.READY},
+                                  timeout=180)
+            req = urllib.request.Request(
+                info['endpoint'] + '/generate',
+                data=json.dumps({'tokens': [1, 2, 3, 4],
+                                 'max_new_tokens': 5}).encode(),
+                headers={'Content-Type': 'application/json'})
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                out = json.loads(resp.read())
+            assert len(out['tokens']) == 5
+            assert all(0 <= t < 256 for t in out['tokens'])
+        finally:
+            serve_core.down(info['name'])
+
     def test_plain_launch_rejects_service_yaml(self):
         with pytest.raises(ValueError, match='serve up'):
             sky.launch(_service_task(), cluster_name='nope')
