@@ -30,7 +30,10 @@ CHILD_ENV = 'SKYTPU_BENCH_CHILD'
 PROBE_ENV = 'SKYTPU_BENCH_PROBE'
 ATTEMPT_TIMEOUT_S = int(os.environ.get('SKYTPU_BENCH_ATTEMPT_TIMEOUT', '600'))
 PROBE_TIMEOUT_S = int(os.environ.get('SKYTPU_BENCH_PROBE_TIMEOUT', '120'))
-BACKOFFS_S = (5, 15, 30, 60)
+# Long tail on purpose: tunnel/backend outages observed in practice last
+# tens of minutes; the driver-facing contract is "produce a number if the
+# chip comes back within ~45 min, else fail loudly".
+BACKOFFS_S = (5, 15, 30, 60, 120, 240, 480)
 
 
 # ---------------------------------------------------------------------------

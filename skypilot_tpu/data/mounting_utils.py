@@ -111,12 +111,14 @@ def volume_mount_command(disk_index: int, mount_path: str,
                ' || { echo "[skytpu] read-only mount failed — a blank '
                'volume has no filesystem; format it by attaching to a '
                'single-host cluster once" >&2; exit 1; }')
+    # ro_hint groups with the MOUNT clause only — a mkdir failure must
+    # not print the reformat-your-volume diagnostic.
     return (
         f'if [ ! -e {dev} ]; then '
         f'  echo "[skytpu] volume device {dev} not attached" >&2; exit 1; '
         f'fi && ({fmt}) && sudo mkdir -p {mp} && '
-        f'(mountpoint -q {mp} || sudo mount -o {opts} {dev} {mp})'
-        f'{ro_hint}{chmod}')
+        f'((mountpoint -q {mp} || sudo mount -o {opts} {dev} {mp})'
+        f'{ro_hint}){chmod}')
 
 
 # --- Local fake-cloud mounts (hermetic miniature of the same contract) -----

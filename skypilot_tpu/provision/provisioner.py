@@ -214,7 +214,8 @@ def post_provision_runtime_setup(cluster_name: str,
         from skypilot_tpu.volumes import core as volumes_core
         # attachment_plan is the single ordering/read-only authority shared
         # with the attach side: index i ↔ device google-persistent-disk-(i+1).
-        _, mounts, read_only = volumes_core.attachment_plan(pc_cfg)
+        _, mounts, read_only = volumes_core.attachment_plan(pc_cfg,
+                                                            warn=False)
         mount_cmds = [
             mounting_utils.volume_mount_command(i, mount_path,
                                                 read_only=read_only)

@@ -113,7 +113,7 @@ def delete(name: str) -> None:
     logger.info(f'Volume {name!r} deleted.')
 
 
-def attachment_plan(provider_config: Dict[str, Any]
+def attachment_plan(provider_config: Dict[str, Any], warn: bool = True
                     ) -> 'tuple[List[str], List[str], bool]':
     """Single source of truth for volume attachment: (volume names in
     attach order, mount paths in the same order, read_only).
@@ -127,7 +127,7 @@ def attachment_plan(provider_config: Dict[str, Any]
     names = [volumes_map[m] for m in mounts]
     read_only = (int(provider_config.get('num_hosts', 1)) > 1 or
                  int(provider_config.get('num_slices', 1)) > 1)
-    if names and read_only:
+    if names and read_only and warn:
         logger.warning(
             'Multi-host slices attach volumes READ_ONLY (GCP rejects '
             'multi-attach READ_WRITE on plain persistent disks): '

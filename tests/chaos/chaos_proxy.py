@@ -8,6 +8,7 @@ errors, no corrupted state) under network faults.
 from __future__ import annotations
 
 import socket
+import struct
 import threading
 from typing import Optional
 
@@ -76,7 +77,6 @@ class ChaosProxy:
                         # connection dies (RST via SO_LINGER 0).
                         for s in (client, upstream):
                             try:
-                                import struct
                                 s.setsockopt(
                                     socket.SOL_SOCKET, socket.SO_LINGER,
                                     struct.pack('ii', 1, 0))
