@@ -457,6 +457,56 @@ def serve_down(service_name: str, purge: bool, yes: bool):
 
 
 @cli.group()
+def storage():
+    """Storage buckets registered with the framework
+    (reference: `sky storage`)."""
+
+
+@storage.command(name='ls')
+def storage_ls():
+    """List registered storage objects."""
+    from skypilot_tpu import global_state
+    rows = global_state.get_storages()
+    if not rows:
+        click.echo('No storage objects.')
+        return
+    for r in rows:
+        h = r['handle'] or {}
+        click.echo(f"{r['name']}  {h.get('store_type', '?')}  "
+                   f"{h.get('mode', '?')}  {h.get('source', '?')}  "
+                   f"{r['status']}")
+
+
+@storage.command(name='delete')
+@click.argument('name', required=True)
+@click.option('--yes', '-y', is_flag=True, default=False)
+def storage_delete(name: str, yes: bool):
+    """Deregister a storage object (does not delete bucket contents)."""
+    from skypilot_tpu import global_state
+    if global_state.get_storage(name) is None:
+        raise click.ClickException(f'Storage {name!r} not found.')
+    if not yes:
+        click.confirm(f'Deregister storage {name!r}?', abort=True)
+    global_state.remove_storage(name)
+    click.echo(f'Storage {name!r} deregistered.')
+
+
+@storage.command(name='transfer')
+@click.argument('src', required=True)
+@click.argument('dst', required=True)
+@click.option('--dryrun', is_flag=True, default=False,
+              help='Print the transfer command without running it.')
+def storage_transfer(src: str, dst: str, dryrun: bool):
+    """Sync SRC bucket/dir into DST (gs://, s3://, r2://, local paths)."""
+    from skypilot_tpu.data import data_transfer
+    try:
+        cmd = data_transfer.transfer(src, dst, dryrun=dryrun)
+    except exceptions.SkyTpuError as e:
+        raise click.ClickException(str(e)) from e
+    click.echo(cmd if dryrun else f'Transferred {src} -> {dst}.')
+
+
+@cli.group()
 def volumes():
     """Network volumes (persistent disks) for clusters
     (reference: `sky volume`)."""

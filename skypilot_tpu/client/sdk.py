@@ -134,18 +134,26 @@ def api_info() -> Dict[str, Any]:
 # Request plumbing
 # ---------------------------------------------------------------------------
 
-def submit(name: str, payload: Dict[str, Any],
-           url: Optional[str] = None) -> str:
-    url = url or api_server_url(required=True)
+def prepare_payload(payload: Dict[str, Any]) -> Dict[str, Any]:
+    """Attach the client's config view to a request payload.
+
+    The server runs with ITS config; the request must carry the client's
+    view so e.g. a client-side `workspace:`/`kubernetes:` setting governs
+    the request (per-request isolation happens in the runner subprocess).
+    Shared by the sync and async SDKs so their request protocol can't
+    diverge."""
     if '_config_overrides' not in payload:
-        # The server runs with ITS config; the request must carry the
-        # client's view so e.g. a client-side `workspace:`/`kubernetes:`
-        # setting governs the request (per-request isolation happens in
-        # the runner subprocess).
         from skypilot_tpu import config as config_lib
         client_cfg = config_lib.to_dict()
         if client_cfg:
             payload = dict(payload, _config_overrides=client_cfg)
+    return payload
+
+
+def submit(name: str, payload: Dict[str, Any],
+           url: Optional[str] = None) -> str:
+    url = url or api_server_url(required=True)
+    payload = prepare_payload(payload)
     r = requests_http.post(f'{url}/api/v1/{name}', json=payload,
                             headers=_headers(), timeout=30)
     if r.status_code != 200:

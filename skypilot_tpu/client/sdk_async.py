@@ -1,0 +1,179 @@
+"""Asyncio client SDK for the API server.
+
+Reference analog: sky/client/sdk_async.py (asyncio variant of sdk.py).
+Same request model as `client/sdk.py` — every call POSTs to
+`/api/v1/<name>`, gets a request id, then awaits the persisted request —
+but non-blocking, so a notebook or an async service (e.g. the serve load
+balancer) can multiplex many control-plane calls on one event loop.
+
+Endpoint/auth resolution is shared with the sync SDK (`api_server_url`,
+`_headers`), so both SDKs always talk to the same server with the same
+token.
+"""
+from __future__ import annotations
+
+import sys
+from typing import Any, Dict, List, Optional
+
+import aiohttp
+
+from skypilot_tpu.client import sdk as sync_sdk
+from skypilot_tpu.client.sdk import ApiError, RequestFailedError
+from skypilot_tpu.server import requests_lib as server_requests
+
+__all__ = [
+    'ApiError', 'RequestFailedError', 'submit', 'get', 'stream_and_get',
+    'api_cancel', 'api_list_requests', 'launch', 'exec', 'status', 'queue',
+    'down', 'stop', 'start', 'cancel', 'tail_logs',
+]
+
+
+def _url(url: Optional[str]) -> str:
+    return url or sync_sdk.api_server_url(required=True)
+
+
+async def submit(name: str, payload: Dict[str, Any],
+                 url: Optional[str] = None) -> str:
+    url = _url(url)
+    payload = sync_sdk.prepare_payload(payload)
+    async with aiohttp.ClientSession() as session:
+        async with session.post(f'{url}/api/v1/{name}', json=payload,
+                                headers=sync_sdk._headers(),
+                                timeout=aiohttp.ClientTimeout(
+                                    total=30)) as r:
+            if r.status != 200:
+                raise ApiError(f'{name}: HTTP {r.status}: {await r.text()}')
+            return (await r.json())['request_id']
+
+
+async def get(request_id: str, url: Optional[str] = None) -> Any:
+    """Await request completion; return its result (or raise)."""
+    url = _url(url)
+    async with aiohttp.ClientSession() as session:
+        while True:
+            async with session.get(
+                    f'{url}/api/v1/get',
+                    params={'request_id': request_id, 'wait': '1'},
+                    headers=sync_sdk._headers(),
+                    timeout=aiohttp.ClientTimeout(total=300)) as r:
+                if r.status == 404:
+                    raise ApiError(f'no request {request_id}')
+                if r.status != 200:
+                    raise ApiError(f'get: HTTP {r.status}: '
+                                   f'{await r.text()}')
+                rec = await r.json()
+            status = server_requests.RequestStatus(rec['status'])
+            if status.is_terminal():
+                break
+    if status == server_requests.RequestStatus.SUCCEEDED:
+        return rec['result']
+    if status == server_requests.RequestStatus.CANCELLED:
+        raise ApiError(f'request {request_id} was cancelled')
+    raise RequestFailedError(request_id, rec.get('error') or '')
+
+
+async def stream_and_get(request_id: str, url: Optional[str] = None,
+                         out=None) -> Any:
+    url = _url(url)
+    out = out or sys.stdout
+    async with aiohttp.ClientSession() as session:
+        async with session.get(
+                f'{url}/api/v1/stream',
+                params={'request_id': request_id},
+                headers=sync_sdk._headers(),
+                timeout=aiohttp.ClientTimeout(total=None)) as r:
+            async for chunk in r.content.iter_any():
+                out.write(chunk.decode('utf-8', errors='replace'))
+                out.flush()
+    return await get(request_id, url)
+
+
+async def api_cancel(request_id: str, url: Optional[str] = None) -> bool:
+    url = _url(url)
+    async with aiohttp.ClientSession() as session:
+        async with session.post(f'{url}/api/v1/request_cancel',
+                                json={'request_id': request_id},
+                                headers=sync_sdk._headers(),
+                                timeout=aiohttp.ClientTimeout(
+                                    total=30)) as r:
+            if r.status != 200:
+                raise ApiError(f'cancel: HTTP {r.status}: '
+                               f'{await r.text()}')
+            return bool((await r.json()).get('cancelled'))
+
+
+async def api_list_requests(url: Optional[str] = None
+                            ) -> List[Dict[str, Any]]:
+    url = _url(url)
+    async with aiohttp.ClientSession() as session:
+        async with session.get(f'{url}/api/v1/requests',
+                               headers=sync_sdk._headers(),
+                               timeout=aiohttp.ClientTimeout(
+                                   total=30)) as r:
+            if r.status != 200:
+                raise ApiError(f'requests: HTTP {r.status}: '
+                               f'{await r.text()}')
+            return await r.json()
+
+
+# ---------------------------------------------------------------------------
+# Typed RPCs
+# ---------------------------------------------------------------------------
+
+async def launch(task, cluster_name: Optional[str] = None, *,
+                 detach_run: bool = True, down_: bool = False,
+                 dryrun: bool = False, retry_until_up: bool = False,
+                 stream: bool = True) -> Any:
+    payload = {'task': task.to_yaml_config(), 'cluster_name': cluster_name,
+               'detach_run': detach_run, 'down': down_, 'dryrun': dryrun,
+               'retry_until_up': retry_until_up}
+    rid = await submit('launch', payload)
+    return await (stream_and_get(rid) if stream else get(rid))
+
+
+async def exec(task, cluster_name: str, *,  # pylint: disable=redefined-builtin
+               detach_run: bool = True) -> Any:
+    rid = await submit('exec', {'task': task.to_yaml_config(),
+                                'cluster_name': cluster_name,
+                                'detach_run': detach_run})
+    return await get(rid)
+
+
+async def status(cluster_names: Optional[List[str]] = None,
+                 refresh: bool = False, all_workspaces: bool = False) -> Any:
+    from skypilot_tpu import workspaces
+    return await get(await submit('status', {
+        'cluster_names': cluster_names,
+        'refresh': refresh,
+        'all_workspaces': all_workspaces,
+        'workspace': workspaces.get_active_workspace(),
+    }))
+
+
+async def queue(cluster_name: str) -> Any:
+    return await get(await submit('queue', {'cluster_name': cluster_name}))
+
+
+async def down(cluster_name: str) -> Any:
+    return await get(await submit('down', {'cluster_name': cluster_name}))
+
+
+async def stop(cluster_name: str) -> Any:
+    return await get(await submit('stop', {'cluster_name': cluster_name}))
+
+
+async def start(cluster_name: str) -> Any:
+    return await get(await submit('start', {'cluster_name': cluster_name}))
+
+
+async def cancel(cluster_name: str,
+                 job_ids: Optional[List[int]] = None) -> Any:
+    return await get(await submit('cancel', {'cluster_name': cluster_name,
+                                             'job_ids': job_ids}))
+
+
+async def tail_logs(cluster_name: str, job_id: Optional[int] = None,
+                    follow: bool = True) -> Any:
+    rid = await submit('logs', {'cluster_name': cluster_name,
+                                'job_id': job_id, 'follow': follow})
+    return await stream_and_get(rid)

@@ -34,12 +34,23 @@ def tokenize_text(text: str, tokenizer: Optional[str] = None) -> np.ndarray:
     return np.asarray(tok(text)['input_ids'], dtype=np.int32)
 
 
-def load_tokens(path: str, tokenizer: Optional[str] = None) -> np.ndarray:
-    """Load a corpus: .bin/.npy = pre-tokenized; anything else = text."""
+def load_tokens(path: str, tokenizer: Optional[str] = None,
+                native: bool = True):
+    """Load a corpus: .bin/.npy = pre-tokenized; anything else = text.
+
+    .bin corpora go through the native C++ core when it's buildable
+    (mmap + threaded gather, data/native_loader.py); the return value then
+    is a NativeTokenFile, which batch_at_step/token_batches accept
+    interchangeably with ndarrays."""
     path = os.path.expanduser(path)
     if path.endswith('.npy'):
         return np.load(path, mmap_mode='r')
     if path.endswith('.bin'):
+        if native:
+            from skypilot_tpu.data import native_loader
+            tf = native_loader.open_token_file(path)
+            if tf is not None:
+                return tf
         # uint16 memmap, the common pre-tokenized format (e.g. nanoGPT-style
         # corpora); uint16 caps vocab at 65535 which covers every preset.
         return np.memmap(path, dtype=np.uint16, mode='r')
@@ -47,13 +58,16 @@ def load_tokens(path: str, tokenizer: Optional[str] = None) -> np.ndarray:
         return tokenize_text(f.read(), tokenizer)
 
 
-def batch_at_step(tokens: np.ndarray, step: int, batch_size: int,
+def batch_at_step(tokens, step: int, batch_size: int,
                   seq_len: int) -> np.ndarray:
     """The deterministic indexer: global batch for `step`, shape [B, S+1].
 
     Rows stride through the corpus with wraparound; consecutive steps read
     consecutive windows, and (tokens, step) fully determines the batch.
+    `tokens` is an ndarray or a NativeTokenFile (same result either way).
     """
+    if hasattr(tokens, 'batch_at_step'):   # native core
+        return tokens.batch_at_step(step, batch_size, seq_len)
     n = len(tokens)
     need = seq_len + 1
     if n < need + 1:
@@ -67,11 +81,14 @@ def batch_at_step(tokens: np.ndarray, step: int, batch_size: int,
     return out
 
 
-def token_batches(tokens: np.ndarray, batch_size: int, seq_len: int,
+def token_batches(tokens, batch_size: int, seq_len: int,
                   start_step: int = 0) -> Iterator[Dict[str, np.ndarray]]:
     """Infinite stream of {'tokens': [B, S+1]} starting at `start_step`."""
     step = start_step
+    prefetch = getattr(tokens, 'prefetch', None)
     while True:
+        if prefetch is not None:
+            prefetch(step + 1, batch_size, seq_len)   # overlap page-in
         yield {'tokens': batch_at_step(tokens, step, batch_size, seq_len)}
         step += 1
 

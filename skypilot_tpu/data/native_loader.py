@@ -1,0 +1,115 @@
+"""ctypes bridge to the native dataloader core.
+
+`NativeTokenFile` mirrors the semantics of
+`data/loader.py::batch_at_step` exactly (asserted by
+tests/unit_tests/test_native.py), gathering batches with a C++ thread team
+over an mmap'd corpus instead of a Python row loop. On a TPU host the
+input pipeline shares one VM with checkpoint uploads and log shipping;
+keeping the gather off the interpreter matters at large B×S.
+
+Falls back transparently: `open_token_file` returns None when the .so
+can't be built (no compiler) or the corpus isn't a supported .bin layout,
+and callers use the numpy path.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import Optional
+
+import numpy as np
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_lib = None
+_lib_tried = False
+
+
+def _load_lib():
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    from skypilot_tpu.native import build as native_build
+    path = native_build.build_target('skytpu_dataloader.so')
+    if path is None:
+        return None
+    lib = ctypes.CDLL(path)
+    lib.dl_open.restype = ctypes.c_void_p
+    lib.dl_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+    lib.dl_num_tokens.restype = ctypes.c_int64
+    lib.dl_num_tokens.argtypes = [ctypes.c_void_p]
+    lib.dl_batch_at_step.restype = ctypes.c_int
+    lib.dl_batch_at_step.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64,
+        ctypes.POINTER(ctypes.c_int32)]
+    lib.dl_max_token.restype = ctypes.c_int32
+    lib.dl_max_token.argtypes = [ctypes.c_void_p]
+    lib.dl_prefetch.restype = ctypes.c_int
+    lib.dl_prefetch.argtypes = [
+        ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64, ctypes.c_int64]
+    lib.dl_close.restype = None
+    lib.dl_close.argtypes = [ctypes.c_void_p]
+    _lib = lib
+    return _lib
+
+
+class NativeTokenFile:
+    """An open pre-tokenized corpus (.bin) served by the native core."""
+
+    def __init__(self, handle: int, lib, path: str):
+        self._handle = handle
+        self._lib = lib
+        self.path = path
+        self.num_tokens = int(lib.dl_num_tokens(handle))
+
+    def __len__(self) -> int:
+        return self.num_tokens
+
+    def batch_at_step(self, step: int, batch_size: int,
+                      seq_len: int) -> np.ndarray:
+        out = np.empty((batch_size, seq_len + 1), dtype=np.int32)
+        rc = self._lib.dl_batch_at_step(
+            self._handle, step, batch_size, seq_len,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        if rc != 0:
+            raise ValueError(
+                f'native batch_at_step failed (errno {rc}): corpus '
+                f'{self.path} has {self.num_tokens} tokens, need > '
+                f'{seq_len + 2}.')
+        return out
+
+    def max(self) -> int:
+        """Largest token id in the corpus (ndarray.max() analog, used by
+        the trainer's vocab-bounds check)."""
+        return int(self._lib.dl_max_token(self._handle))
+
+    def prefetch(self, step: int, batch_size: int, seq_len: int) -> None:
+        """Advise the kernel to fault in step's pages ahead of use."""
+        self._lib.dl_prefetch(self._handle, step, batch_size, seq_len)
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.dl_close(self._handle)
+            self._handle = 0
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # pylint: disable=broad-except
+            pass
+
+
+def open_token_file(path: str, elem_size: int = 2
+                    ) -> Optional[NativeTokenFile]:
+    """Open a .bin corpus natively; None → caller uses the numpy path."""
+    lib = _load_lib()
+    if lib is None:
+        return None
+    handle = lib.dl_open(os.path.expanduser(path).encode(), elem_size)
+    if not handle:
+        logger.debug(f'Native open of {path} failed; using numpy path.')
+        return None
+    return NativeTokenFile(handle, lib, path)

@@ -1,0 +1,7 @@
+"""Native (C++) components: fast data-loader core and the fuse-proxy.
+
+See `native/build.py` for the build contract and the per-component .cc
+files for design docs. Python consumers: `data/native_loader.py`
+(dataloader) and `data/mounting_utils.py` (fuse-proxy shim on k8s).
+"""
+from skypilot_tpu.native.build import build_target  # noqa: F401

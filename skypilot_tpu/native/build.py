@@ -1,0 +1,70 @@
+"""On-demand builder for the native (C++) components.
+
+The reference ships its only native piece (the Go fuse-proxy) as a
+prebuilt container image; this repo compiles from source on first use —
+the toolchain (g++) is part of the TPU VM runtime image — and caches the
+artifacts next to the sources in `native/bin/`. Every entry point degrades
+gracefully: callers get None when no compiler is available and fall back
+to pure-Python paths (loader) or report the feature unsupported
+(fuse-proxy on k8s).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+_NATIVE_DIR = os.path.dirname(os.path.abspath(__file__))
+_BIN_DIR = os.path.join(_NATIVE_DIR, 'bin')
+
+_COMMON_FLAGS = ['-O2', '-std=c++17', '-pthread', '-Wall']
+
+# target name -> (sources, extra flags)
+TARGETS: Dict[str, Tuple[List[str], List[str]]] = {
+    'skytpu_dataloader.so': (['dataloader/skytpu_dataloader.cc'],
+                             ['-shared', '-fPIC']),
+    'fusermount-shim': (['fuse_proxy/fusermount_shim.cc'], []),
+    'fuse-proxy-server': (['fuse_proxy/fuse_proxy_server.cc'], []),
+}
+
+_HEADERS = ['fuse_proxy/proxy_proto.h']
+
+
+def _out_of_date(out: str, sources: List[str]) -> bool:
+    if not os.path.exists(out):
+        return True
+    out_mtime = os.path.getmtime(out)
+    deps = sources + _HEADERS
+    return any(
+        os.path.exists(os.path.join(_NATIVE_DIR, s)) and
+        os.path.getmtime(os.path.join(_NATIVE_DIR, s)) > out_mtime
+        for s in deps)
+
+
+def build_target(name: str) -> Optional[str]:
+    """Compile (if stale) and return the artifact path, or None."""
+    if name not in TARGETS:
+        raise ValueError(f'Unknown native target {name!r}; '
+                         f'valid: {sorted(TARGETS)}')
+    sources, extra = TARGETS[name]
+    out = os.path.join(_BIN_DIR, name)
+    if not _out_of_date(out, sources):
+        return out
+    gxx = shutil.which('g++') or shutil.which('c++')
+    if gxx is None:
+        logger.debug(f'No C++ compiler; native target {name} unavailable.')
+        return None
+    os.makedirs(_BIN_DIR, exist_ok=True)
+    srcs = [os.path.join(_NATIVE_DIR, s) for s in sources]
+    cmd = [gxx, *_COMMON_FLAGS, *extra, '-I', _NATIVE_DIR, *srcs, '-o', out]
+    proc = subprocess.run(cmd, capture_output=True, text=True, check=False)
+    if proc.returncode != 0:
+        logger.warning(f'Native build of {name} failed:\n{proc.stderr}')
+        return None
+    logger.info(f'Built native target {name}.')
+    return out

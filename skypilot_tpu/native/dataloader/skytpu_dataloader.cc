@@ -1,0 +1,198 @@
+// Native tokenized-corpus batch extractor.
+//
+// Reference context: the reference (SkyPilot) has no native data path — it
+// delegates input pipelines to HF datasets inside recipes (SURVEY §2.11).
+// This framework owns the trainer, and on TPU the host input pipeline must
+// keep a >400 GB/s chip fed from one VM; the Python/numpy fancy-index path
+// tops out well below a memcpy. This library does the hot part natively:
+//
+//   - mmap the pre-tokenized corpus (uint16/uint32 .bin) once, O_RDONLY
+//   - batch_at_step: gather B rows of S+1 tokens with dtype widening to
+//     int32, parallelized across rows with a thread team
+//   - prefetch: madvise(WILLNEED) the next step's pages so the gather
+//     never faults on cold file pages
+//
+// Semantics are EXACTLY skypilot_tpu/data/loader.py::batch_at_step —
+// batch k is a pure function of (corpus, k) — so checkpoint/resume gets
+// the same token stream from either implementation (asserted in
+// tests/unit_tests/test_native.py).
+//
+// C ABI (ctypes-consumed; no pybind11 in this image):
+//   dl_open(path, elem_size) -> handle | NULL
+//   dl_num_tokens(h) -> int64
+//   dl_batch_at_step(h, step, batch, seq, out_int32) -> 0 | errno
+//   dl_prefetch(h, step, batch, seq) -> 0
+//   dl_close(h)
+
+#include <algorithm>
+#include <cerrno>
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct TokenFile {
+  void* base = nullptr;
+  int64_t bytes = 0;
+  int elem_size = 2;  // 2 = uint16, 4 = uint32/int32
+  int64_t n_tokens() const { return bytes / elem_size; }
+};
+
+// Row-start rule shared with the Python indexer: rows stride through the
+// corpus with wraparound, consecutive steps read consecutive windows.
+inline int64_t row_start(int64_t usable, int64_t step, int64_t seq,
+                         int64_t batch, int64_t row) {
+  // (row * usable / batch + step * seq) % usable, in int64 (usable and
+  // step*seq both fit: corpora are < 2^47 tokens).
+  int64_t s = (row * usable) / batch + step * seq;
+  s %= usable;
+  return s < 0 ? s + usable : s;
+}
+
+void copy_rows(const TokenFile* tf, int64_t step, int64_t batch, int64_t seq,
+               int32_t* out, int64_t row_begin, int64_t row_end) {
+  const int64_t need = seq + 1;
+  const int64_t usable = tf->n_tokens() - need;
+  for (int64_t i = row_begin; i < row_end; ++i) {
+    const int64_t s = row_start(usable, step, seq, batch, i);
+    int32_t* dst = out + i * need;
+    if (tf->elem_size == 2) {
+      const uint16_t* src = static_cast<const uint16_t*>(tf->base) + s;
+      for (int64_t j = 0; j < need; ++j) dst[j] = src[j];
+    } else {
+      const int32_t* src = static_cast<const int32_t*>(tf->base) + s;
+      std::memcpy(dst, src, need * sizeof(int32_t));
+    }
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void* dl_open(const char* path, int elem_size) {
+  if (elem_size != 2 && elem_size != 4) return nullptr;
+  int fd = ::open(path, O_RDONLY | O_CLOEXEC);
+  if (fd < 0) return nullptr;
+  struct stat st;
+  if (fstat(fd, &st) != 0 || st.st_size < elem_size) {
+    ::close(fd);
+    return nullptr;
+  }
+  void* base = mmap(nullptr, st.st_size, PROT_READ, MAP_PRIVATE, fd, 0);
+  ::close(fd);  // mapping keeps its own reference
+  if (base == MAP_FAILED) return nullptr;
+  // Rows gather from scattered offsets: random beats readahead here.
+  madvise(base, st.st_size, MADV_RANDOM);
+  auto* tf = new TokenFile();
+  tf->base = base;
+  tf->bytes = st.st_size;
+  tf->elem_size = elem_size;
+  return tf;
+}
+
+int64_t dl_num_tokens(void* h) {
+  return h ? static_cast<TokenFile*>(h)->n_tokens() : 0;
+}
+
+int dl_batch_at_step(void* h, int64_t step, int64_t batch, int64_t seq,
+                     int32_t* out) {
+  auto* tf = static_cast<TokenFile*>(h);
+  if (tf == nullptr || batch <= 0 || seq <= 0) return EINVAL;
+  const int64_t need = seq + 1;
+  // Same minimum as the Python indexer (loader.py raises when
+  // n < need + 1): usable = n - need must be >= 1.
+  if (tf->n_tokens() < need + 1) return ERANGE;
+  // Thread team sized to the work: one thread per ~1 MiB of output, capped
+  // at hardware concurrency. Small batches stay single-threaded (spawn
+  // cost dominates).
+  const int64_t total_bytes = batch * need * 4;
+  int n_threads = static_cast<int>(total_bytes / (1 << 20));
+  int hw = static_cast<int>(std::thread::hardware_concurrency());
+  if (n_threads > hw) n_threads = hw;
+  if (n_threads <= 1 || batch == 1) {
+    copy_rows(tf, step, batch, seq, out, 0, batch);
+    return 0;
+  }
+  if (n_threads > batch) n_threads = static_cast<int>(batch);
+  std::vector<std::thread> team;
+  team.reserve(n_threads);
+  const int64_t per = (batch + n_threads - 1) / n_threads;
+  for (int t = 0; t < n_threads; ++t) {
+    const int64_t lo = t * per;
+    const int64_t hi = std::min<int64_t>(lo + per, batch);
+    if (lo >= hi) break;
+    team.emplace_back(copy_rows, tf, step, batch, seq, out, lo, hi);
+  }
+  for (auto& th : team) th.join();
+  return 0;
+}
+
+int32_t dl_max_token(void* h) {
+  // Full-corpus max, threaded — backs the trainer's vocab-bounds check
+  // without materializing the corpus in Python.
+  auto* tf = static_cast<TokenFile*>(h);
+  if (tf == nullptr || tf->n_tokens() == 0) return -1;
+  const int64_t n = tf->n_tokens();
+  int n_threads = static_cast<int>(std::thread::hardware_concurrency());
+  if (n_threads < 1) n_threads = 1;
+  if (n > 0 && n < (1 << 20)) n_threads = 1;
+  std::vector<int32_t> maxima(n_threads, 0);
+  auto scan = [tf, n, n_threads](int t, int32_t* out) {
+    const int64_t per = (n + n_threads - 1) / n_threads;
+    const int64_t lo = t * per;
+    const int64_t hi = std::min<int64_t>(lo + per, n);
+    int32_t m = 0;
+    if (tf->elem_size == 2) {
+      const uint16_t* p = static_cast<const uint16_t*>(tf->base);
+      for (int64_t i = lo; i < hi; ++i) m = std::max<int32_t>(m, p[i]);
+    } else {
+      const int32_t* p = static_cast<const int32_t*>(tf->base);
+      for (int64_t i = lo; i < hi; ++i) m = std::max(m, p[i]);
+    }
+    *out = m;
+  };
+  if (n_threads == 1) {
+    scan(0, &maxima[0]);
+  } else {
+    std::vector<std::thread> team;
+    team.reserve(n_threads);
+    for (int t = 0; t < n_threads; ++t) team.emplace_back(scan, t, &maxima[t]);
+    for (auto& th : team) th.join();
+  }
+  return *std::max_element(maxima.begin(), maxima.end());
+}
+
+int dl_prefetch(void* h, int64_t step, int64_t batch, int64_t seq) {
+  auto* tf = static_cast<TokenFile*>(h);
+  if (tf == nullptr) return EINVAL;
+  const int64_t need = seq + 1;
+  const int64_t usable = tf->n_tokens() - need;
+  if (usable <= 0) return ERANGE;
+  const long page = sysconf(_SC_PAGESIZE);
+  char* base = static_cast<char*>(tf->base);
+  for (int64_t i = 0; i < batch; ++i) {
+    const int64_t s = row_start(usable, step, seq, batch, i);
+    char* lo = base + s * tf->elem_size;
+    char* aligned = reinterpret_cast<char*>(
+        reinterpret_cast<uintptr_t>(lo) & ~(page - 1));
+    size_t len = (lo - aligned) + need * tf->elem_size;
+    madvise(aligned, len, MADV_WILLNEED);
+  }
+  return 0;
+}
+
+void dl_close(void* h) {
+  auto* tf = static_cast<TokenFile*>(h);
+  if (tf == nullptr) return;
+  munmap(tf->base, tf->bytes);
+  delete tf;
+}
+
+}  // extern "C"

@@ -1,0 +1,207 @@
+// Privileged fuse-proxy server: performs real fusermount calls on behalf
+// of unprivileged shim clients and passes the /dev/fuse fd back.
+//
+// Deployment: one instance per node (k8s DaemonSet with CAP_SYS_ADMIN, or
+// a root process on a TPU VM), listening on a unix socket that pod/job
+// containers bind-mount. Each connection is served by a forked child, so a
+// wedged fusermount never blocks the accept loop.
+//
+// Flags / env:
+//   --socket PATH   (or SKYTPU_FUSE_PROXY_SOCKET)  listen path
+//   --fusermount P  (or SKYTPU_FUSE_PROXY_FUSERMOUNT) real binary,
+//                   default "fusermount3" — tests point this at a fake
+//   --once          serve a single connection then exit (tests)
+//
+// Reference analog: addons/fuse-proxy server (Go); protocol in
+// proxy_proto.h.
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <poll.h>
+#include <signal.h>
+#include <string>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/un.h>
+#include <sys/wait.h>
+#include <unistd.h>
+#include <vector>
+
+#include "proxy_proto.h"
+
+namespace {
+
+const char* g_fusermount = "fusermount3";
+
+// libfuse convention: one data byte with the fd attached. Non-blocking —
+// by the time this runs the fusermount child has exited, so the fd (if
+// any) is already queued in the socketpair buffer.
+int recv_fd_nonblock(int commfd) {
+  char byte;
+  struct iovec iov = {&byte, 1};
+  struct msghdr msg = {};
+  msg.msg_iov = &iov;
+  msg.msg_iovlen = 1;
+  alignas(struct cmsghdr) char cbuf[CMSG_SPACE(sizeof(int))] = {};
+  msg.msg_control = cbuf;
+  msg.msg_controllen = sizeof(cbuf);
+  ssize_t r = recvmsg(commfd, &msg, MSG_DONTWAIT | MSG_CMSG_CLOEXEC);
+  if (r < 0) return -1;
+  for (struct cmsghdr* cm = CMSG_FIRSTHDR(&msg); cm != nullptr;
+       cm = CMSG_NXTHDR(&msg, cm)) {
+    if (cm->cmsg_level == SOL_SOCKET && cm->cmsg_type == SCM_RIGHTS &&
+        cm->cmsg_len >= CMSG_LEN(sizeof(int))) {
+      int fd;
+      std::memcpy(&fd, CMSG_DATA(cm), sizeof(int));
+      return fd;
+    }
+  }
+  return -1;
+}
+
+// Run the real fusermount with the client's argv in the client's cwd.
+// Returns its exit code; *fuse_fd gets the passed fd (or -1); *err_text
+// gets captured stderr.
+int run_fusermount(const std::vector<std::string>& req, int* fuse_fd,
+                   std::string* err_text) {
+  *fuse_fd = -1;
+  const std::string& cwd = req[0];
+  int commfd[2];
+  if (socketpair(AF_UNIX, SOCK_STREAM, 0, commfd) != 0) return 127;
+  int errpipe[2];
+  if (pipe(errpipe) != 0) {
+    close(commfd[0]);
+    close(commfd[1]);
+    return 127;
+  }
+  pid_t pid = fork();
+  if (pid < 0) return 127;
+  if (pid == 0) {
+    signal(SIGCHLD, SIG_DFL);
+    close(commfd[0]);
+    close(errpipe[0]);
+    dup2(errpipe[1], 2);
+    close(errpipe[1]);
+    if (chdir(cwd.c_str()) != 0) {
+      fprintf(stderr, "fuse-proxy: chdir(%s): %s\n", cwd.c_str(),
+              strerror(errno));
+      _exit(126);
+    }
+    char commfd_str[16];
+    snprintf(commfd_str, sizeof(commfd_str), "%d", commfd[1]);
+    setenv("_FUSE_COMMFD", commfd_str, 1);
+    // The commfd must survive exec: clear CLOEXEC.
+    std::vector<char*> argv;
+    argv.push_back(const_cast<char*>(g_fusermount));
+    for (size_t i = 1; i < req.size(); ++i)
+      argv.push_back(const_cast<char*>(req[i].c_str()));
+    argv.push_back(nullptr);
+    execvp(g_fusermount, argv.data());
+    fprintf(stderr, "fuse-proxy: exec %s: %s\n", g_fusermount,
+            strerror(errno));
+    _exit(127);
+  }
+  close(commfd[1]);
+  close(errpipe[1]);
+  // Drain stderr until the child closes it (exit), then reap.
+  char buf[4096];
+  ssize_t r;
+  while ((r = read(errpipe[0], buf, sizeof(buf))) > 0)
+    err_text->append(buf, static_cast<size_t>(r));
+  close(errpipe[0]);
+  int wstatus = 0;
+  while (waitpid(pid, &wstatus, 0) < 0 && errno == EINTR) {
+  }
+  *fuse_fd = recv_fd_nonblock(commfd[0]);
+  close(commfd[0]);
+  if (WIFEXITED(wstatus)) return WEXITSTATUS(wstatus);
+  return 128 + (WIFSIGNALED(wstatus) ? WTERMSIG(wstatus) : 0);
+}
+
+void serve_one(int conn) {
+  std::vector<std::string> req;
+  if (!fuseproxy::recv_request(conn, &req) || req.empty()) {
+    fuseproxy::send_response(conn, 1, -1, "fuse-proxy: bad request\n");
+    return;
+  }
+  int fuse_fd = -1;
+  std::string err_text;
+  int code = run_fusermount(req, &fuse_fd, &err_text);
+  fuseproxy::send_response(conn, static_cast<uint32_t>(code), fuse_fd,
+                           err_text);
+  if (fuse_fd >= 0) close(fuse_fd);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  const char* sock_path = fuseproxy::socket_path();
+  bool once = false;
+  const char* env_fm = getenv("SKYTPU_FUSE_PROXY_FUSERMOUNT");
+  if (env_fm && *env_fm) g_fusermount = env_fm;
+  for (int i = 1; i < argc; ++i) {
+    if (!strcmp(argv[i], "--socket") && i + 1 < argc) sock_path = argv[++i];
+    else if (!strcmp(argv[i], "--fusermount") && i + 1 < argc)
+      g_fusermount = argv[++i];
+    else if (!strcmp(argv[i], "--once")) once = true;
+    else {
+      fprintf(stderr, "usage: %s [--socket PATH] [--fusermount BIN] "
+                      "[--once]\n", argv[0]);
+      return 2;
+    }
+  }
+
+  int lsock = socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  if (lsock < 0) {
+    perror("fuse-proxy: socket");
+    return 1;
+  }
+  struct sockaddr_un addr = {};
+  addr.sun_family = AF_UNIX;
+  if (strlen(sock_path) >= sizeof(addr.sun_path)) {
+    fprintf(stderr, "fuse-proxy: socket path too long\n");
+    return 1;
+  }
+  strcpy(addr.sun_path, sock_path);
+  unlink(sock_path);
+  if (bind(lsock, reinterpret_cast<struct sockaddr*>(&addr),
+           sizeof(addr)) != 0 ||
+      listen(lsock, 16) != 0) {
+    fprintf(stderr, "fuse-proxy: bind/listen %s: %s\n", sock_path,
+            strerror(errno));
+    return 1;
+  }
+  // Only the job container's uid should reach the proxy in production;
+  // the DaemonSet mounts the socket dir into trusted pods only. Mode 0666
+  // on the socket matches the reference's behavior (auth is the mount
+  // namespace, not the socket).
+  chmod(sock_path, 0666);
+  fprintf(stderr, "fuse-proxy: listening on %s (fusermount=%s)\n",
+          sock_path, g_fusermount);
+
+  for (;;) {
+    int conn = accept(lsock, nullptr, nullptr);
+    if (conn < 0) {
+      if (errno == EINTR) continue;
+      perror("fuse-proxy: accept");
+      return 1;
+    }
+    if (once) {
+      serve_one(conn);
+      close(conn);
+      return 0;
+    }
+    pid_t pid = fork();
+    if (pid == 0) {
+      close(lsock);
+      serve_one(conn);
+      close(conn);
+      _exit(0);
+    }
+    close(conn);
+    // Opportunistic reap of finished connection children.
+    while (waitpid(-1, nullptr, WNOHANG) > 0) {
+    }
+  }
+}

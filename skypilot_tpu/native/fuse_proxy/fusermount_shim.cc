@@ -1,0 +1,117 @@
+// fusermount shim: the binary installed AS `fusermount3` in unprivileged
+// pods. libfuse execs it with _FUSE_COMMFD pointing at a socketpair and
+// expects the opened /dev/fuse fd back over it. The shim does no mounting
+// itself — it forwards (cwd, argv tail) to the privileged proxy server and
+// relays the fd the server sends back to libfuse, so unmodified gcsfuse
+// binaries work in pods without CAP_SYS_ADMIN.
+//
+// Reference analog: addons/fuse-proxy fusermount-shim (Go); see
+// proxy_proto.h for the contract.
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <string>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+#include <vector>
+
+#include "proxy_proto.h"
+
+namespace {
+
+int connect_proxy() {
+  int sock = socket(AF_UNIX, SOCK_STREAM | SOCK_CLOEXEC, 0);
+  if (sock < 0) return -1;
+  struct sockaddr_un addr = {};
+  addr.sun_family = AF_UNIX;
+  const char* path = fuseproxy::socket_path();
+  if (std::strlen(path) >= sizeof(addr.sun_path)) {
+    close(sock);
+    return -1;
+  }
+  std::strcpy(addr.sun_path, path);
+  if (connect(sock, reinterpret_cast<struct sockaddr*>(&addr),
+              sizeof(addr)) != 0) {
+    close(sock);
+    return -1;
+  }
+  return sock;
+}
+
+// libfuse's fd-passing convention: one message, one data byte, the fd in
+// SCM_RIGHTS.
+bool send_fd_to_commfd(int commfd, int fd) {
+  char byte = '\0';
+  struct iovec iov = {&byte, 1};
+  struct msghdr msg = {};
+  msg.msg_iov = &iov;
+  msg.msg_iovlen = 1;
+  alignas(struct cmsghdr) char cbuf[CMSG_SPACE(sizeof(int))] = {};
+  msg.msg_control = cbuf;
+  msg.msg_controllen = sizeof(cbuf);
+  struct cmsghdr* cm = CMSG_FIRSTHDR(&msg);
+  cm->cmsg_level = SOL_SOCKET;
+  cm->cmsg_type = SCM_RIGHTS;
+  cm->cmsg_len = CMSG_LEN(sizeof(int));
+  std::memcpy(CMSG_DATA(cm), &fd, sizeof(int));
+  ssize_t w;
+  do {
+    w = sendmsg(commfd, &msg, 0);
+  } while (w < 0 && errno == EINTR);
+  return w == 1;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  char cwd[4096];
+  if (getcwd(cwd, sizeof(cwd)) == nullptr) {
+    perror("fusermount-shim: getcwd");
+    return 1;
+  }
+  std::vector<std::string> req;
+  req.emplace_back(cwd);
+  for (int i = 1; i < argc; ++i) req.emplace_back(argv[i]);
+
+  int sock = connect_proxy();
+  if (sock < 0) {
+    fprintf(stderr, "fusermount-shim: cannot reach proxy at %s: %s\n",
+            fuseproxy::socket_path(), strerror(errno));
+    return 1;
+  }
+  if (!fuseproxy::send_request(sock, req)) {
+    fprintf(stderr, "fusermount-shim: request send failed\n");
+    close(sock);
+    return 1;
+  }
+  uint32_t exit_code = 1;
+  int fuse_fd = -1;
+  std::string err_text;
+  if (!fuseproxy::recv_response(sock, &exit_code, &fuse_fd, &err_text)) {
+    fprintf(stderr, "fusermount-shim: bad response from proxy\n");
+    close(sock);
+    return 1;
+  }
+  close(sock);
+  if (!err_text.empty()) fputs(err_text.c_str(), stderr);
+
+  if (fuse_fd >= 0) {
+    const char* commfd_env = getenv("_FUSE_COMMFD");
+    if (commfd_env == nullptr) {
+      fprintf(stderr,
+              "fusermount-shim: got a fuse fd but _FUSE_COMMFD unset\n");
+      close(fuse_fd);
+      return 1;
+    }
+    int commfd = atoi(commfd_env);
+    if (!send_fd_to_commfd(commfd, fuse_fd)) {
+      fprintf(stderr, "fusermount-shim: fd relay to _FUSE_COMMFD failed\n");
+      close(fuse_fd);
+      return 1;
+    }
+    close(fuse_fd);
+  }
+  return static_cast<int>(exit_code);
+}

@@ -1,0 +1,157 @@
+// Wire protocol shared by the fusermount shim and the proxy server.
+//
+// Reference analog: addons/fuse-proxy (Go, 712 LoC) — a rootless-FUSE
+// helper for k8s: unprivileged pods can't mount, so a shim binary that
+// LOOKS like fusermount3 forwards the call over a unix socket to a
+// privileged DaemonSet server, which performs the real mount and passes
+// the opened /dev/fuse fd back via SCM_RIGHTS. This is the C++ build of
+// the same contract (the reference's README documents the behavior; the
+// implementation here is original).
+//
+// Framing (all integers little-endian u32):
+//   request:  MAGIC, nstrings, nstrings x { len, bytes }
+//             strings[0] = client cwd (mountpoint paths are cwd-relative)
+//             strings[1..] = fusermount argv tail
+//   response: MAGIC, exit_code, has_fd, stderr_len, stderr bytes
+//             when has_fd == 1 the /dev/fuse fd rides the SAME sendmsg as
+//             the header via SCM_RIGHTS (one message, no ordering races).
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <sys/socket.h>
+#include <sys/un.h>
+#include <unistd.h>
+#include <vector>
+
+namespace fuseproxy {
+
+constexpr uint32_t kMagic = 0x53544655;  // "UFTS"
+constexpr uint32_t kMaxStrings = 64;
+constexpr uint32_t kMaxStringLen = 64 * 1024;
+
+inline const char* socket_path() {
+  const char* p = getenv("SKYTPU_FUSE_PROXY_SOCKET");
+  return p && *p ? p : "/run/skytpu-fuse-proxy/proxy.sock";
+}
+
+inline bool write_all(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t w = ::write(fd, p, n);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+inline bool read_all(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) {
+      if (r < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+inline bool send_request(int fd, const std::vector<std::string>& strings) {
+  uint32_t header[2] = {kMagic, static_cast<uint32_t>(strings.size())};
+  if (!write_all(fd, header, sizeof(header))) return false;
+  for (const auto& s : strings) {
+    uint32_t len = static_cast<uint32_t>(s.size());
+    if (!write_all(fd, &len, 4) || !write_all(fd, s.data(), len))
+      return false;
+  }
+  return true;
+}
+
+inline bool recv_request(int fd, std::vector<std::string>* strings) {
+  uint32_t header[2];
+  if (!read_all(fd, header, sizeof(header)) || header[0] != kMagic ||
+      header[1] > kMaxStrings)
+    return false;
+  strings->clear();
+  for (uint32_t i = 0; i < header[1]; ++i) {
+    uint32_t len;
+    if (!read_all(fd, &len, 4) || len > kMaxStringLen) return false;
+    std::string s(len, '\0');
+    if (len > 0 && !read_all(fd, &s[0], len)) return false;
+    strings->push_back(std::move(s));
+  }
+  return true;
+}
+
+// Response header + optional fd in ONE sendmsg (SCM_RIGHTS must accompany
+// data bytes; coupling it to the header removes any ordering question).
+inline bool send_response(int sock, uint32_t exit_code, int fuse_fd,
+                          const std::string& err_text) {
+  uint32_t header[4] = {kMagic, exit_code,
+                        fuse_fd >= 0 ? 1u : 0u,
+                        static_cast<uint32_t>(err_text.size())};
+  struct iovec iov = {header, sizeof(header)};
+  struct msghdr msg = {};
+  msg.msg_iov = &iov;
+  msg.msg_iovlen = 1;
+  alignas(struct cmsghdr) char cbuf[CMSG_SPACE(sizeof(int))] = {};
+  if (fuse_fd >= 0) {
+    msg.msg_control = cbuf;
+    msg.msg_controllen = sizeof(cbuf);
+    struct cmsghdr* cm = CMSG_FIRSTHDR(&msg);
+    cm->cmsg_level = SOL_SOCKET;
+    cm->cmsg_type = SCM_RIGHTS;
+    cm->cmsg_len = CMSG_LEN(sizeof(int));
+    std::memcpy(CMSG_DATA(cm), &fuse_fd, sizeof(int));
+  }
+  ssize_t w;
+  do {
+    w = sendmsg(sock, &msg, 0);
+  } while (w < 0 && errno == EINTR);
+  if (w != sizeof(header)) return false;
+  return err_text.empty() ||
+         write_all(sock, err_text.data(), err_text.size());
+}
+
+inline bool recv_response(int sock, uint32_t* exit_code, int* fuse_fd,
+                          std::string* err_text) {
+  uint32_t header[4];
+  struct iovec iov = {header, sizeof(header)};
+  struct msghdr msg = {};
+  msg.msg_iov = &iov;
+  msg.msg_iovlen = 1;
+  alignas(struct cmsghdr) char cbuf[CMSG_SPACE(sizeof(int))] = {};
+  msg.msg_control = cbuf;
+  msg.msg_controllen = sizeof(cbuf);
+  ssize_t r;
+  do {
+    r = recvmsg(sock, &msg, MSG_CMSG_CLOEXEC);
+  } while (r < 0 && errno == EINTR);
+  if (r != sizeof(header) || header[0] != kMagic) return false;
+  *exit_code = header[1];
+  *fuse_fd = -1;
+  if (header[2] == 1) {
+    for (struct cmsghdr* cm = CMSG_FIRSTHDR(&msg); cm != nullptr;
+         cm = CMSG_NXTHDR(&msg, cm)) {
+      if (cm->cmsg_level == SOL_SOCKET && cm->cmsg_type == SCM_RIGHTS &&
+          cm->cmsg_len >= CMSG_LEN(sizeof(int))) {
+        std::memcpy(fuse_fd, CMSG_DATA(cm), sizeof(int));
+      }
+    }
+    if (*fuse_fd < 0) return false;  // promised an fd but none arrived
+  }
+  uint32_t err_len = header[3];
+  if (err_len > kMaxStringLen) return false;
+  err_text->assign(err_len, '\0');
+  return err_len == 0 || read_all(sock, &(*err_text)[0], err_len);
+}
+
+}  // namespace fuseproxy

@@ -66,6 +66,8 @@ def up(task: task_lib.Task, service_name: Optional[str] = None,
     from skypilot_tpu import admin_policy
     task = admin_policy.apply(task, 'serve.up', cluster_name=service_name)
     spec = spec_lib.ServiceSpec.from_yaml_config(task.service_spec)
+    from skypilot_tpu.serve import spot_placer as spot_placer_lib
+    spot_placer_lib.validate_spec(spec, task)
     name = service_name or task.name or 'service'
     existing = serve_state.get_service(name)
     if existing is not None and not existing['status'].is_terminal():

@@ -28,6 +28,7 @@ from skypilot_tpu import sky_logging
 from skypilot_tpu.backends import slice_backend
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve import service_spec as spec_lib
+from skypilot_tpu.serve import spot_placer as spot_placer_lib
 from skypilot_tpu.serve.serve_state import ReplicaStatus
 
 if typing.TYPE_CHECKING:
@@ -67,6 +68,11 @@ class ReplicaManager:
         # when this passes the cap, the app is broken, not unlucky.
         self._probe_failure_streak = 0
         self.permanently_failed: Optional[str] = None
+        # Spot placement: which zone each live replica was placed in, so
+        # preemptions can be charged to the right location and new replicas
+        # spread away from in-use zones (serve/spot_placer.py).
+        self.spot_placer = spot_placer_lib.SpotPlacer.from_task(spec, task)
+        self._replica_locations: Dict[int, spot_placer_lib.Location] = {}
 
     # ------------------------------------------------------------------
     # Launch / terminate
@@ -85,6 +91,13 @@ class ReplicaManager:
                                      if self._local_ports else port),
             'SKYTPU_SERVE_REPLICA_ID': str(replica_id),
         })
+        # Placement was decided in scale_up (single-threaded) — concurrent
+        # launch threads reading the placer here would all see the same
+        # in-use set and pile into one zone.
+        loc = self._replica_locations.get(replica_id)
+        if loc is not None:
+            task.set_resources_override(loc.to_override())
+            logger.info(f'Replica {replica_id} placed at {loc}.')
         return task
 
     def _is_local(self) -> bool:
@@ -126,6 +139,11 @@ class ReplicaManager:
                 self.service_name, rid,
                 cluster_name=self._cluster_name(rid),
                 status=ReplicaStatus.PROVISIONING.value, url='')
+            if self.spot_placer is not None:
+                loc = self.spot_placer.select_next_location(
+                    list(self._replica_locations.values()))
+                if loc is not None:
+                    self._replica_locations[rid] = loc
             t = threading.Thread(target=self._launch_one, args=(rid,),
                                  daemon=True)
             self._launch_threads[rid] = t
@@ -166,6 +184,7 @@ class ReplicaManager:
         except Exception as e:  # pylint: disable=broad-except
             logger.warning(f'Teardown of replica {replica_id} failed: {e}')
         serve_state.remove_replica(self.service_name, replica_id)
+        self._replica_locations.pop(replica_id, None)
 
     def terminate_all(self) -> None:
         for rep in serve_state.get_replicas(self.service_name):
@@ -207,6 +226,10 @@ class ReplicaManager:
             if self._cluster_gone(rid):
                 logger.info(f'Replica {rid} lost (preemption/teardown) — '
                             f'replacing.')
+                if self.spot_placer is not None and \
+                        rid in self._replica_locations:
+                    self.spot_placer.set_preemptive(
+                        self._replica_locations[rid])
                 self.terminate_replica(rid, ReplicaStatus.PREEMPTED)
                 continue
             if status in (ReplicaStatus.STARTING, ReplicaStatus.READY,
@@ -223,6 +246,10 @@ class ReplicaManager:
                         serve_state.set_replica_status(
                             self.service_name, rid, ReplicaStatus.READY)
                         logger.info(f'Replica {rid} is READY.')
+                        if self.spot_placer is not None and \
+                                rid in self._replica_locations:
+                            self.spot_placer.set_active(
+                                self._replica_locations[rid])
                 elif not in_grace:
                     fails = serve_state.bump_replica_failures(
                         self.service_name, rid)

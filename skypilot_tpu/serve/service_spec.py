@@ -24,7 +24,7 @@ from typing import Any, Dict, Optional
 
 _SERVICE_FIELDS = frozenset({
     'readiness_probe', 'replicas', 'replica_policy', 'ports',
-    'load_balancing_policy',
+    'load_balancing_policy', 'spot_placer',
 })
 _POLICY_FIELDS = frozenset({
     'min_replicas', 'max_replicas', 'target_qps_per_replica',
@@ -59,6 +59,9 @@ class ServiceSpec:
     policy: ReplicaPolicy
     port: int = 8000
     load_balancing_policy: str = 'least_load'
+    # Spot placement policy name (serve/spot_placer.py); None disables
+    # placement (replicas launch wherever provisioning failover lands).
+    spot_placer: Optional[str] = None
 
     @classmethod
     def from_yaml_config(cls, config: Dict[str, Any]) -> 'ServiceSpec':
@@ -112,8 +115,15 @@ class ServiceSpec:
             raise ValueError(
                 f'Unknown load_balancing_policy {lb!r}; available: '
                 f'{registry.LB_POLICY_REGISTRY.keys()}')
+        placer = config.get('spot_placer')
+        if placer is not None:
+            from skypilot_tpu.serve import spot_placer as placer_lib
+            if placer not in placer_lib.PLACERS:
+                raise ValueError(
+                    f'Unknown spot_placer {placer!r}; available: '
+                    f'{sorted(placer_lib.PLACERS)}')
         return cls(readiness_probe=probe, policy=policy, port=int(ports),
-                   load_balancing_policy=lb.lower())
+                   load_balancing_policy=lb.lower(), spot_placer=placer)
 
     def to_yaml_config(self) -> Dict[str, Any]:
         out: Dict[str, Any] = {
@@ -121,6 +131,8 @@ class ServiceSpec:
             'ports': self.port,
             'load_balancing_policy': self.load_balancing_policy,
         }
+        if self.spot_placer is not None:
+            out['spot_placer'] = self.spot_placer
         pol = self.policy
         if pol.autoscaling_enabled or pol.max_replicas is not None:
             out['replica_policy'] = {

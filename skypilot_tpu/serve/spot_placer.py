@@ -1,0 +1,208 @@
+"""Spot replica placement: spread across zones, dodge preemption-prone ones.
+
+Reference analog: sky/serve/spot_placer.py (`SpotPlacer:170`,
+`DynamicFallbackSpotPlacer:254`). The problem: spot TPU capacity is
+zone-correlated — when a zone reclaims one replica it usually reclaims the
+rest soon after — so a service with every replica in one zone loses them
+all at once. The placer keeps a live map of candidate (cloud, region, zone)
+locations with a preemption history and places each new spot replica where
+capacity has been most durable, spreading replicas across zones first.
+
+TPU-first differences from the reference:
+  - candidates come from `Cloud.regions_with_offering` over the task's
+    `TpuSlice` (slice shapes are zone-constrained in the catalog), not from
+    per-instance-type launchable enumeration;
+  - preemption COUNTS are retained across fallback resets, so a zone that
+    has burned us five times ranks below one that burned us once even after
+    the active set is rebuilt.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import enum
+import typing
+from typing import Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.serve import service_spec as spec_lib
+
+logger = sky_logging.init_logger(__name__)
+
+DEFAULT_PLACER = 'dynamic_fallback'
+
+
+class LocationStatus(enum.Enum):
+    ACTIVE = 'ACTIVE'
+    PREEMPTED = 'PREEMPTED'
+
+
+@dataclasses.dataclass(frozen=True, order=True)
+class Location:
+    cloud: str
+    region: str
+    zone: Optional[str]
+
+    def to_override(self) -> Dict[str, Optional[str]]:
+        return {'region': self.region, 'zone': self.zone}
+
+    def __str__(self) -> str:
+        loc = f'{self.cloud}/{self.region}'
+        return f'{loc}/{self.zone}' if self.zone else loc
+
+
+def _candidate_locations(task: 'task_lib.Task') -> List[Location]:
+    """Enumerate feasible (cloud, region, zone) triples for the task.
+
+    Respects a user-pinned region/zone (the pin shrinks the candidate set
+    rather than being overridden)."""
+    from skypilot_tpu import check as check_lib
+    candidates = []
+    for res in task.resources_list():
+        clouds = ([res.cloud] if res.cloud is not None else
+                  check_lib.get_cached_enabled_clouds_or_refresh())
+        for cloud in clouds:
+            try:
+                regions = cloud.regions_with_offering(res)
+            except Exception:  # pylint: disable=broad-except
+                continue
+            for region in regions:
+                if res.region is not None and region.name != res.region:
+                    continue
+                zones = [z.name for z in region.zones] or [None]
+                for zone in zones:
+                    if res.zone is not None and zone != res.zone:
+                        continue
+                    candidates.append(
+                        Location(str(cloud), region.name, zone))
+    return sorted(set(candidates))
+
+
+def validate_spec(spec: 'spec_lib.ServiceSpec',
+                  task: 'task_lib.Task') -> None:
+    """Admission-time checks for `service.spot_placer` (serve.core.up)."""
+    name = spec.spot_placer
+    if name is None:
+        return
+    if name not in PLACERS:
+        raise ValueError(f'Unknown spot_placer {name!r}; '
+                         f'valid: {sorted(PLACERS)}')
+    if not all(r.use_spot for r in task.resources_list()):
+        raise ValueError(
+            'service.spot_placer requires every task resource option to '
+            'set use_spot: true (got an on-demand option).')
+
+
+class SpotPlacer:
+    """Base placer: location inventory + preemption bookkeeping."""
+
+    def __init__(self, task: 'task_lib.Task'):
+        locations = _candidate_locations(task)
+        self.location2status: Dict[Location, LocationStatus] = {
+            loc: LocationStatus.ACTIVE for loc in locations}
+        self.preemption_counts: Dict[Location, int] = \
+            collections.defaultdict(int)
+        self._cost_cache: Dict[Location, float] = {}
+        self._resources = task.resources_list()[0]
+        logger.info(f'Spot placer: {len(locations)} candidate locations.')
+
+    # -- status ---------------------------------------------------------
+    def set_active(self, location: Location) -> None:
+        if location in self.location2status:
+            self.location2status[location] = LocationStatus.ACTIVE
+
+    def set_preemptive(self, location: Location) -> None:
+        if location in self.location2status:
+            self.location2status[location] = LocationStatus.PREEMPTED
+            self.preemption_counts[location] += 1
+
+    def clear_preemptive_locations(self) -> None:
+        for loc in self.location2status:
+            self.location2status[loc] = LocationStatus.ACTIVE
+
+    def active_locations(self) -> List[Location]:
+        return [l for l, s in self.location2status.items()
+                if s is LocationStatus.ACTIVE]
+
+    def preemptive_locations(self) -> List[Location]:
+        return [l for l, s in self.location2status.items()
+                if s is LocationStatus.PREEMPTED]
+
+    # -- selection ------------------------------------------------------
+    def select_next_location(self,
+                             current: List[Location]) -> Optional[Location]:
+        raise NotImplementedError
+
+    def _hourly_cost(self, location: Location) -> float:
+        if location not in self._cost_cache:
+            try:
+                res = self._resources.copy(**location.to_override())
+                self._cost_cache[location] = res.get_cost(seconds=3600)
+            except Exception:  # pylint: disable=broad-except
+                self._cost_cache[location] = float('inf')
+        return self._cost_cache[location]
+
+    @classmethod
+    def from_task(cls, spec: 'spec_lib.ServiceSpec',
+                  task: 'task_lib.Task') -> Optional['SpotPlacer']:
+        """Placer iff the service asked for one AND the task runs on spot.
+
+        Misconfiguration degrades to no-placer (with a warning) instead of
+        raising: this runs inside the controller AND inside `serve down`
+        teardown — a raise here would wedge shutdown of a service whose
+        spec was admitted by an older validator. Admission-time rejection
+        is `validate_spec` (called from serve.core.up)."""
+        name = spec.spot_placer
+        if name is None:
+            return None
+        try:
+            validate_spec(spec, task)
+        except ValueError as e:
+            logger.warning(f'Spot placer disabled: {e}')
+            return None
+        placer = PLACERS[name](task)
+        if not placer.location2status:
+            logger.warning('Spot placer found no candidate locations; '
+                           'placement disabled.')
+            return None
+        return placer
+
+
+class DynamicFallbackSpotPlacer(SpotPlacer):
+    """Spread over unused active zones; on preemption, fall back elsewhere.
+
+    Selection order: (1) active locations not currently hosting a replica,
+    (2) any active location. Within a tier, fewest historical preemptions
+    wins, then lowest hourly cost. When preemptions leave fewer than two
+    active locations, the preempted set is reactivated (capacity weather
+    changes) — but their counts persist, so they rank last."""
+
+    def select_next_location(self,
+                             current: List[Location]) -> Optional[Location]:
+        active = self.active_locations()
+        if not active:
+            self.clear_preemptive_locations()
+            active = self.active_locations()
+            if not active:
+                return None
+        candidates = [l for l in active if l not in current] or active
+        choice = min(candidates,
+                     key=lambda l: (self.preemption_counts[l],
+                                    self._hourly_cost(l), l))
+        logger.info(f'Spot placer selected {choice} '
+                    f'(active={len(active)}, in-use={len(current)}).')
+        return choice
+
+    def set_preemptive(self, location: Location) -> None:
+        super().set_preemptive(location)
+        if len(self.active_locations()) < 2:
+            self.clear_preemptive_locations()
+
+
+PLACERS = {
+    DEFAULT_PLACER: DynamicFallbackSpotPlacer,
+}

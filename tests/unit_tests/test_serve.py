@@ -18,6 +18,66 @@ from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
 
 
 # ---------------------------------------------------------------------------
+# Spot placement (pure logic over the local cloud's 2 fake zones)
+# ---------------------------------------------------------------------------
+class TestSpotPlacer:
+
+    def _placer(self, enable_local_cloud):  # noqa: ARG002 (fixture)
+        from skypilot_tpu.serve import spot_placer
+        task = sky.Task(name='svc', run='x')
+        task.set_resources(
+            sky.Resources(accelerators='tpu-v5e-8', cloud='local',
+                          use_spot=True))
+        spec = spec_lib.ServiceSpec.from_yaml_config(
+            {'replicas': 2, 'spot_placer': 'dynamic_fallback'})
+        placer = spot_placer.SpotPlacer.from_task(spec, task)
+        assert placer is not None
+        return placer
+
+    def test_spreads_across_zones(self, enable_local_cloud):
+        placer = self._placer(enable_local_cloud)
+        assert len(placer.location2status) == 2  # local-a, local-b
+        first = placer.select_next_location([])
+        second = placer.select_next_location([first])
+        assert {first.zone, second.zone} == {'local-a', 'local-b'}
+
+    def test_preemption_moves_placement_and_falls_back(
+            self, enable_local_cloud):
+        placer = self._placer(enable_local_cloud)
+        loc_a = placer.select_next_location([])
+        # Zone preempted → next selection avoids it.
+        placer.set_preemptive(loc_a)
+        nxt = placer.select_next_location([])
+        assert nxt != loc_a
+        # Preempting the survivor too leaves <2 active → dynamic fallback
+        # reactivates everything, but historical counts still rank loc_a
+        # (2 preemptions) below nxt (1).
+        placer.set_preemptive(loc_a)
+        placer.set_preemptive(nxt)
+        assert len(placer.active_locations()) == 2
+        assert placer.select_next_location([]) == nxt
+
+    def test_spot_placer_requires_spot_task(self, enable_local_cloud):
+        from skypilot_tpu.serve import spot_placer
+        task = sky.Task(name='svc', run='x')
+        task.set_resources(
+            sky.Resources(accelerators='tpu-v5e-8', cloud='local'))
+        spec = spec_lib.ServiceSpec.from_yaml_config(
+            {'replicas': 1, 'spot_placer': 'dynamic_fallback'})
+        # Admission (serve up) rejects the misconfiguration...
+        with pytest.raises(ValueError, match='use_spot'):
+            spot_placer.validate_spec(spec, task)
+        # ...but controller/teardown construction degrades to no-placer so
+        # `serve down` can't wedge on a bad spec.
+        assert spot_placer.SpotPlacer.from_task(spec, task) is None
+
+    def test_spec_rejects_unknown_placer(self):
+        with pytest.raises(ValueError, match='spot_placer'):
+            spec_lib.ServiceSpec.from_yaml_config(
+                {'replicas': 1, 'spot_placer': 'nope'})
+
+
+# ---------------------------------------------------------------------------
 # Pure-logic tiers
 # ---------------------------------------------------------------------------
 class TestServiceSpec:

@@ -178,3 +178,47 @@ class TestRequestGC:
         assert requests_lib.get(fresh) is not None
         assert requests_lib.get(live) is not None       # non-terminal kept
         assert not os.path.exists(requests_lib.log_path(old))
+
+
+@pytest.mark.usefixtures('isolated_server')
+class TestAsyncSdk:
+    """client/sdk_async.py against the real app (reference analog:
+    sky/client/sdk_async.py). The executor isn't running, so request
+    completion is driven by hand via requests_lib."""
+
+    def test_submit_get_stream_list(self):
+        from skypilot_tpu.client import sdk_async
+
+        async def fn(client):
+            url = str(client.server.make_url('')).rstrip('/')
+            rid = await sdk_async.submit('status', {}, url=url)
+            rec = requests_lib.get(rid)
+            assert rec['name'] == 'status'
+            # Complete it by hand, with a log.
+            with open(requests_lib.log_path(rid), 'w') as f:
+                f.write('hello-from-log\n')
+            requests_lib.set_result(rid, {'clusters': ['c1']})
+            assert (await sdk_async.get(rid, url=url)) == {
+                'clusters': ['c1']}
+            import io
+            buf = io.StringIO()
+            res = await sdk_async.stream_and_get(rid, url=url, out=buf)
+            assert res == {'clusters': ['c1']}
+            assert 'hello-from-log' in buf.getvalue()
+            rids = [r['request_id']
+                    for r in await sdk_async.api_list_requests(url=url)]
+            assert rid in rids
+
+        _with_client(fn)
+
+    def test_failed_request_raises(self):
+        from skypilot_tpu.client import sdk_async
+
+        async def fn(client):
+            url = str(client.server.make_url('')).rstrip('/')
+            rid = await sdk_async.submit('status', {}, url=url)
+            requests_lib.set_failed(rid, 'boom')
+            with pytest.raises(sdk_async.RequestFailedError, match='boom'):
+                await sdk_async.get(rid, url=url)
+
+        _with_client(fn)
