@@ -301,9 +301,12 @@ def jobs():
 @click.option('--env', multiple=True, help='KEY=VALUE task env overrides.')
 @click.option('--detach-run', '-d', is_flag=True, default=False,
               help='Return immediately instead of streaming logs.')
+@click.option('--pool', '-p', default=None,
+              help='Run on a worker of this pool (see `jobs pool apply`) '
+                   'instead of a dedicated cluster.')
 @_resource_options
 def jobs_launch(entrypoint: str, name: Optional[str], env: Tuple[str, ...],
-                detach_run: bool, **overrides):
+                detach_run: bool, pool: Optional[str], **overrides):
     """Submit a managed job — single task, or a multi-document YAML
     pipeline (stages run in order, one recovery-managed job)."""
     from skypilot_tpu import jobs as jobs_lib
@@ -327,7 +330,7 @@ def jobs_launch(entrypoint: str, name: Optional[str], env: Tuple[str, ...],
     if entry is None:
         entry = _load_task(entrypoint, env, overrides)
     try:
-        job_id = jobs_lib.launch(entry, name=name)
+        job_id = jobs_lib.launch(entry, name=name, pool=pool)
     except (exceptions.SkyTpuError, ValueError) as e:
         raise click.ClickException(str(e)) from e
     click.echo(f'Managed job {job_id} submitted.')
@@ -396,6 +399,67 @@ def jobs_cancel(job_ids: Tuple[int, ...], name: Optional[str],
     except (exceptions.SkyTpuError, ValueError) as e:
         raise click.ClickException(str(e)) from e
     click.echo(f'Cancellation requested: {done}')
+
+
+@jobs.group(name='pool')
+def jobs_pool():
+    """Worker pools: pre-provisioned clusters managed jobs exec onto
+    (reference: `sky jobs pool`)."""
+
+
+@jobs_pool.command(name='apply')
+@click.argument('entrypoint', required=True)
+@click.option('--pool-name', '-p', default=None, help='Pool name.')
+@click.option('--workers', '-w', type=int, default=None,
+              help='Worker count (overrides the YAML pool.workers).')
+def jobs_pool_apply(entrypoint: str, pool_name: Optional[str],
+                    workers: Optional[int]):
+    """Create or resize a pool from a task YAML with a `pool:` section."""
+    from skypilot_tpu.jobs import pool as pool_lib
+    task = _load_task(entrypoint, (), {})
+    try:
+        result = pool_lib.apply(task, pool_name=pool_name, workers=workers)
+    except (exceptions.SkyTpuError, ValueError) as e:
+        raise click.ClickException(str(e)) from e
+    click.echo(f"Pool {result['name']!r} applied "
+               f'(watch: skytpu jobs pool status).')
+
+
+@jobs_pool.command(name='status')
+@click.argument('pool_names', nargs=-1)
+def jobs_pool_status(pool_names: Tuple[str, ...]):
+    """Show pools and their workers (busy workers show the job id)."""
+    from skypilot_tpu.jobs import pool as pool_lib
+    records = pool_lib.status(list(pool_names) or None)
+    if not records:
+        click.echo('No pools.')
+        return
+    for r in records:
+        click.echo(f"{r['name']}  {r['status'].colored_str()}  "
+                   f"{len(r['replicas'])} worker(s)")
+        for rep in r['replicas']:
+            busy = (f"  job {rep['job_id']}" if rep.get('job_id') is not None
+                    else '  idle')
+            click.echo(f"  worker {rep['replica_id']}  "
+                       f"{rep['status'].colored_str()}{busy}  "
+                       f"({rep['cluster_name']})")
+
+
+@jobs_pool.command(name='down')
+@click.argument('pool_name', required=True)
+@click.option('--purge', is_flag=True, default=False,
+              help='Also remove the pool record.')
+@click.option('--yes', '-y', is_flag=True, default=False)
+def jobs_pool_down(pool_name: str, purge: bool, yes: bool):
+    """Tear down a pool and its workers."""
+    from skypilot_tpu.jobs import pool as pool_lib
+    if not yes:
+        click.confirm(f'Tear down pool {pool_name!r}?', abort=True)
+    try:
+        pool_lib.down(pool_name, purge=purge)
+    except (exceptions.SkyTpuError, ValueError) as e:
+        raise click.ClickException(str(e)) from e
+    click.echo(f'Pool {pool_name!r} torn down.')
 
 
 @cli.group()

@@ -11,6 +11,10 @@ from skypilot_tpu.jobs.core import cancel
 from skypilot_tpu.jobs.core import launch
 from skypilot_tpu.jobs.core import queue
 from skypilot_tpu.jobs.core import tail_logs
+from skypilot_tpu.jobs.pool import apply as pool_apply
+from skypilot_tpu.jobs.pool import down as pool_down
+from skypilot_tpu.jobs.pool import status as pool_status
 from skypilot_tpu.jobs.state import ManagedJobStatus
 
-__all__ = ['launch', 'queue', 'cancel', 'tail_logs', 'ManagedJobStatus']
+__all__ = ['launch', 'queue', 'cancel', 'tail_logs', 'ManagedJobStatus',
+           'pool_apply', 'pool_down', 'pool_status']

@@ -73,6 +73,15 @@ class JobsController:
         return f'{self._base_cluster_name}-t{index}'
 
     # ------------------------------------------------------------------
+    def _sync_cluster_name(self) -> None:
+        """Pool jobs land on a worker cluster the strategy picked; keep the
+        controller's (and the queue display's) cluster name in step."""
+        if self.strategy.cluster_name != self.cluster_name:
+            self.cluster_name = self.strategy.cluster_name
+            state.set_current_task(self.job_id,
+                                   state.get_job(self.job_id)['current_task'],
+                                   self.cluster_name)
+
     def _cluster_alive(self) -> bool:
         """Cloud-truth liveness of the job's slice (preemption detector)."""
         record = global_state.get_cluster(self.cluster_name)
@@ -156,12 +165,19 @@ class JobsController:
             # PENDING) before this controller got going: nothing to do.
             logger.info(f'[job {job_id}] already terminal; controller exits.')
             return
+        pool = self.record.get('pool')
         for index, task in enumerate(self.tasks):
             self.task = task
             self.cluster_name = self._stage_cluster_name(index)
             state.set_current_task(job_id, index, self.cluster_name)
-            self.strategy = recovery_strategy.StrategyExecutor.make(
-                self.cluster_name, task, job_id)
+            if pool:
+                # Pool jobs run on a claimed worker instead of a dedicated
+                # cluster; the real cluster name is known after acquire.
+                self.strategy = recovery_strategy.PoolStrategyExecutor(
+                    self.cluster_name, task, job_id, pool)
+            else:
+                self.strategy = recovery_strategy.StrategyExecutor.make(
+                    self.cluster_name, task, job_id)
             if len(self.tasks) > 1:
                 logger.info(f'[job {job_id}] pipeline stage '
                             f'{index + 1}/{len(self.tasks)}')
@@ -179,6 +195,11 @@ class JobsController:
         logger.info(f'[job {job_id}] launching as {self.cluster_name!r}')
         try:
             cluster_job_id = self.strategy.launch()
+            self._sync_cluster_name()
+        except recovery_strategy.JobCancelledDuringRecovery:
+            # Cancelled while queued for a pool worker.
+            self._do_cancel(None)
+            return False
         except exceptions.ResourcesUnavailableError as e:
             state.set_terminal(job_id, state.ManagedJobStatus.
                                FAILED_NO_RESOURCE, failure_reason=str(e))
@@ -216,6 +237,7 @@ class JobsController:
                     self._do_cancel(cluster_job_id)
                     return False
                 state.set_recovered(job_id, cluster_job_id)
+                self._sync_cluster_name()
                 continue
 
             job_status = self._job_status(cluster_job_id)

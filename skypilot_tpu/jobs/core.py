@@ -25,13 +25,24 @@ from skypilot_tpu.usage import usage_lib
 
 @usage_lib.tracked('jobs.launch')
 def launch(entrypoint: Union[task_lib.Task, dag_lib.Dag],
-           name: Optional[str] = None) -> int:
+           name: Optional[str] = None, pool: Optional[str] = None) -> int:
     """Submit a managed job; returns its managed-job id immediately.
 
     The controller process owns the whole lifecycle from here: provisioning
-    (with failover), monitoring, preemption recovery, teardown.
+    (with failover), monitoring, preemption recovery, teardown. With
+    `pool`, the job runs on a claimed worker of that pool (jobs/pool.py)
+    instead of a dedicated cluster.
     """
     from skypilot_tpu import admin_policy
+    if pool is not None:
+        from skypilot_tpu.serve import serve_state
+        record = serve_state.get_service(pool)
+        if record is None or not (record['spec'] or {}).get('pool'):
+            raise ValueError(
+                f'Pool {pool!r} does not exist; create it with '
+                f'`skytpu jobs pool apply`.')
+        if record['status'].is_terminal():
+            raise ValueError(f'Pool {pool!r} is {record["status"].value}.')
     if isinstance(entrypoint, dag_lib.Dag):
         if not entrypoint.is_chain():
             raise NotImplementedError(
@@ -57,7 +68,7 @@ def launch(entrypoint: Union[task_lib.Task, dag_lib.Dag],
         job_name, task_config,
         strategy=_strategy_name(tasks[0]),
         max_restarts_on_errors=_max_restarts(tasks[0]),
-        num_tasks=len(tasks))
+        num_tasks=len(tasks), pool=pool)
     scheduler.maybe_schedule()
     logger.info(f'Managed job {job_id} ({job_name!r}) submitted.')
     return job_id

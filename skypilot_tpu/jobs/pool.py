@@ -1,0 +1,102 @@
+"""Worker pools for managed jobs: pre-provisioned clusters jobs exec onto.
+
+Reference analog: sky jobs pool (sky/jobs/server/core.py:1155
+`pool_apply/pool_down/pool_status`, sky/serve/service_spec.py:40-64 pool
+mode). A pool reuses the serve plane wholesale — it IS a service whose
+spec has `pool: true`: the same controller reconciles workers (launch,
+liveness, preemption replacement, spot placement), with no load balancer
+and no HTTP probes. What pools add on top:
+
+  - workers idle after setup (`run:` is rejected at apply);
+  - `jobs launch --pool NAME` claims a READY worker
+    (serve_state.acquire_worker) and execs the job onto it — startup in
+    seconds, cluster reuse across jobs, queueing when all workers are
+    busy (jobs/recovery_strategy.py `PoolStrategyExecutor`).
+
+Pool YAML (task file):
+
+    pool:
+      workers: 2
+    resources:
+      accelerators: tpu-v5e-8
+    setup: pip install -r requirements.txt
+"""
+from __future__ import annotations
+
+import json
+import typing
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.serve import core as serve_core
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.usage import usage_lib
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import task as task_lib
+
+logger = sky_logging.init_logger(__name__)
+
+
+@usage_lib.tracked('jobs.pool_apply')
+def apply(task: 'task_lib.Task', pool_name: Optional[str] = None,
+          workers: Optional[int] = None) -> Dict[str, Any]:
+    """Create a pool (or resize an existing one) from a `pool:` task.
+
+    `workers` overrides the YAML's `pool.workers`. Resizing an existing
+    pool updates the worker target in place — the running controller's
+    reconcile loop scales toward it without touching busy workers.
+    """
+    if task.service_spec is None:
+        task.service_spec = {'pool': True}
+    if not task.service_spec.get('pool'):
+        raise ValueError("Task has a 'service:' section; use `serve up` "
+                         'for services and a `pool:` section for pools.')
+    if workers is not None:
+        task.service_spec = {**task.service_spec, 'workers': int(workers)}
+    name = pool_name or task.name or 'pool'
+    existing = serve_state.get_service(name)
+    if existing is not None and not existing['status'].is_terminal():
+        if not (existing['spec'] or {}).get('pool'):
+            raise ValueError(f'{name!r} is a service, not a pool.')
+        # In-place resize: only the worker count may change (the live
+        # controller re-reads it every reconcile pass); anything else
+        # requires a down/apply cycle.
+        return _resize(name, existing, task)
+    return serve_core.up(task, service_name=name)
+
+
+def _resize(name: str, record: Dict[str, Any],
+            task: 'task_lib.Task') -> Dict[str, Any]:
+    from skypilot_tpu.serve import service_spec as spec_lib
+    new_spec = spec_lib.ServiceSpec.from_yaml_config(task.service_spec)
+    old_cfg = dict(record['spec'])
+    new_cfg = new_spec.to_yaml_config()
+    if {k: v for k, v in old_cfg.items() if k != 'workers'} != \
+            {k: v for k, v in new_cfg.items() if k != 'workers'}:
+        raise ValueError(
+            f'Pool {name!r} exists with a different spec; only the worker '
+            f'count can change in place. `jobs pool down {name}` first.')
+    if record['task_config'].get('setup') != task.to_yaml_config().get(
+            'setup'):
+        raise ValueError(
+            f"Pool {name!r} exists with a different 'setup'; tear it down "
+            f'first (`jobs pool down {name}`).')
+    serve_state.update_service(name, spec=json.dumps(new_cfg))
+    logger.info(f'Pool {name!r} resized to {new_cfg["workers"]} worker(s).')
+    return {'name': name, 'endpoint': None}
+
+
+def status(pool_names: Optional[List[str]] = None) -> List[Dict[str, Any]]:
+    """Pool records only (services are `serve status`)."""
+    return serve_core.status(pool_names, pool=True)
+
+
+@usage_lib.tracked('jobs.pool_down')
+def down(pool_name: str, purge: bool = False) -> None:
+    """Tear a pool down. Jobs still running on its workers lose their
+    clusters and will fail recovery (pool gone → FAILED_NO_RESOURCE)."""
+    record = serve_state.get_service(pool_name)
+    if record is not None and not (record['spec'] or {}).get('pool'):
+        raise ValueError(f'{pool_name!r} is a service; use `serve down`.')
+    serve_core.down(pool_name, purge=purge)

@@ -12,6 +12,7 @@ every recovery is terminate-then-relaunch, never restart.
 """
 from __future__ import annotations
 
+import os
 import time
 import typing
 from typing import Optional
@@ -200,6 +201,96 @@ class StrategyExecutor:
         raise exceptions.ManagedJobReachedMaxRetriesError(
             f'Recovery of job {self.job_id} gave up after '
             f'{MAX_RECOVERY_ROUNDS} failover rounds.')
+
+
+class PoolStrategyExecutor(StrategyExecutor):
+    """Run the job on a worker of a pre-provisioned pool (jobs/pool.py).
+
+    Instead of launching a dedicated cluster, `launch` claims a READY idle
+    worker (serve_state.acquire_worker) and execs the task onto it —
+    seconds instead of minutes, no provisioning risk. Recovery releases
+    the (dead) worker — the pool's replica manager replaces it — and
+    claims a different one. Termination releases the worker; the cluster
+    itself belongs to the pool. Reference: sky/jobs/recovery_strategy.py
+    pool path (job_id_on_pool_cluster) + scheduler.py:396.
+
+    Not in the strategy registry: selection is by the job's `pool` field,
+    not by `job_recovery:` (any recovery name combined with --pool means
+    "reacquire a worker").
+    """
+
+    # How long launch() waits for a free worker before giving up entirely.
+    ACQUIRE_TIMEOUT_SECONDS = float(
+        os.environ.get('SKYTPU_POOL_ACQUIRE_TIMEOUT', str(24 * 3600)))
+    ACQUIRE_POLL_SECONDS = float(
+        os.environ.get('SKYTPU_POOL_ACQUIRE_POLL', '5'))
+
+    def __init__(self, cluster_name: str, task: 'task_lib.Task',
+                 job_id: int, pool: str) -> None:
+        super().__init__(cluster_name, task, job_id)
+        self.pool = pool
+
+    def _pool_alive(self) -> bool:
+        from skypilot_tpu.serve import serve_state
+        record = serve_state.get_service(self.pool)
+        return record is not None and not record['status'].is_terminal()
+
+    def launch(self) -> Optional[int]:
+        """Claim a worker, exec the task on it. Queues (rather than fails)
+        while every worker is busy — that is the pool contract."""
+        from skypilot_tpu import execution
+        from skypilot_tpu.serve import serve_state
+        deadline = time.time() + self.ACQUIRE_TIMEOUT_SECONDS
+        while True:
+            self._check_cancel()
+            if not self._pool_alive():
+                raise exceptions.ResourcesUnavailableError(
+                    f'Pool {self.pool!r} is gone or failed; cannot place '
+                    f'job {self.job_id}.')
+            worker = serve_state.acquire_worker(self.pool, self.job_id)
+            if worker is not None:
+                cluster = worker['cluster_name']
+                try:
+                    job_id_on_cluster, handle = execution.exec(
+                        self.task, cluster_name=cluster, detach_run=True)
+                except Exception:
+                    # Worker unusable (e.g. preempted between READY and
+                    # exec): return it NOT_READY so reconcile re-vets it,
+                    # and try another.
+                    serve_state.release_worker(self.pool, self.job_id)
+                    serve_state.set_replica_status(
+                        self.pool, worker['replica_id'],
+                        serve_state.ReplicaStatus.NOT_READY)
+                    logger.warning(
+                        f'[job {self.job_id}] exec on worker '
+                        f'{worker["replica_id"]} ({cluster}) failed; '
+                        f'trying another.', exc_info=True)
+                    continue
+                self.handle = handle
+                self.cluster_name = cluster
+                logger.info(f'[job {self.job_id}] running on pool '
+                            f'{self.pool!r} worker {worker["replica_id"]} '
+                            f'({cluster}).')
+                return job_id_on_cluster
+            if time.time() > deadline:
+                raise exceptions.ResourcesUnavailableError(
+                    f'No worker of pool {self.pool!r} became free within '
+                    f'{self.ACQUIRE_TIMEOUT_SECONDS:.0f}s.')
+            time.sleep(self.ACQUIRE_POLL_SECONDS)
+
+    def recover(self) -> Optional[int]:
+        """The worker died (or the job's cluster check failed): release it
+        and claim a different one. The pool's own replica manager deals
+        with replacing the dead worker."""
+        from skypilot_tpu.serve import serve_state
+        serve_state.release_worker(self.pool, self.job_id)
+        self.handle = None
+        return self.launch()
+
+    def terminate_cluster(self, max_retries: int = 3) -> None:
+        """Jobs never tear down pool workers — just hand the claim back."""
+        from skypilot_tpu.serve import serve_state
+        serve_state.release_worker(self.pool, self.job_id)
 
 
 @registry.JOBS_RECOVERY_STRATEGY_REGISTRY.register(name='failover')

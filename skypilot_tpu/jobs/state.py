@@ -112,7 +112,8 @@ def _conn() -> sqlite3.Connection:
             controller_pid INTEGER,
             cancel_requested INTEGER DEFAULT 0,
             current_task INTEGER DEFAULT 0,
-            num_tasks INTEGER DEFAULT 1
+            num_tasks INTEGER DEFAULT 1,
+            pool TEXT
         )""")
     # Older DBs predate the pipeline columns.
     for col, default in (('current_task', 0), ('num_tasks', 1)):
@@ -121,6 +122,10 @@ def _conn() -> sqlite3.Connection:
                          f'DEFAULT {default}')
         except sqlite3.OperationalError:
             pass   # already present
+    try:
+        conn.execute('ALTER TABLE jobs ADD COLUMN pool TEXT')
+    except sqlite3.OperationalError:
+        pass
     return conn
 
 
@@ -141,16 +146,20 @@ def job_log_path(job_id: int) -> str:
 # Writes
 # ---------------------------------------------------------------------------
 def submit(name: str, task_config: Dict[str, Any], strategy: str,
-           max_restarts_on_errors: int = 0, num_tasks: int = 1) -> int:
+           max_restarts_on_errors: int = 0, num_tasks: int = 1,
+           pool: Optional[str] = None) -> int:
     """task_config: one task dict, or {'pipeline': [task dicts]} for
-    chained multi-task jobs (reference: pipeline managed jobs)."""
+    chained multi-task jobs (reference: pipeline managed jobs). `pool`
+    routes the job onto a worker of that pool instead of a dedicated
+    cluster."""
     with _conn() as conn:
         cur = conn.execute(
             'INSERT INTO jobs (name, task_config, status, strategy, '
-            'submitted_at, max_restarts_on_errors, num_tasks) '
-            'VALUES (?, ?, ?, ?, ?, ?, ?)',
+            'submitted_at, max_restarts_on_errors, num_tasks, pool) '
+            'VALUES (?, ?, ?, ?, ?, ?, ?, ?)',
             (name, json.dumps(task_config), ManagedJobStatus.PENDING.value,
-             strategy, time.time(), max_restarts_on_errors, num_tasks))
+             strategy, time.time(), max_restarts_on_errors, num_tasks,
+             pool))
         assert cur.lastrowid is not None
         return cur.lastrowid
 

@@ -59,7 +59,13 @@ class ServiceController:
                 if record is None or record['status'] in (
                         ServiceStatus.SHUTTING_DOWN, ServiceStatus.SHUTDOWN):
                     break
-                target = self.autoscaler.target_replicas()
+                if self.spec.pool:
+                    # Worker count is resizable in place (jobs/pool.py
+                    # rewrites the stored spec); honor the live value.
+                    target = int((record['spec'] or {}).get(
+                        'workers', self.spec.policy.min_replicas))
+                else:
+                    target = self.autoscaler.target_replicas()
                 self.manager.reconcile(target)
                 if self.manager.permanently_failed:
                     self.manager.terminate_all()
@@ -69,8 +75,13 @@ class ServiceController:
                     logger.warning(f'Service {self.name!r} FAILED: '
                                    f'{self.manager.permanently_failed}')
                     break
-                ready = self.manager.ready_urls()
-                self.lb.set_ready_replicas(ready)
+                if self.spec.pool:
+                    # Workers have no URLs; readiness is status-driven.
+                    ready = [r for r in serve_state.get_replicas(self.name)
+                             if r['status'] is ReplicaStatus.READY]
+                else:
+                    ready = self.manager.ready_urls()
+                    self.lb.set_ready_replicas(ready)
                 status = (ServiceStatus.READY if ready else
                           ServiceStatus.REPLICA_INIT)
                 if record['status'] is not status:
@@ -82,6 +93,12 @@ class ServiceController:
     # ------------------------------------------------------------------
     def run(self) -> None:
         serve_state.update_service(self.name, controller_pid=os.getpid())
+        if self.spec.pool:
+            # Pools have no load balancer: the reconcile loop IS the
+            # controller (workers are consumed via `jobs launch --pool`).
+            logger.info(f'Pool {self.name!r}: reconcile loop only.')
+            self._reconcile_loop()
+            return
         loop_thread = threading.Thread(target=self._reconcile_loop,
                                        daemon=True)
         loop_thread.start()

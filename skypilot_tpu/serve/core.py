@@ -68,6 +68,11 @@ def up(task: task_lib.Task, service_name: Optional[str] = None,
     spec = spec_lib.ServiceSpec.from_yaml_config(task.service_spec)
     from skypilot_tpu.serve import spot_placer as spot_placer_lib
     spot_placer_lib.validate_spec(spec, task)
+    if spec.pool and task.run is not None:
+        raise ValueError(
+            "A pool task must not have a 'run' section — workers idle "
+            'after setup; jobs submitted with --pool bring their own run '
+            'command.')
     name = service_name or task.name or 'service'
     existing = serve_state.get_service(name)
     if existing is not None and not existing['status'].is_terminal():
@@ -76,7 +81,9 @@ def up(task: task_lib.Task, service_name: Optional[str] = None,
             f'Tear it down first with `skytpu serve down {name}`.')
     if existing is not None:
         serve_state.remove_service(name)
-    if lb_port is None:
+    if spec.pool:
+        lb_port = 0          # pools run no load balancer
+    elif lb_port is None:
         lb_port = _free_port(DEFAULT_LB_PORT_START)
     if not serve_state.add_service(name, task.to_yaml_config(),
                                    spec.to_yaml_config(), lb_port):
@@ -86,24 +93,35 @@ def up(task: task_lib.Task, service_name: Optional[str] = None,
                          f'request; check `skytpu serve status`.')
     pid = _spawn_controller(name)
     serve_state.update_service(name, controller_pid=pid)
+    if spec.pool:
+        logger.info(f'Pool {name!r} starting; '
+                    f'{spec.policy.min_replicas} worker(s) '
+                    f'(controller pid {pid}).')
+        return {'name': name, 'endpoint': None}
     endpoint = f'http://127.0.0.1:{lb_port}'
     logger.info(f'Service {name!r} starting; endpoint {endpoint} '
                 f'(controller pid {pid}).')
     return {'name': name, 'endpoint': endpoint}
 
 
-def status(service_names: Optional[List[str]] = None
-           ) -> List[Dict[str, Any]]:
+def status(service_names: Optional[List[str]] = None,
+           pool: Optional[bool] = None) -> List[Dict[str, Any]]:
+    """Service (pool=False), pool (pool=True), or combined (None) status."""
     records = serve_state.get_services()
     if service_names:
         records = [r for r in records if r['name'] in service_names]
     out = []
     for r in records:
+        is_pool = bool((r['spec'] or {}).get('pool'))
+        if pool is not None and is_pool != pool:
+            continue
         replicas = serve_state.get_replicas(r['name'])
         out.append({
             'name': r['name'],
             'status': r['status'],
-            'endpoint': f"http://127.0.0.1:{r['lb_port']}",
+            'endpoint': (None if is_pool else
+                         f"http://127.0.0.1:{r['lb_port']}"),
+            'pool': is_pool,
             'created_at': r['created_at'],
             'failure_reason': r.get('failure_reason'),
             'replicas': [{
@@ -111,6 +129,7 @@ def status(service_names: Optional[List[str]] = None
                 'status': rep['status'],
                 'url': rep['url'],
                 'cluster_name': rep['cluster_name'],
+                'job_id': rep.get('job_id'),
             } for rep in replicas],
         })
     return out

@@ -84,13 +84,19 @@ class ReplicaManager:
         from skypilot_tpu import task as task_lib_mod
         cfg = self.task.to_yaml_config()
         cfg.pop('service', None)
+        if self.spec.pool:
+            # A pool worker is provision+setup only: it idles until a
+            # managed job execs onto it (jobs/recovery_strategy.py pool
+            # path). A run command here would race the jobs.
+            cfg.pop('run', None)
         task = task_lib_mod.Task.from_yaml_config(cfg)
-        port = self.spec.port
-        task.update_envs({
-            'SKYTPU_SERVE_PORT': str(port + replica_id
-                                     if self._local_ports else port),
-            'SKYTPU_SERVE_REPLICA_ID': str(replica_id),
-        })
+        if not self.spec.pool:
+            port = self.spec.port
+            task.update_envs({
+                'SKYTPU_SERVE_PORT': str(port + replica_id
+                                         if self._local_ports else port),
+                'SKYTPU_SERVE_REPLICA_ID': str(replica_id),
+            })
         # Placement was decided in scale_up (single-threaded) — concurrent
         # launch threads reading the placer here would all see the same
         # in-use set and pile into one zone.
@@ -120,6 +126,8 @@ class ReplicaManager:
 
     def _replica_url(self, replica_id: int,
                      handle: slice_backend.SliceResourceHandle) -> str:
+        if self.spec.pool:
+            return ''   # workers serve no HTTP endpoint
         info = handle.get_cluster_info()
         head = info.ordered_instances()[0]
         port = self.spec.port
@@ -234,6 +242,21 @@ class ReplicaManager:
                 continue
             if status in (ReplicaStatus.STARTING, ReplicaStatus.READY,
                           ReplicaStatus.NOT_READY):
+                if self.spec.pool:
+                    # Pool worker readiness IS cluster liveness (checked by
+                    # _cluster_gone above) + setup completion (STARTING is
+                    # only set once execution.launch returned).
+                    if status is not ReplicaStatus.READY:
+                        serve_state.set_replica_status(
+                            self.service_name, rid, ReplicaStatus.READY)
+                        logger.info(f'Worker {rid} is READY.')
+                        if self.spot_placer is not None and \
+                                rid in self._replica_locations:
+                            self.spot_placer.set_active(
+                                self._replica_locations[rid])
+                    self._probe_failure_streak = 0
+                    alive.append(rep)
+                    continue
                 probe = self.spec.readiness_probe
                 in_grace = (status is ReplicaStatus.STARTING and
                             now - (rep['launched_at'] or 0) <
@@ -285,10 +308,12 @@ class ReplicaManager:
         if len(alive) < target:
             self.scale_up(target - len(alive))
         elif len(alive) > target:
-            # Prefer shedding not-ready replicas, newest first.
+            # Prefer shedding not-ready replicas, then (pools) idle workers
+            # before ones running a managed job, newest first.
             order = sorted(
                 alive,
                 key=lambda r: (r['status'] is ReplicaStatus.READY,
+                               r.get('job_id') is not None,
                                -r['replica_id']))
             for rep in order[:len(alive) - target]:
                 logger.info(f'Scaling down replica {rep["replica_id"]}.')

@@ -79,8 +79,14 @@ def _conn() -> sqlite3.Connection:
             url TEXT,
             launched_at REAL,
             consecutive_failures INTEGER DEFAULT 0,
+            job_id INTEGER,
             PRIMARY KEY (service, replica_id)
         )""")
+    # Pre-pool databases lack the worker-assignment column.
+    try:
+        conn.execute('ALTER TABLE replicas ADD COLUMN job_id INTEGER')
+    except sqlite3.OperationalError:
+        pass
     return conn
 
 
@@ -211,6 +217,34 @@ def get_replicas(service: str) -> List[Dict[str, Any]]:
             d['status'] = ReplicaStatus(d['status'])
             out.append(d)
         return out
+
+
+def acquire_worker(service: str, job_id: int) -> Optional[Dict[str, Any]]:
+    """Atomically claim one READY, unassigned pool worker for a managed
+    job. Returns its replica record, or None when every worker is busy
+    (the caller queues). The single UPDATE makes concurrent controllers
+    claim distinct workers — sqlite serializes writers."""
+    with _conn() as conn:
+        conn.row_factory = sqlite3.Row
+        cur = conn.execute(
+            'UPDATE replicas SET job_id = ? WHERE rowid = ('
+            '  SELECT rowid FROM replicas WHERE service = ? AND '
+            "  status = 'READY' AND job_id IS NULL ORDER BY replica_id "
+            '  LIMIT 1) AND job_id IS NULL RETURNING *', (job_id, service))
+        row = cur.fetchone()
+        if row is None:
+            return None
+        d = dict(row)
+        d['status'] = ReplicaStatus(d['status'])
+        return d
+
+
+def release_worker(service: str, job_id: int) -> None:
+    """Return a managed job's worker to the idle set."""
+    with _conn() as conn:
+        conn.execute(
+            'UPDATE replicas SET job_id = NULL WHERE service = ? AND '
+            'job_id = ?', (service, job_id))
 
 
 def next_replica_id(service: str) -> int:

@@ -25,6 +25,16 @@ from typing import Any, Dict, Optional
 _SERVICE_FIELDS = frozenset({
     'readiness_probe', 'replicas', 'replica_policy', 'ports',
     'load_balancing_policy', 'spot_placer',
+    # Pool mode (reference: sky jobs pool — service_spec.py:40-64): a pool
+    # is this same spec with `pool: true` + `workers: N`. Workers are
+    # replicas that idle after setup; managed jobs exec onto them.
+    'pool', 'workers',
+})
+# Serve-only concepts a pool has no use for: there is no HTTP app to
+# probe or balance (reference rejects these for pool too).
+_POOL_UNSUPPORTED = frozenset({
+    'readiness_probe', 'ports', 'load_balancing_policy', 'replica_policy',
+    'replicas',
 })
 _POLICY_FIELDS = frozenset({
     'min_replicas', 'max_replicas', 'target_qps_per_replica',
@@ -62,6 +72,9 @@ class ServiceSpec:
     # Spot placement policy name (serve/spot_placer.py); None disables
     # placement (replicas launch wherever provisioning failover lands).
     spot_placer: Optional[str] = None
+    # Pool mode: replicas are idle workers for managed jobs (no LB, no
+    # HTTP probe — readiness is cluster liveness).
+    pool: bool = False
 
     @classmethod
     def from_yaml_config(cls, config: Dict[str, Any]) -> 'ServiceSpec':
@@ -70,6 +83,29 @@ class ServiceSpec:
         if unknown:
             raise ValueError(f'Unknown service fields: {sorted(unknown)}. '
                              f'Valid: {sorted(_SERVICE_FIELDS)}')
+        if config.get('pool'):
+            bad = set(config) & _POOL_UNSUPPORTED
+            if bad:
+                raise ValueError(
+                    f'{sorted(bad)} not supported for pool. A pool only '
+                    f"takes 'workers: <num>' (and optionally "
+                    f"'spot_placer').")
+            workers = int(config.get('workers', 1))
+            if workers < 1:
+                raise ValueError('pool workers must be >= 1')
+            placer = config.get('spot_placer')
+            if placer is not None:
+                from skypilot_tpu.serve import spot_placer as placer_lib
+                if placer not in placer_lib.PLACERS:
+                    raise ValueError(
+                        f'Unknown spot_placer {placer!r}; available: '
+                        f'{sorted(placer_lib.PLACERS)}')
+            return cls(readiness_probe=ReadinessProbe(),
+                       policy=ReplicaPolicy(min_replicas=workers),
+                       port=0, spot_placer=placer, pool=True)
+        if 'workers' in config:
+            raise ValueError("'workers' requires 'pool: true' "
+                             "(use 'replicas' for a service).")
         probe_cfg = config.get('readiness_probe', '/')
         if isinstance(probe_cfg, str):
             probe = ReadinessProbe(path=probe_cfg)
@@ -126,6 +162,11 @@ class ServiceSpec:
                    load_balancing_policy=lb.lower(), spot_placer=placer)
 
     def to_yaml_config(self) -> Dict[str, Any]:
+        if self.pool:
+            out = {'pool': True, 'workers': self.policy.min_replicas}
+            if self.spot_placer is not None:
+                out['spot_placer'] = self.spot_placer
+            return out
         out: Dict[str, Any] = {
             'readiness_probe': dataclasses.asdict(self.readiness_probe),
             'ports': self.port,

@@ -111,6 +111,15 @@ class Task:
             task.set_file_mounts(plain_mounts)
         task.config_overrides = dict(config.get('config') or {})
         task.service_spec = config.get('service')
+        pool_cfg = config.get('pool')
+        if pool_cfg is not None:
+            # `pool:` is sugar for a pool-mode service spec (reference:
+            # sky/serve/service_spec.py:182-190 — pools and services share
+            # one spec). `workers: N` is the only knob plus spot_placer.
+            if task.service_spec is not None:
+                raise ValueError("Use either 'service:' or 'pool:', "
+                                 'not both.')
+            task.service_spec = {'pool': True, **dict(pool_cfg)}
         # Shape/unknown-key checks already ran in validate_task_config.
         est = config.get('estimated') or {}
         if est.get('duration_seconds') is not None:

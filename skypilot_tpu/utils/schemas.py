@@ -113,6 +113,7 @@ TASK_SCHEMA: Dict[str, Field] = {
     'file_mounts': Field((dict,)),
     'config': Field((dict,)),
     'service': Field((dict,)),
+    'pool': Field((dict,)),
     'estimated': Field((dict,), nested={
         'duration_seconds': Field(_NUM),
         'total_flops': Field(_NUM),

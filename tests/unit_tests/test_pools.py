@@ -1,0 +1,164 @@
+"""Worker pools: pre-provisioned clusters that managed jobs exec onto.
+
+Reference analog: sky jobs pool, smoke-tested against real clouds in
+tests/smoke_tests/test_pools.py. Here the Local fake-TPU cloud makes the
+whole contract hermetic: pool apply → workers READY; pooled jobs claim
+distinct workers, queue when all are busy, and never tear workers down;
+killing a worker mid-job drives the job through RECOVERING onto another
+worker while the pool controller replaces the dead one.
+"""
+import os
+import shutil
+import time
+
+import pytest
+
+import skypilot_tpu as sky
+from skypilot_tpu import global_state
+from skypilot_tpu.clouds import local as local_cloud
+from skypilot_tpu.jobs import core as jobs_core
+from skypilot_tpu.jobs import pool as pool_lib
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.jobs.state import ManagedJobStatus
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
+
+
+@pytest.fixture
+def pool_env(enable_local_cloud, isolated_state, monkeypatch):
+    monkeypatch.setenv('SKYTPU_JOBS_POLL_SECONDS', '0.3')
+    monkeypatch.setenv('SKYTPU_SERVE_SYNC_SECONDS', '0.5')
+    monkeypatch.setenv('SKYTPU_POOL_ACQUIRE_POLL', '0.3')
+    yield isolated_state
+
+
+def _pool_task(name='wp', workers=2):
+    task = sky.Task(name=name, setup='echo worker-setup-done')
+    task.set_resources(sky.Resources(accelerators='tpu-v5e-8'))
+    task.service_spec = {'pool': True, 'workers': workers}
+    return task
+
+
+def _job_task(name, run):
+    task = sky.Task(name=name, run=run)
+    task.set_resources(sky.Resources(accelerators='tpu-v5e-8'))
+    return task
+
+
+def _wait_workers_ready(pool, n, timeout=90):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        reps = serve_state.get_replicas(pool)
+        if sum(r['status'] is ReplicaStatus.READY for r in reps) >= n:
+            return reps
+        time.sleep(0.3)
+    raise TimeoutError(f'pool {pool}: {serve_state.get_replicas(pool)}')
+
+
+def _wait_job(job_id, statuses, timeout=90):
+    deadline = time.time() + timeout
+    seen = None
+    while time.time() < deadline:
+        job = jobs_state.get_job(job_id)
+        seen = job['status']
+        if seen in statuses:
+            return job
+        time.sleep(0.2)
+    raise TimeoutError(f'job {job_id} stuck in {seen}, wanted {statuses}')
+
+
+@pytest.mark.usefixtures('pool_env')
+class TestPoolLifecycle:
+
+    def test_apply_ready_jobs_share_workers_down(self, tmp_path):
+        pool_lib.apply(_pool_task(workers=2))
+        _wait_workers_ready('wp', 2)
+        record = serve_state.get_service('wp')
+        assert record['status'] is ServiceStatus.READY
+        # Worker clusters exist and idle (setup ran, no job).
+        clusters_before = {c['name'] for c in global_state.get_clusters()}
+        assert len(clusters_before) == 2
+
+        # Two jobs run concurrently on DISTINCT workers; a third queues.
+        gate = tmp_path / 'gate'
+        run = (f'while [ ! -f {gate} ]; do sleep 0.2; done; echo pooled-ok')
+        ids = [jobs_core.launch(_job_task(f'j{i}', run), pool='wp')
+               for i in range(3)]
+        for jid in ids[:2]:
+            _wait_job(jid, {ManagedJobStatus.RUNNING})
+        busy = [r for r in serve_state.get_replicas('wp')
+                if r['job_id'] is not None]
+        assert sorted(r['job_id'] for r in busy) == sorted(ids[:2])
+        assert len({r['cluster_name'] for r in busy}) == 2
+        # Third job has no worker: stays STARTING (queued), not RUNNING.
+        j3 = jobs_state.get_job(ids[2])
+        assert j3['status'] in (ManagedJobStatus.PENDING,
+                                ManagedJobStatus.STARTING)
+
+        gate.write_text('go')
+        for jid in ids:
+            _wait_job(jid, {ManagedJobStatus.SUCCEEDED})
+        # Workers were NOT torn down by job completion — same clusters, all
+        # claims released.
+        assert {c['name'] for c in global_state.get_clusters()} == \
+            clusters_before
+        assert all(r['job_id'] is None
+                   for r in serve_state.get_replicas('wp'))
+        # Job logs were mirrored off the worker.
+        assert 'pooled-ok' in open(jobs_state.job_log_path(ids[0])).read()
+
+        pool_lib.down('wp')
+        assert global_state.get_clusters() == []
+
+    def test_worker_preemption_recovers_job_elsewhere(self, tmp_path):
+        pool_lib.apply(_pool_task(workers=2))
+        _wait_workers_ready('wp', 2)
+        marker = tmp_path / 'recovered.marker'
+        job_id = jobs_core.launch(_job_task(
+            'jrec',
+            f'if [ -f {marker} ]; then echo after-recovery; '
+            f'else sleep 60; fi'), pool='wp')
+        job = _wait_job(job_id, {ManagedJobStatus.RUNNING})
+        victim = job['cluster_name']
+        assert victim.startswith('wp-replica-')
+        marker.write_text('x')
+        # Preempt the worker under the job.
+        shutil.rmtree(os.path.join(local_cloud.LOCAL_CLOUD_ROOT, victim))
+        job = _wait_job(job_id, {ManagedJobStatus.SUCCEEDED})
+        assert job['recovery_count'] >= 1
+        # The job finished on a DIFFERENT worker.
+        assert job['cluster_name'] != victim
+        assert job['cluster_name'].startswith('wp-replica-')
+        # The pool healed back to 2 workers.
+        _wait_workers_ready('wp', 2)
+        pool_lib.down('wp')
+
+    def test_pool_validation(self):
+        # run: is rejected for pool tasks.
+        bad = _pool_task()
+        bad.run = 'python server.py'
+        with pytest.raises(ValueError, match='run'):
+            pool_lib.apply(bad)
+        # Launching into a nonexistent pool fails fast.
+        with pytest.raises(ValueError, match='does not exist'):
+            jobs_core.launch(_job_task('j', 'echo hi'), pool='nope')
+        # A pool is not a service: serve status excludes, pool status shows.
+        pool_lib.apply(_pool_task(name='wp2', workers=1))
+        try:
+            from skypilot_tpu.serve import core as serve_core
+            assert [r['name'] for r in pool_lib.status()] == ['wp2']
+            assert serve_core.status(pool=False) == []
+        finally:
+            pool_lib.down('wp2', purge=True)
+
+    def test_resize_in_place(self):
+        pool_lib.apply(_pool_task(workers=1))
+        _wait_workers_ready('wp', 1)
+        pool_lib.apply(_pool_task(workers=2))
+        _wait_workers_ready('wp', 2)
+        # Non-count changes are rejected.
+        other = _pool_task(workers=2)
+        other.setup = 'echo different'
+        with pytest.raises(ValueError, match='setup'):
+            pool_lib.apply(other)
+        pool_lib.down('wp')
