@@ -71,7 +71,18 @@ def _qkv(x: jnp.ndarray, lp, cfg: llama.LlamaConfig, sin, cos):
     return q, k, v
 
 
-def _mlp(x: jnp.ndarray, lp, cfg: llama.LlamaConfig) -> jnp.ndarray:
+def _ffn(x: jnp.ndarray, lp, cfg: llama.LlamaConfig) -> jnp.ndarray:
+    """Post-attention FFN block: dense SwiGLU, or routed experts for MoE
+    configs. The MoE path reuses training's grouped static-capacity
+    dispatch (models/moe.py) — at decode (S=1) every group holds one
+    token, top-k choices land on distinct experts, and the min-8 capacity
+    means no token is ever dropped, so decode matches the training
+    forward exactly (asserted in tests/unit_tests/test_decode.py)."""
+    from skypilot_tpu.models import moe as moe_lib
+    if isinstance(cfg, moe_lib.MoEConfig):
+        h = norms.rms_norm(x, lp['moe_norm'], cfg.rms_eps)
+        y, _ = moe_lib.moe_ffn(h, lp, cfg, sharding_lib.Rules())
+        return y
     h = norms.rms_norm(x, lp['mlp_norm'], cfg.rms_eps)
     gate = jnp.einsum('bsd,df->bsf', h, lp['w_gate'].astype(cfg.dtype))
     up = jnp.einsum('bsd,df->bsf', h, lp['w_up'].astype(cfg.dtype))
@@ -111,7 +122,7 @@ def prefill(params, tokens: jnp.ndarray, cfg: llama.LlamaConfig,
         out = out.reshape(b, s, cfg.n_heads * cfg.hd)
         carry = carry + jnp.einsum('bsh,hd->bsd', out,
                                    lp['wo'].astype(cfg.dtype))
-        carry = carry + _mlp(carry, lp, cfg)
+        carry = carry + _ffn(carry, lp, cfg)
         return carry, (k, v)
 
     x, (ks, vs) = jax.lax.scan(body, x, params['layers'])
@@ -161,7 +172,7 @@ def decode_step(params, token: jnp.ndarray, cache: KVCache,
         out = out.reshape(b, 1, cfg.n_heads * cfg.hd)
         x_c = x_c + jnp.einsum('bsh,hd->bsd', out,
                                lp['wo'].astype(cfg.dtype))
-        x_c = x_c + _mlp(x_c, lp, cfg)
+        x_c = x_c + _ffn(x_c, lp, cfg)
         return (x_c, k_cache, v_cache), None
 
     layer_ids = jnp.arange(cfg.n_layers, dtype=jnp.int32)

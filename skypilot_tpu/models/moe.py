@@ -186,7 +186,8 @@ def moe_ffn(x: jnp.ndarray, lp: Params, cfg: MoEConfig,
     pos = jnp.cumsum(flat, axis=2) - flat
     pos = pos.reshape(b, g, gs, k, e)
     keep = (pos < c) * onehot                                 # drop overflow
-    slot = jax.nn.one_hot(pos, c, dtype=jnp.float32) * keep[..., None]
+    slot = jax.nn.one_hot(pos.astype(jnp.int32), c,
+                          dtype=jnp.float32) * keep[..., None]
     dispatch = slot.sum(3)                                    # [B,G,T,E,C]
     combine = jnp.einsum('bgtk,bgtkec->bgtec',
                          gate_w.astype(jnp.float32), slot)

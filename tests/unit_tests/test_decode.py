@@ -11,7 +11,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
-from skypilot_tpu.models import decode, llama
+from skypilot_tpu.models import decode, llama, moe
 
 
 @pytest.fixture(scope='module')
@@ -90,3 +90,62 @@ class TestDecode:
                               rng=jax.random.PRNGKey(7))
         assert out.shape == (2, 5)
         assert int(out.max()) < cfg.vocab_size
+
+
+@pytest.fixture(scope='module')
+def moe_model():
+    # capacity_factor = n_experts ⇒ every expert can hold every (token,
+    # choice): no capacity drops, so the grouped full-forward routing and
+    # the per-token decode routing are bit-identical — the equivalence the
+    # test asserts. (Production factors trade exactness at the margin for
+    # memory; decode itself never drops.)
+    cfg = dataclasses.replace(moe.PRESETS['moe-debug'], dtype=jnp.float32,
+                              capacity_factor=float(
+                                  moe.PRESETS['moe-debug'].n_experts))
+    params = moe.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+class TestMoEDecode:
+    """MoE serve path: routed-experts decode matches the training forward."""
+
+    def test_prefill_matches_forward_logits(self, moe_model):
+        cfg, params = moe_model
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 10), 0,
+                                    cfg.vocab_size, dtype=jnp.int32)
+        full = moe.forward(params, tokens, cfg)
+        last, cache = decode.prefill(params, tokens, cfg, max_len=32)
+        np.testing.assert_allclose(np.asarray(last),
+                                   np.asarray(full[:, -1]), rtol=2e-4,
+                                   atol=2e-4)
+        assert cache.k.shape == (cfg.n_layers, 2, 32, cfg.n_kv_heads, cfg.hd)
+
+    def test_decode_step_matches_forward(self, moe_model):
+        cfg, params = moe_model
+        b, s0, steps = 2, 6, 4
+        tokens = jax.random.randint(jax.random.PRNGKey(2), (b, s0), 0,
+                                    cfg.vocab_size, dtype=jnp.int32)
+        logits, cache = decode.prefill(params, tokens, cfg, max_len=32)
+        seq = tokens
+        for _ in range(steps):
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+            full = moe.forward(params, seq, cfg)
+            logits, cache = decode.decode_step(params, nxt, cache, cfg)
+            np.testing.assert_allclose(np.asarray(logits),
+                                       np.asarray(full[:, -1]), rtol=2e-4,
+                                       atol=2e-4)
+
+    def test_generate_greedy_matches_naive(self, moe_model):
+        cfg, params = moe_model
+        prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 8), 0,
+                                    cfg.vocab_size, dtype=jnp.int32)
+        n_new = 5
+        got = decode.generate(params, prompt, cfg, n_new)
+        seq = prompt
+        for _ in range(n_new):
+            logits = moe.forward(params, seq, cfg)
+            nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(seq[:, 8:]))
