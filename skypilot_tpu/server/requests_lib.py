@@ -107,20 +107,24 @@ def list_requests(limit: int = 100) -> List[Dict[str, Any]]:
 
 
 def next_pending(schedule_type: str) -> Optional[Dict[str, Any]]:
-    """Atomically claim the oldest NEW request of this schedule type."""
+    """Atomically claim the oldest unclaimed NEW request of this type.
+
+    Claimed = started_at set (NEW→RUNNING happens later, in the runner).
+    The claim must be one UPDATE with the eligibility filter inside it:
+    a SELECT-then-guarded-UPDATE that can land on a just-claimed row
+    returns None while work is still queued, and the scheduler's idle
+    backoff then paces a busy queue at 5 claims/s (caught by
+    tests/load_tests/test_load_on_server.py)."""
     with _conn() as conn:
         row = conn.execute(
-            'SELECT request_id FROM requests WHERE status=? AND '
-            'schedule_type=? ORDER BY created_at LIMIT 1',
-            (RequestStatus.NEW.value, schedule_type)).fetchone()
+            'UPDATE requests SET started_at=? WHERE request_id = ('
+            '  SELECT request_id FROM requests WHERE status=? AND '
+            '  schedule_type=? AND started_at IS NULL '
+            '  ORDER BY created_at LIMIT 1) '
+            'AND started_at IS NULL RETURNING request_id',
+            (time.time(), RequestStatus.NEW.value,
+             schedule_type)).fetchone()
         if row is None:
-            return None
-        # Claim: NEW -> RUNNING happens in the runner; mark as claimed by
-        # setting started_at so the scheduler does not double-spawn.
-        cur = conn.execute(
-            'UPDATE requests SET started_at=? WHERE request_id=? AND '
-            'started_at IS NULL', (time.time(), row[0]))
-        if cur.rowcount == 0:
             return None
     return get(row[0])
 

@@ -1,0 +1,177 @@
+"""API-server load tests: throughput, latency tails, queue fairness.
+
+Reference analog: tests/load_tests/test_load_on_server.py (N concurrent
+requests, latency percentiles) and test_queue_dispatcher.py (dispatcher
+throughput). Those run against a live deployment; here the real aiohttp
+app + the real Scheduler run in-process with the thread-mode executor
+(SKYTPU_EXECUTOR_MODE=thread), so the load path — HTTP → request record →
+queue claim → handler → result poll — is exercised hermetically and fast
+enough for CI.
+
+What must hold under load:
+  - zero request loss: every submission reaches a terminal record;
+  - SHORT requests are never starved behind a LONG backlog (separate
+    scheduler lanes, executor.py);
+  - the dispatcher sustains a sane claim rate (its 0.2s idle backoff must
+    not throttle a busy queue).
+"""
+import asyncio
+import os
+import statistics
+import time
+
+import pytest
+from aiohttp.test_utils import TestClient
+from aiohttp.test_utils import TestServer as AioTestServer
+
+from skypilot_tpu.server import executor
+from skypilot_tpu.server import registry
+from skypilot_tpu.server import requests_lib
+from skypilot_tpu.server import server as server_lib
+
+
+@pytest.fixture
+def load_env(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKYTPU_SERVER_DIR', str(tmp_path / 'srv'))
+    monkeypatch.delenv('SKYTPU_API_TOKEN', raising=False)
+    monkeypatch.setenv(executor.EXECUTOR_MODE_ENV, 'thread')
+    sched = executor.Scheduler()
+    sched.start()
+    yield
+    sched.stop()
+
+
+@pytest.fixture
+def injected_handlers(monkeypatch):
+    """Test-only request types with controlled service times."""
+    def _sleep(payload):
+        time.sleep(float(payload.get('t', 0)))
+        return {'slept': payload.get('t', 0)}
+    monkeypatch.setitem(registry.HANDLERS, 'load_noop',
+                        (lambda p: {'ok': True}, requests_lib.SHORT))
+    monkeypatch.setitem(registry.HANDLERS, 'load_slow',
+                        (_sleep, requests_lib.LONG))
+    monkeypatch.setitem(registry.HANDLERS, 'load_quick',
+                        (_sleep, requests_lib.SHORT))
+
+
+def _run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+async def _submit_and_wait(client, name, payload, timeout=60.0):
+    """POST a request, poll to terminal; returns (record, latency_s)."""
+    begin = time.monotonic()
+    r = await client.post(f'/api/v1/{name}', json=payload)
+    assert r.status == 200, await r.text()
+    rid = (await r.json())['request_id']
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        r = await client.get('/api/v1/get', params={'request_id': rid})
+        assert r.status == 200
+        rec = await r.json()
+        if requests_lib.RequestStatus(rec['status']).is_terminal():
+            return rec, time.monotonic() - begin
+        await asyncio.sleep(0.05)
+    raise TimeoutError(f'request {rid} ({name}) not terminal')
+
+
+@pytest.mark.usefixtures('load_env', 'injected_handlers')
+class TestServerLoad:
+
+    def test_no_loss_under_concurrent_shorts(self):
+        """60 concurrent SHORT requests: all succeed, tails bounded."""
+        n = 60
+
+        async def fn(client):
+            results = await asyncio.gather(*[
+                _submit_and_wait(client, 'load_noop', {'i': i})
+                for i in range(n)])
+            return results
+
+        async def run():
+            app = server_lib.build_app()
+            client = TestClient(AioTestServer(app))
+            await client.start_server()
+            try:
+                return await fn(client)
+            finally:
+                await client.close()
+
+        results = _run(run())
+        assert len(results) == n
+        statuses = [r['status'] for r, _ in results]
+        assert statuses == ['SUCCEEDED'] * n
+        lats = sorted(lat for _, lat in results)
+        p50 = lats[n // 2]
+        p95 = lats[int(n * 0.95)]
+        print(f'\nshort x{n}: p50={p50:.2f}s p95={p95:.2f}s '
+              f'max={lats[-1]:.2f}s')
+        # Thread-mode handlers are instant; the latency is pure queueing.
+        # Generous bounds: this must pass on a loaded 1-core CI box.
+        assert p95 < 30.0
+
+    def test_shorts_not_starved_by_long_backlog(self):
+        """A LONG backlog (service time >> lane width) must not delay
+        SHORT requests — they ride a separate scheduler lane."""
+        n_long, long_t, n_short = 8, 2.0, 12
+
+        async def run():
+            app = server_lib.build_app()
+            client = TestClient(AioTestServer(app))
+            await client.start_server()
+            try:
+                long_tasks = [
+                    asyncio.create_task(_submit_and_wait(
+                        client, 'load_slow', {'t': long_t}, timeout=120))
+                    for _ in range(n_long)]
+                await asyncio.sleep(0.3)   # backlog forms
+                t0 = time.monotonic()
+                shorts = await asyncio.gather(*[
+                    _submit_and_wait(client, 'load_noop', {})
+                    for _ in range(n_short)])
+                short_wall = time.monotonic() - t0
+                longs = await asyncio.gather(*long_tasks)
+                return shorts, longs, short_wall
+
+            finally:
+                await client.close()
+
+        shorts, longs, short_wall = _run(run())
+        assert [r['status'] for r, _ in shorts] == ['SUCCEEDED'] * n_short
+        assert [r['status'] for r, _ in longs] == ['SUCCEEDED'] * n_long
+        # The LONG lane needs >= ceil(8/LONG_PARALLELISM)*2s of wall; the
+        # shorts must clear far faster than that backlog.
+        long_backlog = (n_long / executor.LONG_PARALLELISM) * long_t
+        print(f'\nshorts cleared in {short_wall:.2f}s vs LONG backlog '
+              f'{long_backlog:.1f}s')
+        assert short_wall < long_backlog
+
+    def test_dispatcher_claim_throughput(self):
+        """Queue drain rate: the dispatcher's idle backoff must not
+        throttle a busy queue (claims should be back-to-back)."""
+        n = 80
+        t0 = time.monotonic()
+        ids = [requests_lib.create('load_noop', {}, requests_lib.SHORT)
+               for _ in range(n)]
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            recs = [requests_lib.get(rid) for rid in ids]
+            if all(requests_lib.RequestStatus(r['status']).is_terminal()
+                   for r in recs):
+                break
+            time.sleep(0.05)
+        else:
+            raise TimeoutError('queue did not drain')
+        wall = time.monotonic() - t0
+        rate = n / wall
+        assert all(requests_lib.get(rid)['status'] == 'SUCCEEDED'
+                   for rid in ids)
+        print(f'\ndispatcher: {n} requests in {wall:.2f}s = {rate:.0f}/s')
+        # 0.2s-per-claim pacing would cap at 5/s; back-to-back claiming on
+        # a busy queue must do far better even on one loaded core.
+        assert rate > 10.0
