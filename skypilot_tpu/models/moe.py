@@ -212,6 +212,41 @@ def _layer(carry, lp, cfg: MoEConfig, rules, sin, cos, q_offset):
     return (x + y, aux_sum + aux)
 
 
+def _pipelined_layers(x, layers, layer_fn, cfg: MoEConfig):
+    """GPipe over 'stage' with the router aux loss riding each microbatch
+    through the rotation (parallel/pipeline.py has_aux=True). Mirrors
+    llama._pipelined_layers; the aux scalar of every microbatch is summed
+    on the last stage and psum-broadcast with the activations."""
+    from jax.sharding import PartitionSpec as P
+    from skypilot_tpu.parallel import pipeline as pipeline_lib
+    if cfg.attention_impl == 'ring':
+        raise NotImplementedError(
+            'pipeline_stages>1 with ring attention would nest the sequence '
+            'shard_map inside the stage shard_map — not supported yet')
+    b, s_len, d = x.shape
+    m = cfg.num_microbatches
+    if b % m != 0:
+        raise ValueError(f'batch {b} not divisible by num_microbatches {m}')
+    if cfg.n_layers % cfg.pipeline_stages != 0:
+        raise ValueError(f'n_layers {cfg.n_layers} not divisible by '
+                         f'pipeline_stages {cfg.pipeline_stages}')
+    from skypilot_tpu.ops.attention import _on_tpu
+    boundary_dtype = x.dtype if _on_tpu() else jnp.float32
+    xm = x.reshape(m, b // m, s_len, d).astype(boundary_dtype)
+
+    def sm_fn(layers_local, xm_local):
+        out, aux = pipeline_lib.pipeline_apply(
+            layer_fn, layers_local, xm_local.astype(x.dtype), has_aux=True)
+        return out.astype(boundary_dtype), aux
+
+    out, aux = jax.shard_map(
+        sm_fn, in_specs=(P('stage'), P()), out_specs=(P(), P()),
+        axis_names={'stage'}, check_vma=False)(layers, xm)
+    # Each microbatch's aux is a mean over its own tokens; the sum over M
+    # microbatches is M× the full-batch mean the scan path produces.
+    return out.reshape(b, s_len, d).astype(x.dtype), aux / m
+
+
 def forward(params: Params,
             tokens: jnp.ndarray,
             cfg: MoEConfig,
@@ -220,9 +255,6 @@ def forward(params: Params,
             q_offset: int | jnp.ndarray = 0,
             return_aux: bool = False):
     """tokens [B,S] → logits [B,S,V] fp32 (+ router aux loss if asked)."""
-    if cfg.pipeline_stages > 1:
-        raise NotImplementedError('pipeline parallelism for MoE layers is '
-                                  'not wired yet (aux-loss carry)')
     rules = rules or sharding_lib.Rules()
     con = functools.partial(sharding_lib.constrain, rules=rules)
     b, s_len = tokens.shape
@@ -243,7 +275,9 @@ def forward(params: Params,
         layer_fn = jax.checkpoint(layer_fn, policy=policy)
 
     aux0 = jnp.zeros((), jnp.float32)
-    if cfg.scan_layers:
+    if cfg.pipeline_stages > 1:
+        x, aux = _pipelined_layers(x, params['layers'], layer_fn, cfg)
+    elif cfg.scan_layers:
         def body(carry, lp):
             return layer_fn(carry, lp), None
         (x, aux), _ = jax.lax.scan(body, (x, aux0), params['layers'])

@@ -22,14 +22,20 @@ def pipeline_apply(layer_fn: Callable[[jnp.ndarray, Any], jnp.ndarray],
                    local_layers: Any,
                    x_microbatches: jnp.ndarray,
                    *,
-                   axis_name: str = 'stage') -> jnp.ndarray:
+                   axis_name: str = 'stage',
+                   has_aux: bool = False):
     """Run a pipelined stack of layers. Call INSIDE shard_map.
 
-    layer_fn(x, layer_params) -> x : one layer step.
+    layer_fn(x, layer_params) -> x : one layer step. With has_aux=True,
+        layer_fn((x, aux), layer_params) -> (x, aux) — a scalar rides the
+        microbatch through the pipeline and accumulates across layers
+        (MoE router load-balance loss).
     local_layers: pytree whose leaves are [L_local, ...] stacks (this
         stage's shard of the full layer stack).
     x_microbatches: [M, mb, S, D] — full input, replicated across stages.
-    Returns [M, mb, S, D] on every stage (broadcast from the last stage).
+    Returns [M, mb, S, D] on every stage (broadcast from the last stage);
+    with has_aux=True, (outputs, aux_total) where aux_total sums every
+    microbatch's accumulated scalar.
     """
     n = jax.lax.axis_size(axis_name)
     stage = jax.lax.axis_index(axis_name)
@@ -37,37 +43,52 @@ def pipeline_apply(layer_fn: Callable[[jnp.ndarray, Any], jnp.ndarray],
     steps = m + n - 1
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def local_stack(x):
+    def local_stack(x, aux):
         def body(carry, lp):
-            return layer_fn(carry, lp), None
-        out, _ = jax.lax.scan(body, x, local_layers)
-        return out
+            if has_aux:
+                return layer_fn(carry, lp), None
+            return (layer_fn(carry[0], lp), carry[1]), None
+        (out, aux), _ = jax.lax.scan(body, (x, aux), local_layers)
+        return out, aux
 
     state0 = jnp.zeros_like(x_microbatches[0])
+    aux0 = jnp.zeros((), jnp.float32)
     outputs0 = jnp.zeros_like(x_microbatches)
 
     def step(carry, t):
-        state, outputs = carry
+        state, aux_state, outputs, aux_total = carry
         inject = x_microbatches[jnp.clip(t, 0, m - 1)]
+        # A microbatch entering stage 0 starts with a fresh aux of 0; on
+        # later stages the rotated partial sum continues accumulating.
         cur = jnp.where(stage == 0, inject, state)
-        y = local_stack(cur)
+        cur_aux = jnp.where(stage == 0, 0.0, aux_state)
+        y, y_aux = local_stack(cur, cur_aux)
         widx = t - (n - 1)
         do_write = jnp.logical_and(stage == n - 1, widx >= 0)
         write_slot = jnp.clip(widx, 0, m - 1)
         updated = jax.lax.dynamic_update_index_in_dim(
             outputs, y.astype(outputs.dtype), write_slot, 0)
         outputs = jnp.where(do_write, updated, outputs)
+        aux_total = aux_total + jnp.where(do_write, y_aux, 0.0)
         state = jax.lax.ppermute(y, axis_name, perm)
-        return (state, outputs), None
+        aux_state = jax.lax.ppermute(y_aux, axis_name, perm)
+        return (state, aux_state, outputs, aux_total), None
 
-    (_, outputs), _ = jax.lax.scan(step, (state0, outputs0),
-                                   jnp.arange(steps))
-    # Broadcast the last stage's outputs to all stages. Off-TPU the psum
-    # runs in f32: XLA CPU's AllReducePromotion pass crashes on bf16
-    # all-reduce (compiler bug).
+    (_, _, outputs, aux_total), _ = jax.lax.scan(
+        step, (state0, aux0, outputs0, jnp.zeros((), jnp.float32)),
+        jnp.arange(steps))
+    # Broadcast the last stage's outputs (and aux sum) to all stages.
+    # Off-TPU the psum runs in f32: XLA CPU's AllReducePromotion pass
+    # crashes on bf16 all-reduce (compiler bug).
     dtype = outputs.dtype
     outputs = jnp.where(stage == n - 1, outputs, jnp.zeros_like(outputs))
     if jax.default_backend() != 'tpu' and dtype == jnp.bfloat16:
-        return jax.lax.psum(outputs.astype(jnp.float32),
-                            axis_name).astype(dtype)
-    return jax.lax.psum(outputs, axis_name)
+        outputs = jax.lax.psum(outputs.astype(jnp.float32),
+                               axis_name).astype(dtype)
+    else:
+        outputs = jax.lax.psum(outputs, axis_name)
+    if not has_aux:
+        return outputs
+    aux_total = jax.lax.psum(
+        jnp.where(stage == n - 1, aux_total, 0.0), axis_name)
+    return outputs, aux_total
