@@ -484,6 +484,27 @@ def serve_up(entrypoint: str, service_name: Optional[str],
                f"(watch: skytpu serve status).")
 
 
+@serve.command(name='update')
+@click.argument('service_name', required=True)
+@click.argument('entrypoint', required=True)
+@click.option('--mode', type=click.Choice(['rolling', 'blue_green']),
+              default='rolling', show_default=True,
+              help='rolling replaces replicas one at a time; blue_green '
+                   'brings up a full new set before cutting traffic over.')
+@click.option('--env', multiple=True)
+def serve_update(service_name: str, entrypoint: str, mode: str,
+                 env: Tuple[str, ...]):
+    """Migrate a live service to a new task YAML version."""
+    from skypilot_tpu import serve as serve_lib
+    task = _load_task(entrypoint, env, {})
+    try:
+        info = serve_lib.update(task, service_name, mode=mode)
+    except (exceptions.SkyTpuError, ValueError) as e:
+        raise click.ClickException(str(e)) from e
+    click.echo(f"Service {info['name']!r} updating to version "
+               f"{info['version']} ({info['mode']}).")
+
+
 @serve.command(name='status')
 @click.argument('service_names', nargs=-1)
 def serve_status(service_names: Tuple[str, ...]):
@@ -495,9 +516,10 @@ def serve_status(service_names: Tuple[str, ...]):
         return
     for r in records:
         click.echo(f"{r['name']}  {r['status'].colored_str()}  "
-                   f"{r['endpoint']}")
+                   f"{r['endpoint']}  v{r.get('version', 1)}")
         for rep in r['replicas']:
             click.echo(f"  replica {rep['replica_id']}  "
+                       f"v{rep.get('version', 1)}  "
                        f"{rep['status'].colored_str()}  {rep['url']}  "
                        f"({rep['cluster_name']})")
         if r.get('failure_reason'):

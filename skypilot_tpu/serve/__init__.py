@@ -11,5 +11,6 @@ autoscalers.py, load_balancer.py). TPU-first redesign notes:
 from skypilot_tpu.serve.core import down
 from skypilot_tpu.serve.core import status
 from skypilot_tpu.serve.core import up
+from skypilot_tpu.serve.core import update
 
-__all__ = ['up', 'down', 'status']
+__all__ = ['up', 'down', 'status', 'update']

@@ -42,12 +42,29 @@ class ServiceController:
         task_cfg = dict(record['task_config'])
         task_cfg.pop('service', None)
         self.task = task_lib.Task.from_yaml_config(task_cfg)
+        self.version = int(record.get('version') or 1)
         self.autoscaler = autoscaler_lib.Autoscaler.make(self.spec.policy)
-        self.manager = replica_managers.ReplicaManager(service_name,
-                                                       self.task, self.spec)
+        self.manager = replica_managers.ReplicaManager(
+            service_name, self.task, self.spec, version=self.version,
+            update_mode=record.get('update_mode') or 'rolling')
         self.lb = lb_lib.LoadBalancer(self.spec.load_balancing_policy,
                                       self.autoscaler)
         self._stop = threading.Event()
+
+    def _maybe_adopt_update(self, record) -> None:
+        """serve update bumped the stored version: reload task/spec and let
+        reconcile migrate the replica set (rolling or blue_green)."""
+        version = int(record.get('version') or 1)
+        if version == self.version:
+            return
+        self.version = version
+        self.spec = spec_lib.ServiceSpec.from_yaml_config(record['spec'])
+        task_cfg = dict(record['task_config'])
+        task_cfg.pop('service', None)
+        self.task = task_lib.Task.from_yaml_config(task_cfg)
+        self.autoscaler = autoscaler_lib.Autoscaler.make(self.spec.policy)
+        self.manager.reload(self.task, self.spec, version,
+                            record.get('update_mode') or 'rolling')
 
     # ------------------------------------------------------------------
     def _reconcile_loop(self) -> None:
@@ -59,6 +76,7 @@ class ServiceController:
                 if record is None or record['status'] in (
                         ServiceStatus.SHUTTING_DOWN, ServiceStatus.SHUTDOWN):
                     break
+                self._maybe_adopt_update(record)
                 if self.spec.pool:
                     # Worker count is resizable in place (jobs/pool.py
                     # rewrites the stored spec); honor the live value.

@@ -122,6 +122,8 @@ def status(service_names: Optional[List[str]] = None,
             'endpoint': (None if is_pool else
                          f"http://127.0.0.1:{r['lb_port']}"),
             'pool': is_pool,
+            'version': int(r.get('version') or 1),
+            'update_mode': r.get('update_mode') or 'rolling',
             'created_at': r['created_at'],
             'failure_reason': r.get('failure_reason'),
             'replicas': [{
@@ -130,9 +132,52 @@ def status(service_names: Optional[List[str]] = None,
                 'url': rep['url'],
                 'cluster_name': rep['cluster_name'],
                 'job_id': rep.get('job_id'),
+                'version': int(rep.get('version') or 1),
             } for rep in replicas],
         })
     return out
+
+
+@usage_lib.tracked('serve.update')
+def update(task: task_lib.Task, service_name: str,
+           mode: str = 'rolling') -> Dict[str, Any]:
+    """Migrate a live service to a new task/spec version.
+
+    Reference analog: sky serve update (serve_utils.UpdateMode —
+    `rolling` replaces replicas one at a time with the READY count never
+    dipping below target; `blue_green` brings up a full new set and cuts
+    traffic over atomically). The live controller adopts the bumped
+    version on its next reconcile pass.
+    """
+    if mode not in ('rolling', 'blue_green'):
+        raise ValueError(f"update mode must be 'rolling' or 'blue_green', "
+                         f'got {mode!r}')
+    record = serve_state.get_service(service_name)
+    if record is None or record['status'].is_terminal():
+        raise ValueError(
+            f'Service {service_name!r} is not running; use `serve up`.')
+    if task.service_spec is None:
+        raise ValueError("Task has no 'service:' section.")
+    from skypilot_tpu import admin_policy
+    task = admin_policy.apply(task, 'serve.update',
+                              cluster_name=service_name)
+    spec = spec_lib.ServiceSpec.from_yaml_config(task.service_spec)
+    from skypilot_tpu.serve import spot_placer as spot_placer_lib
+    spot_placer_lib.validate_spec(spec, task)
+    was_pool = bool((record['spec'] or {}).get('pool'))
+    if spec.pool != was_pool:
+        raise ValueError('Cannot convert between a service and a pool; '
+                         'tear down and recreate instead.')
+    import json as json_lib
+    version = int(record.get('version') or 1) + 1
+    serve_state.update_service(
+        service_name,
+        task_config=json_lib.dumps(task.to_yaml_config()),
+        spec=json_lib.dumps(spec.to_yaml_config()),
+        version=version, update_mode=mode)
+    logger.info(f'Service {service_name!r} updating to version {version} '
+                f'({mode}).')
+    return {'name': service_name, 'version': version, 'mode': mode}
 
 
 def down(service_name: str, purge: bool = False) -> None:

@@ -56,10 +56,13 @@ class ReplicaManager:
     """Drives the replica set of one service toward a target count."""
 
     def __init__(self, service_name: str, task: 'task_lib.Task',
-                 spec: spec_lib.ServiceSpec):
+                 spec: spec_lib.ServiceSpec, version: int = 1,
+                 update_mode: str = 'rolling'):
         self.service_name = service_name
         self.task = task
         self.spec = spec
+        self.version = version
+        self.update_mode = update_mode
         self.backend = slice_backend.TpuSliceBackend()
         self._launch_threads: Dict[int, threading.Thread] = {}
         # One decision for env injection AND probe URLs (they must agree).
@@ -73,6 +76,23 @@ class ReplicaManager:
         # spread away from in-use zones (serve/spot_placer.py).
         self.spot_placer = spot_placer_lib.SpotPlacer.from_task(spec, task)
         self._replica_locations: Dict[int, spot_placer_lib.Location] = {}
+        # Which versions the LB may route to (reference:
+        # serve_utils.py:566 active_versions): rolling serves mixed
+        # versions; blue_green pins traffic to the old set until the new
+        # one can carry the full target.
+        self.active_versions = {version}
+
+    def reload(self, task: 'task_lib.Task', spec: spec_lib.ServiceSpec,
+               version: int, update_mode: str) -> None:
+        """Adopt a new service version (serve update). Running replicas
+        keep their launch-time config; reconcile migrates them."""
+        self.task = task
+        self.spec = spec
+        self.version = version
+        self.update_mode = update_mode
+        self.spot_placer = spot_placer_lib.SpotPlacer.from_task(spec, task)
+        logger.info(f'Service {self.service_name!r} now targets version '
+                    f'{version} ({update_mode}).')
 
     # ------------------------------------------------------------------
     # Launch / terminate
@@ -96,6 +116,7 @@ class ReplicaManager:
                 'SKYTPU_SERVE_PORT': str(port + replica_id
                                          if self._local_ports else port),
                 'SKYTPU_SERVE_REPLICA_ID': str(replica_id),
+                'SKYTPU_SERVE_VERSION': str(self.version),
             })
         # Placement was decided in scale_up (single-threaded) — concurrent
         # launch threads reading the placer here would all see the same
@@ -146,7 +167,8 @@ class ReplicaManager:
             serve_state.upsert_replica(
                 self.service_name, rid,
                 cluster_name=self._cluster_name(rid),
-                status=ReplicaStatus.PROVISIONING.value, url='')
+                status=ReplicaStatus.PROVISIONING.value, url='',
+                version=self.version)
             if self.spot_placer is not None:
                 loc = self.spot_placer.select_next_location(
                     list(self._replica_locations.values()))
@@ -304,6 +326,11 @@ class ReplicaManager:
                 f'to launch or pass readiness probes; check the resources, '
                 f'run command and readiness_probe.')
             return
+        stale = [r for r in alive if (r.get('version') or 1) < self.version]
+        if stale:
+            self._reconcile_update(alive, stale, target)
+            return
+        self.active_versions = {self.version}
         # Scale toward target.
         if len(alive) < target:
             self.scale_up(target - len(alive))
@@ -319,6 +346,53 @@ class ReplicaManager:
                 logger.info(f'Scaling down replica {rep["replica_id"]}.')
                 self.terminate_replica(rep['replica_id'])
 
+    def _reconcile_update(self, alive: List[dict], stale: List[dict],
+                          target: int) -> None:
+        """Migrate the replica set to self.version (serve update).
+
+        rolling (reference replica_managers rolling update): surge one
+        new-version replica at a time; every time one turns READY, retire
+        one old replica — capacity never dips below the old READY set.
+        Mixed versions serve traffic during the transition.
+
+        blue_green: bring up a full new-version set alongside the old one;
+        traffic stays pinned to the old version (active_versions) until
+        the new set can carry the whole target, then the old set retires
+        and traffic cuts over atomically."""
+        fresh = [r for r in alive if (r.get('version') or 1) >= self.version]
+        fresh_ready = [r for r in fresh
+                       if r['status'] is ReplicaStatus.READY]
+        old_versions = {(r.get('version') or 1) for r in stale}
+        if self.update_mode == 'blue_green':
+            self.active_versions = old_versions
+            if len(fresh) < target:
+                self.scale_up(target - len(fresh))
+            elif len(fresh_ready) >= target:
+                for rep in stale:
+                    logger.info(f'blue_green cutover: retiring v'
+                                f'{rep.get("version") or 1} replica '
+                                f'{rep["replica_id"]}.')
+                    self.terminate_replica(rep['replica_id'])
+                self.active_versions = {self.version}
+            return
+        # rolling: the invariant is READY count never drops below target —
+        # a stale replica retires only when the READY set has a surplus
+        # (the surged new-version replica turned READY).
+        self.active_versions = old_versions | {self.version}
+        ready_total = sum(r['status'] is ReplicaStatus.READY for r in alive)
+        if ready_total > target and stale:
+            oldest = min(stale, key=lambda r: r['replica_id'])
+            logger.info(f'rolling update: replica {oldest["replica_id"]} '
+                        f'(v{oldest.get("version") or 1}) retired in '
+                        f'favor of a v{self.version} replica.')
+            self.terminate_replica(oldest['replica_id'])
+            alive = [r for r in alive if r is not oldest]
+        if len(alive) < target + 1 and len(fresh) < target:
+            self.scale_up(1)   # surge one new-version replica
+
     def ready_urls(self) -> List[str]:
+        """URLs the LB may route to: READY replicas of an active version
+        (blue_green pins this to the old set until cutover)."""
         return [r['url'] for r in serve_state.get_replicas(self.service_name)
-                if r['status'] is ReplicaStatus.READY and r['url']]
+                if r['status'] is ReplicaStatus.READY and r['url'] and
+                (r.get('version') or 1) in self.active_versions]

@@ -68,8 +68,16 @@ def _conn() -> sqlite3.Connection:
             lb_port INTEGER,
             controller_pid INTEGER,
             created_at REAL,
-            failure_reason TEXT
+            failure_reason TEXT,
+            version INTEGER DEFAULT 1,
+            update_mode TEXT DEFAULT 'rolling'
         )""")
+    for col, decl in (('version', 'INTEGER DEFAULT 1'),
+                      ('update_mode', "TEXT DEFAULT 'rolling'")):
+        try:
+            conn.execute(f'ALTER TABLE services ADD COLUMN {col} {decl}')
+        except sqlite3.OperationalError:
+            pass
     conn.execute("""
         CREATE TABLE IF NOT EXISTS replicas (
             service TEXT,
@@ -80,13 +88,16 @@ def _conn() -> sqlite3.Connection:
             launched_at REAL,
             consecutive_failures INTEGER DEFAULT 0,
             job_id INTEGER,
+            version INTEGER DEFAULT 1,
             PRIMARY KEY (service, replica_id)
         )""")
-    # Pre-pool databases lack the worker-assignment column.
-    try:
-        conn.execute('ALTER TABLE replicas ADD COLUMN job_id INTEGER')
-    except sqlite3.OperationalError:
-        pass
+    # Pre-pool / pre-update databases lack these columns.
+    for col, decl in (('job_id', 'INTEGER'), ('version',
+                                              'INTEGER DEFAULT 1')):
+        try:
+            conn.execute(f'ALTER TABLE replicas ADD COLUMN {col} {decl}')
+        except sqlite3.OperationalError:
+            pass
     return conn
 
 

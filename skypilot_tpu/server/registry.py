@@ -162,6 +162,14 @@ def _serve_status(payload):
     return out
 
 
+def _serve_update(payload):
+    import skypilot_tpu as sky
+    from skypilot_tpu import serve
+    task = sky.Task.from_yaml_config(payload['task'])
+    return serve.update(task, payload['service_name'],
+                        mode=payload.get('mode', 'rolling'))
+
+
 def _serve_down(payload):
     from skypilot_tpu import serve
     serve.down(payload['service_name'], purge=payload.get('purge', False))
@@ -206,5 +214,6 @@ HANDLERS: Dict[str, Tuple[Callable[[Dict[str, Any]], Any], str]] = {
     # down replicas synchronously, so LONG.
     'serve_up': (_serve_up, requests_lib.SHORT),
     'serve_status': (_serve_status, requests_lib.SHORT),
+    'serve_update': (_serve_update, requests_lib.SHORT),
     'serve_down': (_serve_down, requests_lib.LONG),
 }

@@ -193,9 +193,11 @@ _REPLICA_APP = (
     'python -c "'
     'import http.server, os, json\n'
     'rid = os.environ.get(\'SKYTPU_SERVE_REPLICA_ID\', \'?\')\n'
+    'ver = os.environ.get(\'SKYTPU_SERVE_VERSION\', \'1\')\n'
     'class H(http.server.BaseHTTPRequestHandler):\n'
     '    def do_GET(self):\n'
-    '        body = json.dumps({\'replica\': rid,\'path\': self.path}).encode()\n'
+    '        body = json.dumps({\'replica\': rid,\'path\': self.path,'
+    '\'version\': ver}).encode()\n'
     '        self.send_response(200)\n'
     '        self.send_header(\'Content-Type\',\'application/json\')\n'
     '        self.end_headers()\n'
@@ -381,3 +383,69 @@ class TestServeEndToEnd:
     def test_plain_launch_rejects_service_yaml(self):
         with pytest.raises(ValueError, match='serve up'):
             sky.launch(_service_task(), cluster_name='nope')
+
+    def test_rolling_update_replaces_without_downtime(self):
+        """serve update --mode rolling: replicas migrate one at a time,
+        the LB answers throughout, and traffic ends on the new version."""
+        info = serve_core.up(_service_task(replicas=2),
+                             lb_port=_worker_port_base() + 51)
+        name = info['name']
+        try:
+            serve_core.wait_until(name, {ServiceStatus.READY}, timeout=120)
+            _wait_ready_replicas(name, 2)
+            assert _get(info['endpoint'] + '/v')['version'] == '1'
+
+            out = serve_core.update(_service_task(replicas=2), name,
+                                    mode='rolling')
+            assert out['version'] == 2
+            deadline = time.time() + 150
+            while time.time() < deadline:
+                # Availability invariant: the endpoint answers at every
+                # poll during the whole migration.
+                _get(info['endpoint'] + '/v')
+                reps = serve_state.get_replicas(name)
+                if reps and all((r.get('version') or 1) == 2 and
+                                r['status'] is ReplicaStatus.READY
+                                for r in reps) and len(reps) == 2:
+                    break
+                time.sleep(0.5)
+            else:
+                raise TimeoutError(serve_state.get_replicas(name))
+            # Traffic now reports the new version (both replicas).
+            seen = {_get(info['endpoint'] + '/v')['version']
+                    for _ in range(4)}
+            assert seen == {'2'}
+        finally:
+            serve_core.down(name)
+
+    def test_blue_green_update_pins_traffic_until_cutover(self):
+        """blue_green: old version serves alone until the new set can
+        carry the full target, then traffic cuts over atomically."""
+        info = serve_core.up(_service_task(replicas=1),
+                             lb_port=_worker_port_base() + 52)
+        name = info['name']
+        try:
+            serve_core.wait_until(name, {ServiceStatus.READY}, timeout=120)
+            _wait_ready_replicas(name, 1)
+            serve_core.update(_service_task(replicas=1), name,
+                              mode='blue_green')
+            saw_v1_during_update = False
+            deadline = time.time() + 150
+            while time.time() < deadline:
+                got = _get(info['endpoint'] + '/v')['version']
+                reps = serve_state.get_replicas(name)
+                vs = {(r.get('version') or 1) for r in reps}
+                if vs == {2} and all(r['status'] is ReplicaStatus.READY
+                                     for r in reps):
+                    break
+                if 1 in vs and 2 in vs:
+                    # Both sets exist → pre-cutover: traffic MUST be v1.
+                    assert got == '1'
+                    saw_v1_during_update = True
+                time.sleep(0.3)
+            else:
+                raise TimeoutError(serve_state.get_replicas(name))
+            assert saw_v1_during_update
+            assert _get(info['endpoint'] + '/v')['version'] == '2'
+        finally:
+            serve_core.down(name)
