@@ -543,6 +543,47 @@ def serve_down(service_name: str, purge: bool, yes: bool):
 
 
 @cli.group()
+def ssh():
+    """BYO-machine SSH node pools (reference: `sky ssh`). Pools are
+    declared in ~/.skytpu/ssh_node_pools.yaml and launched with
+    `--cloud ssh`."""
+
+
+@ssh.command(name='list')
+def ssh_list():
+    """Show configured SSH node pools and their hosts."""
+    from skypilot_tpu.clouds import ssh as ssh_cloud
+    pools = ssh_cloud.load_pools()
+    if not pools:
+        raise click.ClickException(
+            f'No pools configured in {ssh_cloud.POOLS_PATH}.')
+    from skypilot_tpu.provision.ssh import instance as ssh_instance
+    state = ssh_instance.load_allocations()
+    host_to_cluster = {}
+    for cluster, alloc in state.get('allocations', {}).items():
+        for h in alloc.get('hosts', []):
+            host_to_cluster[str(h)] = cluster
+    for name, cfg in pools.items():
+        hosts = cfg.get('hosts') or []
+        click.echo(f'{name}  ({len(hosts)} host(s), accelerator: '
+                   f"{cfg.get('accelerator', '-')})")
+        for h in hosts:
+            used = host_to_cluster.get(str(h))
+            click.echo(f'  {h}  '
+                       f'{f"in use by {used}" if used else "free"}')
+
+
+@ssh.command(name='check')
+def ssh_check():
+    """Probe SSH connectivity to every pool host."""
+    from skypilot_tpu.clouds import ssh as ssh_cloud
+    ok, reason = ssh_cloud.Ssh.check_credentials()
+    if not ok:
+        raise click.ClickException(reason or 'ssh pools unavailable')
+    click.echo('SSH node pools configured and reachable.')
+
+
+@cli.group()
 def storage():
     """Storage buckets registered with the framework
     (reference: `sky storage`)."""

@@ -175,3 +175,43 @@ class TestServerLoad:
         # 0.2s-per-claim pacing would cap at 5/s; back-to-back claiming on
         # a busy queue must do far better even on one loaded core.
         assert rate > 10.0
+
+    def test_sustained_load_memory_and_record_growth(self):
+        """sys_profiling analog (reference tests/load_tests/
+        sys_profiling.py monitors API-server memory): three waves of
+        requests must not leak — request records are GC-able and the
+        process RSS stays bounded (no per-request state retained)."""
+        import resource
+
+        def rss_mb():
+            return resource.getrusage(
+                resource.RUSAGE_SELF).ru_maxrss / 1024.0
+
+        def drain(n):
+            ids = [requests_lib.create('load_noop', {},
+                                       requests_lib.SHORT)
+                   for _ in range(n)]
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                if all(requests_lib.RequestStatus(
+                        requests_lib.get(r)['status']).is_terminal()
+                       for r in ids):
+                    return
+                time.sleep(0.05)
+            raise TimeoutError('wave did not drain')
+
+        drain(50)
+        base = rss_mb()
+        for _ in range(2):
+            drain(50)
+        growth = rss_mb() - base
+        print(f'\nsustained load: peak-RSS growth {growth:.1f} MiB '
+              f'over 100 extra requests')
+        # Thread-mode handlers hold no per-request state; a leak of even
+        # 100 KiB/request would show as >10 MiB here.
+        assert growth < 10.0
+
+        # All 150 terminal records are prunable by the GC.
+        pruned = requests_lib.gc_requests(max_age_seconds=0.0)
+        assert pruned >= 150
+        assert len(requests_lib.list_requests(limit=1000)) == 0
