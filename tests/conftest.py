@@ -65,3 +65,40 @@ def isolated_state(tmp_path, monkeypatch):
     monkeypatch.setattr(local_cloud, 'LOCAL_CLOUD_ROOT',
                         str(home / '.skytpu/local_cloud'))
     yield home
+    # A test that fails mid-scenario leaks its detached controller
+    # processes (serve/jobs/pool), which then poll forever and starve the
+    # CPU for every later test. Reap anything whose pid this HOME's state
+    # recorded.
+    _reap_controllers(home)
+
+
+def _reap_controllers(home) -> None:
+    import signal
+    import sqlite3
+    pids = set()
+    for db, query in ((home / '.skytpu/serve.db',
+                       'SELECT controller_pid FROM services'),
+                      (home / '.skytpu/managed_jobs.db',
+                       'SELECT controller_pid FROM jobs')):
+        try:
+            with sqlite3.connect(db) as conn:
+                pids.update(p for (p,) in conn.execute(query) if p)
+        except sqlite3.Error:
+            continue
+    # Gang rank processes (slice_driver) run with cwd inside this HOME's
+    # fake cloud root; match them by cwd rather than trusting any table.
+    home_str = str(home)
+    for entry in os.listdir('/proc'):
+        if not entry.isdigit():
+            continue
+        try:
+            cwd = os.readlink(f'/proc/{entry}/cwd')
+        except OSError:
+            continue
+        if cwd.startswith(home_str):
+            pids.add(int(entry))
+    for pid in pids:
+        try:
+            os.kill(int(pid), signal.SIGKILL)
+        except (OSError, ProcessLookupError, ValueError):
+            pass
