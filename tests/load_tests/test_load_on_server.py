@@ -172,9 +172,11 @@ class TestServerLoad:
         assert all(requests_lib.get(rid)['status'] == 'SUCCEEDED'
                    for rid in ids)
         print(f'\ndispatcher: {n} requests in {wall:.2f}s = {rate:.0f}/s')
-        # 0.2s-per-claim pacing would cap at 5/s; back-to-back claiming on
-        # a busy queue must do far better even on one loaded core.
-        assert rate > 10.0
+        # The idle-backoff pacing bug capped a busy queue at exactly 5
+        # claims/s; back-to-back claiming lands at >100/s on an idle box.
+        # The bound sits above the pacing ceiling but tolerates a CI box
+        # saturated by parallel test workers.
+        assert rate > 6.5
 
     def test_sustained_load_memory_and_record_growth(self):
         """sys_profiling analog (reference tests/load_tests/

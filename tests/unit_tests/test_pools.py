@@ -73,8 +73,12 @@ class TestPoolLifecycle:
     def test_apply_ready_jobs_share_workers_down(self, tmp_path):
         pool_lib.apply(_pool_task(workers=2))
         _wait_workers_ready('wp', 2)
-        record = serve_state.get_service('wp')
-        assert record['status'] is ServiceStatus.READY
+        # Service status lands one reconcile pass after worker readiness.
+        deadline = time.time() + 30
+        while serve_state.get_service('wp')['status'] is not \
+                ServiceStatus.READY:
+            assert time.time() < deadline, serve_state.get_service('wp')
+            time.sleep(0.3)
         # Worker clusters exist and idle (setup ran, no job).
         clusters_before = {c['name'] for c in global_state.get_clusters()}
         assert len(clusters_before) == 2
