@@ -542,6 +542,22 @@ def serve_down(service_name: str, purge: bool, yes: bool):
         raise click.ClickException(str(e)) from e
 
 
+@cli.command(name='tunnel')
+@click.argument('cluster', required=True)
+@click.option('--port', '-p', type=int, default=22, show_default=True,
+              help='Remote port on the cluster head.')
+@click.option('--local-port', '-l', type=int, required=True,
+              help='Local listen port.')
+def tunnel_cmd(cluster: str, port: int, local_port: int):
+    """Tunnel a cluster port through the API server (websocket proxy).
+
+    Example: `skytpu tunnel mycluster -p 22 -l 2222 &` then
+    `ssh -p 2222 user@127.0.0.1`.
+    """
+    from skypilot_tpu.client import tunnel as tunnel_lib
+    tunnel_lib.run_tunnel(cluster, port, local_port)
+
+
 @cli.group()
 def ssh():
     """BYO-machine SSH node pools (reference: `sky ssh`). Pools are

@@ -1,0 +1,86 @@
+"""Client side of the TCP-over-websocket tunnel to cluster ports.
+
+Reference analog: sky/templates/websocket_proxy.py — the ProxyCommand
+script that carries ssh over the API server's websocket endpoint. Here:
+a local TCP listener; every accepted connection gets its own websocket
+to `/api/v1/tunnel?cluster=...&port=...` (authenticated with the same
+bearer token as the SDK) and the two byte streams are pumped in both
+directions. Usable as:
+
+    skytpu tunnel mycluster --port 22 --local-port 2222 &
+    ssh -p 2222 user@127.0.0.1
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+import aiohttp
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.client import sdk as sync_sdk
+
+logger = sky_logging.init_logger(__name__)
+
+
+async def _pump_one(local_reader: asyncio.StreamReader,
+                    local_writer: asyncio.StreamWriter,
+                    server_url: str, cluster: str, port: int) -> None:
+    ws_url = (f'{server_url}/api/v1/tunnel'
+              f'?cluster={cluster}&port={port}')
+    async with aiohttp.ClientSession() as session:
+        try:
+            ws = await session.ws_connect(ws_url,
+                                          headers=sync_sdk._headers(),
+                                          max_msg_size=4 * 1024 * 1024)
+        except aiohttp.ClientError as e:
+            logger.warning(f'tunnel connect failed: {e}')
+            local_writer.close()
+            return
+
+        async def up() -> None:            # local tcp → ws
+            while True:
+                data = await local_reader.read(65536)
+                if not data:
+                    break
+                await ws.send_bytes(data)
+            await ws.close()
+
+        async def down() -> None:          # ws → local tcp
+            async for msg in ws:
+                if msg.type == aiohttp.WSMsgType.BINARY:
+                    local_writer.write(msg.data)
+                    await local_writer.drain()
+                elif msg.type in (aiohttp.WSMsgType.CLOSED,
+                                  aiohttp.WSMsgType.ERROR):
+                    break
+            local_writer.close()
+
+        await asyncio.gather(up(), down(), return_exceptions=True)
+
+
+async def serve_tunnel(cluster: str, port: int, local_port: int,
+                       url: Optional[str] = None,
+                       ready_event: Optional[asyncio.Event] = None) -> None:
+    """Listen on 127.0.0.1:local_port and proxy each connection."""
+    server_url = url or sync_sdk.api_server_url(required=True)
+
+    async def on_conn(reader, writer):
+        await _pump_one(reader, writer, server_url, cluster, port)
+
+    server = await asyncio.start_server(on_conn, '127.0.0.1', local_port)
+    logger.info(f'tunnel: 127.0.0.1:{local_port} -> {cluster}:{port} '
+                f'(via {server_url})')
+    if ready_event is not None:
+        ready_event.set()
+    async with server:
+        await server.serve_forever()
+
+
+def run_tunnel(cluster: str, port: int, local_port: int,
+               url: Optional[str] = None) -> None:
+    """Blocking entry point (the CLI's)."""
+    try:
+        asyncio.run(serve_tunnel(cluster, port, local_port, url=url))
+    except KeyboardInterrupt:
+        pass

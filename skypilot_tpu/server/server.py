@@ -263,6 +263,58 @@ async def dashboard_summary(request: web.Request) -> web.Response:
     })
 
 
+async def tunnel(request: web.Request) -> web.WebSocketResponse:
+    """Bidirectional TCP-over-websocket proxy to a cluster's head host.
+
+    Reference analog: the API server's websocket ssh proxy
+    (sky/server/server.py:1845 + sky/templates/websocket_proxy.py) — the
+    client keeps one authenticated HTTP(S) connection to the API server
+    and reaches cluster ports (ssh, debuggers, TensorBoard) without the
+    cluster being directly routable from the client.
+
+    GET /api/v1/tunnel?cluster=<name>&port=<port> (websocket upgrade);
+    binary frames carry the raw TCP bytes in both directions.
+    """
+    from skypilot_tpu import global_state
+    from skypilot_tpu.backends import slice_backend
+    cluster = request.query.get('cluster', '')
+    port = int(request.query.get('port', 22))
+    record = global_state.get_cluster(cluster)
+    if record is None:
+        raise web.HTTPNotFound(text=f'cluster {cluster!r} not found')
+    handle = slice_backend.SliceResourceHandle.from_dict(record['handle'])
+    head = handle.get_cluster_info().ordered_instances()[0]
+    ip = head.external_ip or head.internal_ip
+
+    ws = web.WebSocketResponse(max_msg_size=4 * 1024 * 1024)
+    await ws.prepare(request)
+    try:
+        reader, writer = await asyncio.open_connection(ip, port)
+    except OSError as e:
+        await ws.close(code=1011, message=str(e).encode()[:120])
+        return ws
+
+    async def pump_up() -> None:           # ws → tcp
+        async for msg in ws:
+            if msg.type == web.WSMsgType.BINARY:
+                writer.write(msg.data)
+                await writer.drain()
+            elif msg.type in (web.WSMsgType.CLOSE, web.WSMsgType.ERROR):
+                break
+        writer.close()
+
+    async def pump_down() -> None:         # tcp → ws
+        while True:
+            data = await reader.read(65536)
+            if not data:
+                break
+            await ws.send_bytes(data)
+        await ws.close()
+
+    await asyncio.gather(pump_up(), pump_down(), return_exceptions=True)
+    return ws
+
+
 async def _gc_loop(app: web.Application) -> None:
     while True:
         try:
@@ -300,6 +352,7 @@ def build_app() -> web.Application:
     app.router.add_get('/api/v1/stream', stream)
     app.router.add_get('/api/v1/requests', list_requests)
     app.router.add_get('/api/v1/metrics', metrics)
+    app.router.add_get('/api/v1/tunnel', tunnel)
     app.router.add_post('/api/v1/request_cancel', request_cancel)
     app.router.add_get('/dashboard', dashboard_page)
     app.router.add_get('/dashboard/api/summary', dashboard_summary)
