@@ -184,21 +184,44 @@ def decode_step(params, token: jnp.ndarray, cache: KVCache,
 
 
 def _select_token(logits: jnp.ndarray, temperature: float,
-                  rng: Optional[jax.Array]) -> jnp.ndarray:
+                  rng: Optional[jax.Array],
+                  top_k: Optional[int] = None,
+                  top_p: Optional[float] = None) -> jnp.ndarray:
+    """Greedy (temperature<=0) or filtered sampling. top_k keeps the k
+    highest logits; top_p keeps the smallest nucleus whose probability
+    mass reaches p (the highest-probability token always survives). All
+    static-shaped: filters are masks, never gathers, so one compiled
+    step serves every request."""
     if temperature <= 0.0 or rng is None:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
-    return jax.random.categorical(rng, logits / temperature,
-                                  axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    neg_inf = jnp.finfo(logits.dtype).min
+    if top_k is not None and top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[..., -top_k, None]
+        logits = jnp.where(logits < kth, neg_inf, logits)
+    if top_p is not None and 0.0 < top_p < 1.0:
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # Keep ranks whose PRECEDING mass is < p (rank 0 always kept);
+        # the cutoff logit is the smallest kept sorted logit.
+        keep = (cum - probs) < top_p
+        cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf),
+                         axis=-1, keepdims=True)
+        logits = jnp.where(logits < cutoff, neg_inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
 @functools.partial(jax.jit,
                    static_argnames=('cfg', 'max_new_tokens', 'max_len',
-                                    'temperature', 'eos_id'))
+                                    'temperature', 'eos_id', 'top_k',
+                                    'top_p'))
 def generate(params, prompt: jnp.ndarray, cfg: llama.LlamaConfig,
              max_new_tokens: int, *, max_len: Optional[int] = None,
              temperature: float = 0.0, eos_id: Optional[int] = None,
+             top_k: Optional[int] = None, top_p: Optional[float] = None,
              rng: Optional[jax.Array] = None) -> jnp.ndarray:
-    """Greedy/temperature generation, fully jitted.
+    """Greedy/temperature/top-k/top-p generation, fully jitted.
 
     prompt [B, S] → generated tokens [B, max_new_tokens] (positions after an
     eos are filled with eos).
@@ -213,13 +236,13 @@ def generate(params, prompt: jnp.ndarray, cfg: llama.LlamaConfig,
     logits, cache = prefill(params, prompt, cfg, max_len)
     if rng is None:
         rng = jax.random.PRNGKey(0)
-    first = _select_token(logits, temperature, rng)
+    first = _select_token(logits, temperature, rng, top_k, top_p)
     done0 = (jnp.full((b,), False) if eos_id is None else first == eos_id)
 
     def body(carry, step_rng):
         tok, cache, done = carry
         logits, cache = decode_step(params, tok, cache, cfg)
-        nxt = _select_token(logits, temperature, step_rng)
+        nxt = _select_token(logits, temperature, step_rng, top_k, top_p)
         if eos_id is not None:
             nxt = jnp.where(done, jnp.int32(eos_id), nxt)
             done = jnp.logical_or(done, nxt == eos_id)

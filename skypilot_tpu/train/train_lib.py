@@ -101,34 +101,75 @@ def init_train_state(rng: jax.Array, cfg: 'llama.LlamaConfig', mesh: Mesh,
 
 def make_train_step(cfg: 'llama.LlamaConfig', mesh: Mesh,
                     tx: optax.GradientTransformation,
-                    rules: Optional[sharding_lib.Rules] = None
+                    rules: Optional[sharding_lib.Rules] = None,
+                    grad_accum_steps: int = 1
                     ) -> Callable[[TrainState, Batch],
                                   Tuple[TrainState, Dict[str, jnp.ndarray]]]:
     """Jitted (state, batch) → (state, metrics); donates state.
 
     batch: {'tokens': int32 [B, S+1]} — shifted internally;
     optional 'loss_mask' [B, S] masks the *target* positions.
+
+    grad_accum_steps > 1 splits the batch into that many microbatches and
+    accumulates grads in one `lax.scan` before a single optimizer update —
+    the global batch stays on the loader/step contract, only peak
+    activation memory shrinks (activations live for one microbatch at a
+    time; with equal-size microbatches the update equals the dense step
+    exactly, asserted in tests/unit_tests/test_parallel.py).
     """
     rules = rules or sharding_lib.Rules()
     shardings = state_shardings(cfg, mesh, tx, rules)
     mod = models_lib.module_for(cfg)
 
-    def step_fn(state: TrainState, batch: Batch):
-        tokens = batch['tokens']
+    def _grads_of(params, tokens, mask):
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
-        mask = batch.get('loss_mask')
 
-        def loss_fn(params):
+        def loss_fn(p):
             if getattr(mod, 'HAS_AUX', False):
-                logits, aux = mod.forward(params, inputs, cfg, rules,
+                logits, aux = mod.forward(p, inputs, cfg, rules,
                                           return_aux=True)
             else:
-                logits, aux = mod.forward(params, inputs, cfg, rules), 0.0
+                logits, aux = mod.forward(p, inputs, cfg, rules), 0.0
             loss, denom = cross_entropy_loss(logits, targets, mask)
             return loss + aux, (loss, denom)
 
         (_, (loss, denom)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(state.params)
+            loss_fn, has_aux=True)(params)
+        return grads, loss, denom
+
+    def step_fn(state: TrainState, batch: Batch):
+        tokens = batch['tokens']
+        mask = batch.get('loss_mask')
+        a = grad_accum_steps
+        if a == 1:
+            grads, loss, denom = _grads_of(state.params, tokens, mask)
+        else:
+            b = tokens.shape[0]
+            if b % a != 0:
+                raise ValueError(f'batch {b} not divisible by '
+                                 f'grad_accum_steps {a}')
+            tok_m = tokens.reshape(a, b // a, *tokens.shape[1:])
+            mask_m = (mask.reshape(a, b // a, *mask.shape[1:])
+                      if mask is not None else None)
+
+            def micro(carry, xs):
+                g_sum, l_sum, d_sum = carry
+                if mask_m is None:
+                    t, m = xs, None
+                else:
+                    t, m = xs
+                g, loss, denom = _grads_of(state.params, t, m)
+                g_sum = jax.tree.map(jnp.add, g_sum, g)
+                # Token-weighted loss so masked microbatches average right.
+                return (g_sum, l_sum + loss * denom, d_sum + denom), None
+
+            g0 = jax.tree.map(jnp.zeros_like, state.params)
+            xs = tok_m if mask_m is None else (tok_m, mask_m)
+            (g_sum, l_sum, d_sum), _ = jax.lax.scan(
+                micro, (g0, jnp.zeros(()), jnp.zeros(())), xs)
+            grads = jax.tree.map(lambda g: g / a, g_sum)
+            loss = l_sum / jnp.maximum(d_sum, 1.0)
+            denom = d_sum
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
         gnorm = optax.global_norm(grads)

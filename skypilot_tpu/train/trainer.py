@@ -39,6 +39,9 @@ class TrainerConfig:
     tokenizer: Optional[str] = None
     ckpt_dir: Optional[str] = None
     ckpt_every: int = 50
+    # >1: split each global batch into this many sequentially-accumulated
+    # microbatches (same update, lower peak activation memory).
+    grad_accum_steps: int = 1
 
 
 def maybe_init_distributed() -> None:
@@ -123,7 +126,12 @@ def train(tcfg: TrainerConfig) -> List[Dict[str, float]]:
     else:
         state = train_lib.init_train_state(jax.random.PRNGKey(0), cfg, mesh,
                                            tx)
-    step_fn = train_lib.make_train_step(cfg, mesh, tx)
+    if tcfg.batch_size % tcfg.grad_accum_steps != 0:
+        raise ValueError(
+            f'batch_size={tcfg.batch_size} must be divisible by '
+            f'grad_accum_steps={tcfg.grad_accum_steps}')
+    step_fn = train_lib.make_train_step(
+        cfg, mesh, tx, grad_accum_steps=tcfg.grad_accum_steps)
     batches = _batch_iter(tcfg, cfg.vocab_size, start_step, mesh)
 
     history: List[Dict[str, float]] = []
@@ -175,6 +183,9 @@ def main() -> None:
     parser.add_argument('--tokenizer', default=None)
     parser.add_argument('--ckpt-dir', default=None)
     parser.add_argument('--ckpt-every', type=int, default=50)
+    parser.add_argument('--grad-accum', type=int, default=1,
+                        help='Accumulate grads over N microbatches per '
+                             'optimizer step (lower peak memory).')
     args = parser.parse_args()
 
     def _parse_kv(items):
@@ -201,7 +212,7 @@ def main() -> None:
         total_steps=args.steps, learning_rate=args.lr,
         log_every=args.log_every, data_path=args.data,
         tokenizer=args.tokenizer, ckpt_dir=args.ckpt_dir,
-        ckpt_every=args.ckpt_every)
+        ckpt_every=args.ckpt_every, grad_accum_steps=args.grad_accum)
     train(tcfg)
 
 

@@ -91,6 +91,45 @@ class TestDecode:
         assert out.shape == (2, 5)
         assert int(out.max()) < cfg.vocab_size
 
+    def test_top_k_and_top_p_filters(self, model):
+        cfg, _ = model
+        rng = jax.random.PRNGKey(0)
+        logits = jax.random.normal(rng, (64, cfg.vocab_size))
+        # top_k=1 and a tiny nucleus both degenerate to argmax.
+        argmax = jnp.argmax(logits, axis=-1)
+        for kwargs in ({'top_k': 1}, {'top_p': 1e-6}):
+            got = decode._select_token(logits, 1.0, rng, **kwargs)
+            np.testing.assert_array_equal(np.asarray(got),
+                                          np.asarray(argmax))
+        # top_k=5: every draw lands inside each row's top-5 set.
+        top5 = jnp.argsort(logits, axis=-1)[:, -5:]
+        for seed in range(5):
+            got = decode._select_token(logits, 1.0,
+                                       jax.random.PRNGKey(seed), top_k=5)
+            assert bool(jnp.all((top5 == got[:, None]).any(axis=-1)))
+        # top_p: draws stay inside the smallest nucleus covering p.
+        probs = jax.nn.softmax(logits, axis=-1)
+        order = jnp.argsort(-logits, axis=-1)
+        sorted_probs = jnp.take_along_axis(probs, order, axis=-1)
+        cum = jnp.cumsum(sorted_probs, axis=-1)
+        nucleus_size = 1 + (cum - sorted_probs < 0.5).sum(-1) - 1
+        for seed in range(5):
+            got = decode._select_token(logits, 1.0,
+                                       jax.random.PRNGKey(seed),
+                                       top_p=0.5)
+            rank = jnp.take_along_axis(
+                jnp.argsort(order, axis=-1), got[:, None], axis=-1)[:, 0]
+            assert bool(jnp.all(rank <= nucleus_size))
+
+    def test_generate_with_sampling_filters(self, model):
+        cfg, params = model
+        prompt = jnp.zeros((2, 4), jnp.int32)
+        out = decode.generate(params, prompt, cfg, 5, temperature=0.8,
+                              top_k=10, top_p=0.9,
+                              rng=jax.random.PRNGKey(7))
+        assert out.shape == (2, 5)
+        assert int(out.max()) < cfg.vocab_size
+
 
 @pytest.fixture(scope='module')
 def moe_model():
