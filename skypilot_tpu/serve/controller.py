@@ -38,31 +38,39 @@ class ServiceController:
             raise ValueError(f'Service {service_name!r} not found.')
         self.name = service_name
         self.record = record
-        self.spec = spec_lib.ServiceSpec.from_yaml_config(record['spec'])
-        task_cfg = dict(record['task_config'])
-        task_cfg.pop('service', None)
-        self.task = task_lib.Task.from_yaml_config(task_cfg)
-        self.version = int(record.get('version') or 1)
-        self.autoscaler = autoscaler_lib.Autoscaler.make(self.spec.policy)
+        self._load_from_record(record)
         self.manager = replica_managers.ReplicaManager(
-            service_name, self.task, self.spec, version=self.version,
+            self.name, self.task, self.spec,
+            version=int(record.get('version') or 1),
             update_mode=record.get('update_mode') or 'rolling')
         self.lb = lb_lib.LoadBalancer(self.spec.load_balancing_policy,
                                       self.autoscaler)
         self._stop = threading.Event()
 
-    def _maybe_adopt_update(self, record) -> None:
-        """serve update bumped the stored version: reload task/spec and let
-        reconcile migrate the replica set (rolling or blue_green)."""
-        version = int(record.get('version') or 1)
-        if version == self.version:
-            return
-        self.version = version
+    def _load_from_record(self, record) -> None:
+        """Build spec/task/autoscaler from a service record (shared by
+        startup and update adoption)."""
         self.spec = spec_lib.ServiceSpec.from_yaml_config(record['spec'])
         task_cfg = dict(record['task_config'])
         task_cfg.pop('service', None)
         self.task = task_lib.Task.from_yaml_config(task_cfg)
         self.autoscaler = autoscaler_lib.Autoscaler.make(self.spec.policy)
+
+    def _maybe_adopt_update(self, record) -> None:
+        """serve update bumped the stored version: reload task/spec and let
+        reconcile migrate the replica set (rolling or blue_green). The
+        manager's version is the comparison base — it also moves on a
+        failed-update rollback, which rewrites the record itself."""
+        version = int(record.get('version') or 1)
+        if version == self.manager.version:
+            # Keep the controller's own mirrors in step (rollback case).
+            if self.spec is not self.manager.spec:
+                self.spec = self.manager.spec
+                self.task = self.manager.task
+                self.autoscaler = autoscaler_lib.Autoscaler.make(
+                    self.spec.policy)
+            return
+        self._load_from_record(record)
         self.manager.reload(self.task, self.spec, version,
                             record.get('update_mode') or 'rolling')
 

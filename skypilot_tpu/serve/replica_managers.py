@@ -81,18 +81,49 @@ class ReplicaManager:
         # versions; blue_green pins traffic to the old set until the new
         # one can carry the full target.
         self.active_versions = {version}
+        # (task, spec, version) before the in-flight update, kept so a
+        # rollout whose new version can never pass probes can roll BACK
+        # instead of failing the still-serving service.
+        self._prev_version_state = None
 
     def reload(self, task: 'task_lib.Task', spec: spec_lib.ServiceSpec,
                version: int, update_mode: str) -> None:
         """Adopt a new service version (serve update). Running replicas
         keep their launch-time config; reconcile migrates them."""
+        self._prev_version_state = (self.task, self.spec, self.version)
         self.task = task
         self.spec = spec
         self.version = version
         self.update_mode = update_mode
         self.spot_placer = spot_placer_lib.SpotPlacer.from_task(spec, task)
+        self._probe_failure_streak = 0
         logger.info(f'Service {self.service_name!r} now targets version '
                     f'{version} ({update_mode}).')
+
+    def _rollback_update(self) -> None:
+        """Abort an update whose new version cannot come up: restore the
+        previous task/spec/version (in memory AND in the service record,
+        so a controller restart stays rolled back) and shed any
+        new-version replicas. Old replicas never stopped serving."""
+        import json as json_lib
+        task, spec, version = self._prev_version_state
+        failed_version = self.version
+        self.task, self.spec, self.version = task, spec, version
+        self._prev_version_state = None
+        self._probe_failure_streak = 0
+        self.active_versions = {version}
+        serve_state.update_service(
+            self.service_name,
+            task_config=json_lib.dumps(task.to_yaml_config()),
+            spec=json_lib.dumps(spec.to_yaml_config()),
+            version=version)
+        for rep in serve_state.get_replicas(self.service_name):
+            if (rep.get('version') or 1) >= failed_version:
+                self.terminate_replica(rep['replica_id'])
+        logger.warning(
+            f'Update of {self.service_name!r} to version {failed_version} '
+            f'ROLLED BACK: new-version replicas kept failing launch or '
+            f'readiness; still serving version {version}.')
 
     # ------------------------------------------------------------------
     # Launch / terminate
@@ -286,7 +317,13 @@ class ReplicaManager:
                 if probe_url(rep['url'], probe.path, probe.timeout_seconds):
                     serve_state.reset_replica_failures(self.service_name,
                                                        rid)
-                    self._probe_failure_streak = 0
+                    # Only a CURRENT-version success clears the churn
+                    # streak: during an update the healthy old replicas
+                    # pass probes every pass, and resetting on those
+                    # would make the cap unreachable while a broken new
+                    # version churns surge replicas forever.
+                    if (rep.get('version') or 1) >= self.version:
+                        self._probe_failure_streak = 0
                     if status is not ReplicaStatus.READY:
                         serve_state.set_replica_status(
                             self.service_name, rid, ReplicaStatus.READY)
@@ -320,13 +357,19 @@ class ReplicaManager:
         # streak resets on any successful probe, so preemption-replacement
         # churn doesn't trip it.
         cap = max(MAX_REPLACEMENTS_BEFORE_FAILED, 2 * target)
+        stale = [r for r in alive if (r.get('version') or 1) < self.version]
         if self._probe_failure_streak >= cap:
+            if stale and self._prev_version_state is not None:
+                # Mid-update churn: the NEW version can't come up while
+                # old replicas are healthy. Roll the update back instead
+                # of failing the whole (still-serving) service.
+                self._rollback_update()
+                return
             self.permanently_failed = (
                 f'{self._probe_failure_streak} consecutive replicas failed '
                 f'to launch or pass readiness probes; check the resources, '
                 f'run command and readiness_probe.')
             return
-        stale = [r for r in alive if (r.get('version') or 1) < self.version]
         if stale:
             self._reconcile_update(alive, stale, target)
             return

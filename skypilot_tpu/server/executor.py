@@ -50,7 +50,10 @@ class _InlineJob:
         import traceback
         from skypilot_tpu import config as config_lib
         from skypilot_tpu.server import registry
-        requests_lib.set_running(rec['request_id'], os.getpid())
+        # pid 0, NOT os.getpid(): the recorded pid is cancel_request's
+        # kill target, and in thread mode that would be the API server
+        # itself. 0 marks "no killable process" (cancel then refuses).
+        requests_lib.set_running(rec['request_id'], 0)
         handler, _ = registry.HANDLERS[rec['name']]
         try:
             payload = rec['payload']
@@ -109,7 +112,11 @@ class Scheduler:
 
 
 def cancel_request(request_id: str) -> bool:
-    """Kill the runner (if running) and mark the record CANCELLED."""
+    """Kill the runner (if running) and mark the record CANCELLED.
+
+    Thread-mode requests (pid recorded as 0) have no killable process:
+    once RUNNING they are uncancellable and this returns False; queued
+    ones cancel normally."""
     rec = requests_lib.get(request_id)
     if rec is None:
         return False
@@ -117,6 +124,8 @@ def cancel_request(request_id: str) -> bool:
     if status.is_terminal():
         return False
     pid = rec.get('pid')
+    if status is requests_lib.RequestStatus.RUNNING and not pid:
+        return False
     if pid:
         try:
             os.killpg(pid, signal.SIGTERM)

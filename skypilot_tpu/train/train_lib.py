@@ -114,8 +114,10 @@ def make_train_step(cfg: 'llama.LlamaConfig', mesh: Mesh,
     accumulates grads in one `lax.scan` before a single optimizer update —
     the global batch stays on the loader/step contract, only peak
     activation memory shrinks (activations live for one microbatch at a
-    time; with equal-size microbatches the update equals the dense step
-    exactly, asserted in tests/unit_tests/test_parallel.py).
+    time). Accumulation is token-weighted (each microbatch's mean-grad
+    scaled by its target-token count, normalized by the total), so the
+    update equals the dense step even when loss_mask counts differ across
+    microbatches (asserted in tests/unit_tests/test_llama.py).
     """
     rules = rules or sharding_lib.Rules()
     shardings = state_shardings(cfg, mesh, tx, rules)
@@ -159,16 +161,20 @@ def make_train_step(cfg: 'llama.LlamaConfig', mesh: Mesh,
                 else:
                     t, m = xs
                 g, loss, denom = _grads_of(state.params, t, m)
-                g_sum = jax.tree.map(jnp.add, g_sum, g)
-                # Token-weighted loss so masked microbatches average right.
+                # Token-weighted: each microbatch's mean-grad re-scales by
+                # its own target-token count so the final grads equal the
+                # dense full-batch mean — equal weighting per MICROBATCH
+                # would over-weight sparsely-masked microbatches' tokens.
+                g_sum = jax.tree.map(lambda s, gi: s + gi * denom, g_sum, g)
                 return (g_sum, l_sum + loss * denom, d_sum + denom), None
 
             g0 = jax.tree.map(jnp.zeros_like, state.params)
             xs = tok_m if mask_m is None else (tok_m, mask_m)
             (g_sum, l_sum, d_sum), _ = jax.lax.scan(
                 micro, (g0, jnp.zeros(()), jnp.zeros(())), xs)
-            grads = jax.tree.map(lambda g: g / a, g_sum)
-            loss = l_sum / jnp.maximum(d_sum, 1.0)
+            d_safe = jnp.maximum(d_sum, 1.0)
+            grads = jax.tree.map(lambda g: g / d_safe, g_sum)
+            loss = l_sum / d_safe
             denom = d_sum
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)

@@ -178,6 +178,39 @@ class TestServerLoad:
         # saturated by parallel test workers.
         assert rate > 6.5
 
+    def test_cancel_never_targets_the_server_process(self):
+        """Thread-mode requests record pid 0: cancelling a RUNNING one
+        must refuse (no killable process) rather than SIGTERM the pid in
+        the record — which would be the API server itself."""
+        from skypilot_tpu.server import executor as executor_lib
+        rid = requests_lib.create('load_slow', {'t': 3.0},
+                                  requests_lib.LONG)
+        deadline = time.monotonic() + 30
+        while requests_lib.get(rid)['status'] != 'RUNNING':
+            assert time.monotonic() < deadline
+            time.sleep(0.05)
+        rec = requests_lib.get(rid)
+        assert not rec['pid'], rec   # never the server's own pid
+        assert executor_lib.cancel_request(rid) is False
+        # The request (and this process) survive; it completes normally.
+        deadline = time.monotonic() + 30
+        while not requests_lib.RequestStatus(
+                requests_lib.get(rid)['status']).is_terminal():
+            assert time.monotonic() < deadline
+            time.sleep(0.1)
+        assert requests_lib.get(rid)['status'] == 'SUCCEEDED'
+        # A still-queued request cancels fine in thread mode: saturate the
+        # LONG lane so at least one stays NEW.
+        ids = [requests_lib.create('load_slow', {'t': 5.0},
+                                   requests_lib.LONG)
+               for _ in range(executor_lib.LONG_PARALLELISM + 1)]
+        time.sleep(0.1)
+        new_ones = [r for r in ids
+                    if requests_lib.get(r)['status'] == 'NEW']
+        assert new_ones, [requests_lib.get(r)['status'] for r in ids]
+        assert executor_lib.cancel_request(new_ones[0]) is True
+        assert requests_lib.get(new_ones[0])['status'] == 'CANCELLED'
+
     def test_sustained_load_memory_and_record_growth(self):
         """sys_profiling analog (reference tests/load_tests/
         sys_profiling.py monitors API-server memory): three waves of

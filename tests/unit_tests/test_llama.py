@@ -138,6 +138,34 @@ class TestTrainStep:
                 params)))
             assert err < 1e-5
 
+    def test_grad_accum_token_weighted_under_mask(self):
+        """Unequal loss_mask counts across microbatches: accumulation
+        must weight TOKENS equally (like the dense step), not
+        microbatches — microbatch A with 10x the targets of B must
+        contribute 10x the gradient mass."""
+        mesh = build_mesh(MeshSpec(fsdp=1), devices=jax.devices('cpu')[:1])
+        tx = train_lib.default_optimizer(learning_rate=1e-2, warmup_steps=1,
+                                         total_steps=100)
+        batch = train_lib.synthetic_batch(jax.random.PRNGKey(1), 8, 32,
+                                          CFG.vocab_size)
+        mask = jnp.zeros((8, 32), jnp.float32)
+        # First half of the batch: all 32 targets; second half: only 3.
+        mask = mask.at[:4, :].set(1.0).at[4:, :3].set(1.0)
+        batch = dict(batch, loss_mask=mask)
+        results = []
+        for accum in (1, 2):
+            state = train_lib.init_train_state(jax.random.PRNGKey(0), CFG,
+                                               mesh, tx)
+            step = train_lib.make_train_step(CFG, mesh, tx,
+                                             grad_accum_steps=accum)
+            state, m = step(state, batch)
+            results.append((state.params, float(m['loss'])))
+        (p_ref, loss_ref), (p_acc, loss_acc) = results
+        assert abs(loss_acc - loss_ref) < 1e-4
+        err = max(jax.tree.leaves(jax.tree.map(
+            lambda a, b: float(jnp.max(jnp.abs(a - b))), p_ref, p_acc)))
+        assert err < 1e-5
+
     def test_sequence_parallel_matches_dp(self):
         """Same batch, same init: sp=4 mesh must produce the same loss as
         dp-only (GSPMD inserts the collectives; numerics match to bf16)."""

@@ -385,6 +385,56 @@ class TestServeEndToEnd:
         with pytest.raises(ValueError, match='serve up'):
             sky.launch(_service_task(), cluster_name='nope')
 
+    def test_broken_update_rolls_back(self):
+        """An update whose new version never passes probes must roll BACK
+        (version reverts, old replicas keep serving) — not fail the
+        still-healthy service and not churn surge replicas forever."""
+        def _spec(port, grace):
+            return {
+                'readiness_probe': {'path': '/health',
+                                    'initial_delay_seconds': grace,
+                                    'timeout_seconds': 2},
+                'replicas': 1,
+                'ports': port,
+                'load_balancing_policy': 'round_robin',
+            }
+        port = _worker_port_base() + 70
+        task = sky.Task(name='rbk', run=_REPLICA_APP)
+        task.set_resources(sky.Resources(accelerators='tpu-v5e-8'))
+        # Real app: generous grace so v1 comes up even on a loaded box.
+        task.service_spec = _spec(port, 30)
+        info = serve_core.up(task, lb_port=_worker_port_base() + 53)
+        name = info['name']
+        try:
+            serve_core.wait_until(name, {ServiceStatus.READY}, timeout=120)
+            _wait_ready_replicas(name, 1)
+
+            bad = sky.Task(name='rbk', run='exit 1')   # never serves
+            bad.set_resources(sky.Resources(accelerators='tpu-v5e-8'))
+            # Tight grace on the doomed version so churn-to-cap is fast.
+            bad.service_spec = _spec(port, 1)
+            serve_core.update(bad, name, mode='rolling')
+            assert serve_state.get_service(name)['version'] == 2
+
+            # The rollout must abort: version reverts to 1 in the record.
+            deadline = time.time() + 180
+            while time.time() < deadline:
+                rec = serve_state.get_service(name)
+                if int(rec.get('version') or 1) == 1:
+                    break
+                assert rec['status'] is not ServiceStatus.FAILED, \
+                    rec.get('failure_reason')
+                time.sleep(0.5)
+            else:
+                raise TimeoutError(serve_state.get_service(name))
+            # Old replica never stopped serving; no v2 replicas remain.
+            _wait_ready_replicas(name, 1)
+            reps = serve_state.get_replicas(name)
+            assert all((r.get('version') or 1) == 1 for r in reps)
+            assert _get(info['endpoint'] + '/v')['version'] == '1'
+        finally:
+            serve_core.down(name)
+
     def test_rolling_update_replaces_without_downtime(self):
         """serve update --mode rolling: replicas migrate one at a time,
         the LB answers throughout, and traffic ends on the new version."""
