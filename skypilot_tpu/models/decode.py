@@ -63,6 +63,10 @@ def _qkv(x: jnp.ndarray, lp, cfg: llama.LlamaConfig, sin, cos):
     q = jnp.einsum('bsd,dh->bsh', h, lp['wq'].astype(cfg.dtype))
     k = jnp.einsum('bsd,dh->bsh', h, lp['wk'].astype(cfg.dtype))
     v = jnp.einsum('bsd,dh->bsh', h, lp['wv'].astype(cfg.dtype))
+    if cfg.qkv_bias:
+        q = q + lp['bq'].astype(cfg.dtype)
+        k = k + lp['bk'].astype(cfg.dtype)
+        v = v + lp['bv'].astype(cfg.dtype)
     q = q.reshape(b, s, cfg.n_heads, hd)
     k = k.reshape(b, s, cfg.n_kv_heads, hd)
     v = v.reshape(b, s, cfg.n_kv_heads, hd)

@@ -57,6 +57,8 @@ class LlamaConfig:
     scan_layers: bool = True
     pipeline_stages: int = 1                # >1: GPipe over the 'stage' axis
     num_microbatches: int = 1               # PP microbatches (divides batch)
+    # Qwen2-family attention: biases on the q/k/v projections only.
+    qkv_bias: bool = False
 
     def __post_init__(self):
         if isinstance(self.rope_scaling, dict):
@@ -73,6 +75,8 @@ class LlamaConfig:
         a = 4 if self.n_kv_heads == self.n_heads else 2 + 2 * (
             self.n_kv_heads / self.n_heads)
         attn = int(a * self.dim * self.n_heads * self.hd)
+        if self.qkv_bias:
+            attn += (self.n_heads + 2 * self.n_kv_heads) * self.hd
         mlp = 3 * self.dim * self.ffn_dim
         per_layer = attn + mlp + 2 * self.dim
         embed = self.vocab_size * self.dim * (1 if self.tie_embeddings else 2)
@@ -100,6 +104,16 @@ PRESETS: Dict[str, LlamaConfig] = {
     'llama2-7b': LlamaConfig(vocab_size=32000, dim=4096, n_layers=32,
                              n_heads=32, n_kv_heads=32, ffn_dim=11008,
                              rope_theta=10000.0, max_seq_len=4096),
+    # Qwen2/2.5 family (reference serves these via vLLM recipes,
+    # llm/qwen/): same decoder as Llama plus q/k/v projection biases.
+    'qwen2-7b': LlamaConfig(vocab_size=152064, dim=3584, n_layers=28,
+                            n_heads=28, n_kv_heads=4, ffn_dim=18944,
+                            rope_theta=1e6, rms_eps=1e-6,
+                            max_seq_len=32768, qkv_bias=True),
+    'qwen2-72b': LlamaConfig(vocab_size=152064, dim=8192, n_layers=80,
+                             n_heads=64, n_kv_heads=8, ffn_dim=29568,
+                             rope_theta=1e6, rms_eps=1e-6,
+                             max_seq_len=32768, qkv_bias=True),
 }
 
 
@@ -131,6 +145,13 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
         },
         'final_norm': jnp.ones((D,), cfg.param_dtype),
     }
+    if cfg.qkv_bias:
+        params['layers']['bq'] = jnp.zeros((L, cfg.n_heads * hd),
+                                           cfg.param_dtype)
+        params['layers']['bk'] = jnp.zeros((L, cfg.n_kv_heads * hd),
+                                           cfg.param_dtype)
+        params['layers']['bv'] = jnp.zeros((L, cfg.n_kv_heads * hd),
+                                           cfg.param_dtype)
     if not cfg.tie_embeddings:
         params['lm_head'] = init(next(k), (D, cfg.vocab_size))
     return params
@@ -158,6 +179,10 @@ def param_specs(cfg: LlamaConfig,
         },
         'final_norm': s('norm'),
     }
+    if cfg.qkv_bias:
+        specs['layers']['bq'] = s('layers', 'heads')
+        specs['layers']['bk'] = s('layers', 'kv_heads')
+        specs['layers']['bv'] = s('layers', 'kv_heads')
     if not cfg.tie_embeddings:
         specs['lm_head'] = s('embed', 'vocab')
     return specs
@@ -246,6 +271,10 @@ def attention_block(x: jnp.ndarray, lp: Params, cfg: LlamaConfig,
     q = jnp.einsum('bsd,dh->bsh', h, lp['wq'].astype(cfg.dtype))
     kk = jnp.einsum('bsd,dh->bsh', h, lp['wk'].astype(cfg.dtype))
     vv = jnp.einsum('bsd,dh->bsh', h, lp['wv'].astype(cfg.dtype))
+    if cfg.qkv_bias:
+        q = q + lp['bq'].astype(cfg.dtype)
+        kk = kk + lp['bk'].astype(cfg.dtype)
+        vv = vv + lp['bv'].astype(cfg.dtype)
     q = q.reshape(b, s_len, cfg.n_heads, hd)
     kk = kk.reshape(b, s_len, cfg.n_kv_heads, hd)
     vv = vv.reshape(b, s_len, cfg.n_kv_heads, hd)

@@ -85,6 +85,45 @@ def test_param_specs_structure(params):
     assert flat_p == flat_s
 
 
+def test_qwen_qkv_bias_family():
+    """Qwen2-family decoders = Llama + q/k/v biases: params exist, are
+    sharded over the head axes, affect the forward, and decode stays
+    exactly equivalent to the full forward."""
+    import dataclasses as dc
+    from skypilot_tpu.models import decode
+    cfg = dc.replace(CFG, qkv_bias=True, dtype=jnp.float32)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    assert params['layers']['bq'].shape == (cfg.n_layers,
+                                            cfg.n_heads * cfg.hd)
+    specs = llama.param_specs(cfg)
+    # 'heads' resolves to the tensor mesh axis under the default rules.
+    assert 'tensor' in jax.tree.leaves(tuple(specs['layers']['bq']))
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                cfg.vocab_size, jnp.int32)
+    base = llama.forward(params, tokens, cfg)
+    # A nonzero bias must change the logits (it's actually applied).
+    bumped = dict(params, layers=dict(params['layers'],
+                                      bq=params['layers']['bq'] + 1.0))
+    assert not np.allclose(np.asarray(base),
+                           np.asarray(llama.forward(bumped, tokens, cfg)))
+    # Decode parity with biases in play.
+    last, cache = decode.prefill(bumped, tokens, cfg, max_len=32)
+    np.testing.assert_allclose(
+        np.asarray(last),
+        np.asarray(llama.forward(bumped, tokens, cfg)[:, -1]),
+        rtol=2e-4, atol=2e-4)
+    nxt = jnp.argmax(last, axis=-1).astype(jnp.int32)
+    step_logits, _ = decode.decode_step(bumped, nxt, cache, cfg)
+    seq = jnp.concatenate([tokens, nxt[:, None]], axis=1)
+    np.testing.assert_allclose(
+        np.asarray(step_logits),
+        np.asarray(llama.forward(bumped, seq, cfg)[:, -1]),
+        rtol=2e-4, atol=2e-4)
+    # Presets advertise the family.
+    assert llama.PRESETS['qwen2-7b'].qkv_bias
+    assert llama.PRESETS['qwen2-7b'].num_params > 7e9
+
+
 def test_validate_divisibility():
     with pytest.raises(ValueError):
         llama.validate_divisibility(CFG, {'tensor': 3})
