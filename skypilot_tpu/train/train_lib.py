@@ -197,6 +197,39 @@ def make_train_step(cfg: 'llama.LlamaConfig', mesh: Mesh,
     return wrapped
 
 
+def make_eval_step(cfg: 'llama.LlamaConfig', mesh: Mesh,
+                   rules: Optional[sharding_lib.Rules] = None
+                   ) -> Callable[[Any, Batch], jnp.ndarray]:
+    """Jitted forward-only loss: (params, batch) → scalar mean CE.
+
+    The held-out metric for the trainer's --eval-data loop; shares the
+    model forward and sharding rules with the train step (no dropout /
+    no optimizer, so eval loss is deterministic given the batch)."""
+    rules = rules or sharding_lib.Rules()
+    mod = models_lib.module_for(cfg)
+
+    def eval_fn(params, batch: Batch):
+        tokens = batch['tokens']
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        if getattr(mod, 'HAS_AUX', False):
+            logits, _ = mod.forward(params, inputs, cfg, rules,
+                                    return_aux=True)
+        else:
+            logits = mod.forward(params, inputs, cfg, rules)
+        loss, _ = cross_entropy_loss(logits, targets,
+                                     batch.get('loss_mask'))
+        return loss
+
+    jitted = jax.jit(eval_fn,
+                     out_shardings=NamedSharding(mesh, PartitionSpec()))
+
+    def wrapped(params, batch):
+        with mesh_lib.use_mesh(mesh):
+            return jitted(params, batch)
+
+    return wrapped
+
+
 def synthetic_batch(rng: jax.Array, batch_size: int, seq_len: int,
                     vocab_size: int) -> Batch:
     tokens = jax.random.randint(rng, (batch_size, seq_len + 1), 0, vocab_size,

@@ -42,6 +42,11 @@ class TrainerConfig:
     # >1: split each global batch into this many sequentially-accumulated
     # microbatches (same update, lower peak activation memory).
     grad_accum_steps: int = 1
+    # Held-out evaluation: a separate corpus evaluated every eval_every
+    # steps over eval_batches deterministic step-indexed batches.
+    eval_data_path: Optional[str] = None
+    eval_every: int = 50
+    eval_batches: int = 8
 
 
 def maybe_init_distributed() -> None:
@@ -134,6 +139,25 @@ def train(tcfg: TrainerConfig) -> List[Dict[str, float]]:
         cfg, mesh, tx, grad_accum_steps=tcfg.grad_accum_steps)
     batches = _batch_iter(tcfg, cfg.vocab_size, start_step, mesh)
 
+    eval_fn = None
+    if tcfg.eval_data_path:
+        from skypilot_tpu.data import loader as loader_lib
+        eval_tokens = loader_lib.load_tokens(tcfg.eval_data_path,
+                                             tcfg.tokenizer)
+        eval_step = train_lib.make_eval_step(cfg, mesh)
+
+        def eval_fn():
+            # Fixed batches 0..K-1 of the eval corpus: the metric is
+            # comparable across steps AND across resumed runs.
+            total = 0.0
+            for i in range(tcfg.eval_batches):
+                eb = loader_lib.batch_at_step(eval_tokens, i,
+                                              tcfg.batch_size,
+                                              tcfg.seq_len)
+                eb = loader_lib.shard_batch({'tokens': eb}, mesh)
+                total += float(eval_step(state.params, eb))
+            return total / tcfg.eval_batches
+
     history: List[Dict[str, float]] = []
     t_last = time.perf_counter()
     steps_since_log = 0
@@ -151,6 +175,10 @@ def train(tcfg: TrainerConfig) -> List[Dict[str, float]]:
                     'sec_per_step': round(
                         (now - t_last) / steps_since_log, 4),
                 }
+                if eval_fn is not None and \
+                        (step + 1) % tcfg.eval_every == 0:
+                    rec['eval_loss'] = round(eval_fn(), 4)
+                    now = time.perf_counter()   # exclude eval time
                 t_last = now
                 steps_since_log = 0
                 history.append(rec)
@@ -186,6 +214,11 @@ def main() -> None:
     parser.add_argument('--grad-accum', type=int, default=1,
                         help='Accumulate grads over N microbatches per '
                              'optimizer step (lower peak memory).')
+    parser.add_argument('--eval-data', default=None,
+                        help='Held-out corpus; eval loss is logged every '
+                             '--eval-every steps.')
+    parser.add_argument('--eval-every', type=int, default=50)
+    parser.add_argument('--eval-batches', type=int, default=8)
     args = parser.parse_args()
 
     def _parse_kv(items):
@@ -212,7 +245,9 @@ def main() -> None:
         total_steps=args.steps, learning_rate=args.lr,
         log_every=args.log_every, data_path=args.data,
         tokenizer=args.tokenizer, ckpt_dir=args.ckpt_dir,
-        ckpt_every=args.ckpt_every, grad_accum_steps=args.grad_accum)
+        ckpt_every=args.ckpt_every, grad_accum_steps=args.grad_accum,
+        eval_data_path=args.eval_data, eval_every=args.eval_every,
+        eval_batches=args.eval_batches)
     train(tcfg)
 
 

@@ -223,6 +223,23 @@ class TestTrainStep:
             losses.append(float(m['loss']))
         assert abs(losses[0] - losses[1]) < 1e-2
 
+    def test_eval_step_deterministic_forward_only(self):
+        mesh = build_mesh(MeshSpec(fsdp=1), devices=jax.devices('cpu')[:1])
+        tx = train_lib.default_optimizer(learning_rate=1e-2, warmup_steps=1,
+                                         total_steps=100)
+        state = train_lib.init_train_state(jax.random.PRNGKey(0), CFG,
+                                           mesh, tx)
+        ev = train_lib.make_eval_step(CFG, mesh)
+        batch = train_lib.synthetic_batch(jax.random.PRNGKey(1), 4, 32,
+                                          CFG.vocab_size)
+        l1, l2 = float(ev(state.params, batch)), float(ev(state.params,
+                                                          batch))
+        assert l1 == l2            # no dropout/optimizer: deterministic
+        # Matches the train step's loss metric on the same params/batch.
+        step = train_lib.make_train_step(CFG, mesh, tx)
+        _, m = step(state, batch)
+        assert abs(float(m['loss']) - l1) < 1e-4
+
     def test_loss_mask(self):
         mesh = build_mesh(MeshSpec(fsdp=1),
                           devices=jax.devices('cpu')[:1])
