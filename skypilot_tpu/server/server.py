@@ -247,9 +247,13 @@ async def dashboard_summary(request: web.Request) -> web.Response:
     services = []
     for s in serve_state.get_services():
         reps = serve_state.get_replicas(s['name'])
+        is_pool = bool((s['spec'] or {}).get('pool'))
         services.append({
             'name': s['name'], 'status': s['status'].value,
-            'endpoint': f"http://127.0.0.1:{s['lb_port']}",
+            'endpoint': (None if is_pool else
+                         f"http://127.0.0.1:{s['lb_port']}"),
+            'pool': is_pool,
+            'version': int(s.get('version') or 1),
             'ready_replicas': sum(
                 1 for r in reps
                 if r['status'] is serve_state.ReplicaStatus.READY),
