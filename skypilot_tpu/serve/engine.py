@@ -1,0 +1,227 @@
+"""Native inference engine: HTTP server over the KV-cache decode path.
+
+Reference analog: the reference serves TPU models through external
+engines (JetStream/vLLM recipes, examples/tpu/v6e/README.md:119-127);
+this framework owns the model code, so the engine is native and ~200
+lines: aiohttp front, a dynamic batcher, and models/decode.py underneath.
+
+TPU-first design:
+  - **Bucketed dynamic batching**: concurrent requests are grouped
+    within a small window; a group shares one `decode.generate` call.
+    Static shapes rule on TPU, so groups are keyed by (prompt_len,
+    max_new_tokens bucket) — each key compiles once and is cached by jax
+    forever after. Unequal prompt lengths never share a group (ragged
+    prefill would need per-row cache lengths; documented future work).
+  - **Byte-level text mode**: POST {'text': ...} uses the hermetic
+    byte tokenizer (data/loader.py), so the engine serves text without
+    downloads; token mode ({'tokens': [...]}) is the raw interface.
+  - **Checkpoint loading**: --ckpt-dir restores trainer checkpoints
+    (orbax, train/checkpoints.py) so `skytpu jobs launch` training and
+    `skytpu serve up` serving share weights end-to-end.
+
+Run: python -m skypilot_tpu.serve.engine --model llama-1b --port 8000
+(the serve plane sets $SKYTPU_SERVE_PORT; see examples/serve-llama-1b).
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+MAX_BATCH = int(os.environ.get('SKYTPU_ENGINE_MAX_BATCH', '8'))
+BATCH_WINDOW_S = float(os.environ.get('SKYTPU_ENGINE_BATCH_WINDOW', '0.01'))
+
+
+def _bucket_new_tokens(n: int) -> int:
+    """Round max_new_tokens up to a power of two (bounded compile count)."""
+    b = 16
+    while b < n:
+        b *= 2
+    return b
+
+
+class InferenceEngine:
+    """Owns params + the batched generate loop."""
+
+    def __init__(self, model: str, ckpt_dir: Optional[str] = None,
+                 max_len: Optional[int] = None):
+        import jax
+        import jax.numpy as jnp
+        from skypilot_tpu.models import decode as decode_lib
+        from skypilot_tpu.models import get_config, module_for
+        self._jnp = jnp
+        self._decode = decode_lib
+        self.cfg = get_config(model)
+        self.max_len = max_len or min(self.cfg.max_seq_len, 2048)
+        if ckpt_dir:
+            from skypilot_tpu.parallel import MeshSpec, build_mesh
+            from skypilot_tpu.train import checkpoints, train_lib
+            mesh = build_mesh(MeshSpec())
+            tx = train_lib.default_optimizer(learning_rate=1e-4,
+                                             warmup_steps=1, total_steps=2)
+            with checkpoints.Checkpointer(ckpt_dir) as ckpt:
+                state = ckpt.restore(self.cfg, mesh, tx)
+                if state is None:
+                    raise FileNotFoundError(
+                        f'No checkpoint under {ckpt_dir!r}.')
+                params = state.params
+            logger.info(f'Restored checkpoint step {int(state.step)} '
+                        f'from {ckpt_dir}.')
+        else:
+            mod = module_for(self.cfg)
+            params = jax.jit(lambda r: mod.init_params(r, self.cfg))(
+                jax.random.PRNGKey(0))
+            logger.info('No --ckpt-dir: serving randomly-initialized '
+                        'params (benchmark/demo mode).')
+        self.params = decode_lib.cast_params_for_decode(params, self.cfg)
+        # Created by start() on the SERVING event loop: an asyncio.Queue
+        # binds to the loop that first awaits it, and the engine object
+        # may outlive a loop (tests; server restarts).
+        self._queue: Optional[asyncio.Queue] = None
+        self.warm = False
+
+    def start(self) -> None:
+        """Bind the batcher to the current event loop (call at server
+        startup)."""
+        self._queue = asyncio.Queue()
+        asyncio.create_task(self.batch_loop())
+
+    def warmup(self) -> None:
+        jnp = self._jnp
+        self._decode.generate(self.params, jnp.zeros((1, 8), jnp.int32),
+                              self.cfg, 16, max_len=self.max_len)
+        self.warm = True
+        logger.info('Engine warm (first generate compiled).')
+
+    # -- batching ----------------------------------------------------------
+    async def submit(self, tokens: List[int], max_new: int,
+                     temperature: float, top_k: Optional[int],
+                     top_p: Optional[float]) -> List[int]:
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        await self._queue.put((tokens, max_new, temperature, top_k, top_p,
+                               fut))
+        return await fut
+
+    async def batch_loop(self) -> None:
+        """Group compatible requests, run one generate per group."""
+        while True:
+            first = await self._queue.get()
+            group = [first]
+            deadline = time.monotonic() + BATCH_WINDOW_S
+            while len(group) < MAX_BATCH:
+                timeout = deadline - time.monotonic()
+                if timeout <= 0:
+                    break
+                try:
+                    item = await asyncio.wait_for(self._queue.get(),
+                                                  timeout)
+                except asyncio.TimeoutError:
+                    break
+                # Same prompt length and sampling params → same compiled
+                # program and one shared RNG stream; anything else goes
+                # back on the queue for the next group.
+                if (len(item[0]) == len(first[0]) and
+                        item[2:5] == first[2:5]):
+                    group.append(item)
+                else:
+                    await self._queue.put(item)
+                    break
+            await self._run_group(group)
+
+    async def _run_group(self, group) -> None:
+        jnp = self._jnp
+        tokens = jnp.asarray([g[0] for g in group], jnp.int32)
+        max_new = _bucket_new_tokens(max(g[1] for g in group))
+        _, _, temperature, top_k, top_p, _ = group[0]
+        import jax
+        try:
+            out = await asyncio.to_thread(
+                self._decode.generate, self.params, tokens, self.cfg,
+                max_new, max_len=self.max_len, temperature=temperature,
+                top_k=top_k, top_p=top_p,
+                rng=jax.random.PRNGKey(int(time.time_ns()) % (2**31)))
+            out = jax.device_get(out)
+            for i, (_, want_new, *_rest, fut) in enumerate(group):
+                if not fut.done():
+                    fut.set_result([int(t) for t in out[i][:want_new]])
+        except Exception as e:  # pylint: disable=broad-except
+            for *_a, fut in group:
+                if not fut.done():
+                    fut.set_exception(e)
+
+
+def build_app(engine: InferenceEngine):
+    from aiohttp import web
+
+    async def health(request):
+        del request
+        if not engine.warm:
+            return web.json_response({'status': 'warming'}, status=503)
+        return web.json_response({'status': 'ok'})
+
+    async def generate(request):
+        body = await request.json()
+        if 'text' in body:
+            from skypilot_tpu.data import loader as loader_lib
+            tokens = [int(t) for t in
+                      loader_lib.tokenize_text(body['text'])]
+        else:
+            tokens = [int(t) for t in body['tokens']]
+        if not tokens:
+            return web.json_response({'error': 'empty prompt'}, status=400)
+        max_new = int(body.get('max_new_tokens', 64))
+        if len(tokens) + max_new > engine.max_len:
+            return web.json_response(
+                {'error': f'prompt+max_new_tokens exceeds max_len '
+                          f'{engine.max_len}'}, status=400)
+        top_k = body.get('top_k')
+        top_p = body.get('top_p')
+        out = await engine.submit(
+            tokens, max_new, float(body.get('temperature', 0.0)),
+            int(top_k) if top_k is not None else None,
+            float(top_p) if top_p is not None else None)
+        resp: Dict[str, Any] = {'tokens': out}
+        if 'text' in body:
+            resp['text'] = bytes(t for t in out if t < 256).decode(
+                'utf-8', errors='replace')
+        return web.json_response(resp)
+
+    app = web.Application()
+    app.router.add_get('/health', health)
+    app.router.add_get('/', health)
+    app.router.add_post('/generate', generate)
+
+    async def _start(app_):
+        del app_
+        engine.start()
+
+    app.on_startup.append(_start)
+    return app
+
+
+def main() -> None:
+    from aiohttp import web
+    parser = argparse.ArgumentParser(prog='skytpu-engine')
+    parser.add_argument('--model', default='llama-1b')
+    parser.add_argument('--ckpt-dir', default=None)
+    parser.add_argument('--max-len', type=int, default=None)
+    parser.add_argument('--port', type=int,
+                        default=int(os.environ.get('SKYTPU_SERVE_PORT',
+                                                   '8000')))
+    parser.add_argument('--host', default='0.0.0.0')
+    args = parser.parse_args()
+    engine = InferenceEngine(args.model, ckpt_dir=args.ckpt_dir,
+                             max_len=args.max_len)
+    engine.warmup()   # readiness flips only once serving is fast
+    web.run_app(build_app(engine), host=args.host, port=args.port,
+                print=None)
+
+
+if __name__ == '__main__':
+    main()
