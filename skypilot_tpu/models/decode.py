@@ -36,7 +36,10 @@ from skypilot_tpu.parallel import sharding as sharding_lib
 class KVCache:
     k: jnp.ndarray        # [L, B, T, KH, hd]
     v: jnp.ndarray        # [L, B, T, KH, hd]
-    length: jnp.ndarray   # scalar int32: valid prefix length
+    length: jnp.ndarray   # [B] int32: valid prefix length PER ROW —
+    #                       ragged batches (mixed prompt lengths) share
+    #                       one cache; pad slots are causally masked and
+    #                       overwritten before they are ever attended.
 
 
 def cast_params_for_decode(params, cfg: llama.LlamaConfig):
@@ -52,7 +55,7 @@ def init_cache(cfg: llama.LlamaConfig, batch: int, max_len: int) -> KVCache:
     shape = (cfg.n_layers, batch, max_len, cfg.n_kv_heads, cfg.hd)
     return KVCache(k=jnp.zeros(shape, cfg.dtype),
                    v=jnp.zeros(shape, cfg.dtype),
-                   length=jnp.zeros((), jnp.int32))
+                   length=jnp.zeros((batch,), jnp.int32))
 
 
 def _qkv(x: jnp.ndarray, lp, cfg: llama.LlamaConfig, sin, cos):
@@ -103,14 +106,28 @@ def _unembed(x: jnp.ndarray, params, cfg: llama.LlamaConfig) -> jnp.ndarray:
 
 
 def prefill(params, tokens: jnp.ndarray, cfg: llama.LlamaConfig,
-            max_len: int, rules: Optional[sharding_lib.Rules] = None
+            max_len: int, rules: Optional[sharding_lib.Rules] = None,
+            lengths: Optional[jnp.ndarray] = None
             ) -> Tuple[jnp.ndarray, KVCache]:
-    """Process the prompt in one pass. tokens [B, S] → (last-position
-    logits [B, vocab], filled cache with length=S)."""
+    """Process the prompt in one pass. tokens [B, S] → (per-row
+    last-content-position logits [B, vocab], filled cache).
+
+    `lengths` [B] enables RAGGED batches: rows are right-padded to S,
+    content occupies [0, lengths[b]). Causality already keeps content
+    positions from attending the later pad positions, pad K/V beyond a
+    row's length is masked during decode (per-row q_offset) and each
+    decode step overwrites its own slot before attending it — so no
+    padding mask is needed anywhere. MoE caveat: pad tokens still route
+    (and can consume expert capacity within their row's groups) during
+    a ragged prefill; with the default min-8 capacity this only matters
+    when capacity binds — use uniform-length batches when bit-exact MoE
+    prefill equivalence is required."""
     rules = rules or sharding_lib.Rules()
     b, s = tokens.shape
     if s > max_len:
         raise ValueError(f'prompt length {s} exceeds cache max_len {max_len}')
+    lengths = (jnp.full((b,), s, jnp.int32) if lengths is None
+               else jnp.asarray(lengths, jnp.int32))
     x = jnp.take(params['embed'], tokens, axis=0).astype(cfg.dtype)
     positions = jnp.arange(s)
     sin, cos = rotary.rope_frequencies(cfg.hd, positions, cfg.rope_theta,
@@ -132,8 +149,10 @@ def prefill(params, tokens: jnp.ndarray, cfg: llama.LlamaConfig,
     x, (ks, vs) = jax.lax.scan(body, x, params['layers'])
     pad = [(0, 0), (0, 0), (0, max_len - s), (0, 0), (0, 0)]
     cache = KVCache(k=jnp.pad(ks, pad), v=jnp.pad(vs, pad),
-                    length=jnp.asarray(s, jnp.int32))
-    logits = _unembed(x[:, -1:], params, cfg)
+                    length=lengths)
+    x_last = jnp.take_along_axis(
+        x, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1)
+    logits = _unembed(x_last, params, cfg)
     return logits[:, 0], cache
 
 
@@ -151,26 +170,31 @@ def decode_step(params, token: jnp.ndarray, cache: KVCache,
     """
     del rules
     b = token.shape[0]
-    length = cache.length
+    length = cache.length                                   # [B]
+    rows = jnp.arange(b)
     x = jnp.take(params['embed'], token[:, None], axis=0).astype(cfg.dtype)
-    sin, cos = rotary.rope_frequencies(cfg.hd, length[None], cfg.rope_theta,
-                                       cfg.rope_scaling)
+    # Per-row rope position: each row's new token sits at ITS length.
+    sin, cos = rotary.rope_frequencies(cfg.hd, length[:, None],
+                                       cfg.rope_theta, cfg.rope_scaling)
 
     def body(carry, xs):
         x_c, k_cache, v_cache = carry
         lp, layer_idx = xs
         q, k_new, v_new = _qkv(x_c, lp, cfg, sin, cos)
-        # Insert the new token's K/V at (layer_idx, :, length).
-        k_cache = jax.lax.dynamic_update_slice(
-            k_cache, k_new[None], (layer_idx, 0, length, 0, 0))
-        v_cache = jax.lax.dynamic_update_slice(
-            v_cache, v_new[None], (layer_idx, 0, length, 0, 0))
+        # Insert each row's new K/V at (layer_idx, b, length[b]) — a
+        # scatter over the row axis (ragged rows write different slots).
         k_l = jax.lax.dynamic_index_in_dim(k_cache, layer_idx, axis=0,
                                            keepdims=False)
         v_l = jax.lax.dynamic_index_in_dim(v_cache, layer_idx, axis=0,
                                            keepdims=False)
-        # q_offset=length masks kv positions > length, so the zero padding
-        # beyond the valid prefix never contributes.
+        k_l = k_l.at[rows, length].set(k_new[:, 0])
+        v_l = v_l.at[rows, length].set(v_new[:, 0])
+        k_cache = jax.lax.dynamic_update_index_in_dim(k_cache, k_l,
+                                                      layer_idx, axis=0)
+        v_cache = jax.lax.dynamic_update_index_in_dim(v_cache, v_l,
+                                                      layer_idx, axis=0)
+        # Per-row q_offset masks kv positions > length[b]: pad garbage
+        # beyond each row's valid prefix never contributes.
         out = _attention(q, k_l, v_l, impl='xla', causal=True,
                          q_offset=length, kv_offset=0)
         out = out.reshape(b, 1, cfg.n_heads * cfg.hd)
@@ -224,11 +248,15 @@ def generate(params, prompt: jnp.ndarray, cfg: llama.LlamaConfig,
              max_new_tokens: int, *, max_len: Optional[int] = None,
              temperature: float = 0.0, eos_id: Optional[int] = None,
              top_k: Optional[int] = None, top_p: Optional[float] = None,
+             prompt_lengths: Optional[jnp.ndarray] = None,
              rng: Optional[jax.Array] = None) -> jnp.ndarray:
     """Greedy/temperature/top-k/top-p generation, fully jitted.
 
     prompt [B, S] → generated tokens [B, max_new_tokens] (positions after an
-    eos are filled with eos).
+    eos are filled with eos). `prompt_lengths` [B] serves RAGGED batches:
+    rows right-padded to S generate from their own content length (the
+    dynamic batcher in serve/engine.py relies on this to group
+    mixed-length requests under one compiled program).
     """
     b, s = prompt.shape
     if max_len is None:
@@ -237,7 +265,8 @@ def generate(params, prompt: jnp.ndarray, cfg: llama.LlamaConfig,
         raise ValueError(
             f'prompt ({s}) + max_new_tokens ({max_new_tokens}) exceeds '
             f'max_len ({max_len})')
-    logits, cache = prefill(params, prompt, cfg, max_len)
+    logits, cache = prefill(params, prompt, cfg, max_len,
+                            lengths=prompt_lengths)
     if rng is None:
         rng = jax.random.PRNGKey(0)
     first = _select_token(logits, temperature, rng, top_k, top_p)

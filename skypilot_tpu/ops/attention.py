@@ -38,10 +38,18 @@ def _xla_attention_impl(q, k, v, causal, q_offset, kv_offset, segment_ids,
 
     mask = None
     if causal:
-        q_pos = jnp.arange(s) + q_offset
+        q_off = jnp.asarray(q_offset)
         kv_pos = jnp.arange(t) + kv_offset
-        mask = q_pos[:, None] >= kv_pos[None, :]          # [S,T]
-        mask = mask[None, None, None, :, :]
+        if q_off.ndim == 1:
+            # Per-row offsets [B] (ragged decode: each row's new token
+            # sits at its own cache length).
+            q_pos = jnp.arange(s)[None, :] + q_off[:, None]    # [B,S]
+            mask = (q_pos[:, :, None] >= kv_pos[None, None, :])
+            mask = mask[:, None, None, :, :]                   # [B,1,1,S,T]
+        else:
+            q_pos = jnp.arange(s) + q_off
+            mask = q_pos[:, None] >= kv_pos[None, :]           # [S,T]
+            mask = mask[None, None, None, :, :]
     if segment_ids is not None:
         q_seg, kv_seg = segment_ids
         seg_mask = (q_seg[:, :, None] == kv_seg[:, None, :])  # [B,S,T]

@@ -8,10 +8,11 @@ lines: aiohttp front, a dynamic batcher, and models/decode.py underneath.
 TPU-first design:
   - **Bucketed dynamic batching**: concurrent requests are grouped
     within a small window; a group shares one `decode.generate` call.
-    Static shapes rule on TPU, so groups are keyed by (prompt_len,
-    max_new_tokens bucket) — each key compiles once and is cached by jax
-    forever after. Unequal prompt lengths never share a group (ragged
-    prefill would need per-row cache lengths; documented future work).
+    Static shapes rule on TPU, so groups are keyed by (prompt-length
+    bucket, sampling params) — each key compiles once and is cached by
+    jax forever after. MIXED prompt lengths batch together: prompts are
+    right-padded to the bucket and models/decode.py's ragged path
+    (per-row cache lengths) makes padding invisible.
   - **Byte-level text mode**: POST {'text': ...} uses the hermetic
     byte tokenizer (data/loader.py), so the engine serves text without
     downloads; token mode ({'tokens': [...]}) is the raw interface.
@@ -38,9 +39,9 @@ MAX_BATCH = int(os.environ.get('SKYTPU_ENGINE_MAX_BATCH', '8'))
 BATCH_WINDOW_S = float(os.environ.get('SKYTPU_ENGINE_BATCH_WINDOW', '0.01'))
 
 
-def _bucket_new_tokens(n: int) -> int:
-    """Round max_new_tokens up to a power of two (bounded compile count)."""
-    b = 16
+def _bucket(n: int, floor: int = 16) -> int:
+    """Round up to a power of two (bounded compile count)."""
+    b = floor
     while b < n:
         b *= 2
     return b
@@ -123,10 +124,11 @@ class InferenceEngine:
                                                   timeout)
                 except asyncio.TimeoutError:
                     break
-                # Same prompt length and sampling params → same compiled
-                # program and one shared RNG stream; anything else goes
-                # back on the queue for the next group.
-                if (len(item[0]) == len(first[0]) and
+                # Same prompt-length BUCKET and sampling params → same
+                # compiled program (ragged right-padding inside the
+                # bucket); anything else goes back on the queue for the
+                # next group.
+                if (_bucket(len(item[0])) == _bucket(len(first[0])) and
                         item[2:5] == first[2:5]):
                     group.append(item)
                 else:
@@ -136,15 +138,19 @@ class InferenceEngine:
 
     async def _run_group(self, group) -> None:
         jnp = self._jnp
-        tokens = jnp.asarray([g[0] for g in group], jnp.int32)
-        max_new = _bucket_new_tokens(max(g[1] for g in group))
+        lens = [len(g[0]) for g in group]
+        s = _bucket(max(lens))
+        tokens = jnp.asarray(
+            [g[0] + [0] * (s - len(g[0])) for g in group], jnp.int32)
+        lengths = jnp.asarray(lens, jnp.int32)
+        max_new = min(_bucket(max(g[1] for g in group)), self.max_len - s)
         _, _, temperature, top_k, top_p, _ = group[0]
         import jax
         try:
             out = await asyncio.to_thread(
                 self._decode.generate, self.params, tokens, self.cfg,
                 max_new, max_len=self.max_len, temperature=temperature,
-                top_k=top_k, top_p=top_p,
+                top_k=top_k, top_p=top_p, prompt_lengths=lengths,
                 rng=jax.random.PRNGKey(int(time.time_ns()) % (2**31)))
             out = jax.device_get(out)
             for i, (_, want_new, *_rest, fut) in enumerate(group):
@@ -176,9 +182,16 @@ def build_app(engine: InferenceEngine):
         if not tokens:
             return web.json_response({'error': 'empty prompt'}, status=400)
         max_new = int(body.get('max_new_tokens', 64))
-        if len(tokens) + max_new > engine.max_len:
+        if max_new < 1:
+            return web.json_response({'error': 'max_new_tokens < 1'},
+                                     status=400)
+        # The batcher pads prompts up to a power-of-two bucket; admission
+        # is checked against the bucketed length so a grouped request can
+        # always be served in full.
+        if _bucket(len(tokens)) + max_new > engine.max_len:
             return web.json_response(
-                {'error': f'prompt+max_new_tokens exceeds max_len '
+                {'error': f'bucketed prompt ({_bucket(len(tokens))}) + '
+                          f'max_new_tokens exceeds max_len '
                           f'{engine.max_len}'}, status=400)
         top_k = body.get('top_k')
         top_p = body.get('top_p')

@@ -36,7 +36,7 @@ class TestDecode:
         np.testing.assert_allclose(np.asarray(last),
                                    np.asarray(full[:, -1]), rtol=2e-4,
                                    atol=2e-4)
-        assert int(cache.length) == 10
+        assert cache.length.tolist() == [10, 10]
         assert cache.k.shape == (cfg.n_layers, 2, 32, cfg.n_kv_heads, cfg.hd)
 
     def test_decode_step_matches_forward(self, model):
@@ -120,6 +120,37 @@ class TestDecode:
             rank = jnp.take_along_axis(
                 jnp.argsort(order, axis=-1), got[:, None], axis=-1)[:, 0]
             assert bool(jnp.all(rank <= nucleus_size))
+
+    def test_ragged_prefill_and_generate_match_solo(self, model):
+        """Per-row prompt lengths: a right-padded ragged batch must
+        produce, row for row, exactly what each prompt produces alone —
+        the contract serve/engine.py's mixed-length batching rests on."""
+        cfg, params = model
+        prompts = [[5, 6, 7], [1, 2, 3, 4, 5, 6, 7, 8], [9, 8, 7, 6, 5]]
+        s = max(len(p) for p in prompts)
+        padded = jnp.asarray([p + [0] * (s - len(p)) for p in prompts],
+                             jnp.int32)
+        lengths = jnp.asarray([len(p) for p in prompts], jnp.int32)
+
+        # Prefill logits at each row's last content position.
+        ragged_logits, cache = decode.prefill(params, padded, cfg,
+                                              max_len=32, lengths=lengths)
+        assert cache.length.tolist() == [3, 8, 5]
+        for i, p in enumerate(prompts):
+            solo, _ = decode.prefill(
+                params, jnp.asarray([p], jnp.int32), cfg, max_len=32)
+            np.testing.assert_allclose(np.asarray(ragged_logits[i]),
+                                       np.asarray(solo[0]), rtol=2e-4,
+                                       atol=2e-4)
+
+        # Full greedy generation, ragged batch vs solo rows.
+        got = decode.generate(params, padded, cfg, 6, max_len=32,
+                              prompt_lengths=lengths)
+        for i, p in enumerate(prompts):
+            want = decode.generate(params, jnp.asarray([p], jnp.int32),
+                                   cfg, 6, max_len=32)
+            np.testing.assert_array_equal(np.asarray(got[i]),
+                                          np.asarray(want[0]))
 
     def test_generate_with_sampling_filters(self, model):
         cfg, params = model

@@ -99,14 +99,28 @@ class TestEngine:
         # Fewer generate calls than requests → grouping happened.
         assert len(calls) < len(prompts), calls
 
-    def test_mixed_lengths_and_validation(self, engine):
+    def test_mixed_lengths_batch_together_and_validation(self, engine):
+        # Mixed prompt lengths inside one bucket (8 and 12 both bucket to
+        # 16) group into ONE ragged generate call and each row matches
+        # its solo result.
+        p_short, p_long = [1] * 8, [2] * 12
+        solo = {}
+        for key, p in (('s', p_short), ('l', p_long)):
+            solo[key] = np.asarray(decode.generate(
+                engine.params, jnp.asarray([p], jnp.int32), engine.cfg,
+                16, max_len=engine.max_len)[0][:4])
+
         async def fn(client):
             rs = await asyncio.gather(
-                client.post('/generate', json={'tokens': [1] * 8,
+                client.post('/generate', json={'tokens': p_short,
                                                'max_new_tokens': 4}),
-                client.post('/generate', json={'tokens': [2] * 12,
+                client.post('/generate', json={'tokens': p_long,
                                                'max_new_tokens': 4}))
             assert all(r.status == 200 for r in rs)
+            got_s = (await rs[0].json())['tokens']
+            got_l = (await rs[1].json())['tokens']
+            np.testing.assert_array_equal(np.asarray(got_s), solo['s'])
+            np.testing.assert_array_equal(np.asarray(got_l), solo['l'])
             bad = await client.post('/generate', json={
                 'tokens': [1] * 8, 'max_new_tokens': 10_000})
             assert bad.status == 400
