@@ -62,7 +62,8 @@ def _qkv(x: jnp.ndarray, lp, cfg: llama.LlamaConfig, sin, cos):
     """Shared with training math: norm → q/k/v projections → rope."""
     b, s, _ = x.shape
     hd = cfg.hd
-    h = norms.rms_norm(x, lp['attn_norm'], cfg.rms_eps)
+    h = norms.rms_norm(x, lp['attn_norm'], cfg.rms_eps,
+                       scale_plus_one=cfg.norm_plus_one)
     q = jnp.einsum('bsd,dh->bsh', h, lp['wq'].astype(cfg.dtype))
     k = jnp.einsum('bsd,dh->bsh', h, lp['wk'].astype(cfg.dtype))
     v = jnp.einsum('bsd,dh->bsh', h, lp['wv'].astype(cfg.dtype))
@@ -90,19 +91,25 @@ def _ffn(x: jnp.ndarray, lp, cfg: llama.LlamaConfig) -> jnp.ndarray:
         h = norms.rms_norm(x, lp['moe_norm'], cfg.rms_eps)
         y, _ = moe_lib.moe_ffn(h, lp, cfg, sharding_lib.Rules())
         return y
-    h = norms.rms_norm(x, lp['mlp_norm'], cfg.rms_eps)
+    h = norms.rms_norm(x, lp['mlp_norm'], cfg.rms_eps,
+                       scale_plus_one=cfg.norm_plus_one)
     gate = jnp.einsum('bsd,df->bsf', h, lp['w_gate'].astype(cfg.dtype))
     up = jnp.einsum('bsd,df->bsf', h, lp['w_up'].astype(cfg.dtype))
-    down = jnp.einsum('bsf,fd->bsd', jax.nn.silu(gate) * up,
+    down = jnp.einsum('bsf,fd->bsd', cfg.act(gate) * up,
                       lp['w_down'].astype(cfg.dtype))
     return down
 
 
 def _unembed(x: jnp.ndarray, params, cfg: llama.LlamaConfig) -> jnp.ndarray:
-    x = norms.rms_norm(x, params['final_norm'], cfg.rms_eps)
+    x = norms.rms_norm(x, params['final_norm'], cfg.rms_eps,
+                       scale_plus_one=cfg.norm_plus_one)
     head = (params['embed'].T if cfg.tie_embeddings else params['lm_head'])
-    return jnp.einsum('bsd,dv->bsv', x, head.astype(cfg.dtype),
-                      preferred_element_type=jnp.float32)
+    logits = jnp.einsum('bsd,dv->bsv', x, head.astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
+    if cfg.final_logit_softcap:
+        cap = cfg.final_logit_softcap
+        logits = cap * jnp.tanh(logits / cap)
+    return logits
 
 
 def prefill(params, tokens: jnp.ndarray, cfg: llama.LlamaConfig,
@@ -129,6 +136,8 @@ def prefill(params, tokens: jnp.ndarray, cfg: llama.LlamaConfig,
     lengths = (jnp.full((b,), s, jnp.int32) if lengths is None
                else jnp.asarray(lengths, jnp.int32))
     x = jnp.take(params['embed'], tokens, axis=0).astype(cfg.dtype)
+    if cfg.embed_scale:
+        x = x * jnp.asarray(cfg.dim ** 0.5, cfg.dtype)
     positions = jnp.arange(s)
     sin, cos = rotary.rope_frequencies(cfg.hd, positions, cfg.rope_theta,
                                        cfg.rope_scaling)
@@ -173,6 +182,8 @@ def decode_step(params, token: jnp.ndarray, cache: KVCache,
     length = cache.length                                   # [B]
     rows = jnp.arange(b)
     x = jnp.take(params['embed'], token[:, None], axis=0).astype(cfg.dtype)
+    if cfg.embed_scale:
+        x = x * jnp.asarray(cfg.dim ** 0.5, cfg.dtype)
     # Per-row rope position: each row's new token sits at ITS length.
     sin, cos = rotary.rope_frequencies(cfg.hd, length[:, None],
                                        cfg.rope_theta, cfg.rope_scaling)

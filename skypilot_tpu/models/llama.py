@@ -59,6 +59,18 @@ class LlamaConfig:
     num_microbatches: int = 1               # PP microbatches (divides batch)
     # Qwen2-family attention: biases on the q/k/v projections only.
     qkv_bias: bool = False
+    # Gemma-family knobs: norms scale by (1+w) with zero-init w, the MLP
+    # uses tanh-gelu gating, embeddings scale by sqrt(dim), and final
+    # logits are tanh-softcapped.
+    norm_plus_one: bool = False
+    mlp_activation: str = 'silu'            # 'silu' | 'gelu'
+    embed_scale: bool = False
+    final_logit_softcap: Optional[float] = None
+
+    def act(self, x):
+        if self.mlp_activation == 'gelu':
+            return jax.nn.gelu(x)           # tanh approximation (Gemma)
+        return jax.nn.silu(x)
 
     def __post_init__(self):
         if isinstance(self.rope_scaling, dict):
@@ -114,6 +126,22 @@ PRESETS: Dict[str, LlamaConfig] = {
                              n_heads=64, n_kv_heads=8, ffn_dim=29568,
                              rope_theta=1e6, rms_eps=1e-6,
                              max_seq_len=32768, qkv_bias=True),
+    # Gemma family (reference: llm/gemma/, llm/gemma3/ recipes): (1+w)
+    # norms, tanh-gelu MLP gating, sqrt(dim)-scaled embeddings, tied
+    # head; gemma2 additionally softcaps the final logits.
+    'gemma-7b': LlamaConfig(vocab_size=256000, dim=3072, n_layers=28,
+                            n_heads=16, n_kv_heads=16, head_dim=256,
+                            ffn_dim=24576, rope_theta=10000.0,
+                            rms_eps=1e-6, max_seq_len=8192,
+                            tie_embeddings=True, norm_plus_one=True,
+                            mlp_activation='gelu', embed_scale=True),
+    'gemma2-9b': LlamaConfig(vocab_size=256000, dim=3584, n_layers=42,
+                             n_heads=16, n_kv_heads=8, head_dim=256,
+                             ffn_dim=14336, rope_theta=10000.0,
+                             rms_eps=1e-6, max_seq_len=8192,
+                             tie_embeddings=True, norm_plus_one=True,
+                             mlp_activation='gelu', embed_scale=True,
+                             final_logit_softcap=30.0),
 }
 
 
@@ -130,20 +158,22 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
     trunc = jax.nn.initializers.variance_scaling(
         1.0, 'fan_in', 'truncated_normal', dtype=cfg.param_dtype)
     L, D, F = cfg.n_layers, cfg.dim, cfg.ffn_dim
+    # (1+w)-style norms carry their identity in the "+1": w inits to 0.
+    norm_init = jnp.zeros if cfg.norm_plus_one else jnp.ones
     params: Params = {
         'embed': init(next(k), (cfg.vocab_size, D)),
         'layers': {
-            'attn_norm': jnp.ones((L, D), cfg.param_dtype),
+            'attn_norm': norm_init((L, D), cfg.param_dtype),
             'wq': trunc(next(k), (L, D, cfg.n_heads * hd)),
             'wk': trunc(next(k), (L, D, cfg.n_kv_heads * hd)),
             'wv': trunc(next(k), (L, D, cfg.n_kv_heads * hd)),
             'wo': trunc(next(k), (L, cfg.n_heads * hd, D)),
-            'mlp_norm': jnp.ones((L, D), cfg.param_dtype),
+            'mlp_norm': norm_init((L, D), cfg.param_dtype),
             'w_gate': trunc(next(k), (L, D, F)),
             'w_up': trunc(next(k), (L, D, F)),
             'w_down': trunc(next(k), (L, F, D)),
         },
-        'final_norm': jnp.ones((D,), cfg.param_dtype),
+        'final_norm': norm_init((D,), cfg.param_dtype),
     }
     if cfg.qkv_bias:
         params['layers']['bq'] = jnp.zeros((L, cfg.n_heads * hd),
@@ -267,7 +297,8 @@ def attention_block(x: jnp.ndarray, lp: Params, cfg: LlamaConfig,
     hd = cfg.hd
     con = functools.partial(sharding_lib.constrain, rules=rules)
 
-    h = norms.rms_norm(x, lp[norm_key], cfg.rms_eps)
+    h = norms.rms_norm(x, lp[norm_key], cfg.rms_eps,
+                       scale_plus_one=cfg.norm_plus_one)
     q = jnp.einsum('bsd,dh->bsh', h, lp['wq'].astype(cfg.dtype))
     kk = jnp.einsum('bsd,dh->bsh', h, lp['wk'].astype(cfg.dtype))
     vv = jnp.einsum('bsd,dh->bsh', h, lp['wv'].astype(cfg.dtype))
@@ -298,10 +329,11 @@ def _layer(x: jnp.ndarray, lp: Params, cfg: LlamaConfig,
     con = functools.partial(sharding_lib.constrain, rules=rules)
     x = x + attention_block(x, lp, cfg, rules, sin, cos, q_offset)
 
-    h = norms.rms_norm(x, lp['mlp_norm'], cfg.rms_eps)
+    h = norms.rms_norm(x, lp['mlp_norm'], cfg.rms_eps,
+                       scale_plus_one=cfg.norm_plus_one)
     gate = jnp.einsum('bsd,df->bsf', h, lp['w_gate'].astype(cfg.dtype))
     up = jnp.einsum('bsd,df->bsf', h, lp['w_up'].astype(cfg.dtype))
-    inner = jax.nn.silu(gate) * up
+    inner = cfg.act(gate) * up
     inner = con(inner, 'batch', 'seq', 'mlp')
     down = jnp.einsum('bsf,fd->bsd', inner, lp['w_down'].astype(cfg.dtype))
     return x + con(down, 'batch', 'seq', 'act_embed')
@@ -331,6 +363,8 @@ def forward(params: Params,
     tokens = con(tokens, 'batch', 'seq')
 
     x = jnp.take(params['embed'], tokens, axis=0).astype(cfg.dtype)
+    if cfg.embed_scale:
+        x = x * jnp.asarray(cfg.dim ** 0.5, cfg.dtype)
     x = con(x, 'batch', 'seq', 'act_embed')
 
     if positions is None:
@@ -356,8 +390,12 @@ def forward(params: Params,
             lp = jax.tree.map(lambda p: p[i], params['layers'])
             x = layer_fn(x, lp)
 
-    x = norms.rms_norm(x, params['final_norm'], cfg.rms_eps)
+    x = norms.rms_norm(x, params['final_norm'], cfg.rms_eps,
+                       scale_plus_one=cfg.norm_plus_one)
     head = (params['embed'].T if cfg.tie_embeddings else params['lm_head'])
     logits = jnp.einsum('bsd,dv->bsv', x, head.astype(cfg.dtype),
                         preferred_element_type=jnp.float32)
+    if cfg.final_logit_softcap:
+        cap = cfg.final_logit_softcap
+        logits = cap * jnp.tanh(logits / cap)
     return con(logits, 'batch', 'seq', 'vocab')

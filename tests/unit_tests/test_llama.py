@@ -124,6 +124,49 @@ def test_qwen_qkv_bias_family():
     assert llama.PRESETS['qwen2-7b'].num_params > 7e9
 
 
+def test_gemma_family_knobs():
+    """Gemma-family decoders: (1+w) norms with zero-init scales, tanh-gelu
+    gating, sqrt(dim) embedding scale, final-logit softcap — and decode
+    parity with every knob on."""
+    import dataclasses as dc
+    from skypilot_tpu.models import decode
+    cfg = dc.replace(CFG, dtype=jnp.float32, norm_plus_one=True,
+                     mlp_activation='gelu', embed_scale=True,
+                     final_logit_softcap=30.0, tie_embeddings=True)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    # Zero-init norm scales ⇒ effective scale 1 via the +1.
+    assert float(jnp.abs(params['layers']['attn_norm']).max()) == 0.0
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                cfg.vocab_size, jnp.int32)
+    logits = llama.forward(params, tokens, cfg)
+    assert float(jnp.abs(logits).max()) <= 30.0   # softcap bound
+    # Each knob changes the function (actually applied, not parsed-only).
+    for change in (dict(norm_plus_one=False), dict(mlp_activation='silu'),
+                   dict(embed_scale=False)):
+        other = dc.replace(cfg, **change)
+        assert not np.allclose(
+            np.asarray(logits),
+            np.asarray(llama.forward(params, tokens, other)), atol=1e-3)
+    # Softcap: with randomly-initialized (small) logits its effect is
+    # sub-1e-4, so assert via the bound it imposes at a tight cap.
+    tight = dc.replace(cfg, final_logit_softcap=0.01)
+    assert float(jnp.abs(llama.forward(params, tokens,
+                                       tight)).max()) <= 0.01
+    # Decode engine honors the same knobs: prefill == forward last pos.
+    last, cache = decode.prefill(params, tokens, cfg, max_len=32)
+    np.testing.assert_allclose(np.asarray(last),
+                               np.asarray(logits[:, -1]), rtol=2e-4,
+                               atol=2e-4)
+    nxt = jnp.argmax(last, -1).astype(jnp.int32)
+    step_logits, _ = decode.decode_step(params, nxt, cache, cfg)
+    seq = jnp.concatenate([tokens, nxt[:, None]], axis=1)
+    np.testing.assert_allclose(np.asarray(step_logits),
+                               np.asarray(llama.forward(params, seq,
+                                                        cfg)[:, -1]),
+                               rtol=2e-4, atol=2e-4)
+    assert llama.PRESETS['gemma2-9b'].final_logit_softcap == 30.0
+
+
 def test_validate_divisibility():
     with pytest.raises(ValueError):
         llama.validate_divisibility(CFG, {'tensor': 3})
