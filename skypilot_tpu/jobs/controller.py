@@ -160,16 +160,36 @@ class JobsController:
 
     def run(self) -> None:
         job_id = self.job_id
-        if not state.set_starting(job_id, self._stage_cluster_name(0)):
+        # Resume path: a controller respawned by the scheduler after a
+        # hard crash re-attaches to the in-flight stage instead of
+        # relaunching from scratch (the cluster job kept running the
+        # whole time — only the monitor died).
+        resume_from = None
+        if self.record['status'] in (state.ManagedJobStatus.STARTING,
+                                     state.ManagedJobStatus.RUNNING,
+                                     state.ManagedJobStatus.RECOVERING,
+                                     state.ManagedJobStatus.CANCELLING):
+            resume_from = int(self.record.get('current_task') or 0)
+            logger.info(f'[job {job_id}] resuming mid-flight at stage '
+                        f'{resume_from} ({self.record["status"].value}).')
+        elif not state.set_starting(job_id, self._stage_cluster_name(0)):
             # The job reached a terminal state (e.g. cancelled while
             # PENDING) before this controller got going: nothing to do.
             logger.info(f'[job {job_id}] already terminal; controller exits.')
             return
         pool = self.record.get('pool')
         for index, task in enumerate(self.tasks):
+            if resume_from is not None and index < resume_from:
+                continue
             self.task = task
-            self.cluster_name = self._stage_cluster_name(index)
-            state.set_current_task(job_id, index, self.cluster_name)
+            reattach = (resume_from == index)
+            if reattach and self.record.get('cluster_name'):
+                # Keep the in-flight stage's cluster (pool jobs: the
+                # claimed worker's name was synced into the record).
+                self.cluster_name = self.record['cluster_name']
+            else:
+                self.cluster_name = self._stage_cluster_name(index)
+                state.set_current_task(job_id, index, self.cluster_name)
             if pool:
                 # Pool jobs run on a claimed worker instead of a dedicated
                 # cluster; the real cluster name is known after acquire.
@@ -181,34 +201,55 @@ class JobsController:
             if len(self.tasks) > 1:
                 logger.info(f'[job {job_id}] pipeline stage '
                             f'{index + 1}/{len(self.tasks)}')
-            if not self._run_one_task():
+            if not self._run_one_task(reattach=reattach):
                 return   # terminal status already recorded
         state.set_terminal(job_id, state.ManagedJobStatus.SUCCEEDED)
 
-    def _run_one_task(self) -> bool:
+    def _try_reattach(self) -> Optional[int]:
+        """Adopt the crashed controller's in-flight cluster job: restore
+        the strategy's handle from the cluster record and reuse the
+        recorded on-cluster job id. Returns None when there is nothing to
+        re-attach to (the monitor loop's liveness check then drives a
+        normal recovery)."""
+        record = global_state.get_cluster(self.cluster_name)
+        if record is None:
+            return None
+        self.strategy.handle = slice_backend.SliceResourceHandle.from_dict(
+            record['handle'])
+        self.strategy.cluster_name = self.cluster_name
+        return self.record.get('cluster_job_id')
+
+    def _run_one_task(self, reattach: bool = False) -> bool:
         """Drive one (stage's) task to completion on its own cluster.
 
         Returns True when the stage SUCCEEDED (pipeline continues); False
         when a terminal ManagedJobStatus was already recorded.
         """
         job_id = self.job_id
-        logger.info(f'[job {job_id}] launching as {self.cluster_name!r}')
-        try:
-            cluster_job_id = self.strategy.launch()
-            self._sync_cluster_name()
-        except recovery_strategy.JobCancelledDuringRecovery:
-            # Cancelled while queued for a pool worker.
-            self._do_cancel(None)
-            return False
-        except exceptions.ResourcesUnavailableError as e:
-            state.set_terminal(job_id, state.ManagedJobStatus.
-                               FAILED_NO_RESOURCE, failure_reason=str(e))
-            return False
-        except Exception as e:  # pylint: disable=broad-except
-            state.set_terminal(job_id,
-                               state.ManagedJobStatus.FAILED_PRECHECKS,
-                               failure_reason=f'{type(e).__name__}: {e}')
-            return False
+        cluster_job_id = self._try_reattach() if reattach else None
+        if cluster_job_id is not None:
+            logger.info(f'[job {job_id}] re-attached to '
+                        f'{self.cluster_name!r} (cluster job '
+                        f'{cluster_job_id}).')
+        else:
+            logger.info(f'[job {job_id}] launching as '
+                        f'{self.cluster_name!r}')
+            try:
+                cluster_job_id = self.strategy.launch()
+                self._sync_cluster_name()
+            except recovery_strategy.JobCancelledDuringRecovery:
+                # Cancelled while queued for a pool worker.
+                self._do_cancel(None)
+                return False
+            except exceptions.ResourcesUnavailableError as e:
+                state.set_terminal(job_id, state.ManagedJobStatus.
+                                   FAILED_NO_RESOURCE, failure_reason=str(e))
+                return False
+            except Exception as e:  # pylint: disable=broad-except
+                state.set_terminal(job_id,
+                                   state.ManagedJobStatus.FAILED_PRECHECKS,
+                                   failure_reason=f'{type(e).__name__}: {e}')
+                return False
         if not state.set_started(job_id, cluster_job_id):
             # Cancelled while we were provisioning: clean up and bow out.
             self.strategy.terminate_cluster()

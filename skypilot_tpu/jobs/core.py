@@ -93,6 +93,10 @@ def _max_restarts(task: task_lib.Task) -> int:
 
 def queue(name: Optional[str] = None,
           skip_finished: bool = False) -> List[Dict[str, Any]]:
+    # Piggyback the crash watchdog on inspection: a job whose controller
+    # died hard gets its controller resumed the next time anyone looks
+    # (scheduler.maybe_schedule is idempotent and cheap).
+    scheduler.maybe_schedule()
     jobs = state.get_jobs(name)
     if skip_finished:
         jobs = [j for j in jobs if not j['status'].is_terminal()]

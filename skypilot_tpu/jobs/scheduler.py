@@ -19,6 +19,11 @@ from skypilot_tpu.utils import locks
 
 logger = sky_logging.init_logger(__name__)
 
+# A job whose controller keeps dying (poisoned record, OOM-looping box)
+# stops being resumed after this many restarts.
+MAX_CONTROLLER_RESTARTS = int(
+    os.environ.get('SKYTPU_JOBS_MAX_CONTROLLER_RESTARTS', '3'))
+
 
 def _max_parallel() -> int:
     from skypilot_tpu import config as config_lib
@@ -30,6 +35,15 @@ def _max_parallel() -> int:
 def _pid_alive(pid: Optional[int]) -> bool:
     if not pid:
         return False
+    # Reap first if it's our child: a zombie still answers kill(pid, 0),
+    # and a dead-but-unreaped controller must count as dead or the crash
+    # watchdog never fires.
+    try:
+        wpid, _ = os.waitpid(pid, os.WNOHANG)
+        if wpid == pid:
+            return False
+    except (ChildProcessError, OSError):
+        pass          # not our child: signal-0 probe decides
     try:
         os.kill(pid, 0)
         return True
@@ -91,14 +105,29 @@ def maybe_schedule() -> None:
             elif _pid_alive(job['controller_pid']):
                 alive += 1
             # Non-terminal with a dead controller and not PENDING: the
-            # controller crashed hard (kill -9 / reboot). Mark it so it
-            # doesn't count against the cap forever — and tear down its
-            # cluster, or the orphaned slice bills forever with no owner.
+            # controller crashed hard (kill -9 / host reboot). RESUME it —
+            # a fresh controller re-attaches to the still-running cluster
+            # job (controller.py resume path) so the user's job survives
+            # control-plane crashes (reference analog: HA recovery,
+            # serve_utils.ha_recovery_for_consolidation_mode). Repeated
+            # crashes (a poisoned record crashing every controller) are
+            # bounded; past the cap the job fails and the cluster is
+            # reclaimed so an orphaned slice can't bill forever.
             elif job['status'] is not state.ManagedJobStatus.PENDING:
-                state.set_terminal(
-                    job['job_id'], state.ManagedJobStatus.FAILED_CONTROLLER,
-                    failure_reason='controller process died')
-                _teardown_orphan(job.get('cluster_name'))
+                restarts = state.bump_controller_restarts(job['job_id'])
+                if restarts > MAX_CONTROLLER_RESTARTS:
+                    state.set_terminal(
+                        job['job_id'],
+                        state.ManagedJobStatus.FAILED_CONTROLLER,
+                        failure_reason=f'controller died {restarts} times')
+                    _teardown_orphan(job.get('cluster_name'))
+                    continue
+                pid = _spawn_controller(job['job_id'])
+                state.set_controller_pid(job['job_id'], pid)
+                alive += 1
+                logger.warning(
+                    f'Controller of job {job["job_id"]} died; resumed with '
+                    f'pid={pid} (restart {restarts}).')
         cap = _max_parallel()
         for job in pending:
             if alive >= cap:

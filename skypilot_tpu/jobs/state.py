@@ -122,10 +122,11 @@ def _conn() -> sqlite3.Connection:
                          f'DEFAULT {default}')
         except sqlite3.OperationalError:
             pass   # already present
-    try:
-        conn.execute('ALTER TABLE jobs ADD COLUMN pool TEXT')
-    except sqlite3.OperationalError:
-        pass
+    for col in ('pool TEXT', 'controller_restarts INTEGER DEFAULT 0'):
+        try:
+            conn.execute(f'ALTER TABLE jobs ADD COLUMN {col}')
+        except sqlite3.OperationalError:
+            pass
     return conn
 
 
@@ -204,6 +205,13 @@ def _update_live(job_id: int, **cols: Any) -> bool:
 
 def set_controller_pid(job_id: int, pid: int) -> None:
     _update(job_id, controller_pid=pid)
+
+
+def bump_controller_restarts(job_id: int) -> int:
+    job = get_job(job_id)
+    count = (job.get('controller_restarts') or 0) + 1 if job else 1
+    _update(job_id, controller_restarts=count)
+    return count
 
 
 def set_starting(job_id: int, cluster_name: str) -> bool:

@@ -93,6 +93,68 @@ class TestManagedJobs:
         assert job['cluster_name'] == cluster_name
         assert global_state.get_cluster(cluster_name) is None
 
+    def test_controller_crash_resumes_without_restarting_job(self,
+                                                             tmp_path):
+        """kill -9 on the controller must NOT kill (or restart) the
+        user's job: the scheduler's watchdog respawns a controller that
+        re-attaches to the still-running cluster job and sees it through
+        (reference analog: HA recovery for consolidation mode)."""
+        import signal
+        from skypilot_tpu.jobs import scheduler
+        gate = tmp_path / 'finish.gate'
+        job_id = jobs_core.launch(_task(
+            'crashproof',
+            f'while [ ! -f {gate} ]; do sleep 0.2; done; echo survived'))
+        job = _wait_status(job_id, {ManagedJobStatus.RUNNING})
+        cluster_name = job['cluster_name']
+        cluster_job_id = job['cluster_job_id']
+        os.kill(job['controller_pid'], signal.SIGKILL)
+        time.sleep(0.5)
+
+        scheduler.maybe_schedule()   # the watchdog (also runs on queue())
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            j = jobs_state.get_job(job_id)
+            if j['controller_pid'] != job['controller_pid']:
+                break
+            time.sleep(0.2)
+        else:
+            raise TimeoutError('controller was not resumed')
+        assert j['controller_restarts'] == 1
+
+        gate.write_text('go')
+        job = _wait_status(job_id, {ManagedJobStatus.SUCCEEDED})
+        # Re-attach, not relaunch: same cluster, same on-cluster job id,
+        # zero recoveries — and the log proves one continuous run.
+        assert job['recovery_count'] == 0
+        assert job['cluster_name'] == cluster_name
+        assert job['cluster_job_id'] == cluster_job_id
+        assert 'survived' in open(jobs_state.job_log_path(job_id)).read()
+
+    def test_repeatedly_dying_controller_fails_and_reclaims(self):
+        """Past the restart cap the job fails and its cluster is torn
+        down — an orphaned slice must not bill forever."""
+        from skypilot_tpu.jobs import scheduler
+        job_id = jobs_core.launch(_task('orphan', 'sleep 120'))
+        job = _wait_status(job_id, {ManagedJobStatus.RUNNING})
+        import signal
+        for restart in range(scheduler.MAX_CONTROLLER_RESTARTS + 1):
+            pid = jobs_state.get_job(job_id)['controller_pid']
+            os.kill(pid, signal.SIGKILL)
+            time.sleep(0.3)
+            scheduler.maybe_schedule()
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                j = jobs_state.get_job(job_id)
+                if j['status'] is ManagedJobStatus.FAILED_CONTROLLER or \
+                        (j['controller_pid'] != pid and
+                         j['controller_pid']):
+                    break
+                time.sleep(0.2)
+        job = _wait_status(job_id, {ManagedJobStatus.FAILED_CONTROLLER},
+                           timeout=30)
+        assert global_state.get_cluster(job['cluster_name']) is None
+
     def test_user_code_failure_is_not_recovered(self):
         job_id = jobs_core.launch(_task('boom', 'exit 7'))
         job = _wait_status(job_id, {ManagedJobStatus.FAILED})
