@@ -104,9 +104,45 @@ def up(task: task_lib.Task, service_name: Optional[str] = None,
     return {'name': name, 'endpoint': endpoint}
 
 
+def _pid_alive(pid) -> bool:
+    if not pid:
+        return False
+    try:                       # reap our own zombie children first
+        wpid, _ = os.waitpid(int(pid), os.WNOHANG)
+        if wpid == int(pid):
+            return False
+    except (ChildProcessError, OSError):
+        pass
+    try:
+        os.kill(int(pid), 0)
+        return True
+    except (OSError, ProcessLookupError):
+        return False
+
+
+def maybe_recover_controllers() -> None:
+    """Crash watchdog (jobs-scheduler analog): a non-terminal service or
+    pool whose controller process died hard gets a fresh controller that
+    re-adopts its replicas from state (the reconcile loop is stateless
+    against the DB, so resume = restart the process)."""
+    from skypilot_tpu.utils import locks
+    with locks.cluster_status_lock('serve-watchdog', timeout=30):
+        for r in serve_state.get_services():
+            if r['status'].is_terminal() or \
+                    r['status'] is ServiceStatus.SHUTTING_DOWN:
+                continue
+            if _pid_alive(r.get('controller_pid')):
+                continue
+            pid = _spawn_controller(r['name'])
+            serve_state.update_service(r['name'], controller_pid=pid)
+            logger.warning(f'Controller of {r["name"]!r} died; resumed '
+                           f'with pid={pid}.')
+
+
 def status(service_names: Optional[List[str]] = None,
            pool: Optional[bool] = None) -> List[Dict[str, Any]]:
     """Service (pool=False), pool (pool=True), or combined (None) status."""
+    maybe_recover_controllers()
     records = serve_state.get_services()
     if service_names:
         records = [r for r in records if r['name'] in service_names]

@@ -4,6 +4,7 @@ fake-TPU cloud (reference validates this only against real clusters,
 tests/smoke_tests/test_sky_serve.py).
 """
 import json
+import os
 import time
 import urllib.error
 import urllib.request
@@ -384,6 +385,46 @@ class TestServeEndToEnd:
     def test_plain_launch_rejects_service_yaml(self):
         with pytest.raises(ValueError, match='serve up'):
             sky.launch(_service_task(), cluster_name='nope')
+
+    def test_controller_crash_resumes_service(self):
+        """kill -9 on the serve controller: the watchdog (piggybacked on
+        serve status) respawns it and the resumed controller keeps
+        reconciling — existing replicas are adopted, a killed replica
+        still gets replaced."""
+        import signal
+        info = serve_core.up(_service_task(replicas=1),
+                             lb_port=_worker_port_base() + 54)
+        name = info['name']
+        try:
+            serve_core.wait_until(name, {ServiceStatus.READY}, timeout=120)
+            _wait_ready_replicas(name, 1)
+            old_pid = serve_state.get_service(name)['controller_pid']
+            os.kill(old_pid, signal.SIGKILL)
+            time.sleep(0.5)
+            serve_core.status()          # watchdog fires here
+            rec = serve_state.get_service(name)
+            assert rec['controller_pid'] != old_pid
+            # The resumed controller adopts the existing replica (no
+            # churn) and still replaces preempted ones.
+            rep = serve_state.get_replicas(name)[0]
+            import shutil as shutil_lib
+            from skypilot_tpu.clouds import local as local_cloud
+            preempted_at = time.time()
+            shutil_lib.rmtree(os.path.join(local_cloud.LOCAL_CLOUD_ROOT,
+                                           rep['cluster_name']))
+            # Replica ids restart from 1 when the table empties; the
+            # replacement is identified by its fresh launch time.
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                reps = serve_state.get_replicas(name)
+                if reps and (reps[0]['launched_at'] or 0) > preempted_at \
+                        and reps[0]['status'] is ReplicaStatus.READY:
+                    break
+                time.sleep(0.5)
+            else:
+                raise TimeoutError(serve_state.get_replicas(name))
+        finally:
+            serve_core.down(name)
 
     def test_broken_update_rolls_back(self):
         """An update whose new version never passes probes must roll BACK
