@@ -7,11 +7,13 @@ train/serve directly. `get_config(name)` resolves preset names;
 param_specs / forward / validate_divisibility).
 """
 from skypilot_tpu.models import llama
+from skypilot_tpu.models import mla
 from skypilot_tpu.models import moe
 
 _PRESETS = {}
 _PRESETS.update(llama.PRESETS)
 _PRESETS.update(moe.PRESETS)
+_PRESETS.update(mla.PRESETS)
 
 
 def get_config(name: str):
@@ -28,6 +30,8 @@ def list_presets():
 
 def module_for(cfg):
     """Model module implementing this config (most-derived class wins)."""
+    if isinstance(cfg, mla.MLAConfig):
+        return mla
     if isinstance(cfg, moe.MoEConfig):
         return moe
     if isinstance(cfg, llama.LlamaConfig):
