@@ -266,7 +266,7 @@ class TestServeEndToEnd:
                              lb_port=_worker_port_base() + 50)
         name = info['name']
         try:
-            serve_core.wait_until(name, {ServiceStatus.READY}, timeout=120)
+            serve_core.wait_until(name, {ServiceStatus.READY}, timeout=180)
             _wait_ready_replicas(name, 2)
 
             # Requests round-trip through the LB and hit BOTH replicas
@@ -396,7 +396,7 @@ class TestServeEndToEnd:
                              lb_port=_worker_port_base() + 54)
         name = info['name']
         try:
-            serve_core.wait_until(name, {ServiceStatus.READY}, timeout=120)
+            serve_core.wait_until(name, {ServiceStatus.READY}, timeout=180)
             _wait_ready_replicas(name, 1)
             old_pid = serve_state.get_service(name)['controller_pid']
             os.kill(old_pid, signal.SIGKILL)
@@ -443,11 +443,11 @@ class TestServeEndToEnd:
         task = sky.Task(name='rbk', run=_REPLICA_APP)
         task.set_resources(sky.Resources(accelerators='tpu-v5e-8'))
         # Real app: generous grace so v1 comes up even on a loaded box.
-        task.service_spec = _spec(port, 30)
+        task.service_spec = _spec(port, 60)
         info = serve_core.up(task, lb_port=_worker_port_base() + 53)
         name = info['name']
         try:
-            serve_core.wait_until(name, {ServiceStatus.READY}, timeout=120)
+            serve_core.wait_until(name, {ServiceStatus.READY}, timeout=180)
             _wait_ready_replicas(name, 1)
 
             bad = sky.Task(name='rbk', run='exit 1')   # never serves
@@ -483,7 +483,7 @@ class TestServeEndToEnd:
                              lb_port=_worker_port_base() + 51)
         name = info['name']
         try:
-            serve_core.wait_until(name, {ServiceStatus.READY}, timeout=120)
+            serve_core.wait_until(name, {ServiceStatus.READY}, timeout=180)
             _wait_ready_replicas(name, 2)
             assert _get(info['endpoint'] + '/v')['version'] == '1'
 
@@ -527,7 +527,7 @@ class TestServeEndToEnd:
                              lb_port=_worker_port_base() + 52)
         name = info['name']
         try:
-            serve_core.wait_until(name, {ServiceStatus.READY}, timeout=120)
+            serve_core.wait_until(name, {ServiceStatus.READY}, timeout=180)
             _wait_ready_replicas(name, 1)
             serve_core.update(_service_task(replicas=1), name,
                               mode='blue_green')
