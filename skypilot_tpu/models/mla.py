@@ -351,25 +351,40 @@ def decode_step(params, token: jnp.ndarray, cache: LatentCache,
                                      length=length + 1)
 
 
-@functools.partial(jax.jit, static_argnames=('cfg', 'max_new_tokens',
-                                             'max_len'))
+@functools.partial(jax.jit,
+                   static_argnames=('cfg', 'max_new_tokens', 'max_len',
+                                    'temperature', 'eos_id', 'top_k',
+                                    'top_p'))
 def generate(params, prompt: jnp.ndarray, cfg: MLAConfig,
-             max_new_tokens: int, *, max_len: Optional[int] = None
-             ) -> jnp.ndarray:
-    """Greedy generation over the latent cache (fully jitted)."""
+             max_new_tokens: int, *, max_len: Optional[int] = None,
+             temperature: float = 0.0, eos_id: Optional[int] = None,
+             top_k: Optional[int] = None, top_p: Optional[float] = None,
+             prompt_lengths: Optional[jnp.ndarray] = None,
+             rng: Optional[jax.Array] = None) -> jnp.ndarray:
+    """Generation over the latent cache, same surface as decode.generate
+    (greedy / temperature / top-k / top-p, eos padding, ragged prompts) —
+    the inference engine serves MLA models through this interchangeably."""
+    from skypilot_tpu.models.decode import _select_token
     b, s = prompt.shape
     if max_len is None:
         max_len = min(cfg.max_seq_len, s + max_new_tokens)
-    logits, cache = prefill(params, prompt, cfg, max_len)
-    first = jnp.argmax(logits, -1).astype(jnp.int32)
+    logits, cache = prefill(params, prompt, cfg, max_len,
+                            lengths=prompt_lengths)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    first = _select_token(logits, temperature, rng, top_k, top_p)
+    done0 = (jnp.full((b,), False) if eos_id is None else first == eos_id)
 
-    def body(carry, _):
-        tok, cache = carry
+    def body(carry, step_rng):
+        tok, cache, done = carry
         logits, cache = decode_step(params, tok, cache, cfg)
-        nxt = jnp.argmax(logits, -1).astype(jnp.int32)
-        return (nxt, cache), nxt
+        nxt = _select_token(logits, temperature, step_rng, top_k, top_p)
+        if eos_id is not None:
+            nxt = jnp.where(done, jnp.int32(eos_id), nxt)
+            done = jnp.logical_or(done, nxt == eos_id)
+        return (nxt, cache, done), nxt
 
-    (_, _), rest = jax.lax.scan(body, (first, cache),
-                                jnp.arange(max(max_new_tokens - 1, 1)))
-    return jnp.concatenate([first[:, None], rest.T[:, :max_new_tokens - 1]],
-                           axis=1)
+    step_rngs = jax.random.split(rng, max(max_new_tokens - 1, 1))
+    (_, _, _), rest = jax.lax.scan(body, (first, cache, done0),
+                                   step_rngs[:max_new_tokens - 1])
+    return jnp.concatenate([first[:, None], rest.T], axis=1)

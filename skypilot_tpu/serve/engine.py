@@ -55,10 +55,13 @@ class InferenceEngine:
         import jax
         import jax.numpy as jnp
         from skypilot_tpu.models import decode as decode_lib
-        from skypilot_tpu.models import get_config, module_for
+        from skypilot_tpu.models import get_config, mla, module_for
         self._jnp = jnp
-        self._decode = decode_lib
         self.cfg = get_config(model)
+        # MLA models generate over the latent cache (models/mla.py);
+        # everything else over the K/V cache. Same call surface.
+        self._decode = (mla if isinstance(self.cfg, mla.MLAConfig)
+                        else decode_lib)
         self.max_len = max_len or min(self.cfg.max_seq_len, 2048)
         if ckpt_dir:
             from skypilot_tpu.parallel import MeshSpec, build_mesh

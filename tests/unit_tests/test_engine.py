@@ -99,6 +99,27 @@ class TestEngine:
         # Fewer generate calls than requests → grouping happened.
         assert len(calls) < len(prompts), calls
 
+    def test_mla_model_served_through_engine(self):
+        """DeepSeek-family models serve through the same engine: the
+        dispatcher picks the latent-cache generate (models/mla.py)."""
+        from skypilot_tpu.models import mla
+        eng = engine_lib.InferenceEngine('mla-debug', max_len=64)
+        eng.cfg = dataclasses.replace(eng.cfg, dtype=jnp.float32)
+        eng.warmup()
+        assert eng._decode is mla
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6]
+        want = mla.generate(eng.params, jnp.asarray([prompt], jnp.int32),
+                            eng.cfg, 16, max_len=eng.max_len)
+
+        async def fn(client):
+            r = await client.post('/generate', json={
+                'tokens': prompt, 'max_new_tokens': 8})
+            assert r.status == 200
+            return (await r.json())['tokens']
+        got = _with_client(eng, fn)
+        np.testing.assert_array_equal(np.asarray(got),
+                                      np.asarray(want[0][:8]))
+
     def test_mixed_lengths_batch_together_and_validation(self, engine):
         # Mixed prompt lengths inside one bucket (8 and 12 both bucket to
         # 16) group into ONE ragged generate call and each row matches
