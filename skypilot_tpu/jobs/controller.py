@@ -169,6 +169,24 @@ class JobsController:
                                      state.ManagedJobStatus.RUNNING,
                                      state.ManagedJobStatus.RECOVERING,
                                      state.ManagedJobStatus.CANCELLING):
+            if state.cancel_was_requested(job_id):
+                # A controller that died mid-cancel must FINISH the
+                # cancel, not re-enter the launch loop (which would
+                # provision a fresh slice for a cancelled job).
+                self.task = self.tasks[
+                    int(self.record.get('current_task') or 0)]
+                self.cluster_name = (self.record.get('cluster_name') or
+                                     self._stage_cluster_name(0))
+                pool_name = self.record.get('pool')
+                if pool_name:
+                    self.strategy = recovery_strategy.PoolStrategyExecutor(
+                        self.cluster_name, self.task, job_id, pool_name)
+                else:
+                    self.strategy = recovery_strategy.StrategyExecutor.make(
+                        self.cluster_name, self.task, job_id)
+                self._try_reattach()
+                self._do_cancel(self.record.get('cluster_job_id'))
+                return
             resume_from = int(self.record.get('current_task') or 0)
             logger.info(f'[job {job_id}] resuming mid-flight at stage '
                         f'{resume_from} ({self.record["status"].value}).')

@@ -32,23 +32,7 @@ def _max_parallel() -> int:
                        config_lib.get_nested(('jobs', 'max_parallel'), 8)))
 
 
-def _pid_alive(pid: Optional[int]) -> bool:
-    if not pid:
-        return False
-    # Reap first if it's our child: a zombie still answers kill(pid, 0),
-    # and a dead-but-unreaped controller must count as dead or the crash
-    # watchdog never fires.
-    try:
-        wpid, _ = os.waitpid(pid, os.WNOHANG)
-        if wpid == pid:
-            return False
-    except (ChildProcessError, OSError):
-        pass          # not our child: signal-0 probe decides
-    try:
-        os.kill(pid, 0)
-        return True
-    except (OSError, ProcessLookupError):
-        return False
+from skypilot_tpu.utils.proc import pid_alive as _pid_alive
 
 
 def _spawn_controller(job_id: int) -> int:

@@ -368,6 +368,10 @@ def generate(params, prompt: jnp.ndarray, cfg: MLAConfig,
     b, s = prompt.shape
     if max_len is None:
         max_len = min(cfg.max_seq_len, s + max_new_tokens)
+    if s + max_new_tokens > max_len:
+        raise ValueError(
+            f'prompt ({s}) + max_new_tokens ({max_new_tokens}) exceeds '
+            f'max_len ({max_len})')
     logits, cache = prefill(params, prompt, cfg, max_len,
                             lengths=prompt_lengths)
     if rng is None:

@@ -104,20 +104,13 @@ def up(task: task_lib.Task, service_name: Optional[str] = None,
     return {'name': name, 'endpoint': endpoint}
 
 
-def _pid_alive(pid) -> bool:
-    if not pid:
-        return False
-    try:                       # reap our own zombie children first
-        wpid, _ = os.waitpid(int(pid), os.WNOHANG)
-        if wpid == int(pid):
-            return False
-    except (ChildProcessError, OSError):
-        pass
-    try:
-        os.kill(int(pid), 0)
-        return True
-    except (OSError, ProcessLookupError):
-        return False
+from skypilot_tpu.utils.proc import pid_alive as _pid_alive
+
+# A service whose controller dies at every spawn (poisoned spec, broken
+# environment) stops being respawned past this many restarts — otherwise
+# every `serve status` forks another doomed controller, forever.
+MAX_CONTROLLER_RESTARTS = int(
+    os.environ.get('SKYTPU_SERVE_MAX_CONTROLLER_RESTARTS', '3'))
 
 
 def maybe_recover_controllers() -> None:
@@ -133,10 +126,20 @@ def maybe_recover_controllers() -> None:
                 continue
             if _pid_alive(r.get('controller_pid')):
                 continue
+            restarts = int(r.get('controller_restarts') or 0) + 1
+            if restarts > MAX_CONTROLLER_RESTARTS:
+                serve_state.set_service_status(
+                    r['name'], ServiceStatus.FAILED,
+                    failure_reason=f'controller died {restarts} times')
+                logger.warning(f'Controller of {r["name"]!r} keeps dying; '
+                               f'marked FAILED (tear down with serve '
+                               f'down).')
+                continue
             pid = _spawn_controller(r['name'])
-            serve_state.update_service(r['name'], controller_pid=pid)
+            serve_state.update_service(r['name'], controller_pid=pid,
+                                       controller_restarts=restarts)
             logger.warning(f'Controller of {r["name"]!r} died; resumed '
-                           f'with pid={pid}.')
+                           f'with pid={pid} (restart {restarts}).')
 
 
 def status(service_names: Optional[List[str]] = None,

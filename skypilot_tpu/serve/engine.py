@@ -97,9 +97,18 @@ class InferenceEngine:
         asyncio.create_task(self.batch_loop())
 
     def warmup(self) -> None:
+        # Compile through the SAME call signature _run_group uses
+        # (prompt_lengths + rng arrays present): a different jit pytree
+        # (None vs array) would compile a program no real request ever
+        # hits, and /health would flip while the first request still
+        # pays the full compile.
+        import jax
         jnp = self._jnp
-        self._decode.generate(self.params, jnp.zeros((1, 8), jnp.int32),
-                              self.cfg, 16, max_len=self.max_len)
+        self._decode.generate(
+            self.params, jnp.zeros((1, 16), jnp.int32), self.cfg, 16,
+            max_len=self.max_len, temperature=0.0, top_k=None, top_p=None,
+            prompt_lengths=jnp.asarray([8], jnp.int32),
+            rng=jax.random.PRNGKey(0))
         self.warm = True
         logger.info('Engine warm (first generate compiled).')
 

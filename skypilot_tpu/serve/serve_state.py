@@ -73,7 +73,8 @@ def _conn() -> sqlite3.Connection:
             update_mode TEXT DEFAULT 'rolling'
         )""")
     for col, decl in (('version', 'INTEGER DEFAULT 1'),
-                      ('update_mode', "TEXT DEFAULT 'rolling'")):
+                      ('update_mode', "TEXT DEFAULT 'rolling'"),
+                      ('controller_restarts', 'INTEGER DEFAULT 0')):
         try:
             conn.execute(f'ALTER TABLE services ADD COLUMN {col} {decl}')
         except sqlite3.OperationalError:

@@ -165,21 +165,23 @@ def train(tcfg: TrainerConfig) -> List[Dict[str, float]]:
         for step in range(start_step, tcfg.total_steps):
             state, metrics = step_fn(state, next(batches))
             steps_since_log += 1
-            if (step + 1) % tcfg.log_every == 0 or step + 1 == \
-                    tcfg.total_steps:
-                loss = float(metrics['loss'])   # device sync point
-                now = time.perf_counter()
-                rec = {
-                    'step': step + 1,
-                    'loss': round(loss, 4),
-                    'sec_per_step': round(
-                        (now - t_last) / steps_since_log, 4),
-                }
-                if eval_fn is not None and \
-                        (step + 1) % tcfg.eval_every == 0:
+            # Eval cadence is INDEPENDENT of log cadence: an eval-only
+            # step emits its own record.
+            do_log = ((step + 1) % tcfg.log_every == 0 or
+                      step + 1 == tcfg.total_steps)
+            do_eval = (eval_fn is not None and
+                       (step + 1) % tcfg.eval_every == 0)
+            if do_log or do_eval:
+                rec = {'step': step + 1}
+                if do_log:
+                    loss = float(metrics['loss'])   # device sync point
+                    now = time.perf_counter()
+                    rec.update(loss=round(loss, 4),
+                               sec_per_step=round(
+                                   (now - t_last) / steps_since_log, 4))
+                if do_eval:
                     rec['eval_loss'] = round(eval_fn(), 4)
-                    now = time.perf_counter()   # exclude eval time
-                t_last = now
+                t_last = time.perf_counter()   # exclude eval time
                 steps_since_log = 0
                 history.append(rec)
                 logger.info(json.dumps(rec))

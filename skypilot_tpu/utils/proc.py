@@ -1,0 +1,29 @@
+"""Process liveness probing shared by the jobs and serve crash watchdogs.
+
+Reference analog: controller-process supervision in
+sky/jobs/scheduler.py / sky/serve/service.py. The wrinkle both watchdogs
+need: a SIGKILLed child of the probing process is a ZOMBIE that still
+answers kill(pid, 0) — reap it with waitpid first or a dead controller
+counts as alive and the watchdog never fires.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def pid_alive(pid: Optional[int]) -> bool:
+    if not pid:
+        return False
+    pid = int(pid)
+    try:
+        wpid, _ = os.waitpid(pid, os.WNOHANG)
+        if wpid == pid:
+            return False
+    except (ChildProcessError, OSError):
+        pass          # not our child: the signal-0 probe decides
+    try:
+        os.kill(pid, 0)
+        return True
+    except (OSError, ProcessLookupError):
+        return False
