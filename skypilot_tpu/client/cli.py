@@ -277,6 +277,22 @@ def show_tpus(name_filter: Optional[str], region: Optional[str],
             click.echo('  '.join(c.ljust(12) for c in row))
 
 
+@cli.command(name='show-models')
+def show_models():
+    """List the native model presets (trainer --model / engine --model)."""
+    from skypilot_tpu import models as models_lib
+    header = ('PRESET', 'FAMILY', 'PARAMS', 'LAYERS', 'DIM', 'MAX SEQ')
+    click.echo('  '.join(h.ljust(18) for h in header))
+    for name in models_lib.list_presets():
+        cfg = models_lib.get_config(name)
+        family = models_lib.module_for(cfg).__name__.rsplit('.', 1)[-1]
+        n = cfg.num_params
+        params = (f'{n/1e9:.1f}B' if n >= 1e9 else f'{n/1e6:.0f}M')
+        row = (name, family, params, str(cfg.n_layers), str(cfg.dim),
+               str(cfg.max_seq_len))
+        click.echo('  '.join(c.ljust(18) for c in row))
+
+
 @cli.command(name='cost-report')
 def cost_report():
     """Show the cost of past clusters."""
