@@ -167,6 +167,32 @@ class TestPoolLifecycle:
         finally:
             pool_lib.down('wp2', purge=True)
 
+    def test_pipeline_job_runs_stages_on_pool(self):
+        """A multi-stage managed pipeline with --pool: every stage execs
+        onto a (possibly different) claimed worker; workers survive all
+        stages."""
+        pool_lib.apply(_pool_task(workers=1))
+        _wait_workers_ready('wp', 1)
+        import skypilot_tpu as sky
+        from skypilot_tpu import dag as dag_lib
+        d = dag_lib.Dag(name='pipe')
+        for i, msg in enumerate(('stage-one', 'stage-two')):
+            t = _job_task(f's{i}', f'echo {msg}')
+            d.add(t)
+            if i:
+                d.add_edge(prev, t)
+            prev = t
+        job_id = jobs_core.launch(d, pool='wp')
+        job = _wait_job(job_id, {ManagedJobStatus.SUCCEEDED}, timeout=120)
+        assert job['num_tasks'] == 2
+        # Worker intact and released after both stages.
+        reps = serve_state.get_replicas('wp')
+        assert len(reps) == 1 and reps[0]['job_id'] is None
+        assert reps[0]['status'] is ReplicaStatus.READY
+        log = open(jobs_state.job_log_path(job_id)).read()
+        assert 'stage-two' in log
+        pool_lib.down('wp')
+
     def test_resize_in_place(self):
         pool_lib.apply(_pool_task(workers=1))
         _wait_workers_ready('wp', 1)
