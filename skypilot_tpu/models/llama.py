@@ -243,8 +243,12 @@ def _pipelined_layers(x, layers, layer_fn, cfg: LlamaConfig):
     from skypilot_tpu.parallel import pipeline as pipeline_lib
     if cfg.attention_impl == 'ring':
         raise NotImplementedError(
-            'pipeline_stages>1 with ring attention would nest the sequence '
-            'shard_map inside the stage shard_map — not supported yet')
+            'pipeline_stages>1 with ring attention: the forward nests the '
+            'sequence shard_map inside the stage shard_map correctly, but '
+            'the backward hits a Shardy limitation (the transposed inner '
+            'manual computation re-binds the stage axis). Needs a single '
+            "merged stage+sequence manual region; use attention_impl "
+            "'flash' with pipeline stages meanwhile.")
     b, s_len, d = x.shape
     m = cfg.num_microbatches
     if b % m != 0:
