@@ -287,7 +287,8 @@ def show_models():
         cfg = models_lib.get_config(name)
         family = models_lib.module_for(cfg).__name__.rsplit('.', 1)[-1]
         n = cfg.num_params
-        params = (f'{n/1e9:.1f}B' if n >= 1e9 else f'{n/1e6:.0f}M')
+        params = (f'{n/1e9:.1f}B' if n >= 1e9 else
+                  f'{n/1e6:.0f}M' if n >= 1e7 else f'{n/1e6:.1f}M')
         row = (name, family, params, str(cfg.n_layers), str(cfg.dim),
                str(cfg.max_seq_len))
         click.echo('  '.join(c.ljust(18) for c in row))

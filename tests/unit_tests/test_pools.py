@@ -173,7 +173,6 @@ class TestPoolLifecycle:
         stages."""
         pool_lib.apply(_pool_task(workers=1))
         _wait_workers_ready('wp', 1)
-        import skypilot_tpu as sky
         from skypilot_tpu import dag as dag_lib
         d = dag_lib.Dag(name='pipe')
         for i, msg in enumerate(('stage-one', 'stage-two')):
