@@ -29,11 +29,16 @@ BASELINE_MFU_PCT = 2.90
 CHILD_ENV = 'SKYTPU_BENCH_CHILD'
 PROBE_ENV = 'SKYTPU_BENCH_PROBE'
 ATTEMPT_TIMEOUT_S = int(os.environ.get('SKYTPU_BENCH_ATTEMPT_TIMEOUT', '600'))
-PROBE_TIMEOUT_S = int(os.environ.get('SKYTPU_BENCH_PROBE_TIMEOUT', '120'))
-# Long tail on purpose: tunnel/backend outages observed in practice last
-# tens of minutes; the driver-facing contract is "produce a number if the
-# chip comes back within ~45 min, else fail loudly".
-BACKOFFS_S = (5, 15, 30, 60, 120, 240, 480)
+# Bounded chip probe: backend init alone (no compile) completes in a few
+# seconds when the tunnel is healthy; 45 s is generous.
+PROBE_TIMEOUT_S = int(os.environ.get('SKYTPU_BENCH_PROBE_TIMEOUT', '45'))
+# Capped retry tail: two rounds of driver history show a long tail never
+# pays off (r02 burned 35 min on a dead tunnel and still failed). Fail
+# fast instead; the durable evidence lives in BENCH_LAST_GOOD.json.
+BACKOFFS_S = (5, 15, 30, 60)
+TOTAL_BUDGET_S = int(os.environ.get('SKYTPU_BENCH_BUDGET', '900'))
+LAST_GOOD_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              'BENCH_LAST_GOOD.json')
 
 
 # ---------------------------------------------------------------------------
@@ -102,38 +107,88 @@ def _diagnose_and_reap():
               '(failure may be on the tunnel/server side)', file=sys.stderr)
 
 
-def _run_child(extra_env, timeout_s) -> int:
+def _run_child(extra_env, timeout_s, capture=False):
+    """Run this script as a child. Returns (rc, stdout_or_None)."""
     env = dict(os.environ, **extra_env)
     try:
-        return subprocess.run([sys.executable, os.path.abspath(__file__)],
-                              env=env, timeout=timeout_s).returncode
+        proc = subprocess.run([sys.executable, os.path.abspath(__file__)],
+                              env=env, timeout=timeout_s,
+                              stdout=subprocess.PIPE if capture else None,
+                              text=capture)
+        return proc.returncode, proc.stdout if capture else None
     except subprocess.TimeoutExpired:
-        return 124
+        return 124, None
+
+
+def _persist_last_good(json_line: str):
+    """Record the measurement durably so a later tunnel outage at driver
+    time cannot erase the evidence (VERDICT r2: two rounds, zero clean
+    captures). The file is committed to git after a good run."""
+    try:
+        record = json.loads(json_line)
+    except ValueError:
+        return
+    # Dev-box CPU runs are smoke tests, not evidence.
+    if 'cpu' in str(record.get('device', 'cpu')).lower():
+        return
+    entry = {
+        'measured_at': time.strftime('%Y-%m-%dT%H:%M:%SZ', time.gmtime()),
+        'result': record,
+    }
+    try:
+        with open(LAST_GOOD_PATH) as f:
+            history = json.load(f)
+        if not isinstance(history, dict):
+            history = {}
+    except (OSError, ValueError):
+        history = {}
+    history[record.get('metric', 'unknown')] = entry
+    with open(LAST_GOOD_PATH, 'w') as f:
+        json.dump(history, f, indent=2, sort_keys=True)
+        f.write('\n')
 
 
 def supervise() -> int:
+    start = time.time()
     attempts = 1 + len(BACKOFFS_S)
     for i in range(attempts):
         t0 = time.time()
         # Phase 1: cheap backend-init probe under a short timeout. A hung
-        # init (stale chip holder / dead tunnel) burns 2 minutes here, not
-        # the full measurement budget.
-        rc = _run_child({PROBE_ENV: '1'}, PROBE_TIMEOUT_S)
+        # init (stale chip holder / dead tunnel) fails here in <1 min, not
+        # after the full measurement budget.
+        rc, _ = _run_child({PROBE_ENV: '1'}, PROBE_TIMEOUT_S)
         if rc == 0:
-            # Phase 2: the measurement (fresh process re-inits the backend).
-            rc = _run_child({CHILD_ENV: '1'}, ATTEMPT_TIMEOUT_S)
-            if rc == 0:
+            # Phase 2: the measurement (fresh process re-inits the backend),
+            # clamped so a hang cannot push wall-clock past the budget.
+            # stdout (the JSON line) is captured so we can both print it and
+            # persist it to BENCH_LAST_GOOD.json.
+            attempt_timeout = min(
+                ATTEMPT_TIMEOUT_S,
+                max(60, TOTAL_BUDGET_S - (time.time() - start)))
+            rc, out = _run_child({CHILD_ENV: '1'}, attempt_timeout,
+                                 capture=True)
+            lines = (out or '').strip().splitlines()
+            if rc == 0 and lines:
+                print(lines[-1], flush=True)
+                _persist_last_good(lines[-1])
                 return 0
+            if rc == 0:
+                rc = 3   # exited clean but produced no JSON line
         print(f'[bench] attempt {i + 1}/{attempts} failed rc={rc} '
               f'after {time.time() - t0:.0f}s', file=sys.stderr)
-        if i < attempts - 1:
-            _diagnose_and_reap()
-            backoff = BACKOFFS_S[i]
-            print(f'[bench] retrying in {backoff}s', file=sys.stderr)
-            time.sleep(backoff)
-    print('[bench] FAILED: could not initialize the TPU and measure MFU '
-          f'after {attempts} attempts. See diagnostics above.',
-          file=sys.stderr)
+        if i >= attempts - 1:
+            break
+        if time.time() - start + PROBE_TIMEOUT_S > TOTAL_BUDGET_S:
+            print(f'[bench] total budget {TOTAL_BUDGET_S}s exhausted; '
+                  'not retrying further', file=sys.stderr)
+            break
+        _diagnose_and_reap()
+        backoff = BACKOFFS_S[i]
+        print(f'[bench] retrying in {backoff}s', file=sys.stderr)
+        time.sleep(backoff)
+    print('[bench] FAILED: could not initialize the TPU and measure. '
+          'Last driver-independent measurement (if any) is committed at '
+          f'{LAST_GOOD_PATH}.', file=sys.stderr)
     return 1
 
 
@@ -229,24 +284,36 @@ def run_decode_bench():
     # unreliable through remote-device tunnels (see run_bench).
     int(prefill_jit(params, prompt)[0])
     int(run()[0, -1])
-    # TTFT: prefill + first-token argmax, compile excluded.
-    t0 = time.perf_counter()
-    int(prefill_jit(params, prompt)[0])
-    ttft_ms = (time.perf_counter() - t0) * 1e3
-    # Steady-state decode throughput.
-    t0 = time.perf_counter()
-    int(run()[0, -1])
-    dt = time.perf_counter() - t0
-    tok_s = batch * new_tokens / dt
+
+    # BASELINE.md's serve rows are latency percentiles (median TTFT/TPOT,
+    # examples/tpu/v6e/README.md:122-127), so report p50 over trials, not a
+    # single sample. TPOT = steady-state per-step decode latency (what each
+    # batched request observes per output token).
+    trials = int(os.environ.get('SKYTPU_BENCH_DECODE_TRIALS', '5'))
+    ttft_ms, tpot_ms, tok_s = [], [], []
+    for _ in range(trials):
+        t0 = time.perf_counter()
+        int(prefill_jit(params, prompt)[0])
+        ttft_ms.append((time.perf_counter() - t0) * 1e3)
+        t0 = time.perf_counter()
+        int(run()[0, -1])
+        dt = time.perf_counter() - t0
+        tpot_ms.append(dt / new_tokens * 1e3)
+        tok_s.append(batch * new_tokens / dt)
+    med = lambda xs: sorted(xs)[len(xs) // 2]
     print(f'decode: device={device.device_kind} params='
           f'{cfg.num_params/1e6:.0f}M batch={batch} prompt={prompt_len} '
-          f'new={new_tokens} ttft={ttft_ms:.1f}ms tok/s={tok_s:.0f}',
+          f'new={new_tokens} trials={trials} ttft_p50={med(ttft_ms):.1f}ms '
+          f'tpot_p50={med(tpot_ms):.2f}ms tok/s_p50={med(tok_s):.0f}',
           file=sys.stderr)
     print(json.dumps({
         'metric': 'decode_tokens_per_s',
-        'value': round(tok_s, 1),
+        'value': round(med(tok_s), 1),
         'unit': 'tok/s',
         'vs_baseline': None,   # reference publishes no 1B-decode number
+        'ttft_ms_p50': round(med(ttft_ms), 1),
+        'tpot_ms_p50': round(med(tpot_ms), 2),
+        'device': device.device_kind,
     }), flush=True)
 
 
@@ -294,6 +361,7 @@ def run_bench():
         'value': round(mfu_pct, 2),
         'unit': '%',
         'vs_baseline': round(mfu_pct / BASELINE_MFU_PCT, 2),
+        'device': device.device_kind,
     }), flush=True)
 
 

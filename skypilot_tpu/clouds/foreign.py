@@ -1,0 +1,76 @@
+"""Clouds the reference supports but this TPU-first framework does not run.
+
+Reference analog: sky/clouds/ registers ~20 provider classes (aws.py,
+azure.py, oci.py, ...). Deliberate scope decision (SURVEY §2.2 row
+"other 16+ clouds": no): those providers have no TPUs, so instead of
+porting dead provisioners we parse their names into an opaque
+`ForeignCloud`. Reference recipes that pin `cloud: aws` therefore load
+cleanly and fail at *optimize* time with a swap-to-TPU hint — the same
+treatment GPU accelerator strings get (resources.py `_set_accelerators`) —
+rather than exploding at parse time with "unknown cloud".
+"""
+from __future__ import annotations
+
+import typing
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu.clouds import cloud as cloud_lib
+
+if typing.TYPE_CHECKING:
+    from skypilot_tpu import resources as resources_lib
+
+# Provider names accepted by the reference (sky/clouds/__init__.py plus
+# registry aliases). Anything else is still a hard parse error — typos in
+# `cloud:` must not silently become "infeasible".
+FOREIGN_CLOUD_NAMES = frozenset({
+    'aws', 'azure', 'oci', 'ibm', 'lambda', 'lambdacloud', 'scp',
+    'runpod', 'vast', 'vsphere', 'cudo', 'paperspace', 'do',
+    'digitalocean', 'fluidstack', 'nebius', 'hyperbolic', 'seeweb',
+    'coreweave', 'shadeform',
+})
+
+
+class ForeignCloud(cloud_lib.Cloud):
+    """A recognized-but-unsupported provider: parses, never feasible."""
+
+    def __init__(self, name: str):
+        self._name = name.lower()
+        self._REPR = self._name.upper() if len(self._name) <= 3 \
+            else self._name.capitalize()
+
+    @classmethod
+    def canonical_name(cls) -> str:
+        return 'foreign'
+
+    def is_same_cloud(self, other: 'cloud_lib.Cloud') -> bool:
+        return isinstance(other, ForeignCloud) and other._name == self._name
+
+    @classmethod
+    def unsupported_features(
+            cls, resources: 'resources_lib.Resources'
+    ) -> Dict[cloud_lib.CloudImplementationFeatures, str]:
+        return {f: 'provider outside the TPU-first scope'
+                for f in cloud_lib.CloudImplementationFeatures}
+
+    def validate_region_zone(
+            self, region: Optional[str],
+            zone: Optional[str]) -> Tuple[Optional[str], Optional[str]]:
+        # Opaque passthrough: we cannot validate another provider's names.
+        return region, zone
+
+    def regions_with_offering(
+            self, resources: 'resources_lib.Resources'
+    ) -> List[cloud_lib.Region]:
+        return []
+
+    def get_feasible_launchable_resources(
+            self, resources: 'resources_lib.Resources'
+    ) -> Tuple[List['resources_lib.Resources'], List[str]]:
+        return [], [
+            f'cloud {self._name!r} is outside this framework\'s TPU-first '
+            f'scope — swap to `cloud: gcp` (or kubernetes) with a '
+            f'`tpu-v5p-8`-style accelerator'
+        ]
+
+    def __deepcopy__(self, memo):
+        return ForeignCloud(self._name)

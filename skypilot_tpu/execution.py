@@ -112,6 +112,7 @@ def _execute_inner(
         return None, None
 
     if Stage.SYNC_WORKDIR in stages and task.workdir is not None:
+        task.validate_workdir()
         backend.sync_workdir(handle, task.workdir)
     if Stage.SYNC_FILE_MOUNTS in stages and (task.file_mounts or
                                              task.storage_mounts):
@@ -229,6 +230,7 @@ def exec(  # pylint: disable=redefined-builtin
         logger.info(f'Dryrun: would exec on {cluster_name!r}.')
         return None, handle
     if task.workdir is not None:
+        task.validate_workdir()
         backend.sync_workdir(handle, task.workdir)
     job_id = backend.execute(handle, task, detach_run=detach_run)
     return job_id, handle

