@@ -54,6 +54,10 @@ class LlamaConfig:
     param_dtype: Any = jnp.float32          # master param dtype
     remat: str = 'full'                     # 'none' | 'dots' | 'full'
     attention_impl: str = 'auto'            # ops.attention impl
+    # Ring-attention causal shard layout: 'seq' (contiguous) or 'zigzag'
+    # (balanced causal work; tokens/labels/positions must be zigzag-permuted
+    # — train_lib does this when it sees this flag).
+    ring_layout: str = 'seq'
     scan_layers: bool = True
     pipeline_stages: int = 1                # >1: GPipe over the 'stage' axis
     num_microbatches: int = 1               # PP microbatches (divides batch)
@@ -237,18 +241,19 @@ def validate_divisibility(cfg: LlamaConfig, mesh_shape: Dict[str, int]):
 # Forward
 # ---------------------------------------------------------------------------
 
-def _pipelined_layers(x, layers, layer_fn, cfg: LlamaConfig):
-    """GPipe the layer stack over the 'stage' mesh axis (parallel.pipeline)."""
+def _pipelined_layers(x, layers, layer_fn, cfg: LlamaConfig, sin, cos):
+    """GPipe the layer stack over the 'stage' mesh axis (parallel.pipeline).
+
+    layer_fn(x, lp, sin, cos) -> x. With ring attention the region is
+    FLATTENED: manual over both 'stage' and 'sequence', activations and
+    RoPE tables enter sequence-sharded, and attention_block calls the
+    in-region ring directly. Shardy rejects opening a new manual region
+    inside a parent that binds other axes, so nesting the sequence
+    shard_map under the stage one (round-2 design) cannot lower; one
+    merged manual region + the ring's custom_vjp backward is the shape
+    that composes (VERDICT r2 item 3)."""
     from jax.sharding import PartitionSpec as P
     from skypilot_tpu.parallel import pipeline as pipeline_lib
-    if cfg.attention_impl == 'ring':
-        raise NotImplementedError(
-            'pipeline_stages>1 with ring attention: the forward nests the '
-            'sequence shard_map inside the stage shard_map correctly, but '
-            'the backward hits a Shardy limitation (the transposed inner '
-            'manual computation re-binds the stage axis). Needs a single '
-            "merged stage+sequence manual region; use attention_impl "
-            "'flash' with pipeline stages meanwhile.")
     b, s_len, d = x.shape
     m = cfg.num_microbatches
     if b % m != 0:
@@ -263,31 +268,23 @@ def _pipelined_layers(x, layers, layer_fn, cfg: LlamaConfig):
     boundary_dtype = x.dtype if _on_tpu() else jnp.float32
 
     xm = x.reshape(m, b // m, s_len, d).astype(boundary_dtype)
+    ring = cfg.attention_impl == 'ring'
+    axes = {'stage', 'sequence'} if ring else {'stage'}
+    x_spec = P(None, None, 'sequence') if ring else P()
+    rope_spec = P('sequence') if ring else P()
 
-    def sm_fn(layers_local, xm_local):
-        out = pipeline_lib.pipeline_apply(layer_fn, layers_local,
+    def sm_fn(layers_local, xm_local, sin_l, cos_l):
+        def fn(xx, lp):
+            return layer_fn(xx, lp, sin_l, cos_l)
+        out = pipeline_lib.pipeline_apply(fn, layers_local,
                                           xm_local.astype(x.dtype))
         return out.astype(boundary_dtype)
 
-    out = jax.shard_map(sm_fn, in_specs=(P('stage'), P()), out_specs=P(),
-                        axis_names={'stage'}, check_vma=False)(layers, xm)
+    out = jax.shard_map(sm_fn,
+                        in_specs=(P('stage'), x_spec, rope_spec, rope_spec),
+                        out_specs=x_spec, axis_names=axes,
+                        check_vma=False)(layers, xm, sin, cos)
     return out.reshape(b, s_len, d).astype(x.dtype)
-
-
-def _ring_attention_sharded(q, k, v):
-    """Context-parallel attention: manual only over the 'sequence' mesh axis
-    (shard_map), GSPMD keeps handling batch/tensor axes."""
-    from skypilot_tpu.ops import ring_attention as ring_lib
-    from skypilot_tpu.ops.attention import _on_tpu
-    from jax.sharding import PartitionSpec as P
-    import functools as _ft
-    fn = _ft.partial(ring_lib.ring_attention, causal=True,
-                     interpret=not _on_tpu())
-    spec = P(None, 'sequence')
-    # check_vma=False: the causal 'skip' branch returns constants that the
-    # varying-axis checker would reject; semantics are still per-shard.
-    return jax.shard_map(fn, in_specs=(spec, spec, spec), out_specs=spec,
-                         axis_names={'sequence'}, check_vma=False)(q, k, v)
 
 
 def attention_block(x: jnp.ndarray, lp: Params, cfg: LlamaConfig,
@@ -317,7 +314,20 @@ def attention_block(x: jnp.ndarray, lp: Params, cfg: LlamaConfig,
     q = rotary.apply_rope(q, sin, cos)
     kk = rotary.apply_rope(kk, sin, cos)
     if cfg.attention_impl == 'ring':
-        out = _ring_attention_sharded(q, kk, vv)
+        from skypilot_tpu.ops import ring_attention as ring_lib
+        from skypilot_tpu.ops.attention import _on_tpu
+        ring_kw = dict(causal=True,
+                       layout=getattr(cfg, 'ring_layout', 'seq'),
+                       interpret=not _on_tpu())
+        if cfg.pipeline_stages > 1:
+            # Inside the flattened stage+sequence manual region
+            # (_pipelined_layers): 'sequence' is already bound — run the
+            # in-region ring directly.
+            out = ring_lib.ring_attention(q, kk, vv, **ring_kw)
+        else:
+            # GSPMD level: manual only over 'sequence'; batch/tensor axes
+            # stay with the partitioner.
+            out = ring_lib.ring_attention_sharded(q, kk, vv, **ring_kw)
     else:
         out = _attention(q, kk, vv, impl=cfg.attention_impl,
                          causal=True, q_offset=q_offset,
@@ -372,27 +382,42 @@ def forward(params: Params,
     x = con(x, 'batch', 'seq', 'act_embed')
 
     if positions is None:
+        if (cfg.attention_impl == 'ring' and
+                getattr(cfg, 'ring_layout', 'seq') == 'zigzag'):
+            raise ValueError(
+                "ring_layout='zigzag' needs zigzag-permuted tokens and "
+                "explicit `positions` (ops.ring_attention.zigzag_positions)"
+                " — contiguous tokens would be causally masked as if they "
+                "were zigzag chunks. train_lib's train/eval steps do the "
+                "permutation automatically.")
         positions = jnp.arange(s_len) + q_offset
     sin, cos = rotary.rope_frequencies(cfg.hd, positions, cfg.rope_theta,
                                        cfg.rope_scaling)
 
-    layer_fn = functools.partial(_layer, cfg=cfg, rules=rules, sin=sin,
-                                 cos=cos, q_offset=q_offset)
+    # Inside the flattened stage+sequence pipeline region, 'sequence' is a
+    # manual axis — drop it from the layer-internal sharding constraints.
+    layer_rules = (rules.override(seq=None)
+                   if cfg.pipeline_stages > 1 and cfg.attention_impl == 'ring'
+                   else rules)
+
+    def layer_fn(xx, lp, sin_l, cos_l):
+        return _layer(xx, lp, cfg, layer_rules, sin_l, cos_l, q_offset)
+
     policy_name = _REMAT_POLICIES[cfg.remat]
     if policy_name is not None:
         policy = getattr(jax.checkpoint_policies, policy_name)
         layer_fn = jax.checkpoint(layer_fn, policy=policy)
 
     if cfg.pipeline_stages > 1:
-        x = _pipelined_layers(x, params['layers'], layer_fn, cfg)
+        x = _pipelined_layers(x, params['layers'], layer_fn, cfg, sin, cos)
     elif cfg.scan_layers:
         def body(carry, lp):
-            return layer_fn(carry, lp), None
+            return layer_fn(carry, lp, sin, cos), None
         x, _ = jax.lax.scan(body, x, params['layers'])
     else:
         for i in range(cfg.n_layers):
             lp = jax.tree.map(lambda p: p[i], params['layers'])
-            x = layer_fn(x, lp)
+            x = layer_fn(x, lp, sin, cos)
 
     x = norms.rms_norm(x, params['final_norm'], cfg.rms_eps,
                        scale_plus_one=cfg.norm_plus_one)

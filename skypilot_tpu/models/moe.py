@@ -177,7 +177,16 @@ def moe_ffn(x: jnp.ndarray, lp: Params, cfg: MoEConfig,
 
     # Load-balance aux (Switch): E · Σ_e f_e · p̄_e over the top-1 choice.
     top1 = jax.nn.one_hot(gate_idx[..., 0], e, dtype=jnp.float32)
-    aux = e * jnp.sum(top1.mean((0, 1, 2)) * probs.mean((0, 1, 2)))
+    f_e, p_e = top1.mean((0, 1, 2)), probs.mean((0, 1, 2))
+    if cfg.attention_impl == 'ring' and cfg.pipeline_stages > 1:
+        # Flattened stage+sequence region (_pipelined_layers): this call
+        # sees only the local sequence shard. f_e·p̄_e is a product of
+        # per-token means, so averaging per-shard aux values would NOT
+        # equal the full-batch aux — average the mean vectors over
+        # 'sequence' first, then take the product.
+        f_e = jax.lax.pmean(f_e, 'sequence')
+        p_e = jax.lax.pmean(p_e, 'sequence')
+    aux = e * jnp.sum(f_e * p_e)
 
     # Static-capacity dispatch: each (token, choice)'s buffer slot in its
     # expert comes from a cumulative count within the group.
@@ -212,17 +221,14 @@ def _layer(carry, lp, cfg: MoEConfig, rules, sin, cos, q_offset):
     return (x + y, aux_sum + aux)
 
 
-def _pipelined_layers(x, layers, layer_fn, cfg: MoEConfig):
+def _pipelined_layers(x, layers, layer_fn, cfg: MoEConfig, sin, cos):
     """GPipe over 'stage' with the router aux loss riding each microbatch
     through the rotation (parallel/pipeline.py has_aux=True). Mirrors
-    llama._pipelined_layers; the aux scalar of every microbatch is summed
-    on the last stage and psum-broadcast with the activations."""
+    llama._pipelined_layers (incl. the flattened stage+sequence manual
+    region for ring attention); the aux scalar of every microbatch is
+    summed on the last stage and psum-broadcast with the activations."""
     from jax.sharding import PartitionSpec as P
     from skypilot_tpu.parallel import pipeline as pipeline_lib
-    if cfg.attention_impl == 'ring':
-        raise NotImplementedError(
-            'pipeline_stages>1 with ring attention would nest the sequence '
-            'shard_map inside the stage shard_map — not supported yet')
     b, s_len, d = x.shape
     m = cfg.num_microbatches
     if b % m != 0:
@@ -233,15 +239,25 @@ def _pipelined_layers(x, layers, layer_fn, cfg: MoEConfig):
     from skypilot_tpu.ops.attention import _on_tpu
     boundary_dtype = x.dtype if _on_tpu() else jnp.float32
     xm = x.reshape(m, b // m, s_len, d).astype(boundary_dtype)
+    ring = cfg.attention_impl == 'ring'
+    axes = {'stage', 'sequence'} if ring else {'stage'}
+    x_spec = P(None, None, 'sequence') if ring else P()
+    rope_spec = P('sequence') if ring else P()
 
-    def sm_fn(layers_local, xm_local):
+    def sm_fn(layers_local, xm_local, sin_l, cos_l):
+        def fn(carry, lp):
+            return layer_fn(carry, lp, sin_l, cos_l)
         out, aux = pipeline_lib.pipeline_apply(
-            layer_fn, layers_local, xm_local.astype(x.dtype), has_aux=True)
+            fn, layers_local, xm_local.astype(x.dtype), has_aux=True)
+        # (aux needs no 'sequence' reduction here: moe_ffn already pmeans
+        # its per-expert mean vectors across sequence shards, so the aux
+        # scalar is uniform over 'sequence'.)
         return out.astype(boundary_dtype), aux
 
     out, aux = jax.shard_map(
-        sm_fn, in_specs=(P('stage'), P()), out_specs=(P(), P()),
-        axis_names={'stage'}, check_vma=False)(layers, xm)
+        sm_fn, in_specs=(P('stage'), x_spec, rope_spec, rope_spec),
+        out_specs=(x_spec, P()),
+        axis_names=axes, check_vma=False)(layers, xm, sin, cos)
     # Each microbatch's aux is a mean over its own tokens; the sum over M
     # microbatches is M× the full-batch mean the scan path produces.
     return out.reshape(b, s_len, d).astype(x.dtype), aux / m
@@ -263,12 +279,23 @@ def forward(params: Params,
     x = con(x, 'batch', 'seq', 'act_embed')
 
     if positions is None:
+        if (cfg.attention_impl == 'ring' and
+                getattr(cfg, 'ring_layout', 'seq') == 'zigzag'):
+            raise ValueError(
+                "ring_layout='zigzag' needs zigzag-permuted tokens and "
+                "explicit `positions` — see llama.forward; train_lib's "
+                "train/eval steps do the permutation automatically.")
         positions = jnp.arange(s_len) + q_offset
     sin, cos = rotary.rope_frequencies(cfg.hd, positions, cfg.rope_theta,
                                        cfg.rope_scaling)
 
-    layer_fn = functools.partial(_layer, cfg=cfg, rules=rules, sin=sin,
-                                 cos=cos, q_offset=q_offset)
+    layer_rules = (rules.override(seq=None)
+                   if cfg.pipeline_stages > 1 and cfg.attention_impl == 'ring'
+                   else rules)
+
+    def layer_fn(carry, lp, sin_l, cos_l):
+        return _layer(carry, lp, cfg, layer_rules, sin_l, cos_l, q_offset)
+
     policy_name = llama_lib._REMAT_POLICIES[cfg.remat]
     if policy_name is not None:
         policy = getattr(jax.checkpoint_policies, policy_name)
@@ -276,16 +303,17 @@ def forward(params: Params,
 
     aux0 = jnp.zeros((), jnp.float32)
     if cfg.pipeline_stages > 1:
-        x, aux = _pipelined_layers(x, params['layers'], layer_fn, cfg)
+        x, aux = _pipelined_layers(x, params['layers'], layer_fn, cfg,
+                                   sin, cos)
     elif cfg.scan_layers:
         def body(carry, lp):
-            return layer_fn(carry, lp), None
+            return layer_fn(carry, lp, sin, cos), None
         (x, aux), _ = jax.lax.scan(body, (x, aux0), params['layers'])
     else:
         carry = (x, aux0)
         for i in range(cfg.n_layers):
             lp = jax.tree.map(lambda p: p[i], params['layers'])
-            carry = layer_fn(carry, lp)
+            carry = layer_fn(carry, lp, sin, cos)
         x, aux = carry
 
     x = norms.rms_norm(x, params['final_norm'], cfg.rms_eps)

@@ -8,24 +8,83 @@ stable log-sum-exp rule. Communication rides nearest-neighbour ICI links and
 overlaps with the per-step kernel, so attention scales to sequence lengths
 far beyond one chip's HBM.
 
-Must be called INSIDE `shard_map` with q/k/v sharded on their sequence dim
-over `axis_name`. RoPE must already be applied with *global* positions
-(the model does this naturally: sin/cos are sharded alongside the tokens).
+Two entry points, both differentiable — the backward is a hand-written
+forward-style ring (`jax.custom_vjp`), never a transposed collective:
+  * `ring_attention(...)` — call INSIDE a manual region that binds
+    `axis_name` (a shard_map, or the flattened stage+sequence pipeline
+    region in models/llama.py).
+  * `ring_attention_sharded(...)` — call OUTSIDE any manual region (GSPMD
+    level): a plain shard_map over `ring_attention`. Shardy rejects
+    opening a new manual region under a parent that binds other axes, so
+    pipeline callers flatten to one stage+sequence region and use
+    `ring_attention` directly instead.
 
-Causal layout note: plain sequential sharding makes causal load imbalanced
-(shard i only attends i+1 of n steps); `zigzag=True` is reserved for the
-balanced layout (future work).
+RoPE must already be applied with *global* positions (the model does this
+naturally: sin/cos are sharded alongside the tokens; for zigzag the caller
+permutes positions with `zigzag_positions`).
+
+Causal layouts:
+  * 'seq'    — contiguous shards. Simple, but shard i only has causal work
+    for i+1 of n ring steps: the last shard does ~n× the work of the first.
+  * 'zigzag' — the global sequence is split into 2n chunks and shard i
+    holds chunks (i, 2n-1-i), so every shard does the same causal work
+    (the balanced layout from the Striped/zigzag ring-attention line of
+    work). Requires the tokens to be laid out zigzag — use
+    `zigzag_positions`/`zigzag_permute` on tokens, labels and positions.
 """
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
 
 NEG_INF = -2.0 ** 30
 
+
+# ---------------------------------------------------------------------------
+# Zigzag layout helpers
+# ---------------------------------------------------------------------------
+
+def zigzag_chunk_order(n_shards: int) -> list:
+    """Chunk ids in device-layout order: shard i holds (i, 2n-1-i)."""
+    return [c for s in range(n_shards) for c in (s, 2 * n_shards - 1 - s)]
+
+
+def zigzag_positions(seq_len: int, n_shards: int) -> np.ndarray:
+    """positions[j] = original sequence position stored at layout slot j.
+
+    Doubles as the gather index that permutes a contiguous sequence into
+    zigzag layout, and as the `positions` argument for RoPE. Pure numpy so
+    it stays a compile-time constant under jit."""
+    if seq_len % (2 * n_shards) != 0:
+        raise ValueError(f'seq_len {seq_len} must divide into '
+                         f'2*{n_shards} zigzag chunks.')
+    chunk = seq_len // (2 * n_shards)
+    order = zigzag_chunk_order(n_shards)
+    return np.concatenate(
+        [np.arange(c * chunk, (c + 1) * chunk) for c in order])
+
+
+def zigzag_permute(x: jnp.ndarray, n_shards: int, axis: int = 1
+                   ) -> jnp.ndarray:
+    """Reorder a contiguous sequence dim into zigzag device layout."""
+    return jnp.take(x, zigzag_positions(x.shape[axis], n_shards), axis=axis)
+
+
+def zigzag_unpermute(x: jnp.ndarray, n_shards: int, axis: int = 1
+                     ) -> jnp.ndarray:
+    """Inverse of `zigzag_permute` (static scatter)."""
+    inv = np.argsort(zigzag_positions(x.shape[axis], n_shards))
+    return jnp.take(x, inv, axis=axis)
+
+
+# ---------------------------------------------------------------------------
+# Log-sum-exp combine + single-block partials
+# ---------------------------------------------------------------------------
 
 def _combine(o: jnp.ndarray, lse: jnp.ndarray, o_i: jnp.ndarray,
              lse_i: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
@@ -43,7 +102,7 @@ def _combine(o: jnp.ndarray, lse: jnp.ndarray, o_i: jnp.ndarray,
 
 
 def _partial(q, k, v, causal: bool, softmax_scale, interpret: bool):
-    """(out [B,S,H,D], lse [B,S,H]) for one ring step."""
+    """(out [B,S,H,D], lse [B,S,H]) for one visible block."""
     from skypilot_tpu.ops.attention import _flash_ok, xla_attention_lse
     use_flash = (not interpret and _flash_ok(q, k))
     if use_flash:
@@ -54,25 +113,55 @@ def _partial(q, k, v, causal: bool, softmax_scale, interpret: bool):
                              softmax_scale=softmax_scale)
 
 
-def ring_attention(q: jnp.ndarray,
-                   k: jnp.ndarray,
-                   v: jnp.ndarray,
-                   *,
-                   axis_name: str = 'sequence',
-                   causal: bool = True,
-                   softmax_scale: Optional[float] = None,
-                   interpret: bool = False) -> jnp.ndarray:
-    """Exact attention over a sequence-sharded q/k/v. Call inside shard_map.
+def _block_partial(qa, kb, vb, rel, softmax_scale, interpret):
+    """Partial for one q-chunk × kv-chunk pair.
 
-    q [B,Sl,H,D], k/v [B,Sl,KH,D] — Sl is the per-device shard. Returns the
-    local output shard [B,Sl,H,D] in q.dtype.
-    """
+    rel (traced int32): 0 = kv chunk strictly earlier (fully visible),
+    1 = same chunk (causal diagonal), 2 = kv later (skip)."""
+    part = functools.partial(_partial, softmax_scale=softmax_scale,
+                             interpret=interpret)
+    b, sq, h, d = qa.shape
+
+    def full(_):
+        return part(qa, kb, vb, causal=False)
+
+    def diag(_):
+        return part(qa, kb, vb, causal=True)
+
+    def skip(_):
+        return (jnp.zeros((b, sq, h, d), qa.dtype),
+                jnp.full((b, sq, h), NEG_INF, jnp.float32))
+
+    return jax.lax.switch(rel, [full, diag, skip], None)
+
+
+def _chunk_ids(shard_idx, n: int, layout: str):
+    if layout == 'zigzag':
+        return (shard_idx, 2 * n - 1 - shard_idx)
+    return (shard_idx,)
+
+
+def _rel(q_chunk, kv_chunk):
+    return jnp.where(kv_chunk == q_chunk, 1,
+                     jnp.where(kv_chunk < q_chunk, 0, 2)).astype(jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# Forward (inside shard_map)
+# ---------------------------------------------------------------------------
+
+def _ring_forward(q, k, v, *, axis_name, causal, softmax_scale, layout,
+                  interpret):
+    """(out [B,Sl,H,D] q.dtype, lse [B,Sl,H] f32). Call inside shard_map."""
     n = jax.lax.axis_size(axis_name)
     me = jax.lax.axis_index(axis_name)
     b, sl, h, d = q.shape
     perm = [(j, (j + 1) % n) for j in range(n)]
-    part = functools.partial(_partial, softmax_scale=softmax_scale,
-                             interpret=interpret)
+    qcs = _chunk_ids(me, n, layout) if causal else (me,)
+    ncq = len(qcs)
+    csize = sl // ncq
+    if causal and layout == 'zigzag' and sl % 2 != 0:
+        raise ValueError(f'zigzag needs an even local shard, got {sl}')
 
     o0 = jnp.zeros((b, sl, h, d), jnp.float32)
     lse0 = jnp.full((b, sl, h), NEG_INF, jnp.float32)
@@ -81,26 +170,238 @@ def ring_attention(q: jnp.ndarray,
         o, lse, k_c, v_c = carry
         src = (me - i) % n                     # whose kv shard we hold now
 
-        if causal:
-            def diag(_):
-                return part(q, k_c, v_c, causal=True)
-
-            def earlier(_):
-                return part(q, k_c, v_c, causal=False)
-
-            def skip(_):
-                return (jnp.zeros((b, sl, h, d), q.dtype),
-                        jnp.full((b, sl, h), NEG_INF, jnp.float32))
-
-            idx = jnp.where(src == me, 1, jnp.where(src < me, 0, 2))
-            o_i, lse_i = jax.lax.switch(idx, [earlier, diag, skip], None)
+        if not causal:
+            o_i, lse_i = _partial(q, k_c, v_c, causal=False,
+                                  softmax_scale=softmax_scale,
+                                  interpret=interpret)
         else:
-            o_i, lse_i = part(q, k_c, v_c, causal=False)
+            kcs = _chunk_ids(src, n, layout)
+            o_rows, lse_rows = [], []
+            for a in range(ncq):
+                qa = q[:, a * csize:(a + 1) * csize]
+                o_a = jnp.zeros((b, csize, h, d), jnp.float32)
+                lse_a = jnp.full((b, csize, h), NEG_INF, jnp.float32)
+                for bi in range(len(kcs)):
+                    kb = k_c[:, bi * csize:(bi + 1) * csize]
+                    vb = v_c[:, bi * csize:(bi + 1) * csize]
+                    o_ab, lse_ab = _block_partial(
+                        qa, kb, vb, _rel(qcs[a], kcs[bi]),
+                        softmax_scale, interpret)
+                    o_a, lse_a = _combine(o_a, lse_a, o_ab, lse_ab)
+                o_rows.append(o_a)
+                lse_rows.append(lse_a)
+            o_i = jnp.concatenate(o_rows, axis=1)
+            lse_i = jnp.concatenate(lse_rows, axis=1)
 
         o, lse = _combine(o, lse, o_i, lse_i)
         k_c = jax.lax.ppermute(k_c, axis_name, perm)
         v_c = jax.lax.ppermute(v_c, axis_name, perm)
         return (o, lse, k_c, v_c), None
 
-    (o, _, _, _), _ = jax.lax.scan(body, (o0, lse0, k, v), jnp.arange(n))
-    return o.astype(q.dtype)
+    (o, lse, _, _), _ = jax.lax.scan(body, (o0, lse0, k, v), jnp.arange(n))
+    return o.astype(q.dtype), lse
+
+
+class _RingOpts(NamedTuple):
+    axis_name: str
+    causal: bool
+    softmax_scale: Optional[float]
+    layout: str
+    interpret: bool
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0,))
+def _ring_local(opts: _RingOpts, q, k, v):
+    out, _ = _ring_forward(q, k, v, axis_name=opts.axis_name,
+                           causal=opts.causal,
+                           softmax_scale=opts.softmax_scale,
+                           layout=opts.layout, interpret=opts.interpret)
+    return out
+
+
+def _ring_local_fwd(opts, q, k, v):
+    out, lse = _ring_forward(q, k, v, axis_name=opts.axis_name,
+                             causal=opts.causal,
+                             softmax_scale=opts.softmax_scale,
+                             layout=opts.layout, interpret=opts.interpret)
+    return out, (q, k, v, out, lse)
+
+
+def _ring_local_bwd(opts, res, g):
+    q, k, v, o, lse = res
+    dq, dk, dv = _ring_backward(q, k, v, o, lse, g,
+                                axis_name=opts.axis_name, causal=opts.causal,
+                                softmax_scale=opts.softmax_scale,
+                                layout=opts.layout)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_ring_local.defvjp(_ring_local_fwd, _ring_local_bwd)
+
+
+def ring_attention(q: jnp.ndarray,
+                   k: jnp.ndarray,
+                   v: jnp.ndarray,
+                   *,
+                   axis_name: str = 'sequence',
+                   causal: bool = True,
+                   softmax_scale: Optional[float] = None,
+                   layout: str = 'seq',
+                   interpret: bool = False) -> jnp.ndarray:
+    """Exact attention over a sequence-sharded q/k/v. Call inside shard_map
+    (or any manual region that binds `axis_name`, e.g. a flattened
+    stage+sequence pipeline region).
+
+    q [B,Sl,H,D], k/v [B,Sl,KH,D] — Sl is the per-device shard. Returns the
+    local output shard [B,Sl,H,D] in q.dtype. Differentiable: the backward
+    is an explicit forward-style ring (custom_vjp), never a transposed
+    collective — this is what lets the ring live inside other manual
+    regions without tripping Shardy's nested-manual rebind.
+    """
+    return _ring_local(
+        _RingOpts(axis_name, causal, softmax_scale, layout, interpret),
+        q, k, v)
+
+
+# ---------------------------------------------------------------------------
+# Backward (inside shard_map)
+# ---------------------------------------------------------------------------
+
+def _block_grads(qa, do_a, lse_a, delta_a, kb, vb, rel, scale):
+    """Flash-style block gradients for one q-chunk × kv-chunk pair.
+
+    Uses the FINAL forward lse (global softmax normalizer) so each block's
+    probabilities are already correctly normalized:
+      P = exp(S - lse);  dV = Pᵀ·dO;  dP = dO·Vᵀ;
+      dS = P ⊙ (dP - Δ)  with Δ = rowsum(dO ⊙ O);
+      dQ = dS·K·scale;   dK = dSᵀ·Q·scale.
+    Shapes: qa/do_a [B,Sq,H,D], kb/vb [B,Tk,KH,D], lse_a/delta_a [B,Sq,H].
+    """
+    b, sq, h, d = qa.shape
+    tk, kh = kb.shape[1], kb.shape[2]
+    g = h // kh
+
+    def compute(masked):
+        qg = qa.reshape(b, sq, kh, g, d).astype(jnp.float32)
+        dog = do_a.reshape(b, sq, kh, g, d).astype(jnp.float32)
+        kf = kb.astype(jnp.float32)
+        vf = vb.astype(jnp.float32)
+        s = jnp.einsum('bskgd,btkd->bkgst', qg, kf) * scale
+        if masked:
+            causal_mask = (jnp.arange(sq)[:, None] >=
+                           jnp.arange(tk)[None, :])
+            s = jnp.where(causal_mask[None, None, None], s, NEG_INF)
+        lse_g = lse_a.reshape(b, sq, kh, g).transpose(0, 2, 3, 1)
+        p = jnp.exp(s - lse_g[..., None])
+        dv = jnp.einsum('bkgst,bskgd->btkd', p, dog)
+        dp = jnp.einsum('bskgd,btkd->bkgst', dog, vf)
+        delta_g = delta_a.reshape(b, sq, kh, g).transpose(0, 2, 3, 1)
+        ds = p * (dp - delta_g[..., None])
+        dq = jnp.einsum('bkgst,btkd->bskgd', ds, kf).reshape(
+            b, sq, h, d) * scale
+        dk = jnp.einsum('bkgst,bskgd->btkd', ds, qg) * scale
+        return dq, dk, dv
+
+    def full(_):
+        return compute(masked=False)
+
+    def diag(_):
+        return compute(masked=True)
+
+    def skip(_):
+        return (jnp.zeros((b, sq, h, d), jnp.float32),
+                jnp.zeros((b, tk, kh, d), jnp.float32),
+                jnp.zeros((b, tk, kh, d), jnp.float32))
+
+    return jax.lax.switch(rel, [full, diag, skip], None)
+
+
+def _ring_backward(q, k, v, o, lse, do, *, axis_name, causal, softmax_scale,
+                   layout):
+    """(dq, dk, dv) local shards (f32). Call inside shard_map.
+
+    The kv shards rotate exactly as in forward, with their gradient
+    accumulators travelling alongside: after n steps each (dk, dv) has
+    collected every device's contribution and is home again."""
+    n = jax.lax.axis_size(axis_name)
+    me = jax.lax.axis_index(axis_name)
+    b, sl, h, d = q.shape
+    kh = k.shape[2]
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+    qcs = _chunk_ids(me, n, layout) if causal else (me,)
+    ncq = len(qcs)
+    csize = sl // ncq
+
+    # Δ = rowsum(dO ⊙ O): one vector per q position, shared by every block.
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    dq0 = jnp.zeros((b, sl, h, d), jnp.float32)
+    dk0 = jnp.zeros((b, sl, kh, d), jnp.float32)
+    dv0 = jnp.zeros((b, sl, kh, d), jnp.float32)
+
+    def body(carry, i):
+        dq, k_c, v_c, dk_c, dv_c = carry
+        src = (me - i) % n
+
+        if not causal:
+            dq_i, dk_i, dv_i = _block_grads(
+                q, do, lse, delta, k_c, v_c, jnp.int32(0), scale)
+            dq = dq + dq_i
+            dk_c = dk_c + dk_i
+            dv_c = dv_c + dv_i
+        else:
+            kcs = _chunk_ids(src, n, layout)
+            for a in range(ncq):
+                sla = slice(a * csize, (a + 1) * csize)
+                for bi in range(len(kcs)):
+                    slb = slice(bi * csize, (bi + 1) * csize)
+                    dq_ab, dk_ab, dv_ab = _block_grads(
+                        q[:, sla], do[:, sla], lse[:, sla], delta[:, sla],
+                        k_c[:, slb], v_c[:, slb],
+                        _rel(qcs[a], kcs[bi]), scale)
+                    dq = dq.at[:, sla].add(dq_ab)
+                    dk_c = dk_c.at[:, slb].add(dk_ab)
+                    dv_c = dv_c.at[:, slb].add(dv_ab)
+
+        k_c = jax.lax.ppermute(k_c, axis_name, perm)
+        v_c = jax.lax.ppermute(v_c, axis_name, perm)
+        dk_c = jax.lax.ppermute(dk_c, axis_name, perm)
+        dv_c = jax.lax.ppermute(dv_c, axis_name, perm)
+        return (dq, k_c, v_c, dk_c, dv_c), None
+
+    (dq, _, _, dk, dv), _ = jax.lax.scan(
+        body, (dq0, k, v, dk0, dv0), jnp.arange(n))
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# GSPMD-level entry point with custom VJP
+# ---------------------------------------------------------------------------
+
+def ring_attention_sharded(q: jnp.ndarray,
+                           k: jnp.ndarray,
+                           v: jnp.ndarray,
+                           *,
+                           axis_name: str = 'sequence',
+                           causal: bool = True,
+                           softmax_scale: Optional[float] = None,
+                           layout: str = 'seq',
+                           interpret: bool = False) -> jnp.ndarray:
+    """Context-parallel attention at the GSPMD level (call OUTSIDE any
+    manual region; q/k/v are globally-shaped arrays sharded on dim 1).
+
+    A plain shard_map over `ring_attention`; autodiff goes through the
+    local custom_vjp (explicit ring backward), so no collective is ever
+    transposed. Callers already inside a manual region that binds
+    `axis_name` (e.g. the flattened stage+sequence pipeline region) should
+    call `ring_attention` directly instead — Shardy rejects opening a new
+    manual region for an axis under a parent that already binds others."""
+    spec = P(None, axis_name)
+    fn = functools.partial(ring_attention, axis_name=axis_name,
+                           causal=causal, softmax_scale=softmax_scale,
+                           layout=layout, interpret=interpret)
+    # check_vma=False: the causal 'skip' branch returns constants that the
+    # varying-axis checker would reject; semantics are still per-shard.
+    return jax.shard_map(fn, in_specs=(spec, spec, spec), out_specs=spec,
+                         axis_names={axis_name}, check_vma=False)(q, k, v)

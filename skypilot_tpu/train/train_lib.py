@@ -25,6 +25,38 @@ from skypilot_tpu.parallel import sharding as sharding_lib
 Batch = Dict[str, jnp.ndarray]
 
 
+def _zigzag_seq_shards(cfg, mesh: Mesh) -> int:
+    """0 when the config doesn't use zigzag ring attention; otherwise the
+    'sequence' mesh size (>=1) — the zigzag layout then applies to
+    tokens/targets/positions even at size 1 (identity permutation), so the
+    model's explicit-positions guard is always satisfied."""
+    if (getattr(cfg, 'attention_impl', '') == 'ring' and
+            getattr(cfg, 'ring_layout', 'seq') == 'zigzag'):
+        return max(1, dict(mesh.shape).get('sequence', 1))
+    return 0
+
+
+def _zigzag_shift(tokens, mask, n_seq: int):
+    """Shift tokens into (inputs, targets) and lay the sequence dim out in
+    zigzag order so every 'sequence' shard does equal causal ring work
+    (ops/ring_attention.py). Returns (inputs, targets, mask, positions);
+    positions are the original sequence positions each layout slot holds —
+    forward() feeds them to RoPE, so the permutation is invisible to the
+    math (CE loss is a masked mean over positions, permutation-invariant).
+    n_seq == 0 means "not zigzag": no permutation, default positions.
+    """
+    from skypilot_tpu.ops import ring_attention as ring_lib
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    if n_seq == 0:
+        return inputs, targets, mask, None
+    perm = ring_lib.zigzag_positions(inputs.shape[1], n_seq)
+    inputs = jnp.take(inputs, perm, axis=1)
+    targets = jnp.take(targets, perm, axis=1)
+    if mask is not None:
+        mask = jnp.take(mask, perm, axis=1)
+    return inputs, targets, mask, perm
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class TrainState:
@@ -123,15 +155,20 @@ def make_train_step(cfg: 'llama.LlamaConfig', mesh: Mesh,
     shardings = state_shardings(cfg, mesh, tx, rules)
     mod = models_lib.module_for(cfg)
 
+    n_zigzag = _zigzag_seq_shards(cfg, mesh)
+
     def _grads_of(params, tokens, mask):
-        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        inputs, targets, mask, positions = _zigzag_shift(tokens, mask,
+                                                         n_zigzag)
 
         def loss_fn(p):
             if getattr(mod, 'HAS_AUX', False):
                 logits, aux = mod.forward(p, inputs, cfg, rules,
+                                          positions=positions,
                                           return_aux=True)
             else:
-                logits, aux = mod.forward(p, inputs, cfg, rules), 0.0
+                logits, aux = mod.forward(p, inputs, cfg, rules,
+                                          positions=positions), 0.0
             loss, denom = cross_entropy_loss(logits, targets, mask)
             return loss + aux, (loss, denom)
 
@@ -208,16 +245,19 @@ def make_eval_step(cfg: 'llama.LlamaConfig', mesh: Mesh,
     rules = rules or sharding_lib.Rules()
     mod = models_lib.module_for(cfg)
 
+    n_zigzag = _zigzag_seq_shards(cfg, mesh)
+
     def eval_fn(params, batch: Batch):
         tokens = batch['tokens']
-        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        inputs, targets, mask, positions = _zigzag_shift(
+            tokens, batch.get('loss_mask'), n_zigzag)
         if getattr(mod, 'HAS_AUX', False):
             logits, _ = mod.forward(params, inputs, cfg, rules,
-                                    return_aux=True)
+                                    positions=positions, return_aux=True)
         else:
-            logits = mod.forward(params, inputs, cfg, rules)
-        loss, _ = cross_entropy_loss(logits, targets,
-                                     batch.get('loss_mask'))
+            logits = mod.forward(params, inputs, cfg, rules,
+                                 positions=positions)
+        loss, _ = cross_entropy_loss(logits, targets, mask)
         return loss
 
     jitted = jax.jit(eval_fn,

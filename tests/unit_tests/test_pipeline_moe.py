@@ -177,6 +177,49 @@ class TestMoE:
         router_g = np.asarray(g_aux['layers']['router'])
         assert np.isfinite(router_g).all() and np.abs(router_g).max() > 0
 
+    def test_pp_moe_ring_forward_aux_and_grads(self):
+        """EP×PP×SP cell: MoE with ring attention inside the flattened
+        stage+sequence pipeline region. Logits must match the dense scan
+        path; aux must match the *pipelined* non-ring path closely (moe_ffn
+        pmeans its per-expert mean vectors over 'sequence', so sequence
+        sharding does not change the aux semantics beyond microbatching);
+        grads must be finite with a live router gradient.
+
+        router_group_size=16 on every config so routing-group boundaries
+        coincide with the 16-token sequence shards — otherwise the
+        sequence-local dispatch legitimately groups (and capacity-drops)
+        differently from the dense path and logits can't be compared."""
+        base = dataclasses.replace(MOE_CFG, router_group_size=16)
+        params = moe.init_params(jax.random.PRNGKey(0), base)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (4, 32), 0,
+                                    base.vocab_size, jnp.int32)
+        ref = moe.forward(params, tokens, base)
+        cfg_pp = dataclasses.replace(base, pipeline_stages=2,
+                                     num_microbatches=2)
+        cfg_rp = dataclasses.replace(cfg_pp, attention_impl='ring')
+        mesh = build_mesh(MeshSpec(fsdp=1, stage=2, sequence=2, data=2),
+                          devices=jax.devices('cpu'))
+        with use_mesh(mesh):
+            out, aux_rp = jax.jit(
+                lambda p, t: moe.forward(p, t, cfg_rp, return_aux=True))(
+                    params, tokens)
+            _, aux_pp = jax.jit(
+                lambda p, t: moe.forward(p, t, cfg_pp, return_aux=True))(
+                    params, tokens)
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                                   atol=2e-2, rtol=2e-2)
+        np.testing.assert_allclose(float(aux_pp), float(aux_rp), rtol=2e-2)
+
+        def loss(p):
+            logits, aux = moe.forward(p, tokens, cfg_rp, return_aux=True)
+            return (logits.astype(jnp.float32)**2).mean() + aux
+        with use_mesh(mesh):
+            g = jax.jit(jax.grad(loss))(params)
+        leaves = jax.tree.leaves(g)
+        assert all(np.isfinite(np.asarray(x)).all() for x in leaves)
+        router_g = np.asarray(g['layers']['router'])
+        assert np.abs(router_g).max() > 0
+
     def test_capacity_rounding(self):
         assert moe.capacity(MOE_CFG, 32) >= 8
         assert moe.capacity(MOE_CFG, 32) % 8 == 0

@@ -77,6 +77,106 @@ def test_xla_attention_lse_matches():
     assert bool(jnp.all(jnp.isfinite(lse)))
 
 
+def test_zigzag_permute_roundtrip():
+    x = jnp.arange(2 * 32 * 3).reshape(2, 32, 3)
+    for n in (2, 4):
+        y = ring_lib.zigzag_permute(x, n)
+        assert y.shape == x.shape
+        np.testing.assert_array_equal(
+            np.asarray(ring_lib.zigzag_unpermute(y, n)), np.asarray(x))
+    # shard i holds chunks (i, 2n-1-i)
+    assert ring_lib.zigzag_chunk_order(4) == [0, 7, 1, 6, 2, 5, 3, 4]
+
+
+@pytest.mark.parametrize('layout', ['seq', 'zigzag'])
+def test_sharded_ring_matches_full_fwd_and_grads(qkv, layout):
+    """ring_attention_sharded (GSPMD-level, custom_vjp) vs dense — forward
+    AND input gradients, both layouts."""
+    q, k, v = qkv
+    n = 4
+    mesh = build_mesh(MeshSpec(fsdp=1, sequence=n),
+                      devices=jax.devices('cpu')[:n])
+
+    def permute(x):
+        return ring_lib.zigzag_permute(x, n) if layout == 'zigzag' else x
+
+    def unpermute(x):
+        return ring_lib.zigzag_unpermute(x, n) if layout == 'zigzag' else x
+
+    def ring_loss(q, k, v):
+        out = ring_lib.ring_attention_sharded(
+            permute(q), permute(k), permute(v), causal=True, layout=layout,
+            interpret=True)
+        # weight positions so the loss is permutation-sensitive
+        w = jnp.arange(S, dtype=jnp.float32)[None, :, None, None]
+        return (unpermute(out).astype(jnp.float32) ** 2 * w).sum()
+
+    def dense_loss(q, k, v):
+        out = xla_attention(q, k, v, causal=True)
+        w = jnp.arange(S, dtype=jnp.float32)[None, :, None, None]
+        return (out.astype(jnp.float32) ** 2 * w).sum()
+
+    with use_mesh(mesh):
+        l_ring, g_ring = jax.jit(jax.value_and_grad(ring_loss,
+                                                    argnums=(0, 1, 2)))(q, k, v)
+    l_ref, g_ref = jax.value_and_grad(dense_loss, argnums=(0, 1, 2))(q, k, v)
+    assert abs(float(l_ring) - float(l_ref)) / abs(float(l_ref)) < 2e-2
+    for a, b in zip(g_ring, g_ref):
+        scale = float(jnp.max(jnp.abs(b.astype(jnp.float32)))) + 1e-6
+        err = float(jnp.max(jnp.abs(a.astype(jnp.float32) -
+                                    b.astype(jnp.float32)))) / scale
+        assert err < 2e-2, err  # relative: bf16 inputs, large sum-loss
+
+
+def test_train_step_zigzag_matches_dense():
+    """Full train step with zigzag ring == dense-attention train step:
+    same loss, same updated params (the layout permutation is invisible)."""
+    from skypilot_tpu.train import train_lib
+    cfg = dataclasses.replace(llama.PRESETS['llama-debug'], remat='none')
+    cfg_zz = dataclasses.replace(cfg, attention_impl='ring',
+                                 ring_layout='zigzag')
+    mesh = build_mesh(MeshSpec(fsdp=1, sequence=4, data=2),
+                      devices=jax.devices('cpu'))
+    tx = train_lib.default_optimizer()
+    batch = train_lib.synthetic_batch(jax.random.PRNGKey(1), 2, 64,
+                                      cfg.vocab_size)
+    losses, steps = [], []
+    for c in (cfg, cfg_zz):
+        state = train_lib.init_train_state(jax.random.PRNGKey(0), c, mesh, tx)
+        step = train_lib.make_train_step(c, mesh, tx)
+        new_state, metrics = step(state, batch)
+        losses.append(float(metrics['loss']))
+        steps.append(new_state)
+    assert abs(losses[0] - losses[1]) < 2e-3, losses
+    err = max(jax.tree.leaves(jax.tree.map(
+        lambda a, b: float(jnp.max(jnp.abs(a - b))),
+        steps[0].params, steps[1].params)))
+    assert err < 1e-2, err
+
+
+def test_ring_composes_with_pipeline_grads():
+    """Ring attention under GPipe: backward must work (the custom_vjp ring
+    avoids transposing a nested manual region — VERDICT r2 item 3)."""
+    cfg = llama.PRESETS['llama-debug']
+    cfg_rp = dataclasses.replace(cfg, attention_impl='ring',
+                                 pipeline_stages=2, num_microbatches=2)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 64), 0,
+                                cfg.vocab_size, jnp.int32)
+    mesh = build_mesh(MeshSpec(fsdp=1, sequence=2, stage=2, data=2),
+                      devices=jax.devices('cpu'))
+
+    def loss(p, c):
+        return (llama.forward(p, tokens, c).astype(jnp.float32) ** 2).mean()
+
+    g_ref = jax.grad(functools.partial(loss, c=cfg))(params)
+    with use_mesh(mesh):
+        g_rp = jax.jit(jax.grad(functools.partial(loss, c=cfg_rp)))(params)
+    err = max(jax.tree.leaves(jax.tree.map(
+        lambda a, b: float(jnp.max(jnp.abs(a - b))), g_ref, g_rp)))
+    assert err < 1e-3, err
+
+
 def test_model_ring_matches_xla_grads():
     cfg = llama.PRESETS['llama-debug']
     cfg_ring = dataclasses.replace(cfg, attention_impl='ring')
