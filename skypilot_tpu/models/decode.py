@@ -167,9 +167,15 @@ def prefill(params, tokens: jnp.ndarray, cfg: llama.LlamaConfig,
 
 def decode_step(params, token: jnp.ndarray, cache: KVCache,
                 cfg: llama.LlamaConfig,
-                rules: Optional[sharding_lib.Rules] = None
+                rules: Optional[sharding_lib.Rules] = None,
+                active: Optional[jnp.ndarray] = None
                 ) -> Tuple[jnp.ndarray, KVCache]:
     """One incremental step. token [B] int32 → (logits [B, vocab], cache).
+
+    `active` [B] bool (continuous batching): rows where it is False do not
+    advance their cache length — their compute still runs (static shapes)
+    but writes land on the row's frozen `length` slot, which the next
+    admission overwrites, and the caller discards their logits.
 
     The cache rides the scan CARRY (updated with per-layer
     dynamic_update_slice), not the xs→ys stream: stacking per-layer ys
@@ -218,7 +224,8 @@ def decode_step(params, token: jnp.ndarray, cache: KVCache,
     (x, ks, vs), _ = jax.lax.scan(
         body, (x, cache.k, cache.v), (params['layers'], layer_ids))
     logits = _unembed(x, params, cfg)
-    new_cache = KVCache(k=ks, v=vs, length=length + 1)
+    advance = 1 if active is None else active.astype(jnp.int32)
+    new_cache = KVCache(k=ks, v=vs, length=length + advance)
     return logits[:, 0], new_cache
 
 
@@ -249,6 +256,43 @@ def _select_token(logits: jnp.ndarray, temperature: float,
                          axis=-1, keepdims=True)
         logits = jnp.where(logits < cutoff, neg_inf, logits)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def select_token_per_row(logits: jnp.ndarray, temperature: jnp.ndarray,
+                         top_k: jnp.ndarray, top_p: jnp.ndarray,
+                         rng: jax.Array) -> jnp.ndarray:
+    """Vectorized PER-ROW sampling for the continuous batcher: rows with
+    different sampling params share one compiled step.
+
+    logits [B,V]; temperature [B] f32 (<=0 → greedy); top_k [B] int32
+    (<=0 → off, values clamped to vocab — an oversized client top_k can
+    not fail the batch); top_p [B] f32 (outside (0,1) → off). Same mask
+    construction as `_select_token`, lifted to per-row thresholds.
+    """
+    b, v = logits.shape
+    del b
+    logits = logits.astype(jnp.float32)
+    greedy = temperature <= 0.0
+    scaled = logits / jnp.where(greedy, 1.0, temperature)[:, None]
+    neg_inf = jnp.finfo(jnp.float32).min
+    # top-k: per-row threshold at the k-th highest logit.
+    asc = jnp.sort(scaled, axis=-1)                     # ascending [B, V]
+    k = jnp.clip(top_k, 1, v)
+    kth = jnp.take_along_axis(asc, (v - k)[:, None], axis=-1)
+    use_k = (top_k > 0)[:, None]
+    scaled = jnp.where(use_k & (scaled < kth), neg_inf, scaled)
+    # top-p nucleus on the (possibly top-k-filtered) logits.
+    desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(desc, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    use_p = (top_p > 0.0) & (top_p < 1.0)
+    p_eff = jnp.where(use_p, top_p, 1.0)[:, None]
+    keep = (cum - probs) < p_eff                        # rank 0 always kept
+    cutoff = jnp.min(jnp.where(keep, desc, jnp.inf), axis=-1, keepdims=True)
+    scaled = jnp.where(use_p[:, None] & (scaled < cutoff), neg_inf, scaled)
+    sampled = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(greedy, jnp.argmax(logits, axis=-1).astype(jnp.int32),
+                     sampled)
 
 
 @functools.partial(jax.jit,

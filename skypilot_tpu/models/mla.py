@@ -312,7 +312,11 @@ def prefill(params, tokens: jnp.ndarray, cfg: MLAConfig, max_len: int,
 
 
 def decode_step(params, token: jnp.ndarray, cache: LatentCache,
-                cfg: MLAConfig) -> Tuple[jnp.ndarray, LatentCache]:
+                cfg: MLAConfig,
+                active: Optional[jnp.ndarray] = None
+                ) -> Tuple[jnp.ndarray, LatentCache]:
+    """One incremental step over the latent cache. `active` [B] bool: see
+    decode.decode_step — continuous-batching rows that must not advance."""
     b = token.shape[0]
     length = cache.length
     rows = jnp.arange(b)
@@ -347,8 +351,9 @@ def decode_step(params, token: jnp.ndarray, cache: LatentCache,
     head = (params['embed'].T if cfg.tie_embeddings else params['lm_head'])
     logits = jnp.einsum('bsd,dv->bsv', x, head.astype(cfg.dtype),
                         preferred_element_type=jnp.float32)
+    advance = 1 if active is None else active.astype(jnp.int32)
     return logits[:, 0], LatentCache(c_kv=cs, k_rope=krs,
-                                     length=length + 1)
+                                     length=length + advance)
 
 
 @functools.partial(jax.jit,

@@ -1,0 +1,99 @@
+"""Thin REST client for the Compute Engine v1 API — firewall rules.
+
+Reference analog: sky/provision/gcp/instance_utils.py
+`GCPComputeInstance.create_or_update_firewall_rule:571` /
+`delete_firewall_rule:552`, which go through the googleapis discovery
+client; here a plain REST client sharing tpu_api's request plumbing (and
+therefore the fake-server test seam at `requests.request`).
+
+Design note: the reference must tag instances after the fact
+(`add_network_tag_if_not_exist`) because Ray creates its VMs; our TPU
+nodes are created by us with the cluster network tag already on the node
+body (instance._node_body), so opening ports is ONLY a firewall-rule
+upsert — no per-instance mutation, no extra LROs.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+from skypilot_tpu import sky_logging
+from skypilot_tpu.provision.gcp import tpu_api
+
+logger = sky_logging.init_logger(__name__)
+
+_API_ROOT = 'https://compute.googleapis.com/compute/v1'
+_OPERATION_POLL_SECONDS = 2
+_OPERATION_TIMEOUT_SECONDS = 300
+
+
+def firewall_rule_name(cluster_name: str) -> str:
+    return f'skytpu-{cluster_name}-ports'
+
+
+def _wait_global_operation(project: str, op: Dict[str, Any],
+                           timeout: float = _OPERATION_TIMEOUT_SECONDS
+                           ) -> None:
+    """Poll a compute global operation until DONE (firewalls are global)."""
+    name = op.get('name')
+    if not name:            # some fakes/immediate ops return no LRO
+        return
+    url = f'{_API_ROOT}/projects/{project}/global/operations/{name}'
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        cur = tpu_api._request('GET', url)  # pylint: disable=protected-access
+        if cur.get('status') == 'DONE':
+            err = cur.get('error', {}).get('errors')
+            if err:
+                raise exceptions.ProvisionError(
+                    f'Compute operation {name} failed: {err}')
+            return
+        time.sleep(_OPERATION_POLL_SECONDS)
+    raise exceptions.ProvisionError(
+        f'Compute operation {name} timed out after {timeout}s.')
+
+
+def get_firewall_rule(project: str, name: str) -> Optional[Dict[str, Any]]:
+    url = f'{_API_ROOT}/projects/{project}/global/firewalls/{name}'
+    try:
+        return tpu_api._request('GET', url)  # pylint: disable=protected-access
+    except exceptions.ClusterDoesNotExist:
+        return None
+
+
+def upsert_firewall_rule(project: str, name: str, network: str,
+                         target_tag: str, ports: List[str]) -> None:
+    """Create (or update, if it exists) an ingress-TCP allow rule for
+    `ports` on `network`, applying to instances tagged `target_tag`."""
+    body = {
+        'name': name,
+        'network': f'projects/{project}/global/networks/{network}',
+        'direction': 'INGRESS',
+        'allowed': [{'IPProtocol': 'tcp', 'ports': [str(p) for p in ports]}],
+        'sourceRanges': ['0.0.0.0/0'],
+        'targetTags': [target_tag],
+    }
+    base = f'{_API_ROOT}/projects/{project}/global/firewalls'
+    # pylint: disable=protected-access
+    if get_firewall_rule(project, name) is None:
+        op = tpu_api._request('POST', base, json_body=body)
+        verb = 'created'
+    else:
+        op = tpu_api._request('PATCH', f'{base}/{name}', json_body=body)
+        verb = 'updated'
+    _wait_global_operation(project, op)
+    logger.info(f'Firewall rule {name} {verb}: tcp:{",".join(map(str, ports))}'
+                f' on network {network} (targetTags=[{target_tag}]).')
+
+
+def delete_firewall_rule(project: str, name: str) -> None:
+    url = f'{_API_ROOT}/projects/{project}/global/firewalls/{name}'
+    try:
+        # pylint: disable=protected-access
+        op = tpu_api._request('DELETE', url)
+    except exceptions.ClusterDoesNotExist:
+        logger.debug(f'Firewall rule {name} already gone.')
+        return
+    _wait_global_operation(project, op)
+    logger.info(f'Firewall rule {name} deleted.')

@@ -68,6 +68,10 @@ def _node_body(pc: Dict[str, Any], cluster_name: str) -> Dict[str, Any]:
             **{k.lower(): str(v).lower()
                for k, v in (pc.get('labels') or {}).items()},
         },
+        # Network tag from birth: open_ports targets its firewall rule at
+        # this tag, so it never has to mutate instances after the fact
+        # (the reference's add_network_tag_if_not_exist dance).
+        'tags': [cluster_name],
         'metadata': {
             'skytpu-cluster': cluster_name,
             # TPU VM guest agent installs this key for the login user.
@@ -271,12 +275,44 @@ def get_cluster_info(region: str, cluster_name: str,
 
 def open_ports(region: str, cluster_name: str, ports: List[str],
                provider_config: Optional[Dict[str, Any]] = None) -> None:
-    # Firewall management is a round-2 item; TPU VMs get external IPs and
-    # default-network rules. Tracked as a gap rather than silently no-oped.
-    logger.warning(f'open_ports({ports}) on GCP not yet implemented; '
-                   f'relying on default network firewall rules.')
+    """Open ingress TCP `ports` via one per-cluster VPC firewall rule.
+
+    Every node of the cluster carries the `cluster_name` network tag from
+    creation (_node_body), so a single rule with
+    targetTags=[cluster_name] covers all slices/workers — including on
+    non-default networks. Idempotent: re-opening with different ports
+    updates the same rule. Reference analog:
+    sky/provision/gcp/instance.py:602 + gcp/config.py firewall CRUD.
+    """
+    from skypilot_tpu.provision.gcp import compute_api
+    pc = provider_config or {}
+    project = _project(pc)
+    compute_api.upsert_firewall_rule(
+        project, compute_api.firewall_rule_name(cluster_name),
+        pc.get('network', 'default'), cluster_name, ports)
+    # Tag backfill: clusters provisioned before tags-at-creation (or being
+    # reused) would otherwise match no targetTags and the ports would stay
+    # silently closed — the exact failure the rule exists to prevent.
+    try:
+        _, zone, nodes = _locate(region, cluster_name, pc)
+        for node in nodes:
+            if cluster_name not in (node.get('tags') or []):
+                name = node['name'].rsplit('/', 1)[-1]
+                tags = list(node.get('tags') or []) + [cluster_name]
+                tpu_api.patch_node(project, zone, name, {'tags': tags},
+                                   update_mask='tags')
+                logger.info(f'Backfilled network tag {cluster_name!r} on '
+                            f'node {name}.')
+    except exceptions.ClusterDoesNotExist:
+        logger.warning(f'open_ports: no nodes found for {cluster_name!r}; '
+                       f'firewall rule created but nothing is tagged yet.')
 
 
 def cleanup_ports(region: str, cluster_name: str, ports: List[str],
                   provider_config: Optional[Dict[str, Any]] = None) -> None:
-    del region, cluster_name, ports, provider_config
+    """Delete the cluster's firewall rule (no-op if it never existed)."""
+    del region, ports
+    from skypilot_tpu.provision.gcp import compute_api
+    pc = provider_config or {}
+    compute_api.delete_firewall_rule(
+        _project(pc), compute_api.firewall_rule_name(cluster_name))

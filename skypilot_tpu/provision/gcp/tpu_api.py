@@ -105,6 +105,17 @@ def get_node(project: str, zone: str, node_id: str) -> Dict[str, Any]:
     return _request('GET', url)
 
 
+def patch_node(project: str, zone: str, node_id: str,
+               body: Dict[str, Any], update_mask: str) -> Dict[str, Any]:
+    """PATCH mutable node fields (e.g. 'tags' for firewall targeting)."""
+    url = f'{_API_ROOT}/{_parent(project, zone)}/nodes/{node_id}'
+    op = _request('PATCH', url, json_body=body,
+                  params={'updateMask': update_mask})
+    if op.get('name'):
+        return wait_operation(op['name'])
+    return op
+
+
 def list_nodes(project: str, zone: str) -> List[Dict[str, Any]]:
     url = f'{_API_ROOT}/{_parent(project, zone)}/nodes'
     out: List[Dict[str, Any]] = []

@@ -68,8 +68,22 @@ def bulk_provision(
             provision.wait_instances(cloud_name, region, cluster_name,
                                      provider_config=deploy_vars)
             if ports_to_open:
-                provision.open_ports(cloud_name, region, cluster_name,
-                                     ports_to_open)
+                try:
+                    provision.open_ports(cloud_name, region, cluster_name,
+                                         ports_to_open,
+                                         provider_config=deploy_vars)
+                except Exception as e:  # pylint: disable=broad-except
+                    # Never tear down a healthy, freshly-provisioned
+                    # cluster over firewall setup (e.g. Compute API not
+                    # enabled on a TPU-only project, missing
+                    # compute.firewalls.* perms) — and never let a
+                    # non-zone-specific error burn the zone failover.
+                    logger.warning(
+                        f'Could not open ports {ports_to_open} for '
+                        f'{cluster_name!r}: {e}. The cluster is up, but '
+                        f'its service ports may be unreachable until the '
+                        f'firewall is configured (check the Compute API / '
+                        f'compute.firewalls.* permissions).')
             return record
         except (exceptions.InsufficientCapacityError,
                 exceptions.QuotaExceededError,
@@ -264,6 +278,13 @@ def teardown_cluster(cloud_name: str, region: str, cluster_name: str,
                      terminate: bool = True) -> None:
     """Analog: provisioner.py:234."""
     if terminate:
+        try:
+            # Best-effort: drops the cluster's firewall rule (gcp) / port
+            # exposure; per-cloud impls no-op when nothing was opened.
+            provision.cleanup_ports(cloud_name, region, cluster_name, [],
+                                    provider_config=provider_config)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.debug(f'cleanup_ports on teardown: {e}')
         provision.terminate_instances(cloud_name, region, cluster_name,
                                       provider_config)
     else:

@@ -6,13 +6,16 @@ this framework owns the model code, so the engine is native and ~200
 lines: aiohttp front, a dynamic batcher, and models/decode.py underneath.
 
 TPU-first design:
-  - **Bucketed dynamic batching**: concurrent requests are grouped
-    within a small window; a group shares one `decode.generate` call.
-    Static shapes rule on TPU, so groups are keyed by (prompt-length
-    bucket, sampling params) — each key compiles once and is cached by
-    jax forever after. MIXED prompt lengths batch together: prompts are
-    right-padded to the bucket and models/decode.py's ragged path
-    (per-row cache lengths) makes padding invisible.
+  - **Continuous batching**: a fixed pool of MAX_BATCH cache slots is
+    stepped one token at a time; a request arriving mid-generation is
+    prefilled into a free slot and joins the NEXT step of the in-flight
+    batch — it never waits for earlier requests to drain. Static shapes
+    rule on TPU, so the step always runs at batch MAX_BATCH (inactive
+    slots are masked) and prompts prefill per power-of-two length bucket
+    — a bounded set of compiled programs, cached by jax forever after.
+    Sampling params are PER-ROW runtime arrays (decode.select_token_per
+    _row), so mixed temperature/top_k/top_p requests share one step and
+    client-supplied values can never trigger a recompile.
   - **Byte-level text mode**: POST {'text': ...} uses the hermetic
     byte tokenizer (data/loader.py), so the engine serves text without
     downloads; token mode ({'tokens': [...]}) is the raw interface.
@@ -36,7 +39,6 @@ from skypilot_tpu import sky_logging
 logger = sky_logging.init_logger(__name__)
 
 MAX_BATCH = int(os.environ.get('SKYTPU_ENGINE_MAX_BATCH', '8'))
-BATCH_WINDOW_S = float(os.environ.get('SKYTPU_ENGINE_BATCH_WINDOW', '0.01'))
 
 
 def _bucket(n: int, floor: int = 16) -> int:
@@ -88,7 +90,9 @@ class InferenceEngine:
         # binds to the loop that first awaits it, and the engine object
         # may outlive a loop (tests; server restarts).
         self._queue: Optional[asyncio.Queue] = None
+        self._state_ready = False
         self.warm = False
+        self.step_count = 0          # observability + tests
 
     def start(self) -> None:
         """Bind the batcher to the current event loop (call at server
@@ -96,23 +100,81 @@ class InferenceEngine:
         self._queue = asyncio.Queue()
         asyncio.create_task(self.batch_loop())
 
-    def warmup(self) -> None:
-        # Compile through the SAME call signature _run_group uses
-        # (prompt_lengths + rng arrays present): a different jit pytree
-        # (None vs array) would compile a program no real request ever
-        # hits, and /health would flip while the first request still
-        # pays the full compile.
+    # -- device state ------------------------------------------------------
+    def _reset_device_state(self) -> None:
+        """(Re)build the slot pool + cache. Called at startup AND after a
+        step/admit execution failure: the failed call was DONATED the old
+        cache buffer (jax invalidates it even on error), so continuing
+        with the old self.cache would poison every later request while
+        /health still says ok."""
+        import jax
+        import numpy as np
+        self.cache = self._decode.init_cache(self.cfg, MAX_BATCH,
+                                             self.max_len)
+        self.rng = jax.random.PRNGKey(int(time.time_ns()) % (2**31))
+        self.slots: List[Optional[Dict[str, Any]]] = [None] * MAX_BATCH
+        self.last = np.zeros(MAX_BATCH, np.int32)
+        self.temp = np.zeros(MAX_BATCH, np.float32)
+        self.topk = np.zeros(MAX_BATCH, np.int32)
+        self.topp = np.zeros(MAX_BATCH, np.float32)
+
+    def _ensure_state(self) -> None:
+        """Jitted step/admit closures, built once (after any test-time cfg
+        overrides — rebuilding them would recompile)."""
+        if self._state_ready:
+            return
+        import functools
         import jax
         jnp = self._jnp
-        self._decode.generate(
-            self.params, jnp.zeros((1, 16), jnp.int32), self.cfg, 16,
-            max_len=self.max_len, temperature=0.0, top_k=None, top_p=None,
-            prompt_lengths=jnp.asarray([8], jnp.int32),
-            rng=jax.random.PRNGKey(0))
-        self.warm = True
-        logger.info('Engine warm (first generate compiled).')
+        cfg, dec, max_len = self.cfg, self._decode, self.max_len
+        from skypilot_tpu.models import decode as decode_lib
 
-    # -- batching ----------------------------------------------------------
+        self._reset_device_state()
+
+        @functools.partial(jax.jit, donate_argnums=(2,))
+        def step(params, last, cache, temp, topk, topp, rng, active):
+            logits, cache = dec.decode_step(params, last, cache, cfg,
+                                            active=active)
+            rng, sub = jax.random.split(rng)
+            nxt = decode_lib.select_token_per_row(logits, temp, topk, topp,
+                                                  sub)
+            return jnp.where(active, nxt, last), cache, rng
+
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def admit(params, cache, tokens, length, slot, temp, topk, topp,
+                  rng):
+            """Prefill one prompt (bucketed [1, S]) into cache row `slot`
+            and sample its first token. One compile per prompt bucket."""
+            logits, row = dec.prefill(params, tokens, cfg, max_len,
+                                      lengths=length[None])
+
+            def write(big, one):
+                if big.ndim == 1:               # the per-row length vector
+                    return big.at[slot].set(one[0])
+                return big.at[:, slot].set(one[:, 0])
+
+            cache = jax.tree.map(write, cache, row)
+            rng, sub = jax.random.split(rng)
+            first = decode_lib.select_token_per_row(
+                logits[None] if logits.ndim == 1 else logits,
+                temp[None], topk[None], topp[None], sub)[0]
+            return first, cache, rng
+
+        self._step_jit = step
+        self._admit_jit = admit
+        self._state_ready = True
+
+    def warmup(self) -> None:
+        """Compile the admit (16-bucket) + step programs through the real
+        code path, then free the warmup slot; /health flips only after."""
+        self._ensure_state()
+        self._admit((list(range(1, 9)), 1, 0.0, None, None, None))
+        self._step_once()
+        self.slots = [None] * MAX_BATCH
+        self.warm = True
+        logger.info('Engine warm (admit + step compiled).')
+
+    # -- continuous batching ----------------------------------------------
     async def submit(self, tokens: List[int], max_new: int,
                      temperature: float, top_k: Optional[int],
                      top_p: Optional[float]) -> List[int]:
@@ -121,57 +183,100 @@ class InferenceEngine:
                                fut))
         return await fut
 
-    async def batch_loop(self) -> None:
-        """Group compatible requests, run one generate per group."""
-        while True:
-            first = await self._queue.get()
-            group = [first]
-            deadline = time.monotonic() + BATCH_WINDOW_S
-            while len(group) < MAX_BATCH:
-                timeout = deadline - time.monotonic()
-                if timeout <= 0:
-                    break
-                try:
-                    item = await asyncio.wait_for(self._queue.get(),
-                                                  timeout)
-                except asyncio.TimeoutError:
-                    break
-                # Same prompt-length BUCKET and sampling params → same
-                # compiled program (ragged right-padding inside the
-                # bucket); anything else goes back on the queue for the
-                # next group.
-                if (_bucket(len(item[0])) == _bucket(len(first[0])) and
-                        item[2:5] == first[2:5]):
-                    group.append(item)
-                else:
-                    await self._queue.put(item)
-                    break
-            await self._run_group(group)
+    def _free_slot(self) -> Optional[int]:
+        for i, s in enumerate(self.slots):
+            if s is None:
+                return i
+        return None
 
-    async def _run_group(self, group) -> None:
+    def _admit(self, item) -> None:
+        """Prefill a request into a free slot (device work: call off-loop)."""
         jnp = self._jnp
-        lens = [len(g[0]) for g in group]
-        s = _bucket(max(lens))
-        tokens = jnp.asarray(
-            [g[0] + [0] * (s - len(g[0])) for g in group], jnp.int32)
-        lengths = jnp.asarray(lens, jnp.int32)
-        max_new = min(_bucket(max(g[1] for g in group)), self.max_len - s)
-        _, _, temperature, top_k, top_p, _ = group[0]
+        tokens, max_new, temperature, top_k, top_p, fut = item
+        slot = self._free_slot()
+        assert slot is not None
+        s = _bucket(len(tokens))
+        padded = jnp.asarray([tokens + [0] * (s - len(tokens))], jnp.int32)
+        self.temp[slot] = max(float(temperature), 0.0)
+        self.topk[slot] = int(top_k) if top_k else 0
+        self.topp[slot] = float(top_p) if top_p else 0.0
+        first, self.cache, self.rng = self._admit_jit(
+            self.params, self.cache, padded,
+            jnp.int32(len(tokens)), jnp.int32(slot),
+            jnp.float32(self.temp[slot]), jnp.int32(self.topk[slot]),
+            jnp.float32(self.topp[slot]), self.rng)
+        first = int(first)
+        self.last[slot] = first
+        self.slots[slot] = {'fut': fut, 'want': max_new, 'out': [first]}
+
+    def _step_once(self) -> None:
+        """One decode step over the whole slot pool (device work)."""
         import jax
-        try:
-            out = await asyncio.to_thread(
-                self._decode.generate, self.params, tokens, self.cfg,
-                max_new, max_len=self.max_len, temperature=temperature,
-                top_k=top_k, top_p=top_p, prompt_lengths=lengths,
-                rng=jax.random.PRNGKey(int(time.time_ns()) % (2**31)))
-            out = jax.device_get(out)
-            for i, (_, want_new, *_rest, fut) in enumerate(group):
-                if not fut.done():
-                    fut.set_result([int(t) for t in out[i][:want_new]])
-        except Exception as e:  # pylint: disable=broad-except
-            for *_a, fut in group:
-                if not fut.done():
-                    fut.set_exception(e)
+        jnp = self._jnp
+        active = jnp.asarray([s is not None for s in self.slots])
+        nxt, self.cache, self.rng = self._step_jit(
+            self.params, jnp.asarray(self.last), self.cache,
+            jnp.asarray(self.temp), jnp.asarray(self.topk),
+            jnp.asarray(self.topp), self.rng, active)
+        nxt = jax.device_get(nxt)
+        self.step_count += 1
+        for i, s in enumerate(self.slots):
+            if s is not None and len(s['out']) < s['want']:
+                s['out'].append(int(nxt[i]))
+                self.last[i] = int(nxt[i])
+
+    def _finish_done(self) -> None:
+        """Resolve futures of slots that produced all they asked for (runs
+        on the event loop)."""
+        for i, s in enumerate(self.slots):
+            if s is not None and len(s['out']) >= s['want']:
+                fut = s['fut']
+                if fut is not None and not fut.done():
+                    fut.set_result(s['out'][:s['want']])
+                self.slots[i] = None
+
+    async def batch_loop(self) -> None:
+        """Continuous scheduler: admit whenever a slot is free, step while
+        anything is active. A late request joins the next step of the
+        in-flight batch — it never waits for earlier requests to drain."""
+        self._ensure_state()
+        while True:
+            busy = any(s is not None for s in self.slots)
+            if not busy:
+                item = await self._queue.get()
+                try:
+                    await asyncio.to_thread(self._admit, item)
+                except Exception as e:  # pylint: disable=broad-except
+                    self._fail_all(e, extra=item)
+                self._finish_done()     # want==1 resolves without a step
+                continue
+            while self._free_slot() is not None and not self._queue.empty():
+                item = self._queue.get_nowait()
+                try:
+                    await asyncio.to_thread(self._admit, item)
+                except Exception as e:  # pylint: disable=broad-except
+                    self._fail_all(e, extra=item)
+            try:
+                await asyncio.to_thread(self._step_once)
+            except Exception as e:  # pylint: disable=broad-except
+                self._fail_all(e)
+                continue
+            self._finish_done()
+
+    def _fail_all(self, e: Exception, extra=None) -> None:
+        """Fail every in-flight request and rebuild the device state: the
+        failed jit call was donated the cache buffer, so the whole pool is
+        unusable (see _reset_device_state)."""
+        logger.warning(f'Engine step/admit failed; resetting slot pool: '
+                       f'{e}')
+        if extra is not None and extra[-1] is not None \
+                and not extra[-1].done():
+            extra[-1].set_exception(e)
+        for s in self.slots:
+            if s is not None and s['fut'] is not None \
+                    and not s['fut'].done():
+                s['fut'].set_exception(e)
+        self._reset_device_state()
 
 
 def build_app(engine: InferenceEngine):
@@ -205,12 +310,27 @@ def build_app(engine: InferenceEngine):
                 {'error': f'bucketed prompt ({_bucket(len(tokens))}) + '
                           f'max_new_tokens exceeds max_len '
                           f'{engine.max_len}'}, status=400)
-        top_k = body.get('top_k')
-        top_p = body.get('top_p')
-        out = await engine.submit(
-            tokens, max_new, float(body.get('temperature', 0.0)),
-            int(top_k) if top_k is not None else None,
-            float(top_p) if top_p is not None else None)
+        # Sampling params are validated/clamped at admission and passed as
+        # PER-ROW runtime arrays — untrusted values can neither trigger a
+        # recompile nor fail the whole batch (top_k is further clamped to
+        # vocab inside decode.select_token_per_row).
+        import math
+        try:
+            temperature = float(body.get('temperature', 0.0))
+            if not math.isfinite(temperature):    # json accepts NaN/Infinity
+                raise ValueError(f'temperature {temperature} not finite')
+            temperature = max(temperature, 0.0)
+            top_k = body.get('top_k')
+            top_k = max(int(top_k), 0) if top_k is not None else None
+            top_p = body.get('top_p')
+            top_p = float(top_p) if top_p is not None else None
+            if top_p is not None and not 0.0 <= top_p <= 1.0:
+                raise ValueError(f'top_p {top_p} outside [0, 1]')
+        except (TypeError, ValueError) as e:
+            return web.json_response({'error': f'bad sampling params: {e}'},
+                                     status=400)
+        out = await engine.submit(tokens, max_new, temperature, top_k,
+                                  top_p)
         resp: Dict[str, Any] = {'tokens': out}
         if 'text' in body:
             resp['text'] = bytes(t for t in out if t < 256).decode(

@@ -14,6 +14,7 @@ tests possible on one machine).
 """
 from __future__ import annotations
 
+import os
 import threading
 import time
 import typing
@@ -41,6 +42,26 @@ MAX_CONSECUTIVE_PROBE_FAILURES = 3
 # Consecutive probe-failure replacements (no READY in between) before the
 # service is declared FAILED instead of churning clusters forever.
 MAX_REPLACEMENTS_BEFORE_FAILED = 3
+
+
+def _boot_patience_seconds(probe: 'spec_lib.ReadinessProbe') -> float:
+    """Extra wall-clock a STARTING replica whose run job is verifiably
+    alive gets beyond initial_delay_seconds before probe misses count
+    toward replacement.
+
+    Probe classing (slow boot vs dead app): on a saturated box a replica
+    can blow through a short grace window while its process is alive and
+    still booting; replacing it then just restarts the same slow boot and
+    eventually FAILs a healthy service. The patience is bounded so an
+    alive-but-never-listening (hung) app is still replaced."""
+    env = os.environ.get('SKYTPU_SERVE_BOOT_PATIENCE')
+    if env is not None:
+        try:
+            return float(env)
+        except ValueError:
+            logger.warning(f'Ignoring malformed SKYTPU_SERVE_BOOT_PATIENCE'
+                           f'={env!r} (want seconds as a float).')
+    return max(60.0, 5.0 * probe.initial_delay_seconds)
 
 
 def probe_url(url: str, path: str, timeout: float) -> bool:
@@ -272,6 +293,24 @@ class ReplicaManager:
         return not statuses or not all(
             s in ('running', 'READY') for s in statuses.values())
 
+    def _replica_app_alive(self, replica_id: int) -> bool:
+        """Is the replica's run job verifiably alive (queued, setting up,
+        or running)? False on job exit and on ANY error — "unknown" must
+        not extend boot patience indefinitely."""
+        record = global_state.get_cluster(self._cluster_name(replica_id))
+        if record is None:
+            return False
+        try:
+            handle = slice_backend.SliceResourceHandle.from_dict(
+                record['handle'])
+            jobs = self.backend.queue(handle)
+        except Exception:  # pylint: disable=broad-except
+            return False
+        if not jobs:
+            return False
+        last = max(jobs, key=lambda j: j['job_id'])
+        return not slice_backend.JobStatus(last['status']).is_terminal()
+
     def reconcile(self, target: int) -> None:
         """One control-loop pass: probe replicas, replace the dead, scale
         toward `target`."""
@@ -333,6 +372,20 @@ class ReplicaManager:
                             self.spot_placer.set_active(
                                 self._replica_locations[rid])
                 elif not in_grace:
+                    boot_age = now - (rep['launched_at'] or 0)
+                    if (status is ReplicaStatus.STARTING and
+                            boot_age < probe.initial_delay_seconds +
+                            _boot_patience_seconds(probe) and
+                            self._replica_app_alive(rid)):
+                        # Probe classing: never-READY replica whose run job
+                        # is alive — slow boot, not a dead app. Don't count
+                        # the miss; the patience bound above keeps a hung
+                        # app from stalling the service forever.
+                        logger.info(f'Replica {rid} not ready after '
+                                    f'{boot_age:.0f}s but its job is alive '
+                                    f'— treating as slow boot.')
+                        alive.append(rep)
+                        continue
                     fails = serve_state.bump_replica_failures(
                         self.service_name, rid)
                     if fails >= MAX_CONSECUTIVE_PROBE_FAILURES:

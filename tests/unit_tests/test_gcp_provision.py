@@ -42,6 +42,8 @@ class FakeTpuService:
         self.deleted_qrs = []
         self.deleted_nodes = []
         self.calls = []
+        self.firewalls: Dict[str, Dict] = {}   # rule name -> body
+        self.deleted_firewalls = []
 
     # -- helpers --
     def _make_node(self, zone, name, body):
@@ -50,6 +52,7 @@ class FakeTpuService:
             'name': f'projects/p/locations/{zone}/nodes/{name}',
             'state': 'READY',
             'labels': body.get('labels', {}),
+            'tags': list(body.get('tags', [])),
             'networkEndpoints': [
                 {'ipAddress': f'10.0.{i}.2',
                  'accessConfig': {'externalIp': f'34.1.{i}.2'}}
@@ -57,11 +60,42 @@ class FakeTpuService:
             ],
         }
 
+    def _compute(self, method, rest, body):
+        """Compute v1 global resources: firewalls + operations."""
+        if rest.startswith('operations/'):
+            return FakeResponse(200, {'status': 'DONE'})
+        if rest == 'firewalls' and method == 'POST':
+            self.firewalls[body['name']] = body
+            return FakeResponse(200, {'name': f'op-fw-{body["name"]}'})
+        fm = re.match(r'firewalls/(?P<name>[^/]+)$', rest)
+        if fm:
+            name = fm.group('name')
+            if method == 'GET':
+                if name not in self.firewalls:
+                    return FakeResponse(404, {'error': 'not found'})
+                return FakeResponse(200, self.firewalls[name])
+            if method == 'PATCH':
+                assert name in self.firewalls
+                self.firewalls[name] = body
+                return FakeResponse(200, {'name': f'op-fw-{name}'})
+            if method == 'DELETE':
+                if name not in self.firewalls:
+                    return FakeResponse(404, {'error': 'not found'})
+                del self.firewalls[name]
+                self.deleted_firewalls.append(name)
+                return FakeResponse(200, {'name': f'op-fwdel-{name}'})
+        raise AssertionError(f'fake compute API: unhandled {method} {rest}')
+
     # -- the requests.request replacement --
     def request(self, method, url, headers=None, json=None, params=None,
                 timeout=None):
         del headers, timeout
         self.calls.append((method, url))
+        cm = re.match(
+            r'https://compute\.googleapis\.com/compute/v1/projects/'
+            r'(?P<p>[^/]+)/global/(?P<rest>.*)', url)
+        if cm:
+            return self._compute(method, cm.group('rest'), json)
         m = re.match(
             r'https://tpu\.googleapis\.com/v2/projects/(?P<p>[^/]+)/'
             r'locations/(?P<zone>[^/]+)/(?P<rest>.*)', url)
@@ -107,6 +141,12 @@ class FakeTpuService:
                 if key not in self.nodes:
                     return FakeResponse(404, {'error': 'not found'})
                 return FakeResponse(200, self.nodes[key])
+            if method == 'PATCH':
+                if key not in self.nodes:
+                    return FakeResponse(404, {'error': 'not found'})
+                self.nodes[key].update(json or {})
+                return FakeResponse(200, {
+                    'name': f'projects/p/locations/{zone}/operations/patch'})
             if method == 'DELETE':
                 if key not in self.nodes:
                     return FakeResponse(404, {'error': 'not found'})
@@ -284,6 +324,62 @@ class TestGcpProvision:
         record = gcp_instance.run_instances('us-central2', 'us-central2-b',
                                             'idem', _config())
         assert record.created_instance_ids == []   # already READY
+
+
+class TestFirewallPorts:
+    """open_ports/cleanup_ports firewall CRUD against the fake compute API
+    (VERDICT r2 item 6: serve endpoints must be reachable on non-default
+    networks, not just hope the default rules allow them)."""
+
+    PC = {'project_id': 'p', 'zones': ['us-central2-b'],
+          'network': 'custom-vpc'}
+
+    def test_open_ports_creates_rule_on_custom_network(self, fake_tpu):
+        gcp_instance.open_ports('us-central2', 'svc', ['8080', '30000-30010'],
+                                self.PC)
+        rule = fake_tpu.firewalls['skytpu-svc-ports']
+        assert rule['network'] == 'projects/p/global/networks/custom-vpc'
+        assert rule['allowed'] == [{'IPProtocol': 'tcp',
+                                    'ports': ['8080', '30000-30010']}]
+        assert rule['targetTags'] == ['svc']
+        assert rule['direction'] == 'INGRESS'
+        assert rule['sourceRanges'] == ['0.0.0.0/0']
+
+    def test_open_ports_is_an_idempotent_upsert(self, fake_tpu):
+        gcp_instance.open_ports('us-central2', 'svc', ['8080'], self.PC)
+        gcp_instance.open_ports('us-central2', 'svc', ['9090'], self.PC)
+        assert len(fake_tpu.firewalls) == 1
+        rule = fake_tpu.firewalls['skytpu-svc-ports']
+        assert rule['allowed'][0]['ports'] == ['9090']
+        # Second call PATCHed the existing rule instead of POSTing anew.
+        patches = [c for c in fake_tpu.calls if c[0] == 'PATCH']
+        assert len(patches) == 1
+
+    def test_cleanup_ports_deletes_rule_and_tolerates_absence(self, fake_tpu):
+        gcp_instance.open_ports('us-central2', 'svc', ['8080'], self.PC)
+        gcp_instance.cleanup_ports('us-central2', 'svc', ['8080'], self.PC)
+        assert fake_tpu.firewalls == {}
+        assert fake_tpu.deleted_firewalls == ['skytpu-svc-ports']
+        # Deleting a rule that never existed must not raise.
+        gcp_instance.cleanup_ports('us-central2', 'nosuch', ['1'], self.PC)
+
+    def test_nodes_carry_cluster_network_tag(self, fake_tpu):
+        # The network tag open_ports targets must be on the node body from
+        # creation (no after-the-fact instance mutation).
+        del fake_tpu
+        body = gcp_instance._node_body(_config().provider_config, 'train')
+        assert body['tags'] == ['train']
+
+    def test_open_ports_backfills_tags_on_legacy_nodes(self, fake_tpu):
+        """Clusters whose nodes predate tags-at-creation (or were made by
+        another tool) get the network tag patched on, so the firewall
+        rule actually matches them."""
+        gcp_instance.run_instances('us-central2', 'us-central2-b', 'old',
+                                   _config())
+        fake_tpu.nodes['us-central2-b/old-0']['tags'] = []   # legacy node
+        pc = {'project_id': 'p', 'zones': ['us-central2-b']}
+        gcp_instance.open_ports('us-central2', 'old', ['8080'], pc)
+        assert fake_tpu.nodes['us-central2-b/old-0']['tags'] == ['old']
 
 
 class TestZoneFailoverLoop:
