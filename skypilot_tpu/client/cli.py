@@ -657,7 +657,11 @@ def storage_delete(name: str, yes: bool):
 @click.option('--dryrun', is_flag=True, default=False,
               help='Print the transfer command without running it.')
 def storage_transfer(src: str, dst: str, dryrun: bool):
-    """Sync SRC bucket/dir into DST (gs://, s3://, r2://, local paths)."""
+    """Sync SRC bucket/dir into DST (gs://, s3://, r2://, local paths).
+
+    MIRRORS the source: files in DST that are not in SRC are DELETED
+    (rsync --delete / gsutil -d / aws s3 sync --delete semantics).
+    """
     from skypilot_tpu.data import data_transfer
     try:
         cmd = data_transfer.transfer(src, dst, dryrun=dryrun)

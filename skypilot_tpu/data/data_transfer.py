@@ -85,10 +85,27 @@ def transfer(src: str, dst: str, dryrun: bool = False) -> str:
         os.makedirs(argv[-1], exist_ok=True)
         if shutil.which('rsync') is None:
             # Minimal hosts (containers) may lack rsync; the sync semantics
-            # (mirror contents, delete extraneous) are reproducible in-process.
+            # (mirror contents, delete extraneous) are reproducible
+            # in-process. Copy into a temp sibling and swap so a failed
+            # copy can never leave the destination EMPTY (the old
+            # rmtree-then-copytree did).
             src_dir = argv[-2].rstrip('/')
-            shutil.rmtree(argv[-1])
-            shutil.copytree(src_dir, argv[-1])
+            dst_dir = argv[-1].rstrip('/')
+            tmp_dir = f'{dst_dir}.skytpu-transfer-tmp'
+            old_dir = f'{dst_dir}.skytpu-transfer-old'
+            shutil.rmtree(tmp_dir, ignore_errors=True)
+            shutil.rmtree(old_dir, ignore_errors=True)
+            try:
+                shutil.copytree(src_dir, tmp_dir)
+            except Exception:
+                shutil.rmtree(tmp_dir, ignore_errors=True)
+                raise
+            # Rename-aside swap: the destination is replaced atomically
+            # and the old tree survives (aside) until the swap succeeded,
+            # so no failure mode leaves dst empty or partial.
+            os.rename(dst_dir, old_dir)
+            os.rename(tmp_dir, dst_dir)
+            shutil.rmtree(old_dir, ignore_errors=True)
             return cmd_str
     proc = subprocess.run(argv, capture_output=True, text=True, check=False)
     if proc.returncode != 0:

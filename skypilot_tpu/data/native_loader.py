@@ -36,7 +36,14 @@ def _load_lib():
     path = native_build.build_target('skytpu_dataloader.so')
     if path is None:
         return None
-    lib = ctypes.CDLL(path)
+    try:
+        lib = ctypes.CDLL(path)
+    except OSError as e:
+        # A stale artifact built for another platform/arch must degrade to
+        # the numpy path, not crash the loader.
+        logger.warning(f'Could not dlopen native dataloader {path}: {e}; '
+                       f'falling back to the numpy loader.')
+        return None
     lib.dl_open.restype = ctypes.c_void_p
     lib.dl_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
     lib.dl_num_tokens.restype = ctypes.c_int64

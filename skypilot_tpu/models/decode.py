@@ -79,6 +79,15 @@ def _qkv(x: jnp.ndarray, lp, cfg: llama.LlamaConfig, sin, cos):
     return q, k, v
 
 
+def _wo_project(out, lp, cfg: llama.LlamaConfig) -> jnp.ndarray:
+    """Attention output projection (+ Gemma-2 post-attention norm)."""
+    y = jnp.einsum('bsh,hd->bsd', out, lp['wo'].astype(cfg.dtype))
+    if cfg.post_norms:
+        y = norms.rms_norm(y, lp['post_attn_norm'], cfg.rms_eps,
+                           scale_plus_one=cfg.norm_plus_one)
+    return y
+
+
 def _ffn(x: jnp.ndarray, lp, cfg: llama.LlamaConfig) -> jnp.ndarray:
     """Post-attention FFN block: dense SwiGLU, or routed experts for MoE
     configs. The MoE path reuses training's grouped static-capacity
@@ -97,6 +106,9 @@ def _ffn(x: jnp.ndarray, lp, cfg: llama.LlamaConfig) -> jnp.ndarray:
     up = jnp.einsum('bsd,df->bsf', h, lp['w_up'].astype(cfg.dtype))
     down = jnp.einsum('bsf,fd->bsd', cfg.act(gate) * up,
                       lp['w_down'].astype(cfg.dtype))
+    if cfg.post_norms:
+        down = norms.rms_norm(down, lp['post_mlp_norm'], cfg.rms_eps,
+                              scale_plus_one=cfg.norm_plus_one)
     return down
 
 
@@ -146,16 +158,20 @@ def prefill(params, tokens: jnp.ndarray, cfg: llama.LlamaConfig,
     # prompts fit on-chip, so route it to the standard path.
     impl = 'auto' if cfg.attention_impl == 'ring' else cfg.attention_impl
 
-    def body(carry, lp):
+    def body(carry, xs):
+        lp, layer_idx = xs
         q, k, v = _qkv(carry, lp, cfg, sin, cos)
-        out = _attention(q, k, v, impl=impl, causal=True)
+        w_active = (layer_idx % 2 == 0) if cfg.sliding_window else None
+        out = _attention(q, k, v, impl=impl, causal=True,
+                         logit_softcap=cfg.attn_logit_softcap,
+                         window=cfg.sliding_window, window_active=w_active)
         out = out.reshape(b, s, cfg.n_heads * cfg.hd)
-        carry = carry + jnp.einsum('bsh,hd->bsd', out,
-                                   lp['wo'].astype(cfg.dtype))
+        carry = carry + _wo_project(out, lp, cfg)
         carry = carry + _ffn(carry, lp, cfg)
         return carry, (k, v)
 
-    x, (ks, vs) = jax.lax.scan(body, x, params['layers'])
+    layer_ids = jnp.arange(cfg.n_layers, dtype=jnp.int32)
+    x, (ks, vs) = jax.lax.scan(body, x, (params['layers'], layer_ids))
     pad = [(0, 0), (0, 0), (0, max_len - s), (0, 0), (0, 0)]
     cache = KVCache(k=jnp.pad(ks, pad), v=jnp.pad(vs, pad),
                     length=lengths)
@@ -212,11 +228,13 @@ def decode_step(params, token: jnp.ndarray, cache: KVCache,
                                                       layer_idx, axis=0)
         # Per-row q_offset masks kv positions > length[b]: pad garbage
         # beyond each row's valid prefix never contributes.
+        w_active = (layer_idx % 2 == 0) if cfg.sliding_window else None
         out = _attention(q, k_l, v_l, impl='xla', causal=True,
-                         q_offset=length, kv_offset=0)
+                         q_offset=length, kv_offset=0,
+                         logit_softcap=cfg.attn_logit_softcap,
+                         window=cfg.sliding_window, window_active=w_active)
         out = out.reshape(b, 1, cfg.n_heads * cfg.hd)
-        x_c = x_c + jnp.einsum('bsh,hd->bsd', out,
-                               lp['wo'].astype(cfg.dtype))
+        x_c = x_c + _wo_project(out, lp, cfg)
         x_c = x_c + _ffn(x_c, lp, cfg)
         return (x_c, k_cache, v_cache), None
 

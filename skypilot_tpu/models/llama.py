@@ -70,6 +70,12 @@ class LlamaConfig:
     mlp_activation: str = 'silu'            # 'silu' | 'gelu'
     embed_scale: bool = False
     final_logit_softcap: Optional[float] = None
+    # Gemma-2 additions: attention-logit softcap, post-sublayer norms
+    # (attn/FFN outputs normed before the residual add), and alternating
+    # sliding-window attention (even layers local, odd global).
+    attn_logit_softcap: Optional[float] = None
+    post_norms: bool = False
+    sliding_window: Optional[int] = None
 
     def act(self, x):
         if self.mlp_activation == 'gelu':
@@ -145,7 +151,9 @@ PRESETS: Dict[str, LlamaConfig] = {
                              rms_eps=1e-6, max_seq_len=8192,
                              tie_embeddings=True, norm_plus_one=True,
                              mlp_activation='gelu', embed_scale=True,
-                             final_logit_softcap=30.0),
+                             final_logit_softcap=30.0,
+                             attn_logit_softcap=50.0, post_norms=True,
+                             sliding_window=4096),
 }
 
 
@@ -186,6 +194,11 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
                                            cfg.param_dtype)
         params['layers']['bv'] = jnp.zeros((L, cfg.n_kv_heads * hd),
                                            cfg.param_dtype)
+    if cfg.post_norms:
+        params['layers']['post_attn_norm'] = norm_init((L, D),
+                                                       cfg.param_dtype)
+        params['layers']['post_mlp_norm'] = norm_init((L, D),
+                                                      cfg.param_dtype)
     if not cfg.tie_embeddings:
         params['lm_head'] = init(next(k), (D, cfg.vocab_size))
     return params
@@ -217,6 +230,9 @@ def param_specs(cfg: LlamaConfig,
         specs['layers']['bq'] = s('layers', 'heads')
         specs['layers']['bk'] = s('layers', 'kv_heads')
         specs['layers']['bv'] = s('layers', 'kv_heads')
+    if cfg.post_norms:
+        specs['layers']['post_attn_norm'] = s('layers', 'norm')
+        specs['layers']['post_mlp_norm'] = s('layers', 'norm')
     if not cfg.tie_embeddings:
         specs['lm_head'] = s('embed', 'vocab')
     return specs
@@ -290,7 +306,8 @@ def _pipelined_layers(x, layers, layer_fn, cfg: LlamaConfig, sin, cos):
 def attention_block(x: jnp.ndarray, lp: Params, cfg: LlamaConfig,
                     rules: sharding_lib.Rules, sin: jnp.ndarray,
                     cos: jnp.ndarray, q_offset,
-                    norm_key: str = 'attn_norm') -> jnp.ndarray:
+                    norm_key: str = 'attn_norm',
+                    layer_idx=None) -> jnp.ndarray:
     """Pre-norm attention sublayer (shared by the dense and MoE models):
     rms_norm → qkv → rope → attention (xla/flash/ring) → wo. Returns the
     residual branch (caller adds it to x)."""
@@ -314,6 +331,15 @@ def attention_block(x: jnp.ndarray, lp: Params, cfg: LlamaConfig,
     q = rotary.apply_rope(q, sin, cos)
     kk = rotary.apply_rope(kk, sin, cos)
     if cfg.attention_impl == 'ring':
+        if cfg.sliding_window is not None:
+            raise NotImplementedError(
+                'sliding_window (Gemma-2 local layers) with ring attention '
+                'is not supported: windowed shards would need neighbor-'
+                "bounded rings. Use attention_impl='auto'/'xla'.")
+        if cfg.attn_logit_softcap is not None:
+            raise NotImplementedError(
+                'attn_logit_softcap with ring attention is not supported '
+                "(the ring kernel does not cap logits); use 'auto'/'xla'.")
         from skypilot_tpu.ops import ring_attention as ring_lib
         from skypilot_tpu.ops.attention import _on_tpu
         ring_kw = dict(causal=True,
@@ -329,19 +355,33 @@ def attention_block(x: jnp.ndarray, lp: Params, cfg: LlamaConfig,
             # stay with the partitioner.
             out = ring_lib.ring_attention_sharded(q, kk, vv, **ring_kw)
     else:
+        window = cfg.sliding_window
+        w_active = None
+        if window is not None and layer_idx is not None:
+            # Gemma-2 alternation: even layers attend within the window,
+            # odd layers attend globally. Traced flag so both kinds share
+            # one scan body / compiled program.
+            w_active = (layer_idx % 2 == 0)
         out = _attention(q, kk, vv, impl=cfg.attention_impl,
                          causal=True, q_offset=q_offset,
-                         kv_offset=q_offset)
+                         kv_offset=q_offset,
+                         logit_softcap=cfg.attn_logit_softcap,
+                         window=window, window_active=w_active)
     out = out.reshape(b, s_len, cfg.n_heads * hd)
     attn_out = jnp.einsum('bsh,hd->bsd', out, lp['wo'].astype(cfg.dtype))
+    if cfg.post_norms:
+        attn_out = norms.rms_norm(attn_out, lp['post_attn_norm'],
+                                  cfg.rms_eps,
+                                  scale_plus_one=cfg.norm_plus_one)
     return con(attn_out, 'batch', 'seq', 'act_embed')
 
 
 def _layer(x: jnp.ndarray, lp: Params, cfg: LlamaConfig,
            rules: sharding_lib.Rules, sin: jnp.ndarray, cos: jnp.ndarray,
-           q_offset) -> jnp.ndarray:
+           q_offset, layer_idx=None) -> jnp.ndarray:
     con = functools.partial(sharding_lib.constrain, rules=rules)
-    x = x + attention_block(x, lp, cfg, rules, sin, cos, q_offset)
+    x = x + attention_block(x, lp, cfg, rules, sin, cos, q_offset,
+                            layer_idx=layer_idx)
 
     h = norms.rms_norm(x, lp['mlp_norm'], cfg.rms_eps,
                        scale_plus_one=cfg.norm_plus_one)
@@ -350,6 +390,9 @@ def _layer(x: jnp.ndarray, lp: Params, cfg: LlamaConfig,
     inner = cfg.act(gate) * up
     inner = con(inner, 'batch', 'seq', 'mlp')
     down = jnp.einsum('bsf,fd->bsd', inner, lp['w_down'].astype(cfg.dtype))
+    if cfg.post_norms:
+        down = norms.rms_norm(down, lp['post_mlp_norm'], cfg.rms_eps,
+                              scale_plus_one=cfg.norm_plus_one)
     return x + con(down, 'batch', 'seq', 'act_embed')
 
 
@@ -400,24 +443,28 @@ def forward(params: Params,
                    if cfg.pipeline_stages > 1 and cfg.attention_impl == 'ring'
                    else rules)
 
-    def layer_fn(xx, lp, sin_l, cos_l):
-        return _layer(xx, lp, cfg, layer_rules, sin_l, cos_l, q_offset)
+    def layer_fn(xx, lp_idx, sin_l, cos_l):
+        lp, idx = lp_idx
+        return _layer(xx, lp, cfg, layer_rules, sin_l, cos_l, q_offset,
+                      layer_idx=idx)
 
     policy_name = _REMAT_POLICIES[cfg.remat]
     if policy_name is not None:
         policy = getattr(jax.checkpoint_policies, policy_name)
         layer_fn = jax.checkpoint(layer_fn, policy=policy)
 
+    layer_ids = jnp.arange(cfg.n_layers, dtype=jnp.int32)
     if cfg.pipeline_stages > 1:
-        x = _pipelined_layers(x, params['layers'], layer_fn, cfg, sin, cos)
+        x = _pipelined_layers(x, (params['layers'], layer_ids), layer_fn,
+                              cfg, sin, cos)
     elif cfg.scan_layers:
-        def body(carry, lp):
-            return layer_fn(carry, lp, sin, cos), None
-        x, _ = jax.lax.scan(body, x, params['layers'])
+        def body(carry, lp_idx):
+            return layer_fn(carry, lp_idx, sin, cos), None
+        x, _ = jax.lax.scan(body, x, (params['layers'], layer_ids))
     else:
         for i in range(cfg.n_layers):
             lp = jax.tree.map(lambda p: p[i], params['layers'])
-            x = layer_fn(x, lp, sin, cos)
+            x = layer_fn(x, (lp, jnp.int32(i)), sin, cos)
 
     x = norms.rms_norm(x, params['final_norm'], cfg.rms_eps,
                        scale_plus_one=cfg.norm_plus_one)

@@ -213,9 +213,11 @@ def moe_ffn(x: jnp.ndarray, lp: Params, cfg: MoEConfig,
     return con(y.reshape(b, s_len, d), 'batch', 'seq', 'act_embed'), aux
 
 
-def _layer(carry, lp, cfg: MoEConfig, rules, sin, cos, q_offset):
+def _layer(carry, lp, cfg: MoEConfig, rules, sin, cos, q_offset,
+           layer_idx=None):
     x, aux_sum = carry
-    x = x + llama_lib.attention_block(x, lp, cfg, rules, sin, cos, q_offset)
+    x = x + llama_lib.attention_block(x, lp, cfg, rules, sin, cos, q_offset,
+                                      layer_idx=layer_idx)
     h = norms.rms_norm(x, lp['moe_norm'], cfg.rms_eps)
     y, aux = moe_ffn(h, lp, cfg, rules)
     return (x + y, aux_sum + aux)
@@ -289,31 +291,39 @@ def forward(params: Params,
     sin, cos = rotary.rope_frequencies(cfg.hd, positions, cfg.rope_theta,
                                        cfg.rope_scaling)
 
+    if cfg.post_norms:
+        raise NotImplementedError(
+            'post_norms is a dense (Gemma-2) feature; MoE layers have no '
+            'post-sublayer norm params.')
     layer_rules = (rules.override(seq=None)
                    if cfg.pipeline_stages > 1 and cfg.attention_impl == 'ring'
                    else rules)
 
-    def layer_fn(carry, lp, sin_l, cos_l):
-        return _layer(carry, lp, cfg, layer_rules, sin_l, cos_l, q_offset)
+    def layer_fn(carry, lp_idx, sin_l, cos_l):
+        lp, idx = lp_idx
+        return _layer(carry, lp, cfg, layer_rules, sin_l, cos_l, q_offset,
+                      layer_idx=idx)
 
     policy_name = llama_lib._REMAT_POLICIES[cfg.remat]
     if policy_name is not None:
         policy = getattr(jax.checkpoint_policies, policy_name)
         layer_fn = jax.checkpoint(layer_fn, policy=policy)
 
+    layer_ids = jnp.arange(cfg.n_layers, dtype=jnp.int32)
     aux0 = jnp.zeros((), jnp.float32)
     if cfg.pipeline_stages > 1:
-        x, aux = _pipelined_layers(x, params['layers'], layer_fn, cfg,
-                                   sin, cos)
+        x, aux = _pipelined_layers(x, (params['layers'], layer_ids),
+                                   layer_fn, cfg, sin, cos)
     elif cfg.scan_layers:
-        def body(carry, lp):
-            return layer_fn(carry, lp, sin, cos), None
-        (x, aux), _ = jax.lax.scan(body, (x, aux0), params['layers'])
+        def body(carry, lp_idx):
+            return layer_fn(carry, lp_idx, sin, cos), None
+        (x, aux), _ = jax.lax.scan(body, (x, aux0),
+                                   (params['layers'], layer_ids))
     else:
         carry = (x, aux0)
         for i in range(cfg.n_layers):
             lp = jax.tree.map(lambda p: p[i], params['layers'])
-            carry = layer_fn(carry, lp, sin, cos)
+            carry = layer_fn(carry, (lp, jnp.int32(i)), sin, cos)
         x, aux = carry
 
     x = norms.rms_norm(x, params['final_norm'], cfg.rms_eps)

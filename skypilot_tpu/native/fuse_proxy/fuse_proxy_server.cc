@@ -119,10 +119,80 @@ int run_fusermount(const std::vector<std::string>& req, int* fuse_fd,
   return 128 + (WIFSIGNALED(wstatus) ? WTERMSIG(wstatus) : 0);
 }
 
+// Defense in depth on top of socket permissions: only root, the proxy's
+// own uid, or uids listed in SKYTPU_FUSE_PROXY_ALLOW_UIDS (comma list)
+// may drive a root fusermount.
+bool uid_allowed(uid_t uid) {
+  if (uid == 0 || uid == geteuid()) return true;
+  const char* env = getenv("SKYTPU_FUSE_PROXY_ALLOW_UIDS");
+  if (!env) return false;
+  std::string s(env);
+  size_t pos = 0;
+  while (pos < s.size()) {
+    size_t comma = s.find(',', pos);
+    if (comma == std::string::npos) comma = s.size();
+    std::string tok = s.substr(pos, comma - pos);
+    // strtoul, not stoul: malformed tokens must read as "not allowed",
+    // never throw (an uncaught exception would kill the handler child).
+    if (!tok.empty()) {
+      char* end = nullptr;
+      unsigned long val = strtoul(tok.c_str(), &end, 10);
+      if (end && *end == '\0' && val == uid) return true;
+    }
+    pos = comma + 1;
+  }
+  return false;
+}
+
+// Allowlist the client-controlled argv: running as root, fusermount skips
+// its setuid safety checks, so arbitrary flags must not pass through.
+// Allowed: -u/-z/-q/--, one "-o <opts>" (allow_other/allow_root gated
+// behind SKYTPU_FUSE_PROXY_ALLOW_OTHER), and bare mountpoint operands.
+bool argv_allowed(const std::vector<std::string>& req, std::string* why) {
+  bool other_ok = getenv("SKYTPU_FUSE_PROXY_ALLOW_OTHER") != nullptr;
+  for (size_t i = 1; i < req.size(); ++i) {
+    const std::string& a = req[i];
+    if (a == "-u" || a == "-z" || a == "-q" || a == "--") continue;
+    if (a == "-o") {
+      if (i + 1 >= req.size()) {
+        *why = "fuse-proxy: -o without a value\n";
+        return false;
+      }
+      const std::string& o = req[++i];
+      if (!other_ok && (o.find("allow_other") != std::string::npos ||
+                        o.find("allow_root") != std::string::npos)) {
+        *why = "fuse-proxy: allow_other/allow_root denied (set "
+               "SKYTPU_FUSE_PROXY_ALLOW_OTHER=1 on the proxy to "
+               "permit)\n";
+        return false;
+      }
+      continue;
+    }
+    if (!a.empty() && a[0] == '-') {
+      *why = "fuse-proxy: flag not allowed: " + a + "\n";
+      return false;
+    }
+  }
+  return true;
+}
+
 void serve_one(int conn) {
+  struct ucred cred = {};
+  socklen_t clen = sizeof(cred);
+  if (getsockopt(conn, SOL_SOCKET, SO_PEERCRED, &cred, &clen) == 0 &&
+      !uid_allowed(cred.uid)) {
+    fuseproxy::send_response(conn, 1, -1,
+                             "fuse-proxy: peer uid not allowed\n");
+    return;
+  }
   std::vector<std::string> req;
   if (!fuseproxy::recv_request(conn, &req) || req.empty()) {
     fuseproxy::send_response(conn, 1, -1, "fuse-proxy: bad request\n");
+    return;
+  }
+  std::string why;
+  if (!argv_allowed(req, &why)) {
+    fuseproxy::send_response(conn, 1, -1, why);
     return;
   }
   int fuse_fd = -1;
@@ -172,11 +242,12 @@ int main(int argc, char** argv) {
             strerror(errno));
     return 1;
   }
-  // Only the job container's uid should reach the proxy in production;
-  // the DaemonSet mounts the socket dir into trusted pods only. Mode 0666
-  // on the socket matches the reference's behavior (auth is the mount
-  // namespace, not the socket).
-  chmod(sock_path, 0666);
+  // 0660: only the proxy's user/group reach the socket (put trusted job
+  // uids in the group, or list them in SKYTPU_FUSE_PROXY_ALLOW_UIDS —
+  // SO_PEERCRED is checked per connection as a second layer). The
+  // reference relies on the mount namespace alone; a root fusermount
+  // deserves tighter defaults.
+  chmod(sock_path, 0660);
   fprintf(stderr, "fuse-proxy: listening on %s (fusermount=%s)\n",
           sock_path, g_fusermount);
 

@@ -24,7 +24,8 @@ NEG_INF = -2.0 ** 30  # large-but-finite: keeps softmax fp32-safe
 
 
 def _xla_attention_impl(q, k, v, causal, q_offset, kv_offset, segment_ids,
-                        softmax_scale, return_lse):
+                        softmax_scale, return_lse, logit_softcap=None,
+                        window=None, window_active=None):
     b, s, h, d = q.shape
     t, kh = k.shape[1], k.shape[2]
     groups = h // kh
@@ -35,8 +36,13 @@ def _xla_attention_impl(q, k, v, causal, q_offset, kv_offset, segment_ids,
     qg = qf.reshape(b, s, kh, groups, d)
     scores = jnp.einsum('bskgd,btkd->bkgst', qg, k,
                         preferred_element_type=jnp.float32)
+    if logit_softcap:
+        # Gemma-2 style: bound attention logits with cap·tanh(s/cap)
+        # BEFORE masking (the mask's NEG_INF must stay -inf-like).
+        scores = logit_softcap * jnp.tanh(scores / logit_softcap)
 
     mask = None
+    win_mask = None
     if causal:
         q_off = jnp.asarray(q_offset)
         kv_pos = jnp.arange(t) + kv_offset
@@ -45,11 +51,25 @@ def _xla_attention_impl(q, k, v, causal, q_offset, kv_offset, segment_ids,
             # sits at its own cache length).
             q_pos = jnp.arange(s)[None, :] + q_off[:, None]    # [B,S]
             mask = (q_pos[:, :, None] >= kv_pos[None, None, :])
+            if window is not None:
+                win_mask = (q_pos[:, :, None] - kv_pos[None, None, :] <
+                            window)
+                win_mask = win_mask[:, None, None, :, :]
             mask = mask[:, None, None, :, :]                   # [B,1,1,S,T]
         else:
             q_pos = jnp.arange(s) + q_off
             mask = q_pos[:, None] >= kv_pos[None, :]           # [S,T]
+            if window is not None:
+                win_mask = (q_pos[:, None] - kv_pos[None, :] < window)
+                win_mask = win_mask[None, None, None, :, :]
             mask = mask[None, None, None, :, :]
+        if win_mask is not None:
+            if window_active is not None:
+                # Traced per-layer flag (alternating local/global layers
+                # under one lax.scan): blend the window in only when set.
+                win_mask = jnp.logical_or(
+                    win_mask, jnp.logical_not(window_active))
+            mask = jnp.logical_and(mask, win_mask)
     if segment_ids is not None:
         q_seg, kv_seg = segment_ids
         seg_mask = (q_seg[:, :, None] == kv_seg[:, None, :])  # [B,S,T]
@@ -78,14 +98,23 @@ def xla_attention(q: jnp.ndarray,
                   q_offset: int | jnp.ndarray = 0,
                   kv_offset: int | jnp.ndarray = 0,
                   segment_ids: Optional[jnp.ndarray] = None,
-                  softmax_scale: Optional[float] = None) -> jnp.ndarray:
+                  softmax_scale: Optional[float] = None,
+                  logit_softcap: Optional[float] = None,
+                  window: Optional[int] = None,
+                  window_active=None) -> jnp.ndarray:
     """Reference attention. q [B,S,H,D], k/v [B,T,KH,D] → [B,S,H,D].
 
     q_offset/kv_offset are the global positions of q[:,0]/k[:,0] — used both
     for decode (q_offset=cache_len) and for context-parallel shards.
+    `logit_softcap` bounds attention logits (Gemma-2); `window` masks keys
+    older than `window` positions, gated by the (possibly traced)
+    `window_active` flag so alternating local/global layers share one
+    compiled scan body.
     """
     return _xla_attention_impl(q, k, v, causal, q_offset, kv_offset,
-                               segment_ids, softmax_scale, return_lse=False)
+                               segment_ids, softmax_scale, return_lse=False,
+                               logit_softcap=logit_softcap, window=window,
+                               window_active=window_active)
 
 
 def xla_attention_lse(q, k, v, *, causal: bool = True, softmax_scale=None):
@@ -103,12 +132,17 @@ def attention(q: jnp.ndarray,
               q_offset: int | jnp.ndarray = 0,
               kv_offset: int | jnp.ndarray = 0,
               segment_ids: Optional[jnp.ndarray] = None,
-              softmax_scale: Optional[float] = None) -> jnp.ndarray:
-    # The Pallas kernel supports neither position offsets nor segment ids;
-    # anything non-trivial routes to the XLA reference implementation.
+              softmax_scale: Optional[float] = None,
+              logit_softcap: Optional[float] = None,
+              window: Optional[int] = None,
+              window_active=None) -> jnp.ndarray:
+    # The Pallas kernel supports neither position offsets, segment ids,
+    # logit softcaps nor sliding windows; anything non-trivial routes to
+    # the XLA reference implementation.
     trivial = (isinstance(q_offset, int) and q_offset == 0 and
                isinstance(kv_offset, int) and kv_offset == 0 and
-               segment_ids is None)
+               segment_ids is None and logit_softcap is None and
+               window is None)
     if impl == 'auto':
         impl = 'flash' if (_on_tpu() and _flash_ok(q, k) and trivial) \
             else 'xla'
@@ -117,7 +151,9 @@ def attention(q: jnp.ndarray,
     if impl == 'xla':
         return xla_attention(q, k, v, causal=causal, q_offset=q_offset,
                              kv_offset=kv_offset, segment_ids=segment_ids,
-                             softmax_scale=softmax_scale)
+                             softmax_scale=softmax_scale,
+                             logit_softcap=logit_softcap, window=window,
+                             window_active=window_active)
     if impl == 'flash':
         from skypilot_tpu.ops.pallas import flash_attention
         return flash_attention.flash_attention(

@@ -167,6 +167,57 @@ def test_gemma_family_knobs():
     assert llama.PRESETS['gemma2-9b'].final_logit_softcap == 30.0
 
 
+def test_gemma2_features():
+    """Gemma-2 additions (ADVICE r2): attention-logit softcap, post-
+    sublayer norms, alternating sliding-window layers. Each knob changes
+    the function; a window >= seq is a no-op; and decode (per-row
+    offsets + the same alternation) matches forward with everything on —
+    two independent mask implementations agreeing."""
+    import dataclasses as dc
+    from skypilot_tpu.models import decode
+    cfg = dc.replace(CFG, dtype=jnp.float32, norm_plus_one=True,
+                     mlp_activation='gelu', embed_scale=True,
+                     final_logit_softcap=30.0, tie_embeddings=True,
+                     attn_logit_softcap=0.5, post_norms=True,
+                     sliding_window=4)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                cfg.vocab_size, jnp.int32)
+    logits = llama.forward(params, tokens, cfg)
+    for change in (dict(attn_logit_softcap=None), dict(post_norms=False),
+                   dict(sliding_window=None)):
+        other = dc.replace(cfg, **change)
+        assert not np.allclose(
+            np.asarray(logits),
+            np.asarray(llama.forward(params, tokens, other)), atol=1e-4), \
+            change
+    # A window at least as long as the sequence masks nothing.
+    wide = dc.replace(cfg, sliding_window=16)
+    off = dc.replace(cfg, sliding_window=None)
+    np.testing.assert_allclose(
+        np.asarray(llama.forward(params, tokens, wide)),
+        np.asarray(llama.forward(params, tokens, off)), rtol=1e-5,
+        atol=1e-5)
+    # Decode parity with every Gemma-2 knob on (window binds: 16 > 4).
+    last, cache = decode.prefill(params, tokens, cfg, max_len=32)
+    np.testing.assert_allclose(np.asarray(last), np.asarray(logits[:, -1]),
+                               rtol=2e-4, atol=2e-4)
+    seq = tokens
+    logits_t = last
+    for _ in range(3):
+        nxt = jnp.argmax(logits_t, -1).astype(jnp.int32)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+        logits_t, cache = decode.decode_step(params, nxt, cache, cfg)
+        np.testing.assert_allclose(
+            np.asarray(logits_t),
+            np.asarray(llama.forward(params, seq, cfg)[:, -1]),
+            rtol=2e-4, atol=2e-4)
+    # Preset carries the real architecture now.
+    g2 = llama.PRESETS['gemma2-9b']
+    assert (g2.attn_logit_softcap, g2.post_norms, g2.sliding_window) == \
+        (50.0, True, 4096)
+
+
 def test_validate_divisibility():
     with pytest.raises(ValueError):
         llama.validate_divisibility(CFG, {'tensor': 3})

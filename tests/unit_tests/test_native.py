@@ -167,3 +167,28 @@ class TestFuseProxy:
             env=env, cwd=str(tmp_path), capture_output=True, text=True)
         assert result.returncode == 3        # fake's unmount exit code
         assert 'fake-fusermount saw: -u mnt-point' in result.stderr
+
+    def test_disallowed_flag_rejected(self, proxy, tmp_path):
+        """The proxy runs fusermount as root (setuid checks skipped), so
+        client argv is allowlisted: unknown flags are refused without
+        executing fusermount."""
+        env = dict(os.environ, SKYTPU_FUSE_PROXY_SOCKET=proxy['sock'])
+        bad_flag = subprocess.run(
+            [proxy['shim'], '--evil-flag', 'mnt-point'],
+            env=env, cwd=str(tmp_path), capture_output=True, text=True)
+        assert bad_flag.returncode != 0
+        assert 'flag not allowed' in bad_flag.stderr
+        assert 'fake-fusermount saw' not in bad_flag.stderr
+
+    def test_allow_other_rejected_by_default(self, proxy, tmp_path):
+        env = dict(os.environ, SKYTPU_FUSE_PROXY_SOCKET=proxy['sock'])
+        allow_other = subprocess.run(
+            [proxy['shim'], '-o', 'rw,allow_other', 'mnt-point'],
+            env=env, cwd=str(tmp_path), capture_output=True, text=True)
+        assert allow_other.returncode != 0
+        assert 'allow_other' in allow_other.stderr
+        assert 'fake-fusermount saw' not in allow_other.stderr
+
+    def test_socket_mode_is_0660(self, proxy):
+        mode = os.stat(proxy['sock']).st_mode & 0o777
+        assert mode == 0o660, oct(mode)
