@@ -301,9 +301,26 @@ class TpuSliceBackend(backend_lib.Backend[SliceResourceHandle]):
         setup_log = os.path.expanduser(
             f'~/.skytpu/logs/{handle.cluster_name}/setup.log')
         logger.info(f'Running setup on {len(runners)} host(s)...')
+        from skypilot_tpu.utils import docker_utils
+        launched = handle.launched_resources_obj()
+        docker_image = docker_utils.docker_image_of(launched.image_id)
 
         def _setup(runner: command_runner_lib.CommandRunner) -> None:
             cmd = f'cd {WORKDIR_NAME} 2>/dev/null; {task.setup}'
+            if docker_image:
+                # image_id: docker:<img> — setup runs INSIDE the task
+                # container (started here, reused by the run phase). Env
+                # must be baked into the wrapped command: the host-shell
+                # exports from runner.run(env=...) don't cross the docker
+                # exec boundary (same pattern as slice_driver's rank
+                # commands).
+                import shlex as shlex_lib
+                exports = ' '.join(
+                    f'export {k}={shlex_lib.quote(str(v))};'
+                    for k, v in task.envs_and_secrets.items())
+                inner = f'{exports} {task.setup}'
+                cmd = (f'{docker_utils.bootstrap_cmd(docker_image)} && '
+                       f'{docker_utils.wrap(inner, WORKDIR_NAME)}')
             rc = runner.run(cmd, env=task.envs_and_secrets,
                             log_path=setup_log)
             if rc != 0:
@@ -369,26 +386,36 @@ class TpuSliceBackend(backend_lib.Backend[SliceResourceHandle]):
                 })
             elif cluster_info.provider_name == 'kubernetes':
                 # Pods have no sshd. The driver runs ON the head pod: its
-                # own rank is a plain local process (no kubectl needed —
-                # covers every single-host slice with the stock image);
-                # peer pods are reached via in-cluster kubectl exec, which
-                # requires the image to ship kubectl and the pod's service
-                # account to grant pods/exec (documented multi-host
-                # requirement). No --context: client-side kubeconfig
-                # context names mean nothing inside the cluster.
+                # own rank is a plain local process; peer pods are reached
+                # over the pod network via the exec agent that runtime
+                # setup started on them (skylet/exec_agent.py) — stock
+                # images work: no kubectl binary, no pods/exec RBAC.
+                # SKYTPU_K8S_KUBECTL_EXEC=1 restores the old in-cluster
+                # kubectl-exec fan-out (image must ship kubectl + RBAC).
                 pc = cluster_info.provider_config or {}
                 is_head = (inst.slice_index == 0 and inst.worker_id == 0)
+                use_kubectl = os.environ.get(
+                    'SKYTPU_K8S_KUBECTL_EXEC') == '1'
+                kind = ('local' if is_head
+                        else ('k8s' if use_kubectl else 'agent'))
                 host: Dict[str, Any] = {
-                    'kind': 'local' if is_head else 'k8s',
+                    'kind': kind,
                     'ip': inst.internal_ip,
                     'slice_index': inst.slice_index,
                     'worker_id': inst.worker_id,
                     'workdir': f'/root/{WORKDIR_NAME}',
                 }
-                if not is_head:
+                if kind == 'k8s':
                     host['k8s'] = {
                         'pod': inst.instance_id,
                         'namespace': pc.get('namespace', 'default'),
+                    }
+                elif kind == 'agent':
+                    from skypilot_tpu.skylet import exec_agent
+                    host['agent'] = {
+                        'ip': inst.internal_ip,
+                        'port': int(pc.get('exec_agent_port',
+                                           exec_agent.DEFAULT_PORT)),
                     }
                 hosts.append(host)
             else:
@@ -425,6 +452,12 @@ class TpuSliceBackend(backend_lib.Backend[SliceResourceHandle]):
             'num_slices': sl.num_slices if sl else 1,
             'epilogue_cmds': epilogue,
         }
+        from skypilot_tpu.utils import docker_utils
+        docker_image = docker_utils.docker_image_of(launched.image_id)
+        if docker_image and cluster_info.provider_name != 'kubernetes':
+            # k8s excepted: there the pod image IS the task image.
+            spec['docker'] = {'image': docker_image,
+                              'cmd': docker_utils.docker_cmd()}
 
         # 3. Ship the spec to the head host and start the driver detached.
         head = self._head_runner(cluster_info)

@@ -187,6 +187,51 @@ def _ship_package(runners: List[command_runner_lib.CommandRunner]) -> None:
     subprocess_utils.run_in_parallel(_ship, runners)
 
 
+def _start_exec_agents(cluster_name: str, cluster_info: common.ClusterInfo,
+                       runners, py: str) -> None:
+    """Multi-host k8s, kubectl-free: give every pod the cluster's exec-
+    agent token and start the agent (skylet/exec_agent.py) on the worker
+    pods. The client-side kubectl (these runners) may exec — it created
+    the pods; the HEAD pod then reaches workers over the pod network with
+    no kubectl/RBAC/sshd in the image."""
+    import secrets
+    from skypilot_tpu.skylet import exec_agent
+    del cluster_name
+    token = secrets.token_hex(16)
+    port = int((cluster_info.provider_config or {}).get(
+        'exec_agent_port', exec_agent.DEFAULT_PORT))
+
+    def _one(idx_runner):
+        idx, runner = idx_runner
+        rc = runner.run(
+            'mkdir -p "${SKYTPU_RUNTIME_DIR:-$HOME/.skytpu_runtime}" && '
+            f'printf %s {token} > '
+            '"${SKYTPU_RUNTIME_DIR:-$HOME/.skytpu_runtime}'
+            '/exec_agent.token"', log_path='/dev/null')
+        if rc != 0:
+            raise exceptions.ClusterSetupError(
+                f'Could not write exec-agent token on {runner.node_id}.')
+        if idx == 0:
+            return    # the head's own rank runs as a local process
+        # RESTART (not reuse): the token rotates per provision pass and
+        # the agent reads it once at startup — a surviving old agent
+        # would reject every new gang. The trailing pgrep is the success
+        # check ('... || nohup ... &' would background the whole list and
+        # always return 0).
+        rc = runner.run(
+            f'pkill -f "skylet.exec_agent serve" 2>/dev/null; sleep 0.2; '
+            f'nohup {py} -m skypilot_tpu.skylet.exec_agent serve '
+            f'--port {port} > /tmp/skytpu_exec_agent.log 2>&1 & '
+            f'sleep 0.5; pgrep -f "skylet.exec_agent serve" >/dev/null',
+            log_path='/dev/null')
+        if rc != 0:
+            raise exceptions.ClusterSetupError(
+                f'Could not start the exec agent on {runner.node_id} '
+                f'(see /tmp/skytpu_exec_agent.log on the pod).')
+
+    subprocess_utils.run_in_parallel(_one, list(enumerate(runners)))
+
+
 @timeline.event
 def post_provision_runtime_setup(cluster_name: str,
                                  cluster_info: common.ClusterInfo) -> None:
@@ -209,6 +254,8 @@ def post_provision_runtime_setup(cluster_name: str,
         head.run('mkdir -p ~/.ssh && chmod 700 ~/.ssh', log_path='/dev/null')
         head.rsync(private, '~/.ssh/skytpu-cluster-key', up=True)
         head.run('chmod 600 ~/.ssh/skytpu-cluster-key', log_path='/dev/null')
+    if cluster_info.provider_name == 'kubernetes' and len(runners) > 1:
+        _start_exec_agents(cluster_name, cluster_info, runners, py)
 
     def _setup_host(runner: command_runner_lib.CommandRunner) -> None:
         rc = runner.run('mkdir -p "${SKYTPU_RUNTIME_DIR:-$HOME/.skytpu_runtime}" '

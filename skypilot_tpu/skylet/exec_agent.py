@@ -1,0 +1,184 @@
+"""In-cluster exec agent: kubectl-free rank fan-out for k8s pods.
+
+Stock pod images cannot run multi-host gangs the kubectl way (the image
+must ship kubectl AND the pod's service account must grant pods/exec —
+backends/slice_backend.py r2 limitation). This agent removes both
+requirements: post-provision runtime setup starts `serve` on every worker
+pod (plain python, shipped with the package tree), and the head-pod
+slice driver reaches workers over the pod network with `client` — no
+kubectl binary, no RBAC, no sshd in the image.
+
+Protocol (newline-delimited JSON over one TCP connection):
+  client → {'token': <cluster secret>, 'cmd': <bash command line>}
+  server → {'out': <merged stdout/stderr line>}*   then   {'rc': <int>}
+
+Teardown rides the socket: the rank command runs in its own process
+group and the server kills the whole group the moment the connection
+drops — so the driver's first-failure gang teardown (killing its local
+client process) reaps the remote rank, same contract as the ssh -tt
+path.
+
+Auth: a per-cluster random token written to ~/.skytpu_runtime by runtime
+setup on every pod; both sides read their local copy. The pod network is
+flat, so the token (not reachability) is the auth boundary.
+
+Reference analog: none — the reference's k8s path needs its image
+(kubectl included) and pods/exec RBAC; this is the native replacement.
+"""
+from __future__ import annotations
+
+import argparse
+import base64
+import json
+import os
+import signal
+import socket
+import socketserver
+import subprocess
+import sys
+import threading
+
+DEFAULT_PORT = 17077
+TOKEN_PATH = os.path.join(
+    os.environ.get('SKYTPU_RUNTIME_DIR',
+                   os.path.expanduser('~/.skytpu_runtime')),
+    'exec_agent.token')
+
+
+def read_token(path: str = None) -> str:
+    with open(path or TOKEN_PATH, 'r', encoding='utf-8') as f:
+        return f.read().strip()
+
+
+# ---------------------------------------------------------------------------
+# Server
+# ---------------------------------------------------------------------------
+
+class _Handler(socketserver.StreamRequestHandler):
+
+    def handle(self):  # noqa: D102
+        try:
+            line = self.rfile.readline()
+            req = json.loads(line)
+        except (json.JSONDecodeError, UnicodeDecodeError):
+            self._send({'rc': 97, 'error': 'bad request'})
+            return
+        if req.get('token') != self.server.token:  # type: ignore[attr-defined]
+            self._send({'rc': 98, 'error': 'bad token'})
+            return
+        cmd = req.get('cmd')
+        if not isinstance(cmd, str) or not cmd:
+            self._send({'rc': 97, 'error': 'missing cmd'})
+            return
+        proc = subprocess.Popen(['bash', '-c', cmd],
+                                stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT,
+                                text=True, bufsize=1,
+                                start_new_session=True)
+
+        # If the client goes away (gang teardown killed it), kill the
+        # whole remote process group.
+        stop = threading.Event()
+
+        def _watch_peer():
+            try:
+                # recv returns b'' on orderly close; raises on reset.
+                self.connection.settimeout(None)
+                data = self.connection.recv(1, socket.MSG_PEEK)
+                if data == b'' and proc.poll() is None:
+                    try:
+                        os.killpg(proc.pid, signal.SIGKILL)
+                    except ProcessLookupError:
+                        pass    # exited between poll() and killpg
+
+            except OSError:
+                if proc.poll() is None:
+                    try:
+                        os.killpg(proc.pid, signal.SIGKILL)
+                    except ProcessLookupError:
+                        pass
+            finally:
+                stop.set()
+
+        watcher = threading.Thread(target=_watch_peer, daemon=True)
+        watcher.start()
+        try:
+            for out_line in proc.stdout:
+                self._send({'out': out_line.rstrip('\n')})
+            rc = proc.wait()
+            self._send({'rc': rc})
+        except (BrokenPipeError, ConnectionResetError):
+            if proc.poll() is None:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+            proc.wait()
+
+    def _send(self, obj) -> None:
+        self.wfile.write((json.dumps(obj) + '\n').encode())
+        self.wfile.flush()
+
+
+class _Server(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+def serve(port: int, token: str, host: str = '0.0.0.0') -> None:
+    srv = _Server((host, port), _Handler)
+    srv.token = token  # type: ignore[attr-defined]
+    srv.serve_forever()
+
+
+# ---------------------------------------------------------------------------
+# Client
+# ---------------------------------------------------------------------------
+
+def run_client(ip: str, port: int, token: str, cmd: str) -> int:
+    """Submit `cmd`, stream its output to stdout, return its exit code.
+
+    Killing this client closes the socket, which makes the server kill
+    the remote process group."""
+    with socket.create_connection((ip, port), timeout=30) as sock:
+        # Connect bounded, reads unbounded: a training rank may be silent
+        # for minutes — a lingering read timeout would kill the gang.
+        sock.settimeout(None)
+        sock.sendall((json.dumps({'token': token, 'cmd': cmd}) +
+                      '\n').encode())
+        f = sock.makefile('r', encoding='utf-8')
+        for line in f:
+            msg = json.loads(line)
+            if 'out' in msg:
+                print(msg['out'], flush=True)
+            if 'rc' in msg:
+                if msg.get('error'):
+                    print(f'exec-agent: {msg["error"]}', file=sys.stderr)
+                return int(msg['rc'])
+    return 99    # connection closed without a result
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(prog='skytpu-exec-agent')
+    sub = parser.add_subparsers(dest='mode', required=True)
+    s = sub.add_parser('serve')
+    s.add_argument('--port', type=int, default=DEFAULT_PORT)
+    s.add_argument('--token-file', default=TOKEN_PATH)
+    s.add_argument('--host', default='0.0.0.0')
+    c = sub.add_parser('client')
+    c.add_argument('--ip', required=True)
+    c.add_argument('--port', type=int, default=DEFAULT_PORT)
+    c.add_argument('--token-file', default=TOKEN_PATH)
+    c.add_argument('--cmd-b64', required=True,
+                   help='base64 of the bash command line to run remotely.')
+    args = parser.parse_args()
+    if args.mode == 'serve':
+        serve(args.port, read_token(args.token_file), host=args.host)
+    else:
+        cmd = base64.b64decode(args.cmd_b64).decode()
+        sys.exit(run_client(args.ip, args.port,
+                            read_token(args.token_file), cmd))
+
+
+if __name__ == '__main__':
+    main()

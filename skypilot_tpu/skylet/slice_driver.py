@@ -44,14 +44,38 @@ class _RankProc:
 
 
 def _build_rank_command(host: Dict[str, Any], run_cmd: str,
-                        env: Dict[str, str]) -> List[str]:
-    """Command launching `run_cmd` on one host with `env` exported."""
+                        env: Dict[str, str],
+                        docker: Optional[Dict[str, str]] = None
+                        ) -> List[str]:
+    """Command launching `run_cmd` on one host with `env` exported.
+
+    `docker` ({'image', 'cmd'}, from the job spec): the rank command runs
+    INSIDE the task container (utils/docker_utils) — env exports travel
+    in the wrapped inner command, the container is (re)used idempotently.
+    """
     import shlex
     exports = ' '.join(
         f'export {k}={shlex.quote(str(v))};' for k, v in env.items())
     inner = f'{exports} cd {shlex.quote(host.get("workdir", "~"))} 2>/dev/null; {run_cmd}'
+    if docker and host['kind'] != 'k8s':
+        from skypilot_tpu.utils import docker_utils
+        inner = (f'{docker_utils.bootstrap_cmd(docker["image"], docker.get("cmd"))} && '
+                 f'{docker_utils.wrap(inner, host.get("workdir"), docker.get("cmd"))}')
     if host['kind'] == 'local':
         return ['bash', '-c', inner]
+    if host['kind'] == 'agent':
+        # In-cluster exec agent (skylet/exec_agent.py): stock-image k8s
+        # fan-out over the pod network. Killing this client closes the
+        # socket and the agent kills the remote process group — same
+        # teardown contract as ssh -tt.
+        import base64
+        from skypilot_tpu.skylet import exec_agent
+        agent = host['agent']
+        return [sys.executable, '-m', 'skypilot_tpu.skylet.exec_agent',
+                'client', '--ip', agent['ip'],
+                '--port', str(agent.get('port', exec_agent.DEFAULT_PORT)),
+                '--cmd-b64',
+                base64.b64encode(inner.encode()).decode()]
     if host['kind'] == 'k8s':
         # kubectl exec from the head pod (in-cluster service account) or
         # wherever the driver runs with a kubeconfig.
@@ -148,7 +172,8 @@ def run_gang(spec: Dict[str, Any]) -> int:
                     coordinator_ip=coordinator_ip,
                 ))
             env.update(host.get('extra_env', {}))
-            cmd = _build_rank_command(host, run_cmd, env)
+            cmd = _build_rank_command(host, run_cmd, env,
+                                      docker=spec.get('docker'))
             rank_log = os.path.join(
                 log_dir, constants.RANK_LOG_FMT.format(rank=rank))
             proc = subprocess.Popen(

@@ -1,0 +1,70 @@
+"""Docker task runtime: run a task's setup/run inside its container image.
+
+Reference analog: sky/provision/docker_utils.py (DockerInitializer, ~557
+LoC) — which re-bootstraps the whole Ray node runtime inside the
+container. Redesigned for this framework: the host runtime (skylet, job
+queue, slice driver) stays ON the host; only the USER's setup and run
+commands execute inside a long-lived keep-alive container that
+bind-mounts the home directory (and with it the synced workdir) at the
+same absolute path. One wrapper seam, no parallel bootstrap path.
+
+Wiring: `image_id: docker:<image>` on a task's resources →
+  - backends/slice_backend.setup wraps the setup command;
+  - the gang job spec carries {'image', 'docker_cmd'} and
+    skylet/slice_driver wraps every rank command.
+The VM image must ship a docker daemon (true for GCP's TPU VM images);
+`SKYTPU_DOCKER_CMD` overrides the binary (tests point it at a fake).
+TPU device access: the container runs --privileged with host networking,
+so libtpu sees the chips exactly as a host process would.
+"""
+from __future__ import annotations
+
+import os
+import shlex
+from typing import Optional
+
+CONTAINER_NAME = 'skytpu-task'
+_PREFIX = 'docker:'
+
+
+def docker_image_of(image_id: Optional[str]) -> Optional[str]:
+    """The container image named by `image_id`, or None for VM images."""
+    if image_id and image_id.startswith(_PREFIX):
+        return image_id[len(_PREFIX):]
+    return None
+
+
+def docker_cmd() -> str:
+    return os.environ.get('SKYTPU_DOCKER_CMD', 'docker')
+
+
+def bootstrap_cmd(image: str, cmd: Optional[str] = None) -> str:
+    """Idempotent shell command ensuring the task container is running.
+
+    Reuses a running container only if it runs the right image (a changed
+    image_id on re-launch replaces it — the reference's
+    check_docker_image/maybe_remove_container flow, one shell line)."""
+    d = cmd or docker_cmd()
+    q_img = shlex.quote(image)
+    c = CONTAINER_NAME
+    return (
+        f'if [ "$({d} inspect -f {{{{.State.Running}}}}-{{{{.Config.Image}}}}'
+        f' {c} 2>/dev/null)" != "true-{image}" ]; then '
+        f'{d} rm -f {c} >/dev/null 2>&1; '
+        f'{d} pull {q_img} && '
+        f'{d} run -d --name {c} --network host --privileged '
+        f'-v "$HOME:$HOME" {q_img} sleep infinity; '
+        f'fi')
+
+
+def wrap(inner: str, workdir: Optional[str] = None,
+         cmd: Optional[str] = None) -> str:
+    """Run `inner` (a bash command line) inside the task container.
+
+    `workdir` is resolved by the HOST shell ($(cd ... && pwd)) so `~` and
+    relative paths mean the host's filesystem — valid inside the
+    container because $HOME is bind-mounted at the same path."""
+    d = cmd or docker_cmd()
+    wd = (f'$(cd {workdir} 2>/dev/null && pwd || pwd)'
+          if workdir else '$(pwd)')
+    return f'{d} exec -w "{wd}" {CONTAINER_NAME} bash -c {shlex.quote(inner)}'

@@ -125,6 +125,77 @@ class TestLaunchLocal:
         finally:
             sky.down('t-fail')
 
+    def test_docker_image_runtime(self, tmp_path, monkeypatch):
+        """image_id: docker:<img> — setup and every rank's run command
+        execute through the container wrapper (bootstrap: pull + keepalive
+        run, then docker exec). A fake docker binary emulates the daemon
+        and actually executes the exec'd command, so the job's effects
+        and the wrapper's call sequence are both asserted."""
+        state = tmp_path / 'docker-state'
+        calls = tmp_path / 'docker-calls.log'
+        fake = tmp_path / 'fake-docker.py'
+        fake.write_text(f'''#!/usr/bin/env python3
+import subprocess, sys
+args = sys.argv[1:]
+with open({str(calls)!r}, 'a') as f:
+    f.write(' '.join(args) + chr(10))
+state = {str(state)!r}
+if args[0] == 'inspect':
+    try:
+        img = open(state).read().strip()
+        print('true-' + img)
+    except FileNotFoundError:
+        sys.exit(1)
+elif args[0] == 'rm':
+    import os
+    try: os.remove(state)
+    except FileNotFoundError: pass
+elif args[0] == 'pull':
+    pass
+elif args[0] == 'run':
+    # ... IMG sleep infinity -> image is the third-from-last arg
+    open(state, 'w').write(args[-3])
+elif args[0] == 'exec':
+    import os
+    wd = args[args.index('-w') + 1]
+    cmd = args[-1]
+    # Scrub env like a real container would: only exports baked into the
+    # wrapped command may reach the task.
+    env = {{'PATH': os.environ['PATH'], 'HOME': os.environ.get('HOME', '/')}}
+    sys.exit(subprocess.run(['bash', '-c', cmd], cwd=wd,
+                            env=env).returncode)
+sys.exit(0)
+''')
+        fake.chmod(0o755)
+        monkeypatch.setenv('SKYTPU_DOCKER_CMD', str(fake))
+
+        out = tmp_path / 'out.txt'
+        setup_out = tmp_path / 'setup.txt'
+        task = sky.Task(name='indocker',
+                        setup=f'echo setup-saw-$MY_SECRET > {setup_out}',
+                        run=f'echo run-rank-$SKYPILOT_NODE_RANK >> {out}',
+                        envs={'MY_SECRET': 'hunter2'})
+        task.set_resources(sky.Resources(accelerators='tpu-v5e-8',
+                                         image_id='docker:ghcr.io/acme/img:1'))
+        job_id, _ = sky.launch(task, cluster_name='t-docker',
+                               detach_run=True)
+        try:
+            status = _wait_job('t-docker', job_id)
+            assert status == JobStatus.SUCCEEDED
+            assert 'run-rank-0' in out.read_text()
+            # Task envs crossed the docker exec boundary (the fake scrubs
+            # the host env, so only baked exports can reach setup).
+            assert setup_out.read_text().strip() == 'setup-saw-hunter2'
+            log = calls.read_text()
+            assert 'pull ghcr.io/acme/img:1' in log
+            assert '--network host --privileged' in log
+            # Setup and run both went through docker exec; the container
+            # was reused (exactly one run after the first bootstrap).
+            assert log.count('exec -w') >= 2
+            assert state.read_text().strip() == 'ghcr.io/acme/img:1'
+        finally:
+            sky.down('t-docker')
+
     def test_exec_on_existing_and_queue(self):
         task = sky.Task(name='first', run='echo one')
         task.set_resources(sky.Resources(accelerators='tpu-v5e-8'))
