@@ -1,0 +1,15 @@
+{{- define "skytpu.fullname" -}}
+{{- printf "%s-skytpu" .Release.Name | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
+
+{{- define "skytpu.labels" -}}
+app.kubernetes.io/name: skytpu
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/version: {{ .Chart.AppVersion | quote }}
+app.kubernetes.io/managed-by: {{ .Release.Service }}
+{{- end -}}
+
+{{- define "skytpu.selectorLabels" -}}
+app.kubernetes.io/name: skytpu
+app.kubernetes.io/instance: {{ .Release.Name }}
+{{- end -}}

@@ -758,6 +758,21 @@ def api_status(limit: int):
         click.echo(f"{r['request_id']}  {r['name']:<18} {r['status']}")
 
 
+@api.command(name='login')
+@click.argument('url', required=True)
+@click.option('--token', default=None,
+              help='Bearer token the server requires (helm chart: the '
+                   '<release>-skytpu-token secret).')
+def api_login(url: str, token: str):
+    """Point this client at a (remote) API server and persist it."""
+    from skypilot_tpu.client import sdk
+    try:
+        sdk.login(url, token)
+    except sdk.ApiError as e:
+        raise click.ClickException(str(e))
+    click.echo(f'Logged in to {url.rstrip("/")}.')
+
+
 @api.command(name='logs')
 @click.argument('request_id', required=True)
 def api_logs(request_id: str):

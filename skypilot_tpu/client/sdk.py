@@ -26,13 +26,16 @@ logger = sky_logging.init_logger(__name__)
 DEFAULT_URL = 'http://127.0.0.1:46580'
 
 
+def _token_path() -> str:
+    return os.path.expanduser('~/.skytpu/api_token')
+
+
 def _headers() -> dict:
     """Bearer auth when the server requires it (server/_api_token)."""
     token = os.environ.get('SKYTPU_API_TOKEN', '')
     if not token:
         try:
-            with open(os.path.expanduser('~/.skytpu/api_token'), 'r',
-                      encoding='utf-8') as f:
+            with open(_token_path(), 'r', encoding='utf-8') as f:
                 token = f.read().strip()
         except OSError:
             token = ''
@@ -52,6 +55,35 @@ class RequestFailedError(ApiError):
 
 def endpoint_file() -> str:
     return os.path.join(server_requests.server_dir(), 'endpoint')
+
+
+def login(url: str, token: Optional[str] = None) -> None:
+    """Point this client at an API server persistently (the deploy story
+    for the helm chart: `skytpu api login <url> --token <...>`).
+
+    Writes the endpoint file every later CLI/SDK call resolves, and the
+    bearer token to ~/.skytpu/api_token (0600). Health-checked first so a
+    typo'd URL fails here, not on the next launch."""
+    url = url.rstrip('/')
+    if not _healthy(url):
+        raise ApiError(f'No healthy API server at {url} '
+                       f'(GET {url}/api/v1/health failed).')
+    os.makedirs(os.path.dirname(endpoint_file()), exist_ok=True)
+    with open(endpoint_file(), 'w', encoding='utf-8') as f:
+        f.write(url)
+    if token:
+        os.makedirs(os.path.dirname(_token_path()), exist_ok=True)
+        fd = os.open(_token_path(), os.O_WRONLY | os.O_CREAT | os.O_TRUNC,
+                     0o600)
+        with os.fdopen(fd, 'w', encoding='utf-8') as f:
+            f.write(token)
+    else:
+        # Token-less login must CLEAR any previous server's token — it
+        # would otherwise keep riding along to the new host.
+        try:
+            os.remove(_token_path())
+        except OSError:
+            pass
 
 
 def api_server_url(required: bool = False) -> Optional[str]:

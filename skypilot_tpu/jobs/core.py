@@ -95,8 +95,11 @@ def queue(name: Optional[str] = None,
           skip_finished: bool = False) -> List[Dict[str, Any]]:
     # Piggyback the crash watchdog on inspection: a job whose controller
     # died hard gets its controller resumed the next time anyone looks
-    # (scheduler.maybe_schedule is idempotent and cheap).
+    # (scheduler.maybe_schedule is idempotent and cheap). Log GC rides
+    # the same path, rate-limited (jobs/log_gc.py).
     scheduler.maybe_schedule()
+    from skypilot_tpu.jobs import log_gc
+    log_gc.maybe_collect()
     jobs = state.get_jobs(name)
     if skip_finished:
         jobs = [j for j in jobs if not j['status'].is_terminal()]

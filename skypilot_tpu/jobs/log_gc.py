@@ -1,0 +1,88 @@
+"""Controller/run log garbage collection for managed jobs.
+
+Reference analog: sky/jobs/log_gc.py:1-201 — an asyncio daemon with
+leader-election filelock and per-kind retention config. Redesigned to
+match this framework's daemonless jobs plane: collection is a cheap,
+idempotent pass piggybacked on `scheduler.maybe_schedule` (the same
+trick the crash watchdog uses), rate-limited by a marker file's mtime, so
+logs age out as long as ANYONE looks at the queue — no long-lived
+process required.
+
+Config (skypilot config, hours; negative disables):
+  jobs.controller_logs_gc_retention_hours   (default 168 = 7 days)
+  jobs.task_logs_gc_retention_hours         (default 168)
+Only logs of TERMINAL jobs are ever collected.
+"""
+from __future__ import annotations
+
+import os
+import time
+from typing import List
+
+from skypilot_tpu import config as config_lib
+from skypilot_tpu import sky_logging
+from skypilot_tpu.jobs import state
+
+logger = sky_logging.init_logger(__name__)
+
+DEFAULT_RETENTION_HOURS = 24 * 7
+# At most one sweep per this interval (marker-file mtime).
+SWEEP_INTERVAL_SECONDS = int(os.environ.get('SKYTPU_JOBS_LOG_GC_INTERVAL',
+                                            '3600'))
+
+
+def _marker_path() -> str:
+    return os.path.join(os.path.dirname(state.controller_log_path(0)),
+                        '.log_gc_last_sweep')
+
+
+def _retention_seconds(key: str) -> float:
+    hours = config_lib.get_nested(('jobs', key), DEFAULT_RETENTION_HOURS)
+    return float(hours) * 3600.0
+
+
+def collect(now: float = None) -> List[str]:
+    """One sweep: delete logs of terminal jobs older than retention.
+
+    Age is the log file's mtime (terminal jobs stop writing, so mtime ≈
+    finish time without a schema change). Returns removed paths."""
+    now = time.time() if now is None else now
+    ret_ctrl = _retention_seconds('controller_logs_gc_retention_hours')
+    ret_task = _retention_seconds('task_logs_gc_retention_hours')
+    removed: List[str] = []
+    for job in state.get_jobs(None):
+        if not job['status'].is_terminal():
+            continue
+        jid = job['job_id']
+        for path, retention in (
+                (state.controller_log_path(jid), ret_ctrl),
+                (state.job_log_path(jid), ret_task)):
+            if retention < 0:
+                continue
+            try:
+                if now - os.path.getmtime(path) > retention:
+                    os.remove(path)
+                    removed.append(path)
+            except OSError:
+                continue
+    if removed:
+        logger.info(f'Log GC removed {len(removed)} file(s) of terminal '
+                    f'jobs past retention.')
+    return removed
+
+
+def maybe_collect() -> None:
+    """Rate-limited sweep; safe to call from any inspection path."""
+    marker = _marker_path()
+    try:
+        if time.time() - os.path.getmtime(marker) < SWEEP_INTERVAL_SECONDS:
+            return
+    except OSError:
+        pass
+    try:
+        os.makedirs(os.path.dirname(marker), exist_ok=True)
+        with open(marker, 'w', encoding='utf-8') as f:
+            f.write(str(time.time()))
+        collect()
+    except Exception as e:  # pylint: disable=broad-except
+        logger.debug(f'log GC sweep failed: {e}')

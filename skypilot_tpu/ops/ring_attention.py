@@ -35,6 +35,7 @@ Causal layouts:
 from __future__ import annotations
 
 import functools
+import os
 from typing import NamedTuple, Optional, Tuple
 
 import jax
@@ -267,6 +268,14 @@ def ring_attention(q: jnp.ndarray,
 # Backward (inside shard_map)
 # ---------------------------------------------------------------------------
 
+# Long-context backward memory bound: KV chunks larger than this are
+# processed through a lax.scan, so the materialized score/probability
+# block is [B,KH,G,Sq,CHUNK] f32 instead of [B,KH,G,Sq,Tk] — at 32k-token
+# shards the unchunked block would be gigabytes per step. The einsums
+# still land on the MXU; only peak HBM changes.
+_BWD_KV_CHUNK = int(os.environ.get('SKYTPU_RING_BWD_CHUNK', '1024'))
+
+
 def _block_grads(qa, do_a, lse_a, delta_a, kb, vb, rel, scale):
     """Flash-style block gradients for one q-chunk × kv-chunk pair.
 
@@ -276,30 +285,57 @@ def _block_grads(qa, do_a, lse_a, delta_a, kb, vb, rel, scale):
       dS = P ⊙ (dP - Δ)  with Δ = rowsum(dO ⊙ O);
       dQ = dS·K·scale;   dK = dSᵀ·Q·scale.
     Shapes: qa/do_a [B,Sq,H,D], kb/vb [B,Tk,KH,D], lse_a/delta_a [B,Sq,H].
+    KV dims past _BWD_KV_CHUNK are scanned in chunks (memory-bounded).
     """
     b, sq, h, d = qa.shape
     tk, kh = kb.shape[1], kb.shape[2]
     g = h // kh
 
-    def compute(masked):
-        qg = qa.reshape(b, sq, kh, g, d).astype(jnp.float32)
-        dog = do_a.reshape(b, sq, kh, g, d).astype(jnp.float32)
-        kf = kb.astype(jnp.float32)
-        vf = vb.astype(jnp.float32)
+    qg = qa.reshape(b, sq, kh, g, d).astype(jnp.float32)
+    dog = do_a.reshape(b, sq, kh, g, d).astype(jnp.float32)
+    lse_g = lse_a.reshape(b, sq, kh, g).transpose(0, 2, 3, 1)
+    delta_g = delta_a.reshape(b, sq, kh, g).transpose(0, 2, 3, 1)
+
+    def grads_vs_kv_chunk(kf, vf, kv_off, masked):
+        """(dq_contrib, dk_chunk, dv_chunk) against kv[kv_off:kv_off+ck]."""
         s = jnp.einsum('bskgd,btkd->bkgst', qg, kf) * scale
         if masked:
+            ck = kf.shape[1]
             causal_mask = (jnp.arange(sq)[:, None] >=
-                           jnp.arange(tk)[None, :])
+                           jnp.arange(ck)[None, :] + kv_off)
             s = jnp.where(causal_mask[None, None, None], s, NEG_INF)
-        lse_g = lse_a.reshape(b, sq, kh, g).transpose(0, 2, 3, 1)
         p = jnp.exp(s - lse_g[..., None])
         dv = jnp.einsum('bkgst,bskgd->btkd', p, dog)
         dp = jnp.einsum('bskgd,btkd->bkgst', dog, vf)
-        delta_g = delta_a.reshape(b, sq, kh, g).transpose(0, 2, 3, 1)
         ds = p * (dp - delta_g[..., None])
         dq = jnp.einsum('bkgst,btkd->bskgd', ds, kf).reshape(
             b, sq, h, d) * scale
         dk = jnp.einsum('bkgst,bskgd->btkd', ds, qg) * scale
+        return dq, dk, dv
+
+    def compute(masked):
+        kf_all = kb.astype(jnp.float32)
+        vf_all = vb.astype(jnp.float32)
+        # Largest divisor of tk <= the target chunk, so the memory bound
+        # holds for non-power-of-two shard sizes too (equal-size chunks
+        # keep the scan body static-shaped).
+        ck = min(_BWD_KV_CHUNK, tk)
+        while tk % ck != 0:
+            ck -= 1
+        if tk <= ck:
+            return grads_vs_kv_chunk(kf_all, vf_all, 0, masked)
+
+        def chunk_body(dq_acc, idx):
+            kc = jax.lax.dynamic_slice_in_dim(kf_all, idx * ck, ck, 1)
+            vc = jax.lax.dynamic_slice_in_dim(vf_all, idx * ck, ck, 1)
+            dq_c, dk_c, dv_c = grads_vs_kv_chunk(kc, vc, idx * ck, masked)
+            return dq_acc + dq_c, (dk_c, dv_c)
+
+        dq0 = jnp.zeros((b, sq, h, d), jnp.float32)
+        dq, (dks, dvs) = jax.lax.scan(chunk_body, dq0,
+                                      jnp.arange(tk // ck))
+        dk = dks.transpose(1, 0, 2, 3, 4).reshape(b, tk, kh, d)
+        dv = dvs.transpose(1, 0, 2, 3, 4).reshape(b, tk, kh, d)
         return dq, dk, dv
 
     def full(_):

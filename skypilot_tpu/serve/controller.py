@@ -108,6 +108,8 @@ class ServiceController:
                 else:
                     ready = self.manager.ready_urls()
                     self.lb.set_ready_replicas(ready)
+                    self.lb.policy.set_replica_weights(
+                        self.manager.ready_url_weights())
                 status = (ServiceStatus.READY if ready else
                           ServiceStatus.REPLICA_INIT)
                 if record['status'] is not status:

@@ -27,6 +27,12 @@ class LoadBalancingPolicy:
                 u: self._in_flight.get(u, 0) for u in urls
             }
 
+    def set_replica_weights(self, weights: Dict[str, float]) -> None:
+        """Optional per-replica capacity weights (url → relative QPS
+        capability). Base policies ignore them; instance-aware ones
+        normalize load by them."""
+        del weights
+
     def select(self) -> Optional[str]:
         raise NotImplementedError
 
@@ -65,3 +71,33 @@ class LeastLoadPolicy(LoadBalancingPolicy):
                 return None
             return min(self._replicas,
                        key=lambda u: self._in_flight.get(u, 0))
+
+
+@registry.LB_POLICY_REGISTRY.register(name='instance_aware_least_load')
+class InstanceAwareLeastLoadPolicy(LeastLoadPolicy):
+    """Least NORMALIZED load: in-flight count divided by the replica's
+    capacity weight, so a v5e-16 replica takes proportionally more
+    traffic than a v5e-8 one in a heterogeneous (e.g. spot-fallback)
+    replica set. Weights come from the serve controller (chip count of
+    each replica's launched slice). Reference analog:
+    sky/serve/load_balancing_policies.py:151
+    (InstanceAwareLeastLoadPolicy, normalized by per-accelerator target
+    QPS)."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._weights: Dict[str, float] = {}
+
+    def set_replica_weights(self, weights: Dict[str, float]) -> None:
+        with self._lock:
+            self._weights = {u: max(float(w), 1e-9)
+                             for u, w in weights.items()}
+
+    def select(self) -> Optional[str]:
+        with self._lock:
+            if not self._replicas:
+                return None
+            return min(
+                self._replicas,
+                key=lambda u: (self._in_flight.get(u, 0) /
+                               self._weights.get(u, 1.0)))

@@ -492,3 +492,31 @@ class ReplicaManager:
         return [r['url'] for r in serve_state.get_replicas(self.service_name)
                 if r['status'] is ReplicaStatus.READY and r['url'] and
                 (r.get('version') or 1) in self.active_versions]
+
+    def ready_url_weights(self) -> Dict[str, float]:
+        """url → capacity weight (total chips of the replica's launched
+        slice; 1.0 when unknown) for instance-aware LB policies — a
+        heterogeneous replica set (spot fallback across accelerator
+        sizes) should not be loaded uniformly. Same readiness AND
+        active-version filter as ready_urls (one source of truth)."""
+        weights: Dict[str, float] = {}
+        routable = set(self.ready_urls())
+        for rep in serve_state.get_replicas(self.service_name):
+            if rep['url'] not in routable:
+                continue
+            weight = 1.0
+            record = global_state.get_cluster(
+                self._cluster_name(rep['replica_id']))
+            if record is not None:
+                try:
+                    handle = slice_backend.SliceResourceHandle.from_dict(
+                        record['handle'])
+                    tpu = handle.launched_resources_obj().tpu
+                    if tpu is not None:
+                        weight = float(tpu.total_chips)
+                except Exception as e:  # pylint: disable=broad-except
+                    logger.debug(f'weight for replica '
+                                 f'{rep["replica_id"]} falls back to 1.0 '
+                                 f'(handle parse: {e})')
+            weights[rep['url']] = weight
+        return weights

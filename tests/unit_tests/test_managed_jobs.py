@@ -243,3 +243,48 @@ class TestManagedJobs:
         rows = jobs_core.queue()
         assert [r['job_id'] for r in rows] == list(reversed(ids))
         assert all(r['status'] is ManagedJobStatus.SUCCEEDED for r in rows)
+
+    def test_log_gc_collects_terminal_job_logs(self):
+        """jobs/log_gc: logs of TERMINAL jobs past retention are removed;
+        fresh logs, non-terminal jobs and negative retention are kept
+        (reference analog: sky/jobs/log_gc.py)."""
+        from skypilot_tpu.jobs import log_gc
+
+        def _mk(job_id, old=True):
+            for path in (jobs_state.controller_log_path(job_id),
+                         jobs_state.job_log_path(job_id)):
+                os.makedirs(os.path.dirname(path), exist_ok=True)
+                with open(path, 'w', encoding='utf-8') as f:
+                    f.write('x')
+                if old:
+                    past = time.time() - 10 * 24 * 3600
+                    os.utime(path, (past, past))
+
+        done = jobs_state.submit('old-done', {'run': 'true'}, 'failover')
+        jobs_state.set_terminal(done, ManagedJobStatus.SUCCEEDED)
+        _mk(done, old=True)
+        fresh = jobs_state.submit('fresh-done', {'run': 'true'}, 'failover')
+        jobs_state.set_terminal(fresh, ManagedJobStatus.FAILED)
+        _mk(fresh, old=False)
+        running = jobs_state.submit('running', {'run': 'true'}, 'failover')
+        _mk(running, old=True)    # old logs but the job is NOT terminal
+
+        removed = log_gc.collect()
+        assert sorted(removed) == sorted(
+            [jobs_state.controller_log_path(done),
+             jobs_state.job_log_path(done)])
+        assert os.path.exists(jobs_state.controller_log_path(fresh))
+        assert os.path.exists(jobs_state.controller_log_path(running))
+        # Negative retention disables collection entirely.
+        _mk(done, old=True)
+        from skypilot_tpu import config as config_lib
+        orig = config_lib.get_nested
+        try:
+            config_lib.get_nested = lambda keys, default=None: -1
+            assert log_gc.collect() == []
+        finally:
+            config_lib.get_nested = orig
+        # The rate-limited entry point runs a first sweep, then no-ops.
+        assert os.path.exists(jobs_state.controller_log_path(done))
+        log_gc.maybe_collect()
+        assert not os.path.exists(jobs_state.controller_log_path(done))

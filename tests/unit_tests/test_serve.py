@@ -114,6 +114,28 @@ class TestServiceSpec:
             spec_lib.ServiceSpec.from_yaml_config(
                 {'load_balancing_policy': 'magic'})
 
+    def test_instance_aware_least_load_policy(self):
+        """Heterogeneous replica set: load is normalized by capacity
+        weight, so a 16-chip replica absorbs 2x the traffic of an 8-chip
+        one (reference: load_balancing_policies.py:151)."""
+        from skypilot_tpu.serve import load_balancing_policies as lb
+        spec_lib.ServiceSpec.from_yaml_config(
+            {'load_balancing_policy': 'instance_aware_least_load'})
+        p = lb.InstanceAwareLeastLoadPolicy()
+        p.set_ready_replicas(['u8', 'u16'])
+        p.set_replica_weights({'u8': 8.0, 'u16': 16.0})
+        picks = []
+        for _ in range(6):
+            target = p.select()
+            p.request_started(target)
+            picks.append(target)
+        assert picks.count('u16') == 4 and picks.count('u8') == 2
+        # Unknown weights degrade to plain least-load (weight 1).
+        p2 = lb.InstanceAwareLeastLoadPolicy()
+        p2.set_ready_replicas(['a', 'b'])
+        p2.request_started('a')
+        assert p2.select() == 'b'
+
 
 class TestAutoscaler:
 

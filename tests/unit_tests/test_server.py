@@ -6,6 +6,7 @@ real app, requests executed inline instead of in runner subprocesses.
 """
 import asyncio
 import json
+import os
 import time
 
 import pytest
@@ -106,6 +107,28 @@ class TestApiServer:
             assert 'skytpu_requests_total{name="launch",status="NEW"} 1' \
                 in text
         _with_client(fn)
+
+
+@pytest.mark.usefixtures('isolated_server')
+class TestApiLogin:
+
+    def test_login_persists_endpoint_and_token(self, tmp_path, monkeypatch):
+        """`skytpu api login` (the helm-chart deploy story): endpoint file
+        + 0600 token file written after a successful health check; a dead
+        URL raises instead of persisting garbage."""
+        from skypilot_tpu.client import sdk
+        monkeypatch.setenv('HOME', str(tmp_path))
+        monkeypatch.setattr(sdk, '_healthy', lambda url: True)
+        sdk.login('http://sky.example:46580/', token='sekrit')
+        with open(sdk.endpoint_file(), encoding='utf-8') as f:
+            assert f.read() == 'http://sky.example:46580'
+        token_path = os.path.join(str(tmp_path), '.skytpu', 'api_token')
+        assert open(token_path, encoding='utf-8').read() == 'sekrit'
+        assert (os.stat(token_path).st_mode & 0o777) == 0o600
+
+        monkeypatch.setattr(sdk, '_healthy', lambda url: False)
+        with pytest.raises(sdk.ApiError):
+            sdk.login('http://dead.example:1')
 
 
 @pytest.mark.usefixtures('isolated_server')
