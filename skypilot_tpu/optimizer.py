@@ -37,10 +37,18 @@ if typing.TYPE_CHECKING:
 
 logger = sky_logging.init_logger(__name__)
 
-# Assumed model FLOPs utilization when converting FLOPs → runtime. Only used
-# for *relative* ranking of slice shapes, so the absolute value is not load-
-# bearing.
-_ASSUMED_MFU = 0.4
+# Assumed model FLOPs utilization when converting FLOPs → runtime, PER
+# GENERATION: achievable MFU tracks memory bandwidth per peak FLOP, which
+# differs across generations — a flat number ranks v5e vs v6e wrong (v6e
+# has 4.7x the peak but nowhere near 4.7x the bandwidth). Values are
+# coarse by design (the ranking, not the absolute runtime, is load-
+# bearing); v5e's is this framework's own measured train MFU (bench.py).
+_ASSUMED_MFU_BY_GEN = {
+    'v2': 0.35, 'v3': 0.40, 'v4': 0.50, 'v5p': 0.50,
+    'v5e': 0.55,            # measured: bench.py, Llama-1B class, bf16
+    'v6e': 0.40,            # high peak / relatively lower HBM BW per FLOP
+}
+_ASSUMED_MFU_DEFAULT = 0.4
 _DEFAULT_TASK_SECONDS = 3600.0
 # Exact-search budget: beyond this many assignment combinations fall back to
 # per-node greedy.
@@ -84,7 +92,9 @@ def _estimate_seconds(task: 'task_lib.Task',
     flops = getattr(task, 'estimated_total_flops', None)
     if flops and res.tpu is not None:
         peak = res.tpu.peak_bf16_tflops * 1e12
-        return max(1.0, flops / (peak * _ASSUMED_MFU))
+        mfu = _ASSUMED_MFU_BY_GEN.get(res.tpu.gen.name,
+                                      _ASSUMED_MFU_DEFAULT)
+        return max(1.0, flops / (peak * mfu))
     if task.estimated_duration_seconds is not None:
         return task.estimated_duration_seconds
     return _DEFAULT_TASK_SECONDS

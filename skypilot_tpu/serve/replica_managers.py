@@ -293,23 +293,32 @@ class ReplicaManager:
         return not statuses or not all(
             s in ('running', 'READY') for s in statuses.values())
 
-    def _replica_app_alive(self, replica_id: int) -> bool:
-        """Is the replica's run job verifiably alive (queued, setting up,
-        or running)? False on job exit and on ANY error — "unknown" must
-        not extend boot patience indefinitely."""
+    def _replica_app_alive(self, replica_id: int) -> Optional[bool]:
+        """Probe classing input: True = the run job is verifiably alive
+        (queued/setting up/running); False = it verifiably EXITED; None =
+        couldn't determine (transient query error — must neither extend
+        boot patience nor trigger immediate replacement)."""
         record = global_state.get_cluster(self._cluster_name(replica_id))
         if record is None:
-            return False
+            # Cluster gone mid-pass (concurrent teardown/preemption):
+            # unknown, NOT "app exited" — _cluster_gone owns that classing.
+            return None
         try:
             handle = slice_backend.SliceResourceHandle.from_dict(
                 record['handle'])
             jobs = self.backend.queue(handle)
         except Exception:  # pylint: disable=broad-except
-            return False
+            return None
         if not jobs:
-            return False
-        last = max(jobs, key=lambda j: j['job_id'])
-        return not slice_backend.JobStatus(last['status']).is_terminal()
+            return None    # job not registered yet (setup still running)
+        last = slice_backend.JobStatus(
+            max(jobs, key=lambda j: j['job_id'])['status'])
+        if not last.is_terminal():
+            return True
+        # SUCCEEDED is NOT "dead": a run command may daemonize its server
+        # and exit 0 — that replica deserves the normal probe-miss budget.
+        # Only a crashed/cancelled run can never become ready.
+        return None if last is slice_backend.JobStatus.SUCCEEDED else False
 
     def reconcile(self, target: int) -> None:
         """One control-loop pass: probe replicas, replace the dead, scale
@@ -373,10 +382,12 @@ class ReplicaManager:
                                 self._replica_locations[rid])
                 elif not in_grace:
                     boot_age = now - (rep['launched_at'] or 0)
-                    if (status is ReplicaStatus.STARTING and
+                    app_alive = (self._replica_app_alive(rid)
+                                 if status is ReplicaStatus.STARTING
+                                 else None)
+                    if (app_alive is True and
                             boot_age < probe.initial_delay_seconds +
-                            _boot_patience_seconds(probe) and
-                            self._replica_app_alive(rid)):
+                            _boot_patience_seconds(probe)):
                         # Probe classing: never-READY replica whose run job
                         # is alive — slow boot, not a dead app. Don't count
                         # the miss; the patience bound above keeps a hung
@@ -385,6 +396,17 @@ class ReplicaManager:
                                     f'{boot_age:.0f}s but its job is alive '
                                     f'— treating as slow boot.')
                         alive.append(rep)
+                        continue
+                    if app_alive is False:
+                        # The run job EXITED without the replica ever
+                        # becoming ready — no future probe can succeed.
+                        # Replace now instead of waiting out the full
+                        # probe-miss budget (keeps broken-app → FAILED
+                        # fast even though classing queries add latency).
+                        logger.info(f'Replica {rid} run job exited before '
+                                    f'readiness — replacing.')
+                        self.terminate_replica(rid, ReplicaStatus.FAILED)
+                        self._probe_failure_streak += 1
                         continue
                     fails = serve_state.bump_replica_failures(
                         self.service_name, rid)
