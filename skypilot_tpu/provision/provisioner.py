@@ -258,8 +258,15 @@ def post_provision_runtime_setup(cluster_name: str,
         _start_exec_agents(cluster_name, cluster_info, runners, py)
 
     def _setup_host(runner: command_runner_lib.CommandRunner) -> None:
+        import shlex
+        # cluster_name file: the skylet orphan reaper only reaps rank
+        # processes whose SKYTPU_CLUSTER_NAME matches this host's cluster
+        # (job ids are per-cluster; a shared/dev host may run several).
         rc = runner.run('mkdir -p "${SKYTPU_RUNTIME_DIR:-$HOME/.skytpu_runtime}" '
-                        '&& mkdir -p skytpu_workdir',
+                        '&& mkdir -p skytpu_workdir '
+                        f'&& printf %s {shlex.quote(cluster_name)} > '
+                        '"${SKYTPU_RUNTIME_DIR:-$HOME/.skytpu_runtime}'
+                        '/cluster_name"',
                         log_path='/dev/null')
         if rc != 0:
             raise exceptions.ClusterSetupError(
@@ -309,14 +316,24 @@ def post_provision_runtime_setup(cluster_name: str,
 
         subprocess_utils.run_in_parallel(_ship_logs, runners)
 
-    # Start skylet on the head host (idempotent: kill stale one first).
-    head = runners[0]
-    skylet_cmd = (
-        f'pkill -f "skypilot_tpu.skylet.skylet" 2>/dev/null; '
-        f'{py} -m skypilot_tpu.skylet.skylet')
-    head.run(skylet_cmd, detach=True,
-             log_path=os.path.join('/tmp', f'skytpu_skylet_{cluster_name}.log'))
-    logger.debug(f'skylet started on {head.node_id}.')
+    # Start skylet on EVERY host (idempotent: kill the stale one first).
+    # Workers need it too: the orphan reaper sweeps the local /proc, and
+    # a rank that outlives its driver lives on the WORKER (autostop and
+    # other head-only events no-op on workers — their config is absent).
+    # The --cluster/--host tags exist so the pkill is scoped: on the
+    # local fake cloud every "host" shares one machine, and an unscoped
+    # pattern would kill other clusters' (and sibling hosts') skylets.
+    def _start_skylet(runner: command_runner_lib.CommandRunner) -> None:
+        tag = f'--cluster {cluster_name} --host {runner.node_id}'
+        runner.run(
+            f'pkill -f "skypilot_tpu.skylet.skylet {tag}" 2>/dev/null; '
+            f'{py} -m skypilot_tpu.skylet.skylet {tag}',
+            detach=True,
+            log_path=os.path.join(
+                '/tmp', f'skytpu_skylet_{cluster_name}.log'))
+
+    subprocess_utils.run_in_parallel(_start_skylet, runners)
+    logger.debug(f'skylet started on {len(runners)} host(s).')
 
 
 @timeline.event

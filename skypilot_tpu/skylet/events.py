@@ -60,6 +60,84 @@ class AutostopEvent(SkyletEvent):
             provision.stop_instances(cloud, region, cluster, pc)
 
 
+class OrphanReaperEvent(SkyletEvent):
+    """Kill rank processes whose job is already terminal.
+
+    Reference analog: sky/skylet/subprocess_daemon.py (a per-job watcher
+    process). Here one periodic sweep per host covers every job: ranks
+    are found by their exported SKYTPU_JOB_ID in /proc/<pid>/environ
+    (the env survives bash's exec optimization; the cmdline marker the
+    driver's pkill cleanup uses does not), and their process group is
+    reaped once job_lib says the job is terminal — SIGTERM first, then
+    SIGKILL on the next sweep if the group trapped/ignored TERM. Covers
+    ranks that outlive their driver (driver SIGKILLed mid-teardown, ssh
+    session dropped without -tt, ...). Runs on every host (provisioner
+    starts a skylet per host): worker-host orphans are a WORKER-local
+    problem — the head has no handle on them."""
+    EVENT_INTERVAL_SECONDS = 30
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._termed: Dict[int, float] = {}   # pid -> first SIGTERM time
+
+    def _run(self) -> None:
+        import signal
+        # Only reap ranks of THIS host's cluster: job ids are per-cluster
+        # and a shared/dev host may run several fake hosts at once. No
+        # cluster_name file (pre-upgrade host) → don't reap at all.
+        try:
+            with open(os.path.join(job_lib.runtime_dir(), 'cluster_name'),
+                      'r', encoding='utf-8') as f:
+                my_cluster = f.read().strip().encode()
+        except OSError:
+            return
+        me = os.getpid()
+        my_pg = os.getpgid(me)
+        for entry in os.listdir('/proc'):
+            if not entry.isdigit() or int(entry) == me:
+                continue
+            pid = int(entry)
+            # The exported env SURVIVES bash's exec optimization (a
+            # single trailing command replaces the shell, wiping the
+            # marker from cmdline) — environ is the reliable signal.
+            try:
+                with open(f'/proc/{pid}/environ', 'rb') as f:
+                    environ = f.read().split(b'\0')
+            except OSError:
+                continue
+            job_id = None
+            cluster = None
+            for kv in environ:
+                if kv.startswith(b'SKYTPU_JOB_ID='):
+                    try:
+                        job_id = int(kv.split(b'=', 1)[1])
+                    except ValueError:
+                        pass
+                elif kv.startswith(b'SKYTPU_CLUSTER_NAME='):
+                    cluster = kv.split(b'=', 1)[1]
+            if job_id is None or cluster != my_cluster:
+                continue
+            status = job_lib.get_status(job_id)
+            if status is None or not status.is_terminal():
+                continue
+            try:
+                pg = os.getpgid(pid)
+                if pg == my_pg:      # never shoot our own process group
+                    continue
+                # TERM first (checkpoint-on-preempt handlers get their
+                # chance); a group still alive next sweep trapped or
+                # ignored it — escalate to KILL (reference analog:
+                # subprocess_daemon's TERM→KILL ladder).
+                sig = (signal.SIGKILL if pid in self._termed
+                       else signal.SIGTERM)
+                logger.info(f'Reaping orphan rank pid {pid} of terminal '
+                            f'job {job_id} ({sig.name}).')
+                os.killpg(pg, sig)
+                self._termed[pid] = self._termed.get(pid, time.time())
+            except (ProcessLookupError, PermissionError, OSError):
+                self._termed.pop(pid, None)
+
+
 class JobHeartbeatEvent(SkyletEvent):
     """Touch a heartbeat file so the control plane can detect dead agents
     (backs the failure-detection path of managed jobs)."""

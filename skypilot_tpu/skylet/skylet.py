@@ -23,11 +23,20 @@ _LOOP_SECONDS = 5.0
 
 
 def main() -> None:
+    import argparse
+    parser = argparse.ArgumentParser(prog='skytpu-skylet')
+    # Identification tags only (they scope the provisioner's restart
+    # pkill on shared machines); the daemon reads its real config from
+    # the runtime dir.
+    parser.add_argument('--cluster', default='')
+    parser.add_argument('--host', default='')
+    parser.parse_args()
     pid_path = os.path.join(job_lib.runtime_dir(), 'skylet.pid')
     os.makedirs(job_lib.runtime_dir(), exist_ok=True)
     with open(pid_path, 'w', encoding='utf-8') as f:
         f.write(str(os.getpid()))
-    evs = [events.AutostopEvent(), events.JobHeartbeatEvent()]
+    evs = [events.AutostopEvent(), events.JobHeartbeatEvent(),
+           events.OrphanReaperEvent()]
     logger.info(f'skylet started (pid {os.getpid()}).')
     while True:
         for ev in evs:

@@ -233,3 +233,66 @@ class TestVolumes:
                                                  read_only=True)
         assert 'google-persistent-disk-2' in ro
         assert 'mount -o ro' in ro and 'mkfs' not in ro
+
+
+class TestOrphanReaper:
+
+    def test_reaps_only_terminal_job_ranks(self, tmp_path, monkeypatch):
+        """skylet's OrphanReaperEvent: a rank shell whose job is terminal
+        is killed; a rank of a RUNNING job survives (reference analog:
+        sky/skylet/subprocess_daemon.py)."""
+        import signal
+        import subprocess
+        import time as time_lib
+        monkeypatch.setenv('SKYTPU_RUNTIME_DIR', str(tmp_path))
+        import importlib
+        from skypilot_tpu.skylet import job_lib
+        importlib.reload(job_lib)
+        from skypilot_tpu.skylet import events
+        importlib.reload(events)
+        procs = {}
+        try:
+            (tmp_path / 'cluster_name').write_text('reap-cluster')
+            dead_id = job_lib.add_job('dead', 'tester', 'sleep', 1)
+            live_id = job_lib.add_job('live', 'tester', 'sleep', 1)
+            other_id = dead_id     # same id, DIFFERENT cluster
+            job_lib.set_status(dead_id, job_lib.JobStatus.RUNNING)
+            job_lib.set_status(dead_id, job_lib.JobStatus.FAILED)
+            job_lib.set_status(live_id, job_lib.JobStatus.RUNNING)
+            procs = {}
+            for key, jid, cluster in (
+                    ('dead', dead_id, 'reap-cluster'),
+                    ('live', live_id, 'reap-cluster'),
+                    ('other', other_id, 'another-cluster')):
+                procs[key] = subprocess.Popen(
+                    ['bash', '-c',
+                     f'export SKYTPU_JOB_ID={jid} '
+                     f'SKYTPU_CLUSTER_NAME={cluster}; sleep 60'],
+                    start_new_session=True)
+            time_lib.sleep(0.3)
+            ev = events.OrphanReaperEvent()
+            ev._last_run = 0.0
+            ev.maybe_run()
+            deadline = time_lib.time() + 10
+            while time_lib.time() < deadline:
+                if procs['dead'].poll() is not None:
+                    break
+                time_lib.sleep(0.2)
+            assert procs['dead'].poll() is not None, \
+                'terminal-job rank was not reaped'
+            assert procs['live'].poll() is None, \
+                'RUNNING-job rank was wrongly reaped'
+            # Same job id, different cluster: never touched (job ids are
+            # per-cluster; a shared host may run several fake hosts).
+            assert procs['other'].poll() is None, \
+                'foreign-cluster rank was wrongly reaped'
+        finally:
+            for p in procs.values():
+                try:
+                    import os as os_lib
+                    os_lib.killpg(os_lib.getpgid(p.pid), signal.SIGKILL)
+                except (ProcessLookupError, OSError):
+                    pass
+            monkeypatch.undo()
+            importlib.reload(job_lib)
+            importlib.reload(events)
