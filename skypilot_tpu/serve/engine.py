@@ -7,9 +7,10 @@ lines: aiohttp front, a dynamic batcher, and models/decode.py underneath.
 
 TPU-first design:
   - **Continuous batching**: a fixed pool of MAX_BATCH cache slots is
-    stepped one token at a time; a request arriving mid-generation is
-    prefilled into a free slot and joins the NEXT step of the in-flight
-    batch — it never waits for earlier requests to drain. Static shapes
+    stepped token by token (fused into MAX_STEP_CHUNK-step device calls
+    while nothing is queued); a request arriving mid-generation is
+    prefilled into a free slot and joins after at most one in-flight
+    fused call — it never waits for earlier requests to drain. Static shapes
     rule on TPU, so the step always runs at batch MAX_BATCH (inactive
     slots are masked) and prompts prefill per power-of-two length bucket
     — a bounded set of compiled programs, cached by jax forever after.
@@ -39,6 +40,8 @@ from skypilot_tpu import sky_logging
 logger = sky_logging.init_logger(__name__)
 
 MAX_BATCH = int(os.environ.get('SKYTPU_ENGINE_MAX_BATCH', '8'))
+# Max decode steps fused into one device call when no request is waiting.
+MAX_STEP_CHUNK = int(os.environ.get('SKYTPU_ENGINE_STEP_CHUNK', '8'))
 
 
 def _bucket(n: int, floor: int = 16) -> int:
@@ -131,14 +134,36 @@ class InferenceEngine:
 
         self._reset_device_state()
 
-        @functools.partial(jax.jit, donate_argnums=(2,))
-        def step(params, last, cache, temp, topk, topp, rng, active):
-            logits, cache = dec.decode_step(params, last, cache, cfg,
-                                            active=active)
-            rng, sub = jax.random.split(rng)
-            nxt = decode_lib.select_token_per_row(logits, temp, topk, topp,
-                                                  sub)
-            return jnp.where(active, nxt, last), cache, rng
+        def step_k(k):
+            """k decode steps in ONE device call (host-loop dispatch cost
+            amortized when no request is waiting to join). Compiled per
+            distinct k — bounded by MAX_STEP_CHUNK."""
+
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def run(params, cache, last, temp, topk, topp, rng, active):
+                def body(carry, _):
+                    last_t, cache_t, rng_t = carry
+                    logits, cache_t = dec.decode_step(params, last_t,
+                                                      cache_t, cfg,
+                                                      active=active)
+                    rng_t, sub = jax.random.split(rng_t)
+                    nxt = decode_lib.select_token_per_row(
+                        logits, temp, topk, topp, sub)
+                    nxt = jnp.where(active, nxt, last_t)
+                    return (nxt, cache_t, rng_t), nxt
+                (last_f, cache_f, rng_f), toks = jax.lax.scan(
+                    body, (last, cache, rng), None, length=k)
+                del last_f
+                return toks, cache_f, rng_f
+            return run
+
+        self._step_k_jits = {}
+
+        def step(params, last, cache, temp, topk, topp, rng, active, k=1):
+            if k not in self._step_k_jits:
+                self._step_k_jits[k] = step_k(k)
+            return self._step_k_jits[k](params, cache, last, temp, topk,
+                                        topp, rng, active)
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def admit(params, cache, tokens, length, slot, temp, topk, topp,
@@ -165,14 +190,18 @@ class InferenceEngine:
         self._state_ready = True
 
     def warmup(self) -> None:
-        """Compile the admit (16-bucket) + step programs through the real
-        code path, then free the warmup slot; /health flips only after."""
+        """Compile the admit (16-bucket) + BOTH step programs (k=1 and
+        k=MAX_STEP_CHUNK) through the real code path, then free the
+        warmup slot; /health flips only after — no client request may
+        ever hit a fresh XLA compile."""
         self._ensure_state()
-        self._admit((list(range(1, 9)), 1, 0.0, None, None, None))
-        self._step_once()
+        self._admit((list(range(1, 9)), MAX_STEP_CHUNK + 2, 0.0, None,
+                     None, None))
+        self._step_once()      # k = MAX_STEP_CHUNK (remaining is large)
+        self._step_once()      # k = 1 (remaining == 1)
         self.slots = [None] * MAX_BATCH
         self.warm = True
-        logger.info('Engine warm (admit + step compiled).')
+        logger.info('Engine warm (admit + step programs compiled).')
 
     # -- continuous batching ----------------------------------------------
     async def submit(self, tokens: List[int], max_new: int,
@@ -210,20 +239,38 @@ class InferenceEngine:
         self.slots[slot] = {'fut': fut, 'want': max_new, 'out': [first]}
 
     def _step_once(self) -> None:
-        """One decode step over the whole slot pool (device work)."""
+        """Decode step(s) over the whole slot pool (device work).
+
+        Steps MAX_STEP_CHUNK tokens per device call when nothing is
+        waiting to join (the per-call host dispatch is the continuous
+        batcher's overhead); drops back to single steps under admission
+        pressure. A request arriving mid-call therefore waits at most one
+        in-flight fused call (up to MAX_STEP_CHUNK steps) to join."""
         import jax
         jnp = self._jnp
+        remaining = [s['want'] - len(s['out']) for s in self.slots
+                     if s is not None]
+        # k ∈ {1, MAX_STEP_CHUNK} ONLY: exactly two compiled step
+        # programs, both built in warmup — a client-chosen max_new must
+        # not be able to trigger a fresh XLA compile via tail-chunk sizes.
+        k = 1
+        if (remaining and min(remaining) >= MAX_STEP_CHUNK and
+                (self._queue is None or self._queue.empty())):
+            k = MAX_STEP_CHUNK
         active = jnp.asarray([s is not None for s in self.slots])
-        nxt, self.cache, self.rng = self._step_jit(
+        toks, self.cache, self.rng = self._step_jit(
             self.params, jnp.asarray(self.last), self.cache,
             jnp.asarray(self.temp), jnp.asarray(self.topk),
-            jnp.asarray(self.topp), self.rng, active)
-        nxt = jax.device_get(nxt)
-        self.step_count += 1
+            jnp.asarray(self.topp), self.rng, active, k=k)
+        toks = jax.device_get(toks)              # [k, B]
+        self.step_count += k
         for i, s in enumerate(self.slots):
-            if s is not None and len(s['out']) < s['want']:
-                s['out'].append(int(nxt[i]))
-                self.last[i] = int(nxt[i])
+            if s is None:
+                continue
+            for t in range(k):
+                if len(s['out']) < s['want']:
+                    s['out'].append(int(toks[t][i]))
+                    self.last[i] = int(toks[t][i])
 
     def _finish_done(self) -> None:
         """Resolve futures of slots that produced all they asked for (runs
@@ -237,8 +284,9 @@ class InferenceEngine:
 
     async def batch_loop(self) -> None:
         """Continuous scheduler: admit whenever a slot is free, step while
-        anything is active. A late request joins the next step of the
-        in-flight batch — it never waits for earlier requests to drain."""
+        anything is active. A late request joins after at most one
+        in-flight fused call — it never waits for earlier requests to
+        drain."""
         self._ensure_state()
         while True:
             busy = any(s is not None for s in self.slots)
