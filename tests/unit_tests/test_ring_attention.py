@@ -187,6 +187,37 @@ def test_train_step_zigzag_matches_dense():
     assert err < 1e-2, err
 
 
+def test_train_step_zigzag_with_pipeline_matches_dense():
+    """The flagship long-context recipe (examples/train-longcontext-ring):
+    zigzag ring INSIDE the pipeline. Full train step equals the dense
+    one — permutation, flattened stage+sequence region and custom-vjp
+    ring backward all composed."""
+    from skypilot_tpu.train import train_lib
+    cfg = dataclasses.replace(llama.PRESETS['llama-debug'], remat='none')
+    cfg_zzpp = dataclasses.replace(cfg, attention_impl='ring',
+                                   ring_layout='zigzag',
+                                   pipeline_stages=2, num_microbatches=2)
+    mesh = build_mesh(MeshSpec(fsdp=1, sequence=2, stage=2, data=2),
+                      devices=jax.devices('cpu'))
+    tx = train_lib.default_optimizer()
+    batch = train_lib.synthetic_batch(jax.random.PRNGKey(1), 4, 64,
+                                      cfg.vocab_size)
+    losses, states = [], []
+    for c in (cfg, cfg_zzpp):
+        state = train_lib.init_train_state(jax.random.PRNGKey(0), c, mesh,
+                                           tx)
+        step = train_lib.make_train_step(c, mesh, tx)
+        new_state, metrics = step(state, batch)
+        losses.append(float(metrics['loss']))
+        states.append(new_state)
+    assert abs(losses[0] - losses[1]) < 2e-3, losses
+    # The BACKWARD composed too: updated params match the dense step.
+    err = max(jax.tree.leaves(jax.tree.map(
+        lambda a, b: float(jnp.max(jnp.abs(a - b))),
+        states[0].params, states[1].params)))
+    assert err < 1e-2, err
+
+
 def test_ring_composes_with_pipeline_grads():
     """Ring attention under GPipe: backward must work (the custom_vjp ring
     avoids transposing a nested manual region — VERDICT r2 item 3)."""
