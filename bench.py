@@ -269,8 +269,12 @@ def run_decode_bench():
     batch = int(os.environ.get('SKYTPU_BENCH_DECODE_BATCH', '8'))
     prompt_len = int(os.environ.get('SKYTPU_BENCH_PROMPT', '512'))
     new_tokens = int(os.environ.get('SKYTPU_BENCH_NEW_TOKENS', '128'))
+    # SKYTPU_BENCH_QUANT=int8 → weight-only int8 (decode is HBM-bound:
+    # ~2x fewer weight bytes per token).
+    quant = os.environ.get('SKYTPU_BENCH_QUANT') or None
     params = jax.jit(lambda r: decode.cast_params_for_decode(
-        llama.init_params(r, cfg), cfg))(jax.random.PRNGKey(0))
+        llama.init_params(r, cfg), cfg, quantize=quant))(
+            jax.random.PRNGKey(0))
     prompt = jnp.zeros((batch, prompt_len), jnp.int32)
 
     def run():

@@ -42,13 +42,89 @@ class KVCache:
     #                       overwritten before they are ever attended.
 
 
-def cast_params_for_decode(params, cfg: llama.LlamaConfig):
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class QuantizedWeight:
+    """Weight-only int8 with a per-output-channel scale.
+
+    Decode reads every weight every token (HBM-bound): int8 halves the
+    bytes vs bf16. The dequant (`int8 * scale`) fuses into the consuming
+    matmul's operand load under XLA, so no bf16 copy is ever
+    materialized in HBM."""
+    q: jnp.ndarray       # int8, original shape
+    scale: jnp.ndarray   # compute dtype, broadcastable over q
+
+
+def _quantize_int8(w: jnp.ndarray) -> QuantizedWeight:
+    """Symmetric per-output-channel (last-dim) int8 quantization.
+
+    Quantizes from the weights AS GIVEN (callers pass the fp32 masters,
+    not a bf16-rounded copy) and keeps the scale in fp32 — one rounding
+    step (int8) instead of three (bf16 weight, int8, bf16 scale)."""
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=-2,
+                     keepdims=True)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127,
+                 127).astype(jnp.int8)
+    return QuantizedWeight(q=q, scale=scale)
+
+
+def _d(w, dtype):
+    """Dense view of a (possibly quantized) weight in the compute dtype."""
+    if isinstance(w, QuantizedWeight):
+        # Dequant in fp32 (the scale's dtype), then one cast.
+        return (w.q.astype(jnp.float32) * w.scale).astype(dtype)
+    return w.astype(dtype)
+
+
+# Layer matrices worth quantizing: ≥2-D projections (the per-layer
+# stacks are 3-D: [L, in, out]). Norm scales/biases stay exact. MoE/MLA
+# decode paths are not quant-aware yet — cast_params_for_decode rejects
+# them loudly rather than serving silently-wrong weights.
+_QUANT_KEYS = frozenset(
+    ['wq', 'wk', 'wv', 'wo', 'w_gate', 'w_up', 'w_down'])
+
+
+def cast_params_for_decode(params, cfg: llama.LlamaConfig,
+                           quantize: Optional[str] = None):
     """Cast weights to the compute dtype once, for serving.
 
     Decode is HBM-bandwidth bound — every token reads every weight — so
-    serving from fp32 master params wastes 2x bandwidth. Training keeps the
-    fp32 masters; a serve engine calls this once at load."""
-    return jax.tree.map(lambda p: p.astype(cfg.dtype), params)
+    serving from fp32 master params wastes 2x bandwidth (and bf16 wastes
+    2x vs `quantize='int8'`, which keeps a per-channel scale and
+    dequantizes inside the matmul). Training keeps the fp32 masters; a
+    serve engine calls this once at load."""
+    if quantize not in (None, 'int8'):
+        raise ValueError(f"quantize must be None or 'int8', got "
+                         f'{quantize!r}')
+    if quantize != 'int8':
+        return jax.tree.map(lambda p: p.astype(cfg.dtype), params)
+    from skypilot_tpu.models import mla as mla_lib
+    from skypilot_tpu.models import moe as moe_lib
+    if isinstance(cfg, (moe_lib.MoEConfig, mla_lib.MLAConfig)):
+        raise NotImplementedError(
+            'int8 decode is implemented for the dense Llama family only '
+            '(MoE expert dispatch and MLA absorbed matmuls are not '
+            'quant-aware yet).')
+    # NOTE: quantized params do not mirror llama.param_specs' tree any
+    # more (QuantizedWeight subtrees) — int8 serving is single-device
+    # (the engine's deployment); sharded decode uses the unquantized
+    # path.
+    out = {}
+    for key, sub in params.items():
+        if key != 'layers':
+            out[key] = jax.tree.map(lambda p: p.astype(cfg.dtype), sub)
+            continue
+        layers = {}
+        for k, w in sub.items():
+            if k in _QUANT_KEYS and w.ndim >= 2:
+                # Quantize from the RAW (fp32 master) weights, not a
+                # bf16-rounded copy.
+                layers[k] = _quantize_int8(w)
+            else:
+                layers[k] = w.astype(cfg.dtype)
+        out[key] = layers
+    return out
 
 
 def init_cache(cfg: llama.LlamaConfig, batch: int, max_len: int) -> KVCache:
@@ -64,9 +140,9 @@ def _qkv(x: jnp.ndarray, lp, cfg: llama.LlamaConfig, sin, cos):
     hd = cfg.hd
     h = norms.rms_norm(x, lp['attn_norm'], cfg.rms_eps,
                        scale_plus_one=cfg.norm_plus_one)
-    q = jnp.einsum('bsd,dh->bsh', h, lp['wq'].astype(cfg.dtype))
-    k = jnp.einsum('bsd,dh->bsh', h, lp['wk'].astype(cfg.dtype))
-    v = jnp.einsum('bsd,dh->bsh', h, lp['wv'].astype(cfg.dtype))
+    q = jnp.einsum('bsd,dh->bsh', h, _d(lp['wq'], cfg.dtype))
+    k = jnp.einsum('bsd,dh->bsh', h, _d(lp['wk'], cfg.dtype))
+    v = jnp.einsum('bsd,dh->bsh', h, _d(lp['wv'], cfg.dtype))
     if cfg.qkv_bias:
         q = q + lp['bq'].astype(cfg.dtype)
         k = k + lp['bk'].astype(cfg.dtype)
@@ -81,7 +157,7 @@ def _qkv(x: jnp.ndarray, lp, cfg: llama.LlamaConfig, sin, cos):
 
 def _wo_project(out, lp, cfg: llama.LlamaConfig) -> jnp.ndarray:
     """Attention output projection (+ Gemma-2 post-attention norm)."""
-    y = jnp.einsum('bsh,hd->bsd', out, lp['wo'].astype(cfg.dtype))
+    y = jnp.einsum('bsh,hd->bsd', out, _d(lp['wo'], cfg.dtype))
     if cfg.post_norms:
         y = norms.rms_norm(y, lp['post_attn_norm'], cfg.rms_eps,
                            scale_plus_one=cfg.norm_plus_one)
@@ -102,10 +178,10 @@ def _ffn(x: jnp.ndarray, lp, cfg: llama.LlamaConfig) -> jnp.ndarray:
         return y
     h = norms.rms_norm(x, lp['mlp_norm'], cfg.rms_eps,
                        scale_plus_one=cfg.norm_plus_one)
-    gate = jnp.einsum('bsd,df->bsf', h, lp['w_gate'].astype(cfg.dtype))
-    up = jnp.einsum('bsd,df->bsf', h, lp['w_up'].astype(cfg.dtype))
+    gate = jnp.einsum('bsd,df->bsf', h, _d(lp['w_gate'], cfg.dtype))
+    up = jnp.einsum('bsd,df->bsf', h, _d(lp['w_up'], cfg.dtype))
     down = jnp.einsum('bsf,fd->bsd', cfg.act(gate) * up,
-                      lp['w_down'].astype(cfg.dtype))
+                      _d(lp['w_down'], cfg.dtype))
     if cfg.post_norms:
         down = norms.rms_norm(down, lp['post_mlp_norm'], cfg.rms_eps,
                               scale_plus_one=cfg.norm_plus_one)

@@ -56,7 +56,8 @@ class InferenceEngine:
     """Owns params + the batched generate loop."""
 
     def __init__(self, model: str, ckpt_dir: Optional[str] = None,
-                 max_len: Optional[int] = None):
+                 max_len: Optional[int] = None,
+                 quantize: Optional[str] = None):
         import jax
         import jax.numpy as jnp
         from skypilot_tpu.models import decode as decode_lib
@@ -88,7 +89,12 @@ class InferenceEngine:
                 jax.random.PRNGKey(0))
             logger.info('No --ckpt-dir: serving randomly-initialized '
                         'params (benchmark/demo mode).')
-        self.params = decode_lib.cast_params_for_decode(params, self.cfg)
+        self.params = decode_lib.cast_params_for_decode(
+            params, self.cfg, quantize=quantize)
+        if quantize:
+            logger.info(f'Serving with weight-only {quantize} '
+                        f'quantization (decode is HBM-bound: ~2x fewer '
+                        f'weight bytes per token).')
         # Created by start() on the SERVING event loop: an asyncio.Queue
         # binds to the loop that first awaits it, and the engine object
         # may outlive a loop (tests; server restarts).
@@ -404,13 +410,16 @@ def main() -> None:
     parser.add_argument('--model', default='llama-1b')
     parser.add_argument('--ckpt-dir', default=None)
     parser.add_argument('--max-len', type=int, default=None)
+    parser.add_argument('--quantize', choices=['int8'], default=None,
+                        help='Weight-only quantization for serving '
+                             '(dense Llama-family models).')
     parser.add_argument('--port', type=int,
                         default=int(os.environ.get('SKYTPU_SERVE_PORT',
                                                    '8000')))
     parser.add_argument('--host', default='0.0.0.0')
     args = parser.parse_args()
     engine = InferenceEngine(args.model, ckpt_dir=args.ckpt_dir,
-                             max_len=args.max_len)
+                             max_len=args.max_len, quantize=args.quantize)
     engine.warmup()   # readiness flips only once serving is fast
     web.run_app(build_app(engine), host=args.host, port=args.port,
                 print=None)

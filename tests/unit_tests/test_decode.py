@@ -241,3 +241,49 @@ class TestMoEDecode:
             seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
         np.testing.assert_array_equal(np.asarray(got),
                                       np.asarray(seq[:, 8:]))
+
+
+class TestInt8Decode:
+
+    def test_int8_quantization_roundtrip_and_generate(self):
+        """Weight-only int8 serving: per-channel dequant error is small,
+        prefill logits stay close to the fp path, and greedy generation
+        runs end to end on quantized params."""
+        import dataclasses as dc
+        cfg = dc.replace(llama.PRESETS['llama-debug'], dtype=jnp.float32)
+        raw = llama.init_params(jax.random.PRNGKey(0), cfg)
+        fp = decode.cast_params_for_decode(raw, cfg)
+        q8 = decode.cast_params_for_decode(raw, cfg, quantize='int8')
+        # Quantized layer matrices really are int8 + scale.
+        wq = q8['layers']['wq']
+        assert isinstance(wq, decode.QuantizedWeight)
+        assert wq.q.dtype == jnp.int8
+        # Per-channel roundtrip error ~ absmax/127 per channel.
+        deq = decode._d(wq, jnp.float32)
+        err = float(jnp.max(jnp.abs(deq - fp['layers']['wq'])))
+        step = float(jnp.max(jnp.abs(fp['layers']['wq']))) / 127.0
+        assert err <= step + 1e-6
+        # Norms/embeddings are untouched.
+        assert not isinstance(q8['layers']['attn_norm'],
+                              decode.QuantizedWeight)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0,
+                                    cfg.vocab_size, jnp.int32)
+        logits_fp, _ = decode.prefill(fp, tokens, cfg, max_len=32)
+        logits_q8, _ = decode.prefill(q8, tokens, cfg, max_len=32)
+        rel = float(jnp.max(jnp.abs(logits_q8 - logits_fp))) / (
+            float(jnp.max(jnp.abs(logits_fp))) + 1e-9)
+        assert rel < 0.1, rel
+        out = decode.generate(q8, tokens, cfg, 8, max_len=32)
+        assert out.shape == (2, 8)
+        assert bool(jnp.all((out >= 0) & (out < cfg.vocab_size)))
+
+    def test_int8_rejected_for_moe_and_mla(self):
+        from skypilot_tpu.models import mla, moe
+        import pytest as pytest_lib
+        for preset in (moe.PRESETS['moe-debug'], mla.PRESETS['mla-debug']):
+            from skypilot_tpu.models import module_for
+            params = module_for(preset).init_params(jax.random.PRNGKey(0),
+                                                    preset)
+            with pytest_lib.raises(NotImplementedError):
+                decode.cast_params_for_decode(params, preset,
+                                              quantize='int8')
