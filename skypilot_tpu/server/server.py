@@ -63,14 +63,42 @@ async def auth_middleware(request: web.Request, handler):
     open_paths = ('/api/v1/health', '/dashboard')
     got = request.headers.get('Authorization', '')
 
-    # Multi-user mode (users file present): token → user, with role
-    # enforcement on request submission (sky/users RBAC analog).
+    # Two identity-resolving modes share one enforcement tail below:
+    #  - SSO header trust (reference analog: sky/server/auth/ with
+    #    oauth2-proxy): SKYTPU_AUTH_USER_HEADER names a header an
+    #    authenticating reverse proxy in front sets (e.g.
+    #    X-Auth-Request-Email). ONLY enable when the server is reachable
+    #    exclusively through that proxy — the header is trusted as-is.
+    #    The identity maps to the users-file entry of that name; unknown
+    #    identities get SKYTPU_AUTH_DEFAULT_ROLE (default: no access).
+    #  - Multi-user bearer tokens (users file present): token → user.
+    trust_header = os.environ.get('SKYTPU_AUTH_USER_HEADER', '')
     users = request.app['users']
-    if users:
+    if trust_header or users:
         if request.path in open_paths:
             return await handler(request)
         from skypilot_tpu.users import rbac
-        user = rbac.resolve_user(got, users)
+        user = None
+        if trust_header:
+            identity = request.headers.get(trust_header, '')
+            if identity:
+                user = next((u for u in (users or {}).values()
+                             if u.name == identity), None)
+                if user is None:
+                    raw = os.environ.get('SKYTPU_AUTH_DEFAULT_ROLE', '')
+                    if raw:
+                        try:
+                            user = rbac.User(name=identity,
+                                             role=rbac.Role(raw.lower()))
+                        except ValueError:
+                            # A typo'd default role must read as "no
+                            # default", not 500 every request.
+                            logger.warning(
+                                f'SKYTPU_AUTH_DEFAULT_ROLE={raw!r} is not '
+                                f'a valid role; rejecting unknown '
+                                f'identities.')
+        else:
+            user = rbac.resolve_user(got, users)
         if user is None:
             return _json({'error': 'unauthorized'}, status=401)
         if request.method == 'POST':

@@ -132,6 +132,58 @@ class TestApiLogin:
 
 
 @pytest.mark.usefixtures('isolated_server')
+class TestSsoHeaderTrust:
+    """SSO via an authenticating reverse proxy (oauth2-proxy analog):
+    SKYTPU_AUTH_USER_HEADER names the trusted identity header; identities
+    map to users-file entries, unknowns get the default role (or 401)."""
+
+    def _users(self):
+        from skypilot_tpu.users import rbac
+        return {'tok-a': rbac.User(name='alice@example.com',
+                                   role=rbac.Role.ADMIN),
+                'tok-v': rbac.User(name='viewer@example.com',
+                                   role=rbac.Role.VIEWER)}
+
+    def test_header_identity_maps_to_user_and_role(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_AUTH_USER_HEADER', 'X-Auth-Request-Email')
+
+        async def fn(client):
+            client.app['users'] = self._users()
+            # No identity header → 401 (health stays open).
+            r = await client.get('/api/v1/requests')
+            assert r.status == 401
+            r = await client.get('/api/v1/health')
+            assert r.status == 200
+            # Known admin identity passes, viewer blocked on mutations.
+            hdr = {'X-Auth-Request-Email': 'alice@example.com'}
+            r = await client.get('/api/v1/requests', headers=hdr)
+            assert r.status == 200
+            hdr_v = {'X-Auth-Request-Email': 'viewer@example.com'}
+            r = await client.post('/api/v1/launch', json={'kwargs': {}},
+                                  headers=hdr_v)
+            assert r.status == 403
+            # Unknown identity: 401 without a default role...
+            hdr_u = {'X-Auth-Request-Email': 'stranger@example.com'}
+            r = await client.get('/api/v1/requests', headers=hdr_u)
+            assert r.status == 401
+        _with_client(fn)
+
+    def test_unknown_identity_gets_default_role(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_AUTH_USER_HEADER', 'X-Auth-Request-Email')
+        monkeypatch.setenv('SKYTPU_AUTH_DEFAULT_ROLE', 'viewer')
+
+        async def fn(client):
+            client.app['users'] = self._users()
+            hdr = {'X-Auth-Request-Email': 'stranger@example.com'}
+            r = await client.get('/api/v1/requests', headers=hdr)
+            assert r.status == 200             # viewer may read
+            r = await client.post('/api/v1/launch', json={'kwargs': {}},
+                                  headers=hdr)
+            assert r.status == 403             # but not mutate
+        _with_client(fn)
+
+
+@pytest.mark.usefixtures('isolated_server')
 class TestRbac:
 
     @pytest.fixture(autouse=True)
