@@ -229,10 +229,16 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _bwd(q, k, v, out, lse, do, causal, block_q, block_k, interpret,
-         dlse=None):
+         dlse=None, grad_dtype=None):
+    """grad_dtype overrides the dq/dk/dv output dtype (ring attention
+    accumulates block grads across ring steps and wants f32 partials;
+    the training custom-vjp path keeps operand dtypes)."""
     b, h, s, d = q.shape
     kh, t = k.shape[1], k.shape[2]
     g = h // kh
+    dq_dt = grad_dtype or q.dtype
+    dk_dt = grad_dtype or k.dtype
+    dv_dt = grad_dtype or v.dtype
     bq, bk = _block_size(s, block_q), _block_size(t, block_k)
     nq, nk = s // bq, t // bk
 
@@ -254,7 +260,7 @@ def _bwd(q, k, v, out, lse, do, causal, block_q, block_k, interpret,
         grid=(b, h, nq, nk),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, stat_spec, stat_spec],
         out_specs=[q_spec],
-        out_shape=[jax.ShapeDtypeStruct((b, h, s, d), q.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((b, h, s, d), dq_dt)],
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=('parallel', 'parallel', 'parallel',
@@ -277,8 +283,8 @@ def _bwd(q, k, v, out, lse, do, causal, block_q, block_k, interpret,
         in_specs=[q_spec2, kv_spec2, kv_spec2, q_spec2, stat_spec2,
                   stat_spec2],
         out_specs=[kv_out_spec2, kv_out_spec2],
-        out_shape=[jax.ShapeDtypeStruct((b, h, t, d), k.dtype),
-                   jax.ShapeDtypeStruct((b, h, t, d), v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((b, h, t, d), dk_dt),
+                   jax.ShapeDtypeStruct((b, h, t, d), dv_dt)],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(

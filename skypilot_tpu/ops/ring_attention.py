@@ -233,7 +233,8 @@ def _ring_local_bwd(opts, res, g):
     dq, dk, dv = _ring_backward(q, k, v, o, lse, g,
                                 axis_name=opts.axis_name, causal=opts.causal,
                                 softmax_scale=opts.softmax_scale,
-                                layout=opts.layout)
+                                layout=opts.layout,
+                                interpret=opts.interpret)
     return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
 
 
@@ -274,9 +275,54 @@ def ring_attention(q: jnp.ndarray,
 # shards the unchunked block would be gigabytes per step. The einsums
 # still land on the MXU; only peak HBM changes.
 _BWD_KV_CHUNK = int(os.environ.get('SKYTPU_RING_BWD_CHUNK', '1024'))
+# Flash-kernel backward dispatch: '' = auto (TPU + lane-aligned shapes),
+# '1' = force (tests use interpret mode), '0' = always einsum path.
+_BWD_FLASH = os.environ.get('SKYTPU_RING_BWD_FLASH', '')
 
 
-def _block_grads(qa, do_a, lse_a, delta_a, kb, vb, rel, scale):
+def _flash_bwd_ok(sq: int, tk: int, d: int, interpret: bool) -> bool:
+    if _BWD_FLASH == '0':
+        return False
+    shapes_ok = (d % 128 == 0 and sq % 128 == 0 and tk % 128 == 0)
+    if _BWD_FLASH == '1':
+        return shapes_ok
+    return shapes_ok and not interpret
+
+
+def _flash_block_grads(qa, do_a, lse_a, delta_a, kb, vb, masked, scale,
+                       interpret):
+    """Block gradients through the Pallas flash backward kernels
+    (ops/pallas/flash_attention._bwd) — fused VMEM-blocked dq/dkv, no
+    HBM score intermediates, same kernels the training step's flash
+    attention backward uses.
+
+    The kernel expects PRE-SCALED q in [B,H,S,D] layout and derives
+    Δ = rowsum(out·do) − dlse internally; the ring already holds the
+    global Δ, so it rides in as dlse = −Δ with out = 0 (out has no other
+    use in _bwd)."""
+    from skypilot_tpu.ops.pallas import flash_attention as fa
+    b, sq, h, d = qa.shape
+    qh = (qa * scale).swapaxes(1, 2)
+    kh = kb.swapaxes(1, 2)
+    vh = vb.swapaxes(1, 2)
+    doh = do_a.swapaxes(1, 2)
+    lse_t = jnp.broadcast_to(
+        lse_a.swapaxes(1, 2)[..., None], (b, h, sq, fa.LANES))
+    dq, dk, dv = fa._bwd(qh, kh, vh, jnp.zeros_like(doh), lse_t, doh,
+                         causal=masked, block_q=512, block_k=512,
+                         interpret=interpret,
+                         dlse=-delta_a.swapaxes(1, 2),
+                         # f32 partials: each block grad is accumulated
+                         # across ring steps — bf16 rounding per step
+                         # would compound with ring size.
+                         grad_dtype=jnp.float32)
+    # dq is w.r.t. the pre-scaled q → chain back through the *scale.
+    dq = dq.swapaxes(1, 2) * scale
+    return dq, dk.swapaxes(1, 2), dv.swapaxes(1, 2)
+
+
+def _block_grads(qa, do_a, lse_a, delta_a, kb, vb, rel, scale, *,
+                 interpret):
     """Flash-style block gradients for one q-chunk × kv-chunk pair.
 
     Uses the FINAL forward lse (global softmax normalizer) so each block's
@@ -285,11 +331,14 @@ def _block_grads(qa, do_a, lse_a, delta_a, kb, vb, rel, scale):
       dS = P ⊙ (dP - Δ)  with Δ = rowsum(dO ⊙ O);
       dQ = dS·K·scale;   dK = dSᵀ·Q·scale.
     Shapes: qa/do_a [B,Sq,H,D], kb/vb [B,Tk,KH,D], lse_a/delta_a [B,Sq,H].
-    KV dims past _BWD_KV_CHUNK are scanned in chunks (memory-bounded).
+    On TPU with lane-aligned shapes the block runs through the Pallas
+    flash backward kernels; otherwise KV dims past _BWD_KV_CHUNK are
+    scanned in chunks (memory-bounded einsums).
     """
     b, sq, h, d = qa.shape
     tk, kh = kb.shape[1], kb.shape[2]
     g = h // kh
+    use_flash = _flash_bwd_ok(sq, tk, d, interpret)
 
     qg = qa.reshape(b, sq, kh, g, d).astype(jnp.float32)
     dog = do_a.reshape(b, sq, kh, g, d).astype(jnp.float32)
@@ -314,6 +363,9 @@ def _block_grads(qa, do_a, lse_a, delta_a, kb, vb, rel, scale):
         return dq, dk, dv
 
     def compute(masked):
+        if use_flash:
+            return _flash_block_grads(qa, do_a, lse_a, delta_a, kb, vb,
+                                      masked, scale, interpret)
         kf_all = kb.astype(jnp.float32)
         vf_all = vb.astype(jnp.float32)
         # Largest divisor of tk <= the target chunk, so the memory bound
@@ -353,7 +405,7 @@ def _block_grads(qa, do_a, lse_a, delta_a, kb, vb, rel, scale):
 
 
 def _ring_backward(q, k, v, o, lse, do, *, axis_name, causal, softmax_scale,
-                   layout):
+                   layout, interpret):
     """(dq, dk, dv) local shards (f32). Call inside shard_map.
 
     The kv shards rotate exactly as in forward, with their gradient
@@ -382,7 +434,8 @@ def _ring_backward(q, k, v, o, lse, do, *, axis_name, causal, softmax_scale,
 
         if not causal:
             dq_i, dk_i, dv_i = _block_grads(
-                q, do, lse, delta, k_c, v_c, jnp.int32(0), scale)
+                q, do, lse, delta, k_c, v_c, jnp.int32(0), scale,
+                interpret=interpret)
             dq = dq + dq_i
             dk_c = dk_c + dk_i
             dv_c = dv_c + dv_i
@@ -395,7 +448,8 @@ def _ring_backward(q, k, v, o, lse, do, *, axis_name, causal, softmax_scale,
                     dq_ab, dk_ab, dv_ab = _block_grads(
                         q[:, sla], do[:, sla], lse[:, sla], delta[:, sla],
                         k_c[:, slb], v_c[:, slb],
-                        _rel(qcs[a], kcs[bi]), scale)
+                        _rel(qcs[a], kcs[bi]), scale,
+                        interpret=interpret)
                     dq = dq.at[:, sla].add(dq_ab)
                     dk_c = dk_c.at[:, slb].add(dk_ab)
                     dv_c = dv_c.at[:, slb].add(dv_ab)
