@@ -78,11 +78,13 @@ def _d(w, dtype):
 
 
 # Layer matrices worth quantizing: ≥2-D projections (the per-layer
-# stacks are 3-D: [L, in, out]). Norm scales/biases stay exact. MoE/MLA
-# decode paths are not quant-aware yet — cast_params_for_decode rejects
-# them loudly rather than serving silently-wrong weights.
+# stacks are 3-D: [L, in, out]). Norm scales/biases stay exact. The MoE
+# decode path is not quant-aware — cast_params_for_decode rejects it
+# loudly rather than serving silently-wrong weights. MLA's projections
+# (incl. the absorbed w_uk/w_uv) read through _d and quantize fine.
 _QUANT_KEYS = frozenset(
-    ['wq', 'wk', 'wv', 'wo', 'w_gate', 'w_up', 'w_down'])
+    ['wq', 'wk', 'wv', 'wo', 'w_gate', 'w_up', 'w_down',
+     'w_dkv', 'w_kr', 'w_uk', 'w_uv'])
 
 
 def cast_params_for_decode(params, cfg: llama.LlamaConfig,
@@ -99,13 +101,11 @@ def cast_params_for_decode(params, cfg: llama.LlamaConfig,
                          f'{quantize!r}')
     if quantize != 'int8':
         return jax.tree.map(lambda p: p.astype(cfg.dtype), params)
-    from skypilot_tpu.models import mla as mla_lib
     from skypilot_tpu.models import moe as moe_lib
-    if isinstance(cfg, (moe_lib.MoEConfig, mla_lib.MLAConfig)):
+    if isinstance(cfg, moe_lib.MoEConfig):
         raise NotImplementedError(
-            'int8 decode is implemented for the dense Llama family only '
-            '(MoE expert dispatch and MLA absorbed matmuls are not '
-            'quant-aware yet).')
+            'int8 decode is implemented for the dense Llama and MLA '
+            'families (MoE expert dispatch is not quant-aware yet).')
     # NOTE: quantized params do not mirror llama.param_specs' tree any
     # more (QuantizedWeight subtrees) — int8 serving is single-device
     # (the engine's deployment); sharded decode uses the unquantized

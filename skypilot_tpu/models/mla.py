@@ -31,6 +31,7 @@ import jax.numpy as jnp
 
 from skypilot_tpu.models import llama as llama_lib
 from skypilot_tpu.ops import norms, rotary
+from skypilot_tpu.models.decode import _d, _select_token
 from skypilot_tpu.parallel import sharding as sharding_lib
 
 Params = Dict[str, Any]
@@ -159,13 +160,13 @@ def _latents(x, lp, cfg: MLAConfig, rope_sin, rope_cos):
     b, s, _ = x.shape
     H, dn, dr = cfg.n_heads, cfg.qk_nope_head_dim, cfg.qk_rope_head_dim
     h = norms.rms_norm(x, lp['attn_norm'], cfg.rms_eps)
-    q = jnp.einsum('bsd,dh->bsh', h, lp['wq'].astype(cfg.dtype))
+    q = jnp.einsum('bsd,dh->bsh', h, _d(lp['wq'], cfg.dtype))
     q = q.reshape(b, s, H, dn + dr)
     q_nope, q_rope = q[..., :dn], q[..., dn:]
     q_rope = rotary.apply_rope(q_rope, rope_sin, rope_cos)
-    c_kv = jnp.einsum('bsd,dr->bsr', h, lp['w_dkv'].astype(cfg.dtype))
+    c_kv = jnp.einsum('bsd,dr->bsr', h, _d(lp['w_dkv'], cfg.dtype))
     c_kv = norms.rms_norm(c_kv, lp['kv_norm'], cfg.rms_eps)
-    k_rope = jnp.einsum('bsd,dr->bsr', h, lp['w_kr'].astype(cfg.dtype))
+    k_rope = jnp.einsum('bsd,dr->bsr', h, _d(lp['w_kr'], cfg.dtype))
     # One shared rope key: apply rope with a singleton heads axis.
     k_rope = rotary.apply_rope(k_rope[:, :, None, :], rope_sin,
                                rope_cos)[:, :, 0, :]
@@ -184,7 +185,7 @@ def _attend_latent(q_nope, q_rope, c_kv, k_rope, lp, cfg: MLAConfig,
     t = c_kv.shape[1]
     r, dv = cfg.kv_lora_rank, cfg.v_head_dim
     scale = (dn + cfg.qk_rope_head_dim) ** -0.5
-    w_uk = lp['w_uk'].astype(cfg.dtype).reshape(r, H, dn)
+    w_uk = _d(lp['w_uk'], cfg.dtype).reshape(r, H, dn)
     # Absorption: q̃ [B,S,H,r]
     q_lat = jnp.einsum('bshd,rhd->bshr', q_nope, w_uk)
     scores = (jnp.einsum('bshr,btr->bhst', q_lat, c_kv) +
@@ -201,23 +202,23 @@ def _attend_latent(q_nope, q_rope, c_kv, k_rope, lp, cfg: MLAConfig,
     probs = jax.nn.softmax(scores, axis=-1).astype(cfg.dtype)
     # Value side: contract probs with the latent, THEN expand per head.
     ctx = jnp.einsum('bhst,btr->bshr', probs, c_kv)        # [B,S,H,r]
-    w_uv = lp['w_uv'].astype(cfg.dtype).reshape(r, H, dv)
+    w_uv = _d(lp['w_uv'], cfg.dtype).reshape(r, H, dv)
     out = jnp.einsum('bshr,rhv->bshv', ctx, w_uv)
     return out.reshape(b, s, H * dv)
 
 
 def _mlp(x, lp, cfg: MLAConfig):
     h = norms.rms_norm(x, lp['mlp_norm'], cfg.rms_eps)
-    gate = jnp.einsum('bsd,df->bsf', h, lp['w_gate'].astype(cfg.dtype))
-    up = jnp.einsum('bsd,df->bsf', h, lp['w_up'].astype(cfg.dtype))
+    gate = jnp.einsum('bsd,df->bsf', h, _d(lp['w_gate'], cfg.dtype))
+    up = jnp.einsum('bsd,df->bsf', h, _d(lp['w_up'], cfg.dtype))
     return jnp.einsum('bsf,fd->bsd', cfg.act(gate) * up,
-                      lp['w_down'].astype(cfg.dtype))
+                      _d(lp['w_down'], cfg.dtype))
 
 
 def _layer(x, lp, cfg: MLAConfig, sin, cos, q_offset):
     q_nope, q_rope, c_kv, k_rope = _latents(x, lp, cfg, sin, cos)
     out = _attend_latent(q_nope, q_rope, c_kv, k_rope, lp, cfg, q_offset)
-    x = x + jnp.einsum('bsh,hd->bsd', out, lp['wo'].astype(cfg.dtype))
+    x = x + jnp.einsum('bsh,hd->bsd', out, _d(lp['wo'], cfg.dtype))
     return x + _mlp(x, lp, cfg)
 
 
@@ -295,7 +296,7 @@ def prefill(params, tokens: jnp.ndarray, cfg: MLAConfig, max_len: int,
         q_nope, q_rope, c_kv, k_rope = _latents(carry, lp, cfg, sin, cos)
         out = _attend_latent(q_nope, q_rope, c_kv, k_rope, lp, cfg, 0)
         carry = carry + jnp.einsum('bsh,hd->bsd', out,
-                                   lp['wo'].astype(cfg.dtype))
+                                   _d(lp['wo'], cfg.dtype))
         carry = carry + _mlp(carry, lp, cfg)
         return carry, (c_kv, k_rope)
 
@@ -340,7 +341,7 @@ def decode_step(params, token: jnp.ndarray, cache: LatentCache,
         out = _attend_latent(q_nope, q_rope, c_l, kr_l, lp, cfg,
                              q_offset=length)
         x_c = x_c + jnp.einsum('bsh,hd->bsd', out,
-                               lp['wo'].astype(cfg.dtype))
+                               _d(lp['wo'], cfg.dtype))
         x_c = x_c + _mlp(x_c, lp, cfg)
         return (x_c, c_all, kr_all), None
 
@@ -369,7 +370,6 @@ def generate(params, prompt: jnp.ndarray, cfg: MLAConfig,
     """Generation over the latent cache, same surface as decode.generate
     (greedy / temperature / top-k / top-p, eos padding, ragged prompts) —
     the inference engine serves MLA models through this interchangeably."""
-    from skypilot_tpu.models.decode import _select_token
     b, s = prompt.shape
     if max_len is None:
         max_len = min(cfg.max_seq_len, s + max_new_tokens)

@@ -277,13 +277,30 @@ class TestInt8Decode:
         assert out.shape == (2, 8)
         assert bool(jnp.all((out >= 0) & (out < cfg.vocab_size)))
 
-    def test_int8_rejected_for_moe_and_mla(self):
-        from skypilot_tpu.models import mla, moe
+    def test_int8_mla_generates_close_to_fp(self):
+        """MLA's absorbed matmuls read through the quant-aware view:
+        int8 DeepSeek-family serving works and stays close to fp."""
+        import dataclasses as dc
+        from skypilot_tpu.models import mla
+        cfg = dc.replace(mla.PRESETS['mla-debug'], dtype=jnp.float32)
+        raw = mla.init_params(jax.random.PRNGKey(0), cfg)
+        fp = decode.cast_params_for_decode(raw, cfg)
+        q8 = decode.cast_params_for_decode(raw, cfg, quantize='int8')
+        assert isinstance(q8['layers']['w_uk'], decode.QuantizedWeight)
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 12), 0,
+                                    cfg.vocab_size, jnp.int32)
+        logits_fp, _ = mla.prefill(fp, tokens, cfg, max_len=32)
+        logits_q8, _ = mla.prefill(q8, tokens, cfg, max_len=32)
+        rel = float(jnp.max(jnp.abs(logits_q8 - logits_fp))) / (
+            float(jnp.max(jnp.abs(logits_fp))) + 1e-9)
+        assert rel < 0.1, rel
+        out = mla.generate(q8, tokens, cfg, 8, max_len=32)
+        assert out.shape == (2, 8)
+
+    def test_int8_rejected_for_moe(self):
+        from skypilot_tpu.models import moe
         import pytest as pytest_lib
-        for preset in (moe.PRESETS['moe-debug'], mla.PRESETS['mla-debug']):
-            from skypilot_tpu.models import module_for
-            params = module_for(preset).init_params(jax.random.PRNGKey(0),
-                                                    preset)
-            with pytest_lib.raises(NotImplementedError):
-                decode.cast_params_for_decode(params, preset,
-                                              quantize='int8')
+        preset = moe.PRESETS['moe-debug']
+        params = moe.init_params(jax.random.PRNGKey(0), preset)
+        with pytest_lib.raises(NotImplementedError):
+            decode.cast_params_for_decode(params, preset, quantize='int8')
