@@ -84,7 +84,7 @@ def _d(w, dtype):
 # (incl. the absorbed w_uk/w_uv) read through _d and quantize fine.
 _QUANT_KEYS = frozenset(
     ['wq', 'wk', 'wv', 'wo', 'w_gate', 'w_up', 'w_down',
-     'w_dkv', 'w_kr', 'w_uk', 'w_uv'])
+     'w_dkv', 'w_kr', 'w_uk', 'w_uv', 'ws_gate', 'ws_up', 'ws_down'])
 
 
 def cast_params_for_decode(params, cfg: llama.LlamaConfig,
@@ -117,7 +117,10 @@ def cast_params_for_decode(params, cfg: llama.LlamaConfig,
             continue
         layers = {}
         for k, w in sub.items():
-            if k in _QUANT_KEYS and w.ndim >= 2:
+            # ndim <= 3: per-layer [L, in, out] projection stacks. 4-D
+            # routed-expert stacks (DeepSeek-MoE [L,E,in,out]) stay dense
+            # — moe_ffn reads them directly, not through _d.
+            if k in _QUANT_KEYS and 2 <= w.ndim <= 3:
                 # Quantize from the RAW (fp32 master) weights, not a
                 # bf16-rounded copy.
                 layers[k] = _quantize_int8(w)

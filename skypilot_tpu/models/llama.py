@@ -126,6 +126,27 @@ PRESETS: Dict[str, LlamaConfig] = {
     'llama2-7b': LlamaConfig(vocab_size=32000, dim=4096, n_layers=32,
                              n_heads=32, n_kv_heads=32, ffn_dim=11008,
                              rope_theta=10000.0, max_seq_len=4096),
+    # Llama-3.2 small models (reference: llm/llama-3_2/ recipes): tied
+    # embeddings, same 3.1-style NTK rope scaling (factor 32).
+    'llama3.2-1b': LlamaConfig(vocab_size=128256, dim=2048, n_layers=16,
+                               n_heads=32, n_kv_heads=8, ffn_dim=8192,
+                               max_seq_len=8192, tie_embeddings=True,
+                               rope_scaling=dict(factor=32.0,
+                                                 low_freq_factor=1.0,
+                                                 high_freq_factor=4.0,
+                                                 original_max_position=8192)),
+    'llama3.2-3b': LlamaConfig(vocab_size=128256, dim=3072, n_layers=28,
+                               n_heads=24, n_kv_heads=8, ffn_dim=8192,
+                               max_seq_len=8192, tie_embeddings=True,
+                               rope_scaling=dict(factor=32.0,
+                                                 low_freq_factor=1.0,
+                                                 high_freq_factor=4.0,
+                                                 original_max_position=8192)),
+    # CodeLlama-7b (reference: llm/codellama/): llama2 geometry with the
+    # 16k-context rope base and a 32016-token vocab (infill specials).
+    'codellama-7b': LlamaConfig(vocab_size=32016, dim=4096, n_layers=32,
+                                n_heads=32, n_kv_heads=32, ffn_dim=11008,
+                                rope_theta=1e6, max_seq_len=16384),
     # Qwen2/2.5 family (reference serves these via vLLM recipes,
     # llm/qwen/): same decoder as Llama plus q/k/v projection biases.
     'qwen2-7b': LlamaConfig(vocab_size=152064, dim=3584, n_layers=28,
@@ -136,6 +157,13 @@ PRESETS: Dict[str, LlamaConfig] = {
                              n_heads=64, n_kv_heads=8, ffn_dim=29568,
                              rope_theta=1e6, rms_eps=1e-6,
                              max_seq_len=32768, qkv_bias=True),
+    # Qwen2.5 small sizes (reference serves these via vLLM/ollama
+    # recipes): same decoder family, tied embeddings on the small ones.
+    'qwen2.5-1.5b': LlamaConfig(vocab_size=151936, dim=1536, n_layers=28,
+                                n_heads=12, n_kv_heads=2, ffn_dim=8960,
+                                rope_theta=1e6, rms_eps=1e-6,
+                                max_seq_len=32768, qkv_bias=True,
+                                tie_embeddings=True),
     # Gemma family (reference: llm/gemma/, llm/gemma3/ recipes): (1+w)
     # norms, tanh-gelu MLP gating, sqrt(dim)-scaled embeddings, tied
     # head; gemma2 additionally softcaps the final logits.

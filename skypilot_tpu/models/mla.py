@@ -65,6 +65,36 @@ class MLAConfig(llama_lib.LlamaConfig):
         return self.n_layers * per_layer + embed + self.dim
 
 
+@dataclasses.dataclass(frozen=True)
+class DeepSeekMoEConfig(MLAConfig):
+    """The REAL DeepSeek-V2/V3/R1 architecture: MLA attention + a
+    mixture-of-experts FFN with always-on SHARED experts beside the
+    routed ones (reference recipes: llm/deepseek-r1/, llm/kimi-k2/ —
+    served there via vLLM/SGLang; native here). `ffn_dim` is the
+    PER-EXPERT width; shared experts add `n_shared_experts · ffn_dim`
+    of dense SwiGLU on every token."""
+    n_experts: int = 8
+    top_k: int = 2
+    n_shared_experts: int = 1
+    capacity_factor: float = 1.25
+    router_aux_weight: float = 0.02
+    router_group_size: int = 2048
+
+    @property
+    def num_params(self) -> int:
+        D, H, F, E = self.dim, self.n_heads, self.ffn_dim, self.n_experts
+        r, dn, dr, dv = (self.kv_lora_rank, self.qk_nope_head_dim,
+                         self.qk_rope_head_dim, self.v_head_dim)
+        attn = (D * H * (dn + dr) + D * r + D * dr + r * H * dn +
+                r * H * dv + H * dv * D)
+        ffn = (E * 3 * D * F                      # routed experts
+               + self.n_shared_experts * 3 * D * F  # shared experts
+               + D * E)                           # router
+        per_layer = attn + ffn + 2 * D
+        embed = self.vocab_size * D * (1 if self.tie_embeddings else 2)
+        return self.n_layers * per_layer + embed + D
+
+
 PRESETS: Dict[str, MLAConfig] = {
     'mla-debug': MLAConfig(vocab_size=256, dim=64, n_layers=2, n_heads=4,
                            n_kv_heads=4, ffn_dim=128, max_seq_len=128,
@@ -78,11 +108,27 @@ PRESETS: Dict[str, MLAConfig] = {
                                   rope_theta=10000.0, max_seq_len=32768,
                                   kv_lora_rank=512, qk_nope_head_dim=128,
                                   qk_rope_head_dim=64, v_head_dim=128),
+    'deepseek-moe-debug': DeepSeekMoEConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=4,
+        ffn_dim=64, max_seq_len=128, rope_theta=10000.0, remat='none',
+        kv_lora_rank=32, qk_nope_head_dim=16, qk_rope_head_dim=8,
+        v_head_dim=16, n_experts=4, top_k=2, n_shared_experts=1,
+        # Ample capacity: no routed token is ever dropped, so decode
+        # matches the training forward bit-for-bit in tests.
+        capacity_factor=4.0),
+    # DeepSeek-V2 geometry (236B total / 21B active in the real model):
+    # MLA (r=512) + 160 routed experts (1536-wide, top-6) + 2 shared.
+    'deepseek-v2': DeepSeekMoEConfig(
+        vocab_size=102400, dim=5120, n_layers=60, n_heads=128,
+        n_kv_heads=128, ffn_dim=1536, max_seq_len=32768,
+        rope_theta=10000.0, kv_lora_rank=512, qk_nope_head_dim=128,
+        qk_rope_head_dim=64, v_head_dim=128, n_experts=160, top_k=6,
+        n_shared_experts=2),
 }
 
 
 def init_params(rng: jax.Array, cfg: MLAConfig) -> Params:
-    k = iter(jax.random.split(rng, 16))
+    k = iter(jax.random.split(rng, 24))
     init = jax.nn.initializers.normal(stddev=0.02, dtype=cfg.param_dtype)
     trunc = jax.nn.initializers.variance_scaling(
         1.0, 'fan_in', 'truncated_normal', dtype=cfg.param_dtype)
@@ -107,6 +153,21 @@ def init_params(rng: jax.Array, cfg: MLAConfig) -> Params:
         },
         'final_norm': jnp.ones((D,), cfg.param_dtype),
     }
+    if isinstance(cfg, DeepSeekMoEConfig):
+        E = cfg.n_experts
+        layers = params['layers']
+        for key in ('mlp_norm', 'w_gate', 'w_up', 'w_down'):
+            del layers[key]
+        layers['moe_norm'] = jnp.ones((L, D), cfg.param_dtype)
+        layers['router'] = init(next(k), (L, D, E))
+        layers['w_gate'] = trunc(next(k), (L, E, D, F))
+        layers['w_up'] = trunc(next(k), (L, E, D, F))
+        layers['w_down'] = trunc(next(k), (L, E, F, D))
+        if cfg.n_shared_experts:
+            Fs = F * cfg.n_shared_experts
+            layers['ws_gate'] = trunc(next(k), (L, D, Fs))
+            layers['ws_up'] = trunc(next(k), (L, D, Fs))
+            layers['ws_down'] = trunc(next(k), (L, Fs, D))
     if not cfg.tie_embeddings:
         params['lm_head'] = init(next(k), (D, cfg.vocab_size))
     return params
@@ -138,6 +199,19 @@ def param_specs(cfg: MLAConfig,
         },
         'final_norm': s('norm'),
     }
+    if isinstance(cfg, DeepSeekMoEConfig):
+        layers = specs['layers']
+        for key in ('mlp_norm', 'w_gate', 'w_up', 'w_down'):
+            del layers[key]
+        layers['moe_norm'] = s('layers', 'norm')
+        layers['router'] = s('layers', 'embed', 'norm')
+        layers['w_gate'] = s('layers', 'expert', 'embed', 'mlp')
+        layers['w_up'] = s('layers', 'expert', 'embed', 'mlp')
+        layers['w_down'] = s('layers', 'expert', 'mlp', 'embed')
+        if cfg.n_shared_experts:
+            layers['ws_gate'] = s('layers', 'embed', 'mlp')
+            layers['ws_up'] = s('layers', 'embed', 'mlp')
+            layers['ws_down'] = s('layers', 'mlp', 'embed')
     if not cfg.tie_embeddings:
         specs['lm_head'] = s('embed', 'vocab')
     return specs
@@ -148,6 +222,11 @@ def validate_divisibility(cfg: MLAConfig, mesh_shape: Dict[str, int]):
     if tp > 1 and cfg.n_heads % tp != 0:
         raise ValueError(f'n_heads={cfg.n_heads} not divisible by tensor '
                          f'axis {tp}')
+    ep = mesh_shape.get('expert', 1)
+    if isinstance(cfg, DeepSeekMoEConfig) and ep > 1 and \
+            cfg.n_experts % ep != 0:
+        raise ValueError(f'n_experts={cfg.n_experts} not divisible by '
+                         f'expert axis {ep}')
 
 
 # ---------------------------------------------------------------------------
@@ -215,18 +294,55 @@ def _mlp(x, lp, cfg: MLAConfig):
                       _d(lp['w_down'], cfg.dtype))
 
 
-def _layer(x, lp, cfg: MLAConfig, sin, cos, q_offset):
+def _ffn(x, lp, cfg: MLAConfig, rules=None):
+    """(residual_branch, router_aux). DeepSeek-MoE configs route through
+    shared + routed experts; dense MLA uses the SwiGLU _mlp."""
+    if not isinstance(cfg, DeepSeekMoEConfig):
+        return _mlp(x, lp, cfg), jnp.zeros((), jnp.float32)
+    from skypilot_tpu.models import moe as moe_lib
+    rules = rules or sharding_lib.Rules()
+    h = norms.rms_norm(x, lp['moe_norm'], cfg.rms_eps)
+    y, aux = moe_lib.moe_ffn(h, lp, cfg, rules)
+    if cfg.n_shared_experts:
+        # Shared experts: dense SwiGLU every token takes, beside the
+        # routed ones (DeepSeek-V2 §MoE; absent from Mixtral-style MoE).
+        gate = jnp.einsum('bsd,df->bsf', h, _d(lp['ws_gate'], cfg.dtype))
+        up = jnp.einsum('bsd,df->bsf', h, _d(lp['ws_up'], cfg.dtype))
+        y = y + jnp.einsum('bsf,fd->bsd', cfg.act(gate) * up,
+                           _d(lp['ws_down'], cfg.dtype))
+    return y, aux
+
+
+def _layer(carry, lp, cfg: MLAConfig, sin, cos, q_offset, rules=None):
+    x, aux_sum = carry
     q_nope, q_rope, c_kv, k_rope = _latents(x, lp, cfg, sin, cos)
     out = _attend_latent(q_nope, q_rope, c_kv, k_rope, lp, cfg, q_offset)
     x = x + jnp.einsum('bsh,hd->bsd', out, _d(lp['wo'], cfg.dtype))
-    return x + _mlp(x, lp, cfg)
+    y, aux = _ffn(x, lp, cfg, rules)
+    return (x + y, aux_sum + aux)
+
+
+# train_lib probes this: forward(return_aux=True) yields the router
+# load-balance aux (0 for dense-MLA configs).
+HAS_AUX = True
 
 
 def forward(params: Params, tokens: jnp.ndarray, cfg: MLAConfig,
             rules: Optional[sharding_lib.Rules] = None,
             positions: Optional[jnp.ndarray] = None,
-            q_offset: int | jnp.ndarray = 0) -> jnp.ndarray:
-    """tokens [B,S] → logits [B,S,V] fp32."""
+            q_offset: int | jnp.ndarray = 0,
+            return_aux: bool = False):
+    """tokens [B,S] → logits [B,S,V] fp32 (+ router aux if asked)."""
+    if cfg.pipeline_stages > 1:
+        raise NotImplementedError(
+            'pipeline_stages>1 is not implemented for MLA models '
+            '(dense Llama/MoE have the GPipe path); shard with '
+            'tensor/expert/data axes instead.')
+    if cfg.attention_impl == 'ring':
+        raise NotImplementedError(
+            'ring attention is not implemented for MLA (the latent-space '
+            'scores need a latent-aware ring); MLA contexts are cheap — '
+            'the r+dr cache usually makes sequence sharding unnecessary.')
     rules = rules or sharding_lib.Rules()
     con = functools.partial(sharding_lib.constrain, rules=rules)
     b, s = tokens.shape
@@ -237,24 +353,31 @@ def forward(params: Params, tokens: jnp.ndarray, cfg: MLAConfig,
     sin, cos = rotary.rope_frequencies(cfg.qk_rope_head_dim, positions,
                                        cfg.rope_theta, cfg.rope_scaling)
     layer_fn = functools.partial(_layer, cfg=cfg, sin=sin, cos=cos,
-                                 q_offset=q_offset)
+                                 q_offset=q_offset, rules=rules)
     policy_name = llama_lib._REMAT_POLICIES[cfg.remat]
     if policy_name is not None:
         policy = getattr(jax.checkpoint_policies, policy_name)
         layer_fn = jax.checkpoint(layer_fn, policy=policy)
+    aux0 = jnp.zeros((), jnp.float32)
     if cfg.scan_layers:
         def body(carry, lp):
             return layer_fn(carry, lp), None
-        x, _ = jax.lax.scan(body, x, params['layers'])
+        (x, aux), _ = jax.lax.scan(body, (x, aux0), params['layers'])
     else:
+        carry = (x, aux0)
         for i in range(cfg.n_layers):
             lp = jax.tree.map(lambda p: p[i], params['layers'])
-            x = layer_fn(x, lp)
+            carry = layer_fn(carry, lp)
+        x, aux = carry
     x = norms.rms_norm(x, params['final_norm'], cfg.rms_eps)
     head = (params['embed'].T if cfg.tie_embeddings else params['lm_head'])
     logits = jnp.einsum('bsd,dv->bsv', x, head.astype(cfg.dtype),
                         preferred_element_type=jnp.float32)
-    return con(logits, 'batch', 'seq', 'vocab')
+    logits = con(logits, 'batch', 'seq', 'vocab')
+    if return_aux:
+        weight = getattr(cfg, 'router_aux_weight', 0.0)
+        return logits, weight * aux / cfg.n_layers
+    return logits
 
 
 # ---------------------------------------------------------------------------
@@ -297,7 +420,7 @@ def prefill(params, tokens: jnp.ndarray, cfg: MLAConfig, max_len: int,
         out = _attend_latent(q_nope, q_rope, c_kv, k_rope, lp, cfg, 0)
         carry = carry + jnp.einsum('bsh,hd->bsd', out,
                                    _d(lp['wo'], cfg.dtype))
-        carry = carry + _mlp(carry, lp, cfg)
+        carry = carry + _ffn(carry, lp, cfg)[0]
         return carry, (c_kv, k_rope)
 
     x, (cs, krs) = jax.lax.scan(body, x, params['layers'])
@@ -342,7 +465,7 @@ def decode_step(params, token: jnp.ndarray, cache: LatentCache,
                              q_offset=length)
         x_c = x_c + jnp.einsum('bsh,hd->bsd', out,
                                _d(lp['wo'], cfg.dtype))
-        x_c = x_c + _mlp(x_c, lp, cfg)
+        x_c = x_c + _ffn(x_c, lp, cfg)[0]
         return (x_c, c_all, kr_all), None
 
     layer_ids = jnp.arange(cfg.n_layers, dtype=jnp.int32)

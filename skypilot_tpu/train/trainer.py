@@ -65,9 +65,10 @@ def maybe_init_distributed() -> None:
 
 
 def _model_config(tcfg: TrainerConfig):
-    from skypilot_tpu.models import llama, moe
+    from skypilot_tpu.models import llama, mla, moe
     presets = dict(llama.PRESETS)
     presets.update(moe.PRESETS)
+    presets.update(mla.PRESETS)
     if tcfg.model not in presets:
         raise ValueError(f'Unknown model preset {tcfg.model!r}; '
                          f'available: {sorted(presets)}')

@@ -297,6 +297,24 @@ class TestInt8Decode:
         out = mla.generate(q8, tokens, cfg, 8, max_len=32)
         assert out.shape == (2, 8)
 
+    def test_int8_deepseek_moe_quantizes_projections_only(self):
+        """DeepSeek-MoE int8: MLA projections + shared experts quantize;
+        4-D routed-expert stacks stay dense (moe_ffn reads them raw)."""
+        import dataclasses as dc
+        from skypilot_tpu.models import mla
+        cfg = dc.replace(mla.PRESETS['deepseek-moe-debug'],
+                         dtype=jnp.float32)
+        raw = mla.init_params(jax.random.PRNGKey(0), cfg)
+        q8 = decode.cast_params_for_decode(raw, cfg, quantize='int8')
+        assert isinstance(q8['layers']['wq'], decode.QuantizedWeight)
+        assert isinstance(q8['layers']['ws_gate'], decode.QuantizedWeight)
+        assert not isinstance(q8['layers']['w_gate'],
+                              decode.QuantizedWeight)   # routed: dense
+        tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 8), 0,
+                                    cfg.vocab_size, jnp.int32)
+        out = mla.generate(q8, tokens, cfg, 4, max_len=32)
+        assert out.shape == (2, 4)
+
     def test_int8_rejected_for_moe(self):
         from skypilot_tpu.models import moe
         import pytest as pytest_lib
