@@ -44,6 +44,30 @@ MAX_BATCH = int(os.environ.get('SKYTPU_ENGINE_MAX_BATCH', '8'))
 MAX_STEP_CHUNK = int(os.environ.get('SKYTPU_ENGINE_STEP_CHUNK', '8'))
 
 
+def _parse_sampling(body, default_temperature: float = 0.0):
+    """(temperature, top_k, top_p) from an untrusted request body —
+    shared by /generate and /v1/completions. Raises ValueError/TypeError
+    on garbage (NaN, out-of-range)."""
+    import math
+    temperature = float(body.get('temperature', default_temperature))
+    if not math.isfinite(temperature):    # json accepts NaN/Infinity
+        raise ValueError(f'temperature {temperature} not finite')
+    temperature = max(temperature, 0.0)
+    top_k = body.get('top_k')
+    top_k = max(int(top_k), 0) if top_k is not None else None
+    top_p = body.get('top_p')
+    top_p = float(top_p) if top_p is not None else None
+    if top_p is not None and not 0.0 <= top_p <= 1.0:
+        raise ValueError(f'top_p {top_p} outside [0, 1]')
+    return temperature, top_k, top_p
+
+
+def _bytes_to_text(tokens) -> str:
+    """Byte-level detokenize (data/loader.py's hermetic tokenizer)."""
+    return bytes(t for t in tokens if t < 256).decode('utf-8',
+                                                      errors='replace')
+
+
 def _bucket(n: int, floor: int = 16) -> int:
     """Round up to a power of two (bounded compile count)."""
     b = floor
@@ -368,18 +392,8 @@ def build_app(engine: InferenceEngine):
         # PER-ROW runtime arrays — untrusted values can neither trigger a
         # recompile nor fail the whole batch (top_k is further clamped to
         # vocab inside decode.select_token_per_row).
-        import math
         try:
-            temperature = float(body.get('temperature', 0.0))
-            if not math.isfinite(temperature):    # json accepts NaN/Infinity
-                raise ValueError(f'temperature {temperature} not finite')
-            temperature = max(temperature, 0.0)
-            top_k = body.get('top_k')
-            top_k = max(int(top_k), 0) if top_k is not None else None
-            top_p = body.get('top_p')
-            top_p = float(top_p) if top_p is not None else None
-            if top_p is not None and not 0.0 <= top_p <= 1.0:
-                raise ValueError(f'top_p {top_p} outside [0, 1]')
+            temperature, top_k, top_p = _parse_sampling(body)
         except (TypeError, ValueError) as e:
             return web.json_response({'error': f'bad sampling params: {e}'},
                                      status=400)
@@ -387,14 +401,83 @@ def build_app(engine: InferenceEngine):
                                   top_p)
         resp: Dict[str, Any] = {'tokens': out}
         if 'text' in body:
-            resp['text'] = bytes(t for t in out if t < 256).decode(
-                'utf-8', errors='replace')
+            resp['text'] = _bytes_to_text(out)
         return web.json_response(resp)
+
+    async def openai_completions(request):
+        """OpenAI-compatible completions (reference users serve through
+        vLLM's OpenAI server — llm/qwen, llm/mixtral recipes curl
+        /v1/completions; non-streaming clients work against this engine
+        unchanged). Byte-level tokenizer; single choice; token-id list
+        prompts honored; stream rejected loudly."""
+
+        def bad(msg, status=400):
+            return web.json_response(
+                {'error': {'message': msg,
+                           'type': 'invalid_request_error'}}, status=status)
+
+        body = await request.json()
+        if not isinstance(body, dict):
+            return bad('request body must be a JSON object')
+        if body.get('stream'):
+            return bad('streaming is not supported; use stream=false')
+        prompt = body.get('prompt', '')
+        try:
+            if isinstance(prompt, list) and prompt and all(
+                    isinstance(t, int) for t in prompt):
+                tokens = [int(t) for t in prompt]   # token-id prompt
+            elif isinstance(prompt, list):
+                if len(prompt) != 1:
+                    return bad('only a single prompt per request is '
+                               'supported')
+                prompt = prompt[0]
+                from skypilot_tpu.data import loader as loader_lib
+                tokens = [int(t)
+                          for t in loader_lib.tokenize_text(str(prompt))]
+            else:
+                from skypilot_tpu.data import loader as loader_lib
+                tokens = [int(t)
+                          for t in loader_lib.tokenize_text(str(prompt))]
+            if not tokens:
+                return bad('empty prompt')
+            max_new = int(body.get('max_tokens', 16))
+            if max_new < 1:
+                raise ValueError('max_tokens must be >= 1')
+            temperature, top_k, top_p = _parse_sampling(
+                body, default_temperature=1.0)
+        except (TypeError, ValueError) as e:
+            return bad(f'invalid request: {e}')
+        if _bucket(len(tokens)) + max_new > engine.max_len:
+            return bad(f'prompt + max_tokens exceeds max_len '
+                       f'{engine.max_len}')
+        out = await engine.submit(tokens, max_new, temperature, top_k,
+                                  top_p)
+        return web.json_response({
+            'id': f'cmpl-{time.time_ns()}',
+            'object': 'text_completion',
+            'created': int(time.time()),
+            'model': body.get('model', 'skytpu'),
+            'choices': [{'text': _bytes_to_text(out), 'index': 0,
+                         'logprobs': None, 'finish_reason': 'length'}],
+            'usage': {'prompt_tokens': len(tokens),
+                      'completion_tokens': len(out),
+                      'total_tokens': len(tokens) + len(out)},
+        })
+
+    async def openai_models(request):
+        del request
+        return web.json_response({
+            'object': 'list',
+            'data': [{'id': 'skytpu', 'object': 'model',
+                      'owned_by': 'skytpu'}],
+        })
 
     app = web.Application()
     app.router.add_get('/health', health)
     app.router.add_get('/', health)
     app.router.add_post('/generate', generate)
+    app.router.add_post('/v1/completions', openai_completions)
+    app.router.add_get('/v1/models', openai_models)
 
     async def _start(app_):
         del app_

@@ -181,3 +181,45 @@ class TestEngine:
             body = await txt.json()
             assert 'text' in body and len(body['tokens']) == 4
         _with_client(engine, fn)
+
+    def test_openai_compatible_completions(self, engine):
+        """Reference users serve through vLLM's OpenAI API; those clients
+        work against the native engine unchanged: /v1/completions +
+        /v1/models with the standard shapes."""
+        async def fn(client):
+            r = await client.get('/v1/models')
+            assert r.status == 200
+            assert (await r.json())['data'][0]['object'] == 'model'
+            r = await client.post('/v1/completions', json={
+                'model': 'skytpu', 'prompt': 'hello', 'max_tokens': 4,
+                'temperature': 0})
+            assert r.status == 200
+            body = await r.json()
+            assert body['object'] == 'text_completion'
+            assert len(body['choices']) == 1
+            assert body['choices'][0]['finish_reason'] == 'length'
+            assert body['usage']['completion_tokens'] == 4
+            assert isinstance(body['choices'][0]['text'], str)
+            bad = await client.post('/v1/completions', json={
+                'prompt': 'hi', 'max_tokens': 4, 'top_p': 9})
+            assert bad.status == 400
+            assert 'invalid_request_error' in (await bad.json())[
+                'error']['type']
+            empty = await client.post('/v1/completions', json={
+                'prompt': '', 'max_tokens': 4})
+            assert empty.status == 400
+            # Token-id prompts (what OpenAI/vLLM clients emit) are honored
+            # as token ids, not str()-tokenized.
+            ids = await client.post('/v1/completions', json={
+                'prompt': [1, 2, 3, 4], 'max_tokens': 3, 'temperature': 0})
+            assert ids.status == 200
+            assert (await ids.json())['usage']['prompt_tokens'] == 4
+            # Garbage max_tokens / multi-prompt / stream fail with 400s,
+            # never 500s.
+            for payload in ({'prompt': 'x', 'max_tokens': None},
+                            {'prompt': ['a', 'b'], 'max_tokens': 2},
+                            {'prompt': 'x', 'max_tokens': 2,
+                             'stream': True}):
+                r = await client.post('/v1/completions', json=payload)
+                assert r.status == 400, payload
+        _with_client(engine, fn)
