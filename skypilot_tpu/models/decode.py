@@ -138,7 +138,8 @@ def init_cache(cfg: llama.LlamaConfig, batch: int, max_len: int) -> KVCache:
 
 
 def _qkv(x: jnp.ndarray, lp, cfg: llama.LlamaConfig, sin, cos):
-    """Shared with training math: norm → q/k/v projections → rope."""
+    """Shared with training math: norm → q/k/v projections → (qk-norm) →
+    rope. sin/cos must already be per-layer (llama.select_rope)."""
     b, s, _ = x.shape
     hd = cfg.hd
     h = norms.rms_norm(x, lp['attn_norm'], cfg.rms_eps,
@@ -153,6 +154,11 @@ def _qkv(x: jnp.ndarray, lp, cfg: llama.LlamaConfig, sin, cos):
     q = q.reshape(b, s, cfg.n_heads, hd)
     k = k.reshape(b, s, cfg.n_kv_heads, hd)
     v = v.reshape(b, s, cfg.n_kv_heads, hd)
+    if cfg.qk_norm:
+        q = norms.rms_norm(q, lp['q_norm'], cfg.rms_eps,
+                           scale_plus_one=cfg.norm_plus_one)
+        k = norms.rms_norm(k, lp['k_norm'], cfg.rms_eps,
+                           scale_plus_one=cfg.norm_plus_one)
     q = rotary.apply_rope(q, sin, cos)
     k = rotary.apply_rope(k, sin, cos)
     return q, k, v
@@ -230,8 +236,7 @@ def prefill(params, tokens: jnp.ndarray, cfg: llama.LlamaConfig,
     if cfg.embed_scale:
         x = x * jnp.asarray(cfg.dim ** 0.5, cfg.dtype)
     positions = jnp.arange(s)
-    sin, cos = rotary.rope_frequencies(cfg.hd, positions, cfg.rope_theta,
-                                       cfg.rope_scaling)
+    sin, cos = llama.rope_tables(cfg, positions)
 
     # Ring attention is a training-time context-parallel impl; decode
     # prompts fit on-chip, so route it to the standard path.
@@ -239,8 +244,10 @@ def prefill(params, tokens: jnp.ndarray, cfg: llama.LlamaConfig,
 
     def body(carry, xs):
         lp, layer_idx = xs
-        q, k, v = _qkv(carry, lp, cfg, sin, cos)
-        w_active = (layer_idx % 2 == 0) if cfg.sliding_window else None
+        sin_l, cos_l = llama.select_rope(sin, cos, layer_idx, cfg)
+        q, k, v = _qkv(carry, lp, cfg, sin_l, cos_l)
+        w_active = (llama.window_active(layer_idx, cfg)
+                    if cfg.sliding_window else None)
         out = _attention(q, k, v, impl=impl, causal=True,
                          logit_softcap=cfg.attn_logit_softcap,
                          window=cfg.sliding_window, window_active=w_active)
@@ -286,13 +293,13 @@ def decode_step(params, token: jnp.ndarray, cache: KVCache,
     if cfg.embed_scale:
         x = x * jnp.asarray(cfg.dim ** 0.5, cfg.dtype)
     # Per-row rope position: each row's new token sits at ITS length.
-    sin, cos = rotary.rope_frequencies(cfg.hd, length[:, None],
-                                       cfg.rope_theta, cfg.rope_scaling)
+    sin, cos = llama.rope_tables(cfg, length[:, None])
 
     def body(carry, xs):
         x_c, k_cache, v_cache = carry
         lp, layer_idx = xs
-        q, k_new, v_new = _qkv(x_c, lp, cfg, sin, cos)
+        sin_l, cos_l = llama.select_rope(sin, cos, layer_idx, cfg)
+        q, k_new, v_new = _qkv(x_c, lp, cfg, sin_l, cos_l)
         # Insert each row's new K/V at (layer_idx, b, length[b]) — a
         # scatter over the row axis (ragged rows write different slots).
         k_l = jax.lax.dynamic_index_in_dim(k_cache, layer_idx, axis=0,
@@ -307,7 +314,8 @@ def decode_step(params, token: jnp.ndarray, cache: KVCache,
                                                       layer_idx, axis=0)
         # Per-row q_offset masks kv positions > length[b]: pad garbage
         # beyond each row's valid prefix never contributes.
-        w_active = (layer_idx % 2 == 0) if cfg.sliding_window else None
+        w_active = (llama.window_active(layer_idx, cfg)
+                    if cfg.sliding_window else None)
         out = _attention(q, k_l, v_l, impl='xla', causal=True,
                          q_offset=length, kv_offset=0,
                          logit_softcap=cfg.attn_logit_softcap,

@@ -71,11 +71,19 @@ class LlamaConfig:
     embed_scale: bool = False
     final_logit_softcap: Optional[float] = None
     # Gemma-2 additions: attention-logit softcap, post-sublayer norms
-    # (attn/FFN outputs normed before the residual add), and alternating
-    # sliding-window attention (even layers local, odd global).
+    # (attn/FFN outputs normed before the residual add), and sliding-
+    # window attention on a repeating layer pattern: every
+    # `sliding_window_pattern`-th layer is GLOBAL, the rest local
+    # (pattern 2 = Gemma-2's alternation; 6 = Gemma-3's 5 local : 1
+    # global).
     attn_logit_softcap: Optional[float] = None
     post_norms: bool = False
     sliding_window: Optional[int] = None
+    sliding_window_pattern: int = 2
+    # Gemma-3 additions: learned RMS-norm on q/k heads before RoPE, and a
+    # separate (smaller) rope base for the local sliding-window layers.
+    qk_norm: bool = False
+    local_rope_theta: Optional[float] = None
 
     def act(self, x):
         if self.mlp_activation == 'gelu':
@@ -182,6 +190,21 @@ PRESETS: Dict[str, LlamaConfig] = {
                              final_logit_softcap=30.0,
                              attn_logit_softcap=50.0, post_norms=True,
                              sliding_window=4096),
+    # Gemma-3 (reference: llm/gemma3/ recipes): drops the softcaps in
+    # favor of learned QK-norm; 5 local : 1 global layer pattern with a
+    # 1024 window and a SEPARATE small rope base for local layers.
+    # (The reference model linearly rescales global rope for >32k
+    # context; that stretch is not modeled here.)
+    'gemma3-12b': LlamaConfig(vocab_size=262208, dim=3840, n_layers=48,
+                              n_heads=16, n_kv_heads=8, head_dim=256,
+                              ffn_dim=15360, rope_theta=1e6,
+                              rms_eps=1e-6, max_seq_len=32768,
+                              tie_embeddings=True, norm_plus_one=True,
+                              mlp_activation='gelu', embed_scale=True,
+                              post_norms=True, qk_norm=True,
+                              sliding_window=1024,
+                              sliding_window_pattern=6,
+                              local_rope_theta=10000.0),
 }
 
 
@@ -227,6 +250,9 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
                                                        cfg.param_dtype)
         params['layers']['post_mlp_norm'] = norm_init((L, D),
                                                       cfg.param_dtype)
+    if cfg.qk_norm:
+        params['layers']['q_norm'] = norm_init((L, hd), cfg.param_dtype)
+        params['layers']['k_norm'] = norm_init((L, hd), cfg.param_dtype)
     if not cfg.tie_embeddings:
         params['lm_head'] = init(next(k), (D, cfg.vocab_size))
     return params
@@ -261,6 +287,9 @@ def param_specs(cfg: LlamaConfig,
     if cfg.post_norms:
         specs['layers']['post_attn_norm'] = s('layers', 'norm')
         specs['layers']['post_mlp_norm'] = s('layers', 'norm')
+    if cfg.qk_norm:
+        specs['layers']['q_norm'] = s('layers', 'norm')
+        specs['layers']['k_norm'] = s('layers', 'norm')
     if not cfg.tie_embeddings:
         specs['lm_head'] = s('embed', 'vocab')
     return specs
@@ -331,6 +360,44 @@ def _pipelined_layers(x, layers, layer_fn, cfg: LlamaConfig, sin, cos):
     return out.reshape(b, s_len, d).astype(x.dtype)
 
 
+def window_active(layer_idx, cfg: LlamaConfig):
+    """Traced bool: does this layer attend within the sliding window?
+    Every `sliding_window_pattern`-th layer is GLOBAL, the rest local
+    (pattern 2 = Gemma-2 alternation, 6 = Gemma-3's 5:1)."""
+    p = cfg.sliding_window_pattern
+    return (layer_idx % p) != (p - 1)
+
+
+def select_rope(sin, cos, layer_idx, cfg: LlamaConfig):
+    """Pick this layer's RoPE tables. With `local_rope_theta` set the
+    tables ALWAYS arrive stacked on a leading [2] dim (rope_tables is
+    the single constructor: 0 = global theta, 1 = local theta for
+    sliding-window layers); selection is a traced where so all layers
+    share one scan body."""
+    if cfg.local_rope_theta is not None:
+        if layer_idx is None:
+            raise ValueError(
+                'local_rope_theta needs per-layer ids at every call site '
+                '(scan xs) to select the rope table.')
+        is_local = window_active(layer_idx, cfg)
+        return (jnp.where(is_local, sin[1], sin[0]),
+                jnp.where(is_local, cos[1], cos[0]))
+    return sin, cos
+
+
+def rope_tables(cfg: LlamaConfig, positions) -> Tuple[jnp.ndarray,
+                                                      jnp.ndarray]:
+    """(sin, cos) for RoPE; stacked [2, ...] when the config uses a
+    separate local rope base (Gemma-3)."""
+    sin, cos = rotary.rope_frequencies(cfg.hd, positions, cfg.rope_theta,
+                                       cfg.rope_scaling)
+    if cfg.local_rope_theta is not None:
+        sin_l, cos_l = rotary.rope_frequencies(cfg.hd, positions,
+                                               cfg.local_rope_theta, None)
+        return jnp.stack([sin, sin_l]), jnp.stack([cos, cos_l])
+    return sin, cos
+
+
 def attention_block(x: jnp.ndarray, lp: Params, cfg: LlamaConfig,
                     rules: sharding_lib.Rules, sin: jnp.ndarray,
                     cos: jnp.ndarray, q_offset,
@@ -356,6 +423,13 @@ def attention_block(x: jnp.ndarray, lp: Params, cfg: LlamaConfig,
     kk = kk.reshape(b, s_len, cfg.n_kv_heads, hd)
     vv = vv.reshape(b, s_len, cfg.n_kv_heads, hd)
     q = con(q, 'batch', 'seq', 'act_heads', 'head_dim')
+    if cfg.qk_norm:
+        # Gemma-3: learned RMS-norm over head_dim before RoPE.
+        q = norms.rms_norm(q, lp['q_norm'], cfg.rms_eps,
+                           scale_plus_one=cfg.norm_plus_one)
+        kk = norms.rms_norm(kk, lp['k_norm'], cfg.rms_eps,
+                            scale_plus_one=cfg.norm_plus_one)
+    sin, cos = select_rope(sin, cos, layer_idx, cfg)
     q = rotary.apply_rope(q, sin, cos)
     kk = rotary.apply_rope(kk, sin, cos)
     if cfg.attention_impl == 'ring':
@@ -368,6 +442,10 @@ def attention_block(x: jnp.ndarray, lp: Params, cfg: LlamaConfig,
             raise NotImplementedError(
                 'attn_logit_softcap with ring attention is not supported '
                 "(the ring kernel does not cap logits); use 'auto'/'xla'.")
+        if cfg.local_rope_theta is not None:
+            raise NotImplementedError(
+                'local_rope_theta (dual rope bases) with ring attention '
+                "is not supported; use 'auto'/'xla'.")
         from skypilot_tpu.ops import ring_attention as ring_lib
         from skypilot_tpu.ops.attention import _on_tpu
         ring_kw = dict(causal=True,
@@ -386,10 +464,10 @@ def attention_block(x: jnp.ndarray, lp: Params, cfg: LlamaConfig,
         window = cfg.sliding_window
         w_active = None
         if window is not None and layer_idx is not None:
-            # Gemma-2 alternation: even layers attend within the window,
-            # odd layers attend globally. Traced flag so both kinds share
-            # one scan body / compiled program.
-            w_active = (layer_idx % 2 == 0)
+            # Traced flag so local and global layers share one scan
+            # body / compiled program (window_active: every
+            # sliding_window_pattern-th layer is global).
+            w_active = window_active(layer_idx, cfg)
         out = _attention(q, kk, vv, impl=cfg.attention_impl,
                          causal=True, q_offset=q_offset,
                          kv_offset=q_offset,
@@ -462,8 +540,7 @@ def forward(params: Params,
                 "were zigzag chunks. train_lib's train/eval steps do the "
                 "permutation automatically.")
         positions = jnp.arange(s_len) + q_offset
-    sin, cos = rotary.rope_frequencies(cfg.hd, positions, cfg.rope_theta,
-                                       cfg.rope_scaling)
+    sin, cos = rope_tables(cfg, positions)
 
     # Inside the flattened stage+sequence pipeline region, 'sequence' is a
     # manual axis — drop it from the layer-internal sharding constraints.

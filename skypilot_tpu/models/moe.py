@@ -280,6 +280,10 @@ def forward(params: Params,
     x = jnp.take(params['embed'], tokens, axis=0).astype(cfg.dtype)
     x = con(x, 'batch', 'seq', 'act_embed')
 
+    if cfg.qk_norm:
+        raise NotImplementedError(
+            'qk_norm is a dense (Gemma-3) feature; MoE layers have no '
+            'q/k norm params.')
     if positions is None:
         if (cfg.attention_impl == 'ring' and
                 getattr(cfg, 'ring_layout', 'seq') == 'zigzag'):
@@ -288,8 +292,10 @@ def forward(params: Params,
                 "explicit `positions` — see llama.forward; train_lib's "
                 "train/eval steps do the permutation automatically.")
         positions = jnp.arange(s_len) + q_offset
-    sin, cos = rotary.rope_frequencies(cfg.hd, positions, cfg.rope_theta,
-                                       cfg.rope_scaling)
+    # rope_tables (not raw rope_frequencies): stacks the dual rope bases
+    # when local_rope_theta is set, so attention_block's per-layer
+    # select_rope sees the same tables training and decode use.
+    sin, cos = llama_lib.rope_tables(cfg, positions)
 
     if cfg.post_norms:
         raise NotImplementedError(

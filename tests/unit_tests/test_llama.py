@@ -218,6 +218,49 @@ def test_gemma2_features():
         (50.0, True, 4096)
 
 
+def test_gemma3_features():
+    """Gemma-3 additions: learned QK-norm, N:1 sliding-window pattern,
+    dual rope bases (local layers use a small theta). Each knob changes
+    the function; decode matches forward with all of them on."""
+    import dataclasses as dc
+    from skypilot_tpu.models import decode
+    cfg = dc.replace(CFG, dtype=jnp.float32, n_layers=3,
+                     norm_plus_one=True, mlp_activation='gelu',
+                     embed_scale=True, tie_embeddings=True,
+                     post_norms=True, qk_norm=True, sliding_window=4,
+                     sliding_window_pattern=3, local_rope_theta=100.0)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    assert 'q_norm' in params['layers']
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 16), 0,
+                                cfg.vocab_size, jnp.int32)
+    logits = llama.forward(params, tokens, cfg)
+    for change in (dict(qk_norm=False), dict(local_rope_theta=None),
+                   dict(sliding_window_pattern=2)):
+        other = dc.replace(cfg, **change)
+        assert not np.allclose(
+            np.asarray(logits),
+            np.asarray(llama.forward(params, tokens, other)), atol=1e-4), \
+            change
+    # Decode parity with every Gemma-3 knob on.
+    last, cache = decode.prefill(params, tokens, cfg, max_len=32)
+    np.testing.assert_allclose(np.asarray(last), np.asarray(logits[:, -1]),
+                               rtol=2e-4, atol=2e-4)
+    seq = tokens
+    logits_t = last
+    for _ in range(3):
+        nxt = jnp.argmax(logits_t, -1).astype(jnp.int32)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+        logits_t, cache = decode.decode_step(params, nxt, cache, cfg)
+        np.testing.assert_allclose(
+            np.asarray(logits_t),
+            np.asarray(llama.forward(params, seq, cfg)[:, -1]),
+            rtol=2e-4, atol=2e-4)
+    g3 = llama.PRESETS['gemma3-12b']
+    assert (g3.qk_norm, g3.sliding_window_pattern,
+            g3.local_rope_theta) == (True, 6, 10000.0)
+    assert g3.attn_logit_softcap is None    # gemma3 dropped the softcaps
+
+
 def test_validate_divisibility():
     with pytest.raises(ValueError):
         llama.validate_divisibility(CFG, {'tensor': 3})
