@@ -1,0 +1,192 @@
+"""Real tokenizers for the serve plane: HF tokenizer.json + chat templates.
+
+The reference serves HF checkpoints whose tokenizer ships as a
+`tokenizer.json` (fast-BPE) next to the weights; its OpenAI-compatible
+recipes (reference: llm/qwen/README.md:60,159) assume the server owns
+tokenization + chat templating. This module gives the native engine the
+same: load `tokenizer.json` via the `tokenizers` library (pure-local, no
+network), detect the chat-template family from the special tokens, and
+stream-decode incrementally (UTF-8-safe deltas for SSE).
+
+Design notes:
+  - The byte-level tokenizer (data/loader.py, vocab 256) stays the
+    hermetic default — engines with no checkpoint directory keep working
+    with zero downloads.
+  - Chat templates are hand-written per family (llama3 header format,
+    ChatML for Qwen) instead of executing the checkpoint's Jinja
+    template: a serve replica must not run template code from an
+    untrusted model directory.
+  - StreamDecoder never emits a dangling UTF-8 replacement char: a
+    multi-byte token sequence split across SSE chunks is held back until
+    it completes.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence
+
+__all__ = ['ByteTokenizer', 'HFTokenizer', 'StreamDecoder',
+           'apply_chat_template', 'load_tokenizer']
+
+
+class ByteTokenizer:
+    """Hermetic byte-level tokenizer (vocab 256) — the engine default."""
+
+    name = 'byte'
+    chat_family = 'plain'
+    eos_ids: List[int] = []
+    vocab_size = 256
+
+    def encode(self, text: str) -> List[int]:
+        return list(text.encode('utf-8'))
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return bytes(t for t in ids if 0 <= t < 256).decode(
+            'utf-8', errors='replace')
+
+
+class HFTokenizer:
+    """A `tokenizer.json` (HF fast-BPE) loaded via the tokenizers lib.
+
+    `eos_extra`: checkpoint-declared eos ids (models/hf_import.hf_eos_ids)
+    merged with the family's stop specials.
+    """
+
+    # Family detection + stop specials: a llama-3 tokenizer defines
+    # <|eot_id|>; Qwen/ChatML ones define <|im_end|>.
+    _FAMILIES = (
+        ('llama3', ('<|eot_id|>', '<|end_of_text|>')),
+        ('chatml', ('<|im_end|>', '<|endoftext|>')),
+    )
+
+    def __init__(self, path: str, eos_extra: Iterable[int] = ()):
+        from tokenizers import Tokenizer
+        self._tok = Tokenizer.from_file(path)
+        self.name = path
+        self.vocab_size = self._tok.get_vocab_size()
+        self.chat_family = 'plain'
+        eos = set(int(i) for i in eos_extra)
+        for family, specials in self._FAMILIES:
+            ids = [self._tok.token_to_id(s) for s in specials]
+            if ids[0] is not None:
+                self.chat_family = family
+                eos.update(i for i in ids if i is not None)
+                break
+        self.eos_ids = sorted(eos)
+
+    def encode(self, text: str) -> List[int]:
+        return list(self._tok.encode(text).ids)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        # skip_special_tokens: stop/eos specials never leak into output
+        # text (they are also excluded at the engine level).
+        return self._tok.decode(list(ids), skip_special_tokens=True)
+
+    def token_to_id(self, token: str) -> Optional[int]:
+        return self._tok.token_to_id(token)
+
+
+def load_tokenizer(path: str, eos_extra: Iterable[int] = ()) -> HFTokenizer:
+    """Load `tokenizer.json` from a file path or checkpoint directory."""
+    import os
+    path = os.path.expanduser(path)
+    if os.path.isdir(path):
+        path = os.path.join(path, 'tokenizer.json')
+    if not os.path.exists(path):
+        raise FileNotFoundError(
+            f'{path} not found. The engine needs the checkpoint\'s '
+            f'tokenizer.json (fast tokenizer); sentencepiece .model files '
+            f'are not supported — convert with '
+            f'transformers.convert_slow_tokenizer.')
+    return HFTokenizer(path, eos_extra=eos_extra)
+
+
+# ---------------------------------------------------------------------------
+# Chat templating
+# ---------------------------------------------------------------------------
+
+_VALID_ROLES = ('system', 'user', 'assistant')
+
+
+def _validate(messages: List[Dict[str, str]]) -> None:
+    if not isinstance(messages, list) or not messages:
+        raise ValueError('messages must be a non-empty list')
+    for m in messages:
+        if not isinstance(m, dict) or 'role' not in m or 'content' not in m:
+            raise ValueError("each message needs 'role' and 'content'")
+        if m['role'] not in _VALID_ROLES:
+            raise ValueError(f"role {m['role']!r} not in {_VALID_ROLES}")
+        if not isinstance(m['content'], str):
+            raise ValueError('message content must be a string')
+
+
+def apply_chat_template(messages: List[Dict[str, str]],
+                        family: str) -> str:
+    """Messages → prompt string ending with the assistant turn opener.
+
+    Formats (hand-checked against the public model cards):
+      llama3:  <|begin_of_text|><|start_header_id|>{role}<|end_header_id|>
+               \\n\\n{content}<|eot_id|> ... then the assistant header.
+      chatml:  <|im_start|>{role}\\n{content}<|im_end|>\\n ... then
+               <|im_start|>assistant\\n   (Qwen2/2.5).
+      plain:   "role: content" lines + "assistant:" (byte tokenizer /
+               unknown vocabs — keeps /v1/chat usable in demo mode).
+    """
+    _validate(messages)
+    if family == 'llama3':
+        parts = ['<|begin_of_text|>']
+        for m in messages:
+            parts.append(f"<|start_header_id|>{m['role']}<|end_header_id|>"
+                         f"\n\n{m['content']}<|eot_id|>")
+        parts.append('<|start_header_id|>assistant<|end_header_id|>\n\n')
+        return ''.join(parts)
+    if family == 'chatml':
+        parts = []
+        for m in messages:
+            parts.append(f"<|im_start|>{m['role']}\n{m['content']}"
+                         f'<|im_end|>\n')
+        parts.append('<|im_start|>assistant\n')
+        return ''.join(parts)
+    if family == 'plain':
+        lines = [f"{m['role']}: {m['content']}" for m in messages]
+        return '\n'.join(lines) + '\nassistant:'
+    raise ValueError(f'unknown chat family {family!r}')
+
+
+# ---------------------------------------------------------------------------
+# Incremental (SSE) decoding
+# ---------------------------------------------------------------------------
+
+class StreamDecoder:
+    """Incremental detokenizer: feed token ids, get UTF-8-safe text deltas.
+
+    BPE tokens are not codepoint-aligned (byte-level BPE splits multi-byte
+    chars across tokens), so decoding each token independently can emit
+    replacement chars mid-stream. Strategy: decode the WHOLE sequence each
+    feed and emit the suffix past what was already emitted, holding back a
+    trailing replacement char until the next token completes it. Cost is
+    O(n) per feed — bounded by max_new_tokens, negligible next to a decode
+    step.
+    """
+
+    def __init__(self, tokenizer):
+        self._tok = tokenizer
+        self._ids: List[int] = []
+        self._emitted = 0      # chars of the decoded string already sent
+
+    def feed(self, ids: Iterable[int]) -> str:
+        self._ids.extend(int(i) for i in ids)
+        text = self._tok.decode(self._ids)
+        # Hold back an incomplete multi-byte tail (shows up as U+FFFD).
+        safe_end = len(text)
+        while safe_end > self._emitted and text[safe_end - 1] == '�':
+            safe_end -= 1
+        delta = text[self._emitted:safe_end]
+        self._emitted = safe_end
+        return delta
+
+    def flush(self) -> str:
+        """Emit whatever remains (end of generation)."""
+        text = self._tok.decode(self._ids)
+        delta = text[self._emitted:]
+        self._emitted = len(text)
+        return delta

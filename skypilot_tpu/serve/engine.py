@@ -1,9 +1,11 @@
 """Native inference engine: HTTP server over the KV-cache decode path.
 
 Reference analog: the reference serves TPU models through external
-engines (JetStream/vLLM recipes, examples/tpu/v6e/README.md:119-127);
-this framework owns the model code, so the engine is native and ~200
-lines: aiohttp front, a dynamic batcher, and models/decode.py underneath.
+engines (JetStream/vLLM recipes, examples/tpu/v6e/README.md:119-127,
+llm/qwen/README.md:60 — an OpenAI-compatible server over HF
+checkpoints); this framework owns the model code, so the engine is
+native: aiohttp front, a dynamic batcher, and models/decode.py
+underneath.
 
 TPU-first design:
   - **Continuous batching**: a fixed pool of MAX_BATCH cache slots is
@@ -17,23 +19,33 @@ TPU-first design:
     Sampling params are PER-ROW runtime arrays (decode.select_token_per
     _row), so mixed temperature/top_k/top_p requests share one step and
     client-supplied values can never trigger a recompile.
-  - **Byte-level text mode**: POST {'text': ...} uses the hermetic
-    byte tokenizer (data/loader.py), so the engine serves text without
-    downloads; token mode ({'tokens': [...]}) is the raw interface.
+  - **Real checkpoints**: --hf-dir points at an HF checkpoint directory
+    (safetensors + tokenizer.json) and serves it with the real
+    tokenizer, per-family chat template, and EOS stop handling
+    (models/hf_import.py, data/tokenizer.py). Without it, the hermetic
+    byte-level tokenizer serves text with zero downloads.
+  - **Streaming**: /v1/completions and /v1/chat/completions support
+    SSE (stream=true) with UTF-8-safe incremental detokenization.
+  - **Backpressure**: the admission queue is BOUNDED; overflow returns
+    429 immediately (the serve LB's least-load policy needs replicas
+    that reject, not replicas that silently queue into SLO death).
+    /metrics exposes queue depth / in-flight / step counters.
   - **Checkpoint loading**: --ckpt-dir restores trainer checkpoints
     (orbax, train/checkpoints.py) so `skytpu jobs launch` training and
     `skytpu serve up` serving share weights end-to-end.
 
 Run: python -m skypilot_tpu.serve.engine --model llama-1b --port 8000
+or:  python -m skypilot_tpu.serve.engine --hf-dir ~/ckpts/Llama-3.2-1B
 (the serve plane sets $SKYTPU_SERVE_PORT; see examples/serve-llama-1b).
 """
 from __future__ import annotations
 
 import argparse
 import asyncio
+import json as json_lib
 import os
 import time
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from skypilot_tpu import sky_logging
 
@@ -42,11 +54,39 @@ logger = sky_logging.init_logger(__name__)
 MAX_BATCH = int(os.environ.get('SKYTPU_ENGINE_MAX_BATCH', '8'))
 # Max decode steps fused into one device call when no request is waiting.
 MAX_STEP_CHUNK = int(os.environ.get('SKYTPU_ENGINE_STEP_CHUNK', '8'))
+# Bounded admission queue: overflow => 429 (backpressure the LB can see).
+MAX_QUEUE = int(os.environ.get('SKYTPU_ENGINE_MAX_QUEUE', '64'))
+
+
+class EngineOverloaded(Exception):
+    """Admission queue full — surfaced as HTTP 429."""
+
+
+def parse_mesh_arg(mesh: str):
+    """'tensor=8' / 'data=2,tensor=4' → MeshSpec (the --mesh flag).
+
+    Axis names are the standard mesh axes (parallel/mesh.MESH_AXES); the
+    reference's serve replicas are 8-chip TP instances (vLLM/JetStream
+    on v5e-8, examples/tpu/v6e/README.md:119) — the equivalent here is
+    --mesh tensor=8."""
+    from skypilot_tpu.parallel import MeshSpec
+    kwargs = {}
+    for part in mesh.split(','):
+        if not part:
+            continue
+        if '=' not in part:
+            raise ValueError(f"--mesh entries are axis=N, got {part!r}")
+        k, v = part.split('=', 1)
+        kwargs[k.strip()] = int(v)
+    try:
+        return MeshSpec(**kwargs)
+    except TypeError as e:
+        raise ValueError(f'bad --mesh axis name: {e}') from None
 
 
 def _parse_sampling(body, default_temperature: float = 0.0):
     """(temperature, top_k, top_p) from an untrusted request body —
-    shared by /generate and /v1/completions. Raises ValueError/TypeError
+    shared by /generate and the /v1 endpoints. Raises ValueError/TypeError
     on garbage (NaN, out-of-range)."""
     import math
     temperature = float(body.get('temperature', default_temperature))
@@ -62,10 +102,37 @@ def _parse_sampling(body, default_temperature: float = 0.0):
     return temperature, top_k, top_p
 
 
-def _bytes_to_text(tokens) -> str:
-    """Byte-level detokenize (data/loader.py's hermetic tokenizer)."""
-    return bytes(t for t in tokens if t < 256).decode('utf-8',
-                                                      errors='replace')
+def _parse_stop_ids(body, tokenizer) -> Tuple[int, ...]:
+    """Stop-token ids for a /v1 request: the tokenizer's EOS set plus any
+    client-supplied stop_token_ids. ignore_eos=true disables all
+    (benchmark clients measure fixed-length decode)."""
+    if body.get('ignore_eos'):
+        return ()
+    ids = list(tokenizer.eos_ids)
+    extra = body.get('stop_token_ids')
+    if extra is not None:
+        if (not isinstance(extra, list) or
+                not all(isinstance(i, int) for i in extra)):
+            raise ValueError('stop_token_ids must be a list of ints')
+        ids.extend(int(i) for i in extra)
+    return tuple(ids)
+
+
+def _truncate_at_stop_strings(text: str, stop) -> Tuple[str, bool]:
+    """OpenAI `stop` strings: cut at the earliest occurrence."""
+    if stop is None:
+        return text, False
+    stops = [stop] if isinstance(stop, str) else list(stop)
+    cut = None
+    for s in stops:
+        if not isinstance(s, str) or not s:
+            raise ValueError('stop must be a string or list of strings')
+        i = text.find(s)
+        if i >= 0 and (cut is None or i < cut):
+            cut = i
+    if cut is None:
+        return text, False
+    return text[:cut], True
 
 
 def _bucket(n: int, floor: int = 16) -> int:
@@ -77,22 +144,38 @@ def _bucket(n: int, floor: int = 16) -> int:
 
 
 class InferenceEngine:
-    """Owns params + the batched generate loop."""
+    """Owns params + tokenizer + the batched generate loop."""
 
-    def __init__(self, model: str, ckpt_dir: Optional[str] = None,
+    def __init__(self, model: Optional[str] = 'llama-1b',
+                 ckpt_dir: Optional[str] = None,
+                 hf_dir: Optional[str] = None,
+                 tokenizer_path: Optional[str] = None,
                  max_len: Optional[int] = None,
-                 quantize: Optional[str] = None):
+                 quantize: Optional[str] = None,
+                 mesh: Optional[Any] = None):
         import jax
         import jax.numpy as jnp
+        from skypilot_tpu.data import tokenizer as tokenizer_lib
         from skypilot_tpu.models import decode as decode_lib
         from skypilot_tpu.models import get_config, mla, module_for
         self._jnp = jnp
-        self.cfg = get_config(model)
+        if hf_dir:
+            from skypilot_tpu.models import hf_import
+            self.cfg, params = hf_import.load_hf_checkpoint(hf_dir)
+            self.model_name = os.path.basename(
+                os.path.normpath(os.path.expanduser(hf_dir)))
+        else:
+            if model is None:
+                raise ValueError('need --model or --hf-dir')
+            self.cfg = get_config(model)
+            self.model_name = model
         # MLA models generate over the latent cache (models/mla.py);
         # everything else over the K/V cache. Same call surface.
         self._decode = (mla if isinstance(self.cfg, mla.MLAConfig)
                         else decode_lib)
         self.max_len = max_len or min(self.cfg.max_seq_len, 2048)
+        if ckpt_dir and hf_dir:
+            raise ValueError('--ckpt-dir and --hf-dir are exclusive')
         if ckpt_dir:
             from skypilot_tpu.parallel import MeshSpec, build_mesh
             from skypilot_tpu.train import checkpoints, train_lib
@@ -107,18 +190,43 @@ class InferenceEngine:
                 params = state.params
             logger.info(f'Restored checkpoint step {int(state.step)} '
                         f'from {ckpt_dir}.')
-        else:
+        elif not hf_dir:
             mod = module_for(self.cfg)
             params = jax.jit(lambda r: mod.init_params(r, self.cfg))(
                 jax.random.PRNGKey(0))
-            logger.info('No --ckpt-dir: serving randomly-initialized '
-                        'params (benchmark/demo mode).')
+            logger.info('No --ckpt-dir/--hf-dir: serving randomly-'
+                        'initialized params (benchmark/demo mode).')
         self.params = decode_lib.cast_params_for_decode(
             params, self.cfg, quantize=quantize)
         if quantize:
             logger.info(f'Serving with weight-only {quantize} '
                         f'quantization (decode is HBM-bound: ~2x fewer '
                         f'weight bytes per token).')
+        # Multi-chip serving: shard params/cache over a named mesh and let
+        # GSPMD insert the TP/DP collectives inside the jitted step/admit
+        # programs (the reference's serve replicas are 8-chip TP
+        # instances: vLLM/JetStream on v5e-8,
+        # examples/tpu/v6e/README.md:119-127).
+        self.mesh = None
+        if mesh is not None:
+            self._setup_mesh(mesh, quantize)
+        # Tokenizer: explicit path > the HF checkpoint's tokenizer.json >
+        # hermetic byte-level (vocab 256) default.
+        if tokenizer_path:
+            self.tokenizer = tokenizer_lib.load_tokenizer(tokenizer_path)
+        elif hf_dir:
+            # No silent byte-level fallback here: serving a 128k-vocab
+            # checkpoint through the 256-vocab ByteTokenizer would return
+            # mojibake with HTTP 200. load_tokenizer raises loudly (with
+            # conversion instructions) when tokenizer.json is missing.
+            from skypilot_tpu.models import hf_import
+            self.tokenizer = tokenizer_lib.load_tokenizer(
+                hf_dir, eos_extra=hf_import.hf_eos_ids(hf_dir))
+            logger.info(f'Loaded tokenizer.json from {hf_dir} '
+                        f'(chat family: {self.tokenizer.chat_family}, '
+                        f'eos ids: {self.tokenizer.eos_ids}).')
+        else:
+            self.tokenizer = tokenizer_lib.ByteTokenizer()
         # Created by start() on the SERVING event loop: an asyncio.Queue
         # binds to the loop that first awaits it, and the engine object
         # may outlive a loop (tests; server restarts).
@@ -126,12 +234,65 @@ class InferenceEngine:
         self._state_ready = False
         self.warm = False
         self.step_count = 0          # observability + tests
+        self.tokens_generated = 0
+        self.requests_total = 0
+        self.rejected_total = 0
+
+    def _setup_mesh(self, mesh, quantize: Optional[str]) -> None:
+        """Place params on a named mesh with the family's sharding rules;
+        GSPMD then inserts TP collectives inside the step/admit jits (the
+        cache is sharded by _reset_device_state: batch over data/fsdp,
+        kv-heads over tensor — the same layout training uses, so decode
+        collectives ride ICI exactly like the training step's)."""
+        import jax
+        from jax.sharding import NamedSharding
+        from skypilot_tpu.models import mla, module_for
+        from skypilot_tpu.parallel import MeshSpec, build_mesh
+        from skypilot_tpu.parallel import sharding as sharding_lib
+        if quantize:
+            raise ValueError('--quantize int8 is single-device serving '
+                             '(QuantizedWeight trees have no sharding '
+                             'rules); drop --mesh or --quantize.')
+        if self._decode is mla:
+            raise NotImplementedError(
+                'mesh serving for MLA (latent-cache) models is not wired '
+                'yet; serve dense/MoE families sharded or MLA '
+                'single-device.')
+        if isinstance(mesh, str):
+            mesh = parse_mesh_arg(mesh)
+        if isinstance(mesh, MeshSpec):
+            mesh = build_mesh(mesh)
+        self.mesh = mesh
+        shape = dict(mesh.shape)
+        mod = module_for(self.cfg)
+        mod.validate_divisibility(self.cfg, shape)
+        dp = shape.get('data', 1) * shape.get('fsdp', 1)
+        if MAX_BATCH % dp != 0:
+            raise ValueError(f'engine batch {MAX_BATCH} not divisible by '
+                             f'data*fsdp={dp} (set SKYTPU_ENGINE_MAX_BATCH '
+                             f'to a multiple)')
+        rules = sharding_lib.Rules()
+        specs = mod.param_specs(self.cfg, rules)
+        self.params = jax.device_put(
+            self.params,
+            jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                         is_leaf=lambda x: isinstance(
+                             x, jax.sharding.PartitionSpec)))
+        logger.info(f'Serving on mesh {shape} '
+                    f'({mesh.devices.size} devices).')
 
     def start(self) -> None:
         """Bind the batcher to the current event loop (call at server
         startup)."""
-        self._queue = asyncio.Queue()
+        self._queue = asyncio.Queue(maxsize=MAX_QUEUE)
         asyncio.create_task(self.batch_loop())
+
+    # -- observability -----------------------------------------------------
+    def queue_depth(self) -> int:
+        return self._queue.qsize() if self._queue is not None else 0
+
+    def in_flight(self) -> int:
+        return sum(1 for s in getattr(self, 'slots', []) if s is not None)
 
     # -- device state ------------------------------------------------------
     def _reset_device_state(self) -> None:
@@ -144,6 +305,17 @@ class InferenceEngine:
         import numpy as np
         self.cache = self._decode.init_cache(self.cfg, MAX_BATCH,
                                              self.max_len)
+        if self.mesh is not None:
+            # KVCache k/v are [L, B, T, KH, hd]: batch over data/fsdp,
+            # kv-heads over tensor (matches the training rule table, so
+            # decode's attention contractions stay local per TP shard).
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            kv = NamedSharding(self.mesh,
+                               P(None, ('data', 'fsdp'), None, 'tensor',
+                                 None))
+            ln = NamedSharding(self.mesh, P(('data', 'fsdp')))
+            self.cache = jax.device_put(
+                self.cache, type(self.cache)(k=kv, v=kv, length=ln))
         self.rng = jax.random.PRNGKey(int(time.time_ns()) % (2**31))
         self.slots: List[Optional[Dict[str, Any]]] = [None] * MAX_BATCH
         self.last = np.zeros(MAX_BATCH, np.int32)
@@ -219,27 +391,73 @@ class InferenceEngine:
         self._admit_jit = admit
         self._state_ready = True
 
-    def warmup(self) -> None:
-        """Compile the admit (16-bucket) + BOTH step programs (k=1 and
-        k=MAX_STEP_CHUNK) through the real code path, then free the
-        warmup slot; /health flips only after — no client request may
-        ever hit a fresh XLA compile."""
+    def warmup(self, buckets: Optional[List[int]] = None) -> None:
+        """Compile BOTH step programs (k=1 and k=MAX_STEP_CHUNK) plus the
+        admit program for each prompt bucket in `buckets` (default: the
+        16-token bucket) through the real code path, then free the warmup
+        slots; /health flips only after. Step programs never recompile
+        after this; admit compiles once per prompt bucket — warm the
+        buckets your traffic uses (--warm-buckets all) to guarantee no
+        client request ever hits a fresh XLA compile."""
         self._ensure_state()
         self._admit((list(range(1, 9)), MAX_STEP_CHUNK + 2, 0.0, None,
-                     None, None))
+                     None, (), None, None))
         self._step_once()      # k = MAX_STEP_CHUNK (remaining is large)
         self._step_once()      # k = 1 (remaining == 1)
         self.slots = [None] * MAX_BATCH
+        for b in (buckets or []):
+            # b == max_len is unreachable by traffic (_check_len needs
+            # bucket + max_new <= max_len with max_new >= 1) — don't pay
+            # an XLA compile for it.
+            if b <= 16 or b >= self.max_len:
+                continue
+            self._admit((list(range(1, b + 1)), 1, 0.0, None, None, (),
+                         None, None))
+            self.slots = [None] * MAX_BATCH
+        self.last[:] = 0
         self.warm = True
-        logger.info('Engine warm (admit + step programs compiled).')
+        logger.info('Engine warm (step + admit programs compiled; buckets: '
+                    f'{sorted(set([16] + list(buckets or [])))}).')
+
+    def all_buckets(self) -> List[int]:
+        """Every admissible prompt bucket (for --warm-buckets all) —
+        strictly below max_len: a bucket-sized prompt still needs room
+        for at least one generated token."""
+        out, b = [], 16
+        while b < self.max_len:
+            out.append(b)
+            b *= 2
+        return out
 
     # -- continuous batching ----------------------------------------------
+    def submit_nowait(self, tokens: List[int], max_new: int,
+                      temperature: float, top_k: Optional[int],
+                      top_p: Optional[float],
+                      stop_ids: Tuple[int, ...] = (),
+                      stream_q: Optional[asyncio.Queue] = None
+                      ) -> asyncio.Future:
+        """Enqueue a request; returns the future resolving to
+        (tokens, finish_reason). Raises EngineOverloaded when the bounded
+        admission queue is full (surfaced as 429) — the queue never grows
+        without limit under overload."""
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        try:
+            self._queue.put_nowait((tokens, max_new, temperature, top_k,
+                                    top_p, stop_ids, stream_q, fut))
+        except asyncio.QueueFull:
+            self.rejected_total += 1
+            raise EngineOverloaded(
+                f'admission queue full ({MAX_QUEUE} waiting)') from None
+        self.requests_total += 1
+        return fut
+
     async def submit(self, tokens: List[int], max_new: int,
                      temperature: float, top_k: Optional[int],
-                     top_p: Optional[float]) -> List[int]:
-        fut: asyncio.Future = asyncio.get_running_loop().create_future()
-        await self._queue.put((tokens, max_new, temperature, top_k, top_p,
-                               fut))
+                     top_p: Optional[float],
+                     stop_ids: Tuple[int, ...] = ()
+                     ) -> Tuple[List[int], str]:
+        fut = self.submit_nowait(tokens, max_new, temperature, top_k,
+                                 top_p, stop_ids=stop_ids)
         return await fut
 
     def _free_slot(self) -> Optional[int]:
@@ -251,7 +469,8 @@ class InferenceEngine:
     def _admit(self, item) -> None:
         """Prefill a request into a free slot (device work: call off-loop)."""
         jnp = self._jnp
-        tokens, max_new, temperature, top_k, top_p, fut = item
+        (tokens, max_new, temperature, top_k, top_p, stop_ids, stream_q,
+         fut) = item
         slot = self._free_slot()
         assert slot is not None
         s = _bucket(len(tokens))
@@ -266,7 +485,18 @@ class InferenceEngine:
             jnp.float32(self.topp[slot]), self.rng)
         first = int(first)
         self.last[slot] = first
-        self.slots[slot] = {'fut': fut, 'want': max_new, 'out': [first]}
+        stop = frozenset(stop_ids or ())
+        entry = {'fut': fut, 'want': max_new, 'out': [],
+                 'stop': stop, 'stream': stream_q, 'sent': 0,
+                 'finish': None}
+        if first in stop:
+            entry['finish'] = 'stop'
+        else:
+            entry['out'].append(first)
+            self.tokens_generated += 1
+            if len(entry['out']) >= max_new:
+                entry['finish'] = 'length'
+        self.slots[slot] = entry
 
     def _step_once(self) -> None:
         """Decode step(s) over the whole slot pool (device work).
@@ -298,18 +528,38 @@ class InferenceEngine:
             if s is None:
                 continue
             for t in range(k):
-                if len(s['out']) < s['want']:
-                    s['out'].append(int(toks[t][i]))
-                    self.last[i] = int(toks[t][i])
+                if s['finish'] is not None:
+                    break
+                tok = int(toks[t][i])
+                self.last[i] = tok
+                if tok in s['stop']:
+                    # EOS/stop token: excluded from the output (OpenAI
+                    # semantics), generation for this row is done.
+                    s['finish'] = 'stop'
+                    break
+                s['out'].append(tok)
+                self.tokens_generated += 1
+                if len(s['out']) >= s['want']:
+                    s['finish'] = 'length'
 
-    def _finish_done(self) -> None:
-        """Resolve futures of slots that produced all they asked for (runs
-        on the event loop)."""
+    def _publish(self) -> None:
+        """Push new tokens to streaming consumers and resolve finished
+        slots (runs on the event loop, between device calls — stream
+        queues are plain asyncio objects, never touched from a thread)."""
         for i, s in enumerate(self.slots):
-            if s is not None and len(s['out']) >= s['want']:
+            if s is None:
+                continue
+            q = s['stream']
+            if q is not None and s['sent'] < len(s['out']):
+                for tok in s['out'][s['sent']:]:
+                    q.put_nowait(tok)
+                s['sent'] = len(s['out'])
+            if s['finish'] is not None:
+                if q is not None:
+                    q.put_nowait(None)           # end-of-stream sentinel
                 fut = s['fut']
                 if fut is not None and not fut.done():
-                    fut.set_result(s['out'][:s['want']])
+                    fut.set_result((s['out'], s['finish']))
                 self.slots[i] = None
 
     async def batch_loop(self) -> None:
@@ -326,7 +576,7 @@ class InferenceEngine:
                     await asyncio.to_thread(self._admit, item)
                 except Exception as e:  # pylint: disable=broad-except
                     self._fail_all(e, extra=item)
-                self._finish_done()     # want==1 resolves without a step
+                self._publish()         # want==1 resolves without a step
                 continue
             while self._free_slot() is not None and not self._queue.empty():
                 item = self._queue.get_nowait()
@@ -334,12 +584,13 @@ class InferenceEngine:
                     await asyncio.to_thread(self._admit, item)
                 except Exception as e:  # pylint: disable=broad-except
                     self._fail_all(e, extra=item)
+            self._publish()             # first tokens stream immediately
             try:
                 await asyncio.to_thread(self._step_once)
             except Exception as e:  # pylint: disable=broad-except
                 self._fail_all(e)
                 continue
-            self._finish_done()
+            self._publish()
 
     def _fail_all(self, e: Exception, extra=None) -> None:
         """Fail every in-flight request and rebuild the device state: the
@@ -347,14 +598,114 @@ class InferenceEngine:
         unusable (see _reset_device_state)."""
         logger.warning(f'Engine step/admit failed; resetting slot pool: '
                        f'{e}')
-        if extra is not None and extra[-1] is not None \
-                and not extra[-1].done():
-            extra[-1].set_exception(e)
+
+        def fail(fut, stream_q):
+            if stream_q is not None:
+                stream_q.put_nowait(None)
+            if fut is not None and not fut.done():
+                fut.set_exception(e)
+
+        if extra is not None:
+            fail(extra[-1], extra[-2])
         for s in self.slots:
-            if s is not None and s['fut'] is not None \
-                    and not s['fut'].done():
-                s['fut'].set_exception(e)
+            if s is not None:
+                fail(s['fut'], s['stream'])
         self._reset_device_state()
+
+
+# ---------------------------------------------------------------------------
+# HTTP layer
+# ---------------------------------------------------------------------------
+
+def _openai_error(web, msg: str, status: int = 400,
+                  err_type: str = 'invalid_request_error'):
+    return web.json_response(
+        {'error': {'message': msg, 'type': err_type}}, status=status)
+
+
+def _resolve_prompt(engine: InferenceEngine, prompt) -> List[int]:
+    """OpenAI `prompt` field → token ids (str, [int], or single-[str])."""
+    if isinstance(prompt, list) and prompt and all(
+            isinstance(t, int) for t in prompt):
+        return [int(t) for t in prompt]          # token-id prompt
+    if isinstance(prompt, list):
+        if len(prompt) != 1:
+            raise ValueError('only a single prompt per request is '
+                             'supported')
+        prompt = prompt[0]
+    return [int(t) for t in engine.tokenizer.encode(str(prompt))]
+
+
+def _check_len(engine: InferenceEngine, tokens: List[int],
+               max_new: int) -> Optional[str]:
+    # The batcher pads prompts up to a power-of-two bucket; admission is
+    # checked against the bucketed length so a grouped request can always
+    # be served in full.
+    if _bucket(len(tokens)) + max_new > engine.max_len:
+        return (f'bucketed prompt ({_bucket(len(tokens))}) + max new '
+                f'tokens exceeds max_len {engine.max_len}')
+    return None
+
+
+async def _sse_response(request, engine: InferenceEngine,
+                        tokens: List[int], max_new: int, sampling,
+                        stop_ids, make_chunks, web):
+    """Shared SSE plumbing for /v1/completions and /v1/chat/completions.
+
+    `make_chunks(delta_text, finish_reason)` yields the JSON payload(s)
+    for one event; finish_reason is set on the final content-bearing
+    event, per the OpenAI streaming contract. Ends with `data: [DONE]`.
+    """
+    from skypilot_tpu.data.tokenizer import StreamDecoder
+    temperature, top_k, top_p = sampling
+    stream_q: asyncio.Queue = asyncio.Queue()
+    try:
+        fut = engine.submit_nowait(tokens, max_new, temperature, top_k,
+                                   top_p, stop_ids=stop_ids,
+                                   stream_q=stream_q)
+    except EngineOverloaded as e:
+        return _openai_error(web, str(e), status=429,
+                             err_type='overloaded_error')
+    resp = web.StreamResponse(headers={
+        'Content-Type': 'text/event-stream',
+        'Cache-Control': 'no-cache',
+        'X-Accel-Buffering': 'no',
+    })
+    await resp.prepare(request)
+
+    async def send(payload) -> None:
+        await resp.write(b'data: ' +
+                         json_lib.dumps(payload).encode() + b'\n\n')
+
+    decoder = StreamDecoder(engine.tokenizer)
+    try:
+        for payload in make_chunks(None, None, first=True):
+            await send(payload)
+        while True:
+            tok = await stream_q.get()
+            if tok is None:
+                break
+            delta = decoder.feed([tok])
+            if delta:
+                for payload in make_chunks(delta, None):
+                    await send(payload)
+        out, finish = await fut
+        del out
+        tail = decoder.flush()
+        for payload in make_chunks(tail if tail else None, finish):
+            await send(payload)
+        await resp.write(b'data: [DONE]\n\n')
+    except Exception as e:  # pylint: disable=broad-except
+        # Mid-stream failure: the status line already went out; the only
+        # honest signal left is an error event + connection close.
+        logger.warning(f'SSE stream aborted: {e}')
+        try:
+            await send({'error': {'message': str(e),
+                                  'type': 'server_error'}})
+        except ConnectionError:
+            pass
+    await resp.write_eof()
+    return resp
 
 
 def build_app(engine: InferenceEngine):
@@ -364,14 +715,38 @@ def build_app(engine: InferenceEngine):
         del request
         if not engine.warm:
             return web.json_response({'status': 'warming'}, status=503)
-        return web.json_response({'status': 'ok'})
+        return web.json_response({
+            'status': 'ok',
+            'queue_depth': engine.queue_depth(),
+            'in_flight': engine.in_flight(),
+        })
+
+    async def metrics(request):
+        """Prometheus text format — consumed by the serve LB's
+        instance-aware policy and any scraper."""
+        del request
+        lines = [
+            '# TYPE skytpu_engine_queue_depth gauge',
+            f'skytpu_engine_queue_depth {engine.queue_depth()}',
+            '# TYPE skytpu_engine_in_flight gauge',
+            f'skytpu_engine_in_flight {engine.in_flight()}',
+            '# TYPE skytpu_engine_steps_total counter',
+            f'skytpu_engine_steps_total {engine.step_count}',
+            '# TYPE skytpu_engine_tokens_total counter',
+            f'skytpu_engine_tokens_total {engine.tokens_generated}',
+            '# TYPE skytpu_engine_requests_total counter',
+            f'skytpu_engine_requests_total {engine.requests_total}',
+            '# TYPE skytpu_engine_rejected_total counter',
+            f'skytpu_engine_rejected_total {engine.rejected_total}',
+        ]
+        return web.Response(text='\n'.join(lines) + '\n',
+                            content_type='text/plain')
 
     async def generate(request):
         body = await request.json()
         if 'text' in body:
-            from skypilot_tpu.data import loader as loader_lib
-            tokens = [int(t) for t in
-                      loader_lib.tokenize_text(body['text'])]
+            tokens = [int(t)
+                      for t in engine.tokenizer.encode(str(body['text']))]
         else:
             tokens = [int(t) for t in body['tokens']]
         if not tokens:
@@ -380,85 +755,187 @@ def build_app(engine: InferenceEngine):
         if max_new < 1:
             return web.json_response({'error': 'max_new_tokens < 1'},
                                      status=400)
-        # The batcher pads prompts up to a power-of-two bucket; admission
-        # is checked against the bucketed length so a grouped request can
-        # always be served in full.
-        if _bucket(len(tokens)) + max_new > engine.max_len:
-            return web.json_response(
-                {'error': f'bucketed prompt ({_bucket(len(tokens))}) + '
-                          f'max_new_tokens exceeds max_len '
-                          f'{engine.max_len}'}, status=400)
+        msg = _check_len(engine, tokens, max_new)
+        if msg:
+            return web.json_response({'error': msg}, status=400)
         # Sampling params are validated/clamped at admission and passed as
         # PER-ROW runtime arrays — untrusted values can neither trigger a
         # recompile nor fail the whole batch (top_k is further clamped to
         # vocab inside decode.select_token_per_row).
         try:
             temperature, top_k, top_p = _parse_sampling(body)
+            stop_ids = (tuple(int(i) for i in body['stop_token_ids'])
+                        if 'stop_token_ids' in body else ())
         except (TypeError, ValueError) as e:
             return web.json_response({'error': f'bad sampling params: {e}'},
                                      status=400)
-        out = await engine.submit(tokens, max_new, temperature, top_k,
-                                  top_p)
-        resp: Dict[str, Any] = {'tokens': out}
+        try:
+            out, finish = await engine.submit(tokens, max_new, temperature,
+                                              top_k, top_p,
+                                              stop_ids=stop_ids)
+        except EngineOverloaded as e:
+            return web.json_response({'error': str(e)}, status=429)
+        resp: Dict[str, Any] = {'tokens': out, 'finish_reason': finish}
         if 'text' in body:
-            resp['text'] = _bytes_to_text(out)
+            resp['text'] = engine.tokenizer.decode(out)
         return web.json_response(resp)
 
     async def openai_completions(request):
         """OpenAI-compatible completions (reference users serve through
         vLLM's OpenAI server — llm/qwen, llm/mixtral recipes curl
-        /v1/completions; non-streaming clients work against this engine
-        unchanged). Byte-level tokenizer; single choice; token-id list
-        prompts honored; stream rejected loudly."""
+        /v1/completions; those clients work against this engine
+        unchanged). Real tokenizer when serving an HF checkpoint;
+        token-id list prompts honored; SSE streaming via stream=true."""
 
         def bad(msg, status=400):
-            return web.json_response(
-                {'error': {'message': msg,
-                           'type': 'invalid_request_error'}}, status=status)
+            return _openai_error(web, msg, status=status)
 
         body = await request.json()
         if not isinstance(body, dict):
             return bad('request body must be a JSON object')
-        if body.get('stream'):
-            return bad('streaming is not supported; use stream=false')
-        prompt = body.get('prompt', '')
         try:
-            if isinstance(prompt, list) and prompt and all(
-                    isinstance(t, int) for t in prompt):
-                tokens = [int(t) for t in prompt]   # token-id prompt
-            elif isinstance(prompt, list):
-                if len(prompt) != 1:
-                    return bad('only a single prompt per request is '
-                               'supported')
-                prompt = prompt[0]
-                from skypilot_tpu.data import loader as loader_lib
-                tokens = [int(t)
-                          for t in loader_lib.tokenize_text(str(prompt))]
-            else:
-                from skypilot_tpu.data import loader as loader_lib
-                tokens = [int(t)
-                          for t in loader_lib.tokenize_text(str(prompt))]
+            tokens = _resolve_prompt(engine, body.get('prompt', ''))
             if not tokens:
-                return bad('empty prompt')
+                raise ValueError('empty prompt')
             max_new = int(body.get('max_tokens', 16))
             if max_new < 1:
                 raise ValueError('max_tokens must be >= 1')
-            temperature, top_k, top_p = _parse_sampling(
-                body, default_temperature=1.0)
+            sampling = _parse_sampling(body, default_temperature=1.0)
+            stop_ids = _parse_stop_ids(body, engine.tokenizer)
+            stop_strings = body.get('stop')
+            if stop_strings is not None and body.get('stream'):
+                raise ValueError('stop strings are not supported with '
+                                 'stream=true; use stop_token_ids')
+            _truncate_at_stop_strings('', stop_strings)   # validate shape
         except (TypeError, ValueError) as e:
             return bad(f'invalid request: {e}')
-        if _bucket(len(tokens)) + max_new > engine.max_len:
-            return bad(f'prompt + max_tokens exceeds max_len '
-                       f'{engine.max_len}')
-        out = await engine.submit(tokens, max_new, temperature, top_k,
-                                  top_p)
+        msg = _check_len(engine, tokens, max_new)
+        if msg:
+            return bad(msg)
+        created = int(time.time())
+        rid = f'cmpl-{time.time_ns()}'
+        model = body.get('model', engine.model_name)
+
+        if body.get('stream'):
+            def make_chunks(delta, finish, first=False):
+                if first:
+                    return
+                if delta is None and finish is None:
+                    return
+                yield {
+                    'id': rid, 'object': 'text_completion',
+                    'created': created, 'model': model,
+                    'choices': [{'text': delta or '', 'index': 0,
+                                 'logprobs': None,
+                                 'finish_reason': finish}],
+                }
+            return await _sse_response(request, engine, tokens, max_new,
+                                       sampling, stop_ids, make_chunks,
+                                       web)
+
+        try:
+            out, finish = await engine.submit(tokens, max_new, *sampling,
+                                              stop_ids=stop_ids)
+        except EngineOverloaded as e:
+            return _openai_error(web, str(e), status=429,
+                                 err_type='overloaded_error')
+        text = engine.tokenizer.decode(out)
+        text, cut = _truncate_at_stop_strings(text, stop_strings)
+        if cut:
+            finish = 'stop'
         return web.json_response({
-            'id': f'cmpl-{time.time_ns()}',
+            'id': rid,
             'object': 'text_completion',
-            'created': int(time.time()),
-            'model': body.get('model', 'skytpu'),
-            'choices': [{'text': _bytes_to_text(out), 'index': 0,
-                         'logprobs': None, 'finish_reason': 'length'}],
+            'created': created,
+            'model': model,
+            'choices': [{'text': text, 'index': 0, 'logprobs': None,
+                         'finish_reason': finish}],
+            'usage': {'prompt_tokens': len(tokens),
+                      'completion_tokens': len(out),
+                      'total_tokens': len(tokens) + len(out)},
+        })
+
+    async def openai_chat(request):
+        """OpenAI-compatible chat completions with per-family templating
+        (reference flagship: llm/qwen/README.md:60 curls
+        /v1/chat/completions against its serve endpoint). The template is
+        chosen from the tokenizer's special tokens (llama3 headers /
+        ChatML / plain) — see data/tokenizer.py."""
+        from skypilot_tpu.data import tokenizer as tokenizer_lib
+
+        def bad(msg, status=400):
+            return _openai_error(web, msg, status=status)
+
+        body = await request.json()
+        if not isinstance(body, dict):
+            return bad('request body must be a JSON object')
+        try:
+            prompt_text = tokenizer_lib.apply_chat_template(
+                body.get('messages'), engine.tokenizer.chat_family)
+            tokens = [int(t)
+                      for t in engine.tokenizer.encode(prompt_text)]
+            if not tokens:
+                raise ValueError('empty prompt after templating')
+            max_new = int(body.get('max_tokens',
+                                   body.get('max_completion_tokens', 256)))
+            if max_new < 1:
+                raise ValueError('max_tokens must be >= 1')
+            sampling = _parse_sampling(body, default_temperature=1.0)
+            stop_ids = _parse_stop_ids(body, engine.tokenizer)
+            stop_strings = body.get('stop')
+            if stop_strings is not None and body.get('stream'):
+                raise ValueError('stop strings are not supported with '
+                                 'stream=true; use stop_token_ids')
+            _truncate_at_stop_strings('', stop_strings)
+        except (TypeError, ValueError) as e:
+            return bad(f'invalid request: {e}')
+        msg = _check_len(engine, tokens, max_new)
+        if msg:
+            return bad(msg)
+        created = int(time.time())
+        rid = f'chatcmpl-{time.time_ns()}'
+        model = body.get('model', engine.model_name)
+
+        if body.get('stream'):
+            def make_chunks(delta, finish, first=False):
+                base = {'id': rid, 'object': 'chat.completion.chunk',
+                        'created': created, 'model': model}
+                if first:
+                    yield {**base, 'choices': [{
+                        'index': 0, 'delta': {'role': 'assistant',
+                                              'content': ''},
+                        'finish_reason': None}]}
+                    return
+                if delta is not None:
+                    yield {**base, 'choices': [{
+                        'index': 0, 'delta': {'content': delta},
+                        'finish_reason': None}]}
+                if finish is not None:
+                    yield {**base, 'choices': [{
+                        'index': 0, 'delta': {},
+                        'finish_reason': finish}]}
+            return await _sse_response(request, engine, tokens, max_new,
+                                       sampling, stop_ids, make_chunks,
+                                       web)
+
+        try:
+            out, finish = await engine.submit(tokens, max_new, *sampling,
+                                              stop_ids=stop_ids)
+        except EngineOverloaded as e:
+            return _openai_error(web, str(e), status=429,
+                                 err_type='overloaded_error')
+        text = engine.tokenizer.decode(out)
+        text, cut = _truncate_at_stop_strings(text, stop_strings)
+        if cut:
+            finish = 'stop'
+        return web.json_response({
+            'id': rid,
+            'object': 'chat.completion',
+            'created': created,
+            'model': model,
+            'choices': [{'index': 0,
+                         'message': {'role': 'assistant', 'content': text},
+                         'finish_reason': finish}],
             'usage': {'prompt_tokens': len(tokens),
                       'completion_tokens': len(out),
                       'total_tokens': len(tokens) + len(out)},
@@ -468,15 +945,17 @@ def build_app(engine: InferenceEngine):
         del request
         return web.json_response({
             'object': 'list',
-            'data': [{'id': 'skytpu', 'object': 'model',
+            'data': [{'id': engine.model_name, 'object': 'model',
                       'owned_by': 'skytpu'}],
         })
 
     app = web.Application()
     app.router.add_get('/health', health)
     app.router.add_get('/', health)
+    app.router.add_get('/metrics', metrics)
     app.router.add_post('/generate', generate)
     app.router.add_post('/v1/completions', openai_completions)
+    app.router.add_post('/v1/chat/completions', openai_chat)
     app.router.add_get('/v1/models', openai_models)
 
     async def _start(app_):
@@ -490,20 +969,45 @@ def build_app(engine: InferenceEngine):
 def main() -> None:
     from aiohttp import web
     parser = argparse.ArgumentParser(prog='skytpu-engine')
-    parser.add_argument('--model', default='llama-1b')
-    parser.add_argument('--ckpt-dir', default=None)
+    parser.add_argument('--model', default=None,
+                        help='Preset name (models.list_presets); optional '
+                             'when --hf-dir is given.')
+    parser.add_argument('--ckpt-dir', default=None,
+                        help='Orbax trainer checkpoint to serve.')
+    parser.add_argument('--hf-dir', default=None,
+                        help='HF checkpoint directory (safetensors + '
+                             'tokenizer.json) to serve.')
+    parser.add_argument('--tokenizer', default=None,
+                        help='Path to a tokenizer.json (overrides the '
+                             'one in --hf-dir).')
     parser.add_argument('--max-len', type=int, default=None)
+    parser.add_argument('--mesh', default=None,
+                        help="Shard serving over a device mesh, e.g. "
+                             "'tensor=8' or 'data=2,tensor=4' (the "
+                             'reference serves 8-chip TP replicas).')
     parser.add_argument('--quantize', choices=['int8'], default=None,
                         help='Weight-only quantization for serving '
                              '(dense Llama-family models).')
+    parser.add_argument('--warm-buckets', default='16',
+                        help="Comma-separated prompt buckets to pre-"
+                             "compile, or 'all' (guarantees no request "
+                             'ever hits a fresh XLA compile).')
     parser.add_argument('--port', type=int,
                         default=int(os.environ.get('SKYTPU_SERVE_PORT',
                                                    '8000')))
     parser.add_argument('--host', default='0.0.0.0')
     args = parser.parse_args()
-    engine = InferenceEngine(args.model, ckpt_dir=args.ckpt_dir,
-                             max_len=args.max_len, quantize=args.quantize)
-    engine.warmup()   # readiness flips only once serving is fast
+    engine = InferenceEngine(args.model or (None if args.hf_dir
+                                            else 'llama-1b'),
+                             ckpt_dir=args.ckpt_dir, hf_dir=args.hf_dir,
+                             tokenizer_path=args.tokenizer,
+                             max_len=args.max_len, quantize=args.quantize,
+                             mesh=args.mesh)
+    if args.warm_buckets == 'all':
+        buckets = engine.all_buckets()
+    else:
+        buckets = [int(b) for b in args.warm_buckets.split(',') if b]
+    engine.warmup(buckets=buckets)   # readiness flips only once fast
     web.run_app(build_app(engine), host=args.host, port=args.port,
                 print=None)
 

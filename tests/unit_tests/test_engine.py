@@ -214,12 +214,25 @@ class TestEngine:
                 'prompt': [1, 2, 3, 4], 'max_tokens': 3, 'temperature': 0})
             assert ids.status == 200
             assert (await ids.json())['usage']['prompt_tokens'] == 4
-            # Garbage max_tokens / multi-prompt / stream fail with 400s,
-            # never 500s.
+            # Garbage max_tokens / multi-prompt fail with 400s, never 500s.
             for payload in ({'prompt': 'x', 'max_tokens': None},
-                            {'prompt': ['a', 'b'], 'max_tokens': 2},
-                            {'prompt': 'x', 'max_tokens': 2,
-                             'stream': True}):
+                            {'prompt': ['a', 'b'], 'max_tokens': 2}):
                 r = await client.post('/v1/completions', json=payload)
                 assert r.status == 400, payload
+            # SSE streaming (byte tokenizer): deltas concatenate to the
+            # non-streamed text.
+            ns = await client.post('/v1/completions', json={
+                'prompt': 'hey', 'max_tokens': 4, 'temperature': 0})
+            want_text = (await ns.json())['choices'][0]['text']
+            r = await client.post('/v1/completions', json={
+                'prompt': 'hey', 'max_tokens': 4, 'temperature': 0,
+                'stream': True})
+            assert r.status == 200
+            raw = (await r.content.read()).decode()
+            assert raw.rstrip().endswith('data: [DONE]')
+            import json as json_mod
+            texts = [json_mod.loads(b[6:])['choices'][0]['text']
+                     for b in raw.split('\n\n')
+                     if b.startswith('data: ') and b != 'data: [DONE]']
+            assert ''.join(texts) == want_text
         _with_client(engine, fn)

@@ -1,0 +1,118 @@
+"""Sharded serving through the HTTP engine (VERDICT r3 item 2).
+
+The reference's serve replicas are 8-chip TP instances (vLLM/JetStream
+on v5e-8, reference examples/tpu/v6e/README.md:119-127). Here the native
+engine takes --mesh tensor=N and runs prefill/decode under GSPMD; this
+test drives the FULL HTTP path on the 8-virtual-CPU-device mesh
+(conftest.py) and asserts sharded greedy tokens == single-device greedy
+tokens, with params actually placed sharded.
+"""
+import asyncio
+import dataclasses
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient
+from aiohttp.test_utils import TestServer as AioTestServer
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.serve import engine as engine_lib
+
+
+def _run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def _with_client(engine, fn):
+    async def inner():
+        client = TestClient(AioTestServer(engine_lib.build_app(engine)))
+        await client.start_server()
+        try:
+            return await fn(client)
+        finally:
+            await client.close()
+    return _run(inner())
+
+
+def _make(mesh=None):
+    eng = engine_lib.InferenceEngine('llama-debug', max_len=64, mesh=mesh)
+    # fp32: the sharded == single-device equality below is exact only
+    # when reduction precision can't flip an argmax.
+    eng.cfg = dataclasses.replace(eng.cfg, dtype=jnp.float32)
+    eng.warmup()
+    return eng
+
+
+async def _generate(client, tokens, n):
+    r = await client.post('/generate', json={'tokens': tokens,
+                                             'max_new_tokens': n})
+    assert r.status == 200
+    return (await r.json())['tokens']
+
+
+class TestShardedEngine:
+
+    def test_parse_mesh_arg(self):
+        spec = engine_lib.parse_mesh_arg('data=2,tensor=4')
+        assert spec.data == 2 and spec.tensor == 4
+        with pytest.raises(ValueError):
+            engine_lib.parse_mesh_arg('bogus_axis=2')
+        with pytest.raises(ValueError):
+            engine_lib.parse_mesh_arg('tensor:2')
+
+    def test_sharded_matches_single_device(self):
+        assert len(jax.devices()) == 8, 'conftest must force 8 CPU devices'
+        single = _make()
+        sharded = _make(mesh='data=2,fsdp=2,tensor=2')
+
+        # Params really are distributed: a TP-sharded projection must not
+        # be fully replicated on the mesh.
+        wq = sharded.params['layers']['wq']
+        assert not wq.sharding.is_fully_replicated
+        assert wq.sharding.mesh.shape['tensor'] == 2
+        assert sharded.cache.k.sharding.spec[3] == 'tensor'
+
+        prompts = [[1, 2, 3, 4, 5], [7] * 9, [3, 1, 4, 1, 5, 9, 2, 6]]
+
+        async def collect(client):
+            return await asyncio.gather(
+                *[_generate(client, p, 8) for p in prompts])
+
+        want = _with_client(single, collect)
+        got = _with_client(sharded, collect)
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(np.asarray(g), np.asarray(w))
+
+    def test_openai_surface_on_sharded_mesh(self):
+        sharded = _make(mesh='tensor=2,data=4')
+
+        async def fn(client):
+            r = await client.post('/v1/chat/completions', json={
+                'messages': [{'role': 'user', 'content': 'hi'}],
+                'max_tokens': 4, 'temperature': 0})
+            assert r.status == 200
+            body = await r.json()
+            assert body['choices'][0]['finish_reason'] in ('stop',
+                                                           'length')
+            h = await client.get('/health')
+            assert (await h.json())['status'] == 'ok'
+        _with_client(sharded, fn)
+
+    def test_mesh_guards(self):
+        # int8 quantized trees have no sharding rules → loud error.
+        with pytest.raises(ValueError, match='single-device'):
+            engine_lib.InferenceEngine('llama-debug', max_len=64,
+                                       quantize='int8', mesh='tensor=2')
+        # Indivisible model dims fail at init, not at first request.
+        with pytest.raises(ValueError, match='divisible'):
+            engine_lib.InferenceEngine('llama-debug', max_len=64,
+                                       mesh='tensor=8')   # kv_heads=2 % 8
+        with pytest.raises(NotImplementedError, match='MLA'):
+            engine_lib.InferenceEngine('mla-debug', max_len=64,
+                                       mesh='tensor=2')
