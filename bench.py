@@ -321,6 +321,214 @@ def run_decode_bench():
     }), flush=True)
 
 
+def run_serve_bench():
+    """Engine-path serve benchmark (SKYTPU_BENCH_METRIC=serve): spawns the
+    REAL HTTP engine (continuous batcher + admission + SSE) as a
+    subprocess and fires concurrent streaming requests at it, reporting
+    req/s + TTFT p50/p99 + TPOT p50 — the same quantities the reference
+    benches through vLLM/JetStream (examples/tpu/v6e/README.md:119-127,
+    BASELINE.md rows 3-7). The decode metric benches decode.generate;
+    this one includes every serving-path overhead."""
+    import asyncio
+    import socket
+
+    device = _get_device()
+    on_tpu = device.platform == 'tpu'
+    model = os.environ.get('SKYTPU_BENCH_SERVE_MODEL',
+                           'llama-1b' if on_tpu else 'llama-debug')
+    concurrency = int(os.environ.get('SKYTPU_BENCH_SERVE_CONCURRENCY', '8'))
+    n_requests = int(os.environ.get(
+        'SKYTPU_BENCH_SERVE_REQUESTS', '32' if on_tpu else '8'))
+    prompt_len = int(os.environ.get(
+        'SKYTPU_BENCH_SERVE_PROMPT', '128' if on_tpu else '8'))
+    new_tokens = int(os.environ.get(
+        'SKYTPU_BENCH_SERVE_NEW_TOKENS', '64' if on_tpu else '8'))
+    max_len = _next_pow2(prompt_len) + new_tokens + 16
+
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        port = s.getsockname()[1]
+    cmd = [sys.executable, '-m', 'skypilot_tpu.serve.engine',
+           '--model', model, '--max-len', str(max_len),
+           '--host', '127.0.0.1', '--port', str(port)]
+    mesh = os.environ.get('SKYTPU_BENCH_SERVE_MESH')
+    if mesh:
+        cmd += ['--mesh', mesh]
+    server = subprocess.Popen(cmd, stdout=sys.stderr, stderr=sys.stderr)
+    try:
+        stats = asyncio.run(_drive_serve_load(
+            port, concurrency, n_requests, prompt_len, new_tokens))
+    finally:
+        server.terminate()
+        try:
+            server.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            server.kill()
+    med = lambda xs: sorted(xs)[len(xs) // 2]
+    p99 = lambda xs: sorted(xs)[min(len(xs) - 1, int(len(xs) * 0.99))]
+    ttft, tpot, wall, n_ok = stats
+    req_s = n_ok / wall
+    print(f'serve: device={device.device_kind} model={model} '
+          f'conc={concurrency} reqs={n_ok}/{n_requests} '
+          f'prompt={prompt_len} new={new_tokens} wall={wall:.2f}s '
+          f'req/s={req_s:.2f} ttft_p50={med(ttft):.1f}ms '
+          f'ttft_p99={p99(ttft):.1f}ms tpot_p50={med(tpot):.2f}ms',
+          file=sys.stderr)
+    print(json.dumps({
+        'metric': 'serve_req_per_s',
+        'value': round(req_s, 2),
+        'unit': 'req/s',
+        'vs_baseline': None,   # reference serve rows are per-model HW runs
+        'ttft_ms_p50': round(med(ttft), 1),
+        'ttft_ms_p99': round(p99(ttft), 1),
+        'tpot_ms_p50': round(med(tpot), 2),
+        'completed': n_ok,
+        'device': device.device_kind,
+    }), flush=True)
+
+
+def _next_pow2(n: int) -> int:
+    b = 16
+    while b < n:
+        b *= 2
+    return b
+
+
+async def _drive_serve_load(port, concurrency, n_requests, prompt_len,
+                            new_tokens):
+    """Concurrent streaming clients; returns (ttft_ms[], tpot_ms[],
+    wall_s, n_ok). TTFT = first SSE content event; TPOT = inter-event
+    spacing after the first."""
+    import asyncio
+
+    import aiohttp
+
+    base = f'http://127.0.0.1:{port}'
+    deadline = time.time() + int(os.environ.get(
+        'SKYTPU_BENCH_SERVE_WARMUP_TIMEOUT', '600'))
+    async with aiohttp.ClientSession() as session:
+        while True:
+            try:
+                async with session.get(base + '/health') as r:
+                    if r.status == 200:
+                        break
+            except aiohttp.ClientError:
+                pass
+            if time.time() > deadline:
+                raise SystemExit('[bench] serve engine never became ready')
+            await asyncio.sleep(1.0)
+
+        ttft_ms, tpot_ms = [], []
+        n_ok = 0
+        sem = asyncio.Semaphore(concurrency)
+
+        async def one(i):
+            nonlocal n_ok
+            # Distinct prompts; token-id prompts skip tokenization noise.
+            prompt = [(i * 7 + j) % 250 + 1 for j in range(prompt_len)]
+            async with sem:
+                t0 = time.perf_counter()
+                first_t = last_t = None
+                n_events = 0
+                done = False
+                async with session.post(base + '/v1/completions', json={
+                        'prompt': prompt, 'max_tokens': new_tokens,
+                        'temperature': 0, 'ignore_eos': True,
+                        'stream': True}) as r:
+                    if r.status != 200:
+                        return
+                    async for raw in r.content:
+                        if not raw.startswith(b'data: '):
+                            continue
+                        if raw.strip() == b'data: [DONE]':
+                            done = True
+                            continue
+                        now = time.perf_counter()
+                        if first_t is None:
+                            first_t = now
+                        last_t = now
+                        n_events += 1
+                if not done:
+                    return
+                n_ok += 1
+                if first_t is not None and n_events >= 2:
+                    ttft_ms.append((first_t - t0) * 1e3)
+                    tpot_ms.append(
+                        (last_t - first_t) / (n_events - 1) * 1e3)
+
+        # One sequential warm request (prompt-bucket compile happens here,
+        # not inside the measured window).
+        await one(0)
+        ttft_ms.clear(), tpot_ms.clear()
+        n_ok = 0
+        t0 = time.perf_counter()
+        await asyncio.gather(*[one(i) for i in range(1, n_requests + 1)])
+        wall = time.perf_counter() - t0
+    if n_ok == 0 or not ttft_ms:
+        raise SystemExit('[bench] no serve request completed with '
+                         'measurable stream timings')
+    return ttft_ms, tpot_ms, wall, n_ok
+
+
+def run_kernelcheck():
+    """SKYTPU_BENCH_METRIC=kernelcheck: assert the Pallas flash kernel
+    matches the XLA reference fwd+bwd ON THE ATTACHED DEVICE, across a
+    geometry matrix (S, GQA groups, causal). On TPU this is the kernels'
+    hardware evidence (interpret-mode tests can't catch tiling bugs); on
+    CPU it degrades to interpret-mode and says so."""
+    import jax
+    import jax.numpy as jnp
+    from skypilot_tpu.ops.attention import attention as _attention
+
+    device = _get_device()
+    on_tpu = device.platform == 'tpu'
+    worst = 0.0
+    cases = 0
+    for s in (256, 1024):
+        for groups in (1, 4):
+            for causal in (True, False):
+                b, kh, d = 2, 2, 128
+                h = kh * groups
+                key = jax.random.PRNGKey(s * 31 + groups * 7 + causal)
+                kq, kk, kv, kg = jax.random.split(key, 4)
+                q = jax.random.normal(kq, (b, s, h, d), jnp.bfloat16)
+                k = jax.random.normal(kk, (b, s, kh, d), jnp.bfloat16)
+                v = jax.random.normal(kv, (b, s, kh, d), jnp.bfloat16)
+                ct = jax.random.normal(kg, (b, s, h, d), jnp.bfloat16)
+
+                def loss(impl, q=q, k=k, v=v, causal=causal, ct=ct):
+                    out = _attention(q, k, v, impl=impl, causal=causal)
+                    return jnp.sum(out.astype(jnp.float32) *
+                                   ct.astype(jnp.float32))
+
+                for fn in (lambda impl: _attention(
+                        q, k, v, impl=impl, causal=causal),
+                           lambda impl: jax.grad(
+                               lambda qq: loss(impl, q=qq))(q)):
+                    ref = fn('xla').astype(jnp.float32)
+                    got = fn('flash').astype(jnp.float32)
+                    scale = float(jnp.max(jnp.abs(ref))) or 1.0
+                    err = float(jnp.max(jnp.abs(got - ref))) / scale
+                    worst = max(worst, err)
+                    cases += 1
+    tol = 5e-2          # bf16 kernel vs fp32-softmax XLA, either backend
+    ok = worst < tol
+    print(f'kernelcheck: device={device.device_kind} cases={cases} '
+          f'worst_rel_err={worst:.2e} tol={tol} '
+          f'{"OK" if ok else "MISMATCH"}', file=sys.stderr)
+    print(json.dumps({
+        'metric': 'kernelcheck_max_rel_err',
+        'value': round(worst, 6),
+        'unit': 'rel_err',
+        'vs_baseline': None,
+        'cases': cases,
+        'passed': ok,
+        'device': device.device_kind,
+    }), flush=True)
+    if not ok:
+        raise SystemExit(4)
+
+
 def run_bench():
     import jax
     from skypilot_tpu.parallel import MeshSpec, build_mesh
@@ -375,8 +583,13 @@ if __name__ == '__main__':
         print(f'[bench] backend ok: {dev.device_kind} ({dev.platform})',
               file=sys.stderr)
     elif os.environ.get(CHILD_ENV) == '1':
-        if os.environ.get('SKYTPU_BENCH_METRIC') == 'decode':
+        metric = os.environ.get('SKYTPU_BENCH_METRIC')
+        if metric == 'decode':
             run_decode_bench()
+        elif metric == 'serve':
+            run_serve_bench()
+        elif metric == 'kernelcheck':
+            run_kernelcheck()
         else:
             run_bench()
     else:

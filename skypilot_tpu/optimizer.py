@@ -45,7 +45,9 @@ logger = sky_logging.init_logger(__name__)
 # bearing); v5e's is this framework's own measured train MFU (bench.py).
 _ASSUMED_MFU_BY_GEN = {
     'v2': 0.35, 'v3': 0.40, 'v4': 0.50, 'v5p': 0.50,
-    'v5e': 0.55,            # measured: bench.py, Llama-1B class, bf16
+    'v5e': 0.55,            # measured 55.52%: BENCH_LAST_GOOD.json
+    #                         (driver-captured, 2026-07-31; bench.py
+    #                         Llama-1B class, bf16, TPU v5 lite)
     'v6e': 0.40,            # high peak / relatively lower HBM BW per FLOP
 }
 _ASSUMED_MFU_DEFAULT = 0.4

@@ -1,0 +1,40 @@
+"""Engine-path serve benchmark plumbing (VERDICT r3 item 3).
+
+`SKYTPU_BENCH_METRIC=serve python bench.py` must spawn the real HTTP
+engine, drive concurrent streaming clients, and emit the one-line JSON
+with req/s + TTFT p50/p99 + TPOT p50 — the driver runs this against
+BASELINE.md's serve rows on TPU; here the whole pipeline is exercised on
+CPU with tiny shapes so a broken bench can never reach the driver.
+"""
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def test_serve_bench_emits_metrics_line():
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS='cpu',
+        SKYTPU_BENCH_CHILD='1',
+        SKYTPU_BENCH_METRIC='serve',
+        SKYTPU_BENCH_SERVE_REQUESTS='6',
+        SKYTPU_BENCH_SERVE_CONCURRENCY='4',
+        SKYTPU_BENCH_SERVE_PROMPT='8',
+        SKYTPU_BENCH_SERVE_NEW_TOKENS='8',
+    )
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, 'bench.py')],
+        env=env, capture_output=True, text=True, timeout=540)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    line = proc.stdout.strip().splitlines()[-1]
+    record = json.loads(line)
+    assert record['metric'] == 'serve_req_per_s'
+    assert record['value'] > 0
+    assert record['ttft_ms_p50'] > 0
+    assert record['ttft_ms_p99'] >= record['ttft_ms_p50']
+    assert record['tpot_ms_p50'] > 0
+    assert record['completed'] >= 4
