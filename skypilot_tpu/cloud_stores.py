@@ -36,15 +36,20 @@ class GcsCloudStorage(CloudStorage):
 
 
 class S3CloudStorage(CloudStorage):
+    """The whole S3-compatible family: plain s3:// plus endpoint-
+    parameterized providers (r2://, nebius:// — data/s3_compat.py),
+    mirroring reference sky/data/storage.py:1468's S3CompatibleStore."""
 
     def make_sync_command(self, source: str, destination: str) -> str:
+        from skypilot_tpu.data import s3_compat
         # cp first: `aws s3 sync` on an object key silently copies nothing,
         # so it must be the fallback, never the probe.
-        src = shlex.quote(source.rstrip('/'))
+        ep_arg = s3_compat.aws_cli_flag(source)
+        src = shlex.quote(s3_compat.to_s3_url(source.rstrip('/')))
         dst = shlex.quote(destination)
         return (f'mkdir -p $(dirname {dst}) && '
-                f'(aws s3 cp {src} {dst} 2>/dev/null || '
-                f'(mkdir -p {dst} && aws s3 sync {src} {dst}))')
+                f'(aws s3{ep_arg} cp {src} {dst} 2>/dev/null || '
+                f'(mkdir -p {dst} && aws s3{ep_arg} sync {src} {dst}))')
 
 
 class HttpCloudStorage(CloudStorage):
@@ -60,6 +65,8 @@ class HttpCloudStorage(CloudStorage):
 _REGISTRY = {
     'gs://': GcsCloudStorage(),
     's3://': S3CloudStorage(),
+    'r2://': S3CloudStorage(),
+    'nebius://': S3CloudStorage(),
     'http://': HttpCloudStorage(),
     'https://': HttpCloudStorage(),
 }

@@ -36,28 +36,31 @@ _LOCAL = 'local'
 
 
 def _scheme(url: str) -> str:
+    from skypilot_tpu.data import s3_compat
     if url.startswith('gs://'):
         return _GS
-    if url.startswith(('s3://', 'r2://')):
+    if s3_compat.scheme_of(url) is not None:
         return _S3
     if '://' in url:
         raise exceptions.StorageError(
             f'Unsupported storage URL scheme: {url!r} '
-            f"(supported: gs://, s3://, r2://, local paths)")
+            f"(supported: gs://, {', '.join(s3_compat.SCHEMES)}, "
+            f'local paths)')
     return _LOCAL
 
 
 def _norm(url: str, scheme: str) -> str:
+    from skypilot_tpu.data import s3_compat
     if scheme == _LOCAL:
         return os.path.expanduser(url)
-    # r2 is S3-compatible; callers configure the endpoint via AWS_* env.
-    if url.startswith('r2://'):
-        return 's3://' + url[len('r2://'):]
-    return url
+    # r2/nebius are S3-compatible: normalize to the s3 CLI surface; the
+    # endpoint travels as --endpoint-url (s3_compat provider table).
+    return s3_compat.to_s3_url(url)
 
 
 def build_transfer_command(src: str, dst: str) -> Tuple[str, list]:
     """Return (description, argv) for the src→dst route."""
+    from skypilot_tpu.data import s3_compat
     s_scheme, d_scheme = _scheme(src), _scheme(dst)
     s, d = _norm(src, s_scheme), _norm(dst, d_scheme)
     pair = (s_scheme, d_scheme)
@@ -67,11 +70,31 @@ def build_transfer_command(src: str, dst: str) -> Tuple[str, list]:
         return ('rsync', ['rsync', '-a', '--delete',
                           s.rstrip('/') + '/', d])
     if _GS in pair:
+        if _S3 in pair and (s3_compat.endpoint_for(src) or
+                            s3_compat.endpoint_for(dst)):
+            # gsutil can reach AWS S3 (built-in s3:// handler) but not a
+            # custom endpoint — an r2↔gs sync would silently hit AWS.
+            raise exceptions.StorageError(
+                f'{src} -> {dst}: gs↔S3-compatible (custom endpoint) '
+                f'transfers need an intermediate hop (sync via a local '
+                f'dir or plain s3://).')
         # -d mirrors (deletes extraneous destination objects), matching the
         # --delete semantics of the rsync and aws routes.
         return ('gsutil', ['gsutil', '-m', 'rsync', '-r', '-d', s, d])
-    # s3↔s3 and local↔s3.
-    return ('aws s3', ['aws', 's3', 'sync', '--delete', s, d])
+    # s3-compat↔s3-compat and local↔s3-compat. ONE endpoint per aws-CLI
+    # invocation and it applies to BOTH sides — so a bucket↔bucket sync
+    # requires the two sides to resolve to the same endpoint (None = AWS).
+    s_ep = s3_compat.endpoint_for(src) if s_scheme == _S3 else None
+    d_ep = s3_compat.endpoint_for(dst) if d_scheme == _S3 else None
+    if s_scheme == _S3 and d_scheme == _S3 and s_ep != d_ep:
+        raise exceptions.StorageError(
+            f'{src} -> {dst}: source and destination resolve to different '
+            f'S3 endpoints ({s_ep!r} vs {d_ep!r}); sync via a local '
+            f'intermediate.')
+    ep = s_ep or d_ep
+    ep_args = (s3_compat.aws_cli_args(src if s_ep else dst) if ep else [])
+    return ('aws s3',
+            ['aws', 's3', 'sync', '--delete', *ep_args, s, d])
 
 
 def transfer(src: str, dst: str, dryrun: bool = False) -> str:

@@ -12,6 +12,16 @@ def gsutil_copy_command(bucket_url: str, dst: str) -> str:
             f'gsutil -m rsync -r {shlex.quote(bucket_url)} {dst_q}')
 
 
+def aws_copy_command(bucket_url: str, dst: str) -> str:
+    """COPY mode for the S3-compatible family (s3/r2/nebius): aws s3 sync
+    with the provider's endpoint (data/s3_compat.py)."""
+    from skypilot_tpu.data import s3_compat
+    dst_q = shlex.quote(dst)
+    return (f'mkdir -p {dst_q} && '
+            f'aws s3 sync{s3_compat.aws_cli_flag(bucket_url)} '
+            f'{shlex.quote(s3_compat.to_s3_url(bucket_url))} {dst_q}')
+
+
 def gcsfuse_mount_command(bucket_url: str, dst: str) -> str:
     """MOUNT mode: plain gcsfuse passthrough (MOUNT_CACHED is rclone's
     write-back cache below, not a gcsfuse flag)."""
@@ -42,11 +52,22 @@ def _mount_tag(dst: str) -> str:
     return dst.strip('/').replace('/', '_') or 'root'
 
 
+def _rclone_remote(bucket_url: str) -> str:
+    """On-the-fly rclone remote for a bucket URL: :gcs: for gs://,
+    endpoint-parameterized :s3, for the S3-compatible family."""
+    if bucket_url.startswith('gs://'):
+        return f':gcs:{shlex.quote(bucket_url[len("gs://"):])}'
+    from skypilot_tpu.data import s3_compat
+    if s3_compat.scheme_of(bucket_url) is not None:
+        return shlex.quote(s3_compat.rclone_remote(bucket_url))
+    raise ValueError(f'No rclone remote mapping for {bucket_url!r}')
+
+
 def rclone_mount_command(bucket_url: str, dst: str) -> str:
-    assert bucket_url.startswith('gs://'), bucket_url
-    remote = bucket_url[len('gs://'):]
+    remote = _rclone_remote(bucket_url)
     dst_q = shlex.quote(dst)
     log = f'{_RCLONE_LOG_DIR}/{_mount_tag(dst)}.log'
+    auth = '--gcs-env-auth' if bucket_url.startswith('gs://') else ''
     # -v so the periodic "vfs cache: cleaned:" lines land in the log —
     # that's what the flush barrier greps (uploaded files stay in the cache
     # dir until --vfs-cache-max-age, so cache-dir emptiness can NOT signal
@@ -56,11 +77,11 @@ def rclone_mount_command(bucket_url: str, dst: str) -> str:
         f'mkdir -p {dst_q} {_RCLONE_CACHE_DIR}/{_mount_tag(dst)} '
         f'{_RCLONE_LOG_DIR} && '
         f'(mountpoint -q {dst_q} || '
-        f'rclone mount :gcs:{shlex.quote(remote)} {dst_q} --daemon -v '
+        f'rclone mount {remote} {dst_q} --daemon -v '
         f'--vfs-cache-mode writes --vfs-write-back 1s '
         f'--vfs-cache-poll-interval {_RCLONE_POLL_SECONDS}s '
         f'--cache-dir {_RCLONE_CACHE_DIR}/{_mount_tag(dst)} '
-        f'--log-file {log} --gcs-env-auth)')
+        f'--log-file {log} {auth}'.rstrip() + ')')
 
 
 def rclone_flush_command(dst: str, timeout_s: int = 600) -> str:

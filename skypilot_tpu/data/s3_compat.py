@@ -1,0 +1,124 @@
+"""S3-compatible object-store providers (R2, Nebius, custom endpoints).
+
+Reference analog: sky/data/storage.py:1468's S3CompatibleStore framework —
+every provider there is "the S3 CLI surface + a different endpoint URL +
+its own credential env". This module is that table for the TPU-native
+stack: schemes normalize to s3:// and the aws CLI / rclone commands get
+an --endpoint-url / `endpoint=` parameter.
+
+Endpoint resolution (first hit wins):
+  1. SKYTPU_<PROVIDER>_ENDPOINT_URL env (hermetic tests use this)
+  2. provider-specific construction (R2: from R2_ACCOUNT_ID;
+     Nebius: from NEBIUS_REGION, default eu-north1)
+Plain s3:// needs no endpoint (AWS default), but honors
+SKYTPU_S3_ENDPOINT_URL for MinIO/on-prem gateways.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Callable, Dict, List, Optional
+
+from skypilot_tpu import exceptions
+
+
+@dataclasses.dataclass(frozen=True)
+class S3CompatProvider:
+    scheme: str                       # URL scheme, e.g. 'r2'
+    display_name: str
+    endpoint_env: str                 # explicit endpoint override env
+    endpoint_builder: Optional[Callable[[], Optional[str]]] = None
+
+    def endpoint(self) -> Optional[str]:
+        url = os.environ.get(self.endpoint_env)
+        if url:
+            return url
+        if self.endpoint_builder is not None:
+            return self.endpoint_builder()
+        return None
+
+
+def _r2_endpoint() -> Optional[str]:
+    account = os.environ.get('R2_ACCOUNT_ID')
+    if not account:
+        return None
+    return f'https://{account}.r2.cloudflarestorage.com'
+
+
+def _nebius_endpoint() -> Optional[str]:
+    region = os.environ.get('NEBIUS_REGION', 'eu-north1')
+    return f'https://storage.{region}.nebius.cloud:443'
+
+
+PROVIDERS: Dict[str, S3CompatProvider] = {
+    's3': S3CompatProvider('s3', 'AWS S3', 'SKYTPU_S3_ENDPOINT_URL'),
+    'r2': S3CompatProvider('r2', 'Cloudflare R2', 'SKYTPU_R2_ENDPOINT_URL',
+                           _r2_endpoint),
+    'nebius': S3CompatProvider('nebius', 'Nebius Object Storage',
+                               'SKYTPU_NEBIUS_ENDPOINT_URL',
+                               _nebius_endpoint),
+}
+
+SCHEMES = tuple(f'{s}://' for s in PROVIDERS)
+
+
+def scheme_of(url: str) -> Optional[str]:
+    """The s3-compat scheme of `url`, or None if it isn't one."""
+    for scheme in PROVIDERS:
+        if url.startswith(f'{scheme}://'):
+            return scheme
+    return None
+
+
+def to_s3_url(url: str) -> str:
+    """r2://bucket/key → s3://bucket/key (the CLI-facing form)."""
+    scheme = scheme_of(url)
+    if scheme is None or scheme == 's3':
+        return url
+    return 's3://' + url.split('://', 1)[1]
+
+
+def endpoint_for(url_or_scheme: str) -> Optional[str]:
+    scheme = (url_or_scheme if url_or_scheme in PROVIDERS
+              else scheme_of(url_or_scheme))
+    if scheme is None:
+        return None
+    provider = PROVIDERS[scheme]
+    ep = provider.endpoint()
+    if ep is None and scheme != 's3':
+        raise exceptions.StorageError(
+            f'{provider.display_name} ({scheme}://) needs an endpoint: '
+            f'set {provider.endpoint_env}'
+            + (' or R2_ACCOUNT_ID' if scheme == 'r2' else '') + '.')
+    return ep
+
+
+def aws_cli_args(url_or_scheme: str) -> List[str]:
+    """Extra `aws s3` argv entries for this provider ([] for plain AWS)."""
+    ep = endpoint_for(url_or_scheme)
+    return ['--endpoint-url', ep] if ep else []
+
+
+def aws_cli_flag(url_or_scheme: str) -> str:
+    """Shell-string form of aws_cli_args (' --endpoint-url ...' or '')."""
+    import shlex
+    ep = endpoint_for(url_or_scheme)
+    return f' --endpoint-url {shlex.quote(ep)}' if ep else ''
+
+
+def rclone_remote(url: str) -> str:
+    """On-the-fly rclone remote spec for an s3-compat URL.
+
+    `:s3,env_auth=true[,endpoint="..."]:bucket/path` — credentials come
+    from the standard AWS_* env (rclone's env_auth), endpoint from the
+    provider table. The endpoint value is double-quoted: rclone's
+    connection-string parser terminates unquoted values at the first
+    ':' , which every https endpoint contains. Used by the MOUNT /
+    MOUNT_CACHED paths.
+    """
+    path = url.split('://', 1)[1]
+    ep = endpoint_for(url)
+    opts = 'provider=Other,env_auth=true'
+    if ep:
+        opts += f',endpoint="{ep}"'
+    return f':s3,{opts}:{path}'

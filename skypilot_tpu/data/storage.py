@@ -34,14 +34,19 @@ class StorageMode(enum.Enum):
 
 class StoreType(enum.Enum):
     GCS = 'gcs'
+    # The whole S3-compatible family (s3/r2/nebius/...): one store class +
+    # an endpoint parameter, the way reference sky/data/storage.py:1468's
+    # S3CompatibleStore generalizes (data/s3_compat.py is the provider
+    # table).
     S3 = 's3'
     LOCAL = 'local'
 
     @classmethod
     def from_source(cls, source: str) -> 'StoreType':
+        from skypilot_tpu.data import s3_compat
         if source.startswith('gs://'):
             return cls.GCS
-        if source.startswith(('s3://', 'r2://')):
+        if s3_compat.scheme_of(source) is not None:
             return cls.S3
         return cls.LOCAL
 
@@ -137,11 +142,19 @@ def mount_command_for(storage: Storage, dst: str, local: bool) -> str:
         if storage.mode == StorageMode.MOUNT_CACHED:
             return mounting_utils.local_cached_mount_command(source, dst)
         return mounting_utils.local_copy_command(source, dst)
+    url = storage.bucket_url()
+    if storage.store_type is StoreType.S3:
+        # S3-compatible family: aws CLI for COPY, rclone (endpoint-
+        # parameterized remote) for both mount modes — gcsfuse is
+        # GCS-only.
+        if storage.mode == StorageMode.COPY:
+            return mounting_utils.aws_copy_command(url, dst)
+        return mounting_utils.rclone_mount_command(url, dst)
     if storage.mode == StorageMode.COPY:
-        return mounting_utils.gsutil_copy_command(storage.bucket_url(), dst)
+        return mounting_utils.gsutil_copy_command(url, dst)
     if storage.mode == StorageMode.MOUNT_CACHED:
-        return mounting_utils.rclone_mount_command(storage.bucket_url(), dst)
-    return mounting_utils.gcsfuse_mount_command(storage.bucket_url(), dst)
+        return mounting_utils.rclone_mount_command(url, dst)
+    return mounting_utils.gcsfuse_mount_command(url, dst)
 
 
 def flush_command_for(storage: Storage, dst: str,
@@ -153,11 +166,16 @@ def flush_command_for(storage: Storage, dst: str,
     checkpoint only if the pre-preemption write actually reached the
     bucket.
     """
-    if storage.mode is not StorageMode.MOUNT_CACHED:
+    s3_mount = (storage.store_type is StoreType.S3 and
+                storage.mode is StorageMode.MOUNT)
+    if storage.mode is not StorageMode.MOUNT_CACHED and not s3_mount:
         return None
     if local:
         source = os.path.expanduser(storage.source or '')
         return mounting_utils.local_cached_flush_command(source, dst)
+    # S3-family MOUNT rides the same rclone write-back cache as
+    # MOUNT_CACHED (no s3fs dependency), so it needs the same exit
+    # barrier for durability.
     return mounting_utils.rclone_flush_command(dst)
 
 

@@ -22,22 +22,60 @@ class TestDataTransfer:
 
     Reference analog: sky/data/data_transfer.py."""
 
-    def test_route_selection(self):
+    def test_route_selection(self, monkeypatch):
         assert data_transfer.transfer(
             'gs://a', 'gs://b', dryrun=True).startswith('gsutil -m rsync')
         assert data_transfer.transfer(
             's3://a', 'gs://b', dryrun=True).startswith('gsutil')
         assert data_transfer.transfer(
             's3://a', 's3://b', dryrun=True).startswith('aws s3 sync')
-        # r2 normalizes to the s3 CLI surface.
+        # r2 normalizes to the s3 CLI surface (+ its endpoint). A single
+        # aws invocation's --endpoint-url applies to BOTH sides, so
+        # r2→plain-s3 must refuse rather than silently hit R2 for both.
+        monkeypatch.setenv('SKYTPU_R2_ENDPOINT_URL', 'https://ep.example')
         assert 's3://a' in data_transfer.transfer(
-            'r2://a', 's3://b', dryrun=True)
+            'r2://a', 'r2://b', dryrun=True)
+        with pytest.raises(exceptions.StorageError, match='different'):
+            data_transfer.transfer('r2://a', 's3://b', dryrun=True)
         assert data_transfer.transfer(
             '/tmp/x', '/tmp/y', dryrun=True).startswith('rsync')
 
     def test_rejects_unknown_scheme(self):
         with pytest.raises(exceptions.StorageError):
             data_transfer.transfer('ftp://a', 'gs://b', dryrun=True)
+
+    def test_r2_endpoint_parameterization(self, monkeypatch):
+        """The S3-compatible family (reference sky/data/storage.py:1468):
+        r2:// is the s3 CLI surface + an endpoint URL."""
+        monkeypatch.setenv('SKYTPU_R2_ENDPOINT_URL',
+                           'https://fake.r2.example')
+        cmd = data_transfer.transfer('r2://bkt/x', '/tmp/y', dryrun=True)
+        assert '--endpoint-url https://fake.r2.example' in cmd
+        assert 's3://bkt/x' in cmd and 'r2://' not in cmd
+        # Endpoint from the account id when no explicit URL is set.
+        monkeypatch.delenv('SKYTPU_R2_ENDPOINT_URL')
+        monkeypatch.setenv('R2_ACCOUNT_ID', 'acct1')
+        cmd = data_transfer.transfer('/tmp/y', 'r2://bkt', dryrun=True)
+        assert 'acct1.r2.cloudflarestorage.com' in cmd
+        # No endpoint resolvable → loud error, not a silent AWS hit.
+        monkeypatch.delenv('R2_ACCOUNT_ID')
+        with pytest.raises(exceptions.StorageError, match='endpoint'):
+            data_transfer.transfer('r2://bkt', '/tmp/y', dryrun=True)
+
+    def test_nebius_and_cross_endpoint_guards(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_R2_ENDPOINT_URL', 'https://r2.example')
+        cmd = data_transfer.transfer('nebius://bkt/p', '/tmp/z',
+                                     dryrun=True)
+        assert 'storage.eu-north1.nebius.cloud' in cmd   # default region
+        # Two different endpoints cannot share one aws-CLI invocation.
+        with pytest.raises(exceptions.StorageError, match='different'):
+            data_transfer.transfer('r2://a', 'nebius://b', dryrun=True)
+        # gsutil cannot reach a custom endpoint — refuse, don't hit AWS.
+        with pytest.raises(exceptions.StorageError, match='intermediate'):
+            data_transfer.transfer('r2://a', 'gs://b', dryrun=True)
+        # Plain s3 ↔ gs still routes through gsutil (built-in handler).
+        assert data_transfer.transfer('s3://a', 'gs://b',
+                                      dryrun=True).startswith('gsutil')
 
     def test_local_roundtrip(self, tmp_path):
         src = tmp_path / 'src'
@@ -60,6 +98,36 @@ class TestCommandBuilders:
         cmd = mounting_utils.gcsfuse_mount_command('gs://bkt/sub', '/data')
         assert 'gcsfuse' in cmd and 'bkt' in cmd and '/data' in cmd
         assert 'mountpoint -q' in cmd          # idempotent
+
+    def test_r2_store_mount_and_copy_commands(self, monkeypatch):
+        """R2 passes the store command matrix: COPY via aws s3 sync with
+        the endpoint, MOUNT/MOUNT_CACHED via an endpoint-parameterized
+        rclone remote, and the flush barrier applies to both mount modes
+        (they share the write-back cache)."""
+        from skypilot_tpu.data import storage as storage_lib
+        monkeypatch.setenv('SKYTPU_R2_ENDPOINT_URL', 'https://ep.example')
+        st = Storage(source='r2://bkt/ckpts', mode=StorageMode.COPY)
+        assert st.store_type is StoreType.S3
+        cmd = storage_lib.mount_command_for(st, '/data', local=False)
+        assert 'aws s3 sync' in cmd
+        assert '--endpoint-url https://ep.example' in cmd
+        assert 's3://bkt/ckpts' in cmd
+        for mode in (StorageMode.MOUNT, StorageMode.MOUNT_CACHED):
+            st = Storage(source='r2://bkt/ckpts', mode=mode)
+            cmd = storage_lib.mount_command_for(st, '/data', local=False)
+            assert 'rclone mount' in cmd
+            # Quoted endpoint: rclone's connection-string parser cuts
+            # unquoted values at the first ':' (every https URL has one).
+            assert 'endpoint="https://ep.example"' in cmd
+            assert 'gcsfuse' not in cmd
+            flush = storage_lib.flush_command_for(st, '/data', local=False)
+            assert flush is not None and 'vfs cache' in flush
+        # GCS MOUNT is still plain gcsfuse with no flush barrier.
+        st = Storage(source='gs://bkt', mode=StorageMode.MOUNT)
+        assert 'gcsfuse' in storage_lib.mount_command_for(
+            st, '/data', local=False)
+        assert storage_lib.flush_command_for(st, '/data',
+                                             local=False) is None
 
     def test_rclone_cached_mount_and_flush(self):
         cmd = mounting_utils.rclone_mount_command('gs://bkt', '/out')
