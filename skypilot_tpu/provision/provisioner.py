@@ -201,11 +201,27 @@ def _start_exec_agents(cluster_name: str, cluster_info: common.ClusterInfo,
     port = int((cluster_info.provider_config or {}).get(
         'exec_agent_port', exec_agent.DEFAULT_PORT))
 
+    import tempfile
+    # The token travels as a synced 0600 file, never on a remote command
+    # line (argv is world-readable in /proc on the pod; audit/log hooks
+    # capture it too).
+    tf = tempfile.NamedTemporaryFile('w', delete=False, prefix='skytpu-tok-')
+    try:
+        tf.write(token)
+        tf.close()
+        os.chmod(tf.name, 0o600)
+    except OSError:
+        os.unlink(tf.name)
+        raise
+
     def _one(idx_runner):
         idx, runner = idx_runner
+        runner.rsync(tf.name, '~/.skytpu_exec_agent.token.tmp', up=True)
         rc = runner.run(
             'mkdir -p "${SKYTPU_RUNTIME_DIR:-$HOME/.skytpu_runtime}" && '
-            f'printf %s {token} > '
+            'mv ~/.skytpu_exec_agent.token.tmp '
+            '"${SKYTPU_RUNTIME_DIR:-$HOME/.skytpu_runtime}'
+            '/exec_agent.token" && chmod 600 '
             '"${SKYTPU_RUNTIME_DIR:-$HOME/.skytpu_runtime}'
             '/exec_agent.token"', log_path='/dev/null')
         if rc != 0:
@@ -229,7 +245,13 @@ def _start_exec_agents(cluster_name: str, cluster_info: common.ClusterInfo,
                 f'Could not start the exec agent on {runner.node_id} '
                 f'(see /tmp/skytpu_exec_agent.log on the pod).')
 
-    subprocess_utils.run_in_parallel(_one, list(enumerate(runners)))
+    try:
+        subprocess_utils.run_in_parallel(_one, list(enumerate(runners)))
+    finally:
+        try:
+            os.unlink(tf.name)
+        except OSError:
+            pass
 
 
 @timeline.event

@@ -44,6 +44,22 @@ MAX_CONSECUTIVE_PROBE_FAILURES = 3
 MAX_REPLACEMENTS_BEFORE_FAILED = 3
 
 
+def _replacement_cap(target: int) -> int:
+    """Churn cap before permanent failure. Env-tunable (read at call
+    time, not import: the controller is a detached process and tests
+    tighten this so FAILED classification needs fewer full
+    launch→crash→replace cycles of wall-clock on a saturated box)."""
+    base = MAX_REPLACEMENTS_BEFORE_FAILED
+    env = os.environ.get('SKYTPU_SERVE_MAX_REPLACEMENTS')
+    if env is not None:
+        try:
+            base = max(1, int(env))
+        except ValueError:
+            logger.warning(f'Ignoring malformed '
+                           f'SKYTPU_SERVE_MAX_REPLACEMENTS={env!r}.')
+    return max(base, 2 * target)
+
+
 def _boot_patience_seconds(probe: 'spec_lib.ReadinessProbe') -> float:
     """Extra wall-clock a STARTING replica whose run job is verifiably
     alive gets beyond initial_delay_seconds before probe misses count
@@ -431,7 +447,7 @@ class ReplicaManager:
         # the loop launches and tears down (billing!) slices forever. The
         # streak resets on any successful probe, so preemption-replacement
         # churn doesn't trip it.
-        cap = max(MAX_REPLACEMENTS_BEFORE_FAILED, 2 * target)
+        cap = _replacement_cap(target)
         stale = [r for r in alive if (r.get('version') or 1) < self.version]
         if self._probe_failure_streak >= cap:
             if stale and self._prev_version_state is not None:

@@ -62,6 +62,12 @@ async def auth_middleware(request: web.Request, handler):
     # header in client-side).
     open_paths = ('/api/v1/health', '/dashboard')
     got = request.headers.get('Authorization', '')
+    if not got:
+        # Dashboard cookie (set by /dashboard?token= once, HttpOnly):
+        # authenticates exactly like a bearer header in both auth modes.
+        cookie = request.cookies.get('skytpu_dash', '')
+        if cookie:
+            got = f'Bearer {cookie}'
 
     # Two identity-resolving modes share one enforcement tail below:
     #  - SSO header trust (reference analog: sky/server/auth/ with
@@ -239,7 +245,19 @@ async def metrics(request: web.Request) -> web.Response:
 
 
 async def dashboard_page(request: web.Request) -> web.Response:
-    del request
+    # Token hygiene: ?token=... lands in access logs and browser history,
+    # so it is accepted exactly once — swapped for an HttpOnly cookie and
+    # stripped from the URL with a redirect. (Deprecated entry path; use
+    # the cookie or an Authorization header directly.)
+    token = request.query.get('token')
+    if token:
+        logger.warning('/dashboard?token=... is deprecated (tokens leak '
+                       'into logs/history); the token was moved into an '
+                       'HttpOnly cookie.')
+        resp = web.Response(status=303, headers={'Location': '/dashboard'})
+        resp.set_cookie('skytpu_dash', token, httponly=True,
+                        samesite='Strict', path='/dashboard')
+        return resp
     path = os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), 'dashboard', 'index.html')
     with open(path, 'r', encoding='utf-8') as f:

@@ -78,10 +78,30 @@ class OrphanReaperEvent(SkyletEvent):
 
     def __init__(self) -> None:
         super().__init__()
-        self._termed: Dict[int, float] = {}   # pid -> first SIGTERM time
+        # (pid, /proc starttime ticks) -> first SIGTERM time. Keyed by
+        # start time so a RECYCLED pid matching a new orphan gets the
+        # full SIGTERM grace window instead of an immediate SIGKILL, and
+        # pruned each sweep so the map cannot grow unbounded.
+        self._termed: Dict[tuple, float] = {}
+
+    @staticmethod
+    def _start_ticks(pid: int):
+        """Process start time in clock ticks (field 22 of
+        /proc/<pid>/stat) — the standard pid-reuse discriminator."""
+        try:
+            with open(f'/proc/{pid}/stat', 'r', encoding='utf-8') as f:
+                return int(f.read().rsplit(')', 1)[1].split()[19])
+        except (OSError, ValueError, IndexError):
+            return None
 
     def _run(self) -> None:
         import signal
+        # Prune _termed entries whose process is gone or whose pid was
+        # recycled (start time changed): a stale entry would escalate a
+        # brand-new orphan straight to SIGKILL, skipping the TERM grace
+        # window checkpoint-on-preempt handlers rely on.
+        self._termed = {key: t for key, t in self._termed.items()
+                        if self._start_ticks(key[0]) == key[1]}
         # Only reap ranks of THIS host's cluster: job ids are per-cluster
         # and a shared/dev host may run several fake hosts at once. No
         # cluster_name file (pre-upgrade host) → don't reap at all.
@@ -120,6 +140,9 @@ class OrphanReaperEvent(SkyletEvent):
             status = job_lib.get_status(job_id)
             if status is None or not status.is_terminal():
                 continue
+            key = (pid, self._start_ticks(pid))
+            if key[1] is None:
+                continue             # exited between listdir and here
             try:
                 pg = os.getpgid(pid)
                 if pg == my_pg:      # never shoot our own process group
@@ -128,14 +151,14 @@ class OrphanReaperEvent(SkyletEvent):
                 # chance); a group still alive next sweep trapped or
                 # ignored it — escalate to KILL (reference analog:
                 # subprocess_daemon's TERM→KILL ladder).
-                sig = (signal.SIGKILL if pid in self._termed
+                sig = (signal.SIGKILL if key in self._termed
                        else signal.SIGTERM)
                 logger.info(f'Reaping orphan rank pid {pid} of terminal '
                             f'job {job_id} ({sig.name}).')
                 os.killpg(pg, sig)
-                self._termed[pid] = self._termed.get(pid, time.time())
+                self._termed[key] = self._termed.get(key, time.time())
             except (ProcessLookupError, PermissionError, OSError):
-                self._termed.pop(pid, None)
+                self._termed.pop(key, None)
 
 
 class JobHeartbeatEvent(SkyletEvent):

@@ -65,6 +65,17 @@ def wrap(inner: str, workdir: Optional[str] = None,
     relative paths mean the host's filesystem — valid inside the
     container because $HOME is bind-mounted at the same path."""
     d = cmd or docker_cmd()
-    wd = (f'$(cd {workdir} 2>/dev/null && pwd || pwd)'
-          if workdir else '$(pwd)')
+    if workdir:
+        # Quote against spaces/metacharacters while keeping `~` meaning
+        # the host's home: a leading ~ becomes "$HOME" outside the quoted
+        # remainder (plain shlex.quote would make the tilde literal).
+        if workdir == '~':
+            q_wd = '"$HOME"'
+        elif workdir.startswith('~/'):
+            q_wd = '"$HOME"/' + shlex.quote(workdir[2:])
+        else:
+            q_wd = shlex.quote(workdir)
+        wd = f'$(cd {q_wd} 2>/dev/null && pwd || pwd)'
+    else:
+        wd = '$(pwd)'
     return f'{d} exec -w "{wd}" {CONTAINER_NAME} bash -c {shlex.quote(inner)}'
