@@ -1,0 +1,143 @@
+"""HF checkpoint export: native param pytrees → safetensors directory.
+
+The inverse of hf_import.params_from_hf — finetuned weights (e.g. a
+LoRA merge, train/lora.py) are written back as a standard HF checkpoint
+so they serve through the existing --hf-dir path (engine + real
+tokenizer) and interoperate with the wider HF ecosystem, the same
+round-trip the reference's finetuning recipes produce (torchtune in
+llm/llama-3_1-finetuning/lora.yaml writes HF-format output dirs).
+
+Only the dense Llama/Qwen2 families round-trip (the ones hf_import
+reads); anything else fails loudly. Layout inversion mirrors import:
+un-stack the leading [L] axis and transpose projections back to torch's
+[out, in].
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.models import llama
+
+logger = sky_logging.init_logger(__name__)
+
+# Sidecar files copied verbatim from the source checkpoint when present:
+# tokenizer + generation config make the exported dir directly servable.
+_SIDECARS = ('config.json', 'generation_config.json', 'tokenizer.json',
+             'tokenizer_config.json', 'special_tokens_map.json')
+
+
+def _to_numpy(x) -> np.ndarray:
+    """Device array → numpy (bf16 arrives as an ml_dtypes array, which
+    safetensors.numpy round-trips — the artifact keeps its dtype)."""
+    import jax
+    return np.asarray(jax.device_get(x))
+
+
+def hf_tensors_from_params(params: llama.Params, cfg: llama.LlamaConfig
+                           ) -> Dict[str, np.ndarray]:
+    """Flat HF-named tensor dict (torch layouts) from a native tree."""
+    lay = params['layers']
+    out: Dict[str, np.ndarray] = {
+        'model.embed_tokens.weight': _to_numpy(params['embed']),
+        'model.norm.weight': _to_numpy(params['final_norm']),
+    }
+
+    def unstack(name: str, arr, transpose: bool):
+        a = _to_numpy(arr)
+        for i in range(cfg.n_layers):
+            t = a[i]
+            out[f'model.layers.{i}.{name}'] = (
+                np.ascontiguousarray(t.T) if transpose else t)
+
+    unstack('input_layernorm.weight', lay['attn_norm'], False)
+    unstack('self_attn.q_proj.weight', lay['wq'], True)
+    unstack('self_attn.k_proj.weight', lay['wk'], True)
+    unstack('self_attn.v_proj.weight', lay['wv'], True)
+    unstack('self_attn.o_proj.weight', lay['wo'], True)
+    unstack('post_attention_layernorm.weight', lay['mlp_norm'], False)
+    unstack('mlp.gate_proj.weight', lay['w_gate'], True)
+    unstack('mlp.up_proj.weight', lay['w_up'], True)
+    unstack('mlp.down_proj.weight', lay['w_down'], True)
+    if cfg.qkv_bias:
+        unstack('self_attn.q_proj.bias', lay['bq'], False)
+        unstack('self_attn.k_proj.bias', lay['bk'], False)
+        unstack('self_attn.v_proj.bias', lay['bv'], False)
+    if not cfg.tie_embeddings:
+        out['lm_head.weight'] = np.ascontiguousarray(
+            _to_numpy(params['lm_head']).T)
+    return out
+
+
+def save_hf_checkpoint(params: llama.Params, cfg: llama.LlamaConfig,
+                       out_dir: str,
+                       source_dir: Optional[str] = None) -> str:
+    """Write `out_dir` as an HF checkpoint directory.
+
+    `source_dir`: the original HF checkpoint — its config.json and
+    tokenizer sidecars are copied so the export serves immediately via
+    --hf-dir. Without it a minimal config.json is synthesized from the
+    native config (tokenizer must then be supplied separately).
+    """
+    if type(cfg) is not llama.LlamaConfig:
+        raise ValueError(
+            f'HF export supports the dense Llama/Qwen2 family only, got '
+            f'{type(cfg).__name__} (the families hf_import reads).')
+    from safetensors.numpy import save_file
+    out_dir = os.path.abspath(os.path.expanduser(out_dir))
+    os.makedirs(out_dir, exist_ok=True)
+    tensors = hf_tensors_from_params(params, cfg)
+    tmp = os.path.join(out_dir, '.model.safetensors.tmp')
+    save_file(tensors, tmp)
+    os.replace(tmp, os.path.join(out_dir, 'model.safetensors'))
+
+    copied = set()
+    if source_dir:
+        source_dir = os.path.abspath(os.path.expanduser(source_dir))
+        for name in _SIDECARS:
+            src = os.path.join(source_dir, name)
+            if os.path.exists(src):
+                shutil.copy2(src, os.path.join(out_dir, name))
+                copied.add(name)
+    if 'config.json' not in copied:
+        with open(os.path.join(out_dir, 'config.json'), 'w',
+                  encoding='utf-8') as f:
+            json.dump(_minimal_hf_config(cfg), f, indent=1)
+    n = sum(int(np.prod(t.shape)) for t in tensors.values())
+    logger.info(f'Exported HF checkpoint to {out_dir}: '
+                f'{n / 1e9:.2f}B params, {len(tensors)} tensors.')
+    return out_dir
+
+
+def _minimal_hf_config(cfg: llama.LlamaConfig) -> Dict[str, Any]:
+    arch = 'Qwen2ForCausalLM' if cfg.qkv_bias else 'LlamaForCausalLM'
+    out: Dict[str, Any] = {
+        'architectures': [arch],
+        'vocab_size': cfg.vocab_size,
+        'hidden_size': cfg.dim,
+        'num_hidden_layers': cfg.n_layers,
+        'num_attention_heads': cfg.n_heads,
+        'num_key_value_heads': cfg.n_kv_heads,
+        'intermediate_size': cfg.ffn_dim,
+        'rope_theta': cfg.rope_theta,
+        'rms_norm_eps': cfg.rms_eps,
+        'max_position_embeddings': cfg.max_seq_len,
+        'tie_word_embeddings': cfg.tie_embeddings,
+        'head_dim': cfg.hd,
+    }
+    if cfg.rope_scaling:
+        rs = dict(cfg.rope_scaling)
+        out['rope_scaling'] = {
+            'rope_type': 'llama3',
+            'factor': rs['factor'],
+            'low_freq_factor': rs.get('low_freq_factor', 1.0),
+            'high_freq_factor': rs.get('high_freq_factor', 4.0),
+            'original_max_position_embeddings':
+                rs.get('original_max_position', 8192),
+        }
+    return out

@@ -195,6 +195,18 @@ def params_from_hf(tensors: Dict[str, Any], cfg: llama.LlamaConfig,
     return params
 
 
+def load_hf_config(hf_dir: str) -> llama.LlamaConfig:
+    """Just the config (cheap — no tensor reads). Used by callers that
+    need the architecture before deciding whether to load weights."""
+    hf_dir = os.path.expanduser(hf_dir)
+    cfg_path = os.path.join(hf_dir, 'config.json')
+    if not os.path.exists(cfg_path):
+        raise FileNotFoundError(f'{cfg_path} not found — --hf-dir must '
+                                f'point at an HF checkpoint directory.')
+    with open(cfg_path, 'r', encoding='utf-8') as f:
+        return config_from_hf(json.load(f))
+
+
 def load_hf_checkpoint(hf_dir: str, dtype: Optional[Any] = None
                        ) -> Tuple[llama.LlamaConfig, llama.Params]:
     """(config, params) from an HF checkpoint directory.
@@ -203,13 +215,8 @@ def load_hf_checkpoint(hf_dir: str, dtype: Optional[Any] = None
     `Qwen/Qwen2.5-1.5B-Instruct`) and point the engine at it:
         python -m skypilot_tpu.serve.engine --hf-dir /path/to/ckpt
     """
+    cfg = load_hf_config(hf_dir)
     hf_dir = os.path.expanduser(hf_dir)
-    cfg_path = os.path.join(hf_dir, 'config.json')
-    if not os.path.exists(cfg_path):
-        raise FileNotFoundError(f'{cfg_path} not found — --hf-dir must '
-                                f'point at an HF checkpoint directory.')
-    with open(cfg_path, 'r', encoding='utf-8') as f:
-        cfg = config_from_hf(json.load(f))
     tensors = _load_tensors(hf_dir)
     params = params_from_hf(tensors, cfg, dtype=dtype)
     n = sum(int(np.prod(x.shape)) for x in

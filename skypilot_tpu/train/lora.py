@@ -1,0 +1,292 @@
+"""LoRA finetuning: low-rank adapters over any native model family.
+
+The reference finetunes via external recipes (llm/llama-3_1-finetuning/
+lora.yaml runs torchtune's LoRA on Llama-3.1; llm/gpt-oss-finetuning/
+runs TRL) — SkyPilot itself only schedules them. Here finetuning is
+native, and the design is TPU-first:
+
+  - Adapters are a *path-keyed overlay* on the stacked-layer param
+    pytrees (llama.py stacks layers on a leading [L] axis): a target
+    leaf of shape [..., in, out] gets A:[..., in, r] and B:[..., r, out].
+    The leading axes ride along, so the same code adapts dense layers
+    ([L, in, out]), per-expert MoE weights ([L, E, in, out]) and 2-D
+    heads — one einsum '...ir,...ro->...io' covers all of them and runs
+    as a single batched matmul on the MXU.
+  - The merge happens *functionally inside the loss*: the train step
+    computes `merged = base + scale * A@B` under jit and runs the
+    family's unmodified forward. No per-family hooks, no model edits;
+    XLA fuses the rank-r matmul + add into the surrounding graph, and
+    autodiff gives exactly the LoRA gradients because `base` enters as
+    a constant (grads are taken w.r.t. the adapters only).
+  - Only adapters + their optimizer state are trained/donated; the base
+    stays sharded per the family's param_specs (fsdp/tensor) and is
+    passed by reference every step. Adapters are tiny (rank<<dim) and
+    replicated — their all-reduce cost is noise next to the base's.
+
+Serving the result: `merge_into()` folds adapters into the base at full
+precision → the merged tree serves through the existing engine paths
+(models/hf_export.py writes it back as an HF checkpoint directory).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh
+
+from skypilot_tpu import models as models_lib
+from skypilot_tpu.parallel import mesh as mesh_lib
+from skypilot_tpu.parallel import sharding as sharding_lib
+from skypilot_tpu.train import train_lib
+
+# Default targets: the attention projections (the standard LoRA recipe,
+# reference analog llm/llama-3_1-finetuning/lora.yaml's torchtune
+# defaults). Leaf names are the native ones (llama.py / mla.py / moe.py).
+DEFAULT_TARGETS = ('wq', 'wk', 'wv', 'wo')
+
+
+@dataclasses.dataclass(frozen=True)
+class LoRAConfig:
+    rank: int = 16
+    alpha: float = 32.0
+    # Leaf names to adapt (matched against the last path segment).
+    targets: Tuple[str, ...] = DEFAULT_TARGETS
+
+    @property
+    def scaling(self) -> float:
+        return self.alpha / self.rank
+
+
+def _leaf_key(path) -> str:
+    """'/'-joined dict keys for a tree path, e.g. 'layers/wq'."""
+    parts = []
+    for p in path:
+        if hasattr(p, 'key'):
+            parts.append(str(p.key))
+        else:
+            parts.append(str(p))
+    return '/'.join(parts)
+
+
+def target_keys(base_params: Any, lcfg: LoRAConfig) -> list:
+    """Sorted adapter keys: targeted leaves with a matmul shape."""
+    keys = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(base_params)[0]:
+        key = _leaf_key(path)
+        if key.split('/')[-1] in lcfg.targets and leaf.ndim >= 2:
+            keys.append(key)
+    if not keys:
+        raise ValueError(
+            f'LoRA targets {lcfg.targets} matched no >=2-D leaves; '
+            f'available: '
+            f'{sorted({_leaf_key(p) for p, _ in jax.tree_util.tree_flatten_with_path(base_params)[0]})}')
+    return sorted(keys)
+
+
+def init_adapters(rng: jax.Array, base_params: Any,
+                  lcfg: LoRAConfig) -> Dict[str, Dict[str, jnp.ndarray]]:
+    """{key: {'a','b'}} — A ~ N(0, 1/r) fp32, B = 0 (so the merged
+    model starts EXACTLY at the base; asserted in tests)."""
+    leaves = {_leaf_key(p): leaf for p, leaf in
+              jax.tree_util.tree_flatten_with_path(base_params)[0]}
+    adapters: Dict[str, Dict[str, jnp.ndarray]] = {}
+    for i, key in enumerate(target_keys(base_params, lcfg)):
+        leaf = leaves[key]
+        *lead, d_in, d_out = leaf.shape
+        k = jax.random.fold_in(rng, i)
+        a = jax.random.normal(k, (*lead, d_in, lcfg.rank),
+                              jnp.float32) / lcfg.rank
+        b = jnp.zeros((*lead, lcfg.rank, d_out), jnp.float32)
+        adapters[key] = {'a': a, 'b': b}
+    return adapters
+
+
+def merge_into(base_params: Any, adapters: Dict[str, Dict[str, Any]],
+               lcfg: LoRAConfig) -> Any:
+    """base + scaling * A@B on targeted leaves (fp32 math, cast back to
+    each leaf's dtype). Works under jit and on concrete trees alike."""
+    scaling = lcfg.scaling
+
+    def _merge(path, leaf):
+        ab = adapters.get(_leaf_key(path))
+        if ab is None:
+            return leaf
+        delta = jnp.einsum('...ir,...ro->...io',
+                           ab['a'].astype(jnp.float32),
+                           ab['b'].astype(jnp.float32)) * scaling
+        return (leaf.astype(jnp.float32) + delta).astype(leaf.dtype)
+
+    return jax.tree_util.tree_map_with_path(_merge, base_params)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class LoRAState:
+    step: jnp.ndarray
+    adapters: Any
+    opt_state: Any
+
+
+def init_lora_state(rng: jax.Array, base_params: Any, lcfg: LoRAConfig,
+                    tx: optax.GradientTransformation) -> LoRAState:
+    adapters = init_adapters(rng, base_params, lcfg)
+    return LoRAState(step=jnp.zeros((), jnp.int32), adapters=adapters,
+                     opt_state=tx.init(adapters))
+
+
+def shard_base_params(base_params: Any, cfg, mesh: Mesh,
+                      rules: Optional[sharding_lib.Rules] = None) -> Any:
+    """Place an (imported) base tree onto the mesh per the family's
+    param_specs — the same layout the full train step uses."""
+    rules = rules or sharding_lib.Rules()
+    mod = models_lib.module_for(cfg)
+    specs = mod.param_specs(cfg, rules)
+    shardings = sharding_lib.tree_shardings(mesh, specs)
+    return jax.tree.map(jax.device_put, base_params, shardings)
+
+
+def make_lora_train_step(cfg, mesh: Mesh, tx: optax.GradientTransformation,
+                         lcfg: LoRAConfig,
+                         rules: Optional[sharding_lib.Rules] = None):
+    """Jitted (state, base_params, batch) → (state, metrics).
+
+    Donates only the LoRA state; `base_params` is read-only (pass the
+    same sharded tree every step — it is neither copied nor updated).
+    Batch contract matches train_lib.make_train_step: {'tokens':
+    [B, S+1]} (+ optional 'loss_mask' over target positions).
+    """
+    rules = rules or sharding_lib.Rules()
+    mod = models_lib.module_for(cfg)
+    n_zigzag = train_lib._zigzag_seq_shards(cfg, mesh)
+
+    def step_fn(state: LoRAState, base_params, batch):
+        tokens = batch['tokens']
+        inputs, targets, mask, positions = train_lib._zigzag_shift(
+            tokens, batch.get('loss_mask'), n_zigzag)
+
+        def loss_fn(adapters):
+            merged = merge_into(base_params, adapters, lcfg)
+            if getattr(mod, 'HAS_AUX', False):
+                logits, aux = mod.forward(merged, inputs, cfg, rules,
+                                          positions=positions,
+                                          return_aux=True)
+            else:
+                logits, aux = mod.forward(merged, inputs, cfg, rules,
+                                          positions=positions), 0.0
+            loss, denom = train_lib.cross_entropy_loss(logits, targets,
+                                                       mask)
+            return loss + aux, (loss, denom)
+
+        (_, (loss, denom)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.adapters)
+        updates, new_opt = tx.update(grads, state.opt_state,
+                                     state.adapters)
+        new_adapters = optax.apply_updates(state.adapters, updates)
+        metrics = {'loss': loss, 'grad_norm': optax.global_norm(grads),
+                   'tokens': denom, 'step': state.step}
+        return LoRAState(step=state.step + 1, adapters=new_adapters,
+                         opt_state=new_opt), metrics
+
+    jitted = jax.jit(step_fn, donate_argnums=(0,))
+
+    def wrapped(state, base_params, batch):
+        with mesh_lib.use_mesh(mesh):
+            return jitted(state, base_params, batch)
+
+    return wrapped
+
+
+# ----------------------------------------------------------------------
+# Adapter persistence: one .npz (flat 'key:a'/'key:b' arrays) + a JSON
+# sidecar with the LoRAConfig and the training step. Small files; no
+# orbax machinery needed.
+
+def save_adapters(directory: str, state: LoRAState,
+                  lcfg: LoRAConfig) -> str:
+    """Persist adapters + optimizer state. Process-0-only on multi-host
+    slices (adapters are replicated, so rank 0 holds the full state; the
+    orbax-style multi-writer dance is unnecessary here)."""
+    directory = os.path.abspath(os.path.expanduser(directory))
+    path = os.path.join(directory, 'adapters.npz')
+    if jax.process_index() != 0:
+        return path
+    os.makedirs(directory, exist_ok=True)
+    adapters = jax.device_get(state.adapters)
+    flat = {}
+    for key, ab in adapters.items():
+        flat[key + ':a'] = np.asarray(ab['a'], np.float32)
+        flat[key + ':b'] = np.asarray(ab['b'], np.float32)
+    # Optimizer state rides along so a resumed run keeps its Adam
+    # moments + schedule count (structure is reproducible from
+    # tx.init(adapters); only the leaves are stored, in tree order).
+    for i, leaf in enumerate(jax.tree.leaves(
+            jax.device_get(state.opt_state))):
+        flat[f'opt:{i}'] = np.asarray(leaf)
+    # Step lives INSIDE the npz so weights+moments+step replace
+    # atomically (lora.json's copy is advisory/human-readable; a crash
+    # between the two os.replace calls can't desync resume).
+    flat['_step'] = np.asarray(int(jax.device_get(state.step)), np.int64)
+    tmp = os.path.join(directory, '.adapters.npz.tmp')
+    with open(tmp, 'wb') as f:
+        np.savez(f, **flat)
+    os.replace(tmp, path)
+    meta = {'rank': lcfg.rank, 'alpha': lcfg.alpha,
+            'targets': list(lcfg.targets),
+            'step': int(jax.device_get(state.step))}
+    meta_tmp = os.path.join(directory, '.lora.json.tmp')
+    with open(meta_tmp, 'w', encoding='utf-8') as f:
+        json.dump(meta, f, indent=1)
+    os.replace(meta_tmp, os.path.join(directory, 'lora.json'))
+    return path
+
+
+def load_adapters(directory: str
+                  ) -> Tuple[Dict[str, Dict[str, jnp.ndarray]],
+                             LoRAConfig, int, list]:
+    """(adapters, lora_config, step, opt_leaves) from save_adapters
+    output. opt_leaves is [] for pre-opt-state artifacts; otherwise the
+    flat optimizer-state leaves in tree order (rebuild the structure
+    with tx.init(adapters) and tree_unflatten)."""
+    directory = os.path.abspath(os.path.expanduser(directory))
+    with open(os.path.join(directory, 'lora.json'), 'r',
+              encoding='utf-8') as f:
+        meta = json.load(f)
+    lcfg = LoRAConfig(rank=int(meta['rank']), alpha=float(meta['alpha']),
+                      targets=tuple(meta['targets']))
+    adapters: Dict[str, Dict[str, jnp.ndarray]] = {}
+    opt: Dict[int, jnp.ndarray] = {}
+    step = int(meta.get('step', 0))
+    with np.load(os.path.join(directory, 'adapters.npz')) as z:
+        for name in z.files:
+            if name == '_step':
+                step = int(z[name])   # authoritative (atomic w/ weights)
+                continue
+            if name.startswith('opt:'):
+                opt[int(name.split(':', 1)[1])] = jnp.asarray(z[name])
+                continue
+            key, part = name.rsplit(':', 1)
+            adapters.setdefault(key, {})[part] = jnp.asarray(z[name])
+    opt_leaves = [opt[i] for i in sorted(opt)]
+    return adapters, lcfg, step, opt_leaves
+
+
+def restore_opt_state(tx: optax.GradientTransformation, adapters: Any,
+                      opt_leaves: list) -> Any:
+    """Rebuild the optax state from saved leaves (fresh init when the
+    artifact predates opt-state saving or shapes drifted)."""
+    template = tx.init(adapters)
+    t_leaves, treedef = jax.tree.flatten(template)
+    if len(opt_leaves) != len(t_leaves) or any(
+            tuple(a.shape) != tuple(b.shape)
+            for a, b in zip(opt_leaves, t_leaves)):
+        return template
+    # Cast to template dtypes (e.g. schedule counts are int32).
+    opt_leaves = [jnp.asarray(a, b.dtype)
+                  for a, b in zip(opt_leaves, t_leaves)]
+    return jax.tree.unflatten(treedef, opt_leaves)

@@ -1,0 +1,52 @@
+"""Fold trained LoRA adapters into base weights for serving.
+
+    python -m skypilot_tpu.train.lora_merge \
+        --hf-dir ~/ckpts/Llama-3.2-1B --lora-dir ~/ft/adapters \
+        --out ~/ft/merged
+
+The output is a standard HF checkpoint directory (weights + tokenizer
+sidecars) — serve it directly:
+
+    python -m skypilot_tpu.serve.engine --hf-dir ~/ft/merged
+
+(The reference's finetune recipes end the same way: torchtune writes an
+HF-format dir that vLLM then serves, llm/llama-3_1-finetuning/.)
+"""
+from __future__ import annotations
+
+import argparse
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger('skypilot_tpu.train.lora_merge')
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(prog='skytpu-lora-merge')
+    parser.add_argument('--hf-dir', required=True,
+                        help='Base HF checkpoint the adapters were '
+                             'trained against.')
+    parser.add_argument('--lora-dir', required=True,
+                        help='Directory holding adapters.npz + lora.json '
+                             '(trainer --lora-dir).')
+    parser.add_argument('--out', required=True,
+                        help='Output HF checkpoint directory.')
+    args = parser.parse_args()
+
+    from skypilot_tpu.models import hf_export, hf_import
+    from skypilot_tpu.train import lora
+
+    # dtype=None keeps the base's stored dtype (bf16 stays bf16 — the
+    # merge itself happens in fp32 inside merge_into, and the export
+    # keeps the artifact the same size as the base).
+    cfg, base = hf_import.load_hf_checkpoint(args.hf_dir, dtype=None)
+    adapters, lcfg, step, _ = lora.load_adapters(args.lora_dir)
+    merged = lora.merge_into(base, adapters, lcfg)
+    out = hf_export.save_hf_checkpoint(merged, cfg, args.out,
+                                       source_dir=args.hf_dir)
+    logger.info(f'Merged rank-{lcfg.rank} adapters (step {step}) into '
+                f'{out}; serve with --hf-dir {out}')
+
+
+if __name__ == '__main__':
+    main()

@@ -47,6 +47,16 @@ class TrainerConfig:
     eval_data_path: Optional[str] = None
     eval_every: int = 50
     eval_batches: int = 8
+    # LoRA finetuning (train/lora.py): rank > 0 trains low-rank adapters
+    # instead of full params. Base weights come from --hf-dir (an HF
+    # checkpoint, the reference llm/llama-3_1-finetuning flow) or the
+    # preset's random init. Adapters persist to lora_dir; merge with
+    # `python -m skypilot_tpu.train.lora_merge` for serving.
+    lora_rank: int = 0
+    lora_alpha: float = 32.0
+    lora_targets: Optional[List[str]] = None
+    hf_dir: Optional[str] = None
+    lora_dir: Optional[str] = None
 
 
 def maybe_init_distributed() -> None:
@@ -111,7 +121,28 @@ def train(tcfg: TrainerConfig) -> List[Dict[str, float]]:
     from skypilot_tpu.train import train_lib
 
     maybe_init_distributed()
-    cfg = _model_config(tcfg)
+    base_params = None
+    load_base = None
+    if tcfg.hf_dir:
+        # Finetune flow: config comes from the HF checkpoint (the preset
+        # name is ignored, loudly). Weights load lazily — a resumed run
+        # restores from its own checkpoint and never reads them.
+        import jax.numpy as jnp
+        from skypilot_tpu.models import hf_import
+        cfg = hf_import.load_hf_config(tcfg.hf_dir)
+        if tcfg.model_overrides:
+            cfg = dataclasses.replace(cfg, **tcfg.model_overrides)
+        logger.info(f'--hf-dir given: model config from {tcfg.hf_dir} '
+                    f'(preset {tcfg.model!r} ignored).')
+
+        def load_base(dtype=jnp.float32):
+            # fp32 for full finetuning (optimizer masters); LoRA keeps
+            # the stored dtype (the frozen base is read-only and
+            # merge_into does its math in fp32 regardless).
+            _, p = hf_import.load_hf_checkpoint(tcfg.hf_dir, dtype=dtype)
+            return p
+    else:
+        cfg = _model_config(tcfg)
     mesh = build_mesh(MeshSpec(**tcfg.mesh) if tcfg.mesh else MeshSpec())
     tx = train_lib.default_optimizer(learning_rate=tcfg.learning_rate,
                                      warmup_steps=tcfg.warmup_steps,
@@ -123,21 +154,131 @@ def train(tcfg: TrainerConfig) -> List[Dict[str, float]]:
             f'batch_size={tcfg.batch_size} must be divisible by '
             f'data*fsdp={batch_shards} (the batch-dim mesh axes).')
 
+    lora_mode = tcfg.lora_rank > 0
+    if lora_mode and tcfg.ckpt_dir:
+        raise ValueError('--lora-rank and --ckpt-dir are exclusive: LoRA '
+                         'persists adapters to --lora-dir instead.')
+    if lora_mode and tcfg.grad_accum_steps > 1:
+        raise ValueError('--grad-accum is not supported with --lora-rank '
+                         'yet; lower --batch-size instead (LoRA peak '
+                         'memory is dominated by activations, same as '
+                         'the full step).')
+
     ckpt = None
     start_step = 0
-    if tcfg.ckpt_dir:
-        from skypilot_tpu.train import checkpoints
-        state, start_step, ckpt = checkpoints.restore_or_init(
-            tcfg.ckpt_dir, cfg, mesh, tx)
+    lcfg = None
+    if lora_mode:
+        from skypilot_tpu.train import lora as lora_lib
+        lcfg = lora_lib.LoRAConfig(
+            rank=tcfg.lora_rank, alpha=tcfg.lora_alpha,
+            targets=tuple(tcfg.lora_targets or lora_lib.DEFAULT_TARGETS))
+        if load_base is not None:
+            base_params = load_base(dtype=None)
+            base_params = lora_lib.shard_base_params(base_params, cfg,
+                                                     mesh)
+        else:
+            # Init directly sharded (no single-device staging — the
+            # same reason train_lib.init_train_state shards its init).
+            from skypilot_tpu import models as models_lib
+            from skypilot_tpu.parallel import mesh as mesh_lib
+            from skypilot_tpu.parallel import sharding as sharding_lib
+            mod = models_lib.module_for(cfg)
+            shardings = sharding_lib.tree_shardings(
+                mesh, mod.param_specs(cfg, sharding_lib.Rules()))
+            with mesh_lib.use_mesh(mesh):
+                base_params = jax.jit(
+                    lambda r: mod.init_params(r, cfg),
+                    out_shardings=shardings)(jax.random.PRNGKey(0))
+        resume = (tcfg.lora_dir and os.path.exists(
+            os.path.join(os.path.expanduser(tcfg.lora_dir),
+                         'adapters.npz')))
+        if jax.process_count() > 1:
+            # All hosts must take the SAME branch: save_adapters writes
+            # on process 0 only, so without a shared filesystem the
+            # exists() answers diverge and the gang deadlocks at the
+            # first collective. Allgather lets EVERY host detect the
+            # divergence and raise cleanly (no one-sided hang).
+            import numpy as _np
+            from jax.experimental import multihost_utils
+            flags = multihost_utils.process_allgather(
+                _np.asarray(bool(resume)))
+            if bool(flags.any()) != bool(flags.all()):
+                raise FileNotFoundError(
+                    f'--lora-dir {tcfg.lora_dir!r} holds adapters.npz on '
+                    f'only {int(flags.sum())}/{flags.size} hosts — LoRA '
+                    f'resume on multi-host slices needs --lora-dir on '
+                    f'shared storage (mounted bucket).')
+            resume = bool(flags.all())
+        if resume:
+            adapters, saved_lcfg, start_step, opt_leaves = (
+                lora_lib.load_adapters(tcfg.lora_dir))
+            if (saved_lcfg.rank, saved_lcfg.alpha,
+                    saved_lcfg.targets) != (lcfg.rank, lcfg.alpha,
+                                            lcfg.targets):
+                raise ValueError(
+                    f'--lora-dir holds rank={saved_lcfg.rank} '
+                    f'alpha={saved_lcfg.alpha} '
+                    f'targets={saved_lcfg.targets}; requested '
+                    f'rank={lcfg.rank} alpha={lcfg.alpha} '
+                    f'targets={lcfg.targets}.')
+            import jax.numpy as jnp
+            state = lora_lib.LoRAState(
+                step=jnp.asarray(start_step, jnp.int32),
+                adapters=adapters,
+                opt_state=lora_lib.restore_opt_state(tx, adapters,
+                                                     opt_leaves))
+            logger.info(f'Resumed LoRA adapters at step {start_step} '
+                        f'from {tcfg.lora_dir}.')
+        else:
+            state = lora_lib.init_lora_state(jax.random.PRNGKey(1),
+                                             base_params, lcfg, tx)
+        lora_step = lora_lib.make_lora_train_step(cfg, mesh, tx, lcfg)
+
+        def step_fn(s, b):
+            return lora_step(s, base_params, b)
     else:
-        state = train_lib.init_train_state(jax.random.PRNGKey(0), cfg, mesh,
-                                           tx)
-    if tcfg.batch_size % tcfg.grad_accum_steps != 0:
-        raise ValueError(
-            f'batch_size={tcfg.batch_size} must be divisible by '
-            f'grad_accum_steps={tcfg.grad_accum_steps}')
-    step_fn = train_lib.make_train_step(
-        cfg, mesh, tx, grad_accum_steps=tcfg.grad_accum_steps)
+        def _state_from_hf():
+            # Full finetune from HF weights: build the TrainState around
+            # the imported base directly (no throwaway random init).
+            import jax.numpy as jnp
+            from skypilot_tpu.parallel import mesh as mesh_lib
+            shardings = train_lib.state_shardings(cfg, mesh, tx)
+            params = jax.device_put(load_base(), shardings.params)
+            with mesh_lib.use_mesh(mesh):
+                opt_state = jax.jit(
+                    tx.init, out_shardings=shardings.opt_state)(params)
+            return train_lib.TrainState(step=jnp.zeros((), jnp.int32),
+                                        params=params,
+                                        opt_state=opt_state)
+
+        if tcfg.ckpt_dir:
+            from skypilot_tpu.train import checkpoints
+            if load_base is not None:
+                # Peek before restore_or_init would materialize a random
+                # init we'd immediately discard for the HF weights.
+                ckpt = checkpoints.Checkpointer(tcfg.ckpt_dir)
+                latest = ckpt.latest_step()
+                if latest is None:
+                    state, start_step = _state_from_hf(), 0
+                else:
+                    state, start_step = ckpt.restore(cfg, mesh, tx,
+                                                     step=latest)
+                    logger.info(f'Resumed from checkpoint step '
+                                f'{start_step} in {tcfg.ckpt_dir}.')
+            else:
+                state, start_step, ckpt = checkpoints.restore_or_init(
+                    tcfg.ckpt_dir, cfg, mesh, tx)
+        elif load_base is not None:
+            state = _state_from_hf()
+        else:
+            state = train_lib.init_train_state(jax.random.PRNGKey(0), cfg,
+                                               mesh, tx)
+        if tcfg.batch_size % tcfg.grad_accum_steps != 0:
+            raise ValueError(
+                f'batch_size={tcfg.batch_size} must be divisible by '
+                f'grad_accum_steps={tcfg.grad_accum_steps}')
+        step_fn = train_lib.make_train_step(
+            cfg, mesh, tx, grad_accum_steps=tcfg.grad_accum_steps)
     batches = _batch_iter(tcfg, cfg.vocab_size, start_step, mesh)
 
     eval_fn = None
@@ -146,17 +287,26 @@ def train(tcfg: TrainerConfig) -> List[Dict[str, float]]:
         eval_tokens = loader_lib.load_tokens(tcfg.eval_data_path,
                                              tcfg.tokenizer)
         eval_step = train_lib.make_eval_step(cfg, mesh)
+        if lora_mode:
+            from skypilot_tpu.train import lora as lora_lib
+            merged_of = jax.jit(
+                lambda a: lora_lib.merge_into(base_params, a, lcfg))
+
+        def _eval_params():
+            return (merged_of(state.adapters) if lora_mode
+                    else state.params)
 
         def eval_fn():
             # Fixed batches 0..K-1 of the eval corpus: the metric is
             # comparable across steps AND across resumed runs.
+            eval_params = _eval_params()
             total = 0.0
             for i in range(tcfg.eval_batches):
                 eb = loader_lib.batch_at_step(eval_tokens, i,
                                               tcfg.batch_size,
                                               tcfg.seq_len)
                 eb = loader_lib.shard_batch({'tokens': eb}, mesh)
-                total += float(eval_step(state.params, eb))
+                total += float(eval_step(eval_params, eb))
             return total / tcfg.eval_batches
 
     history: List[Dict[str, float]] = []
@@ -188,8 +338,15 @@ def train(tcfg: TrainerConfig) -> List[Dict[str, float]]:
                 logger.info(json.dumps(rec))
             if ckpt is not None and (step + 1) % tcfg.ckpt_every == 0:
                 ckpt.save(state, step + 1)
+            if (lora_mode and tcfg.lora_dir and
+                    (step + 1) % tcfg.ckpt_every == 0):
+                lora_lib.save_adapters(tcfg.lora_dir, state, lcfg)
         if ckpt is not None:
             ckpt.save(state, tcfg.total_steps)
+        if (lora_mode and tcfg.lora_dir and
+                tcfg.total_steps % tcfg.ckpt_every != 0):
+            # The in-loop cadence already saved on aligned totals.
+            lora_lib.save_adapters(tcfg.lora_dir, state, lcfg)
     finally:
         if ckpt is not None:
             # Exit flush barrier: async saves must be durable before the
@@ -222,6 +379,18 @@ def main() -> None:
                              '--eval-every steps.')
     parser.add_argument('--eval-every', type=int, default=50)
     parser.add_argument('--eval-batches', type=int, default=8)
+    parser.add_argument('--lora-rank', type=int, default=0,
+                        help='>0 trains LoRA adapters instead of full '
+                             'params (train/lora.py).')
+    parser.add_argument('--lora-alpha', type=float, default=32.0)
+    parser.add_argument('--lora-targets', default=None,
+                        help='Comma list of leaf names to adapt '
+                             '(default: wq,wk,wv,wo).')
+    parser.add_argument('--hf-dir', default=None,
+                        help='HF checkpoint to finetune from (config + '
+                             'base weights; preset ignored).')
+    parser.add_argument('--lora-dir', default=None,
+                        help='Directory for adapters.npz (save/resume).')
     args = parser.parse_args()
 
     def _parse_kv(items):
@@ -250,7 +419,12 @@ def main() -> None:
         tokenizer=args.tokenizer, ckpt_dir=args.ckpt_dir,
         ckpt_every=args.ckpt_every, grad_accum_steps=args.grad_accum,
         eval_data_path=args.eval_data, eval_every=args.eval_every,
-        eval_batches=args.eval_batches)
+        eval_batches=args.eval_batches,
+        lora_rank=args.lora_rank, lora_alpha=args.lora_alpha,
+        lora_targets=([t.strip() for t in args.lora_targets.split(',')
+                       if t.strip()]
+                      if args.lora_targets else None),
+        hf_dir=args.hf_dir, lora_dir=args.lora_dir)
     train(tcfg)
 
 

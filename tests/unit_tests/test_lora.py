@@ -1,0 +1,274 @@
+"""LoRA finetuning: adapter math, families, sharding, persistence,
+HF round-trip (train/lora.py, models/hf_export.py).
+
+Reference analog: llm/llama-3_1-finetuning/lora.yaml (torchtune LoRA →
+HF-format output dir served by vLLM). Here the whole loop is native.
+"""
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu import models as models_lib
+from skypilot_tpu.models import hf_export, hf_import, llama
+from skypilot_tpu.parallel import MeshSpec, build_mesh
+from skypilot_tpu.train import lora, train_lib
+
+
+def _batch(cfg, batch=8, seq=32, seed=0):
+    rng = np.random.default_rng(seed)
+    toks = rng.integers(0, cfg.vocab_size, size=(batch, seq + 1))
+    return {'tokens': jnp.asarray(toks, jnp.int32)}
+
+
+@pytest.fixture(scope='module')
+def debug_base():
+    cfg = models_lib.get_config('llama-debug')
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+class TestAdapterMath:
+
+    def test_fresh_adapters_merge_to_exact_base(self, debug_base):
+        cfg, base = debug_base
+        lcfg = lora.LoRAConfig(rank=4)
+        adapters = lora.init_adapters(jax.random.PRNGKey(1), base, lcfg)
+        assert sorted(adapters) == ['layers/wk', 'layers/wo', 'layers/wq',
+                                    'layers/wv']
+        for ab in adapters.values():
+            assert ab['b'].min() == ab['b'].max() == 0.0
+        merged = lora.merge_into(base, adapters, lcfg)
+        for b, m in zip(jax.tree.leaves(base), jax.tree.leaves(merged)):
+            np.testing.assert_array_equal(np.asarray(b), np.asarray(m))
+
+    def test_merge_changes_only_targets(self, debug_base):
+        cfg, base = debug_base
+        lcfg = lora.LoRAConfig(rank=4, targets=('wq',))
+        adapters = lora.init_adapters(jax.random.PRNGKey(1), base, lcfg)
+        adapters['layers/wq']['b'] = jnp.ones_like(
+            adapters['layers/wq']['b'])
+        merged = lora.merge_into(base, adapters, lcfg)
+        assert not np.allclose(np.asarray(merged['layers']['wq']),
+                               np.asarray(base['layers']['wq']))
+        np.testing.assert_array_equal(np.asarray(merged['layers']['wk']),
+                                      np.asarray(base['layers']['wk']))
+        # Delta equals scaling * A @ B exactly (fp32 tree).
+        want = (np.asarray(base['layers']['wq'], np.float32) +
+                lcfg.scaling * np.einsum(
+                    'lir,lro->lio',
+                    np.asarray(adapters['layers/wq']['a'], np.float32),
+                    np.asarray(adapters['layers/wq']['b'], np.float32)))
+        np.testing.assert_allclose(np.asarray(merged['layers']['wq']),
+                                   want, rtol=1e-6)
+
+    def test_moe_expert_leaves_adapt_with_leading_axes(self):
+        cfg = models_lib.get_config('moe-debug')
+        mod = models_lib.module_for(cfg)
+        base = mod.init_params(jax.random.PRNGKey(0), cfg)
+        lcfg = lora.LoRAConfig(rank=2, targets=('w_gate', 'wq'))
+        adapters = lora.init_adapters(jax.random.PRNGKey(1), base, lcfg)
+        # Expert weight [L, E, in, out] → A [L, E, in, r].
+        assert adapters['layers/w_gate']['a'].shape == (
+            cfg.n_layers, cfg.n_experts, cfg.dim, 2)
+        adapters['layers/w_gate']['b'] = 0.01 * jnp.ones_like(
+            adapters['layers/w_gate']['b'])
+        merged = lora.merge_into(base, adapters, lcfg)
+        assert not np.allclose(np.asarray(merged['layers']['w_gate']),
+                               np.asarray(base['layers']['w_gate']))
+
+    def test_unmatched_targets_fail_loudly(self, debug_base):
+        cfg, base = debug_base
+        with pytest.raises(ValueError, match='matched no'):
+            lora.init_adapters(jax.random.PRNGKey(0), base,
+                               lora.LoRAConfig(targets=('nope',)))
+
+
+class TestLoRATrainStep:
+
+    def test_loss_drops_and_base_is_frozen(self, debug_base):
+        cfg, _ = debug_base
+        mesh = build_mesh(MeshSpec())
+        tx = train_lib.default_optimizer(learning_rate=1e-2,
+                                         warmup_steps=1, total_steps=20)
+        base = llama.init_params(jax.random.PRNGKey(0), cfg)
+        base = lora.shard_base_params(base, cfg, mesh)
+        base_snapshot = jax.device_get(base)
+        lcfg = lora.LoRAConfig(rank=8)
+        state = lora.init_lora_state(jax.random.PRNGKey(1), base, lcfg, tx)
+        step = lora.make_lora_train_step(cfg, mesh, tx, lcfg)
+        batch = _batch(cfg)
+        losses = []
+        for _ in range(12):
+            state, metrics = step(state, base, batch)
+            losses.append(float(metrics['loss']))
+        assert losses[-1] < losses[0] - 0.1, losses
+        # The base tree never moves — only adapters learn.
+        for before, after in zip(jax.tree.leaves(base_snapshot),
+                                 jax.tree.leaves(jax.device_get(base))):
+            np.testing.assert_array_equal(before, after)
+        assert int(state.step) == 12
+
+    def test_sharded_matches_single_device(self, debug_base):
+        cfg, _ = debug_base
+        tx = train_lib.default_optimizer(learning_rate=5e-3,
+                                         warmup_steps=1, total_steps=10)
+        lcfg = lora.LoRAConfig(rank=4)
+        from skypilot_tpu.parallel import mesh as mesh_lib
+        losses = {}
+        for name, mesh in (('single', mesh_lib.single_device_mesh()),
+                           ('sharded',
+                            build_mesh(MeshSpec(data=2, tensor=2)))):
+            base = llama.init_params(jax.random.PRNGKey(0), cfg)
+            base = lora.shard_base_params(base, cfg, mesh)
+            state = lora.init_lora_state(jax.random.PRNGKey(1), base,
+                                         lcfg, tx)
+            step = lora.make_lora_train_step(cfg, mesh, tx, lcfg)
+            batch = _batch(cfg)
+            run = []
+            for _ in range(4):
+                state, metrics = step(state, base, batch)
+                run.append(float(metrics['loss']))
+            losses[name] = run
+        np.testing.assert_allclose(losses['single'], losses['sharded'],
+                                   rtol=2e-4)
+
+    def test_loss_mask_is_honored(self, debug_base):
+        cfg, _ = debug_base
+        mesh = build_mesh(MeshSpec())
+        tx = train_lib.default_optimizer(learning_rate=1e-3,
+                                         warmup_steps=1, total_steps=5)
+        lcfg = lora.LoRAConfig(rank=4)
+        base = lora.shard_base_params(
+            llama.init_params(jax.random.PRNGKey(0), cfg), cfg, mesh)
+        state = lora.init_lora_state(jax.random.PRNGKey(1), base, lcfg, tx)
+        step = lora.make_lora_train_step(cfg, mesh, tx, lcfg)
+        batch = _batch(cfg)
+        batch['loss_mask'] = jnp.zeros(
+            (batch['tokens'].shape[0], batch['tokens'].shape[1] - 1),
+            jnp.float32).at[:, :4].set(1.0)
+        _, metrics = step(state, base, batch)
+        assert float(metrics['tokens']) == 8 * 4
+
+
+class TestPersistence:
+
+    def test_save_load_roundtrip(self, debug_base, tmp_path):
+        cfg, base = debug_base
+        lcfg = lora.LoRAConfig(rank=4, alpha=8.0, targets=('wq', 'wv'))
+        tx = train_lib.default_optimizer()
+        state = lora.init_lora_state(jax.random.PRNGKey(1), base, lcfg, tx)
+        state.adapters['layers/wq']['b'] = jnp.full_like(
+            state.adapters['layers/wq']['b'], 0.5)
+        state = lora.LoRAState(step=jnp.asarray(7, jnp.int32),
+                               adapters=state.adapters,
+                               opt_state=state.opt_state)
+        lora.save_adapters(str(tmp_path), state, lcfg)
+        adapters, lcfg2, step, opt_leaves = lora.load_adapters(
+            str(tmp_path))
+        assert (lcfg2.rank, lcfg2.alpha, lcfg2.targets, step) == (
+            4, 8.0, ('wq', 'wv'), 7)
+        np.testing.assert_array_equal(
+            np.asarray(adapters['layers/wq']['b']),
+            np.asarray(state.adapters['layers/wq']['b']))
+        # Optimizer state (Adam moments + schedule count) rides along.
+        restored = lora.restore_opt_state(tx, adapters, opt_leaves)
+        for a, b in zip(jax.tree.leaves(state.opt_state),
+                        jax.tree.leaves(restored)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+        # Shape drift (different rank) falls back to a fresh init
+        # instead of restoring garbage.
+        lcfg3 = lora.LoRAConfig(rank=2, targets=('wq', 'wv'))
+        ad3 = lora.init_adapters(jax.random.PRNGKey(0),
+                                 debug_base[1], lcfg3)
+        fresh = lora.restore_opt_state(tx, ad3, opt_leaves)
+        for a, b in zip(jax.tree.leaves(fresh),
+                        jax.tree.leaves(tx.init(ad3))):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestHFExportRoundTrip:
+
+    def _tiny_hf_dir(self, tmp_path):
+        """Native-side synthesis: random params + minimal config →
+        save_hf_checkpoint → an importable HF dir."""
+        cfg = llama.LlamaConfig(vocab_size=288, dim=32, n_layers=2,
+                                n_heads=4, n_kv_heads=2, ffn_dim=64,
+                                max_seq_len=64)
+        params = llama.init_params(jax.random.PRNGKey(2), cfg)
+        out = hf_export.save_hf_checkpoint(params, cfg,
+                                           str(tmp_path / 'hf'))
+        return cfg, params, out
+
+    def test_export_import_inverts_exactly(self, tmp_path):
+        cfg, params, out = self._tiny_hf_dir(tmp_path)
+        cfg2, params2 = hf_import.load_hf_checkpoint(out)
+        assert (cfg2.dim, cfg2.n_layers, cfg2.n_heads, cfg2.n_kv_heads,
+                cfg2.ffn_dim, cfg2.vocab_size) == (
+            cfg.dim, cfg.n_layers, cfg.n_heads, cfg.n_kv_heads,
+            cfg.ffn_dim, cfg.vocab_size)
+        flat1 = jax.tree_util.tree_flatten_with_path(params)[0]
+        flat2 = dict(jax.tree_util.tree_flatten_with_path(params2)[0])
+        for path, leaf in flat1:
+            np.testing.assert_allclose(
+                np.asarray(leaf), np.asarray(flat2[path]), rtol=1e-6,
+                err_msg=jax.tree_util.keystr(path))
+
+    def test_merged_export_serves_same_logits(self, tmp_path):
+        cfg, params, out = self._tiny_hf_dir(tmp_path)
+        lcfg = lora.LoRAConfig(rank=2)
+        adapters = lora.init_adapters(jax.random.PRNGKey(3), params, lcfg)
+        for ab in adapters.values():
+            ab['b'] = 0.02 * jnp.ones_like(ab['b'])
+        merged = lora.merge_into(params, adapters, lcfg)
+        out2 = hf_export.save_hf_checkpoint(merged, cfg,
+                                            str(tmp_path / 'merged'),
+                                            source_dir=out)
+        _, reimported = hf_import.load_hf_checkpoint(out2)
+        toks = jnp.asarray([[1, 5, 9, 200]], jnp.int32)
+        want = llama.forward(merged, toks, cfg)
+        got = llama.forward(reimported, toks, cfg)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_non_dense_family_refused(self, tmp_path):
+        cfg = models_lib.get_config('moe-debug')
+        mod = models_lib.module_for(cfg)
+        params = mod.init_params(jax.random.PRNGKey(0), cfg)
+        with pytest.raises(ValueError, match='dense Llama/Qwen2'):
+            hf_export.save_hf_checkpoint(params, cfg, str(tmp_path / 'x'))
+
+
+class TestTrainerIntegration:
+
+    def test_lora_finetune_loop_saves_and_resumes(self, tmp_path):
+        from skypilot_tpu.train import trainer
+        lora_dir = str(tmp_path / 'adapters')
+        tcfg = trainer.TrainerConfig(
+            model='llama-debug', batch_size=8, seq_len=32, total_steps=6,
+            learning_rate=5e-3, warmup_steps=1, log_every=3,
+            ckpt_every=3, lora_rank=4, lora_dir=lora_dir)
+        history = trainer.train(tcfg)
+        assert history and history[-1]['step'] == 6
+        assert os.path.exists(os.path.join(lora_dir, 'adapters.npz'))
+        with open(os.path.join(lora_dir, 'lora.json')) as f:
+            assert json.load(f)['step'] == 6
+        # Resume continues from the saved step (no redundant work).
+        tcfg2 = trainer.TrainerConfig(
+            model='llama-debug', batch_size=8, seq_len=32, total_steps=8,
+            learning_rate=5e-3, warmup_steps=1, log_every=2,
+            ckpt_every=4, lora_rank=4, lora_dir=lora_dir)
+        history2 = trainer.train(tcfg2)
+        assert history2[-1]['step'] == 8
+        with open(os.path.join(lora_dir, 'lora.json')) as f:
+            assert json.load(f)['step'] == 8
+
+    def test_lora_rank_and_ckpt_dir_exclusive(self, tmp_path):
+        from skypilot_tpu.train import trainer
+        tcfg = trainer.TrainerConfig(model='llama-debug', lora_rank=2,
+                                     ckpt_dir=str(tmp_path / 'ck'))
+        with pytest.raises(ValueError, match='exclusive'):
+            trainer.train(tcfg)
