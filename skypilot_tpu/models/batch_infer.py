@@ -1,0 +1,336 @@
+"""Offline batch inference: JSONL in → JSONL out, at TPU-batch sizes.
+
+The reference's large-scale batch-inference recipe
+(llm/batch_inference/README.md, batch_compute_vectors.py) computes text
+embeddings over ~30M records by stride-partitioning the dataset across
+many managed-job workers, each resuming past already-written results.
+SkyPilot only orchestrates; the compute is external torch. Here the
+worker itself is native and TPU-first:
+
+  - **Stride partitioning** identical to the reference: worker j of N
+    processes global lines where `idx % N == j`. Defaults ride the gang
+    env contract (SKYPILOT_NODE_RANK / SKYPILOT_NUM_NODES), so
+    `num_nodes: N` in a task YAML fans the file out with zero flags.
+  - **Resume** by reading the worker's own output partition and
+    skipping ids already present (the reference's "skip computed
+    partitions" behavior) — a preempted managed job re-runs the same
+    command and continues where it stopped.
+  - **Length-bucketed ragged batching**: items sort by token length and
+    pad to the batch max rounded to a power of two, so XLA compiles one
+    program per bucket (not per shape) and `prompt_lengths` keeps the
+    padding out of the math — the same contract the serving engine uses.
+  - Two modes: `generate` (decode.generate — greedy/sampled completion
+    per record) and `embed` (final-norm hidden states, mean- or
+    last-token-pooled — the reference recipe's embedding workload).
+  - `--mesh tensor=4,...` shards params by the family's param_specs for
+    models bigger than one chip.
+
+Usage:
+    python -m skypilot_tpu.models.batch_infer \
+        --hf-dir ~/ckpts/Qwen2.5-1.5B --input prompts.jsonl \
+        --output out.jsonl --mode embed --pool mean
+"""
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger('skypilot_tpu.models.batch_infer')
+
+
+def _pooled_hidden(params, tokens, lens, *, cfg, pool: str):
+    """Final-norm hidden states pooled over the REAL tokens (module-level
+    so the jitted callable is stable — one compile per bucket shape)."""
+    import jax.numpy as jnp
+    from skypilot_tpu.models import llama as llama_mod
+    hidden = llama_mod.forward(params, tokens, cfg, return_hidden=True)
+    mask = (jnp.arange(tokens.shape[1])[None, :]
+            < lens[:, None]).astype(jnp.float32)
+    if pool == 'last':
+        idx = jnp.maximum(lens - 1, 0)
+        return jnp.take_along_axis(hidden, idx[:, None, None],
+                                   axis=1)[:, 0, :]
+    return ((hidden * mask[..., None]).sum(axis=1)
+            / jnp.maximum(mask.sum(axis=1), 1.0)[:, None])
+
+
+
+
+def read_items(path: str, num_workers: int, worker_id: int
+               ) -> List[Dict[str, Any]]:
+    """This worker's stride slice of the input JSONL. Each line needs
+    'prompt' or 'text'; 'id' defaults to the global line index (stable
+    across workers/restarts)."""
+    items = []
+    with open(path, 'r', encoding='utf-8') as f:
+        for idx, line in enumerate(f):
+            line = line.strip()
+            if not line or idx % num_workers != worker_id:
+                continue
+            rec = json.loads(line)
+            text = rec.get('prompt', rec.get('text'))
+            if text is None:
+                raise ValueError(
+                    f'{path}:{idx + 1}: record needs "prompt" or "text"')
+            items.append({'id': rec.get('id', idx), 'text': text})
+    return items
+
+
+def done_ids(output_path: str) -> set:
+    """Ids already present in the output partition (resume support).
+    Truncated trailing lines (crash mid-write) are ignored."""
+    done = set()
+    if not os.path.exists(output_path):
+        return done
+    with open(output_path, 'r', encoding='utf-8') as f:
+        for line in f:
+            try:
+                done.add(json.loads(line)['id'])
+            except (json.JSONDecodeError, KeyError):
+                continue
+    return done
+
+
+class BatchRunner:
+    """Owns params + tokenizer + the bucketed batch loop."""
+
+    def __init__(self, model: Optional[str] = None,
+                 hf_dir: Optional[str] = None,
+                 tokenizer_path: Optional[str] = None,
+                 mesh_spec: Optional[Dict[str, int]] = None,
+                 max_len: int = 2048):
+        import jax
+        from skypilot_tpu.data import tokenizer as tokenizer_lib
+        from skypilot_tpu.models import get_config, mla, module_for
+        from skypilot_tpu.parallel import MeshSpec, build_mesh
+        from skypilot_tpu.parallel import sharding as sharding_lib
+
+        if hf_dir:
+            from skypilot_tpu.models import hf_import
+            self.cfg, params = hf_import.load_hf_checkpoint(hf_dir)
+            self.eos_extra = hf_import.hf_eos_ids(hf_dir)
+        else:
+            if model is None:
+                raise ValueError('need --model or --hf-dir')
+            self.cfg = get_config(model)
+            params = jax.jit(
+                lambda r: module_for(self.cfg).init_params(r, self.cfg))(
+                    jax.random.PRNGKey(0))
+            self.eos_extra = []
+        self.is_mla = isinstance(self.cfg, mla.MLAConfig)
+        self.mod = module_for(self.cfg)
+        self.max_len = min(max_len, self.cfg.max_seq_len)
+
+        if tokenizer_path:
+            self.tokenizer = tokenizer_lib.load_tokenizer(
+                tokenizer_path, eos_extra=self.eos_extra)
+        elif hf_dir:
+            # Raises loudly when tokenizer.json is missing — a byte
+            # fallback against a real-vocab model would write millions
+            # of well-formed but meaningless records with exit 0 (same
+            # refusal the serving engine makes).
+            self.tokenizer = tokenizer_lib.load_tokenizer(
+                os.path.join(os.path.expanduser(hf_dir),
+                             'tokenizer.json'),
+                eos_extra=self.eos_extra)
+        else:
+            self.tokenizer = tokenizer_lib.ByteTokenizer()
+
+        self.mesh = build_mesh(MeshSpec(**(mesh_spec or {})))
+        specs = self.mod.param_specs(self.cfg, sharding_lib.Rules())
+        shardings = sharding_lib.tree_shardings(self.mesh, specs)
+        self.params = jax.tree.map(jax.device_put, params, shardings)
+        self._embed_fns: Dict[str, Any] = {}   # pool → jitted fn
+
+    # ------------------------------------------------------------------
+    def _pad_batch(self, token_rows: List[List[int]], width_cap: int
+                   ) -> Tuple[Any, Any, int]:
+        """Pad to the batch max rounded to a power of two, capped at
+        `width_cap`. Rows longer than the cap are RIGHT-TRUNCATED (the
+        job must always make progress — a crash here would loop every
+        managed-job restart on the same record)."""
+        import jax.numpy as jnp
+        import numpy as np
+        from skypilot_tpu.models import decode as decode_lib
+        lengths = [len(r) for r in token_rows]
+        width = min(decode_lib.bucket_size(max(lengths)), width_cap)
+        if any(n > width for n in lengths):
+            logger.warning(
+                f'{sum(n > width for n in lengths)} prompt(s) truncated '
+                f'to {width} tokens (generation headroom under '
+                f'--max-len {self.max_len}).')
+        arr = np.zeros((len(token_rows), width), np.int32)
+        for i, row in enumerate(token_rows):
+            row = row[:width]
+            arr[i, :len(row)] = row
+            lengths[i] = len(row)
+        return (jnp.asarray(arr), jnp.asarray(lengths, jnp.int32), width)
+
+    def generate_batch(self, token_rows: List[List[int]],
+                       max_new_tokens: int, temperature: float,
+                       top_k: Optional[int], top_p: Optional[float],
+                       seed: int) -> List[List[int]]:
+        """→ per-row generated ids, truncated at the first EOS."""
+        import jax
+        import jax.numpy as jnp
+        from skypilot_tpu.models import decode as decode_lib
+        from skypilot_tpu.parallel import mesh as mesh_lib
+        dec = self.mod if self.is_mla else decode_lib
+        if max_new_tokens >= self.max_len:
+            raise ValueError(
+                f'--max-new-tokens {max_new_tokens} leaves no prompt '
+                f'room under --max-len {self.max_len}')
+        # Width cap reserves the generation budget by construction —
+        # no batch composition can make budget <= 0.
+        prompt, lengths, width = self._pad_batch(
+            token_rows, self.max_len - max_new_tokens)
+        budget = max_new_tokens
+        eos = self.tokenizer.eos_ids[0] if getattr(
+            self.tokenizer, 'eos_ids', None) else None
+        with mesh_lib.use_mesh(self.mesh):
+            out = dec.generate(
+                self.params, prompt, self.cfg, budget,
+                max_len=width + budget, temperature=temperature,
+                eos_id=eos, top_k=top_k, top_p=top_p,
+                prompt_lengths=lengths, rng=jax.random.PRNGKey(seed))
+        out = jax.device_get(out)
+        eos_set = set(getattr(self.tokenizer, 'eos_ids', []) or [])
+        rows = []
+        for i in range(out.shape[0]):
+            ids = []
+            for t in out[i].tolist():
+                if t in eos_set:
+                    break
+                ids.append(int(t))
+            rows.append(ids)
+        return rows
+
+    def embed_batch(self, token_rows: List[List[int]],
+                    pool: str = 'mean') -> List[List[float]]:
+        """→ per-row embedding (final-norm hidden, pooled over the real
+        tokens; padding never enters the pool)."""
+        import jax
+        from skypilot_tpu.models import llama as llama_mod
+        from skypilot_tpu.parallel import mesh as mesh_lib
+        if self.mod is not llama_mod:
+            # Only llama.forward implements return_hidden (covers the
+            # Llama/Qwen/Gemma dense presets — the reference recipe's
+            # gte-Qwen2 embedder is this architecture).
+            raise ValueError(
+                f'embed mode supports the dense family only, not '
+                f'{type(self.cfg).__name__}')
+        prompt, lengths, _ = self._pad_batch(token_rows, self.max_len)
+        fn = self._embed_fns.get(pool)
+        if fn is None:
+            fn = self._embed_fns[pool] = jax.jit(
+                functools.partial(_pooled_hidden, cfg=self.cfg,
+                                  pool=pool))
+        with mesh_lib.use_mesh(self.mesh):
+            out = fn(self.params, prompt, lengths)
+        return [row.tolist() for row in jax.device_get(out)]
+
+
+def run(args) -> Dict[str, int]:
+    num_workers = args.num_workers or int(
+        os.environ.get('SKYPILOT_NUM_NODES', '1'))
+    worker_id = (args.worker_id if args.worker_id is not None
+                 else int(os.environ.get('SKYPILOT_NODE_RANK', '0')))
+    if not 0 <= worker_id < num_workers:
+        raise ValueError(f'worker_id {worker_id} outside [0, '
+                         f'{num_workers})')
+    out_path = (args.output if num_workers == 1
+                else f'{args.output}.part{worker_id}')
+
+    items = read_items(args.input, num_workers, worker_id)
+    done = done_ids(out_path)
+    todo = [it for it in items if it['id'] not in done]
+    logger.info(f'worker {worker_id}/{num_workers}: {len(items)} items, '
+                f'{len(done)} already done, {len(todo)} to run '
+                f'→ {out_path}')
+    if not todo:
+        return {'total': len(items), 'done': len(done), 'ran': 0}
+
+    runner = BatchRunner(model=args.model, hf_dir=args.hf_dir,
+                         tokenizer_path=args.tokenizer,
+                         mesh_spec=args.mesh, max_len=args.max_len)
+    for it in todo:
+        it['tokens'] = runner.tokenizer.encode(it['text'])
+    # Length-sorted → batches are near-uniform → minimal padding waste
+    # and few compiled bucket shapes.
+    todo.sort(key=lambda it: len(it['tokens']))
+
+    ran = 0
+    t0 = time.perf_counter()
+    with open(out_path, 'a', encoding='utf-8') as f:
+        for lo in range(0, len(todo), args.batch_size):
+            chunk = todo[lo:lo + args.batch_size]
+            rows = [it['tokens'] for it in chunk]
+            if args.mode == 'embed':
+                embs = runner.embed_batch(rows, pool=args.pool)
+                for it, e in zip(chunk, embs):
+                    f.write(json.dumps(
+                        {'id': it['id'],
+                         'embedding': [round(v, 6) for v in e]}) + '\n')
+            else:
+                outs = runner.generate_batch(
+                    rows, args.max_new_tokens, args.temperature,
+                    args.top_k, args.top_p, seed=args.seed + lo)
+                for it, ids in zip(chunk, outs):
+                    f.write(json.dumps(
+                        {'id': it['id'],
+                         'completion': runner.tokenizer.decode(ids),
+                         'tokens': len(ids)}) + '\n')
+            f.flush()
+            ran += len(chunk)
+            if ran % (args.batch_size * 8) == 0 or ran == len(todo):
+                rate = ran / max(time.perf_counter() - t0, 1e-9)
+                logger.info(f'{ran}/{len(todo)} ({rate:.2f} items/s)')
+    return {'total': len(items), 'done': len(done), 'ran': ran}
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser(prog='skytpu-batch-infer')
+    parser.add_argument('--input', required=True, help='JSONL of '
+                        '{"prompt"|"text": ..., "id"?: ...} records.')
+    parser.add_argument('--output', required=True)
+    parser.add_argument('--mode', choices=('generate', 'embed'),
+                        default='generate')
+    parser.add_argument('--model', default=None)
+    parser.add_argument('--hf-dir', default=None)
+    parser.add_argument('--tokenizer', default=None)
+    parser.add_argument('--mesh', default='',
+                        help='axis=N comma list (e.g. tensor=4).')
+    parser.add_argument('--batch-size', type=int, default=32)
+    parser.add_argument('--max-len', type=int, default=2048)
+    parser.add_argument('--max-new-tokens', type=int, default=128)
+    parser.add_argument('--temperature', type=float, default=0.0)
+    parser.add_argument('--top-k', type=int, default=None)
+    parser.add_argument('--top-p', type=float, default=None)
+    parser.add_argument('--seed', type=int, default=0)
+    parser.add_argument('--pool', choices=('mean', 'last'),
+                        default='mean')
+    parser.add_argument('--num-workers', type=int, default=None,
+                        help='Stride width (default: '
+                             '$SKYPILOT_NUM_NODES).')
+    parser.add_argument('--worker-id', type=int, default=None,
+                        help='This worker (default: '
+                             '$SKYPILOT_NODE_RANK).')
+    args = parser.parse_args()
+    mesh = {}
+    if args.mesh:
+        for part in args.mesh.split(','):
+            k, v = part.split('=')
+            mesh[k.strip()] = int(v)
+    args.mesh = mesh
+    stats = run(args)
+    logger.info(json.dumps(stats))
+
+
+if __name__ == '__main__':
+    main()

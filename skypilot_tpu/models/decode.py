@@ -27,6 +27,16 @@ import jax.numpy as jnp
 
 from skypilot_tpu.models import llama
 from skypilot_tpu.ops import norms, rotary
+
+
+def bucket_size(n: int, floor: int = 16) -> int:
+    """Round up to a power of two — the shared prompt-bucketing contract
+    (bounded XLA compile count) used by the serving engine and offline
+    batch inference alike."""
+    b = floor
+    while b < n:
+        b *= 2
+    return b
 from skypilot_tpu.ops.attention import attention as _attention
 from skypilot_tpu.parallel import sharding as sharding_lib
 

@@ -514,11 +514,15 @@ def forward(params: Params,
             cfg: LlamaConfig,
             rules: Optional[sharding_lib.Rules] = None,
             positions: Optional[jnp.ndarray] = None,
-            q_offset: int | jnp.ndarray = 0) -> jnp.ndarray:
+            q_offset: int | jnp.ndarray = 0,
+            return_hidden: bool = False) -> jnp.ndarray:
     """tokens [B, S] int32 → logits [B, S, vocab] (fp32).
 
     `positions`/`q_offset` allow context-parallel callers to pass shard-local
-    global positions.
+    global positions. `return_hidden=True` returns the final-norm hidden
+    states [B, S, D] fp32 instead of logits (embedding extraction — the
+    reference's flagship batch-inference workload computes text embeddings
+    with an LLM, llm/batch_inference/README.md).
     """
     rules = rules or sharding_lib.Rules()
     con = functools.partial(sharding_lib.constrain, rules=rules)
@@ -573,6 +577,8 @@ def forward(params: Params,
 
     x = norms.rms_norm(x, params['final_norm'], cfg.rms_eps,
                        scale_plus_one=cfg.norm_plus_one)
+    if return_hidden:
+        return con(x.astype(jnp.float32), 'batch', 'seq', 'act_embed')
     head = (params['embed'].T if cfg.tie_embeddings else params['lm_head'])
     logits = jnp.einsum('bsd,dv->bsv', x, head.astype(cfg.dtype),
                         preferred_element_type=jnp.float32)

@@ -136,11 +136,10 @@ def _truncate_at_stop_strings(text: str, stop) -> Tuple[str, bool]:
 
 
 def _bucket(n: int, floor: int = 16) -> int:
-    """Round up to a power of two (bounded compile count)."""
-    b = floor
-    while b < n:
-        b *= 2
-    return b
+    """Round up to a power of two (bounded compile count; shared
+    contract lives in models/decode.bucket_size)."""
+    from skypilot_tpu.models import decode as decode_lib
+    return decode_lib.bucket_size(n, floor)
 
 
 class InferenceEngine:

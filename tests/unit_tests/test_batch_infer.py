@@ -1,0 +1,186 @@
+"""Offline batch inference (models/batch_infer.py): stride partition,
+resume, ragged batching, generate + embed modes.
+
+Reference analog: llm/batch_inference/ (stride-partitioned embedding
+generation with per-worker resume).
+"""
+import argparse
+import json
+import os
+
+import numpy as np
+import pytest
+
+from skypilot_tpu.models import batch_infer
+
+
+def _write_jsonl(path, records):
+    with open(path, 'w', encoding='utf-8') as f:
+        for r in records:
+            f.write(json.dumps(r) + '\n')
+
+
+def _args(**kw):
+    base = dict(input=None, output=None, mode='generate', model=None,
+                hf_dir=None, tokenizer=None, mesh={}, batch_size=4,
+                max_len=256, max_new_tokens=8, temperature=0.0,
+                top_k=None, top_p=None, seed=0, pool='mean',
+                num_workers=1, worker_id=0)
+    base.update(kw)
+    return argparse.Namespace(**base)
+
+
+class TestPartitioning:
+
+    def test_stride_and_default_ids(self, tmp_path):
+        path = str(tmp_path / 'in.jsonl')
+        _write_jsonl(path, [{'prompt': f'p{i}'} for i in range(7)])
+        w0 = batch_infer.read_items(path, 2, 0)
+        w1 = batch_infer.read_items(path, 2, 1)
+        assert [it['id'] for it in w0] == [0, 2, 4, 6]
+        assert [it['id'] for it in w1] == [1, 3, 5]
+        assert w0[1]['text'] == 'p2'
+
+    def test_explicit_ids_and_text_key(self, tmp_path):
+        path = str(tmp_path / 'in.jsonl')
+        _write_jsonl(path, [{'id': 'a', 'text': 'hello'},
+                            {'id': 'b', 'prompt': 'world'}])
+        items = batch_infer.read_items(path, 1, 0)
+        assert [(it['id'], it['text']) for it in items] == [
+            ('a', 'hello'), ('b', 'world')]
+
+    def test_missing_text_fails_loudly(self, tmp_path):
+        path = str(tmp_path / 'in.jsonl')
+        _write_jsonl(path, [{'id': 1}])
+        with pytest.raises(ValueError, match='needs "prompt" or "text"'):
+            batch_infer.read_items(path, 1, 0)
+
+    def test_done_ids_skips_corrupt_tail(self, tmp_path):
+        out = str(tmp_path / 'out.jsonl')
+        with open(out, 'w') as f:
+            f.write(json.dumps({'id': 3, 'completion': 'x'}) + '\n')
+            f.write('{"id": 5, "comple')   # crash mid-write
+        assert batch_infer.done_ids(out) == {3}
+
+
+class TestRun:
+
+    def test_generate_resume_and_outputs(self, tmp_path):
+        inp = str(tmp_path / 'in.jsonl')
+        out = str(tmp_path / 'out.jsonl')
+        _write_jsonl(inp, [{'prompt': 'hello world ' * (i + 1)}
+                           for i in range(5)])
+        args = _args(input=inp, output=out, model='llama-debug',
+                     max_new_tokens=4, batch_size=2)
+        stats = batch_infer.run(args)
+        assert stats == {'total': 5, 'done': 0, 'ran': 5}
+        recs = [json.loads(l) for l in open(out)]
+        assert sorted(r['id'] for r in recs) == [0, 1, 2, 3, 4]
+        assert all(isinstance(r['completion'], str) for r in recs)
+        # Second run: everything already present → nothing re-runs.
+        stats2 = batch_infer.run(args)
+        assert stats2['ran'] == 0 and stats2['done'] == 5
+
+    def test_worker_partitions_are_disjoint_and_complete(self, tmp_path):
+        inp = str(tmp_path / 'in.jsonl')
+        out = str(tmp_path / 'out.jsonl')
+        _write_jsonl(inp, [{'prompt': f'item {i}'} for i in range(6)])
+        ids = []
+        for w in range(2):
+            args = _args(input=inp, output=out, model='llama-debug',
+                         max_new_tokens=2, num_workers=2, worker_id=w)
+            batch_infer.run(args)
+            part = f'{out}.part{w}'
+            assert os.path.exists(part)
+            ids += [json.loads(l)['id'] for l in open(part)]
+        assert sorted(ids) == [0, 1, 2, 3, 4, 5]
+
+    def test_overlong_prompt_truncates_instead_of_crash_looping(
+            self, tmp_path):
+        inp = str(tmp_path / 'in.jsonl')
+        out = str(tmp_path / 'out.jsonl')
+        # Byte tokenizer: 1 char = 1 token → 300 tokens > max_len=64.
+        _write_jsonl(inp, [{'prompt': 'x' * 300}, {'prompt': 'tiny'}])
+        args = _args(input=inp, output=out, model='llama-debug',
+                     max_len=64, max_new_tokens=8, batch_size=2)
+        stats = batch_infer.run(args)
+        assert stats['ran'] == 2   # completes; no budget ValueError
+        recs = [json.loads(l) for l in open(out)]
+        assert len(recs) == 2
+
+    def test_max_new_tokens_exceeding_max_len_fails_loudly(
+            self, tmp_path):
+        inp = str(tmp_path / 'in.jsonl')
+        _write_jsonl(inp, [{'prompt': 'hi'}])
+        args = _args(input=inp, output=str(tmp_path / 'o.jsonl'),
+                     model='llama-debug', max_len=32, max_new_tokens=32)
+        with pytest.raises(ValueError, match='no prompt room'):
+            batch_infer.run(args)
+
+    def test_hf_dir_without_tokenizer_refused(self, tmp_path):
+        # Weights-only dir: silently byte-tokenizing against a real
+        # vocab would write garbage at scale — must raise instead.
+        import jax
+        from skypilot_tpu.models import hf_export, llama
+        cfg = llama.LlamaConfig(vocab_size=288, dim=32, n_layers=1,
+                                n_heads=4, n_kv_heads=2, ffn_dim=64,
+                                max_seq_len=64)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        out = hf_export.save_hf_checkpoint(params, cfg,
+                                           str(tmp_path / 'hf'))
+        with pytest.raises(FileNotFoundError, match='okenizer'):
+            batch_infer.BatchRunner(hf_dir=out)
+
+    def test_gang_env_defaults(self, tmp_path, monkeypatch):
+        inp = str(tmp_path / 'in.jsonl')
+        out = str(tmp_path / 'out.jsonl')
+        _write_jsonl(inp, [{'prompt': f'i{i}'} for i in range(4)])
+        monkeypatch.setenv('SKYPILOT_NUM_NODES', '2')
+        monkeypatch.setenv('SKYPILOT_NODE_RANK', '1')
+        args = _args(input=inp, output=out, model='llama-debug',
+                     max_new_tokens=2, num_workers=None, worker_id=None)
+        stats = batch_infer.run(args)
+        assert stats['total'] == 2   # the odd-indexed half
+        assert os.path.exists(f'{out}.part1')
+
+
+class TestEmbed:
+
+    def test_embeddings_shape_and_padding_invariance(self, tmp_path):
+        inp = str(tmp_path / 'in.jsonl')
+        out = str(tmp_path / 'emb.jsonl')
+        # One short record alone...
+        _write_jsonl(inp, [{'id': 'solo', 'text': 'short one'}])
+        args = _args(input=inp, output=out, mode='embed',
+                     model='llama-debug')
+        batch_infer.run(args)
+        solo = json.loads(open(out).readline())['embedding']
+
+        # ...then the same record batched next to a much longer one
+        # (forces padding): its embedding must not change.
+        inp2 = str(tmp_path / 'in2.jsonl')
+        out2 = str(tmp_path / 'emb2.jsonl')
+        _write_jsonl(inp2, [{'id': 'solo', 'text': 'short one'},
+                            {'id': 'long',
+                             'text': 'a much longer record ' * 10}])
+        args2 = _args(input=inp2, output=out2, mode='embed',
+                      model='llama-debug', batch_size=2)
+        batch_infer.run(args2)
+        recs = {json.loads(l)['id']: json.loads(l)['embedding']
+                for l in open(out2)}
+        from skypilot_tpu import models as models_lib
+        cfg = models_lib.get_config('llama-debug')
+        assert len(solo) == cfg.dim and len(recs['long']) == cfg.dim
+        np.testing.assert_allclose(recs['solo'], solo, atol=2e-4)
+
+    def test_pool_modes_differ(self, tmp_path):
+        inp = str(tmp_path / 'in.jsonl')
+        _write_jsonl(inp, [{'text': 'several words in here'}])
+        embs = {}
+        for pool in ('mean', 'last'):
+            out = str(tmp_path / f'{pool}.jsonl')
+            args = _args(input=inp, output=out, mode='embed',
+                         model='llama-debug', pool=pool)
+            batch_infer.run(args)
+            embs[pool] = json.loads(open(out).readline())['embedding']
+        assert not np.allclose(embs['mean'], embs['last'])
