@@ -52,6 +52,16 @@ class S3CloudStorage(CloudStorage):
                 f'(mkdir -p {dst} && aws s3{ep_arg} sync {src} {dst}))')
 
 
+class AzureBlobCloudStorage(CloudStorage):
+    """Azure blob URLs (https://ACCOUNT.blob.core.windows.net/...) —
+    matched by HOST, before the generic https handler (reference analog:
+    sky/data/storage.py:2680 AzureBlobStore)."""
+
+    def make_sync_command(self, source: str, destination: str) -> str:
+        from skypilot_tpu.data import azure_blob
+        return azure_blob.azcopy_copy_command(source, destination)
+
+
 class HttpCloudStorage(CloudStorage):
 
     def make_sync_command(self, source: str, destination: str) -> str:
@@ -62,18 +72,26 @@ class HttpCloudStorage(CloudStorage):
                 f'wget -q {shlex.quote(source)} -O {dst})')
 
 
-_REGISTRY = {
-    'gs://': GcsCloudStorage(),
-    's3://': S3CloudStorage(),
-    'r2://': S3CloudStorage(),
-    'nebius://': S3CloudStorage(),
-    'http://': HttpCloudStorage(),
-    'https://': HttpCloudStorage(),
-}
+def _build_registry():
+    # The S3-family entries derive from the provider table so a new
+    # provider in data/s3_compat.py is reachable here automatically.
+    from skypilot_tpu.data import s3_compat
+    s3_store = S3CloudStorage()
+    registry = {'gs://': GcsCloudStorage()}
+    registry.update({scheme: s3_store for scheme in s3_compat.SCHEMES})
+    registry.update({'http://': HttpCloudStorage(),
+                     'https://': HttpCloudStorage()})
+    return registry
+
+
+_REGISTRY = _build_registry()
 
 
 def get_storage_from_path(url: str) -> Optional[CloudStorage]:
     """The CloudStorage for a URL, or None for plain local paths."""
+    from skypilot_tpu.data import azure_blob
+    if azure_blob.is_azure_url(url):
+        return AzureBlobCloudStorage()
     for prefix, store in _REGISTRY.items():
         if url.startswith(prefix):
             return store

@@ -1,0 +1,71 @@
+"""Azure Blob Storage: URL parsing + command builders.
+
+Reference analog: sky/data/storage.py:2680 AzureBlobStore. The canonical
+source form is the same one the reference accepts:
+
+    https://<account>.blob.core.windows.net/<container>[/<path>]
+
+Azure's API is NOT S3-compatible, so this family gets its own builders:
+COPY uses azcopy (auth via AZCOPY_AUTO_LOGIN_TYPE env), MOUNT/
+MOUNT_CACHED ride the same rclone write-back contract as the S3 family
+via an on-the-fly `:azureblob` remote (auth via rclone's env_auth:
+AZURE_STORAGE_ACCOUNT + az-CLI login / MSI / SAS env). SAS tokens are
+never accepted inside source URLs — they would leak into logged
+commands on every host.
+"""
+from __future__ import annotations
+
+import shlex
+from typing import Tuple
+
+from skypilot_tpu import exceptions
+
+_HOST_SUFFIX = '.blob.core.windows.net'
+
+
+def is_azure_url(url: str) -> bool:
+    if not url.startswith(('https://', 'http://')):
+        return False
+    host = url.split('://', 1)[1].split('/', 1)[0]
+    return host.endswith(_HOST_SUFFIX)
+
+
+def split(url: str) -> Tuple[str, str, str]:
+    """(account, container, path) from an Azure blob URL; path may be ''.
+    SAS query strings are rejected here — pass them via env, not the
+    source URL (they would leak into every logged command)."""
+    rest = url.split('://', 1)[1]
+    if '?' in rest:
+        raise exceptions.StorageError(
+            'Azure source URLs must not embed a SAS token (it would leak '
+            'into logged commands) — export AZCOPY_AUTO_LOGIN_TYPE / '
+            'RCLONE_AZUREBLOB_SAS_URL instead.')
+    host, _, tail = rest.partition('/')
+    account = host[:-len(_HOST_SUFFIX)]
+    if not account or not tail:
+        raise exceptions.StorageError(
+            f'Azure blob URLs are https://ACCOUNT{_HOST_SUFFIX}/'
+            f'CONTAINER[/PATH], got {url!r}.')
+    container, _, path = tail.partition('/')
+    return account, container, path.rstrip('/')
+
+
+def rclone_remote(url: str) -> str:
+    """On-the-fly rclone remote for MOUNT/MOUNT_CACHED."""
+    account, container, path = split(url)
+    tail = f'{container}/{path}' if path else container
+    return f':azureblob,account={account},env_auth=true:{tail}'
+
+
+def azcopy_copy_command(url: str, dst: str) -> str:
+    """COPY mode: object-vs-prefix probing like the other families —
+    the single-blob copy is the existence probe, the recursive copy is
+    the fallback."""
+    split(url)   # validates the shape and rejects embedded SAS secrets
+    src = shlex.quote(url.rstrip('/'))
+    src_prefix = shlex.quote(url.rstrip('/') + '/*')
+    dst_q = shlex.quote(dst)
+    return (f'mkdir -p $(dirname {dst_q}) && '
+            f'(azcopy copy {src} {dst_q} 2>/dev/null || '
+            f'(mkdir -p {dst_q} && '
+            f'azcopy copy {src_prefix} {dst_q} --recursive))')

@@ -54,12 +54,15 @@ def _mount_tag(dst: str) -> str:
 
 def _rclone_remote(bucket_url: str) -> str:
     """On-the-fly rclone remote for a bucket URL: :gcs: for gs://,
-    endpoint-parameterized :s3, for the S3-compatible family."""
+    endpoint-parameterized :s3, for the S3-compatible family,
+    :azureblob: for Azure blob URLs."""
     if bucket_url.startswith('gs://'):
         return f':gcs:{shlex.quote(bucket_url[len("gs://"):])}'
-    from skypilot_tpu.data import s3_compat
+    from skypilot_tpu.data import azure_blob, s3_compat
     if s3_compat.scheme_of(bucket_url) is not None:
         return shlex.quote(s3_compat.rclone_remote(bucket_url))
+    if azure_blob.is_azure_url(bucket_url):
+        return shlex.quote(azure_blob.rclone_remote(bucket_url))
     raise ValueError(f'No rclone remote mapping for {bucket_url!r}')
 
 

@@ -1,15 +1,20 @@
-"""S3-compatible object-store providers (R2, Nebius, custom endpoints).
+"""S3-compatible object-store providers (R2, Nebius, OCI, IBM COS, …).
 
 Reference analog: sky/data/storage.py:1468's S3CompatibleStore framework —
 every provider there is "the S3 CLI surface + a different endpoint URL +
 its own credential env". This module is that table for the TPU-native
 stack: schemes normalize to s3:// and the aws CLI / rclone commands get
-an --endpoint-url / `endpoint=` parameter.
+an --endpoint-url / `endpoint=` parameter. (OCI and IBM COS have their
+own SDK-based stores in the reference — storage.py:4039, :3565 — but
+both expose S3-compat APIs, so here they ride this table instead of two
+more SDKs.)
 
 Endpoint resolution (first hit wins):
   1. SKYTPU_<PROVIDER>_ENDPOINT_URL env (hermetic tests use this)
   2. provider-specific construction (R2: from R2_ACCOUNT_ID;
-     Nebius: from NEBIUS_REGION, default eu-north1)
+     Nebius: from NEBIUS_REGION, default eu-north1; OCI: from
+     OCI_NAMESPACE + OCI_REGION; IBM COS: from the region embedded in
+     the URL — cos://REGION/BUCKET/KEY, the reference's canonical form)
 Plain s3:// needs no endpoint (AWS default), but honors
 SKYTPU_S3_ENDPOINT_URL for MinIO/on-prem gateways.
 """
@@ -50,6 +55,18 @@ def _nebius_endpoint() -> Optional[str]:
     return f'https://storage.{region}.nebius.cloud:443'
 
 
+def _oci_endpoint() -> Optional[str]:
+    """OCI Object Storage's S3-compatibility endpoint (reference analog:
+    sky/data/storage.py:4039 OciStore — here it rides the S3 family via
+    OCI's compat API instead of the oci SDK)."""
+    namespace = os.environ.get('OCI_NAMESPACE')
+    region = os.environ.get('OCI_REGION')
+    if not namespace or not region:
+        return None
+    return (f'https://{namespace}.compat.objectstorage.'
+            f'{region}.oraclecloud.com')
+
+
 PROVIDERS: Dict[str, S3CompatProvider] = {
     's3': S3CompatProvider('s3', 'AWS S3', 'SKYTPU_S3_ENDPOINT_URL'),
     'r2': S3CompatProvider('r2', 'Cloudflare R2', 'SKYTPU_R2_ENDPOINT_URL',
@@ -57,6 +74,13 @@ PROVIDERS: Dict[str, S3CompatProvider] = {
     'nebius': S3CompatProvider('nebius', 'Nebius Object Storage',
                                'SKYTPU_NEBIUS_ENDPOINT_URL',
                                _nebius_endpoint),
+    'oci': S3CompatProvider('oci', 'OCI Object Storage',
+                            'SKYTPU_OCI_ENDPOINT_URL', _oci_endpoint),
+    # IBM COS: the region lives IN the URL (cos://REGION/bucket/key, the
+    # reference's canonical form — sky/data/storage.py:3565 IBMCosStore),
+    # so its endpoint resolves per-URL in endpoint_for().
+    'cos': S3CompatProvider('cos', 'IBM Cloud Object Storage',
+                            'SKYTPU_COS_ENDPOINT_URL'),
 }
 
 SCHEMES = tuple(f'{s}://' for s in PROVIDERS)
@@ -70,12 +94,39 @@ def scheme_of(url: str) -> Optional[str]:
     return None
 
 
+def split_path(url: str) -> str:
+    """'bucket/key' for an s3-compat URL (drops cos://'s leading REGION
+    component — it selects the endpoint, not the object path)."""
+    scheme = scheme_of(url)
+    path = url.split('://', 1)[1]
+    if scheme == 'cos':
+        parts = path.split('/', 1)
+        if len(parts) < 2 or not parts[1]:
+            raise exceptions.StorageError(
+                f'IBM COS URLs are cos://REGION/BUCKET[/KEY], got '
+                f'{url!r}.')
+        return parts[1]
+    return path
+
+
+def cos_region_of(url: str) -> str:
+    """The region component of a cos:// URL."""
+    split_path(url)   # validates the shape
+    return url.split('://', 1)[1].split('/', 1)[0]
+
+
 def to_s3_url(url: str) -> str:
     """r2://bucket/key → s3://bucket/key (the CLI-facing form)."""
     scheme = scheme_of(url)
     if scheme is None or scheme == 's3':
         return url
-    return 's3://' + url.split('://', 1)[1]
+    return 's3://' + split_path(url)
+
+
+_ENDPOINT_HINTS = {
+    'r2': ' or R2_ACCOUNT_ID',
+    'oci': ' or OCI_NAMESPACE + OCI_REGION',
+}
 
 
 def endpoint_for(url_or_scheme: str) -> Optional[str]:
@@ -85,11 +136,15 @@ def endpoint_for(url_or_scheme: str) -> Optional[str]:
         return None
     provider = PROVIDERS[scheme]
     ep = provider.endpoint()
+    if ep is None and scheme == 'cos' and '://' in url_or_scheme:
+        region = cos_region_of(url_or_scheme)
+        ep = (f'https://s3.{region}.cloud-object-storage.'
+              f'appdomain.cloud')
     if ep is None and scheme != 's3':
         raise exceptions.StorageError(
             f'{provider.display_name} ({scheme}://) needs an endpoint: '
             f'set {provider.endpoint_env}'
-            + (' or R2_ACCOUNT_ID' if scheme == 'r2' else '') + '.')
+            + _ENDPOINT_HINTS.get(scheme, '') + '.')
     return ep
 
 
@@ -116,7 +171,7 @@ def rclone_remote(url: str) -> str:
     ':' , which every https endpoint contains. Used by the MOUNT /
     MOUNT_CACHED paths.
     """
-    path = url.split('://', 1)[1]
+    path = split_path(url)
     ep = endpoint_for(url)
     opts = 'provider=Other,env_auth=true'
     if ep:

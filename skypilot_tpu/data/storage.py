@@ -34,20 +34,27 @@ class StorageMode(enum.Enum):
 
 class StoreType(enum.Enum):
     GCS = 'gcs'
-    # The whole S3-compatible family (s3/r2/nebius/...): one store class +
-    # an endpoint parameter, the way reference sky/data/storage.py:1468's
-    # S3CompatibleStore generalizes (data/s3_compat.py is the provider
-    # table).
+    # The whole S3-compatible family (s3/r2/nebius/oci/cos/...): one
+    # store class + an endpoint parameter, the way reference
+    # sky/data/storage.py:1468's S3CompatibleStore generalizes
+    # (data/s3_compat.py is the provider table).
     S3 = 's3'
+    # Azure blob is NOT S3-compatible: azcopy for COPY, rclone
+    # :azureblob for the mount modes (reference storage.py:2680
+    # AzureBlobStore; source form https://ACCOUNT.blob.core.windows.net/
+    # CONTAINER/...).
+    AZURE = 'azure'
     LOCAL = 'local'
 
     @classmethod
     def from_source(cls, source: str) -> 'StoreType':
-        from skypilot_tpu.data import s3_compat
+        from skypilot_tpu.data import azure_blob, s3_compat
         if source.startswith('gs://'):
             return cls.GCS
         if s3_compat.scheme_of(source) is not None:
             return cls.S3
+        if azure_blob.is_azure_url(source):
+            return cls.AZURE
         return cls.LOCAL
 
 
@@ -150,6 +157,11 @@ def mount_command_for(storage: Storage, dst: str, local: bool) -> str:
         if storage.mode == StorageMode.COPY:
             return mounting_utils.aws_copy_command(url, dst)
         return mounting_utils.rclone_mount_command(url, dst)
+    if storage.store_type is StoreType.AZURE:
+        from skypilot_tpu.data import azure_blob
+        if storage.mode == StorageMode.COPY:
+            return azure_blob.azcopy_copy_command(url, dst)
+        return mounting_utils.rclone_mount_command(url, dst)
     if storage.mode == StorageMode.COPY:
         return mounting_utils.gsutil_copy_command(url, dst)
     if storage.mode == StorageMode.MOUNT_CACHED:
@@ -166,16 +178,16 @@ def flush_command_for(storage: Storage, dst: str,
     checkpoint only if the pre-preemption write actually reached the
     bucket.
     """
-    s3_mount = (storage.store_type is StoreType.S3 and
-                storage.mode is StorageMode.MOUNT)
-    if storage.mode is not StorageMode.MOUNT_CACHED and not s3_mount:
+    rclone_mount = (storage.store_type in (StoreType.S3, StoreType.AZURE)
+                    and storage.mode is StorageMode.MOUNT)
+    if storage.mode is not StorageMode.MOUNT_CACHED and not rclone_mount:
         return None
     if local:
         source = os.path.expanduser(storage.source or '')
         return mounting_utils.local_cached_flush_command(source, dst)
-    # S3-family MOUNT rides the same rclone write-back cache as
-    # MOUNT_CACHED (no s3fs dependency), so it needs the same exit
-    # barrier for durability.
+    # S3-family and Azure MOUNTs ride the same rclone write-back cache
+    # as MOUNT_CACHED (no s3fs/blobfuse dependency), so they need the
+    # same exit barrier for durability.
     return mounting_utils.rclone_flush_command(dst)
 
 

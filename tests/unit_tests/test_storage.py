@@ -129,6 +129,79 @@ class TestCommandBuilders:
         assert storage_lib.flush_command_for(st, '/data',
                                              local=False) is None
 
+    def test_oci_endpoint_from_namespace_region(self, monkeypatch):
+        from skypilot_tpu.data import s3_compat
+        monkeypatch.delenv('SKYTPU_OCI_ENDPOINT_URL', raising=False)
+        monkeypatch.setenv('OCI_NAMESPACE', 'mytenancy')
+        monkeypatch.setenv('OCI_REGION', 'us-ashburn-1')
+        ep = s3_compat.endpoint_for('oci://bkt/data')
+        assert ep == ('https://mytenancy.compat.objectstorage.'
+                      'us-ashburn-1.oraclecloud.com')
+        assert s3_compat.to_s3_url('oci://bkt/data') == 's3://bkt/data'
+        # Missing envs → loud error naming the knobs.
+        monkeypatch.delenv('OCI_NAMESPACE')
+        with pytest.raises(exceptions.StorageError,
+                           match='OCI_NAMESPACE'):
+            s3_compat.endpoint_for('oci://bkt/data')
+
+    def test_cos_region_lives_in_the_url(self, monkeypatch):
+        """IBM COS keeps the reference's canonical cos://REGION/BUCKET
+        form (sky/data/storage.py:3565): region selects the endpoint and
+        is dropped from the object path."""
+        from skypilot_tpu.data import s3_compat
+        monkeypatch.delenv('SKYTPU_COS_ENDPOINT_URL', raising=False)
+        url = 'cos://eu-de/mybkt/ckpts'
+        assert s3_compat.cos_region_of(url) == 'eu-de'
+        assert s3_compat.to_s3_url(url) == 's3://mybkt/ckpts'
+        assert s3_compat.endpoint_for(url) == (
+            'https://s3.eu-de.cloud-object-storage.appdomain.cloud')
+        assert ':s3,' in s3_compat.rclone_remote(url)
+        assert 'mybkt/ckpts' in s3_compat.rclone_remote(url)
+        assert 'eu-de/mybkt' not in s3_compat.rclone_remote(url)
+        with pytest.raises(exceptions.StorageError, match='REGION/BUCKET'):
+            s3_compat.to_s3_url('cos://only-region')
+        # The store command matrix routes cos through the S3 family.
+        from skypilot_tpu.data import storage as storage_lib
+        st = Storage(source=url, mode=StorageMode.COPY)
+        assert st.store_type is StoreType.S3
+        cmd = storage_lib.mount_command_for(st, '/data', local=False)
+        assert 'aws s3' in cmd and 's3://mybkt/ckpts' in cmd
+        assert 'cloud-object-storage' in cmd
+
+    def test_azure_blob_store_matrix(self):
+        """Azure: azcopy COPY, rclone :azureblob mounts, flush barrier
+        on both mount modes (not S3-compatible — own family)."""
+        from skypilot_tpu.data import azure_blob
+        from skypilot_tpu.data import storage as storage_lib
+        url = 'https://myacct.blob.core.windows.net/cont/ckpts'
+        assert azure_blob.is_azure_url(url)
+        assert not azure_blob.is_azure_url('https://example.com/x')
+        assert azure_blob.split(url) == ('myacct', 'cont', 'ckpts')
+        st = Storage(source=url, mode=StorageMode.COPY)
+        assert st.store_type is StoreType.AZURE
+        cmd = storage_lib.mount_command_for(st, '/data', local=False)
+        assert 'azcopy copy' in cmd and '--recursive' in cmd
+        for mode in (StorageMode.MOUNT, StorageMode.MOUNT_CACHED):
+            st = Storage(source=url, mode=mode)
+            cmd = storage_lib.mount_command_for(st, '/data', local=False)
+            assert 'rclone mount' in cmd
+            assert 'azureblob,account=myacct' in cmd
+            assert 'cont/ckpts' in cmd
+            flush = storage_lib.flush_command_for(st, '/data', local=False)
+            assert flush is not None and 'vfs cache' in flush
+        # SAS tokens in source URLs would leak into logged commands.
+        with pytest.raises(exceptions.StorageError, match='SAS'):
+            azure_blob.split(url + '?sv=2024&sig=SECRET')
+        # cloud_stores: azure matched by HOST before the https handler.
+        from skypilot_tpu import cloud_stores
+        store = cloud_stores.get_storage_from_path(url)
+        assert isinstance(store, cloud_stores.AzureBlobCloudStorage)
+        assert isinstance(
+            cloud_stores.get_storage_from_path('https://example.com/f'),
+            cloud_stores.HttpCloudStorage)
+        sync = store.make_sync_command(url, '/tmp/out')
+        assert 'azcopy' in sync
+
     def test_rclone_cached_mount_and_flush(self):
         cmd = mounting_utils.rclone_mount_command('gs://bkt', '/out')
         assert '--vfs-cache-mode writes' in cmd
