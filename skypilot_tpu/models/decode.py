@@ -27,6 +27,8 @@ import jax.numpy as jnp
 
 from skypilot_tpu.models import llama
 from skypilot_tpu.ops import norms, rotary
+from skypilot_tpu.ops.attention import attention as _attention
+from skypilot_tpu.parallel import sharding as sharding_lib
 
 
 def bucket_size(n: int, floor: int = 16) -> int:
@@ -37,8 +39,6 @@ def bucket_size(n: int, floor: int = 16) -> int:
     while b < n:
         b *= 2
     return b
-from skypilot_tpu.ops.attention import attention as _attention
-from skypilot_tpu.parallel import sharding as sharding_lib
 
 
 @jax.tree_util.register_dataclass
@@ -199,7 +199,7 @@ def _ffn(x: jnp.ndarray, lp, cfg: llama.LlamaConfig) -> jnp.ndarray:
                        scale_plus_one=cfg.norm_plus_one)
     gate = jnp.einsum('bsd,df->bsf', h, _d(lp['w_gate'], cfg.dtype))
     up = jnp.einsum('bsd,df->bsf', h, _d(lp['w_up'], cfg.dtype))
-    down = jnp.einsum('bsf,fd->bsd', cfg.act(gate) * up,
+    down = jnp.einsum('bsf,fd->bsd', cfg.glu(gate, up),
                       _d(lp['w_down'], cfg.dtype))
     if cfg.post_norms:
         down = norms.rms_norm(down, lp['post_mlp_norm'], cfg.rms_eps,
@@ -260,7 +260,9 @@ def prefill(params, tokens: jnp.ndarray, cfg: llama.LlamaConfig,
                     if cfg.sliding_window else None)
         out = _attention(q, k, v, impl=impl, causal=True,
                          logit_softcap=cfg.attn_logit_softcap,
-                         window=cfg.sliding_window, window_active=w_active)
+                         window=cfg.sliding_window, window_active=w_active,
+                         sinks=(lp['sink'].astype(jnp.float32)
+                                if cfg.attn_sinks else None))
         out = out.reshape(b, s, cfg.n_heads * cfg.hd)
         carry = carry + _wo_project(out, lp, cfg)
         carry = carry + _ffn(carry, lp, cfg)
@@ -329,7 +331,9 @@ def decode_step(params, token: jnp.ndarray, cache: KVCache,
         out = _attention(q, k_l, v_l, impl='xla', causal=True,
                          q_offset=length, kv_offset=0,
                          logit_softcap=cfg.attn_logit_softcap,
-                         window=cfg.sliding_window, window_active=w_active)
+                         window=cfg.sliding_window, window_active=w_active,
+                         sinks=(lp['sink'].astype(jnp.float32)
+                                if cfg.attn_sinks else None))
         out = out.reshape(b, 1, cfg.n_heads * cfg.hd)
         x_c = x_c + _wo_project(out, lp, cfg)
         x_c = x_c + _ffn(x_c, lp, cfg)

@@ -28,12 +28,19 @@ Params = Dict[str, Any]
 
 @dataclasses.dataclass(frozen=True)
 class RopeScaling:
-    """Llama-3.1 NTK rope scaling; frozen so configs stay hashable (decode
-    jits with the config as a static argument)."""
+    """Rope scaling; frozen so configs stay hashable (decode jits with
+    the config as a static argument). rope_type 'llama3' uses the NTK
+    low/high_freq_factor fields; 'yarn' (gpt-oss long context) uses
+    beta_fast/beta_slow + the 0.1·ln(factor)+1 concentration factor
+    (override via attention_factor). ops/rotary.py implements both."""
     factor: float = 8.0
     low_freq_factor: float = 1.0
     high_freq_factor: float = 4.0
     original_max_position: int = 8192
+    rope_type: str = 'llama3'
+    beta_fast: float = 32.0
+    beta_slow: float = 1.0
+    attention_factor: Optional[float] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -84,11 +91,27 @@ class LlamaConfig:
     # separate (smaller) rope base for the local sliding-window layers.
     qk_norm: bool = False
     local_rope_theta: Optional[float] = None
+    # gpt-oss additions: learned per-head attention-sink logits (a
+    # phantom key absorbing softmax mass, ops/attention.py), and the
+    # clamped SwiGLU variant (inputs clipped at ±limit, gate activated
+    # with sigmoid(1.702·x), +1 on the linear term).
+    attn_sinks: bool = False
+    swiglu_limit: Optional[float] = None
 
     def act(self, x):
         if self.mlp_activation == 'gelu':
             return jax.nn.gelu(x)           # tanh approximation (Gemma)
         return jax.nn.silu(x)
+
+    def glu(self, gate, up):
+        """The gated-MLP inner product (shared by the dense MLP and the
+        MoE experts)."""
+        if self.swiglu_limit is not None:
+            limit = self.swiglu_limit
+            gate = jnp.minimum(gate, limit)
+            up = jnp.clip(up, -limit, limit)
+            return gate * jax.nn.sigmoid(1.702 * gate) * (up + 1)
+        return self.act(gate) * up
 
     def __post_init__(self):
         if isinstance(self.rope_scaling, dict):
@@ -253,6 +276,11 @@ def init_params(rng: jax.Array, cfg: LlamaConfig) -> Params:
     if cfg.qk_norm:
         params['layers']['q_norm'] = norm_init((L, hd), cfg.param_dtype)
         params['layers']['k_norm'] = norm_init((L, hd), cfg.param_dtype)
+    if cfg.attn_sinks:
+        # Zero-init: exp(0)=1 joins each softmax denominator from step
+        # one (the "sink token" is present immediately, then learned).
+        params['layers']['sink'] = jnp.zeros((L, cfg.n_heads),
+                                             cfg.param_dtype)
     if not cfg.tie_embeddings:
         params['lm_head'] = init(next(k), (D, cfg.vocab_size))
     return params
@@ -290,6 +318,8 @@ def param_specs(cfg: LlamaConfig,
     if cfg.qk_norm:
         specs['layers']['q_norm'] = s('layers', 'norm')
         specs['layers']['k_norm'] = s('layers', 'norm')
+    if cfg.attn_sinks:
+        specs['layers']['sink'] = s('layers', 'heads')
     if not cfg.tie_embeddings:
         specs['lm_head'] = s('embed', 'vocab')
     return specs
@@ -446,6 +476,11 @@ def attention_block(x: jnp.ndarray, lp: Params, cfg: LlamaConfig,
             raise NotImplementedError(
                 'local_rope_theta (dual rope bases) with ring attention '
                 "is not supported; use 'auto'/'xla'.")
+        if cfg.attn_sinks:
+            raise NotImplementedError(
+                'attn_sinks (gpt-oss) with ring attention is not '
+                'supported: the sink logit must join exactly one '
+                "shard's softmax denominator. Use 'auto'/'xla'.")
         from skypilot_tpu.ops import ring_attention as ring_lib
         from skypilot_tpu.ops.attention import _on_tpu
         ring_kw = dict(causal=True,
@@ -472,7 +507,9 @@ def attention_block(x: jnp.ndarray, lp: Params, cfg: LlamaConfig,
                          causal=True, q_offset=q_offset,
                          kv_offset=q_offset,
                          logit_softcap=cfg.attn_logit_softcap,
-                         window=window, window_active=w_active)
+                         window=window, window_active=w_active,
+                         sinks=(lp['sink'].astype(jnp.float32)
+                                if cfg.attn_sinks else None))
     out = out.reshape(b, s_len, cfg.n_heads * hd)
     attn_out = jnp.einsum('bsh,hd->bsd', out, lp['wo'].astype(cfg.dtype))
     if cfg.post_norms:
@@ -493,7 +530,7 @@ def _layer(x: jnp.ndarray, lp: Params, cfg: LlamaConfig,
                        scale_plus_one=cfg.norm_plus_one)
     gate = jnp.einsum('bsd,df->bsf', h, lp['w_gate'].astype(cfg.dtype))
     up = jnp.einsum('bsd,df->bsf', h, lp['w_up'].astype(cfg.dtype))
-    inner = cfg.act(gate) * up
+    inner = cfg.glu(gate, up)
     inner = con(inner, 'batch', 'seq', 'mlp')
     down = jnp.einsum('bsf,fd->bsd', inner, lp['w_down'].astype(cfg.dtype))
     if cfg.post_norms:

@@ -124,6 +124,16 @@ PRESETS: Dict[str, MLAConfig] = {
         rope_theta=10000.0, kv_lora_rank=512, qk_nope_head_dim=128,
         qk_rope_head_dim=64, v_head_dim=128, n_experts=160, top_k=6,
         n_shared_experts=2),
+    # Kimi-K2 geometry (reference recipe llm/kimi-k2/ serves it via
+    # vLLM/SGLang): the DeepSeek-V3 architecture at 1T total / 32B
+    # active — MLA (r=512) + 384 routed experts (2048-wide, top-8) + 1
+    # shared, 64 heads, 61 layers.
+    'kimi-k2': DeepSeekMoEConfig(
+        vocab_size=163840, dim=7168, n_layers=61, n_heads=64,
+        n_kv_heads=64, ffn_dim=2048, max_seq_len=131072,
+        rope_theta=50000.0, kv_lora_rank=512, qk_nope_head_dim=128,
+        qk_rope_head_dim=64, v_head_dim=128, n_experts=384, top_k=8,
+        n_shared_experts=1),
 }
 
 

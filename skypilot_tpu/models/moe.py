@@ -76,6 +76,37 @@ PRESETS: Dict[str, MoEConfig] = {
     'moe-1b': MoEConfig(vocab_size=32768, dim=1024, n_layers=12, n_heads=8,
                         n_kv_heads=4, ffn_dim=4096, max_seq_len=4096,
                         tie_embeddings=True, n_experts=8, top_k=2),
+    # gpt-oss family (reference recipes: llm/gpt-oss/,
+    # llm/gpt-oss-finetuning/): MoE + alternating sliding-window/full
+    # attention + learned attention sinks + clamped SwiGLU + YaRN rope
+    # — every knob composes from the config, no separate module.
+    'gptoss-debug': MoEConfig(
+        vocab_size=256, dim=64, n_layers=2, n_heads=4, n_kv_heads=2,
+        ffn_dim=128, max_seq_len=128, rope_theta=10000.0, remat='none',
+        n_experts=4, top_k=2, qkv_bias=True, attn_sinks=True,
+        swiglu_limit=7.0, sliding_window=32, sliding_window_pattern=2,
+        # Ample capacity: no routed token drops, so decode parity with
+        # the training forward is exact (same note as
+        # deepseek-moe-debug).
+        capacity_factor=4.0,
+        rope_scaling=dict(rope_type='yarn', factor=2.0,
+                          original_max_position=64)),
+    'gpt-oss-20b': MoEConfig(
+        vocab_size=201088, dim=2880, n_layers=24, n_heads=64,
+        n_kv_heads=8, head_dim=64, ffn_dim=2880, max_seq_len=131072,
+        rope_theta=150000.0, n_experts=32, top_k=4, qkv_bias=True,
+        attn_sinks=True, swiglu_limit=7.0, sliding_window=128,
+        sliding_window_pattern=2,
+        rope_scaling=dict(rope_type='yarn', factor=32.0,
+                          original_max_position=4096)),
+    'gpt-oss-120b': MoEConfig(
+        vocab_size=201088, dim=2880, n_layers=36, n_heads=64,
+        n_kv_heads=8, head_dim=64, ffn_dim=2880, max_seq_len=131072,
+        rope_theta=150000.0, n_experts=128, top_k=4, qkv_bias=True,
+        attn_sinks=True, swiglu_limit=7.0, sliding_window=128,
+        sliding_window_pattern=2,
+        rope_scaling=dict(rope_type='yarn', factor=32.0,
+                          original_max_position=4096)),
 }
 
 
@@ -111,6 +142,16 @@ def init_params(rng: jax.Array, cfg: MoEConfig) -> Params:
         },
         'final_norm': jnp.ones((D,), cfg.param_dtype),
     }
+    if cfg.qkv_bias:
+        params['layers']['bq'] = jnp.zeros((L, cfg.n_heads * hd),
+                                           cfg.param_dtype)
+        params['layers']['bk'] = jnp.zeros((L, cfg.n_kv_heads * hd),
+                                           cfg.param_dtype)
+        params['layers']['bv'] = jnp.zeros((L, cfg.n_kv_heads * hd),
+                                           cfg.param_dtype)
+    if cfg.attn_sinks:
+        params['layers']['sink'] = jnp.zeros((L, cfg.n_heads),
+                                             cfg.param_dtype)
     if not cfg.tie_embeddings:
         params['lm_head'] = init(next(k), (D, cfg.vocab_size))
     return params
@@ -138,6 +179,12 @@ def param_specs(cfg: MoEConfig,
         },
         'final_norm': s('norm'),
     }
+    if cfg.qkv_bias:
+        specs['layers']['bq'] = s('layers', 'heads')
+        specs['layers']['bk'] = s('layers', 'kv_heads')
+        specs['layers']['bv'] = s('layers', 'kv_heads')
+    if cfg.attn_sinks:
+        specs['layers']['sink'] = s('layers', 'heads')
     if not cfg.tie_embeddings:
         specs['lm_head'] = s('embed', 'vocab')
     return specs
@@ -205,7 +252,7 @@ def moe_ffn(x: jnp.ndarray, lp: Params, cfg: MoEConfig,
     xin = con(xin, 'expert', 'batch', None, None, 'act_embed')
     gate = jnp.einsum('ebgcd,edf->ebgcf', xin, lp['w_gate'].astype(cfg.dtype))
     up = jnp.einsum('ebgcd,edf->ebgcf', xin, lp['w_up'].astype(cfg.dtype))
-    inner = jax.nn.silu(gate) * up
+    inner = cfg.glu(gate, up)
     inner = con(inner, 'expert', 'batch', None, None, 'mlp')
     out = jnp.einsum('ebgcf,efd->ebgcd', inner,
                      lp['w_down'].astype(cfg.dtype))          # [E,B,G,C,D]

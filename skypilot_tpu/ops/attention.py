@@ -25,7 +25,7 @@ NEG_INF = -2.0 ** 30  # large-but-finite: keeps softmax fp32-safe
 
 def _xla_attention_impl(q, k, v, causal, q_offset, kv_offset, segment_ids,
                         softmax_scale, return_lse, logit_softcap=None,
-                        window=None, window_active=None):
+                        window=None, window_active=None, sinks=None):
     b, s, h, d = q.shape
     t, kh = k.shape[1], k.shape[2]
     groups = h // kh
@@ -78,12 +78,25 @@ def _xla_attention_impl(q, k, v, causal, q_offset, kv_offset, segment_ids,
     if mask is not None:
         scores = jnp.where(mask, scores, NEG_INF)
 
+    if sinks is not None:
+        # Attention sinks (gpt-oss): a learned per-head logit joins the
+        # softmax as a phantom key — it absorbs probability mass (the
+        # denominator grows by exp(sink)) but contributes no value.
+        # Never masked: it is exactly the always-visible "sink token".
+        assert not return_lse, 'sinks not supported on the lse path (ring)'
+        sink_col = jnp.broadcast_to(
+            sinks.astype(jnp.float32).reshape(1, kh, groups, 1, 1),
+            (b, kh, groups, s, 1))
+        scores = jnp.concatenate([scores, sink_col], axis=-1)
+
     if return_lse:
         lse = jax.nn.logsumexp(scores, axis=-1)           # [B,KH,G,S]
         probs = jnp.exp(scores - lse[..., None]).astype(q.dtype)
     else:
         lse = None
         probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    if sinks is not None:
+        probs = probs[..., :t]   # drop the phantom column (no value)
     out = jnp.einsum('bkgst,btkd->bskgd', probs, v).reshape(b, s, h, d)
     if return_lse:
         return out, lse.transpose(0, 3, 1, 2).reshape(b, s, h)
@@ -101,7 +114,8 @@ def xla_attention(q: jnp.ndarray,
                   softmax_scale: Optional[float] = None,
                   logit_softcap: Optional[float] = None,
                   window: Optional[int] = None,
-                  window_active=None) -> jnp.ndarray:
+                  window_active=None,
+                  sinks: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Reference attention. q [B,S,H,D], k/v [B,T,KH,D] → [B,S,H,D].
 
     q_offset/kv_offset are the global positions of q[:,0]/k[:,0] — used both
@@ -109,12 +123,13 @@ def xla_attention(q: jnp.ndarray,
     `logit_softcap` bounds attention logits (Gemma-2); `window` masks keys
     older than `window` positions, gated by the (possibly traced)
     `window_active` flag so alternating local/global layers share one
-    compiled scan body.
+    compiled scan body. `sinks` [H] adds a learned per-head phantom-key
+    logit to the softmax (gpt-oss attention sinks).
     """
     return _xla_attention_impl(q, k, v, causal, q_offset, kv_offset,
                                segment_ids, softmax_scale, return_lse=False,
                                logit_softcap=logit_softcap, window=window,
-                               window_active=window_active)
+                               window_active=window_active, sinks=sinks)
 
 
 def xla_attention_lse(q, k, v, *, causal: bool = True, softmax_scale=None):
@@ -135,14 +150,15 @@ def attention(q: jnp.ndarray,
               softmax_scale: Optional[float] = None,
               logit_softcap: Optional[float] = None,
               window: Optional[int] = None,
-              window_active=None) -> jnp.ndarray:
+              window_active=None,
+              sinks: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     # The Pallas kernel supports neither position offsets, segment ids,
-    # logit softcaps nor sliding windows; anything non-trivial routes to
-    # the XLA reference implementation.
+    # logit softcaps, sliding windows nor attention sinks; anything
+    # non-trivial routes to the XLA reference implementation.
     trivial = (isinstance(q_offset, int) and q_offset == 0 and
                isinstance(kv_offset, int) and kv_offset == 0 and
                segment_ids is None and logit_softcap is None and
-               window is None)
+               window is None and sinks is None)
     if impl == 'auto':
         impl = 'flash' if (_on_tpu() and _flash_ok(q, k) and trivial) \
             else 'xla'
@@ -153,7 +169,7 @@ def attention(q: jnp.ndarray,
                              kv_offset=kv_offset, segment_ids=segment_ids,
                              softmax_scale=softmax_scale,
                              logit_softcap=logit_softcap, window=window,
-                             window_active=window_active)
+                             window_active=window_active, sinks=sinks)
     if impl == 'flash':
         from skypilot_tpu.ops.pallas import flash_attention
         return flash_attention.flash_attention(

@@ -18,28 +18,65 @@ def rope_frequencies(head_dim: int,
                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Return (sin, cos) of shape positions.shape + (head_dim // 2,), fp32.
 
-    `scaling`: optional llama-3.1 style NTK config with keys
-    {factor, low_freq_factor, high_freq_factor, original_max_position}.
+    `scaling`: optional rope-scaling config. `rope_type` selects:
+      - 'llama3' (default): NTK-by-parts with keys {factor,
+        low_freq_factor, high_freq_factor, original_max_position}.
+      - 'yarn' (gpt-oss, DeepSeek long-context): keys {factor,
+        beta_fast=32, beta_slow=1, original_max_position,
+        attention_factor} — low-frequency dims interpolate by `factor`,
+        high-frequency dims extrapolate, with a linear ramp between the
+        beta_fast/beta_slow correction dims; the attention
+        (concentration) factor 0.1·ln(factor)+1 scales the tables.
     """
+    import math
     half = head_dim // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    mscale = 1.0
     if scaling:
         if not isinstance(scaling, dict):   # models.llama.RopeScaling
             import dataclasses as _dc
             scaling = _dc.asdict(scaling)
         factor = float(scaling['factor'])
-        low = float(scaling.get('low_freq_factor', 1.0))
-        high = float(scaling.get('high_freq_factor', 4.0))
         orig = float(scaling.get('original_max_position', 8192))
-        wavelen = 2.0 * jnp.pi / freqs
-        ratio = orig / wavelen
-        smooth = jnp.clip((ratio - low) / (high - low), 0.0, 1.0)
-        scaled = freqs / factor
-        freqs = jnp.where(ratio < low, scaled,
-                          jnp.where(ratio > high, freqs,
-                                    (1 - smooth) * scaled + smooth * freqs))
+        rope_type = scaling.get('rope_type', 'llama3')
+        if rope_type == 'yarn':
+            beta_fast = float(scaling.get('beta_fast', 32.0))
+            beta_slow = float(scaling.get('beta_slow', 1.0))
+
+            def correction_dim(num_rotations: float) -> float:
+                # The dim index whose wavelength completes
+                # `num_rotations` turns over the original context:
+                # freqs_i = θ^(-i/half), so orig·freqs_i/(2π) = n at
+                # i = half·ln(orig/(2πn))/ln θ. (HF writes the same as
+                # dim·ln(...)/(2·ln θ) with dim = FULL head size.)
+                return (half * math.log(orig /
+                                        (num_rotations * 2 * math.pi))
+                        / math.log(theta))
+
+            low = max(math.floor(correction_dim(beta_fast)), 0)
+            high = min(math.ceil(correction_dim(beta_slow)), half - 1)
+            ramp = jnp.clip(
+                (jnp.arange(half, dtype=jnp.float32) - low)
+                / max(high - low, 1e-3), 0.0, 1.0)
+            # ramp 0 → high-frequency (extrapolate, keep freqs);
+            # ramp 1 → low-frequency (interpolate, freqs/factor).
+            freqs = freqs * (1 - ramp) + (freqs / factor) * ramp
+            af = scaling.get('attention_factor')
+            mscale = (float(af) if af is not None
+                      else 0.1 * math.log(factor) + 1.0)
+        else:
+            low = float(scaling.get('low_freq_factor', 1.0))
+            high = float(scaling.get('high_freq_factor', 4.0))
+            wavelen = 2.0 * jnp.pi / freqs
+            ratio = orig / wavelen
+            smooth = jnp.clip((ratio - low) / (high - low), 0.0, 1.0)
+            scaled = freqs / factor
+            freqs = jnp.where(ratio < low, scaled,
+                              jnp.where(ratio > high, freqs,
+                                        (1 - smooth) * scaled
+                                        + smooth * freqs))
     angles = positions.astype(jnp.float32)[..., None] * freqs
-    return jnp.sin(angles), jnp.cos(angles)
+    return jnp.sin(angles) * mscale, jnp.cos(angles) * mscale
 
 
 def apply_rope(x: jnp.ndarray, sin: jnp.ndarray,

@@ -1,0 +1,250 @@
+"""gpt-oss family: attention sinks, clamped SwiGLU, YaRN rope —
+composition knobs on the MoE config (reference recipes: llm/gpt-oss/,
+llm/gpt-oss-finetuning/, llm/kimi-k2/).
+"""
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from skypilot_tpu import models as models_lib
+from skypilot_tpu.models import llama, moe
+from skypilot_tpu.ops import rotary
+from skypilot_tpu.ops.attention import xla_attention
+
+
+class TestSinks:
+
+    def _qkv(self, seed=0, b=2, s=8, h=4, kh=2, d=16):
+        ks = jax.random.split(jax.random.PRNGKey(seed), 3)
+        return (jax.random.normal(ks[0], (b, s, h, d)),
+                jax.random.normal(ks[1], (b, s, kh, d)),
+                jax.random.normal(ks[2], (b, s, kh, d)))
+
+    def test_very_negative_sink_recovers_baseline(self):
+        q, k, v = self._qkv()
+        base = xla_attention(q, k, v, causal=True)
+        got = xla_attention(q, k, v, causal=True,
+                            sinks=jnp.full((4,), -30.0))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(base),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_sink_absorbs_probability_mass(self):
+        """A large positive sink drains softmax mass (contributing no
+        value), shrinking the output toward zero — the sink-token
+        semantics, exactly."""
+        q, k, v = self._qkv()
+        base = xla_attention(q, k, v, causal=True)
+        sunk = xla_attention(q, k, v, causal=True,
+                             sinks=jnp.full((4,), 25.0))
+        assert float(jnp.abs(sunk).max()) < 1e-4
+        mild = xla_attention(q, k, v, causal=True,
+                             sinks=jnp.zeros((4,)))
+        assert 0 < float(jnp.abs(mild).max()) < float(
+            jnp.abs(base).max()) + 1e-6
+        assert not np.allclose(np.asarray(mild), np.asarray(base))
+
+    def test_first_position_with_zero_sink_halves_mass(self):
+        """With q=0, position 0's only score is 0, tying the sink logit:
+        softmax = 1/2 self + 1/2 sink → output = v/2. Closed form."""
+        _, k, v = self._qkv(s=1)
+        q = jnp.zeros((2, 1, 4, 16))
+        out = xla_attention(q, k, v, causal=True, sinks=jnp.zeros((4,)))
+        # GQA: heads 0,1 share kv-head 0; heads 2,3 share kv-head 1.
+        want = np.repeat(np.asarray(v[:, :, :, :]), 2, axis=2) / 2.0
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-5,
+                                   atol=1e-6)
+
+
+class TestYarn:
+
+    def test_factor_one_is_identity(self):
+        pos = jnp.arange(64)
+        base = rotary.rope_frequencies(32, pos, 10000.0, None)
+        yarn = rotary.rope_frequencies(
+            32, pos, 10000.0,
+            dict(rope_type='yarn', factor=1.0, attention_factor=1.0,
+                 original_max_position=64))
+        for a, b in zip(base, yarn):
+            # atol: the fp32 ramp blend (f·(1-r) + f·r) rounds at ~1e-6.
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-5)
+
+    def test_low_freq_dims_interpolate_high_freq_extrapolate(self):
+        pos = jnp.asarray([100])
+        factor = 8.0
+        base_sin, base_cos = rotary.rope_frequencies(64, pos, 10000.0,
+                                                     None)
+        y_sin, y_cos = rotary.rope_frequencies(
+            64, pos, 10000.0,
+            dict(rope_type='yarn', factor=factor, attention_factor=1.0,
+                 original_max_position=2048))
+        base_ang = np.arctan2(np.asarray(base_sin), np.asarray(base_cos))
+        y_ang = np.arctan2(np.asarray(y_sin), np.asarray(y_cos))
+        # Dim 0 (highest frequency) extrapolates: angle unchanged.
+        np.testing.assert_allclose(y_ang[0, 0], base_ang[0, 0],
+                                   rtol=1e-5)
+        # The lowest-frequency dim interpolates: angle shrinks ~by the
+        # factor (compare raw angles, small enough not to wrap).
+        half = 32
+        freqs = 10000.0 ** (-np.arange(half) / half)
+        assert y_ang[0, -1] == pytest.approx(
+            100 * freqs[-1] / factor, rel=1e-4)
+
+    def test_ramp_boundaries_sit_at_beta_rotations(self):
+        """The ramp must start at the dim completing beta_fast rotations
+        over the original context and end at the beta_slow dim (HF YaRN
+        semantics — gpt-oss-20b geometry: dims 8..18). A dim safely
+        inside the extrapolation zone keeps its base frequency; one
+        safely past the ramp is fully interpolated."""
+        hd, theta, orig, factor = 64, 150000.0, 4096.0, 32.0
+        half = hd // 2
+        freqs = theta ** (-np.arange(half) / half)
+        rotations = orig * freqs / (2 * math.pi)
+        # Ground truth from the rotation counts themselves.
+        low = int(np.floor(half * math.log(orig / (32.0 * 2 * math.pi))
+                           / math.log(theta)))
+        assert rotations[low] >= 32.0 > rotations[low + 1]
+        pos = jnp.asarray([1000])
+        y_sin, y_cos = rotary.rope_frequencies(
+            hd, pos, theta, dict(rope_type='yarn', factor=factor,
+                                 attention_factor=1.0,
+                                 original_max_position=orig))
+        ang = np.arctan2(np.asarray(y_sin), np.asarray(y_cos))[0]
+        base_ang = 1000 * freqs
+        # Below the ramp: extrapolated (base frequency), compare mod 2π.
+        d = ang[low - 2] - base_ang[low - 2]
+        assert abs(((d + math.pi) % (2 * math.pi)) - math.pi) < 1e-3
+        # Past the ramp: fully interpolated (freq/factor; angle small
+        # enough at the tail to compare directly).
+        np.testing.assert_allclose(ang[-1], 1000 * freqs[-1] / factor,
+                                   rtol=1e-4)
+
+    def test_concentration_factor_scales_tables(self):
+        pos = jnp.arange(8)
+        factor = 32.0
+        default = rotary.rope_frequencies(
+            16, pos, 10000.0, dict(rope_type='yarn', factor=factor,
+                                   original_max_position=64))
+        unscaled = rotary.rope_frequencies(
+            16, pos, 10000.0, dict(rope_type='yarn', factor=factor,
+                                   attention_factor=1.0,
+                                   original_max_position=64))
+        mscale = 0.1 * math.log(factor) + 1.0
+        np.testing.assert_allclose(np.asarray(default[1]),
+                                   np.asarray(unscaled[1]) * mscale,
+                                   rtol=1e-6)
+
+
+class TestClampedSwiglu:
+
+    def test_formula(self):
+        cfg = models_lib.get_config('gptoss-debug')
+        gate = jnp.asarray([-10.0, -1.0, 0.0, 2.0, 10.0])
+        up = jnp.asarray([9.0, -9.0, 0.5, 1.0, -0.5])
+        got = np.asarray(cfg.glu(gate, up))
+        g = np.minimum(np.asarray(gate), 7.0)
+        u = np.clip(np.asarray(up), -7.0, 7.0)
+        want = g * (1.0 / (1.0 + np.exp(-1.702 * g))) * (u + 1.0)
+        np.testing.assert_allclose(got, want, rtol=1e-5)
+
+    def test_default_glu_unchanged(self):
+        cfg = models_lib.get_config('llama-debug')
+        gate = jnp.asarray([-1.0, 2.0])
+        up = jnp.asarray([3.0, 0.5])
+        np.testing.assert_allclose(
+            np.asarray(cfg.glu(gate, up)),
+            np.asarray(jax.nn.silu(gate) * up), rtol=1e-6)
+
+
+class TestGptOssModel:
+
+    @pytest.fixture(scope='class')
+    def model(self):
+        cfg = models_lib.get_config('gptoss-debug')
+        params = moe.init_params(jax.random.PRNGKey(0), cfg)
+        # Break the zero-init symmetry so sinks/windows actually matter.
+        params['layers']['sink'] = 0.5 * jax.random.normal(
+            jax.random.PRNGKey(9), params['layers']['sink'].shape)
+        return cfg, params
+
+    def test_all_knobs_decode_parity(self, model):
+        """prefill + step-by-step decode == teacher-forced forward with
+        sinks + alternating window + clamped SwiGLU + YaRN + qkv-bias
+        all live — the family's strongest correctness evidence."""
+        from skypilot_tpu.models import decode
+        cfg, params = model
+        b, s0, steps = 2, 6, 4
+        tokens = jax.random.randint(jax.random.PRNGKey(2), (b, s0), 0,
+                                    cfg.vocab_size, dtype=jnp.int32)
+        logits, cache = decode.prefill(params, tokens, cfg, max_len=32)
+        full = moe.forward(params, tokens, cfg)
+        np.testing.assert_allclose(np.asarray(logits),
+                                   np.asarray(full[:, -1]), rtol=2e-4,
+                                   atol=2e-4)
+        seq = tokens
+        for _ in range(steps):
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+            full = moe.forward(params, seq, cfg)
+            logits, cache = decode.decode_step(params, nxt, cache, cfg)
+            np.testing.assert_allclose(np.asarray(logits),
+                                       np.asarray(full[:, -1]),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_sinks_change_the_forward(self, model):
+        cfg, params = model
+        tokens = jax.random.randint(jax.random.PRNGKey(3), (1, 8), 0,
+                                    cfg.vocab_size, dtype=jnp.int32)
+        with_sinks = moe.forward(params, tokens, cfg)
+        p2 = dict(params)
+        p2['layers'] = dict(params['layers'])
+        p2['layers']['sink'] = jnp.full_like(params['layers']['sink'],
+                                             -30.0)
+        without = moe.forward(p2, tokens, cfg)
+        assert not np.allclose(np.asarray(with_sinks),
+                               np.asarray(without), atol=1e-5)
+
+    def test_train_step_learns_sinks(self, model):
+        from skypilot_tpu.parallel import MeshSpec, build_mesh
+        from skypilot_tpu.train import train_lib
+        cfg, _ = model
+        mesh = build_mesh(MeshSpec())
+        tx = train_lib.default_optimizer(learning_rate=1e-2,
+                                         warmup_steps=1, total_steps=10)
+        state = train_lib.init_train_state(jax.random.PRNGKey(0), cfg,
+                                           mesh, tx)
+        sink0 = np.asarray(jax.device_get(
+            state.params['layers']['sink']))
+        step = train_lib.make_train_step(cfg, mesh, tx)
+        toks = jax.random.randint(jax.random.PRNGKey(1), (8, 33), 0,
+                                  cfg.vocab_size, dtype=jnp.int32)
+        losses = []
+        for _ in range(6):
+            state, metrics = step(state, {'tokens': toks})
+            losses.append(float(metrics['loss']))
+        assert losses[-1] < losses[0]
+        sink1 = np.asarray(jax.device_get(
+            state.params['layers']['sink']))
+        assert not np.allclose(sink0, sink1)   # sinks actually train
+
+    def test_ring_attention_refused(self):
+        import dataclasses
+        cfg = dataclasses.replace(models_lib.get_config('gptoss-debug'),
+                                  attention_impl='ring',
+                                  sliding_window=None,
+                                  attn_logit_softcap=None)
+        params = moe.init_params(jax.random.PRNGKey(0), cfg)
+        toks = jnp.zeros((1, 8), jnp.int32)
+        with pytest.raises(NotImplementedError, match='attn_sinks'):
+            moe.forward(params, toks, cfg)
+
+    def test_presets_exist_with_real_geometry(self):
+        g20 = models_lib.get_config('gpt-oss-20b')
+        assert (g20.n_experts, g20.top_k, g20.hd) == (32, 4, 64)
+        assert g20.rope_scaling.rope_type == 'yarn'
+        k2 = models_lib.get_config('kimi-k2')
+        assert (k2.n_experts, k2.top_k, k2.n_shared_experts) == (384, 8, 1)
+        assert k2.kv_lora_rank == 512
