@@ -1,0 +1,460 @@
+"""GRPO reinforcement-learning finetuning, native and TPU-first.
+
+Reference analog: the RL recipes SkyPilot launches as external
+frameworks — llm/verl/multinode.yaml (Ray + vLLM rollouts + FSDP
+updates), llm/skyrl/, llm/nemorl/ (SURVEY §2.11). There the RL loop
+lives outside the launcher; here it is native: rollouts ride the same
+jitted `decode.generate` the serve engine uses (static shapes, KV
+cache, temperature sampling on-device) and the update is one jitted
+SPMD step over the same mesh/sharding rules as supervised training.
+
+GRPO (group-relative policy optimization, the DeepSeek-R1 recipe):
+  - G rollouts per prompt; advantage = (r - mean_group)/(std_group+ε)
+    — no value network, the group IS the baseline.
+  - Clipped importance-ratio surrogate (PPO-style) over completion
+    tokens only.
+  - Optional KL penalty vs the frozen initial policy (k3 estimator:
+    exp(Δ) − Δ − 1, where Δ = logp_ref − logp), added per token.
+
+TPU shape discipline: prompts pad to one bucket, completions are a
+fixed `max_new_tokens`, groups fold into the batch dim ([B·G, S+T]) —
+every iteration reuses two compiled programs (generate + update).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.parallel import mesh as mesh_lib
+from skypilot_tpu.train import train_lib
+
+# Fixed name, not __name__: under `python -m` this module is '__main__',
+# which would fall outside the 'skypilot_tpu' logging root (no handler).
+logger = sky_logging.init_logger('skypilot_tpu.train.grpo')
+
+
+@dataclasses.dataclass(frozen=True)
+class GRPOConfig:
+    group_size: int = 8           # rollouts per prompt (G)
+    max_new_tokens: int = 32      # completion length (T, static)
+    temperature: float = 1.0      # rollout sampling temperature
+    clip_eps: float = 0.2         # PPO ratio clip
+    kl_coef: float = 0.0          # β for the k3 KL penalty (0 = off)
+    inner_steps: int = 1          # optimizer updates per rollout batch
+    adv_eps: float = 1e-4         # group-std floor
+
+
+# A reward maps (prompt_tokens [S], completion_tokens [T], eos_id) →
+# float. Completion tokens after the first EOS are already masked out
+# by the caller (they arrive as eos-fill from decode.generate).
+RewardFn = Callable[[Any, Any], float]
+
+
+def token_logprobs(params, seq: jnp.ndarray, cfg, mod,
+                   temperature: float = 1.0
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """(log-prob of each NEXT token, router aux loss) under the policy:
+    [B, L-1] fp32 (entry t scores seq[:, t+1] given seq[:, :t+1]).
+
+    `temperature`: the ROLLOUT sampling temperature — the behavior
+    policy is softmax(logits/τ), so the importance ratio must score
+    tokens under the same τ-scaled distribution (τ≠1 without this
+    correction is a systematically biased gradient). aux is the MoE
+    load-balance loss (0.0 for dense families) — the update keeps the
+    same routing pressure as supervised training."""
+    if getattr(mod, 'HAS_AUX', False):
+        logits, aux = mod.forward(params, seq[:, :-1], cfg,
+                                  return_aux=True)
+    else:
+        logits, aux = mod.forward(params, seq[:, :-1], cfg), 0.0
+    logits = logits.astype(jnp.float32) / temperature
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, seq[:, 1:, None],
+                               axis=-1)[..., 0]
+    return gold - logz, jnp.asarray(aux, jnp.float32)
+
+
+def completion_mask(completions: jnp.ndarray,
+                    eos_id: Optional[int]) -> jnp.ndarray:
+    """[B, T] float mask: tokens up to AND INCLUDING the first EOS
+    (decode.generate fills post-eos slots with eos)."""
+    if eos_id is None:
+        return jnp.ones(completions.shape, jnp.float32)
+    is_eos = (completions == eos_id)
+    after_eos = jnp.cumsum(is_eos.astype(jnp.int32), axis=1) \
+        - is_eos.astype(jnp.int32)
+    return (after_eos == 0).astype(jnp.float32)
+
+
+def group_advantages(rewards: jnp.ndarray, group_size: int,
+                     eps: float = 1e-4) -> jnp.ndarray:
+    """[B·G] rewards (group-major: prompt i owns rows i·G..(i+1)·G−1) →
+    group-normalized advantages (the GRPO baseline)."""
+    g = rewards.reshape(-1, group_size)
+    mean = g.mean(axis=1, keepdims=True)
+    std = g.std(axis=1, keepdims=True)
+    return ((g - mean) / (std + eps)).reshape(-1)
+
+
+def make_grpo_update(cfg, mesh, tx: optax.GradientTransformation,
+                     gcfg: GRPOConfig, mod,
+                     use_ref: bool = False):
+    """Jitted (state, seq, comp_idx, behavior_lp, advantages, mask,
+    ref_lp) → (state, metrics). Donates state. `comp_idx` [B, T] holds
+    each row's completion positions in the [L-1] log-prob grid (rows are
+    PACKED — prompt then completion at the row's true length — so
+    ragged prompt batches score completions at the positions they were
+    actually sampled at)."""
+
+    def update(state: train_lib.TrainState, seq, comp_idx, behavior_lp,
+               adv, mask, ref_lp):
+
+        def loss_fn(params):
+            lp_full, aux = token_logprobs(params, seq, cfg, mod,
+                                          gcfg.temperature)
+            lp = jnp.take_along_axis(lp_full, comp_idx, axis=1)
+            ratio = jnp.exp(lp - behavior_lp)
+            clipped = jnp.clip(ratio, 1.0 - gcfg.clip_eps,
+                               1.0 + gcfg.clip_eps)
+            surr = jnp.minimum(ratio * adv[:, None],
+                               clipped * adv[:, None])
+            loss_tok = -surr
+            if use_ref:
+                # k3 estimator of KL(policy ‖ ref): unbiased, positive.
+                delta = ref_lp - lp
+                loss_tok = loss_tok + gcfg.kl_coef * (
+                    jnp.exp(delta) - delta - 1.0)
+            denom = jnp.maximum(mask.sum(), 1.0)
+            # aux keeps MoE router load-balancing pressure in RL, same
+            # as the supervised step (train_lib loss_fn adds it too).
+            loss = (loss_tok * mask).sum() / denom + aux
+            frac_clipped = ((jnp.abs(ratio - clipped) > 1e-9)
+                            .astype(jnp.float32) * mask).sum() / denom
+            return loss, (ratio, frac_clipped)
+
+        (loss, (ratio, frac_clipped)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        metrics = {'loss': loss,
+                   'grad_norm': optax.global_norm(grads),
+                   'mean_ratio': (ratio * mask).sum()
+                   / jnp.maximum(mask.sum(), 1.0),
+                   'frac_clipped': frac_clipped}
+        return train_lib.TrainState(step=state.step + 1,
+                                    params=new_params,
+                                    opt_state=new_opt), metrics
+
+    jitted = jax.jit(update, donate_argnums=(0,))
+
+    def wrapped(state, seq, comp_idx, behavior_lp, adv, mask,
+                ref_lp=None):
+        if ref_lp is None:
+            ref_lp = jnp.zeros_like(behavior_lp)
+        with mesh_lib.use_mesh(mesh):
+            return jitted(state, seq, comp_idx, behavior_lp, adv, mask,
+                          ref_lp)
+
+    return wrapped
+
+
+class GRPOTrainer:
+    """Rollout → reward → group advantage → clipped update, iterated."""
+
+    def __init__(self, cfg, gcfg: GRPOConfig, reward_fn: RewardFn,
+                 mesh=None, tx: Optional[optax.GradientTransformation]
+                 = None, eos_id: Optional[int] = None,
+                 init_params=None, seed: int = 0):
+        from skypilot_tpu import models as models_lib
+        from skypilot_tpu.parallel import MeshSpec, build_mesh
+        self.cfg, self.gcfg = cfg, gcfg
+        self.mod = models_lib.module_for(cfg)
+        self.reward_fn = reward_fn
+        self.eos_id = eos_id
+        self.mesh = mesh if mesh is not None else build_mesh(MeshSpec())
+        self.tx = tx or train_lib.default_optimizer(
+            learning_rate=1e-5, warmup_steps=1, total_steps=10_000,
+            max_grad_norm=1.0)
+        self.rng = jax.random.PRNGKey(seed)
+        if init_params is None:
+            self.state = train_lib.init_train_state(
+                jax.random.PRNGKey(seed), cfg, self.mesh, self.tx)
+        else:
+            shardings = train_lib.state_shardings(cfg, self.mesh, self.tx)
+            params = jax.device_put(init_params, shardings.params)
+            with mesh_lib.use_mesh(self.mesh):
+                opt_state = jax.jit(
+                    self.tx.init,
+                    out_shardings=shardings.opt_state)(params)
+            self.state = train_lib.TrainState(
+                step=jnp.zeros((), jnp.int32), params=params,
+                opt_state=opt_state)
+        use_ref = gcfg.kl_coef > 0.0
+        # A REAL copy: the jitted update donates the policy buffers, so
+        # aliased leaves would be invalidated after the first step on
+        # TPU/GPU (and would silently track the policy anywhere).
+        self._ref_params = (jax.tree.map(jnp.copy, self.state.params)
+                            if use_ref else None)
+        self._update = make_grpo_update(cfg, self.mesh, self.tx, gcfg,
+                                        self.mod, use_ref=use_ref)
+        self._lp_fn = jax.jit(functools.partial(
+            token_logprobs, cfg=cfg, mod=self.mod,
+            temperature=gcfg.temperature))
+
+    def _next_rng(self):
+        self.rng, sub = jax.random.split(self.rng)
+        return sub
+
+    def iteration(self, prompts: jnp.ndarray,
+                  prompt_lengths: Optional[jnp.ndarray] = None
+                  ) -> Dict[str, float]:
+        """One GRPO iteration on a [B, S] prompt batch. Returns metrics
+        (mean_reward, loss, mean_ratio, frac_clipped)."""
+        from skypilot_tpu.models import decode as decode_lib
+        cfg, gcfg = self.cfg, self.gcfg
+        b, s = prompts.shape
+        g = gcfg.group_size
+        rep = jnp.repeat(prompts, g, axis=0)            # group-major
+        rep_lens = (jnp.repeat(prompt_lengths, g)
+                    if prompt_lengths is not None else None)
+        from skypilot_tpu.models import mla as mla_lib
+        dec = (self.mod if isinstance(cfg, mla_lib.MLAConfig)
+               else decode_lib)
+        with mesh_lib.use_mesh(self.mesh):
+            gen = dec.generate(
+                self.state.params, rep, cfg, gcfg.max_new_tokens,
+                max_len=s + gcfg.max_new_tokens,
+                temperature=gcfg.temperature, eos_id=self.eos_id,
+                prompt_lengths=rep_lens, rng=self._next_rng())
+        # One bulk device→host transfer; rewards and sequence packing
+        # are host-side per-row work.
+        import numpy as np
+        rep_np = np.asarray(jax.device_get(rep))
+        gen_np = np.asarray(jax.device_get(gen))
+        t = gcfg.max_new_tokens
+        if rep_lens is None:
+            seq_np = np.concatenate([rep_np, gen_np], axis=1)
+            comp_idx = np.broadcast_to(np.arange(t) + s - 1,
+                                       (b * g, t)).copy()
+        else:
+            # PACK ragged rows: prompt[:len] + completion, right-padded
+            # — completions stay at the positions generate() sampled
+            # them at (a pad gap would shift RoPE and poison the
+            # conditioning, making behavior_lp wrong).
+            lens_np = np.asarray(rep_lens)
+            seq_np = np.zeros((b * g, s + t), rep_np.dtype)
+            comp_idx = np.zeros((b * g, t), np.int32)
+            for i in range(b * g):
+                li = int(lens_np[i])
+                seq_np[i, :li] = rep_np[i, :li]
+                seq_np[i, li:li + t] = gen_np[i]
+                comp_idx[i] = np.arange(t) + li - 1
+        seq = jnp.asarray(seq_np)
+        comp_idx = jnp.asarray(comp_idx, jnp.int32)
+        mask = completion_mask(gen, self.eos_id)
+
+        rewards = jnp.asarray(
+            [self.reward_fn(rep_np[i], gen_np[i]) for i in range(b * g)],
+            jnp.float32)
+        adv = group_advantages(rewards, g, gcfg.adv_eps)
+
+        with mesh_lib.use_mesh(self.mesh):
+            lp_full, _ = self._lp_fn(self.state.params, seq)
+            behavior_lp = jax.lax.stop_gradient(
+                jnp.take_along_axis(lp_full, comp_idx, axis=1))
+            ref_lp = None
+            if self._ref_params is not None:
+                ref_full, _ = self._lp_fn(self._ref_params, seq)
+                ref_lp = jax.lax.stop_gradient(
+                    jnp.take_along_axis(ref_full, comp_idx, axis=1))
+
+        metrics: Dict[str, float] = {}
+        for _ in range(gcfg.inner_steps):
+            self.state, m = self._update(self.state, seq, comp_idx,
+                                         behavior_lp, adv, mask, ref_lp)
+            metrics = {k: float(v) for k, v in m.items()}
+        metrics['mean_reward'] = float(rewards.mean())
+        metrics['mean_completion_len'] = float(mask.sum(1).mean())
+        return metrics
+
+
+# --- Built-in rewards (demo/test; real use passes a callable) ----------
+
+def count_token_reward(target_id: int) -> RewardFn:
+    """Fraction of completion tokens equal to `target_id` — a toy
+    objective whose optimum is unambiguous (hermetic learning tests)."""
+    def fn(prompt, completion) -> float:
+        import numpy as np
+        c = np.asarray(completion)
+        return float((c == target_id).mean())
+    return fn
+
+
+def length_reward(eos_id: int) -> RewardFn:
+    """Fraction of the budget used before the first EOS — rewards
+    longer completions (normalized to [0, 1])."""
+    def fn(prompt, completion) -> float:
+        import numpy as np
+        c = np.asarray(completion)
+        hits = np.flatnonzero(c == eos_id)
+        used = hits[0] if hits.size else c.shape[0]
+        return float(used) / float(c.shape[0])
+    return fn
+
+
+def resolve_reward(spec: str, eos_id: Optional[int]) -> RewardFn:
+    """CLI reward resolution: 'count_token:ID', 'length', or
+    'module.path:function' (a callable taking (prompt, completion))."""
+    if spec.startswith('count_token:'):
+        return count_token_reward(int(spec.split(':', 1)[1]))
+    if spec == 'length':
+        if eos_id is None:
+            raise ValueError("reward 'length' needs --eos-id")
+        return length_reward(eos_id)
+    if ':' in spec:
+        import importlib
+        mod_name, fn_name = spec.rsplit(':', 1)
+        return getattr(importlib.import_module(mod_name), fn_name)
+    raise ValueError(
+        f'Unknown reward {spec!r}: use count_token:ID, length, or '
+        f'module.path:function')
+
+
+def main() -> None:
+    """CLI: native GRPO finetuning (the reference's verl/skyrl recipes'
+    role, minus the external framework).
+
+        python -m skypilot_tpu.train.grpo --model llama-debug \
+            --reward count_token:42 --iterations 50
+    """
+    import argparse
+    import json
+
+    from skypilot_tpu import models as models_lib
+    from skypilot_tpu.train import trainer as trainer_mod
+    parser = argparse.ArgumentParser(prog='skytpu-grpo')
+    parser.add_argument('--model', default='llama-debug')
+    parser.add_argument('--hf-dir', default=None,
+                        help='HF checkpoint for the initial policy.')
+    parser.add_argument('--reward', required=True,
+                        help='count_token:ID | length | module:function')
+    parser.add_argument('--iterations', type=int, default=100)
+    parser.add_argument('--prompts', default=None,
+                        help='JSONL of {"tokens": [...]} prompt batches '
+                             '(default: random token prompts).')
+    parser.add_argument('--batch-prompts', type=int, default=4)
+    parser.add_argument('--prompt-len', type=int, default=16)
+    parser.add_argument('--group-size', type=int, default=8)
+    parser.add_argument('--max-new-tokens', type=int, default=32)
+    parser.add_argument('--temperature', type=float, default=1.0)
+    parser.add_argument('--kl-coef', type=float, default=0.0)
+    parser.add_argument('--clip-eps', type=float, default=0.2)
+    parser.add_argument('--inner-steps', type=int, default=1)
+    parser.add_argument('--lr', type=float, default=1e-5)
+    parser.add_argument('--eos-id', type=int, default=None)
+    parser.add_argument('--mesh', default='')
+    parser.add_argument('--ckpt-dir', default=None,
+                        help='Orbax checkpoint dir for the policy.')
+    parser.add_argument('--ckpt-every', type=int, default=50)
+    args = parser.parse_args()
+
+    trainer_mod.maybe_init_distributed()
+    init_params = None
+    if args.hf_dir:
+        from skypilot_tpu.models import hf_import
+        cfg, init_params = hf_import.load_hf_checkpoint(
+            args.hf_dir, dtype=jnp.float32)
+        eos = hf_import.hf_eos_ids(args.hf_dir)
+        if args.eos_id is None and eos:
+            args.eos_id = eos[0]
+    else:
+        cfg = models_lib.get_config(args.model)
+    gcfg = GRPOConfig(group_size=args.group_size,
+                      max_new_tokens=args.max_new_tokens,
+                      temperature=args.temperature,
+                      clip_eps=args.clip_eps, kl_coef=args.kl_coef,
+                      inner_steps=args.inner_steps)
+    from skypilot_tpu.parallel import MeshSpec, build_mesh
+    mesh_kv = {}
+    for part in args.mesh.split(','):
+        if part:
+            k, v = part.split('=')
+            mesh_kv[k.strip()] = int(v)
+    mesh = build_mesh(MeshSpec(**mesh_kv))
+    tx = train_lib.default_optimizer(learning_rate=args.lr,
+                                     warmup_steps=1,
+                                     total_steps=args.iterations + 1)
+    trainer = GRPOTrainer(cfg, gcfg,
+                          resolve_reward(args.reward, args.eos_id),
+                          mesh=mesh, tx=tx, eos_id=args.eos_id,
+                          init_params=init_params)
+
+    def prompt_batches():
+        if args.prompts:
+            import json as json_lib
+            rows: List[List[int]] = []
+            with open(args.prompts, 'r', encoding='utf-8') as f:
+                rows = [json_lib.loads(line)['tokens'] for line in f
+                        if line.strip()]
+            if len(rows) < args.batch_prompts:
+                raise ValueError(
+                    f'--prompts has {len(rows)} rows but '
+                    f'--batch-prompts is {args.batch_prompts}; add '
+                    f'prompts or lower the batch.')
+            if len(rows) % args.batch_prompts:
+                logger.warning(
+                    f'{len(rows) % args.batch_prompts} trailing '
+                    f'prompt(s) are skipped each epoch (static batch '
+                    f'of {args.batch_prompts}).')
+            while True:
+                for lo in range(0, len(rows) - args.batch_prompts + 1,
+                                args.batch_prompts):
+                    chunk = rows[lo:lo + args.batch_prompts]
+                    width = max(len(r) for r in chunk)
+                    arr = jnp.zeros((len(chunk), width), jnp.int32)
+                    lens = []
+                    for i, r in enumerate(chunk):
+                        arr = arr.at[i, :len(r)].set(
+                            jnp.asarray(r, jnp.int32))
+                        lens.append(len(r))
+                    yield arr, jnp.asarray(lens, jnp.int32)
+        else:
+            i = 0
+            while True:
+                rng = jax.random.PRNGKey(1000 + i)
+                yield (jax.random.randint(
+                    rng, (args.batch_prompts, args.prompt_len), 0,
+                    cfg.vocab_size, dtype=jnp.int32), None)
+                i += 1
+
+    ckpt = None
+    if args.ckpt_dir:
+        from skypilot_tpu.train import checkpoints
+        ckpt = checkpoints.Checkpointer(args.ckpt_dir)
+    try:
+        batches = prompt_batches()
+        for it in range(args.iterations):
+            prompts, lens = next(batches)
+            metrics = trainer.iteration(prompts, prompt_lengths=lens)
+            logger.info(json.dumps(
+                {'iter': it + 1,
+                 **{k: round(v, 4) for k, v in metrics.items()}}))
+            if ckpt is not None and (it + 1) % args.ckpt_every == 0:
+                ckpt.save(trainer.state, it + 1)
+        if ckpt is not None and args.iterations % args.ckpt_every != 0:
+            # Aligned totals were already saved by the in-loop cadence
+            # (orbax rejects re-saving an existing step).
+            ckpt.save(trainer.state, args.iterations)
+    finally:
+        if ckpt is not None:
+            ckpt.close()
+
+
+if __name__ == '__main__':
+    main()
