@@ -295,6 +295,8 @@ def run(args) -> Dict[str, int]:
 
 
 def main() -> None:
+    from skypilot_tpu.utils import jax_utils
+    jax_utils.pin_platform_from_env()
     parser = argparse.ArgumentParser(prog='skytpu-batch-infer')
     parser.add_argument('--input', required=True, help='JSONL of '
                         '{"prompt"|"text": ..., "id"?: ...} records.')

@@ -20,7 +20,8 @@ TPU-first notes:
     load natively (numpy has no bfloat16).
 
 Supported architectures: LlamaForCausalLM (Llama 2/3/3.1/3.2,
-CodeLlama), Qwen2ForCausalLM (Qwen2/2.5 — q/k/v biases). Anything else
+CodeLlama), Qwen2ForCausalLM (Qwen2/2.5 — q/k/v biases),
+MixtralForCausalLM (MoE — per-expert stacks + router). Anything else
 fails loudly with the architecture name.
 """
 from __future__ import annotations
@@ -37,9 +38,14 @@ from skypilot_tpu.models import llama
 logger = sky_logging.init_logger(__name__)
 
 # HF architecture string → config-kwarg overrides for LlamaConfig.
+# MixtralForCausalLM maps onto MoEConfig (see config_from_hf); its
+# router semantics match ours exactly — HF softmaxes the top-k logits,
+# we softmax-all-then-renormalize-top-k, and the shared denominator
+# cancels, so the gate weights are identical.
 _ARCHITECTURES = {
     'LlamaForCausalLM': {},
     'Qwen2ForCausalLM': {'qkv_bias': True},
+    'MixtralForCausalLM': {},
 }
 
 
@@ -54,8 +60,8 @@ def config_from_hf(hf_cfg: Dict[str, Any]) -> llama.LlamaConfig:
     if arch not in _ARCHITECTURES:
         raise ValueError(
             f'Unsupported HF architecture {arch!r}; supported: '
-            f'{sorted(_ARCHITECTURES)}. (MoE/MLA families import via '
-            f'their own converters when added.)')
+            f'{sorted(_ARCHITECTURES)}. (The MLA/DeepSeek family '
+            f'imports via its own converter when added.)')
     rope_scaling = None
     rs = hf_cfg.get('rope_scaling')
     if rs:
@@ -91,6 +97,22 @@ def config_from_hf(hf_cfg: Dict[str, Any]) -> llama.LlamaConfig:
     if hf_cfg.get('head_dim'):
         kwargs['head_dim'] = int(hf_cfg['head_dim'])
     kwargs.update(_ARCHITECTURES[arch])
+    if arch == 'MixtralForCausalLM':
+        from skypilot_tpu.models import moe
+        if hf_cfg.get('sliding_window'):
+            # Mistral-lineage windows EVERY layer — a pattern larger
+            # than n_layers means "no layer is global" under
+            # llama.window_active's every-pattern-th-is-global rule.
+            kwargs['sliding_window'] = int(hf_cfg['sliding_window'])
+            kwargs['sliding_window_pattern'] = kwargs['n_layers'] + 1
+        return moe.MoEConfig(
+            **kwargs,
+            n_experts=int(hf_cfg['num_local_experts']),
+            top_k=int(hf_cfg['num_experts_per_tok']),
+            # The true model routes every token (no capacity); 2.0 keeps
+            # drops negligible in our static-capacity dispatch while
+            # staying static-shaped. Decode (S=1) never drops.
+            capacity_factor=2.0)
     return llama.LlamaConfig(**kwargs)
 
 
@@ -152,6 +174,9 @@ def params_from_hf(tensors: Dict[str, Any], cfg: llama.LlamaConfig,
         out = jnp.stack([t.T if transpose else t for t in per_layer])
         return cast(out)
 
+    from skypilot_tpu.models import moe
+    is_moe = isinstance(cfg, moe.MoEConfig)
+
     p = 'model.layers.{i}.'
     params: llama.Params = {
         'embed': cast(_expect(tensors, 'model.embed_tokens.weight',
@@ -166,16 +191,46 @@ def params_from_hf(tensors: Dict[str, Any], cfg: llama.LlamaConfig,
                         transpose=True),
             'wo': stack(p + 'self_attn.o_proj.weight', (D, H * hd),
                         transpose=True),
-            'mlp_norm': stack(p + 'post_attention_layernorm.weight', (D,)),
+        },
+        'final_norm': cast(_expect(tensors, 'model.norm.weight', (D,))),
+    }
+    if is_moe:
+        # Mixtral: per-layer router + per-expert SwiGLU (w1=gate,
+        # w3=up, w2=down in HF naming), stacked to [L, E, in, out].
+        E = cfg.n_experts
+
+        def stack_experts(name: str, shape, transpose: bool):
+            per_layer = []
+            for i in range(L):
+                per_expert = [
+                    _expect(tensors,
+                            f'model.layers.{i}.block_sparse_moe.'
+                            f'experts.{e}.{name}.weight', shape)
+                    for e in range(E)]
+                per_layer.append(jnp.stack(
+                    [t.T if transpose else t for t in per_expert]))
+            return cast(jnp.stack(per_layer))
+
+        params['layers'].update({
+            'moe_norm': stack(p + 'post_attention_layernorm.weight',
+                              (D,)),
+            'router': stack(p + 'block_sparse_moe.gate.weight', (E, D),
+                            transpose=True),
+            'w_gate': stack_experts('w1', (F, D), transpose=True),
+            'w_up': stack_experts('w3', (F, D), transpose=True),
+            'w_down': stack_experts('w2', (D, F), transpose=True),
+        })
+    else:
+        params['layers'].update({
+            'mlp_norm': stack(p + 'post_attention_layernorm.weight',
+                              (D,)),
             'w_gate': stack(p + 'mlp.gate_proj.weight', (F, D),
                             transpose=True),
             'w_up': stack(p + 'mlp.up_proj.weight', (F, D),
                           transpose=True),
             'w_down': stack(p + 'mlp.down_proj.weight', (D, F),
                             transpose=True),
-        },
-        'final_norm': cast(_expect(tensors, 'model.norm.weight', (D,))),
-    }
+        })
     if cfg.qkv_bias:
         params['layers']['bq'] = stack(p + 'self_attn.q_proj.bias',
                                        (H * hd,))

@@ -966,6 +966,8 @@ def build_app(engine: InferenceEngine):
 
 
 def main() -> None:
+    from skypilot_tpu.utils import jax_utils
+    jax_utils.pin_platform_from_env()
     from aiohttp import web
     parser = argparse.ArgumentParser(prog='skytpu-engine')
     parser.add_argument('--model', default=None,

@@ -333,6 +333,8 @@ def main() -> None:
         python -m skypilot_tpu.train.grpo --model llama-debug \
             --reward count_token:42 --iterations 50
     """
+    from skypilot_tpu.utils import jax_utils
+    jax_utils.pin_platform_from_env()
     import argparse
     import json
 

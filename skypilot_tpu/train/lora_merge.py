@@ -22,6 +22,8 @@ logger = sky_logging.init_logger('skypilot_tpu.train.lora_merge')
 
 
 def main() -> None:
+    from skypilot_tpu.utils import jax_utils
+    jax_utils.pin_platform_from_env()
     parser = argparse.ArgumentParser(prog='skytpu-lora-merge')
     parser.add_argument('--hf-dir', required=True,
                         help='Base HF checkpoint the adapters were '
@@ -33,9 +35,17 @@ def main() -> None:
                         help='Output HF checkpoint directory.')
     args = parser.parse_args()
 
-    from skypilot_tpu.models import hf_export, hf_import
+    from skypilot_tpu.models import hf_export, hf_import, llama
     from skypilot_tpu.train import lora
 
+    # Fail BEFORE the (possibly multi-GB) weight load: export
+    # round-trips the dense Llama/Qwen2 family only.
+    cfg_only = hf_import.load_hf_config(args.hf_dir)
+    if type(cfg_only) is not llama.LlamaConfig:
+        raise SystemExit(
+            f'lora_merge exports the dense Llama/Qwen2 family only; '
+            f'{args.hf_dir} is {type(cfg_only).__name__}. (Serve MoE '
+            f'LoRA runs by loading base + adapters directly.)')
     # dtype=None keeps the base's stored dtype (bf16 stays bf16 — the
     # merge itself happens in fp32 inside merge_into, and the export
     # keeps the artifact the same size as the base).

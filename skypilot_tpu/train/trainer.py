@@ -356,6 +356,8 @@ def train(tcfg: TrainerConfig) -> List[Dict[str, float]]:
 
 
 def main() -> None:
+    from skypilot_tpu.utils import jax_utils
+    jax_utils.pin_platform_from_env()
     parser = argparse.ArgumentParser(prog='skytpu-trainer')
     parser.add_argument('--model', default='llama-debug')
     parser.add_argument('--model-override', action='append', default=[],

@@ -1,0 +1,24 @@
+"""Small JAX runtime helpers shared by the compute CLIs."""
+from __future__ import annotations
+
+import os
+
+
+def pin_platform_from_env() -> None:
+    """Honor JAX_PLATFORMS even against force-registered TPU plugins.
+
+    Site hooks (e.g. the 'axon' tunnel plugin) can register their
+    platform at import time regardless of JAX_PLATFORMS; backend init
+    then touches the TPU tunnel — which can HANG a CPU-intended run
+    when the chip is held elsewhere. The config-level pin is the only
+    override that survives force-registration (same trick as
+    tests/conftest.py and __graft_entry__._force_cpu_platform).
+
+    Call at CLI entry, before anything triggers backend init. A no-op
+    when JAX_PLATFORMS is unset (normal on-TPU runs keep their default
+    platform resolution).
+    """
+    plat = os.environ.get('JAX_PLATFORMS')
+    if plat:
+        import jax
+        jax.config.update('jax_platforms', plat)

@@ -54,7 +54,12 @@ def _write_tokenizer_json(path: str, specials) -> None:
 def _write_hf_checkpoint(dirpath, family: str = 'llama'):
     """transformers-built tiny checkpoint (the import ground truth)."""
     import torch
-    if family == 'llama':
+    if family == 'mixtral':
+        from transformers import MixtralConfig as HFConfig
+        from transformers import MixtralForCausalLM as HFModel
+        kw = dict(_TINY, num_local_experts=4, num_experts_per_tok=2)
+        specials = _LLAMA3_SPECIALS
+    elif family == 'llama':
         from transformers import LlamaConfig as HFConfig
         from transformers import LlamaForCausalLM as HFModel
         kw = dict(_TINY, rope_scaling={
@@ -92,6 +97,13 @@ def llama_hf_dir(tmp_path_factory):
 def qwen_hf_dir(tmp_path_factory):
     d = tmp_path_factory.mktemp('hf_qwen')
     toks, logits = _write_hf_checkpoint(d, 'qwen2')
+    return str(d), toks, logits
+
+
+@pytest.fixture(scope='module')
+def mixtral_hf_dir(tmp_path_factory):
+    d = tmp_path_factory.mktemp('hf_mixtral')
+    toks, logits = _write_hf_checkpoint(d, 'mixtral')
     return str(d), toks, logits
 
 
@@ -158,6 +170,35 @@ class TestWeightParity:
 
     def test_qwen2_with_biases(self, qwen_hf_dir):
         self._check(*qwen_hf_dir)
+
+    def test_mixtral_moe_routing_and_experts(self, mixtral_hf_dir):
+        """Mixtral import: per-expert stacks + router. Softmax-then-
+        renormalize-top-k equals HF's softmax-over-top-k (shared
+        denominator cancels), so logits must agree to fp32 noise —
+        capacity is lifted so no token drops in the comparison."""
+        from skypilot_tpu.models import moe
+        hf_dir, toks, want = mixtral_hf_dir
+        cfg, params = hf_import.load_hf_checkpoint(hf_dir)
+        assert isinstance(cfg, moe.MoEConfig)
+        assert (cfg.n_experts, cfg.top_k) == (4, 2)
+        cfg = dataclasses.replace(cfg, dtype=jnp.float32, remat='none',
+                                  capacity_factor=16.0)
+        got = np.asarray(moe.forward(params, jnp.asarray(toks), cfg))
+        assert np.max(np.abs(got - want)) < 5e-3
+        np.testing.assert_array_equal(got.argmax(-1), want.argmax(-1))
+
+    def test_mixtral_config_mapping(self):
+        cfg = hf_import.config_from_hf({
+            'architectures': ['MixtralForCausalLM'], 'vocab_size': 32000,
+            'hidden_size': 4096, 'num_hidden_layers': 32,
+            'num_attention_heads': 32, 'num_key_value_heads': 8,
+            'intermediate_size': 14336, 'rope_theta': 1e6,
+            'rms_norm_eps': 1e-5, 'max_position_embeddings': 32768,
+            'num_local_experts': 8, 'num_experts_per_tok': 2})
+        from skypilot_tpu.models import moe
+        assert isinstance(cfg, moe.MoEConfig)
+        assert (cfg.n_experts, cfg.top_k) == (8, 2)
+        assert cfg.capacity_factor == 2.0
 
     def test_shape_mismatch_fails_loudly(self, llama_hf_dir):
         hf_dir, _, _ = llama_hf_dir
