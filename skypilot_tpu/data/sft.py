@@ -1,0 +1,150 @@
+"""SFT (chat) datasets: conversations → tokens + assistant-only loss
+masks.
+
+Reference analog: the finetuning recipes (llm/llama-3_1-finetuning/,
+llm/gpt-oss-finetuning/) run instruction tuning through torchtune/TRL,
+whose collators mask the loss to assistant turns. Here the pipeline is
+native and feeds the existing train step directly: train_lib's batch
+contract already carries an optional `loss_mask` over target positions,
+so SFT is purely a data-side concern.
+
+Input: JSONL, one conversation per line —
+    {"messages": [{"role": "user", "content": "..."},
+                  {"role": "assistant", "content": "..."}, ...]}
+
+Masking: each assistant message's CONTENT + closing special trains;
+role headers/openers and all non-assistant turns do not (the standard
+chat-SFT recipe). Multi-turn conversations train on every assistant
+turn at once. Segments are tokenized per-message (the same per-segment
+encoding chat collators use), so target spans are exact by
+construction — no string-offset guessing.
+"""
+from __future__ import annotations
+
+import functools
+import json
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+
+def render_segments(messages: List[Dict[str, str]], family: str
+                    ) -> List[Tuple[str, bool]]:
+    """Conversation → [(text, is_target)] segments, concatenation-equal
+    to the family's chat format (data/tokenizer.apply_chat_template,
+    minus the inference-time assistant opener)."""
+    from skypilot_tpu.data import tokenizer as tokenizer_lib
+    tokenizer_lib._validate(messages)
+    segs: List[Tuple[str, bool]] = []
+    if family == 'llama3':
+        segs.append(('<|begin_of_text|>', False))
+        for m in messages:
+            target = m['role'] == 'assistant'
+            segs.append((f"<|start_header_id|>{m['role']}"
+                         f'<|end_header_id|>\n\n', False))
+            segs.append((f"{m['content']}<|eot_id|>", target))
+    elif family == 'chatml':
+        for m in messages:
+            target = m['role'] == 'assistant'
+            segs.append((f"<|im_start|>{m['role']}\n", False))
+            segs.append((f"{m['content']}<|im_end|>\n", target))
+    elif family == 'plain':
+        for m in messages:
+            target = m['role'] == 'assistant'
+            segs.append((f"{m['role']}: ", False))
+            segs.append((f"{m['content']}\n", target))
+    else:
+        raise ValueError(f'unknown chat family {family!r}')
+    return segs
+
+
+def encode_example(messages: List[Dict[str, str]], tokenizer,
+                   family: str, seq_len: int
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """One conversation → (tokens [seq_len+1], loss_mask [seq_len]).
+
+    tokens feed train_lib's shift-internally contract: inputs =
+    tokens[:-1], targets = tokens[1:], and loss_mask[t] gates TARGET
+    position t (i.e. predicting tokens[t+1]). A target-segment token at
+    sequence position p is therefore marked at mask index p-1 — the
+    model is trained to PRODUCE assistant tokens, not to predict what
+    follows them. Right-truncated at seq_len+1, right-padded with 0s
+    (mask 0, so padding never contributes loss)."""
+    ids: List[int] = []
+    is_target: List[bool] = []
+    for text, target in render_segments(messages, family):
+        # add_special_tokens=False: segments carry their specials
+        # literally; a post-processor auto-BOS (real Llama-3
+        # tokenizer.json) would inject a spurious token into EVERY
+        # segment — and into the loss targets.
+        seg = tokenizer.encode(text, add_special_tokens=False)
+        ids.extend(seg)
+        is_target.extend([target] * len(seg))
+    ids = ids[:seq_len + 1]
+    is_target = is_target[:seq_len + 1]
+    tokens = np.zeros((seq_len + 1,), np.int32)
+    tokens[:len(ids)] = ids
+    mask = np.zeros((seq_len,), np.float32)
+    for pos in range(1, len(ids)):
+        if is_target[pos]:
+            mask[pos - 1] = 1.0
+    return tokens, mask
+
+
+def load_sft_dataset(path: str, tokenizer, family: str, seq_len: int
+                     ) -> Tuple[np.ndarray, np.ndarray]:
+    """JSONL → (tokens [N, seq_len+1], loss_mask [N, seq_len]).
+
+    Conversations with no assistant turn (nothing to train on) are
+    skipped with a warning; an empty result raises."""
+    tokens_rows, mask_rows, skipped = [], [], 0
+    with open(path, 'r', encoding='utf-8') as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            messages = rec.get('messages')
+            if messages is None:
+                raise ValueError(f'{path}:{lineno}: record needs '
+                                 f'"messages"')
+            t, m = encode_example(messages, tokenizer, family, seq_len)
+            if m.sum() == 0:
+                skipped += 1
+                continue
+            tokens_rows.append(t)
+            mask_rows.append(m)
+    if skipped:
+        logger.warning(f'{path}: skipped {skipped} conversation(s) with '
+                       f'no trainable assistant tokens (missing '
+                       f'assistant turn, or truncated away at '
+                       f'--seq-len {seq_len}).')
+    if not tokens_rows:
+        raise ValueError(f'{path}: no trainable conversations.')
+    return np.stack(tokens_rows), np.stack(mask_rows)
+
+
+@functools.lru_cache(maxsize=4)
+def _epoch_perm(n: int, epoch: int) -> np.ndarray:
+    return np.random.default_rng(epoch).permutation(n)
+
+
+def batch_at_step(tokens: np.ndarray, masks: np.ndarray, step: int,
+                  batch_size: int) -> Dict[str, Any]:
+    """Step-indexed batch (deterministic across resume, same contract
+    as loader.batch_at_step): examples cycle with a per-epoch
+    deterministic shuffle. The epoch is computed PER ELEMENT, so an
+    epoch-boundary batch draws its tail from the next epoch's
+    permutation — every epoch serves every example exactly once even
+    when n % batch_size != 0. Permutations are cached (O(batch) per
+    step, not O(dataset))."""
+    n = tokens.shape[0]
+    rows = np.empty((batch_size,), np.int64)
+    for i in range(batch_size):
+        epoch, off = divmod(step * batch_size + i, n)
+        rows[i] = _epoch_perm(n, epoch)[off]
+    return {'tokens': tokens[rows], 'loss_mask': masks[rows]}

@@ -36,7 +36,9 @@ class ByteTokenizer:
     eos_ids: List[int] = []
     vocab_size = 256
 
-    def encode(self, text: str) -> List[int]:
+    def encode(self, text: str,
+               add_special_tokens: bool = True) -> List[int]:
+        del add_special_tokens   # bytes have no specials to add
         return list(text.encode('utf-8'))
 
     def decode(self, ids: Sequence[int]) -> str:
@@ -73,8 +75,15 @@ class HFTokenizer:
                 break
         self.eos_ids = sorted(eos)
 
-    def encode(self, text: str) -> List[int]:
-        return list(self._tok.encode(text).ids)
+    def encode(self, text: str,
+               add_special_tokens: bool = True) -> List[int]:
+        """`add_special_tokens=False` skips the tokenizer's
+        post-processor (e.g. Llama-3's auto-BOS) — required whenever
+        `text` already carries its specials literally (chat templates,
+        SFT segments), where the post-processor would inject a SECOND
+        BOS."""
+        return list(self._tok.encode(
+            text, add_special_tokens=add_special_tokens).ids)
 
     def decode(self, ids: Sequence[int]) -> str:
         # skip_special_tokens: stop/eos specials never leak into output

@@ -871,8 +871,11 @@ def build_app(engine: InferenceEngine):
         try:
             prompt_text = tokenizer_lib.apply_chat_template(
                 body.get('messages'), engine.tokenizer.chat_family)
-            tokens = [int(t)
-                      for t in engine.tokenizer.encode(prompt_text)]
+            # The template carries its specials literally — skip the
+            # tokenizer post-processor (real Llama-3 tokenizer.json
+            # auto-prepends BOS, which would double it here).
+            tokens = [int(t) for t in engine.tokenizer.encode(
+                prompt_text, add_special_tokens=False)]
             if not tokens:
                 raise ValueError('empty prompt after templating')
             max_new = int(body.get('max_tokens',

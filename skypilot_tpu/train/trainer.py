@@ -57,6 +57,11 @@ class TrainerConfig:
     lora_targets: Optional[List[str]] = None
     hf_dir: Optional[str] = None
     lora_dir: Optional[str] = None
+    # SFT: a JSONL of {"messages": [...]} conversations; loss masks to
+    # assistant turns (data/sft.py). chat_family None = auto-detect
+    # from the tokenizer's specials (llama3/chatml/plain).
+    sft_data_path: Optional[str] = None
+    chat_family: Optional[str] = None
 
 
 def maybe_init_distributed() -> None:
@@ -88,8 +93,47 @@ def _model_config(tcfg: TrainerConfig):
     return cfg
 
 
+def _sft_batch_iter(tcfg: TrainerConfig, vocab_size: int,
+                    start_step: int, mesh) -> Iterator[Dict[str, Any]]:
+    """Conversation batches with assistant-only loss masks."""
+    import os as os_lib
+
+    from skypilot_tpu.data import loader, sft
+    from skypilot_tpu.data import tokenizer as tokenizer_lib
+    tok_path = tcfg.tokenizer
+    if tok_path is None and tcfg.hf_dir:
+        # No silent byte fallback for an HF finetune: a missing
+        # tokenizer.json must error (load_tokenizer's hint), not train
+        # the model on byte-tokenized garbage.
+        tok_path = os_lib.path.join(
+            os_lib.path.expanduser(tcfg.hf_dir), 'tokenizer.json')
+    if tok_path:
+        tokenizer = tokenizer_lib.load_tokenizer(tok_path)
+    else:
+        tokenizer = tokenizer_lib.ByteTokenizer()
+    family = tcfg.chat_family or tokenizer.chat_family
+    tokens, masks = sft.load_sft_dataset(tcfg.sft_data_path, tokenizer,
+                                         family, tcfg.seq_len)
+    if tokens.max() >= vocab_size:
+        raise ValueError(
+            f'SFT corpus has token id {int(tokens.max())} but the model '
+            f'vocab is {vocab_size} — tokenizer/model mismatch.')
+    logger.info(f'SFT: {tokens.shape[0]} conversations '
+                f'({family} template), '
+                f'{float(masks.sum()):.0f} trainable tokens.')
+    step = start_step
+    while True:
+        yield loader.shard_batch(
+            sft.batch_at_step(tokens, masks, step, tcfg.batch_size),
+            mesh)
+        step += 1
+
+
 def _batch_iter(tcfg: TrainerConfig, vocab_size: int, start_step: int,
                 mesh) -> Iterator[Dict[str, Any]]:
+    if tcfg.sft_data_path:
+        yield from _sft_batch_iter(tcfg, vocab_size, start_step, mesh)
+        return
     from skypilot_tpu.data import loader
     if tcfg.data_path is None:
         # Synthetic stream, still step-indexed for resume determinism.
@@ -154,6 +198,9 @@ def train(tcfg: TrainerConfig) -> List[Dict[str, float]]:
             f'batch_size={tcfg.batch_size} must be divisible by '
             f'data*fsdp={batch_shards} (the batch-dim mesh axes).')
 
+    if tcfg.sft_data_path and tcfg.data_path:
+        raise ValueError('--sft-data and --data are exclusive (chat '
+                         'SFT vs plain-corpus LM).')
     lora_mode = tcfg.lora_rank > 0
     if lora_mode and tcfg.ckpt_dir:
         raise ValueError('--lora-rank and --ckpt-dir are exclusive: LoRA '
@@ -393,6 +440,13 @@ def main() -> None:
                              'base weights; preset ignored).')
     parser.add_argument('--lora-dir', default=None,
                         help='Directory for adapters.npz (save/resume).')
+    parser.add_argument('--sft-data', default=None,
+                        help='JSONL of {"messages": [...]} conversations '
+                             '(assistant-only loss, data/sft.py).')
+    parser.add_argument('--chat-family', default=None,
+                        choices=('llama3', 'chatml', 'plain'),
+                        help='Chat template (default: from the '
+                             "tokenizer's special tokens).")
     args = parser.parse_args()
 
     def _parse_kv(items):
@@ -426,7 +480,8 @@ def main() -> None:
         lora_targets=([t.strip() for t in args.lora_targets.split(',')
                        if t.strip()]
                       if args.lora_targets else None),
-        hf_dir=args.hf_dir, lora_dir=args.lora_dir)
+        hf_dir=args.hf_dir, lora_dir=args.lora_dir,
+        sft_data_path=args.sft_data, chat_family=args.chat_family)
     train(tcfg)
 
 
