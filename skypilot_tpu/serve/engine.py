@@ -367,56 +367,76 @@ class InferenceEngine:
                                         topp, rng, active)
 
         @functools.partial(jax.jit, donate_argnums=(1,))
-        def admit(params, cache, tokens, length, slot, temp, topk, topp,
-                  rng):
-            """Prefill one prompt (bucketed [1, S]) into cache row `slot`
-            and sample its first token. One compile per prompt bucket."""
-            logits, row = dec.prefill(params, tokens, cfg, max_len,
-                                      lengths=length[None])
+        def admit(params, cache, tokens, lengths, slots, temps, topks,
+                  topps, rng):
+            """Prefill a GROUP of same-bucket prompts ([N, S]) into
+            cache rows `slots` ([N], distinct) and sample each first
+            token. One compile per (prompt bucket, group size) pair —
+            a concurrency burst pays ONE prefill device call instead of
+            N serial ones (the TTFT-dominant cost at high load)."""
+            logits, rows = dec.prefill(params, tokens, cfg, max_len,
+                                       lengths=lengths)
 
-            def write(big, one):
+            def write(big, group):
                 if big.ndim == 1:               # the per-row length vector
-                    return big.at[slot].set(one[0])
-                return big.at[:, slot].set(one[:, 0])
+                    return big.at[slots].set(group)
+                return big.at[:, slots].set(group)
 
-            cache = jax.tree.map(write, cache, row)
+            cache = jax.tree.map(write, cache, rows)
             rng, sub = jax.random.split(rng)
+            if logits.ndim == 1:
+                logits = logits[None]
             first = decode_lib.select_token_per_row(
-                logits[None] if logits.ndim == 1 else logits,
-                temp[None], topk[None], topp[None], sub)[0]
+                logits, temps, topks, topps, sub)
             return first, cache, rng
 
         self._step_jit = step
         self._admit_jit = admit
         self._state_ready = True
 
+    @staticmethod
+    def _group_sizes() -> List[int]:
+        sizes, s = [], 1
+        while s <= MAX_BATCH:
+            sizes.append(s)
+            s *= 2
+        return sizes
+
     def warmup(self, buckets: Optional[List[int]] = None) -> None:
         """Compile BOTH step programs (k=1 and k=MAX_STEP_CHUNK) plus the
-        admit program for each prompt bucket in `buckets` (default: the
-        16-token bucket) through the real code path, then free the warmup
-        slots; /health flips only after. Step programs never recompile
-        after this; admit compiles once per prompt bucket — warm the
-        buckets your traffic uses (--warm-buckets all) to guarantee no
-        client request ever hits a fresh XLA compile."""
+        admit programs — every power-of-two GROUP SIZE — for each prompt
+        bucket in `buckets` (default: the 16-token bucket) through the
+        real code path, then free the warmup slots; /health flips only
+        after. Step programs never recompile after this; admit compiles
+        once per (prompt bucket, group size) — warm the buckets your
+        traffic uses (--warm-buckets all) to guarantee no client request
+        ever hits a fresh XLA compile."""
         self._ensure_state()
-        self._admit((list(range(1, 9)), MAX_STEP_CHUNK + 2, 0.0, None,
-                     None, (), None, None))
+        warm_item = (list(range(1, 9)), MAX_STEP_CHUNK + 2, 0.0, None,
+                     None, (), None, None)
+        self._admit(warm_item)
         self._step_once()      # k = MAX_STEP_CHUNK (remaining is large)
         self._step_once()      # k = 1 (remaining == 1)
         self.slots = [None] * MAX_BATCH
+        for size in self._group_sizes()[1:]:
+            self._admit_group([warm_item] * size)
+            self.slots = [None] * MAX_BATCH
         for b in (buckets or []):
             # b == max_len is unreachable by traffic (_check_len needs
             # bucket + max_new <= max_len with max_new >= 1) — don't pay
             # an XLA compile for it.
             if b <= 16 or b >= self.max_len:
                 continue
-            self._admit((list(range(1, b + 1)), 1, 0.0, None, None, (),
-                         None, None))
-            self.slots = [None] * MAX_BATCH
+            item_b = (list(range(1, b + 1)), 1, 0.0, None, None, (),
+                      None, None)
+            for size in self._group_sizes():
+                self._admit_group([item_b] * size)
+                self.slots = [None] * MAX_BATCH
         self.last[:] = 0
         self.warm = True
-        logger.info('Engine warm (step + admit programs compiled; buckets: '
-                    f'{sorted(set([16] + list(buckets or [])))}).')
+        logger.info('Engine warm (step + grouped-admit programs compiled; '
+                    f'buckets: {sorted(set([16] + list(buckets or [])))}, '
+                    f'group sizes: {self._group_sizes()}).')
 
     def all_buckets(self) -> List[int]:
         """Every admissible prompt bucket (for --warm-buckets all) —
@@ -460,42 +480,68 @@ class InferenceEngine:
         return await fut
 
     def _free_slot(self) -> Optional[int]:
-        for i, s in enumerate(self.slots):
-            if s is None:
-                return i
-        return None
+        return self._free_slot_excluding(())
 
     def _admit(self, item) -> None:
-        """Prefill a request into a free slot (device work: call off-loop)."""
+        """Back-compat single admit (warmup + tests)."""
+        self._admit_group([item])
+
+    def _admit_group(self, items) -> None:
+        """Prefill same-bucket requests in ONE device call (device
+        work: call off-loop). Callers group by bucket and split counts
+        into power-of-two sizes so the compile count stays bounded at
+        (#buckets × log2(MAX_BATCH)) programs."""
+        import jax
         jnp = self._jnp
-        (tokens, max_new, temperature, top_k, top_p, stop_ids, stream_q,
-         fut) = item
-        slot = self._free_slot()
-        assert slot is not None
-        s = _bucket(len(tokens))
-        padded = jnp.asarray([tokens + [0] * (s - len(tokens))], jnp.int32)
-        self.temp[slot] = max(float(temperature), 0.0)
-        self.topk[slot] = int(top_k) if top_k else 0
-        self.topp[slot] = float(top_p) if top_p else 0.0
+        bucket = _bucket(len(items[0][0]))
+        slots, padded, lengths = [], [], []
+        temps, topks, topps = [], [], []
+        for item in items:
+            tokens = item[0]
+            assert _bucket(len(tokens)) == bucket, 'caller groups by bucket'
+            slot = self._free_slot_excluding(slots)
+            assert slot is not None
+            slots.append(slot)
+            padded.append(tokens + [0] * (bucket - len(tokens)))
+            lengths.append(len(tokens))
+            temperature, top_k, top_p = item[2], item[3], item[4]
+            self.temp[slot] = max(float(temperature), 0.0)
+            self.topk[slot] = int(top_k) if top_k else 0
+            self.topp[slot] = float(top_p) if top_p else 0.0
+            temps.append(self.temp[slot])
+            topks.append(self.topk[slot])
+            topps.append(self.topp[slot])
         first, self.cache, self.rng = self._admit_jit(
-            self.params, self.cache, padded,
-            jnp.int32(len(tokens)), jnp.int32(slot),
-            jnp.float32(self.temp[slot]), jnp.int32(self.topk[slot]),
-            jnp.float32(self.topp[slot]), self.rng)
-        first = int(first)
-        self.last[slot] = first
-        stop = frozenset(stop_ids or ())
-        entry = {'fut': fut, 'want': max_new, 'out': [],
-                 'stop': stop, 'stream': stream_q, 'sent': 0,
-                 'finish': None}
-        if first in stop:
-            entry['finish'] = 'stop'
-        else:
-            entry['out'].append(first)
-            self.tokens_generated += 1
-            if len(entry['out']) >= max_new:
-                entry['finish'] = 'length'
-        self.slots[slot] = entry
+            self.params, self.cache, jnp.asarray(padded, jnp.int32),
+            jnp.asarray(lengths, jnp.int32),
+            jnp.asarray(slots, jnp.int32),
+            jnp.asarray(temps, jnp.float32),
+            jnp.asarray(topks, jnp.int32),
+            jnp.asarray(topps, jnp.float32), self.rng)
+        first = jax.device_get(first)
+        for i, item in enumerate(items):
+            (_, max_new, _, _, _, stop_ids, stream_q, fut) = item
+            slot = slots[i]
+            tok = int(first[i])
+            self.last[slot] = tok
+            stop = frozenset(stop_ids or ())
+            entry = {'fut': fut, 'want': max_new, 'out': [],
+                     'stop': stop, 'stream': stream_q, 'sent': 0,
+                     'finish': None}
+            if tok in stop:
+                entry['finish'] = 'stop'
+            else:
+                entry['out'].append(tok)
+                self.tokens_generated += 1
+                if len(entry['out']) >= max_new:
+                    entry['finish'] = 'length'
+            self.slots[slot] = entry
+
+    def _free_slot_excluding(self, taken) -> Optional[int]:
+        for i, s in enumerate(self.slots):
+            if s is None and i not in taken:
+                return i
+        return None
 
     def _step_once(self) -> None:
         """Decode step(s) over the whole slot pool (device work).
@@ -561,28 +607,60 @@ class InferenceEngine:
                     fut.set_result((s['out'], s['finish']))
                 self.slots[i] = None
 
+    def _drain_admissible(self, already: int = 0) -> list:
+        """Pop queued requests up to the free-slot budget (non-blocking);
+        `already` counts items the caller holds outside the queue."""
+        items = []
+        free = sum(1 for s in self.slots if s is None) - already
+        while len(items) < free and not self._queue.empty():
+            items.append(self._queue.get_nowait())
+        return items
+
+    @staticmethod
+    def _admit_groups(items) -> list:
+        """Split pending requests into admit groups: same prompt bucket,
+        power-of-two sizes (largest first) — each group is one prefill
+        device call, and the compile count stays bounded at
+        #buckets × log2(MAX_BATCH) programs."""
+        by_bucket: Dict[int, list] = {}
+        for it in items:
+            by_bucket.setdefault(_bucket(len(it[0])), []).append(it)
+        groups = []
+        for _, lst in sorted(by_bucket.items()):
+            i = 0
+            while i < len(lst):
+                size = 1
+                while size * 2 <= len(lst) - i and size * 2 <= MAX_BATCH:
+                    size *= 2
+                groups.append(lst[i:i + size])
+                i += size
+        return groups
+
+    async def _admit_pending(self, first_item=None) -> None:
+        items = ([first_item] if first_item is not None else [])
+        items += self._drain_admissible(already=len(items))
+        for group in self._admit_groups(items):
+            try:
+                await asyncio.to_thread(self._admit_group, group)
+            except Exception as e:  # pylint: disable=broad-except
+                self._fail_all(e, extra=group)
+
     async def batch_loop(self) -> None:
         """Continuous scheduler: admit whenever a slot is free, step while
         anything is active. A late request joins after at most one
         in-flight fused call — it never waits for earlier requests to
-        drain."""
+        drain. Concurrent arrivals sharing a prompt bucket prefill in
+        ONE device call (grouped admission)."""
         self._ensure_state()
         while True:
             busy = any(s is not None for s in self.slots)
             if not busy:
                 item = await self._queue.get()
-                try:
-                    await asyncio.to_thread(self._admit, item)
-                except Exception as e:  # pylint: disable=broad-except
-                    self._fail_all(e, extra=item)
+                await self._admit_pending(first_item=item)
                 self._publish()         # want==1 resolves without a step
                 continue
-            while self._free_slot() is not None and not self._queue.empty():
-                item = self._queue.get_nowait()
-                try:
-                    await asyncio.to_thread(self._admit, item)
-                except Exception as e:  # pylint: disable=broad-except
-                    self._fail_all(e, extra=item)
+            if self._free_slot() is not None and not self._queue.empty():
+                await self._admit_pending()
             self._publish()             # first tokens stream immediately
             try:
                 await asyncio.to_thread(self._step_once)
@@ -605,7 +683,10 @@ class InferenceEngine:
                 fut.set_exception(e)
 
         if extra is not None:
-            fail(extra[-1], extra[-2])
+            # One pending item, or a whole admit group.
+            items = extra if isinstance(extra, list) else [extra]
+            for item in items:
+                fail(item[-1], item[-2])
         for s in self.slots:
             if s is not None:
                 fail(s['fut'], s['stream'])
