@@ -384,8 +384,7 @@ class InferenceEngine:
 
             cache = jax.tree.map(write, cache, rows)
             rng, sub = jax.random.split(rng)
-            if logits.ndim == 1:
-                logits = logits[None]
+            # prefill keeps the batch dim: logits [N, V].
             first = decode_lib.select_token_per_row(
                 logits, temps, topks, topps, sub)
             return first, cache, rng
@@ -433,6 +432,11 @@ class InferenceEngine:
                 self._admit_group([item_b] * size)
                 self.slots = [None] * MAX_BATCH
         self.last[:] = 0
+        # Warmup admits must not pollute the served-token/step metrics
+        # (/metrics feeds dashboards; phantom warmup tokens would skew
+        # tokens-per-request forever).
+        self.step_count = 0
+        self.tokens_generated = 0
         self.warm = True
         logger.info('Engine warm (step + grouped-admit programs compiled; '
                     f'buckets: {sorted(set([16] + list(buckets or [])))}, '
