@@ -279,6 +279,74 @@ def prefill(params, tokens: jnp.ndarray, cfg: llama.LlamaConfig,
     return logits[:, 0], cache
 
 
+def prefill_extend(params, tokens: jnp.ndarray, cfg: llama.LlamaConfig,
+                   max_len: int, prefix_k: jnp.ndarray,
+                   prefix_v: jnp.ndarray,
+                   lengths: Optional[jnp.ndarray] = None
+                   ) -> Tuple[jnp.ndarray, KVCache]:
+    """Prefill a SUFFIX over an already-computed prefix KV
+    (prefix caching: a shared system prompt / chat history pays its
+    prefill once; later requests only prefill their new tokens).
+
+    tokens [B, S2] (suffix, right-padded; `lengths` [B] = real suffix
+    lengths), prefix_k/v [L, B, P, KH, hd] with every row holding a
+    FULL P-token prefix. Returns per-row last-content logits and a
+    cache whose rows are [prefix + suffix] with length P + lengths.
+
+    The suffix queries run at positions P..P+S2 (rope + causal offsets)
+    attending over [prefix_kv ++ suffix_kv] — exactly the math full
+    prefill would produce (asserted bit-for-bit in tests). P and the S2
+    bucket are static → one compile per (P, S2-bucket) pair; callers
+    keep P to powers of two to bound the program count.
+    """
+    b, s2 = tokens.shape
+    p = prefix_k.shape[2]
+    if p + s2 > max_len:
+        raise ValueError(f'prefix ({p}) + suffix ({s2}) exceeds '
+                         f'max_len ({max_len})')
+    lengths = (jnp.full((b,), s2, jnp.int32) if lengths is None
+               else jnp.asarray(lengths, jnp.int32))
+    x = jnp.take(params['embed'], tokens, axis=0).astype(cfg.dtype)
+    if cfg.embed_scale:
+        x = x * jnp.asarray(cfg.dim ** 0.5, cfg.dtype)
+    positions = jnp.arange(s2) + p
+    sin, cos = llama.rope_tables(cfg, positions)
+    impl = 'auto' if cfg.attention_impl == 'ring' else cfg.attention_impl
+
+    def body(carry, xs):
+        lp, layer_idx, pk, pv = xs
+        sin_l, cos_l = llama.select_rope(sin, cos, layer_idx, cfg)
+        q, k, v = _qkv(carry, lp, cfg, sin_l, cos_l)
+        k_all = jnp.concatenate([pk.astype(k.dtype), k], axis=1)
+        v_all = jnp.concatenate([pv.astype(v.dtype), v], axis=1)
+        w_active = (llama.window_active(layer_idx, cfg)
+                    if cfg.sliding_window else None)
+        out = _attention(q, k_all, v_all, impl=impl, causal=True,
+                         q_offset=p, kv_offset=0,
+                         logit_softcap=cfg.attn_logit_softcap,
+                         window=cfg.sliding_window,
+                         window_active=w_active,
+                         sinks=(lp['sink'].astype(jnp.float32)
+                                if cfg.attn_sinks else None))
+        out = out.reshape(b, s2, cfg.n_heads * cfg.hd)
+        carry = carry + _wo_project(out, lp, cfg)
+        carry = carry + _ffn(carry, lp, cfg)
+        return carry, (k, v)
+
+    layer_ids = jnp.arange(cfg.n_layers, dtype=jnp.int32)
+    x, (ks, vs) = jax.lax.scan(
+        body, x, (params['layers'], layer_ids, prefix_k, prefix_v))
+    full_k = jnp.concatenate([prefix_k.astype(ks.dtype), ks], axis=2)
+    full_v = jnp.concatenate([prefix_v.astype(vs.dtype), vs], axis=2)
+    pad = [(0, 0), (0, 0), (0, max_len - p - s2), (0, 0), (0, 0)]
+    cache = KVCache(k=jnp.pad(full_k, pad), v=jnp.pad(full_v, pad),
+                    length=p + lengths)
+    x_last = jnp.take_along_axis(
+        x, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1)
+    logits = _unembed(x_last, params, cfg)
+    return logits[:, 0], cache
+
+
 def decode_step(params, token: jnp.ndarray, cache: KVCache,
                 cfg: llama.LlamaConfig,
                 rules: Optional[sharding_lib.Rules] = None,

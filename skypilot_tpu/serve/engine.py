@@ -56,6 +56,14 @@ MAX_BATCH = int(os.environ.get('SKYTPU_ENGINE_MAX_BATCH', '8'))
 MAX_STEP_CHUNK = int(os.environ.get('SKYTPU_ENGINE_STEP_CHUNK', '8'))
 # Bounded admission queue: overflow => 429 (backpressure the LB can see).
 MAX_QUEUE = int(os.environ.get('SKYTPU_ENGINE_MAX_QUEUE', '64'))
+# Prefix (system-prompt) KV cache: LRU entry count, 0 disables. A hit
+# prefills only the new tokens (decode.prefill_extend) — the TTFT win
+# for chat traffic re-sending system prompt + history every turn.
+PREFIX_CACHE_ENTRIES = int(os.environ.get('SKYTPU_ENGINE_PREFIX_CACHE',
+                                          '4'))
+# Prompts shorter than this are never snapshotted (the prefill they'd
+# save is too small to matter; powers of two only).
+PREFIX_MIN_TOKENS = 64
 
 
 class EngineOverloaded(Exception):
@@ -321,6 +329,14 @@ class InferenceEngine:
         self.temp = np.zeros(MAX_BATCH, np.float32)
         self.topk = np.zeros(MAX_BATCH, np.int32)
         self.topp = np.zeros(MAX_BATCH, np.float32)
+        # Prefix snapshots live OUTSIDE the donated cache buffer (their
+        # slices own their storage), so they survive resets — but wipe
+        # them anyway: after a poisoned-state reset nothing device-side
+        # should be trusted.
+        import collections
+        self._prefix_store: 'collections.OrderedDict' = \
+            collections.OrderedDict()
+        self.prefix_hits = 0
 
     def _ensure_state(self) -> None:
         """Jitted step/admit closures, built once (after any test-time cfg
@@ -389,8 +405,31 @@ class InferenceEngine:
                 logits, temps, topks, topps, sub)
             return first, cache, rng
 
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def admit_extend(params, cache, prefix_k, prefix_v, tokens,
+                         length, slot, temp, topk, topp, rng):
+            """Prefix-cache admit (single request): prefill only the
+            SUFFIX over a stored prefix KV (decode.prefill_extend).
+            One compile per (prefix length, suffix bucket) pair —
+            prefixes are snapshotted at power-of-two lengths."""
+            logits, row = decode_lib.prefill_extend(
+                params, tokens, cfg, max_len, prefix_k[:, None],
+                prefix_v[:, None], lengths=length[None])
+
+            def write(big, one):
+                if big.ndim == 1:
+                    return big.at[slot].set(one[0])
+                return big.at[:, slot].set(one[:, 0])
+
+            cache = jax.tree.map(write, cache, row)
+            rng, sub = jax.random.split(rng)
+            first = decode_lib.select_token_per_row(
+                logits, temp[None], topk[None], topp[None], sub)[0]
+            return first, cache, rng
+
         self._step_jit = step
         self._admit_jit = admit
+        self._admit_extend_jit = admit_extend
         self._state_ready = True
 
     @staticmethod
@@ -434,9 +473,12 @@ class InferenceEngine:
         self.last[:] = 0
         # Warmup admits must not pollute the served-token/step metrics
         # (/metrics feeds dashboards; phantom warmup tokens would skew
-        # tokens-per-request forever).
+        # tokens-per-request forever) — nor the prefix store (fake
+        # warmup prompts must never match real traffic).
         self.step_count = 0
         self.tokens_generated = 0
+        self._prefix_store.clear()
+        self.prefix_hits = 0
         self.warm = True
         logger.info('Engine warm (step + grouped-admit programs compiled; '
                     f'buckets: {sorted(set([16] + list(buckets or [])))}, '
@@ -490,13 +532,109 @@ class InferenceEngine:
         """Back-compat single admit (warmup + tests)."""
         self._admit_group([item])
 
+    # -- prefix (system-prompt) KV cache -------------------------------
+    def _prefix_match(self, tokens) -> Optional[int]:
+        """Longest snapshotted power-of-two prefix of `tokens` (strict:
+        at least one suffix token must remain, and the prefix + the
+        bucketed suffix must still fit max_len — p + bucket(len-p) can
+        exceed bucket(len) for non-power-of-two --max-len, and an
+        overflow inside the admit jit would fail the whole pool), or
+        None (→ full prefill)."""
+        if not self._prefix_store:
+            return None
+        p = PREFIX_MIN_TOKENS
+        best = None
+        while p < len(tokens):
+            if (tuple(tokens[:p]) in self._prefix_store and
+                    p + _bucket(len(tokens) - p) <= self.max_len):
+                best = p
+            p *= 2
+        return best
+
+    def _prefix_capture(self, tokens, slot) -> None:
+        """Snapshot this slot's first pow2-many KV rows under the token
+        prefix key (device-side slice — owns its buffer, so later cache
+        donation can't invalidate it)."""
+        if (PREFIX_CACHE_ENTRIES <= 0 or
+                len(tokens) < PREFIX_MIN_TOKENS or
+                not hasattr(self.cache, 'k')):      # dense KVCache only
+            return
+        p = PREFIX_MIN_TOKENS
+        while p * 2 <= len(tokens):
+            p *= 2
+        key = tuple(tokens[:p])
+        if key in self._prefix_store:
+            self._prefix_store.move_to_end(key)
+            return
+        self._prefix_store[key] = (self.cache.k[:, slot, :p],
+                                   self.cache.v[:, slot, :p])
+        while len(self._prefix_store) > PREFIX_CACHE_ENTRIES:
+            self._prefix_store.popitem(last=False)
+
+    def _admit_with_prefix(self, item, p: int) -> int:
+        """Admit one request over a stored prefix; returns the slot."""
+        jnp = self._jnp
+        (tokens, _, temperature, top_k, top_p, *_rest) = item
+        slot = self._free_slot()
+        assert slot is not None
+        suffix = tokens[p:]
+        s2 = _bucket(len(suffix))
+        padded = jnp.asarray([suffix + [0] * (s2 - len(suffix))],
+                             jnp.int32)
+        self.temp[slot] = max(float(temperature), 0.0)
+        self.topk[slot] = int(top_k) if top_k else 0
+        self.topp[slot] = float(top_p) if top_p else 0.0
+        key = tuple(tokens[:p])
+        pk, pv = self._prefix_store[key]
+        self._prefix_store.move_to_end(key)
+        first, self.cache, self.rng = self._admit_extend_jit(
+            self.params, self.cache, pk, pv, padded,
+            jnp.int32(len(suffix)), jnp.int32(slot),
+            jnp.float32(self.temp[slot]), jnp.int32(self.topk[slot]),
+            jnp.float32(self.topp[slot]), self.rng)
+        self.prefix_hits += 1
+        self._finish_admit(item, slot, int(first))
+        # The slot now holds the FULL prompt's KV — snapshot the longer
+        # prefix so a growing chat history keeps extending its cache
+        # (turn N+1 hits turn N's whole prompt, not just the oldest
+        # 64-token prefix).
+        self._prefix_capture(tokens, slot)
+        return slot
+
+    def _finish_admit(self, item, slot: int, first: int) -> None:
+        (_, max_new, _, _, _, stop_ids, stream_q, fut) = item
+        self.last[slot] = first
+        stop = frozenset(stop_ids or ())
+        entry = {'fut': fut, 'want': max_new, 'out': [],
+                 'stop': stop, 'stream': stream_q, 'sent': 0,
+                 'finish': None}
+        if first in stop:
+            entry['finish'] = 'stop'
+        else:
+            entry['out'].append(first)
+            self.tokens_generated += 1
+            if len(entry['out']) >= max_new:
+                entry['finish'] = 'length'
+        self.slots[slot] = entry
+
     def _admit_group(self, items) -> None:
         """Prefill same-bucket requests in ONE device call (device
         work: call off-loop). Callers group by bucket and split counts
         into power-of-two sizes so the compile count stays bounded at
-        (#buckets × log2(MAX_BATCH)) programs."""
+        (#buckets × log2(MAX_BATCH)) programs. A single-request group
+        whose prompt extends a snapshotted prefix prefills only the
+        suffix (_admit_with_prefix)."""
         import jax
         jnp = self._jnp
+        # self.warm gate: warmup's synthetic prompts share prefixes
+        # across buckets — a warmup hit would skip compiling the very
+        # grouped-admit programs warmup exists to build.
+        if (len(items) == 1 and self.warm and self._decode_is_dense()
+                and PREFIX_CACHE_ENTRIES > 0):
+            p = self._prefix_match(items[0][0])
+            if p is not None:
+                self._admit_with_prefix(items[0], p)
+                return
         bucket = _bucket(len(items[0][0]))
         slots, padded, lengths = [], [], []
         temps, topks, topps = [], [], []
@@ -524,22 +662,13 @@ class InferenceEngine:
             jnp.asarray(topps, jnp.float32), self.rng)
         first = jax.device_get(first)
         for i, item in enumerate(items):
-            (_, max_new, _, _, _, stop_ids, stream_q, fut) = item
-            slot = slots[i]
-            tok = int(first[i])
-            self.last[slot] = tok
-            stop = frozenset(stop_ids or ())
-            entry = {'fut': fut, 'want': max_new, 'out': [],
-                     'stop': stop, 'stream': stream_q, 'sent': 0,
-                     'finish': None}
-            if tok in stop:
-                entry['finish'] = 'stop'
-            else:
-                entry['out'].append(tok)
-                self.tokens_generated += 1
-                if len(entry['out']) >= max_new:
-                    entry['finish'] = 'length'
-            self.slots[slot] = entry
+            self._finish_admit(item, slots[i], int(first[i]))
+            if self.warm and self._decode_is_dense():
+                self._prefix_capture(item[0], slots[i])
+
+    def _decode_is_dense(self) -> bool:
+        from skypilot_tpu.models import decode as decode_lib
+        return self._decode is decode_lib
 
     def _free_slot_excluding(self, taken) -> Optional[int]:
         for i, s in enumerate(self.slots):
@@ -820,6 +949,8 @@ def build_app(engine: InferenceEngine):
             f'skytpu_engine_tokens_total {engine.tokens_generated}',
             '# TYPE skytpu_engine_requests_total counter',
             f'skytpu_engine_requests_total {engine.requests_total}',
+            '# TYPE skytpu_engine_prefix_hits_total counter',
+            f'skytpu_engine_prefix_hits_total {engine.prefix_hits}',
             '# TYPE skytpu_engine_rejected_total counter',
             f'skytpu_engine_rejected_total {engine.rejected_total}',
         ]

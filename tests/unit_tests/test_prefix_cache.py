@@ -1,0 +1,213 @@
+"""Prefix (system-prompt) KV caching: decode.prefill_extend exactness +
+the engine's snapshot/match/admit path.
+
+Reference analog: vLLM's automatic prefix caching / JetStream prompt
+caching — the serving engines the reference deploys on TPU. Here the
+capability is native: suffix-only prefill over a stored prefix KV.
+"""
+import asyncio
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu import models as models_lib
+from skypilot_tpu.models import decode, llama
+from skypilot_tpu.serve import engine as engine_lib
+
+
+class TestPrefillExtend:
+
+    @pytest.fixture(scope='class')
+    def model(self):
+        cfg = models_lib.get_config('llama-debug')
+        cfg = dataclasses.replace(cfg, dtype=jnp.float32)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        return cfg, params
+
+    def test_extend_equals_full_prefill(self, model):
+        """prefill(prefix) + prefill_extend(suffix) must equal
+        prefill(prefix+suffix) bit-for-bit: logits, cache contents,
+        and lengths."""
+        cfg, params = model
+        rng = jax.random.PRNGKey(1)
+        full = jax.random.randint(rng, (1, 24), 0, cfg.vocab_size,
+                                  dtype=jnp.int32)
+        p = 16
+        want_logits, want_cache = decode.prefill(params, full, cfg,
+                                                 max_len=48)
+        _, pre_cache = decode.prefill(params, full[:, :p], cfg,
+                                      max_len=p)
+        got_logits, got_cache = decode.prefill_extend(
+            params, full[:, p:], cfg, 48,
+            pre_cache.k[:, :, :p], pre_cache.v[:, :, :p])
+        np.testing.assert_allclose(np.asarray(got_logits),
+                                   np.asarray(want_logits),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(got_cache.k),
+                                   np.asarray(want_cache.k),
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(got_cache.length),
+                                      np.asarray(want_cache.length))
+
+    def test_extend_then_decode_matches_forward(self, model):
+        """Generation continued from an extended cache equals the
+        teacher-forced forward — the cache is a REAL cache."""
+        cfg, params = model
+        full = jax.random.randint(jax.random.PRNGKey(2), (1, 20), 0,
+                                  cfg.vocab_size, dtype=jnp.int32)
+        _, pre = decode.prefill(params, full[:, :16], cfg, max_len=16)
+        logits, cache = decode.prefill_extend(
+            params, full[:, 16:], cfg, 40,
+            pre.k[:, :, :16], pre.v[:, :, :16])
+        seq = full
+        for _ in range(3):
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+            ref = llama.forward(params, seq, cfg)
+            logits, cache = decode.decode_step(params, nxt, cache, cfg)
+            np.testing.assert_allclose(np.asarray(logits),
+                                       np.asarray(ref[:, -1]),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_ragged_suffix_lengths(self, model):
+        cfg, params = model
+        full = jax.random.randint(jax.random.PRNGKey(3), (2, 22), 0,
+                                  cfg.vocab_size, dtype=jnp.int32)
+        p = 16
+        _, pre = decode.prefill(params, full[:, :p], cfg, max_len=p)
+        # Row 0 uses 6 suffix tokens, row 1 only 3 (rest is pad).
+        suffix = full[:, p:]
+        lengths = jnp.asarray([6, 3], jnp.int32)
+        got, cache = decode.prefill_extend(
+            params, suffix, cfg, 48, pre.k[:, :, :p], pre.v[:, :, :p],
+            lengths=lengths)
+        want1, _ = decode.prefill(params, full[:1, :p + 6], cfg, 48)
+        want2, _ = decode.prefill(params, full[1:, :p + 3], cfg, 48)
+        np.testing.assert_allclose(np.asarray(got[0]),
+                                   np.asarray(want1[0]), rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_allclose(np.asarray(got[1]),
+                                   np.asarray(want2[0]), rtol=1e-5,
+                                   atol=1e-6)
+        np.testing.assert_array_equal(np.asarray(cache.length), [22, 19])
+
+    def test_budget_overflow_refused(self, model):
+        cfg, params = model
+        pre_k = jnp.zeros((cfg.n_layers, 1, 16, cfg.n_kv_heads, cfg.hd))
+        with pytest.raises(ValueError, match='exceeds'):
+            decode.prefill_extend(params, jnp.zeros((1, 16), jnp.int32),
+                                  cfg, 24, pre_k, pre_k)
+
+
+def _run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def _with_client(engine, fn):
+    from aiohttp.test_utils import TestClient
+    from aiohttp.test_utils import TestServer as AioTestServer
+
+    async def inner():
+        app = engine_lib.build_app(engine)
+        async with TestClient(AioTestServer(app)) as client:
+            loop_task = asyncio.get_running_loop().create_task(
+                engine.batch_loop())
+            try:
+                return await fn(client)
+            finally:
+                loop_task.cancel()
+    return _run(inner())
+
+
+class TestEnginePrefixCache:
+
+    @pytest.fixture(scope='class')
+    def engine(self):
+        eng = engine_lib.InferenceEngine('llama-debug', max_len=256)
+        eng.cfg = dataclasses.replace(eng.cfg, dtype=jnp.float32)
+        eng.warmup()
+        return eng
+
+    def test_shared_prefix_hits_and_matches_cold_result(self, engine):
+        """Request B shares A's 64-token prefix: B must be served via
+        the prefix path (hit counter moves) and return EXACTLY what a
+        cold engine returns for the same prompt."""
+        prefix = [(i % 250) + 1 for i in range(70)]
+        prompt_a = prefix + [5, 6, 7]
+        prompt_b = prefix + [9, 8]
+
+        async def fn(client):
+            ra = await client.post('/generate', json={
+                'tokens': prompt_a, 'max_new_tokens': 4})
+            a = (await ra.json())['tokens']
+            hits0 = engine.prefix_hits
+            rb = await client.post('/generate', json={
+                'tokens': prompt_b, 'max_new_tokens': 4})
+            b = (await rb.json())['tokens']
+            return a, b, engine.prefix_hits - hits0
+
+        a, b, hits = _with_client(engine, fn)
+        assert hits == 1, 'second request must ride the prefix cache'
+        cold = np.asarray(decode.generate(
+            engine.params, jnp.asarray([prompt_b], jnp.int32),
+            engine.cfg, 4, max_len=engine.max_len)[0][:4])
+        np.testing.assert_array_equal(np.asarray(b), cold)
+        cold_a = np.asarray(decode.generate(
+            engine.params, jnp.asarray([prompt_a], jnp.int32),
+            engine.cfg, 4, max_len=engine.max_len)[0][:4])
+        np.testing.assert_array_equal(np.asarray(a), cold_a)
+
+    def test_growing_history_extends_its_snapshot(self):
+        """Chat pattern: each turn's prompt starts with the previous
+        turn's whole prompt. The hit path must RE-capture the longer
+        prefix, so turn N+1 matches a prefix that grows with the
+        conversation instead of being pinned at the oldest 64."""
+        eng = engine_lib.InferenceEngine('llama-debug', max_len=1024)
+        eng.warmup()
+        turn1 = [(i % 250) + 1 for i in range(100)]
+        turn2 = turn1 + [(i % 250) + 1 for i in range(100, 300)]
+        turn3 = turn2 + [3, 1, 4]
+
+        async def fn(client):
+            for toks in (turn1, turn2, turn3):
+                r = await client.post('/generate', json={
+                    'tokens': toks, 'max_new_tokens': 2})
+                assert r.status == 200
+                await r.json()
+            return eng._prefix_match(turn3)
+
+        match = _with_client(eng, fn)
+        # turn2 (303 tokens) was admitted via turn1's 64-prefix AND
+        # re-captured at 256 — turn3 must match 256, not 64.
+        assert match == 256, match
+
+    def test_short_prompts_never_snapshot(self, engine):
+        async def fn(client):
+            n0 = len(engine._prefix_store)
+            r = await client.post('/generate', json={
+                'tokens': [1, 2, 3], 'max_new_tokens': 2})
+            await r.json()
+            return n0, len(engine._prefix_store)
+
+        n0, n1 = _with_client(engine, fn)
+        assert n1 == n0    # < PREFIX_MIN_TOKENS → no snapshot
+
+    def test_lru_eviction_bounded(self, engine):
+        async def fn(client):
+            for base in range(engine_lib.PREFIX_CACHE_ENTRIES + 3):
+                toks = [(base * 7 + i) % 250 + 1 for i in range(70)]
+                r = await client.post('/generate', json={
+                    'tokens': toks, 'max_new_tokens': 2})
+                await r.json()
+            return len(engine._prefix_store)
+
+        n = _with_client(engine, fn)
+        assert n <= engine_lib.PREFIX_CACHE_ENTRIES
