@@ -45,7 +45,10 @@ def _job_task(name, run):
     return task
 
 
-def _wait_workers_ready(pool, n, timeout=90):
+# 240s: sized for a saturated 1-core CI box running the full suite with
+# concurrent XLA compiles (the preemption-recovery path chains detect +
+# replace + re-exec waits) — same margin discipline as test_serve.py.
+def _wait_workers_ready(pool, n, timeout=240):
     deadline = time.time() + timeout
     while time.time() < deadline:
         reps = serve_state.get_replicas(pool)
@@ -55,7 +58,7 @@ def _wait_workers_ready(pool, n, timeout=90):
     raise TimeoutError(f'pool {pool}: {serve_state.get_replicas(pool)}')
 
 
-def _wait_job(job_id, statuses, timeout=90):
+def _wait_job(job_id, statuses, timeout=240):
     deadline = time.time() + timeout
     seen = None
     while time.time() < deadline:
