@@ -359,41 +359,55 @@ def decode_step(params, token: jnp.ndarray, cache: KVCache,
     but writes land on the row's frozen `length` slot, which the next
     admission overwrites, and the caller discards their logits.
 
-    The cache rides the scan CARRY (updated with per-layer
-    dynamic_update_slice), not the xs→ys stream: stacking per-layer ys
-    would rewrite the entire [L,B,T,KH,hd] cache every token — at 1B scale
-    that's ~2x the weight-read traffic, and decode is HBM-bound. Carry
-    threading is linear, so XLA keeps the updates in place.
+    Implemented as the K=1 case of `verify_step` (the K-wide step below)
+    plus the length advance — ONE copy of the per-layer cache-scatter /
+    attention body serves single-step decode, speculative verification,
+    and anything else that needs multi-token steps.
     """
     del rules
-    b = token.shape[0]
-    length = cache.length                                   # [B]
+    logits, cache = verify_step(params, token[:, None], cache, cfg)
+    advance = 1 if active is None else active.astype(jnp.int32)
+    return logits[:, 0], KVCache(k=cache.k, v=cache.v,
+                                 length=cache.length + advance)
+
+def verify_step(params, tokens: jnp.ndarray, cache: KVCache,
+                cfg: llama.LlamaConfig
+                ) -> Tuple[jnp.ndarray, KVCache]:
+    """Process K tokens per row at each row's own offset in ONE call —
+    the target-model half of speculative decoding (and a K-token
+    decode_step in general).
+
+    tokens [B, K] → logits [B, K, vocab]; K/V for all K positions are
+    written at rows' [length, length+K) slots, but `length` is NOT
+    advanced — the caller commits however many tokens verification
+    accepts (stale K/V beyond the committed length is causally masked
+    and overwritten later, so rollback is free — the same property
+    ragged decode already relies on).
+    """
+    b, kk = tokens.shape
+    length = cache.length
     rows = jnp.arange(b)
-    x = jnp.take(params['embed'], token[:, None], axis=0).astype(cfg.dtype)
+    x = jnp.take(params['embed'], tokens, axis=0).astype(cfg.dtype)
     if cfg.embed_scale:
         x = x * jnp.asarray(cfg.dim ** 0.5, cfg.dtype)
-    # Per-row rope position: each row's new token sits at ITS length.
-    sin, cos = llama.rope_tables(cfg, length[:, None])
+    positions = length[:, None] + jnp.arange(kk)          # [B, K]
+    sin, cos = llama.rope_tables(cfg, positions)
 
     def body(carry, xs):
         x_c, k_cache, v_cache = carry
         lp, layer_idx = xs
         sin_l, cos_l = llama.select_rope(sin, cos, layer_idx, cfg)
         q, k_new, v_new = _qkv(x_c, lp, cfg, sin_l, cos_l)
-        # Insert each row's new K/V at (layer_idx, b, length[b]) — a
-        # scatter over the row axis (ragged rows write different slots).
         k_l = jax.lax.dynamic_index_in_dim(k_cache, layer_idx, axis=0,
                                            keepdims=False)
         v_l = jax.lax.dynamic_index_in_dim(v_cache, layer_idx, axis=0,
                                            keepdims=False)
-        k_l = k_l.at[rows, length].set(k_new[:, 0])
-        v_l = v_l.at[rows, length].set(v_new[:, 0])
+        k_l = k_l.at[rows[:, None], positions].set(k_new)
+        v_l = v_l.at[rows[:, None], positions].set(v_new)
         k_cache = jax.lax.dynamic_update_index_in_dim(k_cache, k_l,
                                                       layer_idx, axis=0)
         v_cache = jax.lax.dynamic_update_index_in_dim(v_cache, v_l,
                                                       layer_idx, axis=0)
-        # Per-row q_offset masks kv positions > length[b]: pad garbage
-        # beyond each row's valid prefix never contributes.
         w_active = (llama.window_active(layer_idx, cfg)
                     if cfg.sliding_window else None)
         out = _attention(q, k_l, v_l, impl='xla', causal=True,
@@ -402,7 +416,7 @@ def decode_step(params, token: jnp.ndarray, cache: KVCache,
                          window=cfg.sliding_window, window_active=w_active,
                          sinks=(lp['sink'].astype(jnp.float32)
                                 if cfg.attn_sinks else None))
-        out = out.reshape(b, 1, cfg.n_heads * cfg.hd)
+        out = out.reshape(b, kk, cfg.n_heads * cfg.hd)
         x_c = x_c + _wo_project(out, lp, cfg)
         x_c = x_c + _ffn(x_c, lp, cfg)
         return (x_c, k_cache, v_cache), None
@@ -411,9 +425,155 @@ def decode_step(params, token: jnp.ndarray, cache: KVCache,
     (x, ks, vs), _ = jax.lax.scan(
         body, (x, cache.k, cache.v), (params['layers'], layer_ids))
     logits = _unembed(x, params, cfg)
-    advance = 1 if active is None else active.astype(jnp.int32)
-    new_cache = KVCache(k=ks, v=vs, length=length + advance)
-    return logits[:, 0], new_cache
+    return logits, KVCache(k=ks, v=vs, length=length)
+
+
+# Persistent compile caches for the speculative loop (cfg static:
+# model configs are frozen/hashable dataclasses).
+_verify_step_jit = jax.jit(verify_step, static_argnames=('cfg',))
+_decode_step_jit = jax.jit(decode_step, static_argnames=('cfg',))
+
+
+def generate_speculative(params, cfg: llama.LlamaConfig,
+                         draft_params, draft_cfg: llama.LlamaConfig,
+                         prompt: jnp.ndarray, max_new_tokens: int, *,
+                         k: int = 4, max_len: Optional[int] = None,
+                         eos_id: Optional[int] = None,
+                         prompt_lengths: Optional[jnp.ndarray] = None,
+                         return_stats: bool = False):
+    """Greedy speculative decoding: a cheap draft proposes k tokens,
+    the target verifies them in ONE K-wide call (verify_step), and the
+    longest agreeing prefix commits — plus the target's own next token,
+    so every round commits ≥ 1 token and the OUTPUT IS EXACTLY the
+    target model's greedy generation regardless of the draft (the
+    speculative-decoding guarantee; pin-tested against generate()).
+
+    Reference analog: vLLM/JetStream speculative decoding on TPU
+    serving. TPU-first: all shapes static (rounds are k draft steps +
+    one K-wide verify; per-row acceptance just moves the cache
+    `length`, rollback costs nothing); batch rows progress at their own
+    rates under per-row offsets.
+
+    Requires vocab-compatible models (draft.vocab_size >=
+    target.vocab_size) and greedy (temperature-0) semantics.
+    """
+    b, s = prompt.shape
+    if draft_cfg.vocab_size < cfg.vocab_size:
+        raise ValueError(
+            f'draft vocab {draft_cfg.vocab_size} < target vocab '
+            f'{cfg.vocab_size}: draft proposals could be unscorable')
+    if max_len is None:
+        max_len = min(cfg.max_seq_len, s + max_new_tokens + 2 * k)
+    # The verify lookahead needs up to 2k slots past s + max_new (k of
+    # in-flight writes + up to k of final-round overshoot). Near the
+    # context limit, shrink k — and when even k=1 doesn't fit, fall
+    # back to plain generate (identical output contract, just slower).
+    budget = max_len - s - max_new_tokens
+    if budget < 2 * k:
+        k = budget // 2
+        if k < 1:
+            out = generate(params, prompt, cfg, max_new_tokens,
+                           max_len=max_len, eos_id=eos_id,
+                           prompt_lengths=prompt_lengths)
+            if return_stats:
+                return out, {'rounds': max_new_tokens, 'fallback': True}
+            return out
+    import numpy as np
+    if max_new_tokens <= 0:
+        out = jnp.zeros((b, 0), jnp.int32)
+        return (out, {'rounds': 0}) if return_stats else out
+
+    t_logits, t_cache = prefill(params, prompt, cfg, max_len,
+                                lengths=prompt_lengths)
+    d_logits, d_cache = prefill(draft_params, prompt, draft_cfg, max_len,
+                                lengths=prompt_lengths)
+    del d_logits
+    last = jnp.argmax(t_logits, axis=-1).astype(jnp.int32)   # [B]
+
+    # Module-level jits with the config static: the compile caches
+    # persist across calls (the per-call jit(partial(...)) alternative
+    # would retrace every invocation).
+    verify_t = functools.partial(_verify_step_jit, cfg=cfg)
+    step_d = functools.partial(_decode_step_jit, cfg=draft_cfg)
+    out = np.zeros((b, max_new_tokens), np.int32)
+    count = np.ones((b,), np.int64)     # committed tokens per row
+    done = np.zeros((b,), bool)
+    last_h = np.asarray(jax.device_get(last))
+    out[:, 0] = last_h
+    if eos_id is not None:
+        done |= (last_h == eos_id)
+        count[done] = max_new_tokens
+        for r in np.flatnonzero(done):
+            out[r, :] = eos_id
+
+    # Invariant at the top of each round: both caches hold KV for every
+    # committed token EXCEPT `last` (the newest), and both `length`s
+    # advance by exactly the number of tokens a round commits.
+    rounds = 0
+    while count.min() < max_new_tokens:
+        rounds += 1
+        t_len0 = t_cache.length
+        d_len0 = d_cache.length
+        # 1) Draft proposes d1..dk following `last` (writing its own KV
+        # for [last, d1..d_{k-1}] as a side effect).
+        proposals = []
+        d_tok = last
+        for _ in range(k):
+            dl, d_cache = step_d(draft_params, d_tok, d_cache)
+            d_tok = jnp.argmax(
+                dl[:, :cfg.vocab_size], axis=-1).astype(jnp.int32)
+            proposals.append(d_tok)
+        drafted = jnp.stack(proposals, axis=1)               # [B, k]
+
+        # 2) Target scores fed = [last, d1..d_{k-1}] in ONE K-wide call;
+        # greedy[:, i] is the target's token following fed[:, :i+1], so
+        # d_j is accepted iff d_j == greedy[:, j-1] for every j' <= j.
+        fed = jnp.concatenate([last[:, None], drafted[:, :-1]], axis=1)
+        v_logits, t_cache = verify_t(params, fed, t_cache)
+        greedy = np.asarray(jax.device_get(
+            jnp.argmax(v_logits, axis=-1)))                  # [B, k]
+        drafted_h = np.asarray(jax.device_get(drafted))
+
+        # 3) Per-row commit: the agreed run d1..da, plus the target's
+        # correction greedy[a] when a < k (so every round commits >= 1).
+        # When a == k the new `last` is d_k (scored equal to greedy[k-1]
+        # but its KV is not written yet — exactly the invariant).
+        n_commit = np.zeros((b,), np.int32)
+        new_last = last_h.copy()
+        for r in range(b):
+            if done[r] or count[r] >= max_new_tokens:
+                continue
+            a = 0
+            while a < k and drafted_h[r, a] == greedy[r, a]:
+                a += 1
+            if a < k:
+                row = list(drafted_h[r, :a]) + [int(greedy[r, a])]
+            else:
+                row = list(drafted_h[r, :k])
+            n_commit[r] = len(row)
+            new_last[r] = row[-1]
+            space = max_new_tokens - int(count[r])
+            take = row[:space]
+            out[r, count[r]:count[r] + len(take)] = take
+            count[r] = min(count[r] + len(row), max_new_tokens)
+            if eos_id is not None and eos_id in take:
+                p = int(count[r]) - len(take) + take.index(eos_id)
+                out[r, p:] = eos_id
+                count[r] = max_new_tokens
+                done[r] = True
+        last_h = new_last
+        last = jnp.asarray(last_h)
+
+        # 4) Both cache lengths advance by the committed count (rows
+        # that committed nothing roll the draft's k-step advance back).
+        adv = jnp.asarray(n_commit, jnp.int32)
+        t_cache = KVCache(k=t_cache.k, v=t_cache.v,
+                          length=t_len0 + adv)
+        d_cache = KVCache(k=d_cache.k, v=d_cache.v,
+                          length=d_len0 + adv)
+    if return_stats:
+        return jnp.asarray(out), {'rounds': rounds}
+    return jnp.asarray(out)
 
 
 def _select_token(logits: jnp.ndarray, temperature: float,

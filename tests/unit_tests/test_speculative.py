@@ -1,0 +1,155 @@
+"""Speculative decoding (decode.verify_step + generate_speculative).
+
+The load-bearing property: the output EXACTLY equals the target
+model's plain greedy generation for ANY draft — a good draft only
+changes how many verify rounds it takes. Reference analog: vLLM /
+JetStream speculative decoding on TPU serving.
+"""
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu import models as models_lib
+from skypilot_tpu.models import decode, llama
+
+
+@pytest.fixture(scope='module')
+def target():
+    cfg = models_lib.get_config('llama-debug')
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    return cfg, params
+
+
+@pytest.fixture(scope='module')
+def weak_draft():
+    """A different (random) model — near-zero agreement with the
+    target, the worst case for speculation."""
+    cfg = models_lib.get_config('llama-debug')
+    cfg = dataclasses.replace(cfg, dtype=jnp.float32, n_layers=1)
+    params = llama.init_params(jax.random.PRNGKey(7), cfg)
+    return cfg, params
+
+
+class TestVerifyStep:
+
+    def test_k_wide_step_matches_k_single_steps(self, target):
+        """verify_step's logits must equal running decode_step K times
+        (same tokens, same cache evolution)."""
+        cfg, params = target
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 6), 0,
+                                    cfg.vocab_size, dtype=jnp.int32)
+        toks = jax.random.randint(jax.random.PRNGKey(2), (2, 3), 0,
+                                  cfg.vocab_size, dtype=jnp.int32)
+        _, cache_a = decode.prefill(params, prompt, cfg, max_len=32)
+        _, cache_b = decode.prefill(params, prompt, cfg, max_len=32)
+
+        wide, cache_a = decode.verify_step(params, toks, cache_a, cfg)
+        singles = []
+        for i in range(3):
+            lg, cache_b = decode.decode_step(params, toks[:, i],
+                                             cache_b, cfg)
+            singles.append(lg)
+        for i in range(3):
+            np.testing.assert_allclose(np.asarray(wide[:, i]),
+                                       np.asarray(singles[i]),
+                                       rtol=2e-4, atol=2e-4)
+        # verify_step does NOT advance length (caller commits).
+        np.testing.assert_array_equal(np.asarray(cache_a.length), 6)
+
+
+class TestSpeculative:
+
+    def _reference(self, cfg, params, prompt, n):
+        return np.asarray(decode.generate(params, prompt, cfg, n,
+                                          max_len=64))
+
+    def test_self_draft_exact_and_fewer_rounds(self, target):
+        """Draft == target: 100% acceptance — exact output, and the
+        verify count collapses to ~ceil(n/k) instead of n."""
+        cfg, params = target
+        prompt = jax.random.randint(jax.random.PRNGKey(3), (2, 8), 0,
+                                    cfg.vocab_size, dtype=jnp.int32)
+        want = self._reference(cfg, params, prompt, 12)
+        got, stats = decode.generate_speculative(
+            params, cfg, params, cfg, prompt, 12, k=4, max_len=64,
+            return_stats=True)
+        np.testing.assert_array_equal(np.asarray(got), want)
+        # 12 tokens at k=4 with 100% acceptance: ceil(11/4) = 3 rounds
+        # (the first token comes from prefill), vs 11 single steps.
+        assert stats['rounds'] <= 4, stats
+
+    def test_weak_draft_still_exact(self, target, weak_draft):
+        """The guarantee: ANY draft yields the target's exact greedy
+        output — a bad draft only costs rounds."""
+        cfg, params = target
+        d_cfg, d_params = weak_draft
+        for seed in (4, 5):
+            prompt = jax.random.randint(jax.random.PRNGKey(seed),
+                                        (3, 7), 0, cfg.vocab_size,
+                                        dtype=jnp.int32)
+            want = self._reference(cfg, params, prompt, 10)
+            got = decode.generate_speculative(
+                params, cfg, d_params, d_cfg, prompt, 10,
+                k=3, max_len=64)
+            np.testing.assert_array_equal(np.asarray(got), want)
+
+    def test_eos_fill_matches_generate(self, target):
+        cfg, params = target
+        prompt = jax.random.randint(jax.random.PRNGKey(6), (2, 6), 0,
+                                    cfg.vocab_size, dtype=jnp.int32)
+        plain = np.asarray(decode.generate(params, prompt, cfg, 10,
+                                           max_len=64))
+        # Use a token the target actually emits as the 'EOS' so the
+        # fill path really triggers.
+        eos = int(plain[0, 3])
+        want = np.asarray(decode.generate(params, prompt, cfg, 10,
+                                          max_len=64, eos_id=eos))
+        got = decode.generate_speculative(
+            params, cfg, params, cfg, prompt, 10, k=4, max_len=64,
+            eos_id=eos)
+        np.testing.assert_array_equal(np.asarray(got), want)
+
+    def test_near_limit_shrinks_k_then_falls_back(self, target):
+        """Requests plain generate() can serve must never fail under
+        speculation: the lookahead k shrinks to fit, and at the exact
+        limit the call falls back to plain decode — output identical
+        either way."""
+        cfg, params = target
+        prompt = jax.random.randint(jax.random.PRNGKey(8), (1, 8), 0,
+                                    cfg.vocab_size, dtype=jnp.int32)
+        want = np.asarray(decode.generate(params, prompt, cfg, 10,
+                                          max_len=64))[:, :10]
+        # budget = 20-18=2 → k shrinks 4→1 (still speculative).
+        got, stats = decode.generate_speculative(
+            params, cfg, params, cfg, prompt, 10, k=4, max_len=20,
+            return_stats=True)
+        assert not stats.get('fallback')
+        np.testing.assert_array_equal(np.asarray(got), want)
+        # budget 0 → plain-generate fallback, same tokens.
+        got2, stats2 = decode.generate_speculative(
+            params, cfg, params, cfg, prompt, 10, k=4, max_len=18,
+            return_stats=True)
+        assert stats2.get('fallback')
+        np.testing.assert_array_equal(np.asarray(got2), want)
+
+    def test_zero_max_new_tokens(self, target):
+        cfg, params = target
+        out = decode.generate_speculative(
+            params, cfg, params, cfg, jnp.zeros((2, 8), jnp.int32), 0,
+            k=4, max_len=64)
+        assert out.shape == (2, 0)
+
+    def test_guards(self, target, weak_draft):
+        cfg, params = target
+        d_cfg, d_params = weak_draft
+        prompt = jnp.zeros((1, 8), jnp.int32)
+        small_vocab = dataclasses.replace(d_cfg, vocab_size=64)
+        with pytest.raises(ValueError, match='vocab'):
+            decode.generate_speculative(params, cfg, d_params,
+                                        small_vocab, prompt, 4,
+                                        max_len=64)
