@@ -48,6 +48,7 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.utils import timeline
 
 logger = sky_logging.init_logger(__name__)
 
@@ -571,6 +572,7 @@ class InferenceEngine:
         while len(self._prefix_store) > PREFIX_CACHE_ENTRIES:
             self._prefix_store.popitem(last=False)
 
+    @timeline.event
     def _admit_with_prefix(self, item, p: int) -> int:
         """Admit one request over a stored prefix; returns the slot."""
         jnp = self._jnp
@@ -617,6 +619,7 @@ class InferenceEngine:
                 entry['finish'] = 'length'
         self.slots[slot] = entry
 
+    @timeline.event
     def _admit_group(self, items) -> None:
         """Prefill same-bucket requests in ONE device call (device
         work: call off-loop). Callers group by bucket and split counts
@@ -676,6 +679,7 @@ class InferenceEngine:
                 return i
         return None
 
+    @timeline.event
     def _step_once(self) -> None:
         """Decode step(s) over the whole slot pool (device work).
 
