@@ -422,10 +422,27 @@ async def _drive_serve_load(port, concurrency, n_requests, prompt_len,
         n_ok = 0
         sem = asyncio.Semaphore(concurrency)
 
+        # SKYTPU_BENCH_SERVE_SHARED_PREFIX=N: the chat pattern — every
+        # request shares an N-token prefix (system prompt / history), so
+        # the engine's prefix KV cache turns repeat prefills into
+        # suffix-only work. TTFT p50 with vs without this knob is the
+        # prefix-cache win, measured through the real HTTP path.
+        shared = int(os.environ.get('SKYTPU_BENCH_SERVE_SHARED_PREFIX',
+                                    '0'))
+        if shared >= prompt_len:
+            raise SystemExit(
+                f'[bench] SHARED_PREFIX ({shared}) must be < prompt '
+                f'length ({prompt_len}) — an all-shared prompt is a '
+                f'degenerate workload (no distinct suffix to prefill) '
+                f'and can overflow the engine max_len.')
+        shared_prefix = [(j * 3) % 250 + 1 for j in range(shared)]
+
         async def one(i):
             nonlocal n_ok
             # Distinct prompts; token-id prompts skip tokenization noise.
-            prompt = [(i * 7 + j) % 250 + 1 for j in range(prompt_len)]
+            prompt = shared_prefix + [
+                (i * 7 + j) % 250 + 1
+                for j in range(prompt_len - len(shared_prefix))]
             async with sem:
                 t0 = time.perf_counter()
                 first_t = last_t = None
