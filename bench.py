@@ -427,8 +427,13 @@ async def _drive_serve_load(port, concurrency, n_requests, prompt_len,
         # the engine's prefix KV cache turns repeat prefills into
         # suffix-only work. TTFT p50 with vs without this knob is the
         # prefix-cache win, measured through the real HTTP path.
-        shared = int(os.environ.get('SKYTPU_BENCH_SERVE_SHARED_PREFIX',
-                                    '0'))
+        try:
+            shared = int(os.environ.get(
+                'SKYTPU_BENCH_SERVE_SHARED_PREFIX', '0'))
+        except ValueError:
+            raise SystemExit('[bench] SKYTPU_BENCH_SERVE_SHARED_PREFIX '
+                             'must be an integer')
+        shared = max(shared, 0)
         if shared >= prompt_len:
             raise SystemExit(
                 f'[bench] SHARED_PREFIX ({shared}) must be < prompt '

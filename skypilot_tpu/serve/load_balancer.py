@@ -31,6 +31,46 @@ _HOP_HEADERS = {'connection', 'keep-alive', 'transfer-encoding', 'upgrade',
                 'trailers', 'host', 'content-length'}
 
 
+# Affinity keys truncate to a SHORT FIXED head: two prompts sharing at
+# least this much prefix must produce IDENTICAL keys, or the chat
+# pattern (a history that grows every turn) would never co-locate —
+# turn 1's 100-token prompt and turn 2's 300-token prompt both key on
+# their first 64 units. Matches the engine's PREFIX_MIN_TOKENS.
+_AFFINITY_HEAD = 64
+
+
+def _affinity_key(request: web.Request, body: bytes) -> Optional[str]:
+    """Routing hint for affinity-aware policies: the fixed-length head
+    of the request's prompt (str prompt / token ids / first chat
+    message), so requests sharing a prefix — the chat pattern — land on
+    the replica whose prefix KV cache already holds it. None for
+    anything that isn't a generation POST (policies then fall back to
+    load)."""
+    if request.method != 'POST' or not body:
+        return None
+    try:
+        import json
+        payload = json.loads(body)
+    except (ValueError, UnicodeDecodeError):
+        return None
+    if not isinstance(payload, dict):
+        return None
+    prompt = payload.get('prompt')
+    if isinstance(prompt, str):
+        return prompt[:_AFFINITY_HEAD]
+    tokens = payload.get('tokens') or (
+        prompt if isinstance(prompt, list) else None)
+    if isinstance(tokens, list):
+        return ','.join(str(t) for t in tokens[:_AFFINITY_HEAD])
+    messages = payload.get('messages')
+    if (isinstance(messages, list) and messages and
+            isinstance(messages[0], dict)):
+        first = messages[0]
+        return (f"{first.get('role', '')}:"
+                f"{str(first.get('content', ''))[:_AFFINITY_HEAD]}")
+    return None
+
+
 class LoadBalancer:
 
     def __init__(self, policy_name: str,
@@ -47,7 +87,16 @@ class LoadBalancer:
     async def _proxy(self, request: web.Request) -> web.StreamResponse:
         if self.autoscaler is not None:
             self.autoscaler.record_request()
-        target = self.policy.select()
+        if not self.policy.has_replicas():
+            # Reject BEFORE buffering the body: a scaled-to-zero service
+            # must not hold dead multi-MB uploads in RAM.
+            return web.json_response(
+                {'error': 'no ready replicas'}, status=503)
+        body = await request.read()
+        # Key extraction (a JSON parse) only when the policy uses it.
+        key = (_affinity_key(request, body)
+               if self.policy.wants_affinity_key else None)
+        target = self.policy.select(key)
         if target is None:
             return web.json_response(
                 {'error': 'no ready replicas'}, status=503)
@@ -57,7 +106,6 @@ class LoadBalancer:
         url = target.rstrip('/') + request.rel_url.path_qs
         headers = {k: v for k, v in request.headers.items()
                    if k.lower() not in _HOP_HEADERS}
-        body = await request.read()
         self.policy.request_started(target)
         try:
             async with self._session.request(request.method, url,

@@ -5,6 +5,7 @@ Reference analog: sky/serve/load_balancing_policies.py
 """
 from __future__ import annotations
 
+import hashlib
 import itertools
 import threading
 from typing import Dict, List, Optional
@@ -14,6 +15,14 @@ from skypilot_tpu.utils import registry
 
 class LoadBalancingPolicy:
     """Tracks the ready-replica set and picks a target per request."""
+
+    # The LB computes the (JSON-parse-cost) affinity hint only for
+    # policies that set this.
+    wants_affinity_key = False
+
+    def has_replicas(self) -> bool:
+        with self._lock:
+            return bool(self._replicas)
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
@@ -33,7 +42,10 @@ class LoadBalancingPolicy:
         normalize load by them."""
         del weights
 
-    def select(self) -> Optional[str]:
+    def select(self, affinity_key: Optional[str] = None) -> Optional[str]:
+        """Pick a replica. `affinity_key` (e.g. the prompt head) is a
+        ROUTING HINT — only affinity-aware policies use it; the rest
+        ignore it."""
         raise NotImplementedError
 
     def request_started(self, url: str) -> None:
@@ -53,7 +65,8 @@ class RoundRobinPolicy(LoadBalancingPolicy):
         super().__init__()
         self._counter = itertools.count()
 
-    def select(self) -> Optional[str]:
+    def select(self, affinity_key: Optional[str] = None) -> Optional[str]:
+        del affinity_key
         with self._lock:
             if not self._replicas:
                 return None
@@ -65,7 +78,8 @@ class LeastLoadPolicy(LoadBalancingPolicy):
     """Route to the replica with the fewest in-flight requests (reference
     default — best for LLM serving where request cost varies wildly)."""
 
-    def select(self) -> Optional[str]:
+    def select(self, affinity_key: Optional[str] = None) -> Optional[str]:
+        del affinity_key
         with self._lock:
             if not self._replicas:
                 return None
@@ -93,7 +107,8 @@ class InstanceAwareLeastLoadPolicy(LeastLoadPolicy):
             self._weights = {u: max(float(w), 1e-9)
                              for u, w in weights.items()}
 
-    def select(self) -> Optional[str]:
+    def select(self, affinity_key: Optional[str] = None) -> Optional[str]:
+        del affinity_key
         with self._lock:
             if not self._replicas:
                 return None
@@ -101,3 +116,43 @@ class InstanceAwareLeastLoadPolicy(LeastLoadPolicy):
                 self._replicas,
                 key=lambda u: (self._in_flight.get(u, 0) /
                                self._weights.get(u, 1.0)))
+
+
+@registry.LB_POLICY_REGISTRY.register(name='prefix_affinity')
+class PrefixAffinityPolicy(LeastLoadPolicy):
+    """Rendezvous-hash requests sharing a prompt prefix onto the same
+    replica, so per-replica prefix KV caches (serve/engine.py) keep
+    hitting — the chat pattern (same system prompt / growing history)
+    stays warm on one replica instead of spraying across the fleet.
+
+    Net-new vs the reference (its LB policies are load-only); the
+    analog in big serving stacks is vLLM router session affinity.
+
+    Rendezvous (highest-random-weight) hashing keeps the mapping stable
+    under replica churn: removing a replica remaps ONLY the keys that
+    lived on it. A load guard falls back to least-load when the
+    affinity target is overloaded relative to the fleet (affinity must
+    never become a hot-spot amplifier).
+    """
+
+    # Fall back to least-load when the affinity target has this many
+    # more in-flight requests than the least-loaded replica.
+    HOTSPOT_SLACK = 4
+    wants_affinity_key = True
+
+    def select(self, affinity_key: Optional[str] = None) -> Optional[str]:
+        with self._lock:
+            if not self._replicas:
+                return None
+            coolest = min(self._replicas,
+                          key=lambda u: self._in_flight.get(u, 0))
+            if affinity_key is None:
+                return coolest
+            target = max(
+                self._replicas,
+                key=lambda u: hashlib.md5(
+                    f'{affinity_key}\x00{u}'.encode()).digest())
+            if (self._in_flight.get(target, 0) -
+                    self._in_flight.get(coolest, 0)) > self.HOTSPOT_SLACK:
+                return coolest
+            return target

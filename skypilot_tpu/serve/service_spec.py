@@ -15,7 +15,9 @@ service:
     upscale_delay_seconds: 300
     downscale_delay_seconds: 1200
   ports: 8000                         # port the replica app listens on
-  load_balancing_policy: least_load   # or round_robin
+  load_balancing_policy: least_load   # round_robin |
+                                      # instance_aware_least_load |
+                                      # prefix_affinity
 """
 from __future__ import annotations
 

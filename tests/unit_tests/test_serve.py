@@ -207,6 +207,85 @@ class TestLBPolicies:
         p = load_balancing_policies.LeastLoadPolicy()
         assert p.select() is None
 
+    def test_prefix_affinity_stable_and_churn_minimal(self):
+        """Same key → same replica across calls; rendezvous property:
+        removing an UNRELATED replica never remaps a key."""
+        p = load_balancing_policies.PrefixAffinityPolicy()
+        p.set_ready_replicas(['a', 'b', 'c', 'd'])
+        keys = [f'system-prompt-{i}' for i in range(20)]
+        first = {k: p.select(k) for k in keys}
+        assert {p.select(k) for k in keys for _ in range(3)} <= set(
+            first.values())
+        for k in keys:
+            assert p.select(k) == first[k]
+        # Keys spread over more than one replica.
+        assert len(set(first.values())) > 1
+        # Remove one replica: only ITS keys remap.
+        gone = first[keys[0]]
+        p.set_ready_replicas([u for u in 'abcd' if u != gone])
+        for k in keys:
+            if first[k] != gone:
+                assert p.select(k) == first[k], k
+
+    def test_prefix_affinity_hotspot_fallback_and_none_key(self):
+        p = load_balancing_policies.PrefixAffinityPolicy()
+        p.set_ready_replicas(['a', 'b'])
+        key = 'hot-system-prompt'
+        target = p.select(key)
+        other = 'b' if target == 'a' else 'a'
+        # Pile load onto the affinity target beyond the slack → falls
+        # back to the coolest replica instead of amplifying a hot spot.
+        for _ in range(p.HOTSPOT_SLACK + 1):
+            p.request_started(target)
+        assert p.select(key) == other
+        # No key → plain least-load.
+        assert p.select(None) == other
+
+    def test_affinity_key_extraction(self):
+        from skypilot_tpu.serve import load_balancer as lb_mod
+
+        class Req:
+            def __init__(self, method='POST'):
+                self.method = method
+
+        k = lb_mod._affinity_key(Req(), b'{"prompt": "sys prompt X"}')
+        assert k == 'sys prompt X'
+        k2 = lb_mod._affinity_key(Req(), b'{"tokens": [1, 2, 3]}')
+        assert k2 == '1,2,3'
+        k3 = lb_mod._affinity_key(
+            Req(), b'{"messages": [{"role": "system", "content": "S"}]}')
+        assert k3 == 'system:S'
+        assert lb_mod._affinity_key(Req('GET'), b'{}') is None
+        assert lb_mod._affinity_key(Req(), b'not json') is None
+        assert lb_mod._affinity_key(Req(), b'{"other": 1}') is None
+
+    def test_growing_history_keys_identical(self):
+        """The chat pattern MUST co-locate: turn N and turn N+1 share
+        the conversation head, so their affinity keys are identical
+        even though the prompts have different lengths (keys truncate
+        to a fixed head, not a per-request length)."""
+        import json
+
+        from skypilot_tpu.serve import load_balancer as lb_mod
+
+        class Req:
+            method = 'POST'
+
+        turn1 = list(range(100))
+        turn2 = turn1 + list(range(100, 300))
+        k1 = lb_mod._affinity_key(
+            Req(), json.dumps({'tokens': turn1}).encode())
+        k2 = lb_mod._affinity_key(
+            Req(), json.dumps({'tokens': turn2}).encode())
+        assert k1 == k2
+        s1 = lb_mod._affinity_key(
+            Req(), json.dumps({'prompt': 'sys ' * 40 + 'q1'}).encode())
+        s2 = lb_mod._affinity_key(
+            Req(), json.dumps(
+                {'prompt': 'sys ' * 40 + 'a much longer turn 2'}
+            ).encode())
+        assert s1 == s2
+
 
 # ---------------------------------------------------------------------------
 # Hermetic end-to-end on the local cloud
