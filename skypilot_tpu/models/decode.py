@@ -605,6 +605,18 @@ def _select_token(logits: jnp.ndarray, temperature: float,
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+def chosen_logprob(logits: jnp.ndarray, tokens: jnp.ndarray
+                   ) -> jnp.ndarray:
+    """log P(token) under the UNMODIFIED model distribution
+    (temperature/top-k/top-p shape sampling, not the reported
+    probability — OpenAI `logprobs` semantics). logits [B, V],
+    tokens [B] → [B] fp32."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, tokens[:, None], axis=-1)[:, 0]
+    return gold - logz
+
+
 def select_token_per_row(logits: jnp.ndarray, temperature: jnp.ndarray,
                          top_k: jnp.ndarray, top_p: jnp.ndarray,
                          rng: jax.Array) -> jnp.ndarray:

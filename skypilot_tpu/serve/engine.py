@@ -111,6 +111,46 @@ def _parse_sampling(body, default_temperature: float = 0.0):
     return temperature, top_k, top_p
 
 
+def _parse_logprobs(body) -> bool:
+    """OpenAI `logprobs`: the engine reports the CHOSEN token's logprob
+    under the unmodified model distribution (logprobs<=1); top-N
+    alternatives and streaming logprobs are not supported — rejected
+    loudly rather than silently dropped."""
+    lp = body.get('logprobs')
+    if lp is None or lp is False:
+        return False
+    if lp is True:
+        lp = 1
+    lp = int(lp)
+    if lp > 1:
+        raise ValueError('logprobs > 1 (top-N alternatives) is not '
+                         'supported; use logprobs=1 for chosen-token '
+                         'logprobs')
+    if body.get('stream'):
+        raise ValueError('logprobs with stream=true is not supported')
+    return True
+
+
+def _completion_logprobs(tokenizer, out, lps, text):
+    """OpenAI completions logprobs object, ALIGNED with the returned
+    text: parallel tokens / token_logprobs / text_offset arrays, trimmed
+    when a stop string truncated the text (entries for text that was
+    never returned would violate the parallel-array contract eval
+    harnesses rely on)."""
+    pieces, offsets, kept = [], [], []
+    pos = 0
+    for t, v in zip(out, lps):
+        if pos >= len(text):
+            break    # text fully covered (or cut to nothing)
+        piece = tokenizer.decode([t])
+        pieces.append(piece)
+        offsets.append(pos)
+        kept.append(round(v, 6))
+        pos += len(piece)
+    return {'tokens': pieces, 'token_logprobs': kept,
+            'top_logprobs': None, 'text_offset': offsets}
+
+
 def _parse_stop_ids(body, tokenizer) -> Tuple[int, ...]:
     """Stop-token ids for a /v1 request: the tokenizer's EOS set plus any
     client-supplied stop_token_ids. ignore_eos=true disables all
@@ -368,11 +408,12 @@ class InferenceEngine:
                     nxt = decode_lib.select_token_per_row(
                         logits, temp, topk, topp, sub)
                     nxt = jnp.where(active, nxt, last_t)
-                    return (nxt, cache_t, rng_t), nxt
-                (last_f, cache_f, rng_f), toks = jax.lax.scan(
+                    lp = decode_lib.chosen_logprob(logits, nxt)
+                    return (nxt, cache_t, rng_t), (nxt, lp)
+                (last_f, cache_f, rng_f), (toks, lps) = jax.lax.scan(
                     body, (last, cache, rng), None, length=k)
                 del last_f
-                return toks, cache_f, rng_f
+                return toks, lps, cache_f, rng_f
             return run
 
         self._step_k_jits = {}
@@ -404,7 +445,8 @@ class InferenceEngine:
             # prefill keeps the batch dim: logits [N, V].
             first = decode_lib.select_token_per_row(
                 logits, temps, topks, topps, sub)
-            return first, cache, rng
+            first_lp = decode_lib.chosen_logprob(logits, first)
+            return first, first_lp, cache, rng
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def admit_extend(params, cache, prefix_k, prefix_v, tokens,
@@ -425,8 +467,9 @@ class InferenceEngine:
             cache = jax.tree.map(write, cache, row)
             rng, sub = jax.random.split(rng)
             first = decode_lib.select_token_per_row(
-                logits, temp[None], topk[None], topp[None], sub)[0]
-            return first, cache, rng
+                logits, temp[None], topk[None], topp[None], sub)
+            first_lp = decode_lib.chosen_logprob(logits, first)
+            return first[0], first_lp[0], cache, rng
 
         self._step_jit = step
         self._admit_jit = admit
@@ -503,9 +546,10 @@ class InferenceEngine:
                       stream_q: Optional[asyncio.Queue] = None
                       ) -> asyncio.Future:
         """Enqueue a request; returns the future resolving to
-        (tokens, finish_reason). Raises EngineOverloaded when the bounded
-        admission queue is full (surfaced as 429) — the queue never grows
-        without limit under overload."""
+        (tokens, finish_reason, chosen_token_logprobs). Raises
+        EngineOverloaded when the bounded admission queue is full
+        (surfaced as 429) — the queue never grows without limit under
+        overload."""
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         try:
             self._queue.put_nowait((tokens, max_new, temperature, top_k,
@@ -589,13 +633,13 @@ class InferenceEngine:
         key = tuple(tokens[:p])
         pk, pv = self._prefix_store[key]
         self._prefix_store.move_to_end(key)
-        first, self.cache, self.rng = self._admit_extend_jit(
+        first, first_lp, self.cache, self.rng = self._admit_extend_jit(
             self.params, self.cache, pk, pv, padded,
             jnp.int32(len(suffix)), jnp.int32(slot),
             jnp.float32(self.temp[slot]), jnp.int32(self.topk[slot]),
             jnp.float32(self.topp[slot]), self.rng)
         self.prefix_hits += 1
-        self._finish_admit(item, slot, int(first))
+        self._finish_admit(item, slot, int(first), float(first_lp))
         # The slot now holds the FULL prompt's KV — snapshot the longer
         # prefix so a growing chat history keeps extending its cache
         # (turn N+1 hits turn N's whole prompt, not just the oldest
@@ -603,17 +647,19 @@ class InferenceEngine:
         self._prefix_capture(tokens, slot)
         return slot
 
-    def _finish_admit(self, item, slot: int, first: int) -> None:
+    def _finish_admit(self, item, slot: int, first: int,
+                      first_lp: float = 0.0) -> None:
         (_, max_new, _, _, _, stop_ids, stream_q, fut) = item
         self.last[slot] = first
         stop = frozenset(stop_ids or ())
-        entry = {'fut': fut, 'want': max_new, 'out': [],
+        entry = {'fut': fut, 'want': max_new, 'out': [], 'lps': [],
                  'stop': stop, 'stream': stream_q, 'sent': 0,
                  'finish': None}
         if first in stop:
             entry['finish'] = 'stop'
         else:
             entry['out'].append(first)
+            entry['lps'].append(first_lp)
             self.tokens_generated += 1
             if len(entry['out']) >= max_new:
                 entry['finish'] = 'length'
@@ -656,7 +702,7 @@ class InferenceEngine:
             temps.append(self.temp[slot])
             topks.append(self.topk[slot])
             topps.append(self.topp[slot])
-        first, self.cache, self.rng = self._admit_jit(
+        first, first_lp, self.cache, self.rng = self._admit_jit(
             self.params, self.cache, jnp.asarray(padded, jnp.int32),
             jnp.asarray(lengths, jnp.int32),
             jnp.asarray(slots, jnp.int32),
@@ -664,8 +710,10 @@ class InferenceEngine:
             jnp.asarray(topks, jnp.int32),
             jnp.asarray(topps, jnp.float32), self.rng)
         first = jax.device_get(first)
+        first_lp = jax.device_get(first_lp)
         for i, item in enumerate(items):
-            self._finish_admit(item, slots[i], int(first[i]))
+            self._finish_admit(item, slots[i], int(first[i]),
+                               float(first_lp[i]))
             if self.warm and self._decode_is_dense():
                 self._prefix_capture(item[0], slots[i])
 
@@ -700,11 +748,12 @@ class InferenceEngine:
                 (self._queue is None or self._queue.empty())):
             k = MAX_STEP_CHUNK
         active = jnp.asarray([s is not None for s in self.slots])
-        toks, self.cache, self.rng = self._step_jit(
+        toks, lps, self.cache, self.rng = self._step_jit(
             self.params, jnp.asarray(self.last), self.cache,
             jnp.asarray(self.temp), jnp.asarray(self.topk),
             jnp.asarray(self.topp), self.rng, active, k=k)
         toks = jax.device_get(toks)              # [k, B]
+        lps = jax.device_get(lps)                # [k, B]
         self.step_count += k
         for i, s in enumerate(self.slots):
             if s is None:
@@ -720,6 +769,7 @@ class InferenceEngine:
                     s['finish'] = 'stop'
                     break
                 s['out'].append(tok)
+                s['lps'].append(float(lps[t][i]))
                 self.tokens_generated += 1
                 if len(s['out']) >= s['want']:
                     s['finish'] = 'length'
@@ -741,7 +791,7 @@ class InferenceEngine:
                     q.put_nowait(None)           # end-of-stream sentinel
                 fut = s['fut']
                 if fut is not None and not fut.done():
-                    fut.set_result((s['out'], s['finish']))
+                    fut.set_result((s['out'], s['finish'], s['lps']))
                 self.slots[i] = None
 
     def _drain_admissible(self, already: int = 0) -> list:
@@ -906,8 +956,8 @@ async def _sse_response(request, engine: InferenceEngine,
             if delta:
                 for payload in make_chunks(delta, None):
                     await send(payload)
-        out, finish = await fut
-        del out
+        out, finish, lps = await fut
+        del out, lps
         tail = decoder.flush()
         for payload in make_chunks(tail if tail else None, finish):
             await send(payload)
@@ -989,12 +1039,13 @@ def build_app(engine: InferenceEngine):
             return web.json_response({'error': f'bad sampling params: {e}'},
                                      status=400)
         try:
-            out, finish = await engine.submit(tokens, max_new, temperature,
+            out, finish, lps = await engine.submit(tokens, max_new, temperature,
                                               top_k, top_p,
                                               stop_ids=stop_ids)
         except EngineOverloaded as e:
             return web.json_response({'error': str(e)}, status=429)
-        resp: Dict[str, Any] = {'tokens': out, 'finish_reason': finish}
+        resp: Dict[str, Any] = {'tokens': out, 'finish_reason': finish,
+                                'logprobs': lps}
         if 'text' in body:
             resp['text'] = engine.tokenizer.decode(out)
         return web.json_response(resp)
@@ -1026,6 +1077,7 @@ def build_app(engine: InferenceEngine):
                 raise ValueError('stop strings are not supported with '
                                  'stream=true; use stop_token_ids')
             _truncate_at_stop_strings('', stop_strings)   # validate shape
+            want_logprobs = _parse_logprobs(body)
         except (TypeError, ValueError) as e:
             return bad(f'invalid request: {e}')
         msg = _check_len(engine, tokens, max_new)
@@ -1053,7 +1105,7 @@ def build_app(engine: InferenceEngine):
                                        web)
 
         try:
-            out, finish = await engine.submit(tokens, max_new, *sampling,
+            out, finish, lps = await engine.submit(tokens, max_new, *sampling,
                                               stop_ids=stop_ids)
         except EngineOverloaded as e:
             return _openai_error(web, str(e), status=429,
@@ -1062,12 +1114,16 @@ def build_app(engine: InferenceEngine):
         text, cut = _truncate_at_stop_strings(text, stop_strings)
         if cut:
             finish = 'stop'
+        lp_obj = None
+        if want_logprobs:
+            lp_obj = _completion_logprobs(engine.tokenizer, out, lps,
+                                          text)
         return web.json_response({
             'id': rid,
             'object': 'text_completion',
             'created': created,
             'model': model,
-            'choices': [{'text': text, 'index': 0, 'logprobs': None,
+            'choices': [{'text': text, 'index': 0, 'logprobs': lp_obj,
                          'finish_reason': finish}],
             'usage': {'prompt_tokens': len(tokens),
                       'completion_tokens': len(out),
@@ -1109,6 +1165,11 @@ def build_app(engine: InferenceEngine):
                 raise ValueError('stop strings are not supported with '
                                  'stream=true; use stop_token_ids')
             _truncate_at_stop_strings('', stop_strings)
+            if int(body.get('top_logprobs') or 0) > 0:
+                raise ValueError('top_logprobs is not supported; '
+                                 'logprobs=true returns chosen-token '
+                                 'logprobs')
+            want_logprobs = _parse_logprobs(body)
         except (TypeError, ValueError) as e:
             return bad(f'invalid request: {e}')
         msg = _check_len(engine, tokens, max_new)
@@ -1141,7 +1202,7 @@ def build_app(engine: InferenceEngine):
                                        web)
 
         try:
-            out, finish = await engine.submit(tokens, max_new, *sampling,
+            out, finish, lps = await engine.submit(tokens, max_new, *sampling,
                                               stop_ids=stop_ids)
         except EngineOverloaded as e:
             return _openai_error(web, str(e), status=429,
@@ -1150,6 +1211,15 @@ def build_app(engine: InferenceEngine):
         text, cut = _truncate_at_stop_strings(text, stop_strings)
         if cut:
             finish = 'stop'
+        lp_obj = None
+        if want_logprobs:
+            # Chat logprobs format: content entries of {token, logprob},
+            # trimmed to the (possibly stop-string-cut) returned text.
+            flat = _completion_logprobs(engine.tokenizer, out, lps, text)
+            lp_obj = {'content': [
+                {'token': p, 'logprob': v}
+                for p, v in zip(flat['tokens'],
+                                flat['token_logprobs'])]}
         return web.json_response({
             'id': rid,
             'object': 'chat.completion',
@@ -1157,6 +1227,7 @@ def build_app(engine: InferenceEngine):
             'model': model,
             'choices': [{'index': 0,
                          'message': {'role': 'assistant', 'content': text},
+                         'logprobs': lp_obj,
                          'finish_reason': finish}],
             'usage': {'prompt_tokens': len(tokens),
                       'completion_tokens': len(out),
