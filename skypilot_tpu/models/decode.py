@@ -619,7 +619,11 @@ def chosen_logprob(logits: jnp.ndarray, tokens: jnp.ndarray
 
 def select_token_per_row(logits: jnp.ndarray, temperature: jnp.ndarray,
                          top_k: jnp.ndarray, top_p: jnp.ndarray,
-                         rng: jax.Array) -> jnp.ndarray:
+                         rng: jax.Array,
+                         counts: Optional[jnp.ndarray] = None,
+                         presence: Optional[jnp.ndarray] = None,
+                         frequency: Optional[jnp.ndarray] = None
+                         ) -> jnp.ndarray:
     """Vectorized PER-ROW sampling for the continuous batcher: rows with
     different sampling params share one compiled step.
 
@@ -627,10 +631,19 @@ def select_token_per_row(logits: jnp.ndarray, temperature: jnp.ndarray,
     (<=0 → off, values clamped to vocab — an oversized client top_k can
     not fail the batch); top_p [B] f32 (outside (0,1) → off). Same mask
     construction as `_select_token`, lifted to per-row thresholds.
+
+    `counts` [B,V] int32 (+ per-row `presence`/`frequency` [B] f32):
+    OpenAI repetition penalties — logits lose presence·1[count>0] +
+    frequency·count BEFORE temperature/filtering, so they bite in
+    greedy mode too. Counts cover GENERATED tokens (vLLM semantics).
     """
     b, v = logits.shape
     del b
     logits = logits.astype(jnp.float32)
+    if counts is not None:
+        pen = (presence[:, None] * (counts > 0).astype(jnp.float32) +
+               frequency[:, None] * counts.astype(jnp.float32))
+        logits = logits - pen
     greedy = temperature <= 0.0
     scaled = logits / jnp.where(greedy, 1.0, temperature)[:, None]
     neg_inf = jnp.finfo(jnp.float32).min

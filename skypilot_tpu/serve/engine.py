@@ -94,7 +94,8 @@ def parse_mesh_arg(mesh: str):
 
 
 def _parse_sampling(body, default_temperature: float = 0.0):
-    """(temperature, top_k, top_p) from an untrusted request body —
+    """(temperature, top_k, top_p, presence_penalty, frequency_penalty)
+    from an untrusted request body —
     shared by /generate and the /v1 endpoints. Raises ValueError/TypeError
     on garbage (NaN, out-of-range)."""
     import math
@@ -108,7 +109,13 @@ def _parse_sampling(body, default_temperature: float = 0.0):
     top_p = float(top_p) if top_p is not None else None
     if top_p is not None and not 0.0 <= top_p <= 1.0:
         raise ValueError(f'top_p {top_p} outside [0, 1]')
-    return temperature, top_k, top_p
+    penalties = []
+    for field in ('presence_penalty', 'frequency_penalty'):
+        val = float(body.get(field) or 0.0)
+        if not math.isfinite(val) or not -2.0 <= val <= 2.0:
+            raise ValueError(f'{field} {val} outside [-2, 2]')
+        penalties.append(val)
+    return (temperature, top_k, top_p, *penalties)
 
 
 def _parse_logprobs(body) -> bool:
@@ -370,6 +377,13 @@ class InferenceEngine:
         self.temp = np.zeros(MAX_BATCH, np.float32)
         self.topk = np.zeros(MAX_BATCH, np.int32)
         self.topp = np.zeros(MAX_BATCH, np.float32)
+        self.pres = np.zeros(MAX_BATCH, np.float32)
+        self.freq = np.zeros(MAX_BATCH, np.float32)
+        # Generated-token counts per slot (OpenAI presence/frequency
+        # penalties); [B, V] int32 rides the step jits like the cache.
+        import jax.numpy as jnp
+        self.counts = jnp.zeros((MAX_BATCH, self.cfg.vocab_size),
+                                jnp.int32)
         # Prefix snapshots live OUTSIDE the donated cache buffer (their
         # slices own their storage), so they survive resets — but wipe
         # them anyway: after a poisoned-state reset nothing device-side
@@ -392,37 +406,51 @@ class InferenceEngine:
 
         self._reset_device_state()
 
-        def step_k(k):
+        def step_k(k, use_pen):
             """k decode steps in ONE device call (host-loop dispatch cost
             amortized when no request is waiting to join). Compiled per
-            distinct k — bounded by MAX_STEP_CHUNK."""
+            (k, penalties-active) — the common un-penalized path never
+            pays the [B,V] counts carry/scatter or the penalty math."""
 
-            @functools.partial(jax.jit, donate_argnums=(1,))
-            def run(params, cache, last, temp, topk, topp, rng, active):
+            @functools.partial(jax.jit, donate_argnums=(1, 2))
+            def run(params, cache, counts, last, temp, topk, topp, pres,
+                    freq, rng, active):
                 def body(carry, _):
-                    last_t, cache_t, rng_t = carry
+                    last_t, cache_t, counts_t, rng_t = carry
                     logits, cache_t = dec.decode_step(params, last_t,
                                                       cache_t, cfg,
                                                       active=active)
                     rng_t, sub = jax.random.split(rng_t)
                     nxt = decode_lib.select_token_per_row(
-                        logits, temp, topk, topp, sub)
+                        logits, temp, topk, topp, sub,
+                        counts=counts_t if use_pen else None,
+                        presence=pres if use_pen else None,
+                        frequency=freq if use_pen else None)
                     nxt = jnp.where(active, nxt, last_t)
+                    # logprobs report the UNPENALIZED model distribution.
                     lp = decode_lib.chosen_logprob(logits, nxt)
-                    return (nxt, cache_t, rng_t), (nxt, lp)
-                (last_f, cache_f, rng_f), (toks, lps) = jax.lax.scan(
-                    body, (last, cache, rng), None, length=k)
+                    if use_pen:
+                        rows = jnp.arange(nxt.shape[0])
+                        counts_t = counts_t.at[rows, nxt].add(
+                            active.astype(jnp.int32))
+                    return (nxt, cache_t, counts_t, rng_t), (nxt, lp)
+                (last_f, cache_f, counts_f, rng_f), (toks, lps) = \
+                    jax.lax.scan(body, (last, cache, counts, rng), None,
+                                 length=k)
                 del last_f
-                return toks, lps, cache_f, rng_f
+                return toks, lps, cache_f, counts_f, rng_f
             return run
 
         self._step_k_jits = {}
 
-        def step(params, last, cache, temp, topk, topp, rng, active, k=1):
-            if k not in self._step_k_jits:
-                self._step_k_jits[k] = step_k(k)
-            return self._step_k_jits[k](params, cache, last, temp, topk,
-                                        topp, rng, active)
+        def step(params, cache, counts, last, temp, topk, topp, pres,
+                 freq, rng, active, k=1, use_pen=False):
+            key = (k, use_pen)
+            if key not in self._step_k_jits:
+                self._step_k_jits[key] = step_k(k, use_pen)
+            return self._step_k_jits[key](params, cache, counts, last,
+                                          temp, topk, topp, pres, freq,
+                                          rng, active)
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def admit(params, cache, tokens, lengths, slots, temps, topks,
@@ -494,11 +522,21 @@ class InferenceEngine:
         traffic uses (--warm-buckets all) to guarantee no client request
         ever hits a fresh XLA compile."""
         self._ensure_state()
-        warm_item = (list(range(1, 9)), MAX_STEP_CHUNK + 2, 0.0, None,
-                     None, (), None, None)
+        warm_item = (list(range(1, 9)), 2 * MAX_STEP_CHUNK + 4, 0.0,
+                     None, None, 0.0, 0.0, (), None, None)
         self._admit(warm_item)
         self._step_once()      # k = MAX_STEP_CHUNK (remaining is large)
-        self._step_once()      # k = 1 (remaining == 1)
+        self.pres[:] = 1.0     # penalty-variant programs
+        self._step_once()      # k = MAX_STEP_CHUNK, use_pen
+        self.pres[:] = 0.0
+        # Drain to remaining == 1, then compile both k=1 variants.
+        while min(s['want'] - len(s['out']) for s in self.slots
+                  if s is not None) > 2:
+            self._step_once()
+        self._step_once()      # k = 1
+        self.pres[:] = 1.0
+        self._step_once()      # k = 1, use_pen
+        self.pres[:] = 0.0
         self.slots = [None] * MAX_BATCH
         for size in self._group_sizes()[1:]:
             self._admit_group([warm_item] * size)
@@ -509,8 +547,8 @@ class InferenceEngine:
             # an XLA compile for it.
             if b <= 16 or b >= self.max_len:
                 continue
-            item_b = (list(range(1, b + 1)), 1, 0.0, None, None, (),
-                      None, None)
+            item_b = (list(range(1, b + 1)), 1, 0.0, None, None, 0.0,
+                      0.0, (), None, None)
             for size in self._group_sizes():
                 self._admit_group([item_b] * size)
                 self.slots = [None] * MAX_BATCH
@@ -542,6 +580,8 @@ class InferenceEngine:
     def submit_nowait(self, tokens: List[int], max_new: int,
                       temperature: float, top_k: Optional[int],
                       top_p: Optional[float],
+                      presence_penalty: float = 0.0,
+                      frequency_penalty: float = 0.0,
                       stop_ids: Tuple[int, ...] = (),
                       stream_q: Optional[asyncio.Queue] = None
                       ) -> asyncio.Future:
@@ -553,7 +593,9 @@ class InferenceEngine:
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         try:
             self._queue.put_nowait((tokens, max_new, temperature, top_k,
-                                    top_p, stop_ids, stream_q, fut))
+                                    top_p, presence_penalty,
+                                    frequency_penalty, stop_ids,
+                                    stream_q, fut))
         except asyncio.QueueFull:
             self.rejected_total += 1
             raise EngineOverloaded(
@@ -564,10 +606,12 @@ class InferenceEngine:
     async def submit(self, tokens: List[int], max_new: int,
                      temperature: float, top_k: Optional[int],
                      top_p: Optional[float],
-                     stop_ids: Tuple[int, ...] = ()
-                     ) -> Tuple[List[int], str]:
+                     presence_penalty: float = 0.0,
+                     frequency_penalty: float = 0.0,
+                     stop_ids: Tuple[int, ...] = ()):
         fut = self.submit_nowait(tokens, max_new, temperature, top_k,
-                                 top_p, stop_ids=stop_ids)
+                                 top_p, presence_penalty,
+                                 frequency_penalty, stop_ids=stop_ids)
         return await fut
 
     def _free_slot(self) -> Optional[int]:
@@ -620,7 +664,8 @@ class InferenceEngine:
     def _admit_with_prefix(self, item, p: int) -> int:
         """Admit one request over a stored prefix; returns the slot."""
         jnp = self._jnp
-        (tokens, _, temperature, top_k, top_p, *_rest) = item
+        (tokens, _, temperature, top_k, top_p, pres, freq,
+         *_rest) = item
         slot = self._free_slot()
         assert slot is not None
         suffix = tokens[p:]
@@ -630,6 +675,8 @@ class InferenceEngine:
         self.temp[slot] = max(float(temperature), 0.0)
         self.topk[slot] = int(top_k) if top_k else 0
         self.topp[slot] = float(top_p) if top_p else 0.0
+        self.pres[slot] = float(pres or 0.0)
+        self.freq[slot] = float(freq or 0.0)
         key = tuple(tokens[:p])
         pk, pv = self._prefix_store[key]
         self._prefix_store.move_to_end(key)
@@ -639,7 +686,9 @@ class InferenceEngine:
             jnp.float32(self.temp[slot]), jnp.int32(self.topk[slot]),
             jnp.float32(self.topp[slot]), self.rng)
         self.prefix_hits += 1
-        self._finish_admit(item, slot, int(first), float(first_lp))
+        first_i = int(first)
+        self.counts = self.counts.at[slot].set(0).at[slot, first_i].add(1)
+        self._finish_admit(item, slot, first_i, float(first_lp))
         # The slot now holds the FULL prompt's KV — snapshot the longer
         # prefix so a growing chat history keeps extending its cache
         # (turn N+1 hits turn N's whole prompt, not just the oldest
@@ -649,7 +698,7 @@ class InferenceEngine:
 
     def _finish_admit(self, item, slot: int, first: int,
                       first_lp: float = 0.0) -> None:
-        (_, max_new, _, _, _, stop_ids, stream_q, fut) = item
+        (_, max_new, _, _, _, _, _, stop_ids, stream_q, fut) = item
         self.last[slot] = first
         stop = frozenset(stop_ids or ())
         entry = {'fut': fut, 'want': max_new, 'out': [], 'lps': [],
@@ -695,10 +744,12 @@ class InferenceEngine:
             slots.append(slot)
             padded.append(tokens + [0] * (bucket - len(tokens)))
             lengths.append(len(tokens))
-            temperature, top_k, top_p = item[2], item[3], item[4]
+            temperature, top_k, top_p, pres, freq = item[2:7]
             self.temp[slot] = max(float(temperature), 0.0)
             self.topk[slot] = int(top_k) if top_k else 0
             self.topp[slot] = float(top_p) if top_p else 0.0
+            self.pres[slot] = float(pres or 0.0)
+            self.freq[slot] = float(freq or 0.0)
             temps.append(self.temp[slot])
             topks.append(self.topk[slot])
             topps.append(self.topp[slot])
@@ -711,6 +762,11 @@ class InferenceEngine:
             jnp.asarray(topps, jnp.float32), self.rng)
         first = jax.device_get(first)
         first_lp = jax.device_get(first_lp)
+        # Penalty counts: fresh slot, first token counted (host-side
+        # eager update; the buffer is otherwise owned by the step jit).
+        sl = jnp.asarray(slots, jnp.int32)
+        self.counts = self.counts.at[sl].set(0).at[
+            sl, jnp.asarray(first, jnp.int32)].add(1)
         for i, item in enumerate(items):
             self._finish_admit(item, slots[i], int(first[i]),
                                float(first_lp[i]))
@@ -748,10 +804,13 @@ class InferenceEngine:
                 (self._queue is None or self._queue.empty())):
             k = MAX_STEP_CHUNK
         active = jnp.asarray([s is not None for s in self.slots])
-        toks, lps, self.cache, self.rng = self._step_jit(
-            self.params, jnp.asarray(self.last), self.cache,
-            jnp.asarray(self.temp), jnp.asarray(self.topk),
-            jnp.asarray(self.topp), self.rng, active, k=k)
+        use_pen = bool(self.pres.any() or self.freq.any())
+        toks, lps, self.cache, self.counts, self.rng = self._step_jit(
+            self.params, self.cache, self.counts,
+            jnp.asarray(self.last), jnp.asarray(self.temp),
+            jnp.asarray(self.topk), jnp.asarray(self.topp),
+            jnp.asarray(self.pres), jnp.asarray(self.freq),
+            self.rng, active, k=k, use_pen=use_pen)
         toks = jax.device_get(toks)              # [k, B]
         lps = jax.device_get(lps)                # [k, B]
         self.step_count += k
@@ -924,11 +983,11 @@ async def _sse_response(request, engine: InferenceEngine,
     event, per the OpenAI streaming contract. Ends with `data: [DONE]`.
     """
     from skypilot_tpu.data.tokenizer import StreamDecoder
-    temperature, top_k, top_p = sampling
+    temperature, top_k, top_p, pres, freq = sampling
     stream_q: asyncio.Queue = asyncio.Queue()
     try:
         fut = engine.submit_nowait(tokens, max_new, temperature, top_k,
-                                   top_p, stop_ids=stop_ids,
+                                   top_p, pres, freq, stop_ids=stop_ids,
                                    stream_q=stream_q)
     except EngineOverloaded as e:
         return _openai_error(web, str(e), status=429,
@@ -1032,16 +1091,16 @@ def build_app(engine: InferenceEngine):
         # recompile nor fail the whole batch (top_k is further clamped to
         # vocab inside decode.select_token_per_row).
         try:
-            temperature, top_k, top_p = _parse_sampling(body)
+            sampling = _parse_sampling(body)
             stop_ids = (tuple(int(i) for i in body['stop_token_ids'])
                         if 'stop_token_ids' in body else ())
         except (TypeError, ValueError) as e:
             return web.json_response({'error': f'bad sampling params: {e}'},
                                      status=400)
         try:
-            out, finish, lps = await engine.submit(tokens, max_new, temperature,
-                                              top_k, top_p,
-                                              stop_ids=stop_ids)
+            out, finish, lps = await engine.submit(tokens, max_new,
+                                                   *sampling,
+                                                   stop_ids=stop_ids)
         except EngineOverloaded as e:
             return web.json_response({'error': str(e)}, status=429)
         resp: Dict[str, Any] = {'tokens': out, 'finish_reason': finish,

@@ -208,6 +208,63 @@ class TestEngine:
         # Trimmed: no entries beyond the returned text.
         assert len(clp['tokens']) <= max(len(text), 1)
 
+    def test_penalty_math_in_sampler(self):
+        """presence/frequency penalties shift logits before selection
+        (and bite in GREEDY mode too, per OpenAI semantics)."""
+        logits = jnp.asarray([[5.0, 4.0, 0.0, 0.0]])
+        counts = jnp.asarray([[3, 0, 0, 0]], jnp.int32)
+        rng = jax.random.PRNGKey(0)
+        greedy = jnp.zeros((1,)), jnp.zeros((1,), jnp.int32), \
+            jnp.zeros((1,))
+        temp, topk, topp = greedy
+        base = decode.select_token_per_row(logits, temp, topk, topp, rng)
+        assert int(base[0]) == 0
+        # frequency 1.0 × count 3 drops token 0 by 3 → token 1 wins.
+        pen = decode.select_token_per_row(
+            logits, temp, topk, topp, rng, counts=counts,
+            presence=jnp.zeros((1,)), frequency=jnp.ones((1,)))
+        assert int(pen[0]) == 1
+        # presence alone (1[count>0] × 2.0) also flips it (gap is 1.0).
+        pen2 = decode.select_token_per_row(
+            logits, temp, topk, topp, rng, counts=counts,
+            presence=jnp.full((1,), 2.0), frequency=jnp.zeros((1,)))
+        assert int(pen2[0]) == 1
+        # Zero penalties == baseline exactly.
+        same = decode.select_token_per_row(
+            logits, temp, topk, topp, rng, counts=counts,
+            presence=jnp.zeros((1,)), frequency=jnp.zeros((1,)))
+        assert int(same[0]) == 0
+
+    def test_penalties_through_http_reduce_repetition(self, engine):
+        """E2E: zero penalties equal the unpenalized baseline exactly;
+        a strong frequency penalty changes the greedy continuation and
+        lowers the max token-repeat count."""
+        prompt = [7, 7, 7, 7]
+
+        async def fn(client):
+            r0 = await client.post('/generate', json={
+                'tokens': prompt, 'max_new_tokens': 12})
+            rz = await client.post('/generate', json={
+                'tokens': prompt, 'max_new_tokens': 12,
+                'presence_penalty': 0.0, 'frequency_penalty': 0.0})
+            rp = await client.post('/generate', json={
+                'tokens': prompt, 'max_new_tokens': 12,
+                'frequency_penalty': 2.0})
+            rbad = await client.post('/generate', json={
+                'tokens': prompt, 'max_new_tokens': 2,
+                'frequency_penalty': 3.0})
+            return ((await r0.json())['tokens'],
+                    (await rz.json())['tokens'],
+                    (await rp.json())['tokens'], rbad.status)
+
+        base, zero, pen, bad_status = _with_client(engine, fn)
+        assert zero == base          # explicit zeros change nothing
+        assert bad_status == 400     # outside [-2, 2]
+        import collections
+        reps = lambda xs: max(collections.Counter(xs).values())
+        assert pen != base
+        assert reps(pen) <= reps(base)
+
     def test_late_request_joins_inflight_batch(self, engine):
         """Continuous batching acceptance (VERDICT r2 item 7): a request
         arriving MID-GENERATION is answered without waiting for the
