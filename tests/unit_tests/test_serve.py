@@ -341,7 +341,7 @@ def _get(url, timeout=5):
         return json.loads(resp.read())
 
 
-def _wait_ready_replicas(name, count, timeout=120):
+def _wait_ready_replicas(name, count, timeout=300):
     deadline = time.time() + timeout
     while time.time() < deadline:
         ready = [r for r in serve_state.get_replicas(name)
@@ -367,7 +367,7 @@ class TestServeEndToEnd:
                              lb_port=_worker_port_base() + 50)
         name = info['name']
         try:
-            serve_core.wait_until(name, {ServiceStatus.READY}, timeout=180)
+            serve_core.wait_until(name, {ServiceStatus.READY}, timeout=360)
             _wait_ready_replicas(name, 2)
 
             # Requests round-trip through the LB and hit BOTH replicas
@@ -478,7 +478,7 @@ class TestServeEndToEnd:
         info = serve_core.up(task, lb_port=_worker_port_base() + 52)
         try:
             serve_core.wait_until(info['name'], {ServiceStatus.READY},
-                                  timeout=180)
+                                  timeout=360)
             req = urllib.request.Request(
                 info['endpoint'] + '/generate',
                 data=json.dumps({'tokens': [1, 2, 3, 4],
@@ -505,7 +505,7 @@ class TestServeEndToEnd:
                              lb_port=_worker_port_base() + 54)
         name = info['name']
         try:
-            serve_core.wait_until(name, {ServiceStatus.READY}, timeout=180)
+            serve_core.wait_until(name, {ServiceStatus.READY}, timeout=360)
             _wait_ready_replicas(name, 1)
             old_pid = serve_state.get_service(name)['controller_pid']
             os.kill(old_pid, signal.SIGKILL)
@@ -556,7 +556,7 @@ class TestServeEndToEnd:
         info = serve_core.up(task, lb_port=_worker_port_base() + 53)
         name = info['name']
         try:
-            serve_core.wait_until(name, {ServiceStatus.READY}, timeout=180)
+            serve_core.wait_until(name, {ServiceStatus.READY}, timeout=360)
             _wait_ready_replicas(name, 1)
 
             bad = sky.Task(name='rbk', run='exit 1')   # never serves
@@ -592,7 +592,7 @@ class TestServeEndToEnd:
                              lb_port=_worker_port_base() + 51)
         name = info['name']
         try:
-            serve_core.wait_until(name, {ServiceStatus.READY}, timeout=180)
+            serve_core.wait_until(name, {ServiceStatus.READY}, timeout=360)
             _wait_ready_replicas(name, 2)
             assert _get(info['endpoint'] + '/v')['version'] == '1'
 
@@ -638,7 +638,7 @@ class TestServeEndToEnd:
                              lb_port=_worker_port_base() + 52)
         name = info['name']
         try:
-            serve_core.wait_until(name, {ServiceStatus.READY}, timeout=180)
+            serve_core.wait_until(name, {ServiceStatus.READY}, timeout=360)
             _wait_ready_replicas(name, 1)
             serve_core.update(_service_task(replicas=1), name,
                               mode='blue_green')
