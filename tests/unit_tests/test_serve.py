@@ -383,7 +383,7 @@ class TestServeEndToEnd:
             rep1 = serve_state.get_replicas(name)[0]
             shutil.rmtree(os.path.join(local_cloud.LOCAL_CLOUD_ROOT,
                                        rep1['cluster_name']))
-            deadline = time.time() + 120
+            deadline = time.time() + 300
             while time.time() < deadline:
                 reps = serve_state.get_replicas(name)
                 ready = [r for r in reps
@@ -523,7 +523,7 @@ class TestServeEndToEnd:
                                            rep['cluster_name']))
             # Replica ids restart from 1 when the table empties; the
             # replacement is identified by its fresh launch time.
-            deadline = time.time() + 120
+            deadline = time.time() + 300
             while time.time() < deadline:
                 reps = serve_state.get_replicas(name)
                 if reps and (reps[0]['launched_at'] or 0) > preempted_at \
@@ -567,7 +567,7 @@ class TestServeEndToEnd:
             assert serve_state.get_service(name)['version'] == 2
 
             # The rollout must abort: version reverts to 1 in the record.
-            deadline = time.time() + 180
+            deadline = time.time() + 360
             while time.time() < deadline:
                 rec = serve_state.get_service(name)
                 if int(rec.get('version') or 1) == 1:
@@ -643,7 +643,7 @@ class TestServeEndToEnd:
             serve_core.update(_service_task(replicas=1), name,
                               mode='blue_green')
             saw_v1_during_update = False
-            deadline = time.time() + 240
+            deadline = time.time() + 420
             while time.time() < deadline:
                 # Tolerate transient LB 502s: on a saturated CI core the
                 # old replica's probe can time out and briefly empty the
@@ -668,7 +668,7 @@ class TestServeEndToEnd:
             else:
                 raise TimeoutError(serve_state.get_replicas(name))
             assert saw_v1_during_update
-            deadline = time.time() + 30
+            deadline = time.time() + 90
             while True:
                 try:
                     assert _get(info['endpoint'] + '/v')['version'] == '2'
