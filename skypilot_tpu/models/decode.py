@@ -117,9 +117,10 @@ def cast_params_for_decode(params, cfg: llama.LlamaConfig,
             'int8 decode is implemented for the dense Llama and MLA '
             'families (MoE expert dispatch is not quant-aware yet).')
     # NOTE: quantized params do not mirror llama.param_specs' tree any
-    # more (QuantizedWeight subtrees) — int8 serving is single-device
-    # (the engine's deployment); sharded decode uses the unquantized
-    # path.
+    # more (QuantizedWeight subtrees) — mesh placement handles them by
+    # giving the int8 tensor the fp weight's spec and the per-channel
+    # scale the same spec with broadcast (size-1) dims unsharded
+    # (serve/engine._setup_mesh), so int8 composes with --mesh.
     out = {}
     for key, sub in params.items():
         if key != 'layers':
@@ -145,6 +146,18 @@ def init_cache(cfg: llama.LlamaConfig, batch: int, max_len: int) -> KVCache:
     return KVCache(k=jnp.zeros(shape, cfg.dtype),
                    v=jnp.zeros(shape, cfg.dtype),
                    length=jnp.zeros((batch,), jnp.int32))
+
+
+def cache_pspecs(cfg: llama.LlamaConfig) -> KVCache:
+    """PartitionSpecs mirroring init_cache's tree (the serving engine
+    places the cache with these under --mesh). k/v [L, B, T, KH, hd]:
+    batch over data/fsdp, kv-heads over tensor — the training rule
+    table's layout, so decode's attention contractions stay local per
+    TP shard."""
+    del cfg
+    from jax.sharding import PartitionSpec as P
+    kv = P(None, ('data', 'fsdp'), None, 'tensor', None)
+    return KVCache(k=kv, v=kv, length=P(('data', 'fsdp')))
 
 
 def _qkv(x: jnp.ndarray, lp, cfg: llama.LlamaConfig, sin, cos):

@@ -414,6 +414,18 @@ def init_cache(cfg: MLAConfig, batch: int, max_len: int) -> LatentCache:
         length=jnp.zeros((batch,), jnp.int32))
 
 
+def cache_pspecs(cfg: MLAConfig) -> LatentCache:
+    """PartitionSpecs mirroring init_cache's tree (serving engine mesh
+    placement). c_kv/k_rope [L, B, T, r]: batch over data/fsdp; the
+    latent dim REPLICATES over tensor like w_dkv/w_kr (param_specs) —
+    every TP shard scores its own heads against the full shared latent,
+    so decode needs no latent all-gather."""
+    del cfg
+    from jax.sharding import PartitionSpec as P
+    lat = P(None, ('data', 'fsdp'), None, None)
+    return LatentCache(c_kv=lat, k_rope=lat, length=P(('data', 'fsdp')))
+
+
 def prefill(params, tokens: jnp.ndarray, cfg: MLAConfig, max_len: int,
             lengths: Optional[jnp.ndarray] = None
             ) -> Tuple[jnp.ndarray, LatentCache]:

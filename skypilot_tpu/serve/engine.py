@@ -298,21 +298,23 @@ class InferenceEngine:
         GSPMD then inserts TP collectives inside the step/admit jits (the
         cache is sharded by _reset_device_state: batch over data/fsdp,
         kv-heads over tensor — the same layout training uses, so decode
-        collectives ride ICI exactly like the training step's)."""
+        collectives ride ICI exactly like the training step's).
+
+        Every serving family shards: dense/GQA and MoE through the
+        training rule table; MLA/DeepSeek (heads over 'tensor', the
+        shared latent replicated — models/mla.py param_specs) so
+        deepseek-v2/kimi-k2-class geometries serve under --mesh like the
+        reference's 8-chip TP vLLM replicas do
+        (reference llm/deepseek-r1/README.md, examples/tpu/v6e/README.md:
+        119-127); int8 QuantizedWeight trees shard too (the int8 tensor
+        and its per-channel scale take the fp weight's spec — reference
+        replicas quantize AND shard, vLLM defaults)."""
         import jax
-        from jax.sharding import NamedSharding
-        from skypilot_tpu.models import mla, module_for
+        from jax.sharding import NamedSharding, PartitionSpec
+        from skypilot_tpu.models import module_for
+        from skypilot_tpu.models.decode import QuantizedWeight
         from skypilot_tpu.parallel import MeshSpec, build_mesh
         from skypilot_tpu.parallel import sharding as sharding_lib
-        if quantize:
-            raise ValueError('--quantize int8 is single-device serving '
-                             '(QuantizedWeight trees have no sharding '
-                             'rules); drop --mesh or --quantize.')
-        if self._decode is mla:
-            raise NotImplementedError(
-                'mesh serving for MLA (latent-cache) models is not wired '
-                'yet; serve dense/MoE families sharded or MLA '
-                'single-device.')
         if isinstance(mesh, str):
             mesh = parse_mesh_arg(mesh)
         if isinstance(mesh, MeshSpec):
@@ -328,13 +330,30 @@ class InferenceEngine:
                              f'to a multiple)')
         rules = sharding_lib.Rules()
         specs = mod.param_specs(self.cfg, rules)
+
+        def leaf_sharding(param, spec):
+            if isinstance(param, QuantizedWeight):
+                # The int8 tensor takes the fp weight's spec verbatim;
+                # the per-channel scale broadcasts over the reduced
+                # (second-to-last) dim, so any mesh axis on a size-1
+                # scale dim is dropped — per-shard dequant then needs no
+                # collective.
+                q_sh = NamedSharding(mesh, spec)
+                entries = list(spec) + [None] * (param.q.ndim - len(spec))
+                s_spec = PartitionSpec(*[
+                    e if param.scale.shape[i] > 1 else None
+                    for i, e in enumerate(entries)])
+                return QuantizedWeight(q=q_sh,
+                                       scale=NamedSharding(mesh, s_spec))
+            return NamedSharding(mesh, spec)
+
         self.params = jax.device_put(
             self.params,
-            jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
-                         is_leaf=lambda x: isinstance(
-                             x, jax.sharding.PartitionSpec)))
+            jax.tree.map(leaf_sharding, self.params, specs,
+                         is_leaf=lambda x: isinstance(x, QuantizedWeight)))
         logger.info(f'Serving on mesh {shape} '
-                    f'({mesh.devices.size} devices).')
+                    f'({mesh.devices.size} devices)'
+                    + (' [int8 weights sharded]' if quantize else '') + '.')
 
     def start(self) -> None:
         """Bind the batcher to the current event loop (call at server
@@ -361,16 +380,18 @@ class InferenceEngine:
         self.cache = self._decode.init_cache(self.cfg, MAX_BATCH,
                                              self.max_len)
         if self.mesh is not None:
-            # KVCache k/v are [L, B, T, KH, hd]: batch over data/fsdp,
-            # kv-heads over tensor (matches the training rule table, so
-            # decode's attention contractions stay local per TP shard).
-            from jax.sharding import NamedSharding, PartitionSpec as P
-            kv = NamedSharding(self.mesh,
-                               P(None, ('data', 'fsdp'), None, 'tensor',
-                                 None))
-            ln = NamedSharding(self.mesh, P(('data', 'fsdp')))
+            # Each decode family owns its cache layout AND its mesh
+            # layout: cache_pspecs lives next to init_cache
+            # (models/decode.py for KVCache, models/mla.py for
+            # LatentCache), so a new serving family adds one function
+            # there instead of a branch here.
+            from jax.sharding import NamedSharding, PartitionSpec
             self.cache = jax.device_put(
-                self.cache, type(self.cache)(k=kv, v=kv, length=ln))
+                self.cache,
+                jax.tree.map(lambda s: NamedSharding(self.mesh, s),
+                             self._decode.cache_pspecs(self.cfg),
+                             is_leaf=lambda x: isinstance(
+                                 x, PartitionSpec)))
         self.rng = jax.random.PRNGKey(int(time.time_ns()) % (2**31))
         self.slots: List[Optional[Dict[str, Any]]] = [None] * MAX_BATCH
         self.last = np.zeros(MAX_BATCH, np.int32)
@@ -1341,7 +1362,8 @@ def main() -> None:
                              'reference serves 8-chip TP replicas).')
     parser.add_argument('--quantize', choices=['int8'], default=None,
                         help='Weight-only quantization for serving '
-                             '(dense Llama-family models).')
+                             '(dense Llama and MLA families; composes '
+                             'with --mesh).')
     parser.add_argument('--warm-buckets', default='16',
                         help="Comma-separated prompt buckets to pre-"
                              "compile, or 'all' (guarantees no request "
