@@ -14,6 +14,7 @@ un-stack the leading [L] axis and transpose projections back to torch's
 """
 from __future__ import annotations
 
+import dataclasses
 import json
 import os
 import shutil
@@ -131,13 +132,41 @@ def _minimal_hf_config(cfg: llama.LlamaConfig) -> Dict[str, Any]:
         'head_dim': cfg.hd,
     }
     if cfg.rope_scaling:
-        rs = dict(cfg.rope_scaling)
-        out['rope_scaling'] = {
-            'rope_type': 'llama3',
-            'factor': rs['factor'],
-            'low_freq_factor': rs.get('low_freq_factor', 1.0),
-            'high_freq_factor': rs.get('high_freq_factor', 4.0),
-            'original_max_position_embeddings':
-                rs.get('original_max_position', 8192),
-        }
+        # rope_scaling is a frozen RopeScaling dataclass after
+        # LlamaConfig.__post_init__ (raw dicts are converted there).
+        rs = (dataclasses.asdict(cfg.rope_scaling)
+              if dataclasses.is_dataclass(cfg.rope_scaling)
+              else dict(cfg.rope_scaling))
+        rope_type = rs.get('rope_type', 'llama3')
+        if rope_type == 'llama3':
+            out['rope_scaling'] = {
+                'rope_type': 'llama3',
+                'factor': rs['factor'],
+                'low_freq_factor': rs.get('low_freq_factor', 1.0),
+                'high_freq_factor': rs.get('high_freq_factor', 4.0),
+                'original_max_position_embeddings':
+                    rs.get('original_max_position', 8192),
+            }
+        elif rope_type == 'yarn':
+            # beta/attention_factor MUST round-trip: transformers'
+            # defaults differ per model, and a config loading cleanly
+            # with wrong betas computes different RoPE frequencies —
+            # silently wrong logits.
+            out['rope_scaling'] = {
+                'rope_type': 'yarn',
+                'factor': rs['factor'],
+                'beta_fast': rs.get('beta_fast', 32.0),
+                'beta_slow': rs.get('beta_slow', 1.0),
+                'original_max_position_embeddings':
+                    rs.get('original_max_position', 8192),
+            }
+            if rs.get('attention_factor') is not None:
+                out['rope_scaling']['attention_factor'] = \
+                    rs['attention_factor']
+        else:
+            # A mislabeled config.json loads cleanly elsewhere and
+            # generates garbage; refuse instead.
+            raise NotImplementedError(
+                f'HF export for rope_type {rope_type!r} is not wired; '
+                f"supported: 'llama3', 'yarn'.")
     return out

@@ -65,6 +65,12 @@ PREFIX_CACHE_ENTRIES = int(os.environ.get('SKYTPU_ENGINE_PREFIX_CACHE',
 # Prompts shorter than this are never snapshotted (the prefill they'd
 # save is too small to matter; powers of two only).
 PREFIX_MIN_TOKENS = 64
+# Top-N alternative logprobs computed per token (OpenAI `logprobs=N` /
+# chat `top_logprobs`). Always-on inside the step/admit programs — one
+# lax.top_k over [B, V] per token, negligible next to the HBM-bound
+# weight reads, and it keeps the compiled-variant count flat (a
+# per-request flag would double every step/admit program).
+TOP_LOGPROBS_K = 5
 
 
 class EngineOverloaded(Exception):
@@ -118,44 +124,63 @@ def _parse_sampling(body, default_temperature: float = 0.0):
     return (temperature, top_k, top_p, *penalties)
 
 
-def _parse_logprobs(body) -> bool:
-    """OpenAI `logprobs`: the engine reports the CHOSEN token's logprob
-    under the unmodified model distribution (logprobs<=1); top-N
-    alternatives and streaming logprobs are not supported — rejected
-    loudly rather than silently dropped."""
+def _parse_logprobs(body, chat: bool = False) -> Tuple[bool, int]:
+    """OpenAI logprobs params → (want_logprobs, top_n).
+
+    Completions: `logprobs: N` (0..TOP_LOGPROBS_K) — chosen-token
+    logprobs plus N alternatives per position. Chat: `logprobs: true`
+    (+ optional `top_logprobs: N`). Logprobs report the UNPENALIZED
+    model distribution and work with stream=true (per-token chunks)."""
     lp = body.get('logprobs')
     if lp is None or lp is False:
-        return False
-    if lp is True:
-        lp = 1
-    lp = int(lp)
-    if lp > 1:
-        raise ValueError('logprobs > 1 (top-N alternatives) is not '
-                         'supported; use logprobs=1 for chosen-token '
-                         'logprobs')
-    if body.get('stream'):
-        raise ValueError('logprobs with stream=true is not supported')
-    return True
+        if chat and int(body.get('top_logprobs') or 0) > 0:
+            raise ValueError('top_logprobs requires logprobs=true')
+        return False, 0
+    if chat:
+        if lp is not True:
+            raise ValueError('chat logprobs must be a boolean')
+        top_n = int(body.get('top_logprobs') or 0)
+    else:
+        # Completions semantics: logprobs=N → chosen-token logprobs AND
+        # the top-N list per position; N=0 (or boolean true, the legacy
+        # extension) → chosen only.
+        top_n = 0 if lp is True else int(lp)
+    if top_n > TOP_LOGPROBS_K:
+        raise ValueError(f'top logprobs > {TOP_LOGPROBS_K} is not '
+                         f'supported (the engine computes a fixed top-'
+                         f'{TOP_LOGPROBS_K} per token)')
+    return True, max(top_n, 0)
 
 
-def _completion_logprobs(tokenizer, out, lps, text):
+def _completion_logprobs(tokenizer, out, lps, text, tops=None):
     """OpenAI completions logprobs object, ALIGNED with the returned
     text: parallel tokens / token_logprobs / text_offset arrays, trimmed
     when a stop string truncated the text (entries for text that was
     never returned would violate the parallel-array contract eval
-    harnesses rely on)."""
-    pieces, offsets, kept = [], [], []
+    harnesses rely on). Pieces come from INCREMENTAL detokenization
+    (prefix decodes, the StreamDecoder strategy) — per-token decodes can
+    disagree with the joint text when a multi-byte char spans tokens,
+    drifting text_offset. `tops` (optional, per-token
+    [(token_id, logprob), ...]) fills OpenAI's top_logprobs dicts."""
+    pieces, offsets, kept, top_out = [], [], [], []
     pos = 0
-    for t, v in zip(out, lps):
+    prev_len = 0
+    for i, v in enumerate(lps):
         if pos >= len(text):
             break    # text fully covered (or cut to nothing)
-        piece = tokenizer.decode([t])
+        cur = tokenizer.decode(out[:i + 1])
+        piece = cur[prev_len:]
+        prev_len = len(cur)
         pieces.append(piece)
         offsets.append(pos)
         kept.append(round(v, 6))
+        if tops is not None:
+            top_out.append({tokenizer.decode([tid]): round(tv, 6)
+                            for tid, tv in tops[i]})
         pos += len(piece)
     return {'tokens': pieces, 'token_logprobs': kept,
-            'top_logprobs': None, 'text_offset': offsets}
+            'top_logprobs': top_out if tops is not None else None,
+            'text_offset': offsets}
 
 
 def _parse_stop_ids(body, tokenizer) -> Tuple[int, ...]:
@@ -174,6 +199,55 @@ def _parse_stop_ids(body, tokenizer) -> Tuple[int, ...]:
     return tuple(ids)
 
 
+def _parse_n(body) -> Tuple[int, int]:
+    """OpenAI `n` / `best_of`: n samples returned; best_of generated and
+    ranked by mean token logprob (completions only). Bounded by the slot
+    pool size — candidates continuous-batch into the same pool."""
+    n = body.get('n')
+    n = 1 if n is None else int(n)     # `or` would swallow n=0
+    best_of = body.get('best_of')
+    best_of = n if best_of is None else int(best_of)
+    if not 1 <= n <= MAX_BATCH:
+        raise ValueError(f'n must be in [1, {MAX_BATCH}]')
+    if not n <= best_of <= MAX_BATCH:
+        raise ValueError(f'best_of must be in [n, {MAX_BATCH}]')
+    return n, best_of
+
+
+async def _submit_many(engine: InferenceEngine, prompts, max_new,
+                       sampling, stop_ids, n: int, best_of: int):
+    """Fan out prompts × best_of into the continuous batcher, rank each
+    prompt's candidates by mean logprob, keep n per prompt (OpenAI
+    n/best_of + batched-prompt semantics in one place).
+
+    Enqueue is ALL-OR-NOTHING: submit_nowait is synchronous, so on a
+    mid-fan-out EngineOverloaded every already-enqueued sibling is
+    cancelled (queued items are skipped at admission; admitted ones are
+    cut via engine.cancel) — a 429'd request must not leave orphans
+    decoding to max_tokens with no consumer."""
+    temperature, top_k, top_p, pres, freq = sampling
+    futs = []
+    try:
+        for t in prompts:
+            for _ in range(best_of):
+                futs.append(engine.submit_nowait(
+                    t, max_new, temperature, top_k, top_p, pres, freq,
+                    stop_ids=stop_ids))
+    except EngineOverloaded:
+        for f in futs:
+            engine.cancel(f)
+            f.cancel()
+        raise
+    all_res = await asyncio.gather(*futs)
+    results = []
+    for p in range(len(prompts)):
+        cand = list(all_res[p * best_of:(p + 1) * best_of])
+        if best_of > n:
+            cand.sort(key=lambda r: -(sum(r[2]) / max(len(r[2]), 1)))
+        results.extend(cand[:n])
+    return results
+
+
 def _truncate_at_stop_strings(text: str, stop) -> Tuple[str, bool]:
     """OpenAI `stop` strings: cut at the earliest occurrence."""
     if stop is None:
@@ -189,6 +263,12 @@ def _truncate_at_stop_strings(text: str, stop) -> Tuple[str, bool]:
     if cut is None:
         return text, False
     return text[:cut], True
+
+
+def _tops_list(ti, tv) -> list:
+    """Device top-K rows ([K] ids, [K] logprobs) → host-side
+    [(token_id, logprob), ...] stored per emitted token."""
+    return [(int(i), float(v)) for i, v in zip(ti, tv)]
 
 
 def _bucket(n: int, floor: int = 16) -> int:
@@ -427,6 +507,15 @@ class InferenceEngine:
 
         self._reset_device_state()
 
+        def top5(logits):
+            """Top-K alternative logprobs of the UNPENALIZED model
+            distribution (OpenAI logprobs=N / top_logprobs): [.., V]
+            fp32 logits → (values [.., K] fp32, ids [.., K] i32)."""
+            lse = jax.scipy.special.logsumexp(logits, axis=-1,
+                                              keepdims=True)
+            v, i = jax.lax.top_k(logits, TOP_LOGPROBS_K)
+            return (v - lse).astype(jnp.float32), i.astype(jnp.int32)
+
         def step_k(k, use_pen):
             """k decode steps in ONE device call (host-loop dispatch cost
             amortized when no request is waiting to join). Compiled per
@@ -450,16 +539,19 @@ class InferenceEngine:
                     nxt = jnp.where(active, nxt, last_t)
                     # logprobs report the UNPENALIZED model distribution.
                     lp = decode_lib.chosen_logprob(logits, nxt)
+                    tv, ti = top5(logits)
                     if use_pen:
                         rows = jnp.arange(nxt.shape[0])
                         counts_t = counts_t.at[rows, nxt].add(
                             active.astype(jnp.int32))
-                    return (nxt, cache_t, counts_t, rng_t), (nxt, lp)
-                (last_f, cache_f, counts_f, rng_f), (toks, lps) = \
+                    return (nxt, cache_t, counts_t, rng_t), (nxt, lp, ti,
+                                                             tv)
+                (last_f, cache_f, counts_f, rng_f), \
+                    (toks, lps, tis, tvs) = \
                     jax.lax.scan(body, (last, cache, counts, rng), None,
                                  length=k)
                 del last_f
-                return toks, lps, cache_f, counts_f, rng_f
+                return toks, lps, tis, tvs, cache_f, counts_f, rng_f
             return run
 
         self._step_k_jits = {}
@@ -495,7 +587,8 @@ class InferenceEngine:
             first = decode_lib.select_token_per_row(
                 logits, temps, topks, topps, sub)
             first_lp = decode_lib.chosen_logprob(logits, first)
-            return first, first_lp, cache, rng
+            tv, ti = top5(logits)
+            return first, first_lp, ti, tv, cache, rng
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def admit_extend(params, cache, prefix_k, prefix_v, tokens,
@@ -518,7 +611,8 @@ class InferenceEngine:
             first = decode_lib.select_token_per_row(
                 logits, temp[None], topk[None], topp[None], sub)
             first_lp = decode_lib.chosen_logprob(logits, first)
-            return first[0], first_lp[0], cache, rng
+            tv, ti = top5(logits)
+            return first[0], first_lp[0], ti[0], tv[0], cache, rng
 
         self._step_jit = step
         self._admit_jit = admit
@@ -635,6 +729,19 @@ class InferenceEngine:
                                  frequency_penalty, stop_ids=stop_ids)
         return await fut
 
+    def cancel(self, fut) -> None:
+        """Abort the in-flight request owning `fut`: mark it finished so
+        the next _publish frees the slot (the SSE path cuts generation
+        short when a stop STRING matches mid-stream — without this the
+        slot would decode to max_tokens after the client stopped
+        listening). No-op if the request is still queued or already
+        done."""
+        for s in self.slots:
+            if s is not None and s['fut'] is fut:
+                if s['finish'] is None:
+                    s['finish'] = 'stop'
+                return
+
     def _free_slot(self) -> Optional[int]:
         return self._free_slot_excluding(())
 
@@ -701,15 +808,17 @@ class InferenceEngine:
         key = tuple(tokens[:p])
         pk, pv = self._prefix_store[key]
         self._prefix_store.move_to_end(key)
-        first, first_lp, self.cache, self.rng = self._admit_extend_jit(
-            self.params, self.cache, pk, pv, padded,
-            jnp.int32(len(suffix)), jnp.int32(slot),
-            jnp.float32(self.temp[slot]), jnp.int32(self.topk[slot]),
-            jnp.float32(self.topp[slot]), self.rng)
+        first, first_lp, ti, tv, self.cache, self.rng = \
+            self._admit_extend_jit(
+                self.params, self.cache, pk, pv, padded,
+                jnp.int32(len(suffix)), jnp.int32(slot),
+                jnp.float32(self.temp[slot]), jnp.int32(self.topk[slot]),
+                jnp.float32(self.topp[slot]), self.rng)
         self.prefix_hits += 1
         first_i = int(first)
         self.counts = self.counts.at[slot].set(0).at[slot, first_i].add(1)
-        self._finish_admit(item, slot, first_i, float(first_lp))
+        self._finish_admit(item, slot, first_i, float(first_lp),
+                           _tops_list(ti, tv))
         # The slot now holds the FULL prompt's KV — snapshot the longer
         # prefix so a growing chat history keeps extending its cache
         # (turn N+1 hits turn N's whole prompt, not just the oldest
@@ -718,18 +827,20 @@ class InferenceEngine:
         return slot
 
     def _finish_admit(self, item, slot: int, first: int,
-                      first_lp: float = 0.0) -> None:
+                      first_lp: float = 0.0,
+                      first_tops: Optional[list] = None) -> None:
         (_, max_new, _, _, _, _, _, stop_ids, stream_q, fut) = item
         self.last[slot] = first
         stop = frozenset(stop_ids or ())
         entry = {'fut': fut, 'want': max_new, 'out': [], 'lps': [],
-                 'stop': stop, 'stream': stream_q, 'sent': 0,
+                 'tops': [], 'stop': stop, 'stream': stream_q, 'sent': 0,
                  'finish': None}
         if first in stop:
             entry['finish'] = 'stop'
         else:
             entry['out'].append(first)
             entry['lps'].append(first_lp)
+            entry['tops'].append(first_tops or [])
             self.tokens_generated += 1
             if len(entry['out']) >= max_new:
                 entry['finish'] = 'length'
@@ -774,7 +885,7 @@ class InferenceEngine:
             temps.append(self.temp[slot])
             topks.append(self.topk[slot])
             topps.append(self.topp[slot])
-        first, first_lp, self.cache, self.rng = self._admit_jit(
+        first, first_lp, tis, tvs, self.cache, self.rng = self._admit_jit(
             self.params, self.cache, jnp.asarray(padded, jnp.int32),
             jnp.asarray(lengths, jnp.int32),
             jnp.asarray(slots, jnp.int32),
@@ -783,6 +894,7 @@ class InferenceEngine:
             jnp.asarray(topps, jnp.float32), self.rng)
         first = jax.device_get(first)
         first_lp = jax.device_get(first_lp)
+        tis, tvs = jax.device_get(tis), jax.device_get(tvs)
         # Penalty counts: fresh slot, first token counted (host-side
         # eager update; the buffer is otherwise owned by the step jit).
         sl = jnp.asarray(slots, jnp.int32)
@@ -790,7 +902,8 @@ class InferenceEngine:
             sl, jnp.asarray(first, jnp.int32)].add(1)
         for i, item in enumerate(items):
             self._finish_admit(item, slots[i], int(first[i]),
-                               float(first_lp[i]))
+                               float(first_lp[i]),
+                               _tops_list(tis[i], tvs[i]))
             if self.warm and self._decode_is_dense():
                 self._prefix_capture(item[0], slots[i])
 
@@ -826,14 +939,17 @@ class InferenceEngine:
             k = MAX_STEP_CHUNK
         active = jnp.asarray([s is not None for s in self.slots])
         use_pen = bool(self.pres.any() or self.freq.any())
-        toks, lps, self.cache, self.counts, self.rng = self._step_jit(
-            self.params, self.cache, self.counts,
-            jnp.asarray(self.last), jnp.asarray(self.temp),
-            jnp.asarray(self.topk), jnp.asarray(self.topp),
-            jnp.asarray(self.pres), jnp.asarray(self.freq),
-            self.rng, active, k=k, use_pen=use_pen)
+        toks, lps, tis, tvs, self.cache, self.counts, self.rng = \
+            self._step_jit(
+                self.params, self.cache, self.counts,
+                jnp.asarray(self.last), jnp.asarray(self.temp),
+                jnp.asarray(self.topk), jnp.asarray(self.topp),
+                jnp.asarray(self.pres), jnp.asarray(self.freq),
+                self.rng, active, k=k, use_pen=use_pen)
         toks = jax.device_get(toks)              # [k, B]
         lps = jax.device_get(lps)                # [k, B]
+        tis = jax.device_get(tis)                # [k, B, K]
+        tvs = jax.device_get(tvs)                # [k, B, K]
         self.step_count += k
         for i, s in enumerate(self.slots):
             if s is None:
@@ -850,6 +966,7 @@ class InferenceEngine:
                     break
                 s['out'].append(tok)
                 s['lps'].append(float(lps[t][i]))
+                s['tops'].append(_tops_list(tis[t][i], tvs[t][i]))
                 self.tokens_generated += 1
                 if len(s['out']) >= s['want']:
                     s['finish'] = 'length'
@@ -863,16 +980,24 @@ class InferenceEngine:
                 continue
             q = s['stream']
             if q is not None and s['sent'] < len(s['out']):
-                for tok in s['out'][s['sent']:]:
-                    q.put_nowait(tok)
+                for j in range(s['sent'], len(s['out'])):
+                    q.put_nowait((s['out'][j], s['lps'][j], s['tops'][j]))
                 s['sent'] = len(s['out'])
             if s['finish'] is not None:
                 if q is not None:
                     q.put_nowait(None)           # end-of-stream sentinel
                 fut = s['fut']
                 if fut is not None and not fut.done():
-                    fut.set_result((s['out'], s['finish'], s['lps']))
+                    fut.set_result((s['out'], s['finish'], s['lps'],
+                                    s['tops']))
                 self.slots[i] = None
+                # Clear the row's sampling/penalty params: use_pen keys
+                # off pres/freq.any(), so a stale penalized row would
+                # pin every later step onto the penalized compiled
+                # variant ([B,V] counts carry) long after the request
+                # left.
+                self.temp[i] = self.topk[i] = self.topp[i] = 0
+                self.pres[i] = self.freq[i] = 0.0
 
     def _drain_admissible(self, already: int = 0) -> list:
         """Pop queued requests up to the free-slot budget (non-blocking);
@@ -906,6 +1031,11 @@ class InferenceEngine:
     async def _admit_pending(self, first_item=None) -> None:
         items = ([first_item] if first_item is not None else [])
         items += self._drain_admissible(already=len(items))
+        # A cancelled future means the client already gave up on the
+        # queued request (e.g. a 429'd batched fan-out cancelling its
+        # enqueued siblings) — don't burn a prefill on it.
+        items = [it for it in items
+                 if it[-1] is None or not it[-1].done()]
         for group in self._admit_groups(items):
             try:
                 await asyncio.to_thread(self._admit_group, group)
@@ -970,17 +1100,26 @@ def _openai_error(web, msg: str, status: int = 400,
         {'error': {'message': msg, 'type': err_type}}, status=status)
 
 
-def _resolve_prompt(engine: InferenceEngine, prompt) -> List[int]:
-    """OpenAI `prompt` field → token ids (str, [int], or single-[str])."""
+def _resolve_prompts(engine: InferenceEngine, prompt) -> List[List[int]]:
+    """OpenAI `prompt` field → one token-id list PER prompt. Accepts a
+    string, a token-id list, a list of strings, or a list of token-id
+    lists (the batched forms eval harnesses send — each becomes its own
+    choice, continuous-batched in the slot pool)."""
+    def encode(p) -> List[int]:
+        if isinstance(p, list):
+            if not all(isinstance(t, int) for t in p):
+                raise ValueError('a prompt list must be all token ids')
+            return [int(t) for t in p]
+        return [int(t) for t in engine.tokenizer.encode(str(p))]
+
     if isinstance(prompt, list) and prompt and all(
             isinstance(t, int) for t in prompt):
-        return [int(t) for t in prompt]          # token-id prompt
+        return [encode(prompt)]                  # one token-id prompt
     if isinstance(prompt, list):
-        if len(prompt) != 1:
-            raise ValueError('only a single prompt per request is '
-                             'supported')
-        prompt = prompt[0]
-    return [int(t) for t in engine.tokenizer.encode(str(prompt))]
+        if not prompt:
+            raise ValueError('empty prompt list')
+        return [encode(p) for p in prompt]
+    return [encode(prompt)]
 
 
 def _check_len(engine: InferenceEngine, tokens: List[int],
@@ -994,17 +1133,39 @@ def _check_len(engine: InferenceEngine, tokens: List[int],
     return None
 
 
+def _stop_scan(text: str, stops: List[str]) -> Optional[int]:
+    """Earliest stop-string match index in `text`, or None."""
+    cut = None
+    for s in stops:
+        i = text.find(s)
+        if i >= 0 and (cut is None or i < cut):
+            cut = i
+    return cut
+
+
 async def _sse_response(request, engine: InferenceEngine,
                         tokens: List[int], max_new: int, sampling,
-                        stop_ids, make_chunks, web):
+                        stop_ids, make_chunks, web, stop_strings=None,
+                        want_logprobs: bool = False, top_n: int = 0):
     """Shared SSE plumbing for /v1/completions and /v1/chat/completions.
 
-    `make_chunks(delta_text, finish_reason)` yields the JSON payload(s)
-    for one event; finish_reason is set on the final content-bearing
-    event, per the OpenAI streaming contract. Ends with `data: [DONE]`.
+    `make_chunks(delta_text, finish_reason, lp=None)` yields the JSON
+    payload(s) for one event; `lp` is a (piece, logprob, tops, offset)
+    tuple when the client asked for streaming logprobs. finish_reason is
+    set on the final content-bearing event, per the OpenAI streaming
+    contract. Ends with `data: [DONE]`.
+
+    Stop STRINGS stream too: emitted text is held back by
+    len(longest stop)-1 chars so a stop string split across tokens can
+    never leak to the client; on a match the request is cancelled
+    (engine.cancel) and finish_reason='stop'.
     """
     from skypilot_tpu.data.tokenizer import StreamDecoder
     temperature, top_k, top_p, pres, freq = sampling
+    stops = ([] if stop_strings is None else
+             [stop_strings] if isinstance(stop_strings, str)
+             else list(stop_strings))
+    hold = max((len(s) for s in stops), default=0) - 1
     stream_q: asyncio.Queue = asyncio.Queue()
     try:
         fut = engine.submit_nowait(tokens, max_new, temperature, top_k,
@@ -1025,21 +1186,80 @@ async def _sse_response(request, engine: InferenceEngine,
                          json_lib.dumps(payload).encode() + b'\n\n')
 
     decoder = StreamDecoder(engine.tokenizer)
+    # Pieces not yet emitted (stop-string holdback), each the decoded
+    # text OF ITS OWN TOKEN with that token's logprob info — so a
+    # streamed chunk's logprob always describes the text it carries,
+    # and concatenating logprobs.tokens reconstructs the streamed text.
+    pend: List[list] = []     # [piece_text, lp, tops]
+    pend_chars = 0
+    emitted = 0               # chars sent (text_offset)
+    stopped = False
+
+    async def emit_piece(piece: str, lp, tops) -> None:
+        nonlocal emitted
+        lp_info = ((piece, lp, tops[:top_n], emitted)
+                   if want_logprobs and lp is not None else None)
+        if not piece and lp_info is None:
+            return
+        for payload in make_chunks(piece if piece else None, None,
+                                   lp=lp_info):
+            await send(payload)
+        emitted += len(piece)
+
+    async def emit_until(cut: int) -> None:
+        """Emit pend pieces truncated at joined-text index `cut`
+        (logprobs past the cut are trimmed, like the non-stream path)."""
+        remaining = cut
+        for p_text, p_lp, p_tops in pend:
+            if remaining <= 0:
+                break
+            take = min(len(p_text), remaining)
+            await emit_piece(p_text[:take], p_lp, p_tops)
+            remaining -= len(p_text)
+
     try:
         for payload in make_chunks(None, None, first=True):
             await send(payload)
         while True:
-            tok = await stream_q.get()
-            if tok is None:
+            item = await stream_q.get()
+            if item is None:
                 break
-            delta = decoder.feed([tok])
-            if delta:
-                for payload in make_chunks(delta, None):
-                    await send(payload)
-        out, finish, lps = await fut
-        del out, lps
-        tail = decoder.flush()
-        for payload in make_chunks(tail if tail else None, finish):
+            tok, lp, tops = item
+            piece = decoder.feed([tok])
+            pend.append([piece, lp, tops])
+            pend_chars += len(piece)
+            cut = _stop_scan(''.join(p[0] for p in pend), stops)
+            if cut is not None:
+                engine.cancel(fut)
+                await emit_until(cut)
+                pend, stopped = [], True
+                break
+            # Release from the front while the holdback (len(longest
+            # stop) - 1 chars) stays covered by what remains.
+            while pend and pend_chars - len(pend[0][0]) >= hold:
+                p_text, p_lp, p_tops = pend.pop(0)
+                pend_chars -= len(p_text)
+                await emit_piece(p_text, p_lp, p_tops)
+        out, finish, lps, all_tops = await fut
+        del out, lps, all_tops
+        if stopped:
+            finish = 'stop'
+        else:
+            tail = decoder.flush()
+            if tail:
+                # Held-back bytes belong to the last token's piece.
+                if pend:
+                    pend[-1][0] += tail
+                else:
+                    pend.append([tail, None, []])
+            joined = ''.join(p[0] for p in pend)
+            cut = _stop_scan(joined, stops)
+            if cut is not None:
+                finish = 'stop'
+                await emit_until(cut)
+            else:
+                await emit_until(len(joined))
+        for payload in make_chunks(None, finish):
             await send(payload)
         await resp.write(b'data: [DONE]\n\n')
     except Exception as e:  # pylint: disable=broad-except
@@ -1119,9 +1339,8 @@ def build_app(engine: InferenceEngine):
             return web.json_response({'error': f'bad sampling params: {e}'},
                                      status=400)
         try:
-            out, finish, lps = await engine.submit(tokens, max_new,
-                                                   *sampling,
-                                                   stop_ids=stop_ids)
+            out, finish, lps, _tops = await engine.submit(
+                tokens, max_new, *sampling, stop_ids=stop_ids)
         except EngineOverloaded as e:
             return web.json_response({'error': str(e)}, status=429)
         resp: Dict[str, Any] = {'tokens': out, 'finish_reason': finish,
@@ -1135,7 +1354,9 @@ def build_app(engine: InferenceEngine):
         vLLM's OpenAI server — llm/qwen, llm/mixtral recipes curl
         /v1/completions; those clients work against this engine
         unchanged). Real tokenizer when serving an HF checkpoint;
-        token-id list prompts honored; SSE streaming via stream=true."""
+        token-id and BATCHED (list) prompts honored; n/best_of sampling;
+        logprobs=N with top-N alternatives; SSE streaming via
+        stream=true incl. streaming logprobs and stop strings."""
 
         def bad(msg, status=400):
             return _openai_error(web, msg, status=status)
@@ -1144,8 +1365,8 @@ def build_app(engine: InferenceEngine):
         if not isinstance(body, dict):
             return bad('request body must be a JSON object')
         try:
-            tokens = _resolve_prompt(engine, body.get('prompt', ''))
-            if not tokens:
+            prompts = _resolve_prompts(engine, body.get('prompt', ''))
+            if any(not t for t in prompts):
                 raise ValueError('empty prompt')
             max_new = int(body.get('max_tokens', 16))
             if max_new < 1:
@@ -1153,61 +1374,84 @@ def build_app(engine: InferenceEngine):
             sampling = _parse_sampling(body, default_temperature=1.0)
             stop_ids = _parse_stop_ids(body, engine.tokenizer)
             stop_strings = body.get('stop')
-            if stop_strings is not None and body.get('stream'):
-                raise ValueError('stop strings are not supported with '
-                                 'stream=true; use stop_token_ids')
             _truncate_at_stop_strings('', stop_strings)   # validate shape
-            want_logprobs = _parse_logprobs(body)
+            want_logprobs, top_n = _parse_logprobs(body)
+            n, best_of = _parse_n(body)
+            if body.get('stream') and (n > 1 or best_of > 1 or
+                                       len(prompts) > 1):
+                raise ValueError('stream=true supports a single prompt '
+                                 'with n=1 and best_of=1')
         except (TypeError, ValueError) as e:
             return bad(f'invalid request: {e}')
-        msg = _check_len(engine, tokens, max_new)
-        if msg:
-            return bad(msg)
+        for tokens in prompts:
+            msg = _check_len(engine, tokens, max_new)
+            if msg:
+                return bad(msg)
         created = int(time.time())
         rid = f'cmpl-{time.time_ns()}'
         model = body.get('model', engine.model_name)
 
         if body.get('stream'):
-            def make_chunks(delta, finish, first=False):
+            def make_chunks(delta, finish, first=False, lp=None):
                 if first:
                     return
-                if delta is None and finish is None:
+                if delta is None and finish is None and lp is None:
                     return
+                lp_obj = None
+                if lp is not None:
+                    piece, lpv, tops, off = lp
+                    lp_obj = {
+                        'tokens': [piece], 'token_logprobs':
+                            [round(lpv, 6)],
+                        'top_logprobs': [
+                            {engine.tokenizer.decode([i]): round(v, 6)
+                             for i, v in tops}] if top_n else None,
+                        'text_offset': [off]}
                 yield {
                     'id': rid, 'object': 'text_completion',
                     'created': created, 'model': model,
                     'choices': [{'text': delta or '', 'index': 0,
-                                 'logprobs': None,
+                                 'logprobs': lp_obj,
                                  'finish_reason': finish}],
                 }
-            return await _sse_response(request, engine, tokens, max_new,
-                                       sampling, stop_ids, make_chunks,
-                                       web)
+            return await _sse_response(request, engine, prompts[0],
+                                       max_new, sampling, stop_ids,
+                                       make_chunks, web,
+                                       stop_strings=stop_strings,
+                                       want_logprobs=want_logprobs,
+                                       top_n=top_n)
 
         try:
-            out, finish, lps = await engine.submit(tokens, max_new, *sampling,
-                                              stop_ids=stop_ids)
+            results = await _submit_many(engine, prompts, max_new,
+                                         sampling, stop_ids, n, best_of)
         except EngineOverloaded as e:
             return _openai_error(web, str(e), status=429,
                                  err_type='overloaded_error')
-        text = engine.tokenizer.decode(out)
-        text, cut = _truncate_at_stop_strings(text, stop_strings)
-        if cut:
-            finish = 'stop'
-        lp_obj = None
-        if want_logprobs:
-            lp_obj = _completion_logprobs(engine.tokenizer, out, lps,
-                                          text)
+        choices = []
+        total_out = 0
+        for idx, (out, finish, lps, tops) in enumerate(results):
+            text = engine.tokenizer.decode(out)
+            text, cut = _truncate_at_stop_strings(text, stop_strings)
+            if cut:
+                finish = 'stop'
+            lp_obj = None
+            if want_logprobs:
+                lp_obj = _completion_logprobs(
+                    engine.tokenizer, out, lps, text,
+                    tops=[t[:top_n] for t in tops] if top_n else None)
+            total_out += len(out)
+            choices.append({'text': text, 'index': idx,
+                            'logprobs': lp_obj, 'finish_reason': finish})
+        n_prompt = sum(len(t) for t in prompts)
         return web.json_response({
             'id': rid,
             'object': 'text_completion',
             'created': created,
             'model': model,
-            'choices': [{'text': text, 'index': 0, 'logprobs': lp_obj,
-                         'finish_reason': finish}],
-            'usage': {'prompt_tokens': len(tokens),
-                      'completion_tokens': len(out),
-                      'total_tokens': len(tokens) + len(out)},
+            'choices': choices,
+            'usage': {'prompt_tokens': n_prompt,
+                      'completion_tokens': total_out,
+                      'total_tokens': n_prompt + total_out},
         })
 
     async def openai_chat(request):
@@ -1241,15 +1485,11 @@ def build_app(engine: InferenceEngine):
             sampling = _parse_sampling(body, default_temperature=1.0)
             stop_ids = _parse_stop_ids(body, engine.tokenizer)
             stop_strings = body.get('stop')
-            if stop_strings is not None and body.get('stream'):
-                raise ValueError('stop strings are not supported with '
-                                 'stream=true; use stop_token_ids')
             _truncate_at_stop_strings('', stop_strings)
-            if int(body.get('top_logprobs') or 0) > 0:
-                raise ValueError('top_logprobs is not supported; '
-                                 'logprobs=true returns chosen-token '
-                                 'logprobs')
-            want_logprobs = _parse_logprobs(body)
+            want_logprobs, top_n = _parse_logprobs(body, chat=True)
+            n, _ = _parse_n(body)      # chat has no best_of
+            if body.get('stream') and n > 1:
+                raise ValueError('stream=true supports n=1')
         except (TypeError, ValueError) as e:
             return bad(f'invalid request: {e}')
         msg = _check_len(engine, tokens, max_new)
@@ -1260,7 +1500,7 @@ def build_app(engine: InferenceEngine):
         model = body.get('model', engine.model_name)
 
         if body.get('stream'):
-            def make_chunks(delta, finish, first=False):
+            def make_chunks(delta, finish, first=False, lp=None):
                 base = {'id': rid, 'object': 'chat.completion.chunk',
                         'created': created, 'model': model}
                 if first:
@@ -1269,9 +1509,19 @@ def build_app(engine: InferenceEngine):
                                               'content': ''},
                         'finish_reason': None}]}
                     return
-                if delta is not None:
+                if delta is not None or lp is not None:
+                    lp_obj = None
+                    if lp is not None:
+                        piece, lpv, tops, _off = lp
+                        lp_obj = {'content': [{
+                            'token': piece, 'logprob': round(lpv, 6),
+                            'top_logprobs': [
+                                {'token': engine.tokenizer.decode([i]),
+                                 'logprob': round(v, 6)}
+                                for i, v in tops] if top_n else None}]}
                     yield {**base, 'choices': [{
-                        'index': 0, 'delta': {'content': delta},
+                        'index': 0, 'delta': {'content': delta or ''},
+                        'logprobs': lp_obj,
                         'finish_reason': None}]}
                 if finish is not None:
                     yield {**base, 'choices': [{
@@ -1279,39 +1529,56 @@ def build_app(engine: InferenceEngine):
                         'finish_reason': finish}]}
             return await _sse_response(request, engine, tokens, max_new,
                                        sampling, stop_ids, make_chunks,
-                                       web)
+                                       web, stop_strings=stop_strings,
+                                       want_logprobs=want_logprobs,
+                                       top_n=top_n)
 
         try:
-            out, finish, lps = await engine.submit(tokens, max_new, *sampling,
-                                              stop_ids=stop_ids)
+            results = await _submit_many(engine, [tokens], max_new,
+                                         sampling, stop_ids, n, n)
         except EngineOverloaded as e:
             return _openai_error(web, str(e), status=429,
                                  err_type='overloaded_error')
-        text = engine.tokenizer.decode(out)
-        text, cut = _truncate_at_stop_strings(text, stop_strings)
-        if cut:
-            finish = 'stop'
-        lp_obj = None
-        if want_logprobs:
-            # Chat logprobs format: content entries of {token, logprob},
-            # trimmed to the (possibly stop-string-cut) returned text.
-            flat = _completion_logprobs(engine.tokenizer, out, lps, text)
-            lp_obj = {'content': [
-                {'token': p, 'logprob': v}
-                for p, v in zip(flat['tokens'],
-                                flat['token_logprobs'])]}
+        choices = []
+        total_out = 0
+        for idx, (out, finish, lps, tops) in enumerate(results):
+            text = engine.tokenizer.decode(out)
+            text, cut = _truncate_at_stop_strings(text, stop_strings)
+            if cut:
+                finish = 'stop'
+            lp_obj = None
+            if want_logprobs:
+                # Chat logprobs format: content entries of
+                # {token, logprob, top_logprobs}, trimmed to the
+                # (possibly stop-string-cut) returned text.
+                flat = _completion_logprobs(
+                    engine.tokenizer, out, lps, text,
+                    tops=[t[:top_n] for t in tops] if top_n else None)
+                content = []
+                for j, (p, v) in enumerate(zip(flat['tokens'],
+                                               flat['token_logprobs'])):
+                    entry = {'token': p, 'logprob': v}
+                    if top_n:
+                        entry['top_logprobs'] = [
+                            {'token': tt, 'logprob': tv} for tt, tv in
+                            flat['top_logprobs'][j].items()]
+                    content.append(entry)
+                lp_obj = {'content': content}
+            total_out += len(out)
+            choices.append({'index': idx,
+                            'message': {'role': 'assistant',
+                                        'content': text},
+                            'logprobs': lp_obj,
+                            'finish_reason': finish})
         return web.json_response({
             'id': rid,
             'object': 'chat.completion',
             'created': created,
             'model': model,
-            'choices': [{'index': 0,
-                         'message': {'role': 'assistant', 'content': text},
-                         'logprobs': lp_obj,
-                         'finish_reason': finish}],
+            'choices': choices,
             'usage': {'prompt_tokens': len(tokens),
-                      'completion_tokens': len(out),
-                      'total_tokens': len(tokens) + len(out)},
+                      'completion_tokens': total_out,
+                      'total_tokens': len(tokens) + total_out},
         })
 
     async def openai_models(request):

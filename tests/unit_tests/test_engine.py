@@ -7,6 +7,7 @@ prompt lengths) never share a compiled program.
 """
 import asyncio
 import dataclasses
+import json
 
 import numpy as np
 import pytest
@@ -161,11 +162,12 @@ class TestEngine:
 
     def test_logprobs_guards_and_chat_format(self, engine):
         async def fn(client):
+            # Over the engine's fixed top-K → loud 400, not silence.
             r1 = await client.post('/v1/completions', json={
-                'prompt': [1, 2], 'max_tokens': 2, 'logprobs': 5})
-            r2 = await client.post('/v1/completions', json={
-                'prompt': [1, 2], 'max_tokens': 2, 'logprobs': 1,
-                'stream': True})
+                'prompt': [1, 2], 'max_tokens': 2, 'logprobs': 99})
+            r2 = await client.post('/v1/chat/completions', json={
+                'messages': [{'role': 'user', 'content': 'hi'}],
+                'max_tokens': 2, 'top_logprobs': 3})   # needs logprobs
             r3 = await client.post('/v1/chat/completions', json={
                 'messages': [{'role': 'user', 'content': 'hi'}],
                 'max_tokens': 2, 'temperature': 0, 'logprobs': True})
@@ -176,6 +178,78 @@ class TestEngine:
         content = chat['choices'][0]['logprobs']['content']
         assert len(content) == 2
         assert all(c['logprob'] < 0 for c in content)
+
+    def test_top_logprobs(self, engine):
+        """OpenAI top-N alternatives: completions `logprobs: N` returns
+        per-position dicts of N entries; chat `top_logprobs: N` returns
+        {token, logprob} lists. The chosen token's logprob must appear
+        in its own top list when it is the argmax (temperature 0)."""
+        async def fn(client):
+            r = await client.post('/v1/completions', json={
+                'prompt': [1, 2, 3], 'max_tokens': 3, 'temperature': 0,
+                'ignore_eos': True, 'logprobs': 3})
+            assert r.status == 200
+            comp = await r.json()
+            c = await client.post('/v1/chat/completions', json={
+                'messages': [{'role': 'user', 'content': 'hi'}],
+                'max_tokens': 2, 'temperature': 0, 'logprobs': True,
+                'top_logprobs': 2})
+            assert c.status == 200
+            return comp, await c.json()
+
+        comp, chat = _with_client(engine, fn)
+        lp = comp['choices'][0]['logprobs']
+        assert len(lp['top_logprobs']) == len(lp['tokens']) == 3
+        for i, top in enumerate(lp['top_logprobs']):
+            assert len(top) == 3
+            # Greedy: the chosen logprob equals the max of its top list.
+            assert lp['token_logprobs'][i] == pytest.approx(
+                max(top.values()), abs=1e-4)
+        content = chat['choices'][0]['logprobs']['content']
+        for entry in content:
+            assert len(entry['top_logprobs']) == 2
+            assert entry['logprob'] == pytest.approx(
+                max(t['logprob'] for t in entry['top_logprobs']),
+                abs=1e-4)
+
+    def test_streaming_logprobs_and_stop_strings(self, engine):
+        """logprobs ride SSE chunks (per-token), and stop STRINGS work
+        with stream=true: the emitted text is cut exactly where the
+        non-streamed request cuts it, and the stop string never leaks."""
+        async def fn(client):
+            full = await client.post('/v1/completions', json={
+                'prompt': 'abcabc', 'max_tokens': 6, 'temperature': 0,
+                'ignore_eos': True})
+            ftext = (await full.json())['choices'][0]['text']
+            stop = ftext[1:3]
+            want = ftext[:ftext.find(stop)] if stop and stop in ftext \
+                else ftext
+            r = await client.post('/v1/completions', json={
+                'prompt': 'abcabc', 'max_tokens': 6, 'temperature': 0,
+                'ignore_eos': True, 'stream': True, 'logprobs': 2,
+                'stop': [stop] if stop else None})
+            assert r.status == 200
+            text = ''
+            lp_count = 0
+            finishes = []
+            async for line in r.content:
+                line = line.decode().strip()
+                if not line.startswith('data: ') or line == 'data: [DONE]':
+                    continue
+                payload = json.loads(line[len('data: '):])
+                ch = payload['choices'][0]
+                text += ch.get('text') or ''
+                if ch.get('logprobs'):
+                    lp_count += len(ch['logprobs']['token_logprobs'])
+                    assert ch['logprobs']['top_logprobs'] is not None
+                if ch.get('finish_reason'):
+                    finishes.append(ch['finish_reason'])
+            return want, text, lp_count, finishes
+
+        want, text, lp_count, finishes = _with_client(engine, fn)
+        assert text == want
+        assert lp_count >= 1
+        assert finishes == ['stop']
 
     def test_logprobs_trim_to_stop_string_and_offsets(self, engine):
         """Stop-string truncation must trim the logprobs arrays too,
@@ -388,11 +462,35 @@ class TestEngine:
                 'prompt': [1, 2, 3, 4], 'max_tokens': 3, 'temperature': 0})
             assert ids.status == 200
             assert (await ids.json())['usage']['prompt_tokens'] == 4
-            # Garbage max_tokens / multi-prompt fail with 400s, never 500s.
+            # Garbage max_tokens / n out of range fail with 400s, never
+            # 500s.
             for payload in ({'prompt': 'x', 'max_tokens': None},
-                            {'prompt': ['a', 'b'], 'max_tokens': 2}):
+                            {'prompt': 'x', 'max_tokens': 2, 'n': 0},
+                            {'prompt': 'x', 'max_tokens': 2, 'n': 2,
+                             'best_of': 1}):
                 r = await client.post('/v1/completions', json=payload)
                 assert r.status == 400, payload
+            # BATCHED prompts (eval-harness style): one choice per
+            # prompt, in order, indexes 0..N-1.
+            multi = await client.post('/v1/completions', json={
+                'prompt': ['aa', 'bb'], 'max_tokens': 2,
+                'temperature': 0})
+            assert multi.status == 200
+            mbody = await multi.json()
+            assert [c['index'] for c in mbody['choices']] == [0, 1]
+            assert mbody['usage']['completion_tokens'] == 4
+            # n>1: n choices; greedy duplicates are fine.
+            nres = await client.post('/v1/completions', json={
+                'prompt': 'cc', 'max_tokens': 2, 'temperature': 0,
+                'n': 2})
+            assert nres.status == 200
+            assert len((await nres.json())['choices']) == 2
+            # best_of > n: candidates ranked by mean logprob, n kept.
+            bres = await client.post('/v1/completions', json={
+                'prompt': 'dd', 'max_tokens': 2, 'temperature': 0.8,
+                'n': 1, 'best_of': 3})
+            assert bres.status == 200
+            assert len((await bres.json())['choices']) == 1
             # SSE streaming (byte tokenizer): deltas concatenate to the
             # non-streamed text.
             ns = await client.post('/v1/completions', json={

@@ -403,11 +403,24 @@ class TestEngineHFServing:
             body = await r2.json()
             assert body['choices'][0]['text'] == ''
             assert body['choices'][0]['finish_reason'] == 'stop'
-            # stop strings + stream rejected loudly (not silently ignored)
+            # stop strings now stream too: the stop text never leaks
+            # and the stream finishes with 'stop' (consume it fully so
+            # no request stays in flight past this test).
             r3 = await client.post('/v1/completions', json={
-                'prompt': 'hello', 'max_tokens': 4, 'stream': True,
-                'stop': ['x']})
-            assert r3.status == 400
+                'prompt': 'hello', 'max_tokens': 6, 'temperature': 0,
+                'stream': True, 'stop': [full[0]]})
+            assert r3.status == 200
+            text, finishes = '', []
+            async for line in r3.content:
+                line = line.decode().strip()
+                if not line.startswith('data: ') or line == 'data: [DONE]':
+                    continue
+                ch = json.loads(line[len('data: '):])['choices'][0]
+                text += ch.get('text') or ''
+                if ch.get('finish_reason'):
+                    finishes.append(ch['finish_reason'])
+            assert text == ''
+            assert finishes == ['stop']
         _with_client(hf_engine, fn)
 
     def test_metrics_endpoint(self, hf_engine):

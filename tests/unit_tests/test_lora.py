@@ -234,6 +234,26 @@ class TestHFExportRoundTrip:
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-5, atol=1e-5)
 
+    def test_rope_scaling_config_roundtrip(self):
+        """_minimal_hf_config must serialize the frozen RopeScaling
+        dataclass (llama3 AND yarn incl. betas — wrong/missing betas
+        load cleanly in transformers and compute different RoPE
+        frequencies), and refuse unknown rope types loudly."""
+        l3 = hf_export._minimal_hf_config(
+            llama.LlamaConfig(rope_scaling=dict(factor=2.0)))
+        assert l3['rope_scaling']['rope_type'] == 'llama3'
+        assert l3['rope_scaling']['factor'] == 2.0
+        yarn = hf_export._minimal_hf_config(llama.LlamaConfig(
+            rope_scaling=dict(factor=4.0, rope_type='yarn',
+                              beta_fast=16.0, attention_factor=1.2)))
+        assert yarn['rope_scaling'] == {
+            'rope_type': 'yarn', 'factor': 4.0, 'beta_fast': 16.0,
+            'beta_slow': 1.0, 'original_max_position_embeddings': 8192,
+            'attention_factor': 1.2}
+        with pytest.raises(NotImplementedError, match='rope_type'):
+            hf_export._minimal_hf_config(llama.LlamaConfig(
+                rope_scaling=dict(factor=2.0, rope_type='zzz')))
+
     def test_non_dense_family_refused(self, tmp_path):
         cfg = models_lib.get_config('moe-debug')
         mod = models_lib.module_for(cfg)
