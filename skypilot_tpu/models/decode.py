@@ -311,6 +311,13 @@ def prefill_extend(params, tokens: jnp.ndarray, cfg: llama.LlamaConfig,
     prefill would produce (asserted bit-for-bit in tests). P and the S2
     bucket are static → one compile per (P, S2-bucket) pair; callers
     keep P to powers of two to bound the program count.
+
+    MoE configs route the FFN through the expert path (decode._ffn) —
+    exact equivalence with full prefill additionally requires expert
+    capacity not to bind (drops depend on how many tokens share a
+    dispatch group; a P+S2 split groups differently than one pass) —
+    the same batch-composition nondeterminism capacity-bound MoE
+    serving always has.
     """
     b, s2 = tokens.shape
     p = prefix_k.shape[2]

@@ -457,6 +457,63 @@ def prefill(params, tokens: jnp.ndarray, cfg: MLAConfig, max_len: int,
     return logits[:, 0], cache
 
 
+def prefill_extend(params, tokens: jnp.ndarray, cfg: MLAConfig,
+                   max_len: int, prefix_c: jnp.ndarray,
+                   prefix_kr: jnp.ndarray,
+                   lengths: Optional[jnp.ndarray] = None
+                   ) -> Tuple[jnp.ndarray, LatentCache]:
+    """Prefill a SUFFIX over a stored latent prefix (prefix caching for
+    the MLA/DeepSeek family — same contract as decode.prefill_extend,
+    but the snapshot is (c_kv, k_rope) latents instead of K/V heads, so
+    a cached chat history costs r+dr floats per token).
+
+    tokens [B, S2] (suffix, right-padded; `lengths` [B] real suffix
+    lengths), prefix_c [L, B, P, r], prefix_kr [L, B, P, dr] — every
+    row holds a FULL P-token prefix. Returns per-row last-content
+    logits and a LatentCache of [prefix ++ suffix] rows with length
+    P + lengths. Suffix queries run at positions P.. (rope + causal
+    offsets) attending [prefix ++ suffix] latents — exactly what full
+    prefill computes (asserted bit-for-bit in test_prefix_cache)."""
+    b, s2 = tokens.shape
+    p = prefix_c.shape[2]
+    if p + s2 > max_len:
+        raise ValueError(f'prefix ({p}) + suffix ({s2}) exceeds '
+                         f'max_len ({max_len})')
+    lengths = (jnp.full((b,), s2, jnp.int32) if lengths is None
+               else jnp.asarray(lengths, jnp.int32))
+    x = jnp.take(params['embed'], tokens, axis=0).astype(cfg.dtype)
+    sin, cos = rotary.rope_frequencies(cfg.qk_rope_head_dim,
+                                       jnp.arange(s2) + p,
+                                       cfg.rope_theta, cfg.rope_scaling)
+
+    def body(carry, xs):
+        lp, pc, pkr = xs
+        q_nope, q_rope, c_new, kr_new = _latents(carry, lp, cfg, sin, cos)
+        c_all = jnp.concatenate([pc.astype(c_new.dtype), c_new], axis=1)
+        kr_all = jnp.concatenate([pkr.astype(kr_new.dtype), kr_new],
+                                 axis=1)
+        out = _attend_latent(q_nope, q_rope, c_all, kr_all, lp, cfg,
+                             q_offset=p)
+        carry = carry + jnp.einsum('bsh,hd->bsd', out,
+                                   _d(lp['wo'], cfg.dtype))
+        carry = carry + _ffn(carry, lp, cfg)[0]
+        return carry, (c_new, kr_new)
+
+    x, (cs, krs) = jax.lax.scan(body, x,
+                                (params['layers'], prefix_c, prefix_kr))
+    pad3 = [(0, 0), (0, 0), (0, max_len - p - s2), (0, 0)]
+    cache = LatentCache(
+        c_kv=jnp.pad(jnp.concatenate([prefix_c, cs], axis=2), pad3),
+        k_rope=jnp.pad(jnp.concatenate([prefix_kr, krs], axis=2), pad3),
+        length=p + lengths)
+    x_last = jnp.take_along_axis(x, (lengths - 1)[:, None, None], axis=1)
+    x_last = norms.rms_norm(x_last, params['final_norm'], cfg.rms_eps)
+    head = (params['embed'].T if cfg.tie_embeddings else params['lm_head'])
+    logits = jnp.einsum('bsd,dv->bsv', x_last, head.astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits[:, 0], cache
+
+
 def decode_step(params, token: jnp.ndarray, cache: LatentCache,
                 cfg: MLAConfig,
                 active: Optional[jnp.ndarray] = None

@@ -145,11 +145,13 @@ def _parse_logprobs(body, chat: bool = False) -> Tuple[bool, int]:
         # the top-N list per position; N=0 (or boolean true, the legacy
         # extension) → chosen only.
         top_n = 0 if lp is True else int(lp)
+    if top_n < 0:
+        raise ValueError('logprobs/top_logprobs must be >= 0')
     if top_n > TOP_LOGPROBS_K:
         raise ValueError(f'top logprobs > {TOP_LOGPROBS_K} is not '
                          f'supported (the engine computes a fixed top-'
                          f'{TOP_LOGPROBS_K} per token)')
-    return True, max(top_n, 0)
+    return True, top_n
 
 
 def _completion_logprobs(tokenizer, out, lps, text, tops=None):
@@ -162,15 +164,21 @@ def _completion_logprobs(tokenizer, out, lps, text, tops=None):
     disagree with the joint text when a multi-byte char spans tokens,
     drifting text_offset. `tops` (optional, per-token
     [(token_id, logprob), ...]) fills OpenAI's top_logprobs dicts."""
+    from skypilot_tpu.data.tokenizer import StreamDecoder
+    dec = StreamDecoder(tokenizer)
+    # StreamDecoder holds back an incomplete multi-byte tail (U+FFFD)
+    # until the next token completes it — bare prefix decodes are NOT
+    # prefixes of each other across a split char, which would leak
+    # replacement chars into pieces and drift the offsets.
+    all_pieces = [dec.feed([t]) for t in out]
+    if all_pieces:
+        all_pieces[-1] += dec.flush()
     pieces, offsets, kept, top_out = [], [], [], []
     pos = 0
-    prev_len = 0
     for i, v in enumerate(lps):
         if pos >= len(text):
             break    # text fully covered (or cut to nothing)
-        cur = tokenizer.decode(out[:i + 1])
-        piece = cur[prev_len:]
-        prev_len = len(cur)
+        piece = all_pieces[i]
         pieces.append(piece)
         offsets.append(pos)
         kept.append(round(v, 6))
@@ -239,13 +247,27 @@ async def _submit_many(engine: InferenceEngine, prompts, max_new,
             f.cancel()
         raise
     all_res = await asyncio.gather(*futs)
+    # usage must count EVERY generated token, including discarded
+    # best_of candidates (OpenAI semantics; quota accounting reads it).
+    generated = sum(len(r[0]) for r in all_res)
     results = []
     for p in range(len(prompts)):
         cand = list(all_res[p * best_of:(p + 1) * best_of])
         if best_of > n:
             cand.sort(key=lambda r: -(sum(r[2]) / max(len(r[2]), 1)))
         results.extend(cand[:n])
-    return results
+    return results, generated
+
+
+def _stop_scan(text: str, stops: List[str]) -> Optional[int]:
+    """Earliest stop-string match index in `text`, or None — the ONE
+    scan both the stream (holdback) and non-stream paths use."""
+    cut = None
+    for s in stops:
+        i = text.find(s)
+        if i >= 0 and (cut is None or i < cut):
+            cut = i
+    return cut
 
 
 def _truncate_at_stop_strings(text: str, stop) -> Tuple[str, bool]:
@@ -253,13 +275,10 @@ def _truncate_at_stop_strings(text: str, stop) -> Tuple[str, bool]:
     if stop is None:
         return text, False
     stops = [stop] if isinstance(stop, str) else list(stop)
-    cut = None
     for s in stops:
         if not isinstance(s, str) or not s:
             raise ValueError('stop must be a string or list of strings')
-        i = text.find(s)
-        if i >= 0 and (cut is None or i < cut):
-            cut = i
+    cut = _stop_scan(text, stops)
     if cut is None:
         return text, False
     return text[:cut], True
@@ -591,15 +610,18 @@ class InferenceEngine:
             return first, first_lp, ti, tv, cache, rng
 
         @functools.partial(jax.jit, donate_argnums=(1,))
-        def admit_extend(params, cache, prefix_k, prefix_v, tokens,
+        def admit_extend(params, cache, prefix_a, prefix_b, tokens,
                          length, slot, temp, topk, topp, rng):
             """Prefix-cache admit (single request): prefill only the
-            SUFFIX over a stored prefix KV (decode.prefill_extend).
-            One compile per (prefix length, suffix bucket) pair —
-            prefixes are snapshotted at power-of-two lengths."""
-            logits, row = decode_lib.prefill_extend(
-                params, tokens, cfg, max_len, prefix_k[:, None],
-                prefix_v[:, None], lengths=length[None])
+            SUFFIX over a stored prefix snapshot — (k, v) rows for the
+            KVCache families (dense AND MoE: decode.prefill_extend
+            routes the FFN through the expert path), (c_kv, k_rope)
+            latents for MLA (mla.prefill_extend). One compile per
+            (prefix length, suffix bucket) pair — prefixes are
+            snapshotted at power-of-two lengths."""
+            logits, row = dec.prefill_extend(
+                params, tokens, cfg, max_len, prefix_a[:, None],
+                prefix_b[:, None], lengths=length[None])
 
             def write(big, one):
                 if big.ndim == 1:
@@ -769,12 +791,13 @@ class InferenceEngine:
         return best
 
     def _prefix_capture(self, tokens, slot) -> None:
-        """Snapshot this slot's first pow2-many KV rows under the token
-        prefix key (device-side slice — owns its buffer, so later cache
-        donation can't invalidate it)."""
+        """Snapshot this slot's first pow2-many cache rows under the
+        token prefix key (device-side slice — owns its buffer, so later
+        cache donation can't invalidate it). The snapshot pair is
+        (k, v) for KVCache families, (c_kv, k_rope) latents for MLA —
+        whatever the family's prefill_extend takes."""
         if (PREFIX_CACHE_ENTRIES <= 0 or
-                len(tokens) < PREFIX_MIN_TOKENS or
-                not hasattr(self.cache, 'k')):      # dense KVCache only
+                len(tokens) < PREFIX_MIN_TOKENS):
             return
         p = PREFIX_MIN_TOKENS
         while p * 2 <= len(tokens):
@@ -783,8 +806,12 @@ class InferenceEngine:
         if key in self._prefix_store:
             self._prefix_store.move_to_end(key)
             return
-        self._prefix_store[key] = (self.cache.k[:, slot, :p],
-                                   self.cache.v[:, slot, :p])
+        if hasattr(self.cache, 'k'):
+            self._prefix_store[key] = (self.cache.k[:, slot, :p],
+                                       self.cache.v[:, slot, :p])
+        else:
+            self._prefix_store[key] = (self.cache.c_kv[:, slot, :p],
+                                       self.cache.k_rope[:, slot, :p])
         while len(self._prefix_store) > PREFIX_CACHE_ENTRIES:
             self._prefix_store.popitem(last=False)
 
@@ -858,13 +885,29 @@ class InferenceEngine:
         jnp = self._jnp
         # self.warm gate: warmup's synthetic prompts share prefixes
         # across buckets — a warmup hit would skip compiling the very
-        # grouped-admit programs warmup exists to build.
-        if (len(items) == 1 and self.warm and self._decode_is_dense()
-                and PREFIX_CACHE_ENTRIES > 0):
-            p = self._prefix_match(items[0][0])
-            if p is not None:
-                self._admit_with_prefix(items[0], p)
+        # grouped-admit programs warmup exists to build. A BURST of
+        # same-prefix requests splits: hits ride the suffix-only path
+        # one by one, the rest prefill grouped — exactly the
+        # prefix-affinity LB's target traffic shape.
+        if self.warm and PREFIX_CACHE_ENTRIES > 0:
+            rest = []
+            for item in items:
+                p = self._prefix_match(item[0])
+                if p is not None:
+                    self._admit_with_prefix(item, p)
+                else:
+                    rest.append(item)
+            if not rest:
                 return
+            if len(rest) != len(items):
+                # Re-split the misses into power-of-two group sizes
+                # (the compile-count bound); re-entry takes the grouped
+                # path — or the hit path, if an earlier hit's re-capture
+                # made a miss match.
+                for group in self._admit_groups(rest):
+                    self._admit_group(group)
+                return
+            items = rest
         bucket = _bucket(len(items[0][0]))
         slots, padded, lengths = [], [], []
         temps, topks, topps = [], [], []
@@ -904,12 +947,8 @@ class InferenceEngine:
             self._finish_admit(item, slots[i], int(first[i]),
                                float(first_lp[i]),
                                _tops_list(tis[i], tvs[i]))
-            if self.warm and self._decode_is_dense():
+            if self.warm:
                 self._prefix_capture(item[0], slots[i])
-
-    def _decode_is_dense(self) -> bool:
-        from skypilot_tpu.models import decode as decode_lib
-        return self._decode is decode_lib
 
     def _free_slot_excluding(self, taken) -> Optional[int]:
         for i, s in enumerate(self.slots):
@@ -1131,16 +1170,6 @@ def _check_len(engine: InferenceEngine, tokens: List[int],
         return (f'bucketed prompt ({_bucket(len(tokens))}) + max new '
                 f'tokens exceeds max_len {engine.max_len}')
     return None
-
-
-def _stop_scan(text: str, stops: List[str]) -> Optional[int]:
-    """Earliest stop-string match index in `text`, or None."""
-    cut = None
-    for s in stops:
-        i = text.find(s)
-        if i >= 0 and (cut is None or i < cut):
-            cut = i
-    return cut
 
 
 async def _sse_response(request, engine: InferenceEngine,
@@ -1422,13 +1451,12 @@ def build_app(engine: InferenceEngine):
                                        top_n=top_n)
 
         try:
-            results = await _submit_many(engine, prompts, max_new,
-                                         sampling, stop_ids, n, best_of)
+            results, total_out = await _submit_many(
+                engine, prompts, max_new, sampling, stop_ids, n, best_of)
         except EngineOverloaded as e:
             return _openai_error(web, str(e), status=429,
                                  err_type='overloaded_error')
         choices = []
-        total_out = 0
         for idx, (out, finish, lps, tops) in enumerate(results):
             text = engine.tokenizer.decode(out)
             text, cut = _truncate_at_stop_strings(text, stop_strings)
@@ -1439,7 +1467,6 @@ def build_app(engine: InferenceEngine):
                 lp_obj = _completion_logprobs(
                     engine.tokenizer, out, lps, text,
                     tops=[t[:top_n] for t in tops] if top_n else None)
-            total_out += len(out)
             choices.append({'text': text, 'index': idx,
                             'logprobs': lp_obj, 'finish_reason': finish})
         n_prompt = sum(len(t) for t in prompts)
@@ -1534,13 +1561,12 @@ def build_app(engine: InferenceEngine):
                                        top_n=top_n)
 
         try:
-            results = await _submit_many(engine, [tokens], max_new,
-                                         sampling, stop_ids, n, n)
+            results, total_out = await _submit_many(
+                engine, [tokens], max_new, sampling, stop_ids, n, n)
         except EngineOverloaded as e:
             return _openai_error(web, str(e), status=429,
                                  err_type='overloaded_error')
         choices = []
-        total_out = 0
         for idx, (out, finish, lps, tops) in enumerate(results):
             text = engine.tokenizer.decode(out)
             text, cut = _truncate_at_stop_strings(text, stop_strings)
@@ -1564,7 +1590,6 @@ def build_app(engine: InferenceEngine):
                             flat['top_logprobs'][j].items()]
                     content.append(entry)
                 lp_obj = {'content': content}
-            total_out += len(out)
             choices.append({'index': idx,
                             'message': {'role': 'assistant',
                                         'content': text},
