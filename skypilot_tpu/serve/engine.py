@@ -71,6 +71,24 @@ PREFIX_MIN_TOKENS = 64
 # weight reads, and it keeps the compiled-variant count flat (a
 # per-request flag would double every step/admit program).
 TOP_LOGPROBS_K = 5
+# Speculative decoding: propose this many tokens per verify round via
+# prompt-lookup self-drafting (0 disables). One K-wide verify_step
+# costs about one decode step (HBM weight reads dominate), so every
+# accepted token is a nearly-free TPOT win; outputs stay EXACTLY the
+# greedy decode's (the speculative guarantee — pin-tested).
+SPEC_K = int(os.environ.get('SKYTPU_ENGINE_SPEC_K', '4'))
+# Longest n-gram matched against the row's own context when drafting.
+SPEC_NGRAM = 3
+# Only the trailing window of a row's context is scanned for draft
+# matches — the scan is host-side Python on the latency-critical loop.
+SPEC_LOOKUP_WINDOW = 512
+# Adaptive backoff: when a round's accept fraction drops below
+# SPEC_MIN_ACCEPT, speculation pauses for SPEC_COOLDOWN rounds (the
+# fused-chunk path amortizes dispatch better when drafts keep missing),
+# then re-probes — traffic whose text stops repeating stops paying for
+# speculation automatically.
+SPEC_MIN_ACCEPT = 0.25
+SPEC_COOLDOWN = int(os.environ.get('SKYTPU_ENGINE_SPEC_COOLDOWN', '16'))
 
 
 class EngineOverloaded(Exception):
@@ -290,6 +308,30 @@ def _tops_list(ti, tv) -> list:
     return [(int(i), float(v)) for i, v in zip(ti, tv)]
 
 
+def _lookup_draft(ctx: List[int], k: int) -> Optional[List[int]]:
+    """Prompt-lookup drafting (the self-draft in speculative decoding):
+    find the most recent earlier occurrence of the context's trailing
+    n-gram and propose the tokens that followed it. Free (host-side, no
+    draft model), and strong exactly where speculation pays — chat/RAG/
+    summarization traffic that re-states its own context. Returns up to
+    k proposals, or None when the context never repeats."""
+    if len(ctx) > SPEC_LOOKUP_WINDOW:
+        ctx = ctx[-SPEC_LOOKUP_WINDOW:]
+    for n in (SPEC_NGRAM, 2):
+        if len(ctx) < n + 1:
+            continue
+        key = ctx[-n:]
+        # Most recent prior occurrence (scan backwards, skip the
+        # trailing match itself).
+        for i in range(len(ctx) - n - 1, -1, -1):
+            if ctx[i:i + n] == key:
+                cont = ctx[i + n:i + n + k]
+                if cont:
+                    return cont
+                break
+    return None
+
+
 def _bucket(n: int, floor: int = 16) -> int:
     """Round up to a power of two (bounded compile count; shared
     contract lives in models/decode.bucket_size)."""
@@ -391,6 +433,18 @@ class InferenceEngine:
         self.tokens_generated = 0
         self.requests_total = 0
         self.rejected_total = 0
+        # Speculative decoding (prompt-lookup self-draft + K-wide
+        # verify). Greedy dense-family rows only: the exactness
+        # guarantee needs verify_step ≡ sequential decode (MoE capacity
+        # grouping breaks that; sampling rows would need rejection
+        # sampling), and MLA has no verify_step yet.
+        from skypilot_tpu.models import moe as moe_lib
+        self.spec_k = (SPEC_K if self._decode is decode_lib and
+                       not isinstance(self.cfg, moe_lib.MoEConfig) else 0)
+        self.spec_rounds = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self._spec_cool = 0
 
     def _setup_mesh(self, mesh, quantize: Optional[str]) -> None:
         """Place params on a named mesh with the family's sharding rules;
@@ -636,9 +690,28 @@ class InferenceEngine:
             tv, ti = top5(logits)
             return first[0], first_lp[0], ti[0], tv[0], cache, rng
 
+        @functools.partial(jax.jit, donate_argnums=(1,))
+        def spec_verify(params, cache, fed):
+            """One K-wide speculative verify over the WHOLE slot pool:
+            fed [B, K] = per-row [last, d1..d_{K-1}]. Returns the
+            target's greedy token, its logprob and top-5 at every
+            position; KV for the fed tokens is written at each row's
+            offset but `length` does NOT advance — the host commits the
+            accepted run (+1 correction) by bumping length, so rollback
+            is free (decode.verify_step's contract)."""
+            logits, cache2 = dec.verify_step(params, fed, cache, cfg)
+            logits = logits.astype(jnp.float32)          # [B, K, V]
+            greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            lp = (jnp.take_along_axis(logits, greedy[..., None],
+                                      axis=-1)[..., 0] - lse)
+            tv, ti = top5(logits)
+            return greedy, lp, ti, tv, cache2
+
         self._step_jit = step
         self._admit_jit = admit
         self._admit_extend_jit = admit_extend
+        self._spec_jit = spec_verify
         self._state_ready = True
 
     @staticmethod
@@ -674,6 +747,14 @@ class InferenceEngine:
         self.pres[:] = 1.0
         self._step_once()      # k = 1, use_pen
         self.pres[:] = 0.0
+        if self.spec_k > 0:
+            # Compile the speculative verify program (garbage fed/KV is
+            # fine: length does not advance, and every later step
+            # overwrites its own slot before attending it).
+            *_, self.cache = self._spec_jit(
+                self.params, self.cache,
+                self._jnp.zeros((MAX_BATCH, self.spec_k),
+                                self._jnp.int32))
         self.slots = [None] * MAX_BATCH
         for size in self._group_sizes()[1:]:
             self._admit_group([warm_item] * size)
@@ -856,12 +937,14 @@ class InferenceEngine:
     def _finish_admit(self, item, slot: int, first: int,
                       first_lp: float = 0.0,
                       first_tops: Optional[list] = None) -> None:
-        (_, max_new, _, _, _, _, _, stop_ids, stream_q, fut) = item
+        (tokens, max_new, _, _, _, _, _, stop_ids, stream_q, fut) = item
         self.last[slot] = first
         stop = frozenset(stop_ids or ())
+        # ctx = prompt ++ generated: the prompt-lookup draft source AND
+        # the host mirror of the row's cache length (len(ctx) - 1).
         entry = {'fut': fut, 'want': max_new, 'out': [], 'lps': [],
                  'tops': [], 'stop': stop, 'stream': stream_q, 'sent': 0,
-                 'finish': None}
+                 'finish': None, 'ctx': list(tokens) + [first]}
         if first in stop:
             entry['finish'] = 'stop'
         else:
@@ -957,16 +1040,127 @@ class InferenceEngine:
         return None
 
     @timeline.event
+    def _spec_once(self) -> bool:
+        """Try ONE speculative round over the pool; False → caller runs
+        the normal step. Preconditions (all checked here): the dense
+        family, every active row greedy, no penalties, at least one row
+        with a prompt-lookup draft, and K more cache slots free on every
+        active row (an out-of-bounds scatter would clamp onto valid KV).
+
+        Rows WITHOUT a draft still commit exactly one token (the
+        correction IS the target's next greedy token), so a mixed pool
+        pays one verify call and nobody stalls. Outputs are exactly the
+        non-speculative greedy outputs — acceptance only changes how
+        many tokens commit per device call.
+
+        The headroom check is POOL-WIDE by design: verify_step writes K
+        slots on EVERY row (a clamped out-of-bounds scatter would
+        corrupt a tight row's last valid KV), and shrinking K per-round
+        would compile fresh programs from traffic shapes — so one
+        near-limit row pauses speculation until it finishes. Low accept
+        rates pause it too (SPEC_MIN_ACCEPT/SPEC_COOLDOWN): the fused
+        chunk path amortizes dispatch better when drafts keep missing."""
+        import jax
+        import numpy as np
+        jnp = self._jnp
+        k = self.spec_k
+        # warm gate: warmup's _step_once calls each compile a SPECIFIC
+        # step variant — a spec round hijacking one would skip it (the
+        # spec program itself is compiled explicitly in warmup).
+        if k <= 0 or not self.warm:
+            return False
+        if self._spec_cool > 0:
+            self._spec_cool -= 1
+            return False
+        active_idx = [i for i, s in enumerate(self.slots)
+                      if s is not None and s['finish'] is None]
+        if not active_idx:
+            return False
+        if any(self.temp[i] > 0 for i in active_idx):
+            return False
+        if self.pres.any() or self.freq.any():
+            return False
+        drafts = {}
+        real_len = {}
+        for i in active_idx:
+            ctx = self.slots[i]['ctx']
+            if len(ctx) - 1 + k > self.max_len:
+                return False
+            d = _lookup_draft(ctx, k)
+            if d:
+                real_len[i] = len(d)
+                drafts[i] = (d + [0] * k)[:k]
+        if not drafts:
+            return False
+        fed = np.zeros((MAX_BATCH, k), np.int32)
+        for i in active_idx:
+            fed[i, 0] = self.last[i]
+            fed[i, 1:] = (drafts[i][:k - 1] if i in drafts
+                          else [self.last[i]] * (k - 1))
+        greedy, lps, tis, tvs, self.cache = self._spec_jit(
+            self.params, self.cache, jnp.asarray(fed))
+        greedy = jax.device_get(greedy)          # [B, K]
+        lps = jax.device_get(lps)
+        tis, tvs = jax.device_get(tis), jax.device_get(tvs)
+        self.step_count += 1
+        self.spec_rounds += 1
+        adv = np.zeros((MAX_BATCH,), np.int32)
+        round_prop = round_acc = 0
+        for i in active_idx:
+            s = self.slots[i]
+            prop = drafts.get(i, [int(self.last[i])] * k)
+            a = 0
+            while a < k and prop[a] == int(greedy[i][a]):
+                a += 1
+            if i in drafts:
+                # Metrics count only REAL proposals (padding past a
+                # short draft isn't a proposal, and a coincidental
+                # pad-token accept isn't an accepted draft).
+                round_prop += real_len[i]
+                round_acc += min(a, real_len[i])
+            row = (prop[:a] + [int(greedy[i][a])]) if a < k else prop[:k]
+            # Cache length advances by the FULL committed run (KV for
+            # row[:-1] was just written; row[-1] is the new `last`,
+            # whose KV the next step writes — the standing invariant).
+            adv[i] = len(row)
+            self.last[i] = row[-1]
+            for j, tok in enumerate(row):
+                if s['finish'] is not None:
+                    break
+                if tok in s['stop']:
+                    s['finish'] = 'stop'
+                    break
+                s['out'].append(tok)
+                s['lps'].append(float(lps[i][j]))
+                s['tops'].append(_tops_list(tis[i][j], tvs[i][j]))
+                s['ctx'].append(tok)
+                self.tokens_generated += 1
+                if len(s['out']) >= s['want']:
+                    s['finish'] = 'length'
+        self.cache = type(self.cache)(
+            k=self.cache.k, v=self.cache.v,
+            length=self.cache.length + jnp.asarray(adv))
+        self.spec_proposed += round_prop
+        self.spec_accepted += round_acc
+        if round_prop and round_acc < SPEC_MIN_ACCEPT * round_prop:
+            self._spec_cool = SPEC_COOLDOWN
+        return True
+
+    @timeline.event
     def _step_once(self) -> None:
         """Decode step(s) over the whole slot pool (device work).
 
-        Steps MAX_STEP_CHUNK tokens per device call when nothing is
-        waiting to join (the per-call host dispatch is the continuous
-        batcher's overhead); drops back to single steps under admission
-        pressure. A request arriving mid-call therefore waits at most one
-        in-flight fused call (up to MAX_STEP_CHUNK steps) to join."""
+        A speculative round runs instead whenever it applies
+        (_spec_once); otherwise steps MAX_STEP_CHUNK tokens per device
+        call when nothing is waiting to join (the per-call host
+        dispatch is the continuous batcher's overhead); drops back to
+        single steps under admission pressure. A request arriving
+        mid-call therefore waits at most one in-flight fused call (up
+        to MAX_STEP_CHUNK steps) to join."""
         import jax
         jnp = self._jnp
+        if self._spec_once():
+            return
         remaining = [s['want'] - len(s['out']) for s in self.slots
                      if s is not None]
         # k ∈ {1, MAX_STEP_CHUNK} ONLY: exactly two compiled step
@@ -1006,6 +1200,7 @@ class InferenceEngine:
                 s['out'].append(tok)
                 s['lps'].append(float(lps[t][i]))
                 s['tops'].append(_tops_list(tis[t][i], tvs[t][i]))
+                s['ctx'].append(tok)
                 self.tokens_generated += 1
                 if len(s['out']) >= s['want']:
                     s['finish'] = 'length'
@@ -1336,6 +1531,12 @@ def build_app(engine: InferenceEngine):
             f'skytpu_engine_prefix_hits_total {engine.prefix_hits}',
             '# TYPE skytpu_engine_rejected_total counter',
             f'skytpu_engine_rejected_total {engine.rejected_total}',
+            '# TYPE skytpu_engine_spec_rounds_total counter',
+            f'skytpu_engine_spec_rounds_total {engine.spec_rounds}',
+            '# TYPE skytpu_engine_spec_proposed_total counter',
+            f'skytpu_engine_spec_proposed_total {engine.spec_proposed}',
+            '# TYPE skytpu_engine_spec_accepted_total counter',
+            f'skytpu_engine_spec_accepted_total {engine.spec_accepted}',
         ]
         return web.Response(text='\n'.join(lines) + '\n',
                             content_type='text/plain')
