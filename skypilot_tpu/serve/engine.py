@@ -348,7 +348,8 @@ class InferenceEngine:
                  tokenizer_path: Optional[str] = None,
                  max_len: Optional[int] = None,
                  quantize: Optional[str] = None,
-                 mesh: Optional[Any] = None):
+                 mesh: Optional[Any] = None,
+                 seed: Optional[int] = None):
         import jax
         import jax.numpy as jnp
         from skypilot_tpu.data import tokenizer as tokenizer_lib
@@ -445,6 +446,14 @@ class InferenceEngine:
         self.spec_proposed = 0
         self.spec_accepted = 0
         self._spec_cool = 0
+        # Multi-host mirroring (serve/multihost.py): the leader
+        # broadcasts device-touching ops here; None everywhere else.
+        # `seed` pins the sampling RNG — REQUIRED for multi-host (every
+        # process must draw identical samples) and handy for tests.
+        self._ctrl = None
+        self._seed = seed
+        self._resets = 0
+        self._pending_cancels: List[Any] = []
 
     def _setup_mesh(self, mesh, quantize: Optional[str]) -> None:
         """Place params on a named mesh with the family's sharding rules;
@@ -545,7 +554,10 @@ class InferenceEngine:
                              self._decode.cache_pspecs(self.cfg),
                              is_leaf=lambda x: isinstance(
                                  x, PartitionSpec)))
-        self.rng = jax.random.PRNGKey(int(time.time_ns()) % (2**31))
+        base = (self._seed if self._seed is not None
+                else int(time.time_ns()) % (2**31))
+        self.rng = jax.random.PRNGKey((base + self._resets) % (2**31))
+        self._resets += 1
         self.slots: List[Optional[Dict[str, Any]]] = [None] * MAX_BATCH
         self.last = np.zeros(MAX_BATCH, np.int32)
         self.temp = np.zeros(MAX_BATCH, np.float32)
@@ -589,6 +601,21 @@ class InferenceEngine:
             v, i = jax.lax.top_k(logits, TOP_LOGPROBS_K)
             return (v - lse).astype(jnp.float32), i.astype(jnp.int32)
 
+        if self.mesh is not None:
+            # Host-read outputs (tokens/logprobs/top-K) replicate over
+            # the mesh: on a MULTI-HOST mesh a partially-sharded output
+            # is not fully addressable, so device_get would fail —
+            # and every process must read identical values to keep the
+            # mirrored host state in lockstep. Tiny arrays; free.
+            from jax.sharding import NamedSharding, PartitionSpec
+            _repl_sh = NamedSharding(self.mesh, PartitionSpec())
+
+            def repl(x):
+                return jax.lax.with_sharding_constraint(x, _repl_sh)
+        else:
+            def repl(x):
+                return x
+
         def step_k(k, use_pen):
             """k decode steps in ONE device call (host-loop dispatch cost
             amortized when no request is waiting to join). Compiled per
@@ -624,7 +651,8 @@ class InferenceEngine:
                     jax.lax.scan(body, (last, cache, counts, rng), None,
                                  length=k)
                 del last_f
-                return toks, lps, tis, tvs, cache_f, counts_f, rng_f
+                return (repl(toks), repl(lps), repl(tis), repl(tvs),
+                        cache_f, counts_f, rng_f)
             return run
 
         self._step_k_jits = {}
@@ -661,7 +689,8 @@ class InferenceEngine:
                 logits, temps, topks, topps, sub)
             first_lp = decode_lib.chosen_logprob(logits, first)
             tv, ti = top5(logits)
-            return first, first_lp, ti, tv, cache, rng
+            return (repl(first), repl(first_lp), repl(ti), repl(tv),
+                    cache, rng)
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def admit_extend(params, cache, prefix_a, prefix_b, tokens,
@@ -688,7 +717,8 @@ class InferenceEngine:
                 logits, temp[None], topk[None], topp[None], sub)
             first_lp = decode_lib.chosen_logprob(logits, first)
             tv, ti = top5(logits)
-            return first[0], first_lp[0], ti[0], tv[0], cache, rng
+            return (repl(first[0]), repl(first_lp[0]), repl(ti[0]),
+                    repl(tv[0]), cache, rng)
 
         @functools.partial(jax.jit, donate_argnums=(1,))
         def spec_verify(params, cache, fed):
@@ -706,7 +736,7 @@ class InferenceEngine:
             lp = (jnp.take_along_axis(logits, greedy[..., None],
                                       axis=-1)[..., 0] - lse)
             tv, ti = top5(logits)
-            return greedy, lp, ti, tv, cache2
+            return repl(greedy), repl(lp), repl(ti), repl(tv), cache2
 
         self._step_jit = step
         self._admit_jit = admit
@@ -832,18 +862,40 @@ class InferenceEngine:
                                  frequency_penalty, stop_ids=stop_ids)
         return await fut
 
+    def _bcast(self, op) -> None:
+        """Leader→follower control broadcast (multi-host serving);
+        no-op everywhere else. Sent BEFORE the leader executes the op
+        so every process enters the same collective in the same
+        order."""
+        if self._ctrl is not None:
+            self._ctrl.send(op)
+
     def cancel(self, fut) -> None:
-        """Abort the in-flight request owning `fut`: mark it finished so
-        the next _publish frees the slot (the SSE path cuts generation
-        short when a stop STRING matches mid-stream — without this the
-        slot would decode to max_tokens after the client stopped
-        listening). No-op if the request is still queued or already
+        """Abort the in-flight request owning `fut` (the SSE path cuts
+        generation short when a stop STRING matches mid-stream —
+        without this the slot would decode to max_tokens after the
+        client stopped listening). DEFERRED: the batch loop applies
+        cancels at its loop top, so the state mutation lands at a
+        well-defined point between device calls — never racing the
+        in-flight step thread, and broadcast to multi-host followers in
+        op order. No-op if the request is still queued or already
         done."""
-        for s in self.slots:
-            if s is not None and s['fut'] is fut:
-                if s['finish'] is None:
-                    s['finish'] = 'stop'
-                return
+        self._pending_cancels.append(fut)
+
+    def _process_cancels(self) -> None:
+        """Apply deferred cancels (batch-loop top: between device ops).
+        Marks only — the slot frees at the NEXT _publish, the same
+        point in the op stream where followers reap."""
+        if not self._pending_cancels:
+            return
+        for fut in self._pending_cancels:
+            for i, s in enumerate(self.slots):
+                if s is not None and s['fut'] is fut:
+                    if s['finish'] is None:
+                        s['finish'] = 'stop'
+                        self._bcast(('cancel', i))
+                    break
+        self._pending_cancels.clear()
 
     def _free_slot(self) -> Optional[int]:
         return self._free_slot_excluding(())
@@ -1146,8 +1198,21 @@ class InferenceEngine:
             self._spec_cool = SPEC_COOLDOWN
         return True
 
+    def _choose_k(self) -> int:
+        """Step width for the next fused call. k ∈ {1, MAX_STEP_CHUNK}
+        ONLY: exactly two compiled step programs, both built in warmup —
+        a client-chosen max_new must not be able to trigger a fresh XLA
+        compile via tail-chunk sizes. Leader-only inputs (the admission
+        queue) feed this, so multi-host broadcasts the chosen k."""
+        remaining = [s['want'] - len(s['out']) for s in self.slots
+                     if s is not None]
+        if (remaining and min(remaining) >= MAX_STEP_CHUNK and
+                (self._queue is None or self._queue.empty())):
+            return MAX_STEP_CHUNK
+        return 1
+
     @timeline.event
-    def _step_once(self) -> None:
+    def _step_once(self, k_force: Optional[int] = None) -> None:
         """Decode step(s) over the whole slot pool (device work).
 
         A speculative round runs instead whenever it applies
@@ -1156,20 +1221,14 @@ class InferenceEngine:
         dispatch is the continuous batcher's overhead); drops back to
         single steps under admission pressure. A request arriving
         mid-call therefore waits at most one in-flight fused call (up
-        to MAX_STEP_CHUNK steps) to join."""
+        to MAX_STEP_CHUNK steps) to join. `k_force`: multi-host
+        followers mirror the leader's choice instead of reading their
+        (nonexistent) queue."""
         import jax
         jnp = self._jnp
         if self._spec_once():
             return
-        remaining = [s['want'] - len(s['out']) for s in self.slots
-                     if s is not None]
-        # k ∈ {1, MAX_STEP_CHUNK} ONLY: exactly two compiled step
-        # programs, both built in warmup — a client-chosen max_new must
-        # not be able to trigger a fresh XLA compile via tail-chunk sizes.
-        k = 1
-        if (remaining and min(remaining) >= MAX_STEP_CHUNK and
-                (self._queue is None or self._queue.empty())):
-            k = MAX_STEP_CHUNK
+        k = k_force if k_force is not None else self._choose_k()
         active = jnp.asarray([s is not None for s in self.slots])
         use_pen = bool(self.pres.any() or self.freq.any())
         toks, lps, tis, tvs, self.cache, self.counts, self.rng = \
@@ -1208,7 +1267,10 @@ class InferenceEngine:
     def _publish(self) -> None:
         """Push new tokens to streaming consumers and resolve finished
         slots (runs on the event loop, between device calls — stream
-        queues are plain asyncio objects, never touched from a thread)."""
+        queues are plain asyncio objects, never touched from a thread).
+        Multi-host: the leader broadcasts ('reap',) so followers free
+        the same slots at the same point in the op stream."""
+        self._bcast(('reap',))
         for i, s in enumerate(self.slots):
             if s is None:
                 continue
@@ -1271,6 +1333,9 @@ class InferenceEngine:
         items = [it for it in items
                  if it[-1] is None or not it[-1].done()]
         for group in self._admit_groups(items):
+            if self._ctrl is not None:
+                from skypilot_tpu.serve import multihost
+                self._bcast(('admit', multihost.strip_items(group)))
             try:
                 await asyncio.to_thread(self._admit_group, group)
             except Exception as e:  # pylint: disable=broad-except
@@ -1284,6 +1349,7 @@ class InferenceEngine:
         ONE device call (grouped admission)."""
         self._ensure_state()
         while True:
+            self._process_cancels()
             busy = any(s is not None for s in self.slots)
             if not busy:
                 item = await self._queue.get()
@@ -1293,8 +1359,10 @@ class InferenceEngine:
             if self._free_slot() is not None and not self._queue.empty():
                 await self._admit_pending()
             self._publish()             # first tokens stream immediately
+            k = self._choose_k()
+            self._bcast(('step', k))
             try:
-                await asyncio.to_thread(self._step_once)
+                await asyncio.to_thread(self._step_once, k)
             except Exception as e:  # pylint: disable=broad-except
                 self._fail_all(e)
                 continue
@@ -1306,6 +1374,10 @@ class InferenceEngine:
         unusable (see _reset_device_state)."""
         logger.warning(f'Engine step/admit failed; resetting slot pool: '
                        f'{e}')
+        # Followers hit the same failure executing the same op; this
+        # tells them to rebuild device state in lockstep with us
+        # (no-op on followers — their _ctrl is None).
+        self._bcast(('reset',))
 
         def fail(fut, stream_q):
             if stream_q is not None:
@@ -1861,21 +1933,65 @@ def main() -> None:
                         help="Comma-separated prompt buckets to pre-"
                              "compile, or 'all' (guarantees no request "
                              'ever hits a fresh XLA compile).')
+    # Multi-host serving: one replica spanning a whole (multi-host)
+    # slice, like the reference's multi-host vLLM/JetStream replicas.
+    # Defaults come from the gang env the slice driver exports, so a
+    # multi-host `skytpu serve up` needs no extra flags.
+    parser.add_argument('--coordinator',
+                        default=os.environ.get(
+                            'SKYTPU_COORDINATOR_ADDRESS'),
+                        help='jax.distributed coordinator host:port '
+                             '(multi-host serving).')
+    parser.add_argument('--num-processes', type=int,
+                        default=int(os.environ.get(
+                            'SKYTPU_NUM_PROCESSES', '1')))
+    parser.add_argument('--process-id', type=int,
+                        default=int(os.environ.get(
+                            'SKYTPU_NODE_RANK', '0')))
+    parser.add_argument('--seed', type=int, default=None,
+                        help='Pin the sampling RNG (multi-host sets '
+                             'this automatically).')
     parser.add_argument('--port', type=int,
                         default=int(os.environ.get('SKYTPU_SERVE_PORT',
                                                    '8000')))
     parser.add_argument('--host', default='0.0.0.0')
     args = parser.parse_args()
+    multihost_on = bool(args.coordinator) and args.num_processes > 1
+    seed = args.seed
+    if multihost_on:
+        from skypilot_tpu.serve import multihost
+        multihost.init_distributed(args.coordinator, args.num_processes,
+                                   args.process_id)
+        if not args.mesh:
+            raise ValueError('multi-host serving needs --mesh spanning '
+                             'the global device count (e.g. tensor=8 '
+                             'on a 2-host v5e-8... slice).')
+        if seed is None:
+            # Every process in THIS gang must draw identical samples,
+            # but replicas/restarts must not correlate: the leader
+            # draws a fresh seed and ships it in the warmup op;
+            # followers get a placeholder that op overwrites.
+            seed = (int(time.time_ns()) % (2**31) if args.process_id == 0
+                    else 0)
     engine = InferenceEngine(args.model or (None if args.hf_dir
                                             else 'llama-1b'),
                              ckpt_dir=args.ckpt_dir, hf_dir=args.hf_dir,
                              tokenizer_path=args.tokenizer,
                              max_len=args.max_len, quantize=args.quantize,
-                             mesh=args.mesh)
+                             mesh=args.mesh, seed=seed)
     if args.warm_buckets == 'all':
         buckets = engine.all_buckets()
     else:
         buckets = [int(b) for b in args.warm_buckets.split(',') if b]
+    if multihost_on and args.process_id != 0:
+        # Follower: mirror the leader's ops forever (warmup arrives as
+        # the first control op); no HTTP frontend.
+        multihost.follower_serve(engine, args.coordinator)
+        return
+    if multihost_on:
+        engine._ctrl = multihost.ControlLeader(args.coordinator,
+                                               args.num_processes)
+        engine._bcast(('warmup', buckets, seed))
     engine.warmup(buckets=buckets)   # readiness flips only once fast
     web.run_app(build_app(engine), host=args.host, port=args.port,
                 print=None)

@@ -1,0 +1,259 @@
+"""Multi-host serving: one engine replica spanning a whole TPU slice.
+
+Reference analog: the reference's serve replicas are vLLM/JetStream
+instances doing TP over all chips of a (possibly multi-host) slice —
+multi-host slices are one schedulable unit
+(reference sky/backends/cloud_vm_ray_backend.py:6439-6452,
+examples/tpu/v6e/README.md:119-127). Here the native engine does the
+same: every host joins one jax.distributed job, params/cache shard over
+the GLOBAL mesh, and XLA's collectives ride ICI/DCN inside the same
+jitted step/admit programs single-host serving uses.
+
+Design — leader-follower SPMD mirroring:
+  - Process 0 (leader) runs the HTTP frontend and the continuous
+    batcher. Every engine-level operation that touches the device
+    (warmup, an admit group, a decode step round, a failure reset) is
+    broadcast over a tiny TCP control channel BEFORE the leader
+    executes it.
+  - Followers run the SAME engine methods with the SAME inputs, so the
+    whole host-side state (slot pool, sampling arrays, prefix store,
+    speculative drafts) evolves identically everywhere and every
+    process enters the same XLA collective in the same order — the
+    SPMD contract. Device RNG is seeded deterministically, jit outputs
+    that the host reads are replicated over the mesh, and everything
+    derivable from mirrored state (speculation decisions, prefix hits,
+    penalty variants) is NOT broadcast — only the leader-private bits
+    are (queue-dependent step width, request payloads).
+
+The control channel is ordered + reliable (TCP, length-prefixed
+pickle); the jax.distributed coordinator handles device-level wiring.
+A follower that dies takes the replica down (the slice driver restarts
+the gang) — the same failure unit the reference's multi-host vLLM
+replicas have.
+
+Env contract (set by skylet/slice_driver.py for gang jobs):
+SKYTPU_COORDINATOR_ADDRESS, SKYTPU_NUM_PROCESSES, SKYTPU_NODE_RANK —
+the engine's --coordinator/--num-processes/--process-id default to
+these, so `skytpu serve up` on a multi-host slice needs no extra
+flags.
+"""
+from __future__ import annotations
+
+import hmac
+import io
+import os
+import pickle
+import socket
+import struct
+import time
+from typing import Any, Optional, Tuple
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+# The control channel listens next to the jax.distributed coordinator.
+CONTROL_PORT_OFFSET = 1000
+CONNECT_TIMEOUT_S = float(os.environ.get('SKYTPU_MH_CONNECT_TIMEOUT',
+                                         '120'))
+# Handshake magic + shared token: a follower must prove it belongs to
+# this gang before the leader counts it (and before it receives request
+# payloads); anything else connecting to the port is dropped. The token
+# rides the gang env like the coordinator address does.
+_MAGIC = b'SKYTPU-MH1'
+_TOKEN = os.environ.get('SKYTPU_MH_TOKEN',
+                        os.environ.get('SKYTPU_JOB_ID', 'local'))
+
+
+class _SafeUnpickler(pickle.Unpickler):
+    """Control ops are PURE DATA (tuples/lists/dicts of primitives);
+    refusing every class lookup turns a squatted port from arbitrary
+    code execution into a parse error."""
+
+    def find_class(self, module, name):
+        raise pickle.UnpicklingError(
+            f'control channel refuses class {module}.{name}')
+
+
+def control_address(coordinator: str) -> Tuple[str, int]:
+    host, port = coordinator.rsplit(':', 1)
+    return host, int(port) + CONTROL_PORT_OFFSET
+
+
+def init_distributed(coordinator: str, num_processes: int,
+                     process_id: int) -> None:
+    """Join the jax.distributed job (before ANY backend init).
+
+    Pins the platform first: a force-registered TPU plugin would
+    otherwise initialize during distributed setup and can hang on a
+    held chip even for CPU-intended runs. On CPU, cross-process
+    collectives need the gloo implementation."""
+    from skypilot_tpu.utils import jax_utils
+    jax_utils.pin_platform_from_env()
+    import jax
+    if 'cpu' in (os.environ.get('JAX_PLATFORMS') or ''):
+        jax.config.update('jax_cpu_collectives_implementation', 'gloo')
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    logger.info(f'jax.distributed up: process {process_id}/'
+                f'{num_processes}, {len(jax.devices())} global / '
+                f'{len(jax.local_devices())} local devices.')
+
+
+def _send_msg(sock: socket.socket, obj: Any) -> None:
+    data = pickle.dumps(obj)
+    sock.sendall(struct.pack('>I', len(data)) + data)
+
+
+def _recv_msg(sock: socket.socket) -> Any:
+    hdr = _recv_exact(sock, 4)
+    data = _recv_exact(sock, struct.unpack('>I', hdr)[0])
+    return _SafeUnpickler(io.BytesIO(data)).load()
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b''
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError('control channel closed')
+        buf += chunk
+    return buf
+
+
+class ControlLeader:
+    """Process 0's side: accept every follower (handshake-verified),
+    then broadcast ops."""
+
+    def __init__(self, coordinator: str, num_processes: int):
+        host, port = control_address(coordinator)
+        srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        srv.bind(('0.0.0.0', port))
+        srv.listen(num_processes)
+        srv.settimeout(CONNECT_TIMEOUT_S)
+        deadline = time.time() + CONNECT_TIMEOUT_S
+        self._conns = []
+        want = _MAGIC + hmac.new(_TOKEN.encode(), _MAGIC,
+                                 'sha256').digest()
+        while len(self._conns) < num_processes - 1:
+            if time.time() > deadline:
+                raise TimeoutError('not all followers handshook in time')
+            conn, addr = srv.accept()
+            try:
+                conn.settimeout(10)
+                got = _recv_exact(conn, len(want))
+                if not hmac.compare_digest(got, want):
+                    raise ConnectionError('bad handshake')
+                conn.settimeout(None)
+            except (OSError, ConnectionError) as e:
+                logger.warning(f'rejecting connection from {addr}: {e}')
+                conn.close()
+                continue
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._conns.append(conn)
+            logger.info(f'control follower connected: {addr}')
+        srv.close()
+
+    def send(self, op: Tuple) -> None:
+        """Broadcast; a dead follower is FATAL — the replica's
+        collectives can no longer complete, so exit loudly and let the
+        slice driver restart the gang (the reference's multi-host vLLM
+        replicas fail the same way)."""
+        for conn in self._conns:
+            try:
+                _send_msg(conn, op)
+            except OSError as e:
+                logger.error(f'control follower lost ({e}); failing '
+                             f'the replica so the gang restarts.')
+                os._exit(13)
+
+
+class ControlFollower:
+    def __init__(self, coordinator: str):
+        host, port = control_address(coordinator)
+        deadline = time.time() + CONNECT_TIMEOUT_S
+        while True:
+            try:
+                self._sock = socket.create_connection((host, port),
+                                                      timeout=5)
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise
+                time.sleep(0.2)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.sendall(_MAGIC + hmac.new(_TOKEN.encode(), _MAGIC,
+                                             'sha256').digest())
+        # The connect timeout must NOT persist: ops arrive whenever
+        # traffic does — an idle engine would kill the channel.
+        self._sock.settimeout(None)
+
+    def recv(self) -> Tuple:
+        return _recv_msg(self._sock)
+
+
+def strip_items(items) -> list:
+    """Admit-group items minus the leader-private stream queue/future
+    (followers publish to nobody)."""
+    return [tuple(it[:-2]) + (None, None) for it in items]
+
+
+def follower_serve(engine, coordinator: str) -> None:
+    """Follower main loop: mirror every leader op until the channel
+    closes. Device work happens inside the same engine methods the
+    leader runs; an op that raises here raised on the leader too (same
+    computation) — the leader follows up with a 'reset'."""
+    chan = ControlFollower(coordinator)
+    logger.info('follower ready; mirroring leader ops.')
+    failed = False
+    while True:
+        try:
+            op = chan.recv()
+        except ConnectionError:
+            logger.info('leader gone; follower exiting.')
+            return
+        kind = op[0]
+        if failed and kind != 'reset':
+            # We failed an op the leader completed: our device state
+            # has diverged (the failed jit was donated buffers), so the
+            # next collective would hang every process forever. Fail
+            # the gang instead — the slice driver restarts it.
+            logger.error(f'follower diverged (local failure, leader '
+                         f'sent {kind!r} not reset); exiting.')
+            os._exit(13)
+        try:
+            if kind == 'warmup':
+                engine._seed = op[2]   # leader-drawn sampling seed
+                engine.warmup(buckets=op[1])
+            elif kind == 'admit':
+                engine._admit_group(op[1])
+            elif kind == 'step':
+                engine._step_once(k_force=op[1])
+            elif kind == 'reap':
+                # The leader broadcasts this at every _publish, so
+                # finished slots free at EXACTLY the same point in the
+                # op stream on every process — a divergent free-slot
+                # choice would route the next admit to different cache
+                # rows on each process.
+                engine._publish()
+            elif kind == 'cancel':
+                # Mark only; the slot frees at the reap after the next
+                # device op — the same point the leader frees it.
+                s = engine.slots[op[1]]
+                if s is not None and s['finish'] is None:
+                    s['finish'] = 'stop'
+            elif kind == 'reset':
+                engine._fail_all(RuntimeError('leader reset'))
+            elif kind == 'stop':
+                return
+            else:
+                raise ValueError(f'unknown control op {kind!r}')
+            failed = False
+        except Exception as e:  # pylint: disable=broad-except
+            # If the leader hit the same failure it broadcasts 'reset'
+            # next and both sides rebuild; any OTHER next op means the
+            # failure was local-only → exit (checked above).
+            logger.warning(f'follower op {kind} failed: {e}')
+            failed = True

@@ -1,0 +1,120 @@
+"""Multi-host serving e2e (VERDICT r4 item 1b): TWO real processes join
+one jax.distributed job (gloo collectives on CPU), shard the engine over
+the 8-device GLOBAL mesh, and serve HTTP from process 0 while process 1
+mirrors every device op through the control channel.
+
+The test passing AT ALL proves distributed execution: with the follower
+absent or out of lockstep, the leader's collectives hang instead of
+answering. Reference analog: multi-host slices as ONE serve replica
+(vLLM/JetStream over a v5e-16; reference
+sky/backends/cloud_vm_ray_backend.py:6439-6452).
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(('127.0.0.1', 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _post(url, payload, timeout=120):
+    req = urllib.request.Request(
+        url, data=json.dumps(payload).encode(),
+        headers={'Content-Type': 'application/json'})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def test_two_process_engine_serves(tmp_path):
+    coord_port = _free_port()
+    http_port = _free_port()
+    env = dict(os.environ)
+    env.update({
+        'JAX_PLATFORMS': 'cpu',
+        'XLA_FLAGS': '--xla_force_host_platform_device_count=4',
+        'PYTHONPATH': REPO,
+        # The engine batch must stay divisible by data*fsdp=4.
+        'SKYTPU_ENGINE_MAX_BATCH': '8',
+    })
+    common = [sys.executable, '-m', 'skypilot_tpu.serve.engine',
+              '--model', 'llama-debug', '--max-len', '64',
+              '--mesh', 'data=2,fsdp=2,tensor=2',
+              '--coordinator', f'127.0.0.1:{coord_port}',
+              '--num-processes', '2']
+    procs = []
+    # Log to FILES: gloo/XLA chatter would fill an undrained PIPE's
+    # 64KB buffer and block the engine mid-warmup.
+    logs = [open(tmp_path / 'p1.log', 'w+b'),
+            open(tmp_path / 'p0.log', 'w+b')]
+
+    def dump(i):
+        logs[i].flush()
+        logs[i].seek(0)
+        return logs[i].read().decode(errors='replace')[-4000:]
+
+    try:
+        procs.append(subprocess.Popen(
+            common + ['--process-id', '1'],
+            env=env, stdout=logs[0], stderr=subprocess.STDOUT))
+        procs.append(subprocess.Popen(
+            common + ['--process-id', '0', '--port', str(http_port)],
+            env=env, stdout=logs[1], stderr=subprocess.STDOUT))
+        base = f'http://127.0.0.1:{http_port}'
+        deadline = time.time() + 420      # saturated-box margin
+        ready = False
+        while time.time() < deadline:
+            for i, p in enumerate(procs):
+                if p.poll() is not None:
+                    pytest.fail(f'engine process died rc={p.returncode}'
+                                f':\n{dump(i)}')
+            try:
+                with urllib.request.urlopen(base + '/health',
+                                            timeout=2) as r:
+                    if json.loads(r.read())['status'] == 'ok':
+                        ready = True
+                        break
+            except OSError:
+                pass
+            time.sleep(2)
+        assert ready, ('engine never became healthy; leader log:\n' +
+                       dump(1))
+
+        try:
+            body = _post(base + '/generate',
+                         {'tokens': [1, 2, 3, 4, 5],
+                          'max_new_tokens': 6})
+        except Exception as e:  # pylint: disable=broad-except
+            pytest.fail(f'generate failed ({e}); leader log:\n'
+                        f'{dump(1)}\nfollower log:\n{dump(0)}')
+        assert len(body['tokens']) == 6
+        assert body['finish_reason'] == 'length'
+        # Deterministic across calls (seeded RNG, greedy).
+        body2 = _post(base + '/generate',
+                      {'tokens': [1, 2, 3, 4, 5], 'max_new_tokens': 6})
+        assert body2['tokens'] == body['tokens']
+        # The OpenAI surface runs on the distributed mesh too.
+        chat = _post(base + '/v1/chat/completions', {
+            'messages': [{'role': 'user', 'content': 'hi'}],
+            'max_tokens': 4, 'temperature': 0})
+        assert chat['choices'][0]['finish_reason'] in ('stop', 'length')
+    finally:
+        for p in procs:
+            p.kill()
+        for p in procs:
+            p.wait(timeout=30)
+        for f in logs:
+            f.close()
