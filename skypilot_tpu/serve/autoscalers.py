@@ -8,13 +8,13 @@ unit-tests with synthetic clocks, no clusters.
 from __future__ import annotations
 
 import math
-import time
 from typing import Deque, List, Optional
 
 from collections import deque
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.serve import service_spec as spec_lib
+from skypilot_tpu.utils import vclock
 from skypilot_tpu.utils import registry
 
 logger = sky_logging.init_logger(__name__)
@@ -65,7 +65,7 @@ class RequestRateAutoscaler(Autoscaler):
         self._pending: Optional[tuple] = None
 
     def record_request(self, now: Optional[float] = None) -> None:
-        now = time.time() if now is None else now
+        now = vclock.now() if now is None else now
         self._timestamps.append(now)
 
     def _qps(self, now: float) -> float:
@@ -83,7 +83,7 @@ class RequestRateAutoscaler(Autoscaler):
         return max(lo, min(hi, want))
 
     def target_replicas(self, now: Optional[float] = None) -> int:
-        now = time.time() if now is None else now
+        now = vclock.now() if now is None else now
         raw = self._raw_target(now)
         if raw == self._current_target:
             self._pending = None

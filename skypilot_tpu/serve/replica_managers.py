@@ -16,7 +16,6 @@ from __future__ import annotations
 
 import os
 import threading
-import time
 import typing
 from typing import Dict, List, Optional
 from urllib import error as urlerror
@@ -30,6 +29,7 @@ from skypilot_tpu.backends import slice_backend
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve import service_spec as spec_lib
 from skypilot_tpu.serve import spot_placer as spot_placer_lib
+from skypilot_tpu.utils import vclock
 from skypilot_tpu.serve.serve_state import ReplicaStatus
 
 if typing.TYPE_CHECKING:
@@ -340,7 +340,7 @@ class ReplicaManager:
         """One control-loop pass: probe replicas, replace the dead, scale
         toward `target`."""
         replicas = serve_state.get_replicas(self.service_name)
-        now = time.time()
+        now = vclock.now()
         alive: List[dict] = []
         for rep in replicas:
             rid, status = rep['replica_id'], rep['status']

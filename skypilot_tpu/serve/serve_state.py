@@ -11,6 +11,8 @@ import sqlite3
 import time
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu.utils import vclock
+
 _DB_PATH_ENV = 'SKYTPU_SERVE_DB'
 
 
@@ -171,7 +173,7 @@ def _service_row(row: sqlite3.Row) -> Dict[str, Any]:
 # Replicas
 # ---------------------------------------------------------------------------
 def upsert_replica(service: str, replica_id: int, **cols: Any) -> None:
-    cols.setdefault('launched_at', time.time())
+    cols.setdefault('launched_at', vclock.now())
     names = ', '.join(cols)
     ph = ', '.join('?' * len(cols))
     updates = ', '.join(f'{k}=excluded.{k}' for k in cols)
