@@ -498,6 +498,23 @@ class TpuSliceBackend(backend_lib.Backend[SliceResourceHandle]):
         rc = head.run(cmd, stream_logs=True, log_path='/dev/null')
         return int(rc)
 
+    def capture_logs(self, handle: SliceResourceHandle, job_id: int,
+                     lines: int = 200) -> str:
+        """Non-follow log fetch returning the tail as a STRING (the
+        dashboard's poll-based live tail; `tail_logs` streams to the
+        caller's stdout instead). Raises RuntimeError on a non-zero
+        remote rc."""
+        cluster_info = handle.get_cluster_info()
+        py = self._remote_py(cluster_info)
+        head = self._head_runner(cluster_info)
+        rc, out, err = head.run(
+            f'{py} -m skypilot_tpu.skylet.log_lib --job-id {int(job_id)}',
+            require_outputs=True)
+        if rc != 0:
+            raise RuntimeError(f'log fetch failed (rc={rc}): '
+                               f'{(err or out)[-500:]}')
+        return '\n'.join(out.splitlines()[-lines:])
+
     def queue(self, handle: SliceResourceHandle) -> List[Dict[str, Any]]:
         cluster_info = handle.get_cluster_info()
         py = self._remote_py(cluster_info)

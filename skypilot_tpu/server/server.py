@@ -313,6 +313,138 @@ async def dashboard_summary(request: web.Request) -> web.Response:
     })
 
 
+def _tail_file(path: str, lines: int) -> str:
+    try:
+        with open(path, 'r', encoding='utf-8', errors='replace') as f:
+            return ''.join(f.readlines()[-lines:])
+    except OSError:
+        return ''
+
+
+def _parse_lines(request: web.Request) -> int:
+    """`lines` query param, clamped to [1, 2000] (the payload guard);
+    garbage raises ValueError → the caller 400s."""
+    return max(1, min(int(request.query.get('lines', '200')), 2000))
+
+
+async def dashboard_cluster(request: web.Request) -> web.Response:
+    """Drill-down: one cluster's handle facts + its ON-CLUSTER job queue
+    (the `skytpu queue` surface, reachable in the browser — reference
+    parity with the SPA's per-cluster pages, sky/server/server.py:2053)."""
+    from skypilot_tpu import global_state
+    from skypilot_tpu.backends import slice_backend
+    name = request.query.get('name', '')
+    record = global_state.get_cluster(name)
+    if record is None or not record.get('handle'):
+        return _json({'error': f'no cluster {name!r} (or no handle '
+                               f'recorded yet)'}, status=404)
+    handle = slice_backend.SliceResourceHandle.from_dict(record['handle'])
+
+    def fetch():
+        try:
+            return slice_backend.TpuSliceBackend().queue(handle)
+        except Exception as e:  # pylint: disable=broad-except
+            return [{'error': str(e)}]
+
+    jobs = await asyncio.to_thread(fetch)
+    res = (record.get('handle') or {}).get('launched_resources') or {}
+    return _json({
+        'name': name,
+        'status': record['status'].value,
+        'cloud': handle.cloud, 'region': handle.region,
+        'zone': handle.zone,
+        'resources': res.get('accelerators', '-'),
+        'launched_at': record.get('launched_at'),
+        'jobs': jobs,
+    })
+
+
+async def dashboard_cluster_log(request: web.Request) -> web.Response:
+    """Tail one on-cluster job's log (non-follow; the page polls — live
+    tailing without holding a remote stream open per browser tab)."""
+    from skypilot_tpu import global_state
+    from skypilot_tpu.backends import slice_backend
+    name = request.query.get('name', '')
+    try:
+        job_id = int(request.query.get('job_id', ''))
+        lines = _parse_lines(request)
+    except ValueError:
+        return _json({'error': 'job_id/lines must be integers'},
+                     status=400)
+    record = global_state.get_cluster(name)
+    if record is None or not record.get('handle'):
+        return _json({'error': f'no cluster {name!r} (or no handle '
+                               f'recorded yet)'}, status=404)
+    handle = slice_backend.SliceResourceHandle.from_dict(record['handle'])
+    backend = slice_backend.TpuSliceBackend()
+    try:
+        text = await asyncio.to_thread(backend.capture_logs, handle,
+                                       job_id, lines)
+    except Exception as e:  # pylint: disable=broad-except
+        return _json({'error': str(e)}, status=500)
+    return _json({'name': name, 'job_id': job_id, 'log': text})
+
+
+async def dashboard_job(request: web.Request) -> web.Response:
+    """Drill-down: one MANAGED job — record + mirrored run log + its
+    controller log (the `skytpu jobs logs` surface in the browser)."""
+    from skypilot_tpu.jobs import state as jobs_state
+    try:
+        job_id = int(request.query.get('job_id', ''))
+        lines = _parse_lines(request)
+    except ValueError:
+        return _json({'error': 'job_id/lines must be integers'},
+                     status=400)
+    rec = next((j for j in jobs_state.get_jobs()
+                if j['job_id'] == job_id), None)
+    if rec is None:
+        return _json({'error': f'no managed job {job_id}'}, status=404)
+    return _json({
+        'job': {'job_id': rec['job_id'], 'name': rec['name'],
+                'status': rec['status'].value,
+                'cluster_name': rec['cluster_name'],
+                'recovery_count': rec['recovery_count'],
+                'submitted_at': rec['submitted_at']},
+        'run_log': _tail_file(jobs_state.job_log_path(job_id), lines),
+        'controller_log': _tail_file(
+            jobs_state.controller_log_path(job_id), lines),
+    })
+
+
+async def dashboard_service(request: web.Request) -> web.Response:
+    """Drill-down: one service — replica table with probe state (status,
+    consecutive probe failures, version, age) + the controller log (the
+    `skytpu serve status` surface plus logs, in the browser)."""
+    from skypilot_tpu.serve import serve_state
+    name = request.query.get('name', '')
+    try:
+        lines = _parse_lines(request)
+    except ValueError:
+        return _json({'error': 'lines must be an integer'}, status=400)
+    rec = serve_state.get_service(name)
+    if rec is None:
+        return _json({'error': f'no service {name!r}'}, status=404)
+    replicas = [{
+        'replica_id': r['replica_id'],
+        'cluster_name': r['cluster_name'],
+        'status': r['status'].value,
+        'url': r['url'],
+        'version': r.get('version') or 1,
+        'probe_failures': r.get('consecutive_failures') or 0,
+        'launched_at': r.get('launched_at'),
+    } for r in serve_state.get_replicas(name)]
+    return _json({
+        'name': name,
+        'status': rec['status'].value,
+        'version': int(rec.get('version') or 1),
+        'failure_reason': rec.get('failure_reason'),
+        'lb_port': rec.get('lb_port'),
+        'replicas': replicas,
+        'controller_log': _tail_file(
+            serve_state.controller_log_path(name), lines),
+    })
+
+
 async def tunnel(request: web.Request) -> web.WebSocketResponse:
     """Bidirectional TCP-over-websocket proxy to a cluster's head host.
 
@@ -406,6 +538,11 @@ def build_app() -> web.Application:
     app.router.add_post('/api/v1/request_cancel', request_cancel)
     app.router.add_get('/dashboard', dashboard_page)
     app.router.add_get('/dashboard/api/summary', dashboard_summary)
+    app.router.add_get('/dashboard/api/cluster', dashboard_cluster)
+    app.router.add_get('/dashboard/api/cluster_log',
+                       dashboard_cluster_log)
+    app.router.add_get('/dashboard/api/job', dashboard_job)
+    app.router.add_get('/dashboard/api/service', dashboard_service)
     app.router.add_post('/api/v1/{name}', submit)
 
     async def _start_gc(app_):
