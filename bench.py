@@ -399,6 +399,11 @@ def run_serve_bench():
         port = s.getsockname()[1]
     cmd = [sys.executable, '-m', 'skypilot_tpu.serve.engine',
            '--model', model, '--max-len', str(max_len),
+           # Warm exactly the bucket this bench drives (the 'all'
+           # default would compile every bucket before /health flips —
+           # correctness-first for serving, waste for a fixed-shape
+           # bench).
+           '--warm-buckets', str(_next_pow2(prompt_len)),
            '--host', '127.0.0.1', '--port', str(port)]
     mesh = os.environ.get('SKYTPU_BENCH_SERVE_MESH')
     if mesh:

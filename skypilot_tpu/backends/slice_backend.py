@@ -502,18 +502,21 @@ class TpuSliceBackend(backend_lib.Backend[SliceResourceHandle]):
                      lines: int = 200) -> str:
         """Non-follow log fetch returning the tail as a STRING (the
         dashboard's poll-based live tail; `tail_logs` streams to the
-        caller's stdout instead). Raises RuntimeError on a non-zero
-        remote rc."""
+        caller's stdout instead). Only the tail crosses the wire
+        (log_lib --tail). rc 100 is log_lib's job-STATUS convention
+        (non-SUCCEEDED job), not a fetch failure — a live tail of a
+        RUNNING or FAILED job is the whole point."""
         cluster_info = handle.get_cluster_info()
         py = self._remote_py(cluster_info)
         head = self._head_runner(cluster_info)
         rc, out, err = head.run(
-            f'{py} -m skypilot_tpu.skylet.log_lib --job-id {int(job_id)}',
+            f'{py} -m skypilot_tpu.skylet.log_lib '
+            f'--job-id {int(job_id)} --tail {int(lines)}',
             require_outputs=True)
-        if rc != 0:
+        if rc not in (0, 100):
             raise RuntimeError(f'log fetch failed (rc={rc}): '
                                f'{(err or out)[-500:]}')
-        return '\n'.join(out.splitlines()[-lines:])
+        return out
 
     def queue(self, handle: SliceResourceHandle) -> List[Dict[str, Any]]:
         cluster_info = handle.get_cluster_info()

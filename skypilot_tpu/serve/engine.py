@@ -1929,10 +1929,12 @@ def main() -> None:
                         help='Weight-only quantization for serving '
                              '(dense Llama and MLA families; composes '
                              'with --mesh).')
-    parser.add_argument('--warm-buckets', default='16',
+    parser.add_argument('--warm-buckets', default='all',
                         help="Comma-separated prompt buckets to pre-"
-                             "compile, or 'all' (guarantees no request "
-                             'ever hits a fresh XLA compile).')
+                             "compile, or 'all' (the default: /health "
+                             'flips warm only when NO client request '
+                             'can ever hit a fresh XLA compile — pass '
+                             "'16' for a faster, cliffier boot).")
     # Multi-host serving: one replica spanning a whole (multi-host)
     # slice, like the reference's multi-host vLLM/JetStream replicas.
     # Defaults come from the gang env the slice driver exports, so a

@@ -314,9 +314,12 @@ async def dashboard_summary(request: web.Request) -> web.Response:
 
 
 def _tail_file(path: str, lines: int) -> str:
+    import collections
     try:
         with open(path, 'r', encoding='utf-8', errors='replace') as f:
-            return ''.join(f.readlines()[-lines:])
+            # deque keeps only the last N lines in memory — these logs
+            # can be huge and this runs on every dashboard poll.
+            return ''.join(collections.deque(f, maxlen=lines))
     except OSError:
         return ''
 

@@ -21,7 +21,11 @@ _WAIT_FOR_LOG_SECONDS = 30
 
 
 def tail_job_logs(job_id: int, follow: bool = True,
-                  out=sys.stdout) -> Optional[JobStatus]:
+                  out=sys.stdout,
+                  tail: Optional[int] = None) -> Optional[JobStatus]:
+    """Stream (or dump) one job's run.log. `tail` (non-follow only):
+    emit just the last N lines — the dashboard polls this, and shipping
+    a multi-GB log across the wire to keep 200 lines would be absurd."""
     log_path = os.path.join(job_lib.log_dir_for(job_id), 'run.log')
     deadline = time.time() + _WAIT_FOR_LOG_SECONDS
     while not os.path.exists(log_path):
@@ -33,6 +37,14 @@ def tail_job_logs(job_id: int, follow: bool = True,
         time.sleep(_POLL_SECONDS)
     if not os.path.exists(log_path):
         print(f'[skytpu] no logs for job {job_id}.', file=out)
+        return job_lib.get_status(job_id)
+    if tail is not None and not follow:
+        import collections
+        with open(log_path, 'r', encoding='utf-8',
+                  errors='replace') as f:
+            for line in collections.deque(f, maxlen=tail):
+                out.write(line)
+        out.flush()
         return job_lib.get_status(job_id)
     with open(log_path, 'r', encoding='utf-8', errors='replace') as f:
         while True:
@@ -58,8 +70,11 @@ def main() -> None:
     parser = argparse.ArgumentParser(prog='log_lib')
     parser.add_argument('--job-id', type=int, required=True)
     parser.add_argument('--follow', action='store_true')
+    parser.add_argument('--tail', type=int, default=None,
+                        help='Emit only the last N lines (non-follow).')
     args = parser.parse_args()
-    status = tail_job_logs(args.job_id, follow=args.follow)
+    status = tail_job_logs(args.job_id, follow=args.follow,
+                           tail=args.tail)
     if status is not None:
         print(f'[skytpu] job {args.job_id} finished: {status.value}',
               file=sys.stderr)

@@ -179,6 +179,25 @@ class TestEngine:
         assert len(content) == 2
         assert all(c['logprob'] < 0 for c in content)
 
+    def test_warm_all_buckets_covers_every_admissible_prompt(self):
+        """--warm-buckets all (the CLI default): every admissible
+        prompt bucket is strictly below max_len (a bucket-sized prompt
+        still needs room for one generated token), and a warmup over
+        them precompiles enough that serving any in-range prompt works
+        immediately."""
+        eng = engine_lib.InferenceEngine('llama-debug', max_len=128)
+        assert eng.all_buckets() == [16, 32, 64]
+        eng.warmup(buckets=eng.all_buckets())
+        assert eng.warm
+
+        async def fn(client):
+            # One prompt per bucket, incl. the largest admissible.
+            for n in (3, 20, 60):
+                r = await client.post('/generate', json={
+                    'tokens': [1] * n, 'max_new_tokens': 2})
+                assert r.status == 200, n
+        _with_client(eng, fn)
+
     def test_top_logprobs(self, engine):
         """OpenAI top-N alternatives: completions `logprobs: N` returns
         per-position dicts of N entries; chat `top_logprobs: N` returns

@@ -53,6 +53,7 @@ def test_two_process_engine_serves(tmp_path):
     common = [sys.executable, '-m', 'skypilot_tpu.serve.engine',
               '--model', 'llama-debug', '--max-len', '64',
               '--mesh', 'data=2,fsdp=2,tensor=2',
+              '--warm-buckets', '16',   # distribution test, lean boot
               '--coordinator', f'127.0.0.1:{coord_port}',
               '--num-processes', '2']
     procs = []
