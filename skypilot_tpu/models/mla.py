@@ -514,19 +514,26 @@ def prefill_extend(params, tokens: jnp.ndarray, cfg: MLAConfig,
     return logits[:, 0], cache
 
 
-def decode_step(params, token: jnp.ndarray, cache: LatentCache,
-                cfg: MLAConfig,
-                active: Optional[jnp.ndarray] = None
-                ) -> Tuple[jnp.ndarray, LatentCache]:
-    """One incremental step over the latent cache. `active` [B] bool: see
-    decode.decode_step — continuous-batching rows that must not advance."""
-    b = token.shape[0]
+def verify_step(params, tokens: jnp.ndarray, cache: LatentCache,
+                cfg: MLAConfig) -> Tuple[jnp.ndarray, LatentCache]:
+    """Process K tokens per row at each row's own offset in ONE call —
+    the MLA half of speculative decoding (mirrors decode.verify_step's
+    contract, over the latent cache).
+
+    tokens [B, K] → logits [B, K, vocab]; latents for all K positions
+    are written at rows' [length, length+K) slots, but `length` is NOT
+    advanced — the caller commits however many tokens verification
+    accepts (stale latents beyond the committed length are causally
+    masked and overwritten later, the same property ragged decode
+    relies on). decode_step below is its K=1 case — ONE copy of the
+    per-layer latent-scatter/attend body serves both."""
+    b, kk = tokens.shape
     length = cache.length
     rows = jnp.arange(b)
-    x = jnp.take(params['embed'], token[:, None], axis=0).astype(cfg.dtype)
-    sin, cos = rotary.rope_frequencies(cfg.qk_rope_head_dim,
-                                       length[:, None], cfg.rope_theta,
-                                       cfg.rope_scaling)
+    x = jnp.take(params['embed'], tokens, axis=0).astype(cfg.dtype)
+    positions = length[:, None] + jnp.arange(kk)          # [B, K]
+    sin, cos = rotary.rope_frequencies(cfg.qk_rope_head_dim, positions,
+                                       cfg.rope_theta, cfg.rope_scaling)
 
     def body(carry, xs):
         x_c, c_all, kr_all = carry
@@ -534,8 +541,8 @@ def decode_step(params, token: jnp.ndarray, cache: LatentCache,
         q_nope, q_rope, c_new, kr_new = _latents(x_c, lp, cfg, sin, cos)
         c_l = jax.lax.dynamic_index_in_dim(c_all, layer_idx, 0, False)
         kr_l = jax.lax.dynamic_index_in_dim(kr_all, layer_idx, 0, False)
-        c_l = c_l.at[rows, length].set(c_new[:, 0])
-        kr_l = kr_l.at[rows, length].set(kr_new[:, 0])
+        c_l = c_l.at[rows[:, None], positions].set(c_new)
+        kr_l = kr_l.at[rows[:, None], positions].set(kr_new)
         c_all = jax.lax.dynamic_update_index_in_dim(c_all, c_l, layer_idx,
                                                     0)
         kr_all = jax.lax.dynamic_update_index_in_dim(kr_all, kr_l,
@@ -554,9 +561,20 @@ def decode_step(params, token: jnp.ndarray, cache: LatentCache,
     head = (params['embed'].T if cfg.tie_embeddings else params['lm_head'])
     logits = jnp.einsum('bsd,dv->bsv', x, head.astype(cfg.dtype),
                         preferred_element_type=jnp.float32)
+    return logits, LatentCache(c_kv=cs, k_rope=krs, length=length)
+
+
+def decode_step(params, token: jnp.ndarray, cache: LatentCache,
+                cfg: MLAConfig,
+                active: Optional[jnp.ndarray] = None
+                ) -> Tuple[jnp.ndarray, LatentCache]:
+    """One incremental step over the latent cache. `active` [B] bool: see
+    decode.decode_step — continuous-batching rows that must not advance."""
+    logits, cache = verify_step(params, token[:, None], cache, cfg)
     advance = 1 if active is None else active.astype(jnp.int32)
-    return logits[:, 0], LatentCache(c_kv=cs, k_rope=krs,
-                                     length=length + advance)
+    return logits[:, 0], LatentCache(c_kv=cache.c_kv,
+                                     k_rope=cache.k_rope,
+                                     length=cache.length + advance)
 
 
 @functools.partial(jax.jit,

@@ -435,13 +435,16 @@ class InferenceEngine:
         self.requests_total = 0
         self.rejected_total = 0
         # Speculative decoding (prompt-lookup self-draft + K-wide
-        # verify). Greedy dense-family rows only: the exactness
-        # guarantee needs verify_step ≡ sequential decode (MoE capacity
-        # grouping breaks that; sampling rows would need rejection
-        # sampling), and MLA has no verify_step yet.
+        # verify). Greedy non-MoE rows only: the exactness guarantee
+        # needs verify_step ≡ sequential decode (MoE capacity grouping
+        # breaks that; sampling rows would need rejection sampling).
+        # Both cache families have a verify_step (decode.verify_step /
+        # mla.verify_step) — dense GQA AND the MLA/DeepSeek latents
+        # speculate.
         from skypilot_tpu.models import moe as moe_lib
-        self.spec_k = (SPEC_K if self._decode is decode_lib and
-                       not isinstance(self.cfg, moe_lib.MoEConfig) else 0)
+        self.spec_k = (0 if isinstance(self.cfg, (moe_lib.MoEConfig,
+                                                  mla.DeepSeekMoEConfig))
+                       else SPEC_K)
         self.spec_rounds = 0
         self.spec_proposed = 0
         self.spec_accepted = 0
@@ -1094,10 +1097,12 @@ class InferenceEngine:
     @timeline.event
     def _spec_once(self) -> bool:
         """Try ONE speculative round over the pool; False → caller runs
-        the normal step. Preconditions (all checked here): the dense
-        family, every active row greedy, no penalties, at least one row
-        with a prompt-lookup draft, and K more cache slots free on every
-        active row (an out-of-bounds scatter would clamp onto valid KV).
+        the normal step. Preconditions: a non-MoE family (spec_k gates
+        at init — dense GQA and dense MLA both speculate via their
+        verify_step), every active row greedy, no penalties, at least
+        one row with a prompt-lookup draft, and K more cache slots free
+        on every active row (an out-of-bounds scatter would clamp onto
+        valid KV).
 
         Rows WITHOUT a draft still commit exactly one token (the
         correction IS the target's next greedy token), so a mixed pool
@@ -1189,9 +1194,10 @@ class InferenceEngine:
                 self.tokens_generated += 1
                 if len(s['out']) >= s['want']:
                     s['finish'] = 'length'
-        self.cache = type(self.cache)(
-            k=self.cache.k, v=self.cache.v,
-            length=self.cache.length + jnp.asarray(adv))
+        import dataclasses as _dc
+        self.cache = _dc.replace(self.cache,
+                                 length=self.cache.length +
+                                 jnp.asarray(adv))
         self.spec_proposed += round_prop
         self.spec_accepted += round_acc
         if round_prop and round_acc < SPEC_MIN_ACCEPT * round_prop:
@@ -1439,35 +1445,64 @@ def _check_len(engine: InferenceEngine, tokens: List[int],
     return None
 
 
+class _SseChoice:
+    """Per-choice streaming state: incremental detokenization, the
+    stop-string holdback buffer, text offsets, and the engine future.
+    Pieces awaiting release pair each token's OWN decoded text with
+    that token's logprob info, so a streamed chunk's logprob always
+    describes the text it carries and concatenating logprobs.tokens
+    reconstructs the streamed text."""
+
+    def __init__(self, engine, idx: int, fut, queue):
+        from skypilot_tpu.data.tokenizer import StreamDecoder
+        self.idx = idx
+        self.fut = fut
+        self.queue = queue
+        self.decoder = StreamDecoder(engine.tokenizer)
+        self.pend: List[list] = []    # [piece_text, lp, tops]
+        self.pend_chars = 0
+        self.emitted = 0              # chars sent (text_offset)
+        self.stopped = False
+
+
 async def _sse_response(request, engine: InferenceEngine,
-                        tokens: List[int], max_new: int, sampling,
+                        prompts: List[List[int]], max_new: int, sampling,
                         stop_ids, make_chunks, web, stop_strings=None,
                         want_logprobs: bool = False, top_n: int = 0):
-    """Shared SSE plumbing for /v1/completions and /v1/chat/completions.
+    """Shared SSE plumbing for /v1/completions and /v1/chat/completions,
+    over ONE OR MORE choices (n>1 / batched prompts stream too — each
+    entry of `prompts` is a choice, chunks carry its index).
 
-    `make_chunks(delta_text, finish_reason, lp=None)` yields the JSON
-    payload(s) for one event; `lp` is a (piece, logprob, tops, offset)
-    tuple when the client asked for streaming logprobs. finish_reason is
-    set on the final content-bearing event, per the OpenAI streaming
-    contract. Ends with `data: [DONE]`.
+    `make_chunks(delta_text, finish_reason, lp=None, index=0)` yields
+    the JSON payload(s) for one event; `lp` is a (piece, logprob, tops,
+    offset) tuple when the client asked for streaming logprobs.
+    finish_reason is set on each choice's final event, per the OpenAI
+    streaming contract. Ends with `data: [DONE]` after every choice
+    finishes.
 
     Stop STRINGS stream too: emitted text is held back by
     len(longest stop)-1 chars so a stop string split across tokens can
-    never leak to the client; on a match the request is cancelled
-    (engine.cancel) and finish_reason='stop'.
+    never leak to the client; on a match that choice is cancelled
+    (engine.cancel) and its finish_reason='stop'.
     """
-    from skypilot_tpu.data.tokenizer import StreamDecoder
     temperature, top_k, top_p, pres, freq = sampling
     stops = ([] if stop_strings is None else
              [stop_strings] if isinstance(stop_strings, str)
              else list(stop_strings))
     hold = max((len(s) for s in stops), default=0) - 1
-    stream_q: asyncio.Queue = asyncio.Queue()
+    choices: List[_SseChoice] = []
     try:
-        fut = engine.submit_nowait(tokens, max_new, temperature, top_k,
-                                   top_p, pres, freq, stop_ids=stop_ids,
-                                   stream_q=stream_q)
+        for idx, tokens in enumerate(prompts):
+            q: asyncio.Queue = asyncio.Queue()
+            fut = engine.submit_nowait(tokens, max_new, temperature,
+                                       top_k, top_p, pres, freq,
+                                       stop_ids=stop_ids, stream_q=q)
+            choices.append(_SseChoice(engine, idx, fut, q))
     except EngineOverloaded as e:
+        # All-or-nothing like _submit_many: cancel enqueued siblings.
+        for ch in choices:
+            engine.cancel(ch.fut)
+            ch.fut.cancel()
         return _openai_error(web, str(e), status=429,
                              err_type='overloaded_error')
     resp = web.StreamResponse(headers={
@@ -1481,82 +1516,94 @@ async def _sse_response(request, engine: InferenceEngine,
         await resp.write(b'data: ' +
                          json_lib.dumps(payload).encode() + b'\n\n')
 
-    decoder = StreamDecoder(engine.tokenizer)
-    # Pieces not yet emitted (stop-string holdback), each the decoded
-    # text OF ITS OWN TOKEN with that token's logprob info — so a
-    # streamed chunk's logprob always describes the text it carries,
-    # and concatenating logprobs.tokens reconstructs the streamed text.
-    pend: List[list] = []     # [piece_text, lp, tops]
-    pend_chars = 0
-    emitted = 0               # chars sent (text_offset)
-    stopped = False
-
-    async def emit_piece(piece: str, lp, tops) -> None:
-        nonlocal emitted
-        lp_info = ((piece, lp, tops[:top_n], emitted)
+    async def emit_piece(ch: _SseChoice, piece: str, lp, tops) -> None:
+        lp_info = ((piece, lp, tops[:top_n], ch.emitted)
                    if want_logprobs and lp is not None else None)
         if not piece and lp_info is None:
             return
         for payload in make_chunks(piece if piece else None, None,
-                                   lp=lp_info):
+                                   lp=lp_info, index=ch.idx):
             await send(payload)
-        emitted += len(piece)
+        ch.emitted += len(piece)
 
-    async def emit_until(cut: int) -> None:
-        """Emit pend pieces truncated at joined-text index `cut`
-        (logprobs past the cut are trimmed, like the non-stream path)."""
+    async def emit_until(ch: _SseChoice, cut: int) -> None:
+        """Emit the choice's pend pieces truncated at joined-text index
+        `cut` (logprobs past the cut are trimmed, like non-stream)."""
         remaining = cut
-        for p_text, p_lp, p_tops in pend:
+        for p_text, p_lp, p_tops in ch.pend:
             if remaining <= 0:
                 break
             take = min(len(p_text), remaining)
-            await emit_piece(p_text[:take], p_lp, p_tops)
+            await emit_piece(ch, p_text[:take], p_lp, p_tops)
             remaining -= len(p_text)
 
-    try:
-        for payload in make_chunks(None, None, first=True):
-            await send(payload)
-        while True:
-            item = await stream_q.get()
-            if item is None:
-                break
-            tok, lp, tops = item
-            piece = decoder.feed([tok])
-            pend.append([piece, lp, tops])
-            pend_chars += len(piece)
-            cut = _stop_scan(''.join(p[0] for p in pend), stops)
-            if cut is not None:
-                engine.cancel(fut)
-                await emit_until(cut)
-                pend, stopped = [], True
-                break
-            # Release from the front while the holdback (len(longest
-            # stop) - 1 chars) stays covered by what remains.
-            while pend and pend_chars - len(pend[0][0]) >= hold:
-                p_text, p_lp, p_tops = pend.pop(0)
-                pend_chars -= len(p_text)
-                await emit_piece(p_text, p_lp, p_tops)
-        out, finish, lps, all_tops = await fut
+    async def on_token(ch: _SseChoice, item) -> None:
+        tok, lp, tops = item
+        piece = ch.decoder.feed([tok])
+        ch.pend.append([piece, lp, tops])
+        ch.pend_chars += len(piece)
+        cut = _stop_scan(''.join(p[0] for p in ch.pend), stops)
+        if cut is not None:
+            engine.cancel(ch.fut)
+            await emit_until(ch, cut)
+            ch.pend, ch.stopped = [], True
+            return
+        # Release from the front while the holdback (len(longest stop)
+        # - 1 chars) stays covered by what remains.
+        while ch.pend and ch.pend_chars - len(ch.pend[0][0]) >= hold:
+            p_text, p_lp, p_tops = ch.pend.pop(0)
+            ch.pend_chars -= len(p_text)
+            await emit_piece(ch, p_text, p_lp, p_tops)
+
+    async def finish_choice(ch: _SseChoice) -> None:
+        out, finish, lps, all_tops = await ch.fut
         del out, lps, all_tops
-        if stopped:
+        if ch.stopped:
             finish = 'stop'
         else:
-            tail = decoder.flush()
+            tail = ch.decoder.flush()
             if tail:
                 # Held-back bytes belong to the last token's piece.
-                if pend:
-                    pend[-1][0] += tail
+                if ch.pend:
+                    ch.pend[-1][0] += tail
                 else:
-                    pend.append([tail, None, []])
-            joined = ''.join(p[0] for p in pend)
+                    ch.pend.append([tail, None, []])
+            joined = ''.join(p[0] for p in ch.pend)
             cut = _stop_scan(joined, stops)
             if cut is not None:
                 finish = 'stop'
-                await emit_until(cut)
+                await emit_until(ch, cut)
             else:
-                await emit_until(len(joined))
-        for payload in make_chunks(None, finish):
+                await emit_until(ch, len(joined))
+        for payload in make_chunks(None, finish, index=ch.idx):
             await send(payload)
+
+    # Merge every choice's token queue into one stream (tokens arrive
+    # interleaved as the batcher steps the pool).
+    merged: asyncio.Queue = asyncio.Queue()
+
+    async def pump(ch: _SseChoice) -> None:
+        while True:
+            item = await ch.queue.get()
+            await merged.put((ch, item))
+            if item is None:
+                return
+
+    pumps = [asyncio.ensure_future(pump(ch)) for ch in choices]
+    try:
+        for ch in choices:
+            for payload in make_chunks(None, None, first=True,
+                                       index=ch.idx):
+                await send(payload)
+        live = len(choices)
+        while live:
+            ch, item = await merged.get()
+            if item is None:
+                await finish_choice(ch)
+                live -= 1
+                continue
+            if not ch.stopped:
+                await on_token(ch, item)
         await resp.write(b'data: [DONE]\n\n')
     except Exception as e:  # pylint: disable=broad-except
         # Mid-stream failure: the status line already went out; the only
@@ -1567,6 +1614,14 @@ async def _sse_response(request, engine: InferenceEngine,
                                   'type': 'server_error'}})
         except ConnectionError:
             pass
+    finally:
+        for p in pumps:
+            p.cancel()
+        # A dropped client must not leave prompts×n slots decoding to
+        # max_tokens with no consumer — cancel every unfinished choice.
+        for ch in choices:
+            if not ch.fut.done():
+                engine.cancel(ch.fut)
     await resp.write_eof()
     return resp
 
@@ -1679,10 +1734,12 @@ def build_app(engine: InferenceEngine):
             _truncate_at_stop_strings('', stop_strings)   # validate shape
             want_logprobs, top_n = _parse_logprobs(body)
             n, best_of = _parse_n(body)
-            if body.get('stream') and (n > 1 or best_of > 1 or
-                                       len(prompts) > 1):
-                raise ValueError('stream=true supports a single prompt '
-                                 'with n=1 and best_of=1')
+            if body.get('stream') and best_of > n:
+                # Ranking needs completed candidates; OpenAI rejects
+                # best_of with stream too. n>1 and batched prompts
+                # stream fine (per-choice indexed chunks).
+                raise ValueError('best_of > n is not supported with '
+                                 'stream=true')
         except (TypeError, ValueError) as e:
             return bad(f'invalid request: {e}')
         for tokens in prompts:
@@ -1694,7 +1751,8 @@ def build_app(engine: InferenceEngine):
         model = body.get('model', engine.model_name)
 
         if body.get('stream'):
-            def make_chunks(delta, finish, first=False, lp=None):
+            def make_chunks(delta, finish, first=False, lp=None,
+                            index=0):
                 if first:
                     return
                 if delta is None and finish is None and lp is None:
@@ -1712,11 +1770,13 @@ def build_app(engine: InferenceEngine):
                 yield {
                     'id': rid, 'object': 'text_completion',
                     'created': created, 'model': model,
-                    'choices': [{'text': delta or '', 'index': 0,
+                    'choices': [{'text': delta or '', 'index': index,
                                  'logprobs': lp_obj,
                                  'finish_reason': finish}],
                 }
-            return await _sse_response(request, engine, prompts[0],
+            # One choice per prompt×n, OpenAI index order.
+            stream_prompts = [t for t in prompts for _ in range(n)]
+            return await _sse_response(request, engine, stream_prompts,
                                        max_new, sampling, stop_ids,
                                        make_chunks, web,
                                        stop_strings=stop_strings,
@@ -1788,8 +1848,6 @@ def build_app(engine: InferenceEngine):
             _truncate_at_stop_strings('', stop_strings)
             want_logprobs, top_n = _parse_logprobs(body, chat=True)
             n, _ = _parse_n(body)      # chat has no best_of
-            if body.get('stream') and n > 1:
-                raise ValueError('stream=true supports n=1')
         except (TypeError, ValueError) as e:
             return bad(f'invalid request: {e}')
         msg = _check_len(engine, tokens, max_new)
@@ -1800,13 +1858,14 @@ def build_app(engine: InferenceEngine):
         model = body.get('model', engine.model_name)
 
         if body.get('stream'):
-            def make_chunks(delta, finish, first=False, lp=None):
+            def make_chunks(delta, finish, first=False, lp=None,
+                            index=0):
                 base = {'id': rid, 'object': 'chat.completion.chunk',
                         'created': created, 'model': model}
                 if first:
                     yield {**base, 'choices': [{
-                        'index': 0, 'delta': {'role': 'assistant',
-                                              'content': ''},
+                        'index': index,
+                        'delta': {'role': 'assistant', 'content': ''},
                         'finish_reason': None}]}
                     return
                 if delta is not None or lp is not None:
@@ -1820,16 +1879,18 @@ def build_app(engine: InferenceEngine):
                                  'logprob': round(v, 6)}
                                 for i, v in tops] if top_n else None}]}
                     yield {**base, 'choices': [{
-                        'index': 0, 'delta': {'content': delta or ''},
+                        'index': index,
+                        'delta': {'content': delta or ''},
                         'logprobs': lp_obj,
                         'finish_reason': None}]}
                 if finish is not None:
                     yield {**base, 'choices': [{
-                        'index': 0, 'delta': {},
+                        'index': index, 'delta': {},
                         'finish_reason': finish}]}
-            return await _sse_response(request, engine, tokens, max_new,
-                                       sampling, stop_ids, make_chunks,
-                                       web, stop_strings=stop_strings,
+            return await _sse_response(request, engine, [tokens] * n,
+                                       max_new, sampling, stop_ids,
+                                       make_chunks, web,
+                                       stop_strings=stop_strings,
                                        want_logprobs=want_logprobs,
                                        top_n=top_n)
 

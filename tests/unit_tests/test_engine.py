@@ -179,6 +179,39 @@ class TestEngine:
         assert len(content) == 2
         assert all(c['logprob'] < 0 for c in content)
 
+    def test_streaming_n_and_batched_prompts(self, engine):
+        """n>1 AND batched prompts stream: chunks carry per-choice
+        indexes, every choice finishes, and assembling each index's
+        deltas reproduces the non-streamed choice texts (greedy)."""
+        async def fn(client):
+            ns = await client.post('/v1/completions', json={
+                'prompt': ['ab', 'cd'], 'max_tokens': 3,
+                'temperature': 0, 'ignore_eos': True, 'n': 2})
+            want = [c['text'] for c in (await ns.json())['choices']]
+            r = await client.post('/v1/completions', json={
+                'prompt': ['ab', 'cd'], 'max_tokens': 3,
+                'temperature': 0, 'ignore_eos': True, 'n': 2,
+                'stream': True})
+            assert r.status == 200
+            texts = {}
+            finishes = {}
+            async for line in r.content:
+                line = line.decode().strip()
+                if not line.startswith('data: ') or line == 'data: [DONE]':
+                    continue
+                ch = json.loads(line[len('data: '):])['choices'][0]
+                i = ch['index']
+                texts[i] = texts.get(i, '') + (ch.get('text') or '')
+                if ch.get('finish_reason'):
+                    finishes[i] = ch['finish_reason']
+            return want, texts, finishes
+
+        want, texts, finishes = _with_client(engine, fn)
+        assert sorted(texts) == [0, 1, 2, 3]
+        assert set(finishes.values()) == {'length'}
+        for i, w in enumerate(want):
+            assert texts[i] == w, i
+
     def test_warm_all_buckets_covers_every_admissible_prompt(self):
         """--warm-buckets all (the CLI default): every admissible
         prompt bucket is strictly below max_len (a bucket-sized prompt

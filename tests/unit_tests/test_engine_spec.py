@@ -60,13 +60,15 @@ class TestEngineSpeculative:
         # 2-gram fallback when the 3-gram never repeats.
         assert engine_lib._lookup_draft([7, 8, 1, 9, 7, 8], 2) == [1, 9]
 
-    def test_spec_output_equals_plain_greedy(self, monkeypatch):
+    @pytest.mark.parametrize('model', ['llama-debug', 'mla-debug'])
+    def test_spec_output_equals_plain_greedy(self, model, monkeypatch):
         """The speculative guarantee through the FULL HTTP path: same
         tokens (and logprobs) as the non-speculative engine, with
-        speculation demonstrably active. Cooldown disabled: random
-        debug params don't follow the PROMPT's pattern on round one
-        (they loop on their OWN pattern a few tokens in), and a 16-round
-        pause would outlast this short generation."""
+        speculation demonstrably active — for BOTH cache families
+        (dense KVCache and the MLA/DeepSeek latent cache). Cooldown
+        disabled: random debug params don't follow the PROMPT's pattern
+        on round one (they loop on their OWN pattern a few tokens in),
+        and a 16-round pause would outlast this short generation."""
         monkeypatch.setattr(engine_lib, 'SPEC_COOLDOWN', 0)
         prompts = [REPEAT, [9, 9, 9, 9, 9, 9, 9], [3, 1, 4, 1, 5, 9]]
 
@@ -77,8 +79,8 @@ class TestEngineSpeculative:
                 for p in prompts])
             return [await r.json() for r in rs]
 
-        plain = _with_client(_make(spec_k=0), collect)
-        spec_eng = _make(spec_k=4)
+        plain = _with_client(_make(model, spec_k=0), collect)
+        spec_eng = _make(model, spec_k=4)
         spec = _with_client(spec_eng, collect)
         assert spec_eng.spec_rounds > 0, 'speculation never fired'
         assert spec_eng.spec_accepted > 0, \
@@ -165,8 +167,39 @@ class TestEngineSpeculative:
         if eng.spec_accepted == 0:
             assert cool > 0 or eng.spec_proposed == 0
 
-    def test_moe_and_mla_engines_disable_spec(self):
-        eng_moe = engine_lib.InferenceEngine('moe-debug', max_len=64)
-        assert eng_moe.spec_k == 0
-        eng_mla = engine_lib.InferenceEngine('mla-debug', max_len=64)
-        assert eng_mla.spec_k == 0
+    def test_moe_engines_disable_spec_mla_dense_keeps_it(self):
+        """MoE capacity grouping breaks verify==sequential, so both MoE
+        families opt out; dense MLA speculates (mla.verify_step)."""
+        assert engine_lib.InferenceEngine('moe-debug',
+                                          max_len=64).spec_k == 0
+        assert engine_lib.InferenceEngine('deepseek-moe-debug',
+                                          max_len=64).spec_k == 0
+        assert engine_lib.InferenceEngine('mla-debug',
+                                          max_len=64).spec_k > 0
+
+    def test_mla_verify_step_matches_sequential_decode(self):
+        """mla.verify_step (K-wide latent step) must equal K sequential
+        decode_steps bit-for-bit on logits AND leave length unmoved —
+        the exactness base of MLA speculation."""
+        import dataclasses
+        import jax
+        from skypilot_tpu import models as models_lib
+        from skypilot_tpu.models import mla
+        cfg = dataclasses.replace(models_lib.get_config('mla-debug'),
+                                  dtype=jnp.float32)
+        params = mla.init_params(jax.random.PRNGKey(0), cfg)
+        prompt = jax.random.randint(jax.random.PRNGKey(1), (2, 7), 0,
+                                    cfg.vocab_size, dtype=jnp.int32)
+        _, cache0 = mla.prefill(params, prompt, cfg, max_len=32)
+        fed = jax.random.randint(jax.random.PRNGKey(2), (2, 4), 0,
+                                 cfg.vocab_size, dtype=jnp.int32)
+        wide, cache_w = mla.verify_step(params, fed, cache0, cfg)
+        assert (np.asarray(cache_w.length) ==
+                np.asarray(cache0.length)).all()
+        cache = cache0
+        for j in range(4):
+            logits, cache = mla.decode_step(params, fed[:, j], cache,
+                                            cfg)
+            np.testing.assert_allclose(np.asarray(wide[:, j]),
+                                       np.asarray(logits),
+                                       rtol=1e-5, atol=1e-5)
