@@ -1618,10 +1618,13 @@ async def _sse_response(request, engine: InferenceEngine,
         for p in pumps:
             p.cancel()
         # A dropped client must not leave prompts×n slots decoding to
-        # max_tokens with no consumer — cancel every unfinished choice.
+        # max_tokens with no consumer. engine.cancel only reaches
+        # ADMITTED slots; fut.cancel() marks still-QUEUED choices done
+        # so admission skips them (same pair as the overload branch).
         for ch in choices:
             if not ch.fut.done():
                 engine.cancel(ch.fut)
+                ch.fut.cancel()
     await resp.write_eof()
     return resp
 
@@ -1965,10 +1968,9 @@ def build_app(engine: InferenceEngine):
     return app
 
 
-def main() -> None:
-    from skypilot_tpu.utils import jax_utils
-    jax_utils.pin_platform_from_env()
-    from aiohttp import web
+def build_parser() -> argparse.ArgumentParser:
+    """The engine CLI parser (factored out so tests can pin the
+    gang-env defaults against the REAL production parser)."""
     parser = argparse.ArgumentParser(prog='skytpu-engine')
     parser.add_argument('--model', default=None,
                         help='Preset name (models.list_presets); optional '
@@ -2018,7 +2020,14 @@ def main() -> None:
                         default=int(os.environ.get('SKYTPU_SERVE_PORT',
                                                    '8000')))
     parser.add_argument('--host', default='0.0.0.0')
-    args = parser.parse_args()
+    return parser
+
+
+def main() -> None:
+    from skypilot_tpu.utils import jax_utils
+    jax_utils.pin_platform_from_env()
+    from aiohttp import web
+    args = build_parser().parse_args()
     multihost_on = bool(args.coordinator) and args.num_processes > 1
     seed = args.seed
     if multihost_on:

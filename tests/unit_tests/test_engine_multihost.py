@@ -39,7 +39,13 @@ def _post(url, payload, timeout=120):
         return json.loads(r.read())
 
 
-def test_two_process_engine_serves(tmp_path):
+@pytest.mark.parametrize('model,mesh', [
+    ('llama-debug', 'data=2,fsdp=2,tensor=2'),
+    # The DeepSeek/MLA family on a tensor mesh — the reference's
+    # flagship multi-host serving shape (deepseek-r1 over a slice).
+    ('mla-debug', 'tensor=2,data=4'),
+])
+def test_two_process_engine_serves(tmp_path, model, mesh):
     coord_port = _free_port()
     http_port = _free_port()
     env = dict(os.environ)
@@ -51,8 +57,8 @@ def test_two_process_engine_serves(tmp_path):
         'SKYTPU_ENGINE_MAX_BATCH': '8',
     })
     common = [sys.executable, '-m', 'skypilot_tpu.serve.engine',
-              '--model', 'llama-debug', '--max-len', '64',
-              '--mesh', 'data=2,fsdp=2,tensor=2',
+              '--model', model, '--max-len', '64',
+              '--mesh', mesh,
               '--warm-buckets', '16',   # distribution test, lean boot
               '--coordinator', f'127.0.0.1:{coord_port}',
               '--num-processes', '2']
@@ -119,3 +125,26 @@ def test_two_process_engine_serves(tmp_path):
             p.wait(timeout=30)
         for f in logs:
             f.close()
+
+
+def test_engine_flags_default_from_gang_env(monkeypatch):
+    """The slice driver's gang env (skylet/constants.py) IS the engine's
+    multi-host wiring: --coordinator/--num-processes/--process-id
+    default from SKYTPU_COORDINATOR_ADDRESS / SKYTPU_NUM_PROCESSES /
+    SKYTPU_NODE_RANK, so a multi-host `skytpu serve up` replica needs
+    no extra flags in its run command."""
+    monkeypatch.setenv('SKYTPU_COORDINATOR_ADDRESS', '10.0.0.1:8476')
+    monkeypatch.setenv('SKYTPU_NUM_PROCESSES', '4')
+    monkeypatch.setenv('SKYTPU_NODE_RANK', '2')
+    from skypilot_tpu.serve import engine as engine_lib
+    args = engine_lib.build_parser().parse_args([])
+    assert (args.coordinator, args.num_processes, args.process_id) == \
+        ('10.0.0.1:8476', 4, 2)
+    from skypilot_tpu.skylet import constants
+    env = constants.gang_env(cluster_name='c', job_id=1, rank=2,
+                             num_hosts=4, ips=['10.0.0.1'] * 4,
+                             chips_per_host=4, hosts_per_slice=4,
+                             coordinator_ip='10.0.0.1')
+    assert env['SKYTPU_COORDINATOR_ADDRESS'].endswith(
+        str(constants.JAX_COORDINATOR_PORT))
+    assert env['SKYTPU_NUM_PROCESSES'] == '4'
