@@ -31,6 +31,19 @@ def _free_port():
     return port
 
 
+def _coord_port(offset: int) -> int:
+    """Deterministic per-xdist-worker coordinator port. The control
+    channel listens at coordinator+1000 WITHOUT a free-port probe, so
+    both ports must come from a reserved block: coords in
+    34000-34399, controls in 35000-35399 — disjoint from each other
+    and far from the ephemeral range _free_port draws the HTTP port
+    from (a collision here made the leader die at bind under a
+    saturated full-suite run)."""
+    worker = os.environ.get('PYTEST_XDIST_WORKER', 'gw0')
+    idx = int(worker[2:]) if worker[2:].isdigit() else 0
+    return 34000 + 100 * idx + offset
+
+
 def _post(url, payload, timeout=120):
     req = urllib.request.Request(
         url, data=json.dumps(payload).encode(),
@@ -39,14 +52,14 @@ def _post(url, payload, timeout=120):
         return json.loads(r.read())
 
 
-@pytest.mark.parametrize('model,mesh', [
-    ('llama-debug', 'data=2,fsdp=2,tensor=2'),
+@pytest.mark.parametrize('model,mesh,port_offset', [
+    ('llama-debug', 'data=2,fsdp=2,tensor=2', 0),
     # The DeepSeek/MLA family on a tensor mesh — the reference's
     # flagship multi-host serving shape (deepseek-r1 over a slice).
-    ('mla-debug', 'tensor=2,data=4'),
+    ('mla-debug', 'tensor=2,data=4', 7),
 ])
-def test_two_process_engine_serves(tmp_path, model, mesh):
-    coord_port = _free_port()
+def test_two_process_engine_serves(tmp_path, model, mesh, port_offset):
+    coord_port = _coord_port(port_offset)
     http_port = _free_port()
     env = dict(os.environ)
     env.update({
@@ -86,8 +99,9 @@ def test_two_process_engine_serves(tmp_path, model, mesh):
         while time.time() < deadline:
             for i, p in enumerate(procs):
                 if p.poll() is not None:
-                    pytest.fail(f'engine process died rc={p.returncode}'
-                                f':\n{dump(i)}')
+                    pytest.fail(f'engine process {i} died '
+                                f'rc={p.returncode}:\nfollower log:\n'
+                                f'{dump(0)}\nleader log:\n{dump(1)}')
             try:
                 with urllib.request.urlopen(base + '/health',
                                             timeout=2) as r:
