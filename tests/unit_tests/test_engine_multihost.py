@@ -59,7 +59,24 @@ def _post(url, payload, timeout=120):
     ('mla-debug', 'tensor=2,data=4', 7),
 ])
 def test_two_process_engine_serves(tmp_path, model, mesh, port_offset):
-    coord_port = _coord_port(port_offset)
+    # One retry with fresh ports: on a saturated 4-worker suite box, a
+    # starved follower can miss gloo's fixed ~30s collective timeout —
+    # scheduler starvation, not product logic (observed once in ~10
+    # full-suite runs). A genuine regression fails both attempts.
+    last = None
+    for attempt in range(2):
+        last = _run_gang(tmp_path, model, mesh,
+                         _coord_port(port_offset + attempt * 31),
+                         attempt)
+        if last is None:
+            return
+    pytest.fail(last)
+
+
+def _run_gang(tmp_path, model, mesh, coord_port, attempt):
+    """One gang attempt; returns None on success, a failure report
+    string otherwise (assertion errors still raise — they indicate
+    wrong RESULTS, which a retry must not mask)."""
     http_port = _free_port()
     env = dict(os.environ)
     env.update({
@@ -78,13 +95,17 @@ def test_two_process_engine_serves(tmp_path, model, mesh, port_offset):
     procs = []
     # Log to FILES: gloo/XLA chatter would fill an undrained PIPE's
     # 64KB buffer and block the engine mid-warmup.
-    logs = [open(tmp_path / 'p1.log', 'w+b'),
-            open(tmp_path / 'p0.log', 'w+b')]
+    logs = [open(tmp_path / f'p1_{attempt}.log', 'w+b'),
+            open(tmp_path / f'p0_{attempt}.log', 'w+b')]
 
     def dump(i):
         logs[i].flush()
         logs[i].seek(0)
         return logs[i].read().decode(errors='replace')[-4000:]
+
+    def report(what):
+        return (f'{what} (attempt {attempt}):\nfollower log:\n'
+                f'{dump(0)}\nleader log:\n{dump(1)}')
 
     try:
         procs.append(subprocess.Popen(
@@ -99,9 +120,8 @@ def test_two_process_engine_serves(tmp_path, model, mesh, port_offset):
         while time.time() < deadline:
             for i, p in enumerate(procs):
                 if p.poll() is not None:
-                    pytest.fail(f'engine process {i} died '
-                                f'rc={p.returncode}:\nfollower log:\n'
-                                f'{dump(0)}\nleader log:\n{dump(1)}')
+                    return report(f'engine process {i} died '
+                                  f'rc={p.returncode}')
             try:
                 with urllib.request.urlopen(base + '/health',
                                             timeout=2) as r:
@@ -111,16 +131,15 @@ def test_two_process_engine_serves(tmp_path, model, mesh, port_offset):
             except OSError:
                 pass
             time.sleep(2)
-        assert ready, ('engine never became healthy; leader log:\n' +
-                       dump(1))
+        if not ready:
+            return report('engine never became healthy')
 
         try:
             body = _post(base + '/generate',
                          {'tokens': [1, 2, 3, 4, 5],
                           'max_new_tokens': 6})
         except Exception as e:  # pylint: disable=broad-except
-            pytest.fail(f'generate failed ({e}); leader log:\n'
-                        f'{dump(1)}\nfollower log:\n{dump(0)}')
+            return report(f'generate failed ({e})')
         assert len(body['tokens']) == 6
         assert body['finish_reason'] == 'length'
         # Deterministic across calls (seeded RNG, greedy).
@@ -132,6 +151,7 @@ def test_two_process_engine_serves(tmp_path, model, mesh, port_offset):
             'messages': [{'role': 'user', 'content': 'hi'}],
             'max_tokens': 4, 'temperature': 0})
         assert chat['choices'][0]['finish_reason'] in ('stop', 'length')
+        return None
     finally:
         for p in procs:
             p.kill()
