@@ -1,0 +1,35 @@
+"""skylint — AST-based architecture & hazard analyzer.
+
+Enforces the survey's layer contract ("each layer only calls
+downward", PAPER.md §1) and three hazard disciplines (lazy heavy
+imports in the control plane, no blocking calls on the event loop, no
+host syncs under jit) at lint time, over the whole package, with a
+checked-in allowlist for grandfathered violations.
+
+Run it:
+    python -m skypilot_tpu.analysis              # human output
+    python -m skypilot_tpu.analysis --format json
+    skylint                                      # console entry
+
+Tier-1 enforcement lives in tests/unit_tests/test_skylint.py; the
+workflow and layer map rationale in docs/ARCHITECTURE_LINT.md.
+
+Stdlib-only on purpose: parsing, never importing, the analyzed code.
+"""
+from skypilot_tpu.analysis.core import (Violation, load_allowlist,
+                                        run_analysis)
+
+__all__ = ['Violation', 'load_allowlist', 'run_analysis',
+           'default_root', 'default_allowlist_path']
+
+
+def default_root() -> str:
+    """The installed skypilot_tpu package directory."""
+    import os
+    return os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def default_allowlist_path() -> str:
+    import os
+    return os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        'allowlist.txt')

@@ -1,0 +1,88 @@
+"""skylint CLI: `python -m skypilot_tpu.analysis` / `skylint`.
+
+Exit codes: 0 clean (all violations allowlisted), 1 new violations,
+2 usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from skypilot_tpu import analysis
+from skypilot_tpu.analysis import checkers
+from skypilot_tpu.analysis import core
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog='skylint',
+        description='AST-based architecture & hazard analyzer '
+                    '(layer DAG, lazy imports, async-blocking, '
+                    'jit hazards).')
+    parser.add_argument('--root', default=None,
+                        help='Package root to scan (default: the '
+                             'installed skypilot_tpu directory).')
+    parser.add_argument('--format', choices=['text', 'json'],
+                        default='text')
+    parser.add_argument('--allowlist', default=None,
+                        help='Allowlist file (default: the checked-in '
+                             'skypilot_tpu/analysis/allowlist.txt).')
+    parser.add_argument('--no-allowlist', action='store_true',
+                        help='Report every violation as new (what a '
+                             'burn-down session wants to see).')
+    parser.add_argument('--check', action='append', default=None,
+                        metavar='NAME',
+                        help=f'Run only this checker (repeatable). '
+                             f'Available: {", ".join(checkers.names())}')
+    parser.add_argument('--list-checks', action='store_true')
+    return parser
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_checks:
+        for name in checkers.names():
+            print(name)
+        return 0
+    root = args.root or analysis.default_root()
+    if not os.path.isdir(root):
+        print(f'skylint: root {root!r} is not a directory',
+              file=sys.stderr)
+        return 2
+    allowlist = []
+    if not args.no_allowlist:
+        path = args.allowlist or analysis.default_allowlist_path()
+        if os.path.exists(path):
+            allowlist = core.load_allowlist(path)
+        elif args.allowlist:
+            print(f'skylint: allowlist {path!r} not found',
+                  file=sys.stderr)
+            return 2
+    try:
+        report = core.run_analysis(root, checks=args.check,
+                                   allowlist=allowlist)
+    except ValueError as e:
+        print(f'skylint: {e}', file=sys.stderr)
+        return 2
+
+    if args.format == 'json':
+        print(json.dumps(report, indent=2))
+    else:
+        for v in report['violations']:
+            mark = ' (allowlisted)' if v['allowlisted'] else ''
+            print(f"{v['path']}:{v['line']}:{v['col']}: "
+                  f"[{v['check']}] {v['message']}{mark}")
+        print(f"skylint: {report['files_scanned']} files, "
+              f"{report['total']} violation(s) "
+              f"({report['allowlisted']} allowlisted, "
+              f"{report['new']} new).")
+        for stale in report['stale_allowlist_entries']:
+            print(f'skylint: stale allowlist entry (burned down — '
+                  f'delete it): {stale}')
+    return 1 if report['new'] else 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,163 @@
+"""Async-blocking lint: no synchronous stalls on the event loop.
+
+The exact bug class of the round-5 advisor finding: a blocking
+``sendall`` reached from the serve batch loop wedged the whole HTTP
+frontend behind one stalled follower TCP buffer. Anything that parks
+the thread inside an ``async def`` parks EVERY request on that loop.
+
+Two detection hops:
+  1. direct — a known-blocking call in an ``async def`` body (nested
+     ``def``/``async def`` bodies are separate scopes, not entered);
+  2. one-hop — an ``async def`` calls a sync function/method defined
+     in the SAME module whose body contains a blocking call (how the
+     real bug was wired: ``batch_loop`` → ``self._bcast`` → ``send``
+     → ``sendall``). Name-based resolution; cross-module chains are
+     out of scope.
+
+``await``-ed calls are exempt (``await ws.recv()`` is the async API).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu.analysis import core
+
+NAME = 'async-blocking'
+
+# Exact dotted call names that block the calling thread.
+BLOCKING_CALLS = frozenset({
+    'time.sleep',
+    'os.system',
+    'subprocess.run', 'subprocess.call', 'subprocess.check_call',
+    'subprocess.check_output', 'subprocess.getoutput',
+    'subprocess.getstatusoutput',
+    'socket.create_connection',
+    'urllib.request.urlopen',
+})
+# Method names that block when called un-awaited on any object
+# (sockets, threading locks/primitives). Kept tight to stay
+# low-false-positive: each is a blocking primitive by convention.
+BLOCKING_METHODS = frozenset({
+    'sendall', 'recv', 'recv_into', 'accept', 'acquire',
+})
+# Any call on these library roots blocks (sync HTTP clients).
+BLOCKING_ROOTS = frozenset({'requests'})
+
+
+def _alias_map(tree: ast.Module) -> Dict[str, str]:
+    """Local name -> canonical dotted prefix, from module-level imports
+    (`from time import sleep` makes bare `sleep(...)` mean
+    `time.sleep(...)`)."""
+    aliases: Dict[str, str] = {}
+    for stmt, _ in core.module_level_imports(tree):
+        if isinstance(stmt, ast.Import):
+            for a in stmt.names:
+                aliases[a.asname or a.name.split('.')[0]] = \
+                    a.name if a.asname else a.name.split('.')[0]
+        elif isinstance(stmt, ast.ImportFrom) and stmt.level == 0 \
+                and stmt.module:
+            for a in stmt.names:
+                aliases[a.asname or a.name] = f'{stmt.module}.{a.name}'
+    return aliases
+
+
+def _canonical(call: ast.Call, aliases: Dict[str, str]) -> Optional[str]:
+    dotted = core.dotted_name(call.func)
+    if dotted is None:
+        return None
+    head, _, rest = dotted.partition('.')
+    head = aliases.get(head, head)
+    return f'{head}.{rest}' if rest else head
+
+
+def _blocking_reason(call: ast.Call,
+                     aliases: Dict[str, str]) -> Optional[str]:
+    name = _canonical(call, aliases)
+    if name is not None:
+        if name in BLOCKING_CALLS:
+            return name
+        if name.split('.')[0] in BLOCKING_ROOTS and '.' in name:
+            return name
+    if isinstance(call.func, ast.Attribute) and \
+            call.func.attr in BLOCKING_METHODS:
+        return f'.{call.func.attr}'
+    return None
+
+
+def _own_calls(fn: ast.AST) -> List[Tuple[ast.Call, bool]]:
+    """(call, awaited) pairs in `fn`'s own body — nested function
+    scopes excluded."""
+    out: List[Tuple[ast.Call, bool]] = []
+
+    def visit(node: ast.AST, awaited: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            if isinstance(child, ast.Await):
+                visit(child, True)
+                continue
+            if isinstance(child, ast.Call):
+                out.append((child, awaited))
+            visit(child, False)
+
+    visit(fn, False)
+    return out
+
+
+def run(mod: core.ModuleInfo) -> List[core.Violation]:
+    aliases = _alias_map(mod.tree)
+
+    sync_fns: List[ast.FunctionDef] = []
+    async_fns: List[ast.AsyncFunctionDef] = []
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.FunctionDef):
+            sync_fns.append(node)
+        elif isinstance(node, ast.AsyncFunctionDef):
+            async_fns.append(node)
+    if not async_fns:
+        return []
+
+    # Hop 1 prep: sync helpers in this module that block internally.
+    helper_blocks: Dict[str, Tuple[str, int]] = {}
+    for fn in sync_fns:
+        for call, _ in _own_calls(fn):
+            reason = _blocking_reason(call, aliases)
+            if reason is not None:
+                helper_blocks.setdefault(fn.name, (reason, call.lineno))
+                break
+
+    out: List[core.Violation] = []
+    for afn in async_fns:
+        for call, awaited in _own_calls(afn):
+            if awaited:
+                continue
+            reason = _blocking_reason(call, aliases)
+            if reason is not None:
+                out.append(core.Violation(
+                    check=NAME, path=mod.path, line=call.lineno,
+                    col=call.col_offset, key=reason,
+                    message=(
+                        f'blocking call {reason!r} inside '
+                        f'`async def {afn.name}` stalls the event '
+                        f'loop (every in-flight request waits); use '
+                        f'the async API or run_in_executor')))
+                continue
+            # Hop 2: call to a same-module sync helper that blocks.
+            callee = None
+            if isinstance(call.func, ast.Name):
+                callee = call.func.id
+            elif isinstance(call.func, ast.Attribute):
+                callee = call.func.attr
+            if callee in helper_blocks and callee not in aliases:
+                inner, inner_line = helper_blocks[callee]
+                out.append(core.Violation(
+                    check=NAME, path=mod.path, line=call.lineno,
+                    col=call.col_offset, key=f'{callee}->{inner}',
+                    message=(
+                        f'`async def {afn.name}` calls sync helper '
+                        f'{callee!r} which does blocking {inner!r} '
+                        f'(line {inner_line}); the event loop stalls '
+                        f'for the duration')))
+    return out

@@ -1,0 +1,39 @@
+"""Checker registry: name → run(module) -> [Violation].
+
+New checkers register here; `python -m skypilot_tpu.analysis
+--list-checks` and the `--check` CLI filter read this table.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from skypilot_tpu.analysis import async_blocking
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis import jit_hazards
+from skypilot_tpu.analysis import lazy_imports
+from skypilot_tpu.analysis import layers
+
+CheckerFn = Callable[[core.ModuleInfo], List[core.Violation]]
+
+ALL: List[Tuple[str, CheckerFn]] = [
+    (layers.NAME, layers.run),
+    (lazy_imports.NAME, lazy_imports.run),
+    (async_blocking.NAME, async_blocking.run),
+    (jit_hazards.NAME, jit_hazards.run),
+]
+
+
+def names() -> List[str]:
+    return [n for n, _ in ALL]
+
+
+def resolve(
+        selected: Optional[Sequence[str]]) -> List[Tuple[str, CheckerFn]]:
+    if not selected:
+        return list(ALL)
+    by_name = dict(ALL)
+    unknown = [s for s in selected if s not in by_name]
+    if unknown:
+        raise ValueError(
+            f'unknown checker(s) {unknown}; available: {names()}')
+    return [(s, by_name[s]) for s in selected]

@@ -1,0 +1,231 @@
+"""skylint core: module walking, violation model, allowlist, reports.
+
+The analyzer is deliberately stdlib-only (ast + os): it runs in every
+environment the control plane runs in, including bare CI runners with
+no compute extras installed, and it must never import the modules it
+analyzes (parsing only — importing the package under analysis would
+execute control-plane side effects).
+
+A *unit* is the granularity the architecture contract binds: a
+subpackage directory (``serve``, ``provision``) or a top-level module
+(``resources``, ``execution``). Checkers receive parsed modules and
+return :class:`Violation` records; ``run_analysis`` aggregates them,
+applies the allowlist, and builds the machine-readable report.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+PACKAGE = 'skypilot_tpu'
+
+# Report schema version — bump when the JSON shape changes.
+REPORT_VERSION = 1
+
+
+@dataclasses.dataclass
+class Violation:
+    """One finding. ``key`` is the STABLE allowlist handle: it must not
+    contain line numbers (which churn) — it is the imported module, the
+    blocked call's dotted name, etc., so a grandfathered entry survives
+    unrelated edits to the file."""
+    check: str
+    path: str           # repo-relative, '/'-separated
+    line: int
+    col: int
+    key: str
+    message: str
+
+    @property
+    def ident(self) -> str:
+        return f'{self.check}:{self.path}:{self.key}'
+
+    def to_json(self, allowlisted: bool) -> Dict:
+        return {
+            'check': self.check,
+            'path': self.path,
+            'line': self.line,
+            'col': self.col,
+            'key': self.key,
+            'message': self.message,
+            'allowlisted': allowlisted,
+        }
+
+
+@dataclasses.dataclass
+class ModuleInfo:
+    """A parsed module plus the identity facts checkers key off."""
+    path: str           # relative to the scan root, '/'-separated
+    unit: str           # subpackage dir name or top-level module stem
+    dotted: str         # full dotted module path (skypilot_tpu....)
+    tree: ast.Module
+    # Package __init__.py: `dotted` IS the package, so one fewer
+    # component is stripped when resolving relative imports (in a.b's
+    # __init__, `from . import x` means a.b.x, not a.x).
+    is_package: bool = False
+
+
+def iter_py_files(root: str) -> Iterable[str]:
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d != '__pycache__' and
+                             not d.startswith('.'))
+        for f in sorted(filenames):
+            if f.endswith('.py'):
+                yield os.path.join(dirpath, f)
+
+
+def module_info(root: str, abspath: str) -> Optional[ModuleInfo]:
+    rel = os.path.relpath(abspath, root).replace(os.sep, '/')
+    parts = rel[:-3].split('/')
+    is_package = parts[-1] == '__init__'
+    if is_package:
+        parts = parts[:-1]
+    if not parts:
+        # The package's own __init__.py: the public API facade that
+        # re-exports the world — exempt from layering by design.
+        return None
+    unit = parts[0]
+    dotted = '.'.join([PACKAGE] + parts)
+    try:
+        with open(abspath, 'r', encoding='utf-8') as f:
+            tree = ast.parse(f.read(), filename=rel)
+    except SyntaxError as e:
+        raise SyntaxError(f'{rel}: {e}') from e
+    return ModuleInfo(path=rel, unit=unit, dotted=dotted, tree=tree,
+                      is_package=is_package)
+
+
+def _is_type_checking_test(test: ast.expr) -> bool:
+    node = test
+    if isinstance(node, ast.Attribute):
+        return node.attr == 'TYPE_CHECKING'
+    return isinstance(node, ast.Name) and node.id == 'TYPE_CHECKING'
+
+
+def module_level_imports(
+        tree: ast.Module) -> List[Tuple[ast.stmt, bool]]:
+    """Import statements that execute at import time.
+
+    Descends into top-level ``try:`` and ``if`` blocks (optional-dep
+    guards run at import time too) but NOT into ``if TYPE_CHECKING:``
+    bodies — those never execute and are the sanctioned way to type
+    against an upper layer. Returns (stmt, in_type_checking=False)
+    pairs; function bodies are never entered (lazy imports are the
+    sanctioned runtime escape hatch, see docs/ARCHITECTURE_LINT.md).
+    """
+    out: List[Tuple[ast.stmt, bool]] = []
+
+    def visit_block(stmts: Sequence[ast.stmt]) -> None:
+        for node in stmts:
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                out.append((node, False))
+            elif isinstance(node, ast.If):
+                if not _is_type_checking_test(node.test):
+                    visit_block(node.body)
+                visit_block(node.orelse)
+            elif isinstance(node, ast.Try):
+                visit_block(node.body)
+                for h in node.handlers:
+                    visit_block(h.body)
+                visit_block(node.orelse)
+                visit_block(node.finalbody)
+            elif isinstance(node, ast.With):
+                visit_block(node.body)
+    visit_block(tree.body)
+    return out
+
+
+def dotted_name(node: ast.expr) -> Optional[str]:
+    """``a.b.c`` for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return '.'.join(reversed(parts))
+    return None
+
+
+# ---------------------------------------------------------------- allowlist
+
+def load_allowlist(path: str) -> List[str]:
+    """Allowlist file: one ``check:path:key`` ident per line; ``#``
+    comments and blank lines ignored."""
+    entries: List[str] = []
+    with open(path, 'r', encoding='utf-8') as f:
+        for raw in f:
+            line = raw.split('#', 1)[0].strip()
+            if line:
+                entries.append(line)
+    return entries
+
+
+def dump_allowlist(entries: Sequence[str]) -> str:
+    header = ('# skylint allowlist — grandfathered violations.\n'
+              '# One "check:path:key" per line; burn entries down, '
+              'never add without a tracking note.\n')
+    return header + ''.join(f'{e}\n' for e in entries)
+
+
+# ---------------------------------------------------------------- driver
+
+def run_analysis(root: str,
+                 checks: Optional[Sequence[str]] = None,
+                 allowlist: Sequence[str] = ()) -> Dict:
+    """Parse every module under ``root`` and run the checkers.
+
+    Returns the report dict (the JSON mode serializes it verbatim):
+    ``new`` counts non-allowlisted violations — the CI gate is
+    ``new == 0``. Stale allowlist entries (matching nothing) are
+    surfaced so burned-down entries get deleted.
+    """
+    # Imported here (not at module top) to avoid a checkers<->core
+    # import cycle; checkers import core for the shared AST helpers.
+    from skypilot_tpu.analysis import checkers as checkers_lib
+    selected = checkers_lib.resolve(checks)
+
+    modules: List[ModuleInfo] = []
+    for path in iter_py_files(root):
+        info = module_info(root, path)
+        if info is not None:
+            modules.append(info)
+
+    violations: List[Violation] = []
+    seen = set()
+    for name, fn in selected:
+        for mod in modules:
+            for v in fn(mod):
+                # Dedup: e.g. a nested jitted fn inside a jitted fn
+                # reports its hazards once, not per enclosing scope.
+                k = (v.check, v.path, v.line, v.col, v.key)
+                if k not in seen:
+                    seen.add(k)
+                    violations.append(v)
+    violations.sort(key=lambda v: (v.path, v.line, v.check))
+
+    allowset = set(allowlist)
+    used = set()
+    out = []
+    n_allowed = 0
+    for v in violations:
+        allowed = v.ident in allowset
+        if allowed:
+            used.add(v.ident)
+            n_allowed += 1
+        out.append((v, allowed))
+    stale = [e for e in allowlist if e not in used]
+    return {
+        'skylint_version': REPORT_VERSION,
+        'root': os.path.abspath(root),
+        'files_scanned': len(modules),
+        'checks': [name for name, _ in selected],
+        'violations': [v.to_json(a) for v, a in out],
+        'total': len(out),
+        'allowlisted': n_allowed,
+        'new': len(out) - n_allowed,
+        'stale_allowlist_entries': stale,
+    }

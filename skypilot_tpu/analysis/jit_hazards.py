@@ -1,0 +1,145 @@
+"""Jit-hazard lint: no host syncs inside jitted computations.
+
+Inside a ``jax.jit``/``pjit``-compiled function, pulling a concrete
+value to the host — ``.item()``, ``float(x)``/``int(x)`` on a traced
+array, ``np.asarray`` — either fails at trace time
+(ConcretizationTypeError) or, worse, silently forces a device→host
+sync/recompile on every step when the function escapes tracing via a
+callback. These never belong in jitted code.
+
+Jitted functions are found two ways:
+  - decorator: ``@jax.jit``, ``@jit``, ``@pjit``, ``@partial(jax.jit,
+    ...)`` / ``@jax.jit(...)`` parameterized forms;
+  - wrap site: ``name = jax.jit(fn)`` / ``self.x = jax.jit(self._fn)``
+    where the argument resolves to a function/method defined in the
+    same module.
+
+``int()``/``float()`` on shape/metadata expressions (``x.shape[0]``,
+``len(xs)``, ``x.ndim``, ``x.size``) is static under tracing and NOT
+flagged.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from skypilot_tpu.analysis import core
+
+NAME = 'jit-hazards'
+
+_JIT_TAILS = ('jit', 'pjit')
+# Attribute calls that force a host sync on an array value.
+_SYNC_METHODS = frozenset({'item', 'tolist'})
+_NUMPY_NAMES = frozenset({'np', 'numpy'})
+_NUMPY_SYNCS = frozenset({'asarray', 'array'})
+# Metadata attrs that are static python values under tracing.
+_STATIC_ATTRS = frozenset({'shape', 'ndim', 'size', 'dtype'})
+
+
+def _is_jit_expr(node: ast.expr) -> bool:
+    """`jax.jit`, `jit`, `pjit`, `nn.jit` … — a Name/Attribute chain
+    ending in jit/pjit."""
+    dotted = core.dotted_name(node)
+    return dotted is not None and dotted.split('.')[-1] in _JIT_TAILS
+
+
+def _decorator_is_jit(dec: ast.expr) -> bool:
+    if _is_jit_expr(dec):
+        return True
+    if isinstance(dec, ast.Call):
+        # @jax.jit(static_argnums=...) or @partial(jax.jit, ...)
+        if _is_jit_expr(dec.func):
+            return True
+        fn_dotted = core.dotted_name(dec.func) or ''
+        if fn_dotted.split('.')[-1] == 'partial' and dec.args and \
+                _is_jit_expr(dec.args[0]):
+            return True
+    return False
+
+
+def _wrapped_fn_names(tree: ast.Module) -> Set[str]:
+    """Function names passed to a jit wrapper anywhere in the module:
+    `step = jax.jit(_step)`, `self._fn = jax.jit(self._fn_impl)`."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        is_wrap = _is_jit_expr(node.func)
+        if not is_wrap and isinstance(node.func, ast.Call):
+            # functools.partial(jax.jit, ...)(fn) — rare, skip.
+            continue
+        if not is_wrap:
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Name):
+            names.add(arg.id)
+        elif isinstance(arg, ast.Attribute):
+            names.add(arg.attr)
+    return names
+
+
+def _arg_is_static(arg: ast.expr) -> bool:
+    """True when an int()/float() argument is trace-static (constant or
+    shape/metadata arithmetic)."""
+    if isinstance(arg, ast.Constant):
+        return True
+    for sub in ast.walk(arg):
+        if isinstance(sub, ast.Attribute) and sub.attr in _STATIC_ATTRS:
+            return True
+        if isinstance(sub, ast.Call) and \
+                isinstance(sub.func, ast.Name) and sub.func.id == 'len':
+            return True
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            return True     # float('inf') / float('-inf')
+    return False
+
+
+def _hazards_in(fn: ast.AST, mod: core.ModuleInfo,
+                fn_name: str) -> List[core.Violation]:
+    out: List[core.Violation] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        key: Optional[str] = None
+        why = ''
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _SYNC_METHODS:
+            key = f'.{node.func.attr}'
+            why = 'forces a device→host sync of the traced value'
+        elif isinstance(node.func, ast.Name) and \
+                node.func.id in ('float', 'int') and node.args and \
+                not _arg_is_static(node.args[0]):
+            key = node.func.id
+            why = ('concretizes a traced value (fails under jit, or '
+                   'forces a host sync via callback)')
+        elif isinstance(node.func, ast.Attribute):
+            dotted = core.dotted_name(node.func) or ''
+            parts = dotted.split('.')
+            if len(parts) == 2 and parts[0] in _NUMPY_NAMES and \
+                    parts[1] in _NUMPY_SYNCS:
+                key = dotted
+                why = ('materializes the traced array on host; use '
+                       'jnp inside jitted code')
+            elif dotted == 'jax.device_get':
+                key = dotted
+                why = 'forces a device→host transfer'
+        if key is not None:
+            out.append(core.Violation(
+                check=NAME, path=mod.path, line=node.lineno,
+                col=node.col_offset, key=key,
+                message=(f'{key!r} inside jitted function '
+                         f'{fn_name!r}: {why}')))
+    return out
+
+
+def run(mod: core.ModuleInfo) -> List[core.Violation]:
+    wrapped = _wrapped_fn_names(mod.tree)
+    out: List[core.Violation] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        jitted = any(_decorator_is_jit(d) for d in node.decorator_list)
+        if not jitted and node.name not in wrapped:
+            continue
+        out.extend(_hazards_in(node, mod, node.name))
+    return out

@@ -1,0 +1,155 @@
+"""Layer-boundary checker: the downward-only import DAG.
+
+PAPER.md §1's contract — "each layer only calls downward" — encoded as
+a rank per unit. A module-level import is legal iff the target's rank
+is STRICTLY lower, or the target is the importer's own unit. Equal
+ranks are peer planes (``jobs`` vs ``serve``): importing across them
+at module level is exactly the cross-plane coupling the contract
+forbids.
+
+Scope: module-level imports only (incl. optional-dep ``try:`` blocks).
+``if TYPE_CHECKING:`` imports never execute, and function-level lazy
+imports are the sanctioned runtime bridge (the reference breaks its
+clouds→provision dispatch cycle the same way) — both are exempt.
+The full rationale per rank lives in docs/ARCHITECTURE_LINT.md.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from skypilot_tpu.analysis import core
+
+NAME = 'layers'
+
+# Rank per unit; lower = more foundational. Units absent from the map
+# (e.g. a brand-new subpackage) are unconstrained until ranked — add
+# new units here as they land.
+LAYERS = {
+    # 0 — leaf constants / pure data
+    'exceptions': 0,
+    'dashboard': 0,
+    # 1 — logging + TPU topology math (pure, imports only exceptions)
+    'sky_logging': 1,
+    'tpu': 1,
+    # 2 — generic helpers & lazy cloud-SDK adaptors
+    'utils': 2,
+    'adaptors': 2,
+    # 3 — leaf infra libs + pure compute kernels + this analyzer
+    'config': 3,
+    'global_state': 3,
+    'usage': 3,
+    'logs': 3,
+    'users': 3,
+    'native': 3,
+    'workspaces': 3,
+    'authentication': 3,
+    'ops': 3,
+    'parallel': 3,
+    'analysis': 3,
+    # 4-5 — catalog → per-cloud policy
+    'catalog': 4,
+    'clouds': 5,
+    # 6-9 — core abstractions (Resources → Task → Dag → Optimizer)
+    'resources': 6,
+    'task': 7,
+    'dag': 8,
+    'check': 8,
+    'admin_policy': 9,
+    'optimizer': 9,
+    # 10-12 — data plane & model/compute stack
+    'data': 10,
+    'volumes': 10,
+    'cloud_stores': 11,
+    'models': 11,
+    'train': 12,
+    # 12 — on-cluster runtime (library the backend codegens against)
+    'skylet': 12,
+    # 13-16 — provision → backends → core/execution
+    'provision': 13,
+    'backends': 14,
+    'core': 15,
+    'execution': 16,
+    # 17 — peer planes: managed jobs & serve. Same rank on purpose —
+    # module-level imports BETWEEN them are cross-plane violations.
+    'jobs': 17,
+    'serve': 17,
+    # 18-19 — API server → client
+    'server': 18,
+    'client': 19,
+}
+
+
+def _target_units(stmt, mod: core.ModuleInfo) -> List[str]:
+    """Units a module-level import statement binds to (internal only)."""
+    units: List[str] = []
+
+    def from_dotted(name: str) -> Optional[str]:
+        parts = name.split('.')
+        if parts[0] != core.PACKAGE:
+            return None
+        return parts[1] if len(parts) > 1 else None
+
+    if isinstance(stmt, ast.Import):
+        for alias in stmt.names:
+            u = from_dotted(alias.name)
+            if u:
+                units.append(u)
+        return units
+    # ImportFrom — resolve relative imports against the module path.
+    if stmt.level == 0:
+        if stmt.module is None:
+            return units
+        parts = stmt.module.split('.')
+        if parts[0] != core.PACKAGE:
+            return units
+        if len(parts) > 1:
+            units.append(parts[1])
+        else:
+            # `from skypilot_tpu import serve, resources`
+            units.extend(a.name for a in stmt.names)
+        return units
+    # Relative: strip `level` components off the importing module —
+    # one fewer for a package __init__, whose dotted path already IS
+    # the package `.` refers to (in a.b's __init__, `..` means a).
+    parts = mod.dotted.split('.')
+    drop = stmt.level - 1 if mod.is_package else stmt.level
+    base = parts[:len(parts) - drop] if drop else parts
+    if not base or base[0] != core.PACKAGE:
+        return units
+    if stmt.module:
+        full = base + stmt.module.split('.')
+        if len(full) > 1:
+            units.append(full[1])
+    elif len(base) > 1:
+        units.append(base[1])
+    else:
+        # `from . import x` at package root: each name is a unit.
+        units.extend(a.name for a in stmt.names)
+    return units
+
+
+def run(mod: core.ModuleInfo) -> List[core.Violation]:
+    src_rank = LAYERS.get(mod.unit)
+    if src_rank is None:
+        return []
+    out: List[core.Violation] = []
+    for stmt, _ in core.module_level_imports(mod.tree):
+        for unit in _target_units(stmt, mod):
+            if unit == mod.unit:
+                continue
+            dst_rank = LAYERS.get(unit)
+            if dst_rank is None or dst_rank < src_rank:
+                continue
+            kind = ('cross-plane' if dst_rank == src_rank else 'upward')
+            out.append(core.Violation(
+                check=NAME, path=mod.path, line=stmt.lineno,
+                col=stmt.col_offset,
+                key=f'{core.PACKAGE}.{unit}',
+                message=(
+                    f'{kind} import: {mod.unit!r} (layer {src_rank}) '
+                    f'imports {unit!r} (layer {dst_rank}) at module '
+                    f'level; layers may only import strictly downward '
+                    f'— use a function-level lazy import if this is a '
+                    f'sanctioned runtime bridge')))
+    return out

@@ -1,0 +1,73 @@
+"""Lazy-import discipline: heavy deps stay out of control-plane tops.
+
+The control plane (catalog lookups, cloud policy, provisioning, the
+API server, the CLI) must import in milliseconds and run on machines
+with no compute extras installed — `skytpu status` must not pay (or
+crash on) a `import jax` ever. Mirroring the reference's
+``LazyImport`` adaptors (sky/adaptors/common.py), heavy third-party
+deps may only be imported inside functions in these layers, so the
+cost/requirement lands exactly on the code path that needs it.
+
+Compute-plane units (ops/models/train/parallel/data and the serve
+engine's in-replica files) are exempt: they ARE the jax code.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from skypilot_tpu.analysis import core
+
+NAME = 'lazy-imports'
+
+# Third-party roots that are expensive to import, pull in native code,
+# or are optional extras (cloud SDKs).
+HEAVY_ROOTS = frozenset({
+    'jax', 'jaxlib', 'flax', 'optax', 'orbax', 'chex', 'einops',
+    'transformers', 'torch', 'tensorflow', 'numpy', 'pandas', 'scipy',
+    'google', 'googleapiclient', 'kubernetes', 'boto3', 'botocore',
+    'azure', 'ray',
+})
+
+# Units whose module tops must stay light. `serve` is included because
+# its controller/LB/replica-manager half is control plane; the
+# in-replica data-plane files are exempted by path below.
+CONTROL_PLANE_UNITS = frozenset({
+    'adaptors', 'catalog', 'clouds', 'provision', 'backends', 'skylet',
+    'jobs', 'server', 'client', 'serve',
+    # top-level core abstractions + orchestration modules
+    'core', 'execution', 'optimizer', 'resources', 'task', 'dag',
+    'check', 'admin_policy',
+})
+
+# Data-plane files living inside a control-plane unit: the inference
+# engine and its multi-host mirror run ON the slice, next to the chips.
+EXEMPT_PATHS = frozenset({
+    'serve/engine.py',
+    'serve/multihost.py',
+})
+
+
+def run(mod: core.ModuleInfo) -> List[core.Violation]:
+    if mod.unit not in CONTROL_PLANE_UNITS or mod.path in EXEMPT_PATHS:
+        return []
+    out: List[core.Violation] = []
+    for stmt, _ in core.module_level_imports(mod.tree):
+        roots = []
+        if isinstance(stmt, ast.Import):
+            roots = [a.name.split('.')[0] for a in stmt.names]
+        elif isinstance(stmt, ast.ImportFrom) and stmt.level == 0 \
+                and stmt.module:
+            roots = [stmt.module.split('.')[0]]
+        for root in roots:
+            if root in HEAVY_ROOTS:
+                out.append(core.Violation(
+                    check=NAME, path=mod.path, line=stmt.lineno,
+                    col=stmt.col_offset, key=root,
+                    message=(
+                        f'control-plane module imports heavy dep '
+                        f'{root!r} at module top; move it inside the '
+                        f'function that needs it (LazyImport '
+                        f'discipline — keeps `skytpu status` fast and '
+                        f'compute extras optional)')))
+    return out

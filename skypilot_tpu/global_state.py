@@ -15,6 +15,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import sqlite_utils
 from skypilot_tpu.utils.status_lib import ClusterStatus
 
 _DB_PATH_ENV = 'SKYTPU_STATE_DB'
@@ -32,8 +33,7 @@ def _conn() -> sqlite3.Connection:
     # One connection per thread; sqlite locks handle cross-process safety.
     conn = getattr(_local, 'conn', None)
     if conn is None or getattr(_local, 'path', None) != _db_path():
-        conn = sqlite3.connect(_db_path(), timeout=30)
-        conn.execute('PRAGMA journal_mode=WAL')
+        conn = sqlite_utils.connect_wal(_db_path())
         _create_tables(conn)
         _local.conn = conn
         _local.path = _db_path()

@@ -28,14 +28,22 @@ import typing
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import sky_logging
-from skypilot_tpu.serve import core as serve_core
-from skypilot_tpu.serve import serve_state
 from skypilot_tpu.usage import usage_lib
 
 if typing.TYPE_CHECKING:
     from skypilot_tpu import task as task_lib
 
 logger = sky_logging.init_logger(__name__)
+
+
+def _serve():
+    """Lazy cross-plane bridge into the serve plane (skylint layer
+    contract: jobs and serve are peers, so the dependency a pool has on
+    the serve controller stays function-level, same as
+    recovery_strategy's)."""
+    from skypilot_tpu.serve import core as serve_core
+    from skypilot_tpu.serve import serve_state
+    return serve_core, serve_state
 
 
 @usage_lib.tracked('jobs.pool_apply')
@@ -54,6 +62,7 @@ def apply(task: 'task_lib.Task', pool_name: Optional[str] = None,
                          'for services and a `pool:` section for pools.')
     if workers is not None:
         task.service_spec = {**task.service_spec, 'workers': int(workers)}
+    serve_core, serve_state = _serve()
     name = pool_name or task.name or 'pool'
     existing = serve_state.get_service(name)
     if existing is not None and not existing['status'].is_terminal():
@@ -69,6 +78,7 @@ def apply(task: 'task_lib.Task', pool_name: Optional[str] = None,
 def _resize(name: str, record: Dict[str, Any],
             task: 'task_lib.Task') -> Dict[str, Any]:
     from skypilot_tpu.serve import service_spec as spec_lib
+    _, serve_state = _serve()
     new_spec = spec_lib.ServiceSpec.from_yaml_config(task.service_spec)
     old_cfg = dict(record['spec'])
     new_cfg = new_spec.to_yaml_config()
@@ -89,6 +99,7 @@ def _resize(name: str, record: Dict[str, Any],
 
 def status(pool_names: Optional[List[str]] = None) -> List[Dict[str, Any]]:
     """Pool records only (services are `serve status`)."""
+    serve_core, _ = _serve()
     return serve_core.status(pool_names, pool=True)
 
 
@@ -96,6 +107,7 @@ def status(pool_names: Optional[List[str]] = None) -> List[Dict[str, Any]]:
 def down(pool_name: str, purge: bool = False) -> None:
     """Tear a pool down. Jobs still running on its workers lose their
     clusters and will fail recovery (pool gone → FAILED_NO_RESOURCE)."""
+    serve_core, serve_state = _serve()
     record = serve_state.get_service(pool_name)
     if record is not None and not (record['spec'] or {}).get('pool'):
         raise ValueError(f'{pool_name!r} is a service; use `serve down`.')

@@ -91,8 +91,16 @@ def build_mesh(spec: Optional[MeshSpec] = None,
 
 
 def use_mesh(mesh: Mesh):
-    """Context manager putting `mesh` in ambient scope (jax-version compat)."""
-    return jax.set_mesh(mesh)
+    """Context manager putting `mesh` in ambient scope (jax-version compat).
+
+    Deliberately NOT falling back to `with mesh:` on jax versions
+    without set_mesh/use_mesh: the ambient-Mesh context manager has
+    different sharding-resolution semantics and the jitted train step
+    then dies with an XLA abort (process-killing) instead of a clean
+    AttributeError here."""
+    if hasattr(jax, 'set_mesh'):
+        return jax.set_mesh(mesh)
+    return jax.sharding.use_mesh(mesh)
 
 
 def single_device_mesh(device: Optional[jax.Device] = None) -> Mesh:

@@ -1850,7 +1850,14 @@ def build_app(engine: InferenceEngine):
             stop_strings = body.get('stop')
             _truncate_at_stop_strings('', stop_strings)
             want_logprobs, top_n = _parse_logprobs(body, chat=True)
-            n, _ = _parse_n(body)      # chat has no best_of
+            if body.get('best_of') is not None:
+                # Reject loudly, like the completions endpoint rejects
+                # unsupported shapes — validating best_of and then
+                # silently ignoring it (the old behavior) returns
+                # results the client did not ask for.
+                raise ValueError('best_of is not supported on '
+                                 '/v1/chat/completions; use n')
+            n, _ = _parse_n(body)
         except (TypeError, ValueError) as e:
             return bad(f'invalid request: {e}')
         msg = _check_len(engine, tokens, max_new)
@@ -2032,6 +2039,7 @@ def main() -> None:
     seed = args.seed
     if multihost_on:
         from skypilot_tpu.serve import multihost
+        multihost.require_token()   # refuse guessable tokens pre-boot
         multihost.init_distributed(args.coordinator, args.num_processes,
                                    args.process_id)
         if not args.mesh:

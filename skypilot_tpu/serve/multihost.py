@@ -35,7 +35,9 @@ Env contract (set by skylet/slice_driver.py for gang jobs):
 SKYTPU_COORDINATOR_ADDRESS, SKYTPU_NUM_PROCESSES, SKYTPU_NODE_RANK —
 the engine's --coordinator/--num-processes/--process-id default to
 these, so `skytpu serve up` on a multi-host slice needs no extra
-flags.
+flags — plus SKYTPU_MH_TOKEN, a per-job random secret authenticating
+the control channel (startup refuses to run without it; see
+_resolve_token).
 """
 from __future__ import annotations
 
@@ -56,13 +58,42 @@ logger = sky_logging.init_logger(__name__)
 CONTROL_PORT_OFFSET = 1000
 CONNECT_TIMEOUT_S = float(os.environ.get('SKYTPU_MH_CONNECT_TIMEOUT',
                                          '120'))
+# Per-broadcast send budget: a follower whose TCP buffer stays full
+# this long is wedged, and the documented contract is to fail the
+# replica loudly so the slice driver restarts the gang — NOT to park
+# the leader's event-loop thread (and with it the whole HTTP frontend)
+# inside sendall forever.
+SEND_TIMEOUT_S = float(os.environ.get('SKYTPU_MH_SEND_TIMEOUT', '20'))
 # Handshake magic + shared token: a follower must prove it belongs to
 # this gang before the leader counts it (and before it receives request
 # payloads); anything else connecting to the port is dropped. The token
 # rides the gang env like the coordinator address does.
 _MAGIC = b'SKYTPU-MH1'
-_TOKEN = os.environ.get('SKYTPU_MH_TOKEN',
-                        os.environ.get('SKYTPU_JOB_ID', 'local'))
+
+
+def _resolve_token() -> str:
+    """The control-channel secret (SKYTPU_MH_TOKEN, exported per-job by
+    the slice driver's gang env).
+
+    The leader binds 0.0.0.0 and ships request payloads (user prompts)
+    to anything passing the HMAC handshake, so a guessable secret —
+    the old 'local' / SKYTPU_JOB_ID (a small integer) fallback — lets
+    a port squatter claim a follower slot and read traffic. Multi-host
+    startup now REFUSES to run without a real token; the escape hatch
+    (SKYTPU_MH_ALLOW_INSECURE_TOKEN=1) exists for loopback debugging
+    only."""
+    token = os.environ.get('SKYTPU_MH_TOKEN')
+    if token:
+        return token
+    if os.environ.get('SKYTPU_MH_ALLOW_INSECURE_TOKEN') == '1':
+        return os.environ.get('SKYTPU_JOB_ID', 'local')
+    raise RuntimeError(
+        'multi-host serving needs SKYTPU_MH_TOKEN (a per-job random '
+        'secret; the slice driver exports it alongside '
+        'SKYTPU_COORDINATOR_ADDRESS). Refusing the guessable '
+        "'local'/job-id fallback — set "
+        'SKYTPU_MH_ALLOW_INSECURE_TOKEN=1 only for loopback '
+        'debugging.')
 
 
 class _SafeUnpickler(pickle.Unpickler):
@@ -73,6 +104,14 @@ class _SafeUnpickler(pickle.Unpickler):
     def find_class(self, module, name):
         raise pickle.UnpicklingError(
             f'control channel refuses class {module}.{name}')
+
+
+def require_token() -> None:
+    """Fail-fast preflight for multi-host startup: raise the
+    _resolve_token refusal BEFORE jax.distributed joins and the model
+    builds, so a missing SKYTPU_MH_TOKEN surfaces in seconds with a
+    clear message instead of after minutes of boot."""
+    _resolve_token()
 
 
 def control_address(coordinator: str) -> Tuple[str, int]:
@@ -135,7 +174,7 @@ class ControlLeader:
         srv.settimeout(CONNECT_TIMEOUT_S)
         deadline = time.time() + CONNECT_TIMEOUT_S
         self._conns = []
-        want = _MAGIC + hmac.new(_TOKEN.encode(), _MAGIC,
+        want = _MAGIC + hmac.new(_resolve_token().encode(), _MAGIC,
                                  'sha256').digest()
         while len(self._conns) < num_processes - 1:
             if time.time() > deadline:
@@ -146,7 +185,11 @@ class ControlLeader:
                 got = _recv_exact(conn, len(want))
                 if not hmac.compare_digest(got, want):
                     raise ConnectionError('bad handshake')
-                conn.settimeout(None)
+                # Leave a SEND timeout armed for the broadcast path: a
+                # wedged follower (full TCP buffer) must surface as
+                # OSError in send() — the fail-the-replica path — not
+                # block the event-loop thread in sendall forever.
+                conn.settimeout(SEND_TIMEOUT_S)
             except (OSError, ConnectionError) as e:
                 logger.warning(f'rejecting connection from {addr}: {e}')
                 conn.close()
@@ -157,16 +200,20 @@ class ControlLeader:
         srv.close()
 
     def send(self, op: Tuple) -> None:
-        """Broadcast; a dead follower is FATAL — the replica's
-        collectives can no longer complete, so exit loudly and let the
-        slice driver restart the gang (the reference's multi-host vLLM
-        replicas fail the same way)."""
+        """Broadcast; a dead OR wedged follower is FATAL — the
+        replica's collectives can no longer complete, so exit loudly
+        and let the slice driver restart the gang (the reference's
+        multi-host vLLM replicas fail the same way). The per-conn send
+        timeout (SEND_TIMEOUT_S) turns a stalled follower into
+        socket.timeout (an OSError) instead of parking this thread —
+        the serve batch loop — in sendall indefinitely."""
         for conn in self._conns:
             try:
                 _send_msg(conn, op)
             except OSError as e:
-                logger.error(f'control follower lost ({e}); failing '
-                             f'the replica so the gang restarts.')
+                logger.error(f'control follower lost or wedged ({e}); '
+                             f'failing the replica so the gang '
+                             f'restarts.')
                 os._exit(13)
 
 
@@ -184,8 +231,8 @@ class ControlFollower:
                     raise
                 time.sleep(0.2)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._sock.sendall(_MAGIC + hmac.new(_TOKEN.encode(), _MAGIC,
-                                             'sha256').digest())
+        self._sock.sendall(_MAGIC + hmac.new(_resolve_token().encode(),
+                                             _MAGIC, 'sha256').digest())
         # The connect timeout must NOT persist: ops arrive whenever
         # traffic does — an idle engine would kill the channel.
         self._sock.settimeout(None)

@@ -11,6 +11,7 @@ import sqlite3
 import time
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu.utils import sqlite_utils
 from skypilot_tpu.utils import vclock
 
 _DB_PATH_ENV = 'SKYTPU_SERVE_DB'
@@ -59,8 +60,7 @@ def _db_path() -> str:
 
 
 def _conn() -> sqlite3.Connection:
-    conn = sqlite3.connect(_db_path(), timeout=30)
-    conn.execute('PRAGMA journal_mode=WAL')
+    conn = sqlite_utils.connect_wal(_db_path())
     conn.execute("""
         CREATE TABLE IF NOT EXISTS services (
             name TEXT PRIMARY KEY,
@@ -236,19 +236,29 @@ def get_replicas(service: str) -> List[Dict[str, Any]]:
 def acquire_worker(service: str, job_id: int) -> Optional[Dict[str, Any]]:
     """Atomically claim one READY, unassigned pool worker for a managed
     job. Returns its replica record, or None when every worker is busy
-    (the caller queues). The single UPDATE makes concurrent controllers
-    claim distinct workers — sqlite serializes writers."""
+    (the caller queues). BEGIN IMMEDIATE takes sqlite's single write
+    lock up front, so the SELECT-then-UPDATE is atomic against
+    concurrent controllers (and portable: sqlite < 3.35 has no
+    UPDATE...RETURNING)."""
     with _conn() as conn:
         conn.row_factory = sqlite3.Row
-        cur = conn.execute(
-            'UPDATE replicas SET job_id = ? WHERE rowid = ('
-            '  SELECT rowid FROM replicas WHERE service = ? AND '
-            "  status = 'READY' AND job_id IS NULL ORDER BY replica_id "
-            '  LIMIT 1) AND job_id IS NULL RETURNING *', (job_id, service))
-        row = cur.fetchone()
+        # Unconditional: if a future refactor ever hands us a
+        # connection that is already mid-transaction, the claim's
+        # atomicity is gone — fail loudly here, don't degrade to a
+        # read-locked SELECT that lets two controllers claim the same
+        # worker.
+        conn.execute('BEGIN IMMEDIATE')
+        row = conn.execute(
+            'SELECT rowid AS _rowid, * FROM replicas WHERE service = ? '
+            "AND status = 'READY' AND job_id IS NULL ORDER BY replica_id "
+            'LIMIT 1', (service,)).fetchone()
         if row is None:
             return None
+        conn.execute('UPDATE replicas SET job_id = ? WHERE rowid = ?',
+                     (job_id, row['_rowid']))
         d = dict(row)
+        d.pop('_rowid')
+        d['job_id'] = job_id
         d['status'] = ReplicaStatus(d['status'])
         return d
 

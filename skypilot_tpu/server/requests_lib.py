@@ -14,6 +14,8 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu.utils import sqlite_utils
+
 SHORT = 'SHORT'
 LONG = 'LONG'
 
@@ -43,8 +45,7 @@ def _db_path() -> str:
 
 
 def _conn() -> sqlite3.Connection:
-    conn = sqlite3.connect(_db_path(), timeout=30.0)
-    conn.execute('PRAGMA journal_mode=WAL')
+    conn = sqlite_utils.connect_wal(_db_path())
     conn.execute("""CREATE TABLE IF NOT EXISTS requests (
         request_id TEXT PRIMARY KEY,
         name TEXT,
@@ -110,22 +111,28 @@ def next_pending(schedule_type: str) -> Optional[Dict[str, Any]]:
     """Atomically claim the oldest unclaimed NEW request of this type.
 
     Claimed = started_at set (NEW→RUNNING happens later, in the runner).
-    The claim must be one UPDATE with the eligibility filter inside it:
-    a SELECT-then-guarded-UPDATE that can land on a just-claimed row
-    returns None while work is still queued, and the scheduler's idle
-    backoff then paces a busy queue at 5 claims/s (caught by
-    tests/load_tests/test_load_on_server.py)."""
+    The claim must not race: a SELECT-then-guarded-UPDATE that can land
+    on a just-claimed row returns None while work is still queued, and
+    the scheduler's idle backoff then paces a busy queue at 5 claims/s
+    (caught by tests/load_tests/test_load_on_server.py). BEGIN
+    IMMEDIATE takes sqlite's single write lock before the SELECT, so no
+    other dispatcher can claim between our SELECT and UPDATE — same
+    atomicity as the previous UPDATE...RETURNING form, but portable to
+    sqlite < 3.35."""
     with _conn() as conn:
+        # Unconditional: a connection already mid-transaction would
+        # silently lose the write lock this claim's atomicity rests
+        # on — better to fail loudly than double-claim.
+        conn.execute('BEGIN IMMEDIATE')
         row = conn.execute(
-            'UPDATE requests SET started_at=? WHERE request_id = ('
-            '  SELECT request_id FROM requests WHERE status=? AND '
-            '  schedule_type=? AND started_at IS NULL '
-            '  ORDER BY created_at LIMIT 1) '
-            'AND started_at IS NULL RETURNING request_id',
-            (time.time(), RequestStatus.NEW.value,
-             schedule_type)).fetchone()
+            'SELECT request_id FROM requests WHERE status=? AND '
+            'schedule_type=? AND started_at IS NULL '
+            'ORDER BY created_at LIMIT 1',
+            (RequestStatus.NEW.value, schedule_type)).fetchone()
         if row is None:
             return None
+        conn.execute('UPDATE requests SET started_at=? '
+                     'WHERE request_id=?', (time.time(), row[0]))
     return get(row[0])
 
 

@@ -9,7 +9,7 @@ coordinator address for jax.distributed.initialize).
 """
 from __future__ import annotations
 
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 SKYTPU_RUNTIME_DIR_ENV = 'SKYTPU_RUNTIME_DIR'
 DEFAULT_RUNTIME_DIR = '~/.skytpu_runtime'
@@ -58,13 +58,19 @@ def gang_env(*,
              slice_index: int = 0,
              num_slices: int = 1,
              hosts_per_slice: int = 1,
-             coordinator_ip: str = '127.0.0.1') -> Dict[str, str]:
+             coordinator_ip: str = '127.0.0.1',
+             mh_token: Optional[str] = None) -> Dict[str, str]:
     """The full per-host env block for one gang member.
 
     - SKYPILOT_*: GPU-era contract (NUM_GPUS_PER_NODE carries chips/host so
       `torchrun --nproc_per_node $SKYPILOT_NUM_GPUS_PER_NODE` keeps working).
     - TPU_WORKER_*: what libtpu/torch-xla expect on TPU VMs.
     - MEGASCALE_*: DCN multi-slice wiring for JAX (num_slices > 1).
+    - SKYTPU_MH_TOKEN (`mh_token`): per-JOB random secret for the
+      multi-host serve control channel (serve/multihost.py refuses the
+      old guessable job-id fallback). The caller draws it ONCE per gang
+      — every rank must carry the same value — so it is a parameter
+      here, not generated per call.
     """
     worker_id = rank % hosts_per_slice if hosts_per_slice else rank
     env = {
@@ -88,6 +94,8 @@ def gang_env(*,
             f'{coordinator_ip}:{JAX_COORDINATOR_PORT}',
         'SKYTPU_NUM_PROCESSES': str(num_hosts),
     }
+    if mh_token:
+        env['SKYTPU_MH_TOKEN'] = mh_token
     if num_slices > 1:
         env.update({
             'MEGASCALE_COORDINATOR_ADDRESS': coordinator_ip,

@@ -18,6 +18,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.skylet import constants
+from skypilot_tpu.utils import sqlite_utils
 from skypilot_tpu.utils.status_lib import JobStatus
 
 
@@ -34,8 +35,7 @@ def _db_path() -> str:
 
 
 def _conn() -> sqlite3.Connection:
-    conn = sqlite3.connect(_db_path(), timeout=30)
-    conn.execute('PRAGMA journal_mode=WAL')
+    conn = sqlite_utils.connect_wal(_db_path())
     conn.execute("""
         CREATE TABLE IF NOT EXISTS jobs (
             job_id INTEGER PRIMARY KEY AUTOINCREMENT,

@@ -23,6 +23,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import secrets
 import subprocess
 import sys
 import threading
@@ -147,6 +148,11 @@ def run_gang(spec: Dict[str, Any]) -> int:
 
     ips = [h['ip'] for h in hosts]
     coordinator_ip = ips[0] if ips else '127.0.0.1'
+    # One random control-channel secret per JOB, identical on every
+    # rank (serve/multihost.py refuses to start without it). A
+    # user-supplied SKYTPU_MH_TOKEN in the job's envs wins — restarts
+    # orchestrated outside the driver may need a stable token.
+    mh_token = user_envs.get('SKYTPU_MH_TOKEN') or secrets.token_hex(16)
 
     job_lib.set_status(job_id, JobStatus.RUNNING, pid=os.getpid())
 
@@ -170,6 +176,7 @@ def run_gang(spec: Dict[str, Any]) -> int:
                     num_slices=num_slices,
                     hosts_per_slice=hosts_per_slice,
                     coordinator_ip=coordinator_ip,
+                    mh_token=mh_token,
                 ))
             env.update(host.get('extra_env', {}))
             cmd = _build_rank_command(host, run_cmd, env,

@@ -1,0 +1,33 @@
+"""Shared sqlite connect helper for the control-plane state DBs.
+
+Every state DB (clusters, serve replicas, managed jobs, API-server
+requests, on-cluster job queue) runs in WAL mode so concurrent
+readers never block the single writer. One subtlety makes a shared
+helper worth having: converting a fresh DELETE-mode db to WAL needs an
+exclusive lock, and (observed on sqlite 3.34) two connections doing it
+concurrently can get an immediate 'database is locked' WITHOUT the
+busy timeout being honored — exactly the shape of two concurrent first
+launches, pool claims, or dispatcher polls. The retry below absorbs
+that race everywhere instead of each module rediscovering it.
+"""
+from __future__ import annotations
+
+import sqlite3
+import time
+
+_WAL_RETRIES = 50
+_WAL_RETRY_SLEEP_S = 0.05
+
+
+def connect_wal(path: str, timeout: float = 30.0) -> sqlite3.Connection:
+    """sqlite3.connect + retried `PRAGMA journal_mode=WAL`."""
+    conn = sqlite3.connect(path, timeout=timeout)
+    for attempt in range(_WAL_RETRIES):
+        try:
+            conn.execute('PRAGMA journal_mode=WAL')
+            break
+        except sqlite3.OperationalError:
+            if attempt == _WAL_RETRIES - 1:
+                raise
+            time.sleep(_WAL_RETRY_SLEEP_S)
+    return conn

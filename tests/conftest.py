@@ -39,6 +39,39 @@ jax.config.update('jax_platforms', 'cpu')
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    """Parallel by default, serial as the fallback.
+
+    The old `addopts = "-n 4 --dist loadscope"` made a missing
+    pytest-xdist a hard usage error for the whole suite. Instead, when
+    the xdist plugin is registered and no -n/--dist was given, set its
+    options here — a rootdir conftest's pytest_configure runs before
+    xdist's own (hooks fire in reverse registration order), so the
+    plugin activates exactly as if the flags were passed. Without
+    xdist (or with `-p no:xdist`) this is a no-op and the suite runs
+    serially. loadscope keeps module-scoped jit fixtures shared within
+    a worker.
+    """
+    if not config.pluginmanager.hasplugin('xdist'):
+        return
+    if os.environ.get('PYTEST_XDIST_WORKER'):
+        return      # already inside a worker process
+    # xdist's own --pdb incompatibility check ran in
+    # pytest_cmdline_main, BEFORE this hook — injecting workers now
+    # would silently detach breakpoints from the terminal.
+    if config.getoption('usepdb', False):
+        return
+    # Only when neither -n nor --dist was given (numprocesses None is
+    # xdist's parser default; an explicit `-n0` arrives as 0 and must
+    # stay serial; an explicit --dist choice must not be clobbered).
+    if any(str(a).startswith('--dist') for a in
+           config.invocation_params.args):
+        return
+    if getattr(config.option, 'numprocesses', 'absent') is None:
+        config.option.numprocesses = 4
+        config.option.dist = 'loadscope'
+
+
 @pytest.fixture
 def enable_local_cloud(monkeypatch):
     """Analog of the reference's enable_all_clouds fixture: only the Local

@@ -179,6 +179,19 @@ class TestEngine:
         assert len(content) == 2
         assert all(c['logprob'] < 0 for c in content)
 
+    def test_chat_rejects_best_of(self, engine):
+        """ADVICE r5 low: chat has no best_of — reject it loudly (the
+        old behavior validated best_of then silently ignored it)."""
+        async def fn(client):
+            r = await client.post('/v1/chat/completions', json={
+                'messages': [{'role': 'user', 'content': 'hi'}],
+                'max_tokens': 2, 'best_of': 3})
+            return r.status, await r.json()
+
+        status, body = _with_client(engine, fn)
+        assert status == 400
+        assert 'best_of' in body['error']['message']
+
     def test_streaming_n_and_batched_prompts(self, engine):
         """n>1 AND batched prompts stream: chunks carry per-choice
         indexes, every choice finishes, and assembling each index's

@@ -11,6 +11,7 @@ sky/backends/cloud_vm_ray_backend.py:6439-6452).
 """
 import json
 import os
+import secrets
 import socket
 import subprocess
 import sys
@@ -85,6 +86,10 @@ def _run_gang(tmp_path, model, mesh, coord_port, attempt):
         'PYTHONPATH': REPO,
         # The engine batch must stay divisible by data*fsdp=4.
         'SKYTPU_ENGINE_MAX_BATCH': '8',
+        # Per-job random control-channel secret — the same contract the
+        # slice driver's gang env provides; multi-host startup refuses
+        # the old guessable job-id fallback.
+        'SKYTPU_MH_TOKEN': secrets.token_hex(16),
     })
     common = [sys.executable, '-m', 'skypilot_tpu.serve.engine',
               '--model', model, '--max-len', '64',
@@ -182,3 +187,93 @@ def test_engine_flags_default_from_gang_env(monkeypatch):
     assert env['SKYTPU_COORDINATOR_ADDRESS'].endswith(
         str(constants.JAX_COORDINATOR_PORT))
     assert env['SKYTPU_NUM_PROCESSES'] == '4'
+
+
+def test_control_channel_refuses_guessable_token(monkeypatch):
+    """ADVICE r5 medium: the leader binds 0.0.0.0 and ships request
+    payloads to anything passing the HMAC handshake, so the guessable
+    'local'/job-id fallback secret is refused at startup; only an
+    explicit loopback-debug escape hatch restores it."""
+    from skypilot_tpu.serve import multihost
+    monkeypatch.delenv('SKYTPU_MH_TOKEN', raising=False)
+    monkeypatch.delenv('SKYTPU_MH_ALLOW_INSECURE_TOKEN', raising=False)
+    monkeypatch.setenv('SKYTPU_JOB_ID', '7')
+    with pytest.raises(RuntimeError, match='SKYTPU_MH_TOKEN'):
+        multihost._resolve_token()
+    monkeypatch.setenv('SKYTPU_MH_ALLOW_INSECURE_TOKEN', '1')
+    assert multihost._resolve_token() == '7'
+    monkeypatch.setenv('SKYTPU_MH_TOKEN', 'per-job-secret')
+    assert multihost._resolve_token() == 'per-job-secret'
+
+
+def test_leader_send_timeout_armed(monkeypatch):
+    """ADVICE r5 low: follower sockets must carry a SEND timeout so a
+    wedged follower surfaces as OSError in ControlLeader.send (the
+    fail-the-replica path) instead of parking the event-loop thread in
+    sendall. Drives a real handshake over loopback and inspects the
+    accepted socket's timeout."""
+    import threading
+    from skypilot_tpu.serve import multihost
+    monkeypatch.setenv('SKYTPU_MH_TOKEN', 'tok')
+    coord_port = _coord_port(90)
+    coordinator = f'127.0.0.1:{coord_port - multihost.CONTROL_PORT_OFFSET}'
+    follower_sock = {}
+
+    def follower():
+        f = multihost.ControlFollower(coordinator)
+        follower_sock['sock'] = f._sock
+
+    t = threading.Thread(target=follower, daemon=True)
+    t.start()
+    leader = multihost.ControlLeader(coordinator, num_processes=2)
+    t.join(timeout=10)
+    assert not t.is_alive()
+    try:
+        (conn,) = leader._conns
+        assert conn.gettimeout() == multihost.SEND_TIMEOUT_S
+        # The channel still works with the timeout armed.
+        leader.send(('step', 3))
+        assert multihost._recv_msg(follower_sock['sock']) == ('step', 3)
+    finally:
+        for c in leader._conns:
+            c.close()
+        follower_sock['sock'].close()
+
+
+def test_slice_driver_exports_one_token_per_gang(tmp_path, monkeypatch):
+    """The slice driver draws ONE random SKYTPU_MH_TOKEN per job and
+    every rank sees the same value (a per-rank draw would make the
+    followers' handshake HMAC never match the leader's)."""
+    from skypilot_tpu.skylet import job_lib, slice_driver
+    import importlib
+    monkeypatch.setenv('SKYTPU_RUNTIME_DIR', str(tmp_path / 'rt'))
+    (tmp_path / 'rt').mkdir()
+    importlib.reload(job_lib)
+    try:
+        job_id = job_lib.add_job('gang', 'tester', 'echo', 2)
+        out = tmp_path / 'out'
+        out.mkdir()
+        spec = {
+            'job_id': job_id,
+            'cluster_name': 'tok',
+            'hosts': [
+                {'kind': 'local', 'ip': '127.0.0.1', 'slice_index': 0,
+                 'worker_id': 0, 'workdir': str(tmp_path)},
+                {'kind': 'local', 'ip': '127.0.0.1', 'slice_index': 0,
+                 'worker_id': 1, 'workdir': str(tmp_path)},
+            ],
+            'run_cmd': (f'echo "$SKYTPU_MH_TOKEN" '
+                        f'> {out}/r$SKYTPU_NODE_RANK'),
+            'envs': {},
+            'chips_per_host': 1,
+            'num_slices': 1,
+            'log_dir': str(tmp_path / 'logs'),
+        }
+        assert slice_driver.run_gang(spec) == 0
+        t0 = (out / 'r0').read_text().strip()
+        t1 = (out / 'r1').read_text().strip()
+        assert t0 == t1
+        assert len(t0) == 32 and t0 not in ('local', str(job_id))
+    finally:
+        monkeypatch.undo()
+        importlib.reload(job_lib)

@@ -1,0 +1,402 @@
+"""skylint: the architecture contract, enforced in tier-1.
+
+Two halves:
+  1. Checker unit tests on synthetic fixture trees (positive AND
+     negative cases per checker, allowlist round-trip, JSON schema).
+  2. The enforcement test: every checker over the LIVE package with
+     the checked-in allowlist — any new violation fails this suite,
+     so PAPER.md §1's "each layer only calls downward" is a gate on
+     every future PR, not a survey aspiration.
+
+Plus injection tests (fixture COPIES of real modules with a planted
+upward import / blocking call) proving the analyzer catches
+regressions in real code shapes, and a regression fixture distilled
+from the PRE-FIX multihost ControlLeader (ADVICE r5: blocking sendall
+reachable from the serve batch loop).
+"""
+import json
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from skypilot_tpu import analysis
+from skypilot_tpu.analysis import core
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+PKG = os.path.join(REPO, 'skypilot_tpu')
+
+
+def _write(root, rel, src):
+    path = os.path.join(root, rel)
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, 'w', encoding='utf-8') as f:
+        f.write(textwrap.dedent(src))
+    return path
+
+
+def _run(root, checks=None, allowlist=()):
+    return core.run_analysis(str(root), checks=checks,
+                             allowlist=allowlist)
+
+
+def _idents(report):
+    return [v['check'] + ':' + v['path'] + ':' + v['key']
+            for v in report['violations']]
+
+
+# ------------------------------------------------------------ layers
+
+class TestLayerChecker:
+
+    def test_upward_and_cross_plane_flagged(self, tmp_path):
+        _write(tmp_path, 'clouds/x.py',
+               'from skypilot_tpu import backends\n')
+        _write(tmp_path, 'jobs/y.py',
+               'from skypilot_tpu.serve import core\n')
+        report = _run(tmp_path, checks=['layers'])
+        assert sorted(_idents(report)) == [
+            'layers:clouds/x.py:skypilot_tpu.backends',
+            'layers:jobs/y.py:skypilot_tpu.serve',
+        ]
+        assert 'upward' in report['violations'][0]['message']
+        assert 'cross-plane' in report['violations'][1]['message']
+
+    def test_downward_same_unit_and_unranked_ok(self, tmp_path):
+        _write(tmp_path, 'serve/ok.py', '''\
+            from skypilot_tpu import exceptions
+            from skypilot_tpu.backends import slice_backend
+            from skypilot_tpu.serve import serve_state
+            from skypilot_tpu.brand_new_unit import thing
+            import os
+        ''')
+        assert _run(tmp_path, checks=['layers'])['total'] == 0
+
+    def test_lazy_and_type_checking_exempt(self, tmp_path):
+        _write(tmp_path, 'clouds/bridge.py', '''\
+            import typing
+            if typing.TYPE_CHECKING:
+                from skypilot_tpu import backends
+
+            def dispatch():
+                from skypilot_tpu.provision import provisioner
+                return provisioner
+        ''')
+        assert _run(tmp_path, checks=['layers'])['total'] == 0
+
+    def test_relative_import_resolved(self, tmp_path):
+        # `from .. import server` inside jobs/ is an upward import even
+        # though the text never says "skypilot_tpu".
+        _write(tmp_path, 'jobs/z.py', 'from .. import server\n')
+        report = _run(tmp_path, checks=['layers'])
+        assert _idents(report) == ['layers:jobs/z.py:skypilot_tpu.server']
+
+    def test_relative_import_in_package_init(self, tmp_path):
+        # In a.b's __init__, `.` is a.b itself and `..` is a — one
+        # fewer strip than in a plain module. `from . import core`
+        # must resolve to serve.core (self, fine), NOT the top-level
+        # 'core' unit; `from .. import jobs` is the cross-plane
+        # violation spelled relatively.
+        _write(tmp_path, 'serve/__init__.py',
+               'from . import core\nfrom .. import jobs\n')
+        report = _run(tmp_path, checks=['layers'])
+        assert _idents(report) == ['layers:serve/__init__.py:'
+                                   'skypilot_tpu.jobs']
+
+    def test_try_block_import_counted(self, tmp_path):
+        # Optional-dep guards run at import time — not exempt.
+        _write(tmp_path, 'catalog/t.py', '''\
+            try:
+                from skypilot_tpu import execution
+            except ImportError:
+                execution = None
+        ''')
+        assert _run(tmp_path, checks=['layers'])['total'] == 1
+
+
+# ------------------------------------------------------------ lazy imports
+
+class TestLazyImportChecker:
+
+    def test_heavy_top_level_flagged_in_control_plane(self, tmp_path):
+        _write(tmp_path, 'provision/p.py',
+               'import jax\nfrom google.cloud import storage\n')
+        report = _run(tmp_path, checks=['lazy-imports'])
+        assert sorted(v['key'] for v in report['violations']) == \
+            ['google', 'jax']
+
+    def test_function_level_and_compute_plane_ok(self, tmp_path):
+        _write(tmp_path, 'server/s.py', '''\
+            def handler():
+                import jax
+                return jax
+        ''')
+        _write(tmp_path, 'models/m.py', 'import jax\nimport numpy\n')
+        _write(tmp_path, 'ops/o.py', 'import jax.numpy as jnp\n')
+        assert _run(tmp_path, checks=['lazy-imports'])['total'] == 0
+
+    def test_serve_engine_exempt_but_controller_not(self, tmp_path):
+        _write(tmp_path, 'serve/engine.py', 'import jax\n')
+        _write(tmp_path, 'serve/controller.py', 'import jax\n')
+        report = _run(tmp_path, checks=['lazy-imports'])
+        assert _idents(report) == ['lazy-imports:serve/controller.py:jax']
+
+
+# ------------------------------------------------------------ async blocking
+
+class TestAsyncBlockingChecker:
+
+    def test_direct_blocking_calls_flagged(self, tmp_path):
+        _write(tmp_path, 'serve/a.py', '''\
+            import time
+            import subprocess
+            import requests
+
+            async def handler():
+                time.sleep(1)
+                subprocess.run(['ls'])
+                requests.get('http://x')
+        ''')
+        report = _run(tmp_path, checks=['async-blocking'])
+        assert sorted(v['key'] for v in report['violations']) == \
+            ['requests.get', 'subprocess.run', 'time.sleep']
+
+    def test_awaited_and_sync_context_ok(self, tmp_path):
+        _write(tmp_path, 'serve/b.py', '''\
+            import time
+            import asyncio
+
+            def sync_fn():
+                time.sleep(1)      # sync context: fine
+
+            async def handler(lock, sock):
+                await lock.acquire()           # async API: fine
+                await asyncio.sleep(1)
+                data = await sock.recv(4)      # awaited recv: fine
+        ''')
+        assert _run(tmp_path, checks=['async-blocking'])['total'] == 0
+
+    def test_one_hop_helper_flagged(self, tmp_path):
+        # The ADVICE r5 bug shape, distilled from the PRE-FIX
+        # multihost.ControlLeader: the serve batch loop (async) calls
+        # a sync broadcast helper whose sendall can block forever on a
+        # wedged follower's TCP buffer.
+        _write(tmp_path, 'serve/old_multihost.py', '''\
+            import struct
+            import pickle
+
+            class ControlLeader:
+                def send(self, op):
+                    data = pickle.dumps(op)
+                    for conn in self._conns:
+                        conn.sendall(struct.pack('>I', len(data)) + data)
+
+            async def batch_loop(leader, ops):
+                for op in ops:
+                    leader.send(op)    # blocking sendall on the loop
+        ''')
+        report = _run(tmp_path, checks=['async-blocking'])
+        assert _idents(report) == \
+            ['async-blocking:serve/old_multihost.py:send->.sendall']
+        assert 'sendall' in report['violations'][0]['message']
+
+    def test_nested_def_scopes_not_conflated(self, tmp_path):
+        _write(tmp_path, 'serve/c.py', '''\
+            import time
+
+            async def handler():
+                def make_chunks():     # separate sync scope
+                    time.sleep(0)
+                return make_chunks
+        ''')
+        assert _run(tmp_path, checks=['async-blocking'])['total'] == 0
+
+
+# ------------------------------------------------------------ jit hazards
+
+class TestJitHazardChecker:
+
+    def test_decorated_and_wrapped_hazards(self, tmp_path):
+        _write(tmp_path, 'models/j.py', '''\
+            import functools
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def step(x):
+                return x.item() + float(x) + np.asarray(x)
+
+            @functools.partial(jax.jit, static_argnums=0)
+            def step2(n, x):
+                return x.tolist()
+
+            def _impl(x):
+                return int(x)
+
+            wrapped = jax.jit(_impl)
+        ''')
+        report = _run(tmp_path, checks=['jit-hazards'])
+        assert sorted(v['key'] for v in report['violations']) == \
+            ['.item', '.tolist', 'float', 'int', 'np.asarray']
+
+    def test_static_shapes_and_unjitted_ok(self, tmp_path):
+        _write(tmp_path, 'models/k.py', '''\
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def step(x, xs):
+                n = int(x.shape[0]) * int(len(xs)) * int(x.ndim)
+                return x * n + float('inf')
+
+            def host_side(x):
+                return float(x) + np.asarray(x).item()
+        ''')
+        assert _run(tmp_path, checks=['jit-hazards'])['total'] == 0
+
+
+# ------------------------------------------------------------ allowlist + report
+
+class TestAllowlistAndReport:
+
+    def test_allowlist_round_trip(self, tmp_path):
+        _write(tmp_path, 'pkg/clouds/x.py',
+               'from skypilot_tpu import backends\n')
+        report = _run(tmp_path / 'pkg', checks=['layers'])
+        assert report['new'] == 1
+        ident = _idents(report)[0]
+        # Write the ident to an allowlist file, reload, re-run: the
+        # violation is reported but no longer NEW; exit path goes 0.
+        allow_path = tmp_path / 'allow.txt'
+        allow_path.write_text(core.dump_allowlist([ident]))
+        entries = core.load_allowlist(str(allow_path))
+        assert entries == [ident]
+        report2 = _run(tmp_path / 'pkg', checks=['layers'],
+                       allowlist=entries)
+        assert (report2['total'], report2['new'],
+                report2['allowlisted']) == (1, 0, 1)
+        assert report2['stale_allowlist_entries'] == []
+        # Stale entries surface once the violation is fixed.
+        os.unlink(os.path.join(tmp_path, 'pkg', 'clouds', 'x.py'))
+        report3 = _run(tmp_path / 'pkg', checks=['layers'],
+                       allowlist=entries)
+        assert report3['stale_allowlist_entries'] == entries
+
+    def test_json_report_schema(self, tmp_path):
+        _write(tmp_path, 'clouds/x.py', 'import jax\n')
+        report = _run(tmp_path)
+        assert report['skylint_version'] == core.REPORT_VERSION
+        assert set(report) == {
+            'skylint_version', 'root', 'files_scanned', 'checks',
+            'violations', 'total', 'allowlisted', 'new',
+            'stale_allowlist_entries'}
+        assert report['checks'] == ['layers', 'lazy-imports',
+                                    'async-blocking', 'jit-hazards']
+        (v,) = report['violations']
+        assert set(v) == {'check', 'path', 'line', 'col', 'key',
+                          'message', 'allowlisted'}
+        assert (v['path'], v['line'], v['allowlisted']) == \
+            ('clouds/x.py', 1, False)
+        json.dumps(report)    # serializable
+
+    def test_unknown_checker_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match='unknown checker'):
+            _run(tmp_path, checks=['nope'])
+
+
+# ------------------------------------------------------------ CLI
+
+class TestCli:
+
+    def _cli(self, *args):
+        return subprocess.run(
+            [sys.executable, '-m', 'skypilot_tpu.analysis', *args],
+            capture_output=True, text=True, cwd=REPO,
+            env={**os.environ, 'PYTHONPATH': REPO}, timeout=120)
+
+    def test_json_mode_clean_exit_zero(self, tmp_path):
+        _write(tmp_path, 'serve/ok.py', 'import os\n')
+        proc = self._cli('--root', str(tmp_path), '--format', 'json')
+        assert proc.returncode == 0, proc.stderr
+        report = json.loads(proc.stdout)
+        assert report['new'] == 0
+
+    def test_violation_exit_one_and_text_output(self, tmp_path):
+        _write(tmp_path, 'clouds/x.py',
+               'from skypilot_tpu import backends\n')
+        proc = self._cli('--root', str(tmp_path), '--no-allowlist')
+        assert proc.returncode == 1
+        assert 'clouds/x.py:1' in proc.stdout
+        assert '1 new' in proc.stdout
+
+
+# ------------------------------------------------------------ injection
+
+class TestInjectionIntoRealModules:
+    """Fixture COPIES of real modules with planted regressions: the
+    analyzer must catch the exact shapes a future PR would introduce."""
+
+    def _copy(self, tmp_path, rel):
+        dst = os.path.join(tmp_path, rel)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        shutil.copy(os.path.join(PKG, rel), dst)
+        return dst
+
+    def test_upward_import_in_real_module_caught(self, tmp_path):
+        dst = self._copy(tmp_path, 'jobs/scheduler.py')
+        src = open(dst, encoding='utf-8').read()
+        with open(dst, 'w', encoding='utf-8') as f:
+            f.write('from skypilot_tpu import server\n' + src)
+        report = _run(tmp_path, checks=['layers'])
+        assert 'layers:jobs/scheduler.py:skypilot_tpu.server' in \
+            _idents(report)
+
+    def test_blocking_call_in_real_async_module_caught(self, tmp_path):
+        dst = self._copy(tmp_path, 'serve/load_balancer.py')
+        with open(dst, 'a', encoding='utf-8') as f:
+            f.write('\n\nasync def _injected_poll():\n'
+                    '    import time\n'
+                    '    time.sleep(5)\n')
+        report = _run(tmp_path, checks=['async-blocking'])
+        assert ['async-blocking:serve/load_balancer.py:time.sleep'] == \
+            _idents(report)
+
+    def test_clean_copies_stay_clean(self, tmp_path):
+        # The same real modules WITHOUT the injection: no violations —
+        # the injection tests prove detection, this proves precision.
+        self._copy(tmp_path, 'jobs/scheduler.py')
+        self._copy(tmp_path, 'serve/load_balancer.py')
+        assert _run(tmp_path)['new'] == 0
+
+
+# ------------------------------------------------------------ enforcement
+
+class TestLivePackage:
+    """THE gate: the architecture contract over the real package."""
+
+    def test_live_package_clean(self):
+        allowlist = []
+        if os.path.exists(analysis.default_allowlist_path()):
+            allowlist = core.load_allowlist(
+                analysis.default_allowlist_path())
+        assert len(allowlist) <= 10, (
+            'allowlist grew past 10 grandfathered entries — fix '
+            'violations instead of accumulating exemptions')
+        report = core.run_analysis(analysis.default_root(),
+                                   allowlist=allowlist)
+        new = [v for v in report['violations'] if not v['allowlisted']]
+        assert not new, (
+            'skylint found new architecture violations (fix them or, '
+            'with a tracking note, grandfather in '
+            'skypilot_tpu/analysis/allowlist.txt):\n' + '\n'.join(
+                f"{v['path']}:{v['line']}: [{v['check']}] {v['message']}"
+                for v in new))
+        assert report['stale_allowlist_entries'] == [], (
+            'stale allowlist entries — the violations are fixed, '
+            'delete the entries')
+        # Sanity: the scan actually covered the package.
+        assert report['files_scanned'] > 100
