@@ -1,18 +1,23 @@
-"""skylint — AST-based architecture & hazard analyzer.
+"""skylint — AST + dataflow architecture & hazard analyzer.
 
 Enforces the survey's layer contract ("each layer only calls
-downward", PAPER.md §1) and three hazard disciplines (lazy heavy
-imports in the control plane, no blocking calls on the event loop, no
-host syncs under jit) at lint time, over the whole package, with a
-checked-in allowlist for grandfathered violations.
+downward", PAPER.md §1) and seven hazard disciplines at lint time,
+over the whole package, with a checked-in allowlist for grandfathered
+violations. v2 adds an intra-procedural CFG/dataflow core
+(analysis/dataflow.py) and four flow-sensitive checkers: sqlite
+transaction discipline, status state-machine integrity (tables in
+analysis/state_machines.py), thread/lock discipline, and the
+silent-broad-except lint.
 
 Run it:
     python -m skypilot_tpu.analysis              # human output
     python -m skypilot_tpu.analysis --format json
+    python -m skypilot_tpu.analysis --changed    # pre-commit fast path
     skylint                                      # console entry
 
 Tier-1 enforcement lives in tests/unit_tests/test_skylint.py; the
-workflow and layer map rationale in docs/ARCHITECTURE_LINT.md.
+workflow, layer map and checker rationale in
+docs/ARCHITECTURE_LINT.md and docs/STATE_MACHINES.md.
 
 Stdlib-only on purpose: parsing, never importing, the analyzed code.
 """

@@ -1,33 +1,128 @@
 """skylint CLI: `python -m skypilot_tpu.analysis` / `skylint`.
 
-Exit codes: 0 clean (all violations allowlisted), 1 new violations,
-2 usage error.
+Exit codes: 0 clean (all violations allowlisted, no stale entries),
+1 new violations or stale allowlist entries (the ratchet: an entry
+matching nothing must be deleted — or run ``--prune`` to rewrite the
+file), 2 usage error.
+
+Modes:
+  * full scan (default) — the tier-1 gate.
+  * ``--changed`` — lint only files changed vs ``git merge-base HEAD
+    <--base>`` plus untracked files: the fast pre-commit hook (see
+    .pre-commit-config.yaml). Stale-entry ratcheting is scoped away
+    automatically (an entry for an unchanged file is not stale).
+
+Defaults for --root/--allowlist can live in ``[tool.skylint]`` in
+pyproject.toml (keys ``root`` and ``allowlist``, relative to the
+pyproject directory); CLI flags win.
 """
 from __future__ import annotations
 
 import argparse
 import json
 import os
+import re
+import subprocess
 import sys
+from typing import Dict, List, Optional
 
 from skypilot_tpu import analysis
 from skypilot_tpu.analysis import checkers
 from skypilot_tpu.analysis import core
 
 
+def load_pyproject_config(start: str) -> Dict[str, str]:
+    """``[tool.skylint]`` from the nearest pyproject.toml at/above
+    ``start``. Hand-parsed (py3.10: no tomllib): only simple
+    ``key = "value"`` lines are recognized — exactly what this section
+    uses. Paths are returned absolute (relative to the pyproject)."""
+    d = os.path.abspath(start)
+    while True:
+        candidate = os.path.join(d, 'pyproject.toml')
+        if os.path.isfile(candidate):
+            break
+        parent = os.path.dirname(d)
+        if parent == d:
+            return {}
+        d = parent
+    out: Dict[str, str] = {}
+    in_section = False
+    with open(candidate, 'r', encoding='utf-8') as f:
+        for raw in f:
+            line = raw.strip()
+            if line.startswith('['):
+                in_section = line == '[tool.skylint]'
+                continue
+            if not in_section or not line or line.startswith('#'):
+                continue
+            m = re.match(r'^(\w+)\s*=\s*"([^"]*)"\s*(#.*)?$', line)
+            if m:
+                out[m.group(1)] = os.path.normpath(
+                    os.path.join(d, m.group(2)))
+    return out
+
+
+def _git(args: List[str], cwd: str) -> Optional[str]:
+    try:
+        proc = subprocess.run(['git', *args], cwd=cwd,
+                              capture_output=True, text=True, timeout=30)
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    if proc.returncode != 0:
+        return None
+    return proc.stdout
+
+
+def changed_paths(root: str, base: str) -> Optional[List[str]]:
+    """Root-relative .py files changed vs merge-base(HEAD, base), plus
+    untracked ones. None when git/merge-base is unavailable (caller
+    falls back to a full scan)."""
+    top = _git(['rev-parse', '--show-toplevel'], cwd=root)
+    if top is None:
+        return None
+    # Everything below runs from the toplevel: `ls-files` paths are
+    # cwd-relative and scoped to cwd, so a subdir cwd would both
+    # mis-resolve and miss files.
+    top = top.strip()
+    merge_base = _git(['merge-base', 'HEAD', base], cwd=top)
+    if merge_base is None:
+        return None
+    diff = _git(['diff', '--name-only', merge_base.strip()], cwd=top)
+    untracked = _git(['ls-files', '--others', '--exclude-standard'],
+                     cwd=top)
+    if diff is None or untracked is None:
+        return None
+    files = set(diff.splitlines()) | set(untracked.splitlines())
+    root_abs = os.path.abspath(root)
+    out = []
+    for f in sorted(files):
+        if not f.endswith('.py'):
+            continue
+        rel = os.path.relpath(os.path.join(top, f), root_abs)
+        if not rel.startswith('..'):
+            out.append(rel.replace(os.sep, '/'))
+    return out
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog='skylint',
-        description='AST-based architecture & hazard analyzer '
-                    '(layer DAG, lazy imports, async-blocking, '
-                    'jit hazards).')
+        description='AST+dataflow architecture & hazard analyzer '
+                    '(layer DAG, lazy imports, async-blocking, jit '
+                    'hazards, sqlite discipline, status state '
+                    'machines, thread/lock discipline, silent '
+                    'excepts).')
     parser.add_argument('--root', default=None,
-                        help='Package root to scan (default: the '
-                             'installed skypilot_tpu directory).')
+                        help='Package root to scan (default: '
+                             '[tool.skylint] root in pyproject.toml, '
+                             'else the installed skypilot_tpu '
+                             'directory).')
     parser.add_argument('--format', choices=['text', 'json'],
                         default='text')
     parser.add_argument('--allowlist', default=None,
-                        help='Allowlist file (default: the checked-in '
+                        help='Allowlist file (default: [tool.skylint] '
+                             'allowlist in pyproject.toml, else the '
+                             'checked-in '
                              'skypilot_tpu/analysis/allowlist.txt).')
     parser.add_argument('--no-allowlist', action='store_true',
                         help='Report every violation as new (what a '
@@ -36,6 +131,16 @@ def build_parser() -> argparse.ArgumentParser:
                         metavar='NAME',
                         help=f'Run only this checker (repeatable). '
                              f'Available: {", ".join(checkers.names())}')
+    parser.add_argument('--changed', action='store_true',
+                        help='Lint only files changed vs `git '
+                             'merge-base HEAD <base>` (+ untracked) — '
+                             'the pre-commit fast path.')
+    parser.add_argument('--base', default='main',
+                        help='Base ref for --changed (default: main).')
+    parser.add_argument('--prune', action='store_true',
+                        help='Rewrite the allowlist file dropping '
+                             'stale (burned-down) entries instead of '
+                             'failing on them.')
     parser.add_argument('--list-checks', action='store_true')
     return parser
 
@@ -46,26 +151,67 @@ def main(argv=None) -> int:
         for name in checkers.names():
             print(name)
         return 0
-    root = args.root or analysis.default_root()
+    if args.prune and args.changed:
+        print('skylint: --prune needs a full scan; drop --changed',
+              file=sys.stderr)
+        return 2
+
+    config = load_pyproject_config(args.root or os.getcwd())
+    root = args.root or config.get('root') or analysis.default_root()
     if not os.path.isdir(root):
         print(f'skylint: root {root!r} is not a directory',
               file=sys.stderr)
         return 2
-    allowlist = []
+
+    allowlist: List[str] = []
+    allowlist_path = (args.allowlist or config.get('allowlist') or
+                      analysis.default_allowlist_path())
     if not args.no_allowlist:
-        path = args.allowlist or analysis.default_allowlist_path()
-        if os.path.exists(path):
-            allowlist = core.load_allowlist(path)
+        if os.path.exists(allowlist_path):
+            allowlist = core.load_allowlist(allowlist_path)
         elif args.allowlist:
-            print(f'skylint: allowlist {path!r} not found',
+            print(f'skylint: allowlist {allowlist_path!r} not found',
                   file=sys.stderr)
             return 2
+
+    paths = None
+    if args.changed:
+        paths = changed_paths(root, args.base)
+        if paths is None:
+            print('skylint: --changed: git diff unavailable '
+                  '(no repo / no base ref?); falling back to a full '
+                  'scan', file=sys.stderr)
+        elif not paths:
+            # Still produce a (trivially clean) report so json mode
+            # always emits exactly one JSON document on stdout.
+            print('skylint: no changed .py files under '
+                  f'{os.path.abspath(root)}; nothing to lint.',
+                  file=sys.stderr)
+
     try:
         report = core.run_analysis(root, checks=args.check,
-                                   allowlist=allowlist)
+                                   allowlist=allowlist, paths=paths)
     except ValueError as e:
         print(f'skylint: {e}', file=sys.stderr)
         return 2
+
+    stale = list(report['stale_allowlist_entries'])
+    if stale and args.prune:
+        # Filter the ORIGINAL file line-by-line: surviving entries keep
+        # their inline justification comments (required by the
+        # allowlist workflow); only lines whose ident is stale go.
+        gone = set(stale)
+        with open(allowlist_path, 'r', encoding='utf-8') as f:
+            lines = f.readlines()
+        kept = [ln for ln in lines
+                if ln.split('#', 1)[0].strip() not in gone]
+        with open(allowlist_path, 'w', encoding='utf-8') as f:
+            f.writelines(kept)
+        print(f'skylint: pruned {len(stale)} stale allowlist '
+              f'entr{"y" if len(stale) == 1 else "ies"} from '
+              f'{allowlist_path}', file=sys.stderr)
+        report['stale_allowlist_entries'] = []
+        stale = []
 
     if args.format == 'json':
         print(json.dumps(report, indent=2))
@@ -78,10 +224,20 @@ def main(argv=None) -> int:
               f"{report['total']} violation(s) "
               f"({report['allowlisted']} allowlisted, "
               f"{report['new']} new).")
-        for stale in report['stale_allowlist_entries']:
+        for entry in stale:
             print(f'skylint: stale allowlist entry (burned down — '
-                  f'delete it): {stale}')
-    return 1 if report['new'] else 0
+                  f'delete it or run --prune): {entry}')
+    if report['new']:
+        return 1
+    if stale:
+        # The ratchet: an allowlist only shrinks. A stale entry means
+        # the violation is fixed — leaving the entry would let the
+        # same ident silently re-grandfather a future regression.
+        if args.format == 'json':
+            print('skylint: stale allowlist entries (ratchet) — '
+                  'delete them or run --prune', file=sys.stderr)
+        return 1
+    return 0
 
 
 if __name__ == '__main__':

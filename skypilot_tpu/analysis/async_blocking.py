@@ -5,14 +5,15 @@ The exact bug class of the round-5 advisor finding: a blocking
 frontend behind one stalled follower TCP buffer. Anything that parks
 the thread inside an ``async def`` parks EVERY request on that loop.
 
-Two detection hops:
+Detection (upgraded to call-graph depth in skylint v2):
   1. direct — a known-blocking call in an ``async def`` body (nested
      ``def``/``async def`` bodies are separate scopes, not entered);
-  2. one-hop — an ``async def`` calls a sync function/method defined
-     in the SAME module whose body contains a blocking call (how the
-     real bug was wired: ``batch_loop`` → ``self._bcast`` → ``send``
-     → ``sendall``). Name-based resolution; cross-module chains are
-     out of scope.
+  2. transitive — an ``async def`` calls a sync function/method
+     defined in the SAME module that reaches a blocking call through
+     any chain of same-module sync helpers (the real bug was wired
+     ``batch_loop`` → ``self._bcast`` → ``send`` → ``sendall``; v1
+     only followed one hop). Resolution is name-based; cross-module
+     chains are out of scope.
 
 ``await``-ed calls are exempt (``await ws.recv()`` is the async API).
 """
@@ -22,6 +23,7 @@ import ast
 from typing import Dict, List, Optional, Tuple
 
 from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis import dataflow
 
 NAME = 'async-blocking'
 
@@ -45,35 +47,12 @@ BLOCKING_METHODS = frozenset({
 BLOCKING_ROOTS = frozenset({'requests'})
 
 
-def _alias_map(tree: ast.Module) -> Dict[str, str]:
-    """Local name -> canonical dotted prefix, from module-level imports
-    (`from time import sleep` makes bare `sleep(...)` mean
-    `time.sleep(...)`)."""
-    aliases: Dict[str, str] = {}
-    for stmt, _ in core.module_level_imports(tree):
-        if isinstance(stmt, ast.Import):
-            for a in stmt.names:
-                aliases[a.asname or a.name.split('.')[0]] = \
-                    a.name if a.asname else a.name.split('.')[0]
-        elif isinstance(stmt, ast.ImportFrom) and stmt.level == 0 \
-                and stmt.module:
-            for a in stmt.names:
-                aliases[a.asname or a.name] = f'{stmt.module}.{a.name}'
-    return aliases
-
-
-def _canonical(call: ast.Call, aliases: Dict[str, str]) -> Optional[str]:
-    dotted = core.dotted_name(call.func)
-    if dotted is None:
-        return None
-    head, _, rest = dotted.partition('.')
-    head = aliases.get(head, head)
-    return f'{head}.{rest}' if rest else head
-
-
-def _blocking_reason(call: ast.Call,
-                     aliases: Dict[str, str]) -> Optional[str]:
-    name = _canonical(call, aliases)
+def blocking_reason(call: ast.Call,
+                    aliases: Dict[str, str]) -> Optional[str]:
+    """The canonical blocking-call name if ``call`` blocks, else None.
+    Shared with the thread-discipline checker (blocking under a lock
+    is the same call list, different victim)."""
+    name = dataflow.canonical_call(call, aliases)
     if name is not None:
         if name in BLOCKING_CALLS:
             return name
@@ -85,29 +64,49 @@ def _blocking_reason(call: ast.Call,
     return None
 
 
-def _own_calls(fn: ast.AST) -> List[Tuple[ast.Call, bool]]:
-    """(call, awaited) pairs in `fn`'s own body — nested function
-    scopes excluded."""
-    out: List[Tuple[ast.Call, bool]] = []
+def _callee_name(call: ast.Call) -> Optional[str]:
+    if isinstance(call.func, ast.Name):
+        return call.func.id
+    if isinstance(call.func, ast.Attribute):
+        return call.func.attr
+    return None
 
-    def visit(node: ast.AST, awaited: bool) -> None:
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
-                                  ast.Lambda)):
-                continue
-            if isinstance(child, ast.Await):
-                visit(child, True)
-                continue
-            if isinstance(child, ast.Call):
-                out.append((child, awaited))
-            visit(child, False)
 
-    visit(fn, False)
-    return out
+def _helper_chains(
+        sync_fns: List[ast.FunctionDef],
+        aliases: Dict[str, str]) -> Dict[str, Tuple[List[str], int]]:
+    """fn name -> (call chain ending in the blocking reason, line of
+    the ultimate blocking call). Fixpoint over the same-module sync
+    call graph, so ``a -> b -> c -> sendall`` marks a, b AND c."""
+    chains: Dict[str, Tuple[List[str], int]] = {}
+    for fn in sync_fns:
+        for call, awaited in dataflow.own_calls(fn):
+            if awaited:
+                continue
+            reason = blocking_reason(call, aliases)
+            if reason is not None:
+                chains.setdefault(fn.name, ([reason], call.lineno))
+                break
+    changed = True
+    while changed:
+        changed = False
+        for fn in sync_fns:
+            if fn.name in chains:
+                continue
+            for call, awaited in dataflow.own_calls(fn):
+                if awaited:
+                    continue
+                callee = _callee_name(call)
+                if callee in chains and callee not in aliases:
+                    chain, line = chains[callee]
+                    chains[fn.name] = ([callee] + chain, line)
+                    changed = True
+                    break
+    return chains
 
 
 def run(mod: core.ModuleInfo) -> List[core.Violation]:
-    aliases = _alias_map(mod.tree)
+    aliases = dataflow.alias_map(mod.tree)
 
     sync_fns: List[ast.FunctionDef] = []
     async_fns: List[ast.AsyncFunctionDef] = []
@@ -119,21 +118,14 @@ def run(mod: core.ModuleInfo) -> List[core.Violation]:
     if not async_fns:
         return []
 
-    # Hop 1 prep: sync helpers in this module that block internally.
-    helper_blocks: Dict[str, Tuple[str, int]] = {}
-    for fn in sync_fns:
-        for call, _ in _own_calls(fn):
-            reason = _blocking_reason(call, aliases)
-            if reason is not None:
-                helper_blocks.setdefault(fn.name, (reason, call.lineno))
-                break
+    chains = _helper_chains(sync_fns, aliases)
 
     out: List[core.Violation] = []
     for afn in async_fns:
-        for call, awaited in _own_calls(afn):
+        for call, awaited in dataflow.own_calls(afn):
             if awaited:
                 continue
-            reason = _blocking_reason(call, aliases)
+            reason = blocking_reason(call, aliases)
             if reason is not None:
                 out.append(core.Violation(
                     check=NAME, path=mod.path, line=call.lineno,
@@ -144,20 +136,19 @@ def run(mod: core.ModuleInfo) -> List[core.Violation]:
                         f'loop (every in-flight request waits); use '
                         f'the async API or run_in_executor')))
                 continue
-            # Hop 2: call to a same-module sync helper that blocks.
-            callee = None
-            if isinstance(call.func, ast.Name):
-                callee = call.func.id
-            elif isinstance(call.func, ast.Attribute):
-                callee = call.func.attr
-            if callee in helper_blocks and callee not in aliases:
-                inner, inner_line = helper_blocks[callee]
+            # Transitive: call into a same-module sync helper chain
+            # that bottoms out in a blocking call.
+            callee = _callee_name(call)
+            if callee in chains and callee not in aliases:
+                chain, inner_line = chains[callee]
+                full = [callee] + chain
                 out.append(core.Violation(
                     check=NAME, path=mod.path, line=call.lineno,
-                    col=call.col_offset, key=f'{callee}->{inner}',
+                    col=call.col_offset, key='->'.join(full),
                     message=(
                         f'`async def {afn.name}` calls sync helper '
-                        f'{callee!r} which does blocking {inner!r} '
+                        f'{callee!r} which reaches blocking '
+                        f'{chain[-1]!r} via {" -> ".join(full)} '
                         f'(line {inner_line}); the event loop stalls '
                         f'for the duration')))
     return out

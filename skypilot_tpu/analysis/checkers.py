@@ -12,6 +12,10 @@ from skypilot_tpu.analysis import core
 from skypilot_tpu.analysis import jit_hazards
 from skypilot_tpu.analysis import lazy_imports
 from skypilot_tpu.analysis import layers
+from skypilot_tpu.analysis import silent_except
+from skypilot_tpu.analysis import sqlite_discipline
+from skypilot_tpu.analysis import state_integrity
+from skypilot_tpu.analysis import thread_discipline
 
 CheckerFn = Callable[[core.ModuleInfo], List[core.Violation]]
 
@@ -20,6 +24,10 @@ ALL: List[Tuple[str, CheckerFn]] = [
     (lazy_imports.NAME, lazy_imports.run),
     (async_blocking.NAME, async_blocking.run),
     (jit_hazards.NAME, jit_hazards.run),
+    (sqlite_discipline.NAME, sqlite_discipline.run),
+    (state_integrity.NAME, state_integrity.run),
+    (thread_discipline.NAME, thread_discipline.run),
+    (silent_except.NAME, silent_except.run),
 ]
 
 

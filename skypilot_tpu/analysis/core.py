@@ -21,8 +21,10 @@ from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 PACKAGE = 'skypilot_tpu'
 
-# Report schema version — bump when the JSON shape changes.
-REPORT_VERSION = 1
+# Report schema version — bump when the JSON shape OR the default
+# checker set changes (v2: dataflow checkers — sqlite-discipline,
+# state-machine, thread-discipline, silent-except).
+REPORT_VERSION = 2
 
 
 @dataclasses.dataclass
@@ -175,13 +177,21 @@ def dump_allowlist(entries: Sequence[str]) -> str:
 
 def run_analysis(root: str,
                  checks: Optional[Sequence[str]] = None,
-                 allowlist: Sequence[str] = ()) -> Dict:
+                 allowlist: Sequence[str] = (),
+                 paths: Optional[Sequence[str]] = None) -> Dict:
     """Parse every module under ``root`` and run the checkers.
+
+    ``paths`` (root-relative, '/'-separated) restricts the scan to a
+    subset of files — the ``--changed`` pre-commit mode. Allowlist
+    entries for unselected checkers or unscanned paths are dropped
+    before the stale computation, so a partial run never reports a
+    legitimately-grandfathered entry as stale.
 
     Returns the report dict (the JSON mode serializes it verbatim):
     ``new`` counts non-allowlisted violations — the CI gate is
     ``new == 0``. Stale allowlist entries (matching nothing) are
-    surfaced so burned-down entries get deleted.
+    surfaced so burned-down entries get deleted; the CLI turns them
+    into a failure (the ratchet: allowlists only shrink).
     """
     # Imported here (not at module top) to avoid a checkers<->core
     # import cycle; checkers import core for the shared AST helpers.
@@ -193,6 +203,29 @@ def run_analysis(root: str,
         info = module_info(root, path)
         if info is not None:
             modules.append(info)
+    if paths is not None:
+        wanted = {p.replace(os.sep, '/') for p in paths}
+        modules = [m for m in modules if m.path in wanted]
+
+    # Scope the allowlist to what this run can actually see (ident
+    # format: check:path:key). An entry naming a known-but-unselected
+    # checker, or a file outside an explicit ``paths`` scope, is out of
+    # scope for THIS run — not stale. Malformed entries and unknown
+    # checker names stay in, so they surface as stale and fail the
+    # ratchet instead of rotting silently.
+    sel_names = {name for name, _ in selected}
+    known = set(checkers_lib.names())
+    scanned = {m.path for m in modules}
+    scoped = []
+    for entry in allowlist:
+        parts = entry.split(':', 2)
+        if len(parts) == 3:
+            if parts[0] in known and parts[0] not in sel_names:
+                continue
+            if paths is not None and parts[1] not in scanned:
+                continue
+        scoped.append(entry)
+    allowlist = scoped
 
     violations: List[Violation] = []
     seen = set()

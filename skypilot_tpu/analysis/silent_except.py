@@ -1,0 +1,110 @@
+"""Silent broad-exception lint for the control plane.
+
+A ``except Exception:`` whose body neither logs, re-raises, nor
+records a failure reason turns every bug into a silent no-op — the
+job hangs in RUNNING, the replica never turns READY, and the operator
+has NOTHING to debug from. Narrow handlers (``except OSError:``) are
+someone's explicit call and exempt; broad ones must leave a trace.
+
+A handler body counts as non-silent when (own scope only — nested
+defs excluded) it contains any of:
+  * a ``raise``;
+  * a logging call (``logger.warning(...)``, ``.exception(...)``,
+    ``traceback.print_exc()``, ``print(...)``);
+  * a failure-recording call — a ``failure_reason=`` keyword, or a
+    call to ``set_failed`` / ``set_terminal`` / ``fail`` /
+    ``record_failure``;
+  * any USE of the bound exception (``except Exception as e`` followed
+    by ``return {'error': str(e)}`` or ``self._fail_all(e)``): the
+    error escapes the handler, so the caller decides what to surface.
+
+Compute/data-plane units are exempt (a sampling fallback in a kernel
+is not an operator-facing event); the unit list below is the
+control plane whose silence costs debugging sessions.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis import dataflow
+
+NAME = 'silent-except'
+
+CONTROL_PLANE_UNITS = frozenset({
+    'jobs', 'serve', 'server', 'skylet', 'backends', 'provision',
+    'execution', 'core', 'client', 'clouds', 'global_state',
+})
+
+_BROAD = frozenset({'Exception', 'BaseException'})
+_LOG_METHODS = frozenset({
+    'debug', 'info', 'warning', 'error', 'exception', 'critical',
+    'log', 'print_exc',
+})
+_FAILURE_CALLS = frozenset({
+    'set_failed', 'set_terminal', 'fail', 'record_failure',
+})
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [core.dotted_name(e) or '' for e in t.elts]
+    else:
+        names = [core.dotted_name(t) or '']
+    return any(n.split('.')[-1] in _BROAD for n in names)
+
+
+def _leaves_a_trace(handler: ast.ExceptHandler) -> bool:
+    bound = handler.name
+
+    def visit(node: ast.AST) -> bool:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, dataflow.ScopeBoundary):
+                continue
+            if isinstance(child, ast.Raise):
+                return True
+            if bound is not None and isinstance(child, ast.Name) and \
+                    child.id == bound:
+                return True
+            if isinstance(child, ast.Call):
+                if any(kw.arg == 'failure_reason'
+                       for kw in child.keywords):
+                    return True
+                name = None
+                if isinstance(child.func, ast.Attribute):
+                    name = child.func.attr
+                elif isinstance(child.func, ast.Name):
+                    name = child.func.id
+                if name in _LOG_METHODS or name in _FAILURE_CALLS or \
+                        name == 'print':
+                    return True
+            if visit(child):
+                return True
+        return False
+
+    return visit(handler)
+
+
+def run(mod: core.ModuleInfo) -> List[core.Violation]:
+    if mod.unit not in CONTROL_PLANE_UNITS:
+        return []
+    out: List[core.Violation] = []
+    for node, fn in dataflow.nodes_with_enclosing_function(mod.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_broad(node) or _leaves_a_trace(node):
+            continue
+        out.append(core.Violation(
+            check=NAME, path=mod.path, line=node.lineno,
+            col=node.col_offset, key=fn,
+            message=(
+                f'broad except in {fn}() swallows the error '
+                f'silently — log it with context, re-raise, or '
+                f'record a failure_reason so the operator has '
+                f'something to debug from')))
+    return out

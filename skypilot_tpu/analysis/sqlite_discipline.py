@@ -1,0 +1,240 @@
+"""sqlite transaction discipline for the control-plane state DBs.
+
+Round 5's two worst control-plane outages were sqlite flow bugs:
+``UPDATE...RETURNING`` claim sites failing on every pool claim and
+API-server dispatch (this container ships sqlite 3.34, which predates
+RETURNING), and claim races whose SELECT-then-UPDATE let two
+dispatchers grab the same row. Three rules keep them fixed:
+
+  1. raw-connect — ``sqlite3.connect`` is only legal inside
+     ``utils/sqlite_utils.py``: every state DB must go through
+     ``connect_wal`` (WAL mode + the retried journal-mode PRAGMA that
+     absorbs the concurrent-first-launch lock race).
+  2. returning — any SQL string literal using ``RETURNING`` anywhere
+     in the package (sqlite 3.34 regression guard).
+  3. claim-race — inside the state-DB modules, an UPDATE on table T
+     that some path reaches AFTER a SELECT on T, without provably
+     being inside a BEGIN IMMEDIATE transaction on every such path,
+     is a read-modify-write race: another writer can claim the row
+     between the SELECT and the UPDATE. Dataflow on the function's
+     CFG: may-analysis for "a SELECT on T happened", must-analysis
+     for "BEGIN IMMEDIATE is active" (either a literal ``BEGIN``
+     execute or a ``with sqlite_utils.immediate(conn):`` block).
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis import dataflow
+
+NAME = 'sqlite-discipline'
+
+# The control-plane state DBs the claim-race rule binds (docs/
+# STATE_MACHINES.md); rules 1-2 apply package-wide.
+STATE_DB_PATHS = frozenset({
+    'jobs/state.py',
+    'serve/serve_state.py',
+    'server/requests_lib.py',
+    'skylet/job_lib.py',
+    'global_state.py',
+})
+
+_VERB_RE = re.compile(
+    r'^\s*(SELECT|UPDATE|INSERT|DELETE|BEGIN|COMMIT|ROLLBACK)\b', re.I)
+_RETURNING_RE = re.compile(r'\bRETURNING\b')
+_DML_RE = re.compile(r'\b(INSERT|UPDATE|DELETE)\b', re.I)
+
+
+def _sql_text(arg: ast.expr) -> Optional[str]:
+    """Literal text of a (possibly f-string) SQL argument; interpolated
+    holes become a space."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    if isinstance(arg, ast.JoinedStr):
+        parts = []
+        for v in arg.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            else:
+                parts.append(' ')
+        return ''.join(parts)
+    return None
+
+
+def _sql_op(sql: str) -> Optional[Tuple[str, Optional[str]]]:
+    """(VERB, table) for a SQL statement literal, else None."""
+    m = _VERB_RE.match(sql)
+    if not m:
+        return None
+    verb = m.group(1).upper()
+    table = None
+    if verb == 'SELECT' or verb == 'DELETE':
+        t = re.search(r'\bFROM\s+([A-Za-z_][A-Za-z0-9_]*)', sql, re.I)
+        table = t.group(1).lower() if t else None
+    elif verb == 'UPDATE':
+        t = re.match(r'\s*UPDATE\s+([A-Za-z_][A-Za-z0-9_]*)', sql, re.I)
+        table = t.group(1).lower() if t else None
+    elif verb == 'INSERT':
+        t = re.search(r'\bINTO\s+([A-Za-z_][A-Za-z0-9_]*)', sql, re.I)
+        table = t.group(1).lower() if t else None
+    return verb, table
+
+
+def _execute_ops(stmt: ast.stmt) -> List[Tuple[str, Optional[str], int]]:
+    """(verb, table, lineno) for each ``.execute(<literal>)`` call that
+    runs at this CFG node."""
+    out = []
+    for call in dataflow.node_calls(stmt):
+        if not (isinstance(call.func, ast.Attribute) and
+                call.func.attr in ('execute', 'executemany')):
+            continue
+        if not call.args:
+            continue
+        sql = _sql_text(call.args[0])
+        if sql is None:
+            continue
+        op = _sql_op(sql)
+        if op is not None:
+            out.append((op[0], op[1], call.lineno))
+    return out
+
+
+def _commit_like(stmt: ast.stmt) -> bool:
+    for call in dataflow.node_calls(stmt):
+        if isinstance(call.func, ast.Attribute) and \
+                call.func.attr in ('commit', 'rollback'):
+            return True
+    for verb, _, _ in _execute_ops(stmt):
+        if verb in ('COMMIT', 'ROLLBACK'):
+            return True
+    return False
+
+
+def _immediate_with_stmts(fn: ast.AST) -> Set[int]:
+    """id()s of statements inside a ``with ...immediate(...)`` body —
+    the sqlite_utils helper opens a BEGIN IMMEDIATE transaction for
+    exactly that block."""
+    marked: Set[int] = set()
+
+    def mark(node: ast.AST) -> None:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.stmt):
+                marked.add(id(sub))
+
+    for node in ast.walk(fn):
+        if isinstance(node, dataflow.ScopeBoundary) and node is not fn:
+            continue
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                ctx = item.context_expr
+                if isinstance(ctx, ast.Call):
+                    dotted = core.dotted_name(ctx.func) or ''
+                    if dotted.split('.')[-1] in ('immediate',
+                                                 'immediate_transaction'):
+                        for st in node.body:
+                            mark(st)
+    return marked
+
+
+def _claim_races(mod: core.ModuleInfo) -> List[core.Violation]:
+    out: List[core.Violation] = []
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, dataflow.FunctionLike):
+            continue
+        cfg = dataflow.build_cfg(fn)
+        ops_at: Dict[int, List[Tuple[str, Optional[str], int]]] = {}
+        for n in cfg.nodes:
+            if n.stmt is not None:
+                ops = _execute_ops(n.stmt)
+                if ops:
+                    ops_at[id(n)] = ops
+        if not ops_at:
+            continue
+        in_immediate = _immediate_with_stmts(fn)
+
+        def begins(n: dataflow.Node) -> bool:
+            return any(v == 'BEGIN'
+                       for v, _, _ in ops_at.get(id(n), ()))
+
+        txn_in = dataflow.must_forward(
+            cfg, begins,
+            lambda n: n.stmt is not None and _commit_like(n.stmt))
+
+        tables = {t for ops in ops_at.values()
+                  for v, t, _ in ops if v == 'SELECT' and t}
+        for table in sorted(tables):
+            def selects(n: dataflow.Node, _t=table) -> bool:
+                return any(v == 'SELECT' and t == _t
+                           for v, t, _ in ops_at.get(id(n), ()))
+
+            sel_before = dataflow.may_forward(cfg, selects)
+            for n in cfg.nodes:
+                for verb, t, line in ops_at.get(id(n), ()):
+                    if verb != 'UPDATE' or t != table:
+                        continue
+                    if txn_in[id(n)] or begins(n) or \
+                            id(n.stmt) in in_immediate:
+                        continue
+                    if not sel_before[id(n)]:
+                        continue
+                    out.append(core.Violation(
+                        check=NAME, path=mod.path, line=line,
+                        col=n.stmt.col_offset,
+                        key=f'{fn.name}:{table}',
+                        message=(
+                            f'read-modify-write race: {fn.name}() '
+                            f'UPDATEs {table!r} after SELECTing it '
+                            f'outside a BEGIN IMMEDIATE transaction — '
+                            f'a concurrent writer can claim/flip the '
+                            f'row in between; wrap the sequence in '
+                            f'`with sqlite_utils.immediate(conn):`')))
+    return out
+
+
+def run(mod: core.ModuleInfo) -> List[core.Violation]:
+    if mod.unit == 'analysis':
+        # The analyzer (and its fixtures/messages) talks ABOUT SQL.
+        return []
+    out: List[core.Violation] = []
+    aliases = dataflow.alias_map(mod.tree)
+
+    # Rule 1: raw sqlite3.connect outside the shared helper.
+    if mod.path != 'utils/sqlite_utils.py':
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                name = dataflow.canonical_call(node, aliases)
+                if name == 'sqlite3.connect':
+                    out.append(core.Violation(
+                        check=NAME, path=mod.path, line=node.lineno,
+                        col=node.col_offset, key='sqlite3.connect',
+                        message=(
+                            'raw sqlite3.connect bypasses '
+                            'utils/sqlite_utils.connect_wal (WAL mode '
+                            '+ the retried journal-mode PRAGMA that '
+                            'absorbs the concurrent first-launch '
+                            'lock race)')))
+
+    # Rule 2: RETURNING in SQL literals (sqlite 3.34 regression guard).
+    docstrings = dataflow.docstring_constants(mod.tree)
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Constant) and \
+                isinstance(node.value, str) and \
+                id(node) not in docstrings and \
+                _RETURNING_RE.search(node.value) and \
+                _DML_RE.search(node.value):
+            out.append(core.Violation(
+                check=NAME, path=mod.path, line=node.lineno,
+                col=node.col_offset, key='returning',
+                message=(
+                    'SQL RETURNING clause: sqlite < 3.35 (this '
+                    'container: 3.34) has no RETURNING — rewrite as '
+                    'BEGIN IMMEDIATE + SELECT + guarded UPDATE (see '
+                    'serve_state.acquire_worker)')))
+
+    # Rule 3: SELECT-then-UPDATE outside IMMEDIATE, state DBs only.
+    if mod.path in STATE_DB_PATHS:
+        out.extend(_claim_races(mod))
+    return out

@@ -1,0 +1,137 @@
+"""Status state-machine integrity: declared transitions, guarded writes.
+
+The round-5 bug class this kills: a status row overwritten after it
+reached a terminal state (a cancelled job resurrected to RUNNING by
+its slow-starting controller; a FAILED replica flipped back to
+STARTING by a stale launch thread). The legal transitions live in
+``analysis/state_machines.py``; the runtime setters enforce them in a
+BEGIN IMMEDIATE transaction; this checker makes sure nobody writes a
+status column *around* those setters:
+
+  1. coverage — every member of ``ManagedJobStatus`` /
+     ``ServiceStatus`` / ``ReplicaStatus`` must appear as a key in its
+     transition table, so adding a status without wiring transitions
+     fails lint (and tier-1) instead of silently becoming a state the
+     guards refuse or — worse — never check.
+  2. bypass-kwarg — a ``status=`` keyword passed to one of the raw
+     column updaters (``_update`` / ``_update_live`` /
+     ``update_service`` / ``upsert_replica``) outside a guarded setter
+     writes the column with no transition check.
+  3. bypass-sql — a literal ``UPDATE <table> SET ... status = ...``
+     outside a guarded setter, anywhere in the package.
+
+Tests are NOT scanned (skylint runs over ``skypilot_tpu/`` only), so
+fixtures may still seed arbitrary states through the raw updaters.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis import dataflow
+from skypilot_tpu.analysis import state_machines
+
+NAME = 'state-machine'
+
+RAW_STATUS_WRITERS = frozenset({
+    '_update', '_update_live', 'update_service', 'upsert_replica',
+})
+
+_RAW_SQL_STATUS_RE = re.compile(
+    r'\bUPDATE\s+\w+\s+SET\b[^;]*\bstatus\s*=', re.I)
+
+
+def _enum_members(cls: ast.ClassDef) -> List[ast.Assign]:
+    out = []
+    for st in cls.body:
+        if isinstance(st, ast.Assign) and len(st.targets) == 1 and \
+                isinstance(st.targets[0], ast.Name) and \
+                isinstance(st.value, ast.Constant):
+            out.append(st)
+    return out
+
+
+def _is_enum(cls: ast.ClassDef) -> bool:
+    for base in cls.bases:
+        name = core.dotted_name(base) or ''
+        if name.split('.')[-1].endswith('Enum'):
+            return True
+    return False
+
+
+def _string_text(node: ast.AST) -> str:
+    """Literal text of a Constant-str or JoinedStr node, else ''."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        return ''.join(v.value for v in node.values
+                       if isinstance(v, ast.Constant) and
+                       isinstance(v.value, str))
+    return ''
+
+
+def run(mod: core.ModuleInfo) -> List[core.Violation]:
+    if mod.unit == 'analysis':
+        return []
+    out: List[core.Violation] = []
+
+    # Rule 1: transition-table coverage of the status enums.
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef) and \
+                node.name in state_machines.ENUM_TABLES and \
+                _is_enum(node):
+            table = state_machines.ENUM_TABLES[node.name]
+            for member in _enum_members(node):
+                mname = member.targets[0].id
+                if mname not in table:
+                    out.append(core.Violation(
+                        check=NAME, path=mod.path, line=member.lineno,
+                        col=member.col_offset,
+                        key=f'{node.name}.{mname}',
+                        message=(
+                            f'{node.name}.{mname} has no entry in '
+                            f'analysis/state_machines.py — declare its '
+                            f'legal transitions (terminal: empty set) '
+                            f'or the runtime guards will refuse every '
+                            f'write of it')))
+
+    # Rules 2-3 need the enclosing function of each node.
+    docstrings = dataflow.docstring_constants(mod.tree)
+    fstring_parts = {id(v) for n in ast.walk(mod.tree)
+                     if isinstance(n, ast.JoinedStr) for v in n.values}
+    for node, fn in dataflow.nodes_with_enclosing_function(mod.tree):
+        if fn in state_machines.GUARDED_SETTERS:
+            continue
+        if isinstance(node, ast.Call):
+            callee = None
+            if isinstance(node.func, ast.Attribute):
+                callee = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                callee = node.func.id
+            if callee in RAW_STATUS_WRITERS and \
+                    any(kw.arg == 'status' for kw in node.keywords):
+                out.append(core.Violation(
+                    check=NAME, path=mod.path, line=node.lineno,
+                    col=node.col_offset, key=f'{fn}:{callee}',
+                    message=(
+                        f'{fn}() passes status= to raw updater '
+                        f'{callee}(), bypassing the guarded setters '
+                        f'(set_terminal / set_status_nonterminal / '
+                        f'set_replica_status / set_service_status) '
+                        f'and their transition checks')))
+            continue
+        if isinstance(node, (ast.Constant, ast.JoinedStr)) and \
+                id(node) not in docstrings and \
+                id(node) not in fstring_parts and \
+                _RAW_SQL_STATUS_RE.search(_string_text(node)):
+            out.append(core.Violation(
+                check=NAME, path=mod.path, line=node.lineno,
+                col=node.col_offset, key=f'{fn}:raw-sql',
+                message=(
+                    f'{fn}() UPDATEs a status column with raw SQL '
+                    f'outside the guarded setters — route it through '
+                    f'the state module so the transition table (and '
+                    f'first-terminal-wins) applies')))
+    return out

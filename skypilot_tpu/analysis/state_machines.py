@@ -1,0 +1,117 @@
+"""Declared status state machines for the control-plane DBs.
+
+This is the single source of truth for which status transitions are
+LEGAL, consumed from three directions:
+
+  * runtime — the guarded setters (``jobs/state.set_status_nonterminal``,
+    ``serve/serve_state.set_replica_status`` / ``set_service_status``)
+    refuse transitions not listed here, inside a BEGIN IMMEDIATE
+    transaction, so a late writer can never resurrect a terminal row
+    (the round-5 bug class: a job cancelled while PENDING being marked
+    RUNNING by its slow-starting controller);
+  * lint — the ``state-machine`` checker verifies every enum member of
+    ``ManagedJobStatus`` / ``ServiceStatus`` / ``ReplicaStatus`` appears
+    as a key below, so adding a status without wiring its transitions
+    fails skylint (and therefore tier-1);
+  * docs — docs/STATE_MACHINES.md renders these tables as diagrams.
+
+Tables are keyed by enum member NAME (strings, not enum objects):
+this module must stay importable without importing the state modules
+it describes — the analyzer parses, never imports, the code under
+analysis, and the state modules import *us* for the runtime guard.
+
+Semantics: a terminal member maps to an empty set (nothing leaves a
+terminal state — "first terminal wins" is enforced by the setters);
+``can_transition`` additionally allows self-loops (idempotent
+re-writes of the current status are not transitions).
+"""
+from __future__ import annotations
+
+from typing import Dict, FrozenSet, Set
+
+# --------------------------------------------------------------- jobs
+# ManagedJobStatus (jobs/state.py). Any live state may reach any
+# terminal state directly: set_terminal is the crash/cancel funnel and
+# a controller can die (FAILED_CONTROLLER), be cancelled, or fail
+# prechecks from anywhere. Live->live edges are the narrow part.
+_JOB_TERMINAL: FrozenSet[str] = frozenset({
+    'SUCCEEDED', 'CANCELLED', 'FAILED', 'FAILED_SETUP',
+    'FAILED_PRECHECKS', 'FAILED_NO_RESOURCE', 'FAILED_CONTROLLER',
+})
+
+JOB_TRANSITIONS: Dict[str, Set[str]] = {
+    'PENDING': {'STARTING', 'CANCELLING'} | set(_JOB_TERMINAL),
+    'STARTING': {'RUNNING', 'CANCELLING'} | set(_JOB_TERMINAL),
+    'RUNNING': {'RECOVERING', 'CANCELLING'} | set(_JOB_TERMINAL),
+    'RECOVERING': {'RUNNING', 'CANCELLING'} | set(_JOB_TERMINAL),
+    'CANCELLING': set(_JOB_TERMINAL),
+    'SUCCEEDED': set(),
+    'CANCELLED': set(),
+    'FAILED': set(),
+    'FAILED_SETUP': set(),
+    'FAILED_PRECHECKS': set(),
+    'FAILED_NO_RESOURCE': set(),
+    'FAILED_CONTROLLER': set(),
+}
+
+# -------------------------------------------------------------- serve
+# ServiceStatus (serve/serve_state.py). FAILED is terminal for the
+# controller (is_terminal() == True) but still tear-down-able: `serve
+# down` of a FAILED service walks FAILED -> SHUTTING_DOWN -> SHUTDOWN.
+SERVICE_TRANSITIONS: Dict[str, Set[str]] = {
+    'CONTROLLER_INIT': {'REPLICA_INIT', 'SHUTTING_DOWN', 'FAILED',
+                        'SHUTDOWN'},
+    'REPLICA_INIT': {'READY', 'SHUTTING_DOWN', 'FAILED', 'SHUTDOWN'},
+    'READY': {'REPLICA_INIT', 'SHUTTING_DOWN', 'FAILED', 'SHUTDOWN'},
+    'SHUTTING_DOWN': {'SHUTDOWN', 'FAILED'},
+    'FAILED': {'SHUTTING_DOWN', 'SHUTDOWN'},
+    'SHUTDOWN': set(),
+}
+
+# ReplicaStatus (serve/serve_state.py). FAILED/PREEMPTED/SHUTTING_DOWN
+# are pre-removal states: the row is deleted right after, so nothing
+# may leave them except the final SHUTTING_DOWN sweep. In particular
+# FAILED -> READY is forbidden — a replica whose launch failed must be
+# REPLACED (fresh id), never resurrected in place.
+REPLICA_TRANSITIONS: Dict[str, Set[str]] = {
+    'PROVISIONING': {'STARTING', 'FAILED', 'PREEMPTED', 'SHUTTING_DOWN'},
+    'STARTING': {'READY', 'NOT_READY', 'FAILED', 'PREEMPTED',
+                 'SHUTTING_DOWN'},
+    'READY': {'NOT_READY', 'FAILED', 'PREEMPTED', 'SHUTTING_DOWN'},
+    'NOT_READY': {'READY', 'FAILED', 'PREEMPTED', 'SHUTTING_DOWN'},
+    'FAILED': {'SHUTTING_DOWN'},
+    'PREEMPTED': {'SHUTTING_DOWN'},
+    'SHUTTING_DOWN': set(),
+}
+
+# Enum class name -> its transition table (what the state-machine
+# checker verifies coverage against).
+ENUM_TABLES: Dict[str, Dict[str, Set[str]]] = {
+    'ManagedJobStatus': JOB_TRANSITIONS,
+    'ServiceStatus': SERVICE_TRANSITIONS,
+    'ReplicaStatus': REPLICA_TRANSITIONS,
+}
+
+# Functions allowed to write a status column directly (raw UPDATE SQL
+# or a status= kwarg to a raw column updater). Everything else must go
+# through one of these — enforced by the state-machine checker.
+GUARDED_SETTERS: FrozenSet[str] = frozenset({
+    # jobs/state.py
+    'set_terminal', 'set_status_nonterminal',
+    # serve/serve_state.py (+ the shared guarded-write helper)
+    'set_replica_status', 'set_service_status', '_guarded_transition',
+    # global_state.py (ClusterStatus — table not modeled yet)
+    'set_cluster_status',
+    # skylet/job_lib.py (on-cluster JobStatus — resets every recovery)
+    'set_status',
+    # server/requests_lib.py (RequestStatus setters)
+    'set_running', 'set_result', 'set_failed', 'set_cancelled',
+})
+
+
+def can_transition(table: Dict[str, Set[str]], frm: str, to: str) -> bool:
+    """True iff ``frm -> to`` is declared legal (self-loops always are;
+    an UNKNOWN ``frm`` refuses everything — fail closed)."""
+    if frm == to:
+        return True
+    return to in table.get(frm, set())

@@ -1,0 +1,200 @@
+"""Thread & lock discipline for the control plane.
+
+Two rules:
+
+  1. leaked-thread — a non-daemon ``threading.Thread`` that is never
+     ``join``-ed hangs process exit: a controller that finished (or
+     crashed) keeps the interpreter alive behind an invisible worker,
+     which is exactly how a "done" job pins a scheduler slot forever.
+     A Thread is fine if it is daemonized OR its binding is joined
+     somewhere in the module (including the ``for t in threads:
+     t.join()`` shape — the container a thread is appended to counts).
+  2. blocking-under-lock — a known-blocking call (``time.sleep``,
+     ``subprocess.run``, socket ``sendall``/``recv``, sync HTTP, a
+     nested ``.acquire``) inside a ``with <lock>:`` body serializes
+     every other thread contending that lock behind an unbounded
+     stall; do the slow work outside the critical section. Only plain
+     lock objects (``with self._lock:``) are checked — ``with
+     locks.cluster_status_lock(...):`` file locks are coarse
+     by design and exempt.
+
+``time.sleep`` on the event loop stays with the ``async-blocking``
+checker, which now follows sync-helper call chains to any depth.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from skypilot_tpu.analysis import async_blocking
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis import dataflow
+
+NAME = 'thread-discipline'
+
+
+def _joined_names(tree: ast.Module) -> Set[str]:
+    """Names (variables, attributes, containers iterated over) that
+    receive a ``.join()`` call anywhere in the module."""
+    joined: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == 'join':
+            tgt = node.func.value
+            if isinstance(tgt, ast.Name):
+                joined.add(tgt.id)
+            elif isinstance(tgt, ast.Attribute):
+                joined.add(tgt.attr)
+    # `for t in pumps: ... t.join()` joins every element of `pumps`.
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.For, ast.AsyncFor)) and \
+                isinstance(node.target, ast.Name) and \
+                node.target.id in joined:
+            it = node.iter
+            if isinstance(it, ast.Name):
+                joined.add(it.id)
+            elif isinstance(it, ast.Attribute):
+                joined.add(it.attr)
+    return joined
+
+
+def _is_thread_call(call: ast.Call, aliases: Dict[str, str]) -> bool:
+    return dataflow.canonical_call(call, aliases) == 'threading.Thread'
+
+
+def _daemonized(call: ast.Call) -> bool:
+    for kw in call.keywords:
+        if kw.arg == 'daemon':
+            if isinstance(kw.value, ast.Constant):
+                return bool(kw.value.value)
+            return True   # computed daemon flag: a deliberate choice
+    return False
+
+
+def _thread_bindings(
+        tree: ast.Module,
+        aliases: Dict[str, str]) -> List[Tuple[ast.Call, Optional[str]]]:
+    """(Thread(...) call, binding name or None) pairs. The binding is
+    the name the thread (or the container holding it) lands in."""
+    out: List[Tuple[ast.Call, Optional[str]]] = []
+    claimed: Set[int] = set()
+
+    def binding_of(target: ast.expr) -> Optional[str]:
+        if isinstance(target, ast.Name):
+            return target.id
+        if isinstance(target, ast.Attribute):
+            return target.attr
+        if isinstance(target, ast.Subscript):
+            return binding_of(target.value)
+        return None
+
+    def thread_calls_in(expr: ast.AST) -> List[ast.Call]:
+        found = []
+        for sub in ast.walk(expr):
+            if isinstance(sub, ast.Call) and \
+                    _is_thread_call(sub, aliases):
+                found.append(sub)
+        return found
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            value = node.value
+            if value is None:
+                continue
+            for call in thread_calls_in(value):
+                name = binding_of(targets[0]) if targets else None
+                out.append((call, name))
+                claimed.add(id(call))
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == 'append' and node.args:
+            for call in thread_calls_in(node.args[0]):
+                out.append((call, binding_of(node.func.value)))
+                claimed.add(id(call))
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _is_thread_call(node, aliases) \
+                and id(node) not in claimed:
+            out.append((node, None))
+    return out
+
+
+def _lock_name(ctx: ast.expr) -> Optional[str]:
+    """Terminal name of a with-item that looks like a threading lock
+    object (NOT a call — ``cluster_status_lock(...)`` file-lock
+    factories are exempt by design)."""
+    name = None
+    if isinstance(ctx, ast.Name):
+        name = ctx.id
+    elif isinstance(ctx, ast.Attribute):
+        name = ctx.attr
+    if name is not None and 'lock' in name.lower():
+        return name
+    return None
+
+
+def _blocking_in_with(body: List[ast.stmt],
+                      aliases: Dict[str, str]
+                      ) -> List[Tuple[ast.Call, str]]:
+    out = []
+
+    def visit(node: ast.AST, awaited: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, dataflow.ScopeBoundary):
+                continue
+            if isinstance(child, ast.Await):
+                visit(child, True)
+                continue
+            if isinstance(child, ast.Call) and not awaited:
+                reason = async_blocking.blocking_reason(child, aliases)
+                if reason is not None:
+                    out.append((child, reason))
+            visit(child, False)
+
+    for st in body:
+        visit(st, False)
+    return out
+
+
+def run(mod: core.ModuleInfo) -> List[core.Violation]:
+    aliases = dataflow.alias_map(mod.tree)
+    out: List[core.Violation] = []
+
+    joined = _joined_names(mod.tree)
+    for call, binding in _thread_bindings(mod.tree, aliases):
+        if _daemonized(call):
+            continue
+        if binding is not None and binding in joined:
+            continue
+        label = binding or 'anonymous'
+        out.append(core.Violation(
+            check=NAME, path=mod.path, line=call.lineno,
+            col=call.col_offset, key=f'thread-{label}',
+            message=(
+                f'non-daemon Thread ({label!r}) with no reachable '
+                f'join(): it outlives its owner and pins the process '
+                f'at exit — pass daemon=True or join it on every '
+                f'path')))
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.With, ast.AsyncWith)):
+            continue
+        lock = None
+        for item in node.items:
+            lock = _lock_name(item.context_expr)
+            if lock:
+                break
+        if not lock:
+            continue
+        for call, reason in _blocking_in_with(node.body, aliases):
+            out.append(core.Violation(
+                check=NAME, path=mod.path, line=call.lineno,
+                col=call.col_offset, key=f'{lock}->{reason}',
+                message=(
+                    f'blocking call {reason!r} while holding '
+                    f'{lock!r}: every thread contending the lock '
+                    f'stalls behind it — move the slow work outside '
+                    f'the critical section')))
+    return out

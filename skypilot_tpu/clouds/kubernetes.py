@@ -17,8 +17,11 @@ from __future__ import annotations
 import typing
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
+from skypilot_tpu import sky_logging
 from skypilot_tpu.clouds import cloud as cloud_lib
 from skypilot_tpu.utils import registry
+
+logger = sky_logging.init_logger(__name__)
 
 if typing.TYPE_CHECKING:
     from skypilot_tpu import resources as resources_lib
@@ -93,7 +96,11 @@ class Kubernetes(cloud_lib.Cloud):
             return []
         try:
             pools = self._tpu_node_pools()
-        except Exception:  # pylint: disable=broad-except
+        except Exception as e:  # pylint: disable=broad-except
+            # No kubectl / unreachable cluster just means "no offering
+            # here", but silently so makes `skytpu check` undebuggable.
+            logger.debug(f'kubernetes node-pool introspection failed: '
+                         f'{e}')
             return []
         if not self._fits(sl, pools):
             return []

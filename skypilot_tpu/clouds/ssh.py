@@ -22,11 +22,14 @@ import os
 import typing
 from typing import Any, Dict, Iterator, List, Optional, Tuple
 
+from skypilot_tpu import sky_logging
 from skypilot_tpu.clouds import cloud as cloud_lib
 from skypilot_tpu.utils import registry
 
 if typing.TYPE_CHECKING:
     from skypilot_tpu import resources as resources_lib
+
+logger = sky_logging.init_logger(__name__)
 
 SSH_REGION = 'ssh'
 POOLS_PATH = '~/.skytpu/ssh_node_pools.yaml'
@@ -82,7 +85,11 @@ class Ssh(cloud_lib.Cloud):
                 from skypilot_tpu.tpu import topology
                 try:
                     pool_sl = topology.parse_tpu_accelerator(str(acc))
-                except Exception:  # pylint: disable=broad-except
+                except Exception as e:  # pylint: disable=broad-except
+                    # A malformed accelerator string silently hides the
+                    # whole pool from matching — say which and why.
+                    logger.debug(f'ssh pool {name!r}: unparseable '
+                                 f'accelerator {acc!r} ({e}); skipping.')
                     continue
                 if (pool_sl.generation != sl.generation or
                         pool_sl.num_chips != sl.num_chips):

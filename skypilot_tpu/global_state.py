@@ -14,9 +14,12 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu import sky_logging
 from skypilot_tpu.utils import common_utils
 from skypilot_tpu.utils import sqlite_utils
 from skypilot_tpu.utils.status_lib import ClusterStatus
+
+logger = sky_logging.init_logger(__name__)
 
 _DB_PATH_ENV = 'SKYTPU_STATE_DB'
 _local = threading.local()
@@ -177,8 +180,10 @@ def _estimate_cost(handle: Dict[str, Any], duration_seconds: float) -> float:
         res = resources_lib.Resources.from_yaml_config(res_cfg)
         if isinstance(res, resources_lib.Resources):
             return res.get_cost(duration_seconds)
-    except Exception:  # pylint: disable=broad-except
-        pass
+    except Exception as e:  # pylint: disable=broad-except
+        # Cost is best-effort display data, but a silent 0.0 makes the
+        # cost report quietly wrong — leave a trace.
+        logger.debug(f'cost estimate failed for {res_cfg!r}: {e}')
     return 0.0
 
 

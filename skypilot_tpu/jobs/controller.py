@@ -127,8 +127,11 @@ class JobsController:
                       if info.provider_name == 'local' else
                       f'~/.skytpu_runtime/logs/{cluster_job_id}/run.log')
             runner.rsync(remote, state.job_log_path(self.job_id), up=False)
-        except Exception:  # pylint: disable=broad-except
-            pass  # best-effort; the log may not exist yet
+        except Exception as e:  # pylint: disable=broad-except
+            # Best-effort (the log may not exist yet), but say so: a
+            # permanently failing mirror means `jobs logs` serves stale
+            # output after preemption and nobody knows why.
+            logger.debug(f'[job {self.job_id}] log mirror skipped: {e}')
 
     # ------------------------------------------------------------------
     def _do_cancel(self, cluster_job_id) -> None:
@@ -139,8 +142,9 @@ class JobsController:
                 self.strategy.backend.cancel_jobs(
                     self.strategy.handle,
                     [cluster_job_id] if cluster_job_id is not None else None)
-        except Exception:  # pylint: disable=broad-except
-            pass
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'[job {self.job_id}] on-cluster cancel '
+                           f'failed (continuing teardown): {e}')
         self.strategy.terminate_cluster()
         state.set_terminal(self.job_id, state.ManagedJobStatus.CANCELLED)
 
@@ -342,8 +346,12 @@ def main(job_id: int) -> None:
             state.set_terminal(job_id,
                                state.ManagedJobStatus.FAILED_CONTROLLER,
                                failure_reason=f'{type(e).__name__}: {e}')
-        except Exception:  # pylint: disable=broad-except
-            pass
+        except Exception as db_err:  # pylint: disable=broad-except
+            # The crash above is already on stderr; the DB write failing
+            # too means the job will show non-terminal forever — leave
+            # a trace of WHY.
+            logger.warning(f'[job {job_id}] could not record '
+                           f'FAILED_CONTROLLER: {db_err}')
     finally:
         # Free our scheduler slot and let the next PENDING job start.
         from skypilot_tpu.jobs import scheduler

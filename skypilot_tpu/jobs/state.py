@@ -16,7 +16,11 @@ import sqlite3
 import time
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu import sky_logging
+from skypilot_tpu.analysis import state_machines
 from skypilot_tpu.utils import sqlite_utils
+
+logger = sky_logging.init_logger(__name__)
 
 _DB_PATH_ENV = 'SKYTPU_JOBS_DB'
 
@@ -204,57 +208,111 @@ def _update_live(job_id: int, **cols: Any) -> bool:
         return cur.rowcount > 0
 
 
+def set_status_nonterminal(job_id: int, status: ManagedJobStatus,
+                           exprs: Optional[Dict[str, str]] = None,
+                           **cols: Any) -> bool:
+    """Guarded live transition: applies iff the declared state machine
+    (analysis/state_machines.py JOB_TRANSITIONS) allows current->status.
+
+    The read-check-write runs under BEGIN IMMEDIATE, so a concurrent
+    terminal writer cannot slip between the check and the UPDATE: a job
+    cancelled while PENDING can never be resurrected to RUNNING by its
+    late-spawning controller, no matter the interleaving. Returns False
+    when the transition was refused (row gone, already terminal, or an
+    undeclared edge).
+
+    ``exprs`` maps column -> raw SQL expression evaluated inside the
+    same transaction (e.g. ``recovery_count + 1``) — the read half of a
+    read-modify-write must live in here, not in a caller-side SELECT
+    that races other writers.
+    """
+    assert not status.is_terminal(), status
+    conn = _conn()
+    with sqlite_utils.immediate(conn):
+        row = conn.execute('SELECT status FROM jobs WHERE job_id = ?',
+                           (job_id,)).fetchone()
+        if row is None:
+            return False
+        cur = ManagedJobStatus(row[0])
+        if not state_machines.can_transition(
+                state_machines.JOB_TRANSITIONS, cur.name, status.name):
+            logger.warning(
+                f'[job {job_id}] refusing undeclared transition '
+                f'{cur.value} -> {status.value} (see '
+                f'analysis/state_machines.py).')
+            return False
+        sets = ''.join(f', {k} = {sql}'
+                       for k, sql in (exprs or {}).items())
+        sets += ''.join(f', {k} = ?' for k in cols)
+        conn.execute(f'UPDATE jobs SET status = ?{sets} '
+                     f'WHERE job_id = ?',
+                     (status.value, *cols.values(), job_id))
+    return True
+
+
 def set_controller_pid(job_id: int, pid: int) -> None:
     _update(job_id, controller_pid=pid)
 
 
 def bump_controller_restarts(job_id: int) -> int:
-    job = get_job(job_id)
-    count = (job.get('controller_restarts') or 0) + 1 if job else 1
-    _update(job_id, controller_restarts=count)
-    return count
+    return _bump(job_id, 'controller_restarts')
+
+
+def _bump(job_id: int, col: str) -> int:
+    """Atomic counter increment (UPDATE-then-read under BEGIN
+    IMMEDIATE — two concurrent bumpers must not read the same base)."""
+    conn = _conn()
+    with sqlite_utils.immediate(conn):
+        conn.execute(f'UPDATE jobs SET {col} = COALESCE({col}, 0) + 1 '
+                     f'WHERE job_id = ?', (job_id,))
+        row = conn.execute(f'SELECT {col} FROM jobs WHERE job_id = ?',
+                           (job_id,)).fetchone()
+        return int(row[0]) if row else 1
 
 
 def set_starting(job_id: int, cluster_name: str) -> bool:
-    return _update_live(job_id, status=ManagedJobStatus.STARTING.value,
-                        cluster_name=cluster_name)
+    return set_status_nonterminal(job_id, ManagedJobStatus.STARTING,
+                                  cluster_name=cluster_name)
 
 
 def set_started(job_id: int, cluster_job_id: Optional[int]) -> bool:
-    job = get_job(job_id)
-    started = job['started_at'] if job and job['started_at'] else time.time()
-    return _update_live(job_id, status=ManagedJobStatus.RUNNING.value,
-                        started_at=started, cluster_job_id=cluster_job_id)
+    # started_at is sticky across recoveries: COALESCE keeps the first
+    # value, computed inside the guarded transaction (a caller-side
+    # SELECT would race concurrent writers).
+    return set_status_nonterminal(
+        job_id, ManagedJobStatus.RUNNING,
+        exprs={'started_at': f'COALESCE(started_at, {time.time()!r})'},
+        cluster_job_id=cluster_job_id)
 
 
 def set_recovering(job_id: int) -> bool:
-    return _update_live(job_id,
-                        status=ManagedJobStatus.RECOVERING.value)
+    return set_status_nonterminal(job_id, ManagedJobStatus.RECOVERING)
 
 
 def set_recovered(job_id: int, cluster_job_id: Optional[int]) -> bool:
-    job = get_job(job_id)
-    count = (job['recovery_count'] if job else 0) + 1
-    return _update_live(job_id, status=ManagedJobStatus.RUNNING.value,
-                        last_recovered_at=time.time(), recovery_count=count,
-                        cluster_job_id=cluster_job_id)
+    return set_status_nonterminal(
+        job_id, ManagedJobStatus.RUNNING,
+        exprs={'recovery_count': 'COALESCE(recovery_count, 0) + 1'},
+        last_recovered_at=time.time(),
+        cluster_job_id=cluster_job_id)
 
 
 def bump_restart_on_error(job_id: int) -> int:
-    job = get_job(job_id)
-    count = (job['restarts_on_errors'] if job else 0) + 1
-    _update(job_id, restarts_on_errors=count)
-    return count
+    return _bump(job_id, 'restarts_on_errors')
 
 
 def set_cancelling(job_id: int) -> bool:
-    return _update_live(job_id,
-                        status=ManagedJobStatus.CANCELLING.value)
+    return set_status_nonterminal(job_id, ManagedJobStatus.CANCELLING)
 
 
 def set_terminal(job_id: int, status: ManagedJobStatus,
                  failure_reason: Optional[str] = None) -> bool:
-    """First terminal status wins; a later writer cannot overwrite it."""
+    """First terminal status wins; a later writer cannot overwrite it.
+
+    The single guarded UPDATE (status NOT IN terminal) is atomic under
+    sqlite's write lock, so N concurrent terminal writers commit
+    exactly one transition.
+    """
     assert status.is_terminal(), status
     return _update_live(job_id, status=status.value, ended_at=time.time(),
                         failure_reason=failure_reason)

@@ -232,10 +232,9 @@ class ReplicaManager:
         ids = []
         for _ in range(n):
             rid = serve_state.next_replica_id(self.service_name)
-            serve_state.upsert_replica(
+            serve_state.add_replica(
                 self.service_name, rid,
                 cluster_name=self._cluster_name(rid),
-                status=ReplicaStatus.PROVISIONING.value, url='',
                 version=self.version)
             if self.spot_placer is not None:
                 loc = self.spot_placer.select_next_location(
@@ -257,16 +256,45 @@ class ReplicaManager:
             _, handle = execution.launch(task, cluster_name=name,
                                          detach_run=True)
             assert handle is not None
+            # Guarded transition FIRST: if the replica was terminated
+            # while we were launching (scale-down, shutdown), the
+            # PROVISIONING row is gone or SHUTTING_DOWN and the setter
+            # refuses — a stale launch thread must not resurrect it.
+            if not serve_state.set_replica_status(
+                    self.service_name, replica_id,
+                    ReplicaStatus.STARTING):
+                logger.info(f'Replica {replica_id} of '
+                            f'{self.service_name} disappeared during '
+                            f'launch; tearing down {name}.')
+                self._teardown_orphan(name)
+                return
             serve_state.upsert_replica(
                 self.service_name, replica_id, cluster_name=name,
-                status=ReplicaStatus.STARTING.value,
                 url=self._replica_url(replica_id, handle))
             logger.info(f'Replica {replica_id} of {self.service_name} '
                         f'provisioned at {name}.')
         except Exception as e:  # pylint: disable=broad-except
             logger.warning(f'Replica {replica_id} launch failed: {e}')
-            serve_state.set_replica_status(self.service_name, replica_id,
-                                           ReplicaStatus.FAILED)
+            if not serve_state.set_replica_status(
+                    self.service_name, replica_id, ReplicaStatus.FAILED):
+                # Row removed mid-launch (scale-down raced us) — but
+                # the launch may have registered the cluster before
+                # failing a later stage. Nobody else will ever see
+                # this replica: tear the cluster down here or it
+                # bills forever.
+                self._teardown_orphan(name)
+
+    def _teardown_orphan(self, cluster_name: str) -> None:
+        """Tear down a cluster whose replica row no longer exists."""
+        try:
+            record = global_state.get_cluster(cluster_name)
+            if record is not None:
+                handle = slice_backend.SliceResourceHandle.from_dict(
+                    record['handle'])
+                self.backend.teardown(handle, terminate=True)
+        except Exception as e:  # pylint: disable=broad-except
+            logger.warning(f'Orphan teardown of {cluster_name} '
+                           f'failed: {e}')
 
     def terminate_replica(self, replica_id: int,
                           status: ReplicaStatus = ReplicaStatus.SHUTTING_DOWN
@@ -304,8 +332,11 @@ class ReplicaManager:
                                                  handle.provider_config)
         except exceptions.ClusterDoesNotExist:
             return True
-        except Exception:  # pylint: disable=broad-except
-            return False   # transient API error ≠ preemption
+        except Exception as e:  # pylint: disable=broad-except
+            # Transient API error ≠ preemption.
+            logger.debug(f'Replica {replica_id} liveness probe failed '
+                         f'(assuming alive): {e}')
+            return False
         return not statuses or not all(
             s in ('running', 'READY') for s in statuses.values())
 
@@ -323,7 +354,9 @@ class ReplicaManager:
             handle = slice_backend.SliceResourceHandle.from_dict(
                 record['handle'])
             jobs = self.backend.queue(handle)
-        except Exception:  # pylint: disable=broad-except
+        except Exception as e:  # pylint: disable=broad-except
+            logger.debug(f'Replica {replica_id} app-liveness query '
+                         f'failed (treating as unknown): {e}')
             return None
         if not jobs:
             return None    # job not registered yet (setup still running)
@@ -347,6 +380,15 @@ class ReplicaManager:
             if status in (ReplicaStatus.PROVISIONING,
                           ReplicaStatus.SHUTTING_DOWN):
                 alive.append(rep)   # in flight; count toward target
+                continue
+            if status is ReplicaStatus.FAILED:
+                # Launch thread already marked it (often with NO
+                # cluster record, so this must run BEFORE the
+                # cluster-gone probe — a launch failure is not a
+                # preemption: it bumps the permanent-failure streak
+                # and must not penalize the zone in the spot placer).
+                self.terminate_replica(rid, ReplicaStatus.FAILED)
+                self._probe_failure_streak += 1
                 continue
             if self._cluster_gone(rid):
                 logger.info(f'Replica {rid} lost (preemption/teardown) — '
@@ -436,13 +478,6 @@ class ReplicaManager:
                         serve_state.set_replica_status(
                             self.service_name, rid, ReplicaStatus.NOT_READY)
                 alive.append(rep)
-            elif status is ReplicaStatus.FAILED:
-                # Launch thread already marked it; clean up and replace via
-                # the scale-up below. Launch failures count toward the
-                # permanent-failure cap exactly like probe failures — an
-                # unprovisionable service must not churn clusters forever.
-                self.terminate_replica(rid, ReplicaStatus.FAILED)
-                self._probe_failure_streak += 1
         # A broken app fails probes on every fresh replica: without a cap
         # the loop launches and tears down (billing!) slices forever. The
         # streak resets on any successful probe, so preemption-replacement

@@ -11,8 +11,12 @@ import sqlite3
 import time
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu import sky_logging
+from skypilot_tpu.analysis import state_machines
 from skypilot_tpu.utils import sqlite_utils
 from skypilot_tpu.utils import vclock
+
+logger = sky_logging.init_logger(__name__)
 
 _DB_PATH_ENV = 'SKYTPU_SERVE_DB'
 
@@ -134,9 +138,46 @@ def update_service(name: str, **cols: Any) -> None:
                      (*cols.values(), name))
 
 
+def _guarded_transition(table: str, enum_cls, transitions,
+                        where_sql: str, where_params: tuple,
+                        status, set_sql: str = '',
+                        set_params: tuple = ()) -> bool:
+    """Shared guarded status write: SELECT current status, check the
+    declared transition table, UPDATE — all under BEGIN IMMEDIATE, so
+    a concurrent terminal writer cannot slip between the check and the
+    write. Returns False when refused (row gone or undeclared edge)."""
+    conn = _conn()
+    with sqlite_utils.immediate(conn):
+        row = conn.execute(
+            f'SELECT status FROM {table} WHERE {where_sql}',
+            where_params).fetchone()
+        if row is None:
+            return False
+        cur = enum_cls(row[0])
+        if not state_machines.can_transition(transitions, cur.name,
+                                             status.name):
+            logger.warning(
+                f'{table} {where_params}: refusing undeclared '
+                f'transition {cur.value} -> {status.value} (see '
+                f'analysis/state_machines.py).')
+            return False
+        conn.execute(
+            f'UPDATE {table} SET status = ?{set_sql} '
+            f'WHERE {where_sql}',
+            (status.value, *set_params, *where_params))
+    return True
+
+
 def set_service_status(name: str, status: ServiceStatus,
-                       failure_reason: Optional[str] = None) -> None:
-    update_service(name, status=status.value, failure_reason=failure_reason)
+                       failure_reason: Optional[str] = None) -> bool:
+    """Guarded transition per state_machines.SERVICE_TRANSITIONS: a
+    `serve down` racing a crashing controller cannot have its terminal
+    SHUTDOWN overwritten by a late FAILED (nor a SHUTDOWN service
+    resurrected). Returns False when refused."""
+    return _guarded_transition(
+        'services', ServiceStatus, state_machines.SERVICE_TRANSITIONS,
+        'name = ?', (name,), status,
+        set_sql=', failure_reason = ?', set_params=(failure_reason,))
 
 
 def get_service(name: str) -> Optional[Dict[str, Any]]:
@@ -172,7 +213,28 @@ def _service_row(row: sqlite3.Row) -> Dict[str, Any]:
 # ---------------------------------------------------------------------------
 # Replicas
 # ---------------------------------------------------------------------------
+def add_replica(service: str, replica_id: int, cluster_name: str,
+                version: int = 1, url: str = '') -> bool:
+    """Register a fresh replica in its initial PROVISIONING state (the
+    only legal entry point of the replica state machine). Returns False
+    when the id is already taken — never overwrites an existing row."""
+    with _conn() as conn:
+        cur = conn.execute(
+            'INSERT INTO replicas (service, replica_id, cluster_name, '
+            'status, url, launched_at, version) VALUES (?, ?, ?, ?, ?, '
+            '?, ?) ON CONFLICT(service, replica_id) DO NOTHING',
+            (service, replica_id, cluster_name,
+             ReplicaStatus.PROVISIONING.value, url, vclock.now(),
+             version))
+        return cur.rowcount > 0
+
+
 def upsert_replica(service: str, replica_id: int, **cols: Any) -> None:
+    """Raw column upsert for NON-status replica columns (url, job_id,
+    cluster_name, ...). Status changes must go through
+    set_replica_status / add_replica so the declared transition table
+    applies — skylint's state-machine checker enforces that for
+    package code (tests may still seed arbitrary states here)."""
     cols.setdefault('launched_at', vclock.now())
     names = ', '.join(cols)
     ph = ', '.join('?' * len(cols))
@@ -186,11 +248,15 @@ def upsert_replica(service: str, replica_id: int, **cols: Any) -> None:
 
 
 def set_replica_status(service: str, replica_id: int,
-                       status: ReplicaStatus) -> None:
-    with _conn() as conn:
-        conn.execute(
-            'UPDATE replicas SET status = ? WHERE service = ? AND '
-            'replica_id = ?', (status.value, service, replica_id))
+                       status: ReplicaStatus) -> bool:
+    """Guarded transition per state_machines.REPLICA_TRANSITIONS: a
+    stale launch thread can never flip a FAILED/SHUTTING_DOWN replica
+    back to STARTING (the terminal-overwrite bug class). Returns False
+    when refused (row gone — e.g. terminated mid-launch — or an
+    undeclared edge)."""
+    return _guarded_transition(
+        'replicas', ReplicaStatus, state_machines.REPLICA_TRANSITIONS,
+        'service = ? AND replica_id = ?', (service, replica_id), status)
 
 
 def bump_replica_failures(service: str, replica_id: int) -> int:
@@ -236,18 +302,14 @@ def get_replicas(service: str) -> List[Dict[str, Any]]:
 def acquire_worker(service: str, job_id: int) -> Optional[Dict[str, Any]]:
     """Atomically claim one READY, unassigned pool worker for a managed
     job. Returns its replica record, or None when every worker is busy
-    (the caller queues). BEGIN IMMEDIATE takes sqlite's single write
-    lock up front, so the SELECT-then-UPDATE is atomic against
+    (the caller queues). sqlite_utils.immediate takes sqlite's single
+    write lock up front (and fails loudly on an already-open
+    transaction), so the SELECT-then-UPDATE is atomic against
     concurrent controllers (and portable: sqlite < 3.35 has no
     UPDATE...RETURNING)."""
-    with _conn() as conn:
-        conn.row_factory = sqlite3.Row
-        # Unconditional: if a future refactor ever hands us a
-        # connection that is already mid-transaction, the claim's
-        # atomicity is gone — fail loudly here, don't degrade to a
-        # read-locked SELECT that lets two controllers claim the same
-        # worker.
-        conn.execute('BEGIN IMMEDIATE')
+    conn = _conn()
+    conn.row_factory = sqlite3.Row
+    with sqlite_utils.immediate(conn):
         row = conn.execute(
             'SELECT rowid AS _rowid, * FROM replicas WHERE service = ? '
             "AND status = 'READY' AND job_id IS NULL ORDER BY replica_id "

@@ -68,7 +68,11 @@ def _candidate_locations(task: 'task_lib.Task') -> List[Location]:
         for cloud in clouds:
             try:
                 regions = cloud.regions_with_offering(res)
-            except Exception:  # pylint: disable=broad-except
+            except Exception as e:  # pylint: disable=broad-except
+                # One broken cloud must not kill placement, but a
+                # silent skip hides why a zone never gets candidates.
+                logger.debug(f'spot placer: {cloud} offering lookup '
+                             f'failed ({e}); skipping.')
                 continue
             for region in regions:
                 if res.region is not None and region.name != res.region:
@@ -142,7 +146,12 @@ class SpotPlacer:
             try:
                 res = self._resources.copy(**location.to_override())
                 self._cost_cache[location] = res.get_cost(seconds=3600)
-            except Exception:  # pylint: disable=broad-except
+            except Exception as e:  # pylint: disable=broad-except
+                # inf = "never pick on price"; log why so a catalog gap
+                # doesn't silently exile a perfectly good zone.
+                logger.debug(f'spot placer: no cost for {location} '
+                             f'({e}); treating as infinitely '
+                             f'expensive.')
                 self._cost_cache[location] = float('inf')
         return self._cost_cache[location]
 

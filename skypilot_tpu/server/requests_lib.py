@@ -114,16 +114,14 @@ def next_pending(schedule_type: str) -> Optional[Dict[str, Any]]:
     The claim must not race: a SELECT-then-guarded-UPDATE that can land
     on a just-claimed row returns None while work is still queued, and
     the scheduler's idle backoff then paces a busy queue at 5 claims/s
-    (caught by tests/load_tests/test_load_on_server.py). BEGIN
-    IMMEDIATE takes sqlite's single write lock before the SELECT, so no
+    (caught by tests/load_tests/test_load_on_server.py).
+    sqlite_utils.immediate takes sqlite's single write lock before the
+    SELECT (and fails loudly on an already-open transaction), so no
     other dispatcher can claim between our SELECT and UPDATE — same
     atomicity as the previous UPDATE...RETURNING form, but portable to
     sqlite < 3.35."""
-    with _conn() as conn:
-        # Unconditional: a connection already mid-transaction would
-        # silently lose the write lock this claim's atomicity rests
-        # on — better to fail loudly than double-claim.
-        conn.execute('BEGIN IMMEDIATE')
+    conn = _conn()
+    with sqlite_utils.immediate(conn):
         row = conn.execute(
             'SELECT request_id FROM requests WHERE status=? AND '
             'schedule_type=? AND started_at IS NULL '

@@ -72,22 +72,42 @@ def add_job(job_name: str, username: str, run_cmd: str,
 
 
 def set_status(job_id: int, status: JobStatus,
-               pid: Optional[int] = None) -> None:
+               pid: Optional[int] = None,
+               only_if_nonterminal: bool = False) -> bool:
+    """Write the on-cluster job status.
+
+    With ``only_if_nonterminal=True`` the write happens inside a BEGIN
+    IMMEDIATE read-check-write, so it can never overwrite a terminal
+    row — the cancel path uses this to avoid clobbering a
+    SUCCEEDED/FAILED the driver recorded concurrently. Returns False
+    when refused (row gone or already terminal).
+    """
+    sets = ['status = ?']
+    vals: List[Any] = [status.value]
+    if status is JobStatus.RUNNING:
+        sets.append('started_at = ?')
+        vals.append(time.time())
+    if status.is_terminal():
+        sets.append('ended_at = ?')
+        vals.append(time.time())
+    if pid is not None:
+        sets.append('pid = ?')
+        vals.append(pid)
+    vals.append(job_id)
+    sql = f'UPDATE jobs SET {", ".join(sets)} WHERE job_id = ?'
+    if only_if_nonterminal:
+        conn = _conn()
+        with sqlite_utils.immediate(conn):
+            row = conn.execute(
+                'SELECT status FROM jobs WHERE job_id = ?',
+                (job_id,)).fetchone()
+            if row is None or JobStatus(row[0]).is_terminal():
+                return False
+            conn.execute(sql, vals)
+        return True
     with _conn() as conn:
-        sets = ['status = ?']
-        vals: List[Any] = [status.value]
-        if status is JobStatus.RUNNING:
-            sets.append('started_at = ?')
-            vals.append(time.time())
-        if status.is_terminal():
-            sets.append('ended_at = ?')
-            vals.append(time.time())
-        if pid is not None:
-            sets.append('pid = ?')
-            vals.append(pid)
-        vals.append(job_id)
-        conn.execute(f'UPDATE jobs SET {", ".join(sets)} WHERE job_id = ?',
-                     vals)
+        conn.execute(sql, vals)
+    return True
 
 
 def get_job(job_id: int) -> Optional[Dict[str, Any]]:
@@ -118,7 +138,12 @@ def list_jobs(all_users: bool = True,
 
 
 def cancel_job(job_id: int) -> bool:
-    """Terminate the driver process tree; mark CANCELLED."""
+    """Terminate the driver process tree; mark CANCELLED.
+
+    The CANCELLED write is guarded (only_if_nonterminal): if the
+    driver recorded SUCCEEDED/FAILED between our check and the kill,
+    the terminal status it wrote wins — cancel never rewrites history.
+    """
     job = get_job(job_id)
     if job is None:
         return False
@@ -129,8 +154,8 @@ def cancel_job(job_id: int) -> bool:
     if pid:
         from skypilot_tpu.utils import subprocess_utils
         subprocess_utils.kill_process_daemon(int(pid))
-    set_status(job_id, JobStatus.CANCELLED)
-    return True
+    return set_status(job_id, JobStatus.CANCELLED,
+                      only_if_nonterminal=True)
 
 
 def last_activity_time() -> float:

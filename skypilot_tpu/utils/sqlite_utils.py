@@ -12,8 +12,10 @@ that race everywhere instead of each module rediscovering it.
 """
 from __future__ import annotations
 
+import contextlib
 import sqlite3
 import time
+from typing import Iterator
 
 _WAL_RETRIES = 50
 _WAL_RETRY_SLEEP_S = 0.05
@@ -31,3 +33,30 @@ def connect_wal(path: str, timeout: float = 30.0) -> sqlite3.Connection:
                 raise
             time.sleep(_WAL_RETRY_SLEEP_S)
     return conn
+
+
+@contextlib.contextmanager
+def immediate(conn: sqlite3.Connection) -> Iterator[sqlite3.Connection]:
+    """BEGIN IMMEDIATE transaction scope for read-modify-write.
+
+    sqlite's default deferred transaction takes only a read lock until
+    the first write, so SELECT-then-UPDATE lets a concurrent writer
+    claim the row in between (the round-5 pool-claim / dispatcher
+    race). BEGIN IMMEDIATE takes the single write lock up front: the
+    whole block is atomic against every other writer, and portable to
+    sqlite < 3.35 (no UPDATE...RETURNING needed).
+
+    Raises sqlite3.OperationalError if the connection is already
+    mid-transaction — a nested claim would silently lose the lock its
+    atomicity rests on, so fail loudly instead. The skylint
+    ``sqlite-discipline`` checker requires state-DB read-modify-write
+    sequences to run inside this helper.
+    """
+    conn.execute('BEGIN IMMEDIATE')
+    try:
+        yield conn
+    except BaseException:
+        conn.rollback()
+        raise
+    else:
+        conn.commit()

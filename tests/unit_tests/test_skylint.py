@@ -30,6 +30,15 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 PKG = os.path.join(REPO, 'skypilot_tpu')
 
+# The stable checker roster: adding a checker means updating this list,
+# its docs section (asserted in TestLivePackage) and a fixture class
+# below — the gate test fails loudly otherwise.
+EXPECTED_CHECKS = [
+    'layers', 'lazy-imports', 'async-blocking', 'jit-hazards',
+    'sqlite-discipline', 'state-machine', 'thread-discipline',
+    'silent-except',
+]
+
 
 def _write(root, rel, src):
     path = os.path.join(root, rel)
@@ -259,6 +268,324 @@ class TestJitHazardChecker:
         assert _run(tmp_path, checks=['jit-hazards'])['total'] == 0
 
 
+# ------------------------------------------------------------ async multi-hop
+
+class TestAsyncBlockingTransitive:
+
+    def test_two_hop_chain_flagged(self, tmp_path):
+        # v2 upgrade: the v1 checker followed exactly one hop; a bug
+        # hidden one helper deeper (loop -> relay -> send -> sendall)
+        # sailed through. The call-graph fixpoint catches any depth.
+        _write(tmp_path, 'serve/deep.py', '''\
+            class Leader:
+                def send(self, data):
+                    for conn in self._conns:
+                        conn.sendall(data)
+
+                def relay(self, op):
+                    self.send(op)
+
+            async def loop(leader, ops):
+                for op in ops:
+                    leader.relay(op)
+        ''')
+        report = _run(tmp_path, checks=['async-blocking'])
+        assert 'async-blocking:serve/deep.py:relay->send->.sendall' in \
+            _idents(report)
+
+    def test_awaited_helper_chain_ok(self, tmp_path):
+        _write(tmp_path, 'serve/deep_ok.py', '''\
+            import asyncio
+
+            def compute(x):
+                return x + 1
+
+            async def loop(xs):
+                return [compute(x) for x in xs] + \\
+                    [await asyncio.sleep(0)]
+        ''')
+        assert _run(tmp_path, checks=['async-blocking'])['total'] == 0
+
+
+# ------------------------------------------------------------ sqlite discipline
+
+class TestSqliteDisciplineChecker:
+
+    def test_raw_connect_and_returning_flagged(self, tmp_path):
+        _write(tmp_path, 'server/raw.py', '''\
+            import sqlite3
+
+            def bad_connect(path):
+                return sqlite3.connect(path)
+
+            def bad_claim(conn):
+                return conn.execute(
+                    'UPDATE requests SET started_at=1 '
+                    'WHERE id=2 RETURNING *')
+        ''')
+        report = _run(tmp_path, checks=['sqlite-discipline'])
+        assert sorted(v['key'] for v in report['violations']) == \
+            ['returning', 'sqlite3.connect']
+
+    def test_select_then_update_outside_immediate(self, tmp_path):
+        # The claim-race shape: SELECT a candidate row, then UPDATE it,
+        # with no write lock held in between — two dispatchers can both
+        # pass the SELECT. Path goes through jobs/state.py so the
+        # state-DB scope rule applies.
+        _write(tmp_path, 'jobs/state.py', '''\
+            def claim(conn):
+                row = conn.execute(
+                    'SELECT job_id FROM jobs WHERE status = ? '
+                    'LIMIT 1').fetchone()
+                if row is None:
+                    return None
+                conn.execute('UPDATE jobs SET pid = 1 '
+                             'WHERE job_id = ?', (row[0],))
+                return row[0]
+        ''')
+        report = _run(tmp_path, checks=['sqlite-discipline'])
+        assert _idents(report) == \
+            ['sqlite-discipline:jobs/state.py:claim:jobs']
+        assert 'BEGIN IMMEDIATE' in report['violations'][0]['message']
+
+    def test_immediate_helper_and_begin_suppress(self, tmp_path):
+        _write(tmp_path, 'jobs/state.py', '''\
+            from skypilot_tpu.utils import sqlite_utils
+
+            def claim_with_helper(conn):
+                with sqlite_utils.immediate(conn):
+                    row = conn.execute(
+                        'SELECT job_id FROM jobs LIMIT 1').fetchone()
+                    conn.execute('UPDATE jobs SET pid = 1 '
+                                 'WHERE job_id = ?', (row[0],))
+
+            def claim_with_raw_begin(conn):
+                conn.execute('BEGIN IMMEDIATE')
+                row = conn.execute(
+                    'SELECT job_id FROM jobs LIMIT 1').fetchone()
+                conn.execute('UPDATE jobs SET pid = 1 '
+                             'WHERE job_id = ?', (row[0],))
+                conn.commit()
+
+            def different_tables_ok(conn):
+                row = conn.execute(
+                    'SELECT name FROM services LIMIT 1').fetchone()
+                conn.execute('UPDATE jobs SET pool = ?', (row[0],))
+        ''')
+        assert _run(tmp_path, checks=['sqlite-discipline'])['total'] == 0
+
+    def test_update_without_select_and_docstrings_ok(self, tmp_path):
+        _write(tmp_path, 'serve/serve_state.py', '''\
+            def plain_update(conn):
+                """Docstrings mentioning UPDATE...RETURNING are prose."""
+                conn.execute('UPDATE replicas SET url = ?', ('x',))
+        ''')
+        assert _run(tmp_path, checks=['sqlite-discipline'])['total'] == 0
+
+
+# ------------------------------------------------------------ state machine
+
+class TestStateMachineChecker:
+
+    def test_uncovered_enum_member_flagged(self, tmp_path):
+        # Adding a status without wiring its transitions fails lint.
+        _write(tmp_path, 'jobs/state.py', '''\
+            import enum
+
+            class ManagedJobStatus(enum.Enum):
+                PENDING = 'PENDING'
+                PAUSED = 'PAUSED'       # <- not in the declared table
+        ''')
+        report = _run(tmp_path, checks=['state-machine'])
+        assert _idents(report) == \
+            ['state-machine:jobs/state.py:ManagedJobStatus.PAUSED']
+
+    def test_status_kwarg_bypass_flagged(self, tmp_path):
+        _write(tmp_path, 'jobs/sneaky.py', '''\
+            from skypilot_tpu.jobs import state
+
+            def resurrect(job_id):
+                state._update(job_id, status='RUNNING')
+        ''')
+        report = _run(tmp_path, checks=['state-machine'])
+        assert _idents(report) == \
+            ['state-machine:jobs/sneaky.py:resurrect:_update']
+
+    def test_raw_sql_status_write_flagged(self, tmp_path):
+        _write(tmp_path, 'serve/sneaky.py', '''\
+            def overwrite(conn, name):
+                conn.execute("UPDATE services SET status = 'READY' "
+                             'WHERE name = ?', (name,))
+        ''')
+        report = _run(tmp_path, checks=['state-machine'])
+        assert _idents(report) == \
+            ['state-machine:serve/sneaky.py:overwrite:raw-sql']
+
+    def test_guarded_setters_and_covered_enum_ok(self, tmp_path):
+        _write(tmp_path, 'serve/serve_state.py', '''\
+            import enum
+
+            class ReplicaStatus(enum.Enum):
+                PROVISIONING = 'PROVISIONING'
+                STARTING = 'STARTING'
+                READY = 'READY'
+
+            def set_replica_status(conn, status):
+                conn.execute('UPDATE replicas SET status = ? '
+                             'WHERE id = 1', (status,))
+
+            def set_url(job_id, **cols):
+                upsert_replica(job_id, url='http://x')
+
+            def upsert_replica(job_id, **cols):
+                pass
+        ''')
+        assert _run(tmp_path, checks=['state-machine'])['total'] == 0
+
+
+# ------------------------------------------------------------ thread discipline
+
+class TestThreadDisciplineChecker:
+
+    def test_leaked_nondaemon_thread_flagged(self, tmp_path):
+        _write(tmp_path, 'jobs/leak.py', '''\
+            import threading
+
+            def spawn(fn):
+                t = threading.Thread(target=fn)
+                t.start()
+                return t
+        ''')
+        report = _run(tmp_path, checks=['thread-discipline'])
+        assert _idents(report) == \
+            ['thread-discipline:jobs/leak.py:thread-t']
+
+    def test_daemon_joined_and_container_join_ok(self, tmp_path):
+        _write(tmp_path, 'jobs/ok.py', '''\
+            import threading
+
+            def daemonized(fn):
+                threading.Thread(target=fn, daemon=True).start()
+
+            def joined(fn):
+                t = threading.Thread(target=fn)
+                t.start()
+                t.join()
+
+            def container_joined(fns):
+                threads = [threading.Thread(target=f) for f in fns]
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join()
+        ''')
+        assert _run(tmp_path, checks=['thread-discipline'])['total'] == 0
+
+    def test_blocking_under_lock_flagged(self, tmp_path):
+        _write(tmp_path, 'serve/locky.py', '''\
+            import subprocess
+            import threading
+
+            _lock = threading.Lock()
+
+            def slow_critical_section(cmd):
+                with _lock:
+                    subprocess.run(cmd)
+        ''')
+        report = _run(tmp_path, checks=['thread-discipline'])
+        assert _idents(report) == \
+            ['thread-discipline:serve/locky.py:_lock->subprocess.run']
+
+    def test_fast_lock_body_and_filelock_factory_ok(self, tmp_path):
+        _write(tmp_path, 'serve/locky_ok.py', '''\
+            import subprocess
+            import threading
+
+            _lock = threading.Lock()
+
+            def fast(d, k, v):
+                with _lock:
+                    d[k] = v
+
+            def coarse_file_lock(cmd, locks):
+                # cluster_status_lock is a coarse file lock held across
+                # provisioning by design — exempt (it is a call).
+                with locks.cluster_status_lock('x', timeout=60):
+                    subprocess.run(cmd)
+        ''')
+        assert _run(tmp_path, checks=['thread-discipline'])['total'] == 0
+
+
+# ------------------------------------------------------------ silent except
+
+class TestSilentExceptChecker:
+
+    def test_silent_broad_except_flagged(self, tmp_path):
+        _write(tmp_path, 'jobs/quiet.py', '''\
+            def swallow():
+                try:
+                    work()
+                except Exception:
+                    pass
+
+            def swallow_bare():
+                try:
+                    work()
+                except:
+                    return False
+        ''')
+        report = _run(tmp_path, checks=['silent-except'])
+        assert sorted(_idents(report)) == [
+            'silent-except:jobs/quiet.py:swallow',
+            'silent-except:jobs/quiet.py:swallow_bare',
+        ]
+
+    def test_logging_raising_recording_and_escape_ok(self, tmp_path):
+        _write(tmp_path, 'jobs/loud.py', '''\
+            def logs(logger):
+                try:
+                    work()
+                except Exception as e:
+                    logger.warning(f'work failed: {e}')
+
+            def reraises():
+                try:
+                    work()
+                except Exception:
+                    raise RuntimeError('wrapped')
+
+            def records(job_id, state):
+                try:
+                    work()
+                except Exception as e:
+                    state.set_terminal(job_id, 'FAILED',
+                                      failure_reason=str(e))
+
+            def escapes():
+                try:
+                    return work()
+                except Exception as e:
+                    return {'error': str(e)}
+
+            def narrow_is_exempt():
+                try:
+                    work()
+                except OSError:
+                    pass
+        ''')
+        assert _run(tmp_path, checks=['silent-except'])['total'] == 0
+
+    def test_compute_plane_exempt(self, tmp_path):
+        _write(tmp_path, 'ops/kernel.py', '''\
+            def fallback():
+                try:
+                    fancy()
+                except Exception:
+                    pass
+        ''')
+        assert _run(tmp_path, checks=['silent-except'])['total'] == 0
+
+
 # ------------------------------------------------------------ allowlist + report
 
 class TestAllowlistAndReport:
@@ -294,8 +621,7 @@ class TestAllowlistAndReport:
             'skylint_version', 'root', 'files_scanned', 'checks',
             'violations', 'total', 'allowlisted', 'new',
             'stale_allowlist_entries'}
-        assert report['checks'] == ['layers', 'lazy-imports',
-                                    'async-blocking', 'jit-hazards']
+        assert report['checks'] == EXPECTED_CHECKS
         (v,) = report['violations']
         assert set(v) == {'check', 'path', 'line', 'col', 'key',
                           'message', 'allowlisted'}
@@ -332,6 +658,108 @@ class TestCli:
         assert proc.returncode == 1
         assert 'clouds/x.py:1' in proc.stdout
         assert '1 new' in proc.stdout
+
+    def test_stale_entry_fails_ratchet_and_prune_rewrites(self,
+                                                          tmp_path):
+        # The ratchet: an allowlist entry matching nothing means the
+        # violation was fixed — the run FAILS until the entry is
+        # deleted (or --prune rewrites the file). Allowlists only
+        # shrink.
+        _write(tmp_path, 'pkg/serve/ok.py', 'import os\n')
+        allow = tmp_path / 'allow.txt'
+        live = 'layers:serve/gone.py:skypilot_tpu.jobs'
+        allow.write_text(core.dump_allowlist([live]))
+        proc = self._cli('--root', str(tmp_path / 'pkg'),
+                         '--allowlist', str(allow))
+        assert proc.returncode == 1
+        assert 'stale allowlist entry' in proc.stdout
+        proc = self._cli('--root', str(tmp_path / 'pkg'),
+                         '--allowlist', str(allow), '--prune')
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert 'pruned 1 stale' in proc.stderr
+        assert core.load_allowlist(str(allow)) == []
+        # Clean after the prune.
+        proc = self._cli('--root', str(tmp_path / 'pkg'),
+                         '--allowlist', str(allow))
+        assert proc.returncode == 0
+
+    def test_prune_rejects_changed_mode(self, tmp_path):
+        proc = self._cli('--root', str(tmp_path), '--changed', '--prune')
+        assert proc.returncode == 2
+
+    def test_prune_preserves_surviving_comments(self, tmp_path):
+        # The workflow REQUIRES a justification comment per entry;
+        # --prune must not strip it from entries that survive.
+        _write(tmp_path, 'pkg/clouds/x.py',
+               'from skypilot_tpu import backends\n')
+        live = 'layers:clouds/x.py:skypilot_tpu.backends'
+        allow = tmp_path / 'allow.txt'
+        allow.write_text(
+            '# header comment\n'
+            f'{live}   # justified: burn-down tracked in ISSUE-42\n'
+            'layers:clouds/gone.py:skypilot_tpu.server\n')
+        proc = self._cli('--root', str(tmp_path / 'pkg'),
+                         '--allowlist', str(allow), '--prune')
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        text = allow.read_text()
+        assert '# justified: burn-down tracked in ISSUE-42' in text
+        assert '# header comment' in text
+        assert 'gone.py' not in text
+        assert core.load_allowlist(str(allow)) == [live]
+
+    def test_json_mode_stays_pure_json(self, tmp_path):
+        # `skylint ... --format json > skylint.json` is the CI
+        # pattern: stdout must be exactly one JSON document even when
+        # --changed finds nothing (informational notes go to stderr).
+        repo = tmp_path / 'jrepo'
+        _write(repo, 'pkg/serve/ok.py', 'import os\n')
+        env = {**os.environ, 'GIT_AUTHOR_NAME': 't',
+               'GIT_AUTHOR_EMAIL': 't@t', 'GIT_COMMITTER_NAME': 't',
+               'GIT_COMMITTER_EMAIL': 't@t'}
+        for args in (['init', '-b', 'main'], ['add', '-A'],
+                     ['commit', '-m', 'seed']):
+            subprocess.run(['git', *args], cwd=repo, env=env,
+                           capture_output=True, timeout=60, check=True)
+        proc = self._cli('--root', str(repo / 'pkg'), '--format',
+                         'json', '--changed')
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        report = json.loads(proc.stdout)     # pure JSON, parses
+        assert report['files_scanned'] == 0
+        assert 'no changed .py files' in proc.stderr
+
+    def test_changed_mode_lints_only_diffed_files(self, tmp_path):
+        # Build a real git repo: main has a clean file; a feature
+        # branch adds a violating one. --changed must scan ONLY the
+        # new file (1 file), catch its violation, and ignore the
+        # (unchanged) rest of the tree.
+        repo = tmp_path / 'repo'
+        pkg = repo / 'pkg'
+        # Pre-existing (committed) violation: upward import in clouds.
+        # --changed must NOT see it — only the tier-1 full scan does.
+        _write(repo, 'pkg/clouds/old.py',
+               'from skypilot_tpu import backends\n')
+        env = {**os.environ, 'GIT_AUTHOR_NAME': 't',
+               'GIT_AUTHOR_EMAIL': 't@t', 'GIT_COMMITTER_NAME': 't',
+               'GIT_COMMITTER_EMAIL': 't@t'}
+
+        def git(*args):
+            return subprocess.run(['git', *args], cwd=repo, env=env,
+                                  capture_output=True, text=True,
+                                  timeout=60, check=True)
+
+        git('init', '-b', 'main')
+        git('add', '-A')
+        git('commit', '-m', 'seed')
+        git('checkout', '-b', 'feature')
+        _write(repo, 'pkg/jobs/new.py',
+               'from skypilot_tpu.serve import core\n')
+        proc = self._cli('--root', str(pkg), '--format', 'json',
+                         '--changed', '--no-allowlist')
+        report = json.loads(proc.stdout)
+        assert report['files_scanned'] == 1
+        assert [v['path'] for v in report['violations']] == \
+            ['jobs/new.py']
+        assert proc.returncode == 1
 
 
 # ------------------------------------------------------------ injection
@@ -400,3 +828,42 @@ class TestLivePackage:
             'delete the entries')
         # Sanity: the scan actually covered the package.
         assert report['files_scanned'] > 100
+
+    def test_gate_emits_stable_json_summary(self, tmp_path):
+        """CI artifact + schema ratchet: run the real CLI in JSON mode
+        (`skylint --format json > skylint.json`), and pin the checker
+        roster, report schema, and docs/tests sync — adding a checker
+        without updating EXPECTED_CHECKS, its docs section and a
+        fixture class fails here, loudly."""
+        out_path = os.path.join(tmp_path, 'skylint.json')
+        proc = subprocess.run(
+            [sys.executable, '-m', 'skypilot_tpu.analysis',
+             '--format', 'json'],
+            capture_output=True, text=True, cwd=REPO,
+            env={**os.environ, 'PYTHONPATH': REPO}, timeout=300)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        with open(out_path, 'w', encoding='utf-8') as f:
+            f.write(proc.stdout)
+        with open(out_path, encoding='utf-8') as f:
+            report = json.load(f)
+        # Schema stability (version-bump ratchet).
+        assert report['skylint_version'] == core.REPORT_VERSION == 2
+        assert set(report) == {
+            'skylint_version', 'root', 'files_scanned', 'checks',
+            'violations', 'total', 'allowlisted', 'new',
+            'stale_allowlist_entries'}
+        # Checker-count stability.
+        assert report['checks'] == EXPECTED_CHECKS, (
+            'checker roster changed — update EXPECTED_CHECKS, '
+            'docs/ARCHITECTURE_LINT.md and add a fixture class')
+        assert report['new'] == 0
+        # Docs sync: every checker has a documented section.
+        docs = open(os.path.join(REPO, 'docs', 'ARCHITECTURE_LINT.md'),
+                    encoding='utf-8').read()
+        test_src = open(os.path.abspath(__file__),
+                        encoding='utf-8').read()
+        for name in EXPECTED_CHECKS:
+            assert name in docs, f'checker {name!r} missing from ' \
+                                 f'docs/ARCHITECTURE_LINT.md'
+            assert f"checks=['{name}']" in test_src, (
+                f'checker {name!r} has no dedicated fixture test')

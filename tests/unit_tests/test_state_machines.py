@@ -1,0 +1,216 @@
+"""The declared status state machines, exercised for real.
+
+Three angles (docs/STATE_MACHINES.md):
+  1. round-trip — every enum member appears in its transition table
+     and every transition target is a real member (the lint checker
+     covers direction 1 over the live tree; direction 2 lives here).
+  2. contention — concurrent set_terminal writers: exactly one wins.
+  3. integrity — the guards refuse resurrection (a cancelled job
+     cannot go RUNNING; a SHUTDOWN service cannot go READY; a FAILED
+     replica cannot go READY; on-cluster cancel cannot overwrite a
+     terminal status).
+"""
+import threading
+
+import pytest
+
+from skypilot_tpu.analysis import state_machines
+from skypilot_tpu.jobs import state as jobs_state
+from skypilot_tpu.jobs.state import ManagedJobStatus
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
+from skypilot_tpu.skylet import job_lib
+from skypilot_tpu.utils.status_lib import JobStatus
+
+
+@pytest.fixture()
+def state_dirs(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKYTPU_JOBS_DB', str(tmp_path / 'jobs.db'))
+    monkeypatch.setenv('SKYTPU_SERVE_DB', str(tmp_path / 'serve.db'))
+    monkeypatch.setenv('SKYTPU_RUNTIME_DIR', str(tmp_path / 'runtime'))
+    return tmp_path
+
+
+# ------------------------------------------------------------ round trip
+
+class TestTransitionTableRoundTrip:
+
+    @pytest.mark.parametrize('enum_cls,table', [
+        (ManagedJobStatus, state_machines.JOB_TRANSITIONS),
+        (ServiceStatus, state_machines.SERVICE_TRANSITIONS),
+        (ReplicaStatus, state_machines.REPLICA_TRANSITIONS),
+    ])
+    def test_every_member_covered_and_every_target_real(self, enum_cls,
+                                                        table):
+        members = {m.name for m in enum_cls}
+        # Direction 1: every member is a key (adding a status without
+        # wiring transitions fails here AND in skylint).
+        assert members == set(table), (
+            f'{enum_cls.__name__} out of sync with '
+            f'analysis/state_machines.py')
+        # Direction 2: no table entry points at a ghost status.
+        for frm, targets in table.items():
+            assert targets <= members, (frm, targets - members)
+
+    def test_job_terminal_members_are_dead_ends(self):
+        for status in ManagedJobStatus:
+            nxt = state_machines.JOB_TRANSITIONS[status.name]
+            if status.is_terminal():
+                assert nxt == set(), status
+            else:
+                assert nxt, status            # live states can move
+
+    def test_replica_pre_removal_states_cannot_resurrect(self):
+        for name in ('FAILED', 'PREEMPTED', 'SHUTTING_DOWN'):
+            assert 'READY' not in \
+                state_machines.REPLICA_TRANSITIONS[name]
+            assert 'STARTING' not in \
+                state_machines.REPLICA_TRANSITIONS[name]
+
+    def test_self_loops_always_legal(self):
+        assert state_machines.can_transition(
+            state_machines.JOB_TRANSITIONS, 'CANCELLED', 'CANCELLED')
+
+    def test_unknown_state_fails_closed(self):
+        assert not state_machines.can_transition(
+            state_machines.JOB_TRANSITIONS, 'PAUSED', 'RUNNING')
+
+
+# ------------------------------------------------------------ contention
+
+class TestManagedJobContention:
+
+    def test_first_terminal_wins_under_contention(self, state_dirs):
+        job_id = jobs_state.submit('race', {'run': 'true'}, 'failover')
+        jobs_state.set_starting(job_id, 'c')
+        jobs_state.set_started(job_id, 1)
+
+        terminals = [ManagedJobStatus.SUCCEEDED,
+                     ManagedJobStatus.FAILED,
+                     ManagedJobStatus.CANCELLED,
+                     ManagedJobStatus.FAILED_CONTROLLER] * 4
+        results = [None] * len(terminals)
+        barrier = threading.Barrier(len(terminals))
+
+        def writer(i, status):
+            barrier.wait()
+            results[i] = (status,
+                          jobs_state.set_terminal(
+                              job_id, status,
+                              failure_reason=f'writer-{i}'))
+
+        threads = [threading.Thread(target=writer, args=(i, s))
+                   for i, s in enumerate(terminals)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        winners = [s for s, ok in results if ok]
+        assert len(winners) == 1, winners
+        job = jobs_state.get_job(job_id)
+        assert job['status'] is winners[0]
+        assert job['status'].is_terminal()
+
+    def test_nonterminal_cannot_resurrect_cancelled(self, state_dirs):
+        job_id = jobs_state.submit('dead', {'run': 'true'}, 'failover')
+        assert jobs_state.set_terminal(job_id,
+                                       ManagedJobStatus.CANCELLED)
+        # The late controller's whole lifecycle is refused.
+        assert not jobs_state.set_starting(job_id, 'c')
+        assert not jobs_state.set_started(job_id, 7)
+        assert not jobs_state.set_recovering(job_id)
+        assert not jobs_state.set_cancelling(job_id)
+        assert not jobs_state.set_status_nonterminal(
+            job_id, ManagedJobStatus.RUNNING)
+        job = jobs_state.get_job(job_id)
+        assert job['status'] is ManagedJobStatus.CANCELLED
+        assert job['cluster_job_id'] is None   # RUNNING cols not applied
+
+    def test_undeclared_live_edge_refused(self, state_dirs):
+        # PENDING -> RUNNING skips STARTING: not a declared edge.
+        job_id = jobs_state.submit('skip', {'run': 'true'}, 'failover')
+        assert not jobs_state.set_started(job_id, 1)
+        assert jobs_state.get_job(job_id)['status'] is \
+            ManagedJobStatus.PENDING
+
+    def test_missing_row_refused(self, state_dirs):
+        assert not jobs_state.set_status_nonterminal(
+            424242, ManagedJobStatus.STARTING)
+        assert not jobs_state.set_terminal(424242,
+                                           ManagedJobStatus.FAILED)
+
+
+# ------------------------------------------------------------ serve guards
+
+class TestServeStateGuards:
+
+    def test_replica_failed_cannot_go_ready(self, state_dirs):
+        serve_state.add_service('svc', {}, {}, 18080)
+        assert serve_state.add_replica('svc', 1, 'svc-replica-1')
+        assert serve_state.set_replica_status('svc', 1,
+                                              ReplicaStatus.STARTING)
+        assert serve_state.set_replica_status('svc', 1,
+                                              ReplicaStatus.FAILED)
+        # Resurrection refused; replacement (fresh id) is the way.
+        assert not serve_state.set_replica_status('svc', 1,
+                                                  ReplicaStatus.READY)
+        assert not serve_state.set_replica_status(
+            'svc', 1, ReplicaStatus.STARTING)
+        (rep,) = serve_state.get_replicas('svc')
+        assert rep['status'] is ReplicaStatus.FAILED
+
+    def test_add_replica_never_overwrites(self, state_dirs):
+        serve_state.add_service('svc', {}, {}, 18080)
+        assert serve_state.add_replica('svc', 1, 'svc-replica-1')
+        assert serve_state.set_replica_status('svc', 1,
+                                              ReplicaStatus.STARTING)
+        # A duplicate id (stale scale-up) cannot reset the row.
+        assert not serve_state.add_replica('svc', 1, 'svc-replica-1b')
+        (rep,) = serve_state.get_replicas('svc')
+        assert rep['status'] is ReplicaStatus.STARTING
+        assert rep['cluster_name'] == 'svc-replica-1'
+
+    def test_gone_replica_refuses_status_write(self, state_dirs):
+        serve_state.add_service('svc', {}, {}, 18080)
+        assert not serve_state.set_replica_status(
+            'svc', 9, ReplicaStatus.STARTING)
+
+    def test_shutdown_service_cannot_resurrect(self, state_dirs):
+        serve_state.add_service('svc', {}, {}, 18080)
+        assert serve_state.set_service_status(
+            'svc', ServiceStatus.SHUTTING_DOWN)
+        assert serve_state.set_service_status('svc',
+                                              ServiceStatus.SHUTDOWN)
+        assert not serve_state.set_service_status(
+            'svc', ServiceStatus.READY)
+        assert not serve_state.set_service_status(
+            'svc', ServiceStatus.FAILED,
+            failure_reason='late crash handler')
+        assert serve_state.get_service('svc')['status'] is \
+            ServiceStatus.SHUTDOWN
+
+    def test_failed_service_still_tears_down(self, state_dirs):
+        serve_state.add_service('svc', {}, {}, 18080)
+        assert serve_state.set_service_status(
+            'svc', ServiceStatus.FAILED, failure_reason='boom')
+        assert serve_state.set_service_status(
+            'svc', ServiceStatus.SHUTTING_DOWN)
+        assert serve_state.set_service_status('svc',
+                                              ServiceStatus.SHUTDOWN)
+
+
+# ------------------------------------------------------------ skylet cancel
+
+class TestOnClusterCancelGuard:
+
+    def test_cancel_cannot_overwrite_terminal(self, state_dirs):
+        job_id = job_lib.add_job('j', 'u', 'true', 1)
+        job_lib.set_status(job_id, JobStatus.RUNNING)
+        job_lib.set_status(job_id, JobStatus.SUCCEEDED)
+        # The driver finished first: cancel must not rewrite history.
+        assert not job_lib.cancel_job(job_id)
+        assert job_lib.get_status(job_id) is JobStatus.SUCCEEDED
+        # And the guarded write itself refuses too.
+        assert not job_lib.set_status(job_id, JobStatus.CANCELLED,
+                                      only_if_nonterminal=True)
