@@ -12,6 +12,7 @@ from skypilot_tpu.analysis import core
 from skypilot_tpu.analysis import jit_hazards
 from skypilot_tpu.analysis import lazy_imports
 from skypilot_tpu.analysis import layers
+from skypilot_tpu.analysis import metric_discipline
 from skypilot_tpu.analysis import silent_except
 from skypilot_tpu.analysis import sqlite_discipline
 from skypilot_tpu.analysis import state_integrity
@@ -28,6 +29,7 @@ ALL: List[Tuple[str, CheckerFn]] = [
     (state_integrity.NAME, state_integrity.run),
     (thread_discipline.NAME, thread_discipline.run),
     (silent_except.NAME, silent_except.run),
+    (metric_discipline.NAME, metric_discipline.run),
 ]
 
 

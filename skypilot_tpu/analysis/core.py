@@ -23,8 +23,9 @@ PACKAGE = 'skypilot_tpu'
 
 # Report schema version — bump when the JSON shape OR the default
 # checker set changes (v2: dataflow checkers — sqlite-discipline,
-# state-machine, thread-discipline, silent-except).
-REPORT_VERSION = 2
+# state-machine, thread-discipline, silent-except; v3:
+# metric-discipline — observe-plane naming + label cardinality).
+REPORT_VERSION = 3
 
 
 @dataclasses.dataclass

@@ -35,7 +35,12 @@ LAYERS = {
     # 2 — generic helpers & lazy cloud-SDK adaptors
     'utils': 2,
     'adaptors': 2,
-    # 3 — leaf infra libs + pure compute kernels + this analyzer
+    # 3 — leaf infra libs + pure compute kernels + this analyzer.
+    # `observe` (metrics/journal/trace) lives here so every control
+    # plane above can import it at module level; it itself imports only
+    # utils. Rank-3 peers (usage) and utils bridge to it with
+    # function-level lazy imports — the sanctioned upward hop.
+    'observe': 3,
     'config': 3,
     'global_state': 3,
     'usage': 3,

@@ -1,0 +1,174 @@
+"""Metric naming + label-cardinality discipline.
+
+The observe plane's contract (docs/OBSERVABILITY.md), enforced
+statically so a violation fails tier-1 instead of OOMing a collector
+months later:
+
+  1. naming — metric names must be literal
+     ``skytpu_<subsystem>_<name>`` snake_case. A non-literal name is
+     worse than a misnamed one: dynamic names are unbounded series
+     creation, the same failure mode as unbounded labels.
+  2. declared labels — the ``labels=`` spec in a declaration must be a
+     static finite collection (tuple/list literals, enum/constant
+     references, comprehensions over them). Anything built from
+     f-strings / ``.format`` / string concatenation is dynamic; a bare
+     string value is a declaration bug (it iterates per-character).
+  3. bounded label values — at use sites (``.inc(...)``, ``.set(...)``,
+     ``.observe(...)``, ``.dec(...)``, ``.labels(...)``) keyword label
+     values must not be f-strings / ``.format`` / string concatenation:
+     an interpolated label (user name, cluster name, request id) makes
+     series cardinality grow with traffic. The runtime registry refuses
+     undeclared values too — this catches the shape before it ships.
+
+Scope: modules that import ``skypilot_tpu.observe`` (module-level or
+lazy), keyed on the declaration idiom ``metrics.counter(...)`` /
+``metrics_lib.gauge(...)`` / ``REGISTRY.histogram(...)``. The
+``observe`` package itself (which manipulates names generically) and
+``analysis`` (fixtures/prose) are exempt.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional
+
+from skypilot_tpu.analysis import core
+
+NAME = 'metric-discipline'
+
+METRIC_FACTORIES = frozenset({'counter', 'gauge', 'histogram'})
+LABELED_METHODS = frozenset({'inc', 'dec', 'set', 'observe', 'labels'})
+# Receiver segments that mark a factory call as a metric declaration.
+_METRIC_BASES = frozenset({'metrics', 'metrics_lib', 'REGISTRY'})
+
+_NAME_RE = re.compile(r'^skytpu_[a-z0-9]+(_[a-z0-9]+)+$')
+
+
+def _imports_observe(tree: ast.Module) -> bool:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            if any(a.name.startswith('skypilot_tpu.observe')
+                   for a in node.names):
+                return True
+        elif isinstance(node, ast.ImportFrom):
+            module = node.module or ''
+            if module.startswith('skypilot_tpu.observe'):
+                return True
+            if module == 'skypilot_tpu' and any(
+                    a.name == 'observe' for a in node.names):
+                return True
+    return False
+
+
+def _dynamic_string(node: ast.AST) -> bool:
+    """Does this expression build a string at runtime (f-string,
+    .format, concatenation/interpolation of a string literal)?"""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.JoinedStr) and any(
+                isinstance(v, ast.FormattedValue) for v in sub.values):
+            return True
+        if isinstance(sub, ast.Call) and \
+                isinstance(sub.func, ast.Attribute) and \
+                sub.func.attr == 'format':
+            return True
+        if isinstance(sub, ast.BinOp) and \
+                isinstance(sub.op, (ast.Add, ast.Mod)) and any(
+                    isinstance(s, ast.Constant) and
+                    isinstance(s.value, str)
+                    for s in (sub.left, sub.right)):
+            return True
+    return False
+
+
+def _is_metric_declaration(call: ast.Call) -> bool:
+    if not (isinstance(call.func, ast.Attribute) and
+            call.func.attr in METRIC_FACTORIES):
+        return False
+    dotted = core.dotted_name(call.func) or ''
+    segments = set(dotted.split('.')[:-1])
+    return bool(segments & _METRIC_BASES)
+
+
+def _metric_name_arg(call: ast.Call) -> Optional[ast.expr]:
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg == 'name':
+            return kw.value
+    return None
+
+
+def _labels_arg(call: ast.Call) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == 'labels':
+            return kw.value
+    return None
+
+
+def run(mod: core.ModuleInfo) -> List[core.Violation]:
+    if mod.unit in ('analysis', 'observe'):
+        return []
+    if not _imports_observe(mod.tree):
+        return []
+    out: List[core.Violation] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _is_metric_declaration(node):
+            name_arg = _metric_name_arg(node)
+            literal = (name_arg.value
+                       if isinstance(name_arg, ast.Constant) and
+                       isinstance(name_arg.value, str) else None)
+            if literal is None:
+                out.append(core.Violation(
+                    check=NAME, path=mod.path, line=node.lineno,
+                    col=node.col_offset, key='dynamic-name',
+                    message=(
+                        'metric name must be a string literal — a '
+                        'computed name is unbounded series creation '
+                        '(one new metric per distinct value)')))
+            elif not _NAME_RE.match(literal):
+                out.append(core.Violation(
+                    check=NAME, path=mod.path, line=node.lineno,
+                    col=node.col_offset, key=literal,
+                    message=(
+                        f'metric name {literal!r} must be '
+                        f'skytpu_<subsystem>_<name> snake_case '
+                        f'(docs/OBSERVABILITY.md naming contract)')))
+            labels = _labels_arg(node)
+            if labels is not None:
+                bad = _dynamic_string(labels)
+                if not bad and isinstance(labels, ast.Dict):
+                    # A bare string as the declared value set iterates
+                    # per-character — a declaration bug, not a bound.
+                    bad = any(isinstance(v, ast.Constant) and
+                              isinstance(v.value, str)
+                              for v in labels.values)
+                if bad:
+                    key = f'{literal or "<dynamic>"}:labels'
+                    out.append(core.Violation(
+                        check=NAME, path=mod.path, line=labels.lineno,
+                        col=labels.col_offset, key=key,
+                        message=(
+                            'declared label values must be a static '
+                            'finite collection (tuple/list literal, '
+                            'enum/constant reference) — f-string/'
+                            '.format/concatenated or bare-string '
+                            'declarations are unbounded or malformed')))
+            continue
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in LABELED_METHODS:
+            for kw in node.keywords:
+                if kw.arg is None or not _dynamic_string(kw.value):
+                    continue
+                out.append(core.Violation(
+                    check=NAME, path=mod.path, line=kw.value.lineno,
+                    col=kw.value.col_offset,
+                    key=f'{node.func.attr}:{kw.arg}',
+                    message=(
+                        f'label {kw.arg!r} passed to '
+                        f'.{node.func.attr}() is built with f-string/'
+                        f'.format/concatenation — label values must '
+                        f'come from the declared finite set, or '
+                        f'cardinality grows with traffic')))
+    return out

@@ -40,6 +40,7 @@ STATE_DB_PATHS = frozenset({
     'server/requests_lib.py',
     'skylet/job_lib.py',
     'global_state.py',
+    'observe/journal.py',
 })
 
 _VERB_RE = re.compile(

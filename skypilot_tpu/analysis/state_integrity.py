@@ -14,9 +14,9 @@ status column *around* those setters:
      fails lint (and tier-1) instead of silently becoming a state the
      guards refuse or — worse — never check.
   2. bypass-kwarg — a ``status=`` keyword passed to one of the raw
-     column updaters (``_update`` / ``_update_live`` /
-     ``update_service`` / ``upsert_replica``) outside a guarded setter
-     writes the column with no transition check.
+     column updaters (``_update`` / ``update_service`` /
+     ``upsert_replica``) outside a guarded setter writes the column
+     with no transition check.
   3. bypass-sql — a literal ``UPDATE <table> SET ... status = ...``
      outside a guarded setter, anywhere in the package.
 
@@ -36,7 +36,7 @@ from skypilot_tpu.analysis import state_machines
 NAME = 'state-machine'
 
 RAW_STATUS_WRITERS = frozenset({
-    '_update', '_update_live', 'update_service', 'upsert_replica',
+    '_update', 'update_service', 'upsert_replica',
 })
 
 _RAW_SQL_STATUS_RE = re.compile(

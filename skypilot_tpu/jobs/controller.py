@@ -54,6 +54,12 @@ class JobsController:
             raise exceptions.ManagedJobStatusError(
                 f'Managed job {job_id} not found.')
         self.record = record
+        # Whole-process trace adoption (this process exists for exactly
+        # one job): journal/timeline writes and every child —
+        # provisioning runners, the slice driver — carry the trace
+        # minted when the launch request entered the API server.
+        from skypilot_tpu.observe import trace
+        trace.adopt(record.get('trace_id'))
         cfg = record['task_config']
         if 'pipeline' in cfg:
             # Chained multi-task job (reference: pipeline managed jobs):

@@ -44,6 +44,14 @@ def _spawn_controller(job_id: int) -> int:
     pp = env.get('PYTHONPATH', '')
     if repo_root not in pp.split(os.pathsep):
         env['PYTHONPATH'] = f'{repo_root}{os.pathsep}{pp}' if pp else repo_root
+    # The controller carries the JOB's trace, not whatever trace this
+    # scheduler invocation happens to run under (a controller-exit
+    # rescheduling pass services many jobs).
+    record = state.get_job(job_id)
+    if record and record.get('trace_id'):
+        env['SKYTPU_TRACE_ID'] = record['trace_id']
+    else:
+        env.pop('SKYTPU_TRACE_ID', None)
     with open(log_path, 'ab') as log_file:
         proc = subprocess.Popen(
             [sys.executable, '-m', 'skypilot_tpu.jobs.controller',

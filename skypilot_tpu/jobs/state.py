@@ -18,6 +18,9 @@ from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.analysis import state_machines
+from skypilot_tpu.observe import journal as journal_lib
+from skypilot_tpu.observe import metrics as metrics_lib
+from skypilot_tpu.observe import trace as trace_lib
 from skypilot_tpu.utils import sqlite_utils
 
 logger = sky_logging.init_logger(__name__)
@@ -87,6 +90,31 @@ _FAILED = frozenset({
     ManagedJobStatus.FAILED_CONTROLLER,
 })
 
+# Transition telemetry: label values are the declared enum — bounded by
+# construction (the metric-discipline contract).
+_TRANSITIONS_METRIC = metrics_lib.counter(
+    'skytpu_jobs_transitions_total',
+    'Managed-job status transitions committed, by target status.',
+    labels={'to': tuple(s.value for s in ManagedJobStatus)})
+_RECOVERIES_METRIC = metrics_lib.counter(
+    'skytpu_jobs_recoveries_total',
+    'Managed-job recoveries completed (RECOVERING -> RUNNING).')
+
+
+def _journal_transition(job_id: int, old: Optional[ManagedJobStatus],
+                        new: ManagedJobStatus,
+                        reason: Optional[str] = None,
+                        trace_id: Optional[str] = None) -> None:
+    """Publish one WINNING job transition (callers invoke this only
+    after their guarded UPDATE committed, and never for self-loops)."""
+    journal_lib.record_transition(
+        'job', str(job_id), old.value if old else None, new.value,
+        reason=reason, trace_id=trace_id)
+    if old is not None:
+        # Entry into PENDING is row creation, not a transition — the
+        # journal classes it as KIND_ENTRY; the counter must agree.
+        _TRANSITIONS_METRIC.inc(to=new.value)
+
 
 def _db_path() -> str:
     path = os.path.expanduser(
@@ -118,7 +146,8 @@ def _conn() -> sqlite3.Connection:
             cancel_requested INTEGER DEFAULT 0,
             current_task INTEGER DEFAULT 0,
             num_tasks INTEGER DEFAULT 1,
-            pool TEXT
+            pool TEXT,
+            trace_id TEXT
         )""")
     # Older DBs predate the pipeline columns.
     for col, default in (('current_task', 0), ('num_tasks', 1)):
@@ -127,7 +156,8 @@ def _conn() -> sqlite3.Connection:
                          f'DEFAULT {default}')
         except sqlite3.OperationalError:
             pass   # already present
-    for col in ('pool TEXT', 'controller_restarts INTEGER DEFAULT 0'):
+    for col in ('pool TEXT', 'controller_restarts INTEGER DEFAULT 0',
+                'trace_id TEXT'):
         try:
             conn.execute(f'ALTER TABLE jobs ADD COLUMN {col}')
         except sqlite3.OperationalError:
@@ -158,16 +188,23 @@ def submit(name: str, task_config: Dict[str, Any], strategy: str,
     chained multi-task jobs (reference: pipeline managed jobs). `pool`
     routes the job onto a worker of that pool instead of a dedicated
     cluster."""
+    # The trace minted at API-request ingress sticks to the job row, so
+    # a resumed controller (fresh process, no contextvar) still journals
+    # under the original correlation id.
+    trace_id = trace_lib.get()
     with _conn() as conn:
         cur = conn.execute(
             'INSERT INTO jobs (name, task_config, status, strategy, '
-            'submitted_at, max_restarts_on_errors, num_tasks, pool) '
-            'VALUES (?, ?, ?, ?, ?, ?, ?, ?)',
+            'submitted_at, max_restarts_on_errors, num_tasks, pool, '
+            'trace_id) VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?)',
             (name, json.dumps(task_config), ManagedJobStatus.PENDING.value,
              strategy, time.time(), max_restarts_on_errors, num_tasks,
-             pool))
+             pool, trace_id))
         assert cur.lastrowid is not None
-        return cur.lastrowid
+        job_id = cur.lastrowid
+    _journal_transition(job_id, None, ManagedJobStatus.PENDING,
+                        trace_id=trace_id)
+    return job_id
 
 
 def set_current_task(job_id: int, index: int,
@@ -186,26 +223,6 @@ def _update(job_id: int, **cols: Any) -> None:
     with _conn() as conn:
         conn.execute(f'UPDATE jobs SET {sets} WHERE job_id = ?',
                      (*cols.values(), job_id))
-
-
-_TERMINAL_VALUES = tuple(s.value for s in _TERMINAL)
-_NOT_TERMINAL_SQL = ('status NOT IN (%s)' %
-                     ','.join('?' * len(_TERMINAL_VALUES)))
-
-
-def _update_live(job_id: int, **cols: Any) -> bool:
-    """Guarded transition: only applies while the job is non-terminal.
-
-    Returns False when the row was already terminal — e.g. a job cancelled
-    while PENDING must NOT be resurrected by its late-spawning controller.
-    """
-    sets = ', '.join(f'{k} = ?' for k in cols)
-    with _conn() as conn:
-        cur = conn.execute(
-            f'UPDATE jobs SET {sets} WHERE job_id = ? AND '
-            f'{_NOT_TERMINAL_SQL}',
-            (*cols.values(), job_id, *_TERMINAL_VALUES))
-        return cur.rowcount > 0
 
 
 def set_status_nonterminal(job_id: int, status: ManagedJobStatus,
@@ -229,8 +246,9 @@ def set_status_nonterminal(job_id: int, status: ManagedJobStatus,
     assert not status.is_terminal(), status
     conn = _conn()
     with sqlite_utils.immediate(conn):
-        row = conn.execute('SELECT status FROM jobs WHERE job_id = ?',
-                           (job_id,)).fetchone()
+        row = conn.execute(
+            'SELECT status, trace_id FROM jobs WHERE job_id = ?',
+            (job_id,)).fetchone()
         if row is None:
             return False
         cur = ManagedJobStatus(row[0])
@@ -247,6 +265,14 @@ def set_status_nonterminal(job_id: int, status: ManagedJobStatus,
         conn.execute(f'UPDATE jobs SET status = ?{sets} '
                      f'WHERE job_id = ?',
                      (status.value, *cols.values(), job_id))
+        # Journal INSIDE the write lock (the journal is a different DB
+        # file — no deadlock) so journal order matches commit order:
+        # outside it, a preempted winner could journal its edge after
+        # a later writer's, inverting the chain readers see. Only a
+        # real edge is journaled — a self-loop re-write is not a
+        # transition.
+        if cur is not status:
+            _journal_transition(job_id, cur, status, trace_id=row[1])
     return True
 
 
@@ -290,11 +316,14 @@ def set_recovering(job_id: int) -> bool:
 
 
 def set_recovered(job_id: int, cluster_job_id: Optional[int]) -> bool:
-    return set_status_nonterminal(
+    ok = set_status_nonterminal(
         job_id, ManagedJobStatus.RUNNING,
         exprs={'recovery_count': 'COALESCE(recovery_count, 0) + 1'},
         last_recovered_at=time.time(),
         cluster_job_id=cluster_job_id)
+    if ok:
+        _RECOVERIES_METRIC.inc()
+    return ok
 
 
 def bump_restart_on_error(job_id: int) -> int:
@@ -309,13 +338,32 @@ def set_terminal(job_id: int, status: ManagedJobStatus,
                  failure_reason: Optional[str] = None) -> bool:
     """First terminal status wins; a later writer cannot overwrite it.
 
-    The single guarded UPDATE (status NOT IN terminal) is atomic under
-    sqlite's write lock, so N concurrent terminal writers commit
-    exactly one transition.
+    The read-check-write runs under BEGIN IMMEDIATE (sqlite's single
+    write lock), so N concurrent terminal writers commit exactly one
+    transition — and that winning writer (alone) journals the edge
+    old -> terminal, so docs/STATE_MACHINES.md is observable at
+    runtime with exactly one event per committed transition.
     """
     assert status.is_terminal(), status
-    return _update_live(job_id, status=status.value, ended_at=time.time(),
-                        failure_reason=failure_reason)
+    conn = _conn()
+    with sqlite_utils.immediate(conn):
+        row = conn.execute(
+            'SELECT status, trace_id FROM jobs WHERE job_id = ?',
+            (job_id,)).fetchone()
+        if row is None:
+            return False
+        cur = ManagedJobStatus(row[0])
+        if cur.is_terminal():
+            return False
+        conn.execute(
+            'UPDATE jobs SET status = ?, ended_at = ?, '
+            'failure_reason = ? WHERE job_id = ?',
+            (status.value, time.time(), failure_reason, job_id))
+        # Inside the lock: journal order == commit order (see
+        # set_status_nonterminal).
+        _journal_transition(job_id, cur, status, reason=failure_reason,
+                            trace_id=row[1])
+    return True
 
 
 def request_cancel(job_id: int) -> None:

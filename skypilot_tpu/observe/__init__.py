@@ -1,0 +1,26 @@
+"""skypilot_tpu.observe — the unified observability plane.
+
+Three pieces, stdlib-only (plus ``utils``), importable from every
+layer of the control plane:
+
+  * :mod:`~skypilot_tpu.observe.metrics` — a thread-safe registry of
+    Counter/Gauge/Histogram with declared, bounded label sets,
+    rendered in Prometheus text exposition format (``/metrics`` on the
+    API server and the serve load balancer);
+  * :mod:`~skypilot_tpu.observe.journal` — a durable sqlite event
+    journal every guarded status setter publishes transitions into,
+    making docs/STATE_MACHINES.md observable at runtime (``/v1/events``
+    + ``python -m skypilot_tpu.observe tail``);
+  * :mod:`~skypilot_tpu.observe.trace` — contextvar/env-carried trace
+    IDs minted per API request and threaded through controllers,
+    recovery, backends and the slice driver's gang env, stamped onto
+    journal events, timeline spans and usage events.
+
+See docs/OBSERVABILITY.md for the metric catalog, journal schema and
+the trace propagation diagram.
+"""
+from skypilot_tpu.observe import journal
+from skypilot_tpu.observe import metrics
+from skypilot_tpu.observe import trace
+
+__all__ = ['journal', 'metrics', 'trace']

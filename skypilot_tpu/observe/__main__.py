@@ -1,0 +1,134 @@
+"""Observability CLI: ``python -m skypilot_tpu.observe <cmd>``.
+
+Commands:
+  tail     — last N journal events, human-readable or --json
+  events   — filtered journal query (--machine/--entity/--trace/
+             --kind/--since/--limit)
+  metrics  — dump Prometheus exposition: --url fetches a live
+             ``/metrics`` endpoint (API server, serve LB); without
+             --url, renders THIS process's registry (useful from
+             tests/REPLs, empty in a fresh CLI process)
+  export   — write matching journal events as JSONL through the
+             shared rotating writer
+
+Exit codes: 0 ok, 2 usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.observe import journal
+from skypilot_tpu.observe import metrics
+
+
+def _fmt_event(e: Dict[str, Any]) -> str:
+    ts = time.strftime('%Y-%m-%d %H:%M:%S', time.localtime(e['ts']))
+    trace_part = f' trace={e["trace_id"]}' if e.get('trace_id') else ''
+    if e['kind'] == journal.KIND_TRANSITION:
+        body = (f'{e["machine"]} {e["entity"]}: '
+                f'{e["old_status"]} -> {e["new_status"]}')
+    elif e['kind'] == journal.KIND_ENTRY:
+        body = f'{e["machine"]} {e["entity"]}: entered {e["new_status"]}'
+    else:
+        body = f'{e["kind"]} {e.get("entity") or ""}'.strip()
+    reason = f' ({e["reason"]})' if e.get('reason') else ''
+    return f'{ts} [{e["event_id"]}] {body}{reason}{trace_part}'
+
+
+def _print_events(events: List[Dict[str, Any]], as_json: bool) -> None:
+    if as_json:
+        print(json.dumps(events, indent=2))
+        return
+    for e in events:
+        print(_fmt_event(e))
+
+
+def _query_args(args: argparse.Namespace) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for key in ('machine', 'entity', 'kind'):
+        val = getattr(args, key, None)
+        if val is not None:
+            out[key] = val
+    if getattr(args, 'trace', None) is not None:
+        out['trace_id'] = args.trace
+    if getattr(args, 'since', None) is not None:
+        out['since'] = args.since
+    if getattr(args, 'limit', None) is not None:
+        out['limit'] = args.limit
+    return out
+
+
+def _fetch_metrics(url: Optional[str]) -> str:
+    if url is None:
+        return metrics.render()
+    from urllib import request as urlrequest
+    target = url if '://' in url else f'http://{url}'
+    if not target.rstrip('/').endswith('/metrics'):
+        target = target.rstrip('/') + '/metrics'
+    with urlrequest.urlopen(target, timeout=10) as resp:
+        return resp.read().decode('utf-8', errors='replace')
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog='python -m skypilot_tpu.observe',
+        description='Tail/query the event journal; dump metrics.')
+    sub = parser.add_subparsers(dest='cmd', required=True)
+
+    p_tail = sub.add_parser('tail', help='last N journal events')
+    p_tail.add_argument('-n', type=int, default=20)
+    p_tail.add_argument('--json', action='store_true')
+
+    p_events = sub.add_parser('events', help='filtered journal query')
+    p_events.add_argument('--machine')
+    p_events.add_argument('--entity')
+    p_events.add_argument('--trace')
+    p_events.add_argument('--kind')
+    p_events.add_argument('--since', type=float,
+                          help='unix timestamp lower bound')
+    p_events.add_argument('--limit', type=int, default=1000)
+    p_events.add_argument('--json', action='store_true')
+
+    p_metrics = sub.add_parser('metrics',
+                               help='Prometheus exposition dump')
+    p_metrics.add_argument('--url', default=None,
+                           help='fetch a live /metrics endpoint '
+                                '(host:port or full URL)')
+
+    p_export = sub.add_parser('export', help='journal -> JSONL')
+    p_export.add_argument('--out', required=True)
+    p_export.add_argument('--machine')
+    p_export.add_argument('--entity')
+    p_export.add_argument('--trace')
+    p_export.add_argument('--kind')
+    p_export.add_argument('--since', type=float)
+    p_export.add_argument('--limit', type=int, default=100000)
+    return parser
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.cmd == 'tail':
+        _print_events(journal.tail(args.n), args.json)
+    elif args.cmd == 'events':
+        _print_events(journal.query(**_query_args(args)), args.json)
+    elif args.cmd == 'metrics':
+        try:
+            sys.stdout.write(_fetch_metrics(args.url))
+        except OSError as e:
+            print(f'observe: could not fetch metrics: {e}',
+                  file=sys.stderr)
+            return 2
+    elif args.cmd == 'export':
+        n = journal.export_jsonl(args.out, **_query_args(args))
+        print(f'observe: wrote {n} event(s) to {args.out}',
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,287 @@
+"""Durable structured event journal (sqlite, WAL).
+
+The runtime half of docs/STATE_MACHINES.md: every guarded status
+setter (``jobs/state.set_status_nonterminal``/``set_terminal``,
+``serve/serve_state.set_replica_status``/``set_service_status``,
+``skylet/job_lib.set_status``) publishes its winning transition here —
+old→new, reason, timestamp, trace id — so the declared state machines
+are *observable* at runtime, not just enforced. Provisioning and
+request milestones land as generic events in the same table.
+
+Write contract:
+
+  * exactly once per WINNING write — callers journal inside their
+    guarded BEGIN IMMEDIATE transaction, right after the UPDATE (the
+    journal is a separate DB file, so no deadlock), which also makes
+    journal order match commit order; never for self-loop re-writes
+    (a re-assertion of the current status is not a transition);
+  * never in the way — journal I/O failures are swallowed
+    (``record_*`` return False); telemetry must not fail the
+    control-plane write it describes;
+  * trace-correlated — ``trace_id`` defaults to the active
+    :mod:`skypilot_tpu.observe.trace` id, so journal rows join against
+    timeline spans, usage events and the API request that caused them.
+
+The DB is one WAL-mode sqlite file (``SKYTPU_OBSERVE_DB``, default
+``~/.skytpu/observe/journal.db``) — INSERT-only, no read-modify-write,
+so plain autocommit inserts are race-free under sqlite's write lock.
+sqlite-3.34-safe: no RETURNING, connections via
+``utils/sqlite_utils.connect_wal``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Mapping, Optional
+
+from skypilot_tpu.utils import jsonl_utils
+from skypilot_tpu.utils import sqlite_utils
+
+from skypilot_tpu.observe import trace
+
+_DB_PATH_ENV = 'SKYTPU_OBSERVE_DB'
+_DISABLE_ENV = 'SKYTPU_DISABLE_JOURNAL'
+
+KIND_TRANSITION = 'transition'
+KIND_ENTRY = 'entry'
+
+
+def db_path() -> str:
+    """Pure path resolution — no filesystem side effects. _conn()
+    creates the directory on its cache-miss branch; keeping this pure
+    means the per-event cache-key comparison costs no syscalls."""
+    return os.path.expanduser(
+        os.environ.get(_DB_PATH_ENV, '~/.skytpu/observe/journal.db'))
+
+
+def _enabled() -> bool:
+    return os.environ.get(_DISABLE_ENV, '0') != '1'
+
+
+# Per-thread connection cache (the global_state._conn pattern): the
+# journal sits on hot paths — every API request and status transition
+# — so paying connect + WAL pragma + DDL per event would multiply
+# sqlite lock traffic. Keyed by path: tests repoint SKYTPU_OBSERVE_DB
+# per case and must not inherit a stale connection.
+_local = threading.local()
+
+
+def _conn() -> sqlite3.Connection:
+    path = db_path()
+    cached = getattr(_local, 'conn', None)
+    if cached is not None and getattr(_local, 'path', None) == path:
+        return cached
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    conn = sqlite_utils.connect_wal(path)
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS events (
+            event_id INTEGER PRIMARY KEY AUTOINCREMENT,
+            ts REAL,
+            kind TEXT,
+            machine TEXT,
+            entity TEXT,
+            old_status TEXT,
+            new_status TEXT,
+            reason TEXT,
+            trace_id TEXT,
+            pid INTEGER,
+            data TEXT
+        )""")
+    conn.execute('CREATE INDEX IF NOT EXISTS idx_events_trace '
+                 'ON events (trace_id)')
+    conn.execute('CREATE INDEX IF NOT EXISTS idx_events_entity '
+                 'ON events (machine, entity)')
+    conn.commit()
+    _local.conn = conn
+    _local.path = path
+    return conn
+
+
+def _insert(kind: str, machine: Optional[str], entity: Optional[str],
+            old: Optional[str], new: Optional[str],
+            reason: Optional[str], trace_id: Optional[str],
+            data: Optional[Dict[str, Any]]) -> bool:
+    if not _enabled():
+        return False
+    if trace_id is None:
+        trace_id = trace.get()
+    try:
+        with _conn() as conn:
+            conn.execute(
+                'INSERT INTO events (ts, kind, machine, entity, '
+                'old_status, new_status, reason, trace_id, pid, data) '
+                'VALUES (?, ?, ?, ?, ?, ?, ?, ?, ?, ?)',
+                (time.time(), kind, machine, entity, old, new, reason,
+                 trace_id, os.getpid(),
+                 json.dumps(data) if data else None))
+        return True
+    except (sqlite3.Error, OSError):
+        # Best-effort by contract: the state write this describes
+        # already committed and must not be failed retroactively.
+        return False
+
+
+def record_transition(machine: str, entity: str, old: Optional[str],
+                      new: str, *, reason: Optional[str] = None,
+                      trace_id: Optional[str] = None,
+                      data: Optional[Dict[str, Any]] = None) -> bool:
+    """One status-machine edge. ``old is None`` marks the entity's
+    ENTRY into its state machine (row creation), not a transition."""
+    kind = KIND_TRANSITION if old is not None else KIND_ENTRY
+    return _insert(kind, machine, entity, old, new, reason, trace_id,
+                   data)
+
+
+def record_event(kind: str, entity: Optional[str] = None, *,
+                 machine: Optional[str] = None,
+                 reason: Optional[str] = None,
+                 trace_id: Optional[str] = None,
+                 data: Optional[Dict[str, Any]] = None) -> bool:
+    """A non-transition milestone (provision attempt, request finish...)."""
+    return _insert(kind, machine, entity, None, None, reason, trace_id,
+                   data)
+
+
+# ---------------------------------------------------------------- reads
+
+_COLUMNS = ('event_id', 'ts', 'kind', 'machine', 'entity', 'old_status',
+            'new_status', 'reason', 'trace_id', 'pid', 'data')
+
+
+def _row_to_dict(row) -> Dict[str, Any]:
+    d = dict(zip(_COLUMNS, row))
+    if d.get('data'):
+        try:
+            d['data'] = json.loads(d['data'])
+        except ValueError:
+            pass
+    return d
+
+
+def filters_from_query(params: Mapping[str, str],
+                       max_limit: int = 10000) -> Dict[str, Any]:
+    """HTTP query params -> ``query()`` kwargs — ONE parser for every
+    events endpoint (API server ``/v1/events``, LB ``/-/lb/events``),
+    so the filter surface cannot silently diverge. Accepts ``machine``
+    / ``entity`` / ``kind`` / ``trace_id`` (alias ``trace``) /
+    ``since`` / ``limit``; raises ValueError on non-numeric
+    since/limit (callers turn that into a 400)."""
+    kwargs: Dict[str, Any] = {}
+    for key in ('machine', 'entity', 'kind'):
+        value = params.get(key)
+        if value:
+            kwargs[key] = value
+    trace_id = params.get('trace_id') or params.get('trace')
+    if trace_id:
+        kwargs['trace_id'] = trace_id
+    if params.get('since'):
+        kwargs['since'] = float(params['since'])
+    kwargs['limit'] = min(int(params.get('limit', '200')), max_limit)
+    return kwargs
+
+
+def query(*, machine: Optional[str] = None, entity: Optional[str] = None,
+          trace_id: Optional[str] = None, kind: Optional[str] = None,
+          since: Optional[float] = None, limit: int = 1000,
+          entity_scope: Optional[str] = None) -> List[Dict[str, Any]]:
+    """Filtered events, oldest first.
+
+    ``entity_scope='svc'`` restricts to entities belonging to that
+    name: ``entity == 'svc'`` (the service row itself) or entities
+    under it (``'svc/<replica_id>'``) — what a per-service endpoint
+    may expose without leaking the rest of the shared journal.
+    """
+    clauses, params = [], []
+    for col, val in (('machine', machine), ('entity', entity),
+                     ('trace_id', trace_id), ('kind', kind)):
+        if val is not None:
+            clauses.append(f'{col} = ?')
+            params.append(val)
+    if entity_scope is not None:
+        # LIKE metachars in the scope ('_' is common in service names)
+        # must not act as wildcards — that would leak OTHER services'
+        # events through the scoped LB endpoint.
+        escaped = (entity_scope.replace('\\', '\\\\')
+                   .replace('%', '\\%').replace('_', '\\_'))
+        clauses.append(
+            "(entity = ? OR entity LIKE ? || '/%' ESCAPE '\\')")
+        params.extend([entity_scope, escaped])
+    if since is not None:
+        clauses.append('ts >= ?')
+        params.append(since)
+    where = (' WHERE ' + ' AND '.join(clauses)) if clauses else ''
+    sql = (f'SELECT {", ".join(_COLUMNS)} FROM events{where} '
+           f'ORDER BY event_id LIMIT ?')
+    params.append(max(1, int(limit)))
+    try:
+        with _conn() as conn:
+            rows = conn.execute(sql, params).fetchall()
+    except (sqlite3.Error, OSError):
+        return []
+    return [_row_to_dict(r) for r in rows]
+
+
+def tail(n: int = 20) -> List[Dict[str, Any]]:
+    """The most recent ``n`` events, oldest first."""
+    try:
+        with _conn() as conn:
+            rows = conn.execute(
+                f'SELECT {", ".join(_COLUMNS)} FROM events '
+                f'ORDER BY event_id DESC LIMIT ?',
+                (max(1, int(n)),)).fetchall()
+    except (sqlite3.Error, OSError):
+        return []
+    return [_row_to_dict(r) for r in reversed(rows)]
+
+
+def gc_events(max_age_seconds: float = 7 * 24 * 3600,
+              max_rows: int = 500_000) -> int:
+    """Retention: drop events older than ``max_age_seconds`` and, if
+    the table still exceeds ``max_rows``, the oldest overflow — the
+    journal is INSERT-only on hot paths (every API request and status
+    transition), so without this it grows until the disk fills. The
+    API server's hourly GC loop calls it alongside gc_requests; it is
+    also safe to run from any process sharing the DB."""
+    try:
+        conn = _conn()
+        with sqlite_utils.immediate(conn):
+            cur = conn.execute('DELETE FROM events WHERE ts < ?',
+                               (time.time() - max_age_seconds,))
+            deleted = cur.rowcount
+            # Row cap by the (max_rows+1)-th NEWEST id — never by
+            # max_id arithmetic: AUTOINCREMENT ids are sparse after
+            # age-based deletes, and `max_id - max_rows` would wipe
+            # live rows far beyond the intended overflow.
+            row = conn.execute(
+                'SELECT event_id FROM events '
+                'ORDER BY event_id DESC LIMIT 1 OFFSET ?',
+                (max_rows,)).fetchone()
+            if row is not None:
+                cur = conn.execute(
+                    'DELETE FROM events WHERE event_id <= ?', (row[0],))
+                deleted += cur.rowcount
+        return max(0, deleted)
+    except (sqlite3.Error, OSError):
+        return 0
+
+
+def export_jsonl(path: str, max_bytes: float = float('inf'),
+                 **filters: Any) -> int:
+    """Dump matching events as JSONL through the shared writer
+    (utils/jsonl_utils — the one usage telemetry appends through).
+    Returns the number of lines written.
+
+    Rotation is OFF by default (``max_bytes=inf``): a one-shot export
+    that rotated mid-dump would silently keep only the newest chunk
+    while reporting the full count. Pass a finite ``max_bytes`` only
+    for an append-forever streaming export.
+    """
+    writer = jsonl_utils.RotatingJsonlWriter(path, max_bytes)
+    written = 0
+    for event in query(**filters):
+        if writer.write(event):
+            written += 1
+    return written

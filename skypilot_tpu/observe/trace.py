@@ -1,0 +1,110 @@
+"""Trace/correlation IDs, carried on a contextvar + SKYTPU_TRACE_ID.
+
+One trace ID is minted per API request at ingress
+(``server/requests_lib.create``) and threaded through everything that
+request causes: the runner subprocess, the managed-job controller, the
+recovery strategy, the backend and finally the slice driver's gang env
+— so a preempted replica's journal entries, timeline spans and usage
+events can all be joined back to the request that launched it.
+
+Two carriers, checked in order:
+
+  * the :mod:`contextvars` variable — same-process propagation (async
+    handlers, ``with trace_context(...)`` scopes). NOTE: plain
+    ``threading.Thread`` targets start with an EMPTY context, so a
+    thread that must carry the trace either re-sets it or relies on
+    the env carrier below.
+  * the ``SKYTPU_TRACE_ID`` environment variable — cross-process
+    propagation. ``adopt()`` writes both, which is what dedicated
+    per-entity processes (request runner, job controller, serve
+    controller, slice driver) call at startup so every child process
+    they spawn inherits the trace for free.
+
+Stdlib-only; safe to import from any layer.
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import os
+import re
+import uuid
+from typing import Dict, Iterator, Optional
+
+ENV_VAR = 'SKYTPU_TRACE_ID'
+
+_HEX_RE = re.compile(r'[0-9a-fA-F]{8,64}')
+
+_TRACE: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    'skytpu_trace_id', default=None)
+
+
+def new_trace_id() -> str:
+    """A fresh 16-hex-char correlation id."""
+    return uuid.uuid4().hex[:16]
+
+
+def is_valid_trace_id(candidate: str) -> bool:
+    """Is this an acceptable EXTERNALLY-supplied trace id?
+
+    One definition for every ingress (API header today, future LB/CLI
+    surfaces): hex with optional uuid-style dashes, 8-64 hex chars
+    total. The value lands in DB rows, journal indexes and
+    child-process environments, so anything else must be rejected in
+    favor of a minted id.
+    """
+    if not candidate or len(candidate) > 64:
+        return False
+    return bool(_HEX_RE.fullmatch(candidate.replace('-', '')))
+
+
+def get() -> Optional[str]:
+    """The active trace id: contextvar first, then the env carrier."""
+    tid = _TRACE.get()
+    if tid:
+        return tid
+    return os.environ.get(ENV_VAR) or None
+
+
+def set_trace(trace_id: Optional[str]) -> 'contextvars.Token':
+    """Bind ``trace_id`` in the current context; returns the reset token."""
+    return _TRACE.set(trace_id)
+
+
+def reset(token: 'contextvars.Token') -> None:
+    _TRACE.reset(token)
+
+
+@contextlib.contextmanager
+def trace_context(trace_id: Optional[str] = None) -> Iterator[str]:
+    """Scope a trace id (minting one when none is given)."""
+    tid = trace_id or new_trace_id()
+    token = _TRACE.set(tid)
+    try:
+        yield tid
+    finally:
+        _TRACE.reset(token)
+
+
+def adopt(trace_id: Optional[str]) -> None:
+    """Make ``trace_id`` this PROCESS's trace: contextvar + env.
+
+    Called at the top of dedicated per-entity processes (request
+    runner, jobs controller, serve controller, slice driver) so that
+    (a) every journal/metric/timeline call in the process carries it
+    and (b) every subprocess inherits it through the environment.
+    """
+    if not trace_id:
+        return
+    _TRACE.set(trace_id)
+    os.environ[ENV_VAR] = trace_id
+
+
+def env_with_trace(env: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """A copy of ``env`` (default: empty) with the active trace stamped
+    in — for subprocess spawns that build their env explicitly."""
+    out = dict(env or {})
+    tid = get()
+    if tid:
+        out[ENV_VAR] = tid
+    return out

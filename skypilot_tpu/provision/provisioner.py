@@ -17,6 +17,8 @@ from typing import Any, Dict, List, Optional
 from skypilot_tpu import exceptions
 from skypilot_tpu import provision
 from skypilot_tpu import sky_logging
+from skypilot_tpu.observe import journal as journal_lib
+from skypilot_tpu.observe import metrics as metrics_lib
 from skypilot_tpu.provision import common
 from skypilot_tpu.utils import command_runner as command_runner_lib
 from skypilot_tpu.utils import subprocess_utils
@@ -30,6 +32,17 @@ logger = sky_logging.init_logger(__name__)
 
 _CONNECTION_WAIT_SECONDS = 300
 _CONNECTION_POLL_SECONDS = 5
+
+# Per-zone attempt outcomes + region-level failovers: the fleet signal
+# the ads-infra paper reads first when preemption recovery stalls —
+# "is anything landing, and how many zones does each launch burn?"
+_ATTEMPT_METRIC = metrics_lib.counter(
+    'skytpu_provision_attempts_total',
+    'Per-zone provision attempts by outcome.',
+    labels={'outcome': ('success', 'zone_failed', 'exhausted')})
+_ATTEMPT_SECONDS = metrics_lib.histogram(
+    'skytpu_provision_attempt_seconds',
+    'Wall-clock of one successful zone provision attempt.')
 
 
 @timeline.event
@@ -63,6 +76,7 @@ def bulk_provision(
             logger.info(f'Provisioning {cluster_name!r} '
                         f'({resources.tpu.name if resources.tpu else "cpu"}) '
                         f'in {zone}...')
+            attempt_start = time.time()
             record = provision.run_instances(cloud_name, region, zone,
                                              cluster_name, config)
             provision.wait_instances(cloud_name, region, cluster_name,
@@ -84,11 +98,17 @@ def bulk_provision(
                         f'its service ports may be unreachable until the '
                         f'firewall is configured (check the Compute API / '
                         f'compute.firewalls.* permissions).')
+            _ATTEMPT_METRIC.inc(outcome='success')
+            _ATTEMPT_SECONDS.observe(time.time() - attempt_start)
+            journal_lib.record_event(
+                'provision', entity=cluster_name,
+                data={'zone': zone, 'failed_zones': len(errors)})
             return record
         except (exceptions.InsufficientCapacityError,
                 exceptions.QuotaExceededError,
                 exceptions.ProvisionError) as e:
             logger.warning(f'  zone {zone}: {type(e).__name__}: {e}')
+            _ATTEMPT_METRIC.inc(outcome='zone_failed')
             errors.append(e)
             # Leave nothing half-created in the failed zone.
             try:
@@ -97,6 +117,10 @@ def bulk_provision(
             except Exception as cleanup_err:  # pylint: disable=broad-except
                 logger.debug(f'  cleanup after failure: {cleanup_err}')
             continue
+    _ATTEMPT_METRIC.inc(outcome='exhausted')
+    journal_lib.record_event(
+        'provision_exhausted', entity=cluster_name,
+        reason=f'{cloud_name}/{region}: {len(errors)} zone(s) failed')
     raise exceptions.ResourcesUnavailableError(
         f'All zones in {cloud_name}/{region} failed for {cluster_name}.',
         failover_history=errors)

@@ -13,6 +13,7 @@ from typing import Deque, List, Optional
 from collections import deque
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.observe import metrics as metrics_lib
 from skypilot_tpu.serve import service_spec as spec_lib
 from skypilot_tpu.utils import vclock
 from skypilot_tpu.utils import registry
@@ -21,6 +22,15 @@ logger = sky_logging.init_logger(__name__)
 
 # Sliding window over which QPS is measured (reference default 60s).
 QPS_WINDOW_SECONDS = 60.0
+
+# Decision gauges. One controller process per service, so no service
+# label is needed (or allowed: service names are unbounded).
+_TARGET_GAUGE = metrics_lib.gauge(
+    'skytpu_serve_autoscaler_target_replicas',
+    'Current autoscaler decision (post-hysteresis replica target).')
+_QPS_GAUGE = metrics_lib.gauge(
+    'skytpu_serve_autoscaler_qps',
+    'Request rate over the sliding QPS window.')
 
 
 class Autoscaler:
@@ -46,6 +56,7 @@ class FixedAutoscaler(Autoscaler):
     """Static replica count (service.replicas: N)."""
 
     def target_replicas(self, now: Optional[float] = None) -> int:
+        _TARGET_GAUGE.set(self.policy.min_replicas)
         return self.policy.min_replicas
 
 
@@ -85,11 +96,16 @@ class RequestRateAutoscaler(Autoscaler):
     def target_replicas(self, now: Optional[float] = None) -> int:
         now = vclock.now() if now is None else now
         raw = self._raw_target(now)
+        # One source of truth with the decision input (_raw_target has
+        # already trimmed the window, so this is a cheap re-read).
+        _QPS_GAUGE.set(self._qps(now))
         if raw == self._current_target:
             self._pending = None
+            _TARGET_GAUGE.set(self._current_target)
             return self._current_target
         if self._pending is None or self._pending[0] != raw:
             self._pending = (raw, now)
+            _TARGET_GAUGE.set(self._current_target)
             return self._current_target
         delay = (self.policy.upscale_delay_seconds
                  if raw > self._current_target else
@@ -99,4 +115,5 @@ class RequestRateAutoscaler(Autoscaler):
                         f'replicas (held {now - self._pending[1]:.0f}s).')
             self._current_target = raw
             self._pending = None
+        _TARGET_GAUGE.set(self._current_target)
         return self._current_target

@@ -38,13 +38,19 @@ class ServiceController:
             raise ValueError(f'Service {service_name!r} not found.')
         self.name = service_name
         self.record = record
+        # One controller process per service: adopt the trace of the
+        # `serve up` request so replica transitions, probe events and
+        # launch subprocesses all correlate back to it.
+        from skypilot_tpu.observe import trace
+        trace.adopt(record.get('trace_id'))
         self._load_from_record(record)
         self.manager = replica_managers.ReplicaManager(
             self.name, self.task, self.spec,
             version=int(record.get('version') or 1),
             update_mode=record.get('update_mode') or 'rolling')
         self.lb = lb_lib.LoadBalancer(self.spec.load_balancing_policy,
-                                      self.autoscaler)
+                                      self.autoscaler,
+                                      service_name=self.name)
         self._stop = threading.Event()
 
     def _load_from_record(self, record) -> None:

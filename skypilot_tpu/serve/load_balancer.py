@@ -6,11 +6,18 @@ process (serve/controller.py) and is told the ready-replica set after every
 reconcile pass; it feeds request timestamps to the autoscaler.
 
 Control endpoints live under /-/lb/ (anything else is proxied verbatim):
-  GET /-/lb/health → {ready_replicas: N}
+  GET /-/lb/health  → {ready_replicas: N}
+  GET /-/lb/metrics → Prometheus exposition (per-policy request
+                      counters + latency histograms, autoscaler gauges,
+                      probe outcome counters — everything this
+                      controller process registered)
+  GET /-/lb/events  → the trace-correlated event journal (this
+                      service's replica transitions included)
 """
 from __future__ import annotations
 
 import asyncio
+import time
 import typing
 from typing import List, Optional
 
@@ -18,6 +25,8 @@ import aiohttp
 from aiohttp import web
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.observe import journal as journal_lib
+from skypilot_tpu.observe import metrics as metrics_lib
 from skypilot_tpu.serve import load_balancing_policies as lb_policies
 from skypilot_tpu.utils import registry
 
@@ -25,6 +34,19 @@ if typing.TYPE_CHECKING:
     from skypilot_tpu.serve import autoscalers
 
 logger = sky_logging.init_logger(__name__)
+
+# Label bounds: policies come from the static registry (populated by
+# the lb_policies import above), outcomes are this closed set.
+_OUTCOMES = ('proxied', 'upstream_error', 'no_replica')
+_LB_REQUESTS = metrics_lib.counter(
+    'skytpu_lb_requests_total',
+    'Load-balanced requests by policy and outcome.',
+    labels={'policy': tuple(registry.LB_POLICY_REGISTRY.keys()),
+            'outcome': _OUTCOMES})
+_LB_LATENCY = metrics_lib.histogram(
+    'skytpu_lb_request_seconds',
+    'End-to-end proxy latency (body read to upstream EOF).',
+    labels={'policy': tuple(registry.LB_POLICY_REGISTRY.keys())})
 
 _HOP_HEADERS = {'connection', 'keep-alive', 'transfer-encoding', 'upgrade',
                 'proxy-authenticate', 'proxy-authorization', 'te',
@@ -74,9 +96,19 @@ def _affinity_key(request: web.Request, body: bytes) -> Optional[str]:
 class LoadBalancer:
 
     def __init__(self, policy_name: str,
-                 autoscaler: Optional['autoscalers.Autoscaler'] = None):
-        self.policy: lb_policies.LoadBalancingPolicy = (
-            registry.LB_POLICY_REGISTRY.type_from_str(policy_name)())
+                 autoscaler: Optional['autoscalers.Autoscaler'] = None,
+                 service_name: Optional[str] = None):
+        policy_cls = registry.LB_POLICY_REGISTRY.type_from_str(policy_name)
+        self.policy: lb_policies.LoadBalancingPolicy = policy_cls()
+        # Canonical registry key (aliases resolved) — the declared,
+        # bounded metric label value.
+        self.policy_name = next(
+            k for k in registry.LB_POLICY_REGISTRY.keys()
+            if registry.LB_POLICY_REGISTRY.type_from_str(k) is policy_cls)
+        # When set, /-/lb/events is scoped to THIS service's entities:
+        # the LB port faces end users and must not leak the rest of
+        # the shared control-plane journal.
+        self.service_name = service_name
         self.autoscaler = autoscaler
         self._session: Optional[aiohttp.ClientSession] = None
 
@@ -90,14 +122,19 @@ class LoadBalancer:
         if not self.policy.has_replicas():
             # Reject BEFORE buffering the body: a scaled-to-zero service
             # must not hold dead multi-MB uploads in RAM.
+            _LB_REQUESTS.inc(policy=self.policy_name,
+                             outcome='no_replica')
             return web.json_response(
                 {'error': 'no ready replicas'}, status=503)
+        t0 = time.monotonic()
         body = await request.read()
         # Key extraction (a JSON parse) only when the policy uses it.
         key = (_affinity_key(request, body)
                if self.policy.wants_affinity_key else None)
         target = self.policy.select(key)
         if target is None:
+            _LB_REQUESTS.inc(policy=self.policy_name,
+                             outcome='no_replica')
             return web.json_response(
                 {'error': 'no ready replicas'}, status=503)
         if self._session is None:
@@ -121,22 +158,57 @@ class LoadBalancer:
                 async for chunk in upstream.content.iter_chunked(16384):
                     await resp.write(chunk)
                 await resp.write_eof()
+                _LB_REQUESTS.inc(policy=self.policy_name,
+                                 outcome='proxied')
                 return resp
         except (aiohttp.ClientError, asyncio.TimeoutError) as e:
+            _LB_REQUESTS.inc(policy=self.policy_name,
+                             outcome='upstream_error')
             return web.json_response(
                 {'error': f'upstream {target} failed: {e}'}, status=502)
         finally:
             self.policy.request_finished(target)
+            _LB_LATENCY.observe(time.monotonic() - t0,
+                                policy=self.policy_name)
 
     async def _health(self, request: web.Request) -> web.Response:
         del request
         ready = len(self.policy._replicas)  # pylint: disable=protected-access
         return web.json_response({'ready_replicas': ready})
 
+    async def _metrics(self, request: web.Request) -> web.Response:
+        """This controller process's whole registry: LB counters and
+        latency histograms, autoscaler gauges, replica-probe outcome
+        counters — one scrape target per service."""
+        del request
+        return web.Response(text=metrics_lib.render(),
+                            content_type='text/plain')
+
+    async def _events(self, request: web.Request) -> web.Response:
+        """Journal query, same filter surface as the API server's
+        /v1/events — one shared parser (journal.filters_from_query) so
+        the two endpoints cannot diverge. Scoped: the LB port faces
+        end users, so with a bound service_name only THIS service's
+        entities (the service row + its ``svc/<id>`` replicas) are
+        visible, not the rest of the shared journal. The scan runs
+        off-loop: this event loop is also carrying live proxied
+        traffic."""
+        try:
+            kwargs = journal_lib.filters_from_query(request.query)
+        except ValueError:
+            return web.json_response(
+                {'error': 'since/limit must be numbers'}, status=400)
+        if self.service_name is not None:
+            kwargs['entity_scope'] = self.service_name
+        result = await asyncio.to_thread(journal_lib.query, **kwargs)
+        return web.json_response({'events': result})
+
     # ------------------------------------------------------------------
     def build_app(self) -> web.Application:
         app = web.Application()
         app.router.add_get('/-/lb/health', self._health)
+        app.router.add_get('/-/lb/metrics', self._metrics)
+        app.router.add_get('/-/lb/events', self._events)
         app.router.add_route('*', '/{tail:.*}', self._proxy)
 
         async def _cleanup(app_):

@@ -26,6 +26,7 @@ from skypilot_tpu import global_state
 from skypilot_tpu import provision
 from skypilot_tpu import sky_logging
 from skypilot_tpu.backends import slice_backend
+from skypilot_tpu.observe import metrics as metrics_lib
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve import service_spec as spec_lib
 from skypilot_tpu.serve import spot_placer as spot_placer_lib
@@ -42,6 +43,17 @@ MAX_CONSECUTIVE_PROBE_FAILURES = 3
 # Consecutive probe-failure replacements (no READY in between) before the
 # service is declared FAILED instead of churning clusters forever.
 MAX_REPLACEMENTS_BEFORE_FAILED = 3
+
+# Per-pass probe outcome classing — the reconcile loop's eyes. A rising
+# `replaced_*` rate with flat `ready` is the preemption-churn /
+# broken-app signature the serve FAILED cap acts on.
+_PROBE_OUTCOMES = ('ready', 'miss', 'slow_boot', 'app_exited',
+                   'replaced_failed', 'replaced_preempted',
+                   'launch_failed')
+_PROBE_METRIC = metrics_lib.counter(
+    'skytpu_serve_probe_total',
+    'Replica probe / liveness classing outcomes per reconcile pass.',
+    labels={'outcome': _PROBE_OUTCOMES})
 
 
 def _replacement_cap(target: int) -> int:
@@ -387,12 +399,14 @@ class ReplicaManager:
                 # cluster-gone probe — a launch failure is not a
                 # preemption: it bumps the permanent-failure streak
                 # and must not penalize the zone in the spot placer).
+                _PROBE_METRIC.inc(outcome='launch_failed')
                 self.terminate_replica(rid, ReplicaStatus.FAILED)
                 self._probe_failure_streak += 1
                 continue
             if self._cluster_gone(rid):
                 logger.info(f'Replica {rid} lost (preemption/teardown) — '
                             f'replacing.')
+                _PROBE_METRIC.inc(outcome='replaced_preempted')
                 if self.spot_placer is not None and \
                         rid in self._replica_locations:
                     self.spot_placer.set_preemptive(
@@ -421,6 +435,7 @@ class ReplicaManager:
                             now - (rep['launched_at'] or 0) <
                             probe.initial_delay_seconds)
                 if probe_url(rep['url'], probe.path, probe.timeout_seconds):
+                    _PROBE_METRIC.inc(outcome='ready')
                     serve_state.reset_replica_failures(self.service_name,
                                                        rid)
                     # Only a CURRENT-version success clears the churn
@@ -453,6 +468,7 @@ class ReplicaManager:
                         logger.info(f'Replica {rid} not ready after '
                                     f'{boot_age:.0f}s but its job is alive '
                                     f'— treating as slow boot.')
+                        _PROBE_METRIC.inc(outcome='slow_boot')
                         alive.append(rep)
                         continue
                     if app_alive is False:
@@ -463,6 +479,7 @@ class ReplicaManager:
                         # fast even though classing queries add latency).
                         logger.info(f'Replica {rid} run job exited before '
                                     f'readiness — replacing.')
+                        _PROBE_METRIC.inc(outcome='app_exited')
                         self.terminate_replica(rid, ReplicaStatus.FAILED)
                         self._probe_failure_streak += 1
                         continue
@@ -471,9 +488,14 @@ class ReplicaManager:
                     if fails >= MAX_CONSECUTIVE_PROBE_FAILURES:
                         logger.info(f'Replica {rid} failed {fails} probes — '
                                     f'replacing.')
+                        # replaced_failed subsumes the miss: exactly one
+                        # outcome per classing, so outcomes sum to
+                        # probes performed.
+                        _PROBE_METRIC.inc(outcome='replaced_failed')
                         self.terminate_replica(rid, ReplicaStatus.FAILED)
                         self._probe_failure_streak += 1
                         continue
+                    _PROBE_METRIC.inc(outcome='miss')
                     if status is ReplicaStatus.READY:
                         serve_state.set_replica_status(
                             self.service_name, rid, ReplicaStatus.NOT_READY)

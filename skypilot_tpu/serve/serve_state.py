@@ -13,6 +13,8 @@ from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.analysis import state_machines
+from skypilot_tpu.observe import journal as journal_lib
+from skypilot_tpu.observe import trace as trace_lib
 from skypilot_tpu.utils import sqlite_utils
 from skypilot_tpu.utils import vclock
 
@@ -76,11 +78,13 @@ def _conn() -> sqlite3.Connection:
             created_at REAL,
             failure_reason TEXT,
             version INTEGER DEFAULT 1,
-            update_mode TEXT DEFAULT 'rolling'
+            update_mode TEXT DEFAULT 'rolling',
+            trace_id TEXT
         )""")
     for col, decl in (('version', 'INTEGER DEFAULT 1'),
                       ('update_mode', "TEXT DEFAULT 'rolling'"),
-                      ('controller_restarts', 'INTEGER DEFAULT 0')):
+                      ('controller_restarts', 'INTEGER DEFAULT 0'),
+                      ('trace_id', 'TEXT')):
         try:
             conn.execute(f'ALTER TABLE services ADD COLUMN {col} {decl}')
         except sqlite3.OperationalError:
@@ -119,16 +123,25 @@ def controller_log_path(service: str) -> str:
 # ---------------------------------------------------------------------------
 def add_service(name: str, task_config: Dict[str, Any],
                 spec: Dict[str, Any], lb_port: int) -> bool:
+    # The up-request's trace sticks to the row: the controller (a
+    # detached process) adopts it at startup so its journal entries
+    # correlate back to the request that created the service.
+    trace_id = trace_lib.get()
     with _conn() as conn:
         try:
             conn.execute(
                 'INSERT INTO services (name, task_config, spec, status, '
-                'lb_port, created_at) VALUES (?, ?, ?, ?, ?, ?)',
+                'lb_port, created_at, trace_id) '
+                'VALUES (?, ?, ?, ?, ?, ?, ?)',
                 (name, json.dumps(task_config), json.dumps(spec),
-                 ServiceStatus.CONTROLLER_INIT.value, lb_port, time.time()))
-            return True
+                 ServiceStatus.CONTROLLER_INIT.value, lb_port,
+                 time.time(), trace_id))
         except sqlite3.IntegrityError:
             return False
+    journal_lib.record_transition(
+        'service', name, None, ServiceStatus.CONTROLLER_INIT.value,
+        trace_id=trace_id)
+    return True
 
 
 def update_service(name: str, **cols: Any) -> None:
@@ -141,11 +154,18 @@ def update_service(name: str, **cols: Any) -> None:
 def _guarded_transition(table: str, enum_cls, transitions,
                         where_sql: str, where_params: tuple,
                         status, set_sql: str = '',
-                        set_params: tuple = ()) -> bool:
+                        set_params: tuple = (),
+                        machine: str = '', entity: str = '',
+                        reason: Optional[str] = None) -> bool:
     """Shared guarded status write: SELECT current status, check the
     declared transition table, UPDATE — all under BEGIN IMMEDIATE, so
     a concurrent terminal writer cannot slip between the check and the
-    write. Returns False when refused (row gone or undeclared edge)."""
+    write. Returns False when refused (row gone or undeclared edge).
+
+    The winning write (alone, after commit, and only for a real edge —
+    not a self-loop re-write) is published to the observe journal, so
+    every committed transition of docs/STATE_MACHINES.md appears there
+    exactly once."""
     conn = _conn()
     with sqlite_utils.immediate(conn):
         row = conn.execute(
@@ -165,6 +185,12 @@ def _guarded_transition(table: str, enum_cls, transitions,
             f'UPDATE {table} SET status = ?{set_sql} '
             f'WHERE {where_sql}',
             (status.value, *set_params, *where_params))
+        # Inside the write lock (journal = different DB, no deadlock):
+        # journal order matches commit order even when a preempted
+        # winner races a later writer's journal call.
+        if machine and cur is not status:
+            journal_lib.record_transition(machine, entity, cur.value,
+                                          status.value, reason=reason)
     return True
 
 
@@ -177,7 +203,8 @@ def set_service_status(name: str, status: ServiceStatus,
     return _guarded_transition(
         'services', ServiceStatus, state_machines.SERVICE_TRANSITIONS,
         'name = ?', (name,), status,
-        set_sql=', failure_reason = ?', set_params=(failure_reason,))
+        set_sql=', failure_reason = ?', set_params=(failure_reason,),
+        machine='service', entity=name, reason=failure_reason)
 
 
 def get_service(name: str) -> Optional[Dict[str, Any]]:
@@ -226,7 +253,12 @@ def add_replica(service: str, replica_id: int, cluster_name: str,
             (service, replica_id, cluster_name,
              ReplicaStatus.PROVISIONING.value, url, vclock.now(),
              version))
-        return cur.rowcount > 0
+        created = cur.rowcount > 0
+    if created:
+        journal_lib.record_transition(
+            'replica', f'{service}/{replica_id}', None,
+            ReplicaStatus.PROVISIONING.value)
+    return created
 
 
 def upsert_replica(service: str, replica_id: int, **cols: Any) -> None:
@@ -256,7 +288,8 @@ def set_replica_status(service: str, replica_id: int,
     undeclared edge)."""
     return _guarded_transition(
         'replicas', ReplicaStatus, state_machines.REPLICA_TRANSITIONS,
-        'service = ? AND replica_id = ?', (service, replica_id), status)
+        'service = ? AND replica_id = ?', (service, replica_id), status,
+        machine='replica', entity=f'{service}/{replica_id}')
 
 
 def bump_replica_failures(service: str, replica_id: int) -> int:

@@ -49,12 +49,19 @@ class _InlineJob:
     def _run(rec: Dict) -> None:
         import traceback
         from skypilot_tpu import config as config_lib
+        from skypilot_tpu.observe import trace
         from skypilot_tpu.server import registry
         # pid 0, NOT os.getpid(): the recorded pid is cancel_request's
         # kill target, and in thread mode that would be the API server
         # itself. 0 marks "no killable process" (cancel then refuses).
         requests_lib.set_running(rec['request_id'], 0)
         handler, _ = registry.HANDLERS[rec['name']]
+        # Contextvar only (NOT trace.adopt): the env is shared with
+        # every sibling request thread in this process, so writing it
+        # would cross-contaminate their traces. Threads start with a
+        # fresh context, so the set below scopes to this request.
+        if rec.get('trace_id'):
+            trace.set_trace(rec['trace_id'])
         try:
             payload = rec['payload']
             with config_lib.override(payload.get('_config_overrides') or {}):

@@ -22,6 +22,12 @@ def main() -> None:
         print(f'unknown request {args.request_id}', file=sys.stderr)
         sys.exit(2)
 
+    # Adopt the trace minted at ingress: contextvar for this process's
+    # journal/timeline/usage calls, env for every subprocess the handler
+    # spawns (jobs controller, serve controller, backend runners).
+    from skypilot_tpu.observe import trace
+    trace.adopt(rec.get('trace_id'))
+
     log = open(requests_lib.log_path(rec['request_id']), 'a', buffering=1,
                encoding='utf-8')
     os.dup2(log.fileno(), sys.stdout.fileno())

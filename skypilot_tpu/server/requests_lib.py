@@ -14,10 +14,22 @@ import time
 import uuid
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu.observe import journal as journal_lib
+from skypilot_tpu.observe import metrics as metrics_lib
+from skypilot_tpu.observe import trace as trace_lib
 from skypilot_tpu.utils import sqlite_utils
 
 SHORT = 'SHORT'
 LONG = 'LONG'
+
+# How long a request sat NEW in the queue before a dispatcher claimed
+# it — the first thing to look at when "the server feels slow": a tall
+# tail here means the LONG pool is saturated, not that handlers got
+# slower. Label values are the two schedule types — bounded.
+_QUEUE_WAIT = metrics_lib.histogram(
+    'skytpu_server_queue_wait_seconds',
+    'Wait between request creation and dispatcher claim.',
+    labels={'schedule_type': (LONG, SHORT)})
 
 
 class RequestStatus(str, enum.Enum):
@@ -58,7 +70,12 @@ def _conn() -> sqlite3.Connection:
         user TEXT,
         created_at REAL,
         started_at REAL,
-        finished_at REAL)""")
+        finished_at REAL,
+        trace_id TEXT)""")
+    try:
+        conn.execute('ALTER TABLE requests ADD COLUMN trace_id TEXT')
+    except sqlite3.OperationalError:
+        pass   # pre-observability DB already migrated
     return conn
 
 
@@ -67,14 +84,23 @@ def log_path(request_id: str) -> str:
 
 
 def create(name: str, payload: Dict[str, Any], schedule_type: str = LONG,
-           user: str = '') -> str:
+           user: str = '', trace_id: Optional[str] = None) -> str:
+    """Persist a request row. This is trace INGRESS: every request gets
+    a correlation id here (caller-provided, ambient, or freshly minted)
+    that then follows the work through the runner subprocess, the
+    managed-job controller, recovery, and down to the slice driver's
+    gang env — the join key across journal, timeline and usage."""
     request_id = uuid.uuid4().hex[:16]
+    trace_id = trace_id or trace_lib.get() or trace_lib.new_trace_id()
     with _conn() as conn:
         conn.execute(
             'INSERT INTO requests (request_id, name, payload, status, '
-            'schedule_type, user, created_at) VALUES (?,?,?,?,?,?,?)',
+            'schedule_type, user, created_at, trace_id) '
+            'VALUES (?,?,?,?,?,?,?,?)',
             (request_id, name, json.dumps(payload), RequestStatus.NEW.value,
-             schedule_type, user, time.time()))
+             schedule_type, user, time.time(), trace_id))
+    journal_lib.record_event('api_request', entity=request_id,
+                             trace_id=trace_id, data={'name': name})
     return request_id
 
 
@@ -82,14 +108,15 @@ def get(request_id: str) -> Optional[Dict[str, Any]]:
     with _conn() as conn:
         row = conn.execute(
             'SELECT request_id, name, payload, status, schedule_type, '
-            'result, error, pid, user, created_at, started_at, finished_at '
+            'result, error, pid, user, created_at, started_at, '
+            'finished_at, trace_id '
             'FROM requests WHERE request_id LIKE ?',
             (request_id + '%',)).fetchone()
     if row is None:
         return None
     keys = ['request_id', 'name', 'payload', 'status', 'schedule_type',
             'result', 'error', 'pid', 'user', 'created_at', 'started_at',
-            'finished_at']
+            'finished_at', 'trace_id']
     rec = dict(zip(keys, row))
     rec['payload'] = json.loads(rec['payload']) if rec['payload'] else {}
     rec['result'] = json.loads(rec['result']) if rec['result'] else None
@@ -121,16 +148,20 @@ def next_pending(schedule_type: str) -> Optional[Dict[str, Any]]:
     atomicity as the previous UPDATE...RETURNING form, but portable to
     sqlite < 3.35."""
     conn = _conn()
+    now = time.time()
     with sqlite_utils.immediate(conn):
         row = conn.execute(
-            'SELECT request_id FROM requests WHERE status=? AND '
-            'schedule_type=? AND started_at IS NULL '
+            'SELECT request_id, created_at FROM requests WHERE status=? '
+            'AND schedule_type=? AND started_at IS NULL '
             'ORDER BY created_at LIMIT 1',
             (RequestStatus.NEW.value, schedule_type)).fetchone()
         if row is None:
             return None
         conn.execute('UPDATE requests SET started_at=? '
-                     'WHERE request_id=?', (time.time(), row[0]))
+                     'WHERE request_id=?', (now, row[0]))
+    if row[1] is not None:
+        _QUEUE_WAIT.observe(max(0.0, now - row[1]),
+                            schedule_type=schedule_type)
     return get(row[0])
 
 
@@ -141,6 +172,13 @@ def set_running(request_id: str, pid: int) -> None:
             (RequestStatus.RUNNING.value, pid, request_id))
 
 
+def _journal_finished(request_id: str, status: RequestStatus,
+                      reason: Optional[str] = None) -> None:
+    journal_lib.record_event('api_request_finished', entity=request_id,
+                             reason=reason,
+                             data={'status': status.value})
+
+
 def set_result(request_id: str, result: Any) -> None:
     with _conn() as conn:
         conn.execute(
@@ -148,6 +186,7 @@ def set_result(request_id: str, result: Any) -> None:
             'WHERE request_id=?',
             (RequestStatus.SUCCEEDED.value, json.dumps(result), time.time(),
              request_id))
+    _journal_finished(request_id, RequestStatus.SUCCEEDED)
 
 
 def set_failed(request_id: str, error: str) -> None:
@@ -156,6 +195,12 @@ def set_failed(request_id: str, error: str) -> None:
             'UPDATE requests SET status=?, error=?, finished_at=? '
             'WHERE request_id=?',
             (RequestStatus.FAILED.value, error, time.time(), request_id))
+    # The full traceback stays on the row; the journal gets its last
+    # line — the exception itself — enough to class the failure when
+    # scanning a trace.
+    last_line = ((error or '').strip().splitlines() or [''])[-1][:200]
+    _journal_finished(request_id, RequestStatus.FAILED,
+                      reason=last_line or None)
 
 
 def set_cancelled(request_id: str) -> None:
@@ -163,6 +208,7 @@ def set_cancelled(request_id: str) -> None:
         conn.execute(
             'UPDATE requests SET status=?, finished_at=? WHERE request_id=?',
             (RequestStatus.CANCELLED.value, time.time(), request_id))
+    _journal_finished(request_id, RequestStatus.CANCELLED)
 
 
 def gc_requests(max_age_seconds: float = 24 * 3600) -> int:

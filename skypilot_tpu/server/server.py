@@ -143,9 +143,22 @@ async def submit(request: web.Request) -> web.Response:
     _, sched_type = registry.HANDLERS[name]
     user = request.get('user')
     user_name = user.name if user else request.headers.get('X-User', '')
-    request_id = requests_lib.create(name, payload, sched_type,
-                                     user=user_name)
-    return _json({'request_id': request_id})
+    # Trace ingress: the id minted (or honored, via X-Skytpu-Trace-Id)
+    # here follows the request through runner → controller → recovery →
+    # slice driver, and is the join key for /v1/events. Validation
+    # lives with the trace semantics (observe/trace.py): garbage falls
+    # back to a minted id rather than propagating into DB rows and
+    # child-process environments.
+    from skypilot_tpu.observe import trace as trace_lib
+    offered = request.headers.get('X-Skytpu-Trace-Id', '')
+    trace_id = (offered if trace_lib.is_valid_trace_id(offered)
+                else trace_lib.new_trace_id())
+    # Off-loop: create() writes the requests DB and the shared journal
+    # — both sqlite files other processes contend on.
+    request_id = await asyncio.to_thread(
+        requests_lib.create, name, payload, sched_type,
+        user=user_name, trace_id=trace_id)
+    return _json({'request_id': request_id, 'trace_id': trace_id})
 
 
 async def get_request(request: web.Request) -> web.Response:
@@ -214,11 +227,17 @@ async def list_requests(request: web.Request) -> web.Response:
 
 async def metrics(request: web.Request) -> web.Response:
     """Prometheus text exposition (reference: sky/metrics/utils.py:47-146).
-    Hand-formatted — the format is trivial and it keeps the server
-    dependency-free."""
+
+    Two sources concatenated: DB-derived aggregates (request counts and
+    durations survive process restarts because the requests table does)
+    and the in-process observe registry (queue-wait histograms and
+    whatever else this process instrumented). Served at both
+    ``/metrics`` (scraper convention) and ``/api/v1/metrics``."""
     del request
     import time as time_lib
-    snap = requests_lib.metrics_snapshot()
+    # Off-loop: the snapshot is sqlite aggregation over the requests
+    # table and must not stall in-flight handlers on a busy DB.
+    snap = await asyncio.to_thread(requests_lib.metrics_snapshot)
     lines = [
         '# HELP skytpu_uptime_seconds API server uptime.',
         '# TYPE skytpu_uptime_seconds gauge',
@@ -240,8 +259,26 @@ async def metrics(request: web.Request) -> web.Response:
         lines.append(
             f'skytpu_request_duration_seconds_count{{name="{name}"}} '
             f'{count}')
-    return web.Response(text='\n'.join(lines) + '\n',
-                        content_type='text/plain')
+    from skypilot_tpu.observe import metrics as metrics_lib
+    registry_text = metrics_lib.render()
+    body = '\n'.join(lines) + '\n' + registry_text
+    return web.Response(text=body, content_type='text/plain')
+
+
+async def events(request: web.Request) -> web.Response:
+    """Trace-correlated event journal query (``/v1/events``): status
+    transitions published by the guarded setters plus request and
+    provisioning milestones, filterable by machine/entity/trace_id/
+    kind/since/limit."""
+    from skypilot_tpu.observe import journal as journal_lib
+    try:
+        kwargs = journal_lib.filters_from_query(request.query)
+    except ValueError:
+        return _json({'error': 'since/limit must be numbers'}, status=400)
+    # Off-loop: the journal scan is sqlite I/O and can be large —
+    # blocking here would stall every other in-flight handler.
+    result = await asyncio.to_thread(journal_lib.query, **kwargs)
+    return _json({'events': result})
 
 
 async def dashboard_page(request: web.Request) -> web.Response:
@@ -506,6 +543,10 @@ async def _gc_loop(app: web.Application) -> None:
             n = requests_lib.gc_requests()
             if n:
                 logger.info(f'request GC: pruned {n} old records')
+            from skypilot_tpu.observe import journal as journal_lib
+            n = await asyncio.to_thread(journal_lib.gc_events)
+            if n:
+                logger.info(f'journal GC: pruned {n} old events')
         except asyncio.CancelledError:
             return
         except Exception as e:  # pylint: disable=broad-except
@@ -520,7 +561,9 @@ async def _gc_loop(app: web.Application) -> None:
 
 async def request_cancel(request: web.Request) -> web.Response:
     payload = await request.json()
-    ok = executor.cancel_request(payload.get('request_id', ''))
+    # Off-loop: the cancel path writes the requests DB and journals.
+    ok = await asyncio.to_thread(executor.cancel_request,
+                                 payload.get('request_id', ''))
     return _json({'cancelled': ok})
 
 
@@ -537,6 +580,9 @@ def build_app() -> web.Application:
     app.router.add_get('/api/v1/stream', stream)
     app.router.add_get('/api/v1/requests', list_requests)
     app.router.add_get('/api/v1/metrics', metrics)
+    app.router.add_get('/metrics', metrics)
+    app.router.add_get('/api/v1/events', events)
+    app.router.add_get('/v1/events', events)
     app.router.add_get('/api/v1/tunnel', tunnel)
     app.router.add_post('/api/v1/request_cancel', request_cancel)
     app.router.add_get('/dashboard', dashboard_page)

@@ -59,7 +59,8 @@ def gang_env(*,
              num_slices: int = 1,
              hosts_per_slice: int = 1,
              coordinator_ip: str = '127.0.0.1',
-             mh_token: Optional[str] = None) -> Dict[str, str]:
+             mh_token: Optional[str] = None,
+             trace_id: Optional[str] = None) -> Dict[str, str]:
     """The full per-host env block for one gang member.
 
     - SKYPILOT_*: GPU-era contract (NUM_GPUS_PER_NODE carries chips/host so
@@ -71,6 +72,11 @@ def gang_env(*,
       old guessable job-id fallback). The caller draws it ONCE per gang
       — every rank must carry the same value — so it is a parameter
       here, not generated per call.
+    - SKYTPU_TRACE_ID (`trace_id`): the correlation id minted when the
+      originating API request entered the server, so on-cluster
+      telemetry (observe journal, timeline, usage) joins against the
+      control-plane's — the last hop of the trace propagation chain
+      (docs/OBSERVABILITY.md).
     """
     worker_id = rank % hosts_per_slice if hosts_per_slice else rank
     env = {
@@ -96,6 +102,8 @@ def gang_env(*,
     }
     if mh_token:
         env['SKYTPU_MH_TOKEN'] = mh_token
+    if trace_id:
+        env['SKYTPU_TRACE_ID'] = trace_id
     if num_slices > 1:
         env.update({
             'MEGASCALE_COORDINATOR_ADDRESS': coordinator_ip,

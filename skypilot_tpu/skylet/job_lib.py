@@ -17,6 +17,7 @@ import sqlite3
 import time
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu.observe import journal as journal_lib
 from skypilot_tpu.skylet import constants
 from skypilot_tpu.utils import sqlite_utils
 from skypilot_tpu.utils.status_lib import JobStatus
@@ -32,6 +33,25 @@ def _db_path() -> str:
     d = runtime_dir()
     os.makedirs(d, exist_ok=True)
     return os.path.join(d, constants.JOBS_DB)
+
+
+def _journal_entity(job_id: int) -> str:
+    """'<cluster>/<job_id>' for the host-global observe journal.
+
+    jobs.db is per-cluster (SKYTPU_RUNTIME_DIR) and job ids restart at
+    1 per cluster, but journal.db is one file per host — on the local
+    fake cloud several clusters share it, so a bare job id would
+    interleave unrelated jobs' histories under one entity. The cluster
+    name comes from the runtime dir's marker file (written by the
+    provisioner; the orphan reaper keys on the same file).
+    """
+    try:
+        with open(os.path.join(runtime_dir(), 'cluster_name'), 'r',
+                  encoding='utf-8') as f:
+            cluster = f.read().strip()
+    except OSError:
+        cluster = ''
+    return f'{cluster}/{job_id}' if cluster else str(job_id)
 
 
 def _conn() -> sqlite3.Connection:
@@ -68,7 +88,9 @@ def add_job(job_name: str, username: str, run_cmd: str,
         os.makedirs(log_dir, exist_ok=True)
         conn.execute('UPDATE jobs SET log_dir = ? WHERE job_id = ?',
                      (log_dir, job_id))
-        return job_id
+    journal_lib.record_transition('cluster_job', _journal_entity(job_id),
+                                  None, JobStatus.INIT.value)
+    return job_id
 
 
 def set_status(job_id: int, status: JobStatus,
@@ -81,6 +103,13 @@ def set_status(job_id: int, status: JobStatus,
     row — the cancel path uses this to avoid clobbering a
     SUCCEEDED/FAILED the driver recorded concurrently. Returns False
     when refused (row gone or already terminal).
+
+    Every committed status change is published to the observe journal
+    (machine ``cluster_job``): unlike the managed-job machine, this
+    one resets on every recovery, so the journal is what stitches the
+    per-incarnation histories together. Both paths read-then-write
+    under BEGIN IMMEDIATE so the journal's old→new pair is exactly the
+    committed edge, never a concurrent writer's.
     """
     sets = ['status = ?']
     vals: List[Any] = [status.value]
@@ -95,18 +124,23 @@ def set_status(job_id: int, status: JobStatus,
         vals.append(pid)
     vals.append(job_id)
     sql = f'UPDATE jobs SET {", ".join(sets)} WHERE job_id = ?'
-    if only_if_nonterminal:
-        conn = _conn()
-        with sqlite_utils.immediate(conn):
-            row = conn.execute(
-                'SELECT status FROM jobs WHERE job_id = ?',
-                (job_id,)).fetchone()
-            if row is None or JobStatus(row[0]).is_terminal():
-                return False
-            conn.execute(sql, vals)
-        return True
-    with _conn() as conn:
+    conn = _conn()
+    with sqlite_utils.immediate(conn):
+        row = conn.execute(
+            'SELECT status FROM jobs WHERE job_id = ?',
+            (job_id,)).fetchone()
+        if row is None:
+            return False
+        old = JobStatus(row[0])
+        if only_if_nonterminal and old.is_terminal():
+            return False
         conn.execute(sql, vals)
+        # Inside the lock: journal order == commit order (the journal
+        # is a separate DB file, so no deadlock with this transaction).
+        if old is not status:
+            journal_lib.record_transition('cluster_job',
+                                          _journal_entity(job_id),
+                                          old.value, status.value)
     return True
 
 

@@ -153,6 +153,13 @@ def run_gang(spec: Dict[str, Any]) -> int:
     # user-supplied SKYTPU_MH_TOKEN in the job's envs wins — restarts
     # orchestrated outside the driver may need a stable token.
     mh_token = user_envs.get('SKYTPU_MH_TOKEN') or secrets.token_hex(16)
+    # The trace rides the spec JSON (the env does not cross the ssh
+    # boundary the driver was started over); adopting it here makes the
+    # driver's own journal writes (job_lib.set_status below) and every
+    # rank carry the control-plane correlation id.
+    trace_id = spec.get('trace_id') or os.environ.get('SKYTPU_TRACE_ID')
+    if trace_id:
+        os.environ['SKYTPU_TRACE_ID'] = trace_id
 
     job_lib.set_status(job_id, JobStatus.RUNNING, pid=os.getpid())
 
@@ -177,6 +184,7 @@ def run_gang(spec: Dict[str, Any]) -> int:
                     hosts_per_slice=hosts_per_slice,
                     coordinator_ip=coordinator_ip,
                     mh_token=mh_token,
+                    trace_id=trace_id,
                 ))
             env.update(host.get('extra_env', {}))
             cmd = _build_rank_command(host, run_cmd, env,

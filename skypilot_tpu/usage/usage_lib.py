@@ -14,17 +14,17 @@ from __future__ import annotations
 
 import contextlib
 import functools
-import json
 import os
 import time
 from typing import Any, Dict, Optional
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import jsonl_utils
 
 logger = sky_logging.init_logger(__name__)
 
-_MAX_LOG_BYTES = 8 * 1024 * 1024
+_MAX_LOG_BYTES = jsonl_utils.DEFAULT_MAX_BYTES
 
 
 def _enabled() -> bool:
@@ -32,17 +32,10 @@ def _enabled() -> bool:
 
 
 def _log_path() -> str:
-    d = os.path.expanduser('~/.skytpu/usage')
-    os.makedirs(d, exist_ok=True)
-    return os.path.join(d, 'events.jsonl')
-
-
-def _rotate(path: str) -> None:
-    try:
-        if os.path.getsize(path) > _MAX_LOG_BYTES:
-            os.replace(path, path + '.1')
-    except OSError:
-        pass
+    # Pure: jsonl_utils.append_jsonl creates the directory itself (and
+    # swallows I/O errors), so no makedirs — and no exception — here.
+    return os.path.join(os.path.expanduser('~/.skytpu/usage'),
+                        'events.jsonl')
 
 
 def resource_shape(task) -> Optional[Dict[str, Any]]:
@@ -72,19 +65,27 @@ def record_event(operation: str, *, duration_s: Optional[float] = None,
         'outcome': outcome,
         'user': common_utils.get_user_hash(),
     }
+    # The trace id is a random correlation token, not an identity —
+    # privacy-compatible, and it lets a usage event be joined against
+    # the observe journal / timeline of the same request. Lazy import:
+    # usage and observe are layer peers, so the bridge is runtime-only.
+    from skypilot_tpu.observe import trace as trace_lib
+    trace_id = trace_lib.get()
+    if trace_id:
+        event['trace_id'] = trace_id
     if duration_s is not None:
         event['duration_s'] = round(duration_s, 3)
     if error_type:
         event['error'] = error_type
     if resources:
         event['resources'] = resources
-    try:
-        path = _log_path()
-        _rotate(path)
-        with open(path, 'a', encoding='utf-8') as f:
-            f.write(json.dumps(event) + '\n')
-    except OSError:
-        pass
+    # Shared rotating writer (utils/jsonl_utils) — the same one the
+    # observe journal's JSONL export appends through. It never raises
+    # (a failed local write returns False), so a read-only HOME can
+    # neither fail the tracked operation nor skip the remote POST
+    # below — constrained environments are exactly where the endpoint
+    # matters.
+    jsonl_utils.append_jsonl(_log_path(), event, _MAX_LOG_BYTES)
     endpoint = os.environ.get('SKYTPU_USAGE_ENDPOINT')
     if endpoint:
         with contextlib.suppress(Exception):

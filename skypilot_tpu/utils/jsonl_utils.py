@@ -1,0 +1,61 @@
+"""Rotating append-only JSONL writer.
+
+One implementation of size-rotated ``*.jsonl`` appending, shared by
+usage telemetry (``usage/usage_lib.py``) and the observability
+journal's JSONL export (``observe/journal.py``) — both previously
+would have carried their own copy of the same rotate-then-append
+logic. Rotation is a single ``os.replace`` to ``<path>.1`` once the
+file passes ``max_bytes``, so readers always see at most two files and
+the append itself stays a single atomic-enough write of one line.
+
+Best-effort by contract: telemetry must never take down the operation
+it observes, so I/O errors are swallowed and reported via the return
+value.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict
+
+DEFAULT_MAX_BYTES = 8 * 1024 * 1024
+
+
+def rotate_if_needed(path: str,
+                     max_bytes: float = DEFAULT_MAX_BYTES) -> None:
+    """Shift ``path`` to ``path + '.1'`` once it outgrows max_bytes."""
+    try:
+        if os.path.getsize(path) > max_bytes:
+            os.replace(path, path + '.1')
+    except OSError:
+        pass
+
+
+def append_jsonl(path: str, obj: Dict[str, Any],
+                 max_bytes: float = DEFAULT_MAX_BYTES) -> bool:
+    """Append one JSON object as a line, rotating first if oversized.
+
+    Returns False (never raises) when the write could not happen.
+    """
+    try:
+        directory = os.path.dirname(path)
+        if directory:
+            os.makedirs(directory, exist_ok=True)
+        rotate_if_needed(path, max_bytes)
+        with open(path, 'a', encoding='utf-8') as f:
+            f.write(json.dumps(obj) + '\n')
+        return True
+    except (OSError, TypeError, ValueError):
+        return False
+
+
+class RotatingJsonlWriter:
+    """Bound a path + size cap once, then ``write(obj)`` repeatedly."""
+
+    def __init__(self, path: str,
+                 max_bytes: float = DEFAULT_MAX_BYTES) -> None:
+        self.path = os.path.expanduser(path)
+        self.max_bytes = max_bytes
+
+    def write(self, obj: Dict[str, Any]) -> bool:
+        return append_jsonl(self.path, obj, self.max_bytes)

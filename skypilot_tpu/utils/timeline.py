@@ -18,15 +18,42 @@ from typing import Any, Callable, Dict, List, Optional
 _EVENTS: List[Dict[str, Any]] = []
 _LOCK = threading.Lock()
 _ENABLED: Optional[bool] = None
+_ATEXIT_REGISTERED = False
 
 
 def _enabled() -> bool:
-    global _ENABLED
+    global _ENABLED, _ATEXIT_REGISTERED
     if _ENABLED is None:
         _ENABLED = bool(os.environ.get('SKYTPU_TIMELINE_FILE_PATH'))
-        if _ENABLED:
+        if _ENABLED and not _ATEXIT_REGISTERED:
+            # Guarded: reset_for_tests() re-arms _ENABLED, and a second
+            # atexit registration would double-write the trace file.
             atexit.register(save_timeline)
+            _ATEXIT_REGISTERED = True
     return _ENABLED
+
+
+def reset_for_tests() -> None:
+    """Drop the cached enable decision and buffered events.
+
+    ``_ENABLED`` is a module-level cache of one env read, so without
+    this hook a test could not toggle SKYTPU_TIMELINE_FILE_PATH — the
+    first probe in the process would stick forever.
+    """
+    global _ENABLED
+    with _LOCK:
+        _EVENTS.clear()
+    _ENABLED = None
+
+
+def _active_trace() -> Optional[str]:
+    # Lazy: utils sits below observe in the layer DAG, so the bridge is
+    # a function-level import (the sanctioned upward runtime hop).
+    try:
+        from skypilot_tpu.observe import trace
+        return trace.get()
+    except ImportError:
+        return None
 
 
 class Event:
@@ -46,6 +73,11 @@ class Event:
         }
         if self._message is not None:
             event['args'] = {'message': self._message}
+        # Stamp the active trace id so a perfetto span can be joined
+        # against the observe journal (`events --trace <id>`).
+        trace_id = _active_trace()
+        if trace_id:
+            event.setdefault('args', {})['trace_id'] = trace_id
         with _LOCK:
             _EVENTS.append(event)
 

@@ -1,0 +1,620 @@
+"""The observability plane: metrics registry, event journal, trace IDs.
+
+Five angles:
+  1. registry semantics — exposition format, bounded-label refusal,
+     idempotent declaration, 16-thread contention (no lost counts);
+  2. journal + trace plumbing — env/contextvar carriers, rotation-
+     shared JSONL writer, timeline trace stamping + reset hook;
+  3. exposition over HTTP — /metrics on the API server and /-/lb/
+     metrics on the serve load balancer parse as valid Prometheus
+     text (HELP/TYPE per family, cumulative histogram buckets);
+  4. end-to-end — a managed job and a serve replica driven through
+     their declared state machines produce exactly one journal event
+     per fired transition, each carrying the trace id minted at
+     request ingress;
+  5. the CLI (tail / events / export / metrics).
+"""
+import asyncio
+import json
+import math
+import os
+import re
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient
+from aiohttp.test_utils import TestServer as AioTestServer
+
+from skypilot_tpu.analysis import state_machines
+from skypilot_tpu.observe import journal
+from skypilot_tpu.observe import metrics
+from skypilot_tpu.observe import trace
+from skypilot_tpu.utils import jsonl_utils
+from skypilot_tpu.utils import timeline
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+@pytest.fixture()
+def observe_env(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKYTPU_OBSERVE_DB', str(tmp_path / 'journal.db'))
+    monkeypatch.setenv('SKYTPU_JOBS_DB', str(tmp_path / 'jobs.db'))
+    monkeypatch.setenv('SKYTPU_SERVE_DB', str(tmp_path / 'serve.db'))
+    monkeypatch.setenv('SKYTPU_SERVER_DIR', str(tmp_path / 'srv'))
+    monkeypatch.setenv('SKYTPU_RUNTIME_DIR', str(tmp_path / 'runtime'))
+    monkeypatch.delenv('SKYTPU_TRACE_ID', raising=False)
+    monkeypatch.delenv('SKYTPU_API_TOKEN', raising=False)
+    metrics.REGISTRY.reset_for_tests()
+    yield tmp_path
+    metrics.REGISTRY.reset_for_tests()
+
+
+# ---------------------------------------------------------------- helpers
+
+_SAMPLE_RE = re.compile(
+    r'^([a-zA-Z_:][a-zA-Z0-9_:]*)(?:\{(.*)\})?\s+(\S+)$')
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_prom(text):
+    """Parse Prometheus text exposition; raises on malformed lines.
+
+    Returns (types, samples): types maps family -> kind; samples maps
+    sample name -> list of (labels dict, float value). Asserts every
+    family with samples has both HELP and TYPE lines.
+    """
+    helps, types, samples = set(), {}, {}
+    for line in text.splitlines():
+        if not line.strip():
+            continue
+        if line.startswith('# HELP '):
+            helps.add(line.split()[2])
+            continue
+        if line.startswith('# TYPE '):
+            parts = line.split()
+            types[parts[2]] = parts[3]
+            continue
+        assert not line.startswith('#'), f'unknown comment: {line!r}'
+        m = _SAMPLE_RE.match(line)
+        assert m, f'unparsable exposition line: {line!r}'
+        name, labels_str, raw = m.groups()
+        labels = dict(_LABEL_RE.findall(labels_str or ''))
+        value = float('inf') if raw == '+Inf' else float(raw)
+        samples.setdefault(name, []).append((labels, value))
+    for name in samples:
+        family = re.sub(r'_(bucket|sum|count)$', '', name)
+        assert family in types or name in types, \
+            f'sample {name} has no TYPE line'
+        assert family in helps or name in helps, \
+            f'sample {name} has no HELP line'
+    return types, samples
+
+
+def check_histogram(samples, family, labels_subset=None):
+    """Bucket discipline: cumulative counts are monotone in ascending
+    le, the +Inf bucket equals _count, and _sum is present."""
+    def match(labels):
+        return all(labels.get(k) == v
+                   for k, v in (labels_subset or {}).items())
+
+    buckets = [(labels, v) for labels, v in samples[f'{family}_bucket']
+               if match(labels)]
+    assert buckets, f'no buckets for {family} {labels_subset}'
+    bounds = sorted(
+        (float('inf') if labels['le'] == '+Inf' else float(labels['le']),
+         v) for labels, v in buckets)
+    counts = [v for _, v in bounds]
+    assert counts == sorted(counts), f'non-cumulative buckets: {bounds}'
+    (count,) = [v for labels, v in samples[f'{family}_count']
+                if match(labels)]
+    assert bounds[-1][0] == math.inf and bounds[-1][1] == count
+    (total,) = [v for labels, v in samples[f'{family}_sum']
+                if match(labels)]
+    return count, total
+
+
+def _run_async(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+# ---------------------------------------------------------------- registry
+
+@pytest.mark.usefixtures('observe_env')
+class TestMetricsRegistry:
+
+    def test_naming_and_label_declaration_validated(self):
+        with pytest.raises(ValueError, match='snake_case'):
+            metrics.counter('lb_requests', 'bad prefix')
+        with pytest.raises(ValueError, match='no values'):
+            metrics.counter('skytpu_x_total', 'x', labels={'a': ()})
+        c = metrics.counter('skytpu_reg_outcomes_total', 'x',
+                            labels={'outcome': ('ok', 'err')})
+        with pytest.raises(ValueError, match='undeclared value'):
+            c.inc(outcome='other')
+        with pytest.raises(ValueError, match='declared'):
+            c.inc(wrong_label='ok')
+
+    def test_declaration_idempotent_but_conflict_refused(self):
+        a = metrics.counter('skytpu_reg_idem_total', 'x',
+                            labels={'k': ('a', 'b')})
+        b = metrics.counter('skytpu_reg_idem_total', 'x',
+                            labels={'k': ('b', 'a')})
+        assert a is b
+        with pytest.raises(ValueError, match='different kind'):
+            metrics.gauge('skytpu_reg_idem_total', 'x')
+        with pytest.raises(ValueError, match='different kind'):
+            metrics.counter('skytpu_reg_idem_total', 'x',
+                            labels={'k': ('a',)})
+        # Histogram bucket conflicts are refused too (not silently
+        # merged into the first declaration's buckets).
+        metrics.histogram('skytpu_reg_idem_seconds', 'x',
+                          buckets=(0.1, 1.0))
+        with pytest.raises(ValueError, match='buckets'):
+            metrics.histogram('skytpu_reg_idem_seconds', 'x',
+                              buckets=(5.0, 50.0))
+        assert metrics.histogram('skytpu_reg_idem_seconds', 'x',
+                                 buckets=(1.0, 0.1)) is not None
+
+    def test_render_and_reset(self):
+        g = metrics.gauge('skytpu_reg_depth', 'Queue "depth"\nnow.')
+        g.set(4)
+        types, samples = parse_prom(metrics.render())
+        assert types['skytpu_reg_depth'] == 'gauge'
+        assert samples['skytpu_reg_depth'] == [({}, 4.0)]
+        metrics.REGISTRY.reset_for_tests()
+        # Samples are gone (HELP/TYPE headers remain), the registration
+        # survives, and the module-level handle still works.
+        assert 'skytpu_reg_depth' not in parse_prom(metrics.render())[1]
+        g.set(2)
+        assert ({}, 2.0) in parse_prom(
+            metrics.render())[1]['skytpu_reg_depth']
+
+    def test_histogram_buckets_sum_correctly(self):
+        h = metrics.histogram('skytpu_reg_lat_seconds', 'x',
+                              labels={'op': ('a', 'b')},
+                              buckets=(0.1, 1.0, 10.0))
+        observations = [0.05, 0.5, 0.5, 5.0, 50.0]
+        for v in observations:
+            h.observe(v, op='a')
+        h.observe(0.2, op='b')
+        types, samples = parse_prom(metrics.render())
+        assert types['skytpu_reg_lat_seconds'] == 'histogram'
+        count, total = check_histogram(samples, 'skytpu_reg_lat_seconds',
+                                       {'op': 'a'})
+        assert count == len(observations)
+        assert total == pytest.approx(sum(observations))
+        by_le = {labels['le']: v for labels, v
+                 in samples['skytpu_reg_lat_seconds_bucket']
+                 if labels['op'] == 'a'}
+        assert (by_le['0.1'], by_le['1'], by_le['10']) == (1, 3, 4)
+
+    def test_sixteen_thread_contention_loses_nothing(self):
+        c = metrics.counter('skytpu_reg_contended_total', 'x',
+                            labels={'lane': tuple('abcd')})
+        h = metrics.histogram('skytpu_reg_contended_seconds', 'x')
+        n_threads, n_incs = 16, 500
+        barrier = threading.Barrier(n_threads)
+
+        def worker(i):
+            lane = 'abcd'[i % 4]
+            barrier.wait()
+            for _ in range(n_incs):
+                c.inc(lane=lane)
+                h.observe(0.001)
+
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        total = sum(c.value(lane=lane) for lane in 'abcd')
+        assert total == n_threads * n_incs
+        _, samples = parse_prom(metrics.render())
+        count, _ = check_histogram(samples, 'skytpu_reg_contended_seconds')
+        assert count == n_threads * n_incs
+
+
+# ---------------------------------------------------------------- plumbing
+
+@pytest.mark.usefixtures('observe_env')
+class TestTraceCarriers:
+
+    def test_contextvar_wins_over_env(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_TRACE_ID', 'from-env')
+        assert trace.get() == 'from-env'
+        with trace.trace_context('from-ctx'):
+            assert trace.get() == 'from-ctx'
+        assert trace.get() == 'from-env'
+
+    def test_adopt_sets_both_carriers(self, monkeypatch):
+        monkeypatch.delenv('SKYTPU_TRACE_ID', raising=False)
+        token = trace.set_trace(None)
+        try:
+            trace.adopt('adopted-id')
+            assert os.environ['SKYTPU_TRACE_ID'] == 'adopted-id'
+            assert trace.get() == 'adopted-id'
+            assert trace.env_with_trace({'A': '1'}) == {
+                'A': '1', 'SKYTPU_TRACE_ID': 'adopted-id'}
+        finally:
+            trace.reset(token)
+            monkeypatch.delenv('SKYTPU_TRACE_ID', raising=False)
+
+    def test_threads_see_env_carrier(self):
+        # threading.Thread targets start with an EMPTY context — the
+        # env carrier (what trace.adopt writes) is what makes launch
+        # threads and reconcile loops trace-correlated.
+        seen = {}
+
+        def child():
+            seen['tid'] = trace.get()
+
+        with trace.trace_context('ctx-only'):
+            t = threading.Thread(target=child)
+            t.start()
+            t.join()
+        assert seen['tid'] is None
+
+    def test_entity_scope_escapes_like_wildcards(self):
+        # '_' is a LIKE metachar AND common in service names: scoping
+        # to 'svc_a' must not match 'svcxa' (cross-service leak).
+        journal.record_event('scope_probe', entity='svc_a')
+        journal.record_event('scope_probe', entity='svc_a/1')
+        journal.record_event('scope_probe', entity='svcxa/1')
+        journal.record_event('scope_probe', entity='svc_ab/1')
+        got = [e['entity'] for e in journal.query(kind='scope_probe',
+                                                  entity_scope='svc_a')]
+        assert got == ['svc_a', 'svc_a/1']
+
+    def test_journal_gc_retention(self):
+        for i in range(10):
+            journal.record_event('gc_probe', entity=str(i))
+        # Age-based: nothing is old enough yet.
+        assert journal.gc_events(max_age_seconds=3600) == 0
+        # Row-cap: keep only the newest 4.
+        assert journal.gc_events(max_age_seconds=3600, max_rows=4) == 6
+        left = journal.query(kind='gc_probe')
+        assert [e['entity'] for e in left] == ['6', '7', '8', '9']
+        # Age-based path: everything is "old" with a zero window.
+        assert journal.gc_events(max_age_seconds=0) == 4
+        assert journal.query(kind='gc_probe') == []
+
+    def test_journal_rotation_shared_writer(self, tmp_path):
+        path = str(tmp_path / 'out.jsonl')
+        with trace.trace_context('rot-1'):
+            for i in range(5):
+                journal.record_event('rot_test', entity=str(i))
+        n = journal.export_jsonl(path, kind='rot_test')
+        assert n == 5
+        lines = [json.loads(line)
+                 for line in open(path, encoding='utf-8')]
+        assert [e['entity'] for e in lines] == list('01234')
+        assert all(e['trace_id'] == 'rot-1' for e in lines)
+        # Same rotation behavior usage_lib gets: cap exceeded → .1 file.
+        big = str(tmp_path / 'small.jsonl')
+        for i in range(4):
+            jsonl_utils.append_jsonl(big, {'i': i, 'pad': 'x' * 30},
+                                     max_bytes=60)
+        assert os.path.exists(big + '.1')
+
+    def test_usage_events_gain_trace_id(self, tmp_path, monkeypatch):
+        from skypilot_tpu.usage import usage_lib
+        monkeypatch.setenv('HOME', str(tmp_path))
+        monkeypatch.delenv('SKYTPU_DISABLE_USAGE', raising=False)
+        monkeypatch.delenv('SKYTPU_USAGE_ENDPOINT', raising=False)
+        with trace.trace_context('usage-tid'):
+            usage_lib.record_event('launch', duration_s=1.5)
+        (event,) = [json.loads(line) for line in open(
+            os.path.join(str(tmp_path), '.skytpu/usage/events.jsonl'),
+            encoding='utf-8')]
+        assert event['trace_id'] == 'usage-tid'
+        assert event['op'] == 'launch'
+
+    def test_timeline_trace_stamp_and_reset_hook(self, tmp_path,
+                                                 monkeypatch):
+        out = str(tmp_path / 'tl.json')
+        timeline.reset_for_tests()
+        monkeypatch.setenv('SKYTPU_TIMELINE_FILE_PATH', out)
+        try:
+            with trace.trace_context('tl-tid'):
+                with timeline.Event('unit-span', message='m'):
+                    pass
+            timeline.save_timeline()
+            events = json.load(open(out, encoding='utf-8'))['traceEvents']
+            assert events and all(
+                e['args']['trace_id'] == 'tl-tid' for e in events)
+            assert events[0]['args']['message'] == 'm'
+            # The reset hook un-sticks the module-level _ENABLED cache.
+            monkeypatch.delenv('SKYTPU_TIMELINE_FILE_PATH')
+            timeline.reset_for_tests()
+            with timeline.Event('ignored'):
+                pass
+            assert not timeline._EVENTS
+        finally:
+            timeline.reset_for_tests()
+
+
+# ---------------------------------------------------------------- endpoints
+
+@pytest.mark.usefixtures('observe_env')
+class TestServerMetricsEndpoint:
+
+    def test_metrics_parse_and_queue_wait_histogram(self):
+        from skypilot_tpu.server import requests_lib
+        from skypilot_tpu.server import server as server_lib
+        rid = requests_lib.create('status', {}, requests_lib.SHORT)
+        claimed = requests_lib.next_pending(requests_lib.SHORT)
+        assert claimed['request_id'] == rid
+
+        async def fn():
+            app = server_lib.build_app()
+            client = TestClient(AioTestServer(app))
+            await client.start_server()
+            try:
+                texts = {}
+                for path in ('/metrics', '/api/v1/metrics'):
+                    r = await client.get(path)
+                    assert r.status == 200
+                    texts[path] = await r.text()
+            finally:
+                await client.close()
+            return texts
+
+        texts = _run_async(fn())
+        for text in texts.values():
+            types, samples = parse_prom(text)
+            assert types['skytpu_requests_total'] == 'counter'
+            assert ({'name': 'status', 'status': 'NEW'}, 1.0) in \
+                samples['skytpu_requests_total']
+            # The claim above observed the queue-wait histogram.
+            assert types['skytpu_server_queue_wait_seconds'] == 'histogram'
+            count, total = check_histogram(
+                samples, 'skytpu_server_queue_wait_seconds',
+                {'schedule_type': 'SHORT'})
+            assert count == 1 and total >= 0
+
+    def test_events_endpoint_filters_by_trace(self):
+        from skypilot_tpu.server import server as server_lib
+        with trace.trace_context('evt-tid'):
+            journal.record_event('unit_probe', entity='e1')
+        journal.record_event('unit_probe', entity='e2',
+                             trace_id='other-tid')
+
+        async def fn():
+            app = server_lib.build_app()
+            client = TestClient(AioTestServer(app))
+            await client.start_server()
+            try:
+                r = await client.get('/v1/events?trace_id=evt-tid')
+                assert r.status == 200
+                body = await r.json()
+                r = await client.get('/api/v1/events?kind=unit_probe')
+                both = await r.json()
+                r = await client.get('/v1/events?limit=nope')
+                assert r.status == 400
+            finally:
+                await client.close()
+            return body, both
+
+        body, both = _run_async(fn())
+        assert [e['entity'] for e in body['events']] == ['e1']
+        assert body['events'][0]['trace_id'] == 'evt-tid'
+        assert {e['entity'] for e in both['events']} == {'e1', 'e2'}
+
+
+@pytest.mark.usefixtures('observe_env')
+class TestLoadBalancerMetricsEndpoint:
+
+    def test_lb_metrics_and_events_parse(self):
+        from skypilot_tpu.serve import load_balancer as lb_lib
+        # The LB port faces end users: with a bound service_name only
+        # this service's entities are visible from /-/lb/events.
+        journal.record_event('lb_marker', entity='lbsvc',
+                             machine='service')
+        journal.record_event('lb_marker', entity='lbsvc/1',
+                             machine='replica')
+        journal.record_event('lb_marker', entity='lbsvc2/9',
+                             machine='replica')
+        journal.record_event('lb_marker', entity='other-job',
+                             machine='job')
+
+        async def fn():
+            upstream = web.Application()
+
+            async def ok(request):
+                return web.json_response({'pong': True})
+
+            upstream.router.add_route('*', '/{tail:.*}', ok)
+            up_server = AioTestServer(upstream)
+            await up_server.start_server()
+
+            lb = lb_lib.LoadBalancer('round_robin',
+                                     service_name='lbsvc')
+            lb.set_ready_replicas(
+                [str(up_server.make_url('')).rstrip('/')])
+            client = TestClient(AioTestServer(lb.build_app()))
+            await client.start_server()
+            try:
+                for _ in range(3):
+                    r = await client.get('/v1/ping')
+                    assert r.status == 200
+                lb.set_ready_replicas([])
+                r = await client.get('/v1/ping')
+                assert r.status == 503
+                r = await client.get('/-/lb/metrics')
+                assert r.status == 200
+                text = await r.text()
+                r = await client.get('/-/lb/events?kind=lb_marker')
+                events_body = await r.json()
+            finally:
+                await client.close()
+                await up_server.close()
+            return text, events_body
+
+        text, events_body = _run_async(fn())
+        types, samples = parse_prom(text)
+        assert types['skytpu_lb_requests_total'] == 'counter'
+        by_outcome = {labels['outcome']: v for labels, v
+                      in samples['skytpu_lb_requests_total']
+                      if labels['policy'] == 'round_robin'}
+        assert by_outcome['proxied'] == 3
+        assert by_outcome['no_replica'] == 1
+        count, total = check_histogram(samples, 'skytpu_lb_request_seconds',
+                                       {'policy': 'round_robin'})
+        assert count == 3 and total > 0
+        # Scoped: 'lbsvc' + 'lbsvc/1' visible; the prefix-collision
+        # service 'lbsvc2' and unrelated jobs are not.
+        assert [e['entity'] for e in events_body['events']] == \
+            ['lbsvc', 'lbsvc/1']
+
+
+# ---------------------------------------------------------------- end to end
+
+@pytest.mark.usefixtures('observe_env')
+class TestEndToEndTransitionJournal:
+    """The acceptance path: a trace minted at request ingress follows a
+    managed job and a serve replica through their declared state
+    machines; every fired transition lands in the journal exactly once
+    carrying that trace."""
+
+    def _ingress_trace(self):
+        """Mint the trace the way the API server does: request
+        creation IS ingress (requests_lib.create)."""
+        from skypilot_tpu.server import requests_lib
+        rid = requests_lib.create('jobs_launch', {})
+        rec = requests_lib.get(rid)
+        assert rec['trace_id']
+        return rec['trace_id']
+
+    def test_job_and_replica_machines_fully_journaled(self):
+        from skypilot_tpu.jobs import state as jobs_state
+        from skypilot_tpu.jobs.state import ManagedJobStatus
+        from skypilot_tpu.serve import serve_state
+        from skypilot_tpu.serve.serve_state import ReplicaStatus
+        tid = self._ingress_trace()
+        with trace.trace_context(tid):
+            job_id = jobs_state.submit('e2e', {'run': 'true'}, 'failover')
+            assert jobs_state.set_starting(job_id, 'c')
+            assert jobs_state.set_started(job_id, 1)
+            assert jobs_state.set_recovering(job_id)
+            assert jobs_state.set_recovered(job_id, 2)
+            assert jobs_state.set_terminal(job_id,
+                                           ManagedJobStatus.SUCCEEDED)
+            # Losers and self-loops must not journal.
+            assert not jobs_state.set_terminal(job_id,
+                                               ManagedJobStatus.FAILED)
+
+            serve_state.add_service('e2esvc', {}, {}, 18080)
+            assert serve_state.add_replica('e2esvc', 1, 'e2esvc-replica-1')
+            fired = [('PROVISIONING', 'STARTING'), ('STARTING', 'READY'),
+                     ('READY', 'NOT_READY'), ('NOT_READY', 'READY'),
+                     ('READY', 'FAILED'), ('FAILED', 'SHUTTING_DOWN')]
+            for _, new in fired:
+                assert serve_state.set_replica_status(
+                    'e2esvc', 1, ReplicaStatus(new))
+            # Refused edge: no journal event either.
+            assert not serve_state.set_replica_status(
+                'e2esvc', 1, ReplicaStatus.READY)
+
+        job_events = journal.query(machine='job', entity=str(job_id))
+        job_pairs = [(e['old_status'], e['new_status'])
+                     for e in job_events if e['kind'] == 'transition']
+        expected_job = [('PENDING', 'STARTING'), ('STARTING', 'RUNNING'),
+                        ('RUNNING', 'RECOVERING'),
+                        ('RECOVERING', 'RUNNING'),
+                        ('RUNNING', 'SUCCEEDED')]
+        assert job_pairs == expected_job          # each exactly once
+        entry = [e for e in job_events if e['kind'] == 'entry']
+        assert [e['new_status'] for e in entry] == ['PENDING']
+        rep_events = journal.query(machine='replica', entity='e2esvc/1')
+        rep_pairs = [(e['old_status'], e['new_status'])
+                     for e in rep_events if e['kind'] == 'transition']
+        assert rep_pairs == fired                 # each exactly once
+        # Every journaled edge is declared, every event carries the
+        # ingress trace.
+        for pair in job_pairs:
+            assert state_machines.can_transition(
+                state_machines.JOB_TRANSITIONS, *pair)
+        for pair in rep_pairs:
+            assert state_machines.can_transition(
+                state_machines.REPLICA_TRANSITIONS, *pair)
+        for e in job_events + rep_events:
+            assert e['trace_id'] == tid, e
+
+    def test_job_row_trace_outlives_contextvar(self):
+        # The stored trace (not the ambient one) is what a resumed
+        # controller journals under.
+        from skypilot_tpu.jobs import state as jobs_state
+        with trace.trace_context('stored-tid'):
+            job_id = jobs_state.submit('late', {'run': 'true'}, 'failover')
+        assert jobs_state.set_starting(job_id, 'c')   # no ambient trace
+        (event,) = [e for e in journal.query(machine='job',
+                                             entity=str(job_id))
+                    if e['kind'] == 'transition']
+        assert event['trace_id'] == 'stored-tid'
+
+
+# ---------------------------------------------------------------- CLI
+
+@pytest.mark.usefixtures('observe_env')
+class TestObserveCli:
+
+    def _cli(self, *args, env_extra=None):
+        env = {**os.environ, 'PYTHONPATH': REPO, **(env_extra or {})}
+        return subprocess.run(
+            [sys.executable, '-m', 'skypilot_tpu.observe', *args],
+            capture_output=True, text=True, cwd=REPO, env=env,
+            timeout=120)
+
+    def test_tail_events_export(self, tmp_path):
+        with trace.trace_context('cli-tid'):
+            journal.record_transition('job', '7', 'PENDING', 'STARTING')
+            journal.record_event('provision', entity='c9')
+        proc = self._cli('tail', '-n', '5')
+        assert proc.returncode == 0, proc.stderr
+        assert 'PENDING -> STARTING' in proc.stdout
+        assert 'trace=cli-tid' in proc.stdout
+        proc = self._cli('events', '--machine', 'job', '--json')
+        events = json.loads(proc.stdout)
+        assert [e['entity'] for e in events] == ['7']
+        out = str(tmp_path / 'dump.jsonl')
+        proc = self._cli('export', '--out', out, '--trace', 'cli-tid')
+        assert proc.returncode == 0, proc.stderr
+        assert 'wrote 2 event(s)' in proc.stderr
+        assert len(open(out, encoding='utf-8').readlines()) == 2
+
+    def test_metrics_dump_url_mode(self):
+        # --url against a live exposition endpoint (a tiny stdlib
+        # server standing in for the API server).
+        import http.server
+        payload = (b'# HELP skytpu_cli_up x\n'
+                   b'# TYPE skytpu_cli_up gauge\nskytpu_cli_up 1\n')
+
+        class H(http.server.BaseHTTPRequestHandler):
+            def do_GET(self):
+                self.send_response(200)
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def log_message(self, *a):
+                pass
+
+        srv = http.server.HTTPServer(('127.0.0.1', 0), H)
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            proc = self._cli('metrics', '--url',
+                             f'127.0.0.1:{srv.server_port}')
+            assert proc.returncode == 0, proc.stderr
+            assert 'skytpu_cli_up 1' in proc.stdout
+        finally:
+            srv.shutdown()

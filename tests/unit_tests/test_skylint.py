@@ -36,7 +36,7 @@ PKG = os.path.join(REPO, 'skypilot_tpu')
 EXPECTED_CHECKS = [
     'layers', 'lazy-imports', 'async-blocking', 'jit-hazards',
     'sqlite-discipline', 'state-machine', 'thread-discipline',
-    'silent-except',
+    'silent-except', 'metric-discipline',
 ]
 
 
@@ -586,6 +586,69 @@ class TestSilentExceptChecker:
         assert _run(tmp_path, checks=['silent-except'])['total'] == 0
 
 
+# ------------------------------------------------------------ metric discipline
+
+class TestMetricDisciplineChecker:
+
+    def test_bad_name_dynamic_name_and_fstring_labels_flagged(
+            self, tmp_path):
+        _write(tmp_path, 'serve/m.py', '''\
+            from skypilot_tpu.observe import metrics
+
+            _BAD = metrics.counter('lb_requests', 'Name misses prefix.')
+            _DYN = metrics.counter(f'skytpu_{x}_total', 'Dynamic name.')
+            _H = metrics.histogram('skytpu_lb_latency_seconds', 'ok',
+                                   labels={'policy': ('round_robin',)})
+            _S = metrics.counter('skytpu_lb_chars_total', 'Bare string.',
+                                 labels={'user': 'admin'})
+
+            def record(policy):
+                _H.observe(0.1, policy=f'policy-{policy}')
+        ''')
+        report = _run(tmp_path, checks=['metric-discipline'])
+        assert sorted(_idents(report)) == [
+            'metric-discipline:serve/m.py:dynamic-name',
+            'metric-discipline:serve/m.py:lb_requests',
+            'metric-discipline:serve/m.py:observe:policy',
+            'metric-discipline:serve/m.py:skytpu_lb_chars_total:labels',
+        ]
+        assert 'cardinality' in report['violations'][-1]['message']
+
+    def test_declared_tuples_enum_refs_and_literals_ok(self, tmp_path):
+        _write(tmp_path, 'jobs/ok.py', '''\
+            import enum
+
+            from skypilot_tpu.observe import metrics as metrics_lib
+
+            class Status(enum.Enum):
+                A = 'A'
+
+            _C = metrics_lib.counter(
+                'skytpu_jobs_transitions_total', 'By target status.',
+                labels={'to': tuple(s.value for s in Status)})
+            _G = metrics_lib.gauge('skytpu_jobs_queue_depth', 'Depth.')
+            _H = metrics_lib.histogram(
+                'skytpu_jobs_wait_seconds', 'Queue wait.',
+                labels={'schedule_type': ('LONG', 'SHORT')})
+
+            def record(status, wait):
+                _C.inc(to=status.value)
+                _G.set(3)
+                _H.observe(wait, schedule_type='LONG')
+        ''')
+        assert _run(tmp_path, checks=['metric-discipline'])['total'] == 0
+
+    def test_modules_not_touching_observe_exempt(self, tmp_path):
+        # The keyed idiom + observe-import gate keeps unrelated .set()/
+        # .format() call sites out of scope.
+        _write(tmp_path, 'server/other.py', '''\
+            def unrelated(resp, token, x):
+                resp.set(name=f'cookie-{token}')
+                return 'metric-{}'.format(x)
+        ''')
+        assert _run(tmp_path, checks=['metric-discipline'])['total'] == 0
+
+
 # ------------------------------------------------------------ allowlist + report
 
 class TestAllowlistAndReport:
@@ -826,8 +889,14 @@ class TestLivePackage:
         assert report['stale_allowlist_entries'] == [], (
             'stale allowlist entries — the violations are fixed, '
             'delete the entries')
-        # Sanity: the scan actually covered the package.
+        # Sanity: the scan actually covered the package — including the
+        # observe plane itself (the gate lints the telemetry code too).
         assert report['files_scanned'] > 100
+        sub = core.run_analysis(
+            analysis.default_root(),
+            paths=['observe/journal.py', 'observe/metrics.py',
+                   'observe/trace.py'])
+        assert sub['files_scanned'] == 3
 
     def test_gate_emits_stable_json_summary(self, tmp_path):
         """CI artifact + schema ratchet: run the real CLI in JSON mode
@@ -847,7 +916,7 @@ class TestLivePackage:
         with open(out_path, encoding='utf-8') as f:
             report = json.load(f)
         # Schema stability (version-bump ratchet).
-        assert report['skylint_version'] == core.REPORT_VERSION == 2
+        assert report['skylint_version'] == core.REPORT_VERSION == 3
         assert set(report) == {
             'skylint_version', 'root', 'files_scanned', 'checks',
             'violations', 'total', 'allowlisted', 'new',

@@ -17,6 +17,7 @@ import pytest
 from skypilot_tpu.analysis import state_machines
 from skypilot_tpu.jobs import state as jobs_state
 from skypilot_tpu.jobs.state import ManagedJobStatus
+from skypilot_tpu.observe import journal
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
 from skypilot_tpu.skylet import job_lib
@@ -28,6 +29,7 @@ def state_dirs(tmp_path, monkeypatch):
     monkeypatch.setenv('SKYTPU_JOBS_DB', str(tmp_path / 'jobs.db'))
     monkeypatch.setenv('SKYTPU_SERVE_DB', str(tmp_path / 'serve.db'))
     monkeypatch.setenv('SKYTPU_RUNTIME_DIR', str(tmp_path / 'runtime'))
+    monkeypatch.setenv('SKYTPU_OBSERVE_DB', str(tmp_path / 'journal.db'))
     return tmp_path
 
 
@@ -111,6 +113,17 @@ class TestManagedJobContention:
         job = jobs_state.get_job(job_id)
         assert job['status'] is winners[0]
         assert job['status'].is_terminal()
+        # Exactly ONE journal event per winning write: the 15 losing
+        # terminal writers must publish nothing (journal-on-winner is
+        # decided inside the guarded transaction, not by a later read).
+        terminal_events = [
+            e for e in journal.query(machine='job', entity=str(job_id),
+                                     kind='transition')
+            if ManagedJobStatus(e['new_status']).is_terminal()
+        ]
+        assert len(terminal_events) == 1, terminal_events
+        assert terminal_events[0]['old_status'] == 'RUNNING'
+        assert terminal_events[0]['new_status'] == winners[0].value
 
     def test_nonterminal_cannot_resurrect_cancelled(self, state_dirs):
         job_id = jobs_state.submit('dead', {'run': 'true'}, 'failover')
