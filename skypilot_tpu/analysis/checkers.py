@@ -9,6 +9,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 from skypilot_tpu.analysis import async_blocking
 from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis import host_sync_loops
 from skypilot_tpu.analysis import jit_hazards
 from skypilot_tpu.analysis import lazy_imports
 from skypilot_tpu.analysis import layers
@@ -25,6 +26,7 @@ ALL: List[Tuple[str, CheckerFn]] = [
     (lazy_imports.NAME, lazy_imports.run),
     (async_blocking.NAME, async_blocking.run),
     (jit_hazards.NAME, jit_hazards.run),
+    (host_sync_loops.NAME, host_sync_loops.run),
     (sqlite_discipline.NAME, sqlite_discipline.run),
     (state_integrity.NAME, state_integrity.run),
     (thread_discipline.NAME, thread_discipline.run),

@@ -24,8 +24,10 @@ PACKAGE = 'skypilot_tpu'
 # Report schema version — bump when the JSON shape OR the default
 # checker set changes (v2: dataflow checkers — sqlite-discipline,
 # state-machine, thread-discipline, silent-except; v3:
-# metric-discipline — observe-plane naming + label cardinality).
-REPORT_VERSION = 3
+# metric-discipline — observe-plane naming + label cardinality; v4:
+# host-sync-loop — no unconditional device_get in serve/models loop
+# bodies, the decode-pipeline anti-pattern).
+REPORT_VERSION = 4
 
 
 @dataclasses.dataclass

@@ -1,0 +1,231 @@
+"""Host-sync-loop lint: no unconditional ``jax.device_get`` inside
+loop bodies on the serving/model hot paths.
+
+A scheduler loop that blocks on a device→host transfer every iteration
+serializes the accelerator behind Python: the device finishes a step,
+then idles while the host fetches tensors and runs bookkeeping, then
+the next call is dispatched — the exact anti-pattern the engine's
+double-buffered decode pipeline removes (dispatch step N+1 before
+collecting step N; see docs/ENGINE.md). This checker pins that fix:
+in modules under ``serve/`` or ``models/``, a ``jax.device_get``
+executed unconditionally in a *data-independent* loop body is flagged.
+
+Scope rules (precision over recall — the flagged shape must be the
+indefensible one):
+
+- **Data-independent loops only.** ``while True:`` (or any constant
+  test), and ``for`` over ``range(...)`` or a literal sequence. A
+  ``while`` whose test reads a name assigned in its own body, or any
+  loop containing ``break``, is *data-dependent*: the host genuinely
+  needs the fetched values to decide whether to continue (speculative
+  verify loops, EOS scans), so the sync is semantic, not accidental.
+- **Unconditional only.** Calls nested under an ``if`` inside the loop
+  body are skipped — a guarded fetch (e.g. only when a client asked
+  for logprobs) is the remediation, not the bug.
+- **One helper hop.** The loop body calling a same-module function or
+  method whose body contains ``jax.device_get`` is flagged too, with
+  the chain in the key — including through ``asyncio.to_thread(f,
+  ...)`` / ``run_in_executor(None, f, ...)``, the idiom event-loop
+  schedulers use for device work (the pre-pipeline batch loop's exact
+  shape).
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from skypilot_tpu.analysis import core
+
+NAME = 'host-sync-loop'
+
+_SCOPED_UNITS = frozenset({'serve', 'models'})
+_EXECUTOR_TAILS = frozenset({'to_thread', 'run_in_executor'})
+
+
+def _is_device_get(node: ast.Call) -> bool:
+    return (core.dotted_name(node.func) or '') == 'jax.device_get'
+
+
+def _module_fns(tree: ast.Module) -> Dict[str, ast.AST]:
+    """Every function/method defined in the module, by bare name
+    (methods resolve via ``self.<name>(...)`` / ``<name>(...)`` call
+    sites; a name collision keeps the first definition — good enough
+    for a one-hop heuristic)."""
+    fns: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fns.setdefault(node.name, node)
+    return fns
+
+
+def _fns_with_device_get(fns: Dict[str, ast.AST]) -> Set[str]:
+    out: Set[str] = set()
+    for name, fn in fns.items():
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) and _is_device_get(node):
+                out.add(name)
+                break
+    return out
+
+
+def _assigned_names(body: List[ast.stmt]) -> Set[str]:
+    """Names (re)bound anywhere in a loop body — subscript/attribute
+    stores count toward their base name (``count[r] = ...`` makes the
+    loop's ``while count.min() < n`` data-dependent)."""
+    names: Set[str] = set()
+
+    def target_names(target: ast.expr) -> None:
+        for sub in ast.walk(target):
+            if isinstance(sub, ast.Name):
+                names.add(sub.id)
+
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    target_names(t)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                target_names(node.target)
+    return names
+
+
+def _has_break(body: List[ast.stmt]) -> bool:
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Break):
+                return True
+    return False
+
+
+def _loop_is_data_independent(loop: ast.stmt) -> bool:
+    """True when nothing the loop fetches can end it: the transfer
+    repeats forever (or a statically-known number of times) regardless
+    of its result."""
+    if _has_break(loop.body):
+        return False
+    if isinstance(loop, ast.While):
+        if isinstance(loop.test, ast.Constant):
+            return bool(loop.test.value)      # `while True:`
+        read = {n.id for n in ast.walk(loop.test)
+                if isinstance(n, ast.Name)}
+        return not (read & _assigned_names(loop.body))
+    if isinstance(loop, (ast.For, ast.AsyncFor)):
+        it = loop.iter
+        if isinstance(it, ast.Call) and \
+                (core.dotted_name(it.func) or '') == 'range':
+            return True
+        return isinstance(it, (ast.Constant, ast.Tuple, ast.List))
+    return False
+
+
+def _unconditional_calls(body: List[ast.stmt]) -> List[ast.Call]:
+    """Call nodes executed on every iteration: statements nested under
+    an ``if`` (or a ``try`` exception handler) are conditional and
+    skipped; nested loops, ``with`` blocks, ``try`` bodies, ``try``
+    ``else`` blocks and ``finally`` blocks (which run on every
+    iteration) are walked."""
+    out: List[ast.Call] = []
+    for stmt in body:
+        if isinstance(stmt, ast.If):
+            continue
+        if isinstance(stmt, ast.Try):
+            # try body, else (runs on normal completion) and finally
+            # (runs ALWAYS) are unconditional per iteration; except
+            # handlers are not.
+            out.extend(_unconditional_calls(stmt.body))
+            out.extend(_unconditional_calls(stmt.orelse))
+            out.extend(_unconditional_calls(stmt.finalbody))
+            continue
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            # Nested loops report through their own loop scan; their
+            # calls still run each outer iteration, so include them.
+            out.extend(_unconditional_calls(stmt.body))
+            continue
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            out.extend(_unconditional_calls(stmt.body))
+            continue
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue                          # defining ≠ executing
+        out.extend(_calls_in(stmt))
+    return out
+
+
+def _calls_in(node: ast.AST) -> List[ast.Call]:
+    """Call nodes in an expression/statement, NOT descending into
+    nested function definitions or lambdas (their bodies do not run
+    where they are written)."""
+    out: List[ast.Call] = []
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                              ast.Lambda)):
+            continue
+        out.extend(_calls_in(child))
+    if isinstance(node, ast.Call):
+        out.append(node)
+    return out
+
+
+def _callee_name(call: ast.Call) -> Optional[str]:
+    """The same-module function a loop-body call invokes: ``f(...)``,
+    ``self.f(...)``, and the executor idioms ``asyncio.to_thread(f,
+    ...)`` / ``loop.run_in_executor(None, f, ...)`` (the function is
+    an ARGUMENT there, but it runs once per iteration all the same)."""
+    func = call.func
+    dotted = core.dotted_name(func) or ''
+    tail = dotted.split('.')[-1] if dotted else ''
+    if tail in _EXECUTOR_TAILS:
+        args = call.args
+        if tail == 'run_in_executor':
+            args = args[1:]                   # skip the executor arg
+        if args:
+            target = args[0]
+            if isinstance(target, ast.Name):
+                return target.id
+            if isinstance(target, ast.Attribute):
+                return target.attr
+        return None
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute) and \
+            isinstance(func.value, ast.Name) and func.value.id == 'self':
+        return func.attr
+    return None
+
+
+def run(mod: core.ModuleInfo) -> List[core.Violation]:
+    if mod.unit not in _SCOPED_UNITS:
+        return []
+    fns = _module_fns(mod.tree)
+    syncing = _fns_with_device_get(fns)
+    out: List[core.Violation] = []
+    seen = set()
+    for loop in ast.walk(mod.tree):
+        if not isinstance(loop, (ast.While, ast.For, ast.AsyncFor)):
+            continue
+        if not _loop_is_data_independent(loop):
+            continue
+        for call in _unconditional_calls(loop.body):
+            key = None
+            if _is_device_get(call):
+                key = 'jax.device_get'
+                why = ('blocks on a device→host transfer every '
+                       'iteration of a data-independent loop')
+            else:
+                callee = _callee_name(call)
+                if callee in syncing:
+                    key = f'{callee}->jax.device_get'
+                    why = (f'calls {callee!r} (which device_gets) every '
+                           f'iteration of a data-independent loop')
+            if key is None or (key, call.lineno) in seen:
+                continue
+            seen.add((key, call.lineno))
+            out.append(core.Violation(
+                check=NAME, path=mod.path, line=call.lineno,
+                col=call.col_offset, key=key,
+                message=(f'{key!r} in a loop body: {why} — split the '
+                         f'step into dispatch/collect halves and '
+                         f'pipeline them (docs/ENGINE.md), or make the '
+                         f'transfer conditional/data-dependent')))
+    return out

@@ -625,6 +625,20 @@ def _select_token(logits: jnp.ndarray, temperature: float,
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
+def top_k_logprobs(logits: jnp.ndarray, k: int
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Top-k alternative logprobs of the UNPENALIZED model distribution
+    (OpenAI ``logprobs=N`` / ``top_logprobs``): [..., V] logits →
+    (values [..., k] fp32, ids [..., k] i32). Family-agnostic (plain
+    logits math), shared by the serving engine's step/admit/verify
+    programs for both the KVCache and MLA latent families — and only
+    COMPILED into the variants whose requests asked for it (the
+    engine's ``want_tops`` static flag)."""
+    lse = jax.scipy.special.logsumexp(logits, axis=-1, keepdims=True)
+    v, i = jax.lax.top_k(logits, k)
+    return (v - lse).astype(jnp.float32), i.astype(jnp.int32)
+
+
 def chosen_logprob(logits: jnp.ndarray, tokens: jnp.ndarray
                    ) -> jnp.ndarray:
     """log P(token) under the UNMODIFIED model distribution

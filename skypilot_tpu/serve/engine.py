@@ -11,14 +11,25 @@ TPU-first design:
   - **Continuous batching**: a fixed pool of MAX_BATCH cache slots is
     stepped token by token (fused into MAX_STEP_CHUNK-step device calls
     while nothing is queued); a request arriving mid-generation is
-    prefilled into a free slot and joins after at most one in-flight
-    fused call — it never waits for earlier requests to drain. Static shapes
+    prefilled into a free slot and joins after at most the in-flight
+    fused call(s) drain (≤ two when the pipeline is looking ahead) —
+    it never waits for earlier requests to drain. Static shapes
     rule on TPU, so the step always runs at batch MAX_BATCH (inactive
     slots are masked) and prompts prefill per power-of-two length bucket
     — a bounded set of compiled programs, cached by jax forever after.
     Sampling params are PER-ROW runtime arrays (decode.select_token_per
     _row), so mixed temperature/top_k/top_p requests share one step and
     client-supplied values can never trigger a recompile.
+  - **Overlapped decode pipeline** (docs/ENGINE.md): the fused step is
+    split into a dispatch half (enqueue the device call; the per-slot
+    previous token `last` is DEVICE-RESIDENT and carried through the
+    jit, so no host value is needed to start step N+1) and a collect
+    half (device→host transfer + Python bookkeeping). While traffic is
+    steady — nothing queued, no cancels pending — the batch loop keeps
+    one fused call in flight: step N+1 is dispatched before step N's
+    results are consumed, so the TPU never waits on Python. Admission,
+    cancellation, speculation and failure resets happen only at
+    drained points (collect always precedes slot/buffer reuse).
   - **Real checkpoints**: --hf-dir points at an HF checkpoint directory
     (safetensors + tokenizer.json) and serves it with the real
     tokenizer, per-family chat template, and EOS stop handling
@@ -48,9 +59,82 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.observe import metrics as metrics_lib
 from skypilot_tpu.utils import timeline
 
 logger = sky_logging.init_logger(__name__)
+
+# Engine observability (docs/OBSERVABILITY.md catalog, rendered by the
+# /metrics endpoint). Histograms capture the decode pipeline's
+# before/after: dispatch time is host work per device call, collect is
+# the bookkeeping half, host_sync is the time the event-loop's worker
+# thread actually BLOCKS on device→host transfers — the quantity the
+# double-buffered pipeline exists to hide.
+_M_STEP_SECONDS = metrics_lib.histogram(
+    'skytpu_engine_step_seconds',
+    'Decode-step latency by pipeline phase (dispatch = host time to '
+    'enqueue the fused device call, collect = transfer + bookkeeping)',
+    labels={'phase': ('dispatch', 'collect')})
+_M_ADMIT_SECONDS = metrics_lib.histogram(
+    'skytpu_engine_admit_seconds',
+    'Grouped-prefill admission latency (one device call per group)')
+_M_HOST_SYNC_SECONDS = metrics_lib.histogram(
+    'skytpu_engine_host_sync_seconds',
+    'Time the decode loop blocks on device→host transfers')
+_M_QUEUE_DEPTH = metrics_lib.gauge(
+    'skytpu_engine_queue_depth', 'Requests waiting in the admission '
+    'queue')
+_M_IN_FLIGHT = metrics_lib.gauge(
+    'skytpu_engine_in_flight', 'Requests occupying decode slots')
+_M_STEPS = metrics_lib.counter(
+    'skytpu_engine_steps_total', 'Decode steps executed (fused steps '
+    'count each token)')
+_M_TOKENS = metrics_lib.counter(
+    'skytpu_engine_tokens_total', 'Tokens generated and delivered to '
+    'requests')
+_M_REQUESTS = metrics_lib.counter(
+    'skytpu_engine_requests_total', 'Requests accepted into the '
+    'admission queue')
+_M_REJECTED = metrics_lib.counter(
+    'skytpu_engine_rejected_total', 'Requests rejected with 429 '
+    '(admission queue full)')
+_M_PREFIX = metrics_lib.counter(
+    'skytpu_engine_prefix_requests_total',
+    'Prefix (system-prompt) cache lookups at admission',
+    labels={'outcome': ('hit', 'miss')})
+_M_PREFIX_HITS = metrics_lib.counter(
+    'skytpu_engine_prefix_hits_total', 'Prefix-cache hits (suffix-only '
+    'prefills)')
+_M_SPEC_ROUNDS = metrics_lib.counter(
+    'skytpu_engine_spec_rounds_total', 'Speculative verify rounds')
+_M_SPEC_PROPOSED = metrics_lib.counter(
+    'skytpu_engine_spec_proposed_total', 'Draft tokens proposed to the '
+    'verifier')
+_M_SPEC_ACCEPTED = metrics_lib.counter(
+    'skytpu_engine_spec_accepted_total', 'Draft tokens accepted by the '
+    'verifier')
+
+_ENGINE_METRICS = (
+    _M_STEP_SECONDS, _M_ADMIT_SECONDS, _M_HOST_SYNC_SECONDS,
+    _M_QUEUE_DEPTH, _M_IN_FLIGHT, _M_STEPS, _M_TOKENS, _M_REQUESTS,
+    _M_REJECTED, _M_PREFIX, _M_PREFIX_HITS, _M_SPEC_ROUNDS,
+    _M_SPEC_PROPOSED, _M_SPEC_ACCEPTED)
+
+
+def _seed_counter_zeros() -> None:
+    """Make every counter series render a zero sample from birth (the
+    pre-registry /metrics always emitted 0s; Prometheus rate()/absent()
+    alerts rely on the series existing before its first event). Called
+    at import and again after warmup's metric reset."""
+    for metric in (_M_STEPS, _M_TOKENS, _M_REQUESTS, _M_REJECTED,
+                   _M_PREFIX_HITS, _M_SPEC_ROUNDS, _M_SPEC_PROPOSED,
+                   _M_SPEC_ACCEPTED):
+        metric.inc(0)
+    _M_PREFIX.inc(0, outcome='hit')
+    _M_PREFIX.inc(0, outcome='miss')
+
+
+_seed_counter_zeros()
 
 MAX_BATCH = int(os.environ.get('SKYTPU_ENGINE_MAX_BATCH', '8'))
 # Max decode steps fused into one device call when no request is waiting.
@@ -66,10 +150,13 @@ PREFIX_CACHE_ENTRIES = int(os.environ.get('SKYTPU_ENGINE_PREFIX_CACHE',
 # save is too small to matter; powers of two only).
 PREFIX_MIN_TOKENS = 64
 # Top-N alternative logprobs computed per token (OpenAI `logprobs=N` /
-# chat `top_logprobs`). Always-on inside the step/admit programs — one
-# lax.top_k over [B, V] per token, negligible next to the HBM-bound
-# weight reads, and it keeps the compiled-variant count flat (a
-# per-request flag would double every step/admit program).
+# chat `top_logprobs`). The STEP/VERIFY programs compute (and transfer)
+# the [.., K] top-k tensors only in their want_tops=True variants —
+# selected iff some active slot requested logprobs — so the common
+# steady-state path transfers just tokens + chosen logprobs. Admit
+# programs keep it always-on: one lax.top_k per REQUEST (not per
+# token) is negligible, and gating it there would double the
+# (#buckets × group sizes) admit-compile matrix for nothing.
 TOP_LOGPROBS_K = 5
 # Speculative decoding: propose this many tokens per verify round via
 # prompt-lookup self-drafting (0 disables). One K-wide verify_step
@@ -89,6 +176,13 @@ SPEC_LOOKUP_WINDOW = 512
 # speculation automatically.
 SPEC_MIN_ACCEPT = 0.25
 SPEC_COOLDOWN = int(os.environ.get('SKYTPU_ENGINE_SPEC_COOLDOWN', '16'))
+# When a speculation probe finds NO draft on any row (or a row lacks
+# verify headroom), speculation pauses this many steps and the overlap
+# PIPELINE owns the pool — probing every round would both starve the
+# pipeline for non-repetitive greedy traffic and pay the host-side
+# draft scan for nothing. The cooldown ticks at collect, so the pool
+# is re-scanned a few tokens later when drafts may have appeared.
+SPEC_NO_DRAFT_COOLDOWN = 4
 
 
 class EngineOverloaded(Exception):
@@ -241,7 +335,8 @@ def _parse_n(body) -> Tuple[int, int]:
 
 
 async def _submit_many(engine: InferenceEngine, prompts, max_new,
-                       sampling, stop_ids, n: int, best_of: int):
+                       sampling, stop_ids, n: int, best_of: int,
+                       want_tops: bool = False):
     """Fan out prompts × best_of into the continuous batcher, rank each
     prompt's candidates by mean logprob, keep n per prompt (OpenAI
     n/best_of + batched-prompt semantics in one place).
@@ -258,7 +353,7 @@ async def _submit_many(engine: InferenceEngine, prompts, max_new,
             for _ in range(best_of):
                 futs.append(engine.submit_nowait(
                     t, max_new, temperature, top_k, top_p, pres, freq,
-                    stop_ids=stop_ids))
+                    stop_ids=stop_ids, want_tops=want_tops))
     except EngineOverloaded:
         for f in futs:
             engine.cancel(f)
@@ -337,6 +432,25 @@ def _bucket(n: int, floor: int = 16) -> int:
     contract lives in models/decode.bucket_size)."""
     from skypilot_tpu.models import decode as decode_lib
     return decode_lib.bucket_size(n, floor)
+
+
+class _InFlightStep:
+    """Host handle for a dispatched-but-uncollected fused step: the
+    device output arrays (futures until the device finishes) plus the
+    static facts the collect half needs. `tis`/`tvs` are None in the
+    want_tops=False variant — the [k, B, K] top-k tensors were never
+    computed, let alone transferred."""
+
+    __slots__ = ('k', 'want_tops', 'toks', 'lps', 'tis', 'tvs')
+
+    def __init__(self, k: int, want_tops: bool, toks, lps, tis=None,
+                 tvs=None):
+        self.k = k
+        self.want_tops = want_tops
+        self.toks = toks
+        self.lps = lps
+        self.tis = tis
+        self.tvs = tvs
 
 
 class InferenceEngine:
@@ -457,6 +571,10 @@ class InferenceEngine:
         self._seed = seed
         self._resets = 0
         self._pending_cancels: List[Any] = []
+        # Dispatched-but-uncollected fused steps (oldest first). The
+        # leader keeps at most one outstanding across its broadcast
+        # points; followers mirror via the ('step',)/('collect',) ops.
+        self._inflight: List['_InFlightStep'] = []
 
     def _setup_mesh(self, mesh, quantize: Optional[str]) -> None:
         """Place params on a named mesh with the family's sharding rules;
@@ -561,7 +679,19 @@ class InferenceEngine:
                 else int(time.time_ns()) % (2**31))
         self.rng = jax.random.PRNGKey((base + self._resets) % (2**31))
         self._resets += 1
+        # Rebuilding device state invalidates any in-flight lookahead
+        # call (its donated inputs/outputs belong to the poisoned
+        # buffer generation): drop the handles so a later collect can
+        # never consume stale outputs into the fresh pool.
+        self._inflight.clear()
         self.slots: List[Optional[Dict[str, Any]]] = [None] * MAX_BATCH
+        # Per-slot previous token, DEVICE-resident (carried through the
+        # step jits so a lookahead step can be dispatched without any
+        # host sync) — self.last is its host MIRROR, maintained at
+        # collect/admit time for stop/length accounting and the
+        # speculative draft feed.
+        import jax.numpy as _jnp
+        self.last_dev = _jnp.zeros(MAX_BATCH, _jnp.int32)
         self.last = np.zeros(MAX_BATCH, np.int32)
         self.temp = np.zeros(MAX_BATCH, np.float32)
         self.topk = np.zeros(MAX_BATCH, np.int32)
@@ -597,12 +727,9 @@ class InferenceEngine:
 
         def top5(logits):
             """Top-K alternative logprobs of the UNPENALIZED model
-            distribution (OpenAI logprobs=N / top_logprobs): [.., V]
-            fp32 logits → (values [.., K] fp32, ids [.., K] i32)."""
-            lse = jax.scipy.special.logsumexp(logits, axis=-1,
-                                              keepdims=True)
-            v, i = jax.lax.top_k(logits, TOP_LOGPROBS_K)
-            return (v - lse).astype(jnp.float32), i.astype(jnp.int32)
+            distribution (decode.top_k_logprobs): [.., V] fp32 logits →
+            (values [.., K] fp32, ids [.., K] i32)."""
+            return decode_lib.top_k_logprobs(logits, TOP_LOGPROBS_K)
 
         if self.mesh is not None:
             # Host-read outputs (tokens/logprobs/top-K) replicate over
@@ -619,11 +746,19 @@ class InferenceEngine:
             def repl(x):
                 return x
 
-        def step_k(k, use_pen):
+        def step_k(k, use_pen, want_tops):
             """k decode steps in ONE device call (host-loop dispatch cost
             amortized when no request is waiting to join). Compiled per
-            (k, penalties-active) — the common un-penalized path never
-            pays the [B,V] counts carry/scatter or the penalty math."""
+            (k, penalties-active, want_tops) — the common un-penalized
+            path never pays the [B,V] counts carry/scatter or the
+            penalty math, and the [k,B,K] top-k logprob tensors are
+            computed (and transferred) only when some active slot asked
+            for logprobs.
+
+            `last` [B] i32 is a DEVICE-RESIDENT carry (in and out):
+            dispatching step N+1 needs only step N's output arrays, so
+            the batch loop can keep a call in flight with no host
+            sync between steps."""
 
             @functools.partial(jax.jit, donate_argnums=(1, 2))
             def run(params, cache, counts, last, temp, topk, topp, pres,
@@ -642,41 +777,49 @@ class InferenceEngine:
                     nxt = jnp.where(active, nxt, last_t)
                     # logprobs report the UNPENALIZED model distribution.
                     lp = decode_lib.chosen_logprob(logits, nxt)
-                    tv, ti = top5(logits)
                     if use_pen:
                         rows = jnp.arange(nxt.shape[0])
                         counts_t = counts_t.at[rows, nxt].add(
                             active.astype(jnp.int32))
-                    return (nxt, cache_t, counts_t, rng_t), (nxt, lp, ti,
-                                                             tv)
-                (last_f, cache_f, counts_f, rng_f), \
-                    (toks, lps, tis, tvs) = \
+                    if want_tops:
+                        tv, ti = top5(logits)
+                        return ((nxt, cache_t, counts_t, rng_t),
+                                (nxt, lp, ti, tv))
+                    return (nxt, cache_t, counts_t, rng_t), (nxt, lp)
+                (last_f, cache_f, counts_f, rng_f), outs = \
                     jax.lax.scan(body, (last, cache, counts, rng), None,
                                  length=k)
-                del last_f
-                return (repl(toks), repl(lps), repl(tis), repl(tvs),
-                        cache_f, counts_f, rng_f)
+                if want_tops:
+                    toks, lps, tis, tvs = outs
+                    return (repl(toks), repl(lps), repl(tis), repl(tvs),
+                            repl(last_f), cache_f, counts_f, rng_f)
+                toks, lps = outs
+                return (repl(toks), repl(lps), repl(last_f), cache_f,
+                        counts_f, rng_f)
             return run
 
         self._step_k_jits = {}
 
         def step(params, cache, counts, last, temp, topk, topp, pres,
-                 freq, rng, active, k=1, use_pen=False):
-            key = (k, use_pen)
+                 freq, rng, active, k=1, use_pen=False,
+                 want_tops=False):
+            key = (k, use_pen, want_tops)
             if key not in self._step_k_jits:
-                self._step_k_jits[key] = step_k(k, use_pen)
+                self._step_k_jits[key] = step_k(k, use_pen, want_tops)
             return self._step_k_jits[key](params, cache, counts, last,
                                           temp, topk, topp, pres, freq,
                                           rng, active)
 
         @functools.partial(jax.jit, donate_argnums=(1,))
-        def admit(params, cache, tokens, lengths, slots, temps, topks,
-                  topps, rng):
+        def admit(params, cache, last, tokens, lengths, slots, temps,
+                  topks, topps, rng):
             """Prefill a GROUP of same-bucket prompts ([N, S]) into
             cache rows `slots` ([N], distinct) and sample each first
             token. One compile per (prompt bucket, group size) pair —
             a concurrency burst pays ONE prefill device call instead of
-            N serial ones (the TTFT-dominant cost at high load)."""
+            N serial ones (the TTFT-dominant cost at high load). The
+            device-resident `last` carry picks up each admitted row's
+            first token here, so the next step needs no host upload."""
             logits, rows = dec.prefill(params, tokens, cfg, max_len,
                                        lengths=lengths)
 
@@ -692,12 +835,13 @@ class InferenceEngine:
                 logits, temps, topks, topps, sub)
             first_lp = decode_lib.chosen_logprob(logits, first)
             tv, ti = top5(logits)
+            last = last.at[slots].set(first)
             return (repl(first), repl(first_lp), repl(ti), repl(tv),
-                    cache, rng)
+                    cache, repl(last), rng)
 
         @functools.partial(jax.jit, donate_argnums=(1,))
-        def admit_extend(params, cache, prefix_a, prefix_b, tokens,
-                         length, slot, temp, topk, topp, rng):
+        def admit_extend(params, cache, last, prefix_a, prefix_b,
+                         tokens, length, slot, temp, topk, topp, rng):
             """Prefix-cache admit (single request): prefill only the
             SUFFIX over a stored prefix snapshot — (k, v) rows for the
             KVCache families (dense AND MoE: decode.prefill_extend
@@ -720,14 +864,17 @@ class InferenceEngine:
                 logits, temp[None], topk[None], topp[None], sub)
             first_lp = decode_lib.chosen_logprob(logits, first)
             tv, ti = top5(logits)
+            last = last.at[slot].set(first[0])
             return (repl(first[0]), repl(first_lp[0]), repl(ti[0]),
-                    repl(tv[0]), cache, rng)
+                    repl(tv[0]), cache, repl(last), rng)
 
-        @functools.partial(jax.jit, donate_argnums=(1,))
-        def spec_verify(params, cache, fed):
+        @functools.partial(jax.jit, donate_argnums=(1,),
+                           static_argnums=(3,))
+        def spec_verify(params, cache, fed, want_tops):
             """One K-wide speculative verify over the WHOLE slot pool:
             fed [B, K] = per-row [last, d1..d_{K-1}]. Returns the
-            target's greedy token, its logprob and top-5 at every
+            target's greedy token, its logprob (and, in the
+            want_tops=True variant only, the top-5 tensors) at every
             position; KV for the fed tokens is written at each row's
             offset but `length` does NOT advance — the host commits the
             accepted run (+1 correction) by bumping length, so rollback
@@ -738,13 +885,29 @@ class InferenceEngine:
             lse = jax.scipy.special.logsumexp(logits, axis=-1)
             lp = (jnp.take_along_axis(logits, greedy[..., None],
                                       axis=-1)[..., 0] - lse)
+            if not want_tops:
+                return repl(greedy), repl(lp), cache2
             tv, ti = top5(logits)
             return repl(greedy), repl(lp), repl(ti), repl(tv), cache2
+
+        @jax.jit
+        def fix_last(last, mask, vals):
+            """Re-sync the device-resident `last` with the host mirror
+            on `mask` rows ([B] bool): a row that stops or length-caps
+            mid-chunk (or a speculative commit) leaves the device carry
+            at the chunk's final token while the host mirror holds the
+            stop-point token — this pins the invariant device last ==
+            host mirror for every occupied slot after each collect.
+            One tiny [B] program, SPMD-safe on every mesh (an eager
+            scatter would fail on a non-addressable multi-host
+            array)."""
+            return repl(jnp.where(mask, vals, last))
 
         self._step_jit = step
         self._admit_jit = admit
         self._admit_extend_jit = admit_extend
         self._spec_jit = spec_verify
+        self._fix_last_jit = fix_last
         self._state_ready = True
 
     @staticmethod
@@ -756,38 +919,42 @@ class InferenceEngine:
         return sizes
 
     def warmup(self, buckets: Optional[List[int]] = None) -> None:
-        """Compile BOTH step programs (k=1 and k=MAX_STEP_CHUNK) plus the
-        admit programs — every power-of-two GROUP SIZE — for each prompt
-        bucket in `buckets` (default: the 16-token bucket) through the
-        real code path, then free the warmup slots; /health flips only
-        after. Step programs never recompile after this; admit compiles
-        once per (prompt bucket, group size) — warm the buckets your
-        traffic uses (--warm-buckets all) to guarantee no client request
-        ever hits a fresh XLA compile."""
+        """Compile the FULL step-variant matrix — k ∈ {1,
+        MAX_STEP_CHUNK} × use_pen × want_tops, the only programs
+        _dispatch_step can ever select — plus the admit programs (every
+        power-of-two GROUP SIZE per prompt bucket in `buckets`; default
+        the 16-token bucket), the speculative-verify variants, and the
+        last-resync program, all through the real code path; then free
+        the warmup slots. /health flips only after. Step programs never
+        recompile after this; admit compiles once per (prompt bucket,
+        group size) — warm the buckets your traffic uses
+        (--warm-buckets all) to guarantee no client request ever hits a
+        fresh XLA compile."""
         self._ensure_state()
-        warm_item = (list(range(1, 9)), 2 * MAX_STEP_CHUNK + 4, 0.0,
-                     None, None, 0.0, 0.0, (), None, None)
+        jnp = self._jnp
+        warm_item = (list(range(1, 9)), 4 * MAX_STEP_CHUNK + 8, 0.0,
+                     None, None, 0.0, 0.0, (), False, None, None)
         self._admit(warm_item)
-        self._step_once()      # k = MAX_STEP_CHUNK (remaining is large)
-        self.pres[:] = 1.0     # penalty-variant programs
-        self._step_once()      # k = MAX_STEP_CHUNK, use_pen
-        self.pres[:] = 0.0
-        # Drain to remaining == 1, then compile both k=1 variants.
-        while min(s['want'] - len(s['out']) for s in self.slots
-                  if s is not None) > 2:
-            self._step_once()
-        self._step_once()      # k = 1
-        self.pres[:] = 1.0
-        self._step_once()      # k = 1, use_pen
+        for want_tops in (False, True):
+            for use_pen in (False, True):
+                self.pres[:] = 1.0 if use_pen else 0.0
+                for k in (MAX_STEP_CHUNK, 1):
+                    self._step_once(k_force=k,
+                                    want_tops_force=want_tops)
         self.pres[:] = 0.0
         if self.spec_k > 0:
-            # Compile the speculative verify program (garbage fed/KV is
-            # fine: length does not advance, and every later step
+            # Compile BOTH speculative verify variants (garbage fed/KV
+            # is fine: length does not advance, and every later step
             # overwrites its own slot before attending it).
-            *_, self.cache = self._spec_jit(
-                self.params, self.cache,
-                self._jnp.zeros((MAX_BATCH, self.spec_k),
-                                self._jnp.int32))
+            fed = jnp.zeros((MAX_BATCH, self.spec_k), jnp.int32)
+            for want_tops in (False, True):
+                *_, self.cache = self._spec_jit(self.params, self.cache,
+                                                fed, want_tops)
+        # The device-last resync program (mid-chunk stop/length
+        # finishes and speculative commits re-pin device == mirror).
+        self.last_dev = self._fix_last_jit(
+            self.last_dev, jnp.zeros((MAX_BATCH,), bool),
+            jnp.asarray(self.last))
         self.slots = [None] * MAX_BATCH
         for size in self._group_sizes()[1:]:
             self._admit_group([warm_item] * size)
@@ -799,22 +966,28 @@ class InferenceEngine:
             if b <= 16 or b >= self.max_len:
                 continue
             item_b = (list(range(1, b + 1)), 1, 0.0, None, None, 0.0,
-                      0.0, (), None, None)
+                      0.0, (), False, None, None)
             for size in self._group_sizes():
                 self._admit_group([item_b] * size)
                 self.slots = [None] * MAX_BATCH
         self.last[:] = 0
+        self.last_dev = jnp.zeros(MAX_BATCH, jnp.int32)
         # Warmup admits must not pollute the served-token/step metrics
         # (/metrics feeds dashboards; phantom warmup tokens would skew
-        # tokens-per-request forever) — nor the prefix store (fake
+        # tokens-per-request forever — and warmup COMPILE times would
+        # wreck the latency histograms) — nor the prefix store (fake
         # warmup prompts must never match real traffic).
         self.step_count = 0
         self.tokens_generated = 0
         self._prefix_store.clear()
         self.prefix_hits = 0
+        for metric in _ENGINE_METRICS:
+            metric.reset()
+        _seed_counter_zeros()
         self.warm = True
-        logger.info('Engine warm (step + grouped-admit programs compiled; '
-                    f'buckets: {sorted(set([16] + list(buckets or [])))}, '
+        logger.info('Engine warm (step variants k x use_pen x want_tops '
+                    '+ grouped-admit programs compiled; buckets: '
+                    f'{sorted(set([16] + list(buckets or [])))}, '
                     f'group sizes: {self._group_sizes()}).')
 
     def all_buckets(self) -> List[int]:
@@ -834,24 +1007,30 @@ class InferenceEngine:
                       presence_penalty: float = 0.0,
                       frequency_penalty: float = 0.0,
                       stop_ids: Tuple[int, ...] = (),
+                      want_tops: bool = False,
                       stream_q: Optional[asyncio.Queue] = None
                       ) -> asyncio.Future:
         """Enqueue a request; returns the future resolving to
         (tokens, finish_reason, chosen_token_logprobs). Raises
         EngineOverloaded when the bounded admission queue is full
         (surfaced as 429) — the queue never grows without limit under
-        overload."""
+        overload. `want_tops`: the request asked for top-N alternative
+        logprobs, so steps serving it must run the want_tops compiled
+        variant (chosen-token logprobs are always recorded)."""
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         try:
             self._queue.put_nowait((tokens, max_new, temperature, top_k,
                                     top_p, presence_penalty,
                                     frequency_penalty, stop_ids,
-                                    stream_q, fut))
+                                    bool(want_tops), stream_q, fut))
         except asyncio.QueueFull:
             self.rejected_total += 1
+            _M_REJECTED.inc()
             raise EngineOverloaded(
                 f'admission queue full ({MAX_QUEUE} waiting)') from None
         self.requests_total += 1
+        _M_REQUESTS.inc()
+        _M_QUEUE_DEPTH.set(self.queue_depth())
         return fut
 
     async def submit(self, tokens: List[int], max_new: int,
@@ -971,13 +1150,15 @@ class InferenceEngine:
         key = tuple(tokens[:p])
         pk, pv = self._prefix_store[key]
         self._prefix_store.move_to_end(key)
-        first, first_lp, ti, tv, self.cache, self.rng = \
+        first, first_lp, ti, tv, self.cache, self.last_dev, self.rng = \
             self._admit_extend_jit(
-                self.params, self.cache, pk, pv, padded,
+                self.params, self.cache, self.last_dev, pk, pv, padded,
                 jnp.int32(len(suffix)), jnp.int32(slot),
                 jnp.float32(self.temp[slot]), jnp.int32(self.topk[slot]),
                 jnp.float32(self.topp[slot]), self.rng)
         self.prefix_hits += 1
+        _M_PREFIX_HITS.inc()
+        _M_PREFIX.inc(outcome='hit')
         first_i = int(first)
         self.counts = self.counts.at[slot].set(0).at[slot, first_i].add(1)
         self._finish_admit(item, slot, first_i, float(first_lp),
@@ -992,14 +1173,16 @@ class InferenceEngine:
     def _finish_admit(self, item, slot: int, first: int,
                       first_lp: float = 0.0,
                       first_tops: Optional[list] = None) -> None:
-        (tokens, max_new, _, _, _, _, _, stop_ids, stream_q, fut) = item
+        (tokens, max_new, _, _, _, _, _, stop_ids, want_tops, stream_q,
+         fut) = item
         self.last[slot] = first
         stop = frozenset(stop_ids or ())
         # ctx = prompt ++ generated: the prompt-lookup draft source AND
         # the host mirror of the row's cache length (len(ctx) - 1).
         entry = {'fut': fut, 'want': max_new, 'out': [], 'lps': [],
                  'tops': [], 'stop': stop, 'stream': stream_q, 'sent': 0,
-                 'finish': None, 'ctx': list(tokens) + [first]}
+                 'finish': None, 'want_tops': bool(want_tops),
+                 'ctx': list(tokens) + [first]}
         if first in stop:
             entry['finish'] = 'stop'
         else:
@@ -1007,6 +1190,7 @@ class InferenceEngine:
             entry['lps'].append(first_lp)
             entry['tops'].append(first_tops or [])
             self.tokens_generated += 1
+            _M_TOKENS.inc()
             if len(entry['out']) >= max_new:
                 entry['finish'] = 'length'
         self.slots[slot] = entry
@@ -1021,6 +1205,15 @@ class InferenceEngine:
         suffix (_admit_with_prefix)."""
         import jax
         jnp = self._jnp
+        # Buffer-reuse guard: admission reuses freed cache rows and
+        # reassigns the device `last` carry, so it is only legal at a
+        # DRAINED point — an uncollected lookahead step's output for a
+        # reused slot would otherwise be consumed by the new occupant
+        # (tested: collect always precedes buffer reuse).
+        assert not self._inflight, \
+            'admit while a step is in flight (collect must precede ' \
+            'slot reuse)'
+        t_admit = time.perf_counter()
         # self.warm gate: warmup's synthetic prompts share prefixes
         # across buckets — a warmup hit would skip compiling the very
         # grouped-admit programs warmup exists to build. A BURST of
@@ -1066,16 +1259,24 @@ class InferenceEngine:
             temps.append(self.temp[slot])
             topks.append(self.topk[slot])
             topps.append(self.topp[slot])
-        first, first_lp, tis, tvs, self.cache, self.rng = self._admit_jit(
-            self.params, self.cache, jnp.asarray(padded, jnp.int32),
-            jnp.asarray(lengths, jnp.int32),
-            jnp.asarray(slots, jnp.int32),
-            jnp.asarray(temps, jnp.float32),
-            jnp.asarray(topks, jnp.int32),
-            jnp.asarray(topps, jnp.float32), self.rng)
+        if self.warm and PREFIX_CACHE_ENTRIES > 0:
+            # Every item reaching the grouped prefill was a prefix-cache
+            # lookup miss (hits rode _admit_with_prefix above).
+            _M_PREFIX.inc(len(items), outcome='miss')
+        first, first_lp, tis, tvs, self.cache, self.last_dev, self.rng = \
+            self._admit_jit(
+                self.params, self.cache, self.last_dev,
+                jnp.asarray(padded, jnp.int32),
+                jnp.asarray(lengths, jnp.int32),
+                jnp.asarray(slots, jnp.int32),
+                jnp.asarray(temps, jnp.float32),
+                jnp.asarray(topks, jnp.int32),
+                jnp.asarray(topps, jnp.float32), self.rng)
+        t_sync = time.perf_counter()
         first = jax.device_get(first)
         first_lp = jax.device_get(first_lp)
         tis, tvs = jax.device_get(tis), jax.device_get(tvs)
+        _M_HOST_SYNC_SECONDS.observe(time.perf_counter() - t_sync)
         # Penalty counts: fresh slot, first token counted (host-side
         # eager update; the buffer is otherwise owned by the step jit).
         sl = jnp.asarray(slots, jnp.int32)
@@ -1087,6 +1288,7 @@ class InferenceEngine:
                                _tops_list(tis[i], tvs[i]))
             if self.warm:
                 self._prefix_capture(item[0], slots[i])
+        _M_ADMIT_SECONDS.observe(time.perf_counter() - t_admit)
 
     def _free_slot_excluding(self, taken) -> Optional[int]:
         for i, s in enumerate(self.slots):
@@ -1121,46 +1323,70 @@ class InferenceEngine:
         import numpy as np
         jnp = self._jnp
         k = self.spec_k
-        # warm gate: warmup's _step_once calls each compile a SPECIFIC
-        # step variant — a spec round hijacking one would skip it (the
-        # spec program itself is compiled explicitly in warmup).
-        if k <= 0 or not self.warm:
+        # A speculative round is host-SYNCHRONOUS (the verify outputs
+        # decide the next feed), so it only runs at a drained point: a
+        # lookahead step in flight means this is a pipelined round —
+        # decline BEFORE touching the cooldown counter, so leader and
+        # followers (which call this on every 'step' op) stay in
+        # lockstep.
+        if self._inflight:
             return False
-        if self._spec_cool > 0:
-            self._spec_cool -= 1
+        # The cheap preconditions are SHARED with the batch loop's
+        # lookahead gate (_spec_precheck: spec enabled, warm, no
+        # cooldown, all rows greedy, no penalties) — one definition,
+        # so the 'spec takes precedence' decision can never drift from
+        # what this method actually accepts. The cooldown inside it is
+        # check-only here: it DECREMENTS at _collect_step (one tick
+        # per executed fused step, the old per-round cadence), so it
+        # keeps draining while the pipeline owns the pool and spec
+        # re-probes when it expires.
+        if not self._spec_precheck():
             return False
         active_idx = [i for i, s in enumerate(self.slots)
                       if s is not None and s['finish'] is None]
-        if not active_idx:
-            return False
-        if any(self.temp[i] > 0 for i in active_idx):
-            return False
-        if self.pres.any() or self.freq.any():
-            return False
         drafts = {}
         real_len = {}
+        no_draft = False
         for i in active_idx:
             ctx = self.slots[i]['ctx']
             if len(ctx) - 1 + k > self.max_len:
-                return False
+                no_draft = True      # headroom pause — same handling
+                break
             d = _lookup_draft(ctx, k)
             if d:
                 real_len[i] = len(d)
                 drafts[i] = (d + [0] * k)[:k]
-        if not drafts:
+        if no_draft or not drafts:
+            # Nothing to verify (non-repetitive traffic, or a
+            # near-limit row): pause the probing for a few steps and
+            # hand the pool to the overlap PIPELINE — without this,
+            # greedy traffic that never drafts would re-probe every
+            # round and never pipeline at all.
+            self._spec_cool = SPEC_NO_DRAFT_COOLDOWN
             return False
         fed = np.zeros((MAX_BATCH, k), np.int32)
         for i in active_idx:
             fed[i, 0] = self.last[i]
             fed[i, 1:] = (drafts[i][:k - 1] if i in drafts
                           else [self.last[i]] * (k - 1))
-        greedy, lps, tis, tvs, self.cache = self._spec_jit(
-            self.params, self.cache, jnp.asarray(fed))
+        want_tops = any(self.slots[i]['want_tops'] for i in active_idx)
+        if want_tops:
+            greedy, lps, tis, tvs, self.cache = self._spec_jit(
+                self.params, self.cache, jnp.asarray(fed), True)
+        else:
+            greedy, lps, self.cache = self._spec_jit(
+                self.params, self.cache, jnp.asarray(fed), False)
+            tis = tvs = None
+        t_sync = time.perf_counter()
         greedy = jax.device_get(greedy)          # [B, K]
         lps = jax.device_get(lps)
-        tis, tvs = jax.device_get(tis), jax.device_get(tvs)
+        if want_tops:
+            tis, tvs = jax.device_get(tis), jax.device_get(tvs)
+        _M_HOST_SYNC_SECONDS.observe(time.perf_counter() - t_sync)
         self.step_count += 1
         self.spec_rounds += 1
+        _M_STEPS.inc()
+        _M_SPEC_ROUNDS.inc()
         adv = np.zeros((MAX_BATCH,), np.int32)
         round_prop = round_acc = 0
         for i in active_idx:
@@ -1189,72 +1415,164 @@ class InferenceEngine:
                     break
                 s['out'].append(tok)
                 s['lps'].append(float(lps[i][j]))
-                s['tops'].append(_tops_list(tis[i][j], tvs[i][j]))
+                s['tops'].append(_tops_list(tis[i][j], tvs[i][j])
+                                 if want_tops else [])
                 s['ctx'].append(tok)
                 self.tokens_generated += 1
+                _M_TOKENS.inc()
                 if len(s['out']) >= s['want']:
                     s['finish'] = 'length'
         import dataclasses as _dc
         self.cache = _dc.replace(self.cache,
                                  length=self.cache.length +
                                  jnp.asarray(adv))
+        # Re-pin the device-resident `last` to the committed tokens
+        # (the step carry did not see this round).
+        mask = np.zeros((MAX_BATCH,), bool)
+        mask[active_idx] = True
+        self.last_dev = self._fix_last_jit(self.last_dev,
+                                           jnp.asarray(mask),
+                                           jnp.asarray(self.last))
         self.spec_proposed += round_prop
         self.spec_accepted += round_acc
+        _M_SPEC_PROPOSED.inc(round_prop)
+        _M_SPEC_ACCEPTED.inc(round_acc)
         if round_prop and round_acc < SPEC_MIN_ACCEPT * round_prop:
             self._spec_cool = SPEC_COOLDOWN
         return True
 
-    def _choose_k(self) -> int:
+    def _remaining(self, inflight_k: int = 0) -> List[int]:
+        """Per-active-row token budget before length-finish.
+        `inflight_k`: an uncollected call's tokens are budgeted as
+        already consumed (the lookahead view)."""
+        return [s['want'] - len(s['out']) - inflight_k
+                for s in self.slots
+                if s is not None and s['finish'] is None]
+
+    def _choose_k(self, inflight_k: int = 0) -> int:
         """Step width for the next fused call. k ∈ {1, MAX_STEP_CHUNK}
-        ONLY: exactly two compiled step programs, both built in warmup —
-        a client-chosen max_new must not be able to trigger a fresh XLA
-        compile via tail-chunk sizes. Leader-only inputs (the admission
-        queue) feed this, so multi-host broadcasts the chosen k."""
-        remaining = [s['want'] - len(s['out']) for s in self.slots
-                     if s is not None]
+        ONLY: exactly two step widths in the compiled-variant matrix,
+        all built in warmup — a client-chosen max_new must not be able
+        to trigger a fresh XLA compile via tail-chunk sizes.
+        Leader-only inputs (the admission queue) feed this, so
+        multi-host broadcasts the chosen k."""
+        remaining = self._remaining(inflight_k)
         if (remaining and min(remaining) >= MAX_STEP_CHUNK and
                 (self._queue is None or self._queue.empty())):
             return MAX_STEP_CHUNK
         return 1
 
-    @timeline.event
-    def _step_once(self, k_force: Optional[int] = None) -> None:
-        """Decode step(s) over the whole slot pool (device work).
+    def _lookahead_k(self, inflight_k: int) -> Optional[int]:
+        """Width for a lookahead dispatch (step N+1 before step N is
+        collected), or None when the pipeline must drain first: a
+        request is waiting to admit, a cancel is pending, or some
+        active row may finish inside the in-flight call (its tokens
+        past the finish would be garbage AND the freed slot must not be
+        stepped before re-admission). Speculation-ELIGIBLE pools do not
+        look ahead either: a verify round is host-synchronous by
+        nature, so speculation and pipelining are alternative TPOT
+        strategies — spec takes precedence while its preconditions
+        hold, and the pipeline owns sampling/penalized/spec-disabled
+        pools plus spec's cooldown windows (the cooldown decrements at
+        collect, so an expiring pause re-probes spec at the next
+        drained round)."""
+        if self._pending_cancels:
+            return None
+        if self._queue is not None and not self._queue.empty():
+            return None
+        if self._spec_precheck():
+            return None
+        remaining = self._remaining(inflight_k)
+        if not remaining or min(remaining) < 1:
+            return None
+        return self._choose_k(inflight_k)
 
-        A speculative round runs instead whenever it applies
-        (_spec_once); otherwise steps MAX_STEP_CHUNK tokens per device
-        call when nothing is waiting to join (the per-call host
-        dispatch is the continuous batcher's overhead); drops back to
-        single steps under admission pressure. A request arriving
-        mid-call therefore waits at most one in-flight fused call (up
-        to MAX_STEP_CHUNK steps) to join. `k_force`: multi-host
-        followers mirror the leader's choice instead of reading their
-        (nonexistent) queue."""
-        import jax
+    def _spec_precheck(self) -> bool:
+        """Cheap host-only preconditions for a speculative round (no
+        draft scan): used by the batch loop to stop looking ahead when
+        the NEXT drained round could speculate instead."""
+        if self.spec_k <= 0 or not self.warm or self._spec_cool > 0:
+            return False
+        active_idx = [i for i, s in enumerate(self.slots)
+                      if s is not None and s['finish'] is None]
+        if not active_idx:
+            return False
+        if any(self.temp[i] > 0 for i in active_idx):
+            return False
+        return not (self.pres.any() or self.freq.any())
+
+    @timeline.event
+    def _dispatch_step(self, k: int,
+                       want_tops_force: Optional[bool] = None
+                       ) -> _InFlightStep:
+        """Dispatch half of a fused step: select the compiled variant
+        (k × use_pen × want_tops, all runtime state derived from
+        MIRRORED host state so multi-host followers pick the same one),
+        enqueue the device call, and return the in-flight handle — NO
+        host sync happens here; the outputs stay device-side futures
+        until _collect_step. Rows whose `finish` is already set are
+        masked out of `active` at dispatch, so a stopped/cancelled/
+        length-capped row stops burning decode FLOPs immediately
+        instead of at the next reap."""
+        t0 = time.perf_counter()
         jnp = self._jnp
-        if self._spec_once():
-            return
-        k = k_force if k_force is not None else self._choose_k()
-        active = jnp.asarray([s is not None for s in self.slots])
+        active = jnp.asarray([s is not None and s['finish'] is None
+                              for s in self.slots])
         use_pen = bool(self.pres.any() or self.freq.any())
-        toks, lps, tis, tvs, self.cache, self.counts, self.rng = \
-            self._step_jit(
-                self.params, self.cache, self.counts,
-                jnp.asarray(self.last), jnp.asarray(self.temp),
-                jnp.asarray(self.topk), jnp.asarray(self.topp),
-                jnp.asarray(self.pres), jnp.asarray(self.freq),
-                self.rng, active, k=k, use_pen=use_pen)
-        toks = jax.device_get(toks)              # [k, B]
-        lps = jax.device_get(lps)                # [k, B]
-        tis = jax.device_get(tis)                # [k, B, K]
-        tvs = jax.device_get(tvs)                # [k, B, K]
+        want_tops = (bool(want_tops_force) if want_tops_force is not None
+                     else any(s is not None and s['finish'] is None and
+                              s['want_tops'] for s in self.slots))
+        out = self._step_jit(
+            self.params, self.cache, self.counts, self.last_dev,
+            jnp.asarray(self.temp), jnp.asarray(self.topk),
+            jnp.asarray(self.topp), jnp.asarray(self.pres),
+            jnp.asarray(self.freq), self.rng, active, k=k,
+            use_pen=use_pen, want_tops=want_tops)
+        if want_tops:
+            (toks, lps, tis, tvs, self.last_dev, self.cache,
+             self.counts, self.rng) = out
+            handle = _InFlightStep(k, True, toks, lps, tis, tvs)
+        else:
+            toks, lps, self.last_dev, self.cache, self.counts, \
+                self.rng = out
+            handle = _InFlightStep(k, False, toks, lps)
+        self._inflight.append(handle)
+        _M_STEP_SECONDS.observe(time.perf_counter() - t0,
+                                phase='dispatch')
+        return handle
+
+    @timeline.event
+    def _collect_step(self) -> None:
+        """Collect half: block on the OLDEST in-flight step's outputs
+        (tokens + chosen logprobs always; the [k, B, K] top-k tensors
+        only in the want_tops variant) and run the Python bookkeeping.
+        Rows that finish mid-chunk leave the device-resident `last`
+        carry at the chunk's final token — a tiny jitted where()
+        re-pins it to the host mirror, keeping the invariant device
+        last == host mirror for every occupied slot after collect."""
+        import jax
+        import numpy as np
+        assert self._inflight, 'collect with no step in flight'
+        h = self._inflight.pop(0)
+        t0 = time.perf_counter()
+        t_sync = time.perf_counter()
+        toks = jax.device_get(h.toks)            # [k, B]
+        lps = jax.device_get(h.lps)              # [k, B]
+        if h.want_tops:
+            tis = jax.device_get(h.tis)          # [k, B, K]
+            tvs = jax.device_get(h.tvs)          # [k, B, K]
+        _M_HOST_SYNC_SECONDS.observe(time.perf_counter() - t_sync)
+        k = h.k
         self.step_count += k
+        _M_STEPS.inc(k)
+        fixups = []
         for i, s in enumerate(self.slots):
-            if s is None:
+            if s is None or s['finish'] is not None:
+                # Finished rows were masked inactive at dispatch (or
+                # this call was dispatched before the finish was known
+                # — either way their outputs are not consumed).
                 continue
             for t in range(k):
-                if s['finish'] is not None:
-                    break
                 tok = int(toks[t][i])
                 self.last[i] = tok
                 if tok in s['stop']:
@@ -1264,11 +1582,52 @@ class InferenceEngine:
                     break
                 s['out'].append(tok)
                 s['lps'].append(float(lps[t][i]))
-                s['tops'].append(_tops_list(tis[t][i], tvs[t][i]))
+                s['tops'].append(_tops_list(tis[t][i], tvs[t][i])
+                                 if h.want_tops else [])
                 s['ctx'].append(tok)
                 self.tokens_generated += 1
+                _M_TOKENS.inc()
                 if len(s['out']) >= s['want']:
                     s['finish'] = 'length'
+                    break
+            if s['finish'] is not None:
+                fixups.append(i)
+        if fixups:
+            mask = np.zeros((MAX_BATCH,), bool)
+            mask[fixups] = True
+            self.last_dev = self._fix_last_jit(
+                self.last_dev, self._jnp.asarray(mask),
+                self._jnp.asarray(self.last))
+        if self._spec_cool > 0:
+            # One cooldown tick per executed fused step (leader AND
+            # followers collect in lockstep, so the counter stays
+            # mirrored); when it reaches 0, _spec_precheck flips and
+            # the batch loop hands the pool back to speculation at the
+            # next drained round.
+            self._spec_cool -= 1
+        _M_STEP_SECONDS.observe(time.perf_counter() - t0,
+                                phase='collect')
+
+    def _step_or_dispatch(self, k: int) -> Optional[_InFlightStep]:
+        """One 'step' op: a speculative round when it applies (host-
+        synchronous, drained points only — then returns None), else a
+        pipelined dispatch returning the in-flight handle. Shared by
+        the leader's batch loop and multi-host followers so both sides
+        make the identical choice from mirrored state."""
+        if self._spec_once():
+            return None
+        return self._dispatch_step(k)
+
+    def _step_once(self, k_force: Optional[int] = None,
+                   want_tops_force: Optional[bool] = None) -> None:
+        """Synchronous dispatch + collect (warmup and tests; the batch
+        loop pipelines via _dispatch_step/_collect_step directly).
+        `k_force` overrides the queue-dependent width choice."""
+        if self._spec_once():
+            return
+        k = k_force if k_force is not None else self._choose_k()
+        self._dispatch_step(k, want_tops_force=want_tops_force)
+        self._collect_step()
 
     def _publish(self) -> None:
         """Push new tokens to streaming consumers and resolve finished
@@ -1348,13 +1707,17 @@ class InferenceEngine:
                 self._fail_all(e, extra=group)
 
     async def batch_loop(self) -> None:
-        """Continuous scheduler: admit whenever a slot is free, step while
-        anything is active. A late request joins after at most one
-        in-flight fused call — it never waits for earlier requests to
-        drain. Concurrent arrivals sharing a prompt bucket prefill in
-        ONE device call (grouped admission)."""
+        """Continuous scheduler: admit whenever a slot is free, step
+        while anything is active. A late request joins after the
+        in-flight fused call(s) drain (at most two while the pipeline
+        is looking ahead) — it never waits for earlier requests to
+        finish. Concurrent arrivals sharing a prompt bucket prefill in
+        ONE device call (grouped admission). Admission, cancels and
+        failure resets happen only HERE, at drained points — the
+        pipeline invariant (collect always precedes buffer reuse)."""
         self._ensure_state()
         while True:
+            # Drained point: no step in flight (asserted in admit).
             self._process_cancels()
             busy = any(s is not None for s in self.slots)
             if not busy:
@@ -1365,14 +1728,49 @@ class InferenceEngine:
             if self._free_slot() is not None and not self._queue.empty():
                 await self._admit_pending()
             self._publish()             # first tokens stream immediately
-            k = self._choose_k()
-            self._bcast(('step', k))
+            if all(s is None for s in self.slots):
+                continue                # the publish reaped everything
             try:
-                await asyncio.to_thread(self._step_once, k)
+                await self._step_round()
             except Exception as e:  # pylint: disable=broad-except
                 self._fail_all(e)
                 continue
             self._publish()
+
+    async def _step_round(self) -> None:
+        """One scheduling round of device work, PIPELINED: dispatch
+        step N, then — while nothing is queued, no cancel is pending
+        and no active row can finish inside the in-flight call —
+        dispatch step N+1 BEFORE collecting step N, so the device is
+        never waiting on Python bookkeeping. Every collect is followed
+        by a publish so tokens stream at the same cadence as the
+        unpipelined loop. Speculative rounds are host-synchronous and
+        run instead of the whole round when applicable. Broadcast
+        discipline: ('step', k) at every dispatch, ('collect',) before
+        every collect, ('reap',) inside every publish — followers
+        replay the identical dispatch/collect interleaving, keeping
+        host state (and therefore the next collective) in lockstep."""
+        k = self._choose_k()
+        self._bcast(('step', k))
+        inflight = await asyncio.to_thread(self._step_or_dispatch, k)
+        if inflight is None:            # a speculative round ran
+            return
+        while True:
+            k2 = self._lookahead_k(inflight.k)
+            if k2 is None:
+                break
+            self._bcast(('step', k2))
+            nxt = await asyncio.to_thread(self._dispatch_step, k2)
+            self._bcast(('collect',))
+            await asyncio.to_thread(self._collect_step)
+            self._publish()
+            inflight = nxt
+            if self._spec_precheck():
+                # Let the next drained round try a speculative verify
+                # instead of pipelining past it forever.
+                break
+        self._bcast(('collect',))
+        await asyncio.to_thread(self._collect_step)
 
     def _fail_all(self, e: Exception, extra=None) -> None:
         """Fail every in-flight request and rebuild the device state: the
@@ -1496,7 +1894,10 @@ async def _sse_response(request, engine: InferenceEngine,
             q: asyncio.Queue = asyncio.Queue()
             fut = engine.submit_nowait(tokens, max_new, temperature,
                                        top_k, top_p, pres, freq,
-                                       stop_ids=stop_ids, stream_q=q)
+                                       stop_ids=stop_ids,
+                                       want_tops=(want_logprobs and
+                                                  top_n > 0),
+                                       stream_q=q)
             choices.append(_SseChoice(engine, idx, fut, q))
     except EngineOverloaded as e:
         # All-or-nothing like _submit_many: cancel enqueued siblings.
@@ -1643,32 +2044,15 @@ def build_app(engine: InferenceEngine):
         })
 
     async def metrics(request):
-        """Prometheus text format — consumed by the serve LB's
-        instance-aware policy and any scraper."""
+        """Prometheus text exposition, rendered from the observe
+        registry (docs/OBSERVABILITY.md catalog: skytpu_engine_* —
+        counters incremented on the hot path, latency histograms from
+        the decode pipeline, gauges sampled at scrape time). Consumed
+        by the serve LB's instance-aware policy and any scraper."""
         del request
-        lines = [
-            '# TYPE skytpu_engine_queue_depth gauge',
-            f'skytpu_engine_queue_depth {engine.queue_depth()}',
-            '# TYPE skytpu_engine_in_flight gauge',
-            f'skytpu_engine_in_flight {engine.in_flight()}',
-            '# TYPE skytpu_engine_steps_total counter',
-            f'skytpu_engine_steps_total {engine.step_count}',
-            '# TYPE skytpu_engine_tokens_total counter',
-            f'skytpu_engine_tokens_total {engine.tokens_generated}',
-            '# TYPE skytpu_engine_requests_total counter',
-            f'skytpu_engine_requests_total {engine.requests_total}',
-            '# TYPE skytpu_engine_prefix_hits_total counter',
-            f'skytpu_engine_prefix_hits_total {engine.prefix_hits}',
-            '# TYPE skytpu_engine_rejected_total counter',
-            f'skytpu_engine_rejected_total {engine.rejected_total}',
-            '# TYPE skytpu_engine_spec_rounds_total counter',
-            f'skytpu_engine_spec_rounds_total {engine.spec_rounds}',
-            '# TYPE skytpu_engine_spec_proposed_total counter',
-            f'skytpu_engine_spec_proposed_total {engine.spec_proposed}',
-            '# TYPE skytpu_engine_spec_accepted_total counter',
-            f'skytpu_engine_spec_accepted_total {engine.spec_accepted}',
-        ]
-        return web.Response(text='\n'.join(lines) + '\n',
+        _M_QUEUE_DEPTH.set(engine.queue_depth())
+        _M_IN_FLIGHT.set(engine.in_flight())
+        return web.Response(text=metrics_lib.render(),
                             content_type='text/plain')
 
     async def generate(request):
@@ -1788,7 +2172,8 @@ def build_app(engine: InferenceEngine):
 
         try:
             results, total_out = await _submit_many(
-                engine, prompts, max_new, sampling, stop_ids, n, best_of)
+                engine, prompts, max_new, sampling, stop_ids, n, best_of,
+                want_tops=want_logprobs and top_n > 0)
         except EngineOverloaded as e:
             return _openai_error(web, str(e), status=429,
                                  err_type='overloaded_error')
@@ -1906,7 +2291,8 @@ def build_app(engine: InferenceEngine):
 
         try:
             results, total_out = await _submit_many(
-                engine, [tokens], max_new, sampling, stop_ids, n, n)
+                engine, [tokens], max_new, sampling, stop_ids, n, n,
+                want_tops=want_logprobs and top_n > 0)
         except EngineOverloaded as e:
             return _openai_error(web, str(e), status=429,
                                  err_type='overloaded_error')

@@ -12,9 +12,14 @@ jitted step/admit programs single-host serving uses.
 Design — leader-follower SPMD mirroring:
   - Process 0 (leader) runs the HTTP frontend and the continuous
     batcher. Every engine-level operation that touches the device
-    (warmup, an admit group, a decode step round, a failure reset) is
-    broadcast over a tiny TCP control channel BEFORE the leader
-    executes it.
+    (warmup, an admit group, a decode step DISPATCH, a step COLLECT,
+    a failure reset) is broadcast over a tiny TCP control channel
+    BEFORE the leader executes it. The decode pipeline's dispatch and
+    collect halves are SEPARATE ops: the leader may dispatch step N+1
+    before collecting step N (double buffering), and followers replay
+    the identical interleaving, so every process's host state — and
+    therefore its next collective — advances at the same op-stream
+    points.
   - Followers run the SAME engine methods with the SAME inputs, so the
     whole host-side state (slot pool, sampling arrays, prefix store,
     speculative drafts) evolves identically everywhere and every
@@ -277,7 +282,21 @@ def follower_serve(engine, coordinator: str) -> None:
             elif kind == 'admit':
                 engine._admit_group(op[1])
             elif kind == 'step':
-                engine._step_once(k_force=op[1])
+                # DISPATCH only (pipelined): the leader broadcasts a
+                # separate ('collect',) before it consumes the
+                # outputs, so a lookahead dispatch lands here with the
+                # previous step still uncollected — exactly like the
+                # leader. A speculative round (host-synchronous,
+                # drained points only) is derived from mirrored state
+                # inside _step_or_dispatch, same as the leader.
+                engine._step_or_dispatch(op[1])
+            elif kind == 'collect':
+                # Consume the OLDEST in-flight step's outputs at the
+                # same op-stream point the leader does — host
+                # bookkeeping (stop/length finishes, the device-last
+                # resync) must advance in lockstep or the next reap
+                # would free different slots on each process.
+                engine._collect_step()
             elif kind == 'reap':
                 # The leader broadcasts this at every _publish, so
                 # finished slots free at EXACTLY the same point in the

@@ -1,0 +1,389 @@
+"""Overlapped decode pipeline: double-buffered dispatch/collect,
+device-resident sampling state, on-demand logprob transfer.
+
+The contract under test (docs/ENGINE.md):
+  - OVERLAP: with traffic steady (queue empty, no cancels), step N+1 is
+    dispatched BEFORE step N's results are consumed — the device never
+    waits on Python bookkeeping.
+  - SAFETY: collect always precedes buffer reuse (admission only at
+    drained points); a cancel or failure arriving while a lookahead
+    call is in flight drains/resets cleanly and the engine keeps
+    serving.
+  - ON-DEMAND TRANSFER: the [k, B, K] top-k logprob tensors are
+    computed and transferred only when some active slot requested
+    logprobs — the want_tops=False variants never materialize them.
+  - MIRROR: the device-resident `last` carry equals the host mirror
+    for every slot after stop/length finishes (mid-chunk finishes are
+    re-pinned at collect).
+
+All CPU-backed (JAX_PLATFORMS=cpu), like the rest of tier-1.
+"""
+import asyncio
+import dataclasses
+import random
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient
+from aiohttp.test_utils import TestServer as AioTestServer
+
+import jax.numpy as jnp
+
+from skypilot_tpu.models import decode
+from skypilot_tpu.serve import engine as engine_lib
+
+
+@pytest.fixture(scope='module')
+def engine():
+    eng = engine_lib.InferenceEngine('llama-debug', max_len=128)
+    # fp32: CPU reduction order must not flip argmax vs the reference;
+    # spec disabled: speculative rounds are host-synchronous by design,
+    # and these tests pin the PIPELINED path.
+    eng.cfg = dataclasses.replace(eng.cfg, dtype=jnp.float32)
+    eng.spec_k = 0
+    eng.warmup()
+    return eng
+
+
+def _run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def _with_client(engine, fn):
+    async def inner():
+        client = TestClient(AioTestServer(engine_lib.build_app(engine)))
+        await client.start_server()
+        try:
+            return await fn(client)
+        finally:
+            await client.close()
+    return _run(inner())
+
+
+class TestPipelineOverlap:
+
+    def test_step_n_plus_1_dispatched_before_step_n_collected(
+            self, engine, monkeypatch):
+        """THE overlap proof: during a steady single-request decode the
+        event trace must contain two consecutive dispatches with no
+        intervening collect — i.e. the lookahead call went out while
+        the previous call's results were still unconsumed futures."""
+        events = []
+        orig_d = engine_lib.InferenceEngine._dispatch_step
+        orig_c = engine_lib.InferenceEngine._collect_step
+
+        def spy_d(self, k, want_tops_force=None):
+            events.append(('dispatch', k))
+            return orig_d(self, k, want_tops_force=want_tops_force)
+
+        def spy_c(self):
+            events.append(('collect', self._inflight[0].k))
+            return orig_c(self)
+
+        monkeypatch.setattr(engine_lib.InferenceEngine, '_dispatch_step',
+                            spy_d)
+        monkeypatch.setattr(engine_lib.InferenceEngine, '_collect_step',
+                            spy_c)
+
+        async def fn(client):
+            r = await client.post('/generate', json={
+                'tokens': [1] * 8, 'max_new_tokens': 40})
+            assert r.status == 200
+            return (await r.json())['tokens']
+
+        out = _with_client(engine, fn)
+        assert len(out) == 40
+        kinds = [e[0] for e in events]
+        assert any(kinds[i] == kinds[i + 1] == 'dispatch'
+                   for i in range(len(kinds) - 1)), (
+            'no lookahead dispatch observed — the pipeline never '
+            f'overlapped: {kinds}')
+        # Every dispatch was eventually collected; nothing leaked.
+        assert kinds.count('dispatch') == kinds.count('collect')
+        assert engine._inflight == []
+        # Steady-state used the fused chunk width for the lookahead.
+        assert ('dispatch', engine_lib.MAX_STEP_CHUNK) in events
+
+    def test_collect_always_precedes_buffer_reuse(self, engine):
+        """A request arriving mid-generation must not be admitted over
+        an uncollected lookahead call (its slot's in-flight outputs
+        would leak into the new occupant): _admit_group asserts the
+        drained invariant, and the late request's output must still
+        equal its solo greedy result exactly."""
+        admits = []
+        orig = engine_lib.InferenceEngine._admit_group
+
+        def spy(self, items):
+            admits.append(len(self._inflight))
+            return orig(self, items)
+
+        solo = np.asarray(decode.generate(
+            engine.params, jnp.asarray([[5] * 8], jnp.int32), engine.cfg,
+            4, max_len=engine.max_len)[0][:4])
+
+        import unittest.mock as mock
+        with mock.patch.object(engine_lib.InferenceEngine,
+                               '_admit_group', spy):
+            async def fn(client):
+                t_long = asyncio.create_task(client.post(
+                    '/generate', json={'tokens': [4] * 8,
+                                       'max_new_tokens': 48}))
+                for _ in range(200):
+                    await asyncio.sleep(0.01)
+                    if engine.in_flight():
+                        break
+                r = await client.post('/generate', json={
+                    'tokens': [5] * 8, 'max_new_tokens': 4})
+                short = (await r.json())['tokens']
+                long_out = (await (await t_long).json())['tokens']
+                return short, long_out
+
+            short, long_out = _with_client(engine, fn)
+        np.testing.assert_array_equal(np.asarray(short), solo)
+        assert len(long_out) == 48
+        # Every admission (warm path) happened at a drained point.
+        assert admits and all(n == 0 for n in admits)
+
+    def test_cancel_while_lookahead_in_flight_drains_cleanly(
+            self, engine):
+        """cancel() arriving while the pipeline has a call in flight is
+        DEFERRED to the next drained point: the request resolves with
+        finish='stop', no handle leaks, and the engine keeps serving."""
+        async def fn(client):
+            fut = engine.submit_nowait([2] * 8, 64, 0.0, None, None)
+            for _ in range(200):
+                await asyncio.sleep(0.01)
+                if engine.in_flight():
+                    break
+            assert engine.in_flight() == 1
+            engine.cancel(fut)
+            out, finish, _lps, _tops = await fut
+            assert finish == 'stop'
+            assert len(out) < 64
+            # The engine still serves after the mid-flight cancel.
+            r = await client.post('/generate', json={
+                'tokens': [3] * 8, 'max_new_tokens': 3})
+            assert r.status == 200
+            assert len((await r.json())['tokens']) == 3
+            return True
+
+        assert _with_client(engine, fn)
+        assert engine._inflight == []
+
+    def test_failure_while_pipelined_resets_and_recovers(self, engine,
+                                                         monkeypatch):
+        """A device-call failure surfacing at collect time (the failed
+        jit was donated the cache) fails the in-flight requests, drops
+        any lookahead handle, rebuilds device state, and the next
+        request succeeds."""
+        orig = engine_lib.InferenceEngine._collect_step
+        state = {'arm': True}
+
+        def failing(self):
+            if state['arm']:
+                state['arm'] = False
+                raise RuntimeError('injected device failure')
+            return orig(self)
+
+        monkeypatch.setattr(engine_lib.InferenceEngine, '_collect_step',
+                            failing)
+        resets0 = engine._resets
+
+        async def fn(client):
+            r = await client.post('/generate', json={
+                'tokens': [6] * 8, 'max_new_tokens': 24})
+            assert r.status == 500        # the failed request surfaces
+            r2 = await client.post('/generate', json={
+                'tokens': [6] * 8, 'max_new_tokens': 3})
+            assert r2.status == 200
+            return (await r2.json())['tokens']
+
+        out = _with_client(engine, fn)
+        assert len(out) == 3
+        assert engine._resets == resets0 + 1
+        assert engine._inflight == []
+        assert all(s is None for s in engine.slots)
+
+
+class TestWantTopsVariants:
+
+    def test_no_topk_computed_or_transferred_without_logprobs(
+            self, engine, monkeypatch):
+        """Steady-state decode with logprobs unrequested must select
+        the want_tops=False variants only: no handle carries a
+        [k, B, K] tensor (tis/tvs are None — never computed, never
+        transferred)."""
+        handles = []
+        orig = engine_lib.InferenceEngine._dispatch_step
+
+        def spy(self, k, want_tops_force=None):
+            h = orig(self, k, want_tops_force=want_tops_force)
+            handles.append(h)
+            return h
+
+        monkeypatch.setattr(engine_lib.InferenceEngine, '_dispatch_step',
+                            spy)
+
+        async def fn(client):
+            r = await client.post('/v1/completions', json={
+                'prompt': [1, 2, 3, 4], 'max_tokens': 12,
+                'temperature': 0, 'ignore_eos': True})
+            assert r.status == 200
+            return await r.json()
+
+        _with_client(engine, fn)
+        assert handles, 'no steps dispatched'
+        assert all(not h.want_tops for h in handles)
+        assert all(h.tis is None and h.tvs is None for h in handles)
+
+    def test_topk_variant_selected_iff_some_slot_wants_logprobs(
+            self, engine, monkeypatch):
+        """A logprobs=N request flips the pool onto the want_tops=True
+        variants (and the response carries real top-N lists)."""
+        handles = []
+        orig = engine_lib.InferenceEngine._dispatch_step
+
+        def spy(self, k, want_tops_force=None):
+            h = orig(self, k, want_tops_force=want_tops_force)
+            handles.append(h)
+            return h
+
+        monkeypatch.setattr(engine_lib.InferenceEngine, '_dispatch_step',
+                            spy)
+
+        async def fn(client):
+            r = await client.post('/v1/completions', json={
+                'prompt': [1, 2, 3, 4], 'max_tokens': 6,
+                'temperature': 0, 'ignore_eos': True, 'logprobs': 2})
+            assert r.status == 200
+            return await r.json()
+
+        body = _with_client(engine, fn)
+        assert handles and all(h.want_tops for h in handles)
+        assert all(h.tis is not None for h in handles)
+        lp = body['choices'][0]['logprobs']
+        assert lp['top_logprobs'] and all(t for t in lp['top_logprobs'])
+
+    def test_chosen_logprobs_still_served_without_topk(self, engine):
+        """logprobs=0 (chosen-token only) needs no top-k tensors —
+        and still returns real logprob values."""
+        async def fn(client):
+            r = await client.post('/v1/completions', json={
+                'prompt': [7, 8, 9], 'max_tokens': 4, 'temperature': 0,
+                'ignore_eos': True, 'logprobs': 0})
+            assert r.status == 200
+            return await r.json()
+
+        body = _with_client(engine, fn)
+        lp = body['choices'][0]['logprobs']
+        assert len(lp['token_logprobs']) == 4
+        assert all(v < 0 for v in lp['token_logprobs'])
+        assert lp['top_logprobs'] is None
+
+
+class TestDeviceResidentLast:
+
+    def test_device_last_matches_host_mirror_after_finishes(
+            self, engine):
+        """After stop-token and length finishes (including mid-chunk
+        stops, which the collect half re-pins), the device-resident
+        `last` carry equals the host mirror on every row."""
+        async def fn(client):
+            # Length finish.
+            r = await client.post('/generate', json={
+                'tokens': [1, 3, 5, 7], 'max_new_tokens': 11})
+            full = (await r.json())['tokens']
+            assert len(full) == 11
+            # Stop-token finish mid-generation (stop at a token the
+            # greedy continuation actually emits, past the first).
+            stop = full[4]
+            r2 = await client.post('/generate', json={
+                'tokens': [1, 3, 5, 7], 'max_new_tokens': 11,
+                'stop_token_ids': [stop]})
+            body = await r2.json()
+            assert body['finish_reason'] == 'stop'
+            return body
+
+        _with_client(engine, fn)
+        np.testing.assert_array_equal(np.asarray(engine.last_dev),
+                                      engine.last)
+
+    def test_admission_seeds_device_last(self, engine):
+        """The admit jits thread the device `last` carry: right after
+        serving, device == mirror on the slots the requests used."""
+        async def fn(client):
+            rs = await asyncio.gather(*[
+                client.post('/generate', json={'tokens': [i + 1] * 8,
+                                               'max_new_tokens': 2})
+                for i in range(4)])
+            assert all(r.status == 200 for r in rs)
+
+        _with_client(engine, fn)
+        np.testing.assert_array_equal(np.asarray(engine.last_dev),
+                                      engine.last)
+
+
+class TestAdmitGroupsInvariant:
+
+    def test_power_of_two_same_bucket_partition(self):
+        """Property test over random arrival patterns: _admit_groups
+        must PARTITION the items (no loss, no duplication), every
+        group must share one prompt bucket, and group sizes must be
+        powers of two ≤ MAX_BATCH, largest-first within a bucket."""
+        rng = random.Random(1234)
+        for trial in range(50):
+            n = rng.randint(1, 2 * engine_lib.MAX_BATCH)
+            items = []
+            for j in range(n):
+                length = rng.randint(1, 300)
+                items.append(([j] * length, 4, 0.0, None, None, 0.0,
+                              0.0, (), False, None, None))
+            groups = engine_lib.InferenceEngine._admit_groups(items)
+            flat = [it for g in groups for it in g]
+            assert sorted(it[0][0] for it in flat) == \
+                sorted(it[0][0] for it in items), trial
+            sizes_by_bucket = {}
+            for g in groups:
+                buckets = {engine_lib._bucket(len(it[0])) for it in g}
+                assert len(buckets) == 1, (trial, buckets)
+                size = len(g)
+                assert size <= engine_lib.MAX_BATCH
+                assert size & (size - 1) == 0, (trial, size)
+                sizes_by_bucket.setdefault(buckets.pop(),
+                                           []).append(size)
+            for bucket, sizes in sizes_by_bucket.items():
+                assert sizes == sorted(sizes, reverse=True), \
+                    (trial, bucket, sizes)
+
+
+class TestEngineMetrics:
+
+    def test_registry_metrics_exposed_after_traffic(self, engine):
+        """The engine's /metrics is rendered from the observe registry:
+        pipeline histograms and hot-path counters appear with real
+        samples after traffic; gauges are sampled at scrape time."""
+        async def fn(client):
+            await client.post('/generate', json={
+                'tokens': [2, 4, 6, 8], 'max_new_tokens': 10})
+            r = await client.get('/metrics')
+            assert r.status == 200
+            return await r.text()
+
+        text = _with_client(engine, fn)
+        for needle in (
+                'skytpu_engine_step_seconds_bucket',
+                'skytpu_engine_step_seconds_count{phase="dispatch"}',
+                'skytpu_engine_step_seconds_count{phase="collect"}',
+                'skytpu_engine_host_sync_seconds_sum',
+                'skytpu_engine_admit_seconds_count',
+                'skytpu_engine_tokens_total',
+                'skytpu_engine_steps_total',
+                'skytpu_engine_requests_total',
+                'skytpu_engine_queue_depth 0',
+                '# TYPE skytpu_engine_step_seconds histogram',
+        ):
+            assert needle in text, needle

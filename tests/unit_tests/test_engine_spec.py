@@ -73,9 +73,14 @@ class TestEngineSpeculative:
         prompts = [REPEAT, [9, 9, 9, 9, 9, 9, 9], [3, 1, 4, 1, 5, 9]]
 
         async def collect(client):
+            # 24 tokens (not a handful): a no-draft probe now pauses
+            # speculation for SPEC_NO_DRAFT_COOLDOWN steps and hands
+            # the pool to the overlap pipeline, so speculation needs a
+            # few pipelined chunks of room before the model's own
+            # repetition produces drafts and a verify round fires.
             rs = await asyncio.gather(*[
                 client.post('/generate', json={'tokens': p,
-                                               'max_new_tokens': 12})
+                                               'max_new_tokens': 24})
                 for p in prompts])
             return [await r.json() for r in rs]
 
@@ -154,9 +159,12 @@ class TestEngineSpeculative:
 
         async def fn(client):
             # The model's greedy continuation won't follow the prompt's
-            # synthetic pattern on the first round → low accept.
+            # synthetic pattern on the first round → low accept. 48
+            # tokens of room: early no-draft probes pause speculation
+            # (SPEC_NO_DRAFT_COOLDOWN) while the pipeline runs, so the
+            # firing round happens a few chunks in.
             await client.post('/generate', json={
-                'tokens': REPEAT, 'max_new_tokens': 10})
+                'tokens': REPEAT, 'max_new_tokens': 48})
             return eng.spec_rounds, eng._spec_cool
 
         rounds, cool = _with_client(eng, fn)

@@ -35,8 +35,8 @@ PKG = os.path.join(REPO, 'skypilot_tpu')
 # below — the gate test fails loudly otherwise.
 EXPECTED_CHECKS = [
     'layers', 'lazy-imports', 'async-blocking', 'jit-hazards',
-    'sqlite-discipline', 'state-machine', 'thread-discipline',
-    'silent-except', 'metric-discipline',
+    'host-sync-loop', 'sqlite-discipline', 'state-machine',
+    'thread-discipline', 'silent-except', 'metric-discipline',
 ]
 
 
@@ -266,6 +266,94 @@ class TestJitHazardChecker:
                 return float(x) + np.asarray(x).item()
         ''')
         assert _run(tmp_path, checks=['jit-hazards'])['total'] == 0
+
+
+# ------------------------------------------------------------ host-sync loops
+
+class TestHostSyncLoopChecker:
+    """Unconditional jax.device_get in serve//models/ loop bodies —
+    the scheduler-loop anti-pattern the engine's double-buffered
+    decode pipeline removed (docs/ENGINE.md)."""
+
+    def test_device_get_in_while_true_loop_flagged(self, tmp_path):
+        # The pre-pipeline batch loop's exact shape: an infinite
+        # scheduler loop whose step helper device_gets every
+        # iteration (through asyncio.to_thread — the function is an
+        # ARGUMENT there, but it runs once per iteration all the
+        # same), plus a direct fetch in a range() loop.
+        _write(tmp_path, 'serve/loopy.py', '''\
+            import asyncio
+            import jax
+
+            class Engine:
+                def _step_once(self, k):
+                    out = self._jit(k)
+                    return jax.device_get(out)
+
+                async def batch_loop(self):
+                    while True:
+                        await asyncio.to_thread(self._step_once, 1)
+
+            def drain(xs):
+                for i in range(8):
+                    jax.device_get(xs[i])
+
+            def flush(step, xs):
+                while True:
+                    try:
+                        step()
+                    finally:
+                        jax.device_get(xs)   # finally runs EVERY pass
+        ''')
+        report = _run(tmp_path, checks=['host-sync-loop'])
+        assert sorted(v['key'] for v in report['violations']) == [
+            '_step_once->jax.device_get', 'jax.device_get',
+            'jax.device_get']
+
+    def test_pipelined_conditional_and_data_dependent_ok(self, tmp_path):
+        # Clean shapes: a data-dependent while (the fetched value
+        # decides continuation — speculative-verify style), a fetch
+        # guarded by an if, a loop with a break, and device_get
+        # OUTSIDE any loop. None are the anti-pattern.
+        _write(tmp_path, 'models/clean.py', '''\
+            import jax
+            import numpy as np
+
+            def speculative(step, n):
+                count = 0
+                while count < n:
+                    greedy = np.asarray(jax.device_get(step()))
+                    count = count + int(greedy.sum())
+                return count
+
+            def guarded(xs, want):
+                for i in range(8):
+                    if want:
+                        jax.device_get(xs[i])
+
+            def scan_until(step):
+                while True:
+                    out = jax.device_get(step())
+                    if out:
+                        break
+
+            def once(x):
+                return jax.device_get(x)
+        ''')
+        assert _run(tmp_path, checks=['host-sync-loop'])['total'] == 0
+
+    def test_out_of_scope_units_exempt(self, tmp_path):
+        # The rule binds the serving/model hot paths only — a training
+        # or tooling loop that syncs per iteration (metrics printing)
+        # is not the serving anti-pattern.
+        _write(tmp_path, 'train/loop.py', '''\
+            import jax
+
+            def fit(step, steps):
+                for i in range(steps):
+                    print(jax.device_get(step(i)))
+        ''')
+        assert _run(tmp_path, checks=['host-sync-loop'])['total'] == 0
 
 
 # ------------------------------------------------------------ async multi-hop
@@ -916,7 +1004,7 @@ class TestLivePackage:
         with open(out_path, encoding='utf-8') as f:
             report = json.load(f)
         # Schema stability (version-bump ratchet).
-        assert report['skylint_version'] == core.REPORT_VERSION == 3
+        assert report['skylint_version'] == core.REPORT_VERSION == 4
         assert set(report) == {
             'skylint_version', 'root', 'files_scanned', 'checks',
             'violations', 'total', 'allowlisted', 'new',
