@@ -484,10 +484,50 @@ def run_serve_bench():
     }), flush=True)
 
 
+def _histogram_quantile(text: str, family: str, q: float) -> float:
+    """Prometheus-style histogram_quantile over one family's buckets
+    (no labels): linear interpolation inside the bucket the q-th
+    sample lands in — the same estimate a dashboard would show.
+    Returns nan when the family has no samples."""
+    buckets = []
+    prefix = f'{family}_bucket{{le="'
+    for line in text.splitlines():
+        if not line.startswith(prefix):
+            continue
+        le_str = line[len(prefix):].split('"', 1)[0]
+        le = float('inf') if le_str == '+Inf' else float(le_str)
+        try:
+            buckets.append((le, float(line.rsplit(' ', 1)[1])))
+        except ValueError:
+            pass
+    buckets.sort()
+    if not buckets or buckets[-1][1] <= 0:
+        return float('nan')
+    count = buckets[-1][1]
+    rank = q * count
+    lo_bound = lo_count = 0.0
+    for le, cum in buckets:
+        if cum >= rank:
+            if le == float('inf'):
+                # Open-ended tail: the lower bound is the honest
+                # answer (Prometheus returns the last finite bound).
+                return lo_bound
+            span_count = cum - lo_count
+            frac = ((rank - lo_count) / span_count) if span_count else 0
+            return lo_bound + (le - lo_bound) * frac
+        lo_bound, lo_count = le, cum
+    return lo_bound
+
+
 def _scrape_host_overhead(port: int) -> dict:
     """Pull skytpu_engine_* pipeline sums from the live engine's
-    /metrics and reduce them to per-token milliseconds. Best-effort:
-    a scrape failure returns {} rather than failing the bench."""
+    /metrics and reduce them to per-token milliseconds, plus the
+    engine's OWN request-latency decomposition — TTFT/TPOT p50/p95
+    from the skytpu_engine_ttft/tpot_seconds histograms (derived from
+    flight-ring deltas at publish time, so they exclude client/HTTP
+    overhead the driver-side numbers include). Best-effort: a scrape
+    failure returns {} rather than failing the bench."""
+    import math
     import urllib.request
 
     def _value(text: str, prefix: str) -> float:
@@ -512,10 +552,17 @@ def _scrape_host_overhead(port: int) -> dict:
     sync_s = _value(text, 'skytpu_engine_host_sync_seconds_sum')
     disp_s = _value(text, 'skytpu_engine_step_seconds_sum'
                           '{phase="dispatch"}')
-    return {
+    out = {
         'host_sync_ms_per_tok': round(sync_s / tokens * 1e3, 4),
         'dispatch_ms_per_tok': round(disp_s / tokens * 1e3, 4),
     }
+    for family, key in (('skytpu_engine_ttft_seconds', 'engine_ttft_ms'),
+                        ('skytpu_engine_tpot_seconds', 'engine_tpot_ms')):
+        for q, suffix in ((0.50, 'p50'), (0.95, 'p95')):
+            v = _histogram_quantile(text, family, q)
+            if not math.isnan(v):
+                out[f'{key}_{suffix}'] = round(v * 1e3, 2)
+    return out
 
 
 def _next_pow2(n: int) -> int:

@@ -15,6 +15,7 @@ from skypilot_tpu.analysis import lazy_imports
 from skypilot_tpu.analysis import layers
 from skypilot_tpu.analysis import metric_discipline
 from skypilot_tpu.analysis import silent_except
+from skypilot_tpu.analysis import span_discipline
 from skypilot_tpu.analysis import sqlite_discipline
 from skypilot_tpu.analysis import state_integrity
 from skypilot_tpu.analysis import thread_discipline
@@ -32,6 +33,7 @@ ALL: List[Tuple[str, CheckerFn]] = [
     (thread_discipline.NAME, thread_discipline.run),
     (silent_except.NAME, silent_except.run),
     (metric_discipline.NAME, metric_discipline.run),
+    (span_discipline.NAME, span_discipline.run),
 ]
 
 

@@ -26,8 +26,10 @@ PACKAGE = 'skypilot_tpu'
 # state-machine, thread-discipline, silent-except; v3:
 # metric-discipline — observe-plane naming + label cardinality; v4:
 # host-sync-loop — no unconditional device_get in serve/models loop
-# bodies, the decode-pipeline anti-pattern).
-REPORT_VERSION = 4
+# bodies, the decode-pipeline anti-pattern; v5: span-discipline — no
+# leaked spans.start/span, no span/journal writes in the engine's hot
+# loop bodies).
+REPORT_VERSION = 5
 
 
 @dataclasses.dataclass

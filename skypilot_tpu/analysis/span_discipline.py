@@ -1,0 +1,237 @@
+"""Span-tree discipline: no leaked spans, no span/journal writes on
+the engine's hot loop.
+
+Two contracts from docs/OBSERVABILITY.md, enforced statically:
+
+  1. **No leaked spans.** ``spans.start(...)`` / ``spans.span(...)``
+     must be used as a context manager (``with spans.span(...):``) —
+     a bare call records a start and never a finish, so the span
+     silently vanishes from every ``/v1/traces`` tree (the write-behind
+     queue only sees FINISHED spans). Hops whose endpoints are not
+     lexically scoped have the sanctioned escape hatch
+     ``spans.record(...)`` (retroactive, duration supplied).
+  2. **Hot loop records ring tuples only.** Inside
+     ``serve/engine.py``'s ``InferenceEngine`` methods — the batch
+     loop and everything multi-host followers replay — no span
+     recording or journal write may execute in a loop body: at target
+     TPOT (a few ms/token) a dict-allocating span or a sqlite INSERT
+     per iteration is telemetry stealing double-digit percentages of
+     the serving budget. The hot path's recorder is the preallocated
+     flight ring (observe/flight.py: one counter bump + one slot
+     store); spans derive AFTER the request finishes, off the loop
+     (``pop_timing`` → the HTTP handler). Exception-handler bodies are
+     exempt — a failure reset snapshotting the ring into the journal
+     is the post-mortem path, not the hot path — and one same-module
+     helper hop is followed (including the ``asyncio.to_thread(f,
+     ...)`` idiom the batch loop dispatches device work through).
+
+Scope: rule 1 applies to every module importing
+``skypilot_tpu.observe`` (the ``spans``/``spans_lib`` aliases); rule 2
+to ``serve/engine.py``. The ``observe`` package itself and
+``analysis`` (fixtures/prose) are exempt.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis import metric_discipline
+
+NAME = 'span-discipline'
+
+_SPAN_BASES = frozenset({'spans', 'spans_lib'})
+_SPAN_SCOPED = frozenset({'span', 'start'})
+# Everything that persists telemetry: span recording (scoped,
+# retroactive, queue flush) and journal writes (direct or via the
+# flight-ring snapshot helper).
+_SPAN_WRITES = frozenset({'span', 'start', 'record', 'flush', 'traced'})
+_JOURNAL_BASES = frozenset({'journal', 'journal_lib'})
+_JOURNAL_WRITES = frozenset({'record_event', 'record_transition'})
+_SNAPSHOT = 'snapshot_to_journal'
+_EXECUTOR_TAILS = frozenset({'to_thread', 'run_in_executor'})
+
+_ENGINE_PATH = 'serve/engine.py'
+_ENGINE_CLASS = 'InferenceEngine'
+
+
+def _is_span_write(call: ast.Call) -> Optional[str]:
+    """The dotted name when this call records a span or writes the
+    journal, else None."""
+    dotted = core.dotted_name(call.func) or ''
+    parts = dotted.split('.')
+    if len(parts) < 2:
+        return None
+    base, attr = set(parts[:-1]), parts[-1]
+    if base & _SPAN_BASES and attr in _SPAN_WRITES:
+        return dotted
+    if base & _JOURNAL_BASES and attr in _JOURNAL_WRITES:
+        return dotted
+    if attr == _SNAPSHOT:
+        return dotted
+    return None
+
+
+def _is_scoped_span_call(call: ast.Call) -> bool:
+    dotted = core.dotted_name(call.func) or ''
+    parts = dotted.split('.')
+    return (len(parts) >= 2 and parts[-1] in _SPAN_SCOPED and
+            bool(set(parts[:-1]) & _SPAN_BASES))
+
+
+def _with_context_ids(tree: ast.AST) -> Set[int]:
+    """id() of every expression used as a ``with`` item context — the
+    sanctioned position for spans.span()/start()."""
+    out: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                out.add(id(item.context_expr))
+    return out
+
+
+def _calls_outside_handlers(body: List[ast.stmt]) -> List[ast.Call]:
+    """Call nodes in these statements, skipping exception-handler
+    bodies (the failure path is not the hot path) and nested function
+    definitions/lambdas (defining is not executing)."""
+    out: List[ast.Call] = []
+
+    def walk_expr(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                continue
+            walk_expr(child)
+        if isinstance(node, ast.Call):
+            out.append(node)
+
+    for stmt in body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(stmt, ast.Try):
+            out.extend(_calls_outside_handlers(stmt.body))
+            out.extend(_calls_outside_handlers(stmt.orelse))
+            out.extend(_calls_outside_handlers(stmt.finalbody))
+            continue
+        if isinstance(stmt, (ast.If,)):
+            walk_expr(stmt.test)
+            out.extend(_calls_outside_handlers(stmt.body))
+            out.extend(_calls_outside_handlers(stmt.orelse))
+            continue
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            out.extend(_calls_outside_handlers(stmt.body))
+            out.extend(_calls_outside_handlers(stmt.orelse))
+            continue
+        if isinstance(stmt, (ast.With, ast.AsyncWith)):
+            for item in stmt.items:
+                walk_expr(item.context_expr)
+            out.extend(_calls_outside_handlers(stmt.body))
+            continue
+        walk_expr(stmt)
+    return out
+
+
+def _callee_name(call: ast.Call) -> Optional[str]:
+    """Same-module callee: ``f(...)``, ``self.f(...)``, and the
+    executor idioms (``asyncio.to_thread(f, ...)`` — the function runs
+    per iteration all the same)."""
+    func = call.func
+    dotted = core.dotted_name(func) or ''
+    tail = dotted.split('.')[-1] if dotted else ''
+    if tail in _EXECUTOR_TAILS:
+        args = call.args
+        if tail == 'run_in_executor':
+            args = args[1:]
+        if args:
+            target = args[0]
+            if isinstance(target, ast.Name):
+                return target.id
+            if isinstance(target, ast.Attribute):
+                return target.attr
+        return None
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute) and \
+            isinstance(func.value, ast.Name) and func.value.id == 'self':
+        return func.attr
+    return None
+
+
+def _engine_loop_violations(mod: core.ModuleInfo) -> List[core.Violation]:
+    cls = next((n for n in mod.tree.body
+                if isinstance(n, ast.ClassDef) and
+                n.name == _ENGINE_CLASS), None)
+    if cls is None:
+        return []
+    methods: Dict[str, ast.AST] = {}
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            methods.setdefault(node.name, node)
+    # Methods whose non-handler body writes spans/journal — the one-hop
+    # targets a loop body must not call.
+    writing: Dict[str, str] = {}
+    for name, fn in methods.items():
+        for call in _calls_outside_handlers(fn.body):
+            write = _is_span_write(call)
+            if write is not None:
+                writing[name] = write
+                break
+    out: List[core.Violation] = []
+    seen = set()
+    for loop in ast.walk(cls):
+        if not isinstance(loop, (ast.While, ast.For, ast.AsyncFor)):
+            continue
+        for call in _calls_outside_handlers(loop.body):
+            key = why = None
+            write = _is_span_write(call)
+            if write is not None:
+                key = write
+                why = ('records a span / writes the journal every '
+                       'iteration of an engine loop')
+            else:
+                callee = _callee_name(call)
+                if callee in writing:
+                    key = f'{callee}->{writing[callee]}'
+                    why = (f'calls {callee!r} (which writes '
+                           f'{writing[callee]}) from an engine loop '
+                           f'body')
+            if key is None or (key, call.lineno) in seen:
+                continue
+            seen.add((key, call.lineno))
+            out.append(core.Violation(
+                check=NAME, path=mod.path, line=call.lineno,
+                col=call.col_offset, key=f'hot-loop:{key}',
+                message=(f'{key!r} in an {_ENGINE_CLASS} loop body: '
+                         f'{why} — the decode hot path records '
+                         f'flight-ring tuples only '
+                         f'(observe/flight.py); derive spans after '
+                         f'the request finishes (pop_timing) or move '
+                         f'the write to a failure handler')))
+    return out
+
+
+def run(mod: core.ModuleInfo) -> List[core.Violation]:
+    if mod.unit in ('analysis', 'observe'):
+        return []
+    if not metric_discipline._imports_observe(mod.tree):
+        return []
+    out: List[core.Violation] = []
+    with_ctx = _with_context_ids(mod.tree)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _is_scoped_span_call(node) and id(node) not in with_ctx:
+            dotted = core.dotted_name(node.func)
+            out.append(core.Violation(
+                check=NAME, path=mod.path, line=node.lineno,
+                col=node.col_offset, key=f'leaked-span:{dotted}',
+                message=(
+                    f'{dotted}(...) not used as a context manager: a '
+                    f'span with no paired finish never persists (the '
+                    f'write-behind queue sees finished spans only) — '
+                    f'use `with {dotted}(...):`, or spans.record() '
+                    f'for hops whose endpoints are not lexically '
+                    f'scoped')))
+    if mod.path == _ENGINE_PATH:
+        out.extend(_engine_loop_violations(mod))
+    return out

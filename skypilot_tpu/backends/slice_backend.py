@@ -442,6 +442,7 @@ class TpuSliceBackend(backend_lib.Backend[SliceResourceHandle]):
             from skypilot_tpu.data import storage as storage_lib
             epilogue = list(storage_lib.flush_commands(
                 handle, task.storage_mounts).values())
+        from skypilot_tpu.observe import spans as spans_lib
         from skypilot_tpu.observe import trace as trace_lib
         spec = {
             'job_id': job_id,
@@ -452,10 +453,13 @@ class TpuSliceBackend(backend_lib.Backend[SliceResourceHandle]):
             'chips_per_host': sl.chips_per_host if sl else 1,
             'num_slices': sl.num_slices if sl else 1,
             'epilogue_cmds': epilogue,
-            # The control-plane trace crosses to the cluster inside the
-            # spec (env does not survive the ssh/detach boundary); the
-            # driver re-exports it into every rank via gang_env.
+            # The control-plane trace AND span parent cross to the
+            # cluster inside the spec (env does not survive the
+            # ssh/detach boundary); the driver re-exports both into
+            # every rank via gang_env, so on-cluster spans nest under
+            # the launching request's tree in /v1/traces.
             'trace_id': trace_lib.get(),
+            'parent_span_id': spans_lib.current(),
         }
         from skypilot_tpu.utils import docker_utils
         docker_image = docker_utils.docker_image_of(launched.image_id)

@@ -59,12 +59,27 @@ def _execute(
 ) -> Tuple[Optional[int], Optional[slice_backend.SliceResourceHandle]]:
     """Run the requested stages for a single task. Returns (job_id, handle)."""
     from skypilot_tpu import config as config_lib
-    with config_lib.override(task.config_overrides):
-        return _execute_inner(
-            task, cluster_name=cluster_name, stages=stages, dryrun=dryrun,
-            detach_run=detach_run, optimize_target=optimize_target,
-            down=down, retry_until_up=retry_until_up,
-            blocked_resources=blocked_resources)
+    from skypilot_tpu.observe import spans
+    from skypilot_tpu.observe import trace
+
+    def _run():
+        with config_lib.override(task.config_overrides):
+            return _execute_inner(
+                task, cluster_name=cluster_name, stages=stages, dryrun=dryrun,
+                detach_run=detach_run, optimize_target=optimize_target,
+                down=down, retry_until_up=retry_until_up,
+                blocked_resources=blocked_resources)
+
+    if trace.get() is not None:
+        # Server mode (or a controller): the API ingress already minted
+        # the trace and the executor opened the root span.
+        return _run()
+    # Client-side ingress: the CLI/SDK called straight into the library
+    # (hermetic local mode) — without a root minted here, every
+    # optimize/provision/setup span lands traceless and orphaned.
+    with trace.trace_context():
+        with spans.span('client.execute', attrs={'cluster': cluster_name}):
+            return _run()
 
 
 def _execute_inner(

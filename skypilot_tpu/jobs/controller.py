@@ -163,7 +163,11 @@ class JobsController:
             logger.info(f'[job {self.job_id}] user code failed; restarting '
                         f'(max_restarts_on_errors={max_restarts}).')
             state.set_recovering(self.job_id)
-            new_id = self.strategy.recover()
+            from skypilot_tpu.observe import spans
+            with spans.span('jobs.recover',
+                            attrs={'job_id': self.job_id,
+                                   'why': 'user_code_failure'}):
+                new_id = self.strategy.recover()
             state.set_recovered(self.job_id, new_id)
             return True, new_id
         return False, cluster_job_id
@@ -263,7 +267,14 @@ class JobsController:
             logger.info(f'[job {job_id}] launching as '
                         f'{self.cluster_name!r}')
             try:
-                cluster_job_id = self.strategy.launch()
+                # The stage-launch span: optimizer/provision/driver
+                # child spans (same process + subprocess env carrier)
+                # nest under it in /v1/traces.
+                from skypilot_tpu.observe import spans
+                with spans.span('jobs.launch',
+                                attrs={'job_id': job_id,
+                                       'cluster': self.cluster_name}):
+                    cluster_job_id = self.strategy.launch()
                 self._sync_cluster_name()
             except recovery_strategy.JobCancelledDuringRecovery:
                 # Cancelled while queued for a pool worker.
@@ -296,7 +307,11 @@ class JobsController:
                 logger.info(f'[job {job_id}] cluster lost — recovering')
                 state.set_recovering(job_id)
                 try:
-                    cluster_job_id = self.strategy.recover()
+                    from skypilot_tpu.observe import spans
+                    with spans.span('jobs.recover',
+                                    attrs={'job_id': job_id,
+                                           'why': 'cluster_lost'}):
+                        cluster_job_id = self.strategy.recover()
                 except exceptions.ManagedJobReachedMaxRetriesError as e:
                     state.set_terminal(
                         job_id, state.ManagedJobStatus.FAILED_NO_RESOURCE,

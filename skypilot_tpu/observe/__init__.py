@@ -1,6 +1,6 @@
 """skypilot_tpu.observe — the unified observability plane.
 
-Three pieces, stdlib-only (plus ``utils``), importable from every
+Five pieces, stdlib-only (plus ``utils``), importable from every
 layer of the control plane:
 
   * :mod:`~skypilot_tpu.observe.metrics` — a thread-safe registry of
@@ -14,13 +14,42 @@ layer of the control plane:
   * :mod:`~skypilot_tpu.observe.trace` — contextvar/env-carried trace
     IDs minted per API request and threaded through controllers,
     recovery, backends and the slice driver's gang env, stamped onto
-    journal events, timeline spans and usage events.
+    journal events, timeline spans and usage events;
+  * :mod:`~skypilot_tpu.observe.spans` — timed span trees keyed by
+    those trace IDs: queue wait, optimizer plan, per-zone provision
+    attempts, LB/engine hops — one request's latency decomposed at
+    ``/v1/traces/<trace_id>`` (write-behind persistence into a
+    ``spans`` table in the journal DB);
+  * :mod:`~skypilot_tpu.observe.flight` — the engine hot loop's
+    fixed-size lock-free event ring (``/debug/flight``; snapshotted
+    into the journal on engine failures), from which per-request
+    TTFT/TPOT derive without a single span or sqlite write per token.
 
-See docs/OBSERVABILITY.md for the metric catalog, journal schema and
-the trace propagation diagram.
+See docs/OBSERVABILITY.md for the metric catalog, journal/span schema
+and the trace propagation diagram.
 """
+from typing import Dict
+
+from skypilot_tpu.observe import flight
 from skypilot_tpu.observe import journal
 from skypilot_tpu.observe import metrics
+from skypilot_tpu.observe import spans
 from skypilot_tpu.observe import trace
 
-__all__ = ['journal', 'metrics', 'trace']
+__all__ = ['flight', 'gc', 'journal', 'metrics', 'spans', 'trace']
+
+
+def gc(max_age_seconds: float = 7 * 24 * 3600,
+       max_rows: int = 500_000) -> Dict[str, int]:
+    """Retention for BOTH journal tables (events + spans), one call —
+    the API server's hourly GC loop and the serve controller's
+    reconcile loop both run it, so every process that writes the
+    journal also collects it (events and spans accrue in whichever
+    process's DB the writer saw; GC only in the API server would leak
+    the controller- and LB-written rows forever). Same Nth-newest-id
+    row-cap discipline in both tables; best-effort like every
+    telemetry write."""
+    return {'events': journal.gc_events(max_age_seconds=max_age_seconds,
+                                        max_rows=max_rows),
+            'spans': spans.gc_spans(max_age_seconds=max_age_seconds,
+                                    max_rows=max_rows)}

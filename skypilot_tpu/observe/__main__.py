@@ -4,12 +4,20 @@ Commands:
   tail     — last N journal events, human-readable or --json
   events   — filtered journal query (--machine/--entity/--trace/
              --kind/--since/--limit)
+  trace    — one request's latency decomposition: the rooted span
+             tree with durations and % of parent. ``--url`` fetches a
+             live ``/v1/traces/<id>`` (API server) or
+             ``/-/lb/trace/<id>`` (serve LB) endpoint; without it the
+             local journal DB is read directly (``--db`` overrides
+             the path)
   metrics  — dump Prometheus exposition: --url fetches a live
              ``/metrics`` endpoint (API server, serve LB); without
              --url, renders THIS process's registry (useful from
              tests/REPLs, empty in a fresh CLI process)
   export   — write matching journal events as JSONL through the
-             shared rotating writer
+             shared rotating writer; ``--chrome`` writes the span
+             tables merged with any timeline capture as Chrome
+             trace-event JSON instead (load in Perfetto)
 
 Exit codes: 0 ok, 2 usage error.
 """
@@ -17,12 +25,14 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.observe import journal
 from skypilot_tpu.observe import metrics
+from skypilot_tpu.observe import spans as spans_lib
 
 
 def _fmt_event(e: Dict[str, Any]) -> str:
@@ -62,6 +72,24 @@ def _query_args(args: argparse.Namespace) -> Dict[str, Any]:
     return out
 
 
+def _fetch_tree(trace_id: str, url: Optional[str],
+                db: Optional[str]) -> Dict[str, Any]:
+    """The span tree for one trace: from a live endpoint (--url: an
+    API server's /v1/traces or a serve LB's /-/lb/trace — a bare
+    host:port gets the API-server path) or straight from the journal
+    DB this process can see (--db repoints it)."""
+    if url is not None:
+        from urllib import request as urlrequest
+        target = url if '://' in url else f'http://{url}'
+        if not target.rstrip('/').endswith(trace_id):
+            target = f'{target.rstrip("/")}/v1/traces/{trace_id}'
+        with urlrequest.urlopen(target, timeout=10) as resp:
+            return json.loads(resp.read().decode('utf-8'))
+    if db is not None:
+        os.environ['SKYTPU_OBSERVE_DB'] = db
+    return spans_lib.tree(trace_id)
+
+
 def _fetch_metrics(url: Optional[str]) -> str:
     if url is None:
         return metrics.render()
@@ -93,6 +121,18 @@ def build_parser() -> argparse.ArgumentParser:
     p_events.add_argument('--limit', type=int, default=1000)
     p_events.add_argument('--json', action='store_true')
 
+    p_trace = sub.add_parser('trace',
+                             help='span tree for one trace id')
+    p_trace.add_argument('trace_id')
+    p_trace.add_argument('--url', default=None,
+                         help='fetch a live trace endpoint (host:port '
+                              'or full URL; bare hosts get '
+                              '/v1/traces/<id> appended)')
+    p_trace.add_argument('--db', default=None,
+                         help='read this journal DB instead of the '
+                              'default local one (no --url)')
+    p_trace.add_argument('--json', action='store_true')
+
     p_metrics = sub.add_parser('metrics',
                                help='Prometheus exposition dump')
     p_metrics.add_argument('--url', default=None,
@@ -101,6 +141,10 @@ def build_parser() -> argparse.ArgumentParser:
 
     p_export = sub.add_parser('export', help='journal -> JSONL')
     p_export.add_argument('--out', required=True)
+    p_export.add_argument('--chrome', action='store_true',
+                          help='write Chrome trace-event JSON (spans '
+                               'merged with any timeline capture) '
+                               'instead of journal JSONL')
     p_export.add_argument('--machine')
     p_export.add_argument('--entity')
     p_export.add_argument('--trace')
@@ -123,10 +167,41 @@ def main(argv=None) -> int:
             print(f'observe: could not fetch metrics: {e}',
                   file=sys.stderr)
             return 2
+    elif args.cmd == 'trace':
+        try:
+            result = _fetch_tree(args.trace_id, args.url, args.db)
+        except (OSError, ValueError) as e:
+            print(f'observe: could not fetch trace: {e}',
+                  file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(result, indent=2))
+        else:
+            print(spans_lib.format_tree(result))
     elif args.cmd == 'export':
-        n = journal.export_jsonl(args.out, **_query_args(args))
-        print(f'observe: wrote {n} event(s) to {args.out}',
-              file=sys.stderr)
+        if args.chrome:
+            # chrome_trace filters by trace id only — refuse the other
+            # filters instead of writing the whole table while the
+            # user believes it was narrowed.
+            ignored = [f'--{k}' for k in
+                       ('machine', 'entity', 'kind', 'since')
+                       if getattr(args, k, None) is not None]
+            if ignored:
+                print(f'observe: --chrome supports --trace only '
+                      f'(got {", ".join(ignored)})', file=sys.stderr)
+                return 2
+            doc = spans_lib.chrome_trace(trace_id=args.trace,
+                                         limit=args.limit)
+            with open(args.out, 'w', encoding='utf-8') as f:
+                json.dump(doc, f)
+            note = (' (hit --limit: oldest spans dropped)'
+                    if len(doc['traceEvents']) >= args.limit else '')
+            print(f'observe: wrote {len(doc["traceEvents"])} trace '
+                  f'event(s) to {args.out}{note}', file=sys.stderr)
+        else:
+            n = journal.export_jsonl(args.out, **_query_args(args))
+            print(f'observe: wrote {n} event(s) to {args.out}',
+                  file=sys.stderr)
     return 0
 
 

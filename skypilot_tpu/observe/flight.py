@@ -1,0 +1,131 @@
+"""Engine flight recorder: a fixed-size, lock-free hot-loop event ring.
+
+The decode hot loop (serve/engine.py batch loop) must not take a
+sqlite write — or even a dict-allocating span — per token: at target
+TPOT (a few ms) that is telemetry stealing double-digit percentages of
+the serving budget. This is the hot path's recorder instead: a
+PREALLOCATED ring of ``(monotonic_ns, event_code, slot, seq)`` tuples.
+The record path is one atomic counter bump (``itertools.count`` —
+CPython's C-level iterator, no lock) plus one list-slot store (a
+pointer swap under the GIL): no locks, no sqlite, no syscalls beyond
+the vDSO clock read, and — critically — NO device sync.
+
+Consumers:
+
+  * ``GET /debug/flight`` on the engine dumps the ring (newest events,
+    decoded codes) for live "what was the loop doing" inspection;
+  * ``_fail_all`` / ``_reset_device_state`` snapshot the ring into the
+    event journal automatically, so every engine failure ships its
+    last ~64k hot-loop events alongside the reset event;
+  * per-request TTFT/TPOT are derived from ring-aligned host
+    timestamps at collect/publish time (never inside the per-token
+    loop) and surface as ``skytpu_engine_ttft_seconds`` /
+    ``skytpu_engine_tpot_seconds`` histograms plus request-span attrs.
+
+Multi-host: followers run the same engine methods at the same
+op-stream points (serve/multihost.py), so each process's ring mirrors
+the leader's dispatch/collect interleaving — comparing rings across
+hosts shows where a follower fell behind.
+
+Stdlib-only; safe to import from any layer.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+# Event codes (ints in the ring; names only at dump time).
+DISPATCH = 1        # fused step enqueued; seq = k (step width)
+COLLECT = 2         # fused step consumed; seq = k
+ADMIT = 3           # a request prefilled into `slot`; seq = bucket
+FINISH = 4          # `slot` finished; seq = tokens generated
+SPEC = 5            # speculative verify round; seq = accepted tokens
+RESET = 6           # device-state rebuild (failure path)
+CANCEL = 7          # a cancel applied to `slot`
+
+CODE_NAMES: Dict[int, str] = {
+    DISPATCH: 'dispatch', COLLECT: 'collect', ADMIT: 'admit',
+    FINISH: 'finish', SPEC: 'spec', RESET: 'reset', CANCEL: 'cancel',
+}
+
+_CAPACITY_ENV = 'SKYTPU_FLIGHT_CAPACITY'
+DEFAULT_CAPACITY = 65536
+
+
+class FlightRecorder:
+    """Fixed-capacity ring of hot-loop events.
+
+    Concurrent writers are safe with no lock: each ``record`` claims a
+    unique monotonically-increasing index from the shared counter and
+    stores one immutable tuple into its slot — overwrites only ever
+    replace the OLDEST entries (index mod capacity), so a wraparound
+    loses nothing but them. ``snapshot`` reads a point-in-time copy of
+    the slots; an entry being concurrently replaced is seen as either
+    its old or its new tuple, never a torn value.
+    """
+
+    __slots__ = ('capacity', '_buf', '_ctr')
+
+    def __init__(self, capacity: Optional[int] = None):
+        if capacity is None:
+            capacity = int(os.environ.get(_CAPACITY_ENV,
+                                          str(DEFAULT_CAPACITY)))
+        if capacity < 1:
+            raise ValueError('flight ring needs capacity >= 1')
+        self.capacity = capacity
+        self._buf: List[Optional[Tuple[int, int, int, int]]] = \
+            [None] * capacity
+        self._ctr = itertools.count()
+
+    def record(self, code: int, slot: int = 0, seq: int = 0) -> None:
+        """THE hot-path call: one counter bump + one slot store."""
+        i = next(self._ctr)
+        self._buf[i % self.capacity] = (time.monotonic_ns(), code, slot,
+                                        seq)
+
+    def snapshot(self) -> List[Tuple[int, int, int, int]]:
+        """Point-in-time copy, oldest first (by monotonic timestamp —
+        ring order is index order, but a concurrent writer may have
+        replaced a slot between the copy's first and last element)."""
+        entries = [e for e in list(self._buf) if e is not None]
+        entries.sort(key=lambda e: e[0])
+        return entries
+
+    def dump(self, limit: Optional[int] = None) -> List[Dict[str, Any]]:
+        """Decoded events for the /debug/flight endpoint (newest-last;
+        ``limit`` keeps only the newest N)."""
+        entries = self.snapshot()
+        if limit is not None and limit > 0:
+            entries = entries[-limit:]
+        return [{'t_ns': ns, 'event': CODE_NAMES.get(code, str(code)),
+                 'slot': slot, 'seq': seq}
+                for ns, code, slot, seq in entries]
+
+    def clear(self) -> None:
+        """Drop every entry (tests; post-snapshot resets keep the ring
+        by default — overlapping failures should still see history)."""
+        self._buf = [None] * self.capacity
+        self._ctr = itertools.count()
+
+
+def snapshot_to_journal(recorder: FlightRecorder, *,
+                        reason: Optional[str] = None,
+                        entity: Optional[str] = None,
+                        max_events: Optional[int] = None) -> bool:
+    """Persist the ring into the event journal (kind=flight_snapshot)
+    — called from the engine's failure paths so a post-mortem has the
+    hot loop's last moments without anyone having scraped /debug/flight
+    in time. Best-effort like every journal write."""
+    entries = recorder.snapshot()
+    if not entries:
+        return False
+    if max_events is not None and max_events > 0:
+        entries = entries[-max_events:]
+    from skypilot_tpu.observe import journal
+    return journal.record_event(
+        'flight_snapshot', entity=entity, reason=reason,
+        data={'events': [list(e) for e in entries],
+              'columns': ['t_ns', 'code', 'slot', 'seq'],
+              'codes': {str(k): v for k, v in CODE_NAMES.items()}})

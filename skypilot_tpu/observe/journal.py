@@ -183,6 +183,20 @@ def filters_from_query(params: Mapping[str, str],
     return kwargs
 
 
+def entity_scope_clause(entity_scope: str) -> 'tuple[str, List[str]]':
+    """SQL predicate restricting rows to one entity subtree: the
+    entity equals the scope (the service row itself) or lives under it
+    (``scope/<replica_id>``). LIKE metachars in the scope ('_' is
+    common in service names) must not act as wildcards — that would
+    leak OTHER services' rows through the user-facing scoped LB
+    endpoints. One definition shared by the events and spans readers
+    so the escaping (a security boundary) cannot drift between them."""
+    escaped = (entity_scope.replace('\\', '\\\\')
+               .replace('%', '\\%').replace('_', '\\_'))
+    return ("(entity = ? OR entity LIKE ? || '/%' ESCAPE '\\')",
+            [entity_scope, escaped])
+
+
 def query(*, machine: Optional[str] = None, entity: Optional[str] = None,
           trace_id: Optional[str] = None, kind: Optional[str] = None,
           since: Optional[float] = None, limit: int = 1000,
@@ -201,14 +215,9 @@ def query(*, machine: Optional[str] = None, entity: Optional[str] = None,
             clauses.append(f'{col} = ?')
             params.append(val)
     if entity_scope is not None:
-        # LIKE metachars in the scope ('_' is common in service names)
-        # must not act as wildcards — that would leak OTHER services'
-        # events through the scoped LB endpoint.
-        escaped = (entity_scope.replace('\\', '\\\\')
-                   .replace('%', '\\%').replace('_', '\\_'))
-        clauses.append(
-            "(entity = ? OR entity LIKE ? || '/%' ESCAPE '\\')")
-        params.extend([entity_scope, escaped])
+        clause, scope_params = entity_scope_clause(entity_scope)
+        clauses.append(clause)
+        params.extend(scope_params)
     if since is not None:
         clauses.append('ts >= ?')
         params.append(since)

@@ -1,0 +1,571 @@
+"""Timed span trees: request-level latency decomposition.
+
+The journal (observe/journal.py) answers "what happened" — this module
+answers "where did the time go". A span is one timed hop of a request
+(queue wait, optimizer plan, a per-zone provision attempt, an LB
+upstream call, engine prefill), keyed by the existing trace IDs
+(observe/trace.py) and parented into a tree so one slow request
+decomposes across the control and serving planes
+(``/v1/traces/<trace_id>``; docs/OBSERVABILITY.md).
+
+Recording surfaces:
+
+  * ``with spans.span('server.queue_wait', attrs...):`` — the scoped
+    form. Parentage is contextvar-first (nested spans in one process),
+    then the ``SKYTPU_PARENT_SPAN_ID`` env carrier (a child process
+    parents its spans under whatever its parent exported — the same
+    two-carrier contract trace IDs use). ``spans.start(...)`` is the
+    same object un-sugared; the skylint ``span-discipline`` checker
+    flags a ``start`` that is not used as a context manager (a leaked
+    span never records its end).
+  * ``spans.record(name, start_wall=..., duration=...)`` — the
+    RETROACTIVE form, for hops whose endpoints live in different
+    processes (a queue wait starts in the API server and ends in a
+    scheduler thread) or that must not write telemetry on their hot
+    path (the engine records request spans from ring-buffer deltas
+    after the request finishes — see observe/flight.py).
+
+Persistence is WRITE-BEHIND by contract: a finished span is enqueued
+onto an in-process queue and a daemon thread batches it into a
+``spans`` table in the journal DB (same file, same BEGIN IMMEDIATE /
+sqlite-3.34-safe discipline). The traced work never blocks on — and
+can never be failed by — telemetry I/O; readers (``tree()``,
+``query_spans()``) flush the queue first so a just-finished request is
+immediately decomposable.
+
+Durations pair a wall-clock start (cross-process alignment) with a
+monotonic interval (immune to clock steps). Stdlib-only.
+"""
+from __future__ import annotations
+
+import atexit
+import contextlib
+import contextvars
+import functools
+import json
+import os
+import queue
+import sqlite3
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional
+
+from skypilot_tpu.utils import sqlite_utils
+
+from skypilot_tpu.observe import journal
+from skypilot_tpu.observe import trace
+
+ENV_PARENT = 'SKYTPU_PARENT_SPAN_ID'
+_DISABLE_ENV = 'SKYTPU_DISABLE_SPANS'
+
+# The active span id — parent for any span opened in this context.
+_CURRENT: contextvars.ContextVar[Optional[str]] = contextvars.ContextVar(
+    'skytpu_span_id', default=None)
+
+# Sampling: True while the current request was sampled OUT — scoped
+# spans still nest (cheap objects, parentage intact) but nothing is
+# enqueued for persistence.
+_SUPPRESSED: contextvars.ContextVar[bool] = contextvars.ContextVar(
+    'skytpu_span_suppress', default=False)
+
+
+def suppressed() -> bool:
+    """True when span persistence is off in this context (an unsampled
+    request). Callers that export carriers (headers, env) should skip
+    them for a suppressed request so downstream processes don't persist
+    spans the sampler dropped."""
+    return _SUPPRESSED.get()
+
+
+@contextlib.contextmanager
+def suppress():
+    """Run a request with span persistence suppressed (sampling)."""
+    token = _SUPPRESSED.set(True)
+    try:
+        yield
+    finally:
+        _SUPPRESSED.reset(token)
+
+
+def new_span_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def _enabled() -> bool:
+    return os.environ.get(_DISABLE_ENV, '0') != '1'
+
+
+def current() -> Optional[str]:
+    """The parent for a new span: contextvar first (same-process
+    nesting), then the env carrier (a parent process exported it)."""
+    sid = _CURRENT.get()
+    if sid:
+        return sid
+    return os.environ.get(ENV_PARENT) or None
+
+
+def set_parent(span_id: Optional[str]) -> 'contextvars.Token':
+    """Bind a parent span id in THIS context only (thread-mode
+    executors: the env is shared with sibling request threads, so only
+    the contextvar may carry per-request parentage)."""
+    return _CURRENT.set(span_id)
+
+
+def adopt_parent(span_id: Optional[str]) -> None:
+    """Make ``span_id`` this PROCESS's parent span: contextvar + env,
+    so every subprocess spawned from here parents its spans under it
+    (mirrors trace.adopt). Call only from dedicated per-entity
+    processes (request runner, slice driver) — never from a shared
+    server process."""
+    if not span_id:
+        return
+    _CURRENT.set(span_id)
+    os.environ[ENV_PARENT] = span_id
+
+
+def env_with_span(env: Optional[Dict[str, str]] = None) -> Dict[str, str]:
+    """A copy of ``env`` with the active span stamped in as the
+    cross-process parent carrier."""
+    out = dict(env or {})
+    sid = current()
+    if sid:
+        out[ENV_PARENT] = sid
+    return out
+
+
+class Span:
+    """One timed hop. Context-manager use records start on ``with``
+    entry (already done by ``start()``) and the duration + persistence
+    on exit; the span is also the parent scope for spans opened inside
+    the ``with`` body."""
+
+    __slots__ = ('span_id', 'trace_id', 'parent_id', 'name', 'entity',
+                 'start_wall', '_start_mono', 'attrs', '_token',
+                 '_finished')
+
+    def __init__(self, name: str, *, trace_id: Optional[str],
+                 parent_id: Optional[str], entity: Optional[str],
+                 attrs: Optional[Dict[str, Any]]):
+        self.span_id = new_span_id()
+        self.name = name
+        self.trace_id = trace_id
+        self.parent_id = parent_id
+        self.entity = entity
+        self.attrs: Dict[str, Any] = dict(attrs or {})
+        self.start_wall = time.time()
+        self._start_mono = time.monotonic()
+        self._token: Optional[contextvars.Token] = None
+        self._finished = False
+
+    def set_attr(self, key: str, value: Any) -> None:
+        self.attrs[key] = value
+
+    def finish(self) -> None:
+        """Record the end and enqueue for write-behind persistence.
+        Idempotent — a double finish records once."""
+        if self._finished:
+            return
+        self._finished = True
+        duration = time.monotonic() - self._start_mono
+        _enqueue_row(self.span_id, self.trace_id, self.parent_id,
+                     self.name, self.entity, self.start_wall, duration,
+                     self.attrs)
+
+    def __enter__(self) -> 'Span':
+        self._token = _CURRENT.set(self.span_id)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        if self._token is not None:
+            _CURRENT.reset(self._token)
+            self._token = None
+        if exc is not None and 'error' not in self.attrs:
+            self.attrs['error'] = f'{type(exc).__name__}: {exc}'
+        self.finish()
+
+
+def start(name: str, *, trace_id: Optional[str] = None,
+          parent_id: Optional[str] = None, entity: Optional[str] = None,
+          attrs: Optional[Dict[str, Any]] = None, **extra: Any) -> Span:
+    """Begin a span. Use as a context manager (``with spans.start(...)
+    as s:``) — a bare start with no paired finish leaks the span, and
+    skylint's ``span-discipline`` checker flags that shape. For spans
+    whose endpoints are not lexically scoped, use ``record()``."""
+    if trace_id is None:
+        trace_id = trace.get()
+    if parent_id is None:
+        parent_id = current()
+    merged = dict(attrs or {})
+    merged.update(extra)
+    return Span(name, trace_id=trace_id, parent_id=parent_id,
+                entity=entity, attrs=merged)
+
+
+# `span` is the documented context-manager spelling; `start` exists so
+# the lint rule has an explicit escape-hatch name to police.
+span = start
+
+
+def traced(name: str) -> Callable:
+    """Decorator form: record the wrapped call as a span (the timeline
+    ``@timeline.event`` idiom, but persisted and tree-shaped)."""
+
+    def _decorate(fn: Callable) -> Callable:
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            with start(name):
+                return fn(*args, **kwargs)
+
+        return wrapper
+
+    return _decorate
+
+
+def record(name: str, *, start_wall: float, duration: float,
+           trace_id: Optional[str] = None,
+           parent_id: Optional[str] = None,
+           span_id: Optional[str] = None,
+           entity: Optional[str] = None,
+           attrs: Optional[Dict[str, Any]] = None) -> Optional[str]:
+    """Record an already-measured span retroactively (cross-process
+    hops; hot paths that derive timings after the fact). ``span_id``
+    may be supplied when the id must be known ahead of time (e.g. the
+    API request root span is the request id, so the claim site in
+    another process can parent under it without coordination). Returns
+    the span id, or None when spans are disabled."""
+    if not _enabled():
+        return None
+    if trace_id is None:
+        trace_id = trace.get()
+    sid = span_id or new_span_id()
+    _enqueue_row(sid, trace_id, parent_id, name, entity, start_wall,
+                 max(0.0, float(duration)), dict(attrs or {}))
+    return sid
+
+
+# ------------------------------------------------------------ persistence
+
+_COLUMNS = ('span_id', 'trace_id', 'parent_id', 'name', 'entity',
+            'start_ts', 'duration', 'pid', 'attrs')
+
+# Write-behind queue: span finish is an enqueue (never sqlite I/O on
+# the traced path); one daemon worker drains it in batches. Each item
+# carries the DB path RESOLVED AT FINISH TIME so tests that repoint
+# SKYTPU_OBSERVE_DB per case stay deterministic.
+_QUEUE: 'queue.SimpleQueue' = queue.SimpleQueue()
+_WORKER_LOCK = threading.Lock()
+_WORKER: Optional[threading.Thread] = None
+_BATCH_MAX = 256
+
+
+def _enqueue_row(span_id: str, trace_id: Optional[str],
+                 parent_id: Optional[str], name: str,
+                 entity: Optional[str], start_wall: float,
+                 duration: float, attrs: Dict[str, Any]) -> None:
+    if not _enabled() or _SUPPRESSED.get():
+        return
+    row = (span_id, trace_id, parent_id, name, entity, start_wall,
+           duration, os.getpid(),
+           json.dumps(attrs, default=str) if attrs else None)
+    _QUEUE.put((journal.db_path(), row))
+    _ensure_worker()
+
+
+_ATEXIT_ARMED = False
+
+
+def _ensure_worker() -> None:
+    global _WORKER, _ATEXIT_ARMED
+    if _WORKER is not None and _WORKER.is_alive():
+        return
+    with _WORKER_LOCK:
+        if _WORKER is not None and _WORKER.is_alive():
+            return
+        if not _ATEXIT_ARMED:
+            # The worker is a daemon: a short-lived process (the CLI's
+            # hermetic local mode) can exit with spans still queued.
+            # atexit handlers run while daemon threads are still
+            # schedulable, so a bounded flush drains them.
+            atexit.register(flush, 2.0)
+            _ATEXIT_ARMED = True
+        _WORKER = threading.Thread(target=_worker_loop,
+                                   name='skytpu-span-writer',
+                                   daemon=True)
+        _WORKER.start()
+
+
+def _ensure_table(conn: sqlite3.Connection) -> None:
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS spans (
+            row_id INTEGER PRIMARY KEY AUTOINCREMENT,
+            span_id TEXT,
+            trace_id TEXT,
+            parent_id TEXT,
+            name TEXT,
+            entity TEXT,
+            start_ts REAL,
+            duration REAL,
+            pid INTEGER,
+            attrs TEXT
+        )""")
+    conn.execute('CREATE INDEX IF NOT EXISTS idx_spans_trace '
+                 'ON spans (trace_id)')
+    conn.commit()
+
+
+def _write_batch(path: str, rows: List[tuple]) -> None:
+    try:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        conn = sqlite_utils.connect_wal(path)
+        try:
+            _ensure_table(conn)
+            with sqlite_utils.immediate(conn):
+                conn.executemany(
+                    'INSERT INTO spans (span_id, trace_id, parent_id, '
+                    'name, entity, start_ts, duration, pid, attrs) '
+                    'VALUES (?,?,?,?,?,?,?,?,?)', rows)
+        finally:
+            conn.close()
+    except (sqlite3.Error, OSError):
+        # Best-effort by contract: spans describe work that already
+        # happened and must never fail (or retry-storm) it.
+        pass
+
+
+def _worker_loop() -> None:
+    while True:
+        item = _QUEUE.get()
+        taken = 0
+        events: List[threading.Event] = []
+        by_path: Dict[str, List[tuple]] = {}
+        while True:
+            if isinstance(item, threading.Event):
+                events.append(item)
+            else:
+                path, row = item
+                by_path.setdefault(path, []).append(row)
+                taken += 1
+            if taken >= _BATCH_MAX:
+                break
+            try:
+                item = _QUEUE.get_nowait()
+            except queue.Empty:
+                break
+        for path, rows in by_path.items():
+            _write_batch(path, rows)
+        for ev in events:
+            ev.set()
+
+
+def flush(timeout: float = 5.0) -> bool:
+    """Block until everything enqueued so far is committed (readers
+    call this so a just-finished span is immediately visible). Returns
+    False on timeout — never raises."""
+    if _WORKER is None and _QUEUE.empty():
+        return True
+    done = threading.Event()
+    _QUEUE.put(done)
+    _ensure_worker()
+    return done.wait(timeout)
+
+
+# ------------------------------------------------------------------ reads
+
+def _conn_ro() -> sqlite3.Connection:
+    path = journal.db_path()
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    conn = sqlite_utils.connect_wal(path)
+    _ensure_table(conn)
+    return conn
+
+
+def _row_to_dict(row) -> Dict[str, Any]:
+    d = dict(zip(_COLUMNS, row))
+    if d.get('attrs'):
+        try:
+            d['attrs'] = json.loads(d['attrs'])
+        except ValueError:
+            pass
+    return d
+
+
+def query_spans(*, trace_id: Optional[str] = None,
+                name: Optional[str] = None,
+                entity_scope: Optional[str] = None,
+                since: Optional[float] = None,
+                limit: int = 5000,
+                newest_first: bool = False) -> List[Dict[str, Any]]:
+    """Filtered spans, oldest-start first. ``entity_scope`` restricts
+    like journal.query's: the span's entity equals the scope or lives
+    under it (``scope/...``) — what a user-facing per-service endpoint
+    may expose from the shared DB. ``newest_first`` makes the LIMIT
+    keep the NEWEST rows instead of the oldest (results still return
+    oldest-first) — for unfiltered exports, where truncating away the
+    most recent requests would hide exactly what's being debugged."""
+    flush(timeout=2.0)
+    clauses, params = [], []
+    for col, val in (('trace_id', trace_id), ('name', name)):
+        if val is not None:
+            clauses.append(f'{col} = ?')
+            params.append(val)
+    if entity_scope is not None:
+        clause, scope_params = journal.entity_scope_clause(entity_scope)
+        clauses.append(clause)
+        params.extend(scope_params)
+    if since is not None:
+        clauses.append('start_ts >= ?')
+        params.append(since)
+    where = (' WHERE ' + ' AND '.join(clauses)) if clauses else ''
+    order = ('start_ts DESC, row_id DESC' if newest_first
+             else 'start_ts, row_id')
+    sql = (f'SELECT {", ".join(_COLUMNS)} FROM spans{where} '
+           f'ORDER BY {order} LIMIT ?')
+    params.append(max(1, int(limit)))
+    try:
+        conn = _conn_ro()
+        try:
+            rows = conn.execute(sql, params).fetchall()
+        finally:
+            conn.close()
+    except (sqlite3.Error, OSError):
+        return []
+    if newest_first:
+        rows.reverse()
+    return [_row_to_dict(r) for r in rows]
+
+
+def tree(trace_id: str,
+         entity_scope: Optional[str] = None) -> Dict[str, Any]:
+    """The rooted span tree of one trace: every persisted span, nested
+    by parentage. A span whose parent is missing (recorded by a process
+    whose DB we cannot see, or simply not yet flushed) surfaces as a
+    root rather than vanishing."""
+    flat = query_spans(trace_id=trace_id, entity_scope=entity_scope)
+    by_id: Dict[str, Dict[str, Any]] = {}
+    for s in flat:
+        node = dict(s)
+        node['children'] = []
+        by_id[node['span_id']] = node
+    roots: List[Dict[str, Any]] = []
+    for node in by_id.values():
+        parent = by_id.get(node['parent_id'] or '')
+        if parent is not None and parent is not node:
+            parent['children'].append(node)
+        else:
+            roots.append(node)
+
+    def sort_rec(nodes: List[Dict[str, Any]]) -> None:
+        nodes.sort(key=lambda n: (n['start_ts'], n['span_id']))
+        for n in nodes:
+            sort_rec(n['children'])
+
+    sort_rec(roots)
+    return {'trace_id': trace_id, 'span_count': len(flat),
+            'roots': roots}
+
+
+def gc_spans(max_age_seconds: float = 7 * 24 * 3600,
+             max_rows: int = 500_000) -> int:
+    """Retention, same discipline as journal.gc_events: age window plus
+    a row cap keyed on the Nth-NEWEST row id (never max-id arithmetic —
+    AUTOINCREMENT ids go sparse after age deletes)."""
+    flush(timeout=2.0)
+    try:
+        conn = _conn_ro()
+        try:
+            with sqlite_utils.immediate(conn):
+                cur = conn.execute('DELETE FROM spans WHERE start_ts < ?',
+                                   (time.time() - max_age_seconds,))
+                deleted = cur.rowcount
+                row = conn.execute(
+                    'SELECT row_id FROM spans '
+                    'ORDER BY row_id DESC LIMIT 1 OFFSET ?',
+                    (max_rows,)).fetchone()
+                if row is not None:
+                    cur = conn.execute(
+                        'DELETE FROM spans WHERE row_id <= ?', (row[0],))
+                    deleted += cur.rowcount
+        finally:
+            conn.close()
+        return max(0, deleted)
+    except (sqlite3.Error, OSError):
+        return 0
+
+
+# ---------------------------------------------------------- chrome export
+
+def chrome_trace(trace_id: Optional[str] = None,
+                 timeline_path: Optional[str] = None,
+                 limit: int = 100_000) -> Dict[str, Any]:
+    """Spans as Chrome trace-event JSON ('X' complete events, μs),
+    merged with the process-profiling events utils/timeline.py captured
+    (``SKYTPU_TIMELINE_FILE_PATH``) so one perfetto load shows the
+    request tree AND the decorated control-plane functions on a shared
+    wall-clock axis. An unfiltered export over ``limit`` keeps the
+    NEWEST spans (the requests being debugged), never the oldest."""
+    events: List[Dict[str, Any]] = []
+    spans_flat = (query_spans(trace_id=trace_id, limit=limit)
+                  if trace_id
+                  else query_spans(limit=limit, newest_first=True))
+    for s in spans_flat:
+        args: Dict[str, Any] = {'span_id': s['span_id']}
+        if s.get('trace_id'):
+            args['trace_id'] = s['trace_id']
+        if s.get('parent_id'):
+            args['parent_id'] = s['parent_id']
+        if s.get('entity'):
+            args['entity'] = s['entity']
+        if isinstance(s.get('attrs'), dict):
+            args.update({f'attr.{k}': v for k, v in s['attrs'].items()})
+        events.append({
+            'name': s['name'], 'ph': 'X',
+            'ts': s['start_ts'] * 1e6,
+            'dur': max(s['duration'], 0.0) * 1e6,
+            'pid': str(s['pid']), 'tid': 'spans',
+            'args': args,
+        })
+    tl_path = timeline_path or os.environ.get('SKYTPU_TIMELINE_FILE_PATH')
+    if tl_path and os.path.exists(os.path.expanduser(tl_path)):
+        try:
+            with open(os.path.expanduser(tl_path), 'r',
+                      encoding='utf-8') as f:
+                tl = json.load(f)
+            for e in tl.get('traceEvents', []):
+                if trace_id is not None and (
+                        (e.get('args') or {}).get('trace_id') != trace_id):
+                    continue
+                events.append(e)
+        except (OSError, ValueError):
+            pass
+    return {'traceEvents': events}
+
+
+# ---------------------------------------------------------- rendering
+
+def format_tree(result: Dict[str, Any]) -> str:
+    """Human-readable indented tree with durations and % of parent —
+    the `observe trace <id>` CLI surface."""
+    lines = [f"trace {result['trace_id']}: "
+             f"{result['span_count']} span(s)"]
+
+    def walk(node: Dict[str, Any], depth: int,
+             parent_dur: Optional[float]) -> None:
+        dur_ms = node['duration'] * 1e3
+        pct = ''
+        if parent_dur and parent_dur > 0:
+            pct = f' ({min(100.0, node["duration"] / parent_dur * 100):.0f}% of parent)'
+        attrs = node.get('attrs')
+        attr_str = ''
+        if isinstance(attrs, dict) and attrs:
+            inner = ', '.join(f'{k}={v}' for k, v in sorted(attrs.items()))
+            attr_str = f'  [{inner}]'
+        lines.append(f'{"  " * depth}{node["name"]}  '
+                     f'{dur_ms:.1f}ms{pct}{attr_str}')
+        for child in node['children']:
+            walk(child, depth + 1, node['duration'])
+
+    for root in result['roots']:
+        walk(root, 1, None)
+    return '\n'.join(lines)

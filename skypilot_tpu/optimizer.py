@@ -28,6 +28,7 @@ from skypilot_tpu import check as check_lib
 from skypilot_tpu import exceptions
 from skypilot_tpu import sky_logging
 from skypilot_tpu.clouds import cloud as cloud_lib
+from skypilot_tpu.observe import spans as spans_lib
 from skypilot_tpu.utils import timeline
 
 if typing.TYPE_CHECKING:
@@ -66,6 +67,7 @@ class Optimizer:
 
     @staticmethod
     @timeline.event
+    @spans_lib.traced('optimizer.plan')
     def optimize(dag: 'dag_lib.Dag',
                  minimize: OptimizeTarget = OptimizeTarget.COST,
                  blocked_resources: Optional[

@@ -19,6 +19,7 @@ from skypilot_tpu import provision
 from skypilot_tpu import sky_logging
 from skypilot_tpu.observe import journal as journal_lib
 from skypilot_tpu.observe import metrics as metrics_lib
+from skypilot_tpu.observe import spans as spans_lib
 from skypilot_tpu.provision import common
 from skypilot_tpu.utils import command_runner as command_runner_lib
 from skypilot_tpu.utils import subprocess_utils
@@ -72,51 +73,64 @@ def bulk_provision(
             tags={'skytpu-cluster': cluster_name},
             ports_to_open_on_launch=ports_to_open,
         )
-        try:
-            logger.info(f'Provisioning {cluster_name!r} '
-                        f'({resources.tpu.name if resources.tpu else "cpu"}) '
-                        f'in {zone}...')
-            attempt_start = time.time()
-            record = provision.run_instances(cloud_name, region, zone,
-                                             cluster_name, config)
-            provision.wait_instances(cloud_name, region, cluster_name,
-                                     provider_config=deploy_vars)
-            if ports_to_open:
-                try:
-                    provision.open_ports(cloud_name, region, cluster_name,
-                                         ports_to_open,
-                                         provider_config=deploy_vars)
-                except Exception as e:  # pylint: disable=broad-except
-                    # Never tear down a healthy, freshly-provisioned
-                    # cluster over firewall setup (e.g. Compute API not
-                    # enabled on a TPU-only project, missing
-                    # compute.firewalls.* perms) — and never let a
-                    # non-zone-specific error burn the zone failover.
-                    logger.warning(
-                        f'Could not open ports {ports_to_open} for '
-                        f'{cluster_name!r}: {e}. The cluster is up, but '
-                        f'its service ports may be unreachable until the '
-                        f'firewall is configured (check the Compute API / '
-                        f'compute.firewalls.* permissions).')
-            _ATTEMPT_METRIC.inc(outcome='success')
-            _ATTEMPT_SECONDS.observe(time.time() - attempt_start)
-            journal_lib.record_event(
-                'provision', entity=cluster_name,
-                data={'zone': zone, 'failed_zones': len(errors)})
-            return record
-        except (exceptions.InsufficientCapacityError,
-                exceptions.QuotaExceededError,
-                exceptions.ProvisionError) as e:
-            logger.warning(f'  zone {zone}: {type(e).__name__}: {e}')
-            _ATTEMPT_METRIC.inc(outcome='zone_failed')
-            errors.append(e)
-            # Leave nothing half-created in the failed zone.
+        # One span per ZONE attempt (the retry loop is exactly where a
+        # slow launch hides: /v1/traces shows each zone's wall-clock
+        # and outcome, not just the aggregate counter).
+        with spans_lib.span('provision.attempt',
+                            attrs={'zone': zone, 'region': region,
+                                   'cluster': cluster_name}) as att:
             try:
-                provision.terminate_instances(cloud_name, region,
-                                              cluster_name, deploy_vars)
-            except Exception as cleanup_err:  # pylint: disable=broad-except
-                logger.debug(f'  cleanup after failure: {cleanup_err}')
-            continue
+                logger.info(
+                    f'Provisioning {cluster_name!r} '
+                    f'({resources.tpu.name if resources.tpu else "cpu"}) '
+                    f'in {zone}...')
+                attempt_start = time.time()
+                record = provision.run_instances(cloud_name, region, zone,
+                                                 cluster_name, config)
+                provision.wait_instances(cloud_name, region, cluster_name,
+                                         provider_config=deploy_vars)
+                if ports_to_open:
+                    try:
+                        provision.open_ports(cloud_name, region,
+                                             cluster_name, ports_to_open,
+                                             provider_config=deploy_vars)
+                    except Exception as e:  # pylint: disable=broad-except
+                        # Never tear down a healthy, freshly-provisioned
+                        # cluster over firewall setup (e.g. Compute API
+                        # not enabled on a TPU-only project, missing
+                        # compute.firewalls.* perms) — and never let a
+                        # non-zone-specific error burn the zone failover.
+                        logger.warning(
+                            f'Could not open ports {ports_to_open} for '
+                            f'{cluster_name!r}: {e}. The cluster is up, '
+                            f'but its service ports may be unreachable '
+                            f'until the firewall is configured (check '
+                            f'the Compute API / compute.firewalls.* '
+                            f'permissions).')
+                _ATTEMPT_METRIC.inc(outcome='success')
+                _ATTEMPT_SECONDS.observe(time.time() - attempt_start)
+                att.set_attr('outcome', 'success')
+                journal_lib.record_event(
+                    'provision', entity=cluster_name,
+                    data={'zone': zone, 'failed_zones': len(errors)})
+                return record
+            except (exceptions.InsufficientCapacityError,
+                    exceptions.QuotaExceededError,
+                    exceptions.ProvisionError) as e:
+                logger.warning(f'  zone {zone}: {type(e).__name__}: {e}')
+                _ATTEMPT_METRIC.inc(outcome='zone_failed')
+                att.set_attr('outcome', 'zone_failed')
+                att.set_attr('error', f'{type(e).__name__}: {e}')
+                errors.append(e)
+                # Leave nothing half-created in the failed zone.
+                try:
+                    provision.terminate_instances(cloud_name, region,
+                                                  cluster_name,
+                                                  deploy_vars)
+                except Exception as cleanup_err:  # pylint: disable=broad-except
+                    logger.debug(f'  cleanup after failure: '
+                                 f'{cleanup_err}')
+                continue
     _ATTEMPT_METRIC.inc(outcome='exhausted')
     journal_lib.record_event(
         'provision_exhausted', entity=cluster_name,
@@ -164,6 +178,7 @@ def get_command_runners(
 
 
 @timeline.event
+@spans_lib.traced('provision.wait_connection')
 def wait_for_connection(cluster_info: common.ClusterInfo,
                         timeout: float = _CONNECTION_WAIT_SECONDS) -> None:
     """Block until every host accepts commands (analog wait_for_ssh:387)."""
@@ -279,6 +294,7 @@ def _start_exec_agents(cluster_name: str, cluster_info: common.ClusterInfo,
 
 
 @timeline.event
+@spans_lib.traced('provision.runtime_setup')
 def post_provision_runtime_setup(cluster_name: str,
                                  cluster_info: common.ClusterInfo) -> None:
     """Bootstrap every host: runtime dir + skylet daemon on the head.

@@ -28,6 +28,13 @@ from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
 logger = sky_logging.init_logger('skypilot_tpu.serve.controller')
 
 RECONCILE_SECONDS = float(os.environ.get('SKYTPU_SERVE_SYNC_SECONDS', '5'))
+# Journal/span retention cadence for THIS process (mirrors the API
+# server's hourly GC loop): the controller and its LB write journal
+# events and spans into their own DB — often on a different host from
+# the API server — so without a local observe.gc() those rows would
+# grow until the disk fills.
+GC_INTERVAL_SECONDS = float(os.environ.get('SKYTPU_SERVE_GC_SECONDS',
+                                           '3600'))
 
 
 class ServiceController:
@@ -81,11 +88,30 @@ class ServiceController:
                             record.get('update_mode') or 'rolling')
 
     # ------------------------------------------------------------------
+    def _maybe_gc_observe(self) -> None:
+        """Hourly events+spans retention in the controller process —
+        the shared observe.gc() the API server's GC loop also runs
+        (GC only there would leak this process's journal/span rows
+        forever when the controller runs on its own host)."""
+        now = time.time()
+        if now - self._last_observe_gc < GC_INTERVAL_SECONDS:
+            return
+        self._last_observe_gc = now
+        from skypilot_tpu import observe
+        pruned = observe.gc()
+        if any(pruned.values()):
+            logger.info(f'observe GC: pruned {pruned["events"]} '
+                        f'event(s), {pruned["spans"]} span(s)')
+
     def _reconcile_loop(self) -> None:
         serve_state.set_service_status(self.name,
                                        ServiceStatus.REPLICA_INIT)
+        # First pass runs a GC immediately: a controller that restarts
+        # daily would otherwise never reach the interval.
+        self._last_observe_gc = 0.0
         while not self._stop.is_set():
             try:
+                self._maybe_gc_observe()
                 record = serve_state.get_service(self.name)
                 if record is None or record['status'] in (
                         ServiceStatus.SHUTTING_DOWN, ServiceStatus.SHUTDOWN):

@@ -59,7 +59,10 @@ import time
 from typing import Any, Dict, List, Optional, Tuple
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.observe import flight as flight_lib
 from skypilot_tpu.observe import metrics as metrics_lib
+from skypilot_tpu.observe import spans as spans_lib
+from skypilot_tpu.observe import trace as trace_lib
 from skypilot_tpu.utils import timeline
 
 logger = sky_logging.init_logger(__name__)
@@ -113,12 +116,29 @@ _M_SPEC_PROPOSED = metrics_lib.counter(
 _M_SPEC_ACCEPTED = metrics_lib.counter(
     'skytpu_engine_spec_accepted_total', 'Draft tokens accepted by the '
     'verifier')
+# Request-level serving latency, derived from flight-ring-aligned host
+# timestamps at admit/publish time — never from per-token telemetry on
+# the decode loop (observe/flight.py). TTFT = submit → first token
+# (queue wait + prefill); TPOT = mean inter-token time after the
+# first. The quantities BASELINE.md's serve rows and the LB's SLOs are
+# written in.
+_M_TTFT = metrics_lib.histogram(
+    'skytpu_engine_ttft_seconds',
+    'Time to first token: request submit to first sampled token '
+    '(queue wait + prefill)',
+    buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+             10.0, 30.0, 60.0))
+_M_TPOT = metrics_lib.histogram(
+    'skytpu_engine_tpot_seconds',
+    'Time per output token after the first (mean per request)',
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.25, 0.5, 1.0, 2.5))
 
 _ENGINE_METRICS = (
     _M_STEP_SECONDS, _M_ADMIT_SECONDS, _M_HOST_SYNC_SECONDS,
     _M_QUEUE_DEPTH, _M_IN_FLIGHT, _M_STEPS, _M_TOKENS, _M_REQUESTS,
     _M_REJECTED, _M_PREFIX, _M_PREFIX_HITS, _M_SPEC_ROUNDS,
-    _M_SPEC_PROPOSED, _M_SPEC_ACCEPTED)
+    _M_SPEC_PROPOSED, _M_SPEC_ACCEPTED, _M_TTFT, _M_TPOT)
 
 
 def _seed_counter_zeros() -> None:
@@ -334,9 +354,54 @@ def _parse_n(body) -> Tuple[int, int]:
     return n, best_of
 
 
+def _record_request_spans(engine: InferenceEngine, headers, futs) -> None:
+    """Record each finished request's engine-side span decomposition
+    (engine.request → queue wait → prefill → decode) from the timing
+    the batch loop stashed at publish (pop_timing). Called by the HTTP
+    handlers AFTER the request resolves — NEVER from the batch loop
+    (span-discipline: the hot path records flight-ring tuples only).
+
+    Parentage comes from the forwarded carriers the serve LB stamps on
+    its upstream call (X-Skytpu-Trace-Id / X-Skytpu-Parent-Span /
+    X-Skytpu-Entity), so these spans nest under lb.upstream in
+    ``/v1/traces/<id>`` and — carrying the LB's entity — fall inside
+    ``/-/lb/trace/<id>``'s entity scope when the replica shares the
+    journal DB. With no well-formed trace offered, nobody upstream is
+    tracing this request and nothing is recorded (the histograms
+    already got the data)."""
+    tid = headers.get('X-Skytpu-Trace-Id', '')
+    if not trace_lib.is_valid_trace_id(tid):
+        return
+    parent = headers.get('X-Skytpu-Parent-Span', '')
+    parent = parent if trace_lib.is_valid_trace_id(parent) else None
+    entity = headers.get('X-Skytpu-Entity', '').strip()[:128] or None
+    for fut in futs:
+        t = engine.pop_timing(fut)
+        if t is None or t.get('submit_wall') is None:
+            continue
+        attrs: Dict[str, Any] = {'tokens': t['tokens'],
+                                 'finish': t['finish'],
+                                 'ttft_s': round(t['ttft_s'], 6)}
+        if t['tpot_s'] is not None:
+            attrs['tpot_s'] = round(t['tpot_s'], 6)
+        total = t['queue_s'] + t['prefill_s'] + t['decode_s']
+        rid = spans_lib.record('engine.request',
+                               start_wall=t['submit_wall'],
+                               duration=total, trace_id=tid,
+                               parent_id=parent, entity=entity,
+                               attrs=attrs)
+        w = t['submit_wall']
+        for name, dur in (('engine.queue', t['queue_s']),
+                          ('engine.prefill', t['prefill_s']),
+                          ('engine.decode', t['decode_s'])):
+            spans_lib.record(name, start_wall=w, duration=dur,
+                             trace_id=tid, parent_id=rid, entity=entity)
+            w += dur
+
+
 async def _submit_many(engine: InferenceEngine, prompts, max_new,
                        sampling, stop_ids, n: int, best_of: int,
-                       want_tops: bool = False):
+                       want_tops: bool = False, headers=None):
     """Fan out prompts × best_of into the continuous batcher, rank each
     prompt's candidates by mean logprob, keep n per prompt (OpenAI
     n/best_of + batched-prompt semantics in one place).
@@ -360,6 +425,8 @@ async def _submit_many(engine: InferenceEngine, prompts, max_new,
             f.cancel()
         raise
     all_res = await asyncio.gather(*futs)
+    if headers is not None:
+        _record_request_spans(engine, headers, futs)
     # usage must count EVERY generated token, including discarded
     # best_of candidates (OpenAI semantics; quota accounting reads it).
     generated = sum(len(r[0]) for r in all_res)
@@ -571,6 +638,25 @@ class InferenceEngine:
         self._seed = seed
         self._resets = 0
         self._pending_cancels: List[Any] = []
+        # Flight recorder (observe/flight.py): the hot loop's only
+        # telemetry — dispatch/collect/admit/finish events as
+        # preallocated ring tuples (no sqlite, no spans, no device
+        # sync). /debug/flight dumps it; failure resets snapshot it
+        # into the journal. Followers record into their own ring at
+        # the mirrored op-stream points.
+        self.flight = flight_lib.FlightRecorder()
+        # Request-timing sidecars, keyed by id(future) so the item
+        # tuple (and the multi-host admit protocol built on its shape)
+        # stays untouched. _submit_meta: (monotonic_ns, wall) captured
+        # at enqueue; _timings: the finished request's decomposition,
+        # picked up by the HTTP handlers (engine.pop_timing) which
+        # record the engine spans OFF the batch loop. Both bounded:
+        # entries whose handler never collects them (failed or
+        # abandoned requests) age out by insertion order.
+        import collections as _collections
+        self._submit_meta: Dict[int, tuple] = {}
+        self._timings: '_collections.OrderedDict' = \
+            _collections.OrderedDict()
         # Dispatched-but-uncollected fused steps (oldest first). The
         # leader keeps at most one outstanding across its broadcast
         # points; followers mirror via the ('step',)/('collect',) ops.
@@ -652,14 +738,28 @@ class InferenceEngine:
         return sum(1 for s in getattr(self, 'slots', []) if s is not None)
 
     # -- device state ------------------------------------------------------
-    def _reset_device_state(self) -> None:
+    def _reset_device_state(self, reason: Optional[str] = None) -> None:
         """(Re)build the slot pool + cache. Called at startup AND after a
         step/admit execution failure: the failed call was DONATED the old
         cache buffer (jax invalidates it even on error), so continuing
         with the old self.cache would poison every later request while
-        /health still says ok."""
+        /health still says ok.
+
+        Every reset snapshots the flight ring into the event journal
+        first (kind=flight_snapshot): an engine failure ships the hot
+        loop's last ~64k events with it, post-mortem-ready, whether or
+        not anyone scraped /debug/flight in time. The startup call is a
+        no-op snapshot (empty ring)."""
         import jax
         import numpy as np
+        # Snapshot BEFORE the reset marker: the journal gets the hot
+        # loop's history as it stood at failure (an empty ring — the
+        # startup call — writes nothing), then the marker opens the new
+        # buffer generation's era in the ring.
+        flight_lib.snapshot_to_journal(
+            self.flight, reason=reason or 'device state reset',
+            entity=f'engine/{self.model_name}')
+        self.flight.record(flight_lib.RESET, 0, self._resets)
         self.cache = self._decode.init_cache(self.cfg, MAX_BATCH,
                                              self.max_len)
         if self.mesh is not None:
@@ -984,6 +1084,12 @@ class InferenceEngine:
         for metric in _ENGINE_METRICS:
             metric.reset()
         _seed_counter_zeros()
+        # Warmup's synthetic admits/steps must not pollute the flight
+        # ring (a /debug/flight dump should start at real traffic) or
+        # leak timing sidecar entries for futures that never existed.
+        self.flight.clear()
+        self._submit_meta.clear()
+        self._timings.clear()
         self.warm = True
         logger.info('Engine warm (step variants k x use_pen x want_tops '
                     '+ grouped-admit programs compiled; buckets: '
@@ -1028,6 +1134,13 @@ class InferenceEngine:
             _M_REJECTED.inc()
             raise EngineOverloaded(
                 f'admission queue full ({MAX_QUEUE} waiting)') from None
+        # Submit timestamp pair: the monotonic ns aligns with the flight
+        # ring's clock (queue-wait/TTFT deltas), the wall clock anchors
+        # the recorded spans cross-process. Bounded: a queued item whose
+        # future is cancelled before admission never pops its entry.
+        self._submit_meta[id(fut)] = (time.monotonic_ns(), time.time())
+        while len(self._submit_meta) > 4096:
+            self._submit_meta.pop(next(iter(self._submit_meta)))
         self.requests_total += 1
         _M_REQUESTS.inc()
         _M_QUEUE_DEPTH.set(self.queue_depth())
@@ -1075,6 +1188,7 @@ class InferenceEngine:
                 if s is not None and s['fut'] is fut:
                     if s['finish'] is None:
                         s['finish'] = 'stop'
+                        self.flight.record(flight_lib.CANCEL, i)
                         self._bcast(('cancel', i))
                     break
         self._pending_cancels.clear()
@@ -1177,12 +1291,26 @@ class InferenceEngine:
          fut) = item
         self.last[slot] = first
         stop = frozenset(stop_ids or ())
+        # Flight ring: the admit event (seq = prompt bucket), plus the
+        # request's timing anchors folded into the slot entry — submit
+        # meta popped by future id, admit start from the enclosing
+        # admit call, first token = now. TTFT/TPOT derive from these
+        # ring-aligned deltas at publish time; the per-token loop
+        # records nothing but ring tuples (observe/flight.py).
+        now_ns = time.monotonic_ns()
+        self.flight.record(flight_lib.ADMIT, slot, _bucket(len(tokens)))
+        meta = (self._submit_meta.pop(id(fut), None)
+                if fut is not None else None)
         # ctx = prompt ++ generated: the prompt-lookup draft source AND
         # the host mirror of the row's cache length (len(ctx) - 1).
         entry = {'fut': fut, 'want': max_new, 'out': [], 'lps': [],
                  'tops': [], 'stop': stop, 'stream': stream_q, 'sent': 0,
                  'finish': None, 'want_tops': bool(want_tops),
-                 'ctx': list(tokens) + [first]}
+                 'ctx': list(tokens) + [first],
+                 't_submit_ns': meta[0] if meta else None,
+                 't_submit_wall': meta[1] if meta else None,
+                 't_admit_ns': getattr(self, '_admit_t0_ns', now_ns),
+                 't_first_ns': now_ns}
         if first in stop:
             entry['finish'] = 'stop'
         else:
@@ -1214,6 +1342,10 @@ class InferenceEngine:
             'admit while a step is in flight (collect must precede ' \
             'slot reuse)'
         t_admit = time.perf_counter()
+        # Prefill-start anchor for every request this call admits
+        # (including the prefix-hit path below): _finish_admit folds it
+        # into the slot entry, so queue wait and prefill decompose.
+        self._admit_t0_ns = time.monotonic_ns()
         # self.warm gate: warmup's synthetic prompts share prefixes
         # across buckets — a warmup hit would skip compiling the very
         # grouped-admit programs warmup exists to build. A BURST of
@@ -1435,6 +1567,7 @@ class InferenceEngine:
                                            jnp.asarray(self.last))
         self.spec_proposed += round_prop
         self.spec_accepted += round_acc
+        self.flight.record(flight_lib.SPEC, 0, round_acc)
         _M_SPEC_PROPOSED.inc(round_prop)
         _M_SPEC_ACCEPTED.inc(round_acc)
         if round_prop and round_acc < SPEC_MIN_ACCEPT * round_prop:
@@ -1537,6 +1670,9 @@ class InferenceEngine:
                 self.rng = out
             handle = _InFlightStep(k, False, toks, lps)
         self._inflight.append(handle)
+        # Ring only on the hot path: one counter bump + one slot store,
+        # no sqlite/span/syscall (observe/flight.py; seq = step width).
+        self.flight.record(flight_lib.DISPATCH, 0, k)
         _M_STEP_SECONDS.observe(time.perf_counter() - t0,
                                 phase='dispatch')
         return handle
@@ -1562,6 +1698,9 @@ class InferenceEngine:
             tis = jax.device_get(h.tis)          # [k, B, K]
             tvs = jax.device_get(h.tvs)          # [k, B, K]
         _M_HOST_SYNC_SECONDS.observe(time.perf_counter() - t_sync)
+        # Timestamped AFTER the device_get: the dispatch→collect ring
+        # delta is the chunk's device+transfer wall time.
+        self.flight.record(flight_lib.COLLECT, 0, h.k)
         k = h.k
         self.step_count += k
         _M_STEPS.inc(k)
@@ -1647,6 +1786,7 @@ class InferenceEngine:
             if s['finish'] is not None:
                 if q is not None:
                     q.put_nowait(None)           # end-of-stream sentinel
+                self._finish_timing(i, s)
                 fut = s['fut']
                 if fut is not None and not fut.done():
                     fut.set_result((s['out'], s['finish'], s['lps'],
@@ -1659,6 +1799,42 @@ class InferenceEngine:
                 # left.
                 self.temp[i] = self.topk[i] = self.topp[i] = 0
                 self.pres[i] = self.freq[i] = 0.0
+
+    def _finish_timing(self, slot: int, s: Dict[str, Any]) -> None:
+        """Derive the finished request's TTFT/TPOT from the ring-aligned
+        timestamps its slot entry carries — ONE histogram observe pair
+        per REQUEST at publish time, never per-token telemetry on the
+        decode loop — and stash the full decomposition for the HTTP
+        handler (pop_timing → engine.queue/prefill/decode spans)."""
+        self.flight.record(flight_lib.FINISH, slot, len(s['out']))
+        t_sub = s.get('t_submit_ns')
+        if t_sub is None:
+            return                     # follower / warmup / no meta
+        done_ns = time.monotonic_ns()
+        n = len(s['out'])
+        queue_s = max(0.0, (s['t_admit_ns'] - t_sub) / 1e9)
+        prefill_s = max(0.0, (s['t_first_ns'] - s['t_admit_ns']) / 1e9)
+        decode_s = max(0.0, (done_ns - s['t_first_ns']) / 1e9)
+        ttft = queue_s + prefill_s
+        tpot = decode_s / (n - 1) if n > 1 else None
+        _M_TTFT.observe(ttft)
+        if tpot is not None:
+            _M_TPOT.observe(tpot)
+        if s['fut'] is not None:
+            self._timings[id(s['fut'])] = {
+                'submit_wall': s['t_submit_wall'], 'queue_s': queue_s,
+                'prefill_s': prefill_s, 'decode_s': decode_s,
+                'ttft_s': ttft, 'tpot_s': tpot, 'tokens': n,
+                'finish': s['finish']}
+            while len(self._timings) > 1024:
+                self._timings.popitem(last=False)
+
+    def pop_timing(self, fut) -> Optional[Dict[str, Any]]:
+        """The finished request's latency decomposition, consumed ONCE
+        by the HTTP handler that owns `fut` (which records the engine
+        spans off the batch loop). None for requests that never
+        admitted (429'd, cancelled in queue) or already-popped ones."""
+        return self._timings.pop(id(fut), None)
 
     def _drain_admissible(self, already: int = 0) -> list:
         """Pop queued requests up to the free-slot budget (non-blocking);
@@ -1797,7 +1973,7 @@ class InferenceEngine:
         for s in self.slots:
             if s is not None:
                 fail(s['fut'], s['stream'])
-        self._reset_device_state()
+        self._reset_device_state(reason=f'{type(e).__name__}: {e}')
 
 
 # ---------------------------------------------------------------------------
@@ -2026,6 +2202,11 @@ async def _sse_response(request, engine: InferenceEngine,
             if not ch.fut.done():
                 engine.cancel(ch.fut)
                 ch.fut.cancel()
+        # Streamed requests decompose too: timings exist for every
+        # choice the batch loop published (cancelled-in-queue futures
+        # simply have none to pop).
+        _record_request_spans(engine, request.headers,
+                              [ch.fut for ch in choices])
     await resp.write_eof()
     return resp
 
@@ -2055,6 +2236,23 @@ def build_app(engine: InferenceEngine):
         return web.Response(text=metrics_lib.render(),
                             content_type='text/plain')
 
+    async def debug_flight(request):
+        """Dump the flight ring (observe/flight.py): the hot loop's
+        last dispatch/collect/admit/finish/spec/cancel/reset events,
+        decoded, newest-last. `?limit=N` keeps the newest N (default
+        4096 — the full ~64k ring is a big JSON document; ask for
+        `?limit=0` to get it all, e.g. before restarting a replica)."""
+        try:
+            limit = int(request.query.get('limit', '4096'))
+        except ValueError:
+            return web.json_response({'error': 'bad limit'}, status=400)
+        events = engine.flight.dump(limit if limit > 0 else None)
+        return web.json_response({
+            'capacity': engine.flight.capacity,
+            'count': len(events),
+            'events': events,
+        })
+
     async def generate(request):
         body = await request.json()
         if 'text' in body:
@@ -2083,10 +2281,12 @@ def build_app(engine: InferenceEngine):
             return web.json_response({'error': f'bad sampling params: {e}'},
                                      status=400)
         try:
-            out, finish, lps, _tops = await engine.submit(
-                tokens, max_new, *sampling, stop_ids=stop_ids)
+            fut = engine.submit_nowait(tokens, max_new, *sampling,
+                                       stop_ids=stop_ids)
+            out, finish, lps, _tops = await fut
         except EngineOverloaded as e:
             return web.json_response({'error': str(e)}, status=429)
+        _record_request_spans(engine, request.headers, [fut])
         resp: Dict[str, Any] = {'tokens': out, 'finish_reason': finish,
                                 'logprobs': lps}
         if 'text' in body:
@@ -2173,7 +2373,8 @@ def build_app(engine: InferenceEngine):
         try:
             results, total_out = await _submit_many(
                 engine, prompts, max_new, sampling, stop_ids, n, best_of,
-                want_tops=want_logprobs and top_n > 0)
+                want_tops=want_logprobs and top_n > 0,
+                headers=request.headers)
         except EngineOverloaded as e:
             return _openai_error(web, str(e), status=429,
                                  err_type='overloaded_error')
@@ -2292,7 +2493,8 @@ def build_app(engine: InferenceEngine):
         try:
             results, total_out = await _submit_many(
                 engine, [tokens], max_new, sampling, stop_ids, n, n,
-                want_tops=want_logprobs and top_n > 0)
+                want_tops=want_logprobs and top_n > 0,
+                headers=request.headers)
         except EngineOverloaded as e:
             return _openai_error(web, str(e), status=429,
                                  err_type='overloaded_error')
@@ -2348,6 +2550,7 @@ def build_app(engine: InferenceEngine):
     app.router.add_get('/health', health)
     app.router.add_get('/', health)
     app.router.add_get('/metrics', metrics)
+    app.router.add_get('/debug/flight', debug_flight)
     app.router.add_post('/generate', generate)
     app.router.add_post('/v1/completions', openai_completions)
     app.router.add_post('/v1/chat/completions', openai_chat)
@@ -2357,7 +2560,32 @@ def build_app(engine: InferenceEngine):
         del app_
         engine.start()
 
+    async def _observe_gc_loop():
+        # The replica writes span rows per request and multi-MB
+        # flight_snapshot rows per failure reset into its HOST-LOCAL
+        # journal DB — no API server or serve controller ever sees
+        # that file, so this process must collect it itself (same
+        # contract as the server/controller GC loops).
+        from skypilot_tpu import observe
+        while True:
+            await asyncio.sleep(3600)
+            try:
+                await asyncio.to_thread(observe.gc)
+            except Exception:  # pylint: disable=broad-except
+                logger.warning('observe GC pass failed (will retry)',
+                               exc_info=True)
+
+    async def _start_gc(app_):
+        app_['observe_gc'] = asyncio.create_task(_observe_gc_loop())
+
+    async def _stop_gc(app_):
+        task = app_.pop('observe_gc', None)
+        if task is not None:
+            task.cancel()
+
     app.on_startup.append(_start)
+    app.on_startup.append(_start_gc)
+    app.on_cleanup.append(_stop_gc)
     return app
 
 

@@ -13,10 +13,16 @@ Control endpoints live under /-/lb/ (anything else is proxied verbatim):
                       controller process registered)
   GET /-/lb/events  → the trace-correlated event journal (this
                       service's replica transitions included)
+  GET /-/lb/trace/<trace_id>
+                    → this service's span tree for one trace (the
+                      lb.request → lb.pick / lb.upstream hops),
+                      entity-scoped like /-/lb/events
 """
 from __future__ import annotations
 
 import asyncio
+import os
+import random
 import time
 import typing
 from typing import List, Optional
@@ -27,6 +33,8 @@ from aiohttp import web
 from skypilot_tpu import sky_logging
 from skypilot_tpu.observe import journal as journal_lib
 from skypilot_tpu.observe import metrics as metrics_lib
+from skypilot_tpu.observe import spans as spans_lib
+from skypilot_tpu.observe import trace as trace_lib
 from skypilot_tpu.serve import load_balancing_policies as lb_policies
 from skypilot_tpu.utils import registry
 
@@ -110,6 +118,15 @@ class LoadBalancer:
         # the shared control-plane journal.
         self.service_name = service_name
         self.autoscaler = autoscaler
+        # Span sampling rate in [0, 1] (default 1 = trace everything).
+        # Every traced proxied request persists ~7 span rows (lb.*
+        # here, engine.* on the replica); at high rps that churns
+        # gc_spans' row cap — this knob sheds that write load.
+        try:
+            self._span_sample = min(1.0, max(0.0, float(
+                os.environ.get('SKYTPU_LB_SPAN_SAMPLE', '1') or 1)))
+        except ValueError:
+            self._span_sample = 1.0
         self._session: Optional[aiohttp.ClientSession] = None
 
     def set_ready_replicas(self, urls: List[str]) -> None:
@@ -119,51 +136,119 @@ class LoadBalancer:
     async def _proxy(self, request: web.Request) -> web.StreamResponse:
         if self.autoscaler is not None:
             self.autoscaler.record_request()
+        # Serving-plane trace ingress: honor a well-formed client
+        # X-Skytpu-Trace-Id (one chat turn can then join its LB hop,
+        # engine spans and any control-plane events under one id) or
+        # mint one. The trace + this request's span id are FORWARDED
+        # to the replica, so engine-side spans parent under lb.upstream
+        # and /v1/traces shows lb → engine.queue → prefill → decode.
+        offered = request.headers.get('X-Skytpu-Trace-Id', '')
+        client_traced = trace_lib.is_valid_trace_id(offered)
+        tid = offered if client_traced else trace_lib.new_trace_id()
+        offered_parent = request.headers.get('X-Skytpu-Parent-Span', '')
+        parent = (offered_parent
+                  if trace_lib.is_valid_trace_id(offered_parent)
+                  else None)
+        # Sampling: a client-offered trace id is ALWAYS recorded
+        # (explicit debugging intent); organic traffic persists spans
+        # at SKYTPU_LB_SPAN_SAMPLE. A sampled-out request runs under
+        # spans.suppress() — same code path, nothing persisted, no
+        # carriers exported (so the replica's engine records nothing
+        # either); metrics/histograms still move.
+        if (client_traced or self._span_sample >= 1.0 or
+                random.random() < self._span_sample):
+            with trace_lib.trace_context(tid):
+                with spans_lib.span('lb.request', parent_id=parent,
+                                    entity=self.service_name,
+                                    attrs={'path': request.rel_url.path,
+                                           'policy': self.policy_name}
+                                    ) as root:
+                    return await self._proxy_traced(request, root)
+        with spans_lib.suppress():
+            with trace_lib.trace_context(tid):
+                with spans_lib.span('lb.request', parent_id=parent,
+                                    entity=self.service_name,
+                                    attrs={'path': request.rel_url.path,
+                                           'policy': self.policy_name}
+                                    ) as root:
+                    return await self._proxy_traced(request, root)
+
+    async def _proxy_traced(self, request: web.Request,
+                            root: 'spans_lib.Span') -> web.StreamResponse:
         if not self.policy.has_replicas():
             # Reject BEFORE buffering the body: a scaled-to-zero service
             # must not hold dead multi-MB uploads in RAM.
             _LB_REQUESTS.inc(policy=self.policy_name,
                              outcome='no_replica')
+            root.set_attr('outcome', 'no_replica')
             return web.json_response(
                 {'error': 'no ready replicas'}, status=503)
         t0 = time.monotonic()
         body = await request.read()
-        # Key extraction (a JSON parse) only when the policy uses it.
-        key = (_affinity_key(request, body)
-               if self.policy.wants_affinity_key else None)
-        target = self.policy.select(key)
+        with spans_lib.span('lb.pick', entity=self.service_name) as pick:
+            # Key extraction (a JSON parse) only when the policy uses
+            # it.
+            key = (_affinity_key(request, body)
+                   if self.policy.wants_affinity_key else None)
+            target = self.policy.select(key)
+            if target is not None:
+                pick.set_attr('replica', target)
         if target is None:
             _LB_REQUESTS.inc(policy=self.policy_name,
                              outcome='no_replica')
+            root.set_attr('outcome', 'no_replica')
             return web.json_response(
                 {'error': 'no ready replicas'}, status=503)
         if self._session is None:
             self._session = aiohttp.ClientSession(
                 timeout=aiohttp.ClientTimeout(total=300))
         url = target.rstrip('/') + request.rel_url.path_qs
+        # Strip any client-supplied X-Skytpu-* before stamping our own:
+        # forwarding them would DUPLICATE the headers (dict stamping
+        # can't replace a differently-cased client key), and the
+        # engine's multidict .get() returns the client's value first —
+        # letting a client spoof the entity (planting spans inside
+        # another service's scoped /-/lb/trace view) or detach engine
+        # spans from the LB's trace.
         headers = {k: v for k, v in request.headers.items()
-                   if k.lower() not in _HOP_HEADERS}
+                   if k.lower() not in _HOP_HEADERS
+                   and not k.lower().startswith('x-skytpu-')}
         self.policy.request_started(target)
         try:
-            async with self._session.request(request.method, url,
-                                             headers=headers,
-                                             data=body) as upstream:
-                resp = web.StreamResponse(status=upstream.status)
-                for k, v in upstream.headers.items():
-                    if k.lower() not in _HOP_HEADERS:
-                        resp.headers[k] = v
-                await resp.prepare(request)
-                # Stream the body through: LLM replies are long and
-                # incremental (SSE/chunked) — never buffer them whole.
-                async for chunk in upstream.content.iter_chunked(16384):
-                    await resp.write(chunk)
-                await resp.write_eof()
-                _LB_REQUESTS.inc(policy=self.policy_name,
-                                 outcome='proxied')
-                return resp
+            with spans_lib.span('lb.upstream', entity=self.service_name,
+                                attrs={'replica': target}) as up_span:
+                if not spans_lib.suppressed():
+                    headers['X-Skytpu-Trace-Id'] = up_span.trace_id or ''
+                    headers['X-Skytpu-Parent-Span'] = up_span.span_id
+                    # The engine stamps this entity on its request
+                    # spans so they fall inside /-/lb/trace/<id>'s
+                    # entity scope.
+                    if self.service_name:
+                        headers['X-Skytpu-Entity'] = self.service_name
+                async with self._session.request(request.method, url,
+                                                 headers=headers,
+                                                 data=body) as upstream:
+                    up_span.set_attr('status', upstream.status)
+                    resp = web.StreamResponse(status=upstream.status)
+                    for k, v in upstream.headers.items():
+                        if k.lower() not in _HOP_HEADERS:
+                            resp.headers[k] = v
+                    await resp.prepare(request)
+                    # Stream the body through: LLM replies are long and
+                    # incremental (SSE/chunked) — never buffer them
+                    # whole.
+                    async for chunk in upstream.content.iter_chunked(
+                            16384):
+                        await resp.write(chunk)
+                    await resp.write_eof()
+                    _LB_REQUESTS.inc(policy=self.policy_name,
+                                     outcome='proxied')
+                    root.set_attr('outcome', 'proxied')
+                    return resp
         except (aiohttp.ClientError, asyncio.TimeoutError) as e:
             _LB_REQUESTS.inc(policy=self.policy_name,
                              outcome='upstream_error')
+            root.set_attr('outcome', 'upstream_error')
             return web.json_response(
                 {'error': f'upstream {target} failed: {e}'}, status=502)
         finally:
@@ -203,12 +288,31 @@ class LoadBalancer:
         result = await asyncio.to_thread(journal_lib.query, **kwargs)
         return web.json_response({'events': result})
 
+    async def _trace(self, request: web.Request) -> web.Response:
+        """Span tree for one trace (``/-/lb/trace/<trace_id>``) —
+        entity-SCOPED like /-/lb/events: the LB port faces end users,
+        so with a bound service_name only spans stamped with this
+        service's entities (the lb.request/pick/upstream hops this
+        process recorded) are visible, not the rest of the shared
+        spans table. Off-loop: the read flushes the write-behind queue
+        and scans sqlite."""
+        trace_id = request.match_info.get('trace_id', '')
+        if not trace_lib.is_valid_trace_id(trace_id):
+            return web.json_response(
+                {'error': f'bad trace id {trace_id!r}'}, status=400)
+        # A None service_name disables entity scoping entirely — only
+        # legitimate for a standalone LB owning its whole journal DB.
+        result = await asyncio.to_thread(
+            spans_lib.tree, trace_id, self.service_name)
+        return web.json_response(result)
+
     # ------------------------------------------------------------------
     def build_app(self) -> web.Application:
         app = web.Application()
         app.router.add_get('/-/lb/health', self._health)
         app.router.add_get('/-/lb/metrics', self._metrics)
         app.router.add_get('/-/lb/events', self._events)
+        app.router.add_get('/-/lb/trace/{trace_id}', self._trace)
         app.router.add_route('*', '/{tail:.*}', self._proxy)
 
         async def _cleanup(app_):

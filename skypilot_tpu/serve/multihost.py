@@ -306,10 +306,17 @@ def follower_serve(engine, coordinator: str) -> None:
                 engine._publish()
             elif kind == 'cancel':
                 # Mark only; the slot frees at the reap after the next
-                # device op — the same point the leader frees it.
+                # device op — the same point the leader frees it. Every
+                # OTHER op records its flight events inside the shared
+                # engine methods, so follower rings mirror the leader's
+                # interleaving for free; cancel is applied inline here,
+                # so its ring event is too (comparing rings across
+                # hosts shows where a follower fell behind).
+                from skypilot_tpu.observe import flight as flight_lib
                 s = engine.slots[op[1]]
                 if s is not None and s['finish'] is None:
                     s['finish'] = 'stop'
+                    engine.flight.record(flight_lib.CANCEL, op[1])
             elif kind == 'reset':
                 engine._fail_all(RuntimeError('leader reset'))
             elif kind == 'stop':

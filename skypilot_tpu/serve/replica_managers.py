@@ -262,11 +262,21 @@ class ReplicaManager:
 
     def _launch_one(self, replica_id: int) -> None:
         from skypilot_tpu import execution
+        from skypilot_tpu.observe import spans
         name = self._cluster_name(replica_id)
         try:
             task = self._replica_task(replica_id)
-            _, handle = execution.launch(task, cluster_name=name,
-                                         detach_run=True)
+            # Launch threads start with an empty contextvar context, so
+            # the span parents via the env carrier (the controller
+            # process adopted the `serve up` request's trace/parent) —
+            # the replica's provision.attempt child spans then join the
+            # same tree. Entity-stamped so /-/lb/trace can expose it.
+            with spans.span('serve.replica_launch',
+                            entity=f'{self.service_name}/{replica_id}',
+                            attrs={'replica': replica_id,
+                                   'cluster': name}):
+                _, handle = execution.launch(task, cluster_name=name,
+                                             detach_run=True)
             assert handle is not None
             # Guarded transition FIRST: if the replica was terminated
             # while we were launching (scale-down, shutdown), the

@@ -49,6 +49,7 @@ class _InlineJob:
     def _run(rec: Dict) -> None:
         import traceback
         from skypilot_tpu import config as config_lib
+        from skypilot_tpu.observe import spans
         from skypilot_tpu.observe import trace
         from skypilot_tpu.server import registry
         # pid 0, NOT os.getpid(): the recorded pid is cancel_request's
@@ -56,16 +57,21 @@ class _InlineJob:
         # itself. 0 marks "no killable process" (cancel then refuses).
         requests_lib.set_running(rec['request_id'], 0)
         handler, _ = registry.HANDLERS[rec['name']]
-        # Contextvar only (NOT trace.adopt): the env is shared with
-        # every sibling request thread in this process, so writing it
-        # would cross-contaminate their traces. Threads start with a
-        # fresh context, so the set below scopes to this request.
+        # Contextvar only (NOT trace.adopt / spans.adopt_parent): the
+        # env is shared with every sibling request thread in this
+        # process, so writing it would cross-contaminate their traces
+        # and span parentage. Threads start with a fresh context, so
+        # the sets below scope to this request.
         if rec.get('trace_id'):
             trace.set_trace(rec['trace_id'])
+        spans.set_parent(rec['request_id'])
         try:
-            payload = rec['payload']
-            with config_lib.override(payload.get('_config_overrides') or {}):
-                result = handler(payload)
+            with spans.span('server.run', attrs={'name': rec['name'],
+                                                 'mode': 'thread'}):
+                payload = rec['payload']
+                with config_lib.override(
+                        payload.get('_config_overrides') or {}):
+                    result = handler(payload)
         except BaseException:  # pylint: disable=broad-except
             requests_lib.set_failed(rec['request_id'],
                                     traceback.format_exc())

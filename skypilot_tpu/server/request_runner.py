@@ -35,25 +35,43 @@ def main() -> None:
 
     requests_lib.set_running(rec['request_id'], os.getpid())
     handler, _ = registry.HANDLERS[rec['name']]
+    # The executor-run span: everything the handler does (optimizer,
+    # provisioning, the slice driver via subprocess env) parents under
+    # it, and it parents under the request's root span (span id ==
+    # request id). adopt_parent exports the env carrier — this is a
+    # dedicated per-request process, so process-wide adoption is safe
+    # (the thread-mode executor must NOT do this; see executor.py).
+    from skypilot_tpu.observe import spans
     try:
-        # Per-request config isolation (reference analog:
-        # sky/utils/context.py contextvars): the client's config overrides
-        # apply to THIS request only — the subprocess boundary guarantees
-        # no bleed into sibling requests.
-        from skypilot_tpu import config as config_lib
-        payload = rec['payload']
-        with config_lib.override(payload.get('_config_overrides') or {}):
-            result = handler(payload)
+        with spans.span('server.run', parent_id=rec['request_id'],
+                        attrs={'name': rec['name']}) as run_span:
+            spans.adopt_parent(run_span.span_id)
+            # Per-request config isolation (reference analog:
+            # sky/utils/context.py contextvars): the client's config
+            # overrides apply to THIS request only — the subprocess
+            # boundary guarantees no bleed into sibling requests.
+            from skypilot_tpu import config as config_lib
+            payload = rec['payload']
+            with config_lib.override(
+                    payload.get('_config_overrides') or {}):
+                result = handler(payload)
     except SystemExit as e:
         if e.code in (None, 0):
             requests_lib.set_result(rec['request_id'], None)
+            spans.flush(timeout=2.0)
             return
         requests_lib.set_failed(rec['request_id'], f'exit code {e.code}')
+        spans.flush(timeout=2.0)
         raise
     except BaseException:  # pylint: disable=broad-except
         requests_lib.set_failed(rec['request_id'], traceback.format_exc())
+        # The write-behind span queue lives on a daemon thread: drain
+        # it before the dedicated runner process exits or the run's
+        # spans die with it.
+        spans.flush(timeout=2.0)
         sys.exit(1)
     requests_lib.set_result(rec['request_id'], result)
+    spans.flush(timeout=2.0)
 
 
 if __name__ == '__main__':

@@ -16,6 +16,7 @@ from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.observe import journal as journal_lib
 from skypilot_tpu.observe import metrics as metrics_lib
+from skypilot_tpu.observe import spans as spans_lib
 from skypilot_tpu.observe import trace as trace_lib
 from skypilot_tpu.utils import sqlite_utils
 
@@ -159,10 +160,21 @@ def next_pending(schedule_type: str) -> Optional[Dict[str, Any]]:
             return None
         conn.execute('UPDATE requests SET started_at=? '
                      'WHERE request_id=?', (now, row[0]))
+    rec = get(row[0])
     if row[1] is not None:
         _QUEUE_WAIT.observe(max(0.0, now - row[1]),
                             schedule_type=schedule_type)
-    return get(row[0])
+        # The queue wait starts in the API server's ingress and ends in
+        # this dispatcher thread — a retroactive span (the scoped form
+        # cannot cross the gap). Parent = the request's root span,
+        # whose id IS the request id by contract, so no cross-process
+        # id exchange is needed.
+        spans_lib.record('server.queue_wait', start_wall=row[1],
+                         duration=max(0.0, now - row[1]),
+                         trace_id=rec.get('trace_id') if rec else None,
+                         parent_id=row[0],
+                         attrs={'schedule_type': schedule_type})
+    return rec
 
 
 def set_running(request_id: str, pid: int) -> None:
@@ -177,6 +189,26 @@ def _journal_finished(request_id: str, status: RequestStatus,
     journal_lib.record_event('api_request_finished', entity=request_id,
                              reason=reason,
                              data={'status': status.value})
+    # The request's ROOT span, recorded retroactively at the terminal
+    # write (its endpoints span the server and runner processes).
+    # span_id == request_id by contract: the dispatcher's queue-wait
+    # span and the runner's server.run span parent under it from other
+    # processes with no id exchange.
+    # Targeted read: get() would deserialize the payload AND the
+    # result blob set_result just serialized — an extra multi-MB JSON
+    # parse per finished request for three scalar columns.
+    with _conn() as conn:
+        row = conn.execute(
+            'SELECT created_at, name, trace_id FROM requests '
+            'WHERE request_id = ?', (request_id,)).fetchone()
+    if row is None or not row[0]:
+        return
+    spans_lib.record('api.request', start_wall=row[0],
+                     duration=max(0.0, time.time() - row[0]),
+                     trace_id=row[2],
+                     span_id=request_id,
+                     attrs={'name': row[1],
+                            'status': status.value})
 
 
 def set_result(request_id: str, result: Any) -> None:

@@ -281,6 +281,24 @@ async def events(request: web.Request) -> web.Response:
     return _json({'events': result})
 
 
+async def traces(request: web.Request) -> web.Response:
+    """One request's latency decomposition (``/v1/traces/<trace_id>``):
+    the rooted span tree — ingress → queue wait → executor run →
+    optimizer → per-zone provisioning on the control plane; LB pick →
+    upstream → engine queue/prefill/decode on the serving plane —
+    assembled from the ``spans`` table keyed by the trace id
+    (docs/OBSERVABILITY.md#span-trees)."""
+    from skypilot_tpu.observe import spans as spans_lib
+    trace_id = request.match_info.get('trace_id', '')
+    from skypilot_tpu.observe import trace as trace_lib
+    if not trace_lib.is_valid_trace_id(trace_id):
+        return _json({'error': f'bad trace id {trace_id!r}'}, status=400)
+    # Off-loop: the tree read flushes the write-behind queue and scans
+    # sqlite — neither may stall in-flight handlers.
+    result = await asyncio.to_thread(spans_lib.tree, trace_id)
+    return _json(result)
+
+
 async def dashboard_page(request: web.Request) -> web.Response:
     # Token hygiene: ?token=... lands in access logs and browser history,
     # so it is accepted exactly once — swapped for an HttpOnly cookie and
@@ -543,10 +561,11 @@ async def _gc_loop(app: web.Application) -> None:
             n = requests_lib.gc_requests()
             if n:
                 logger.info(f'request GC: pruned {n} old records')
-            from skypilot_tpu.observe import journal as journal_lib
-            n = await asyncio.to_thread(journal_lib.gc_events)
-            if n:
-                logger.info(f'journal GC: pruned {n} old events')
+            from skypilot_tpu import observe
+            pruned = await asyncio.to_thread(observe.gc)
+            if any(pruned.values()):
+                logger.info(f'observe GC: pruned {pruned["events"]} '
+                            f'event(s), {pruned["spans"]} span(s)')
         except asyncio.CancelledError:
             return
         except Exception as e:  # pylint: disable=broad-except
@@ -583,6 +602,8 @@ def build_app() -> web.Application:
     app.router.add_get('/metrics', metrics)
     app.router.add_get('/api/v1/events', events)
     app.router.add_get('/v1/events', events)
+    app.router.add_get('/api/v1/traces/{trace_id}', traces)
+    app.router.add_get('/v1/traces/{trace_id}', traces)
     app.router.add_get('/api/v1/tunnel', tunnel)
     app.router.add_post('/api/v1/request_cancel', request_cancel)
     app.router.add_get('/dashboard', dashboard_page)

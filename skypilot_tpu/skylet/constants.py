@@ -60,7 +60,8 @@ def gang_env(*,
              hosts_per_slice: int = 1,
              coordinator_ip: str = '127.0.0.1',
              mh_token: Optional[str] = None,
-             trace_id: Optional[str] = None) -> Dict[str, str]:
+             trace_id: Optional[str] = None,
+             parent_span_id: Optional[str] = None) -> Dict[str, str]:
     """The full per-host env block for one gang member.
 
     - SKYPILOT_*: GPU-era contract (NUM_GPUS_PER_NODE carries chips/host so
@@ -77,6 +78,10 @@ def gang_env(*,
       telemetry (observe journal, timeline, usage) joins against the
       control-plane's — the last hop of the trace propagation chain
       (docs/OBSERVABILITY.md).
+    - SKYTPU_PARENT_SPAN_ID (`parent_span_id`): the span-tree parent
+      for any spans a rank records (observe/spans.py) — remote spans
+      then nest under the driver's gang span in `/v1/traces/<id>`
+      instead of surfacing as orphan roots.
     """
     worker_id = rank % hosts_per_slice if hosts_per_slice else rank
     env = {
@@ -104,6 +109,8 @@ def gang_env(*,
         env['SKYTPU_MH_TOKEN'] = mh_token
     if trace_id:
         env['SKYTPU_TRACE_ID'] = trace_id
+    if parent_span_id:
+        env['SKYTPU_PARENT_SPAN_ID'] = parent_span_id
     if num_slices > 1:
         env.update({
             'MEGASCALE_COORDINATOR_ADDRESS': coordinator_ip,

@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Any, Dict, List, Optional
 
+from skypilot_tpu.observe import spans as spans_lib
 from skypilot_tpu.skylet import constants
 from skypilot_tpu.skylet import job_lib
 from skypilot_tpu.utils.status_lib import JobStatus
@@ -153,13 +154,24 @@ def run_gang(spec: Dict[str, Any]) -> int:
     # user-supplied SKYTPU_MH_TOKEN in the job's envs wins — restarts
     # orchestrated outside the driver may need a stable token.
     mh_token = user_envs.get('SKYTPU_MH_TOKEN') or secrets.token_hex(16)
-    # The trace rides the spec JSON (the env does not cross the ssh
-    # boundary the driver was started over); adopting it here makes the
-    # driver's own journal writes (job_lib.set_status below) and every
-    # rank carry the control-plane correlation id.
+    # The trace (and span parent) ride the spec JSON (the env does not
+    # cross the ssh boundary the driver was started over); adopting
+    # them here makes the driver's own journal writes
+    # (job_lib.set_status below) and every rank carry the
+    # control-plane correlation id and span parentage.
     trace_id = spec.get('trace_id') or os.environ.get('SKYTPU_TRACE_ID')
     if trace_id:
         os.environ['SKYTPU_TRACE_ID'] = trace_id
+    launch_parent = (spec.get('parent_span_id') or
+                     os.environ.get(spans_lib.ENV_PARENT))
+    # The gang span covers the whole on-cluster run (spawn → barrier →
+    # exit) and is the parent every rank's spans nest under. Its id is
+    # MINTED up front and the span recorded retroactively at the end:
+    # the driver outlives arbitrary user code, and a `with` spanning
+    # the gang wait would lose the span on a driver crash mid-wait.
+    gang_span_id = spans_lib.new_span_id()
+    spans_lib.adopt_parent(gang_span_id)
+    t_gang_start = time.time()
 
     job_lib.set_status(job_id, JobStatus.RUNNING, pid=os.getpid())
 
@@ -169,43 +181,52 @@ def run_gang(spec: Dict[str, Any]) -> int:
     pumps: List[threading.Thread] = []
     failed_rank: Optional[int] = None
     with open(agg_path, 'a', encoding='utf-8') as agg:
-        for rank, host in enumerate(hosts):
-            env = dict(user_envs)
-            env.update(
-                constants.gang_env(
-                    rank=rank,
-                    ips=ips,
-                    num_hosts=len(hosts),
-                    chips_per_host=chips_per_host,
-                    job_id=job_id,
-                    cluster_name=cluster_name,
-                    slice_index=int(host.get('slice_index', 0)),
-                    num_slices=num_slices,
-                    hosts_per_slice=hosts_per_slice,
-                    coordinator_ip=coordinator_ip,
-                    mh_token=mh_token,
-                    trace_id=trace_id,
-                ))
-            env.update(host.get('extra_env', {}))
-            cmd = _build_rank_command(host, run_cmd, env,
-                                      docker=spec.get('docker'))
-            rank_log = os.path.join(
-                log_dir, constants.RANK_LOG_FMT.format(rank=rank))
-            proc = subprocess.Popen(
-                cmd,
-                stdout=subprocess.PIPE,
-                stderr=subprocess.STDOUT,
-                text=True,
-                bufsize=1,
-                start_new_session=True,
-            )
-            rp = _RankProc(rank, proc, rank_log)
-            procs.append(rp)
-            t = threading.Thread(target=_pump,
-                                 args=(proc, rank, rank_log, agg, agg_lock),
-                                 daemon=True)
-            t.start()
-            pumps.append(t)
+        # Gang setup is its own child span: "slow launch" usually means
+        # this loop (ssh/kubectl/agent process spawns), and the tree
+        # should show it apart from the job's own runtime.
+        with spans_lib.span('driver.gang_setup', parent_id=gang_span_id,
+                            trace_id=trace_id,
+                            attrs={'job_id': job_id,
+                                   'hosts': len(hosts)}):
+            for rank, host in enumerate(hosts):
+                env = dict(user_envs)
+                env.update(
+                    constants.gang_env(
+                        rank=rank,
+                        ips=ips,
+                        num_hosts=len(hosts),
+                        chips_per_host=chips_per_host,
+                        job_id=job_id,
+                        cluster_name=cluster_name,
+                        slice_index=int(host.get('slice_index', 0)),
+                        num_slices=num_slices,
+                        hosts_per_slice=hosts_per_slice,
+                        coordinator_ip=coordinator_ip,
+                        mh_token=mh_token,
+                        trace_id=trace_id,
+                        parent_span_id=gang_span_id,
+                    ))
+                env.update(host.get('extra_env', {}))
+                cmd = _build_rank_command(host, run_cmd, env,
+                                          docker=spec.get('docker'))
+                rank_log = os.path.join(
+                    log_dir, constants.RANK_LOG_FMT.format(rank=rank))
+                proc = subprocess.Popen(
+                    cmd,
+                    stdout=subprocess.PIPE,
+                    stderr=subprocess.STDOUT,
+                    text=True,
+                    bufsize=1,
+                    start_new_session=True,
+                )
+                rp = _RankProc(rank, proc, rank_log)
+                procs.append(rp)
+                t = threading.Thread(target=_pump,
+                                     args=(proc, rank, rank_log, agg,
+                                           agg_lock),
+                                     daemon=True)
+                t.start()
+                pumps.append(t)
 
         # Gang wait: poll all ranks; first failure kills the rest.
         pending = set(range(len(procs)))
@@ -245,6 +266,20 @@ def run_gang(spec: Dict[str, Any]) -> int:
         for t in pumps:
             t.join()
 
+    def _finish_gang_span(rc: int) -> None:
+        """The gang ROOT span, recorded retroactively at exit (a
+        `with` spanning the whole gang wait would lose the span if the
+        driver died mid-wait; minting the id up front let ranks parent
+        under it all along)."""
+        spans_lib.record('driver.gang', span_id=gang_span_id,
+                         parent_id=launch_parent, trace_id=trace_id,
+                         start_wall=t_gang_start,
+                         duration=time.time() - t_gang_start,
+                         attrs={'job_id': job_id, 'hosts': len(hosts),
+                                'rc': rc,
+                                'failed_rank': failed_rank})
+        spans_lib.flush(timeout=2.0)
+
     if failed_rank is None:
         # Storage flush barrier (MOUNT_CACHED): run the epilogue on every
         # host in parallel (each flush may block minutes draining its
@@ -281,11 +316,14 @@ def run_gang(spec: Dict[str, Any]) -> int:
                         agg.write(f'[driver] flush barrier failed on rank '
                                   f'{rank}: {out}\n')
                     job_lib.set_status(job_id, JobStatus.FAILED)
+                    _finish_gang_span(rc)
                     return rc
         job_lib.set_status(job_id, JobStatus.SUCCEEDED)
+        _finish_gang_span(0)
         return 0
     job_lib.set_status(job_id, JobStatus.FAILED)
     bad = next(p for p in procs if p.rank == failed_rank)
+    _finish_gang_span(bad.returncode or 1)
     return bad.returncode or 1
 
 

@@ -387,3 +387,133 @@ class TestEngineMetrics:
                 '# TYPE skytpu_engine_step_seconds histogram',
         ):
             assert needle in text, needle
+
+
+class TestEngineFlightAndSpans:
+    """Tentpole observability: the hot loop records flight-ring tuples
+    only; TTFT/TPOT derive from ring-aligned deltas at publish;
+    request spans are recorded by the HTTP handler AFTER the request
+    resolves, parented under the forwarded LB carriers; failures
+    snapshot the ring into the journal."""
+
+    def test_request_spans_flight_dump_and_latency_histograms(
+            self, engine, monkeypatch, tmp_path):
+        from skypilot_tpu.observe import spans as spans_lib
+        from skypilot_tpu.observe import trace as trace_lib
+        monkeypatch.setenv('SKYTPU_OBSERVE_DB',
+                           str(tmp_path / 'journal.db'))
+        # Module-scoped engine: earlier tests left ring events and
+        # unconsumed timing entries — start this one clean.
+        engine.flight.clear()
+        engine._timings.clear()
+        tid = trace_lib.new_trace_id()
+        parent = 'ab' * 8        # the LB's lb.upstream span id
+
+        async def fn(client):
+            r = await client.post(
+                '/generate',
+                json={'tokens': [5] * 8, 'max_new_tokens': 6},
+                headers={'X-Skytpu-Trace-Id': tid,
+                         'X-Skytpu-Parent-Span': parent,
+                         'X-Skytpu-Entity': 'svc'})
+            assert r.status == 200
+            body = await r.json()
+            assert len(body['tokens']) == 6
+            rf = await client.get('/debug/flight')
+            assert rf.status == 200
+            flight_doc = await rf.json()
+            rm = await client.get('/metrics')
+            return flight_doc, await rm.text()
+
+        flight_doc, metrics_text = _with_client(engine, fn)
+        # Flight ring saw the request's whole hot-loop life.
+        kinds = {e['event'] for e in flight_doc['events']}
+        assert {'admit', 'dispatch', 'collect', 'finish'} <= kinds
+        assert flight_doc['capacity'] >= 1
+        (fin,) = [e for e in flight_doc['events']
+                  if e['event'] == 'finish']
+        assert fin['seq'] == 6               # tokens generated
+        # TTFT/TPOT histograms observed once per request, not per token.
+        assert 'skytpu_engine_ttft_seconds_bucket' in metrics_text
+        for line in metrics_text.splitlines():
+            if line.startswith('skytpu_engine_ttft_seconds_count'):
+                assert float(line.rsplit(' ', 1)[1]) >= 1
+            if line.startswith('skytpu_engine_tpot_seconds_count'):
+                assert float(line.rsplit(' ', 1)[1]) >= 1
+        # The handler recorded the engine decomposition under the
+        # forwarded carriers.
+        spans_lib.flush()
+        by_name = {s['name']: s
+                   for s in spans_lib.query_spans(trace_id=tid)}
+        assert set(by_name) >= {'engine.request', 'engine.queue',
+                                'engine.prefill', 'engine.decode'}
+        req = by_name['engine.request']
+        assert req['parent_id'] == parent
+        # The LB-forwarded entity is stamped on every engine span, so
+        # they pass /-/lb/trace's entity-scope filter on a shared DB.
+        assert req['entity'] == 'svc'
+        assert req['attrs']['tokens'] == 6
+        assert req['attrs']['ttft_s'] >= 0
+        assert req['attrs']['tpot_s'] > 0
+        for child in ('engine.queue', 'engine.prefill', 'engine.decode'):
+            assert by_name[child]['parent_id'] == req['span_id']
+            assert by_name[child]['entity'] == 'svc'
+        assert by_name['engine.prefill']['duration'] > 0
+        assert by_name['engine.decode']['duration'] > 0
+        # Timing is consumed exactly once — popped, not leaked.
+        assert not engine._timings
+
+    def test_no_trace_offered_records_no_spans(self, engine,
+                                               monkeypatch, tmp_path):
+        from skypilot_tpu.observe import spans as spans_lib
+        monkeypatch.setenv('SKYTPU_OBSERVE_DB',
+                           str(tmp_path / 'journal.db'))
+
+        async def fn(client):
+            r = await client.post('/generate', json={
+                'tokens': [7] * 8, 'max_new_tokens': 3})
+            assert r.status == 200
+
+        _with_client(engine, fn)
+        spans_lib.flush()
+        assert spans_lib.query_spans(name='engine.request') == []
+        # But the timing was still derived (histograms got it) and the
+        # sidecar does not leak entries for unconsumed futures forever.
+        assert len(engine._timings) <= 1024
+
+    def test_injected_failure_snapshots_flight_to_journal(
+            self, engine, monkeypatch, tmp_path):
+        from skypilot_tpu.observe import journal as journal_lib
+        monkeypatch.setenv('SKYTPU_OBSERVE_DB',
+                           str(tmp_path / 'journal.db'))
+        orig = engine_lib.InferenceEngine._collect_step
+        state = {'arm': True}
+
+        def failing(self):
+            if state['arm']:
+                state['arm'] = False
+                raise RuntimeError('injected device failure')
+            return orig(self)
+
+        monkeypatch.setattr(engine_lib.InferenceEngine, '_collect_step',
+                            failing)
+
+        async def fn(client):
+            r = await client.post('/generate', json={
+                'tokens': [9] * 8, 'max_new_tokens': 24})
+            assert r.status == 500
+            r2 = await client.post('/generate', json={
+                'tokens': [9] * 8, 'max_new_tokens': 3})
+            assert r2.status == 200
+
+        _with_client(engine, fn)
+        snaps = journal_lib.query(kind='flight_snapshot')
+        assert snaps, 'engine failure must ship a flight snapshot'
+        snap = snaps[-1]
+        assert 'injected device failure' in snap['reason']
+        assert snap['entity'].startswith('engine/')
+        data = snap['data']
+        assert data['columns'] == ['t_ns', 'code', 'slot', 'seq']
+        assert data['events'], 'snapshot carries the hot-loop history'
+        codes = {str(c) for c in data['codes'].values()}
+        assert 'dispatch' in codes

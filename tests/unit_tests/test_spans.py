@@ -1,0 +1,634 @@
+"""Timed span trees + flight recorder (observe/spans.py, flight.py).
+
+Four layers of coverage:
+  1. Span mechanics: contextvar nesting, retroactive record, the
+     write-behind queue, tree assembly (orphans surface as roots),
+     retention GC, Chrome export.
+  2. Propagation edges: parentage survives ``asyncio.to_thread`` and
+     the thread-adoption path (executor thread mode), and the
+     ``SKYTPU_PARENT_SPAN_ID`` env carrier round-trips through a real
+     spawned subprocess.
+  3. Flight ring: wraparound loses nothing but the oldest entries,
+     16 concurrent writers lose nothing (mirroring test_observe's
+     registry contention test), journal snapshots.
+  4. End-to-end: a REAL local-cloud launch decomposes at the live API
+     server's ``/v1/traces/<id>`` (ingress → optimize → provision →
+     gang setup, non-zero durations, cross-process driver spans
+     parented via the spec carrier), and a proxied LB request
+     decomposes at ``/-/lb/trace/<id>`` (lb.request → lb.pick /
+     lb.upstream), entity-scoped.
+"""
+import asyncio
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from aiohttp import web
+from aiohttp.test_utils import TestClient
+from aiohttp.test_utils import TestServer as AioTestServer
+
+from skypilot_tpu.observe import flight
+from skypilot_tpu.observe import journal
+from skypilot_tpu.observe import metrics
+from skypilot_tpu.observe import spans
+from skypilot_tpu.observe import trace
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+@pytest.fixture()
+def observe_env(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKYTPU_OBSERVE_DB', str(tmp_path / 'journal.db'))
+    monkeypatch.delenv('SKYTPU_TRACE_ID', raising=False)
+    monkeypatch.delenv(spans.ENV_PARENT, raising=False)
+    metrics.REGISTRY.reset_for_tests()
+    yield tmp_path
+    metrics.REGISTRY.reset_for_tests()
+
+
+def _run_async(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+# ---------------------------------------------------------------- mechanics
+
+@pytest.mark.usefixtures('observe_env')
+class TestSpanMechanics:
+
+    def test_nesting_parentage_attrs_and_tree(self):
+        with trace.trace_context() as tid:
+            with spans.span('root', attrs={'name': 'launch'}) as root:
+                with spans.span('child.a') as a:
+                    with spans.span('grand'):
+                        time.sleep(0.002)
+                with spans.span('child.b', zone='us-x1-a') as b:
+                    b.set_attr('outcome', 'success')
+        assert spans.flush()
+        t = spans.tree(tid)
+        assert t['span_count'] == 4
+        assert len(t['roots']) == 1
+        r = t['roots'][0]
+        assert r['name'] == 'root' and r['span_id'] == root.span_id
+        assert r['attrs'] == {'name': 'launch'}
+        kids = {c['name']: c for c in r['children']}
+        assert set(kids) == {'child.a', 'child.b'}
+        assert kids['child.a']['children'][0]['name'] == 'grand'
+        assert kids['child.a']['duration'] >= 0.002
+        # kwargs merge into attrs; set_attr lands too.
+        assert kids['child.b']['attrs'] == {'zone': 'us-x1-a',
+                                            'outcome': 'success'}
+        assert all(s['duration'] > 0 for s in (r, kids['child.a']))
+        # The rendering carries durations and % of parent.
+        text = spans.format_tree(t)
+        assert 'root' in text and '% of parent' in text
+
+    def test_exception_records_error_attr_and_finishes(self):
+        with trace.trace_context() as tid:
+            with pytest.raises(ValueError):
+                with spans.span('failing'):
+                    raise ValueError('boom')
+        spans.flush()
+        (s,) = spans.query_spans(trace_id=tid)
+        assert s['attrs']['error'] == 'ValueError: boom'
+
+    def test_retroactive_record_with_preset_id_links_cross_process(self):
+        """The api.request root span's id IS the request id by
+        contract, so another process's queue-wait span parents under
+        it with no id exchange — both arrive retroactively, in either
+        order."""
+        with trace.trace_context() as tid:
+            spans.record('server.queue_wait', start_wall=time.time(),
+                         duration=0.05, parent_id='req-root-1')
+            spans.record('api.request', start_wall=time.time() - 1,
+                         duration=1.0, span_id='req-root-1')
+        spans.flush()
+        t = spans.tree(tid)
+        assert len(t['roots']) == 1
+        assert t['roots'][0]['span_id'] == 'req-root-1'
+        assert t['roots'][0]['children'][0]['name'] == 'server.queue_wait'
+
+    def test_orphan_parent_surfaces_as_root_not_dropped(self):
+        with trace.trace_context() as tid:
+            spans.record('lost.child', start_wall=time.time(),
+                         duration=0.1, parent_id='never-persisted')
+        spans.flush()
+        t = spans.tree(tid)
+        assert [r['name'] for r in t['roots']] == ['lost.child']
+
+    def test_gc_spans_age_and_rowcap(self):
+        now = time.time()
+        for i in range(20):
+            spans.record(f'old.{i}', start_wall=now - 10 * 24 * 3600,
+                         duration=0.1)
+        for i in range(20):
+            spans.record(f'new.{i}', start_wall=now, duration=0.1)
+        spans.flush()
+        deleted = spans.gc_spans(max_age_seconds=7 * 24 * 3600,
+                                 max_rows=10)
+        assert deleted >= 20
+        left = spans.query_spans()
+        assert len(left) == 10
+        assert all(s['name'].startswith('new.') for s in left)
+        # The shared observe.gc() covers both tables in one call.
+        from skypilot_tpu import observe
+        pruned = observe.gc()
+        assert set(pruned) == {'events', 'spans'}
+
+    def test_chrome_export_merges_timeline(self, tmp_path, monkeypatch):
+        tl_path = tmp_path / 'timeline.json'
+        with trace.trace_context() as tid:
+            spans.record('hop', start_wall=time.time(), duration=0.25,
+                         attrs={'zone': 'z'})
+            tl_path.write_text(json.dumps({'traceEvents': [
+                {'name': 'fn', 'ph': 'X', 'ts': 1.0, 'dur': 2.0,
+                 'args': {'trace_id': tid}},
+                {'name': 'other', 'ph': 'X', 'ts': 1.0, 'dur': 2.0,
+                 'args': {'trace_id': 'someone-else'}},
+            ]}))
+        spans.flush()
+        monkeypatch.setenv('SKYTPU_TIMELINE_FILE_PATH', str(tl_path))
+        doc = spans.chrome_trace(trace_id=tid)
+        names = [e['name'] for e in doc['traceEvents']]
+        assert 'hop' in names and 'fn' in names
+        assert 'other' not in names          # filtered by trace id
+        (hop,) = [e for e in doc['traceEvents'] if e['name'] == 'hop']
+        assert hop['ph'] == 'X' and hop['dur'] == pytest.approx(0.25e6)
+        assert hop['args']['attr.zone'] == 'z'
+
+    def test_disable_env_suppresses_recording(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_DISABLE_SPANS', '1')
+        with trace.trace_context() as tid:
+            with spans.span('nope'):
+                pass
+            assert spans.record('nor.this', start_wall=0.0,
+                                duration=1.0) is None
+        spans.flush()
+        monkeypatch.delenv('SKYTPU_DISABLE_SPANS')
+        assert spans.query_spans(trace_id=tid) == []
+
+    def test_client_mode_execute_mints_trace_root(self, monkeypatch):
+        """The hermetic local mode: CLI/SDK call straight into
+        execution._execute with no API server having minted a trace —
+        the stage spans must root under a minted client.execute span
+        instead of landing traceless and orphaned. With a trace already
+        active (server mode), no extra root appears."""
+        from skypilot_tpu import execution
+        from skypilot_tpu import task as task_lib
+        seen = {}
+
+        def fake_inner(task, **kwargs):
+            seen['trace'] = trace.get()
+            with spans.span('optimizer.plan'):
+                pass
+            return None, None
+
+        monkeypatch.setattr(execution, '_execute_inner', fake_inner)
+        t = task_lib.Task(run='echo hi')
+        execution._execute(t, cluster_name='c1', stages=[])
+        assert seen['trace'], 'client mode must mint a trace'
+        result = spans.tree(seen['trace'])
+        assert [r['name'] for r in result['roots']] == ['client.execute']
+        assert [c['name'] for c in result['roots'][0]['children']] == [
+            'optimizer.plan']
+        # Server mode: the executor owns the root; _execute adds none.
+        with trace.trace_context() as tid:
+            execution._execute(t, cluster_name='c1', stages=[])
+        assert seen['trace'] == tid
+        names = [s['name'] for s in spans.query_spans(trace_id=tid)]
+        assert 'client.execute' not in names
+
+
+# ---------------------------------------------------------------- propagation
+
+@pytest.mark.usefixtures('observe_env')
+class TestSpanPropagation:
+
+    def test_parentage_survives_asyncio_to_thread(self):
+        """The request_runner/batch-loop idiom: device/blocking work
+        hops through asyncio.to_thread, and spans opened inside must
+        still nest under the caller's span (contextvars copy into the
+        worker thread)."""
+
+        def blocking_work():
+            with spans.span('inner.thread_hop'):
+                time.sleep(0.001)
+
+        async def fn():
+            with trace.trace_context() as tid:
+                with spans.span('outer') as outer:
+                    await asyncio.to_thread(blocking_work)
+                return tid, outer.span_id
+
+        tid, outer_id = _run_async(fn())
+        spans.flush()
+        by_name = {s['name']: s for s in spans.query_spans(trace_id=tid)}
+        assert by_name['inner.thread_hop']['parent_id'] == outer_id
+
+    def test_thread_adoption_isolated_per_request(self):
+        """The thread-mode executor path (server/executor.py): sibling
+        request threads each set_parent their own request id in a
+        FRESH context — neither leaks into the other (the shared env
+        must not carry per-request parentage)."""
+        results = {}
+
+        def request_thread(req_id):
+            spans.set_parent(req_id)
+            with spans.span('server.run') as s:
+                time.sleep(0.001)
+            results[req_id] = (s.parent_id, spans.current())
+
+        threads = [threading.Thread(target=request_thread,
+                                    args=(f'req-{i}',))
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert results['req-0'][0] == 'req-0'
+        assert results['req-1'][0] == 'req-1'
+        # Adoption stayed contextvar-only: this (main) context and the
+        # process env never saw either parent.
+        assert spans.current() is None
+        assert spans.ENV_PARENT not in os.environ
+
+    def test_env_carrier_round_trips_through_subprocess(self, tmp_path):
+        """The gang-env contract: a child process (rank, driver) finds
+        SKYTPU_PARENT_SPAN_ID + SKYTPU_TRACE_ID in its env and its
+        spans parent under the exporting process's span in the shared
+        tree — the real subprocess boundary, not a simulation."""
+        with trace.trace_context() as tid:
+            with spans.span('driver.gang') as gang:
+                env = dict(os.environ)
+                env.update(trace.env_with_trace(spans.env_with_span()))
+                env['PYTHONPATH'] = REPO
+                assert env[spans.ENV_PARENT] == gang.span_id
+                proc = subprocess.run(
+                    [sys.executable, '-c', (
+                        'from skypilot_tpu.observe import spans\n'
+                        'with spans.span("rank.work"):\n'
+                        '    pass\n'
+                        'assert spans.flush()\n'
+                        'print(spans.current())\n')],
+                    env=env, capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr
+        # The child saw the exported parent through the env carrier.
+        assert gang.span_id in proc.stdout
+        spans.flush()
+        t = spans.tree(tid)
+        (root,) = t['roots']
+        assert root['name'] == 'driver.gang'
+        assert [c['name'] for c in root['children']] == ['rank.work']
+        assert root['children'][0]['pid'] != os.getpid()
+
+
+# ---------------------------------------------------------------- flight ring
+
+class TestFlightRecorder:
+
+    def test_wraparound_loses_only_oldest(self):
+        ring = flight.FlightRecorder(capacity=8)
+        for i in range(20):
+            ring.record(flight.DISPATCH, slot=i, seq=i)
+        entries = ring.snapshot()
+        assert len(entries) == 8
+        # Newest 8 survive, in timestamp order.
+        assert [e[2] for e in entries] == list(range(12, 20))
+
+    def test_sixteen_thread_contention_loses_nothing(self):
+        """Concurrent writers from follower/leader threads: with
+        capacity >= total writes, every event survives (the atomic
+        counter hands each write a distinct slot)."""
+        ring = flight.FlightRecorder(capacity=16 * 500)
+        barrier = threading.Barrier(16)
+
+        def worker(wid):
+            barrier.wait()
+            for i in range(500):
+                ring.record(flight.ADMIT, slot=wid, seq=i)
+
+        threads = [threading.Thread(target=worker, args=(w,))
+                   for w in range(16)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        entries = ring.snapshot()
+        assert len(entries) == 16 * 500
+        per_writer = {}
+        for _, code, slot, seq in entries:
+            assert code == flight.ADMIT
+            per_writer.setdefault(slot, set()).add(seq)
+        assert all(per_writer[w] == set(range(500)) for w in range(16))
+
+    def test_dump_decodes_and_limits(self):
+        ring = flight.FlightRecorder(capacity=16)
+        ring.record(flight.DISPATCH, 0, 8)
+        ring.record(flight.COLLECT, 0, 8)
+        ring.record(flight.FINISH, 3, 42)
+        out = ring.dump()
+        assert [e['event'] for e in out] == ['dispatch', 'collect',
+                                             'finish']
+        assert out[-1] == {'t_ns': out[-1]['t_ns'], 'event': 'finish',
+                           'slot': 3, 'seq': 42}
+        assert [e['event'] for e in ring.dump(limit=1)] == ['finish']
+        ring.clear()
+        assert ring.snapshot() == []
+
+    @pytest.mark.usefixtures('observe_env')
+    def test_snapshot_to_journal(self):
+        ring = flight.FlightRecorder(capacity=64)
+        for i in range(5):
+            ring.record(flight.DISPATCH, 0, i)
+        assert flight.snapshot_to_journal(ring, reason='test failure',
+                                          entity='engine/test',
+                                          max_events=3)
+        (ev,) = journal.query(kind='flight_snapshot')
+        assert ev['entity'] == 'engine/test'
+        assert ev['reason'] == 'test failure'
+        data = ev['data']
+        assert data['columns'] == ['t_ns', 'code', 'slot', 'seq']
+        assert len(data['events']) == 3            # newest 3 kept
+        assert [e[3] for e in data['events']] == [2, 3, 4]
+        # An empty ring writes nothing.
+        empty = flight.FlightRecorder(capacity=4)
+        assert not flight.snapshot_to_journal(empty)
+
+
+# ---------------------------------------------------------------- end to end
+
+@pytest.mark.usefixtures('enable_local_cloud', 'isolated_state')
+class TestLaunchTraceEndToEnd:
+
+    def test_launch_decomposes_at_live_server_endpoint(self):
+        """THE control-plane acceptance path: a real local-cloud launch
+        under one trace, decomposed by the live API server's
+        /v1/traces/<id> — ingress root → optimizer.plan →
+        provision.attempt → runtime setup → driver.gang(+setup), the
+        driver spans crossing a real subprocess boundary via the spec
+        carrier, all with non-zero durations."""
+        import skypilot_tpu as sky
+        from skypilot_tpu.utils.status_lib import JobStatus
+
+        with trace.trace_context() as tid:
+            with spans.span('api.request', attrs={'name': 'launch'}):
+                task = sky.Task(name='hello', run='echo hi')
+                task.set_resources(
+                    sky.Resources(accelerators='tpu-v5e-8'))
+                job_id, handle = sky.launch(task, cluster_name='t-span',
+                                            detach_run=True)
+                assert handle is not None
+                deadline = time.time() + 60
+                status = None
+                while time.time() < deadline:
+                    status = sky.job_status('t-span', job_id)
+                    if status is not None and status.is_terminal():
+                        break
+                    time.sleep(0.5)
+                assert status == JobStatus.SUCCEEDED
+        sky.down('t-span')
+        spans.flush()
+        # The driver subprocess flushes its own spans on exit; give a
+        # slow container a moment before reading the shared DB.
+        deadline = time.time() + 10
+        names = set()
+        while time.time() < deadline:
+            names = {s['name'] for s in spans.query_spans(trace_id=tid)}
+            if 'driver.gang_setup' in names:
+                break
+            time.sleep(0.5)
+
+        from skypilot_tpu.server import server as server_lib
+
+        async def fn():
+            client = TestClient(AioTestServer(server_lib.build_app()))
+            await client.start_server()
+            try:
+                r = await client.get(f'/v1/traces/{tid}')
+                assert r.status == 200
+                tree_doc = await r.json()
+                r = await client.get('/v1/traces/not-hex-zz')
+                assert r.status == 400
+            finally:
+                await client.close()
+            return tree_doc
+
+        tree_doc = _run_async(fn())
+        assert tree_doc['trace_id'] == tid
+        (root,) = tree_doc['roots']
+        assert root['name'] == 'api.request'
+        kids = {c['name']: c for c in root['children']}
+        assert {'optimizer.plan', 'provision.attempt',
+                'provision.runtime_setup', 'driver.gang'} <= set(kids)
+        assert kids['provision.attempt']['attrs']['outcome'] == 'success'
+        assert kids['provision.attempt']['attrs']['zone']
+        gang = kids['driver.gang']
+        assert [c['name'] for c in gang['children']] == \
+            ['driver.gang_setup']
+        for s in [root, *kids.values(), gang['children'][0]]:
+            assert s['duration'] > 0
+
+
+@pytest.mark.usefixtures('observe_env')
+class TestLBTraceEndpoint:
+
+    def test_proxied_request_decomposes_scoped(self):
+        """Serving-plane acceptance: one proxied request under a
+        client-offered trace id decomposes at the live LB's
+        /-/lb/trace/<id> (lb.request → lb.pick / lb.upstream), the
+        trace + parent-span carriers reach the replica as headers, and
+        the endpoint stays entity-scoped (a sibling service's span
+        with the same trace id is not exposed)."""
+        from skypilot_tpu.serve import load_balancer as lb_lib
+        tid = trace.new_trace_id()
+        seen_headers = {}
+
+        async def fn():
+            upstream = web.Application()
+
+            async def ok(request):
+                seen_headers.update(request.headers)
+                return web.json_response({'pong': True})
+
+            upstream.router.add_route('*', '/{tail:.*}', ok)
+            up_server = AioTestServer(upstream)
+            await up_server.start_server()
+            lb = lb_lib.LoadBalancer('round_robin',
+                                     service_name='svc')
+            lb.set_ready_replicas(
+                [str(up_server.make_url('')).rstrip('/')])
+            client = TestClient(AioTestServer(lb.build_app()))
+            await client.start_server()
+            try:
+                r = await client.get('/v1/ping',
+                                     headers={'X-Skytpu-Trace-Id': tid})
+                assert r.status == 200
+                # A sibling service's span under the SAME trace: the
+                # user-facing endpoint must not leak it.
+                spans.record('lb.request', start_wall=time.time(),
+                             duration=0.5, trace_id=tid,
+                             entity='othersvc')
+                r = await client.get(f'/-/lb/trace/{tid}')
+                assert r.status == 200
+                doc = await r.json()
+                r = await client.get('/-/lb/trace/not-hex-zz')
+                assert r.status == 400
+            finally:
+                await client.close()
+                await up_server.close()
+            return doc
+
+        doc = _run_async(fn())
+        (root,) = doc['roots']
+        assert root['name'] == 'lb.request'
+        assert root['entity'] == 'svc'
+        assert root['attrs']['outcome'] == 'proxied'
+        kids = {c['name']: c for c in root['children']}
+        assert set(kids) == {'lb.pick', 'lb.upstream'}
+        assert kids['lb.upstream']['attrs']['status'] == 200
+        # Carriers reached the replica: the engine side parents its
+        # spans under lb.upstream with exactly these two headers.
+        assert seen_headers['X-Skytpu-Trace-Id'] == tid
+        assert seen_headers['X-Skytpu-Parent-Span'] == \
+            kids['lb.upstream']['span_id']
+        # The LB's entity rides along so engine-side spans can pass
+        # this endpoint's scope filter on a shared journal DB.
+        assert seen_headers['X-Skytpu-Entity'] == 'svc'
+
+    def test_client_skytpu_headers_stripped_not_forwarded(self):
+        """A client-supplied X-Skytpu-* header (any casing) must never
+        reach the replica: the LB stamps its own values as NEW dict
+        keys, so forwarding the client's would duplicate the header and
+        the engine's multidict .get() would return the client's value
+        first — letting a client of service A plant engine spans inside
+        service B's entity-scoped /-/lb/trace view."""
+        from skypilot_tpu.serve import load_balancer as lb_lib
+        seen = {}
+
+        async def fn():
+            upstream = web.Application()
+
+            async def ok(request):
+                for k, v in request.headers.items():
+                    seen.setdefault(k.lower(), []).append(v)
+                return web.json_response({})
+
+            upstream.router.add_route('*', '/{tail:.*}', ok)
+            up_server = AioTestServer(upstream)
+            await up_server.start_server()
+            lb = lb_lib.LoadBalancer('round_robin', service_name='svc')
+            lb.set_ready_replicas(
+                [str(up_server.make_url('')).rstrip('/')])
+            client = TestClient(AioTestServer(lb.build_app()))
+            await client.start_server()
+            try:
+                r = await client.get(
+                    '/v1/ping',
+                    headers={'x-skytpu-entity': 'victim-svc',
+                             'x-skytpu-parent-span': 'ff' * 8})
+                assert r.status == 200
+            finally:
+                await client.close()
+                await up_server.close()
+
+        _run_async(fn())
+        # Exactly ONE value per carrier — the LB's own, never the
+        # client's spoof.
+        assert seen['x-skytpu-entity'] == ['svc']
+        assert seen['x-skytpu-parent-span'] != [('ff' * 8)]
+        assert len(seen['x-skytpu-parent-span']) == 1
+
+    def test_sample_zero_persists_nothing_and_exports_no_carriers(
+            self, monkeypatch):
+        """SKYTPU_LB_SPAN_SAMPLE=0: organic traffic records no spans
+        anywhere (no carriers forwarded, so the engine's no-trace gate
+        fires on the replica too) — but a client-OFFERED trace id is
+        still always recorded."""
+        from skypilot_tpu.serve import load_balancer as lb_lib
+        monkeypatch.setenv('SKYTPU_LB_SPAN_SAMPLE', '0')
+        tid = trace.new_trace_id()
+        seen = {}
+
+        async def fn():
+            upstream = web.Application()
+
+            async def ok(request):
+                seen.update({k.lower(): v
+                             for k, v in request.headers.items()})
+                return web.json_response({})
+
+            upstream.router.add_route('*', '/{tail:.*}', ok)
+            up_server = AioTestServer(upstream)
+            await up_server.start_server()
+            lb = lb_lib.LoadBalancer('round_robin', service_name='svc')
+            lb.set_ready_replicas(
+                [str(up_server.make_url('')).rstrip('/')])
+            client = TestClient(AioTestServer(lb.build_app()))
+            await client.start_server()
+            try:
+                r = await client.get('/v1/ping')      # organic
+                assert r.status == 200
+                organic_headers = dict(seen)
+                r = await client.get(                 # explicit trace
+                    '/v1/ping',
+                    headers={'X-Skytpu-Trace-Id': tid})
+                assert r.status == 200
+            finally:
+                await client.close()
+                await up_server.close()
+            return organic_headers
+
+        organic_headers = _run_async(fn())
+        assert 'x-skytpu-trace-id' not in organic_headers
+        assert 'x-skytpu-entity' not in organic_headers
+        spans.flush()
+        # Organic request persisted nothing; the offered trace did.
+        organic = [s for s in spans.query_spans(name='lb.request')
+                   if s['trace_id'] != tid]
+        assert organic == []
+        traced = spans.query_spans(trace_id=tid)
+        assert {s['name'] for s in traced} >= {'lb.request'}
+
+
+@pytest.mark.usefixtures('observe_env')
+class TestSpanCli:
+
+    def test_trace_subcommand_and_chrome_export(self, tmp_path):
+        """`python -m skypilot_tpu.observe trace <id>` renders the
+        indented tree (--db reads a specific journal DB directly);
+        `export --chrome` writes the merged Chrome-trace JSON."""
+        with trace.trace_context() as tid:
+            with spans.span('api.request'):
+                with spans.span('optimizer.plan'):
+                    time.sleep(0.002)
+        spans.flush()
+        db = os.environ['SKYTPU_OBSERVE_DB']
+        env = {**os.environ, 'PYTHONPATH': REPO}
+        env.pop('SKYTPU_OBSERVE_DB')
+        proc = subprocess.run(
+            [sys.executable, '-m', 'skypilot_tpu.observe', 'trace',
+             tid, '--db', db],
+            env=env, capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr
+        assert 'api.request' in proc.stdout
+        assert 'optimizer.plan' in proc.stdout
+        assert '% of parent' in proc.stdout
+        out = tmp_path / 'chrome.json'
+        proc = subprocess.run(
+            [sys.executable, '-m', 'skypilot_tpu.observe', 'export',
+             '--out', str(out), '--chrome', '--trace', tid],
+            env={**env, 'SKYTPU_OBSERVE_DB': db},
+            capture_output=True, text=True, timeout=60)
+        assert proc.returncode == 0, proc.stderr
+        doc = json.loads(out.read_text())
+        assert {e['name'] for e in doc['traceEvents']} == \
+            {'api.request', 'optimizer.plan'}
