@@ -484,6 +484,197 @@ def run_serve_bench():
     }), flush=True)
 
 
+def run_serve_mixed_bench():
+    """Mixed-length admission scenario (SKYTPU_BENCH_METRIC=
+    serve_mixed, CPU-runnable): a flood of short-decode requests with a
+    long prompt injected every LONG_EVERY-th request — the workload
+    where bucket admission loses TTFT (a long prompt's monolithic
+    prefill blocks every short behind it) and the paged engine's
+    chunked prefill + page-gated admission wins. Runs the SAME load
+    twice, against the paged engine (SKYTPU_ENGINE_PAGED=1, long
+    prompts chunked) and the bucket-admission baseline (PAGED=0), and
+    reports per-class TTFT p50/p95 plus the engine's own
+    skytpu_engine_admission_wait_seconds histogram, so the queueing win
+    is measured pre/post on one artifact. `value` is the short-class
+    TTFT p95 speedup of paged over the baseline."""
+    import asyncio
+    import math
+    import socket
+
+    device = _get_device()
+    on_tpu = device.platform == 'tpu'
+    model = os.environ.get('SKYTPU_BENCH_SERVE_MODEL',
+                           'llama-1b' if on_tpu else 'llama-debug')
+    concurrency = int(os.environ.get('SKYTPU_BENCH_SERVE_CONCURRENCY',
+                                     '8'))
+    n_requests = int(os.environ.get(
+        'SKYTPU_BENCH_SERVE_REQUESTS', '48' if on_tpu else '20'))
+    short_len = int(os.environ.get('SKYTPU_BENCH_MIXED_SHORT', '8'))
+    long_len = int(os.environ.get(
+        'SKYTPU_BENCH_MIXED_LONG', '1024' if on_tpu else '192'))
+    long_every = int(os.environ.get('SKYTPU_BENCH_MIXED_EVERY', '5'))
+    new_tokens = int(os.environ.get('SKYTPU_BENCH_SERVE_NEW_TOKENS',
+                                    '8'))
+    chunk = int(os.environ.get('SKYTPU_ENGINE_PREFILL_CHUNK',
+                               '256' if on_tpu else '64'))
+    max_len = _next_pow2(long_len) + new_tokens + 2 * chunk
+
+    def run_mode(paged: bool):
+        with socket.socket() as s:
+            s.bind(('127.0.0.1', 0))
+            port = s.getsockname()[1]
+        env = dict(os.environ)
+        env['SKYTPU_ENGINE_PAGED'] = '1' if paged else '0'
+        env['SKYTPU_ENGINE_PREFILL_CHUNK'] = str(chunk)
+        cmd = [sys.executable, '-m', 'skypilot_tpu.serve.engine',
+               '--model', model, '--max-len', str(max_len),
+               '--warm-buckets',
+               f'{_next_pow2(short_len)},{_next_pow2(long_len)}',
+               '--host', '127.0.0.1', '--port', str(port)]
+        mesh = os.environ.get('SKYTPU_BENCH_SERVE_MESH')
+        if mesh:
+            cmd += ['--mesh', mesh]
+        server = subprocess.Popen(cmd, stdout=sys.stderr,
+                                  stderr=sys.stderr, env=env)
+        try:
+            short_ttft, long_ttft = asyncio.run(_drive_mixed_load(
+                port, concurrency, n_requests, short_len, long_len,
+                long_every, new_tokens))
+            text = _scrape_metrics_text(port)
+        finally:
+            server.terminate()
+            try:
+                server.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                server.kill()
+        out = {}
+        for cls, xs in (('short', short_ttft), ('long', long_ttft)):
+            if not xs:
+                continue
+            xs = sorted(xs)
+            out[f'{cls}_ttft_ms_p50'] = round(xs[len(xs) // 2], 1)
+            out[f'{cls}_ttft_ms_p95'] = round(
+                xs[min(len(xs) - 1, int(len(xs) * 0.95))], 1)
+        if text:
+            for q, suffix in ((0.50, 'p50'), (0.95, 'p95')):
+                v = _histogram_quantile(
+                    text, 'skytpu_engine_admission_wait_seconds', q)
+                if not math.isnan(v):
+                    out[f'admission_wait_ms_{suffix}'] = round(v * 1e3,
+                                                               2)
+            for line in text.splitlines():
+                if line.startswith('skytpu_engine_kv_page_alloc_total'
+                                   '{outcome="wait"}'):
+                    out['page_alloc_waits'] = float(
+                        line.rsplit(' ', 1)[1])
+        return out
+
+    paged_stats = run_mode(True)
+    base_stats = run_mode(False)
+    speedup = None
+    if paged_stats.get('short_ttft_ms_p95') and \
+            base_stats.get('short_ttft_ms_p95'):
+        speedup = round(base_stats['short_ttft_ms_p95'] /
+                        paged_stats['short_ttft_ms_p95'], 2)
+    print(f'serve_mixed: device={device.device_kind} model={model} '
+          f'short={short_len} long={long_len} every={long_every} '
+          f'paged={paged_stats} baseline={base_stats} '
+          f'short_p95_speedup={speedup}', file=sys.stderr)
+    print(json.dumps({
+        'metric': 'serve_mixed_short_ttft_p95_speedup',
+        'value': speedup,
+        'unit': 'x (bucket-admission baseline / paged)',
+        'paged': paged_stats,
+        'baseline': base_stats,
+        'workload': {'short_len': short_len, 'long_len': long_len,
+                     'long_every': long_every, 'requests': n_requests,
+                     'concurrency': concurrency,
+                     'new_tokens': new_tokens,
+                     'prefill_chunk': chunk},
+        'device': device.device_kind,
+    }), flush=True)
+
+
+def _scrape_metrics_text(port: int) -> str:
+    """Best-effort /metrics scrape (empty string on failure)."""
+    import urllib.request
+    try:
+        with urllib.request.urlopen(
+                f'http://127.0.0.1:{port}/metrics', timeout=10) as r:
+            return r.read().decode()
+    except OSError:
+        return ''
+
+
+async def _drive_mixed_load(port, concurrency, n_requests, short_len,
+                            long_len, long_every, new_tokens):
+    """Concurrent mixed-length streaming clients; returns
+    (short_ttft_ms[], long_ttft_ms[]). Every long_every-th request
+    carries the long prompt; the rest are distinct shorts — the chat
+    flood + occasional-context-dump pattern."""
+    import asyncio
+
+    import aiohttp
+
+    base = f'http://127.0.0.1:{port}'
+    deadline = time.time() + int(os.environ.get(
+        'SKYTPU_BENCH_SERVE_WARMUP_TIMEOUT', '600'))
+    async with aiohttp.ClientSession() as session:
+        while True:
+            try:
+                async with session.get(base + '/health') as r:
+                    if r.status == 200:
+                        break
+            except aiohttp.ClientError:
+                pass
+            if time.time() > deadline:
+                raise SystemExit('[bench] serve engine never became '
+                                 'ready')
+            await asyncio.sleep(1.0)
+
+        short_ttft, long_ttft = [], []
+        sem = asyncio.Semaphore(concurrency)
+
+        async def one(i):
+            is_long = (i % long_every == long_every - 1)
+            n = long_len if is_long else short_len
+            prompt = [(i * 7 + j) % 250 + 1 for j in range(n)]
+            async with sem:
+                t0 = time.perf_counter()
+                first_t = None
+                done = False
+                async with session.post(base + '/v1/completions', json={
+                        'prompt': prompt, 'max_tokens': new_tokens,
+                        'temperature': 0, 'ignore_eos': True,
+                        'stream': True}) as r:
+                    if r.status != 200:
+                        return
+                    async for raw in r.content:
+                        if not raw.startswith(b'data: '):
+                            continue
+                        if raw.strip() == b'data: [DONE]':
+                            done = True
+                            continue
+                        if first_t is None:
+                            first_t = time.perf_counter()
+                if done and first_t is not None:
+                    (long_ttft if is_long else short_ttft).append(
+                        (first_t - t0) * 1e3)
+
+        # Two sequential warm requests (one per class): prompt-bucket
+        # and chunk-program compiles happen here, outside the measured
+        # window.
+        await one(0)
+        await one(long_every - 1)
+        short_ttft.clear()
+        long_ttft.clear()
+        await asyncio.gather(*[one(i) for i in range(n_requests)])
+    if not short_ttft:
+        raise SystemExit('[bench] no short request completed with '
+                         'measurable stream timings')
+    return short_ttft, long_ttft
+
+
 def _histogram_quantile(text: str, family: str, q: float) -> float:
     """Prometheus-style histogram_quantile over one family's buckets
     (no labels): linear interpolation inside the bucket the q-th
@@ -793,6 +984,8 @@ if __name__ == '__main__':
             run_decode_bench()
         elif metric == 'serve':
             run_serve_bench()
+        elif metric == 'serve_mixed':
+            run_serve_mixed_bench()
         elif metric == 'kernelcheck':
             run_kernelcheck()
         else:

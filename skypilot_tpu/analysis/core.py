@@ -28,8 +28,9 @@ PACKAGE = 'skypilot_tpu'
 # host-sync-loop — no unconditional device_get in serve/models loop
 # bodies, the decode-pipeline anti-pattern; v5: span-discipline — no
 # leaked spans.start/span, no span/journal writes in the engine's hot
-# loop bodies).
-REPORT_VERSION = 5
+# loop bodies; v6: page-table-shape — page tables cross into jits as
+# fixed-shape int32 arrays, never static args or Python page lists).
+REPORT_VERSION = 6
 
 
 @dataclasses.dataclass

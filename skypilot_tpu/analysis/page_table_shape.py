@@ -1,0 +1,136 @@
+"""page-table-shape lint: page tables cross into jits as runtime
+int32 arrays, never as Python-level page lists or static arguments.
+
+The paged KV cache's shape discipline (models/paging.py,
+docs/ENGINE.md): page COUNT is data, not shape. Every jit sees the
+same fixed-shape ``[B, max_pages]`` int32 table no matter how many
+pages a row holds, so the compiled-variant matrix stays bounded. Two
+ways to break that silently:
+
+  - marking a table-like parameter STATIC (``static_argnames`` /
+    ``static_argnums``): every distinct page assignment then compiles
+    a fresh program — the compile cache explodes with traffic instead
+    of staying bounded;
+  - passing a Python list/tuple of page ids as a table-like argument
+    to a jitted call: jax treats each element as a separate traced
+    scalar (or a static pytree of ints), so the program SHAPE depends
+    on the page count and the cache explodes the same way.
+
+Both are flagged in the engine/model units (``serve/``, ``models/``)
+— the only places page tables exist. Best-effort AST rule: list
+literals/comprehensions are caught at the call site; a variable bound
+to a list elsewhere is not (the equality + allocator tests catch the
+runtime half).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis import jit_hazards
+
+NAME = 'page-table-shape'
+
+_UNITS = frozenset({'serve', 'models'})
+# Parameter/argument names that carry a page table or page-id plan.
+_TABLE_NAMES = frozenset({'table', 'page_table', 'pages', 'page_ids',
+                          'page_plan', 'pids'})
+_LIST_NODES = (ast.List, ast.ListComp, ast.GeneratorExp)
+
+
+def _static_spec_names(call: ast.Call, fn_args: List[str]) -> Set[str]:
+    """Parameter names a jit decoration marks static, resolved from
+    static_argnames (strings) and static_argnums (indices into the
+    decorated function's positional args)."""
+    out: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg == 'static_argnames':
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and \
+                        isinstance(node.value, str):
+                    out.add(node.value)
+        elif kw.arg == 'static_argnums':
+            for node in ast.walk(kw.value):
+                if isinstance(node, ast.Constant) and \
+                        isinstance(node.value, int) and \
+                        0 <= node.value < len(fn_args):
+                    out.add(fn_args[node.value])
+    return out
+
+
+def _jit_call_of(dec: ast.expr) -> ast.Call:
+    """The parameterized jit Call inside a decorator expression, or
+    None: ``@jax.jit(...)`` or ``@partial(jax.jit, ...)``."""
+    if not isinstance(dec, ast.Call):
+        return None
+    if jit_hazards._is_jit_expr(dec.func):
+        return dec
+    dotted = core.dotted_name(dec.func) or ''
+    if dotted.split('.')[-1] == 'partial' and dec.args and \
+            jit_hazards._is_jit_expr(dec.args[0]):
+        return dec
+    return None
+
+
+def _callee_is_jit_like(func: ast.expr, wrapped: Set[str]) -> bool:
+    """A call target that is (or conventionally holds) a compiled
+    program: a name jit-wrapped in this module, or any *_jit name /
+    attribute (the engine's self._step_jit / self._extend_jit(...)
+    convention)."""
+    dotted = core.dotted_name(func)
+    if dotted is None:
+        # self._extend_jit(p, s2, True)(...) — a call returning the
+        # compiled program.
+        if isinstance(func, ast.Call):
+            return _callee_is_jit_like(func.func, wrapped)
+        return False
+    tail = dotted.split('.')[-1]
+    return tail in wrapped or tail.endswith('_jit')
+
+
+def run(mod: core.ModuleInfo) -> List[core.Violation]:
+    if mod.unit not in _UNITS:
+        return []
+    out: List[core.Violation] = []
+    wrapped = jit_hazards._wrapped_fn_names(mod.tree)
+
+    for node in ast.walk(mod.tree):
+        # Rule 1: static table-like parameters on jitted functions.
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            arg_names = [a.arg for a in node.args.args]
+            for dec in node.decorator_list:
+                call = _jit_call_of(dec)
+                if call is None:
+                    continue
+                bad = _static_spec_names(call, arg_names) & _TABLE_NAMES
+                for name in sorted(bad):
+                    out.append(core.Violation(
+                        check=NAME, path=mod.path, line=node.lineno,
+                        col=node.col_offset,
+                        key=f'static:{node.name}:{name}',
+                        message=(
+                            f'jitted function {node.name!r} marks page-'
+                            f'table parameter {name!r} STATIC: every '
+                            f'distinct page assignment compiles a '
+                            f'fresh program — pass it as a fixed-shape '
+                            f'int32 array (page count is data, not '
+                            f'shape)')))
+        # Rule 2: Python page lists at jitted call sites.
+        if isinstance(node, ast.Call) and \
+                _callee_is_jit_like(node.func, wrapped):
+            for kw in node.keywords:
+                if kw.arg in _TABLE_NAMES and \
+                        isinstance(kw.value, _LIST_NODES + (ast.Tuple,)):
+                    out.append(core.Violation(
+                        check=NAME, path=mod.path, line=node.lineno,
+                        col=node.col_offset,
+                        key=f'pylist:{kw.arg}',
+                        message=(
+                            f'Python list/tuple passed as page-table '
+                            f'argument {kw.arg!r} to a jitted call: '
+                            f'the program shape then depends on the '
+                            f'page count and the compile cache '
+                            f'explodes — convert with '
+                            f'jnp.asarray(..., jnp.int32) first')))
+    return out

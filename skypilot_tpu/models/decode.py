@@ -160,6 +160,32 @@ def cache_pspecs(cfg: llama.LlamaConfig) -> KVCache:
     return KVCache(k=kv, v=kv, length=P(('data', 'fsdp')))
 
 
+def init_page_pool(cfg: llama.LlamaConfig, n_pages: int, page_size: int,
+                   batch: int, max_pages: int):
+    """Block-paged K/V pool for the serving engine (models/paging.py):
+    [L, n_pages, page_size, KH, hd] pools, a zeroed [batch, max_pages]
+    int32 page table (0 = trash page), and per-row lengths. Page COUNT
+    is data, not shape — one pool serves every request mix."""
+    from skypilot_tpu.models import paging
+    shape = (cfg.n_layers, n_pages, page_size, cfg.n_kv_heads, cfg.hd)
+    return paging.PagedKV(
+        k=jnp.zeros(shape, cfg.dtype), v=jnp.zeros(shape, cfg.dtype),
+        table=jnp.zeros((batch, max_pages), jnp.int32),
+        length=jnp.zeros((batch,), jnp.int32))
+
+
+def paged_pspecs(cfg: llama.LlamaConfig):
+    """PartitionSpecs mirroring init_page_pool's tree: the PAGE axis
+    shards over data/fsdp (pages are interchangeable, so the pool
+    spreads like the contiguous cache's batch axis did), kv-heads over
+    tensor; tables/lengths replicate (tiny, host-updated)."""
+    del cfg
+    from jax.sharding import PartitionSpec as P
+    from skypilot_tpu.models import paging
+    kv = P(None, ('data', 'fsdp'), None, 'tensor', None)
+    return paging.PagedKV(k=kv, v=kv, table=P(), length=P())
+
+
 def _qkv(x: jnp.ndarray, lp, cfg: llama.LlamaConfig, sin, cos):
     """Shared with training math: norm → q/k/v projections → (qk-norm) →
     rope. sin/cos must already be per-layer (llama.select_rope)."""

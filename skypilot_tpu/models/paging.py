@@ -1,0 +1,322 @@
+"""Block-paged KV cache: device-resident page pools, a host-side
+refcounted free-list allocator, and the gather/scatter ops that thread
+per-request page tables through the serving jits as int32 indices.
+
+Why pages: the serving engine's contiguous layout dedicates a full
+``max_len`` cache row per slot, so a finished request strands its
+memory until the slot is reaped and reused, and admission is gated on
+whole rows. With paging, HBM is a pool of fixed-size pages
+(``[L, n_pages, page_size, ...]``); each request borrows just the
+pages its (bucketed prompt + max_new + spec headroom) needs via a
+fixed-shape int32 page table, releases them the moment it finishes
+(collect time, not reap time), and a prefix-cache hit shares the
+prefix's pages read-only instead of copying a snapshot — a hit costs
+page-table entries, not HBM.
+
+Shape discipline (the TPU contract): page COUNT is data, not shape.
+Every jit sees the same ``[B, max_pages]`` int32 table regardless of
+how many pages a row actually holds, so the compiled-variant matrix
+stays exactly as bounded as the contiguous engine's. The skylint
+``page-table-shape`` checker pins this: a page table must never reach
+a jit as a Python list or a static argument.
+
+Page 0 is the TRASH page: it is never allocated, and writes for
+inactive rows (masked-out, finished, or prefilling slots) are routed
+to it so a freed page can never be corrupted by a stale in-flight
+step. The allocator hands out ids 1..n_pages-1.
+
+Families: ``PagedKV`` pools the dense/GQA/MoE K/V cache
+(models/decode.py); ``PagedLatent`` pools the MLA latent cache
+(models/mla.py). ``gather_view`` materializes the contiguous
+per-row view both families' existing prefill/step/verify math runs
+on unchanged — which is what makes paged decode token-identical to
+the contiguous path by construction (pin-tested in
+tests/unit_tests/test_engine_paged.py).
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+from typing import Dict, Iterable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+TRASH_PAGE = 0
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PagedKV:
+    """Paged dense K/V pool + per-slot tables.
+
+    k/v: [L, n_pages, page_size, KH, hd] — page id indexes axis 1.
+    table: [B, max_pages] int32 page ids (0 = trash / unassigned).
+    length: [B] int32 valid token count per slot (same contract as
+    KVCache.length)."""
+    k: jnp.ndarray
+    v: jnp.ndarray
+    table: jnp.ndarray
+    length: jnp.ndarray
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class PagedLatent:
+    """Paged MLA latent pool (models/mla.py): c_kv [L, n_pages,
+    page_size, r], k_rope [L, n_pages, page_size, dr]."""
+    c_kv: jnp.ndarray
+    k_rope: jnp.ndarray
+    table: jnp.ndarray
+    length: jnp.ndarray
+
+
+def _pools(pcache) -> Dict[str, jnp.ndarray]:
+    """The per-token pool arrays of either family, by field name."""
+    if isinstance(pcache, PagedKV):
+        return {'k': pcache.k, 'v': pcache.v}
+    return {'c_kv': pcache.c_kv, 'k_rope': pcache.k_rope}
+
+
+def page_size_of(pcache) -> int:
+    return next(iter(_pools(pcache).values())).shape[2]
+
+
+def max_pages_of(pcache) -> int:
+    return pcache.table.shape[1]
+
+
+def pages_for(n_tokens: int, page_size: int) -> int:
+    """Pages covering positions [0, n_tokens)."""
+    return -(-n_tokens // page_size)
+
+
+def gather_view(pcache, max_len: int):
+    """Materialize the contiguous [L, B, max_len, ...] per-row view the
+    existing decode/prefill/verify math consumes: ``pool[:, table]``
+    reshaped so position ``p`` of row ``b`` reads
+    ``pool[:, table[b, p // psz], p % psz]``. Rows whose table entries
+    are 0 read the trash page (garbage — such rows are always masked
+    inactive and their logits discarded). Returns the family's
+    contiguous cache dataclass, so callers are family-blind."""
+    table = pcache.table
+    psz = page_size_of(pcache)
+    del psz
+
+    def g(a):
+        v = a[:, table]                        # [L, B, MAXP, psz, ...]
+        l, b = v.shape[0], v.shape[1]
+        v = v.reshape(l, b, -1, *a.shape[3:])  # [L, B, MAXP*psz, ...]
+        return v[:, :, :max_len]
+
+    if isinstance(pcache, PagedKV):
+        from skypilot_tpu.models import decode as decode_lib
+        return decode_lib.KVCache(k=g(pcache.k), v=g(pcache.v),
+                                  length=pcache.length)
+    from skypilot_tpu.models import mla as mla_lib
+    return mla_lib.LatentCache(c_kv=g(pcache.c_kv),
+                               k_rope=g(pcache.k_rope),
+                               length=pcache.length)
+
+
+def _write_indices(pcache, pos: jnp.ndarray, active=None):
+    """(page_id, offset) arrays for token positions ``pos`` (any shape
+    broadcastable with [B, ...], values in [0, max_len)). Inactive rows
+    route to the trash page."""
+    psz = page_size_of(pcache)
+    maxp = max_pages_of(pcache)
+    pos = jnp.minimum(pos, maxp * psz - 1)
+    pid = jnp.take_along_axis(pcache.table, pos // psz, axis=1)
+    if active is not None:
+        pid = jnp.where(active[:, None], pid, TRASH_PAGE)
+    return pid, pos % psz
+
+
+def scatter_steps(pcache, view, start: jnp.ndarray, k: int,
+                  active: jnp.ndarray):
+    """Write the k tokens a fused step produced back into the pool:
+    positions [start, start+k) per row, read from the contiguous view
+    the step math updated. ``active`` [B] bool: inactive rows' writes
+    land on the trash page (their view slots hold garbage and their
+    pages may already be freed)."""
+    pos = start[:, None] + jnp.arange(k)[None, :]          # [B, k]
+    pid, off = _write_indices(pcache, pos, active)
+    psz = page_size_of(pcache)
+    maxp = max_pages_of(pcache)
+    pos_r = jnp.minimum(pos, maxp * psz - 1)
+    view_arrays = _pools_of_view(view)
+    out = {}
+    for name, pool_a in _pools(pcache).items():
+        view_a = view_arrays[name]
+        rows = jnp.arange(view_a.shape[1])
+        # Clamp the read too: the view only covers max_len positions.
+        rd = jnp.minimum(pos_r, view_a.shape[2] - 1)
+        tok = view_a[:, rows[:, None], rd]                 # [L, B, k, ...]
+        out[name] = pool_a.at[:, pid, off].set(tok)
+    del psz
+    return dataclasses.replace(pcache, length=view.length, **out)
+
+
+def _pools_of_view(view) -> Dict[str, jnp.ndarray]:
+    if hasattr(view, 'k'):
+        return {'k': view.k, 'v': view.v}
+    return {'c_kv': view.c_kv, 'k_rope': view.k_rope}
+
+
+def scatter_prefill(pcache, rows_cache, slots: jnp.ndarray, s: int,
+                    lengths: jnp.ndarray):
+    """Write a grouped prefill's rows into the pool: positions [0, s)
+    of each admitted row (s = the static prompt bucket) land in the
+    pages its table row covers; length[slots] = lengths. The admitted
+    rows' pages were just allocated, so no trash masking is needed."""
+    pos = jnp.arange(s)                                    # [s]
+    psz = page_size_of(pcache)
+    pid = pcache.table[slots][:, pos // psz]               # [N, s]
+    off = (pos % psz)[None, :]                             # [1, s]
+    off = jnp.broadcast_to(off, pid.shape)
+    rows_arrays = _pools_of_view(rows_cache)
+    out = {}
+    for name, pool_a in _pools(pcache).items():
+        tok = rows_arrays[name][:, :, :s]                  # [L, N, s, ...]
+        out[name] = pool_a.at[:, pid, off].set(tok)
+    length = pcache.length.at[slots].set(lengths)
+    return dataclasses.replace(pcache, length=length, **out)
+
+
+def gather_prefix(pcache, slot, p: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """The [L, 1, p, ...] contiguous prefix arrays of row ``slot``
+    (p static, a multiple of the page size): the exact pair the
+    family's ``prefill_extend`` takes — (k, v) for PagedKV,
+    (c_kv, k_rope) for PagedLatent. Zero-copy sharing rides this: a
+    prefix-cache hit points its table entries at the SHARED pages and
+    gathers the same data every other holder reads."""
+    pools = _pools(pcache)
+    if p == 0:
+        a, b = pools.values()
+        za = jnp.zeros((a.shape[0], 1, 0, *a.shape[3:]), a.dtype)
+        zb = jnp.zeros((b.shape[0], 1, 0, *b.shape[3:]), b.dtype)
+        return za, zb
+    psz = page_size_of(pcache)
+    pos = jnp.arange(p)
+    pid = pcache.table[slot, pos // psz]                   # [p]
+    off = pos % psz
+    a, b = [arr[:, pid, off][:, None] for arr in pools.values()]
+    return a, b
+
+
+def scatter_suffix(pcache, row_cache, slot, p: int, s2: int, new_len):
+    """Write an extend/chunk prefill's suffix — positions [p, p+s2) of
+    the single returned row — into row ``slot``'s own pages, leaving
+    the (possibly shared) prefix pages untouched; length[slot] =
+    new_len."""
+    pos = p + jnp.arange(s2)
+    psz = page_size_of(pcache)
+    pid = pcache.table[slot, pos // psz]                   # [s2]
+    off = pos % psz
+    row_arrays = _pools_of_view(row_cache)
+    out = {}
+    for name, pool_a in _pools(pcache).items():
+        tok = row_arrays[name][:, 0, p:p + s2]             # [L, s2, ...]
+        out[name] = pool_a.at[:, pid, off].set(tok)
+    length = pcache.length.at[slot].set(new_len)
+    return dataclasses.replace(pcache, length=length, **out)
+
+
+class PagesExhausted(Exception):
+    """Not enough free pages — admission must wait (or evict)."""
+
+
+class PageAllocator:
+    """Host-side deterministic free-list allocator with refcounts.
+
+    Determinism is load-bearing: multi-host followers must arrive at
+    identical page assignments, so allocation order is FIFO over a
+    deque seeded 1..n_pages-1 ascending — a follower replaying the
+    leader's admit/chunk/reap op stream from mirrored state draws the
+    identical ids in the identical order. The admit/chunkstart ops
+    additionally carry the leader's :meth:`fingerprint`, so any drift
+    fails the gang loudly before it can corrupt KV. ``take()`` claims
+    explicit ids (serialized page handoff for disaggregated serving;
+    exercised by the property tests).
+
+    Refcounts implement read-only sharing: a prefix-cache entry and
+    every request admitted over it each hold one ref on the prefix's
+    pages; a page returns to the free list only when its last holder
+    unrefs (no double-free: unref below zero raises — property-tested
+    in tests/unit_tests/test_paging.py)."""
+
+    def __init__(self, n_pages: int):
+        if n_pages < 2:
+            raise ValueError(f'need >= 2 pages (1 data + trash), got '
+                             f'{n_pages}')
+        self.n_pages = n_pages
+        self._free = collections.deque(range(1, n_pages))
+        self._free_set = set(self._free)
+        self._rc: Dict[int, int] = {}
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return (self.n_pages - 1) - len(self._free)
+
+    def can_fit(self, n: int) -> bool:
+        return n <= len(self._free)
+
+    def fingerprint(self) -> Tuple[int, int]:
+        """(free_count, next_free_id) — a cheap state digest for the
+        multi-host lockstep cross-check. Two allocators that replayed
+        the same alloc/free sequence always agree; disagreement means
+        page assignments diverged."""
+        return (len(self._free), self._free[0] if self._free else -1)
+
+    def alloc(self, n: int) -> List[int]:
+        if n > len(self._free):
+            raise PagesExhausted(
+                f'need {n} pages, {len(self._free)} free')
+        out = [self._free.popleft() for _ in range(n)]
+        for pid in out:
+            self._free_set.discard(pid)
+            self._rc[pid] = 1
+        return out
+
+    def take(self, pids: Sequence[int]) -> None:
+        """Claim specific pages (follower replaying the leader's plan).
+        Every id must currently be free."""
+        want = set(pids)
+        if len(want) != len(pids):
+            raise ValueError(f'duplicate page ids in plan: {pids}')
+        missing = want - self._free_set
+        if missing:
+            raise PagesExhausted(
+                f'plan pages not free: {sorted(missing)}')
+        self._free = collections.deque(
+            p for p in self._free if p not in want)
+        self._free_set -= want
+        for pid in pids:
+            self._rc[pid] = 1
+
+    def ref(self, pid: int) -> None:
+        if pid not in self._rc:
+            raise ValueError(f'ref of unallocated page {pid}')
+        self._rc[pid] += 1
+
+    def unref(self, pid: int) -> None:
+        rc = self._rc.get(pid)
+        if rc is None:
+            raise ValueError(f'double free of page {pid}')
+        if rc == 1:
+            del self._rc[pid]
+            self._free.append(pid)
+            self._free_set.add(pid)
+        else:
+            self._rc[pid] = rc - 1
+
+    def unref_all(self, pids: Iterable[int]) -> None:
+        for pid in pids:
+            self.unref(pid)
+
+    def refcount(self, pid: int) -> int:
+        return self._rc.get(pid, 0)

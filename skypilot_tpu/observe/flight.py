@@ -44,10 +44,12 @@ FINISH = 4          # `slot` finished; seq = tokens generated
 SPEC = 5            # speculative verify round; seq = accepted tokens
 RESET = 6           # device-state rebuild (failure path)
 CANCEL = 7          # a cancel applied to `slot`
+CHUNK = 8           # a prefill chunk ran for `slot`; seq = tokens done
 
 CODE_NAMES: Dict[int, str] = {
     DISPATCH: 'dispatch', COLLECT: 'collect', ADMIT: 'admit',
     FINISH: 'finish', SPEC: 'spec', RESET: 'reset', CANCEL: 'cancel',
+    CHUNK: 'chunk',
 }
 
 _CAPACITY_ENV = 'SKYTPU_FLIGHT_CAPACITY'

@@ -133,12 +133,36 @@ _M_TPOT = metrics_lib.histogram(
     'Time per output token after the first (mean per request)',
     buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
              0.25, 0.5, 1.0, 2.5))
+# Block-paged KV cache (models/paging.py; docs/ENGINE.md): queueing vs
+# memory pressure must be distinguishable at /metrics — free/used page
+# gauges are sampled at scrape, the alloc counter splits admissions
+# that found pages from admissions that had to wait, and the wait
+# histogram is the submit→admit delta (the quantity the mixed-length
+# bench scenario tracks pre/post paging).
+_M_PAGES_FREE = metrics_lib.gauge(
+    'skytpu_engine_kv_pages_free', 'Free KV cache pages in the pool '
+    '(paged mode; excludes the trash page)')
+_M_PAGES_USED = metrics_lib.gauge(
+    'skytpu_engine_kv_pages_used', 'KV cache pages held by live '
+    'requests and shared prefix entries (paged mode)')
+_M_PAGE_ALLOC = metrics_lib.counter(
+    'skytpu_engine_kv_page_alloc_total',
+    'Page-reservation attempts at admission: ok = pages granted, '
+    'wait = the request stayed queued for lack of free pages',
+    labels={'outcome': ('ok', 'wait')})
+_M_ADMIT_WAIT = metrics_lib.histogram(
+    'skytpu_engine_admission_wait_seconds',
+    'Request submit to admission (queue wait, incl. waiting on free '
+    'KV pages)',
+    buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+             1.0, 2.5, 5.0, 10.0, 30.0, 60.0))
 
 _ENGINE_METRICS = (
     _M_STEP_SECONDS, _M_ADMIT_SECONDS, _M_HOST_SYNC_SECONDS,
     _M_QUEUE_DEPTH, _M_IN_FLIGHT, _M_STEPS, _M_TOKENS, _M_REQUESTS,
     _M_REJECTED, _M_PREFIX, _M_PREFIX_HITS, _M_SPEC_ROUNDS,
-    _M_SPEC_PROPOSED, _M_SPEC_ACCEPTED, _M_TTFT, _M_TPOT)
+    _M_SPEC_PROPOSED, _M_SPEC_ACCEPTED, _M_TTFT, _M_TPOT,
+    _M_PAGES_FREE, _M_PAGES_USED, _M_PAGE_ALLOC, _M_ADMIT_WAIT)
 
 
 def _seed_counter_zeros() -> None:
@@ -152,6 +176,8 @@ def _seed_counter_zeros() -> None:
         metric.inc(0)
     _M_PREFIX.inc(0, outcome='hit')
     _M_PREFIX.inc(0, outcome='miss')
+    _M_PAGE_ALLOC.inc(0, outcome='ok')
+    _M_PAGE_ALLOC.inc(0, outcome='wait')
 
 
 _seed_counter_zeros()
@@ -203,6 +229,30 @@ SPEC_COOLDOWN = int(os.environ.get('SKYTPU_ENGINE_SPEC_COOLDOWN', '16'))
 # draft scan for nothing. The cooldown ticks at collect, so the pool
 # is re-scanned a few tokens later when drafts may have appeared.
 SPEC_NO_DRAFT_COOLDOWN = 4
+# --- Block-paged KV cache (models/paging.py; docs/ENGINE.md) ---------
+# Paged mode is the default: the cache is a pool of fixed-size pages,
+# per-request page tables ride the jits as fixed-shape int32 arrays,
+# finished rows release pages at collect time, and long prompts
+# prefill in chunks interleaved with decode rounds. PAGED=0 restores
+# the contiguous per-slot layout (the bucket-admission baseline the
+# CPU equality test and the mixed-length bench compare against).
+PAGED = os.environ.get('SKYTPU_ENGINE_PAGED', '1') != '0'
+# Tokens per KV page. Must be a power of two dividing
+# PREFIX_MIN_TOKENS (64) so power-of-two prefix snapshots land on page
+# boundaries and share zero-copy.
+PAGE_SIZE = int(os.environ.get('SKYTPU_ENGINE_PAGE_SIZE', '64'))
+# Total pool pages (including the reserved trash page). 0 = auto:
+# enough for every slot's worst case plus prefix-cache headroom — no
+# capacity regression vs the contiguous layout. Shrink it to
+# oversubscribe memory; admission then waits on free pages (visible
+# in skytpu_engine_kv_page_alloc_total{outcome="wait"}).
+KV_PAGES = int(os.environ.get('SKYTPU_ENGINE_KV_PAGES', '0'))
+# Chunked prefill: prompts whose bucket exceeds this prefill in
+# PREFILL_CHUNK-token pieces interleaved with decode rounds at drained
+# points, so a long prompt no longer blocks the pool for one giant
+# prefill call and short requests keep streaming. Power of two >= 16.
+PREFILL_CHUNK = int(os.environ.get('SKYTPU_ENGINE_PREFILL_CHUNK',
+                                   '256'))
 
 
 class EngineOverloaded(Exception):
@@ -661,6 +711,44 @@ class InferenceEngine:
         # leader keeps at most one outstanding across its broadcast
         # points; followers mirror via the ('step',)/('collect',) ops.
         self._inflight: List['_InFlightStep'] = []
+        # Block-paged KV cache (models/paging.py). Instance attributes
+        # (not module reads) so tests can override before warmup.
+        self.paged = PAGED
+        self.page_size = PAGE_SIZE
+        self.prefill_chunk = PREFILL_CHUNK
+        self.kv_pages = KV_PAGES
+        if self.paged:
+            if (self.page_size & (self.page_size - 1) or
+                    PREFIX_MIN_TOKENS % self.page_size):
+                raise ValueError(
+                    f'SKYTPU_ENGINE_PAGE_SIZE must be a power of two '
+                    f'dividing {PREFIX_MIN_TOKENS}, got '
+                    f'{self.page_size}')
+            if (self.prefill_chunk < 16 or
+                    self.prefill_chunk & (self.prefill_chunk - 1)):
+                raise ValueError(
+                    f'SKYTPU_ENGINE_PREFILL_CHUNK must be a power of '
+                    f'two >= 16, got {self.prefill_chunk}')
+        # Host-side paging state, (re)built by _reset_device_state:
+        # the refcounted free-list allocator, the numpy mirror of the
+        # device page table, and the shared-prefix page store. The
+        # device table is refreshed lazily (_table_dirty) at the next
+        # drained device call after any host-side alloc/free.
+        self.alloc = None
+        self._table_np = None
+        self._table_dirty = False
+        # Page-gated admission: items popped from the queue that could
+        # not reserve pages wait here (FIFO — later arrivals never jump
+        # a held request, or a flood of shorts would starve a long
+        # prompt forever). _hold_waited: items already counted in the
+        # kv_page_alloc_total{outcome="wait"} counter (once per
+        # request, not once per retry round).
+        self._hold: List[tuple] = []
+        self._hold_waited: set = set()
+        # Chunked-prefill scheduler state: slots mid-prefill round-robin
+        # one chunk per drained round (the interleave that lets short
+        # requests stream while a long prompt fills).
+        self._chunk_rr = 0
 
     def _setup_mesh(self, mesh, quantize: Optional[str]) -> None:
         """Place params on a named mesh with the family's sharding rules;
@@ -732,7 +820,10 @@ class InferenceEngine:
 
     # -- observability -----------------------------------------------------
     def queue_depth(self) -> int:
-        return self._queue.qsize() if self._queue is not None else 0
+        # Held items (popped, waiting on free KV pages) are still
+        # queued work — the LB's least-load policy must see them.
+        return ((self._queue.qsize() if self._queue is not None else 0)
+                + len(self._hold))
 
     def in_flight(self) -> int:
         return sum(1 for s in getattr(self, 'slots', []) if s is not None)
@@ -760,21 +851,64 @@ class InferenceEngine:
             self.flight, reason=reason or 'device state reset',
             entity=f'engine/{self.model_name}')
         self.flight.record(flight_lib.RESET, 0, self._resets)
-        self.cache = self._decode.init_cache(self.cfg, MAX_BATCH,
-                                             self.max_len)
-        if self.mesh is not None:
-            # Each decode family owns its cache layout AND its mesh
-            # layout: cache_pspecs lives next to init_cache
-            # (models/decode.py for KVCache, models/mla.py for
-            # LatentCache), so a new serving family adds one function
-            # there instead of a branch here.
-            from jax.sharding import NamedSharding, PartitionSpec
-            self.cache = jax.device_put(
-                self.cache,
-                jax.tree.map(lambda s: NamedSharding(self.mesh, s),
-                             self._decode.cache_pspecs(self.cfg),
-                             is_leaf=lambda x: isinstance(
-                                 x, PartitionSpec)))
+        if self.paged:
+            # Page pool instead of contiguous rows: MAXP table entries
+            # cover max_len positions; the default pool matches the
+            # contiguous layout's worst case (every slot full) plus
+            # prefix-cache headroom, so default capacity never
+            # regresses — SKYTPU_ENGINE_KV_PAGES shrinks it to
+            # oversubscribe.
+            from skypilot_tpu.models import paging
+            psz = self.page_size
+            self._max_pages = paging.pages_for(self.max_len, psz)
+            n_pages = self.kv_pages
+            if n_pages <= 0:
+                n_pages = (MAX_BATCH + min(PREFIX_CACHE_ENTRIES,
+                                           MAX_BATCH)) \
+                    * self._max_pages + 1
+            if self.mesh is not None:
+                # The page axis shards over data/fsdp: keep it
+                # divisible (pages are fungible; a few extra are free).
+                shape = dict(self.mesh.shape)
+                dp = shape.get('data', 1) * shape.get('fsdp', 1)
+                n_pages += (-n_pages) % dp
+            if n_pages < self._max_pages + 1:
+                raise ValueError(
+                    f'SKYTPU_ENGINE_KV_PAGES={n_pages} cannot hold one '
+                    f'full-length request ({self._max_pages} pages + '
+                    f'trash)')
+            self.n_pages = n_pages
+            self.alloc = paging.PageAllocator(n_pages)
+            self._table_np = np.zeros((MAX_BATCH, self._max_pages),
+                                      np.int32)
+            self._table_dirty = True
+            self.cache = self._decode.init_page_pool(
+                self.cfg, n_pages, psz, MAX_BATCH, self._max_pages)
+            if self.mesh is not None:
+                from jax.sharding import NamedSharding, PartitionSpec
+                self.cache = jax.device_put(
+                    self.cache,
+                    jax.tree.map(
+                        lambda s: NamedSharding(self.mesh, s),
+                        self._decode.paged_pspecs(self.cfg),
+                        is_leaf=lambda x: isinstance(
+                            x, PartitionSpec)))
+        else:
+            self.cache = self._decode.init_cache(self.cfg, MAX_BATCH,
+                                                 self.max_len)
+            if self.mesh is not None:
+                # Each decode family owns its cache layout AND its mesh
+                # layout: cache_pspecs lives next to init_cache
+                # (models/decode.py for KVCache, models/mla.py for
+                # LatentCache), so a new serving family adds one
+                # function there instead of a branch here.
+                from jax.sharding import NamedSharding, PartitionSpec
+                self.cache = jax.device_put(
+                    self.cache,
+                    jax.tree.map(lambda s: NamedSharding(self.mesh, s),
+                                 self._decode.cache_pspecs(self.cfg),
+                                 is_leaf=lambda x: isinstance(
+                                     x, PartitionSpec)))
         base = (self._seed if self._seed is not None
                 else int(time.time_ns()) % (2**31))
         self.rng = jax.random.PRNGKey((base + self._resets) % (2**31))
@@ -812,6 +946,136 @@ class InferenceEngine:
             collections.OrderedDict()
         self.prefix_hits = 0
 
+    # -- block-paged KV cache: host-side state (models/paging.py) -------
+    @staticmethod
+    def _row_active(s: Optional[Dict[str, Any]]) -> bool:
+        """A slot that should be stepped: occupied, unfinished, and not
+        mid-chunked-prefill (a prefilling row holds pages and a slot
+        but produces no tokens until its final chunk samples)."""
+        return (s is not None and s['finish'] is None and
+                s.get('prefill') is None)
+
+    def _refresh_table(self) -> None:
+        """Push the host page-table mirror to the device cache if any
+        alloc/free dirtied it since the last device call. The table is
+        runtime DATA to every jit ([B, max_pages] int32 — page COUNT is
+        data, not shape), so this replaces one tiny leaf of the cache
+        pytree and can never recompile anything."""
+        if not self.paged or not self._table_dirty:
+            return
+        import dataclasses as _dc
+        # COPY, not asarray: on CPU jax an asarray of a numpy array can
+        # alias its buffer zero-copy, and the step/extend jits DONATE
+        # the cache pytree — XLA would then scribble output data over
+        # the host mirror itself (observed: token garbage in the table
+        # → phantom page ids → double frees).
+        table = self._jnp.array(self._table_np, copy=True)
+        self.cache = _dc.replace(self.cache, table=table)
+        self._table_dirty = False
+
+    def _pages_needed(self, item) -> int:
+        """Worst-case pages a request must reserve: bucketed prompt +
+        max_new + speculative verify headroom (verify_step writes
+        [length, length+K) on every row), capped at the table's
+        coverage. Conservative w.r.t. prefix sharing — a hit then needs
+        fewer OWN pages, never more."""
+        from skypilot_tpu.models import paging
+        tokens, max_new = item[0], item[1]
+        spec = self.spec_k if self.spec_k > 0 else 0
+        want = min(_bucket(len(tokens)) + max_new + spec,
+                   self._max_pages * self.page_size)
+        return paging.pages_for(want, self.page_size)
+
+    def _evictable_pages(self) -> int:
+        """Pages the prefix store would return to the free list if
+        evicted now (only entries no live request still shares)."""
+        if not self.paged:
+            return 0
+        n = 0
+        for pids in self._prefix_store.values():
+            n += sum(1 for pid in pids if self.alloc.refcount(pid) == 1)
+        return n
+
+    def _alloc_pages(self, n: int) -> List[int]:
+        """Reserve n pages, evicting prefix-store LRU entries as needed
+        (a cached prefix is worth less than an admitted request).
+        Deterministic — multi-host followers replaying the same admit
+        op from the same mirrored state make the identical evictions
+        and draw the identical page ids (FIFO free list); the admit op
+        additionally carries the leader's allocator fingerprint so any
+        drift fails loudly instead of corrupting KV."""
+        while not self.alloc.can_fit(n) and self._prefix_store:
+            _, pids = self._prefix_store.popitem(last=False)
+            self.alloc.unref_all(pids)
+        pids = self.alloc.alloc(n)
+        _M_PAGE_ALLOC.inc(outcome='ok')
+        return pids
+
+    def _reserve_slot_pages(self, slot: int, pids: List[int]) -> None:
+        """Point slot's table row at exactly `pids` (zeroing the tail)
+        and mark the device table stale — the ONE place the
+        table-mirror/allocator contract is written (see
+        _release_slot_pages for the inverse)."""
+        self._table_np[slot, :len(pids)] = pids
+        self._table_np[slot, len(pids):] = 0
+        self._table_dirty = True
+
+    def _release_slot_pages(self, i: int) -> None:
+        """Return slot i's pages at finish time (publish — the mirrored
+        reap point, directly after every collect), NOT at slot reuse:
+        a finished row's memory is admissible again at the very next
+        drained round. Shared prefix pages just drop one ref; they free
+        when their last holder (store entry or sharer) lets go."""
+        if not self.paged:
+            return
+        pids = [int(p) for p in self._table_np[i] if p]
+        if pids:
+            self.alloc.unref_all(pids)
+            self._table_np[i] = 0
+            self._table_dirty = True
+
+    def _drop_all_slots(self) -> None:
+        """Warmup-only slot wipe that returns pages too (the plain
+        `slots = [None]*B` wipe would leak every warmup admission's
+        pages into the allocator forever)."""
+        for i in range(MAX_BATCH):
+            if self.slots[i] is not None:
+                self._release_slot_pages(i)
+                self.slots[i] = None
+
+    def _clear_prefix_store(self) -> None:
+        """Empty the prefix store, returning its page refs in paged
+        mode (reset paths rebuild the allocator first and use plain
+        .clear() — stale ids must not be unref'd into a fresh pool)."""
+        if self.paged:
+            while self._prefix_store:
+                _, pids = self._prefix_store.popitem(last=False)
+                self.alloc.unref_all(pids)
+        else:
+            self._prefix_store.clear()
+
+    def _page_fp(self) -> Optional[tuple]:
+        """Allocator fingerprint shipped with admit/chunkstart ops —
+        the multi-host cross-check that page-alloc replay stayed in
+        lockstep."""
+        if not self.paged or self.alloc is None:
+            return None
+        return self.alloc.fingerprint()
+
+    def _check_page_fp(self, fp: Optional[tuple]) -> None:
+        """Follower side: compare the leader's allocator fingerprint
+        with ours BEFORE replaying the op. A mismatch means page
+        assignments have diverged — KV corruption, not recoverable by
+        retrying — so raise (the follower loop treats a failed op as
+        divergence and exits the gang loudly)."""
+        if fp is None or not self.paged:
+            return
+        mine = self._page_fp()
+        if mine != fp:
+            raise RuntimeError(
+                f'page allocator diverged from leader: leader {fp}, '
+                f'local {mine}')
+
     def _ensure_state(self) -> None:
         """Jitted step/admit closures, built once (after any test-time cfg
         overrides — rebuilding them would recompile)."""
@@ -846,6 +1110,9 @@ class InferenceEngine:
             def repl(x):
                 return x
 
+        paged = self.paged
+        from skypilot_tpu.models import paging as paging_lib
+
         def step_k(k, use_pen, want_tops):
             """k decode steps in ONE device call (host-loop dispatch cost
             amortized when no request is waiting to join). Compiled per
@@ -858,11 +1125,25 @@ class InferenceEngine:
             `last` [B] i32 is a DEVICE-RESIDENT carry (in and out):
             dispatching step N+1 needs only step N's output arrays, so
             the batch loop can keep a call in flight with no host
-            sync between steps."""
+            sync between steps.
+
+            Paged mode wraps the SAME step math: gather the contiguous
+            per-row view from the page pool (page-table indices are
+            runtime int32 data — one compiled program regardless of
+            page assignment), run the identical scan, then scatter the
+            k written positions back into the pool — inactive rows'
+            writes route to the trash page so a freed page can never
+            be corrupted by a stale step."""
 
             @functools.partial(jax.jit, donate_argnums=(1, 2))
             def run(params, cache, counts, last, temp, topk, topp, pres,
                     freq, rng, active):
+                if paged:
+                    start = cache.length
+                    view0 = paging_lib.gather_view(cache, max_len)
+                else:
+                    view0 = cache
+
                 def body(carry, _):
                     last_t, cache_t, counts_t, rng_t = carry
                     logits, cache_t = dec.decode_step(params, last_t,
@@ -886,9 +1167,14 @@ class InferenceEngine:
                         return ((nxt, cache_t, counts_t, rng_t),
                                 (nxt, lp, ti, tv))
                     return (nxt, cache_t, counts_t, rng_t), (nxt, lp)
-                (last_f, cache_f, counts_f, rng_f), outs = \
-                    jax.lax.scan(body, (last, cache, counts, rng), None,
+                (last_f, view_f, counts_f, rng_f), outs = \
+                    jax.lax.scan(body, (last, view0, counts, rng), None,
                                  length=k)
+                if paged:
+                    cache_f = paging_lib.scatter_steps(cache, view_f,
+                                                       start, k, active)
+                else:
+                    cache_f = view_f
                 if want_tops:
                     toks, lps, tis, tvs = outs
                     return (repl(toks), repl(lps), repl(tis), repl(tvs),
@@ -919,16 +1205,23 @@ class InferenceEngine:
             a concurrency burst pays ONE prefill device call instead of
             N serial ones (the TTFT-dominant cost at high load). The
             device-resident `last` carry picks up each admitted row's
-            first token here, so the next step needs no host upload."""
+            first token here, so the next step needs no host upload.
+            Paged mode scatters the S prefilled positions into the
+            pages each row's table covers instead of writing whole
+            contiguous rows."""
             logits, rows = dec.prefill(params, tokens, cfg, max_len,
                                        lengths=lengths)
 
-            def write(big, group):
-                if big.ndim == 1:               # the per-row length vector
-                    return big.at[slots].set(group)
-                return big.at[:, slots].set(group)
+            if paged:
+                cache = paging_lib.scatter_prefill(
+                    cache, rows, slots, tokens.shape[1], lengths)
+            else:
+                def write(big, group):
+                    if big.ndim == 1:           # the per-row length vector
+                        return big.at[slots].set(group)
+                    return big.at[:, slots].set(group)
 
-            cache = jax.tree.map(write, cache, rows)
+                cache = jax.tree.map(write, cache, rows)
             rng, sub = jax.random.split(rng)
             # prefill keeps the batch dim: logits [N, V].
             first = decode_lib.select_token_per_row(
@@ -969,8 +1262,8 @@ class InferenceEngine:
                     repl(tv[0]), cache, repl(last), rng)
 
         @functools.partial(jax.jit, donate_argnums=(1,),
-                           static_argnums=(3,))
-        def spec_verify(params, cache, fed, want_tops):
+                           static_argnums=(4,))
+        def spec_verify(params, cache, fed, active, want_tops):
             """One K-wide speculative verify over the WHOLE slot pool:
             fed [B, K] = per-row [last, d1..d_{K-1}]. Returns the
             target's greedy token, its logprob (and, in the
@@ -978,8 +1271,24 @@ class InferenceEngine:
             position; KV for the fed tokens is written at each row's
             offset but `length` does NOT advance — the host commits the
             accepted run (+1 correction) by bumping length, so rollback
-            is free (decode.verify_step's contract)."""
-            logits, cache2 = dec.verify_step(params, fed, cache, cfg)
+            is free (decode.verify_step's contract). ``active`` [B]
+            bool: in paged mode inactive rows' K-wide writes route to
+            the trash page (their pages may be freed); the contiguous
+            path ignores it (stale writes land on the frozen row the
+            next admission overwrites, as before)."""
+            if paged:
+                start = cache.length
+                view0 = paging_lib.gather_view(cache, max_len)
+            else:
+                view0 = cache
+            logits, view2 = dec.verify_step(params, fed, view0, cfg)
+            if paged:
+                # verify_step wrote [length, length+K) without
+                # advancing length — scatter exactly those positions.
+                cache2 = paging_lib.scatter_steps(
+                    cache, view2, start, fed.shape[1], active)
+            else:
+                cache2 = view2
             logits = logits.astype(jnp.float32)          # [B, K, V]
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             lse = jax.scipy.special.logsumexp(logits, axis=-1)
@@ -989,6 +1298,50 @@ class InferenceEngine:
                 return repl(greedy), repl(lp), cache2
             tv, ti = top5(logits)
             return repl(greedy), repl(lp), repl(ti), repl(tv), cache2
+
+        def make_extend(p, s2, sample):
+            """Paged extend program: prefill an [1, s2] suffix over the
+            p tokens row `slot` already holds — the ONE program shape
+            serving both prefix-cache hits (the prefix lives in SHARED
+            pages; only table entries were copied) and chunked prefill
+            (the prefix is the row's own earlier chunks). Compiled per
+            (p, s2 bucket, sample); `sample` is False for non-final
+            chunks, which also leave rng and the device `last`
+            untouched so a chunked admission consumes exactly the same
+            RNG stream as a contiguous one."""
+
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def run(params, cache, last, tokens, length_s, slot, temp,
+                    topk, topp, rng):
+                pa, pb = paging_lib.gather_prefix(cache, slot, p)
+                # Intermediates sized p+s2, not engine max_len: a chunk
+                # call materializes only the row it extends.
+                logits, row = dec.prefill_extend(
+                    params, tokens, cfg, p + s2, pa, pb,
+                    lengths=length_s[None])
+                cache2 = paging_lib.scatter_suffix(
+                    cache, row, slot, p, s2, p + length_s)
+                if not sample:
+                    return cache2
+                rng, sub = jax.random.split(rng)
+                first = decode_lib.select_token_per_row(
+                    logits, temp[None], topk[None], topp[None], sub)
+                first_lp = decode_lib.chosen_logprob(logits, first)
+                tv, ti = top5(logits)
+                last = last.at[slot].set(first[0])
+                return (repl(first[0]), repl(first_lp[0]), repl(ti[0]),
+                        repl(tv[0]), cache2, repl(last), rng)
+            return run
+
+        self._extend_jits: Dict[Tuple[int, int, bool], Any] = {}
+
+        def extend_jit(p, s2, sample):
+            key = (p, s2, bool(sample))
+            if key not in self._extend_jits:
+                self._extend_jits[key] = make_extend(*key)
+            return self._extend_jits[key]
+
+        self._extend_jit = extend_jit
 
         @jax.jit
         def fix_last(last, mask, vals):
@@ -1045,31 +1398,57 @@ class InferenceEngine:
         if self.spec_k > 0:
             # Compile BOTH speculative verify variants (garbage fed/KV
             # is fine: length does not advance, and every later step
-            # overwrites its own slot before attending it).
+            # overwrites its own slot before attending it; in paged
+            # mode the all-False active mask routes the garbage writes
+            # to the trash page).
+            self._refresh_table()
             fed = jnp.zeros((MAX_BATCH, self.spec_k), jnp.int32)
+            no_rows = jnp.zeros((MAX_BATCH,), bool)
             for want_tops in (False, True):
                 *_, self.cache = self._spec_jit(self.params, self.cache,
-                                                fed, want_tops)
+                                                fed, no_rows, want_tops)
         # The device-last resync program (mid-chunk stop/length
         # finishes and speculative commits re-pin device == mirror).
         self.last_dev = self._fix_last_jit(
             self.last_dev, jnp.zeros((MAX_BATCH,), bool),
             jnp.asarray(self.last))
-        self.slots = [None] * MAX_BATCH
+        self._drop_all_slots()
+
+        def _fits_warm(item, size: int) -> bool:
+            # An oversubscribed pool (small SKYTPU_ENGINE_KV_PAGES)
+            # cannot hold every warm group; admission gating will never
+            # select those group sizes either, so skip their compiles
+            # (they fall back to on-demand if a smaller-reservation mix
+            # ever selects them).
+            return (not self.paged or
+                    size * self._pages_needed(item) <=
+                    self.alloc.free_count)
+
         for size in self._group_sizes()[1:]:
+            if not _fits_warm(warm_item, size):
+                continue
             self._admit_group([warm_item] * size)
-            self.slots = [None] * MAX_BATCH
+            self._drop_all_slots()
         for b in (buckets or []):
             # b == max_len is unreachable by traffic (_check_len needs
             # bucket + max_new <= max_len with max_new >= 1) — don't pay
-            # an XLA compile for it.
+            # an XLA compile for it. Paged mode: prompts longer than
+            # PREFILL_CHUNK admit via the chunked-extend programs (the
+            # grid below), never the grouped-prefill ones — skip those
+            # buckets here.
             if b <= 16 or b >= self.max_len:
+                continue
+            if self.paged and b > self.prefill_chunk:
                 continue
             item_b = (list(range(1, b + 1)), 1, 0.0, None, None, 0.0,
                       0.0, (), False, None, None)
             for size in self._group_sizes():
+                if not _fits_warm(item_b, size):
+                    continue
                 self._admit_group([item_b] * size)
-                self.slots = [None] * MAX_BATCH
+                self._drop_all_slots()
+        if self.paged and buckets:
+            self._warm_chunk_grid()
         self.last[:] = 0
         self.last_dev = jnp.zeros(MAX_BATCH, jnp.int32)
         # Warmup admits must not pollute the served-token/step metrics
@@ -1079,7 +1458,7 @@ class InferenceEngine:
         # warmup prompts must never match real traffic).
         self.step_count = 0
         self.tokens_generated = 0
-        self._prefix_store.clear()
+        self._clear_prefix_store()
         self.prefix_hits = 0
         for metric in _ENGINE_METRICS:
             metric.reset()
@@ -1095,6 +1474,51 @@ class InferenceEngine:
                     '+ grouped-admit programs compiled; buckets: '
                     f'{sorted(set([16] + list(buckets or [])))}, '
                     f'group sizes: {self._group_sizes()}).')
+
+    def _warm_chunk_grid(self) -> None:
+        """Compile every chunked-prefill extend program traffic can
+        select (paged mode): non-final chunks at (p = i·C, s2 = C,
+        sample=False) and final chunks at (p = i·C ≥ C, s2 = any tail
+        bucket ≤ C, sample=True) — p is always a multiple of
+        PREFILL_CHUNK because only prefix-MISS prompts chunk (hits ride
+        the on-demand prefix-extend programs, as before). Executed with
+        zero tokens against the zeroed table, so every write lands on
+        the trash page and no pages are consumed."""
+        jnp = self._jnp
+        self._refresh_table()
+        c = self.prefill_chunk
+        zero = jnp.float32(0.0)
+        zk = jnp.int32(0)
+        slot0 = jnp.int32(0)
+
+        def tails() -> List[int]:
+            out, b = [], 16
+            while b <= c:
+                out.append(b)
+                b *= 2
+            return out
+
+        p = 0
+        while p + c < self.max_len:
+            run = self._extend_jit(p, c, False)
+            self.cache = run(self.params, self.cache, self.last_dev,
+                             jnp.zeros((1, c), jnp.int32), jnp.int32(c),
+                             slot0, zero, zk, zero, self.rng)
+            p += c
+        p = c
+        while p < self.max_len:
+            for b in tails():
+                if p + b >= self.max_len:
+                    continue
+                run = self._extend_jit(p, b, True)
+                (_f, _lp, _ti, _tv, self.cache, self.last_dev,
+                 self.rng) = run(
+                    self.params, self.cache, self.last_dev,
+                    jnp.zeros((1, b), jnp.int32), jnp.int32(b), slot0,
+                    zero, zk, zero, self.rng)
+            p += c
+        # The sampled warm calls touched slot 0's device `last`;
+        # warmup re-zeros both carries right after this returns.
 
     def all_buckets(self) -> List[int]:
         """Every admissible prompt bucket (for --warm-buckets all) —
@@ -1235,14 +1659,29 @@ class InferenceEngine:
         if key in self._prefix_store:
             self._prefix_store.move_to_end(key)
             return
-        if hasattr(self.cache, 'k'):
+        if self.paged:
+            # A snapshot is p/page_size REFS on the slot's prefix pages
+            # — page-table entries, not HBM. The slot keeps decoding
+            # into its own pages at positions ≥ len(tokens) ≥ p, so
+            # the shared pages stay read-only for everyone.
+            n = p // self.page_size
+            pids = [int(x) for x in self._table_np[slot, :n]]
+            if not pids or 0 in pids:
+                return        # row reserved fewer pages than p (never
+                #               happens for admitted traffic; guard)
+            for pid in pids:
+                self.alloc.ref(pid)
+            self._prefix_store[key] = pids
+        elif hasattr(self.cache, 'k'):
             self._prefix_store[key] = (self.cache.k[:, slot, :p],
                                        self.cache.v[:, slot, :p])
         else:
             self._prefix_store[key] = (self.cache.c_kv[:, slot, :p],
                                        self.cache.k_rope[:, slot, :p])
         while len(self._prefix_store) > PREFIX_CACHE_ENTRIES:
-            self._prefix_store.popitem(last=False)
+            _, old = self._prefix_store.popitem(last=False)
+            if self.paged:
+                self.alloc.unref_all(old)
 
     @timeline.event
     def _admit_with_prefix(self, item, p: int) -> int:
@@ -1262,10 +1701,36 @@ class InferenceEngine:
         self.pres[slot] = float(pres or 0.0)
         self.freq[slot] = float(freq or 0.0)
         key = tuple(tokens[:p])
-        pk, pv = self._prefix_store[key]
-        self._prefix_store.move_to_end(key)
-        first, first_lp, ti, tv, self.cache, self.last_dev, self.rng = \
-            self._admit_extend_jit(
+        if self.paged:
+            # Zero-copy sharing: the hit's table points at the SAME
+            # pages the store entry holds (one ref each); only the
+            # suffix gets own pages, and the extend program gathers the
+            # prefix from the shared pages every other holder reads.
+            # p is a power of two ≥ PREFIX_MIN_TOKENS and page_size
+            # divides PREFIX_MIN_TOKENS, so the suffix starts exactly
+            # on a page boundary — a sharer can never write a shared
+            # page.
+            shared = self._prefix_store[key]
+            self._prefix_store.move_to_end(key)
+            need = self._pages_needed(item)
+            own = self._alloc_pages(max(0, need - len(shared)))
+            for pid in shared:
+                self.alloc.ref(pid)
+            self._reserve_slot_pages(slot, list(shared) + own)
+            self._refresh_table()
+            run = self._extend_jit(p, s2, True)
+            (first, first_lp, ti, tv, self.cache, self.last_dev,
+             self.rng) = run(
+                self.params, self.cache, self.last_dev, padded,
+                jnp.int32(len(suffix)), jnp.int32(slot),
+                jnp.float32(self.temp[slot]),
+                jnp.int32(self.topk[slot]),
+                jnp.float32(self.topp[slot]), self.rng)
+        else:
+            pk, pv = self._prefix_store[key]
+            self._prefix_store.move_to_end(key)
+            (first, first_lp, ti, tv, self.cache, self.last_dev,
+             self.rng) = self._admit_extend_jit(
                 self.params, self.cache, self.last_dev, pk, pv, padded,
                 jnp.int32(len(suffix)), jnp.int32(slot),
                 jnp.float32(self.temp[slot]), jnp.int32(self.topk[slot]),
@@ -1301,6 +1766,14 @@ class InferenceEngine:
         self.flight.record(flight_lib.ADMIT, slot, _bucket(len(tokens)))
         meta = (self._submit_meta.pop(id(fut), None)
                 if fut is not None else None)
+        if meta is not None:
+            # Submit → admission (pages + slot granted): the queue-wait
+            # quantity the paged/chunked admission exists to shrink.
+            # For chunked admits the anchor is chunkstart, so chunk
+            # rounds count as prefill, not wait.
+            _M_ADMIT_WAIT.observe(max(
+                0.0, (getattr(self, '_admit_t0_ns', now_ns) - meta[0])
+                / 1e9))
         # ctx = prompt ++ generated: the prompt-lookup draft source AND
         # the host mirror of the row's cache length (len(ctx) - 1).
         entry = {'fut': fut, 'want': max_new, 'out': [], 'lps': [],
@@ -1358,6 +1831,16 @@ class InferenceEngine:
                 p = self._prefix_match(item[0])
                 if p is not None:
                     self._admit_with_prefix(item, p)
+                elif self._should_chunk(item):
+                    # Classified as a prefix HIT upstream, but the
+                    # snapshot was evicted in the meantime (page-
+                    # pressure eviction or LRU overflow from an
+                    # earlier group in this same pass): take the
+                    # chunked path — a grouped prefill at a
+                    # bucket > PREFILL_CHUNK is a program warmup
+                    # deliberately never compiled. Deterministic on
+                    # followers (mirrored store + config).
+                    self._start_chunked(item)
                 else:
                     rest.append(item)
             if not rest:
@@ -1391,6 +1874,15 @@ class InferenceEngine:
             temps.append(self.temp[slot])
             topks.append(self.topk[slot])
             topps.append(self.topp[slot])
+            if self.paged:
+                # Reserve the row's worst-case pages up front and point
+                # its table at them; positions past the reservation
+                # read/write the trash page (pad garbage, never
+                # attended). The leader gates admission on this exact
+                # count, so alloc cannot fail here.
+                self._reserve_slot_pages(
+                    slot, self._alloc_pages(self._pages_needed(item)))
+        self._refresh_table()
         if self.warm and PREFIX_CACHE_ENTRIES > 0:
             # Every item reaching the grouped prefill was a prefix-cache
             # lookup miss (hits rode _admit_with_prefix above).
@@ -1427,6 +1919,121 @@ class InferenceEngine:
             if s is None and i not in taken:
                 return i
         return None
+
+    # -- chunked prefill (paged mode) -----------------------------------
+    def _should_chunk(self, item) -> bool:
+        """Long prefix-miss prompts prefill in PREFILL_CHUNK-token
+        pieces interleaved with decode rounds instead of one monolithic
+        bucket prefill. Prefix HITS keep the whole-suffix extend path
+        (one on-demand program per (p, suffix-bucket), the pre-paging
+        compile model) — chunk alignment stays a multiple of
+        PREFILL_CHUNK, so the chunk program grid is bounded and
+        warmable."""
+        if not self.paged or len(item[0]) <= self.prefill_chunk:
+            return False
+        if self.warm and PREFIX_CACHE_ENTRIES > 0 and \
+                self._prefix_match(item[0]) is not None:
+            return False
+        return True
+
+    def _pending_chunks(self) -> List[int]:
+        """Slots mid-chunked-prefill (occupied, unfinished, prefill
+        state present)."""
+        return [i for i, s in enumerate(self.slots)
+                if s is not None and s['finish'] is None and
+                s.get('prefill') is not None]
+
+    @timeline.event
+    def _start_chunked(self, item) -> int:
+        """Begin a chunked admission: claim the slot + reserve all
+        pages now (admission blocks on free pages, not bucket shape),
+        run the FIRST chunk, and leave the slot in the prefilling state
+        — the batch loop advances one chunk per drained round, so short
+        requests keep admitting and decoding between chunks. Mirrored
+        on followers via the ('chunkstart', item, fp) op."""
+        assert not self._inflight, \
+            'chunk start while a step is in flight'
+        (tokens, max_new, temperature, top_k, top_p, pres, freq,
+         stop_ids, want_tops, stream_q, fut) = item
+        slot = self._free_slot()
+        assert slot is not None
+        self.temp[slot] = max(float(temperature), 0.0)
+        self.topk[slot] = int(top_k) if top_k else 0
+        self.topp[slot] = float(top_p) if top_p else 0.0
+        self.pres[slot] = float(pres or 0.0)
+        self.freq[slot] = float(freq or 0.0)
+        self._reserve_slot_pages(
+            slot, self._alloc_pages(self._pages_needed(item)))
+        self.slots[slot] = {
+            'fut': fut, 'stream': stream_q, 'finish': None,
+            'want': max_new, 'out': [], 'lps': [], 'tops': [],
+            'stop': frozenset(stop_ids or ()), 'sent': 0,
+            'want_tops': bool(want_tops), 'ctx': list(tokens),
+            'prefill': {'item': item, 'pos': 0,
+                        't_admit_ns': time.monotonic_ns()},
+        }
+        self._advance_chunk(slot)
+        return slot
+
+    @timeline.event
+    def _advance_chunk(self, slot: int) -> None:
+        """Run ONE prefill chunk for `slot` (drained points only;
+        followers replay via ('chunk', slot)). Non-final chunks write
+        positions [pos, pos+C) into the row's own pages and touch
+        neither the RNG nor the device `last` carry, so a chunked
+        admission consumes exactly the contiguous path's RNG stream;
+        the final chunk samples the first token and converts the slot
+        into a normal decoding entry (_finish_admit)."""
+        jnp = self._jnp
+        s = self.slots[slot]
+        if s is None or s['finish'] is not None or \
+                s.get('prefill') is None:
+            return          # cancelled mid-prefill; publish reaps it
+        assert not self._inflight, 'chunk while a step is in flight'
+        st = s['prefill']
+        item = st['item']
+        tokens = item[0]
+        pos = st['pos']
+        c = self.prefill_chunk
+        remaining = len(tokens) - pos
+        t0 = time.perf_counter()
+        self._refresh_table()
+        if remaining > c:
+            run = self._extend_jit(pos, c, False)
+            chunk = jnp.asarray([tokens[pos:pos + c]], jnp.int32)
+            self.cache = run(
+                self.params, self.cache, self.last_dev, chunk,
+                jnp.int32(c), jnp.int32(slot),
+                jnp.float32(self.temp[slot]),
+                jnp.int32(self.topk[slot]),
+                jnp.float32(self.topp[slot]), self.rng)
+            st['pos'] = pos + c
+            self.flight.record(flight_lib.CHUNK, slot, pos + c)
+            _M_ADMIT_SECONDS.observe(time.perf_counter() - t0)
+            return
+        s2 = _bucket(remaining)
+        padded = jnp.asarray(
+            [tokens[pos:] + [0] * (s2 - remaining)], jnp.int32)
+        run = self._extend_jit(pos, s2, True)
+        (first, first_lp, ti, tv, self.cache, self.last_dev,
+         self.rng) = run(
+            self.params, self.cache, self.last_dev, padded,
+            jnp.int32(remaining), jnp.int32(slot),
+            jnp.float32(self.temp[slot]), jnp.int32(self.topk[slot]),
+            jnp.float32(self.topp[slot]), self.rng)
+        first_i = int(first)
+        self.counts = self.counts.at[slot].set(0).at[
+            slot, first_i].add(1)
+        # Convert to a decoding slot: _finish_admit rebuilds the entry;
+        # the admission anchor is the chunkstart timestamp, so queue
+        # wait excludes (and prefill time includes) the chunk rounds.
+        self._admit_t0_ns = st['t_admit_ns']
+        self.slots[slot] = None
+        self._finish_admit(item, slot, first_i, float(first_lp),
+                           _tops_list(ti, tv))
+        self.flight.record(flight_lib.CHUNK, slot, len(tokens))
+        self._prefix_capture(tokens, slot)
+        _M_ADMIT_SECONDS.observe(time.perf_counter() - t0)
 
     @timeline.event
     def _spec_once(self) -> bool:
@@ -1475,7 +2082,7 @@ class InferenceEngine:
         if not self._spec_precheck():
             return False
         active_idx = [i for i, s in enumerate(self.slots)
-                      if s is not None and s['finish'] is None]
+                      if self._row_active(s)]
         drafts = {}
         real_len = {}
         no_draft = False
@@ -1502,12 +2109,17 @@ class InferenceEngine:
             fed[i, 1:] = (drafts[i][:k - 1] if i in drafts
                           else [self.last[i]] * (k - 1))
         want_tops = any(self.slots[i]['want_tops'] for i in active_idx)
+        self._refresh_table()
+        active_arr = jnp.asarray([self._row_active(s)
+                                  for s in self.slots])
         if want_tops:
             greedy, lps, tis, tvs, self.cache = self._spec_jit(
-                self.params, self.cache, jnp.asarray(fed), True)
+                self.params, self.cache, jnp.asarray(fed), active_arr,
+                True)
         else:
             greedy, lps, self.cache = self._spec_jit(
-                self.params, self.cache, jnp.asarray(fed), False)
+                self.params, self.cache, jnp.asarray(fed), active_arr,
+                False)
             tis = tvs = None
         t_sync = time.perf_counter()
         greedy = jax.device_get(greedy)          # [B, K]
@@ -1579,8 +2191,7 @@ class InferenceEngine:
         `inflight_k`: an uncollected call's tokens are budgeted as
         already consumed (the lookahead view)."""
         return [s['want'] - len(s['out']) - inflight_k
-                for s in self.slots
-                if s is not None and s['finish'] is None]
+                for s in self.slots if self._row_active(s)]
 
     def _choose_k(self, inflight_k: int = 0) -> int:
         """Step width for the next fused call. k ∈ {1, MAX_STEP_CHUNK}
@@ -1589,6 +2200,13 @@ class InferenceEngine:
         to trigger a fresh XLA compile via tail-chunk sizes.
         Leader-only inputs (the admission queue) feed this, so
         multi-host broadcasts the chosen k."""
+        if self._hold or self._pending_chunks():
+            # A request waiting on free pages retries admission — and
+            # a prefilling row advances its chunk — only at drained
+            # points: fused 8-token steps would multiply their wait
+            # (pre-paging, any waiter sat in _queue and forced k=1
+            # through the queue.empty() check below).
+            return 1
         remaining = self._remaining(inflight_k)
         if (remaining and min(remaining) >= MAX_STEP_CHUNK and
                 (self._queue is None or self._queue.empty())):
@@ -1613,6 +2231,11 @@ class InferenceEngine:
             return None
         if self._queue is not None and not self._queue.empty():
             return None
+        if self._hold or self._pending_chunks():
+            # A held request needs the next drained point to re-try
+            # admission; a prefilling row needs it to advance its
+            # chunk — don't pipeline past either.
+            return None
         if self._spec_precheck():
             return None
         remaining = self._remaining(inflight_k)
@@ -1627,7 +2250,7 @@ class InferenceEngine:
         if self.spec_k <= 0 or not self.warm or self._spec_cool > 0:
             return False
         active_idx = [i for i, s in enumerate(self.slots)
-                      if s is not None and s['finish'] is None]
+                      if self._row_active(s)]
         if not active_idx:
             return False
         if any(self.temp[i] > 0 for i in active_idx):
@@ -1649,12 +2272,12 @@ class InferenceEngine:
         instead of at the next reap."""
         t0 = time.perf_counter()
         jnp = self._jnp
-        active = jnp.asarray([s is not None and s['finish'] is None
-                              for s in self.slots])
+        self._refresh_table()
+        active = jnp.asarray([self._row_active(s) for s in self.slots])
         use_pen = bool(self.pres.any() or self.freq.any())
         want_tops = (bool(want_tops_force) if want_tops_force is not None
-                     else any(s is not None and s['finish'] is None and
-                              s['want_tops'] for s in self.slots))
+                     else any(self._row_active(s) and s['want_tops']
+                              for s in self.slots))
         out = self._step_jit(
             self.params, self.cache, self.counts, self.last_dev,
             jnp.asarray(self.temp), jnp.asarray(self.topk),
@@ -1706,10 +2329,14 @@ class InferenceEngine:
         _M_STEPS.inc(k)
         fixups = []
         for i, s in enumerate(self.slots):
-            if s is None or s['finish'] is not None:
+            if s is None or s['finish'] is not None or \
+                    s.get('prefill') is not None:
                 # Finished rows were masked inactive at dispatch (or
                 # this call was dispatched before the finish was known
-                # — either way their outputs are not consumed).
+                # — either way their outputs are not consumed). Rows
+                # mid-chunked-prefill are masked too: their step
+                # "outputs" are the stale device-last carry, not
+                # tokens.
                 continue
             for t in range(k):
                 tok = int(toks[t][i])
@@ -1792,6 +2419,16 @@ class InferenceEngine:
                     fut.set_result((s['out'], s['finish'], s['lps'],
                                     s['tops']))
                 self.slots[i] = None
+                # Paged mode: the row's pages return to the free list
+                # NOW (publish directly follows every collect and is
+                # the mirrored reap point) — not when the slot is
+                # reused. A stopped/cancelled row's memory is
+                # admissible at the next drained round. An in-flight
+                # lookahead step may still write these pages, but
+                # reallocation only happens at drained points, and
+                # device ops execute in dispatch order — the stale
+                # write lands before the new occupant's prefill.
+                self._release_slot_pages(i)
                 # Clear the row's sampling/penalty params: use_pen keys
                 # off pres/freq.any(), so a stale penalized row would
                 # pin every later step onto the penalized compiled
@@ -1836,13 +2473,53 @@ class InferenceEngine:
         admitted (429'd, cancelled in queue) or already-popped ones."""
         return self._timings.pop(id(fut), None)
 
-    def _drain_admissible(self, already: int = 0) -> list:
-        """Pop queued requests up to the free-slot budget (non-blocking);
-        `already` counts items the caller holds outside the queue."""
+    def _drain_admissible(self) -> list:
+        """Pop admissible requests (non-blocking): bounded by free
+        slots AND, in paged mode, by free pages (counting what evicting
+        unshared prefix-store entries would return). An item that fits
+        neither waits in `_hold` — FIFO: once something is held,
+        nothing younger is popped past it, so a flood of short prompts
+        can never starve a held long one. Admission blocks only on
+        free pages, never on bucket shape."""
         items = []
-        free = sum(1 for s in self.slots if s is None) - already
-        while len(items) < free and not self._queue.empty():
-            items.append(self._queue.get_nowait())
+        free_slots = sum(1 for s in self.slots if s is None)
+        budget = (self.alloc.free_count + self._evictable_pages()
+                  if self.paged else None)
+
+        def fits(it) -> bool:
+            nonlocal budget
+            if budget is None:
+                return True
+            n = self._pages_needed(it)
+            if n > budget:
+                return False
+            budget -= n
+            return True
+
+        held, self._hold = self._hold, []
+        for it in held:
+            if it[-1] is not None and it[-1].done():
+                self._hold_waited.discard(id(it))
+                continue          # cancelled while waiting
+            if len(items) < free_slots and fits(it):
+                self._hold_waited.discard(id(it))
+                items.append(it)
+            else:
+                self._hold.append(it)
+        while (not self._hold and len(items) < free_slots and
+               not self._queue.empty()):
+            it = self._queue.get_nowait()
+            if it[-1] is not None and it[-1].done():
+                continue          # cancelled while queued
+            if fits(it):
+                items.append(it)
+            else:
+                self._hold.append(it)
+                if id(it) not in self._hold_waited:
+                    # Counted once per request: this admission attempt
+                    # found the pool short of pages.
+                    self._hold_waited.add(id(it))
+                    _M_PAGE_ALLOC.inc(outcome='wait')
         return items
 
     @staticmethod
@@ -1866,21 +2543,40 @@ class InferenceEngine:
         return groups
 
     async def _admit_pending(self, first_item=None) -> None:
-        items = ([first_item] if first_item is not None else [])
-        items += self._drain_admissible(already=len(items))
-        # A cancelled future means the client already gave up on the
-        # queued request (e.g. a 429'd batched fan-out cancelling its
-        # enqueued siblings) — don't burn a prefill on it.
-        items = [it for it in items
-                 if it[-1] is None or not it[-1].done()]
-        for group in self._admit_groups(items):
+        # A first_item was popped by the idle wait; it is younger than
+        # anything in _hold (which is empty on that path) and older
+        # than anything still queued — append + drain keeps FIFO.
+        if first_item is not None:
+            self._hold.append(first_item)
+        # _drain_admissible drops cancelled futures (a 429'd batched
+        # fan-out cancelling its enqueued siblings) — don't burn a
+        # prefill on them.
+        items = self._drain_admissible()
+        grouped = [it for it in items if not self._should_chunk(it)]
+        chunked = [it for it in items if self._should_chunk(it)]
+        for group in self._admit_groups(grouped):
             if self._ctrl is not None:
                 from skypilot_tpu.serve import multihost
-                self._bcast(('admit', multihost.strip_items(group)))
+                self._bcast(('admit', multihost.strip_items(group),
+                             self._page_fp()))
             try:
                 await asyncio.to_thread(self._admit_group, group)
             except Exception as e:  # pylint: disable=broad-except
+                # _fail_all resets device state (fresh pool +
+                # allocator); later groups/chunk starts admit against
+                # the rebuilt state — never drop them unfailed, their
+                # futures would hang forever.
                 self._fail_all(e, extra=group)
+        for item in chunked:
+            if self._ctrl is not None:
+                from skypilot_tpu.serve import multihost
+                self._bcast(('chunkstart',
+                             multihost.strip_items([item])[0],
+                             self._page_fp()))
+            try:
+                await asyncio.to_thread(self._start_chunked, item)
+            except Exception as e:  # pylint: disable=broad-except
+                self._fail_all(e, extra=item)
 
     async def batch_loop(self) -> None:
         """Continuous scheduler: admit whenever a slot is free, step
@@ -1897,15 +2593,42 @@ class InferenceEngine:
             self._process_cancels()
             busy = any(s is not None for s in self.slots)
             if not busy:
-                item = await self._queue.get()
-                await self._admit_pending(first_item=item)
+                if self._hold:
+                    # Requests waiting on free pages: with the pool
+                    # idle, prefix-store eviction guarantees they fit
+                    # (a reservation never exceeds the pool), so admit
+                    # without blocking on new arrivals.
+                    await self._admit_pending()
+                    if not any(s is not None for s in self.slots):
+                        await asyncio.sleep(0.05)   # defensive: no spin
+                else:
+                    item = await self._queue.get()
+                    await self._admit_pending(first_item=item)
                 self._publish()         # want==1 resolves without a step
                 continue
-            if self._free_slot() is not None and not self._queue.empty():
+            if self._free_slot() is not None and (
+                    self._hold or not self._queue.empty()):
                 await self._admit_pending()
             self._publish()             # first tokens stream immediately
             if all(s is None for s in self.slots):
                 continue                # the publish reaped everything
+            pending = self._pending_chunks()
+            if pending:
+                # Chunked prefill interleave: ONE chunk per scheduling
+                # round, round-robin over prefilling rows, so decode
+                # rounds (below) keep running between chunks and a
+                # long prompt never monopolizes the device.
+                slot = pending[self._chunk_rr % len(pending)]
+                self._chunk_rr += 1
+                self._bcast(('chunk', slot))
+                try:
+                    await asyncio.to_thread(self._advance_chunk, slot)
+                except Exception as e:  # pylint: disable=broad-except
+                    self._fail_all(e)
+                    continue
+                self._publish()     # a final chunk's first token streams
+            if not any(self._row_active(s) for s in self.slots):
+                continue                # everyone is still prefilling
             try:
                 await self._step_round()
             except Exception as e:  # pylint: disable=broad-except
@@ -2233,6 +2956,9 @@ def build_app(engine: InferenceEngine):
         del request
         _M_QUEUE_DEPTH.set(engine.queue_depth())
         _M_IN_FLIGHT.set(engine.in_flight())
+        if engine.paged and engine.alloc is not None:
+            _M_PAGES_FREE.set(engine.alloc.free_count)
+            _M_PAGES_USED.set(engine.alloc.used_count)
         return web.Response(text=metrics_lib.render(),
                             content_type='text/plain')
 

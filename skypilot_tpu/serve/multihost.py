@@ -280,7 +280,24 @@ def follower_serve(engine, coordinator: str) -> None:
                 engine._seed = op[2]   # leader-drawn sampling seed
                 engine.warmup(buckets=op[1])
             elif kind == 'admit':
+                # op[2] (paged mode): the leader's page-allocator
+                # fingerprint BEFORE this admit — our mirrored
+                # allocator must agree or page assignments have
+                # diverged (KV corruption); _check_page_fp raises and
+                # the divergence path below exits the gang loudly.
+                engine._check_page_fp(op[2] if len(op) > 2 else None)
                 engine._admit_group(op[1])
+            elif kind == 'chunkstart':
+                # Begin a chunked admission (paged mode): reserve the
+                # slot + pages and run the first prefill chunk at the
+                # same op-stream point the leader does.
+                engine._check_page_fp(op[2] if len(op) > 2 else None)
+                engine._start_chunked(op[1])
+            elif kind == 'chunk':
+                # Advance one prefill chunk for the named slot (the
+                # leader's round-robin choice is leader-private — the
+                # slot index rides the op).
+                engine._advance_chunk(op[1])
             elif kind == 'step':
                 # DISPATCH only (pipelined): the leader broadcasts a
                 # separate ('collect',) before it consumes the

@@ -1,0 +1,362 @@
+"""Block-paged KV cache + chunked prefill in the serving engine.
+
+The contracts under test (docs/ENGINE.md):
+  - EQUALITY: paged decode (gather view → identical step math →
+    scatter back) is TOKEN-IDENTICAL to the contiguous layout for
+    greedy and sampled pools — masked trash-page garbage contributes
+    exactly zero through the attention softmax, and the RNG stream is
+    consumed at the same points.
+  - CHUNKED PREFILL: a long prefix-miss prompt prefills in
+    PREFILL_CHUNK pieces interleaved with decode rounds — short
+    requests keep decoding between chunks — and its output still
+    equals the contiguous one-shot prefill's exactly.
+  - RELEASE AT FINISH: a finished/cancelled row's pages return to the
+    free list at publish (directly after collect), not at slot reuse;
+    warmup leaks nothing.
+  - PAGE-GATED ADMISSION: admission blocks only on free pages (FIFO —
+    held requests are never starved by younger arrivals), visible in
+    kv_page_alloc_total{outcome="wait"}; everything eventually serves.
+  - PREFIX SHARING: a prefix-cache hit costs page-table entries +
+    suffix pages, not a copied snapshot; the store holds page refs
+    that free on eviction.
+
+All CPU-backed (JAX_PLATFORMS=cpu), like the rest of tier-1.
+"""
+import asyncio
+import dataclasses
+
+import numpy as np
+import pytest
+from aiohttp.test_utils import TestClient
+from aiohttp.test_utils import TestServer as AioTestServer
+
+import jax.numpy as jnp
+
+from skypilot_tpu.models import decode
+from skypilot_tpu.serve import engine as engine_lib
+
+SEED = 20260803
+
+
+def _build(paged: bool, *, max_len=128, page_size=None, kv_pages=None,
+           prefill_chunk=None, spec_k=0):
+    eng = engine_lib.InferenceEngine('llama-debug', max_len=max_len,
+                                     seed=SEED)
+    # fp32: CPU reduction order must not flip argmax vs the reference.
+    eng.cfg = dataclasses.replace(eng.cfg, dtype=jnp.float32)
+    eng.spec_k = spec_k
+    eng.paged = paged
+    if page_size is not None:
+        eng.page_size = page_size
+    if kv_pages is not None:
+        eng.kv_pages = kv_pages
+    if prefill_chunk is not None:
+        eng.prefill_chunk = prefill_chunk
+    eng.warmup()
+    return eng
+
+
+@pytest.fixture(scope='module')
+def paged():
+    return _build(True, prefill_chunk=16)
+
+
+@pytest.fixture(scope='module')
+def contiguous():
+    return _build(False)
+
+
+@pytest.fixture(scope='module')
+def tight():
+    """Small oversubscribed pool: page_size 16 (divides the 64-token
+    prefix floor), 12 pages total — about two concurrent mid-size
+    requests' worth — so admission actually waits on pages."""
+    return _build(True, page_size=16, kv_pages=12, prefill_chunk=16)
+
+
+def _serve(eng, jobs):
+    """Drive the real batch loop: jobs are submit_nowait arg tuples;
+    returns the resolved (out, finish, lps, tops) per job."""
+    async def main():
+        eng._queue = asyncio.Queue(maxsize=engine_lib.MAX_QUEUE)
+        task = asyncio.get_running_loop().create_task(eng.batch_loop())
+        futs = [eng.submit_nowait(*j) for j in jobs]
+        try:
+            return [await f for f in futs]
+        finally:
+            task.cancel()
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(main())
+    finally:
+        loop.close()
+
+
+def _with_client(engine, fn):
+    async def inner():
+        client = TestClient(AioTestServer(engine_lib.build_app(engine)))
+        await client.start_server()
+        try:
+            return await fn(client)
+        finally:
+            await client.close()
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(inner())
+    finally:
+        loop.close()
+
+
+class TestPagedEquality:
+
+    def test_greedy_token_identical_to_contiguous(self, paged,
+                                                  contiguous):
+        jobs = [([1, 2, 3, 4, 5, 6, 7, 8], 16, 0.0, None, None),
+                ([9] * 20, 12, 0.0, None, None),
+                ([3, 1, 4, 1, 5], 8, 0.0, None, None)]
+        a = _serve(paged, jobs)
+        b = _serve(contiguous, jobs)
+        for (oa, fa, la, _), (ob, fb, lb, _) in zip(a, b):
+            assert list(oa) == list(ob)
+            assert fa == fb
+            np.testing.assert_allclose(la, lb, rtol=1e-6)
+
+    def test_greedy_matches_decode_generate_reference(self, paged):
+        prompt = [5, 4, 3, 2, 1, 6, 7, 8]
+        (out, finish, _, _), = _serve(paged, [(prompt, 10, 0.0, None,
+                                               None)])
+        ref = np.asarray(decode.generate(
+            paged.params, jnp.asarray([prompt], jnp.int32), paged.cfg,
+            10, max_len=paged.max_len)[0])
+        assert list(out) == list(ref)
+        assert finish == 'length'
+
+    def test_sampled_pool_token_identical_to_contiguous(self, paged,
+                                                        contiguous):
+        """Mixed-sampling pool (temperature/top_k/top_p per row), same
+        seed: the paged engine consumes the RNG stream at exactly the
+        contiguous engine's points, so every sampled token matches."""
+        import jax
+        jobs = [([11] * 8, 10, 0.9, 40, 0.95),
+                ([12] * 8, 10, 0.7, None, None),
+                ([13, 14, 15], 10, 1.2, 20, 0.8),
+                ([16] * 8, 10, 0.0, None, None)]   # a greedy row mixed in
+        # The module fixtures served different earlier traffic — re-pin
+        # the sampling RNG so both engines draw the same stream here.
+        paged.rng = jax.random.PRNGKey(SEED)
+        contiguous.rng = jax.random.PRNGKey(SEED)
+        a = _serve(paged, jobs)
+        b = _serve(contiguous, jobs)
+        for (oa, *_), (ob, *_) in zip(a, b):
+            assert list(oa) == list(ob)
+
+
+class TestChunkedPrefill:
+
+    def test_chunked_output_identical_and_decode_interleaves(
+            self, paged):
+        """A 100-token prompt (chunk size 16 → 7 chunk calls) admitted
+        with a short request: the long output still equals the one-shot
+        reference exactly, AND decode dispatches ran BETWEEN chunk
+        calls — the interleave that keeps short traffic streaming while
+        a long prompt fills."""
+        paged.flight.clear()
+        long_p = [(i * 7) % 250 + 1 for i in range(100)]
+        short_p = [42, 43, 44, 45]
+        (lo, lf, _, _), (so, sf, _, _) = _serve(
+            paged, [(long_p, 6, 0.0, None, None),
+                    (short_p, 16, 0.0, None, None)])
+        ref_l = np.asarray(decode.generate(
+            paged.params, jnp.asarray([long_p], jnp.int32), paged.cfg,
+            6, max_len=paged.max_len)[0])
+        ref_s = np.asarray(decode.generate(
+            paged.params, jnp.asarray([short_p], jnp.int32), paged.cfg,
+            16, max_len=paged.max_len)[0])
+        assert list(lo) == list(ref_l) and lf == 'length'
+        assert list(so) == list(ref_s) and sf == 'length'
+        events = [(e['event'], e['seq']) for e in paged.flight.dump()]
+        chunk_idx = [i for i, (k, _) in enumerate(events)
+                     if k == 'chunk']
+        assert len(chunk_idx) == 7, events    # ceil(100/16) chunk calls
+        # Decode dispatched between chunk calls (interleave, not
+        # monopoly): some dispatch falls strictly inside the chunk span.
+        assert any(events[i][0] == 'dispatch'
+                   for i in range(chunk_idx[0], chunk_idx[-1])), events
+        # Chunk progress is cumulative token counts, ending at the
+        # full prompt.
+        seqs = [events[i][1] for i in chunk_idx]
+        assert seqs == sorted(seqs) and seqs[-1] == len(long_p)
+
+    def test_cancel_mid_chunked_prefill_releases_pages(self, paged):
+        free0 = paged.alloc.free_count
+
+        async def main():
+            paged._queue = asyncio.Queue(maxsize=engine_lib.MAX_QUEUE)
+            task = asyncio.get_running_loop().create_task(
+                paged.batch_loop())
+            long_p = [(i * 11) % 250 + 1 for i in range(100)]
+            fut = paged.submit_nowait(long_p, 8, 0.0, None, None)
+            for _ in range(400):
+                await asyncio.sleep(0.005)
+                if paged._pending_chunks():
+                    break
+            assert paged._pending_chunks(), 'prefill never started'
+            paged.cancel(fut)
+            out, finish, _, _ = await fut
+            assert finish == 'stop' and out == []
+            # Pages return at the publish right after the cancel lands.
+            for _ in range(400):
+                await asyncio.sleep(0.005)
+                if paged.alloc.free_count == free0:
+                    break
+            task.cancel()
+            return paged.alloc.free_count
+
+        loop = asyncio.new_event_loop()
+        try:
+            free_after = loop.run_until_complete(main())
+        finally:
+            loop.close()
+        assert free_after == free0
+
+
+class TestPageLifecycle:
+
+    def test_warmup_leaks_no_pages(self, paged):
+        assert paged.alloc is not None
+        # The module fixtures already served traffic; build the
+        # invariant from counts: everything not held by the prefix
+        # store is free.
+        held = sum(len(v) for v in paged._prefix_store.values())
+        assert paged.alloc.used_count == held
+
+    def test_pages_freed_at_finish_while_pool_still_busy(self, paged):
+        """A short request's pages free while a longer one still
+        decodes — finish releases memory, not reap/reuse."""
+        async def main():
+            paged._queue = asyncio.Queue(maxsize=engine_lib.MAX_QUEUE)
+            task = asyncio.get_running_loop().create_task(
+                paged.batch_loop())
+            f_long = paged.submit_nowait([8] * 8, 48, 0.0, None, None)
+            f_short = paged.submit_nowait([6] * 8, 2, 0.0, None, None)
+            await f_short
+            used_at_short_done = None
+            for _ in range(400):
+                await asyncio.sleep(0.005)
+                if not f_long.done():
+                    live = [s for s in paged.slots if s is not None]
+                    if len(live) == 1:
+                        used_at_short_done = paged.alloc.used_count
+                        break
+            await f_long
+            task.cancel()
+            return used_at_short_done
+
+        loop = asyncio.new_event_loop()
+        try:
+            used = loop.run_until_complete(main())
+        finally:
+            loop.close()
+        held = sum(len(v) for v in paged._prefix_store.values())
+        # While the long request still ran, only ITS pages (plus any
+        # store refs) were held — the short one's came back already.
+        long_need = paged._pages_needed(([8] * 8, 48, 0, None, None))
+        assert used is not None
+        assert used <= long_need + held
+
+
+class TestPageGatedAdmission:
+
+    def test_oversubscribed_pool_waits_then_serves_fifo(self, tight):
+        """More concurrent requests than the pool holds: some wait on
+        pages (the wait outcome counts them), nobody fails, and every
+        output matches its solo reference — memory pressure degrades
+        latency, never correctness."""
+        from skypilot_tpu.observe import metrics as metrics_lib
+        jobs = [([i + 1] * 8, 8, 0.0, None, None) for i in range(8)]
+        results = _serve(tight, jobs)
+        for (tokens, *_), (out, finish, _, _) in zip(jobs, results):
+            ref = np.asarray(decode.generate(
+                tight.params, jnp.asarray([tokens], jnp.int32),
+                tight.cfg, 8, max_len=tight.max_len)[0])
+            assert list(out) == list(ref)
+            assert finish == 'length'
+        assert not tight._hold                  # nothing stranded
+        held = sum(len(v) for v in tight._prefix_store.values())
+        assert tight.alloc.used_count == held   # all pages returned
+        text = metrics_lib.render()
+        waits = [line for line in text.splitlines()
+                 if line.startswith('skytpu_engine_kv_page_alloc_total'
+                                    '{outcome="wait"}')]
+        assert waits and float(waits[0].rsplit(' ', 1)[1]) >= 1, (
+            '8×2 pages vs an 11-page pool must have made someone wait')
+
+
+class TestPrefixPageSharing:
+
+    def test_hit_shares_pages_and_eviction_returns_them(self, paged):
+        pfx = [(i * 3) % 250 + 1 for i in range(70)]
+        _serve(paged, [(pfx + [101, 102, 103], 4, 0.0, None, None)])
+        key = tuple(pfx[:64])
+        assert key in paged._prefix_store
+        pids = paged._prefix_store[key]
+        assert pids and all(isinstance(p, int) for p in pids)
+        assert all(paged.alloc.refcount(p) >= 1 for p in pids)
+        hits0 = paged.prefix_hits
+        free0 = paged.alloc.free_count
+        (out, _, _, _), = _serve(
+            paged, [(pfx + [104, 105], 4, 0.0, None, None)])
+        assert paged.prefix_hits == hits0 + 1
+        assert len(out) == 4
+        # The hit borrowed the shared pages and returned its own; the
+        # shared ones are still exactly where they were.
+        assert paged.alloc.free_count == free0
+        assert all(paged.alloc.refcount(p) >= 1 for p in pids)
+        # Eviction (store clear) drops the refs and frees the pages.
+        paged._clear_prefix_store()
+        assert all(paged.alloc.refcount(p) == 0 for p in pids)
+
+    def test_hit_output_matches_contiguous_engine(self, paged,
+                                                  contiguous):
+        pfx = [(i * 5) % 250 + 1 for i in range(66)]
+        jobs = [(pfx + [7, 8, 9], 6, 0.0, None, None)]
+        _serve(paged, jobs)          # seed the snapshot
+        _serve(contiguous, jobs)
+        a = _serve(paged, jobs)      # paged: shared-page hit
+        b = _serve(contiguous, jobs)  # contiguous: snapshot-copy hit
+        assert list(a[0][0]) == list(b[0][0])
+
+
+class TestPagedMetricsExposure:
+
+    def test_gauges_counters_and_wait_histogram_at_metrics(self, paged):
+        async def fn(client):
+            r = await client.post('/generate', json={
+                'tokens': [2, 4, 6, 8], 'max_new_tokens': 4})
+            assert r.status == 200
+            rm = await client.get('/metrics')
+            assert rm.status == 200
+            return await rm.text()
+
+        text = _with_client(paged, fn)
+        for needle in (
+                'skytpu_engine_kv_pages_free',
+                'skytpu_engine_kv_pages_used',
+                'skytpu_engine_kv_page_alloc_total{outcome="ok"}',
+                'skytpu_engine_kv_page_alloc_total{outcome="wait"}',
+                'skytpu_engine_admission_wait_seconds_bucket',
+                'skytpu_engine_admission_wait_seconds_count',
+        ):
+            assert needle in text, needle
+        # The gauges are sampled at scrape and must agree with the
+        # allocator (idle pool: used == store-held refs).
+        vals = {}
+        for line in text.splitlines():
+            for g in ('skytpu_engine_kv_pages_free',
+                      'skytpu_engine_kv_pages_used'):
+                if line.startswith(g + ' '):
+                    vals[g] = float(line.rsplit(' ', 1)[1])
+        assert vals['skytpu_engine_kv_pages_free'] == \
+            paged.alloc.free_count
+        assert vals['skytpu_engine_kv_pages_used'] == \
+            paged.alloc.used_count

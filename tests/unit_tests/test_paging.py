@@ -1,0 +1,233 @@
+"""Block-paged KV cache: the host-side free-list allocator's safety
+properties (models/paging.py).
+
+The allocator is the engine's memory-safety keystone: a double-free
+would hand one page to two requests (silent KV corruption), a leak
+would shrink the pool until admission starves, and multi-host
+followers must draw IDENTICAL page ids replaying the leader's op
+stream. Property-tested against a reference dict model over random
+admit/finish/cancel/share schedules.
+"""
+import collections
+import random
+
+import pytest
+
+from skypilot_tpu.models import paging
+
+
+class TestAllocatorBasics:
+
+    def test_pool_seeded_without_trash_page(self):
+        a = paging.PageAllocator(8)
+        assert a.free_count == 7            # page 0 reserved
+        got = a.alloc(7)
+        assert sorted(got) == list(range(1, 8))
+        assert paging.TRASH_PAGE not in got
+
+    def test_too_small_pool_refused(self):
+        with pytest.raises(ValueError):
+            paging.PageAllocator(1)
+
+    def test_alloc_beyond_free_raises_and_changes_nothing(self):
+        a = paging.PageAllocator(4)
+        a.alloc(2)
+        with pytest.raises(paging.PagesExhausted):
+            a.alloc(2)
+        assert a.free_count == 1
+        assert a.can_fit(1) and not a.can_fit(2)
+
+    def test_double_free_raises(self):
+        a = paging.PageAllocator(4)
+        (pid,) = a.alloc(1)
+        a.unref(pid)
+        with pytest.raises(ValueError):
+            a.unref(pid)
+
+    def test_unref_of_never_allocated_raises(self):
+        a = paging.PageAllocator(4)
+        with pytest.raises(ValueError):
+            a.unref(2)
+
+    def test_ref_of_unallocated_raises(self):
+        a = paging.PageAllocator(4)
+        with pytest.raises(ValueError):
+            a.ref(1)
+
+    def test_refcount_sharing(self):
+        """A shared prefix page frees only when its LAST holder unrefs
+        (store entry + every admitted sharer hold one ref each)."""
+        a = paging.PageAllocator(4)
+        (pid,) = a.alloc(1)
+        a.ref(pid)                          # prefix-store snapshot
+        a.ref(pid)                          # a second sharer
+        a.unref(pid)
+        a.unref(pid)
+        assert a.free_count == 2            # still held
+        assert a.refcount(pid) == 1
+        a.unref(pid)
+        assert a.free_count == 3
+        assert a.refcount(pid) == 0
+
+    def test_fifo_order_is_deterministic(self):
+        """Two allocators replaying the same alloc/free sequence draw
+        identical ids in identical order — the multi-host lockstep
+        contract (followers mirror the leader's op stream)."""
+        seq = []
+        rng = random.Random(7)
+        a, b = paging.PageAllocator(16), paging.PageAllocator(16)
+        live_a, live_b = [], []
+        for _ in range(200):
+            if live_a and rng.random() < 0.45:
+                i = rng.randrange(len(live_a))
+                a.unref_all(live_a.pop(i))
+                b.unref_all(live_b.pop(i))
+            else:
+                n = rng.randint(1, 3)
+                if not a.can_fit(n):
+                    continue
+                ga, gb = a.alloc(n), b.alloc(n)
+                assert ga == gb
+                seq.append(ga)
+                live_a.append(ga)
+                live_b.append(gb)
+            assert a.fingerprint() == b.fingerprint()
+        assert seq, 'schedule exercised nothing'
+
+    def test_take_claims_exact_ids_and_refuses_unfree(self):
+        a = paging.PageAllocator(8)
+        a.take([3, 5])
+        assert a.refcount(3) == 1 and a.refcount(5) == 1
+        with pytest.raises(paging.PagesExhausted):
+            a.take([5])                     # already taken
+        with pytest.raises(ValueError):
+            a.take([2, 2])                  # duplicate plan
+        got = a.alloc(5)
+        assert sorted(got) == [1, 2, 4, 6, 7]
+
+    def test_fingerprint_detects_divergence(self):
+        a, b = paging.PageAllocator(8), paging.PageAllocator(8)
+        a.alloc(1)
+        assert a.fingerprint() != b.fingerprint()
+        b.alloc(1)
+        assert a.fingerprint() == b.fingerprint()
+
+
+class _RefModel:
+    """Reference model: a dict of page -> refcount plus a free set.
+    Order-free — only set/count semantics are modeled; the FIFO order
+    property is pinned separately above."""
+
+    def __init__(self, n):
+        self.free = set(range(1, n))
+        self.rc = {}
+
+    def alloc(self, pids):
+        for p in pids:
+            assert p in self.free
+            self.free.discard(p)
+            self.rc[p] = 1
+
+    def ref(self, p):
+        self.rc[p] += 1
+
+    def unref(self, p):
+        self.rc[p] -= 1
+        if self.rc[p] == 0:
+            del self.rc[p]
+            self.free.add(p)
+
+
+class TestAllocatorProperties:
+
+    @pytest.mark.parametrize('seed', [0, 1, 2, 3, 4])
+    def test_random_admit_finish_cancel_schedules(self, seed):
+        """N random schedules of admit (alloc n pages), share (ref a
+        live request's pages — the prefix-store pattern), finish/cancel
+        (unref all) against the reference model: no double-free, no
+        leak, no page simultaneously free and held, and the allocator's
+        counts always match the model's."""
+        rng = random.Random(seed)
+        n_pages = rng.choice([4, 9, 17, 33])
+        a = paging.PageAllocator(n_pages)
+        model = _RefModel(n_pages)
+        live = []                 # requests: lists of held page ids
+        snapshots = []            # prefix-store entries: ditto
+        for _ in range(500):
+            op = rng.random()
+            if op < 0.40:
+                n = rng.randint(1, 4)
+                if a.can_fit(n):
+                    got = a.alloc(n)
+                    assert len(set(got)) == n
+                    model.alloc(got)
+                    live.append(got)
+            elif op < 0.55 and live:
+                # Snapshot a live request's pages (prefix capture).
+                src = rng.choice(live)
+                take = src[:rng.randint(1, len(src))]
+                for p in take:
+                    a.ref(p)
+                    model.ref(p)
+                snapshots.append(list(take))
+            elif op < 0.85 and live:
+                done = live.pop(rng.randrange(len(live)))
+                a.unref_all(done)
+                for p in done:
+                    model.unref(p)
+            elif snapshots:
+                snap = snapshots.pop(rng.randrange(len(snapshots)))
+                a.unref_all(snap)
+                for p in snap:
+                    model.unref(p)
+            # Invariants after every step.
+            assert a.free_count == len(model.free)
+            assert a.used_count == len(model.rc)
+            for p in range(1, n_pages):
+                assert a.refcount(p) == model.rc.get(p, 0)
+        # Drain everything: the pool must come back whole (no leaks).
+        for done in live:
+            a.unref_all(done)
+        for snap in snapshots:
+            a.unref_all(snap)
+        assert a.free_count == n_pages - 1
+        assert a.used_count == 0
+
+
+class TestPageTableConsistency:
+    """The engine-facing invariant: every page id a slot's table row
+    holds is allocated (never on the free list), rows never share a
+    NON-shared page, and released rows return exactly their pages."""
+
+    @pytest.mark.parametrize('seed', [10, 11, 12])
+    def test_table_rows_mirror_allocator_state(self, seed):
+        rng = random.Random(seed)
+        n_pages, max_rows, maxp = 33, 6, 4
+        a = paging.PageAllocator(n_pages)
+        table = {}                # row -> page list
+        for _ in range(300):
+            if table and rng.random() < 0.5:
+                row = rng.choice(list(table))
+                a.unref_all(table.pop(row))
+            else:
+                free_rows = [r for r in range(max_rows) if r not in table]
+                if not free_rows:
+                    continue
+                n = rng.randint(1, maxp)
+                if not a.can_fit(n):
+                    continue
+                table[rng.choice(free_rows)] = a.alloc(n)
+            held = [p for row in table.values() for p in row]
+            # No page in two rows; none both held and free.
+            assert len(held) == len(set(held))
+            counts = collections.Counter(held)
+            for p in range(1, n_pages):
+                assert a.refcount(p) == counts.get(p, 0)
+            assert a.used_count == len(set(held))
+
+    def test_pages_for(self):
+        assert paging.pages_for(0, 64) == 0
+        assert paging.pages_for(1, 64) == 1
+        assert paging.pages_for(64, 64) == 1
+        assert paging.pages_for(65, 64) == 2
+        assert paging.pages_for(128, 16) == 8

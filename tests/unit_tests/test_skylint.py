@@ -35,9 +35,9 @@ PKG = os.path.join(REPO, 'skypilot_tpu')
 # below — the gate test fails loudly otherwise.
 EXPECTED_CHECKS = [
     'layers', 'lazy-imports', 'async-blocking', 'jit-hazards',
-    'host-sync-loop', 'sqlite-discipline', 'state-machine',
-    'thread-discipline', 'silent-except', 'metric-discipline',
-    'span-discipline',
+    'host-sync-loop', 'page-table-shape', 'sqlite-discipline',
+    'state-machine', 'thread-discipline', 'silent-except',
+    'metric-discipline', 'span-discipline',
 ]
 
 
@@ -355,6 +355,82 @@ class TestHostSyncLoopChecker:
                     print(jax.device_get(step(i)))
         ''')
         assert _run(tmp_path, checks=['host-sync-loop'])['total'] == 0
+
+
+# ------------------------------------------------------------ page tables
+
+class TestPageTableShapeChecker:
+
+    def test_static_table_params_flagged(self, tmp_path):
+        """A jit marking a page-table parameter static compiles a
+        fresh program per page assignment — both spellings
+        (static_argnames and static_argnums) are caught."""
+        _write(tmp_path, 'serve/engine.py', '''\
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=('table',))
+            def step(params, cache, table):
+                return cache
+
+            @functools.partial(jax.jit, static_argnums=(2,))
+            def verify(params, cache, page_table, fed):
+                return cache
+        ''')
+        report = _run(tmp_path, checks=['page-table-shape'])
+        assert sorted(_idents(report)) == [
+            'page-table-shape:serve/engine.py:static:step:table',
+            'page-table-shape:serve/engine.py:static:verify:page_table',
+        ]
+        assert 'data, not shape' in report['violations'][0]['message']
+
+    def test_python_page_list_at_jit_call_site_flagged(self, tmp_path):
+        """Page ids as a Python list/comprehension reaching a jitted
+        call become per-element traced scalars — the program shape then
+        depends on the page count."""
+        _write(tmp_path, 'models/paged.py', '''\
+            import jax
+
+            step_jit = jax.jit(lambda c, **kw: c)
+
+            def run(cache, plan):
+                step_jit(cache, pages=[1, 2, 3])
+                step_jit(cache, table=[p for p in plan])
+        ''')
+        report = _run(tmp_path, checks=['page-table-shape'])
+        assert sorted(_idents(report)) == [
+            'page-table-shape:models/paged.py:pylist:pages',
+            'page-table-shape:models/paged.py:pylist:table',
+        ]
+
+    def test_fixed_shape_arrays_and_other_units_ok(self, tmp_path):
+        """The sanctioned shape — jnp.asarray(..., jnp.int32) tables as
+        runtime data, static args that are NOT tables — passes; page
+        lists outside serve//models/ are out of scope."""
+        _write(tmp_path, 'serve/engine.py', '''\
+            import functools
+            import jax
+            import jax.numpy as jnp
+
+            @functools.partial(jax.jit, static_argnames=('k',))
+            def step(params, cache, table, k):
+                return cache
+
+            def run(params, cache, table_np, plan):
+                step(params, cache,
+                     table=jnp.asarray(table_np, jnp.int32), k=8)
+                # host-side bookkeeping lists never cross into the jit
+                held = [p for p in plan]
+                return held
+        ''')
+        _write(tmp_path, 'jobs/other.py', '''\
+            import jax
+            run_jit = jax.jit(lambda c, **kw: c)
+
+            def go(c):
+                run_jit(c, pages=[1, 2])   # not an engine/model unit
+        ''')
+        assert _run(tmp_path, checks=['page-table-shape'])['total'] == 0
 
 
 # ------------------------------------------------------------ async multi-hop
@@ -1083,7 +1159,7 @@ class TestLivePackage:
         with open(out_path, encoding='utf-8') as f:
             report = json.load(f)
         # Schema stability (version-bump ratchet).
-        assert report['skylint_version'] == core.REPORT_VERSION == 5
+        assert report['skylint_version'] == core.REPORT_VERSION == 6
         assert set(report) == {
             'skylint_version', 'root', 'files_scanned', 'checks',
             'violations', 'total', 'allowlisted', 'new',
