@@ -9,6 +9,7 @@ from typing import Callable, List, Optional, Sequence, Tuple
 
 from skypilot_tpu.analysis import async_blocking
 from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis import failpoint_naming
 from skypilot_tpu.analysis import host_sync_loops
 from skypilot_tpu.analysis import jit_hazards
 from skypilot_tpu.analysis import lazy_imports
@@ -20,6 +21,7 @@ from skypilot_tpu.analysis import span_discipline
 from skypilot_tpu.analysis import sqlite_discipline
 from skypilot_tpu.analysis import state_integrity
 from skypilot_tpu.analysis import thread_discipline
+from skypilot_tpu.analysis import timeout_discipline
 
 CheckerFn = Callable[[core.ModuleInfo], List[core.Violation]]
 
@@ -36,6 +38,8 @@ ALL: List[Tuple[str, CheckerFn]] = [
     (silent_except.NAME, silent_except.run),
     (metric_discipline.NAME, metric_discipline.run),
     (span_discipline.NAME, span_discipline.run),
+    (timeout_discipline.NAME, timeout_discipline.run),
+    (failpoint_naming.NAME, failpoint_naming.run),
 ]
 
 

@@ -29,8 +29,12 @@ PACKAGE = 'skypilot_tpu'
 # bodies, the decode-pipeline anti-pattern; v5: span-discipline — no
 # leaked spans.start/span, no span/journal writes in the engine's hot
 # loop bodies; v6: page-table-shape — page tables cross into jits as
-# fixed-shape int32 arrays, never static args or Python page lists).
-REPORT_VERSION = 6
+# fixed-shape int32 arrays, never static args or Python page lists;
+# v7: timeout-discipline — explicit timeouts on control-plane/serve
+# network calls, no total cap on streaming proxy paths — and
+# failpoint-naming — literal unit.site failpoint names under the
+# `if failpoints.ACTIVE:` zero-cost guard).
+REPORT_VERSION = 7
 
 
 @dataclasses.dataclass

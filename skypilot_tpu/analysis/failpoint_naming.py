@@ -1,0 +1,105 @@
+"""Failpoint-site discipline: literal names, naming contract, and the
+zero-cost guard.
+
+The failpoint plane (utils/failpoints.py, docs/ROBUSTNESS.md) rests on
+three statically-checkable contracts:
+
+  1. **Literal names** — ``failpoints.fire(<literal str>)`` only. A
+     computed name is undiscoverable: ``python -m
+     skypilot_tpu.utils.failpoints --list`` AST-scans for literals, and
+     a chaos schedule can only arm sites it can name.
+  2. **Naming contract** — lowercase ``unit.site[.subsite]``
+     (``engine.step``, ``lb.upstream_connect``); the same regex the
+     runtime enforces, caught here before anything runs.
+  3. **Zero-cost guard** — every ``fire()`` call must sit under an
+     ``if failpoints.ACTIVE:`` test. The inactive hot path must pay
+     exactly one module-attribute read; an unguarded ``fire()`` takes
+     a lock per call in production builds.
+
+Scope: the whole package except ``analysis`` (fixtures/prose) and the
+failpoints module itself.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import List
+
+from skypilot_tpu.analysis import core
+
+NAME = 'failpoint-naming'
+
+# Keep in sync with utils/failpoints.py NAME_RE (runtime enforcement).
+NAME_RE = re.compile(r'^[a-z0-9_]+(\.[a-z0-9_]+)+$')
+
+_BASES = frozenset({'failpoints', 'failpoints_lib'})
+
+
+def _is_fire(call: ast.Call) -> bool:
+    if not (isinstance(call.func, ast.Attribute) and
+            call.func.attr == 'fire'):
+        return False
+    base = call.func.value
+    return isinstance(base, ast.Name) and base.id in _BASES
+
+
+def _mentions_active(test: ast.expr) -> bool:
+    for sub in ast.walk(test):
+        if isinstance(sub, ast.Attribute) and sub.attr == 'ACTIVE':
+            if isinstance(sub.value, ast.Name) and \
+                    sub.value.id in _BASES:
+                return True
+    return False
+
+
+def run(mod: core.ModuleInfo) -> List[core.Violation]:
+    if mod.unit == 'analysis' or mod.path == 'utils/failpoints.py':
+        return []
+    out: List[core.Violation] = []
+
+    def check(call: ast.Call, guarded: bool) -> None:
+        arg = call.args[0] if call.args else None
+        literal = (arg.value if isinstance(arg, ast.Constant) and
+                   isinstance(arg.value, str) else None)
+        if literal is None:
+            out.append(core.Violation(
+                check=NAME, path=mod.path, line=call.lineno,
+                col=call.col_offset, key='dynamic-name',
+                message=(
+                    'failpoint name must be a string literal — a '
+                    'computed name is undiscoverable by --list and '
+                    'unarmable by a chaos schedule')))
+        elif not NAME_RE.match(literal):
+            out.append(core.Violation(
+                check=NAME, path=mod.path, line=call.lineno,
+                col=call.col_offset, key=literal,
+                message=(
+                    f'failpoint name {literal!r} must be lowercase '
+                    f'unit.site[.subsite] (e.g. "engine.step" — '
+                    f'docs/ROBUSTNESS.md naming contract)')))
+        if not guarded:
+            out.append(core.Violation(
+                check=NAME, path=mod.path, line=call.lineno,
+                col=call.col_offset,
+                key=f'{literal or "<dynamic>"}:unguarded',
+                message=(
+                    'fire() must sit under `if failpoints.ACTIVE:` — '
+                    'the zero-cost contract: inactive hot paths pay '
+                    'one attribute read, never the fire() lock')))
+
+    def visit(node: ast.AST, guarded: bool) -> None:
+        if isinstance(node, ast.Call) and _is_fire(node):
+            check(node, guarded)
+        if isinstance(node, ast.If):
+            body_guarded = guarded or _mentions_active(node.test)
+            visit(node.test, guarded)
+            for child in node.body:
+                visit(child, body_guarded)
+            for child in node.orelse:
+                visit(child, guarded)
+            return
+        for child in ast.iter_child_nodes(node):
+            visit(child, guarded)
+
+    visit(mod.tree, False)
+    return out

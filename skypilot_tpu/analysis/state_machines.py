@@ -72,13 +72,22 @@ SERVICE_TRANSITIONS: Dict[str, Set[str]] = {
 # are pre-removal states: the row is deleted right after, so nothing
 # may leave them except the final SHUTTING_DOWN sweep. In particular
 # FAILED -> READY is forbidden — a replica whose launch failed must be
-# REPLACED (fresh id), never resurrected in place.
+# REPLACED (fresh id), never resurrected in place. DRAINING is the
+# graceful-retirement state (scale-down, rolling-update retirement):
+# the LB stops routing, in-flight requests finish under a deadline,
+# then teardown — and it is ONE-WAY: DRAINING -> READY is forbidden
+# (a drain decision sticks; un-draining would re-route traffic onto a
+# replica the controller already promised to retire), so the only
+# exits are the teardown/loss states.
 REPLICA_TRANSITIONS: Dict[str, Set[str]] = {
     'PROVISIONING': {'STARTING', 'FAILED', 'PREEMPTED', 'SHUTTING_DOWN'},
     'STARTING': {'READY', 'NOT_READY', 'FAILED', 'PREEMPTED',
                  'SHUTTING_DOWN'},
-    'READY': {'NOT_READY', 'FAILED', 'PREEMPTED', 'SHUTTING_DOWN'},
-    'NOT_READY': {'READY', 'FAILED', 'PREEMPTED', 'SHUTTING_DOWN'},
+    'READY': {'NOT_READY', 'DRAINING', 'FAILED', 'PREEMPTED',
+              'SHUTTING_DOWN'},
+    'NOT_READY': {'READY', 'DRAINING', 'FAILED', 'PREEMPTED',
+                  'SHUTTING_DOWN'},
+    'DRAINING': {'FAILED', 'PREEMPTED', 'SHUTTING_DOWN'},
     'FAILED': {'SHUTTING_DOWN'},
     'PREEMPTED': {'SHUTTING_DOWN'},
     'SHUTTING_DOWN': set(),

@@ -1,0 +1,170 @@
+"""Timeout discipline for control-plane and serve network calls.
+
+A network call with no timeout is an unbounded hang wearing a function
+call's clothes: the LB waiting forever on a dead replica, a probe
+wedging the reconcile loop, an SDK call parking a CLI session. This
+checker makes the timeout decision EXPLICIT at every outbound call
+site in the control-plane/serve layers:
+
+  1. ``requests`` library calls (``requests.get`` /
+     ``requests_http.post`` / ...) must pass a ``timeout=`` keyword
+     (``timeout=None`` is accepted — an explicit unbounded choice is a
+     decision; a missing one is an accident).
+  2. ``urlopen(...)`` must pass ``timeout`` (keyword or the 3rd
+     positional).
+  3. ``socket.create_connection(...)`` must pass ``timeout`` (keyword
+     or the 2nd positional).
+  4. ``aiohttp.ClientSession(...)`` with no session-level ``timeout=``
+     is fine ONLY while every request made on that session
+     (``.get/.post/.request/...``) carries a per-request ``timeout=``;
+     a request with neither is flagged. Sessions are tracked across
+     the module (including ``self._session`` attributes), so the
+     reachable-timeout question is answered where the request
+     happens. ``ws_connect`` is exempt: a tunnel/websocket is
+     long-lived by design.
+  5. In the ``serve`` unit (the streaming proxy paths):
+     ``aiohttp.ClientTimeout(total=<non-None>)`` is flagged — a total
+     cap both kills legitimate long streaming responses AND detects a
+     dead replica far too slowly. Split timeouts (connect/sock_read,
+     total=None) are the sanctioned shape (docs/ROBUSTNESS.md).
+
+Scope: the units that make control-plane network calls. The compute
+plane (models/, train/, ops/) and analysis fixtures are exempt.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from skypilot_tpu.analysis import core
+
+NAME = 'timeout-discipline'
+
+UNITS = frozenset({'serve', 'server', 'client', 'jobs', 'provision',
+                   'clouds', 'backends', 'skylet'})
+
+_REQUESTS_METHODS = frozenset({'get', 'post', 'put', 'delete', 'head',
+                               'patch', 'request'})
+_SESSION_METHODS = frozenset({'get', 'post', 'put', 'delete', 'head',
+                              'patch', 'request', 'options'})
+
+
+def _has_kwarg(call: ast.Call, name: str) -> bool:
+    return any(kw.arg == name for kw in call.keywords)
+
+
+def _is_client_session_ctor(call: ast.Call) -> bool:
+    dotted = core.dotted_name(call.func) or ''
+    return dotted.split('.')[-1] == 'ClientSession'
+
+
+def _target_name(node: ast.expr) -> Optional[str]:
+    """``session`` / ``self._session`` → a stable tracking key."""
+    return core.dotted_name(node)
+
+
+def _bound_sessions(tree: ast.Module) -> 'tuple[Set[str], Set[str]]':
+    """(names bound to a ClientSession WITHOUT a timeout, names bound
+    WITH one). A name in both sets is treated as safe — one
+    timeout-carrying construction makes intent explicit."""
+    unsafe: Set[str] = set()
+    safe: Set[str] = set()
+
+    def record(target: Optional[ast.expr], call: ast.Call) -> None:
+        if target is None:
+            return
+        name = _target_name(target)
+        if name is None:
+            return
+        (safe if _has_kwarg(call, 'timeout') else unsafe).add(name)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Call) and \
+                _is_client_session_ctor(node.value):
+            for tgt in node.targets:
+                record(tgt, node.value)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if isinstance(item.context_expr, ast.Call) and \
+                        _is_client_session_ctor(item.context_expr):
+                    record(item.optional_vars, item.context_expr)
+    return unsafe - safe, safe
+
+
+def run(mod: core.ModuleInfo) -> List[core.Violation]:
+    if mod.unit not in UNITS:
+        return []
+    out: List[core.Violation] = []
+    unsafe_sessions, _ = _bound_sessions(mod.tree)
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = core.dotted_name(node.func) or ''
+        parts = dotted.split('.')
+        tail = parts[-1]
+        # 1. requests-library calls. Exact receiver names only:
+        # `requests_lib` is this repo's request-record DB module, not
+        # the HTTP library.
+        if (len(parts) >= 2 and tail in _REQUESTS_METHODS and
+                parts[-2] in ('requests', 'requests_http')):
+            if not _has_kwarg(node, 'timeout'):
+                out.append(core.Violation(
+                    check=NAME, path=mod.path, line=node.lineno,
+                    col=node.col_offset, key=f'requests.{tail}',
+                    message=(
+                        f'{dotted}() has no timeout= — a dead server '
+                        f'hangs this call forever; pass an explicit '
+                        f'timeout (timeout=None if unbounded is truly '
+                        f'intended)')))
+            continue
+        # 2. urlopen.
+        if tail == 'urlopen':
+            if not _has_kwarg(node, 'timeout') and len(node.args) < 3:
+                out.append(core.Violation(
+                    check=NAME, path=mod.path, line=node.lineno,
+                    col=node.col_offset, key='urlopen',
+                    message=('urlopen() has no timeout — probes and '
+                             'fetches against dead hosts must fail in '
+                             'bounded time')))
+            continue
+        # 3. socket.create_connection.
+        if tail == 'create_connection' and len(parts) >= 2 and \
+                parts[-2] == 'socket':
+            if not _has_kwarg(node, 'timeout') and len(node.args) < 2:
+                out.append(core.Violation(
+                    check=NAME, path=mod.path, line=node.lineno,
+                    col=node.col_offset, key='socket.create_connection',
+                    message=('socket.create_connection() has no '
+                             'timeout — an unreachable peer hangs the '
+                             'caller in connect()')))
+            continue
+        # 4. requests on a timeout-less ClientSession.
+        if (tail in _SESSION_METHODS and len(parts) >= 2 and
+                '.'.join(parts[:-1]) in unsafe_sessions):
+            if not _has_kwarg(node, 'timeout'):
+                out.append(core.Violation(
+                    check=NAME, path=mod.path, line=node.lineno,
+                    col=node.col_offset, key='client-session-request',
+                    message=(
+                        f'{dotted}() on a ClientSession constructed '
+                        f'without timeout= and no per-request '
+                        f'timeout — no reachable timeout bounds this '
+                        f'call; set one at the session or the call')))
+            continue
+        # 5. serve-unit streaming proxies: no total cap.
+        if tail == 'ClientTimeout' and mod.unit == 'serve':
+            for kw in node.keywords:
+                if kw.arg == 'total' and not (
+                        isinstance(kw.value, ast.Constant) and
+                        kw.value.value is None):
+                    out.append(core.Violation(
+                        check=NAME, path=mod.path, line=node.lineno,
+                        col=node.col_offset, key='stream-total-cap',
+                        message=(
+                            'ClientTimeout(total=...) on a serve-layer '
+                            'proxy path: a total cap kills legitimate '
+                            'long streams AND detects dead replicas '
+                            'slowly — use connect/sock_read with '
+                            'total=None (docs/ROBUSTNESS.md)')))
+    return out

@@ -24,6 +24,7 @@ from skypilot_tpu.serve import replica_managers
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve import service_spec as spec_lib
 from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
+from skypilot_tpu.utils import failpoints
 
 logger = sky_logging.init_logger('skypilot_tpu.serve.controller')
 
@@ -111,6 +112,11 @@ class ServiceController:
         self._last_observe_gc = 0.0
         while not self._stop.is_set():
             try:
+                if failpoints.ACTIVE:
+                    # Inside the try: a firing exercises the pass-level
+                    # containment below (one reconcile pass lost, loop
+                    # alive, next pass repairs).
+                    failpoints.fire('controller.reconcile')
                 self._maybe_gc_observe()
                 record = serve_state.get_service(self.name)
                 if record is None or record['status'] in (

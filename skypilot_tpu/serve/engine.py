@@ -63,6 +63,7 @@ from skypilot_tpu.observe import flight as flight_lib
 from skypilot_tpu.observe import metrics as metrics_lib
 from skypilot_tpu.observe import spans as spans_lib
 from skypilot_tpu.observe import trace as trace_lib
+from skypilot_tpu.utils import failpoints as failpoints_lib
 from skypilot_tpu.utils import timeline
 
 logger = sky_logging.init_logger(__name__)
@@ -101,6 +102,10 @@ _M_REQUESTS = metrics_lib.counter(
 _M_REJECTED = metrics_lib.counter(
     'skytpu_engine_rejected_total', 'Requests rejected with 429 '
     '(admission queue full)')
+_M_RESURRECTED = metrics_lib.counter(
+    'skytpu_engine_resurrected_total',
+    'Requests internally resubmitted after a device failure reset '
+    '(they had not sampled a token, so nothing was lost)')
 _M_PREFIX = metrics_lib.counter(
     'skytpu_engine_prefix_requests_total',
     'Prefix (system-prompt) cache lookups at admission',
@@ -253,10 +258,32 @@ KV_PAGES = int(os.environ.get('SKYTPU_ENGINE_KV_PAGES', '0'))
 # prefill call and short requests keep streaming. Power of two >= 16.
 PREFILL_CHUNK = int(os.environ.get('SKYTPU_ENGINE_PREFILL_CHUNK',
                                    '256'))
+# Request resurrection (docs/ROBUSTNESS.md): after a device-step
+# failure resets the pool, requests that never sampled a token are
+# resubmitted internally instead of failed. Each request is resurrected
+# at most this many times — a request whose ADMISSION deterministically
+# faults must eventually surface an error, not loop forever.
+RESURRECT_MAX = int(os.environ.get('SKYTPU_ENGINE_RESURRECT_MAX', '2'))
 
 
 class EngineOverloaded(Exception):
     """Admission queue full — surfaced as HTTP 429."""
+
+
+class EngineResetError(Exception):
+    """A device step/admit serving this request failed and the slot
+    pool was rebuilt (_reset_device_state). STRUCTURED and RETRIABLE:
+    the request's KV state is gone, but the engine is healthy again —
+    a client (or the serve LB) may safely resubmit. ``tokens_emitted``
+    tells a streaming client how many tokens it already received, so
+    it can decide between resume-by-truncation and full retry.
+    Surfaced as HTTP 503 with ``type: engine_reset_error`` and
+    ``retriable: true`` (docs/ROBUSTNESS.md)."""
+
+    def __init__(self, msg: str, tokens_emitted: int = 0):
+        super().__init__(msg)
+        self.tokens_emitted = tokens_emitted
+        self.retriable = True
 
 
 def parse_mesh_arg(mesh: str):
@@ -474,7 +501,17 @@ async def _submit_many(engine: InferenceEngine, prompts, max_new,
             engine.cancel(f)
             f.cancel()
         raise
-    all_res = await asyncio.gather(*futs)
+    try:
+        all_res = await asyncio.gather(*futs)
+    except EngineResetError:
+        # One sibling died in a device reset and the handler is about
+        # to return a 503 — siblings that were RESURRECTED must not
+        # keep decoding to max_tokens with no consumer.
+        for f in futs:
+            if not f.done():
+                engine.cancel(f)
+                f.cancel()
+        raise
     if headers is not None:
         _record_request_spans(engine, headers, futs)
     # usage must count EVERY generated token, including discarded
@@ -687,6 +724,11 @@ class InferenceEngine:
         self._ctrl = None
         self._seed = seed
         self._resets = 0
+        self.resurrected_total = 0
+        # id(fut) -> times this request was internally resubmitted
+        # after a failure reset (bounded by RESURRECT_MAX; entries
+        # cleared when the request resolves).
+        self._resurrect_counts: Dict[int, int] = {}
         self._pending_cancels: List[Any] = []
         # Flight recorder (observe/flight.py): the hot loop's only
         # telemetry — dispatch/collect/admit/finish events as
@@ -1779,6 +1821,7 @@ class InferenceEngine:
         entry = {'fut': fut, 'want': max_new, 'out': [], 'lps': [],
                  'tops': [], 'stop': stop, 'stream': stream_q, 'sent': 0,
                  'finish': None, 'want_tops': bool(want_tops),
+                 'item': item,
                  'ctx': list(tokens) + [first],
                  't_submit_ns': meta[0] if meta else None,
                  't_submit_wall': meta[1] if meta else None,
@@ -1814,6 +1857,12 @@ class InferenceEngine:
         assert not self._inflight, \
             'admit while a step is in flight (collect must precede ' \
             'slot reuse)'
+        # self.warm gate on every engine fault site: warmup drives the
+        # same admit/step/collect methods synchronously with NO
+        # containment wrapper — an env-armed chaos schedule must hit
+        # serving traffic, not kill the boot.
+        if failpoints_lib.ACTIVE and self.warm:
+            failpoints_lib.fire('engine.admit')
         t_admit = time.perf_counter()
         # Prefill-start anchor for every request this call admits
         # (including the prefix-hit path below): _finish_admit folds it
@@ -1953,6 +2002,8 @@ class InferenceEngine:
         on followers via the ('chunkstart', item, fp) op."""
         assert not self._inflight, \
             'chunk start while a step is in flight'
+        if failpoints_lib.ACTIVE and self.warm:
+            failpoints_lib.fire('engine.admit')
         (tokens, max_new, temperature, top_k, top_p, pres, freq,
          stop_ids, want_tops, stream_q, fut) = item
         slot = self._free_slot()
@@ -2270,6 +2321,8 @@ class InferenceEngine:
         masked out of `active` at dispatch, so a stopped/cancelled/
         length-capped row stops burning decode FLOPs immediately
         instead of at the next reap."""
+        if failpoints_lib.ACTIVE and self.warm:
+            failpoints_lib.fire('engine.step')
         t0 = time.perf_counter()
         jnp = self._jnp
         self._refresh_table()
@@ -2312,6 +2365,8 @@ class InferenceEngine:
         import jax
         import numpy as np
         assert self._inflight, 'collect with no step in flight'
+        if failpoints_lib.ACTIVE and self.warm:
+            failpoints_lib.fire('engine.collect')
         h = self._inflight.pop(0)
         t0 = time.perf_counter()
         t_sync = time.perf_counter()
@@ -2418,6 +2473,8 @@ class InferenceEngine:
                 if fut is not None and not fut.done():
                     fut.set_result((s['out'], s['finish'], s['lps'],
                                     s['tops']))
+                if fut is not None:
+                    self._resurrect_counts.pop(id(fut), None)
                 self.slots[i] = None
                 # Paged mode: the row's pages return to the free list
                 # NOW (publish directly follows every collect and is
@@ -2500,6 +2557,10 @@ class InferenceEngine:
         for it in held:
             if it[-1] is not None and it[-1].done():
                 self._hold_waited.discard(id(it))
+                # Dropping the item is where its resurrection budget
+                # dies too — a stale id(fut) entry could otherwise be
+                # inherited by a later future reusing the id.
+                self._resurrect_counts.pop(id(it[-1]), None)
                 continue          # cancelled while waiting
             if len(items) < free_slots and fits(it):
                 self._hold_waited.discard(id(it))
@@ -2510,6 +2571,7 @@ class InferenceEngine:
                not self._queue.empty()):
             it = self._queue.get_nowait()
             if it[-1] is not None and it[-1].done():
+                self._resurrect_counts.pop(id(it[-1]), None)
                 continue          # cancelled while queued
             if fits(it):
                 items.append(it)
@@ -2672,9 +2734,25 @@ class InferenceEngine:
         await asyncio.to_thread(self._collect_step)
 
     def _fail_all(self, e: Exception, extra=None) -> None:
-        """Fail every in-flight request and rebuild the device state: the
-        failed jit call was donated the cache buffer, so the whole pool is
-        unusable (see _reset_device_state)."""
+        """Contain a device step/admit failure (the failed jit call was
+        donated the cache buffer, so the whole pool must be rebuilt —
+        see _reset_device_state) with the smallest blast radius:
+
+          * rows that already FINISHED (result complete, publish just
+            had not run yet) resolve normally — the failure happened
+            after their last token;
+          * requests that never SAMPLED a token (admit-group items the
+            failure interrupted, rows still mid-chunked-prefill) are
+            RESURRECTED: resubmitted internally at the front of the
+            hold queue, at most RESURRECT_MAX times each;
+          * only rows with tokens already emitted — whose KV state the
+            reset destroys mid-generation — surface an error, and it
+            is a STRUCTURED, RETRIABLE EngineResetError carrying
+            tokens_emitted (docs/ROBUSTNESS.md).
+
+        Items still sitting in self._queue / self._hold are untouched:
+        they never reached the device and admit against the rebuilt
+        pool."""
         logger.warning(f'Engine step/admit failed; resetting slot pool: '
                        f'{e}')
         # Followers hit the same failure executing the same op; this
@@ -2682,21 +2760,100 @@ class InferenceEngine:
         # (no-op on followers — their _ctrl is None).
         self._bcast(('reset',))
 
-        def fail(fut, stream_q):
+        def reset_error(n_emitted: int) -> EngineResetError:
+            err = EngineResetError(
+                f'engine reset after device failure '
+                f'({type(e).__name__}: {e}); request state lost',
+                tokens_emitted=n_emitted)
+            err.__cause__ = e
+            return err
+
+        def fail(fut, stream_q, n_emitted: int) -> None:
             if stream_q is not None:
                 stream_q.put_nowait(None)
             if fut is not None and not fut.done():
-                fut.set_exception(e)
+                fut.set_exception(reset_error(n_emitted))
+            if fut is not None:
+                self._resurrect_counts.pop(id(fut), None)
 
+        def try_resurrect(item) -> bool:
+            fut = item[-1]
+            if fut is None or fut.done():
+                return False
+            count = self._resurrect_counts.get(id(fut), 0)
+            if count >= RESURRECT_MAX:
+                return False
+            self._resurrect_counts[id(fut)] = count + 1
+            resurrected.append(item)
+            return True
+
+        resurrected: List[tuple] = []
+        handled = set()          # id(fut) the slot loop dispositioned
+        for i, s in enumerate(self.slots):
+            if s is None:
+                continue
+            fut, stream_q = s['fut'], s['stream']
+            if fut is not None:
+                handled.add(id(fut))
+            if s['finish'] is not None:
+                # The row completed BEFORE the failure — deliver its
+                # result; undelivered tokens ride the stream first.
+                if stream_q is not None:
+                    for j in range(s['sent'], len(s['out'])):
+                        stream_q.put_nowait(
+                            (s['out'][j], s['lps'][j], s['tops'][j]))
+                    stream_q.put_nowait(None)
+                self._finish_timing(i, s)
+                if fut is not None and not fut.done():
+                    fut.set_result((s['out'], s['finish'], s['lps'],
+                                    s['tops']))
+                if fut is not None:
+                    self._resurrect_counts.pop(id(fut), None)
+                continue
+            emitted = len(s['out'])
+            item = s.get('item') or (s.get('prefill') or {}).get('item')
+            if emitted == 0 and s['sent'] == 0 and item is not None \
+                    and try_resurrect(item):
+                continue
+            fail(fut, stream_q, emitted)
         if extra is not None:
-            # One pending item, or a whole admit group.
+            # One pending item, or a whole admit group: none of these
+            # sampled a token (the failure interrupted their admission),
+            # so they resurrect — the pre-fix behavior failed the whole
+            # group with the device exception even though only the
+            # device call was poisoned.
             items = extra if isinstance(extra, list) else [extra]
             for item in items:
-                fail(item[-1], item[-2])
-        for s in self.slots:
-            if s is not None:
-                fail(s['fut'], s['stream'])
-        self._reset_device_state(reason=f'{type(e).__name__}: {e}')
+                fut = item[-1]
+                if fut is not None and id(fut) in handled:
+                    continue     # partially admitted: slot loop owns it
+                if try_resurrect(item):
+                    continue
+                fail(fut, item[-2], 0)
+        try:
+            self._reset_device_state(reason=f'{type(e).__name__}: {e}')
+        except BaseException:
+            # The rebuild ITSELF failed: the engine cannot serve.
+            # The set-aside requests must not hang on futures nobody
+            # will ever resolve — fail them before the error
+            # propagates (the pre-resurrection code failed everything
+            # up front and so never had this window).
+            for item in resurrected:
+                fail(item[-1], item[-2], 0)
+            resurrected.clear()
+            raise
+        if resurrected:
+            # Front of the hold queue, original admission order:
+            # resurrected requests are older than anything held or
+            # queued, and FIFO admission must stay fair.
+            self._hold[:0] = resurrected
+            self.resurrected_total += len(resurrected)
+            _M_RESURRECTED.inc(len(resurrected))
+            logger.info(f'Resurrected {len(resurrected)} request(s) '
+                        f'that had not sampled a token; '
+                        f'{len(self._hold)} held for re-admission.')
+        while len(self._resurrect_counts) > 4096:
+            self._resurrect_counts.pop(next(iter(self._resurrect_counts)))
 
 
 # ---------------------------------------------------------------------------
@@ -2707,6 +2864,17 @@ def _openai_error(web, msg: str, status: int = 400,
                   err_type: str = 'invalid_request_error'):
     return web.json_response(
         {'error': {'message': msg, 'type': err_type}}, status=status)
+
+
+def _reset_error_response(web, e: EngineResetError):
+    """EngineResetError → structured 503: the engine recovered (the
+    pool was rebuilt) but this request's state was lost — retriable,
+    and the client learns how many tokens it already received."""
+    return web.json_response(
+        {'error': {'message': str(e), 'type': 'engine_reset_error',
+                   'retriable': True,
+                   'tokens_emitted': e.tokens_emitted}},
+        status=503, headers={'Retry-After': '1'})
 
 
 def _resolve_prompts(engine: InferenceEngine, prompt) -> List[List[int]]:
@@ -2907,11 +3075,19 @@ async def _sse_response(request, engine: InferenceEngine,
         await resp.write(b'data: [DONE]\n\n')
     except Exception as e:  # pylint: disable=broad-except
         # Mid-stream failure: the status line already went out; the only
-        # honest signal left is an error event + connection close.
+        # honest signal left is an error event + connection close. An
+        # EngineResetError stays STRUCTURED here too — the client
+        # learns the failure is retriable and how many tokens of this
+        # stream it already holds (emitted chars track the stream; the
+        # error carries the engine-side token count).
         logger.warning(f'SSE stream aborted: {e}')
+        payload = {'error': {'message': str(e), 'type': 'server_error'}}
+        if isinstance(e, EngineResetError):
+            payload = {'error': {
+                'message': str(e), 'type': 'engine_reset_error',
+                'retriable': True, 'tokens_emitted': e.tokens_emitted}}
         try:
-            await send({'error': {'message': str(e),
-                                  'type': 'server_error'}})
+            await send(payload)
         except ConnectionError:
             pass
     finally:
@@ -3012,6 +3188,8 @@ def build_app(engine: InferenceEngine):
             out, finish, lps, _tops = await fut
         except EngineOverloaded as e:
             return web.json_response({'error': str(e)}, status=429)
+        except EngineResetError as e:
+            return _reset_error_response(web, e)
         _record_request_spans(engine, request.headers, [fut])
         resp: Dict[str, Any] = {'tokens': out, 'finish_reason': finish,
                                 'logprobs': lps}
@@ -3104,6 +3282,8 @@ def build_app(engine: InferenceEngine):
         except EngineOverloaded as e:
             return _openai_error(web, str(e), status=429,
                                  err_type='overloaded_error')
+        except EngineResetError as e:
+            return _reset_error_response(web, e)
         choices = []
         for idx, (out, finish, lps, tops) in enumerate(results):
             text = engine.tokenizer.decode(out)
@@ -3224,6 +3404,8 @@ def build_app(engine: InferenceEngine):
         except EngineOverloaded as e:
             return _openai_error(web, str(e), status=429,
                                  err_type='overloaded_error')
+        except EngineResetError as e:
+            return _reset_error_response(web, e)
         choices = []
         for idx, (out, finish, lps, tops) in enumerate(results):
             text = engine.tokenizer.decode(out)

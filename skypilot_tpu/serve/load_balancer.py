@@ -5,6 +5,26 @@ Reference analog: sky/serve/load_balancer.py (FastAPI proxy). aiohttp here
 process (serve/controller.py) and is told the ready-replica set after every
 reconcile pass; it feeds request timestamps to the autoscaler.
 
+Failure containment (docs/ROBUSTNESS.md):
+  - Split upstream timeouts: a CONNECT timeout detects a dead replica in
+    seconds, a SOCK_READ (between-bytes) timeout catches a stalled or
+    slow-loris upstream — and there is NO total cap, so a legitimate
+    long streaming response is never killed at an arbitrary wall-clock
+    mark (the old ``ClientTimeout(total=300)`` did both wrong).
+  - Per-replica CIRCUIT BREAKER: closed → open after
+    ``SKYTPU_LB_BREAKER_THRESHOLD`` consecutive upstream failures
+    (traffic reroutes around it) → half-open after
+    ``SKYTPU_LB_BREAKER_COOLDOWN`` seconds (exactly ONE probe request)
+    → closed on success. Transitions are journaled (``lb_breaker``
+    events) and counted per state in ``skytpu_lb_breaker_state``.
+  - Bounded RETRY of idempotent-safe attempts: a request whose response
+    has not started streaming to the client (connect failure, upstream
+    disconnect before headers, read timeout before headers, breaker
+    open) is retried with backoff on a different replica, up to
+    ``SKYTPU_LB_RETRIES`` times (``skytpu_lb_retries_total{reason}``).
+    Once response bytes have reached the client, a failure truncates —
+    never silently rewrites — the stream.
+
 Control endpoints live under /-/lb/ (anything else is proxied verbatim):
   GET /-/lb/health  → {ready_replicas: N}
   GET /-/lb/metrics → Prometheus exposition (per-policy request
@@ -25,7 +45,7 @@ import os
 import random
 import time
 import typing
-from typing import List, Optional
+from typing import Dict, List, Optional, Tuple
 
 import aiohttp
 from aiohttp import web
@@ -36,6 +56,8 @@ from skypilot_tpu.observe import metrics as metrics_lib
 from skypilot_tpu.observe import spans as spans_lib
 from skypilot_tpu.observe import trace as trace_lib
 from skypilot_tpu.serve import load_balancing_policies as lb_policies
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import failpoints as failpoints_lib
 from skypilot_tpu.utils import registry
 
 if typing.TYPE_CHECKING:
@@ -44,8 +66,10 @@ if typing.TYPE_CHECKING:
 logger = sky_logging.init_logger(__name__)
 
 # Label bounds: policies come from the static registry (populated by
-# the lb_policies import above), outcomes are this closed set.
-_OUTCOMES = ('proxied', 'upstream_error', 'no_replica')
+# the lb_policies import above), outcomes/reasons/states are these
+# closed sets.
+_OUTCOMES = ('proxied', 'upstream_error', 'no_replica', 'breaker_open',
+             'client_abort')
 _LB_REQUESTS = metrics_lib.counter(
     'skytpu_lb_requests_total',
     'Load-balanced requests by policy and outcome.',
@@ -55,10 +79,43 @@ _LB_LATENCY = metrics_lib.histogram(
     'skytpu_lb_request_seconds',
     'End-to-end proxy latency (body read to upstream EOF).',
     labels={'policy': tuple(registry.LB_POLICY_REGISTRY.keys())})
+_RETRY_REASONS = ('connect_error', 'disconnected', 'timeout',
+                  'breaker_open')
+_LB_RETRIES = metrics_lib.counter(
+    'skytpu_lb_retries_total',
+    'Upstream attempts retried on another replica, by the failure '
+    'reason that caused the retry (idempotent-safe attempts only: no '
+    'response bytes had reached the client).',
+    labels={'reason': _RETRY_REASONS})
+_BREAKER_STATES = ('closed', 'open', 'half_open')
+_LB_BREAKER_STATE = metrics_lib.gauge(
+    'skytpu_lb_breaker_state',
+    'Replicas currently in each circuit-breaker state. Per-replica '
+    'detail rides the journal lb_breaker events (replica URLs are '
+    'unbounded; metric label sets must stay declared and finite).',
+    labels={'state': _BREAKER_STATES})
 
 _HOP_HEADERS = {'connection', 'keep-alive', 'transfer-encoding', 'upgrade',
                 'proxy-authenticate', 'proxy-authorization', 'te',
                 'trailers', 'host', 'content-length'}
+
+
+class _ClientAborted(Exception):
+    """Internal sentinel: the CLIENT side of the proxy (the downstream
+    response transport) failed — prepare/write raised. Distinct from
+    upstream failures by construction so a user closing their laptop
+    can never count against a healthy replica's circuit breaker."""
+
+
+async def _downstream(coro):
+    """Await a client-side (downstream) response operation, converting
+    its connection failures into the _ClientAborted sentinel.
+    ConnectionError ⊂ OSError covers the transport-reset shapes aiohttp
+    raises from prepare/write on a dead client connection."""
+    try:
+        return await coro
+    except OSError as e:
+        raise _ClientAborted() from e
 
 
 # Affinity keys truncate to a SHORT FIXED head: two prompts sharing at
@@ -101,6 +158,71 @@ def _affinity_key(request: web.Request, body: bytes) -> Optional[str]:
     return None
 
 
+class CircuitBreaker:
+    """One replica's breaker. All methods run on the LB's event loop —
+    no locking. ``routable`` is a PURE check; ``begin_attempt`` is the
+    mutating half that consumes the half-open probe token, so scanning
+    candidates never burns probes."""
+
+    def __init__(self, threshold: int, cooldown: float):
+        self.threshold = threshold
+        self.cooldown = cooldown
+        self.state = 'closed'
+        self.consecutive = 0
+        self._opened_at = 0.0
+        self._probing = False
+
+    def routable(self, now: float) -> bool:
+        if self.state == 'closed':
+            return True
+        if self.state == 'open':
+            return now - self._opened_at >= self.cooldown
+        return not self._probing            # half_open: one probe only
+
+    def begin_attempt(self, now: float) -> Optional[Tuple[str, str]]:
+        """Mark an attempt started; returns the (old, new) transition
+        when the open→half_open edge fires."""
+        edge = None
+        if self.state == 'open' and \
+                now - self._opened_at >= self.cooldown:
+            edge = ('open', 'half_open')
+            self.state = 'half_open'
+            self._probing = False
+        if self.state == 'half_open':
+            self._probing = True
+        return edge
+
+    def abort_attempt(self) -> None:
+        """Release the half-open probe token without judging the
+        replica (client abort / handler cancellation mid-attempt):
+        half-open allows exactly ONE probe, so leaking the token here
+        would wedge the breaker half-open — and the replica out of
+        routing — forever."""
+        self._probing = False
+
+    def record_success(self) -> Optional[Tuple[str, str]]:
+        old = self.state
+        self.state = 'closed'
+        self.consecutive = 0
+        self._probing = False
+        return (old, 'closed') if old != 'closed' else None
+
+    def record_failure(self, now: float) -> Optional[Tuple[str, str]]:
+        old = self.state
+        self.consecutive += 1
+        self._probing = False
+        if old == 'half_open' or (old == 'closed' and
+                                  self.consecutive >= self.threshold):
+            self.state = 'open'
+            self._opened_at = now
+            return (old, 'open')
+        if old == 'open':
+            # A failure while open (raced in before the breaker saw the
+            # last one) re-arms the cooldown.
+            self._opened_at = now
+        return None
+
+
 class LoadBalancer:
 
     def __init__(self, policy_name: str,
@@ -128,9 +250,96 @@ class LoadBalancer:
         except ValueError:
             self._span_sample = 1.0
         self._session: Optional[aiohttp.ClientSession] = None
+        # Upstream timeout shape (docs/ROBUSTNESS.md): connect bounds
+        # dead-replica detection, sock_read bounds the gap BETWEEN
+        # bytes (slow-loris / stalled upstream), and total stays None
+        # so long legitimate streams are never killed mid-flight.
+        self._connect_timeout = common_utils.env_float('SKYTPU_LB_CONNECT_TIMEOUT',
+                                           10.0)
+        self._read_timeout = common_utils.env_float('SKYTPU_LB_READ_TIMEOUT', 120.0)
+        # Bounded retry of idempotent-safe attempts + per-replica
+        # breakers.
+        self._retries = max(0, common_utils.env_int('SKYTPU_LB_RETRIES', 2))
+        self._retry_backoff = max(0.0, common_utils.env_float(
+            'SKYTPU_LB_RETRY_BACKOFF', 0.05))
+        self._breaker_threshold = max(1, common_utils.env_int(
+            'SKYTPU_LB_BREAKER_THRESHOLD', 3))
+        self._breaker_cooldown = max(0.0, common_utils.env_float(
+            'SKYTPU_LB_BREAKER_COOLDOWN', 5.0))
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._ready: List[str] = []
+        self._fallback_rr = 0
 
     def set_ready_replicas(self, urls: List[str]) -> None:
+        """Called from the controller's reconcile THREAD: only swaps
+        references. The breaker dict is event-loop-owned — entries are
+        created lazily by _breaker() and pruned by
+        _refresh_breaker_gauge(), both of which only run on the LB's
+        loop, so no cross-thread dict mutation races a loop-side
+        iteration."""
+        self._ready = list(urls)
         self.policy.set_ready_replicas(urls)
+
+    # ------------------------------------------------------- breakers
+    def _breaker(self, url: str) -> CircuitBreaker:
+        breaker = self._breakers.get(url)
+        if breaker is None:
+            breaker = CircuitBreaker(self._breaker_threshold,
+                                     self._breaker_cooldown)
+            self._breakers[url] = breaker
+        return breaker
+
+    def _refresh_breaker_gauge(self) -> None:
+        """Event-loop only. Also the pruning point for breakers whose
+        replicas left the ready set (drained, replaced, scaled down) —
+        pruning here instead of in set_ready_replicas keeps every
+        mutation of the dict on the loop."""
+        ready = set(self._ready)
+        for url in [u for u in self._breakers if u not in ready]:
+            del self._breakers[url]
+        counts = {s: 0 for s in _BREAKER_STATES}
+        for breaker in self._breakers.values():
+            counts[breaker.state] += 1
+        # Ready replicas that never needed a breaker entry are closed.
+        counts['closed'] += len(ready - set(self._breakers))
+        for state, n in counts.items():
+            _LB_BREAKER_STATE.set(n, state=state)
+
+    def _breaker_edge(self, url: str,
+                      edge: Optional[Tuple[str, str]]) -> None:
+        """Publish a breaker transition: journal event (the per-replica
+        record the bounded-label gauge cannot carry) + gauge refresh."""
+        if edge is None:
+            return
+        old, new = edge
+        logger.warning(f'Breaker for {url}: {old} -> {new}.')
+        journal_lib.record_event(
+            'lb_breaker', entity=self.service_name,
+            reason=f'{old}->{new}', data={'replica': url})
+        self._refresh_breaker_gauge()
+
+    def _record_upstream_failure(self, url: str, now: float) -> None:
+        self._breaker_edge(url, self._breaker(url).record_failure(now))
+
+    def _record_upstream_success(self, url: str) -> None:
+        self._breaker_edge(url, self._breaker(url).record_success())
+
+    def _pick(self, key: Optional[str], excluded: set,
+              now: float) -> Optional[str]:
+        """The policy's choice when it is routable (breaker allows, not
+        already tried this request); otherwise any routable replica by
+        rotation. None when nothing is routable right now."""
+        choice = self.policy.select(key)
+        if (choice is not None and choice not in excluded and
+                self._breaker(choice).routable(now)):
+            return choice
+        candidates = [u for u in self._ready
+                      if u not in excluded and
+                      self._breaker(u).routable(now)]
+        if not candidates:
+            return None
+        self._fallback_rr = (self._fallback_rr + 1) % len(candidates)
+        return candidates[self._fallback_rr]
 
     # ------------------------------------------------------------------
     async def _proxy(self, request: web.Request) -> web.StreamResponse:
@@ -173,6 +382,25 @@ class LoadBalancer:
                                     ) as root:
                     return await self._proxy_traced(request, root)
 
+    @staticmethod
+    def _classify(err: BaseException) -> str:
+        """Failure reason for retry accounting — one of _RETRY_REASONS
+        (breaker_open is assigned at the pick, not here)."""
+        if isinstance(err, failpoints_lib.FailpointError):
+            return ('disconnected' if 'read' in err.failpoint
+                    else 'connect_error')
+        if isinstance(err, (aiohttp.ServerTimeoutError,
+                            asyncio.TimeoutError)):
+            return 'timeout'
+        if isinstance(err, aiohttp.ClientConnectorError):
+            return 'connect_error'
+        if isinstance(err, (aiohttp.ServerDisconnectedError,
+                            aiohttp.ClientPayloadError)):
+            return 'disconnected'
+        if isinstance(err, OSError):
+            return 'connect_error'
+        return 'disconnected'
+
     async def _proxy_traced(self, request: web.Request,
                             root: 'spans_lib.Span') -> web.StreamResponse:
         if not self.policy.has_replicas():
@@ -185,24 +413,18 @@ class LoadBalancer:
                 {'error': 'no ready replicas'}, status=503)
         t0 = time.monotonic()
         body = await request.read()
-        with spans_lib.span('lb.pick', entity=self.service_name) as pick:
+        with spans_lib.span('lb.pick', entity=self.service_name):
             # Key extraction (a JSON parse) only when the policy uses
-            # it.
+            # it; the replica actually chosen is recorded per attempt
+            # on the lb.upstream span (retries may reroute).
             key = (_affinity_key(request, body)
                    if self.policy.wants_affinity_key else None)
-            target = self.policy.select(key)
-            if target is not None:
-                pick.set_attr('replica', target)
-        if target is None:
-            _LB_REQUESTS.inc(policy=self.policy_name,
-                             outcome='no_replica')
-            root.set_attr('outcome', 'no_replica')
-            return web.json_response(
-                {'error': 'no ready replicas'}, status=503)
         if self._session is None:
             self._session = aiohttp.ClientSession(
-                timeout=aiohttp.ClientTimeout(total=300))
-        url = target.rstrip('/') + request.rel_url.path_qs
+                timeout=aiohttp.ClientTimeout(
+                    total=None, connect=self._connect_timeout,
+                    sock_connect=self._connect_timeout,
+                    sock_read=self._read_timeout))
         # Strip any client-supplied X-Skytpu-* before stamping our own:
         # forwarding them would DUPLICATE the headers (dict stamping
         # can't replace a differently-cased client key), and the
@@ -213,48 +435,180 @@ class LoadBalancer:
         headers = {k: v for k, v in request.headers.items()
                    if k.lower() not in _HOP_HEADERS
                    and not k.lower().startswith('x-skytpu-')}
-        self.policy.request_started(target)
         try:
-            with spans_lib.span('lb.upstream', entity=self.service_name,
-                                attrs={'replica': target}) as up_span:
-                if not spans_lib.suppressed():
-                    headers['X-Skytpu-Trace-Id'] = up_span.trace_id or ''
-                    headers['X-Skytpu-Parent-Span'] = up_span.span_id
-                    # The engine stamps this entity on its request
-                    # spans so they fall inside /-/lb/trace/<id>'s
-                    # entity scope.
-                    if self.service_name:
-                        headers['X-Skytpu-Entity'] = self.service_name
-                async with self._session.request(request.method, url,
-                                                 headers=headers,
-                                                 data=body) as upstream:
-                    up_span.set_attr('status', upstream.status)
-                    resp = web.StreamResponse(status=upstream.status)
-                    for k, v in upstream.headers.items():
-                        if k.lower() not in _HOP_HEADERS:
-                            resp.headers[k] = v
-                    await resp.prepare(request)
-                    # Stream the body through: LLM replies are long and
-                    # incremental (SSE/chunked) — never buffer them
-                    # whole.
-                    async for chunk in upstream.content.iter_chunked(
-                            16384):
-                        await resp.write(chunk)
-                    await resp.write_eof()
-                    _LB_REQUESTS.inc(policy=self.policy_name,
-                                     outcome='proxied')
-                    root.set_attr('outcome', 'proxied')
-                    return resp
-        except (aiohttp.ClientError, asyncio.TimeoutError) as e:
-            _LB_REQUESTS.inc(policy=self.policy_name,
-                             outcome='upstream_error')
-            root.set_attr('outcome', 'upstream_error')
-            return web.json_response(
-                {'error': f'upstream {target} failed: {e}'}, status=502)
+            return await self._proxy_attempts(request, root, key,
+                                              body, headers)
         finally:
-            self.policy.request_finished(target)
             _LB_LATENCY.observe(time.monotonic() - t0,
                                 policy=self.policy_name)
+
+    async def _proxy_attempts(self, request: web.Request,
+                              root: 'spans_lib.Span',
+                              key: Optional[str], body: bytes,
+                              headers: Dict[str, str]
+                              ) -> web.StreamResponse:
+        """The bounded attempt loop: pick (breaker-aware) → proxy →
+        on an idempotent-safe failure (no response bytes sent to the
+        client yet) reroute with backoff. A failure after streaming
+        started truncates the stream — the only honest option left."""
+        tried: set = set()
+        last_err: Optional[BaseException] = None
+        attempts = self._retries + 1
+        for attempt in range(attempts):
+            now = time.monotonic()
+            target = self._pick(key, tried, now)
+            if target is None and tried:
+                # Every untried replica is breaker-blocked; widen to
+                # the tried set before giving up (a flapping replica
+                # may still beat a 502).
+                tried = set()
+                target = self._pick(key, tried, now)
+            if target is None:
+                if attempt + 1 < attempts:
+                    # Nothing routable RIGHT NOW (breakers open): wait
+                    # out the backoff — a cooldown may elapse or the
+                    # reconcile loop may deliver a fresh replica.
+                    _LB_RETRIES.inc(reason='breaker_open')
+                    await asyncio.sleep(
+                        self._retry_backoff * (2 ** attempt))
+                    continue
+                _LB_REQUESTS.inc(policy=self.policy_name,
+                                 outcome='breaker_open')
+                root.set_attr('outcome', 'breaker_open')
+                return web.json_response(
+                    {'error': 'all replicas unavailable (circuit '
+                              'breakers open); retry shortly',
+                     'retriable': True}, status=503,
+                    headers={'Retry-After': '1'})
+            tried.add(target)
+            breaker = self._breaker(target)
+            self._breaker_edge(target, breaker.begin_attempt(now))
+            self.policy.request_started(target)
+            url = target.rstrip('/') + request.rel_url.path_qs
+            resp: Optional[web.StreamResponse] = None
+            # Every exit of the try below must disposition the breaker
+            # (success, failure, or abort) — `judged` tracks it, and
+            # the finally releases the half-open probe token for ANY
+            # unanticipated exception type, or the breaker would wedge
+            # half-open and the replica never route again.
+            judged = False
+            try:
+                with spans_lib.span('lb.upstream',
+                                    entity=self.service_name,
+                                    attrs={'replica': target,
+                                           'attempt': attempt}) as up:
+                    if not spans_lib.suppressed():
+                        headers['X-Skytpu-Trace-Id'] = up.trace_id or ''
+                        headers['X-Skytpu-Parent-Span'] = up.span_id
+                        # The engine stamps this entity on its request
+                        # spans so they fall inside /-/lb/trace/<id>'s
+                        # entity scope.
+                        if self.service_name:
+                            headers['X-Skytpu-Entity'] = self.service_name
+                    if failpoints_lib.ACTIVE:
+                        failpoints_lib.fire('lb.upstream_connect')
+                    async with self._session.request(
+                            request.method, url, headers=headers,
+                            data=body) as upstream:
+                        up.set_attr('status', upstream.status)
+                        resp = web.StreamResponse(status=upstream.status)
+                        for k, v in upstream.headers.items():
+                            if k.lower() not in _HOP_HEADERS:
+                                resp.headers[k] = v
+                        await _downstream(resp.prepare(request))
+                        # Stream the body through: LLM replies are long
+                        # and incremental (SSE/chunked) — never buffer
+                        # them whole. Upstream reads and client writes
+                        # are wrapped SEPARATELY: a failure reading the
+                        # replica is an upstream fault (breaker,
+                        # retry/truncate); a failure writing to the
+                        # client is a client abort (neither).
+                        while True:
+                            if failpoints_lib.ACTIVE:
+                                failpoints_lib.fire('lb.upstream_read')
+                            chunk = await upstream.content.readany()
+                            if not chunk:
+                                break
+                            await _downstream(resp.write(chunk))
+                        await _downstream(resp.write_eof())
+                        self._record_upstream_success(target)
+                        judged = True
+                        _LB_REQUESTS.inc(policy=self.policy_name,
+                                         outcome='proxied')
+                        root.set_attr('outcome', 'proxied')
+                        return resp
+            except asyncio.CancelledError:
+                # aiohttp CANCELS the handler task when the client
+                # drops the connection — same disposition as
+                # _ClientAborted below (count it, never blame the
+                # replica), but cancellation must RE-RAISE. The probe
+                # token releases in the finally (judged stays False).
+                _LB_REQUESTS.inc(policy=self.policy_name,
+                                 outcome='client_abort')
+                root.set_attr('outcome', 'client_abort')
+                raise
+            except _ClientAborted as e:
+                # The CLIENT went away mid-proxy: nothing to retry,
+                # nobody left to answer — and the replica did nothing
+                # wrong, so its breaker must not move (the finally
+                # releases the probe token). The upstream read (still
+                # streaming a reply nobody wants) is torn down by
+                # leaving the `async with` block.
+                logger.debug(f'Client aborted while proxying to '
+                             f'{target}: {e.__cause__}')
+                _LB_REQUESTS.inc(policy=self.policy_name,
+                                 outcome='client_abort')
+                root.set_attr('outcome', 'client_abort')
+                if resp is not None and resp.prepared:
+                    resp.force_close()
+                    return resp
+                return web.Response(status=499)   # nobody will see it
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
+                    failpoints_lib.FailpointError) as e:
+                last_err = e
+                self._record_upstream_failure(target, time.monotonic())
+                judged = True
+                if resp is not None and resp.prepared:
+                    # Response bytes already reached the client: not
+                    # idempotent-safe — truncate the stream instead of
+                    # silently retrying into a duplicated reply. The
+                    # transport is closed DIRECTLY: merely returning
+                    # the response would let aiohttp write the chunked
+                    # terminator, making the truncated body look like
+                    # a well-formed complete reply.
+                    logger.warning(f'Upstream {target} failed '
+                                   f'mid-stream: {e}')
+                    resp.force_close()
+                    if request.transport is not None:
+                        request.transport.close()
+                    _LB_REQUESTS.inc(policy=self.policy_name,
+                                     outcome='upstream_error')
+                    root.set_attr('outcome', 'upstream_error')
+                    return resp
+                reason = self._classify(e)
+                if attempt + 1 < attempts:
+                    logger.info(f'Upstream {target} failed before '
+                                f'response start ({reason}: {e}); '
+                                f'retrying on another replica.')
+                    _LB_RETRIES.inc(reason=reason)
+                    await asyncio.sleep(
+                        self._retry_backoff * (2 ** attempt))
+                    continue
+            finally:
+                if not judged:
+                    # Any exit that neither blamed nor credited the
+                    # replica (client abort, cancellation, an
+                    # unanticipated exception type): release the
+                    # half-open probe token so the breaker can't wedge.
+                    breaker.abort_attempt()
+                self.policy.request_finished(target)
+        _LB_REQUESTS.inc(policy=self.policy_name,
+                         outcome='upstream_error')
+        root.set_attr('outcome', 'upstream_error')
+        return web.json_response(
+            {'error': f'upstream failed after {attempts} attempt(s): '
+                      f'{last_err}',
+             'retriable': True}, status=502)
 
     async def _health(self, request: web.Request) -> web.Response:
         del request
@@ -266,6 +620,7 @@ class LoadBalancer:
         latency histograms, autoscaler gauges, replica-probe outcome
         counters — one scrape target per service."""
         del request
+        self._refresh_breaker_gauge()
         return web.Response(text=metrics_lib.render(),
                             content_type='text/plain')
 

@@ -56,6 +56,7 @@ import time
 from typing import Any, Optional, Tuple
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.utils import failpoints as failpoints_lib
 
 logger = sky_logging.init_logger(__name__)
 
@@ -214,8 +215,15 @@ class ControlLeader:
         the serve batch loop — in sendall indefinitely."""
         for conn in self._conns:
             try:
+                if failpoints_lib.ACTIVE:
+                    # Simulates a dead/wedged follower socket (delay
+                    # mode models a slow one). FailpointError is caught
+                    # below alongside OSError so an env-armed firing
+                    # takes the SAME fail-the-replica path a real
+                    # socket error does.
+                    failpoints_lib.fire('multihost.send')
                 _send_msg(conn, op)
-            except OSError as e:
+            except (OSError, failpoints_lib.FailpointError) as e:
                 logger.error(f'control follower lost or wedged ({e}); '
                              f'failing the replica so the gang '
                              f'restarts.')
@@ -243,6 +251,12 @@ class ControlFollower:
         self._sock.settimeout(None)
 
     def recv(self) -> Tuple:
+        if failpoints_lib.ACTIVE:
+            # A firing here models a torn/poisoned control channel —
+            # follower_serve catches FailpointError next to
+            # ConnectionError, so an env-armed firing takes the same
+            # leader-gone exit path a real torn channel does.
+            failpoints_lib.fire('multihost.recv')
         return _recv_msg(self._sock)
 
 
@@ -263,7 +277,7 @@ def follower_serve(engine, coordinator: str) -> None:
     while True:
         try:
             op = chan.recv()
-        except ConnectionError:
+        except (ConnectionError, failpoints_lib.FailpointError):
             logger.info('leader gone; follower exiting.')
             return
         kind = op[0]

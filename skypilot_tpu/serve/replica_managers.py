@@ -14,6 +14,7 @@ tests possible on one machine).
 """
 from __future__ import annotations
 
+import json
 import os
 import threading
 import typing
@@ -26,10 +27,13 @@ from skypilot_tpu import global_state
 from skypilot_tpu import provision
 from skypilot_tpu import sky_logging
 from skypilot_tpu.backends import slice_backend
+from skypilot_tpu.observe import journal as journal_lib
 from skypilot_tpu.observe import metrics as metrics_lib
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve import service_spec as spec_lib
 from skypilot_tpu.serve import spot_placer as spot_placer_lib
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import failpoints
 from skypilot_tpu.utils import vclock
 from skypilot_tpu.serve.serve_state import ReplicaStatus
 
@@ -54,6 +58,24 @@ _PROBE_METRIC = metrics_lib.counter(
     'skytpu_serve_probe_total',
     'Replica probe / liveness classing outcomes per reconcile pass.',
     labels={'outcome': _PROBE_OUTCOMES})
+
+# Graceful drain (docs/ROBUSTNESS.md): a retiring replica stops taking
+# traffic (DRAINING — excluded from ready_urls), finishes its in-flight
+# requests, then tears down. Observed once per drain, at teardown.
+_DRAIN_SECONDS = metrics_lib.histogram(
+    'skytpu_serve_drain_seconds',
+    'Wall-clock from drain start to teardown eligibility (in-flight '
+    'drained, deadline hit, or cluster lost).')
+
+# Default in-flight-completion deadline for a draining replica.
+DRAIN_DEADLINE_SECONDS = 120.0
+
+
+def _drain_deadline_seconds() -> float:
+    """Env-tunable (read at call time — the controller is a detached
+    process, and tests tighten this to keep drain scenarios fast)."""
+    return common_utils.env_float('SKYTPU_SERVE_DRAIN_SECONDS',
+                                  DRAIN_DEADLINE_SECONDS)
 
 
 def _replacement_cap(target: int) -> int:
@@ -94,10 +116,16 @@ def _boot_patience_seconds(probe: 'spec_lib.ReadinessProbe') -> float:
 
 def probe_url(url: str, path: str, timeout: float) -> bool:
     try:
+        if failpoints.ACTIVE:
+            # A firing is classed as a probe miss (the except below):
+            # deterministic probe-failure injection for the
+            # replacement / NOT_READY paths without killing a replica.
+            failpoints.fire('serve.probe')
         with urlrequest.urlopen(url.rstrip('/') + path,
                                 timeout=timeout) as resp:
             return 200 <= resp.status < 400
-    except (urlerror.URLError, OSError, ValueError):
+    except (urlerror.URLError, OSError, ValueError,
+            failpoints.FailpointError):
         return False
 
 
@@ -130,6 +158,10 @@ class ReplicaManager:
         # versions; blue_green pins traffic to the old set until the new
         # one can carry the full target.
         self.active_versions = {version}
+        # replica_id -> drain start time. In-memory: a controller
+        # restart restarts the deadline clock (reconcile re-stamps a
+        # DRAINING row it has no record of), never un-drains.
+        self._drain_started: Dict[int, float] = {}
         # (task, spec, version) before the in-flight update, kept so a
         # rollout whose new version can never pass probes can roll BACK
         # instead of failing the still-serving service.
@@ -339,6 +371,99 @@ class ReplicaManager:
             self.terminate_replica(rep['replica_id'])
 
     # ------------------------------------------------------------------
+    # Graceful drain
+    # ------------------------------------------------------------------
+    def drain_replica(self, replica_id: int) -> bool:
+        """Begin graceful retirement: the guarded DRAINING transition
+        pulls the replica out of ready_urls() (the LB stops routing at
+        the next reconcile sync), then reconcile tears it down once its
+        in-flight requests finish — or the deadline hits. Falls back to
+        immediate termination when the transition is refused (the
+        replica is not READY/NOT_READY, so there is no accepted traffic
+        to protect). Returns True when a drain actually started."""
+        if not serve_state.set_replica_status(
+                self.service_name, replica_id, ReplicaStatus.DRAINING):
+            self.terminate_replica(replica_id)
+            return False
+        self._drain_started[replica_id] = vclock.now()
+        journal_lib.record_event(
+            'drain_start', machine='replica',
+            entity=f'{self.service_name}/{replica_id}')
+        logger.info(f'Replica {replica_id} of {self.service_name} '
+                    f'DRAINING (deadline '
+                    f'{_drain_deadline_seconds():.0f}s).')
+        return True
+
+    def _replica_idle(self, rep: dict) -> Optional[bool]:
+        """Does the draining replica report zero in-flight work? The
+        engine's /health carries queue_depth + in_flight. None =
+        couldn't tell (unreachable / non-engine app) — the deadline
+        then decides."""
+        url = rep.get('url')
+        if not url:
+            return True
+        probe = self.spec.readiness_probe
+        try:
+            with urlrequest.urlopen(url.rstrip('/') + '/health',
+                                    timeout=probe.timeout_seconds) as r:
+                doc = json.loads(r.read().decode())
+        except (urlerror.URLError, OSError, ValueError):
+            return None
+        if not isinstance(doc, dict) or 'in_flight' not in doc:
+            # App without drain telemetry: nothing to wait on beyond
+            # the reconcile pass that already pulled it from the LB —
+            # holding it for the full deadline buys nothing.
+            return True
+        try:
+            return (int(doc.get('in_flight', 0)) == 0 and
+                    int(doc.get('queue_depth', 0)) == 0)
+        except (TypeError, ValueError):
+            return None
+
+    def _reconcile_draining(self, rep: dict, now: float) -> None:
+        """One reconcile pass over a DRAINING replica: tear it down
+        when its in-flight work is done, the drain deadline passes, or
+        the cluster is gone (preempted mid-drain) — otherwise leave it
+        finishing. Draining replicas never count toward the target, so
+        replacements scale up while they finish."""
+        rid = rep['replica_id']
+        started = self._drain_started.setdefault(rid, now)
+        deadline = _drain_deadline_seconds()
+        idle = self._replica_idle(rep)
+        if idle is True:
+            reason = 'complete'
+        elif now - started >= deadline:
+            reason = 'deadline'
+        elif self._cluster_gone(rid):
+            reason = 'lost'
+        else:
+            return
+        elapsed = max(0.0, now - started)
+        _DRAIN_SECONDS.observe(elapsed)
+        journal_lib.record_event(
+            'drain_finish', machine='replica',
+            entity=f'{self.service_name}/{rid}', reason=reason,
+            data={'seconds': round(elapsed, 3)})
+        logger.info(f'Replica {rid} drain finished ({reason}, '
+                    f'{elapsed:.1f}s) — tearing down.')
+        self._drain_started.pop(rid, None)
+        self.terminate_replica(rid)
+
+    def _retire_replica(self, rep: dict) -> None:
+        """Retirement entry point for scale-down and updates: replicas
+        that may hold accepted traffic DRAIN (kill-mid-stream loses
+        requests) — that includes NOT_READY, whose probe blip does not
+        evict in-flight generations and whose DRAINING edge the state
+        machine declares; everything else (pool workers — no HTTP
+        drain signal — and pre-serving replicas) tears down
+        immediately."""
+        if not self.spec.pool and rep['status'] in (
+                ReplicaStatus.READY, ReplicaStatus.NOT_READY):
+            self.drain_replica(rep['replica_id'])
+        else:
+            self.terminate_replica(rep['replica_id'])
+
+    # ------------------------------------------------------------------
     # Probe / reconcile
     # ------------------------------------------------------------------
     def _cluster_gone(self, replica_id: int) -> bool:
@@ -412,6 +537,12 @@ class ReplicaManager:
                 _PROBE_METRIC.inc(outcome='launch_failed')
                 self.terminate_replica(rid, ReplicaStatus.FAILED)
                 self._probe_failure_streak += 1
+                continue
+            if status is ReplicaStatus.DRAINING:
+                # Not counted toward target: a drain IS the retirement
+                # decision, and its replacement (if any) must be free
+                # to scale up while in-flight requests finish.
+                self._reconcile_draining(rep, now)
                 continue
             if self._cluster_gone(rid):
                 logger.info(f'Replica {rid} lost (preemption/teardown) — '
@@ -545,7 +676,7 @@ class ReplicaManager:
                                -r['replica_id']))
             for rep in order[:len(alive) - target]:
                 logger.info(f'Scaling down replica {rep["replica_id"]}.')
-                self.terminate_replica(rep['replica_id'])
+                self._retire_replica(rep)
 
     def _reconcile_update(self, alive: List[dict], stale: List[dict],
                           target: int) -> None:
@@ -573,7 +704,7 @@ class ReplicaManager:
                     logger.info(f'blue_green cutover: retiring v'
                                 f'{rep.get("version") or 1} replica '
                                 f'{rep["replica_id"]}.')
-                    self.terminate_replica(rep['replica_id'])
+                    self._retire_replica(rep)
                 self.active_versions = {self.version}
             return
         # rolling: the invariant is READY count never drops below target —
@@ -586,7 +717,7 @@ class ReplicaManager:
             logger.info(f'rolling update: replica {oldest["replica_id"]} '
                         f'(v{oldest.get("version") or 1}) retired in '
                         f'favor of a v{self.version} replica.')
-            self.terminate_replica(oldest['replica_id'])
+            self._retire_replica(oldest)
             alive = [r for r in alive if r is not oldest]
         if len(alive) < target + 1 and len(fresh) < target:
             self.scale_up(1)   # surge one new-version replica

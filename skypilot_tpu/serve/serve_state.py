@@ -45,11 +45,15 @@ class ReplicaStatus(enum.Enum):
     STARTING = 'STARTING'            # cluster up, app not ready yet
     READY = 'READY'
     NOT_READY = 'NOT_READY'          # probe failing; grace period
+    DRAINING = 'DRAINING'            # no new traffic; in-flight finishes
     FAILED = 'FAILED'
     PREEMPTED = 'PREEMPTED'
     SHUTTING_DOWN = 'SHUTTING_DOWN'
 
     def is_serving(self) -> bool:
+        # DRAINING is deliberately NOT serving: the LB stops routing
+        # to a draining replica the moment the transition commits —
+        # that is what lets its in-flight requests finish.
         return self is ReplicaStatus.READY
 
     def colored_str(self) -> str:

@@ -54,6 +54,37 @@ def get_usage_run_id() -> str:
     return str(uuid.uuid4())
 
 
+def env_float(name: str, default: float) -> float:
+    """Float knob from the environment: missing/empty → default;
+    malformed → default with a warning (a typo'd knob must not
+    silently change runtime semantics)."""
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        import logging
+        logging.getLogger(__name__).warning(
+            'Ignoring malformed %s=%r (want a number).', name, raw)
+        return default
+
+
+def env_int(name: str, default: int) -> int:
+    """Integer knob from the environment (same contract as
+    env_float)."""
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        import logging
+        logging.getLogger(__name__).warning(
+            'Ignoring malformed %s=%r (want an integer).', name, raw)
+        return default
+
+
 def base36(n: int) -> str:
     chars = '0123456789abcdefghijklmnopqrstuvwxyz'
     if n == 0:

@@ -1,0 +1,366 @@
+"""Deterministic failpoint plane: named fault sites, armed on demand.
+
+The serving/control planes must *survive* infrastructure failure — and
+"survive" is only testable if failures can be forced deterministically.
+A failpoint is a named site in the code (``engine.step``,
+``lb.upstream_connect``, ``sqlite.commit`` ...) where a fault can be
+injected: an exception raised, or a delay slept. Sites are compiled
+down to a single module-attribute truth test when nothing is armed —
+hot paths pay one ``if failpoints.ACTIVE:`` and nothing else — so the
+plane ships enabled in production builds at zero cost.
+
+Call-site contract (enforced by the skylint ``failpoint-naming``
+checker: literal ``unit.site[.subsite]`` lowercase names only)::
+
+    from skypilot_tpu.utils import failpoints
+    ...
+    if failpoints.ACTIVE:
+        failpoints.fire('engine.step')
+
+Arming — environment (parsed once at import)::
+
+    SKYTPU_FAILPOINTS='engine.step=once;lb.upstream_read=every:3'
+    SKYTPU_FAILPOINTS='serve.probe=prob:0.5,seed:7;sqlite.commit=delay:0.2'
+
+Spec grammar: ``site=term[,term...]`` with terms
+  ``once``        fire exactly once, then disarm
+  ``every:N``     fire on every Nth hit (N >= 1)
+  ``prob:P``      fire with probability P per hit — SEEDED (see below)
+  ``seed:S``      RNG seed for ``prob`` (default 0; per-site stream, so
+                  runs are bit-reproducible regardless of interleaving)
+  ``delay:S``     a firing SLEEPS S seconds instead of raising
+  ``max:N``       fire at most N times total, then disarm
+
+or programmatically (tests)::
+
+    failpoints.arm('engine.step', once=True)
+    failpoints.arm('engine.step', every=3, exc=lambda n: OSError(n))
+    with failpoints.armed('serve.probe', prob=0.5, seed=7):
+        ...
+
+A firing raises :class:`FailpointError` (``.failpoint`` carries the
+site name) unless the armed spec says ``delay`` (sleep) or supplies a
+custom exception factory. Discoverability: every site in the package
+is listed — without importing any heavy module — by::
+
+    python -m skypilot_tpu.utils.failpoints --list
+
+which AST-scans the installed package for ``fire('...')`` literals
+(the same scan tests/chaos pins, so an undiscoverable or misnamed
+site fails tier-1). See docs/ROBUSTNESS.md for the site catalog.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import random
+import re
+import threading
+import time
+from typing import Callable, Dict, Iterator, List, Optional
+
+# One attribute read on the hot path. False ⟺ no site armed; flips
+# under _LOCK only. Reads are racy-by-design (a site armed mid-step
+# takes effect at the next check) — that is fine for fault injection.
+ACTIVE: bool = False
+
+NAME_RE = re.compile(r'^[a-z0-9_]+(\.[a-z0-9_]+)+$')
+
+ENV_VAR = 'SKYTPU_FAILPOINTS'
+
+_LOCK = threading.Lock()
+
+
+class FailpointError(RuntimeError):
+    """The default injected fault. ``failpoint`` names the fired site,
+    so recovery paths (and tests) can tell an injected fault from an
+    organic one."""
+
+    def __init__(self, failpoint: str):
+        super().__init__(f'failpoint {failpoint!r} fired')
+        self.failpoint = failpoint
+
+
+class _Spec:
+    """One armed site: mode + deterministic per-site RNG + counters."""
+
+    __slots__ = ('name', 'every', 'prob', 'rng', 'delay', 'max_fires',
+                 'exc', 'hits', 'fires')
+
+    def __init__(self, name: str, *, once: bool = False,
+                 every: Optional[int] = None, prob: Optional[float] = None,
+                 seed: int = 0, delay: Optional[float] = None,
+                 max_fires: Optional[int] = None,
+                 exc: Optional[Callable[[str], BaseException]] = None):
+        if not NAME_RE.match(name):
+            raise ValueError(
+                f'failpoint name {name!r} must be lowercase '
+                f'unit.site[.subsite] (e.g. "engine.step")')
+        if once:
+            if max_fires is not None and max_fires != 1:
+                raise ValueError('once conflicts with max')
+            max_fires = 1
+        if every is not None and prob is not None:
+            raise ValueError(f'{name}: every and prob are exclusive')
+        if every is not None and every < 1:
+            raise ValueError(f'{name}: every must be >= 1')
+        if prob is not None and not 0.0 <= prob <= 1.0:
+            raise ValueError(f'{name}: prob must be in [0, 1]')
+        if delay is not None and delay < 0:
+            raise ValueError(f'{name}: delay must be >= 0')
+        self.name = name
+        self.every = every
+        self.prob = prob
+        # Per-site stream: two probabilistic sites never perturb each
+        # other's draws, so a seeded run reproduces exactly even when
+        # thread interleaving differs.
+        self.rng = random.Random(seed) if prob is not None else None
+        self.delay = delay
+        self.max_fires = max_fires
+        self.exc = exc
+        self.hits = 0
+        self.fires = 0
+
+    def should_fire(self) -> bool:
+        self.hits += 1
+        if self.max_fires is not None and self.fires >= self.max_fires:
+            return False
+        if self.prob is not None:
+            return self.rng.random() < self.prob
+        if self.every is not None:
+            return self.hits % self.every == 0
+        return True
+
+
+_ARMED: Dict[str, _Spec] = {}
+
+
+def _recompute_active() -> None:
+    global ACTIVE
+    ACTIVE = bool(_ARMED)
+
+
+def arm(name: str, *, once: bool = False, every: Optional[int] = None,
+        prob: Optional[float] = None, seed: int = 0,
+        delay: Optional[float] = None, max_fires: Optional[int] = None,
+        exc: Optional[Callable[[str], BaseException]] = None) -> None:
+    """Arm (or re-arm, resetting counters) one failpoint site."""
+    spec = _Spec(name, once=once, every=every, prob=prob, seed=seed,
+                 delay=delay, max_fires=max_fires, exc=exc)
+    with _LOCK:
+        _ARMED[name] = spec
+        _recompute_active()
+
+
+def disarm(name: str) -> None:
+    with _LOCK:
+        _ARMED.pop(name, None)
+        _recompute_active()
+
+
+def reset() -> None:
+    """Disarm everything (test teardown)."""
+    with _LOCK:
+        _ARMED.clear()
+        _recompute_active()
+
+
+@contextlib.contextmanager
+def armed(name: str, **kwargs) -> Iterator[None]:
+    """Scoped arm for tests: restores the site's previous state."""
+    with _LOCK:
+        prev = _ARMED.get(name)
+    arm(name, **kwargs)
+    try:
+        yield
+    finally:
+        with _LOCK:
+            if prev is None:
+                _ARMED.pop(name, None)
+            else:
+                _ARMED[name] = prev
+            _recompute_active()
+
+
+def fire(name: str) -> None:
+    """The instrumented site. Call ONLY under ``if failpoints.ACTIVE:``
+    — this function is deliberately not cheap (a lock, counters); the
+    attribute guard is what keeps inactive hot paths free."""
+    with _LOCK:
+        spec = _ARMED.get(name)
+        if spec is None:
+            return
+        if not spec.should_fire():
+            return
+        spec.fires += 1
+        if spec.max_fires is not None and spec.fires >= spec.max_fires:
+            _ARMED.pop(name, None)
+            _recompute_active()
+        delay = spec.delay
+        exc = spec.exc
+    # Outside the lock: a sleeping delay site must not serialize every
+    # other site, and a custom factory may do arbitrary work.
+    if delay is not None:
+        time.sleep(delay)
+        return
+    raise (exc(name) if exc is not None else FailpointError(name))
+
+
+def hits(name: str) -> int:
+    """Times the armed site was evaluated (0 if not currently armed)."""
+    with _LOCK:
+        spec = _ARMED.get(name)
+        return spec.hits if spec is not None else 0
+
+
+def fires(name: str) -> int:
+    with _LOCK:
+        spec = _ARMED.get(name)
+        return spec.fires if spec is not None else 0
+
+
+def state() -> Dict[str, Dict[str, object]]:
+    """Armed-site snapshot (debug endpoints, tests)."""
+    with _LOCK:
+        return {n: {'every': s.every, 'prob': s.prob, 'delay': s.delay,
+                    'max_fires': s.max_fires, 'hits': s.hits,
+                    'fires': s.fires}
+                for n, s in _ARMED.items()}
+
+
+# ------------------------------------------------------------- env parse
+
+def parse_spec(text: str) -> Dict[str, Dict[str, object]]:
+    """``site=term,...;site=...`` → {site: arm() kwargs}. Raises
+    ValueError on malformed input — a typo'd chaos schedule must fail
+    loudly, not silently inject nothing."""
+    out: Dict[str, Dict[str, object]] = {}
+    for part in text.split(';'):
+        part = part.strip()
+        if not part:
+            continue
+        if '=' not in part:
+            raise ValueError(f'failpoint spec {part!r}: want site=mode')
+        site, _, spec = part.partition('=')
+        site = site.strip()
+        kwargs: Dict[str, object] = {}
+        for term in spec.split(','):
+            term = term.strip()
+            if not term:
+                continue
+            key, _, val = term.partition(':')
+            try:
+                if key == 'once' and not val:
+                    kwargs['once'] = True
+                elif key == 'every':
+                    kwargs['every'] = int(val)
+                elif key == 'prob':
+                    kwargs['prob'] = float(val)
+                elif key == 'seed':
+                    kwargs['seed'] = int(val)
+                elif key == 'delay':
+                    kwargs['delay'] = float(val)
+                elif key == 'max':
+                    kwargs['max_fires'] = int(val)
+                else:
+                    raise ValueError(f'unknown term {term!r}')
+            except (TypeError, ValueError) as e:
+                raise ValueError(
+                    f'failpoint spec {part!r}: {e}') from None
+        if not kwargs:
+            raise ValueError(f'failpoint spec {part!r}: empty mode')
+        out[site] = kwargs
+    return out
+
+
+def load_env() -> None:
+    """Arm sites from ``SKYTPU_FAILPOINTS`` (idempotent; re-arms with
+    fresh counters). Called at import and by server entrypoints so a
+    chaos schedule set in the environment reaches detached processes."""
+    text = os.environ.get(ENV_VAR, '')
+    if not text:
+        return
+    for site, kwargs in parse_spec(text).items():
+        arm(site, **kwargs)
+
+
+load_env()
+
+
+# ------------------------------------------------------------- discovery
+
+def scan_sites(root: Optional[str] = None) -> List[Dict[str, object]]:
+    """AST-scan the package for ``fire('<literal>')`` call sites —
+    no imports, so listing works without jax or a server. Returns
+    [{name, path, line}] sorted by name; malformed names (non-literal
+    arguments are the skylint checker's job) still appear so the CLI
+    can flag them."""
+    import ast
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sites: List[Dict[str, object]] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames
+                             if d != '__pycache__' and
+                             not d.startswith('.'))
+        for fname in sorted(filenames):
+            if not fname.endswith('.py'):
+                continue
+            path = os.path.join(dirpath, fname)
+            rel = os.path.relpath(path, root).replace(os.sep, '/')
+            if rel == 'utils/failpoints.py':
+                continue
+            try:
+                with open(path, 'r', encoding='utf-8') as f:
+                    tree = ast.parse(f.read(), filename=rel)
+            except SyntaxError:
+                continue
+            for node in ast.walk(tree):
+                if not (isinstance(node, ast.Call) and
+                        isinstance(node.func, ast.Attribute) and
+                        node.func.attr == 'fire'):
+                    continue
+                base = node.func.value
+                if not (isinstance(base, ast.Name) and
+                        base.id in ('failpoints', 'failpoints_lib')):
+                    continue
+                arg = node.args[0] if node.args else None
+                name = (arg.value if isinstance(arg, ast.Constant) and
+                        isinstance(arg.value, str) else '<dynamic>')
+                sites.append({'name': name, 'path': rel,
+                              'line': node.lineno})
+    sites.sort(key=lambda s: (s['name'], s['path'], s['line']))
+    return sites
+
+
+def _main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog='python -m skypilot_tpu.utils.failpoints',
+        description='List the package\'s registered failpoint sites.')
+    parser.add_argument('--list', action='store_true', dest='list_sites',
+                        help='scan the package for fire() sites')
+    parser.add_argument('--format', choices=('text', 'json'),
+                        default='text')
+    args = parser.parse_args(argv)
+    if not args.list_sites:
+        parser.print_help()
+        return 2
+    sites = scan_sites()
+    bad = [s for s in sites if not NAME_RE.match(str(s['name']))]
+    if args.format == 'json':
+        import json
+        print(json.dumps({'sites': sites,
+                          'malformed': len(bad)}, indent=2))
+    else:
+        width = max((len(str(s['name'])) for s in sites), default=4)
+        for s in sites:
+            marker = '' if NAME_RE.match(str(s['name'])) else '  <- BAD NAME'
+            print(f'{str(s["name"]).ljust(width)}  '
+                  f'{s["path"]}:{s["line"]}{marker}')
+        print(f'{len(sites)} site(s), {len(bad)} malformed')
+    return 1 if bad else 0
+
+
+if __name__ == '__main__':
+    raise SystemExit(_main())

@@ -17,6 +17,8 @@ import sqlite3
 import time
 from typing import Iterator
 
+from skypilot_tpu.utils import failpoints as failpoints_lib
+
 _WAL_RETRIES = 50
 _WAL_RETRY_SLEEP_S = 0.05
 
@@ -55,6 +57,12 @@ def immediate(conn: sqlite3.Connection) -> Iterator[sqlite3.Connection]:
     conn.execute('BEGIN IMMEDIATE')
     try:
         yield conn
+        if failpoints_lib.ACTIVE:
+            # Inside the try: a firing rolls the transaction back —
+            # exactly what a real commit failure (disk full, crashed
+            # process) does to a state write. Callers must tolerate
+            # the write having NOT happened.
+            failpoints_lib.fire('sqlite.commit')
     except BaseException:
         conn.rollback()
         raise

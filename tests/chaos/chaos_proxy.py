@@ -1,26 +1,63 @@
-"""TCP chaos proxy: forwards client↔server traffic, killing every Nth
-connection mid-flight.
+"""TCP chaos proxy: forwards client↔server traffic, injecting faults.
 
 Reference analog: tests/chaos/chaos_proxy.py — placed between the client
-and the API server to prove the control plane degrades cleanly (clear
-errors, no corrupted state) under network faults.
+and the API server (and, since the serving-robustness work, between the
+serve LB and an engine replica) to prove both planes degrade cleanly
+(clear errors, no corrupted state, bounded client-visible failures)
+under network faults.
+
+Fault modes (per doomed connection, every ``kill_every``-th):
+  mode='midstream'    kill after the first REQUEST bytes flow — the
+                      server got (some of) the request; the response
+                      dies. Downstream of an LB this looks like an
+                      upstream disconnection before/at response start.
+  mode='response'     forward the request intact, then kill after the
+                      first RESPONSE bytes reach the client — a true
+                      mid-stream kill (the client already has data).
+  mode='mid_headers'  kill the instant the server starts answering,
+                      BEFORE any response byte is forwarded — the
+                      nastiest LB case: request fully delivered,
+                      response headers lost.
+
+``byte_delay`` > 0 turns the proxy into a slow-loris: every response
+chunk is trickled after that many seconds, on EVERY connection —
+tripping between-bytes (sock_read) timeouts without ever going silent.
 """
 from __future__ import annotations
 
 import socket
 import struct
 import threading
+import time
 from typing import Optional
+
+
+def _rst_close(*socks: socket.socket) -> None:
+    """Hard-kill sockets with RST via SO_LINGER 0."""
+    for s in socks:
+        try:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                         struct.pack('ii', 1, 0))
+            s.close()
+        except OSError:
+            pass
 
 
 class ChaosProxy:
 
     def __init__(self, upstream_host: str, upstream_port: int,
-                 kill_every: int = 3):
+                 kill_every: int = 3, mode: str = 'midstream',
+                 byte_delay: float = 0.0):
         """Every `kill_every`-th connection is accepted then torn down
-        after the first payload bytes flow — the nastiest failure point."""
+        at the point `mode` selects — after first payload bytes flow
+        (the nastiest failure point), after first response bytes, or
+        just before any response byte escapes."""
+        if mode not in ('midstream', 'response', 'mid_headers'):
+            raise ValueError(f'unknown chaos mode {mode!r}')
         self.upstream = (upstream_host, upstream_port)
         self.kill_every = kill_every
+        self.mode = mode
+        self.byte_delay = byte_delay
         self._count = 0
         self._lock = threading.Lock()
         self._listener: Optional[socket.socket] = None
@@ -65,24 +102,34 @@ class ChaosProxy:
             client.close()
             return
 
-        def pump(src, dst, kill_after_first: bool):
+        # Which direction's first bytes trigger the kill:
+        #   midstream   → client→upstream (request bytes made it)
+        #   response    → upstream→client AFTER forwarding one chunk
+        #   mid_headers → upstream→client BEFORE forwarding anything
+        kill_on_request = doomed and self.mode == 'midstream'
+        kill_on_response = doomed and self.mode in ('response',
+                                                    'mid_headers')
+        kill_before_forward = doomed and self.mode == 'mid_headers'
+
+        def pump(src, dst, kill_after_first: bool,
+                 kill_before: bool = False, delay: float = 0.0) -> None:
             try:
                 while True:
                     data = src.recv(65536)
                     if not data:
                         break
+                    if kill_before:
+                        # The server answered; no response byte may
+                        # escape (mid-headers kill).
+                        _rst_close(client, upstream)
+                        return
+                    if delay > 0:
+                        time.sleep(delay)
                     dst.sendall(data)
                     if kill_after_first:
                         # Chaos: first bytes made it through, then the
                         # connection dies (RST via SO_LINGER 0).
-                        for s in (client, upstream):
-                            try:
-                                s.setsockopt(
-                                    socket.SOL_SOCKET, socket.SO_LINGER,
-                                    struct.pack('ii', 1, 0))
-                                s.close()
-                            except OSError:
-                                pass
+                        _rst_close(client, upstream)
                         return
             except OSError:
                 pass
@@ -93,6 +140,10 @@ class ChaosProxy:
                     except OSError:
                         pass
 
-        threading.Thread(target=pump, args=(upstream, client, False),
-                         daemon=True).start()
-        pump(client, upstream, doomed)
+        threading.Thread(
+            target=pump,
+            args=(upstream, client, kill_on_response),
+            kwargs={'kill_before': kill_before_forward,
+                    'delay': self.byte_delay},
+            daemon=True).start()
+        pump(client, upstream, kill_on_request)

@@ -1,0 +1,798 @@
+"""Chaos scenario suite: serving-path failure containment, on CPU.
+
+Deterministic fault injection (utils/failpoints.py, seeded) drives the
+recovery paths the robustness plan wired in (docs/ROBUSTNESS.md):
+
+  A. ENGINE — injected step/admit faults: every client request either
+     completes or fails with a STRUCTURED retriable error (zero hung
+     futures/streams); requests that never sampled a token are
+     resurrected, not failed; the resurrection budget bounds retries;
+     the engine serves normally after every reset.
+  B. LB — a killed/flapping upstream: bounded retries reroute
+     idempotent-safe requests to a healthy replica; the per-replica
+     circuit breaker opens after consecutive failures, sheds traffic,
+     half-open-probes, and re-closes after recovery — with metric and
+     journal evidence.
+  C. LB↔ENGINE through the ChaosProxy — connection kills mid-headers
+     and mid-stream, slow-loris reads: clients see bounded, clear
+     failures; the LB reroutes what is safe to reroute.
+  D. DRAIN — a DRAINING replica leaves the routable set, completes
+     100% of its accepted in-flight requests, then tears down (the
+     deadline bounds the wait); DRAINING can never be resurrected to
+     READY.
+
+All hermetic and CPU-backed (JAX_PLATFORMS=cpu), like the rest of
+tier-1.
+"""
+import asyncio
+import dataclasses
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import aiohttp
+import pytest
+from aiohttp import web
+from aiohttp.test_utils import TestClient
+from aiohttp.test_utils import TestServer as AioTestServer
+
+import jax.numpy as jnp
+
+from skypilot_tpu.observe import journal
+from skypilot_tpu.observe import metrics as metrics_lib
+from skypilot_tpu.serve import engine as engine_lib
+from skypilot_tpu.serve import load_balancer as lb_lib
+from skypilot_tpu.serve import replica_managers
+from skypilot_tpu.serve import serve_state
+from skypilot_tpu.serve.serve_state import ReplicaStatus
+from skypilot_tpu.utils import failpoints
+from tests.chaos.chaos_proxy import ChaosProxy
+
+
+@pytest.fixture(scope='module')
+def engine():
+    eng = engine_lib.InferenceEngine('llama-debug', max_len=128)
+    # fp32 (CPU argmax stability) + spec off: these scenarios pin the
+    # pipelined path, like test_engine_pipeline.
+    eng.cfg = dataclasses.replace(eng.cfg, dtype=jnp.float32)
+    eng.spec_k = 0
+    eng.warmup()
+    return eng
+
+
+@pytest.fixture(autouse=True)
+def chaos_env(tmp_path, monkeypatch):
+    """Every scenario starts with a disarmed failpoint plane and its
+    own journal DB; nothing leaks across tests."""
+    failpoints.reset()
+    monkeypatch.setenv('SKYTPU_OBSERVE_DB', str(tmp_path / 'observe.db'))
+    yield
+    failpoints.reset()
+
+
+def _run(coro, timeout=120):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(
+            asyncio.wait_for(coro, timeout=timeout))
+    finally:
+        loop.close()
+
+
+def _with_engine_client(engine, fn, timeout=120):
+    async def inner():
+        client = TestClient(AioTestServer(engine_lib.build_app(engine)))
+        await client.start_server()
+        try:
+            return await fn(client)
+        finally:
+            await client.close()
+    return _run(inner(), timeout=timeout)
+
+
+# ---------------------------------------------------------------------------
+# A. Engine fault containment
+# ---------------------------------------------------------------------------
+
+class TestEngineFaultContainment:
+
+    def test_injected_step_faults_zero_hangs_structured_errors(
+            self, engine):
+        """Seeded step faults mid-traffic: every request resolves —
+        200, or a STRUCTURED retriable 503 — inside a hard timeout
+        (zero hangs), and the engine serves cleanly afterwards."""
+        failpoints.arm('engine.step', every=3, max_fires=2)
+
+        async def fn(client):
+            async def one(i):
+                r = await client.post('/generate', json={
+                    'tokens': [i + 1] * 8, 'max_new_tokens': 6})
+                return r.status, await r.json()
+
+            results = await asyncio.gather(*(one(i) for i in range(8)))
+            # Recovery proof: with faults off, the rebuilt pool must
+            # serve normally (disarm explicitly — the burst may not
+            # have consumed every scheduled firing).
+            failpoints.reset()
+            r = await client.post('/generate', json={
+                'tokens': [3] * 8, 'max_new_tokens': 5})
+            after = r.status, await r.json()
+            return results, after
+
+        results, after = _with_engine_client(engine, fn)
+        for status, body in results:
+            assert status in (200, 503), body
+            if status == 503:
+                err = body['error']
+                assert err['type'] == 'engine_reset_error'
+                assert err['retriable'] is True
+                assert isinstance(err['tokens_emitted'], int)
+            else:
+                assert len(body['tokens']) == 6
+        assert after[0] == 200 and len(after[1]['tokens']) == 5
+        # Zero leaked state: no slot, no in-flight handle, no hold.
+        assert all(s is None for s in engine.slots)
+        assert engine._inflight == []
+        assert engine._hold == []
+
+    def test_admit_fault_resurrects_request_to_completion(self, engine):
+        """A request whose ADMISSION device call faults never sampled a
+        token — it is resubmitted internally and completes with 200;
+        the client never sees the fault."""
+        before = engine.resurrected_total
+        metric_before = engine_lib._M_RESURRECTED.value()
+        failpoints.arm('engine.admit', once=True)
+
+        async def fn(client):
+            r = await client.post('/generate', json={
+                'tokens': [5] * 8, 'max_new_tokens': 6})
+            return r.status, await r.json()
+
+        status, body = _with_engine_client(engine, fn)
+        assert status == 200
+        assert len(body['tokens']) == 6
+        assert engine.resurrected_total == before + 1
+        assert engine_lib._M_RESURRECTED.value() == metric_before + 1
+
+    def test_resurrection_budget_bounds_retries(self, engine):
+        """An admission that faults EVERY time must surface a bounded,
+        structured failure — not loop forever."""
+        before = engine.resurrected_total
+        failpoints.arm('engine.admit', every=1)
+
+        async def fn(client):
+            r = await client.post('/generate', json={
+                'tokens': [6] * 8, 'max_new_tokens': 4})
+            return r.status, await r.json()
+
+        status, body = _with_engine_client(engine, fn)
+        assert status == 503
+        err = body['error']
+        assert err['type'] == 'engine_reset_error'
+        assert err['tokens_emitted'] == 0
+        # Exactly RESURRECT_MAX internal resubmissions were spent.
+        assert engine.resurrected_total == \
+            before + engine_lib.RESURRECT_MAX
+
+    def test_fail_all_dispositions_each_row_minimally(self, engine):
+        """The containment matrix, row by row (regression for the
+        pre-fix behavior that failed EVERYTHING with the step's
+        exception): finished rows resolve with their results; rows
+        mid-prefill (zero tokens) resurrect; rows mid-decode fail with
+        tokens_emitted; a pending admit-group item resurrects."""
+        async def fn():
+            loop = asyncio.get_running_loop()
+
+            def item(fut, toks):
+                return (toks, 4, 0.0, None, None, 0.0, 0.0, (), False,
+                        None, fut)
+
+            def entry(fut, out, finish, prefill_item=None):
+                e = {'fut': fut, 'stream': None, 'finish': finish,
+                     'out': list(out), 'lps': [0.0] * len(out),
+                     'tops': [[] for _ in out], 'sent': 0, 'want': 4,
+                     'want_tops': False, 'stop': frozenset(),
+                     'ctx': [1] + list(out), 't_submit_ns': None}
+                if prefill_item is not None:
+                    e['prefill'] = {'item': prefill_item, 'pos': 0,
+                                    't_admit_ns': 0}
+                else:
+                    e['item'] = item(fut, [1] * 8)
+                return e
+
+            fut_done = loop.create_future()      # finished, unpublished
+            fut_mid = loop.create_future()       # mid-decode, 2 tokens
+            fut_pre = loop.create_future()       # mid-chunked-prefill
+            fut_queued = loop.create_future()    # in the admit group
+            item_pre = item(fut_pre, [2] * 8)
+            item_queued = item(fut_queued, [3] * 8)
+            engine.slots[0] = entry(fut_done, [7, 8], 'length')
+            engine.slots[1] = entry(fut_mid, [9, 10], None)
+            engine.slots[2] = entry(fut_pre, [], None,
+                                    prefill_item=item_pre)
+            try:
+                engine._fail_all(RuntimeError('boom'),
+                                 extra=[item_queued])
+                out, finish, _, _ = fut_done.result()
+                assert (out, finish) == ([7, 8], 'length')
+                with pytest.raises(engine_lib.EngineResetError) as ei:
+                    fut_mid.result()
+                assert ei.value.tokens_emitted == 2
+                assert ei.value.retriable is True
+                # Zero-token rows were RESURRECTED, not failed —
+                # oldest (the prefilling slot) ahead of the pending
+                # admit item, both ahead of anything newly held.
+                assert not fut_pre.done() and not fut_queued.done()
+                assert engine._hold[:2] == [item_pre, item_queued]
+            finally:
+                engine._hold.clear()
+                engine._resurrect_counts.clear()
+                for f in (fut_pre, fut_queued):
+                    f.cancel()
+
+        _run(fn())
+
+    def test_streaming_reset_is_structured_and_never_hangs(self,
+                                                           engine):
+        """A stream cut by a device failure ends with a structured
+        retriable error event carrying tokens_emitted — never a silent
+        stall — and the engine serves the next request."""
+        failpoints.arm('engine.step', every=2, max_fires=1)
+
+        async def fn(client):
+            r = await client.post('/v1/completions', json={
+                'prompt': [4] * 8, 'max_tokens': 48, 'stream': True,
+                'temperature': 0})
+            assert r.status == 200
+            body = (await r.read()).decode()
+            r2 = await client.post('/generate', json={
+                'tokens': [2] * 8, 'max_new_tokens': 4})
+            return body, r2.status
+
+        body, after_status = _with_engine_client(engine, fn)
+        if 'engine_reset_error' in body:
+            assert 'tokens_emitted' in body
+        else:
+            # The fault landed between this stream's steps (e.g. on
+            # admit of the follow-up): the stream then completed.
+            assert 'data: [DONE]' in body
+        assert after_status == 200
+
+
+# ---------------------------------------------------------------------------
+# B. LB retries + circuit breaker (fake upstreams — pure asyncio)
+# ---------------------------------------------------------------------------
+
+def _toggle_app(state):
+    """An upstream whose handler can be broken (kills the connection
+    before any response byte — the LB sees a pre-response disconnect)
+    and counts attempts/successes."""
+    app = web.Application()
+
+    async def handler(request):
+        state['attempts'] += 1
+        if state['broken']:
+            request.transport.close()
+            return web.Response()
+        state['hits'] += 1
+        return web.json_response({'ok': True, 'who': state['name']})
+
+    app.router.add_route('*', '/{tail:.*}', handler)
+    return app
+
+
+def _make_lb(monkeypatch, urls, retries=2, threshold=2, cooldown=30.0,
+             connect=5.0, read=5.0):
+    monkeypatch.setenv('SKYTPU_LB_RETRIES', str(retries))
+    monkeypatch.setenv('SKYTPU_LB_BREAKER_THRESHOLD', str(threshold))
+    monkeypatch.setenv('SKYTPU_LB_BREAKER_COOLDOWN', str(cooldown))
+    monkeypatch.setenv('SKYTPU_LB_CONNECT_TIMEOUT', str(connect))
+    monkeypatch.setenv('SKYTPU_LB_READ_TIMEOUT', str(read))
+    lb = lb_lib.LoadBalancer('round_robin', service_name='chaos-svc')
+    lb.set_ready_replicas(urls)
+    return lb
+
+
+class TestLBRetriesAndBreaker:
+
+    def test_retry_reroutes_and_breaker_opens_then_recloses(
+            self, monkeypatch):
+        """The full breaker arc with a flapping upstream: every client
+        request succeeds (rerouted), the breaker opens after
+        `threshold` consecutive failures and sheds traffic, then
+        half-open-probes and re-closes once the upstream recovers —
+        metrics + journal record the whole story."""
+        bad = {'name': 'bad', 'broken': True, 'attempts': 0, 'hits': 0}
+        good = {'name': 'good', 'broken': False, 'attempts': 0,
+                'hits': 0}
+        retries_before = sum(
+            lb_lib._LB_RETRIES.value(reason=r)
+            for r in lb_lib._RETRY_REASONS)
+
+        async def fn():
+            bad_srv = AioTestServer(_toggle_app(bad))
+            good_srv = AioTestServer(_toggle_app(good))
+            await bad_srv.start_server()
+            await good_srv.start_server()
+            bad_url = str(bad_srv.make_url('')).rstrip('/')
+            good_url = str(good_srv.make_url('')).rstrip('/')
+            lb = _make_lb(monkeypatch, [bad_url, good_url],
+                          threshold=2, cooldown=1.0)
+            client = TestClient(AioTestServer(lb.build_app()))
+            await client.start_server()
+            try:
+                # Phase 1: flapping upstream. Every request must still
+                # return 200 (retried onto the healthy replica).
+                for _ in range(6):
+                    r = await client.get('/ping')
+                    assert r.status == 200
+                    assert (await r.json())['who'] == 'good'
+                assert lb._breakers[bad_url].state == 'open'
+                open_attempts = bad['attempts']
+                # Phase 2: breaker open — traffic sheds (no new
+                # attempts reach the broken replica inside cooldown).
+                for _ in range(4):
+                    r = await client.get('/ping')
+                    assert r.status == 200
+                assert bad['attempts'] == open_attempts
+                # Phase 3: upstream recovers; after the cooldown the
+                # half-open probe succeeds and the breaker re-closes.
+                bad['broken'] = False
+                await asyncio.sleep(1.1)
+                for _ in range(4):
+                    r = await client.get('/ping')
+                    assert r.status == 200
+                assert lb._breakers[bad_url].state == 'closed'
+                assert bad['hits'] > 0
+            finally:
+                await client.close()
+                await bad_srv.close()
+                await good_srv.close()
+            return bad_url
+
+        bad_url = _run(fn())
+        # Metric evidence: retries were counted with a reason.
+        retries_after = sum(
+            lb_lib._LB_RETRIES.value(reason=r)
+            for r in lb_lib._RETRY_REASONS)
+        assert retries_after > retries_before
+        # Journal evidence: the breaker's transitions, with the
+        # replica URL in the event payload.
+        events = journal.query(kind='lb_breaker')
+        arcs = [e['reason'] for e in events
+                if (e.get('data') or {}).get('replica') == bad_url]
+        assert 'closed->open' in arcs
+        assert any(a.endswith('->closed') for a in arcs)
+
+    def test_all_replicas_broken_bounded_structured_503(
+            self, monkeypatch):
+        """With every replica down, the client gets a bounded,
+        structured, retriable error — not a hang, not a raw 502 per
+        attempt forever."""
+        bad = {'name': 'bad', 'broken': True, 'attempts': 0, 'hits': 0}
+
+        async def fn():
+            bad_srv = AioTestServer(_toggle_app(bad))
+            await bad_srv.start_server()
+            bad_url = str(bad_srv.make_url('')).rstrip('/')
+            lb = _make_lb(monkeypatch, [bad_url], retries=1,
+                          threshold=2, cooldown=30.0)
+            client = TestClient(AioTestServer(lb.build_app()))
+            await client.start_server()
+            try:
+                out = []
+                for _ in range(4):
+                    r = await client.get('/ping')
+                    out.append((r.status, await r.json()))
+                return out
+            finally:
+                await client.close()
+                await bad_srv.close()
+
+        results = _run(fn(), timeout=60)
+        for status, body in results:
+            assert status in (502, 503)
+            assert body.get('retriable') is True
+
+    def test_aborted_half_open_probe_releases_token(self):
+        """Half-open allows exactly ONE probe — an aborted probe
+        (client hung up mid-attempt) must release the token, or the
+        breaker wedges half-open and the replica never routes again."""
+        b = lb_lib.CircuitBreaker(threshold=1, cooldown=0.0)
+        assert b.record_failure(0.0) == ('closed', 'open')
+        assert b.routable(1.0)                 # cooldown elapsed
+        assert b.begin_attempt(1.0) == ('open', 'half_open')
+        assert not b.routable(1.0)             # probe token consumed
+        b.abort_attempt()                      # client abort mid-probe
+        assert b.routable(1.0)                 # released, not wedged
+        b.begin_attempt(1.0)
+        assert b.record_success() == ('half_open', 'closed')
+
+    def test_client_abort_does_not_poison_breaker(self, monkeypatch):
+        """A client hanging up mid-stream is NOT an upstream failure:
+        the replica's breaker must not move (threshold=1 here, so one
+        misattributed failure would open it and shed a healthy
+        replica), and the outcome is counted as client_abort."""
+        async def fn():
+            app = web.Application()
+
+            async def slow_stream(request):
+                resp = web.StreamResponse()
+                await resp.prepare(request)
+                for _ in range(100):
+                    await resp.write(b'x' * 64)
+                    await asyncio.sleep(0.05)
+                return resp
+
+            async def ping(request):
+                return web.json_response({'ok': True})
+
+            app.router.add_get('/slow', slow_stream)
+            app.router.add_get('/ping', ping)
+            srv = AioTestServer(app)
+            await srv.start_server()
+            url = str(srv.make_url('')).rstrip('/')
+            lb = _make_lb(monkeypatch, [url], retries=1, threshold=1,
+                          cooldown=30.0, read=10.0)
+            client = TestClient(AioTestServer(lb.build_app()))
+            await client.start_server()
+            try:
+                for _ in range(3):
+                    try:
+                        await client.get(
+                            '/slow',
+                            timeout=aiohttp.ClientTimeout(total=0.3))
+                    except (asyncio.TimeoutError,
+                            aiohttp.ClientError):
+                        pass        # the client gave up — that's the point
+                # Give the LB loop a beat to observe the dead writes.
+                await asyncio.sleep(0.3)
+                assert lb._breakers[url].state == 'closed'
+                r = await client.get('/ping')
+                assert r.status == 200
+            finally:
+                await client.close()
+                await srv.close()
+
+        before = lb_lib._LB_REQUESTS.value(policy='round_robin',
+                                           outcome='client_abort')
+        _run(fn(), timeout=60)
+        assert lb_lib._LB_REQUESTS.value(
+            policy='round_robin', outcome='client_abort') > before
+
+    def test_connect_refused_counts_and_reroutes(self, monkeypatch):
+        """A replica that refuses connections entirely (dead port):
+        connect-level failure, retried onto the live replica."""
+        good = {'name': 'good', 'broken': False, 'attempts': 0,
+                'hits': 0}
+        before = lb_lib._LB_RETRIES.value(reason='connect_error')
+
+        async def fn():
+            good_srv = AioTestServer(_toggle_app(good))
+            await good_srv.start_server()
+            good_url = str(good_srv.make_url('')).rstrip('/')
+            # Port 1: nothing listens (connection refused).
+            lb = _make_lb(monkeypatch,
+                          ['http://127.0.0.1:1', good_url],
+                          threshold=1, cooldown=30.0, connect=2.0)
+            client = TestClient(AioTestServer(lb.build_app()))
+            await client.start_server()
+            try:
+                for _ in range(3):
+                    r = await client.get('/ping')
+                    assert r.status == 200
+            finally:
+                await client.close()
+                await good_srv.close()
+
+        _run(fn(), timeout=60)
+        assert lb_lib._LB_RETRIES.value(reason='connect_error') > before
+
+
+# ---------------------------------------------------------------------------
+# C. LB ↔ live engine replica through the ChaosProxy
+# ---------------------------------------------------------------------------
+
+class TestLBEngineChaos:
+
+    def test_mid_headers_kill_is_retried_to_healthy_route(
+            self, engine, monkeypatch):
+        """The nastiest LB case: request fully delivered, response
+        headers never arrive. Idempotent-safe → retried; with a
+        healthy route available every request still completes."""
+        async def fn():
+            eng_srv = AioTestServer(engine_lib.build_app(engine))
+            await eng_srv.start_server()
+            proxy = ChaosProxy('127.0.0.1', eng_srv.port,
+                               kill_every=1, mode='mid_headers')
+            proxy_port = proxy.start()
+            direct = str(eng_srv.make_url('')).rstrip('/')
+            lb = _make_lb(monkeypatch,
+                          [f'http://127.0.0.1:{proxy_port}', direct],
+                          retries=2, threshold=3, cooldown=30.0,
+                          read=10.0)
+            client = TestClient(AioTestServer(lb.build_app()))
+            await client.start_server()
+            try:
+                for i in range(4):
+                    r = await client.post('/generate', json={
+                        'tokens': [i + 1] * 8, 'max_new_tokens': 4})
+                    assert r.status == 200
+                    assert len((await r.json())['tokens']) == 4
+            finally:
+                await client.close()
+                proxy.stop()
+                await eng_srv.close()
+
+        _run(fn())
+
+    def test_mid_stream_kill_truncates_without_hanging(
+            self, engine, monkeypatch):
+        """A streaming response killed mid-flight: the client sees a
+        truncated stream promptly (never a hang), the LB records the
+        upstream failure, and the engine stays healthy."""
+        async def fn():
+            eng_srv = AioTestServer(engine_lib.build_app(engine))
+            await eng_srv.start_server()
+            proxy = ChaosProxy('127.0.0.1', eng_srv.port,
+                               kill_every=1, mode='response')
+            proxy_port = proxy.start()
+            lb = _make_lb(monkeypatch,
+                          [f'http://127.0.0.1:{proxy_port}'],
+                          retries=1, threshold=3, cooldown=30.0,
+                          read=10.0)
+            client = TestClient(AioTestServer(lb.build_app()))
+            await client.start_server()
+            try:
+                r = await client.post('/v1/completions', json={
+                    'prompt': [5] * 8, 'max_tokens': 40,
+                    'stream': True, 'temperature': 0})
+                try:
+                    body = (await r.read()).decode()
+                except Exception:       # noqa: BLE001 — torn transfer
+                    body = ''
+                # Truncated: the stream never reached its terminator.
+                assert 'data: [DONE]' not in body
+                # Engine is fine: a direct request completes.
+                direct = TestClient(eng_srv)
+                r2 = await direct.post('/generate', json={
+                    'tokens': [2] * 8, 'max_new_tokens': 3})
+                assert r2.status == 200
+            finally:
+                await client.close()
+                proxy.stop()
+                await eng_srv.close()
+
+        _run(fn())
+
+    def test_slow_loris_read_timeout_reroutes(self, engine,
+                                              monkeypatch):
+        """A replica trickling bytes slower than the between-bytes
+        timeout is detected (sock_read), and requests reroute to the
+        healthy route — the split-timeout shape at work."""
+        before = lb_lib._LB_RETRIES.value(reason='timeout')
+
+        async def fn():
+            eng_srv = AioTestServer(engine_lib.build_app(engine))
+            await eng_srv.start_server()
+            proxy = ChaosProxy('127.0.0.1', eng_srv.port,
+                               kill_every=10 ** 9, byte_delay=1.0)
+            proxy_port = proxy.start()
+            direct = str(eng_srv.make_url('')).rstrip('/')
+            lb = _make_lb(monkeypatch,
+                          [f'http://127.0.0.1:{proxy_port}', direct],
+                          retries=2, threshold=5, cooldown=30.0,
+                          read=0.3)
+            client = TestClient(AioTestServer(lb.build_app()))
+            await client.start_server()
+            try:
+                for i in range(4):
+                    r = await client.post('/generate', json={
+                        'tokens': [i + 2] * 8, 'max_new_tokens': 3})
+                    assert r.status == 200
+            finally:
+                await client.close()
+                proxy.stop()
+                await eng_srv.close()
+
+        _run(fn())
+        assert lb_lib._LB_RETRIES.value(reason='timeout') > before
+
+
+# ---------------------------------------------------------------------------
+# D. Graceful drain
+# ---------------------------------------------------------------------------
+
+class _HealthHandler(BaseHTTPRequestHandler):
+    state = None        # injected per server
+
+    def do_GET(self):
+        doc = json.dumps({'status': 'ok',
+                          'queue_depth': self.state['queue_depth'],
+                          'in_flight': self.state['in_flight']})
+        self.send_response(200)
+        self.send_header('Content-Type', 'application/json')
+        self.end_headers()
+        self.wfile.write(doc.encode())
+
+    def log_message(self, *args):
+        pass
+
+
+def _health_server(state):
+    handler = type('H', (_HealthHandler,), {'state': state})
+    srv = HTTPServer(('127.0.0.1', 0), handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv, f'http://127.0.0.1:{srv.server_port}'
+
+
+@pytest.fixture
+def serve_db(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKYTPU_SERVE_DB', str(tmp_path / 'serve.db'))
+    yield
+
+
+def _manager(name='dsvc'):
+    from skypilot_tpu import task as task_lib
+    from skypilot_tpu.serve import service_spec as spec_lib
+    spec = spec_lib.ServiceSpec.from_yaml_config({
+        'readiness_probe': '/health', 'replicas': 2})
+    task = task_lib.Task.from_yaml_config({'run': 'sleep 1'})
+    return replica_managers.ReplicaManager(name, task, spec)
+
+
+class TestGracefulDrain:
+
+    def _seed_ready(self, name, rid, url=''):
+        serve_state.add_replica(name, rid, cluster_name=f'c{rid}')
+        assert serve_state.set_replica_status(name, rid,
+                                              ReplicaStatus.STARTING)
+        assert serve_state.set_replica_status(name, rid,
+                                              ReplicaStatus.READY)
+        if url:
+            serve_state.upsert_replica(name, rid, url=url)
+
+    def test_drain_waits_for_in_flight_then_tears_down(
+            self, serve_db, enable_local_cloud, monkeypatch):
+        """The drain arc against live telemetry: DRAINING leaves the
+        routable set at once; teardown happens ONLY when in-flight
+        work reaches zero; metric + journal evidence lands."""
+        mgr = _manager()
+        state = {'in_flight': 2, 'queue_depth': 1}
+        srv, url = _health_server(state)
+        torn = []
+        monkeypatch.setattr(mgr, 'terminate_replica',
+                            lambda rid, status=None: torn.append(rid))
+        monkeypatch.setattr(mgr, '_cluster_gone', lambda rid: False)
+        try:
+            self._seed_ready('dsvc', 1, url=url)
+            assert mgr.drain_replica(1) is True
+            reps = serve_state.get_replicas('dsvc')
+            assert reps[0]['status'] is ReplicaStatus.DRAINING
+            # Out of the routable set immediately.
+            assert mgr.ready_urls() == []
+            rep = reps[0]
+            now = rep['launched_at'] + 1
+            # Busy: both passes leave it finishing.
+            mgr._reconcile_draining(rep, now)
+            state['in_flight'] = 1
+            state['queue_depth'] = 0
+            mgr._reconcile_draining(rep, now + 1)
+            assert torn == []
+            # Idle: teardown fires, with evidence.
+            state['in_flight'] = 0
+            mgr._reconcile_draining(rep, now + 2)
+            assert torn == [1]
+            finishes = journal.query(kind='drain_finish')
+            assert finishes and finishes[-1]['reason'] == 'complete'
+            assert journal.query(kind='drain_start')
+        finally:
+            srv.shutdown()
+
+    def test_drain_deadline_bounds_a_stuck_replica(
+            self, serve_db, enable_local_cloud, monkeypatch):
+        mgr = _manager()
+        state = {'in_flight': 5, 'queue_depth': 3}   # never drains
+        srv, url = _health_server(state)
+        torn = []
+        monkeypatch.setattr(mgr, 'terminate_replica',
+                            lambda rid, status=None: torn.append(rid))
+        monkeypatch.setattr(mgr, '_cluster_gone', lambda rid: False)
+        monkeypatch.setenv('SKYTPU_SERVE_DRAIN_SECONDS', '0.1')
+        try:
+            self._seed_ready('dsvc', 1, url=url)
+            assert mgr.drain_replica(1)
+            rep = serve_state.get_replicas('dsvc')[0]
+            start = mgr._drain_started[1]
+            mgr._reconcile_draining(rep, start)          # within deadline
+            assert torn == []
+            mgr._reconcile_draining(rep, start + 0.2)    # past deadline
+            assert torn == [1]
+            finishes = journal.query(kind='drain_finish')
+            assert finishes[-1]['reason'] == 'deadline'
+        finally:
+            srv.shutdown()
+
+    def test_draining_replica_cannot_resurrect_to_ready(
+            self, serve_db, enable_local_cloud):
+        self._seed_ready('dsvc', 1)
+        assert serve_state.set_replica_status(
+            'dsvc', 1, ReplicaStatus.DRAINING)
+        # The resurrect-refusal contract: a drain decision sticks.
+        assert not serve_state.set_replica_status(
+            'dsvc', 1, ReplicaStatus.READY)
+        assert not serve_state.set_replica_status(
+            'dsvc', 1, ReplicaStatus.NOT_READY)
+        assert serve_state.get_replicas('dsvc')[0]['status'] is \
+            ReplicaStatus.DRAINING
+        # The legal exits still work.
+        assert serve_state.set_replica_status(
+            'dsvc', 1, ReplicaStatus.SHUTTING_DOWN)
+
+    def test_scale_down_drains_ready_replicas(
+            self, serve_db, enable_local_cloud, monkeypatch):
+        """Autoscaler scale-down retires via DRAIN, not kill: the shed
+        replica transitions to DRAINING (and stays up finishing);
+        non-ready replicas still tear down immediately."""
+        mgr = _manager()
+        monkeypatch.setattr(mgr, '_cluster_gone', lambda rid: False)
+        monkeypatch.setattr(replica_managers, 'probe_url',
+                            lambda *a, **k: True)
+        self._seed_ready('dsvc', 1)
+        self._seed_ready('dsvc', 2)
+        mgr.reconcile(target=1)
+        statuses = {r['replica_id']: r['status']
+                    for r in serve_state.get_replicas('dsvc')}
+        assert sorted(statuses.values(), key=lambda s: s.value) == \
+            [ReplicaStatus.DRAINING, ReplicaStatus.READY]
+
+    def test_drained_engine_completes_all_accepted_requests(
+            self, serve_db, enable_local_cloud, engine, monkeypatch):
+        """THE zero-loss contract, against a live engine replica:
+        requests accepted before the drain decision ALL complete;
+        teardown happens only after the engine reports idle."""
+        mgr = _manager()
+        torn = []
+        monkeypatch.setattr(mgr, 'terminate_replica',
+                            lambda rid, status=None: torn.append(rid))
+        monkeypatch.setattr(mgr, '_cluster_gone', lambda rid: False)
+
+        async def fn():
+            eng_srv = AioTestServer(engine_lib.build_app(engine))
+            await eng_srv.start_server()
+            url = str(eng_srv.make_url('')).rstrip('/')
+            self._seed_ready('dsvc', 1, url=url)
+            client = TestClient(eng_srv)
+            # Accept in-flight work BEFORE the drain decision.
+            tasks = [asyncio.create_task(client.post('/generate', json={
+                'tokens': [i + 1] * 8, 'max_new_tokens': 40}))
+                for i in range(3)]
+            await asyncio.sleep(0)      # let them enqueue
+            assert mgr.drain_replica(1)
+            rep = serve_state.get_replicas('dsvc')[0]
+            assert rep['status'] is ReplicaStatus.DRAINING
+            # Reconcile-drain loop: poll off-loop so the engine keeps
+            # decoding on this loop.
+            start = mgr._drain_started[1]
+            deadline = time.monotonic() + 60
+            while not torn and time.monotonic() < deadline:
+                await asyncio.to_thread(
+                    mgr._reconcile_draining, rep, start + 1.0)
+                await asyncio.sleep(0.05)
+            results = []
+            for t in tasks:
+                r = await t
+                results.append((r.status, await r.json()))
+            await client.close()
+            await eng_srv.close()
+            return results
+
+        results = _run(fn())
+        assert torn == [1]
+        # 100% of accepted requests completed, in full.
+        for status, body in results:
+            assert status == 200
+            assert len(body['tokens']) == 40
+        finishes = journal.query(kind='drain_finish')
+        assert finishes and finishes[-1]['reason'] == 'complete'

@@ -177,9 +177,11 @@ class TestPipelineOverlap:
     def test_failure_while_pipelined_resets_and_recovers(self, engine,
                                                          monkeypatch):
         """A device-call failure surfacing at collect time (the failed
-        jit was donated the cache) fails the in-flight requests, drops
-        any lookahead handle, rebuilds device state, and the next
-        request succeeds."""
+        jit was donated the cache) fails the in-flight requests with a
+        STRUCTURED retriable 503 (they had already emitted tokens, so
+        resurrection does not apply — docs/ROBUSTNESS.md), drops any
+        lookahead handle, rebuilds device state, and the next request
+        succeeds."""
         orig = engine_lib.InferenceEngine._collect_step
         state = {'arm': True}
 
@@ -196,7 +198,13 @@ class TestPipelineOverlap:
         async def fn(client):
             r = await client.post('/generate', json={
                 'tokens': [6] * 8, 'max_new_tokens': 24})
-            assert r.status == 500        # the failed request surfaces
+            # The failed request surfaces — structured and retriable,
+            # with the token count it already consumed.
+            assert r.status == 503
+            err = (await r.json())['error']
+            assert err['type'] == 'engine_reset_error'
+            assert err['retriable'] is True
+            assert err['tokens_emitted'] >= 1
             r2 = await client.post('/generate', json={
                 'tokens': [6] * 8, 'max_new_tokens': 3})
             assert r2.status == 200
@@ -501,7 +509,7 @@ class TestEngineFlightAndSpans:
         async def fn(client):
             r = await client.post('/generate', json={
                 'tokens': [9] * 8, 'max_new_tokens': 24})
-            assert r.status == 500
+            assert r.status == 503        # structured retriable reset
             r2 = await client.post('/generate', json={
                 'tokens': [9] * 8, 'max_new_tokens': 3})
             assert r2.status == 200

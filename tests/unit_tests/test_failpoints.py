@@ -1,0 +1,205 @@
+"""The failpoint plane (utils/failpoints.py): deterministic fault
+injection with zero inactive cost.
+
+Contracts under test:
+  - modes: fire-once, every-N, probabilistic-with-seed (bit-reproducible
+    across runs), delay-injection, max-fires;
+  - env activation (SKYTPU_FAILPOINTS grammar) incl. loud rejection of
+    malformed specs;
+  - zero-cost-when-inactive: ACTIVE is a plain module bool, False by
+    default, flipped only by arming;
+  - discoverability: every fire() site in the package is found by the
+    AST scan behind `python -m skypilot_tpu.utils.failpoints --list`,
+    and every discovered name satisfies the naming contract.
+"""
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+from skypilot_tpu.utils import failpoints
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+@pytest.fixture(autouse=True)
+def _clean_plane():
+    failpoints.reset()
+    yield
+    failpoints.reset()
+
+
+class TestModes:
+
+    def test_inactive_is_default_and_fire_is_noop(self):
+        assert failpoints.ACTIVE is False
+        failpoints.fire('engine.step')      # unarmed: returns silently
+
+    def test_once_fires_exactly_once_then_disarms(self):
+        failpoints.arm('engine.step', once=True)
+        assert failpoints.ACTIVE is True
+        with pytest.raises(failpoints.FailpointError) as ei:
+            failpoints.fire('engine.step')
+        assert ei.value.failpoint == 'engine.step'
+        # Disarmed after the single firing — and ACTIVE drops back.
+        failpoints.fire('engine.step')
+        assert failpoints.ACTIVE is False
+
+    def test_every_n(self):
+        failpoints.arm('engine.step', every=3)
+        fired = 0
+        for _ in range(9):
+            try:
+                failpoints.fire('engine.step')
+            except failpoints.FailpointError:
+                fired += 1
+        assert fired == 3
+        assert failpoints.hits('engine.step') == 9
+        assert failpoints.fires('engine.step') == 3
+
+    def test_prob_is_seed_deterministic(self):
+        def run(seed):
+            failpoints.arm('engine.step', prob=0.5, seed=seed)
+            pattern = []
+            for _ in range(32):
+                try:
+                    failpoints.fire('engine.step')
+                    pattern.append(0)
+                except failpoints.FailpointError:
+                    pattern.append(1)
+            failpoints.disarm('engine.step')
+            return pattern
+
+        a, b = run(7), run(7)
+        assert a == b                       # bit-reproducible
+        assert 0 < sum(a) < 32              # actually probabilistic
+        assert run(8) != a                  # seed matters
+
+    def test_per_site_rng_streams_are_independent(self):
+        # Interleaving a second probabilistic site must not perturb the
+        # first site's draw sequence.
+        failpoints.arm('engine.step', prob=0.5, seed=7)
+        solo = []
+        for _ in range(16):
+            try:
+                failpoints.fire('engine.step')
+                solo.append(0)
+            except failpoints.FailpointError:
+                solo.append(1)
+        failpoints.reset()
+        failpoints.arm('engine.step', prob=0.5, seed=7)
+        failpoints.arm('engine.admit', prob=0.5, seed=9)
+        interleaved = []
+        for _ in range(16):
+            try:
+                failpoints.fire('engine.admit')
+            except failpoints.FailpointError:
+                pass
+            try:
+                failpoints.fire('engine.step')
+                interleaved.append(0)
+            except failpoints.FailpointError:
+                interleaved.append(1)
+        assert interleaved == solo
+
+    def test_delay_sleeps_instead_of_raising(self):
+        failpoints.arm('sqlite.commit', delay=0.05)
+        t0 = time.monotonic()
+        failpoints.fire('sqlite.commit')    # no raise
+        assert time.monotonic() - t0 >= 0.04
+
+    def test_max_fires_bounds_total(self):
+        failpoints.arm('engine.step', max_fires=2)
+        fired = 0
+        for _ in range(5):
+            try:
+                failpoints.fire('engine.step')
+            except failpoints.FailpointError:
+                fired += 1
+        assert fired == 2
+        assert failpoints.ACTIVE is False   # disarmed at the cap
+
+    def test_custom_exception_factory(self):
+        failpoints.arm('multihost.send', exc=lambda n: OSError(n))
+        with pytest.raises(OSError):
+            failpoints.fire('multihost.send')
+
+    def test_armed_context_restores_previous_state(self):
+        failpoints.arm('engine.step', every=100)
+        with failpoints.armed('engine.step', once=True):
+            with pytest.raises(failpoints.FailpointError):
+                failpoints.fire('engine.step')
+        # The every=100 arming is back (hit counters reset with it).
+        assert failpoints.state()['engine.step']['every'] == 100
+
+    def test_bad_names_and_specs_rejected(self):
+        with pytest.raises(ValueError):
+            failpoints.arm('NoDots')
+        with pytest.raises(ValueError):
+            failpoints.arm('Engine.Step')
+        with pytest.raises(ValueError):
+            failpoints.arm('engine.step', every=2, prob=0.5)
+        with pytest.raises(ValueError):
+            failpoints.arm('engine.step', prob=1.5)
+        with pytest.raises(ValueError):
+            failpoints.arm('engine.step', every=0)
+
+
+class TestEnvActivation:
+
+    def test_parse_spec_grammar(self):
+        spec = failpoints.parse_spec(
+            'engine.step=once;lb.upstream_read=every:3;'
+            'serve.probe=prob:0.5,seed:7;sqlite.commit=delay:0.2,max:4')
+        assert spec == {
+            'engine.step': {'once': True},
+            'lb.upstream_read': {'every': 3},
+            'serve.probe': {'prob': 0.5, 'seed': 7},
+            'sqlite.commit': {'delay': 0.2, 'max_fires': 4},
+        }
+
+    def test_malformed_specs_fail_loudly(self):
+        for bad in ('engine.step', 'engine.step=', 'a.b=bogus:1',
+                    'a.b=every:x'):
+            with pytest.raises(ValueError):
+                failpoints.parse_spec(bad)
+
+    def test_load_env_arms_sites(self, monkeypatch):
+        monkeypatch.setenv(failpoints.ENV_VAR,
+                           'engine.step=every:2')
+        failpoints.load_env()
+        assert failpoints.ACTIVE is True
+        failpoints.fire('engine.step')
+        with pytest.raises(failpoints.FailpointError):
+            failpoints.fire('engine.step')
+
+
+class TestDiscovery:
+
+    def test_scan_finds_all_wired_sites(self):
+        names = {s['name'] for s in failpoints.scan_sites()}
+        # The serving-path fault sites the robustness plan wired in —
+        # removing one silently un-tests its recovery path.
+        assert {'engine.step', 'engine.admit', 'engine.collect',
+                'multihost.send', 'multihost.recv',
+                'lb.upstream_connect', 'lb.upstream_read',
+                'serve.probe', 'controller.reconcile',
+                'sqlite.commit'} <= names
+        # Naming contract holds for every discovered site.
+        for name in names:
+            assert failpoints.NAME_RE.match(name), name
+
+    def test_list_cli(self):
+        proc = subprocess.run(
+            [sys.executable, '-m', 'skypilot_tpu.utils.failpoints',
+             '--list', '--format', 'json'],
+            capture_output=True, text=True, cwd=REPO,
+            env={**os.environ, 'PYTHONPATH': REPO}, timeout=120)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        import json
+        doc = json.loads(proc.stdout)
+        assert doc['malformed'] == 0
+        assert any(s['name'] == 'engine.step' for s in doc['sites'])

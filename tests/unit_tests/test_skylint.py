@@ -37,7 +37,8 @@ EXPECTED_CHECKS = [
     'layers', 'lazy-imports', 'async-blocking', 'jit-hazards',
     'host-sync-loop', 'page-table-shape', 'sqlite-discipline',
     'state-machine', 'thread-discipline', 'silent-except',
-    'metric-discipline', 'span-discipline',
+    'metric-discipline', 'span-discipline', 'timeout-discipline',
+    'failpoint-naming',
 ]
 
 
@@ -892,6 +893,166 @@ class TestSpanDisciplineChecker:
         assert _run(tmp_path, checks=['span-discipline'])['total'] == 0
 
 
+class TestTimeoutDisciplineChecker:
+
+    def test_missing_timeouts_flagged(self, tmp_path):
+        _write(tmp_path, 'client/bad.py', '''\
+            import socket
+            from urllib import request as urlrequest
+            import requests
+
+            def probe(url):
+                with urlrequest.urlopen(url) as r:
+                    return r.status
+
+            def fetch(url):
+                return requests.get(url)
+
+            def connect(host, port):
+                return socket.create_connection((host, port))
+        ''')
+        _write(tmp_path, 'serve/bad_session.py', '''\
+            import aiohttp
+
+            async def call(url):
+                async with aiohttp.ClientSession() as session:
+                    async with session.get(url) as r:
+                        return r.status
+        ''')
+        report = _run(tmp_path, checks=['timeout-discipline'])
+        assert sorted(_idents(report)) == [
+            'timeout-discipline:client/bad.py:requests.get',
+            'timeout-discipline:client/bad.py:socket.create_connection',
+            'timeout-discipline:client/bad.py:urlopen',
+            'timeout-discipline:serve/bad_session.py:'
+            'client-session-request',
+        ]
+
+    def test_total_cap_on_serve_proxy_flagged(self, tmp_path):
+        # The exact pre-fix LB shape: one total=300 killing long
+        # streams AND detecting dead replicas slowly.
+        _write(tmp_path, 'serve/lb.py', '''\
+            import aiohttp
+
+            def make_session():
+                return aiohttp.ClientSession(
+                    timeout=aiohttp.ClientTimeout(total=300))
+        ''')
+        report = _run(tmp_path, checks=['timeout-discipline'])
+        assert _idents(report) == [
+            'timeout-discipline:serve/lb.py:stream-total-cap']
+
+    def test_explicit_timeouts_and_split_shape_ok(self, tmp_path):
+        _write(tmp_path, 'serve/good.py', '''\
+            import socket
+            import aiohttp
+            import requests
+            from urllib import request as urlrequest
+
+            def probe(url, t):
+                with urlrequest.urlopen(url, timeout=t) as r:
+                    return r.status
+
+            def stream(url):
+                # Explicit timeout=None: a deliberate unbounded choice.
+                return requests.get(url, timeout=None)
+
+            def connect(host, port):
+                return socket.create_connection((host, port), 5)
+
+            def make_session():
+                return aiohttp.ClientSession(
+                    timeout=aiohttp.ClientTimeout(
+                        total=None, connect=10, sock_read=120))
+        ''')
+        # Session without a session timeout is fine while every request
+        # carries its own (the sdk_async shape); ws_connect is exempt
+        # (long-lived by design).
+        _write(tmp_path, 'client/good_session.py', '''\
+            import aiohttp
+
+            async def call(url):
+                async with aiohttp.ClientSession() as session:
+                    async with session.get(
+                            url, timeout=aiohttp.ClientTimeout(
+                                total=30)) as r:
+                        return r.status
+
+            async def tunnel(url):
+                async with aiohttp.ClientSession() as session:
+                    return await session.ws_connect(url)
+        ''')
+        assert _run(tmp_path,
+                    checks=['timeout-discipline'])['total'] == 0
+
+    def test_compute_plane_and_requests_lib_exempt(self, tmp_path):
+        # models/ is out of scope; `requests_lib` is the server's
+        # request-record DB module, not the HTTP library.
+        _write(tmp_path, 'models/fetch.py', '''\
+            import requests
+
+            def download(url):
+                return requests.get(url)
+        ''')
+        _write(tmp_path, 'server/db.py', '''\
+            from skypilot_tpu.server import requests_lib
+
+            def load(request_id):
+                return requests_lib.get(request_id)
+        ''')
+        assert _run(tmp_path,
+                    checks=['timeout-discipline'])['total'] == 0
+
+
+class TestFailpointNamingChecker:
+
+    def test_dynamic_malformed_and_unguarded_flagged(self, tmp_path):
+        _write(tmp_path, 'serve/bad.py', '''\
+            from skypilot_tpu.utils import failpoints
+
+            def step(name):
+                if failpoints.ACTIVE:
+                    failpoints.fire('Engine.Step')      # bad casing
+                failpoints.fire(name)                   # dynamic + bare
+        ''')
+        report = _run(tmp_path, checks=['failpoint-naming'])
+        assert sorted(_idents(report)) == [
+            'failpoint-naming:serve/bad.py:<dynamic>:unguarded',
+            'failpoint-naming:serve/bad.py:Engine.Step',
+            'failpoint-naming:serve/bad.py:dynamic-name',
+        ]
+
+    def test_guarded_literal_sites_ok(self, tmp_path):
+        _write(tmp_path, 'serve/good.py', '''\
+            from skypilot_tpu.utils import failpoints as failpoints_lib
+
+            def step():
+                if failpoints_lib.ACTIVE:
+                    failpoints_lib.fire('engine.step')
+
+            def admit(flag):
+                if flag and failpoints_lib.ACTIVE:
+                    failpoints_lib.fire('engine.admit')
+        ''')
+        assert _run(tmp_path, checks=['failpoint-naming'])['total'] == 0
+
+    def test_else_branch_is_not_guarded(self, tmp_path):
+        # The orelse of the ACTIVE test runs when failpoints are OFF —
+        # a fire() there is both unguarded and dead.
+        _write(tmp_path, 'serve/orelse.py', '''\
+            from skypilot_tpu.utils import failpoints
+
+            def step():
+                if failpoints.ACTIVE:
+                    pass
+                else:
+                    failpoints.fire('engine.step')
+        ''')
+        report = _run(tmp_path, checks=['failpoint-naming'])
+        assert _idents(report) == [
+            'failpoint-naming:serve/orelse.py:engine.step:unguarded']
+
+
 # ------------------------------------------------------------ allowlist + report
 
 class TestAllowlistAndReport:
@@ -1159,7 +1320,7 @@ class TestLivePackage:
         with open(out_path, encoding='utf-8') as f:
             report = json.load(f)
         # Schema stability (version-bump ratchet).
-        assert report['skylint_version'] == core.REPORT_VERSION == 6
+        assert report['skylint_version'] == core.REPORT_VERSION == 7
         assert set(report) == {
             'skylint_version', 'root', 'files_scanned', 'checks',
             'violations', 'total', 'allowlisted', 'new',

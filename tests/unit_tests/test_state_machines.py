@@ -69,6 +69,20 @@ class TestTransitionTableRoundTrip:
             assert 'STARTING' not in \
                 state_machines.REPLICA_TRANSITIONS[name]
 
+    def test_draining_is_one_way_from_serving_states(self):
+        """The graceful-drain edges (docs/ROBUSTNESS.md): only serving
+        states may enter DRAINING, and nothing leaves it except
+        teardown/loss — un-draining would re-route traffic onto a
+        replica the controller promised to retire."""
+        table = state_machines.REPLICA_TRANSITIONS
+        assert 'DRAINING' in table['READY']
+        assert 'DRAINING' in table['NOT_READY']
+        for name in ('PROVISIONING', 'STARTING', 'FAILED',
+                     'PREEMPTED', 'SHUTTING_DOWN'):
+            assert 'DRAINING' not in table[name], name
+        assert table['DRAINING'] == {'FAILED', 'PREEMPTED',
+                                     'SHUTTING_DOWN'}
+
     def test_self_loops_always_legal(self):
         assert state_machines.can_transition(
             state_machines.JOB_TRANSITIONS, 'CANCELLED', 'CANCELLED')
