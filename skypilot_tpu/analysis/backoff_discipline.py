@@ -1,0 +1,108 @@
+"""Backoff discipline: no fixed-cadence retry sleeps in jobs//provision/.
+
+The jobs and provisioning planes retry against shared, failing
+resources — a cloud API that just 429'd, the zone that just preempted
+every spot slice in it, a wedged teardown. A retry loop that sleeps a
+CONSTANT between attempts synchronizes every recovering job into a
+thundering herd (they all failed together, so they all retry together,
+forever), and never backs off a persistently-failing dependency. The
+shared helper (``utils/backoff.py``: exponential growth, per-caller
+seeded jitter) exists precisely so no retry loop hand-rolls this.
+
+The static shape flagged here: a ``time.sleep(<constant>)`` call
+lexically inside an ``except`` handler that is itself inside a loop —
+the canonical retry-without-backoff pattern (``for attempt: try: ...
+except: time.sleep(5)``). "Constant" means a literal number or a name
+bound to a module-level literal (``RETRY_GAP_SECONDS = 20``); a sleep
+whose duration comes from a :class:`~skypilot_tpu.utils.backoff.Backoff`
+(or any computed value) passes. Plain poll loops — sleeps in a loop
+body outside any handler — are cadence, not retry, and are exempt.
+
+Scope: the ``jobs`` and ``provision`` units (plus their nested
+subpackages), where every retry target is a shared cloud resource.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from skypilot_tpu.analysis import core
+
+NAME = 'backoff-discipline'
+
+_UNITS = ('jobs', 'provision')
+
+
+def _module_constants(tree: ast.Module) -> Dict[str, ast.Constant]:
+    """Module-level ``NAME = <number literal>`` bindings."""
+    out: Dict[str, ast.Constant] = {}
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and
+                isinstance(node.value, ast.Constant) and
+                isinstance(node.value.value, (int, float))):
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    out[target.id] = node.value
+    return out
+
+
+def _is_time_sleep(call: ast.Call) -> bool:
+    func = call.func
+    return (isinstance(func, ast.Attribute) and func.attr == 'sleep' and
+            isinstance(func.value, ast.Name) and func.value.id == 'time')
+
+
+def _const_desc(arg: ast.expr,
+                constants: Dict[str, ast.Constant]) -> Optional[str]:
+    """A printable description when `arg` is a constant-cadence sleep
+    duration; None when the duration is computed (backoff-shaped)."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, (int, float)):
+        return repr(arg.value)
+    if isinstance(arg, ast.Name) and arg.id in constants:
+        return arg.id
+    return None
+
+
+def run(mod: core.ModuleInfo) -> List[core.Violation]:
+    if mod.unit not in _UNITS and not any(
+            mod.path.startswith(u + '/') for u in _UNITS):
+        return []
+    constants = _module_constants(mod.tree)
+    out: List[core.Violation] = []
+
+    def visit(node: ast.AST, in_loop: bool, in_retry: bool,
+              func: str) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # A nested def is a fresh lexical scope: its body does not
+            # execute inside the enclosing handler.
+            for child in node.body:
+                visit(child, False, False, node.name)
+            return
+        if isinstance(node, (ast.For, ast.While, ast.AsyncFor)):
+            for child in node.body:
+                visit(child, True, in_retry, func)
+            for child in node.orelse:
+                visit(child, in_loop, in_retry, func)
+            return
+        if isinstance(node, ast.ExceptHandler):
+            for child in node.body:
+                visit(child, in_loop, in_loop or in_retry, func)
+            return
+        if (isinstance(node, ast.Call) and in_retry and
+                _is_time_sleep(node) and node.args):
+            desc = _const_desc(node.args[0], constants)
+            if desc is not None:
+                out.append(core.Violation(
+                    check=NAME, path=mod.path, line=node.lineno,
+                    col=node.col_offset, key=f'{func}:{desc}',
+                    message=(
+                        f'fixed-cadence retry sleep time.sleep({desc}) '
+                        f'inside an except handler in a loop — '
+                        f'synchronized retries herd against whatever '
+                        f'just failed; use utils/backoff.Backoff '
+                        f'(exponential + seeded jitter) instead')))
+        for child in ast.iter_child_nodes(node):
+            visit(child, in_loop, in_retry, func)
+
+    visit(mod.tree, False, False, '<module>')
+    return out

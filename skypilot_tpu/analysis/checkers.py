@@ -8,6 +8,7 @@ from __future__ import annotations
 from typing import Callable, List, Optional, Sequence, Tuple
 
 from skypilot_tpu.analysis import async_blocking
+from skypilot_tpu.analysis import backoff_discipline
 from skypilot_tpu.analysis import core
 from skypilot_tpu.analysis import failpoint_naming
 from skypilot_tpu.analysis import host_sync_loops
@@ -40,6 +41,7 @@ ALL: List[Tuple[str, CheckerFn]] = [
     (span_discipline.NAME, span_discipline.run),
     (timeout_discipline.NAME, timeout_discipline.run),
     (failpoint_naming.NAME, failpoint_naming.run),
+    (backoff_discipline.NAME, backoff_discipline.run),
 ]
 
 

@@ -26,6 +26,7 @@ from skypilot_tpu.backends import backend as backend_lib
 from skypilot_tpu.provision import common as provision_common
 from skypilot_tpu.provision import provisioner as provisioner_lib
 from skypilot_tpu.utils import command_runner as command_runner_lib
+from skypilot_tpu.utils import failpoints
 from skypilot_tpu.utils import locks
 from skypilot_tpu.utils import subprocess_utils
 from skypilot_tpu.utils import timeline
@@ -295,6 +296,11 @@ class TpuSliceBackend(backend_lib.Backend[SliceResourceHandle]):
     @timeline.event
     def setup(self, handle: SliceResourceHandle, task: 'task_lib.Task',
               detach_setup: bool = False) -> None:
+        if failpoints.ACTIVE:
+            # A firing surfaces as a setup failure mid-launch: first
+            # launches class it FAILED_PRECHECKS, recovery rounds class
+            # it like any other failed attempt (backoff + failover).
+            failpoints.fire('jobs.setup')
         if task.setup is None:
             return
         runners = self._runners(handle)

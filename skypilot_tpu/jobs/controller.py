@@ -30,6 +30,7 @@ from skypilot_tpu import task as task_lib
 from skypilot_tpu.backends import slice_backend
 from skypilot_tpu.jobs import recovery_strategy
 from skypilot_tpu.jobs import state
+from skypilot_tpu.utils import failpoints
 from skypilot_tpu.utils.status_lib import JobStatus
 
 logger = sky_logging.init_logger(__name__)
@@ -90,6 +91,15 @@ class JobsController:
 
     def _cluster_alive(self) -> bool:
         """Cloud-truth liveness of the job's slice (preemption detector)."""
+        if failpoints.ACTIVE:
+            # Deterministic preemption injection: a firing is classed
+            # exactly like a dead slice, so a chaos schedule drives the
+            # real RECOVERING -> recover() -> RECOVERED containment arc
+            # without touching a cloud.
+            try:
+                failpoints.fire('jobs.preempt')
+            except failpoints.FailpointError:
+                return False
         record = global_state.get_cluster(self.cluster_name)
         if record is None:
             return False

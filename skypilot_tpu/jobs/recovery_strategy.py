@@ -21,6 +21,10 @@ from skypilot_tpu import exceptions
 from skypilot_tpu import sky_logging
 from skypilot_tpu.backends import slice_backend
 from skypilot_tpu.jobs import state
+from skypilot_tpu.observe import journal as journal_lib
+from skypilot_tpu.observe import metrics as metrics_lib
+from skypilot_tpu.utils import backoff as backoff_lib
+from skypilot_tpu.utils import failpoints
 from skypilot_tpu.utils import registry
 
 if typing.TYPE_CHECKING:
@@ -35,11 +39,34 @@ class JobCancelledDuringRecovery(Exception):
     """Raised out of recover() when the user cancels mid-failover, so the
     controller can stop burning provisioning attempts immediately."""
 
-# Gap between failed relaunch attempts while recovering. Tests shrink this.
+# Base gap between failed relaunch attempts while recovering — grows
+# exponentially with per-job seeded jitter (utils/backoff.py), capped at
+# RETRY_GAP_CAP_SECONDS. Tests shrink these via the env knobs below.
 RETRY_GAP_SECONDS = 20
+RETRY_GAP_CAP_SECONDS = 300
 # Max full failover rounds while recovering before giving up; None = forever
 # (the reference retries forever; we bound it but keep it high).
 MAX_RECOVERY_ROUNDS = 720
+
+# Recovery budget knobs (read per recover() call so tests/operators can
+# retune without a controller restart):
+#   SKYTPU_JOBS_RECOVERY_MAX_ROUNDS    max failover rounds (default 720)
+#   SKYTPU_JOBS_RECOVERY_BUDGET_SECONDS  wall-clock budget for one
+#       recovery, 0 = unlimited (default 0)
+#   SKYTPU_JOBS_RECOVERY_BASE_SECONDS / _CAP_SECONDS  backoff shape
+_MAX_ROUNDS_ENV = 'SKYTPU_JOBS_RECOVERY_MAX_ROUNDS'
+_BUDGET_ENV = 'SKYTPU_JOBS_RECOVERY_BUDGET_SECONDS'
+_BASE_ENV = 'SKYTPU_JOBS_RECOVERY_BASE_SECONDS'
+_CAP_ENV = 'SKYTPU_JOBS_RECOVERY_CAP_SECONDS'
+
+_RECOVERY_ATTEMPTS = metrics_lib.counter(
+    'skytpu_jobs_recovery_attempts_total',
+    'Managed-job recovery relaunch attempts, by outcome.',
+    labels={'outcome': ('ok', 'no_capacity', 'fault')})
+_RECOVERY_SECONDS = metrics_lib.histogram(
+    'skytpu_jobs_recovery_seconds',
+    'Wall-clock duration of one full recovery (cluster lost -> '
+    'relaunched), failover strategies only.')
 
 
 class StrategyExecutor:
@@ -109,6 +136,8 @@ class StrategyExecutor:
                 r.copy(**resources_override) for r in task.resources_list()
             ]
             task.set_resources(new_res if len(new_res) > 1 else new_res[0])
+        if failpoints.ACTIVE:
+            failpoints.fire('jobs.launch')
         job_id, handle = execution.launch(
             task, cluster_name=self.cluster_name, detach_run=True,
             blocked_resources=blocked_resources)
@@ -119,10 +148,19 @@ class StrategyExecutor:
     def terminate_cluster(self, max_retries: int = 3) -> None:
         """Delete the job's slice. Preempted spot TPUs MUST be deleted
         before a relaunch can reuse the name (clouds/gcp.py:1095-1101);
-        termination of an already-gone cluster is a no-op."""
+        termination of an already-gone cluster is a no-op. Retries ride
+        the shared jittered backoff; the FINAL failure is journaled with
+        its failure class — a leaked slice blocks name reuse at the next
+        relaunch and keeps billing, so the evidence must outlive this
+        process."""
         from skypilot_tpu import global_state
+        retry_backoff = backoff_lib.Backoff(base=1.0, cap=10.0,
+                                            seed=self.job_id)
+        last_exc: Optional[BaseException] = None
         for attempt in range(max_retries):
             try:
+                if failpoints.ACTIVE:
+                    failpoints.fire('jobs.terminate')
                 record = global_state.get_cluster(self.cluster_name)
                 if record is None:
                     return
@@ -131,27 +169,105 @@ class StrategyExecutor:
                 self.backend.teardown(handle, terminate=True)
                 return
             except Exception as e:  # pylint: disable=broad-except
-                if attempt == max_retries - 1:
-                    logger.warning(
-                        f'Failed to terminate {self.cluster_name}: {e}')
-                    return
-                time.sleep(min(2 ** attempt, 10))
+                last_exc = e
+                if attempt < max_retries - 1:
+                    retry_backoff.sleep()
+        failure_reason = f'{type(last_exc).__name__}: {last_exc}'
+        logger.warning(f'Failed to terminate {self.cluster_name} after '
+                       f'{max_retries} attempts: {failure_reason}')
+        journal_lib.record_event(
+            'jobs_terminate_failed', entity=str(self.job_id),
+            reason=failure_reason,
+            data={'cluster': self.cluster_name, 'attempts': max_retries,
+                  'failure_class': type(last_exc).__name__})
 
     def _check_cancel(self) -> None:
         if self.job_id and state.cancel_was_requested(self.job_id):
             raise JobCancelledDuringRecovery(
                 f'job {self.job_id} cancelled during recovery')
 
+    def _recovery_attempt(self, round_idx: int, phase: str,
+                          target: dict, **launch_kwargs) -> Optional[int]:
+        """One journaled relaunch attempt. Injected faults
+        (FailpointError out of jobs.launch/jobs.setup) are classed and
+        re-raised as no-capacity so the loop's containment — backoff,
+        budget, failover — applies to them identically."""
+        t0 = time.monotonic()
+        outcome = 'error'
+        try:
+            result = self._launch_once(**launch_kwargs)
+            outcome = 'ok'
+            return result
+        except exceptions.ResourcesUnavailableError:
+            outcome = 'no_capacity'
+            raise
+        except failpoints.FailpointError as e:
+            outcome = 'fault'
+            raise exceptions.ResourcesUnavailableError(
+                f'injected fault: {e}') from e
+        finally:
+            if outcome in ('ok', 'no_capacity', 'fault'):
+                # 'error' (an unexpected exception class) is journaled
+                # below but kept out of the bounded metric label set.
+                _RECOVERY_ATTEMPTS.inc(outcome=outcome)
+            landed = self.handle if outcome == 'ok' else None
+            journal_lib.record_event(
+                'jobs_recovery_attempt', entity=str(self.job_id),
+                data={'round': round_idx + 1, 'phase': phase,
+                      'outcome': outcome,
+                      'duration': round(time.monotonic() - t0, 3),
+                      'target': target,
+                      'zone': landed.zone if landed is not None else None,
+                      'region': (landed.region if landed is not None
+                                 else None)})
+
     def _relaunch_with_failover(
             self, try_same_placement_first: bool) -> Optional[int]:
         """Shared recovery loop: optional same-placement fast path, then
-        avoid-the-preempted-region, then unconstrained, retrying with a gap
-        until something lands. Aborts promptly on user cancel."""
+        avoid-the-preempted-region, then unconstrained, retrying under
+        an exponential per-job-jittered backoff and a bounded budget
+        (rounds + optional wall-clock) until something lands. Every
+        attempt is journaled with its placement target and outcome;
+        aborts promptly on user cancel."""
+        t_recover = time.monotonic()
+        result = self._failover_rounds(try_same_placement_first)
+        _RECOVERY_SECONDS.observe(time.monotonic() - t_recover)
+        return result
+
+    def _failover_rounds(
+            self, try_same_placement_first: bool) -> Optional[int]:
         launched_cloud = self.handle.cloud if self.handle else None
         launched_region = self.handle.region if self.handle else None
         launched_zone = self.handle.zone if self.handle else None
-        for round_idx in range(MAX_RECOVERY_ROUNDS):
+        max_rounds = int(os.environ.get(_MAX_ROUNDS_ENV,
+                                        str(MAX_RECOVERY_ROUNDS)))
+        budget_seconds = float(os.environ.get(_BUDGET_ENV, '0'))
+        retry_backoff = backoff_lib.Backoff(
+            base=float(os.environ.get(_BASE_ENV, str(RETRY_GAP_SECONDS))),
+            cap=float(os.environ.get(_CAP_ENV, str(RETRY_GAP_CAP_SECONDS))),
+            seed=self.job_id)
+        t_start = time.monotonic()
+
+        def _exhausted(why: str, rounds: int
+                       ) -> exceptions.ManagedJobReachedMaxRetriesError:
+            msg = (f'Recovery of job {self.job_id} gave up: {why} '
+                   f'(rounds={rounds}, elapsed='
+                   f'{time.monotonic() - t_start:.1f}s).')
+            journal_lib.record_event(
+                'jobs_recovery_exhausted', entity=str(self.job_id),
+                reason=why,
+                data={'rounds': rounds,
+                      'elapsed': round(time.monotonic() - t_start, 3),
+                      'budget_seconds': budget_seconds,
+                      'max_rounds': max_rounds})
+            return exceptions.ManagedJobReachedMaxRetriesError(msg)
+
+        for round_idx in range(max_rounds):
             self._check_cancel()
+            if budget_seconds and time.monotonic() - t_start > budget_seconds:
+                raise _exhausted(
+                    f'recovery budget of {budget_seconds:.0f}s exhausted',
+                    round_idx)
             # The dead slice blocks name reuse: always delete first.
             self.terminate_cluster()
             if try_same_placement_first and launched_region is not None:
@@ -160,11 +276,15 @@ class StrategyExecutor:
                 try:
                     # Pin cloud too: region/zone names only validate against
                     # the cloud that owns them.
-                    return self._launch_once(resources_override={
-                        'cloud': launched_cloud,
-                        'region': launched_region,
-                        'zone': launched_zone,
-                    })
+                    return self._recovery_attempt(
+                        round_idx, 'same_placement',
+                        {'cloud': launched_cloud, 'region': launched_region,
+                         'zone': launched_zone},
+                        resources_override={
+                            'cloud': launched_cloud,
+                            'region': launched_region,
+                            'zone': launched_zone,
+                        })
                 except exceptions.ResourcesUnavailableError:
                     logger.info(
                         f'[job {self.job_id}] same-placement relaunch in '
@@ -178,7 +298,10 @@ class StrategyExecutor:
                 blocked = [resources_lib.Resources(cloud=launched_cloud,
                                                    region=launched_region)]
                 try:
-                    return self._launch_once(
+                    return self._recovery_attempt(
+                        round_idx, 'blocked_region',
+                        {'blocked_cloud': launched_cloud,
+                         'blocked_region': launched_region},
                         resources_override={'region': None, 'zone': None},
                         blocked_resources=blocked)
                 except exceptions.ResourcesUnavailableError:
@@ -189,18 +312,17 @@ class StrategyExecutor:
             self._check_cancel()
             try:
                 # Unconstrained: let the optimizer pick anywhere feasible.
-                return self._launch_once(resources_override={
-                    'region': None, 'zone': None,
-                })
+                return self._recovery_attempt(
+                    round_idx, 'unconstrained', {},
+                    resources_override={'region': None, 'zone': None})
             except exceptions.ResourcesUnavailableError:
+                gap = retry_backoff.next()
                 logger.info(
                     f'[job {self.job_id}] recovery round {round_idx + 1} '
-                    f'found no capacity anywhere; retrying in '
-                    f'{RETRY_GAP_SECONDS}s.')
-                time.sleep(RETRY_GAP_SECONDS)
-        raise exceptions.ManagedJobReachedMaxRetriesError(
-            f'Recovery of job {self.job_id} gave up after '
-            f'{MAX_RECOVERY_ROUNDS} failover rounds.')
+                    f'found no capacity anywhere; retrying in {gap:.1f}s.')
+                time.sleep(gap)
+        raise _exhausted(f'no capacity after {max_rounds} failover rounds',
+                         max_rounds)
 
 
 class PoolStrategyExecutor(StrategyExecutor):

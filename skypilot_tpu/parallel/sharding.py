@@ -10,9 +10,10 @@ The reference has no analog (parallelism lives in launched recipes, SURVEY
 """
 from __future__ import annotations
 
-from typing import Dict, Optional, Sequence, Tuple, Union
+from typing import Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 AxisRule = Tuple[str, Union[None, str, Tuple[str, ...]]]
@@ -127,6 +128,64 @@ def tree_shardings(mesh: Mesh, spec_tree):
         lambda s: NamedSharding(mesh, s),
         spec_tree,
         is_leaf=lambda s: isinstance(s, PartitionSpec))
+
+
+def spec_to_json(spec: PartitionSpec) -> List[Union[None, str, List[str]]]:
+    """PartitionSpec → JSON-serializable form: per-dim ``None`` (no
+    sharding), a mesh-axis name, or a list of names.
+
+    This is the *logical* half of a sharding — the named-axis layout
+    with no device assignment — which is what a topology-independent
+    checkpoint records: a spec like ``['fsdp', None]`` is meaningful on
+    a 2×4 mesh, a 1×8 mesh, or a single host, while a device list is
+    meaningful only on the exact slice that wrote it.
+    """
+    out: List[Union[None, str, List[str]]] = []
+    for entry in spec:
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, str):
+            out.append(entry)
+        else:
+            out.append([str(ax) for ax in entry])
+    return out
+
+
+def spec_from_json(entries: Sequence[Union[None, str, Sequence[str]]]
+                   ) -> PartitionSpec:
+    """Inverse of :func:`spec_to_json`."""
+    parts = []
+    for entry in entries:
+        if entry is None or isinstance(entry, str):
+            parts.append(entry)
+        else:
+            parts.append(tuple(entry))
+    return PartitionSpec(*parts)
+
+
+def host_to_sharded(host_array: 'np.ndarray',
+                    sharding: NamedSharding) -> jax.Array:
+    """Place a host array onto devices per `sharding`, slicing per-device
+    shards from the host buffer (``jax.make_array_from_callback``) —
+    each device reads exactly its shard, so placement cost does not
+    grow with mesh size. The resharding primitive of checkpoint
+    restore: the host array is topology-neutral, the sharding belongs
+    to whatever mesh recovery landed on."""
+    return jax.make_array_from_callback(
+        host_array.shape, sharding, lambda idx: host_array[idx])
+
+
+def sharded_to_host(arr: jax.Array) -> 'np.ndarray':
+    """Gather a (possibly sharded) array fully to host memory.
+
+    Fully-addressable arrays (single-process: always) copy directly;
+    multi-process arrays fall back to a DCN allgather so every host
+    holds the full value. This is the checkpoint-restore fallback for
+    callers that need whole arrays rather than per-shard slices."""
+    if getattr(arr, 'is_fully_addressable', True):
+        return np.asarray(jax.device_get(arr))
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
 
 
 def shardings_like(mesh: Mesh, spec_tree, shape_tree):

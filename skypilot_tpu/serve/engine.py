@@ -42,7 +42,7 @@ TPU-first design:
     that reject, not replicas that silently queue into SLO death).
     /metrics exposes queue depth / in-flight / step counters.
   - **Checkpoint loading**: --ckpt-dir restores trainer checkpoints
-    (orbax, train/checkpoints.py) so `skytpu jobs launch` training and
+    (train/checkpoints.py) so `skytpu jobs launch` training and
     `skytpu serve up` serving share weights end-to-end.
 
 Run: python -m skypilot_tpu.serve.engine --model llama-1b --port 8000
@@ -648,12 +648,11 @@ class InferenceEngine:
             tx = train_lib.default_optimizer(learning_rate=1e-4,
                                              warmup_steps=1, total_steps=2)
             with checkpoints.Checkpointer(ckpt_dir) as ckpt:
-                state = ckpt.restore(self.cfg, mesh, tx)
-                if state is None:
-                    raise FileNotFoundError(
-                        f'No checkpoint under {ckpt_dir!r}.')
+                # restore() raises FileNotFoundError when the directory
+                # holds no complete step.
+                state, step = ckpt.restore(self.cfg, mesh, tx)
                 params = state.params
-            logger.info(f'Restored checkpoint step {int(state.step)} '
+            logger.info(f'Restored checkpoint step {step} '
                         f'from {ckpt_dir}.')
         elif not hf_dir:
             mod = module_for(self.cfg)

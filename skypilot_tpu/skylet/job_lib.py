@@ -19,6 +19,7 @@ from typing import Any, Dict, List, Optional
 
 from skypilot_tpu.observe import journal as journal_lib
 from skypilot_tpu.skylet import constants
+from skypilot_tpu.utils import failpoints
 from skypilot_tpu.utils import sqlite_utils
 from skypilot_tpu.utils.status_lib import JobStatus
 
@@ -75,6 +76,11 @@ def _conn() -> sqlite3.Connection:
 
 def add_job(job_name: str, username: str, run_cmd: str,
             num_hosts: int) -> int:
+    if failpoints.ACTIVE:
+        # On-cluster submission fault: exec fails before a job row
+        # exists, so the caller's launch/exec error path (not the
+        # monitor) owns containment — same class as a dead skylet.
+        failpoints.fire('skylet.job_submit')
     with _conn() as conn:
         cur = conn.execute(
             'INSERT INTO jobs (job_name, username, submitted_at, status, '

@@ -1,37 +1,84 @@
-"""Sharded checkpoint save/restore for TrainState (async, mesh-aware).
+"""Topology-independent sharded checkpoints for TrainState pytrees.
 
 The reference has no native checkpointing — its contract is "write to a
 mounted bucket, flush before exit" (sky/backends/cloud_vm_ray_backend.py:
 763-790 MOUNT_CACHED flush barrier; llm/llama-3_1-finetuning/lora.yaml:26-31
 writes checkpoints to a MOUNTed /output). This framework owns the trainer,
-so checkpointing is native: orbax per-shard save where every host writes
-exactly its addressable shards (no gather — HBM and DCN stay quiet), async
-so the save overlaps the next train steps, and restore materialises arrays
-directly with the target mesh's NamedShardings.
+so checkpointing is native, and built for the managed-jobs preemption
+contract (jobs/controller.py + recovery_strategy.py): a preempted job's
+recovery may land on a *different* slice topology, so the on-disk format
+records the logical axis layout (named mesh axes per array dim), never the
+physical device assignment. A checkpoint written on a 2×4 mesh restores
+onto 1×8, 4×2, or a single host: every array is reassembled on host from
+its chunk files and re-sliced per-device through
+``jax.make_array_from_callback`` against the *current* mesh's shardings
+(parallel/sharding.py host_to_sharded).
 
-The managed-jobs recovery contract (jobs/controller.py) composes with this:
-point `--ckpt-dir` at the job's storage mount, and a recovered job resumes
-from `latest_step()` instead of step 0.
+Durability contract (what a preemption mid-save can and cannot do):
+
+  * every step writes into a hidden temp dir and is renamed into place
+    only after its MANIFEST.json (per-array tree path, shape, dtype,
+    logical spec, and per-chunk sha256 content digests) is durable —
+    a killed save leaves a manifest-less temp dir that ``latest_step``
+    can never see, never a half step;
+  * restore verifies every chunk digest; a truncated or bit-flipped
+    file raises :class:`CheckpointCorruptError` instead of silently
+    restoring garbage;
+  * :func:`restore_or_init` refuses corrupt steps LOUDLY and falls back
+    to the newest older complete step; if steps exist but none restores
+    it raises rather than silently reinitializing (that would be data
+    loss dressed up as a fresh run).
+
+Format (one directory per step)::
+
+    <dir>/step_00000012/
+        MANIFEST.json
+        arrays/a0003.c00.npy     # one .npy per addressable chunk
+
+On multi-host slices every process writes only its own replica-0 shards
+plus a per-process chunk index; process 0 merges the indexes into the
+manifest and performs the rename after a global barrier.
 """
 from __future__ import annotations
 
+import hashlib
+import io
+import json
 import os
-from typing import Any, Optional, Tuple
+import queue
+import re
+import shutil
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
+import numpy as np
 import optax
-import orbax.checkpoint as ocp
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.parallel import sharding as sharding_lib
 from skypilot_tpu.train import train_lib
+from skypilot_tpu.utils import failpoints
 
 logger = sky_logging.init_logger(__name__)
+
+FORMAT_VERSION = 2
+MANIFEST_NAME = 'MANIFEST.json'
+_STEP_RE = re.compile(r'^step_(\d{8})$')
+_TMP_PREFIX = '.tmp-step_'
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A step directory exists but cannot be restored faithfully:
+    malformed/missing manifest, missing chunk files, digest mismatch,
+    or chunk coverage that does not tile the array."""
 
 
 def abstract_train_state(cfg, mesh, tx: optax.GradientTransformation,
                          rules=None) -> train_lib.TrainState:
     """TrainState-shaped tree of ShapeDtypeStructs carrying NamedShardings —
-    the restore target that tells orbax how to place every shard."""
+    the restore target that tells the loader how to place every shard."""
     import functools
     from skypilot_tpu import models as models_lib
     shardings = train_lib.state_shardings(cfg, mesh, tx, rules)
@@ -49,68 +96,589 @@ def abstract_train_state(cfg, mesh, tx: optax.GradientTransformation,
         shapes, shardings)
 
 
+# ---------------------------------------------------------------- helpers
+
+def _step_dirname(step: int) -> str:
+    return f'step_{step:08d}'
+
+
+def _flatten_with_paths(tree) -> List[Tuple[str, Any]]:
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    return [(jax.tree_util.keystr(kp), leaf) for kp, leaf in flat]
+
+
+def _npy_bytes(array: np.ndarray) -> bytes:
+    buf = io.BytesIO()
+    array = np.asarray(array)
+    if array.ndim > 0:
+        # NOT on 0-d: ascontiguousarray promotes scalars to shape (1,).
+        array = np.ascontiguousarray(array)
+    np.save(buf, array, allow_pickle=False)
+    return buf.getvalue()
+
+
+def _sha256(data: bytes) -> str:
+    return hashlib.sha256(data).hexdigest()
+
+
+def _leaf_chunks(leaf) -> List[Dict[str, Any]]:
+    """Snapshot this process's owned shards of one leaf to host memory.
+
+    Each distinct array slice is written by exactly one process (the
+    one holding its replica-0 shard), so the union over processes tiles
+    the array with no duplicate writers. Plain numpy/python leaves are
+    a single full chunk owned by process 0.
+    """
+    chunks: List[Dict[str, Any]] = []
+    if isinstance(leaf, jax.Array) and hasattr(leaf, 'addressable_shards'):
+        for shard in leaf.addressable_shards:
+            if shard.replica_id != 0:
+                continue
+            start = [0 if sl.start is None else int(sl.start)
+                     for sl in shard.index]
+            data = np.asarray(jax.device_get(shard.data))
+            chunks.append({'start': start, 'data': data})
+    else:
+        if jax.process_index() == 0:
+            data = np.asarray(leaf)
+            chunks.append({'start': [0] * data.ndim, 'data': data})
+    return chunks
+
+
+def _leaf_spec_json(leaf) -> Optional[List[Any]]:
+    sharding = getattr(leaf, 'sharding', None)
+    spec = getattr(sharding, 'spec', None)
+    if spec is None:
+        return None
+    return sharding_lib.spec_to_json(spec)
+
+
+# ---------------------------------------------------------------- writer
+
+class _SaveJob:
+    """A host-side snapshot of one step, ready for (async) file IO."""
+
+    def __init__(self, step: int, arrays: List[Dict[str, Any]],
+                 mesh_axes: Optional[Dict[str, int]]):
+        self.step = step
+        self.arrays = arrays          # [{path, shape, dtype, spec, chunks}]
+        self.mesh_axes = mesh_axes
+
+
 class Checkpointer:
-    """Thin, opinionated wrapper over an orbax CheckpointManager."""
+    """Step-directory checkpoint manager with atomic completes.
+
+    Single writer per directory (the trainer contract); saves are async
+    by default — arrays are snapshotted to host synchronously (so the
+    caller may donate/mutate state immediately) and file IO proceeds on
+    a background thread. ``wait()`` is the exit flush barrier (the
+    native analog of the reference's MOUNT_CACHED flush-before-exit).
+    """
 
     def __init__(self, directory: str, *, max_to_keep: int = 3,
                  async_save: bool = True, keep_period: Optional[int] = None):
         self.directory = os.path.abspath(os.path.expanduser(directory))
         os.makedirs(self.directory, exist_ok=True)
-        self._mngr = ocp.CheckpointManager(
-            self.directory,
-            options=ocp.CheckpointManagerOptions(
-                max_to_keep=max_to_keep,
-                keep_period=keep_period,
-                enable_async_checkpointing=async_save,
-            ))
+        self.max_to_keep = max_to_keep
+        self.keep_period = keep_period
+        self._async = async_save
+        self._queue: 'queue.Queue[Optional[_SaveJob]]' = queue.Queue()
+        self._worker: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._error_lock = threading.Lock()
+        # Stale-tmp sweeping happens on the WRITE path (first save), not
+        # here: a restore-only Checkpointer opened on a live training
+        # directory must never delete the trainer's in-progress save.
+        self._swept_stale = False
 
     # ------------------------------------------------------------------
-    def save(self, state: train_lib.TrainState,
-             step: Optional[int] = None, *, wait: bool = False) -> int:
-        """Async by default: returns as soon as arrays are snapshotted;
-        the write proceeds while training continues."""
+    def save(self, state, step: Optional[int] = None, *,
+             wait: bool = False) -> int:
+        """Snapshot `state` and persist it as `step`. Async by default:
+        returns as soon as arrays are snapshotted to host; the write +
+        atomic rename proceed while training continues."""
+        self._raise_pending_error()
         if step is None:
             step = int(jax.device_get(state.step))
-        self._mngr.save(step, args=ocp.args.PyTreeSave(state))
+        # Drain the in-flight save FIRST, for both paths: it bounds the
+        # backlog to one host-memory snapshot at a time (a slow disk
+        # under a short time-cadence must not accumulate full TrainState
+        # copies until OOM), and it serializes a synchronous save of a
+        # step the worker is currently writing (same deterministic tmp
+        # dir — concurrent writers would race on the rename).
+        self._queue.join()
+        self._raise_pending_error()
+        job = self._snapshot(state, step)
+        if self._async and not wait and jax.process_count() == 1:
+            self._ensure_worker()
+            self._queue.put(job)
+        else:
+            # Synchronous: multi-process saves barrier inside and must
+            # not skew across hosts by queueing behind unrelated IO.
+            self._write_step(job)
         if wait:
-            self._mngr.wait_until_finished()
+            self.wait()
         return step
 
+    def _snapshot(self, state, step: int) -> _SaveJob:
+        arrays = []
+        mesh_axes: Optional[Dict[str, int]] = None
+        for path, leaf in _flatten_with_paths(state):
+            sharding = getattr(leaf, 'sharding', None)
+            mesh = getattr(sharding, 'mesh', None)
+            if mesh_axes is None and mesh is not None:
+                try:
+                    mesh_axes = {str(k): int(v)
+                                 for k, v in dict(mesh.shape).items()}
+                except (TypeError, AttributeError):
+                    mesh_axes = None
+            dtype = (leaf.dtype if isinstance(leaf, jax.Array)
+                     else np.asarray(leaf).dtype)
+            arrays.append({
+                'path': path,
+                'shape': [int(d) for d in np.shape(leaf)],
+                'dtype': str(dtype),
+                'spec': _leaf_spec_json(leaf),
+                'chunks': _leaf_chunks(leaf),
+            })
+        return _SaveJob(step, arrays, mesh_axes)
+
+    # ------------------------------------------------------------------
+    def _ensure_worker(self) -> None:
+        if self._worker is not None and self._worker.is_alive():
+            return
+        self._worker = threading.Thread(
+            target=self._worker_loop, name='ckpt-writer', daemon=True)
+        self._worker.start()
+
+    def _worker_loop(self) -> None:
+        while True:
+            job = self._queue.get()
+            if job is None:
+                # Account for the sentinel too: a missed task_done here
+                # leaves join() blocking forever on the next wait()/
+                # close() after shutdown.
+                self._queue.task_done()
+                return
+            try:
+                self._write_step(job)
+            except BaseException as e:  # pylint: disable=broad-except
+                # Surfaces at the next save()/wait()/close(): a failed
+                # async save must not be silently droppable.
+                with self._error_lock:
+                    if self._error is None:
+                        self._error = e
+                logger.error(f'async checkpoint save of step {job.step} '
+                             f'failed: {e}')
+            finally:
+                self._queue.task_done()
+
+    def _raise_pending_error(self) -> None:
+        with self._error_lock:
+            err, self._error = self._error, None
+        if err is not None:
+            raise err
+
+    # ------------------------------------------------------------------
+    def _tmp_dir(self, step: int) -> str:
+        # Deterministic (no pid): on multi-host shared storage every
+        # process must write into the SAME in-progress dir.
+        return os.path.join(self.directory, f'{_TMP_PREFIX}{step:08d}')
+
+    def _clean_stale_tmp(self) -> None:
+        for name in os.listdir(self.directory):
+            if name.startswith(_TMP_PREFIX):
+                logger.warning(
+                    f'Removing stale in-progress checkpoint {name!r} '
+                    f'(a previous save was killed mid-write; the step '
+                    f'was never completed and cannot be restored).')
+                shutil.rmtree(os.path.join(self.directory, name),
+                              ignore_errors=True)
+
+    def _write_step(self, job: _SaveJob) -> None:
+        final_dir = os.path.join(self.directory, _step_dirname(job.step))
+        if os.path.isdir(final_dir):
+            logger.debug(f'checkpoint step {job.step} already complete; '
+                         f'skipping re-save.')
+            return
+        tmp_dir = self._tmp_dir(job.step)
+        if jax.process_count() > 1:
+            # Shared storage: only process 0 clears debris, and every
+            # process waits for it before writing into the shared dir.
+            if jax.process_index() == 0 and os.path.isdir(tmp_dir):
+                shutil.rmtree(tmp_dir, ignore_errors=True)
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(
+                f'skytpu_ckpt_begin_{job.step}')
+        else:
+            if not self._swept_stale:
+                self._swept_stale = True
+                self._clean_stale_tmp()
+            elif os.path.isdir(tmp_dir):
+                # A previous crashed save of THIS step: its leftover
+                # chunk files must not leak into the new manifest's dir.
+                shutil.rmtree(tmp_dir, ignore_errors=True)
+        # Chunk names carry the process index: on multi-host shared
+        # storage every process writes its own shards into the SAME
+        # temp dir, and per-process local chunk counters would collide.
+        proc = jax.process_index()
+        manifest_arrays = []
+        write_error: Optional[BaseException] = None
+        try:
+            os.makedirs(os.path.join(tmp_dir, 'arrays'), exist_ok=True)
+            for i, rec in enumerate(job.arrays):
+                stem = f'a{i:04d}'
+                chunk_records = []
+                for j, chunk in enumerate(rec['chunks']):
+                    fname = f'arrays/{stem}.p{proc:04d}.c{j:02d}.npy'
+                    data = _npy_bytes(chunk['data'])
+                    with open(os.path.join(tmp_dir, fname), 'wb') as f:
+                        f.write(data)
+                    chunk_records.append({
+                        'file': fname,
+                        'start': chunk['start'],
+                        'shape': [int(d) for d in chunk['data'].shape],
+                        'sha256': _sha256(data),
+                    })
+                manifest_arrays.append({
+                    'path': rec['path'], 'shape': rec['shape'],
+                    'dtype': rec['dtype'], 'spec': rec['spec'],
+                    'chunks': chunk_records,
+                })
+        except OSError as e:
+            if jax.process_count() == 1:
+                raise
+            # Multi-host: a one-sided raise here would leave every peer
+            # blocked in the barrier below. Carry the error TO the
+            # barrier instead; everyone aborts together.
+            write_error = e
+
+        if jax.process_count() > 1:
+            # Every process contributes its chunk index; process 0
+            # merges after the barrier so the manifest covers ALL
+            # shards, with digests computed by whoever wrote each file.
+            # The barrier doubles as failure propagation: a process
+            # whose IO failed still REACHES it (we got here, so ours
+            # succeeded — peers report theirs), because a one-sided
+            # raise would leave the other hosts blocked forever.
+            index_path = os.path.join(
+                tmp_dir, f'chunks.p{jax.process_index():04d}.json')
+            if write_error is None:
+                try:
+                    with open(index_path, 'w', encoding='utf-8') as f:
+                        json.dump({'arrays': manifest_arrays}, f)
+                except OSError as e:
+                    write_error = e
+            if not self._all_processes_ok(write_error is None):
+                raise write_error if write_error is not None else IOError(
+                    f'checkpoint step {job.step}: a peer process failed '
+                    f'writing its shards; aborting the save on every '
+                    f'host (the step stays invisible).')
+            if jax.process_index() == 0:
+                manifest_arrays = self._merge_chunk_indexes(tmp_dir)
+
+        def _commit() -> None:
+            # Deterministic mid-save fault site: fires with every chunk
+            # on disk but no manifest/rename — exactly the window a
+            # real preemption hits; the step must stay invisible.
+            if failpoints.ACTIVE:
+                failpoints.fire('ckpt.save')
+            if jax.process_index() != 0:
+                return
+            manifest = {
+                'format': FORMAT_VERSION,
+                'step': job.step,
+                'time': time.time(),
+                'process_count': jax.process_count(),
+                'mesh_axes': job.mesh_axes,
+                'arrays': manifest_arrays,
+            }
+            mpath = os.path.join(tmp_dir, MANIFEST_NAME)
+            with open(mpath + '.tmp', 'w', encoding='utf-8') as f:
+                json.dump(manifest, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(mpath + '.tmp', mpath)
+            # The commit point: a step exists iff this rename happened.
+            os.replace(tmp_dir, final_dir)
+            self._gc_steps()
+
+        if jax.process_count() == 1:
+            _commit()
+        else:
+            # Same carry-the-error-to-the-barrier protocol as above: a
+            # failed manifest fsync/rename on process 0 (or a one-sided
+            # failpoint firing) must surface on EVERY host, not wedge
+            # the peers in a barrier.
+            commit_error: Optional[BaseException] = None
+            try:
+                _commit()
+            except BaseException as e:  # pylint: disable=broad-except
+                commit_error = e
+            if not self._all_processes_ok(commit_error is None):
+                if commit_error is not None:
+                    raise commit_error
+                raise IOError(
+                    f'checkpoint step {job.step}: commit failed on a '
+                    f'peer process; the step was not published.')
+
+    @staticmethod
+    def _all_processes_ok(local_ok: bool) -> bool:
+        """Collective status exchange doubling as a barrier: every
+        process reports whether its local IO succeeded; all learn
+        whether ALL succeeded. Used instead of a bare barrier so a
+        one-sided failure aborts the save everywhere rather than
+        leaving the healthy hosts blocked forever."""
+        import numpy as _np
+        from jax.experimental import multihost_utils
+        flags = multihost_utils.process_allgather(_np.asarray(local_ok))
+        return bool(flags.all())
+
+    @staticmethod
+    def _merge_chunk_indexes(tmp_dir: str) -> List[Dict[str, Any]]:
+        merged: Dict[str, Dict[str, Any]] = {}
+        for name in sorted(os.listdir(tmp_dir)):
+            if not (name.startswith('chunks.p') and name.endswith('.json')):
+                continue
+            with open(os.path.join(tmp_dir, name), encoding='utf-8') as f:
+                index = json.load(f)
+            for rec in index['arrays']:
+                have = merged.setdefault(rec['path'], dict(rec, chunks=[]))
+                have['chunks'].extend(rec['chunks'])
+            os.unlink(os.path.join(tmp_dir, name))
+        return list(merged.values())
+
+    def _gc_steps(self) -> None:
+        steps = self.all_steps()
+        if self.max_to_keep is None or len(steps) <= self.max_to_keep:
+            return
+        victims = steps[:-self.max_to_keep]
+        for step in victims:
+            if self.keep_period and step % self.keep_period == 0:
+                continue
+            shutil.rmtree(
+                os.path.join(self.directory, _step_dirname(step)),
+                ignore_errors=True)
+
+    # ------------------------------------------------------------------
     def restore(self, cfg, mesh, tx: optax.GradientTransformation,
                 step: Optional[int] = None, rules=None
                 ) -> Tuple[train_lib.TrainState, int]:
-        """Restore (state, step) sharded onto `mesh`. step=None → latest."""
+        """Restore (state, step) sharded onto `mesh`. step=None → latest.
+
+        `mesh` is the CURRENT topology — the checkpoint's own mesh shape
+        is advisory metadata only; arrays reshard through the logical
+        layout regardless of what slice shape wrote them."""
         if step is None:
             step = self.latest_step()
             if step is None:
                 raise FileNotFoundError(
                     f'No checkpoint found under {self.directory}.')
         abstract = abstract_train_state(cfg, mesh, tx, rules)
-        # Explicit per-leaf shardings: without restore_args orbax falls back
-        # to the shardings recorded in the checkpoint, which is wrong when
-        # recovery lands on a different slice topology than the save.
-        restore_args = ocp.checkpoint_utils.construct_restore_args(abstract)
-        state = self._mngr.restore(
-            step, args=ocp.args.PyTreeRestore(abstract,
-                                              restore_args=restore_args))
-        return state, step
+        return self.restore_tree(abstract, step), step
+
+    def restore_tree(self, abstract, step: int):
+        """Generic restore: `abstract` is any pytree of ShapeDtypeStructs
+        carrying NamedShardings (the target placement). Verifies the
+        manifest + every chunk digest; raises CheckpointCorruptError on
+        any integrity failure, ValueError on shape/dtype/tree mismatch
+        (a config mismatch, not corruption)."""
+        if failpoints.ACTIVE:
+            failpoints.fire('ckpt.restore')
+        step_dir = os.path.join(self.directory, _step_dirname(step))
+        manifest = self._load_manifest(step_dir, step)
+        by_path = {rec['path']: rec for rec in manifest['arrays']}
+        saved_axes = manifest.get('mesh_axes')
+
+        flat, treedef = jax.tree_util.tree_flatten_with_path(abstract)
+        want_paths = [jax.tree_util.keystr(kp) for kp, _ in flat]
+        missing = [p for p in want_paths if p not in by_path]
+        extra = set(by_path) - set(want_paths)
+        if missing or extra:
+            raise ValueError(
+                f'Checkpoint step {step} tree does not match the restore '
+                f'target: missing={missing[:5]} extra={sorted(extra)[:5]} '
+                f'(model/optimizer config mismatch).')
+
+        cur_axes = None
+        leaves = []
+        for (kp, leaf), path in zip(flat, want_paths):
+            rec = by_path[path]
+            shape = tuple(rec['shape'])
+            if shape != tuple(leaf.shape) or rec['dtype'] != str(leaf.dtype):
+                raise ValueError(
+                    f'Checkpoint array {path} is {rec["dtype"]}{shape}, '
+                    f'restore target wants {leaf.dtype}'
+                    f'{tuple(leaf.shape)} — config mismatch.')
+            host = self._assemble_array(step_dir, step, rec)
+            sharding = leaf.sharding
+            if cur_axes is None and hasattr(sharding, 'mesh'):
+                cur_axes = {str(k): int(v)
+                            for k, v in dict(sharding.mesh.shape).items()}
+            if sharding is None:
+                leaves.append(host)
+            else:
+                leaves.append(sharding_lib.host_to_sharded(host, sharding))
+        if saved_axes and cur_axes and saved_axes != cur_axes:
+            logger.info(
+                f'Resharded checkpoint step {step}: saved on mesh '
+                f'{saved_axes}, restored onto {cur_axes} (logical layout '
+                f'preserved, per-array re-slice).')
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def _load_manifest(self, step_dir: str, step: int) -> Dict[str, Any]:
+        mpath = os.path.join(step_dir, MANIFEST_NAME)
+        if not os.path.isdir(step_dir):
+            raise FileNotFoundError(
+                f'No checkpoint step {step} under {self.directory}.')
+        try:
+            with open(mpath, encoding='utf-8') as f:
+                manifest = json.load(f)
+        except FileNotFoundError:
+            raise CheckpointCorruptError(
+                f'step {step}: no {MANIFEST_NAME} — save was interrupted '
+                f'before commit; this step is partial.') from None
+        except (ValueError, OSError) as e:
+            raise CheckpointCorruptError(
+                f'step {step}: unreadable manifest: {e}') from None
+        if (not isinstance(manifest, dict) or
+                manifest.get('format') != FORMAT_VERSION or
+                not isinstance(manifest.get('arrays'), list)):
+            raise CheckpointCorruptError(
+                f'step {step}: manifest malformed or format '
+                f'{manifest.get("format") if isinstance(manifest, dict) else "?"!r} '
+                f'!= {FORMAT_VERSION}.')
+        return manifest
+
+    @staticmethod
+    def _assemble_array(step_dir: str, step: int,
+                        rec: Dict[str, Any]) -> np.ndarray:
+        """Reassemble one array from its chunk files, verifying every
+        content digest and that the chunks exactly tile the array."""
+        shape = tuple(rec['shape'])
+        dtype = np.dtype(rec['dtype'])
+        # Geometry is manifest data, and the sha256s cover only the
+        # chunk FILES — a corrupted manifest could carry out-of-range,
+        # overlapping, or duplicated 'start's that a size-sum check
+        # would pass (silently permuted values / uninitialized memory).
+        # In-bounds + pairwise-disjoint + volume-sum == array volume
+        # proves exact tiling in O(k²·ndim), no per-element bitmap (an
+        # extra byte per element would be real money on the host-
+        # memory-bound restore path). Validated BEFORE any file reads.
+        boxes = []
+        volume = 0
+        for chunk in rec['chunks']:
+            start = chunk.get('start')
+            cshape = chunk.get('shape')
+            if (not isinstance(start, list) or not isinstance(cshape, list)
+                    or len(start) != len(shape) or len(cshape) != len(shape)
+                    or any(s < 0 or d < 0 or s + d > dim for s, d, dim
+                           in zip(start, cshape, shape))):
+                raise CheckpointCorruptError(
+                    f'step {step}: chunk {chunk.get("file")} geometry '
+                    f'start={start} shape={cshape} does not fit array '
+                    f'{rec["path"]} {shape}.')
+            boxes.append((chunk.get('file'), start, cshape))
+            volume += int(np.prod(cshape, dtype=np.int64))
+        if volume != int(np.prod(shape, dtype=np.int64)):
+            raise CheckpointCorruptError(
+                f'step {step}: array {rec["path"]} chunks cover {volume} '
+                f'of {int(np.prod(shape, dtype=np.int64))} elements — '
+                f'partial shard set.')
+        for a in range(len(boxes)):
+            for b in range(a + 1, len(boxes)):
+                _, sa, da = boxes[a]
+                _, sb, db = boxes[b]
+                disjoint = any(sa[k] + da[k] <= sb[k] or
+                               sb[k] + db[k] <= sa[k]
+                               for k in range(len(shape)))
+                if not disjoint:
+                    raise CheckpointCorruptError(
+                        f'step {step}: chunks {boxes[a][0]} and '
+                        f'{boxes[b][0]} of {rec["path"]} overlap — '
+                        f'duplicated/shifted shard set.')
+        out = np.empty(shape, dtype)
+        for chunk in rec['chunks']:
+            cpath = os.path.join(step_dir, chunk['file'])
+            try:
+                with open(cpath, 'rb') as f:
+                    raw = f.read()
+            except OSError as e:
+                raise CheckpointCorruptError(
+                    f'step {step}: chunk {chunk["file"]} unreadable: '
+                    f'{e}') from None
+            if _sha256(raw) != chunk['sha256']:
+                raise CheckpointCorruptError(
+                    f'step {step}: chunk {chunk["file"]} content digest '
+                    f'mismatch (truncated or corrupted on disk).')
+            try:
+                data = np.load(io.BytesIO(raw), allow_pickle=False)
+            except ValueError as e:
+                raise CheckpointCorruptError(
+                    f'step {step}: chunk {chunk["file"]} undecodable: '
+                    f'{e}') from None
+            if list(data.shape) != list(chunk['shape']):
+                raise CheckpointCorruptError(
+                    f'step {step}: chunk {chunk["file"]} shape '
+                    f'{data.shape} != manifest {chunk["shape"]}.')
+            index = tuple(slice(s, s + d)
+                          for s, d in zip(chunk['start'], data.shape))
+            out[index] = data
+        return out
+
+    def restore_newest(self, abstract) -> Tuple[Optional[Any],
+                                                Optional[int]]:
+        """Walk complete steps newest→oldest; refuse corrupt steps loudly
+        and fall back. Returns (None, None) when the directory has no
+        steps at all; raises CheckpointCorruptError when steps exist but
+        none restores (silent reinit would be data loss)."""
+        steps = self.all_steps()
+        if not steps:
+            return None, None
+        for step in reversed(steps):
+            try:
+                return self.restore_tree(abstract, step), step
+            except CheckpointCorruptError as e:
+                logger.error(
+                    f'REFUSING corrupt checkpoint step {step}: {e} — '
+                    f'falling back to the next older complete step.')
+        raise CheckpointCorruptError(
+            f'All {len(steps)} checkpoint step(s) under {self.directory} '
+            f'failed integrity verification; refusing to silently '
+            f'reinitialize.')
 
     # ------------------------------------------------------------------
     def latest_step(self) -> Optional[int]:
-        return self._mngr.latest_step()
+        steps = self.all_steps()
+        return steps[-1] if steps else None
 
     def all_steps(self) -> list:
-        return list(self._mngr.all_steps())
+        """Complete steps only (manifest present), ascending. An
+        in-progress or interrupted save is invisible by construction."""
+        steps = []
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return []
+        for name in names:
+            m = _STEP_RE.match(name)
+            if m and os.path.isfile(os.path.join(self.directory, name,
+                                                 MANIFEST_NAME)):
+                steps.append(int(m.group(1)))
+        return sorted(steps)
 
     def wait(self) -> None:
         """The exit flush barrier: block until in-flight async saves are
         durable (the native analog of the reference's MOUNT_CACHED
         flush-before-exit script)."""
-        self._mngr.wait_until_finished()
+        self._queue.join()
+        self._raise_pending_error()
 
     def close(self) -> None:
         self.wait()
-        self._mngr.close()
+        if self._worker is not None and self._worker.is_alive():
+            self._queue.put(None)
+            self._worker.join(timeout=60)
 
     def __enter__(self) -> 'Checkpointer':
         return self
@@ -122,11 +690,14 @@ class Checkpointer:
 def restore_or_init(directory: str, cfg: Any, mesh, tx,
                     rng: Optional[jax.Array] = None, rules=None
                     ) -> Tuple[train_lib.TrainState, int, Checkpointer]:
-    """The resume entrypoint used by the trainer: latest checkpoint if one
-    exists, else a fresh sharded init. Returns (state, start_step, ckpt)."""
+    """The resume entrypoint used by the trainer: newest restorable
+    checkpoint if one exists (resharded onto the CURRENT mesh — the
+    recovery may have landed on a different slice topology), else a
+    fresh sharded init. Returns (state, start_step, ckpt)."""
     ckpt = Checkpointer(directory)
     if ckpt.latest_step() is not None:
-        state, step = ckpt.restore(cfg, mesh, tx, rules=rules)
+        abstract = abstract_train_state(cfg, mesh, tx, rules)
+        state, step = ckpt.restore_newest(abstract)
         logger.info(f'Resumed from checkpoint step {step} in {directory}.')
         return state, step, ckpt
     if rng is None:

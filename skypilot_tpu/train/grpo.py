@@ -451,7 +451,7 @@ def main() -> None:
                 ckpt.save(trainer.state, it + 1)
         if ckpt is not None and args.iterations % args.ckpt_every != 0:
             # Aligned totals were already saved by the in-loop cadence
-            # (orbax rejects re-saving an existing step).
+            # (a complete step is durable; re-saving it is a no-op).
             ckpt.save(trainer.state, args.iterations)
     finally:
         if ckpt is not None:

@@ -11,13 +11,17 @@ for jax.distributed on multi-host slices.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import dataclasses
 import json
 import os
+import signal
+import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.utils import failpoints
 
 # Fixed name, not __name__: under `python -m` this module is '__main__',
 # which would fall outside the 'skypilot_tpu' logging root (no handler).
@@ -39,6 +43,10 @@ class TrainerConfig:
     tokenizer: Optional[str] = None
     ckpt_dir: Optional[str] = None
     ckpt_every: int = 50
+    # >0: ALSO checkpoint whenever this many seconds elapsed since the
+    # last save — the preemption-exposure bound for spot training (a
+    # step-count cadence is meaningless when step time varies).
+    ckpt_time_interval: float = 0.0
     # >1: split each global batch into this many sequentially-accumulated
     # microbatches (same update, lower peak activation memory).
     grad_accum_steps: int = 1
@@ -62,6 +70,45 @@ class TrainerConfig:
     # from the tokenizer's specials (llama3/chatml/plain).
     sft_data_path: Optional[str] = None
     chat_family: Optional[str] = None
+
+
+class _PreemptionWatch(contextlib.AbstractContextManager):
+    """Preemption notice → graceful final checkpoint.
+
+    GCP delivers a spot TPU preemption as an ACPI shutdown, which
+    reaches the task as SIGTERM with a grace window; the watch turns
+    that (and the deterministic `trainer.preempt` failpoint, for chaos
+    schedules) into a flag the step loop checks at step boundaries, so
+    the trainer writes one final checkpoint and exits cleanly instead
+    of losing everything since the last cadence save. Installed only on
+    the main thread (signal.signal raises elsewhere — e.g. trainer
+    tests driving train() from a worker thread)."""
+
+    def __init__(self):
+        self._flag = threading.Event()
+        self._prev = None
+
+    def __enter__(self) -> '_PreemptionWatch':
+        if threading.current_thread() is threading.main_thread():
+            self._prev = signal.signal(
+                signal.SIGTERM, lambda *_: self._flag.set())
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._prev is not None:
+            signal.signal(signal.SIGTERM, self._prev)
+
+    @property
+    def preempted(self) -> bool:
+        if self._flag.is_set():
+            return True
+        if failpoints.ACTIVE:
+            try:
+                failpoints.fire('trainer.preempt')
+            except failpoints.FailpointError:
+                self._flag.set()
+                return True
+        return False
 
 
 def maybe_init_distributed() -> None:
@@ -304,12 +351,16 @@ def train(tcfg: TrainerConfig) -> List[Dict[str, float]]:
                 # Peek before restore_or_init would materialize a random
                 # init we'd immediately discard for the HF weights.
                 ckpt = checkpoints.Checkpointer(tcfg.ckpt_dir)
-                latest = ckpt.latest_step()
-                if latest is None:
+                if ckpt.latest_step() is None:
                     state, start_step = _state_from_hf(), 0
                 else:
-                    state, start_step = ckpt.restore(cfg, mesh, tx,
-                                                     step=latest)
+                    # Same corrupt-step fallback as restore_or_init: a
+                    # truncated newest step must not crash-loop every
+                    # recovery round while an older complete step sits
+                    # in the same directory.
+                    abstract = checkpoints.abstract_train_state(
+                        cfg, mesh, tx)
+                    state, start_step = ckpt.restore_newest(abstract)
                     logger.info(f'Resumed from checkpoint step '
                                 f'{start_step} in {tcfg.ckpt_dir}.')
             else:
@@ -358,42 +409,66 @@ def train(tcfg: TrainerConfig) -> List[Dict[str, float]]:
 
     history: List[Dict[str, float]] = []
     t_last = time.perf_counter()
+    t_last_save = time.monotonic()
     steps_since_log = 0
     try:
-        for step in range(start_step, tcfg.total_steps):
-            state, metrics = step_fn(state, next(batches))
-            steps_since_log += 1
-            # Eval cadence is INDEPENDENT of log cadence: an eval-only
-            # step emits its own record.
-            do_log = ((step + 1) % tcfg.log_every == 0 or
-                      step + 1 == tcfg.total_steps)
-            do_eval = (eval_fn is not None and
-                       (step + 1) % tcfg.eval_every == 0)
-            if do_log or do_eval:
-                rec = {'step': step + 1}
-                if do_log:
-                    loss = float(metrics['loss'])   # device sync point
-                    now = time.perf_counter()
-                    rec.update(loss=round(loss, 4),
-                               sec_per_step=round(
-                                   (now - t_last) / steps_since_log, 4))
-                if do_eval:
-                    rec['eval_loss'] = round(eval_fn(), 4)
-                t_last = time.perf_counter()   # exclude eval time
-                steps_since_log = 0
-                history.append(rec)
-                logger.info(json.dumps(rec))
-            if ckpt is not None and (step + 1) % tcfg.ckpt_every == 0:
-                ckpt.save(state, step + 1)
+        with _PreemptionWatch() as watch:
+            for step in range(start_step, tcfg.total_steps):
+                state, metrics = step_fn(state, next(batches))
+                steps_since_log += 1
+                # Eval cadence is INDEPENDENT of log cadence: an
+                # eval-only step emits its own record.
+                do_log = ((step + 1) % tcfg.log_every == 0 or
+                          step + 1 == tcfg.total_steps)
+                do_eval = (eval_fn is not None and
+                           (step + 1) % tcfg.eval_every == 0)
+                if do_log or do_eval:
+                    rec = {'step': step + 1}
+                    if do_log:
+                        loss = float(metrics['loss'])  # device sync point
+                        now = time.perf_counter()
+                        rec.update(loss=round(loss, 4),
+                                   sec_per_step=round(
+                                       (now - t_last) / steps_since_log,
+                                       4))
+                    if do_eval:
+                        rec['eval_loss'] = round(eval_fn(), 4)
+                    t_last = time.perf_counter()   # exclude eval time
+                    steps_since_log = 0
+                    history.append(rec)
+                    logger.info(json.dumps(rec))
+                save_due = (step + 1) % tcfg.ckpt_every == 0
+                if (not save_due and tcfg.ckpt_time_interval > 0 and
+                        time.monotonic() - t_last_save >=
+                        tcfg.ckpt_time_interval):
+                    save_due = True
+                if ckpt is not None and save_due:
+                    ckpt.save(state, step + 1)
+                    t_last_save = time.monotonic()
+                if lora_mode and tcfg.lora_dir and save_due:
+                    lora_lib.save_adapters(tcfg.lora_dir, state, lcfg)
+                    t_last_save = time.monotonic()
+                if watch.preempted:
+                    # Preemption notice: one synchronous final save —
+                    # the relaunch rebuilds its mesh from whatever
+                    # topology recovery lands on and restores through
+                    # the resharding path, so nothing after this point
+                    # depends on the current slice shape surviving.
+                    if ckpt is not None:
+                        ckpt.save(state, step + 1, wait=True)
+                    if lora_mode and tcfg.lora_dir:
+                        lora_lib.save_adapters(tcfg.lora_dir, state, lcfg)
+                    logger.info(json.dumps(
+                        {'step': step + 1, 'preempted': True,
+                         'final_checkpoint': ckpt is not None or
+                         bool(lora_mode and tcfg.lora_dir)}))
+                    return history
+            if ckpt is not None:
+                ckpt.save(state, tcfg.total_steps)
             if (lora_mode and tcfg.lora_dir and
-                    (step + 1) % tcfg.ckpt_every == 0):
+                    tcfg.total_steps % tcfg.ckpt_every != 0):
+                # The in-loop cadence already saved on aligned totals.
                 lora_lib.save_adapters(tcfg.lora_dir, state, lcfg)
-        if ckpt is not None:
-            ckpt.save(state, tcfg.total_steps)
-        if (lora_mode and tcfg.lora_dir and
-                tcfg.total_steps % tcfg.ckpt_every != 0):
-            # The in-loop cadence already saved on aligned totals.
-            lora_lib.save_adapters(tcfg.lora_dir, state, lcfg)
     finally:
         if ckpt is not None:
             # Exit flush barrier: async saves must be durable before the
@@ -420,6 +495,9 @@ def main() -> None:
     parser.add_argument('--tokenizer', default=None)
     parser.add_argument('--ckpt-dir', default=None)
     parser.add_argument('--ckpt-every', type=int, default=50)
+    parser.add_argument('--ckpt-time-interval', type=float, default=0.0,
+                        help='>0: also checkpoint every N seconds (the '
+                             'preemption-exposure bound on spot).')
     parser.add_argument('--grad-accum', type=int, default=1,
                         help='Accumulate grads over N microbatches per '
                              'optimizer step (lower peak memory).')
@@ -473,7 +551,9 @@ def main() -> None:
         total_steps=args.steps, learning_rate=args.lr,
         log_every=args.log_every, data_path=args.data,
         tokenizer=args.tokenizer, ckpt_dir=args.ckpt_dir,
-        ckpt_every=args.ckpt_every, grad_accum_steps=args.grad_accum,
+        ckpt_every=args.ckpt_every,
+        ckpt_time_interval=args.ckpt_time_interval,
+        grad_accum_steps=args.grad_accum,
         eval_data_path=args.eval_data, eval_every=args.eval_every,
         eval_batches=args.eval_batches,
         lora_rank=args.lora_rank, lora_alpha=args.lora_alpha,

@@ -1,0 +1,59 @@
+"""Shared retry backoff: exponential growth, seeded jitter, budgets.
+
+Every retry loop in the jobs/provision planes goes through this helper
+instead of ``time.sleep(<const>)`` (the skylint ``backoff-discipline``
+checker enforces it): a fixed retry cadence synchronizes every
+recovering job into thundering herds against whatever just failed —
+the cloud API, the zone that preempted them, the sqlite lock — while
+exponential-with-jitter spreads them out and backs off together.
+
+Jitter is SEEDED (per caller — jobs seed with their job id) so a chaos
+run's retry timeline is bit-reproducible: the same failure schedule
+yields the same sleeps, which is what lets tests assert "recovery
+attempts bounded by the configured budget" instead of sleeping and
+hoping. Two jobs with different seeds draw independent streams, so
+determinism never reintroduces the herd.
+"""
+from __future__ import annotations
+
+import random
+import time
+from typing import Optional
+
+
+class Backoff:
+    """Exponential backoff with half-jitter.
+
+    Attempt n (0-based) sleeps ``uniform(0.5, 1.0) * min(cap,
+    base * 2**n)`` — the 0.5 floor keeps retries from collapsing to
+    zero-sleep spins while the jitter half desynchronizes callers.
+    """
+
+    def __init__(self, base: float = 1.0, cap: float = 30.0,
+                 seed: Optional[int] = None):
+        if base < 0 or cap < 0:
+            raise ValueError(f'base={base} and cap={cap} must be >= 0')
+        self.base = base
+        self.cap = cap
+        self._rng = random.Random(seed)
+        self.attempt = 0
+
+    def next(self) -> float:
+        """The next sleep duration (advances the attempt counter)."""
+        # Exponent clamp: 2.0**attempt overflows float at ~1024, and a
+        # retry-forever loop (the reference's semantics) reaches that —
+        # past ~64 doublings every realistic cap has long since won.
+        raw = min(self.cap, self.base * (2.0 ** min(self.attempt, 64)))
+        self.attempt += 1
+        return raw * (0.5 + 0.5 * self._rng.random())
+
+    def sleep(self) -> float:
+        """Sleep the next duration; returns how long was slept."""
+        duration = self.next()
+        if duration > 0:
+            time.sleep(duration)
+        return duration
+
+    def reset(self) -> None:
+        """Back to attempt 0 (after a success inside a long loop)."""
+        self.attempt = 0

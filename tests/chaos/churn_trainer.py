@@ -1,0 +1,165 @@
+"""Elastic churn trainer: the subprocess body of test_train_churn.py.
+
+A deliberately tiny SPMD LM (embed → relu MLP → logits, adam) whose
+training stack is exactly the production contract under test:
+
+  * params sharded over 'fsdp', batch over ('data', 'fsdp') — explicit
+    NamedShardings on the jitted step (no ambient-mesh APIs, so this
+    runs on every jax version the repo supports);
+  * step-indexed synthetic data — batch k is a pure function of k, the
+    property that makes resume trajectories comparable at all;
+  * the REAL train/checkpoints.py Checkpointer — topology-independent
+    manifest format, atomic completes, digest verification — with
+    synchronous saves so an armed ckpt.save failpoint kills this
+    process exactly mid-save;
+  * the REAL trainer preemption watch (SIGTERM + trainer.preempt
+    failpoint) → one final save → clean exit.
+
+The driving test relaunches this script under different --mesh shapes
+against one checkpoint dir and asserts the stitched loss trajectory is
+bit-identical to an unpreempted run. Every step appends one JSON line
+{"step": k, "loss": <float>} to --losses; markers RESUMED/SAVED/
+PREEMPTED on stdout are the test's evidence stream.
+"""
+from __future__ import annotations
+
+import argparse
+import functools
+import json
+import os
+import sys
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser()
+    parser.add_argument('--ckpt-dir', required=True)
+    parser.add_argument('--losses', required=True)
+    parser.add_argument('--steps', type=int, default=12)
+    parser.add_argument('--mesh', default='data=2,fsdp=4')
+    parser.add_argument('--ckpt-every', type=int, default=1000)
+    parser.add_argument('--devices', type=int, default=0,
+                        help='>0: build the mesh over the first N '
+                             'devices (the single-host episode).')
+    parser.add_argument('--step-seconds', type=float, default=0.0,
+                        help='artificial per-step sleep (SIGTERM tests '
+                             'need time to aim).')
+    args = parser.parse_args()
+
+    os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+    os.environ.setdefault('XLA_FLAGS',
+                          '--xla_force_host_platform_device_count=8')
+    import time
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from skypilot_tpu.parallel import MeshSpec, build_mesh
+    from skypilot_tpu.parallel import sharding as sharding_lib
+    from skypilot_tpu.train import checkpoints
+    from skypilot_tpu.train import trainer as trainer_lib
+
+    mesh_sizes = {}
+    for part in args.mesh.split(','):
+        k, v = part.split('=')
+        mesh_sizes[k] = int(v)
+    devices = jax.devices()[:args.devices] if args.devices else None
+    mesh = build_mesh(MeshSpec(**mesh_sizes), devices=devices)
+
+    V, D, H, B, S = 64, 32, 96, 8, 16
+    PSPECS = {'emb': P(), 'w1': P(None, 'fsdp'), 'w2': P('fsdp', None)}
+
+    def init_params():
+        k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+        return {
+            'emb': jax.random.normal(k1, (V, D), jnp.float32) * 0.02,
+            'w1': jax.random.normal(k2, (D, H), jnp.float32) * 0.02,
+            'w2': jax.random.normal(k3, (H, V), jnp.float32) * 0.02,
+        }
+
+    tx = optax.adam(1e-2)
+
+    def init_state_host():
+        params = init_params()
+        return {'step': jnp.zeros((), jnp.int32), 'params': params,
+                'opt': tx.init(params)}
+
+    # Shape-matched shardings: adam's mu/nu embed copies of the param
+    # tree, scalars replicate (the state_shardings pattern).
+    shapes = jax.eval_shape(init_state_host)
+    leaf_sharding = sharding_lib.shardings_like(
+        mesh, {k: PSPECS[k] for k in PSPECS}, shapes['params'])
+    abstract = jax.tree.map(
+        lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype,
+                                       sharding=leaf_sharding(l)),
+        shapes)
+    batch_sharding = NamedSharding(mesh, P(('data', 'fsdp'), None))
+
+    def batch_at(step: int) -> np.ndarray:
+        rng = np.random.default_rng(1234 + step)
+        return rng.integers(0, V, size=(B, S + 1)).astype(np.int32)
+
+    def loss_of(params, tokens):
+        inp, tgt = tokens[:, :-1], tokens[:, 1:]
+        x = params['emb'][inp]
+        h = jax.nn.relu(x @ params['w1'])
+        logits = h @ params['w2']
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, tgt[..., None], axis=-1)[..., 0]
+        return (logz - gold).mean()
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def step_fn(state, tokens):
+        loss, grads = jax.value_and_grad(loss_of)(state['params'], tokens)
+        updates, opt = tx.update(grads, state['opt'], state['params'])
+        params = optax.apply_updates(state['params'], updates)
+        return {'step': state['step'] + 1, 'params': params,
+                'opt': opt}, loss
+
+    ckpt = checkpoints.Checkpointer(args.ckpt_dir)
+    state, start_step = ckpt.restore_newest(abstract)
+    if state is None:
+        state = jax.device_put(
+            init_state_host(),
+            jax.tree.map(lambda a: a.sharding, abstract))
+        start_step = 0
+    print(f'RESUMED step={start_step}', flush=True)
+
+    def save(step: int) -> None:
+        print(f'SAVING step={step}', flush=True)
+        # Synchronous: an armed ckpt.save failpoint (or a SIGKILL aimed
+        # at the SAVING marker) dies HERE, mid-write — the partial step
+        # must stay invisible to every later restore.
+        ckpt.save(state, step, wait=True)
+        print(f'SAVED step={step}', flush=True)
+
+    losses = open(args.losses, 'a', encoding='utf-8')
+    try:
+        with trainer_lib._PreemptionWatch() as watch:
+            for step in range(start_step, args.steps):
+                state, loss = step_fn(
+                    state, jax.device_put(batch_at(step), batch_sharding))
+                losses.write(json.dumps(
+                    {'step': step + 1, 'loss': float(loss)}) + '\n')
+                losses.flush()
+                if args.step_seconds:
+                    time.sleep(args.step_seconds)
+                if (step + 1) % args.ckpt_every == 0:
+                    save(step + 1)
+                if watch.preempted:
+                    save(step + 1)
+                    print(f'PREEMPTED step={step + 1}', flush=True)
+                    return 0
+        if args.steps % args.ckpt_every != 0:
+            save(args.steps)
+        print(f'FINISHED step={args.steps}', flush=True)
+    finally:
+        losses.close()
+        ckpt.close()
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,374 @@
+"""Chaos churn suite: elastic training under seeded preemption schedules.
+
+The jobs-plane acceptance contract (docs/ROBUSTNESS.md): under a
+deterministic preemption schedule — kills mid-step, kills mid-save,
+SIGTERM grace windows — training resumes on a DIFFERENT mesh shape each
+time, through the topology-independent checkpoint path, and the stitched
+loss trajectory is bit-identical to a run that was never preempted.
+Partial checkpoints (a save killed before its manifest commit) must
+never be restored; corrupt steps must be refused loudly with fallback
+to the newest older complete step; jobs-plane recovery must stay inside
+its configured budget with per-attempt journal evidence.
+
+Episodes run tests/chaos/churn_trainer.py as subprocesses (a real kill
+needs a real process); the jobs-plane budget/journal tests drive
+recovery_strategy in-process with launches stubbed out.
+"""
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+HARNESS = os.path.join(REPO, 'tests', 'chaos', 'churn_trainer.py')
+
+TOTAL_STEPS = 12
+
+
+def _env(tmp, failpoints_spec=''):
+    env = dict(os.environ)
+    env.update({
+        'JAX_PLATFORMS': 'cpu',
+        'XLA_FLAGS': '--xla_force_host_platform_device_count=8',
+        'PYTHONPATH': REPO,
+        'SKYTPU_OBSERVE_DB': os.path.join(str(tmp), 'journal.db'),
+        # jax 0.4.37's persistent compile cache SEGFAULTS reloading
+        # this suite's program mix (reproduced deterministically with
+        # the cache on, clean with it off); the model is tiny, so
+        # cold compiles cost ~1s per episode.
+        'JAX_ENABLE_COMPILATION_CACHE': 'false',
+    })
+    env.pop('JAX_COMPILATION_CACHE_DIR', None)
+    if failpoints_spec:
+        env['SKYTPU_FAILPOINTS'] = failpoints_spec
+    else:
+        env.pop('SKYTPU_FAILPOINTS', None)
+    return env
+
+
+def _episode(tmp, ckpt_dir, losses, *, mesh, steps=TOTAL_STEPS,
+             ckpt_every=1000, failpoints_spec='', devices=0,
+             step_seconds=0.0, check=True, timeout=240):
+    cmd = [sys.executable, HARNESS, '--ckpt-dir', str(ckpt_dir),
+           '--losses', str(losses), '--steps', str(steps),
+           '--mesh', mesh, '--ckpt-every', str(ckpt_every)]
+    if devices:
+        cmd += ['--devices', str(devices)]
+    if step_seconds:
+        cmd += ['--step-seconds', str(step_seconds)]
+    proc = subprocess.run(cmd, env=_env(tmp, failpoints_spec),
+                          capture_output=True, text=True, cwd=REPO,
+                          timeout=timeout)
+    if check:
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+    return proc
+
+
+def _read_losses(path):
+    """{step: loss}; a step logged twice (an overlap re-run after a
+    restore) must be bit-identical both times — diverging duplicates
+    mean a partial or stale checkpoint was restored."""
+    out = {}
+    with open(path, encoding='utf-8') as f:
+        for line in f:
+            rec = json.loads(line)
+            if rec['step'] in out:
+                assert out[rec['step']] == rec['loss'], (
+                    f'step {rec["step"]} diverged across episodes: '
+                    f'{out[rec["step"]]} vs {rec["loss"]} — a resumed '
+                    f'episode did not restore the exact saved state')
+            out[rec['step']] = rec['loss']
+    return out
+
+
+@pytest.fixture(scope='module')
+def reference(tmp_path_factory):
+    """The unpreempted ground truth: TOTAL_STEPS straight on a 2x4
+    mesh, no churn, no checkpoint interference."""
+    tmp = tmp_path_factory.mktemp('ref')
+    losses = tmp / 'losses.jsonl'
+    _episode(tmp, tmp / 'ckpt', losses, mesh='data=2,fsdp=4')
+    ref = _read_losses(losses)
+    assert sorted(ref) == list(range(1, TOTAL_STEPS + 1))
+    return ref
+
+
+class TestChurnTrajectory:
+
+    def test_seeded_churn_matches_unpreempted_exactly(self, tmp_path,
+                                                      reference):
+        """The seeded schedule: failpoint preemption on 2x4 → resume on
+        1x8 and die MID-SAVE → resume on 4x2 (from the last complete
+        step, never the partial) → finish. Stitched losses must equal
+        the unpreempted run bit-for-bit."""
+        ckpt = tmp_path / 'ckpt'
+        losses = tmp_path / 'losses.jsonl'
+
+        # Episode 1 (mesh 2x4): trainer.preempt fires at step 6 → one
+        # final save, clean exit.
+        p1 = _episode(tmp_path, ckpt, losses, mesh='data=2,fsdp=4',
+                      failpoints_spec='trainer.preempt=every:6')
+        assert 'PREEMPTED step=6' in p1.stdout
+        assert 'SAVED step=6' in p1.stdout
+
+        # Episode 2 (mesh 1x8): resumes at 6, then ckpt.save fires
+        # inside the step-9 cadence save — chunks on disk, no manifest
+        # commit — and the process dies mid-save.
+        p2 = _episode(tmp_path, ckpt, losses, mesh='data=1,fsdp=8',
+                      ckpt_every=3, failpoints_spec='ckpt.save=once',
+                      check=False)
+        assert p2.returncode != 0, p2.stdout + p2.stderr
+        assert 'RESUMED step=6' in p2.stdout
+        assert 'SAVING step=9' in p2.stdout
+        assert 'SAVED step=9' not in p2.stdout
+        assert 'failpoint' in p2.stderr     # the injected fault, loudly
+
+        # The killed save is invisible: no step_00000009, and the
+        # in-progress temp dir holds no manifest.
+        names = sorted(os.listdir(ckpt))
+        assert 'step_00000009' not in names
+        partial = [n for n in names if n.startswith('.tmp-')]
+        for name in partial:
+            assert 'MANIFEST.json' not in os.listdir(ckpt / name)
+
+        # Episode 3 (mesh 4x2): must resume from step 6 — the newest
+        # COMPLETE step — never the partial 9; runs to completion.
+        p3 = _episode(tmp_path, ckpt, losses, mesh='data=4,fsdp=2')
+        assert 'RESUMED step=6' in p3.stdout
+        assert 'FINISHED step=12' in p3.stdout
+
+        churn = _read_losses(losses)
+        assert sorted(churn) == list(range(1, TOTAL_STEPS + 1))
+        for step in range(1, TOTAL_STEPS + 1):
+            assert churn[step] == reference[step], (
+                f'step {step}: churn {churn[step]!r} != unpreempted '
+                f'{reference[step]!r}')
+
+    def test_corrupt_newest_step_refused_with_fallback(self, tmp_path,
+                                                       reference):
+        """Truncate a chunk of the newest checkpoint: the relaunch must
+        refuse it LOUDLY, fall back to the older complete step, and
+        still reproduce the reference trajectory."""
+        ckpt = tmp_path / 'ckpt'
+        losses = tmp_path / 'losses.jsonl'
+        _episode(tmp_path, ckpt, losses, mesh='data=2,fsdp=4',
+                 steps=8, ckpt_every=4)
+        step_dir = ckpt / 'step_00000008'
+        chunks = sorted(p for p in (step_dir / 'arrays').iterdir())
+        with open(chunks[0], 'r+b') as f:
+            f.truncate(64)
+        p2 = _episode(tmp_path, ckpt, losses, mesh='data=1,fsdp=8',
+                      steps=TOTAL_STEPS)
+        assert 'RESUMED step=4' in p2.stdout   # 8 refused, 4 restored
+        churn = _read_losses(losses)
+        for step in range(1, TOTAL_STEPS + 1):
+            assert churn[step] == reference[step]
+
+    def test_sigterm_grace_saves_final_checkpoint(self, tmp_path,
+                                                  reference):
+        """A real preemption notice: SIGTERM mid-run → final save at
+        the interrupted step → resume on a reshaped mesh lands exactly
+        there, trajectory intact; a single-host resume restores the
+        same step too (the slice shape is gone entirely)."""
+        ckpt = tmp_path / 'ckpt'
+        losses = tmp_path / 'losses.jsonl'
+        env = _env(tmp_path)
+        proc = subprocess.Popen(
+            [sys.executable, HARNESS, '--ckpt-dir', str(ckpt),
+             '--losses', str(losses), '--steps', str(TOTAL_STEPS),
+             '--mesh', 'data=2,fsdp=4', '--ckpt-every', '1000',
+             '--step-seconds', '0.2'],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, cwd=REPO)
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            if losses.exists() and len(losses.read_text().splitlines()) >= 3:
+                break
+            if proc.poll() is not None:
+                break
+            time.sleep(0.05)
+        proc.send_signal(signal.SIGTERM)
+        out, err = proc.communicate(timeout=120)
+        assert proc.returncode == 0, out + err
+        assert 'PREEMPTED step=' in out
+        final_step = int(out.split('PREEMPTED step=')[1].split()[0])
+        assert final_step < TOTAL_STEPS  # it really was interrupted
+
+        # Single-host resume (on a COPY, so the main resume below still
+        # sees the preemption-time checkpoint): restores the SAME step
+        # and continues. Loss comparison is allclose, not bit-equal —
+        # a 1-device reduction legitimately reassociates float sums vs
+        # the 8-device reference (the bit-exact contract holds across
+        # mesh SHAPES at equal device count).
+        solo_ckpt = tmp_path / 'solo_ckpt'
+        shutil.copytree(ckpt, solo_ckpt)
+        solo_losses = tmp_path / 'solo.jsonl'
+        p3 = _episode(tmp_path, solo_ckpt, solo_losses,
+                      mesh='data=1,fsdp=1', devices=1,
+                      steps=final_step + 2)
+        assert f'RESUMED step={final_step}' in p3.stdout
+        solo = _read_losses(solo_losses)
+        assert sorted(solo) == [final_step + 1, final_step + 2]
+        for step, loss in solo.items():
+            np.testing.assert_allclose(loss, reference[step], rtol=1e-5)
+
+        p2 = _episode(tmp_path, ckpt, losses, mesh='data=4,fsdp=2')
+        assert f'RESUMED step={final_step}' in p2.stdout
+        churn = _read_losses(losses)
+        for step in range(1, TOTAL_STEPS + 1):
+            assert churn[step] == reference[step]
+
+
+class TestJobsPlaneRecovery:
+
+    @pytest.fixture(autouse=True)
+    def _observe_db(self, tmp_path, monkeypatch):
+        monkeypatch.setenv('SKYTPU_OBSERVE_DB',
+                           str(tmp_path / 'journal.db'))
+        from skypilot_tpu.utils import failpoints
+        yield
+        failpoints.reset()
+
+    def _journal_events(self, kind):
+        from skypilot_tpu.observe import journal
+        return journal.query(kind=kind, limit=1000)
+
+    def _strategy(self, monkeypatch, job_id=7, fail_with=None):
+        from skypilot_tpu import exceptions
+        from skypilot_tpu.jobs import recovery_strategy
+
+        strategy = recovery_strategy.FailoverStrategyExecutor.__new__(
+            recovery_strategy.FailoverStrategyExecutor)
+        strategy.cluster_name = 'chaos-train'
+        strategy.task = None
+        strategy.job_id = job_id
+        strategy.handle = None
+        attempts = []
+
+        def _launch_once(**kwargs):
+            attempts.append(kwargs)
+            raise (fail_with or exceptions.ResourcesUnavailableError)(
+                'no capacity (stub)')
+
+        monkeypatch.setattr(strategy, '_launch_once', _launch_once)
+        monkeypatch.setattr(strategy, 'terminate_cluster',
+                            lambda max_retries=3: None)
+        monkeypatch.setattr(recovery_strategy.state,
+                            'cancel_was_requested', lambda job_id: False)
+        return strategy, attempts
+
+    def test_round_budget_bounds_attempts_with_journal(self, tmp_path,
+                                                       monkeypatch):
+        """max-rounds budget: exactly N journaled attempts, then a
+        journaled exhaustion and ManagedJobReachedMaxRetriesError."""
+        from skypilot_tpu import exceptions
+        monkeypatch.setenv('SKYTPU_JOBS_RECOVERY_MAX_ROUNDS', '3')
+        monkeypatch.setenv('SKYTPU_JOBS_RECOVERY_BASE_SECONDS', '0.01')
+        monkeypatch.setenv('SKYTPU_JOBS_RECOVERY_CAP_SECONDS', '0.02')
+        strategy, attempts = self._strategy(monkeypatch)
+        with pytest.raises(exceptions.ManagedJobReachedMaxRetriesError):
+            strategy.recover()
+        assert len(attempts) == 3   # one unconstrained try per round
+        events = self._journal_events('jobs_recovery_attempt')
+        assert len(events) == 3
+        for event in events:
+            assert event['entity'] == '7'
+            assert event['data']['outcome'] == 'no_capacity'
+            assert event['data']['phase'] == 'unconstrained'
+        exhausted = self._journal_events('jobs_recovery_exhausted')
+        assert len(exhausted) == 1
+        assert exhausted[0]['data']['max_rounds'] == 3
+
+    def test_wallclock_budget_bounds_recovery(self, tmp_path,
+                                              monkeypatch):
+        from skypilot_tpu import exceptions
+        monkeypatch.setenv('SKYTPU_JOBS_RECOVERY_MAX_ROUNDS', '10000')
+        monkeypatch.setenv('SKYTPU_JOBS_RECOVERY_BUDGET_SECONDS', '0.3')
+        monkeypatch.setenv('SKYTPU_JOBS_RECOVERY_BASE_SECONDS', '0.05')
+        monkeypatch.setenv('SKYTPU_JOBS_RECOVERY_CAP_SECONDS', '0.1')
+        strategy, attempts = self._strategy(monkeypatch)
+        t0 = time.monotonic()
+        with pytest.raises(exceptions.ManagedJobReachedMaxRetriesError,
+                           match='budget'):
+            strategy.recover()
+        assert time.monotonic() - t0 < 5.0
+        assert 1 <= len(attempts) < 100
+        exhausted = self._journal_events('jobs_recovery_exhausted')
+        assert 'budget' in exhausted[0]['reason']
+
+    def test_injected_launch_fault_classed_and_contained(self, tmp_path,
+                                                         monkeypatch):
+        """An armed jobs.launch failpoint inside a recovery attempt is
+        journaled as outcome=fault and retried like no-capacity — the
+        loop, not the caller, owns injected infra faults."""
+        from skypilot_tpu import exceptions
+        from skypilot_tpu.utils import failpoints
+        monkeypatch.setenv('SKYTPU_JOBS_RECOVERY_MAX_ROUNDS', '2')
+        monkeypatch.setenv('SKYTPU_JOBS_RECOVERY_BASE_SECONDS', '0.01')
+        monkeypatch.setenv('SKYTPU_JOBS_RECOVERY_CAP_SECONDS', '0.02')
+        strategy, attempts = self._strategy(
+            monkeypatch, fail_with=lambda msg: failpoints.FailpointError(
+                'jobs.launch'))
+        with pytest.raises(exceptions.ManagedJobReachedMaxRetriesError):
+            strategy.recover()
+        events = self._journal_events('jobs_recovery_attempt')
+        assert len(events) == 2
+        assert all(e['data']['outcome'] == 'fault' for e in events)
+
+    def test_backoff_gaps_grow_and_are_seed_deterministic(self,
+                                                          monkeypatch):
+        """The recovery loop's sleeps follow the seeded backoff: two
+        identical runs sleep identically; gaps grow exponentially."""
+        from skypilot_tpu import exceptions
+        from skypilot_tpu.jobs import recovery_strategy
+        monkeypatch.setenv('SKYTPU_JOBS_RECOVERY_MAX_ROUNDS', '4')
+        monkeypatch.setenv('SKYTPU_JOBS_RECOVERY_BASE_SECONDS', '1')
+        monkeypatch.setenv('SKYTPU_JOBS_RECOVERY_CAP_SECONDS', '64')
+
+        def run_once():
+            sleeps = []
+            monkeypatch.setattr(recovery_strategy.time, 'sleep',
+                                sleeps.append)
+            strategy, _ = self._strategy(monkeypatch)
+            with pytest.raises(
+                    exceptions.ManagedJobReachedMaxRetriesError):
+                strategy.recover()
+            return sleeps
+
+        first, second = run_once(), run_once()
+        assert first == second          # per-job seed ⇒ reproducible
+        assert len(first) == 4
+        # Exponential shape with half-jitter: attempt n in
+        # [0.5, 1.0] * 2^n.
+        for n, gap in enumerate(first):
+            assert 0.5 * 2 ** n <= gap <= 1.0 * 2 ** n
+
+    def test_jobs_preempt_failpoint_short_circuits_liveness(
+            self, monkeypatch):
+        """An armed jobs.preempt classes the cluster dead BEFORE any
+        cloud/state lookup — the controller's recovery arc starts from
+        the injection alone."""
+        from skypilot_tpu.jobs import controller as controller_lib
+        from skypilot_tpu.utils import failpoints
+        ctl = controller_lib.JobsController.__new__(
+            controller_lib.JobsController)
+        ctl.cluster_name = 'chaos-train'
+        monkeypatch.setattr(
+            controller_lib.global_state, 'get_cluster',
+            lambda name: pytest.fail('liveness hit state DB despite '
+                                     'injected preemption'))
+        with failpoints.armed('jobs.preempt'):
+            assert ctl._cluster_alive() is False
+
+    def test_recovery_metrics_registered(self):
+        from skypilot_tpu.observe import metrics
+        rendered = metrics.render()
+        assert 'skytpu_jobs_recovery_attempts_total' in rendered
+        assert 'skytpu_jobs_recovery_seconds' in rendered

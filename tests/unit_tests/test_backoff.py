@@ -1,0 +1,62 @@
+"""utils/backoff.py: exponential shape, seeded determinism, budget use.
+
+The jobs-plane retry contract (docs/ROBUSTNESS.md "jobs plane", skylint
+``backoff-discipline``): every retry loop sleeps through this helper,
+and a fixed seed makes a chaos run's retry timeline bit-reproducible.
+"""
+import pytest
+
+from skypilot_tpu.utils import backoff
+
+
+class TestBackoff:
+
+    def test_exponential_growth_with_jitter_bounds(self):
+        b = backoff.Backoff(base=1.0, cap=64.0, seed=0)
+        for n in range(6):
+            gap = b.next()
+            raw = min(64.0, 2.0 ** n)
+            assert 0.5 * raw <= gap <= raw
+
+    def test_cap_bounds_late_attempts(self):
+        b = backoff.Backoff(base=1.0, cap=4.0, seed=0)
+        gaps = [b.next() for _ in range(10)]
+        assert all(g <= 4.0 for g in gaps[3:])
+
+    def test_seed_determinism_and_independence(self):
+        one = backoff.Backoff(base=1, cap=30, seed=7)
+        two = backoff.Backoff(base=1, cap=30, seed=7)
+        other = backoff.Backoff(base=1, cap=30, seed=8)
+        s1 = [one.next() for _ in range(5)]
+        s2 = [two.next() for _ in range(5)]
+        s3 = [other.next() for _ in range(5)]
+        assert s1 == s2          # same seed → identical timeline
+        assert s1 != s3          # different job → desynchronized
+
+    def test_reset_restarts_the_ramp(self):
+        b = backoff.Backoff(base=1.0, cap=64.0, seed=1)
+        for _ in range(5):
+            b.next()
+        b.reset()
+        assert b.next() <= 1.0   # back to attempt 0
+
+    def test_sleep_returns_duration(self, monkeypatch):
+        import skypilot_tpu.utils.backoff as backoff_mod
+        slept = []
+        monkeypatch.setattr(backoff_mod.time, 'sleep', slept.append)
+        b = backoff.Backoff(base=0.25, cap=1.0, seed=2)
+        d = b.sleep()
+        assert slept == [d]
+
+    def test_no_overflow_on_retry_forever(self):
+        # 2.0**attempt overflows float at ~1024 without the exponent
+        # clamp — a retry-forever recovery loop reaches that.
+        b = backoff.Backoff(base=20.0, cap=300.0, seed=3)
+        for _ in range(1500):
+            assert 0 < b.next() <= 300.0
+
+    def test_invalid_args_rejected(self):
+        with pytest.raises(ValueError):
+            backoff.Backoff(base=-1)
+        with pytest.raises(ValueError):
+            backoff.Backoff(cap=-0.1)

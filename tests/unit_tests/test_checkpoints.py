@@ -136,6 +136,277 @@ class TestLoader:
         assert batch.shape == (2, 65)
 
 
+def _host_state(cfg, tx):
+    """A TrainState built eagerly on host — no mesh-context APIs, so
+    these tests run on every jax version the repo supports."""
+    from skypilot_tpu import models as models_lib
+    mod = models_lib.module_for(cfg)
+    params = mod.init_params(jax.random.PRNGKey(0), cfg)
+    return train_lib.TrainState(
+        step=jax.numpy.asarray(3, jax.numpy.int32), params=params,
+        opt_state=tx.init(params))
+
+
+def _place(state, cfg, mesh, tx):
+    shardings = train_lib.state_shardings(cfg, mesh, tx)
+    return jax.tree.map(jax.device_put, state,
+                        train_lib.TrainState(step=shardings.step,
+                                             params=shardings.params,
+                                             opt_state=shardings.opt_state))
+
+
+def _assert_trees_bitequal(a, b):
+    jax.tree.map(
+        lambda x, y: np.testing.assert_array_equal(
+            np.asarray(jax.device_get(x)), np.asarray(jax.device_get(y))),
+        a, b)
+
+
+class TestReshardRoundtrip:
+    """Topology-independent restore: a checkpoint written on one mesh
+    shape restores bit-identically onto any other (the preemption-
+    recovery contract — the relaunch takes whatever slice shape it
+    gets)."""
+
+    @pytest.fixture(scope='class')
+    def saved(self, tmp_path_factory):
+        cfg = dataclasses.replace(llama.PRESETS['llama-debug'], n_layers=1,
+                                  dim=32, ffn_dim=64, max_seq_len=64)
+        tx = train_lib.default_optimizer(warmup_steps=2, total_steps=100)
+        save_mesh = build_mesh(MeshSpec(data=2, fsdp=4))
+        state = _place(_host_state(cfg, tx), cfg, save_mesh, tx)
+        directory = str(tmp_path_factory.mktemp('reshard') / 'ckpt')
+        with checkpoints.Checkpointer(directory) as ckpt:
+            assert ckpt.save(state, wait=True) == 3
+        return cfg, tx, state, directory
+
+    @pytest.mark.parametrize('mesh_kwargs,devices', [
+        (dict(data=1, fsdp=8), None),
+        (dict(data=4, fsdp=2), None),
+        (dict(data=1, fsdp=1), 1),     # single host: slice shape gone
+    ])
+    def test_restore_other_topology_bitidentical(self, saved, mesh_kwargs,
+                                                 devices):
+        cfg, tx, state, directory = saved
+        new_mesh = build_mesh(
+            MeshSpec(**mesh_kwargs),
+            devices=jax.devices()[:devices] if devices else None)
+        restored, step = checkpoints.Checkpointer(directory).restore(
+            cfg, new_mesh, tx)
+        assert step == 3
+        assert jax.tree.structure(restored) == jax.tree.structure(state)
+        for got, want in zip(jax.tree.leaves(restored),
+                             jax.tree.leaves(state)):
+            assert got.dtype == want.dtype
+            assert got.shape == want.shape
+            np.testing.assert_array_equal(np.asarray(jax.device_get(got)),
+                                          np.asarray(jax.device_get(want)))
+        for leaf in jax.tree.leaves(restored.params):
+            assert dict(leaf.sharding.mesh.shape) == dict(new_mesh.shape)
+
+    def test_manifest_records_logical_layout(self, saved):
+        _, _, _, directory = saved
+        import json
+        import os
+        step_dir = os.path.join(directory, 'step_00000003')
+        with open(os.path.join(step_dir, 'MANIFEST.json'),
+                  encoding='utf-8') as f:
+            manifest = json.load(f)
+        assert manifest['format'] == checkpoints.FORMAT_VERSION
+        assert manifest['mesh_axes']['data'] == 2     # advisory only
+        specs = {rec['path']: rec['spec'] for rec in manifest['arrays']}
+        # At least one param is sharded by NAMED axis, none by device:
+        # the layout is logical, so any topology can re-slice it.
+        assert any(spec and any(e is not None for e in spec)
+                   for spec in specs.values())
+        for rec in manifest['arrays']:
+            assert rec['chunks'], rec['path']
+            for chunk in rec['chunks']:
+                assert set(chunk) == {'file', 'start', 'shape', 'sha256'}
+
+
+class TestCorruptionRefusal:
+
+    @pytest.fixture
+    def saved(self, tmp_path):
+        cfg = dataclasses.replace(llama.PRESETS['llama-debug'], n_layers=1,
+                                  dim=32, ffn_dim=64, max_seq_len=64)
+        tx = train_lib.default_optimizer(warmup_steps=2, total_steps=100)
+        mesh = build_mesh(MeshSpec(data=2, fsdp=4))
+        state = _place(_host_state(cfg, tx), cfg, mesh, tx)
+        directory = str(tmp_path / 'ckpt')
+        with checkpoints.Checkpointer(directory) as ckpt:
+            ckpt.save(state, 3, wait=True)
+            ckpt.save(state, 5, wait=True)
+        return cfg, tx, mesh, state, directory
+
+    def _chunks_of(self, directory, step):
+        import glob
+        import os
+        return sorted(glob.glob(os.path.join(
+            directory, f'step_{step:08d}', 'arrays', '*.npy')))
+
+    def test_corrupt_manifest_refused(self, saved):
+        import os
+        cfg, tx, mesh, _, directory = saved
+        mpath = os.path.join(directory, 'step_00000005', 'MANIFEST.json')
+        with open(mpath, 'r+', encoding='utf-8') as f:
+            f.truncate(17)    # mid-JSON: parseable as nothing
+        ckpt = checkpoints.Checkpointer(directory)
+        with pytest.raises(checkpoints.CheckpointCorruptError,
+                           match='manifest'):
+            ckpt.restore(cfg, mesh, tx, step=5)
+
+    def test_truncated_array_refused(self, saved):
+        cfg, tx, mesh, _, directory = saved
+        with open(self._chunks_of(directory, 5)[0], 'r+b') as f:
+            f.truncate(32)
+        ckpt = checkpoints.Checkpointer(directory)
+        with pytest.raises(checkpoints.CheckpointCorruptError,
+                           match='digest'):
+            ckpt.restore(cfg, mesh, tx, step=5)
+
+    def test_bitflipped_array_refused(self, saved):
+        import os
+        cfg, tx, mesh, _, directory = saved
+        chunk = max(self._chunks_of(directory, 5), key=os.path.getsize)
+        offset = os.path.getsize(chunk) // 2
+        with open(chunk, 'r+b') as f:
+            f.seek(offset)
+            byte = f.read(1)
+            f.seek(offset)
+            f.write(bytes([byte[0] ^ 0xff]))
+        ckpt = checkpoints.Checkpointer(directory)
+        with pytest.raises(checkpoints.CheckpointCorruptError,
+                           match='digest'):
+            ckpt.restore(cfg, mesh, tx, step=5)
+
+    def test_restore_newest_falls_back_to_complete_step(self, saved):
+        cfg, tx, mesh, state, directory = saved
+        with open(self._chunks_of(directory, 5)[0], 'r+b') as f:
+            f.truncate(32)
+        ckpt = checkpoints.Checkpointer(directory)
+        abstract = checkpoints.abstract_train_state(cfg, mesh, tx)
+        restored, step = ckpt.restore_newest(abstract)
+        assert step == 3      # 5 refused loudly, 3 restored
+        _assert_trees_bitequal(restored, state)
+
+    def test_all_steps_corrupt_raises_instead_of_reinit(self, saved):
+        cfg, tx, mesh, _, directory = saved
+        for step in (3, 5):
+            with open(self._chunks_of(directory, step)[0], 'r+b') as f:
+                f.truncate(32)
+        ckpt = checkpoints.Checkpointer(directory)
+        abstract = checkpoints.abstract_train_state(cfg, mesh, tx)
+        with pytest.raises(checkpoints.CheckpointCorruptError,
+                           match='refusing'):
+            ckpt.restore_newest(abstract)
+
+    def test_partial_step_invisible_and_cleaned(self, saved):
+        import os
+        cfg, tx, mesh, state, directory = saved
+        from skypilot_tpu.utils import failpoints
+        ckpt = checkpoints.Checkpointer(directory)
+        failpoints.arm('ckpt.save', once=True)
+        try:
+            with pytest.raises(failpoints.FailpointError):
+                ckpt.save(state, 7, wait=True)
+        finally:
+            failpoints.reset()
+        # Chunks hit disk, the manifest never did: step 7 must not
+        # exist for any reader.
+        assert ckpt.all_steps() == [3, 5]
+        assert ckpt.latest_step() == 5
+        leftovers = [n for n in os.listdir(directory)
+                     if n.startswith('.tmp-')]
+        assert leftovers                     # the interrupted write
+        # A restore-only Checkpointer must NOT sweep (it could be a
+        # reader racing a live writer); the next WRITER does.
+        reader = checkpoints.Checkpointer(directory)
+        abstract = checkpoints.abstract_train_state(cfg, mesh, tx)
+        reader.restore_newest(abstract)
+        assert [n for n in os.listdir(directory)
+                if n.startswith('.tmp-')] == leftovers
+        writer = checkpoints.Checkpointer(directory)
+        writer.save(state, 9, wait=True)
+        assert not [n for n in os.listdir(directory)
+                    if n.startswith('.tmp-')]
+        assert writer.all_steps() == [3, 5, 9]
+
+    def test_tampered_chunk_geometry_refused(self, saved):
+        """The sha256s cover chunk FILES, not the manifest: shifted or
+        duplicated 'start's must be refused as corruption (they would
+        otherwise permute values or leave uninitialized memory), and
+        the refusal must stay inside the CheckpointCorruptError
+        fallback contract — never a raw numpy error."""
+        import json
+        import os
+        cfg, tx, mesh, state, directory = saved
+        mpath = os.path.join(directory, 'step_00000005', 'MANIFEST.json')
+        with open(mpath, encoding='utf-8') as f:
+            manifest = json.load(f)
+        sharded = next(rec for rec in manifest['arrays']
+                       if len(rec['chunks']) > 1)
+        sharded['chunks'][0]['start'] = list(
+            sharded['chunks'][1]['start'])     # duplicate placement
+        with open(mpath, 'w', encoding='utf-8') as f:
+            json.dump(manifest, f)
+        ckpt = checkpoints.Checkpointer(directory)
+        with pytest.raises(checkpoints.CheckpointCorruptError,
+                           match='overlap|geometry'):
+            ckpt.restore(cfg, mesh, tx, step=5)
+        # And the fallback walk still lands on the older complete step.
+        abstract = checkpoints.abstract_train_state(cfg, mesh, tx)
+        _, step = ckpt.restore_newest(abstract)
+        assert step == 3
+
+    def test_out_of_range_chunk_start_refused(self, saved):
+        import json
+        import os
+        cfg, tx, mesh, _, directory = saved
+        mpath = os.path.join(directory, 'step_00000005', 'MANIFEST.json')
+        with open(mpath, encoding='utf-8') as f:
+            manifest = json.load(f)
+        sharded = next(rec for rec in manifest['arrays']
+                       if len(rec['chunks']) > 1)
+        sharded['chunks'][0]['start'][0] = 10 ** 6
+        with open(mpath, 'w', encoding='utf-8') as f:
+            json.dump(manifest, f)
+        ckpt = checkpoints.Checkpointer(directory)
+        with pytest.raises(checkpoints.CheckpointCorruptError,
+                           match='geometry'):
+            ckpt.restore(cfg, mesh, tx, step=5)
+
+    def test_close_is_idempotent_and_late_wait_returns(self, saved):
+        """Shutdown accounting: the worker's exit sentinel must be
+        task_done'd, or any wait()/close() after the first close blocks
+        forever in queue.join()."""
+        cfg, tx, mesh, state, directory = saved
+        ckpt = checkpoints.Checkpointer(directory)
+        ckpt.save(state, 9)     # async → spins up the worker
+        ckpt.close()
+        ckpt.close()            # second close must not hang
+        ckpt.wait()             # nor a late flush barrier
+        assert 9 in ckpt.all_steps()
+
+    def test_final_save_of_inflight_step_serializes(self, saved):
+        """The preemption arc: an async cadence save of step N followed
+        immediately by the synchronous final save of the SAME step must
+        serialize (shared deterministic tmp dir), not race the rename."""
+        cfg, tx, mesh, state, directory = saved
+        with checkpoints.Checkpointer(directory) as ckpt:
+            ckpt.save(state, 9)             # async, in flight
+            ckpt.save(state, 9, wait=True)  # the preemption final save
+            assert 9 in ckpt.all_steps()
+
+    def test_config_mismatch_is_not_corruption(self, saved):
+        cfg, tx, mesh, _, directory = saved
+        smaller = dataclasses.replace(cfg, dim=16, ffn_dim=32)
+        ckpt = checkpoints.Checkpointer(directory)
+        with pytest.raises(ValueError, match='config mismatch'):
+            ckpt.restore(smaller, mesh, tx, step=5)
+
+
 class TestTrainerResume:
 
     def test_trainer_end_to_end_resume(self, tmp_path):

@@ -188,6 +188,12 @@ class TestDiscovery:
                 'lb.upstream_connect', 'lb.upstream_read',
                 'serve.probe', 'controller.reconcile',
                 'sqlite.commit'} <= names
+        # The jobs/training-plane sites (preemption-resilient elastic
+        # training): tests/chaos/test_train_churn.py drives these.
+        assert {'jobs.preempt', 'jobs.launch', 'jobs.setup',
+                'jobs.terminate', 'skylet.job_submit',
+                'ckpt.save', 'ckpt.restore',
+                'trainer.preempt'} <= names
         # Naming contract holds for every discovered site.
         for name in names:
             assert failpoints.NAME_RE.match(name), name

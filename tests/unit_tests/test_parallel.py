@@ -67,3 +67,40 @@ class TestRules:
         r = Rules().override(seq='fsdp')
         with pytest.raises(ValueError):
             r.spec('embed', 'seq')
+
+
+class TestSpecSerialization:
+    """The checkpoint manifest's logical-layout half
+    (train/checkpoints.py records spec_to_json per array; the restore
+    side resolves placement from the abstract target, so the recorded
+    spec is advisory — but it must round-trip faithfully for tooling
+    that reads manifests)."""
+
+    @pytest.mark.parametrize('spec', [
+        PartitionSpec(),
+        PartitionSpec('fsdp'),
+        PartitionSpec(None, 'tensor'),
+        PartitionSpec(('data', 'fsdp'), None),
+        PartitionSpec('fsdp', None, ('expert', 'tensor')),
+    ])
+    def test_round_trip(self, spec):
+        from skypilot_tpu.parallel import sharding as sharding_lib
+        encoded = sharding_lib.spec_to_json(spec)
+        import json
+        assert json.loads(json.dumps(encoded)) == encoded  # JSON-safe
+        assert sharding_lib.spec_from_json(encoded) == spec
+
+
+class TestHostTransfers:
+
+    def test_host_to_sharded_and_back(self):
+        import numpy as np
+        from jax.sharding import NamedSharding
+        from skypilot_tpu.parallel import sharding as sharding_lib
+        mesh = build_mesh(MeshSpec(data=2, fsdp=4), platform='cpu')
+        host = np.arange(64, dtype=np.float32).reshape(8, 8)
+        arr = sharding_lib.host_to_sharded(
+            host, NamedSharding(mesh, PartitionSpec('fsdp', None)))
+        assert not arr.sharding.is_fully_replicated
+        np.testing.assert_array_equal(sharding_lib.sharded_to_host(arr),
+                                      host)

@@ -38,7 +38,7 @@ EXPECTED_CHECKS = [
     'host-sync-loop', 'page-table-shape', 'sqlite-discipline',
     'state-machine', 'thread-discipline', 'silent-except',
     'metric-discipline', 'span-discipline', 'timeout-discipline',
-    'failpoint-naming',
+    'failpoint-naming', 'backoff-discipline',
 ]
 
 
@@ -1053,6 +1053,91 @@ class TestFailpointNamingChecker:
             'failpoint-naming:serve/orelse.py:engine.step:unguarded']
 
 
+class TestBackoffDisciplineChecker:
+
+    def test_const_retry_sleep_flagged(self, tmp_path):
+        # The exact pre-fix shapes from jobs/recovery_strategy.py: a
+        # literal sleep and a module-constant sleep inside except
+        # handlers inside retry loops.
+        _write(tmp_path, 'jobs/bad.py', '''\
+            import time
+
+            RETRY_GAP_SECONDS = 20
+
+            def terminate(max_retries=3):
+                for attempt in range(max_retries):
+                    try:
+                        do_teardown()
+                        return
+                    except Exception:
+                        time.sleep(5)
+
+            def recover():
+                while True:
+                    try:
+                        return launch()
+                    except RuntimeError:
+                        time.sleep(RETRY_GAP_SECONDS)
+        ''')
+        report = _run(tmp_path, checks=['backoff-discipline'])
+        assert sorted(_idents(report)) == [
+            'backoff-discipline:jobs/bad.py:recover:RETRY_GAP_SECONDS',
+            'backoff-discipline:jobs/bad.py:terminate:5',
+        ]
+
+    def test_backoff_and_poll_sleeps_pass(self, tmp_path):
+        # Computed durations (the Backoff helper) and plain poll-loop
+        # cadences are fine; so is anything outside jobs//provision/.
+        _write(tmp_path, 'jobs/good.py', '''\
+            import time
+
+            from skypilot_tpu.utils import backoff as backoff_lib
+
+            POLL_SECONDS = 10
+
+            def recover(job_id):
+                retry = backoff_lib.Backoff(base=1, cap=30, seed=job_id)
+                while True:
+                    try:
+                        return launch()
+                    except RuntimeError:
+                        time.sleep(retry.next())
+
+            def monitor():
+                while True:
+                    time.sleep(POLL_SECONDS)   # poll cadence, no retry
+                    check()
+        ''')
+        _write(tmp_path, 'serve/elsewhere.py', '''\
+            import time
+
+            def retry():
+                for _ in range(3):
+                    try:
+                        return go()
+                    except OSError:
+                        time.sleep(5)
+        ''')
+        assert _run(tmp_path, checks=['backoff-discipline'])['total'] == 0
+
+    def test_nested_def_resets_retry_scope(self, tmp_path):
+        # A helper DEFINED inside an except handler does not execute
+        # there; its own sleeps are not retry sleeps.
+        _write(tmp_path, 'provision/nested.py', '''\
+            import time
+
+            def outer():
+                for _ in range(3):
+                    try:
+                        return go()
+                    except OSError:
+                        def waiter():
+                            time.sleep(2)
+                        register(waiter)
+        ''')
+        assert _run(tmp_path, checks=['backoff-discipline'])['total'] == 0
+
+
 # ------------------------------------------------------------ allowlist + report
 
 class TestAllowlistAndReport:
@@ -1320,7 +1405,7 @@ class TestLivePackage:
         with open(out_path, encoding='utf-8') as f:
             report = json.load(f)
         # Schema stability (version-bump ratchet).
-        assert report['skylint_version'] == core.REPORT_VERSION == 7
+        assert report['skylint_version'] == core.REPORT_VERSION == 8
         assert set(report) == {
             'skylint_version', 'root', 'files_scanned', 'checks',
             'violations', 'total', 'allowlisted', 'new',
