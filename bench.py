@@ -676,38 +676,14 @@ async def _drive_mixed_load(port, concurrency, n_requests, short_len,
 
 
 def _histogram_quantile(text: str, family: str, q: float) -> float:
-    """Prometheus-style histogram_quantile over one family's buckets
-    (no labels): linear interpolation inside the bucket the q-th
-    sample lands in — the same estimate a dashboard would show.
-    Returns nan when the family has no samples."""
-    buckets = []
-    prefix = f'{family}_bucket{{le="'
-    for line in text.splitlines():
-        if not line.startswith(prefix):
-            continue
-        le_str = line[len(prefix):].split('"', 1)[0]
-        le = float('inf') if le_str == '+Inf' else float(le_str)
-        try:
-            buckets.append((le, float(line.rsplit(' ', 1)[1])))
-        except ValueError:
-            pass
-    buckets.sort()
-    if not buckets or buckets[-1][1] <= 0:
-        return float('nan')
-    count = buckets[-1][1]
-    rank = q * count
-    lo_bound = lo_count = 0.0
-    for le, cum in buckets:
-        if cum >= rank:
-            if le == float('inf'):
-                # Open-ended tail: the lower bound is the honest
-                # answer (Prometheus returns the last finite bound).
-                return lo_bound
-            span_count = cum - lo_count
-            frac = ((rank - lo_count) / span_count) if span_count else 0
-            return lo_bound + (le - lo_bound) * frac
-        lo_bound, lo_count = le, cum
-    return lo_bound
+    """Prometheus-style histogram_quantile over one family — the ONE
+    shared definition in observe/promtext.py (exposition parser +
+    bucket merge + quantile), also used by the `observe fleet` CLI and
+    the SLO engine. bench.py's former private line-regexing copy was
+    the drift that motivated the factoring. Returns nan when the
+    family has no samples."""
+    from skypilot_tpu.observe import promtext
+    return promtext.quantile_from_text(text, family, q)
 
 
 def _scrape_host_overhead(port: int) -> dict:

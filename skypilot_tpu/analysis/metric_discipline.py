@@ -20,11 +20,22 @@ months later:
      series cardinality grow with traffic. The runtime registry refuses
      undeclared values too — this catches the shape before it ships.
 
-Scope: modules that import ``skypilot_tpu.observe`` (module-level or
-lazy), keyed on the declaration idiom ``metrics.counter(...)`` /
-``metrics_lib.gauge(...)`` / ``REGISTRY.histogram(...)``. The
-``observe`` package itself (which manipulates names generically) and
-``analysis`` (fixtures/prose) are exempt.
+  4. one exposition parser — string literals that smell of AD-HOC
+     Prometheus-text regexing (``_bucket{`` / ``{le="`` fragments used
+     to prefix-match or regex metric lines) are flagged OUTSIDE
+     ``observe/``: every metric-text read goes through
+     ``observe/promtext.py`` (parse + bucket merge + quantile), the
+     one definition bench.py, the fleet CLI and the SLO engine share.
+     A private line parser quietly assumes label order and bucket
+     layout — the drift that motivated the promtext factoring.
+
+Scope: rules 1–3 apply to modules that import
+``skypilot_tpu.observe`` (module-level or lazy), keyed on the
+declaration idiom ``metrics.counter(...)`` / ``metrics_lib.gauge(...)``
+/ ``REGISTRY.histogram(...)``; rule 4 applies to EVERY scanned module
+(an ad-hoc parser needs no observe import). The ``observe`` package
+itself (which manipulates names generically) and ``analysis``
+(fixtures/prose) are exempt.
 """
 from __future__ import annotations
 
@@ -105,12 +116,59 @@ def _labels_arg(call: ast.Call) -> Optional[ast.expr]:
     return None
 
 
+# Substrings a string literal only carries when it is being used to
+# hand-parse exposition text (histogram bucket lines). Metric NAME
+# literals (declarations, .startswith on a family) never contain them.
+_EXPOSITION_MARKERS = ('_bucket{', '{le="')
+
+
+def _docstring_nodes(tree: ast.Module) -> set:
+    """ids of docstring Constant nodes (module/class/def bodies) —
+    prose ABOUT bucket lines is not parsing them."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            body = getattr(node, 'body', [])
+            if body and isinstance(body[0], ast.Expr) and \
+                    isinstance(body[0].value, ast.Constant) and \
+                    isinstance(body[0].value.value, str):
+                out.add(id(body[0].value))
+    return out
+
+
+def _adhoc_exposition(mod: core.ModuleInfo) -> List[core.Violation]:
+    docstrings = _docstring_nodes(mod.tree)
+    out: List[core.Violation] = []
+    for node in ast.walk(mod.tree):
+        if not (isinstance(node, ast.Constant) and
+                isinstance(node.value, str)):
+            continue
+        if id(node) in docstrings:
+            continue
+        if not any(marker in node.value
+                   for marker in _EXPOSITION_MARKERS):
+            continue
+        out.append(core.Violation(
+            check=NAME, path=mod.path, line=node.lineno,
+            col=node.col_offset, key='adhoc-exposition-parse',
+            message=(
+                'ad-hoc Prometheus exposition parsing (a string '
+                'literal carrying a bucket-line fragment) — read '
+                'metric text through observe/promtext.py (parse + '
+                'merge_histograms + histogram_quantile), the one '
+                'shared definition; private line parsers drift on '
+                'label order and bucket layout')))
+    return out
+
+
 def run(mod: core.ModuleInfo) -> List[core.Violation]:
     if mod.unit in ('analysis', 'observe'):
         return []
-    if not _imports_observe(mod.tree):
-        return []
     out: List[core.Violation] = []
+    out.extend(_adhoc_exposition(mod))
+    if not _imports_observe(mod.tree):
+        return out
     for node in ast.walk(mod.tree):
         if not isinstance(node, ast.Call):
             continue

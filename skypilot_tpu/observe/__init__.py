@@ -25,31 +25,48 @@ layer of the control plane:
     into the journal on engine failures), from which per-request
     TTFT/TPOT derive without a single span or sqlite write per token.
 
-See docs/OBSERVABILITY.md for the metric catalog, journal/span schema
-and the trace propagation diagram.
+The FLEET plane (PR 9) builds on those five:
+
+  * :mod:`~skypilot_tpu.observe.promtext` — the one exposition
+    parser/merger/quantile every metric-text consumer goes through;
+  * :mod:`~skypilot_tpu.observe.tsdb` — the scraped-sample
+    time-series table (same DB file, own retention);
+  * :mod:`~skypilot_tpu.observe.scrape` — the controller-side scraper
+    pulling every replica's ``/metrics`` + ``/health`` with
+    per-target failure containment;
+  * :mod:`~skypilot_tpu.observe.slo` — declarative SLOs evaluated as
+    multi-window burn rates over the scraped samples.
+
+See docs/OBSERVABILITY.md for the metric catalog, journal/span/sample
+schema and the trace propagation diagram.
 """
 from typing import Dict
 
 from skypilot_tpu.observe import flight
 from skypilot_tpu.observe import journal
 from skypilot_tpu.observe import metrics
+from skypilot_tpu.observe import promtext
 from skypilot_tpu.observe import spans
 from skypilot_tpu.observe import trace
+from skypilot_tpu.observe import tsdb
 
-__all__ = ['flight', 'gc', 'journal', 'metrics', 'spans', 'trace']
+__all__ = ['flight', 'gc', 'journal', 'metrics', 'promtext', 'spans',
+           'trace', 'tsdb']
 
 
 def gc(max_age_seconds: float = 7 * 24 * 3600,
        max_rows: int = 500_000) -> Dict[str, int]:
-    """Retention for BOTH journal tables (events + spans), one call —
-    the API server's hourly GC loop and the serve controller's
-    reconcile loop both run it, so every process that writes the
-    journal also collects it (events and spans accrue in whichever
+    """Retention for ALL journal-DB tables (events + spans + scraped
+    samples), one call — the API server's hourly GC loop and the serve
+    controller's reconcile loop both run it, so every process that
+    writes the journal also collects it (rows accrue in whichever
     process's DB the writer saw; GC only in the API server would leak
     the controller- and LB-written rows forever). Same Nth-newest-id
-    row-cap discipline in both tables; best-effort like every
+    row-cap discipline in every table; best-effort like every
     telemetry write."""
     return {'events': journal.gc_events(max_age_seconds=max_age_seconds,
                                         max_rows=max_rows),
             'spans': spans.gc_spans(max_age_seconds=max_age_seconds,
-                                    max_rows=max_rows)}
+                                    max_rows=max_rows),
+            'samples': tsdb.gc_samples(max_age_seconds=max_age_seconds,
+                                       max_rows=max_rows)}

@@ -18,6 +18,13 @@ Commands:
              shared rotating writer; ``--chrome`` writes the span
              tables merged with any timeline capture as Chrome
              trace-event JSON instead (load in Perfetto)
+  fleet    — the fleet view: per-replica scrape/saturation table +
+             merged fleet TTFT/TPOT p50/p95 (the shared
+             promtext.histogram_quantile). ``--url`` asks a live
+             serve LB (``/-/fleet/status`` + ``/-/fleet/metrics``);
+             without it the local scraped-samples table is read
+             (``--db`` repoints, ``--window`` bounds the quantile
+             window)
 
 Exit codes: 0 ok, 2 usage error.
 """
@@ -101,6 +108,115 @@ def _fetch_metrics(url: Optional[str]) -> str:
         return resp.read().decode('utf-8', errors='replace')
 
 
+_FLEET_QUANTILES = ((0.50, 'p50'), (0.95, 'p95'))
+_FLEET_FAMILIES = (('skytpu_engine_ttft_seconds', 'ttft'),
+                   ('skytpu_engine_tpot_seconds', 'tpot'))
+
+
+def _http_json(url: str) -> Dict[str, Any]:
+    from urllib import request as urlrequest
+    with urlrequest.urlopen(url, timeout=10) as resp:
+        return json.loads(resp.read().decode('utf-8'))
+
+
+def _http_text(url: str) -> str:
+    from urllib import request as urlrequest
+    with urlrequest.urlopen(url, timeout=10) as resp:
+        return resp.read().decode('utf-8', errors='replace')
+
+
+def _fleet_doc(url: Optional[str], db: Optional[str],
+               window: float) -> Dict[str, Any]:
+    """The fleet view as one JSON-able doc: per-replica rows + merged
+    quantiles. Live (--url → a serve LB's /-/fleet/ endpoints) or
+    offline (the scraped-samples table this process can see)."""
+    from skypilot_tpu.observe import promtext
+    if url is not None:
+        base = (url if '://' in url else f'http://{url}').rstrip('/')
+        doc = _http_json(base + '/-/fleet/status')
+        # /-/fleet/metrics legitimately answers 503 before the first
+        # scrape or during a full outage (every replica stale) — the
+        # per-replica status table we ALREADY have is the operator's
+        # diagnostic in exactly that moment, so degrade to it instead
+        # of aborting the whole view on the metrics fetch.
+        try:
+            text = _http_text(base + '/-/fleet/metrics')
+        except OSError as e:
+            doc['fleet_quantiles'] = {}
+            doc['metrics_error'] = str(e)
+            return doc
+        quantiles: Dict[str, float] = {}
+        for family, short in _FLEET_FAMILIES:
+            for q, suffix in _FLEET_QUANTILES:
+                v = promtext.quantile_from_text(text, family, q)
+                if v == v:                       # not NaN
+                    quantiles[f'{short}_{suffix}_ms'] = round(v * 1e3,
+                                                              2)
+        doc['fleet_quantiles'] = quantiles
+        return doc
+    if db is not None:
+        os.environ['SKYTPU_OBSERVE_DB'] = db
+    from skypilot_tpu.observe import slo as slo_lib
+    from skypilot_tpu.observe import tsdb
+    now = time.time()
+    replicas = []
+    for target in tsdb.targets(since=now - window):
+        row: Dict[str, Any] = {'entity': target}
+        up = tsdb.latest_round('skytpu_scrape_up', target)
+        if up:
+            ts, val = next(iter(up.values()))
+            row['last_success_age'] = (round(now - ts, 1)
+                                       if val >= 0.5 else None)
+            row['up'] = val >= 0.5
+        for name, key in (('skytpu_engine_queue_depth', 'queue_depth'),
+                          ('skytpu_engine_in_flight', 'in_flight'),
+                          ('skytpu_engine_kv_pages_free',
+                           'kv_pages_free')):
+            latest = tsdb.latest_round(name, target)
+            if latest:
+                row[key] = next(iter(latest.values()))[1]
+        replicas.append(row)
+    quantiles = {}
+    for family, short in _FLEET_FAMILIES:
+        hist = slo_lib.windowed_histogram(family, window, now)
+        for q, suffix in _FLEET_QUANTILES:
+            v = promtext.histogram_quantile(hist, q)
+            if v == v:
+                quantiles[f'{short}_{suffix}_ms'] = round(v * 1e3, 2)
+    return {'replicas': replicas, 'fleet_quantiles': quantiles,
+            'window_seconds': window}
+
+
+def _print_fleet(doc: Dict[str, Any]) -> None:
+    replicas = doc.get('replicas') or []
+    cols = ('entity', 'url', 'up', 'last_success_age', 'queue_depth',
+            'in_flight', 'kv_pages_free', 'stale', 'error')
+    present = [c for c in cols
+               if any(c in r and r[c] is not None for r in replicas)]
+    if replicas and present:
+        widths = {c: max(len(c), *(len(str(r.get(c, '')))
+                                   for r in replicas))
+                  for c in present}
+        print('  '.join(c.ljust(widths[c]) for c in present))
+        for r in replicas:
+            print('  '.join(str(r.get(c, '')).ljust(widths[c])
+                            for c in present))
+    else:
+        print('(no replicas scraped)')
+    slo_states = doc.get('slo')
+    if slo_states:
+        print('slo: ' + '  '.join(f'{k}={v}'
+                                  for k, v in sorted(slo_states.items())))
+    quantiles = doc.get('fleet_quantiles') or {}
+    if quantiles:
+        print('fleet: ' + '  '.join(f'{k}={v}'
+                                    for k, v in sorted(quantiles.items())))
+    elif doc.get('metrics_error'):
+        print(f'fleet: (metrics unavailable: {doc["metrics_error"]})')
+    else:
+        print('fleet: (no histogram samples yet)')
+
+
 def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
         prog='python -m skypilot_tpu.observe',
@@ -151,6 +267,20 @@ def build_parser() -> argparse.ArgumentParser:
     p_export.add_argument('--kind')
     p_export.add_argument('--since', type=float)
     p_export.add_argument('--limit', type=int, default=100000)
+
+    p_fleet = sub.add_parser(
+        'fleet', help='per-replica table + merged fleet quantiles')
+    p_fleet.add_argument('--url', default=None,
+                         help='a live serve LB (host:port or URL); '
+                              'fetches /-/fleet/status + '
+                              '/-/fleet/metrics')
+    p_fleet.add_argument('--db', default=None,
+                         help='read this observe DB instead of the '
+                              'default local one (no --url)')
+    p_fleet.add_argument('--window', type=float, default=3600.0,
+                         help='quantile window in seconds for the '
+                              'offline (tsdb) path')
+    p_fleet.add_argument('--json', action='store_true')
     return parser
 
 
@@ -178,6 +308,17 @@ def main(argv=None) -> int:
             print(json.dumps(result, indent=2))
         else:
             print(spans_lib.format_tree(result))
+    elif args.cmd == 'fleet':
+        try:
+            doc = _fleet_doc(args.url, args.db, args.window)
+        except (OSError, ValueError) as e:
+            print(f'observe: could not fetch fleet view: {e}',
+                  file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(doc, indent=2))
+        else:
+            _print_fleet(doc)
     elif args.cmd == 'export':
         if args.chrome:
             # chrome_trace filters by trace id only — refuse the other

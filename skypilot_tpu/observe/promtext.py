@@ -1,0 +1,409 @@
+"""Prometheus text-exposition parser, histogram merge, and quantile.
+
+ONE definition of "how metric text is read" for the whole codebase.
+Before this module, every consumer of a ``/metrics`` endpoint grew its
+own ad-hoc line regexing (bench.py's private ``_histogram_quantile``
+was the live example) — each with its own quiet assumptions about
+label order and bucket layout. Now bench.py, the fleet CLI
+(``python -m skypilot_tpu.observe fleet``), the controller scraper
+(observe/scrape.py) and the SLO engine (observe/slo.py) all parse
+through here, and the skylint ``metric-discipline`` checker flags any
+new ad-hoc exposition regexing outside ``observe/``.
+
+Three layers:
+
+  * :func:`parse` — exposition text → ``{name: Family}`` (type, help,
+    samples with parsed label sets). Tolerant of unknown families,
+    strict about sample-line shape.
+  * histogram structure — :func:`extract_histograms` groups one
+    family's ``_bucket``/``_sum``/``_count`` samples into
+    :class:`HistogramData` per label set, and :func:`merge_histograms`
+    merges shards **bucket-wise** (cumulative Prometheus buckets merge
+    by addition). Mismatched bucket layouts REFUSE loudly
+    (:class:`BucketMismatchError`) — silently merging different
+    layouts would fabricate quantiles.
+  * :func:`histogram_quantile` — the dashboard estimate: linear
+    interpolation inside the bucket the q-th sample lands in; the
+    +Inf tail answers with the last finite bound; ``nan`` with no
+    samples.
+
+Fleet aggregation (:func:`merge_texts`): counters and gauges sum per
+label set across shards, histograms merge bucket-wise — the
+"federate-and-sum" shape ``/-/fleet/metrics`` exposes.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
+
+# A parsed sample's label set: sorted (name, value) pairs — hashable,
+# order-insensitive, so samples from shards that render labels in
+# different orders still line up.
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+class BucketMismatchError(ValueError):
+    """Histogram shards disagree on bucket layout: merging them
+    bucket-wise would silently fabricate counts, so refuse loudly.
+    The fix is at the source — histograms meant to merge fleet-wide
+    must declare identical buckets (docs/OBSERVABILITY.md)."""
+
+
+@dataclasses.dataclass
+class Sample:
+    name: str                      # full sample name incl. _bucket etc.
+    labels: LabelKey
+    value: float
+
+
+@dataclasses.dataclass
+class Family:
+    name: str
+    kind: str = 'untyped'          # counter | gauge | histogram | untyped
+    help_text: str = ''
+    samples: List[Sample] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class HistogramData:
+    """One histogram series (one label set): cumulative buckets plus
+    the _sum/_count scalars. ``buckets`` is sorted by bound; the +Inf
+    bucket is ALWAYS present and equals ``count`` (renderers that obey
+    the exposition contract guarantee it; :func:`extract_histograms`
+    repairs a missing +Inf from _count)."""
+    buckets: List[Tuple[float, float]]   # (le, cumulative count)
+    sum: float = 0.0
+    count: float = 0.0
+
+    def layout(self) -> Tuple[float, ...]:
+        return tuple(le for le, _ in self.buckets)
+
+
+def _unescape(text: str) -> str:
+    out, i = [], 0
+    while i < len(text):
+        ch = text[i]
+        if ch == '\\' and i + 1 < len(text):
+            nxt = text[i + 1]
+            out.append({'n': '\n', '\\': '\\', '"': '"'}.get(nxt, nxt))
+            i += 2
+        else:
+            out.append(ch)
+            i += 1
+    return ''.join(out)
+
+
+def _parse_labels(text: str) -> LabelKey:
+    """``a="x",b="y"`` → sorted pairs. Raises ValueError on shapes a
+    conforming renderer never emits (the caller skips the line)."""
+    pairs: List[Tuple[str, str]] = []
+    i = 0
+    while i < len(text):
+        eq = text.index('=', i)
+        name = text[i:eq].strip()
+        if not name or text[eq + 1] != '"':
+            raise ValueError(f'malformed label pair at {text[i:]!r}')
+        j = eq + 2
+        buf = []
+        while j < len(text):
+            ch = text[j]
+            if ch == '\\' and j + 1 < len(text):
+                buf.append(text[j:j + 2])
+                j += 2
+                continue
+            if ch == '"':
+                break
+            buf.append(ch)
+            j += 1
+        else:
+            raise ValueError('unterminated label value')
+        pairs.append((name, _unescape(''.join(buf))))
+        i = j + 1
+        if i < len(text):
+            if text[i] != ',':
+                raise ValueError(f'expected "," at {text[i:]!r}')
+            i += 1
+    return tuple(sorted(pairs))
+
+
+def _parse_value(text: str) -> float:
+    if text == '+Inf':
+        return math.inf
+    if text == '-Inf':
+        return -math.inf
+    return float(text)
+
+
+def base_name(sample_name: str) -> str:
+    """``foo_bucket``/``foo_sum``/``foo_count`` → ``foo`` (histogram
+    sample names fold into their family)."""
+    for suffix in ('_bucket', '_sum', '_count'):
+        if sample_name.endswith(suffix):
+            return sample_name[:-len(suffix)]
+    return sample_name
+
+
+def parse(text: str) -> Dict[str, Family]:
+    """Exposition text → families keyed by metric name. Sample lines
+    that do not parse are SKIPPED (a scraper must survive a partially
+    garbled shard), but ``# TYPE``/``# HELP`` inconsistencies within
+    one document raise — that is a broken renderer, not line noise."""
+    families: Dict[str, Family] = {}
+
+    def fam(name: str) -> Family:
+        f = families.get(name)
+        if f is None:
+            f = Family(name=name)
+            families[name] = f
+        return f
+
+    histogram_bases = set()
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line:
+            continue
+        if line.startswith('#'):
+            parts = line.split(None, 3)
+            if len(parts) >= 3 and parts[1] in ('TYPE', 'HELP'):
+                name = parts[2]
+                if parts[1] == 'TYPE':
+                    kind = parts[3].strip() if len(parts) > 3 else 'untyped'
+                    f = fam(name)
+                    if f.kind not in ('untyped', kind):
+                        raise ValueError(
+                            f'family {name!r} declared both {f.kind!r} '
+                            f'and {kind!r} in one document')
+                    f.kind = kind
+                    if kind == 'histogram':
+                        histogram_bases.add(name)
+                else:
+                    fam(name).help_text = _unescape(
+                        parts[3] if len(parts) > 3 else '')
+            continue
+        # Sample line: name[{labels}] value [timestamp]
+        try:
+            if '{' in line:
+                name_part, rest = line.split('{', 1)
+                label_part, tail = rest.rsplit('}', 1)
+                labels = _parse_labels(label_part)
+            else:
+                name_part, tail = line.split(None, 1)
+                labels = ()
+            name = name_part.strip()
+            value = _parse_value(tail.split()[0])
+        except (ValueError, IndexError):
+            continue
+        family_name = name
+        folded = base_name(name)
+        if folded in histogram_bases:
+            family_name = folded
+        fam(family_name).samples.append(Sample(name, labels, value))
+    return families
+
+
+# ------------------------------------------------------------ histograms
+
+def _strip_le(labels: LabelKey) -> Tuple[LabelKey, Optional[float]]:
+    le = None
+    rest = []
+    for k, v in labels:
+        if k == 'le':
+            le = _parse_value(v)
+        else:
+            rest.append((k, v))
+    return tuple(rest), le
+
+
+def extract_histograms(families: Mapping[str, Family],
+                       family: str) -> Dict[LabelKey, HistogramData]:
+    """One histogram family's samples → HistogramData per label set
+    (the label set EXCLUDING ``le``). Missing +Inf buckets are
+    repaired from ``_count`` (they are equal by the exposition
+    contract)."""
+    f = families.get(family)
+    if f is None:
+        return {}
+    out: Dict[LabelKey, HistogramData] = {}
+
+    def entry(key: LabelKey) -> HistogramData:
+        h = out.get(key)
+        if h is None:
+            h = HistogramData(buckets=[])
+            out[key] = h
+        return h
+
+    for s in f.samples:
+        if s.name == f'{family}_bucket':
+            key, le = _strip_le(s.labels)
+            if le is None:
+                continue
+            entry(key).buckets.append((le, s.value))
+        elif s.name == f'{family}_sum':
+            entry(s.labels).sum = s.value
+        elif s.name == f'{family}_count':
+            entry(s.labels).count = s.value
+    for h in out.values():
+        h.buckets.sort(key=lambda b: b[0])
+        if not h.buckets or h.buckets[-1][0] != math.inf:
+            h.buckets.append((math.inf, h.count))
+    return out
+
+
+def merge_histograms(shards: Sequence[HistogramData]) -> HistogramData:
+    """Bucket-wise merge: cumulative Prometheus buckets merge by
+    ADDITION (each shard's ``le`` bucket counts samples <= le, so the
+    union stream's count is the sum). Layouts must be identical —
+    a mismatch raises :class:`BucketMismatchError` instead of
+    interpolating counts that were never observed."""
+    shards = [s for s in shards if s is not None]
+    if not shards:
+        return HistogramData(buckets=[(math.inf, 0.0)])
+    layout = shards[0].layout()
+    for s in shards[1:]:
+        if s.layout() != layout:
+            raise BucketMismatchError(
+                f'cannot merge histograms with different bucket '
+                f'layouts: {layout} vs {s.layout()} — fleet-merged '
+                f'histograms must declare identical buckets')
+    merged = HistogramData(
+        buckets=[(le, sum(s.buckets[i][1] for s in shards))
+                 for i, le in enumerate(layout)],
+        sum=sum(s.sum for s in shards),
+        count=sum(s.count for s in shards))
+    return merged
+
+
+def histogram_quantile(hist: Optional[HistogramData], q: float) -> float:
+    """The Prometheus histogram_quantile estimate: linear
+    interpolation inside the bucket the q-th sample lands in. The
+    open-ended +Inf tail answers with the last finite bound (the
+    honest lower bound a dashboard shows); no samples → ``nan``."""
+    if hist is None or not hist.buckets:
+        return float('nan')
+    buckets = hist.buckets
+    total = buckets[-1][1]
+    if total <= 0:
+        return float('nan')
+    rank = q * total
+    lo_bound = lo_count = 0.0
+    for le, cum in buckets:
+        if cum >= rank:
+            if le == math.inf:
+                return lo_bound
+            span = cum - lo_count
+            frac = ((rank - lo_count) / span) if span else 0.0
+            return lo_bound + (le - lo_bound) * frac
+        lo_bound, lo_count = le, cum
+    return lo_bound
+
+
+def quantile_from_text(text: str, family: str, q: float) -> float:
+    """bench.py's original convenience shape: parse ``text``, merge
+    every label set of ``family`` (they share a layout by declaration)
+    and estimate the q-th quantile. ``nan`` when absent/empty."""
+    hists = extract_histograms(parse(text), family)
+    if not hists:
+        return float('nan')
+    return histogram_quantile(merge_histograms(list(hists.values())), q)
+
+
+# --------------------------------------------------------- fleet merging
+
+def merge_families(shards: Sequence[Mapping[str, Family]]
+                   ) -> Dict[str, Family]:
+    """Merge parsed shards into one fleet document: counters and
+    gauges SUM per label set (fleet totals — a fleet queue depth is
+    the sum of replica queue depths), histograms merge bucket-wise.
+    A family typed differently across shards raises ValueError;
+    mismatched histogram layouts raise BucketMismatchError."""
+    out: Dict[str, Family] = {}
+    # name -> label key -> value (scalar kinds)
+    scalars: Dict[str, Dict[LabelKey, float]] = {}
+    hist_shards: Dict[str, List[Dict[LabelKey, HistogramData]]] = {}
+    for shard in shards:
+        for name, f in shard.items():
+            existing = out.get(name)
+            if existing is None:
+                out[name] = Family(name=name, kind=f.kind,
+                                   help_text=f.help_text)
+            else:
+                if existing.kind == 'untyped':
+                    existing.kind = f.kind
+                elif f.kind not in ('untyped', existing.kind):
+                    raise ValueError(
+                        f'family {name!r} typed {existing.kind!r} on '
+                        f'one shard and {f.kind!r} on another')
+                if not existing.help_text:
+                    existing.help_text = f.help_text
+            if f.kind == 'histogram':
+                hist_shards.setdefault(name, []).append(
+                    extract_histograms(shard, name))
+            else:
+                acc = scalars.setdefault(name, {})
+                for s in f.samples:
+                    acc[s.labels] = acc.get(s.labels, 0.0) + s.value
+    for name, acc in scalars.items():
+        out[name].samples = [Sample(name, k, v)
+                             for k, v in sorted(acc.items())]
+    for name, per_shard in hist_shards.items():
+        keys = sorted({k for shard in per_shard for k in shard})
+        samples: List[Sample] = []
+        for key in keys:
+            merged = merge_histograms(
+                [shard[key] for shard in per_shard if key in shard])
+            for le, cum in merged.buckets:
+                le_txt = '+Inf' if le == math.inf else _fmt_float(le)
+                samples.append(Sample(
+                    f'{name}_bucket', tuple(sorted(
+                        key + (('le', le_txt),))), cum))
+            samples.append(Sample(f'{name}_sum', key, merged.sum))
+            samples.append(Sample(f'{name}_count', key, merged.count))
+        out[name].samples = samples
+    return out
+
+
+def _fmt_float(value: float) -> str:
+    if value == math.inf:
+        return '+Inf'
+    if float(value).is_integer():
+        return str(int(value))
+    return repr(float(value))
+
+
+def _escape_label(text: str) -> str:
+    return (text.replace('\\', r'\\').replace('\n', r'\n')
+            .replace('"', r'\"'))
+
+
+def labels_text(labels: LabelKey) -> str:
+    """Canonical (sorted, escaped) label rendering WITHOUT braces —
+    the form tsdb stores, so a bucket series round-trips exactly."""
+    return ','.join(f'{k}="{_escape_label(v)}"' for k, v in labels)
+
+
+def render(families: Mapping[str, Family]) -> str:
+    """Families → exposition text (the inverse of :func:`parse`),
+    used by the fleet endpoint to re-expose merged shards."""
+    lines: List[str] = []
+    for name in sorted(families):
+        f = families[name]
+        if f.help_text:
+            lines.append(f'# HELP {name} {_escape_label(f.help_text)}')
+        if f.kind != 'untyped':
+            lines.append(f'# TYPE {name} {f.kind}')
+        for s in f.samples:
+            if s.labels:
+                inner = ','.join(f'{k}="{_escape_label(v)}"'
+                                 for k, v in s.labels)
+                label_txt = '{' + inner + '}'
+            else:
+                label_txt = ''
+            lines.append(f'{s.name}{label_txt} {_fmt_float(s.value)}')
+    return '\n'.join(lines) + ('\n' if lines else '')
+
+
+def merge_texts(texts: Iterable[str]) -> str:
+    """Exposition texts → one merged exposition text (the
+    ``/-/fleet/metrics`` operation)."""
+    return render(merge_families([parse(t) for t in texts]))

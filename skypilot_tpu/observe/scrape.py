@@ -1,0 +1,476 @@
+"""Controller-side fleet scraper: pull every replica's telemetry.
+
+The component PRs 3–6 left missing: every replica exposes rich local
+``/metrics`` (TTFT/TPOT histograms, queue depth, KV page gauges) and a
+``/health`` saturation doc, but nothing ever AGGREGATED them — the LB
+and autoscaler acted on LB-side QPS probes, and "what is fleet TTFT
+p95 right now?" had no answer. The :class:`Scraper` runs in the serve
+controller process, pulls every target each round, persists a curated
+sample set into :mod:`~skypilot_tpu.observe.tsdb`, and keeps the last
+good parse in memory for:
+
+  * fleet aggregation — ``fleet_families()`` merges fresh shards
+    (counters/gauges summed, histograms bucket-wise) for the LB's
+    ``/-/fleet/metrics`` endpoint and the ``observe fleet`` CLI;
+  * the saturation snapshot — ``saturation_snapshot()`` gives the LB
+    (least-loaded tie-breaking) and the saturation autoscaler a
+    ``ready_urls()``-style view of per-replica queue depth / in-flight
+    / free KV pages, with freshness stamps so consumers can refuse
+    stale signal;
+  * the SLO engine — burn-rate windows evaluate over the persisted
+    samples each round.
+
+FAILURE CONTAINMENT is the design center: every target is scraped on
+its own thread with its own wall-clock deadline, so a dead, wedged or
+slow-loris replica can never delay a healthy target's scrape or wedge
+the loop — it burns only its own timeout. A failed target journals a
+``scrape_failed`` event, writes an ``up 0`` sample (the availability
+SLO's raw material), and moves the staleness gauge; per-target detail
+rides the journal/status endpoints because metric label sets must
+stay declared and finite (the breaker-state precedent).
+
+Failpoint: ``observe.scrape`` fires inside the per-target worker, so
+chaos tests inject timeouts/errors without a real dead replica.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+import time
+import urllib.request
+from typing import Callable, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import failpoints
+
+from skypilot_tpu.observe import journal
+from skypilot_tpu.observe import metrics as metrics_lib
+from skypilot_tpu.observe import promtext
+from skypilot_tpu.observe import tsdb
+
+logger = sky_logging.init_logger(__name__)
+
+# Metric families persisted into tsdb each round (the curated set the
+# SLO engine and fleet CLI read; storing the full exposition would
+# multiply tsdb row volume ~10x for series nothing consumes).
+STORED_FAMILIES = (
+    'skytpu_engine_ttft_seconds',
+    'skytpu_engine_tpot_seconds',
+    'skytpu_engine_queue_depth',
+    'skytpu_engine_in_flight',
+    'skytpu_engine_kv_pages_free',
+    'skytpu_engine_requests_total',
+    'skytpu_engine_tokens_total',
+)
+
+# The synthetic per-target liveness series every round writes (1 on a
+# successful scrape, 0 on failure) — the availability SLO's input.
+UP_SERIES = 'skytpu_scrape_up'
+
+_SCRAPE_OUTCOMES = ('ok', 'timeout', 'error')
+_M_SCRAPES = metrics_lib.counter(
+    'skytpu_scrape_total',
+    'Per-target scrape attempts by outcome.',
+    labels={'outcome': _SCRAPE_OUTCOMES})
+_M_SCRAPE_SECONDS = metrics_lib.histogram(
+    'skytpu_scrape_seconds',
+    'Per-target scrape latency (metrics + health fetch + parse).')
+_M_STALE = metrics_lib.gauge(
+    'skytpu_scrape_stale_targets',
+    'Targets whose last successful scrape is older than the staleness '
+    'window. Per-target detail rides scrape_failed journal events and '
+    'the /-/fleet/status endpoint (target names are unbounded; metric '
+    'label sets must stay declared and finite).')
+_M_TARGETS = metrics_lib.gauge(
+    'skytpu_scrape_targets',
+    'Targets configured for the current scrape round.')
+
+
+class ScrapeTimeout(Exception):
+    """A target exceeded its per-scrape wall-clock deadline."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Target:
+    entity: str                 # journal/tsdb identity: <svc>/<replica_id>
+    url: str                    # base URL, e.g. http://127.0.0.1:8000
+
+
+@dataclasses.dataclass
+class Saturation:
+    """One replica's engine-reported load, as of ``ts``."""
+    entity: str
+    url: str
+    ts: float
+    queue_depth: float = 0.0
+    in_flight: float = 0.0
+    kv_pages_free: Optional[float] = None
+
+    def age(self, now: Optional[float] = None) -> float:
+        return (time.time() if now is None else now) - self.ts
+
+
+@dataclasses.dataclass
+class _TargetState:
+    target: Target
+    last_attempt: float = 0.0
+    last_success: float = 0.0
+    last_error: str = ''
+    families: Optional[Dict[str, promtext.Family]] = None
+    saturation: Optional[Saturation] = None
+
+
+@dataclasses.dataclass
+class _ScrapeResult:
+    """What a worker hands back to the round thread. Workers do ONLY
+    network + parse — all sqlite (tsdb/journal) and scraper-state
+    writes happen on the persistent scrape-loop thread, so per-round
+    worker threads never open (and leak to GC) fresh thread-local
+    sqlite connections, and a worker completing after the round's
+    join deadline persists nothing stale."""
+    ok: bool
+    ts: float
+    latency: float
+    outcome: str = 'ok'                    # ok | timeout | error
+    error: str = ''
+    families: Optional[Dict[str, promtext.Family]] = None
+    saturation: Optional[Saturation] = None
+
+
+def _fetch(url: str, deadline: float) -> bytes:
+    """GET with a WALL-CLOCK deadline, not just a socket timeout: a
+    slow-loris upstream that trickles a byte per socket-timeout window
+    keeps every recv "live" forever — so the body is read in chunks
+    and the deadline checked between reads. Worst case one blocked
+    recv adds one socket timeout past the deadline; the worker thread
+    always terminates."""
+    remaining = deadline - time.monotonic()
+    if remaining <= 0:
+        raise ScrapeTimeout(url)
+    with urllib.request.urlopen(url, timeout=remaining) as resp:
+        chunks: List[bytes] = []
+        while True:
+            if time.monotonic() > deadline:
+                raise ScrapeTimeout(url)
+            chunk = resp.read(65536)
+            if not chunk:
+                break
+            chunks.append(chunk)
+    return b''.join(chunks)
+
+
+def _curated_rows(families: Dict[str, promtext.Family]
+                  ) -> List[tsdb.SampleRow]:
+    rows: List[tsdb.SampleRow] = []
+    for fam_name in STORED_FAMILIES:
+        fam = families.get(fam_name)
+        if fam is None:
+            continue
+        for s in fam.samples:
+            rows.append((s.name, promtext.labels_text(s.labels),
+                         s.value))
+    return rows
+
+
+class Scraper:
+    """Pulls targets; owns the last-good in-memory view. Thread-safe:
+    ``set_targets`` may be called from the reconcile thread while a
+    round runs on the scrape-loop thread."""
+
+    def __init__(self,
+                 metrics_path: str = '/metrics',
+                 health_path: str = '/health',
+                 timeout: Optional[float] = None,
+                 staleness_seconds: Optional[float] = None):
+        self.metrics_path = metrics_path
+        self.health_path = health_path
+        self.timeout = (common_utils.env_float('SKYTPU_SCRAPE_TIMEOUT', 5.0)
+                        if timeout is None else timeout)
+        self.staleness_seconds = (
+            common_utils.env_float('SKYTPU_SCRAPE_STALENESS', 30.0)
+            if staleness_seconds is None else staleness_seconds)
+        self._lock = threading.Lock()
+        self._states: Dict[str, _TargetState] = {}
+
+    # ------------------------------------------------------------ targets
+    def set_targets(self, targets: List[Target]) -> None:
+        """Adopt the current replica set (called after each reconcile
+        pass). State for departed targets is dropped — a scaled-down
+        replica must not linger in snapshots or the staleness count."""
+        with self._lock:
+            fresh: Dict[str, _TargetState] = {}
+            for t in targets:
+                prev = self._states.get(t.entity)
+                if prev is not None and prev.target.url == t.url:
+                    fresh[t.entity] = prev
+                else:
+                    fresh[t.entity] = _TargetState(target=t)
+            self._states = fresh
+        _M_TARGETS.set(len(targets))
+
+    def targets(self) -> List[Target]:
+        with self._lock:
+            return [s.target for s in self._states.values()]
+
+    # ------------------------------------------------------------- round
+    def scrape_round(self) -> Dict[str, bool]:
+        """Scrape every target IN PARALLEL, one thread + one deadline
+        each. Returns {entity: succeeded}. The round's wall time is
+        bounded by the slowest single target's timeout, never the sum
+        — a dead target cannot slow a healthy one (its thread is
+        abandoned at the join deadline and self-terminates at its
+        fetch deadline)."""
+        targets = self.targets()
+        if not targets:
+            self._refresh_staleness()
+            return {}
+        results: Dict[str, _ScrapeResult] = {}
+        results_lock = threading.Lock()
+
+        def worker(target: Target) -> None:
+            result = self._scrape_one(target)
+            with results_lock:
+                results[target.entity] = result
+
+        threads = []
+        for t in targets:
+            th = threading.Thread(target=worker, args=(t,), daemon=True,
+                                  name=f'scrape-{t.entity}')
+            th.start()
+            threads.append(th)
+        # Join against one shared deadline: every worker self-bounds
+        # at ~timeout (+ one socket-timeout of slack for a blocked
+        # recv), so the round converges even if a worker never posts.
+        deadline = time.monotonic() + self.timeout * 2 + 1.0
+        for th in threads:
+            th.join(max(0.0, deadline - time.monotonic()))
+        with results_lock:
+            posted = dict(results)
+        # Persist on THIS (persistent) thread: one cached sqlite
+        # connection for the loop's lifetime instead of one fresh
+        # connection + DDL per worker per round. A worker still wedged
+        # past the shared deadline counts as failed NOW (its late
+        # result, if any, is discarded unread).
+        out: Dict[str, bool] = {}
+        for t in targets:
+            result = posted.get(t.entity)
+            if result is None:
+                result = _ScrapeResult(
+                    ok=False, ts=time.time(),
+                    latency=self.timeout * 2, outcome='timeout',
+                    error='ScrapeTimeout: worker exceeded the round '
+                          'deadline')
+            self._persist(t, result)
+            out[t.entity] = result.ok
+        self._refresh_staleness()
+        return out
+
+    def _scrape_one(self, target: Target) -> _ScrapeResult:
+        """Worker half: network + parse ONLY (no sqlite, no scraper
+        state — see _ScrapeResult)."""
+        t0 = time.monotonic()
+        deadline = t0 + self.timeout
+        now = time.time()
+        base = target.url.rstrip('/')
+        try:
+            if failpoints.ACTIVE:
+                failpoints.fire('observe.scrape')
+            text = _fetch(base + self.metrics_path, deadline).decode(
+                'utf-8', errors='replace')
+            families = promtext.parse(text)
+            health: Dict[str, object] = {}
+            try:
+                health = json.loads(_fetch(base + self.health_path,
+                                           deadline).decode())
+            except (OSError, ValueError, ScrapeTimeout):
+                # The saturation doc is an enrichment; a replica whose
+                # /metrics answered is UP even if /health lagged (the
+                # gauges below fall back to the metric families).
+                health = {}
+        except Exception as e:  # pylint: disable=broad-except
+            if isinstance(e, (ScrapeTimeout, TimeoutError)) or (
+                    isinstance(e, OSError) and
+                    'timed out' in str(e).lower()):
+                outcome = 'timeout'
+            else:
+                outcome = 'error'
+            return _ScrapeResult(
+                ok=False, ts=now, latency=time.monotonic() - t0,
+                outcome=outcome,
+                error=f'{type(e).__name__}: {e}'[:300])
+        return _ScrapeResult(
+            ok=True, ts=now, latency=time.monotonic() - t0,
+            families=families,
+            saturation=self._saturation_from(target, now, families,
+                                             health))
+
+    def _persist(self, target: Target, result: _ScrapeResult) -> None:
+        """Round-thread half: tsdb/journal writes + state update."""
+        _M_SCRAPES.inc(outcome=result.outcome)
+        _M_SCRAPE_SECONDS.observe(result.latency)
+        if not result.ok:
+            tsdb.insert_samples(target.entity, [(UP_SERIES, '', 0.0)],
+                                ts=result.ts)
+            journal.record_event(
+                'scrape_failed', entity=target.entity,
+                reason=result.outcome,
+                data={'url': target.url, 'error': result.error})
+            with self._lock:
+                state = self._states.get(target.entity)
+                if state is not None:
+                    state.last_attempt = result.ts
+                    state.last_error = result.error
+            return
+        rows = _curated_rows(result.families)
+        rows.append((UP_SERIES, '', 1.0))
+        tsdb.insert_samples(target.entity, rows, ts=result.ts)
+        with self._lock:
+            state = self._states.get(target.entity)
+            if state is not None:
+                state.last_attempt = result.ts
+                state.last_success = result.ts
+                state.last_error = ''
+                state.families = result.families
+                state.saturation = result.saturation
+
+    @staticmethod
+    def _saturation_from(target: Target, now: float,
+                         families: Dict[str, promtext.Family],
+                         health: Dict[str, object]) -> Saturation:
+        def gauge_value(name: str) -> Optional[float]:
+            fam = families.get(name)
+            if fam is None or not fam.samples:
+                return None
+            return fam.samples[0].value
+
+        def pick(key: str, metric: str) -> Optional[float]:
+            val = health.get(key)
+            if isinstance(val, (int, float)):
+                return float(val)
+            return gauge_value(metric)
+
+        return Saturation(
+            entity=target.entity, url=target.url, ts=now,
+            queue_depth=pick('queue_depth',
+                             'skytpu_engine_queue_depth') or 0.0,
+            in_flight=pick('in_flight',
+                           'skytpu_engine_in_flight') or 0.0,
+            kv_pages_free=pick('kv_pages_free',
+                               'skytpu_engine_kv_pages_free'))
+
+    # --------------------------------------------------------- consumers
+    def _refresh_staleness(self) -> None:
+        now = time.time()
+        with self._lock:
+            stale = sum(
+                1 for s in self._states.values()
+                if now - s.last_success > self.staleness_seconds)
+        _M_STALE.set(stale)
+
+    def saturation_snapshot(self, max_age: Optional[float] = None
+                            ) -> Dict[str, Saturation]:
+        """url → freshest Saturation, FRESH entries only (older than
+        ``max_age``, default the staleness window, are withheld —
+        consumers fall back to their own signal rather than act on a
+        dead replica's last word)."""
+        horizon = self.staleness_seconds if max_age is None else max_age
+        now = time.time()
+        with self._lock:
+            return {s.saturation.url: s.saturation
+                    for s in self._states.values()
+                    if s.saturation is not None and
+                    s.saturation.age(now) <= horizon}
+
+    def fleet_families(self) -> Dict[str, promtext.Family]:
+        """Merged families over FRESH targets (counters/gauges summed,
+        histograms bucket-wise) — the /-/fleet/metrics document."""
+        now = time.time()
+        with self._lock:
+            shards = [s.families for s in self._states.values()
+                      if s.families is not None and
+                      now - s.last_success <= self.staleness_seconds]
+        return promtext.merge_families(shards)
+
+    def status(self) -> List[Dict[str, object]]:
+        """Per-target JSON doc for /-/fleet/status and the CLI table."""
+        now = time.time()
+        out = []
+        with self._lock:
+            states = list(self._states.values())
+        for s in sorted(states, key=lambda st: st.target.entity):
+            sat = s.saturation
+            doc: Dict[str, object] = {
+                'entity': s.target.entity,
+                'url': s.target.url,
+                'last_success_age': (round(now - s.last_success, 3)
+                                     if s.last_success else None),
+                'stale': (now - s.last_success >
+                          self.staleness_seconds),
+                'error': s.last_error or None,
+            }
+            if sat is not None:
+                doc.update({'queue_depth': sat.queue_depth,
+                            'in_flight': sat.in_flight,
+                            'kv_pages_free': sat.kv_pages_free})
+            out.append(doc)
+        return out
+
+
+class ScrapeLoop:
+    """The periodic driver: one daemon thread running
+    ``scraper.scrape_round()`` every ``interval`` seconds, invoking
+    ``on_round(scraper)`` after each round (the controller hooks SLO
+    evaluation and saturation publication there). Round failures are
+    contained per-target inside the scraper; an ``on_round`` exception
+    is logged and the loop continues — fleet telemetry must never die
+    of one bad evaluation."""
+
+    def __init__(self, scraper: Scraper,
+                 interval: Optional[float] = None,
+                 on_round: Optional[Callable[[Scraper], None]] = None):
+        self.scraper = scraper
+        self.interval = (common_utils.env_float(
+            'SKYTPU_SCRAPE_INTERVAL', 10.0)
+            if interval is None else interval)
+        self.on_round = on_round
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name='fleet-scrape-loop')
+        self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def run_once(self) -> Dict[str, bool]:
+        """One synchronous round + callback (tests; also lets a
+        controller force a round right after replicas turn READY)."""
+        results = self.scraper.scrape_round()
+        if self.on_round is not None:
+            try:
+                self.on_round(self.scraper)
+            except Exception:  # pylint: disable=broad-except
+                logger.warning('scrape on_round hook failed:',
+                               exc_info=True)
+        return results
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.run_once()
+            except Exception:  # pylint: disable=broad-except
+                # The round itself contains per-target failures; this
+                # guards the loop against everything else (e.g. a tsdb
+                # schema error). Telemetry must not crash the
+                # controller thread that hosts it.
+                logger.warning('scrape round failed:', exc_info=True)
+            self._stop.wait(self.interval)

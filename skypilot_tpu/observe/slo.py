@@ -1,0 +1,500 @@
+"""Declarative SLOs evaluated over scraped fleet samples: burn rates,
+multi-window alerting, hysteresis.
+
+The measurement half of "handles production traffic": PR 9's scraper
+(observe/scrape.py) persists every replica's availability and latency
+histograms into tsdb; this module turns them into *objectives* — "99.9%
+of scrapes up", "95% of requests first-token under 2s" — evaluated
+every scrape round the way serving-scale playbooks do (the
+Google-SRE-style multi-window, multi-burn-rate recipe):
+
+  * the ERROR BUDGET is ``1 - objective``;
+  * the BURN RATE over a window is ``error_fraction / budget`` — 1.0
+    means exactly spending the budget, 14x means spending a month's
+    budget in ~2 days;
+  * a FAST window (minutes) catches cliffs, a SLOW window (hour+)
+    confirms they are real — a breach requires BOTH, so a single bad
+    scrape round cannot page;
+  * transitions carry HYSTERESIS: escalation (ok→warning→breach) is
+    immediate, de-escalation requires ``clear_rounds`` consecutive
+    clean evaluations — a flapping replica cannot strobe the state.
+
+States export as ``skytpu_slo_state{slo=<kind>}`` (0 ok / 1 warning /
+2 breach) and ``skytpu_slo_burn_rate{slo=<kind>,window=fast|slow}``;
+every transition journals an ``slo_<new_state>`` event with the burn
+rates and the measured quantile in ``data``. SLO *kinds* are a closed
+set (the metric-label cardinality contract); custom spec NAMES ride
+the journal events.
+
+Latency SLOs evaluate from CUMULATIVE bucket deltas over the window
+(latest round minus the round at the window start, merged across
+replicas bucket-wise via promtext) — the same math a Prometheus
+recording rule would do, no per-request state anywhere.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import math
+import os
+import time
+from typing import Dict, List, Mapping, Optional, Tuple
+
+from skypilot_tpu import sky_logging
+
+from skypilot_tpu.observe import journal
+from skypilot_tpu.observe import metrics as metrics_lib
+from skypilot_tpu.observe import promtext
+from skypilot_tpu.observe import tsdb
+
+logger = sky_logging.init_logger(__name__)
+
+# The closed set of SLO kinds — the declared, bounded metric label.
+KINDS = ('availability', 'ttft_p95', 'tpot_p95')
+STATES = ('ok', 'warning', 'breach')
+_STATE_CODE = {'ok': 0, 'warning': 1, 'breach': 2}
+
+_KIND_FAMILY = {
+    'ttft_p95': 'skytpu_engine_ttft_seconds',
+    'tpot_p95': 'skytpu_engine_tpot_seconds',
+}
+# scrape.UP_SERIES without importing scrape (slo must stay importable
+# standalone for the CLI; both modules pin this literal and
+# test_fleet asserts they agree).
+_UP_SERIES = 'skytpu_scrape_up'
+
+_M_BURN = metrics_lib.gauge(
+    'skytpu_slo_burn_rate',
+    'Error-budget burn rate per SLO kind and window (1.0 = spending '
+    'exactly the budget).',
+    labels={'slo': KINDS, 'window': ('fast', 'slow')})
+_M_STATE = metrics_lib.gauge(
+    'skytpu_slo_state',
+    'SLO state per kind: 0 ok, 1 warning, 2 breach.',
+    labels={'slo': KINDS})
+
+
+@dataclasses.dataclass
+class SLOSpec:
+    """One objective. ``kind`` must be one of :data:`KINDS`;
+    ``name`` defaults to the kind (custom names appear in journal
+    events; metrics label by kind). ``objective`` is the good
+    fraction; latency kinds also take ``threshold_seconds`` (a request
+    is good when at/under it — align it with a declared histogram
+    bucket bound, or the bucketed good-count rounds down)."""
+    kind: str
+    name: str = ''
+    objective: float = 0.999
+    threshold_seconds: float = 2.0
+    fast_window: float = 300.0
+    slow_window: float = 3600.0
+    fast_burn: float = 14.0
+    slow_burn: float = 6.0
+    clear_rounds: int = 3
+
+    def __post_init__(self) -> None:
+        if self.kind not in KINDS:
+            raise ValueError(f'unknown SLO kind {self.kind!r}; '
+                             f'valid: {KINDS}')
+        if not (0.0 < self.objective < 1.0):
+            raise ValueError('objective must be in (0, 1) — an '
+                             'objective of 1.0 has a zero error '
+                             'budget and every error is a breach')
+        if not self.name:
+            self.name = self.kind
+
+    @property
+    def budget(self) -> float:
+        return 1.0 - self.objective
+
+
+def default_specs() -> List[SLOSpec]:
+    """The stock objectives, overridable via ``SKYTPU_SLO_SPECS`` — a
+    JSON list of :class:`SLOSpec` kwargs dicts (docs/OBSERVABILITY.md
+    "Fleet" section shows the format). A malformed env var raises at
+    controller startup: a silently-dropped SLO is an unmonitored
+    fleet."""
+    raw = os.environ.get('SKYTPU_SLO_SPECS', '')
+    if raw.strip():
+        try:
+            cfg = json.loads(raw)
+            if not isinstance(cfg, list):
+                raise ValueError('expected a JSON list')
+            return [SLOSpec(**item) for item in cfg]
+        except (ValueError, TypeError) as e:
+            raise ValueError(
+                f'SKYTPU_SLO_SPECS is malformed ({e}); expected a '
+                f'JSON list of SLO spec objects, e.g. '
+                f'[{{"kind": "availability", "objective": 0.999}}]'
+            ) from e
+    return [
+        SLOSpec(kind='availability', objective=0.999),
+        SLOSpec(kind='ttft_p95', objective=0.95, threshold_seconds=2.5),
+        SLOSpec(kind='tpot_p95', objective=0.95, threshold_seconds=0.25),
+    ]
+
+
+# ------------------------------------------------------------ window math
+
+def _split_le(labels: str) -> Tuple[Optional[str], Optional[float]]:
+    """A stored bucket-series label string → (canonical label string
+    WITHOUT le, the le bound). (None, None) on a malformed string."""
+    if not labels:
+        return None, None
+    try:
+        pairs = promtext._parse_labels(labels)  # pylint: disable=protected-access
+    except ValueError:
+        return None, None
+    le = None
+    rest = []
+    for k, v in pairs:
+        if k == 'le':
+            le = math.inf if v == '+Inf' else float(v)
+        else:
+            rest.append((k, v))
+    if le is None:
+        return None, None
+    return promtext.labels_text(tuple(rest)), le
+
+
+def _series_delta(latest: Mapping[str, Tuple[float, float]],
+                  anchor: Mapping[str, Tuple[float, float]]
+                  ) -> Dict[str, float]:
+    """Cumulative-series window delta per label set. A negative delta
+    means the counter restarted inside the window (replica relaunch):
+    the latest ABSOLUTE value is the honest lower bound of the
+    window's activity, so use it."""
+    out: Dict[str, float] = {}
+    for labels, (_, value) in latest.items():
+        prev = anchor.get(labels, (0.0, 0.0))[1]
+        out[labels] = value - prev if value >= prev else value
+    return out
+
+
+def _target_window_hist(latest_b, latest_c, latest_s, family: str,
+                        target: str, start: float
+                        ) -> Optional[promtext.HistogramData]:
+    """One target's windowed histogram from its (already fetched)
+    latest cumulative rounds and the anchor rounds at the window
+    start. Grouped PER LABEL SET (minus le): a labeled family
+    (foo_seconds{cls=...}) has one cumulative bucket series per label
+    set — concatenating them would interleave duplicate le bounds into
+    one garbage bucket list. Each label set's series is its own
+    histogram; within one family they share the declared layout, so
+    they merge bucket-wise."""
+    anchor_b = tsdb.round_at_or_before(f'{family}_bucket', target,
+                                       start)
+    deltas = _series_delta(latest_b, anchor_b)
+    count_d = _series_delta(
+        latest_c,
+        tsdb.round_at_or_before(f'{family}_count', target, start))
+    sum_d = _series_delta(
+        latest_s,
+        tsdb.round_at_or_before(f'{family}_sum', target, start))
+    groups: Dict[str, List[Tuple[float, float]]] = {}
+    for labels, delta in deltas.items():
+        rest_key, le = _split_le(labels)
+        if le is not None:
+            groups.setdefault(rest_key, []).append((le, delta))
+    per_label: List[promtext.HistogramData] = []
+    for rest_key, buckets in groups.items():
+        buckets.sort(key=lambda b: b[0])
+        hist = promtext.HistogramData(
+            buckets=buckets,
+            sum=sum_d.get(rest_key, 0.0),
+            count=count_d.get(rest_key, buckets[-1][1]))
+        if hist.buckets[-1][0] != math.inf:
+            hist.buckets.append((math.inf, hist.count))
+        per_label.append(hist)
+    if not per_label:
+        return None
+    return promtext.merge_histograms(per_label)
+
+
+def windowed_histograms(family: str, windows: List[float],
+                        now: Optional[float] = None,
+                        targets: Optional[List[str]] = None
+                        ) -> List[promtext.HistogramData]:
+    """The fleet's histogram of ``family`` observations inside EACH
+    window: per target, latest cumulative round minus the round at the
+    window start; shards merged bucket-wise (mismatched layouts refuse
+    loudly in promtext). The latest rounds are window-independent and
+    fetched ONCE per target — the SLO engine evaluates a fast and a
+    slow window every scrape round, and doubling the sqlite reads per
+    round per replica would be pure waste. Empty HistogramData entries
+    where nothing was scraped."""
+    now = time.time() if now is None else now
+    if targets is None:
+        targets = tsdb.targets(since=now - max(windows))
+    per_window: List[List[promtext.HistogramData]] = [
+        [] for _ in windows]
+    for target in targets:
+        latest_b = tsdb.latest_round(f'{family}_bucket', target)
+        if not latest_b:
+            continue
+        latest_c = tsdb.latest_round(f'{family}_count', target)
+        latest_s = tsdb.latest_round(f'{family}_sum', target)
+        for i, window in enumerate(windows):
+            hist = _target_window_hist(latest_b, latest_c, latest_s,
+                                       family, target, now - window)
+            if hist is not None:
+                per_window[i].append(hist)
+    return [promtext.merge_histograms(shards) if shards else
+            promtext.HistogramData(buckets=[(math.inf, 0.0)])
+            for shards in per_window]
+
+
+def windowed_histogram(family: str, window: float,
+                       now: Optional[float] = None,
+                       targets: Optional[List[str]] = None
+                       ) -> promtext.HistogramData:
+    """Single-window convenience over :func:`windowed_histograms`
+    (the fleet CLI's offline path)."""
+    return windowed_histograms(family, [window], now, targets)[0]
+
+
+def availability_error_fraction(window: float,
+                                now: Optional[float] = None,
+                                targets: Optional[List[str]] = None
+                                ) -> Optional[float]:
+    """Fraction of per-target scrape rounds in the window that were
+    DOWN (the ``skytpu_scrape_up`` series the scraper writes every
+    round, success or failure). ``targets`` restricts to one service's
+    replicas on a SHARED observe DB (two co-located controllers must
+    not count each other's outages). None with no rounds recorded —
+    "no data" must not read as "perfectly available"."""
+    fast, _ = _availability_fractions(window, window, now, targets)
+    return fast
+
+
+def _availability_fractions(fast_window: float, slow_window: float,
+                            now: Optional[float] = None,
+                            targets: Optional[List[str]] = None
+                            ) -> Tuple[Optional[float],
+                                       Optional[float]]:
+    """(fast, slow) error fractions from ONE query over the slow
+    window (the superset) — the fast window is a timestamp filter of
+    rows already in hand, not a second sqlite scan per round."""
+    now = time.time() if now is None else now
+    rows = tsdb.query(name=_UP_SERIES, since=now - slow_window,
+                      until=now)
+    if targets is not None:
+        allowed = set(targets)
+        rows = [r for r in rows if r['target'] in allowed]
+
+    def frac(subset) -> Optional[float]:
+        if not subset:
+            return None
+        return sum(1 for r in subset if r['value'] < 0.5) / len(subset)
+
+    fast_cut = now - fast_window
+    return frac([r for r in rows if r['ts'] >= fast_cut]), frac(rows)
+
+
+def latency_error_fraction(hist: promtext.HistogramData,
+                           threshold: float) -> Optional[float]:
+    """Fraction of windowed observations ABOVE the threshold. The
+    good count is the cumulative bucket at the largest finite bound at
+    or under the threshold (bucketed data can only answer at bucket
+    resolution — rounding DOWN the good side is the conservative
+    choice). None with no observations."""
+    if hist.count <= 0:
+        return None
+    good = 0.0
+    for le, cum in hist.buckets:
+        if le == math.inf or le > threshold:
+            break
+        good = cum
+    return max(0.0, 1.0 - good / hist.count)
+
+
+# --------------------------------------------------------------- engine
+
+@dataclasses.dataclass
+class Evaluation:
+    spec: SLOSpec
+    state: str
+    burn_fast: Optional[float]
+    burn_slow: Optional[float]
+    measured: Optional[float] = None     # p95 / availability fraction
+    transitioned: bool = False
+
+
+class SLOEngine:
+    """Holds per-spec state machines; ``evaluate()`` runs once per
+    scrape round (the controller wires it into the scrape loop's
+    ``on_round`` hook). ``entity`` scopes journal events to the owning
+    service so the LB's scoped /-/lb/events shows them."""
+
+    def __init__(self, specs: Optional[List[SLOSpec]] = None,
+                 entity: Optional[str] = None):
+        self.specs = list(specs) if specs is not None else default_specs()
+        names = [s.name for s in self.specs]
+        if len(set(names)) != len(names):
+            raise ValueError(f'duplicate SLO spec names: {names}')
+        self.entity = entity
+        self._state: Dict[str, str] = {s.name: 'ok' for s in self.specs}
+        self._clean_rounds: Dict[str, int] = {s.name: 0
+                                              for s in self.specs}
+        self._publish_states()
+
+    # ------------------------------------------------------------ query
+    def state(self, name: str) -> str:
+        return self._state[name]
+
+    def states(self) -> Dict[str, str]:
+        return dict(self._state)
+
+    # ------------------------------------------------------- evaluation
+    def _scoped_targets(self, now: float,
+                        window: float) -> Optional[List[str]]:
+        """The tsdb targets THIS engine may evaluate: with a bound
+        entity, only ``<entity>/...`` replicas — the observe DB is
+        shared (two co-located controllers write the same file, the
+        reality that made /-/lb/events entity-scoped), so an unscoped
+        engine would count a sibling service's outages and latencies
+        in this service's burn rates. None (= all targets) only
+        without an entity — a standalone evaluator owning its DB."""
+        if self.entity is None:
+            return None
+        prefix = f'{self.entity}/'
+        return [t for t in tsdb.targets(since=now - window)
+                if t == self.entity or t.startswith(prefix)]
+
+    def _error_fractions(self, spec: SLOSpec, now: float
+                         ) -> Tuple[Optional[float], Optional[float],
+                                    Optional[float]]:
+        """(fast_fraction, slow_fraction, measured)."""
+        targets = self._scoped_targets(now, spec.slow_window)
+        if spec.kind == 'availability':
+            fast, slow = _availability_fractions(
+                spec.fast_window, spec.slow_window, now, targets)
+            measured = None if slow is None else 1.0 - slow
+            return fast, slow, measured
+        family = _KIND_FAMILY[spec.kind]
+        fast_h, slow_h = windowed_histograms(
+            family, [spec.fast_window, spec.slow_window], now, targets)
+        fast = latency_error_fraction(fast_h, spec.threshold_seconds)
+        slow = latency_error_fraction(slow_h, spec.threshold_seconds)
+        measured = promtext.histogram_quantile(slow_h, 0.95)
+        if math.isnan(measured):
+            measured = None
+        return fast, slow, measured
+
+    @staticmethod
+    def _target_state(spec: SLOSpec, burn_fast: Optional[float],
+                      burn_slow: Optional[float]) -> Optional[str]:
+        """What the burn rates say RIGHT NOW (hysteresis applied by
+        the caller). None = no data, hold the current state."""
+        if burn_fast is None and burn_slow is None:
+            return None
+        bf = burn_fast or 0.0
+        bs = burn_slow or 0.0
+        if bf >= spec.fast_burn and bs >= spec.slow_burn:
+            return 'breach'
+        if bf >= spec.fast_burn or bs >= 1.0:
+            return 'warning'
+        return 'ok'
+
+    def evaluate(self, now: Optional[float] = None) -> List[Evaluation]:
+        now = time.time() if now is None else now
+        out: List[Evaluation] = []
+        # The burn gauge labels by KIND (bounded); when several specs
+        # share a kind the WORST burn wins — same aggregation as the
+        # state gauge, or a relaxed spec evaluated later would
+        # silently overwrite a strict spec's 20x burn with 0.
+        burn_by_kind: Dict[Tuple[str, str], float] = {}
+        for spec in self.specs:
+            try:
+                fast_frac, slow_frac, measured = self._error_fractions(
+                    spec, now)
+            except Exception:  # pylint: disable=broad-except
+                # PER-SPEC containment: one spec's evaluation blowing
+                # up (e.g. BucketMismatchError during a rolling update
+                # where old/new engine versions declare different
+                # bucket layouts) must not kill the OTHER specs —
+                # losing availability alerting in a mixed-version
+                # window is losing it exactly when an outage is most
+                # likely. The broken spec holds its state and reports
+                # no burn until the fleet converges.
+                logger.warning(f'SLO {spec.name!r} evaluation failed; '
+                               f'holding state '
+                               f'{self._state[spec.name]!r}:',
+                               exc_info=True)
+                out.append(Evaluation(
+                    spec=spec, state=self._state[spec.name],
+                    burn_fast=None, burn_slow=None))
+                continue
+            burn_fast = (None if fast_frac is None
+                         else fast_frac / spec.budget)
+            burn_slow = (None if slow_frac is None
+                         else slow_frac / spec.budget)
+            for window, burn in (('fast', burn_fast),
+                                 ('slow', burn_slow)):
+                if burn is None:
+                    # No data is NOT a zero burn: writing 0.0 here
+                    # would clear an operator's burn-rate alert at the
+                    # exact moment telemetry went missing. The gauge
+                    # holds its last value; the scrape staleness gauge
+                    # says why.
+                    continue
+                key = (spec.kind, window)
+                burn_by_kind[key] = max(burn_by_kind.get(key, 0.0),
+                                        burn)
+            target = self._target_state(spec, burn_fast, burn_slow)
+            current = self._state[spec.name]
+            transitioned = False
+            if target is not None and target != current:
+                if _STATE_CODE[target] > _STATE_CODE[current]:
+                    # Escalate immediately — a breach must not wait
+                    # out the hysteresis.
+                    transitioned = self._transition(
+                        spec, current, target, burn_fast, burn_slow,
+                        measured)
+                else:
+                    # De-escalate only after clear_rounds consecutive
+                    # cleaner evaluations (hysteresis: a flapping
+                    # signal cannot strobe ok/breach).
+                    self._clean_rounds[spec.name] += 1
+                    if self._clean_rounds[spec.name] >= \
+                            spec.clear_rounds:
+                        transitioned = self._transition(
+                            spec, current, target, burn_fast,
+                            burn_slow, measured)
+            else:
+                self._clean_rounds[spec.name] = 0
+            out.append(Evaluation(
+                spec=spec, state=self._state[spec.name],
+                burn_fast=burn_fast, burn_slow=burn_slow,
+                measured=measured, transitioned=transitioned))
+        for (kind, window), burn in burn_by_kind.items():
+            _M_BURN.set(burn, slo=kind, window=window)
+        self._publish_states()
+        return out
+
+    def _transition(self, spec: SLOSpec, old: str, new: str,
+                    burn_fast: Optional[float],
+                    burn_slow: Optional[float],
+                    measured: Optional[float]) -> bool:
+        self._state[spec.name] = new
+        self._clean_rounds[spec.name] = 0
+        logger.warning(f'SLO {spec.name!r}: {old} -> {new} '
+                       f'(burn fast={burn_fast}, slow={burn_slow})')
+        journal.record_event(
+            f'slo_{new}', entity=self.entity, reason=f'{old}->{new}',
+            data={'slo': spec.name, 'kind': spec.kind,
+                  'objective': spec.objective,
+                  'burn_fast': burn_fast, 'burn_slow': burn_slow,
+                  'measured': measured})
+        return True
+
+    def _publish_states(self) -> None:
+        # Per KIND (bounded label): when several specs share a kind,
+        # the worst state wins the gauge; names disambiguate in the
+        # journal.
+        per_kind: Dict[str, int] = {}
+        for spec in self.specs:
+            code = _STATE_CODE[self._state[spec.name]]
+            per_kind[spec.kind] = max(per_kind.get(spec.kind, 0), code)
+        for kind, code in per_kind.items():
+            _M_STATE.set(code, slo=kind)

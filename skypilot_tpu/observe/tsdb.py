@@ -1,0 +1,206 @@
+"""Scraped-sample time-series store (sqlite, WAL) — the fleet plane's
+memory.
+
+The controller scraper (observe/scrape.py) persists a curated set of
+every replica's metrics here each round; the SLO engine (observe/slo.py)
+evaluates burn-rate windows over it and the ``observe fleet`` CLI reads
+it directly when no live endpoint is reachable. Same DB file as the
+journal (``SKYTPU_OBSERVE_DB``) — one retention loop, one place to
+look — in its own ``samples`` table.
+
+Schema (one row per sample per target per scrape round):
+
+    samples(sample_id AUTOINCREMENT, ts REAL, target TEXT,
+            name TEXT, labels TEXT, value REAL)
+
+``target`` is the scraped entity (``<service>/<replica_id>``);
+``labels`` is the canonical sorted ``k="v"`` rendering of the sample's
+label set ('' for none) so histogram bucket series round-trip exactly.
+
+Write contract (same as the journal): INSERT-only on the hot path,
+best-effort — a sample that fails to persist must never wedge the
+scrape loop; sqlite-3.34-safe (no RETURNING, ``connect_wal``);
+retention via :func:`gc_samples` (age window + Nth-newest-id row cap),
+wired into the shared ``observe.gc()``.
+"""
+from __future__ import annotations
+
+import os
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from skypilot_tpu.utils import sqlite_utils
+
+from skypilot_tpu.observe import journal
+
+# (name, labels, value) — labels already canonically rendered.
+SampleRow = Tuple[str, str, float]
+
+_local = threading.local()
+
+
+def _conn() -> sqlite3.Connection:
+    path = journal.db_path()
+    cached = getattr(_local, 'conn', None)
+    if cached is not None and getattr(_local, 'path', None) == path:
+        return cached
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    conn = sqlite_utils.connect_wal(path)
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS samples (
+            sample_id INTEGER PRIMARY KEY AUTOINCREMENT,
+            ts REAL,
+            target TEXT,
+            name TEXT,
+            labels TEXT,
+            value REAL
+        )""")
+    conn.execute('CREATE INDEX IF NOT EXISTS idx_samples_name_ts '
+                 'ON samples (name, ts)')
+    conn.execute('CREATE INDEX IF NOT EXISTS idx_samples_target '
+                 'ON samples (target, name, ts)')
+    conn.commit()
+    _local.conn = conn
+    _local.path = path
+    return conn
+
+
+def insert_samples(target: str, rows: Iterable[SampleRow],
+                   ts: Optional[float] = None) -> int:
+    """One scrape round's samples for one target, in ONE transaction
+    (a round is all-or-nothing per target: a half-written round would
+    make windowed bucket deltas lie). Best-effort: returns the number
+    of rows written, 0 on any sqlite/OS failure."""
+    rows = list(rows)
+    if not rows:
+        return 0
+    stamp = time.time() if ts is None else ts
+    try:
+        conn = _conn()
+        with conn:
+            conn.executemany(
+                'INSERT INTO samples (ts, target, name, labels, value) '
+                'VALUES (?, ?, ?, ?, ?)',
+                [(stamp, target, name, labels, float(value))
+                 for name, labels, value in rows])
+        return len(rows)
+    except (sqlite3.Error, OSError):
+        return 0
+
+
+_COLUMNS = ('sample_id', 'ts', 'target', 'name', 'labels', 'value')
+
+
+def query(*, name: Optional[str] = None, target: Optional[str] = None,
+          since: Optional[float] = None, until: Optional[float] = None,
+          limit: int = 100000) -> List[Dict[str, Any]]:
+    """Filtered samples, oldest first. Best-effort ([] on failure)."""
+    clauses, params = [], []
+    for col, val in (('name', name), ('target', target)):
+        if val is not None:
+            clauses.append(f'{col} = ?')
+            params.append(val)
+    if since is not None:
+        clauses.append('ts >= ?')
+        params.append(since)
+    if until is not None:
+        clauses.append('ts <= ?')
+        params.append(until)
+    where = (' WHERE ' + ' AND '.join(clauses)) if clauses else ''
+    sql = (f'SELECT {", ".join(_COLUMNS)} FROM samples{where} '
+           f'ORDER BY sample_id LIMIT ?')
+    params.append(max(1, int(limit)))
+    try:
+        with _conn() as conn:
+            rows = conn.execute(sql, params).fetchall()
+    except (sqlite3.Error, OSError):
+        return []
+    return [dict(zip(_COLUMNS, r)) for r in rows]
+
+
+def targets(since: Optional[float] = None) -> List[str]:
+    """Distinct targets with samples (optionally only recent ones) —
+    what the CLI's per-replica table iterates."""
+    clauses, params = [], []
+    if since is not None:
+        clauses.append('ts >= ?')
+        params.append(since)
+    where = (' WHERE ' + ' AND '.join(clauses)) if clauses else ''
+    try:
+        with _conn() as conn:
+            rows = conn.execute(
+                f'SELECT DISTINCT target FROM samples{where} '
+                f'ORDER BY target', params).fetchall()
+    except (sqlite3.Error, OSError):
+        return []
+    return [r[0] for r in rows]
+
+
+def latest_round(name: str, target: str) -> Dict[str, Tuple[float, float]]:
+    """The NEWEST scrape round's series for (name, target):
+    ``{labels: (ts, value)}``. A round shares one ts (insert_samples
+    stamps the batch), so "newest round" = all rows at the max ts."""
+    try:
+        with _conn() as conn:
+            row = conn.execute(
+                'SELECT MAX(ts) FROM samples WHERE name = ? AND '
+                'target = ?', (name, target)).fetchone()
+            if row is None or row[0] is None:
+                return {}
+            ts = row[0]
+            rows = conn.execute(
+                'SELECT labels, value FROM samples WHERE name = ? AND '
+                'target = ? AND ts = ?', (name, target, ts)).fetchall()
+    except (sqlite3.Error, OSError):
+        return {}
+    return {labels: (ts, value) for labels, value in rows}
+
+
+def round_at_or_before(name: str, target: str,
+                       ts: float) -> Dict[str, Tuple[float, float]]:
+    """The newest round at or before ``ts`` — the window-start anchor
+    for cumulative-series deltas (burn-rate windows)."""
+    try:
+        with _conn() as conn:
+            row = conn.execute(
+                'SELECT MAX(ts) FROM samples WHERE name = ? AND '
+                'target = ? AND ts <= ?', (name, target, ts)).fetchone()
+            if row is None or row[0] is None:
+                return {}
+            anchor = row[0]
+            rows = conn.execute(
+                'SELECT labels, value FROM samples WHERE name = ? AND '
+                'target = ? AND ts = ?',
+                (name, target, anchor)).fetchall()
+    except (sqlite3.Error, OSError):
+        return {}
+    return {labels: (anchor, value) for labels, value in rows}
+
+
+def gc_samples(max_age_seconds: float = 7 * 24 * 3600,
+               max_rows: int = 500_000) -> int:
+    """Retention, same discipline as journal.gc_events: age window
+    plus a row cap keyed on the Nth-NEWEST row id (never max-id
+    arithmetic — AUTOINCREMENT ids go sparse after age deletes). The
+    scraper writes dozens of rows per replica per round; without this
+    the samples table outgrows every other journal table combined."""
+    try:
+        conn = _conn()
+        with sqlite_utils.immediate(conn):
+            cur = conn.execute('DELETE FROM samples WHERE ts < ?',
+                               (time.time() - max_age_seconds,))
+            deleted = cur.rowcount
+            row = conn.execute(
+                'SELECT sample_id FROM samples '
+                'ORDER BY sample_id DESC LIMIT 1 OFFSET ?',
+                (max_rows,)).fetchone()
+            if row is not None:
+                cur = conn.execute(
+                    'DELETE FROM samples WHERE sample_id <= ?',
+                    (row[0],))
+                deleted += cur.rowcount
+        return max(0, deleted)
+    except (sqlite3.Error, OSError):
+        return 0

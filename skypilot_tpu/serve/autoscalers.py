@@ -1,20 +1,44 @@
-"""Autoscalers: request-rate scaling with hysteresis.
+"""Autoscalers: request-rate and engine-saturation scaling with
+hysteresis.
 
 Reference analog: sky/serve/autoscalers.py (`Autoscaler:116`,
-`_AutoscalerWithHysteresis:369`, `RequestRateAutoscaler:455`). The decision
-function is pure — (request timestamps, ready count, now) → target — so it
-unit-tests with synthetic clocks, no clusters.
+`_AutoscalerWithHysteresis:369`, `RequestRateAutoscaler:455`). The
+decision function is pure — (signal, now) → target — so it unit-tests
+with synthetic clocks, no clusters.
+
+Two signals (ROADMAP item 3: scale on engine-reported saturation, not
+LB-side probes):
+
+  * ``request_rate`` — LB-observed QPS over a sliding window divided
+    by ``target_qps_per_replica``. Cheap, always available, but blind
+    to request COST: 10 QPS of 4k-token prompts saturates a replica
+    that 10 QPS of chat turns barely warms.
+  * ``saturation`` — the fleet's engine-reported queue depth (scraped
+    by observe/scrape.py from every replica's /health + /metrics)
+    divided by ``target_queue_depth_per_replica``. Queue depth is the
+    engine's own admission backlog — it already prices request cost
+    in. When the scraped snapshot goes STALE (scraper dead, all
+    replicas unreachable) the policy FALLS BACK to the QPS signal
+    rather than flying blind on a dead replica's last word
+    (``skytpu_serve_autoscaler_fallback_total`` counts it).
+
+Both share the same hysteresis: a raw target must hold for
+``upscale_delay_seconds`` (or ``downscale_delay_seconds``) before the
+decision changes — absorbing bursts without flapping replicas whose
+provision time is minutes.
 """
 from __future__ import annotations
 
 import math
-from typing import Deque, List, Optional
+import threading
+from typing import Deque, Mapping, Optional
 
 from collections import deque
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.observe import metrics as metrics_lib
 from skypilot_tpu.serve import service_spec as spec_lib
+from skypilot_tpu.utils import common_utils
 from skypilot_tpu.utils import vclock
 from skypilot_tpu.utils import registry
 
@@ -22,6 +46,11 @@ logger = sky_logging.init_logger(__name__)
 
 # Sliding window over which QPS is measured (reference default 60s).
 QPS_WINDOW_SECONDS = 60.0
+
+# A saturation snapshot older than this is STALE: the saturation
+# autoscaler falls back to the QPS signal. Matches the scraper's
+# default staleness window.
+SATURATION_STALE_SECONDS = 30.0
 
 # Decision gauges. One controller process per service, so no service
 # label is needed (or allowed: service names are unbounded).
@@ -31,6 +60,16 @@ _TARGET_GAUGE = metrics_lib.gauge(
 _QPS_GAUGE = metrics_lib.gauge(
     'skytpu_serve_autoscaler_qps',
     'Request rate over the sliding QPS window.')
+_QUEUE_GAUGE = metrics_lib.gauge(
+    'skytpu_serve_autoscaler_queue_depth',
+    'Fleet engine-reported queue depth (sum over fresh scraped '
+    'replicas) feeding the saturation autoscaler.')
+_FALLBACK_TOTAL = metrics_lib.counter(
+    'skytpu_serve_autoscaler_fallback_total',
+    'Saturation-autoscaler decisions that could not use the scraped '
+    'signal, by reason (stale: snapshot older than the staleness '
+    'window; no_signal: no scrape data was ever published).',
+    labels={'reason': ('stale', 'no_signal')})
 
 
 class Autoscaler:
@@ -41,15 +80,23 @@ class Autoscaler:
     def record_request(self, now: Optional[float] = None) -> None:
         """Called by the load balancer on every proxied request."""
 
+    def observe_saturation(self, queue_depths: Mapping[str, float],
+                           now: Optional[float] = None) -> None:
+        """Called by the controller after each scrape round with the
+        FRESH per-replica engine queue depths (url → depth). Base
+        policies ignore it."""
+
     def target_replicas(self, now: Optional[float] = None) -> int:
         raise NotImplementedError
 
     @classmethod
     def make(cls, policy: spec_lib.ReplicaPolicy) -> 'Autoscaler':
-        if policy.autoscaling_enabled:
-            return registry.AUTOSCALER_REGISTRY.type_from_str(
-                'request_rate')(policy)
-        return FixedAutoscaler(policy)
+        if not policy.autoscaling_enabled:
+            return FixedAutoscaler(policy)
+        name = ('saturation'
+                if policy.target_queue_depth_per_replica is not None
+                else 'request_rate')
+        return registry.AUTOSCALER_REGISTRY.type_from_str(name)(policy)
 
 
 class FixedAutoscaler(Autoscaler):
@@ -62,43 +109,65 @@ class FixedAutoscaler(Autoscaler):
 
 @registry.AUTOSCALER_REGISTRY.register(name='request_rate')
 class RequestRateAutoscaler(Autoscaler):
-    """target = ceil(qps / target_qps_per_replica), with hysteresis: the
-    raw target must hold for upscale_delay_seconds (or
-    downscale_delay_seconds) before the decision changes — absorbing bursts
-    without flapping replicas whose provision time is minutes."""
+    """target = ceil(qps / target_qps_per_replica), with hysteresis."""
 
     def __init__(self, policy: spec_lib.ReplicaPolicy):
         super().__init__(policy)
         assert policy.autoscaling_enabled
         self._timestamps: Deque[float] = deque()
+        # record_request runs on the LB's event-loop thread while
+        # target_replicas runs on the reconcile thread (and, for the
+        # saturation subclass, the scrape-loop thread) — both trim the
+        # deque, and an unsynchronized check-then-popleft pair can
+        # IndexError or pop an in-window sample.
+        self._ts_lock = threading.Lock()
         self._current_target = policy.min_replicas
         # (proposed_target, since_when) while a change is pending.
         self._pending: Optional[tuple] = None
 
     def record_request(self, now: Optional[float] = None) -> None:
         now = vclock.now() if now is None else now
-        self._timestamps.append(now)
+        with self._ts_lock:
+            self._timestamps.append(now)
+            # Trim at APPEND, not only at read: the saturation
+            # subclass can go rounds/days without reaching _qps() (its
+            # fresh-signal branch never reads QPS), and an untrimmed
+            # deque grows by one float per proxied request forever.
+            self._trim(now)
 
-    def _qps(self, now: float) -> float:
+    def _trim(self, now: float) -> None:
+        # Callers hold _ts_lock.
         cutoff = now - QPS_WINDOW_SECONDS
         while self._timestamps and self._timestamps[0] < cutoff:
             self._timestamps.popleft()
-        return len(self._timestamps) / QPS_WINDOW_SECONDS
 
-    def _raw_target(self, now: float) -> int:
-        qps = self._qps(now)
-        assert self.policy.target_qps_per_replica is not None
-        want = math.ceil(qps / self.policy.target_qps_per_replica)
+    def _qps(self, now: float) -> float:
+        with self._ts_lock:
+            self._trim(now)
+            return len(self._timestamps) / QPS_WINDOW_SECONDS
+
+    def _clamp(self, want: int) -> int:
         lo = self.policy.min_replicas
         hi = self.policy.max_replicas or lo
         return max(lo, min(hi, want))
 
+    def _qps_target(self, now: float) -> int:
+        qps = self._qps(now)
+        _QPS_GAUGE.set(qps)
+        if self.policy.target_qps_per_replica is None:
+            # No QPS objective configured (saturation-only policy
+            # falling back here): hold the current decision rather
+            # than invent one from an undeclared target.
+            return self._current_target
+        return self._clamp(
+            math.ceil(qps / self.policy.target_qps_per_replica))
+
+    def _raw_target(self, now: float) -> int:
+        return self._qps_target(now)
+
     def target_replicas(self, now: Optional[float] = None) -> int:
         now = vclock.now() if now is None else now
         raw = self._raw_target(now)
-        # One source of truth with the decision input (_raw_target has
-        # already trimmed the window, so this is a cheap re-read).
-        _QPS_GAUGE.set(self._qps(now))
         if raw == self._current_target:
             self._pending = None
             _TARGET_GAUGE.set(self._current_target)
@@ -117,3 +186,49 @@ class RequestRateAutoscaler(Autoscaler):
             self._pending = None
         _TARGET_GAUGE.set(self._current_target)
         return self._current_target
+
+
+@registry.AUTOSCALER_REGISTRY.register(name='saturation')
+class SaturationAutoscaler(RequestRateAutoscaler):
+    """target = ceil(fleet queue depth / target_queue_depth_per_replica)
+    from ENGINE-REPORTED saturation, falling back to the QPS signal
+    when the scraped snapshot is stale. Shares the request-rate
+    hysteresis (the raw signal differs; the flap-damping should not)."""
+
+    def __init__(self, policy: spec_lib.ReplicaPolicy):
+        super().__init__(policy)
+        assert policy.target_queue_depth_per_replica is not None
+        self._fleet_queue_depth: Optional[float] = None
+        self._saturation_ts: Optional[float] = None
+        self.stale_after = common_utils.env_float(
+            'SKYTPU_SATURATION_STALE_SECONDS', SATURATION_STALE_SECONDS)
+
+    def observe_saturation(self, queue_depths: Mapping[str, float],
+                           now: Optional[float] = None) -> None:
+        if not queue_depths:
+            # An EMPTY snapshot is "no fresh signal" (every replica
+            # stale/unreachable, or none scraped yet) — refreshing the
+            # timestamp on it would read as "fleet queue depth 0" and
+            # scale an unreachable, possibly saturated fleet DOWN.
+            # Let the timestamp age out so _raw_target takes the
+            # stale→QPS fallback instead. (A healthy idle fleet posts
+            # a NON-empty mapping of zero depths.)
+            return
+        now = vclock.now() if now is None else now
+        total = float(sum(queue_depths.values()))
+        self._fleet_queue_depth = total
+        self._saturation_ts = now
+        _QUEUE_GAUGE.set(total)
+
+    def _raw_target(self, now: float) -> int:
+        if self._saturation_ts is None:
+            _FALLBACK_TOTAL.inc(reason='no_signal')
+            return self._qps_target(now)
+        if now - self._saturation_ts > self.stale_after:
+            _FALLBACK_TOTAL.inc(reason='stale')
+            return self._qps_target(now)
+        per_replica = self.policy.target_queue_depth_per_replica
+        want = math.ceil(self._fleet_queue_depth / per_replica)
+        # Queue depth can legitimately read 0 under light load; the
+        # floor is min_replicas via the clamp, same as QPS.
+        return self._clamp(want)

@@ -18,6 +18,8 @@ from aiohttp import web
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu import task as task_lib
+from skypilot_tpu.observe import scrape as scrape_lib
+from skypilot_tpu.observe import slo as slo_lib
 from skypilot_tpu.serve import autoscalers as autoscaler_lib
 from skypilot_tpu.serve import load_balancer as lb_lib
 from skypilot_tpu.serve import replica_managers
@@ -59,6 +61,21 @@ class ServiceController:
         self.lb = lb_lib.LoadBalancer(self.spec.load_balancing_policy,
                                       self.autoscaler,
                                       service_name=self.name)
+        # Fleet telemetry plane (non-pool services): the scraper pulls
+        # every READY replica's /metrics + /health each round; the SLO
+        # engine evaluates burn rates over the stored samples; the
+        # saturation snapshot feeds the LB policy's tie-breaker and
+        # the saturation autoscaler. Pools have no replica HTTP apps
+        # to scrape.
+        self.scraper = None
+        self.slo_engine = None
+        self.scrape_loop = None
+        if not self.spec.pool:
+            self.scraper = scrape_lib.Scraper()
+            self.slo_engine = slo_lib.SLOEngine(entity=self.name)
+            self.scrape_loop = scrape_lib.ScrapeLoop(
+                self.scraper, on_round=self._on_scrape_round)
+            self.lb.attach_fleet(self.scraper, self.slo_engine)
         self._stop = threading.Event()
 
     def _load_from_record(self, record) -> None:
@@ -89,6 +106,29 @@ class ServiceController:
                             record.get('update_mode') or 'rolling')
 
     # ------------------------------------------------------------------
+    def _on_scrape_round(self, scraper: 'scrape_lib.Scraper') -> None:
+        """After every scrape round (scrape-loop thread): publish the
+        FRESH saturation snapshot to the LB policy and the autoscaler,
+        then evaluate the SLOs over the stored samples. Attribute
+        reads, not captures — update adoption swaps self.autoscaler."""
+        snapshot = scraper.saturation_snapshot()
+        depths = {url: s.queue_depth for url, s in snapshot.items()}
+        self.lb.set_replica_saturation(depths)
+        self.autoscaler.observe_saturation(depths)
+        if self.slo_engine is not None:
+            self.slo_engine.evaluate()
+
+    def _sync_scrape_targets(self, id_urls) -> None:
+        """Reconcile-thread hook: the scrape target set IS the
+        routable set (the pass's ready_id_urls() snapshot — one filter
+        definition, one query), identified by journal entity
+        (<service>/<replica_id>)."""
+        if self.scraper is None:
+            return
+        self.scraper.set_targets([
+            scrape_lib.Target(entity=f'{self.name}/{rid}', url=url)
+            for rid, url in id_urls])
+
     def _maybe_gc_observe(self) -> None:
         """Hourly events+spans retention in the controller process —
         the shared observe.gc() the API server's GC loop also runs
@@ -144,10 +184,17 @@ class ServiceController:
                     ready = [r for r in serve_state.get_replicas(self.name)
                              if r['status'] is ReplicaStatus.READY]
                 else:
-                    ready = self.manager.ready_urls()
+                    # ONE routable-set snapshot per pass: LB targets,
+                    # capacity weights and scrape targets all derive
+                    # from the same ready_id_urls() result, so a
+                    # replica flipping READY mid-pass cannot make the
+                    # routed set drift from the scraped set.
+                    id_urls = self.manager.ready_id_urls()
+                    ready = [url for _, url in id_urls]
                     self.lb.set_ready_replicas(ready)
                     self.lb.policy.set_replica_weights(
-                        self.manager.ready_url_weights())
+                        self.manager.ready_url_weights(ready))
+                    self._sync_scrape_targets(id_urls)
                 status = (ServiceStatus.READY if ready else
                           ServiceStatus.REPLICA_INIT)
                 if record['status'] is not status:
@@ -168,6 +215,8 @@ class ServiceController:
         loop_thread = threading.Thread(target=self._reconcile_loop,
                                        daemon=True)
         loop_thread.start()
+        if self.scrape_loop is not None:
+            self.scrape_loop.start()
         lb_port = int(self.record['lb_port'])
         logger.info(f'Service {self.name!r}: load balancer on :{lb_port}, '
                     f'policy={self.spec.load_balancing_policy}.')
@@ -176,6 +225,8 @@ class ServiceController:
                         print=None, handle_signals=True)
         finally:
             self._stop.set()
+            if self.scrape_loop is not None:
+                self.scrape_loop.stop()
             loop_thread.join(timeout=10)
 
 

@@ -3113,14 +3113,22 @@ def build_app(engine: InferenceEngine):
     from aiohttp import web
 
     async def health(request):
+        """Liveness + the SATURATION DOC the fleet scraper
+        (observe/scrape.py) folds into its snapshot: queue depth,
+        in-flight count and free KV pages are the engine's own
+        admission view — the signal the saturation autoscaler and the
+        LB's least-loaded tie-breaker act on."""
         del request
         if not engine.warm:
             return web.json_response({'status': 'warming'}, status=503)
-        return web.json_response({
+        doc = {
             'status': 'ok',
             'queue_depth': engine.queue_depth(),
             'in_flight': engine.in_flight(),
-        })
+        }
+        if engine.paged and engine.alloc is not None:
+            doc['kv_pages_free'] = engine.alloc.free_count
+        return web.json_response(doc)
 
     async def metrics(request):
         """Prometheus text exposition, rendered from the observe

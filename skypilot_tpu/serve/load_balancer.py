@@ -25,7 +25,8 @@ Failure containment (docs/ROBUSTNESS.md):
     Once response bytes have reached the client, a failure truncates —
     never silently rewrites — the stream.
 
-Control endpoints live under /-/lb/ (anything else is proxied verbatim):
+Control endpoints live under /-/lb/ and /-/fleet/ (anything else is
+proxied verbatim):
   GET /-/lb/health  → {ready_replicas: N}
   GET /-/lb/metrics → Prometheus exposition (per-policy request
                       counters + latency histograms, autoscaler gauges,
@@ -37,6 +38,16 @@ Control endpoints live under /-/lb/ (anything else is proxied verbatim):
                     → this service's span tree for one trace (the
                       lb.request → lb.pick / lb.upstream hops),
                       entity-scoped like /-/lb/events
+  GET /-/fleet/metrics
+                    → the MERGED fleet exposition: every fresh
+                      replica's scraped /metrics, counters/gauges
+                      summed and histograms merged bucket-wise
+                      (observe/promtext.py) — "fleet TTFT p95" is a
+                      histogram_quantile over THIS document
+  GET /-/fleet/status
+                    → per-replica scrape/saturation table (last
+                      scrape age, queue depth, in-flight, free KV
+                      pages) + current SLO states
 """
 from __future__ import annotations
 
@@ -53,6 +64,7 @@ from aiohttp import web
 from skypilot_tpu import sky_logging
 from skypilot_tpu.observe import journal as journal_lib
 from skypilot_tpu.observe import metrics as metrics_lib
+from skypilot_tpu.observe import promtext
 from skypilot_tpu.observe import spans as spans_lib
 from skypilot_tpu.observe import trace as trace_lib
 from skypilot_tpu.serve import load_balancing_policies as lb_policies
@@ -269,6 +281,23 @@ class LoadBalancer:
         self._breakers: Dict[str, CircuitBreaker] = {}
         self._ready: List[str] = []
         self._fallback_rr = 0
+        # Fleet telemetry (observe/scrape.py + slo.py), attached by
+        # the controller when it owns a scrape loop; None leaves the
+        # /-/fleet/ endpoints answering 503 (a standalone LB has no
+        # scraper).
+        self._scraper = None
+        self._slo_engine = None
+
+    def attach_fleet(self, scraper, slo_engine=None) -> None:
+        """Give the /-/fleet/ endpoints their data sources (the
+        controller's Scraper and SLOEngine)."""
+        self._scraper = scraper
+        self._slo_engine = slo_engine
+
+    def set_replica_saturation(self,
+                               queue_depths: Dict[str, float]) -> None:
+        """Controller scrape-round hook → the policy's tie-breaker."""
+        self.policy.set_replica_saturation(queue_depths)
 
     def set_ready_replicas(self, urls: List[str]) -> None:
         """Called from the controller's reconcile THREAD: only swaps
@@ -661,6 +690,51 @@ class LoadBalancer:
             spans_lib.tree, trace_id, self.service_name)
         return web.json_response(result)
 
+    async def _fleet_metrics(self, request: web.Request) -> web.Response:
+        """The merged fleet exposition document: every FRESH scraped
+        replica's families, counters/gauges summed, histograms merged
+        bucket-wise. 503 (retriable) without a scraper or while no
+        replica has been scraped yet — an empty 200 would read as "a
+        healthy fleet with zero traffic". Off-loop: the merge walks
+        every shard's parsed families."""
+        del request
+        if self._scraper is None:
+            return web.json_response(
+                {'error': 'no fleet scraper attached'}, status=503)
+
+        def _render() -> str:
+            return promtext.render(self._scraper.fleet_families())
+
+        try:
+            text = await asyncio.to_thread(_render)
+        except ValueError as e:
+            # BucketMismatchError ⊂ ValueError: replicas disagree on a
+            # histogram's bucket layout (mid rolling update) — a
+            # structured refusal, not an unhandled 500. Per-replica
+            # raw text stays scrapable on each replica directly.
+            return web.json_response(
+                {'error': f'fleet merge refused: {e}',
+                 'retriable': True}, status=503,
+                headers={'Retry-After': '30'})
+        if not text:
+            return web.json_response(
+                {'error': 'no replica scraped yet', 'retriable': True},
+                status=503, headers={'Retry-After': '5'})
+        return web.Response(text=text, content_type='text/plain')
+
+    async def _fleet_status(self, request: web.Request) -> web.Response:
+        """Per-replica scrape/saturation table + SLO states — the
+        ``observe fleet`` CLI's data source."""
+        del request
+        if self._scraper is None:
+            return web.json_response(
+                {'error': 'no fleet scraper attached'}, status=503)
+        replicas = await asyncio.to_thread(self._scraper.status)
+        doc = {'service': self.service_name, 'replicas': replicas}
+        if self._slo_engine is not None:
+            doc['slo'] = self._slo_engine.states()
+        return web.json_response(doc)
+
     # ------------------------------------------------------------------
     def build_app(self) -> web.Application:
         app = web.Application()
@@ -668,6 +742,8 @@ class LoadBalancer:
         app.router.add_get('/-/lb/metrics', self._metrics)
         app.router.add_get('/-/lb/events', self._events)
         app.router.add_get('/-/lb/trace/{trace_id}', self._trace)
+        app.router.add_get('/-/fleet/metrics', self._fleet_metrics)
+        app.router.add_get('/-/fleet/status', self._fleet_status)
         app.router.add_route('*', '/{tail:.*}', self._proxy)
 
         async def _cleanup(app_):

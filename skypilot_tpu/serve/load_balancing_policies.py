@@ -28,6 +28,12 @@ class LoadBalancingPolicy:
         self._lock = threading.Lock()
         self._replicas: List[str] = []       # replica URLs
         self._in_flight: Dict[str, int] = {}
+        # Engine-reported saturation (url → queue depth), published by
+        # the controller after every scrape round (observe/scrape.py).
+        # STALE entries never arrive here — the scraper's snapshot
+        # withholds them — so an empty dict degrades every policy to
+        # its pre-fleet-telemetry behavior.
+        self._saturation: Dict[str, float] = {}
 
     def set_ready_replicas(self, urls: List[str]) -> None:
         with self._lock:
@@ -41,6 +47,23 @@ class LoadBalancingPolicy:
         capability). Base policies ignore them; instance-aware ones
         normalize load by them."""
         del weights
+
+    def set_replica_saturation(self,
+                               queue_depths: Dict[str, float]) -> None:
+        """Fresh engine-reported queue depths (url → depth). Load-aware
+        policies use them to break in-flight-count ties: the LB's own
+        in-flight count sees requests it proxied, the engine's queue
+        depth also prices what each request COSTS (a 4k-token prefill
+        queues deeper than a chat turn)."""
+        with self._lock:
+            self._saturation = dict(queue_depths)
+
+    def _load_key(self, url: str):
+        """Sort key for 'least loaded': LB-side in-flight first (it
+        moves per request, the scraped depth only per scrape round),
+        engine queue depth as the tie-breaker."""
+        return (self._in_flight.get(url, 0),
+                self._saturation.get(url, 0.0))
 
     def select(self, affinity_key: Optional[str] = None) -> Optional[str]:
         """Pick a replica. `affinity_key` (e.g. the prompt head) is a
@@ -75,16 +98,18 @@ class RoundRobinPolicy(LoadBalancingPolicy):
 
 @registry.LB_POLICY_REGISTRY.register(name='least_load')
 class LeastLoadPolicy(LoadBalancingPolicy):
-    """Route to the replica with the fewest in-flight requests (reference
-    default — best for LLM serving where request cost varies wildly)."""
+    """Route to the replica with the fewest in-flight requests
+    (reference default — best for LLM serving where request cost
+    varies wildly), with scraped engine queue depth breaking ties —
+    two replicas with equal in-flight counts can hide very different
+    admission backlogs."""
 
     def select(self, affinity_key: Optional[str] = None) -> Optional[str]:
         del affinity_key
         with self._lock:
             if not self._replicas:
                 return None
-            return min(self._replicas,
-                       key=lambda u: self._in_flight.get(u, 0))
+            return min(self._replicas, key=self._load_key)
 
 
 @registry.LB_POLICY_REGISTRY.register(name='instance_aware_least_load')
@@ -115,6 +140,8 @@ class InstanceAwareLeastLoadPolicy(LeastLoadPolicy):
             return min(
                 self._replicas,
                 key=lambda u: (self._in_flight.get(u, 0) /
+                               self._weights.get(u, 1.0),
+                               self._saturation.get(u, 0.0) /
                                self._weights.get(u, 1.0)))
 
 
@@ -144,8 +171,7 @@ class PrefixAffinityPolicy(LeastLoadPolicy):
         with self._lock:
             if not self._replicas:
                 return None
-            coolest = min(self._replicas,
-                          key=lambda u: self._in_flight.get(u, 0))
+            coolest = min(self._replicas, key=self._load_key)
             if affinity_key is None:
                 return coolest
             target = max(

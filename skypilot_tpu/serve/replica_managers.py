@@ -722,21 +722,33 @@ class ReplicaManager:
         if len(alive) < target + 1 and len(fresh) < target:
             self.scale_up(1)   # surge one new-version replica
 
-    def ready_urls(self) -> List[str]:
-        """URLs the LB may route to: READY replicas of an active version
-        (blue_green pins this to the old set until cutover)."""
-        return [r['url'] for r in serve_state.get_replicas(self.service_name)
+    def ready_id_urls(self) -> List[tuple]:
+        """(replica_id, url) pairs the LB may route to: READY replicas
+        of an active version (blue_green pins this to the old set
+        until cutover). THE routable-set filter — ready_urls, the
+        weight map and the fleet scraper's target list all derive from
+        it, so the scraped set can never drift from the routed set."""
+        return [(r['replica_id'], r['url'])
+                for r in serve_state.get_replicas(self.service_name)
                 if r['status'] is ReplicaStatus.READY and r['url'] and
                 (r.get('version') or 1) in self.active_versions]
 
-    def ready_url_weights(self) -> Dict[str, float]:
+    def ready_urls(self) -> List[str]:
+        """URLs the LB may route to (see ready_id_urls)."""
+        return [url for _, url in self.ready_id_urls()]
+
+    def ready_url_weights(self, routable_urls: Optional[List[str]] = None
+                          ) -> Dict[str, float]:
         """url → capacity weight (total chips of the replica's launched
         slice; 1.0 when unknown) for instance-aware LB policies — a
         heterogeneous replica set (spot fallback across accelerator
         sizes) should not be loaded uniformly. Same readiness AND
-        active-version filter as ready_urls (one source of truth)."""
+        active-version filter as ready_urls (one source of truth);
+        pass ``routable_urls`` from a ready_id_urls() result already
+        in hand so one reconcile pass sees ONE consistent snapshot."""
         weights: Dict[str, float] = {}
-        routable = set(self.ready_urls())
+        routable = set(self.ready_urls() if routable_urls is None
+                       else routable_urls)
         for rep in serve_state.get_replicas(self.service_name):
             if rep['url'] not in routable:
                 continue

@@ -40,6 +40,7 @@ _POOL_UNSUPPORTED = frozenset({
 })
 _POLICY_FIELDS = frozenset({
     'min_replicas', 'max_replicas', 'target_qps_per_replica',
+    'target_queue_depth_per_replica',
     'upscale_delay_seconds', 'downscale_delay_seconds',
 })
 
@@ -56,13 +57,19 @@ class ReplicaPolicy:
     min_replicas: int = 1
     max_replicas: Optional[int] = None      # None → fixed at min_replicas
     target_qps_per_replica: Optional[float] = None
+    # Saturation autoscaling (serve/autoscalers.py
+    # SaturationAutoscaler): target fleet queue depth per replica,
+    # computed from the controller scraper's engine-reported
+    # saturation; falls back to QPS when scrape data goes stale.
+    target_queue_depth_per_replica: Optional[float] = None
     upscale_delay_seconds: float = 300.0
     downscale_delay_seconds: float = 1200.0
 
     @property
     def autoscaling_enabled(self) -> bool:
         return (self.max_replicas is not None and
-                self.target_qps_per_replica is not None)
+                (self.target_qps_per_replica is not None or
+                 self.target_queue_depth_per_replica is not None))
 
 
 @dataclasses.dataclass
@@ -136,6 +143,10 @@ class ServiceSpec:
                 target_qps_per_replica=(
                     float(pol_cfg['target_qps_per_replica'])
                     if 'target_qps_per_replica' in pol_cfg else None),
+                target_queue_depth_per_replica=(
+                    float(pol_cfg['target_queue_depth_per_replica'])
+                    if 'target_queue_depth_per_replica' in pol_cfg
+                    else None),
                 upscale_delay_seconds=float(
                     pol_cfg.get('upscale_delay_seconds', 300.0)),
                 downscale_delay_seconds=float(

@@ -1,0 +1,220 @@
+"""Fleet telemetry plane, END TO END on a live CPU stack (the ISSUE 9
+acceptance arc). Marked slow — two real engine subprocesses warm up in
+it — so tier-1 (-m 'not slow') skips it; run explicitly:
+
+    JAX_PLATFORMS=cpu pytest tests/chaos/test_fleet_e2e.py -m slow
+
+One test, three acts against TWO live `skypilot_tpu.serve.engine`
+replicas behind a real LoadBalancer wired exactly as the service
+controller wires it (Scraper + SLOEngine + ScrapeLoop + attach_fleet):
+
+  1. traffic through the LB → merged fleet TTFT/TPOT quantiles at
+     /-/fleet/metrics, per-replica saturation at /-/fleet/status, the
+     `observe fleet` CLI against the live endpoints;
+  2. kill one replica → scrape_failed journal events, the staleness
+     gauge trips, the availability SLO escalates to breach with a
+     journaled slo_breach event carrying both burn rates;
+  3. the saturation autoscaler consumed scraped queue depth while
+     fresh, and falls back to the QPS signal once samples go stale.
+"""
+import asyncio
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+def _get(url, timeout=10):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return r.read().decode()
+
+
+@pytest.fixture()
+def fleet_env(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKYTPU_OBSERVE_DB', str(tmp_path / 'observe.db'))
+    monkeypatch.setenv('SKYTPU_SATURATION_STALE_SECONDS', '5')
+    from skypilot_tpu.observe import metrics
+    metrics.REGISTRY.reset_for_tests()
+    yield tmp_path
+    metrics.REGISTRY.reset_for_tests()
+
+
+def test_fleet_plane_end_to_end(fleet_env):
+    from aiohttp import web
+
+    from skypilot_tpu.observe import journal
+    from skypilot_tpu.observe import metrics
+    from skypilot_tpu.observe import promtext
+    from skypilot_tpu.observe import scrape
+    from skypilot_tpu.observe import slo as slo_lib
+    from skypilot_tpu.serve import autoscalers as autoscaler_lib
+    from skypilot_tpu.serve import load_balancer as lb_lib
+    from skypilot_tpu.serve import service_spec as spec_lib
+
+    ports = [_free_port(), _free_port()]
+    engines = []
+    for p in ports:
+        engines.append(subprocess.Popen(
+            [sys.executable, '-m', 'skypilot_tpu.serve.engine',
+             '--model', 'llama-debug', '--max-len', '64',
+             '--warm-buckets', '16', '--host', '127.0.0.1',
+             '--port', str(p)],
+            stdout=sys.stderr, stderr=sys.stderr,
+            env={**os.environ, 'JAX_PLATFORMS': 'cpu',
+                 'SKYTPU_OBSERVE_DB': str(fleet_env / f'rep-{p}.db')}))
+    try:
+        deadline = time.time() + 300
+        for p in ports:
+            while True:
+                try:
+                    if json.loads(_get(
+                            f'http://127.0.0.1:{p}/health'))['status'] \
+                            == 'ok':
+                        break
+                except OSError:
+                    pass
+                assert time.time() < deadline, 'engine never ready'
+                time.sleep(1)
+
+        policy = spec_lib.ReplicaPolicy(
+            min_replicas=1, max_replicas=4, target_qps_per_replica=2.0,
+            target_queue_depth_per_replica=2.0,
+            upscale_delay_seconds=0.0, downscale_delay_seconds=0.0)
+        autoscaler = autoscaler_lib.Autoscaler.make(policy)
+        assert isinstance(autoscaler,
+                          autoscaler_lib.SaturationAutoscaler)
+        scraper = scrape.Scraper(timeout=2.0, staleness_seconds=5.0)
+        slo_engine = slo_lib.SLOEngine([slo_lib.SLOSpec(
+            kind='availability', objective=0.9, fast_window=6.0,
+            slow_window=15.0, fast_burn=1.5, slow_burn=1.0,
+            clear_rounds=3)], entity='fleet-demo')
+        lb = lb_lib.LoadBalancer('least_load', autoscaler,
+                                 service_name='fleet-demo')
+        lb.attach_fleet(scraper, slo_engine)
+        urls = [f'http://127.0.0.1:{p}' for p in ports]
+        lb.set_ready_replicas(urls)
+        scraper.set_targets([scrape.Target(f'fleet-demo/{i}', u)
+                             for i, u in enumerate(urls)])
+
+        def on_round(s):
+            snap = s.saturation_snapshot()
+            depths = {u: sat.queue_depth for u, sat in snap.items()}
+            lb.set_replica_saturation(depths)
+            autoscaler.observe_saturation(depths)
+            slo_engine.evaluate()
+
+        scrape_loop = scrape.ScrapeLoop(scraper, interval=1.0,
+                                        on_round=on_round)
+        lb_port = _free_port()
+
+        async def arc():
+            runner = web.AppRunner(lb.build_app())
+            await runner.setup()
+            await web.TCPSite(runner, '127.0.0.1', lb_port).start()
+            scrape_loop.start()
+            try:
+                import aiohttp
+                async with aiohttp.ClientSession() as sess:
+                    async def one(i):
+                        async with sess.post(
+                                f'http://127.0.0.1:{lb_port}/generate',
+                                json={'tokens': [(i % 30) + 1] * 8,
+                                      'max_new_tokens': 4}) as r:
+                            assert r.status == 200, await r.text()
+                            await r.json()
+                    await asyncio.gather(*(one(i) for i in range(12)))
+                await asyncio.sleep(3)      # a couple of rounds
+
+                # Act 1: merged fleet quantiles + status + CLI.
+                text = await asyncio.to_thread(
+                    _get, f'http://127.0.0.1:{lb_port}/-/fleet/metrics')
+                for fam in ('skytpu_engine_ttft_seconds',
+                            'skytpu_engine_tpot_seconds'):
+                    for q in (0.5, 0.95):
+                        v = promtext.quantile_from_text(text, fam, q)
+                        assert v == v, f'NaN fleet quantile for {fam}'
+                fams = promtext.parse(text)
+                reqs = sum(s.value for s in fams[
+                    'skytpu_engine_requests_total'].samples)
+                assert reqs >= 12      # both replicas' counters merged
+                status = json.loads(await asyncio.to_thread(
+                    _get, f'http://127.0.0.1:{lb_port}/-/fleet/status'))
+                assert len(status['replicas']) == 2
+                assert status['slo'] == {'availability': 'ok'}
+                cli = await asyncio.to_thread(
+                    subprocess.run,
+                    [sys.executable, '-m', 'skypilot_tpu.observe',
+                     'fleet', '--url', f'127.0.0.1:{lb_port}'],
+                    capture_output=True, text=True,
+                    env={**os.environ, 'PYTHONPATH': REPO})
+                assert cli.returncode == 0, cli.stderr
+                assert 'ttft_p95_ms' in cli.stdout
+
+                # Act 2: kill replica 1 → journal + staleness + breach.
+                engines[1].kill()
+                engines[1].wait()
+                t_end = time.time() + 30
+                while time.time() < t_end and \
+                        slo_engine.state('availability') != 'breach':
+                    await asyncio.sleep(0.5)
+                assert slo_engine.state('availability') == 'breach'
+                failed = journal.query(kind='scrape_failed')
+                assert failed
+                assert all(e['entity'] == 'fleet-demo/1'
+                           for e in failed)
+                breaches = journal.query(kind='slo_breach')
+                assert breaches
+                assert breaches[0]['data']['burn_fast'] >= 1.5
+                t_end = time.time() + 20    # staleness window trails
+                stale = 0.0
+                while time.time() < t_end:
+                    stale = metrics.REGISTRY._metrics[  # pylint: disable=protected-access
+                        'skytpu_scrape_stale_targets'].value()
+                    if stale >= 1:
+                        break
+                    await asyncio.sleep(0.5)
+                assert stale >= 1
+                status = json.loads(await asyncio.to_thread(
+                    _get, f'http://127.0.0.1:{lb_port}/-/fleet/status'))
+                assert status['slo'] == {'availability': 'breach'}
+
+                # Act 3: stop scraping → snapshot stale → QPS fallback.
+                scrape_loop.stop()
+                await asyncio.sleep(6)
+                for _ in range(10):
+                    autoscaler.record_request()
+                autoscaler.target_replicas()
+                fb = metrics.REGISTRY._metrics[  # pylint: disable=protected-access
+                    'skytpu_serve_autoscaler_fallback_total'].value(
+                        reason='stale')
+                assert fb >= 1
+            finally:
+                scrape_loop.stop()
+                await runner.cleanup()
+
+        asyncio.run(arc())
+    finally:
+        for e in engines:
+            if e.poll() is None:
+                e.terminate()
+        for e in engines:
+            try:
+                e.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                e.kill()

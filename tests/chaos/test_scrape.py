@@ -1,0 +1,185 @@
+"""Scrape-plane chaos: a blackholing/slow-loris replica can never
+delay healthy-target scraping beyond its own timeout.
+
+The scraper's containment contract (observe/scrape.py): each target
+scrapes on its own thread against its own wall-clock deadline, so
+
+  * a replica trickling /metrics bytes (slow-loris via ChaosProxy)
+    burns ONLY its own timeout — the healthy target's scrape lands in
+    the same round, on time;
+  * the round's wall time is bounded by one target's timeout budget,
+    never the sum over dead targets;
+  * the failure is evidence, not silence: a scrape_failed journal
+    event, an up=0 sample, the staleness accounting.
+
+Plus the deterministic half: the ``observe.scrape`` failpoint injects
+timeout (delay) and error modes without any real network misbehavior.
+"""
+import http.server
+import json
+import threading
+import time
+
+import pytest
+
+from skypilot_tpu.observe import journal
+from skypilot_tpu.observe import metrics
+from skypilot_tpu.observe import scrape
+from skypilot_tpu.observe import tsdb
+from skypilot_tpu.utils import failpoints
+from tests.chaos.chaos_proxy import ChaosProxy
+
+
+@pytest.fixture(autouse=True)
+def chaos_env(tmp_path, monkeypatch):
+    failpoints.reset()
+    monkeypatch.setenv('SKYTPU_OBSERVE_DB', str(tmp_path / 'observe.db'))
+    metrics.REGISTRY.reset_for_tests()
+    yield
+    failpoints.reset()
+    metrics.REGISTRY.reset_for_tests()
+
+
+_METRICS_TEXT = (
+    '# HELP skytpu_engine_queue_depth Depth.\n'
+    '# TYPE skytpu_engine_queue_depth gauge\n'
+    'skytpu_engine_queue_depth 2\n')
+
+
+class _Replica:
+    """A live /metrics + /health stub with a generous body (the
+    slow-loris proxy needs bytes to trickle)."""
+
+    def __init__(self):
+        class Handler(http.server.BaseHTTPRequestHandler):
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path == '/metrics':
+                    # Padded well past the proxy's 64KB relay chunk:
+                    # the slow-loris trickles per CHUNK, so the body
+                    # must span enough chunks that the trickle cannot
+                    # finish inside any reasonable scrape timeout.
+                    body = _METRICS_TEXT.encode() + b'\n' * (4 << 20)
+                    ctype = 'text/plain'
+                elif self.path == '/health':
+                    body = json.dumps(
+                        {'status': 'ok', 'queue_depth': 2,
+                         'in_flight': 1}).encode()
+                    ctype = 'application/json'
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header('Content-Type', ctype)
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.server = http.server.ThreadingHTTPServer(
+            ('127.0.0.1', 0), Handler)
+        self.port = self.server.server_address[1]
+        self.url = f'http://127.0.0.1:{self.port}'
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+class TestSlowLorisContainment:
+
+    def test_slow_loris_replica_never_delays_healthy_target(self):
+        """One healthy replica, one behind a byte-trickling ChaosProxy
+        (a chunk every 0.4s — each recv stays 'live', so only the
+        wall-clock deadline can stop it). The healthy target must be
+        scraped successfully IN THE SAME ROUND, and the round must end
+        within the per-target budget (~2x timeout worst case), not
+        hang on the loris."""
+        healthy = _Replica()
+        backend = _Replica()
+        proxy = ChaosProxy('127.0.0.1', backend.port, kill_every=10**9,
+                           byte_delay=0.4)
+        proxy.start()
+        try:
+            timeout = 1.5
+            s = scrape.Scraper(timeout=timeout, staleness_seconds=600)
+            s.set_targets([
+                scrape.Target('svc/ok', healthy.url),
+                scrape.Target('svc/loris',
+                              f'http://127.0.0.1:{proxy.port}'),
+            ])
+            t0 = time.monotonic()
+            results = s.scrape_round()
+            wall = time.monotonic() - t0
+            assert results['svc/ok'] is True
+            assert results['svc/loris'] is False
+            # Healthy data landed: samples + snapshot.
+            assert tsdb.latest_round(scrape.UP_SERIES,
+                                     'svc/ok')[''][1] == 1.0
+            assert s.saturation_snapshot()[healthy.url].queue_depth == 2
+            # The loris burned only its own budget: the round is
+            # bounded by the containment math (2x timeout + slack),
+            # nowhere near a serialized/wedged scan.
+            assert wall < timeout * 2 + 2.0, wall
+            # Evidence: up=0 + scrape_failed with the timeout class.
+            assert tsdb.latest_round(scrape.UP_SERIES,
+                                     'svc/loris')[''][1] == 0.0
+            events = journal.query(kind='scrape_failed')
+            assert [e['entity'] for e in events] == ['svc/loris']
+            assert events[0]['reason'] == 'timeout'
+            # And the healthy target's scrape latency stayed its own:
+            # a second round right away still succeeds for it.
+            assert s.scrape_round()['svc/ok'] is True
+        finally:
+            proxy.stop()
+            healthy.stop()
+            backend.stop()
+
+
+class TestScrapeFailpoint:
+
+    def test_error_mode_fails_target_not_round(self):
+        healthy = _Replica()
+        try:
+            s = scrape.Scraper(timeout=3.0)
+            s.set_targets([scrape.Target('svc/0', healthy.url)])
+            failpoints.arm('observe.scrape', once=True)
+            results = s.scrape_round()
+            assert results == {'svc/0': False}
+            events = journal.query(kind='scrape_failed')
+            assert events and events[0]['entity'] == 'svc/0'
+            assert 'Failpoint' in events[0]['data']['error']
+            # Disarmed: the next round recovers the target.
+            assert s.scrape_round() == {'svc/0': True}
+        finally:
+            healthy.stop()
+
+    def test_delay_mode_contained_to_its_target(self):
+        """A delay firing on one target (the failpoint's timeout
+        shape) must not stall the other target's scrape."""
+        fast = _Replica()
+        slow = _Replica()
+        try:
+            s = scrape.Scraper(timeout=3.0)
+            s.set_targets([scrape.Target('svc/fast', fast.url),
+                           scrape.Target('svc/slow', slow.url)])
+            # Probabilistic per-site seeding is overkill here: delay
+            # EVERY firing, max one, so exactly one of the two
+            # parallel workers eats the 1.2s.
+            failpoints.arm('observe.scrape', delay=1.2, max_fires=1)
+            t0 = time.monotonic()
+            results = s.scrape_round()
+            wall = time.monotonic() - t0
+            # Both succeed (delay, not error) — but in ONE round whose
+            # wall time shows the delay ran in parallel with, not in
+            # front of, the healthy scrape.
+            assert results == {'svc/fast': True, 'svc/slow': True}
+            assert wall < 3.0, wall
+        finally:
+            failpoints.reset()
+            fast.stop()
+            slow.stop()

@@ -194,6 +194,9 @@ class TestDiscovery:
                 'jobs.terminate', 'skylet.job_submit',
                 'ckpt.save', 'ckpt.restore',
                 'trainer.preempt'} <= names
+        # The fleet-telemetry site (observe/scrape.py):
+        # tests/chaos/test_scrape.py drives its timeout/error modes.
+        assert 'observe.scrape' in names
         # Naming contract holds for every discovered site.
         for name in names:
             assert failpoints.NAME_RE.match(name), name

@@ -1,0 +1,674 @@
+"""The fleet telemetry plane: tsdb samples, the controller scraper,
+SLO burn-rate evaluation, and the saturation consumers.
+
+Five angles:
+  1. tsdb — round-trip, latest/anchor round queries, GC (age +
+     row-cap) and its membership in the shared observe.gc();
+  2. scraper — two live stub replicas scraped in one round: samples
+     persisted, saturation snapshot fresh, fleet families merged;
+     a dead replica journals scrape_failed, writes up=0 and moves
+     the staleness gauge without touching the healthy target;
+  3. SLO engine — burn-rate math from synthetic samples, the
+     ok→warning→breach ladder (escalation immediate), de-escalation
+     hysteresis (clear_rounds), journaled slo_* events, bounded-label
+     metrics;
+  4. saturation autoscaler — queue-depth targets while the snapshot
+     is fresh, QPS fallback once it goes stale, hold with no QPS
+     objective;
+  5. LB policy — scraped queue depth breaks in-flight ties; the
+     fleet CLI renders both live and offline paths.
+"""
+import http.server
+import json
+import math
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from skypilot_tpu import observe
+from skypilot_tpu.observe import journal
+from skypilot_tpu.observe import metrics
+from skypilot_tpu.observe import promtext
+from skypilot_tpu.observe import scrape
+from skypilot_tpu.observe import slo as slo_lib
+from skypilot_tpu.observe import tsdb
+from skypilot_tpu.serve import autoscalers as autoscaler_lib
+from skypilot_tpu.serve import load_balancing_policies as lb_policies
+from skypilot_tpu.serve import service_spec as spec_lib
+
+
+@pytest.fixture(autouse=True)
+def fleet_env(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKYTPU_OBSERVE_DB', str(tmp_path / 'observe.db'))
+    monkeypatch.delenv('SKYTPU_SLO_SPECS', raising=False)
+    metrics.REGISTRY.reset_for_tests()
+    yield tmp_path
+    metrics.REGISTRY.reset_for_tests()
+
+
+# --------------------------------------------------------------- helpers
+
+def _engine_text(ttfts=(), tpots=(), queue_depth=0.0, in_flight=0.0,
+                 pages_free=None, requests=0):
+    """A replica's /metrics document with the engine families the
+    scraper stores, rendered by a REAL registry (same shape a live
+    engine emits)."""
+    reg = metrics.Registry()
+    h1 = reg.histogram('skytpu_engine_ttft_seconds', 'TTFT.',
+                       buckets=(0.1, 0.5, 1.0, 2.5))
+    for v in ttfts:
+        h1.observe(v)
+    h2 = reg.histogram('skytpu_engine_tpot_seconds', 'TPOT.',
+                       buckets=(0.01, 0.05, 0.25))
+    for v in tpots:
+        h2.observe(v)
+    reg.gauge('skytpu_engine_queue_depth', 'Depth.').set(queue_depth)
+    reg.gauge('skytpu_engine_in_flight', 'In flight.').set(in_flight)
+    if pages_free is not None:
+        reg.gauge('skytpu_engine_kv_pages_free',
+                  'Free pages.').set(pages_free)
+    c = reg.counter('skytpu_engine_requests_total', 'Requests.')
+    c.inc(requests)
+    return reg.render()
+
+
+class _StubReplica:
+    """A minimal live /metrics + /health server (http.server, own
+    thread) — what the scraper sees from a real engine replica."""
+
+    def __init__(self, metrics_text='', health=None):
+        self.metrics_text = metrics_text
+        self.health = health if health is not None else {'status': 'ok'}
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path == '/metrics':
+                    body = outer.metrics_text.encode()
+                    ctype = 'text/plain'
+                elif self.path == '/health':
+                    body = json.dumps(outer.health).encode()
+                    ctype = 'application/json'
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header('Content-Type', ctype)
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.server = http.server.ThreadingHTTPServer(
+            ('127.0.0.1', 0), Handler)
+        self.port = self.server.server_address[1]
+        self.url = f'http://127.0.0.1:{self.port}'
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+        self._thread.join(timeout=5)
+
+
+# ------------------------------------------------------------------ tsdb
+
+class TestTsdb:
+
+    def test_round_trip_latest_and_anchor(self):
+        t0 = time.time() - 100
+        tsdb.insert_samples('svc/0', [('skytpu_scrape_up', '', 1.0),
+                                      ('m', 'le="0.1"', 3.0)], ts=t0)
+        tsdb.insert_samples('svc/0', [('m', 'le="0.1"', 7.0)],
+                            ts=t0 + 50)
+        assert tsdb.targets() == ['svc/0']
+        latest = tsdb.latest_round('m', 'svc/0')
+        assert latest == {'le="0.1"': (t0 + 50, 7.0)}
+        anchor = tsdb.round_at_or_before('m', 'svc/0', t0 + 10)
+        assert anchor == {'le="0.1"': (t0, 3.0)}
+        # Before any round: empty.
+        assert tsdb.round_at_or_before('m', 'svc/0', t0 - 10) == {}
+        assert tsdb.latest_round('m', 'svc/1') == {}
+
+    def test_gc_age_and_rowcap_and_shared_observe_gc(self):
+        """The satellite contract: scrape data cannot grow unbounded —
+        the samples table obeys the same age + Nth-newest-id row cap
+        as events/spans and rides the ONE shared observe.gc()."""
+        now = time.time()
+        for i in range(10):
+            tsdb.insert_samples('svc/0', [('m', '', float(i))],
+                                ts=now - 1 + i * 0.01)
+        assert tsdb.gc_samples(max_age_seconds=3600) == 0
+        assert tsdb.gc_samples(max_age_seconds=3600, max_rows=4) == 6
+        left = tsdb.query(name='m')
+        assert [r['value'] for r in left] == [6.0, 7.0, 8.0, 9.0]
+        assert tsdb.gc_samples(max_age_seconds=0) == 4
+        assert tsdb.query(name='m') == []
+        # Shared GC covers events + spans + samples in one call.
+        tsdb.insert_samples('svc/0', [('m', '', 1.0)],
+                            ts=now - 10 * 24 * 3600)
+        pruned = observe.gc()
+        assert set(pruned) == {'events', 'spans', 'samples'}
+        assert pruned['samples'] == 1
+
+
+# --------------------------------------------------------------- scraper
+
+class TestScraper:
+
+    def test_two_live_replicas_one_round(self):
+        rep0 = _StubReplica(
+            _engine_text(ttfts=[0.05, 0.2], queue_depth=3,
+                         requests=2),
+            health={'status': 'ok', 'queue_depth': 3, 'in_flight': 1,
+                    'kv_pages_free': 40})
+        rep1 = _StubReplica(
+            _engine_text(ttfts=[0.7], queue_depth=5, requests=1),
+            health={'status': 'ok', 'queue_depth': 5, 'in_flight': 2})
+        try:
+            s = scrape.Scraper(timeout=5.0)
+            s.set_targets([scrape.Target('svc/0', rep0.url),
+                           scrape.Target('svc/1', rep1.url)])
+            results = s.scrape_round()
+            assert results == {'svc/0': True, 'svc/1': True}
+            # Samples persisted per target, incl. the up series.
+            assert tsdb.latest_round(scrape.UP_SERIES,
+                                     'svc/0')[''][1] == 1.0
+            assert tsdb.latest_round('skytpu_engine_queue_depth',
+                                     'svc/1')[''][1] == 5.0
+            # Saturation snapshot: health doc wins, metrics fill in.
+            snap = s.saturation_snapshot()
+            assert snap[rep0.url].queue_depth == 3
+            assert snap[rep0.url].kv_pages_free == 40
+            assert snap[rep1.url].in_flight == 2
+            assert snap[rep1.url].kv_pages_free is None
+            # Fleet merge: 3 TTFT observations across both shards,
+            # gauges summed.
+            fams = s.fleet_families()
+            hist = promtext.extract_histograms(
+                fams, 'skytpu_engine_ttft_seconds')[()]
+            assert hist.count == 3.0
+            depth = fams['skytpu_engine_queue_depth'].samples[0].value
+            assert depth == 8.0
+            p95 = promtext.histogram_quantile(hist, 0.95)
+            assert 0.5 < p95 <= 1.0
+        finally:
+            rep0.stop()
+            rep1.stop()
+
+    def test_dead_replica_contained_and_journaled(self):
+        rep0 = _StubReplica(_engine_text(queue_depth=1),
+                            health={'status': 'ok', 'queue_depth': 1})
+        try:
+            s = scrape.Scraper(timeout=2.0, staleness_seconds=600)
+            # A port nothing listens on: connection refused, fast.
+            s.set_targets([scrape.Target('svc/0', rep0.url),
+                           scrape.Target('svc/1',
+                                         'http://127.0.0.1:9')])
+            results = s.scrape_round()
+            assert results == {'svc/0': True, 'svc/1': False}
+            # The healthy target's data is intact.
+            assert s.saturation_snapshot()[rep0.url].queue_depth == 1
+            # Dead target: up=0 persisted + scrape_failed journaled.
+            assert tsdb.latest_round(scrape.UP_SERIES,
+                                     'svc/1')[''][1] == 0.0
+            events = journal.query(kind='scrape_failed')
+            assert len(events) == 1
+            assert events[0]['entity'] == 'svc/1'
+            assert events[0]['data']['url'] == 'http://127.0.0.1:9'
+            # Staleness gauge: svc/1 never succeeded but is younger
+            # than the window... with a 600s window nothing is stale
+            # yet — never-scraped targets count as stale only past it.
+            # Tighten the window and re-evaluate:
+            s.staleness_seconds = 0.0
+            s._refresh_staleness()  # pylint: disable=protected-access
+            stale = metrics.REGISTRY._metrics[  # pylint: disable=protected-access
+                'skytpu_scrape_stale_targets'].value()
+            assert stale >= 1
+        finally:
+            rep0.stop()
+
+    def test_departed_target_dropped_from_snapshot(self):
+        rep0 = _StubReplica(_engine_text(queue_depth=2),
+                            health={'queue_depth': 2})
+        try:
+            s = scrape.Scraper(timeout=5.0)
+            s.set_targets([scrape.Target('svc/0', rep0.url)])
+            s.scrape_round()
+            assert s.saturation_snapshot()
+            s.set_targets([])     # scaled down
+            assert s.saturation_snapshot() == {}
+            assert s.fleet_families() == {}
+        finally:
+            rep0.stop()
+
+
+# ------------------------------------------------------------ SLO engine
+
+def _write_up(target, values, now, spacing=10.0):
+    """Backfill an up-series: values[-1] is the most recent round."""
+    for i, v in enumerate(values):
+        ts = now - (len(values) - 1 - i) * spacing
+        tsdb.insert_samples(target, [(scrape.UP_SERIES, '', v)], ts=ts)
+
+
+class TestSLOEngine:
+
+    def test_up_series_literal_matches_scraper(self):
+        assert slo_lib._UP_SERIES == scrape.UP_SERIES  # pylint: disable=protected-access
+
+    def test_availability_burn_math(self):
+        now = time.time()
+        # 10 rounds in the fast window, 2 down → error fraction 0.2.
+        _write_up('svc/0', [1, 1, 1, 1, 0, 0, 1, 1, 1, 1], now,
+                  spacing=10.0)
+        frac = slo_lib.availability_error_fraction(200.0, now)
+        assert frac == pytest.approx(0.2)
+        assert slo_lib.availability_error_fraction(200.0,
+                                                   now + 5000) is None
+
+    def test_ladder_escalates_immediately_and_clears_with_hysteresis(
+            self):
+        spec = slo_lib.SLOSpec(kind='availability', objective=0.9,
+                               fast_window=100.0, slow_window=300.0,
+                               fast_burn=2.0, slow_burn=1.0,
+                               clear_rounds=2)
+        engine = slo_lib.SLOEngine([spec], entity='svc')
+        now = time.time()
+        # Healthy history → ok.
+        _write_up('svc/0', [1] * 30, now, spacing=10.0)
+        evals = engine.evaluate(now)
+        assert engine.state('availability') == 'ok'
+        assert evals[0].burn_fast == pytest.approx(0.0)
+        # Total outage inside the fast window: burn_fast = 1/0.1 = 10
+        # >= 2, slow burn well over 1 → breach, IMMEDIATELY.
+        _write_up('svc/0', [0] * 10, now + 100, spacing=10.0)
+        engine.evaluate(now + 100)
+        assert engine.state('availability') == 'breach'
+        events = journal.query(kind='slo_breach')
+        assert len(events) == 1
+        assert events[0]['entity'] == 'svc'
+        assert events[0]['data']['slo'] == 'availability'
+        assert events[0]['data']['burn_fast'] > 2.0
+        # Recovery: clean rounds — but de-escalation needs
+        # clear_rounds consecutive clean evaluations (hysteresis).
+        recovery = now + 2000
+        _write_up('svc/0', [1] * 40, recovery, spacing=10.0)
+        engine.evaluate(recovery)
+        assert engine.state('availability') == 'breach'   # 1st clean
+        engine.evaluate(recovery + 10)
+        assert engine.state('availability') == 'ok'       # 2nd clean
+        ok_events = journal.query(kind='slo_ok')
+        assert len(ok_events) == 1
+        # Bounded-label state metric: 0 again after recovery.
+        state_gauge = metrics.REGISTRY._metrics['skytpu_slo_state']  # pylint: disable=protected-access
+        assert state_gauge.value(slo='availability') == 0
+
+    def test_flapping_signal_cannot_strobe_state(self):
+        spec = slo_lib.SLOSpec(kind='availability', objective=0.9,
+                               fast_window=100.0, slow_window=300.0,
+                               fast_burn=2.0, slow_burn=1.0,
+                               clear_rounds=3)
+        engine = slo_lib.SLOEngine([spec], entity='svc')
+        now = time.time()
+        _write_up('svc/0', [0] * 10, now, spacing=10.0)
+        engine.evaluate(now)
+        assert engine.state('availability') == 'breach'
+        # ok, ok, bad, ok, ok — the bad round resets the clean count,
+        # so state holds breach through all five.
+        for i, vals in enumerate(([1] * 30, [1] * 30, [0] * 10,
+                                  [1] * 30, [1] * 30)):
+            t = now + 3000 * (i + 1)
+            _write_up('svc/0', vals, t, spacing=10.0)
+            engine.evaluate(t)
+            assert engine.state('availability') == 'breach', f'round {i}'
+
+    def test_latency_slo_from_bucket_deltas(self):
+        """A TTFT p95 SLO breaches when the WINDOW's observations
+        (cumulative bucket deltas, merged across replicas) run over
+        threshold — and old pre-window traffic cannot save it."""
+        spec = slo_lib.SLOSpec(kind='ttft_p95', objective=0.9,
+                               threshold_seconds=0.5,
+                               fast_window=100.0, slow_window=300.0,
+                               fast_burn=2.0, slow_burn=1.0,
+                               clear_rounds=2)
+        engine = slo_lib.SLOEngine([spec], entity='svc')
+        now = time.time()
+
+        def rows(text):
+            fams = promtext.parse(text)
+            out = []
+            for fam_name in ('skytpu_engine_ttft_seconds',):
+                for s in fams[fam_name].samples:
+                    out.append((s.name, promtext.labels_text(s.labels),
+                                s.value))
+            return out
+
+        # Ancient history: 100 fast requests, well before the window.
+        fast_hist = [0.05] * 100
+        tsdb.insert_samples('svc/0', rows(_engine_text(ttfts=fast_hist)),
+                            ts=now - 1000)
+        # Window start anchor: same cumulative state.
+        tsdb.insert_samples('svc/0', rows(_engine_text(ttfts=fast_hist)),
+                            ts=now - 90)
+        # Latest: 10 NEW slow requests (cumulative includes history).
+        tsdb.insert_samples(
+            'svc/0', rows(_engine_text(ttfts=fast_hist + [2.0] * 10)),
+            ts=now - 5)
+        hist = slo_lib.windowed_histogram('skytpu_engine_ttft_seconds',
+                                          100.0, now)
+        assert hist.count == 10.0       # only the window's delta
+        frac = slo_lib.latency_error_fraction(hist, 0.5)
+        assert frac == pytest.approx(1.0)
+        engine.evaluate(now)
+        assert engine.state('ttft_p95') == 'breach'
+        breach = journal.query(kind='slo_breach')[0]
+        assert breach['data']['kind'] == 'ttft_p95'
+        assert breach['data']['measured'] is not None
+
+    def test_counter_restart_uses_absolute_not_negative_delta(self):
+        now = time.time()
+        tsdb.insert_samples('svc/0', [
+            ('skytpu_engine_ttft_seconds_bucket', 'le="0.1"', 50.0),
+            ('skytpu_engine_ttft_seconds_bucket', 'le="+Inf"', 50.0),
+            ('skytpu_engine_ttft_seconds_count', '', 50.0),
+            ('skytpu_engine_ttft_seconds_sum', '', 2.0)], ts=now - 90)
+        # Replica restarted: cumulative counts dropped below anchor.
+        tsdb.insert_samples('svc/0', [
+            ('skytpu_engine_ttft_seconds_bucket', 'le="0.1"', 3.0),
+            ('skytpu_engine_ttft_seconds_bucket', 'le="+Inf"', 3.0),
+            ('skytpu_engine_ttft_seconds_count', '', 3.0),
+            ('skytpu_engine_ttft_seconds_sum', '', 0.1)], ts=now - 5)
+        hist = slo_lib.windowed_histogram('skytpu_engine_ttft_seconds',
+                                          100.0, now)
+        assert hist.count == 3.0        # absolute, never negative
+
+    def test_bucket_mismatch_contained_per_spec(self):
+        """Regression: during a rolling update old/new replicas can
+        declare different bucket layouts — the resulting merge refusal
+        must cost ONLY the latency spec's round, never availability
+        alerting (which matters most in exactly that window)."""
+        specs = [
+            slo_lib.SLOSpec(kind='availability', objective=0.9,
+                            fast_window=100.0, slow_window=300.0,
+                            fast_burn=2.0, slow_burn=1.0),
+            slo_lib.SLOSpec(kind='ttft_p95', objective=0.9,
+                            threshold_seconds=0.5, fast_window=100.0,
+                            slow_window=300.0),
+        ]
+        engine = slo_lib.SLOEngine(specs, entity='svc')
+        now = time.time()
+        # Availability data: total outage → must still breach.
+        _write_up('svc/0', [0] * 10, now, spacing=10.0)
+        _write_up('svc/1', [0] * 10, now, spacing=10.0)
+        # Mismatched TTFT layouts across the two replicas.
+        for target, les in (('svc/0', ('0.1', '+Inf')),
+                            ('svc/1', ('0.2', '+Inf'))):
+            tsdb.insert_samples(target, [
+                *[('skytpu_engine_ttft_seconds_bucket', f'le="{le}"',
+                   5.0) for le in les],
+                ('skytpu_engine_ttft_seconds_count', '', 5.0),
+                ('skytpu_engine_ttft_seconds_sum', '', 1.0)],
+                ts=now - 5)
+        evals = engine.evaluate(now)
+        by_kind = {e.spec.kind: e for e in evals}
+        # The latency spec held (no burn data), availability breached.
+        assert by_kind['ttft_p95'].state == 'ok'
+        assert by_kind['ttft_p95'].burn_fast is None
+        assert by_kind['availability'].state == 'breach'
+        assert journal.query(kind='slo_breach')
+
+    def test_windowed_histogram_labeled_family_groups_label_sets(self):
+        """Regression: a LABELED histogram family has one cumulative
+        bucket series per label set — they must group per label set
+        and merge bucket-wise, not concatenate into one garbage
+        bucket list with an arbitrary label set's _sum/_count."""
+        now = time.time()
+        rows = []
+        # Two label sets, same declared layout: cls=a all fast (10),
+        # cls=b all slow (10).
+        for cls, fast, slow in (('a', 10.0, 0.0), ('b', 0.0, 10.0)):
+            rows += [
+                ('skytpu_engine_ttft_seconds_bucket',
+                 f'cls="{cls}",le="0.1"', fast),
+                ('skytpu_engine_ttft_seconds_bucket',
+                 f'cls="{cls}",le="1"', fast + slow),
+                ('skytpu_engine_ttft_seconds_bucket',
+                 f'cls="{cls}",le="+Inf"', fast + slow),
+                ('skytpu_engine_ttft_seconds_count', f'cls="{cls}"',
+                 fast + slow),
+                ('skytpu_engine_ttft_seconds_sum', f'cls="{cls}"',
+                 fast * 0.05 + slow * 0.5),
+            ]
+        tsdb.insert_samples('svc/0', rows, ts=now - 5)
+        hist = slo_lib.windowed_histogram('skytpu_engine_ttft_seconds',
+                                          100.0, now)
+        assert hist.count == 20.0
+        assert hist.buckets == [(0.1, 10.0), (1.0, 20.0),
+                                (math.inf, 20.0)]
+        assert hist.sum == pytest.approx(10 * 0.05 + 10 * 0.5)
+        # p50 sits at the 0.1 boundary; p95 inside the slow bucket.
+        assert promtext.histogram_quantile(hist, 0.5) == \
+            pytest.approx(0.1)
+        assert 0.1 < promtext.histogram_quantile(hist, 0.95) <= 1.0
+
+    def test_no_data_holds_state(self):
+        spec = slo_lib.SLOSpec(kind='availability', objective=0.9,
+                               fast_window=50.0, slow_window=100.0)
+        engine = slo_lib.SLOEngine([spec])
+        evals = engine.evaluate(time.time())
+        assert engine.state('availability') == 'ok'
+        assert evals[0].burn_fast is None
+        assert not evals[0].transitioned
+
+    def test_entity_scoping_on_shared_db(self):
+        """Regression: two controllers share one observe DB — service
+        A's SLOs must never count service B's outages or latencies.
+        An engine bound to entity 'a' sees only 'a/...' targets (and
+        'ab/...' must not prefix-leak in)."""
+        spec = slo_lib.SLOSpec(kind='availability', objective=0.9,
+                               fast_window=100.0, slow_window=300.0,
+                               fast_burn=2.0, slow_burn=1.0,
+                               clear_rounds=2)
+        engine_a = slo_lib.SLOEngine([spec], entity='a')
+        now = time.time()
+        _write_up('a/0', [1] * 30, now, spacing=10.0)       # healthy
+        _write_up('b/0', [0] * 30, now, spacing=10.0)       # outage
+        _write_up('ab/0', [0] * 30, now, spacing=10.0)      # outage
+        engine_a.evaluate(now)
+        assert engine_a.state('availability') == 'ok'
+        assert journal.query(kind='slo_breach') == []
+        # The sibling's own engine DOES breach from the same DB.
+        engine_b = slo_lib.SLOEngine(
+            [slo_lib.SLOSpec(kind='availability', objective=0.9,
+                             fast_window=100.0, slow_window=300.0,
+                             fast_burn=2.0, slow_burn=1.0)],
+            entity='b')
+        engine_b.evaluate(now)
+        assert engine_b.state('availability') == 'breach'
+
+    def test_spec_validation(self):
+        with pytest.raises(ValueError, match='unknown SLO kind'):
+            slo_lib.SLOSpec(kind='latency_p50')
+        with pytest.raises(ValueError, match='objective'):
+            slo_lib.SLOSpec(kind='availability', objective=1.0)
+        with pytest.raises(ValueError, match='duplicate'):
+            slo_lib.SLOEngine([slo_lib.SLOSpec(kind='availability'),
+                               slo_lib.SLOSpec(kind='availability')])
+
+    def test_env_specs_parse_and_malformed_raises(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_SLO_SPECS', json.dumps([
+            {'kind': 'availability', 'objective': 0.95,
+             'fast_window': 60}]))
+        specs = slo_lib.default_specs()
+        assert len(specs) == 1
+        assert specs[0].objective == 0.95
+        monkeypatch.setenv('SKYTPU_SLO_SPECS', '{not json')
+        with pytest.raises(ValueError, match='SKYTPU_SLO_SPECS'):
+            slo_lib.default_specs()
+
+
+# ------------------------------------------- saturation autoscaler + LB
+
+def _sat_policy(**kw):
+    cfg = dict(min_replicas=1, max_replicas=8,
+               target_queue_depth_per_replica=4.0)
+    cfg.update(kw)
+    return spec_lib.ReplicaPolicy(**cfg)
+
+
+class TestSaturationAutoscaler:
+
+    def test_make_chooses_saturation_policy(self):
+        a = autoscaler_lib.Autoscaler.make(_sat_policy())
+        assert isinstance(a, autoscaler_lib.SaturationAutoscaler)
+        b = autoscaler_lib.Autoscaler.make(spec_lib.ReplicaPolicy(
+            min_replicas=1, max_replicas=4, target_qps_per_replica=2.0))
+        assert isinstance(b, autoscaler_lib.RequestRateAutoscaler)
+        assert not isinstance(b, autoscaler_lib.SaturationAutoscaler)
+
+    def test_fresh_signal_scales_on_queue_depth(self):
+        a = autoscaler_lib.SaturationAutoscaler(
+            _sat_policy(upscale_delay_seconds=10.0))
+        now = 1000.0
+        a.observe_saturation({'u0': 10.0, 'u1': 10.0}, now=now)
+        # Raw target = ceil(20/4) = 5; hysteresis holds at 1 until the
+        # delay elapses.
+        assert a.target_replicas(now=now) == 1
+        a.observe_saturation({'u0': 10.0, 'u1': 10.0}, now=now + 5)
+        assert a.target_replicas(now=now + 5) == 1
+        a.observe_saturation({'u0': 10.0, 'u1': 10.0}, now=now + 11)
+        assert a.target_replicas(now=now + 11) == 5
+
+    def test_stale_signal_falls_back_to_qps(self):
+        """THE fallback contract: scrape data older than the staleness
+        window must not drive scaling — the QPS signal takes over."""
+        a = autoscaler_lib.SaturationAutoscaler(_sat_policy(
+            target_qps_per_replica=1.0, upscale_delay_seconds=0.0,
+            downscale_delay_seconds=0.0))
+        now = 1000.0
+        a.observe_saturation({'u0': 40.0}, now=now)
+        # Zero delay still takes two sightings (pending is armed on
+        # the first, applied on the second).
+        a.target_replicas(now=now + 1)
+        assert a.target_replicas(now=now + 2) == 8  # capped queue path
+        # 60 QPS-window requests → qps 1 → want 1. Past the staleness
+        # horizon the queue depth (which said 8) must be IGNORED.
+        for i in range(60):
+            a.record_request(now=now + 31 + i * 0.01)
+        t = now + 31 + autoscaler_lib.SATURATION_STALE_SECONDS
+        a.target_replicas(now=t)
+        assert a.target_replicas(now=t + 1) == 1
+        fallback = metrics.REGISTRY._metrics[  # pylint: disable=protected-access
+            'skytpu_serve_autoscaler_fallback_total']
+        assert fallback.value(reason='stale') >= 1
+
+    def test_qps_deque_trims_on_record_in_saturation_mode(self):
+        """Regression: with a fresh saturation signal the QPS path is
+        never read, so the request-timestamp deque must trim at
+        APPEND — or it grows by one float per proxied request for as
+        long as the fleet stays healthy."""
+        a = autoscaler_lib.SaturationAutoscaler(_sat_policy())
+        now = 1000.0
+        a.observe_saturation({'u0': 1.0}, now=now)
+        for i in range(5000):
+            a.record_request(now=now + i * 0.1)   # 500s of traffic
+        # Only the last QPS_WINDOW_SECONDS of timestamps remain.
+        assert len(a._timestamps) <= \
+            autoscaler_lib.QPS_WINDOW_SECONDS / 0.1 + 1
+
+    def test_empty_snapshot_is_no_signal_not_zero_depth(self):
+        """Regression: when every replica goes stale/unreachable the
+        controller publishes an EMPTY snapshot each round — that must
+        not refresh the freshness stamp as 'fleet depth 0' (scaling an
+        unreachable fleet DOWN); it must age out into the QPS
+        fallback."""
+        a = autoscaler_lib.SaturationAutoscaler(_sat_policy(
+            target_qps_per_replica=1.0, upscale_delay_seconds=0.0,
+            downscale_delay_seconds=0.0))
+        now = 1000.0
+        a.observe_saturation({'u0': 40.0}, now=now)
+        a.target_replicas(now=now + 1)
+        assert a.target_replicas(now=now + 2) == 8
+        # Replicas vanish: empty snapshots keep arriving every round.
+        stale_at = now + 2 + autoscaler_lib.SATURATION_STALE_SECONDS + 1
+        for i in range(5):
+            a.observe_saturation({}, now=stale_at + i)
+        for i in range(60):
+            a.record_request(now=stale_at + i * 0.01)
+        a.target_replicas(now=stale_at + 5)
+        assert a.target_replicas(now=stale_at + 6) == 1  # QPS, not 8
+        fallback = metrics.REGISTRY._metrics[  # pylint: disable=protected-access
+            'skytpu_serve_autoscaler_fallback_total']
+        assert fallback.value(reason='stale') >= 1
+
+    def test_no_signal_ever_uses_qps_and_no_qps_holds(self):
+        a = autoscaler_lib.SaturationAutoscaler(_sat_policy(
+            upscale_delay_seconds=0.0, downscale_delay_seconds=0.0))
+        # Never observed saturation, no QPS objective → hold min.
+        assert a.target_replicas(now=5.0) == 1
+        fallback = metrics.REGISTRY._metrics[  # pylint: disable=protected-access
+            'skytpu_serve_autoscaler_fallback_total']
+        assert fallback.value(reason='no_signal') >= 1
+
+
+class TestPolicySaturationTieBreak:
+
+    def test_least_load_breaks_ties_on_scraped_depth(self):
+        p = lb_policies.LeastLoadPolicy()
+        p.set_ready_replicas(['u0', 'u1'])
+        p.set_replica_saturation({'u0': 9.0, 'u1': 1.0})
+        # Equal in-flight (0 each): the scraped depth decides.
+        assert p.select() == 'u1'
+        # In-flight still dominates: u1 busier in-flight loses even
+        # with the shallower queue.
+        p.request_started('u1')
+        assert p.select() == 'u0'
+
+    def test_no_saturation_data_degrades_to_in_flight_only(self):
+        p = lb_policies.LeastLoadPolicy()
+        p.set_ready_replicas(['u0', 'u1'])
+        p.request_started('u0')
+        assert p.select() == 'u1'
+
+
+# ------------------------------------------------------------- fleet CLI
+
+class TestFleetCLI:
+
+    def test_offline_fleet_doc_from_tsdb(self, fleet_env):
+        now = time.time()
+        fams_text = _engine_text(ttfts=[0.05] * 9 + [2.0],
+                                 queue_depth=4, in_flight=2,
+                                 pages_free=10)
+        fams = promtext.parse(fams_text)
+        rows = []
+        for fam_name in scrape.STORED_FAMILIES:
+            fam = fams.get(fam_name)
+            if fam:
+                for s in fam.samples:
+                    rows.append((s.name,
+                                 promtext.labels_text(s.labels),
+                                 s.value))
+        rows.append((scrape.UP_SERIES, '', 1.0))
+        tsdb.insert_samples('svc/0', rows, ts=now - 5)
+        out = subprocess.run(
+            [sys.executable, '-m', 'skypilot_tpu.observe', 'fleet',
+             '--db', str(fleet_env / 'observe.db'), '--json'],
+            capture_output=True, text=True, check=True)
+        doc = json.loads(out.stdout)
+        assert doc['replicas'][0]['entity'] == 'svc/0'
+        assert doc['replicas'][0]['queue_depth'] == 4.0
+        assert doc['replicas'][0]['up'] is True
+        assert 'ttft_p50_ms' in doc['fleet_quantiles']
+        assert 'ttft_p95_ms' in doc['fleet_quantiles']
+        assert doc['fleet_quantiles']['ttft_p95_ms'] > \
+            doc['fleet_quantiles']['ttft_p50_ms']

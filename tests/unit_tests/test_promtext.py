@@ -1,0 +1,239 @@
+"""observe/promtext: the ONE exposition parser / bucket merger /
+quantile estimator (shared by bench.py, the fleet CLI, the scraper and
+the SLO engine).
+
+Contracts under test:
+  1. parse ∘ render round-trips the live registry's exposition output
+     (labels, escaping, +Inf buckets, HELP/TYPE);
+  2. the merge PROPERTY: merging N shards' histograms bucket-wise
+     equals one histogram fed the union stream — including the
+     +Inf == _count invariant — over randomized shardings;
+  3. mismatched bucket layouts REFUSE loudly (BucketMismatchError),
+     never interpolate;
+  4. histogram_quantile matches the documented estimate: linear
+     interpolation inside the target bucket, last finite bound for
+     the +Inf tail, nan on empty;
+  5. merge_texts fleet semantics: counters/gauges sum per label set,
+     histograms merge, type conflicts refuse.
+"""
+import math
+import random
+
+import pytest
+
+from skypilot_tpu.observe import metrics
+from skypilot_tpu.observe import promtext
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    metrics.REGISTRY.reset_for_tests()
+    yield
+    metrics.REGISTRY.reset_for_tests()
+
+
+def _render_histogram(values, buckets, name='skytpu_test_h_seconds'):
+    """A fresh single-family exposition text via a throwaway registry
+    (not the global one — each shard must be independent)."""
+    reg = metrics.Registry()
+    h = reg.histogram(name, 'test histogram', buckets=buckets)
+    for v in values:
+        h.observe(v)
+    return reg.render()
+
+
+class TestParse:
+
+    def test_round_trips_live_registry_output(self):
+        reg = metrics.Registry()
+        c = reg.counter('skytpu_test_requests_total', 'Requests.',
+                        labels={'outcome': ('ok', 'err')})
+        c.inc(outcome='ok')
+        c.inc(2.0, outcome='err')
+        g = reg.gauge('skytpu_test_depth', 'A "quoted" gauge\nhelp.')
+        g.set(7.5)
+        h = reg.histogram('skytpu_test_wait_seconds', 'Waits.',
+                          buckets=(0.1, 1.0))
+        h.observe(0.05)
+        h.observe(0.5)
+        h.observe(5.0)
+        text = reg.render()
+        fams = promtext.parse(text)
+        assert fams['skytpu_test_requests_total'].kind == 'counter'
+        assert fams['skytpu_test_depth'].kind == 'gauge'
+        assert fams['skytpu_test_wait_seconds'].kind == 'histogram'
+        # Escaped help round-trips.
+        assert fams['skytpu_test_depth'].help_text == \
+            'A "quoted" gauge\nhelp.'
+        by_labels = {s.labels: s.value
+                     for s in fams['skytpu_test_requests_total'].samples}
+        assert by_labels == {(('outcome', 'err'),): 2.0,
+                             (('outcome', 'ok'),): 1.0}
+        # Histogram samples folded under the base family name.
+        names = {s.name for s in fams['skytpu_test_wait_seconds'].samples}
+        assert names == {'skytpu_test_wait_seconds_bucket',
+                         'skytpu_test_wait_seconds_sum',
+                         'skytpu_test_wait_seconds_count'}
+        # And render(parse(x)) parses identically (stable fixpoint).
+        again = promtext.parse(promtext.render(fams))
+        assert {n: [(s.name, s.labels, s.value) for s in f.samples]
+                for n, f in again.items()} == \
+            {n: [(s.name, s.labels, s.value) for s in f.samples]
+             for n, f in fams.items()}
+
+    def test_garbled_sample_lines_skipped_not_fatal(self):
+        text = ('# TYPE skytpu_test_x_total counter\n'
+                'skytpu_test_x_total 3\n'
+                'this is not a sample line at all {{{\n'
+                'skytpu_test_x_total{bad-label=}} 4\n')
+        fams = promtext.parse(text)
+        assert [s.value for s in fams['skytpu_test_x_total'].samples] \
+            == [3.0]
+
+    def test_conflicting_type_declaration_raises(self):
+        text = ('# TYPE skytpu_test_x_total counter\n'
+                '# TYPE skytpu_test_x_total gauge\n')
+        with pytest.raises(ValueError, match='declared both'):
+            promtext.parse(text)
+
+
+class TestHistogramMergeProperty:
+
+    def test_merge_of_shards_equals_union_stream(self):
+        """THE merge property: for random value streams randomly
+        sharded N ways, bucket-wise merge of the shards' histograms ==
+        the histogram of the union stream — buckets, _sum, _count and
+        the +Inf == _count invariant all equal."""
+        rng = random.Random(20260804)
+        buckets = (0.01, 0.05, 0.1, 0.5, 1.0, 5.0)
+        for trial in range(25):
+            n_shards = rng.randint(1, 5)
+            values = [rng.expovariate(2.0) for _ in range(
+                rng.randint(0, 120))]
+            shards = [[] for _ in range(n_shards)]
+            for v in values:
+                shards[rng.randrange(n_shards)].append(v)
+            shard_hists = []
+            for sv in shards:
+                fams = promtext.parse(_render_histogram(sv, buckets))
+                hs = promtext.extract_histograms(fams,
+                                                 'skytpu_test_h_seconds')
+                # An empty shard renders no samples — represent as
+                # absent (merge must tolerate it via the empty case).
+                if hs:
+                    shard_hists.append(hs[()])
+            union = promtext.extract_histograms(
+                promtext.parse(_render_histogram(values, buckets)),
+                'skytpu_test_h_seconds')
+            merged = promtext.merge_histograms(shard_hists)
+            if not union:
+                assert merged.count == 0
+                continue
+            expect = union[()]
+            assert merged.buckets == expect.buckets, f'trial {trial}'
+            assert merged.count == expect.count
+            assert merged.sum == pytest.approx(expect.sum)
+            # +Inf bucket equals _count (the exposition invariant
+            # merging must preserve).
+            assert merged.buckets[-1][0] == math.inf
+            assert merged.buckets[-1][1] == merged.count
+
+    def test_mismatched_bucket_layouts_refuse_loudly(self):
+        a = promtext.extract_histograms(
+            promtext.parse(_render_histogram([0.2], (0.1, 1.0))),
+            'skytpu_test_h_seconds')[()]
+        b = promtext.extract_histograms(
+            promtext.parse(_render_histogram([0.2], (0.1, 2.0))),
+            'skytpu_test_h_seconds')[()]
+        with pytest.raises(promtext.BucketMismatchError,
+                           match='bucket layouts'):
+            promtext.merge_histograms([a, b])
+        # Same bounds, different cardinality: also a refusal.
+        c = promtext.extract_histograms(
+            promtext.parse(_render_histogram([0.2], (0.1, 1.0, 2.0))),
+            'skytpu_test_h_seconds')[()]
+        with pytest.raises(promtext.BucketMismatchError):
+            promtext.merge_histograms([a, c])
+
+    def test_merge_empty_inputs(self):
+        merged = promtext.merge_histograms([])
+        assert merged.count == 0
+        assert math.isnan(promtext.histogram_quantile(merged, 0.95))
+
+
+class TestQuantile:
+
+    def test_linear_interpolation_inside_bucket(self):
+        # 10 samples <= 1.0, none below 0.5: rank 5 lands mid-bucket.
+        hist = promtext.HistogramData(
+            buckets=[(0.5, 0.0), (1.0, 10.0), (math.inf, 10.0)],
+            sum=8.0, count=10.0)
+        assert promtext.histogram_quantile(hist, 0.5) == \
+            pytest.approx(0.5 + (1.0 - 0.5) * 0.5)
+
+    def test_inf_tail_answers_last_finite_bound(self):
+        hist = promtext.HistogramData(
+            buckets=[(1.0, 1.0), (math.inf, 10.0)], sum=0.0, count=10.0)
+        assert promtext.histogram_quantile(hist, 0.99) == 1.0
+
+    def test_empty_and_none_are_nan(self):
+        assert math.isnan(promtext.histogram_quantile(None, 0.5))
+        empty = promtext.HistogramData(buckets=[(math.inf, 0.0)])
+        assert math.isnan(promtext.histogram_quantile(empty, 0.5))
+
+    def test_quantile_from_text_merges_label_sets(self):
+        """The bench.py shape: one family, several label sets — the
+        quantile is over ALL of them merged (they share the declared
+        layout by construction)."""
+        reg = metrics.Registry()
+        h = reg.histogram('skytpu_test_lat_seconds', 'x',
+                          labels={'cls': ('a', 'b')},
+                          buckets=(0.1, 1.0, 10.0))
+        for _ in range(9):
+            h.observe(0.05, cls='a')
+        h.observe(5.0, cls='b')
+        text = reg.render()
+        v50 = promtext.quantile_from_text(text,
+                                          'skytpu_test_lat_seconds', 0.5)
+        assert 0.0 < v50 <= 0.1
+        v95 = promtext.quantile_from_text(text,
+                                          'skytpu_test_lat_seconds',
+                                          0.95)
+        assert 1.0 < v95 <= 10.0
+        assert math.isnan(promtext.quantile_from_text(
+            text, 'skytpu_test_absent_seconds', 0.5))
+
+
+class TestFleetMerge:
+
+    def test_counters_and_gauges_sum_histograms_merge(self):
+        def shard(n_ok, depth, waits):
+            reg = metrics.Registry()
+            c = reg.counter('skytpu_test_reqs_total', 'Reqs.',
+                            labels={'outcome': ('ok',)})
+            c.inc(n_ok, outcome='ok')
+            reg.gauge('skytpu_test_queue_depth', 'Depth.').set(depth)
+            h = reg.histogram('skytpu_test_wait_seconds', 'Waits.',
+                              buckets=(0.1, 1.0))
+            for w in waits:
+                h.observe(w)
+            return reg.render()
+
+        merged = promtext.parse(promtext.merge_texts([
+            shard(3, 2, [0.05, 0.5]), shard(4, 5, [2.0])]))
+        reqs = merged['skytpu_test_reqs_total'].samples
+        assert [(s.labels, s.value) for s in reqs] == \
+            [((('outcome', 'ok'),), 7.0)]
+        depth = merged['skytpu_test_queue_depth'].samples
+        assert depth[0].value == 7.0
+        hists = promtext.extract_histograms(merged,
+                                            'skytpu_test_wait_seconds')
+        assert hists[()].count == 3.0
+        assert hists[()].buckets == [(0.1, 1.0), (1.0, 2.0),
+                                     (math.inf, 3.0)]
+
+    def test_type_conflict_across_shards_refuses(self):
+        a = '# TYPE skytpu_test_x_total counter\nskytpu_test_x_total 1\n'
+        b = '# TYPE skytpu_test_x_total gauge\nskytpu_test_x_total 2\n'
+        with pytest.raises(ValueError, match='typed'):
+            promtext.merge_texts([a, b])

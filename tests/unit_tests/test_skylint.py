@@ -814,6 +814,47 @@ class TestMetricDisciplineChecker:
         ''')
         assert _run(tmp_path, checks=['metric-discipline'])['total'] == 0
 
+    def test_adhoc_exposition_parse_flagged_outside_observe(
+            self, tmp_path):
+        """Rule 4: hand-regexing Prometheus text (bucket-line string
+        fragments) outside observe/ is the drift the promtext
+        factoring removed — flagged even WITHOUT an observe import
+        (an ad-hoc parser needs none)."""
+        _write(tmp_path, 'serve/reader.py', '''\
+            def p95(text, family):
+                prefix = f'{family}_bucket{{le="'
+                for line in text.splitlines():
+                    if line.startswith(prefix):
+                        pass
+
+            def other(text):
+                return [l for l in text.splitlines()
+                        if '_bucket{' in l]
+        ''')
+        report = _run(tmp_path, checks=['metric-discipline'])
+        idents = _idents(report)
+        assert idents == [
+            'metric-discipline:serve/reader.py:adhoc-exposition-parse',
+        ] * 2
+        assert 'promtext' in report['violations'][0]['message']
+
+    def test_adhoc_exposition_docstrings_and_plain_names_exempt(
+            self, tmp_path):
+        _write(tmp_path, 'serve/clean.py', '''\
+            """Prose about skytpu_x_bucket{le="0.1"} lines is fine."""
+            from skypilot_tpu.observe import promtext
+
+            def quantile(text, family, q):
+                return promtext.quantile_from_text(text, family, q)
+
+            def total(text):
+                # Family-name prefix matching carries no bucket
+                # fragment — not ad-hoc exposition parsing.
+                return [l for l in text.splitlines()
+                        if l.startswith('skytpu_engine_tokens_total')]
+        ''')
+        assert _run(tmp_path, checks=['metric-discipline'])['total'] == 0
+
 
 class TestSpanDisciplineChecker:
 
@@ -1405,7 +1446,7 @@ class TestLivePackage:
         with open(out_path, encoding='utf-8') as f:
             report = json.load(f)
         # Schema stability (version-bump ratchet).
-        assert report['skylint_version'] == core.REPORT_VERSION == 8
+        assert report['skylint_version'] == core.REPORT_VERSION == 9
         assert set(report) == {
             'skylint_version', 'root', 'files_scanned', 'checks',
             'violations', 'total', 'allowlisted', 'new',

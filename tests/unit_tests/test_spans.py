@@ -139,10 +139,11 @@ class TestSpanMechanics:
         left = spans.query_spans()
         assert len(left) == 10
         assert all(s['name'].startswith('new.') for s in left)
-        # The shared observe.gc() covers both tables in one call.
+        # The shared observe.gc() covers every journal-DB table
+        # (events + spans + the fleet scraper's samples) in one call.
         from skypilot_tpu import observe
         pruned = observe.gc()
-        assert set(pruned) == {'events', 'spans'}
+        assert set(pruned) == {'events', 'spans', 'samples'}
 
     def test_chrome_export_merges_timeline(self, tmp_path, monkeypatch):
         tl_path = tmp_path / 'timeline.json'
