@@ -837,6 +837,108 @@ async def _drive_serve_load(port, concurrency, n_requests, prompt_len,
     return ttft_ms, tpot_ms, wall, n_ok
 
 
+def run_train_input_bench():
+    """SKYTPU_BENCH_METRIC=train_input (CPU-runnable, no jax): does
+    input preprocessing scale independently of the trainer?
+
+    A synthetic pipeline with a configurable per-batch preprocess
+    delay (SKYTPU_BENCH_INPUT_DELAY_MS, the CPU-cost proxy) feeds a
+    simulated train step (SKYTPU_BENCH_INPUT_STEP_MS sleep) two ways:
+
+      * in-process — the trainer pays the preprocess cost inline on
+        every step (the pre-data-service shape);
+      * data service — a local dispatcher + SKYTPU_BENCH_INPUT_WORKERS
+        CPU workers compute the SAME batches (same DatasetSpec, so the
+        stream is bit-identical) while the client's bounded prefetch
+        overlaps them with the step.
+
+    Reports step-time p50/p95 and the batch-wait share
+    (skytpu_train_batch_wait_seconds's numerator) for both modes;
+    `value` is the in-process/service step-time p50 ratio — >1 means
+    the service hid that much preprocess latency. The "input scales
+    independently" claim is measured here, not asserted
+    (docs/DATA_SERVICE.md)."""
+    import shutil
+    import tempfile
+
+    from skypilot_tpu.data_service import client as ds_client
+    from skypilot_tpu.data_service import dispatcher as ds_dispatcher
+    from skypilot_tpu.data_service import spec as ds_spec
+    from skypilot_tpu.data_service import worker as ds_worker
+
+    steps = int(os.environ.get('SKYTPU_BENCH_INPUT_STEPS', '40'))
+    warmup = int(os.environ.get('SKYTPU_BENCH_INPUT_WARMUP', '5'))
+    delay_ms = float(os.environ.get('SKYTPU_BENCH_INPUT_DELAY_MS', '25'))
+    step_ms = float(os.environ.get('SKYTPU_BENCH_INPUT_STEP_MS', '30'))
+    n_workers = int(os.environ.get('SKYTPU_BENCH_INPUT_WORKERS', '2'))
+    spec = ds_spec.DatasetSpec(batch_size=8, seq_len=128,
+                               vocab_size=256, seed=0,
+                               preprocess_delay_s=delay_ms / 1000.0)
+
+    def consume(next_batch):
+        waits, totals = [], []
+        for step in range(warmup + steps):
+            t0 = time.perf_counter()
+            next_batch(step)
+            wait = time.perf_counter() - t0
+            time.sleep(step_ms / 1000.0)   # the simulated train step
+            if step >= warmup:
+                waits.append(wait)
+                totals.append(time.perf_counter() - t0)
+        return waits, totals
+
+    source = ds_spec.load_source(spec)
+    w_inproc, t_inproc = consume(lambda s: source.batch_at_step(s))
+
+    tmp = tempfile.mkdtemp(prefix='skytpu-bench-ds-')
+    disp = ds_dispatcher.Dispatcher(
+        os.path.join(tmp, 'dispatcher.db'), num_splits=4,
+        heartbeat_timeout=5.0).start()
+    workers = [ds_worker.DataWorker(disp.addr, heartbeat_interval=1.0
+                                    ).start() for _ in range(n_workers)]
+    cl = ds_client.DataServiceClient(
+        f'{disp.addr[0]}:{disp.addr[1]}', spec,
+        prefetch_depth=4, stall_budget_s=60.0).start()
+    try:
+        w_svc, t_svc = consume(lambda s: next(cl))
+    finally:
+        cl.close()
+        for w in workers:
+            w.stop()
+        disp.stop()
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    def pctl(xs, q):
+        s = sorted(xs)
+        return s[min(len(s) - 1, int(q * len(s)))]
+
+    def ms(x):
+        return round(x * 1e3, 2)
+
+    detail = {
+        'inproc_step_ms_p50': ms(pctl(t_inproc, 0.5)),
+        'inproc_step_ms_p95': ms(pctl(t_inproc, 0.95)),
+        'inproc_batch_wait_share': round(
+            sum(w_inproc) / max(sum(t_inproc), 1e-9), 3),
+        'service_step_ms_p50': ms(pctl(t_svc, 0.5)),
+        'service_step_ms_p95': ms(pctl(t_svc, 0.95)),
+        'service_batch_wait_share': round(
+            sum(w_svc) / max(sum(t_svc), 1e-9), 3),
+        'preprocess_delay_ms': delay_ms,
+        'train_step_ms': step_ms,
+        'workers': n_workers,
+        'steps': steps,
+    }
+    value = round(pctl(t_inproc, 0.5) / max(pctl(t_svc, 0.5), 1e-9), 2)
+    print(f'[bench] train_input: {detail}', file=sys.stderr)
+    print(json.dumps({
+        'metric': 'train_input',
+        'value': value,
+        'unit': 'x',
+        **detail,
+    }), flush=True)
+
+
 def run_kernelcheck():
     """SKYTPU_BENCH_METRIC=kernelcheck: assert the Pallas flash kernel
     matches the XLA reference fwd+bwd ON THE ATTACHED DEVICE, across a
@@ -962,6 +1064,8 @@ if __name__ == '__main__':
             run_serve_bench()
         elif metric == 'serve_mixed':
             run_serve_mixed_bench()
+        elif metric == 'train_input':
+            run_train_input_bench()
         elif metric == 'kernelcheck':
             run_kernelcheck()
         else:

@@ -34,7 +34,7 @@ PACKAGE = 'skypilot_tpu'
 # network calls, no total cap on streaming proxy paths — and
 # failpoint-naming — literal unit.site failpoint names under the
 # `if failpoints.ACTIVE:` zero-cost guard).
-REPORT_VERSION = 9
+REPORT_VERSION = 10
 
 
 @dataclasses.dataclass

@@ -67,6 +67,10 @@ LAYERS = {
     'volumes': 10,
     'cloud_stores': 11,
     'models': 11,
+    # data_service sits ABOVE data (it serves data/'s pipelines over
+    # the wire) and BELOW train (the trainer's --data-service client):
+    # strictly-downward imports both ways.
+    'data_service': 11,
     'train': 12,
     # 12 — on-cluster runtime (library the backend codegens against)
     'skylet': 12,

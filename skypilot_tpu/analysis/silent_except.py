@@ -35,6 +35,7 @@ NAME = 'silent-except'
 CONTROL_PLANE_UNITS = frozenset({
     'jobs', 'serve', 'server', 'skylet', 'backends', 'provision',
     'execution', 'core', 'client', 'clouds', 'global_state',
+    'data_service',
 })
 
 _BROAD = frozenset({'Exception', 'BaseException'})

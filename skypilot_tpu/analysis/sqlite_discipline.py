@@ -41,6 +41,7 @@ STATE_DB_PATHS = frozenset({
     'skylet/job_lib.py',
     'global_state.py',
     'observe/journal.py',
+    'data_service/dispatcher.py',
 })
 
 _VERB_RE = re.compile(

@@ -93,12 +93,33 @@ REPLICA_TRANSITIONS: Dict[str, Set[str]] = {
     'SHUTTING_DOWN': set(),
 }
 
+# ------------------------------------------------------- data service
+# DataWorkerStatus (data_service/dispatcher.py). No terminal state on
+# purpose: a LOST worker that heartbeats again re-registers and goes
+# back to ALIVE — its old splits were already reassigned (at-least-once
+# by construction: batches are pure functions of step, so double
+# ownership during the window is harmless).
+DATA_WORKER_TRANSITIONS: Dict[str, Set[str]] = {
+    'ALIVE': {'LOST'},
+    'LOST': {'ALIVE'},
+}
+
+# DataSplitStatus (data_service/dispatcher.py). A split bounces between
+# assigned and unassigned as workers churn; owner changes within
+# ASSIGNED are self-loops (legal by can_transition).
+DATA_SPLIT_TRANSITIONS: Dict[str, Set[str]] = {
+    'UNASSIGNED': {'ASSIGNED'},
+    'ASSIGNED': {'UNASSIGNED'},
+}
+
 # Enum class name -> its transition table (what the state-machine
 # checker verifies coverage against).
 ENUM_TABLES: Dict[str, Dict[str, Set[str]]] = {
     'ManagedJobStatus': JOB_TRANSITIONS,
     'ServiceStatus': SERVICE_TRANSITIONS,
     'ReplicaStatus': REPLICA_TRANSITIONS,
+    'DataWorkerStatus': DATA_WORKER_TRANSITIONS,
+    'DataSplitStatus': DATA_SPLIT_TRANSITIONS,
 }
 
 # Functions allowed to write a status column directly (raw UPDATE SQL
@@ -115,6 +136,8 @@ GUARDED_SETTERS: FrozenSet[str] = frozenset({
     'set_status',
     # server/requests_lib.py (RequestStatus setters)
     'set_running', 'set_result', 'set_failed', 'set_cancelled',
+    # data_service/dispatcher.py (worker registry + split assignment)
+    'set_worker_status', 'set_split_status',
 })
 
 

@@ -27,6 +27,13 @@ site in the control-plane/serve layers:
      cap both kills legitimate long streaming responses AND detects a
      dead replica far too slowly. Split timeouts (connect/sock_read,
      total=None) are the sanctioned shape (docs/ROBUSTNESS.md).
+  6. In the ``data_service`` unit (raw-socket framed TCP): every
+     socket this unit constructs — ``socket.socket(...)`` bindings AND
+     the connections an ``accept()`` hands out — must have a reachable
+     ``settimeout()`` call on that name somewhere in the module. A
+     trainer whose input socket has no deadline hangs the whole gang
+     on one dead worker; "every socket op carries a deadline" is the
+     unit's contract (docs/DATA_SERVICE.md).
 
 Scope: the units that make control-plane network calls. The compute
 plane (models/, train/, ops/) and analysis fixtures are exempt.
@@ -41,7 +48,11 @@ from skypilot_tpu.analysis import core
 NAME = 'timeout-discipline'
 
 UNITS = frozenset({'serve', 'server', 'client', 'jobs', 'provision',
-                   'clouds', 'backends', 'skylet'})
+                   'clouds', 'backends', 'skylet', 'data_service'})
+
+# Units where RAW sockets (socket.socket() / accept()) are an expected
+# idiom and therefore checked for a reachable settimeout (rule 6).
+_RAW_SOCKET_UNITS = frozenset({'data_service'})
 
 _REQUESTS_METHODS = frozenset({'get', 'post', 'put', 'delete', 'head',
                                'patch', 'request'})
@@ -92,10 +103,77 @@ def _bound_sessions(tree: ast.Module) -> 'tuple[Set[str], Set[str]]':
     return unsafe - safe, safe
 
 
+def _is_socket_ctor(call: ast.Call) -> bool:
+    """``socket.socket(...)`` or ``socket.create_connection(...)`` —
+    every constructor that hands back a raw socket object."""
+    dotted = core.dotted_name(call.func) or ''
+    parts = dotted.split('.')
+    return (parts[-1] in ('socket', 'create_connection') and
+            len(parts) >= 2 and parts[-2] == 'socket')
+
+
+def _raw_socket_bindings(tree: ast.Module) -> 'list[tuple[str, ast.AST]]':
+    """Names bound to raw-socket constructors — plain assigns, ``with
+    ... as s:`` items, and the connection half of an
+    ``x, y = s.accept()`` unpack — with the binding node."""
+    out: 'list[tuple[str, ast.AST]]' = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt, val = node.targets[0], node.value
+            if not isinstance(val, ast.Call):
+                continue
+            if _is_socket_ctor(val):
+                name = _target_name(tgt)
+                if name:
+                    out.append((name, node))
+            else:
+                dotted = core.dotted_name(val.func) or ''
+                if dotted.split('.')[-1] == 'accept' and \
+                        isinstance(tgt, (ast.Tuple, ast.List)) and \
+                        len(tgt.elts) == 2:
+                    name = _target_name(tgt.elts[0])
+                    if name:
+                        out.append((name, node))
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if isinstance(item.context_expr, ast.Call) and \
+                        _is_socket_ctor(item.context_expr) and \
+                        item.optional_vars is not None:
+                    name = _target_name(item.optional_vars)
+                    if name:
+                        out.append((name, node))
+    return out
+
+
+def _settimeout_names(tree: ast.Module) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr == 'settimeout':
+            name = _target_name(node.func.value)
+            if name:
+                out.add(name)
+    return out
+
+
 def run(mod: core.ModuleInfo) -> List[core.Violation]:
     if mod.unit not in UNITS:
         return []
     out: List[core.Violation] = []
+    # 6. raw sockets must get a deadline (data_service framed TCP).
+    if mod.unit in _RAW_SOCKET_UNITS:
+        timed = _settimeout_names(mod.tree)
+        for name, node in _raw_socket_bindings(mod.tree):
+            if name not in timed:
+                out.append(core.Violation(
+                    check=NAME, path=mod.path, line=node.lineno,
+                    col=node.col_offset, key='raw-socket-deadline',
+                    message=(
+                        f'socket {name!r} never gets settimeout() in '
+                        f'this module — every data-service socket op '
+                        f'must carry a deadline (a dead peer costs '
+                        f'bounded time, never a hung trainer)')))
     unsafe_sessions, _ = _bound_sessions(mod.tree)
     for node in ast.walk(mod.tree):
         if not isinstance(node, ast.Call):

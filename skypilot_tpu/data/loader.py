@@ -58,6 +58,23 @@ def load_tokens(path: str, tokenizer: Optional[str] = None,
         return tokenize_text(f.read(), tokenizer)
 
 
+def validate_vocab(tokens, vocab_size: int, context: str = 'Corpus') -> None:
+    """Refuse a tokenizer/model mismatch before any batch ships.
+
+    One definition for every consumer — the trainer's in-process
+    iterators AND the data-service workers (data_service/spec.py) —
+    so a service-fed run can never stream token ids the model's
+    embedding table cannot index. ``tokens`` is an ndarray or a
+    NativeTokenFile (both expose ``.max()``).
+    """
+    max_id = int(tokens.max())
+    if max_id >= vocab_size:
+        raise ValueError(
+            f'{context} has token id {max_id} but the model vocab is '
+            f'{vocab_size} — tokenizer/model mismatch. Pick a '
+            f'bigger-vocab preset or a matching tokenizer.')
+
+
 def batch_at_step(tokens, step: int, batch_size: int,
                   seq_len: int) -> np.ndarray:
     """The deterministic indexer: global batch for `step`, shape [B, S+1].

@@ -1,0 +1,31 @@
+"""Disaggregated input-data service: dispatcher + CPU workers + client.
+
+The tf.data-service architecture (PAPERS.md: "A Case for Disaggregating
+ML Input Data Processing") adapted to this framework's determinism
+contract: input preprocessing runs on a pool of CPU-only workers that
+scale independently of the TPU count, while the batch at step N stays a
+pure function of ``(seed, corpus, step)`` — identical for 1 vs 3
+workers, across worker deaths, and across the checkpoint-resume path
+(train/checkpoints.py). Workers are *stateless compute*: worker churn,
+like mesh churn, changes nothing about the token stream.
+
+Pieces (each its own module, docs/DATA_SERVICE.md for the wiring):
+
+  * :mod:`protocol`  — versioned length-prefixed framed TCP (stdlib
+    sockets, a deadline on every socket op) carrying npy-encoded
+    fixed-shape batches;
+  * :mod:`spec`      — the ``DatasetSpec`` both sides fingerprint and
+    the pure step→batch sources built from the existing ``data/``
+    tokenizer/sft/loader pipelines;
+  * :mod:`dispatcher`— worker registry with heartbeats and a
+    split-assignment state machine in WAL-sqlite, reassigning a dead
+    worker's splits at-least-once;
+  * :mod:`worker`    — stateless CPU worker serving batches under a
+    bounded prefetch queue (backpressure, never unbounded buffering);
+  * :mod:`client`    — trainer-side prefetching iterator with
+    backoff reconnects (``--data-service <addr>`` on the trainer).
+
+Run the services with ``python -m skypilot_tpu.data_service
+dispatcher|worker ...`` — data workers are just CPU Tasks to the
+control plane (examples/data-service-train.yaml).
+"""

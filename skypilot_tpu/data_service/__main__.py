@@ -1,0 +1,96 @@
+"""CLI: ``python -m skypilot_tpu.data_service dispatcher|worker``.
+
+Data workers are just CPU Tasks to the control plane — see
+examples/data-service-train.yaml for the gang wiring. Both
+subcommands print one JSON readiness line to stdout (address,
+identity) so a supervising task — or a chaos test — can harvest the
+endpoint, then serve until SIGTERM/SIGINT.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+from typing import List, Optional
+
+from skypilot_tpu.utils import failpoints
+
+
+def _serve_until_signal() -> None:
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    failpoints.load_env()
+    parser = argparse.ArgumentParser(
+        prog='python -m skypilot_tpu.data_service',
+        description='Disaggregated input-data service '
+                    '(docs/DATA_SERVICE.md).')
+    sub = parser.add_subparsers(dest='cmd', required=True)
+
+    disp = sub.add_parser('dispatcher', help='worker registry + '
+                                             'split assignment')
+    disp.add_argument('--host', default='0.0.0.0')
+    disp.add_argument('--port', type=int, default=8470)
+    disp.add_argument('--db', default='~/.skytpu/data_service/'
+                                      'dispatcher.db')
+    disp.add_argument('--num-splits', type=int, default=8)
+    disp.add_argument('--heartbeat-timeout', type=float,
+                      default=float(os.environ.get(
+                          'SKYTPU_DATA_HEARTBEAT_TIMEOUT', '10.0')))
+    disp.add_argument('--fresh', action='store_true',
+                      help='drop the previously served dataset spec '
+                           '(new job, same --db; restart workers too)')
+
+    work = sub.add_parser('worker', help='stateless CPU batch worker')
+    work.add_argument('--dispatcher', required=True,
+                      help='dispatcher host:port')
+    work.add_argument('--host', default='0.0.0.0')
+    work.add_argument('--port', type=int, default=0,
+                      help='0 = ephemeral')
+    work.add_argument('--advertise-host', default=None,
+                      help='address clients/dispatcher reach this '
+                           'worker at (default: the bound host)')
+    work.add_argument('--worker-id', default=None)
+    work.add_argument('--queue-depth', type=int, default=8)
+    work.add_argument('--heartbeat-interval', type=float, default=2.0)
+
+    args = parser.parse_args(argv)
+    if args.cmd == 'dispatcher':
+        from skypilot_tpu.data_service import dispatcher as disp_lib
+        db = os.path.expanduser(args.db)
+        os.makedirs(os.path.dirname(db) or '.', exist_ok=True)
+        svc = disp_lib.Dispatcher(
+            db, host=args.host, port=args.port,
+            num_splits=args.num_splits,
+            heartbeat_timeout=args.heartbeat_timeout,
+            reset_spec=args.fresh).start()
+        print(json.dumps({'role': 'dispatcher',
+                          'addr': f'{svc.addr[0]}:{svc.addr[1]}',
+                          'num_splits': svc.num_splits}), flush=True)
+        _serve_until_signal()
+        svc.stop()
+        return 0
+    from skypilot_tpu.data_service import protocol
+    from skypilot_tpu.data_service import worker as worker_lib
+    w = worker_lib.DataWorker(
+        protocol.parse_addr(args.dispatcher),
+        host=args.host, port=args.port,
+        advertise_host=args.advertise_host,
+        worker_id=args.worker_id, queue_depth=args.queue_depth,
+        heartbeat_interval=args.heartbeat_interval).start()
+    print(json.dumps({'role': 'worker', 'worker_id': w.worker_id,
+                      'addr': f'{w.addr[0]}:{w.addr[1]}'}), flush=True)
+    _serve_until_signal()
+    w.stop()
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,254 @@
+"""Trainer-side data-service client: a prefetching, reconnecting
+step-ordered batch iterator.
+
+The client is where the service's failure containment meets the
+trainer's determinism contract: batches are yielded strictly in step
+order, each fetched from whichever worker currently owns the step's
+split, and EVERY failure mode — dead worker, dispatcher blip, injected
+``data.fetch`` fault — is handled by refreshing the routing table and
+retrying under a seeded :class:`~skypilot_tpu.utils.backoff.Backoff`,
+never by skipping or reordering a step. A worker death therefore
+stalls the stream for at most the heartbeat-timeout + backoff budget
+and changes nothing about its contents.
+
+The prefetch thread keeps a BOUNDED queue of upcoming batches
+(``prefetch_depth``); ``next()`` pops from it, so fetch latency
+overlaps the train step instead of serializing with it. The stall
+budget (``stall_budget_s``) is the loud-failure bound: a stream that
+cannot make progress for that long raises ``DataServiceStallError``
+instead of hanging the job silently.
+"""
+from __future__ import annotations
+
+import itertools
+import os
+import queue
+import threading
+import time
+from typing import Any, Dict, Iterator, Optional, Tuple
+
+import numpy as np
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.data_service import protocol
+from skypilot_tpu.data_service import spec as spec_lib
+from skypilot_tpu.data_service import telemetry
+from skypilot_tpu.utils import backoff as backoff_lib
+from skypilot_tpu.utils import failpoints
+
+logger = sky_logging.init_logger(__name__)
+
+
+class DataServiceStallError(RuntimeError):
+    """The stream made no progress within the stall budget."""
+
+
+class DataServiceClient:
+    """Iterator of ``{name: ndarray}`` batches for steps
+    ``start_step, start_step+1, ...``."""
+
+    def __init__(self, addr: str, spec: spec_lib.DatasetSpec, *,
+                 start_step: int = 0,
+                 prefetch_depth: int = 4,
+                 fetch_timeout: Optional[float] = None,
+                 stall_budget_s: Optional[float] = None):
+        # Env-tunable (the trainer exposes no per-knob flags): a corpus
+        # whose worker-side load/tokenize takes minutes needs a bigger
+        # budget than the echo-fast default.
+        if fetch_timeout is None:
+            fetch_timeout = float(os.environ.get(
+                'SKYTPU_DATA_FETCH_TIMEOUT', '10.0'))
+        if stall_budget_s is None:
+            stall_budget_s = float(os.environ.get(
+                'SKYTPU_DATA_STALL_BUDGET', '120.0'))
+        self._dispatcher_addr = protocol.parse_addr(addr)
+        self.spec = spec
+        self._spec_fp = spec.fingerprint()
+        self._start_step = start_step
+        self._fetch_timeout = fetch_timeout
+        self._stall_budget_s = stall_budget_s
+        self._stop = threading.Event()
+        self._queue: 'queue.Queue[Tuple[int, Any]]' = queue.Queue(
+            maxsize=max(1, prefetch_depth))
+        self._routes: Dict[str, Any] = {}
+        self._failure: Optional[BaseException] = None
+        # Persistent connections, all owned by the prefetch thread
+        # (start() touches the dispatcher one before the thread runs):
+        # a batch fetch per train step must not pay a TCP handshake —
+        # FramedServer keeps connections open for exactly this.
+        self._dispatcher = protocol.FramedClient(self._dispatcher_addr)
+        self._worker_conns: Dict[str, protocol.FramedClient] = {}
+        self._thread = threading.Thread(
+            target=self._prefetch_loop, daemon=True,
+            name='data-service-prefetch')
+        self._started = False
+
+    # ------------------------------------------------------- lifecycle
+
+    def start(self) -> 'DataServiceClient':
+        """Register the spec with the dispatcher and start prefetching.
+        Retries until the dispatcher answers (it may still be booting
+        when the trainer comes up) within the stall budget."""
+        deadline = time.monotonic() + self._stall_budget_s
+        boff = backoff_lib.Backoff(base=0.2, cap=2.0,
+                                   seed=self.spec.seed)
+        last_err: Optional[Exception] = None
+        while time.monotonic() < deadline:
+            try:
+                self._dispatcher.request(
+                    {'op': 'put_spec', 'spec': self.spec.to_json()},
+                    timeout=self._fetch_timeout)
+                self._thread.start()
+                self._started = True
+                return self
+            except protocol.RemoteError as e:
+                if e.kind in ('spec', 'spec_mismatch'):
+                    raise   # config error: retrying cannot heal it
+                last_err = e
+                boff.sleep()
+            except (protocol.ProtocolError, OSError) as e:
+                last_err = e
+                boff.sleep()
+        raise DataServiceStallError(
+            f'dispatcher at {self._dispatcher_addr} unreachable for '
+            f'{self._stall_budget_s}s: {last_err}')
+
+    def close(self) -> None:
+        # The prefetcher's put() polls at 0.2s against _stop, so no
+        # queue drain is needed to unblock it.
+        self._stop.set()
+        if self._started:
+            self._thread.join(timeout=5.0)
+        self._dispatcher.close()
+        for conn in self._worker_conns.values():
+            conn.close()
+        self._worker_conns.clear()
+
+    def __enter__(self) -> 'DataServiceClient':
+        return self if self._started else self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -------------------------------------------------------- iterator
+
+    def __iter__(self) -> Iterator[Dict[str, np.ndarray]]:
+        return self
+
+    def __next__(self) -> Dict[str, np.ndarray]:
+        if not self._started:
+            self.start()
+        deadline = time.monotonic() + self._stall_budget_s
+        while True:
+            if self._failure is not None:
+                raise self._failure
+            try:
+                _, batch = self._queue.get(timeout=0.2)
+            except queue.Empty:
+                if self._stop.is_set():
+                    raise StopIteration
+                if time.monotonic() >= deadline:
+                    raise DataServiceStallError(
+                        f'no batch within the {self._stall_budget_s}s '
+                        f'stall budget') from None
+                continue
+            telemetry.BATCHES.inc(role='client')
+            telemetry.QUEUE_DEPTH.set(float(self._queue.qsize()),
+                                      role='client')
+            return batch
+
+    # -------------------------------------------------------- fetching
+
+    def _prefetch_loop(self) -> None:
+        try:
+            for step in itertools.count(self._start_step):
+                if self._stop.is_set():
+                    return
+                batch = self._fetch(step)
+                while not self._stop.is_set():
+                    try:
+                        self._queue.put((step, batch), timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
+        except BaseException as e:  # noqa: BLE001 — surfaced at next()
+            self._failure = e
+
+    def _refresh_routes(self) -> None:
+        reply, _ = self._dispatcher.request({'op': 'routes'},
+                                            timeout=self._fetch_timeout)
+        self._routes = reply
+        # Prune connections to addresses that left the routable set
+        # (keyed by ADDRESS: a rejoined worker id may move).
+        alive = set((reply.get('workers') or {}).values())
+        for addr_text in list(self._worker_conns):
+            if addr_text not in alive:
+                self._worker_conns.pop(addr_text).close()
+
+    def _fetch(self, step: int) -> Dict[str, np.ndarray]:
+        """Fetch ONE step's batch, retrying across worker/dispatcher
+        failures until the stall budget runs out. Seeded backoff: a
+        chaos schedule reproduces the same retry timeline."""
+        deadline = time.monotonic() + self._stall_budget_s
+        boff = backoff_lib.Backoff(base=0.1, cap=2.0,
+                                   seed=self.spec.seed ^ step)
+        last_err: Optional[Exception] = None
+        while time.monotonic() < deadline and not self._stop.is_set():
+            try:
+                if failpoints.ACTIVE:
+                    failpoints.fire('data.fetch')
+                t0 = time.perf_counter()
+                batch = self._fetch_once(step)
+                telemetry.FETCH_SECONDS.observe(time.perf_counter() - t0)
+                return batch
+            except protocol.RemoteError as e:
+                if e.kind in ('spec', 'spec_mismatch'):
+                    raise   # config refusal: fail the run loudly
+                last_err = e
+            except (protocol.ProtocolError, OSError, KeyError,
+                    failpoints.FailpointError) as e:
+                last_err = e
+            # The route we just used failed us: drop the cache so the
+            # retry re-asks the dispatcher (which reassigns a dead
+            # worker's splits after its heartbeat timeout).
+            self._routes = {}
+            boff.sleep()
+        raise DataServiceStallError(
+            f'step {step}: no worker served the batch within the '
+            f'{self._stall_budget_s}s stall budget (last error: '
+            f'{last_err})')
+
+    def _fetch_once(self, step: int) -> Dict[str, np.ndarray]:
+        num_splits = int(self._routes.get('num_splits') or 0)
+        if not num_splits or not self._routes.get('workers'):
+            self._refresh_routes()
+            num_splits = int(self._routes.get('num_splits') or 0)
+        split = step % num_splits if num_splits else 0
+        worker_id = self._routes.get('assignments', {}).get(str(split))
+        addr_text = self._routes.get('workers', {}).get(worker_id)
+        if addr_text is None:
+            self._refresh_routes()
+            worker_id = self._routes.get('assignments', {}).get(
+                str(split))
+            addr_text = self._routes.get('workers', {}).get(worker_id)
+            if addr_text is None:
+                raise protocol.ProtocolError(
+                    f'no ALIVE worker owns split {split} yet')
+        conn = self._worker_conns.get(addr_text)
+        if conn is None:
+            conn = protocol.FramedClient(protocol.parse_addr(addr_text))
+            self._worker_conns[addr_text] = conn
+        reply, arrays = conn.request(
+            {'op': 'get_batch', 'step': step, 'spec_fp': self._spec_fp},
+            timeout=self._fetch_timeout)
+        if int(reply.get('step', -1)) != step:
+            raise protocol.ProtocolError(
+                f'worker answered step {reply.get("step")} for step '
+                f'{step}')
+        if not arrays:
+            raise protocol.ProtocolError('batch reply carried no arrays')
+        # A failed fetch against THIS worker invalidates the cached
+        # route at the next retry via _refresh_routes; a succeeded one
+        # keeps it (the common path costs one dispatcher round-trip
+        # only at startup and after churn).
+        return arrays

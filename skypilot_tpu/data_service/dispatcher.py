@@ -1,0 +1,509 @@
+"""Data-service dispatcher: worker registry + split assignment.
+
+The dispatcher is the control plane of the input service — it never
+touches a batch. It tracks workers (heartbeats → ALIVE/LOST), owns the
+dataset spec of the job it serves, and maintains the split-assignment
+state machine: step space is partitioned round-robin into
+``num_splits`` splits (split ``s`` owns steps with
+``step % num_splits == s``) and every split is assigned to exactly one
+ALIVE worker. Because a batch is a pure function of ``(spec, step)``
+(data_service/spec.py), reassignment is *at-least-once by
+construction*: handing a dead worker's splits to a survivor — or to a
+worker that turns out to still be alive — can duplicate work but never
+change a byte of the stream.
+
+State lives in WAL sqlite (``utils/sqlite_utils``; sqlite-3.34-safe,
+no RETURNING). All status writes go through the guarded setters
+``set_worker_status`` / ``set_split_status`` (declared in
+``analysis/state_machines.py``, enforced by the skylint
+``state-machine`` checker) inside ``BEGIN IMMEDIATE`` transactions,
+journaling ``data_worker_join`` / ``data_worker_lost`` /
+``data_worker_reassign`` events exactly once per winning write.
+"""
+from __future__ import annotations
+
+import enum
+import json
+import os
+import sqlite3
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.analysis import state_machines
+from skypilot_tpu.data_service import protocol
+from skypilot_tpu.data_service import spec as spec_lib
+from skypilot_tpu.observe import journal
+from skypilot_tpu.observe import metrics as metrics_lib
+from skypilot_tpu.utils import failpoints
+from skypilot_tpu.utils import sqlite_utils
+
+logger = sky_logging.init_logger(__name__)
+
+DEFAULT_NUM_SPLITS = 8
+DEFAULT_HEARTBEAT_TIMEOUT = float(
+    os.environ.get('SKYTPU_DATA_HEARTBEAT_TIMEOUT', '10.0'))
+
+
+class DataWorkerStatus(enum.Enum):
+    """Registry state of one data worker (docs/DATA_SERVICE.md)."""
+    ALIVE = 'ALIVE'
+    LOST = 'LOST'
+
+
+class DataSplitStatus(enum.Enum):
+    """Assignment state of one step-space split."""
+    UNASSIGNED = 'UNASSIGNED'
+    ASSIGNED = 'ASSIGNED'
+
+
+_WORKERS_UP = metrics_lib.gauge(
+    'skytpu_data_workers_up',
+    'Data-service workers currently ALIVE in the dispatcher registry')
+_REQUESTS = metrics_lib.counter(
+    'skytpu_data_requests_total',
+    'Dispatcher protocol requests by operation',
+    labels={'op': ('register', 'heartbeat', 'routes', 'put_spec',
+                   'stats', 'other')})
+
+
+def _connect(path: str) -> sqlite3.Connection:
+    conn = sqlite_utils.connect_wal(path)
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS workers (
+            worker_id TEXT PRIMARY KEY,
+            addr TEXT,
+            status TEXT,
+            last_heartbeat REAL,
+            joined_ts REAL
+        )""")
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS splits (
+            split_id INTEGER PRIMARY KEY,
+            status TEXT,
+            worker_id TEXT,
+            assigned_ts REAL
+        )""")
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS meta (
+            key TEXT PRIMARY KEY,
+            value TEXT
+        )""")
+    conn.commit()
+    return conn
+
+
+# ----------------------------------------------------- guarded setters
+
+def set_worker_status(conn: sqlite3.Connection, worker_id: str,
+                      new: DataWorkerStatus, *,
+                      addr: Optional[str] = None,
+                      reason: Optional[str] = None,
+                      require_heartbeat_before: Optional[float] = None,
+                      ) -> Tuple[Optional[str], bool]:
+    """THE worker-status write path (state-machine checker contract).
+
+    Returns ``(old_status, changed)``. A missing row is created only
+    for ``new == ALIVE`` (registration is the machine's entry point).
+    ``require_heartbeat_before`` makes the reaper's LOST write
+    conditional: a heartbeat that lands between the reaper's scan and
+    this transaction keeps the worker ALIVE (no stale kill).
+    Journals ``data_worker_join`` / ``data_worker_lost`` exactly once
+    per winning edge, inside the transaction.
+    """
+    now = time.time()
+    with sqlite_utils.immediate(conn):
+        row = conn.execute(
+            'SELECT status, last_heartbeat FROM workers '
+            'WHERE worker_id = ?', (worker_id,)).fetchone()
+        if row is None:
+            if new is not DataWorkerStatus.ALIVE:
+                return None, False
+            conn.execute(
+                'INSERT INTO workers (worker_id, addr, status, '
+                'last_heartbeat, joined_ts) VALUES (?, ?, ?, ?, ?)',
+                (worker_id, addr, new.value, now, now))
+            journal.record_event('data_worker_join', worker_id,
+                                 reason=reason or 'register',
+                                 data={'addr': addr})
+            return None, True
+        old, last_hb = row
+        if require_heartbeat_before is not None and \
+                last_hb is not None and \
+                last_hb >= require_heartbeat_before:
+            return old, False
+        if not state_machines.can_transition(
+                state_machines.DATA_WORKER_TRANSITIONS, old, new.value):
+            return old, False
+        if old == new.value:
+            # Self-loop: refresh liveness facts, no journal.
+            conn.execute(
+                'UPDATE workers SET addr = COALESCE(?, addr), '
+                'last_heartbeat = ? WHERE worker_id = ?',
+                (addr, now, worker_id))
+            return old, False
+        conn.execute(
+            'UPDATE workers SET status = ?, addr = COALESCE(?, addr), '
+            'last_heartbeat = ? WHERE worker_id = ?',
+            (new.value, addr, now, worker_id))
+        if new is DataWorkerStatus.ALIVE:
+            journal.record_event('data_worker_join', worker_id,
+                                 reason=reason or 'rejoin',
+                                 data={'old': old, 'addr': addr})
+        else:
+            journal.record_event('data_worker_lost', worker_id,
+                                 reason=reason,
+                                 data={'old': old, 'addr': addr})
+        return old, True
+
+
+def set_split_status(conn: sqlite3.Connection,
+                     assignment: Dict[int, Optional[str]],
+                     ) -> List[Tuple[int, Optional[str], Optional[str]]]:
+    """THE split-status write path: bulk (re)assignment in ONE
+    transaction. ``assignment`` maps split_id → worker_id (None =
+    UNASSIGNED). Owner changes within ASSIGNED are legal self-loops of
+    the status machine — the at-least-once reassignment contract rests
+    on batches being pure functions of step, not on exclusivity.
+    Returns the applied ``(split_id, old_worker, new_worker)`` edges.
+    """
+    applied: List[Tuple[int, Optional[str], Optional[str]]] = []
+    now = time.time()
+    with sqlite_utils.immediate(conn):
+        for split_id, worker_id in sorted(assignment.items()):
+            row = conn.execute(
+                'SELECT status, worker_id FROM splits WHERE split_id = ?',
+                (split_id,)).fetchone()
+            if row is None:
+                continue
+            old_status, old_worker = row
+            new_status = (DataSplitStatus.ASSIGNED if worker_id
+                          else DataSplitStatus.UNASSIGNED).value
+            if not state_machines.can_transition(
+                    state_machines.DATA_SPLIT_TRANSITIONS, old_status,
+                    new_status):
+                continue
+            if old_status == new_status and old_worker == worker_id:
+                continue
+            conn.execute(
+                'UPDATE splits SET status = ?, worker_id = ?, '
+                'assigned_ts = ? WHERE split_id = ?',
+                (new_status, worker_id, now, split_id))
+            applied.append((split_id, old_worker, worker_id))
+    return applied
+
+
+class Dispatcher:
+    """TCP front + sqlite state + heartbeat reaper."""
+
+    def __init__(self, db_path: str, *, host: str = '127.0.0.1',
+                 port: int = 0,
+                 num_splits: int = DEFAULT_NUM_SPLITS,
+                 heartbeat_timeout: float = DEFAULT_HEARTBEAT_TIMEOUT,
+                 reset_spec: bool = False):
+        self._db_path = db_path
+        self._heartbeat_timeout = heartbeat_timeout
+        self._local = threading.local()
+        self._stop = threading.Event()
+        # Serializes every read-plan-apply assignment sequence
+        # (register handlers + the reaper). The split writes alone are
+        # transactional, but a plan computed from a stale read and
+        # committed LAST could strand splits on a LOST worker or leave
+        # a new worker idle — and this process is the DB's only
+        # writer, so a process lock makes the whole sequence atomic.
+        self._assign_lock = threading.Lock()
+        conn = self._conn()
+        if reset_spec:
+            # New job, same DB path (`--fresh`): drop the served spec
+            # so the next put_spec wins. Split geometry stays — and
+            # workers cache their spec in memory, so restart them too
+            # (their fetches would refuse the new fingerprint loudly).
+            with sqlite_utils.immediate(conn):
+                conn.execute("DELETE FROM meta WHERE key IN "
+                             "('spec', 'spec_fp')")
+            logger.info('dispatcher spec reset (--fresh): the next '
+                        'put_spec defines the served pipeline.')
+        with sqlite_utils.immediate(conn):
+            row = conn.execute(
+                "SELECT value FROM meta WHERE key = 'num_splits'"
+            ).fetchone()
+            if row is None:
+                conn.execute(
+                    "INSERT INTO meta (key, value) VALUES "
+                    "('num_splits', ?)", (str(num_splits),))
+                conn.executemany(
+                    'INSERT INTO splits (split_id, status, worker_id, '
+                    'assigned_ts) VALUES (?, ?, NULL, NULL)',
+                    [(i, DataSplitStatus.UNASSIGNED.value)
+                     for i in range(num_splits)])
+                self.num_splits = num_splits
+            else:
+                # An existing DB owns the split geometry: step→split
+                # routing must not change across dispatcher restarts.
+                self.num_splits = int(row[0])
+                if self.num_splits != num_splits:
+                    logger.warning(
+                        f'dispatcher DB {db_path} was created with '
+                        f'num_splits={self.num_splits}; ignoring '
+                        f'requested {num_splits}.')
+        self._server = protocol.FramedServer(host, port, self._handle,
+                                             name='data-dispatcher')
+        self.addr = self._server.addr
+        self._reaper = threading.Thread(target=self._reap_loop,
+                                        name='data-dispatcher-reaper',
+                                        daemon=True)
+
+    # ------------------------------------------------------- lifecycle
+
+    def start(self) -> 'Dispatcher':
+        self._server.start()
+        self._reaper.start()
+        logger.info(f'data-service dispatcher on {self.addr[0]}:'
+                    f'{self.addr[1]} (db={self._db_path}, '
+                    f'num_splits={self.num_splits}, heartbeat_timeout='
+                    f'{self._heartbeat_timeout}s)')
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._server.stop()
+        self._reaper.join(timeout=5.0)
+
+    def _conn(self) -> sqlite3.Connection:
+        conn = getattr(self._local, 'conn', None)
+        if conn is None:
+            conn = _connect(self._db_path)
+            self._local.conn = conn
+        return conn
+
+    # -------------------------------------------------------- handlers
+
+    def _handle(self, obj: Dict[str, Any], arrays: protocol.Arrays
+                ) -> Tuple[Dict[str, Any], Optional[protocol.Arrays]]:
+        op = str(obj.get('op', ''))
+        _REQUESTS.inc(op=op if op in ('register', 'heartbeat', 'routes',
+                                      'put_spec', 'stats') else 'other')
+        if failpoints.ACTIVE:
+            failpoints.fire('data.dispatch')
+        if op == 'register':
+            return self._op_register(obj), None
+        if op == 'heartbeat':
+            return self._op_heartbeat(obj), None
+        if op == 'routes':
+            return self._routes(), None
+        if op == 'put_spec':
+            return self._op_put_spec(obj), None
+        if op == 'stats':
+            return self._op_stats(), None
+        raise protocol.RemoteError(f'unknown op {op!r}', kind='bad_op')
+
+    def _op_register(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        worker_id = str(obj['worker_id'])
+        addr = str(obj['addr'])
+        conn = self._conn()
+        with self._assign_lock:
+            old, changed = set_worker_status(
+                conn, worker_id, DataWorkerStatus.ALIVE, addr=addr)
+            self._rebalance(conn)
+        reply = self._routes()
+        reply.update(ok=True, rejoined=bool(old is not None and changed))
+        return reply
+
+    def _op_heartbeat(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        worker_id = str(obj['worker_id'])
+        conn = self._conn()
+        # `status IN (?)`: reads the column, never writes it — the
+        # state-machine lint's raw-SQL rule keys on `status =` anywhere
+        # in an UPDATE, and a WHERE-clause equality would false-positive.
+        cur = conn.execute(
+            'UPDATE workers SET last_heartbeat = ? '
+            'WHERE worker_id = ? AND status IN (?)',
+            (time.time(), worker_id, DataWorkerStatus.ALIVE.value))
+        conn.commit()
+        if cur.rowcount == 0:
+            # Unknown or LOST: tell the worker to re-register — its
+            # splits were reassigned, rejoining gets it new ones.
+            return {'ok': False, 'resync': True}
+        reply: Dict[str, Any] = {'ok': True, 'spec_fp': self._spec_fp()}
+        if not obj.get('have_spec'):
+            # Spec rides the next beat after put_spec, so workers load
+            # the corpus OFF the fetch path (a multi-minute tokenize
+            # must burn heartbeat time, not the client's fetch budget).
+            row = conn.execute(
+                "SELECT value FROM meta WHERE key = 'spec'").fetchone()
+            if row:
+                reply['spec'] = json.loads(row[0])
+                reply['num_splits'] = self.num_splits
+        return reply
+
+    def _op_put_spec(self, obj: Dict[str, Any]) -> Dict[str, Any]:
+        try:
+            spec = spec_lib.DatasetSpec.from_json(obj['spec'])
+        except (ValueError, TypeError) as e:
+            # Schema skew is a CONFIG refusal ('spec' kind — clients
+            # never retry it), not an 'internal' error they would
+            # retry for the whole stall budget.
+            raise protocol.RemoteError(f'cannot parse dataset spec: '
+                                       f'{e}', kind='spec') from e
+        fp = spec.fingerprint()
+        conn = self._conn()
+        with sqlite_utils.immediate(conn):
+            row = conn.execute(
+                "SELECT value FROM meta WHERE key = 'spec_fp'").fetchone()
+            if row is None:
+                conn.execute(
+                    "INSERT INTO meta (key, value) VALUES ('spec', ?), "
+                    "('spec_fp', ?)",
+                    (json.dumps(spec.to_json()), fp))
+            elif row[0] != fp:
+                raise protocol.RemoteError(
+                    f'dispatcher already serves spec {row[0]}, client '
+                    f'sent {fp} — one dispatcher serves one dataset '
+                    f'spec; start another, or restart this one with '
+                    f'--fresh (and fresh workers) for a new pipeline',
+                    kind='spec_mismatch')
+        return {'ok': True, 'spec_fp': fp,
+                'num_splits': self.num_splits}
+
+    def _op_stats(self) -> Dict[str, Any]:
+        conn = self._conn()
+        workers = conn.execute(
+            'SELECT status, COUNT(*) FROM workers GROUP BY status'
+        ).fetchall()
+        splits = conn.execute(
+            'SELECT status, COUNT(*) FROM splits GROUP BY status'
+        ).fetchall()
+        return {'ok': True, 'workers': dict(workers),
+                'splits': dict(splits), 'num_splits': self.num_splits,
+                'spec_fp': self._spec_fp()}
+
+    def _routes(self) -> Dict[str, Any]:
+        conn = self._conn()
+        workers = dict(conn.execute(
+            'SELECT worker_id, addr FROM workers WHERE status = ?',
+            (DataWorkerStatus.ALIVE.value,)).fetchall())
+        assignments = {
+            str(split_id): worker_id
+            for split_id, worker_id in conn.execute(
+                'SELECT split_id, worker_id FROM splits '
+                'WHERE status = ?',
+                (DataSplitStatus.ASSIGNED.value,)).fetchall()
+            if worker_id in workers
+        }
+        row = conn.execute(
+            "SELECT value FROM meta WHERE key = 'spec'").fetchone()
+        return {'workers': workers, 'assignments': assignments,
+                'num_splits': self.num_splits,
+                'spec': json.loads(row[0]) if row else None,
+                'spec_fp': self._spec_fp()}
+
+    def _spec_fp(self) -> Optional[str]:
+        row = self._conn().execute(
+            "SELECT value FROM meta WHERE key = 'spec_fp'").fetchone()
+        return row[0] if row else None
+
+    # ----------------------------------------------------- assignment
+
+    def _rebalance(self, conn: sqlite3.Connection) -> Dict[int, str]:
+        """Assign every orphaned/UNASSIGNED split to the least-loaded
+        ALIVE worker, then level the load (a freshly joined worker
+        must take splits from the incumbents — input capacity scales
+        only if assignments follow the pool). Deterministic (sorted
+        ids, stable moves) so concurrent rebalances converge to the
+        same layout; batches being pure functions of step makes every
+        interim double-ownership harmless."""
+        alive = [w for (w,) in conn.execute(
+            'SELECT worker_id FROM workers WHERE status = ? '
+            'ORDER BY worker_id',
+            (DataWorkerStatus.ALIVE.value,)).fetchall()]
+        if not alive:
+            return {}
+        owned: Dict[str, List[int]] = {w: [] for w in alive}
+        unassigned: List[int] = []
+        for split_id, status, worker_id in conn.execute(
+                'SELECT split_id, status, worker_id FROM splits '
+                'ORDER BY split_id').fetchall():
+            if status == DataSplitStatus.ASSIGNED.value and \
+                    worker_id in owned:
+                owned[worker_id].append(split_id)
+            else:
+                unassigned.append(split_id)
+        plan: Dict[int, str] = {}
+        for split_id in unassigned:
+            target = min(alive, key=lambda w: (len(owned[w]), w))
+            plan[split_id] = target
+            owned[target].append(split_id)
+        while True:
+            most = max(alive, key=lambda w: (len(owned[w]), w))
+            least = min(alive, key=lambda w: (len(owned[w]), w))
+            if len(owned[most]) - len(owned[least]) <= 1:
+                break
+            moved = owned[most].pop()   # highest id: stable choice
+            plan[moved] = least
+            owned[least].append(moved)
+        if not plan:
+            return {}
+        set_split_status(conn, plan)
+        return plan
+
+    def _reap_loop(self) -> None:
+        interval = max(0.05, self._heartbeat_timeout / 4.0)
+        while not self._stop.wait(interval):
+            try:
+                self._reap_once()
+            except Exception as e:  # noqa: BLE001 — reaper must survive
+                logger.warning(f'dispatcher reaper pass failed: {e}')
+
+    def _reap_once(self) -> None:
+        conn = self._conn()
+        # Orphan sweep: splits still assigned to a non-ALIVE worker.
+        # Normally the LOST write and the rebalance land in the same
+        # pass, but a dispatcher restart between the two (or right
+        # after a crash mid-register) would otherwise strand those
+        # splits forever — survivors only heartbeat, never re-register,
+        # so no other path re-runs the rebalance.
+        with self._assign_lock:
+            orphans = conn.execute(
+                'SELECT COUNT(*) FROM splits WHERE status = ? AND '
+                'worker_id NOT IN (SELECT worker_id FROM workers '
+                'WHERE status = ?)',
+                (DataSplitStatus.ASSIGNED.value,
+                 DataWorkerStatus.ALIVE.value)).fetchone()[0]
+            if orphans:
+                plan = self._rebalance(conn)
+                if plan:
+                    journal.record_event(
+                        'data_worker_reassign', 'dispatcher',
+                        reason='orphan_sweep',
+                        data={'to': {str(k): v
+                                     for k, v in plan.items()}})
+        cutoff = time.time() - self._heartbeat_timeout
+        stale = [w for (w,) in conn.execute(
+            'SELECT worker_id FROM workers WHERE status = ? AND '
+            'last_heartbeat < ?',
+            (DataWorkerStatus.ALIVE.value, cutoff)).fetchall()]
+        for worker_id in stale:
+            with self._assign_lock:
+                _, changed = set_worker_status(
+                    conn, worker_id, DataWorkerStatus.LOST,
+                    reason='heartbeat_timeout',
+                    require_heartbeat_before=cutoff)
+                if not changed:
+                    continue
+                orphaned = [s for (s,) in conn.execute(
+                    'SELECT split_id FROM splits WHERE worker_id = ?',
+                    (worker_id,)).fetchall()]
+                plan = self._rebalance(conn)
+            journal.record_event(
+                'data_worker_reassign', worker_id,
+                reason='heartbeat_timeout',
+                data={'splits': orphaned,
+                      'to': {str(k): v for k, v in plan.items()}})
+            logger.warning(
+                f'data worker {worker_id} lost (no heartbeat for '
+                f'{self._heartbeat_timeout}s); reassigned splits '
+                f'{orphaned} -> {plan}')
+        _WORKERS_UP.set(float(self._conn().execute(
+            'SELECT COUNT(*) FROM workers WHERE status = ?',
+            (DataWorkerStatus.ALIVE.value,)).fetchone()[0]))

@@ -1,0 +1,170 @@
+"""DatasetSpec + pure step-indexed batch sources.
+
+The determinism contract of the whole data service lives here: a
+:class:`DatasetSpec` fully describes an input pipeline, and
+:func:`load_source` builds a *pure* ``step -> batch`` function from it
+using the existing ``data/`` loader/sft/tokenizer pipelines. Every
+consumer — the trainer's in-process iterator, every data-service
+worker, the bench harness — runs the SAME source code over the same
+spec, which is what makes the batch at step N a pure function of
+``(seed, corpus, step)``: identical for 1 vs 3 workers, across worker
+deaths, and across checkpoint-resume.
+
+Specs are fingerprinted (sha256 of the canonical JSON); the client
+sends its fingerprint with every fetch and a worker refuses a
+mismatch loudly — two processes silently disagreeing about the
+pipeline is exactly the garbage-batch failure the service must not
+ship to the TPU.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import time
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class DatasetSpec:
+    """Everything a stateless worker needs to recreate the pipeline.
+
+    Paths must resolve on every worker (shared storage / baked image —
+    the same contract checkpoints place on ``--ckpt-dir``). ``seed``
+    feeds the synthetic stream (and any future shuffling); the
+    on-disk corpus paths feed the deterministic indexers in
+    ``data/loader.py`` / ``data/sft.py``.
+    """
+    batch_size: int
+    seq_len: int
+    vocab_size: int
+    seed: int = 0
+    data_path: Optional[str] = None
+    # HF tokenizer name (plain corpus) or tokenizer.json path (SFT) —
+    # the same double duty TrainerConfig.tokenizer serves.
+    tokenizer: Optional[str] = None
+    sft_data_path: Optional[str] = None
+    chat_family: Optional[str] = None
+    # Bench knob (SKYTPU_BENCH_METRIC=train_input): an artificial
+    # per-batch preprocess cost, so "input scales independently" is
+    # measurable on CPU without a heavyweight real pipeline. Affects
+    # timing only, never batch content.
+    preprocess_delay_s: float = 0.0
+
+    def __post_init__(self):
+        if self.batch_size < 1 or self.seq_len < 1:
+            raise ValueError(f'batch_size={self.batch_size} and '
+                             f'seq_len={self.seq_len} must be >= 1')
+        if self.vocab_size < 1:
+            raise ValueError(f'vocab_size={self.vocab_size} must be >= 1')
+        if self.data_path and self.sft_data_path:
+            raise ValueError('data_path and sft_data_path are exclusive')
+
+    def to_json(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+    @classmethod
+    def from_json(cls, obj: Dict[str, Any]) -> 'DatasetSpec':
+        known = {f.name for f in dataclasses.fields(cls)}
+        unknown = set(obj) - known
+        if unknown:
+            raise ValueError(f'unknown DatasetSpec fields {sorted(unknown)}'
+                             f' — client and worker disagree about the '
+                             f'spec schema; upgrade the older side')
+        return cls(**obj)
+
+    def fingerprint(self) -> str:
+        text = json.dumps(self.to_json(), sort_keys=True,
+                          separators=(',', ':'))
+        return hashlib.sha256(text.encode('utf-8')).hexdigest()[:16]
+
+
+class Source:
+    """A loaded pipeline: ``batch_at_step`` is pure in ``step``."""
+
+    def __init__(self, spec: DatasetSpec):
+        self.spec = spec
+
+    def _compute(self, step: int) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def batch_at_step(self, step: int) -> Dict[str, np.ndarray]:
+        if self.spec.preprocess_delay_s > 0:
+            time.sleep(self.spec.preprocess_delay_s)
+        return self._compute(step)
+
+
+class _PlainSource(Source):
+    """Contiguous-window LM batches over a token corpus."""
+
+    def __init__(self, spec: DatasetSpec, tokens):
+        super().__init__(spec)
+        self._tokens = tokens
+
+    def _compute(self, step: int) -> Dict[str, np.ndarray]:
+        from skypilot_tpu.data import loader
+        return {'tokens': loader.batch_at_step(
+            self._tokens, step, self.spec.batch_size, self.spec.seq_len)}
+
+
+class _SftSource(Source):
+    """Conversation batches with assistant-only loss masks."""
+
+    def __init__(self, spec: DatasetSpec, tokens: np.ndarray,
+                 masks: np.ndarray):
+        super().__init__(spec)
+        self._tokens = tokens
+        self._masks = masks
+
+    def _compute(self, step: int) -> Dict[str, np.ndarray]:
+        from skypilot_tpu.data import sft
+        return sft.batch_at_step(self._tokens, self._masks, step,
+                                 self.spec.batch_size)
+
+
+def synthetic_tokens(spec: DatasetSpec) -> np.ndarray:
+    """The seeded synthetic corpus (no data path): the stream every
+    smoke-test trainer run consumes, reproducible from the spec alone."""
+    rng = np.random.default_rng(spec.seed)
+    base = rng.integers(
+        0, spec.vocab_size,
+        size=(max(4 * spec.batch_size * spec.seq_len, spec.seq_len + 2),),
+        dtype=np.int64)
+    return base.astype(np.int32)
+
+
+def load_source(spec: DatasetSpec) -> Source:
+    """Materialize the pipeline a spec describes.
+
+    Raises ``ValueError`` on a tokenizer/model vocab mismatch
+    (``data/loader.validate_vocab``) — a worker built from a bad spec
+    must refuse at load, not ship garbage batches to the TPU.
+    """
+    from skypilot_tpu.data import loader
+    if spec.sft_data_path:
+        from skypilot_tpu.data import sft
+        from skypilot_tpu.data import tokenizer as tokenizer_lib
+        if spec.tokenizer:
+            tokenizer = tokenizer_lib.load_tokenizer(spec.tokenizer)
+        else:
+            tokenizer = tokenizer_lib.ByteTokenizer()
+        family = spec.chat_family or tokenizer.chat_family
+        tokens, masks = sft.load_sft_dataset(spec.sft_data_path, tokenizer,
+                                             family, spec.seq_len)
+        loader.validate_vocab(tokens, spec.vocab_size,
+                              context='SFT corpus')
+        logger.info(f'SFT: {tokens.shape[0]} conversations '
+                    f'({family} template), '
+                    f'{float(masks.sum()):.0f} trainable tokens.')
+        return _SftSource(spec, tokens, masks)
+    if spec.data_path is not None:
+        tokens = loader.load_tokens(spec.data_path, spec.tokenizer)
+        loader.validate_vocab(tokens, spec.vocab_size, context='Corpus')
+        return _PlainSource(spec, tokens)
+    return _PlainSource(spec, synthetic_tokens(spec))

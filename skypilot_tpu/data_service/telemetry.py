@@ -1,0 +1,23 @@
+"""Shared metric declarations for the data service.
+
+One definition per metric (the promtext precedent): worker and client
+both move ``batches_total``/``queue_depth`` under different ``role``
+labels, and the registry refuses conflicting redeclarations at import
+time — two copy-pasted literals drifting apart would break whichever
+module imports second. Catalog: docs/OBSERVABILITY.md.
+"""
+from __future__ import annotations
+
+from skypilot_tpu.observe import metrics as metrics_lib
+
+BATCHES = metrics_lib.counter(
+    'skytpu_data_batches_total',
+    'Batches served (worker) / consumed (client) by the data service',
+    labels={'role': ('worker', 'client')})
+QUEUE_DEPTH = metrics_lib.gauge(
+    'skytpu_data_queue_depth',
+    'Bounded prefetch-buffer occupancy (worker cache / client queue)',
+    labels={'role': ('worker', 'client')})
+FETCH_SECONDS = metrics_lib.histogram(
+    'skytpu_data_fetch_seconds',
+    'Client-observed latency of one batch fetch, retries included')

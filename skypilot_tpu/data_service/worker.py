@@ -1,0 +1,365 @@
+"""Stateless CPU data worker: spec in, batches out.
+
+A worker holds NO state the stream depends on: its only inputs are the
+:class:`~skypilot_tpu.data_service.spec.DatasetSpec` it pulls from the
+dispatcher and the step numbers clients ask for, and
+``spec.load_source`` makes the batch for step N a pure function of
+both. Killing a worker mid-run therefore changes nothing about the
+token stream — the dispatcher reassigns its splits and the survivors
+compute the identical batches (the chaos suite's load-bearing
+invariant, tests/chaos/test_data_service.py).
+
+Buffering is BOUNDED everywhere: one prefetch thread computes at most
+``queue_depth`` batches ahead into a step-keyed cache, and a full
+precompute queue drops work instead of growing — backpressure, never
+an unbounded buffer (the tf.data-service lesson: input workers that
+buffer unboundedly just move the OOM from the trainer to the pool).
+
+A worker built from a mismatched spec (token ids outside the model
+vocab — ``data/loader.validate_vocab``) refuses EVERY fetch with a
+``spec``-kinded error instead of shipping garbage batches to the TPU.
+"""
+from __future__ import annotations
+
+import collections
+import hashlib
+import queue
+import socket
+import threading
+import time
+import uuid
+from typing import Any, Dict, Optional, Tuple
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.data_service import protocol
+from skypilot_tpu.data_service import spec as spec_lib
+from skypilot_tpu.data_service import telemetry
+from skypilot_tpu.utils import backoff as backoff_lib
+from skypilot_tpu.utils import failpoints
+
+logger = sky_logging.init_logger(__name__)
+
+
+def stable_seed(text: str) -> int:
+    """Deterministic seed from an id string. ``hash(str)`` is salted
+    per process (PYTHONHASHSEED), which would break the seeded-Backoff
+    contract of bit-reproducible retry timelines."""
+    return int.from_bytes(
+        hashlib.sha256(text.encode('utf-8')).digest()[:4], 'big')
+
+
+def _routable_host(bound_host: str,
+                   dispatcher_addr: Tuple[str, int]) -> str:
+    """A peer-reachable address for a wildcard bind: registering
+    '0.0.0.0' with the dispatcher would route every client to ITSELF
+    (connection refused on any multi-node deployment).
+
+    The UDP-connect trick asks the kernel which interface egresses
+    toward the dispatcher — unlike ``gethostbyname(gethostname())``,
+    which on stock Debian-family hosts resolves to the /etc/hosts
+    loopback entry (127.0.1.1) and would advertise an unroutable
+    address. A loopback answer is CORRECT when the dispatcher itself
+    is loopback (single-box tests)."""
+    if bound_host not in ('0.0.0.0', '::', ''):
+        return bound_host
+    probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    probe.settimeout(1.0)
+    try:
+        probe.connect(dispatcher_addr)   # routes only; no packet sent
+        return probe.getsockname()[0]
+    except OSError:
+        try:
+            return socket.gethostbyname(socket.gethostname())
+        except OSError:
+            return socket.gethostname()
+    finally:
+        probe.close()
+
+
+class DataWorker:
+    """One stateless worker process/thread: serve + heartbeat loops."""
+
+    def __init__(self, dispatcher_addr: Tuple[str, int], *,
+                 host: str = '127.0.0.1', port: int = 0,
+                 worker_id: Optional[str] = None,
+                 advertise_host: Optional[str] = None,
+                 queue_depth: int = 8,
+                 heartbeat_interval: float = 2.0,
+                 register_timeout: float = 60.0,
+                 rpc_timeout: float = 10.0):
+        self.worker_id = worker_id or f'dw-{uuid.uuid4().hex[:8]}'
+        self._dispatcher_addr = dispatcher_addr
+        self._queue_depth = max(1, queue_depth)
+        self._heartbeat_interval = heartbeat_interval
+        self._register_timeout = register_timeout
+        self._rpc_timeout = rpc_timeout
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._spec: Optional[spec_lib.DatasetSpec] = None
+        self._spec_fp: Optional[str] = None
+        self._source: Optional[spec_lib.Source] = None
+        self._spec_error: Optional[str] = None
+        self._loader_thread: Optional[threading.Thread] = None
+        self._num_splits: Optional[int] = None
+        # step -> batch, bounded to queue_depth entries (oldest out).
+        self._cache: 'collections.OrderedDict[int, Dict[str, Any]]' = (
+            collections.OrderedDict())
+        self._precompute: 'queue.Queue[int]' = queue.Queue(
+            maxsize=self._queue_depth)
+        self._server = protocol.FramedServer(
+            host, port, self._handle, name=f'data-worker-{self.worker_id}')
+        adv = advertise_host or _routable_host(self._server.addr[0],
+                                               dispatcher_addr)
+        self.addr = (adv, self._server.addr[1])
+        self._seed = stable_seed(self.worker_id)
+        # Owned by the heartbeat thread (and by start() before it runs):
+        # one persistent connection carries every heartbeat instead of a
+        # handshake + dispatcher thread + sqlite connection per beat.
+        self._dispatcher = protocol.FramedClient(dispatcher_addr)
+        self._heartbeat_thread = threading.Thread(
+            target=self._heartbeat_loop, daemon=True,
+            name=f'{self.worker_id}-heartbeat')
+        self._prefetch_thread = threading.Thread(
+            target=self._prefetch_loop, daemon=True,
+            name=f'{self.worker_id}-prefetch')
+
+    # ------------------------------------------------------- lifecycle
+
+    def start(self) -> 'DataWorker':
+        self._server.start()
+        self._register(deadline_s=self._register_timeout)
+        self._heartbeat_thread.start()
+        self._prefetch_thread.start()
+        logger.info(f'data worker {self.worker_id} serving on '
+                    f'{self.addr[0]}:{self.addr[1]}, dispatcher '
+                    f'{self._dispatcher_addr[0]}:'
+                    f'{self._dispatcher_addr[1]}')
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._server.stop()
+        self._heartbeat_thread.join(timeout=5.0)
+        self._prefetch_thread.join(timeout=5.0)
+        if self._loader_thread is not None:
+            self._loader_thread.join(timeout=5.0)
+        self._dispatcher.close()
+
+    # ---------------------------------------------------- registration
+
+    def _register(self, deadline_s: float) -> None:
+        deadline = time.monotonic() + deadline_s
+        boff = backoff_lib.Backoff(base=0.2, cap=2.0, seed=self._seed)
+        last_err: Optional[Exception] = None
+        while time.monotonic() < deadline and not self._stop.is_set():
+            try:
+                reply, _ = self._dispatcher.request(
+                    {'op': 'register', 'worker_id': self.worker_id,
+                     'addr': f'{self.addr[0]}:{self.addr[1]}'},
+                    timeout=self._rpc_timeout)
+                self._adopt_routes(reply)
+                return
+            except (protocol.ProtocolError, protocol.RemoteError,
+                    OSError) as e:
+                last_err = e
+                boff.sleep()
+        raise TimeoutError(
+            f'worker {self.worker_id} could not register with '
+            f'dispatcher at {self._dispatcher_addr} within '
+            f'{deadline_s}s: {last_err}')
+
+    def _adopt_routes(self, reply: Dict[str, Any]) -> None:
+        with self._lock:
+            self._adopt_routes_locked(reply)
+
+    def _set_spec(self, spec: spec_lib.DatasetSpec) -> None:
+        """Adopt a spec and start loading its source on a DEDICATED
+        thread. Caller holds ``_lock``. The load may take minutes
+        (tokenizing a real corpus) and must starve neither heartbeats
+        (a loading worker reaped as LOST would churn splits among
+        equally-loading peers) nor the serve loop — fetches during the
+        load get a retriable ``loading`` error instead."""
+        self._spec = spec
+        self._spec_fp = spec.fingerprint()
+        self._loader_thread = threading.Thread(
+            target=self._load_source, args=(spec,), daemon=True,
+            name=f'{self.worker_id}-load')
+        self._loader_thread.start()
+
+    def _load_source(self, spec: spec_lib.DatasetSpec) -> None:
+        try:
+            source = spec_lib.load_source(spec)
+            error = None
+        except (ValueError, OSError) as e:
+            # Config refusal (vocab mismatch, unreadable corpus):
+            # permanent for this spec — every fetch answers kind=spec.
+            source, error = None, str(e)
+            logger.error(f'worker {self.worker_id} refuses spec '
+                         f'{spec.fingerprint()}: {e}')
+        with self._lock:
+            self._source = source
+            self._spec_error = error
+
+    def _ensure_source(self) -> spec_lib.Source:
+        with self._lock:
+            if self._source is not None:
+                return self._source
+            if self._spec_error is not None:
+                raise protocol.RemoteError(self._spec_error, kind='spec')
+            have_spec = self._spec is not None
+        if not have_spec:
+            # No spec yet: pull it from the dispatcher (put there by
+            # the client before its first fetch).
+            reply, _ = protocol.request(self._dispatcher_addr,
+                                        {'op': 'routes'},
+                                        timeout=self._rpc_timeout)
+            with self._lock:
+                if self._spec is None:
+                    if reply.get('spec') is None:
+                        raise protocol.RemoteError(
+                            'dispatcher has no dataset spec yet',
+                            kind='no_spec')
+                    self._adopt_routes_locked(reply)
+        with self._lock:
+            if self._source is not None:
+                return self._source
+            if self._spec_error is not None:
+                raise protocol.RemoteError(self._spec_error, kind='spec')
+        # Loader thread still running: transient — the client retries
+        # under its stall budget while heartbeats keep this worker
+        # ALIVE through the load.
+        raise protocol.RemoteError('dataset source still loading',
+                                   kind='loading')
+
+    def _adopt_routes_locked(self, reply: Dict[str, Any]) -> None:
+        self._num_splits = int(reply.get('num_splits') or 0) or None
+        if self._spec is None and self._spec_error is None and \
+                reply.get('spec') is not None:
+            try:
+                spec = spec_lib.DatasetSpec.from_json(reply['spec'])
+            except (ValueError, TypeError) as e:
+                # Version skew: refuse LOUDLY and keep beating — a
+                # raise here would kill the heartbeat thread and brick
+                # the process silently; instead every fetch answers a
+                # permanent 'spec'-kinded error carrying the message.
+                self._spec_error = f'cannot parse dataset spec: {e}'
+                logger.error(f'worker {self.worker_id}: '
+                             f'{self._spec_error}')
+                return
+            self._set_spec(spec)
+
+    # -------------------------------------------------------- serving
+
+    def _handle(self, obj: Dict[str, Any], arrays: protocol.Arrays
+                ) -> Tuple[Dict[str, Any], Optional[protocol.Arrays]]:
+        op = str(obj.get('op', ''))
+        if op == 'get_batch':
+            return self._op_get_batch(obj)
+        if op == 'ping':
+            return {'ok': True, 'worker_id': self.worker_id}, None
+        raise protocol.RemoteError(f'unknown op {op!r}', kind='bad_op')
+
+    def _op_get_batch(self, obj: Dict[str, Any]
+                      ) -> Tuple[Dict[str, Any], protocol.Arrays]:
+        if failpoints.ACTIVE:
+            failpoints.fire('data.worker_batch')
+        step = int(obj['step'])
+        source = self._ensure_source()
+        want_fp = obj.get('spec_fp')
+        if want_fp is not None and want_fp != self._spec_fp:
+            raise protocol.RemoteError(
+                f'worker serves spec {self._spec_fp}, client asked for '
+                f'{want_fp} — pipelines diverged; restart the older '
+                f'side', kind='spec_mismatch')
+        with self._lock:
+            # get, not pop: in a multi-host gang EVERY host fetches
+            # step N — one computation must serve all of them.
+            batch = self._cache.get(step)
+        if batch is None:
+            batch = source.batch_at_step(step)
+            with self._lock:
+                # Cache the inline result too (same multi-host
+                # contract); the size bound evicts oldest.
+                self._cache[step] = batch
+                while len(self._cache) > self._queue_depth:
+                    self._cache.popitem(last=False)
+        self._schedule_prefetch(step)
+        telemetry.BATCHES.inc(role='worker')
+        with self._lock:
+            telemetry.QUEUE_DEPTH.set(float(len(self._cache)),
+                                      role='worker')
+        return {'ok': True, 'step': step, 'spec_fp': self._spec_fp}, batch
+
+    # ------------------------------------------------------- prefetch
+
+    def _schedule_prefetch(self, served_step: int) -> None:
+        """Precompute the steps this worker will most likely serve
+        next: the same split's following steps. Non-blocking put — a
+        full queue means we are already queue_depth ahead, so DROP
+        (bounded buffering is the contract, not throughput)."""
+        stride = self._num_splits or 1
+        for ahead in range(1, self._queue_depth + 1):
+            try:
+                self._precompute.put_nowait(served_step + ahead * stride)
+            except queue.Full:
+                return
+
+    def _prefetch_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                step = self._precompute.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            with self._lock:
+                source = self._source
+                have = step in self._cache
+            if source is None or have:
+                continue
+            try:
+                batch = source.batch_at_step(step)
+            except Exception as e:  # noqa: BLE001 — prefetch is advisory
+                logger.warning(f'worker {self.worker_id} prefetch of '
+                               f'step {step} failed: {e}')
+                continue
+            with self._lock:
+                self._cache[step] = batch
+                while len(self._cache) > self._queue_depth:
+                    self._cache.popitem(last=False)
+
+    # ------------------------------------------------------ heartbeats
+
+    def _heartbeat_loop(self) -> None:
+        boff = backoff_lib.Backoff(base=0.2, cap=5.0, seed=self._seed)
+        while not self._stop.wait(self._heartbeat_interval):
+            try:
+                if failpoints.ACTIVE:
+                    # Chaos hook: a firing skips beats, so the
+                    # dispatcher sees exactly the silence a hung or
+                    # partitioned worker would produce.
+                    failpoints.fire('data.heartbeat')
+                with self._lock:
+                    have_spec = self._spec is not None
+                reply, _ = self._dispatcher.request(
+                    {'op': 'heartbeat', 'worker_id': self.worker_id,
+                     'have_spec': have_spec},
+                    timeout=self._rpc_timeout)
+                if not have_spec and reply.get('spec') is not None:
+                    # Load the source NOW (heartbeat thread), so the
+                    # first get_batch finds it ready instead of paying
+                    # the corpus load inside the client's fetch budget.
+                    self._adopt_routes(reply)
+                if reply.get('resync'):
+                    # Dispatcher declared us LOST: rejoin for fresh
+                    # splits. At-least-once reassignment means the
+                    # interim double-ownership was harmless.
+                    self._register(deadline_s=self._register_timeout)
+                boff.reset()
+            except failpoints.FailpointError:
+                continue
+            except (protocol.ProtocolError, protocol.RemoteError,
+                    OSError, TimeoutError) as e:
+                logger.warning(f'worker {self.worker_id} heartbeat '
+                               f'failed: {e}')
+                # Jittered pause on top of the interval: a dispatcher
+                # restart must not see a thundering herd of beats.
+                boff.sleep()

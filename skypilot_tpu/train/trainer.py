@@ -21,11 +21,29 @@ import time
 from typing import Any, Dict, Iterator, List, Optional
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.observe import metrics as metrics_lib
+from skypilot_tpu.observe import spans
 from skypilot_tpu.utils import failpoints
 
 # Fixed name, not __name__: under `python -m` this module is '__main__',
 # which would fall outside the 'skypilot_tpu' logging root (no handler).
 logger = sky_logging.init_logger('skypilot_tpu.train.trainer')
+
+# Input-starvation accounting: time the step loop blocks in next() on
+# the batch iterator — for BOTH the in-process and the data-service
+# paths. On healthy overlap (prefetch ahead of compute) this sits near
+# zero; a growing batch-wait share is the "scale the input pool"
+# signal (docs/OBSERVABILITY.md, bench.py train_input).
+_BATCH_WAIT = metrics_lib.histogram(
+    'skytpu_train_batch_wait_seconds',
+    'Time the train step loop blocked waiting for the next input batch')
+# The paired `train.batch_wait` span records retroactively and ONLY
+# for waits past this threshold (the engine's hot-path idiom: derive
+# timings, persist the interesting ones) — a span row per step on a
+# 100k-step run would just churn the journal GC with near-zero
+# durations the histogram already counts.
+_BATCH_WAIT_SPAN_MIN_S = float(
+    os.environ.get('SKYTPU_TRAIN_BATCH_WAIT_SPAN_MIN', '0.05'))
 
 
 @dataclasses.dataclass
@@ -70,6 +88,12 @@ class TrainerConfig:
     # from the tokenizer's specials (llama3/chatml/plain).
     sft_data_path: Optional[str] = None
     chat_family: Optional[str] = None
+    # host:port of a data-service dispatcher (data_service/): input
+    # preprocessing runs on its CPU worker pool instead of in-process.
+    # The stream is BIT-IDENTICAL either way — both sides run
+    # data_service/spec.load_source over the same DatasetSpec — so
+    # flipping this flag (or losing a worker) never changes training.
+    data_service: Optional[str] = None
 
 
 class _PreemptionWatch(contextlib.AbstractContextManager):
@@ -140,68 +164,50 @@ def _model_config(tcfg: TrainerConfig):
     return cfg
 
 
-def _sft_batch_iter(tcfg: TrainerConfig, vocab_size: int,
-                    start_step: int, mesh) -> Iterator[Dict[str, Any]]:
-    """Conversation batches with assistant-only loss masks."""
-    import os as os_lib
+def _dataset_spec(tcfg: TrainerConfig, vocab_size: int):
+    """TrainerConfig → the DatasetSpec BOTH input paths run on.
 
-    from skypilot_tpu.data import loader, sft
-    from skypilot_tpu.data import tokenizer as tokenizer_lib
-    tok_path = tcfg.tokenizer
-    if tok_path is None and tcfg.hf_dir:
+    One spec drives the in-process source and every data-service
+    worker; tokenizer resolution (the hf_dir tokenizer.json rule) and
+    vocab validation (data/loader.validate_vocab) happen inside
+    spec.load_source, so neither path can drift from the other.
+    """
+    from skypilot_tpu.data_service import spec as spec_lib
+    tokenizer = tcfg.tokenizer
+    if tcfg.sft_data_path and tokenizer is None and tcfg.hf_dir:
         # No silent byte fallback for an HF finetune: a missing
         # tokenizer.json must error (load_tokenizer's hint), not train
         # the model on byte-tokenized garbage.
-        tok_path = os_lib.path.join(
-            os_lib.path.expanduser(tcfg.hf_dir), 'tokenizer.json')
-    if tok_path:
-        tokenizer = tokenizer_lib.load_tokenizer(tok_path)
-    else:
-        tokenizer = tokenizer_lib.ByteTokenizer()
-    family = tcfg.chat_family or tokenizer.chat_family
-    tokens, masks = sft.load_sft_dataset(tcfg.sft_data_path, tokenizer,
-                                         family, tcfg.seq_len)
-    if tokens.max() >= vocab_size:
-        raise ValueError(
-            f'SFT corpus has token id {int(tokens.max())} but the model '
-            f'vocab is {vocab_size} — tokenizer/model mismatch.')
-    logger.info(f'SFT: {tokens.shape[0]} conversations '
-                f'({family} template), '
-                f'{float(masks.sum()):.0f} trainable tokens.')
-    step = start_step
-    while True:
-        yield loader.shard_batch(
-            sft.batch_at_step(tokens, masks, step, tcfg.batch_size),
-            mesh)
-        step += 1
+        tokenizer = os.path.join(
+            os.path.expanduser(tcfg.hf_dir), 'tokenizer.json')
+    return spec_lib.DatasetSpec(
+        batch_size=tcfg.batch_size, seq_len=tcfg.seq_len,
+        vocab_size=vocab_size, data_path=tcfg.data_path,
+        tokenizer=tokenizer, sft_data_path=tcfg.sft_data_path,
+        chat_family=tcfg.chat_family)
 
 
 def _batch_iter(tcfg: TrainerConfig, vocab_size: int, start_step: int,
                 mesh) -> Iterator[Dict[str, Any]]:
-    if tcfg.sft_data_path:
-        yield from _sft_batch_iter(tcfg, vocab_size, start_step, mesh)
-        return
     from skypilot_tpu.data import loader
-    if tcfg.data_path is None:
-        # Synthetic stream, still step-indexed for resume determinism.
-        import numpy as np
-        rng = np.random.default_rng(0)
-        base = rng.integers(0, vocab_size,
-                            size=(max(4 * tcfg.batch_size * tcfg.seq_len,
-                                      tcfg.seq_len + 2),), dtype=np.int64)
-        tokens = base.astype(np.int32)
-    else:
-        tokens = loader.load_tokens(tcfg.data_path, tcfg.tokenizer)
-        if tokens.max() >= vocab_size:
-            raise ValueError(
-                f'Corpus has token id {int(tokens.max())} but the model '
-                f'vocab is {vocab_size}. Pick a bigger-vocab preset or a '
-                f'matching tokenizer.')
+    from skypilot_tpu.data_service import spec as spec_lib
+    dspec = _dataset_spec(tcfg, vocab_size)
+    if tcfg.data_service:
+        from skypilot_tpu.data_service import client as ds_client
+        cl = ds_client.DataServiceClient(tcfg.data_service, dspec,
+                                         start_step=start_step)
+        logger.info(f'Input via data service at {tcfg.data_service} '
+                    f'(spec {dspec.fingerprint()}).')
+        try:
+            for batch in cl:
+                yield loader.shard_batch(batch, mesh)
+        finally:
+            cl.close()
+        return
+    source = spec_lib.load_source(dspec)
     step = start_step
     while True:
-        batch = loader.batch_at_step(tokens, step, tcfg.batch_size,
-                                     tcfg.seq_len)
-        yield loader.shard_batch({'tokens': batch}, mesh)
+        yield loader.shard_batch(source.batch_at_step(step), mesh)
         step += 1
 
 
@@ -414,7 +420,18 @@ def train(tcfg: TrainerConfig) -> List[Dict[str, float]]:
     try:
         with _PreemptionWatch() as watch:
             for step in range(start_step, tcfg.total_steps):
-                state, metrics = step_fn(state, next(batches))
+                wait_wall = time.time()
+                t_wait = time.perf_counter()
+                batch = next(batches)
+                waited = time.perf_counter() - t_wait
+                _BATCH_WAIT.observe(waited)
+                if waited >= _BATCH_WAIT_SPAN_MIN_S:
+                    spans.record('train.batch_wait',
+                                 start_wall=wait_wall,
+                                 duration=waited,
+                                 parent_id=spans.current(),
+                                 attrs={'step': step})
+                state, metrics = step_fn(state, batch)
                 steps_since_log += 1
                 # Eval cadence is INDEPENDENT of log cadence: an
                 # eval-only step emits its own record.
@@ -525,6 +542,11 @@ def main() -> None:
                         choices=('llama3', 'chatml', 'plain'),
                         help='Chat template (default: from the '
                              "tokenizer's special tokens).")
+    parser.add_argument('--data-service', default=None,
+                        help='host:port of a data-service dispatcher '
+                             '(docs/DATA_SERVICE.md): preprocess on '
+                             'its CPU worker pool; the stream is '
+                             'bit-identical to in-process input.')
     args = parser.parse_args()
 
     def _parse_kv(items):
@@ -561,7 +583,8 @@ def main() -> None:
                        if t.strip()]
                       if args.lora_targets else None),
         hf_dir=args.hf_dir, lora_dir=args.lora_dir,
-        sft_data_path=args.sft_data, chat_family=args.chat_family)
+        sft_data_path=args.sft_data, chat_family=args.chat_family,
+        data_service=args.data_service)
     train(tcfg)
 
 

@@ -1,0 +1,439 @@
+"""Data-service unit tests: protocol framing, dispatcher state
+machine, and the client's determinism contract.
+
+The chaos half (real worker subprocesses SIGKILLed mid-train, loss
+trajectories) lives in tests/chaos/test_data_service.py; here the
+dispatcher/workers run in-process so the wire protocol, the
+split-assignment machine and the 1-vs-3-worker bit-equality pin run in
+seconds.
+"""
+import os
+import socket
+import struct
+import time
+
+import numpy as np
+import pytest
+
+from skypilot_tpu.data_service import client as client_lib
+from skypilot_tpu.data_service import dispatcher as dispatcher_lib
+from skypilot_tpu.data_service import protocol
+from skypilot_tpu.data_service import spec as spec_lib
+from skypilot_tpu.data_service import worker as worker_lib
+from skypilot_tpu.observe import journal
+from skypilot_tpu.utils import failpoints
+
+
+@pytest.fixture(autouse=True)
+def _isolated_observe_db(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKYTPU_OBSERVE_DB',
+                       str(tmp_path / 'observe.db'))
+    failpoints.reset()
+    yield
+    failpoints.reset()
+
+
+def _mk_spec(**overrides):
+    base = dict(batch_size=4, seq_len=16, vocab_size=64, seed=7)
+    base.update(overrides)
+    return spec_lib.DatasetSpec(**base)
+
+
+# ---------------------------------------------------------- protocol
+
+class TestProtocol:
+
+    def test_roundtrip_obj_and_arrays(self):
+        a, b = socket.socketpair()
+        try:
+            arrays = {
+                'tokens': np.arange(12, dtype=np.int32).reshape(3, 4),
+                'loss_mask': np.ones((3, 3), np.float32),
+            }
+            protocol.send_msg(a, {'op': 'x', 'step': 3}, arrays,
+                              timeout=5.0)
+            obj, got = protocol.recv_msg(b, timeout=5.0)
+            assert obj == {'op': 'x', 'step': 3}
+            assert set(got) == set(arrays)
+            for k in arrays:
+                assert got[k].dtype == arrays[k].dtype
+                np.testing.assert_array_equal(got[k], arrays[k])
+        finally:
+            a.close()
+            b.close()
+
+    def test_truncated_frame_refused(self):
+        a, b = socket.socketpair()
+        try:
+            payload = protocol._encode_payload({'op': 'x'}, None)
+            frame = protocol._HEADER.pack(protocol.MAGIC,
+                                          protocol.VERSION, 0,
+                                          len(payload)) + payload
+            a.sendall(frame[:len(frame) - 3])
+            a.close()
+            with pytest.raises(protocol.ProtocolError,
+                               match='truncated'):
+                protocol.recv_msg(b, timeout=5.0)
+        finally:
+            b.close()
+
+    def test_version_mismatch_refused(self):
+        a, b = socket.socketpair()
+        try:
+            payload = protocol._encode_payload({'op': 'x'}, None)
+            a.sendall(protocol._HEADER.pack(protocol.MAGIC,
+                                            protocol.VERSION + 1, 0,
+                                            len(payload)) + payload)
+            with pytest.raises(protocol.VersionMismatchError):
+                protocol.recv_msg(b, timeout=5.0)
+        finally:
+            a.close()
+            b.close()
+
+    def test_bad_magic_and_oversize_refused(self):
+        a, b = socket.socketpair()
+        try:
+            a.sendall(struct.pack('!4sHHI', b'NOPE', protocol.VERSION,
+                                  0, 4) + b'xxxx')
+            with pytest.raises(protocol.ProtocolError, match='magic'):
+                protocol.recv_msg(b, timeout=5.0)
+        finally:
+            a.close()
+            b.close()
+        a, b = socket.socketpair()
+        try:
+            a.sendall(protocol._HEADER.pack(protocol.MAGIC,
+                                            protocol.VERSION, 0,
+                                            1 << 24))
+            with pytest.raises(protocol.ProtocolError, match='cap'):
+                protocol.recv_msg(b, timeout=5.0, max_frame=1 << 20)
+        finally:
+            a.close()
+            b.close()
+
+    def test_recv_deadline_bounds_a_silent_peer(self):
+        a, b = socket.socketpair()
+        try:
+            t0 = time.monotonic()
+            with pytest.raises(protocol.ProtocolTimeout):
+                protocol.recv_msg(b, timeout=0.3)
+            assert time.monotonic() - t0 < 5.0
+        finally:
+            a.close()
+            b.close()
+
+    def test_error_reply_raises_with_kind(self):
+        with pytest.raises(protocol.RemoteError) as ei:
+            protocol.raise_if_error({'error': 'nope', 'kind': 'spec'})
+        assert ei.value.kind == 'spec'
+
+
+# -------------------------------------------------------------- spec
+
+class TestDatasetSpec:
+
+    def test_json_roundtrip_and_fingerprint_stability(self):
+        spec = _mk_spec(data_path='/tmp/x.npy', tokenizer=None)
+        again = spec_lib.DatasetSpec.from_json(spec.to_json())
+        assert again == spec
+        assert again.fingerprint() == spec.fingerprint()
+        assert _mk_spec(seed=8).fingerprint() != spec.fingerprint()
+
+    def test_unknown_field_refused(self):
+        obj = _mk_spec().to_json()
+        obj['shiny_new_knob'] = 1
+        with pytest.raises(ValueError, match='shiny_new_knob'):
+            spec_lib.DatasetSpec.from_json(obj)
+
+    def test_exclusive_paths_refused(self):
+        with pytest.raises(ValueError, match='exclusive'):
+            _mk_spec(data_path='a', sft_data_path='b')
+
+    def test_synthetic_source_is_pure_in_step(self):
+        s1 = spec_lib.load_source(_mk_spec())
+        s2 = spec_lib.load_source(_mk_spec())
+        for step in (0, 3, 1000):
+            np.testing.assert_array_equal(
+                s1.batch_at_step(step)['tokens'],
+                s2.batch_at_step(step)['tokens'])
+
+    def test_corpus_vocab_mismatch_refused(self, tmp_path):
+        path = tmp_path / 'big.npy'
+        np.save(path, np.arange(4000, dtype=np.int32))
+        with pytest.raises(ValueError, match='mismatch'):
+            spec_lib.load_source(_mk_spec(data_path=str(path),
+                                          vocab_size=64))
+
+    def test_sft_source_masks_and_determinism(self, tmp_path):
+        import json as json_lib
+        path = tmp_path / 'chat.jsonl'
+        with open(path, 'w', encoding='utf-8') as f:
+            for i in range(6):
+                f.write(json_lib.dumps({'messages': [
+                    {'role': 'user', 'content': f'q {i}'},
+                    {'role': 'assistant', 'content': 'a'},
+                ]}) + '\n')
+        spec = _mk_spec(sft_data_path=str(path), vocab_size=300,
+                        seq_len=32)
+        src = spec_lib.load_source(spec)
+        b1, b2 = src.batch_at_step(2), src.batch_at_step(2)
+        assert set(b1) == {'tokens', 'loss_mask'}
+        np.testing.assert_array_equal(b1['tokens'], b2['tokens'])
+        np.testing.assert_array_equal(b1['loss_mask'], b2['loss_mask'])
+
+
+# -------------------------------------------------- dispatcher state
+
+@pytest.fixture
+def dispatcher(tmp_path):
+    d = dispatcher_lib.Dispatcher(
+        str(tmp_path / 'disp.db'), num_splits=4,
+        heartbeat_timeout=1.0).start()
+    yield d
+    d.stop()
+
+
+def _worker(dispatcher, **kw):
+    kw.setdefault('heartbeat_interval', 0.2)
+    return worker_lib.DataWorker(dispatcher.addr, **kw).start()
+
+
+def _routes(dispatcher):
+    reply, _ = protocol.request(dispatcher.addr, {'op': 'routes'},
+                                timeout=5.0)
+    return reply
+
+
+def _wait_for(pred, timeout=15.0, what='condition'):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.1)
+    raise AssertionError(f'{what} not reached within {timeout}s')
+
+
+class TestDispatcher:
+
+    def test_register_balances_splits(self, dispatcher):
+        w1, w2 = _worker(dispatcher), _worker(dispatcher)
+        try:
+            routes = _routes(dispatcher)
+            assert len(routes['workers']) == 2
+            assert len(routes['assignments']) == 4
+            counts = {}
+            for wid in routes['assignments'].values():
+                counts[wid] = counts.get(wid, 0) + 1
+            assert sorted(counts.values()) == [2, 2]
+        finally:
+            w1.stop()
+            w2.stop()
+
+    def test_missed_heartbeats_reassign_and_journal(self, dispatcher):
+        w1, w2 = _worker(dispatcher), _worker(dispatcher)
+        try:
+            dead_id = w1.worker_id
+            w1.stop()   # heartbeats cease: the reaper must notice
+            _wait_for(
+                lambda: set(_routes(dispatcher)['workers']) ==
+                {w2.worker_id},
+                what='dead worker evicted from routes')
+            routes = _routes(dispatcher)
+            assert len(routes['assignments']) == 4
+            assert set(routes['assignments'].values()) == {w2.worker_id}
+            kinds = {}
+            for ev in journal.query(limit=100):
+                if ev['entity'] == dead_id:
+                    kinds.setdefault(ev['kind'], []).append(ev)
+            assert 'data_worker_lost' in kinds
+            assert 'data_worker_reassign' in kinds
+            reassign = kinds['data_worker_reassign'][0]
+            assert reassign['data']['splits'], (
+                'reassign event must name the orphaned splits')
+        finally:
+            w2.stop()
+
+    def test_lost_worker_heartbeat_gets_resync(self, dispatcher):
+        reply, _ = protocol.request(
+            dispatcher.addr,
+            {'op': 'heartbeat', 'worker_id': 'never-registered'},
+            timeout=5.0)
+        assert reply.get('resync') is True
+
+    def test_put_spec_mismatch_refused(self, dispatcher):
+        protocol.request(dispatcher.addr,
+                         {'op': 'put_spec',
+                          'spec': _mk_spec().to_json()}, timeout=5.0)
+        with pytest.raises(protocol.RemoteError) as ei:
+            protocol.request(dispatcher.addr,
+                             {'op': 'put_spec',
+                              'spec': _mk_spec(seed=99).to_json()},
+                             timeout=5.0)
+        assert ei.value.kind == 'spec_mismatch'
+
+    def test_orphan_splits_swept_by_reaper(self, dispatcher, tmp_path):
+        """A split stranded on a non-ALIVE owner (dispatcher crash
+        between the LOST write and its rebalance) must be reassigned
+        by the reaper's orphan sweep — survivors only heartbeat, so no
+        register would ever re-run the rebalance."""
+        w = _worker(dispatcher)
+        try:
+            conn = dispatcher_lib._connect(str(tmp_path / 'disp.db'))
+            dispatcher_lib.set_split_status(conn, {0: 'ghost-worker'})
+            _wait_for(
+                lambda: _routes(dispatcher)['assignments'].get('0') ==
+                w.worker_id,
+                what='orphaned split swept back to the live pool')
+        finally:
+            w.stop()
+
+    def test_fresh_restart_resets_spec_not_geometry(self, tmp_path):
+        db = str(tmp_path / 'fresh.db')
+        d1 = dispatcher_lib.Dispatcher(db, num_splits=4,
+                                       heartbeat_timeout=2.0).start()
+        protocol.request(d1.addr, {'op': 'put_spec',
+                                   'spec': _mk_spec().to_json()},
+                         timeout=5.0)
+        d1.stop()
+        # Same DB, new job: --fresh drops the spec, keeps the splits.
+        d2 = dispatcher_lib.Dispatcher(db, num_splits=8,
+                                       heartbeat_timeout=2.0,
+                                       reset_spec=True).start()
+        try:
+            assert d2.num_splits == 4   # geometry is sticky
+            reply, _ = protocol.request(
+                d2.addr, {'op': 'put_spec',
+                          'spec': _mk_spec(seed=99).to_json()},
+                timeout=5.0)
+            assert reply['ok'] is True
+        finally:
+            d2.stop()
+
+    def test_split_state_machine_refuses_bad_edges(self, tmp_path):
+        conn = dispatcher_lib._connect(str(tmp_path / 'sm.db'))
+        conn.execute("INSERT INTO splits VALUES (0, 'ASSIGNED', 'w1', 0)")
+        conn.commit()
+        # ASSIGNED -> ASSIGNED (owner move) is a legal self-loop;
+        # both directions of the two-state machine are declared.
+        applied = dispatcher_lib.set_split_status(conn, {0: 'w2'})
+        assert applied == [(0, 'w1', 'w2')]
+        applied = dispatcher_lib.set_split_status(conn, {0: None})
+        assert applied == [(0, 'w2', None)]
+        # Unknown split ids are skipped, not invented.
+        assert dispatcher_lib.set_split_status(conn, {99: 'w1'}) == []
+
+    def test_worker_status_machine(self, tmp_path):
+        conn = dispatcher_lib._connect(str(tmp_path / 'wm.db'))
+        st = dispatcher_lib.DataWorkerStatus
+        old, changed = dispatcher_lib.set_worker_status(
+            conn, 'w1', st.ALIVE, addr='a:1')
+        assert (old, changed) == (None, True)
+        # A LOST write for a row that just heartbeated is refused.
+        old, changed = dispatcher_lib.set_worker_status(
+            conn, 'w1', st.LOST, require_heartbeat_before=0.0)
+        assert changed is False
+        old, changed = dispatcher_lib.set_worker_status(
+            conn, 'w1', st.LOST)
+        assert (old, changed) == ('ALIVE', True)
+        # LOST -> ALIVE: the rejoin edge.
+        old, changed = dispatcher_lib.set_worker_status(
+            conn, 'w1', st.ALIVE, addr='a:2')
+        assert (old, changed) == ('LOST', True)
+        # Unknown worker can only enter via ALIVE.
+        old, changed = dispatcher_lib.set_worker_status(
+            conn, 'nope', st.LOST)
+        assert (old, changed) == (None, False)
+
+
+# ------------------------------------------------ client determinism
+
+class TestClientDeterminism:
+
+    def _stream(self, tmp_path, tag, n_workers, spec, steps,
+                start_step=0, arm_fetch_faults=False):
+        d = dispatcher_lib.Dispatcher(
+            str(tmp_path / f'd-{tag}.db'), num_splits=4,
+            heartbeat_timeout=2.0).start()
+        workers = [_worker(d) for _ in range(n_workers)]
+        if arm_fetch_faults:
+            failpoints.arm('data.fetch', every=3)
+        cl = client_lib.DataServiceClient(
+            f'{d.addr[0]}:{d.addr[1]}', spec, start_step=start_step,
+            stall_budget_s=30.0)
+        try:
+            cl.start()
+            return [next(cl) for _ in range(steps)]
+        finally:
+            failpoints.reset()
+            cl.close()
+            for w in workers:
+                w.stop()
+            d.stop()
+
+    def test_1_vs_3_workers_bit_equal(self, tmp_path):
+        spec = _mk_spec()
+        ref_source = spec_lib.load_source(spec)
+        one = self._stream(tmp_path, 'one', 1, spec, steps=10)
+        three = self._stream(tmp_path, 'three', 3, spec, steps=10)
+        for step, (a, b) in enumerate(zip(one, three)):
+            ref = ref_source.batch_at_step(step)
+            np.testing.assert_array_equal(a['tokens'], b['tokens'])
+            np.testing.assert_array_equal(a['tokens'], ref['tokens'])
+
+    def test_injected_fetch_faults_never_skip_steps(self, tmp_path):
+        spec = _mk_spec(seed=11)
+        ref_source = spec_lib.load_source(spec)
+        got = self._stream(tmp_path, 'faulty', 2, spec, steps=9,
+                           arm_fetch_faults=True)
+        for step, batch in enumerate(got):
+            np.testing.assert_array_equal(
+                batch['tokens'], ref_source.batch_at_step(step)['tokens'])
+
+    def test_start_step_resumes_mid_stream(self, tmp_path):
+        spec = _mk_spec(seed=13)
+        ref_source = spec_lib.load_source(spec)
+        got = self._stream(tmp_path, 'resume', 1, spec, steps=4,
+                           start_step=5)
+        for i, batch in enumerate(got):
+            np.testing.assert_array_equal(
+                batch['tokens'],
+                ref_source.batch_at_step(5 + i)['tokens'])
+
+    def test_worker_refuses_vocab_mismatch(self, tmp_path):
+        path = tmp_path / 'corpus.npy'
+        np.save(path, np.arange(500, dtype=np.int32))
+        spec = _mk_spec(data_path=str(path), vocab_size=64)
+        d = dispatcher_lib.Dispatcher(
+            str(tmp_path / 'd-vocab.db'), num_splits=2,
+            heartbeat_timeout=2.0).start()
+        w = _worker(d)
+        cl = client_lib.DataServiceClient(
+            f'{d.addr[0]}:{d.addr[1]}', spec, stall_budget_s=20.0)
+        try:
+            cl.start()
+            with pytest.raises(protocol.RemoteError) as ei:
+                next(cl)
+            assert ei.value.kind == 'spec'
+            assert 'mismatch' in str(ei.value)
+        finally:
+            cl.close()
+            w.stop()
+            d.stop()
+
+    def test_stall_budget_bounds_no_worker_pool(self, tmp_path):
+        d = dispatcher_lib.Dispatcher(
+            str(tmp_path / 'd-empty.db'), num_splits=2,
+            heartbeat_timeout=2.0).start()
+        cl = client_lib.DataServiceClient(
+            f'{d.addr[0]}:{d.addr[1]}', _mk_spec(),
+            stall_budget_s=2.0)
+        try:
+            cl.start()
+            t0 = time.monotonic()
+            with pytest.raises(
+                    (client_lib.DataServiceStallError,)):
+                next(cl)
+            assert time.monotonic() - t0 < 20.0
+        finally:
+            cl.close()
+            d.stop()

@@ -197,6 +197,11 @@ class TestDiscovery:
         # The fleet-telemetry site (observe/scrape.py):
         # tests/chaos/test_scrape.py drives its timeout/error modes.
         assert 'observe.scrape' in names
+        # The input-data-service sites (data_service/):
+        # tests/chaos/test_data_service.py drives worker-kill
+        # containment and stream determinism through these.
+        assert {'data.dispatch', 'data.worker_batch', 'data.fetch',
+                'data.heartbeat'} <= names
         # Naming contract holds for every discovered site.
         for name in names:
             assert failpoints.NAME_RE.match(name), name

@@ -1026,6 +1026,61 @@ class TestTimeoutDisciplineChecker:
         assert _run(tmp_path,
                     checks=['timeout-discipline'])['total'] == 0
 
+    def test_raw_socket_without_deadline_flagged(self, tmp_path):
+        # data_service framed TCP: sockets this unit constructs —
+        # accept()ed connections, create_connection results and
+        # with-bound sockets included — must get settimeout.
+        _write(tmp_path, 'data_service/bad_proto.py', '''\
+            import socket
+
+            def serve(host, port):
+                listener = socket.socket(socket.AF_INET,
+                                         socket.SOCK_STREAM)
+                listener.bind((host, port))
+                listener.listen(8)
+                conn, addr = listener.accept()
+                return conn.recv(4)
+
+            def dial(addr):
+                sock = socket.create_connection(addr, timeout=5)
+                return sock.recv(4)   # connect bounded, ops unbounded
+
+            def dial_scoped(addr):
+                with socket.socket() as s:
+                    s.connect(addr)
+                    return s.recv(4)
+        ''')
+        report = _run(tmp_path, checks=['timeout-discipline'])
+        assert sorted(_idents(report)) == [
+            'timeout-discipline:data_service/bad_proto.py:'
+            'raw-socket-deadline'] * 4
+
+    def test_raw_socket_with_deadline_and_other_units_ok(self, tmp_path):
+        _write(tmp_path, 'data_service/good_proto.py', '''\
+            import socket
+
+            def serve(host, port):
+                listener = socket.socket(socket.AF_INET,
+                                         socket.SOCK_STREAM)
+                listener.bind((host, port))
+                listener.listen(8)
+                listener.settimeout(0.2)
+                conn, addr = listener.accept()
+                conn.settimeout(30.0)
+                return conn.recv(4)
+        ''')
+        # Raw sockets elsewhere are out of the rule's scope (multihost
+        # has its own armed-timeout discipline).
+        _write(tmp_path, 'serve/raw_elsewhere.py', '''\
+            import socket
+
+            def open_raw():
+                s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                return s
+        ''')
+        assert _run(tmp_path,
+                    checks=['timeout-discipline'])['total'] == 0
+
     def test_compute_plane_and_requests_lib_exempt(self, tmp_path):
         # models/ is out of scope; `requests_lib` is the server's
         # request-record DB module, not the HTTP library.
@@ -1446,7 +1501,7 @@ class TestLivePackage:
         with open(out_path, encoding='utf-8') as f:
             report = json.load(f)
         # Schema stability (version-bump ratchet).
-        assert report['skylint_version'] == core.REPORT_VERSION == 9
+        assert report['skylint_version'] == core.REPORT_VERSION == 10
         assert set(report) == {
             'skylint_version', 'root', 'files_scanned', 'checks',
             'violations', 'total', 'allowlisted', 'new',
