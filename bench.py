@@ -939,6 +939,93 @@ def run_train_input_bench():
     }), flush=True)
 
 
+LOADGEN_LAST_GOOD_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    'LOADGEN_LAST_GOOD.json')
+
+
+def run_loadgen_bench():
+    """SKYTPU_BENCH_METRIC=loadgen (CPU-runnable): the traffic harness
+    as a regression tripwire. Runs the fixed-seed smoke profile against
+    a self-spawned 2-replica stack (skypilot_tpu/loadgen — real
+    engines, real LB, real scrape/SLO plane) and diffs the resulting
+    scorecard against the checked-in LOADGEN_LAST_GOOD.json:
+
+      * the schedule hash must REPLAY byte-identically (same seed +
+        profile => same offered traffic, the loadgen contract);
+      * per-class goodput and fleet-attributed p95s must not collapse
+        (diff_scorecards' tolerance bands — CPU boxes are noisy, an
+        order of magnitude is not noise).
+
+    `value` is the run's overall goodput fraction (fleet-measured
+    good / finished across classes)."""
+    import shutil
+    import tempfile
+
+    from skypilot_tpu.loadgen import report as report_lib
+
+    device = _get_device()
+    seed = int(os.environ.get('SKYTPU_BENCH_LOADGEN_SEED', '7'))
+    profile = os.environ.get('SKYTPU_BENCH_LOADGEN_PROFILE', 'smoke')
+    replicas = int(os.environ.get('SKYTPU_BENCH_LOADGEN_REPLICAS', '2'))
+    run_dir = tempfile.mkdtemp(prefix='skytpu-bench-loadgen-')
+    report_path = os.path.join(run_dir, 'scorecard.json')
+    try:
+        proc = subprocess.run(
+            [sys.executable, '-m', 'skypilot_tpu.loadgen',
+             '--seed', str(seed), '--profile', profile,
+             '--local-stack', str(replicas), '--run-dir', run_dir,
+             '--report', report_path],
+            stdout=sys.stderr, stderr=sys.stderr,
+            env={**os.environ,
+                 'SKYTPU_OBSERVE_DB': os.path.join(run_dir,
+                                                   'observe.db')})
+        if proc.returncode != 0:
+            raise SystemExit(f'[bench] loadgen run failed '
+                             f'rc={proc.returncode}')
+        with open(report_path) as f:
+            card = json.load(f)
+    finally:
+        shutil.rmtree(run_dir, ignore_errors=True)
+
+    by_class = (card.get('fleet') or {}).get('by_class') or {}
+    good = sum(row.get('good', 0.0) for row in by_class.values())
+    slow = sum(row.get('slow', 0.0) for row in by_class.values())
+    finished = good + slow
+    value = round(good / finished, 4) if finished else None
+
+    diff = None
+    try:
+        with open(LOADGEN_LAST_GOOD_PATH) as f:
+            last_good = json.load(f)
+        diff = report_lib.diff_scorecards(card, last_good)
+    except (OSError, ValueError):
+        print('[bench] no LOADGEN_LAST_GOOD.json to diff against',
+              file=sys.stderr)
+    doc = {
+        'metric': 'loadgen_goodput',
+        'value': value,
+        'unit': 'fraction (fleet-measured good/finished)',
+        'profile': profile,
+        'seed': seed,
+        'replicas': replicas,
+        'schedule_hash': card.get('schedule_hash'),
+        'completed': (card.get('client') or {}).get('completed'),
+        'errors': (card.get('client') or {}).get('errors'),
+        'by_class': {cls: {k: row.get(k) for k in
+                           ('goodput', 'ttft_p95_ms', 'tpot_p95_ms')}
+                     for cls, row in sorted(by_class.items())},
+        'routing': card.get('routing'),
+        'device': device.device_kind,
+    }
+    if diff is not None:
+        doc['vs_last_good'] = diff
+        if not diff['ok']:
+            print(f'[bench] loadgen REGRESSION vs last good: '
+                  f'{diff["regressions"]}', file=sys.stderr)
+    print(json.dumps(doc), flush=True)
+
+
 def run_kernelcheck():
     """SKYTPU_BENCH_METRIC=kernelcheck: assert the Pallas flash kernel
     matches the XLA reference fwd+bwd ON THE ATTACHED DEVICE, across a
@@ -1066,6 +1153,8 @@ if __name__ == '__main__':
             run_serve_mixed_bench()
         elif metric == 'train_input':
             run_train_input_bench()
+        elif metric == 'loadgen':
+            run_loadgen_bench()
         elif metric == 'kernelcheck':
             run_kernelcheck()
         else:

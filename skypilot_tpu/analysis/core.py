@@ -33,8 +33,11 @@ PACKAGE = 'skypilot_tpu'
 # v7: timeout-discipline — explicit timeouts on control-plane/serve
 # network calls, no total cap on streaming proxy paths — and
 # failpoint-naming — literal unit.site failpoint names under the
-# `if failpoints.ACTIVE:` zero-cost guard).
-REPORT_VERSION = 10
+# `if failpoints.ACTIVE:` zero-cost guard; v11: metric-discipline
+# closed-class-registry rule — a raw X-Skytpu-Class header value must
+# map through observe/request_class.normalize()/from_headers() before
+# reaching any metric label kwarg).
+REPORT_VERSION = 11
 
 
 @dataclasses.dataclass

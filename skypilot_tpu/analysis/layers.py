@@ -83,6 +83,11 @@ LAYERS = {
     # module-level imports BETWEEN them are cross-plane violations.
     'jobs': 17,
     'serve': 17,
+    # 18 — the replayable traffic harness: drives the serve plane
+    # (spawns engine replicas, wires an in-process LB + scraper + SLO
+    # engine) and reads the observe plane, so it sits above both —
+    # peer of the API server, below the client.
+    'loadgen': 18,
     # 18-19 — API server → client
     'server': 18,
     'client': 19,

@@ -20,7 +20,17 @@ months later:
      series cardinality grow with traffic. The runtime registry refuses
      undeclared values too — this catches the shape before it ships.
 
-  4. one exposition parser — string literals that smell of AD-HOC
+  4. closed class registry — the request-class label
+     (``X-Skytpu-Class``) is client-supplied, so a RAW header read
+     must be mapped through ``observe/request_class.py``
+     (``normalize()`` / ``from_headers()``) before it can reach any
+     metric label value. An expression that carries the header
+     constant — or a variable assigned from one — appearing as a
+     label kwarg without routing through the registry is flagged:
+     that is exactly how an unbounded client string becomes an
+     unbounded label set.
+
+  5. one exposition parser — string literals that smell of AD-HOC
      Prometheus-text regexing (``_bucket{`` / ``{le="`` fragments used
      to prefix-match or regex metric lines) are flagged OUTSIDE
      ``observe/``: every metric-text read goes through
@@ -29,10 +39,10 @@ months later:
      A private line parser quietly assumes label order and bucket
      layout — the drift that motivated the promtext factoring.
 
-Scope: rules 1–3 apply to modules that import
+Scope: rules 1–4 apply to modules that import
 ``skypilot_tpu.observe`` (module-level or lazy), keyed on the
 declaration idiom ``metrics.counter(...)`` / ``metrics_lib.gauge(...)``
-/ ``REGISTRY.histogram(...)``; rule 4 applies to EVERY scanned module
+/ ``REGISTRY.histogram(...)``; rule 5 applies to EVERY scanned module
 (an ad-hoc parser needs no observe import). The ``observe`` package
 itself (which manipulates names generically) and ``analysis``
 (fixtures/prose) are exempt.
@@ -116,6 +126,64 @@ def _labels_arg(call: ast.Call) -> Optional[ast.expr]:
     return None
 
 
+# The client-supplied request-class header (observe/request_class.py's
+# HEADER literal): a raw read of it must route through the closed
+# registry before reaching labels().
+_CLASS_HEADER = 'x-skytpu-class'
+# Calls that ARE the sanctioned mapping (request_class.normalize /
+# request_class.from_headers, under any import alias).
+_REGISTRY_FUNCS = frozenset({'normalize', 'from_headers'})
+
+
+def _mentions_class_header(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and \
+                isinstance(sub.value, str) and \
+                sub.value.lower() == _CLASS_HEADER:
+            return True
+        # The idiomatic spelling reads the exported constant
+        # (`headers.get(request_class.HEADER)`) — an ast.Attribute,
+        # not a string literal; it must not evade the rule the
+        # literal spelling trips.
+        if isinstance(sub, ast.Attribute) and sub.attr == 'HEADER':
+            return True
+    return False
+
+
+def _through_class_registry(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            func = sub.func
+            name = (func.attr if isinstance(func, ast.Attribute)
+                    else getattr(func, 'id', ''))
+            if name in _REGISTRY_FUNCS:
+                return True
+    return False
+
+
+def _tainted_class_names(tree: ast.Module) -> set:
+    """Names assigned from a raw class-header read that never routed
+    through the registry. Conservative straight-line taint: ANY raw
+    assignment taints the name for the module (reusing one name for
+    raw and clean values is itself the bug this guards against)."""
+    out = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        if not _mentions_class_header(node.value) or \
+                _through_class_registry(node.value):
+            continue
+        for target in node.targets:
+            if isinstance(target, ast.Name):
+                out.add(target.id)
+    return out
+
+
+def _expr_touches_taint(node: ast.AST, tainted: set) -> bool:
+    return any(isinstance(sub, ast.Name) and sub.id in tainted
+               for sub in ast.walk(node))
+
+
 # Substrings a string literal only carries when it is being used to
 # hand-parse exposition text (histogram bucket lines). Metric NAME
 # literals (declarations, .startswith on a family) never contain them.
@@ -169,6 +237,7 @@ def run(mod: core.ModuleInfo) -> List[core.Violation]:
     out.extend(_adhoc_exposition(mod))
     if not _imports_observe(mod.tree):
         return out
+    tainted = _tainted_class_names(mod.tree)
     for node in ast.walk(mod.tree):
         if not isinstance(node, ast.Call):
             continue
@@ -217,16 +286,42 @@ def run(mod: core.ModuleInfo) -> List[core.Violation]:
         if isinstance(node.func, ast.Attribute) and \
                 node.func.attr in LABELED_METHODS:
             for kw in node.keywords:
-                if kw.arg is None or not _dynamic_string(kw.value):
+                if kw.arg is None:
                     continue
-                out.append(core.Violation(
-                    check=NAME, path=mod.path, line=kw.value.lineno,
-                    col=kw.value.col_offset,
-                    key=f'{node.func.attr}:{kw.arg}',
-                    message=(
-                        f'label {kw.arg!r} passed to '
-                        f'.{node.func.attr}() is built with f-string/'
-                        f'.format/concatenation — label values must '
-                        f'come from the declared finite set, or '
-                        f'cardinality grows with traffic')))
+                if _dynamic_string(kw.value):
+                    out.append(core.Violation(
+                        check=NAME, path=mod.path,
+                        line=kw.value.lineno,
+                        col=kw.value.col_offset,
+                        key=f'{node.func.attr}:{kw.arg}',
+                        message=(
+                            f'label {kw.arg!r} passed to '
+                            f'.{node.func.attr}() is built with '
+                            f'f-string/.format/concatenation — label '
+                            f'values must come from the declared '
+                            f'finite set, or cardinality grows with '
+                            f'traffic')))
+                    continue
+                raw_inline = (_mentions_class_header(kw.value) and
+                              not _through_class_registry(kw.value))
+                raw_via_name = (not raw_inline and
+                                _expr_touches_taint(kw.value, tainted)
+                                and not _through_class_registry(
+                                    kw.value))
+                if raw_inline or raw_via_name:
+                    out.append(core.Violation(
+                        check=NAME, path=mod.path,
+                        line=kw.value.lineno,
+                        col=kw.value.col_offset,
+                        key='raw-class-label',
+                        message=(
+                            f'label {kw.arg!r} passed to '
+                            f'.{node.func.attr}() carries a raw '
+                            f'X-Skytpu-Class header value — client '
+                            f'strings must be mapped through the '
+                            f'closed class registry (observe/'
+                            f'request_class.py normalize()/'
+                            f'from_headers()) before reaching '
+                            f'labels(), or any client can mint label '
+                            f'values')))
     return out

@@ -1,0 +1,46 @@
+"""Replayable multi-tenant traffic harness with fleet-attributed
+per-class SLO scorecards.
+
+ROADMAP item 3 said it plainly: scale claims were asserted, not
+measured — every latency number so far was a client-side guess. This
+package closes that gap with two disciplines borrowed from the
+serving-infrastructure literature (PAPERS.md — the Google ads serving
+paper's continuous class-attributed load measurement; the Gemma-on-TPU
+comparison's per-class TTFT/TPOT reporting):
+
+  1. REPLAYABLE OFFERED LOAD. One seed derives everything — tenants,
+     sessions, Zipfian session popularity, prompt/prefix content,
+     request classes, diurnal + spike arrival times — into a fully
+     materialized request schedule BEFORE a single byte is sent
+     (:mod:`~skypilot_tpu.loadgen.schedule`). The schedule's sha256
+     is the replay contract: same seed -> byte-identical schedule,
+     regardless of client concurrency, machine, or how the run went.
+
+  2. FLEET-ATTRIBUTED SCORING. The harness never grades itself with
+     client stopwatches. Each request carries a declared class
+     (``X-Skytpu-Class``, clamped through the closed registry) and a
+     session id (``X-Skytpu-Session``, the consistent-hash routing
+     key); the scorecard's per-class TTFT/TPOT quantiles, goodput and
+     SLO burn columns come from the PR-9 fleet plane —
+     ``/-/fleet/metrics`` + ``/-/fleet/status`` — merged with the
+     harness's own offered-load truth (what it sent, per class, per
+     phase) in :mod:`~skypilot_tpu.loadgen.report`.
+
+Entry point::
+
+    python -m skypilot_tpu.loadgen --seed 7 --profile smoke \
+        --local-stack 2 --report scorecard.json
+
+``--local-stack N`` spawns N CPU engine replicas behind an in-process
+LoadBalancer wired exactly as the service controller wires it
+(:mod:`~skypilot_tpu.loadgen.harness`); ``--base-url`` points at any
+live LB instead. The checked-in artifact (LOADGEN_LAST_GOOD.json) and
+``SKYTPU_BENCH_METRIC=loadgen`` (bench.py) make the harness the
+CPU-proxy regression tripwire for every future serving PR.
+"""
+from skypilot_tpu.loadgen.schedule import (PROFILES, Profile,
+                                           RequestSpec, build_schedule,
+                                           schedule_hash)
+
+__all__ = ['PROFILES', 'Profile', 'RequestSpec', 'build_schedule',
+           'schedule_hash']

@@ -1,0 +1,254 @@
+"""Traffic-harness CLI: ``python -m skypilot_tpu.loadgen``.
+
+Typical runs::
+
+  # Bit-replayable schedule only (no network) — print the hash:
+  python -m skypilot_tpu.loadgen --seed 7 --profile smoke --dry-run
+
+  # Full scorecard against a self-spawned 2-replica CPU stack:
+  python -m skypilot_tpu.loadgen --seed 7 --profile smoke \
+      --local-stack 2 --report scorecard.json
+
+  # Against a live serve LB:
+  python -m skypilot_tpu.loadgen --seed 7 --profile small \
+      --base-url http://127.0.0.1:8080 --report scorecard.json
+
+Exit codes: 0 ok, 1 run failed, 2 usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import tempfile
+from typing import Any, Dict, Optional
+
+from skypilot_tpu.loadgen import schedule as schedule_lib
+
+
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog='python -m skypilot_tpu.loadgen',
+        description='Seeded, replayable multi-tenant traffic harness '
+                    'with fleet-attributed per-class SLO scorecards.')
+    parser.add_argument('--seed', type=int, default=0)
+    parser.add_argument('--profile', default='smoke',
+                        help=f'one of {sorted(schedule_lib.PROFILES)}')
+    parser.add_argument('--requests', type=int, default=None,
+                        help='override the profile request count '
+                             '(changes the schedule hash)')
+    parser.add_argument('--duration', type=float, default=None,
+                        help='override the profile duration seconds')
+    parser.add_argument('--workers', type=int, default=4,
+                        help='max in-flight client requests')
+    parser.add_argument('--time-scale', type=float, default=1.0,
+                        help='multiply arrival offsets (<1 compresses '
+                             'the run)')
+    parser.add_argument('--base-url', default=None,
+                        help='drive a live serve LB at this URL')
+    parser.add_argument('--local-stack', type=int, default=0,
+                        metavar='N',
+                        help='spawn N local CPU engine replicas '
+                             'behind an in-process LB and drive those')
+    parser.add_argument('--model', default='llama-debug',
+                        help='model for --local-stack replicas')
+    parser.add_argument('--policy', default='prefix_affinity',
+                        help='LB policy for --local-stack')
+    parser.add_argument('--run-dir', default=None,
+                        help='scratch dir for --local-stack observe '
+                             'DBs (default: a fresh temp dir)')
+    parser.add_argument('--report', default=None,
+                        help='write the scorecard JSON here')
+    parser.add_argument('--dry-run', action='store_true',
+                        help='build + hash the schedule, no traffic')
+    parser.add_argument('--no-routing-drill', action='store_true',
+                        help='skip the consistent-hash routing drill')
+    parser.add_argument('--no-churn', action='store_true',
+                        help='skip the mid-run LB-restart churn '
+                             'scenario (--local-stack only)')
+    return parser
+
+
+async def _run_local(args, profile, schedule) -> Dict[str, Any]:
+    import dataclasses
+
+    from skypilot_tpu.loadgen import client as client_lib
+    from skypilot_tpu.loadgen import harness as harness_lib
+    from skypilot_tpu.loadgen import report as report_lib
+
+    churn_on = not args.no_churn and len(schedule) >= 4
+    async with harness_lib.LocalStack(
+            profile, replicas=args.local_stack, run_dir=args.run_dir,
+            model=args.model, policy=args.policy) as stack:
+        await client_lib.wait_ready(stack.lb_url)
+        churn: Dict[str, Any] = {}
+        if churn_on:
+            # Replica-churn schedule: run the first half, RESTART the
+            # LB's routing state (fresh policy — what a real restart
+            # discards), run the second half, and diff the fleet's
+            # prefix-hit counters across the cut. A restart-stable
+            # ring keeps sessions on the replicas that hold their
+            # prefix snapshots, so the phase-2 hit rate must not
+            # collapse.
+            half = len(schedule) // 2
+            first, second = schedule[:half], schedule[half:]
+            rebase = second[0].t
+            second = [dataclasses.replace(s, t=round(s.t - rebase, 6))
+                      for s in second]
+            run1 = await client_lib.run_schedule(
+                stack.lb_url, first, workers=args.workers,
+                time_scale=args.time_scale)
+            stack.settle()
+            h1, m1 = report_lib.prefix_counts(
+                await stack.fleet_metrics())
+            stack.reset_routing()
+            run2 = await client_lib.run_schedule(
+                stack.lb_url, second, workers=args.workers,
+                time_scale=args.time_scale)
+            run = client_lib.RunResult(
+                started_at=run1.started_at,
+                wall_s=run1.wall_s + run2.wall_s,
+                results=run1.results + run2.results)
+            stack.settle()
+            h2, m2 = report_lib.prefix_counts(
+                await stack.fleet_metrics())
+
+            def rate(h, m):
+                return round(h / (h + m), 4) if h + m else None
+
+            churn = {
+                'requests_before_restart': len(first),
+                'requests_after_restart': len(second),
+                'phase1': {'hits': h1, 'misses': m1,
+                           'hit_rate': rate(h1, m1)},
+                'phase2': {'hits': h2 - h1, 'misses': m2 - m1,
+                           'hit_rate': rate(h2 - h1, m2 - m1)},
+            }
+        else:
+            run = await client_lib.run_schedule(
+                stack.lb_url, schedule, workers=args.workers,
+                time_scale=args.time_scale)
+            # One settling scrape round so the final requests'
+            # publishes are in the merged view the scorecard reads.
+            stack.settle()
+        return {
+            'run': run,
+            'churn': churn,
+            'fleet_metrics_text': await stack.fleet_metrics(),
+            'fleet_status': await stack.fleet_status(),
+            'slo_events': stack.slo_events(),
+            'stack': {'mode': 'local', 'replicas': args.local_stack,
+                      'model': args.model, 'policy': args.policy},
+        }
+
+
+async def _run_remote(args, schedule) -> Dict[str, Any]:
+    import aiohttp
+
+    from skypilot_tpu.loadgen import client as client_lib
+
+    base = args.base_url.rstrip('/')
+    run = await client_lib.run_schedule(
+        base, schedule, workers=args.workers,
+        time_scale=args.time_scale)
+    out: Dict[str, Any] = {
+        'run': run,
+        'fleet_metrics_text': '',
+        'fleet_status': None,
+        'stack': {'mode': 'remote', 'base_url': base},
+    }
+    async with aiohttp.ClientSession() as session:
+        try:
+            async with session.get(base + '/-/fleet/metrics') as resp:
+                if resp.status == 200:
+                    out['fleet_metrics_text'] = await resp.text()
+            async with session.get(base + '/-/fleet/status') as resp:
+                if resp.status == 200:
+                    out['fleet_status'] = await resp.json()
+        except (OSError, aiohttp.ClientError) as e:
+            print(f'loadgen: fleet endpoints unavailable ({e}); '
+                  f'scorecard will carry offered/client planes only',
+                  file=sys.stderr)
+    return out
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.base_url and args.local_stack:
+        print('loadgen: --base-url and --local-stack are exclusive',
+              file=sys.stderr)
+        return 2
+    try:
+        profile = schedule_lib.resolve_profile(
+            args.profile, requests=args.requests,
+            duration_s=args.duration)
+        schedule = schedule_lib.build_schedule(profile, args.seed)
+    except ValueError as e:
+        print(f'loadgen: {e}', file=sys.stderr)
+        return 2
+    sched_hash = schedule_lib.schedule_hash(schedule)
+    if args.dry_run:
+        print(json.dumps({
+            'profile': profile.name, 'seed': args.seed,
+            'requests': len(schedule), 'schedule_hash': sched_hash,
+            'offered': schedule_lib.offered_truth(schedule),
+        }, indent=1, sort_keys=True))
+        return 0
+
+    if not args.base_url and not args.local_stack:
+        print('loadgen: need --base-url, --local-stack N or --dry-run',
+              file=sys.stderr)
+        return 2
+
+    routing: Optional[Dict[str, Any]] = None
+    if not args.no_routing_drill:
+        from skypilot_tpu.loadgen import harness as harness_lib
+        routing = harness_lib.routing_drill(args.seed)
+
+    if args.local_stack:
+        if args.run_dir is None:
+            args.run_dir = tempfile.mkdtemp(prefix='skytpu-loadgen-')
+        # The harness process's own journal/tsdb live in the run dir
+        # unless the operator already pinned a DB.
+        os.environ.setdefault(
+            'SKYTPU_OBSERVE_DB',
+            os.path.join(args.run_dir, 'observe.db'))
+        evidence = asyncio.run(_run_local(args, profile, schedule))
+    else:
+        evidence = asyncio.run(_run_remote(args, schedule))
+
+    churn = evidence.get('churn')
+    if churn:
+        routing = dict(routing or {})
+        routing['live_churn'] = churn
+
+    from skypilot_tpu.loadgen import report as report_lib
+    doc = report_lib.build_scorecard(
+        profile=profile, seed=args.seed, schedule=schedule,
+        run=evidence['run'],
+        fleet_metrics_text=evidence.get('fleet_metrics_text', ''),
+        fleet_status=evidence.get('fleet_status'),
+        slo_events=evidence.get('slo_events'),
+        routing=routing, stack=evidence.get('stack'))
+    if args.report:
+        report_lib.write_scorecard(doc, args.report)
+        print(f'loadgen: wrote scorecard to {args.report}',
+              file=sys.stderr)
+    run = evidence['run']
+    summary = {
+        'schedule_hash': sched_hash,
+        'completed': run.completed(),
+        'errors': run.errors(),
+    }
+    fleet = doc.get('fleet') or {}
+    for cls, row in sorted((fleet.get('by_class') or {}).items()):
+        if row.get('goodput') is not None:
+            summary[f'{cls}_goodput'] = row['goodput']
+    print(json.dumps(summary, sort_keys=True))
+    return 0 if run.errors() == 0 else 1
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,217 @@
+"""Async traffic clients: replay a materialized schedule against a
+live LB (or a bare engine replica).
+
+The runner is deliberately dumb: the schedule IS the experiment; the
+client's only jobs are (a) send each request at its scheduled offset,
+as the declared class and session, over the declared transport
+(plain /generate POST or SSE /v1/completions streaming), and (b) keep
+honest books about what actually happened client-side (completions,
+errors, and a client-view latency it clearly labels as secondary —
+the scorecard's headline latency columns come from the fleet plane,
+never from these stopwatches).
+
+Concurrency: ``workers`` bounds in-flight requests with a semaphore.
+It shapes DELIVERY only — the offered schedule (and its hash) is fixed
+before the first send, which is exactly the determinism contract the
+tests pin (same seed => identical schedule at --workers 1 and 4).
+"""
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import time
+from typing import Dict, List, Optional
+
+import aiohttp
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.observe import request_class
+from skypilot_tpu.loadgen import schedule as schedule_lib
+
+logger = sky_logging.init_logger(__name__)
+
+
+@dataclasses.dataclass
+class RequestResult:
+    index: int
+    cls: str
+    phase: str
+    session: str
+    ok: bool
+    status: int = 0
+    error: str = ''
+    tokens_out: int = 0
+    # Client-view timings — SECONDARY evidence (queueing in the client,
+    # the proxy hop and SSE parsing all ride on them); the scorecard's
+    # latency columns come from /-/fleet/metrics.
+    latency_s: float = 0.0
+    client_ttft_s: Optional[float] = None
+
+
+@dataclasses.dataclass
+class RunResult:
+    started_at: float
+    wall_s: float
+    results: List[RequestResult]
+
+    def completed(self) -> int:
+        return sum(1 for r in self.results if r.ok)
+
+    def errors(self) -> int:
+        return sum(1 for r in self.results if not r.ok)
+
+    def client_view(self) -> Dict[str, Dict[str, float]]:
+        """Per-class client-side summary (marked secondary in the
+        scorecard)."""
+        out: Dict[str, Dict[str, float]] = {}
+        by_cls: Dict[str, List[RequestResult]] = {}
+        for r in self.results:
+            by_cls.setdefault(r.cls, []).append(r)
+        for cls, rows in sorted(by_cls.items()):
+            ok = [r for r in rows if r.ok]
+            row: Dict[str, float] = {
+                'sent': len(rows), 'completed': len(ok),
+                'errors': len(rows) - len(ok),
+            }
+            ttfts = sorted(r.client_ttft_s for r in ok
+                           if r.client_ttft_s is not None)
+            if ttfts:
+                row['client_ttft_ms_p50'] = round(
+                    ttfts[len(ttfts) // 2] * 1e3, 2)
+            lats = sorted(r.latency_s for r in ok)
+            if lats:
+                row['client_latency_ms_p50'] = round(
+                    lats[len(lats) // 2] * 1e3, 2)
+            out[cls] = row
+        return out
+
+
+def _headers(spec: schedule_lib.RequestSpec) -> Dict[str, str]:
+    return {request_class.HEADER: spec.cls,
+            'X-Skytpu-Session': spec.session}
+
+
+async def _send_generate(session, base_url: str,
+                         spec: schedule_lib.RequestSpec
+                         ) -> RequestResult:
+    t0 = time.monotonic()
+    try:
+        async with session.post(
+                f'{base_url}/generate',
+                json={'tokens': list(spec.tokens),
+                      'max_new_tokens': spec.max_new_tokens},
+                headers=_headers(spec)) as resp:
+            body = await resp.json(content_type=None)
+            ok = resp.status == 200
+            return RequestResult(
+                index=spec.index, cls=spec.cls, phase=spec.phase,
+                session=spec.session, ok=ok, status=resp.status,
+                error='' if ok else str(body)[:200],
+                tokens_out=(len(body.get('tokens', [])) if ok else 0),
+                latency_s=time.monotonic() - t0)
+    except (aiohttp.ClientError, OSError, asyncio.TimeoutError,
+            ValueError) as e:
+        return RequestResult(
+            index=spec.index, cls=spec.cls, phase=spec.phase,
+            session=spec.session, ok=False,
+            error=f'{type(e).__name__}: {e}'[:200],
+            latency_s=time.monotonic() - t0)
+
+
+async def _send_stream(session, base_url: str,
+                       spec: schedule_lib.RequestSpec) -> RequestResult:
+    """SSE streaming client (/v1/completions stream=true, token-id
+    prompt): counts data events, stamps client TTFT at the first
+    content-bearing chunk."""
+    t0 = time.monotonic()
+    ttft = None
+    chunks = 0
+    try:
+        async with session.post(
+                f'{base_url}/v1/completions',
+                json={'prompt': list(spec.tokens),
+                      'max_tokens': spec.max_new_tokens,
+                      'stream': True},
+                headers=_headers(spec)) as resp:
+            if resp.status != 200:
+                body = await resp.text()
+                return RequestResult(
+                    index=spec.index, cls=spec.cls, phase=spec.phase,
+                    session=spec.session, ok=False, status=resp.status,
+                    error=body[:200], latency_s=time.monotonic() - t0)
+            async for raw in resp.content:
+                line = raw.decode('utf-8', errors='replace').strip()
+                if not line.startswith('data:'):
+                    continue
+                payload = line[len('data:'):].strip()
+                if payload == '[DONE]':
+                    break
+                chunks += 1
+                if ttft is None:
+                    ttft = time.monotonic() - t0
+        return RequestResult(
+            index=spec.index, cls=spec.cls, phase=spec.phase,
+            session=spec.session, ok=True, status=200,
+            tokens_out=chunks, latency_s=time.monotonic() - t0,
+            client_ttft_s=ttft)
+    except (aiohttp.ClientError, OSError, asyncio.TimeoutError,
+            ValueError) as e:
+        return RequestResult(
+            index=spec.index, cls=spec.cls, phase=spec.phase,
+            session=spec.session, ok=False,
+            error=f'{type(e).__name__}: {e}'[:200],
+            latency_s=time.monotonic() - t0)
+
+
+async def run_schedule(base_url: str,
+                       schedule: List[schedule_lib.RequestSpec],
+                       workers: int = 4,
+                       time_scale: float = 1.0,
+                       request_timeout: float = 120.0) -> RunResult:
+    """Replay ``schedule`` against ``base_url``. Each request fires at
+    its scheduled offset (scaled by ``time_scale`` — <1 compresses a
+    long profile into a short wall-clock run); ``workers`` bounds
+    in-flight requests. Every spec yields exactly one RequestResult,
+    success or not — the books must balance against the schedule."""
+    base = base_url.rstrip('/')
+    sem = asyncio.Semaphore(max(1, workers))
+    started_at = time.time()
+    t0 = time.monotonic()
+    timeout = aiohttp.ClientTimeout(total=None, connect=30.0,
+                                    sock_read=request_timeout)
+    async with aiohttp.ClientSession(timeout=timeout) as session:
+
+        async def one(spec: schedule_lib.RequestSpec) -> RequestResult:
+            delay = spec.t * time_scale - (time.monotonic() - t0)
+            if delay > 0:
+                await asyncio.sleep(delay)
+            async with sem:
+                if spec.stream:
+                    return await _send_stream(session, base, spec)
+                return await _send_generate(session, base, spec)
+
+        results = await asyncio.gather(*(one(s) for s in schedule))
+    return RunResult(started_at=started_at,
+                     wall_s=time.monotonic() - t0,
+                     results=list(results))
+
+
+async def wait_ready(base_url: str, path: str = '/-/lb/health',
+                     timeout_s: float = 600.0) -> None:
+    """Poll a health endpoint until 200 or deadline (engine warmup on
+    CPU takes tens of seconds — compiling the debug model's buckets)."""
+    base = base_url.rstrip('/')
+    deadline = time.monotonic() + timeout_s
+    async with aiohttp.ClientSession() as session:
+        while True:
+            try:
+                async with session.get(base + path) as resp:
+                    if resp.status == 200:
+                        return
+            except (OSError, aiohttp.ClientError):
+                pass
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f'{base}{path} never became ready '
+                    f'({timeout_s:.0f}s)')
+            await asyncio.sleep(1.0)

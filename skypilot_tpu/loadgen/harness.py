@@ -1,0 +1,327 @@
+"""The live local stack and the routing drill — how a scorecard gets
+made without a TPU fleet.
+
+``LocalStack`` spawns N real ``skypilot_tpu.serve.engine`` replicas
+(CPU debug model) behind an in-process LoadBalancer wired EXACTLY as
+the service controller wires it — Scraper + SLOEngine + ScrapeLoop +
+``attach_fleet`` — so the scorecard's fleet columns exercise the same
+scrape → tsdb → burn-rate path production runs. Nothing here is a
+mock; the only concession to CPU is the model size.
+
+``routing_drill`` is the deterministic consistent-hash proof: it
+replays Zipf-popular session traffic against the REAL
+PrefixAffinityPolicy object, restarts it (a fresh policy instance —
+exactly the state an LB restart discards), and measures
+session→replica stability, the bounded-load guarantee, and churn
+remap fractions. Policy-level on purpose: the properties under test
+are routing invariants, and measuring them through subprocess restarts
+would only add noise to the same arithmetic.
+"""
+from __future__ import annotations
+
+import asyncio
+import collections
+import heapq
+import math
+import os
+import random
+import socket
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+
+logger = sky_logging.init_logger(__name__)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+def _next_pow2(n: int) -> int:
+    p = 1
+    while p < n:
+        p *= 2
+    return p
+
+
+class LocalStack:
+    """N live CPU engine replicas + in-process LB/scraper/SLO plane.
+
+    Use as an async context manager::
+
+        async with LocalStack(profile, replicas=2, run_dir=d) as stack:
+            result = await client.run_schedule(stack.lb_url, schedule)
+            text = await stack.fleet_metrics()
+    """
+
+    def __init__(self, profile, replicas: int = 2,
+                 run_dir: str = '.',
+                 model: str = 'llama-debug',
+                 policy: str = 'prefix_affinity',
+                 scrape_interval: float = 1.0,
+                 warmup_timeout: float = 600.0):
+        self.profile = profile
+        self.replicas = replicas
+        self.run_dir = run_dir
+        self.model = model
+        self.policy = policy
+        self.scrape_interval = scrape_interval
+        self.warmup_timeout = warmup_timeout
+        self.lb_port = _free_port()
+        self.lb_url = f'http://127.0.0.1:{self.lb_port}'
+        self.started_unix: float = 0.0
+        self._procs: List[subprocess.Popen] = []
+        self._urls: List[str] = []
+        self._runner = None
+        self._scrape_loop = None
+        self._slo_engine = None
+        self._scraper = None
+        self._lb = None
+
+    # ------------------------------------------------------------ wiring
+    def _engine_cmd(self, port: int) -> List[str]:
+        max_len = (_next_pow2(self.profile.max_prompt_len()) +
+                   self.profile.max_new() + 16)
+        buckets = sorted({
+            _next_pow2(c.prefix_len + c.suffix_len)
+            for c in self.profile.classes.values()})
+        return [sys.executable, '-m', 'skypilot_tpu.serve.engine',
+                '--model', self.model, '--max-len', str(max_len),
+                '--warm-buckets', ','.join(str(b) for b in buckets),
+                '--host', '127.0.0.1', '--port', str(port)]
+
+    async def __aenter__(self) -> 'LocalStack':
+        # A failure inside enter (engine never warms, port races)
+        # must not leak the engine subprocesses — __aexit__ never
+        # runs when __aenter__ raises, and leaked replicas poison
+        # every later run on the box.
+        try:
+            return await self._enter()
+        except BaseException:
+            await self.__aexit__()
+            raise
+
+    async def _enter(self) -> 'LocalStack':
+        from aiohttp import web
+
+        from skypilot_tpu.observe import scrape
+        from skypilot_tpu.observe import slo as slo_lib
+        from skypilot_tpu.observe import request_class
+        from skypilot_tpu.serve import load_balancer as lb_lib
+
+        ports = [_free_port() for _ in range(self.replicas)]
+        for i, port in enumerate(ports):
+            env = {**os.environ, 'JAX_PLATFORMS': 'cpu',
+                   # Enough prefix-cache entries that eviction noise
+                   # doesn't mask the routing signal the churn
+                   # scenario measures.
+                   'SKYTPU_ENGINE_PREFIX_CACHE': os.environ.get(
+                       'SKYTPU_ENGINE_PREFIX_CACHE', '16'),
+                   'SKYTPU_OBSERVE_DB': os.path.join(
+                       self.run_dir, f'replica-{i}.db')}
+            self._procs.append(subprocess.Popen(
+                self._engine_cmd(port), stdout=sys.stderr,
+                stderr=sys.stderr, env=env))
+        urls = [f'http://127.0.0.1:{p}' for p in ports]
+        self._urls = urls
+
+        # Warm up every replica before the LB fronts it.
+        from skypilot_tpu.loadgen import client as client_lib
+        await asyncio.gather(*(
+            client_lib.wait_ready(u, path='/health',
+                                  timeout_s=self.warmup_timeout)
+            for u in urls))
+
+        self._scraper = scrape.Scraper(timeout=3.0,
+                                       staleness_seconds=10.0)
+        # Short SLO windows sized to a seconds-long run, goodput kinds
+        # included — the scorecard's burn columns come from here.
+        specs = [slo_lib.SLOSpec(kind='availability', objective=0.9,
+                                 fast_window=10.0, slow_window=30.0,
+                                 fast_burn=1.5, slow_burn=1.0)]
+        specs += [slo_lib.SLOSpec(kind=kind, objective=0.9,
+                                  fast_window=10.0, slow_window=30.0,
+                                  fast_burn=2.0, slow_burn=1.0)
+                  for kind in request_class.GOODPUT_KINDS]
+        self._slo_engine = slo_lib.SLOEngine(specs, entity='loadgen')
+        self._lb = lb_lib.LoadBalancer(self.policy,
+                                       service_name='loadgen')
+        self._lb.attach_fleet(self._scraper, self._slo_engine)
+        self._lb.set_ready_replicas(urls)
+        self._scraper.set_targets(
+            [scrape.Target(f'loadgen/{i}', u)
+             for i, u in enumerate(urls)])
+
+        lb = self._lb
+
+        def on_round(s):
+            snap = s.saturation_snapshot()
+            lb.set_replica_saturation(
+                {u: sat.queue_depth for u, sat in snap.items()})
+            self._slo_engine.evaluate()
+
+        self._scrape_loop = scrape.ScrapeLoop(
+            self._scraper, interval=self.scrape_interval,
+            on_round=on_round)
+        self._runner = web.AppRunner(self._lb.build_app())
+        await self._runner.setup()
+        await web.TCPSite(self._runner, '127.0.0.1',
+                          self.lb_port).start()
+        self._scrape_loop.start()
+        self.started_unix = time.time()
+        return self
+
+    async def __aexit__(self, *exc) -> None:
+        if self._scrape_loop is not None:
+            self._scrape_loop.stop()
+        if self._runner is not None:
+            await self._runner.cleanup()
+        for p in self._procs:
+            if p.poll() is None:
+                p.terminate()
+        for p in self._procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+    def reset_routing(self) -> None:
+        """Simulate an LB restart's routing-state loss: swap in a
+        FRESH policy instance — in-flight counts gone, hash ring
+        rebuilt from nothing but the replica set, exactly what a
+        restarted LB process starts from. The churn scenario measures
+        whether prefix hit rate survives this."""
+        from skypilot_tpu.utils import registry
+        fresh = registry.LB_POLICY_REGISTRY.type_from_str(
+            self.policy)()
+        fresh.set_ready_replicas(self._urls)
+        self._lb.policy = fresh
+
+    # ------------------------------------------------------- evidence
+    def settle(self) -> None:
+        """One final synchronous scrape round + SLO evaluation so the
+        scorecard reads counters that include the run's tail."""
+        if self._scrape_loop is not None:
+            self._scrape_loop.run_once()
+
+    async def fleet_metrics(self) -> str:
+        import aiohttp
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                    f'{self.lb_url}/-/fleet/metrics') as resp:
+                return await resp.text()
+
+    async def fleet_status(self) -> Dict[str, Any]:
+        import aiohttp
+        async with aiohttp.ClientSession() as session:
+            async with session.get(
+                    f'{self.lb_url}/-/fleet/status') as resp:
+                return await resp.json()
+
+    def slo_events(self) -> List[Dict[str, Any]]:
+        """This run's slo_* journal events — the evidence the
+        scorecard's burn columns must agree with."""
+        from skypilot_tpu.observe import journal
+        events = journal.query(since=self.started_unix - 1.0,
+                               entity_scope='loadgen')
+        return [e for e in events
+                if str(e.get('kind', '')).startswith('slo_')]
+
+
+# ------------------------------------------------------------- routing
+
+def routing_drill(seed: int, replicas: int = 3, sessions: int = 300,
+                  requests: int = 3000, zipf_a: float = 1.1,
+                  hold: int = 16,
+                  churn_schedule: Optional[List[int]] = None
+                  ) -> Dict[str, Any]:
+    """The consistent-hash proof, against the real policy objects.
+
+    Drives ``requests`` Zipf-popular session picks through a
+    PrefixAffinityPolicy while requests stay in flight for ``hold``
+    steps, then:
+
+      * RESTART: builds a FRESH policy (what an LB restart leaves —
+        no in-flight state survives) over the same replica set and
+        checks each session's post-restart home against the replica
+        that served MOST of its loaded-run traffic. The stability
+        fraction is the headline number (>= 0.9 is the contract —
+        only bounded-load spill traffic may move).
+      * LOAD BOUND: at every loaded pick, verifies the chosen
+        replica's in-flight count stayed within the policy's
+        capacity ceil(c * (total+1) / n).
+      * CHURN: removes each replica in ``churn_schedule`` (default:
+        the last) and checks that only sessions homed on the removed
+        replica remap.
+    """
+    from skypilot_tpu.serve import load_balancing_policies as lb_pol
+
+    rng = random.Random(seed ^ 0x5E551084)
+    urls = [f'http://replica-{i}' for i in range(replicas)]
+    session_ids = [f'drill/s{i:04d}' for i in range(sessions)]
+    weights = [1.0 / (k + 1) ** zipf_a for k in range(sessions)]
+
+    policy = lb_pol.PrefixAffinityPolicy()
+    policy.set_ready_replicas(urls)
+    in_flight: List[tuple] = []          # heap on completion step
+    observed: Dict[str, collections.Counter] = {
+        s: collections.Counter() for s in session_ids}
+    bound_violations = 0
+    max_load_ratio = 0.0
+    for step in range(requests):
+        while in_flight and in_flight[0][0] <= step:
+            policy.request_finished(heapq.heappop(in_flight)[1])
+        session = rng.choices(session_ids, weights=weights)[0]
+        # Capacity BEFORE the pick — the bound select() must honor.
+        with policy._lock:  # pylint: disable=protected-access
+            total = sum(policy._in_flight.get(u, 0) for u in urls)
+            capacity = math.ceil(policy.LOAD_BOUND * (total + 1) /
+                                 len(urls))
+        url = policy.select(session)
+        load = policy._in_flight.get(url, 0)  # pylint: disable=protected-access
+        if load + 1 > capacity:
+            bound_violations += 1
+        if total:
+            max_load_ratio = max(
+                max_load_ratio,
+                (load + 1) / ((total + 1) / len(urls)))
+        policy.request_started(url)
+        heapq.heappush(in_flight,
+                       (step + 1 + rng.randrange(hold), url))
+        observed[session][url] += 1
+
+    active = {s: c for s, c in observed.items() if c}
+    homes = {s: c.most_common(1)[0][0] for s, c in active.items()}
+
+    # RESTART: a fresh policy carries zero in-flight state — exactly
+    # what survives an LB restart (nothing but the replica set).
+    restarted = lb_pol.PrefixAffinityPolicy()
+    restarted.set_ready_replicas(urls)
+    stable = sum(1 for s, home in homes.items()
+                 if restarted.select(s) == home)
+    stability = stable / len(homes) if homes else 1.0
+
+    # CHURN: drop a replica; only its sessions may remap.
+    gone = urls[(churn_schedule or [replicas - 1])[0]]
+    churned = lb_pol.PrefixAffinityPolicy()
+    churned.set_ready_replicas([u for u in urls if u != gone])
+    kept = [s for s, home in homes.items() if home != gone]
+    kept_stable = sum(1 for s in kept if churned.select(s) == homes[s])
+    return {
+        'replicas': replicas,
+        'sessions': len(homes),
+        'requests': requests,
+        'zipf_a': zipf_a,
+        'restart_stability': round(stability, 4),
+        'load_bound': lb_pol.PrefixAffinityPolicy.LOAD_BOUND,
+        'bound_violations': bound_violations,
+        'max_load_vs_mean': round(max_load_ratio, 3),
+        'churn_removed': gone,
+        'churn_unrelated_kept': (round(kept_stable / len(kept), 4)
+                                 if kept else 1.0),
+    }

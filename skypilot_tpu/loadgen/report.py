@@ -1,0 +1,208 @@
+"""Scorecard assembly: offered-load truth + fleet-plane measurement.
+
+One JSON artifact per run, merging three evidence planes that must
+never be conflated:
+
+  * ``offered`` — what the schedule SENT (per class, per phase).
+    Ground truth by construction.
+  * ``fleet`` — what the FLEET PLANE measured: per-class TTFT/TPOT
+    quantiles, goodput good/slow counts and prefix-cache hit rate
+    parsed (via observe/promtext — the one exposition parser) from
+    ``/-/fleet/metrics``, plus the per-class burn/state columns the
+    LB's ``/-/fleet/status`` reports from its SLO engine. This is the
+    headline evidence: none of it comes from client stopwatches.
+  * ``client`` — the runner's own books (completions, errors, its
+    secondary latency view). Kept for reconciliation: fleet-side
+    request counts should match what the client believes it sent.
+
+The scorecard also records the ``schedule_hash`` — the replay
+contract — and the ``routing`` drill results (session→replica
+stability across an LB restart, load-bound compliance) when the
+harness ran one.
+"""
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu.observe import promtext
+from skypilot_tpu.observe import request_class
+from skypilot_tpu.loadgen import client as client_lib
+from skypilot_tpu.loadgen import schedule as schedule_lib
+
+SCHEMA_VERSION = 1
+
+_CLASS_FAMILIES = (('skytpu_engine_class_ttft_seconds', 'ttft'),
+                   ('skytpu_engine_class_tpot_seconds', 'tpot'))
+_QUANTILES = ((0.50, 'p50'), (0.95, 'p95'))
+
+
+def _counter_by_labels(fams, family: str) -> Dict[tuple, float]:
+    fam = fams.get(family)
+    if fam is None:
+        return {}
+    return {s.labels: s.value for s in fam.samples}
+
+
+def fleet_section(metrics_text: str) -> Dict[str, Any]:
+    """The fleet-measured half of the scorecard from one
+    ``/-/fleet/metrics`` document. Tolerant throughout: a class with
+    no samples yet yields a row of what IS known (goodput counts seed
+    at zero on every engine), never a KeyError."""
+    fams = promtext.parse(metrics_text)
+    goodput = _counter_by_labels(fams, 'skytpu_engine_goodput_total')
+    class_hists = {short: promtext.extract_histograms(fams, family)
+                   for family, short in _CLASS_FAMILIES}
+    by_class: Dict[str, Dict[str, Any]] = {}
+    for cls in request_class.CLASSES:
+        row: Dict[str, Any] = {}
+        good = goodput.get((('cls', cls), ('outcome', 'good')), 0.0)
+        slow = goodput.get((('cls', cls), ('outcome', 'slow')), 0.0)
+        row['good'] = good
+        row['slow'] = slow
+        total = good + slow
+        row['goodput'] = round(good / total, 4) if total else None
+        for _, short in _CLASS_FAMILIES:
+            hist = class_hists[short].get((('cls', cls),))
+            if hist is None:
+                continue
+            for q, suffix in _QUANTILES:
+                v = promtext.histogram_quantile(hist, q)
+                if v == v:
+                    # One spelling everywhere ('<fam>_p95_ms'): the
+                    # status table, the fleet CLI and this section
+                    # must join on the same keys.
+                    row[f'{short}_{suffix}_ms'] = round(v * 1e3, 2)
+        by_class[cls] = row
+    aggregate: Dict[str, Any] = {}
+    for family, short in (('skytpu_engine_ttft_seconds', 'ttft'),
+                          ('skytpu_engine_tpot_seconds', 'tpot')):
+        for q, suffix in _QUANTILES:
+            v = promtext.quantile_from_text(metrics_text, family, q)
+            if v == v:
+                aggregate[f'{short}_{suffix}_ms'] = round(v * 1e3, 2)
+    requests_fam = fams.get('skytpu_engine_requests_total')
+    if requests_fam is not None:
+        aggregate['requests_total'] = sum(
+            s.value for s in requests_fam.samples)
+    prefix = _counter_by_labels(fams,
+                                'skytpu_engine_prefix_requests_total')
+    hits = prefix.get((('outcome', 'hit'),), 0.0)
+    misses = prefix.get((('outcome', 'miss'),), 0.0)
+    prefix_row: Dict[str, Any] = {'hits': hits, 'misses': misses}
+    lookups = hits + misses
+    prefix_row['hit_rate'] = (round(hits / lookups, 4)
+                              if lookups else None)
+    return {'by_class': by_class, 'aggregate': aggregate,
+            'prefix': prefix_row}
+
+
+def prefix_counts(metrics_text: str) -> tuple:
+    """(hits, misses) of the fleet's prefix-cache lookups — the churn
+    scenario diffs these across an LB restart."""
+    fams = promtext.parse(metrics_text)
+    prefix = _counter_by_labels(fams,
+                                'skytpu_engine_prefix_requests_total')
+    return (prefix.get((('outcome', 'hit'),), 0.0),
+            prefix.get((('outcome', 'miss'),), 0.0))
+
+
+def build_scorecard(
+        *, profile: schedule_lib.Profile, seed: int,
+        schedule: List[schedule_lib.RequestSpec],
+        run: Optional[client_lib.RunResult],
+        fleet_metrics_text: str = '',
+        fleet_status: Optional[Dict[str, Any]] = None,
+        slo_events: Optional[List[Dict[str, Any]]] = None,
+        routing: Optional[Dict[str, Any]] = None,
+        stack: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Merge one run's evidence planes into the scorecard doc."""
+    doc: Dict[str, Any] = {
+        'schema_version': SCHEMA_VERSION,
+        'generated_unix': round(time.time(), 3),
+        'profile': profile.name,
+        'seed': seed,
+        'requests': len(schedule),
+        'duration_s': profile.duration_s,
+        'schedule_hash': schedule_lib.schedule_hash(schedule),
+        'offered': schedule_lib.offered_truth(schedule),
+    }
+    if stack:
+        doc['stack'] = stack
+    if run is not None:
+        doc['client'] = {
+            'note': ('client-side view, SECONDARY evidence — the '
+                     'headline latency columns are fleet-attributed '
+                     '(fleet.by_class)'),
+            'completed': run.completed(),
+            'errors': run.errors(),
+            'wall_s': round(run.wall_s, 3),
+            'by_class': run.client_view(),
+        }
+    if fleet_metrics_text:
+        doc['fleet'] = fleet_section(fleet_metrics_text)
+    if fleet_status is not None:
+        doc['slo'] = {
+            'states': fleet_status.get('slo') or {},
+            'classes': fleet_status.get('classes') or {},
+        }
+    if slo_events is not None:
+        doc['slo_events'] = slo_events
+    if routing is not None:
+        doc['routing'] = routing
+    return doc
+
+
+def write_scorecard(doc: Dict[str, Any], path: str) -> None:
+    with open(path, 'w', encoding='utf-8') as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write('\n')
+
+
+def diff_scorecards(current: Dict[str, Any], last_good: Dict[str, Any],
+                    quantile_tolerance: float = 3.0
+                    ) -> Dict[str, Any]:
+    """The bench tripwire's comparison: replay must be EXACT (same
+    seed+profile => same schedule hash, byte for byte), quality must
+    not collapse (per-class goodput may not drop more than the
+    tolerance band; per-class p95s may not exceed last-good by more
+    than ``quantile_tolerance``x — CPU boxes are noisy, an order of
+    magnitude is not noise)."""
+    out: Dict[str, Any] = {'replay_ok': None, 'regressions': []}
+    if (current.get('profile') == last_good.get('profile') and
+            current.get('seed') == last_good.get('seed')):
+        out['replay_ok'] = (current.get('schedule_hash') ==
+                            last_good.get('schedule_hash'))
+        if not out['replay_ok']:
+            out['regressions'].append(
+                'schedule_hash changed for the same (profile, seed) — '
+                'the replay contract is broken')
+    cur_cls = (current.get('fleet') or {}).get('by_class') or {}
+    old_cls = (last_good.get('fleet') or {}).get('by_class') or {}
+    for cls, old_row in old_cls.items():
+        cur_row = cur_cls.get(cls) or {}
+        old_gp, cur_gp = old_row.get('goodput'), cur_row.get('goodput')
+        if old_gp is not None and cur_gp is not None and \
+                cur_gp < old_gp - 0.25:
+            out['regressions'].append(
+                f'{cls}: goodput {cur_gp} vs last-good {old_gp}')
+        # Quantiles are only evidence at quantile-worthy sample
+        # counts: at n < 20 the p95 IS the max of a handful of
+        # CPU-noise samples (observed 10x swings run to run on an
+        # otherwise identical tree) — the goodput band above is the
+        # small-n tripwire.
+        finished = min(
+            cur_row.get('good', 0.0) + cur_row.get('slow', 0.0),
+            old_row.get('good', 0.0) + old_row.get('slow', 0.0))
+        if finished < 20:
+            continue
+        for key in ('ttft_p95_ms', 'tpot_p95_ms'):
+            old_v, cur_v = old_row.get(key), cur_row.get(key)
+            if old_v and cur_v and cur_v > old_v * quantile_tolerance:
+                out['regressions'].append(
+                    f'{cls}: {key} {cur_v} vs last-good {old_v} '
+                    f'(>{quantile_tolerance}x)')
+    out['ok'] = (out['replay_ok'] is not False and
+                 not out['regressions'])
+    return out

@@ -1,0 +1,302 @@
+"""Seeded, fully-materialized request schedules — the replay contract.
+
+Everything random in a load run derives from ONE seed through one
+``random.Random`` stream, and the whole schedule is materialized before
+a single request is sent. That ordering is what makes a run
+bit-replayable: client concurrency, network jitter and replica churn
+can change *when* requests complete, but never *what* was offered —
+``schedule_hash`` (sha256 over the canonical JSON of every request
+spec) is identical for the same (profile, seed) on any machine, any
+``--workers`` setting, any day. The scorecard records the hash; a
+regression bisect replays the exact traffic by seed alone.
+
+Workload shape (the million-user serving pattern scaled by profile):
+
+  * N TENANTS x M SESSIONS, both Zipf-popular: a few tenants dominate
+    traffic and, within each, a few sessions are hot — the skew that
+    makes session routing matter (a uniform workload would never
+    expose a hot-spot amplifier).
+  * PREFIX REUSE: every session owns a seeded prefix token block; each
+    of its requests is ``prefix ++ fresh suffix`` — the chat pattern
+    (system prompt + growing history) that prefix KV caches and
+    consistent-hash affinity exist for.
+  * CLASSES: each request draws a declared class
+    (observe/request_class.py) from the profile's mix; classes differ
+    in prompt/suffix/new-token lengths, so the mixed short/long
+    admission behavior is part of the offered load.
+  * ARRIVALS: a diurnal sinusoid compressed into the run's duration,
+    plus a multiplicative SPIKE window — sampled by rejection against
+    the intensity envelope (deterministic: the accept/reject draws
+    come from the same seeded stream). Each request is labeled with
+    its phase (offpeak/peak/spike) so the scorecard reports offered
+    truth per class per phase.
+"""
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import math
+import random
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu.observe import request_class
+
+# Prompt token ids are drawn from this range — comfortably inside every
+# debug/test model's vocab (the fleet e2e suite uses ids < 32).
+_TOKEN_LOW, _TOKEN_HIGH = 1, 63
+
+
+@dataclasses.dataclass(frozen=True)
+class ClassShape:
+    """One request class's size parameters (token counts)."""
+    prefix_len: int          # session-shared prompt head
+    suffix_len: int          # fresh per-request tail
+    max_new_tokens: int
+    weight: float            # share of the class mix
+
+
+@dataclasses.dataclass(frozen=True)
+class Profile:
+    """A named workload. ``duration_s`` is the schedule's span; the
+    runner replays arrival offsets against its own start time."""
+    name: str
+    tenants: int
+    sessions_per_tenant: int
+    requests: int
+    duration_s: float
+    classes: Dict[str, ClassShape]
+    zipf_a: float = 1.1              # tenant/session popularity skew
+    diurnal_amplitude: float = 0.6   # peak-to-trough intensity swing
+    spike_start_frac: float = 0.5    # spike window, as run fractions
+    spike_len_frac: float = 0.2
+    spike_factor: float = 3.0
+    stream_fraction: float = 0.5     # share of requests using SSE
+
+    def max_prompt_len(self) -> int:
+        return max(c.prefix_len + c.suffix_len
+                   for c in self.classes.values())
+
+    def max_new(self) -> int:
+        return max(c.max_new_tokens for c in self.classes.values())
+
+
+PROFILES: Dict[str, Profile] = {
+    # CPU-runnable in seconds — the bench tripwire and the checked-in
+    # scorecard's profile. Prefix lengths clear the engine's 64-token
+    # prefix-snapshot minimum so session affinity shows up as prefix
+    # HITS, not just stable routing.
+    'smoke': Profile(
+        name='smoke', tenants=3, sessions_per_tenant=4, requests=36,
+        duration_s=6.0,
+        classes={
+            'interactive': ClassShape(prefix_len=64, suffix_len=4,
+                                      max_new_tokens=6, weight=0.6),
+            'long_context': ClassShape(prefix_len=96, suffix_len=16,
+                                       max_new_tokens=4, weight=0.25),
+            'batch': ClassShape(prefix_len=64, suffix_len=8,
+                                max_new_tokens=8, weight=0.15),
+        }),
+    # A few minutes on CPU, a shakeout on real hardware.
+    'small': Profile(
+        name='small', tenants=8, sessions_per_tenant=8, requests=160,
+        duration_s=40.0,
+        classes={
+            'interactive': ClassShape(prefix_len=16, suffix_len=8,
+                                      max_new_tokens=8, weight=0.6),
+            'long_context': ClassShape(prefix_len=48, suffix_len=16,
+                                       max_new_tokens=8, weight=0.25),
+            'batch': ClassShape(prefix_len=16, suffix_len=16,
+                                max_new_tokens=16, weight=0.15),
+        }),
+    # The million-user SHAPE (tenant/session cardinality and skew) at
+    # a request count a TPU fleet sustains for ~half an hour; scale
+    # `requests` up from the CLI for longer soaks.
+    'soak': Profile(
+        name='soak', tenants=1000, sessions_per_tenant=50,
+        requests=20000, duration_s=1800.0,
+        classes={
+            'interactive': ClassShape(prefix_len=128, suffix_len=64,
+                                      max_new_tokens=64, weight=0.7),
+            'long_context': ClassShape(prefix_len=1024, suffix_len=128,
+                                       max_new_tokens=32, weight=0.2),
+            'batch': ClassShape(prefix_len=128, suffix_len=256,
+                                max_new_tokens=128, weight=0.1),
+        }),
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class RequestSpec:
+    """One scheduled request — everything the client needs to send it
+    and the scorecard needs to attribute it. ``t`` is the offset from
+    run start in seconds; ``phase`` labels the arrival-intensity
+    regime it was scheduled under."""
+    index: int
+    t: float
+    tenant: str
+    session: str
+    cls: str
+    phase: str
+    tokens: Tuple[int, ...]
+    max_new_tokens: int
+    stream: bool
+
+    def to_doc(self) -> Dict[str, object]:
+        doc = dataclasses.asdict(self)
+        doc['tokens'] = list(self.tokens)
+        return doc
+
+
+def _zipf_weights(n: int, a: float) -> List[float]:
+    return [1.0 / (k + 1) ** a for k in range(n)]
+
+
+def _intensity(profile: Profile, t: float) -> float:
+    """Relative arrival intensity at offset ``t``: diurnal sinusoid
+    (trough at the start, peak mid-run) times the spike factor inside
+    the spike window."""
+    frac = t / profile.duration_s
+    lam = 1.0 + profile.diurnal_amplitude * math.sin(
+        2.0 * math.pi * frac - math.pi / 2.0)
+    if (profile.spike_start_frac <= frac <
+            profile.spike_start_frac + profile.spike_len_frac):
+        lam *= profile.spike_factor
+    return lam
+
+
+def _phase(profile: Profile, t: float) -> str:
+    frac = t / profile.duration_s
+    if (profile.spike_start_frac <= frac <
+            profile.spike_start_frac + profile.spike_len_frac):
+        return 'spike'
+    return 'peak' if _intensity(profile, t) >= 1.0 else 'offpeak'
+
+
+def build_schedule(profile: Profile, seed: int) -> List[RequestSpec]:
+    """The full request schedule for (profile, seed) — pure function,
+    no wall clock, no I/O. Sorted by arrival offset; ``index`` is the
+    arrival order (ties broken by draw order, itself deterministic)."""
+    unknown = set(profile.classes) - set(request_class.CLASSES)
+    if unknown:
+        raise ValueError(
+            f'profile {profile.name!r} declares classes outside the '
+            f'closed registry: {sorted(unknown)} (declared: '
+            f'{request_class.CLASSES})')
+    rng = random.Random(seed)
+    tenants = [f'tenant-{i:04d}' for i in range(profile.tenants)]
+    tenant_w = _zipf_weights(profile.tenants, profile.zipf_a)
+    session_w = _zipf_weights(profile.sessions_per_tenant,
+                              profile.zipf_a)
+
+    # Session prefix blocks: derived LAZILY from a per-(seed, session,
+    # class) child stream, so only sessions actually drawn pay for
+    # their prefixes — under Zipf skew most of a large profile's
+    # tenant x session space is never touched (the 'soak' profile's
+    # full space is ~64M tokens; its 20k requests hit a tiny
+    # fraction). Child-seeding keeps the determinism contract: a
+    # session's prefix depends on nothing but (seed, session, cls),
+    # never on draw order.
+    prefix_cache: Dict[Tuple[str, str], Tuple[int, ...]] = {}
+
+    def session_prefix(session: str, cls: str) -> Tuple[int, ...]:
+        key = (session, cls)
+        prefix = prefix_cache.get(key)
+        if prefix is None:
+            child = random.Random(f'{seed}/{session}/{cls}')
+            prefix = tuple(
+                child.randint(_TOKEN_LOW, _TOKEN_HIGH)
+                for _ in range(profile.classes[cls].prefix_len))
+            prefix_cache[key] = prefix
+        return prefix
+
+    class_names = sorted(profile.classes)
+    class_weights = [profile.classes[c].weight for c in class_names]
+    lam_max = max(_intensity(profile, x * profile.duration_s / 1000.0)
+                  for x in range(1000)) * 1.001
+
+    drawn = []
+    for _ in range(profile.requests):
+        # Arrival: rejection-sample against the intensity envelope.
+        while True:
+            t = rng.random() * profile.duration_s
+            if rng.random() * lam_max <= _intensity(profile, t):
+                break
+        tenant = rng.choices(tenants, weights=tenant_w)[0]
+        s_idx = rng.choices(range(profile.sessions_per_tenant),
+                            weights=session_w)[0]
+        session = f'{tenant}/s{s_idx:03d}'
+        cls = rng.choices(class_names, weights=class_weights)[0]
+        shape = profile.classes[cls]
+        suffix = tuple(rng.randint(_TOKEN_LOW, _TOKEN_HIGH)
+                       for _ in range(shape.suffix_len))
+        stream = rng.random() < profile.stream_fraction
+        drawn.append((t, tenant, session, cls, suffix, stream))
+
+    drawn.sort(key=lambda d: d[0])
+    out: List[RequestSpec] = []
+    for index, (t, tenant, session, cls, suffix, stream) in \
+            enumerate(drawn):
+        shape = profile.classes[cls]
+        prefix = session_prefix(session, cls)
+        out.append(RequestSpec(
+            index=index, t=round(t, 6), tenant=tenant, session=session,
+            cls=cls, phase=_phase(profile, t), tokens=prefix + suffix,
+            max_new_tokens=shape.max_new_tokens, stream=stream))
+    return out
+
+
+def schedule_hash(schedule: List[RequestSpec]) -> str:
+    """sha256 over the canonical JSON of every spec — the replay
+    contract the scorecard records and the bench tripwire asserts."""
+    blob = json.dumps([spec.to_doc() for spec in schedule],
+                      sort_keys=True, separators=(',', ':'))
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def offered_truth(schedule: List[RequestSpec]
+                  ) -> Dict[str, Dict[str, Dict[str, float]]]:
+    """The offered-load side of the scorecard: per class and per
+    (class, phase), how many requests / prompt tokens / requested new
+    tokens the schedule contains. Ground truth by construction — it
+    describes the schedule, not the run."""
+    by_class: Dict[str, Dict[str, float]] = {}
+    by_phase: Dict[str, Dict[str, float]] = {}
+    for spec in schedule:
+        for key, acc in ((spec.cls, by_class),
+                         (f'{spec.cls}/{spec.phase}', by_phase)):
+            row = acc.setdefault(key, {'requests': 0,
+                                       'prompt_tokens': 0,
+                                       'new_tokens_requested': 0,
+                                       'sessions': 0})
+            row['requests'] += 1
+            row['prompt_tokens'] += len(spec.tokens)
+            row['new_tokens_requested'] += spec.max_new_tokens
+    sessions_by_class: Dict[str, set] = {}
+    for spec in schedule:
+        sessions_by_class.setdefault(spec.cls, set()).add(spec.session)
+    for cls, sessions in sessions_by_class.items():
+        by_class[cls]['sessions'] = len(sessions)
+    for row in by_phase.values():
+        row.pop('sessions', None)
+    return {'by_class': by_class, 'by_class_phase': by_phase}
+
+
+def resolve_profile(name: str,
+                    requests: Optional[int] = None,
+                    duration_s: Optional[float] = None) -> Profile:
+    """A named profile, optionally rescaled (request count / duration
+    overrides change the schedule — and therefore the hash — exactly
+    as a different profile would)."""
+    base = PROFILES.get(name)
+    if base is None:
+        raise ValueError(
+            f'unknown profile {name!r}; available: '
+            f'{sorted(PROFILES)}')
+    if requests is None and duration_s is None:
+        return base
+    return dataclasses.replace(
+        base,
+        requests=base.requests if requests is None else requests,
+        duration_s=(base.duration_s if duration_s is None
+                    else duration_s))

@@ -20,11 +20,13 @@ Commands:
              trace-event JSON instead (load in Perfetto)
   fleet    — the fleet view: per-replica scrape/saturation table +
              merged fleet TTFT/TPOT p50/p95 (the shared
-             promtext.histogram_quantile). ``--url`` asks a live
-             serve LB (``/-/fleet/status`` + ``/-/fleet/metrics``);
-             without it the local scraped-samples table is read
-             (``--db`` repoints, ``--window`` bounds the quantile
-             window)
+             promtext.histogram_quantile) + the per-class table
+             (goodput, miss fraction, class p95s, SLO burn/state) —
+             every registered class renders, sample-less ones as
+             ``-`` cells. ``--url`` asks a live serve LB
+             (``/-/fleet/status`` + ``/-/fleet/metrics``); without
+             it the local scraped-samples table is read (``--db``
+             repoints, ``--window`` bounds the quantile window)
 
 Exit codes: 0 ok, 2 usage error.
 """
@@ -156,6 +158,7 @@ def _fleet_doc(url: Optional[str], db: Optional[str],
         return doc
     if db is not None:
         os.environ['SKYTPU_OBSERVE_DB'] = db
+    from skypilot_tpu.observe import request_class
     from skypilot_tpu.observe import slo as slo_lib
     from skypilot_tpu.observe import tsdb
     now = time.time()
@@ -183,8 +186,43 @@ def _fleet_doc(url: Optional[str], db: Optional[str],
             v = promtext.histogram_quantile(hist, q)
             if v == v:
                 quantiles[f'{short}_{suffix}_ms'] = round(v * 1e3, 2)
+    # Per-class scorecard columns from the same scraped samples. Every
+    # lookup degrades to "no row entries" for a class with no samples
+    # yet — a freshly declared class must render, not KeyError.
+    classes = {}
+    for cls in request_class.CLASSES:
+        row = {}
+        fast, slow, measured = slo_lib.goodput_fractions(
+            cls, window, window, now)
+        del fast
+        if measured is not None:
+            row['goodput'] = round(measured, 4)
+            # Burn is objective-relative; offline (no SLOEngine, no
+            # specs) reports the raw miss fraction instead — the live
+            # path's status doc carries real burn_fast/burn_slow.
+            row['miss_fraction'] = round(slow, 4)
+        cls_filter = promtext.labels_text((('cls', cls),))
+        for family, short in (
+                ('skytpu_engine_class_ttft_seconds', 'ttft'),
+                ('skytpu_engine_class_tpot_seconds', 'tpot')):
+            hist = slo_lib.windowed_histogram(
+                family, window, now, label_filter=cls_filter)
+            v = promtext.histogram_quantile(hist, 0.95)
+            if v == v:
+                row[f'{short}_p95_ms'] = round(v * 1e3, 2)
+        classes[cls] = row
     return {'replicas': replicas, 'fleet_quantiles': quantiles,
-            'window_seconds': window}
+            'classes': classes, 'window_seconds': window}
+
+
+def _cell(value: Any) -> str:
+    """One class-table cell: None (no samples for this class yet)
+    renders as '-', floats round-trip compactly."""
+    if value is None:
+        return '-'
+    if isinstance(value, float):
+        return f'{value:g}'
+    return str(value)
 
 
 def _print_fleet(doc: Dict[str, Any]) -> None:
@@ -207,6 +245,25 @@ def _print_fleet(doc: Dict[str, Any]) -> None:
     if slo_states:
         print('slo: ' + '  '.join(f'{k}={v}'
                                   for k, v in sorted(slo_states.items())))
+    classes = doc.get('classes') or {}
+    if classes:
+        # Every cell via .get: a class with no samples yet renders as
+        # blanks, never a KeyError on a missing label set.
+        ccols = ('cls', 'goodput', 'good', 'slow', 'miss_fraction',
+                 'ttft_p95_ms', 'tpot_p95_ms', 'state', 'burn_fast',
+                 'burn_slow')
+        rows = [{'cls': cls, **(row if isinstance(row, dict) else {})}
+                for cls, row in sorted(classes.items())]
+        present = [c for c in ccols
+                   if any(r.get(c) is not None for r in rows)]
+        if present:
+            widths = {c: max(len(c), *(len(_cell(r.get(c)))
+                                       for r in rows))
+                      for c in present}
+            print('  '.join(c.ljust(widths[c]) for c in present))
+            for r in rows:
+                print('  '.join(_cell(r.get(c)).ljust(widths[c])
+                                for c in present))
     quantiles = doc.get('fleet_quantiles') or {}
     if quantiles:
         print('fleet: ' + '  '.join(f'{k}={v}'

@@ -1,0 +1,108 @@
+"""The closed request-class registry — the serving plane's declared,
+bounded ``cls`` metric label.
+
+Per-class measurement is the honest unit of serving evidence (PAPERS.md,
+the Gemma-on-TPU serving comparison): an aggregate TTFT p95 over mixed
+traffic answers nothing, because a batch job's 30 s first token is fine
+and an interactive chat turn's is an outage. But a per-request class is
+also exactly the kind of value that destroys a metrics plane when fed
+raw: it arrives on an HTTP header (``X-Skytpu-Class``) any client can
+set to anything, and an interpolated label makes every scrape bigger
+than the last (the cardinality contract in docs/OBSERVABILITY.md).
+
+So the class label is CLOSED here, once, for every consumer:
+
+  * :data:`CLASSES` is the full declared value set — engines declare
+    their per-class histograms over it, the SLO engine derives its
+    per-class goodput kinds from it, the fleet CLI renders it;
+  * :func:`normalize` is the ONE mapping from a raw client-supplied
+    string into the set (unknown/absent → ``other``, never a new
+    label value) — the LB clamps the header through it before
+    forwarding, the engine clamps again before ``labels()`` (defense
+    in depth: a replica addressed directly must stay bounded too).
+    The skylint ``metric-discipline`` checker enforces statically that
+    a raw ``X-Skytpu-Class`` read reaches no metric call without
+    passing through it;
+  * :data:`OBJECTIVES` carries each class's latency objective — the
+    GOODPUT definition. A request counts toward goodput only if it
+    completed within its class's objective (TTFT at/under the bound,
+    and TPOT at/under the bound when the request decoded more than one
+    token). Bounds are aligned with declared histogram bucket bounds
+    so bucketed windowed evaluation (observe/slo.py) answers exactly.
+
+Layering: this module lives in ``observe`` (rank 3) so both the serve
+plane and the SLO engine import it downward; it imports nothing but the
+stdlib.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Mapping, Optional, Tuple
+
+# The declared finite label values. ``other`` is the clamp target for
+# anything unknown and the default for unlabeled traffic — it MUST stay
+# a member, or clamping would itself mint a new value.
+CLASSES: Tuple[str, ...] = ('interactive', 'long_context', 'batch',
+                            'other')
+DEFAULT_CLASS = 'other'
+
+# The header a client (or the loadgen harness) declares its class on.
+# The LB clamps it through normalize() before forwarding — mirroring
+# the X-Skytpu-Trace-Id header-hardening precedent (PR 5).
+HEADER = 'X-Skytpu-Class'
+
+# Per-class SLO kind names, derived once so observe/slo.py's KINDS and
+# every scorecard column agree by construction.
+GOODPUT_KINDS: Tuple[str, ...] = tuple('goodput_' + c for c in CLASSES)
+
+
+@dataclasses.dataclass(frozen=True)
+class ClassObjective:
+    """One class's latency objective — the goodput cut. Both bounds
+    are declared histogram bucket bounds (engine TTFT buckets include
+    2.5/10/30 s, TPOT buckets include 0.25/0.5/1.0 s), so windowed
+    bucket-delta evaluation needs no interpolation."""
+    ttft_seconds: float
+    tpot_seconds: float
+
+
+OBJECTIVES: Mapping[str, ClassObjective] = {
+    'interactive': ClassObjective(ttft_seconds=2.5, tpot_seconds=0.25),
+    'long_context': ClassObjective(ttft_seconds=10.0, tpot_seconds=0.25),
+    'batch': ClassObjective(ttft_seconds=30.0, tpot_seconds=1.0),
+    'other': ClassObjective(ttft_seconds=10.0, tpot_seconds=0.5),
+}
+assert set(OBJECTIVES) == set(CLASSES)
+
+
+def normalize(raw: Optional[str]) -> str:
+    """Map a raw (client-supplied, untrusted) class string into the
+    closed set: case/whitespace-insensitive exact match, anything else
+    — including None/empty — clamps to ``other``. This is the ONE
+    sanctioned path from an ``X-Skytpu-Class`` header value to a
+    metric ``cls=`` label."""
+    if not raw:
+        return DEFAULT_CLASS
+    value = raw.strip().lower()
+    return value if value in CLASSES else DEFAULT_CLASS
+
+
+def from_headers(headers) -> str:
+    """The request's class from an HTTP header mapping (aiohttp
+    CIMultiDict or plain dict), already clamped."""
+    try:
+        raw = headers.get(HEADER, '')
+    except AttributeError:
+        raw = ''
+    return normalize(raw)
+
+
+def is_good(cls: str, ttft_seconds: float,
+            tpot_seconds: Optional[float]) -> bool:
+    """The goodput predicate: did this request complete within its
+    class's latency objective? ``tpot_seconds`` is None for
+    single-token requests — TTFT alone judges those."""
+    obj = OBJECTIVES.get(cls) or OBJECTIVES[DEFAULT_CLASS]
+    if ttft_seconds > obj.ttft_seconds:
+        return False
+    return tpot_seconds is None or tpot_seconds <= obj.tpot_seconds

@@ -58,11 +58,19 @@ logger = sky_logging.init_logger(__name__)
 STORED_FAMILIES = (
     'skytpu_engine_ttft_seconds',
     'skytpu_engine_tpot_seconds',
+    # Per-class mirrors + goodput (observe/request_class.py): the raw
+    # material for the goodput_<cls> SLO kinds and the loadgen
+    # scorecard's fleet-attributed per-class quantiles. Bounded: the
+    # cls label is the closed class registry.
+    'skytpu_engine_class_ttft_seconds',
+    'skytpu_engine_class_tpot_seconds',
+    'skytpu_engine_goodput_total',
     'skytpu_engine_queue_depth',
     'skytpu_engine_in_flight',
     'skytpu_engine_kv_pages_free',
     'skytpu_engine_requests_total',
     'skytpu_engine_tokens_total',
+    'skytpu_engine_prefix_requests_total',
 )
 
 # The synthetic per-target liveness series every round writes (1 on a
@@ -437,6 +445,13 @@ class ScrapeLoop:
         self.on_round = on_round
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # Serializes rounds: run_once() is also a public
+        # force-a-round API (controller right after replicas turn
+        # READY, the loadgen harness's settle()) and may be called
+        # from another thread while the loop thread is mid-round —
+        # on_round hooks (SLO evaluation mutates per-spec state
+        # machines) are not written for concurrent entry.
+        self._round_lock = threading.Lock()
 
     def start(self) -> None:
         if self._thread is not None:
@@ -453,15 +468,19 @@ class ScrapeLoop:
 
     def run_once(self) -> Dict[str, bool]:
         """One synchronous round + callback (tests; also lets a
-        controller force a round right after replicas turn READY)."""
-        results = self.scraper.scrape_round()
-        if self.on_round is not None:
-            try:
-                self.on_round(self.scraper)
-            except Exception:  # pylint: disable=broad-except
-                logger.warning('scrape on_round hook failed:',
-                               exc_info=True)
-        return results
+        controller force a round right after replicas turn READY).
+        Rounds are serialized: a forced round from another thread
+        waits out the loop thread's in-flight round instead of
+        racing its on_round hook."""
+        with self._round_lock:
+            results = self.scraper.scrape_round()
+            if self.on_round is not None:
+                try:
+                    self.on_round(self.scraper)
+                except Exception:  # pylint: disable=broad-except
+                    logger.warning('scrape on_round hook failed:',
+                                   exc_info=True)
+            return results
 
     def _run(self) -> None:
         while not self._stop.is_set():

@@ -45,12 +45,20 @@ from skypilot_tpu import sky_logging
 from skypilot_tpu.observe import journal
 from skypilot_tpu.observe import metrics as metrics_lib
 from skypilot_tpu.observe import promtext
+from skypilot_tpu.observe import request_class
 from skypilot_tpu.observe import tsdb
 
 logger = sky_logging.init_logger(__name__)
 
 # The closed set of SLO kinds — the declared, bounded metric label.
-KINDS = ('availability', 'ttft_p95', 'tpot_p95')
+# The per-class goodput kinds (goodput_<cls>, one per closed request
+# class) evaluate the engine's skytpu_engine_goodput_total counter as
+# windowed deltas: error fraction = slow / (good + slow) inside the
+# window — "what share of this class's finished requests missed their
+# class latency objective" — run through the same multi-window
+# burn-rate machinery as every other kind.
+KINDS = (('availability', 'ttft_p95', 'tpot_p95') +
+         request_class.GOODPUT_KINDS)
 STATES = ('ok', 'warning', 'breach')
 _STATE_CODE = {'ok': 0, 'warning': 1, 'breach': 2}
 
@@ -58,6 +66,7 @@ _KIND_FAMILY = {
     'ttft_p95': 'skytpu_engine_ttft_seconds',
     'tpot_p95': 'skytpu_engine_tpot_seconds',
 }
+GOODPUT_FAMILY = 'skytpu_engine_goodput_total'
 # scrape.UP_SERIES without importing scrape (slo must stay importable
 # standalone for the CLI; both modules pin this literal and
 # test_fleet asserts they agree).
@@ -131,6 +140,13 @@ def default_specs() -> List[SLOSpec]:
         SLOSpec(kind='availability', objective=0.999),
         SLOSpec(kind='ttft_p95', objective=0.95, threshold_seconds=2.5),
         SLOSpec(kind='tpot_p95', objective=0.95, threshold_seconds=0.25),
+    ] + [
+        # Per-class goodput: 99% of each class's finished requests
+        # meet their class latency objective (the objective itself —
+        # the TTFT/TPOT cut — lives in request_class.OBJECTIVES; this
+        # spec only sets how much missing is tolerable).
+        SLOSpec(kind=kind, objective=0.99)
+        for kind in request_class.GOODPUT_KINDS
     ]
 
 
@@ -172,7 +188,8 @@ def _series_delta(latest: Mapping[str, Tuple[float, float]],
 
 
 def _target_window_hist(latest_b, latest_c, latest_s, family: str,
-                        target: str, start: float
+                        target: str, start: float,
+                        label_filter: Optional[str] = None
                         ) -> Optional[promtext.HistogramData]:
     """One target's windowed histogram from its (already fetched)
     latest cumulative rounds and the anchor rounds at the window
@@ -196,6 +213,11 @@ def _target_window_hist(latest_b, latest_c, latest_s, family: str,
         rest_key, le = _split_le(labels)
         if le is not None:
             groups.setdefault(rest_key, []).append((le, delta))
+    if label_filter is not None:
+        # Restrict to ONE label set (canonical labels_text rendering,
+        # e.g. 'cls="interactive"') — the per-class quantile path.
+        groups = ({label_filter: groups[label_filter]}
+                  if label_filter in groups else {})
     per_label: List[promtext.HistogramData] = []
     for rest_key, buckets in groups.items():
         buckets.sort(key=lambda b: b[0])
@@ -213,7 +235,8 @@ def _target_window_hist(latest_b, latest_c, latest_s, family: str,
 
 def windowed_histograms(family: str, windows: List[float],
                         now: Optional[float] = None,
-                        targets: Optional[List[str]] = None
+                        targets: Optional[List[str]] = None,
+                        label_filter: Optional[str] = None
                         ) -> List[promtext.HistogramData]:
     """The fleet's histogram of ``family`` observations inside EACH
     window: per target, latest cumulative round minus the round at the
@@ -236,7 +259,8 @@ def windowed_histograms(family: str, windows: List[float],
         latest_s = tsdb.latest_round(f'{family}_sum', target)
         for i, window in enumerate(windows):
             hist = _target_window_hist(latest_b, latest_c, latest_s,
-                                       family, target, now - window)
+                                       family, target, now - window,
+                                       label_filter)
             if hist is not None:
                 per_window[i].append(hist)
     return [promtext.merge_histograms(shards) if shards else
@@ -246,11 +270,13 @@ def windowed_histograms(family: str, windows: List[float],
 
 def windowed_histogram(family: str, window: float,
                        now: Optional[float] = None,
-                       targets: Optional[List[str]] = None
+                       targets: Optional[List[str]] = None,
+                       label_filter: Optional[str] = None
                        ) -> promtext.HistogramData:
     """Single-window convenience over :func:`windowed_histograms`
     (the fleet CLI's offline path)."""
-    return windowed_histograms(family, [window], now, targets)[0]
+    return windowed_histograms(family, [window], now, targets,
+                               label_filter)[0]
 
 
 def availability_error_fraction(window: float,
@@ -289,6 +315,46 @@ def _availability_fractions(fast_window: float, slow_window: float,
 
     fast_cut = now - fast_window
     return frac([r for r in rows if r['ts'] >= fast_cut]), frac(rows)
+
+
+def goodput_fractions(cls: str, fast_window: float, slow_window: float,
+                      now: Optional[float] = None,
+                      targets: Optional[List[str]] = None
+                      ) -> Tuple[Optional[float], Optional[float],
+                                 Optional[float]]:
+    """(fast_error, slow_error, measured_goodput) for one request
+    class from windowed deltas of the engine goodput counter: error
+    fraction = slow / (good + slow) finished inside the window, i.e.
+    the share of the class's completed requests that missed their
+    latency objective. None with no finishes in the window — a silent
+    class has no goodput, good or bad. ``measured`` is the goodput
+    (good) fraction over the SLOW window, the scorecard column."""
+    now = time.time() if now is None else now
+    if targets is None:
+        targets = tsdb.targets(since=now - slow_window)
+    good_key = promtext.labels_text((('cls', cls), ('outcome', 'good')))
+    slow_key = promtext.labels_text((('cls', cls), ('outcome', 'slow')))
+    windows = (fast_window, slow_window)
+    sums = {w: [0.0, 0.0] for w in windows}          # [good, slow]
+    for target in targets:
+        latest = tsdb.latest_round(GOODPUT_FAMILY, target)
+        if not latest:
+            continue
+        for window in windows:
+            deltas = _series_delta(
+                latest,
+                tsdb.round_at_or_before(GOODPUT_FAMILY, target,
+                                        now - window))
+            acc = sums[window]
+            acc[0] += deltas.get(good_key, 0.0)
+            acc[1] += deltas.get(slow_key, 0.0)
+
+    def err(acc) -> Optional[float]:
+        total = acc[0] + acc[1]
+        return (acc[1] / total) if total > 0 else None
+
+    fast, slow = err(sums[fast_window]), err(sums[slow_window])
+    return fast, slow, (None if slow is None else 1.0 - slow)
 
 
 def latency_error_fraction(hist: promtext.HistogramData,
@@ -336,6 +402,7 @@ class SLOEngine:
         self._state: Dict[str, str] = {s.name: 'ok' for s in self.specs}
         self._clean_rounds: Dict[str, int] = {s.name: 0
                                               for s in self.specs}
+        self._last_evals: List[Evaluation] = []
         self._publish_states()
 
     # ------------------------------------------------------------ query
@@ -371,6 +438,10 @@ class SLOEngine:
                 spec.fast_window, spec.slow_window, now, targets)
             measured = None if slow is None else 1.0 - slow
             return fast, slow, measured
+        if spec.kind.startswith('goodput_'):
+            return goodput_fractions(
+                spec.kind[len('goodput_'):], spec.fast_window,
+                spec.slow_window, now, targets)
         family = _KIND_FAMILY[spec.kind]
         fast_h, slow_h = windowed_histograms(
             family, [spec.fast_window, spec.slow_window], now, targets)
@@ -470,6 +541,35 @@ class SLOEngine:
         for (kind, window), burn in burn_by_kind.items():
             _M_BURN.set(burn, slo=kind, window=window)
         self._publish_states()
+        self._last_evals = out
+        return out
+
+    def burn_summary(self) -> Dict[str, Dict[str, object]]:
+        """Per-KIND snapshot of the last ``evaluate()`` round —
+        ``{kind: {state, burn_fast, burn_slow, measured}}`` — for the
+        /-/fleet/status per-class columns and the loadgen scorecard.
+        When several specs share a kind the worst state wins and burns
+        take the max, mirroring the gauge aggregation. Empty before
+        the first evaluation."""
+        out: Dict[str, Dict[str, object]] = {}
+        for ev in self._last_evals:
+            kind = ev.spec.kind
+            row = out.get(kind)
+            if row is None:
+                row = {'state': ev.state, 'burn_fast': ev.burn_fast,
+                       'burn_slow': ev.burn_slow,
+                       'measured': ev.measured}
+                out[kind] = row
+                continue
+            if _STATE_CODE[ev.state] > _STATE_CODE[row['state']]:
+                row['state'] = ev.state
+            for field, value in (('burn_fast', ev.burn_fast),
+                                 ('burn_slow', ev.burn_slow)):
+                if value is not None and (row[field] is None or
+                                          value > row[field]):
+                    row[field] = value
+            if row['measured'] is None:
+                row['measured'] = ev.measured
         return out
 
     def _transition(self, spec: SLOSpec, old: str, new: str,

@@ -61,6 +61,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from skypilot_tpu import sky_logging
 from skypilot_tpu.observe import flight as flight_lib
 from skypilot_tpu.observe import metrics as metrics_lib
+from skypilot_tpu.observe import request_class
 from skypilot_tpu.observe import spans as spans_lib
 from skypilot_tpu.observe import trace as trace_lib
 from skypilot_tpu.utils import failpoints as failpoints_lib
@@ -138,6 +139,35 @@ _M_TPOT = metrics_lib.histogram(
     'Time per output token after the first (mean per request)',
     buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
              0.25, 0.5, 1.0, 2.5))
+# Per-class serving latency + goodput (observe/request_class.py): the
+# same publish-time observation as _M_TTFT/_M_TPOT, labeled by the
+# request's DECLARED class (clamped through the closed registry — the
+# LB stamps X-Skytpu-Class, submit_nowait normalizes again). Buckets
+# match the unlabeled families exactly so fleet merges and windowed
+# SLO deltas share one layout. Goodput counts a request 'good' only
+# when it completed within its class's latency objective
+# (request_class.OBJECTIVES) — the honest per-class unit the loadgen
+# scorecard and the per-class SLO burn rates are written in.
+_M_CLASS_TTFT = metrics_lib.histogram(
+    'skytpu_engine_class_ttft_seconds',
+    'Time to first token by request class (declared via '
+    'X-Skytpu-Class, clamped to the closed class registry)',
+    labels={'cls': request_class.CLASSES},
+    buckets=(0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0,
+             10.0, 30.0, 60.0))
+_M_CLASS_TPOT = metrics_lib.histogram(
+    'skytpu_engine_class_tpot_seconds',
+    'Time per output token after the first by request class',
+    labels={'cls': request_class.CLASSES},
+    buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+             0.25, 0.5, 1.0, 2.5))
+_M_GOODPUT = metrics_lib.counter(
+    'skytpu_engine_goodput_total',
+    'Finished requests by class and whether they met their class\'s '
+    'latency objective (good = TTFT and TPOT at/under the '
+    'request_class.OBJECTIVES bounds; slow = completed but missed '
+    'them)',
+    labels={'cls': request_class.CLASSES, 'outcome': ('good', 'slow')})
 # Block-paged KV cache (models/paging.py; docs/ENGINE.md): queueing vs
 # memory pressure must be distinguishable at /metrics — free/used page
 # gauges are sampled at scrape, the alloc counter splits admissions
@@ -167,6 +197,7 @@ _ENGINE_METRICS = (
     _M_QUEUE_DEPTH, _M_IN_FLIGHT, _M_STEPS, _M_TOKENS, _M_REQUESTS,
     _M_REJECTED, _M_PREFIX, _M_PREFIX_HITS, _M_SPEC_ROUNDS,
     _M_SPEC_PROPOSED, _M_SPEC_ACCEPTED, _M_TTFT, _M_TPOT,
+    _M_CLASS_TTFT, _M_CLASS_TPOT, _M_GOODPUT,
     _M_PAGES_FREE, _M_PAGES_USED, _M_PAGE_ALLOC, _M_ADMIT_WAIT)
 
 
@@ -183,6 +214,9 @@ def _seed_counter_zeros() -> None:
     _M_PREFIX.inc(0, outcome='miss')
     _M_PAGE_ALLOC.inc(0, outcome='ok')
     _M_PAGE_ALLOC.inc(0, outcome='wait')
+    for cls in request_class.CLASSES:
+        _M_GOODPUT.inc(0, cls=cls, outcome='good')
+        _M_GOODPUT.inc(0, cls=cls, outcome='slow')
 
 
 _seed_counter_zeros()
@@ -489,13 +523,15 @@ async def _submit_many(engine: InferenceEngine, prompts, max_new,
     cut via engine.cancel) — a 429'd request must not leave orphans
     decoding to max_tokens with no consumer."""
     temperature, top_k, top_p, pres, freq = sampling
+    cls = (request_class.from_headers(headers)
+           if headers is not None else request_class.DEFAULT_CLASS)
     futs = []
     try:
         for t in prompts:
             for _ in range(best_of):
                 futs.append(engine.submit_nowait(
                     t, max_new, temperature, top_k, top_p, pres, freq,
-                    stop_ids=stop_ids, want_tops=want_tops))
+                    stop_ids=stop_ids, want_tops=want_tops, cls=cls))
     except EngineOverloaded:
         for f in futs:
             engine.cancel(f)
@@ -738,8 +774,9 @@ class InferenceEngine:
         self.flight = flight_lib.FlightRecorder()
         # Request-timing sidecars, keyed by id(future) so the item
         # tuple (and the multi-host admit protocol built on its shape)
-        # stays untouched. _submit_meta: (monotonic_ns, wall) captured
-        # at enqueue; _timings: the finished request's decomposition,
+        # stays untouched. _submit_meta: (monotonic_ns, wall,
+        # normalized class) captured at enqueue; _timings: the
+        # finished request's decomposition,
         # picked up by the HTTP handlers (engine.pop_timing) which
         # record the engine spans OFF the batch loop. Both bounded:
         # entries whose handler never collects them (failed or
@@ -1579,7 +1616,8 @@ class InferenceEngine:
                       frequency_penalty: float = 0.0,
                       stop_ids: Tuple[int, ...] = (),
                       want_tops: bool = False,
-                      stream_q: Optional[asyncio.Queue] = None
+                      stream_q: Optional[asyncio.Queue] = None,
+                      cls: str = request_class.DEFAULT_CLASS
                       ) -> asyncio.Future:
         """Enqueue a request; returns the future resolving to
         (tokens, finish_reason, chosen_token_logprobs). Raises
@@ -1587,7 +1625,10 @@ class InferenceEngine:
         (surfaced as 429) — the queue never grows without limit under
         overload. `want_tops`: the request asked for top-N alternative
         logprobs, so steps serving it must run the want_tops compiled
-        variant (chosen-token logprobs are always recorded)."""
+        variant (chosen-token logprobs are always recorded). `cls`:
+        the request's declared class — clamped here through the closed
+        registry even though the LB already clamped the header, so a
+        replica addressed directly can never mint a label value."""
         fut: asyncio.Future = asyncio.get_running_loop().create_future()
         try:
             self._queue.put_nowait((tokens, max_new, temperature, top_k,
@@ -1601,9 +1642,12 @@ class InferenceEngine:
                 f'admission queue full ({MAX_QUEUE} waiting)') from None
         # Submit timestamp pair: the monotonic ns aligns with the flight
         # ring's clock (queue-wait/TTFT deltas), the wall clock anchors
-        # the recorded spans cross-process. Bounded: a queued item whose
-        # future is cancelled before admission never pops its entry.
-        self._submit_meta[id(fut)] = (time.monotonic_ns(), time.time())
+        # the recorded spans cross-process; the normalized class rides
+        # along to the slot entry for publish-time per-class telemetry.
+        # Bounded: a queued item whose future is cancelled before
+        # admission never pops its entry.
+        self._submit_meta[id(fut)] = (time.monotonic_ns(), time.time(),
+                                      request_class.normalize(cls))
         while len(self._submit_meta) > 4096:
             self._submit_meta.pop(next(iter(self._submit_meta)))
         self.requests_total += 1
@@ -1824,6 +1868,8 @@ class InferenceEngine:
                  'ctx': list(tokens) + [first],
                  't_submit_ns': meta[0] if meta else None,
                  't_submit_wall': meta[1] if meta else None,
+                 'cls': (meta[2] if meta
+                         else request_class.DEFAULT_CLASS),
                  't_admit_ns': getattr(self, '_admit_t0_ns', now_ns),
                  't_first_ns': now_ns}
         if first in stop:
@@ -2513,6 +2559,16 @@ class InferenceEngine:
         _M_TTFT.observe(ttft)
         if tpot is not None:
             _M_TPOT.observe(tpot)
+        # Per-class mirror + goodput judgment — `cls` entered the slot
+        # already clamped to the closed registry at submit_nowait.
+        cls = s.get('cls', request_class.DEFAULT_CLASS)
+        _M_CLASS_TTFT.observe(ttft, cls=cls)
+        if tpot is not None:
+            _M_CLASS_TPOT.observe(tpot, cls=cls)
+        _M_GOODPUT.inc(
+            cls=cls,
+            outcome=('good' if request_class.is_good(cls, ttft, tpot)
+                     else 'slow'))
         if s['fut'] is not None:
             self._timings[id(s['fut'])] = {
                 'submit_wall': s['t_submit_wall'], 'queue_s': queue_s,
@@ -2954,6 +3010,7 @@ async def _sse_response(request, engine: InferenceEngine,
              [stop_strings] if isinstance(stop_strings, str)
              else list(stop_strings))
     hold = max((len(s) for s in stops), default=0) - 1
+    cls = request_class.from_headers(request.headers)
     choices: List[_SseChoice] = []
     try:
         for idx, tokens in enumerate(prompts):
@@ -2963,7 +3020,7 @@ async def _sse_response(request, engine: InferenceEngine,
                                        stop_ids=stop_ids,
                                        want_tops=(want_logprobs and
                                                   top_n > 0),
-                                       stream_q=q)
+                                       stream_q=q, cls=cls)
             choices.append(_SseChoice(engine, idx, fut, q))
     except EngineOverloaded as e:
         # All-or-nothing like _submit_many: cancel enqueued siblings.
@@ -3190,8 +3247,9 @@ def build_app(engine: InferenceEngine):
             return web.json_response({'error': f'bad sampling params: {e}'},
                                      status=400)
         try:
-            fut = engine.submit_nowait(tokens, max_new, *sampling,
-                                       stop_ids=stop_ids)
+            fut = engine.submit_nowait(
+                tokens, max_new, *sampling, stop_ids=stop_ids,
+                cls=request_class.from_headers(request.headers))
             out, finish, lps, _tops = await fut
         except EngineOverloaded as e:
             return web.json_response({'error': str(e)}, status=429)

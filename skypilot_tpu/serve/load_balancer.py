@@ -65,6 +65,7 @@ from skypilot_tpu import sky_logging
 from skypilot_tpu.observe import journal as journal_lib
 from skypilot_tpu.observe import metrics as metrics_lib
 from skypilot_tpu.observe import promtext
+from skypilot_tpu.observe import request_class
 from skypilot_tpu.observe import spans as spans_lib
 from skypilot_tpu.observe import trace as trace_lib
 from skypilot_tpu.serve import load_balancing_policies as lb_policies
@@ -99,6 +100,13 @@ _LB_RETRIES = metrics_lib.counter(
     'reason that caused the retry (idempotent-safe attempts only: no '
     'response bytes had reached the client).',
     labels={'reason': _RETRY_REASONS})
+_LB_CLASS_REQUESTS = metrics_lib.counter(
+    'skytpu_lb_class_requests_total',
+    'Requests entering the LB by declared request class '
+    '(X-Skytpu-Class, clamped through the closed class registry '
+    'before it can reach any label set) — the offered-load side the '
+    'loadgen scorecard reconciles against engine-side goodput.',
+    labels={'cls': request_class.CLASSES})
 _BREAKER_STATES = ('closed', 'open', 'half_open')
 _LB_BREAKER_STATE = metrics_lib.gauge(
     'skytpu_lb_breaker_state',
@@ -442,12 +450,30 @@ class LoadBalancer:
                 {'error': 'no ready replicas'}, status=503)
         t0 = time.monotonic()
         body = await request.read()
+        # Request class: clamp the client-supplied X-Skytpu-Class
+        # through the closed registry HERE, at the trust boundary —
+        # an unknown value becomes 'other', never a new label value
+        # (the X-Skytpu-Trace-Id hardening precedent). The clamped
+        # value is counted as offered load and re-stamped on the
+        # upstream call below (the raw header is stripped with the
+        # rest of x-skytpu-*).
+        cls = request_class.from_headers(request.headers)
+        _LB_CLASS_REQUESTS.inc(cls=cls)
+        root.set_attr('cls', cls)
         with spans_lib.span('lb.pick', entity=self.service_name):
             # Key extraction (a JSON parse) only when the policy uses
             # it; the replica actually chosen is recorded per attempt
-            # on the lb.upstream span (retries may reroute).
-            key = (_affinity_key(request, body)
-                   if self.policy.wants_affinity_key else None)
+            # on the lb.upstream span (retries may reroute). An
+            # explicit session id (X-Skytpu-Session) beats the
+            # prompt-head heuristic: the consistent-hash ring then
+            # pins the whole session even when its prompts diverge
+            # past the affinity head.
+            key = None
+            if self.policy.wants_affinity_key:
+                session = request.headers.get('X-Skytpu-Session',
+                                              '').strip()
+                key = (session[:128] if session
+                       else _affinity_key(request, body))
         if self._session is None:
             self._session = aiohttp.ClientSession(
                 timeout=aiohttp.ClientTimeout(
@@ -464,6 +490,10 @@ class LoadBalancer:
         headers = {k: v for k, v in request.headers.items()
                    if k.lower() not in _HOP_HEADERS
                    and not k.lower().startswith('x-skytpu-')}
+        # Stamp the CLAMPED class (the raw client header was stripped
+        # above): the engine labels its per-class TTFT/TPOT/goodput
+        # off this value, and normalizes again on arrival.
+        headers[request_class.HEADER] = cls
         try:
             return await self._proxy_attempts(request, root, key,
                                               body, headers)
@@ -722,9 +752,63 @@ class LoadBalancer:
                 status=503, headers={'Retry-After': '5'})
         return web.Response(text=text, content_type='text/plain')
 
+    def _class_table(self) -> Dict[str, Dict[str, object]]:
+        """Per-class scorecard columns from the merged fleet families:
+        goodput good/slow totals, goodput fraction, TTFT/TPOT p95 —
+        every read is a tolerant .get, because a class with no traffic
+        yet simply has no label set in the merged document (and the
+        table must render, not KeyError). Burn columns join from the
+        SLO engine when one is attached."""
+        try:
+            fams = self._scraper.fleet_families()
+        except ValueError:
+            # BucketMismatchError mid rolling update: no class table
+            # this round rather than a 500 on the status endpoint.
+            return {}
+        counts: Dict[str, Dict[str, float]] = {}
+        goodput = fams.get('skytpu_engine_goodput_total')
+        if goodput is not None:
+            for s in goodput.samples:
+                labels = dict(s.labels)
+                c, outcome = labels.get('cls'), labels.get('outcome')
+                if c is None or outcome is None:
+                    continue
+                per = counts.setdefault(c, {})
+                per[outcome] = per.get(outcome, 0.0) + s.value
+        hists = {
+            short: promtext.extract_histograms(fams, family)
+            for family, short in
+            (('skytpu_engine_class_ttft_seconds', 'ttft'),
+             ('skytpu_engine_class_tpot_seconds', 'tpot'))}
+        burns = (self._slo_engine.burn_summary()
+                 if self._slo_engine is not None else {})
+        out: Dict[str, Dict[str, object]] = {}
+        for cls in request_class.CLASSES:
+            per = counts.get(cls, {})
+            good = per.get('good', 0.0)
+            slow = per.get('slow', 0.0)
+            row: Dict[str, object] = {'good': good, 'slow': slow}
+            total = good + slow
+            row['goodput'] = (round(good / total, 4) if total else None)
+            for short, by_label in hists.items():
+                hist = by_label.get((('cls', cls),))
+                if hist is None:
+                    continue
+                v = promtext.histogram_quantile(hist, 0.95)
+                if v == v:                        # not NaN
+                    row[f'{short}_p95_ms'] = round(v * 1e3, 2)
+            burn = burns.get(f'goodput_{cls}')
+            if burn is not None:
+                row.update({'state': burn.get('state'),
+                            'burn_fast': burn.get('burn_fast'),
+                            'burn_slow': burn.get('burn_slow')})
+            out[cls] = row
+        return out
+
     async def _fleet_status(self, request: web.Request) -> web.Response:
-        """Per-replica scrape/saturation table + SLO states — the
-        ``observe fleet`` CLI's data source."""
+        """Per-replica scrape/saturation table + SLO states + the
+        per-class goodput/burn scorecard columns — the ``observe
+        fleet`` CLI's data source."""
         del request
         if self._scraper is None:
             return web.json_response(
@@ -733,6 +817,7 @@ class LoadBalancer:
         doc = {'service': self.service_name, 'replicas': replicas}
         if self._slo_engine is not None:
             doc['slo'] = self._slo_engine.states()
+        doc['classes'] = await asyncio.to_thread(self._class_table)
         return web.json_response(doc)
 
     # ------------------------------------------------------------------

@@ -5,10 +5,12 @@ Reference analog: sky/serve/load_balancing_policies.py
 """
 from __future__ import annotations
 
+import bisect
 import hashlib
 import itertools
+import math
 import threading
-from typing import Dict, List, Optional
+from typing import Dict, Iterator, List, Optional
 
 from skypilot_tpu.utils import registry
 
@@ -145,40 +147,123 @@ class InstanceAwareLeastLoadPolicy(LeastLoadPolicy):
                                self._weights.get(u, 1.0)))
 
 
-@registry.LB_POLICY_REGISTRY.register(name='prefix_affinity')
-class PrefixAffinityPolicy(LeastLoadPolicy):
-    """Rendezvous-hash requests sharing a prompt prefix onto the same
-    replica, so per-replica prefix KV caches (serve/engine.py) keep
-    hitting — the chat pattern (same system prompt / growing history)
-    stays warm on one replica instead of spraying across the fleet.
+class _HashRing:
+    """A deterministic consistent-hash ring over replica URLs.
 
-    Net-new vs the reference (its LB policies are load-only); the
-    analog in big serving stacks is vLLM router session affinity.
-
-    Rendezvous (highest-random-weight) hashing keeps the mapping stable
-    under replica churn: removing a replica remaps ONLY the keys that
-    lived on it. A load guard falls back to least-load when the
-    affinity target is overloaded relative to the fleet (affinity must
-    never become a hot-spot amplifier).
+    Determinism is the whole point: ring points are md5 of
+    ``<url>#<vnode>`` — a pure function of the replica set — so a
+    REBUILT ring (LB restart, controller failover) maps every key to
+    the same replica as its predecessor, with no state to persist or
+    hand off. VNODES points per replica smooth arc sizes so removing
+    one replica spreads its keys roughly evenly over the survivors
+    instead of dumping them on one neighbor.
     """
 
-    # Fall back to least-load when the affinity target has this many
-    # more in-flight requests than the least-loaded replica.
-    HOTSPOT_SLACK = 4
+    VNODES = 64
+
+    def __init__(self, urls: List[str]):
+        points = []
+        for url in sorted(set(urls)):
+            for i in range(self.VNODES):
+                points.append((self._point(f'{url}#{i}'), url))
+        points.sort()
+        self._points = points
+        self._hashes = [h for h, _ in points]
+
+    @staticmethod
+    def _point(key: str) -> int:
+        return int.from_bytes(hashlib.md5(key.encode()).digest()[:8],
+                              'big')
+
+    def walk(self, key: str) -> Iterator[str]:
+        """Replica URLs clockwise from the key's ring position, each
+        DISTINCT replica yielded once — the bounded-load probe order.
+        The first yield is the key's home replica; later yields are
+        the deterministic spill order when the home is over the load
+        bound."""
+        n = len(self._points)
+        if n == 0:
+            return
+        start = bisect.bisect_right(self._hashes, self._point(key))
+        seen = set()
+        for step in range(n):
+            _, url = self._points[(start + step) % n]
+            if url not in seen:
+                seen.add(url)
+                yield url
+
+
+@registry.LB_POLICY_REGISTRY.register(name='prefix_affinity',
+                                      aliases=['consistent_hash'])
+class PrefixAffinityPolicy(LeastLoadPolicy):
+    """Bounded-load consistent hashing: requests sharing a session (or
+    prompt-prefix) key land on one replica, so per-replica prefix KV
+    caches (serve/engine.py) keep hitting — the chat pattern (same
+    system prompt / growing history) stays warm on one replica instead
+    of spraying across the fleet.
+
+    Two properties the earlier rendezvous+slack version lacked, both
+    exposed the moment a replayable load harness measured them
+    (skypilot_tpu/loadgen):
+
+      * RESTART-STABLE: the ring is a pure function of the replica
+        set (_HashRing), so a restarted LB (fresh in-flight counts,
+        fresh policy object) routes every session exactly where the
+        old process did — sessions keep their hot prefix pages through
+        rolling updates and controller failover. The old version's
+        in-flight-delta fallback made post-restart routing depend on
+        arrival order.
+      * LOAD-BOUNDED (the consistent-hashing-with-bounded-loads
+        recipe): a replica accepts an affinity request only while its
+        in-flight count stays within LOAD_BOUND x the fleet's mean;
+        past that, the walk spills to the NEXT ring replica — itself
+        deterministic — so a Zipf-popular session can never turn
+        affinity into a hot-spot amplifier, and the spill target is
+        stable rather than "whichever replica was coolest".
+
+    Churn behavior is the classic consistent-hash guarantee: removing
+    a replica remaps only the keys that lived on it; adding one steals
+    only the arcs it now owns.
+    """
+
+    # Max in-flight on a replica relative to a perfectly even spread
+    # before an affinity request spills to the next ring replica
+    # (c in the bounded-load literature; 1.25 keeps p99 load within
+    # ~25% of mean while remapping few keys).
+    LOAD_BOUND = 1.25
     wants_affinity_key = True
+
+    def __init__(self) -> None:
+        super().__init__()
+        self._ring = _HashRing([])
+
+    def set_ready_replicas(self, urls: List[str]) -> None:
+        ring = _HashRing(urls)          # built outside the lock
+        with self._lock:
+            self._replicas = list(urls)
+            self._in_flight = {
+                u: self._in_flight.get(u, 0) for u in urls
+            }
+            self._ring = ring
+
+    def _capacity(self) -> int:
+        """Per-replica admission bound: ceil(c * (total_in_flight + 1)
+        / n). The +1 counts the request being placed, so a single
+        replica fleet (mean == its own load) always admits."""
+        total = sum(self._in_flight.get(u, 0) for u in self._replicas)
+        return math.ceil(self.LOAD_BOUND * (total + 1) /
+                         len(self._replicas))
 
     def select(self, affinity_key: Optional[str] = None) -> Optional[str]:
         with self._lock:
             if not self._replicas:
                 return None
-            coolest = min(self._replicas, key=self._load_key)
             if affinity_key is None:
-                return coolest
-            target = max(
-                self._replicas,
-                key=lambda u: hashlib.md5(
-                    f'{affinity_key}\x00{u}'.encode()).digest())
-            if (self._in_flight.get(target, 0) -
-                    self._in_flight.get(coolest, 0)) > self.HOTSPOT_SLACK:
-                return coolest
-            return target
+                return min(self._replicas, key=self._load_key)
+            capacity = self._capacity()
+            for url in self._ring.walk(affinity_key):
+                if self._in_flight.get(url, 0) + 1 <= capacity:
+                    return url
+            # Every replica at the bound (only possible transiently —
+            # capacity tracks total load): plain least-load.
+            return min(self._replicas, key=self._load_key)

@@ -1,0 +1,84 @@
+"""The traffic harness END TO END on a live CPU stack (the ISSUE 12
+acceptance arc). Marked slow — two real engine subprocesses warm up
+inside it — so tier-1 (-m 'not slow') skips it; run explicitly:
+
+    JAX_PLATFORMS=cpu pytest tests/chaos/test_loadgen_e2e.py -m slow
+
+One test, one CLI invocation, every contract checked on the artifact:
+
+  * the scorecard's per-class TTFT/TPOT quantiles are FLEET-attributed
+    (present for every class the schedule offered, parsed from
+    /-/fleet/metrics — client stopwatches are labeled secondary);
+  * goodput books balance: fleet-side good+slow equals the client's
+    completed count, and the burn/state columns agree with the SLO
+    engine's journaled slo_* events;
+  * the run replays: the scorecard's schedule hash equals a --dry-run
+    of the same (profile, seed);
+  * the consistent-hash evidence rides along: restart stability >= 0.9
+    with zero load-bound violations, and the live mid-run LB restart
+    (churn scenario) did not collapse the prefix hit rate.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def test_loadgen_harness_end_to_end(tmp_path):
+    report = tmp_path / 'scorecard.json'
+    env = {**os.environ, 'JAX_PLATFORMS': 'cpu', 'PYTHONPATH': REPO,
+           'SKYTPU_OBSERVE_DB': str(tmp_path / 'observe.db')}
+    run = subprocess.run(
+        [sys.executable, '-m', 'skypilot_tpu.loadgen',
+         '--seed', '7', '--profile', 'smoke', '--local-stack', '2',
+         '--run-dir', str(tmp_path), '--report', str(report)],
+        capture_output=True, text=True, env=env, timeout=560)
+    assert run.returncode == 0, run.stderr[-2000:]
+    card = json.loads(report.read_text())
+
+    # Replay contract: the live run's hash is the dry-run's hash.
+    dry = json.loads(subprocess.run(
+        [sys.executable, '-m', 'skypilot_tpu.loadgen',
+         '--seed', '7', '--profile', 'smoke', '--dry-run'],
+        capture_output=True, text=True, env=env, check=True).stdout)
+    assert card['schedule_hash'] == dry['schedule_hash']
+
+    # Fleet-attributed per-class columns for every offered class.
+    offered = card['offered']['by_class']
+    fleet = card['fleet']['by_class']
+    for cls, truth in offered.items():
+        row = fleet[cls]
+        assert row['ttft_p95_ms'] > 0, cls
+        # Books balance: every offered request finished and was judged.
+        assert row['good'] + row['slow'] == truth['requests'], cls
+    assert card['client']['errors'] == 0
+    assert (sum(r['good'] + r['slow'] for r in fleet.values()) ==
+            card['client']['completed'])
+
+    # Burn/state columns agree with the journaled SLO events: any
+    # class in a non-ok state must have a matching slo_* event whose
+    # payload names it (and vice versa for breach events).
+    states = card['slo']['states']
+    events = card.get('slo_events') or []
+    for kind, state in states.items():
+        if state != 'ok':
+            assert any(e['data']['kind'] == kind for e in events), kind
+    for e in events:
+        assert e['data']['kind'] in states
+
+    # Consistent-hash evidence: restart stability with the bound held,
+    # and the live LB restart didn't collapse prefix hits (phase 2
+    # serves warmed sessions, so its hit rate must not drop below the
+    # cold phase's).
+    routing = card['routing']
+    assert routing['restart_stability'] >= 0.9
+    assert routing['bound_violations'] == 0
+    churn = routing['live_churn']
+    assert churn['phase2']['hit_rate'] >= churn['phase1']['hit_rate']

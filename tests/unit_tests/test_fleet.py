@@ -515,6 +515,69 @@ class TestSLOEngine:
         with pytest.raises(ValueError, match='SKYTPU_SLO_SPECS'):
             slo_lib.default_specs()
 
+    def _goodput_rows(self, cls, good, slow):
+        return [('skytpu_engine_goodput_total',
+                 f'cls="{cls}",outcome="good"', float(good)),
+                ('skytpu_engine_goodput_total',
+                 f'cls="{cls}",outcome="slow"', float(slow))]
+
+    def test_goodput_kind_burns_on_window_deltas(self):
+        """A per-class goodput SLO evaluates the engine goodput
+        counter's WINDOW deltas (slow/finished), merged across
+        replicas — and pre-window misses cannot re-breach it."""
+        spec = slo_lib.SLOSpec(kind='goodput_interactive',
+                               objective=0.9, fast_window=100.0,
+                               slow_window=300.0, fast_burn=2.0,
+                               slow_burn=1.0)
+        engine = slo_lib.SLOEngine([spec], entity='svc')
+        now = time.time()
+        # Ancient misses (before the window) + anchors at the window
+        # start; then 10 new finishes, 5 of them slow → 50% misses.
+        for target in ('svc/0', 'svc/1'):
+            tsdb.insert_samples(
+                target, self._goodput_rows('interactive', 10, 40),
+                ts=now - 1000)
+            tsdb.insert_samples(
+                target, self._goodput_rows('interactive', 10, 40),
+                ts=now - 90)
+            tsdb.insert_samples(
+                target, self._goodput_rows('interactive', 15, 45),
+                ts=now - 5)
+        fast, slow, measured = slo_lib.goodput_fractions(
+            'interactive', 100.0, 300.0, now)
+        assert fast == pytest.approx(0.5)   # only the window's deltas
+        assert measured == pytest.approx(0.5)
+        engine.evaluate(now)
+        assert engine.state('goodput_interactive') == 'breach'
+        breach = journal.query(kind='slo_breach')[0]
+        assert breach['data']['kind'] == 'goodput_interactive'
+        summary = engine.burn_summary()
+        assert summary['goodput_interactive']['state'] == 'breach'
+        assert summary['goodput_interactive']['burn_fast'] >= 2.0
+
+    def test_goodput_kind_no_traffic_holds_state(self):
+        """A class with NO finishes in the window has no goodput —
+        good or bad. The spec holds ok (no-data-is-not-zero-burn),
+        and a DIFFERENT class's misses never bleed across."""
+        specs = [slo_lib.SLOSpec(kind='goodput_batch', objective=0.9,
+                                 fast_window=100.0, slow_window=300.0,
+                                 fast_burn=2.0, slow_burn=1.0)]
+        engine = slo_lib.SLOEngine(specs, entity='svc')
+        now = time.time()
+        tsdb.insert_samples(
+            'svc/0', self._goodput_rows('interactive', 0, 50),
+            ts=now - 5)
+        evals = engine.evaluate(now)
+        assert engine.state('goodput_batch') == 'ok'
+        assert evals[0].burn_fast is None
+        assert not journal.query(kind='slo_breach')
+
+    def test_default_specs_include_per_class_goodput(self):
+        from skypilot_tpu.observe import request_class
+        kinds = {s.kind for s in slo_lib.default_specs()}
+        for cls in request_class.CLASSES:
+            assert f'goodput_{cls}' in kinds
+
 
 # ------------------------------------------- saturation autoscaler + LB
 
@@ -672,3 +735,32 @@ class TestFleetCLI:
         assert 'ttft_p95_ms' in doc['fleet_quantiles']
         assert doc['fleet_quantiles']['ttft_p95_ms'] > \
             doc['fleet_quantiles']['ttft_p50_ms']
+        # Per-class columns render for EVERY registered class, with
+        # sample-less classes as empty rows — never a KeyError on a
+        # missing label set.
+        from skypilot_tpu.observe import request_class
+        assert set(doc['classes']) == set(request_class.CLASSES)
+        assert doc['classes']['batch'] == {}
+
+    def test_offline_fleet_doc_renders_class_goodput(self, fleet_env):
+        now = time.time()
+        rows = [('skytpu_engine_goodput_total',
+                 'cls="interactive",outcome="good"', 9.0),
+                ('skytpu_engine_goodput_total',
+                 'cls="interactive",outcome="slow"', 1.0),
+                (scrape.UP_SERIES, '', 1.0)]
+        tsdb.insert_samples('svc/0', rows, ts=now - 5)
+        out = subprocess.run(
+            [sys.executable, '-m', 'skypilot_tpu.observe', 'fleet',
+             '--db', str(fleet_env / 'observe.db'), '--json'],
+            capture_output=True, text=True, check=True)
+        doc = json.loads(out.stdout)
+        assert doc['classes']['interactive']['goodput'] == 0.9
+        assert doc['classes']['interactive']['miss_fraction'] == 0.1
+        # The human table renders too (no KeyError on sparse rows).
+        out = subprocess.run(
+            [sys.executable, '-m', 'skypilot_tpu.observe', 'fleet',
+             '--db', str(fleet_env / 'observe.db')],
+            capture_output=True, text=True, check=True)
+        assert 'interactive' in out.stdout
+        assert 'goodput' in out.stdout

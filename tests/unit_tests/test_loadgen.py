@@ -1,0 +1,363 @@
+"""The traffic harness: schedule determinism, worker-count
+independence, the closed class registry, consistent-hash routing
+properties, and scorecard assembly.
+
+Five angles:
+  1. schedule — same (profile, seed) => byte-identical schedules and
+     hashes across two builds and across the CLI; different seeds
+     diverge; offered truth balances against the schedule.
+  2. runner — replaying the same schedule at --workers 1 and
+     --workers 4 against a live stub server delivers the IDENTICAL
+     request set (and the hash, computed pre-send, cannot move);
+     every request carries its clamped class + session headers.
+  3. request classes — normalize() clamps unknown/hostile values to
+     'other', never a new label; the goodput predicate honors each
+     class's objective.
+  4. routing — the routing drill's contract numbers: restart
+     stability >= 0.9 under Zipfian popularity with the load bound
+     never exceeded; churn remaps only the removed replica's
+     sessions (within spill noise).
+  5. scorecard — fleet_section reads per-class quantiles/goodput from
+     real exposition text and renders classes with NO samples as rows
+     (no KeyError); diff_scorecards trips on hash changes and goodput
+     collapse, passes a faithful replay.
+"""
+import http.server
+import json
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from skypilot_tpu.observe import metrics
+from skypilot_tpu.observe import request_class
+from skypilot_tpu.loadgen import harness as harness_lib
+from skypilot_tpu.loadgen import report as report_lib
+from skypilot_tpu.loadgen import schedule as schedule_lib
+
+
+# ----------------------------------------------------------- schedule
+
+class TestScheduleDeterminism:
+
+    def test_same_seed_bit_identical(self):
+        a = schedule_lib.build_schedule(schedule_lib.PROFILES['smoke'],
+                                        seed=7)
+        b = schedule_lib.build_schedule(schedule_lib.PROFILES['smoke'],
+                                        seed=7)
+        assert a == b
+        assert (schedule_lib.schedule_hash(a) ==
+                schedule_lib.schedule_hash(b))
+
+    def test_different_seed_diverges(self):
+        p = schedule_lib.PROFILES['smoke']
+        assert (schedule_lib.schedule_hash(
+                    schedule_lib.build_schedule(p, seed=1)) !=
+                schedule_lib.schedule_hash(
+                    schedule_lib.build_schedule(p, seed=2)))
+
+    def test_cli_dry_run_replays(self):
+        outs = [subprocess.run(
+            [sys.executable, '-m', 'skypilot_tpu.loadgen',
+             '--seed', '11', '--profile', 'smoke', '--dry-run'],
+            capture_output=True, text=True, check=True).stdout
+            for _ in range(2)]
+        assert outs[0] == outs[1]
+        doc = json.loads(outs[0])
+        assert doc['schedule_hash']
+        assert doc['requests'] == 36
+
+    def test_schedule_shape(self):
+        profile = schedule_lib.PROFILES['smoke']
+        sched = schedule_lib.build_schedule(profile, seed=3)
+        assert len(sched) == profile.requests
+        # Sorted arrivals inside the declared duration.
+        times = [s.t for s in sched]
+        assert times == sorted(times)
+        assert all(0.0 <= t <= profile.duration_s for t in times)
+        # Every class drawn from the closed registry; sessions carry
+        # their tenant prefix; prompts = session prefix + suffix.
+        for spec in sched:
+            assert spec.cls in request_class.CLASSES
+            assert spec.session.startswith(spec.tenant)
+            shape = profile.classes[spec.cls]
+            assert len(spec.tokens) == (shape.prefix_len +
+                                        shape.suffix_len)
+        # Same (session, cls) pairs share their prefix block — the
+        # prefix-reuse contract the affinity routing exists for.
+        by_key = {}
+        for spec in sched:
+            prefix = spec.tokens[:profile.classes[spec.cls].prefix_len]
+            prior = by_key.setdefault((spec.session, spec.cls), prefix)
+            assert prior == prefix
+
+    def test_offered_truth_balances(self):
+        sched = schedule_lib.build_schedule(
+            schedule_lib.PROFILES['smoke'], seed=5)
+        truth = schedule_lib.offered_truth(sched)
+        assert (sum(r['requests']
+                    for r in truth['by_class'].values()) == len(sched))
+        assert (sum(r['requests']
+                    for r in truth['by_class_phase'].values()) ==
+                len(sched))
+
+    def test_unknown_class_in_profile_refused(self):
+        import dataclasses
+        base = schedule_lib.PROFILES['smoke']
+        bad = dataclasses.replace(base, classes={
+            'vip': schedule_lib.ClassShape(8, 4, 4, 1.0)})
+        with pytest.raises(ValueError, match='closed registry'):
+            schedule_lib.build_schedule(bad, seed=0)
+
+    def test_resolve_profile_overrides_and_unknown(self):
+        p = schedule_lib.resolve_profile('smoke', requests=10)
+        assert p.requests == 10
+        assert schedule_lib.resolve_profile('smoke').requests == 36
+        with pytest.raises(ValueError, match='unknown profile'):
+            schedule_lib.resolve_profile('nope')
+
+
+# ------------------------------------------------------------- runner
+
+class _StubEngine:
+    """A live /generate + /v1/completions SSE stub recording every
+    request's payload and class/session headers."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.seen = []
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = 'HTTP/1.1'
+
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get('Content-Length', 0))
+                body = json.loads(self.rfile.read(n))
+                with outer.lock:
+                    outer.seen.append({
+                        'path': self.path,
+                        'tokens': tuple(body.get('tokens') or
+                                        body.get('prompt') or ()),
+                        'cls': self.headers.get(request_class.HEADER),
+                        'session': self.headers.get('X-Skytpu-Session'),
+                    })
+                if self.path == '/v1/completions':
+                    payload = (b'data: {"choices": [{"text": "x"}]}'
+                               b'\n\ndata: [DONE]\n\n')
+                    self.send_response(200)
+                    self.send_header('Content-Type',
+                                     'text/event-stream')
+                    self.send_header('Content-Length',
+                                     str(len(payload)))
+                    self.end_headers()
+                    self.wfile.write(payload)
+                    return
+                payload = json.dumps(
+                    {'tokens': [1], 'finish_reason': 'length',
+                     'logprobs': [0.0]}).encode()
+                self.send_response(200)
+                self.send_header('Content-Type', 'application/json')
+                self.send_header('Content-Length', str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+        self.server = http.server.ThreadingHTTPServer(
+            ('127.0.0.1', 0), Handler)
+        self.url = f'http://127.0.0.1:{self.server.server_address[1]}'
+        self._thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def snapshot(self):
+        with self.lock:
+            return sorted(self.seen,
+                          key=lambda d: (d['session'], d['tokens']))
+
+    def reset(self):
+        with self.lock:
+            self.seen = []
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+        self._thread.join(timeout=5)
+
+
+class TestRunnerWorkerIndependence:
+
+    def test_workers_1_vs_4_identical_request_set(self):
+        import asyncio
+
+        from skypilot_tpu.loadgen import client as client_lib
+
+        profile = schedule_lib.resolve_profile('smoke', requests=16,
+                                               duration_s=0.2)
+        sched = schedule_lib.build_schedule(profile, seed=9)
+        want_hash = schedule_lib.schedule_hash(sched)
+        stub = _StubEngine()
+        try:
+            seen = {}
+            for workers in (1, 4):
+                stub.reset()
+                run = asyncio.run(client_lib.run_schedule(
+                    stub.url, sched, workers=workers))
+                assert run.completed() == len(sched)
+                assert run.errors() == 0
+                seen[workers] = stub.snapshot()
+            assert seen[1] == seen[4]
+            # The hash is computed over the PRE-SEND schedule — the
+            # replay contract cannot depend on delivery concurrency.
+            assert schedule_lib.schedule_hash(sched) == want_hash
+            # Every request carried its clamped class + session.
+            for row in seen[1]:
+                assert row['cls'] in request_class.CLASSES
+                assert row['session']
+        finally:
+            stub.stop()
+
+
+# ------------------------------------------------------ class registry
+
+class TestRequestClassRegistry:
+
+    def test_normalize_clamps_to_closed_set(self):
+        assert request_class.normalize('interactive') == 'interactive'
+        assert request_class.normalize('  Interactive ') == \
+            'interactive'
+        assert request_class.normalize('vip-tier') == 'other'
+        assert request_class.normalize('') == 'other'
+        assert request_class.normalize(None) == 'other'
+        assert request_class.normalize('x' * 10000) == 'other'
+
+    def test_from_headers(self):
+        assert request_class.from_headers(
+            {request_class.HEADER: 'batch'}) == 'batch'
+        assert request_class.from_headers({}) == 'other'
+        assert request_class.from_headers(object()) == 'other'
+
+    def test_goodput_predicate_honors_objectives(self):
+        obj = request_class.OBJECTIVES['interactive']
+        assert request_class.is_good('interactive',
+                                     obj.ttft_seconds, None)
+        assert not request_class.is_good(
+            'interactive', obj.ttft_seconds + 0.01, None)
+        assert not request_class.is_good(
+            'interactive', 0.1, obj.tpot_seconds + 0.01)
+        # Unknown class judged at the default objective, never a crash.
+        assert request_class.is_good('never-registered', 0.1, 0.1)
+
+    def test_every_class_has_objective(self):
+        assert set(request_class.OBJECTIVES) == \
+            set(request_class.CLASSES)
+        assert request_class.DEFAULT_CLASS in request_class.CLASSES
+
+
+# ------------------------------------------------------------ routing
+
+class TestRoutingDrill:
+
+    def test_restart_stability_and_load_bound(self):
+        drill = harness_lib.routing_drill(seed=7)
+        # The contract numbers: >= 90% of sessions keep their replica
+        # across an LB restart under Zipfian popularity, and the
+        # bounded-load walk NEVER hands out a pick past capacity.
+        assert drill['restart_stability'] >= 0.9
+        assert drill['bound_violations'] == 0
+        assert drill['churn_unrelated_kept'] >= 0.9
+        assert drill['sessions'] > 100
+
+    def test_drill_deterministic(self):
+        assert (harness_lib.routing_drill(seed=3) ==
+                harness_lib.routing_drill(seed=3))
+
+
+# ---------------------------------------------------------- scorecard
+
+def _fleet_text(classes=('interactive',), good=5, slow=1):
+    """Exposition text with per-class families for `classes` only —
+    rendered by a REAL registry, same shape a live engine emits."""
+    reg = metrics.Registry()
+    h_ttft = reg.histogram(
+        'skytpu_engine_class_ttft_seconds', 'TTFT by class.',
+        labels={'cls': request_class.CLASSES},
+        buckets=(0.1, 0.5, 2.5))
+    h_tpot = reg.histogram(
+        'skytpu_engine_class_tpot_seconds', 'TPOT by class.',
+        labels={'cls': request_class.CLASSES},
+        buckets=(0.01, 0.25))
+    c = reg.counter('skytpu_engine_goodput_total', 'Goodput.',
+                    labels={'cls': request_class.CLASSES,
+                            'outcome': ('good', 'slow')})
+    p = reg.counter('skytpu_engine_prefix_requests_total', 'Prefix.',
+                    labels={'outcome': ('hit', 'miss')})
+    p.inc(3, outcome='hit')
+    p.inc(1, outcome='miss')
+    for cls in classes:
+        for _ in range(good):
+            h_ttft.observe(0.05, cls=cls)
+            h_tpot.observe(0.005, cls=cls)
+            c.inc(cls=cls, outcome='good')
+        for _ in range(slow):
+            h_ttft.observe(2.0, cls=cls)
+            c.inc(cls=cls, outcome='slow')
+    return reg.render()
+
+
+class TestScorecard:
+
+    def test_fleet_section_reads_classes_and_tolerates_missing(self):
+        doc = report_lib.fleet_section(
+            _fleet_text(classes=('interactive',)))
+        row = doc['by_class']['interactive']
+        assert row['good'] == 5 and row['slow'] == 1
+        assert row['goodput'] == round(5 / 6, 4)
+        assert row['ttft_p95_ms'] > 0
+        # Classes with NO samples still render as rows — the
+        # missing-label-set case that used to KeyError.
+        for cls in request_class.CLASSES:
+            assert cls in doc['by_class']
+        assert doc['by_class']['batch']['goodput'] is None
+        assert doc['prefix']['hit_rate'] == 0.75
+
+    def test_fleet_section_empty_text(self):
+        doc = report_lib.fleet_section('')
+        assert set(doc['by_class']) == set(request_class.CLASSES)
+        assert doc['prefix']['hit_rate'] is None
+
+    def test_diff_scorecards_replay_and_regression(self):
+        profile = schedule_lib.PROFILES['smoke']
+        sched = schedule_lib.build_schedule(profile, seed=7)
+        card = report_lib.build_scorecard(
+            profile=profile, seed=7, schedule=sched, run=None,
+            fleet_metrics_text=_fleet_text())
+        # Faithful replay of itself: ok.
+        diff = report_lib.diff_scorecards(card, card)
+        assert diff['ok'] and diff['replay_ok']
+        # A different schedule hash for the same (profile, seed) is a
+        # broken replay contract.
+        import copy
+        tampered = copy.deepcopy(card)
+        tampered['schedule_hash'] = 'deadbeef'
+        diff = report_lib.diff_scorecards(tampered, card)
+        assert not diff['ok'] and diff['replay_ok'] is False
+        # Goodput collapse trips the tripwire.
+        collapsed = copy.deepcopy(card)
+        collapsed['fleet']['by_class']['interactive']['goodput'] = 0.1
+        diff = report_lib.diff_scorecards(collapsed, card)
+        assert not diff['ok']
+        assert any('goodput' in r for r in diff['regressions'])
+
+    def test_scorecard_carries_offered_truth_and_hash(self):
+        profile = schedule_lib.PROFILES['smoke']
+        sched = schedule_lib.build_schedule(profile, seed=7)
+        card = report_lib.build_scorecard(
+            profile=profile, seed=7, schedule=sched, run=None)
+        assert card['schedule_hash'] == \
+            schedule_lib.schedule_hash(sched)
+        assert card['offered']['by_class']
+        assert card['requests'] == len(sched)

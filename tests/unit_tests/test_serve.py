@@ -208,8 +208,8 @@ class TestLBPolicies:
         assert p.select() is None
 
     def test_prefix_affinity_stable_and_churn_minimal(self):
-        """Same key → same replica across calls; rendezvous property:
-        removing an UNRELATED replica never remaps a key."""
+        """Same key → same replica across calls; consistent-hash
+        property: removing an UNRELATED replica never remaps a key."""
         p = load_balancing_policies.PrefixAffinityPolicy()
         p.set_ready_replicas(['a', 'b', 'c', 'd'])
         keys = [f'system-prompt-{i}' for i in range(20)]
@@ -227,19 +227,40 @@ class TestLBPolicies:
             if first[k] != gone:
                 assert p.select(k) == first[k], k
 
-    def test_prefix_affinity_hotspot_fallback_and_none_key(self):
+    def test_prefix_affinity_load_bound_spills_and_none_key(self):
+        """Bounded-load guarantee: past LOAD_BOUND x the even-spread
+        mean, the ring walk spills to the NEXT ring replica — the
+        deterministic spill target, not 'whichever was coolest'."""
         p = load_balancing_policies.PrefixAffinityPolicy()
         p.set_ready_replicas(['a', 'b'])
-        key = 'hot-system-prompt'
+        key = 'hot-session'
         target = p.select(key)
         other = 'b' if target == 'a' else 'a'
-        # Pile load onto the affinity target beyond the slack → falls
-        # back to the coolest replica instead of amplifying a hot spot.
-        for _ in range(p.HOTSPOT_SLACK + 1):
+        # Load the home replica past capacity = ceil(1.25*(total+1)/2).
+        for _ in range(6):
             p.request_started(target)
         assert p.select(key) == other
         # No key → plain least-load.
         assert p.select(None) == other
+        # Draining the home restores affinity (no sticky fallback).
+        for _ in range(6):
+            p.request_finished(target)
+        assert p.select(key) == target
+
+    def test_prefix_affinity_restart_stable(self):
+        """An LB restart discards every in-flight count and policy
+        object; a FRESH policy over the same replica set must route
+        every key identically — the ring is a pure function of the
+        replica URLs."""
+        urls = [f'http://10.0.0.{i}:8000' for i in range(5)]
+        p1 = load_balancing_policies.PrefixAffinityPolicy()
+        p1.set_ready_replicas(urls)
+        keys = [f'tenant-{i}/s{j}' for i in range(10)
+                for j in range(10)]
+        first = {k: p1.select(k) for k in keys}
+        p2 = load_balancing_policies.PrefixAffinityPolicy()
+        p2.set_ready_replicas(list(reversed(urls)))  # order-agnostic
+        assert {k: p2.select(k) for k in keys} == first
 
     def test_affinity_key_extraction(self):
         from skypilot_tpu.serve import load_balancer as lb_mod

@@ -838,6 +838,57 @@ class TestMetricDisciplineChecker:
         ] * 2
         assert 'promtext' in report['violations'][0]['message']
 
+    def test_raw_class_header_label_flagged(self, tmp_path):
+        """Rule 4: a raw X-Skytpu-Class read — inline or through a
+        straight-line variable — must not reach a metric label kwarg
+        without the closed-registry mapping."""
+        _write(tmp_path, 'serve/cls.py', '''\
+            from skypilot_tpu.observe import metrics
+
+            _C = metrics.counter(
+                'skytpu_lb_class_requests_total', 'By class.',
+                labels={'cls': ('interactive', 'other')})
+
+            def record_inline(request):
+                _C.inc(cls=request.headers.get('X-Skytpu-Class'))
+
+            def record_via_name(request):
+                raw = request.headers.get('X-Skytpu-Class', '')
+                _C.inc(cls=raw)
+
+            def record_via_constant(request):
+                from skypilot_tpu.observe import request_class
+                raw = request.headers.get(request_class.HEADER)
+                _C.inc(cls=raw)
+        ''')
+        report = _run(tmp_path, checks=['metric-discipline'])
+        assert sorted(_idents(report)) == [
+            'metric-discipline:serve/cls.py:raw-class-label',
+            'metric-discipline:serve/cls.py:raw-class-label',
+            'metric-discipline:serve/cls.py:raw-class-label',
+        ]
+        assert 'request_class' in report['violations'][0]['message']
+
+    def test_class_header_through_registry_ok(self, tmp_path):
+        """The sanctioned shapes: normalize()/from_headers() wrapping
+        the raw read (inline or via assignment) — and the live LB/
+        engine idiom of a pre-clamped variable."""
+        _write(tmp_path, 'serve/cls_ok.py', '''\
+            from skypilot_tpu.observe import metrics
+            from skypilot_tpu.observe import request_class
+
+            _C = metrics.counter(
+                'skytpu_lb_class_requests_total', 'By class.',
+                labels={'cls': request_class.CLASSES})
+
+            def record(request):
+                cls = request_class.normalize(
+                    request.headers.get('X-Skytpu-Class'))
+                _C.inc(cls=cls)
+                _C.inc(cls=request_class.from_headers(request.headers))
+        ''')
+        assert _run(tmp_path, checks=['metric-discipline'])['total'] == 0
+
     def test_adhoc_exposition_docstrings_and_plain_names_exempt(
             self, tmp_path):
         _write(tmp_path, 'serve/clean.py', '''\
@@ -1501,7 +1552,7 @@ class TestLivePackage:
         with open(out_path, encoding='utf-8') as f:
             report = json.load(f)
         # Schema stability (version-bump ratchet).
-        assert report['skylint_version'] == core.REPORT_VERSION == 10
+        assert report['skylint_version'] == core.REPORT_VERSION == 11
         assert set(report) == {
             'skylint_version', 'root', 'files_scanned', 'checks',
             'violations', 'total', 'allowlisted', 'new',
