@@ -968,13 +968,21 @@ def run_loadgen_bench():
     seed = int(os.environ.get('SKYTPU_BENCH_LOADGEN_SEED', '7'))
     profile = os.environ.get('SKYTPU_BENCH_LOADGEN_PROFILE', 'smoke')
     replicas = int(os.environ.get('SKYTPU_BENCH_LOADGEN_REPLICAS', '2'))
+    # SKYTPU_BENCH_LOADGEN_DISAGG='P+D' runs the stack disaggregated
+    # (P prefill + D decode replicas, two-stage KV-handoff routing) —
+    # the prefill_burst proof runs this way; the diff baseline then
+    # comes from the profile-specific checked-in scorecard (e.g.
+    # LOADGEN_PREFILL_BURST_DISAGG.json) instead of LOADGEN_LAST_GOOD.
+    disagg = os.environ.get('SKYTPU_BENCH_LOADGEN_DISAGG', '')
+    stack_args = (['--disagg', disagg] if disagg
+                  else ['--local-stack', str(replicas)])
     run_dir = tempfile.mkdtemp(prefix='skytpu-bench-loadgen-')
     report_path = os.path.join(run_dir, 'scorecard.json')
     try:
         proc = subprocess.run(
             [sys.executable, '-m', 'skypilot_tpu.loadgen',
              '--seed', str(seed), '--profile', profile,
-             '--local-stack', str(replicas), '--run-dir', run_dir,
+             *stack_args, '--run-dir', run_dir,
              '--report', report_path],
             stdout=sys.stderr, stderr=sys.stderr,
             env={**os.environ,
@@ -994,14 +1002,20 @@ def run_loadgen_bench():
     finished = good + slow
     value = round(good / finished, 4) if finished else None
 
+    baseline_path = LOADGEN_LAST_GOOD_PATH
+    if profile != 'smoke' or disagg:
+        name = ('LOADGEN_' + profile.upper() +
+                ('_DISAGG' if disagg else '_MONO'))
+        baseline_path = os.path.join(
+            os.path.dirname(LOADGEN_LAST_GOOD_PATH), name + '.json')
     diff = None
     try:
-        with open(LOADGEN_LAST_GOOD_PATH) as f:
+        with open(baseline_path) as f:
             last_good = json.load(f)
         diff = report_lib.diff_scorecards(card, last_good)
     except (OSError, ValueError):
-        print('[bench] no LOADGEN_LAST_GOOD.json to diff against',
-              file=sys.stderr)
+        print(f'[bench] no {os.path.basename(baseline_path)} to diff '
+              f'against', file=sys.stderr)
     doc = {
         'metric': 'loadgen_goodput',
         'value': value,
@@ -1009,6 +1023,7 @@ def run_loadgen_bench():
         'profile': profile,
         'seed': seed,
         'replicas': replicas,
+        'disagg': disagg or None,
         'schedule_hash': card.get('schedule_hash'),
         'completed': (card.get('client') or {}).get('completed'),
         'errors': (card.get('client') or {}).get('errors'),

@@ -36,8 +36,10 @@ PACKAGE = 'skypilot_tpu'
 # `if failpoints.ACTIVE:` zero-cost guard; v11: metric-discipline
 # closed-class-registry rule — a raw X-Skytpu-Class header value must
 # map through observe/request_class.normalize()/from_headers() before
-# reaching any metric label kwarg).
-REPORT_VERSION = 11
+# reaching any metric label kwarg; v12: layers learns NESTED sub-unit
+# ranks ('serve/disagg' above 'serve' — the serve plane may only
+# bridge to the disagg orchestration layer lazily).
+REPORT_VERSION = 12
 
 
 @dataclasses.dataclass

@@ -83,6 +83,16 @@ LAYERS = {
     # module-level imports BETWEEN them are cross-plane violations.
     'jobs': 17,
     'serve': 17,
+    # 18 — nested sub-unit: the disaggregated-serving orchestration
+    # layer (KV page handoff transport + staging). It sits ABOVE the
+    # serve plane it coordinates: serve/disagg may import serve (and
+    # models/utils) at module level, but serve's engine and LB bridge
+    # to serve/disagg with function-level lazy imports only — the
+    # hosts must stay loadable (and testable) without the disagg
+    # plane, and a module-level cycle serve↔serve/disagg could never
+    # import. Nested keys ('a/b') rank a subpackage independently of
+    # its parent; modules of 'a' outside 'b' keep 'a''s rank.
+    'serve/disagg': 18,
     # 18 — the replayable traffic harness: drives the serve plane
     # (spawns engine replicas, wires an in-process LB + scraper + SLO
     # engine) and reads the observe plane, so it sits above both —
@@ -94,21 +104,33 @@ LAYERS = {
 }
 
 
+def _unit_path(parts: List[str]) -> Optional[str]:
+    """Internal dotted components (AFTER the package name) → the unit
+    path the DAG ranks: the two-segment nested key (``a/b``) when
+    LAYERS declares one, else the top segment. Nested keys let a
+    subpackage rank independently of its parent (``serve/disagg``)."""
+    if not parts:
+        return None
+    if len(parts) >= 2 and f'{parts[0]}/{parts[1]}' in LAYERS:
+        return f'{parts[0]}/{parts[1]}'
+    return parts[0]
+
+
 def _target_units(stmt, mod: core.ModuleInfo) -> List[str]:
-    """Units a module-level import statement binds to (internal only)."""
+    """Unit paths a module-level import statement binds to (internal
+    only)."""
     units: List[str] = []
 
-    def from_dotted(name: str) -> Optional[str]:
-        parts = name.split('.')
-        if parts[0] != core.PACKAGE:
-            return None
-        return parts[1] if len(parts) > 1 else None
+    def add(parts: List[str]) -> None:
+        u = _unit_path(parts)
+        if u:
+            units.append(u)
 
     if isinstance(stmt, ast.Import):
         for alias in stmt.names:
-            u = from_dotted(alias.name)
-            if u:
-                units.append(u)
+            parts = alias.name.split('.')
+            if parts[0] == core.PACKAGE:
+                add(parts[1:])
         return units
     # ImportFrom — resolve relative imports against the module path.
     if stmt.level == 0:
@@ -118,7 +140,10 @@ def _target_units(stmt, mod: core.ModuleInfo) -> List[str]:
         if parts[0] != core.PACKAGE:
             return units
         if len(parts) > 1:
-            units.append(parts[1])
+            # `from skypilot_tpu.serve import disagg` binds the
+            # NESTED unit when one is ranked — resolve per alias.
+            for alias in stmt.names:
+                add(parts[1:] + [alias.name])
         else:
             # `from skypilot_tpu import serve, resources`
             units.extend(a.name for a in stmt.names)
@@ -134,9 +159,11 @@ def _target_units(stmt, mod: core.ModuleInfo) -> List[str]:
     if stmt.module:
         full = base + stmt.module.split('.')
         if len(full) > 1:
-            units.append(full[1])
+            for alias in stmt.names:
+                add(full[1:] + [alias.name])
     elif len(base) > 1:
-        units.append(base[1])
+        for alias in stmt.names:
+            add(base[1:] + [alias.name])
     else:
         # `from . import x` at package root: each name is a unit.
         units.extend(a.name for a in stmt.names)
@@ -144,24 +171,29 @@ def _target_units(stmt, mod: core.ModuleInfo) -> List[str]:
 
 
 def run(mod: core.ModuleInfo) -> List[core.Violation]:
-    src_rank = LAYERS.get(mod.unit)
+    src_unit = _unit_path(mod.dotted.split('.')[1:]) or mod.unit
+    src_rank = LAYERS.get(src_unit)
     if src_rank is None:
         return []
     out: List[core.Violation] = []
     for stmt, _ in core.module_level_imports(mod.tree):
-        for unit in _target_units(stmt, mod):
-            if unit == mod.unit:
+        # Dedupe per statement: multi-alias froms now resolve per
+        # alias (nested units), and two aliases of one unit must not
+        # double-report one import line.
+        for unit in dict.fromkeys(_target_units(stmt, mod)):
+            if unit == src_unit:
                 continue
             dst_rank = LAYERS.get(unit)
             if dst_rank is None or dst_rank < src_rank:
                 continue
             kind = ('cross-plane' if dst_rank == src_rank else 'upward')
+            dotted_unit = unit.replace('/', '.')
             out.append(core.Violation(
                 check=NAME, path=mod.path, line=stmt.lineno,
                 col=stmt.col_offset,
-                key=f'{core.PACKAGE}.{unit}',
+                key=f'{core.PACKAGE}.{dotted_unit}',
                 message=(
-                    f'{kind} import: {mod.unit!r} (layer {src_rank}) '
+                    f'{kind} import: {src_unit!r} (layer {src_rank}) '
                     f'imports {unit!r} (layer {dst_rank}) at module '
                     f'level; layers may only import strictly downward '
                     f'— use a function-level lazy import if this is a '

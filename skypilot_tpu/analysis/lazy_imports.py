@@ -41,10 +41,13 @@ CONTROL_PLANE_UNITS = frozenset({
 })
 
 # Data-plane files living inside a control-plane unit: the inference
-# engine and its multi-host mirror run ON the slice, next to the chips.
+# engine and its multi-host mirror run ON the slice, next to the
+# chips, and the KV handoff transport ships pages BETWEEN replicas —
+# all three hold numpy arrays at module scope by design.
 EXEMPT_PATHS = frozenset({
     'serve/engine.py',
     'serve/multihost.py',
+    'serve/disagg/handoff.py',
 })
 
 

@@ -52,6 +52,11 @@ def build_parser() -> argparse.ArgumentParser:
                         metavar='N',
                         help='spawn N local CPU engine replicas '
                              'behind an in-process LB and drive those')
+    parser.add_argument('--disagg', default=None, metavar='P+D',
+                        help="disaggregate the local stack into P "
+                             "prefill + D decode replicas (e.g. "
+                             "'1+2'); implies --local-stack P+D and "
+                             'two-stage KV-handoff routing')
     parser.add_argument('--model', default='llama-debug',
                         help='model for --local-stack replicas')
     parser.add_argument('--policy', default='prefix_affinity',
@@ -81,7 +86,8 @@ async def _run_local(args, profile, schedule) -> Dict[str, Any]:
     churn_on = not args.no_churn and len(schedule) >= 4
     async with harness_lib.LocalStack(
             profile, replicas=args.local_stack, run_dir=args.run_dir,
-            model=args.model, policy=args.policy) as stack:
+            model=args.model, policy=args.policy,
+            disagg=args.disagg_pools) as stack:
         await client_lib.wait_ready(stack.lb_url)
         churn: Dict[str, Any] = {}
         if churn_on:
@@ -140,7 +146,8 @@ async def _run_local(args, profile, schedule) -> Dict[str, Any]:
             'fleet_status': await stack.fleet_status(),
             'slo_events': stack.slo_events(),
             'stack': {'mode': 'local', 'replicas': args.local_stack,
-                      'model': args.model, 'policy': args.policy},
+                      'model': args.model, 'policy': args.policy,
+                      'disagg': args.disagg},
         }
 
 
@@ -176,6 +183,22 @@ async def _run_remote(args, schedule) -> Dict[str, Any]:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
+    args.disagg_pools = None
+    if args.disagg:
+        try:
+            p, _, d = args.disagg.partition('+')
+            args.disagg_pools = (int(p), int(d))
+            if min(args.disagg_pools) < 1:
+                raise ValueError
+        except ValueError:
+            print(f"loadgen: --disagg wants 'P+D' with P,D >= 1, got "
+                  f'{args.disagg!r}', file=sys.stderr)
+            return 2
+        if args.base_url:
+            print('loadgen: --disagg needs a local stack',
+                  file=sys.stderr)
+            return 2
+        args.local_stack = sum(args.disagg_pools)
     if args.base_url and args.local_stack:
         print('loadgen: --base-url and --local-stack are exclusive',
               file=sys.stderr)

@@ -64,7 +64,8 @@ class LocalStack:
                  model: str = 'llama-debug',
                  policy: str = 'prefix_affinity',
                  scrape_interval: float = 1.0,
-                 warmup_timeout: float = 600.0):
+                 warmup_timeout: float = 600.0,
+                 disagg: Optional[tuple] = None):
         self.profile = profile
         self.replicas = replicas
         self.run_dir = run_dir
@@ -72,11 +73,18 @@ class LocalStack:
         self.policy = policy
         self.scrape_interval = scrape_interval
         self.warmup_timeout = warmup_timeout
+        # Disaggregated stack: (n_prefill, n_decode) real engine
+        # replicas wired through the LB's two-stage PoolRouter exactly
+        # as the service controller wires it (set_pool_replicas +
+        # role-tagged scrape targets + per-stage SLO kinds). None =
+        # monolithic `replicas`-wide stack.
+        self.disagg = disagg
         self.lb_port = _free_port()
         self.lb_url = f'http://127.0.0.1:{self.lb_port}'
         self.started_unix: float = 0.0
         self._procs: List[subprocess.Popen] = []
         self._urls: List[str] = []
+        self._pool_urls: Dict[str, List[str]] = {}
         self._runner = None
         self._scrape_loop = None
         self._slo_engine = None
@@ -84,16 +92,20 @@ class LocalStack:
         self._lb = None
 
     # ------------------------------------------------------------ wiring
-    def _engine_cmd(self, port: int) -> List[str]:
+    def _engine_cmd(self, port: int,
+                    handoff_port: Optional[int] = None) -> List[str]:
         max_len = (_next_pow2(self.profile.max_prompt_len()) +
                    self.profile.max_new() + 16)
         buckets = sorted({
             _next_pow2(c.prefix_len + c.suffix_len)
             for c in self.profile.classes.values()})
-        return [sys.executable, '-m', 'skypilot_tpu.serve.engine',
-                '--model', self.model, '--max-len', str(max_len),
-                '--warm-buckets', ','.join(str(b) for b in buckets),
-                '--host', '127.0.0.1', '--port', str(port)]
+        cmd = [sys.executable, '-m', 'skypilot_tpu.serve.engine',
+               '--model', self.model, '--max-len', str(max_len),
+               '--warm-buckets', ','.join(str(b) for b in buckets),
+               '--host', '127.0.0.1', '--port', str(port)]
+        if handoff_port is not None:
+            cmd += ['--handoff-port', str(handoff_port)]
+        return cmd
 
     async def __aenter__(self) -> 'LocalStack':
         # A failure inside enter (engine never warms, port races)
@@ -114,8 +126,14 @@ class LocalStack:
         from skypilot_tpu.observe import request_class
         from skypilot_tpu.serve import load_balancer as lb_lib
 
-        ports = [_free_port() for _ in range(self.replicas)]
-        for i, port in enumerate(ports):
+        if self.disagg:
+            n_prefill, n_decode = self.disagg
+            roles = (['prefill'] * n_prefill) + (['decode'] * n_decode)
+        else:
+            roles = [None] * self.replicas
+        ports = [_free_port() for _ in roles]
+        pool_urls: Dict[str, List[str]] = {'prefill': [], 'decode': []}
+        for i, (role, port) in enumerate(zip(roles, ports)):
             env = {**os.environ, 'JAX_PLATFORMS': 'cpu',
                    # Enough prefix-cache entries that eviction noise
                    # doesn't mask the routing signal the churn
@@ -124,11 +142,24 @@ class LocalStack:
                        'SKYTPU_ENGINE_PREFIX_CACHE', '16'),
                    'SKYTPU_OBSERVE_DB': os.path.join(
                        self.run_dir, f'replica-{i}.db')}
+            handoff_port = None
+            if role is not None:
+                from skypilot_tpu.serve.disagg import handoff
+                env['SKYTPU_ENGINE_ROLE'] = role
+                # Handoffs must never pay an XLA compile mid-run.
+                env['SKYTPU_ENGINE_WARM_DISAGG'] = '1'
+                # Decode replicas listen at the fixed-offset handoff
+                # port the LB derives from their URL; prefill
+                # replicas need no receiver.
+                handoff_port = (port + handoff.HANDOFF_PORT_OFFSET
+                                if role == 'decode' else 0)
+                pool_urls[role].append(f'http://127.0.0.1:{port}')
             self._procs.append(subprocess.Popen(
-                self._engine_cmd(port), stdout=sys.stderr,
-                stderr=sys.stderr, env=env))
+                self._engine_cmd(port, handoff_port=handoff_port),
+                stdout=sys.stderr, stderr=sys.stderr, env=env))
         urls = [f'http://127.0.0.1:{p}' for p in ports]
         self._urls = urls
+        self._pool_urls = pool_urls
 
         # Warm up every replica before the LB fronts it.
         from skypilot_tpu.loadgen import client as client_lib
@@ -148,14 +179,41 @@ class LocalStack:
                                   fast_window=10.0, slow_window=30.0,
                                   fast_burn=2.0, slow_burn=1.0)
                   for kind in request_class.GOODPUT_KINDS]
+        if self.disagg:
+            # Per-stage kinds over role-tagged targets — same wiring
+            # as a disagg service controller.
+            specs += [
+                slo_lib.SLOSpec(kind='prefill_queue', objective=0.9,
+                                threshold_seconds=2.5,
+                                fast_window=10.0, slow_window=30.0,
+                                fast_burn=2.0, slow_burn=1.0),
+                slo_lib.SLOSpec(kind='decode_ttft', objective=0.9,
+                                threshold_seconds=1.0,
+                                fast_window=10.0, slow_window=30.0,
+                                fast_burn=2.0, slow_burn=1.0),
+            ]
         self._slo_engine = slo_lib.SLOEngine(specs, entity='loadgen')
         self._lb = lb_lib.LoadBalancer(self.policy,
                                        service_name='loadgen')
         self._lb.attach_fleet(self._scraper, self._slo_engine)
-        self._lb.set_ready_replicas(urls)
-        self._scraper.set_targets(
-            [scrape.Target(f'loadgen/{i}', u)
-             for i, u in enumerate(urls)])
+        if self.disagg:
+            # Single-stage traffic (short prompts, control paths)
+            # rides the decode pool; eligible long-prompt traffic
+            # routes two-stage through the PoolRouter.
+            self._lb.set_ready_replicas(pool_urls['decode'])
+            self._lb.set_pool_replicas(pool_urls['prefill'],
+                                       pool_urls['decode'])
+            targets = []
+            for role in ('prefill', 'decode'):
+                targets += [
+                    scrape.Target(f'loadgen/{role}/{i}', u)
+                    for i, u in enumerate(pool_urls[role])]
+            self._scraper.set_targets(targets)
+        else:
+            self._lb.set_ready_replicas(urls)
+            self._scraper.set_targets(
+                [scrape.Target(f'loadgen/{i}', u)
+                 for i, u in enumerate(urls)])
 
         lb = self._lb
 
@@ -199,8 +257,15 @@ class LocalStack:
         from skypilot_tpu.utils import registry
         fresh = registry.LB_POLICY_REGISTRY.type_from_str(
             self.policy)()
-        fresh.set_ready_replicas(self._urls)
+        fresh.set_ready_replicas(self._pool_urls['decode']
+                                 if self.disagg else self._urls)
         self._lb.policy = fresh
+        if self.disagg:
+            from skypilot_tpu.serve import load_balancing_policies
+            router = load_balancing_policies.PoolRouter()
+            router.set_pools(self._pool_urls['prefill'],
+                             self._pool_urls['decode'])
+            self._lb._pools = router  # pylint: disable=protected-access
 
     # ------------------------------------------------------- evidence
     def settle(self) -> None:

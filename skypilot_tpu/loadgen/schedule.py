@@ -72,6 +72,15 @@ class Profile:
     spike_len_frac: float = 0.2
     spike_factor: float = 3.0
     stream_fraction: float = 0.5     # share of requests using SSE
+    # Class-shaped spikes (the disaggregation scenario): when set,
+    # requests scheduled INSIDE the spike window draw their class with
+    # ``spike_class`` boosted to ``spike_class_weight`` of the mix
+    # (other classes share the remainder proportionally) — a burst of
+    # long prompts OVER steady interactive traffic, not instead of it.
+    # Empty string = the plain uniform-mix spike every earlier profile
+    # uses (their schedule hashes must replay unchanged).
+    spike_class: str = ''
+    spike_class_weight: float = 0.0
 
     def max_prompt_len(self) -> int:
         return max(c.prefix_len + c.suffix_len
@@ -109,6 +118,34 @@ PROFILES: Dict[str, Profile] = {
             'batch': ClassShape(prefix_len=16, suffix_len=16,
                                 max_new_tokens=16, weight=0.15),
         }),
+    # The disaggregation proof profile (docs/serving.md): steady
+    # interactive chat turns (short prompts — below the LB's
+    # two-stage threshold, so they live on the decode pool) with a
+    # mid-run SPIKE of long-prompt traffic (3x intensity, 85%
+    # long_context inside the window). The long prompts bucket to
+    # 2048 — several prefill chunks each. On a monolithic pool every
+    # replica decodes interactive traffic, so the burst's prefills
+    # crawl one interleaved chunk per scheduling round (chunked
+    # prefill caps the interactive-TPOT damage but cannot mint
+    # prefill capacity): the burst class's TTFT blows up and its
+    # goodput breaches. Behind a disaggregated 1+2 stack the
+    # dedicated prefill pool drains the same spike flat out while
+    # interactive TPOT holds within the calm run's band (the
+    # checked-in LOADGEN_PREFILL_BURST*.json scorecards, pinned by
+    # TestPrefillBurstArtifacts).
+    'prefill_burst': Profile(
+        name='prefill_burst', tenants=4, sessions_per_tenant=4,
+        requests=60, duration_s=12.0,
+        classes={
+            'interactive': ClassShape(prefix_len=32, suffix_len=8,
+                                      max_new_tokens=10, weight=0.8),
+            'long_context': ClassShape(prefix_len=1500, suffix_len=32,
+                                       max_new_tokens=2, weight=0.2),
+        },
+        diurnal_amplitude=0.2, spike_start_frac=0.4,
+        spike_len_frac=0.25, spike_factor=3.0,
+        spike_class='long_context', spike_class_weight=0.85,
+        stream_fraction=0.4),
     # The million-user SHAPE (tenant/session cardinality and skew) at
     # a request count a TPU fleet sustains for ~half an hour; scale
     # `requests` up from the CLI for longer soaks.
@@ -124,6 +161,15 @@ PROFILES: Dict[str, Profile] = {
                                 max_new_tokens=128, weight=0.1),
         }),
 }
+
+# The burst profile's no-burst control: identical classes/skew/rates
+# with the spike window removed — the scorecard pair the acceptance
+# band compares ("interactive TPOT under the burst within tolerance of
+# its no-burst run").
+PROFILES['prefill_calm'] = dataclasses.replace(
+    PROFILES['prefill_burst'], name='prefill_calm',
+    spike_len_frac=0.0, spike_factor=1.0, spike_class='',
+    spike_class_weight=0.0)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -212,6 +258,25 @@ def build_schedule(profile: Profile, seed: int) -> List[RequestSpec]:
 
     class_names = sorted(profile.classes)
     class_weights = [profile.classes[c].weight for c in class_names]
+    spike_weights = None
+    if profile.spike_class:
+        if profile.spike_class not in profile.classes:
+            raise ValueError(
+                f'profile {profile.name!r} spike_class '
+                f'{profile.spike_class!r} is not one of its classes')
+        if not 0.0 < profile.spike_class_weight < 1.0:
+            raise ValueError('spike_class_weight must be in (0, 1)')
+        rest = sum(w for c, w in zip(class_names, class_weights)
+                   if c != profile.spike_class)
+        if rest <= 0:
+            raise ValueError(
+                f'profile {profile.name!r}: spike_class '
+                f'{profile.spike_class!r} needs at least one OTHER '
+                f'positive-weight class to spike against')
+        spike_weights = [
+            profile.spike_class_weight if c == profile.spike_class
+            else w / rest * (1.0 - profile.spike_class_weight)
+            for c, w in zip(class_names, class_weights)]
     lam_max = max(_intensity(profile, x * profile.duration_s / 1000.0)
                   for x in range(1000)) * 1.001
 
@@ -226,7 +291,11 @@ def build_schedule(profile: Profile, seed: int) -> List[RequestSpec]:
         s_idx = rng.choices(range(profile.sessions_per_tenant),
                             weights=session_w)[0]
         session = f'{tenant}/s{s_idx:03d}'
-        cls = rng.choices(class_names, weights=class_weights)[0]
+        in_spike = spike_weights is not None and _phase(
+            profile, t) == 'spike'
+        cls = rng.choices(class_names,
+                          weights=(spike_weights if in_spike
+                                   else class_weights))[0]
         shape = profile.classes[cls]
         suffix = tuple(rng.randint(_TOKEN_LOW, _TOKEN_HIGH)
                        for _ in range(shape.suffix_len))

@@ -204,6 +204,46 @@ def gather_prefix(pcache, slot, p: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
     return a, b
 
 
+def adopt_rows(pcache, a: jnp.ndarray, b: jnp.ndarray, slot, s: int,
+               new_len):
+    """Write a HANDED-OFF row into slot's own pages: ``(a, b)`` are the
+    [L, 1, s, ...] contiguous per-token arrays in :func:`gather_prefix`
+    order — (k, v) for PagedKV, (c_kv, k_rope) for PagedLatent — as
+    exported by a prefill replica and shipped npy-framed across the
+    wire (serve/disagg/handoff.py). Positions [0, s) land in the pages
+    the slot's table covers (the adopter reserved them through its own
+    allocator — page IDS never cross the wire, only page CONTENTS);
+    length[slot] = new_len, so pad garbage past the real prompt length
+    is never attended. The exact inverse of the export gather: adopt
+    then gather_prefix round-trips bit-identically (pin-tested in
+    tests/unit_tests/test_paging.py)."""
+    psz = page_size_of(pcache)
+    names = list(_pools(pcache))
+    rows = {names[0]: a, names[1]: b}
+    out = {}
+    if s % psz == 0:
+        # Page-granular scatter: export buckets are page-aligned, so
+        # whole pages land with s/psz scatter indices instead of s —
+        # the adopt is a memory op and must stay cheap next to the
+        # decode rounds it interleaves with.
+        n = s // psz
+        pid = pcache.table[slot, :n]                       # [n]
+        for name, pool_a in _pools(pcache).items():
+            tok = rows[name][:, 0, :s]                     # [L, s, ...]
+            paged = tok.reshape(tok.shape[0], n, psz,
+                                *tok.shape[2:])
+            out[name] = pool_a.at[:, pid].set(paged)
+    else:
+        pos = jnp.arange(s)
+        pid = pcache.table[slot, pos // psz]               # [s]
+        off = pos % psz
+        for name, pool_a in _pools(pcache).items():
+            tok = rows[name][:, 0, :s]                     # [L, s, ...]
+            out[name] = pool_a.at[:, pid, off].set(tok)
+    length = pcache.length.at[slot].set(new_len)
+    return dataclasses.replace(pcache, length=length, **out)
+
+
 def scatter_suffix(pcache, row_cache, slot, p: int, s2: int, new_len):
     """Write an extend/chunk prefill's suffix — positions [p, p+s2) of
     the single returned row — into row ``slot``'s own pages, leaving

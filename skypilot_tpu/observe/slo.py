@@ -57,7 +57,19 @@ logger = sky_logging.init_logger(__name__)
 # window — "what share of this class's finished requests missed their
 # class latency objective" — run through the same multi-window
 # burn-rate machinery as every other kind.
-KINDS = (('availability', 'ttft_p95', 'tpot_p95') +
+#
+# The PER-STAGE kinds serve the disaggregated pools (serve/disagg):
+# ``prefill_queue`` evaluates the admission-wait histogram over the
+# PREFILL pool's targets only (the saturation a long-prompt burst
+# builds up — the prefill autoscaler's alerting mirror) and
+# ``decode_ttft`` evaluates the TTFT histogram over the DECODE pool's
+# targets only (adoption → first streamed token — the latency-shaped
+# phase disaggregation protects). Same windowed-delta burn machinery;
+# the only difference is the target filter: a controller tags disagg
+# scrape targets ``<service>/<role>/<replica_id>``, and these kinds
+# restrict to their role segment.
+KINDS = (('availability', 'ttft_p95', 'tpot_p95',
+          'prefill_queue', 'decode_ttft') +
          request_class.GOODPUT_KINDS)
 STATES = ('ok', 'warning', 'breach')
 _STATE_CODE = {'ok': 0, 'warning': 1, 'breach': 2}
@@ -65,6 +77,14 @@ _STATE_CODE = {'ok': 0, 'warning': 1, 'breach': 2}
 _KIND_FAMILY = {
     'ttft_p95': 'skytpu_engine_ttft_seconds',
     'tpot_p95': 'skytpu_engine_tpot_seconds',
+    'prefill_queue': 'skytpu_engine_admission_wait_seconds',
+    'decode_ttft': 'skytpu_engine_ttft_seconds',
+}
+# Pool-scoped kinds: evaluated only over targets whose entity carries
+# the role segment (``<service>/<role>/<replica_id>``).
+_KIND_POOL = {
+    'prefill_queue': 'prefill',
+    'decode_ttft': 'decode',
 }
 GOODPUT_FAMILY = 'skytpu_engine_goodput_total'
 # scrape.UP_SERIES without importing scrape (slo must stay importable
@@ -443,6 +463,15 @@ class SLOEngine:
                 spec.kind[len('goodput_'):], spec.fast_window,
                 spec.slow_window, now, targets)
         family = _KIND_FAMILY[spec.kind]
+        pool = _KIND_POOL.get(spec.kind)
+        if pool is not None:
+            # Per-pool delta windows: restrict to the role's scrape
+            # targets. With no pool-tagged targets (a monolithic
+            # service evaluating a disagg kind) the windows are empty
+            # → no data → the state machine HOLDS, it never breaches.
+            if targets is None:
+                targets = tsdb.targets(since=now - spec.slow_window)
+            targets = [t for t in targets if f'/{pool}/' in t]
         fast_h, slow_h = windowed_histograms(
             family, [spec.fast_window, spec.slow_window], now, targets)
         fast = latency_error_fraction(fast_h, spec.threshold_seconds)

@@ -54,10 +54,24 @@ class ServiceController:
         from skypilot_tpu.observe import trace
         trace.adopt(record.get('trace_id'))
         self._load_from_record(record)
-        self.manager = replica_managers.ReplicaManager(
-            self.name, self.task, self.spec,
-            version=int(record.get('version') or 1),
-            update_mode=record.get('update_mode') or 'rolling')
+        version = int(record.get('version') or 1)
+        update_mode = record.get('update_mode') or 'rolling'
+        if self.spec.disagg is not None:
+            # Disaggregated service: one manager per pool, sharing the
+            # service's replica-id sequence and partitioning the
+            # replica table by role-tagged cluster names.
+            self.managers = {
+                role: replica_managers.ReplicaManager(
+                    self.name, self.task, self.spec, version=version,
+                    update_mode=update_mode, role=role)
+                for role in ('prefill', 'decode')}
+        else:
+            self.managers = {None: replica_managers.ReplicaManager(
+                self.name, self.task, self.spec, version=version,
+                update_mode=update_mode)}
+        # Back-compat alias: the monolithic manager (tests, update
+        # adoption). Disagg updates adopt through every manager.
+        self.manager = next(iter(self.managers.values()))
         self.lb = lb_lib.LoadBalancer(self.spec.load_balancing_policy,
                                       self.autoscaler,
                                       service_name=self.name)
@@ -72,20 +86,51 @@ class ServiceController:
         self.scrape_loop = None
         if not self.spec.pool:
             self.scraper = scrape_lib.Scraper()
-            self.slo_engine = slo_lib.SLOEngine(entity=self.name)
+            specs = slo_lib.default_specs()
+            if self.spec.disagg is not None:
+                # Per-stage SLO kinds (observe/slo.py): queue wait on
+                # the prefill pool, decode-side TTFT (adoption → first
+                # streamed token) on the decode pool — each evaluated
+                # over ITS pool's scrape targets only.
+                specs += [
+                    slo_lib.SLOSpec(kind='prefill_queue',
+                                    objective=0.95,
+                                    threshold_seconds=2.5),
+                    slo_lib.SLOSpec(kind='decode_ttft', objective=0.95,
+                                    threshold_seconds=1.0),
+                ]
+            self.slo_engine = slo_lib.SLOEngine(specs, entity=self.name)
             self.scrape_loop = scrape_lib.ScrapeLoop(
                 self.scraper, on_round=self._on_scrape_round)
             self.lb.attach_fleet(self.scraper, self.slo_engine)
         self._stop = threading.Event()
 
     def _load_from_record(self, record) -> None:
-        """Build spec/task/autoscaler from a service record (shared by
-        startup and update adoption)."""
+        """Build spec/task/autoscaler(s) from a service record (shared
+        by startup and update adoption)."""
         self.spec = spec_lib.ServiceSpec.from_yaml_config(record['spec'])
         task_cfg = dict(record['task_config'])
         task_cfg.pop('service', None)
         self.task = task_lib.Task.from_yaml_config(task_cfg)
-        self.autoscaler = autoscaler_lib.Autoscaler.make(self.spec.policy)
+        if self.spec.disagg is not None:
+            # One autoscaler per pool — independent scaling is the
+            # point of disaggregation: the prefill pool grows off its
+            # queue saturation while the decode pool holds TPOT.
+            self.autoscalers = {
+                role: autoscaler_lib.Autoscaler.make(
+                    self.spec.disagg.role_policy(role))
+                for role in ('prefill', 'decode')}
+            # The LB's request-rate signal (QPS fallback) goes to the
+            # decode pool's autoscaler: every request decodes; only
+            # long-prompt ones prefill remotely.
+            self.autoscaler = self.autoscalers['decode']
+        else:
+            self.autoscaler = autoscaler_lib.Autoscaler.make(
+                self.spec.policy)
+            self.autoscalers = {None: self.autoscaler}
+        # url → pool role, refreshed each reconcile pass; the scrape
+        # round splits saturation snapshots per pool with it.
+        self._pool_urls = {}
 
     def _maybe_adopt_update(self, record) -> None:
         """serve update bumped the stored version: reload task/spec and let
@@ -102,8 +147,13 @@ class ServiceController:
                     self.spec.policy)
             return
         self._load_from_record(record)
-        self.manager.reload(self.task, self.spec, version,
-                            record.get('update_mode') or 'rolling')
+        # Disagg: every pool manager adopts the new version (a
+        # mono↔disagg TOPOLOGY change needs a controller restart —
+        # the manager set is fixed at startup; documented in
+        # docs/serving.md).
+        for manager in self.managers.values():
+            manager.reload(self.task, self.spec, version,
+                           record.get('update_mode') or 'rolling')
 
     # ------------------------------------------------------------------
     def _on_scrape_round(self, scraper: 'scrape_lib.Scraper') -> None:
@@ -114,7 +164,17 @@ class ServiceController:
         snapshot = scraper.saturation_snapshot()
         depths = {url: s.queue_depth for url, s in snapshot.items()}
         self.lb.set_replica_saturation(depths)
-        self.autoscaler.observe_saturation(depths)
+        if self.spec.disagg is not None:
+            # Independent pool scaling: each autoscaler sees only ITS
+            # pool's saturation (an empty sub-snapshot is no-signal →
+            # QPS fallback / hold, exactly the monolithic contract).
+            pool_urls = dict(self._pool_urls)
+            for role, autoscaler in self.autoscalers.items():
+                autoscaler.observe_saturation(
+                    {u: d for u, d in depths.items()
+                     if pool_urls.get(u) == role})
+        else:
+            self.autoscaler.observe_saturation(depths)
         if self.slo_engine is not None:
             self.slo_engine.evaluate()
 
@@ -163,26 +223,61 @@ class ServiceController:
                         ServiceStatus.SHUTTING_DOWN, ServiceStatus.SHUTDOWN):
                     break
                 self._maybe_adopt_update(record)
-                if self.spec.pool:
-                    # Worker count is resizable in place (jobs/pool.py
-                    # rewrites the stored spec); honor the live value.
-                    target = int((record['spec'] or {}).get(
-                        'workers', self.spec.policy.min_replicas))
-                else:
-                    target = self.autoscaler.target_replicas()
-                self.manager.reconcile(target)
-                if self.manager.permanently_failed:
-                    self.manager.terminate_all()
+                permanently_failed = None
+                for role, manager in self.managers.items():
+                    if self.spec.pool:
+                        # Worker count is resizable in place
+                        # (jobs/pool.py rewrites the stored spec);
+                        # honor the live value.
+                        target = int((record['spec'] or {}).get(
+                            'workers', self.spec.policy.min_replicas))
+                    else:
+                        target = self.autoscalers[role].target_replicas()
+                    manager.reconcile(target)
+                    if manager.permanently_failed:
+                        permanently_failed = manager.permanently_failed
+                if permanently_failed:
+                    for manager in self.managers.values():
+                        manager.terminate_all()
                     serve_state.set_service_status(
                         self.name, ServiceStatus.FAILED,
-                        failure_reason=self.manager.permanently_failed)
+                        failure_reason=permanently_failed)
                     logger.warning(f'Service {self.name!r} FAILED: '
-                                   f'{self.manager.permanently_failed}')
+                                   f'{permanently_failed}')
                     break
                 if self.spec.pool:
                     # Workers have no URLs; readiness is status-driven.
                     ready = [r for r in serve_state.get_replicas(self.name)
                              if r['status'] is ReplicaStatus.READY]
+                elif self.spec.disagg is not None:
+                    # ONE routable snapshot per pool per pass. The LB's
+                    # single-stage _ready set IS the decode pool (full
+                    # engines — they serve any shape); the PoolRouter
+                    # gets both pools; service readiness keys on the
+                    # decode pool (with no prefill replica the router
+                    # has no pools and traffic degrades to monolithic
+                    # on decode, which still serves).
+                    pool_ready = {}
+                    targets = []
+                    for role, manager in self.managers.items():
+                        id_urls = manager.ready_id_urls()
+                        pool_ready[role] = [url for _, url in id_urls]
+                        targets += [
+                            scrape_lib.Target(
+                                entity=f'{self.name}/{role}/{rid}',
+                                url=url)
+                            for rid, url in id_urls]
+                    self._pool_urls = {
+                        u: role for role, urls in pool_ready.items()
+                        for u in urls}
+                    ready = pool_ready['decode']
+                    self.lb.set_ready_replicas(ready)
+                    self.lb.set_pool_replicas(pool_ready['prefill'],
+                                              pool_ready['decode'])
+                    self.lb.policy.set_replica_weights(
+                        self.managers['decode'].ready_url_weights(ready))
+                    if self.scraper is not None:
+                        self.scraper.set_targets(targets)
                 else:
                     # ONE routable-set snapshot per pass: LB targets,
                     # capacity weights and scrape targets all derive
@@ -275,16 +370,21 @@ def shutdown_service(service_name: str) -> None:
     task_cfg = dict(record['task_config'])
     task_cfg.pop('service', None)
     task = task_lib.Task.from_yaml_config(task_cfg)
-    manager = replica_managers.ReplicaManager(service_name, task, spec)
-    manager.terminate_all()
+    roles = (['prefill', 'decode'] if spec.disagg is not None
+             else [None])
+    for role in roles:
+        replica_managers.ReplicaManager(
+            service_name, task, spec, role=role).terminate_all()
     # A launch thread that survived the SIGTERM window may have registered
     # a cluster after terminate_all enumerated the table: sweep any cluster
     # named like this service's replicas.
     from skypilot_tpu import global_state
     from skypilot_tpu.backends import slice_backend
-    prefix = f'{service_name}-replica-'
+    prefixes = tuple(
+        f'{service_name}-{role}-replica-' if role else
+        f'{service_name}-replica-' for role in roles)
     for cluster in global_state.get_clusters():
-        if cluster['name'].startswith(prefix):
+        if cluster['name'].startswith(prefixes):
             try:
                 handle = slice_backend.SliceResourceHandle.from_dict(
                     cluster['handle'])

@@ -1,0 +1,22 @@
+"""Disaggregated prefill/decode serving (docs/serving.md §disagg).
+
+Two independently-scaled replica pools behind one LB: PREFILL replicas
+run the compute-shaped phase (chunked prefill + first-token sampling)
+and ship the request's KV pages + sampler state to a DECODE replica,
+which adopts the pages into its own ``PageAllocator`` and carries the
+latency-shaped phase (token-by-token decode, SSE streaming). A burst
+of long prompts then saturates the prefill pool's queue — scaled on
+the ``prefill_queue`` SLO — while interactive TPOT on the decode pool
+holds (the loadgen ``prefill_burst`` scorecard is the checked-in
+proof).
+
+Modules:
+  * :mod:`.handoff` — the page handoff transport: npy-framed KV rows
+    over the shared framed-TCP idiom (utils/framed.py), content
+    fingerprints, and the decode-side staging store.
+
+The engine's ``/disagg/prefill`` + ``/disagg/continue`` endpoints and
+the LB's two-stage router live with their hosts (serve/engine.py,
+serve/load_balancer.py) and bridge to this package lazily — ``serve``
+ranks below ``serve/disagg`` in the skylint layer DAG.
+"""

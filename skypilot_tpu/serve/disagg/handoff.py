@@ -1,0 +1,330 @@
+"""KV page handoff between prefill and decode replicas.
+
+Wire format: ONE framed-TCP exchange (utils/framed.py — the same
+versioned framing, npy array encoding, deadline discipline and
+structured error replies the input-data service ships batches over)
+per handoff:
+
+  request  {'op': 'handoff', 'meta': {...}}  + arrays {'a': ..., 'b': ...}
+  reply    {'ok': True, 'handoff_id': ...}   (or {'error', 'kind'})
+
+``meta`` carries everything the decode replica needs to continue the
+request as if it had prefilled it itself: the prompt tokens, sampler
+state (temperature/top-k/top-p/penalties, the sampled FIRST token and
+its logprobs), stop ids, the request class, and the export geometry
+(bucket, page size, family). ``arrays`` are the [L, 1, bucket, ...]
+contiguous per-token cache rows in :func:`models.paging.gather_prefix`
+order — (k, v) for PagedKV, (c_kv, k_rope) for PagedLatent. Page IDS
+never cross the wire: the decode replica reserves pages through its
+OWN refcounted allocator and scatters the page CONTENTS in
+(``paging.adopt_rows``), so the two pools' allocators stay sovereign
+and a handoff can never alias or leak a page on either side.
+
+Integrity discipline: ``meta['kv_sha256']`` is the content fingerprint
+of the arrays, recomputed on the receive side BEFORE staging — a
+truncated or bit-flipped page refuses loudly (kind ``integrity``)
+instead of decoding garbage with HTTP 200. Config skew (different
+model/vocab/page size) refuses with kind ``spec`` — never retried, a
+mismatched pool pairing does not heal.
+
+Staging: adopted-but-not-yet-continued handoffs wait in
+:class:`HandoffStore` as HOST memory only — no device pages are
+allocated until the decode engine actually admits the request
+(``/disagg/continue``), so an orphaned handoff (its LB died between
+stages) costs RAM until the TTL sweep, never KV pool pages. Duplicate
+handoff ids are refused (kind ``duplicate``): a retried send that
+actually landed twice must not double-admit.
+
+Device-to-device transport (ICI within a slice) is a documented seam:
+:func:`send` is the one place serialization happens, so a D2D path
+replaces this module's body without touching the engine or LB.
+
+Failpoints: ``handoff.send`` (prefill side, before the socket op) and
+``handoff.recv`` (decode side, inside the receiver handler) — the
+chaos suite's mid-handoff kill windows (docs/ROBUSTNESS.md).
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+import uuid
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.utils import failpoints as failpoints_lib
+from skypilot_tpu.utils import framed
+
+logger = sky_logging.init_logger(__name__)
+
+# The decode replica's handoff listener rides alongside its HTTP port
+# at a fixed offset, so the LB (and the prefill replica it instructs)
+# can derive the handoff address from the replica URL it already
+# routes to — no extra service discovery. Engines accept
+# --handoff-port to override.
+HANDOFF_PORT_OFFSET = 1000
+
+# Whole-exchange deadline for one handoff send (connect + frame +
+# ack). A dead decode replica costs the prefill handler this long,
+# bounded — the LB's stage-1 read timeout must exceed it.
+SEND_TIMEOUT_ENV = 'SKYTPU_HANDOFF_TIMEOUT'
+SEND_TIMEOUT_DEFAULT = 30.0
+
+# Staged handoffs whose /disagg/continue never arrives (the
+# orchestrating LB died between stages) are swept after this many
+# seconds. Host memory only — no pages are held.
+STORE_TTL_ENV = 'SKYTPU_HANDOFF_TTL'
+STORE_TTL_DEFAULT = 120.0
+
+# meta keys every handoff must carry — refused (kind 'spec') otherwise.
+REQUIRED_META = ('handoff_id', 'model', 'vocab_size', 'page_size',
+                 'family', 'bucket', 'tokens', 'max_new', 'first_token',
+                 'kv_sha256')
+
+
+class HandoffError(RuntimeError):
+    """Prefill-side send failure (socket/protocol/refusal). ``kind``
+    mirrors the framed reply's error kind; ``retriable`` is False only
+    for configuration refusals (kind ``spec``) — a retry on another
+    replica pair cannot heal those."""
+
+    def __init__(self, message: str, kind: str = 'error'):
+        super().__init__(message)
+        self.kind = kind
+        self.retriable = kind != 'spec'
+
+
+def kv_fingerprint(arrays: Dict[str, np.ndarray]) -> str:
+    """Content sha256 over the handoff arrays (name-ordered, shape and
+    dtype included so a reshaped buffer can't collide)."""
+    h = hashlib.sha256()
+    for name in sorted(arrays):
+        a = np.ascontiguousarray(arrays[name])
+        h.update(name.encode())
+        h.update(str(a.shape).encode())
+        h.update(str(a.dtype).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def new_handoff_id() -> str:
+    return uuid.uuid4().hex
+
+
+def handoff_addr_for_url(url: str,
+                         offset: int = HANDOFF_PORT_OFFSET
+                         ) -> Tuple[str, int]:
+    """Replica HTTP url → its handoff (host, port): the fixed-offset
+    convention the LB uses to point prefill replicas at decode
+    replicas."""
+    rest = url.split('://', 1)[-1].rstrip('/')
+    host, port = framed.parse_addr(rest, default_port=8000)
+    return host, port + offset
+
+
+def send_timeout() -> float:
+    import os
+    try:
+        return float(os.environ.get(SEND_TIMEOUT_ENV,
+                                    SEND_TIMEOUT_DEFAULT))
+    except ValueError:
+        return SEND_TIMEOUT_DEFAULT
+
+
+def send(addr: Tuple[str, int], meta: Dict[str, Any],
+         arrays: Dict[str, np.ndarray]) -> Dict[str, Any]:
+    """Ship one handoff to a decode replica's receiver; returns the
+    ack. Raises :class:`HandoffError` on any failure — socket errors
+    and protocol refusals are retriable (another prefill attempt or
+    decode target may succeed), ``spec``-kinded refusals are not.
+
+    Blocking (stdlib sockets): callers on an event loop run it via
+    ``asyncio.to_thread``."""
+    try:
+        if failpoints_lib.ACTIVE:
+            # A firing is a transport failure (the chaos window for a
+            # prefill replica dying mid-send) — classed retriable like
+            # any socket fault below.
+            failpoints_lib.fire('handoff.send')
+        reply, _ = framed.request(addr, {'op': 'handoff', 'meta': meta},
+                                  arrays, timeout=send_timeout())
+        return reply
+    except framed.RemoteError as e:
+        raise HandoffError(f'decode replica refused handoff: {e}',
+                           kind=e.kind) from e
+    except (framed.ProtocolError, OSError,
+            failpoints_lib.FailpointError) as e:
+        raise HandoffError(
+            f'handoff transport to {addr[0]}:{addr[1]} failed: '
+            f'{type(e).__name__}: {e}') from e
+
+
+class HandoffStore:
+    """Decode-side staging for received handoffs, keyed by handoff id.
+
+    Thread-safe: the receiver's connection threads put, the engine's
+    event loop pops. Entries are (meta, arrays) HOST tuples — no
+    device state — with a TTL sweep for orphans and a hard entry cap
+    (a flooding peer exhausts its own handoffs, not this process's
+    RAM). Duplicate puts refuse: at-most-once admission is the
+    adopt-side half of the no-leak contract."""
+
+    def __init__(self, ttl: Optional[float] = None, max_entries: int = 256):
+        import os
+        if ttl is None:
+            try:
+                ttl = float(os.environ.get(STORE_TTL_ENV,
+                                           STORE_TTL_DEFAULT))
+            except ValueError:
+                ttl = STORE_TTL_DEFAULT
+        self.ttl = ttl
+        self.max_entries = max_entries
+        self._lock = threading.Lock()
+        self._entries: Dict[str, Tuple[float, Dict[str, Any],
+                                       Dict[str, np.ndarray]]] = {}
+        # Recently-consumed ids: a duplicate arriving AFTER its twin
+        # was adopted must refuse too, not stage a second admission.
+        self._consumed: Dict[str, float] = {}
+
+    def put(self, meta: Dict[str, Any],
+            arrays: Dict[str, np.ndarray]) -> None:
+        hid = str(meta['handoff_id'])
+        now = time.monotonic()
+        with self._lock:
+            self._sweep_locked(now)
+            if hid in self._entries or hid in self._consumed:
+                raise framed.RemoteError(
+                    f'handoff {hid} already received — duplicate '
+                    f'delivery refused (at-most-once adoption)',
+                    kind='duplicate')
+            if len(self._entries) >= self.max_entries:
+                raise framed.RemoteError(
+                    f'handoff store full ({self.max_entries} staged); '
+                    f'retry shortly', kind='overloaded')
+            self._entries[hid] = (now + self.ttl, meta, arrays)
+
+    def pop(self, handoff_id: str
+            ) -> Optional[Tuple[Dict[str, Any], Dict[str, np.ndarray]]]:
+        now = time.monotonic()
+        with self._lock:
+            self._sweep_locked(now)
+            entry = self._entries.pop(handoff_id, None)
+            if entry is None:
+                return None
+            self._consumed[handoff_id] = now + self.ttl
+            return entry[1], entry[2]
+
+    def sweep(self) -> int:
+        """Drop expired entries; returns how many were swept."""
+        with self._lock:
+            return self._sweep_locked(time.monotonic())
+
+    def _sweep_locked(self, now: float) -> int:
+        dead = [hid for hid, (exp, _, _) in self._entries.items()
+                if exp <= now]
+        for hid in dead:
+            del self._entries[hid]
+            logger.warning(f'handoff {hid} expired unconsumed after '
+                           f'{self.ttl:.0f}s — swept (host memory '
+                           f'only; no pages were held)')
+        for hid in [h for h, exp in self._consumed.items()
+                    if exp <= now]:
+            del self._consumed[hid]
+        return len(dead)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+class HandoffReceiver:
+    """The decode replica's framed-TCP listener.
+
+    ``validate(meta) -> Optional[str]`` is the engine's compatibility
+    check (model/vocab/page-size/bucket coverage); a non-None return
+    refuses the handoff with kind ``spec``. Integrity (content
+    fingerprint) and duplicate refusals happen here too — BEFORE
+    staging, so nothing unverifiable ever waits for adoption."""
+
+    def __init__(self, host: str, port: int, store: HandoffStore,
+                 validate: Optional[Callable[[Dict[str, Any]],
+                                             Optional[str]]] = None):
+        self.store = store
+        self._validate = validate
+        self._server = framed.FramedServer(host, port, self._handle,
+                                           name='kv-handoff')
+        self.addr = self._server.addr
+
+    def start(self) -> 'HandoffReceiver':
+        self._server.start()
+        logger.info(f'KV handoff receiver listening on '
+                    f'{self.addr[0]}:{self.addr[1]}.')
+        return self
+
+    def stop(self) -> None:
+        self._server.stop()
+
+    # ------------------------------------------------------------------
+    def _handle(self, obj: Dict[str, Any], arrays: framed.Arrays
+                ) -> Tuple[Dict[str, Any], Optional[framed.Arrays]]:
+        if failpoints_lib.ACTIVE:
+            failpoints_lib.fire('handoff.recv')
+        if obj.get('op') != 'handoff':
+            raise framed.RemoteError(
+                f'unknown op {obj.get("op")!r}', kind='spec')
+        meta = obj.get('meta')
+        if not isinstance(meta, dict):
+            raise framed.RemoteError('handoff without meta', kind='spec')
+        missing = [k for k in REQUIRED_META if k not in meta]
+        if missing:
+            raise framed.RemoteError(
+                f'handoff meta missing {missing}', kind='spec')
+        if set(arrays) != {'a', 'b'}:
+            raise framed.RemoteError(
+                f'handoff arrays must be exactly {{a, b}}, got '
+                f'{sorted(arrays)}', kind='spec')
+        digest = kv_fingerprint(arrays)
+        if digest != meta['kv_sha256']:
+            raise framed.RemoteError(
+                f'handoff {meta["handoff_id"]} KV fingerprint mismatch '
+                f'(sent {meta["kv_sha256"][:12]}…, received '
+                f'{digest[:12]}…) — refusing to adopt corrupted pages',
+                kind='integrity')
+        if self._validate is not None:
+            msg = self._validate(meta)
+            if msg:
+                raise framed.RemoteError(msg, kind='spec')
+        self.store.put(meta, dict(arrays))
+        return {'ok': True, 'handoff_id': meta['handoff_id']}, None
+
+
+def build_meta(*, handoff_id: str, model: str, vocab_size: int,
+               page_size: int, family: str, bucket: int,
+               tokens: List[int], max_new: int, first_token: int,
+               first_lp: float, first_tops: List,
+               temperature: float, top_k: Optional[int],
+               top_p: Optional[float], presence_penalty: float,
+               frequency_penalty: float, stop_ids: List[int],
+               want_tops: bool, cls: str,
+               kv_sha256: str) -> Dict[str, Any]:
+    """The handoff meta document — one constructor so the prefill
+    handler and the tests can never drift on field names."""
+    return {
+        'handoff_id': handoff_id, 'model': model,
+        'vocab_size': int(vocab_size), 'page_size': int(page_size),
+        'family': family, 'bucket': int(bucket),
+        'tokens': [int(t) for t in tokens], 'max_new': int(max_new),
+        'first_token': int(first_token), 'first_lp': float(first_lp),
+        'first_tops': first_tops or [],
+        'temperature': float(temperature),
+        'top_k': (int(top_k) if top_k else 0),
+        'top_p': (float(top_p) if top_p else 0.0),
+        'presence_penalty': float(presence_penalty),
+        'frequency_penalty': float(frequency_penalty),
+        'stop_ids': [int(i) for i in (stop_ids or ())],
+        'want_tops': bool(want_tops), 'cls': cls,
+        'kv_sha256': kv_sha256,
+        'sent_unix': round(time.time(), 6),
+    }

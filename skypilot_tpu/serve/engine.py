@@ -191,6 +191,24 @@ _M_ADMIT_WAIT = metrics_lib.histogram(
     'KV pages)',
     buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
              1.0, 2.5, 5.0, 10.0, 30.0, 60.0))
+# Disaggregated prefill/decode serving (serve/disagg/handoff.py;
+# docs/serving.md): the three handoff stages this replica can play a
+# part in — exporting a prefilled row's pages (prefill role), shipping
+# them over the framed-TCP transport (prefill role), and adopting
+# received pages into the local pool (decode role). Errors here are
+# the disagg plane's primary health signal; the staged gauge is the
+# decode-side host-memory backlog (pages are NOT held while staged).
+_M_HANDOFF = metrics_lib.counter(
+    'skytpu_engine_handoff_total',
+    'KV page handoff operations by stage (export = gather+device_get '
+    'of a prefilled row, send = framed-TCP ship to the decode '
+    'replica, adopt = scatter into the local page pool) and outcome.',
+    labels={'stage': ('export', 'send', 'adopt'),
+            'outcome': ('ok', 'error')})
+_M_HANDOFF_STAGED = metrics_lib.gauge(
+    'skytpu_engine_handoff_staged',
+    'Handoffs received and staged (host memory) but not yet continued '
+    'by a /disagg/continue call (decode role; sampled at scrape).')
 
 _ENGINE_METRICS = (
     _M_STEP_SECONDS, _M_ADMIT_SECONDS, _M_HOST_SYNC_SECONDS,
@@ -198,7 +216,8 @@ _ENGINE_METRICS = (
     _M_REJECTED, _M_PREFIX, _M_PREFIX_HITS, _M_SPEC_ROUNDS,
     _M_SPEC_PROPOSED, _M_SPEC_ACCEPTED, _M_TTFT, _M_TPOT,
     _M_CLASS_TTFT, _M_CLASS_TPOT, _M_GOODPUT,
-    _M_PAGES_FREE, _M_PAGES_USED, _M_PAGE_ALLOC, _M_ADMIT_WAIT)
+    _M_PAGES_FREE, _M_PAGES_USED, _M_PAGE_ALLOC, _M_ADMIT_WAIT,
+    _M_HANDOFF, _M_HANDOFF_STAGED)
 
 
 def _seed_counter_zeros() -> None:
@@ -827,6 +846,28 @@ class InferenceEngine:
         # one chunk per drained round (the interleave that lets short
         # requests stream while a long prompt fills).
         self._chunk_rr = 0
+        # Disaggregated prefill/decode serving (serve/disagg): request
+        # markers keyed by id(future) — the item TUPLE (and the
+        # multi-host admit protocol built on its shape) stays
+        # untouched. {'mode': 'export'} turns an admission into a
+        # prefill-only request (KV pages exported, no decode);
+        # {'mode': 'adopt', 'meta':…, 'arrays':…} admits a handed-off
+        # request by scattering received pages instead of prefilling.
+        # Marks pop on successful admission; a resurrected item keeps
+        # its mark (same future). Bounded like _submit_meta.
+        self._disagg_marks: Dict[int, Dict[str, Any]] = {}
+        # Export blobs stashed at admission, popped once by the
+        # /disagg/prefill handler that owns the future.
+        self._exports: '_collections.OrderedDict' = \
+            _collections.OrderedDict()
+        # Decode-side handoff plumbing, started by build_app when
+        # handoff_port is set: the framed-TCP receiver and the staged
+        # (meta, arrays) store. Host memory only — device pages are
+        # reserved at adoption time, through the normal allocator.
+        self.role = os.environ.get('SKYTPU_ENGINE_ROLE', '')
+        self.handoff_port: Optional[int] = None
+        self.handoff_store = None
+        self._handoff_receiver = None
 
     def _setup_mesh(self, mesh, quantize: Optional[str]) -> None:
         """Place params on a named mesh with the family's sharding rules;
@@ -905,6 +946,14 @@ class InferenceEngine:
 
     def in_flight(self) -> int:
         return sum(1 for s in getattr(self, 'slots', []) if s is not None)
+
+    def cache_family(self) -> str:
+        """'paged_kv' (dense/GQA/MoE) or 'paged_latent' (MLA) — the
+        handoff-meta family tag a decode replica validates against its
+        own pool (paged mode only)."""
+        from skypilot_tpu.models import mla
+        return ('paged_latent' if isinstance(self.cfg, mla.MLAConfig)
+                else 'paged_kv')
 
     # -- device state ------------------------------------------------------
     def _reset_device_state(self, reason: Optional[str] = None) -> None:
@@ -1421,6 +1470,49 @@ class InferenceEngine:
 
         self._extend_jit = extend_jit
 
+        # --- disaggregated serving: page export / adopt programs ------
+        # Export gathers the first p token positions of one row out of
+        # the page pool as contiguous [L, 1, p, ...] arrays (the
+        # gather_prefix order both families' prefill_extend consumes);
+        # adopt is its exact inverse, scattering shipped rows into the
+        # pages the ADOPTING allocator reserved and re-pinning the
+        # device `last` carry to the prefill-sampled first token. Both
+        # compile per prompt BUCKET (powers of two — the same grid as
+        # admission), so a client-chosen prompt length can never mint
+        # a fresh program shape.
+        def make_export(p):
+            @jax.jit
+            def run(cache, slot):
+                a, b = paging_lib.gather_prefix(cache, slot, p)
+                return repl(a), repl(b)
+            return run
+
+        self._export_jits: Dict[int, Any] = {}
+
+        def export_jit(p):
+            if p not in self._export_jits:
+                self._export_jits[p] = make_export(p)
+            return self._export_jits[p]
+
+        self._export_jit = export_jit
+
+        def make_adopt(s):
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def run(cache, a, b, slot, length, last, first):
+                cache2 = paging_lib.adopt_rows(cache, a, b, slot, s,
+                                               length)
+                return cache2, repl(last.at[slot].set(first))
+            return run
+
+        self._adopt_jits: Dict[int, Any] = {}
+
+        def adopt_jit(s):
+            if s not in self._adopt_jits:
+                self._adopt_jits[s] = make_adopt(s)
+            return self._adopt_jits[s]
+
+        self._adopt_jit = adopt_jit
+
         @jax.jit
         def fix_last(last, mask, vals):
             """Re-sync the device-resident `last` with the host mirror
@@ -1527,6 +1619,14 @@ class InferenceEngine:
                 self._drop_all_slots()
         if self.paged and buckets:
             self._warm_chunk_grid()
+        if self.paged and os.environ.get('SKYTPU_ENGINE_WARM_DISAGG',
+                                         '') == '1':
+            # Disagg pools opt in (the serve controller / LocalStack
+            # set this on pool replicas): compile the page
+            # export/adopt programs for every warm bucket so a
+            # handoff can never hit a fresh XLA compile at a drained
+            # point mid-traffic.
+            self._warm_disagg_grid(buckets or [])
         self.last[:] = 0
         self.last_dev = jnp.zeros(MAX_BATCH, jnp.int32)
         # Warmup admits must not pollute the served-token/step metrics
@@ -1552,6 +1652,39 @@ class InferenceEngine:
                     '+ grouped-admit programs compiled; buckets: '
                     f'{sorted(set([16] + list(buckets or [])))}, '
                     f'group sizes: {self._group_sizes()}).')
+
+    def _warm_disagg_grid(self, buckets: List[int]) -> None:
+        """Compile the export (gather) and adopt (scatter) programs
+        per prompt bucket through the REAL code path: reserve a warm
+        slot's pages, adopt zero rows into them, export them back,
+        release. Garbage KV is fine — the slot is never activated and
+        its pages free right here."""
+        import jax
+        from skypilot_tpu.models import paging as paging_lib
+        jnp = self._jnp
+        pools = [self.cache.k, self.cache.v] \
+            if hasattr(self.cache, 'k') \
+            else [self.cache.c_kv, self.cache.k_rope]
+        for b in sorted({_bucket(b) for b in buckets
+                         if 16 <= b < self.max_len}):
+            need = paging_lib.pages_for(b, self.page_size)
+            if not self.alloc.can_fit(need):
+                continue
+            slot = self._free_slot()
+            if slot is None:
+                continue
+            self._reserve_slot_pages(slot, self._alloc_pages(need))
+            self._refresh_table()
+            a = jnp.zeros((pools[0].shape[0], 1, b,
+                           *pools[0].shape[3:]), pools[0].dtype)
+            bb = jnp.zeros((pools[1].shape[0], 1, b,
+                            *pools[1].shape[3:]), pools[1].dtype)
+            self.cache, self.last_dev = self._adopt_jit(b)(
+                self.cache, a, bb, jnp.int32(slot), jnp.int32(b),
+                self.last_dev, jnp.int32(0))
+            out = self._export_jit(b)(self.cache, jnp.int32(slot))
+            jax.device_get(out)
+            self._release_slot_pages(slot)
 
     def _warm_chunk_grid(self) -> None:
         """Compile every chunked-prefill extend program traffic can
@@ -1665,6 +1798,182 @@ class InferenceEngine:
                                  top_p, presence_penalty,
                                  frequency_penalty, stop_ids=stop_ids)
         return await fut
+
+    # -- disaggregated serving (serve/disagg; docs/serving.md) ----------
+    def mark_prefill_export(self, fut) -> None:
+        """Turn the queued request owning ``fut`` into a PREFILL-ONLY
+        admission: it prefills (grouped or chunked, prefix hits
+        included) and samples its first token exactly like any other
+        request, then — instead of converting to a decoding slot — its
+        KV pages are exported host-side, the slot finishes with reason
+        ``'handoff'``, and the pages free at the very next publish.
+        The export blob waits in :meth:`pop_export` for the
+        /disagg/prefill handler."""
+        self._mark(fut, {'mode': 'export'})
+
+    def submit_adopted(self, meta: Dict[str, Any],
+                       arrays: Dict[str, Any],
+                       stream_q: Optional[asyncio.Queue] = None):
+        """Enqueue a HANDED-OFF request (decode role): admission
+        scatters the shipped page contents into locally-reserved pages
+        (paging.adopt_rows) instead of prefilling, seeds the sampler
+        state from ``meta``, and decode continues token-for-token as
+        if this replica had prefilled the prompt itself (greedy
+        outputs are bit-identical to a monolithic run — pin-tested).
+        Same backpressure surface as submit_nowait: EngineOverloaded
+        on a full queue."""
+        fut = self.submit_nowait(
+            list(meta['tokens']), int(meta['max_new']),
+            float(meta['temperature']),
+            int(meta['top_k']) or None,
+            float(meta['top_p']) or None,
+            float(meta['presence_penalty']),
+            float(meta['frequency_penalty']),
+            stop_ids=tuple(int(i) for i in meta['stop_ids']),
+            want_tops=bool(meta['want_tops']), stream_q=stream_q,
+            cls=str(meta.get('cls', request_class.DEFAULT_CLASS)))
+        self._mark(fut, {'mode': 'adopt', 'meta': meta,
+                         'arrays': arrays})
+        return fut
+
+    def _mark(self, fut, mark: Dict[str, Any]) -> None:
+        self._disagg_marks[id(fut)] = mark
+        while len(self._disagg_marks) > 4096:
+            self._disagg_marks.pop(next(iter(self._disagg_marks)))
+
+    def _mode_of(self, item) -> Optional[str]:
+        fut = item[-1]
+        if fut is None:
+            return None
+        mark = self._disagg_marks.get(id(fut))
+        return mark.get('mode') if mark else None
+
+    def pop_export(self, fut) -> Optional[Dict[str, Any]]:
+        """The prefill-only request's exported pages + geometry,
+        consumed ONCE by the /disagg/prefill handler owning ``fut``.
+        None when the request completed outright at admission (first
+        token hit a stop id, or max_new == 1) — no decode phase
+        remains, so nothing ships."""
+        return self._exports.pop(id(fut), None)
+
+    def handoff_validate(self, meta: Dict[str, Any]) -> Optional[str]:
+        """Receiver-side compatibility check (serve/disagg/handoff.py
+        calls this BEFORE staging): a prefill pool paired with an
+        incompatible decode pool must refuse loudly (kind 'spec'),
+        never adopt garbage. Deep shape skew the cheap checks miss
+        still fails contained at the adopt device call (_fail_all →
+        structured retriable 503)."""
+        if not self.paged:
+            return 'decode replica is not in paged mode (disagg ' \
+                   'requires SKYTPU_ENGINE_PAGED=1)'
+        from skypilot_tpu.models import paging as paging_lib
+        family = ('paged_kv' if isinstance(self.cache, paging_lib.PagedKV)
+                  else 'paged_latent')
+        if meta['family'] != family:
+            return (f'cache family mismatch: handoff {meta["family"]}, '
+                    f'replica {family}')
+        if int(meta['vocab_size']) != self.cfg.vocab_size:
+            return (f'vocab mismatch: handoff {meta["vocab_size"]}, '
+                    f'replica {self.cfg.vocab_size}')
+        if str(meta['model']) != self.model_name:
+            return (f'model mismatch: handoff {meta["model"]!r}, '
+                    f'replica {self.model_name!r}')
+        n = len(meta['tokens'])
+        if n < 1:
+            return 'handoff with empty prompt'
+        if int(meta['bucket']) != _bucket(n):
+            return (f'bucket mismatch: handoff {meta["bucket"]}, '
+                    f'replica computes {_bucket(n)} for {n} tokens')
+        if _bucket(n) + int(meta['max_new']) > self.max_len:
+            return (f'bucketed prompt ({_bucket(n)}) + max_new '
+                    f'({meta["max_new"]}) exceeds replica max_len '
+                    f'{self.max_len}')
+        return None
+
+    def _export_slot(self, slot: int, tokens) -> Dict[str, Any]:
+        """Gather the freshly-prefilled row's first bucket-many token
+        positions out of the page pool into host arrays (the handoff
+        payload). Runs inside the admit call, at a drained point, on
+        the fresh cache the prefill just produced. ``prefill.flush``
+        is the chaos window between 'prefill done' and 'pages
+        exported' (docs/ROBUSTNESS.md)."""
+        import jax
+        import numpy as np
+        if failpoints_lib.ACTIVE and self.warm:
+            failpoints_lib.fire('prefill.flush')
+        p = _bucket(len(tokens))
+        try:
+            a, b = self._export_jit(p)(self.cache,
+                                       self._jnp.int32(slot))
+            t_sync = time.perf_counter()
+            a = np.asarray(jax.device_get(a))
+            b = np.asarray(jax.device_get(b))
+            _M_HOST_SYNC_SECONDS.observe(time.perf_counter() - t_sync)
+        except BaseException:
+            _M_HANDOFF.inc(stage='export', outcome='error')
+            raise
+        _M_HANDOFF.inc(stage='export', outcome='ok')
+        return {'a': a, 'b': b, 'bucket': p, 'length': len(tokens)}
+
+    def _admit_adopted(self, item) -> int:
+        """Admit one handed-off request (drained points only): reserve
+        worst-case pages through the LOCAL allocator, scatter the
+        shipped page contents in, seed sampler state + penalty counts
+        + the device `last` carry from the handoff meta, and convert
+        straight to a decoding slot via _finish_admit — the first
+        token (sampled on the prefill replica) streams at the next
+        publish and decode proceeds on the standard step path."""
+        assert not self._inflight, \
+            'adopt while a step is in flight (collect must precede ' \
+            'slot reuse)'
+        fut = item[-1]
+        mark = self._disagg_marks.get(id(fut)) or {}
+        meta, arrays = mark.get('meta'), mark.get('arrays')
+        if meta is None:
+            # Mark aged out of the bounded dict (pathological backlog):
+            # the decode replica is a full engine — prefill locally
+            # instead of failing the request. Greedy outputs are
+            # identical either way.
+            logger.warning('adopt mark lost; falling back to a local '
+                           'prefill admission')
+            self._admit_group([item])
+            return -1
+        if failpoints_lib.ACTIVE and self.warm:
+            failpoints_lib.fire('engine.admit')
+        t0 = time.perf_counter()
+        jnp = self._jnp
+        tokens, max_new, temperature, top_k, top_p, pres, freq = item[:7]
+        slot = self._free_slot()
+        assert slot is not None
+        self.temp[slot] = max(float(temperature), 0.0)
+        self.topk[slot] = int(top_k) if top_k else 0
+        self.topp[slot] = float(top_p) if top_p else 0.0
+        self.pres[slot] = float(pres or 0.0)
+        self.freq[slot] = float(freq or 0.0)
+        self._reserve_slot_pages(
+            slot, self._alloc_pages(self._pages_needed(item)))
+        self._refresh_table()
+        s = _bucket(len(tokens))
+        first = int(meta['first_token'])
+        try:
+            self.cache, self.last_dev = self._adopt_jit(s)(
+                self.cache, jnp.asarray(arrays['a']),
+                jnp.asarray(arrays['b']), jnp.int32(slot),
+                jnp.int32(len(tokens)), self.last_dev,
+                jnp.int32(first))
+        except BaseException:
+            _M_HANDOFF.inc(stage='adopt', outcome='error')
+            raise
+        self.counts = self.counts.at[slot].set(0).at[slot, first].add(1)
+        # Admission anchor: adoption IS this replica's prefill phase.
+        self._admit_t0_ns = time.monotonic_ns()
+        self._finish_admit(item, slot, first, float(meta['first_lp']),
+                           list(meta.get('first_tops') or []))
+        self._disagg_marks.pop(id(fut), None)
+        self.flight.record(flight_lib.ADMIT, slot, s)
+        _M_HANDOFF.inc(stage='adopt', outcome='ok')
+        _M_ADMIT_SECONDS.observe(time.perf_counter() - t0)
+        return slot
 
     def _bcast(self, op) -> None:
         """Leader→follower control broadcast (multi-host serving);
@@ -1883,6 +2192,27 @@ class InferenceEngine:
             if len(entry['out']) >= max_new:
                 entry['finish'] = 'length'
         self.slots[slot] = entry
+        # Prefill-only (disaggregated serving): the row's job ends at
+        # its first sampled token — export the prefilled pages for the
+        # handoff and finish with reason 'handoff'; publish resolves
+        # the future and frees the pages at the next drained point. A
+        # request that finished outright (first token hit a stop id,
+        # max_new == 1) skips the export: no decode phase remains.
+        if fut is not None:
+            mark = self._disagg_marks.get(id(fut))
+            if mark is not None and mark.get('mode') == 'export':
+                self._disagg_marks.pop(id(fut), None)
+                if entry['finish'] is None:
+                    # Export BEFORE marking finished: a failed export
+                    # (prefill.flush chaos, device fault) leaves the
+                    # row unfinished, so _fail_all surfaces the
+                    # standard structured retriable 503 instead of
+                    # resolving a handoff that has no pages.
+                    self._exports[id(fut)] = self._export_slot(slot,
+                                                               tokens)
+                    while len(self._exports) > 256:
+                        self._exports.popitem(last=False)
+                    entry['finish'] = 'handoff'
 
     @timeline.event
     def _admit_group(self, items) -> None:
@@ -2556,6 +2886,22 @@ class InferenceEngine:
         decode_s = max(0.0, (done_ns - s['t_first_ns']) / 1e9)
         ttft = queue_s + prefill_s
         tpot = decode_s / (n - 1) if n > 1 else None
+        if s['finish'] == 'handoff':
+            # Prefill-only rows skip the fleet latency/goodput
+            # families: the DECODE replica finishes the same logical
+            # request and counting both sides would double every
+            # disagg request in the merged fleet view. The prefill
+            # side's own signal is the admission-wait histogram (the
+            # prefill_queue SLO kind) observed at _finish_admit.
+            if s['fut'] is not None:
+                self._timings[id(s['fut'])] = {
+                    'submit_wall': s['t_submit_wall'],
+                    'queue_s': queue_s, 'prefill_s': prefill_s,
+                    'decode_s': 0.0, 'ttft_s': ttft, 'tpot_s': None,
+                    'tokens': n, 'finish': s['finish']}
+                while len(self._timings) > 1024:
+                    self._timings.popitem(last=False)
+            return
         _M_TTFT.observe(ttft)
         if tpot is not None:
             _M_TPOT.observe(tpot)
@@ -2614,8 +2960,11 @@ class InferenceEngine:
                 self._hold_waited.discard(id(it))
                 # Dropping the item is where its resurrection budget
                 # dies too — a stale id(fut) entry could otherwise be
-                # inherited by a later future reusing the id.
+                # inherited by a later future reusing the id. Disagg
+                # marks (export/adopt payloads) die with it for the
+                # same reason.
                 self._resurrect_counts.pop(id(it[-1]), None)
+                self._disagg_marks.pop(id(it[-1]), None)
                 continue          # cancelled while waiting
             if len(items) < free_slots and fits(it):
                 self._hold_waited.discard(id(it))
@@ -2627,6 +2976,7 @@ class InferenceEngine:
             it = self._queue.get_nowait()
             if it[-1] is not None and it[-1].done():
                 self._resurrect_counts.pop(id(it[-1]), None)
+                self._disagg_marks.pop(id(it[-1]), None)
                 continue          # cancelled while queued
             if fits(it):
                 items.append(it)
@@ -2669,8 +3019,20 @@ class InferenceEngine:
         # fan-out cancelling its enqueued siblings) — don't burn a
         # prefill on them.
         items = self._drain_admissible()
-        grouped = [it for it in items if not self._should_chunk(it)]
-        chunked = [it for it in items if self._should_chunk(it)]
+        # Handed-off requests (decode role) admit by page ADOPTION —
+        # they carry their KV, so neither the grouped-prefill nor the
+        # chunked path applies. Disagg is single-host (no _ctrl): the
+        # multihost seam is documented in docs/serving.md.
+        adopted = [it for it in items if self._mode_of(it) == 'adopt']
+        adopted_ids = {id(it) for it in adopted}
+        rest = [it for it in items if id(it) not in adopted_ids]
+        grouped = [it for it in rest if not self._should_chunk(it)]
+        chunked = [it for it in rest if self._should_chunk(it)]
+        for item in adopted:
+            try:
+                await asyncio.to_thread(self._admit_adopted, item)
+            except Exception as e:  # pylint: disable=broad-except
+                self._fail_all(e, extra=item)
         for group in self._admit_groups(grouped):
             if self._ctrl is not None:
                 from skypilot_tpu.serve import multihost
@@ -3185,6 +3547,11 @@ def build_app(engine: InferenceEngine):
         }
         if engine.paged and engine.alloc is not None:
             doc['kv_pages_free'] = engine.alloc.free_count
+        if engine.role:
+            doc['role'] = engine.role
+        if engine.handoff_store is not None:
+            doc['handoff_port'] = engine.handoff_port
+            doc['handoff_staged'] = len(engine.handoff_store)
         return web.json_response(doc)
 
     async def metrics(request):
@@ -3199,6 +3566,8 @@ def build_app(engine: InferenceEngine):
         if engine.paged and engine.alloc is not None:
             _M_PAGES_FREE.set(engine.alloc.free_count)
             _M_PAGES_USED.set(engine.alloc.used_count)
+        if engine.handoff_store is not None:
+            _M_HANDOFF_STAGED.set(len(engine.handoff_store))
         return web.Response(text=metrics_lib.render(),
                             content_type='text/plain')
 
@@ -3519,6 +3888,261 @@ def build_app(engine: InferenceEngine):
                       'owned_by': 'skytpu'}],
         })
 
+    # -- disaggregated prefill/decode (serve/disagg; docs/serving.md) --
+    def _disagg_unsupported(msg: str):
+        return web.json_response(
+            {'error': {'message': msg, 'type': 'handoff_unsupported'}},
+            status=501)
+
+    def _disagg_done_doc(orig: str, body, out, finish, lps,
+                         n_prompt: int = 0):
+        """The final response document (in the ORIGINAL endpoint's
+        shape) for a request that completed at prefill admission —
+        first token hit a stop id or max_new == 1, so there is no
+        decode phase to hand off."""
+        if orig == '/v1/completions':
+            text = engine.tokenizer.decode(out)
+            return {
+                'id': f'cmpl-{time.time_ns()}',
+                'object': 'text_completion', 'created': int(time.time()),
+                'model': body.get('model', engine.model_name),
+                'choices': [{'text': text, 'index': 0, 'logprobs': None,
+                             'finish_reason': finish}],
+                'usage': {'prompt_tokens': n_prompt,
+                          'completion_tokens': len(out),
+                          'total_tokens': n_prompt + len(out)},
+            }
+        doc = {'tokens': out, 'finish_reason': finish, 'logprobs': lps}
+        if 'text' in body:
+            doc['text'] = engine.tokenizer.decode(out)
+        return doc
+
+    async def disagg_prefill(request):
+        """Stage 1 of the two-stage disagg pipeline (the LB drives
+        it): prefill the prompt + sample the first token on THIS
+        replica, export the KV pages, ship them npy-framed to the
+        decode replica named by X-Skytpu-Handoff-Target, and answer
+        with the handoff id the LB passes to /disagg/continue. The
+        request body is the ORIGINAL endpoint's body (?orig= names
+        it), so the LB forwards bytes, not a re-encoding."""
+        if not engine.paged:
+            return _disagg_unsupported(
+                'disagg requires paged mode (SKYTPU_ENGINE_PAGED=1)')
+        if engine._ctrl is not None:  # pylint: disable=protected-access
+            return _disagg_unsupported(
+                'disagg prefill is single-host for now (multi-host '
+                'page export is a documented seam, docs/serving.md)')
+        target = request.headers.get('X-Skytpu-Handoff-Target',
+                                     '').strip()
+        if not target:
+            return web.json_response(
+                {'error': 'missing X-Skytpu-Handoff-Target header'},
+                status=400)
+        try:
+            body = await request.json()
+        except ValueError:
+            return web.json_response({'error': 'bad json'}, status=400)
+        orig = request.query.get('orig', '/generate')
+        want_tops = False
+        try:
+            if orig == '/v1/completions':
+                prompts = _resolve_prompts(engine, body.get('prompt', ''))
+                if len(prompts) != 1 or not prompts[0]:
+                    raise ValueError('disagg prefill serves exactly one '
+                                     'non-empty prompt')
+                tokens = prompts[0]
+                max_new = int(body.get('max_tokens', 16))
+                sampling = _parse_sampling(body, default_temperature=1.0)
+                stop_ids = _parse_stop_ids(body, engine.tokenizer)
+                want_logprobs, top_n = _parse_logprobs(body)
+                want_tops = want_logprobs and top_n > 0
+                n, best_of = _parse_n(body)
+                if n != 1 or best_of != 1:
+                    raise ValueError('disagg prefill serves '
+                                     'single-choice requests (n=1)')
+            elif orig == '/generate':
+                if 'text' in body:
+                    tokens = [int(t) for t in
+                              engine.tokenizer.encode(str(body['text']))]
+                else:
+                    tokens = [int(t) for t in body['tokens']]
+                if not tokens:
+                    raise ValueError('empty prompt')
+                max_new = int(body.get('max_new_tokens', 64))
+                sampling = _parse_sampling(body)
+                stop_ids = (tuple(int(i) for i in body['stop_token_ids'])
+                            if 'stop_token_ids' in body else ())
+            else:
+                raise ValueError(f'unsupported orig endpoint {orig!r}')
+            if max_new < 1:
+                raise ValueError('max new tokens must be >= 1')
+        except (TypeError, ValueError, KeyError) as e:
+            return web.json_response(
+                {'error': f'invalid request: {e}'}, status=400)
+        msg = _check_len(engine, tokens, max_new)
+        if msg:
+            return web.json_response({'error': msg}, status=400)
+        cls = request_class.from_headers(request.headers)
+        try:
+            fut = engine.submit_nowait(tokens, max_new, *sampling,
+                                       stop_ids=stop_ids,
+                                       want_tops=want_tops, cls=cls)
+            engine.mark_prefill_export(fut)
+            out, finish, lps, tops = await fut
+        except EngineOverloaded as e:
+            return web.json_response({'error': str(e)}, status=429)
+        except EngineResetError as e:
+            return _reset_error_response(web, e)
+        _record_request_spans(engine, request.headers, [fut])
+        blob = engine.pop_export(fut)
+        if finish != 'handoff':
+            # Completed outright at admission — nothing to hand off.
+            return web.json_response(
+                {'done': _disagg_done_doc(orig, body, out, finish, lps,
+                                          n_prompt=len(tokens))})
+        if blob is None:
+            # finish says handoff but the export stash aged out (a
+            # pathological handler backlog): retriable.
+            return web.json_response(
+                {'error': {'message': 'export blob lost before send',
+                           'type': 'handoff_send_error',
+                           'retriable': True}},
+                status=503, headers={'Retry-After': '1'})
+        from skypilot_tpu.serve.disagg import handoff as handoff_lib
+        from skypilot_tpu.utils import framed
+        temperature, top_k, top_p, pres, freq = sampling
+        arrays = {'a': blob['a'], 'b': blob['b']}
+        meta = handoff_lib.build_meta(
+            handoff_id=handoff_lib.new_handoff_id(),
+            model=engine.model_name,
+            vocab_size=engine.cfg.vocab_size,
+            page_size=engine.page_size, family=engine.cache_family(),
+            bucket=blob['bucket'], tokens=tokens, max_new=max_new,
+            first_token=int(out[0]),
+            first_lp=(float(lps[0]) if lps else 0.0),
+            first_tops=(tops[0] if tops else []),
+            temperature=temperature, top_k=top_k, top_p=top_p,
+            presence_penalty=pres, frequency_penalty=freq,
+            stop_ids=list(stop_ids), want_tops=want_tops, cls=cls,
+            kv_sha256=handoff_lib.kv_fingerprint(arrays))
+        try:
+            await asyncio.to_thread(handoff_lib.send,
+                                    framed.parse_addr(target), meta,
+                                    arrays)
+        except handoff_lib.HandoffError as e:
+            _M_HANDOFF.inc(stage='send', outcome='error')
+            status = 503 if e.retriable else 400
+            headers = {'Retry-After': '1'} if e.retriable else None
+            return web.json_response(
+                {'error': {'message': str(e),
+                           'type': 'handoff_send_error', 'kind': e.kind,
+                           'retriable': e.retriable}},
+                status=status, headers=headers)
+        _M_HANDOFF.inc(stage='send', outcome='ok')
+        return web.json_response(
+            {'handoff': {'id': meta['handoff_id'],
+                         'first_token': int(out[0]),
+                         'prompt_tokens': len(tokens)}})
+
+    async def disagg_continue(request):
+        """Stage 2: adopt the staged pages into this replica's pool
+        and run the decode phase, answering in the ORIGINAL endpoint's
+        shape (?orig=), SSE streaming included. A missing handoff id
+        (expired, already consumed, or never received — the prefill
+        replica may have died mid-send) is a structured retriable 503:
+        the LB re-runs the whole pipeline."""
+        if engine.handoff_store is None:
+            return _disagg_unsupported(
+                'no handoff receiver on this replica '
+                '(set --handoff-port)')
+        try:
+            body = await request.json()
+        except ValueError:
+            return web.json_response({'error': 'bad json'}, status=400)
+        orig = request.query.get('orig', '/generate')
+        hid = str(body.get('handoff_id', ''))
+        entry = engine.handoff_store.pop(hid) if hid else None
+        _M_HANDOFF_STAGED.set(len(engine.handoff_store))
+        if entry is None:
+            return web.json_response(
+                {'error': {'message': f'handoff {hid!r} not staged '
+                                      f'(expired, consumed, or never '
+                                      f'received)',
+                           'type': 'handoff_missing', 'retriable': True}},
+                status=503, headers={'Retry-After': '0'})
+        meta, arrays = entry
+        stream = bool(body.get('stream'))
+        if not stream:
+            try:
+                fut = engine.submit_adopted(meta, arrays)
+                out, finish, lps, tops = await fut
+            except EngineOverloaded as e:
+                return web.json_response({'error': str(e)}, status=429)
+            except EngineResetError as e:
+                return _reset_error_response(web, e)
+            del tops
+            _record_request_spans(engine, request.headers, [fut])
+            return web.json_response(
+                _disagg_done_doc(orig, body, out, finish, lps,
+                                 n_prompt=len(meta['tokens'])))
+        # SSE decode stream in the completions chunk shape (the one
+        # streaming transport the disagg router routes — the LB's
+        # eligibility check pins it).
+        from skypilot_tpu.data.tokenizer import StreamDecoder
+        try:
+            q: asyncio.Queue = asyncio.Queue()
+            fut = engine.submit_adopted(meta, arrays, stream_q=q)
+        except EngineOverloaded as e:
+            return web.json_response({'error': str(e)}, status=429)
+        rid = f'cmpl-{time.time_ns()}'
+        created = int(time.time())
+        model = body.get('model', engine.model_name)
+        resp = web.StreamResponse()
+        resp.headers['Content-Type'] = 'text/event-stream'
+        resp.headers['Cache-Control'] = 'no-cache'
+        await resp.prepare(request)
+
+        async def send_doc(doc) -> None:
+            await resp.write(b'data: ' +
+                             json_lib.dumps(doc).encode() + b'\n\n')
+
+        decoder = StreamDecoder(engine.tokenizer)
+        try:
+            while True:
+                item = await q.get()
+                if item is None:
+                    break
+                piece = decoder.feed([item[0]])
+                if piece:
+                    await send_doc({
+                        'id': rid, 'object': 'text_completion',
+                        'created': created, 'model': model,
+                        'choices': [{'text': piece, 'index': 0,
+                                     'logprobs': None,
+                                     'finish_reason': None}]})
+            try:
+                _, finish, _, _ = await fut
+            except EngineResetError as e:
+                # Mid-stream reset: the structured event IS the
+                # truncation marker ([DONE] never arrives).
+                await send_doc({'error': {
+                    'message': str(e), 'type': 'engine_reset_error',
+                    'retriable': True,
+                    'tokens_emitted': e.tokens_emitted}})
+                return resp
+            tail = decoder.flush()
+            await send_doc({
+                'id': rid, 'object': 'text_completion',
+                'created': created, 'model': model,
+                'choices': [{'text': tail, 'index': 0, 'logprobs': None,
+                             'finish_reason': finish}]})
+            await resp.write(b'data: [DONE]\n\n')
+        except (ConnectionResetError, OSError):
+            engine.cancel(fut)
+        finally:
+            _record_request_spans(engine, request.headers, [fut])
+        return resp
+
     app = web.Application()
     app.router.add_get('/health', health)
     app.router.add_get('/', health)
@@ -3528,10 +4152,24 @@ def build_app(engine: InferenceEngine):
     app.router.add_post('/v1/completions', openai_completions)
     app.router.add_post('/v1/chat/completions', openai_chat)
     app.router.add_get('/v1/models', openai_models)
+    app.router.add_post('/disagg/prefill', disagg_prefill)
+    app.router.add_post('/disagg/continue', disagg_continue)
 
     async def _start(app_):
-        del app_
         engine.start()
+        # Decode-side page handoff listener (framed TCP): any paged
+        # replica with a handoff port can adopt — the CONTROL plane
+        # decides which pool a replica serves in; the engine itself is
+        # role-capable both ways (a decode replica still serves
+        # monolithic traffic for request shapes the two-stage router
+        # does not cover).
+        if engine.paged and engine.handoff_port:
+            from skypilot_tpu.serve.disagg import handoff as handoff_lib
+            engine.handoff_store = handoff_lib.HandoffStore()
+            engine._handoff_receiver = handoff_lib.HandoffReceiver(
+                '0.0.0.0', engine.handoff_port, engine.handoff_store,
+                validate=engine.handoff_validate).start()
+            app_['handoff_receiver'] = engine._handoff_receiver
 
     async def _observe_gc_loop():
         # The replica writes span rows per request and multi-MB
@@ -3547,6 +4185,10 @@ def build_app(engine: InferenceEngine):
             except Exception:  # pylint: disable=broad-except
                 logger.warning('observe GC pass failed (will retry)',
                                exc_info=True)
+            if engine.handoff_store is not None:
+                # Orphaned handoffs also sweep lazily on every
+                # put/pop; this catches a fully idle store.
+                engine.handoff_store.sweep()
 
     async def _start_gc(app_):
         app_['observe_gc'] = asyncio.create_task(_observe_gc_loop())
@@ -3555,6 +4197,9 @@ def build_app(engine: InferenceEngine):
         task = app_.pop('observe_gc', None)
         if task is not None:
             task.cancel()
+        receiver = app_.pop('handoff_receiver', None)
+        if receiver is not None:
+            await asyncio.to_thread(receiver.stop)
 
     app.on_startup.append(_start)
     app.on_startup.append(_start_gc)
@@ -3614,6 +4259,13 @@ def build_parser() -> argparse.ArgumentParser:
                         default=int(os.environ.get('SKYTPU_SERVE_PORT',
                                                    '8000')))
     parser.add_argument('--host', default='0.0.0.0')
+    # Disaggregated serving: the framed-TCP port this replica accepts
+    # KV page handoffs on (serve/disagg). Default -1 = the fixed
+    # HANDOFF_PORT_OFFSET convention (HTTP port + 1000) the LB derives
+    # decode targets from; 0 disables the receiver entirely.
+    parser.add_argument('--handoff-port', type=int,
+                        default=int(os.environ.get(
+                            'SKYTPU_ENGINE_HANDOFF_PORT', '-1')))
     return parser
 
 
@@ -3646,6 +4298,17 @@ def main() -> None:
                              tokenizer_path=args.tokenizer,
                              max_len=args.max_len, quantize=args.quantize,
                              mesh=args.mesh, seed=seed)
+    # KV handoff receiver port (disagg decode role): -1 = derive from
+    # the HTTP port by the fixed offset, 0 = disabled. Multi-host
+    # serving disables it — page export across a gang is a documented
+    # seam (docs/serving.md).
+    if args.handoff_port < 0:
+        from skypilot_tpu.serve.disagg import handoff as handoff_lib
+        engine.handoff_port = args.port + handoff_lib.HANDOFF_PORT_OFFSET
+    else:
+        engine.handoff_port = args.handoff_port or None
+    if multihost_on:
+        engine.handoff_port = None
     if args.warm_buckets == 'all':
         buckets = engine.all_buckets()
     else:

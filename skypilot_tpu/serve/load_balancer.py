@@ -107,6 +107,20 @@ _LB_CLASS_REQUESTS = metrics_lib.counter(
     'before it can reach any label set) — the offered-load side the '
     'loadgen scorecard reconciles against engine-side goodput.',
     labels={'cls': request_class.CLASSES})
+# Disaggregated two-stage routing (serve/disagg; docs/serving.md):
+# per-stage outcomes of the prefill→handoff→decode pipeline. 'retry'
+# counts attempts reroute/re-run; 'fallback' counts eligible requests
+# served single-stage because a pool was empty.
+_LB_HANDOFF = metrics_lib.counter(
+    'skytpu_lb_handoff_total',
+    'Two-stage disaggregated requests by pipeline stage and outcome.',
+    labels={'stage': ('prefill', 'decode'),
+            'outcome': ('ok', 'retry', 'error', 'fallback')})
+_LB_HANDOFF_SECONDS = metrics_lib.histogram(
+    'skytpu_lb_handoff_seconds',
+    'Stage-1 wall time of the disagg pipeline: pick → prefill replica '
+    'prefills + ships pages → handoff ack (the end-to-end handoff '
+    'overhead a monolithic pool does not pay).')
 _BREAKER_STATES = ('closed', 'open', 'half_open')
 _LB_BREAKER_STATE = metrics_lib.gauge(
     'skytpu_lb_breaker_state',
@@ -295,6 +309,10 @@ class LoadBalancer:
         # scraper).
         self._scraper = None
         self._slo_engine = None
+        # Disaggregated pools (serve/disagg): set by the controller
+        # when the service declares prefill/decode pools. None = every
+        # request routes single-stage over the _ready set.
+        self._pools: Optional[lb_policies.PoolRouter] = None
 
     def attach_fleet(self, scraper, slo_engine=None) -> None:
         """Give the /-/fleet/ endpoints their data sources (the
@@ -306,6 +324,21 @@ class LoadBalancer:
                                queue_depths: Dict[str, float]) -> None:
         """Controller scrape-round hook → the policy's tie-breaker."""
         self.policy.set_replica_saturation(queue_depths)
+        if self._pools is not None:
+            self._pools.set_saturation(queue_depths)
+
+    def set_pool_replicas(self, prefill_urls: List[str],
+                          decode_urls: List[str]) -> None:
+        """Disaggregated pools (controller reconcile thread): eligible
+        generation traffic routes two-stage — class/length-aware pick
+        over the prefill pool, session-ring pick over the decode pool
+        — while everything else proxies single-stage over the _ready
+        set (the controller points that at the decode pool, whose
+        replicas are full engines). Reference swaps only, like
+        set_ready_replicas."""
+        if self._pools is None:
+            self._pools = lb_policies.PoolRouter()
+        self._pools.set_pools(prefill_urls, decode_urls)
 
     def set_ready_replicas(self, urls: List[str]) -> None:
         """Called from the controller's reconcile THREAD: only swaps
@@ -494,7 +527,27 @@ class LoadBalancer:
         # above): the engine labels its per-class TTFT/TPOT/goodput
         # off this value, and normalizes again on arrival.
         headers[request_class.HEADER] = cls
+        # Disaggregated two-stage routing: eligible generation POSTs
+        # (single prompt, long enough — PoolRouter.plan is the
+        # class/length-aware gate) run prefill-pool-first with a KV
+        # page handoff to the ring-pinned decode replica. Everything
+        # else falls through to the single-stage proxy over _ready
+        # (the decode pool — its replicas are full engines).
+        plan = None
+        if self._pools is not None and self._pools.has_pools() and \
+                self._pools.eligible(request.method,
+                                     request.rel_url.path):
+            import json
+            try:
+                payload = json.loads(body) if body else None
+            except (ValueError, UnicodeDecodeError):
+                payload = None
+            plan = self._pools.plan(request.method,
+                                    request.rel_url.path, payload, cls)
         try:
+            if plan is not None:
+                return await self._disagg_attempts(request, root, body,
+                                                   headers, plan)
             return await self._proxy_attempts(request, root, key,
                                               body, headers)
         finally:
@@ -668,6 +721,239 @@ class LoadBalancer:
             {'error': f'upstream failed after {attempts} attempt(s): '
                       f'{last_err}',
              'retriable': True}, status=502)
+
+    # ------------------------------------------------------------------
+    # Disaggregated two-stage pipeline (serve/disagg; docs/serving.md)
+    # ------------------------------------------------------------------
+    async def _disagg_attempts(self, request: web.Request,
+                               root: 'spans_lib.Span', body: bytes,
+                               headers: Dict[str, str],
+                               plan: Dict[str, typing.Any]
+                               ) -> web.StreamResponse:
+        """Bounded retry loop over the whole prefill→handoff→decode
+        pipeline. Stage-1 failures (prefill replica dead, handoff.send
+        armed, mid-handoff kill) reroute to ANOTHER prefill replica —
+        nothing has streamed to the client, so the retry is
+        idempotent-safe. Stage-2 pre-header failures (handoff_missing:
+        the pages never arrived or expired; decode 5xx) re-run the
+        WHOLE pipeline — the handoff is consumed-at-most-once, so a
+        fresh prefill mints a fresh one. A failure after response
+        bytes reached the client truncates honestly, exactly like the
+        single-stage proxy. Exhausted attempts surface a structured
+        retriable 502."""
+        root.set_attr('disagg', True)
+        session = request.headers.get('X-Skytpu-Session', '').strip()
+        key = session[:128] if session else _affinity_key(request, body)
+        attempts = self._retries + 1
+        tried_prefill: set = set()
+        tried_decode: set = set()
+        last_err = 'no pool replica available'
+        for attempt in range(attempts):
+            prefill_url = self._pools.pick_prefill(tried_prefill)
+            if prefill_url is None and tried_prefill:
+                # Every prefill replica already failed this request:
+                # widen rather than 502 while one may have recovered.
+                tried_prefill = set()
+                prefill_url = self._pools.pick_prefill()
+            decode_url = self._pools.pick_decode(key, tried_decode)
+            if decode_url is None and tried_decode:
+                tried_decode = set()
+                decode_url = self._pools.pick_decode(key)
+            if prefill_url is None or decode_url is None:
+                break
+            self._pools.request_started(prefill_url, decode_url)
+            try:
+                kind, value = await self._disagg_one(
+                    request, root, body, headers, plan, prefill_url,
+                    decode_url, attempt)
+            finally:
+                self._pools.request_finished(prefill_url, decode_url)
+            if kind == 'response':
+                return value
+            last_err = value
+            if kind == 'stage1_retry':
+                tried_prefill.add(prefill_url)
+                _LB_HANDOFF.inc(stage='prefill', outcome='retry')
+            else:
+                # Step the pipeline re-run off this decode replica
+                # too: the ring pick is deterministic, so a dead
+                # replica would otherwise be re-picked every attempt.
+                # (handoff_missing also lands here — moving one
+                # request off its session home is harmless; the
+                # pages ship fresh wherever the retry prefills.)
+                tried_decode.add(decode_url)
+                _LB_HANDOFF.inc(stage='decode', outcome='retry')
+            if attempt + 1 < attempts:
+                await asyncio.sleep(self._retry_backoff * (2 ** attempt))
+        _LB_REQUESTS.inc(policy=self.policy_name,
+                         outcome='upstream_error')
+        root.set_attr('outcome', 'upstream_error')
+        return web.json_response(
+            {'error': f'disaggregated pipeline failed after '
+                      f'{attempts} attempt(s): {last_err}',
+             'retriable': True}, status=502,
+            headers={'Retry-After': '1'})
+
+    async def _disagg_one(self, request: web.Request,
+                          root: 'spans_lib.Span', body: bytes,
+                          headers: Dict[str, str],
+                          plan: Dict[str, typing.Any],
+                          prefill_url: str, decode_url: str,
+                          attempt: int) -> tuple:
+        """One pipeline attempt. Returns ('response', resp) when a
+        final answer (success or non-retriable refusal) exists,
+        ('stage1_retry', why) to reroute prefill, or
+        ('pipeline_retry', why) to re-run both stages."""
+        from skypilot_tpu.serve.disagg import handoff as handoff_lib
+        orig = plan['path']
+        h_host, h_port = handoff_lib.handoff_addr_for_url(decode_url)
+        s1_headers = dict(headers)
+        s1_headers['X-Skytpu-Handoff-Target'] = f'{h_host}:{h_port}'
+        t0 = time.monotonic()
+        with spans_lib.span('lb.prefill', entity=self.service_name,
+                            attrs={'replica': prefill_url,
+                                   'attempt': attempt}):
+            try:
+                if failpoints_lib.ACTIVE:
+                    failpoints_lib.fire('lb.upstream_connect')
+                async with self._session.post(
+                        prefill_url.rstrip('/') +
+                        f'/disagg/prefill?orig={orig}',
+                        data=body, headers=s1_headers) as r1:
+                    status1 = r1.status
+                    try:
+                        doc = await r1.json(content_type=None)
+                    except ValueError:
+                        doc = None
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
+                    failpoints_lib.FailpointError) as e:
+                return ('stage1_retry',
+                        f'prefill {prefill_url}: '
+                        f'{type(e).__name__}: {e}')
+        _LB_HANDOFF_SECONDS.observe(time.monotonic() - t0)
+        if status1 == 200 and isinstance(doc, dict) and 'done' in doc:
+            # Completed at prefill admission (stop-id first token /
+            # max_new == 1): no decode stage.
+            _LB_HANDOFF.inc(stage='prefill', outcome='ok')
+            _LB_REQUESTS.inc(policy=self.policy_name,
+                             outcome='proxied')
+            root.set_attr('outcome', 'proxied')
+            return ('response', await self._disagg_done_response(
+                request, plan, doc['done']))
+        if status1 != 200 or not isinstance(doc, dict) or \
+                'handoff' not in doc:
+            if status1 in (429, 502, 503):
+                return ('stage1_retry',
+                        f'prefill {prefill_url} answered {status1}')
+            if status1 == 200:
+                # 200 with a body that is neither 'done' nor
+                # 'handoff': a broken replica (or intermediary) —
+                # never hand the client a 200-wrapped error doc.
+                return ('stage1_retry',
+                        f'prefill {prefill_url} answered 200 with '
+                        f'an unrecognizable body')
+            # Non-retriable refusal (bad request, spec mismatch):
+            # the client must see it.
+            _LB_HANDOFF.inc(stage='prefill', outcome='error')
+            root.set_attr('outcome', 'upstream_error')
+            return ('response', web.json_response(
+                doc if isinstance(doc, dict) else
+                {'error': f'prefill replica answered {status1}'},
+                status=status1))
+        _LB_HANDOFF.inc(stage='prefill', outcome='ok')
+        payload = {'handoff_id': doc['handoff']['id'],
+                   'stream': plan['stream']}
+        resp: Optional[web.StreamResponse] = None
+        with spans_lib.span('lb.decode', entity=self.service_name,
+                            attrs={'replica': decode_url,
+                                   'attempt': attempt}):
+            try:
+                async with self._session.post(
+                        decode_url.rstrip('/') +
+                        f'/disagg/continue?orig={orig}',
+                        json=payload, headers=headers) as upstream:
+                    if upstream.status != 200:
+                        try:
+                            doc2 = await upstream.json(content_type=None)
+                        except ValueError:
+                            doc2 = {'error': f'decode replica answered '
+                                             f'{upstream.status}'}
+                        if upstream.status in (429, 502, 503):
+                            return ('pipeline_retry',
+                                    f'decode {decode_url} answered '
+                                    f'{upstream.status}')
+                        _LB_HANDOFF.inc(stage='decode', outcome='error')
+                        root.set_attr('outcome', 'upstream_error')
+                        return ('response', web.json_response(
+                            doc2, status=upstream.status))
+                    resp = web.StreamResponse(status=200)
+                    for k, v in upstream.headers.items():
+                        if k.lower() not in _HOP_HEADERS:
+                            resp.headers[k] = v
+                    await _downstream(resp.prepare(request))
+                    while True:
+                        if failpoints_lib.ACTIVE:
+                            failpoints_lib.fire('lb.upstream_read')
+                        chunk = await upstream.content.readany()
+                        if not chunk:
+                            break
+                        await _downstream(resp.write(chunk))
+                    await _downstream(resp.write_eof())
+                    _LB_HANDOFF.inc(stage='decode', outcome='ok')
+                    _LB_REQUESTS.inc(policy=self.policy_name,
+                                     outcome='proxied')
+                    root.set_attr('outcome', 'proxied')
+                    return ('response', resp)
+            except _ClientAborted:
+                _LB_REQUESTS.inc(policy=self.policy_name,
+                                 outcome='client_abort')
+                root.set_attr('outcome', 'client_abort')
+                if resp is not None and resp.prepared:
+                    resp.force_close()
+                    return ('response', resp)
+                return ('response', web.Response(status=499))
+            except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
+                    failpoints_lib.FailpointError) as e:
+                if resp is not None and resp.prepared:
+                    # Mid-stream: truncate honestly (never a silent
+                    # replay — tokens already reached the client).
+                    logger.warning(f'Decode {decode_url} failed '
+                                   f'mid-stream: {e}')
+                    resp.force_close()
+                    if request.transport is not None:
+                        request.transport.close()
+                    _LB_HANDOFF.inc(stage='decode', outcome='error')
+                    _LB_REQUESTS.inc(policy=self.policy_name,
+                                     outcome='upstream_error')
+                    root.set_attr('outcome', 'upstream_error')
+                    return ('response', resp)
+                return ('pipeline_retry',
+                        f'decode {decode_url}: '
+                        f'{type(e).__name__}: {e}')
+
+    async def _disagg_done_response(self, request: web.Request,
+                                    plan: Dict[str, typing.Any],
+                                    done_doc: Dict[str, typing.Any]
+                                    ) -> web.StreamResponse:
+        """Render a completed-at-prefill result. Non-stream: the doc
+        IS the original endpoint's response body. Stream: fabricate
+        the one-chunk SSE the client expects (first token == last
+        token)."""
+        if not plan['stream']:
+            return web.json_response(done_doc)
+        import json
+        resp = web.StreamResponse()
+        resp.headers['Content-Type'] = 'text/event-stream'
+        resp.headers['Cache-Control'] = 'no-cache'
+        await _downstream(resp.prepare(request))
+        chunk = {k: done_doc.get(k)
+                 for k in ('id', 'object', 'created', 'model')}
+        chunk['choices'] = done_doc.get('choices', [])
+        await _downstream(resp.write(
+            b'data: ' + json.dumps(chunk).encode() + b'\n\n'))
+        await _downstream(resp.write(b'data: [DONE]\n\n'))
+        await _downstream(resp.write_eof())
+        return resp
 
     async def _health(self, request: web.Request) -> web.Response:
         del request

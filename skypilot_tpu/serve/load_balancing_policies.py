@@ -2,6 +2,14 @@
 
 Reference analog: sky/serve/load_balancing_policies.py
 (`RoundRobinPolicy:85`, `LeastLoadPolicy:111` — the default).
+
+Disaggregated serving adds :class:`PoolRouter` — not a registered
+policy but the LB's two-stage routing state: a class/length-aware pick
+over the PREFILL pool (least-load; only prompts long enough to be
+worth a handoff round-trip go two-stage) and a session-ring-pinned
+pick over the DECODE pool (the PR-12 bounded-load consistent-hash
+ring, so a session's decode replica — and any prefix pages adopted
+there — stays stable across LB restarts and pool churn).
 """
 from __future__ import annotations
 
@@ -9,8 +17,9 @@ import bisect
 import hashlib
 import itertools
 import math
+import os
 import threading
-from typing import Dict, Iterator, List, Optional
+from typing import Any, Dict, Iterator, List, Optional
 
 from skypilot_tpu.utils import registry
 
@@ -267,3 +276,156 @@ class PrefixAffinityPolicy(LeastLoadPolicy):
             # Every replica at the bound (only possible transiently —
             # capacity tracks total load): plain least-load.
             return min(self._replicas, key=self._load_key)
+
+
+# ------------------------------------------------------------------
+# Disaggregated prefill/decode routing (serve/disagg; docs/serving.md)
+# ------------------------------------------------------------------
+
+# Prompts shorter than this (tokens; chars/4 for string prompts) skip
+# the two-stage pipeline: a tiny prefill on the decode replica costs
+# less than a handoff round-trip, and short interactive turns are the
+# TPOT-sensitive traffic disaggregation protects. Matches the engine's
+# 64-token prefix-snapshot floor by default.
+DISAGG_MIN_PROMPT_ENV = 'SKYTPU_LB_DISAGG_MIN_PROMPT'
+DISAGG_MIN_PROMPT_DEFAULT = 64
+
+
+def _prompt_units(payload: Dict[str, Any], path: str) -> Optional[int]:
+    """Estimated prompt length (tokens, or chars/4 for text) of a
+    single-prompt generation body; None when the shape is not the
+    single-prompt form the two-stage pipeline serves."""
+    if path == '/generate':
+        tokens = payload.get('tokens')
+        if isinstance(tokens, list) and all(
+                isinstance(t, int) for t in tokens):
+            return len(tokens)
+        text = payload.get('text')
+        if isinstance(text, str):
+            return max(1, len(text) // 4)
+        return None
+    prompt = payload.get('prompt')
+    if isinstance(prompt, list) and prompt and all(
+            isinstance(t, int) for t in prompt):
+        return len(prompt)
+    if isinstance(prompt, str) and prompt:
+        return max(1, len(prompt) // 4)
+    return None
+
+
+class PoolRouter:
+    """Two-stage routing state for disaggregated serving.
+
+    ``plan()`` is the class/length-aware gate: only single-prompt
+    generation POSTs whose prompt is long enough (or whose declared
+    class is ``long_context``) route prefill-pool-first; everything
+    else — short interactive turns, chat/batched/multi-choice shapes,
+    stop-string bodies — proxies single-stage to the decode pool,
+    which is a full engine. ``pick_prefill`` is least-load over the
+    prefill pool; ``pick_decode`` is the deterministic bounded-load
+    session ring over the decode pool (restart-stable, so adopted
+    pages and prefix snapshots stay hot on one replica)."""
+
+    def __init__(self, min_prompt: Optional[int] = None):
+        if min_prompt is None:
+            try:
+                min_prompt = int(os.environ.get(
+                    DISAGG_MIN_PROMPT_ENV, DISAGG_MIN_PROMPT_DEFAULT))
+            except ValueError:
+                min_prompt = DISAGG_MIN_PROMPT_DEFAULT
+        self.min_prompt = min_prompt
+        self._prefill = LeastLoadPolicy()
+        self._decode = PrefixAffinityPolicy()
+
+    # ------------------------------------------------------- pool state
+    def set_pools(self, prefill_urls: List[str],
+                  decode_urls: List[str]) -> None:
+        self._prefill.set_ready_replicas(prefill_urls)
+        self._decode.set_ready_replicas(decode_urls)
+
+    def set_saturation(self, queue_depths: Dict[str, float]) -> None:
+        self._prefill.set_replica_saturation(queue_depths)
+        self._decode.set_replica_saturation(queue_depths)
+
+    def has_pools(self) -> bool:
+        return self._prefill.has_replicas() and \
+            self._decode.has_replicas()
+
+    def prefill_urls(self) -> List[str]:
+        with self._prefill._lock:  # pylint: disable=protected-access
+            return list(self._prefill._replicas)  # pylint: disable=protected-access
+
+    # ------------------------------------------------------ eligibility
+    @staticmethod
+    def eligible(method: str, path: str) -> bool:
+        """The cheap pre-parse gate: only these (method, path) pairs
+        can ever route two-stage, so the LB skips the body JSON parse
+        for everything else (chat bodies are multi-KB)."""
+        return method == 'POST' and path in ('/generate',
+                                             '/v1/completions')
+
+    def plan(self, method: str, path: str, payload: Any,
+             cls: str) -> Optional[Dict[str, Any]]:
+        """The two-stage routing decision for one request, or None for
+        single-stage. ``payload`` is the parsed JSON body (or None).
+        The returned plan carries what the LB's disagg pipeline needs:
+        the orig path, streaming-ness, and the prompt estimate."""
+        if not self.eligible(method, path) or \
+                not isinstance(payload, dict):
+            return None
+        units = _prompt_units(payload, path)
+        if units is None:
+            return None
+        if path == '/v1/completions':
+            # Shapes the /disagg endpoints don't serve stay
+            # single-stage on the (full-engine) decode pool.
+            if payload.get('stop') or payload.get('logprobs') \
+                    or payload.get('suffix'):
+                return None
+            if int(payload.get('n') or 1) != 1 or \
+                    int(payload.get('best_of') or 0) > 1:
+                return None
+        if cls != 'long_context' and units < self.min_prompt:
+            return None
+        # /generate ignores 'stream' (plain JSON always) — the plan
+        # must agree, or the disagg pipeline would answer the same
+        # body SSE-shaped while the monolithic endpoint answers JSON.
+        stream = (bool(payload.get('stream'))
+                  if path == '/v1/completions' else False)
+        return {'path': path, 'units': units, 'stream': stream}
+
+    # ------------------------------------------------------------ picks
+    def pick_prefill(self, excluded=()) -> Optional[str]:
+        p = self._prefill
+        with p._lock:  # pylint: disable=protected-access
+            candidates = [u for u in p._replicas  # pylint: disable=protected-access
+                          if u not in excluded]
+            if not candidates:
+                return None
+            return min(candidates, key=p._load_key)  # pylint: disable=protected-access
+
+    def pick_decode(self, key: Optional[str],
+                    excluded=()) -> Optional[str]:
+        d = self._decode
+        if not excluded:
+            return d.select(key)
+        with d._lock:  # pylint: disable=protected-access
+            candidates = [u for u in d._replicas  # pylint: disable=protected-access
+                          if u not in excluded]
+            if not candidates:
+                return None
+            if key is not None:
+                for url in d._ring.walk(key):  # pylint: disable=protected-access
+                    if url in candidates:
+                        return url
+            return min(candidates, key=d._load_key)  # pylint: disable=protected-access
+
+    # ------------------------------------------------- load accounting
+    def request_started(self, prefill_url: str, decode_url: str) -> None:
+        self._prefill.request_started(prefill_url)
+        self._decode.request_started(decode_url)
+
+    def request_finished(self, prefill_url: str,
+                         decode_url: str) -> None:
+        self._prefill.request_finished(prefill_url)
+        self._decode.request_finished(decode_url)

@@ -134,12 +134,21 @@ class ReplicaManager:
 
     def __init__(self, service_name: str, task: 'task_lib.Task',
                  spec: spec_lib.ServiceSpec, version: int = 1,
-                 update_mode: str = 'rolling'):
+                 update_mode: str = 'rolling',
+                 role: Optional[str] = None):
         self.service_name = service_name
         self.task = task
         self.spec = spec
         self.version = version
         self.update_mode = update_mode
+        # Disaggregated pool role ('prefill'/'decode'; None =
+        # monolithic). The role namespaces CLUSTER NAMES — the durable
+        # record that survives controller restarts — so two managers
+        # of one service partition the shared replica table by
+        # cluster-name prefix, share the service's monotonic replica-id
+        # sequence (ids never collide across pools), and inject
+        # SKYTPU_ENGINE_ROLE into their replicas.
+        self.role = role
         self.backend = slice_backend.TpuSliceBackend()
         self._launch_threads: Dict[int, threading.Thread] = {}
         # One decision for env injection AND probe URLs (they must agree).
@@ -198,7 +207,7 @@ class ReplicaManager:
             task_config=json_lib.dumps(task.to_yaml_config()),
             spec=json_lib.dumps(spec.to_yaml_config()),
             version=version)
-        for rep in serve_state.get_replicas(self.service_name):
+        for rep in self._my_replicas():
             if (rep.get('version') or 1) >= failed_version:
                 self.terminate_replica(rep['replica_id'])
         logger.warning(
@@ -209,8 +218,28 @@ class ReplicaManager:
     # ------------------------------------------------------------------
     # Launch / terminate
     # ------------------------------------------------------------------
+    def _cluster_prefix(self) -> str:
+        if self.role:
+            return f'{self.service_name}-{self.role}-replica-'
+        return f'{self.service_name}-replica-'
+
     def _cluster_name(self, replica_id: int) -> str:
-        return f'{self.service_name}-replica-{replica_id}'
+        return f'{self._cluster_prefix()}{replica_id}'
+
+    def _my_replicas(self) -> List[dict]:
+        """This manager's slice of the service's replica table. Pool
+        managers (role set) partition by cluster-name prefix —
+        ``<svc>-<role>-replica-`` — so two managers of one disagg
+        service split the shared table recoverably from the durable
+        rows alone after a controller restart. A monolithic manager
+        owns the WHOLE table unfiltered (a disagg service never
+        instantiates one — the manager set is fixed at startup), so
+        rows with legacy or custom cluster names stay managed."""
+        if not self.role:
+            return serve_state.get_replicas(self.service_name)
+        prefix = self._cluster_prefix()
+        return [r for r in serve_state.get_replicas(self.service_name)
+                if str(r.get('cluster_name') or '').startswith(prefix)]
 
     def _replica_task(self, replica_id: int) -> 'task_lib.Task':
         from skypilot_tpu import task as task_lib_mod
@@ -224,12 +253,18 @@ class ReplicaManager:
         task = task_lib_mod.Task.from_yaml_config(cfg)
         if not self.spec.pool:
             port = self.spec.port
-            task.update_envs({
+            envs = {
                 'SKYTPU_SERVE_PORT': str(port + replica_id
                                          if self._local_ports else port),
                 'SKYTPU_SERVE_REPLICA_ID': str(replica_id),
                 'SKYTPU_SERVE_VERSION': str(self.version),
-            })
+            }
+            if self.role:
+                # Disagg pool role: the engine reports it on /health
+                # and the ops surface; the LB's pool routing derives
+                # from the CONTROLLER's manager split, not from this.
+                envs['SKYTPU_ENGINE_ROLE'] = self.role
+            task.update_envs(envs)
         # Placement was decided in scale_up (single-threaded) — concurrent
         # launch threads reading the placer here would all see the same
         # in-use set and pile into one zone.
@@ -367,7 +402,7 @@ class ReplicaManager:
         self._replica_locations.pop(replica_id, None)
 
     def terminate_all(self) -> None:
-        for rep in serve_state.get_replicas(self.service_name):
+        for rep in self._my_replicas():
             self.terminate_replica(rep['replica_id'])
 
     # ------------------------------------------------------------------
@@ -519,7 +554,7 @@ class ReplicaManager:
     def reconcile(self, target: int) -> None:
         """One control-loop pass: probe replicas, replace the dead, scale
         toward `target`."""
-        replicas = serve_state.get_replicas(self.service_name)
+        replicas = self._my_replicas()
         now = vclock.now()
         alive: List[dict] = []
         for rep in replicas:
@@ -729,7 +764,7 @@ class ReplicaManager:
         weight map and the fleet scraper's target list all derive from
         it, so the scraped set can never drift from the routed set."""
         return [(r['replica_id'], r['url'])
-                for r in serve_state.get_replicas(self.service_name)
+                for r in self._my_replicas()
                 if r['status'] is ReplicaStatus.READY and r['url'] and
                 (r.get('version') or 1) in self.active_versions]
 
@@ -749,7 +784,7 @@ class ReplicaManager:
         weights: Dict[str, float] = {}
         routable = set(self.ready_urls() if routable_urls is None
                        else routable_urls)
-        for rep in serve_state.get_replicas(self.service_name):
+        for rep in self._my_replicas():
             if rep['url'] not in routable:
                 continue
             weight = 1.0

@@ -31,7 +31,14 @@ _SERVICE_FIELDS = frozenset({
     # is this same spec with `pool: true` + `workers: N`. Workers are
     # replicas that idle after setup; managed jobs exec onto them.
     'pool', 'workers',
+    # Disaggregated prefill/decode serving (serve/disagg,
+    # docs/serving.md): two independently-scaled replica pools behind
+    # one LB, with KV page handoff between them.
+    'disagg',
 })
+# Per-pool sub-config keys inside `disagg:`. Each pool takes either
+# `replicas: N` (static) or the replica_policy autoscaling fields.
+_DISAGG_ROLES = ('prefill', 'decode')
 # Serve-only concepts a pool has no use for: there is no HTTP app to
 # probe or balance (reference rejects these for pool too).
 _POOL_UNSUPPORTED = frozenset({
@@ -73,6 +80,19 @@ class ReplicaPolicy:
 
 
 @dataclasses.dataclass
+class DisaggSpec:
+    """Per-role replica policies for disaggregated prefill/decode
+    serving: each pool scales independently (the whole point — a
+    long-prompt flood grows the prefill pool off its queue-wait SLO
+    while the decode pool holds interactive TPOT)."""
+    prefill: ReplicaPolicy
+    decode: ReplicaPolicy
+
+    def role_policy(self, role: str) -> ReplicaPolicy:
+        return self.prefill if role == 'prefill' else self.decode
+
+
+@dataclasses.dataclass
 class ServiceSpec:
     readiness_probe: ReadinessProbe
     policy: ReplicaPolicy
@@ -84,6 +104,48 @@ class ServiceSpec:
     # Pool mode: replicas are idle workers for managed jobs (no LB, no
     # HTTP probe — readiness is cluster liveness).
     pool: bool = False
+    # Disaggregated prefill/decode pools; None = monolithic service.
+    disagg: Optional[DisaggSpec] = None
+
+    @staticmethod
+    def _parse_pool_policy(role: str, cfg: Any) -> ReplicaPolicy:
+        """One disagg pool's config: ``{replicas: N}`` or the
+        replica_policy autoscaling fields (same grammar as the
+        top-level section)."""
+        if not isinstance(cfg, dict) or not cfg:
+            raise ValueError(
+                f"disagg.{role} must be a mapping with 'replicas' or "
+                f'replica-policy fields, got {cfg!r}')
+        if 'replicas' in cfg:
+            extra = set(cfg) - {'replicas'}
+            if extra:
+                raise ValueError(
+                    f"disagg.{role}: 'replicas' excludes "
+                    f'{sorted(extra)}')
+            return ReplicaPolicy(min_replicas=int(cfg['replicas']))
+        unknown = set(cfg) - _POLICY_FIELDS
+        if unknown:
+            raise ValueError(
+                f'Unknown disagg.{role} fields: {sorted(unknown)}')
+        policy = ReplicaPolicy(
+            min_replicas=int(cfg.get('min_replicas', 1)),
+            max_replicas=(int(cfg['max_replicas'])
+                          if 'max_replicas' in cfg else None),
+            target_qps_per_replica=(
+                float(cfg['target_qps_per_replica'])
+                if 'target_qps_per_replica' in cfg else None),
+            target_queue_depth_per_replica=(
+                float(cfg['target_queue_depth_per_replica'])
+                if 'target_queue_depth_per_replica' in cfg else None),
+            upscale_delay_seconds=float(
+                cfg.get('upscale_delay_seconds', 300.0)),
+            downscale_delay_seconds=float(
+                cfg.get('downscale_delay_seconds', 1200.0)))
+        if policy.max_replicas is not None and \
+                policy.max_replicas < policy.min_replicas:
+            raise ValueError(f'disagg.{role}: max_replicas < '
+                             f'min_replicas')
+        return policy
 
     @classmethod
     def from_yaml_config(cls, config: Dict[str, Any]) -> 'ServiceSpec':
@@ -124,6 +186,32 @@ class ServiceSpec:
                 initial_delay_seconds=float(
                     probe_cfg.get('initial_delay_seconds', 60.0)),
                 timeout_seconds=float(probe_cfg.get('timeout_seconds', 15.0)))
+
+        disagg = None
+        if 'disagg' in config:
+            d_cfg = config['disagg']
+            if not isinstance(d_cfg, dict):
+                raise ValueError("'disagg' must be a mapping with "
+                                 "'prefill' and 'decode' sections")
+            unknown = set(d_cfg) - set(_DISAGG_ROLES)
+            if unknown:
+                raise ValueError(f'Unknown disagg sections: '
+                                 f'{sorted(unknown)}; valid: '
+                                 f'{list(_DISAGG_ROLES)}')
+            missing = [r for r in _DISAGG_ROLES if r not in d_cfg]
+            if missing:
+                raise ValueError(f'disagg needs both pools; missing: '
+                                 f'{missing}')
+            if 'replicas' in config or config.get('replica_policy'):
+                raise ValueError(
+                    "'disagg' replaces top-level 'replicas'/"
+                    "'replica_policy': each pool declares its own "
+                    'count or autoscaling policy')
+            disagg = DisaggSpec(
+                prefill=cls._parse_pool_policy('prefill',
+                                               d_cfg['prefill']),
+                decode=cls._parse_pool_policy('decode',
+                                              d_cfg['decode']))
 
         pol_cfg = dict(config.get('replica_policy') or {})
         unknown = set(pol_cfg) - _POLICY_FIELDS
@@ -172,7 +260,15 @@ class ServiceSpec:
                     f'Unknown spot_placer {placer!r}; available: '
                     f'{sorted(placer_lib.PLACERS)}')
         return cls(readiness_probe=probe, policy=policy, port=int(ports),
-                   load_balancing_policy=lb.lower(), spot_placer=placer)
+                   load_balancing_policy=lb.lower(), spot_placer=placer,
+                   disagg=disagg)
+
+    @staticmethod
+    def _pool_to_yaml(policy: ReplicaPolicy) -> Dict[str, Any]:
+        if policy.autoscaling_enabled or policy.max_replicas is not None:
+            return {k: v for k, v in dataclasses.asdict(policy).items()
+                    if v is not None}
+        return {'replicas': policy.min_replicas}
 
     def to_yaml_config(self) -> Dict[str, Any]:
         if self.pool:
@@ -187,6 +283,12 @@ class ServiceSpec:
         }
         if self.spot_placer is not None:
             out['spot_placer'] = self.spot_placer
+        if self.disagg is not None:
+            out['disagg'] = {
+                'prefill': self._pool_to_yaml(self.disagg.prefill),
+                'decode': self._pool_to_yaml(self.disagg.decode),
+            }
+            return out
         pol = self.policy
         if pol.autoscaling_enabled or pol.max_replicas is not None:
             out['replica_policy'] = {

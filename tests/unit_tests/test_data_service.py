@@ -127,6 +127,29 @@ class TestProtocol:
             protocol.raise_if_error({'error': 'nope', 'kind': 'spec'})
         assert ei.value.kind == 'spec'
 
+    def test_extension_dtypes_round_trip_exactly(self):
+        """npy's descr serializes ml_dtypes extension types (bfloat16,
+        the fp8 family — real KV cache dtypes) as anonymous void
+        (``|V2``); the framing's ``_dtypes`` sidecar must restore the
+        true dtype so handoff fingerprints match across the wire and
+        adopted pages scatter with the right type."""
+        import ml_dtypes
+        from skypilot_tpu.utils import framed
+        for dt in (ml_dtypes.bfloat16, ml_dtypes.float8_e4m3fn):
+            a = (np.arange(24).reshape(2, 3, 4) % 7).astype(dt)
+            obj, arrs = framed._decode_payload(
+                framed._encode_payload({'op': 'x'}, {'a': a}))
+            assert arrs['a'].dtype == a.dtype
+            assert arrs['a'].tobytes() == a.tobytes()
+            # The sidecar is internal — consumed, never surfaced.
+            assert '_dtypes' not in obj
+        # Builtin dtypes don't grow a sidecar (header stays stable
+        # for old peers).
+        enc = framed._encode_payload(
+            {'op': 'x'}, {'a': np.zeros(3, np.float32)})
+        head_len = struct.unpack_from('!I', enc, 0)[0]
+        assert b'_dtypes' not in enc[4:4 + head_len]
+
 
 # -------------------------------------------------------------- spec
 

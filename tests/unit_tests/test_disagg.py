@@ -1,0 +1,509 @@
+"""Disaggregated prefill/decode serving: the KV page handoff contract
+(serve/disagg + the engine's /disagg endpoints), in-process.
+
+The contracts under test (docs/serving.md):
+  - EQUALITY: prefill on replica A → npy-framed page handoff → adopt
+    on replica B → decode produces TOKEN-IDENTICAL greedy output to a
+    monolithic run of the same prompt (the pages carry the exact KV
+    the monolith would have computed; the device `last` carry and
+    penalty counts are reseeded from the handoff meta).
+  - NO LEAKED PAGES: after any arc — success, refused handoff, armed
+    failpoints, engine reset — both allocators return to their free
+    baselines (page ids never cross the wire; each pool is
+    sovereign).
+  - REFUSALS ARE LOUD AND TYPED: corrupted pages refuse with kind
+    'integrity', config skew with kind 'spec' (non-retriable),
+    duplicate delivery with kind 'duplicate'; a consumed/expired
+    handoff answers a structured retriable 503 (handoff_missing).
+  - FAILURE ARCS ARE STRUCTURED: prefill.flush / handoff.send firings
+    surface retriable 503s, never hangs, and the engine serves again
+    immediately after.
+
+All CPU (JAX_PLATFORMS=cpu), two real engines + a real framed-TCP
+receiver in one process.
+"""
+import asyncio
+import dataclasses
+import socket
+
+import pytest
+from aiohttp.test_utils import TestClient
+from aiohttp.test_utils import TestServer as AioTestServer
+
+import jax.numpy as jnp
+
+from skypilot_tpu.serve import engine as engine_lib
+from skypilot_tpu.serve.disagg import handoff as handoff_lib
+from skypilot_tpu.utils import failpoints
+from skypilot_tpu.utils import framed
+
+SEED = 20260804
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(('127.0.0.1', 0))
+        return s.getsockname()[1]
+
+
+def _build():
+    eng = engine_lib.InferenceEngine('llama-debug', max_len=128,
+                                     seed=SEED)
+    # fp32: CPU reduction order must not flip argmax vs the reference.
+    eng.cfg = dataclasses.replace(eng.cfg, dtype=jnp.float32)
+    eng.spec_k = 0
+    eng.paged = True
+    eng.prefill_chunk = 16
+    eng.warmup()
+    return eng
+
+
+@pytest.fixture(scope='module')
+def prefill_eng():
+    return _build()
+
+
+@pytest.fixture(scope='module')
+def decode_eng():
+    return _build()
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    failpoints.reset()
+    yield
+    failpoints.reset()
+
+
+def _run_stack(prefill_eng, decode_eng, fn):
+    """Both engines live behind real aiohttp apps; the decode engine
+    additionally runs its framed-TCP handoff receiver. fn(pc, dc,
+    target) gets both test clients and the handoff target string."""
+    async def inner():
+        prefill_eng.handoff_port = None
+        decode_eng.handoff_port = _free_port()
+        pc = TestClient(AioTestServer(engine_lib.build_app(prefill_eng)))
+        dc = TestClient(AioTestServer(engine_lib.build_app(decode_eng)))
+        await pc.start_server()
+        await dc.start_server()
+        try:
+            return await fn(pc, dc,
+                            f'127.0.0.1:{decode_eng.handoff_port}')
+        finally:
+            await pc.close()
+            await dc.close()
+            decode_eng.handoff_store = None
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(inner())
+    finally:
+        loop.close()
+
+
+async def _drain_idle(eng, timeout=10.0):
+    """Wait until the engine pool is idle (pages freed at publish)."""
+    deadline = asyncio.get_event_loop().time() + timeout
+    while eng.in_flight() or eng.queue_depth():
+        if asyncio.get_event_loop().time() > deadline:
+            raise TimeoutError('engine never went idle')
+        await asyncio.sleep(0.05)
+
+
+class TestHandoffEquality:
+
+    def test_two_stage_matches_monolith_and_conserves_pages(
+            self, prefill_eng, decode_eng):
+        prompt = list(range(1, 40))     # > chunk(16): chunked prefill
+
+        async def fn(pc, dc, target):
+            free_p = prefill_eng.alloc.free_count
+            free_d = decode_eng.alloc.free_count
+            ref = await dc.post('/generate', json={
+                'tokens': prompt, 'max_new_tokens': 10})
+            assert ref.status == 200
+            ref_doc = await ref.json()
+            await _drain_idle(decode_eng)
+
+            r1 = await pc.post('/disagg/prefill?orig=/generate',
+                               json={'tokens': prompt,
+                                     'max_new_tokens': 10},
+                               headers={'X-Skytpu-Handoff-Target':
+                                        target})
+            assert r1.status == 200, await r1.text()
+            doc1 = await r1.json()
+            assert 'handoff' in doc1
+            assert doc1['handoff']['first_token'] == \
+                ref_doc['tokens'][0]
+            r2 = await dc.post('/disagg/continue?orig=/generate',
+                               json={'handoff_id':
+                                     doc1['handoff']['id']})
+            assert r2.status == 200, await r2.text()
+            doc2 = await r2.json()
+            assert doc2['tokens'] == ref_doc['tokens']
+            assert doc2['finish_reason'] == ref_doc['finish_reason']
+            await _drain_idle(prefill_eng)
+            await _drain_idle(decode_eng)
+            assert prefill_eng.alloc.free_count == free_p
+            assert decode_eng.alloc.free_count == free_d
+            # Handoff telemetry moved on both sides.
+            mt = await (await pc.get('/metrics')).text()
+            line = next(
+                ln for ln in mt.splitlines()
+                if ln.startswith('skytpu_engine_handoff_total')
+                and 'stage="send"' in ln and 'outcome="ok"' in ln)
+            assert float(line.rsplit(' ', 1)[1]) >= 1.0
+
+        _run_stack(prefill_eng, decode_eng, fn)
+
+    def test_streaming_continue_emits_sse_and_done(self, prefill_eng,
+                                                   decode_eng):
+        prompt = list(range(2, 40))
+
+        async def fn(pc, dc, target):
+            body = {'prompt': prompt, 'max_tokens': 6, 'stream': True,
+                    'temperature': 0.0}
+            r1 = await pc.post('/disagg/prefill?orig=/v1/completions',
+                               json=body,
+                               headers={'X-Skytpu-Handoff-Target':
+                                        target})
+            assert r1.status == 200, await r1.text()
+            hid = (await r1.json())['handoff']['id']
+            r2 = await dc.post('/disagg/continue?orig=/v1/completions',
+                               json={'handoff_id': hid, 'stream': True})
+            assert r2.status == 200
+            assert r2.headers['Content-Type'].startswith(
+                'text/event-stream')
+            events, done = [], False
+            async for raw in r2.content:
+                line = raw.decode().strip()
+                if not line.startswith('data:'):
+                    continue
+                payload = line[5:].strip()
+                if payload == '[DONE]':
+                    done = True
+                    break
+                events.append(payload)
+            assert done and events
+            await _drain_idle(decode_eng)
+
+        _run_stack(prefill_eng, decode_eng, fn)
+
+    def test_completed_at_admission_returns_done(self, prefill_eng,
+                                                 decode_eng):
+        async def fn(pc, dc, target):
+            r = await pc.post('/disagg/prefill?orig=/generate',
+                              json={'tokens': list(range(1, 20)),
+                                    'max_new_tokens': 1},
+                              headers={'X-Skytpu-Handoff-Target':
+                                       target})
+            assert r.status == 200
+            doc = await r.json()
+            assert 'done' in doc and 'handoff' not in doc
+            assert doc['done']['finish_reason'] == 'length'
+            assert len(doc['done']['tokens']) == 1
+            await _drain_idle(prefill_eng)
+
+        _run_stack(prefill_eng, decode_eng, fn)
+
+
+class TestHandoffRefusals:
+
+    def test_missing_handoff_is_structured_retriable_503(
+            self, prefill_eng, decode_eng):
+        async def fn(pc, dc, target):
+            r = await dc.post('/disagg/continue?orig=/generate',
+                              json={'handoff_id': 'deadbeef'})
+            assert r.status == 503
+            doc = await r.json()
+            assert doc['error']['type'] == 'handoff_missing'
+            assert doc['error']['retriable'] is True
+
+        _run_stack(prefill_eng, decode_eng, fn)
+
+    def _meta_for(self, eng, arrays, tokens, first=5):
+        return handoff_lib.build_meta(
+            handoff_id=handoff_lib.new_handoff_id(),
+            model=eng.model_name, vocab_size=eng.cfg.vocab_size,
+            page_size=eng.page_size, family=eng.cache_family(),
+            bucket=engine_lib._bucket(len(tokens)), tokens=tokens,
+            max_new=4, first_token=first, first_lp=0.0, first_tops=[],
+            temperature=0.0, top_k=None, top_p=None,
+            presence_penalty=0.0, frequency_penalty=0.0, stop_ids=[],
+            want_tops=False, cls='other',
+            kv_sha256=handoff_lib.kv_fingerprint(arrays))
+
+    def test_integrity_and_spec_and_duplicate_refusals(
+            self, prefill_eng, decode_eng):
+        import numpy as np
+        tokens = list(range(1, 20))
+
+        async def fn(pc, dc, target):
+            addr = framed.parse_addr(target)
+            shp = decode_eng.cache.k.shape      # [L, P, psz, KH, hd]
+            a = np.zeros((shp[0], 1, 32, shp[3], shp[4]), 'float32')
+            b = np.zeros_like(a)
+            arrays = {'a': a, 'b': b}
+
+            # Corrupted content: fingerprint recomputed at recv.
+            meta = self._meta_for(decode_eng, arrays, tokens)
+            bad = {'a': a.copy(), 'b': b}
+            bad['a'][0, 0, 0, 0, 0] = 1.0
+            with pytest.raises(handoff_lib.HandoffError) as ei:
+                await asyncio.to_thread(handoff_lib.send, addr, meta,
+                                        bad)
+            assert ei.value.kind == 'integrity'
+
+            # Config skew: non-retriable spec refusal.
+            meta2 = self._meta_for(decode_eng, arrays, tokens)
+            meta2['vocab_size'] = 999
+            with pytest.raises(handoff_lib.HandoffError) as ei:
+                await asyncio.to_thread(handoff_lib.send, addr, meta2,
+                                        arrays)
+            assert ei.value.kind == 'spec'
+            assert ei.value.retriable is False
+
+            # Duplicate delivery: second send of one id refused.
+            meta3 = self._meta_for(decode_eng, arrays, tokens)
+            await asyncio.to_thread(handoff_lib.send, addr, meta3,
+                                    arrays)
+            with pytest.raises(handoff_lib.HandoffError) as ei:
+                await asyncio.to_thread(handoff_lib.send, addr, meta3,
+                                        arrays)
+            assert ei.value.kind == 'duplicate'
+            # Staged-but-never-continued handoffs hold HOST memory
+            # only — the decode pool's allocator is untouched.
+            assert len(decode_eng.handoff_store) == 1
+            assert decode_eng.handoff_store.sweep() == 0
+
+        _run_stack(prefill_eng, decode_eng, fn)
+
+
+class TestHandoffFailureArcs:
+
+    def test_prefill_flush_failpoint_is_structured_retriable(
+            self, prefill_eng, decode_eng):
+        prompt = list(range(3, 40))
+
+        async def fn(pc, dc, target):
+            failpoints.arm('prefill.flush', once=True)
+            r = await pc.post('/disagg/prefill?orig=/generate',
+                              json={'tokens': prompt,
+                                    'max_new_tokens': 6},
+                              headers={'X-Skytpu-Handoff-Target':
+                                       target})
+            assert r.status == 503
+            doc = await r.json()
+            assert doc['error']['type'] == 'engine_reset_error'
+            assert doc['error']['retriable'] is True
+            # The engine recovered: the same request now round-trips,
+            # and the (rebuilt) pool leaks nothing.
+            free_p = prefill_eng.alloc.free_count
+            r1 = await pc.post('/disagg/prefill?orig=/generate',
+                               json={'tokens': prompt,
+                                     'max_new_tokens': 6},
+                               headers={'X-Skytpu-Handoff-Target':
+                                        target})
+            assert r1.status == 200, await r1.text()
+            hid = (await r1.json())['handoff']['id']
+            r2 = await dc.post('/disagg/continue?orig=/generate',
+                               json={'handoff_id': hid})
+            assert r2.status == 200
+            await _drain_idle(prefill_eng)
+            assert prefill_eng.alloc.free_count == free_p
+
+        _run_stack(prefill_eng, decode_eng, fn)
+
+    def test_handoff_send_failpoint_is_structured_retriable(
+            self, prefill_eng, decode_eng):
+        prompt = list(range(4, 40))
+
+        async def fn(pc, dc, target):
+            failpoints.arm('handoff.send', once=True)
+            free_p = prefill_eng.alloc.free_count
+            r = await pc.post('/disagg/prefill?orig=/generate',
+                              json={'tokens': prompt,
+                                    'max_new_tokens': 6},
+                              headers={'X-Skytpu-Handoff-Target':
+                                       target})
+            assert r.status == 503
+            doc = await r.json()
+            assert doc['error']['type'] == 'handoff_send_error'
+            assert doc['error']['retriable'] is True
+            await _drain_idle(prefill_eng)
+            # The export's pages freed at publish; nothing leaked on
+            # either side (the handoff never reached the decode pool).
+            assert prefill_eng.alloc.free_count == free_p
+
+        _run_stack(prefill_eng, decode_eng, fn)
+
+    def test_handoff_recv_failpoint_refuses_and_decode_pool_clean(
+            self, prefill_eng, decode_eng):
+        prompt = list(range(5, 40))
+
+        async def fn(pc, dc, target):
+            failpoints.arm('handoff.recv', once=True)
+            free_d = decode_eng.alloc.free_count
+            r = await pc.post('/disagg/prefill?orig=/generate',
+                              json={'tokens': prompt,
+                                    'max_new_tokens': 6},
+                              headers={'X-Skytpu-Handoff-Target':
+                                       target})
+            assert r.status == 503
+            doc = await r.json()
+            assert doc['error']['type'] == 'handoff_send_error'
+            assert doc['error']['retriable'] is True
+            assert decode_eng.alloc.free_count == free_d
+
+        _run_stack(prefill_eng, decode_eng, fn)
+
+    def test_lb_retries_prefill_on_dead_replica_then_completes(
+            self, prefill_eng, decode_eng):
+        """The SIGKILL arc at the LB: the first prefill pick is a dead
+        address (connection refused — exactly what a SIGKILLed replica
+        leaves behind); the pipeline reroutes to the live prefill
+        replica and the request completes. Nothing leaks on either
+        pool."""
+        prompt = list(range(6, 40))
+
+        async def fn(lb_client, dead_url, live_url):
+            # Deterministic first pick: bias the live replica's load
+            # so least-load picks the dead one first.
+            lb = lb_client.server.app['lb']
+            lb._pools._prefill.request_started(live_url)  # pylint: disable=protected-access
+            free_p = prefill_eng.alloc.free_count
+            free_d = decode_eng.alloc.free_count
+            ref = await lb_client.server.app['decode_client'].post(
+                '/generate', json={'tokens': prompt,
+                                   'max_new_tokens': 6})
+            ref_doc = await ref.json()
+            await _drain_idle(decode_eng)
+            r = await lb_client.post('/generate',
+                                     json={'tokens': prompt,
+                                           'max_new_tokens': 6})
+            assert r.status == 200, await r.text()
+            doc = await r.json()
+            assert doc['tokens'] == ref_doc['tokens']
+            await _drain_idle(prefill_eng)
+            await _drain_idle(decode_eng)
+            assert prefill_eng.alloc.free_count == free_p
+            assert decode_eng.alloc.free_count == free_d
+
+        self._run_lb_stack(prefill_eng, decode_eng, fn,
+                           dead_prefill=True)
+
+    def test_lb_retry_completes_after_armed_send_failure(
+            self, prefill_eng, decode_eng):
+        """handoff.send armed once: attempt 1 answers a retriable 503,
+        the LB's pipeline loop widens past the failed replica set and
+        attempt 2 completes — the client never sees the failure."""
+        prompt = list(range(7, 40))
+
+        async def fn(lb_client, dead_url, live_url):
+            failpoints.arm('handoff.send', once=True)
+            r = await lb_client.post('/generate',
+                                     json={'tokens': prompt,
+                                           'max_new_tokens': 4})
+            assert r.status == 200, await r.text()
+            assert len((await r.json())['tokens']) == 4
+            await _drain_idle(prefill_eng)
+            await _drain_idle(decode_eng)
+
+        self._run_lb_stack(prefill_eng, decode_eng, fn)
+
+    def test_lb_exhausted_attempts_is_structured_retriable_502(
+            self, prefill_eng, decode_eng):
+        """Every attempt fails (handoff.send armed permanently): the
+        client gets a structured retriable 502 — never a hang — and
+        the decode pool's allocator is untouched."""
+        prompt = list(range(8, 40))
+
+        async def fn(lb_client, dead_url, live_url):
+            failpoints.arm('handoff.send')
+            free_d = decode_eng.alloc.free_count
+            r = await lb_client.post('/generate',
+                                     json={'tokens': prompt,
+                                           'max_new_tokens': 4})
+            assert r.status == 502
+            doc = await r.json()
+            assert doc['retriable'] is True
+            assert 'pipeline failed' in doc['error']
+            await _drain_idle(prefill_eng)
+            assert decode_eng.alloc.free_count == free_d
+            # Disarmed, the same stack serves the same request.
+            failpoints.reset()
+            r2 = await lb_client.post('/generate',
+                                      json={'tokens': prompt,
+                                            'max_new_tokens': 4})
+            assert r2.status == 200, await r2.text()
+            await _drain_idle(prefill_eng)
+            await _drain_idle(decode_eng)
+
+        self._run_lb_stack(prefill_eng, decode_eng, fn)
+
+    def _run_lb_stack(self, prefill_eng, decode_eng, fn,
+                      dead_prefill=False):
+        """A real LoadBalancer fronting one live prefill replica and
+        one decode replica (whose handoff receiver sits at the LB's
+        derived fixed-offset port), optionally with a dead prefill
+        address in the pool. fn(lb_client, dead_url, live_url)."""
+        from skypilot_tpu.serve import load_balancer as lb_lib
+
+        async def inner():
+            dport = _free_port()
+            decode_eng.handoff_port = (dport +
+                                       handoff_lib.HANDOFF_PORT_OFFSET)
+            prefill_eng.handoff_port = None
+            dc = TestClient(AioTestServer(
+                engine_lib.build_app(decode_eng), port=dport))
+            pc = TestClient(AioTestServer(
+                engine_lib.build_app(prefill_eng)))
+            await dc.start_server()
+            await pc.start_server()
+            decode_url = f'http://127.0.0.1:{dport}'
+            live_url = f'http://127.0.0.1:{pc.server.port}'
+            dead_url = f'http://127.0.0.1:{_free_port()}'
+            pool = ([dead_url, live_url] if dead_prefill
+                    else [live_url])
+            lb = lb_lib.LoadBalancer('prefix_affinity',
+                                     service_name='disagg-test')
+            lb.set_ready_replicas([decode_url])
+            lb.set_pool_replicas(pool, [decode_url])
+            # The module fixtures build max_len=128 engines; drop the
+            # two-stage length gate so the short test prompts route
+            # through the pipeline.
+            lb._pools.min_prompt = 16  # pylint: disable=protected-access
+            lbc = TestClient(AioTestServer(lb.build_app()))
+            await lbc.start_server()
+            lbc.server.app['lb'] = lb
+            lbc.server.app['decode_client'] = dc
+            try:
+                return await fn(lbc, dead_url, live_url)
+            finally:
+                await lbc.close()
+                await pc.close()
+                await dc.close()
+                decode_eng.handoff_store = None
+        loop = asyncio.new_event_loop()
+        try:
+            return loop.run_until_complete(inner())
+        finally:
+            loop.close()
+
+    def test_health_and_validate_surface(self, prefill_eng,
+                                         decode_eng):
+        async def fn(pc, dc, target):
+            doc = await (await dc.get('/health')).json()
+            assert doc['handoff_port'] == decode_eng.handoff_port
+            assert doc['handoff_staged'] == len(
+                decode_eng.handoff_store)
+            # handoff_validate refuses an oversized request loudly.
+            meta = {'family': decode_eng.cache_family(),
+                    'vocab_size': decode_eng.cfg.vocab_size,
+                    'model': decode_eng.model_name,
+                    'tokens': list(range(100)),
+                    'bucket': engine_lib._bucket(100),
+                    'max_new': 1000}
+            assert 'exceeds replica max_len' in \
+                decode_eng.handoff_validate(meta)
+
+        _run_stack(prefill_eng, decode_eng, fn)

@@ -202,6 +202,11 @@ class TestDiscovery:
         # containment and stream determinism through these.
         assert {'data.dispatch', 'data.worker_batch', 'data.fetch',
                 'data.heartbeat'} <= names
+        # The disaggregated-serving handoff sites (serve/disagg +
+        # engine export): tests/unit_tests/test_disagg.py drives the
+        # mid-handoff failure arcs through these.
+        assert {'handoff.send', 'handoff.recv',
+                'prefill.flush'} <= names
         # Naming contract holds for every discovered site.
         for name in names:
             assert failpoints.NAME_RE.match(name), name

@@ -764,3 +764,100 @@ class TestFleetCLI:
             capture_output=True, text=True, check=True)
         assert 'interactive' in out.stdout
         assert 'goodput' in out.stdout
+
+
+class TestPerStageSLOKinds:
+    """Disaggregated per-pool SLO kinds (serve/disagg): prefill_queue
+    evaluates the admission-wait histogram over prefill-pool targets
+    only; decode_ttft evaluates the TTFT histogram over decode-pool
+    targets only — a slow DECODE pool must never burn the PREFILL
+    kind's budget (and vice versa), and a monolithic fleet with no
+    role-tagged targets holds (no data), never breaches."""
+
+    @staticmethod
+    def _hist_rows(family, values, buckets=(0.1, 0.5, 1.0, 2.5)):
+        reg = metrics.Registry()
+        h = reg.histogram(family, 'x.', buckets=buckets)
+        for v in values:
+            h.observe(v)
+        fams = promtext.parse(reg.render())
+        return [(s.name, promtext.labels_text(s.labels), s.value)
+                for s in fams[family].samples]
+
+    def _specs(self):
+        return [
+            slo_lib.SLOSpec(kind='prefill_queue', objective=0.9,
+                            threshold_seconds=0.5, fast_window=100.0,
+                            slow_window=300.0, fast_burn=2.0,
+                            slow_burn=1.0),
+            slo_lib.SLOSpec(kind='decode_ttft', objective=0.9,
+                            threshold_seconds=0.5, fast_window=100.0,
+                            slow_window=300.0, fast_burn=2.0,
+                            slow_burn=1.0),
+        ]
+
+    def test_pool_isolation(self):
+        """Saturated prefill pool + healthy decode pool: prefill_queue
+        breaches, decode_ttft stays ok — the target filter keeps each
+        kind on its own pool."""
+        engine = slo_lib.SLOEngine(self._specs(), entity='svc')
+        now = time.time()
+        tsdb.insert_samples('svc/prefill/0', self._hist_rows(
+            'skytpu_engine_admission_wait_seconds', [2.0] * 20),
+            ts=now - 5)
+        tsdb.insert_samples('svc/decode/0', self._hist_rows(
+            'skytpu_engine_ttft_seconds', [0.05] * 50), ts=now - 5)
+        # The decode pool also reports admission waits (it admits
+        # adopted requests) — slow ones must NOT count against the
+        # prefill kind.
+        tsdb.insert_samples('svc/decode/0', self._hist_rows(
+            'skytpu_engine_admission_wait_seconds', [2.0] * 50),
+            ts=now - 5)
+        engine.evaluate(now)
+        assert engine.state('prefill_queue') == 'breach'
+        assert engine.state('decode_ttft') == 'ok'
+        breach = journal.query(kind='slo_breach')
+        assert len(breach) == 1
+        assert breach[0]['data']['kind'] == 'prefill_queue'
+
+    def test_decode_ttft_breaches_independently(self):
+        engine = slo_lib.SLOEngine(self._specs(), entity='svc')
+        now = time.time()
+        tsdb.insert_samples('svc/prefill/0', self._hist_rows(
+            'skytpu_engine_admission_wait_seconds', [0.05] * 50),
+            ts=now - 5)
+        tsdb.insert_samples('svc/decode/0', self._hist_rows(
+            'skytpu_engine_ttft_seconds', [2.0] * 20), ts=now - 5)
+        engine.evaluate(now)
+        assert engine.state('prefill_queue') == 'ok'
+        assert engine.state('decode_ttft') == 'breach'
+
+    def test_monolithic_fleet_holds_with_no_pool_targets(self):
+        """No role-tagged targets (monolithic service): the per-stage
+        kinds have no data — hold ok, never breach, burn gauges write
+        nothing."""
+        engine = slo_lib.SLOEngine(self._specs(), entity='svc')
+        now = time.time()
+        tsdb.insert_samples('svc/0', self._hist_rows(
+            'skytpu_engine_admission_wait_seconds', [2.0] * 50),
+            ts=now - 5)
+        tsdb.insert_samples('svc/0', self._hist_rows(
+            'skytpu_engine_ttft_seconds', [2.0] * 50), ts=now - 5)
+        evals = engine.evaluate(now)
+        assert engine.state('prefill_queue') == 'ok'
+        assert engine.state('decode_ttft') == 'ok'
+        assert all(e.burn_fast is None for e in evals)
+
+    def test_entity_scope_still_applies(self):
+        """A sibling service's prefill outage must not leak into this
+        service's prefill_queue burn (shared observe DB)."""
+        engine = slo_lib.SLOEngine([self._specs()[0]], entity='svc')
+        now = time.time()
+        tsdb.insert_samples('other/prefill/0', self._hist_rows(
+            'skytpu_engine_admission_wait_seconds', [2.0] * 50),
+            ts=now - 5)
+        tsdb.insert_samples('svc/prefill/0', self._hist_rows(
+            'skytpu_engine_admission_wait_seconds', [0.05] * 50),
+            ts=now - 5)
+        engine.evaluate(now)
+        assert engine.state('prefill_queue') == 'ok'

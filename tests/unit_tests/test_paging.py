@@ -231,3 +231,144 @@ class TestPageTableConsistency:
         assert paging.pages_for(64, 64) == 1
         assert paging.pages_for(65, 64) == 2
         assert paging.pages_for(128, 16) == 8
+
+
+class TestExportAdoptHandoff:
+    """Disaggregated-serving page discipline (serve/disagg): a handoff
+    ships page CONTENTS, never page IDS — the adopting side reserves
+    through its OWN allocator — so a random export→adopt schedule must
+    conserve refcounts on both pools independently, content
+    fingerprints must survive the framed wire, and a duplicate
+    delivery must refuse rather than double-admit."""
+
+    @pytest.mark.parametrize('seed', [3, 17])
+    def test_export_adopt_schedule_conserves_both_pools(self, seed):
+        rng = random.Random(seed)
+        n_pages = 24
+        prefill = paging.PageAllocator(n_pages)
+        decode = paging.PageAllocator(n_pages)
+        pref_model = _RefModel(n_pages)
+        dec_model = _RefModel(n_pages)
+        staged = []            # exported request sizes awaiting adopt
+        adopted = {}           # handoff id -> decode-side pages
+        hid = 0
+        for _ in range(300):
+            op = rng.random()
+            if op < 0.4 and prefill.can_fit(4):
+                # Prefill + immediate export + free (pages release at
+                # publish on the prefill side; only bytes travel).
+                n = rng.randint(1, 4)
+                pids = prefill.alloc(n)
+                pref_model.alloc(pids)
+                staged.append(n)
+                prefill.unref_all(pids)
+                for p in pids:
+                    pref_model.unref(p)
+            elif op < 0.8 and staged and decode.can_fit(staged[0]):
+                n = staged.pop(0)
+                pids = decode.alloc(n)
+                dec_model.alloc(pids)
+                adopted[hid] = pids
+                hid += 1
+            elif adopted:
+                key = rng.choice(list(adopted))
+                pids = adopted.pop(key)
+                decode.unref_all(pids)
+                for p in pids:
+                    dec_model.unref(p)
+            # The live allocators track the reference model exactly.
+            for p in range(1, n_pages):
+                assert prefill.refcount(p) == pref_model.rc.get(p, 0)
+                assert decode.refcount(p) == dec_model.rc.get(p, 0)
+        for pids in adopted.values():
+            decode.unref_all(pids)
+        # Both pools return to fully free — no page crossed pools, no
+        # export leaked on either side.
+        assert prefill.free_count == n_pages - 1
+        assert decode.free_count == n_pages - 1
+        assert prefill.used_count == 0 and decode.used_count == 0
+
+    def test_take_replay_refuses_double_adopt_at_allocator_level(self):
+        a = paging.PageAllocator(8)
+        a.take([3, 5])
+        with pytest.raises(paging.PagesExhausted):
+            a.take([3, 5])          # the plan's pages are no longer free
+        a.unref_all([3, 5])
+        a.take([3, 5])              # free again -> claimable again
+
+    def test_kv_fingerprint_survives_framed_wire(self):
+        import numpy as np
+        from skypilot_tpu.serve.disagg import handoff
+        from skypilot_tpu.utils import framed
+        rng = np.random.default_rng(7)
+        arrays = {'a': rng.standard_normal((2, 1, 8, 3)).astype('float32'),
+                  'b': rng.standard_normal((2, 1, 8, 2)).astype('float32')}
+        digest = handoff.kv_fingerprint(arrays)
+        payload = framed._encode_payload({'op': 'handoff'}, arrays)
+        _, back = framed._decode_payload(payload)
+        assert handoff.kv_fingerprint(back) == digest
+        # A single flipped byte must change the digest (the receiver
+        # refuses before staging).
+        back['a'].view('uint8').reshape(-1)[5] ^= 0x40
+        assert handoff.kv_fingerprint(back) != digest
+
+    def test_fingerprint_depends_on_shape_and_dtype(self):
+        import numpy as np
+        from skypilot_tpu.serve.disagg import handoff
+        a = np.arange(12, dtype='float32')
+        assert (handoff.kv_fingerprint({'a': a}) !=
+                handoff.kv_fingerprint({'a': a.reshape(3, 4)}))
+        assert (handoff.kv_fingerprint({'a': a}) !=
+                handoff.kv_fingerprint({'a': a.astype('float64')}))
+
+    def test_store_refuses_duplicate_and_consumed_handoffs(self):
+        import numpy as np
+        from skypilot_tpu.serve.disagg import handoff
+        from skypilot_tpu.utils import framed
+        store = handoff.HandoffStore(ttl=60.0)
+        meta = {'handoff_id': 'h1'}
+        arrays = {'a': np.zeros(2), 'b': np.zeros(2)}
+        store.put(meta, arrays)
+        with pytest.raises(framed.RemoteError) as ei:
+            store.put(meta, arrays)
+        assert ei.value.kind == 'duplicate'
+        got = store.pop('h1')
+        assert got is not None and got[0]['handoff_id'] == 'h1'
+        assert store.pop('h1') is None          # consumed-at-most-once
+        with pytest.raises(framed.RemoteError):
+            store.put(meta, arrays)             # late twin refused too
+
+    def test_store_ttl_sweeps_orphans(self):
+        import numpy as np
+        from skypilot_tpu.serve.disagg import handoff
+        store = handoff.HandoffStore(ttl=0.0)
+        store._entries['h2'] = (0.0, {'handoff_id': 'h2'},
+                                {'a': np.zeros(1)})
+        assert store.sweep() == 1
+        assert store.pop('h2') is None
+
+    def test_adopt_rows_is_gather_prefix_inverse(self):
+        """adopt_rows(export(x)) == x: the device-side half of the
+        round-trip, bit-exact (CPU jax)."""
+        import jax.numpy as jnp
+        import numpy as np
+        psz, n_pages, maxp, L = 4, 9, 4, 2
+        rng = np.random.default_rng(11)
+        src = paging.PagedKV(
+            k=jnp.asarray(rng.standard_normal((L, n_pages, psz, 2, 3))
+                          .astype('float32')),
+            v=jnp.asarray(rng.standard_normal((L, n_pages, psz, 2, 3))
+                          .astype('float32')),
+            table=jnp.asarray([[1, 2, 3, 0]], jnp.int32),
+            length=jnp.asarray([10], jnp.int32))
+        a, b = paging.gather_prefix(src, 0, 8)
+        dst = paging.PagedKV(
+            k=jnp.zeros((L, n_pages, psz, 2, 3), jnp.float32),
+            v=jnp.zeros((L, n_pages, psz, 2, 3), jnp.float32),
+            table=jnp.asarray([[5, 7, 0, 0]], jnp.int32),
+            length=jnp.asarray([0], jnp.int32))
+        dst2 = paging.adopt_rows(dst, a, b, 0, 8, 8)
+        a2, b2 = paging.gather_prefix(dst2, 0, 8)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(a2))
+        np.testing.assert_array_equal(np.asarray(b), np.asarray(b2))
+        assert int(dst2.length[0]) == 8

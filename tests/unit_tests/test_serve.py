@@ -114,6 +114,46 @@ class TestServiceSpec:
             spec_lib.ServiceSpec.from_yaml_config(
                 {'load_balancing_policy': 'magic'})
 
+    def test_disagg_spec_parses_and_round_trips(self):
+        spec = spec_lib.ServiceSpec.from_yaml_config({
+            'readiness_probe': '/health',
+            'disagg': {
+                'prefill': {'min_replicas': 1, 'max_replicas': 4,
+                            'target_queue_depth_per_replica': 4},
+                'decode': {'replicas': 2},
+            },
+        })
+        assert spec.disagg is not None
+        assert spec.disagg.prefill.autoscaling_enabled
+        assert spec.disagg.prefill.max_replicas == 4
+        assert not spec.disagg.decode.autoscaling_enabled
+        assert spec.disagg.decode.min_replicas == 2
+        assert spec.disagg.role_policy('prefill') is spec.disagg.prefill
+        again = spec_lib.ServiceSpec.from_yaml_config(
+            spec.to_yaml_config())
+        assert again.disagg.prefill.max_replicas == 4
+        assert again.disagg.decode.min_replicas == 2
+
+    def test_disagg_spec_refusals(self):
+        with pytest.raises(ValueError, match='missing'):
+            spec_lib.ServiceSpec.from_yaml_config(
+                {'disagg': {'prefill': {'replicas': 1}}})
+        with pytest.raises(ValueError, match='Unknown disagg sections'):
+            spec_lib.ServiceSpec.from_yaml_config(
+                {'disagg': {'prefill': {'replicas': 1},
+                            'decode': {'replicas': 1},
+                            'verify': {'replicas': 1}}})
+        with pytest.raises(ValueError, match="replaces top-level"):
+            spec_lib.ServiceSpec.from_yaml_config(
+                {'replicas': 3,
+                 'disagg': {'prefill': {'replicas': 1},
+                            'decode': {'replicas': 2}}})
+        with pytest.raises(ValueError, match="'replicas' excludes"):
+            spec_lib.ServiceSpec.from_yaml_config(
+                {'disagg': {'prefill': {'replicas': 1,
+                                        'max_replicas': 2},
+                            'decode': {'replicas': 2}}})
+
     def test_instance_aware_least_load_policy(self):
         """Heterogeneous replica set: load is normalized by capacity
         weight, so a 16-chip replica absorbs 2x the traffic of an 8-chip
@@ -261,6 +301,64 @@ class TestLBPolicies:
         p2 = load_balancing_policies.PrefixAffinityPolicy()
         p2.set_ready_replicas(list(reversed(urls)))  # order-agnostic
         assert {k: p2.select(k) for k in keys} == first
+
+    def test_pool_router_plan_gate(self):
+        """The two-stage eligibility gate: long single-prompt
+        generation bodies route two-stage; short, declared-long, and
+        unservable shapes behave as documented (docs/serving.md)."""
+        from skypilot_tpu.serve import load_balancing_policies as lb
+        r = lb.PoolRouter(min_prompt=64)
+        long_toks = list(range(100))
+        # Long /generate body: eligible, carries units + streaminess.
+        plan = r.plan('POST', '/generate', {'tokens': long_toks},
+                      'other')
+        assert plan == {'path': '/generate', 'units': 100,
+                        'stream': False}
+        # Short prompt: single-stage — unless its class declares it
+        # long.
+        short = {'tokens': list(range(10))}
+        assert r.plan('POST', '/generate', short, 'interactive') is None
+        assert r.plan('POST', '/generate', short,
+                      'long_context') is not None
+        # Text prompts estimate at chars/4.
+        assert r.plan('POST', '/generate', {'text': 'x' * 400},
+                      'other')['units'] == 100
+        # Shapes the /disagg endpoints don't serve stay single-stage.
+        base = {'prompt': long_toks}
+        assert r.plan('POST', '/v1/completions',
+                      {**base, 'stream': True},
+                      'other')['stream'] is True
+        for bad in ({'stop': ['x']}, {'logprobs': 2}, {'n': 2},
+                    {'best_of': 3}, {'suffix': 'y'}):
+            assert r.plan('POST', '/v1/completions', {**base, **bad},
+                          'other') is None
+        assert r.plan('POST', '/v1/completions',
+                      {'prompt': [long_toks, long_toks]},
+                      'other') is None
+        assert r.plan('POST', '/v1/chat/completions', base,
+                      'other') is None
+        assert r.plan('GET', '/generate', {'tokens': long_toks},
+                      'other') is None
+
+    def test_pool_router_picks_and_exclusion(self):
+        from skypilot_tpu.serve import load_balancing_policies as lb
+        r = lb.PoolRouter(min_prompt=64)
+        assert not r.has_pools()
+        assert r.pick_prefill() is None
+        r.set_pools(['p1', 'p2'], ['d1', 'd2', 'd3'])
+        assert r.has_pools()
+        # Least-load over the prefill pool; exclusion reroutes.
+        first = r.pick_prefill()
+        r.request_started(first, 'd1')
+        assert r.pick_prefill() != first
+        assert r.pick_prefill({'p1'}) == 'p2'
+        assert r.pick_prefill({'p1', 'p2'}) is None
+        # The decode pick is the deterministic session ring: stable
+        # per key, exclusion moves it.
+        home = r.pick_decode('session-1')
+        assert r.pick_decode('session-1') == home
+        moved = r.pick_decode('session-1', {home})
+        assert moved is not None and moved != home
 
     def test_affinity_key_extraction(self):
         from skypilot_tpu.serve import load_balancer as lb_mod

@@ -181,3 +181,47 @@ class TestAutoscalerOnVirtualClock:
         assert scaler.target_replicas() == 3    # pending downscale
         vclock.advance(121)
         assert scaler.target_replicas() == 1
+
+
+class TestDisaggPoolPartition:
+
+    def test_role_managers_partition_replica_table(self, isolated_state,
+                                                   vtime):
+        """Two pool managers of one disagg service split the shared
+        replica table by cluster-name prefix (durable — recoverable
+        after a controller restart); a monolithic manager owns the
+        whole table unfiltered, legacy/custom cluster names included."""
+        del isolated_state, vtime
+        spec = spec_lib.ServiceSpec.from_yaml_config({
+            'readiness_probe': '/health', 'replicas': 1,
+            'ports': 19999,
+        })
+        task = task_lib.Task(name='dsvc', run='true')
+        serve_state.add_service('dsvc',
+                                task_config=task.to_yaml_config(),
+                                spec=json.loads(json.dumps(
+                                    spec.to_yaml_config())),
+                                lb_port=19998)
+        managers = {
+            role: replica_managers.ReplicaManager('dsvc', task, spec,
+                                                  role=role)
+            for role in ('prefill', 'decode', None)}
+        rows = [(1, managers['prefill']._cluster_name(1)),
+                (2, managers['decode']._cluster_name(2)),
+                (3, 'dsvc-custom-3')]
+        for rid, cname in rows:
+            serve_state.upsert_replica(
+                'dsvc', rid, cluster_name=cname,
+                status=ReplicaStatus.STARTING.value,
+                url=f'http://127.0.0.1:2000{rid}', version=1)
+        assert [r['replica_id'] for r in
+                managers['prefill']._my_replicas()] == [1]
+        assert [r['replica_id'] for r in
+                managers['decode']._my_replicas()] == [2]
+        assert sorted(r['replica_id'] for r in
+                      managers[None]._my_replicas()) == [1, 2, 3]
+        # Role replicas carry SKYTPU_ENGINE_ROLE; monolithic don't.
+        envs = managers['prefill']._replica_task(1).envs
+        assert envs['SKYTPU_ENGINE_ROLE'] == 'prefill'
+        assert 'SKYTPU_ENGINE_ROLE' not in \
+            managers[None]._replica_task(3).envs

@@ -128,6 +128,46 @@ class TestLayerChecker:
         ''')
         assert _run(tmp_path, checks=['layers'])['total'] == 1
 
+    def test_nested_subunit_ranks_above_parent(self, tmp_path):
+        # serve/disagg (18) sits ABOVE the serve plane (17) it
+        # coordinates: serve's modules must bridge to it lazily —
+        # both spellings of the module-level import are upward —
+        # while disagg itself imports serve (and unranked utils)
+        # freely.
+        _write(tmp_path, 'serve/load_balancer.py',
+               'from skypilot_tpu.serve import disagg\n')
+        _write(tmp_path, 'serve/controller.py',
+               'from skypilot_tpu.serve.disagg import handoff\n')
+        _write(tmp_path, 'serve/disagg/handoff.py', '''\
+            from skypilot_tpu.serve import serve_state
+            from skypilot_tpu.utils import framed
+        ''')
+        report = _run(tmp_path, checks=['layers'])
+        assert sorted(_idents(report)) == [
+            'layers:serve/controller.py:skypilot_tpu.serve.disagg',
+            'layers:serve/load_balancer.py:skypilot_tpu.serve.disagg',
+        ]
+        assert all('upward' in v['message']
+                   for v in report['violations'])
+
+    def test_nested_subunit_relative_and_sibling_imports(self, tmp_path):
+        # Relative spellings resolve to the nested unit too: from
+        # inside serve, `from .disagg import handoff` is the same
+        # upward edge; within disagg, `from . import handoff` is
+        # self-unit (fine), and jobs (17, another plane) reaching up
+        # to serve.disagg (18) is upward cross-plane-style too.
+        _write(tmp_path, 'serve/engine.py',
+               'from .disagg import handoff\n')
+        _write(tmp_path, 'serve/disagg/transport.py',
+               'from . import handoff\n')
+        _write(tmp_path, 'jobs/pool.py',
+               'from skypilot_tpu.serve.disagg import handoff\n')
+        report = _run(tmp_path, checks=['layers'])
+        assert sorted(_idents(report)) == [
+            'layers:jobs/pool.py:skypilot_tpu.serve.disagg',
+            'layers:serve/engine.py:skypilot_tpu.serve.disagg',
+        ]
+
 
 # ------------------------------------------------------------ lazy imports
 
@@ -155,6 +195,17 @@ class TestLazyImportChecker:
         _write(tmp_path, 'serve/controller.py', 'import jax\n')
         report = _run(tmp_path, checks=['lazy-imports'])
         assert _idents(report) == ['lazy-imports:serve/controller.py:jax']
+
+    def test_handoff_transport_exempt_but_disagg_siblings_not(
+            self, tmp_path):
+        # The KV handoff transport holds numpy arrays at module scope
+        # (data plane, like the engine); any OTHER disagg module is
+        # still control plane and must stay light.
+        _write(tmp_path, 'serve/disagg/handoff.py', 'import numpy\n')
+        _write(tmp_path, 'serve/disagg/planner.py', 'import numpy\n')
+        report = _run(tmp_path, checks=['lazy-imports'])
+        assert _idents(report) == [
+            'lazy-imports:serve/disagg/planner.py:numpy']
 
 
 # ------------------------------------------------------------ async blocking
@@ -1552,7 +1603,7 @@ class TestLivePackage:
         with open(out_path, encoding='utf-8') as f:
             report = json.load(f)
         # Schema stability (version-bump ratchet).
-        assert report['skylint_version'] == core.REPORT_VERSION == 11
+        assert report['skylint_version'] == core.REPORT_VERSION == 12
         assert set(report) == {
             'skylint_version', 'root', 'files_scanned', 'checks',
             'violations', 'total', 'allowlisted', 'new',
