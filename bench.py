@@ -1041,6 +1041,170 @@ def run_loadgen_bench():
     print(json.dumps(doc), flush=True)
 
 
+RL_HARVEST_LAST_GOOD_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    'RL_HARVEST_LAST_GOOD.json')
+
+
+def _diff_rl_harvest(doc, last):
+    """Tolerance-band diff against the checked-in scorecard (the
+    loadgen-baseline precedent): multiplicative bands on the rate
+    ratios (CPU boxes are noisy; an order of magnitude is not noise)
+    and an absolute floor on the recovery ratio."""
+    regressions = []
+    base = last.get('result', last)
+
+    def band(key, factor):
+        ours, theirs = doc.get(key), base.get(key)
+        if ours is None or not theirs:
+            return
+        if ours < theirs / factor or ours > theirs * factor:
+            regressions.append(
+                f'{key}: {ours:.4g} vs last-good {theirs:.4g} '
+                f'(band x{factor})')
+
+    band('samples_per_sec_nokill', 3.0)
+    band('sps_ratio_kill_vs_nokill', 2.0)
+    band('cost_ratio_harvested_vs_ondemand', 1.5)
+    floor = base.get('recovery_ratio')
+    ours = doc.get('recovery_ratio')
+    if ours is not None and floor:
+        if ours < min(0.5, floor * 0.6):
+            regressions.append(
+                f'recovery_ratio: {ours:.3f} vs last-good '
+                f'{floor:.3f} (floor min(0.5, x0.6))')
+    return {'ok': not regressions, 'regressions': regressions}
+
+
+def run_rl_harvest_bench():
+    """SKYTPU_BENCH_METRIC=rl_harvest (CPU proxy, tiny model): the
+    harvested-RL plane as a regression tripwire + cost artifact.
+
+    Runs the SAME harness the chaos suite drives
+    (skypilot_tpu/train/rollout/harness.py), twice:
+
+      * on-demand control — 0 kills: steady fleet, on-demand worker
+        pricing;
+      * harvested — a seeded kill schedule SIGKILLs 2 of 3 workers
+        mid-run and respawns them, spot worker pricing.
+
+    Reports samples/sec for both, their ratio, recovery time and the
+    post-rejoin/pre-kill recovery ratio, staleness quantiles, journal
+    reassignment evidence, and cost-per-sample for harvested vs
+    on-demand-only (catalog spot/on-demand prices; compute time
+    measured on this box). `value` is the cost-per-sample ratio
+    harvested/on-demand — <1 means spot harvesting is cheaper per
+    sample even after paying for the churn. Diffs against the
+    checked-in RL_HARVEST_LAST_GOOD.json with tolerance bands."""
+    import shutil
+    import tempfile
+
+    run_dir = tempfile.mkdtemp(prefix='skytpu-bench-rl-')
+    os.environ['SKYTPU_OBSERVE_DB'] = os.path.join(run_dir,
+                                                   'observe.db')
+    from skypilot_tpu.observe import journal
+    from skypilot_tpu.train.rollout import harness
+
+    device = _get_device()
+    steps = int(os.environ.get('SKYTPU_BENCH_RL_STEPS', '40'))
+    workers = int(os.environ.get('SKYTPU_BENCH_RL_WORKERS', '3'))
+    kills = int(os.environ.get('SKYTPU_BENCH_RL_KILLS', '2'))
+    kill_at = int(os.environ.get('SKYTPU_BENCH_RL_KILL_AT', '8'))
+    respawn_at = int(os.environ.get('SKYTPU_BENCH_RL_RESPAWN_AT',
+                                    '10'))
+    accel = os.environ.get('SKYTPU_BENCH_RL_ACCEL', 'v5litepod-8')
+    try:
+        control = harness.run_harvest(
+            run_dir, n_workers=workers, total_steps=steps,
+            tag='ondemand')
+        harvested = harness.run_harvest(
+            run_dir, n_workers=workers, total_steps=steps,
+            kill_at_step=kill_at, kill_count=kills,
+            respawn_at_step=respawn_at, tag='spot')
+        reassigns = [e for e in
+                     journal.query(kind='rollout_lease_reassign',
+                                   limit=500)
+                     if e['entity'] in harvested['killed']]
+    finally:
+        shutil.rmtree(run_dir, ignore_errors=True)
+
+    cost_harvested = harness.cost_per_sample(
+        harvested['samples_total'], harvested['learner_busy_s'],
+        harvested['worker_busy_s'], accelerator=accel,
+        workers_spot=True)
+    cost_ondemand = harness.cost_per_sample(
+        control['samples_total'], control['learner_busy_s'],
+        control['worker_busy_s'], accelerator=accel,
+        workers_spot=False)
+    cps_h = cost_harvested['cost_per_sample_usd']
+    cps_o = cost_ondemand['cost_per_sample_usd']
+    sps_nokill = control['samples_per_sec']
+    sps_kill = harvested['samples_per_sec']
+    recovery_ratio = None
+    if harvested['post_rejoin_sps'] and harvested['pre_kill_sps']:
+        recovery_ratio = round(harvested['post_rejoin_sps'] /
+                               harvested['pre_kill_sps'], 4)
+    doc = {
+        'metric': 'rl_harvest',
+        'value': (round(cps_h / cps_o, 4)
+                  if cps_h and cps_o else None),
+        'unit': 'x (cost/sample harvested vs on-demand-only)',
+        'steps': steps,
+        'workers': workers,
+        'preemptions': len(harvested['killed']),
+        'lease_reassigns_journaled': len(reassigns),
+        'samples_per_sec_nokill': (round(sps_nokill, 3)
+                                   if sps_nokill else None),
+        'samples_per_sec_kill': (round(sps_kill, 3)
+                                 if sps_kill else None),
+        'sps_ratio_kill_vs_nokill': (
+            round(sps_kill / sps_nokill, 4)
+            if sps_kill and sps_nokill else None),
+        'pre_kill_sps': harvested['pre_kill_sps'],
+        'degraded_sps': harvested['degraded_sps'],
+        'post_rejoin_sps': harvested['post_rejoin_sps'],
+        'best_post_rejoin_sps': harvested['best_post_rejoin_sps'],
+        'recovery_s': (round(harvested['recovery_s'], 2)
+                       if harvested['recovery_s'] else None),
+        'recovery_ratio': recovery_ratio,
+        'staleness_p50': harvested['report']['staleness_p50'],
+        'staleness_p95': harvested['report']['staleness_p95'],
+        'stale_dropped': harvested['report']['stale_dropped'],
+        'cost_per_sample_harvested_usd': cps_h,
+        'cost_per_sample_ondemand_usd': cps_o,
+        'cost_ratio_harvested_vs_ondemand': (
+            round(cps_h / cps_o, 4) if cps_h and cps_o else None),
+        'cost_detail_harvested': cost_harvested,
+        'cost_detail_ondemand': cost_ondemand,
+        'device': device.device_kind,
+    }
+    if not os.path.exists(RL_HARVEST_LAST_GOOD_PATH):
+        # Seed ONLY when genuinely absent — a corrupt checked-in
+        # baseline must not be silently replaced by whatever this
+        # run measured (that would reset the regression tripwire).
+        print('[bench] no RL_HARVEST_LAST_GOOD.json to diff against; '
+              'seeding it from this run', file=sys.stderr)
+        with open(RL_HARVEST_LAST_GOOD_PATH, 'w') as f:
+            json.dump({'measured_at': time.strftime(
+                '%Y-%m-%dT%H:%M:%SZ', time.gmtime()),
+                'result': doc}, f, indent=2, sort_keys=True)
+            f.write('\n')
+    else:
+        try:
+            with open(RL_HARVEST_LAST_GOOD_PATH) as f:
+                last_good = json.load(f)
+            diff = _diff_rl_harvest(doc, last_good)
+            doc['vs_last_good'] = diff
+            if not diff['ok']:
+                print(f'[bench] rl_harvest REGRESSION vs last good: '
+                      f'{diff["regressions"]}', file=sys.stderr)
+        except (OSError, ValueError) as e:
+            print(f'[bench] RL_HARVEST_LAST_GOOD.json unreadable '
+                  f'({e}); diff skipped — fix or delete the baseline',
+                  file=sys.stderr)
+    print(json.dumps(doc), flush=True)
+
+
 def run_kernelcheck():
     """SKYTPU_BENCH_METRIC=kernelcheck: assert the Pallas flash kernel
     matches the XLA reference fwd+bwd ON THE ATTACHED DEVICE, across a
@@ -1170,6 +1334,8 @@ if __name__ == '__main__':
             run_train_input_bench()
         elif metric == 'loadgen':
             run_loadgen_bench()
+        elif metric == 'rl_harvest':
+            run_rl_harvest_bench()
         elif metric == 'kernelcheck':
             run_kernelcheck()
         else:

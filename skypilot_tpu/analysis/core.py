@@ -38,8 +38,11 @@ PACKAGE = 'skypilot_tpu'
 # map through observe/request_class.normalize()/from_headers() before
 # reaching any metric label kwarg; v12: layers learns NESTED sub-unit
 # ranks ('serve/disagg' above 'serve' — the serve plane may only
-# bridge to the disagg orchestration layer lazily).
-REPORT_VERSION = 12
+# bridge to the disagg orchestration layer lazily); v13: the
+# spot-harvesting RL plane ('train/rollout' ranked 13 above train,
+# its dispatcher joins the sqlite state-DB set, and the rollout
+# worker/lease machines join the enum-coverage rule).
+REPORT_VERSION = 13
 
 
 @dataclasses.dataclass

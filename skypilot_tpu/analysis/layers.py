@@ -72,6 +72,13 @@ LAYERS = {
     # strictly-downward imports both ways.
     'data_service': 11,
     'train': 12,
+    # 13 — nested sub-unit: the spot-harvesting RL plane. It sits
+    # ABOVE train (it drives train/grpo's update math and publishes
+    # snapshots through train/checkpoints) and above data_service's
+    # rank (same dispatcher/worker idiom, shared utils/framed
+    # transport), importing models/observe/utils strictly downward.
+    # Modules of 'train' outside 'rollout' keep rank 12.
+    'train/rollout': 13,
     # 12 — on-cluster runtime (library the backend codegens against)
     'skylet': 12,
     # 13-16 — provision → backends → core/execution

@@ -42,6 +42,7 @@ STATE_DB_PATHS = frozenset({
     'global_state.py',
     'observe/journal.py',
     'data_service/dispatcher.py',
+    'train/rollout/dispatcher.py',
 })
 
 _VERB_RE = re.compile(

@@ -112,6 +112,31 @@ DATA_SPLIT_TRANSITIONS: Dict[str, Set[str]] = {
     'ASSIGNED': {'UNASSIGNED'},
 }
 
+# ------------------------------------------------------- rollout plane
+# RolloutWorkerStatus (train/rollout/dispatcher.py). Same shape as the
+# data-service registry: no terminal state — a harvested (preempted)
+# worker that comes back re-registers and goes ALIVE again; its leases
+# were already reassigned.
+ROLLOUT_WORKER_TRANSITIONS: Dict[str, Set[str]] = {
+    'ALIVE': {'LOST'},
+    'LOST': {'ALIVE'},
+}
+
+# RolloutLeaseStatus (train/rollout/dispatcher.py). A prompt lease is
+# minted PENDING, handed to a worker (LEASED), and completed exactly
+# once (DONE, terminal — first completed trajectory wins). LEASED ->
+# PENDING is the reassignment edge (owner died / lease timed out /
+# worker released it after a failed generation). PENDING -> DONE is
+# legal on purpose: at-least-once reassignment means a lease can sit
+# PENDING (owner reaped) while its ORIGINAL owner — alive after all —
+# finishes and submits; refusing that trajectory would waste real
+# rollout compute for state-machine aesthetics.
+ROLLOUT_LEASE_TRANSITIONS: Dict[str, Set[str]] = {
+    'PENDING': {'LEASED', 'DONE'},
+    'LEASED': {'PENDING', 'DONE'},
+    'DONE': set(),
+}
+
 # Enum class name -> its transition table (what the state-machine
 # checker verifies coverage against).
 ENUM_TABLES: Dict[str, Dict[str, Set[str]]] = {
@@ -120,6 +145,8 @@ ENUM_TABLES: Dict[str, Dict[str, Set[str]]] = {
     'ReplicaStatus': REPLICA_TRANSITIONS,
     'DataWorkerStatus': DATA_WORKER_TRANSITIONS,
     'DataSplitStatus': DATA_SPLIT_TRANSITIONS,
+    'RolloutWorkerStatus': ROLLOUT_WORKER_TRANSITIONS,
+    'RolloutLeaseStatus': ROLLOUT_LEASE_TRANSITIONS,
 }
 
 # Functions allowed to write a status column directly (raw UPDATE SQL
@@ -138,6 +165,8 @@ GUARDED_SETTERS: FrozenSet[str] = frozenset({
     'set_running', 'set_result', 'set_failed', 'set_cancelled',
     # data_service/dispatcher.py (worker registry + split assignment)
     'set_worker_status', 'set_split_status',
+    # train/rollout/dispatcher.py (rollout registry + prompt leases)
+    'set_rollout_worker_status', 'set_lease_status',
 })
 
 

@@ -22,7 +22,6 @@ vocab — ``data/loader.validate_vocab``) refuses EVERY fetch with a
 from __future__ import annotations
 
 import collections
-import hashlib
 import queue
 import socket
 import threading
@@ -40,12 +39,9 @@ from skypilot_tpu.utils import failpoints
 logger = sky_logging.init_logger(__name__)
 
 
-def stable_seed(text: str) -> int:
-    """Deterministic seed from an id string. ``hash(str)`` is salted
-    per process (PYTHONHASHSEED), which would break the seeded-Backoff
-    contract of bit-reproducible retry timelines."""
-    return int.from_bytes(
-        hashlib.sha256(text.encode('utf-8')).digest()[:4], 'big')
+# THE seed derivation for worker-style loops (shared with the rollout
+# worker; utils/backoff owns it so the planes can't drift).
+stable_seed = backoff_lib.stable_seed
 
 
 def _routable_host(bound_host: str,

@@ -110,7 +110,12 @@ def make_grpo_update(cfg, mesh, tx: optax.GradientTransformation,
     each row's completion positions in the [L-1] log-prob grid (rows are
     PACKED — prompt then completion at the row's true length — so
     ragged prompt batches score completions at the positions they were
-    actually sampled at)."""
+    actually sampled at).
+
+    ``mesh=None`` runs the update WITHOUT an ambient mesh — the
+    single-device path the harvested-RL learner (train/rollout) uses:
+    no sharding APIs touched, so it runs on every jax version the repo
+    supports (the churn-trainer idiom)."""
 
     def update(state: train_lib.TrainState, seq, comp_idx, behavior_lp,
                adv, mask, ref_lp):
@@ -157,6 +162,9 @@ def make_grpo_update(cfg, mesh, tx: optax.GradientTransformation,
                 ref_lp=None):
         if ref_lp is None:
             ref_lp = jnp.zeros_like(behavior_lp)
+        if mesh is None:
+            return jitted(state, seq, comp_idx, behavior_lp, adv, mask,
+                          ref_lp)
         with mesh_lib.use_mesh(mesh):
             return jitted(state, seq, comp_idx, behavior_lp, adv, mask,
                           ref_lp)
@@ -362,7 +370,8 @@ def main() -> None:
     parser.add_argument('--eos-id', type=int, default=None)
     parser.add_argument('--mesh', default='')
     parser.add_argument('--ckpt-dir', default=None,
-                        help='Orbax checkpoint dir for the policy.')
+                        help='Checkpoint dir for the policy (native '
+                             'chunked format; resume-from-newest).')
     parser.add_argument('--ckpt-every', type=int, default=50)
     args = parser.parse_args()
 
@@ -436,23 +445,56 @@ def main() -> None:
                 i += 1
 
     ckpt = None
+    start_it = 0
     if args.ckpt_dir:
         from skypilot_tpu.train import checkpoints
         ckpt = checkpoints.Checkpointer(args.ckpt_dir)
+        if ckpt.latest_step() is not None:
+            # Elastic resume (the trainer-CLI contract from the jobs
+            # plane): restore the newest COMPLETE step through the
+            # resharding path — a preempted GRPO job relaunched on a
+            # different mesh picks up where it left off instead of
+            # losing the run. Corrupt-newest falls back to an older
+            # complete step inside restore_newest.
+            abstract = checkpoints.abstract_train_state(
+                trainer.cfg, trainer.mesh, trainer.tx)
+            state, start_it = ckpt.restore_newest(abstract)
+            trainer.state = state
+            logger.info(f'Resumed GRPO policy at iteration {start_it} '
+                        f'from {args.ckpt_dir}.')
     try:
         batches = prompt_batches()
-        for it in range(args.iterations):
-            prompts, lens = next(batches)
-            metrics = trainer.iteration(prompts, prompt_lengths=lens)
-            logger.info(json.dumps(
-                {'iter': it + 1,
-                 **{k: round(v, 4) for k, v in metrics.items()}}))
-            if ckpt is not None and (it + 1) % args.ckpt_every == 0:
-                ckpt.save(trainer.state, it + 1)
-        if ckpt is not None and args.iterations % args.ckpt_every != 0:
-            # Aligned totals were already saved by the in-loop cadence
-            # (a complete step is durable; re-saving it is a no-op).
-            ckpt.save(trainer.state, args.iterations)
+        for _ in range(start_it):
+            # Fast-forward: iteration i's prompts must be the same
+            # whether or not the run was preempted before it (prompt
+            # construction is cheap; the stream is a pure function of
+            # the iteration index).
+            next(batches)
+        with trainer_mod._PreemptionWatch() as watch:
+            for it in range(start_it, args.iterations):
+                prompts, lens = next(batches)
+                metrics = trainer.iteration(prompts, prompt_lengths=lens)
+                logger.info(json.dumps(
+                    {'iter': it + 1,
+                     **{k: round(v, 4) for k, v in metrics.items()}}))
+                if ckpt is not None and (it + 1) % args.ckpt_every == 0:
+                    ckpt.save(trainer.state, it + 1)
+                if watch.preempted:
+                    # Preemption notice (SIGTERM / trainer.preempt
+                    # failpoint): one synchronous final save, clean
+                    # exit — the relaunch resumes via restore_newest
+                    # on whatever mesh recovery lands on.
+                    if ckpt is not None:
+                        ckpt.save(trainer.state, it + 1, wait=True)
+                    logger.info(json.dumps(
+                        {'iter': it + 1, 'preempted': True,
+                         'final_checkpoint': ckpt is not None}))
+                    return
+            if ckpt is not None and args.iterations % args.ckpt_every != 0:
+                # Aligned totals were already saved by the in-loop
+                # cadence (a complete step is durable; re-saving it is
+                # a no-op).
+                ckpt.save(trainer.state, args.iterations)
     finally:
         if ckpt is not None:
             ckpt.close()

@@ -1,0 +1,27 @@
+"""Spot-harvesting RL plane: preemptible rollout fleet → stable learner.
+
+The RLBoost topology (PAPERS.md) built from planes this repo already
+has: a **dispatcher** (WAL-sqlite worker registry + prompt-lease state
+machine, the ``data_service/`` idiom over ``utils/framed`` TCP),
+**harvestable rollout workers** (stateless jax processes that generate
+GRPO completion groups from a learner-published policy snapshot and
+survive SIGKILL at any point), and a **stable learner**
+(``train/grpo`` update math fed by an at-least-once trajectory stream,
+staleness-bounded off-policy window, journaled trajectory log whose
+replay reproduces the loss trajectory bit-equal).
+
+Why it is robust by construction:
+
+  * a lease's prompt is a pure function of ``(spec, lease_id)`` — any
+    worker can (re)compute it, so reassignment ships one integer;
+  * trajectories are stamped with the snapshot version that generated
+    them — the learner drops anything older than its staleness window
+    instead of silently training on ancient behavior;
+  * policy snapshots ride the chunked, digest-verified checkpoint
+    format (``train/checkpoints``) — workers restore onto whatever
+    device/mesh they have, which is exactly what makes them
+    harvestable;
+  * losing ANY subset of workers degrades learner throughput but never
+    stalls or corrupts it (docs/ROBUSTNESS.md, "Harvested RL plane").
+"""
+from skypilot_tpu.train.rollout.spec import RolloutSpec  # noqa: F401

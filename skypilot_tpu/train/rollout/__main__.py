@@ -1,0 +1,164 @@
+"""CLI: ``python -m skypilot_tpu.train.rollout dispatcher|worker|learner``.
+
+Rollout workers are low-priority managed jobs to the control plane —
+see examples/rl-harvest.yaml for the gang wiring (dispatcher + learner
+on the stable on-demand slice, workers harvesting spot capacity). All
+subcommands print one JSON readiness line to stdout (role, address,
+identity) so a supervising task — or a chaos test — can harvest the
+endpoint; dispatcher and worker then serve until SIGTERM/SIGINT.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import sys
+import threading
+from typing import List, Optional
+
+from skypilot_tpu.utils import failpoints
+
+
+def _serve_until_signal(on_stop=None) -> None:
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    if on_stop is not None:
+        on_stop()
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    failpoints.load_env()
+    parser = argparse.ArgumentParser(
+        prog='python -m skypilot_tpu.train.rollout',
+        description='Spot-harvesting RL plane '
+                    '(docs/ROBUSTNESS.md, "Harvested RL plane").')
+    sub = parser.add_subparsers(dest='cmd', required=True)
+
+    disp = sub.add_parser('dispatcher',
+                          help='worker registry + prompt leases')
+    disp.add_argument('--host', default='0.0.0.0')
+    disp.add_argument('--port', type=int, default=8480)
+    disp.add_argument('--db', default='~/.skytpu/rollout/dispatcher.db')
+    disp.add_argument('--heartbeat-timeout', type=float,
+                      default=float(os.environ.get(
+                          'SKYTPU_ROLLOUT_HEARTBEAT_TIMEOUT', '10.0')))
+    disp.add_argument('--lease-timeout', type=float,
+                      default=float(os.environ.get(
+                          'SKYTPU_ROLLOUT_LEASE_TIMEOUT', '120.0')))
+    disp.add_argument('--max-outstanding', type=int,
+                      default=int(os.environ.get(
+                          'SKYTPU_ROLLOUT_MAX_OUTSTANDING', '32')))
+
+    work = sub.add_parser('worker', help='harvestable rollout worker')
+    work.add_argument('--dispatcher', required=True,
+                      help='host:port of the rollout dispatcher')
+    work.add_argument('--worker-id', default=None)
+    work.add_argument('--heartbeat-interval', type=float, default=2.0)
+    work.add_argument('--leases-per-round', type=int, default=1)
+
+    learn = sub.add_parser('learner', help='stable GRPO learner')
+    learn.add_argument('--dispatcher', required=True)
+    learn.add_argument('--model', default='llama-debug')
+    learn.add_argument('--reward', required=True,
+                       help='count_token:ID | length | module:function')
+    learn.add_argument('--snapshot-dir', required=True,
+                       help='shared dir for policy snapshots (workers '
+                            'restore from it)')
+    learn.add_argument('--steps', type=int, default=100)
+    learn.add_argument('--groups-per-step', type=int, default=2)
+    learn.add_argument('--group-size', type=int, default=4)
+    learn.add_argument('--prompt-len', type=int, default=16)
+    learn.add_argument('--max-new-tokens', type=int, default=16)
+    learn.add_argument('--temperature', type=float, default=1.0)
+    learn.add_argument('--kl-coef', type=float, default=0.0)
+    learn.add_argument('--lr', type=float, default=1e-4)
+    learn.add_argument('--eos-id', type=int, default=None)
+    learn.add_argument('--seed', type=int, default=0)
+    learn.add_argument('--publish-every', type=int, default=4)
+    learn.add_argument('--max-staleness', type=int, default=4)
+    learn.add_argument('--snapshot-keep', type=int, default=4)
+    learn.add_argument('--state-dir', default=None,
+                       help='learner TrainState checkpoints '
+                            '(preemption resume)')
+    learn.add_argument('--traj-log', default=None,
+                       help='journaled trajectory log dir (replay)')
+
+    args = parser.parse_args(argv)
+
+    if args.cmd == 'dispatcher':
+        from skypilot_tpu.train.rollout import dispatcher as disp_lib
+        d = disp_lib.RolloutDispatcher(
+            os.path.expanduser(args.db), host=args.host, port=args.port,
+            heartbeat_timeout=args.heartbeat_timeout,
+            lease_timeout=args.lease_timeout,
+            max_outstanding=args.max_outstanding).start()
+        print(json.dumps({'role': 'dispatcher',
+                          'addr': f'{d.addr[0]}:{d.addr[1]}'}),
+              flush=True)
+        _serve_until_signal(d.stop)
+        return 0
+
+    if args.cmd == 'worker':
+        from skypilot_tpu.utils import jax_utils
+        jax_utils.pin_platform_from_env()
+        from skypilot_tpu.train.rollout import worker as worker_lib
+        from skypilot_tpu.utils import framed
+        w = worker_lib.RolloutWorker(
+            framed.parse_addr(args.dispatcher),
+            worker_id=args.worker_id,
+            heartbeat_interval=args.heartbeat_interval,
+            leases_per_round=args.leases_per_round).start()
+        print(json.dumps({'role': 'worker',
+                          'worker_id': w.worker_id}), flush=True)
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, lambda *_: w.stop())
+        try:
+            w.run()
+        finally:
+            w.stop()
+        return 0
+
+    # learner
+    from skypilot_tpu.utils import jax_utils
+    jax_utils.pin_platform_from_env()
+    from skypilot_tpu import models as models_lib
+    from skypilot_tpu.train.rollout import learner as learner_lib
+    from skypilot_tpu.train.rollout import spec as spec_lib
+    from skypilot_tpu.utils import framed
+    cfg = models_lib.get_config(args.model)
+    spec = spec_lib.RolloutSpec(
+        model=args.model, reward=args.reward,
+        snapshot_dir=os.path.expanduser(args.snapshot_dir),
+        vocab_size=cfg.vocab_size, prompt_len=args.prompt_len,
+        group_size=args.group_size,
+        max_new_tokens=args.max_new_tokens,
+        temperature=args.temperature, kl_coef=args.kl_coef,
+        eos_id=args.eos_id, seed=args.seed)
+    learner = learner_lib.RolloutLearner(
+        spec, framed.parse_addr(args.dispatcher),
+        total_steps=args.steps,
+        groups_per_step=args.groups_per_step,
+        publish_every=args.publish_every,
+        max_staleness=args.max_staleness,
+        learning_rate=args.lr,
+        snapshot_max_to_keep=args.snapshot_keep,
+        state_dir=(os.path.expanduser(args.state_dir)
+                   if args.state_dir else None),
+        traj_log_dir=(os.path.expanduser(args.traj_log)
+                      if args.traj_log else None))
+    with learner:
+        print(json.dumps({'role': 'learner',
+                          'spec_fp': spec.fingerprint(),
+                          'start_step': learner.start_step}),
+              flush=True)
+        learner.run()
+        print(json.dumps({'role': 'learner', 'done': True,
+                          **learner.report()}), flush=True)
+    return 0
+
+
+if __name__ == '__main__':
+    sys.exit(main())

@@ -1,0 +1,304 @@
+"""Harvest-run harness: dispatcher + worker subprocesses + learner.
+
+One entry point (:func:`run_harvest`) drives a complete harvested-RL
+run on this box — in-process dispatcher and learner (so callers can
+read the journal and the learner's accounting), REAL worker
+subprocesses (so SIGKILL means SIGKILL) — under a seeded kill/respawn
+schedule. Shared by ``bench.py rl_harvest`` (the scorecard pair:
+0-kill control vs seeded-kill harvest) and the chaos suite
+(tests/chaos/test_rollout_churn.py), so the numbers the scorecard
+reports come from exactly the code path the chaos proof exercises.
+
+Cost accounting (:func:`cost_per_sample`) prices the learner at
+on-demand and the workers at spot (or on-demand, for the control
+configuration) using the catalog layer — the RLBoost economics: spot
+rollout capacity is ~40% of on-demand price, and the harness measures
+how much of that saving preemption churn gives back.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.train.rollout import dispatcher as dispatcher_lib
+from skypilot_tpu.train.rollout import learner as learner_lib
+from skypilot_tpu.train.rollout import spec as spec_lib
+from skypilot_tpu.utils import framed
+
+logger = sky_logging.init_logger(__name__)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+
+def default_spec(run_dir: str, tag: str = 'run',
+                 **overrides) -> spec_lib.RolloutSpec:
+    """The tiny CPU-proxy job both the bench and the chaos suite run.
+
+    ``snapshot_dir`` is TAG-scoped: bench's control/harvested run pair
+    shares one run_dir, and a shared snapshot directory would let the
+    second run's workers restore the FIRST run's final policy (its
+    step numbers sort newer than the fresh run's version 0)."""
+    from skypilot_tpu import models as models_lib
+    fields = dict(
+        model='llama-debug',
+        reward='count_token:42',
+        snapshot_dir=os.path.join(run_dir, f'snapshots-{tag}'),
+        vocab_size=models_lib.get_config('llama-debug').vocab_size,
+        prompt_len=8, group_size=4, max_new_tokens=8,
+        temperature=1.0, seed=0,
+        # Pacing: the tiny model generates near-instantly on CPU, so
+        # without a per-group cost the learner banks the whole run in
+        # its prefetch buffer and worker churn is invisible. The delay
+        # makes rollout capacity the bottleneck — kills visibly
+        # degrade samples/sec, rejoin visibly restores it.
+        rollout_delay_s=0.25)
+    fields.update(overrides)
+    return spec_lib.RolloutSpec(**fields)
+
+
+def spawn_worker(dispatcher_addr, worker_id: str, *,
+                 heartbeat_interval: float = 0.3,
+                 extra_env: Optional[Dict[str, str]] = None
+                 ) -> subprocess.Popen:
+    """A REAL rollout-worker subprocess (CPU jax). The persistent
+    jax compile cache is disabled: jax 0.4.x segfaults reloading this
+    program mix (the train-churn suite's documented workaround)."""
+    env = {**os.environ, 'PYTHONPATH': _REPO, 'JAX_PLATFORMS': 'cpu',
+           'JAX_ENABLE_COMPILATION_CACHE': 'false'}
+    env.pop('JAX_COMPILATION_CACHE_DIR', None)
+    env.update(extra_env or {})
+    return subprocess.Popen(
+        [sys.executable, '-m', 'skypilot_tpu.train.rollout', 'worker',
+         '--dispatcher', f'{dispatcher_addr[0]}:{dispatcher_addr[1]}',
+         '--worker-id', worker_id,
+         '--heartbeat-interval', str(heartbeat_interval)],
+        cwd=_REPO, env=env, stdout=subprocess.DEVNULL,
+        stderr=subprocess.DEVNULL)
+
+
+def wait_alive(dispatcher_addr, n: int, timeout: float = 90.0) -> None:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        reply, _ = framed.request(dispatcher_addr, {'op': 'stats'},
+                                  timeout=5.0)
+        if reply['workers'].get('ALIVE', 0) >= n:
+            return
+        time.sleep(0.1)
+    raise TimeoutError(f'{n} rollout workers not ALIVE within '
+                       f'{timeout}s')
+
+
+def _window_rate(walls: List[float], lo: int, hi: int,
+                 samples_per_step: float) -> Optional[float]:
+    """samples/sec over completed steps [lo, hi) (wall = step-end
+    monotonic stamps)."""
+    span = walls[lo:hi]
+    if len(span) < 2:
+        return None
+    dt = span[-1] - span[0]
+    return (len(span) - 1) * samples_per_step / dt if dt > 0 else None
+
+
+def run_harvest(run_dir: str, *,
+                n_workers: int,
+                total_steps: int,
+                kill_at_step: Optional[int] = None,
+                kill_count: int = 0,
+                respawn_at_step: Optional[int] = None,
+                groups_per_step: int = 2,
+                publish_every: int = 4,
+                max_staleness: int = 8,
+                learning_rate: float = 1e-3,
+                heartbeat_timeout: float = 1.5,
+                lease_timeout: float = 20.0,
+                max_outstanding: int = 6,
+                result_cap: int = 4,
+                stall_budget_s: float = 120.0,
+                worker_env: Optional[Dict[str, str]] = None,
+                spec_overrides: Optional[Dict[str, Any]] = None,
+                tag: str = 'run') -> Dict[str, Any]:
+    """One complete harvested run under a deterministic kill schedule.
+
+    ``kill_at_step``: after the learner completes that step, SIGKILL
+    ``kill_count`` workers (no goodbye — mid-generation for any worker
+    currently holding a lease). ``respawn_at_step``: spawn the same
+    number of fresh workers after that step (capacity rejoins).
+    Returns the run artifact: learner history, samples/sec windows,
+    recovery time, per-role busy seconds for cost accounting, and the
+    killed worker ids (journal evidence keys).
+    """
+    os.makedirs(run_dir, exist_ok=True)
+    spec = default_spec(run_dir, tag=tag, **(spec_overrides or {}))
+    disp = dispatcher_lib.RolloutDispatcher(
+        os.path.join(run_dir, f'dispatcher-{tag}.db'),
+        heartbeat_timeout=heartbeat_timeout,
+        lease_timeout=lease_timeout,
+        # Tight backpressure: the buffer must not bank the run, or
+        # worker churn would be invisible to the learner's cadence.
+        max_outstanding=max_outstanding,
+        result_cap=result_cap).start()
+    procs: Dict[str, subprocess.Popen] = {}
+    spawn_ts: Dict[str, float] = {}
+    dead_ts: Dict[str, float] = {}
+    killed: List[str] = []
+    kill_wall: Optional[float] = None
+    respawn_wall: Optional[float] = None
+
+    def _spawn(i: int) -> None:
+        wid = f'rw-{tag}-{i}'
+        procs[wid] = spawn_worker(disp.addr, wid,
+                                  extra_env=worker_env)
+        spawn_ts[wid] = time.monotonic()
+
+    learner = None
+    try:
+        learner = learner_lib.RolloutLearner(
+            spec, disp.addr, total_steps=total_steps,
+            groups_per_step=groups_per_step,
+            publish_every=publish_every, max_staleness=max_staleness,
+            learning_rate=learning_rate,
+            traj_log_dir=os.path.join(run_dir, f'traj-{tag}'),
+            stall_budget_s=stall_budget_s,
+            on_step=lambda step: _schedule(step))
+
+        def _schedule(step: int) -> None:
+            nonlocal kill_wall, respawn_wall
+            if kill_at_step is not None and step + 1 == kill_at_step \
+                    and not killed:
+                for wid in list(procs)[:kill_count]:
+                    procs[wid].send_signal(signal.SIGKILL)
+                    procs[wid].wait(timeout=10)
+                    dead_ts[wid] = time.monotonic()
+                    killed.append(wid)
+                kill_wall = time.monotonic()
+            if respawn_at_step is not None and \
+                    step + 1 == respawn_at_step and \
+                    respawn_wall is None and killed:
+                base = len(procs)
+                for j in range(len(killed)):
+                    _spawn(base + j)
+                respawn_wall = time.monotonic()
+
+        t_start = time.monotonic()
+        # Workers first: their jax boot overlaps the learner's
+        # put_spec + initial publish + update-jit warmup.
+        for i in range(n_workers):
+            _spawn(i)
+        learner.start()
+        wait_alive(disp.addr, n_workers)
+        history = learner.run()
+        duration = time.monotonic() - t_start
+
+        walls = learner.step_walls
+        per_step = groups_per_step * spec.group_size
+        sps_all = _window_rate(walls, 0, len(walls), per_step)
+        pre = post = degraded = best_post = None
+        recovery_s = None
+        if kill_at_step is not None and killed:
+            # Pre-kill rate over the steady approach to the kill —
+            # the first steps drain whatever the fleet banked during
+            # the learner's compile and would inflate the baseline.
+            pre = _window_rate(walls, max(1, kill_at_step - 5),
+                               kill_at_step, per_step)
+            degraded = _window_rate(
+                walls, kill_at_step,
+                min(len(walls), kill_at_step + 6), per_step)
+            # Post-rejoin rate = the steady tail (respawned workers
+            # pay jax boot + compile before they contribute — that
+            # warm-up IS part of recovery time, not of the recovered
+            # rate).
+            post = _window_rate(walls, max(0, len(walls) - 5),
+                                len(walls), per_step)
+            # Recovery: kill → first moment the trailing 3-step rate
+            # is back to >= 90% of the pre-kill rate. Also export the
+            # BEST trailing window after the rejoin — the
+            # contention-robust recovery signal chaos tests assert on
+            # (the tail itself can be noisy on a loaded box).
+            best_post = None
+            for i in range(kill_at_step + 3, len(walls)):
+                rate = _window_rate(walls, i - 3, i + 1, per_step)
+                if rate is None:
+                    continue
+                if pre and recovery_s is None and rate >= 0.9 * pre:
+                    recovery_s = walls[i] - kill_wall
+                if respawn_at_step is not None and \
+                        i >= respawn_at_step + 1 and \
+                        (best_post is None or rate > best_post):
+                    best_post = rate
+        now = time.monotonic()
+        worker_busy_s = sum(
+            (dead_ts.get(wid, now) - t0)
+            for wid, t0 in spawn_ts.items())
+        return {
+            'tag': tag,
+            'spec_fp': spec.fingerprint(),
+            'spec': spec,
+            'steps': len(history),
+            'duration_s': round(duration, 3),
+            'history': history,
+            'report': learner.report(),
+            'samples_total': learner.samples_total,
+            'samples_per_sec': sps_all,
+            'pre_kill_sps': pre,
+            'degraded_sps': degraded,
+            'post_rejoin_sps': post,
+            'best_post_rejoin_sps': best_post,
+            'recovery_s': recovery_s,
+            'killed': killed,
+            'kill_wall': kill_wall,
+            'learner_busy_s': duration,
+            'worker_busy_s': worker_busy_s,
+            'traj_log_dir': os.path.join(run_dir, f'traj-{tag}'),
+            'losses': [h['loss'] for h in history],
+        }
+    finally:
+        # Learner first (stops the collect thread's redial loop), on
+        # EVERY exit path — a RolloutStallError must not leak a live
+        # thread + open sockets into the calling pytest process.
+        if learner is not None:
+            learner.close()
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs.values():
+            if proc.poll() is None:
+                try:
+                    proc.wait(timeout=10)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+        disp.stop()
+
+
+def cost_per_sample(samples: int, learner_busy_s: float,
+                    worker_busy_s: float, *,
+                    accelerator: str = 'v5litepod-8',
+                    workers_spot: bool = True) -> Dict[str, Any]:
+    """$/sample for a run: stable learner at on-demand price, rollout
+    fleet at spot (harvested) or on-demand (control) — prices from the
+    catalog layer, compute time from the measured run."""
+    from skypilot_tpu import catalog
+    from skypilot_tpu.tpu import topology
+    tpu_slice = topology.parse_tpu_accelerator(accelerator)
+    learner_rate = catalog.get_hourly_cost(tpu_slice, use_spot=False)
+    worker_rate = catalog.get_hourly_cost(tpu_slice,
+                                          use_spot=workers_spot)
+    learner_cost = learner_rate * learner_busy_s / 3600.0
+    worker_cost = worker_rate * worker_busy_s / 3600.0
+    total = learner_cost + worker_cost
+    return {
+        'accelerator': accelerator,
+        'workers_spot': workers_spot,
+        'learner_hourly_usd': learner_rate,
+        'worker_hourly_usd': worker_rate,
+        'learner_cost_usd': round(learner_cost, 6),
+        'worker_cost_usd': round(worker_cost, 6),
+        'total_cost_usd': round(total, 6),
+        'cost_per_sample_usd': (round(total / samples, 9)
+                                if samples else None),
+    }

@@ -1,0 +1,58 @@
+"""Shared metric declarations for the rollout plane.
+
+One module owns every ``skytpu_rollout_*`` declaration (the
+``data_service/telemetry.py`` precedent): dispatcher, worker and
+learner all import from here, so two copy-pasted declarations can
+never drift and break whichever module imports second.
+Catalog: docs/OBSERVABILITY.md, "Harvested RL plane".
+"""
+from __future__ import annotations
+
+from skypilot_tpu.observe import metrics as metrics_lib
+
+WORKERS_UP = metrics_lib.gauge(
+    'skytpu_rollout_workers_up',
+    'Rollout workers currently ALIVE in the dispatcher registry')
+
+LEASES = metrics_lib.counter(
+    'skytpu_rollout_leases_total',
+    'Prompt-lease events at the dispatcher',
+    labels={'event': ('minted', 'leased', 'done', 'reassigned',
+                      'duplicate', 'released')})
+
+TRAJECTORIES = metrics_lib.counter(
+    'skytpu_rollout_trajectories_total',
+    'Completed trajectory groups by role (worker=submitted, '
+    'learner=consumed)',
+    labels={'role': ('worker', 'learner')})
+
+SAMPLES = metrics_lib.counter(
+    'skytpu_rollout_samples_total',
+    'Completions consumed by the learner (trajectory groups x G)')
+
+STALENESS = metrics_lib.histogram(
+    'skytpu_rollout_staleness',
+    'Snapshot-version lag (published - generating version) of each '
+    'trajectory group at consumption',
+    buckets=(0.0, 1.0, 2.0, 4.0, 8.0, 16.0, 32.0))
+
+STALE_DROPPED = metrics_lib.counter(
+    'skytpu_rollout_stale_dropped_total',
+    'Trajectory groups dropped for exceeding the staleness window')
+
+SNAPSHOT_VERSION = metrics_lib.gauge(
+    'skytpu_rollout_snapshot_version',
+    'Latest policy snapshot version announced to the dispatcher')
+
+QUEUE_DEPTH = metrics_lib.gauge(
+    'skytpu_rollout_queue_depth',
+    'Buffered trajectory groups awaiting consumption',
+    labels={'role': ('dispatcher', 'learner')})
+
+STEP_SECONDS = metrics_lib.histogram(
+    'skytpu_rollout_step_seconds',
+    'Learner wall-clock per optimizer step (gather + update)')
+
+GENERATE_SECONDS = metrics_lib.histogram(
+    'skytpu_rollout_generate_seconds',
+    'Worker wall-clock per trajectory group (generate + score)')

@@ -1,0 +1,381 @@
+"""Harvestable rollout worker: leases in, trajectory groups out.
+
+A rollout worker holds NO state the learner depends on: its inputs
+are the :class:`~skypilot_tpu.train.rollout.spec.RolloutSpec` it pulls
+from the dispatcher, the lease ids it is granted, and whatever policy
+snapshot is newest in ``spec.snapshot_dir`` when it looks. SIGKILL at
+ANY point — mid-generation, mid-submit, between heartbeats — loses at
+most the leases it held, which the dispatcher reaps and reassigns;
+nothing about the learner's stream is corrupted (the chaos suite's
+load-bearing invariant, tests/chaos/test_rollout_churn.py). That is
+what makes the fleet harvestable: workers run as low-priority managed
+jobs on spot capacity (examples/rl-harvest.yaml) and preemption is an
+ordinary event, not a failure.
+
+Topology independence comes from the snapshot path: policies are
+published in the chunked, digest-verified checkpoint format
+(``train/checkpoints``), and the worker restores through
+``restore_newest(abstract)`` onto whatever device it has — the
+learner's mesh shape never constrains where a rollout can run.
+
+Per-lease determinism: the prompt AND the sampling RNG derive from
+``(spec, lease_id)``, so a reassigned lease re-executed against the
+same snapshot yields a byte-identical trajectory (at-least-once
+duplicates are literal duplicates; the dispatcher keeps the first).
+"""
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Dict, Optional, Tuple
+
+import numpy as np
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.train.rollout import spec as spec_lib
+from skypilot_tpu.train.rollout import telemetry
+from skypilot_tpu.utils import backoff as backoff_lib
+from skypilot_tpu.utils import failpoints
+from skypilot_tpu.utils import framed
+
+logger = sky_logging.init_logger(__name__)
+
+
+# THE seed derivation for worker-style loops (shared with the
+# data-service worker; utils/backoff owns it so the planes can't
+# drift).
+stable_seed = backoff_lib.stable_seed
+
+
+class RolloutWorker:
+    """One stateless rollout process: heartbeat + lease/generate loop."""
+
+    def __init__(self, dispatcher_addr: Tuple[str, int], *,
+                 worker_id: Optional[str] = None,
+                 heartbeat_interval: float = 2.0,
+                 register_timeout: float = 60.0,
+                 rpc_timeout: float = 10.0,
+                 leases_per_round: int = 1):
+        self.worker_id = worker_id or f'rw-{uuid.uuid4().hex[:8]}'
+        self._dispatcher_addr = dispatcher_addr
+        self._heartbeat_interval = heartbeat_interval
+        self._register_timeout = register_timeout
+        self._rpc_timeout = rpc_timeout
+        self._leases_per_round = max(1, leases_per_round)
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._spec: Optional[spec_lib.RolloutSpec] = None
+        self._latest_version = -1     # newest announced by the learner
+        self._held_version = -1       # version of the params we hold
+        self._seed = stable_seed(self.worker_id)
+        # Model state, built lazily on the run loop (jax import +
+        # compile must not block registration/heartbeats).
+        self._cfg = None
+        self._mod = None
+        self._dec = None
+        self._params = None
+        self._reward_fn = None
+        self._lp_fn = None
+        self._ckpt = None
+        # One persistent connection per owning thread (the framed
+        # idiom): heartbeats must not share a socket with a main loop
+        # that may be mid-request when the heartbeat fires.
+        self._hb_conn = framed.FramedClient(dispatcher_addr)
+        self._main_conn = framed.FramedClient(dispatcher_addr)
+        self._hb_thread = threading.Thread(
+            target=self._heartbeat_loop, daemon=True,
+            name=f'{self.worker_id}-heartbeat')
+
+    # ------------------------------------------------------- lifecycle
+
+    def start(self) -> 'RolloutWorker':
+        self._register(self._hb_conn, deadline_s=self._register_timeout)
+        self._hb_thread.start()
+        logger.info(f'rollout worker {self.worker_id} registered with '
+                    f'dispatcher {self._dispatcher_addr[0]}:'
+                    f'{self._dispatcher_addr[1]}')
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._hb_thread.join(timeout=5.0)
+        self._hb_conn.close()
+        self._main_conn.close()
+
+    def _register(self, conn: framed.FramedClient,
+                  deadline_s: float) -> None:
+        deadline = time.monotonic() + deadline_s
+        boff = backoff_lib.Backoff(base=0.2, cap=2.0, seed=self._seed)
+        last_err: Optional[Exception] = None
+        while time.monotonic() < deadline and not self._stop.is_set():
+            try:
+                reply, _ = conn.request(
+                    {'op': 'register', 'worker_id': self.worker_id},
+                    timeout=self._rpc_timeout)
+                self._adopt(reply)
+                return
+            except (framed.ProtocolError, framed.RemoteError,
+                    OSError) as e:
+                last_err = e
+                boff.sleep()
+        raise TimeoutError(
+            f'rollout worker {self.worker_id} could not register with '
+            f'dispatcher at {self._dispatcher_addr} within '
+            f'{deadline_s}s: {last_err}')
+
+    def _adopt(self, reply: Dict[str, Any]) -> None:
+        with self._lock:
+            version = int(reply.get('snapshot_version', -1))
+            if version > self._latest_version:
+                self._latest_version = version
+            if self._spec is None and reply.get('spec') is not None:
+                self._spec = spec_lib.RolloutSpec.from_json(
+                    reply['spec'])
+
+    # ------------------------------------------------------ heartbeats
+
+    def _heartbeat_loop(self) -> None:
+        boff = backoff_lib.Backoff(base=0.2, cap=5.0, seed=self._seed)
+        while not self._stop.wait(self._heartbeat_interval):
+            try:
+                with self._lock:
+                    have_spec = self._spec is not None
+                reply, _ = self._hb_conn.request(
+                    {'op': 'heartbeat', 'worker_id': self.worker_id,
+                     'have_spec': have_spec},
+                    timeout=self._rpc_timeout)
+                if reply.get('resync'):
+                    # Dispatcher declared us LOST: rejoin. Our old
+                    # leases were reassigned — at-least-once makes the
+                    # interim double-ownership harmless.
+                    self._register(self._hb_conn,
+                                   deadline_s=self._register_timeout)
+                else:
+                    self._adopt(reply)
+                boff.reset()
+            except (framed.ProtocolError, framed.RemoteError,
+                    OSError, TimeoutError) as e:
+                logger.warning(f'rollout worker {self.worker_id} '
+                               f'heartbeat failed: {e}')
+                boff.sleep()
+
+    # ------------------------------------------------------ model side
+
+    def _ensure_model(self) -> bool:
+        """Build model/reward/checkpointer once a spec is known.
+        Returns False while the spec has not arrived yet."""
+        with self._lock:
+            spec = self._spec
+        if spec is None:
+            return False
+        if self._cfg is not None:
+            return True
+        from skypilot_tpu import models as models_lib
+        from skypilot_tpu.models import decode as decode_lib
+        from skypilot_tpu.models import mla as mla_lib
+        from skypilot_tpu.train import checkpoints
+        from skypilot_tpu.train import grpo
+        cfg = models_lib.get_config(spec.model)
+        if cfg.vocab_size != spec.vocab_size:
+            raise ValueError(
+                f'spec vocab_size={spec.vocab_size} disagrees with '
+                f'model preset {spec.model!r} '
+                f'(vocab_size={cfg.vocab_size}) — the prompt stream '
+                f'would sample tokens the model cannot embed')
+        self._cfg = cfg
+        self._mod = models_lib.module_for(cfg)
+        self._dec = (self._mod if isinstance(cfg, mla_lib.MLAConfig)
+                     else decode_lib)
+        self._reward_fn = grpo.resolve_reward(spec.reward, spec.eos_id)
+        self._ckpt = checkpoints.Checkpointer(spec.snapshot_dir)
+        import functools
+
+        import jax
+        self._lp_fn = jax.jit(functools.partial(
+            grpo.token_logprobs, cfg=cfg, mod=self._mod,
+            temperature=spec.temperature))
+        return True
+
+    def _ensure_snapshot(self) -> bool:
+        """Fetch the newest policy snapshot when the learner announced
+        one newer than what we hold. Returns True iff params are
+        usable. Fetch failures (corrupt mid-GC step, injected
+        ``rollout.snapshot_fetch`` fault) keep the old params — a
+        stale policy degrades freshness, not correctness; the learner's
+        staleness window judges the result."""
+        with self._lock:
+            latest = self._latest_version
+        if self._params is not None and self._held_version >= latest:
+            return True
+        if latest < 0:
+            return self._params is not None
+        import jax
+
+        from skypilot_tpu.train import checkpoints
+        try:
+            if failpoints.ACTIVE:
+                failpoints.fire('rollout.snapshot_fetch')
+            abstract = jax.eval_shape(
+                lambda: self._mod.init_params(jax.random.PRNGKey(0),
+                                              self._cfg))
+            restored, version = self._ckpt.restore_newest(abstract)
+            if restored is None:
+                # Announced but not visible HERE yet (fresh shared
+                # mount, dispatcher restarted with persisted meta
+                # while the dir was cleaned): not an error — keep
+                # whatever we hold and look again next loop.
+                return self._params is not None
+            self._params = jax.device_put(restored)
+            self._held_version = int(version)
+            logger.info(f'rollout worker {self.worker_id} holds policy '
+                        f'snapshot v{self._held_version}')
+            return True
+        except (failpoints.FailpointError,
+                checkpoints.CheckpointCorruptError, OSError,
+                ValueError) as e:
+            logger.warning(f'rollout worker {self.worker_id} snapshot '
+                           f'fetch failed (keeping '
+                           f'v{self._held_version}): {e}')
+            return self._params is not None
+
+    def _generate(self, lease_id: int) -> Dict[str, np.ndarray]:
+        """One trajectory group for ``lease_id``: G completions,
+        rewards, and behavior log-probs under the HELD snapshot."""
+        import jax
+        import jax.numpy as jnp
+        spec = self._spec
+        s, t, g = spec.prompt_len, spec.max_new_tokens, spec.group_size
+        prompt = spec_lib.prompt_for(spec, lease_id)
+        rep = jnp.asarray(np.repeat(prompt[None, :], g, axis=0))
+        rng = jax.random.PRNGKey(
+            spec_lib.lease_rng_seed(spec, lease_id))
+        if failpoints.ACTIVE:
+            failpoints.fire('rollout.generate')
+        if spec.rollout_delay_s > 0:
+            time.sleep(spec.rollout_delay_s)
+        gen = self._dec.generate(
+            self._params, rep, self._cfg, t, max_len=s + t,
+            temperature=spec.temperature, eos_id=spec.eos_id, rng=rng)
+        seq = jnp.concatenate([rep, gen], axis=1)
+        lp_full, _ = self._lp_fn(self._params, seq)
+        # Fixed-length prompts: completion token j sits at sequence
+        # position s+j, scored by log-prob grid entry s+j-1.
+        behavior_lp = jax.lax.stop_gradient(lp_full[:, s - 1:s - 1 + t])
+        gen_np = np.asarray(jax.device_get(gen))
+        rewards = np.asarray(
+            [self._reward_fn(prompt, gen_np[i]) for i in range(g)],
+            np.float32)
+        return {'completions': gen_np.astype(np.int32),
+                'rewards': rewards,
+                'behavior_lp': np.asarray(jax.device_get(behavior_lp),
+                                          np.float32)}
+
+    # ------------------------------------------------------- main loop
+
+    def _request(self, obj: Dict[str, Any],
+                 arrays: Optional[framed.Arrays] = None
+                 ) -> Dict[str, Any]:
+        reply, _ = self._main_conn.request(obj, arrays=arrays,
+                                           timeout=self._rpc_timeout)
+        return reply
+
+    def run(self) -> None:
+        """Lease → generate → submit until stopped. Every failure mode
+        is contained: transient RPC errors back off and retry, resync
+        re-registers, a failed generation releases its lease."""
+        boff = backoff_lib.Backoff(base=0.2, cap=5.0, seed=self._seed)
+        while not self._stop.is_set():
+            try:
+                if not self._ensure_model() or \
+                        not self._ensure_snapshot():
+                    if self._stop.wait(0.2):
+                        return
+                    continue
+                reply = self._request(
+                    {'op': 'lease', 'worker_id': self.worker_id,
+                     'max_n': self._leases_per_round,
+                     'spec_fp': self._spec.fingerprint()})
+                if reply.get('resync'):
+                    self._register(self._main_conn,
+                                   deadline_s=self._register_timeout)
+                    continue
+                version = int(reply.get('snapshot_version', -1))
+                with self._lock:
+                    if version > self._latest_version:
+                        self._latest_version = version
+                leases = list(reply.get('leases') or [])
+                if not leases:
+                    # Backpressure (learner behind) or a drained job:
+                    # idle briefly, stay registered.
+                    if self._stop.wait(0.2):
+                        return
+                    continue
+                for lease_id in leases:
+                    if self._stop.is_set():
+                        return
+                    self._serve_lease(int(lease_id))
+                boff.reset()
+            except (framed.ProtocolError, framed.RemoteError,
+                    OSError, TimeoutError) as e:
+                logger.warning(f'rollout worker {self.worker_id} '
+                               f'lease round failed: {e}')
+                boff.sleep()
+
+    def _serve_lease(self, lease_id: int) -> None:
+        t0 = time.perf_counter()
+        try:
+            traj = self._generate(lease_id)
+        except Exception as e:  # noqa: BLE001 — containment, see below
+            # ANY generation/reward failure — injected fault, device
+            # error, a user reward_fn raising on one completion —
+            # hands the lease back NOW so a healthy worker picks it
+            # up, and the worker lives on to serve the next lease.
+            # One bad completion must cost one re-lease, never a
+            # fleet member (the reaper's lease timeout would contain
+            # a crash too, but slower and with a dead worker).
+            logger.warning(f'rollout worker {self.worker_id} failed '
+                           f'lease {lease_id}: {e!r}; releasing')
+            try:
+                self._request({'op': 'release',
+                               'worker_id': self.worker_id,
+                               'lease_id': lease_id})
+            except (framed.ProtocolError, framed.RemoteError,
+                    OSError):
+                pass   # reaper's lease timeout is the backstop
+            return
+        telemetry.GENERATE_SECONDS.observe(time.perf_counter() - t0)
+        submit = {'op': 'submit', 'worker_id': self.worker_id,
+                  'lease_id': lease_id,
+                  'snapshot_version': self._held_version,
+                  'spec_fp': self._spec.fingerprint()}
+        for attempt in (0, 1):
+            try:
+                self._request(submit, arrays=traj)
+                return
+            except framed.RemoteError as e:
+                # The dispatcher ANSWERED — it decided the lease's
+                # fate (refusal or duplicate); retrying or releasing
+                # would fight its decision.
+                logger.warning(f'rollout worker {self.worker_id} '
+                               f'submit of lease {lease_id} refused: '
+                               f'{e}')
+                return
+            except (framed.ProtocolError, OSError,
+                    TimeoutError) as e:
+                # Transient wire failure: one reconnect-retry (the
+                # trajectory in hand is real work), then hand the
+                # lease back rather than stranding it LEASED until
+                # the lease timeout.
+                logger.warning(f'rollout worker {self.worker_id} '
+                               f'submit of lease {lease_id} failed '
+                               f'(attempt {attempt + 1}): {e}')
+                if attempt == 0:
+                    time.sleep(0.2)
+        try:
+            self._request({'op': 'release',
+                           'worker_id': self.worker_id,
+                           'lease_id': lease_id})
+        except (framed.ProtocolError, framed.RemoteError, OSError,
+                TimeoutError):
+            pass   # reaper's lease timeout is the backstop

@@ -16,9 +16,19 @@ determinism never reintroduces the herd.
 """
 from __future__ import annotations
 
+import hashlib
 import random
 import time
 from typing import Optional
+
+
+def stable_seed(text: str) -> int:
+    """Deterministic int seed from an id string — the per-caller seed
+    every worker-style loop derives its Backoff from. ``hash(str)``
+    is salted per process (PYTHONHASHSEED), which would break the
+    seeded-Backoff contract of bit-reproducible retry timelines."""
+    return int.from_bytes(
+        hashlib.sha256(text.encode('utf-8')).digest()[:4], 'big')
 
 
 class Backoff:

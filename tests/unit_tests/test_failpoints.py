@@ -207,6 +207,11 @@ class TestDiscovery:
         # mid-handoff failure arcs through these.
         assert {'handoff.send', 'handoff.recv',
                 'prefill.flush'} <= names
+        # The harvested-RL plane sites (train/rollout):
+        # tests/chaos/test_rollout_churn.py drives worker-kill
+        # containment; tests/unit_tests/test_rollout.py the rest.
+        assert {'rollout.lease', 'rollout.generate', 'rollout.publish',
+                'rollout.snapshot_fetch'} <= names
         # Naming contract holds for every discovered site.
         for name in names:
             assert failpoints.NAME_RE.match(name), name

@@ -40,6 +40,51 @@ class TestMath:
         np.testing.assert_array_equal(
             np.asarray(grpo.completion_mask(comp, eos_id=None)), 1.0)
 
+    def test_group_advantages_mean_invariance_property(self):
+        """Property (50 seeded trials): adding a constant to every
+        reward in a group leaves its advantages unchanged (the group
+        IS the baseline), and a zero-variance group yields exactly
+        zero advantage (the std floor, never NaN) regardless of the
+        constant's magnitude."""
+        rng = np.random.default_rng(0)
+        for _ in range(50):
+            g = int(rng.integers(2, 9))
+            b = int(rng.integers(1, 4))
+            rewards = rng.normal(size=(b * g,)).astype(np.float32)
+            shift = np.repeat(rng.normal(size=(b,)) * 100.0,
+                              g).astype(np.float32)
+            base = np.asarray(grpo.group_advantages(
+                jnp.asarray(rewards), g))
+            shifted = np.asarray(grpo.group_advantages(
+                jnp.asarray(rewards + shift), g))
+            np.testing.assert_allclose(shifted, base, atol=1e-3)
+            # Zero-variance group: advantage is BOUNDED near zero —
+            # exactly zero for exactly-representable means, and at
+            # most (fp32 mean-rounding ulp / adv_eps floor) for large
+            # constants; the floor is what keeps it from blowing up
+            # to huge values or NaN.
+            flat = np.asarray(grpo.group_advantages(
+                jnp.asarray(shift), g))          # constant per group
+            assert np.all(np.isfinite(flat))
+            assert np.max(np.abs(flat)) < 0.5, flat
+
+    def test_completion_mask_eos_at_position_zero(self):
+        """EOS as the FIRST completion token: only that token carries
+        loss (the mask includes the first EOS, nothing after)."""
+        comp = jnp.asarray([[9, 3, 4, 5],
+                            [3, 9, 9, 9]])
+        mask = np.asarray(grpo.completion_mask(comp, eos_id=9))
+        np.testing.assert_array_equal(mask, [[1, 0, 0, 0],
+                                             [1, 1, 0, 0]])
+
+    def test_completion_mask_no_eos_keeps_everything(self):
+        comp = jnp.asarray([[1, 2, 3, 4]])
+        np.testing.assert_array_equal(
+            np.asarray(grpo.completion_mask(comp, eos_id=9)), 1.0)
+        # Degenerate width-0 completions survive too.
+        empty = jnp.zeros((2, 0), jnp.int32)
+        assert grpo.completion_mask(empty, eos_id=9).shape == (2, 0)
+
     def test_token_logprobs_normalized(self):
         cfg = models_lib.get_config('llama-debug')
         from skypilot_tpu.models import llama
@@ -56,6 +101,63 @@ class TestMath:
         assert float(probs.sum()) == pytest.approx(1.0, rel=1e-5)
         assert float(lp[0, 3]) == pytest.approx(
             float(jnp.log(probs[seq[0, 4]])), rel=1e-4)
+
+
+class TestDeterminism:
+
+    def test_seeded_rollout_update_sequence_is_bit_deterministic(self):
+        """The seeded determinism pin the harvested-RL replay contract
+        rests on (mesh-free, runs on every jax this repo supports):
+        the full learner data path — seeded generate → rewards →
+        group advantages → clipped update — executed twice from the
+        same seeds produces BIT-identical loss/ratio sequences."""
+        from skypilot_tpu.models import decode as decode_lib
+        from skypilot_tpu.models import llama
+        import functools
+        cfg = models_lib.get_config('llama-debug')
+        g, s, t = 4, 8, 6
+        gcfg = grpo.GRPOConfig(group_size=g, max_new_tokens=t)
+        tx = train_lib.default_optimizer(learning_rate=1e-3,
+                                         warmup_steps=1,
+                                         total_steps=10)
+        init = jax.jit(lambda r: llama.init_params(r, cfg))
+        opt_init = jax.jit(tx.init)
+        update = grpo.make_grpo_update(cfg, None, tx, gcfg, llama)
+        lp_fn = jax.jit(functools.partial(grpo.token_logprobs,
+                                          cfg=cfg, mod=llama))
+
+        def run_sequence():
+            params = init(jax.random.PRNGKey(0))
+            state = train_lib.TrainState(
+                step=jnp.zeros((), jnp.int32), params=params,
+                opt_state=opt_init(params))
+            out = []
+            for i in range(3):
+                prompts = jax.random.randint(
+                    jax.random.PRNGKey(100 + i), (2, s), 0,
+                    cfg.vocab_size, dtype=jnp.int32)
+                rep = jnp.repeat(prompts, g, axis=0)
+                gen = decode_lib.generate(
+                    state.params, rep, cfg, t, max_len=s + t,
+                    temperature=1.0, rng=jax.random.PRNGKey(i))
+                seq = jnp.concatenate([rep, gen], axis=1)
+                lp_full, _ = lp_fn(state.params, seq)
+                behavior_lp = jax.lax.stop_gradient(
+                    lp_full[:, s - 1:s - 1 + t])
+                rewards = (gen == 42).astype(jnp.float32).mean(1)
+                adv = grpo.group_advantages(rewards, g)
+                mask = grpo.completion_mask(gen, None)
+                comp_idx = jnp.broadcast_to(
+                    jnp.arange(t, dtype=jnp.int32) + s - 1,
+                    (2 * g, t))
+                state, m = update(state, seq, comp_idx, behavior_lp,
+                                  adv, mask)
+                out.append((float(m['loss']), float(m['mean_ratio']),
+                            float(m['grad_norm'])))
+            return out
+
+        first = run_sequence()
+        assert run_sequence() == first   # BIT-equal, not allclose
 
 
 class TestLearning:

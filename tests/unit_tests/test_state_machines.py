@@ -37,10 +37,19 @@ def state_dirs(tmp_path, monkeypatch):
 
 class TestTransitionTableRoundTrip:
 
+    def _rollout_enums():
+        from skypilot_tpu.train.rollout.dispatcher import (
+            RolloutLeaseStatus, RolloutWorkerStatus)
+        return [(RolloutWorkerStatus,
+                 state_machines.ROLLOUT_WORKER_TRANSITIONS),
+                (RolloutLeaseStatus,
+                 state_machines.ROLLOUT_LEASE_TRANSITIONS)]
+
     @pytest.mark.parametrize('enum_cls,table', [
         (ManagedJobStatus, state_machines.JOB_TRANSITIONS),
         (ServiceStatus, state_machines.SERVICE_TRANSITIONS),
         (ReplicaStatus, state_machines.REPLICA_TRANSITIONS),
+        *_rollout_enums(),
     ])
     def test_every_member_covered_and_every_target_real(self, enum_cls,
                                                         table):
@@ -82,6 +91,21 @@ class TestTransitionTableRoundTrip:
             assert 'DRAINING' not in table[name], name
         assert table['DRAINING'] == {'FAILED', 'PREEMPTED',
                                      'SHUTTING_DOWN'}
+
+    def test_rollout_lease_done_is_terminal(self):
+        """The prompt-lease machine (docs/STATE_MACHINES.md): DONE is
+        terminal (first completed trajectory wins — a duplicate
+        at-least-once execution can never overwrite it), and the
+        reassignment edge LEASED -> PENDING exists in BOTH directions
+        of the lease/re-lease cycle."""
+        table = state_machines.ROLLOUT_LEASE_TRANSITIONS
+        assert table['DONE'] == set()
+        assert 'PENDING' in table['LEASED']     # reassignment
+        assert 'LEASED' in table['PENDING']     # re-lease
+        # At-least-once: the ORIGINAL owner of a reassigned-but-not-
+        # yet-re-leased lease may still finish first.
+        assert 'DONE' in table['PENDING']
+        assert 'DONE' in table['LEASED']
 
     def test_self_loops_always_legal(self):
         assert state_machines.can_transition(
