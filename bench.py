@@ -386,6 +386,11 @@ def run_decode_bench():
         # hides; see docs/ENGINE.md).
         'dispatch_gap_ms_per_tok_p50': round(med(disp_ms_tok), 4),
         'host_sync_ms_per_tok_p50': round(med(sync_ms_tok), 4),
+        # Engine attention backend this artifact's trajectory pairs
+        # with (SKYTPU_ENGINE_ATTN; the decode metric itself drives
+        # decode.generate's contiguous cache — serve_mixed carries the
+        # fused-vs-gather A/B).
+        'attn_backend': os.environ.get('SKYTPU_ENGINE_ATTN', 'fused'),
         'device': device.device_kind,
     }), flush=True)
 
@@ -496,7 +501,15 @@ def run_serve_mixed_bench():
     reports per-class TTFT p50/p95 plus the engine's own
     skytpu_engine_admission_wait_seconds histogram, so the queueing win
     is measured pre/post on one artifact. `value` is the short-class
-    TTFT p95 speedup of paged over the baseline."""
+    TTFT p95 speedup of paged over the baseline.
+
+    Attention-backend A/B rides the same artifact: the paged load also
+    runs under SKYTPU_ENGINE_ATTN=gather (yesterday's gather_view →
+    contiguous math → scatter programs) next to the fused in-place
+    default, with each mode's engine-reported TPOT and the
+    shape-derived skytpu_engine_cache_bytes_* counters scraped into
+    per-mode cache_bytes_per_token — the ~2/k traversal reduction,
+    checked in as a number (docs/ENGINE.md)."""
     import asyncio
     import math
     import socket
@@ -519,12 +532,13 @@ def run_serve_mixed_bench():
                                '256' if on_tpu else '64'))
     max_len = _next_pow2(long_len) + new_tokens + 2 * chunk
 
-    def run_mode(paged: bool):
+    def run_mode(paged: bool, attn: str = 'fused'):
         with socket.socket() as s:
             s.bind(('127.0.0.1', 0))
             port = s.getsockname()[1]
         env = dict(os.environ)
         env['SKYTPU_ENGINE_PAGED'] = '1' if paged else '0'
+        env['SKYTPU_ENGINE_ATTN'] = attn
         env['SKYTPU_ENGINE_PREFILL_CHUNK'] = str(chunk)
         cmd = [sys.executable, '-m', 'skypilot_tpu.serve.engine',
                '--model', model, '--max-len', str(max_len),
@@ -547,7 +561,7 @@ def run_serve_mixed_bench():
                 server.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 server.kill()
-        out = {}
+        out = {'attn_backend': attn if paged else 'contiguous'}
         for cls, xs in (('short', short_ttft), ('long', long_ttft)):
             if not xs:
                 continue
@@ -562,37 +576,82 @@ def run_serve_mixed_bench():
                 if not math.isnan(v):
                     out[f'admission_wait_ms_{suffix}'] = round(v * 1e3,
                                                                2)
+            v = _histogram_quantile(text,
+                                    'skytpu_engine_tpot_seconds', 0.5)
+            if not math.isnan(v):
+                out['engine_tpot_ms_p50'] = round(v * 1e3, 3)
+            counters = {}
             for line in text.splitlines():
                 if line.startswith('skytpu_engine_kv_page_alloc_total'
                                    '{outcome="wait"}'):
                     out['page_alloc_waits'] = float(
                         line.rsplit(' ', 1)[1])
+                for name in ('skytpu_engine_cache_bytes_read_total',
+                             'skytpu_engine_cache_bytes_written_total',
+                             'skytpu_engine_tokens_total'):
+                    if line.startswith(name + ' '):
+                        counters[name] = float(line.rsplit(' ', 1)[1])
+            toks = counters.get('skytpu_engine_tokens_total', 0)
+            if toks:
+                # Shape-derived step/verify cache traffic per generated
+                # token — the gather-vs-fused traversal delta made a
+                # checked-in number.
+                out['cache_bytes_per_token'] = round(
+                    (counters.get(
+                        'skytpu_engine_cache_bytes_read_total', 0) +
+                     counters.get(
+                         'skytpu_engine_cache_bytes_written_total', 0))
+                    / toks, 1)
         return out
 
-    paged_stats = run_mode(True)
+    paged_stats = run_mode(True, 'fused')
+    gather_stats = run_mode(True, 'gather')
     base_stats = run_mode(False)
-    speedup = None
-    if paged_stats.get('short_ttft_ms_p95') and \
-            base_stats.get('short_ttft_ms_p95'):
-        speedup = round(base_stats['short_ttft_ms_p95'] /
-                        paged_stats['short_ttft_ms_p95'], 2)
+
+    def ratio(num, den, digits=2):
+        return round(num / den, digits) if num and den else None
+
+    speedup = ratio(base_stats.get('short_ttft_ms_p95'),
+                    paged_stats.get('short_ttft_ms_p95'))
+    fused_vs_gather = ratio(gather_stats.get('short_ttft_ms_p95'),
+                            paged_stats.get('short_ttft_ms_p95'))
+    traversal_cut = ratio(gather_stats.get('cache_bytes_per_token'),
+                          paged_stats.get('cache_bytes_per_token'))
     print(f'serve_mixed: device={device.device_kind} model={model} '
           f'short={short_len} long={long_len} every={long_every} '
-          f'paged={paged_stats} baseline={base_stats} '
-          f'short_p95_speedup={speedup}', file=sys.stderr)
-    print(json.dumps({
+          f'paged={paged_stats} paged_gather={gather_stats} '
+          f'baseline={base_stats} short_p95_speedup={speedup} '
+          f'fused_vs_gather={fused_vs_gather} '
+          f'cache_traversal_cut={traversal_cut}x', file=sys.stderr)
+    artifact = {
         'metric': 'serve_mixed_short_ttft_p95_speedup',
         'value': speedup,
         'unit': 'x (bucket-admission baseline / paged)',
+        'attn_backend': paged_stats.get('attn_backend'),
         'paged': paged_stats,
+        'paged_gather': gather_stats,
         'baseline': base_stats,
+        # Fused in-place attention vs the gather/scatter baseline on
+        # the SAME paged load: short-TTFT ratio (>= 1.0 expected — the
+        # fused path must never regress) and the cache-bytes-per-token
+        # ratio (the ~2/k traversal reduction, from the shape-derived
+        # counters).
+        'fused_vs_gather_short_ttft_p95_speedup': fused_vs_gather,
+        'fused_vs_gather_cache_bytes_ratio': traversal_cut,
         'workload': {'short_len': short_len, 'long_len': long_len,
                      'long_every': long_every, 'requests': n_requests,
                      'concurrency': concurrency,
                      'new_tokens': new_tokens,
                      'prefill_chunk': chunk},
         'device': device.device_kind,
-    }), flush=True)
+    }
+    if not on_tpu:
+        # BENCH_LAST_GOOD trajectory convention: CPU-proxy numbers are
+        # admissible evidence, but the TPU trajectory point is pending
+        # until a chip-holding run lands.
+        artifact['tpu_note'] = ('CPU proxy; TPU trajectory point '
+                                'pending (BENCH_LAST_GOOD convention)')
+    print(json.dumps(artifact), flush=True)
 
 
 def _scrape_metrics_text(port: int) -> str:

@@ -17,6 +17,7 @@ from skypilot_tpu.analysis import lazy_imports
 from skypilot_tpu.analysis import layers
 from skypilot_tpu.analysis import metric_discipline
 from skypilot_tpu.analysis import page_table_shape
+from skypilot_tpu.analysis import paged_view_materialization
 from skypilot_tpu.analysis import silent_except
 from skypilot_tpu.analysis import span_discipline
 from skypilot_tpu.analysis import sqlite_discipline
@@ -33,6 +34,7 @@ ALL: List[Tuple[str, CheckerFn]] = [
     (jit_hazards.NAME, jit_hazards.run),
     (host_sync_loops.NAME, host_sync_loops.run),
     (page_table_shape.NAME, page_table_shape.run),
+    (paged_view_materialization.NAME, paged_view_materialization.run),
     (sqlite_discipline.NAME, sqlite_discipline.run),
     (state_integrity.NAME, state_integrity.run),
     (thread_discipline.NAME, thread_discipline.run),

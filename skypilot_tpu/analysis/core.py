@@ -41,8 +41,13 @@ PACKAGE = 'skypilot_tpu'
 # bridge to the disagg orchestration layer lazily); v13: the
 # spot-harvesting RL plane ('train/rollout' ranked 13 above train,
 # its dispatcher joins the sqlite state-DB set, and the rollout
-# worker/lease machines join the enum-coverage rule).
-REPORT_VERSION = 13
+# worker/lease machines join the enum-coverage rule); v14:
+# paged-view-materialization — serve-plane jits must not materialize
+# the contiguous paged-cache view (gather_view): the hot
+# step/verify/chunk programs index pages in place
+# (ops/paged_attention.py), and only *_gather-named baseline programs
+# may still gather.
+REPORT_VERSION = 14
 
 
 @dataclasses.dataclass

@@ -1,0 +1,83 @@
+"""paged-view-materialization lint: the engine's hot-path jits must
+not materialize the contiguous paged-cache view.
+
+The in-place paged attention work (ops/paged_attention.py,
+docs/ENGINE.md) removed ``paging.gather_view`` — the full
+``[L, B, max_len, ...]`` view materialization — from the
+step/verify/chunked-prefill device programs: those programs now index
+pages inside the attention computation, and the gather/scatter round
+trip (~2/k extra full-cache traversals per decoded token) exists only
+in the ``SKYTPU_ENGINE_ATTN=gather`` regression baseline. This checker
+pins that state: a ``gather_view`` call inside a JIT-COMPILED function
+in the serve plane is the hot-path anti-pattern reintroduced, and is
+flagged.
+
+Sanctioned sites, by NAME: a jit whose function name ends with
+``_gather`` is the explicitly-labeled baseline program (the engine's
+``run_gather`` / ``spec_verify_gather`` bodies) — cold by contract
+(only selected when the operator asks for the baseline), and the
+suffix makes the exemption self-documenting at the call site. Host-
+side (non-jit) uses — admission bookkeeping, snapshot/export paths,
+tests — are out of scope: they run per request, not per token, and
+the cold paths deliberately keep their gather/scatter ops
+(``gather_prefix``/``scatter_prefill``/``adopt_rows`` are not view
+materializations and are never flagged).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis import jit_hazards
+from skypilot_tpu.analysis import page_table_shape
+
+NAME = 'paged-view-materialization'
+
+_UNITS = frozenset({'serve'})
+# The explicitly-labeled baseline suffix: a jit named *_gather IS the
+# regression baseline program and may materialize the view.
+_BASELINE_SUFFIX = '_gather'
+
+
+def _is_jit_decorated(node: ast.FunctionDef) -> bool:
+    for dec in node.decorator_list:
+        if jit_hazards._is_jit_expr(dec):
+            return True
+        if page_table_shape._jit_call_of(dec) is not None:
+            return True
+    return False
+
+
+def run(mod: core.ModuleInfo) -> List[core.Violation]:
+    if mod.unit not in _UNITS:
+        return []
+    out: List[core.Violation] = []
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+            continue
+        if not _is_jit_decorated(node):
+            continue
+        if node.name.endswith(_BASELINE_SUFFIX):
+            continue
+        # The whole jit body, nested scan/helper defs included — a
+        # gather_view buried in a lax.scan body function is still
+        # traced into this program.
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            dotted = core.dotted_name(call.func) or ''
+            if dotted.split('.')[-1] != 'gather_view':
+                continue
+            out.append(core.Violation(
+                check=NAME, path=mod.path, line=call.lineno,
+                col=call.col_offset, key=f'jit:{node.name}',
+                message=(
+                    f'jitted function {node.name!r} materializes the '
+                    f'contiguous paged-cache view (gather_view) — the '
+                    f'hot step/verify/chunk programs index pages in '
+                    f'place (ops/paged_attention.py); if this program '
+                    f'is the sanctioned regression baseline, name it '
+                    f'*{_BASELINE_SUFFIX}')))
+    return out

@@ -474,6 +474,156 @@ def verify_step(params, tokens: jnp.ndarray, cache: KVCache,
     return logits, KVCache(k=ks, v=vs, length=length)
 
 
+def paged_verify_step(params, tokens: jnp.ndarray, pcache,
+                      cfg: llama.LlamaConfig, *, max_len: int,
+                      active: Optional[jnp.ndarray] = None,
+                      attn: str = 'fused'):
+    """`verify_step` over the block-paged pool, IN PLACE: K/V for the
+    K fed positions are written straight into each row's pages
+    (inactive rows route to the trash page) and attention indexes the
+    pages per layer inside the scan body (ops/paged_attention.py) — no
+    contiguous [L, B, max_len, ...] view is materialized and nothing
+    scatters back afterwards. Bit-identical to
+    gather_view → verify_step → scatter_steps by construction: the
+    per-layer page gather reads the same values the materialized view
+    held, the new K/V overlay lands at the same positions, and the
+    attention reduction is the unchanged XLA path (property-tested in
+    tests/unit_tests/test_paging.py). `length` does NOT advance — the
+    same commit contract as verify_step."""
+    from skypilot_tpu.models import paging
+    from skypilot_tpu.ops import paged_attention as pa
+    b, kk = tokens.shape
+    length = pcache.length
+    positions = length[:, None] + jnp.arange(kk)          # [B, K]
+    pid, off = paging._write_indices(pcache, positions, active)
+    table = pcache.table
+    x = jnp.take(params['embed'], tokens, axis=0).astype(cfg.dtype)
+    if cfg.embed_scale:
+        x = x * jnp.asarray(cfg.dim ** 0.5, cfg.dtype)
+    sin, cos = llama.rope_tables(cfg, positions)
+
+    def body(carry, xs):
+        x_c, kp_all, vp_all = carry
+        lp, layer_idx = xs
+        sin_l, cos_l = llama.select_rope(sin, cos, layer_idx, cfg)
+        q, k_new, v_new = _qkv(x_c, lp, cfg, sin_l, cos_l)
+        kp = jax.lax.dynamic_index_in_dim(kp_all, layer_idx, axis=0,
+                                          keepdims=False)
+        vp = jax.lax.dynamic_index_in_dim(vp_all, layer_idx, axis=0,
+                                          keepdims=False)
+        w_active = (llama.window_active(layer_idx, cfg)
+                    if cfg.sliding_window else None)
+        out, kp, vp = pa.paged_attention_step(
+            q, kp, vp, table, length, k_new, v_new, pid, off,
+            max_len=max_len, impl=attn,
+            logit_softcap=cfg.attn_logit_softcap,
+            window=cfg.sliding_window, window_active=w_active,
+            sinks=(lp['sink'].astype(jnp.float32)
+                   if cfg.attn_sinks else None))
+        kp_all = jax.lax.dynamic_update_index_in_dim(kp_all, kp,
+                                                     layer_idx, axis=0)
+        vp_all = jax.lax.dynamic_update_index_in_dim(vp_all, vp,
+                                                     layer_idx, axis=0)
+        out = out.reshape(b, kk, cfg.n_heads * cfg.hd)
+        x_c = x_c + _wo_project(out, lp, cfg)
+        x_c = x_c + _ffn(x_c, lp, cfg)
+        return (x_c, kp_all, vp_all), None
+
+    layer_ids = jnp.arange(cfg.n_layers, dtype=jnp.int32)
+    (x, kps, vps), _ = jax.lax.scan(
+        body, (x, pcache.k, pcache.v), (params['layers'], layer_ids))
+    logits = _unembed(x, params, cfg)
+    return logits, dataclasses.replace(pcache, k=kps, v=vps)
+
+
+def paged_decode_step(params, token: jnp.ndarray, pcache,
+                      cfg: llama.LlamaConfig, *, max_len: int,
+                      active: Optional[jnp.ndarray] = None,
+                      attn: str = 'fused'):
+    """One in-place paged decode step — the K=1 case of
+    :func:`paged_verify_step` plus the per-row length advance (the
+    same relationship decode_step has to verify_step)."""
+    logits, pcache = paged_verify_step(params, token[:, None], pcache,
+                                       cfg, max_len=max_len,
+                                       active=active, attn=attn)
+    advance = 1 if active is None else active.astype(jnp.int32)
+    return logits[:, 0], dataclasses.replace(
+        pcache, length=pcache.length + advance)
+
+
+def paged_prefill_extend(params, tokens: jnp.ndarray, pcache,
+                         cfg: llama.LlamaConfig, *, slot, p: int,
+                         lengths, attn: str = 'fused'):
+    """`prefill_extend` for ONE paged row, in place: the [1, S2] suffix
+    attends [prefix ++ suffix] with the prefix gathered per layer from
+    the (possibly shared) pages row ``slot``'s table covers, and the
+    suffix K/V writes land straight in the row's own pages — the
+    chunked-prefill / prefix-hit program with no gather_prefix
+    materialization across layers and no scatter_suffix afterwards.
+    Bit-identical to the gather formulation for the same reason
+    paged_verify_step is. length[slot] = p + lengths."""
+    del attn  # extend has no pallas kernel yet; the fused path serves.
+    from skypilot_tpu.models import paging
+    b, s2 = tokens.shape
+    psz = paging.page_size_of(pcache)
+    pre_pos = jnp.arange(p)
+    pre_pid = pcache.table[slot, pre_pos // psz]           # [p]
+    pre_off = pre_pos % psz
+    suf_pos = p + jnp.arange(s2)
+    suf_pid = pcache.table[slot, suf_pos // psz]           # [s2]
+    suf_off = suf_pos % psz
+    lengths = jnp.asarray(lengths, jnp.int32).reshape((b,))
+    x = jnp.take(params['embed'], tokens, axis=0).astype(cfg.dtype)
+    if cfg.embed_scale:
+        x = x * jnp.asarray(cfg.dim ** 0.5, cfg.dtype)
+    positions = jnp.arange(s2) + p
+    sin, cos = llama.rope_tables(cfg, positions)
+    impl = 'auto' if cfg.attention_impl == 'ring' else cfg.attention_impl
+
+    def body(carry, xs):
+        x_c, kp_all, vp_all = carry
+        lp, layer_idx = xs
+        sin_l, cos_l = llama.select_rope(sin, cos, layer_idx, cfg)
+        q, k, v = _qkv(x_c, lp, cfg, sin_l, cos_l)
+        kp = jax.lax.dynamic_index_in_dim(kp_all, layer_idx, axis=0,
+                                          keepdims=False)
+        vp = jax.lax.dynamic_index_in_dim(vp_all, layer_idx, axis=0,
+                                          keepdims=False)
+        pk = kp[pre_pid, pre_off][None]                    # [1, p, ...]
+        pv = vp[pre_pid, pre_off][None]
+        k_all = jnp.concatenate([pk.astype(k.dtype), k], axis=1)
+        v_all = jnp.concatenate([pv.astype(v.dtype), v], axis=1)
+        w_active = (llama.window_active(layer_idx, cfg)
+                    if cfg.sliding_window else None)
+        out = _attention(q, k_all, v_all, impl=impl, causal=True,
+                         q_offset=p, kv_offset=0,
+                         logit_softcap=cfg.attn_logit_softcap,
+                         window=cfg.sliding_window,
+                         window_active=w_active,
+                         sinks=(lp['sink'].astype(jnp.float32)
+                                if cfg.attn_sinks else None))
+        kp_all = jax.lax.dynamic_update_index_in_dim(
+            kp_all, kp.at[suf_pid, suf_off].set(k[0]), layer_idx,
+            axis=0)
+        vp_all = jax.lax.dynamic_update_index_in_dim(
+            vp_all, vp.at[suf_pid, suf_off].set(v[0]), layer_idx,
+            axis=0)
+        out = out.reshape(b, s2, cfg.n_heads * cfg.hd)
+        x_c = x_c + _wo_project(out, lp, cfg)
+        x_c = x_c + _ffn(x_c, lp, cfg)
+        return (x_c, kp_all, vp_all), None
+
+    layer_ids = jnp.arange(cfg.n_layers, dtype=jnp.int32)
+    (x, kps, vps), _ = jax.lax.scan(
+        body, (x, pcache.k, pcache.v), (params['layers'], layer_ids))
+    x_last = jnp.take_along_axis(
+        x, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1)
+    logits = _unembed(x_last, params, cfg)
+    length = pcache.length.at[slot].set(p + lengths[0])
+    return logits[:, 0], dataclasses.replace(pcache, k=kps, v=vps,
+                                             length=length)
+
+
 # Persistent compile caches for the speculative loop (cfg static:
 # model configs are frozen/hashable dataclasses).
 _verify_step_jit = jax.jit(verify_step, static_argnames=('cfg',))

@@ -592,6 +592,141 @@ def verify_step(params, tokens: jnp.ndarray, cache: LatentCache,
     return logits, LatentCache(c_kv=cs, k_rope=krs, length=length)
 
 
+def paged_verify_step(params, tokens: jnp.ndarray, pcache,
+                      cfg: MLAConfig, *, max_len: int,
+                      active: Optional[jnp.ndarray] = None,
+                      attn: str = 'fused'):
+    """`verify_step` over the block-paged LATENT pool, in place: the K
+    fed positions' (c_kv, k_rope) write straight into each row's pages
+    (inactive rows to the trash page) and the absorbed-matmul
+    attention indexes pages per layer inside the scan — no contiguous
+    latent view, no scatter-back. Bit-identical to
+    gather_view → verify_step → scatter_steps for the same reason the
+    dense path is (decode.paged_verify_step); the attention itself is
+    the unchanged `_attend_latent` reduction. `attn='pallas'` routes
+    here too: the Pallas kernel covers the dense K/V family only, and
+    the latent family's absorbed attention serves through this fused
+    lax formulation (documented in docs/ENGINE.md)."""
+    del attn
+    from skypilot_tpu.models import paging
+    from skypilot_tpu.ops import paged_attention as pa
+    b, kk = tokens.shape
+    length = pcache.length
+    rows = jnp.arange(b)
+    positions = length[:, None] + jnp.arange(kk)          # [B, K]
+    pid, off = paging._write_indices(pcache, positions, active)
+    table = pcache.table
+    x = jnp.take(params['embed'], tokens, axis=0).astype(cfg.dtype)
+    sin, cos = rotary.rope_frequencies(cfg.qk_rope_head_dim, positions,
+                                       cfg.rope_theta, cfg.rope_scaling)
+
+    def body(carry, xs):
+        x_c, cp_all, krp_all = carry
+        lp, layer_idx = xs
+        q_nope, q_rope, c_new, kr_new = _latents(x_c, lp, cfg, sin, cos)
+        cp = jax.lax.dynamic_index_in_dim(cp_all, layer_idx, 0, False)
+        krp = jax.lax.dynamic_index_in_dim(krp_all, layer_idx, 0, False)
+        c_l = pa.gather_pages(cp, table, max_len)
+        kr_l = pa.gather_pages(krp, table, max_len)
+        c_l = c_l.at[rows[:, None], positions].set(c_new)
+        kr_l = kr_l.at[rows[:, None], positions].set(kr_new)
+        out = _attend_latent(q_nope, q_rope, c_l, kr_l, lp, cfg,
+                             q_offset=length)
+        cp_all = jax.lax.dynamic_update_index_in_dim(
+            cp_all, pa.write_pages(cp, c_new, pid, off), layer_idx, 0)
+        krp_all = jax.lax.dynamic_update_index_in_dim(
+            krp_all, pa.write_pages(krp, kr_new, pid, off), layer_idx,
+            0)
+        x_c = x_c + jnp.einsum('bsh,hd->bsd', out,
+                               _d(lp['wo'], cfg.dtype))
+        x_c = x_c + _ffn(x_c, lp, cfg)[0]
+        return (x_c, cp_all, krp_all), None
+
+    layer_ids = jnp.arange(cfg.n_layers, dtype=jnp.int32)
+    (x, cps, krps), _ = jax.lax.scan(
+        body, (x, pcache.c_kv, pcache.k_rope),
+        (params['layers'], layer_ids))
+    x = norms.rms_norm(x, params['final_norm'], cfg.rms_eps)
+    head = (params['embed'].T if cfg.tie_embeddings else params['lm_head'])
+    logits = jnp.einsum('bsd,dv->bsv', x, head.astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
+    return logits, dataclasses.replace(pcache, c_kv=cps, k_rope=krps)
+
+
+def paged_decode_step(params, token: jnp.ndarray, pcache,
+                      cfg: MLAConfig, *, max_len: int,
+                      active: Optional[jnp.ndarray] = None,
+                      attn: str = 'fused'):
+    """K=1 case of :func:`paged_verify_step` + the length advance."""
+    logits, pcache = paged_verify_step(params, token[:, None], pcache,
+                                       cfg, max_len=max_len,
+                                       active=active, attn=attn)
+    advance = 1 if active is None else active.astype(jnp.int32)
+    return logits[:, 0], dataclasses.replace(
+        pcache, length=pcache.length + advance)
+
+
+def paged_prefill_extend(params, tokens: jnp.ndarray, pcache,
+                         cfg: MLAConfig, *, slot, p: int, lengths,
+                         attn: str = 'fused'):
+    """`prefill_extend` for one paged latent row, in place — the MLA
+    half of decode.paged_prefill_extend: the suffix attends
+    [prefix ++ suffix] latents with the prefix gathered per layer from
+    the row's (possibly shared) pages, and the suffix latents land
+    straight in the row's own pages. length[slot] = p + lengths."""
+    del attn
+    from skypilot_tpu.models import paging
+    b, s2 = tokens.shape
+    psz = paging.page_size_of(pcache)
+    pre_pos = jnp.arange(p)
+    pre_pid = pcache.table[slot, pre_pos // psz]           # [p]
+    pre_off = pre_pos % psz
+    suf_pos = p + jnp.arange(s2)
+    suf_pid = pcache.table[slot, suf_pos // psz]           # [s2]
+    suf_off = suf_pos % psz
+    lengths = jnp.asarray(lengths, jnp.int32).reshape((b,))
+    x = jnp.take(params['embed'], tokens, axis=0).astype(cfg.dtype)
+    sin, cos = rotary.rope_frequencies(cfg.qk_rope_head_dim,
+                                       jnp.arange(s2) + p,
+                                       cfg.rope_theta, cfg.rope_scaling)
+
+    def body(carry, xs):
+        x_c, cp_all, krp_all = carry
+        lp, layer_idx = xs
+        q_nope, q_rope, c_new, kr_new = _latents(x_c, lp, cfg, sin, cos)
+        cp = jax.lax.dynamic_index_in_dim(cp_all, layer_idx, 0, False)
+        krp = jax.lax.dynamic_index_in_dim(krp_all, layer_idx, 0, False)
+        pc = cp[pre_pid, pre_off][None]                    # [1, p, r]
+        pkr = krp[pre_pid, pre_off][None]                  # [1, p, dr]
+        c_all = jnp.concatenate([pc.astype(c_new.dtype), c_new], axis=1)
+        kr_all = jnp.concatenate([pkr.astype(kr_new.dtype), kr_new],
+                                 axis=1)
+        out = _attend_latent(q_nope, q_rope, c_all, kr_all, lp, cfg,
+                             q_offset=p)
+        cp_all = jax.lax.dynamic_update_index_in_dim(
+            cp_all, cp.at[suf_pid, suf_off].set(c_new[0]), layer_idx, 0)
+        krp_all = jax.lax.dynamic_update_index_in_dim(
+            krp_all, krp.at[suf_pid, suf_off].set(kr_new[0]), layer_idx,
+            0)
+        x_c = x_c + jnp.einsum('bsh,hd->bsd', out,
+                               _d(lp['wo'], cfg.dtype))
+        x_c = x_c + _ffn(x_c, lp, cfg)[0]
+        return (x_c, cp_all, krp_all), None
+
+    layer_ids = jnp.arange(cfg.n_layers, dtype=jnp.int32)
+    (x, cps, krps), _ = jax.lax.scan(
+        body, (x, pcache.c_kv, pcache.k_rope),
+        (params['layers'], layer_ids))
+    x_last = jnp.take_along_axis(x, (lengths - 1)[:, None, None], axis=1)
+    x_last = norms.rms_norm(x_last, params['final_norm'], cfg.rms_eps)
+    head = (params['embed'].T if cfg.tie_embeddings else params['lm_head'])
+    logits = jnp.einsum('bsd,dv->bsv', x_last, head.astype(cfg.dtype),
+                        preferred_element_type=jnp.float32)
+    length = pcache.length.at[slot].set(p + lengths[0])
+    return logits[:, 0], dataclasses.replace(pcache, c_kv=cps,
+                                             k_rope=krps, length=length)
+
+
 def decode_step(params, token: jnp.ndarray, cache: LatentCache,
                 cfg: MLAConfig,
                 active: Optional[jnp.ndarray] = None

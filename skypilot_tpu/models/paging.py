@@ -27,11 +27,15 @@ step. The allocator hands out ids 1..n_pages-1.
 
 Families: ``PagedKV`` pools the dense/GQA/MoE K/V cache
 (models/decode.py); ``PagedLatent`` pools the MLA latent cache
-(models/mla.py). ``gather_view`` materializes the contiguous
-per-row view both families' existing prefill/step/verify math runs
-on unchanged — which is what makes paged decode token-identical to
-the contiguous path by construction (pin-tested in
-tests/unit_tests/test_engine_paged.py).
+(models/mla.py). The hot step/verify/chunk programs index pages IN
+PLACE inside the attention computation (ops/paged_attention.py +
+decode/mla ``paged_*`` steps — the fused default, still bit-identical
+to the contiguous path and pin-tested in
+tests/unit_tests/test_engine_paged.py); ``gather_view`` materializes
+the contiguous per-row view only for the SKYTPU_ENGINE_ATTN=gather
+regression baseline, and the cold paths (admit's scatter_prefill,
+prefix snapshot/export gathers, disagg handoff) keep their
+gather/scatter ops — they run once per request, not per token.
 """
 from __future__ import annotations
 
@@ -92,16 +96,21 @@ def pages_for(n_tokens: int, page_size: int) -> int:
 
 
 def gather_view(pcache, max_len: int):
-    """Materialize the contiguous [L, B, max_len, ...] per-row view the
-    existing decode/prefill/verify math consumes: ``pool[:, table]``
-    reshaped so position ``p`` of row ``b`` reads
+    """Materialize the contiguous [L, B, max_len, ...] per-row view:
+    ``pool[:, table]`` reshaped so position ``p`` of row ``b`` reads
     ``pool[:, table[b, p // psz], p % psz]``. Rows whose table entries
     are 0 read the trash page (garbage — such rows are always masked
     inactive and their logits discarded). Returns the family's
-    contiguous cache dataclass, so callers are family-blind."""
+    contiguous cache dataclass, so callers are family-blind.
+
+    BASELINE-ONLY on the hot path: the default fused engine
+    (SKYTPU_ENGINE_ATTN=fused, ops/paged_attention.py) indexes pages
+    in place inside the step/verify/chunk attention and never
+    materializes this view — only the SKYTPU_ENGINE_ATTN=gather
+    regression baseline still routes steps through it (skylint's
+    ``paged-view-materialization`` checker pins that no new hot-path
+    jit does)."""
     table = pcache.table
-    psz = page_size_of(pcache)
-    del psz
 
     def g(a):
         v = a[:, table]                        # [L, B, MAXP, psz, ...]

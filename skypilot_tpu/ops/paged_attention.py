@@ -1,0 +1,176 @@
+"""In-place paged attention: index KV pages inside the attention
+computation instead of materializing a contiguous per-row view.
+
+The block-paged engine (models/paging.py, docs/ENGINE.md) originally
+ran every fused step as gather → contiguous math → scatter:
+``paging.gather_view`` materialized the full ``[L, B, max_len, ...]``
+view in HBM before attention and ``scatter_steps``/``scatter_suffix``
+wrote results back — roughly 2/k extra full-cache traversals per
+decoded token on an HBM-bandwidth-bound decode path. This module is
+the JetStream/vLLM-style fix: the step/verify/chunked-prefill programs
+read ``pool[table[b, p // psz], p % psz]`` per LAYER inside the
+attention computation and write the k new token positions straight
+into the pool, so the only full-cache traffic left is the attention
+read itself.
+
+Two formulations behind one entry point (:func:`paged_attention_step`):
+
+  - ``fused`` (default, CPU-runnable, the correctness anchor): a
+    lax-level blockwise path — gather THIS layer's pages, overlay the
+    step's new K/V at each row's write positions exactly like the
+    contiguous ``verify_step`` does, and run the unchanged
+    ``ops.attention`` reduction. Page order equals position order, so
+    the reduction order (and the NEG_INF-underflow masking of
+    trash-page garbage) is preserved bit-for-bit: the paged engine
+    stays token-identical to the contiguous path by construction
+    (pin-tested in tests/unit_tests/test_engine_paged.py, property-
+    tested against the gather/scatter formulation in
+    tests/unit_tests/test_paging.py).
+  - ``pallas`` (TPU): a table-driven kernel
+    (ops/pallas/paged_attention.py) streaming per-page K/V blocks from
+    the pool with the page table scalar-prefetched into the index
+    maps. Gated like the flash path: allclose-tested in interpret mode
+    against the fused formulation, selected on TPU only — off-TPU (or
+    for the MLA latent family, whose absorbed attention has no kernel
+    yet) it falls back to ``fused``.
+
+``gather`` keeps yesterday's gather/scatter programs compiled as the
+regression baseline (serve/engine.py selects it per
+``SKYTPU_ENGINE_ATTN``); skylint's ``paged-view-materialization``
+checker pins that no NEW hot-path jit reaches for ``gather_view``.
+
+Layout contract (both cache families): pools are
+``[n_pages, page_size, ...]`` per layer, tables ``[B, max_pages]``
+int32 runtime data (page COUNT is data, not shape — the
+``page-table-shape`` discipline), page 0 is the trash page.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from skypilot_tpu.ops.attention import _on_tpu
+from skypilot_tpu.ops.attention import attention as _attention
+
+BACKENDS = ('fused', 'pallas', 'gather')
+DEFAULT_BACKEND = 'fused'
+ENV_VAR = 'SKYTPU_ENGINE_ATTN'
+
+
+def backend_from_env() -> str:
+    """The engine's attention-backend selection
+    (``SKYTPU_ENGINE_ATTN=fused|pallas|gather``; default ``fused``).
+    Garbage fails loudly at startup — a typo silently serving the slow
+    gather baseline would be an invisible perf regression."""
+    val = os.environ.get(ENV_VAR, DEFAULT_BACKEND).strip() or \
+        DEFAULT_BACKEND
+    if val not in BACKENDS:
+        raise ValueError(
+            f'{ENV_VAR} must be one of {BACKENDS}, got {val!r}')
+    return val
+
+
+def gather_pages(pool_layer: jnp.ndarray, table: jnp.ndarray,
+                 max_len: int) -> jnp.ndarray:
+    """One layer's contiguous view, straight from the pages: position
+    ``p`` of row ``b`` reads ``pool_layer[table[b, p // psz], p % psz]``.
+    pool_layer [n_pages, psz, ...], table [B, max_pages] →
+    [B, max_len, ...]. Rows whose table entries are 0 read the trash
+    page (garbage — always causally masked or overwritten before it is
+    attended). Pages concatenate in position order, so the attention
+    reduction order equals the materialized gather_view's exactly."""
+    v = pool_layer[table]                       # [B, MAXP, psz, ...]
+    b = v.shape[0]
+    v = v.reshape(b, -1, *pool_layer.shape[2:])
+    return v[:, :max_len]
+
+
+def write_pages(pool_layer: jnp.ndarray, new: jnp.ndarray,
+                pid: jnp.ndarray, off: jnp.ndarray) -> jnp.ndarray:
+    """Write this step's new per-token values straight into the pool:
+    new [B, S, ...] lands at (pid, off) [B, S] — indices the caller
+    derives from the page table with inactive rows routed to the trash
+    page (paging._write_indices), so a freed page can never be
+    corrupted by a stale in-flight step."""
+    return pool_layer.at[pid, off].set(new)
+
+
+def paged_attention_step(q: jnp.ndarray,
+                         kp: jnp.ndarray,
+                         vp: jnp.ndarray,
+                         table: jnp.ndarray,
+                         length: jnp.ndarray,
+                         k_new: jnp.ndarray,
+                         v_new: jnp.ndarray,
+                         pid: jnp.ndarray,
+                         off: jnp.ndarray,
+                         *,
+                         max_len: int,
+                         impl: str = 'fused',
+                         logit_softcap: Optional[float] = None,
+                         window: Optional[int] = None,
+                         window_active=None,
+                         sinks: Optional[jnp.ndarray] = None
+                         ) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                    jnp.ndarray]:
+    """One layer of in-place paged decode/verify attention for the
+    dense/GQA K/V family: q [B, S, H, hd] at per-row offsets `length`
+    ([B] int32), pools kp/vp [n_pages, psz, KH, hd], the step's new
+    K/V [B, S, KH, hd] written at (pid, off). Returns
+    (out [B, S, H, hd], kp', vp') with the pools updated in place —
+    no contiguous view is ever materialized across layers.
+
+    ``impl='fused'`` reproduces the contiguous verify_step bit-for-bit:
+    gather this layer's view from the PRE-WRITE pool, overlay the new
+    K/V at positions [length, length+S) for every row (exactly the
+    ``.at[rows, positions].set`` the contiguous path does — inactive
+    rows attend their own overlay too, so even their discarded logits
+    match), attend with the unchanged XLA reduction. ``impl='pallas'``
+    writes the pool first and streams page blocks through the
+    table-driven kernel — TPU only; off-TPU, and whenever the kernel's
+    shape/feature guard declines (softcap/window/sinks, lane-unaligned
+    head dims), it falls back to the fused formulation."""
+    b, s = q.shape[0], q.shape[1]
+    rows = jnp.arange(b)
+    positions = length[:, None] + jnp.arange(s)            # [B, S]
+    if impl == 'pallas' and _pallas_ok(q, kp, logit_softcap, window,
+                                       sinks):
+        from skypilot_tpu.ops.pallas import paged_attention as pk
+        kp2 = write_pages(kp, k_new, pid, off)
+        vp2 = write_pages(vp, v_new, pid, off)
+        # _pallas_ok gated on a real TPU, so the kernel always compiles
+        # here; interpret mode is the TESTS' entry (they call
+        # paged_decode_attention directly).
+        out = pk.paged_decode_attention(q, kp2, vp2, table, length)
+        return out, kp2, vp2
+    # Fused lax path (and the pallas fallback): overlay-then-attend,
+    # preserving the contiguous reduction order exactly.
+    k_l = gather_pages(kp, table, max_len)
+    v_l = gather_pages(vp, table, max_len)
+    k_l = k_l.at[rows[:, None], positions].set(k_new)
+    v_l = v_l.at[rows[:, None], positions].set(v_new)
+    out = _attention(
+        q, k_l, v_l, impl='xla', causal=True, q_offset=length,
+        kv_offset=0, logit_softcap=logit_softcap, window=window,
+        window_active=window_active, sinks=sinks)
+    kp2 = write_pages(kp, k_new, pid, off)
+    vp2 = write_pages(vp, v_new, pid, off)
+    return out, kp2, vp2
+
+
+def _pallas_ok(q, kp, logit_softcap, window, sinks) -> bool:
+    """The kernel guard, mirroring ops/attention's flash gating: TPU
+    only, plain causal attention only (no softcap/window/sinks — those
+    route to the fused lax path, like non-trivial shapes route flash
+    to xla)."""
+    if logit_softcap is not None or window is not None or \
+            sinks is not None:
+        return False
+    if not _on_tpu():
+        return False
+    # Lane alignment: head_dim multiples of 128 stream cleanly; the
+    # fused path serves everything else.
+    return q.shape[-1] % 128 == 0 and kp.shape[1] % 8 == 0

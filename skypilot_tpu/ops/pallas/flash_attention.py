@@ -31,6 +31,11 @@ from jax.experimental.pallas import tpu as pltpu
 LANES = 128
 NEG_INF = -2.0 ** 30
 
+# jax renamed TPUCompilerParams → CompilerParams across versions;
+# serve both spellings so the kernels load on either.
+COMPILER_PARAMS = getattr(pltpu, 'CompilerParams', None) or \
+    getattr(pltpu, 'TPUCompilerParams')
+
 
 def _block_size(s: int, preferred: int) -> int:
     for cand in (preferred, 512, 256, 128):
@@ -139,7 +144,7 @@ def _fwd(q, k, v, causal, block_q, block_k, interpret):
             pltpu.VMEM((bq, LANES), jnp.float32),
             pltpu.VMEM((bq, d), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=COMPILER_PARAMS(
             dimension_semantics=('parallel', 'parallel', 'parallel',
                                  'arbitrary')),
         interpret=interpret,
@@ -262,7 +267,7 @@ def _bwd(q, k, v, out, lse, do, causal, block_q, block_k, interpret,
         out_specs=[q_spec],
         out_shape=[jax.ShapeDtypeStruct((b, h, s, d), dq_dt)],
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=COMPILER_PARAMS(
             dimension_semantics=('parallel', 'parallel', 'parallel',
                                  'arbitrary')),
         interpret=interpret,
@@ -287,7 +292,7 @@ def _bwd(q, k, v, out, lse, do, causal, block_q, block_k, interpret,
                    jax.ShapeDtypeStruct((b, h, t, d), dv_dt)],
         scratch_shapes=[pltpu.VMEM((bk, d), jnp.float32),
                         pltpu.VMEM((bk, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=COMPILER_PARAMS(
             dimension_semantics=('parallel', 'parallel', 'parallel',
                                  'arbitrary')),
         interpret=interpret,

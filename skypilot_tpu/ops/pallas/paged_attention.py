@@ -1,0 +1,149 @@
+"""Table-driven paged decode attention as a Pallas TPU kernel.
+
+The dense/GQA half of the in-place paged attention entry point
+(ops/paged_attention.py): q is a handful of decode/verify positions
+per row ([B, S, H, hd], S = the fused step width), K/V live in the
+block-paged pool ([n_pages, page_size, KH, hd]) and the per-row page
+table ([B, max_pages] int32) says which page backs which position
+span. Instead of materializing a contiguous per-row view, the kernel
+STREAMS one page block per grid step straight from the pool:
+
+  - grid (B, H, max_pages), page index innermost; the page table and
+    per-row lengths ride as SCALAR-PREFETCH operands so the K/V
+    BlockSpec index maps resolve ``table[b, j]`` while the pipeline
+    prefetches — the JetStream/vLLM paged-attention structure;
+  - online softmax across page blocks (VMEM scratch m/l/acc persists
+    over the page dimension, flash-attention style); pages past the
+    row's content (``j*psz > length+S-1``) are skipped with pl.when —
+    their table entries are 0 (the trash page) and never loaded;
+  - causality inside a block is positional: page j covers row
+    positions [j*psz, (j+1)*psz), so the mask is
+    ``length + s >= j*psz + offset`` — no view, no position clamp.
+
+The caller writes the step's new K/V into the pool FIRST (the same
+trash-routed scatter the fused lax path uses), so the kernel only ever
+reads pages. Gated like the flash kernel: interpret-mode allclose
+against the fused lax formulation in tests/unit_tests/
+test_paged_attention.py, selected on real TPUs only
+(ops/paged_attention._pallas_ok).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+NEG_INF = -2.0 ** 30
+
+# The TPUCompilerParams → CompilerParams rename alias lives with the
+# flash kernel; one definition serves every pallas kernel here.
+from skypilot_tpu.ops.pallas.flash_attention import COMPILER_PARAMS
+
+
+def _kernel(table_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+            m_scr, l_scr, acc_scr, *, psz: int, s: int, nk: int):
+    b, j = pl.program_id(0), pl.program_id(2)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+
+    length = len_ref[b]
+    q_pos_max = length + s - 1
+    # Pages wholly past the row's content hold table entry 0 (trash):
+    # skip them — the online stats simply don't advance.
+    relevant = j * psz <= q_pos_max
+    last_j = jnp.minimum(q_pos_max // psz, nk - 1)
+
+    @pl.when(relevant)
+    def _body():
+        q = q_ref[0, :, 0, :]                              # (S, hd)
+        k = k_ref[0, :, 0, :]                              # (psz, hd)
+        v = v_ref[0, :, 0, :]
+        s_ij = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)            # (S, psz)
+        q_pos = length + jax.lax.broadcasted_iota(
+            jnp.int32, s_ij.shape, 0)
+        kv_pos = j * psz + jax.lax.broadcasted_iota(
+            jnp.int32, s_ij.shape, 1)
+        s_ij = jnp.where(q_pos >= kv_pos, s_ij, NEG_INF)
+
+        m_prev = m_scr[...][:, :1]                         # (S, 1)
+        l_prev = l_scr[...][:, :1]
+        m_cur = jnp.max(s_ij, axis=1, keepdims=True)
+        m_next = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_next)
+        p = jnp.exp(s_ij - m_next)                         # (S, psz)
+        l_next = l_prev * alpha + jnp.sum(p, axis=1, keepdims=True)
+        m_scr[...] = jnp.broadcast_to(m_next, m_scr.shape)
+        l_scr[...] = jnp.broadcast_to(l_next, l_scr.shape)
+        pv = jax.lax.dot(p.astype(v.dtype), v,
+                         preferred_element_type=jnp.float32)
+        acc_scr[...] = acc_scr[...] * alpha + pv
+
+    @pl.when(j == last_j)
+    def _finalize():
+        l = l_scr[...][:, :1]
+        l_inv = jnp.where(l == 0.0, 1.0, 1.0 / l)
+        o_ref[0, :, 0, :] = (acc_scr[...] * l_inv).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q: jnp.ndarray,
+                           kp: jnp.ndarray,
+                           vp: jnp.ndarray,
+                           table: jnp.ndarray,
+                           length: jnp.ndarray,
+                           *,
+                           softmax_scale: Optional[float] = None,
+                           interpret: bool = False) -> jnp.ndarray:
+    """q [B, S, H, hd] at per-row offsets `length` [B], pools kp/vp
+    [n_pages, psz, KH, hd] addressed through table [B, max_pages] →
+    out [B, S, H, hd]. Causal over positions [0, length+S) per row;
+    positions [length, length+S) must already be written to the pool
+    (the caller's in-place scatter precedes the call)."""
+    b, s, h, hd = q.shape
+    kh, psz = kp.shape[2], kp.shape[1]
+    g = h // kh
+    nk = table.shape[1]
+    scale = softmax_scale if softmax_scale is not None else hd ** -0.5
+    qs = (q * scale).astype(q.dtype)
+    grid = (b, h, nk)
+    spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, s, 1, hd),
+                         lambda b_, h_, j, tref, lref: (b_, 0, h_, 0)),
+            pl.BlockSpec((1, psz, 1, hd),
+                         lambda b_, h_, j, tref, lref:
+                         (tref[b_, j], 0, h_ // g, 0)),
+            pl.BlockSpec((1, psz, 1, hd),
+                         lambda b_, h_, j, tref, lref:
+                         (tref[b_, j], 0, h_ // g, 0)),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, s, 1, hd),
+            lambda b_, h_, j, tref, lref: (b_, 0, h_, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((s, LANES), jnp.float32),
+            pltpu.VMEM((s, LANES), jnp.float32),
+            pltpu.VMEM((s, hd), jnp.float32),
+        ],
+    )
+    kernel = functools.partial(_kernel, psz=psz, s=s, nk=nk)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=spec,
+        out_shape=jax.ShapeDtypeStruct((b, s, h, hd), q.dtype),
+        compiler_params=COMPILER_PARAMS(
+            dimension_semantics=('parallel', 'parallel', 'arbitrary')),
+        interpret=interpret,
+    )(table, length.astype(jnp.int32), qs, kp, vp)

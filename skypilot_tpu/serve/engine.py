@@ -209,6 +209,30 @@ _M_HANDOFF_STAGED = metrics_lib.gauge(
     'skytpu_engine_handoff_staged',
     'Handoffs received and staged (host memory) but not yet continued '
     'by a /disagg/continue call (decode role; sampled at scrape).')
+# In-place paged attention (ops/paged_attention.py; docs/ENGINE.md):
+# the backend info-gauge makes "which attention path is this replica
+# serving" a scrape-able fact, and the cache-traffic counters are a
+# SHAPE-DERIVED proxy (bytes the step/verify programs move through the
+# KV cache, computed host-side from static shapes — never a device
+# sync) that makes the gather-vs-fused win visible at /metrics:
+# the gather baseline's extra view materialization + scatter-back
+# shows up as ~2 extra full-cache traversals per fused k-step call.
+_M_ATTN_BACKEND = metrics_lib.gauge(
+    'skytpu_engine_attn_backend',
+    'Info gauge: 1 on the attention backend this replica serves the '
+    'paged hot path with (SKYTPU_ENGINE_ATTN), 0 elsewhere',
+    labels={'backend': ('fused', 'pallas', 'gather')})
+_M_CACHE_READ = metrics_lib.counter(
+    'skytpu_engine_cache_bytes_read_total',
+    'KV-cache bytes read by the decode step/verify programs '
+    '(shape-derived proxy: attention reads of the [B, max_len] span '
+    'plus, on the gather baseline, the view materialization and '
+    'scatter-back reads)')
+_M_CACHE_WRITTEN = metrics_lib.counter(
+    'skytpu_engine_cache_bytes_written_total',
+    'KV-cache bytes written by the decode step/verify programs '
+    '(shape-derived proxy: the new token positions plus, on the '
+    'gather baseline, the materialized contiguous view)')
 
 _ENGINE_METRICS = (
     _M_STEP_SECONDS, _M_ADMIT_SECONDS, _M_HOST_SYNC_SECONDS,
@@ -217,7 +241,8 @@ _ENGINE_METRICS = (
     _M_SPEC_PROPOSED, _M_SPEC_ACCEPTED, _M_TTFT, _M_TPOT,
     _M_CLASS_TTFT, _M_CLASS_TPOT, _M_GOODPUT,
     _M_PAGES_FREE, _M_PAGES_USED, _M_PAGE_ALLOC, _M_ADMIT_WAIT,
-    _M_HANDOFF, _M_HANDOFF_STAGED)
+    _M_HANDOFF, _M_HANDOFF_STAGED, _M_ATTN_BACKEND, _M_CACHE_READ,
+    _M_CACHE_WRITTEN)
 
 
 def _seed_counter_zeros() -> None:
@@ -233,12 +258,23 @@ def _seed_counter_zeros() -> None:
     _M_PREFIX.inc(0, outcome='miss')
     _M_PAGE_ALLOC.inc(0, outcome='ok')
     _M_PAGE_ALLOC.inc(0, outcome='wait')
+    _M_CACHE_READ.inc(0)
+    _M_CACHE_WRITTEN.inc(0)
     for cls in request_class.CLASSES:
         _M_GOODPUT.inc(0, cls=cls, outcome='good')
         _M_GOODPUT.inc(0, cls=cls, outcome='slow')
 
 
 _seed_counter_zeros()
+
+
+def _set_attn_backend_gauge(backend: str) -> None:
+    """Publish the active attention backend as an info gauge (1 on the
+    serving backend, 0 on the others — every series exists, so a
+    dashboard can pivot on it without absent-series special cases)."""
+    for b in ('fused', 'pallas', 'gather'):
+        _M_ATTN_BACKEND.set(1.0 if b == backend else 0.0, backend=b)
+
 
 MAX_BATCH = int(os.environ.get('SKYTPU_ENGINE_MAX_BATCH', '8'))
 # Max decode steps fused into one device call when no request is waiting.
@@ -311,6 +347,14 @@ KV_PAGES = int(os.environ.get('SKYTPU_ENGINE_KV_PAGES', '0'))
 # prefill call and short requests keep streaming. Power of two >= 16.
 PREFILL_CHUNK = int(os.environ.get('SKYTPU_ENGINE_PREFILL_CHUNK',
                                    '256'))
+# In-place paged attention backend (SKYTPU_ENGINE_ATTN, parsed and
+# validated by ops.paged_attention.backend_from_env at engine init):
+# 'fused' (default — pages indexed inside the step/verify/chunk
+# attention, no view materialization), 'pallas' (the table-driven TPU
+# kernel for the dense family; falls back to fused off-TPU and for
+# MLA), or 'gather' (yesterday's gather_view → contiguous math →
+# scatter programs, kept compiled as the regression baseline). Only
+# meaningful in paged mode.
 # Request resurrection (docs/ROBUSTNESS.md): after a device-step
 # failure resets the pool, requests that never sampled a token are
 # resubmitted internally instead of failed. Each request is resurrected
@@ -814,6 +858,12 @@ class InferenceEngine:
         self.page_size = PAGE_SIZE
         self.prefill_chunk = PREFILL_CHUNK
         self.kv_pages = KV_PAGES
+        # Attention backend for the paged hot path — an instance
+        # attribute (tests override it before warmup), parsed/validated
+        # by THE one env reader (garbage fails engine construction
+        # loudly, never silently serves the slow baseline).
+        from skypilot_tpu.ops import paged_attention as pa_lib
+        self.attn_backend = pa_lib.backend_from_env()
         if self.paged:
             if (self.page_size & (self.page_size - 1) or
                     PREFIX_MIN_TOKENS % self.page_size):
@@ -1036,6 +1086,18 @@ class InferenceEngine:
                                  self._decode.cache_pspecs(self.cfg),
                                  is_leaf=lambda x: isinstance(
                                      x, PartitionSpec)))
+        # Shape-derived cache-traffic proxy inputs (the
+        # skytpu_engine_cache_bytes_* counters; no device sync —
+        # pure host arithmetic on static shapes). token bytes = one
+        # position's cache footprint across layers; view bytes = the
+        # full [B, max_len] span's.
+        pools = ([self.cache.k, self.cache.v]
+                 if hasattr(self.cache, 'k')
+                 else [self.cache.c_kv, self.cache.k_rope])
+        self._tok_bytes = sum(
+            a.shape[0] * int(np.prod(a.shape[3:])) * a.dtype.itemsize
+            for a in pools)
+        self._view_bytes = MAX_BATCH * self.max_len * self._tok_bytes
         base = (self._seed if self._seed is not None
                 else int(time.time_ns()) % (2**31))
         self.rng = jax.random.PRNGKey((base + self._resets) % (2**31))
@@ -1081,6 +1143,24 @@ class InferenceEngine:
         but produces no tokens until its final chunk samples)."""
         return (s is not None and s['finish'] is None and
                 s.get('prefill') is None)
+
+    def _count_cache_traffic(self, n_attend: int, n_tokens: int) -> None:
+        """Account one hot-path device call's KV-cache traffic into the
+        skytpu_engine_cache_bytes_* counters — a SHAPE-DERIVED proxy
+        (host ints only, never a device sync). ``n_attend`` = times the
+        call attends the full [B, max_len] span (k for a fused k-step,
+        1 for a K-wide verify), ``n_tokens`` = new positions written
+        per row. The gather baseline additionally pays the view
+        materialization (pool read + view write) and the scatter-back
+        (view read + pool write) — the ~2 extra full-cache traversals
+        per call the fused path eliminates."""
+        read = n_attend * self._view_bytes
+        written = n_tokens * MAX_BATCH * self._tok_bytes
+        if self.paged and self.attn_backend == 'gather':
+            read += self._view_bytes + written
+            written += self._view_bytes
+        _M_CACHE_READ.inc(read)
+        _M_CACHE_WRITTEN.inc(written)
 
     def _refresh_table(self) -> None:
         """Push the host page-table mirror to the device cache if any
@@ -1238,7 +1318,19 @@ class InferenceEngine:
                 return x
 
         paged = self.paged
+        attn = self.attn_backend
+        # The fused in-place paged path is the default; 'gather' keeps
+        # yesterday's gather_view → contiguous math → scatter programs
+        # compiled as the regression baseline (their jits carry the
+        # *_gather naming skylint's paged-view-materialization checker
+        # sanctions).
+        fused_paged = paged and attn != 'gather'
         from skypilot_tpu.models import paging as paging_lib
+        if paged:
+            # Contiguous (PAGED=0) replicas don't publish the gauge:
+            # no paged attention path is serving, and a 'fused' series
+            # there would mislabel the replica on backend dashboards.
+            _set_attn_backend_gauge(attn)
 
         def step_k(k, use_pen, want_tops):
             """k decode steps in ONE device call (host-loop dispatch cost
@@ -1254,17 +1346,75 @@ class InferenceEngine:
             the batch loop can keep a call in flight with no host
             sync between steps.
 
-            Paged mode wraps the SAME step math: gather the contiguous
-            per-row view from the page pool (page-table indices are
-            runtime int32 data — one compiled program regardless of
-            page assignment), run the identical scan, then scatter the
-            k written positions back into the pool — inactive rows'
-            writes route to the trash page so a freed page can never
-            be corrupted by a stale step."""
+            Paged mode (fused, the default): the page pool ITSELF is
+            the scan carry — each step's attention indexes
+            pool[table[b, p // psz], p % psz] per layer inside the
+            computation and writes its token straight into the pages
+            (inactive rows' writes route to the trash page so a freed
+            page can never be corrupted by a stale step). No
+            contiguous view is materialized and nothing scatters back:
+            the ~2/k extra full-cache traversals the gather baseline
+            pays per token are gone, and the token stream is
+            bit-identical by construction (ops/paged_attention.py)."""
+
+            def sample(logits, last_t, counts_t, rng_t, temp, topk,
+                       topp, pres, freq, active):
+                rng_t, sub = jax.random.split(rng_t)
+                nxt = decode_lib.select_token_per_row(
+                    logits, temp, topk, topp, sub,
+                    counts=counts_t if use_pen else None,
+                    presence=pres if use_pen else None,
+                    frequency=freq if use_pen else None)
+                nxt = jnp.where(active, nxt, last_t)
+                # logprobs report the UNPENALIZED model distribution.
+                lp = decode_lib.chosen_logprob(logits, nxt)
+                if use_pen:
+                    rows = jnp.arange(nxt.shape[0])
+                    counts_t = counts_t.at[rows, nxt].add(
+                        active.astype(jnp.int32))
+                return nxt, lp, counts_t, rng_t
+
+            def finish(outs, last_f, cache_f, counts_f, rng_f):
+                if want_tops:
+                    toks, lps, tis, tvs = outs
+                    return (repl(toks), repl(lps), repl(tis), repl(tvs),
+                            repl(last_f), cache_f, counts_f, rng_f)
+                toks, lps = outs
+                return (repl(toks), repl(lps), repl(last_f), cache_f,
+                        counts_f, rng_f)
+
+            if fused_paged:
+                @functools.partial(jax.jit, donate_argnums=(1, 2))
+                def run(params, cache, counts, last, temp, topk, topp,
+                        pres, freq, rng, active):
+                    def body(carry, _):
+                        last_t, cache_t, counts_t, rng_t = carry
+                        logits, cache_t = dec.paged_decode_step(
+                            params, last_t, cache_t, cfg,
+                            max_len=max_len, active=active, attn=attn)
+                        nxt, lp, counts_t, rng_t = sample(
+                            logits, last_t, counts_t, rng_t, temp,
+                            topk, topp, pres, freq, active)
+                        if want_tops:
+                            tv, ti = top5(logits)
+                            return ((nxt, cache_t, counts_t, rng_t),
+                                    (nxt, lp, ti, tv))
+                        return ((nxt, cache_t, counts_t, rng_t),
+                                (nxt, lp))
+                    (last_f, cache_f, counts_f, rng_f), outs = \
+                        jax.lax.scan(body, (last, cache, counts, rng),
+                                     None, length=k)
+                    return finish(outs, last_f, cache_f, counts_f,
+                                  rng_f)
+                return run
 
             @functools.partial(jax.jit, donate_argnums=(1, 2))
-            def run(params, cache, counts, last, temp, topk, topp, pres,
-                    freq, rng, active):
+            def run_gather(params, cache, counts, last, temp, topk,
+                           topp, pres, freq, rng, active):
+                # Baseline formulation (SKYTPU_ENGINE_ATTN=gather, and
+                # the contiguous PAGED=0 layout): materialize the
+                # per-row view, run the contiguous step math, scatter
+                # the k written positions back.
                 if paged:
                     start = cache.length
                     view0 = paging_lib.gather_view(cache, max_len)
@@ -1276,19 +1426,9 @@ class InferenceEngine:
                     logits, cache_t = dec.decode_step(params, last_t,
                                                       cache_t, cfg,
                                                       active=active)
-                    rng_t, sub = jax.random.split(rng_t)
-                    nxt = decode_lib.select_token_per_row(
-                        logits, temp, topk, topp, sub,
-                        counts=counts_t if use_pen else None,
-                        presence=pres if use_pen else None,
-                        frequency=freq if use_pen else None)
-                    nxt = jnp.where(active, nxt, last_t)
-                    # logprobs report the UNPENALIZED model distribution.
-                    lp = decode_lib.chosen_logprob(logits, nxt)
-                    if use_pen:
-                        rows = jnp.arange(nxt.shape[0])
-                        counts_t = counts_t.at[rows, nxt].add(
-                            active.astype(jnp.int32))
+                    nxt, lp, counts_t, rng_t = sample(
+                        logits, last_t, counts_t, rng_t, temp, topk,
+                        topp, pres, freq, active)
                     if want_tops:
                         tv, ti = top5(logits)
                         return ((nxt, cache_t, counts_t, rng_t),
@@ -1302,14 +1442,8 @@ class InferenceEngine:
                                                        start, k, active)
                 else:
                     cache_f = view_f
-                if want_tops:
-                    toks, lps, tis, tvs = outs
-                    return (repl(toks), repl(lps), repl(tis), repl(tvs),
-                            repl(last_f), cache_f, counts_f, rng_f)
-                toks, lps = outs
-                return (repl(toks), repl(lps), repl(last_f), cache_f,
-                        counts_f, rng_f)
-            return run
+                return finish(outs, last_f, cache_f, counts_f, rng_f)
+            return run_gather
 
         self._step_k_jits = {}
 
@@ -1388,34 +1522,10 @@ class InferenceEngine:
             return (repl(first[0]), repl(first_lp[0]), repl(ti[0]),
                     repl(tv[0]), cache, repl(last), rng)
 
-        @functools.partial(jax.jit, donate_argnums=(1,),
-                           static_argnums=(4,))
-        def spec_verify(params, cache, fed, active, want_tops):
-            """One K-wide speculative verify over the WHOLE slot pool:
-            fed [B, K] = per-row [last, d1..d_{K-1}]. Returns the
-            target's greedy token, its logprob (and, in the
-            want_tops=True variant only, the top-5 tensors) at every
-            position; KV for the fed tokens is written at each row's
-            offset but `length` does NOT advance — the host commits the
-            accepted run (+1 correction) by bumping length, so rollback
-            is free (decode.verify_step's contract). ``active`` [B]
-            bool: in paged mode inactive rows' K-wide writes route to
-            the trash page (their pages may be freed); the contiguous
-            path ignores it (stale writes land on the frozen row the
-            next admission overwrites, as before)."""
-            if paged:
-                start = cache.length
-                view0 = paging_lib.gather_view(cache, max_len)
-            else:
-                view0 = cache
-            logits, view2 = dec.verify_step(params, fed, view0, cfg)
-            if paged:
-                # verify_step wrote [length, length+K) without
-                # advancing length — scatter exactly those positions.
-                cache2 = paging_lib.scatter_steps(
-                    cache, view2, start, fed.shape[1], active)
-            else:
-                cache2 = view2
+        def spec_outputs(logits, want_tops, cache2):
+            """Shared verify post-processing: greedy token + its
+            logprob per position (and the top-5 tensors in the
+            want_tops variant)."""
             logits = logits.astype(jnp.float32)          # [B, K, V]
             greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             lse = jax.scipy.special.logsumexp(logits, axis=-1)
@@ -1426,6 +1536,50 @@ class InferenceEngine:
             tv, ti = top5(logits)
             return repl(greedy), repl(lp), repl(ti), repl(tv), cache2
 
+        # One K-wide speculative verify over the WHOLE slot pool:
+        # fed [B, K] = per-row [last, d1..d_{K-1}]. Returns the
+        # target's greedy token + logprob (and top-5 in the
+        # want_tops=True variant) at every position; KV for the fed
+        # tokens is written at each row's offset but `length` does NOT
+        # advance — the host commits the accepted run (+1 correction)
+        # by bumping length, so rollback is free (verify_step's
+        # contract). ``active`` [B] bool: in paged mode inactive rows'
+        # K-wide writes route to the trash page (their pages may be
+        # freed); the contiguous path ignores it (stale writes land on
+        # the frozen row the next admission overwrites, as before).
+        if fused_paged:
+            @functools.partial(jax.jit, donate_argnums=(1,),
+                               static_argnums=(4,))
+            def spec_verify(params, cache, fed, active, want_tops):
+                # Fused: the K fed positions write straight into the
+                # pool and attention indexes the pages in place — no
+                # view, no scatter-back (ops/paged_attention.py).
+                logits, cache2 = dec.paged_verify_step(
+                    params, fed, cache, cfg, max_len=max_len,
+                    active=active, attn=attn)
+                return spec_outputs(logits, want_tops, cache2)
+        else:
+            @functools.partial(jax.jit, donate_argnums=(1,),
+                               static_argnums=(4,))
+            def spec_verify_gather(params, cache, fed, active,
+                                   want_tops):
+                # Baseline: gather the view, run the contiguous
+                # verify, scatter exactly the [length, length+K)
+                # positions back.
+                if paged:
+                    start = cache.length
+                    view0 = paging_lib.gather_view(cache, max_len)
+                else:
+                    view0 = cache
+                logits, view2 = dec.verify_step(params, fed, view0, cfg)
+                if paged:
+                    cache2 = paging_lib.scatter_steps(
+                        cache, view2, start, fed.shape[1], active)
+                else:
+                    cache2 = view2
+                return spec_outputs(logits, want_tops, cache2)
+            spec_verify = spec_verify_gather
+
         def make_extend(p, s2, sample):
             """Paged extend program: prefill an [1, s2] suffix over the
             p tokens row `slot` already holds — the ONE program shape
@@ -1435,11 +1589,39 @@ class InferenceEngine:
             (p, s2 bucket, sample); `sample` is False for non-final
             chunks, which also leave rng and the device `last`
             untouched so a chunked admission consumes exactly the same
-            RNG stream as a contiguous one."""
+            RNG stream as a contiguous one. Fused default: the prefix
+            is gathered per layer from the (possibly shared) pages
+            inside the attention and the suffix K/V lands straight in
+            the row's own pages — no [L, 1, p] prefix materialization,
+            no scatter_suffix."""
+
+            def sample_tail(logits, cache2, last, slot, temp, topk,
+                            topp, rng):
+                rng, sub = jax.random.split(rng)
+                first = decode_lib.select_token_per_row(
+                    logits, temp[None], topk[None], topp[None], sub)
+                first_lp = decode_lib.chosen_logprob(logits, first)
+                tv, ti = top5(logits)
+                last = last.at[slot].set(first[0])
+                return (repl(first[0]), repl(first_lp[0]), repl(ti[0]),
+                        repl(tv[0]), cache2, repl(last), rng)
+
+            if fused_paged:
+                @functools.partial(jax.jit, donate_argnums=(1,))
+                def run(params, cache, last, tokens, length_s, slot,
+                        temp, topk, topp, rng):
+                    logits, cache2 = dec.paged_prefill_extend(
+                        params, tokens, cache, cfg, slot=slot, p=p,
+                        lengths=length_s, attn=attn)
+                    if not sample:
+                        return cache2
+                    return sample_tail(logits, cache2, last, slot,
+                                       temp, topk, topp, rng)
+                return run
 
             @functools.partial(jax.jit, donate_argnums=(1,))
-            def run(params, cache, last, tokens, length_s, slot, temp,
-                    topk, topp, rng):
+            def run_gather(params, cache, last, tokens, length_s, slot,
+                           temp, topk, topp, rng):
                 pa, pb = paging_lib.gather_prefix(cache, slot, p)
                 # Intermediates sized p+s2, not engine max_len: a chunk
                 # call materializes only the row it extends.
@@ -1450,15 +1632,9 @@ class InferenceEngine:
                     cache, row, slot, p, s2, p + length_s)
                 if not sample:
                     return cache2
-                rng, sub = jax.random.split(rng)
-                first = decode_lib.select_token_per_row(
-                    logits, temp[None], topk[None], topp[None], sub)
-                first_lp = decode_lib.chosen_logprob(logits, first)
-                tv, ti = top5(logits)
-                last = last.at[slot].set(first[0])
-                return (repl(first[0]), repl(first_lp[0]), repl(ti[0]),
-                        repl(tv[0]), cache2, repl(last), rng)
-            return run
+                return sample_tail(logits, cache2, last, slot, temp,
+                                   topk, topp, rng)
+            return run_gather
 
         self._extend_jits: Dict[Tuple[int, int, bool], Any] = {}
 
@@ -1641,6 +1817,8 @@ class InferenceEngine:
         for metric in _ENGINE_METRICS:
             metric.reset()
         _seed_counter_zeros()
+        if self.paged:
+            _set_attn_backend_gauge(self.attn_backend)
         # Warmup's synthetic admits/steps must not pollute the flight
         # ring (a /debug/flight dump should start at real traffic) or
         # leak timing sidecar entries for futures that never existed.
@@ -2555,6 +2733,7 @@ class InferenceEngine:
         _M_HOST_SYNC_SECONDS.observe(time.perf_counter() - t_sync)
         self.step_count += 1
         self.spec_rounds += 1
+        self._count_cache_traffic(1, k)
         _M_STEPS.inc()
         _M_SPEC_ROUNDS.inc()
         adv = np.zeros((MAX_BATCH,), np.int32)
@@ -2721,6 +2900,7 @@ class InferenceEngine:
                 self.rng = out
             handle = _InFlightStep(k, False, toks, lps)
         self._inflight.append(handle)
+        self._count_cache_traffic(k, k)
         # Ring only on the hot path: one counter bump + one slot store,
         # no sqlite/span/syscall (observe/flight.py; seq = step width).
         self.flight.record(flight_lib.DISPATCH, 0, k)
@@ -4321,7 +4501,12 @@ def main() -> None:
     if multihost_on:
         engine._ctrl = multihost.ControlLeader(args.coordinator,
                                                args.num_processes)
-        engine._bcast(('warmup', buckets, seed))
+        # The warmup op also carries the leader's attention backend:
+        # all processes must compile (and later select) the SAME
+        # step/verify/chunk program family or the gang's collectives
+        # would diverge — env skew across hosts must not be able to
+        # split the variant matrix.
+        engine._bcast(('warmup', buckets, seed, engine.attn_backend))
     engine.warmup(buckets=buckets)   # readiness flips only once fast
     web.run_app(build_app(engine), host=args.host, port=args.port,
                 print=None)

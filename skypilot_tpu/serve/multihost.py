@@ -292,6 +292,12 @@ def follower_serve(engine, coordinator: str) -> None:
         try:
             if kind == 'warmup':
                 engine._seed = op[2]   # leader-drawn sampling seed
+                if len(op) > 3:
+                    # Leader's attention backend (paged hot path):
+                    # every process must build the same program
+                    # family — a follower's local SKYTPU_ENGINE_ATTN
+                    # must not be able to split the variant matrix.
+                    engine.attn_backend = op[3]
                 engine.warmup(buckets=op[1])
             elif kind == 'admit':
                 # op[2] (paged mode): the leader's page-allocator

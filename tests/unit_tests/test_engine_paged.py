@@ -39,13 +39,15 @@ SEED = 20260803
 
 
 def _build(paged: bool, *, max_len=128, page_size=None, kv_pages=None,
-           prefill_chunk=None, spec_k=0):
+           prefill_chunk=None, spec_k=0, attn=None):
     eng = engine_lib.InferenceEngine('llama-debug', max_len=max_len,
                                      seed=SEED)
     # fp32: CPU reduction order must not flip argmax vs the reference.
     eng.cfg = dataclasses.replace(eng.cfg, dtype=jnp.float32)
     eng.spec_k = spec_k
     eng.paged = paged
+    if attn is not None:
+        eng.attn_backend = attn
     if page_size is not None:
         eng.page_size = page_size
     if kv_pages is not None:
@@ -58,7 +60,16 @@ def _build(paged: bool, *, max_len=128, page_size=None, kv_pages=None,
 
 @pytest.fixture(scope='module')
 def paged():
+    # The fused in-place attention default (SKYTPU_ENGINE_ATTN=fused):
+    # every equality pin in this module gates the DEFAULT hot path.
     return _build(True, prefill_chunk=16)
+
+
+@pytest.fixture(scope='module')
+def paged_gather():
+    """The SKYTPU_ENGINE_ATTN=gather regression baseline: yesterday's
+    gather_view → contiguous math → scatter programs."""
+    return _build(True, prefill_chunk=16, attn='gather')
 
 
 @pytest.fixture(scope='module')
@@ -149,6 +160,81 @@ class TestPagedEquality:
         b = _serve(contiguous, jobs)
         for (oa, *_), (ob, *_) in zip(a, b):
             assert list(oa) == list(ob)
+
+
+class TestAttnBackends:
+    """Backend selection (ops/paged_attention.py): fused is the
+    DEFAULT, gather stays selectable as the regression baseline, and
+    the two serve token-identical streams — greedy (with a chunked
+    long prompt) AND sampled."""
+
+    def test_fused_is_the_default_backend(self, paged):
+        from skypilot_tpu.ops import paged_attention as pa
+        assert pa.DEFAULT_BACKEND == 'fused'
+        assert paged.attn_backend == 'fused'
+
+    def test_garbage_backend_refused_at_engine_init(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_ENGINE_ATTN', 'fast')
+        with pytest.raises(ValueError, match='SKYTPU_ENGINE_ATTN'):
+            engine_lib.InferenceEngine('llama-debug', max_len=64,
+                                       seed=SEED)
+
+    def test_gather_baseline_token_identical_to_fused(self, paged,
+                                                      paged_gather):
+        import jax
+        long_p = [(i * 13) % 250 + 1 for i in range(60)]  # chunked
+        greedy_jobs = [([1, 2, 3, 4, 5], 12, 0.0, None, None),
+                       (long_p, 6, 0.0, None, None)]
+        sampled_jobs = [([21] * 8, 10, 0.8, 30, 0.9),
+                        ([22, 23, 24], 10, 1.1, None, None)]
+        for jobs in (greedy_jobs, sampled_jobs):
+            paged.rng = jax.random.PRNGKey(SEED)
+            paged_gather.rng = jax.random.PRNGKey(SEED)
+            a = _serve(paged, jobs)
+            b = _serve(paged_gather, jobs)
+            for (oa, fa, la, _), (ob, fb, lb, _) in zip(a, b):
+                assert list(oa) == list(ob)
+                assert fa == fb
+                np.testing.assert_array_equal(la, lb)
+
+    def test_pallas_backend_serves_token_identical_off_tpu(self, paged):
+        """SKYTPU_ENGINE_ATTN=pallas on CPU: the kernel guard declines
+        (no TPU) and every program serves through the fused lax path —
+        token-identical, no crash. The kernel itself is allclose-gated
+        in test_paged_attention.py."""
+        eng = _build(True, prefill_chunk=16, attn='pallas')
+        jobs = [([1, 2, 3, 4, 5], 8, 0.0, None, None)]
+        a = _serve(eng, jobs)
+        b = _serve(paged, jobs)
+        assert list(a[0][0]) == list(b[0][0])
+        assert a[0][1] == b[0][1]
+
+    def test_cache_traffic_counters_show_traversal_reduction(
+            self, paged, paged_gather):
+        """The shape-derived cache-bytes counters: for the SAME fused
+        k-step call, the gather baseline books ~2 extra full-view
+        traversals (materialize + scatter-back) the fused path never
+        pays."""
+        from skypilot_tpu.serve.engine import (_M_CACHE_READ,
+                                               _M_CACHE_WRITTEN)
+        k = engine_lib.MAX_STEP_CHUNK
+        deltas = {}
+        for eng in (paged, paged_gather):
+            r0, w0 = _M_CACHE_READ.value(), _M_CACHE_WRITTEN.value()
+            eng._count_cache_traffic(k, k)
+            deltas[eng.attn_backend] = (_M_CACHE_READ.value() - r0,
+                                        _M_CACHE_WRITTEN.value() - w0)
+        view = paged._view_bytes
+        tok_writes = k * engine_lib.MAX_BATCH * paged._tok_bytes
+        assert deltas['fused'] == (k * view, tok_writes)
+        assert deltas['gather'] == (k * view + view + tok_writes,
+                                    tok_writes + view)
+        # Per fused k-step call the baseline pays 2 extra view
+        # traversals — the ~2/k per-token reduction the fused path
+        # claims.
+        extra = (deltas['gather'][0] + deltas['gather'][1]) - \
+            (deltas['fused'][0] + deltas['fused'][1])
+        assert extra == 2 * view + tok_writes
 
 
 class TestChunkedPrefill:

@@ -1,0 +1,136 @@
+"""In-place paged attention: the ops-level entry point
+(ops/paged_attention.py) and the table-driven Pallas kernel
+(ops/pallas/paged_attention.py, interpret mode on CPU).
+
+The fused lax path's BIT-equality with the gather formulation is
+property-tested in test_paging.py and pinned end-to-end in
+test_engine_paged.py; this module covers what's left: backend
+selection (env validation), the page gather/write primitives, and the
+Pallas kernel's allclose gate against the fused formulation — the same
+interpret-mode contract the flash kernel has
+(test_flash_attention.py).
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from skypilot_tpu.ops import paged_attention as pa
+
+
+class TestBackendSelection:
+
+    def test_default_and_explicit_values(self, monkeypatch):
+        monkeypatch.delenv(pa.ENV_VAR, raising=False)
+        assert pa.backend_from_env() == 'fused'
+        for b in pa.BACKENDS:
+            monkeypatch.setenv(pa.ENV_VAR, b)
+            assert pa.backend_from_env() == b
+
+    def test_garbage_refused_loudly(self, monkeypatch):
+        monkeypatch.setenv(pa.ENV_VAR, 'turbo')
+        with pytest.raises(ValueError, match='SKYTPU_ENGINE_ATTN'):
+            pa.backend_from_env()
+
+
+def _pool(seed, n_pages=10, psz=8, kh=2, hd=16):
+    rng = np.random.default_rng(seed)
+    kp = jnp.asarray(rng.standard_normal((n_pages, psz, kh, hd)),
+                     jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((n_pages, psz, kh, hd)),
+                     jnp.float32)
+    # Rows 0 and 1 share page 1 (zero-copy prefix); trailing zeros are
+    # the trash page.
+    table = jnp.asarray([[1, 2, 3, 0], [1, 4, 5, 6], [7, 8, 9, 0]],
+                        jnp.int32)
+    length = jnp.asarray([13, 27, 5], jnp.int32)   # non-pow2
+    return kp, vp, table, length
+
+
+class TestPagePrimitives:
+
+    def test_gather_pages_matches_positionwise_indexing(self):
+        kp, _, table, _ = _pool(0)
+        psz, max_len = 8, 32
+        got = np.asarray(pa.gather_pages(kp, table, max_len))
+        kp_np = np.asarray(kp)
+        for b in range(table.shape[0]):
+            for p in range(max_len):
+                np.testing.assert_array_equal(
+                    got[b, p],
+                    kp_np[int(table[b, p // psz]), p % psz])
+
+    def test_write_pages_lands_at_table_positions(self):
+        kp, _, table, length = _pool(1)
+        from skypilot_tpu.models import paging
+        # The cache dataclass carries the LAYERED pools ([L, n_pages,
+        # psz, ...]); the per-layer primitives take one layer's slice.
+        pcache = paging.PagedKV(k=kp[None], v=kp[None], table=table,
+                                length=length)
+        k = 2
+        positions = length[:, None] + jnp.arange(k)
+        pid, off = paging._write_indices(pcache, positions)
+        new = jnp.asarray(
+            np.random.default_rng(2).standard_normal(
+                (3, k, kp.shape[2], kp.shape[3])), jnp.float32)
+        kp2 = pa.write_pages(kp, new, pid, off)
+        view = np.asarray(pa.gather_pages(kp2, table, 32))
+        for b in range(3):
+            for j in range(k):
+                np.testing.assert_array_equal(
+                    view[b, int(length[b]) + j], np.asarray(new[b, j]))
+
+
+class TestPallasKernel:
+    """Interpret-mode allclose gate: the table-driven kernel must match
+    the fused lax formulation over shared pages, trash-tailed tables,
+    GQA grouping and multi-token (verify-width) queries."""
+
+    @pytest.mark.parametrize('s', [1, 4])
+    @pytest.mark.parametrize('groups', [1, 2])
+    def test_kernel_matches_fused_lax(self, s, groups):
+        from skypilot_tpu.ops.attention import attention
+        from skypilot_tpu.ops.pallas import paged_attention as pk
+        kh, hd, psz, max_len = 2, 16, 8, 32
+        h = kh * groups
+        kp, vp, table, length = _pool(seed=s + groups)
+        rng = np.random.default_rng(40 + s)
+        b = table.shape[0]
+        q = jnp.asarray(rng.standard_normal((b, s, h, hd)), jnp.float32)
+        # New positions already written to the pool (the caller's
+        # contract): just attend.
+        out = pk.paged_decode_attention(q, kp, vp, table, length,
+                                        interpret=True)
+        k_l = pa.gather_pages(kp, table, max_len)
+        v_l = pa.gather_pages(vp, table, max_len)
+        ref = attention(q, k_l, v_l, impl='xla', causal=True,
+                        q_offset=length)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-6, rtol=2e-6)
+
+    def test_entry_point_pallas_falls_back_to_fused_off_tpu(self):
+        """impl='pallas' off-TPU must serve the fused lax path (and
+        still write the pool) — the TPU guard, like flash → xla."""
+        from skypilot_tpu.models import paging
+        kp, vp, table, length = _pool(9)
+        pcache = paging.PagedKV(k=kp[None], v=vp[None], table=table,
+                                length=length)
+        b, s, kh, hd = 3, 1, 2, 16
+        rng = np.random.default_rng(9)
+        q = jnp.asarray(rng.standard_normal((b, s, kh * 2, hd)),
+                        jnp.float32)
+        k_new = jnp.asarray(rng.standard_normal((b, s, kh, hd)),
+                            jnp.float32)
+        v_new = jnp.asarray(rng.standard_normal((b, s, kh, hd)),
+                            jnp.float32)
+        positions = length[:, None] + jnp.arange(s)
+        pid, off = paging._write_indices(pcache, positions)
+        outs = {}
+        for impl in ('fused', 'pallas'):
+            out, kp2, vp2 = pa.paged_attention_step(
+                q, kp, vp, table, length, k_new, v_new, pid, off,
+                max_len=32, impl=impl)
+            outs[impl] = (np.asarray(out), np.asarray(kp2),
+                          np.asarray(vp2))
+        for a, b_ in zip(outs['fused'], outs['pallas']):
+            np.testing.assert_array_equal(a, b_)

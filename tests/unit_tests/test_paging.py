@@ -1,5 +1,7 @@
 """Block-paged KV cache: the host-side free-list allocator's safety
-properties (models/paging.py).
+properties (models/paging.py), and the fused in-place paged attention's
+bit-equality with the gather/scatter formulation
+(ops/paged_attention.py + the models' paged_* steps).
 
 The allocator is the engine's memory-safety keystone: a double-free
 would hand one page to two requests (silent KV corruption), a leak
@@ -372,3 +374,124 @@ class TestExportAdoptHandoff:
         np.testing.assert_array_equal(np.asarray(a), np.asarray(a2))
         np.testing.assert_array_equal(np.asarray(b), np.asarray(b2))
         assert int(dst2.length[0]) == 8
+
+
+class TestFusedPagedAttention:
+    """The fused in-place formulation must be BIT-EQUAL to the gather
+    baseline (gather_view → contiguous verify/extend math → scatter) on
+    the lax path — the invariant that lets the engine default to
+    SKYTPU_ENGINE_ATTN=fused while test_engine_paged's contiguous pins
+    keep gating correctness. Random page tables with shared zero-copy
+    prefix pages, trash-page-masked inactive rows and non-pow2 lengths,
+    both cache families, k ∈ {1, 4}."""
+
+    PSZ, MAXP, B, MAX_LEN, N_PAGES = 16, 8, 4, 128, 48
+
+    @staticmethod
+    def _params(family):
+        import jax
+        import jax.numpy as jnp
+        import dataclasses
+        from skypilot_tpu.models import decode, llama, mla
+        if family == 'kv':
+            cfg = dataclasses.replace(llama.PRESETS['llama-debug'],
+                                      dtype=jnp.float32)
+            init = llama.init_params
+        else:
+            cfg = dataclasses.replace(mla.PRESETS['mla-debug'],
+                                      dtype=jnp.float32)
+            init = mla.init_params
+        params = jax.jit(lambda r: init(r, cfg))(jax.random.PRNGKey(7))
+        return decode.cast_params_for_decode(params, cfg), cfg
+
+    def _pool(self, family, cfg, seed):
+        """Random pool + a random VALID table: per-row page runs drawn
+        without replacement, rows 0/1 share a zero-copy prefix run,
+        unreserved tail entries 0 (trash), non-pow2 lengths."""
+        import jax.numpy as jnp
+        import numpy as np
+        from skypilot_tpu.models import decode, mla
+        rng = np.random.default_rng(seed)
+        mod = decode if family == 'kv' else mla
+        pool = mod.init_page_pool(cfg, self.N_PAGES, self.PSZ, self.B,
+                                  self.MAXP)
+        arrays = {f: jnp.asarray(
+            rng.standard_normal(getattr(pool, f).shape), jnp.float32)
+            for f in (('k', 'v') if family == 'kv'
+                      else ('c_kv', 'k_rope'))}
+        ids = list(rng.permutation(np.arange(1, self.N_PAGES)))
+        shared = [ids.pop() for _ in range(2)]   # rows 0+1's prefix
+        table = np.zeros((self.B, self.MAXP), np.int32)
+        lengths = np.zeros((self.B,), np.int32)
+        for b in range(self.B):
+            own = [ids.pop() for _ in range(3)]
+            row = (shared + own) if b < 2 else own
+            table[b, :len(row)] = row
+            # Non-pow2 length, with >= 4 free positions of verify
+            # headroom inside the reserved pages.
+            lengths[b] = int(rng.integers(1, len(row) * self.PSZ - 4))
+        return (pool.__class__(**arrays,
+                               table=jnp.asarray(table),
+                               length=jnp.asarray(lengths)),
+                jnp.asarray([True, True, False, True]))
+
+    @pytest.mark.parametrize('family', ['kv', 'latent'])
+    @pytest.mark.parametrize('k', [1, 4])
+    def test_fused_verify_bit_equals_gather_formulation(self, family,
+                                                        k):
+        import jax.numpy as jnp
+        import numpy as np
+        from skypilot_tpu.models import decode, mla
+        params, cfg = self._params(family)
+        mod = decode if family == 'kv' else mla
+        pool, active = self._pool(family, cfg, seed=k)
+        rng = np.random.default_rng(100 + k)
+        toks = jnp.asarray(
+            rng.integers(0, cfg.vocab_size, (self.B, k)), jnp.int32)
+        view0 = paging.gather_view(pool, self.MAX_LEN)
+        logits_ref, view2 = mod.verify_step(params, toks, view0, cfg)
+        ref = paging.scatter_steps(pool, view2, pool.length, k, active)
+        logits_f, fused = mod.paged_verify_step(
+            params, toks, pool, cfg, max_len=self.MAX_LEN,
+            active=active, attn='fused')
+        np.testing.assert_array_equal(np.asarray(logits_ref),
+                                      np.asarray(logits_f))
+        for f in (('k', 'v') if family == 'kv' else ('c_kv', 'k_rope')):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ref, f)),
+                np.asarray(getattr(fused, f)))
+        np.testing.assert_array_equal(np.asarray(ref.length),
+                                      np.asarray(fused.length))
+
+    @pytest.mark.parametrize('family', ['kv', 'latent'])
+    def test_fused_extend_bit_equals_gather_formulation(self, family):
+        """The chunk/prefix-extend program: suffix over shared prefix
+        pages, fused vs gather_prefix → prefill_extend →
+        scatter_suffix."""
+        import jax.numpy as jnp
+        import numpy as np
+        from skypilot_tpu.models import decode, mla
+        params, cfg = self._params(family)
+        mod = decode if family == 'kv' else mla
+        pool, _ = self._pool(family, cfg, seed=5)
+        slot, p, s2 = 1, 2 * self.PSZ, 16    # prefix spans the SHARED
+        #                                      pages + one own page
+        rng = np.random.default_rng(55)
+        toks = jnp.asarray(rng.integers(0, cfg.vocab_size, (1, s2)),
+                           jnp.int32)
+        ln = jnp.int32(11)                   # non-pow2 suffix length
+        pa_, pb_ = paging.gather_prefix(pool, slot, p)
+        logits_ref, row = mod.prefill_extend(
+            params, toks, cfg, p + s2, pa_, pb_, lengths=ln[None])
+        ref = paging.scatter_suffix(pool, row, slot, p, s2, p + ln)
+        logits_f, fused = mod.paged_prefill_extend(
+            params, toks, pool, cfg, slot=jnp.int32(slot), p=p,
+            lengths=ln, attn='fused')
+        np.testing.assert_array_equal(np.asarray(logits_ref),
+                                      np.asarray(logits_f))
+        for f in (('k', 'v') if family == 'kv' else ('c_kv', 'k_rope')):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ref, f)),
+                np.asarray(getattr(fused, f)))
+        np.testing.assert_array_equal(np.asarray(ref.length),
+                                      np.asarray(fused.length))

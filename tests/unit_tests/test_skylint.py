@@ -35,7 +35,8 @@ PKG = os.path.join(REPO, 'skypilot_tpu')
 # below — the gate test fails loudly otherwise.
 EXPECTED_CHECKS = [
     'layers', 'lazy-imports', 'async-blocking', 'jit-hazards',
-    'host-sync-loop', 'page-table-shape', 'sqlite-discipline',
+    'host-sync-loop', 'page-table-shape',
+    'paged-view-materialization', 'sqlite-discipline',
     'state-machine', 'thread-discipline', 'silent-except',
     'metric-discipline', 'span-discipline', 'timeout-discipline',
     'failpoint-naming', 'backoff-discipline',
@@ -483,6 +484,71 @@ class TestPageTableShapeChecker:
                 run_jit(c, pages=[1, 2])   # not an engine/model unit
         ''')
         assert _run(tmp_path, checks=['page-table-shape'])['total'] == 0
+
+
+# ------------------------------------------------------- paged view gather
+
+class TestPagedViewMaterializationChecker:
+
+    def test_gather_view_in_hot_jit_flagged(self, tmp_path):
+        """A serve-plane jit materializing the contiguous paged view
+        is the gather/scatter hot-path anti-pattern reintroduced —
+        both decorator spellings are caught, nested scan bodies
+        included."""
+        _write(tmp_path, 'serve/engine.py', '''\
+            import functools
+            import jax
+            from skypilot_tpu.models import paging
+
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def run(params, cache, last):
+                view = paging.gather_view(cache, 128)
+                return view
+
+            @jax.jit
+            def verify(params, cache):
+                def body(carry, _):
+                    v = paging.gather_view(cache, 128)
+                    return carry, v
+                return jax.lax.scan(body, cache, None, length=2)
+        ''')
+        report = _run(tmp_path, checks=['paged-view-materialization'])
+        assert sorted(_idents(report)) == [
+            'paged-view-materialization:serve/engine.py:jit:run',
+            'paged-view-materialization:serve/engine.py:jit:verify',
+        ]
+        assert 'in place' in report['violations'][0]['message']
+
+    def test_baseline_suffix_and_host_side_and_models_ok(self, tmp_path):
+        """The sanctioned shapes: a *_gather-named baseline jit may
+        materialize the view; host-side (non-jit) calls are per-request
+        cold paths; models/ (where gather_view is DEFINED and the
+        property tests drive it) is out of scope."""
+        _write(tmp_path, 'serve/engine.py', '''\
+            import functools
+            import jax
+            from skypilot_tpu.models import paging
+
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def run_gather(params, cache, last):
+                return paging.gather_view(cache, 128)
+
+            def snapshot(cache):
+                # host-side export path, runs once per request
+                return paging.gather_view(cache, 128)
+        ''')
+        _write(tmp_path, 'models/paging.py', '''\
+            import jax
+
+            @jax.jit
+            def reference(cache):
+                return gather_view(cache, 128)
+
+            def gather_view(cache, n):
+                return cache
+        ''')
+        report = _run(tmp_path, checks=['paged-view-materialization'])
+        assert report['total'] == 0
 
 
 # ------------------------------------------------------------ async multi-hop
@@ -1603,7 +1669,7 @@ class TestLivePackage:
         with open(out_path, encoding='utf-8') as f:
             report = json.load(f)
         # Schema stability (version-bump ratchet).
-        assert report['skylint_version'] == core.REPORT_VERSION == 13
+        assert report['skylint_version'] == core.REPORT_VERSION == 14
         assert set(report) == {
             'skylint_version', 'root', 'files_scanned', 'checks',
             'violations', 'total', 'allowlisted', 'new',
