@@ -5,22 +5,25 @@ The exact bug class of the round-5 advisor finding: a blocking
 frontend behind one stalled follower TCP buffer. Anything that parks
 the thread inside an ``async def`` parks EVERY request on that loop.
 
-Detection (upgraded to call-graph depth in skylint v2):
+Detection (whole-program since skylint v15 — the per-module fixpoint
+this checker carried in v2 moved into ``analysis/callgraph.py`` and
+went cross-module):
   1. direct — a known-blocking call in an ``async def`` body (nested
      ``def``/``async def`` bodies are separate scopes, not entered);
-  2. transitive — an ``async def`` calls a sync function/method
-     defined in the SAME module that reaches a blocking call through
-     any chain of same-module sync helpers (the real bug was wired
+  2. transitive — an ``async def`` calls a sync function or method,
+     in ANY module of the package, that reaches a blocking call
+     through any chain of sync calls (the real bug was wired
      ``batch_loop`` → ``self._bcast`` → ``send`` → ``sendall``; v1
-     only followed one hop). Resolution is name-based; cross-module
-     chains are out of scope.
+     only followed one hop, v2 stopped at the module boundary).
 
-``await``-ed calls are exempt (``await ws.recv()`` is the async API).
+``await``-ed calls are exempt (``await ws.recv()`` is the async API),
+and so are ``asyncio.to_thread`` / ``run_in_executor`` targets — the
+executor IS the remediation this checker demands.
 """
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 from skypilot_tpu.analysis import core
 from skypilot_tpu.analysis import dataflow
@@ -51,7 +54,8 @@ def blocking_reason(call: ast.Call,
                     aliases: Dict[str, str]) -> Optional[str]:
     """The canonical blocking-call name if ``call`` blocks, else None.
     Shared with the thread-discipline checker (blocking under a lock
-    is the same call list, different victim)."""
+    is the same call list, different victim) and with the call-graph
+    may-block summary."""
     name = dataflow.canonical_call(call, aliases)
     if name is not None:
         if name in BLOCKING_CALLS:
@@ -64,91 +68,48 @@ def blocking_reason(call: ast.Call,
     return None
 
 
-def _callee_name(call: ast.Call) -> Optional[str]:
-    if isinstance(call.func, ast.Name):
-        return call.func.id
-    if isinstance(call.func, ast.Attribute):
-        return call.func.attr
-    return None
-
-
-def _helper_chains(
-        sync_fns: List[ast.FunctionDef],
-        aliases: Dict[str, str]) -> Dict[str, Tuple[List[str], int]]:
-    """fn name -> (call chain ending in the blocking reason, line of
-    the ultimate blocking call). Fixpoint over the same-module sync
-    call graph, so ``a -> b -> c -> sendall`` marks a, b AND c."""
-    chains: Dict[str, Tuple[List[str], int]] = {}
-    for fn in sync_fns:
-        for call, awaited in dataflow.own_calls(fn):
-            if awaited:
-                continue
-            reason = blocking_reason(call, aliases)
-            if reason is not None:
-                chains.setdefault(fn.name, ([reason], call.lineno))
-                break
-    changed = True
-    while changed:
-        changed = False
-        for fn in sync_fns:
-            if fn.name in chains:
-                continue
-            for call, awaited in dataflow.own_calls(fn):
-                if awaited:
-                    continue
-                callee = _callee_name(call)
-                if callee in chains and callee not in aliases:
-                    chain, line = chains[callee]
-                    chains[fn.name] = ([callee] + chain, line)
-                    changed = True
-                    break
-    return chains
-
-
-def run(mod: core.ModuleInfo) -> List[core.Violation]:
-    aliases = dataflow.alias_map(mod.tree)
-
-    sync_fns: List[ast.FunctionDef] = []
-    async_fns: List[ast.AsyncFunctionDef] = []
-    for node in ast.walk(mod.tree):
-        if isinstance(node, ast.FunctionDef):
-            sync_fns.append(node)
-        elif isinstance(node, ast.AsyncFunctionDef):
-            async_fns.append(node)
-    if not async_fns:
-        return []
-
-    chains = _helper_chains(sync_fns, aliases)
-
+def run_program(modules, graph) -> List[core.Violation]:
     out: List[core.Violation] = []
-    for afn in async_fns:
-        for call, awaited in dataflow.own_calls(afn):
-            if awaited:
+    for mod in modules:
+        aliases = graph.aliases(mod.dotted)
+        for fi in graph.funcs_in_module(mod.dotted):
+            if not fi.is_async:
                 continue
-            reason = blocking_reason(call, aliases)
-            if reason is not None:
+            for site in graph.calls[fi.qname]:
+                if site.awaited:
+                    continue
+                reason = blocking_reason(site.call, aliases)
+                if reason is not None:
+                    out.append(core.Violation(
+                        check=NAME, path=mod.path,
+                        line=site.call.lineno,
+                        col=site.call.col_offset, key=reason,
+                        message=(
+                            f'blocking call {reason!r} inside '
+                            f'`async def {fi.name}` stalls the event '
+                            f'loop (every in-flight request waits); '
+                            f'use the async API or run_in_executor')))
+                    continue
+                # Transitive: a sync callee (any module) whose
+                # may-block summary bottoms out in a blocking call.
+                # Executor targets run off-loop; an un-awaited async
+                # callee is just a coroutine object. A callee that is
+                # itself async-and-awaited reports at its own body.
+                if site.via_executor or site.callee is None:
+                    continue
+                callee = graph.funcs.get(site.callee)
+                sub = graph.blocks.get(site.callee)
+                if callee is None or callee.is_async or sub is None:
+                    continue
+                chain, inner_line = sub
+                full = [site.label] + list(chain)
                 out.append(core.Violation(
-                    check=NAME, path=mod.path, line=call.lineno,
-                    col=call.col_offset, key=reason,
+                    check=NAME, path=mod.path, line=site.call.lineno,
+                    col=site.call.col_offset, key='->'.join(full),
                     message=(
-                        f'blocking call {reason!r} inside '
-                        f'`async def {afn.name}` stalls the event '
-                        f'loop (every in-flight request waits); use '
-                        f'the async API or run_in_executor')))
-                continue
-            # Transitive: call into a same-module sync helper chain
-            # that bottoms out in a blocking call.
-            callee = _callee_name(call)
-            if callee in chains and callee not in aliases:
-                chain, inner_line = chains[callee]
-                full = [callee] + chain
-                out.append(core.Violation(
-                    check=NAME, path=mod.path, line=call.lineno,
-                    col=call.col_offset, key='->'.join(full),
-                    message=(
-                        f'`async def {afn.name}` calls sync helper '
-                        f'{callee!r} which reaches blocking '
+                        f'`async def {fi.name}` calls sync helper '
+                        f'{site.label!r} which reaches blocking '
                         f'{chain[-1]!r} via {" -> ".join(full)} '
-                        f'(line {inner_line}); the event loop stalls '
-                        f'for the duration')))
+                        f'({callee.mod.path} line {inner_line}); the '
+                        f'event loop stalls for the duration')))
     return out

@@ -1,0 +1,879 @@
+"""skylint whole-program engine: package-wide call graph + summaries.
+
+Until v14 every checker stopped at a function or one-hop boundary
+(``analysis/dataflow.py`` is explicitly intra-procedural), so a
+blocking call or an unlocked shared write hidden one helper deeper was
+invisible.  This module is the v15 escalation: ONE package-wide call
+graph, built once per analysis run, with per-function summaries
+propagated to fixpoint.  Checkers consume the summaries instead of
+re-deriving their own ad-hoc call chains.
+
+Construction (stdlib ``ast`` only, like the rest of the plane — the
+analyzer parses, never imports, the code under analysis):
+
+  * every ``def``/``async def`` in every module is indexed under a
+    stable qualified name ``<module.dotted>:<Qual.path>`` — methods
+    under their class, nested functions under their lexical parent
+    (``outer.inner``), decorator-wrapped defs under their own name
+    (decoration does not change the binding);
+  * call sites resolve through, in order: the lexical scope chain
+    (nested defs shadow outer ones), same-module top-level functions,
+    the import-alias map (module-level AND function-level imports,
+    relative imports resolved against the importing module), bound
+    ``self.<method>`` against the enclosing class and its same-module
+    bases, and finally a loose same-module by-attr-name fallback for
+    calls on untyped receivers (``leader.send(...)``) — the heuristic
+    the v2 one-hop checkers already relied on, kept behind a stoplist
+    of ubiquitous method names so ``d.get(...)`` never edges into an
+    unrelated helper;
+  * ``asyncio.to_thread(f, ...)`` / ``run_in_executor(None, f, ...)``
+    resolve to ``f`` as *executor* edges: they count for device-get
+    reachability (the work still runs once per call) but NOT for
+    event-loop blocking (shipping the blocking call to a thread is the
+    sanctioned remediation).
+
+Summaries (least fixpoints over the graph; cycles converge because
+every domain is finite and the transfer functions are monotone):
+
+  * ``blocks``    — a known-blocking call reachable through any chain
+    of same-thread calls, with the chain and the ultimate line;
+  * ``device_gets`` — ``jax.device_get`` reachable the same way
+    (executor edges included);
+  * ``locks_trans`` — every lock identity acquired by the function or
+    anything it transitively calls;
+  * ``returns_taint`` — functions whose return value carries a raw
+    ``X-Skytpu-Class`` header read that never routed through the
+    closed class registry.
+
+Lock identities are scope-stable so ordering composes across
+functions: ``self._lock`` in class ``C`` of module ``m`` is
+``m:C._lock`` in every method; a module-global ``_LOCK`` is
+``m:_LOCK``; a function-local or parameter lock stays scoped to its
+function (it cannot soundly pair with anything else).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from skypilot_tpu.analysis import async_blocking
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis import dataflow
+
+FunctionLike = dataflow.FunctionLike
+
+_EXECUTOR_TAILS = frozenset({'to_thread', 'run_in_executor'})
+
+# Ubiquitous method names the loose by-attr-name fallback must never
+# resolve: ``headers.get(...)`` or ``fut.result()`` edging into an
+# unrelated same-module helper would poison every transitive summary.
+_LOOSE_STOPLIST = frozenset({
+    'get', 'set', 'put', 'pop', 'add', 'append', 'extend', 'update',
+    'items', 'keys', 'values', 'copy', 'clear', 'remove', 'discard',
+    'join', 'split', 'strip', 'format', 'encode', 'decode', 'read',
+    'write', 'close', 'open', 'acquire', 'release', 'wait', 'notify',
+    'notify_all', 'result', 'done', 'cancel', 'submit', 'count',
+    'index', 'sort', 'setdefault', 'group', 'match', 'search',
+})
+
+
+def _must_call_ids(fn_node: ast.AST) -> Set[int]:
+    """``id()``s of Call nodes that run on EVERY execution of the
+    function — the transitive analog of host_sync_loops' direct-level
+    "unconditional only" rule.  A call is conditional when it sits
+    under an ``if`` branch, a loop body (zero iterations possible), or
+    an ``except`` handler, or when it follows a conditional early exit
+    (a ``return``/``raise`` nested under one of those): a guarded
+    fetch is the sanctioned remediation, and that sanction must not
+    evaporate just because the guard lives one call deeper.
+    Statement-level approximation (no path-sensitive CFG): ``if``
+    tests, ``while`` tests and ``for`` iterables DO evaluate; ``with``
+    bodies, ``try`` bodies and ``finally`` blocks DO run."""
+    bail: Optional[int] = None   # first conditional early exit's line
+
+    def scan_bail(body: Sequence[ast.stmt], conditional: bool) -> None:
+        nonlocal bail
+        for st in body:
+            if isinstance(st, (FunctionLike, ast.ClassDef)):
+                continue
+            if conditional and isinstance(st, (ast.Return, ast.Raise)):
+                if bail is None or st.lineno < bail:
+                    bail = st.lineno
+            if isinstance(st, ast.If):
+                scan_bail(st.body, True)
+                scan_bail(st.orelse, True)
+            elif isinstance(st, (ast.For, ast.AsyncFor, ast.While)):
+                scan_bail(st.body, True)
+                scan_bail(st.orelse, True)
+            elif isinstance(st, ast.Try):
+                scan_bail(st.body, conditional)
+                scan_bail(st.orelse, conditional)
+                scan_bail(st.finalbody, conditional)
+                for h in st.handlers:
+                    scan_bail(h.body, True)
+            elif isinstance(st, (ast.With, ast.AsyncWith)):
+                scan_bail(st.body, conditional)
+    scan_bail(getattr(fn_node, 'body', []), False)
+
+    out: Set[int] = set()
+
+    def take(expr: Optional[ast.AST]) -> None:
+        if expr is None:
+            return
+        stack: List[ast.AST] = [expr]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, (ast.Lambda,) + FunctionLike):
+                continue              # a deferred body does not run here
+            if isinstance(n, ast.Call) and \
+                    (bail is None or n.lineno < bail):
+                out.add(id(n))
+            stack.extend(ast.iter_child_nodes(n))
+
+    def visit(body: Sequence[ast.stmt]) -> None:
+        for st in body:
+            if isinstance(st, (FunctionLike, ast.ClassDef)):
+                continue                  # defining is not executing
+            if isinstance(st, ast.If):
+                take(st.test)
+                continue
+            if isinstance(st, (ast.For, ast.AsyncFor)):
+                take(st.iter)
+                continue
+            if isinstance(st, ast.While):
+                take(st.test)
+                continue
+            if isinstance(st, ast.Try):
+                visit(st.body)
+                visit(st.orelse)
+                visit(st.finalbody)
+                continue
+            if isinstance(st, (ast.With, ast.AsyncWith)):
+                for item in st.items:
+                    take(item.context_expr)
+                visit(st.body)
+                continue
+            take(st)
+    visit(getattr(fn_node, 'body', []))
+    return out
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    """One indexed function/method. ``cls`` is the immediately
+    enclosing class (for ``self.`` resolution), ``enclosing`` the
+    qname of the lexically enclosing function (for scope chains)."""
+    qname: str
+    name: str
+    mod: core.ModuleInfo
+    node: ast.AST
+    cls: Optional[str]
+    is_async: bool
+    enclosing: Optional[str]
+
+
+@dataclasses.dataclass
+class CallSite:
+    """One call in a function's own body (nested defs excluded —
+    they are their own functions). ``held`` is the tuple of lock ids
+    held at the site via enclosing ``with`` statements."""
+    call: ast.Call
+    awaited: bool
+    callee: Optional[str]        # resolved qname, or None
+    label: str                   # bare display name for chains
+    via_executor: bool
+    held: Tuple[str, ...]
+
+
+@dataclasses.dataclass
+class LockAcquire:
+    """One lock acquisition (a ``with <lock>:`` item or an explicit
+    ``<lock>.acquire()``) with the locks already held when it runs."""
+    lock: str                    # stable identity
+    label: str                   # short display name
+    node: ast.AST
+    held: Tuple[str, ...]
+    is_with: bool                # with-statements extend the held set
+
+
+class _ModIndex:
+    __slots__ = ('dotted', 'aliases', 'top_funcs', 'classes',
+                 'class_bases', 'nested', 'any_name', 'module_globals')
+
+    def __init__(self, dotted: str):
+        self.dotted = dotted
+        self.aliases: Dict[str, str] = {}
+        self.top_funcs: Dict[str, str] = {}
+        self.classes: Dict[str, Dict[str, str]] = {}
+        self.class_bases: Dict[str, List[str]] = {}
+        self.nested: Dict[str, Dict[str, str]] = {}
+        self.any_name: Dict[str, str] = {}
+        self.module_globals: Set[str] = set()
+
+
+def _all_aliases(mod: core.ModuleInfo) -> Dict[str, str]:
+    """Local name -> canonical dotted prefix, from EVERY import in the
+    module — module-level and function-level (lazy imports are the
+    control plane's sanctioned idiom, and exactly where cross-module
+    call edges hide). Relative imports resolve against the importing
+    module's own dotted path."""
+    aliases: Dict[str, str] = {}
+    for node in core.module_nodes(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                aliases[a.asname or a.name.split('.')[0]] = \
+                    a.name if a.asname else a.name.split('.')[0]
+        elif isinstance(node, ast.ImportFrom):
+            if node.level == 0:
+                base = node.module or ''
+            else:
+                parts = mod.dotted.split('.')
+                strip = node.level - (1 if mod.is_package else 0)
+                if strip > len(parts):
+                    continue
+                kept = parts[:len(parts) - strip] if strip else parts
+                base = '.'.join(kept + ([node.module]
+                                        if node.module else []))
+            for a in node.names:
+                if a.name == '*':
+                    continue
+                aliases[a.asname or a.name] = \
+                    f'{base}.{a.name}' if base else a.name
+    return aliases
+
+
+def _base_names(cls: ast.ClassDef) -> List[str]:
+    out = []
+    for b in cls.bases:
+        name = core.dotted_name(b)
+        if name:
+            out.append(name.split('.')[-1])
+    return out
+
+
+class CallGraph:
+    """The whole-program index + summaries. Build once with
+    :func:`build`; checkers read the public dicts and call
+    :meth:`resolve_call` for ad-hoc sites (loop bodies, kwargs)."""
+
+    def __init__(self) -> None:
+        self.funcs: Dict[str, FuncInfo] = {}
+        self.calls: Dict[str, List[CallSite]] = {}
+        self.acquires: Dict[str, List[LockAcquire]] = {}
+        # self.<attr> stores in each function's own body, with the
+        # locks held at the write: (attr, lineno, held) triples.
+        self.writes: Dict[str, List[Tuple[str, int,
+                                          Tuple[str, ...]]]] = {}
+        self.mod_index: Dict[str, _ModIndex] = {}
+        self._by_module: Dict[str, List[str]] = {}
+        # Summaries (filled by _summarize):
+        self.blocks: Dict[str, Tuple[Tuple[str, ...], int]] = {}
+        self.device_gets: Dict[str, Tuple[Tuple[str, ...], int]] = {}
+        self.locks_trans: Dict[str, Dict[str, str]] = {}
+        self.returns_taint: Set[str] = set()
+        self.lock_kinds: Dict[str, str] = {}    # id -> 'Lock' | 'RLock'
+        self.lock_labels: Dict[str, str] = {}
+
+    # ---------------------------------------------------------- index
+
+    def funcs_in_module(self, dotted: str) -> List[FuncInfo]:
+        return [self.funcs[q] for q in self._by_module.get(dotted, [])]
+
+    def aliases(self, dotted: str) -> Dict[str, str]:
+        idx = self.mod_index.get(dotted)
+        return idx.aliases if idx else {}
+
+    def _index_module(self, mod: core.ModuleInfo) -> None:
+        idx = _ModIndex(mod.dotted)
+        idx.aliases = _all_aliases(mod)
+        self.mod_index[mod.dotted] = idx
+        self._by_module.setdefault(mod.dotted, [])
+
+        def visit(stmts: Sequence[ast.stmt], path: List[str],
+                  cls: Optional[str], enclosing: Optional[str]) -> None:
+            for st in stmts:
+                if isinstance(st, ast.ClassDef):
+                    idx.classes.setdefault(st.name, {})
+                    idx.class_bases[st.name] = _base_names(st)
+                    visit(st.body, path + [st.name], st.name, enclosing)
+                elif isinstance(st, FunctionLike):
+                    qname = f'{mod.dotted}:' + \
+                        '.'.join(path + [st.name])
+                    fi = FuncInfo(
+                        qname=qname, name=st.name, mod=mod, node=st,
+                        cls=cls,
+                        is_async=isinstance(st, ast.AsyncFunctionDef),
+                        enclosing=enclosing)
+                    self.funcs[qname] = fi
+                    self._by_module[mod.dotted].append(qname)
+                    if enclosing is None and cls is None:
+                        idx.top_funcs.setdefault(st.name, qname)
+                    elif cls is not None:
+                        idx.classes[cls].setdefault(st.name, qname)
+                    if enclosing is not None:
+                        idx.nested.setdefault(
+                            enclosing, {}).setdefault(st.name, qname)
+                    idx.any_name.setdefault(st.name, qname)
+                    visit(st.body, path + [st.name], None, qname)
+                elif isinstance(st, ast.If):
+                    visit(st.body, path, cls, enclosing)
+                    visit(st.orelse, path, cls, enclosing)
+                elif isinstance(st, ast.Try):
+                    visit(st.body, path, cls, enclosing)
+                    for h in st.handlers:
+                        visit(h.body, path, cls, enclosing)
+                    visit(st.orelse, path, cls, enclosing)
+                    visit(st.finalbody, path, cls, enclosing)
+                elif isinstance(st, (ast.For, ast.AsyncFor, ast.While)):
+                    visit(st.body, path, cls, enclosing)
+                    visit(st.orelse, path, cls, enclosing)
+                elif isinstance(st, (ast.With, ast.AsyncWith)):
+                    visit(st.body, path, cls, enclosing)
+
+        visit(mod.tree.body, [], None, None)
+
+        # Module-global names (top-level assignments, descending into
+        # top-level if/try blocks) — lock identity needs them.
+        def globals_in(stmts: Sequence[ast.stmt]) -> None:
+            for st in stmts:
+                if isinstance(st, (ast.Assign, ast.AnnAssign,
+                                   ast.AugAssign)):
+                    targets = (st.targets if isinstance(st, ast.Assign)
+                               else [st.target])
+                    for t in targets:
+                        if isinstance(t, ast.Name):
+                            idx.module_globals.add(t.id)
+                elif isinstance(st, ast.If):
+                    globals_in(st.body)
+                    globals_in(st.orelse)
+                elif isinstance(st, ast.Try):
+                    globals_in(st.body)
+                    for h in st.handlers:
+                        globals_in(h.body)
+                    globals_in(st.orelse)
+                    globals_in(st.finalbody)
+        globals_in(mod.tree.body)
+
+    # ----------------------------------------------------- resolution
+
+    def _lexical(self, fi: Optional[FuncInfo], idx: _ModIndex,
+                 name: str) -> Optional[str]:
+        cur = fi
+        while cur is not None:
+            hit = idx.nested.get(cur.qname, {}).get(name)
+            if hit:
+                return hit
+            if cur.name == name and cur.cls is None:
+                return cur.qname          # direct recursion
+            cur = self.funcs.get(cur.enclosing) \
+                if cur.enclosing else None
+        return idx.top_funcs.get(name)
+
+    def _method(self, idx: _ModIndex, cls: str,
+                name: str) -> Optional[str]:
+        seen = set()
+        stack = [cls]
+        while stack:
+            c = stack.pop(0)
+            if c in seen:
+                continue
+            seen.add(c)
+            hit = idx.classes.get(c, {}).get(name)
+            if hit:
+                return hit
+            stack.extend(idx.class_bases.get(c, []))
+        return None
+
+    def _global(self, dotted: str) -> Optional[str]:
+        parts = dotted.split('.')
+        for cut in range(len(parts) - 1, 0, -1):
+            midx = self.mod_index.get('.'.join(parts[:cut]))
+            if midx is None:
+                continue
+            rest = parts[cut:]
+            if len(rest) == 1:
+                hit = midx.top_funcs.get(rest[0])
+                if hit:
+                    return hit
+                # Calling a class = running its __init__.
+                return midx.classes.get(rest[0], {}).get('__init__')
+            if len(rest) == 2:
+                return midx.classes.get(rest[0], {}).get(rest[1])
+            return None
+        return None
+
+    def _resolve_ref(self, expr: ast.expr, fi: Optional[FuncInfo],
+                     idx: _ModIndex
+                     ) -> Tuple[Optional[str], Optional[str]]:
+        """Resolve a callable REFERENCE (not a call) — the executor
+        trampoline's function argument. Returns (qname, label)."""
+        if isinstance(expr, ast.Name):
+            q = self._lexical(fi, idx, expr.id)
+            if q:
+                return q, expr.id
+            target = idx.aliases.get(expr.id)
+            if target:
+                return self._global(target), expr.id
+            return None, expr.id
+        if isinstance(expr, ast.Attribute):
+            if isinstance(expr.value, ast.Name) and \
+                    expr.value.id == 'self' and fi is not None and \
+                    fi.cls is not None:
+                return self._method(idx, fi.cls, expr.attr), expr.attr
+            dotted = core.dotted_name(expr)
+            if dotted:
+                head, _, rest = dotted.partition('.')
+                target = idx.aliases.get(head)
+                if target and rest:
+                    return self._global(f'{target}.{rest}'), expr.attr
+            return None, expr.attr
+        return None, None
+
+    def resolve_call(self, call: ast.Call, fi: Optional[FuncInfo],
+                     dotted_module: str
+                     ) -> Tuple[Optional[str], str, bool]:
+        """(callee qname or None, display label, via_executor) for a
+        call expression evaluated inside ``fi`` (None = module level)
+        of the module ``dotted_module``."""
+        idx = self.mod_index.get(dotted_module)
+        if idx is None:
+            return None, '', False
+        func = call.func
+        dotted = core.dotted_name(func)
+        tail = dotted.split('.')[-1] if dotted else (
+            func.attr if isinstance(func, ast.Attribute) else '')
+        if tail in _EXECUTOR_TAILS:
+            args = list(call.args)
+            if tail == 'run_in_executor':
+                args = args[1:]               # skip the executor arg
+            if args:
+                q, label = self._resolve_ref(args[0], fi, idx)
+                return q, label or tail, True
+            return None, tail, True
+        if isinstance(func, ast.Name):
+            q = self._lexical(fi, idx, func.id)
+            if q:
+                return q, func.id, False
+            target = idx.aliases.get(func.id)
+            if target:
+                return self._global(target), func.id, False
+            return None, func.id, False
+        if isinstance(func, ast.Attribute):
+            if isinstance(func.value, ast.Name) and \
+                    func.value.id == 'self' and fi is not None and \
+                    fi.cls is not None:
+                q = self._method(idx, fi.cls, func.attr)
+                if q:
+                    return q, func.attr, False
+            if dotted:
+                head, _, rest = dotted.partition('.')
+                target = idx.aliases.get(head)
+                if target and rest:
+                    return (self._global(f'{target}.{rest}'),
+                            func.attr, False)
+                if target:
+                    return None, func.attr, False
+            # Loose same-module fallback for untyped receivers — the
+            # v2 heuristic, behind the stoplist.
+            if func.attr not in _LOOSE_STOPLIST:
+                q = idx.any_name.get(func.attr)
+                if q:
+                    return q, func.attr, False
+            return None, func.attr, False
+        return None, '', False
+
+    # ----------------------------------------------------- extraction
+
+    def _lock_of(self, expr: ast.expr, fi: FuncInfo,
+                 idx: _ModIndex) -> Optional[Tuple[str, str]]:
+        """(identity, short label) when ``expr`` names a
+        threading-style lock object. Calls are excluded by design
+        (file-lock factories like ``locks.cluster_status_lock(...)``
+        are coarse on purpose). Labels are the bare source name (the
+        v2 thread-discipline key format); identities carry the full
+        scope so ordering composes across functions."""
+        if isinstance(expr, ast.Name):
+            if 'lock' not in expr.id.lower():
+                return None
+            if expr.id in idx.module_globals:
+                return f'{idx.dotted}:{expr.id}', expr.id
+            return f'{fi.qname}:{expr.id}', expr.id
+        if isinstance(expr, ast.Attribute):
+            if 'lock' not in expr.attr.lower():
+                return None
+            base = expr.value
+            if isinstance(base, ast.Name):
+                if base.id == 'self' and fi.cls is not None:
+                    return (f'{idx.dotted}:{fi.cls}.{expr.attr}',
+                            expr.attr)
+                target = idx.aliases.get(base.id)
+                if target:
+                    return f'{target}:{expr.attr}', expr.attr
+            # Unknown receiver: function-scoped (cannot soundly pair).
+            return f'{fi.qname}:.{expr.attr}', expr.attr
+        return None
+
+    def _extract(self, fi: FuncInfo) -> None:
+        idx = self.mod_index[fi.mod.dotted]
+        calls: List[CallSite] = []
+        acquires: List[LockAcquire] = []
+        writes: List[Tuple[str, int, Tuple[str, ...]]] = []
+
+        def note_writes(st: ast.stmt, held: Tuple[str, ...]) -> None:
+            if not isinstance(st, (ast.Assign, ast.AnnAssign,
+                                   ast.AugAssign)):
+                return
+            targets = (st.targets if isinstance(st, ast.Assign)
+                       else [st.target])
+            for t in targets:
+                if isinstance(t, ast.Tuple):
+                    elts = t.elts
+                else:
+                    elts = [t]
+                for e in elts:
+                    if isinstance(e, ast.Attribute) and \
+                            isinstance(e.value, ast.Name) and \
+                            e.value.id == 'self':
+                        writes.append((e.attr, st.lineno, held))
+
+        def visit_expr(node: ast.AST, awaited: bool,
+                       held: Tuple[str, ...]) -> None:
+            """Record every Call in the expression tree rooted at
+            ``node`` (which may itself be a Call), tagging the direct
+            operand of an ``await`` as awaited."""
+            if isinstance(node, dataflow.ScopeBoundary):
+                return
+            if isinstance(node, ast.Await):
+                visit_expr(node.value, True, held)
+                return
+            if isinstance(node, ast.Call):
+                q, label, via = self.resolve_call(
+                    node, fi, fi.mod.dotted)
+                calls.append(CallSite(
+                    call=node, awaited=awaited, callee=q,
+                    label=label, via_executor=via, held=held))
+                if isinstance(node.func, ast.Attribute) and \
+                        node.func.attr == 'acquire':
+                    lk = self._lock_of(node.func.value, fi, idx)
+                    if lk:
+                        acquires.append(LockAcquire(
+                            lock=lk[0], label=lk[1], node=node,
+                            held=held, is_with=False))
+            for child in ast.iter_child_nodes(node):
+                visit_expr(child, False, held)
+
+        def walk(stmts: Sequence[ast.stmt],
+                 held: Tuple[str, ...]) -> None:
+            for st in stmts:
+                if isinstance(st, FunctionLike):
+                    # Decorators/defaults execute here, in this scope.
+                    for dec in st.decorator_list:
+                        visit_expr(dec, False, held)
+                    continue
+                if isinstance(st, ast.ClassDef):
+                    continue
+                if isinstance(st, (ast.With, ast.AsyncWith)):
+                    new_held = list(held)
+                    for item in st.items:
+                        visit_expr(item.context_expr, False,
+                                   tuple(new_held))
+                        lk = self._lock_of(item.context_expr, fi, idx)
+                        if lk:
+                            acquires.append(LockAcquire(
+                                lock=lk[0], label=lk[1],
+                                node=item.context_expr,
+                                held=tuple(new_held), is_with=True))
+                            new_held.append(lk[0])
+                    walk(st.body, tuple(new_held))
+                elif isinstance(st, ast.Try):
+                    walk(st.body, held)
+                    for h in st.handlers:
+                        walk(h.body, held)
+                    walk(st.orelse, held)
+                    walk(st.finalbody, held)
+                elif isinstance(st, ast.If):
+                    visit_expr(st.test, False, held)
+                    walk(st.body, held)
+                    walk(st.orelse, held)
+                elif isinstance(st, (ast.For, ast.AsyncFor)):
+                    visit_expr(st.iter, False, held)
+                    walk(st.body, held)
+                    walk(st.orelse, held)
+                elif isinstance(st, ast.While):
+                    visit_expr(st.test, False, held)
+                    walk(st.body, held)
+                    walk(st.orelse, held)
+                else:
+                    note_writes(st, held)
+                    visit_expr(st, False, held)
+
+        walk(fi.node.body, ())
+        self.calls[fi.qname] = calls
+        self.acquires[fi.qname] = acquires
+        self.writes[fi.qname] = writes
+
+    def _collect_lock_kinds(self, mod: core.ModuleInfo) -> None:
+        """``<target> = threading.Lock()`` / ``RLock()`` constructor
+        sites, keyed by the same identity scheme as acquisitions —
+        the reacquire rule only fires on KNOWN non-reentrant locks."""
+        idx = self.mod_index[mod.dotted]
+
+        def record(target: ast.expr, kind: str,
+                   cls: Optional[str]) -> None:
+            ident = None
+            label = None
+            if isinstance(target, ast.Name):
+                ident = f'{mod.dotted}:{target.id}'
+                label = target.id
+            elif isinstance(target, ast.Attribute) and \
+                    isinstance(target.value, ast.Name) and \
+                    target.value.id == 'self' and cls is not None:
+                ident = f'{mod.dotted}:{cls}.{target.attr}'
+                label = target.attr
+            if ident:
+                self.lock_kinds[ident] = kind
+                self.lock_labels.setdefault(ident, label)
+
+        def visit(stmts: Sequence[ast.stmt], cls: Optional[str],
+                  in_func: bool) -> None:
+            for st in stmts:
+                if isinstance(st, ast.ClassDef):
+                    visit(st.body, st.name, in_func)
+                elif isinstance(st, FunctionLike):
+                    visit(st.body, cls, True)
+                elif isinstance(st, (ast.Assign, ast.AnnAssign)):
+                    value = st.value
+                    if not isinstance(value, ast.Call):
+                        continue
+                    name = dataflow.canonical_call(
+                        value, idx.aliases) or ''
+                    if name not in ('threading.Lock',
+                                    'threading.RLock'):
+                        continue
+                    kind = name.split('.')[-1]
+                    targets = (st.targets
+                               if isinstance(st, ast.Assign)
+                               else [st.target])
+                    for t in targets:
+                        record(t, kind, cls)
+                elif isinstance(st, (ast.If, ast.Try, ast.For,
+                                     ast.AsyncFor, ast.While, ast.With,
+                                     ast.AsyncWith)):
+                    for field in ('body', 'orelse', 'finalbody'):
+                        visit(getattr(st, field, []) or [], cls,
+                              in_func)
+                    for h in getattr(st, 'handlers', []) or []:
+                        visit(h.body, cls, in_func)
+        visit(mod.tree.body, None, False)
+
+    # ------------------------------------------------------ summaries
+
+    def _summarize(self) -> None:
+        order = sorted(self.funcs)
+
+        # ---- blocking (event-loop / under-lock stall) fixpoint.
+        for q in order:
+            fi = self.funcs[q]
+            aliases = self.mod_index[fi.mod.dotted].aliases
+            for site in self.calls[q]:
+                if site.awaited or site.via_executor:
+                    continue
+                reason = async_blocking.blocking_reason(
+                    site.call, aliases)
+                if reason is not None:
+                    self.blocks[q] = ((reason,), site.call.lineno)
+                    break
+        changed = True
+        while changed:
+            changed = False
+            for q in order:
+                if q in self.blocks:
+                    continue
+                for site in self.calls[q]:
+                    if site.via_executor or site.callee is None:
+                        continue
+                    callee = self.funcs.get(site.callee)
+                    sub = self.blocks.get(site.callee)
+                    if callee is None or sub is None:
+                        continue
+                    # A sync callee runs (and blocks) wherever it is
+                    # called; an async callee only stalls the caller
+                    # when awaited (un-awaited it is just a coroutine).
+                    if callee.is_async and not site.awaited:
+                        continue
+                    self.blocks[q] = ((site.label,) + sub[0], sub[1])
+                    changed = True
+                    break
+
+        # ---- jax.device_get reachability (executor edges count: the
+        # transfer still happens once per call). Unlike ``blocks``
+        # (a may-analysis: sometimes-blocking is still a bug), this
+        # summary only propagates through calls that execute on EVERY
+        # run of the caller — host_sync_loops' direct-level rule is
+        # "unconditional only; a guarded fetch is the remediation",
+        # and that sanction must survive the guard moving one call
+        # deeper (e.g. a speculative-verify helper whose device_get
+        # sits behind data-dependent early returns is a SEMANTIC
+        # sync, not an accidental per-iteration stall).
+        must_cache: Dict[str, Set[int]] = {}
+
+        def must(q: str) -> Set[int]:
+            # Lazy: device_get chains touch a handful of functions;
+            # walking every body for must-sets upfront would cost
+            # seconds against the CI wall-clock budget.
+            got = must_cache.get(q)
+            if got is None:
+                got = must_cache[q] = _must_call_ids(self.funcs[q].node)
+            return got
+
+        for q in order:
+            fi = self.funcs[q]
+            aliases = self.mod_index[fi.mod.dotted].aliases
+            for site in self.calls[q]:
+                name = dataflow.canonical_call(site.call, aliases)
+                if name == 'jax.device_get' and \
+                        id(site.call) in must(q):
+                    self.device_gets[q] = (('jax.device_get',),
+                                           site.call.lineno)
+                    break
+        changed = True
+        while changed:
+            changed = False
+            for q in order:
+                if q in self.device_gets:
+                    continue
+                for site in self.calls[q]:
+                    if site.callee is None:
+                        continue
+                    sub = self.device_gets.get(site.callee)
+                    if sub is None:
+                        continue
+                    if id(site.call) not in must(q):
+                        continue
+                    self.device_gets[q] = (
+                        (site.label,) + sub[0], sub[1])
+                    changed = True
+                    break
+
+        # ---- transitive lock sets (monotone union; executor edges
+        # count — a to_thread'ed helper acquires its locks on a REAL
+        # other thread, which is exactly when ordering matters).
+        for q in order:
+            self.locks_trans[q] = {
+                a.lock: a.label for a in self.acquires[q]}
+            for a in self.acquires[q]:
+                self.lock_labels.setdefault(a.lock, a.label)
+        changed = True
+        while changed:
+            changed = False
+            for q in order:
+                mine = self.locks_trans[q]
+                for site in self.calls[q]:
+                    if site.callee is None:
+                        continue
+                    for ident, label in self.locks_trans.get(
+                            site.callee, {}).items():
+                        if ident not in mine:
+                            mine[ident] = label
+                            changed = True
+
+        # ---- raw class-header taint carried through return values.
+        from skypilot_tpu.analysis import metric_discipline as md
+
+        def raw_locals(fi: FuncInfo) -> Set[str]:
+            out: Set[str] = set()
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Assign) and \
+                        md._mentions_class_header(node.value) and \
+                        not md._through_class_registry(node.value):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            out.add(t.id)
+            return out
+
+        def returns_of(fi: FuncInfo) -> List[ast.expr]:
+            out = []
+
+            def visit(node: ast.AST) -> None:
+                for child in ast.iter_child_nodes(node):
+                    if isinstance(child, dataflow.ScopeBoundary):
+                        continue
+                    if isinstance(child, ast.Return) and \
+                            child.value is not None:
+                        out.append(child.value)
+                    visit(child)
+            visit(fi.node)
+            return out
+
+        mod_mentions: Dict[str, bool] = {}
+
+        def mentions_header(mod: core.ModuleInfo) -> bool:
+            # Module-level gate: raw_locals walks every function body
+            # looking for a header string almost no module contains —
+            # one cached scan of the (already memoized) node list per
+            # module short-circuits all of that.
+            got = mod_mentions.get(mod.dotted)
+            if got is None:
+                got = any(md._mentions_class_header(n)
+                          for n in core.module_nodes(mod.tree)
+                          if isinstance(n, (ast.Constant,
+                                            ast.Attribute)))
+                mod_mentions[mod.dotted] = got
+            return got
+
+        base_rets: Dict[str, List[ast.expr]] = {}
+        for q in order:
+            fi = self.funcs[q]
+            rets = returns_of(fi)
+            if not rets:
+                continue
+            base_rets[q] = rets
+            if not mentions_header(fi.mod):
+                continue       # cross-module propagation still runs
+            tainted_names = raw_locals(fi)
+            for r in rets:
+                if md._through_class_registry(r):
+                    continue
+                if md._mentions_class_header(r) or any(
+                        isinstance(sub, ast.Name) and
+                        sub.id in tainted_names
+                        for sub in ast.walk(r)):
+                    self.returns_taint.add(q)
+                    break
+        changed = True
+        while changed:
+            changed = False
+            for q, rets in base_rets.items():
+                if q in self.returns_taint:
+                    continue
+                fi = self.funcs[q]
+                for r in rets:
+                    hit = False
+                    for sub in ast.walk(r):
+                        if not isinstance(sub, ast.Call):
+                            continue
+                        callee, _, _ = self.resolve_call(
+                            sub, fi, fi.mod.dotted)
+                        if callee in self.returns_taint:
+                            hit = True
+                            break
+                    if hit:
+                        self.returns_taint.add(q)
+                        changed = True
+                        break
+
+
+def build(modules: Sequence[core.ModuleInfo]) -> CallGraph:
+    """Index every module, extract call/lock events, run the summary
+    fixpoints. One call per analysis run — program checkers share the
+    result."""
+    graph = CallGraph()
+    for mod in modules:
+        graph._index_module(mod)
+    for mod in modules:
+        graph._collect_lock_kinds(mod)
+    for q in sorted(graph.funcs):
+        graph._extract(graph.funcs[q])
+    graph._summarize()
+    return graph

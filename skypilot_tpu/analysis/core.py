@@ -17,6 +17,7 @@ from __future__ import annotations
 import ast
 import dataclasses
 import os
+import re
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 PACKAGE = 'skypilot_tpu'
@@ -46,8 +47,17 @@ PACKAGE = 'skypilot_tpu'
 # the contiguous paged-cache view (gather_view): the hot
 # step/verify/chunk programs index pages in place
 # (ops/paged_attention.py), and only *_gather-named baseline programs
-# may still gather.
-REPORT_VERSION = 14
+# may still gather; v15: the whole-program engine — a package-wide
+# call graph (analysis/callgraph.py) with per-function summaries
+# propagated to fixpoint; async-blocking / blocking-under-lock /
+# host-sync-loop / metric class-label taint go fully transitive and
+# cross-module, plus two new checkers: lock-ordering (inconsistent
+# lock-acquisition orders reachable across functions, non-reentrant
+# reacquire, attrs written both under and outside their lock) and
+# jit-boundary (jit created in loop bodies, fresh containers /
+# unhashable static args at jitted call sites, donated buffers read
+# after the donating call).
+REPORT_VERSION = 15
 
 
 @dataclasses.dataclass
@@ -163,6 +173,22 @@ def module_level_imports(
     return out
 
 
+def module_nodes(tree: ast.AST) -> List[ast.AST]:
+    """Preorder list of every node in ``tree``, memoized ON the tree.
+
+    ~18 checkers each re-walk every module tree (some several times
+    per module); ``ast.walk``'s generator + deque costs seconds of
+    the CI wall-clock budget across a 200-file package. One flat
+    list per tree amortizes that to a single walk. Only sound for
+    trees that are never mutated after parse — which skylint
+    guarantees (it parses, analyzes, and never transforms)."""
+    cached = getattr(tree, '_skylint_nodes', None)
+    if cached is None:
+        cached = list(ast.walk(tree))
+        tree._skylint_nodes = cached       # type: ignore[attr-defined]
+    return cached
+
+
 def dotted_name(node: ast.expr) -> Optional[str]:
     """``a.b.c`` for a Name/Attribute chain, else None."""
     parts: List[str] = []
@@ -180,13 +206,45 @@ def dotted_name(node: ast.expr) -> Optional[str]:
 def load_allowlist(path: str) -> List[str]:
     """Allowlist file: one ``check:path:key`` ident per line; ``#``
     comments and blank lines ignored."""
-    entries: List[str] = []
+    return [ident for ident, _ in load_allowlist_entries(path)]
+
+
+_EXPIRES_RE = re.compile(r'expires:\s*(\S+)')
+_DATE_RE = re.compile(r'^\d{4}-\d{2}-\d{2}$')
+
+
+def load_allowlist_entries(
+        path: str) -> List[Tuple[str, Optional[str]]]:
+    """(ident, expires) pairs. The optional expiry rides in the
+    entry's trailing comment — ``check:path:key  # expires:
+    2026-09-01 <why>`` — so a grandfathered finding carries its own
+    deadline instead of fossilizing."""
+    entries: List[Tuple[str, Optional[str]]] = []
     with open(path, 'r', encoding='utf-8') as f:
         for raw in f:
-            line = raw.split('#', 1)[0].strip()
-            if line:
-                entries.append(line)
+            ident, _, comment = raw.partition('#')
+            ident = ident.strip()
+            if not ident:
+                continue
+            m = _EXPIRES_RE.search(comment)
+            entries.append((ident, m.group(1) if m else None))
     return entries
+
+
+def expired_allowlist_entries(
+        entries: Sequence[Tuple[str, Optional[str]]],
+        today: str) -> List[Tuple[str, str]]:
+    """Entries whose ``expires:`` date is on/before ``today``
+    (``YYYY-MM-DD``). A malformed date counts as expired — a deadline
+    that cannot be read must fail loudly, not silently never fire.
+    ISO dates compare correctly as strings; no datetime needed."""
+    out: List[Tuple[str, str]] = []
+    for ident, expires in entries:
+        if expires is None:
+            continue
+        if not _DATE_RE.match(expires) or expires <= today:
+            out.append((ident, expires))
+    return out
 
 
 def dump_allowlist(entries: Sequence[str]) -> str:
@@ -221,14 +279,15 @@ def run_analysis(root: str,
     from skypilot_tpu.analysis import checkers as checkers_lib
     selected = checkers_lib.resolve(checks)
 
-    modules: List[ModuleInfo] = []
+    all_modules: List[ModuleInfo] = []
     for path in iter_py_files(root):
         info = module_info(root, path)
         if info is not None:
-            modules.append(info)
+            all_modules.append(info)
+    modules = all_modules
     if paths is not None:
         wanted = {p.replace(os.sep, '/') for p in paths}
-        modules = [m for m in modules if m.path in wanted]
+        modules = [m for m in all_modules if m.path in wanted]
 
     # Scope the allowlist to what this run can actually see (ident
     # format: check:path:key). An entry naming a known-but-unselected
@@ -252,15 +311,35 @@ def run_analysis(root: str,
 
     violations: List[Violation] = []
     seen = set()
-    for name, fn in selected:
-        for mod in modules:
-            for v in fn(mod):
-                # Dedup: e.g. a nested jitted fn inside a jitted fn
-                # reports its hazards once, not per enclosing scope.
-                k = (v.check, v.path, v.line, v.col, v.key)
-                if k not in seen:
-                    seen.add(k)
-                    violations.append(v)
+
+    def add(v: Violation) -> None:
+        # Dedup: e.g. a nested jitted fn inside a jitted fn reports
+        # its hazards once, not per enclosing scope.
+        k = (v.check, v.path, v.line, v.col, v.key)
+        if k not in seen:
+            seen.add(k)
+            violations.append(v)
+
+    graph = None
+    for name, chk in selected:
+        run_mod = getattr(chk, 'run', None)
+        if run_mod is not None:
+            for mod in modules:
+                for v in run_mod(mod):
+                    add(v)
+        run_prog = getattr(chk, 'run_program', None)
+        if run_prog is not None:
+            if graph is None:
+                # Built once over the FULL package (not the --changed
+                # subset): a cross-module chain is invisible from a
+                # partial module list. Findings are filtered back down
+                # to the scanned paths below, so partial runs stay
+                # partial in what they REPORT, not in what they see.
+                from skypilot_tpu.analysis import callgraph
+                graph = callgraph.build(all_modules)
+            for v in run_prog(all_modules, graph):
+                if v.path in scanned:
+                    add(v)
     violations.sort(key=lambda v: (v.path, v.line, v.check))
 
     allowset = set(allowlist)

@@ -136,9 +136,14 @@ def must_forward(cfg: CFG,
 
 
 def may_forward(cfg: CFG,
-                gen: Callable[[Node], bool]) -> Dict[int, bool]:
+                gen: Callable[[Node], bool],
+                kill: Optional[Callable[[Node], bool]] = None,
+                ) -> Dict[int, bool]:
     """``result[id(node)]`` — the fact holds BEFORE ``node`` on some
-    path from entry. Least fixpoint."""
+    path from entry. Least fixpoint. A node that both gens and kills
+    (``cache = step(params, cache)`` — donate then rebind) kills: the
+    fact does not survive past it."""
+    kill = kill or (lambda n: False)
     out = {id(n): False for n in cfg.nodes}
     inn = {id(n): False for n in cfg.nodes}
     changed = True
@@ -146,7 +151,7 @@ def may_forward(cfg: CFG,
         changed = False
         for n in cfg.nodes:
             new_in = any(out[id(p)] for p in n.preds)
-            new_out = gen(n) or new_in
+            new_out = (gen(n) or new_in) and not kill(n)
             if new_in != inn[id(n)] or new_out != out[id(n)]:
                 inn[id(n)] = new_in
                 out[id(n)] = new_out
@@ -241,10 +246,25 @@ def node_calls(stmt: ast.stmt) -> List[ast.Call]:
     return out
 
 
+def cached_walk(tree: ast.AST) -> List[ast.AST]:
+    """Preorder node list memoized ON the tree (same cache attribute
+    as ``core.module_nodes`` — dataflow stays stdlib-only, so the
+    five lines are duplicated rather than imported). Sound because
+    skylint never mutates a parsed tree."""
+    cached = getattr(tree, '_skylint_nodes', None)
+    if cached is None:
+        cached = list(ast.walk(tree))
+        tree._skylint_nodes = cached       # type: ignore[attr-defined]
+    return cached
+
+
 def nodes_with_enclosing_function(
         tree: ast.Module) -> List[Tuple[ast.AST, str]]:
     """Every AST node paired with the name of its nearest enclosing
-    function ('<module>' at module level)."""
+    function ('<module>' at module level). Memoized on the tree."""
+    cached = getattr(tree, '_skylint_enclosing', None)
+    if cached is not None:
+        return cached
     out: List[Tuple[ast.AST, str]] = []
 
     def visit(node: ast.AST, fn: str) -> None:
@@ -254,19 +274,24 @@ def nodes_with_enclosing_function(
             visit(child, nfn)
 
     visit(tree, '<module>')
+    tree._skylint_enclosing = out          # type: ignore[attr-defined]
     return out
 
 
 def docstring_constants(tree: ast.Module) -> set:
     """id()s of Constant nodes that are docstrings (the conventional
     first-statement string of a module/class/function) — SQL-looking
-    prose in a docstring is not SQL."""
+    prose in a docstring is not SQL. Memoized on the tree."""
+    cached = getattr(tree, '_skylint_docstrings', None)
+    if cached is not None:
+        return cached
     out = set()
-    for node in ast.walk(tree):
+    for node in cached_walk(tree):
         if isinstance(node, (ast.Module, ast.ClassDef) + FunctionLike):
             body = node.body
             if body and isinstance(body[0], ast.Expr) and \
                     isinstance(body[0].value, ast.Constant) and \
                     isinstance(body[0].value.value, str):
                 out.add(id(body[0].value))
+    tree._skylint_docstrings = out         # type: ignore[attr-defined]
     return out

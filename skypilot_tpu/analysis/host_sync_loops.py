@@ -22,50 +22,29 @@ indefensible one):
 - **Unconditional only.** Calls nested under an ``if`` inside the loop
   body are skipped — a guarded fetch (e.g. only when a client asked
   for logprobs) is the remediation, not the bug.
-- **One helper hop.** The loop body calling a same-module function or
-  method whose body contains ``jax.device_get`` is flagged too, with
-  the chain in the key — including through ``asyncio.to_thread(f,
-  ...)`` / ``run_in_executor(None, f, ...)``, the idiom event-loop
-  schedulers use for device work (the pre-pipeline batch loop's exact
-  shape).
+- **Transitive helpers.** The loop body calling a function or method
+  whose body reaches ``jax.device_get`` through ANY chain of calls —
+  in any module — is flagged too, with the chain in the key
+  (whole-program since skylint v15; v4–v14 followed one same-module
+  hop). ``asyncio.to_thread(f, ...)`` / ``run_in_executor(None, f,
+  ...)`` count as calling ``f``: the idiom event-loop schedulers use
+  for device work still transfers once per iteration.
 """
 from __future__ import annotations
 
 import ast
-from typing import Dict, List, Optional, Set
+from typing import List, Set
 
 from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis import dataflow
 
 NAME = 'host-sync-loop'
 
 _SCOPED_UNITS = frozenset({'serve', 'models'})
-_EXECUTOR_TAILS = frozenset({'to_thread', 'run_in_executor'})
 
 
 def _is_device_get(node: ast.Call) -> bool:
     return (core.dotted_name(node.func) or '') == 'jax.device_get'
-
-
-def _module_fns(tree: ast.Module) -> Dict[str, ast.AST]:
-    """Every function/method defined in the module, by bare name
-    (methods resolve via ``self.<name>(...)`` / ``<name>(...)`` call
-    sites; a name collision keeps the first definition — good enough
-    for a one-hop heuristic)."""
-    fns: Dict[str, ast.AST] = {}
-    for node in ast.walk(tree):
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            fns.setdefault(node.name, node)
-    return fns
-
-
-def _fns_with_device_get(fns: Dict[str, ast.AST]) -> Set[str]:
-    out: Set[str] = set()
-    for name, fn in fns.items():
-        for node in ast.walk(fn):
-            if isinstance(node, ast.Call) and _is_device_get(node):
-                out.add(name)
-                break
-    return out
 
 
 def _assigned_names(body: List[ast.stmt]) -> Set[str]:
@@ -167,65 +146,65 @@ def _calls_in(node: ast.AST) -> List[ast.Call]:
     return out
 
 
-def _callee_name(call: ast.Call) -> Optional[str]:
-    """The same-module function a loop-body call invokes: ``f(...)``,
-    ``self.f(...)``, and the executor idioms ``asyncio.to_thread(f,
-    ...)`` / ``loop.run_in_executor(None, f, ...)`` (the function is
-    an ARGUMENT there, but it runs once per iteration all the same)."""
-    func = call.func
-    dotted = core.dotted_name(func) or ''
-    tail = dotted.split('.')[-1] if dotted else ''
-    if tail in _EXECUTOR_TAILS:
-        args = call.args
-        if tail == 'run_in_executor':
-            args = args[1:]                   # skip the executor arg
-        if args:
-            target = args[0]
-            if isinstance(target, ast.Name):
-                return target.id
-            if isinstance(target, ast.Attribute):
-                return target.attr
-        return None
-    if isinstance(func, ast.Name):
-        return func.id
-    if isinstance(func, ast.Attribute) and \
-            isinstance(func.value, ast.Name) and func.value.id == 'self':
-        return func.attr
-    return None
+def _own_loops(root: ast.AST) -> List[ast.stmt]:
+    """Loop statements in ``root``'s own body, not descending into
+    nested function/lambda scopes (their loops belong to them)."""
+    out: List[ast.stmt] = []
 
-
-def run(mod: core.ModuleInfo) -> List[core.Violation]:
-    if mod.unit not in _SCOPED_UNITS:
-        return []
-    fns = _module_fns(mod.tree)
-    syncing = _fns_with_device_get(fns)
-    out: List[core.Violation] = []
-    seen = set()
-    for loop in ast.walk(mod.tree):
-        if not isinstance(loop, (ast.While, ast.For, ast.AsyncFor)):
-            continue
-        if not _loop_is_data_independent(loop):
-            continue
-        for call in _unconditional_calls(loop.body):
-            key = None
-            if _is_device_get(call):
-                key = 'jax.device_get'
-                why = ('blocks on a device→host transfer every '
-                       'iteration of a data-independent loop')
-            else:
-                callee = _callee_name(call)
-                if callee in syncing:
-                    key = f'{callee}->jax.device_get'
-                    why = (f'calls {callee!r} (which device_gets) every '
-                           f'iteration of a data-independent loop')
-            if key is None or (key, call.lineno) in seen:
+    def visit(node: ast.AST) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, dataflow.ScopeBoundary):
                 continue
-            seen.add((key, call.lineno))
-            out.append(core.Violation(
-                check=NAME, path=mod.path, line=call.lineno,
-                col=call.col_offset, key=key,
-                message=(f'{key!r} in a loop body: {why} — split the '
-                         f'step into dispatch/collect halves and '
-                         f'pipeline them (docs/ENGINE.md), or make the '
-                         f'transfer conditional/data-dependent')))
+            if isinstance(child, (ast.While, ast.For, ast.AsyncFor)):
+                out.append(child)
+            visit(child)
+    visit(root)
+    return out
+
+
+def run_program(modules, graph) -> List[core.Violation]:
+    out: List[core.Violation] = []
+    for mod in modules:
+        if mod.unit not in _SCOPED_UNITS:
+            continue
+        seen = set()
+        # (loop, resolution context) pairs: loops inside functions
+        # resolve with their function's scope; module/class-level
+        # loops resolve with no self context.
+        scoped = [(loop, fi)
+                  for fi in graph.funcs_in_module(mod.dotted)
+                  for loop in _own_loops(fi.node)]
+        scoped += [(loop, None) for loop in _own_loops(mod.tree)]
+        for loop, fi in scoped:
+            if not _loop_is_data_independent(loop):
+                continue
+            for call in _unconditional_calls(loop.body):
+                key = None
+                if _is_device_get(call):
+                    key = 'jax.device_get'
+                    why = ('blocks on a device→host transfer every '
+                           'iteration of a data-independent loop')
+                else:
+                    callee, label, _ = graph.resolve_call(
+                        call, fi, mod.dotted)
+                    sub = graph.device_gets.get(callee)
+                    if sub is not None:
+                        chain = [label] + list(sub[0])
+                        key = '->'.join(chain)
+                        why = (f'calls {label!r} (which reaches '
+                               f'jax.device_get via '
+                               f'{" -> ".join(chain)}) every '
+                               f'iteration of a data-independent '
+                               f'loop')
+                if key is None or (key, call.lineno) in seen:
+                    continue
+                seen.add((key, call.lineno))
+                out.append(core.Violation(
+                    check=NAME, path=mod.path, line=call.lineno,
+                    col=call.col_offset, key=key,
+                    message=(f'{key!r} in a loop body: {why} — split '
+                             f'the step into dispatch/collect halves '
+                             f'and pipeline them (docs/ENGINE.md), or '
+                             f'make the transfer conditional/'
+                             f'data-dependent')))
     return out

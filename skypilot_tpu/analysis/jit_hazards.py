@@ -59,9 +59,14 @@ def _decorator_is_jit(dec: ast.expr) -> bool:
 
 def _wrapped_fn_names(tree: ast.Module) -> Set[str]:
     """Function names passed to a jit wrapper anywhere in the module:
-    `step = jax.jit(_step)`, `self._fn = jax.jit(self._fn_impl)`."""
+    `step = jax.jit(_step)`, `self._fn = jax.jit(self._fn_impl)`.
+    Memoized on the tree (several checkers ask per module, and the
+    scan is a full walk)."""
+    cached = getattr(tree, '_skylint_wrapped_fn_names', None)
+    if cached is not None:
+        return cached
     names: Set[str] = set()
-    for node in ast.walk(tree):
+    for node in core.module_nodes(tree):
         if not isinstance(node, ast.Call) or not node.args:
             continue
         is_wrap = _is_jit_expr(node.func)
@@ -75,6 +80,7 @@ def _wrapped_fn_names(tree: ast.Module) -> Set[str]:
             names.add(arg.id)
         elif isinstance(arg, ast.Attribute):
             names.add(arg.attr)
+    tree._skylint_wrapped_fn_names = names
     return names
 
 
@@ -135,7 +141,7 @@ def _hazards_in(fn: ast.AST, mod: core.ModuleInfo,
 def run(mod: core.ModuleInfo) -> List[core.Violation]:
     wrapped = _wrapped_fn_names(mod.tree)
     out: List[core.Violation] = []
-    for node in ast.walk(mod.tree):
+    for node in core.module_nodes(mod.tree):
         if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
         jitted = any(_decorator_is_jit(d) for d in node.decorator_list)

@@ -28,7 +28,10 @@ months later:
      constant — or a variable assigned from one — appearing as a
      label kwarg without routing through the registry is flagged:
      that is exactly how an unbounded client string becomes an
-     unbounded label set.
+     unbounded label set. Whole-program since skylint v15: a call
+     into a helper — any module — whose return value carries the raw
+     header (the call-graph ``returns_taint`` summary) taints the
+     expression the same way a literal read does.
 
   5. one exposition parser — string literals that smell of AD-HOC
      Prometheus-text regexing (``_bucket{`` / ``{le="`` fragments used
@@ -66,7 +69,7 @@ _NAME_RE = re.compile(r'^skytpu_[a-z0-9]+(_[a-z0-9]+)+$')
 
 
 def _imports_observe(tree: ast.Module) -> bool:
-    for node in ast.walk(tree):
+    for node in core.module_nodes(tree):
         if isinstance(node, ast.Import):
             if any(a.name.startswith('skypilot_tpu.observe')
                    for a in node.names):
@@ -161,16 +164,38 @@ def _through_class_registry(node: ast.AST) -> bool:
     return False
 
 
-def _tainted_class_names(tree: ast.Module) -> set:
+def _call_resolutions(mod: core.ModuleInfo, graph) -> dict:
+    """id(Call node) -> resolved callee qname, over every call site
+    the call-graph extracted from this module's functions."""
+    sites = {}
+    for fi in graph.funcs_in_module(mod.dotted):
+        for site in graph.calls[fi.qname]:
+            sites[id(site.call)] = site.callee
+    return sites
+
+
+def _touches_tainted_call(node: ast.AST, sites: dict, graph) -> bool:
+    """Does the expression contain a call to a function whose RETURN
+    VALUE carries a raw class-header read (the call-graph's
+    returns_taint summary — transitive, cross-module)?"""
+    return any(isinstance(sub, ast.Call) and
+               sites.get(id(sub)) in graph.returns_taint
+               for sub in ast.walk(node))
+
+
+def _tainted_class_names(tree: ast.Module, raw_expr) -> set:
     """Names assigned from a raw class-header read that never routed
     through the registry. Conservative straight-line taint: ANY raw
     assignment taints the name for the module (reusing one name for
-    raw and clean values is itself the bug this guards against)."""
+    raw and clean values is itself the bug this guards against).
+    ``raw_expr`` decides whether an expression carries the raw value —
+    a literal/``.HEADER`` mention or (since v15) a call into a
+    taint-returning helper."""
     out = set()
-    for node in ast.walk(tree):
+    for node in core.module_nodes(tree):
         if not isinstance(node, ast.Assign):
             continue
-        if not _mentions_class_header(node.value) or \
+        if not raw_expr(node.value) or \
                 _through_class_registry(node.value):
             continue
         for target in node.targets:
@@ -194,7 +219,7 @@ def _docstring_nodes(tree: ast.Module) -> set:
     """ids of docstring Constant nodes (module/class/def bodies) —
     prose ABOUT bucket lines is not parsing them."""
     out = set()
-    for node in ast.walk(tree):
+    for node in core.module_nodes(tree):
         if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
                              ast.AsyncFunctionDef)):
             body = getattr(node, 'body', [])
@@ -208,7 +233,7 @@ def _docstring_nodes(tree: ast.Module) -> set:
 def _adhoc_exposition(mod: core.ModuleInfo) -> List[core.Violation]:
     docstrings = _docstring_nodes(mod.tree)
     out: List[core.Violation] = []
-    for node in ast.walk(mod.tree):
+    for node in core.module_nodes(mod.tree):
         if not (isinstance(node, ast.Constant) and
                 isinstance(node.value, str)):
             continue
@@ -230,15 +255,28 @@ def _adhoc_exposition(mod: core.ModuleInfo) -> List[core.Violation]:
     return out
 
 
-def run(mod: core.ModuleInfo) -> List[core.Violation]:
+def run_program(modules, graph) -> List[core.Violation]:
+    out: List[core.Violation] = []
+    for mod in modules:
+        out.extend(_run_module(mod, graph))
+    return out
+
+
+def _run_module(mod: core.ModuleInfo, graph) -> List[core.Violation]:
     if mod.unit in ('analysis', 'observe'):
         return []
     out: List[core.Violation] = []
     out.extend(_adhoc_exposition(mod))
     if not _imports_observe(mod.tree):
         return out
-    tainted = _tainted_class_names(mod.tree)
-    for node in ast.walk(mod.tree):
+    sites = _call_resolutions(mod, graph)
+
+    def raw_expr(node: ast.AST) -> bool:
+        return (_mentions_class_header(node) or
+                _touches_tainted_call(node, sites, graph))
+
+    tainted = _tainted_class_names(mod.tree, raw_expr)
+    for node in core.module_nodes(mod.tree):
         if not isinstance(node, ast.Call):
             continue
         if _is_metric_declaration(node):
@@ -302,7 +340,7 @@ def run(mod: core.ModuleInfo) -> List[core.Violation]:
                             f'finite set, or cardinality grows with '
                             f'traffic')))
                     continue
-                raw_inline = (_mentions_class_header(kw.value) and
+                raw_inline = (raw_expr(kw.value) and
                               not _through_class_registry(kw.value))
                 raw_via_name = (not raw_inline and
                                 _expr_touches_taint(kw.value, tainted)

@@ -95,7 +95,7 @@ def run(mod: core.ModuleInfo) -> List[core.Violation]:
     out: List[core.Violation] = []
     wrapped = jit_hazards._wrapped_fn_names(mod.tree)
 
-    for node in ast.walk(mod.tree):
+    for node in core.module_nodes(mod.tree):
         # Rule 1: static table-like parameters on jitted functions.
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
             arg_names = [a.arg for a in node.args.args]

@@ -53,7 +53,7 @@ def run(mod: core.ModuleInfo) -> List[core.Violation]:
     if mod.unit not in _UNITS:
         return []
     out: List[core.Violation] = []
-    for node in ast.walk(mod.tree):
+    for node in core.module_nodes(mod.tree):
         if not isinstance(node, (ast.FunctionDef,
                                  ast.AsyncFunctionDef)):
             continue

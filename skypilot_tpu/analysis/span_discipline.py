@@ -83,7 +83,7 @@ def _with_context_ids(tree: ast.AST) -> Set[int]:
     """id() of every expression used as a ``with`` item context — the
     sanctioned position for spans.span()/start()."""
     out: Set[int] = set()
-    for node in ast.walk(tree):
+    for node in core.module_nodes(tree):
         if isinstance(node, (ast.With, ast.AsyncWith)):
             for item in node.items:
                 out.add(id(item.context_expr))
@@ -217,7 +217,7 @@ def run(mod: core.ModuleInfo) -> List[core.Violation]:
         return []
     out: List[core.Violation] = []
     with_ctx = _with_context_ids(mod.tree)
-    for node in ast.walk(mod.tree):
+    for node in core.module_nodes(mod.tree):
         if not isinstance(node, ast.Call):
             continue
         if _is_scoped_span_call(node) and id(node) not in with_ctx:

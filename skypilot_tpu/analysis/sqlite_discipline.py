@@ -144,7 +144,7 @@ def _immediate_with_stmts(fn: ast.AST) -> Set[int]:
 
 def _claim_races(mod: core.ModuleInfo) -> List[core.Violation]:
     out: List[core.Violation] = []
-    for fn in ast.walk(mod.tree):
+    for fn in core.module_nodes(mod.tree):
         if not isinstance(fn, dataflow.FunctionLike):
             continue
         cfg = dataflow.build_cfg(fn)
@@ -206,7 +206,7 @@ def run(mod: core.ModuleInfo) -> List[core.Violation]:
 
     # Rule 1: raw sqlite3.connect outside the shared helper.
     if mod.path != 'utils/sqlite_utils.py':
-        for node in ast.walk(mod.tree):
+        for node in core.module_nodes(mod.tree):
             if isinstance(node, ast.Call):
                 name = dataflow.canonical_call(node, aliases)
                 if name == 'sqlite3.connect':
@@ -222,7 +222,7 @@ def run(mod: core.ModuleInfo) -> List[core.Violation]:
 
     # Rule 2: RETURNING in SQL literals (sqlite 3.34 regression guard).
     docstrings = dataflow.docstring_constants(mod.tree)
-    for node in ast.walk(mod.tree):
+    for node in core.module_nodes(mod.tree):
         if isinstance(node, ast.Constant) and \
                 isinstance(node.value, str) and \
                 id(node) not in docstrings and \

@@ -78,7 +78,7 @@ def run(mod: core.ModuleInfo) -> List[core.Violation]:
     out: List[core.Violation] = []
 
     # Rule 1: transition-table coverage of the status enums.
-    for node in ast.walk(mod.tree):
+    for node in core.module_nodes(mod.tree):
         if isinstance(node, ast.ClassDef) and \
                 node.name in state_machines.ENUM_TABLES and \
                 _is_enum(node):
@@ -99,7 +99,7 @@ def run(mod: core.ModuleInfo) -> List[core.Violation]:
 
     # Rules 2-3 need the enclosing function of each node.
     docstrings = dataflow.docstring_constants(mod.tree)
-    fstring_parts = {id(v) for n in ast.walk(mod.tree)
+    fstring_parts = {id(v) for n in core.module_nodes(mod.tree)
                      if isinstance(n, ast.JoinedStr) for v in n.values}
     for node, fn in dataflow.nodes_with_enclosing_function(mod.tree):
         if fn in state_machines.GUARDED_SETTERS:

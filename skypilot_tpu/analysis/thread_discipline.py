@@ -16,10 +16,13 @@ Two rules:
      stall; do the slow work outside the critical section. Only plain
      lock objects (``with self._lock:``) are checked — ``with
      locks.cluster_status_lock(...):`` file locks are coarse
-     by design and exempt.
+     by design and exempt. Whole-program since skylint v15: a helper
+     CALLED under the lock that reaches a blocking call through any
+     chain of sync calls — in any module — is flagged too, with the
+     chain in the key (``_lock->_refresh->requests.get``).
 
 ``time.sleep`` on the event loop stays with the ``async-blocking``
-checker, which now follows sync-helper call chains to any depth.
+checker, which follows sync call chains the same way.
 """
 from __future__ import annotations
 
@@ -37,7 +40,7 @@ def _joined_names(tree: ast.Module) -> Set[str]:
     """Names (variables, attributes, containers iterated over) that
     receive a ``.join()`` call anywhere in the module."""
     joined: Set[str] = set()
-    for node in ast.walk(tree):
+    for node in core.module_nodes(tree):
         if isinstance(node, ast.Call) and \
                 isinstance(node.func, ast.Attribute) and \
                 node.func.attr == 'join':
@@ -47,7 +50,7 @@ def _joined_names(tree: ast.Module) -> Set[str]:
             elif isinstance(tgt, ast.Attribute):
                 joined.add(tgt.attr)
     # `for t in pumps: ... t.join()` joins every element of `pumps`.
-    for node in ast.walk(tree):
+    for node in core.module_nodes(tree):
         if isinstance(node, (ast.For, ast.AsyncFor)) and \
                 isinstance(node.target, ast.Name) and \
                 node.target.id in joined:
@@ -97,7 +100,7 @@ def _thread_bindings(
                 found.append(sub)
         return found
 
-    for node in ast.walk(tree):
+    for node in core.module_nodes(tree):
         if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
             targets = (node.targets if isinstance(node, ast.Assign)
                        else [node.target])
@@ -114,47 +117,10 @@ def _thread_bindings(
             for call in thread_calls_in(node.args[0]):
                 out.append((call, binding_of(node.func.value)))
                 claimed.add(id(call))
-    for node in ast.walk(tree):
+    for node in core.module_nodes(tree):
         if isinstance(node, ast.Call) and _is_thread_call(node, aliases) \
                 and id(node) not in claimed:
             out.append((node, None))
-    return out
-
-
-def _lock_name(ctx: ast.expr) -> Optional[str]:
-    """Terminal name of a with-item that looks like a threading lock
-    object (NOT a call — ``cluster_status_lock(...)`` file-lock
-    factories are exempt by design)."""
-    name = None
-    if isinstance(ctx, ast.Name):
-        name = ctx.id
-    elif isinstance(ctx, ast.Attribute):
-        name = ctx.attr
-    if name is not None and 'lock' in name.lower():
-        return name
-    return None
-
-
-def _blocking_in_with(body: List[ast.stmt],
-                      aliases: Dict[str, str]
-                      ) -> List[Tuple[ast.Call, str]]:
-    out = []
-
-    def visit(node: ast.AST, awaited: bool) -> None:
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, dataflow.ScopeBoundary):
-                continue
-            if isinstance(child, ast.Await):
-                visit(child, True)
-                continue
-            if isinstance(child, ast.Call) and not awaited:
-                reason = async_blocking.blocking_reason(child, aliases)
-                if reason is not None:
-                    out.append((child, reason))
-            visit(child, False)
-
-    for st in body:
-        visit(st, False)
     return out
 
 
@@ -177,24 +143,58 @@ def run(mod: core.ModuleInfo) -> List[core.Violation]:
                 f'join(): it outlives its owner and pins the process '
                 f'at exit — pass daemon=True or join it on every '
                 f'path')))
+    return out
 
-    for node in ast.walk(mod.tree):
-        if not isinstance(node, (ast.With, ast.AsyncWith)):
-            continue
-        lock = None
-        for item in node.items:
-            lock = _lock_name(item.context_expr)
-            if lock:
-                break
-        if not lock:
-            continue
-        for call, reason in _blocking_in_with(node.body, aliases):
-            out.append(core.Violation(
-                check=NAME, path=mod.path, line=call.lineno,
-                col=call.col_offset, key=f'{lock}->{reason}',
-                message=(
-                    f'blocking call {reason!r} while holding '
-                    f'{lock!r}: every thread contending the lock '
-                    f'stalls behind it — move the slow work outside '
-                    f'the critical section')))
+
+def run_program(modules, graph) -> List[core.Violation]:
+    """Blocking-under-lock over the call-graph: every call site with a
+    non-empty held-lock set, checked directly AND through the callee's
+    may-block summary."""
+    out: List[core.Violation] = []
+    for mod in modules:
+        aliases = graph.aliases(mod.dotted)
+        for fi in graph.funcs_in_module(mod.dotted):
+            for site in graph.calls[fi.qname]:
+                if not site.held or site.awaited:
+                    continue
+                reason = async_blocking.blocking_reason(
+                    site.call, aliases)
+                if reason is not None:
+                    for lock_id in site.held:
+                        lock = graph.lock_labels.get(lock_id, lock_id)
+                        out.append(core.Violation(
+                            check=NAME, path=mod.path,
+                            line=site.call.lineno,
+                            col=site.call.col_offset,
+                            key=f'{lock}->{reason}',
+                            message=(
+                                f'blocking call {reason!r} while '
+                                f'holding {lock!r}: every thread '
+                                f'contending the lock stalls behind '
+                                f'it — move the slow work outside '
+                                f'the critical section')))
+                    continue
+                if site.via_executor or site.callee is None:
+                    continue
+                callee = graph.funcs.get(site.callee)
+                sub = graph.blocks.get(site.callee)
+                if callee is None or callee.is_async or sub is None:
+                    continue
+                chain, inner_line = sub
+                full = [site.label] + list(chain)
+                for lock_id in site.held:
+                    lock = graph.lock_labels.get(lock_id, lock_id)
+                    out.append(core.Violation(
+                        check=NAME, path=mod.path,
+                        line=site.call.lineno,
+                        col=site.call.col_offset,
+                        key='->'.join([lock] + full),
+                        message=(
+                            f'call to {site.label!r} while holding '
+                            f'{lock!r} reaches blocking '
+                            f'{chain[-1]!r} via {" -> ".join(full)} '
+                            f'({callee.mod.path} line {inner_line}): '
+                            f'every thread contending the lock '
+                            f'stalls behind it — move the slow work '
+                            f'outside the critical section')))
     return out

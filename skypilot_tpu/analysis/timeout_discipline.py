@@ -89,7 +89,7 @@ def _bound_sessions(tree: ast.Module) -> 'tuple[Set[str], Set[str]]':
             return
         (safe if _has_kwarg(call, 'timeout') else unsafe).add(name)
 
-    for node in ast.walk(tree):
+    for node in core.module_nodes(tree):
         if isinstance(node, ast.Assign) and \
                 isinstance(node.value, ast.Call) and \
                 _is_client_session_ctor(node.value):
@@ -117,7 +117,7 @@ def _raw_socket_bindings(tree: ast.Module) -> 'list[tuple[str, ast.AST]]':
     ... as s:`` items, and the connection half of an
     ``x, y = s.accept()`` unpack — with the binding node."""
     out: 'list[tuple[str, ast.AST]]' = []
-    for node in ast.walk(tree):
+    for node in core.module_nodes(tree):
         if isinstance(node, ast.Assign) and len(node.targets) == 1:
             tgt, val = node.targets[0], node.value
             if not isinstance(val, ast.Call):
@@ -147,7 +147,7 @@ def _raw_socket_bindings(tree: ast.Module) -> 'list[tuple[str, ast.AST]]':
 
 def _settimeout_names(tree: ast.Module) -> Set[str]:
     out: Set[str] = set()
-    for node in ast.walk(tree):
+    for node in core.module_nodes(tree):
         if isinstance(node, ast.Call) and \
                 isinstance(node.func, ast.Attribute) and \
                 node.func.attr == 'settimeout':
@@ -175,7 +175,7 @@ def run(mod: core.ModuleInfo) -> List[core.Violation]:
                         f'must carry a deadline (a dead peer costs '
                         f'bounded time, never a hung trainer)')))
     unsafe_sessions, _ = _bound_sessions(mod.tree)
-    for node in ast.walk(mod.tree):
+    for node in core.module_nodes(mod.tree):
         if not isinstance(node, ast.Call):
             continue
         dotted = core.dotted_name(node.func) or ''
