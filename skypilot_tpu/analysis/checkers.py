@@ -1,20 +1,32 @@
-"""Checker registry: name → run(module) -> [Violation].
+"""Checker registry: name → checker module.
+
+A checker module exposes ``NAME`` plus one or both entry points:
+
+  * ``run(mod: ModuleInfo) -> [Violation]`` — per-module, runs once
+    for every module in the scan scope (the v1 shape);
+  * ``run_program(modules, graph) -> [Violation]`` — whole-program
+    (v15): runs ONCE with every module in the package and the shared
+    :mod:`callgraph` summaries, regardless of ``--changed`` scoping
+    (a cross-module finding needs the whole graph); ``core`` filters
+    its findings back down to the scanned paths.
 
 New checkers register here; `python -m skypilot_tpu.analysis
 --list-checks` and the `--check` CLI filter read this table.
 """
 from __future__ import annotations
 
-from typing import Callable, List, Optional, Sequence, Tuple
+from types import ModuleType
+from typing import List, Optional, Sequence, Tuple
 
 from skypilot_tpu.analysis import async_blocking
 from skypilot_tpu.analysis import backoff_discipline
-from skypilot_tpu.analysis import core
 from skypilot_tpu.analysis import failpoint_naming
 from skypilot_tpu.analysis import host_sync_loops
+from skypilot_tpu.analysis import jit_boundary
 from skypilot_tpu.analysis import jit_hazards
 from skypilot_tpu.analysis import lazy_imports
 from skypilot_tpu.analysis import layers
+from skypilot_tpu.analysis import lock_ordering
 from skypilot_tpu.analysis import metric_discipline
 from skypilot_tpu.analysis import page_table_shape
 from skypilot_tpu.analysis import paged_view_materialization
@@ -25,25 +37,25 @@ from skypilot_tpu.analysis import state_integrity
 from skypilot_tpu.analysis import thread_discipline
 from skypilot_tpu.analysis import timeout_discipline
 
-CheckerFn = Callable[[core.ModuleInfo], List[core.Violation]]
-
-ALL: List[Tuple[str, CheckerFn]] = [
-    (layers.NAME, layers.run),
-    (lazy_imports.NAME, lazy_imports.run),
-    (async_blocking.NAME, async_blocking.run),
-    (jit_hazards.NAME, jit_hazards.run),
-    (host_sync_loops.NAME, host_sync_loops.run),
-    (page_table_shape.NAME, page_table_shape.run),
-    (paged_view_materialization.NAME, paged_view_materialization.run),
-    (sqlite_discipline.NAME, sqlite_discipline.run),
-    (state_integrity.NAME, state_integrity.run),
-    (thread_discipline.NAME, thread_discipline.run),
-    (silent_except.NAME, silent_except.run),
-    (metric_discipline.NAME, metric_discipline.run),
-    (span_discipline.NAME, span_discipline.run),
-    (timeout_discipline.NAME, timeout_discipline.run),
-    (failpoint_naming.NAME, failpoint_naming.run),
-    (backoff_discipline.NAME, backoff_discipline.run),
+ALL: List[Tuple[str, ModuleType]] = [
+    (layers.NAME, layers),
+    (lazy_imports.NAME, lazy_imports),
+    (async_blocking.NAME, async_blocking),
+    (jit_hazards.NAME, jit_hazards),
+    (host_sync_loops.NAME, host_sync_loops),
+    (page_table_shape.NAME, page_table_shape),
+    (paged_view_materialization.NAME, paged_view_materialization),
+    (sqlite_discipline.NAME, sqlite_discipline),
+    (state_integrity.NAME, state_integrity),
+    (thread_discipline.NAME, thread_discipline),
+    (silent_except.NAME, silent_except),
+    (metric_discipline.NAME, metric_discipline),
+    (span_discipline.NAME, span_discipline),
+    (timeout_discipline.NAME, timeout_discipline),
+    (failpoint_naming.NAME, failpoint_naming),
+    (backoff_discipline.NAME, backoff_discipline),
+    (lock_ordering.NAME, lock_ordering),
+    (jit_boundary.NAME, jit_boundary),
 ]
 
 
@@ -52,7 +64,8 @@ def names() -> List[str]:
 
 
 def resolve(
-        selected: Optional[Sequence[str]]) -> List[Tuple[str, CheckerFn]]:
+        selected: Optional[Sequence[str]]
+) -> List[Tuple[str, ModuleType]]:
     if not selected:
         return list(ALL)
     by_name = dict(ALL)

@@ -1,0 +1,383 @@
+"""jit-boundary: retrace and donation hazards at jit call sites.
+
+``jit_hazards`` polices what happens INSIDE a jitted function; this
+checker polices the boundary — how compiled callables are created and
+called. Three ways the host side quietly destroys the compilation
+work PR 15 just fused:
+
+  1. **jit-in-loop** — ``jax.jit(...)`` executed unconditionally in a
+     loop body builds a fresh callable (and a fresh trace-cache entry)
+     every iteration: the cache keys on the wrapper object, so the
+     loop retraces forever. Hoist the wrap, or memoize it (a
+     cache-miss-guarded wrap under ``if`` is the sanctioned memo shape
+     and is not flagged).
+  2. **fresh containers / unhashable statics at call sites** — calling
+     a jitted function with a freshly-constructed list/set/
+     comprehension argument re-keys the trace cache on the container's
+     structure (length changes retrace; generators are consumed);
+     passing a dict/list/set literal for a STATIC parameter raises
+     ``TypeError: unhashable`` at call time — or, wrapped in a
+     hashable shim, retraces per value. Tuples and dict pytrees of
+     arrays are the sanctioned shapes and pass.
+  3. **donated-buffer reuse** — an argument donated via
+     ``donate_argnums``/``donate_argnames`` is dead after the call
+     (its device buffer was reused for the output); reading it again
+     on any path is a use-after-free that XLA surfaces as a runtime
+     error on TPU and silently tolerates on CPU — exactly the kind of
+     backend-dependent bug that ships. The sanctioned rebind
+     ``cache = step(params, cache)`` kills the fact and passes; CFG
+     ``may_forward`` (with the v15 ``kill`` parameter) flags any
+     *other* read reachable from the donating call.
+
+Jitted callables are recognized per module: jit-decorated defs,
+``name = jax.jit(fn, ...)`` wrap bindings (including
+``self._x = jax.jit(...)``) and the engine's ``*_jit`` naming
+convention (spec unknown there — only the container rule applies).
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from skypilot_tpu.analysis import core
+from skypilot_tpu.analysis import dataflow
+from skypilot_tpu.analysis import host_sync_loops
+from skypilot_tpu.analysis import jit_hazards
+from skypilot_tpu.analysis import page_table_shape
+
+NAME = 'jit-boundary'
+
+# Freshly-constructed container expressions that re-key (or break) the
+# trace cache when passed to a compiled call. Tuples and dict literals
+# are the sanctioned pytree shapes and are NOT here.
+_FRESH_NODES = (ast.List, ast.ListComp, ast.Set, ast.SetComp,
+                ast.GeneratorExp, ast.DictComp)
+# Literals that can never be a static (hashable) argument.
+_UNHASHABLE_NODES = (ast.List, ast.ListComp, ast.Set, ast.SetComp,
+                     ast.Dict, ast.DictComp, ast.GeneratorExp)
+
+
+@dataclasses.dataclass
+class _JitSpec:
+    static_names: Set[str] = dataclasses.field(default_factory=set)
+    static_nums: Set[int] = dataclasses.field(default_factory=set)
+    donate_names: Set[str] = dataclasses.field(default_factory=set)
+    donate_nums: Set[int] = dataclasses.field(default_factory=set)
+
+
+def _ints_in(node: ast.expr) -> Set[int]:
+    return {sub.value for sub in ast.walk(node)
+            if isinstance(sub, ast.Constant) and
+            isinstance(sub.value, int) and
+            not isinstance(sub.value, bool)}
+
+
+def _strs_in(node: ast.expr) -> Set[str]:
+    return {sub.value for sub in ast.walk(node)
+            if isinstance(sub, ast.Constant) and
+            isinstance(sub.value, str)}
+
+
+def _spec_of(jit_call: ast.Call,
+             fn_args: Optional[List[str]] = None) -> _JitSpec:
+    """Static/donate spec from a ``jax.jit(...)`` call's keywords.
+    With ``fn_args`` (decorated def), argnum indices are also
+    translated to parameter names so kwarg call sites match."""
+    spec = _JitSpec()
+    for kw in jit_call.keywords:
+        if kw.arg == 'static_argnames':
+            spec.static_names |= _strs_in(kw.value)
+        elif kw.arg == 'donate_argnames':
+            spec.donate_names |= _strs_in(kw.value)
+        elif kw.arg == 'static_argnums':
+            spec.static_nums |= _ints_in(kw.value)
+        elif kw.arg == 'donate_argnums':
+            spec.donate_nums |= _ints_in(kw.value)
+    if fn_args:
+        for i in sorted(spec.static_nums):
+            if 0 <= i < len(fn_args):
+                spec.static_names.add(fn_args[i])
+        for i in sorted(spec.donate_nums):
+            if 0 <= i < len(fn_args):
+                spec.donate_names.add(fn_args[i])
+    return spec
+
+
+def _jit_specs(tree: ast.Module) -> Dict[str, _JitSpec]:
+    """Callable name -> spec for every jit creation in the module:
+    decorated defs (by def name) and wrap assignments (by binding
+    name / self-attribute name)."""
+    specs: Dict[str, _JitSpec] = {}
+    for node in core.module_nodes(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            arg_names = [a.arg for a in node.args.args]
+            for dec in node.decorator_list:
+                if not jit_hazards._decorator_is_jit(dec):
+                    continue
+                call = page_table_shape._jit_call_of(dec)
+                specs[node.name] = (_spec_of(call, arg_names)
+                                    if call else _JitSpec())
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+            value = node.value
+            if not (isinstance(value, ast.Call) and
+                    jit_hazards._is_jit_expr(value.func)):
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                name = None
+                if isinstance(t, ast.Name):
+                    name = t.id
+                elif isinstance(t, ast.Attribute):
+                    name = t.attr
+                if name:
+                    specs[name] = _spec_of(value)
+    return specs
+
+
+def _callee_tail(func: ast.expr) -> Optional[str]:
+    dotted = core.dotted_name(func)
+    if dotted is not None:
+        return dotted.split('.')[-1]
+    if isinstance(func, ast.Call):
+        # self._extend_jit(p, s)(...) — a factory returning the
+        # compiled program.
+        return _callee_tail(func.func)
+    return None
+
+
+def _is_jit_creation(call: ast.Call) -> bool:
+    """``jax.jit(...)`` / ``partial(jax.jit, ...)`` evaluated as an
+    expression (not a decorator)."""
+    if jit_hazards._is_jit_expr(call.func):
+        return True
+    dotted = core.dotted_name(call.func) or ''
+    return dotted.split('.')[-1] == 'partial' and bool(call.args) and \
+        jit_hazards._is_jit_expr(call.args[0])
+
+
+def _enclosing_fn_names(tree: ast.Module) -> Dict[int, str]:
+    return {id(node): fn for node, fn in
+            dataflow.nodes_with_enclosing_function(tree)}
+
+
+# ------------------------------------------------------ donated reuse
+
+def _assigns_name(stmt: ast.stmt, name: str) -> bool:
+    if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                   else [stmt.target])
+        for t in targets:
+            elts = t.elts if isinstance(t, ast.Tuple) else [t]
+            for e in elts:
+                if isinstance(e, ast.Name) and e.id == name:
+                    return True
+    if isinstance(stmt, (ast.For, ast.AsyncFor)):
+        for sub in ast.walk(stmt.target):
+            if isinstance(sub, ast.Name) and sub.id == name:
+                return True
+    return False
+
+
+def _reads_name(stmt: ast.stmt, name: str) -> bool:
+    """Does the code that executes AT this CFG node read ``name``?
+    Mirrors ``dataflow.node_calls`` structure: compound-statement
+    headers contribute only their controlling expressions."""
+    headers = (ast.If, ast.For, ast.AsyncFor, ast.While, ast.With,
+               ast.AsyncWith, ast.Try)
+
+    def reads_in(node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, dataflow.ScopeBoundary):
+                # ast.walk is non-recursive over our scope rule; a
+                # nested def capturing the name is a deferred read we
+                # conservatively skip (it runs later, maybe never).
+                continue
+            if isinstance(sub, ast.Name) and sub.id == name and \
+                    isinstance(sub.ctx, ast.Load):
+                return True
+        return False
+
+    if isinstance(stmt, headers):
+        for field in ('test', 'iter'):
+            sub = getattr(stmt, field, None)
+            if sub is not None and reads_in(sub):
+                return True
+        for item in getattr(stmt, 'items', []):
+            if reads_in(item.context_expr):
+                return True
+        return False
+    if isinstance(stmt, ast.AugAssign):
+        if isinstance(stmt.target, ast.Name) and \
+                stmt.target.id == name:
+            return True                   # x += ... reads x
+    return reads_in(stmt)
+
+
+def _donated_reuse(fn: ast.AST, mod: core.ModuleInfo,
+                   specs: Dict[str, _JitSpec]
+                   ) -> List[core.Violation]:
+    donations: List[Tuple[ast.Call, str, str]] = []
+    for call, _ in dataflow.own_calls(fn):
+        tail = _callee_tail(call.func)
+        spec = specs.get(tail or '')
+        if spec is None or not (spec.donate_nums or spec.donate_names):
+            continue
+        for i in sorted(spec.donate_nums):
+            if i < len(call.args) and \
+                    isinstance(call.args[i], ast.Name):
+                donations.append((call, call.args[i].id, tail))
+        for kw in call.keywords:
+            if kw.arg in spec.donate_names and \
+                    isinstance(kw.value, ast.Name):
+                donations.append((call, kw.value.id, tail))
+    if not donations:
+        return []
+
+    cfg = dataflow.build_cfg(fn)
+    calls_at = {id(n): dataflow.node_calls(n.stmt) if n.stmt else []
+                for n in cfg.nodes}
+    out: List[core.Violation] = []
+    for don_call, name, tail in donations:
+        def gen(n, _c=don_call):
+            return any(c is _c for c in calls_at[id(n)])
+
+        def kill(n, _name=name):
+            return n.stmt is not None and _assigns_name(n.stmt, _name)
+
+        live = dataflow.may_forward(cfg, gen, kill)
+        hits = [n for n in cfg.nodes
+                if n.stmt is not None and live[id(n)] and
+                _reads_name(n.stmt, name)]
+        if not hits:
+            continue
+        first = min(hits, key=lambda n: n.stmt.lineno)
+        out.append(core.Violation(
+            check=NAME, path=mod.path, line=first.stmt.lineno,
+            col=first.stmt.col_offset,
+            key=f'donated-reuse:{tail}:{name}',
+            message=(
+                f'{name!r} is DONATED to {tail!r} (its device buffer '
+                f'is reused for the output) but read again here: '
+                f'use-after-donation fails at runtime on TPU and '
+                f'silently works on CPU — rebind the result '
+                f'({name} = {tail}(...)) or drop the donation')))
+    return out
+
+
+# -------------------------------------------------------------- run
+
+def run(mod: core.ModuleInfo) -> List[core.Violation]:
+    out: List[core.Violation] = []
+    specs = _jit_specs(mod.tree)
+    wrapped = jit_hazards._wrapped_fn_names(mod.tree)
+    enclosing: Optional[Dict[int, str]] = None
+
+    # Rule 1: jit created unconditionally inside a loop body. A wrap
+    # guarded by an `if` (cache-miss memoization) is sanctioned.
+    for loop in core.module_nodes(mod.tree):
+        if not isinstance(loop, (ast.While, ast.For, ast.AsyncFor)):
+            continue
+        for call in host_sync_loops._unconditional_calls(loop.body):
+            if not _is_jit_creation(call):
+                continue
+            if enclosing is None:
+                # Lazy: the enclosing-function index walks the whole
+                # tree and only names findings, which are rare.
+                enclosing = _enclosing_fn_names(mod.tree)
+            fn = enclosing.get(id(call), '<module>')
+            out.append(core.Violation(
+                check=NAME, path=mod.path, line=call.lineno,
+                col=call.col_offset, key=f'jit-in-loop:{fn}',
+                message=(
+                    f'jax.jit(...) constructed inside a loop body '
+                    f'(in {fn!r}): the trace cache keys on the '
+                    f'wrapper object, so every iteration retraces '
+                    f'and recompiles — hoist the wrap out of the '
+                    f'loop or memoize it behind a cache-miss '
+                    f'check')))
+
+    # Rules 2+3: call sites of jitted callables.
+    for node in core.module_nodes(mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if _is_jit_creation(node):
+            continue                      # creating, not calling
+        tail = _callee_tail(node.func)
+        if tail is None:
+            continue
+        spec = specs.get(tail)
+        jit_like = spec is not None or tail in wrapped or \
+            tail.endswith('_jit')
+        if not jit_like:
+            continue
+        for pos, arg in enumerate(node.args):
+            if spec is not None and pos in spec.static_nums:
+                if isinstance(arg, _UNHASHABLE_NODES):
+                    out.append(core.Violation(
+                        check=NAME, path=mod.path, line=arg.lineno,
+                        col=arg.col_offset,
+                        key=f'unhashable-static:{tail}:{pos}',
+                        message=(
+                            f'positional arg {pos} of {tail!r} is '
+                            f'STATIC but a dict/list/set literal is '
+                            f'passed: unhashable static args fail at '
+                            f'call time (or retrace per value behind '
+                            f'a shim) — pass a hashable config '
+                            f'(frozen dataclass / tuple)')))
+                    continue
+            if isinstance(arg, _FRESH_NODES):
+                out.append(core.Violation(
+                    check=NAME, path=mod.path, line=arg.lineno,
+                    col=arg.col_offset,
+                    key=f'fresh-container:{tail}:{pos}',
+                    message=(
+                        f'freshly-constructed container passed as '
+                        f'arg {pos} to jitted {tail!r}: the trace '
+                        f'cache re-keys on the container structure '
+                        f'(length changes retrace; generators are '
+                        f'consumed) — convert to an array '
+                        f'(jnp.asarray) or a tuple outside the hot '
+                        f'path')))
+        for kw in node.keywords:
+            if kw.arg is None:
+                continue
+            if spec is not None and kw.arg in spec.static_names:
+                if isinstance(kw.value, _UNHASHABLE_NODES):
+                    out.append(core.Violation(
+                        check=NAME, path=mod.path,
+                        line=kw.value.lineno,
+                        col=kw.value.col_offset,
+                        key=f'unhashable-static:{tail}:{kw.arg}',
+                        message=(
+                            f'static arg {kw.arg!r} of {tail!r} is a '
+                            f'dict/list/set literal: unhashable '
+                            f'static args fail at call time (or '
+                            f'retrace per value behind a shim) — '
+                            f'pass a hashable config (frozen '
+                            f'dataclass / tuple)')))
+                    continue
+            if isinstance(kw.value, _FRESH_NODES):
+                out.append(core.Violation(
+                    check=NAME, path=mod.path, line=kw.value.lineno,
+                    col=kw.value.col_offset,
+                    key=f'fresh-container:{tail}:{kw.arg}',
+                    message=(
+                        f'freshly-constructed container passed as '
+                        f'arg {kw.arg!r} to jitted {tail!r}: the '
+                        f'trace cache re-keys on the container '
+                        f'structure (length changes retrace; '
+                        f'generators are consumed) — convert to an '
+                        f'array (jnp.asarray) or a tuple outside '
+                        f'the hot path')))
+
+    # Rule 4: donated buffers read after the donating call. Gated on
+    # any donating spec existing — scanning every function's calls
+    # for donations nobody declared is pure wall-clock waste.
+    if any(s.donate_nums or s.donate_names for s in specs.values()):
+        for node in core.module_nodes(mod.tree):
+            if isinstance(node,
+                          (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.extend(_donated_reuse(node, mod, specs))
+    return out

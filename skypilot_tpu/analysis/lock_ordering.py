@@ -1,0 +1,221 @@
+"""lock-ordering: interprocedural deadlock-order and data-race lint.
+
+The elastic controller and live-evacuation work multiply concurrent
+state machines across the serve / disagg / rollout / loadgen planes;
+this checker is the ahead-of-time ratchet for the two lock bugs a
+test suite only catches probabilistically:
+
+  1. **order inversion** (deadlock candidate) — somewhere in the
+     program lock A is held while lock B is acquired, and somewhere
+     else B is held while A is acquired. Two threads interleaving
+     those paths deadlock. Acquisition-while-holding is computed over
+     the whole call graph: ``with self._a: self._helper()`` where
+     ``_helper`` (any module away) takes ``self._b`` is an A→B edge
+     exactly as if the ``with`` were inline.
+  2. **non-reentrant reacquire** (self-deadlock) — a function holding
+     a lock reaches (directly or through callees) a second acquire of
+     the SAME lock, and that lock is a known ``threading.Lock()``
+     (not an RLock): the thread blocks on itself, forever. Locks
+     whose constructor isn't visible are skipped — only a provable
+     plain Lock fires.
+  3. **unlocked write** (data-race candidate) — an instance attribute
+     written under a lock in one place and written bare in another
+     (``__init__`` excepted: construction happens-before
+     publication). "Under a lock" is interprocedural: a setter only
+     ever CALLED with the lock held counts as locked, via a
+     must-hold-at-entry analysis (intersection over all call sites,
+     greatest fixpoint).
+
+Lock identity comes from the call graph's scope-stable scheme
+(``module:Class.attr`` / ``module:GLOBAL``); function-scoped locks
+(locals, parameters, unknown receivers) can't soundly pair across
+functions and never participate. Scope: functions and classes in
+``serve/`` (including ``serve/disagg/``), ``train/rollout/`` and
+``loadgen/`` — the planes the ROADMAP items grow.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from skypilot_tpu.analysis import core
+
+NAME = 'lock-ordering'
+
+_SCOPED_PREFIXES = ('serve/', 'train/rollout/', 'loadgen/')
+
+# __init__ (and __new__) run before the object is visible to other
+# threads; writes there need no lock.
+_CONSTRUCTION = frozenset({'__init__', '__new__', '__post_init__'})
+
+
+def _in_scope(path: str) -> bool:
+    return path.startswith(_SCOPED_PREFIXES)
+
+
+def _pairable(lock_id: str) -> bool:
+    """Module-scoped identities only: a function-scoped id (qname
+    prefix — two colons) names a different object per call frame."""
+    return lock_id.count(':') == 1
+
+
+def _display(lock_id: str) -> str:
+    return lock_id.rsplit(':', 1)[-1] if ':' in lock_id else lock_id
+
+
+def _entry_held(graph, order: List[str]) -> Dict[str, Set[str]]:
+    """Locks PROVABLY held whenever each function runs: the
+    intersection, over every call site that reaches it, of the locks
+    held at the site plus the caller's own entry set. Greatest
+    fixpoint (entries start at TOP = unknown); a function with no
+    callers is an entry point and holds nothing."""
+    callers: Dict[str, List[Tuple[str, Tuple[str, ...]]]] = {}
+    for q in order:
+        for site in graph.calls[q]:
+            if site.callee is not None:
+                callers.setdefault(site.callee, []).append(
+                    (q, site.held))
+    TOP = None
+    entry: Dict[str, Optional[Set[str]]] = {q: TOP for q in order}
+    for q in order:
+        if q not in callers:
+            entry[q] = set()
+    changed = True
+    while changed:
+        changed = False
+        for q in order:
+            sites = callers.get(q)
+            if not sites:
+                continue
+            acc: Optional[Set[str]] = TOP
+            for caller, held in sites:
+                ch = entry[caller]
+                if ch is TOP:
+                    continue            # contributes the universe
+                contrib = ch | set(held)
+                acc = contrib if acc is TOP else acc & contrib
+            if acc is not TOP and acc != entry[q]:
+                entry[q] = acc
+                changed = True
+    # Anything still TOP is reachable only from itself (dead mutual
+    # recursion) — claim nothing rather than everything.
+    return {q: (s if s is not None else set())
+            for q, s in entry.items()}
+
+
+def run_program(modules, graph) -> List[core.Violation]:
+    order = sorted(graph.funcs)
+    out: List[core.Violation] = []
+
+    # ---------------- rule 1+2: held→acquired edges with witnesses.
+    # edges[(A, B)] = first witness (path, line, via-label) of B being
+    # acquired (directly or transitively) while A is held.
+    edges: Dict[Tuple[str, str],
+                Tuple[str, int, Optional[str]]] = {}
+    for q in order:
+        fi = graph.funcs[q]
+        if not _in_scope(fi.mod.path):
+            continue
+        for a in graph.acquires[q]:
+            if not _pairable(a.lock):
+                continue
+            for h in a.held:
+                if _pairable(h):
+                    edges.setdefault(
+                        (h, a.lock),
+                        (fi.mod.path, a.node.lineno, None))
+        for site in graph.calls[q]:
+            if not site.held or site.callee is None:
+                continue
+            for inner in graph.locks_trans.get(site.callee, {}):
+                if not _pairable(inner):
+                    continue
+                for h in site.held:
+                    if _pairable(h):
+                        edges.setdefault(
+                            (h, inner),
+                            (fi.mod.path, site.call.lineno,
+                             site.label))
+
+    for (a, b), (path, line, via) in sorted(edges.items()):
+        if a == b:
+            # Reacquire: only a provable non-reentrant Lock fires.
+            if graph.lock_kinds.get(a) != 'Lock':
+                continue
+            disp = _display(a)
+            how = (f'via call to {via!r} ' if via else '')
+            out.append(core.Violation(
+                check=NAME, path=path, line=line, col=0,
+                key=f'reacquire:{disp}',
+                message=(
+                    f'non-reentrant Lock {disp!r} reacquired {how}'
+                    f'while already held: the thread deadlocks on '
+                    f'itself — use an RLock, or split the locked '
+                    f'method into a public locking wrapper and a '
+                    f'_locked inner')))
+            continue
+        if (b, a) not in edges:
+            continue
+        da, db = _display(a), _display(b)
+        how = (f'(via call to {via!r}) ' if via else '')
+        out.append(core.Violation(
+            check=NAME, path=path, line=line, col=0,
+            key=f'order:{da}->{db}',
+            message=(
+                f'lock order inversion: {db!r} acquired {how}while '
+                f'holding {da!r} here, but the opposite order '
+                f'{db!r}→{da!r} is taken elsewhere in the program — '
+                f'two threads interleaving these paths deadlock; '
+                f'pick one global order (docs/ARCHITECTURE_LINT.md '
+                f'lock-ordering)')))
+
+    # ---------------- rule 3: attrs written under and outside a lock.
+    entry = _entry_held(graph, order)
+    # (module, class) -> attr -> [(effective held, path, line, fn)]
+    by_class: Dict[Tuple[str, str],
+                   Dict[str, List[Tuple[Set[str], str, int, str]]]] \
+        = {}
+    for q in order:
+        fi = graph.funcs[q]
+        if fi.cls is None or not _in_scope(fi.mod.path):
+            continue
+        if fi.name in _CONSTRUCTION:
+            continue
+        for attr, line, held in graph.writes[q]:
+            eff = {h for h in (set(held) | entry[q]) if _pairable(h)}
+            by_class.setdefault((fi.mod.dotted, fi.cls), {}) \
+                .setdefault(attr, []) \
+                .append((eff, fi.mod.path, line, fi.name))
+
+    for (dotted, cls), attrs in sorted(by_class.items()):
+        for attr, writes in sorted(attrs.items()):
+            union: Set[str] = set()
+            for eff, _, _, _ in writes:
+                union |= eff
+            if not union:
+                continue                  # never locked: not our rule
+            common = set(union)
+            for eff, _, _, _ in writes:
+                common &= eff
+            if common:
+                continue                  # consistently protected
+            # The attr's lock: the one held at the most writes.
+            counts = sorted(
+                ((sum(1 for e, _, _, _ in writes if lk in e), lk)
+                 for lk in union), reverse=True)
+            lock = counts[0][1]
+            disp = _display(lock)
+            for eff, path, line, fn in writes:
+                if lock in eff:
+                    continue
+                out.append(core.Violation(
+                    check=NAME, path=path, line=line, col=0,
+                    key=f'race:{cls}.{attr}',
+                    message=(
+                        f'attribute {cls}.{attr} is written under '
+                        f'{disp!r} elsewhere but written here (in '
+                        f'{fn!r}) without it — a concurrent reader/'
+                        f'writer sees torn state; take {disp!r} '
+                        f'here too, or move the write into '
+                        f'construction')))
+    return out
