@@ -12,6 +12,7 @@ token.
 """
 from __future__ import annotations
 
+import asyncio
 import sys
 from typing import Any, Dict, List, Optional
 
@@ -28,13 +29,19 @@ __all__ = [
 ]
 
 
-def _url(url: Optional[str]) -> str:
-    return url or sync_sdk.api_server_url(required=True)
+async def _url(url: Optional[str]) -> str:
+    if url:
+        return url
+    # api_server_url probes /api/v1/health with a synchronous
+    # requests.get (2 s timeout) and may read the endpoint file — run
+    # it in a worker thread so endpoint resolution never stalls every
+    # other coroutine on the loop.
+    return await asyncio.to_thread(sync_sdk.api_server_url, required=True)
 
 
 async def submit(name: str, payload: Dict[str, Any],
                  url: Optional[str] = None) -> str:
-    url = _url(url)
+    url = await _url(url)
     payload = sync_sdk.prepare_payload(payload)
     async with aiohttp.ClientSession() as session:
         async with session.post(f'{url}/api/v1/{name}', json=payload,
@@ -48,7 +55,7 @@ async def submit(name: str, payload: Dict[str, Any],
 
 async def get(request_id: str, url: Optional[str] = None) -> Any:
     """Await request completion; return its result (or raise)."""
-    url = _url(url)
+    url = await _url(url)
     async with aiohttp.ClientSession() as session:
         while True:
             async with session.get(
@@ -74,7 +81,7 @@ async def get(request_id: str, url: Optional[str] = None) -> Any:
 
 async def stream_and_get(request_id: str, url: Optional[str] = None,
                          out=None) -> Any:
-    url = _url(url)
+    url = await _url(url)
     out = out or sys.stdout
     async with aiohttp.ClientSession() as session:
         async with session.get(
@@ -89,7 +96,7 @@ async def stream_and_get(request_id: str, url: Optional[str] = None,
 
 
 async def api_cancel(request_id: str, url: Optional[str] = None) -> bool:
-    url = _url(url)
+    url = await _url(url)
     async with aiohttp.ClientSession() as session:
         async with session.post(f'{url}/api/v1/request_cancel',
                                 json={'request_id': request_id},
@@ -104,7 +111,7 @@ async def api_cancel(request_id: str, url: Optional[str] = None) -> bool:
 
 async def api_list_requests(url: Optional[str] = None
                             ) -> List[Dict[str, Any]]:
-    url = _url(url)
+    url = await _url(url)
     async with aiohttp.ClientSession() as session:
         async with session.get(f'{url}/api/v1/requests',
                                headers=sync_sdk._headers(),

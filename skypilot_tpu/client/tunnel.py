@@ -63,7 +63,11 @@ async def serve_tunnel(cluster: str, port: int, local_port: int,
                        url: Optional[str] = None,
                        ready_event: Optional[asyncio.Event] = None) -> None:
     """Listen on 127.0.0.1:local_port and proxy each connection."""
-    server_url = url or sync_sdk.api_server_url(required=True)
+    # api_server_url does a synchronous health probe (requests.get,
+    # 2 s timeout) — resolve it in a worker thread so an in-flight
+    # tunnel on the same loop never stalls behind it.
+    server_url = url or await asyncio.to_thread(
+        sync_sdk.api_server_url, required=True)
 
     async def on_conn(reader, writer):
         await _pump_one(reader, writer, server_url, cluster, port)
