@@ -4,10 +4,11 @@ zero-cost guard.
 The failpoint plane (utils/failpoints.py, docs/ROBUSTNESS.md) rests on
 three statically-checkable contracts:
 
-  1. **Literal names** — ``failpoints.fire(<literal str>)`` only. A
-     computed name is undiscoverable: ``python -m
-     skypilot_tpu.utils.failpoints --list`` AST-scans for literals, and
-     a chaos schedule can only arm sites it can name.
+  1. **Literal names** — ``failpoints.fire(<literal str>)`` (or its
+     coroutine twin ``afire``) only. A computed name is
+     undiscoverable: ``python -m skypilot_tpu.utils.failpoints
+     --list`` AST-scans for literals, and a chaos schedule can only
+     arm sites it can name.
   2. **Naming contract** — lowercase ``unit.site[.subsite]``
      (``engine.step``, ``lb.upstream_connect``); the same regex the
      runtime enforces, caught here before anything runs.
@@ -37,7 +38,7 @@ _BASES = frozenset({'failpoints', 'failpoints_lib'})
 
 def _is_fire(call: ast.Call) -> bool:
     if not (isinstance(call.func, ast.Attribute) and
-            call.func.attr == 'fire'):
+            call.func.attr in ('fire', 'afire')):
         return False
     base = call.func.value
     return isinstance(base, ast.Name) and base.id in _BASES

@@ -17,6 +17,10 @@ checker: literal ``unit.site[.subsite]`` lowercase names only)::
     if failpoints.ACTIVE:
         failpoints.fire('engine.step')
 
+Coroutine sites use ``await failpoints.afire(...)`` instead: a
+``delay`` spec then suspends only the calling task, never the whole
+event loop.
+
 Arming — environment (parsed once at import)::
 
     SKYTPU_FAILPOINTS='engine.step=once;lb.upstream_read=every:3'
@@ -51,6 +55,7 @@ site fails tier-1). See docs/ROBUSTNESS.md for the site catalog.
 """
 from __future__ import annotations
 
+import asyncio
 import contextlib
 import os
 import random
@@ -182,26 +187,51 @@ def armed(name: str, **kwargs) -> Iterator[None]:
             _recompute_active()
 
 
-def fire(name: str) -> None:
-    """The instrumented site. Call ONLY under ``if failpoints.ACTIVE:``
-    — this function is deliberately not cheap (a lock, counters); the
-    attribute guard is what keeps inactive hot paths free."""
+def _consume(name: str):
+    """Evaluate one hit of an armed site under the lock. Returns None
+    when nothing fires, else ``(delay, exc)`` for the caller to apply
+    OUTSIDE the lock — a sleeping delay site must not serialize every
+    other site, and a custom factory may do arbitrary work."""
     with _LOCK:
         spec = _ARMED.get(name)
         if spec is None:
-            return
+            return None
         if not spec.should_fire():
-            return
+            return None
         spec.fires += 1
         if spec.max_fires is not None and spec.fires >= spec.max_fires:
             _ARMED.pop(name, None)
             _recompute_active()
-        delay = spec.delay
-        exc = spec.exc
-    # Outside the lock: a sleeping delay site must not serialize every
-    # other site, and a custom factory may do arbitrary work.
+        return (spec.delay, spec.exc)
+
+
+def fire(name: str) -> None:
+    """The instrumented site. Call ONLY under ``if failpoints.ACTIVE:``
+    — this function is deliberately not cheap (a lock, counters); the
+    attribute guard is what keeps inactive hot paths free."""
+    hit = _consume(name)
+    if hit is None:
+        return
+    delay, exc = hit
     if delay is not None:
         time.sleep(delay)
+        return
+    raise (exc(name) if exc is not None else FailpointError(name))
+
+
+async def afire(name: str) -> None:
+    """``fire`` for coroutine sites: a ``delay`` spec suspends only the
+    calling task (``await asyncio.sleep``) instead of blocking the
+    whole event loop the way ``time.sleep`` would — injected latency in
+    an async server must slow the one request, not every request. Same
+    arming/counting semantics and the same ``if failpoints.ACTIVE:``
+    guard contract as ``fire``."""
+    hit = _consume(name)
+    if hit is None:
+        return
+    delay, exc = hit
+    if delay is not None:
+        await asyncio.sleep(delay)
         return
     raise (exc(name) if exc is not None else FailpointError(name))
 
@@ -298,6 +328,7 @@ def scan_sites(root: Optional[str] = None) -> List[Dict[str, object]]:
     import ast
     if root is None:
         root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    fire_names = ('fire', 'afire')
     sites: List[Dict[str, object]] = []
     for dirpath, dirnames, filenames in os.walk(root):
         dirnames[:] = sorted(d for d in dirnames
@@ -318,7 +349,7 @@ def scan_sites(root: Optional[str] = None) -> List[Dict[str, object]]:
             for node in ast.walk(tree):
                 if not (isinstance(node, ast.Call) and
                         isinstance(node.func, ast.Attribute) and
-                        node.func.attr == 'fire'):
+                        node.func.attr in fire_names):
                     continue
                 base = node.func.value
                 if not (isinstance(base, ast.Name) and
