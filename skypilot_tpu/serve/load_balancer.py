@@ -375,24 +375,32 @@ class LoadBalancer:
         for state, n in counts.items():
             _LB_BREAKER_STATE.set(n, state=state)
 
-    def _breaker_edge(self, url: str,
-                      edge: Optional[Tuple[str, str]]) -> None:
+    async def _breaker_edge(self, url: str,
+                            edge: Optional[Tuple[str, str]]) -> None:
         """Publish a breaker transition: journal event (the per-replica
-        record the bounded-label gauge cannot carry) + gauge refresh."""
+        record the bounded-label gauge cannot carry) + gauge refresh.
+        The journal write opens a sqlite connection (with a retried
+        WAL pragma that can sleep) — it runs in a worker thread so a
+        contended journal never stalls the proxy loop; the gauge
+        refresh stays on the loop (it mutates loop-only state)."""
         if edge is None:
             return
         old, new = edge
         logger.warning(f'Breaker for {url}: {old} -> {new}.')
-        journal_lib.record_event(
+        await asyncio.to_thread(
+            journal_lib.record_event,
             'lb_breaker', entity=self.service_name,
             reason=f'{old}->{new}', data={'replica': url})
         self._refresh_breaker_gauge()
 
-    def _record_upstream_failure(self, url: str, now: float) -> None:
-        self._breaker_edge(url, self._breaker(url).record_failure(now))
+    async def _record_upstream_failure(self, url: str,
+                                       now: float) -> None:
+        await self._breaker_edge(url,
+                                 self._breaker(url).record_failure(now))
 
-    def _record_upstream_success(self, url: str) -> None:
-        self._breaker_edge(url, self._breaker(url).record_success())
+    async def _record_upstream_success(self, url: str) -> None:
+        await self._breaker_edge(url,
+                                 self._breaker(url).record_success())
 
     def _pick(self, key: Optional[str], excluded: set,
               now: float) -> Optional[str]:
@@ -594,7 +602,7 @@ class LoadBalancer:
                     headers={'Retry-After': '1'})
             tried.add(target)
             breaker = self._breaker(target)
-            self._breaker_edge(target, breaker.begin_attempt(now))
+            await self._breaker_edge(target, breaker.begin_attempt(now))
             self.policy.request_started(target)
             url = target.rstrip('/') + request.rel_url.path_qs
             resp: Optional[web.StreamResponse] = None
@@ -618,7 +626,7 @@ class LoadBalancer:
                         if self.service_name:
                             headers['X-Skytpu-Entity'] = self.service_name
                     if failpoints_lib.ACTIVE:
-                        failpoints_lib.fire('lb.upstream_connect')
+                        await failpoints_lib.afire('lb.upstream_connect')
                     async with self._session.request(
                             request.method, url, headers=headers,
                             data=body) as upstream:
@@ -637,13 +645,13 @@ class LoadBalancer:
                         # client is a client abort (neither).
                         while True:
                             if failpoints_lib.ACTIVE:
-                                failpoints_lib.fire('lb.upstream_read')
+                                await failpoints_lib.afire('lb.upstream_read')
                             chunk = await upstream.content.readany()
                             if not chunk:
                                 break
                             await _downstream(resp.write(chunk))
                         await _downstream(resp.write_eof())
-                        self._record_upstream_success(target)
+                        await self._record_upstream_success(target)
                         judged = True
                         _LB_REQUESTS.inc(policy=self.policy_name,
                                          outcome='proxied')
@@ -678,7 +686,7 @@ class LoadBalancer:
             except (aiohttp.ClientError, asyncio.TimeoutError, OSError,
                     failpoints_lib.FailpointError) as e:
                 last_err = e
-                self._record_upstream_failure(target, time.monotonic())
+                await self._record_upstream_failure(target, time.monotonic())
                 judged = True
                 if resp is not None and resp.prepared:
                     # Response bytes already reached the client: not
@@ -815,7 +823,7 @@ class LoadBalancer:
                                    'attempt': attempt}):
             try:
                 if failpoints_lib.ACTIVE:
-                    failpoints_lib.fire('lb.upstream_connect')
+                    await failpoints_lib.afire('lb.upstream_connect')
                 async with self._session.post(
                         prefill_url.rstrip('/') +
                         f'/disagg/prefill?orig={orig}',
@@ -893,7 +901,7 @@ class LoadBalancer:
                     await _downstream(resp.prepare(request))
                     while True:
                         if failpoints_lib.ACTIVE:
-                            failpoints_lib.fire('lb.upstream_read')
+                            await failpoints_lib.afire('lb.upstream_read')
                         chunk = await upstream.content.readany()
                         if not chunk:
                             break
