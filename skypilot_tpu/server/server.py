@@ -164,7 +164,10 @@ async def submit(request: web.Request) -> web.Response:
 async def get_request(request: web.Request) -> web.Response:
     request_id = request.query.get('request_id', '')
     wait = request.query.get('wait', '0') == '1'
-    rec = requests_lib.get(request_id)
+    # Off-loop: every requests-DB read opens a sqlite connection (with
+    # a retried WAL pragma that can sleep under contention) — polled
+    # here per waiting client, it must never run on the event loop.
+    rec = await asyncio.to_thread(requests_lib.get, request_id)
     if rec is None:
         return _json({'error': f'no request {request_id!r}'}, status=404)
     # Adaptive backoff: snappy for short requests, 1 Hz for long ones —
@@ -173,13 +176,13 @@ async def get_request(request: web.Request) -> web.Response:
     while wait and not requests_lib.RequestStatus(rec['status']).is_terminal():
         await asyncio.sleep(delay)
         delay = min(delay * 1.5, 1.0)
-        rec = requests_lib.get(request_id)
+        rec = await asyncio.to_thread(requests_lib.get, request_id)
     return _json(rec)
 
 
 async def stream(request: web.Request) -> web.StreamResponse:
     request_id = request.query.get('request_id', '')
-    rec = requests_lib.get(request_id)
+    rec = await asyncio.to_thread(requests_lib.get, request_id)
     if rec is None:
         return _json({'error': f'no request {request_id!r}'}, status=404)
     request_id = rec['request_id']
@@ -199,7 +202,7 @@ async def stream(request: web.Request) -> web.StreamResponse:
                 pos = f.tell()
         if chunk:
             await resp.write(chunk)
-        rec = requests_lib.get(request_id)
+        rec = await asyncio.to_thread(requests_lib.get, request_id)
         if rec is None or requests_lib.RequestStatus(
                 rec['status']).is_terminal():
             # Drain whatever arrived between the read and the status check.
@@ -222,7 +225,8 @@ async def stream(request: web.Request) -> web.StreamResponse:
 
 async def list_requests(request: web.Request) -> web.Response:
     limit = int(request.query.get('limit', '100'))
-    return _json(requests_lib.list_requests(limit))
+    return _json(await asyncio.to_thread(requests_lib.list_requests,
+                                         limit))
 
 
 async def metrics(request: web.Request) -> web.Response:
@@ -321,11 +325,13 @@ async def dashboard_page(request: web.Request) -> web.Response:
 
 async def dashboard_summary(request: web.Request) -> web.Response:
     """Read-only snapshot for the dashboard: direct sqlite reads (fast, no
-    request queue round-trip)."""
+    request queue round-trip) — each one runs off-loop, because every
+    state-DB read opens a sqlite connection whose WAL pragma can
+    retry-sleep under contention."""
     del request
     from skypilot_tpu import global_state
     clusters = []
-    for r in global_state.get_clusters():
+    for r in await asyncio.to_thread(global_state.get_clusters):
         handle = r.get('handle') or {}
         res = handle.get('launched_resources') or {}
         clusters.append({
@@ -343,11 +349,12 @@ async def dashboard_summary(request: web.Request) -> web.Response:
         'status': j['status'].value, 'cluster_name': j['cluster_name'],
         'recovery_count': j['recovery_count'],
         'submitted_at': j['submitted_at'],
-    } for j in jobs_state.get_jobs()[:50]]
+    } for j in (await asyncio.to_thread(jobs_state.get_jobs))[:50]]
     from skypilot_tpu.serve import serve_state
     services = []
-    for s in serve_state.get_services():
-        reps = serve_state.get_replicas(s['name'])
+    for s in await asyncio.to_thread(serve_state.get_services):
+        reps = await asyncio.to_thread(serve_state.get_replicas,
+                                       s['name'])
         is_pool = bool((s['spec'] or {}).get('pool'))
         services.append({
             'name': s['name'], 'status': s['status'].value,
@@ -364,7 +371,8 @@ async def dashboard_summary(request: web.Request) -> web.Response:
         'clusters': clusters,
         'jobs': jobs,
         'services': services,
-        'requests': requests_lib.list_requests(20),
+        'requests': await asyncio.to_thread(requests_lib.list_requests,
+                                            20),
     })
 
 
@@ -392,7 +400,7 @@ async def dashboard_cluster(request: web.Request) -> web.Response:
     from skypilot_tpu import global_state
     from skypilot_tpu.backends import slice_backend
     name = request.query.get('name', '')
-    record = global_state.get_cluster(name)
+    record = await asyncio.to_thread(global_state.get_cluster, name)
     if record is None or not record.get('handle'):
         return _json({'error': f'no cluster {name!r} (or no handle '
                                f'recorded yet)'}, status=404)
@@ -429,7 +437,7 @@ async def dashboard_cluster_log(request: web.Request) -> web.Response:
     except ValueError:
         return _json({'error': 'job_id/lines must be integers'},
                      status=400)
-    record = global_state.get_cluster(name)
+    record = await asyncio.to_thread(global_state.get_cluster, name)
     if record is None or not record.get('handle'):
         return _json({'error': f'no cluster {name!r} (or no handle '
                                f'recorded yet)'}, status=404)
@@ -453,7 +461,7 @@ async def dashboard_job(request: web.Request) -> web.Response:
     except ValueError:
         return _json({'error': 'job_id/lines must be integers'},
                      status=400)
-    rec = next((j for j in jobs_state.get_jobs()
+    rec = next((j for j in await asyncio.to_thread(jobs_state.get_jobs)
                 if j['job_id'] == job_id), None)
     if rec is None:
         return _json({'error': f'no managed job {job_id}'}, status=404)
@@ -479,7 +487,7 @@ async def dashboard_service(request: web.Request) -> web.Response:
         lines = _parse_lines(request)
     except ValueError:
         return _json({'error': 'lines must be an integer'}, status=400)
-    rec = serve_state.get_service(name)
+    rec = await asyncio.to_thread(serve_state.get_service, name)
     if rec is None:
         return _json({'error': f'no service {name!r}'}, status=404)
     replicas = [{
@@ -490,7 +498,7 @@ async def dashboard_service(request: web.Request) -> web.Response:
         'version': r.get('version') or 1,
         'probe_failures': r.get('consecutive_failures') or 0,
         'launched_at': r.get('launched_at'),
-    } for r in serve_state.get_replicas(name)]
+    } for r in await asyncio.to_thread(serve_state.get_replicas, name)]
     return _json({
         'name': name,
         'status': rec['status'].value,
@@ -519,7 +527,7 @@ async def tunnel(request: web.Request) -> web.WebSocketResponse:
     from skypilot_tpu.backends import slice_backend
     cluster = request.query.get('cluster', '')
     port = int(request.query.get('port', 22))
-    record = global_state.get_cluster(cluster)
+    record = await asyncio.to_thread(global_state.get_cluster, cluster)
     if record is None:
         raise web.HTTPNotFound(text=f'cluster {cluster!r} not found')
     handle = slice_backend.SliceResourceHandle.from_dict(record['handle'])
@@ -558,7 +566,7 @@ async def tunnel(request: web.Request) -> web.WebSocketResponse:
 async def _gc_loop(app: web.Application) -> None:
     while True:
         try:
-            n = requests_lib.gc_requests()
+            n = await asyncio.to_thread(requests_lib.gc_requests)
             if n:
                 logger.info(f'request GC: pruned {n} old records')
             from skypilot_tpu import observe
