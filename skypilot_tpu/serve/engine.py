@@ -3212,7 +3212,7 @@ class InferenceEngine:
             try:
                 await asyncio.to_thread(self._admit_adopted, item)
             except Exception as e:  # pylint: disable=broad-except
-                self._fail_all(e, extra=item)
+                await self._fail_all(e, extra=item)
         for group in self._admit_groups(grouped):
             if self._ctrl is not None:
                 from skypilot_tpu.serve import multihost
@@ -3225,7 +3225,7 @@ class InferenceEngine:
                 # allocator); later groups/chunk starts admit against
                 # the rebuilt state — never drop them unfailed, their
                 # futures would hang forever.
-                self._fail_all(e, extra=group)
+                await self._fail_all(e, extra=group)
         for item in chunked:
             if self._ctrl is not None:
                 from skypilot_tpu.serve import multihost
@@ -3235,7 +3235,7 @@ class InferenceEngine:
             try:
                 await asyncio.to_thread(self._start_chunked, item)
             except Exception as e:  # pylint: disable=broad-except
-                self._fail_all(e, extra=item)
+                await self._fail_all(e, extra=item)
 
     async def batch_loop(self) -> None:
         """Continuous scheduler: admit whenever a slot is free, step
@@ -3246,7 +3246,10 @@ class InferenceEngine:
         ONE device call (grouped admission). Admission, cancels and
         failure resets happen only HERE, at drained points — the
         pipeline invariant (collect always precedes buffer reuse)."""
-        self._ensure_state()
+        # First call builds device state (journal snapshot + pool
+        # allocation + jit program construction): off-loop, so a
+        # server starting its scheduler keeps answering /health.
+        await asyncio.to_thread(self._ensure_state)
         while True:
             # Drained point: no step in flight (asserted in admit).
             self._process_cancels()
@@ -3283,7 +3286,7 @@ class InferenceEngine:
                 try:
                     await asyncio.to_thread(self._advance_chunk, slot)
                 except Exception as e:  # pylint: disable=broad-except
-                    self._fail_all(e)
+                    await self._fail_all(e)
                     continue
                 self._publish()     # a final chunk's first token streams
             if not any(self._row_active(s) for s in self.slots):
@@ -3291,7 +3294,7 @@ class InferenceEngine:
             try:
                 await self._step_round()
             except Exception as e:  # pylint: disable=broad-except
-                self._fail_all(e)
+                await self._fail_all(e)
                 continue
             self._publish()
 
@@ -3330,7 +3333,7 @@ class InferenceEngine:
         self._bcast(('collect',))
         await asyncio.to_thread(self._collect_step)
 
-    def _fail_all(self, e: Exception, extra=None) -> None:
+    async def _fail_all(self, e: Exception, extra=None) -> None:
         """Contain a device step/admit failure (the failed jit call was
         donated the cache buffer, so the whole pool must be rebuilt —
         see _reset_device_state) with the smallest blast radius:
@@ -3366,12 +3369,15 @@ class InferenceEngine:
             return err
 
         def fail(fut, stream_q, n_emitted: int) -> None:
-            if stream_q is not None:
-                stream_q.put_nowait(None)
-            if fut is not None and not fut.done():
-                fut.set_exception(reset_error(n_emitted))
             if fut is not None:
                 self._resurrect_counts.pop(id(fut), None)
+
+            def apply(fut=fut, stream_q=stream_q, n=n_emitted) -> None:
+                if stream_q is not None:
+                    stream_q.put_nowait(None)
+                if fut is not None and not fut.done():
+                    fut.set_exception(reset_error(n))
+            deliver.append(apply)
 
         def try_resurrect(item) -> bool:
             fut = item[-1]
@@ -3385,6 +3391,12 @@ class InferenceEngine:
             return True
 
         resurrected: List[tuple] = []
+        # Client-visible dispositions (future results/exceptions,
+        # stream sentinels) are DEFERRED until the rebuild below
+        # lands: waking a future yields a window in which its awaiter
+        # runs with the pool still mid-rebuild — a retrying client
+        # must never observe (or re-admit against) pre-reset state.
+        deliver: List = []
         handled = set()          # id(fut) the slot loop dispositioned
         for i, s in enumerate(self.slots):
             if s is None:
@@ -3395,17 +3407,20 @@ class InferenceEngine:
             if s['finish'] is not None:
                 # The row completed BEFORE the failure — deliver its
                 # result; undelivered tokens ride the stream first.
-                if stream_q is not None:
-                    for j in range(s['sent'], len(s['out'])):
-                        stream_q.put_nowait(
-                            (s['out'][j], s['lps'][j], s['tops'][j]))
-                    stream_q.put_nowait(None)
                 self._finish_timing(i, s)
-                if fut is not None and not fut.done():
-                    fut.set_result((s['out'], s['finish'], s['lps'],
-                                    s['tops']))
                 if fut is not None:
                     self._resurrect_counts.pop(id(fut), None)
+
+                def apply(s=s, fut=fut, stream_q=stream_q) -> None:
+                    if stream_q is not None:
+                        for j in range(s['sent'], len(s['out'])):
+                            stream_q.put_nowait(
+                                (s['out'][j], s['lps'][j], s['tops'][j]))
+                        stream_q.put_nowait(None)
+                    if fut is not None and not fut.done():
+                        fut.set_result((s['out'], s['finish'],
+                                        s['lps'], s['tops']))
+                deliver.append(apply)
                 continue
             emitted = len(s['out'])
             item = s.get('item') or (s.get('prefill') or {}).get('item')
@@ -3428,7 +3443,14 @@ class InferenceEngine:
                     continue
                 fail(fut, item[-2], 0)
         try:
-            self._reset_device_state(reason=f'{type(e).__name__}: {e}')
+            # Off-loop: the rebuild snapshots the flight ring into the
+            # sqlite journal (a connect can retry-sleep) and allocates
+            # a fresh device pool — neither may stall the event loop
+            # while other handlers are answering /health or queuing
+            # requests. The deferred dispositions run on the loop
+            # AFTER this lands (see `deliver` above).
+            await asyncio.to_thread(self._reset_device_state,
+                                    reason=f'{type(e).__name__}: {e}')
         except BaseException:
             # The rebuild ITSELF failed: the engine cannot serve.
             # The set-aside requests must not hang on futures nobody
@@ -3438,7 +3460,11 @@ class InferenceEngine:
             for item in resurrected:
                 fail(item[-1], item[-2], 0)
             resurrected.clear()
+            for apply in deliver:
+                apply()
             raise
+        for apply in deliver:
+            apply()
         if resurrected:
             # Front of the hold queue, original admission order:
             # resurrected requests are older than anything held or

@@ -212,8 +212,8 @@ class TestEngineFaultContainment:
             engine.slots[2] = entry(fut_pre, [], None,
                                     prefill_item=item_pre)
             try:
-                engine._fail_all(RuntimeError('boom'),
-                                 extra=[item_queued])
+                await engine._fail_all(RuntimeError('boom'),
+                                       extra=[item_queued])
                 out, finish, _, _ = fut_done.result()
                 assert (out, finish) == ([7, 8], 'length')
                 with pytest.raises(engine_lib.EngineResetError) as ei:
