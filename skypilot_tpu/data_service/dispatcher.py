@@ -302,10 +302,19 @@ class Dispatcher:
         worker_id = str(obj['worker_id'])
         addr = str(obj['addr'])
         conn = self._conn()
+        # Status write OUTSIDE _assign_lock: the setter is its own
+        # BEGIN IMMEDIATE transaction, and holding the lock across a
+        # commit would stall every other handler thread behind
+        # sqlite's WAL-contention retry sleep. The lock only
+        # serializes plan *computation*; applying the plan is safe
+        # unlocked because set_split_status is per-row guarded and
+        # reassignment is at-least-once by construction.
+        old, changed = set_worker_status(
+            conn, worker_id, DataWorkerStatus.ALIVE, addr=addr)
         with self._assign_lock:
-            old, changed = set_worker_status(
-                conn, worker_id, DataWorkerStatus.ALIVE, addr=addr)
-            self._rebalance(conn)
+            plan = self._plan_rebalance(conn)
+        if plan:
+            set_split_status(conn, plan)
         reply = self._routes()
         reply.update(ok=True, rejoined=bool(old is not None and changed))
         return reply
@@ -405,14 +414,19 @@ class Dispatcher:
 
     # ----------------------------------------------------- assignment
 
-    def _rebalance(self, conn: sqlite3.Connection) -> Dict[int, str]:
-        """Assign every orphaned/UNASSIGNED split to the least-loaded
-        ALIVE worker, then level the load (a freshly joined worker
-        must take splits from the incumbents — input capacity scales
-        only if assignments follow the pool). Deterministic (sorted
-        ids, stable moves) so concurrent rebalances converge to the
-        same layout; batches being pure functions of step makes every
-        interim double-ownership harmless."""
+    def _plan_rebalance(self, conn: sqlite3.Connection
+                        ) -> Dict[int, str]:
+        """Plan (do not apply): assign every orphaned/UNASSIGNED
+        split to the least-loaded ALIVE worker, then level the load
+        (a freshly joined worker must take splits from the
+        incumbents — input capacity scales only if assignments follow
+        the pool). Deterministic (sorted ids, stable moves) so
+        concurrent rebalances converge to the same layout; batches
+        being pure functions of step makes every interim
+        double-ownership harmless. Pure reads + compute so callers
+        can run it under ``_assign_lock`` without holding the lock
+        across a commit; the plan is applied OUTSIDE the lock via
+        ``set_split_status`` (its own guarded transaction)."""
         alive = [w for (w,) in conn.execute(
             'SELECT worker_id FROM workers WHERE status = ? '
             'ORDER BY worker_id',
@@ -442,9 +456,6 @@ class Dispatcher:
             moved = owned[most].pop()   # highest id: stable choice
             plan[moved] = least
             owned[least].append(moved)
-        if not plan:
-            return {}
-        set_split_status(conn, plan)
         return plan
 
     def _reap_loop(self) -> None:
@@ -470,31 +481,38 @@ class Dispatcher:
                 'WHERE status = ?)',
                 (DataSplitStatus.ASSIGNED.value,
                  DataWorkerStatus.ALIVE.value)).fetchone()[0]
-            if orphans:
-                plan = self._rebalance(conn)
-                if plan:
-                    journal.record_event(
-                        'data_worker_reassign', 'dispatcher',
-                        reason='orphan_sweep',
-                        data={'to': {str(k): v
-                                     for k, v in plan.items()}})
+            plan = self._plan_rebalance(conn) if orphans else {}
+        # Apply + journal outside the lock: both commit to sqlite and
+        # can sleep on WAL contention; a register RPC must not stall
+        # behind the reaper's bookkeeping.
+        if plan:
+            set_split_status(conn, plan)
+            journal.record_event(
+                'data_worker_reassign', 'dispatcher',
+                reason='orphan_sweep',
+                data={'to': {str(k): v for k, v in plan.items()}})
         cutoff = time.time() - self._heartbeat_timeout
         stale = [w for (w,) in conn.execute(
             'SELECT worker_id FROM workers WHERE status = ? AND '
             'last_heartbeat < ?',
             (DataWorkerStatus.ALIVE.value, cutoff)).fetchall()]
         for worker_id in stale:
+            # The LOST write needs no lock: require_heartbeat_before
+            # makes it a compare-and-set inside the setter's own
+            # transaction, so a concurrent heartbeat wins cleanly.
+            _, changed = set_worker_status(
+                conn, worker_id, DataWorkerStatus.LOST,
+                reason='heartbeat_timeout',
+                require_heartbeat_before=cutoff)
+            if not changed:
+                continue
             with self._assign_lock:
-                _, changed = set_worker_status(
-                    conn, worker_id, DataWorkerStatus.LOST,
-                    reason='heartbeat_timeout',
-                    require_heartbeat_before=cutoff)
-                if not changed:
-                    continue
                 orphaned = [s for (s,) in conn.execute(
                     'SELECT split_id FROM splits WHERE worker_id = ?',
                     (worker_id,)).fetchall()]
-                plan = self._rebalance(conn)
+                plan = self._plan_rebalance(conn)
+            if plan:
+                set_split_status(conn, plan)
             journal.record_event(
                 'data_worker_reassign', worker_id,
                 reason='heartbeat_timeout',
