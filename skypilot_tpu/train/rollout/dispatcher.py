@@ -183,6 +183,8 @@ def set_rollout_worker_status(
 def set_lease_status(
         conn: sqlite3.Connection,
         changes: List[Tuple[int, 'RolloutLeaseStatus', Optional[str]]],
+        *,
+        require_owner: Optional[str] = None,
 ) -> List[Tuple[int, str, str]]:
     """THE lease-status write path: bulk edges in ONE transaction.
 
@@ -192,17 +194,23 @@ def set_lease_status(
     the machine's entry point). Transitions not declared in
     ``ROLLOUT_LEASE_TRANSITIONS`` are refused silently (the caller's
     plan raced a faster writer — at-least-once semantics make that
-    harmless). Returns the applied ``(lease_id, old, new)`` edges.
+    harmless). ``require_owner`` makes every edge conditional on the
+    lease's CURRENT owner — a compare-and-set inside this
+    transaction, so callers never need to hold a process lock across
+    the read and the write (the owner check and the status flip are
+    atomic at the DB). Returns the applied ``(lease_id, old, new)``
+    edges.
     """
     applied: List[Tuple[int, str, str]] = []
     now = time.time()
     with sqlite_utils.immediate(conn):
         for lease_id, new, worker_id in changes:
             row = conn.execute(
-                'SELECT status FROM leases WHERE lease_id = ?',
-                (lease_id,)).fetchone()
+                'SELECT status, worker_id FROM leases '
+                'WHERE lease_id = ?', (lease_id,)).fetchone()
             if row is None:
-                if new is not RolloutLeaseStatus.PENDING:
+                if new is not RolloutLeaseStatus.PENDING or \
+                        require_owner is not None:
                     continue
                 conn.execute(
                     'INSERT INTO leases (lease_id, status, worker_id, '
@@ -210,7 +218,9 @@ def set_lease_status(
                     (lease_id, new.value, now))
                 applied.append((lease_id, '', new.value))
                 continue
-            old = row[0]
+            old, old_owner = row
+            if require_owner is not None and old_owner != require_owner:
+                continue
             if old == new.value or not state_machines.can_transition(
                     state_machines.ROLLOUT_LEASE_TRANSITIONS, old,
                     new.value):
@@ -245,11 +255,16 @@ class RolloutDispatcher:
         self._max_outstanding = max(1, max_outstanding)
         self._local = threading.local()
         self._stop = threading.Event()
-        # Serializes every read-plan-apply lease sequence (lease
-        # handler, reaper sweeps): the writes are transactional, but a
-        # plan computed from a stale read and committed last could
-        # double-lease — and this process is the DB's only writer, so
-        # a process lock makes each sequence atomic.
+        # Serializes the lease handler's read-plan phase (bounding
+        # over-mint between concurrent lease RPCs). NEVER held across
+        # a commit: every write is its own guarded transaction whose
+        # compare-and-set refuses a plan that raced a faster writer
+        # (``set_lease_status`` returns the edges that actually
+        # applied; ``require_owner`` makes release owner-conditional;
+        # ``_mint_ids`` reserves the id counter atomically), so
+        # correctness comes from the DB — right even across processes
+        # — and no handler thread ever stalls behind another's
+        # WAL-contention retry sleep.
         self._assign_lock = threading.Lock()
         # Completed trajectory groups awaiting the learner. Bounded:
         # when full, the oldest (stalest — the learner would likely
@@ -309,6 +324,23 @@ class RolloutDispatcher:
                 'INSERT INTO meta (key, value) VALUES (?, ?) '
                 'ON CONFLICT(key) DO UPDATE SET value = excluded.value',
                 (key, value))
+
+    def _mint_ids(self, conn: sqlite3.Connection, n: int) -> List[int]:
+        """Reserve ``n`` fresh lease ids: the counter's
+        read-increment-write is ONE BEGIN IMMEDIATE transaction, so
+        sqlite's write lock is the arbiter and no Python lock is
+        needed — concurrent minters get disjoint ranges even across
+        processes."""
+        with sqlite_utils.immediate(conn):
+            row = conn.execute(
+                "SELECT value FROM meta WHERE key = 'next_lease_id'"
+            ).fetchone()
+            next_id = int(row[0]) if row else 0
+            conn.execute(
+                'INSERT INTO meta (key, value) VALUES (?, ?) '
+                'ON CONFLICT(key) DO UPDATE SET value = excluded.value',
+                ('next_lease_id', str(next_id + n)))
+        return list(range(next_id, next_id + n))
 
     def snapshot_version(self) -> int:
         return int(self._meta_get('snapshot_version') or -1)
@@ -444,12 +476,14 @@ class RolloutDispatcher:
         if row is None or row[0] != RolloutWorkerStatus.ALIVE.value:
             return {'ok': False, 'resync': True}
         with self._assign_lock:
+            # Reads + arithmetic only — the lock bounds over-minting
+            # between concurrent lease RPCs, never a commit.
             pending = [l for (l,) in conn.execute(
                 'SELECT lease_id FROM leases WHERE status = ? '
                 'ORDER BY lease_id LIMIT ?',
                 (RolloutLeaseStatus.PENDING.value, max_n)).fetchall()]
-            minted: List[int] = []
             want_new = max_n - len(pending)
+            to_mint = 0
             if want_new > 0:
                 outstanding = int(conn.execute(
                     'SELECT COUNT(*) FROM leases WHERE status != ?',
@@ -462,24 +496,27 @@ class RolloutDispatcher:
                 headroom = min(
                     self._max_outstanding - outstanding,
                     (self._results.maxlen or 1) - backlog - outstanding)
-                if headroom > 0:
-                    next_id = int(self._meta_get('next_lease_id') or 0)
-                    minted = list(range(next_id,
-                                        next_id + min(want_new,
-                                                      headroom)))
-                    if minted:
-                        self._meta_set(conn, 'next_lease_id',
-                                       str(minted[-1] + 1))
-                        set_lease_status(conn, [
-                            (l, RolloutLeaseStatus.PENDING, None)
-                            for l in minted])
-                        telemetry.LEASES.inc(len(minted),
-                                             event='minted')
-            grant = pending + minted
+                to_mint = min(want_new, max(0, headroom))
+        # Writes OUTSIDE the lock: each sets its own transaction and
+        # can sleep on WAL contention or an armed sqlite.commit
+        # failpoint — other handler threads must keep moving.
+        minted: List[int] = []
+        if to_mint > 0:
+            minted = self._mint_ids(conn, to_mint)
+            set_lease_status(conn, [
+                (l, RolloutLeaseStatus.PENDING, None) for l in minted])
+            telemetry.LEASES.inc(len(minted), event='minted')
+        grant: List[int] = []
+        if pending or minted:
+            # The grant is whatever the guarded setter ACTUALLY
+            # applied: a concurrent granter of the same PENDING ids
+            # loses cleanly (LEASED -> LEASED refused) instead of two
+            # workers both believing they own the lease.
+            applied = set_lease_status(conn, [
+                (l, RolloutLeaseStatus.LEASED, worker_id)
+                for l in pending + minted])
+            grant = [l for l, _, _ in applied]
             if grant:
-                set_lease_status(conn, [
-                    (l, RolloutLeaseStatus.LEASED, worker_id)
-                    for l in grant])
                 telemetry.LEASES.inc(len(grant), event='leased')
         return {'ok': True, 'leases': grant,
                 'spec_fp': self.spec_fp(),
@@ -500,7 +537,14 @@ class RolloutDispatcher:
                 f'side', kind='spec_mismatch')
         traj = self._validate_trajectory(lease_id, version, arrays)
         conn = self._conn()
-        with self._assign_lock:
+        # Apply first, diagnose on refusal: the guarded setter's
+        # transaction is the arbiter (DONE is terminal, so the first
+        # writer wins atomically) — no lock held across the commit,
+        # and no check-then-act window between a status read and the
+        # write.
+        applied = set_lease_status(
+            conn, [(lease_id, RolloutLeaseStatus.DONE, None)])
+        if not applied:
             row = conn.execute(
                 'SELECT status FROM leases WHERE lease_id = ?',
                 (lease_id,)).fetchone()
@@ -513,13 +557,10 @@ class RolloutDispatcher:
                 telemetry.LEASES.inc(event='duplicate')
                 return {'ok': True, 'accepted': False,
                         'duplicate': True}
-            applied = set_lease_status(
-                conn, [(lease_id, RolloutLeaseStatus.DONE, None)])
-            if not applied:
-                raise framed.RemoteError(
-                    f'lease {lease_id} refused DONE from {row[0]}',
-                    kind='bad_transition')
-            telemetry.LEASES.inc(event='done')
+            raise framed.RemoteError(
+                f'lease {lease_id} refused DONE from {row[0]}',
+                kind='bad_transition')
+        telemetry.LEASES.inc(event='done')
         with self._results_lock:
             self._results.append(traj)
             telemetry.QUEUE_DEPTH.set(float(len(self._results)),
@@ -557,16 +598,17 @@ class RolloutDispatcher:
         worker_id = str(obj['worker_id'])
         lease_id = int(obj['lease_id'])
         conn = self._conn()
-        with self._assign_lock:
-            row = conn.execute(
-                'SELECT status, worker_id FROM leases '
-                'WHERE lease_id = ?', (lease_id,)).fetchone()
-            if row is None or row[0] != RolloutLeaseStatus.LEASED.value \
-                    or row[1] != worker_id:
-                return {'ok': True, 'released': False}
-            set_lease_status(
-                conn, [(lease_id, RolloutLeaseStatus.PENDING, None)])
-            telemetry.LEASES.inc(event='released')
+        # Owner-conditional compare-and-set inside the setter's own
+        # transaction: "only the current owner may release" holds
+        # without holding a process lock across the commit (a lease
+        # reassigned-and-re-leased between any read here and the
+        # write can no longer be released by its old owner).
+        applied = set_lease_status(
+            conn, [(lease_id, RolloutLeaseStatus.PENDING, None)],
+            require_owner=worker_id)
+        if not applied:
+            return {'ok': True, 'released': False}
+        telemetry.LEASES.inc(event='released')
         return {'ok': True, 'released': True}
 
     def _op_collect(self, obj: Dict[str, Any]
@@ -640,8 +682,11 @@ class RolloutDispatcher:
                   entity: str, reason: str) -> None:
         applied = set_lease_status(conn, [
             (l, RolloutLeaseStatus.PENDING, None) for l in lease_ids])
-        if applied:
-            telemetry.LEASES.inc(len(applied), event='reassigned')
+        if not applied:
+            # A faster writer (submit, release, another sweep) moved
+            # every lease first — nothing happened, journal nothing.
+            return
+        telemetry.LEASES.inc(len(applied), event='reassigned')
         journal.record_event(
             'rollout_lease_reassign', entity, reason=reason,
             data={'leases': [l for l, _, _ in applied]})
@@ -656,16 +701,21 @@ class RolloutDispatcher:
             'last_heartbeat < ?',
             (RolloutWorkerStatus.ALIVE.value, cutoff)).fetchall()]
         for worker_id in stale:
-            with self._assign_lock:
-                _, changed = set_rollout_worker_status(
-                    conn, worker_id, RolloutWorkerStatus.LOST,
-                    reason='heartbeat_timeout',
-                    require_heartbeat_before=cutoff)
-                if not changed:
-                    continue
-                orphaned = self._leases_of(conn, worker_id)
-                self._reassign(conn, orphaned, worker_id,
-                               'heartbeat_timeout')
+            # No lock: the LOST write is a compare-and-set
+            # (require_heartbeat_before) in its own transaction, and
+            # the reassign's LEASED -> PENDING edges are refused by
+            # the setter for any lease a faster writer already moved.
+            # A lease acquired between the two is caught by the
+            # orphan sweep below.
+            _, changed = set_rollout_worker_status(
+                conn, worker_id, RolloutWorkerStatus.LOST,
+                reason='heartbeat_timeout',
+                require_heartbeat_before=cutoff)
+            if not changed:
+                continue
+            orphaned = self._leases_of(conn, worker_id)
+            self._reassign(conn, orphaned, worker_id,
+                           'heartbeat_timeout')
             logger.warning(
                 f'rollout worker {worker_id} lost (no heartbeat for '
                 f'{self._heartbeat_timeout}s); reassigned leases '
@@ -673,27 +723,25 @@ class RolloutDispatcher:
         # 2. Orphan sweep: LEASED leases owned by a non-ALIVE worker —
         # a crash between the LOST write and its reassignment would
         # otherwise strand them forever (survivors only heartbeat).
-        with self._assign_lock:
-            orphans = [l for (l,) in conn.execute(
-                'SELECT lease_id FROM leases WHERE status = ? AND '
-                '(worker_id IS NULL OR worker_id NOT IN '
-                '(SELECT worker_id FROM workers WHERE status = ?))',
-                (RolloutLeaseStatus.LEASED.value,
-                 RolloutWorkerStatus.ALIVE.value)).fetchall()]
-            if orphans:
-                self._reassign(conn, orphans, 'dispatcher',
-                               'orphan_sweep')
+        orphans = [l for (l,) in conn.execute(
+            'SELECT lease_id FROM leases WHERE status = ? AND '
+            '(worker_id IS NULL OR worker_id NOT IN '
+            '(SELECT worker_id FROM workers WHERE status = ?))',
+            (RolloutLeaseStatus.LEASED.value,
+             RolloutWorkerStatus.ALIVE.value)).fetchall()]
+        if orphans:
+            self._reassign(conn, orphans, 'dispatcher',
+                           'orphan_sweep')
         # 3. Lease timeout: a wedged-but-heartbeating owner cannot sit
         # on a lease forever (at-least-once makes re-execution safe).
-        with self._assign_lock:
-            timed_out = [l for (l,) in conn.execute(
-                'SELECT lease_id FROM leases WHERE status = ? AND '
-                'assigned_ts < ?',
-                (RolloutLeaseStatus.LEASED.value,
-                 now - self._lease_timeout)).fetchall()]
-            if timed_out:
-                self._reassign(conn, timed_out, 'dispatcher',
-                               'lease_timeout')
+        timed_out = [l for (l,) in conn.execute(
+            'SELECT lease_id FROM leases WHERE status = ? AND '
+            'assigned_ts < ?',
+            (RolloutLeaseStatus.LEASED.value,
+             now - self._lease_timeout)).fetchall()]
+        if timed_out:
+            self._reassign(conn, timed_out, 'dispatcher',
+                           'lease_timeout')
         # 4. DONE-row GC: keep a bounded accounting tail.
         with sqlite_utils.immediate(conn):
             row = conn.execute(
