@@ -450,8 +450,12 @@ class ScrapeLoop:
         # READY, the loadgen harness's settle()) and may be called
         # from another thread while the loop thread is mid-round —
         # on_round hooks (SLO evaluation mutates per-spec state
-        # machines) are not written for concurrent entry.
-        self._round_lock = threading.Lock()
+        # machines) are not written for concurrent entry. A round is
+        # seconds of network + sqlite, so serialization uses a
+        # condition-variable gate (held only for flag flips), never a
+        # mutex held across the blocking work itself.
+        self._round_cv = threading.Condition()
+        self._round_active = False
 
     def start(self) -> None:
         if self._thread is not None:
@@ -472,7 +476,11 @@ class ScrapeLoop:
         Rounds are serialized: a forced round from another thread
         waits out the loop thread's in-flight round instead of
         racing its on_round hook."""
-        with self._round_lock:
+        with self._round_cv:
+            while self._round_active:
+                self._round_cv.wait()
+            self._round_active = True
+        try:
             results = self.scraper.scrape_round()
             if self.on_round is not None:
                 try:
@@ -481,6 +489,10 @@ class ScrapeLoop:
                     logger.warning('scrape on_round hook failed:',
                                    exc_info=True)
             return results
+        finally:
+            with self._round_cv:
+                self._round_active = False
+                self._round_cv.notify_all()
 
     def _run(self) -> None:
         while not self._stop.is_set():
