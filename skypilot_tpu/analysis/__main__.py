@@ -1,9 +1,11 @@
 """skylint CLI: `python -m skypilot_tpu.analysis` / `skylint`.
 
-Exit codes: 0 clean (all violations allowlisted, no stale entries),
-1 new violations or stale allowlist entries (the ratchet: an entry
-matching nothing must be deleted — or run ``--prune`` to rewrite the
-file), 2 usage error.
+Exit codes: 0 clean (all violations allowlisted, no stale or expired
+entries), 1 new violations, stale allowlist entries (the ratchet: an
+entry matching nothing must be deleted — or run ``--prune`` to
+rewrite the file) or EXPIRED allowlist entries (an entry may carry
+``# expires: YYYY-MM-DD``; past the date it fails loudly so a
+grandfathered finding can't fossilize), 2 usage error.
 
 Modes:
   * full scan (default) — the tier-1 gate.
@@ -11,6 +13,9 @@ Modes:
     <--base>`` plus untracked files: the fast pre-commit hook (see
     .pre-commit-config.yaml). Stale-entry ratcheting is scoped away
     automatically (an entry for an unchanged file is not stale).
+  * ``--diff baseline.json`` — incremental mode: report only
+    violations not present in a prior ``--format json`` report, so a
+    PR diff shows exactly the newly-introduced findings.
 
 Defaults for --root/--allowlist can live in ``[tool.skylint]`` in
 pyproject.toml (keys ``root`` and ``allowlist``, relative to the
@@ -19,6 +24,7 @@ pyproject directory); CLI flags win.
 from __future__ import annotations
 
 import argparse
+import datetime
 import json
 import os
 import re
@@ -141,8 +147,51 @@ def build_parser() -> argparse.ArgumentParser:
                         help='Rewrite the allowlist file dropping '
                              'stale (burned-down) entries instead of '
                              'failing on them.')
+    parser.add_argument('--diff', metavar='BASELINE_JSON',
+                        default=None,
+                        help='Incremental mode: report only '
+                             'violations NOT present in a baseline '
+                             'JSON report (a prior --format json '
+                             'run). Matching is ident-based '
+                             '(check:path:key) and count-aware; the '
+                             'stale-entry ratchet is skipped (a '
+                             'diff is a fast path, not the gate).')
     parser.add_argument('--list-checks', action='store_true')
     return parser
+
+
+def _apply_diff(report: Dict, baseline_path: str) -> Optional[str]:
+    """Drop violations already present in the baseline report,
+    count-aware: a baseline with two `foo:bar.py:baz` entries absorbs
+    two current ones; the third is new. Mutates ``report`` (the
+    violations list, totals, and a ``baseline`` marker) in place;
+    returns an error string on an unreadable baseline."""
+    try:
+        with open(baseline_path, 'r', encoding='utf-8') as f:
+            base = json.load(f)
+        base_idents = [f"{v['check']}:{v['path']}:{v['key']}"
+                       for v in base['violations']]
+    except (OSError, ValueError, KeyError, TypeError) as e:
+        return f'unreadable baseline {baseline_path!r}: {e}'
+    budget: Dict[str, int] = {}
+    for ident in base_idents:
+        budget[ident] = budget.get(ident, 0) + 1
+    kept = []
+    suppressed = 0
+    for v in report['violations']:
+        ident = f"{v['check']}:{v['path']}:{v['key']}"
+        if budget.get(ident, 0) > 0:
+            budget[ident] -= 1
+            suppressed += 1
+            continue
+        kept.append(v)
+    report['violations'] = kept
+    report['total'] = len(kept)
+    report['allowlisted'] = sum(1 for v in kept if v['allowlisted'])
+    report['new'] = report['total'] - report['allowlisted']
+    report['baseline'] = os.path.abspath(baseline_path)
+    report['suppressed_by_baseline'] = suppressed
+    return None
 
 
 def main(argv=None) -> int:
@@ -155,6 +204,10 @@ def main(argv=None) -> int:
         print('skylint: --prune needs a full scan; drop --changed',
               file=sys.stderr)
         return 2
+    if args.prune and args.diff:
+        print('skylint: --prune needs the full picture; drop --diff',
+              file=sys.stderr)
+        return 2
 
     config = load_pyproject_config(args.root or os.getcwd())
     root = args.root or config.get('root') or analysis.default_root()
@@ -164,11 +217,15 @@ def main(argv=None) -> int:
         return 2
 
     allowlist: List[str] = []
+    expired: List = []
     allowlist_path = (args.allowlist or config.get('allowlist') or
                       analysis.default_allowlist_path())
     if not args.no_allowlist:
         if os.path.exists(allowlist_path):
-            allowlist = core.load_allowlist(allowlist_path)
+            entries = core.load_allowlist_entries(allowlist_path)
+            allowlist = [ident for ident, _ in entries]
+            today = datetime.date.today().isoformat()
+            expired = core.expired_allowlist_entries(entries, today)
         elif args.allowlist:
             print(f'skylint: allowlist {allowlist_path!r} not found',
                   file=sys.stderr)
@@ -194,6 +251,15 @@ def main(argv=None) -> int:
     except ValueError as e:
         print(f'skylint: {e}', file=sys.stderr)
         return 2
+
+    if args.diff:
+        err = _apply_diff(report, args.diff)
+        if err is not None:
+            print(f'skylint: {err}', file=sys.stderr)
+            return 2
+        # A diff run is a fast path over a known-good baseline — the
+        # stale ratchet belongs to the full gate, not here.
+        report['stale_allowlist_entries'] = []
 
     stale = list(report['stale_allowlist_entries'])
     if stale and args.prune:
@@ -227,7 +293,17 @@ def main(argv=None) -> int:
         for entry in stale:
             print(f'skylint: stale allowlist entry (burned down — '
                   f'delete it or run --prune): {entry}')
+    for ident, expires in expired:
+        # Loudly, on stderr, in every format: an expired entry means
+        # the grandfathering deadline passed with the violation still
+        # in place — fix it or renegotiate the date.
+        print(f'skylint: EXPIRED allowlist entry (deadline '
+              f'{expires}): {ident} — fix the violation or move '
+              f'the expires: date with a justification',
+              file=sys.stderr)
     if report['new']:
+        return 1
+    if expired:
         return 1
     if stale:
         # The ratchet: an allowlist only shrinks. A stale entry means
