@@ -20,10 +20,12 @@ import shutil
 import subprocess
 import sys
 import textwrap
+import time
 
 import pytest
 
 from skypilot_tpu import analysis
+from skypilot_tpu.analysis import callgraph
 from skypilot_tpu.analysis import core
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -39,7 +41,8 @@ EXPECTED_CHECKS = [
     'paged-view-materialization', 'sqlite-discipline',
     'state-machine', 'thread-discipline', 'silent-except',
     'metric-discipline', 'span-discipline', 'timeout-discipline',
-    'failpoint-naming', 'backoff-discipline',
+    'failpoint-naming', 'backoff-discipline', 'lock-ordering',
+    'jit-boundary',
 ]
 
 
@@ -1402,6 +1405,612 @@ class TestBackoffDisciplineChecker:
         assert _run(tmp_path, checks=['backoff-discipline'])['total'] == 0
 
 
+# ------------------------------------------------------------ call graph (v15)
+
+def _graph(root):
+    mods = []
+    for path in core.iter_py_files(str(root)):
+        info = core.module_info(str(root), path)
+        if info is not None:
+            mods.append(info)
+    return callgraph.build(mods)
+
+
+class TestCallGraph:
+    """Property tests for the v15 whole-program engine: indexing and
+    summary propagation over the structural shapes that historically
+    hid call edges (try/finally, with-bodies, nested defs,
+    decorator-wrapped defs, lazy imports, executor trampolines)."""
+
+    def test_nested_and_decorated_defs_indexed(self, tmp_path):
+        _write(tmp_path, 'serve/m.py', '''\
+            import functools
+
+            def deco(f):
+                return f
+
+            @deco
+            def outer():
+                def inner():
+                    pass
+                inner()
+
+            class Box:
+                @functools.lru_cache()
+                def method(self):
+                    pass
+        ''')
+        g = _graph(tmp_path)
+        base = 'skypilot_tpu.serve.m'
+        # Decoration does not change the binding: outer is indexed
+        # under its own name; nested defs under their lexical parent;
+        # methods under their class.
+        assert f'{base}:outer' in g.funcs
+        assert f'{base}:outer.inner' in g.funcs
+        assert f'{base}:Box.method' in g.funcs
+        # The call inside outer resolves to the NESTED inner.
+        (site,) = [s for s in g.calls[f'{base}:outer']
+                   if s.label == 'inner']
+        assert site.callee == f'{base}:outer.inner'
+
+    def test_blocking_propagates_through_try_finally_and_with(
+            self, tmp_path):
+        _write(tmp_path, 'serve/m.py', '''\
+            import time
+
+            def slow():
+                time.sleep(1)
+
+            def in_finally():
+                try:
+                    pass
+                finally:
+                    slow()
+
+            def in_with(resource):
+                with resource:
+                    slow()
+        ''')
+        g = _graph(tmp_path)
+        base = 'skypilot_tpu.serve.m'
+        assert g.blocks[f'{base}:slow'][0] == ('time.sleep',)
+        assert g.blocks[f'{base}:in_finally'][0] == \
+            ('slow', 'time.sleep')
+        assert g.blocks[f'{base}:in_with'][0] == \
+            ('slow', 'time.sleep')
+
+    def test_cross_module_edge_through_lazy_import(self, tmp_path):
+        # Lazy (function-level) imports are the control plane's
+        # sanctioned idiom — and exactly where call edges hide.
+        _write(tmp_path, 'serve/io_util.py', '''\
+            import time
+
+            def flush():
+                time.sleep(0.5)
+        ''')
+        _write(tmp_path, 'serve/mgr.py', '''\
+            def commit():
+                from skypilot_tpu.serve.io_util import flush
+                flush()
+        ''')
+        g = _graph(tmp_path)
+        assert g.blocks['skypilot_tpu.serve.mgr:commit'] == \
+            (('flush', 'time.sleep'), 4)
+
+    def test_executor_edges_split_blocking_and_device_get(
+            self, tmp_path):
+        _write(tmp_path, 'serve/m.py', '''\
+            import asyncio
+            import jax
+            import time
+
+            def work():
+                time.sleep(1)
+
+            def fetch(x):
+                return jax.device_get(x)
+
+            async def runner(x):
+                await asyncio.to_thread(work)
+                await asyncio.to_thread(fetch, x)
+        ''')
+        g = _graph(tmp_path)
+        base = 'skypilot_tpu.serve.m'
+        # Shipping blocking work to a thread is the sanctioned
+        # remediation: no blocks summary through the trampoline...
+        assert f'{base}:runner' not in g.blocks
+        # ...but the device→host transfer still happens once per call.
+        assert f'{base}:runner' in g.device_gets
+
+    def test_device_get_propagates_must_execute_only(self, tmp_path):
+        _write(tmp_path, 'serve/m.py', '''\
+            import jax
+
+            def always(x):
+                return jax.device_get(x)
+
+            def guarded(x, i, every):
+                if i % every == 0:
+                    return jax.device_get(x)
+                return None
+
+            def caller_of_guarded(x, i):
+                return guarded(x, i, 32)
+
+            def conditional_call(x, flag):
+                if flag:
+                    return always(x)
+                return None
+
+            def after_early_exit(x, ready):
+                if not ready:
+                    return None
+                return jax.device_get(x)
+        ''')
+        g = _graph(tmp_path)
+        base = 'skypilot_tpu.serve.m'
+        assert f'{base}:always' in g.device_gets
+        # A guarded fetch is the sanctioned remediation — and the
+        # sanction survives the guard living one call deeper.
+        assert f'{base}:guarded' not in g.device_gets
+        assert f'{base}:caller_of_guarded' not in g.device_gets
+        # A conditional CALL of an always-fetching helper is likewise
+        # not a must-fetch for the caller.
+        assert f'{base}:conditional_call' not in g.device_gets
+        # Past a conditional early exit nothing is a must-call.
+        assert f'{base}:after_early_exit' not in g.device_gets
+
+
+class TestWholeProgramSummaries:
+    """The v14 one-hop checkers, upgraded to fully transitive through
+    the shared call graph — a helper chain of any depth, across
+    modules."""
+
+    def test_async_blocking_transitive_cross_module(self, tmp_path):
+        _write(tmp_path, 'serve/io_util.py', '''\
+            import time
+
+            def flush():
+                time.sleep(0.5)
+        ''')
+        _write(tmp_path, 'serve/api.py', '''\
+            import asyncio
+
+            from skypilot_tpu.serve.io_util import flush
+
+            async def bad(req):
+                flush()
+
+            async def good(req):
+                await asyncio.to_thread(flush)
+        ''')
+        report = _run(tmp_path, checks=['async-blocking'])
+        assert _idents(report) == [
+            'async-blocking:serve/api.py:flush->time.sleep']
+        (v,) = report['violations']
+        assert 'reaches blocking' in v['message']
+        assert 'serve/io_util.py' in v['message']
+
+    def test_blocking_under_lock_transitive_cross_module(
+            self, tmp_path):
+        _write(tmp_path, 'serve/io_util.py', '''\
+            import time
+
+            def flush():
+                time.sleep(0.5)
+        ''')
+        _write(tmp_path, 'serve/mgr.py', '''\
+            import threading
+
+            from skypilot_tpu.serve.io_util import flush
+
+            _STATE_LOCK = threading.Lock()
+
+            def commit():
+                with _STATE_LOCK:
+                    flush()
+        ''')
+        report = _run(tmp_path, checks=['thread-discipline'])
+        assert ('thread-discipline:serve/mgr.py:'
+                '_STATE_LOCK->flush->time.sleep') in _idents(report)
+        # Every finding points at the call site under the lock, not
+        # into the (innocent-by-itself) helper module.
+        assert all(v['path'] == 'serve/mgr.py' and v['line'] == 9
+                   for v in report['violations'])
+
+    def test_plan_under_lock_apply_outside_ok(self, tmp_path):
+        # The remediation shape the burn-down converged on: compute
+        # the plan under the lock, do the slow apply outside it.
+        _write(tmp_path, 'serve/io_util.py', '''\
+            import time
+
+            def flush():
+                time.sleep(0.5)
+        ''')
+        _write(tmp_path, 'serve/mgr.py', '''\
+            import threading
+
+            from skypilot_tpu.serve.io_util import flush
+
+            _STATE_LOCK = threading.Lock()
+
+            def commit():
+                with _STATE_LOCK:
+                    plan = compute_plan()
+                if plan:
+                    flush()
+        ''')
+        assert _run(tmp_path, checks=['thread-discipline'])['total'] \
+            == 0
+
+
+# ------------------------------------------------------------ lock-ordering
+
+class TestLockOrderingChecker:
+    """Interprocedural deadlock-order + data-race lint: the lock bugs
+    a test suite only catches probabilistically."""
+
+    def test_order_inversion_flagged(self, tmp_path):
+        _write(tmp_path, 'serve/pool.py', '''\
+            import threading
+
+            class Pool:
+                def __init__(self):
+                    self._slot_lock = threading.Lock()
+                    self._stats_lock = threading.Lock()
+
+                def grab(self):
+                    with self._slot_lock:
+                        with self._stats_lock:
+                            pass
+
+                def report(self):
+                    with self._stats_lock:
+                        with self._slot_lock:
+                            pass
+        ''')
+        report = _run(tmp_path, checks=['lock-ordering'])
+        keys = {v['key'] for v in report['violations']}
+        # Both halves of the cycle are reported — whichever thread a
+        # reader lands in first, the finding is local to it.
+        assert keys == {
+            'order:Pool._slot_lock->Pool._stats_lock',
+            'order:Pool._stats_lock->Pool._slot_lock'}
+        assert all('deadlock' in v['message']
+                   for v in report['violations'])
+
+    def test_consistent_global_order_ok(self, tmp_path):
+        _write(tmp_path, 'serve/pool.py', '''\
+            import threading
+
+            class Pool:
+                def __init__(self):
+                    self._slot_lock = threading.Lock()
+                    self._stats_lock = threading.Lock()
+
+                def grab(self):
+                    with self._slot_lock:
+                        with self._stats_lock:
+                            pass
+
+                def report(self):
+                    with self._slot_lock:
+                        with self._stats_lock:
+                            pass
+        ''')
+        assert _run(tmp_path, checks=['lock-ordering'])['total'] == 0
+
+    def test_regression_inversion_via_cross_function_call(
+            self, tmp_path):
+        # Regression fixture distilled from the rollout-dispatcher
+        # shape the burn-down fixed: assign() journals WHILE holding
+        # the assignment lock, and the flush path takes the same two
+        # locks in the opposite order. The inner acquire is one call
+        # away — invisible to any per-function analysis.
+        _write(tmp_path, 'train/rollout/disp.py', '''\
+            import threading
+
+            class Dispatcher:
+                def __init__(self):
+                    self._assign_lock = threading.Lock()
+                    self._journal_lock = threading.Lock()
+                    self._events = []
+
+                def _journal(self, event):
+                    with self._journal_lock:
+                        self._events.append(event)
+
+                def assign(self, worker):
+                    with self._assign_lock:
+                        self._journal(('assign', worker))
+
+                def flush(self):
+                    with self._journal_lock:
+                        with self._assign_lock:
+                            pass
+        ''')
+        report = _run(tmp_path, checks=['lock-ordering'])
+        by_key = {v['key']: v for v in report['violations']}
+        inv = ('order:Dispatcher._assign_lock->'
+               'Dispatcher._journal_lock')
+        assert inv in by_key
+        v = by_key[inv]
+        assert "via call to '_journal'" in v['message']
+
+    def test_reacquire_nonreentrant_lock_flagged(self, tmp_path):
+        _write(tmp_path, 'serve/box.py', '''\
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._state_lock = threading.Lock()
+
+                def put(self, v):
+                    with self._state_lock:
+                        self._store(v)
+
+                def _store(self, v):
+                    with self._state_lock:
+                        self._v = v
+        ''')
+        report = _run(tmp_path, checks=['lock-ordering'])
+        (v,) = report['violations']
+        assert v['key'] == 'reacquire:Box._state_lock'
+        assert 'deadlocks on itself' in v['message']
+
+    def test_reacquire_rlock_ok(self, tmp_path):
+        # Only a PROVABLE plain threading.Lock fires; RLock reenters.
+        _write(tmp_path, 'serve/box.py', '''\
+            import threading
+
+            class Box:
+                def __init__(self):
+                    self._state_lock = threading.RLock()
+
+                def put(self, v):
+                    with self._state_lock:
+                        self._store(v)
+
+                def _store(self, v):
+                    with self._state_lock:
+                        self._v = v
+        ''')
+        assert _run(tmp_path, checks=['lock-ordering'])['total'] == 0
+
+    def test_unlocked_write_race_flagged(self, tmp_path):
+        _write(tmp_path, 'serve/counter.py', '''\
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self._n += 1
+
+                def reset(self):
+                    self._n = 0
+        ''')
+        report = _run(tmp_path, checks=['lock-ordering'])
+        (v,) = report['violations']
+        assert v['key'] == 'race:Counter._n'
+        assert v['line'] == 13        # the bare write in reset()
+        # __init__'s write did NOT count: construction happens-before
+        # publication.
+
+    def test_consistently_locked_writes_ok(self, tmp_path):
+        _write(tmp_path, 'serve/counter.py', '''\
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self._n += 1
+
+                def reset(self):
+                    with self._lock:
+                        self._n = 0
+        ''')
+        assert _run(tmp_path, checks=['lock-ordering'])['total'] == 0
+
+    def test_setter_only_called_under_lock_ok(self, tmp_path):
+        # Interprocedural must-hold: a private setter whose EVERY call
+        # site holds the lock counts as locked, so the _locked-inner
+        # refactor the reacquire rule recommends does not trip the
+        # race rule.
+        _write(tmp_path, 'serve/held.py', '''\
+            import threading
+
+            class Held:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self._v = 0
+
+                def _store(self, v):
+                    self._v = v
+
+                def put(self, v):
+                    with self._lock:
+                        self._store(v)
+
+                def swap(self, v):
+                    with self._lock:
+                        self._store(v)
+        ''')
+        assert _run(tmp_path, checks=['lock-ordering'])['total'] == 0
+
+    def test_out_of_scope_paths_ignored(self, tmp_path):
+        # Scope is serve//train/rollout//loadgen/ — the planes the
+        # ROADMAP items grow; a utils-layer inversion is not ours.
+        _write(tmp_path, 'utils/pool.py', '''\
+            import threading
+
+            class Pool:
+                def __init__(self):
+                    self._slot_lock = threading.Lock()
+                    self._stats_lock = threading.Lock()
+
+                def grab(self):
+                    with self._slot_lock:
+                        with self._stats_lock:
+                            pass
+
+                def report(self):
+                    with self._stats_lock:
+                        with self._slot_lock:
+                            pass
+        ''')
+        assert _run(tmp_path, checks=['lock-ordering'])['total'] == 0
+
+
+# ------------------------------------------------------------ jit-boundary
+
+class TestJitBoundaryChecker:
+    """Retrace/donation hazards at the jit boundary — how compiled
+    callables are created and called (jit-hazards polices what happens
+    inside them)."""
+
+    def test_jit_in_loop_flagged(self, tmp_path):
+        _write(tmp_path, 'serve/hot.py', '''\
+            import jax
+
+            def drive(xs):
+                out = []
+                for x in xs:
+                    f = jax.jit(lambda y: y + 1)
+                    out.append(f(x))
+                return out
+        ''')
+        report = _run(tmp_path, checks=['jit-boundary'])
+        (v,) = report['violations']
+        assert v['key'] == 'jit-in-loop:drive'
+        assert 'retraces' in v['message']
+
+    def test_hoisted_and_memoized_forms_ok(self, tmp_path):
+        _write(tmp_path, 'serve/cold.py', '''\
+            import jax
+
+            def drive(xs, step):
+                f = jax.jit(step)
+                return [f(x) for x in xs]
+
+            def drive_memo(xs, step, cache):
+                for x in xs:
+                    if 'f' not in cache:
+                        cache['f'] = jax.jit(step)
+                    cache['f'](x)
+        ''')
+        assert _run(tmp_path, checks=['jit-boundary'])['total'] == 0
+
+    def test_regression_engine_loop_retrace(self, tmp_path):
+        # Regression fixture: the decode-engine shape where the step
+        # program was rebuilt (jax.jit of a fresh partial) inside the
+        # serve loop — every iteration recompiled. The fixed form
+        # hoists the wrap and passes.
+        _write(tmp_path, 'serve/engine.py', '''\
+            import functools
+
+            import jax
+
+            class Engine:
+                def serve_forever(self):
+                    while True:
+                        batch = self._next_batch()
+                        step = jax.jit(functools.partial(
+                            self._decode, batch.size))
+                        step(batch)
+
+                def serve_forever_fixed(self):
+                    step = jax.jit(self._decode)
+                    while True:
+                        batch = self._next_batch()
+                        step(batch)
+        ''')
+        report = _run(tmp_path, checks=['jit-boundary'])
+        (v,) = report['violations']
+        assert v['key'] == 'jit-in-loop:serve_forever'
+
+    def test_fresh_container_args_flagged(self, tmp_path):
+        _write(tmp_path, 'serve/callsites.py', '''\
+            import jax
+
+            def _fwd(xs):
+                return xs
+
+            fwd = jax.jit(_fwd)
+
+            def bad(batch):
+                return fwd([b.tokens for b in batch])
+
+            def bad_kw(batch):
+                return fwd(xs={b for b in batch})
+
+            def ok(batch, arr):
+                return fwd(arr) and fwd((1, 2))
+        ''')
+        report = _run(tmp_path, checks=['jit-boundary'])
+        keys = sorted(v['key'] for v in report['violations'])
+        # Tuples are the sanctioned pytree shape: ok() passes.
+        assert keys == ['fresh-container:fwd:0',
+                        'fresh-container:fwd:xs']
+
+    def test_unhashable_static_args_flagged(self, tmp_path):
+        _write(tmp_path, 'serve/statics.py', '''\
+            from functools import partial
+
+            import jax
+
+            @partial(jax.jit, static_argnames=('cfg',),
+                     static_argnums=(2,))
+            def fwd(x, cfg, mode):
+                return x
+
+            def bad(x):
+                return fwd(x, cfg={'layers': 4})
+
+            def bad_pos(x):
+                return fwd(x, None, ['fast'])
+
+            def ok(x, cfg_tuple):
+                return fwd(x, cfg=cfg_tuple, mode='fast')
+        ''')
+        report = _run(tmp_path, checks=['jit-boundary'])
+        keys = sorted(v['key'] for v in report['violations'])
+        assert keys == ['unhashable-static:fwd:2',
+                        'unhashable-static:fwd:cfg']
+
+    def test_donated_buffer_reuse_flagged_and_rebind_ok(
+            self, tmp_path):
+        _write(tmp_path, 'serve/donate.py', '''\
+            import jax
+
+            def _step(params, cache):
+                return cache
+
+            step = jax.jit(_step, donate_argnums=(1,))
+
+            def bad(params, cache):
+                out = step(params, cache)
+                return out, cache.shape
+
+            def good(params, cache):
+                cache = step(params, cache)
+                return cache
+        ''')
+        report = _run(tmp_path, checks=['jit-boundary'])
+        (v,) = report['violations']
+        assert v['key'] == 'donated-reuse:step:cache'
+        assert v['line'] == 10        # the read, not the donation
+        assert 'use-after-donation' in v['message']
+        # good(): the sanctioned rebind kills the fact — no finding.
+
+
 # ------------------------------------------------------------ allowlist + report
 
 class TestAllowlistAndReport:
@@ -1543,6 +2152,94 @@ class TestCli:
         assert report['files_scanned'] == 0
         assert 'no changed .py files' in proc.stderr
 
+    def test_diff_mode_reports_only_new_violations(self, tmp_path):
+        # Baseline: one violating file, captured as a --format json
+        # report. A second violation lands; --diff against the
+        # baseline reports ONLY the new one — the PR-review fast path.
+        pkg = tmp_path / 'pkg'
+        _write(tmp_path, 'pkg/clouds/old.py',
+               'from skypilot_tpu import backends\n')
+        proc = self._cli('--root', str(pkg), '--format', 'json',
+                         '--no-allowlist')
+        assert proc.returncode == 1
+        baseline = tmp_path / 'baseline.json'
+        baseline.write_text(proc.stdout)
+        _write(tmp_path, 'pkg/jobs/new.py',
+               'from skypilot_tpu.serve import core\n')
+        proc = self._cli('--root', str(pkg), '--format', 'json',
+                         '--no-allowlist', '--diff', str(baseline))
+        assert proc.returncode == 1
+        report = json.loads(proc.stdout)
+        assert [v['path'] for v in report['violations']] == \
+            ['jobs/new.py']
+        assert report['suppressed_by_baseline'] == 1
+        assert report['baseline'] == str(baseline)
+        # With nothing new the diff run exits clean.
+        os.unlink(os.path.join(pkg, 'jobs', 'new.py'))
+        proc = self._cli('--root', str(pkg), '--format', 'json',
+                         '--no-allowlist', '--diff', str(baseline))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert json.loads(proc.stdout)['total'] == 0
+
+    def test_diff_mode_is_count_aware(self, tmp_path):
+        # A baseline with ONE foo ident absorbs one current foo; a
+        # second instance of the same ident is new.
+        pkg = tmp_path / 'pkg'
+        _write(tmp_path, 'pkg/clouds/x.py',
+               'from skypilot_tpu import backends\n')
+        proc = self._cli('--root', str(pkg), '--format', 'json',
+                         '--no-allowlist')
+        baseline = tmp_path / 'baseline.json'
+        baseline.write_text(proc.stdout)
+        _write(tmp_path, 'pkg/clouds/x.py',
+               'from skypilot_tpu import backends\n'
+               'from skypilot_tpu import backends as bk2\n')
+        proc = self._cli('--root', str(pkg), '--format', 'json',
+                         '--no-allowlist', '--diff', str(baseline))
+        assert proc.returncode == 1
+        report = json.loads(proc.stdout)
+        assert report['total'] == 1
+        assert report['suppressed_by_baseline'] == 1
+
+    def test_diff_unreadable_baseline_usage_error(self, tmp_path):
+        _write(tmp_path, 'serve/ok.py', 'import os\n')
+        proc = self._cli('--root', str(tmp_path), '--diff',
+                         str(tmp_path / 'missing.json'))
+        assert proc.returncode == 2
+        assert 'unreadable baseline' in proc.stderr
+
+    def test_expired_allowlist_entry_fails_loudly(self, tmp_path):
+        # An entry may carry `# expires: YYYY-MM-DD`; past the date
+        # the run fails even though the violation is still matched —
+        # a grandfathered finding cannot fossilize.
+        pkg = tmp_path / 'pkg'
+        _write(tmp_path, 'pkg/clouds/x.py',
+               'from skypilot_tpu import backends\n')
+        allow = tmp_path / 'allow.txt'
+        live = 'layers:clouds/x.py:skypilot_tpu.backends'
+        allow.write_text(f'{live}  # expires: 2020-01-01 ISSUE-7\n')
+        proc = self._cli('--root', str(pkg), '--allowlist', str(allow))
+        assert proc.returncode == 1
+        assert 'EXPIRED allowlist entry' in proc.stderr
+        assert '2020-01-01' in proc.stderr
+        # A future deadline still allowlists and passes.
+        allow.write_text(f'{live}  # expires: 2999-01-01 ISSUE-7\n')
+        proc = self._cli('--root', str(pkg), '--allowlist', str(allow))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    def test_malformed_expiry_date_counts_as_expired(self, tmp_path):
+        # A deadline that cannot be read must fail loudly, not
+        # silently never fire.
+        pkg = tmp_path / 'pkg'
+        _write(tmp_path, 'pkg/clouds/x.py',
+               'from skypilot_tpu import backends\n')
+        allow = tmp_path / 'allow.txt'
+        live = 'layers:clouds/x.py:skypilot_tpu.backends'
+        allow.write_text(f'{live}  # expires: soonish\n')
+        proc = self._cli('--root', str(pkg), '--allowlist', str(allow))
+        assert proc.returncode == 1
+        assert 'EXPIRED allowlist entry' in proc.stderr
+
     def test_changed_mode_lints_only_diffed_files(self, tmp_path):
         # Build a real git repo: main has a clean file; a feature
         # branch adds a violating one. --changed must scan ONLY the
@@ -1619,19 +2316,36 @@ class TestInjectionIntoRealModules:
 
 # ------------------------------------------------------------ enforcement
 
-class TestLivePackage:
-    """THE gate: the architecture contract over the real package."""
+_LIVE_SCAN: dict = {}
 
-    def test_live_package_clean(self):
+
+def _live_scan() -> dict:
+    """ONE timed full-package scan shared by the tier-1 gate tests:
+    the scan is the expensive part (call-graph build + every summary
+    fixpoint), and two tests asserting on the same run keep the gate
+    honest without doubling its wall-clock cost."""
+    if not _LIVE_SCAN:
         allowlist = []
         if os.path.exists(analysis.default_allowlist_path()):
             allowlist = core.load_allowlist(
                 analysis.default_allowlist_path())
+        start = time.monotonic()
+        report = core.run_analysis(analysis.default_root(),
+                                   allowlist=allowlist)
+        _LIVE_SCAN.update(report=report, allowlist=allowlist,
+                          elapsed=time.monotonic() - start)
+    return _LIVE_SCAN
+
+
+class TestLivePackage:
+    """THE gate: the architecture contract over the real package."""
+
+    def test_live_package_clean(self):
+        scan = _live_scan()
+        allowlist, report = scan['allowlist'], scan['report']
         assert len(allowlist) <= 10, (
             'allowlist grew past 10 grandfathered entries — fix '
             'violations instead of accumulating exemptions')
-        report = core.run_analysis(analysis.default_root(),
-                                   allowlist=allowlist)
         new = [v for v in report['violations'] if not v['allowlisted']]
         assert not new, (
             'skylint found new architecture violations (fix them or, '
@@ -1651,6 +2365,17 @@ class TestLivePackage:
                    'observe/trace.py'])
         assert sub['files_scanned'] == 3
 
+    def test_wall_clock_budget(self):
+        # CI budget assertion: the full gate — call-graph build and
+        # all summary fixpoints included — must stay interactive,
+        # because pre-commit and tier-1 both run it.
+        elapsed = _live_scan()['elapsed']
+        assert elapsed < 10.0, (
+            f'full skylint scan took {elapsed:.1f}s against a 10s '
+            f'budget — profile the newest checker first; the '
+            f'AST-walk memoization (core.module_nodes) is the usual '
+            f'lever')
+
     def test_gate_emits_stable_json_summary(self, tmp_path):
         """CI artifact + schema ratchet: run the real CLI in JSON mode
         (`skylint --format json > skylint.json`), and pin the checker
@@ -1669,7 +2394,7 @@ class TestLivePackage:
         with open(out_path, encoding='utf-8') as f:
             report = json.load(f)
         # Schema stability (version-bump ratchet).
-        assert report['skylint_version'] == core.REPORT_VERSION == 14
+        assert report['skylint_version'] == core.REPORT_VERSION == 15
         assert set(report) == {
             'skylint_version', 'root', 'files_scanned', 'checks',
             'violations', 'total', 'allowlisted', 'new',
