@@ -1,0 +1,646 @@
+"""The typed SKYTPU_* config-knob registry — every env knob, declared once.
+
+The control surface of this repo is environment variables (PAPER.md
+§1: declarative Task YAML + env plumbed into every rank via
+``constants.gang_env``). Before this module, 100+ ``SKYTPU_*`` vars
+were read at ad-hoc ``os.environ`` sites: none type-checked, barely
+half documented, and nothing guaranteed a knob set on the driver
+reached gang followers or worker subprocesses (the PR-15
+``SKYTPU_ENGINE_ATTN`` gang-skew bug class). This registry is the
+single source of truth, consumed from four directions:
+
+  * runtime — the typed accessors (:func:`get_int` & co.) read the
+    env PER CALL (a knob read at import time stays read at import
+    time — the call site decides), parse against the declared type,
+    and fail LOUDLY with :class:`KnobError` naming the knob on a
+    malformed value, instead of raising a bare ``ValueError`` deep in
+    a hot loop or silently falling back to a default;
+  * lint — skylint's ``knob-discipline`` checker AST-loads the
+    ``_declare`` calls below (the ``state_machines.py`` precedent)
+    and fails the build on raw env reads, undeclared knobs, dead
+    knobs, docs drift, and un-propagated ``propagate=True`` knobs;
+  * docs — ``python -m skypilot_tpu.utils.knobs --markdown``
+    generates docs/KNOBS.md (checked in, sync-tested in tier-1);
+  * propagation — ``propagate=True`` knobs are process-identity /
+    correlation values every gang member must carry; lint proves
+    ``constants.gang_env`` forwards each one.
+
+Layering: this module is stdlib-only and imports nothing from the
+package — everything may import it, including ``ops/`` kernels and
+the analysis plane's fixtures.
+
+Declaration contract (enforced by the checker, so keep it AST-simple):
+one ``_declare(...)`` call per knob with literal arguments.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json as _json
+import os
+from typing import Any, Dict, Optional, Tuple
+
+TYPES = ('int', 'float', 'bool', 'str', 'enum', 'json')
+
+# Bool grammar — shared by get_bool/parse/export. Empty string means
+# "unset" (→ default) for every type, so it appears in neither set.
+_TRUE = frozenset({'1', 'true', 'yes', 'on'})
+_FALSE = frozenset({'0', 'false', 'no', 'off'})
+
+
+class KnobError(ValueError):
+    """A malformed or undeclared knob — always names the knob.
+
+    Raised at the READ site (or at :func:`export` time for writes),
+    so ``SKYTPU_LB_RETRIES=banana`` fails the moment the LB reads its
+    retry budget, with the knob name, the garbage value, and the
+    expected type in the message — not as a bare ``ValueError`` from
+    ``int()`` three frames deep in a request handler."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    """One declared knob. ``default`` is the TYPED value (``8``, not
+    ``'8'``); ``None`` means "no default — accessor returns None when
+    the env is unset" (valid for any type). ``propagate`` marks
+    process-identity/correlation knobs every gang member must carry —
+    lint proves ``constants.gang_env`` forwards them."""
+    name: str
+    type: str
+    default: Any
+    subsystem: str
+    doc: str
+    propagate: bool = False
+    choices: Tuple[str, ...] = ()
+
+
+REGISTRY: Dict[str, Knob] = {}
+
+
+def _declare(name: str, type: str, default: Any, subsystem: str,
+             doc: str, *, propagate: bool = False,
+             choices: Tuple[str, ...] = ()) -> None:
+    # pylint: disable=redefined-builtin
+    if type not in TYPES:
+        raise ValueError(f'{name}: unknown knob type {type!r}')
+    if type == 'enum' and not choices:
+        raise ValueError(f'{name}: enum knob needs choices')
+    if name in REGISTRY:
+        raise ValueError(f'duplicate knob declaration {name}')
+    REGISTRY[name] = Knob(name=name, type=type, default=default,
+                          subsystem=subsystem, doc=doc,
+                          propagate=propagate, choices=choices)
+
+
+# =====================================================================
+# The registry. Grouped by owning subsystem; keep one _declare per
+# knob with LITERAL arguments (the knob-discipline checker AST-loads
+# this block without importing it).
+# =====================================================================
+
+# ------------------------------------------------------------- core
+_declare('SKYTPU_CONFIG', 'str', None, 'core',
+         'Path to the user config YAML (overrides ~/.skytpu/config.yaml).')
+_declare('SKYTPU_WORKSPACE', 'str', None, 'core',
+         'Active workspace name (overrides the config default).')
+_declare('SKYTPU_STATE_DB', 'str', '~/.skytpu/state.db', 'core',
+         'Cluster-registry sqlite path (global_state).')
+_declare('SKYTPU_USER_HASH', 'str', None, 'core',
+         'Stable per-user identity hash override (CI sets this).')
+_declare('SKYTPU_DEV', 'bool', False, 'core',
+         'Developer mode (extra output in CLI surfaces).')
+_declare('SKYTPU_RUNNING_IN_BUFFER', 'bool', False, 'core',
+         'Set when running inside a buffered/captured terminal.')
+
+# ---------------------------------------------------------- logging
+_declare('SKYTPU_DEBUG', 'bool', False, 'logging',
+         'Verbose debug logging across every plane (single grammar: '
+         '1/true/yes/on).')
+_declare('SKYTPU_MINIMIZE_LOGGING', 'bool', True, 'logging',
+         'Terse CLI logging (suppress verbose hints).')
+_declare('SKYTPU_SUPPRESS_SENSITIVE_LOG', 'bool', False, 'logging',
+         'Redact cluster/user identifiers from log lines.')
+
+# ----------------------------------------------------------- server
+_declare('SKYTPU_API_TOKEN', 'str', '', 'server',
+         'Shared-secret bearer token for the API server (server '
+         'enforces, client sends).')
+_declare('SKYTPU_AUTH_USER_HEADER', 'str', '', 'server',
+         'Trusted reverse-proxy header carrying the authenticated '
+         'user name (enables header auth mode).')
+_declare('SKYTPU_AUTH_DEFAULT_ROLE', 'str', '', 'server',
+         'Role granted to first-seen header-auth users (admin|user).')
+_declare('SKYTPU_COMMIT', 'str', 'dev', 'server',
+         'Build commit stamp reported by /api/health.')
+_declare('SKYTPU_SERVER_DIR', 'str', '~/.skytpu/api_server', 'server',
+         'API-server state directory (requests DB + logs).')
+_declare('SKYTPU_EXECUTOR_MODE', 'enum', 'subprocess', 'server',
+         'Request-executor isolation: one subprocess per request, or '
+         'in-process threads (tests).',
+         choices=('subprocess', 'thread'))
+_declare('SKYTPU_API_SERVER_URL', 'str', None, 'client',
+         'API-server endpoint the client SDK talks to (unset = '
+         'local/in-process mode).')
+
+# ------------------------------------------------------------- jobs
+_declare('SKYTPU_JOBS_DB', 'str', '~/.skytpu/managed_jobs.db', 'jobs',
+         'Managed-jobs controller sqlite path.')
+_declare('SKYTPU_JOBS_POLL_SECONDS', 'float', 10.0, 'jobs',
+         'Controller poll cadence for job status reconciliation.')
+_declare('SKYTPU_JOBS_MAX_CONTROLLER_RESTARTS', 'int', 3, 'jobs',
+         'Controller crash-restart budget before FAILED_CONTROLLER.')
+_declare('SKYTPU_JOBS_MAX_PARALLEL', 'int', 8, 'jobs',
+         'Max concurrently-launching managed jobs (config '
+         'jobs.max_parallel overrides the default).')
+_declare('SKYTPU_JOBS_LOG_GC_INTERVAL', 'int', 3600, 'jobs',
+         'Seconds between controller log-GC sweeps.')
+_declare('SKYTPU_JOBS_RECOVERY_MAX_ROUNDS', 'int', 720, 'jobs',
+         'Failover rounds before a recovering job gives up.')
+_declare('SKYTPU_JOBS_RECOVERY_BUDGET_SECONDS', 'float', 0.0, 'jobs',
+         'Wall-clock recovery budget (0 = unlimited).')
+_declare('SKYTPU_JOBS_RECOVERY_BASE_SECONDS', 'float', 20.0, 'jobs',
+         'Base gap of the recovery retry backoff.')
+_declare('SKYTPU_JOBS_RECOVERY_CAP_SECONDS', 'float', 300.0, 'jobs',
+         'Cap of the recovery retry backoff.')
+_declare('SKYTPU_POOL_ACQUIRE_TIMEOUT', 'float', 86400.0, 'jobs',
+         'Max seconds a pool-scheduled job waits for a free worker.')
+_declare('SKYTPU_POOL_ACQUIRE_POLL', 'float', 5.0, 'jobs',
+         'Poll cadence while waiting on a pool worker.')
+_declare('SKYTPU_MAX_RESTARTS_ON_ERRORS', 'int', 0, 'jobs',
+         'Task-env knob (reads task.envs, not the process env): '
+         'restarts granted on user-code failure.')
+
+# ------------------------------------------------------------ serve
+_declare('SKYTPU_SERVE_DB', 'str', '~/.skytpu/serve.db', 'serve',
+         'Serve controller sqlite path.')
+_declare('SKYTPU_SERVE_SYNC_SECONDS', 'float', 5.0, 'serve',
+         'Controller reconcile cadence.')
+_declare('SKYTPU_SERVE_GC_SECONDS', 'float', 3600.0, 'serve',
+         'Controller telemetry/GC sweep cadence.')
+_declare('SKYTPU_SERVE_MAX_CONTROLLER_RESTARTS', 'int', 3, 'serve',
+         'Serve controller crash-restart budget.')
+_declare('SKYTPU_SERVE_MAX_REPLACEMENTS', 'int', None, 'serve',
+         'Replica-churn cap before a service goes FAILED (unset = '
+         'max(3, 2x target replicas)).')
+_declare('SKYTPU_SERVE_BOOT_PATIENCE', 'float', None, 'serve',
+         'Extra seconds a STARTING replica with a live run job gets '
+         'before probe misses count (unset = max(60, 5x '
+         'initial_delay)).')
+_declare('SKYTPU_SERVE_DRAIN_SECONDS', 'float', 120.0, 'serve',
+         'In-flight-completion deadline for a DRAINING replica.')
+_declare('SKYTPU_SERVE_PORT', 'int', 8000, 'serve',
+         'Engine HTTP port default for `skytpu serve`.')
+_declare('SKYTPU_SERVE_REPLICA_ID', 'int', None, 'serve',
+         'Replica identity, exported by the replica manager into '
+         'each replica process env.')
+_declare('SKYTPU_SERVE_VERSION', 'int', None, 'serve',
+         'Service version stamp, exported next to '
+         'SKYTPU_SERVE_REPLICA_ID.')
+
+# ------------------------------------------------------- multi-host
+_declare('SKYTPU_MH_TOKEN', 'str', None, 'multihost',
+         'Per-job random secret for the multi-host serve control '
+         'channel; drawn once per gang by the slice driver.',
+         propagate=True)
+_declare('SKYTPU_MH_ALLOW_INSECURE_TOKEN', 'bool', False, 'multihost',
+         'Loopback-debug escape hatch: accept the guessable job-id '
+         'token instead of refusing to start.')
+_declare('SKYTPU_MH_CONNECT_TIMEOUT', 'float', 120.0, 'multihost',
+         'Follower connect budget to the leader control channel.')
+_declare('SKYTPU_MH_SEND_TIMEOUT', 'float', 20.0, 'multihost',
+         'Per-broadcast send budget; a follower wedged this long '
+         'fails the replica.')
+
+# ----------------------------------------------------------- engine
+_declare('SKYTPU_ENGINE_MAX_BATCH', 'int', 8, 'engine',
+         'Decode batch slots (engine admission width).')
+_declare('SKYTPU_ENGINE_STEP_CHUNK', 'int', 8, 'engine',
+         'Decode steps fused per host-loop iteration.')
+_declare('SKYTPU_ENGINE_MAX_QUEUE', 'int', 64, 'engine',
+         'Admission queue depth before 503 shedding.')
+_declare('SKYTPU_ENGINE_PREFIX_CACHE', 'int', 4, 'engine',
+         'Prefix-snapshot cache entries (0 disables).')
+_declare('SKYTPU_ENGINE_SPEC_K', 'int', 4, 'engine',
+         'Speculative-decoding draft length (0 disables).')
+_declare('SKYTPU_ENGINE_SPEC_COOLDOWN', 'int', 16, 'engine',
+         'Steps a batch slot sits out speculation after a rejection.')
+_declare('SKYTPU_ENGINE_PAGED', 'bool', True, 'engine',
+         'Paged KV cache (the default hot path) vs dense slabs.')
+_declare('SKYTPU_ENGINE_PAGE_SIZE', 'int', 64, 'engine',
+         'Tokens per KV page.')
+_declare('SKYTPU_ENGINE_KV_PAGES', 'int', 0, 'engine',
+         'Total KV pages (0 = size from the HBM budget).')
+_declare('SKYTPU_ENGINE_PREFILL_CHUNK', 'int', 256, 'engine',
+         'Chunked-prefill chunk length (tokens).')
+_declare('SKYTPU_ENGINE_RESURRECT_MAX', 'int', 2, 'engine',
+         'Times a preempted request may be resurrected before 503.')
+_declare('SKYTPU_ENGINE_ROLE', 'enum', '', 'engine',
+         'Disaggregation role of this engine process.',
+         choices=('', 'prefill', 'decode'))
+_declare('SKYTPU_ENGINE_WARM_DISAGG', 'bool', False, 'engine',
+         'Pre-compile page export/adopt programs for every warm '
+         'bucket (disagg pool replicas opt in).')
+_declare('SKYTPU_ENGINE_HANDOFF_PORT', 'int', -1, 'engine',
+         'KV-handoff listener port (-1 = HTTP port + 1000 '
+         'convention, 0 = disabled).')
+_declare('SKYTPU_ENGINE_ATTN', 'enum', 'fused', 'engine',
+         'Paged attention backend; the gang leader broadcasts its '
+         'choice so followers cannot skew the program family.',
+         choices=('fused', 'pallas', 'gather'))
+
+# ---------------------------------------------------- load balancer
+_declare('SKYTPU_LB_SPAN_SAMPLE', 'float', 1.0, 'lb',
+         'Span sampling rate in [0,1] for proxied requests.')
+_declare('SKYTPU_LB_CONNECT_TIMEOUT', 'float', 10.0, 'lb',
+         'Upstream connect timeout (dead-replica detection bound).')
+_declare('SKYTPU_LB_READ_TIMEOUT', 'float', 120.0, 'lb',
+         'Gap-between-bytes timeout on upstream streams.')
+_declare('SKYTPU_LB_RETRIES', 'int', 2, 'lb',
+         'Retry budget for idempotent-safe proxy attempts.')
+_declare('SKYTPU_LB_RETRY_BACKOFF', 'float', 0.05, 'lb',
+         'Base backoff between proxy retries (seconds).')
+_declare('SKYTPU_LB_BREAKER_THRESHOLD', 'int', 3, 'lb',
+         'Consecutive upstream failures that open a replica breaker.')
+_declare('SKYTPU_LB_BREAKER_COOLDOWN', 'float', 5.0, 'lb',
+         'Seconds an open breaker holds before the single probe.')
+_declare('SKYTPU_LB_DISAGG_MIN_PROMPT', 'int', 64, 'lb',
+         'Prompts shorter than this skip the two-stage disagg '
+         'pipeline (tokens; chars/4 for text).')
+
+# ----------------------------------------------------------- disagg
+_declare('SKYTPU_HANDOFF_TIMEOUT', 'float', 30.0, 'disagg',
+         'Whole-exchange deadline for one KV handoff send.')
+_declare('SKYTPU_HANDOFF_TTL', 'float', 120.0, 'disagg',
+         'Sweep age for staged handoffs whose continue never came.')
+
+# ------------------------------------------------------ autoscaler
+_declare('SKYTPU_SATURATION_STALE_SECONDS', 'float', 30.0, 'serve',
+         'Saturation telemetry older than this is ignored by the '
+         'autoscaler.')
+
+# ---------------------------------------------------------- observe
+_declare('SKYTPU_OBSERVE_DB', 'str', '~/.skytpu/observe/journal.db',
+         'observe', 'Journal/span sqlite path.')
+_declare('SKYTPU_DISABLE_JOURNAL', 'bool', False, 'observe',
+         'Drop journal writes (hermetic tests).')
+_declare('SKYTPU_DISABLE_SPANS', 'bool', False, 'observe',
+         'Drop span recording.')
+_declare('SKYTPU_SLO_SPECS', 'json', None, 'observe',
+         'JSON list of SLOSpec kwargs overriding the stock '
+         'objectives.')
+_declare('SKYTPU_SCRAPE_TIMEOUT', 'float', 5.0, 'observe',
+         'Per-target metrics scrape timeout.')
+_declare('SKYTPU_SCRAPE_STALENESS', 'float', 30.0, 'observe',
+         'Scraped sample staleness horizon.')
+_declare('SKYTPU_SCRAPE_INTERVAL', 'float', 10.0, 'observe',
+         'Fleet scrape-loop cadence.')
+_declare('SKYTPU_FLIGHT_CAPACITY', 'int', 65536, 'observe',
+         'Flight-recorder ring capacity (events).')
+_declare('SKYTPU_TIMELINE_FILE_PATH', 'str', None, 'observe',
+         'Chrome-trace timeline output path (setting it enables the '
+         'timeline).')
+_declare('SKYTPU_TRACE_ID', 'str', None, 'observe',
+         'Correlation id minted when the originating API request '
+         'entered the server; joins on-cluster telemetry to the '
+         'control plane.', propagate=True)
+_declare('SKYTPU_PARENT_SPAN_ID', 'str', None, 'observe',
+         'Cross-process span-tree parent carrier.', propagate=True)
+
+# ----------------------------------------------------- data service
+_declare('SKYTPU_DATA_HEARTBEAT_TIMEOUT', 'float', 10.0,
+         'data_service',
+         'Dispatcher marks a worker LOST after this silence.')
+_declare('SKYTPU_DATA_FETCH_TIMEOUT', 'float', 10.0, 'data_service',
+         'Client budget for one batch fetch round-trip.')
+_declare('SKYTPU_DATA_STALL_BUDGET', 'float', 120.0, 'data_service',
+         'Client stall budget before declaring the service wedged.')
+
+# ---------------------------------------------------------- rollout
+_declare('SKYTPU_ROLLOUT_HEARTBEAT_TIMEOUT', 'float', 10.0, 'rollout',
+         'Dispatcher marks a rollout worker LOST after this silence.')
+_declare('SKYTPU_ROLLOUT_LEASE_TIMEOUT', 'float', 120.0, 'rollout',
+         'Prompt-lease reassignment age.')
+_declare('SKYTPU_ROLLOUT_MAX_OUTSTANDING', 'int', 32, 'rollout',
+         'Max outstanding leases per worker pool.')
+_declare('SKYTPU_ROLLOUT_RESULT_CAP', 'int', 64, 'rollout',
+         'Completed-trajectory buffer cap at the dispatcher.')
+_declare('SKYTPU_ROLLOUT_STALL_BUDGET', 'float', 120.0, 'rollout',
+         'Learner stall budget waiting on trajectory batches.')
+
+# ------------------------------------------------------------ train
+_declare('SKYTPU_TRAIN_BATCH_WAIT_SPAN_MIN', 'float', 0.05, 'train',
+         'Min batch-wait seconds worth a dedicated span.')
+
+# -------------------------------------------------------------- ops
+_declare('SKYTPU_RING_BWD_CHUNK', 'int', 1024, 'ops',
+         'Ring-attention backward KV chunk (HBM peak bound).')
+_declare('SKYTPU_RING_BWD_FLASH', 'enum', '', 'ops',
+         'Flash-kernel backward dispatch: auto / force / einsum-only.',
+         choices=('', '1', '0'))
+
+# ------------------------------------------------------------ usage
+_declare('SKYTPU_DISABLE_USAGE', 'bool', False, 'usage',
+         'Disable usage reporting.')
+_declare('SKYTPU_DISABLE_USAGE_COLLECTION', 'bool', False, 'usage',
+         'Disable usage collection (reference-compatible alias '
+         'consulted by logging paths).')
+_declare('SKYTPU_USAGE_ENDPOINT', 'str', None, 'usage',
+         'Usage-report HTTP endpoint (unset = local file only).')
+
+# ---------------------------------------------------------- storage
+_declare('SKYTPU_S3_ENDPOINT_URL', 'str', None, 'storage',
+         'Explicit S3 endpoint (MinIO/on-prem gateways).')
+_declare('SKYTPU_R2_ENDPOINT_URL', 'str', None, 'storage',
+         'Explicit Cloudflare R2 endpoint.')
+_declare('SKYTPU_NEBIUS_ENDPOINT_URL', 'str', None, 'storage',
+         'Explicit Nebius Object Storage endpoint.')
+_declare('SKYTPU_OCI_ENDPOINT_URL', 'str', None, 'storage',
+         'Explicit OCI Object Storage S3-compat endpoint.')
+_declare('SKYTPU_COS_ENDPOINT_URL', 'str', None, 'storage',
+         'Explicit IBM COS endpoint.')
+
+# --------------------------------------------- skylet / gang runtime
+_declare('SKYTPU_RUNTIME_DIR', 'str', '~/.skytpu_runtime', 'skylet',
+         'Per-host runtime dir (job logs, jobs DB, synced workdir).')
+_declare('SKYTPU_NODE_RANK', 'int', 0, 'skylet',
+         'Global rank of this gang member.', propagate=True)
+_declare('SKYTPU_JOB_ID', 'str', None, 'skylet',
+         'Job id of the owning gang.', propagate=True)
+_declare('SKYTPU_CLUSTER_NAME', 'str', None, 'skylet',
+         'Cluster the gang runs on (skylet events match orphans by '
+         'scanning /proc environs for it).', propagate=True)
+_declare('SKYTPU_COORDINATOR_ADDRESS', 'str', None, 'skylet',
+         'jax.distributed coordinator host:port.', propagate=True)
+_declare('SKYTPU_NUM_PROCESSES', 'int', 1, 'skylet',
+         'Total processes across all slices.', propagate=True)
+_declare('SKYTPU_EPILOGUE', 'bool', False, 'skylet',
+         'Set on storage-flush epilogue commands so mounts skip '
+         'remount work.')
+_declare('SKYTPU_RETRY_UNTIL_UP_GAP', 'float', 60.0, 'backends',
+         'Gap between --retry-until-up provision attempts.')
+_declare('SKYTPU_K8S_KUBECTL_EXEC', 'bool', False, 'backends',
+         'Use the in-cluster kubectl-exec fan-out for k8s workers '
+         '(needs kubectl + pods/exec RBAC in the image).')
+
+# ------------------------------------------------------------ utils
+_declare('SKYTPU_DOCKER_CMD', 'str', 'docker', 'utils',
+         'Container runtime binary (docker/podman/nerdctl).')
+_declare('SKYTPU_CLOCK_OFFSET_FILE', 'str', None, 'utils',
+         'Virtual-clock offset file (chaos tests warp time with it).')
+_declare('SKYTPU_FAILPOINTS', 'str', '', 'utils',
+         'Failpoint arming schedule (name=spec,... — see '
+         'docs/ROBUSTNESS.md).')
+
+# ---------------------------------------------------------- loadgen
+_declare('SKYTPU_BENCH_METRIC', 'str', None, 'loadgen',
+         'bench.py scenario selector (decode, serve, loadgen, '
+         'train_input, rl_harvest, kernelcheck, ...).')
+
+
+# =====================================================================
+# Typed accessors. Every accessor reads the env PER CALL; call sites
+# keep today's read-at-use vs read-at-import behavior by where they
+# call. ``default=`` overrides the declared default for the sites
+# whose fallback is computed (config files, probe-derived patience).
+# =====================================================================
+
+_UNSET = object()
+
+
+def _lookup(name: str, want_type: str) -> Knob:
+    knob = REGISTRY.get(name)
+    if knob is None:
+        raise KnobError(
+            f'{name} is not a declared knob — add a _declare() row to '
+            f'skypilot_tpu/utils/knobs.py (and regenerate '
+            f'docs/KNOBS.md)')
+    if knob.type != want_type:
+        raise KnobError(
+            f'{name} is declared {knob.type!r} but was read with the '
+            f'{want_type!r} accessor')
+    return knob
+
+
+def _parse(knob: Knob, raw: str) -> Any:
+    """``raw`` (non-empty) → typed value, or KnobError naming the
+    knob."""
+    if knob.type == 'int':
+        try:
+            return int(raw)
+        except ValueError:
+            raise KnobError(
+                f'{knob.name}={raw!r} is not an integer') from None
+    if knob.type == 'float':
+        try:
+            return float(raw)
+        except ValueError:
+            raise KnobError(
+                f'{knob.name}={raw!r} is not a number') from None
+    if knob.type == 'bool':
+        low = raw.strip().lower()
+        if low in _TRUE:
+            return True
+        if low in _FALSE:
+            return False
+        raise KnobError(
+            f'{knob.name}={raw!r} is not a boolean '
+            f'(want one of 1/0/true/false/yes/no/on/off)')
+    if knob.type == 'enum':
+        val = raw.strip()
+        if val not in knob.choices:
+            raise KnobError(
+                f'{knob.name}={raw!r} must be one of {knob.choices}')
+        return val
+    if knob.type == 'json':
+        try:
+            return _json.loads(raw)
+        except ValueError as e:
+            raise KnobError(
+                f'{knob.name} is not valid JSON ({e}): {raw!r}'
+            ) from None
+    return raw           # 'str': the raw value IS the value.
+
+
+def _get(name: str, want_type: str, default: Any) -> Any:
+    knob = _lookup(name, want_type)
+    raw = os.environ.get(name)
+    if raw is None or raw == '':
+        # Empty string counts as unset for every type — EXCEPT when
+        # the empty string is itself a declared enum choice (the
+        # tri-state '' / '0' / '1' knobs).
+        if raw == '' and knob.type == 'enum' and '' in knob.choices:
+            return ''
+        return knob.default if default is _UNSET else default
+    return _parse(knob, raw)
+
+
+def get_int(name: str, *, default: Any = _UNSET) -> Optional[int]:
+    return _get(name, 'int', default)
+
+
+def get_float(name: str, *, default: Any = _UNSET) -> Optional[float]:
+    return _get(name, 'float', default)
+
+
+def get_bool(name: str, *, default: Any = _UNSET) -> Optional[bool]:
+    return _get(name, 'bool', default)
+
+
+def get_str(name: str, *, default: Any = _UNSET) -> Optional[str]:
+    return _get(name, 'str', default)
+
+
+def get_enum(name: str, *, default: Any = _UNSET) -> Optional[str]:
+    return _get(name, 'enum', default)
+
+
+def get_json(name: str, *, default: Any = _UNSET) -> Any:
+    return _get(name, 'json', default)
+
+
+def parse(name: str, raw_value: Optional[str]) -> Any:
+    """Parse a raw string AGAINST the declared type without touching
+    the env — for knobs that arrive through other channels (task env
+    dicts, YAML). None/empty → declared default."""
+    knob = REGISTRY.get(name)
+    if knob is None:
+        raise KnobError(f'{name} is not a declared knob')
+    if raw_value is None or raw_value == '':
+        return knob.default
+    return _parse(knob, raw_value)
+
+
+def is_set(name: str) -> bool:
+    """True when the knob is present AND non-empty in the env."""
+    if name not in REGISTRY:
+        raise KnobError(f'{name} is not a declared knob')
+    return bool(os.environ.get(name))
+
+
+def raw(name: str, *, default: Optional[str] = None) -> Optional[str]:
+    """The VALIDATED raw string — for forwarding a knob into a child
+    process env block. Parses against the declared type first, so a
+    harness never ships garbage a child would then crash on."""
+    knob = REGISTRY.get(name)
+    if knob is None:
+        raise KnobError(f'{name} is not a declared knob')
+    val = os.environ.get(name)
+    if val is None or val == '':
+        return default
+    _parse(knob, val)
+    return val
+
+
+def export(name: str, value: str) -> None:
+    """Validated ``os.environ`` write — the ONLY sanctioned way to set
+    a SKYTPU_* var on the current process (propagation to subprocesses
+    and the contextvar/env carriers)."""
+    knob = REGISTRY.get(name)
+    if knob is None:
+        raise KnobError(
+            f'refusing to export undeclared knob {name}')
+    if not isinstance(value, str):
+        raise KnobError(
+            f'{name}: export() takes the env STRING form, got '
+            f'{type(value).__name__}')
+    if value != '':
+        _parse(knob, value)
+    os.environ[name] = value
+
+
+def declared() -> Dict[str, Knob]:
+    """The registry (read-only view by convention)."""
+    return dict(REGISTRY)
+
+
+def default_of(name: str) -> Any:
+    """The declared default — for modules that expose it as a
+    constant."""
+    knob = REGISTRY.get(name)
+    if knob is None:
+        raise KnobError(f'{name} is not a declared knob')
+    return knob.default
+
+
+# ------------------------------------------------------------- docs
+
+_SUBSYSTEM_ORDER = (
+    'core', 'logging', 'server', 'client', 'jobs', 'serve',
+    'multihost', 'engine', 'lb', 'disagg', 'observe', 'data_service',
+    'rollout', 'train', 'ops', 'usage', 'storage', 'skylet',
+    'backends', 'utils', 'loadgen',
+)
+
+
+def markdown() -> str:
+    """docs/KNOBS.md, generated. Regenerating must be a no-op against
+    the checked-in file (tier-1 sync test); the knob-discipline
+    checker separately requires a row per declared knob."""
+    lines = [
+        '# SKYTPU_* configuration knobs',
+        '',
+        '<!-- GENERATED FILE — do not edit by hand. -->',
+        '<!-- Regenerate: python -m skypilot_tpu.utils.knobs '
+        '--markdown > docs/KNOBS.md -->',
+        '',
+        'Every environment knob the package reads, generated from the',
+        'typed registry in `skypilot_tpu/utils/knobs.py` (the single',
+        'source of truth — raw `os.environ` reads of `SKYTPU_*` vars',
+        'are a skylint `knob-discipline` violation). A malformed value',
+        'raises `KnobError` naming the knob at the read site.',
+        '',
+        '**propagate** knobs are process-identity/correlation values',
+        'every gang member carries: lint proves `constants.gang_env`',
+        'forwards each one to every rank.',
+        '',
+        f'{len(REGISTRY)} knobs.',
+    ]
+    by_sub: Dict[str, list] = {}
+    for knob in REGISTRY.values():
+        by_sub.setdefault(knob.subsystem, []).append(knob)
+    order = [s for s in _SUBSYSTEM_ORDER if s in by_sub]
+    order += sorted(s for s in by_sub if s not in _SUBSYSTEM_ORDER)
+    for sub in order:
+        lines += ['', f'## {sub}', '',
+                  '| knob | type | default | propagate | doc |',
+                  '|---|---|---|---|---|']
+        for knob in sorted(by_sub[sub], key=lambda k: k.name):
+            if knob.type == 'enum':
+                typ = 'enum(' + ', '.join(
+                    repr(c) for c in knob.choices) + ')'
+            else:
+                typ = knob.type
+            default = '—' if knob.default is None else repr(knob.default)
+            prop = 'yes' if knob.propagate else ''
+            lines.append(f'| `{knob.name}` | {typ} | `{default}` | '
+                         f'{prop} | {knob.doc} |')
+    lines.append('')
+    return '\n'.join(lines)
+
+
+def main(argv=None) -> int:
+    import argparse
+    parser = argparse.ArgumentParser(
+        prog='python -m skypilot_tpu.utils.knobs',
+        description='The typed SKYTPU_* knob registry.')
+    parser.add_argument('--markdown', action='store_true',
+                        help='Emit docs/KNOBS.md content.')
+    parser.add_argument('--list', action='store_true',
+                        help='One knob name per line.')
+    args = parser.parse_args(argv)
+    if args.markdown:
+        print(markdown(), end='')
+        return 0
+    if args.list:
+        for name in sorted(REGISTRY):
+            print(name)
+        return 0
+    for knob in sorted(REGISTRY.values(), key=lambda k: k.name):
+        prop = ' [propagate]' if knob.propagate else ''
+        print(f'{knob.name} ({knob.type}, default '
+              f'{knob.default!r}){prop}: {knob.doc}')
+    return 0
+
+
+if __name__ == '__main__':
+    import sys
+    sys.exit(main())

@@ -1,0 +1,254 @@
+"""The typed SKYTPU_* knob registry (utils/knobs.py, docs/KNOBS.md).
+
+Four contracts, each pinned:
+  * typed parsing — the one bool grammar, enum refusal, json, and
+    the loud KnobError-naming-the-knob failure on garbage (the
+    pre-registry bug class: a bare ValueError three frames deep);
+  * registry completeness — every env_options member and every
+    propagate=True knob is declared, and the declared set only grows
+    through _declare (the checker AST-loads the same rows);
+  * propagation — the propagate=True set round-trips through the
+    REAL ``constants.gang_env`` (the cross-host env boundary);
+  * docs sync — regenerating docs/KNOBS.md is a byte-level no-op
+    (tier-1; the knob-discipline checker separately requires a row
+    per knob).
+"""
+import os
+
+import pytest
+
+from skypilot_tpu.skylet import constants
+from skypilot_tpu.utils import env_options
+from skypilot_tpu.utils import knobs
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+class TestTypedParsing:
+
+    def test_int_parses_and_defaults(self, monkeypatch):
+        monkeypatch.delenv('SKYTPU_LB_RETRIES', raising=False)
+        assert knobs.get_int('SKYTPU_LB_RETRIES') == \
+            knobs.default_of('SKYTPU_LB_RETRIES')
+        monkeypatch.setenv('SKYTPU_LB_RETRIES', '7')
+        assert knobs.get_int('SKYTPU_LB_RETRIES') == 7
+
+    def test_callsite_default_overrides_declared(self, monkeypatch):
+        monkeypatch.delenv('SKYTPU_LB_RETRIES', raising=False)
+        assert knobs.get_int('SKYTPU_LB_RETRIES', default=42) == 42
+        # An env value still wins over the call-site default.
+        monkeypatch.setenv('SKYTPU_LB_RETRIES', '3')
+        assert knobs.get_int('SKYTPU_LB_RETRIES', default=42) == 3
+
+    def test_empty_string_means_unset(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_LB_RETRIES', '')
+        assert knobs.get_int('SKYTPU_LB_RETRIES') == \
+            knobs.default_of('SKYTPU_LB_RETRIES')
+
+    def test_float(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_LB_CONNECT_TIMEOUT', '2.5')
+        assert knobs.get_float('SKYTPU_LB_CONNECT_TIMEOUT') == 2.5
+
+    @pytest.mark.parametrize('raw,want', [
+        ('1', True), ('true', True), ('yes', True), ('on', True),
+        ('TRUE', True), (' Yes ', True),
+        ('0', False), ('false', False), ('no', False), ('off', False),
+    ])
+    def test_bool_grammar(self, monkeypatch, raw, want):
+        monkeypatch.setenv('SKYTPU_DEBUG', raw)
+        assert knobs.get_bool('SKYTPU_DEBUG') is want
+
+    def test_bool_garbage_raises_naming_knob(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_DEBUG', 'maybe')
+        with pytest.raises(knobs.KnobError) as e:
+            knobs.get_bool('SKYTPU_DEBUG')
+        assert 'SKYTPU_DEBUG' in str(e.value)
+        assert 'maybe' in str(e.value)
+
+    def test_enum_accepts_choices_and_refuses_others(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_ENGINE_ROLE', 'prefill')
+        assert knobs.get_enum('SKYTPU_ENGINE_ROLE') == 'prefill'
+        monkeypatch.setenv('SKYTPU_ENGINE_ROLE', 'both')
+        with pytest.raises(knobs.KnobError) as e:
+            knobs.get_enum('SKYTPU_ENGINE_ROLE')
+        assert 'SKYTPU_ENGINE_ROLE' in str(e.value)
+        assert 'both' in str(e.value)
+
+    def test_enum_tristate_empty_is_a_choice(self, monkeypatch):
+        # '' is a declared ENGINE_ROLE choice (unified engine), so the
+        # empty string is the VALUE here, not "unset → default".
+        monkeypatch.setenv('SKYTPU_ENGINE_ROLE', '')
+        assert knobs.get_enum('SKYTPU_ENGINE_ROLE') == ''
+
+    def test_json(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_SLO_SPECS', '[{"p": 99}]')
+        assert knobs.get_json('SKYTPU_SLO_SPECS') == [{'p': 99}]
+        monkeypatch.setenv('SKYTPU_SLO_SPECS', '{not json')
+        with pytest.raises(knobs.KnobError) as e:
+            knobs.get_json('SKYTPU_SLO_SPECS')
+        assert 'SKYTPU_SLO_SPECS' in str(e.value)
+
+    def test_undeclared_knob_read_raises(self):
+        with pytest.raises(knobs.KnobError) as e:
+            knobs.get_int('SKYTPU_NOT_A_KNOB')
+        assert 'SKYTPU_NOT_A_KNOB' in str(e.value)
+
+    def test_wrong_type_accessor_raises(self):
+        with pytest.raises(knobs.KnobError) as e:
+            knobs.get_str('SKYTPU_LB_RETRIES')     # declared int
+        assert 'int' in str(e.value)
+
+    def test_parse_channels_non_env_values(self):
+        # Task-env dicts / YAML hand raw strings to parse() — same
+        # grammar, same loud failure, no os.environ involved.
+        assert knobs.parse('SKYTPU_MAX_RESTARTS_ON_ERRORS', '5') == 5
+        assert knobs.parse('SKYTPU_MAX_RESTARTS_ON_ERRORS', None) == \
+            knobs.default_of('SKYTPU_MAX_RESTARTS_ON_ERRORS')
+        with pytest.raises(knobs.KnobError):
+            knobs.parse('SKYTPU_MAX_RESTARTS_ON_ERRORS', 'lots')
+
+    def test_raw_validates_before_forwarding(self, monkeypatch):
+        # raw() is the child-env forwarding path (loadgen harness):
+        # it returns the STRING but refuses to ship garbage.
+        monkeypatch.setenv('SKYTPU_ENGINE_PREFIX_CACHE', '32')
+        assert knobs.raw('SKYTPU_ENGINE_PREFIX_CACHE') == '32'
+        monkeypatch.delenv('SKYTPU_ENGINE_PREFIX_CACHE')
+        assert knobs.raw('SKYTPU_ENGINE_PREFIX_CACHE',
+                         default='16') == '16'
+        monkeypatch.setenv('SKYTPU_ENGINE_PREFIX_CACHE', 'many')
+        with pytest.raises(knobs.KnobError):
+            knobs.raw('SKYTPU_ENGINE_PREFIX_CACHE')
+
+    def test_export_is_a_validated_write(self, monkeypatch):
+        monkeypatch.delenv('SKYTPU_TRACE_ID', raising=False)
+        knobs.export('SKYTPU_TRACE_ID', 'abc123')
+        assert os.environ['SKYTPU_TRACE_ID'] == 'abc123'
+        assert knobs.is_set('SKYTPU_TRACE_ID')
+        monkeypatch.delenv('SKYTPU_TRACE_ID')
+        with pytest.raises(knobs.KnobError):
+            knobs.export('SKYTPU_NOT_A_KNOB', 'x')
+        with pytest.raises(knobs.KnobError):
+            knobs.export('SKYTPU_LB_RETRIES', 'banana')
+
+
+class TestLoudMalformedRegression:
+    """Satellite pin: garbage numeric knobs fail at the read site
+    naming the knob — the pre-registry shape raised a bare
+    ``ValueError: invalid literal for int()`` mid-request."""
+
+    def test_prefix_shape_was_anonymous(self, monkeypatch):
+        # The PRE-FIX shape of load_balancer.py's retry-budget read,
+        # reproduced verbatim: the error names neither the env var
+        # nor the read site — undebuggable from a request log.
+        monkeypatch.setenv('SKYTPU_LB_RETRIES', 'banana')
+        with pytest.raises(ValueError) as e:
+            max(0, int(os.environ.get('SKYTPU_LB_RETRIES', '1')))
+        assert 'SKYTPU_LB_RETRIES' not in str(e.value)
+
+    def test_real_lb_site_now_fails_naming_the_knob(self, monkeypatch):
+        # The REAL post-fix site: constructing the load balancer with
+        # a garbage retry budget raises KnobError carrying the knob
+        # name and the garbage value, at construction — not a bare
+        # ValueError deep in the request path.
+        from skypilot_tpu.serve import load_balancer as lb_lib
+        monkeypatch.setenv('SKYTPU_LB_RETRIES', 'banana')
+        with pytest.raises(knobs.KnobError) as e:
+            lb_lib.LoadBalancer(policy_name='round_robin')
+        assert 'SKYTPU_LB_RETRIES' in str(e.value)
+        assert 'banana' in str(e.value)
+
+
+class TestRegistryCompleteness:
+    """The registry-shape pin: the declared set, the env_options
+    bridge, and declaration hygiene."""
+
+    def test_registry_size_floor(self):
+        # The audit that seeded the registry found 111 knobs; the set
+        # may only grow deliberately (each with a _declare row and a
+        # KNOBS.md entry — drops mean a knob was deleted, which the
+        # dead-knob checker rule makes an explicit act).
+        assert len(knobs.declared()) >= 111
+
+    def test_every_env_options_member_is_declared(self):
+        for opt in env_options.Options:
+            knob = knobs.declared().get(opt.env_var)
+            assert knob is not None, opt.env_var
+            assert knob.type == 'bool', opt.env_var
+
+    def test_every_knob_has_valid_shape(self):
+        for name, knob in knobs.declared().items():
+            assert name.startswith('SKYTPU_'), name
+            assert knob.type in knobs.TYPES, name
+            assert knob.doc.strip(), f'{name} has no doc line'
+            assert knob.subsystem, name
+            if knob.type == 'enum':
+                assert knob.choices, name
+
+    def test_env_options_shares_the_registry_grammar(self, monkeypatch):
+        # The two SKYTPU_DEBUG readers (sky_logging, env_options) used
+        # to disagree ('1'-only vs truthy-set); both now read the one
+        # registry grammar.
+        monkeypatch.setenv('SKYTPU_DEBUG', 'yes')
+        from skypilot_tpu import sky_logging
+        assert env_options.Options.SHOW_DEBUG_INFO.get() is True
+        assert sky_logging._debug_enabled() is True
+        monkeypatch.setenv('SKYTPU_DEBUG', 'nope')
+        with pytest.raises(knobs.KnobError):
+            env_options.Options.SHOW_DEBUG_INFO.get()
+
+
+class TestPropagateRoundTrip:
+    """propagate=True knobs must cross the gang boundary via the REAL
+    ``constants.gang_env`` — the lint rule's runtime twin."""
+
+    def test_propagate_set_round_trips_through_gang_env(self):
+        env = constants.gang_env(
+            rank=1, ips=['10.0.0.1', '10.0.0.2'], num_hosts=2,
+            chips_per_host=4, job_id=7, cluster_name='c',
+            coordinator_ip='10.0.0.1', mh_token='tok',
+            trace_id='tr-1', parent_span_id='sp-1')
+        propagated = {name for name, k in knobs.declared().items()
+                      if k.propagate}
+        missing = propagated - set(env)
+        assert not missing, (
+            f'propagate=True knobs not forwarded by gang_env: '
+            f'{sorted(missing)}')
+        # And each forwarded value parses against its declared type
+        # (a follower re-reads these through the same registry).
+        for name in propagated:
+            knobs.parse(name, env[name])
+
+    def test_propagate_flags_match_gang_env_exactly(self):
+        # The converse of the lint rule: gang_env's SKYTPU_* keys are
+        # exactly the propagate set — a key added there without the
+        # registry flag (or vice versa) fails here AND in skylint.
+        env = constants.gang_env(
+            rank=0, ips=['127.0.0.1'], num_hosts=1, chips_per_host=1,
+            job_id=1, cluster_name='c', mh_token='t', trace_id='tr',
+            parent_span_id='sp')
+        forwarded = {k for k in env if k.startswith('SKYTPU_')}
+        propagated = {name for name, k in knobs.declared().items()
+                      if k.propagate}
+        assert forwarded == propagated
+
+
+class TestDocsSync:
+
+    def test_regenerating_knobs_md_is_a_noop(self):
+        path = os.path.join(REPO, 'docs', 'KNOBS.md')
+        with open(path, 'r', encoding='utf-8') as f:
+            checked_in = f.read()
+        assert checked_in == knobs.markdown(), (
+            'docs/KNOBS.md is stale — regenerate: python -m '
+            'skypilot_tpu.utils.knobs --markdown > docs/KNOBS.md')
+
+    def test_markdown_has_a_row_per_knob(self):
+        md = knobs.markdown()
+        for name in knobs.declared():
+            assert f'`{name}`' in md, name
+
+    def test_cli_list_names_every_knob(self, capsys):
+        assert knobs.main(['--list']) == 0
+        out = capsys.readouterr().out.splitlines()
+        assert out == sorted(knobs.declared())
