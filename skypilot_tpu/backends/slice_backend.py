@@ -27,6 +27,7 @@ from skypilot_tpu.provision import common as provision_common
 from skypilot_tpu.provision import provisioner as provisioner_lib
 from skypilot_tpu.utils import command_runner as command_runner_lib
 from skypilot_tpu.utils import failpoints
+from skypilot_tpu.utils import knobs
 from skypilot_tpu.utils import locks
 from skypilot_tpu.utils import subprocess_utils
 from skypilot_tpu.utils import timeline
@@ -186,7 +187,7 @@ class TpuSliceBackend(backend_lib.Backend[SliceResourceHandle]):
             # sleep and restart the whole failover sweep instead of failing
             # (reference: `sky launch --retry-until-up`). Gap is env-tunable
             # so tests don't wait minutes.
-            gap = float(os.environ.get('SKYTPU_RETRY_UNTIL_UP_GAP', '60'))
+            gap = knobs.get_float('SKYTPU_RETRY_UNTIL_UP_GAP')
             while True:
                 try:
                     record, final_res = _FailoverProvisioner(
@@ -400,8 +401,7 @@ class TpuSliceBackend(backend_lib.Backend[SliceResourceHandle]):
                 # kubectl-exec fan-out (image must ship kubectl + RBAC).
                 pc = cluster_info.provider_config or {}
                 is_head = (inst.slice_index == 0 and inst.worker_id == 0)
-                use_kubectl = os.environ.get(
-                    'SKYTPU_K8S_KUBECTL_EXEC') == '1'
+                use_kubectl = knobs.get_bool('SKYTPU_K8S_KUBECTL_EXEC')
                 kind = ('local' if is_head
                         else ('k8s' if use_kubectl else 'agent'))
                 host: Dict[str, Any] = {

@@ -18,6 +18,8 @@ from typing import Any, Dict, List, Optional
 
 import requests as requests_http
 
+from skypilot_tpu.utils import knobs
+
 from skypilot_tpu import sky_logging
 from skypilot_tpu.server import requests_lib as server_requests
 
@@ -32,7 +34,7 @@ def _token_path() -> str:
 
 def _headers() -> dict:
     """Bearer auth when the server requires it (server/_api_token)."""
-    token = os.environ.get('SKYTPU_API_TOKEN', '')
+    token = knobs.get_str('SKYTPU_API_TOKEN')
     if not token:
         try:
             with open(_token_path(), 'r', encoding='utf-8') as f:
@@ -87,7 +89,7 @@ def login(url: str, token: Optional[str] = None) -> None:
 
 
 def api_server_url(required: bool = False) -> Optional[str]:
-    url = os.environ.get('SKYTPU_API_SERVER_URL')
+    url = knobs.get_str('SKYTPU_API_SERVER_URL')
     if not url and os.path.exists(endpoint_file()):
         with open(endpoint_file(), 'r', encoding='utf-8') as f:
             url = f.read().strip()

@@ -17,6 +17,7 @@ from typing import Any, Dict, Iterable, List, Optional, Tuple
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import knobs
 
 logger = sky_logging.init_logger(__name__)
 
@@ -44,7 +45,7 @@ def _merge_dicts(base: Dict[str, Any], override: Dict[str, Any]
 
 def _load_layers() -> Dict[str, Any]:
     layers: List[str] = []
-    env_path = os.environ.get(ENV_VAR_CONFIG_PATH)
+    env_path = knobs.get_str(ENV_VAR_CONFIG_PATH)
     if env_path:
         layers.append(os.path.expanduser(env_path))
     else:

@@ -25,6 +25,7 @@ import os
 from typing import Callable, Dict, List, Optional
 
 from skypilot_tpu import exceptions
+from skypilot_tpu.utils import knobs
 
 
 @dataclasses.dataclass(frozen=True)
@@ -35,7 +36,7 @@ class S3CompatProvider:
     endpoint_builder: Optional[Callable[[], Optional[str]]] = None
 
     def endpoint(self) -> Optional[str]:
-        url = os.environ.get(self.endpoint_env)
+        url = knobs.get_str(self.endpoint_env)
         if url:
             return url
         if self.endpoint_builder is not None:

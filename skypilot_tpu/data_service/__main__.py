@@ -17,6 +17,7 @@ import threading
 from typing import List, Optional
 
 from skypilot_tpu.utils import failpoints
+from skypilot_tpu.utils import knobs
 
 
 def _serve_until_signal() -> None:
@@ -42,8 +43,8 @@ def main(argv: Optional[List[str]] = None) -> int:
                                       'dispatcher.db')
     disp.add_argument('--num-splits', type=int, default=8)
     disp.add_argument('--heartbeat-timeout', type=float,
-                      default=float(os.environ.get(
-                          'SKYTPU_DATA_HEARTBEAT_TIMEOUT', '10.0')))
+                      default=knobs.get_float(
+                          'SKYTPU_DATA_HEARTBEAT_TIMEOUT'))
     disp.add_argument('--fresh', action='store_true',
                       help='drop the previously served dataset spec '
                            '(new job, same --db; restart workers too)')

@@ -34,6 +34,7 @@ from skypilot_tpu.data_service import protocol
 from skypilot_tpu.data_service import spec as spec_lib
 from skypilot_tpu.data_service import telemetry
 from skypilot_tpu.utils import backoff as backoff_lib
+from skypilot_tpu.utils import knobs
 from skypilot_tpu.utils import failpoints
 
 logger = sky_logging.init_logger(__name__)
@@ -56,11 +57,9 @@ class DataServiceClient:
         # whose worker-side load/tokenize takes minutes needs a bigger
         # budget than the echo-fast default.
         if fetch_timeout is None:
-            fetch_timeout = float(os.environ.get(
-                'SKYTPU_DATA_FETCH_TIMEOUT', '10.0'))
+            fetch_timeout = knobs.get_float('SKYTPU_DATA_FETCH_TIMEOUT')
         if stall_budget_s is None:
-            stall_budget_s = float(os.environ.get(
-                'SKYTPU_DATA_STALL_BUDGET', '120.0'))
+            stall_budget_s = knobs.get_float('SKYTPU_DATA_STALL_BUDGET')
         self._dispatcher_addr = protocol.parse_addr(addr)
         self.spec = spec
         self._spec_fp = spec.fingerprint()

@@ -37,13 +37,13 @@ from skypilot_tpu.data_service import spec as spec_lib
 from skypilot_tpu.observe import journal
 from skypilot_tpu.observe import metrics as metrics_lib
 from skypilot_tpu.utils import failpoints
+from skypilot_tpu.utils import knobs
 from skypilot_tpu.utils import sqlite_utils
 
 logger = sky_logging.init_logger(__name__)
 
 DEFAULT_NUM_SPLITS = 8
-DEFAULT_HEARTBEAT_TIMEOUT = float(
-    os.environ.get('SKYTPU_DATA_HEARTBEAT_TIMEOUT', '10.0'))
+DEFAULT_HEARTBEAT_TIMEOUT = knobs.get_float('SKYTPU_DATA_HEARTBEAT_TIMEOUT')
 
 
 class DataWorkerStatus(enum.Enum):

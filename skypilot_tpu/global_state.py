@@ -16,6 +16,7 @@ from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import knobs
 from skypilot_tpu.utils import sqlite_utils
 from skypilot_tpu.utils.status_lib import ClusterStatus
 
@@ -26,7 +27,7 @@ _local = threading.local()
 
 
 def _db_path() -> str:
-    path = os.environ.get(_DB_PATH_ENV, '~/.skytpu/state.db')
+    path = knobs.get_str(_DB_PATH_ENV)
     path = os.path.expanduser(path)
     os.makedirs(os.path.dirname(path), exist_ok=True)
     return path

@@ -31,13 +31,14 @@ from skypilot_tpu.backends import slice_backend
 from skypilot_tpu.jobs import recovery_strategy
 from skypilot_tpu.jobs import state
 from skypilot_tpu.utils import failpoints
+from skypilot_tpu.utils import knobs
 from skypilot_tpu.utils.status_lib import JobStatus
 
 logger = sky_logging.init_logger(__name__)
 
 # Seconds between monitor polls (reference: JOB_STATUS_CHECK_GAP ~ 15-30s;
 # kept low and env-tunable so hermetic tests run in seconds).
-POLL_SECONDS = float(os.environ.get('SKYTPU_JOBS_POLL_SECONDS', '10'))
+POLL_SECONDS = knobs.get_float('SKYTPU_JOBS_POLL_SECONDS')
 
 
 def _generate_cluster_name(job_id: int, name: str) -> str:

@@ -15,6 +15,7 @@ from skypilot_tpu import sky_logging
 from skypilot_tpu import task as task_lib
 from skypilot_tpu.jobs import recovery_strategy
 from skypilot_tpu.jobs import scheduler
+from skypilot_tpu.utils import knobs
 from skypilot_tpu.jobs import state
 
 logger = sky_logging.init_logger(__name__)
@@ -83,12 +84,11 @@ def _strategy_name(task: task_lib.Task) -> str:
 
 def _max_restarts(task: task_lib.Task) -> int:
     # YAML: resources.job_recovery could grow {max_restarts_on_errors: N};
-    # until then a task env opt-in keeps the knob reachable.
-    try:
-        return int(task.envs_and_secrets.get(
-            'SKYTPU_MAX_RESTARTS_ON_ERRORS', '0'))
-    except ValueError:
-        return 0
+    # until then a task env opt-in keeps the knob reachable. Parsed
+    # against the registry so garbage fails at submit time, loudly.
+    return knobs.parse('SKYTPU_MAX_RESTARTS_ON_ERRORS',
+                       task.envs_and_secrets.get(
+                           'SKYTPU_MAX_RESTARTS_ON_ERRORS'))
 
 
 def queue(name: Optional[str] = None,

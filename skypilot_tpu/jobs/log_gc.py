@@ -22,13 +22,13 @@ from typing import List
 from skypilot_tpu import config as config_lib
 from skypilot_tpu import sky_logging
 from skypilot_tpu.jobs import state
+from skypilot_tpu.utils import knobs
 
 logger = sky_logging.init_logger(__name__)
 
 DEFAULT_RETENTION_HOURS = 24 * 7
 # At most one sweep per this interval (marker-file mtime).
-SWEEP_INTERVAL_SECONDS = int(os.environ.get('SKYTPU_JOBS_LOG_GC_INTERVAL',
-                                            '3600'))
+SWEEP_INTERVAL_SECONDS = knobs.get_int('SKYTPU_JOBS_LOG_GC_INTERVAL')
 
 
 def _marker_path() -> str:

@@ -25,6 +25,7 @@ from skypilot_tpu.observe import journal as journal_lib
 from skypilot_tpu.observe import metrics as metrics_lib
 from skypilot_tpu.utils import backoff as backoff_lib
 from skypilot_tpu.utils import failpoints
+from skypilot_tpu.utils import knobs
 from skypilot_tpu.utils import registry
 
 if typing.TYPE_CHECKING:
@@ -239,12 +240,11 @@ class StrategyExecutor:
         launched_cloud = self.handle.cloud if self.handle else None
         launched_region = self.handle.region if self.handle else None
         launched_zone = self.handle.zone if self.handle else None
-        max_rounds = int(os.environ.get(_MAX_ROUNDS_ENV,
-                                        str(MAX_RECOVERY_ROUNDS)))
-        budget_seconds = float(os.environ.get(_BUDGET_ENV, '0'))
+        max_rounds = knobs.get_int(_MAX_ROUNDS_ENV)
+        budget_seconds = knobs.get_float(_BUDGET_ENV)
         retry_backoff = backoff_lib.Backoff(
-            base=float(os.environ.get(_BASE_ENV, str(RETRY_GAP_SECONDS))),
-            cap=float(os.environ.get(_CAP_ENV, str(RETRY_GAP_CAP_SECONDS))),
+            base=knobs.get_float(_BASE_ENV),
+            cap=knobs.get_float(_CAP_ENV),
             seed=self.job_id)
         t_start = time.monotonic()
 
@@ -342,10 +342,8 @@ class PoolStrategyExecutor(StrategyExecutor):
     """
 
     # How long launch() waits for a free worker before giving up entirely.
-    ACQUIRE_TIMEOUT_SECONDS = float(
-        os.environ.get('SKYTPU_POOL_ACQUIRE_TIMEOUT', str(24 * 3600)))
-    ACQUIRE_POLL_SECONDS = float(
-        os.environ.get('SKYTPU_POOL_ACQUIRE_POLL', '5'))
+    ACQUIRE_TIMEOUT_SECONDS = knobs.get_float('SKYTPU_POOL_ACQUIRE_TIMEOUT')
+    ACQUIRE_POLL_SECONDS = knobs.get_float('SKYTPU_POOL_ACQUIRE_POLL')
 
     def __init__(self, cluster_name: str, task: 'task_lib.Task',
                  job_id: int, pool: str) -> None:

@@ -15,21 +15,21 @@ from typing import Optional
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.jobs import state
+from skypilot_tpu.utils import knobs
 from skypilot_tpu.utils import locks
 
 logger = sky_logging.init_logger(__name__)
 
 # A job whose controller keeps dying (poisoned record, OOM-looping box)
 # stops being resumed after this many restarts.
-MAX_CONTROLLER_RESTARTS = int(
-    os.environ.get('SKYTPU_JOBS_MAX_CONTROLLER_RESTARTS', '3'))
+MAX_CONTROLLER_RESTARTS = knobs.get_int('SKYTPU_JOBS_MAX_CONTROLLER_RESTARTS')
 
 
 def _max_parallel() -> int:
     from skypilot_tpu import config as config_lib
-    return int(
-        os.environ.get('SKYTPU_JOBS_MAX_PARALLEL',
-                       config_lib.get_nested(('jobs', 'max_parallel'), 8)))
+    return knobs.get_int(
+        'SKYTPU_JOBS_MAX_PARALLEL',
+        default=int(config_lib.get_nested(('jobs', 'max_parallel'), 8)))
 
 
 from skypilot_tpu.utils.proc import pid_alive as _pid_alive

@@ -18,6 +18,7 @@ from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.analysis import state_machines
+from skypilot_tpu.utils import knobs
 from skypilot_tpu.observe import journal as journal_lib
 from skypilot_tpu.observe import metrics as metrics_lib
 from skypilot_tpu.observe import trace as trace_lib
@@ -117,8 +118,7 @@ def _journal_transition(job_id: int, old: Optional[ManagedJobStatus],
 
 
 def _db_path() -> str:
-    path = os.path.expanduser(
-        os.environ.get(_DB_PATH_ENV, '~/.skytpu/managed_jobs.db'))
+    path = os.path.expanduser(knobs.get_str(_DB_PATH_ENV))
     os.makedirs(os.path.dirname(path), exist_ok=True)
     return path
 

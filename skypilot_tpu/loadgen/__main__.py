@@ -26,6 +26,7 @@ import tempfile
 from typing import Any, Dict, Optional
 
 from skypilot_tpu.loadgen import schedule as schedule_lib
+from skypilot_tpu.utils import knobs
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -235,9 +236,9 @@ def main(argv=None) -> int:
             args.run_dir = tempfile.mkdtemp(prefix='skytpu-loadgen-')
         # The harness process's own journal/tsdb live in the run dir
         # unless the operator already pinned a DB.
-        os.environ.setdefault(
-            'SKYTPU_OBSERVE_DB',
-            os.path.join(args.run_dir, 'observe.db'))
+        if not knobs.is_set('SKYTPU_OBSERVE_DB'):
+            knobs.export('SKYTPU_OBSERVE_DB',
+                         os.path.join(args.run_dir, 'observe.db'))
         evidence = asyncio.run(_run_local(args, profile, schedule))
     else:
         evidence = asyncio.run(_run_remote(args, schedule))

@@ -34,7 +34,6 @@ from __future__ import annotations
 
 import argparse
 import json
-import os
 import sys
 import time
 from typing import Any, Dict, List, Optional
@@ -42,6 +41,7 @@ from typing import Any, Dict, List, Optional
 from skypilot_tpu.observe import journal
 from skypilot_tpu.observe import metrics
 from skypilot_tpu.observe import spans as spans_lib
+from skypilot_tpu.utils import knobs
 
 
 def _fmt_event(e: Dict[str, Any]) -> str:
@@ -95,7 +95,7 @@ def _fetch_tree(trace_id: str, url: Optional[str],
         with urlrequest.urlopen(target, timeout=10) as resp:
             return json.loads(resp.read().decode('utf-8'))
     if db is not None:
-        os.environ['SKYTPU_OBSERVE_DB'] = db
+        knobs.export('SKYTPU_OBSERVE_DB', db)
     return spans_lib.tree(trace_id)
 
 
@@ -157,7 +157,7 @@ def _fleet_doc(url: Optional[str], db: Optional[str],
         doc['fleet_quantiles'] = quantiles
         return doc
     if db is not None:
-        os.environ['SKYTPU_OBSERVE_DB'] = db
+        knobs.export('SKYTPU_OBSERVE_DB', db)
     from skypilot_tpu.observe import request_class
     from skypilot_tpu.observe import slo as slo_lib
     from skypilot_tpu.observe import tsdb

@@ -32,8 +32,9 @@ Stdlib-only; safe to import from any layer.
 from __future__ import annotations
 
 import itertools
-import os
 import time
+
+from skypilot_tpu.utils import knobs
 from typing import Any, Dict, List, Optional, Tuple
 
 # Event codes (ints in the ring; names only at dump time).
@@ -72,8 +73,7 @@ class FlightRecorder:
 
     def __init__(self, capacity: Optional[int] = None):
         if capacity is None:
-            capacity = int(os.environ.get(_CAPACITY_ENV,
-                                          str(DEFAULT_CAPACITY)))
+            capacity = knobs.get_int(_CAPACITY_ENV)
         if capacity < 1:
             raise ValueError('flight ring needs capacity >= 1')
         self.capacity = capacity

@@ -38,6 +38,7 @@ import time
 from typing import Any, Dict, List, Mapping, Optional
 
 from skypilot_tpu.utils import jsonl_utils
+from skypilot_tpu.utils import knobs
 from skypilot_tpu.utils import sqlite_utils
 
 from skypilot_tpu.observe import trace
@@ -53,12 +54,11 @@ def db_path() -> str:
     """Pure path resolution — no filesystem side effects. _conn()
     creates the directory on its cache-miss branch; keeping this pure
     means the per-event cache-key comparison costs no syscalls."""
-    return os.path.expanduser(
-        os.environ.get(_DB_PATH_ENV, '~/.skytpu/observe/journal.db'))
+    return os.path.expanduser(knobs.get_str(_DB_PATH_ENV))
 
 
 def _enabled() -> bool:
-    return os.environ.get(_DISABLE_ENV, '0') != '1'
+    return not knobs.get_bool(_DISABLE_ENV)
 
 
 # Per-thread connection cache (the global_state._conn pattern): the

@@ -42,8 +42,8 @@ import urllib.request
 from typing import Callable, Dict, List, Optional
 
 from skypilot_tpu import sky_logging
-from skypilot_tpu.utils import common_utils
 from skypilot_tpu.utils import failpoints
+from skypilot_tpu.utils import knobs
 
 from skypilot_tpu.observe import journal
 from skypilot_tpu.observe import metrics as metrics_lib
@@ -194,10 +194,10 @@ class Scraper:
                  staleness_seconds: Optional[float] = None):
         self.metrics_path = metrics_path
         self.health_path = health_path
-        self.timeout = (common_utils.env_float('SKYTPU_SCRAPE_TIMEOUT', 5.0)
+        self.timeout = (knobs.get_float('SKYTPU_SCRAPE_TIMEOUT')
                         if timeout is None else timeout)
         self.staleness_seconds = (
-            common_utils.env_float('SKYTPU_SCRAPE_STALENESS', 30.0)
+            knobs.get_float('SKYTPU_SCRAPE_STALENESS')
             if staleness_seconds is None else staleness_seconds)
         self._lock = threading.Lock()
         self._states: Dict[str, _TargetState] = {}
@@ -439,9 +439,8 @@ class ScrapeLoop:
                  interval: Optional[float] = None,
                  on_round: Optional[Callable[[Scraper], None]] = None):
         self.scraper = scraper
-        self.interval = (common_utils.env_float(
-            'SKYTPU_SCRAPE_INTERVAL', 10.0)
-            if interval is None else interval)
+        self.interval = (knobs.get_float('SKYTPU_SCRAPE_INTERVAL')
+                         if interval is None else interval)
         self.on_round = on_round
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None

@@ -36,7 +36,6 @@ from __future__ import annotations
 import dataclasses
 import json
 import math
-import os
 import time
 from typing import Dict, List, Mapping, Optional, Tuple
 
@@ -47,6 +46,7 @@ from skypilot_tpu.observe import metrics as metrics_lib
 from skypilot_tpu.observe import promtext
 from skypilot_tpu.observe import request_class
 from skypilot_tpu.observe import tsdb
+from skypilot_tpu.utils import knobs
 
 logger = sky_logging.init_logger(__name__)
 
@@ -143,10 +143,9 @@ def default_specs() -> List[SLOSpec]:
     "Fleet" section shows the format). A malformed env var raises at
     controller startup: a silently-dropped SLO is an unmonitored
     fleet."""
-    raw = os.environ.get('SKYTPU_SLO_SPECS', '')
-    if raw.strip():
+    cfg = knobs.get_json('SKYTPU_SLO_SPECS')
+    if cfg is not None:
         try:
-            cfg = json.loads(raw)
             if not isinstance(cfg, list):
                 raise ValueError('expected a JSON list')
             return [SLOSpec(**item) for item in cfg]

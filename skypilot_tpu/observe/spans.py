@@ -51,6 +51,7 @@ import time
 import uuid
 from typing import Any, Callable, Dict, List, Optional
 
+from skypilot_tpu.utils import knobs
 from skypilot_tpu.utils import sqlite_utils
 
 from skypilot_tpu.observe import journal
@@ -93,7 +94,7 @@ def new_span_id() -> str:
 
 
 def _enabled() -> bool:
-    return os.environ.get(_DISABLE_ENV, '0') != '1'
+    return not knobs.get_bool(_DISABLE_ENV)
 
 
 def current() -> Optional[str]:
@@ -102,7 +103,7 @@ def current() -> Optional[str]:
     sid = _CURRENT.get()
     if sid:
         return sid
-    return os.environ.get(ENV_PARENT) or None
+    return knobs.get_str(ENV_PARENT) or None
 
 
 def set_parent(span_id: Optional[str]) -> 'contextvars.Token':
@@ -121,7 +122,7 @@ def adopt_parent(span_id: Optional[str]) -> None:
     if not span_id:
         return
     _CURRENT.set(span_id)
-    os.environ[ENV_PARENT] = span_id
+    knobs.export(ENV_PARENT, span_id)
 
 
 def env_with_span(env: Optional[Dict[str, str]] = None) -> Dict[str, str]:
@@ -526,7 +527,7 @@ def chrome_trace(trace_id: Optional[str] = None,
             'pid': str(s['pid']), 'tid': 'spans',
             'args': args,
         })
-    tl_path = timeline_path or os.environ.get('SKYTPU_TIMELINE_FILE_PATH')
+    tl_path = timeline_path or knobs.get_str('SKYTPU_TIMELINE_FILE_PATH')
     if tl_path and os.path.exists(os.path.expanduser(tl_path)):
         try:
             with open(os.path.expanduser(tl_path), 'r',

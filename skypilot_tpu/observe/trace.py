@@ -26,9 +26,10 @@ from __future__ import annotations
 
 import contextlib
 import contextvars
-import os
 import re
 import uuid
+
+from skypilot_tpu.utils import knobs
 from typing import Dict, Iterator, Optional
 
 ENV_VAR = 'SKYTPU_TRACE_ID'
@@ -63,7 +64,7 @@ def get() -> Optional[str]:
     tid = _TRACE.get()
     if tid:
         return tid
-    return os.environ.get(ENV_VAR) or None
+    return knobs.get_str(ENV_VAR) or None
 
 
 def set_trace(trace_id: Optional[str]) -> 'contextvars.Token':
@@ -97,7 +98,7 @@ def adopt(trace_id: Optional[str]) -> None:
     if not trace_id:
         return
     _TRACE.set(trace_id)
-    os.environ[ENV_VAR] = trace_id
+    knobs.export(ENV_VAR, trace_id)
 
 
 def env_with_trace(env: Optional[Dict[str, str]] = None) -> Dict[str, str]:

@@ -46,13 +46,13 @@ int32 runtime data (page COUNT is data, not shape — the
 """
 from __future__ import annotations
 
-import os
 from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from skypilot_tpu.ops.attention import _on_tpu
+from skypilot_tpu.utils import knobs
 from skypilot_tpu.ops.attention import attention as _attention
 
 BACKENDS = ('fused', 'pallas', 'gather')
@@ -65,12 +65,7 @@ def backend_from_env() -> str:
     (``SKYTPU_ENGINE_ATTN=fused|pallas|gather``; default ``fused``).
     Garbage fails loudly at startup — a typo silently serving the slow
     gather baseline would be an invisible perf regression."""
-    val = os.environ.get(ENV_VAR, DEFAULT_BACKEND).strip() or \
-        DEFAULT_BACKEND
-    if val not in BACKENDS:
-        raise ValueError(
-            f'{ENV_VAR} must be one of {BACKENDS}, got {val!r}')
-    return val
+    return knobs.get_enum(ENV_VAR)
 
 
 def gather_pages(pool_layer: jnp.ndarray, table: jnp.ndarray,

@@ -35,12 +35,13 @@ Causal layouts:
 from __future__ import annotations
 
 import functools
-import os
 from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from skypilot_tpu.utils import knobs
 from jax.sharding import PartitionSpec as P
 
 NEG_INF = -2.0 ** 30
@@ -274,10 +275,10 @@ def ring_attention(q: jnp.ndarray,
 # block is [B,KH,G,Sq,CHUNK] f32 instead of [B,KH,G,Sq,Tk] — at 32k-token
 # shards the unchunked block would be gigabytes per step. The einsums
 # still land on the MXU; only peak HBM changes.
-_BWD_KV_CHUNK = int(os.environ.get('SKYTPU_RING_BWD_CHUNK', '1024'))
+_BWD_KV_CHUNK = knobs.get_int('SKYTPU_RING_BWD_CHUNK')
 # Flash-kernel backward dispatch: '' = auto (TPU + lane-aligned shapes),
 # '1' = force (tests use interpret mode), '0' = always einsum path.
-_BWD_FLASH = os.environ.get('SKYTPU_RING_BWD_FLASH', '')
+_BWD_FLASH = knobs.get_enum('SKYTPU_RING_BWD_FLASH')
 
 
 def _flash_bwd_ok(sq: int, tk: int, d: int, interpret: bool) -> bool:

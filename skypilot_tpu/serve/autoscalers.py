@@ -38,7 +38,7 @@ from collections import deque
 from skypilot_tpu import sky_logging
 from skypilot_tpu.observe import metrics as metrics_lib
 from skypilot_tpu.serve import service_spec as spec_lib
-from skypilot_tpu.utils import common_utils
+from skypilot_tpu.utils import knobs
 from skypilot_tpu.utils import vclock
 from skypilot_tpu.utils import registry
 
@@ -200,8 +200,8 @@ class SaturationAutoscaler(RequestRateAutoscaler):
         assert policy.target_queue_depth_per_replica is not None
         self._fleet_queue_depth: Optional[float] = None
         self._saturation_ts: Optional[float] = None
-        self.stale_after = common_utils.env_float(
-            'SKYTPU_SATURATION_STALE_SECONDS', SATURATION_STALE_SECONDS)
+        self.stale_after = knobs.get_float(
+            'SKYTPU_SATURATION_STALE_SECONDS')
 
     def observe_saturation(self, queue_depths: Mapping[str, float],
                            now: Optional[float] = None) -> None:

@@ -27,17 +27,17 @@ from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve import service_spec as spec_lib
 from skypilot_tpu.serve.serve_state import ReplicaStatus, ServiceStatus
 from skypilot_tpu.utils import failpoints
+from skypilot_tpu.utils import knobs
 
 logger = sky_logging.init_logger('skypilot_tpu.serve.controller')
 
-RECONCILE_SECONDS = float(os.environ.get('SKYTPU_SERVE_SYNC_SECONDS', '5'))
+RECONCILE_SECONDS = knobs.get_float('SKYTPU_SERVE_SYNC_SECONDS')
 # Journal/span retention cadence for THIS process (mirrors the API
 # server's hourly GC loop): the controller and its LB write journal
 # events and spans into their own DB — often on a different host from
 # the API server — so without a local observe.gc() those rows would
 # grow until the disk fills.
-GC_INTERVAL_SECONDS = float(os.environ.get('SKYTPU_SERVE_GC_SECONDS',
-                                           '3600'))
+GC_INTERVAL_SECONDS = knobs.get_float('SKYTPU_SERVE_GC_SECONDS')
 
 
 class ServiceController:

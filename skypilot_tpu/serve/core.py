@@ -52,6 +52,7 @@ def _spawn_controller(service_name: str) -> int:
 
 
 from skypilot_tpu.usage import usage_lib
+from skypilot_tpu.utils import knobs
 
 
 @usage_lib.tracked('serve.up')
@@ -109,8 +110,8 @@ from skypilot_tpu.utils.proc import pid_alive as _pid_alive
 # A service whose controller dies at every spawn (poisoned spec, broken
 # environment) stops being respawned past this many restarts — otherwise
 # every `serve status` forks another doomed controller, forever.
-MAX_CONTROLLER_RESTARTS = int(
-    os.environ.get('SKYTPU_SERVE_MAX_CONTROLLER_RESTARTS', '3'))
+MAX_CONTROLLER_RESTARTS = knobs.get_int(
+    'SKYTPU_SERVE_MAX_CONTROLLER_RESTARTS')
 
 
 def maybe_recover_controllers() -> None:

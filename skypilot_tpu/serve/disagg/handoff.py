@@ -56,6 +56,7 @@ import numpy as np
 from skypilot_tpu import sky_logging
 from skypilot_tpu.utils import failpoints as failpoints_lib
 from skypilot_tpu.utils import framed
+from skypilot_tpu.utils import knobs
 
 logger = sky_logging.init_logger(__name__)
 
@@ -125,12 +126,7 @@ def handoff_addr_for_url(url: str,
 
 
 def send_timeout() -> float:
-    import os
-    try:
-        return float(os.environ.get(SEND_TIMEOUT_ENV,
-                                    SEND_TIMEOUT_DEFAULT))
-    except ValueError:
-        return SEND_TIMEOUT_DEFAULT
+    return knobs.get_float(SEND_TIMEOUT_ENV)
 
 
 def send(addr: Tuple[str, int], meta: Dict[str, Any],
@@ -172,13 +168,8 @@ class HandoffStore:
     adopt-side half of the no-leak contract."""
 
     def __init__(self, ttl: Optional[float] = None, max_entries: int = 256):
-        import os
         if ttl is None:
-            try:
-                ttl = float(os.environ.get(STORE_TTL_ENV,
-                                           STORE_TTL_DEFAULT))
-            except ValueError:
-                ttl = STORE_TTL_DEFAULT
+            ttl = knobs.get_float(STORE_TTL_ENV)
         self.ttl = ttl
         self.max_entries = max_entries
         self._lock = threading.Lock()

@@ -65,6 +65,7 @@ from skypilot_tpu.observe import request_class
 from skypilot_tpu.observe import spans as spans_lib
 from skypilot_tpu.observe import trace as trace_lib
 from skypilot_tpu.utils import failpoints as failpoints_lib
+from skypilot_tpu.utils import knobs
 from skypilot_tpu.utils import timeline
 
 logger = sky_logging.init_logger(__name__)
@@ -276,16 +277,15 @@ def _set_attn_backend_gauge(backend: str) -> None:
         _M_ATTN_BACKEND.set(1.0 if b == backend else 0.0, backend=b)
 
 
-MAX_BATCH = int(os.environ.get('SKYTPU_ENGINE_MAX_BATCH', '8'))
+MAX_BATCH = knobs.get_int('SKYTPU_ENGINE_MAX_BATCH')
 # Max decode steps fused into one device call when no request is waiting.
-MAX_STEP_CHUNK = int(os.environ.get('SKYTPU_ENGINE_STEP_CHUNK', '8'))
+MAX_STEP_CHUNK = knobs.get_int('SKYTPU_ENGINE_STEP_CHUNK')
 # Bounded admission queue: overflow => 429 (backpressure the LB can see).
-MAX_QUEUE = int(os.environ.get('SKYTPU_ENGINE_MAX_QUEUE', '64'))
+MAX_QUEUE = knobs.get_int('SKYTPU_ENGINE_MAX_QUEUE')
 # Prefix (system-prompt) KV cache: LRU entry count, 0 disables. A hit
 # prefills only the new tokens (decode.prefill_extend) — the TTFT win
 # for chat traffic re-sending system prompt + history every turn.
-PREFIX_CACHE_ENTRIES = int(os.environ.get('SKYTPU_ENGINE_PREFIX_CACHE',
-                                          '4'))
+PREFIX_CACHE_ENTRIES = knobs.get_int('SKYTPU_ENGINE_PREFIX_CACHE')
 # Prompts shorter than this are never snapshotted (the prefill they'd
 # save is too small to matter; powers of two only).
 PREFIX_MIN_TOKENS = 64
@@ -303,7 +303,7 @@ TOP_LOGPROBS_K = 5
 # costs about one decode step (HBM weight reads dominate), so every
 # accepted token is a nearly-free TPOT win; outputs stay EXACTLY the
 # greedy decode's (the speculative guarantee — pin-tested).
-SPEC_K = int(os.environ.get('SKYTPU_ENGINE_SPEC_K', '4'))
+SPEC_K = knobs.get_int('SKYTPU_ENGINE_SPEC_K')
 # Longest n-gram matched against the row's own context when drafting.
 SPEC_NGRAM = 3
 # Only the trailing window of a row's context is scanned for draft
@@ -315,7 +315,7 @@ SPEC_LOOKUP_WINDOW = 512
 # then re-probes — traffic whose text stops repeating stops paying for
 # speculation automatically.
 SPEC_MIN_ACCEPT = 0.25
-SPEC_COOLDOWN = int(os.environ.get('SKYTPU_ENGINE_SPEC_COOLDOWN', '16'))
+SPEC_COOLDOWN = knobs.get_int('SKYTPU_ENGINE_SPEC_COOLDOWN')
 # When a speculation probe finds NO draft on any row (or a row lacks
 # verify headroom), speculation pauses this many steps and the overlap
 # PIPELINE owns the pool — probing every round would both starve the
@@ -330,23 +330,22 @@ SPEC_NO_DRAFT_COOLDOWN = 4
 # prefill in chunks interleaved with decode rounds. PAGED=0 restores
 # the contiguous per-slot layout (the bucket-admission baseline the
 # CPU equality test and the mixed-length bench compare against).
-PAGED = os.environ.get('SKYTPU_ENGINE_PAGED', '1') != '0'
+PAGED = knobs.get_bool('SKYTPU_ENGINE_PAGED')
 # Tokens per KV page. Must be a power of two dividing
 # PREFIX_MIN_TOKENS (64) so power-of-two prefix snapshots land on page
 # boundaries and share zero-copy.
-PAGE_SIZE = int(os.environ.get('SKYTPU_ENGINE_PAGE_SIZE', '64'))
+PAGE_SIZE = knobs.get_int('SKYTPU_ENGINE_PAGE_SIZE')
 # Total pool pages (including the reserved trash page). 0 = auto:
 # enough for every slot's worst case plus prefix-cache headroom — no
 # capacity regression vs the contiguous layout. Shrink it to
 # oversubscribe memory; admission then waits on free pages (visible
 # in skytpu_engine_kv_page_alloc_total{outcome="wait"}).
-KV_PAGES = int(os.environ.get('SKYTPU_ENGINE_KV_PAGES', '0'))
+KV_PAGES = knobs.get_int('SKYTPU_ENGINE_KV_PAGES')
 # Chunked prefill: prompts whose bucket exceeds this prefill in
 # PREFILL_CHUNK-token pieces interleaved with decode rounds at drained
 # points, so a long prompt no longer blocks the pool for one giant
 # prefill call and short requests keep streaming. Power of two >= 16.
-PREFILL_CHUNK = int(os.environ.get('SKYTPU_ENGINE_PREFILL_CHUNK',
-                                   '256'))
+PREFILL_CHUNK = knobs.get_int('SKYTPU_ENGINE_PREFILL_CHUNK')
 # In-place paged attention backend (SKYTPU_ENGINE_ATTN, parsed and
 # validated by ops.paged_attention.backend_from_env at engine init):
 # 'fused' (default — pages indexed inside the step/verify/chunk
@@ -360,7 +359,7 @@ PREFILL_CHUNK = int(os.environ.get('SKYTPU_ENGINE_PREFILL_CHUNK',
 # resubmitted internally instead of failed. Each request is resurrected
 # at most this many times — a request whose ADMISSION deterministically
 # faults must eventually surface an error, not loop forever.
-RESURRECT_MAX = int(os.environ.get('SKYTPU_ENGINE_RESURRECT_MAX', '2'))
+RESURRECT_MAX = knobs.get_int('SKYTPU_ENGINE_RESURRECT_MAX')
 
 
 class EngineOverloaded(Exception):
@@ -914,7 +913,7 @@ class InferenceEngine:
         # handoff_port is set: the framed-TCP receiver and the staged
         # (meta, arrays) store. Host memory only — device pages are
         # reserved at adoption time, through the normal allocator.
-        self.role = os.environ.get('SKYTPU_ENGINE_ROLE', '')
+        self.role = knobs.get_enum('SKYTPU_ENGINE_ROLE')
         self.handoff_port: Optional[int] = None
         self.handoff_store = None
         self._handoff_receiver = None
@@ -1795,8 +1794,7 @@ class InferenceEngine:
                 self._drop_all_slots()
         if self.paged and buckets:
             self._warm_chunk_grid()
-        if self.paged and os.environ.get('SKYTPU_ENGINE_WARM_DISAGG',
-                                         '') == '1':
+        if self.paged and knobs.get_bool('SKYTPU_ENGINE_WARM_DISAGG'):
             # Disagg pools opt in (the serve controller / LocalStack
             # set this on pool replicas): compile the page
             # export/adopt programs for every warm bucket so a
@@ -4448,30 +4446,26 @@ def build_parser() -> argparse.ArgumentParser:
     # Defaults come from the gang env the slice driver exports, so a
     # multi-host `skytpu serve up` needs no extra flags.
     parser.add_argument('--coordinator',
-                        default=os.environ.get(
+                        default=knobs.get_str(
                             'SKYTPU_COORDINATOR_ADDRESS'),
                         help='jax.distributed coordinator host:port '
                              '(multi-host serving).')
     parser.add_argument('--num-processes', type=int,
-                        default=int(os.environ.get(
-                            'SKYTPU_NUM_PROCESSES', '1')))
+                        default=knobs.get_int('SKYTPU_NUM_PROCESSES'))
     parser.add_argument('--process-id', type=int,
-                        default=int(os.environ.get(
-                            'SKYTPU_NODE_RANK', '0')))
+                        default=knobs.get_int('SKYTPU_NODE_RANK'))
     parser.add_argument('--seed', type=int, default=None,
                         help='Pin the sampling RNG (multi-host sets '
                              'this automatically).')
     parser.add_argument('--port', type=int,
-                        default=int(os.environ.get('SKYTPU_SERVE_PORT',
-                                                   '8000')))
+                        default=knobs.get_int('SKYTPU_SERVE_PORT'))
     parser.add_argument('--host', default='0.0.0.0')
     # Disaggregated serving: the framed-TCP port this replica accepts
     # KV page handoffs on (serve/disagg). Default -1 = the fixed
     # HANDOFF_PORT_OFFSET convention (HTTP port + 1000) the LB derives
     # decode targets from; 0 disables the receiver entirely.
     parser.add_argument('--handoff-port', type=int,
-                        default=int(os.environ.get(
-                            'SKYTPU_ENGINE_HANDOFF_PORT', '-1')))
+                        default=knobs.get_int('SKYTPU_ENGINE_HANDOFF_PORT'))
     return parser
 
 

@@ -69,8 +69,8 @@ from skypilot_tpu.observe import request_class
 from skypilot_tpu.observe import spans as spans_lib
 from skypilot_tpu.observe import trace as trace_lib
 from skypilot_tpu.serve import load_balancing_policies as lb_policies
-from skypilot_tpu.utils import common_utils
 from skypilot_tpu.utils import failpoints as failpoints_lib
+from skypilot_tpu.utils import knobs
 from skypilot_tpu.utils import registry
 
 if typing.TYPE_CHECKING:
@@ -278,28 +278,24 @@ class LoadBalancer:
         # Every traced proxied request persists ~7 span rows (lb.*
         # here, engine.* on the replica); at high rps that churns
         # gc_spans' row cap — this knob sheds that write load.
-        try:
-            self._span_sample = min(1.0, max(0.0, float(
-                os.environ.get('SKYTPU_LB_SPAN_SAMPLE', '1') or 1)))
-        except ValueError:
-            self._span_sample = 1.0
+        self._span_sample = min(1.0, max(0.0, knobs.get_float(
+            'SKYTPU_LB_SPAN_SAMPLE')))
         self._session: Optional[aiohttp.ClientSession] = None
         # Upstream timeout shape (docs/ROBUSTNESS.md): connect bounds
         # dead-replica detection, sock_read bounds the gap BETWEEN
         # bytes (slow-loris / stalled upstream), and total stays None
         # so long legitimate streams are never killed mid-flight.
-        self._connect_timeout = common_utils.env_float('SKYTPU_LB_CONNECT_TIMEOUT',
-                                           10.0)
-        self._read_timeout = common_utils.env_float('SKYTPU_LB_READ_TIMEOUT', 120.0)
+        self._connect_timeout = knobs.get_float('SKYTPU_LB_CONNECT_TIMEOUT')
+        self._read_timeout = knobs.get_float('SKYTPU_LB_READ_TIMEOUT')
         # Bounded retry of idempotent-safe attempts + per-replica
         # breakers.
-        self._retries = max(0, common_utils.env_int('SKYTPU_LB_RETRIES', 2))
-        self._retry_backoff = max(0.0, common_utils.env_float(
-            'SKYTPU_LB_RETRY_BACKOFF', 0.05))
-        self._breaker_threshold = max(1, common_utils.env_int(
-            'SKYTPU_LB_BREAKER_THRESHOLD', 3))
-        self._breaker_cooldown = max(0.0, common_utils.env_float(
-            'SKYTPU_LB_BREAKER_COOLDOWN', 5.0))
+        self._retries = max(0, knobs.get_int('SKYTPU_LB_RETRIES'))
+        self._retry_backoff = max(0.0, knobs.get_float(
+            'SKYTPU_LB_RETRY_BACKOFF'))
+        self._breaker_threshold = max(1, knobs.get_int(
+            'SKYTPU_LB_BREAKER_THRESHOLD'))
+        self._breaker_cooldown = max(0.0, knobs.get_float(
+            'SKYTPU_LB_BREAKER_COOLDOWN'))
         self._breakers: Dict[str, CircuitBreaker] = {}
         self._ready: List[str] = []
         self._fallback_rr = 0

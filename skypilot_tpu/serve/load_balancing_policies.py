@@ -21,6 +21,7 @@ import os
 import threading
 from typing import Any, Dict, Iterator, List, Optional
 
+from skypilot_tpu.utils import knobs
 from skypilot_tpu.utils import registry
 
 
@@ -328,11 +329,7 @@ class PoolRouter:
 
     def __init__(self, min_prompt: Optional[int] = None):
         if min_prompt is None:
-            try:
-                min_prompt = int(os.environ.get(
-                    DISAGG_MIN_PROMPT_ENV, DISAGG_MIN_PROMPT_DEFAULT))
-            except ValueError:
-                min_prompt = DISAGG_MIN_PROMPT_DEFAULT
+            min_prompt = knobs.get_int(DISAGG_MIN_PROMPT_ENV)
         self.min_prompt = min_prompt
         self._prefill = LeastLoadPolicy()
         self._decode = PrefixAffinityPolicy()

@@ -57,19 +57,19 @@ from typing import Any, Optional, Tuple
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.utils import failpoints as failpoints_lib
+from skypilot_tpu.utils import knobs
 
 logger = sky_logging.init_logger(__name__)
 
 # The control channel listens next to the jax.distributed coordinator.
 CONTROL_PORT_OFFSET = 1000
-CONNECT_TIMEOUT_S = float(os.environ.get('SKYTPU_MH_CONNECT_TIMEOUT',
-                                         '120'))
+CONNECT_TIMEOUT_S = knobs.get_float('SKYTPU_MH_CONNECT_TIMEOUT')
 # Per-broadcast send budget: a follower whose TCP buffer stays full
 # this long is wedged, and the documented contract is to fail the
 # replica loudly so the slice driver restarts the gang — NOT to park
 # the leader's event-loop thread (and with it the whole HTTP frontend)
 # inside sendall forever.
-SEND_TIMEOUT_S = float(os.environ.get('SKYTPU_MH_SEND_TIMEOUT', '20'))
+SEND_TIMEOUT_S = knobs.get_float('SKYTPU_MH_SEND_TIMEOUT')
 # Handshake magic + shared token: a follower must prove it belongs to
 # this gang before the leader counts it (and before it receives request
 # payloads); anything else connecting to the port is dropped. The token
@@ -88,11 +88,11 @@ def _resolve_token() -> str:
     startup now REFUSES to run without a real token; the escape hatch
     (SKYTPU_MH_ALLOW_INSECURE_TOKEN=1) exists for loopback debugging
     only."""
-    token = os.environ.get('SKYTPU_MH_TOKEN')
+    token = knobs.get_str('SKYTPU_MH_TOKEN')
     if token:
         return token
-    if os.environ.get('SKYTPU_MH_ALLOW_INSECURE_TOKEN') == '1':
-        return os.environ.get('SKYTPU_JOB_ID', 'local')
+    if knobs.get_bool('SKYTPU_MH_ALLOW_INSECURE_TOKEN'):
+        return knobs.get_str('SKYTPU_JOB_ID', default='local')
     raise RuntimeError(
         'multi-host serving needs SKYTPU_MH_TOKEN (a per-job random '
         'secret; the slice driver exports it alongside '

@@ -32,8 +32,8 @@ from skypilot_tpu.observe import metrics as metrics_lib
 from skypilot_tpu.serve import serve_state
 from skypilot_tpu.serve import service_spec as spec_lib
 from skypilot_tpu.serve import spot_placer as spot_placer_lib
-from skypilot_tpu.utils import common_utils
 from skypilot_tpu.utils import failpoints
+from skypilot_tpu.utils import knobs
 from skypilot_tpu.utils import vclock
 from skypilot_tpu.serve.serve_state import ReplicaStatus
 
@@ -74,8 +74,7 @@ DRAIN_DEADLINE_SECONDS = 120.0
 def _drain_deadline_seconds() -> float:
     """Env-tunable (read at call time — the controller is a detached
     process, and tests tighten this to keep drain scenarios fast)."""
-    return common_utils.env_float('SKYTPU_SERVE_DRAIN_SECONDS',
-                                  DRAIN_DEADLINE_SECONDS)
+    return knobs.get_float('SKYTPU_SERVE_DRAIN_SECONDS')
 
 
 def _replacement_cap(target: int) -> int:
@@ -83,14 +82,9 @@ def _replacement_cap(target: int) -> int:
     time, not import: the controller is a detached process and tests
     tighten this so FAILED classification needs fewer full
     launch→crash→replace cycles of wall-clock on a saturated box)."""
-    base = MAX_REPLACEMENTS_BEFORE_FAILED
-    env = os.environ.get('SKYTPU_SERVE_MAX_REPLACEMENTS')
-    if env is not None:
-        try:
-            base = max(1, int(env))
-        except ValueError:
-            logger.warning(f'Ignoring malformed '
-                           f'SKYTPU_SERVE_MAX_REPLACEMENTS={env!r}.')
+    env = knobs.get_int('SKYTPU_SERVE_MAX_REPLACEMENTS')
+    base = (MAX_REPLACEMENTS_BEFORE_FAILED if env is None
+            else max(1, env))
     return max(base, 2 * target)
 
 
@@ -104,13 +98,9 @@ def _boot_patience_seconds(probe: 'spec_lib.ReadinessProbe') -> float:
     still booting; replacing it then just restarts the same slow boot and
     eventually FAILs a healthy service. The patience is bounded so an
     alive-but-never-listening (hung) app is still replaced."""
-    env = os.environ.get('SKYTPU_SERVE_BOOT_PATIENCE')
+    env = knobs.get_float('SKYTPU_SERVE_BOOT_PATIENCE')
     if env is not None:
-        try:
-            return float(env)
-        except ValueError:
-            logger.warning(f'Ignoring malformed SKYTPU_SERVE_BOOT_PATIENCE'
-                           f'={env!r} (want seconds as a float).')
+        return env
     return max(60.0, 5.0 * probe.initial_delay_seconds)
 
 

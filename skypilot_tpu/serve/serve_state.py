@@ -15,6 +15,7 @@ from skypilot_tpu import sky_logging
 from skypilot_tpu.analysis import state_machines
 from skypilot_tpu.observe import journal as journal_lib
 from skypilot_tpu.observe import trace as trace_lib
+from skypilot_tpu.utils import knobs
 from skypilot_tpu.utils import sqlite_utils
 from skypilot_tpu.utils import vclock
 
@@ -63,8 +64,7 @@ class ReplicaStatus(enum.Enum):
 
 
 def _db_path() -> str:
-    path = os.path.expanduser(
-        os.environ.get(_DB_PATH_ENV, '~/.skytpu/serve.db'))
+    path = os.path.expanduser(knobs.get_str(_DB_PATH_ENV))
     os.makedirs(os.path.dirname(path), exist_ok=True)
     return path
 

@@ -18,6 +18,7 @@ from typing import Dict, List
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu.server import requests_lib
+from skypilot_tpu.utils import knobs
 
 logger = sky_logging.init_logger(__name__)
 
@@ -115,7 +116,7 @@ class Scheduler:
 
     def _spawn(self, rec):
         logger.info(f'request {rec["request_id"]} ({rec["name"]}) starting')
-        if os.environ.get(EXECUTOR_MODE_ENV) == 'thread':
+        if knobs.get_enum(EXECUTOR_MODE_ENV) == 'thread':
             return _InlineJob(rec)
         return subprocess.Popen(
             [sys.executable, '-m', 'skypilot_tpu.server.request_runner',

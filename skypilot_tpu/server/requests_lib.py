@@ -18,6 +18,7 @@ from skypilot_tpu.observe import journal as journal_lib
 from skypilot_tpu.observe import metrics as metrics_lib
 from skypilot_tpu.observe import spans as spans_lib
 from skypilot_tpu.observe import trace as trace_lib
+from skypilot_tpu.utils import knobs
 from skypilot_tpu.utils import sqlite_utils
 
 SHORT = 'SHORT'
@@ -46,8 +47,7 @@ class RequestStatus(str, enum.Enum):
 
 
 def server_dir() -> str:
-    d = os.path.expanduser(os.environ.get('SKYTPU_SERVER_DIR',
-                                          '~/.skytpu/api_server'))
+    d = os.path.expanduser(knobs.get_str('SKYTPU_SERVER_DIR'))
     os.makedirs(d, exist_ok=True)
     os.makedirs(os.path.join(d, 'logs'), exist_ok=True)
     return d

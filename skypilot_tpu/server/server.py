@@ -25,6 +25,7 @@ from aiohttp import web
 import skypilot_tpu
 from skypilot_tpu import sky_logging
 from skypilot_tpu.server import executor, registry, requests_lib
+from skypilot_tpu.utils import knobs
 
 logger = sky_logging.init_logger(__name__)
 
@@ -44,7 +45,7 @@ def _api_token() -> str:
     SKYTPU_API_TOKEN (or write ~/.skytpu/api_token) when exposing the
     server beyond localhost.
     """
-    token = os.environ.get('SKYTPU_API_TOKEN', '')
+    token = knobs.get_str('SKYTPU_API_TOKEN')
     if token:
         return token
     path = os.path.expanduser('~/.skytpu/api_token')
@@ -78,7 +79,7 @@ async def auth_middleware(request: web.Request, handler):
     #    The identity maps to the users-file entry of that name; unknown
     #    identities get SKYTPU_AUTH_DEFAULT_ROLE (default: no access).
     #  - Multi-user bearer tokens (users file present): token → user.
-    trust_header = os.environ.get('SKYTPU_AUTH_USER_HEADER', '')
+    trust_header = knobs.get_str('SKYTPU_AUTH_USER_HEADER')
     users = request.app['users']
     if trust_header or users:
         if request.path in open_paths:
@@ -91,7 +92,7 @@ async def auth_middleware(request: web.Request, handler):
                 user = next((u for u in (users or {}).values()
                              if u.name == identity), None)
                 if user is None:
-                    raw = os.environ.get('SKYTPU_AUTH_DEFAULT_ROLE', '')
+                    raw = knobs.get_str('SKYTPU_AUTH_DEFAULT_ROLE')
                     if raw:
                         try:
                             user = rbac.User(name=identity,
@@ -129,7 +130,7 @@ async def auth_middleware(request: web.Request, handler):
 
 async def health(request: web.Request) -> web.Response:
     return _json({'status': 'healthy', 'version': skypilot_tpu.__version__,
-                  'commit': os.environ.get('SKYTPU_COMMIT', 'dev')})
+                  'commit': knobs.get_str('SKYTPU_COMMIT')})
 
 
 async def submit(request: web.Request) -> web.Response:

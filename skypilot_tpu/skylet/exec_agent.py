@@ -38,10 +38,11 @@ import subprocess
 import sys
 import threading
 
+from skypilot_tpu.utils import knobs
+
 DEFAULT_PORT = 17077
 TOKEN_PATH = os.path.join(
-    os.environ.get('SKYTPU_RUNTIME_DIR',
-                   os.path.expanduser('~/.skytpu_runtime')),
+    os.path.expanduser(knobs.get_str('SKYTPU_RUNTIME_DIR')),
     'exec_agent.token')
 
 

@@ -20,14 +20,14 @@ from typing import Any, Dict, List, Optional
 from skypilot_tpu.observe import journal as journal_lib
 from skypilot_tpu.skylet import constants
 from skypilot_tpu.utils import failpoints
+from skypilot_tpu.utils import knobs
 from skypilot_tpu.utils import sqlite_utils
 from skypilot_tpu.utils.status_lib import JobStatus
 
 
 def runtime_dir() -> str:
     return os.path.expanduser(
-        os.environ.get(constants.SKYTPU_RUNTIME_DIR_ENV,
-                       constants.DEFAULT_RUNTIME_DIR))
+        knobs.get_str(constants.SKYTPU_RUNTIME_DIR_ENV))
 
 
 def _db_path() -> str:

@@ -33,6 +33,7 @@ from typing import Any, Dict, List, Optional
 from skypilot_tpu.observe import spans as spans_lib
 from skypilot_tpu.skylet import constants
 from skypilot_tpu.skylet import job_lib
+from skypilot_tpu.utils import knobs
 from skypilot_tpu.utils.status_lib import JobStatus
 
 
@@ -159,11 +160,11 @@ def run_gang(spec: Dict[str, Any]) -> int:
     # them here makes the driver's own journal writes
     # (job_lib.set_status below) and every rank carry the
     # control-plane correlation id and span parentage.
-    trace_id = spec.get('trace_id') or os.environ.get('SKYTPU_TRACE_ID')
+    trace_id = spec.get('trace_id') or knobs.get_str('SKYTPU_TRACE_ID')
     if trace_id:
-        os.environ['SKYTPU_TRACE_ID'] = trace_id
+        knobs.export('SKYTPU_TRACE_ID', trace_id)
     launch_parent = (spec.get('parent_span_id') or
-                     os.environ.get(spans_lib.ENV_PARENT))
+                     knobs.get_str(spans_lib.ENV_PARENT))
     # The gang span covers the whole on-cluster run (spawn → barrier →
     # exit) and is the parent every rank's spans nest under. Its id is
     # MINTED up front and the span recorded retroactively at the end:

@@ -18,6 +18,7 @@ import threading
 from typing import List, Optional
 
 from skypilot_tpu.utils import failpoints
+from skypilot_tpu.utils import knobs
 
 
 def _serve_until_signal(on_stop=None) -> None:
@@ -43,14 +44,14 @@ def main(argv: Optional[List[str]] = None) -> int:
     disp.add_argument('--port', type=int, default=8480)
     disp.add_argument('--db', default='~/.skytpu/rollout/dispatcher.db')
     disp.add_argument('--heartbeat-timeout', type=float,
-                      default=float(os.environ.get(
-                          'SKYTPU_ROLLOUT_HEARTBEAT_TIMEOUT', '10.0')))
+                      default=knobs.get_float(
+                          'SKYTPU_ROLLOUT_HEARTBEAT_TIMEOUT'))
     disp.add_argument('--lease-timeout', type=float,
-                      default=float(os.environ.get(
-                          'SKYTPU_ROLLOUT_LEASE_TIMEOUT', '120.0')))
+                      default=knobs.get_float(
+                          'SKYTPU_ROLLOUT_LEASE_TIMEOUT'))
     disp.add_argument('--max-outstanding', type=int,
-                      default=int(os.environ.get(
-                          'SKYTPU_ROLLOUT_MAX_OUTSTANDING', '32')))
+                      default=knobs.get_int(
+                          'SKYTPU_ROLLOUT_MAX_OUTSTANDING'))
 
     work = sub.add_parser('worker', help='harvestable rollout worker')
     work.add_argument('--dispatcher', required=True,

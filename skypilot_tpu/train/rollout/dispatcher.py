@@ -61,21 +61,19 @@ from skypilot_tpu.train.rollout import spec as spec_lib
 from skypilot_tpu.train.rollout import telemetry
 from skypilot_tpu.utils import failpoints
 from skypilot_tpu.utils import framed
+from skypilot_tpu.utils import knobs
 from skypilot_tpu.utils import sqlite_utils
 
 logger = sky_logging.init_logger(__name__)
 
-DEFAULT_HEARTBEAT_TIMEOUT = float(
-    os.environ.get('SKYTPU_ROLLOUT_HEARTBEAT_TIMEOUT', '10.0'))
-DEFAULT_LEASE_TIMEOUT = float(
-    os.environ.get('SKYTPU_ROLLOUT_LEASE_TIMEOUT', '120.0'))
+DEFAULT_HEARTBEAT_TIMEOUT = knobs.get_float(
+    'SKYTPU_ROLLOUT_HEARTBEAT_TIMEOUT')
+DEFAULT_LEASE_TIMEOUT = knobs.get_float('SKYTPU_ROLLOUT_LEASE_TIMEOUT')
 # Outstanding = minted-but-not-DONE leases. Bounds duplicated work
 # after a mass preemption AND (with the result cap) the dispatcher's
 # memory; the learner's consumption rate is the real throttle.
-DEFAULT_MAX_OUTSTANDING = int(
-    os.environ.get('SKYTPU_ROLLOUT_MAX_OUTSTANDING', '32'))
-DEFAULT_RESULT_CAP = int(
-    os.environ.get('SKYTPU_ROLLOUT_RESULT_CAP', '64'))
+DEFAULT_MAX_OUTSTANDING = knobs.get_int('SKYTPU_ROLLOUT_MAX_OUTSTANDING')
+DEFAULT_RESULT_CAP = knobs.get_int('SKYTPU_ROLLOUT_RESULT_CAP')
 # DONE lease rows kept for accounting before the reaper GCs them.
 _DONE_KEEP_ROWS = 10_000
 

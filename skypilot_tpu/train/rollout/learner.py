@@ -51,11 +51,11 @@ from skypilot_tpu.train.rollout import telemetry
 from skypilot_tpu.utils import backoff as backoff_lib
 from skypilot_tpu.utils import failpoints
 from skypilot_tpu.utils import framed
+from skypilot_tpu.utils import knobs
 
 logger = sky_logging.init_logger(__name__)
 
-DEFAULT_STALL_BUDGET_S = float(
-    os.environ.get('SKYTPU_ROLLOUT_STALL_BUDGET', '120.0'))
+DEFAULT_STALL_BUDGET_S = knobs.get_float('SKYTPU_ROLLOUT_STALL_BUDGET')
 
 
 class RolloutStallError(RuntimeError):

@@ -24,6 +24,7 @@ from skypilot_tpu import sky_logging
 from skypilot_tpu.observe import metrics as metrics_lib
 from skypilot_tpu.observe import spans
 from skypilot_tpu.utils import failpoints
+from skypilot_tpu.utils import knobs
 
 # Fixed name, not __name__: under `python -m` this module is '__main__',
 # which would fall outside the 'skypilot_tpu' logging root (no handler).
@@ -42,8 +43,7 @@ _BATCH_WAIT = metrics_lib.histogram(
 # timings, persist the interesting ones) — a span row per step on a
 # 100k-step run would just churn the journal GC with near-zero
 # durations the histogram already counts.
-_BATCH_WAIT_SPAN_MIN_S = float(
-    os.environ.get('SKYTPU_TRAIN_BATCH_WAIT_SPAN_MIN', '0.05'))
+_BATCH_WAIT_SPAN_MIN_S = knobs.get_float('SKYTPU_TRAIN_BATCH_WAIT_SPAN_MIN')
 
 
 @dataclasses.dataclass
@@ -139,15 +139,15 @@ def maybe_init_distributed() -> None:
     """Initialise jax.distributed on multi-host slices from the gang env
     (skylet/constants.py gang_env: coordinator + TPU_WORKER_ID)."""
     import jax
-    coordinator = os.environ.get('SKYTPU_COORDINATOR_ADDRESS')
-    num_procs = int(os.environ.get('SKYTPU_NUM_PROCESSES', '1'))
+    coordinator = knobs.get_str('SKYTPU_COORDINATOR_ADDRESS')
+    num_procs = knobs.get_int('SKYTPU_NUM_PROCESSES')
     if coordinator and num_procs > 1:
         # SKYTPU_NODE_RANK is the global rank across all slices;
         # TPU_WORKER_ID is slice-local and would collide on multi-slice.
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=num_procs,
-            process_id=int(os.environ.get('SKYTPU_NODE_RANK', '0')))
+            process_id=knobs.get_int('SKYTPU_NODE_RANK'))
 
 
 def _model_config(tcfg: TrainerConfig):

@@ -21,6 +21,7 @@ from typing import Any, Dict, Optional
 from skypilot_tpu import sky_logging
 from skypilot_tpu.utils import common_utils
 from skypilot_tpu.utils import jsonl_utils
+from skypilot_tpu.utils import knobs
 
 logger = sky_logging.init_logger(__name__)
 
@@ -28,7 +29,7 @@ _MAX_LOG_BYTES = jsonl_utils.DEFAULT_MAX_BYTES
 
 
 def _enabled() -> bool:
-    return os.environ.get('SKYTPU_DISABLE_USAGE', '0') != '1'
+    return not knobs.get_bool('SKYTPU_DISABLE_USAGE')
 
 
 def _log_path() -> str:
@@ -86,7 +87,7 @@ def record_event(operation: str, *, duration_s: Optional[float] = None,
     # below — constrained environments are exactly where the endpoint
     # matters.
     jsonl_utils.append_jsonl(_log_path(), event, _MAX_LOG_BYTES)
-    endpoint = os.environ.get('SKYTPU_USAGE_ENDPOINT')
+    endpoint = knobs.get_str('SKYTPU_USAGE_ENDPOINT')
     if endpoint:
         with contextlib.suppress(Exception):
             import requests

@@ -18,6 +18,8 @@ from typing import Any, Callable, Dict, List, Optional, TypeVar, Union
 
 import yaml
 
+from skypilot_tpu.utils import knobs
+
 _USER_HASH_FILE = os.path.expanduser('~/.skytpu/user_hash')
 USER_HASH_LENGTH = 8
 CLUSTER_NAME_VALID_REGEX = re.compile(r'^[a-zA-Z]([-_.a-zA-Z0-9]*[a-zA-Z0-9])?$')
@@ -27,7 +29,7 @@ F = TypeVar('F', bound=Callable)
 
 def get_user_hash() -> str:
     """Stable per-user hash, persisted under ~/.skytpu (analog of ~/.sky)."""
-    env = os.environ.get('SKYTPU_USER_HASH')
+    env = knobs.get_str('SKYTPU_USER_HASH')
     if env:
         return env[:USER_HASH_LENGTH]
     if os.path.exists(_USER_HASH_FILE):
@@ -52,37 +54,6 @@ def get_user() -> str:
 
 def get_usage_run_id() -> str:
     return str(uuid.uuid4())
-
-
-def env_float(name: str, default: float) -> float:
-    """Float knob from the environment: missing/empty → default;
-    malformed → default with a warning (a typo'd knob must not
-    silently change runtime semantics)."""
-    raw = os.environ.get(name)
-    if not raw:
-        return default
-    try:
-        return float(raw)
-    except ValueError:
-        import logging
-        logging.getLogger(__name__).warning(
-            'Ignoring malformed %s=%r (want a number).', name, raw)
-        return default
-
-
-def env_int(name: str, default: int) -> int:
-    """Integer knob from the environment (same contract as
-    env_float)."""
-    raw = os.environ.get(name)
-    if not raw:
-        return default
-    try:
-        return int(raw)
-    except ValueError:
-        import logging
-        logging.getLogger(__name__).warning(
-            'Ignoring malformed %s=%r (want an integer).', name, raw)
-        return default
 
 
 def base36(n: int) -> str:

@@ -19,8 +19,9 @@ so libtpu sees the chips exactly as a host process would.
 """
 from __future__ import annotations
 
-import os
 import shlex
+
+from skypilot_tpu.utils import knobs
 from typing import Optional
 
 CONTAINER_NAME = 'skytpu-task'
@@ -35,7 +36,7 @@ def docker_image_of(image_id: Optional[str]) -> Optional[str]:
 
 
 def docker_cmd() -> str:
-    return os.environ.get('SKYTPU_DOCKER_CMD', 'docker')
+    return knobs.get_str('SKYTPU_DOCKER_CMD')
 
 
 def bootstrap_cmd(image: str, cmd: Optional[str] = None) -> str:

@@ -1,28 +1,36 @@
-"""Boolean environment toggles (analog: sky/utils/env_options.py)."""
+"""Boolean environment toggles (analog: sky/utils/env_options.py).
+
+Each member maps to a bool knob declared in the typed registry
+(``utils/knobs.py``); reads delegate to :func:`knobs.get_bool`, so
+every toggle shares the one bool grammar (1/0/true/false/yes/no/
+on/off, anything else raises ``KnobError`` naming the knob) and the
+per-member defaults live in the registry, not here.
+"""
 from __future__ import annotations
 
 import enum
-import os
+
+from skypilot_tpu.utils import knobs
 
 
 class Options(enum.Enum):
-    """Each member is (env var name, default)."""
-    IS_DEVELOPER = ('SKYTPU_DEV', False)
-    SHOW_DEBUG_INFO = ('SKYTPU_DEBUG', False)
-    DISABLE_LOGGING = ('SKYTPU_DISABLE_USAGE_COLLECTION', False)
-    MINIMIZE_LOGGING = ('SKYTPU_MINIMIZE_LOGGING', True)
-    SUPPRESS_SENSITIVE_LOG = ('SKYTPU_SUPPRESS_SENSITIVE_LOG', False)
-    RUNNING_IN_BUFFER = ('SKYTPU_RUNNING_IN_BUFFER', False)
+    """Each member names its registry knob."""
+    IS_DEVELOPER = 'SKYTPU_DEV'
+    SHOW_DEBUG_INFO = 'SKYTPU_DEBUG'
+    DISABLE_LOGGING = 'SKYTPU_DISABLE_USAGE_COLLECTION'
+    MINIMIZE_LOGGING = 'SKYTPU_MINIMIZE_LOGGING'
+    SUPPRESS_SENSITIVE_LOG = 'SKYTPU_SUPPRESS_SENSITIVE_LOG'
+    RUNNING_IN_BUFFER = 'SKYTPU_RUNNING_IN_BUFFER'
 
-    def __init__(self, env_var: str, default: bool):
+    def __init__(self, env_var: str):
         self.env_var = env_var
-        self.default = default
+
+    @property
+    def default(self) -> bool:
+        return knobs.default_of(self.env_var)
 
     def get(self) -> bool:
-        v = os.environ.get(self.env_var)
-        if v is None:
-            return self.default
-        return v.lower() in ('1', 'true', 'yes')
+        return knobs.get_bool(self.env_var)
 
     def __bool__(self) -> bool:
         return self.get()

@@ -64,6 +64,8 @@ import threading
 import time
 from typing import Callable, Dict, Iterator, List, Optional
 
+from skypilot_tpu.utils import knobs
+
 # One attribute read on the hot path. False ⟺ no site armed; flips
 # under _LOCK only. Reads are racy-by-design (a site armed mid-step
 # takes effect at the next check) — that is fine for fault injection.
@@ -307,7 +309,7 @@ def load_env() -> None:
     """Arm sites from ``SKYTPU_FAILPOINTS`` (idempotent; re-arms with
     fresh counters). Called at import and by server entrypoints so a
     chaos schedule set in the environment reaches detached processes."""
-    text = os.environ.get(ENV_VAR, '')
+    text = knobs.get_str(ENV_VAR)
     if not text:
         return
     for site, kwargs in parse_spec(text).items():

@@ -15,6 +15,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
 
+from skypilot_tpu.utils import knobs
+
 _EVENTS: List[Dict[str, Any]] = []
 _LOCK = threading.Lock()
 _ENABLED: Optional[bool] = None
@@ -24,7 +26,7 @@ _ATEXIT_REGISTERED = False
 def _enabled() -> bool:
     global _ENABLED, _ATEXIT_REGISTERED
     if _ENABLED is None:
-        _ENABLED = bool(os.environ.get('SKYTPU_TIMELINE_FILE_PATH'))
+        _ENABLED = knobs.is_set('SKYTPU_TIMELINE_FILE_PATH')
         if _ENABLED and not _ATEXIT_REGISTERED:
             # Guarded: reset_for_tests() re-arms _ENABLED, and a second
             # atexit registration would double-write the trace file.
@@ -110,7 +112,7 @@ def event(fn: Optional[Callable] = None, name: Optional[str] = None):
 
 
 def save_timeline() -> None:
-    path = os.environ.get('SKYTPU_TIMELINE_FILE_PATH')
+    path = knobs.get_str('SKYTPU_TIMELINE_FILE_PATH')
     if not path or not _EVENTS:
         return
     with _LOCK:

@@ -18,14 +18,15 @@ there is no file read on the hot path.
 """
 from __future__ import annotations
 
-import os
 import time
+
+from skypilot_tpu.utils import knobs
 
 _ENV = 'SKYTPU_CLOCK_OFFSET_FILE'
 
 
 def now() -> float:
-    path = os.environ.get(_ENV)
+    path = knobs.get_str(_ENV)
     if not path:
         return time.time()
     try:
@@ -39,7 +40,7 @@ def now() -> float:
 def advance(seconds: float) -> None:
     """Test helper: add `seconds` to the virtual offset (requires the
     env var to point at a writable file)."""
-    path = os.environ.get(_ENV)
+    path = knobs.get_str(_ENV)
     if not path:
         raise RuntimeError(f'{_ENV} is not set; nothing to advance')
     try:

@@ -10,13 +10,13 @@ the feature.
 """
 from __future__ import annotations
 
-import os
+from skypilot_tpu.utils import knobs
 
 DEFAULT_WORKSPACE = 'default'
 
 
 def get_active_workspace() -> str:
-    env = os.environ.get('SKYTPU_WORKSPACE')
+    env = knobs.get_str('SKYTPU_WORKSPACE')
     if env:
         return env
     from skypilot_tpu import config as config_lib
