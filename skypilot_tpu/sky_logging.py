@@ -6,7 +6,6 @@ from __future__ import annotations
 
 import contextlib
 import logging
-import os
 import sys
 import threading
 
@@ -21,7 +20,12 @@ _silent = threading.local()
 
 
 def _debug_enabled() -> bool:
-    return os.environ.get('SKYTPU_DEBUG', '0') == '1'
+    # Shares the one registry bool grammar with env_options
+    # SHOW_DEBUG_INFO — the two SKYTPU_DEBUG readers used to disagree
+    # (this one accepted only '1'; 'true'/'yes' toggled the other).
+    # Lazy import: sky_logging sits below utils in the layer DAG.
+    from skypilot_tpu.utils import knobs
+    return knobs.get_bool('SKYTPU_DEBUG')
 
 
 class _NoPrefixFormatter(logging.Formatter):
