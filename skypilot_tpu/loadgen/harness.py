@@ -32,6 +32,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.utils import knobs
 
 logger = sky_logging.init_logger(__name__)
 
@@ -138,8 +139,11 @@ class LocalStack:
                    # Enough prefix-cache entries that eviction noise
                    # doesn't mask the routing signal the churn
                    # scenario measures.
-                   'SKYTPU_ENGINE_PREFIX_CACHE': os.environ.get(
-                       'SKYTPU_ENGINE_PREFIX_CACHE', '16'),
+                   # Deliberately larger than the registry default
+                   # (4): an explicit, validated bench setting — an
+                   # operator-set value still wins.
+                   'SKYTPU_ENGINE_PREFIX_CACHE': knobs.raw(
+                       'SKYTPU_ENGINE_PREFIX_CACHE', default='16'),
                    'SKYTPU_OBSERVE_DB': os.path.join(
                        self.run_dir, f'replica-{i}.db')}
             handoff_port = None
