@@ -24,6 +24,7 @@ from skypilot_tpu.analysis import failpoint_naming
 from skypilot_tpu.analysis import host_sync_loops
 from skypilot_tpu.analysis import jit_boundary
 from skypilot_tpu.analysis import jit_hazards
+from skypilot_tpu.analysis import knob_discipline
 from skypilot_tpu.analysis import lazy_imports
 from skypilot_tpu.analysis import layers
 from skypilot_tpu.analysis import lock_ordering
@@ -56,6 +57,7 @@ ALL: List[Tuple[str, ModuleType]] = [
     (backoff_discipline.NAME, backoff_discipline),
     (lock_ordering.NAME, lock_ordering),
     (jit_boundary.NAME, jit_boundary),
+    (knob_discipline.NAME, knob_discipline),
 ]
 
 

@@ -56,8 +56,15 @@ PACKAGE = 'skypilot_tpu'
 # reacquire, attrs written both under and outside their lock) and
 # jit-boundary (jit created in loop bodies, fresh containers /
 # unhashable static args at jitted call sites, donated buffers read
-# after the donating call).
-REPORT_VERSION = 15
+# after the donating call); v16: knob-discipline — the typed SKYTPU_*
+# registry (utils/knobs.py) becomes the only sanctioned env surface:
+# raw os.environ reads of SKYTPU_* vars, undeclared knob names at
+# knobs.get_* sites, docs/KNOBS.md drift, dead declarations, and
+# propagate=True knobs missing from constants.gang_env (or spawn envs
+# built without the inherited environment) all fail the build —
+# checkers gain a third entry point, run_package(modules, root), for
+# rules that need the package root (the generated-docs sync).
+REPORT_VERSION = 16
 
 
 @dataclasses.dataclass
@@ -338,6 +345,14 @@ def run_analysis(root: str,
                 from skypilot_tpu.analysis import callgraph
                 graph = callgraph.build(all_modules)
             for v in run_prog(all_modules, graph):
+                if v.path in scanned:
+                    add(v)
+        run_pkg = getattr(chk, 'run_package', None)
+        if run_pkg is not None:
+            # Like run_program: sees the FULL package plus the scan
+            # root (for generated-docs sync against dirname(root)),
+            # findings filtered back to the scanned paths.
+            for v in run_pkg(all_modules, root):
                 if v.path in scanned:
                     add(v)
     violations.sort(key=lambda v: (v.path, v.line, v.check))

@@ -1,0 +1,400 @@
+"""Knob discipline: every SKYTPU_* env var declared, read through the
+registry, documented, alive, and (when flagged) propagated.
+
+The typed knob registry (``utils/knobs.py``, docs/KNOBS.md) is the
+single source of truth for the package's env control surface. This
+checker AST-loads the ``_declare(...)`` calls (the ``state_machines``
+precedent: parse, never import) and enforces five rules:
+
+  1. **no-raw-env** — ``os.environ``/``os.getenv`` touching a
+     ``SKYTPU_*`` name outside ``utils/knobs.py`` is a violation:
+     raw reads bypass the type grammar, the loud-failure contract,
+     and the docs/propagation audit. Writes (``os.environ[...] =``)
+     are included — ``knobs.export`` is the sanctioned write path.
+  2. **undeclared-knob** — every knob name reaching a
+     ``knobs.<accessor>(...)`` call site must be declared in the
+     registry. Names are literals or module-level string constants
+     (resolved per module); a typo'd knob silently reading "unset"
+     forever is exactly the bug class this kills.
+  3. **docs-sync** — every declared knob needs a row in the generated
+     docs/KNOBS.md, and every documented knob must still be declared
+     (the roster-sync precedent; the tier-1 regen test pins the full
+     file, this rule keeps partial hand-edits from drifting).
+  4. **dead-knob** — a declared knob that no module outside
+     ``knobs.py`` mentions (as an accessor argument, a resolvable
+     constant, or inside any string literal — env-dict keys, docs
+     prose, provider tables all count) is dead weight; delete the
+     declaration or wire the consumer.
+  5. **propagate** — knobs declared ``propagate=True`` are
+     process-identity/correlation values every gang member must
+     carry: each must be provably forwarded by
+     ``skylet/constants.py::gang_env`` (the cross-host env boundary —
+     nothing inherits across SSH). The converse holds too: a
+     ``SKYTPU_*`` key gang_env forwards must be declared
+     ``propagate=True``, so the flag can't rot. Worker-spawn sites
+     (data_service/rollout/loadgen/jobs/serve) inherit the parent env
+     — any ``subprocess`` call whose ``env=`` is built from scratch
+     (no ``**os.environ`` / ``dict(os.environ)`` base) drops every
+     propagated knob on the floor and is flagged.
+
+Scope: the whole package except ``analysis`` (fixtures/prose) and
+``utils/knobs.py`` itself (rules 1/2/4 exempt the registry module).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from skypilot_tpu.analysis import core
+
+NAME = 'knob-discipline'
+
+KNOBS_PATH = 'utils/knobs.py'
+GANG_ENV_PATH = 'skylet/constants.py'
+
+_KNOB_RE = re.compile(r'\bSKYTPU_[A-Z0-9_]+\b')
+
+# The registry's public accessors whose first argument is a knob name.
+_ACCESSORS = frozenset({
+    'get_int', 'get_float', 'get_bool', 'get_str', 'get_enum',
+    'get_json', 'parse', 'is_set', 'raw', 'export', 'default_of',
+})
+
+
+# ----------------------------------------------------- registry load
+
+def load_registry(modules) -> Dict[str, Dict]:
+    """AST-extract the ``_declare(...)`` table from utils/knobs.py.
+
+    Returns name → {'line', 'propagate'}. Only literal arguments are
+    honored (the declaration contract knobs.py documents); a
+    non-literal name is simply skipped — rule 2 then flags its call
+    sites as undeclared, which is the loud failure we want.
+    """
+    registry: Dict[str, Dict] = {}
+    for mod in modules:
+        if mod.path != KNOBS_PATH:
+            continue
+        for node in ast.walk(mod.tree):
+            if not (isinstance(node, ast.Call) and
+                    isinstance(node.func, ast.Name) and
+                    node.func.id == '_declare'):
+                continue
+            if not (node.args and
+                    isinstance(node.args[0], ast.Constant) and
+                    isinstance(node.args[0].value, str)):
+                continue
+            propagate = False
+            for kw in node.keywords:
+                if kw.arg == 'propagate' and \
+                        isinstance(kw.value, ast.Constant):
+                    propagate = bool(kw.value.value)
+            registry[node.args[0].value] = {
+                'line': node.lineno, 'propagate': propagate,
+            }
+    return registry
+
+
+def _module_str_constants(tree: ast.Module) -> Dict[str, str]:
+    """Module-level NAME = '<literal str>' assignments."""
+    out: Dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    out[tgt.id] = node.value.value
+    return out
+
+
+def _resolve_knob_arg(arg: ast.expr,
+                      consts: Dict[str, str]) -> Optional[str]:
+    """The knob name an accessor's first argument statically names.
+
+    Literals and module-level constants resolve; ``CONSTANT`` pulled
+    from another module (``constants.SKYTPU_RUNTIME_DIR_ENV``) or a
+    dynamic attribute (``self.endpoint_env``) return None — those
+    sites are covered by the dead-knob string sweep instead.
+    """
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value
+    if isinstance(arg, ast.Name):
+        return consts.get(arg.id)
+    return None
+
+
+# ------------------------------------------------- rule 1 + 2 (per-module)
+
+def _is_environ_node(node: ast.expr) -> bool:
+    """``os.environ`` (Attribute) — the raw-env surface."""
+    return (isinstance(node, ast.Attribute) and node.attr == 'environ'
+            and isinstance(node.value, ast.Name)
+            and node.value.id == 'os')
+
+
+def _raw_env_knobs(node: ast.AST) -> List[str]:
+    """SKYTPU_* names a raw env expression touches (empty if none)."""
+    names: List[str] = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, str):
+            names.extend(_KNOB_RE.findall(sub.value))
+    return names
+
+
+def _module_violations(mod: core.ModuleInfo,
+                       registry: Dict[str, Dict]
+                       ) -> List[core.Violation]:
+    """Rules 1 and 2 for one module."""
+    if mod.unit == 'analysis' or mod.path == KNOBS_PATH:
+        return []
+    out: List[core.Violation] = []
+    consts = _module_str_constants(mod.tree)
+
+    for node in core.module_nodes(mod.tree):
+        # Rule 1: os.environ[...] / os.environ.get(...) / os.getenv(...)
+        # with a SKYTPU_* literal anywhere in the expression.
+        raw_site = None
+        if isinstance(node, ast.Subscript) and \
+                _is_environ_node(node.value):
+            raw_site = node
+        elif isinstance(node, ast.Call):
+            f = node.func
+            if isinstance(f, ast.Attribute) and (
+                    _is_environ_node(f.value) or
+                    (f.attr == 'getenv' and
+                     isinstance(f.value, ast.Name) and
+                     f.value.id == 'os')):
+                raw_site = node
+        if raw_site is not None:
+            hit = _raw_env_knobs(raw_site)
+            # Constant-named reads too: os.environ.get(FOO) where FOO
+            # is (or resolves to) a SKYTPU_* module constant.
+            if not hit and isinstance(raw_site, ast.Call):
+                for arg in raw_site.args[:1]:
+                    r = _resolve_knob_arg(arg, consts)
+                    if r and _KNOB_RE.fullmatch(r):
+                        hit = [r]
+            if not hit and isinstance(raw_site, ast.Subscript):
+                r = _resolve_knob_arg(raw_site.slice, consts)
+                if r and _KNOB_RE.fullmatch(r):
+                    hit = [r]
+            for knob in hit:
+                out.append(core.Violation(
+                    NAME, mod.path, raw_site.lineno, raw_site.col_offset,
+                    f'raw-env:{knob}',
+                    f'raw os.environ access of {knob}: read/write it '
+                    f'through utils/knobs.py (knobs.get_* / '
+                    f'knobs.export) so the type grammar, loud-failure '
+                    f'contract, and propagation audit apply'))
+
+        # Rule 2: knobs.<accessor>('SKYTPU_X') must be declared.
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _ACCESSORS and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id == 'knobs' and node.args:
+            knob = _resolve_knob_arg(node.args[0], consts)
+            if knob is not None and registry and knob not in registry:
+                out.append(core.Violation(
+                    NAME, mod.path, node.lineno, node.col_offset,
+                    f'undeclared:{knob}',
+                    f'knobs.{node.func.attr}({knob!r}) but {knob} is '
+                    f'not declared in utils/knobs.py — add a '
+                    f'_declare(...) row (typo? a misspelled knob '
+                    f'reads as permanently unset)'))
+    return out
+
+
+# ------------------------------------------------ rules 3-5 (package)
+
+def _docs_rows(root: str) -> Optional[Set[str]]:
+    """Knob names with a table row in docs/KNOBS.md (None: no file)."""
+    path = os.path.join(os.path.dirname(os.path.abspath(root)),
+                        'docs', 'KNOBS.md')
+    if not os.path.exists(path):
+        return None
+    rows: Set[str] = set()
+    with open(path, 'r', encoding='utf-8') as f:
+        for line in f:
+            m = re.match(r'\|\s*`(SKYTPU_[A-Z0-9_]+)`\s*\|', line)
+            if m:
+                rows.add(m.group(1))
+    return rows
+
+
+def _gang_env_forwards(modules) -> Tuple[Set[str], int]:
+    """SKYTPU_* names ``gang_env`` puts in its env dict, + its line."""
+    forwarded: Set[str] = set()
+    line = 0
+    for mod in modules:
+        if mod.path != GANG_ENV_PATH:
+            continue
+        consts = _module_str_constants(mod.tree)
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.FunctionDef) and \
+                    node.name == 'gang_env':
+                line = node.lineno
+                for sub in ast.walk(node):
+                    # Dict-display keys and env['X'] = ... stores.
+                    keys: List[ast.expr] = []
+                    if isinstance(sub, ast.Dict):
+                        keys = [k for k in sub.keys if k is not None]
+                    elif isinstance(sub, ast.Subscript) and \
+                            isinstance(sub.ctx, ast.Store):
+                        keys = [sub.slice]
+                    for key in keys:
+                        name = _resolve_knob_arg(key, consts)
+                        if name and _KNOB_RE.fullmatch(name):
+                            forwarded.add(name)
+    return forwarded, line
+
+
+def _spawn_env_violations(modules) -> List[core.Violation]:
+    """subprocess calls whose env= is built from scratch (rule 5b).
+
+    ``env=<Name>`` resolves through the module's ``NAME = <expr>``
+    assignments; with several assignments the call is flagged only
+    when EVERY candidate builds a fresh dict (conservative — one
+    inheriting branch clears the site). One memoized node sweep per
+    module (the wall-clock budget shape)."""
+    out: List[core.Violation] = []
+    for mod in modules:
+        if mod.unit == 'analysis' or mod.path == KNOBS_PATH:
+            continue
+        nodes = core.module_nodes(mod.tree)
+        assigns: Dict[str, List[ast.expr]] = {}
+        for sub in nodes:
+            if isinstance(sub, ast.Assign) and \
+                    len(sub.targets) == 1 and \
+                    isinstance(sub.targets[0], ast.Name):
+                assigns.setdefault(sub.targets[0].id,
+                                   []).append(sub.value)
+        for sub in nodes:
+            if not (isinstance(sub, ast.Call) and
+                    isinstance(sub.func, ast.Attribute) and
+                    sub.func.attr in ('Popen', 'run',
+                                      'check_call', 'check_output')
+                    and isinstance(sub.func.value, ast.Name) and
+                    sub.func.value.id == 'subprocess'):
+                continue
+            for kw in sub.keywords:
+                if kw.arg != 'env':
+                    continue
+                exprs: List[ast.expr] = [kw.value]
+                if isinstance(kw.value, ast.Name):
+                    exprs = assigns.get(kw.value.id, [])
+                if not exprs or \
+                        not all(_builds_fresh_env(e) for e in exprs):
+                    continue
+                out.append(core.Violation(
+                    NAME, mod.path, sub.lineno, sub.col_offset,
+                    'spawn-env-fresh',
+                    'subprocess env= is built from scratch (no '
+                    '**os.environ / dict(os.environ) base): every '
+                    'propagate=True knob set on this process is '
+                    'silently dropped in the child — start from '
+                    'the inherited environment'))
+    return out
+
+
+def _builds_fresh_env(expr: ast.expr) -> bool:
+    """True when the env expression does NOT inherit os.environ."""
+    for sub in ast.walk(expr):
+        if _is_environ_node(sub):
+            return False
+    return True
+
+
+def run_package(modules, root: str) -> List[core.Violation]:
+    """All five rules; runs ONCE over the whole package (core
+    filters findings back down to the --changed scope)."""
+    registry = load_registry(modules)
+    out: List[core.Violation] = []
+    for mod in modules:
+        out.extend(_module_violations(mod, registry))
+    if not registry:
+        # No registry module in this package (fixture trees without a
+        # utils/knobs.py): rules 2-5 have nothing to check against —
+        # the raw-env and spawn-env rules above/below still apply.
+        out.extend(_spawn_env_violations(modules))
+        return out
+
+    # Rule 3: docs sync, both directions.
+    rows = _docs_rows(root)
+    if rows is None:
+        out.append(core.Violation(
+            NAME, KNOBS_PATH, 1, 0, 'docs-missing',
+            'docs/KNOBS.md does not exist — generate it: '
+            'python -m skypilot_tpu.utils.knobs --markdown'))
+    else:
+        for name, info in sorted(registry.items()):
+            if name not in rows:
+                out.append(core.Violation(
+                    NAME, KNOBS_PATH, info['line'], 0,
+                    f'undocumented:{name}',
+                    f'{name} is declared but has no row in '
+                    f'docs/KNOBS.md — regenerate it: python -m '
+                    f'skypilot_tpu.utils.knobs --markdown'))
+        for name in sorted(rows - set(registry)):
+            out.append(core.Violation(
+                NAME, KNOBS_PATH, 1, 0, f'ghost-doc:{name}',
+                f'docs/KNOBS.md documents {name} but the registry '
+                f'does not declare it — regenerate the doc'))
+
+    # Rule 4: dead knobs. A knob is alive if any module other than
+    # knobs.py mentions it — as a resolvable accessor argument or
+    # inside ANY string literal (env-dict keys, provider tables,
+    # docstrings that hand the knob to operators all count; the bar
+    # is deliberately low — rule 4 exists to catch *deleted* call
+    # sites, not to second-guess unusual but real consumers).
+    mentioned: Set[str] = set()
+    for mod in modules:
+        if mod.path == KNOBS_PATH:
+            continue
+        for node in core.module_nodes(mod.tree):
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str):
+                mentioned.update(_KNOB_RE.findall(node.value))
+        consts = _module_str_constants(mod.tree)
+        mentioned.update(v for v in consts.values()
+                         if _KNOB_RE.fullmatch(v))
+    for name, info in sorted(registry.items()):
+        if name not in mentioned:
+            out.append(core.Violation(
+                NAME, KNOBS_PATH, info['line'], 0, f'dead:{name}',
+                f'{name} is declared but nothing in the package '
+                f'reads or mentions it — delete the declaration or '
+                f'wire the consumer'))
+
+    # Rule 5: propagate=True knobs must cross the gang boundary.
+    forwarded, gang_line = _gang_env_forwards(modules)
+    if forwarded:
+        for name, info in sorted(registry.items()):
+            if info['propagate'] and name not in forwarded:
+                out.append(core.Violation(
+                    NAME, KNOBS_PATH, info['line'], 0,
+                    f'unpropagated:{name}',
+                    f'{name} is declared propagate=True but '
+                    f'constants.gang_env does not forward it — every '
+                    f'gang member must carry it (the PR-15 '
+                    f'SKYTPU_ENGINE_ATTN gang-skew bug class)'))
+        for name in sorted(forwarded):
+            if name in registry and not registry[name]['propagate']:
+                out.append(core.Violation(
+                    NAME, GANG_ENV_PATH, gang_line, 0,
+                    f'propagate-flag:{name}',
+                    f'gang_env forwards {name} but its declaration '
+                    f'is not propagate=True — flag it so the '
+                    f'propagation contract is auditable'))
+            elif name not in registry:
+                out.append(core.Violation(
+                    NAME, GANG_ENV_PATH, gang_line, 0,
+                    f'undeclared:{name}',
+                    f'gang_env forwards {name} but the registry does '
+                    f'not declare it'))
+
+    out.extend(_spawn_env_violations(modules))
+    return out

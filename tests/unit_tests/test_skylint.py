@@ -42,7 +42,7 @@ EXPECTED_CHECKS = [
     'state-machine', 'thread-discipline', 'silent-except',
     'metric-discipline', 'span-discipline', 'timeout-discipline',
     'failpoint-naming', 'backoff-discipline', 'lock-ordering',
-    'jit-boundary',
+    'jit-boundary', 'knob-discipline',
 ]
 
 
@@ -2013,6 +2013,206 @@ class TestJitBoundaryChecker:
 
 # ------------------------------------------------------------ allowlist + report
 
+# ----------------------------------------------------- knob-discipline
+
+class TestKnobDisciplineChecker:
+    """The typed SKYTPU_* registry contract (docs/KNOBS.md):
+    raw-env reads, undeclared knobs, docs drift, dead declarations,
+    and the propagate/gang_env round-trip."""
+
+    REGISTRY_SRC = """
+        REGISTRY = {}
+
+        def _declare(name, type, default, subsystem, doc, *,
+                     propagate=False, choices=()):
+            REGISTRY[name] = (type, default, subsystem)
+
+        _declare('SKYTPU_ALPHA', 'int', 3, 'serve', 'Alpha knob.')
+        _declare('SKYTPU_BETA', 'str', None, 'jobs', 'Beta knob.',
+                 propagate=True)
+    """
+
+    DOCS_SRC = """
+        # knobs
+        | knob | type | default | propagate | doc |
+        |---|---|---|---|---|
+        | `SKYTPU_ALPHA` | int | `3` |  | Alpha knob. |
+        | `SKYTPU_BETA` | str | `—` | yes | Beta knob. |
+    """
+
+    def _tree(self, tmp_path):
+        """A fixture package that satisfies all five rules."""
+        pkg = tmp_path / 'pkg'
+        _write(tmp_path, 'pkg/utils/knobs.py', self.REGISTRY_SRC)
+        _write(tmp_path, 'pkg/serve/consumer.py', """
+            from skypilot_tpu.utils import knobs
+            LIMIT = knobs.get_int('SKYTPU_ALPHA')
+        """)
+        _write(tmp_path, 'pkg/skylet/constants.py', """
+            def gang_env(rank):
+                env = {'SKYTPU_BETA': str(rank)}
+                return env
+        """)
+        _write(tmp_path, 'docs/KNOBS.md', self.DOCS_SRC)
+        return pkg
+
+    def test_clean_fixture_no_findings(self, tmp_path):
+        report = _run(self._tree(tmp_path),
+                      checks=['knob-discipline'])
+        assert report['violations'] == []
+
+    def test_raw_env_read_and_write_flagged(self, tmp_path):
+        pkg = self._tree(tmp_path)
+        _write(tmp_path, 'pkg/serve/raw.py', """
+            import os
+            A = os.environ.get('SKYTPU_ALPHA', '3')
+            B = os.getenv('SKYTPU_BETA')
+            os.environ['SKYTPU_ALPHA'] = '9'
+        """)
+        idents = _idents(_run(pkg, checks=['knob-discipline']))
+        assert 'knob-discipline:serve/raw.py:raw-env:SKYTPU_ALPHA' \
+            in idents
+        assert 'knob-discipline:serve/raw.py:raw-env:SKYTPU_BETA' \
+            in idents
+        assert len(idents) == 3  # read + getenv + write
+
+    def test_raw_env_via_module_constant_flagged(self, tmp_path):
+        # The literal hides behind a module-level constant — still a
+        # raw read (the job_lib runtime_dir() pre-fix shape).
+        pkg = self._tree(tmp_path)
+        _write(tmp_path, 'pkg/serve/indirect.py', """
+            import os
+            _ENV = 'SKYTPU_ALPHA'
+            A = os.environ.get(_ENV)
+        """)
+        idents = _idents(_run(pkg, checks=['knob-discipline']))
+        assert idents == [
+            'knob-discipline:serve/indirect.py:raw-env:SKYTPU_ALPHA']
+
+    def test_non_skytpu_env_reads_untouched(self, tmp_path):
+        pkg = self._tree(tmp_path)
+        _write(tmp_path, 'pkg/serve/other.py', """
+            import os
+            HOME = os.environ.get('HOME')
+            PLAT = os.getenv('JAX_PLATFORMS', 'cpu')
+        """)
+        assert _run(pkg, checks=['knob-discipline'])['violations'] == []
+
+    def test_undeclared_knob_at_callsite(self, tmp_path):
+        pkg = self._tree(tmp_path)
+        _write(tmp_path, 'pkg/serve/typo.py', """
+            from skypilot_tpu.utils import knobs
+            A = knobs.get_int('SKYTPU_TYPO')
+            _ENV = 'SKYTPU_TYPO_TWO'
+            B = knobs.get_str(_ENV)
+        """)
+        idents = _idents(_run(pkg, checks=['knob-discipline']))
+        assert 'knob-discipline:serve/typo.py:undeclared:SKYTPU_TYPO' \
+            in idents
+        assert ('knob-discipline:serve/typo.py:undeclared:'
+                'SKYTPU_TYPO_TWO') in idents
+
+    def test_docs_sync_both_directions(self, tmp_path):
+        pkg = self._tree(tmp_path)
+        # Drop ALPHA's row, add a ghost row.
+        _write(tmp_path, 'docs/KNOBS.md', """
+            | knob | type | default | propagate | doc |
+            |---|---|---|---|---|
+            | `SKYTPU_BETA` | str | `—` | yes | Beta knob. |
+            | `SKYTPU_GHOST` | int | `1` |  | Gone knob. |
+        """)
+        idents = _idents(_run(pkg, checks=['knob-discipline']))
+        assert ('knob-discipline:utils/knobs.py:'
+                'undocumented:SKYTPU_ALPHA') in idents
+        assert 'knob-discipline:utils/knobs.py:ghost-doc:SKYTPU_GHOST' \
+            in idents
+
+    def test_missing_docs_file_flagged(self, tmp_path):
+        pkg = self._tree(tmp_path)
+        os.unlink(os.path.join(tmp_path, 'docs', 'KNOBS.md'))
+        idents = _idents(_run(pkg, checks=['knob-discipline']))
+        assert idents == ['knob-discipline:utils/knobs.py:docs-missing']
+
+    def test_dead_knob_flagged_and_string_mention_is_alive(
+            self, tmp_path):
+        pkg = self._tree(tmp_path)
+        _write(tmp_path, 'pkg/utils/knobs.py', self.REGISTRY_SRC + """
+        _declare('SKYTPU_GAMMA', 'bool', False, 'serve', 'Gamma.')
+        _declare('SKYTPU_DELTA', 'bool', False, 'serve', 'Delta.')
+        """)
+        _write(tmp_path, 'docs/KNOBS.md', """
+            | knob | type | default | propagate | doc |
+            |---|---|---|---|---|
+            | `SKYTPU_ALPHA` | int | `3` |  | Alpha knob. |
+            | `SKYTPU_BETA` | str | `—` | yes | Beta knob. |
+            | `SKYTPU_GAMMA` | bool | `False` |  | Gamma. |
+            | `SKYTPU_DELTA` | bool | `False` |  | Delta. |
+        """)
+        # DELTA is mentioned inside a string (an env-dict key, the
+        # loadgen pattern) — alive; GAMMA is mentioned nowhere.
+        _write(tmp_path, 'pkg/serve/spawnish.py', """
+            CHILD_ENV = {'SKYTPU_DELTA': '1'}
+        """)
+        idents = _idents(_run(pkg, checks=['knob-discipline']))
+        assert idents == ['knob-discipline:utils/knobs.py:dead:SKYTPU_GAMMA']
+
+    def test_propagate_knob_must_cross_gang_env(self, tmp_path):
+        pkg = self._tree(tmp_path)
+        # BETA forwarded via a module constant; EPSILON (propagate)
+        # is NOT forwarded → violation. ALPHA (propagate=False) now
+        # forwarded → flag-drift violation.
+        _write(tmp_path, 'pkg/utils/knobs.py', self.REGISTRY_SRC + """
+        _declare('SKYTPU_EPSILON', 'str', None, 'jobs', 'Eps.',
+                 propagate=True)
+        """)
+        _write(tmp_path, 'docs/KNOBS.md', """
+            | knob | type | default | propagate | doc |
+            |---|---|---|---|---|
+            | `SKYTPU_ALPHA` | int | `3` |  | Alpha knob. |
+            | `SKYTPU_BETA` | str | `—` | yes | Beta knob. |
+            | `SKYTPU_EPSILON` | str | `—` | yes | Eps. |
+        """)
+        _write(tmp_path, 'pkg/skylet/constants.py', """
+            SKYTPU_BETA = 'SKYTPU_BETA'
+
+            def gang_env(rank):
+                env = {SKYTPU_BETA: str(rank)}
+                env['SKYTPU_ALPHA'] = '3'
+                return env
+        """)
+        _write(tmp_path, 'pkg/jobs/eps_user.py', """
+            from skypilot_tpu.utils import knobs
+            E = knobs.get_str('SKYTPU_EPSILON')
+        """)
+        idents = _idents(_run(pkg, checks=['knob-discipline']))
+        assert ('knob-discipline:utils/knobs.py:'
+                'unpropagated:SKYTPU_EPSILON') in idents
+        assert ('knob-discipline:skylet/constants.py:'
+                'propagate-flag:SKYTPU_ALPHA') in idents
+        assert len(idents) == 2
+
+    def test_spawn_env_built_from_scratch_flagged(self, tmp_path):
+        pkg = self._tree(tmp_path)
+        _write(tmp_path, 'pkg/serve/spawn.py', """
+            import os
+            import subprocess
+
+            def bad(cmd):
+                subprocess.Popen(cmd, env={'JAX_PLATFORMS': 'cpu'})
+
+            def good_inline(cmd):
+                subprocess.Popen(cmd, env={**os.environ, 'X': '1'})
+
+            def good_via_local(cmd):
+                env = dict(os.environ)
+                env['X'] = '1'
+                subprocess.run(cmd, env=env)
+        """)
+        idents = _idents(_run(pkg, checks=['knob-discipline']))
+        assert idents == [
+            'knob-discipline:serve/spawn.py:spawn-env-fresh']
+
+
 class TestAllowlistAndReport:
 
     def test_allowlist_round_trip(self, tmp_path):
@@ -2240,6 +2440,44 @@ class TestCli:
         assert proc.returncode == 1
         assert 'EXPIRED allowlist entry' in proc.stderr
 
+    def test_diff_and_expires_apply_to_knob_discipline(self, tmp_path):
+        # The PR-review fast path and the allowlist deadline both
+        # cover the v16 checker: a baselined raw-env read is
+        # suppressed by --diff, and a grandfathered entry for it
+        # expires like any other.
+        pkg = tmp_path / 'pkg'
+        _write(tmp_path, 'pkg/serve/raw.py',
+               "import os\nA = os.environ.get('SKYTPU_RAW_ONE')\n")
+        proc = self._cli('--root', str(pkg), '--format', 'json',
+                         '--check', 'knob-discipline',
+                         '--no-allowlist')
+        assert proc.returncode == 1
+        baseline = tmp_path / 'baseline.json'
+        baseline.write_text(proc.stdout)
+        _write(tmp_path, 'pkg/jobs/raw2.py',
+               "import os\nB = os.getenv('SKYTPU_RAW_TWO')\n")
+        proc = self._cli('--root', str(pkg), '--format', 'json',
+                         '--check', 'knob-discipline',
+                         '--no-allowlist', '--diff', str(baseline))
+        assert proc.returncode == 1
+        report = json.loads(proc.stdout)
+        assert [v['path'] for v in report['violations']] == \
+            ['jobs/raw2.py']
+        assert report['suppressed_by_baseline'] == 1
+        # Expiring allowlist entries apply to the new checker too.
+        os.unlink(os.path.join(pkg, 'jobs', 'raw2.py'))
+        allow = tmp_path / 'allow.txt'
+        ident = 'knob-discipline:serve/raw.py:raw-env:SKYTPU_RAW_ONE'
+        allow.write_text(f'{ident}  # expires: 2999-01-01 ISSUE-17\n')
+        proc = self._cli('--root', str(pkg), '--check',
+                         'knob-discipline', '--allowlist', str(allow))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        allow.write_text(f'{ident}  # expires: 2020-01-01 ISSUE-17\n')
+        proc = self._cli('--root', str(pkg), '--check',
+                         'knob-discipline', '--allowlist', str(allow))
+        assert proc.returncode == 1
+        assert 'EXPIRED allowlist entry' in proc.stderr
+
     def test_changed_mode_lints_only_diffed_files(self, tmp_path):
         # Build a real git repo: main has a clean file; a feature
         # branch adds a violating one. --changed must scan ONLY the
@@ -2394,7 +2632,7 @@ class TestLivePackage:
         with open(out_path, encoding='utf-8') as f:
             report = json.load(f)
         # Schema stability (version-bump ratchet).
-        assert report['skylint_version'] == core.REPORT_VERSION == 15
+        assert report['skylint_version'] == core.REPORT_VERSION == 16
         assert set(report) == {
             'skylint_version', 'root', 'files_scanned', 'checks',
             'violations', 'total', 'allowlisted', 'new',
