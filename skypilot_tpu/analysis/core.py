@@ -58,7 +58,7 @@ PACKAGE = 'skypilot_tpu'
 # unhashable static args at jitted call sites, donated buffers read
 # after the donating call); v16: knob-discipline — the typed SKYTPU_*
 # registry (utils/knobs.py) becomes the only sanctioned env surface:
-# raw os.environ reads of SKYTPU_* vars, undeclared knob names at
+# raw environment reads of SKYTPU_* vars, undeclared knob names at
 # knobs.get_* sites, docs/KNOBS.md drift, dead declarations, and
 # propagate=True knobs missing from constants.gang_env (or spawn envs
 # built without the inherited environment) all fail the build —
