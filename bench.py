@@ -17,6 +17,7 @@ init is cached for the life of the process, so the measurement runs in a
 CHILD process and the parent retries with backoff, diagnosing (and, for
 obviously-stale bench processes, killing) chip holders between attempts.
 """
+import collections
 import dataclasses
 import json
 import os
@@ -1100,6 +1101,291 @@ def run_loadgen_bench():
     print(json.dumps(doc), flush=True)
 
 
+ELASTIC_LAST_GOOD_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    'ELASTIC_LAST_GOOD.json')
+
+
+def _diff_elastic(doc, last):
+    """Tolerance-band diff against the checked-in elastic scorecard:
+    multiplicative bands on the noisy CPU timings, hard floors on the
+    contract booleans (a broken bit-identity or a controller that
+    never scaled is a regression regardless of box speed)."""
+    regressions = []
+    base = last.get('result', last)
+
+    def band(key, factor):
+        ours, theirs = doc.get(key), base.get(key)
+        if ours is None or not theirs:
+            return
+        if ours < theirs / factor or ours > theirs * factor:
+            regressions.append(
+                f'{key}: {ours:.4g} vs last-good {theirs:.4g} '
+                f'(band x{factor})')
+
+    band('data_wait_share_before', 3.0)
+    band('data_wait_share_after', 4.0)
+    if not doc.get('data_stream_bit_identical'):
+        regressions.append(
+            'data_stream_bit_identical is False — the training stream '
+            'changed across the scale event')
+    if doc.get('data_scale_up_step') is None:
+        regressions.append(
+            'controller never scaled the data-worker pool up')
+    before = doc.get('rollout_fleet_before') or 0
+    after = doc.get('rollout_fleet_after') or 0
+    if after >= before:
+        regressions.append(
+            f'rollout fleet did not shrink under backpressure '
+            f'({before} -> {after})')
+    old_gp, cur_gp = base.get('ramp_goodput'), doc.get('ramp_goodput')
+    if old_gp is not None and cur_gp is not None and \
+            cur_gp < old_gp - 0.25:
+        regressions.append(
+            f'ramp_goodput {cur_gp} vs last-good {old_gp}')
+    return {'ok': not regressions, 'regressions': regressions}
+
+
+def run_elastic_bench():
+    """SKYTPU_BENCH_METRIC=elastic (CPU proxy, no jax for the data
+    phase): the closed-loop pool controller end to end
+    (docs/ELASTIC.md), three phases:
+
+      * data-worker scale-up — an under-provisioned data-service pool
+        (1 worker) feeds a simulated train step; the controller
+        watches the measured batch-wait share and adds workers until
+        the share re-enters the hold band. Evidence: the wait share
+        COLLAPSES after the scale event, and the consumed batch
+        stream stays bit-identical to `Source.batch_at_step` across
+        it (batches are pure functions of (spec, step));
+      * rollout scale-down — a real RolloutDispatcher's result buffer
+        is driven to saturation (leases minted, nothing collected);
+        `result_backpressure()` crosses the inverted band and the
+        controller shrinks the fleet before more doomed work is
+        minted;
+      * serve ramp — the loadgen `ramp` profile (calm → 2x QPS →
+        calm, seeded) against the 2-replica local stack: goodput must
+        hold through the ramp and the shadow serve controller's
+        decisions land in the scorecard's scale_events column.
+
+    `value` is the data phase's wait-share collapse ratio
+    (before/after — higher = the scale-up bought more). Diffs against
+    the checked-in ELASTIC_LAST_GOOD.json with tolerance bands."""
+    import shutil
+    import tempfile
+
+    run_dir = tempfile.mkdtemp(prefix='skytpu-bench-elastic-')
+    os.environ['SKYTPU_OBSERVE_DB'] = os.path.join(run_dir, 'observe.db')
+
+    from skypilot_tpu.data_service import client as ds_client
+    from skypilot_tpu.data_service import dispatcher as ds_dispatcher
+    from skypilot_tpu.data_service import elastic as ds_elastic
+    from skypilot_tpu.data_service import spec as ds_spec
+    from skypilot_tpu.data_service import worker as ds_worker
+    from skypilot_tpu.elastic import controller as elastic_controller
+    from skypilot_tpu.elastic import signals as elastic_signals
+    from skypilot_tpu.observe import journal
+    from skypilot_tpu.train.rollout import dispatcher as ro_dispatcher
+    from skypilot_tpu.train.rollout import elastic as ro_elastic
+
+    steps = int(os.environ.get('SKYTPU_BENCH_ELASTIC_STEPS', '60'))
+    delay_ms = float(os.environ.get('SKYTPU_BENCH_ELASTIC_DELAY_MS',
+                                    '25'))
+    step_ms = float(os.environ.get('SKYTPU_BENCH_ELASTIC_STEP_MS',
+                                   '10'))
+    max_workers = int(os.environ.get('SKYTPU_BENCH_ELASTIC_WORKERS',
+                                     '4'))
+    window = 8   # wait-share measurement window (steps)
+
+    # ---------------- phase 1: data-worker scale-up under input stall
+    spec = ds_spec.DatasetSpec(batch_size=8, seq_len=128,
+                               vocab_size=256, seed=0,
+                               preprocess_delay_s=delay_ms / 1000.0)
+    # Bit-identity reference WITHOUT the simulated preprocess cost:
+    # batch content is a pure function of (seed, shape, step) — the
+    # delay is load, not data — and paying it inline here would slow
+    # the consumer into hiding the very input stall being measured.
+    source = ds_spec.load_source(
+        dataclasses.replace(spec, preprocess_delay_s=0.0))
+    disp = ds_dispatcher.Dispatcher(
+        os.path.join(run_dir, 'dispatcher.db'), num_splits=4,
+        heartbeat_timeout=5.0).start()
+    workers = [ds_worker.DataWorker(disp.addr,
+                                    heartbeat_interval=0.5).start()]
+    recent = collections.deque(maxlen=window)
+
+    def wait_share():
+        if len(recent) < window:
+            return None   # not enough evidence yet -> controller holds
+        waits = sum(w for w, _ in recent)
+        totals = sum(t for _, t in recent)
+        return waits / max(totals, 1e-9)
+
+    def add_workers(target):
+        while len(workers) < target:
+            workers.append(ds_worker.DataWorker(
+                disp.addr, heartbeat_interval=0.5).start())
+
+    def drain_workers(target):
+        while len(workers) > target:
+            ds_elastic.drain_one(workers)
+
+    ctl = elastic_controller.PoolController(ds_elastic.worker_pool_spec(
+        elastic_signals.callback(wait_share),
+        scale_up=add_workers, scale_down=drain_workers,
+        min_workers=1, max_workers=max_workers,
+        band=(0.05, 0.2)))
+    # Bench cadence: every round is a fresh window, no extra damping.
+    ctl.spec.cooldown_seconds = 0.0
+    ctl.spec.clean_rounds = 1
+
+    cl = ds_client.DataServiceClient(
+        f'{disp.addr[0]}:{disp.addr[1]}', spec,
+        prefetch_depth=2, stall_budget_s=60.0).start()
+    shares = []              # (step, wait share, workers) per window
+    scale_up_step = None
+    stream_ok = True
+    try:
+        for step in range(steps):
+            t0 = time.perf_counter()
+            batch = next(cl)
+            wait = time.perf_counter() - t0
+            time.sleep(step_ms / 1000.0)   # the simulated train step
+            recent.append((wait, time.perf_counter() - t0))
+            want = source.batch_at_step(step)
+            if any((batch[k] != want[k]).any() for k in want):
+                stream_ok = False
+            before = ctl.target
+            if step % window == window - 1:
+                share = wait_share()
+                shares.append((step, share, len(workers)))
+                ctl.evaluate(time.perf_counter())
+                if ctl.target > before and scale_up_step is None:
+                    scale_up_step = step
+                    recent.clear()   # measure the AFTER epoch cleanly
+    finally:
+        cl.close()
+        for w in workers:
+            w.stop()
+        disp.stop()
+
+    pre = [s for step, s, _ in shares
+           if s is not None and (scale_up_step is None or
+                                 step <= scale_up_step)]
+    post = [s for step, s, _ in shares
+            if s is not None and scale_up_step is not None and
+            step > scale_up_step + window]
+    share_before = round(max(pre), 3) if pre else None
+    share_after = round(min(post), 3) if post else None
+
+    # ---------------- phase 2: rollout scale-down under backpressure
+    ro = ro_dispatcher.RolloutDispatcher(
+        os.path.join(run_dir, 'rollout.db'), result_cap=8,
+        max_outstanding=64)
+    fleet = ['w0', 'w1', 'w2', 'w3']
+
+    def fleet_down(target):
+        while len(fleet) > target:
+            fleet.pop()
+
+    def fleet_up(target):
+        while len(fleet) < target:
+            fleet.append(f'w{len(fleet)}')
+
+    ro._op_register({'worker_id': 'w0'})
+    granted = ro._op_lease({'worker_id': 'w0', 'max_n': 8})['leases']
+    backpressure = ro.result_backpressure()
+    ro_ctl = elastic_controller.PoolController(ro_elastic.fleet_spec(
+        ro_elastic.backpressure_signal(ro),
+        scale_up=fleet_up, scale_down=fleet_down,
+        min_workers=1, max_workers=4, initial_workers=4))
+    ro_ctl.spec.cooldown_seconds = 0.0
+    fleet_before = len(fleet)
+    now = time.time()
+    ro_ctl.evaluate(now)          # arms the shrink proposal
+    ro_ctl.evaluate(now + 0.01)   # confirming round adopts it
+    fleet_after = len(fleet)
+
+    decisions = journal.query(kind='elastic_decision', limit=200)
+
+    # ---------------- phase 3: serve goodput through the QPS ramp
+    seed = int(os.environ.get('SKYTPU_BENCH_LOADGEN_SEED', '7'))
+    report_path = os.path.join(run_dir, 'ramp-scorecard.json')
+    proc = subprocess.run(
+        [sys.executable, '-m', 'skypilot_tpu.loadgen',
+         '--seed', str(seed), '--profile', 'ramp',
+         '--local-stack', '2', '--run-dir', run_dir,
+         '--report', report_path],
+        stdout=sys.stderr, stderr=sys.stderr,
+        env={**os.environ,
+             'SKYTPU_OBSERVE_DB': os.path.join(run_dir,
+                                               'ramp-observe.db')})
+    ramp_goodput = None
+    ramp_scale_events = None
+    ramp_hash = None
+    if proc.returncode == 0:
+        with open(report_path) as f:
+            card = json.load(f)
+        by_class = (card.get('fleet') or {}).get('by_class') or {}
+        good = sum(r.get('good', 0.0) for r in by_class.values())
+        slow = sum(r.get('slow', 0.0) for r in by_class.values())
+        if good + slow:
+            ramp_goodput = round(good / (good + slow), 4)
+        ramp_scale_events = len(card.get('scale_events') or [])
+        ramp_hash = card.get('schedule_hash')
+    else:
+        print(f'[bench] elastic: ramp loadgen run failed '
+              f'rc={proc.returncode}', file=sys.stderr)
+    shutil.rmtree(run_dir, ignore_errors=True)
+
+    value = None
+    if share_before and share_after:
+        value = round(share_before / max(share_after, 1e-3), 2)
+    doc = {
+        'metric': 'elastic',
+        'value': value,
+        'unit': 'x (batch-wait share collapse across the scale-up)',
+        'steps': steps,
+        'data_wait_share_before': share_before,
+        'data_wait_share_after': share_after,
+        'data_scale_up_step': scale_up_step,
+        'data_workers_final': shares[-1][2] if shares else None,
+        'data_stream_bit_identical': stream_ok,
+        'rollout_backpressure': round(backpressure, 3),
+        'rollout_leases_granted': len(granted),
+        'rollout_fleet_before': fleet_before,
+        'rollout_fleet_after': fleet_after,
+        'ramp_goodput': ramp_goodput,
+        'ramp_scale_events': ramp_scale_events,
+        'ramp_schedule_hash': ramp_hash,
+        'decisions_journaled': len(decisions),
+    }
+    if not os.path.exists(ELASTIC_LAST_GOOD_PATH):
+        # Seed ONLY when genuinely absent (the RL_HARVEST precedent):
+        # a corrupt checked-in baseline must not be silently replaced.
+        print('[bench] no ELASTIC_LAST_GOOD.json to diff against; '
+              'seeding it from this run', file=sys.stderr)
+        with open(ELASTIC_LAST_GOOD_PATH, 'w') as f:
+            json.dump({'measured_at': time.strftime(
+                '%Y-%m-%dT%H:%M:%SZ', time.gmtime()),
+                'result': doc}, f, indent=2, sort_keys=True)
+            f.write('\n')
+    else:
+        try:
+            with open(ELASTIC_LAST_GOOD_PATH) as f:
+                last_good = json.load(f)
+            diff = _diff_elastic(doc, last_good)
+            doc['vs_last_good'] = diff
+            if not diff['ok']:
+                print(f'[bench] elastic REGRESSION vs last good: '
+                      f'{diff["regressions"]}', file=sys.stderr)
+        except (OSError, ValueError) as e:
+            print(f'[bench] ELASTIC_LAST_GOOD.json unreadable ({e}); '
+                  f'diff skipped — fix or delete the baseline',
+                  file=sys.stderr)
+    print(json.dumps(doc), flush=True)
+
+
 RL_HARVEST_LAST_GOOD_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)),
     'RL_HARVEST_LAST_GOOD.json')
@@ -1391,6 +1677,8 @@ if __name__ == '__main__':
             run_serve_mixed_bench()
         elif metric == 'train_input':
             run_train_input_bench()
+        elif metric == 'elastic':
+            run_elastic_bench()
         elif metric == 'loadgen':
             run_loadgen_bench()
         elif metric == 'rl_harvest':

@@ -63,8 +63,12 @@ PACKAGE = 'skypilot_tpu'
 # propagate=True knobs missing from constants.gang_env (or spawn envs
 # built without the inherited environment) all fail the build —
 # checkers gain a third entry point, run_package(modules, root), for
-# rules that need the package root (the generated-docs sync).
-REPORT_VERSION = 16
+# rules that need the package root (the generated-docs sync); v17: the
+# elastic pool-controller plane joins the governed surface — 'elastic'
+# ranked 4 in the layer DAG (above observe/analysis, below every pool
+# that registers with it), ElasticAction joins the enum-coverage
+# tables, and the SKYTPU_ELASTIC_* knob family lands in the registry.
+REPORT_VERSION = 17
 
 
 @dataclasses.dataclass

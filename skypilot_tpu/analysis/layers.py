@@ -52,7 +52,14 @@ LAYERS = {
     'ops': 3,
     'parallel': 3,
     'analysis': 3,
-    # 4-5 — catalog → per-cloud policy
+    # 4-5 — catalog → per-cloud policy. `elastic` (the generic pool
+    # controller) also sits at 4: it imports observe (signals/journal/
+    # metrics) and analysis (transition tables) strictly downward,
+    # while every pool it scales — serve's autoscalers, data_service's
+    # worker wiring, train/rollout's fleet wiring, loadgen's harness —
+    # imports IT downward and hands it hooks; elastic itself never
+    # imports a pool. catalog is a rank peer with no cross-imports.
+    'elastic': 4,
     'catalog': 4,
     'clouds': 5,
     # 6-9 — core abstractions (Resources → Task → Dag → Optimizer)

@@ -137,6 +137,20 @@ ROLLOUT_LEASE_TRANSITIONS: Dict[str, Set[str]] = {
     'DONE': set(),
 }
 
+# ------------------------------------------------------ elastic plane
+# ElasticAction (elastic/spec.py): the per-round decision of the pool
+# controller. The hysteresis core arms a PENDING proposal (a HOLD
+# round) before any change is adopted, so two applied scale actions
+# can never be adjacent — SCALE_UP -> SCALE_DOWN without an
+# intervening HOLD is thrash and an illegal edge (the controller
+# fails closed on it, like the guarded setters). Self-loops are legal
+# per can_transition but unreachable by construction.
+ELASTIC_ACTION_TRANSITIONS: Dict[str, Set[str]] = {
+    'HOLD': {'SCALE_UP', 'SCALE_DOWN'},
+    'SCALE_UP': {'HOLD'},
+    'SCALE_DOWN': {'HOLD'},
+}
+
 # Enum class name -> its transition table (what the state-machine
 # checker verifies coverage against).
 ENUM_TABLES: Dict[str, Dict[str, Set[str]]] = {
@@ -147,6 +161,7 @@ ENUM_TABLES: Dict[str, Dict[str, Set[str]]] = {
     'DataSplitStatus': DATA_SPLIT_TRANSITIONS,
     'RolloutWorkerStatus': ROLLOUT_WORKER_TRANSITIONS,
     'RolloutLeaseStatus': ROLLOUT_LEASE_TRANSITIONS,
+    'ElasticAction': ELASTIC_ACTION_TRANSITIONS,
 }
 
 # Functions allowed to write a status column directly (raw UPDATE SQL

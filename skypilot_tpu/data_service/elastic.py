@@ -1,0 +1,91 @@
+"""Elastic wiring for the data-service worker pool (docs/ELASTIC.md).
+
+The paper's core economic claim ("A Case for Disaggregating ML Input
+Data Processing", PAPERS.md) is that the CPU input pool should track
+what the TPUs actually need — and the signal for that is already on
+the telemetry plane: ``skytpu_train_batch_wait_seconds``, the time
+the train step loop blocks in ``next()``. This module declares the
+pool's ElasticSpec:
+
+  * signal — batch-wait BURN (seconds blocked per wall second; a
+    share in [0, 1] for one trainer) from a scraper
+    (:func:`batch_wait_burn_signal`) or any in-process probe;
+  * target — a hold band (`SKYTPU_ELASTIC_DATA_WAIT_LOW/HIGH`):
+    above it the trainer is input-stalled → add a worker; below it
+    the pool is overprovisioned → drain one. Band mode, not
+    proportional: wait share does not map linearly onto worker count;
+  * hooks — ``scale_up`` spawns a worker (a CPU Task in production,
+    a DataWorker object in the bench/tests); ``scale_down`` drains
+    one. DRAIN = :func:`drain_one`: STOP HEARTBEATING the chosen
+    worker and let the dispatcher's reassignment machinery (PR 10)
+    rebalance its splits — batches are pure functions of
+    ``(spec, step)``, so the training stream stays bit-identical
+    across the scale event.
+
+Safety is the uniform elastic contract: a dead scrape plane or a
+not-yet-measuring trainer is NO SIGNAL → hold (there is no sane
+fallback reducer for input starvation, so none is declared).
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, TypeVar
+
+from skypilot_tpu.elastic import signals
+from skypilot_tpu.elastic import spec as elastic_spec
+from skypilot_tpu.utils import knobs
+
+_Worker = TypeVar('_Worker')
+
+
+def batch_wait_burn_signal(scraper) -> signals.SignalFn:
+    """Batch-wait burn from the fleet telemetry plane (the scraper
+    must have the trainer's /metrics endpoint as a target)."""
+    return signals.scraped_burn(scraper,
+                                'skytpu_train_batch_wait_seconds')
+
+
+def worker_pool_spec(
+        signal: signals.SignalFn, *,
+        scale_up: Callable[[int], None],
+        scale_down: Callable[[int], None],
+        min_workers: int = 1,
+        max_workers: Optional[int] = None,
+        initial_workers: Optional[int] = None,
+        band: Optional[tuple] = None,
+        upscale_delay_seconds: float = 0.0,
+        downscale_delay_seconds: float = 0.0,
+) -> elastic_spec.ElasticSpec:
+    """The data-worker pool's declared elastic contract. Knobs fill
+    the band/cooldown/flap-resistance defaults; callers override for
+    tests and benches (synthetic clocks, tight cadences)."""
+    if band is None:
+        band = (knobs.get_float('SKYTPU_ELASTIC_DATA_WAIT_LOW'),
+                knobs.get_float('SKYTPU_ELASTIC_DATA_WAIT_HIGH'))
+    return elastic_spec.ElasticSpec(
+        pool='data_workers',
+        signal=signal,
+        band=band,
+        min_units=min_workers,
+        max_units=max_workers,
+        initial_units=initial_workers,
+        upscale_delay_seconds=upscale_delay_seconds,
+        downscale_delay_seconds=downscale_delay_seconds,
+        cooldown_seconds=knobs.get_float(
+            'SKYTPU_ELASTIC_COOLDOWN_SECONDS'),
+        clean_rounds=knobs.get_int('SKYTPU_ELASTIC_CLEAN_ROUNDS'),
+        stale_after=knobs.get_float('SKYTPU_ELASTIC_STALE_SECONDS'),
+        scale_up=scale_up,
+        scale_down=scale_down)
+
+
+def drain_one(workers: List[_Worker]) -> Optional[_Worker]:
+    """Drain the NEWEST worker from a live pool list (LIFO: the
+    longest-lived workers keep their warm source caches) by stopping
+    it — which stops its heartbeat, so the dispatcher's reaper marks
+    it LOST and reassigns its splits bit-identically. Returns the
+    drained worker (already stopped), or None for an empty pool."""
+    if not workers:
+        return None
+    worker = workers.pop()
+    worker.stop()
+    return worker

@@ -1,0 +1,31 @@
+"""skypilot_tpu.elastic — one closed-loop controller for every pool.
+
+Declarative elastic scaling (docs/ELASTIC.md). The repo runs five
+independently scalable pools — serve monolith replicas, disagg
+prefill, disagg decode, data-service CPU workers, the spot rollout
+fleet — and before this package each closed (or failed to close) its
+own loop. Now a pool registers ONE :class:`~spec.ElasticSpec` and the
+controller does the rest:
+
+  * :mod:`spec`       — the declarative contract: signal, target shape
+    (proportional ``target_per_unit`` or a hold band), min/max bounds,
+    up/downscale delays, clean-rounds flap resistance, cooldown,
+    declared stale fallback, scale hooks; plus the ``ElasticAction``
+    decision enum (transitions declared in analysis/state_machines.py)
+    and the CLOSED ``POOLS`` metric-label vocabulary;
+  * :mod:`controller` — the decision engine (``PoolController``) and
+    the multi-pool host loop (``ElasticController``): one hysteresis
+    core for every pool, every decision journaled
+    (``elastic_decision``) and published
+    (``skytpu_elastic_target{pool}``,
+    ``skytpu_elastic_decisions_total{pool,action}``), and the PR-9
+    safety contract enforced uniformly — no signal → hold, stale
+    signal → the DECLARED fallback, never a guess;
+  * :mod:`signals`    — reducers from the fleet telemetry plane
+    (observe/scrape.py): fleet sums, histogram shares (batch-wait
+    burn), and in-process probe wrappers.
+
+serve/autoscalers.py, the per-role disagg autoscalers, the
+data-service worker pool (data_service/elastic.py) and the rollout
+fleet (train/rollout/elastic.py) all scale through this package.
+"""

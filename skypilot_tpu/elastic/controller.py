@@ -1,0 +1,307 @@
+"""The closed-loop pool controller: one decision engine for every pool.
+
+``PoolController`` turns one ElasticSpec into decisions; an
+``ElasticController`` hosts many pools behind one loop (or behind a
+caller-driven cadence — the serve reconcile loop and the scrape-round
+callback both just call ``evaluate()``; a thread is only for pools
+with no loop of their own).
+
+The decision pipeline per round, uniform across pools:
+
+    signal ── stale? ──> declared fallback (or hold) ──┐
+       │                                               │
+       └── fresh ──> reduce (ratio or band) ──> clamp ─┴─> raw target
+                                                             │
+    hysteresis: raw must HOLD for the up/downscale delay,     │
+    a downscale needs `clean_rounds` confirming rounds        │
+    (observe/slo.py's de-escalation idiom), and applied       │
+    changes are `cooldown_seconds` apart ─────────────────────┘
+                                                             │
+    adopt ──> scale_up/scale_down hook ──> journal + metrics ─┘
+
+Safety contract (PR-9): NO signal → hold; STALE signal → the
+DECLARED fallback only (never a guess); every applied change and every
+signal-source transition is journaled as an ``elastic_decision`` event
+so a scale event is replayable from the journal alone. Decisions are
+also published as ``skytpu_elastic_target{pool}`` (post-hysteresis
+target) and ``skytpu_elastic_decisions_total{pool,action}`` (round
+outcomes — `hold` counts rounds, so liveness is visible).
+
+The hysteresis core is the serve autoscaler's (pending proposal +
+delay), extracted here so serve/autoscalers.py, the disagg per-role
+autoscalers, the data-worker pool and the rollout fleet all flap-damp
+identically; serve's existing behavior is pinned by its tests and
+preserved bit-for-bit (clean_rounds=1, cooldown=0 there).
+"""
+from __future__ import annotations
+
+import math
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.analysis import state_machines
+from skypilot_tpu.elastic import spec as spec_lib
+from skypilot_tpu.observe import journal
+from skypilot_tpu.observe import metrics as metrics_lib
+from skypilot_tpu.utils import knobs
+from skypilot_tpu.utils import vclock
+
+logger = sky_logging.init_logger(__name__)
+
+_TARGET_GAUGE = metrics_lib.gauge(
+    'skytpu_elastic_target',
+    'Post-hysteresis unit target per elastic pool (what the pool '
+    'should converge to; the pool\'s own reconcile applies it).',
+    labels={'pool': spec_lib.POOLS})
+_DECISIONS_TOTAL = metrics_lib.counter(
+    'skytpu_elastic_decisions_total',
+    'Elastic controller round outcomes per pool. scale_up/scale_down '
+    'count APPLIED target changes; hold counts evaluated rounds that '
+    'changed nothing (liveness — a silent controller reads as zero).',
+    labels={'pool': spec_lib.POOLS,
+            'action': ('scale_up', 'scale_down', 'hold')})
+
+# Signal source of one round's raw target — journaled so a replay can
+# tell a signal-driven decision from a fallback-driven one.
+_SOURCE_SIGNAL = 'signal'
+_SOURCE_FALLBACK_STALE = 'fallback_stale'
+_SOURCE_FALLBACK_NO_SIGNAL = 'fallback_no_signal'
+_SOURCE_HOLD_STALE = 'hold_stale'
+_SOURCE_HOLD_NO_SIGNAL = 'hold_no_signal'
+
+
+class PoolController:
+    """Decision engine for ONE pool. Pure in time: every entry point
+    takes ``now``, so the contract unit-tests on a synthetic clock."""
+
+    def __init__(self, spec: spec_lib.ElasticSpec):
+        spec.validate()
+        self.spec = spec
+        initial = (spec.initial_units if spec.initial_units is not None
+                   else spec.min_units)
+        self.target = self._clamp(initial)
+        # (proposed_target, since_when, confirming_rounds) while a
+        # change is pending adoption; None otherwise.
+        self.pending: Optional[Tuple[int, float, int]] = None
+        self.last_change_ts: Optional[float] = None
+        self.last_action = spec_lib.ElasticAction.HOLD
+        # Journal source transitions, not every round: a 1s cadence
+        # journaling 'hold' forever is DB bloat, but entering/leaving
+        # a fallback is exactly what an operator replays.
+        self._journaled_source = _SOURCE_SIGNAL
+
+    # ------------------------------------------------------------ raw
+
+    def _clamp(self, want: int) -> int:
+        lo = self.spec.min_units
+        hi = self.spec.max_units if self.spec.max_units is not None else max(
+            lo, want)
+        return max(lo, min(hi, want))
+
+    def _reduce(self, value: float) -> int:
+        s = self.spec
+        if s.target_per_unit is not None:
+            return self._clamp(math.ceil(value / s.target_per_unit))
+        if s.band is not None:
+            lo, hi = s.band
+            up, down = self.target + s.step, self.target - s.step
+            if s.invert:
+                up, down = down, up
+            if value > hi:
+                return self._clamp(up)
+            if value < lo:
+                return self._clamp(down)
+            return self.target
+        # No target shape declared: the signal is informational only.
+        return self.target
+
+    def _fallback(self, now: float, reason: str) -> Tuple[int, str]:
+        if self.spec.on_fallback is not None:
+            self.spec.on_fallback(reason)
+        if self.spec.fallback is not None:
+            raw = self.spec.fallback(now)
+            if raw is not None:
+                return self._clamp(int(raw)), 'fallback_' + reason
+        return self.target, 'hold_' + reason
+
+    def compute_raw(self, now: float) -> Tuple[int, str]:
+        """(raw target, signal source) for this instant — no decision
+        state is advanced (safe to probe from tests/CLI)."""
+        reading = self.spec.signal(now)
+        if reading is None:
+            return self._fallback(now, 'no_signal')
+        if (self.spec.stale_after is not None and
+                now - reading.ts > self.spec.stale_after):
+            return self._fallback(now, 'stale')
+        return self._reduce(reading.value), _SOURCE_SIGNAL
+
+    # ------------------------------------------------------- decision
+
+    def decide(self, now: float, raw: int,
+               source: str = _SOURCE_SIGNAL) -> int:
+        """Run one hysteresis round against a raw target and return
+        the (possibly updated) adopted target."""
+        action = spec_lib.ElasticAction.HOLD
+        reason = 'steady'
+        if raw == self.target:
+            self.pending = None
+        elif self.pending is None or self.pending[0] != raw:
+            self.pending = (raw, now, 0)
+            reason = 'pending'
+        else:
+            confirmed = self.pending[2] + 1
+            self.pending = (self.pending[0], self.pending[1], confirmed)
+            up = raw > self.target
+            delay = (self.spec.upscale_delay_seconds if up
+                     else self.spec.downscale_delay_seconds)
+            held = now - self.pending[1]
+            if held < delay:
+                reason = 'pending'
+            elif not up and confirmed < self.spec.clean_rounds:
+                # slo.py's de-escalation idiom: growing is urgent,
+                # shrinking waits for consecutive clean confirmation.
+                reason = 'clean_rounds'
+            elif (self.spec.cooldown_seconds > 0 and
+                  self.last_change_ts is not None and
+                  now - self.last_change_ts <
+                  self.spec.cooldown_seconds):
+                reason = 'cooldown'
+            else:
+                action = (spec_lib.ElasticAction.SCALE_UP if up
+                          else spec_lib.ElasticAction.SCALE_DOWN)
+                if self._adopt(now, raw, held, action):
+                    reason = source
+                else:
+                    action = spec_lib.ElasticAction.HOLD
+                    reason = 'refused_edge'
+        self._publish(now, action, raw, reason, source)
+        return self.target
+
+    def _adopt(self, now: float, raw: int, held: float,
+               action: spec_lib.ElasticAction) -> bool:
+        if not state_machines.can_transition(
+                state_machines.ELASTIC_ACTION_TRANSITIONS,
+                self.last_action.name, action.name):
+            # Fail closed like the guarded setters: an illegal edge
+            # (scale-to-scale without a hold round) is a controller
+            # bug; refusing it keeps the pool where it is.
+            logger.error(
+                f'elastic[{self.spec.pool}]: refusing illegal decision '
+                f'edge {self.last_action.name} -> {action.name}')
+            return False
+        old = self.target
+        logger.info(f'elastic[{self.spec.pool}]: {old} -> {raw} units '
+                    f'(held {held:.0f}s).')
+        self.target = raw
+        self.pending = None
+        self.last_change_ts = now
+        journal.record_event(
+            'elastic_decision', entity=f'elastic/{self.spec.pool}',
+            reason=action.value,
+            data={'pool': self.spec.pool, 'old': old, 'new': raw,
+                  'held_seconds': round(held, 3)})
+        hook = (self.spec.scale_up
+                if action is spec_lib.ElasticAction.SCALE_UP
+                else self.spec.scale_down)
+        if hook is not None:
+            try:
+                hook(raw)
+            except Exception:  # pylint: disable=broad-except
+                # A hook failure must not kill the loop — the target
+                # stands, the next reconcile retries convergence.
+                logger.warning(
+                    f'elastic[{self.spec.pool}]: scale hook failed:',
+                    exc_info=True)
+        return True
+
+    def _publish(self, now: float, action: spec_lib.ElasticAction,
+                 raw: int, reason: str, source: str) -> None:
+        del now  # uniform signature; journal stamps its own clock.
+        self.last_action = action
+        _TARGET_GAUGE.set(float(self.target), pool=self.spec.pool)
+        _DECISIONS_TOTAL.inc(pool=self.spec.pool, action=action.value)
+        if source != self._journaled_source:
+            # Entering/leaving a fallback or no-signal hold is the
+            # safety contract in action — journal the edge once, keyed
+            # by the SOURCE (the decide-level reason rides in data).
+            journal.record_event(
+                'elastic_decision',
+                entity=f'elastic/{self.spec.pool}', reason=source,
+                data={'pool': self.spec.pool, 'target': self.target,
+                      'raw': raw, 'reason': reason, 'source': source,
+                      'was': self._journaled_source})
+            self._journaled_source = source
+
+    def evaluate(self, now: Optional[float] = None) -> int:
+        """One full round: reduce the signal, run hysteresis, publish."""
+        now = vclock.now() if now is None else now
+        raw, source = self.compute_raw(now)
+        return self.decide(now, raw, source)
+
+
+class ElasticController:
+    """Hosts every registered pool behind ONE loop.
+
+    ``run_once()`` is the caller-driven cadence (the loadgen harness's
+    settle, a scrape-round callback, tests); ``start()`` spawns the
+    periodic daemon thread for deployments where no existing loop owns
+    the cadence. One round failure is contained per pool — fleet
+    scaling must never die of one pool's bad reduction.
+    """
+
+    def __init__(self, interval: Optional[float] = None):
+        self.interval = (knobs.get_float('SKYTPU_ELASTIC_INTERVAL')
+                         if interval is None else interval)
+        self._pools: Dict[str, PoolController] = {}
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def register(self, spec: spec_lib.ElasticSpec) -> PoolController:
+        if spec.pool in self._pools:
+            raise ValueError(
+                f'elastic pool {spec.pool!r} is already registered')
+        ctl = PoolController(spec)
+        self._pools[spec.pool] = ctl
+        return ctl
+
+    def pool(self, name: str) -> PoolController:
+        return self._pools[name]
+
+    def pools(self) -> List[str]:
+        return sorted(self._pools)
+
+    def targets(self) -> Dict[str, int]:
+        return {name: ctl.target
+                for name, ctl in self._pools.items()}
+
+    def run_once(self, now: Optional[float] = None) -> Dict[str, int]:
+        now = vclock.now() if now is None else now
+        out: Dict[str, int] = {}
+        for name, ctl in sorted(self._pools.items()):
+            try:
+                out[name] = ctl.evaluate(now)
+            except Exception:  # pylint: disable=broad-except
+                logger.warning(
+                    f'elastic[{name}]: evaluation round failed:',
+                    exc_info=True)
+                out[name] = ctl.target
+        return out
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name='elastic-controller')
+        self._thread.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self.run_once()
+            self._stop.wait(self.interval)

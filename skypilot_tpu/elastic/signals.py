@@ -1,0 +1,102 @@
+"""Signal reducers: fleet telemetry (PR-9 scrape plane) → Readings.
+
+An ElasticSpec's ``signal`` is just a callable; these helpers build
+the common ones from a ``Scraper`` so pools declare "metric name +
+reducer" instead of re-implementing exposition plumbing. Freshness is
+taken from the scraper's own per-target success stamps, so a dead
+scrape plane surfaces as a STALE/absent Reading — which the controller
+turns into the declared fallback or a hold, never a guess.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from skypilot_tpu.elastic import spec as spec_lib
+from skypilot_tpu.observe import promtext
+from skypilot_tpu.observe import scrape as scrape_lib
+
+SignalFn = Callable[[float], Optional[spec_lib.Reading]]
+
+
+def _fresh_ts(scraper: 'scrape_lib.Scraper', now: float
+              ) -> Optional[float]:
+    """Timestamp of the freshest NON-stale target, or None when the
+    whole plane is stale/empty (→ no signal)."""
+    ages = [doc['last_success_age'] for doc in scraper.status()
+            if doc.get('last_success_age') is not None and
+            not doc.get('stale')]
+    if not ages:
+        return None
+    return now - min(ages)
+
+
+def scraped_sum(scraper: 'scrape_lib.Scraper', family: str) -> SignalFn:
+    """Sum of one counter/gauge family over the fresh fleet (merged by
+    ``fleet_families()`` — counters/gauges sum across replicas)."""
+
+    def signal(now: float) -> Optional[spec_lib.Reading]:
+        ts = _fresh_ts(scraper, now)
+        if ts is None:
+            return None
+        fam = scraper.fleet_families().get(family)
+        if fam is None:
+            return None
+        value = float(sum(s.value for s in fam.samples))
+        return spec_lib.Reading(value=value, ts=ts)
+
+    return signal
+
+
+def scraped_burn(scraper: 'scrape_lib.Scraper', family: str) -> SignalFn:
+    """Burn rate of a histogram's ``_sum`` (or a counter) over the
+    fresh fleet: d(total)/dt between evaluations. For
+    ``skytpu_train_batch_wait_seconds`` this is seconds blocked per
+    wall-clock second — the batch-wait share driving the data-worker
+    pool. The first evaluation (no baseline yet) reports no signal, so
+    the controller HOLDS instead of reacting to an all-time total."""
+    state = {'total': None, 'ts': None}
+
+    def signal(now: float) -> Optional[spec_lib.Reading]:
+        ts = _fresh_ts(scraper, now)
+        if ts is None:
+            return None
+        fam = scraper.fleet_families().get(family)
+        total = _hist_sum(fam)
+        if total is None:
+            total = (float(sum(s.value for s in fam.samples))
+                     if fam is not None else None)
+        if total is None:
+            return None
+        prev_total, prev_ts = state['total'], state['ts']
+        state['total'], state['ts'] = total, ts
+        if prev_total is None or ts <= prev_ts:
+            return None
+        burn = max(0.0, total - prev_total) / (ts - prev_ts)
+        return spec_lib.Reading(value=burn, ts=ts)
+
+    return signal
+
+
+def _hist_sum(fam: Optional[promtext.Family]) -> Optional[float]:
+    if fam is None:
+        return None
+    total = None
+    for sample in fam.samples:
+        if sample.name.endswith('_sum'):
+            total = (total or 0.0) + sample.value
+    return total
+
+
+def callback(fn: Callable[[], Optional[float]]) -> SignalFn:
+    """Wrap an always-fresh in-process probe (a dispatcher's own
+    result-buffer stats, an autoscaler's QPS window) — the Reading is
+    stamped with the evaluation instant, so it never goes stale; the
+    probe returning None means no signal."""
+
+    def signal(now: float) -> Optional[spec_lib.Reading]:
+        value = fn()
+        if value is None:
+            return None
+        return spec_lib.Reading(value=float(value), ts=now)
+
+    return signal

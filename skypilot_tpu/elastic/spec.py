@@ -1,0 +1,142 @@
+"""ElasticSpec: the declarative contract a pool signs to be scaled.
+
+Every independently scalable pool in the repo (serve monolith
+replicas, disagg prefill, disagg decode, data-service workers, the
+rollout fleet) registers ONE of these with the elastic controller
+(controller.py) instead of hand-wiring its own scaling loop. The spec
+declares:
+
+  * a **signal** — a callable reducing the fleet telemetry plane
+    (observe/scrape.py families, an autoscaler's QPS window, a
+    dispatcher's result-buffer stats) to one fresh ``Reading``;
+  * a **target** — either proportional (``target_per_unit``: raw
+    target = ceil(value / target_per_unit), the serve QPS/queue-depth
+    shape) or a **band** (hold while lo <= value <= hi, step the pool
+    by ``step`` outside it — for signals like batch-wait share that
+    do not map linearly onto a unit count);
+  * **bounds** (min/max units), **hysteresis** (a proposed change must
+    hold for the up/downscale delay), **flap resistance** (a
+    scale-down additionally needs ``clean_rounds`` consecutive
+    confirming rounds, the observe/slo.py de-escalation idiom) and a
+    **cooldown** between applied changes;
+  * the **safety contract** — no signal ever → hold; stale signal →
+    the DECLARED ``fallback`` reducer (serve: the QPS window) or hold
+    when none is declared. Never invent a target from missing data;
+  * **hooks** — ``scale_up`` / ``scale_down`` callables the controller
+    invokes with the adopted target (serve: reconcile picks the target
+    up itself; data service: spawn/drain a worker; rollout: resize the
+    fleet before minting leases the staleness window would drop).
+
+The decision function stays pure — (signal, now) → target — so every
+pool's scaling logic unit-tests with synthetic clocks, no clusters.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Callable, Optional, Tuple
+
+
+class ElasticAction(enum.Enum):
+    """One controller decision per evaluation round.
+
+    Declared in analysis/state_machines.py (ELASTIC_ACTION_TRANSITIONS)
+    so the enum-coverage lint forces new actions to be wired: between
+    any two applied scale actions there is always at least one HOLD
+    round (the pending/hysteresis arm), so SCALE_UP -> SCALE_DOWN is
+    an illegal edge — thrash without an intervening hold is a bug.
+    """
+    SCALE_UP = 'scale_up'
+    SCALE_DOWN = 'scale_down'
+    HOLD = 'hold'
+
+
+# Closed metric-label vocabulary: one name per scalable pool. Label
+# sets must stay declared and finite (the breaker-state precedent), so
+# a new pool means a new entry HERE, not an unbounded label.
+POOLS: Tuple[str, ...] = (
+    'serve',          # monolith replica fleet (QPS / engine queue depth)
+    'prefill',        # disagg prefill pool (per-role queue depth)
+    'decode',         # disagg decode pool (per-role queue depth)
+    'data_workers',   # data-service CPU workers (batch-wait burn)
+    'rollout',        # spot rollout fleet (result-buffer backpressure)
+)
+
+
+@dataclasses.dataclass(frozen=True)
+class Reading:
+    """One reduced signal observation: ``value`` as of ``ts``.
+
+    ``ts`` is the observation time of the UNDERLYING data (a scrape
+    round's success stamp, a saturation snapshot's freshness stamp),
+    not the reduction time — staleness is judged against it.
+    """
+    value: float
+    ts: float
+
+
+@dataclasses.dataclass
+class ElasticSpec:
+    """Everything the controller needs to scale one pool. See module
+    docstring for field semantics."""
+    pool: str
+    # now -> freshest Reading, or None when no signal was EVER
+    # observed (an empty scrape, a never-started scraper).
+    signal: Callable[[float], Optional[Reading]]
+    # Exactly one target shape: proportional or band.
+    target_per_unit: Optional[float] = None
+    band: Optional[Tuple[float, float]] = None
+    step: int = 1
+    # High signal normally means GROW (queue building → add units);
+    # invert for pools where high signal means the CONSUMER is behind
+    # (rollout: a full result buffer → shrink the producer fleet).
+    invert: bool = False
+    min_units: int = 1
+    max_units: Optional[int] = None
+    initial_units: Optional[int] = None
+    upscale_delay_seconds: float = 0.0
+    downscale_delay_seconds: float = 0.0
+    cooldown_seconds: float = 0.0
+    clean_rounds: int = 1
+    # A Reading older than this is STALE → fallback path. None = the
+    # signal never goes stale (e.g. serve QPS, computed on demand).
+    stale_after: Optional[float] = None
+    # Declared stale/no-signal fallback reducer: now -> raw target
+    # (None = hold). Serve declares its QPS window here.
+    fallback: Optional[Callable[[float], Optional[int]]] = None
+    # Observability bridge for pool-local fallback accounting (serve
+    # keeps its skytpu_serve_autoscaler_fallback_total contract alive
+    # through this) — called with 'stale' or 'no_signal'.
+    on_fallback: Optional[Callable[[str], None]] = None
+    scale_up: Optional[Callable[[int], None]] = None
+    scale_down: Optional[Callable[[int], None]] = None
+
+    def validate(self) -> None:
+        if self.pool not in POOLS:
+            raise ValueError(
+                f'unknown elastic pool {self.pool!r}: the metric label '
+                f'set is closed — declare it in elastic/spec.py POOLS '
+                f'(known: {", ".join(POOLS)})')
+        if self.target_per_unit is not None and self.band is not None:
+            raise ValueError(
+                f'pool {self.pool!r} declares BOTH target_per_unit and '
+                f'band — pick one target shape')
+        if self.band is not None and self.band[0] > self.band[1]:
+            raise ValueError(
+                f'pool {self.pool!r} band low {self.band[0]} > high '
+                f'{self.band[1]}')
+        if self.min_units < 0:
+            raise ValueError(
+                f'pool {self.pool!r} min_units {self.min_units} < 0')
+        if (self.max_units is not None and
+                self.max_units < self.min_units):
+            raise ValueError(
+                f'pool {self.pool!r} max_units {self.max_units} < '
+                f'min_units {self.min_units}')
+        if self.step < 1:
+            raise ValueError(
+                f'pool {self.pool!r} band step {self.step} < 1')
+        if self.clean_rounds < 1:
+            raise ValueError(
+                f'pool {self.pool!r} clean_rounds {self.clean_rounds} '
+                f'< 1 (the confirming round itself counts)')

@@ -20,7 +20,7 @@ import argparse
 import os
 import time
 import traceback
-from typing import Optional
+from typing import Dict, Optional
 
 from skypilot_tpu import exceptions
 from skypilot_tpu import global_state
@@ -73,6 +73,11 @@ class JobsController:
             self.tasks = [task_lib.Task.from_yaml_config(cfg)]
         base = _generate_cluster_name(job_id, record['name'] or 'job')
         self._base_cluster_name = record['cluster_name'] or base
+        # Cross-stage exports: <STAGE_NAME>_HEAD_IP per launched stage,
+        # injected into every LATER stage's envs (run()). Replaces the
+        # hand-exported `${DATA_PLANE_HEAD_IP:?...}` dance in chained
+        # DAGs — the controller already knows every stage's head node.
+        self._stage_exports: Dict[str, str] = {}
         # task/cluster_name/strategy are per-stage state, owned by run().
 
     def _stage_cluster_name(self, index: int) -> str:
@@ -89,6 +94,37 @@ class JobsController:
             state.set_current_task(self.job_id,
                                    state.get_job(self.job_id)['current_task'],
                                    self.cluster_name)
+
+    def _record_stage_export(self) -> None:
+        """Publish this stage's head-node IP for later pipeline stages.
+
+        The data-service example's train stage needs the data plane's
+        dispatcher address; the gang env already carries the head IP
+        WITHIN a gang (skylet/constants.py gang_env), and this is the
+        cross-STAGE analog: after a stage launches (or recovers onto a
+        new slice), `<STAGE_NAME>_HEAD_IP` becomes visible to every
+        later stage's envs. Internal IP preferred — stages of one
+        pipeline share a network; the external IP is the fallback."""
+        if len(self.tasks) <= 1 or not self.task.name:
+            return
+        handle = self.strategy.handle
+        if handle is None:
+            return
+        try:
+            head = handle.get_cluster_info().get_head_instance()
+        except Exception as e:  # pylint: disable=broad-except
+            logger.debug(f'[job {self.job_id}] head-IP export skipped: {e}')
+            return
+        if head is None:
+            return
+        ip = head.internal_ip or head.external_ip
+        if not ip:
+            return
+        key = ''.join(c if c.isalnum() else '_'
+                      for c in self.task.name.upper()) + '_HEAD_IP'
+        self._stage_exports[key] = ip
+        logger.info(f'[job {self.job_id}] exporting {key}={ip} to later '
+                    f'pipeline stages.')
 
     def _cluster_alive(self) -> bool:
         """Cloud-truth liveness of the job's slice (preemption detector)."""
@@ -225,6 +261,11 @@ class JobsController:
             if resume_from is not None and index < resume_from:
                 continue
             self.task = task
+            if self._stage_exports:
+                # Earlier stages' head IPs; a user-set env wins.
+                task.update_envs({k: v
+                                  for k, v in self._stage_exports.items()
+                                  if k not in task.envs})
             reattach = (resume_from == index)
             if reattach and self.record.get('cluster_name'):
                 # Keep the in-flight stage's cluster (pool jobs: the
@@ -287,6 +328,7 @@ class JobsController:
                                        'cluster': self.cluster_name}):
                     cluster_job_id = self.strategy.launch()
                 self._sync_cluster_name()
+                self._record_stage_export()
             except recovery_strategy.JobCancelledDuringRecovery:
                 # Cancelled while queued for a pool worker.
                 self._do_cancel(None)
@@ -333,6 +375,8 @@ class JobsController:
                     return False
                 state.set_recovered(job_id, cluster_job_id)
                 self._sync_cluster_name()
+                # Recovery may land on a new slice: re-export the IP.
+                self._record_stage_export()
                 continue
 
             job_status = self._job_status(cluster_job_id)

@@ -146,6 +146,7 @@ async def _run_local(args, profile, schedule) -> Dict[str, Any]:
             'fleet_metrics_text': await stack.fleet_metrics(),
             'fleet_status': await stack.fleet_status(),
             'slo_events': stack.slo_events(),
+            'scale_events': stack.scale_events(),
             'stack': {'mode': 'local', 'replicas': args.local_stack,
                       'model': args.model, 'policy': args.policy,
                       'disagg': args.disagg},
@@ -255,6 +256,7 @@ def main(argv=None) -> int:
         fleet_metrics_text=evidence.get('fleet_metrics_text', ''),
         fleet_status=evidence.get('fleet_status'),
         slo_events=evidence.get('slo_events'),
+        scale_events=evidence.get('scale_events'),
         routing=routing, stack=evidence.get('stack'))
     if args.report:
         report_lib.write_scorecard(doc, args.report)

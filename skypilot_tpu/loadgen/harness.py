@@ -91,6 +91,7 @@ class LocalStack:
         self._slo_engine = None
         self._scraper = None
         self._lb = None
+        self._elastic = None
 
     # ------------------------------------------------------------ wiring
     def _engine_cmd(self, port: int,
@@ -221,11 +222,43 @@ class LocalStack:
 
         lb = self._lb
 
+        # SHADOW elastic controller (docs/ELASTIC.md): the stack's
+        # replica set is fixed, but a PoolController per pool watches
+        # the same scraped queue-depth signal a live deployment would
+        # scale on and journals every decision — the scorecard's
+        # scale_events column, replayable against the schedule hash.
+        # No hooks: targets are published, replicas never move.
+        from skypilot_tpu.elastic import controller as elastic_ctl
+        from skypilot_tpu.elastic import signals as elastic_signals
+        from skypilot_tpu.elastic import spec as elastic_spec
+        self._elastic = elastic_ctl.ElasticController(interval=1.0)
+
+        def _queue_probe(members):
+            def probe():
+                snap = self._scraper.saturation_snapshot()
+                depths = [sat.queue_depth for u, sat in snap.items()
+                          if u in members]
+                if not depths:
+                    return None
+                return float(sum(depths))
+            return elastic_signals.callback(probe)
+
+        shadow_pools = ([('prefill', pool_urls['prefill']),
+                         ('decode', pool_urls['decode'])]
+                        if self.disagg else [('serve', urls)])
+        for pool_name, members in shadow_pools:
+            self._elastic.register(elastic_spec.ElasticSpec(
+                pool=pool_name, signal=_queue_probe(set(members)),
+                target_per_unit=4.0, min_units=1,
+                max_units=2 * max(1, len(members)),
+                initial_units=len(members)))
+
         def on_round(s):
             snap = s.saturation_snapshot()
             lb.set_replica_saturation(
                 {u: sat.queue_depth for u, sat in snap.items()})
             self._slo_engine.evaluate()
+            self._elastic.run_once()
 
         self._scrape_loop = scrape.ScrapeLoop(
             self._scraper, interval=self.scrape_interval,
@@ -300,6 +333,14 @@ class LocalStack:
                                entity_scope='loadgen')
         return [e for e in events
                 if str(e.get('kind', '')).startswith('slo_')]
+
+    def scale_events(self) -> List[Dict[str, Any]]:
+        """This run's ``elastic_decision`` journal events — the
+        scorecard's scale-events column: every controller reaction to
+        the offered ramp, replayable against the schedule hash."""
+        from skypilot_tpu.observe import journal
+        return journal.query(kind='elastic_decision',
+                             since=self.started_unix - 1.0)
 
 
 # ------------------------------------------------------------- routing

@@ -115,6 +115,7 @@ def build_scorecard(
         fleet_metrics_text: str = '',
         fleet_status: Optional[Dict[str, Any]] = None,
         slo_events: Optional[List[Dict[str, Any]]] = None,
+        scale_events: Optional[List[Dict[str, Any]]] = None,
         routing: Optional[Dict[str, Any]] = None,
         stack: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     """Merge one run's evidence planes into the scorecard doc."""
@@ -149,6 +150,12 @@ def build_scorecard(
         }
     if slo_events is not None:
         doc['slo_events'] = slo_events
+    if scale_events is not None:
+        # The elastic controller's journaled reactions to this run's
+        # offered load (elastic_decision events): with the schedule
+        # hash pinning the arrivals, a scale event is replayable —
+        # same seed, same profile, same signal, same decision.
+        doc['scale_events'] = scale_events
     if routing is not None:
         doc['routing'] = routing
     return doc

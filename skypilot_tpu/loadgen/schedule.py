@@ -171,6 +171,20 @@ PROFILES['prefill_calm'] = dataclasses.replace(
     spike_len_frac=0.0, spike_factor=1.0, spike_class='',
     spike_class_weight=0.0)
 
+# The elastic-controller proof profile (docs/ELASTIC.md): calm → a
+# sustained 2x-QPS ramp window → calm, with the diurnal swing removed
+# so the ONLY intensity change is the ramp itself. Same class mix as
+# smoke; the window is long enough (40% of the run) for a controller
+# to ride out its hysteresis and react inside it, and the arrivals are
+# the same seeded draw as every profile — scale decisions replay
+# against a schedule-hash-stable offered load. Defined as a
+# dataclasses.replace variant (the prefill_calm precedent) so existing
+# profiles' schedule hashes cannot drift.
+PROFILES['ramp'] = dataclasses.replace(
+    PROFILES['smoke'], name='ramp', requests=48, duration_s=8.0,
+    diurnal_amplitude=0.0, spike_start_frac=0.3, spike_len_frac=0.4,
+    spike_factor=2.0)
+
 
 @dataclasses.dataclass(frozen=True)
 class RequestSpec:

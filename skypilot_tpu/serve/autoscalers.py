@@ -6,6 +6,14 @@ Reference analog: sky/serve/autoscalers.py (`Autoscaler:116`,
 decision function is pure — (signal, now) → target — so it unit-tests
 with synthetic clocks, no clusters.
 
+Since the elastic plane landed these classes are ADAPTERS: each wraps
+one ``elastic.PoolController`` whose ElasticSpec declares the serve
+signal, bounds and delays, so serve flap-damps with the exact same
+decision core as the data-worker pool and the rollout fleet
+(docs/ELASTIC.md). The serve-visible behavior — the two signals, the
+QPS fallback, the pending/delay hysteresis — is pinned by the
+existing tests and unchanged.
+
 Two signals (ROADMAP item 3: scale on engine-reported saturation, not
 LB-side probes):
 
@@ -18,7 +26,8 @@ LB-side probes):
     divided by ``target_queue_depth_per_replica``. Queue depth is the
     engine's own admission backlog — it already prices request cost
     in. When the scraped snapshot goes STALE (scraper dead, all
-    replicas unreachable) the policy FALLS BACK to the QPS signal
+    replicas unreachable) the policy FALLS BACK to the QPS signal —
+    the DECLARED stale-signal fallback of the elastic contract —
     rather than flying blind on a dead replica's last word
     (``skytpu_serve_autoscaler_fallback_total`` counts it).
 
@@ -31,11 +40,13 @@ from __future__ import annotations
 
 import math
 import threading
-from typing import Deque, Mapping, Optional
+from typing import Deque, Mapping, Optional, Tuple
 
 from collections import deque
 
 from skypilot_tpu import sky_logging
+from skypilot_tpu.elastic import controller as elastic_controller
+from skypilot_tpu.elastic import spec as elastic_spec
 from skypilot_tpu.observe import metrics as metrics_lib
 from skypilot_tpu.serve import service_spec as spec_lib
 from skypilot_tpu.utils import knobs
@@ -90,13 +101,18 @@ class Autoscaler:
         raise NotImplementedError
 
     @classmethod
-    def make(cls, policy: spec_lib.ReplicaPolicy) -> 'Autoscaler':
+    def make(cls, policy: spec_lib.ReplicaPolicy,
+             pool: str = 'serve') -> 'Autoscaler':
+        """``pool`` is the elastic pool label the decision publishes
+        under (the disagg controller passes its role — 'prefill' /
+        'decode'; the label set is closed in elastic/spec.py)."""
         if not policy.autoscaling_enabled:
             return FixedAutoscaler(policy)
         name = ('saturation'
                 if policy.target_queue_depth_per_replica is not None
                 else 'request_rate')
-        return registry.AUTOSCALER_REGISTRY.type_from_str(name)(policy)
+        return registry.AUTOSCALER_REGISTRY.type_from_str(name)(
+            policy, pool=pool)
 
 
 class FixedAutoscaler(Autoscaler):
@@ -111,7 +127,8 @@ class FixedAutoscaler(Autoscaler):
 class RequestRateAutoscaler(Autoscaler):
     """target = ceil(qps / target_qps_per_replica), with hysteresis."""
 
-    def __init__(self, policy: spec_lib.ReplicaPolicy):
+    def __init__(self, policy: spec_lib.ReplicaPolicy,
+                 pool: str = 'serve'):
         super().__init__(policy)
         assert policy.autoscaling_enabled
         self._timestamps: Deque[float] = deque()
@@ -121,9 +138,45 @@ class RequestRateAutoscaler(Autoscaler):
         # deque, and an unsynchronized check-then-popleft pair can
         # IndexError or pop an in-window sample.
         self._ts_lock = threading.Lock()
-        self._current_target = policy.min_replicas
-        # (proposed_target, since_when) while a change is pending.
-        self._pending: Optional[tuple] = None
+        self._ctl = elastic_controller.PoolController(
+            self._elastic_spec(pool))
+
+    def _elastic_spec(self, pool: str) -> elastic_spec.ElasticSpec:
+        """The declared contract this policy scales under. Subclasses
+        override to swap the signal; the hysteresis shape (delay-gated,
+        clean_rounds=1, no cooldown) is serve's pinned behavior."""
+        return elastic_spec.ElasticSpec(
+            pool=pool,
+            signal=self._qps_reading,
+            # None objective (a saturation-only policy reaching the
+            # QPS shape) reduces to HOLD — never invent a target from
+            # an undeclared objective.
+            target_per_unit=self.policy.target_qps_per_replica,
+            min_units=self.policy.min_replicas,
+            max_units=(self.policy.max_replicas or
+                       self.policy.min_replicas),
+            upscale_delay_seconds=self.policy.upscale_delay_seconds,
+            downscale_delay_seconds=self.policy.downscale_delay_seconds)
+
+    # Test-pinned decision state lives on the wrapped PoolController;
+    # these views keep the (old, documented) poke surface stable.
+    @property
+    def _current_target(self) -> int:
+        return self._ctl.target
+
+    @_current_target.setter
+    def _current_target(self, value: int) -> None:
+        self._ctl.target = value
+
+    @property
+    def _pending(self) -> Optional[Tuple[int, float]]:
+        p = self._ctl.pending
+        return None if p is None else (p[0], p[1])
+
+    @_pending.setter
+    def _pending(self, value: Optional[Tuple[int, float]]) -> None:
+        self._ctl.pending = (None if value is None
+                             else (value[0], value[1], 0))
 
     def record_request(self, now: Optional[float] = None) -> None:
         now = vclock.now() if now is None else now
@@ -146,6 +199,13 @@ class RequestRateAutoscaler(Autoscaler):
             self._trim(now)
             return len(self._timestamps) / QPS_WINDOW_SECONDS
 
+    def _qps_reading(self, now: float) -> elastic_spec.Reading:
+        """The request-rate signal: always fresh (computed on demand
+        from the LB-fed window), so it never takes the stale path."""
+        qps = self._qps(now)
+        _QPS_GAUGE.set(qps)
+        return elastic_spec.Reading(value=qps, ts=now)
+
     def _clamp(self, want: int) -> int:
         lo = self.policy.min_replicas
         hi = self.policy.max_replicas or lo
@@ -163,29 +223,14 @@ class RequestRateAutoscaler(Autoscaler):
             math.ceil(qps / self.policy.target_qps_per_replica))
 
     def _raw_target(self, now: float) -> int:
-        return self._qps_target(now)
+        return self._ctl.compute_raw(now)[0]
 
     def target_replicas(self, now: Optional[float] = None) -> int:
         now = vclock.now() if now is None else now
-        raw = self._raw_target(now)
-        if raw == self._current_target:
-            self._pending = None
-            _TARGET_GAUGE.set(self._current_target)
-            return self._current_target
-        if self._pending is None or self._pending[0] != raw:
-            self._pending = (raw, now)
-            _TARGET_GAUGE.set(self._current_target)
-            return self._current_target
-        delay = (self.policy.upscale_delay_seconds
-                 if raw > self._current_target else
-                 self.policy.downscale_delay_seconds)
-        if now - self._pending[1] >= delay:
-            logger.info(f'Autoscaler: {self._current_target} → {raw} '
-                        f'replicas (held {now - self._pending[1]:.0f}s).')
-            self._current_target = raw
-            self._pending = None
-        _TARGET_GAUGE.set(self._current_target)
-        return self._current_target
+        raw, source = self._ctl.compute_raw(now)
+        target = self._ctl.decide(now, raw, source)
+        _TARGET_GAUGE.set(target)
+        return target
 
 
 @registry.AUTOSCALER_REGISTRY.register(name='saturation')
@@ -193,15 +238,44 @@ class SaturationAutoscaler(RequestRateAutoscaler):
     """target = ceil(fleet queue depth / target_queue_depth_per_replica)
     from ENGINE-REPORTED saturation, falling back to the QPS signal
     when the scraped snapshot is stale. Shares the request-rate
-    hysteresis (the raw signal differs; the flap-damping should not)."""
+    hysteresis (the raw signal differs; the flap-damping should not).
+    In elastic terms: the saturation Reading is the signal, the QPS
+    window is the DECLARED stale/no-signal fallback."""
 
-    def __init__(self, policy: spec_lib.ReplicaPolicy):
-        super().__init__(policy)
+    def __init__(self, policy: spec_lib.ReplicaPolicy,
+                 pool: str = 'serve'):
         assert policy.target_queue_depth_per_replica is not None
         self._fleet_queue_depth: Optional[float] = None
         self._saturation_ts: Optional[float] = None
         self.stale_after = knobs.get_float(
             'SKYTPU_SATURATION_STALE_SECONDS')
+        super().__init__(policy, pool=pool)
+
+    def _elastic_spec(self, pool: str) -> elastic_spec.ElasticSpec:
+        base = super()._elastic_spec(pool)
+        per_replica = self.policy.target_queue_depth_per_replica
+        return elastic_spec.ElasticSpec(
+            pool=pool,
+            signal=self._saturation_reading,
+            target_per_unit=per_replica,
+            min_units=base.min_units,
+            max_units=base.max_units,
+            upscale_delay_seconds=base.upscale_delay_seconds,
+            downscale_delay_seconds=base.downscale_delay_seconds,
+            stale_after=self.stale_after,
+            fallback=self._qps_target,
+            on_fallback=self._count_fallback)
+
+    def _saturation_reading(self, now: float
+                            ) -> Optional[elastic_spec.Reading]:
+        del now  # freshness is the snapshot's own stamp.
+        if self._saturation_ts is None:
+            return None
+        return elastic_spec.Reading(value=self._fleet_queue_depth,
+                                    ts=self._saturation_ts)
+
+    def _count_fallback(self, reason: str) -> None:
+        _FALLBACK_TOTAL.inc(reason=reason)
 
     def observe_saturation(self, queue_depths: Mapping[str, float],
                            now: Optional[float] = None) -> None:
@@ -219,16 +293,3 @@ class SaturationAutoscaler(RequestRateAutoscaler):
         self._fleet_queue_depth = total
         self._saturation_ts = now
         _QUEUE_GAUGE.set(total)
-
-    def _raw_target(self, now: float) -> int:
-        if self._saturation_ts is None:
-            _FALLBACK_TOTAL.inc(reason='no_signal')
-            return self._qps_target(now)
-        if now - self._saturation_ts > self.stale_after:
-            _FALLBACK_TOTAL.inc(reason='stale')
-            return self._qps_target(now)
-        per_replica = self.policy.target_queue_depth_per_replica
-        want = math.ceil(self._fleet_queue_depth / per_replica)
-        # Queue depth can legitimately read 0 under light load; the
-        # floor is min_replicas via the clamp, same as QPS.
-        return self._clamp(want)

@@ -116,9 +116,11 @@ class ServiceController:
             # One autoscaler per pool — independent scaling is the
             # point of disaggregation: the prefill pool grows off its
             # queue saturation while the decode pool holds TPOT.
+            # The role doubles as the elastic pool label, so each
+            # pool's decisions land under skytpu_elastic_target{pool}.
             self.autoscalers = {
                 role: autoscaler_lib.Autoscaler.make(
-                    self.spec.disagg.role_policy(role))
+                    self.spec.disagg.role_policy(role), pool=role)
                 for role in ('prefill', 'decode')}
             # The LB's request-rate signal (QPS fallback) goes to the
             # decode pool's autoscaler: every request decodes; only

@@ -659,6 +659,23 @@ class RolloutDispatcher:
                 'snapshot_version': self.snapshot_version(),
                 'spec_fp': self.spec_fp()}
 
+    def result_backpressure(self) -> float:
+        """Result-buffer fill share in [0, 1]: (backlog + live leases)
+        over the buffer capacity — the complement of the headroom
+        ``_op_lease`` mints against. 1.0 means a new lease would only
+        evict completed groups; the elastic fleet wiring
+        (train/rollout/elastic.py) scales the rollout pool DOWN before
+        that point, so no worker generates a trajectory the staleness
+        window would drop. Thread-safe (thread-local conn + the
+        results lock), so the controller loop may probe it directly."""
+        outstanding = int(self._conn().execute(
+            'SELECT COUNT(*) FROM leases WHERE status != ?',
+            (RolloutLeaseStatus.DONE.value,)).fetchone()[0])
+        with self._results_lock:
+            backlog = len(self._results)
+        cap = self._results.maxlen or 1
+        return min(1.0, max(0.0, (backlog + outstanding) / cap))
+
     # ----------------------------------------------------------- reaper
 
     def _reap_loop(self) -> None:

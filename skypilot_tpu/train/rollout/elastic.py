@@ -1,0 +1,77 @@
+"""Elastic wiring for the spot rollout fleet (docs/ELASTIC.md).
+
+RLBoost's spot-economics play (PAPERS.md, PR 14) only pays off while
+fleet size tracks what the learner can actually ABSORB: rollout
+workers that outrun the learner fill the dispatcher's bounded result
+buffer, and every trajectory past that point is compute the staleness
+window will drop. This module declares the fleet's ElasticSpec:
+
+  * signal — :meth:`RolloutDispatcher.result_backpressure`: result
+    backlog plus live leases over buffer capacity, the exact quantity
+    ``_op_lease`` mints headroom against;
+  * target — an INVERTED hold band
+    (`SKYTPU_ELASTIC_ROLLOUT_BACKLOG_LOW/HIGH`): backpressure above
+    the band means the learner is behind → shrink the fleet BEFORE
+    new leases are minted for doomed work; below the band the learner
+    is keeping up → grow back toward max. Shrinking is the urgent
+    direction here (the mirror of the data-worker pool), so the
+    DOWNSCALE delay defaults to zero while growth waits out the
+    upscale delay and the cooldown;
+  * hooks — ``scale_up`` / ``scale_down`` add or retire workers (spot
+    Tasks in production; harness RolloutWorker objects in tests — a
+    retired worker just stops heartbeating and the lease reaper
+    reassigns, the same at-least-once machinery preemption exercises).
+
+Safety is the uniform elastic contract: an unreachable dispatcher is
+NO SIGNAL → hold the fleet (never a guess).
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from skypilot_tpu.elastic import signals
+from skypilot_tpu.elastic import spec as elastic_spec
+from skypilot_tpu.utils import knobs
+
+
+def backpressure_signal(dispatcher) -> signals.SignalFn:
+    """In-process probe of the dispatcher's result-buffer fill share
+    (always fresh — it reads the live buffer, not a scrape)."""
+    return signals.callback(dispatcher.result_backpressure)
+
+
+def fleet_spec(
+        signal: signals.SignalFn, *,
+        scale_up: Callable[[int], None],
+        scale_down: Callable[[int], None],
+        min_workers: int = 0,
+        max_workers: Optional[int] = None,
+        initial_workers: Optional[int] = None,
+        band: Optional[tuple] = None,
+        upscale_delay_seconds: float = 0.0,
+        downscale_delay_seconds: float = 0.0,
+) -> elastic_spec.ElasticSpec:
+    """The rollout fleet's declared elastic contract."""
+    if band is None:
+        band = (knobs.get_float('SKYTPU_ELASTIC_ROLLOUT_BACKLOG_LOW'),
+                knobs.get_float('SKYTPU_ELASTIC_ROLLOUT_BACKLOG_HIGH'))
+    return elastic_spec.ElasticSpec(
+        pool='rollout',
+        signal=signal,
+        band=band,
+        # High backpressure → FEWER producers: the inverted band.
+        invert=True,
+        min_units=min_workers,
+        max_units=max_workers,
+        initial_units=initial_workers,
+        upscale_delay_seconds=upscale_delay_seconds,
+        downscale_delay_seconds=downscale_delay_seconds,
+        cooldown_seconds=knobs.get_float(
+            'SKYTPU_ELASTIC_COOLDOWN_SECONDS'),
+        # clean_rounds gates the shrink direction; for this pool
+        # shrinking is urgent, so flap resistance rides the upscale
+        # delay/cooldown instead.
+        clean_rounds=1,
+        stale_after=knobs.get_float('SKYTPU_ELASTIC_STALE_SECONDS'),
+        scale_up=scale_up,
+        scale_down=scale_down)

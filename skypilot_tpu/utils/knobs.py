@@ -390,10 +390,41 @@ _declare('SKYTPU_FAILPOINTS', 'str', '', 'utils',
          'Failpoint arming schedule (name=spec,... — see '
          'docs/ROBUSTNESS.md).')
 
+# ---------------------------------------------------------- elastic
+_declare('SKYTPU_ELASTIC_INTERVAL', 'float', 5.0, 'elastic',
+         'Elastic controller loop cadence in seconds (pools driven by '
+         'an existing loop — serve reconcile, scrape rounds — ignore '
+         'it).')
+_declare('SKYTPU_ELASTIC_STALE_SECONDS', 'float', 30.0, 'elastic',
+         'Default signal staleness window: a Reading older than this '
+         'routes to the pool\'s declared fallback (or a hold).')
+_declare('SKYTPU_ELASTIC_COOLDOWN_SECONDS', 'float', 30.0, 'elastic',
+         'Default minimum gap between APPLIED scale decisions of one '
+         'pool (band-mode wirings; serve keeps its delay-only '
+         'hysteresis).')
+_declare('SKYTPU_ELASTIC_CLEAN_ROUNDS', 'int', 2, 'elastic',
+         'Default consecutive confirming rounds before a SCALE-DOWN '
+         'is adopted (scale-up stays delay-gated only — the '
+         'observe/slo.py de-escalation idiom).')
+_declare('SKYTPU_ELASTIC_DATA_WAIT_LOW', 'float', 0.05, 'elastic',
+         'Data-worker pool: batch-wait share below which the pool '
+         'drains one worker (input is overprovisioned).')
+_declare('SKYTPU_ELASTIC_DATA_WAIT_HIGH', 'float', 0.2, 'elastic',
+         'Data-worker pool: batch-wait share above which the pool '
+         'adds one worker (the trainer is input-stalled).')
+_declare('SKYTPU_ELASTIC_ROLLOUT_BACKLOG_LOW', 'float', 0.3, 'elastic',
+         'Rollout fleet: result-buffer fill share below which the '
+         'fleet may grow back toward max (learner is keeping up).')
+_declare('SKYTPU_ELASTIC_ROLLOUT_BACKLOG_HIGH', 'float', 0.8,
+         'elastic',
+         'Rollout fleet: result-buffer fill share above which the '
+         'fleet shrinks BEFORE minting leases the staleness window '
+         'would drop (learner backpressure).')
+
 # ---------------------------------------------------------- loadgen
 _declare('SKYTPU_BENCH_METRIC', 'str', None, 'loadgen',
          'bench.py scenario selector (decode, serve, loadgen, '
-         'train_input, rl_harvest, kernelcheck, ...).')
+         'train_input, rl_harvest, elastic, kernelcheck, ...).')
 
 
 # =====================================================================
@@ -566,7 +597,7 @@ def default_of(name: str) -> Any:
 _SUBSYSTEM_ORDER = (
     'core', 'logging', 'server', 'client', 'jobs', 'serve',
     'multihost', 'engine', 'lb', 'disagg', 'observe', 'data_service',
-    'rollout', 'train', 'ops', 'usage', 'storage', 'skylet',
+    'rollout', 'train', 'elastic', 'ops', 'usage', 'storage', 'skylet',
     'backends', 'utils', 'loadgen',
 )
 

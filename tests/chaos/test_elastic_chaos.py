@@ -1,0 +1,177 @@
+"""Elastic-controller chaos: a dead scrape plane can never move a pool.
+
+The controller's safety contract (elastic/controller.py): a pool whose
+signal comes from the PR-9 scrape plane must HOLD its last-adopted
+target the moment that plane stops producing fresh readings — armed
+``observe.scrape`` failpoints mid-ramp are indistinguishable from a
+partitioned metrics endpoint, and scaling on a guess is how fleets
+flap themselves to death. The hold is evidence, not silence: the
+source transition lands in the journal as an ``elastic_decision``
+event, and so does the recovery edge once scrapes succeed again.
+"""
+import http.server
+import threading
+import time
+
+import pytest
+
+from skypilot_tpu.elastic import controller as controller_lib
+from skypilot_tpu.elastic import signals
+from skypilot_tpu.elastic import spec as spec_lib
+from skypilot_tpu.observe import journal
+from skypilot_tpu.observe import metrics
+from skypilot_tpu.observe import scrape
+from skypilot_tpu.utils import failpoints
+
+
+@pytest.fixture(autouse=True)
+def chaos_env(tmp_path, monkeypatch):
+    failpoints.reset()
+    monkeypatch.setenv('SKYTPU_OBSERVE_DB', str(tmp_path / 'observe.db'))
+    metrics.REGISTRY.reset_for_tests()
+    yield
+    failpoints.reset()
+    metrics.REGISTRY.reset_for_tests()
+
+
+class _Replica:
+    """A /metrics stub whose queue depth the test ramps at will."""
+
+    def __init__(self):
+        self.depth = 2.0
+        stub = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path != '/metrics':
+                    self.send_error(404)
+                    return
+                body = (
+                    '# TYPE skytpu_engine_queue_depth gauge\n'
+                    f'skytpu_engine_queue_depth {stub.depth}\n'
+                ).encode()
+                self.send_response(200)
+                self.send_header('Content-Type', 'text/plain')
+                self.send_header('Content-Length', str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.server = http.server.ThreadingHTTPServer(
+            ('127.0.0.1', 0), Handler)
+        self.url = f'http://127.0.0.1:{self.server.server_address[1]}'
+        threading.Thread(target=self.server.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+class TestScrapeOutageHoldsPool:
+
+    def test_failpoint_outage_holds_then_recovers(self):
+        """Ramp a scraped queue-depth signal, kill the scrape plane
+        with the ``observe.scrape`` failpoint mid-ramp, and watch the
+        controller: (1) it holds the last-adopted target while blind —
+        even though the hidden load would justify further scale-up —
+        with the hold journaled as a source transition; (2) once the
+        failpoint disarms and a scrape lands, it resumes scaling from
+        the now-visible signal, and the recovery edge is journaled
+        with the outage it came back from."""
+        replica = _Replica()
+        scraper = scrape.Scraper(timeout=3.0, staleness_seconds=0.4)
+        scraper.set_targets([scrape.Target('svc/0', replica.url)])
+        pool = spec_lib.ElasticSpec(
+            pool='serve',
+            signal=signals.scraped_sum(scraper,
+                                       'skytpu_engine_queue_depth'),
+            target_per_unit=4.0, min_units=1, max_units=8,
+            initial_units=1, cooldown_seconds=0.0, clean_rounds=1)
+        ctl = controller_lib.PoolController(pool)
+        try:
+            # Calm phase: depth 2 over 4-per-unit keeps the pool at 1.
+            assert scraper.scrape_round() == {'svc/0': True}
+            assert ctl.evaluate(time.time()) == 1
+
+            # Ramp: depth 12 -> ceil(12/4) = 3 units. Flap resistance
+            # arms the first round, the second confirms and adopts.
+            replica.depth = 12.0
+            scraper.scrape_round()
+            assert ctl.evaluate(time.time()) == 1  # pending
+            assert ctl.evaluate(time.time()) == 3
+
+            # Mid-ramp outage: every scrape now fails, and the load
+            # keeps growing where the controller can no longer see it.
+            failpoints.arm('observe.scrape')
+            replica.depth = 40.0
+            assert scraper.scrape_round() == {'svc/0': False}
+            time.sleep(0.5)  # age the last success past staleness
+            scraper.scrape_round()
+            for _ in range(3):
+                assert ctl.evaluate(time.time()) == 3  # HOLD, blind
+
+            # The hold is journaled once (source transition, not one
+            # event per blind round).
+            events = journal.query(kind='elastic_decision')
+            holds = [e for e in events
+                     if e['reason'] == 'hold_no_signal']
+            assert len(holds) == 1
+            assert holds[0]['data']['target'] == 3
+            assert holds[0]['data']['was'] == 'signal'
+
+            # Recovery: disarm, one good scrape, and the controller
+            # scales from the now-visible 40 -> ceil(40/4) = 10,
+            # clamped to max_units.
+            failpoints.reset()
+            assert scraper.scrape_round() == {'svc/0': True}
+            assert ctl.evaluate(time.time()) == 3  # pending again
+            assert ctl.evaluate(time.time()) == 8
+
+            events = journal.query(kind='elastic_decision')
+            recoveries = [e for e in events
+                          if e['reason'] == 'signal' and
+                          e['data'].get('was') == 'hold_no_signal']
+            assert len(recoveries) == 1
+        finally:
+            replica.stop()
+
+    def test_outage_with_declared_fallback_journals_fallback(self):
+        """A pool that DECLARES a fallback reducer (serve's QPS path)
+        applies it while blind instead of holding — and the journal
+        says so, naming the fallback source."""
+        replica = _Replica()
+        scraper = scrape.Scraper(timeout=3.0, staleness_seconds=0.4)
+        scraper.set_targets([scrape.Target('svc/0', replica.url)])
+        pool = spec_lib.ElasticSpec(
+            pool='serve',
+            signal=signals.scraped_sum(scraper,
+                                       'skytpu_engine_queue_depth'),
+            target_per_unit=4.0, min_units=1, max_units=8,
+            initial_units=1, cooldown_seconds=0.0, clean_rounds=1,
+            fallback=lambda units: 2)
+        ctl = controller_lib.PoolController(pool)
+        try:
+            replica.depth = 12.0
+            scraper.scrape_round()
+            ctl.evaluate(time.time())
+            assert ctl.evaluate(time.time()) == 3
+
+            failpoints.arm('observe.scrape')
+            scraper.scrape_round()
+            time.sleep(0.5)
+            # Downscale to the declared fallback still pays one
+            # confirmation round — the fallback is a target, not an
+            # emergency brake.
+            assert ctl.evaluate(time.time()) == 3
+            assert ctl.evaluate(time.time()) == 2
+
+            events = journal.query(kind='elastic_decision')
+            assert any(e['reason'] == 'fallback_no_signal'
+                       for e in events)
+        finally:
+            failpoints.reset()
+            replica.stop()

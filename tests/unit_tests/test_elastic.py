@@ -1,0 +1,433 @@
+"""The elastic pool-controller plane (skypilot_tpu/elastic/).
+
+Five angles:
+  1. spec validation — the closed pool vocabulary, one target shape,
+     sane bounds/step/clean_rounds;
+  2. PoolController decision contract — band hysteresis (upscale
+     delay), proportional clamping to min/max, cooldown between
+     applied changes, clean-rounds flap gate on the shrink direction,
+     inverted bands (rollout), and the PR-9 safety contract: no
+     signal → hold, stale signal → the DECLARED fallback only;
+  3. flap resistance — an oscillating signal produces a bounded
+     number of applied scale decisions, and every applied change plus
+     every signal-source transition lands in the journal as an
+     ``elastic_decision`` event;
+  4. ElasticController hosting — duplicate-pool rejection, per-pool
+     failure containment in run_once();
+  5. pool wirings — data-service drain_one (LIFO + stop), rollout
+     inverted backpressure spec, and the serve mid-flight spec-update
+     regression: swapping in a fresh autoscaler object must not
+     strand the old object's target (ISSUE 18 satellite 6).
+"""
+import pytest
+
+from skypilot_tpu.elastic import controller as controller_lib
+from skypilot_tpu.elastic import signals
+from skypilot_tpu.elastic import spec as spec_lib
+from skypilot_tpu.observe import journal
+from skypilot_tpu.observe import metrics
+from skypilot_tpu.serve import autoscalers as autoscaler_lib
+from skypilot_tpu.serve import service_spec as serve_spec_lib
+
+
+@pytest.fixture(autouse=True)
+def elastic_env(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKYTPU_OBSERVE_DB', str(tmp_path / 'observe.db'))
+    metrics.REGISTRY.reset_for_tests()
+    yield tmp_path
+    metrics.REGISTRY.reset_for_tests()
+
+
+def _band_spec(**kw):
+    """A data-worker-shaped band spec with a programmable signal."""
+    cfg = dict(pool='data_workers',
+               signal=lambda now: spec_lib.Reading(value=0.1, ts=now),
+               band=(0.05, 0.2), min_units=1, max_units=8)
+    cfg.update(kw)
+    return spec_lib.ElasticSpec(**cfg)
+
+
+class _Probe:
+    """A mutable signal the tests drive round by round. ``value`` may
+    be None (no signal) and ``ts_lag`` ages the reading (staleness)."""
+
+    def __init__(self, value=0.1, ts_lag=0.0):
+        self.value = value
+        self.ts_lag = ts_lag
+
+    def __call__(self, now):
+        if self.value is None:
+            return None
+        return spec_lib.Reading(value=self.value, ts=now - self.ts_lag)
+
+
+# ------------------------------------------------------------ validation
+
+class TestSpecValidation:
+
+    def test_unknown_pool_rejected(self):
+        with pytest.raises(ValueError, match='closed'):
+            _band_spec(pool='gpu_miners').validate()
+
+    def test_exactly_one_target_shape(self):
+        with pytest.raises(ValueError, match='BOTH'):
+            _band_spec(target_per_unit=2.0).validate()
+
+    def test_inverted_band_bounds_rejected(self):
+        with pytest.raises(ValueError, match='band low'):
+            _band_spec(band=(0.9, 0.1)).validate()
+
+    def test_bounds_and_step(self):
+        with pytest.raises(ValueError, match='max_units'):
+            _band_spec(min_units=4, max_units=2).validate()
+        with pytest.raises(ValueError, match='step'):
+            _band_spec(step=0).validate()
+        with pytest.raises(ValueError, match='clean_rounds'):
+            _band_spec(clean_rounds=0).validate()
+
+
+# --------------------------------------------------- decision contract
+
+class TestPoolController:
+
+    def test_band_hysteresis_needs_sustained_breach(self):
+        """Above-band signal proposes +1 but the target only moves
+        once the breach HELD for the upscale delay."""
+        probe = _Probe(value=0.5)
+        ctl = controller_lib.PoolController(_band_spec(
+            signal=probe, upscale_delay_seconds=10.0))
+        t0 = 1000.0
+        assert ctl.evaluate(t0) == 1          # proposal armed
+        assert ctl.evaluate(t0 + 5) == 1      # still inside the delay
+        assert ctl.evaluate(t0 + 11) == 2     # delay elapsed → adopt
+        # Back inside the band → the pending proposal resets.
+        probe.value = 0.1
+        assert ctl.evaluate(t0 + 12) == 2
+        assert ctl.pending is None
+
+    def test_proportional_clamps_to_bounds(self):
+        probe = _Probe(value=1000.0)
+        ctl = controller_lib.PoolController(spec_lib.ElasticSpec(
+            pool='serve', signal=probe, target_per_unit=2.0,
+            min_units=1, max_units=5))
+        t0 = 1000.0
+        ctl.evaluate(t0)
+        assert ctl.evaluate(t0 + 1) == 5      # ceil(1000/2) capped at 5
+        probe.value = 0.0
+        ctl.evaluate(t0 + 2)
+        assert ctl.evaluate(t0 + 3) == 1      # floor at min_units
+
+    def test_cooldown_spaces_applied_changes(self):
+        probe = _Probe(value=0.5)
+        ctl = controller_lib.PoolController(_band_spec(
+            signal=probe, cooldown_seconds=60.0))
+        t0 = 1000.0
+        ctl.evaluate(t0)
+        assert ctl.evaluate(t0 + 1) == 2      # first change applies
+        # Signal still hot: the next step must wait out the cooldown.
+        ctl.evaluate(t0 + 2)
+        assert ctl.evaluate(t0 + 3) == 2
+        assert ctl.evaluate(t0 + 62) == 3     # cooldown elapsed
+
+    def test_scale_down_needs_clean_rounds(self):
+        """slo.py's de-escalation idiom: shrinking waits for
+        consecutive confirming rounds even with a zero delay."""
+        probe = _Probe(value=0.01)
+        ctl = controller_lib.PoolController(_band_spec(
+            signal=probe, clean_rounds=3, initial_units=4))
+        t0 = 1000.0
+        assert ctl.evaluate(t0) == 4          # round 0: proposal armed
+        assert ctl.evaluate(t0 + 1) == 4      # confirming round 1
+        assert ctl.evaluate(t0 + 2) == 4      # confirming round 2
+        assert ctl.evaluate(t0 + 3) == 3      # round 3: clean → adopt
+
+    def test_inverted_band_shrinks_on_high_signal(self):
+        """The rollout shape: high backpressure → FEWER producers."""
+        probe = _Probe(value=0.95)
+        ctl = controller_lib.PoolController(spec_lib.ElasticSpec(
+            pool='rollout', signal=probe, band=(0.3, 0.8), invert=True,
+            min_units=0, max_units=8, initial_units=4))
+        t0 = 1000.0
+        ctl.evaluate(t0)
+        assert ctl.evaluate(t0 + 1) == 3
+        probe.value = 0.05                    # learner caught up → grow
+        ctl.evaluate(t0 + 2)
+        assert ctl.evaluate(t0 + 3) == 4
+
+    def test_no_signal_holds(self):
+        probe = _Probe(value=None)
+        calls = []
+        ctl = controller_lib.PoolController(_band_spec(
+            signal=probe, initial_units=3,
+            on_fallback=calls.append))
+        assert ctl.evaluate(1000.0) == 3
+        assert ctl.evaluate(1001.0) == 3
+        assert calls == ['no_signal', 'no_signal']
+
+    def test_stale_signal_uses_declared_fallback(self):
+        """THE safety contract: a stale reading never drives scaling —
+        the declared fallback reducer takes over (and is clamped)."""
+        probe = _Probe(value=0.9, ts_lag=100.0)
+        calls = []
+        ctl = controller_lib.PoolController(_band_spec(
+            signal=probe, stale_after=30.0,
+            fallback=lambda now: 6, on_fallback=calls.append))
+        t0 = 1000.0
+        raw, source = ctl.compute_raw(t0)
+        assert (raw, source) == (6, 'fallback_stale')
+        ctl.evaluate(t0)
+        assert ctl.evaluate(t0 + 1) == 6
+        assert calls and set(calls) == {'stale'}
+
+    def test_stale_without_fallback_holds(self):
+        probe = _Probe(value=0.9, ts_lag=100.0)
+        ctl = controller_lib.PoolController(_band_spec(
+            signal=probe, stale_after=30.0, initial_units=2))
+        assert ctl.compute_raw(1000.0) == (2, 'hold_stale')
+        assert ctl.evaluate(1000.0) == 2
+
+    def test_hook_called_with_adopted_target_and_contained(self):
+        ups, downs = [], []
+        probe = _Probe(value=0.5)
+        ctl = controller_lib.PoolController(_band_spec(
+            signal=probe, scale_up=ups.append,
+            scale_down=lambda n: 1 / 0))      # hook failure is contained
+        t0 = 1000.0
+        ctl.evaluate(t0)
+        assert ctl.evaluate(t0 + 1) == 2 and ups == [2]
+        probe.value = 0.01
+        ctl.evaluate(t0 + 2)
+        assert ctl.evaluate(t0 + 3) == 1      # target moved despite raise
+        assert downs == []
+
+
+# -------------------------------------------- flap resistance + journal
+
+class TestFlapAndJournal:
+
+    def test_oscillating_signal_bounds_decisions(self):
+        """A signal flipping every round never survives its own
+        hysteresis: the pending proposal resets each flip, so the
+        applied-change count stays ZERO over many rounds."""
+        probe = _Probe(value=0.5)
+        ctl = controller_lib.PoolController(_band_spec(
+            signal=probe, initial_units=2, upscale_delay_seconds=5.0,
+            downscale_delay_seconds=5.0))
+        t0 = 1000.0
+        for i in range(40):
+            probe.value = 0.5 if i % 2 == 0 else 0.01
+            ctl.evaluate(t0 + i)
+        assert ctl.target == 2
+        assert not journal.query(kind='elastic_decision', limit=10)
+        applied = controller_lib._DECISIONS_TOTAL
+        assert applied.value(pool='data_workers', action='scale_up') == 0
+        assert applied.value(pool='data_workers',
+                             action='scale_down') == 0
+        assert applied.value(pool='data_workers', action='hold') == 40
+
+    def test_adoption_and_source_transitions_journaled(self):
+        probe = _Probe(value=0.5)
+        ctl = controller_lib.PoolController(_band_spec(signal=probe))
+        t0 = 1000.0
+        ctl.evaluate(t0)
+        ctl.evaluate(t0 + 1)                  # adopts 1 → 2
+        probe.value = None                    # signal vanishes
+        ctl.evaluate(t0 + 2)                  # source edge journaled once
+        ctl.evaluate(t0 + 3)                  # …but not every hold round
+        probe.value = 0.1
+        ctl.evaluate(t0 + 4)                  # recovery edge journaled
+        events = journal.query(kind='elastic_decision', limit=10)
+        reasons = [e['reason'] for e in events]
+        assert reasons.count('scale_up') == 1
+        assert reasons.count('hold_no_signal') == 1
+        adopt = [e for e in events if e['reason'] == 'scale_up'][0]
+        assert adopt['entity'] == 'elastic/data_workers'
+        assert adopt['data']['old'] == 1 and adopt['data']['new'] == 2
+        edges = [e['data'] for e in events
+                 if e['reason'] == 'hold_no_signal']
+        assert edges[0]['source'] == 'hold_no_signal'
+        recov = [e for e in events if e['data'].get('source') == 'signal']
+        assert len(recov) == 1 and recov[0]['data']['was'] == (
+            'hold_no_signal')
+
+    def test_target_gauge_tracks_pool(self):
+        probe = _Probe(value=0.5)
+        ctl = controller_lib.PoolController(_band_spec(signal=probe))
+        ctl.evaluate(1000.0)
+        ctl.evaluate(1001.0)
+        gauge = controller_lib._TARGET_GAUGE
+        assert gauge.value(pool='data_workers') == 2.0
+
+
+# ----------------------------------------------------- hosting controller
+
+class TestElasticController:
+
+    def test_duplicate_pool_rejected(self):
+        host = controller_lib.ElasticController(interval=1.0)
+        host.register(_band_spec())
+        with pytest.raises(ValueError, match='already registered'):
+            host.register(_band_spec())
+
+    def test_run_once_contains_pool_failures(self):
+        host = controller_lib.ElasticController(interval=1.0)
+        boom = _band_spec(pool='serve', initial_units=2)
+        boom.signal = lambda now: 1 / 0
+        host.register(boom)
+        probe = _Probe(value=0.5)
+        host.register(_band_spec(signal=probe))
+        t0 = 1000.0
+        host.run_once(t0)
+        out = host.run_once(t0 + 1)
+        # The broken pool holds its target; the healthy one still scales.
+        assert out == {'data_workers': 2, 'serve': 2}
+        assert host.targets() == out
+        assert host.pools() == ['data_workers', 'serve']
+
+
+# -------------------------------------------------------------- signals
+
+class _FakeScraper:
+    """status() + fleet_families() — the two surfaces signals.py uses."""
+
+    def __init__(self):
+        self.age = 0.0
+        self.stale = False
+        self.families = {}
+
+    def status(self):
+        return [{'last_success_age': self.age, 'stale': self.stale}]
+
+    def fleet_families(self):
+        return self.families
+
+
+def _hist_family(name, total):
+    reg = metrics.Registry()
+    h = reg.histogram(name, 'x.', buckets=(1.0,))
+    h.observe(total)
+    from skypilot_tpu.observe import promtext
+    return promtext.parse(reg.render())
+
+
+class TestSignals:
+
+    def test_scraped_burn_first_evaluation_is_no_signal(self):
+        scraper = _FakeScraper()
+        name = 'skytpu_train_batch_wait_seconds'
+        scraper.families = _hist_family(name, 10.0)
+        sig = signals.scraped_burn(scraper, name)
+        assert sig(1000.0) is None            # no baseline yet → hold
+        scraper.families = _hist_family(name, 15.0)
+        scraper.age = 0.0
+        reading = sig(1010.0)
+        assert reading is not None
+        assert reading.value == pytest.approx(0.5)   # 5s blocked / 10s
+
+    def test_stale_plane_is_no_signal(self):
+        scraper = _FakeScraper()
+        scraper.stale = True
+        sig = signals.scraped_sum(scraper, 'anything')
+        assert sig(1000.0) is None
+
+    def test_callback_probe_is_always_fresh(self):
+        sig = signals.callback(lambda: 0.7)
+        reading = sig(1234.0)
+        assert reading.value == 0.7 and reading.ts == 1234.0
+        assert signals.callback(lambda: None)(1234.0) is None
+
+
+# ---------------------------------------------------------- pool wirings
+
+class TestPoolWirings:
+
+    def test_data_service_drain_one_is_lifo_and_stops(self):
+        from skypilot_tpu.data_service import elastic as ds_elastic
+
+        class _W:
+            def __init__(self):
+                self.stopped = False
+
+            def stop(self):
+                self.stopped = True
+
+        pool = [_W(), _W(), _W()]
+        oldest, newest = pool[0], pool[-1]
+        drained = ds_elastic.drain_one(pool)
+        assert drained is newest and drained.stopped
+        assert pool == [oldest, pool[1]] and not oldest.stopped
+        assert ds_elastic.drain_one([]) is None
+
+    def test_data_worker_spec_defaults_from_knobs(self, monkeypatch):
+        from skypilot_tpu.data_service import elastic as ds_elastic
+        monkeypatch.setenv('SKYTPU_ELASTIC_DATA_WAIT_LOW', '0.01')
+        monkeypatch.setenv('SKYTPU_ELASTIC_DATA_WAIT_HIGH', '0.5')
+        spec = ds_elastic.worker_pool_spec(
+            _Probe(), scale_up=lambda n: None, scale_down=lambda n: None)
+        spec.validate()
+        assert spec.pool == 'data_workers'
+        assert spec.band == (0.01, 0.5) and not spec.invert
+
+    def test_rollout_fleet_spec_is_inverted(self):
+        from skypilot_tpu.train.rollout import elastic as ro_elastic
+
+        class _Disp:
+            def result_backpressure(self):
+                return 0.9
+
+        spec = ro_elastic.fleet_spec(
+            ro_elastic.backpressure_signal(_Disp()),
+            scale_up=lambda n: None, scale_down=lambda n: None,
+            max_workers=8, initial_workers=4)
+        spec.validate()
+        assert spec.pool == 'rollout' and spec.invert
+        ctl = controller_lib.PoolController(spec)
+        ctl.evaluate(1000.0)
+        # clean_rounds=1 for this pool: shrinking is the urgent
+        # direction, so the confirming round is enough.
+        assert ctl.evaluate(1001.0) == 3
+
+
+# ------------------------------------- serve spec-update swap regression
+
+class TestServeSwapRegression:
+
+    def test_fresh_autoscaler_does_not_inherit_stale_target(self):
+        """ISSUE 18 satellite 6: update adoption swaps in a NEW
+        autoscaler object (controller.py `_load_from_record`); the
+        scrape-round callback reads the attribute each round ("reads,
+        not captures"), so the fresh object's controller state — not
+        the old one's adopted target — must drive the next decision,
+        and the shared pool gauge must reflect the LIVE object after
+        its first evaluation."""
+        policy = serve_spec_lib.ReplicaPolicy(
+            min_replicas=1, max_replicas=8, target_qps_per_replica=1.0,
+            upscale_delay_seconds=0.0, downscale_delay_seconds=0.0)
+        old = autoscaler_lib.RequestRateAutoscaler(policy)
+        t0 = 1000.0
+        for i in range(600):
+            old.record_request(t0 + i * 0.1)   # 10 qps → raw 10, cap 8
+        old.target_replicas(t0 + 60)
+        assert old.target_replicas(t0 + 61) == 8
+        # Mid-flight spec update: the controller builds a fresh object
+        # via Autoscaler.make and swaps the attribute.
+        new = autoscaler_lib.Autoscaler.make(policy)
+        assert new._current_target == policy.min_replicas
+        assert new._pending is None
+        # The new object saw no traffic: its first decision holds at
+        # min_replicas instead of inheriting the drained target.
+        assert new.target_replicas(t0 + 62) == 1
+        gauge = controller_lib._TARGET_GAUGE
+        assert gauge.value(pool='serve') == 1.0
+
+    def test_swapped_in_object_scales_from_its_own_signal(self):
+        policy = serve_spec_lib.ReplicaPolicy(
+            min_replicas=1, max_replicas=4, target_qps_per_replica=1.0,
+            upscale_delay_seconds=0.0, downscale_delay_seconds=0.0)
+        new = autoscaler_lib.Autoscaler.make(policy)
+        t0 = 2000.0
+        for i in range(300):
+            new.record_request(t0 + i * 0.1)   # 5 qps → raw 5, cap 4
+        new.target_replicas(t0 + 30)
+        assert new.target_replicas(t0 + 31) == 4
+        assert controller_lib._TARGET_GAUGE.value(pool='serve') == 4.0

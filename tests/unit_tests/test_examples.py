@@ -33,3 +33,23 @@ def test_example_parses(path):
 
 def test_examples_exist():
     assert len(_EXAMPLES) >= 6
+
+
+def test_no_hand_exported_stage_addresses():
+    """Pipelines use the controller's cross-stage head-IP auto-export
+    (`<STAGE_NAME>_HEAD_IP`, jobs/controller.py) — an example requiring
+    a hand-exported address (`${X_HEAD_IP:?...}`) is a regression."""
+    for path in _EXAMPLES:
+        with open(path, 'r', encoding='utf-8') as f:
+            content = f.read()
+        assert '_HEAD_IP:?' not in content, os.path.basename(path)
+
+
+def test_data_service_example_uses_auto_export():
+    path = os.path.join(_EXAMPLES_DIR, 'data-service-train.yaml')
+    dag = dag_lib.load_chain_dag_from_yaml(path)
+    names = [t.name for t in dag.tasks]
+    assert names == ['data-plane', 'train']
+    # Stage name 'data-plane' sanitizes to the DATA_PLANE_HEAD_IP env
+    # the train stage consumes.
+    assert 'DATA_PLANE_HEAD_IP' in dag.tasks[-1].run

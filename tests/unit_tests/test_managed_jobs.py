@@ -219,6 +219,26 @@ class TestManagedJobs:
         # Every stage cluster was torn down.
         assert global_state.get_clusters() == []
 
+    def test_pipeline_exports_head_ip_to_later_stages(self, tmp_path):
+        """Cross-stage address plumbing (ISSUE 18): after stage 1
+        launches, the controller exports its head IP as
+        <STAGE_NAME>_HEAD_IP into every later stage's env — the
+        data-service example's train stage consumes DATA_PLANE_HEAD_IP
+        without any hand-exported variable."""
+        import skypilot_tpu.dag as dag_lib
+        log = tmp_path / 'ip.txt'
+        dag = dag_lib.Dag(name='ippipe')
+        t1 = _task('data-plane', 'echo up')
+        t2 = _task('train', f'echo "${{DATA_PLANE_HEAD_IP:-missing}}" '
+                            f'>> {log}')
+        dag.add(t1)
+        dag.add(t2)
+        dag.add_edge(t1, t2)
+        job_id = jobs_core.launch(dag)
+        _wait_status(job_id, {ManagedJobStatus.SUCCEEDED}, timeout=300)
+        exported = log.read_text().strip()
+        assert exported and exported != 'missing'
+
     def test_pipeline_stage_failure_stops_chain(self, tmp_path):
         import skypilot_tpu.dag as dag_lib
         log = tmp_path / 'order.txt'

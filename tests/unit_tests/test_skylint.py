@@ -2632,7 +2632,7 @@ class TestLivePackage:
         with open(out_path, encoding='utf-8') as f:
             report = json.load(f)
         # Schema stability (version-bump ratchet).
-        assert report['skylint_version'] == core.REPORT_VERSION == 16
+        assert report['skylint_version'] == core.REPORT_VERSION == 17
         assert set(report) == {
             'skylint_version', 'root', 'files_scanned', 'checks',
             'violations', 'total', 'allowlisted', 'new',
