@@ -19,6 +19,7 @@ obviously-stale bench processes, killing) chip holders between attempts.
 """
 import collections
 import dataclasses
+import functools
 import json
 import os
 import signal
@@ -394,6 +395,170 @@ def run_decode_bench():
         'attn_backend': os.environ.get('SKYTPU_ENGINE_ATTN', 'fused'),
         'device': device.device_kind,
     }), flush=True)
+
+
+QUALITY_LAST_GOOD_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    'QUALITY_LAST_GOOD.json')
+
+# Tolerance bands for diffing against QUALITY_LAST_GOOD.json: the
+# int8 KV path must hold teacher-forced NLL within QUALITY_NLL_BAND
+# (absolute nats/token) of the pinned fp numbers and reproduce at
+# least QUALITY_GREEDY_MATCH_MIN of the pinned greedy continuation.
+# The fp path sits at 0 drift / 1.0 match by construction — the bands
+# exist so the bit-identity relaxation under SKYTPU_ENGINE_KV_QUANT=
+# int8 is a checked-in, diffable number, never a vibe (ISSUE 19).
+QUALITY_NLL_BAND = 0.05
+QUALITY_GREEDY_MATCH_MIN = 0.9
+
+
+def _quality_family(family: str, quant: str):
+    """One debug family's pinned eval: fixed-seed params and prompts,
+    teacher-forced NLL + greedy continuation THROUGH THE PAGED DECODE
+    PATH — the path the int8 page pool changes. The prompt K/V lands
+    via scatter_prefill (which quantizes under int8), so every scored
+    step attends the pool representation the engine would serve."""
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from skypilot_tpu.models import paging
+
+    B, PROMPT, CONT = 4, 24, 16
+    PSZ, MAXP = 8, 8
+    max_len = PSZ * MAXP
+    pages_per_row = -(-(PROMPT + CONT) // PSZ)
+    n_pages = B * pages_per_row + 1
+    if family == 'llama':
+        from skypilot_tpu.models import decode as prog
+        from skypilot_tpu.models import llama
+        cfg = _dc.replace(llama.PRESETS['llama-debug'],
+                          dtype=jnp.float32)
+        params = jax.jit(lambda r: prog.cast_params_for_decode(
+            llama.init_params(r, cfg), cfg))(jax.random.PRNGKey(0))
+    else:
+        from skypilot_tpu.models import mla as prog
+        cfg = _dc.replace(prog.PRESETS['mla-debug'], dtype=jnp.float32)
+        params = jax.jit(lambda r: prog.init_params(r, cfg))(
+            jax.random.PRNGKey(0))
+
+    key = jax.random.PRNGKey(19)  # the pinned prompt-set seed
+    kp, kf = jax.random.split(key)
+    prompts = jax.random.randint(kp, (B, PROMPT), 0, cfg.vocab_size)
+    forced = jax.random.randint(kf, (B, CONT), 0, cfg.vocab_size)
+
+    table = np.zeros((B, MAXP), np.int32)
+    for b in range(B):
+        for i in range(pages_per_row):
+            table[b, i] = 1 + b * pages_per_row + i
+
+    def fresh_pool(rows):
+        pool = prog.init_page_pool(cfg, n_pages, PSZ, B, MAXP,
+                                   quant=quant)
+        pool = _dc.replace(pool, table=jnp.asarray(table))
+        return paging.scatter_prefill(
+            pool, rows, jnp.arange(B), PROMPT,
+            jnp.full((B,), PROMPT, jnp.int32))
+
+    prefill_logits, rows = prog.prefill(params, prompts, cfg, PROMPT)
+    step = jax.jit(functools.partial(
+        prog.paged_decode_step, cfg=cfg, max_len=max_len),
+        static_argnames=())
+
+    # Teacher-forced NLL over the continuation: the prefill's
+    # last-content-position logits ([B, vocab]) score forced[0]; each
+    # paged step then scores the next.
+    pool = fresh_pool(rows)
+    lp = jax.nn.log_softmax(prefill_logits.astype(jnp.float32))
+    nll = [-lp[jnp.arange(B), forced[:, 0]]]
+    for t in range(CONT - 1):
+        logits, pool = step(params, forced[:, t], pool)
+        lp = jax.nn.log_softmax(logits.astype(jnp.float32))
+        nll.append(-lp[jnp.arange(B), forced[:, t + 1]])
+    nll_mean = float(jnp.mean(jnp.stack(nll)))
+
+    # Greedy continuation: argmax chain, pinned token ids.
+    pool = fresh_pool(rows)
+    cur = jnp.argmax(prefill_logits, axis=-1).astype(jnp.int32)
+    greedy = [np.asarray(cur)]
+    for _ in range(CONT - 1):
+        logits, pool = step(params, cur, pool)
+        cur = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        greedy.append(np.asarray(cur))
+    tokens = np.stack(greedy, axis=1)                     # [B, CONT]
+    return {'nll': round(nll_mean, 6),
+            'greedy_tokens': tokens.tolist()}
+
+
+def _diff_quality(doc, last):
+    """Band diff vs QUALITY_LAST_GOOD.json: NLL within the absolute
+    band, greedy continuation agreement above the floor, per family."""
+    regressions = []
+    base = last.get('families') or {}
+    for family, row in (doc.get('families') or {}).items():
+        old = base.get(family)
+        if old is None:
+            regressions.append(f'{family}: no last-good row')
+            continue
+        drift = abs(row['nll'] - old['nll'])
+        if drift > QUALITY_NLL_BAND:
+            regressions.append(
+                f'{family}: nll {row["nll"]} vs last-good '
+                f'{old["nll"]} (drift {drift:.4f} > band '
+                f'{QUALITY_NLL_BAND})')
+        ours = [t for r in row['greedy_tokens'] for t in r]
+        theirs = [t for r in old['greedy_tokens'] for t in r]
+        n = min(len(ours), len(theirs))
+        match = (sum(a == b for a, b in
+                     zip(ours[:n], theirs[:n])) / n if n else 0.0)
+        if match < QUALITY_GREEDY_MATCH_MIN:
+            regressions.append(
+                f'{family}: greedy continuation match {match:.3f} < '
+                f'{QUALITY_GREEDY_MATCH_MIN}')
+    return {'ok': not regressions, 'regressions': regressions}
+
+
+def run_quality_bench():
+    """SKYTPU_BENCH_METRIC=quality (CPU-runnable): the pinned quality
+    eval the int8 KV path diffs against — fixed-seed teacher-forced
+    NLL + greedy-continuation exact-match over a pinned prompt set,
+    both debug families, THROUGH the paged decode path. Run at
+    SKYTPU_ENGINE_KV_QUANT=none this reproduces QUALITY_LAST_GOOD.json
+    exactly; at int8 the diff's tolerance bands are the checked-in
+    relaxation of the engine's bit-identity gate (ISSUE 19 — the eval
+    lands FIRST, so the relaxation is a diffable number)."""
+    from skypilot_tpu.utils import knobs
+
+    device = _get_device()
+    quant = knobs.get_enum('SKYTPU_ENGINE_KV_QUANT')
+    families = {family: _quality_family(family, quant)
+                for family in ('llama', 'mla')}
+    value = round(sum(row['nll'] for row in families.values()) /
+                  len(families), 6)
+    doc = {
+        'metric': 'quality',
+        'value': value,
+        'unit': 'nll (nats/token, teacher-forced, debug models)',
+        'kv_quant': quant,
+        'families': families,
+        'bands': {'nll_abs': QUALITY_NLL_BAND,
+                  'greedy_match_min': QUALITY_GREEDY_MATCH_MIN},
+        'device': device.device_kind,
+    }
+    try:
+        with open(QUALITY_LAST_GOOD_PATH) as f:
+            last_good = json.load(f)
+        doc['vs_last_good'] = _diff_quality(doc, last_good)
+        if not doc['vs_last_good']['ok']:
+            print(f'[bench] quality REGRESSION vs last good: '
+                  f'{doc["vs_last_good"]["regressions"]}',
+                  file=sys.stderr)
+    except (OSError, ValueError):
+        print('[bench] no QUALITY_LAST_GOOD.json to diff against',
+              file=sys.stderr)
+    print(json.dumps(doc), flush=True)
 
 
 def run_serve_bench():
@@ -1101,6 +1266,174 @@ def run_loadgen_bench():
     print(json.dumps(doc), flush=True)
 
 
+KV_HIERARCHY_LAST_GOOD_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    'KV_HIERARCHY_LAST_GOOD.json')
+
+# Acceptance bands for the KV-hierarchy A/B (ISSUE 19): the hierarchy
+# run must hold at least this many times the baseline's resident-
+# session peak, and interactive TPOT p95 may not exceed the baseline's
+# by more than the factor (the same 3x CPU-noise band
+# diff_scorecards uses).
+KV_HIERARCHY_SESSIONS_RATIO_MIN = 2.0
+KV_HIERARCHY_TPOT_FACTOR = 3.0
+
+
+def _kv_hierarchy_row(card):
+    """The columns the A/B compares, from one churn-profile
+    scorecard."""
+    fleet = card.get('fleet') or {}
+    agg = fleet.get('aggregate') or {}
+    inter = (fleet.get('by_class') or {}).get('interactive') or {}
+    client = card.get('client') or {}
+    return {
+        'concurrent_sessions_peak': agg.get('concurrent_sessions_peak'),
+        'interactive_tpot_p95_ms': inter.get('tpot_p95_ms'),
+        'interactive_goodput': inter.get('goodput'),
+        'completed': client.get('completed'),
+        'errors': client.get('errors'),
+        'schedule_hash': card.get('schedule_hash'),
+    }
+
+
+def _diff_kv_hierarchy(doc, last):
+    """Diff against the checked-in KV_HIERARCHY_LAST_GOOD.json: the
+    schedule must replay byte-identically, the sessions ratio must
+    hold its hard floor (the 2x capacity claim is the contract, not a
+    timing), and the ratio itself may not collapse below last-good's
+    noise band."""
+    regressions = []
+    if doc.get('schedule_hash') != last.get('schedule_hash') and \
+            doc.get('seed') == last.get('seed') and \
+            doc.get('profile') == last.get('profile'):
+        regressions.append(
+            'schedule_hash changed for the same (profile, seed) — '
+            'the replay contract is broken')
+    floor = last.get('bands', {}).get(
+        'sessions_ratio_min', KV_HIERARCHY_SESSIONS_RATIO_MIN)
+    ours = doc.get('value')
+    if ours is not None and ours < floor:
+        regressions.append(
+            f'sessions ratio {ours} fell below the {floor}x floor')
+    theirs = last.get('value')
+    if ours is not None and theirs and ours < theirs / 2.0:
+        regressions.append(
+            f'sessions ratio {ours} vs last-good {theirs} (>2x drop)')
+    return {'ok': not regressions, 'regressions': regressions}
+
+
+def run_kv_hierarchy_bench():
+    """SKYTPU_BENCH_METRIC=kv_hierarchy (CPU-runnable): the KV memory
+    hierarchy's capacity proof (docs/ENGINE.md "KV memory hierarchy").
+    Runs the fixed-seed churn profile TWICE against a 1-replica local
+    stack with a deliberately entry-starved device prefix cache:
+
+      * baseline  — SKYTPU_ENGINE_KV_QUANT=none, host tier off: an
+        idle session's eviction is a full re-prefill and the replica's
+        resident-session peak is capped at the device store size;
+      * hierarchy — int8 page pool + host-RAM spill tier with a short
+        idle threshold: idle sessions park in host RAM and wake on
+        their Zipf re-activation.
+
+    `value` is the ratio of the two runs' concurrent_sessions_peak
+    columns (fleet-scraped engine high-water marks); the acceptance
+    bands require >= 2x at interactive TPOT p95 within the baseline's
+    noise band. Identical schedule hashes prove both runs saw the same
+    offered traffic."""
+    import shutil
+    import tempfile
+
+    device = _get_device()
+    seed = int(os.environ.get('SKYTPU_BENCH_KV_SEED', '19'))
+    profile = os.environ.get('SKYTPU_BENCH_KV_PROFILE', 'churn')
+    # Entry-starve the device store so session count (not page bytes)
+    # is the binding resource on CPU — the tier's lever either way.
+    prefix_entries = os.environ.get('SKYTPU_BENCH_KV_PREFIX_CACHE', '6')
+    arms = {
+        'baseline': {'SKYTPU_ENGINE_KV_QUANT': 'none',
+                     'SKYTPU_ENGINE_KV_HOST_MB': '0',
+                     'SKYTPU_ENGINE_KV_IDLE_SPILL_S': '0'},
+        'hierarchy': {'SKYTPU_ENGINE_KV_QUANT': 'int8',
+                      'SKYTPU_ENGINE_KV_HOST_MB': '256',
+                      'SKYTPU_ENGINE_KV_IDLE_SPILL_S': '0.75'},
+    }
+    run_dir = tempfile.mkdtemp(prefix='skytpu-bench-kvh-')
+    rows = {}
+    try:
+        for tag, extra in arms.items():
+            report_path = os.path.join(run_dir, f'{tag}.json')
+            proc = subprocess.run(
+                [sys.executable, '-m', 'skypilot_tpu.loadgen',
+                 '--seed', str(seed), '--profile', profile,
+                 '--local-stack', '1', '--run-dir', run_dir,
+                 '--no-churn', '--no-routing-drill',
+                 '--report', report_path],
+                stdout=sys.stderr, stderr=sys.stderr,
+                env={**os.environ,
+                     'SKYTPU_ENGINE_PREFIX_CACHE': prefix_entries,
+                     'SKYTPU_OBSERVE_DB': os.path.join(
+                         run_dir, f'{tag}.db'),
+                     **extra})
+            if proc.returncode != 0:
+                raise SystemExit(f'[bench] kv_hierarchy {tag} run '
+                                 f'failed rc={proc.returncode}')
+            with open(report_path) as f:
+                rows[tag] = _kv_hierarchy_row(json.load(f))
+    finally:
+        shutil.rmtree(run_dir, ignore_errors=True)
+
+    base, hier = rows['baseline'], rows['hierarchy']
+    value = None
+    if base['concurrent_sessions_peak'] and \
+            hier['concurrent_sessions_peak'] is not None:
+        value = round(hier['concurrent_sessions_peak'] /
+                      base['concurrent_sessions_peak'], 3)
+    tpot_ok = None
+    if base['interactive_tpot_p95_ms'] and \
+            hier['interactive_tpot_p95_ms']:
+        tpot_ok = (hier['interactive_tpot_p95_ms'] <=
+                   base['interactive_tpot_p95_ms'] *
+                   KV_HIERARCHY_TPOT_FACTOR)
+    contract = {
+        'sessions_ratio_ok': (value is not None and
+                              value >= KV_HIERARCHY_SESSIONS_RATIO_MIN),
+        'tpot_in_band': tpot_ok,
+        'replay_ok': (base['schedule_hash'] == hier['schedule_hash']),
+        'errors_ok': (base['errors'] == 0 and hier['errors'] == 0),
+    }
+    doc = {
+        'metric': 'kv_hierarchy_sessions_ratio',
+        'value': value,
+        'unit': 'x (concurrent_sessions_peak, int8+spill vs '
+                'none+no-spill)',
+        'profile': profile,
+        'seed': seed,
+        'prefix_cache_entries': int(prefix_entries),
+        'schedule_hash': base['schedule_hash'],
+        'baseline': base,
+        'hierarchy': hier,
+        'bands': {'sessions_ratio_min': KV_HIERARCHY_SESSIONS_RATIO_MIN,
+                  'tpot_p95_factor': KV_HIERARCHY_TPOT_FACTOR},
+        'contract': contract,
+        'device': device.device_kind,
+    }
+    if not all(v is not False for v in contract.values()):
+        print(f'[bench] kv_hierarchy CONTRACT failure: {contract}',
+              file=sys.stderr)
+    try:
+        with open(KV_HIERARCHY_LAST_GOOD_PATH) as f:
+            last_good = json.load(f)
+        doc['vs_last_good'] = _diff_kv_hierarchy(doc, last_good)
+        if not doc['vs_last_good']['ok']:
+            print(f'[bench] kv_hierarchy REGRESSION vs last good: '
+                  f'{doc["vs_last_good"]["regressions"]}',
+                  file=sys.stderr)
+    except (OSError, ValueError):
+        print('[bench] no KV_HIERARCHY_LAST_GOOD.json to diff against',
+              file=sys.stderr)
+    print(json.dumps(doc), flush=True)
+
+
 ELASTIC_LAST_GOOD_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)),
     'ELASTIC_LAST_GOOD.json')
@@ -1685,6 +2018,10 @@ if __name__ == '__main__':
             run_rl_harvest_bench()
         elif metric == 'kernelcheck':
             run_kernelcheck()
+        elif metric == 'quality':
+            run_quality_bench()
+        elif metric == 'kv_hierarchy':
+            run_kv_hierarchy_bench()
         else:
             run_bench()
     else:

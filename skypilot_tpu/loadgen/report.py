@@ -86,6 +86,15 @@ def fleet_section(metrics_text: str) -> Dict[str, Any]:
     if requests_fam is not None:
         aggregate['requests_total'] = sum(
             s.value for s in requests_fam.samples)
+    kv_peak = fams.get('skytpu_engine_kv_sessions_peak')
+    if kv_peak is not None and kv_peak.samples:
+        # Summed across replicas by the fleet merge: each replica's
+        # high-water mark of sessions resident in its KV hierarchy
+        # (device prefix store + host spill tier). The KV-hierarchy
+        # bench compares this column across int8+spill vs
+        # none+no-spill runs of the churn profile.
+        aggregate['concurrent_sessions_peak'] = sum(
+            s.value for s in kv_peak.samples)
     prefix = _counter_by_labels(fams,
                                 'skytpu_engine_prefix_requests_total')
     hits = prefix.get((('outcome', 'hit'),), 0.0)

@@ -185,6 +185,29 @@ PROFILES['ramp'] = dataclasses.replace(
     diurnal_amplitude=0.0, spike_start_frac=0.3, spike_len_frac=0.4,
     spike_factor=2.0)
 
+# The KV-memory-hierarchy proof profile (docs/ENGINE.md "KV memory
+# hierarchy"): many long-context sessions against a deliberately
+# entry-starved device prefix cache, Zipf-skewed re-activation so
+# sessions go idle and RETURN. Without the host spill tier every
+# eviction is a full re-prefill and the replica's resident-session
+# peak is capped at the device store size; with
+# SKYTPU_ENGINE_KV_HOST_MB + SKYTPU_ENGINE_KV_IDLE_SPILL_S the same
+# schedule parks idle sessions in host RAM and wakes them on return —
+# the concurrent_sessions_peak column the KV-hierarchy bench compares
+# (int8+spill vs none+no-spill, TPOT held in band). A NEW entry, not a
+# replace-variant: existing profiles' schedule hashes must not drift.
+PROFILES['churn'] = Profile(
+    name='churn', tenants=4, sessions_per_tenant=6, requests=72,
+    duration_s=12.0,
+    classes={
+        'interactive': ClassShape(prefix_len=64, suffix_len=4,
+                                  max_new_tokens=4, weight=0.45),
+        'long_context': ClassShape(prefix_len=256, suffix_len=16,
+                                   max_new_tokens=4, weight=0.55),
+    },
+    diurnal_amplitude=0.3, spike_len_frac=0.0, spike_factor=1.0,
+    stream_fraction=0.25)
+
 
 @dataclasses.dataclass(frozen=True)
 class RequestSpec:

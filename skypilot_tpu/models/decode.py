@@ -161,29 +161,45 @@ def cache_pspecs(cfg: llama.LlamaConfig) -> KVCache:
 
 
 def init_page_pool(cfg: llama.LlamaConfig, n_pages: int, page_size: int,
-                   batch: int, max_pages: int):
+                   batch: int, max_pages: int, quant: str = 'none'):
     """Block-paged K/V pool for the serving engine (models/paging.py):
     [L, n_pages, page_size, KH, hd] pools, a zeroed [batch, max_pages]
     int32 page table (0 = trash page), and per-row lengths. Page COUNT
-    is data, not shape — one pool serves every request mix."""
+    is data, not shape — one pool serves every request mix.
+    ``quant='int8'`` (SKYTPU_ENGINE_KV_QUANT) pools int8 codes plus
+    [L, n_pages, page_size, KH] float32 scale sidecars — ~2x the pages
+    in the same HBM footprint at bf16."""
     from skypilot_tpu.models import paging
     shape = (cfg.n_layers, n_pages, page_size, cfg.n_kv_heads, cfg.hd)
+    pool_dtype = jnp.int8 if quant == 'int8' else cfg.dtype
+
+    def scale():
+        # Distinct buffers: the step jits donate the cache tree, and
+        # two leaves aliasing one buffer would double-donate.
+        return (jnp.zeros(shape[:-1], jnp.float32)
+                if quant == 'int8' else None)
+
     return paging.PagedKV(
-        k=jnp.zeros(shape, cfg.dtype), v=jnp.zeros(shape, cfg.dtype),
+        k=jnp.zeros(shape, pool_dtype), v=jnp.zeros(shape, pool_dtype),
         table=jnp.zeros((batch, max_pages), jnp.int32),
-        length=jnp.zeros((batch,), jnp.int32))
+        length=jnp.zeros((batch,), jnp.int32),
+        k_scale=scale(), v_scale=scale())
 
 
-def paged_pspecs(cfg: llama.LlamaConfig):
+def paged_pspecs(cfg: llama.LlamaConfig, quant: str = 'none'):
     """PartitionSpecs mirroring init_page_pool's tree: the PAGE axis
     shards over data/fsdp (pages are interchangeable, so the pool
     spreads like the contiguous cache's batch axis did), kv-heads over
-    tensor; tables/lengths replicate (tiny, host-updated)."""
+    tensor; tables/lengths replicate (tiny, host-updated). The scale
+    sidecars mirror the pools minus the last axis."""
     del cfg
     from jax.sharding import PartitionSpec as P
     from skypilot_tpu.models import paging
     kv = P(None, ('data', 'fsdp'), None, 'tensor', None)
-    return paging.PagedKV(k=kv, v=kv, table=P(), length=P())
+    scale = (P(None, ('data', 'fsdp'), None, 'tensor')
+             if quant == 'int8' else None)
+    return paging.PagedKV(k=kv, v=kv, table=P(), length=P(),
+                          k_scale=scale, v_scale=scale)
 
 
 def _qkv(x: jnp.ndarray, lp, cfg: llama.LlamaConfig, sin, cos):
@@ -489,9 +505,15 @@ def paged_verify_step(params, tokens: jnp.ndarray, pcache,
     held, the new K/V overlay lands at the same positions, and the
     attention reduction is the unchanged XLA path (property-tested in
     tests/unit_tests/test_paging.py). `length` does NOT advance — the
-    same commit contract as verify_step."""
+    same commit contract as verify_step.
+
+    Int8 pools (k_scale/v_scale sidecars set) thread the scales
+    through the scan carry and the dequant fuses into the per-layer
+    page gather (ops/paged_attention.py) — allclose to the fp path,
+    gated by the pinned quality eval."""
     from skypilot_tpu.models import paging
     from skypilot_tpu.ops import paged_attention as pa
+    quant = paging.quantized(pcache)
     b, kk = tokens.shape
     length = pcache.length
     positions = length[:, None] + jnp.arange(kk)          # [B, K]
@@ -503,37 +525,54 @@ def paged_verify_step(params, tokens: jnp.ndarray, pcache,
     sin, cos = llama.rope_tables(cfg, positions)
 
     def body(carry, xs):
-        x_c, kp_all, vp_all = carry
+        x_c, kp_all, vp_all, ks_all, vs_all = carry
         lp, layer_idx = xs
         sin_l, cos_l = llama.select_rope(sin, cos, layer_idx, cfg)
         q, k_new, v_new = _qkv(x_c, lp, cfg, sin_l, cos_l)
-        kp = jax.lax.dynamic_index_in_dim(kp_all, layer_idx, axis=0,
-                                          keepdims=False)
-        vp = jax.lax.dynamic_index_in_dim(vp_all, layer_idx, axis=0,
-                                          keepdims=False)
+
+        def sel(a):
+            return jax.lax.dynamic_index_in_dim(a, layer_idx, axis=0,
+                                                keepdims=False)
+
+        kp, vp = sel(kp_all), sel(vp_all)
+        ks = sel(ks_all) if quant else None
+        vs = sel(vs_all) if quant else None
         w_active = (llama.window_active(layer_idx, cfg)
                     if cfg.sliding_window else None)
-        out, kp, vp = pa.paged_attention_step(
+        res = pa.paged_attention_step(
             q, kp, vp, table, length, k_new, v_new, pid, off,
             max_len=max_len, impl=attn,
             logit_softcap=cfg.attn_logit_softcap,
             window=cfg.sliding_window, window_active=w_active,
             sinks=(lp['sink'].astype(jnp.float32)
-                   if cfg.attn_sinks else None))
-        kp_all = jax.lax.dynamic_update_index_in_dim(kp_all, kp,
-                                                     layer_idx, axis=0)
-        vp_all = jax.lax.dynamic_update_index_in_dim(vp_all, vp,
-                                                     layer_idx, axis=0)
+                   if cfg.attn_sinks else None),
+            k_scale=ks, v_scale=vs)
+
+        def put(a, new):
+            return jax.lax.dynamic_update_index_in_dim(a, new,
+                                                       layer_idx,
+                                                       axis=0)
+
+        if quant:
+            out, kp, vp, ks, vs = res
+            ks_all, vs_all = put(ks_all, ks), put(vs_all, vs)
+        else:
+            out, kp, vp = res
+        kp_all, vp_all = put(kp_all, kp), put(vp_all, vp)
         out = out.reshape(b, kk, cfg.n_heads * cfg.hd)
         x_c = x_c + _wo_project(out, lp, cfg)
         x_c = x_c + _ffn(x_c, lp, cfg)
-        return (x_c, kp_all, vp_all), None
+        return (x_c, kp_all, vp_all, ks_all, vs_all), None
 
     layer_ids = jnp.arange(cfg.n_layers, dtype=jnp.int32)
-    (x, kps, vps), _ = jax.lax.scan(
-        body, (x, pcache.k, pcache.v), (params['layers'], layer_ids))
+    # None rides the carry as an empty pytree on the fp path — the
+    # quant branches above are static Python, so one program per mode.
+    (x, kps, vps, kss, vss), _ = jax.lax.scan(
+        body, (x, pcache.k, pcache.v, pcache.k_scale, pcache.v_scale),
+        (params['layers'], layer_ids))
     logits = _unembed(x, params, cfg)
-    return logits, dataclasses.replace(pcache, k=kps, v=vps)
+    return logits, dataclasses.replace(pcache, k=kps, v=vps,
+                                       k_scale=kss, v_scale=vss)
 
 
 def paged_decode_step(params, token: jnp.ndarray, pcache,
@@ -561,9 +600,13 @@ def paged_prefill_extend(params, tokens: jnp.ndarray, pcache,
     chunked-prefill / prefix-hit program with no gather_prefix
     materialization across layers and no scatter_suffix afterwards.
     Bit-identical to the gather formulation for the same reason
-    paged_verify_step is. length[slot] = p + lengths."""
+    paged_verify_step is. length[slot] = p + lengths. Int8 pools
+    dequantize the gathered prefix per layer and quantize the suffix
+    writes — the same codes every later gather reads."""
     del attn  # extend has no pallas kernel yet; the fused path serves.
     from skypilot_tpu.models import paging
+    from skypilot_tpu.ops import paged_attention as pa
+    quant = paging.quantized(pcache)
     b, s2 = tokens.shape
     psz = paging.page_size_of(pcache)
     pre_pos = jnp.arange(p)
@@ -581,16 +624,33 @@ def paged_prefill_extend(params, tokens: jnp.ndarray, pcache,
     impl = 'auto' if cfg.attention_impl == 'ring' else cfg.attention_impl
 
     def body(carry, xs):
-        x_c, kp_all, vp_all = carry
+        x_c, kp_all, vp_all, ks_all, vs_all = carry
         lp, layer_idx = xs
         sin_l, cos_l = llama.select_rope(sin, cos, layer_idx, cfg)
         q, k, v = _qkv(x_c, lp, cfg, sin_l, cos_l)
-        kp = jax.lax.dynamic_index_in_dim(kp_all, layer_idx, axis=0,
-                                          keepdims=False)
-        vp = jax.lax.dynamic_index_in_dim(vp_all, layer_idx, axis=0,
-                                          keepdims=False)
-        pk = kp[pre_pid, pre_off][None]                    # [1, p, ...]
-        pv = vp[pre_pid, pre_off][None]
+
+        def sel(a):
+            return jax.lax.dynamic_index_in_dim(a, layer_idx, axis=0,
+                                                keepdims=False)
+
+        kp, vp = sel(kp_all), sel(vp_all)
+        if quant:
+            ks, vs = sel(ks_all), sel(vs_all)
+            kq, ks_new = pa.quantize_values(k)
+            vq, vs_new = pa.quantize_values(v)
+            # The suffix attends its own DEQUANTIZED values — exactly
+            # what later decode gathers of these positions will read.
+            k = pa.dequantize_values(kq, ks_new, k.dtype)
+            v = pa.dequantize_values(vq, vs_new, v.dtype)
+            pk = pa.dequantize_values(kp[pre_pid, pre_off][None],
+                                      ks[pre_pid, pre_off][None],
+                                      k.dtype)
+            pv = pa.dequantize_values(vp[pre_pid, pre_off][None],
+                                      vs[pre_pid, pre_off][None],
+                                      v.dtype)
+        else:
+            pk = kp[pre_pid, pre_off][None]                # [1, p, ...]
+            pv = vp[pre_pid, pre_off][None]
         k_all = jnp.concatenate([pk.astype(k.dtype), k], axis=1)
         v_all = jnp.concatenate([pv.astype(v.dtype), v], axis=1)
         w_active = (llama.window_active(layer_idx, cfg)
@@ -602,25 +662,37 @@ def paged_prefill_extend(params, tokens: jnp.ndarray, pcache,
                          window_active=w_active,
                          sinks=(lp['sink'].astype(jnp.float32)
                                 if cfg.attn_sinks else None))
-        kp_all = jax.lax.dynamic_update_index_in_dim(
-            kp_all, kp.at[suf_pid, suf_off].set(k[0]), layer_idx,
-            axis=0)
-        vp_all = jax.lax.dynamic_update_index_in_dim(
-            vp_all, vp.at[suf_pid, suf_off].set(v[0]), layer_idx,
-            axis=0)
+
+        def put(a, new):
+            return jax.lax.dynamic_update_index_in_dim(a, new,
+                                                       layer_idx,
+                                                       axis=0)
+
+        if quant:
+            kp_all = put(kp_all, kp.at[suf_pid, suf_off].set(kq[0]))
+            vp_all = put(vp_all, vp.at[suf_pid, suf_off].set(vq[0]))
+            ks_all = put(ks_all,
+                         ks.at[suf_pid, suf_off].set(ks_new[0]))
+            vs_all = put(vs_all,
+                         vs.at[suf_pid, suf_off].set(vs_new[0]))
+        else:
+            kp_all = put(kp_all, kp.at[suf_pid, suf_off].set(k[0]))
+            vp_all = put(vp_all, vp.at[suf_pid, suf_off].set(v[0]))
         out = out.reshape(b, s2, cfg.n_heads * cfg.hd)
         x_c = x_c + _wo_project(out, lp, cfg)
         x_c = x_c + _ffn(x_c, lp, cfg)
-        return (x_c, kp_all, vp_all), None
+        return (x_c, kp_all, vp_all, ks_all, vs_all), None
 
     layer_ids = jnp.arange(cfg.n_layers, dtype=jnp.int32)
-    (x, kps, vps), _ = jax.lax.scan(
-        body, (x, pcache.k, pcache.v), (params['layers'], layer_ids))
+    (x, kps, vps, kss, vss), _ = jax.lax.scan(
+        body, (x, pcache.k, pcache.v, pcache.k_scale, pcache.v_scale),
+        (params['layers'], layer_ids))
     x_last = jnp.take_along_axis(
         x, (lengths - 1)[:, None, None].astype(jnp.int32), axis=1)
     logits = _unembed(x_last, params, cfg)
     length = pcache.length.at[slot].set(p + lengths[0])
     return logits[:, 0], dataclasses.replace(pcache, k=kps, v=vps,
+                                             k_scale=kss, v_scale=vss,
                                              length=length)
 
 

@@ -427,31 +427,44 @@ def cache_pspecs(cfg: MLAConfig) -> LatentCache:
 
 
 def init_page_pool(cfg: MLAConfig, n_pages: int, page_size: int,
-                   batch: int, max_pages: int):
+                   batch: int, max_pages: int, quant: str = 'none'):
     """Block-paged latent pool (models/paging.py): the MLA family's
     r+dr floats per token, pooled as [L, n_pages, page_size, r] /
     [L, n_pages, page_size, dr] pages — same page-table contract as
-    the dense PagedKV, ~18x less HBM per page at DeepSeek shapes."""
+    the dense PagedKV, ~18x less HBM per page at DeepSeek shapes.
+    ``quant='int8'`` (SKYTPU_ENGINE_KV_QUANT) pools int8 codes plus
+    [L, n_pages, page_size] float32 per-token scale sidecars."""
     from skypilot_tpu.models import paging
+    dt = jnp.int8 if quant == 'int8' else cfg.dtype
+
+    def scale():
+        # Distinct buffers — the step jits donate the cache tree.
+        return (jnp.zeros((cfg.n_layers, n_pages, page_size),
+                          jnp.float32) if quant == 'int8' else None)
+
     return paging.PagedLatent(
         c_kv=jnp.zeros((cfg.n_layers, n_pages, page_size,
-                        cfg.kv_lora_rank), cfg.dtype),
+                        cfg.kv_lora_rank), dt),
         k_rope=jnp.zeros((cfg.n_layers, n_pages, page_size,
-                          cfg.qk_rope_head_dim), cfg.dtype),
+                          cfg.qk_rope_head_dim), dt),
         table=jnp.zeros((batch, max_pages), jnp.int32),
-        length=jnp.zeros((batch,), jnp.int32))
+        length=jnp.zeros((batch,), jnp.int32),
+        c_scale=scale(), r_scale=scale())
 
 
-def paged_pspecs(cfg: MLAConfig):
+def paged_pspecs(cfg: MLAConfig, quant: str = 'none'):
     """PartitionSpecs mirroring init_page_pool: page axis over
     data/fsdp, the latent dim replicated over tensor (like
-    cache_pspecs); tables/lengths replicate."""
+    cache_pspecs); tables/lengths replicate; scale sidecars mirror
+    the pools minus the last axis."""
     del cfg
     from jax.sharding import PartitionSpec as P
     from skypilot_tpu.models import paging
     lat = P(None, ('data', 'fsdp'), None, None)
+    scale = P(None, ('data', 'fsdp'), None) if quant == 'int8' else None
     return paging.PagedLatent(c_kv=lat, k_rope=lat, table=P(),
-                              length=P())
+                              length=P(), c_scale=scale,
+                              r_scale=scale)
 
 
 def prefill(params, tokens: jnp.ndarray, cfg: MLAConfig, max_len: int,
@@ -606,10 +619,14 @@ def paged_verify_step(params, tokens: jnp.ndarray, pcache,
     the unchanged `_attend_latent` reduction. `attn='pallas'` routes
     here too: the Pallas kernel covers the dense K/V family only, and
     the latent family's absorbed attention serves through this fused
-    lax formulation (documented in docs/ENGINE.md)."""
+    lax formulation (documented in docs/ENGINE.md). Int8 pools
+    (c_scale/r_scale sidecars set) dequantize inside the per-layer
+    gather and quantize the written latents — the overlay attends the
+    DEQUANTIZED values, exactly what future gathers read."""
     del attn
     from skypilot_tpu.models import paging
     from skypilot_tpu.ops import paged_attention as pa
+    quant = paging.quantized(pcache)
     b, kk = tokens.shape
     length = pcache.length
     rows = jnp.arange(b)
@@ -621,36 +638,62 @@ def paged_verify_step(params, tokens: jnp.ndarray, pcache,
                                        cfg.rope_theta, cfg.rope_scaling)
 
     def body(carry, xs):
-        x_c, cp_all, krp_all = carry
+        x_c, cp_all, krp_all, cs_all, rs_all = carry
         lp, layer_idx = xs
         q_nope, q_rope, c_new, kr_new = _latents(x_c, lp, cfg, sin, cos)
-        cp = jax.lax.dynamic_index_in_dim(cp_all, layer_idx, 0, False)
-        krp = jax.lax.dynamic_index_in_dim(krp_all, layer_idx, 0, False)
-        c_l = pa.gather_pages(cp, table, max_len)
-        kr_l = pa.gather_pages(krp, table, max_len)
+
+        def sel(a):
+            return jax.lax.dynamic_index_in_dim(a, layer_idx, 0, False)
+
+        def put(a, new):
+            return jax.lax.dynamic_update_index_in_dim(a, new,
+                                                       layer_idx, 0)
+
+        cp, krp = sel(cp_all), sel(krp_all)
+        if quant:
+            cs, rs = sel(cs_all), sel(rs_all)
+            cq, cs_new = pa.quantize_values(c_new)
+            krq, rs_new = pa.quantize_values(kr_new)
+            c_new = pa.dequantize_values(cq, cs_new, c_new.dtype)
+            kr_new = pa.dequantize_values(krq, rs_new, kr_new.dtype)
+            c_l = pa.dequantize_values(
+                pa.gather_pages(cp, table, max_len),
+                pa.gather_pages(cs, table, max_len), c_new.dtype)
+            kr_l = pa.dequantize_values(
+                pa.gather_pages(krp, table, max_len),
+                pa.gather_pages(rs, table, max_len), kr_new.dtype)
+        else:
+            c_l = pa.gather_pages(cp, table, max_len)
+            kr_l = pa.gather_pages(krp, table, max_len)
         c_l = c_l.at[rows[:, None], positions].set(c_new)
         kr_l = kr_l.at[rows[:, None], positions].set(kr_new)
         out = _attend_latent(q_nope, q_rope, c_l, kr_l, lp, cfg,
                              q_offset=length)
-        cp_all = jax.lax.dynamic_update_index_in_dim(
-            cp_all, pa.write_pages(cp, c_new, pid, off), layer_idx, 0)
-        krp_all = jax.lax.dynamic_update_index_in_dim(
-            krp_all, pa.write_pages(krp, kr_new, pid, off), layer_idx,
-            0)
+        if quant:
+            cp_all = put(cp_all, pa.write_pages(cp, cq, pid, off))
+            krp_all = put(krp_all, pa.write_pages(krp, krq, pid, off))
+            cs_all = put(cs_all, pa.write_pages(cs, cs_new, pid, off))
+            rs_all = put(rs_all, pa.write_pages(rs, rs_new, pid, off))
+        else:
+            cp_all = put(cp_all, pa.write_pages(cp, c_new, pid, off))
+            krp_all = put(krp_all,
+                          pa.write_pages(krp, kr_new, pid, off))
         x_c = x_c + jnp.einsum('bsh,hd->bsd', out,
                                _d(lp['wo'], cfg.dtype))
         x_c = x_c + _ffn(x_c, lp, cfg)[0]
-        return (x_c, cp_all, krp_all), None
+        return (x_c, cp_all, krp_all, cs_all, rs_all), None
 
     layer_ids = jnp.arange(cfg.n_layers, dtype=jnp.int32)
-    (x, cps, krps), _ = jax.lax.scan(
-        body, (x, pcache.c_kv, pcache.k_rope),
+    (x, cps, krps, css, rss), _ = jax.lax.scan(
+        body, (x, pcache.c_kv, pcache.k_rope, pcache.c_scale,
+               pcache.r_scale),
         (params['layers'], layer_ids))
     x = norms.rms_norm(x, params['final_norm'], cfg.rms_eps)
     head = (params['embed'].T if cfg.tie_embeddings else params['lm_head'])
     logits = jnp.einsum('bsd,dv->bsv', x, head.astype(cfg.dtype),
                         preferred_element_type=jnp.float32)
-    return logits, dataclasses.replace(pcache, c_kv=cps, k_rope=krps)
+    return logits, dataclasses.replace(pcache, c_kv=cps, k_rope=krps,
+                                       c_scale=css, r_scale=rss)
 
 
 def paged_decode_step(params, token: jnp.ndarray, pcache,
@@ -673,9 +716,13 @@ def paged_prefill_extend(params, tokens: jnp.ndarray, pcache,
     half of decode.paged_prefill_extend: the suffix attends
     [prefix ++ suffix] latents with the prefix gathered per layer from
     the row's (possibly shared) pages, and the suffix latents land
-    straight in the row's own pages. length[slot] = p + lengths."""
+    straight in the row's own pages. length[slot] = p + lengths.
+    Int8 pools dequantize the gathered prefix latents and quantize
+    the suffix writes (decode.paged_prefill_extend's discipline)."""
     del attn
     from skypilot_tpu.models import paging
+    from skypilot_tpu.ops import paged_attention as pa
+    quant = paging.quantized(pcache)
     b, s2 = tokens.shape
     psz = paging.page_size_of(pcache)
     pre_pos = jnp.arange(p)
@@ -691,31 +738,61 @@ def paged_prefill_extend(params, tokens: jnp.ndarray, pcache,
                                        cfg.rope_theta, cfg.rope_scaling)
 
     def body(carry, xs):
-        x_c, cp_all, krp_all = carry
+        x_c, cp_all, krp_all, cs_all, rs_all = carry
         lp, layer_idx = xs
         q_nope, q_rope, c_new, kr_new = _latents(x_c, lp, cfg, sin, cos)
-        cp = jax.lax.dynamic_index_in_dim(cp_all, layer_idx, 0, False)
-        krp = jax.lax.dynamic_index_in_dim(krp_all, layer_idx, 0, False)
-        pc = cp[pre_pid, pre_off][None]                    # [1, p, r]
-        pkr = krp[pre_pid, pre_off][None]                  # [1, p, dr]
+
+        def sel(a):
+            return jax.lax.dynamic_index_in_dim(a, layer_idx, 0, False)
+
+        def put(a, new):
+            return jax.lax.dynamic_update_index_in_dim(a, new,
+                                                       layer_idx, 0)
+
+        cp, krp = sel(cp_all), sel(krp_all)
+        if quant:
+            cs, rs = sel(cs_all), sel(rs_all)
+            cq, cs_new = pa.quantize_values(c_new)
+            krq, rs_new = pa.quantize_values(kr_new)
+            # The suffix attends its own DEQUANTIZED latents — exactly
+            # what later decode gathers of these positions will read.
+            c_new = pa.dequantize_values(cq, cs_new, c_new.dtype)
+            kr_new = pa.dequantize_values(krq, rs_new, kr_new.dtype)
+            pc = pa.dequantize_values(cp[pre_pid, pre_off][None],
+                                      cs[pre_pid, pre_off][None],
+                                      c_new.dtype)
+            pkr = pa.dequantize_values(krp[pre_pid, pre_off][None],
+                                       rs[pre_pid, pre_off][None],
+                                       kr_new.dtype)
+        else:
+            pc = cp[pre_pid, pre_off][None]                # [1, p, r]
+            pkr = krp[pre_pid, pre_off][None]              # [1, p, dr]
         c_all = jnp.concatenate([pc.astype(c_new.dtype), c_new], axis=1)
         kr_all = jnp.concatenate([pkr.astype(kr_new.dtype), kr_new],
                                  axis=1)
         out = _attend_latent(q_nope, q_rope, c_all, kr_all, lp, cfg,
                              q_offset=p)
-        cp_all = jax.lax.dynamic_update_index_in_dim(
-            cp_all, cp.at[suf_pid, suf_off].set(c_new[0]), layer_idx, 0)
-        krp_all = jax.lax.dynamic_update_index_in_dim(
-            krp_all, krp.at[suf_pid, suf_off].set(kr_new[0]), layer_idx,
-            0)
+        if quant:
+            cp_all = put(cp_all, cp.at[suf_pid, suf_off].set(cq[0]))
+            krp_all = put(krp_all,
+                          krp.at[suf_pid, suf_off].set(krq[0]))
+            cs_all = put(cs_all,
+                         cs.at[suf_pid, suf_off].set(cs_new[0]))
+            rs_all = put(rs_all,
+                         rs.at[suf_pid, suf_off].set(rs_new[0]))
+        else:
+            cp_all = put(cp_all, cp.at[suf_pid, suf_off].set(c_new[0]))
+            krp_all = put(krp_all,
+                          krp.at[suf_pid, suf_off].set(kr_new[0]))
         x_c = x_c + jnp.einsum('bsh,hd->bsd', out,
                                _d(lp['wo'], cfg.dtype))
         x_c = x_c + _ffn(x_c, lp, cfg)[0]
-        return (x_c, cp_all, krp_all), None
+        return (x_c, cp_all, krp_all, cs_all, rs_all), None
 
     layer_ids = jnp.arange(cfg.n_layers, dtype=jnp.int32)
-    (x, cps, krps), _ = jax.lax.scan(
-        body, (x, pcache.c_kv, pcache.k_rope),
+    (x, cps, krps, css, rss), _ = jax.lax.scan(
+        body, (x, pcache.c_kv, pcache.k_rope, pcache.c_scale,
+               pcache.r_scale),
         (params['layers'], layer_ids))
     x_last = jnp.take_along_axis(x, (lengths - 1)[:, None, None], axis=1)
     x_last = norms.rms_norm(x_last, params['final_norm'], cfg.rms_eps)
@@ -724,7 +801,8 @@ def paged_prefill_extend(params, tokens: jnp.ndarray, pcache,
                         preferred_element_type=jnp.float32)
     length = pcache.length.at[slot].set(p + lengths[0])
     return logits[:, 0], dataclasses.replace(pcache, c_kv=cps,
-                                             k_rope=krps, length=length)
+                                             k_rope=krps, c_scale=css,
+                                             r_scale=rss, length=length)
 
 
 def decode_step(params, token: jnp.ndarray, cache: LatentCache,

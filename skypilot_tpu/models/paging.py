@@ -36,12 +36,23 @@ the contiguous per-row view only for the SKYTPU_ENGINE_ATTN=gather
 regression baseline, and the cold paths (admit's scatter_prefill,
 prefix snapshot/export gathers, disagg handoff) keep their
 gather/scatter ops — they run once per request, not per token.
+
+KV memory hierarchy (docs/ENGINE.md): the pools optionally quantize
+to int8 (SKYTPU_ENGINE_KV_QUANT=int8) with per-vector float32 scales
+in SIDECAR pools — same page geometry minus the last axis, so scales
+ride every gather/scatter/spill path with their pages. Under quant
+the cold scatter ops quantize fp inputs on the way in and
+``gather_prefix`` dequantizes on the way out; the hot in-place paths
+fuse dequant into the attention gather (ops/paged_attention.py).
+``export_pages``/``import_pages`` move EXACT page contents (codes and
+scales alike) for the host-RAM spill tier — a spilled page re-imports
+bit-identically in either representation.
 """
 from __future__ import annotations
 
 import collections
 import dataclasses
-from typing import Dict, Iterable, List, Sequence, Tuple
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -57,22 +68,31 @@ class PagedKV:
     k/v: [L, n_pages, page_size, KH, hd] — page id indexes axis 1.
     table: [B, max_pages] int32 page ids (0 = trash / unassigned).
     length: [B] int32 valid token count per slot (same contract as
-    KVCache.length)."""
+    KVCache.length).
+    k_scale/v_scale: None on the fp path; under
+    SKYTPU_ENGINE_KV_QUANT=int8 the [L, n_pages, page_size, KH]
+    float32 per-vector scale sidecars (k/v hold int8 codes)."""
     k: jnp.ndarray
     v: jnp.ndarray
     table: jnp.ndarray
     length: jnp.ndarray
+    k_scale: Optional[jnp.ndarray] = None
+    v_scale: Optional[jnp.ndarray] = None
 
 
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class PagedLatent:
     """Paged MLA latent pool (models/mla.py): c_kv [L, n_pages,
-    page_size, r], k_rope [L, n_pages, page_size, dr]."""
+    page_size, r], k_rope [L, n_pages, page_size, dr].
+    c_scale/r_scale: the int8 variant's [L, n_pages, page_size]
+    float32 scale sidecars (None on the fp path)."""
     c_kv: jnp.ndarray
     k_rope: jnp.ndarray
     table: jnp.ndarray
     length: jnp.ndarray
+    c_scale: Optional[jnp.ndarray] = None
+    r_scale: Optional[jnp.ndarray] = None
 
 
 def _pools(pcache) -> Dict[str, jnp.ndarray]:
@@ -80,6 +100,28 @@ def _pools(pcache) -> Dict[str, jnp.ndarray]:
     if isinstance(pcache, PagedKV):
         return {'k': pcache.k, 'v': pcache.v}
     return {'c_kv': pcache.c_kv, 'k_rope': pcache.k_rope}
+
+
+# Pool field -> its scale-sidecar field (the spill/export naming too).
+_SCALE_FIELD = {'k': 'k_scale', 'v': 'v_scale',
+                'c_kv': 'c_scale', 'k_rope': 'r_scale'}
+
+
+def _scale_pools(pcache) -> Optional[Dict[str, jnp.ndarray]]:
+    """The scale sidecars keyed like :func:`_pools`, or None on the fp
+    path (both sidecars are always set together — init_page_pool)."""
+    if isinstance(pcache, PagedKV):
+        if pcache.k_scale is None:
+            return None
+        return {'k': pcache.k_scale, 'v': pcache.v_scale}
+    if pcache.c_scale is None:
+        return None
+    return {'c_kv': pcache.c_scale, 'k_rope': pcache.r_scale}
+
+
+def quantized(pcache) -> bool:
+    """True when the pool holds int8 codes + scale sidecars."""
+    return _scale_pools(pcache) is not None
 
 
 def page_size_of(pcache) -> int:
@@ -109,7 +151,16 @@ def gather_view(pcache, max_len: int):
     materializes this view — only the SKYTPU_ENGINE_ATTN=gather
     regression baseline still routes steps through it (skylint's
     ``paged-view-materialization`` checker pins that no new hot-path
-    jit does)."""
+    jit does).
+
+    Quantized pools have no contiguous fp view to materialize (and the
+    engine refuses SKYTPU_ENGINE_KV_QUANT=int8 + ATTN=gather at
+    startup), so this raises rather than silently hand back int8
+    codes a contiguous program would misread as floats."""
+    if quantized(pcache):
+        raise NotImplementedError(
+            'gather_view of an int8-quantized pool: the gather '
+            'baseline serves fp pools only (SKYTPU_ENGINE_KV_QUANT)')
     table = pcache.table
 
     def g(a):
@@ -148,6 +199,10 @@ def scatter_steps(pcache, view, start: jnp.ndarray, k: int,
     the step math updated. ``active`` [B] bool: inactive rows' writes
     land on the trash page (their view slots hold garbage and their
     pages may already be freed)."""
+    if quantized(pcache):
+        raise NotImplementedError(
+            'scatter_steps into an int8-quantized pool: the gather '
+            'baseline serves fp pools only (SKYTPU_ENGINE_KV_QUANT)')
     pos = start[:, None] + jnp.arange(k)[None, :]          # [B, k]
     pid, off = _write_indices(pcache, pos, active)
     psz = page_size_of(pcache)
@@ -177,17 +232,27 @@ def scatter_prefill(pcache, rows_cache, slots: jnp.ndarray, s: int,
     """Write a grouped prefill's rows into the pool: positions [0, s)
     of each admitted row (s = the static prompt bucket) land in the
     pages its table row covers; length[slots] = lengths. The admitted
-    rows' pages were just allocated, so no trash masking is needed."""
+    rows' pages were just allocated, so no trash masking is needed.
+    Quantized pools quantize the fp rows on the way in (scales land in
+    the sidecars at the same page indices)."""
     pos = jnp.arange(s)                                    # [s]
     psz = page_size_of(pcache)
     pid = pcache.table[slots][:, pos // psz]               # [N, s]
     off = (pos % psz)[None, :]                             # [1, s]
     off = jnp.broadcast_to(off, pid.shape)
     rows_arrays = _pools_of_view(rows_cache)
+    scales = _scale_pools(pcache)
     out = {}
     for name, pool_a in _pools(pcache).items():
         tok = rows_arrays[name][:, :, :s]                  # [L, N, s, ...]
-        out[name] = pool_a.at[:, pid, off].set(tok)
+        if scales is None:
+            out[name] = pool_a.at[:, pid, off].set(tok)
+        else:
+            from skypilot_tpu.ops import paged_attention as pa
+            q, sc = pa.quantize_values(tok)
+            out[name] = pool_a.at[:, pid, off].set(q)
+            out[_SCALE_FIELD[name]] = \
+                scales[name].at[:, pid, off].set(sc)
     length = pcache.length.at[slots].set(lengths)
     return dataclasses.replace(pcache, length=length, **out)
 
@@ -198,17 +263,33 @@ def gather_prefix(pcache, slot, p: int) -> Tuple[jnp.ndarray, jnp.ndarray]:
     family's ``prefill_extend`` takes — (k, v) for PagedKV,
     (c_kv, k_rope) for PagedLatent. Zero-copy sharing rides this: a
     prefix-cache hit points its table entries at the SHARED pages and
-    gathers the same data every other holder reads."""
+    gathers the same data every other holder reads.
+
+    Quantized pools dequantize on the way out (float32 — the scale
+    precision): the pair is the family's fp ``prefill_extend``
+    contract either way. A disagg adopter re-quantizes on its own
+    scatter, so cross-replica token identity holds at
+    SKYTPU_ENGINE_KV_QUANT=none only (docs/ENGINE.md)."""
     pools = _pools(pcache)
+    scales = _scale_pools(pcache)
     if p == 0:
         a, b = pools.values()
-        za = jnp.zeros((a.shape[0], 1, 0, *a.shape[3:]), a.dtype)
-        zb = jnp.zeros((b.shape[0], 1, 0, *b.shape[3:]), b.dtype)
+        dta = jnp.float32 if scales is not None else a.dtype
+        dtb = jnp.float32 if scales is not None else b.dtype
+        za = jnp.zeros((a.shape[0], 1, 0, *a.shape[3:]), dta)
+        zb = jnp.zeros((b.shape[0], 1, 0, *b.shape[3:]), dtb)
         return za, zb
     psz = page_size_of(pcache)
     pos = jnp.arange(p)
     pid = pcache.table[slot, pos // psz]                   # [p]
     off = pos % psz
+    if scales is not None:
+        from skypilot_tpu.ops import paged_attention as pa
+        a, b = [pa.dequantize_values(
+                    arr[:, pid, off][:, None],
+                    scales[name][:, pid, off][:, None], jnp.float32)
+                for name, arr in pools.items()]
+        return a, b
     a, b = [arr[:, pid, off][:, None] for arr in pools.values()]
     return a, b
 
@@ -229,7 +310,27 @@ def adopt_rows(pcache, a: jnp.ndarray, b: jnp.ndarray, slot, s: int,
     psz = page_size_of(pcache)
     names = list(_pools(pcache))
     rows = {names[0]: a, names[1]: b}
+    scales = _scale_pools(pcache)
     out = {}
+
+    def _write(name, pool_a, tok, pid, off):
+        """One pool's scatter — fp straight in, quantized via the
+        codes + sidecar pair (the adopter re-quantizes: page contents
+        stay exact in ITS representation)."""
+        if scales is None:
+            if off is None:
+                return {name: pool_a.at[:, pid].set(tok)}
+            return {name: pool_a.at[:, pid, off].set(tok)}
+        from skypilot_tpu.ops import paged_attention as pa
+        q, sc = pa.quantize_values(tok)
+        if off is None:
+            return {name: pool_a.at[:, pid].set(q),
+                    _SCALE_FIELD[name]:
+                        scales[name].at[:, pid].set(sc)}
+        return {name: pool_a.at[:, pid, off].set(q),
+                _SCALE_FIELD[name]:
+                    scales[name].at[:, pid, off].set(sc)}
+
     if s % psz == 0:
         # Page-granular scatter: export buckets are page-aligned, so
         # whole pages land with s/psz scatter indices instead of s —
@@ -241,14 +342,14 @@ def adopt_rows(pcache, a: jnp.ndarray, b: jnp.ndarray, slot, s: int,
             tok = rows[name][:, 0, :s]                     # [L, s, ...]
             paged = tok.reshape(tok.shape[0], n, psz,
                                 *tok.shape[2:])
-            out[name] = pool_a.at[:, pid].set(paged)
+            out.update(_write(name, pool_a, paged, pid, None))
     else:
         pos = jnp.arange(s)
         pid = pcache.table[slot, pos // psz]               # [s]
         off = pos % psz
         for name, pool_a in _pools(pcache).items():
             tok = rows[name][:, 0, :s]                     # [L, s, ...]
-            out[name] = pool_a.at[:, pid, off].set(tok)
+            out.update(_write(name, pool_a, tok, pid, off))
     length = pcache.length.at[slot].set(new_len)
     return dataclasses.replace(pcache, length=length, **out)
 
@@ -263,12 +364,56 @@ def scatter_suffix(pcache, row_cache, slot, p: int, s2: int, new_len):
     pid = pcache.table[slot, pos // psz]                   # [s2]
     off = pos % psz
     row_arrays = _pools_of_view(row_cache)
+    scales = _scale_pools(pcache)
     out = {}
     for name, pool_a in _pools(pcache).items():
         tok = row_arrays[name][:, 0, p:p + s2]             # [L, s2, ...]
-        out[name] = pool_a.at[:, pid, off].set(tok)
+        if scales is None:
+            out[name] = pool_a.at[:, pid, off].set(tok)
+        else:
+            from skypilot_tpu.ops import paged_attention as pa
+            q, sc = pa.quantize_values(tok)
+            out[name] = pool_a.at[:, pid, off].set(q)
+            out[_SCALE_FIELD[name]] = \
+                scales[name].at[:, pid, off].set(sc)
     length = pcache.length.at[slot].set(new_len)
     return dataclasses.replace(pcache, length=length, **out)
+
+
+def export_pages(pcache, pids) -> Dict[str, jnp.ndarray]:
+    """EXACT contents of pages ``pids`` (int32 [n], runtime data — the
+    page-table-shape discipline), for the host-RAM spill tier: one
+    [L, n, psz, ...] array per pool field, INCLUDING the scale
+    sidecars under quantization. No dequant, no cast — spill then
+    :func:`import_pages` round-trips bit-identically in either
+    representation (fp16 pages byte-for-byte; int8 codes + float32
+    scales byte-for-byte), property-tested in
+    tests/unit_tests/test_paging.py."""
+    idx = jnp.asarray(pids, jnp.int32)
+    out = {name: a[:, idx] for name, a in _pools(pcache).items()}
+    scales = _scale_pools(pcache)
+    if scales is not None:
+        for name, a in scales.items():
+            out[_SCALE_FIELD[name]] = a[:, idx]
+    return out
+
+
+def import_pages(pcache, arrays: Dict[str, jnp.ndarray], pids):
+    """Inverse of :func:`export_pages`: land spilled page contents in
+    the (freshly allocated) device pages ``pids`` — the wake half of
+    the spill tier. Page IDS never persist across the round trip, only
+    CONTENTS: the waker reserved its own pages through its own
+    allocator, exactly the disagg adopt discipline. Tables and lengths
+    are untouched — the caller re-admits through the normal paths."""
+    idx = jnp.asarray(pids, jnp.int32)
+    out = {name: a.at[:, idx].set(arrays[name])
+           for name, a in _pools(pcache).items()}
+    scales = _scale_pools(pcache)
+    if scales is not None:
+        for name, a in scales.items():
+            out[_SCALE_FIELD[name]] = \
+                a.at[:, idx].set(arrays[_SCALE_FIELD[name]])
+    return dataclasses.replace(pcache, **out)
 
 
 class PagesExhausted(Exception):

@@ -115,6 +115,11 @@ class Saturation:
     queue_depth: float = 0.0
     in_flight: float = 0.0
     kv_pages_free: Optional[float] = None
+    # Pages parked in the replica's host-RAM spill tier (None when the
+    # tier is off): paired with kv_pages_free it separates "device
+    # pool full but sessions merely sleeping" from "genuinely out of
+    # KV capacity" — only the latter should scale the fleet.
+    kv_host_pages: Optional[float] = None
 
     def age(self, now: Optional[float] = None) -> float:
         return (time.time() if now is None else now) - self.ts
@@ -359,6 +364,14 @@ class Scraper:
                 return float(val)
             return gauge_value(metric)
 
+        host = health.get('kv_host')
+        host_pages: Optional[float] = None
+        if isinstance(host, dict) and \
+                isinstance(host.get('pages'), (int, float)):
+            host_pages = float(host['pages'])
+        if host_pages is None:
+            host_pages = gauge_value('skytpu_engine_kv_pages_spilled')
+
         return Saturation(
             entity=target.entity, url=target.url, ts=now,
             queue_depth=pick('queue_depth',
@@ -366,7 +379,8 @@ class Scraper:
             in_flight=pick('in_flight',
                            'skytpu_engine_in_flight') or 0.0,
             kv_pages_free=pick('kv_pages_free',
-                               'skytpu_engine_kv_pages_free'))
+                               'skytpu_engine_kv_pages_free'),
+            kv_host_pages=host_pages)
 
     # --------------------------------------------------------- consumers
     def _refresh_staleness(self) -> None:

@@ -93,6 +93,37 @@ def write_pages(pool_layer: jnp.ndarray, new: jnp.ndarray,
     return pool_layer.at[pid, off].set(new)
 
 
+# Guard against all-zero vectors (fresh pool pages, padding tokens):
+# a zero amax would divide by zero; QUANT_EPS keeps the scale finite
+# and the round trip exactly zero (0 / eps rounds to 0, 0 * eps = 0).
+QUANT_EPS = 1e-8
+
+
+def quantize_values(x: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Symmetric per-vector int8 quantization over the LAST axis — the
+    head dim for the dense K/V family, the latent rank for MLA. One
+    float32 scale per vector lands in the sidecar scale pool (shape =
+    value shape minus the last axis), so a page's scales travel with
+    the page through every gather/scatter/spill path. amax/127 keeps
+    the codebook symmetric (no zero-point): K/V activations are
+    zero-centered post-norm, and symmetry means dequant is one fused
+    multiply inside the attention gather. Error bound per element is
+    scale/2 — property-tested in tests/unit_tests/test_paging.py."""
+    xf = x.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax, QUANT_EPS) / 127.0
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127)
+    return q.astype(jnp.int8), scale
+
+
+def dequantize_values(q: jnp.ndarray, scale: jnp.ndarray,
+                      dtype) -> jnp.ndarray:
+    """Inverse of :func:`quantize_values`: q [..., d] int8 with scale
+    [...] float32 back to ``dtype``. The multiply happens in float32 so
+    bf16/fp16 targets round once, not twice."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
 def paged_attention_step(q: jnp.ndarray,
                          kp: jnp.ndarray,
                          vp: jnp.ndarray,
@@ -108,9 +139,9 @@ def paged_attention_step(q: jnp.ndarray,
                          logit_softcap: Optional[float] = None,
                          window: Optional[int] = None,
                          window_active=None,
-                         sinks: Optional[jnp.ndarray] = None
-                         ) -> Tuple[jnp.ndarray, jnp.ndarray,
-                                    jnp.ndarray]:
+                         sinks: Optional[jnp.ndarray] = None,
+                         k_scale: Optional[jnp.ndarray] = None,
+                         v_scale: Optional[jnp.ndarray] = None):
     """One layer of in-place paged decode/verify attention for the
     dense/GQA K/V family: q [B, S, H, hd] at per-row offsets `length`
     ([B] int32), pools kp/vp [n_pages, psz, KH, hd], the step's new
@@ -127,12 +158,23 @@ def paged_attention_step(q: jnp.ndarray,
     writes the pool first and streams page blocks through the
     table-driven kernel — TPU only; off-TPU, and whenever the kernel's
     shape/feature guard declines (softcap/window/sinks, lane-unaligned
-    head dims), it falls back to the fused formulation."""
+    head dims), it falls back to the fused formulation.
+
+    ``k_scale``/``v_scale`` [n_pages, psz, KH] select the int8 page
+    pool (SKYTPU_ENGINE_KV_QUANT=int8): kp/vp hold int8 codes, the
+    gather dequantizes in place, and the step's new K/V quantize on
+    the way in. The overlay uses the DEQUANTIZED new values — this
+    step's attention sees exactly what every future gather of these
+    positions will read, so decode is replay-consistent under
+    quantization (the fp path's bit-identity relaxes to allclose,
+    gated by the pinned quality eval — QUALITY_LAST_GOOD.json).
+    Returns a 5-tuple (out, kp', vp', k_scale', v_scale') on this
+    path; the pallas kernel declines it (fused lax serves)."""
     b, s = q.shape[0], q.shape[1]
     rows = jnp.arange(b)
     positions = length[:, None] + jnp.arange(s)            # [B, S]
-    if impl == 'pallas' and _pallas_ok(q, kp, logit_softcap, window,
-                                       sinks):
+    if impl == 'pallas' and k_scale is None and \
+            _pallas_ok(q, kp, logit_softcap, window, sinks):
         from skypilot_tpu.ops.pallas import paged_attention as pk
         kp2 = write_pages(kp, k_new, pid, off)
         vp2 = write_pages(vp, v_new, pid, off)
@@ -143,6 +185,27 @@ def paged_attention_step(q: jnp.ndarray,
         return out, kp2, vp2
     # Fused lax path (and the pallas fallback): overlay-then-attend,
     # preserving the contiguous reduction order exactly.
+    if k_scale is not None:
+        kq, ks_new = quantize_values(k_new)
+        vq, vs_new = quantize_values(v_new)
+        k_l = dequantize_values(gather_pages(kp, table, max_len),
+                                gather_pages(k_scale, table, max_len),
+                                q.dtype)
+        v_l = dequantize_values(gather_pages(vp, table, max_len),
+                                gather_pages(v_scale, table, max_len),
+                                q.dtype)
+        k_l = k_l.at[rows[:, None], positions].set(
+            dequantize_values(kq, ks_new, q.dtype))
+        v_l = v_l.at[rows[:, None], positions].set(
+            dequantize_values(vq, vs_new, q.dtype))
+        out = _attention(
+            q, k_l, v_l, impl='xla', causal=True, q_offset=length,
+            kv_offset=0, logit_softcap=logit_softcap, window=window,
+            window_active=window_active, sinks=sinks)
+        return (out, write_pages(kp, kq, pid, off),
+                write_pages(vp, vq, pid, off),
+                write_pages(k_scale, ks_new, pid, off),
+                write_pages(v_scale, vs_new, pid, off))
     k_l = gather_pages(kp, table, max_len)
     v_l = gather_pages(vp, table, max_len)
     k_l = k_l.at[rows[:, None], positions].set(k_new)

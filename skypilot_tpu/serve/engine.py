@@ -235,6 +235,39 @@ _M_CACHE_WRITTEN = metrics_lib.counter(
     '(shape-derived proxy: the new token positions plus, on the '
     'gather baseline, the materialized contiguous view)')
 
+# KV memory hierarchy (serve/host_store.py; docs/ENGINE.md): the
+# spilled gauge is the host tier's device-pages-worth of parked KV
+# (sampled at scrape from the store), the quantized gauge publishes
+# how many device pool pages hold int8 codes (pool size minus trash
+# when SKYTPU_ENGINE_KV_QUANT=int8, 0 on fp pools — an info gauge a
+# dashboard can pivot capacity math on), and the two histograms time
+# the host halves of the tier moves: spill = export + device_get +
+# framed encode, wake = decode + page alloc + scatter-in. Both run at
+# drained points only, so they bound the admission-latency cost of
+# the hierarchy directly.
+_M_KV_SPILLED = metrics_lib.gauge(
+    'skytpu_engine_kv_pages_spilled',
+    'KV pages\' worth of cache parked in the host-RAM spill tier '
+    '(SKYTPU_ENGINE_KV_HOST_MB; sampled at scrape)')
+_M_KV_QUANTIZED = metrics_lib.gauge(
+    'skytpu_engine_kv_pages_quantized',
+    'Device pool pages holding int8-quantized KV '
+    '(SKYTPU_ENGINE_KV_QUANT=int8; 0 on fp pools)')
+_M_SPILL_SECONDS = metrics_lib.histogram(
+    'skytpu_engine_spill_seconds',
+    'Host time to spill one prefix entry to the host tier (page '
+    'export + device_get + framed encode)')
+_M_WAKE_SECONDS = metrics_lib.histogram(
+    'skytpu_engine_wake_seconds',
+    'Host time to wake one spilled prefix entry (framed decode + '
+    'page alloc + scatter into fresh pages)')
+_M_KV_SESSIONS_PEAK = metrics_lib.gauge(
+    'skytpu_engine_kv_sessions_peak',
+    'Peak count of session prefix entries resident in the KV '
+    'hierarchy (device prefix store + host spill tier) since the '
+    'last reset — the concurrent-sessions capacity the KV-hierarchy '
+    'bench scores')
+
 _ENGINE_METRICS = (
     _M_STEP_SECONDS, _M_ADMIT_SECONDS, _M_HOST_SYNC_SECONDS,
     _M_QUEUE_DEPTH, _M_IN_FLIGHT, _M_STEPS, _M_TOKENS, _M_REQUESTS,
@@ -243,7 +276,8 @@ _ENGINE_METRICS = (
     _M_CLASS_TTFT, _M_CLASS_TPOT, _M_GOODPUT,
     _M_PAGES_FREE, _M_PAGES_USED, _M_PAGE_ALLOC, _M_ADMIT_WAIT,
     _M_HANDOFF, _M_HANDOFF_STAGED, _M_ATTN_BACKEND, _M_CACHE_READ,
-    _M_CACHE_WRITTEN)
+    _M_CACHE_WRITTEN, _M_KV_SPILLED, _M_KV_QUANTIZED,
+    _M_SPILL_SECONDS, _M_WAKE_SECONDS, _M_KV_SESSIONS_PEAK)
 
 
 def _seed_counter_zeros() -> None:
@@ -346,6 +380,20 @@ KV_PAGES = knobs.get_int('SKYTPU_ENGINE_KV_PAGES')
 # points, so a long prompt no longer blocks the pool for one giant
 # prefill call and short requests keep streaming. Power of two >= 16.
 PREFILL_CHUNK = knobs.get_int('SKYTPU_ENGINE_PREFILL_CHUNK')
+# --- KV memory hierarchy (serve/host_store.py; docs/ENGINE.md) -------
+# Device page representation: 'int8' stores per-vector int8 codes with
+# float32 scale sidecars (models/paging.py scale pools) — ~2x pages
+# per HBM byte; decode stays allclose to the fp path and is gated by
+# the pinned quality eval (QUALITY_LAST_GOOD.json). 'none' (default)
+# keeps the fp pools and every bit-identity gate unchanged.
+KV_QUANT = knobs.get_enum('SKYTPU_ENGINE_KV_QUANT')
+# Prefix-store entries idle this long spill to the host tier at the
+# batch loop's drained points (0 disables the idle sweep; page
+# PRESSURE still spills evictions whenever the host tier is on).
+KV_IDLE_SPILL_S = knobs.get_float('SKYTPU_ENGINE_KV_IDLE_SPILL_S')
+# Host-RAM spill tier byte budget (0 disables the tier: evicted
+# prefix entries just drop, yesterday's behavior).
+KV_HOST_MB = knobs.get_int('SKYTPU_ENGINE_KV_HOST_MB')
 # In-place paged attention backend (SKYTPU_ENGINE_ATTN, parsed and
 # validated by ops.paged_attention.backend_from_env at engine init):
 # 'fused' (default — pages indexed inside the step/verify/chunk
@@ -863,6 +911,31 @@ class InferenceEngine:
         # loudly, never silently serves the slow baseline).
         from skypilot_tpu.ops import paged_attention as pa_lib
         self.attn_backend = pa_lib.backend_from_env()
+        # KV memory hierarchy — instance attributes (tests override
+        # before warmup) validated here so a bad combination fails
+        # engine construction loudly, never serves silently degraded.
+        self.kv_quant = KV_QUANT
+        self.kv_idle_spill_s = KV_IDLE_SPILL_S
+        self.kv_host_mb = KV_HOST_MB
+        if self.kv_quant != 'none':
+            if not self.paged:
+                raise ValueError(
+                    'SKYTPU_ENGINE_KV_QUANT needs paged mode '
+                    '(SKYTPU_ENGINE_PAGED=1): the contiguous layout '
+                    'has no quantized pool variant')
+            if self.attn_backend == 'gather':
+                # The gather baseline materializes the raw pool into a
+                # contiguous view — int8 codes without their scales
+                # would silently attend garbage. The fused/pallas
+                # paths dequantize inside the step programs.
+                raise ValueError(
+                    'SKYTPU_ENGINE_KV_QUANT=int8 is incompatible with '
+                    'SKYTPU_ENGINE_ATTN=gather (the view baseline '
+                    'cannot carry the scale sidecars); use fused')
+        # Host spill tier (serve/host_store.py), (re)built by
+        # _reset_device_state — a poisoned-state reset distrusts the
+        # parked blobs along with everything else.
+        self.host_store = None
         if self.paged:
             if (self.page_size & (self.page_size - 1) or
                     PREFIX_MIN_TOKENS % self.page_size):
@@ -1059,16 +1132,28 @@ class InferenceEngine:
                                       np.int32)
             self._table_dirty = True
             self.cache = self._decode.init_page_pool(
-                self.cfg, n_pages, psz, MAX_BATCH, self._max_pages)
+                self.cfg, n_pages, psz, MAX_BATCH, self._max_pages,
+                quant=self.kv_quant)
             if self.mesh is not None:
                 from jax.sharding import NamedSharding, PartitionSpec
                 self.cache = jax.device_put(
                     self.cache,
                     jax.tree.map(
                         lambda s: NamedSharding(self.mesh, s),
-                        self._decode.paged_pspecs(self.cfg),
+                        self._decode.paged_pspecs(self.cfg,
+                                                  quant=self.kv_quant),
                         is_leaf=lambda x: isinstance(
                             x, PartitionSpec)))
+            # Host spill tier: rebuilt fresh (not cleared) each reset —
+            # a poisoned-state reset must distrust the parked blobs
+            # exactly like the prefix store's device snapshots.
+            self.host_store = None
+            if self.kv_host_mb > 0:
+                from skypilot_tpu.serve.host_store import HostPageStore
+                self.host_store = HostPageStore(self.kv_host_mb)
+            _M_KV_QUANTIZED.set(
+                n_pages - 1 if self.kv_quant == 'int8' else 0)
+            _M_KV_SPILLED.set(0)
         else:
             self.cache = self._decode.init_cache(self.cfg, MAX_BATCH,
                                                  self.max_len)
@@ -1132,7 +1217,13 @@ class InferenceEngine:
         import collections
         self._prefix_store: 'collections.OrderedDict' = \
             collections.OrderedDict()
+        # key -> last time.monotonic() the entry was captured or hit;
+        # the idle-spill sweep's clock (leader-private — followers
+        # spill via the explicit ('spill', key, fp) op).
+        self._prefix_last_used: Dict[tuple, float] = {}
         self.prefix_hits = 0
+        self._kv_sessions_peak = 0
+        _M_KV_SESSIONS_PEAK.set(0)
 
     # -- block-paged KV cache: host-side state (models/paging.py) -------
     @staticmethod
@@ -1210,9 +1301,17 @@ class InferenceEngine:
         and draw the identical page ids (FIFO free list); the admit op
         additionally carries the leader's allocator fingerprint so any
         drift fails loudly instead of corrupting KV."""
+        spills = []
         while not self.alloc.can_fit(n) and self._prefix_store:
-            _, pids = self._prefix_store.popitem(last=False)
-            self.alloc.unref_all(pids)
+            key, pids = self._prefix_store.popitem(last=False)
+            # Pressure spill: with the host tier on, the evicted
+            # entry's contents park host-side instead of dropping —
+            # same page ids freed either way, so follower replay of
+            # this deterministic loop stays in lockstep.
+            info = self._spill_entry(key, pids)
+            if info is not None:
+                spills.append(info)
+        self._journal_spill(spills)
         pids = self.alloc.alloc(n)
         _M_PAGE_ALLOC.inc(outcome='ok')
         return pids
@@ -1259,6 +1358,142 @@ class InferenceEngine:
                 self.alloc.unref_all(pids)
         else:
             self._prefix_store.clear()
+        self._prefix_last_used.clear()
+        if self.host_store is not None:
+            self.host_store.clear()
+
+    # -- KV memory hierarchy: host-RAM spill tier (host_store.py) -------
+    def _spill_entry(self, key, pids) -> Optional[Tuple[int, bool]]:
+        """Spill one prefix-store entry the caller already popped:
+        export its pages to the host tier (when on), then free the
+        device refs. Prefix pages are read-only after capture, so the
+        exported contents are frozen even while a live request still
+        shares them. Runs at drained points only (admission paths and
+        the idle sweep). ``kv.spill`` is the chaos window between
+        'entry chosen' and 'pages parked' (docs/ROBUSTNESS.md).
+        Returns (pages, stored) for the caller's _journal_spill batch —
+        spill runs journal once, never per entry inside the loop."""
+        if self.host_store is not None:
+            import jax
+            import numpy as np
+            if failpoints_lib.ACTIVE and self.warm:
+                failpoints_lib.fire('kv.spill')
+            t0 = time.perf_counter()
+            out = self._spill_jit(len(pids))(
+                self.cache, self._jnp.asarray(pids, self._jnp.int32))
+            arrays = {name: np.asarray(jax.device_get(a))
+                      for name, a in out.items()}
+            ok = self.host_store.put(key, arrays, n_pages=len(pids))
+            _M_SPILL_SECONDS.observe(time.perf_counter() - t0)
+            spilled = (len(pids), bool(ok))
+        else:
+            spilled = None
+        self.alloc.unref_all(pids)
+        self._prefix_last_used.pop(key, None)
+        return spilled
+
+    def _journal_spill(self, spills: List[Tuple[int, bool]]) -> None:
+        """One kv_spill journal event summarizing a whole spill run
+        (a pressure eviction, an LRU overflow, or one idle sweep).
+        The eviction loops accumulate (pages, stored) tuples and this
+        straight-line point writes — sqlite INSERTs stay off the
+        per-iteration path (span-discipline)."""
+        if not spills or self.host_store is None:
+            return
+        from skypilot_tpu.observe import journal as journal_lib
+        journal_lib.record_event(
+            'kv_spill', entity=f'engine/{self.model_name}',
+            data={'entries': len(spills),
+                  'pages': sum(p for p, _ in spills),
+                  'stored': sum(1 for _, ok in spills if ok),
+                  'host_pages': self.host_store.pages_spilled()})
+
+    def _spill_key(self, key) -> Optional[Tuple[int, bool]]:
+        """Spill the named prefix-store entry — the replayable half of
+        the idle sweep (multi-host followers run this for each
+        ('spill', key, fp) op; clocks are leader-private). Returns the
+        (pages, stored) tuple for the caller's _journal_spill batch."""
+        pids = self._prefix_store.pop(key, None)
+        if pids is None:
+            return None
+        return self._spill_entry(key, pids)
+
+    def _wake_prefix_entry(self, key) -> None:
+        """Re-admit a spilled entry to the device tier: fresh pages
+        from the allocator, blob contents scattered back in, entry
+        restored to the prefix store (newest — the caller is about to
+        hit it). One copy lives at a time: waking pops the host blob.
+        Deterministic given mirrored host stores, so followers replay
+        it inside the same admit op the leader ran it in. ``kv.wake``
+        fires before the device work — an injected failure propagates
+        out of the admission path into _fail_all, which resurrects the
+        interrupted request (docs/ROBUSTNESS.md)."""
+        jnp = self._jnp
+        if failpoints_lib.ACTIVE and self.warm:
+            failpoints_lib.fire('kv.wake')
+        t0 = time.perf_counter()
+        arrays = self.host_store.pop(key)
+        if arrays is None:       # raced an eviction; caller re-checks
+            return
+        n = len(key) // self.page_size
+        pids = self._alloc_pages(n)
+        # Device-side dict built once up front: its key set is fixed
+        # by the pool family, so the trace cache keys stably per n.
+        device = {name: jnp.asarray(a) for name, a in arrays.items()}
+        self.cache = self._wake_jit(n)(
+            self.cache, device, jnp.asarray(pids, jnp.int32))
+        self._prefix_store[key] = pids
+        self._prefix_last_used[key] = time.monotonic()
+        _M_WAKE_SECONDS.observe(time.perf_counter() - t0)
+        from skypilot_tpu.observe import journal as journal_lib
+        journal_lib.record_event(
+            'kv_wake', entity=f'engine/{self.model_name}',
+            data={'pages': n,
+                  'host_pages': self.host_store.pages_spilled()})
+
+    def _note_kv_residency(self) -> None:
+        """High-water mark of sessions resident in the KV hierarchy
+        (device prefix entries + host-tier entries). Called wherever a
+        new entry lands in the device store; the gauge is what the
+        fleet scrape sums into the scorecard's
+        concurrent_sessions_peak column."""
+        resident = len(self._prefix_store)
+        if self.host_store is not None:
+            resident += len(self.host_store)
+        if resident > self._kv_sessions_peak:
+            self._kv_sessions_peak = resident
+            _M_KV_SESSIONS_PEAK.set(resident)
+
+    def _sweep_due(self) -> bool:
+        """Cheap event-loop precheck for the idle sweep: True when at
+        least one prefix entry has idled past the spill threshold (the
+        batch loop pays the off-loop thread hop only then)."""
+        if (not self.paged or self.host_store is None or
+                self.kv_idle_spill_s <= 0 or not self._prefix_store):
+            return False
+        now = time.monotonic()
+        return any(now - ts >= self.kv_idle_spill_s
+                   for ts in self._prefix_last_used.values())
+
+    def _sweep_idle_prefixes(self) -> None:
+        """Leader-side idle sweep (batch-loop drained points): spill
+        prefix entries untouched for SKYTPU_ENGINE_KV_IDLE_SPILL_S.
+        Clock reads are leader-private, so each spill is broadcast as
+        an explicit ('spill', key, fp) op before execution — followers
+        replay _spill_key at the same op-stream point."""
+        if (not self.paged or self.host_store is None or
+                self.kv_idle_spill_s <= 0 or not self._prefix_store):
+            return
+        now = time.monotonic()
+        spills = []
+        for key in list(self._prefix_store):
+            ts = self._prefix_last_used.get(key)
+            if ts is not None and now - ts >= self.kv_idle_spill_s:
+                self._bcast(('spill', key, self._page_fp()))
+                info = self._spill_key(key)
+                if info is not None:
+                    spills.append(info)
+        self._journal_spill(spills)
 
     def _page_fp(self) -> Optional[tuple]:
         """Allocator fingerprint shipped with admit/chunkstart ops —
@@ -1688,6 +1923,45 @@ class InferenceEngine:
 
         self._adopt_jit = adopt_jit
 
+        # KV memory hierarchy (host_store.py): spill gathers one
+        # prefix entry's pages (all pool fields + scale sidecars) for
+        # device_get; wake scatters a decoded blob into freshly
+        # allocated pages. Both compile per page COUNT — prefix
+        # entries hold pow2-many tokens, so the shape set is
+        # log2-bounded like the bucket grid. Wake donates the cache
+        # (in-place page writes, nothing else references the buffer
+        # at a drained point).
+        def make_spill(n):
+            @jax.jit
+            def run(cache, page_ids):
+                out = paging_lib.export_pages(cache, page_ids)
+                return {name: repl(a) for name, a in out.items()}
+            return run
+
+        self._spill_jits: Dict[int, Any] = {}
+
+        def spill_jit(n):
+            if n not in self._spill_jits:
+                self._spill_jits[n] = make_spill(n)
+            return self._spill_jits[n]
+
+        self._spill_jit = spill_jit
+
+        def make_wake(n):
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def run(cache, arrays, page_ids):
+                return paging_lib.import_pages(cache, arrays, page_ids)
+            return run
+
+        self._wake_jits: Dict[int, Any] = {}
+
+        def wake_jit(n):
+            if n not in self._wake_jits:
+                self._wake_jits[n] = make_wake(n)
+            return self._wake_jits[n]
+
+        self._wake_jit = wake_jit
+
         @jax.jit
         def fix_last(last, mask, vals):
             """Re-sync the device-resident `last` with the host mirror
@@ -1817,6 +2091,18 @@ class InferenceEngine:
         _seed_counter_zeros()
         if self.paged:
             _set_attn_backend_gauge(self.attn_backend)
+            # The metric wipe above also cleared the KV-hierarchy
+            # gauges; re-seed them from live state (and zero the
+            # sessions high-water mark — warmup's synthetic prefix
+            # captures must not inflate the served peak).
+            _M_KV_QUANTIZED.set(
+                (self.alloc.n_pages - 1)
+                if self.kv_quant == 'int8' else 0)
+            _M_KV_SPILLED.set(
+                self.host_store.pages_spilled()
+                if self.host_store is not None else 0)
+            self._kv_sessions_peak = 0
+            _M_KV_SESSIONS_PEAK.set(0)
         # Warmup's synthetic admits/steps must not pollute the flight
         # ring (a /debug/flight dump should start at real traffic) or
         # leak timing sidecar entries for futures that never existed.
@@ -2202,12 +2488,17 @@ class InferenceEngine:
         exceed bucket(len) for non-power-of-two --max-len, and an
         overflow inside the admit jit would fail the whole pool), or
         None (→ full prefill)."""
-        if not self._prefix_store:
+        has_host = self.host_store is not None and len(self.host_store)
+        if not self._prefix_store and not has_host:
             return None
         p = PREFIX_MIN_TOKENS
         best = None
         while p < len(tokens):
-            if (tuple(tokens[:p]) in self._prefix_store and
+            key = tuple(tokens[:p])
+            # A spilled entry counts as a hit: _admit_with_prefix
+            # wakes it back into the device tier before extending.
+            if ((key in self._prefix_store or
+                 (has_host and key in self.host_store)) and
                     p + _bucket(len(tokens) - p) <= self.max_len):
                 best = p
             p *= 2
@@ -2228,6 +2519,7 @@ class InferenceEngine:
         key = tuple(tokens[:p])
         if key in self._prefix_store:
             self._prefix_store.move_to_end(key)
+            self._prefix_last_used[key] = time.monotonic()
             return
         if self.paged:
             # A snapshot is p/page_size REFS on the slot's prefix pages
@@ -2242,16 +2534,25 @@ class InferenceEngine:
             for pid in pids:
                 self.alloc.ref(pid)
             self._prefix_store[key] = pids
+            self._prefix_last_used[key] = time.monotonic()
         elif hasattr(self.cache, 'k'):
             self._prefix_store[key] = (self.cache.k[:, slot, :p],
                                        self.cache.v[:, slot, :p])
         else:
             self._prefix_store[key] = (self.cache.c_kv[:, slot, :p],
                                        self.cache.k_rope[:, slot, :p])
+        spills = []
         while len(self._prefix_store) > PREFIX_CACHE_ENTRIES:
-            _, old = self._prefix_store.popitem(last=False)
+            old_key, old = self._prefix_store.popitem(last=False)
             if self.paged:
-                self.alloc.unref_all(old)
+                # LRU overflow spills instead of dropping when the
+                # host tier is on — entry-count pressure is the churn
+                # profile's main spill trigger.
+                info = self._spill_entry(old_key, old)
+                if info is not None:
+                    spills.append(info)
+        self._journal_spill(spills)
+        self._note_kv_residency()
 
     @timeline.event
     def _admit_with_prefix(self, item, p: int) -> int:
@@ -2280,8 +2581,16 @@ class InferenceEngine:
             # divides PREFIX_MIN_TOKENS, so the suffix starts exactly
             # on a page boundary — a sharer can never write a shared
             # page.
+            if (key not in self._prefix_store and
+                    self.host_store is not None):
+                # Host-tier hit: wake the spilled entry back into the
+                # device tier first. A wake failure (chaos kv.wake, a
+                # corrupt blob) propagates to _fail_all, which
+                # resurrects this not-yet-sampled request.
+                self._wake_prefix_entry(key)
             shared = self._prefix_store[key]
             self._prefix_store.move_to_end(key)
+            self._prefix_last_used[key] = time.monotonic()
             need = self._pages_needed(item)
             own = self._alloc_pages(max(0, need - len(shared)))
             for pid in shared:
@@ -3248,9 +3557,18 @@ class InferenceEngine:
         # allocation + jit program construction): off-loop, so a
         # server starting its scheduler keeps answering /health.
         await asyncio.to_thread(self._ensure_state)
+        # With the idle sweep armed, the fully-idle queue wait wakes
+        # periodically so cold sessions spill even when no request
+        # arrives to create a drained point.
+        sweep_every = (min(max(self.kv_idle_spill_s, 0.05), 1.0)
+                       if self.kv_idle_spill_s > 0 else None)
         while True:
             # Drained point: no step in flight (asserted in admit).
             self._process_cancels()
+            if sweep_every is not None and self._sweep_due():
+                # Spilling is device work (page export + device_get):
+                # off-loop, like every other drained-point device op.
+                await asyncio.to_thread(self._sweep_idle_prefixes)
             busy = any(s is not None for s in self.slots)
             if not busy:
                 if self._hold:
@@ -3262,7 +3580,14 @@ class InferenceEngine:
                     if not any(s is not None for s in self.slots):
                         await asyncio.sleep(0.05)   # defensive: no spin
                 else:
-                    item = await self._queue.get()
+                    try:
+                        if sweep_every is None:
+                            item = await self._queue.get()
+                        else:
+                            item = await asyncio.wait_for(
+                                self._queue.get(), timeout=sweep_every)
+                    except asyncio.TimeoutError:
+                        continue    # loop top runs the idle sweep
                     await self._admit_pending(first_item=item)
                 self._publish()         # want==1 resolves without a step
                 continue
@@ -3751,6 +4076,12 @@ def build_app(engine: InferenceEngine):
         }
         if engine.paged and engine.alloc is not None:
             doc['kv_pages_free'] = engine.alloc.free_count
+        if engine.host_store is not None:
+            # Host spill-tier occupancy: the capacity headroom the
+            # KV-hierarchy bench (and a saturation autoscaler) reads.
+            doc['kv_host'] = engine.host_store.occupancy()
+        if engine.kv_quant != 'none':
+            doc['kv_quant'] = engine.kv_quant
         if engine.role:
             doc['role'] = engine.role
         if engine.handoff_store is not None:
@@ -3770,6 +4101,8 @@ def build_app(engine: InferenceEngine):
         if engine.paged and engine.alloc is not None:
             _M_PAGES_FREE.set(engine.alloc.free_count)
             _M_PAGES_USED.set(engine.alloc.used_count)
+        if engine.host_store is not None:
+            _M_KV_SPILLED.set(engine.host_store.pages_spilled())
         if engine.handoff_store is not None:
             _M_HANDOFF_STAGED.set(len(engine.handoff_store))
         return web.Response(text=metrics_lib.render(),

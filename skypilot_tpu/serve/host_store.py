@@ -1,0 +1,151 @@
+"""Host-RAM spill tier for the block-paged KV cache (docs/ENGINE.md,
+"KV memory hierarchy").
+
+The engine's prefix store holds page REFS — device HBM. A cold
+session's continuation state is exactly its prefix-store snapshot, so
+when a session goes idle (SKYTPU_ENGINE_KV_IDLE_SPILL_S) or page
+pressure evicts an entry, the engine exports the entry's pages
+(models/paging.py export_pages), frees the device pages immediately,
+and parks the page CONTENTS here. A later request extending the same
+prefix wakes the entry: fresh pages come from the allocator, the blob
+scatters back in (import_pages), and admission proceeds through the
+normal shared-prefix path — the 2-4x sessions-per-replica lever the
+KV-hierarchy bench measures.
+
+Wire format / integrity discipline:
+  - Entries are framed-npy blobs (utils/framed.py _encode_payload):
+    one npy block per pool field — k/v or c_kv/k_rope, plus the int8
+    scale sidecars when the pool is quantized — with a JSON meta head
+    recording the page count and a sha256 content fingerprint
+    (serve/disagg/handoff.py kv_fingerprint). decode verifies the
+    fingerprint, so a corrupted blob raises instead of waking garbage
+    KV. fp16 pools round-trip BIT-identically (property-tested).
+  - Keys are the engine's prefix-store keys (token tuples). One copy
+    of an entry lives at a time: spilling removes it from the device
+    prefix store, waking pops it from here.
+
+Budgeting: LRU by BYTES against SKYTPU_ENGINE_KV_HOST_MB (0 disables
+the tier). Eviction here is a plain drop — the entry's device pages
+were already freed at spill time, so the session just re-prefills like
+any cache miss. Thread-safety mirrors HandoffStore: every access under
+one lock; occupancy() is the /health snapshot.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.utils import framed
+
+logger = sky_logging.init_logger(__name__)
+
+
+def _kv_fingerprint(arrays: Dict[str, Any]) -> str:
+    # serve (this layer) may not import serve/disagg at module level —
+    # the content-fingerprint helper is a sanctioned runtime bridge,
+    # reached lazily like the handoff client itself.
+    from skypilot_tpu.serve.disagg import handoff as handoff_lib
+    return handoff_lib.kv_fingerprint(arrays)
+
+
+class HostPageStore:
+    """Byte-budgeted LRU of spilled KV page blobs, keyed by prefix
+    token key. All methods are thread-safe (the batch loop spills and
+    wakes from its worker threads; /health reads occupancy from the
+    event loop)."""
+
+    def __init__(self, budget_mb: int):
+        self.budget_bytes = int(budget_mb) * (1 << 20)
+        self._lock = threading.Lock()
+        # key -> (blob bytes, n_pages). Insertion order IS the LRU
+        # order (move_to_end on get-miss never happens: a hit pops).
+        self._entries: 'Dict[Tuple[int, ...], Tuple[bytes, int]]' = {}
+        self._order: List[Tuple[int, ...]] = []
+        self._bytes = 0
+        self._pages = 0
+
+    def put(self, key, arrays: Dict[str, Any], n_pages: int) -> bool:
+        """Park one spilled entry. Returns False (and stores nothing)
+        when the blob alone exceeds the whole budget; otherwise evicts
+        LRU entries until it fits. A duplicate key is refreshed — the
+        caller re-exported the same immutable pages, so last-write-wins
+        is safe."""
+        meta = {'n_pages': int(n_pages),
+                'kv_sha256': _kv_fingerprint(arrays)}
+        blob = framed._encode_payload(meta, arrays)
+        if len(blob) > self.budget_bytes:
+            return False
+        with self._lock:
+            self._pop_locked(key)
+            while self._bytes + len(blob) > self.budget_bytes:
+                old = self._order[0]
+                dropped = self._pop_locked(old)
+                assert dropped is not None
+                logger.debug(f'host tier evicted a {dropped[1]}-page '
+                             f'entry for space')
+            self._entries[key] = (blob, int(n_pages))
+            self._order.append(key)
+            self._bytes += len(blob)
+            self._pages += int(n_pages)
+        return True
+
+    def pop(self, key) -> Optional[Dict[str, Any]]:
+        """Wake: remove and decode the entry (one copy lives at a
+        time — the caller re-admits it to the device prefix store).
+        Returns the page arrays, or None on a miss. Raises
+        framed.RemoteError(kind='integrity') when the blob's content
+        fingerprint no longer matches — waking corrupted KV would
+        silently poison every sharer of the prefix."""
+        with self._lock:
+            entry = self._pop_locked(key)
+        if entry is None:
+            return None
+        meta, arrays = framed._decode_payload(entry[0])
+        got = _kv_fingerprint(arrays)
+        if got != meta.get('kv_sha256'):
+            raise framed.RemoteError(
+                'spilled KV blob failed its content fingerprint',
+                kind='integrity')
+        return arrays
+
+    def _pop_locked(self, key) -> Optional[Tuple[bytes, int]]:
+        entry = self._entries.pop(key, None)
+        if entry is None:
+            return None
+        self._order.remove(key)
+        self._bytes -= len(entry[0])
+        self._pages -= entry[1]
+        return entry
+
+    def clear(self) -> None:
+        """Drop every entry (prefix-store wipes and poisoned-state
+        resets distrust everything; re-prefill is always correct)."""
+        with self._lock:
+            self._entries.clear()
+            self._order.clear()
+            self._bytes = 0
+            self._pages = 0
+
+    def __contains__(self, key) -> bool:
+        with self._lock:
+            return key in self._entries
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def pages_spilled(self) -> int:
+        """Device pages' worth of KV currently parked here (the
+        skytpu_engine_kv_pages_spilled gauge, sampled at scrape)."""
+        with self._lock:
+            return self._pages
+
+    def occupancy(self) -> Dict[str, int]:
+        """Host-tier occupancy for /health: entry count, resident
+        bytes, page count, and the byte budget."""
+        with self._lock:
+            return {'entries': len(self._entries),
+                    'bytes': self._bytes,
+                    'pages': self._pages,
+                    'budget_bytes': self.budget_bytes}

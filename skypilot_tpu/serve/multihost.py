@@ -313,6 +313,17 @@ def follower_serve(engine, coordinator: str) -> None:
                 # same op-stream point the leader does.
                 engine._check_page_fp(op[2] if len(op) > 2 else None)
                 engine._start_chunked(op[1])
+            elif kind == 'spill':
+                # Spill one prefix entry to the host tier (KV memory
+                # hierarchy). The leader's idle sweep is CLOCK-driven
+                # (leader-private), so unlike pressure spills — which
+                # replay deterministically inside admit ops — each
+                # idle spill rides an explicit op carrying the entry
+                # key and the allocator fingerprint. The mirrored
+                # host stores then hold identical blobs, so a later
+                # wake replays deterministically inside its admit op.
+                engine._check_page_fp(op[2] if len(op) > 2 else None)
+                engine._spill_key(op[1])
             elif kind == 'chunk':
                 # Advance one prefill chunk for the named slot (the
                 # leader's round-robin choice is leader-private — the

@@ -246,6 +246,21 @@ _declare('SKYTPU_ENGINE_ATTN', 'enum', 'fused', 'engine',
          'Paged attention backend; the gang leader broadcasts its '
          'choice so followers cannot skew the program family.',
          choices=('fused', 'pallas', 'gather'))
+_declare('SKYTPU_ENGINE_KV_QUANT', 'enum', 'none', 'engine',
+         'KV page-pool representation: int8 pools per-vector codes '
+         'with float32 scale sidecars (~2x pages per HBM byte; '
+         'allclose to fp, gated by QUALITY_LAST_GOOD.json). '
+         'Incompatible with SKYTPU_ENGINE_ATTN=gather.',
+         choices=('none', 'int8'))
+_declare('SKYTPU_ENGINE_KV_IDLE_SPILL_S', 'float', 0.0, 'engine',
+         'Seconds a prefix-store snapshot may sit unused before its '
+         'pages spill to the host-RAM tier (0 disables idle spill; '
+         'pressure spill still rides eviction when the host store '
+         'is enabled).')
+_declare('SKYTPU_ENGINE_KV_HOST_MB', 'int', 0, 'engine',
+         'Host-RAM KV spill-tier budget in MiB (0 disables the '
+         'spill tier entirely; evicted prefixes are then dropped '
+         'as before).')
 
 # ---------------------------------------------------- load balancer
 _declare('SKYTPU_LB_SPAN_SAMPLE', 'float', 1.0, 'lb',
@@ -424,7 +439,8 @@ _declare('SKYTPU_ELASTIC_ROLLOUT_BACKLOG_HIGH', 'float', 0.8,
 # ---------------------------------------------------------- loadgen
 _declare('SKYTPU_BENCH_METRIC', 'str', None, 'loadgen',
          'bench.py scenario selector (decode, serve, loadgen, '
-         'train_input, rl_harvest, elastic, kernelcheck, ...).')
+         'train_input, rl_harvest, elastic, kernelcheck, quality, '
+         'kv_hierarchy, ...).')
 
 
 # =====================================================================

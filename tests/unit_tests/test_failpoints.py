@@ -207,6 +207,10 @@ class TestDiscovery:
         # mid-handoff failure arcs through these.
         assert {'handoff.send', 'handoff.recv',
                 'prefill.flush'} <= names
+        # The KV-memory-hierarchy sites (host spill tier):
+        # tests/unit_tests/test_kv_hierarchy.py proves an injected
+        # wake failure resurrects the interrupted request.
+        assert {'kv.spill', 'kv.wake'} <= names
         # The harvested-RL plane sites (train/rollout):
         # tests/chaos/test_rollout_churn.py drives worker-kill
         # containment; tests/unit_tests/test_rollout.py the rest.

@@ -169,7 +169,10 @@ class TestScraper:
             _engine_text(ttfts=[0.05, 0.2], queue_depth=3,
                          requests=2),
             health={'status': 'ok', 'queue_depth': 3, 'in_flight': 1,
-                    'kv_pages_free': 40})
+                    'kv_pages_free': 40,
+                    'kv_host': {'entries': 1, 'bytes': 1024,
+                                'pages': 7,
+                                'budget_bytes': 64 << 20}})
         rep1 = _StubReplica(
             _engine_text(ttfts=[0.7], queue_depth=5, requests=1),
             health={'status': 'ok', 'queue_depth': 5, 'in_flight': 2})
@@ -188,8 +191,11 @@ class TestScraper:
             snap = s.saturation_snapshot()
             assert snap[rep0.url].queue_depth == 3
             assert snap[rep0.url].kv_pages_free == 40
+            # Host spill-tier occupancy rides the same health doc.
+            assert snap[rep0.url].kv_host_pages == 7
             assert snap[rep1.url].in_flight == 2
             assert snap[rep1.url].kv_pages_free is None
+            assert snap[rep1.url].kv_host_pages is None
             # Fleet merge: 3 TTFT observations across both shards,
             # gauges summed.
             fams = s.fleet_families()

@@ -1,0 +1,302 @@
+"""KV memory hierarchy: the host-RAM spill tier end to end.
+
+Three layers, cheapest first:
+
+  * HostPageStore (serve/host_store.py) — byte-budgeted LRU of framed
+    page blobs: bit-identical round trips (fp AND int8+scales), LRU
+    eviction under the byte budget, oversized-blob refusal, duplicate
+    refresh, fingerprint-verified decode (a corrupted blob must raise,
+    never wake garbage KV).
+  * Allocator discipline — the spill flow unrefs the prefix store's
+    page refs exactly once; pages a live slot still shares survive the
+    spill and a later double-unref still raises (no-double-free).
+  * The live engine — spill → wake over HTTP is BIT-identical to the
+    cold path on fp pools, the wake counts as a prefix hit, the idle
+    sweep parks entries after SKYTPU_ENGINE_KV_IDLE_SPILL_S, /health
+    reports host-tier occupancy, kv_spill/kv_wake journal events land,
+    and a chaos-injected ``kv.wake`` failure RESURRECTS the in-flight
+    request (the client sees 200, never the fault).
+"""
+import asyncio
+import dataclasses
+import time
+
+import numpy as np
+import pytest
+
+from skypilot_tpu.serve.host_store import HostPageStore
+from skypilot_tpu.utils import failpoints
+from skypilot_tpu.utils import framed
+
+
+def _arrays(seed, shape=(2, 3, 8, 4), with_int8=False):
+    rng = np.random.default_rng(seed)
+    out = {'k': rng.standard_normal(shape).astype(np.float32)}
+    if with_int8:
+        out['q'] = rng.integers(-127, 128, shape, dtype=np.int8)
+        out['q_scale'] = rng.standard_normal(shape[:-1]) \
+            .astype(np.float32)
+    return out
+
+
+class TestHostPageStore:
+
+    def test_put_pop_roundtrip_bit_identical(self):
+        store = HostPageStore(budget_mb=4)
+        arrays = _arrays(0, with_int8=True)
+        assert store.put(('a',), arrays, n_pages=3)
+        assert ('a',) in store
+        back = store.pop(('a',))
+        assert set(back) == set(arrays)
+        for name, a in arrays.items():
+            assert back[name].dtype == a.dtype
+            np.testing.assert_array_equal(back[name], a)
+        # One copy lives at a time: the pop consumed it.
+        assert ('a',) not in store
+        assert store.pop(('a',)) is None
+        assert len(store) == 0
+
+    def test_lru_eviction_respects_byte_budget(self):
+        store = HostPageStore(budget_mb=1)
+        blob = _arrays(1, shape=(2, 3, 8, 2048))  # ~384 KiB each
+        keys = [('k', i) for i in range(4)]
+        for key in keys:
+            assert store.put(key, blob, n_pages=3)
+        occ = store.occupancy()
+        assert occ['bytes'] <= occ['budget_bytes']
+        # Oldest entries evicted, newest resident.
+        assert keys[0] not in store and keys[-1] in store
+        assert store.pages_spilled() == 3 * len(store)
+
+    def test_oversized_blob_refused(self):
+        store = HostPageStore(budget_mb=1)
+        huge = _arrays(2, shape=(2, 3, 8, 8192))  # > 1 MiB alone
+        assert not store.put(('big',), huge, n_pages=2)
+        assert len(store) == 0 and store.pages_spilled() == 0
+
+    def test_duplicate_key_refreshes(self):
+        store = HostPageStore(budget_mb=4)
+        store.put(('a',), _arrays(3), n_pages=2)
+        second = _arrays(4)
+        store.put(('a',), second, n_pages=5)
+        assert len(store) == 1
+        assert store.pages_spilled() == 5
+        np.testing.assert_array_equal(store.pop(('a',))['k'],
+                                      second['k'])
+
+    def test_corrupted_blob_raises_integrity_error(self):
+        store = HostPageStore(budget_mb=4)
+        store.put(('a',), _arrays(5), n_pages=1)
+        blob, n = store._entries[('a',)]
+        flipped = bytearray(blob)
+        flipped[-3] ^= 0x40            # damage the npy payload tail
+        store._entries[('a',)] = (bytes(flipped), n)
+        with pytest.raises(framed.RemoteError) as ei:
+            store.pop(('a',))
+        assert ei.value.kind == 'integrity'
+
+    def test_clear_and_occupancy(self):
+        store = HostPageStore(budget_mb=4)
+        store.put(('a',), _arrays(6), n_pages=2)
+        store.put(('b',), _arrays(7), n_pages=3)
+        occ = store.occupancy()
+        assert occ['entries'] == 2 and occ['pages'] == 5
+        assert occ['bytes'] > 0
+        store.clear()
+        assert len(store) == 0
+        assert store.occupancy() == {
+            'entries': 0, 'bytes': 0, 'pages': 0,
+            'budget_bytes': 4 << 20}
+
+
+class TestSpillRefcountDiscipline:
+    """The engine's spill flow at the allocator: the prefix store's
+    refs are returned exactly ONCE per spill; pages a live slot still
+    shares stay allocated until the slot releases them, and releasing
+    again raises (the no-double-free keystone)."""
+
+    def test_shared_prefix_spill_no_double_free(self):
+        from skypilot_tpu.models import paging
+        alloc = paging.PageAllocator(10)
+        pids = alloc.alloc(3)
+        # A live slot shares the snapshot's pages (admit-with-prefix
+        # refs them), rc=2 each.
+        for pid in pids:
+            alloc.ref(pid)
+        before = alloc.fingerprint()
+        alloc.unref_all(pids)          # the spill's single unref
+        # Still the slot's pages: nothing freed yet.
+        assert alloc.used_count == 3
+        alloc.unref_all(pids)          # the slot finishing
+        assert alloc.used_count == 0
+        assert alloc.fingerprint() != before
+        with pytest.raises(ValueError):
+            alloc.unref(pids[0])       # a third release must raise
+
+
+# ------------------------------------------------------------- engine
+
+@pytest.fixture(scope='module')
+def engine():
+    import jax.numpy as jnp
+    from skypilot_tpu.serve import engine as engine_lib
+    eng = engine_lib.InferenceEngine('llama-debug', max_len=256)
+    # fp32: the spill→wake bit-identity assertions need a stable
+    # argmax on CPU, like test_prefix_cache.
+    eng.cfg = dataclasses.replace(eng.cfg, dtype=jnp.float32)
+    eng.kv_host_mb = 64
+    eng.warmup()
+    assert eng.paged and eng.host_store is not None
+    return eng
+
+
+@pytest.fixture(autouse=True)
+def _clean(tmp_path, monkeypatch):
+    failpoints.reset()
+    monkeypatch.setenv('SKYTPU_OBSERVE_DB', str(tmp_path / 'observe.db'))
+    yield
+    failpoints.reset()
+
+
+def _run(coro, timeout=120):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(
+            asyncio.wait_for(coro, timeout=timeout))
+    finally:
+        loop.close()
+
+
+def _with_client(eng, fn, timeout=120):
+    from aiohttp.test_utils import TestClient
+    from aiohttp.test_utils import TestServer as AioTestServer
+    from skypilot_tpu.serve import engine as engine_lib
+
+    async def inner():
+        client = TestClient(AioTestServer(engine_lib.build_app(eng)))
+        await client.start_server()
+        try:
+            return await fn(client)
+        finally:
+            await client.close()
+    return _run(inner(), timeout=timeout)
+
+
+def _prompt(base, tail):
+    # 70-token shared prefix (clears the 64-token snapshot minimum)
+    # plus a distinct tail.
+    return [(i % 240) + base + 1 for i in range(70)] + tail
+
+
+class TestEngineSpillWake:
+
+    def test_spill_wake_bit_identical_and_counts_hit(self, engine):
+        """Generate (captures the prefix) → spill every entry → a
+        second request over the same prefix WAKES the host entry and
+        produces the exact cold-path tokens; /health shows the tier;
+        a kv_wake journal event lands (kv_spill is batched per spill
+        run — the idle-sweep test covers it)."""
+        import jax.numpy as jnp
+        from skypilot_tpu.models import decode
+        from skypilot_tpu.observe import journal
+        engine._clear_prefix_store()
+        prompt_a = _prompt(0, [5, 6, 7])
+        prompt_b = _prompt(0, [9, 8])
+
+        async def fn(client):
+            ra = await client.post('/generate', json={
+                'tokens': prompt_a, 'max_new_tokens': 4})
+            assert ra.status == 200
+            for key in list(engine._prefix_store):
+                engine._spill_key(key)
+            assert not engine._prefix_store
+            assert len(engine.host_store) == 1
+            spilled = engine.host_store.pages_spilled()
+            hits0 = engine.prefix_hits
+            rb = await client.post('/generate', json={
+                'tokens': prompt_b, 'max_new_tokens': 4})
+            doc = await (await client.get('/health')).json()
+            return ((await rb.json())['tokens'],
+                    engine.prefix_hits - hits0, spilled, doc)
+
+        tokens, hits, spilled, doc = _with_client(engine, fn)
+        assert hits == 1, 'a host-tier wake must count as a prefix hit'
+        assert spilled > 0
+        assert doc['kv_host']['budget_bytes'] == 64 << 20
+        # Woken and extended: the entry is back on the device tier.
+        assert len(engine.host_store) == 0
+        cold = np.asarray(decode.generate(
+            engine.params, jnp.asarray([prompt_b], jnp.int32),
+            engine.cfg, 4, max_len=engine.max_len)[0][:4])
+        np.testing.assert_array_equal(np.asarray(tokens), cold)
+        kinds = {e['kind'] for e in journal.query(since=0)}
+        assert 'kv_wake' in kinds
+        assert engine._kv_sessions_peak >= 1
+
+    def test_idle_sweep_spills_after_threshold(self, engine):
+        """SKYTPU_ENGINE_KV_IDLE_SPILL_S: entries untouched past the
+        threshold leave the device tier via the sweep; recent entries
+        stay; the sweep journals ONE batched kv_spill event for the
+        whole run (never one sqlite INSERT per entry)."""
+        from skypilot_tpu.observe import journal
+        engine._clear_prefix_store()
+
+        async def fn(client):
+            r = await client.post('/generate', json={
+                'tokens': _prompt(10, [3, 4]), 'max_new_tokens': 2})
+            assert r.status == 200
+
+        _with_client(engine, fn)
+        assert len(engine._prefix_store) == 1
+        engine.kv_idle_spill_s = 0.05
+        try:
+            assert not engine._sweep_due()   # just captured
+            time.sleep(0.1)
+            assert engine._sweep_due()
+            engine._sweep_idle_prefixes()
+        finally:
+            engine.kv_idle_spill_s = 0.0
+        assert not engine._prefix_store
+        assert len(engine.host_store) == 1
+        spill_events = [e for e in journal.query(since=0)
+                        if e['kind'] == 'kv_spill']
+        assert len(spill_events) == 1
+        assert spill_events[0]['data']['entries'] == 1
+        assert spill_events[0]['data']['stored'] == 1
+        engine._clear_prefix_store()
+
+    def test_injected_wake_failure_resurrects_request(self, engine):
+        """Chaos: an armed ``kv.wake`` failpoint fires inside the
+        admission that extends a spilled prefix. The request never
+        sampled a token, so _fail_all RESURRECTS it; the retry
+        completes and the client only ever sees 200 + the exact
+        cold-path tokens."""
+        import jax.numpy as jnp
+        from skypilot_tpu.models import decode
+        engine._clear_prefix_store()
+        prompt_a = _prompt(20, [5, 6])
+        prompt_b = _prompt(20, [7, 8])
+        before = engine.resurrected_total
+
+        async def fn(client):
+            ra = await client.post('/generate', json={
+                'tokens': prompt_a, 'max_new_tokens': 2})
+            assert ra.status == 200
+            for key in list(engine._prefix_store):
+                engine._spill_key(key)
+            assert len(engine.host_store) == 1
+            failpoints.arm('kv.wake', once=True)
+            rb = await client.post('/generate', json={
+                'tokens': prompt_b, 'max_new_tokens': 4})
+            return rb.status, await rb.json()
+
+        status, body = _with_client(engine, fn)
+        assert status == 200, body
+        assert engine.resurrected_total == before + 1
+        cold = np.asarray(decode.generate(
+            engine.params, jnp.asarray([prompt_b], jnp.int32),
+            engine.cfg, 4, max_len=engine.max_len)[0][:4])
+        np.testing.assert_array_equal(np.asarray(body['tokens']), cold)
+        # Serves normally afterwards: no leaked slots or holds.
+        assert all(s is None for s in engine.slots)
+        assert engine._hold == []

@@ -495,3 +495,125 @@ class TestFusedPagedAttention:
                 np.asarray(getattr(fused, f)))
         np.testing.assert_array_equal(np.asarray(ref.length),
                                       np.asarray(fused.length))
+
+
+class TestInt8Quantization:
+    """The int8 page-pool variant's primitives (ops/paged_attention.py
+    quantize_values/dequantize_values) and the scale-sidecar lifecycle
+    (models/paging.py): per-vector symmetric quant holds its scale/2
+    error bound, zero vectors round-trip exactly, int8 pools carry one
+    f32 scale per vector through init/export/import, and fp pools
+    never grow sidecars."""
+
+    @pytest.mark.parametrize('seed', range(4))
+    def test_roundtrip_error_bounded_by_half_scale(self, seed):
+        import jax.numpy as jnp
+        import numpy as np
+        from skypilot_tpu.ops import paged_attention as pa
+        rng = np.random.default_rng(seed)
+        # Mixed magnitudes per vector — the per-vector scale must
+        # adapt (a global scale would blow the bound on small rows).
+        mags = 10.0 ** rng.uniform(-3, 3, (6, 5, 1))
+        x = (rng.standard_normal((6, 5, 16)) * mags).astype(np.float32)
+        q, scale = pa.quantize_values(jnp.asarray(x))
+        assert q.dtype == jnp.int8
+        assert scale.dtype == jnp.float32
+        assert scale.shape == x.shape[:-1]
+        back = np.asarray(pa.dequantize_values(q, scale, jnp.float32))
+        # scale/2 per element, with a whisker of fp32 rounding slack.
+        bound = np.broadcast_to(
+            np.asarray(scale)[..., None] * (0.5 + 1e-3) + 1e-6,
+            x.shape)
+        np.testing.assert_array_less(np.abs(back - x), bound)
+
+    def test_zero_vectors_roundtrip_exactly(self):
+        import jax.numpy as jnp
+        import numpy as np
+        from skypilot_tpu.ops import paged_attention as pa
+        q, scale = pa.quantize_values(jnp.zeros((3, 8), jnp.float32))
+        assert np.all(np.asarray(q) == 0)
+        assert np.all(np.isfinite(np.asarray(scale)))
+        back = pa.dequantize_values(q, scale, jnp.float32)
+        assert np.all(np.asarray(back) == 0.0)
+
+    @staticmethod
+    def _debug_cfg(family):
+        import dataclasses
+        import jax.numpy as jnp
+        from skypilot_tpu.models import llama, mla
+        preset = (llama.PRESETS['llama-debug'] if family == 'kv'
+                  else mla.PRESETS['mla-debug'])
+        return dataclasses.replace(preset, dtype=jnp.float32)
+
+    @pytest.mark.parametrize('family', ['kv', 'latent'])
+    def test_int8_pool_carries_scale_sidecars(self, family):
+        import jax.numpy as jnp
+        from skypilot_tpu.models import decode, mla
+        mod = decode if family == 'kv' else mla
+        cfg = self._debug_cfg(family)
+        pool = mod.init_page_pool(cfg, 12, 8, 2, 4, quant='int8')
+        assert paging.quantized(pool)
+        pools = paging._pools(pool)
+        scales = paging._scale_pools(pool)
+        for name, a in pools.items():
+            assert a.dtype == jnp.int8
+            s = scales[name]
+            # One f32 scale per quantized vector: the pool shape minus
+            # its last (quantized) axis.
+            assert s.shape == a.shape[:-1]
+            assert s.dtype == jnp.float32
+
+    @pytest.mark.parametrize('family', ['kv', 'latent'])
+    def test_fp_pool_has_no_sidecars(self, family):
+        from skypilot_tpu.models import decode, mla
+        mod = decode if family == 'kv' else mla
+        cfg = self._debug_cfg(family)
+        pool = mod.init_page_pool(cfg, 12, 8, 2, 4)
+        assert not paging.quantized(pool)
+        assert paging._scale_pools(pool) is None
+
+    @pytest.mark.parametrize('family', ['kv', 'latent'])
+    @pytest.mark.parametrize('quant', ['none', 'int8'])
+    def test_export_import_roundtrip_bit_identical(self, family,
+                                                   quant):
+        """The spill tier's device halves: export_pages → (host) →
+        import_pages into fresh pages must round-trip every pool field
+        — fp values AND int8 codes + scale sidecars — bit-identically.
+        The host leg (framed blob + fingerprint) is covered in
+        test_kv_hierarchy.py."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from skypilot_tpu.models import decode, mla
+        mod = decode if family == 'kv' else mla
+        cfg = self._debug_cfg(family)
+        kwargs = {} if quant == 'none' else {'quant': 'int8'}
+        pool = mod.init_page_pool(cfg, 12, 8, 2, 4, **kwargs)
+        rng = np.random.default_rng(3)
+
+        def fill(a):
+            if a.dtype == jnp.int8:
+                return jnp.asarray(rng.integers(-127, 128, a.shape),
+                                   jnp.int8)
+            if jnp.issubdtype(a.dtype, jnp.floating):
+                return jnp.asarray(rng.standard_normal(a.shape),
+                                   a.dtype)
+            return a                      # table/length stay zeroed
+        pool = jax.tree.map(fill, pool)
+        pids = jnp.asarray([3, 7, 2], jnp.int32)
+        out = paging.export_pages(pool, pids)
+        expect = ({'k', 'v', 'k_scale', 'v_scale'}
+                  if family == 'kv' else
+                  {'c_kv', 'k_rope', 'c_scale', 'r_scale'})
+        if quant == 'none':
+            expect = {n for n in expect if not n.endswith('scale')}
+        assert set(out) == expect
+        fresh = mod.init_page_pool(cfg, 12, 8, 2, 4, **kwargs)
+        # Different destination pages — content must follow the pids
+        # mapping, not the page numbers.
+        new_pids = jnp.asarray([5, 1, 9], jnp.int32)
+        back = paging.import_pages(fresh, out, new_pids)
+        out2 = paging.export_pages(back, new_pids)
+        for name in expect:
+            np.testing.assert_array_equal(np.asarray(out[name]),
+                                          np.asarray(out2[name]))
