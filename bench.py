@@ -1168,6 +1168,46 @@ LOADGEN_LAST_GOOD_PATH = os.path.join(
     os.path.dirname(os.path.abspath(__file__)),
     'LOADGEN_LAST_GOOD.json')
 
+COST_LAST_GOOD_PATH = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)),
+    'COST_LAST_GOOD.json')
+
+# Acceptance bands for the loadgen cost columns (ISSUE 20).
+# cost_per_token is metered wall-clock dollars over generated tokens,
+# so it inherits the CPU box's throughput noise — the same 3x band
+# diff_scorecards uses for quantiles. spot_discount is a catalog
+# price RATIO (on-demand reference / metered spend) for a fleet whose
+# price-class mix the bench pins, so it gets a tight absolute band —
+# and it must stay above 1.0, the checked-in spot-vs-on-demand claim.
+COST_PER_TOKEN_FACTOR = 3.0
+SPOT_DISCOUNT_TOLERANCE = 0.05
+
+
+def _diff_cost(cur, last_good):
+    """Tolerance-band diff of this run's cost columns against
+    COST_LAST_GOOD.json (seed-only-when-absent, like every other
+    anchor)."""
+    regressions = []
+    old = (last_good.get('result') or {})
+    old_cpt, cur_cpt = (old.get('cost_per_token_usd'),
+                        cur.get('cost_per_token_usd'))
+    if old_cpt and cur_cpt and cur_cpt > old_cpt * COST_PER_TOKEN_FACTOR:
+        regressions.append(
+            f'cost_per_token_usd {cur_cpt} vs last-good {old_cpt} '
+            f'(>{COST_PER_TOKEN_FACTOR}x)')
+    old_disc, cur_disc = (old.get('spot_discount'),
+                          cur.get('spot_discount'))
+    if cur_disc is not None and cur_disc <= 1.0:
+        regressions.append(
+            f'spot_discount {cur_disc} <= 1.0 — spot metering no '
+            f'longer prices below the on-demand reference')
+    if old_disc and cur_disc and \
+            abs(cur_disc - old_disc) > SPOT_DISCOUNT_TOLERANCE:
+        regressions.append(
+            f'spot_discount {cur_disc} vs last-good {old_disc} '
+            f'(price-ratio drift > {SPOT_DISCOUNT_TOLERANCE})')
+    return {'ok': not regressions, 'regressions': regressions}
+
 
 def run_loadgen_bench():
     """SKYTPU_BENCH_METRIC=loadgen (CPU-runnable): the traffic harness
@@ -1211,6 +1251,13 @@ def run_loadgen_bench():
              '--report', report_path],
             stdout=sys.stderr, stderr=sys.stderr,
             env={**os.environ,
+                 # The stack's replicas meter as SPOT by default so
+                 # the scorecard's spot_discount column is the live
+                 # spot-vs-on-demand A/B (env still overridable for an
+                 # on-demand control run). Pricing never touches the
+                 # schedule, so the replay hash is unaffected.
+                 'SKYTPU_COST_PRICE_CLASS': os.environ.get(
+                     'SKYTPU_COST_PRICE_CLASS', 'spot'),
                  'SKYTPU_OBSERVE_DB': os.path.join(run_dir,
                                                    'observe.db')})
         if proc.returncode != 0:
@@ -1258,11 +1305,51 @@ def run_loadgen_bench():
         'routing': card.get('routing'),
         'device': device.device_kind,
     }
+    cost_totals = (card.get('cost') or {}).get('totals') or {}
+    cost_row = {
+        'cost_per_token_usd': cost_totals.get('cost_per_token_usd'),
+        'spot_discount': cost_totals.get('spot_discount'),
+        'usd': cost_totals.get('usd'),
+        'price_class': os.environ.get('SKYTPU_COST_PRICE_CLASS',
+                                      'spot'),
+    }
+    doc['cost'] = cost_row
     if diff is not None:
         doc['vs_last_good'] = diff
         if not diff['ok']:
             print(f'[bench] loadgen REGRESSION vs last good: '
                   f'{diff["regressions"]}', file=sys.stderr)
+    # The cost columns anchor separately (COST_LAST_GOOD.json): only
+    # the default smoke/mono/spot configuration is the pinned claim.
+    if (profile == 'smoke' and not disagg and
+            cost_row['price_class'] == 'spot' and
+            cost_row['cost_per_token_usd'] is not None):
+        if not os.path.exists(COST_LAST_GOOD_PATH):
+            # Seed ONLY when genuinely absent — a corrupt checked-in
+            # baseline must not be silently replaced (that would reset
+            # the regression tripwire).
+            print('[bench] no COST_LAST_GOOD.json to diff against; '
+                  'seeding it from this run', file=sys.stderr)
+            with open(COST_LAST_GOOD_PATH, 'w') as f:
+                json.dump({'measured_at': time.strftime(
+                    '%Y-%m-%dT%H:%M:%SZ', time.gmtime()),
+                    'schedule_hash': card.get('schedule_hash'),
+                    'result': cost_row}, f, indent=2, sort_keys=True)
+                f.write('\n')
+        else:
+            try:
+                with open(COST_LAST_GOOD_PATH) as f:
+                    cost_last = json.load(f)
+                cost_diff = _diff_cost(cost_row, cost_last)
+                doc['cost_vs_last_good'] = cost_diff
+                if not cost_diff['ok']:
+                    print(f'[bench] loadgen COST regression vs last '
+                          f'good: {cost_diff["regressions"]}',
+                          file=sys.stderr)
+            except (OSError, ValueError) as e:
+                print(f'[bench] COST_LAST_GOOD.json unreadable ({e}); '
+                      f'diff skipped — fix or delete the baseline',
+                      file=sys.stderr)
     print(json.dumps(doc), flush=True)
 
 

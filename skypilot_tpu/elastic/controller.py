@@ -196,11 +196,25 @@ class PoolController:
         self.target = raw
         self.pending = None
         self.last_change_ts = now
+        data = {'pool': self.spec.pool, 'old': old, 'new': raw,
+                'held_seconds': round(held, 3)}
+        if self.spec.cost_delta is not None:
+            # Projected dollar consequence of this decision (the cost
+            # meter's projection — see ElasticSpec.cost_delta). A
+            # failed projection annotates nothing; it never blocks the
+            # decision itself.
+            try:
+                delta = self.spec.cost_delta(old, raw)
+            except Exception:  # pylint: disable=broad-except
+                logger.warning(
+                    f'elastic[{self.spec.pool}]: cost projection '
+                    f'failed:', exc_info=True)
+                delta = None
+            if delta is not None:
+                data['usd_per_hour_delta'] = round(delta, 6)
         journal.record_event(
             'elastic_decision', entity=f'elastic/{self.spec.pool}',
-            reason=action.value,
-            data={'pool': self.spec.pool, 'old': old, 'new': raw,
-                  'held_seconds': round(held, 3)})
+            reason=action.value, data=data)
         hook = (self.spec.scale_up
                 if action is spec_lib.ElasticAction.SCALE_UP
                 else self.spec.scale_down)

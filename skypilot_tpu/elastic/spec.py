@@ -110,6 +110,13 @@ class ElasticSpec:
     on_fallback: Optional[Callable[[str], None]] = None
     scale_up: Optional[Callable[[int], None]] = None
     scale_down: Optional[Callable[[int], None]] = None
+    # Cost projection bridge (observe/costs.py CostMeter.projector):
+    # (old_units, new_units) -> projected $/hour delta, or None when
+    # nothing is priced yet. The controller stamps the result onto
+    # every elastic_decision journal event so each scale decision
+    # carries its dollar consequence; the price math itself stays in
+    # the cost meter.
+    cost_delta: Optional[Callable[[int, int], Optional[float]]] = None
 
     def validate(self) -> None:
         if self.pool not in POOLS:

@@ -147,6 +147,7 @@ async def _run_local(args, profile, schedule) -> Dict[str, Any]:
             'fleet_status': await stack.fleet_status(),
             'slo_events': stack.slo_events(),
             'scale_events': stack.scale_events(),
+            'cost': stack.cost_summary(),
             'stack': {'mode': 'local', 'replicas': args.local_stack,
                       'model': args.model, 'policy': args.policy,
                       'disagg': args.disagg},
@@ -257,7 +258,8 @@ def main(argv=None) -> int:
         fleet_status=evidence.get('fleet_status'),
         slo_events=evidence.get('slo_events'),
         scale_events=evidence.get('scale_events'),
-        routing=routing, stack=evidence.get('stack'))
+        routing=routing, stack=evidence.get('stack'),
+        cost=evidence.get('cost'))
     if args.report:
         report_lib.write_scorecard(doc, args.report)
         print(f'loadgen: wrote scorecard to {args.report}',

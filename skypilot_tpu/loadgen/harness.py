@@ -92,6 +92,7 @@ class LocalStack:
         self._scraper = None
         self._lb = None
         self._elastic = None
+        self._cost_meter = None
 
     # ------------------------------------------------------------ wiring
     def _engine_cmd(self, port: int,
@@ -123,6 +124,7 @@ class LocalStack:
     async def _enter(self) -> 'LocalStack':
         from aiohttp import web
 
+        from skypilot_tpu.observe import costs as costs_lib
         from skypilot_tpu.observe import scrape
         from skypilot_tpu.observe import slo as slo_lib
         from skypilot_tpu.observe import request_class
@@ -198,9 +200,17 @@ class LocalStack:
                                 fast_burn=2.0, slow_burn=1.0),
             ]
         self._slo_engine = slo_lib.SLOEngine(specs, entity='loadgen')
+        # Cost meter wired exactly as the service controller wires it:
+        # every scrape target is a metered replica (pool from the role
+        # segment), priced once from the catalog at the knob-selected
+        # price class, accrued per scrape round. Short join window — a
+        # loadgen run is seconds long.
+        self._cost_meter = costs_lib.CostMeter(entity='loadgen',
+                                               join_window=600.0)
         self._lb = lb_lib.LoadBalancer(self.policy,
                                        service_name='loadgen')
-        self._lb.attach_fleet(self._scraper, self._slo_engine)
+        self._lb.attach_fleet(self._scraper, self._slo_engine,
+                              self._cost_meter)
         if self.disagg:
             # Single-stage traffic (short prompts, control paths)
             # rides the decode pool; eligible long-prompt traffic
@@ -214,11 +224,16 @@ class LocalStack:
                     scrape.Target(f'loadgen/{role}/{i}', u)
                     for i, u in enumerate(pool_urls[role])]
             self._scraper.set_targets(targets)
+            for t in targets:
+                self._cost_meter.register(t.entity,
+                                          t.entity.split('/')[1])
         else:
             self._lb.set_ready_replicas(urls)
-            self._scraper.set_targets(
-                [scrape.Target(f'loadgen/{i}', u)
-                 for i, u in enumerate(urls)])
+            targets = [scrape.Target(f'loadgen/{i}', u)
+                       for i, u in enumerate(urls)]
+            self._scraper.set_targets(targets)
+            for t in targets:
+                self._cost_meter.register(t.entity, 'serve')
 
         lb = self._lb
 
@@ -251,13 +266,19 @@ class LocalStack:
                 pool=pool_name, signal=_queue_probe(set(members)),
                 target_per_unit=4.0, min_units=1,
                 max_units=2 * max(1, len(members)),
-                initial_units=len(members)))
+                initial_units=len(members),
+                # Every shadow decision carries its projected $/hour
+                # delta — the cost meter prices it, the journal event
+                # records it.
+                cost_delta=self._cost_meter.projector(pool_name)))
 
         def on_round(s):
             snap = s.saturation_snapshot()
             lb.set_replica_saturation(
                 {u: sat.queue_depth for u, sat in snap.items()})
             self._slo_engine.evaluate()
+            self._cost_meter.accrue()
+            self._cost_meter.evaluate()
             self._elastic.run_once()
 
         self._scrape_loop = scrape.ScrapeLoop(
@@ -341,6 +362,13 @@ class LocalStack:
         from skypilot_tpu.observe import journal
         return journal.query(kind='elastic_decision',
                              since=self.started_unix - 1.0)
+
+    def cost_summary(self) -> Dict[str, Any]:
+        """The cost meter's windowed summary over the whole run — the
+        scorecard's cost section ($/token, spot discount, per-pool
+        dollars), priced entirely through observe/costs.py."""
+        window = time.time() - self.started_unix + 5.0
+        return self._cost_meter.summary(window=window)
 
 
 # ------------------------------------------------------------- routing

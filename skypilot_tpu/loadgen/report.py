@@ -126,7 +126,8 @@ def build_scorecard(
         slo_events: Optional[List[Dict[str, Any]]] = None,
         scale_events: Optional[List[Dict[str, Any]]] = None,
         routing: Optional[Dict[str, Any]] = None,
-        stack: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        stack: Optional[Dict[str, Any]] = None,
+        cost: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
     """Merge one run's evidence planes into the scorecard doc."""
     doc: Dict[str, Any] = {
         'schema_version': SCHEMA_VERSION,
@@ -167,6 +168,13 @@ def build_scorecard(
         doc['scale_events'] = scale_events
     if routing is not None:
         doc['routing'] = routing
+    if cost is not None:
+        # The economic plane (observe/costs.py CostMeter.summary):
+        # per-pool metered dollars, the cost_per_token_usd join and
+        # spot_discount (on-demand reference over metered spend) —
+        # every number priced through the one cost code path, none
+        # computed here.
+        doc['cost'] = cost
     return doc
 
 

@@ -35,13 +35,18 @@ The FLEET plane (PR 9) builds on those five:
     pulling every replica's ``/metrics`` + ``/health`` with
     per-target failure containment;
   * :mod:`~skypilot_tpu.observe.slo` — declarative SLOs evaluated as
-    multi-window burn rates over the scraped samples.
+    multi-window burn rates over the scraped samples;
+  * :mod:`~skypilot_tpu.observe.costs` — catalog-priced replica
+    metering joined against the scraped token/request counters
+    ($/token, $/request, spot discount) with declarative CostBudget
+    burn-rate alerts — the economic axis of the same plane.
 
 See docs/OBSERVABILITY.md for the metric catalog, journal/span/sample
 schema and the trace propagation diagram.
 """
 from typing import Dict
 
+from skypilot_tpu.observe import costs
 from skypilot_tpu.observe import flight
 from skypilot_tpu.observe import journal
 from skypilot_tpu.observe import metrics
@@ -50,14 +55,14 @@ from skypilot_tpu.observe import spans
 from skypilot_tpu.observe import trace
 from skypilot_tpu.observe import tsdb
 
-__all__ = ['flight', 'gc', 'journal', 'metrics', 'promtext', 'spans',
-           'trace', 'tsdb']
+__all__ = ['costs', 'flight', 'gc', 'journal', 'metrics', 'promtext',
+           'spans', 'trace', 'tsdb']
 
 
 def gc(max_age_seconds: float = 7 * 24 * 3600,
        max_rows: int = 500_000) -> Dict[str, int]:
     """Retention for ALL journal-DB tables (events + spans + scraped
-    samples), one call — the API server's hourly GC loop and the serve
+    samples + cost accruals), one call — the API server's hourly GC loop and the serve
     controller's reconcile loop both run it, so every process that
     writes the journal also collects it (rows accrue in whichever
     process's DB the writer saw; GC only in the API server would leak
@@ -69,4 +74,6 @@ def gc(max_age_seconds: float = 7 * 24 * 3600,
             'spans': spans.gc_spans(max_age_seconds=max_age_seconds,
                                     max_rows=max_rows),
             'samples': tsdb.gc_samples(max_age_seconds=max_age_seconds,
-                                       max_rows=max_rows)}
+                                       max_rows=max_rows),
+            'costs': costs.gc_costs(max_age_seconds=max_age_seconds,
+                                    max_rows=max_rows)}

@@ -18,6 +18,12 @@ Commands:
              shared rotating writer; ``--chrome`` writes the span
              tables merged with any timeline capture as Chrome
              trace-event JSON instead (load in Perfetto)
+  cost     — the cost-attribution view (observe/costs.py): per-pool
+             metered dollars, $/token join, spot discount and budget
+             states. ``--url`` asks a live serve LB's
+             ``/-/fleet/costs``; without it the costs/tsdb tables this
+             process can see are read (``--db`` repoints,
+             ``--window`` bounds the metered window)
   fleet    — the fleet view: per-replica scrape/saturation table +
              merged fleet TTFT/TPOT p50/p95 (the shared
              promtext.histogram_quantile) + the per-class table
@@ -215,6 +221,50 @@ def _fleet_doc(url: Optional[str], db: Optional[str],
             'classes': classes, 'window_seconds': window}
 
 
+def _cost_doc(url: Optional[str], db: Optional[str],
+              window: float) -> Dict[str, Any]:
+    """The cost view as one JSON-able doc. Live (--url → a serve LB's
+    /-/fleet/costs, the attached meter's summary with its entity
+    scope and live rates) or offline (costs.window_summary over the
+    tables this process can see — metered history only; no live
+    replica rates without a meter)."""
+    if url is not None:
+        base = (url if '://' in url else f'http://{url}').rstrip('/')
+        return _http_json(base + '/-/fleet/costs')
+    if db is not None:
+        knobs.export('SKYTPU_OBSERVE_DB', db)
+    from skypilot_tpu.observe import costs
+    return costs.window_summary(window)
+
+
+def _print_cost(doc: Dict[str, Any]) -> None:
+    pools = doc.get('pools') or {}
+    if pools:
+        cols = ('pool', 'usd', 'reference_usd', 'replica_seconds',
+                'tokens', 'cost_per_token_usd')
+        rows = [{'pool': pool, **(row if isinstance(row, dict) else {})}
+                for pool, row in sorted(pools.items())]
+        present = [c for c in cols
+                   if any(r.get(c) is not None for r in rows)]
+        widths = {c: max(len(c), *(len(_cell(r.get(c)))
+                                   for r in rows))
+                  for c in present}
+        print('  '.join(c.ljust(widths[c]) for c in present))
+        for r in rows:
+            print('  '.join(_cell(r.get(c)).ljust(widths[c])
+                            for c in present))
+    else:
+        print('(no metered cost rows in the window)')
+    totals = doc.get('totals') or {}
+    if totals:
+        print('totals: ' + '  '.join(
+            f'{k}={_cell(v)}' for k, v in sorted(totals.items())))
+    budgets = doc.get('budgets') or {}
+    for name, row in sorted(budgets.items()):
+        print(f'budget {name}: ' + '  '.join(
+            f'{k}={_cell(v)}' for k, v in sorted(row.items())))
+
+
 def _cell(value: Any) -> str:
     """One class-table cell: None (no samples for this class yet)
     renders as '-', floats round-trip compactly."""
@@ -338,6 +388,20 @@ def build_parser() -> argparse.ArgumentParser:
                          help='quantile window in seconds for the '
                               'offline (tsdb) path')
     p_fleet.add_argument('--json', action='store_true')
+
+    p_cost = sub.add_parser(
+        'cost', help='per-pool metered dollars + $/token joins + '
+                     'budget states')
+    p_cost.add_argument('--url', default=None,
+                        help='a live serve LB (host:port or URL); '
+                             'fetches /-/fleet/costs')
+    p_cost.add_argument('--db', default=None,
+                        help='read this observe DB instead of the '
+                             'default local one (no --url)')
+    p_cost.add_argument('--window', type=float, default=3600.0,
+                        help='metered window in seconds for the '
+                             'offline path')
+    p_cost.add_argument('--json', action='store_true')
     return parser
 
 
@@ -376,6 +440,17 @@ def main(argv=None) -> int:
             print(json.dumps(doc, indent=2))
         else:
             _print_fleet(doc)
+    elif args.cmd == 'cost':
+        try:
+            doc = _cost_doc(args.url, args.db, args.window)
+        except (OSError, ValueError) as e:
+            print(f'observe: could not fetch cost view: {e}',
+                  file=sys.stderr)
+            return 2
+        if args.json:
+            print(json.dumps(doc, indent=2))
+        else:
+            _print_cost(doc)
     elif args.cmd == 'export':
         if args.chrome:
             # chrome_trace filters by trace id only — refuse the other

@@ -1,0 +1,847 @@
+"""Cost attribution: metered dollars from catalog pricing to per-token
+joins — the economic axis of the fleet plane.
+
+Every other signal the observe plane tracks (latency, goodput, burn
+rates) already flows scrape → tsdb → SLO; dollars were the one axis
+living in an ad-hoc helper. This module is the single place price math
+is allowed to happen:
+
+  * a :class:`CostMeter` prices every pool's runtime from the catalog
+    layer (``catalog.get_hourly_cost``, per replica, keyed by slice
+    topology and price class spot|on_demand). The price is resolved
+    ONCE per replica lifetime and journaled as a ``cost_price`` event
+    — later catalog drift cannot rewrite a run's history;
+  * each scrape round, :meth:`CostMeter.accrue` turns wall-clock since
+    the last round into metered replica-seconds and dollars, persisted
+    into a ``costs`` table in the journal DB (same write contract as
+    tsdb samples: best-effort, one transaction per round, retention
+    via :func:`gc_costs` wired into the shared ``observe.gc()``);
+  * the metered dollars JOIN against the already-scraped
+    ``skytpu_engine_tokens_total`` / goodput counters to derive
+    ``skytpu_cost_usd_total{pool,price_class}``,
+    ``skytpu_cost_per_token_usd{pool}`` and
+    ``skytpu_cost_per_request_usd{cls}`` gauges;
+  * declarative :class:`CostBudget` specs (``SKYTPU_COST_BUDGETS``
+    JSON, refused loudly when malformed) evaluate per round with
+    fast/slow burn-rate windows and ``cost_budget_ok|warning|breach``
+    journal events — observe/slo.py's multi-window hysteresis idiom
+    applied to spend rate instead of error fraction: burn = measured
+    $/hour over the window divided by the budgeted $/hour.
+
+Alongside the reference rate each replica also resolves its ON-DEMAND
+price once: the accrual rows carry both, so ``spot_discount`` (what
+the same replica-seconds would have cost on-demand ÷ what they did
+cost) is a first-class, journal-backed column rather than a separate
+pricing run — the loadgen scorecard's spot-vs-on-demand A/B.
+
+Entity scoping follows the journal: cost rows key on the replica's
+journal entity (``<svc>/<rid>`` or ``<svc>/<role>/<rid>``), and every
+reader takes the same ``entity_scope`` predicate the scoped LB
+endpoints use — a shared observe DB must not leak one service's spend
+into another's ``/-/fleet/costs``.
+
+The catalog import is function-level on purpose: observe (layer 3)
+sits below catalog (layer 4); pricing is a sanctioned runtime bridge,
+not a module-level dependency.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import sqlite3
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from skypilot_tpu import sky_logging
+from skypilot_tpu.utils import knobs
+from skypilot_tpu.utils import sqlite_utils
+
+from skypilot_tpu.observe import journal
+from skypilot_tpu.observe import metrics as metrics_lib
+from skypilot_tpu.observe import promtext
+from skypilot_tpu.observe import request_class
+from skypilot_tpu.observe import tsdb
+
+logger = sky_logging.init_logger(__name__)
+
+# Closed metric-label vocabularies (the breaker-state precedent): one
+# value per priceable pool. Superset of elastic/spec.py POOLS (which
+# observe must not import — layering) plus the rollout plane's stable
+# learner; test_costs pins the subset relation so the two cannot
+# silently drift.
+POOLS: Tuple[str, ...] = ('serve', 'prefill', 'decode', 'data_workers',
+                          'rollout', 'learner')
+PRICE_CLASSES: Tuple[str, ...] = ('on_demand', 'spot')
+# Budget scope label: a budget covers one pool or the whole fleet.
+BUDGET_POOLS: Tuple[str, ...] = POOLS + ('fleet',)
+STATES = ('ok', 'warning', 'breach')
+_STATE_CODE = {'ok': 0, 'warning': 1, 'breach': 2}
+
+TOKENS_FAMILY = 'skytpu_engine_tokens_total'
+GOODPUT_FAMILY = 'skytpu_engine_goodput_total'
+# Per-class decode-token proxy: the class TPOT histogram observes one
+# sample per decoded token beyond the first, so its _count delta is
+# the closest per-class token share the fleet plane records.
+CLASS_TOKENS_FAMILY = 'skytpu_engine_class_tpot_seconds_count'
+
+_M_USD_TOTAL = metrics_lib.gauge(
+    'skytpu_cost_usd_total',
+    'Metered dollars accrued by this process\'s cost meter since '
+    'start, per pool and price class.',
+    labels={'pool': POOLS, 'price_class': PRICE_CLASSES})
+_M_PER_TOKEN = metrics_lib.gauge(
+    'skytpu_cost_per_token_usd',
+    'Windowed $/generated-token per pool: metered dollars over the '
+    'join window divided by the fleet token-counter delta.',
+    labels={'pool': POOLS})
+_M_PER_REQUEST = metrics_lib.gauge(
+    'skytpu_cost_per_request_usd',
+    'Windowed $/finished-request per request class (dollars '
+    'apportioned by each class\'s decode-token share).',
+    labels={'cls': request_class.CLASSES})
+_M_BURN = metrics_lib.gauge(
+    'skytpu_cost_burn_rate',
+    'Cost-budget burn rate per budget pool and window (1.0 = spending '
+    'exactly the budgeted $/hour).',
+    labels={'pool': BUDGET_POOLS, 'window': ('fast', 'slow')})
+_M_STATE = metrics_lib.gauge(
+    'skytpu_cost_budget_state',
+    'Cost-budget state per budget pool: 0 ok, 1 warning, 2 breach.',
+    labels={'pool': BUDGET_POOLS})
+
+
+# --------------------------------------------------------------- pricing
+
+def hourly_rate(accelerator: str, price_class: str) -> float:
+    """$/hour for one replica of ``accelerator`` at ``price_class`` —
+    THE price resolution every consumer (serve meter, rollout harness,
+    elastic projections, scorecards) goes through. Lazy catalog import:
+    observe sits below catalog in the layer order."""
+    if price_class not in PRICE_CLASSES:
+        raise ValueError(f'unknown price class {price_class!r}; '
+                         f'valid: {PRICE_CLASSES}')
+    from skypilot_tpu import catalog
+    from skypilot_tpu.tpu import topology
+    tpu_slice = topology.parse_tpu_accelerator(accelerator)
+    return catalog.get_hourly_cost(tpu_slice,
+                                   use_spot=price_class == 'spot')
+
+
+def default_accelerator() -> str:
+    return knobs.get_str('SKYTPU_COST_ACCELERATOR')
+
+
+def default_price_class() -> str:
+    return knobs.get_enum('SKYTPU_COST_PRICE_CLASS')
+
+
+# ------------------------------------------------------------- the table
+
+_local = threading.local()
+
+
+def _conn() -> sqlite3.Connection:
+    path = journal.db_path()
+    cached = getattr(_local, 'conn', None)
+    if cached is not None and getattr(_local, 'path', None) == path:
+        return cached
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    conn = sqlite_utils.connect_wal(path)
+    conn.execute("""
+        CREATE TABLE IF NOT EXISTS costs (
+            cost_id INTEGER PRIMARY KEY AUTOINCREMENT,
+            ts REAL,
+            entity TEXT,
+            pool TEXT,
+            price_class TEXT,
+            hourly_usd REAL,
+            seconds REAL,
+            usd REAL,
+            reference_usd REAL
+        )""")
+    conn.execute('CREATE INDEX IF NOT EXISTS idx_costs_ts '
+                 'ON costs (ts)')
+    conn.execute('CREATE INDEX IF NOT EXISTS idx_costs_entity '
+                 'ON costs (entity, ts)')
+    conn.commit()
+    _local.conn = conn
+    _local.path = path
+    return conn
+
+
+def insert_costs(rows: List[Tuple[float, str, str, str, float, float,
+                                  float, float]]) -> int:
+    """One accrual round's rows ``(ts, entity, pool, price_class,
+    hourly_usd, seconds, usd, reference_usd)`` in ONE transaction
+    (all-or-nothing per round, like a tsdb scrape round). Best-effort:
+    a failed persist must never wedge the scrape loop."""
+    if not rows:
+        return 0
+    try:
+        conn = _conn()
+        with conn:
+            conn.executemany(
+                'INSERT INTO costs (ts, entity, pool, price_class, '
+                'hourly_usd, seconds, usd, reference_usd) '
+                'VALUES (?, ?, ?, ?, ?, ?, ?, ?)', rows)
+        return len(rows)
+    except (sqlite3.Error, OSError):
+        return 0
+
+
+def window_spend(window: float, now: Optional[float] = None,
+                 entity_scope: Optional[str] = None
+                 ) -> Dict[Tuple[str, str], Dict[str, float]]:
+    """Aggregated spend inside the window, grouped per (pool,
+    price_class): ``{'usd', 'reference_usd', 'seconds'}``. The
+    ``entity_scope`` predicate is journal.entity_scope_clause — the
+    same escaped-LIKE security boundary the scoped LB endpoints use.
+    Best-effort ({} on failure)."""
+    now = time.time() if now is None else now
+    clauses = ['ts > ?', 'ts <= ?']
+    params: List[Any] = [now - window, now]
+    if entity_scope is not None:
+        clause, scope_params = journal.entity_scope_clause(entity_scope)
+        clauses.append(clause)
+        params.extend(scope_params)
+    sql = ('SELECT pool, price_class, SUM(usd), SUM(reference_usd), '
+           'SUM(seconds) FROM costs WHERE ' + ' AND '.join(clauses) +
+           ' GROUP BY pool, price_class')
+    try:
+        with _conn() as conn:
+            rows = conn.execute(sql, params).fetchall()
+    except (sqlite3.Error, OSError):
+        return {}
+    return {(pool, pc): {'usd': usd or 0.0,
+                         'reference_usd': ref or 0.0,
+                         'seconds': secs or 0.0}
+            for pool, pc, usd, ref, secs in rows}
+
+
+def gc_costs(max_age_seconds: float = 7 * 24 * 3600,
+             max_rows: int = 500_000) -> int:
+    """Retention, same discipline as tsdb.gc_samples: age window plus
+    a row cap keyed on the Nth-NEWEST row id (never max-id arithmetic
+    — AUTOINCREMENT ids go sparse after age deletes). Long-lived
+    controllers accrue one row per replica per scrape round; without
+    this the costs table leaks forever."""
+    try:
+        conn = _conn()
+        with sqlite_utils.immediate(conn):
+            cur = conn.execute('DELETE FROM costs WHERE ts < ?',
+                               (time.time() - max_age_seconds,))
+            deleted = cur.rowcount
+            row = conn.execute(
+                'SELECT cost_id FROM costs '
+                'ORDER BY cost_id DESC LIMIT 1 OFFSET ?',
+                (max_rows,)).fetchone()
+            if row is not None:
+                cur = conn.execute(
+                    'DELETE FROM costs WHERE cost_id <= ?', (row[0],))
+                deleted += cur.rowcount
+        return max(0, deleted)
+    except (sqlite3.Error, OSError):
+        return 0
+
+
+# -------------------------------------------------------------- budgets
+
+@dataclasses.dataclass
+class CostBudget:
+    """One spend objective: ``hourly_usd`` is the budgeted $/hour for
+    ``pool`` ('fleet' = every metered pool). Burn over a window is the
+    measured spend rate divided by the budget — 1.0 means spending
+    exactly the budgeted dollars; a FAST window catches a runaway
+    scale-up, a SLOW window confirms it is sustained (a breach
+    requires BOTH, exactly the SLO engine's multi-window recipe)."""
+    hourly_usd: float
+    pool: str = 'fleet'
+    name: str = ''
+    fast_window: float = 300.0
+    slow_window: float = 3600.0
+    fast_burn: float = 2.0
+    slow_burn: float = 1.2
+    clear_rounds: int = 3
+
+    def __post_init__(self) -> None:
+        if self.pool not in BUDGET_POOLS:
+            raise ValueError(f'unknown budget pool {self.pool!r}; '
+                             f'valid: {BUDGET_POOLS}')
+        if not self.hourly_usd > 0.0:
+            raise ValueError('hourly_usd must be > 0 — a zero budget '
+                             'makes every metered second a breach')
+        if not self.name:
+            self.name = f'cost_{self.pool}'
+
+
+def default_budgets() -> List[CostBudget]:
+    """Budgets from ``SKYTPU_COST_BUDGETS`` — a JSON list of
+    :class:`CostBudget` kwargs dicts (docs/OBSERVABILITY.md "Cost
+    attribution" shows the format). Malformed raises at startup: a
+    silently-dropped budget is unmonitored spend. No stock budgets —
+    unlike latency objectives, a dollar ceiling is deployment policy
+    with no sane universal default."""
+    cfg = knobs.get_json('SKYTPU_COST_BUDGETS')
+    if cfg is None:
+        return []
+    try:
+        if not isinstance(cfg, list):
+            raise ValueError('expected a JSON list')
+        return [CostBudget(**item) for item in cfg]
+    except (ValueError, TypeError) as e:
+        raise ValueError(
+            f'SKYTPU_COST_BUDGETS is malformed ({e}); expected a JSON '
+            f'list of cost budget objects, e.g. '
+            f'[{{"pool": "serve", "hourly_usd": 40.0}}]') from e
+
+
+@dataclasses.dataclass
+class BudgetEvaluation:
+    budget: CostBudget
+    state: str
+    burn_fast: Optional[float]
+    burn_slow: Optional[float]
+    rate_usd_per_hour: Optional[float] = None   # slow-window spend rate
+    transitioned: bool = False
+
+
+# ------------------------------------------------------------ the meter
+
+@dataclasses.dataclass
+class _Replica:
+    entity: str
+    pool: str
+    accelerator: str
+    price_class: str
+    hourly_usd: float        # resolved ONCE, at registration
+    reference_usd: float     # the on-demand rate, resolved at the same
+    last_accrued: float      # instant — drift-proof like hourly_usd
+
+
+class CostMeter:
+    """Prices a fleet's runtime and joins it against its traffic.
+
+    Owned like the SLO engine: the service controller (or the loadgen
+    LocalStack) constructs one per service, registers/deregisters
+    replicas as the routable set changes, and calls ``accrue()`` +
+    ``evaluate()`` from the scrape loop's ``on_round`` hook. ``entity``
+    scopes journal events, cost rows and tsdb joins to the owning
+    service — the shared-DB reality that made /-/lb/events scoped."""
+
+    def __init__(self, entity: Optional[str] = None,
+                 budgets: Optional[List[CostBudget]] = None,
+                 join_window: Optional[float] = None):
+        self.entity = entity
+        self.budgets = (list(budgets) if budgets is not None
+                        else default_budgets())
+        names = [b.name for b in self.budgets]
+        if len(set(names)) != len(names):
+            raise ValueError(f'duplicate cost budget names: {names}')
+        self.join_window = (knobs.get_float('SKYTPU_COST_JOIN_WINDOW')
+                            if join_window is None else join_window)
+        self._replicas: Dict[str, _Replica] = {}
+        self._totals: Dict[Tuple[str, str], float] = {}
+        self._reference_totals: Dict[str, float] = {}   # per pool
+        self._state: Dict[str, str] = {b.name: 'ok'
+                                       for b in self.budgets}
+        self._clean_rounds: Dict[str, int] = {b.name: 0
+                                              for b in self.budgets}
+        self._last_evals: List[BudgetEvaluation] = []
+        self._publish_states()
+
+    # -------------------------------------------------- registration
+    def register(self, entity: str, pool: str, *,
+                 accelerator: Optional[str] = None,
+                 price_class: Optional[str] = None,
+                 now: Optional[float] = None) -> None:
+        """Start metering one replica. The price resolves HERE, once,
+        and rides a ``cost_price`` journal event — the run's pricing
+        history survives later catalog edits. Idempotent for an
+        unchanged (accelerator, price_class); a changed price class
+        (spot replica replaced by on-demand) closes the old meter at
+        ``now`` and opens a fresh one, so a mid-window flip accrues
+        each side at its own rate."""
+        if pool not in POOLS:
+            raise ValueError(f'unknown cost pool {pool!r}; '
+                             f'valid: {POOLS}')
+        now = time.time() if now is None else now
+        accelerator = accelerator or default_accelerator()
+        price_class = price_class or default_price_class()
+        current = self._replicas.get(entity)
+        if current is not None:
+            if (current.accelerator == accelerator and
+                    current.price_class == price_class and
+                    current.pool == pool):
+                return
+            self.deregister(entity, now=now)
+        rate = hourly_rate(accelerator, price_class)
+        reference = (rate if price_class == 'on_demand'
+                     else hourly_rate(accelerator, 'on_demand'))
+        self._replicas[entity] = _Replica(
+            entity=entity, pool=pool, accelerator=accelerator,
+            price_class=price_class, hourly_usd=rate,
+            reference_usd=reference, last_accrued=now)
+        journal.record_event(
+            'cost_price', entity=entity,
+            reason=f'{accelerator}@{price_class}',
+            data={'pool': pool, 'accelerator': accelerator,
+                  'price_class': price_class, 'hourly_usd': rate,
+                  'reference_hourly_usd': reference})
+
+    def deregister(self, entity: str,
+                   now: Optional[float] = None) -> None:
+        """Final accrual up to ``now``, then stop metering."""
+        replica = self._replicas.pop(entity, None)
+        if replica is None:
+            return
+        now = time.time() if now is None else now
+        self._accrue_rows([replica], now)
+
+    def replicas(self) -> Dict[str, str]:
+        """{entity: price_class} of currently metered replicas."""
+        return {e: r.price_class for e, r in self._replicas.items()}
+
+    # ------------------------------------------------------- accrual
+    def _accrue_rows(self, replicas: List[_Replica],
+                     now: float) -> None:
+        rows = []
+        for r in replicas:
+            dt = now - r.last_accrued
+            if dt <= 0:
+                continue
+            usd = r.hourly_usd * dt / 3600.0
+            ref = r.reference_usd * dt / 3600.0
+            rows.append((now, r.entity, r.pool, r.price_class,
+                         r.hourly_usd, dt, usd, ref))
+            r.last_accrued = now
+            key = (r.pool, r.price_class)
+            self._totals[key] = self._totals.get(key, 0.0) + usd
+            self._reference_totals[r.pool] = (
+                self._reference_totals.get(r.pool, 0.0) + ref)
+        insert_costs(rows)
+
+    def charge(self, entity: str, seconds: float,
+               now: Optional[float] = None) -> float:
+        """Manual accrual of measured busy-seconds for a registered
+        replica (the rollout harness's path — it meters compute time,
+        not wall-clock between scrape rounds). Returns the dollars
+        charged."""
+        replica = self._replicas[entity]
+        now = time.time() if now is None else now
+        usd = replica.hourly_usd * seconds / 3600.0
+        ref = replica.reference_usd * seconds / 3600.0
+        insert_costs([(now, replica.entity, replica.pool,
+                       replica.price_class, replica.hourly_usd,
+                       seconds, usd, ref)])
+        key = (replica.pool, replica.price_class)
+        self._totals[key] = self._totals.get(key, 0.0) + usd
+        self._reference_totals[replica.pool] = (
+            self._reference_totals.get(replica.pool, 0.0) + ref)
+        return usd
+
+    def accrue(self, now: Optional[float] = None) -> int:
+        """One metering round (scrape-loop thread): wall-clock since
+        each replica's last accrual becomes replica-seconds and
+        dollars, persisted and folded into the gauges; then the
+        token/request joins republish. Returns the number of metered
+        replicas."""
+        now = time.time() if now is None else now
+        live = list(self._replicas.values())
+        self._accrue_rows(live, now)
+        for (pool, price_class), usd in self._totals.items():
+            _M_USD_TOTAL.set(usd, pool=pool, price_class=price_class)
+        try:
+            self._publish_joins(now)
+        except Exception:  # pylint: disable=broad-except
+            # The joins read tsdb (shared sqlite) — a failed join must
+            # not kill the metering itself; dollars stay accrued.
+            logger.warning('cost join publish failed:', exc_info=True)
+        return len(live)
+
+    # --------------------------------------------------------- joins
+    def _scoped_targets(self, now: float, window: float) -> List[str]:
+        if self.entity is None:
+            return tsdb.targets(since=now - window)
+        prefix = f'{self.entity}/'
+        return [t for t in tsdb.targets(since=now - window)
+                if t == self.entity or t.startswith(prefix)]
+
+    def _target_pool(self, target: str) -> str:
+        """A scrape target's cost pool from its entity shape:
+        ``<svc>/<role>/<rid>`` carries its pool in the role segment
+        (the disagg tagging convention); anything else is the
+        monolithic serve pool."""
+        parts = target.split('/')
+        if len(parts) >= 3 and parts[-2] in POOLS:
+            return parts[-2]
+        return 'serve'
+
+    def _publish_joins(self, now: float) -> None:
+        window = self.join_window
+        spend = window_spend(window, now, entity_scope=self.entity)
+        usd_by_pool: Dict[str, float] = {}
+        for (pool, _), agg in spend.items():
+            usd_by_pool[pool] = usd_by_pool.get(pool, 0.0) + agg['usd']
+        total_usd = sum(usd_by_pool.values())
+        tokens_by_pool: Dict[str, float] = {}
+        class_tokens: Dict[str, float] = {}
+        class_requests: Dict[str, float] = {}
+        for target in self._scoped_targets(now, window):
+            pool = self._target_pool(target)
+            tokens_by_pool[pool] = (tokens_by_pool.get(pool, 0.0) +
+                                    _counter_window_sum(
+                                        TOKENS_FAMILY, target, window,
+                                        now))
+            for cls in request_class.CLASSES:
+                cls_labels = promtext.labels_text((('cls', cls),))
+                class_tokens[cls] = (
+                    class_tokens.get(cls, 0.0) +
+                    _counter_window_sum(CLASS_TOKENS_FAMILY, target,
+                                        window, now,
+                                        labels=cls_labels))
+                for outcome in ('good', 'slow'):
+                    key = promtext.labels_text(
+                        (('cls', cls), ('outcome', outcome)))
+                    class_requests[cls] = (
+                        class_requests.get(cls, 0.0) +
+                        _counter_window_sum(GOODPUT_FAMILY, target,
+                                            window, now, labels=key))
+        for pool, usd in usd_by_pool.items():
+            tokens = tokens_by_pool.get(pool, 0.0)
+            if tokens > 0:
+                _M_PER_TOKEN.set(usd / tokens, pool=pool)
+        # Per-request cost: apportion the window's dollars by each
+        # class's decode-token share (its actual compute draw), then
+        # divide by its finished requests. With no per-class token
+        # data yet, fall back to request share — uniform per request,
+        # honest about what IS known.
+        token_total = sum(class_tokens.values())
+        request_total = sum(class_requests.values())
+        for cls in request_class.CLASSES:
+            finished = class_requests.get(cls, 0.0)
+            if finished <= 0 or total_usd <= 0:
+                continue
+            if token_total > 0:
+                share = class_tokens.get(cls, 0.0) / token_total
+            elif request_total > 0:
+                share = finished / request_total
+            else:
+                continue
+            _M_PER_REQUEST.set(total_usd * share / finished, cls=cls)
+
+    # ------------------------------------------------------ budgets
+    def _pool_rates(self, budget: CostBudget, now: float
+                    ) -> Tuple[Optional[float], Optional[float]]:
+        """(fast, slow) spend rates in $/hour for one budget's scope.
+        None with no cost rows in the window — no data must HOLD the
+        state (the meter may simply not have accrued yet), never read
+        as zero spend."""
+        out: List[Optional[float]] = []
+        for window in (budget.fast_window, budget.slow_window):
+            spend = window_spend(window, now, entity_scope=self.entity)
+            rows = [agg for (pool, _), agg in spend.items()
+                    if budget.pool == 'fleet' or pool == budget.pool]
+            if not rows:
+                out.append(None)
+                continue
+            usd = sum(agg['usd'] for agg in rows)
+            out.append(usd / window * 3600.0)
+        return out[0], out[1]
+
+    @staticmethod
+    def _target_state(budget: CostBudget, burn_fast: Optional[float],
+                      burn_slow: Optional[float]) -> Optional[str]:
+        if burn_fast is None and burn_slow is None:
+            return None
+        bf = burn_fast or 0.0
+        bs = burn_slow or 0.0
+        if bf >= budget.fast_burn and bs >= budget.slow_burn:
+            return 'breach'
+        if bf >= budget.fast_burn or bs >= 1.0:
+            return 'warning'
+        return 'ok'
+
+    def evaluate(self, now: Optional[float] = None
+                 ) -> List[BudgetEvaluation]:
+        """One budget round (scrape-loop thread, after accrue()):
+        escalation immediate, de-escalation after ``clear_rounds``
+        consecutive cleaner rounds — a spend rate hovering at the
+        threshold cannot strobe ok/breach."""
+        now = time.time() if now is None else now
+        out: List[BudgetEvaluation] = []
+        burn_by_pool: Dict[Tuple[str, str], float] = {}
+        for budget in self.budgets:
+            try:
+                rate_fast, rate_slow = self._pool_rates(budget, now)
+            except Exception:  # pylint: disable=broad-except
+                # Per-budget containment, the SLO engine's idiom: one
+                # budget's read blowing up must not kill the others.
+                logger.warning(
+                    f'cost budget {budget.name!r} evaluation failed; '
+                    f'holding state {self._state[budget.name]!r}:',
+                    exc_info=True)
+                out.append(BudgetEvaluation(
+                    budget=budget, state=self._state[budget.name],
+                    burn_fast=None, burn_slow=None))
+                continue
+            burn_fast = (None if rate_fast is None
+                         else rate_fast / budget.hourly_usd)
+            burn_slow = (None if rate_slow is None
+                         else rate_slow / budget.hourly_usd)
+            for window, burn in (('fast', burn_fast),
+                                 ('slow', burn_slow)):
+                if burn is None:
+                    continue       # no data is NOT a zero burn
+                key = (budget.pool, window)
+                burn_by_pool[key] = max(burn_by_pool.get(key, 0.0),
+                                        burn)
+            target = self._target_state(budget, burn_fast, burn_slow)
+            current = self._state[budget.name]
+            transitioned = False
+            if target is not None and target != current:
+                if _STATE_CODE[target] > _STATE_CODE[current]:
+                    transitioned = self._transition(
+                        budget, current, target, burn_fast, burn_slow,
+                        rate_slow)
+                else:
+                    self._clean_rounds[budget.name] += 1
+                    if self._clean_rounds[budget.name] >= \
+                            budget.clear_rounds:
+                        transitioned = self._transition(
+                            budget, current, target, burn_fast,
+                            burn_slow, rate_slow)
+            else:
+                self._clean_rounds[budget.name] = 0
+            out.append(BudgetEvaluation(
+                budget=budget, state=self._state[budget.name],
+                burn_fast=burn_fast, burn_slow=burn_slow,
+                rate_usd_per_hour=rate_slow,
+                transitioned=transitioned))
+        for (pool, window), burn in burn_by_pool.items():
+            _M_BURN.set(burn, pool=pool, window=window)
+        self._publish_states()
+        self._last_evals = out
+        return out
+
+    def _transition(self, budget: CostBudget, old: str, new: str,
+                    burn_fast: Optional[float],
+                    burn_slow: Optional[float],
+                    rate_slow: Optional[float]) -> bool:
+        self._state[budget.name] = new
+        self._clean_rounds[budget.name] = 0
+        logger.warning(f'Cost budget {budget.name!r}: {old} -> {new} '
+                       f'(burn fast={burn_fast}, slow={burn_slow})')
+        journal.record_event(
+            f'cost_budget_{new}', entity=self.entity,
+            reason=f'{old}->{new}',
+            data={'budget': budget.name, 'pool': budget.pool,
+                  'hourly_usd': budget.hourly_usd,
+                  'burn_fast': burn_fast, 'burn_slow': burn_slow,
+                  'rate_usd_per_hour': rate_slow})
+        return True
+
+    def _publish_states(self) -> None:
+        per_pool: Dict[str, int] = {}
+        for budget in self.budgets:
+            code = _STATE_CODE[self._state[budget.name]]
+            per_pool[budget.pool] = max(per_pool.get(budget.pool, 0),
+                                        code)
+        for pool, code in per_pool.items():
+            _M_STATE.set(code, pool=pool)
+
+    def states(self) -> Dict[str, str]:
+        return dict(self._state)
+
+    def budget_summary(self) -> Dict[str, Dict[str, Any]]:
+        """Per-budget snapshot of the last evaluate() round (the
+        /-/fleet/costs budget rows). Empty before the first round."""
+        out: Dict[str, Dict[str, Any]] = {}
+        for ev in self._last_evals:
+            out[ev.budget.name] = {
+                'pool': ev.budget.pool,
+                'hourly_usd': ev.budget.hourly_usd,
+                'state': ev.state,
+                'burn_fast': ev.burn_fast,
+                'burn_slow': ev.burn_slow,
+                'rate_usd_per_hour': ev.rate_usd_per_hour,
+            }
+        return out
+
+    # ---------------------------------------------------- projections
+    def pool_hourly_usd(self, pool: str) -> Optional[float]:
+        """Current metered $/hour of one pool's live replicas (None
+        when nothing is registered there)."""
+        rates = [r.hourly_usd for r in self._replicas.values()
+                 if r.pool == pool]
+        return sum(rates) if rates else None
+
+    def projector(self, pool: str
+                  ) -> Callable[[int, int], Optional[float]]:
+        """A ``(old_units, new_units) -> projected $/hour delta``
+        closure for the elastic controller's decision journal — the
+        price math stays HERE, the controller only carries the
+        number. Projects at the pool's mean per-replica rate; None
+        before the first replica registers (nothing to price from)."""
+        def project(old: int, new: int) -> Optional[float]:
+            rates = [r.hourly_usd for r in self._replicas.values()
+                     if r.pool == pool]
+            if not rates:
+                return None
+            return (new - old) * (sum(rates) / len(rates))
+        return project
+
+    # ------------------------------------------------------- summary
+    def summary(self, window: Optional[float] = None,
+                now: Optional[float] = None) -> Dict[str, Any]:
+        """One JSON-able doc merging the metered window, the live
+        rates, the joins and the budget states — the /-/fleet/costs
+        body and the scorecard's cost section."""
+        now = time.time() if now is None else now
+        window = self.join_window if window is None else window
+        doc = window_summary(window, now=now, entity_scope=self.entity)
+        doc['entity'] = self.entity
+        live: Dict[str, Any] = {}
+        for r in self._replicas.values():
+            row = live.setdefault(r.pool, {'replicas': 0,
+                                           'hourly_usd': 0.0,
+                                           'price_classes': set()})
+            row['replicas'] += 1
+            row['hourly_usd'] = round(row['hourly_usd'] +
+                                      r.hourly_usd, 6)
+            row['price_classes'].add(r.price_class)
+        for row in live.values():
+            row['price_classes'] = sorted(row['price_classes'])
+        doc['live'] = live
+        doc['budgets'] = self.budget_summary()
+        return doc
+
+
+# ------------------------------------------------------- offline reads
+
+def _counter_window_sum(name: str, target: str, window: float,
+                        now: float,
+                        labels: Optional[str] = None) -> float:
+    """One target's windowed counter delta, summed across label sets
+    (or restricted to one canonical ``labels`` rendering). The
+    counter-restart rule is slo.py's: a negative delta means the
+    replica relaunched inside the window, and the latest ABSOLUTE
+    value is the honest lower bound."""
+    latest = tsdb.latest_round(name, target)
+    if not latest:
+        return 0.0
+    anchor = tsdb.round_at_or_before(name, target, now - window)
+    total = 0.0
+    for labels_key, (_, value) in latest.items():
+        if labels is not None and labels_key != labels:
+            continue
+        prev = anchor.get(labels_key, (0.0, 0.0))[1]
+        total += value - prev if value >= prev else value
+    return total
+
+
+def window_summary(window: float, now: Optional[float] = None,
+                   entity_scope: Optional[str] = None
+                   ) -> Dict[str, Any]:
+    """The metered window from the DB alone — no meter object needed
+    (the ``observe cost --db`` offline path and the live summary's
+    shared core): per-pool dollars/seconds/per-token joins, totals and
+    the spot discount. ``entity_scope`` restricts a shared DB to one
+    service's subtree."""
+    now = time.time() if now is None else now
+    spend = window_spend(window, now, entity_scope=entity_scope)
+    pools: Dict[str, Dict[str, Any]] = {}
+    for (pool, price_class), agg in spend.items():
+        row = pools.setdefault(pool, {'usd': 0.0, 'reference_usd': 0.0,
+                                      'replica_seconds': 0.0,
+                                      'by_price_class': {}})
+        row['usd'] += agg['usd']
+        row['reference_usd'] += agg['reference_usd']
+        row['replica_seconds'] += agg['seconds']
+        row['by_price_class'][price_class] = round(agg['usd'], 9)
+    # Token joins per pool over the same window and scope.
+    targets = tsdb.targets(since=now - window)
+    if entity_scope is not None:
+        prefix = f'{entity_scope}/'
+        targets = [t for t in targets
+                   if t == entity_scope or t.startswith(prefix)]
+    tokens_by_pool: Dict[str, float] = {}
+    requests = 0.0
+    for target in targets:
+        parts = target.split('/')
+        pool = (parts[-2] if len(parts) >= 3 and parts[-2] in POOLS
+                else 'serve')
+        tokens_by_pool[pool] = (tokens_by_pool.get(pool, 0.0) +
+                                _counter_window_sum(
+                                    TOKENS_FAMILY, target, window,
+                                    now))
+        requests += _counter_window_sum('skytpu_engine_requests_total',
+                                        target, window, now)
+    total_usd = 0.0
+    total_ref = 0.0
+    total_tokens = 0.0
+    for pool, row in pools.items():
+        tokens = tokens_by_pool.get(pool, 0.0)
+        row['tokens'] = tokens
+        if tokens > 0:
+            row['cost_per_token_usd'] = round(row['usd'] / tokens, 12)
+        total_usd += row['usd']
+        total_ref += row['reference_usd']
+        total_tokens += tokens
+        row['usd'] = round(row['usd'], 9)
+        row['reference_usd'] = round(row['reference_usd'], 9)
+        row['replica_seconds'] = round(row['replica_seconds'], 3)
+    totals: Dict[str, Any] = {
+        'usd': round(total_usd, 9),
+        'reference_usd': round(total_ref, 9),
+    }
+    if total_tokens > 0 and total_usd > 0:
+        totals['cost_per_token_usd'] = round(total_usd / total_tokens,
+                                             12)
+    if requests > 0 and total_usd > 0:
+        totals['cost_per_request_usd'] = round(total_usd / requests,
+                                               12)
+    if total_usd > 0:
+        # What the same replica-seconds would have cost on-demand,
+        # over what they did cost: the spot discount (1.0 when every
+        # replica already runs on-demand).
+        totals['spot_discount'] = round(total_ref / total_usd, 4)
+    return {'window_seconds': window, 'pools': pools,
+            'totals': totals}
+
+
+# --------------------------------------------------- rollout cost path
+
+def cost_per_sample(samples: int, learner_busy_s: float,
+                    worker_busy_s: float, *,
+                    accelerator: str = 'v5litepod-8',
+                    workers_spot: bool = True) -> Dict[str, Any]:
+    """$/sample for a rollout run: stable learner at on-demand price,
+    rollout fleet at spot (harvested) or on-demand (control) — the
+    rollout harness's historical contract (key set, rounding and all:
+    RL_HARVEST_LAST_GOOD.json pins the numbers), re-priced through the
+    one CostMeter code path instead of its own catalog math."""
+    meter = CostMeter(entity='rollout_cost', budgets=[])
+    meter.register('rollout_cost/learner', 'learner',
+                   accelerator=accelerator, price_class='on_demand')
+    meter.register(
+        'rollout_cost/workers', 'rollout', accelerator=accelerator,
+        price_class='spot' if workers_spot else 'on_demand')
+    learner_rate = meter._replicas[  # pylint: disable=protected-access
+        'rollout_cost/learner'].hourly_usd
+    worker_rate = meter._replicas[  # pylint: disable=protected-access
+        'rollout_cost/workers'].hourly_usd
+    learner_cost = meter.charge('rollout_cost/learner', learner_busy_s)
+    worker_cost = meter.charge('rollout_cost/workers', worker_busy_s)
+    total = learner_cost + worker_cost
+    return {
+        'accelerator': accelerator,
+        'workers_spot': workers_spot,
+        'learner_hourly_usd': learner_rate,
+        'worker_hourly_usd': worker_rate,
+        'learner_cost_usd': round(learner_cost, 6),
+        'worker_cost_usd': round(worker_cost, 6),
+        'total_cost_usd': round(total, 6),
+        'cost_per_sample_usd': (round(total / samples, 9)
+                                if samples else None),
+    }

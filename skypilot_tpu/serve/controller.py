@@ -18,6 +18,7 @@ from aiohttp import web
 
 from skypilot_tpu import sky_logging
 from skypilot_tpu import task as task_lib
+from skypilot_tpu.observe import costs as costs_lib
 from skypilot_tpu.observe import scrape as scrape_lib
 from skypilot_tpu.observe import slo as slo_lib
 from skypilot_tpu.serve import autoscalers as autoscaler_lib
@@ -83,6 +84,7 @@ class ServiceController:
         # to scrape.
         self.scraper = None
         self.slo_engine = None
+        self.cost_meter = None
         self.scrape_loop = None
         if not self.spec.pool:
             self.scraper = scrape_lib.Scraper()
@@ -100,9 +102,15 @@ class ServiceController:
                                     threshold_seconds=1.0),
                 ]
             self.slo_engine = slo_lib.SLOEngine(specs, entity=self.name)
+            # Cost attribution rides the same scrape cadence: the
+            # meter registers/deregisters with the routable set and
+            # accrues + evaluates budgets each round, entity-scoped to
+            # this service like the SLO engine.
+            self.cost_meter = costs_lib.CostMeter(entity=self.name)
             self.scrape_loop = scrape_lib.ScrapeLoop(
                 self.scraper, on_round=self._on_scrape_round)
-            self.lb.attach_fleet(self.scraper, self.slo_engine)
+            self.lb.attach_fleet(self.scraper, self.slo_engine,
+                                 self.cost_meter)
         self._stop = threading.Event()
 
     def _load_from_record(self, record) -> None:
@@ -179,6 +187,9 @@ class ServiceController:
             self.autoscaler.observe_saturation(depths)
         if self.slo_engine is not None:
             self.slo_engine.evaluate()
+        if self.cost_meter is not None:
+            self.cost_meter.accrue()
+            self.cost_meter.evaluate()
 
     def _sync_scrape_targets(self, id_urls) -> None:
         """Reconcile-thread hook: the scrape target set IS the
@@ -187,9 +198,35 @@ class ServiceController:
         (<service>/<replica_id>)."""
         if self.scraper is None:
             return
-        self.scraper.set_targets([
+        self._set_fleet_targets([
             scrape_lib.Target(entity=f'{self.name}/{rid}', url=url)
             for rid, url in id_urls])
+
+    def _set_fleet_targets(self, targets) -> None:
+        """One routable-set hand-off for BOTH fleet consumers: the
+        scraper's target set and the cost meter's metered-replica set
+        stay the same snapshot (a replica the LB can route must be
+        both scraped and billed). The meter prices each entity's pool
+        from its role segment; register() is idempotent and a dropped
+        entity gets its final accrual on deregister."""
+        self.scraper.set_targets(targets)
+        if self.cost_meter is None:
+            return
+        try:
+            live = {t.entity for t in targets}
+            for entity in list(self.cost_meter.replicas()):
+                if entity not in live:
+                    self.cost_meter.deregister(entity)
+            for t in targets:
+                parts = t.entity.split('/')
+                pool = (parts[-2] if len(parts) >= 3 and
+                        parts[-2] in costs_lib.POOLS else 'serve')
+                self.cost_meter.register(t.entity, pool)
+        except Exception:  # pylint: disable=broad-except
+            # Pricing must never take down reconciliation — the next
+            # pass retries registration from the same snapshot.
+            logger.warning('cost meter target sync failed:\n' +
+                           traceback.format_exc())
 
     def _maybe_gc_observe(self) -> None:
         """Hourly events+spans retention in the controller process —
@@ -204,7 +241,8 @@ class ServiceController:
         pruned = observe.gc()
         if any(pruned.values()):
             logger.info(f'observe GC: pruned {pruned["events"]} '
-                        f'event(s), {pruned["spans"]} span(s)')
+                        f'event(s), {pruned["spans"]} span(s), '
+                        f'{pruned["costs"]} cost row(s)')
 
     def _reconcile_loop(self) -> None:
         serve_state.set_service_status(self.name,
@@ -279,7 +317,7 @@ class ServiceController:
                     self.lb.policy.set_replica_weights(
                         self.managers['decode'].ready_url_weights(ready))
                     if self.scraper is not None:
-                        self.scraper.set_targets(targets)
+                        self._set_fleet_targets(targets)
                 else:
                     # ONE routable-set snapshot per pass: LB targets,
                     # capacity weights and scrape targets all derive

@@ -305,16 +305,19 @@ class LoadBalancer:
         # scraper).
         self._scraper = None
         self._slo_engine = None
+        self._cost_meter = None
         # Disaggregated pools (serve/disagg): set by the controller
         # when the service declares prefill/decode pools. None = every
         # request routes single-stage over the _ready set.
         self._pools: Optional[lb_policies.PoolRouter] = None
 
-    def attach_fleet(self, scraper, slo_engine=None) -> None:
+    def attach_fleet(self, scraper, slo_engine=None,
+                     cost_meter=None) -> None:
         """Give the /-/fleet/ endpoints their data sources (the
-        controller's Scraper and SLOEngine)."""
+        controller's Scraper, SLOEngine and CostMeter)."""
         self._scraper = scraper
         self._slo_engine = slo_engine
+        self._cost_meter = cost_meter
 
     def set_replica_saturation(self,
                                queue_depths: Dict[str, float]) -> None:
@@ -1110,6 +1113,19 @@ class LoadBalancer:
         doc['classes'] = await asyncio.to_thread(self._class_table)
         return web.json_response(doc)
 
+    async def _fleet_costs(self, request: web.Request) -> web.Response:
+        """The cost meter's windowed summary (observe/costs.py):
+        per-pool dollars, $/token joins, spot discount and budget
+        states. The meter is constructed with this service's entity
+        scope, so a shared observe DB never leaks another service's
+        spend here — the same boundary /-/lb/events enforces."""
+        del request
+        if self._cost_meter is None:
+            return web.json_response(
+                {'error': 'no cost meter attached'}, status=503)
+        doc = await asyncio.to_thread(self._cost_meter.summary)
+        return web.json_response(doc)
+
     # ------------------------------------------------------------------
     def build_app(self) -> web.Application:
         app = web.Application()
@@ -1119,6 +1135,7 @@ class LoadBalancer:
         app.router.add_get('/-/lb/trace/{trace_id}', self._trace)
         app.router.add_get('/-/fleet/metrics', self._fleet_metrics)
         app.router.add_get('/-/fleet/status', self._fleet_status)
+        app.router.add_get('/-/fleet/costs', self._fleet_costs)
         app.router.add_route('*', '/{tail:.*}', self._proxy)
 
         async def _cleanup(app_):

@@ -280,25 +280,13 @@ def cost_per_sample(samples: int, learner_busy_s: float,
                     accelerator: str = 'v5litepod-8',
                     workers_spot: bool = True) -> Dict[str, Any]:
     """$/sample for a run: stable learner at on-demand price, rollout
-    fleet at spot (harvested) or on-demand (control) — prices from the
-    catalog layer, compute time from the measured run."""
-    from skypilot_tpu import catalog
-    from skypilot_tpu.tpu import topology
-    tpu_slice = topology.parse_tpu_accelerator(accelerator)
-    learner_rate = catalog.get_hourly_cost(tpu_slice, use_spot=False)
-    worker_rate = catalog.get_hourly_cost(tpu_slice,
-                                          use_spot=workers_spot)
-    learner_cost = learner_rate * learner_busy_s / 3600.0
-    worker_cost = worker_rate * worker_busy_s / 3600.0
-    total = learner_cost + worker_cost
-    return {
-        'accelerator': accelerator,
-        'workers_spot': workers_spot,
-        'learner_hourly_usd': learner_rate,
-        'worker_hourly_usd': worker_rate,
-        'learner_cost_usd': round(learner_cost, 6),
-        'worker_cost_usd': round(worker_cost, 6),
-        'total_cost_usd': round(total, 6),
-        'cost_per_sample_usd': (round(total / samples, 9)
-                                if samples else None),
-    }
+    fleet at spot (harvested) or on-demand (control). A thin delegate
+    since the cost-attribution plane landed: every price resolution
+    and accrual goes through observe/costs.py's CostMeter — rollout
+    and serve bill from ONE code path (RL_HARVEST_LAST_GOOD.json pins
+    the key set, rates and rounding this must keep reproducing)."""
+    from skypilot_tpu.observe import costs
+    return costs.cost_per_sample(samples, learner_busy_s,
+                                 worker_busy_s,
+                                 accelerator=accelerator,
+                                 workers_spot=workers_spot)

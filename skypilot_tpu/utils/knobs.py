@@ -319,6 +319,18 @@ _declare('SKYTPU_TRACE_ID', 'str', None, 'observe',
          'control plane.', propagate=True)
 _declare('SKYTPU_PARENT_SPAN_ID', 'str', None, 'observe',
          'Cross-process span-tree parent carrier.', propagate=True)
+_declare('SKYTPU_COST_BUDGETS', 'json', None, 'observe',
+         'JSON list of CostBudget kwargs (observe/costs.py); '
+         'malformed input is refused at meter construction.')
+_declare('SKYTPU_COST_ACCELERATOR', 'str', 'v5litepod-8', 'observe',
+         'Accelerator priced per replica when the cost meter '
+         'registers one without an explicit slice.')
+_declare('SKYTPU_COST_PRICE_CLASS', 'enum', 'on_demand', 'observe',
+         'Default price class for metered replicas.',
+         choices=('on_demand', 'spot'))
+_declare('SKYTPU_COST_JOIN_WINDOW', 'float', 600.0, 'observe',
+         'Window for the cost meter\'s $/token and $/request joins '
+         'and the /-/fleet/costs summary.')
 
 # ----------------------------------------------------- data service
 _declare('SKYTPU_DATA_HEARTBEAT_TIMEOUT', 'float', 10.0,

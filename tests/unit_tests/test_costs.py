@@ -1,0 +1,462 @@
+"""Cost attribution plane (observe/costs.py): metered dollars from
+catalog pricing to per-token joins.
+
+Five angles, mirroring the ISSUE-20 contract:
+  1. meter accrual — replica-seconds priced once per replica lifetime
+     (journaled cost_price), correct across a mid-window price-class
+     flip (spot replica replaced by on-demand);
+  2. budget burn — fast/slow windows, immediate escalation, clear-
+     rounds de-escalation (flap resistance), no-data holds state;
+  3. spec refusal — malformed SKYTPU_COST_BUDGETS raises loudly;
+  4. the LB's /-/fleet/costs endpoint — entity-scoped on a shared DB
+     (one service's spend never leaks into another's view);
+  5. the offline CLI (`observe cost --db`) via subprocess, plus the
+     rollout cost_per_sample delegation staying band-exact.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from skypilot_tpu.observe import costs
+from skypilot_tpu.observe import journal
+from skypilot_tpu.observe import metrics
+from skypilot_tpu.observe import tsdb
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+@pytest.fixture()
+def observe_env(tmp_path, monkeypatch):
+    monkeypatch.setenv('SKYTPU_OBSERVE_DB', str(tmp_path / 'journal.db'))
+    monkeypatch.delenv('SKYTPU_COST_BUDGETS', raising=False)
+    monkeypatch.delenv('SKYTPU_COST_PRICE_CLASS', raising=False)
+    monkeypatch.delenv('SKYTPU_COST_ACCELERATOR', raising=False)
+    metrics.REGISTRY.reset_for_tests()
+    yield tmp_path
+    metrics.REGISTRY.reset_for_tests()
+
+
+T0 = 1_700_000_000.0
+
+# Catalog truth for the default v5litepod-8 slice; the meter must
+# resolve exactly these (catalog.get_hourly_cost is the one price
+# source).
+ON_DEMAND = costs.hourly_rate('v5litepod-8', 'on_demand')
+SPOT = costs.hourly_rate('v5litepod-8', 'spot')
+
+
+# ------------------------------------------------------------- accrual
+
+@pytest.mark.usefixtures('observe_env')
+class TestMeterAccrual:
+
+    def test_price_resolved_once_and_journaled(self):
+        m = costs.CostMeter(entity='svc', budgets=[])
+        m.register('svc/1', 'serve', price_class='spot', now=T0)
+        events = journal.query(kind='cost_price')
+        assert len(events) == 1
+        data = events[0]['data']
+        assert data['price_class'] == 'spot'
+        assert data['hourly_usd'] == SPOT
+        assert data['reference_hourly_usd'] == ON_DEMAND
+        # Idempotent for an unchanged config: no second price event.
+        m.register('svc/1', 'serve', price_class='spot', now=T0 + 10)
+        assert len(journal.query(kind='cost_price')) == 1
+
+    def test_accrual_prices_replica_seconds(self):
+        m = costs.CostMeter(entity='svc', budgets=[])
+        m.register('svc/1', 'serve', price_class='spot', now=T0)
+        assert m.accrue(now=T0 + 1800) == 1
+        spend = costs.window_spend(3600, now=T0 + 1800,
+                                   entity_scope='svc')
+        agg = spend[('serve', 'spot')]
+        assert agg['seconds'] == pytest.approx(1800.0)
+        assert agg['usd'] == pytest.approx(SPOT * 0.5)
+        assert agg['reference_usd'] == pytest.approx(ON_DEMAND * 0.5)
+
+    def test_mid_window_price_class_flip(self):
+        """A spot replica replaced by an on-demand one mid-window:
+        each side of the flip accrues at ITS OWN resolved rate, and
+        the flip re-journals the price."""
+        m = costs.CostMeter(entity='svc', budgets=[])
+        m.register('svc/1', 'serve', price_class='spot', now=T0)
+        m.accrue(now=T0 + 1800)
+        # The replacement replica arrives on-demand; register() closes
+        # the spot meter at the flip instant and opens a fresh one.
+        m.register('svc/1', 'serve', price_class='on_demand',
+                   now=T0 + 1800)
+        m.accrue(now=T0 + 3600)
+        spend = costs.window_spend(7200, now=T0 + 3600,
+                                   entity_scope='svc')
+        assert spend[('serve', 'spot')]['usd'] == \
+            pytest.approx(SPOT * 0.5)
+        assert spend[('serve', 'on_demand')]['usd'] == \
+            pytest.approx(ON_DEMAND * 0.5)
+        # Two price resolutions, both journaled — the run's pricing
+        # history is complete even after the flip.
+        events = journal.query(kind='cost_price')
+        assert [e['data']['price_class'] for e in events] == \
+            ['spot', 'on_demand']
+
+    def test_deregister_takes_final_accrual(self):
+        m = costs.CostMeter(entity='svc', budgets=[])
+        m.register('svc/1', 'serve', price_class='spot', now=T0)
+        m.deregister('svc/1', now=T0 + 900)
+        assert m.replicas() == {}
+        spend = costs.window_spend(3600, now=T0 + 900,
+                                   entity_scope='svc')
+        assert spend[('serve', 'spot')]['usd'] == \
+            pytest.approx(SPOT * 0.25)
+        # Nothing accrues after the replica is gone.
+        assert m.accrue(now=T0 + 3600) == 0
+
+    def test_unknown_pool_and_price_class_refused(self):
+        m = costs.CostMeter(entity='svc', budgets=[])
+        with pytest.raises(ValueError, match='unknown cost pool'):
+            m.register('svc/1', 'mystery', now=T0)
+        with pytest.raises(ValueError, match='unknown price class'):
+            costs.hourly_rate('v5litepod-8', 'preemptible')
+
+    def test_spot_discount_in_summary(self):
+        """The scorecard's spot-vs-on-demand A/B: an all-spot fleet's
+        window reports discount = on-demand reference / metered spend,
+        straight from the catalog price ratio."""
+        m = costs.CostMeter(entity='svc', budgets=[])
+        m.register('svc/1', 'serve', price_class='spot', now=T0)
+        m.accrue(now=T0 + 3600)
+        doc = m.summary(window=7200, now=T0 + 3600)
+        assert doc['totals']['spot_discount'] == \
+            pytest.approx(ON_DEMAND / SPOT, abs=1e-3)
+        assert doc['totals']['spot_discount'] > 1.0
+        # An on-demand fleet has no discount to claim.
+        m2 = costs.CostMeter(entity='svc2', budgets=[])
+        m2.register('svc2/1', 'serve', price_class='on_demand', now=T0)
+        m2.accrue(now=T0 + 3600)
+        doc2 = m2.summary(window=7200, now=T0 + 3600)
+        assert doc2['totals']['spot_discount'] == pytest.approx(1.0)
+
+    def test_per_token_join_from_tsdb(self):
+        """Metered dollars join the scraped token counters: $/token =
+        window spend / window token delta (counter-restart safe)."""
+        m = costs.CostMeter(entity='svc', budgets=[], join_window=7200)
+        m.register('svc/1', 'serve', price_class='spot', now=T0)
+        # A pre-window round pins the counter baseline: only the
+        # WINDOW's token delta is joined, not the counter's lifetime.
+        tsdb.insert_samples(
+            'svc/1', [('skytpu_engine_tokens_total', '', 1000.0)],
+            ts=T0 - 3600)
+        tsdb.insert_samples(
+            'svc/1', [('skytpu_engine_tokens_total', '', 5000.0)],
+            ts=T0 + 3600)
+        m.accrue(now=T0 + 3600)
+        doc = m.summary(window=7200, now=T0 + 3600)
+        row = doc['pools']['serve']
+        assert row['tokens'] == pytest.approx(4000.0)
+        assert row['cost_per_token_usd'] == \
+            pytest.approx(SPOT / 4000.0, rel=1e-6)
+
+    def test_projector_prices_scale_deltas(self):
+        m = costs.CostMeter(entity='svc', budgets=[])
+        project = m.projector('serve')
+        assert project(2, 3) is None        # nothing priced yet
+        m.register('svc/1', 'serve', price_class='spot', now=T0)
+        assert project(2, 3) == pytest.approx(SPOT)
+        assert project(3, 1) == pytest.approx(-2 * SPOT)
+
+
+# ------------------------------------------------------------- budgets
+
+@pytest.mark.usefixtures('observe_env')
+class TestCostBudgets:
+
+    def _meter(self, **over):
+        kwargs = dict(pool='serve', hourly_usd=ON_DEMAND,
+                      fast_window=300.0, slow_window=3600.0,
+                      fast_burn=2.0, slow_burn=1.2, clear_rounds=3)
+        kwargs.update(over)
+        return costs.CostMeter(entity='svc',
+                               budgets=[costs.CostBudget(**kwargs)])
+
+    def test_no_data_holds_state(self):
+        m = self._meter()
+        evals = m.evaluate(now=T0)
+        assert evals[0].state == 'ok'
+        assert evals[0].burn_fast is None
+        assert not journal.query(kind='cost_budget_ok')
+
+    def test_breach_and_clear_rounds_deescalation(self):
+        """Escalation is immediate; de-escalation waits for
+        clear_rounds consecutive cleaner evaluations — a spend rate
+        hovering at the threshold cannot strobe states."""
+        m = self._meter(clear_rounds=3)
+        # 4 replicas of on-demand → 4x the budgeted $/hour, sustained
+        # across both windows.
+        for i in range(4):
+            m.register(f'svc/{i}', 'serve', price_class='on_demand',
+                       now=T0 - 7200)
+        for step in range(60, 7201, 60):
+            m.accrue(now=T0 - 7200 + step)
+        evals = m.evaluate(now=T0)
+        assert evals[0].state == 'breach'
+        assert evals[0].burn_fast == pytest.approx(4.0, rel=0.1)
+        assert evals[0].burn_slow == pytest.approx(4.0, rel=0.1)
+        breach_events = journal.query(kind='cost_budget_breach')
+        assert len(breach_events) == 1
+        assert breach_events[0]['data']['burn_fast'] == \
+            pytest.approx(4.0, rel=0.1)
+        # Spend stops (replicas gone); burn decays. The first cleaner
+        # rounds must NOT de-escalate...
+        for i in range(4):
+            m.deregister(f'svc/{i}', now=T0)
+        assert m.evaluate(now=T0 + 1200)[0].state == 'breach'
+        assert m.evaluate(now=T0 + 1800)[0].state == 'breach'
+        # ...the third consecutive clean round does.
+        ev = m.evaluate(now=T0 + 2400)[0]
+        assert ev.state in ('ok', 'warning')
+        assert journal.query(kind=f'cost_budget_{ev.state}')
+
+    def test_fast_spike_alone_is_warning_not_breach(self):
+        """The multi-window contract: a fast-window spike without
+        slow-window confirmation warns, never breaches."""
+        m = self._meter()
+        for i in range(4):
+            m.register(f'svc/{i}', 'serve', price_class='on_demand',
+                       now=T0 - 300)
+        m.accrue(now=T0)        # only 300s of spend in the slow window
+        ev = m.evaluate(now=T0)[0]
+        assert ev.burn_fast >= 2.0
+        assert ev.burn_slow < 1.2
+        assert ev.state == 'warning'
+
+    def test_fleet_budget_covers_all_pools(self):
+        m = costs.CostMeter(entity='svc', budgets=[costs.CostBudget(
+            pool='fleet', hourly_usd=2 * ON_DEMAND,
+            fast_window=300.0, slow_window=3600.0)])
+        m.register('svc/prefill/0', 'prefill',
+                   price_class='on_demand', now=T0 - 3600)
+        m.register('svc/decode/0', 'decode',
+                   price_class='on_demand', now=T0 - 3600)
+        for step in range(0, 3600, 60):
+            m.accrue(now=T0 - 3600 + step)
+        ev = m.evaluate(now=T0)[0]
+        assert ev.rate_usd_per_hour == \
+            pytest.approx(2 * ON_DEMAND, rel=0.1)
+        assert ev.burn_slow == pytest.approx(1.0, rel=0.1)
+
+    def test_duplicate_budget_names_refused(self):
+        with pytest.raises(ValueError, match='duplicate'):
+            costs.CostMeter(budgets=[
+                costs.CostBudget(pool='serve', hourly_usd=1.0,
+                                 name='b'),
+                costs.CostBudget(pool='decode', hourly_usd=1.0,
+                                 name='b')])
+
+    def test_budget_validation(self):
+        with pytest.raises(ValueError, match='unknown budget pool'):
+            costs.CostBudget(pool='mystery', hourly_usd=1.0)
+        with pytest.raises(ValueError, match='hourly_usd'):
+            costs.CostBudget(pool='serve', hourly_usd=0.0)
+
+
+@pytest.mark.usefixtures('observe_env')
+class TestBudgetEnvSpecs:
+
+    def test_env_budgets_parsed(self, monkeypatch):
+        monkeypatch.setenv('SKYTPU_COST_BUDGETS', json.dumps([
+            {'pool': 'serve', 'hourly_usd': 40.0},
+            {'pool': 'fleet', 'hourly_usd': 100.0,
+             'fast_burn': 3.0}]))
+        budgets = costs.default_budgets()
+        assert [b.pool for b in budgets] == ['serve', 'fleet']
+        assert budgets[1].fast_burn == 3.0
+        # The meter picks the env budgets up by default.
+        m = costs.CostMeter(entity='svc')
+        assert set(m.states()) == {'cost_serve', 'cost_fleet'}
+
+    def test_malformed_budgets_refused_loudly(self, monkeypatch):
+        for bad in ('{"pool": "serve"}',          # not a list
+                    '[{"pool": "serve"}]',        # missing hourly_usd
+                    '[{"pool": "serve", "hourly_usd": -1}]',
+                    '[{"pool": "nope", "hourly_usd": 1}]',
+                    '[{"hourly_usd": 1, "surprise": true}]'):
+            monkeypatch.setenv('SKYTPU_COST_BUDGETS', bad)
+            with pytest.raises(ValueError,
+                               match='SKYTPU_COST_BUDGETS is '
+                                     'malformed'):
+                costs.default_budgets()
+
+    def test_absent_env_means_no_budgets(self):
+        assert costs.default_budgets() == []
+
+
+# ----------------------------------------------------------- retention
+
+@pytest.mark.usefixtures('observe_env')
+class TestCostsGC:
+
+    def test_row_cap_keeps_newest(self):
+        rows = [(T0 + i, 'svc/1', 'serve', 'spot', SPOT, 1.0,
+                 SPOT / 3600.0, ON_DEMAND / 3600.0)
+                for i in range(50)]
+        assert costs.insert_costs(rows) == 50
+        deleted = costs.gc_costs(max_age_seconds=10 ** 9, max_rows=10)
+        assert deleted == 40
+        spend = costs.window_spend(10 ** 9, now=T0 + 100)
+        assert spend[('serve', 'spot')]['seconds'] == \
+            pytest.approx(10.0)
+
+    def test_observe_gc_sweeps_costs_table(self):
+        from skypilot_tpu import observe
+        costs.insert_costs([(T0, 'svc/1', 'serve', 'spot', SPOT, 1.0,
+                             0.001, 0.002)])
+        pruned = observe.gc(max_age_seconds=10 ** 9)
+        assert 'costs' in pruned
+        assert pruned['costs'] == 0     # young row survives
+        pruned = observe.gc(max_age_seconds=0)
+        assert pruned['costs'] >= 1
+
+
+# ----------------------------------------------------- entity scoping
+
+@pytest.mark.usefixtures('observe_env')
+class TestFleetCostsEndpoint:
+
+    def test_endpoint_is_entity_scoped_on_shared_db(self):
+        """Two services metering into ONE observe DB: each LB's
+        /-/fleet/costs shows only its own service's spend (the
+        /-/lb/events scoping contract, applied to dollars)."""
+        import asyncio
+        import time
+
+        from aiohttp.test_utils import TestClient
+        from aiohttp.test_utils import TestServer as AioTestServer
+
+        from skypilot_tpu.serve import load_balancer as lb_lib
+
+        # Wall-clock stamps: the LB handler calls summary() with the
+        # request-time now, so the spend must sit in the live window.
+        now = time.time()
+        m_a = costs.CostMeter(entity='svca', budgets=[])
+        m_a.register('svca/1', 'serve', price_class='spot',
+                     now=now - 3600)
+        m_a.accrue(now=now)
+        m_b = costs.CostMeter(entity='svcb', budgets=[])
+        m_b.register('svcb/1', 'serve', price_class='on_demand',
+                     now=now - 3600)
+        m_b.register('svcb/2', 'serve', price_class='on_demand',
+                     now=now - 3600)
+        m_b.accrue(now=now)
+        # Entity-prefix injection must not leak either: a service
+        # named like a scope prefix of another.
+        m_c = costs.CostMeter(entity='svc', budgets=[])
+        m_c.register('svc/1', 'serve', price_class='on_demand',
+                     now=now - 3600)
+        m_c.accrue(now=now)
+
+        async def fn():
+            lb = lb_lib.LoadBalancer('round_robin',
+                                     service_name='svca')
+            lb.attach_fleet(None, None, m_a)
+            client = TestClient(AioTestServer(lb.build_app()))
+            await client.start_server()
+            try:
+                r = await client.get('/-/fleet/costs')
+                assert r.status == 200
+                doc = await r.json()
+            finally:
+                await client.close()
+
+            bare = lb_lib.LoadBalancer('round_robin',
+                                       service_name='svcz')
+            client2 = TestClient(AioTestServer(bare.build_app()))
+            await client2.start_server()
+            try:
+                r = await client2.get('/-/fleet/costs')
+                assert r.status == 503
+            finally:
+                await client2.close()
+            return doc
+
+        loop = asyncio.new_event_loop()
+        try:
+            doc = loop.run_until_complete(fn())
+        finally:
+            loop.close()
+        assert doc['entity'] == 'svca'
+        # Only svca's single spot replica-hour — not svcb's two
+        # on-demand hours, not 'svc's (prefix of 'svca') hour.
+        assert doc['totals']['usd'] == pytest.approx(SPOT)
+        assert list(doc['pools']) == ['serve']
+        assert doc['pools']['serve']['by_price_class'] == {
+            'spot': pytest.approx(SPOT)}
+
+
+# ------------------------------------------------------- CLI + rollout
+
+class TestOfflineCLI:
+
+    def test_observe_cost_offline_db(self, tmp_path):
+        """`observe cost --db` in a fresh process: the metered window
+        reads back from the DB alone."""
+        db = str(tmp_path / 'observe.db')
+        env = {**os.environ, 'SKYTPU_OBSERVE_DB': db}
+        seed = (
+            'import time\n'
+            'from skypilot_tpu.observe import costs\n'
+            'm = costs.CostMeter(entity="svc", budgets=[])\n'
+            'now = time.time()\n'
+            'm.register("svc/1", "serve", price_class="spot",\n'
+            '           now=now - 1800)\n'
+            'm.accrue(now=now)\n')
+        subprocess.run([sys.executable, '-c', seed], env=env,
+                       check=True, cwd=REPO)
+        proc = subprocess.run(
+            [sys.executable, '-m', 'skypilot_tpu.observe', 'cost',
+             '--db', db, '--window', '3600', '--json'],
+            env=env, capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 0, proc.stderr
+        doc = json.loads(proc.stdout)
+        assert doc['pools']['serve']['usd'] == \
+            pytest.approx(SPOT * 0.5, rel=1e-3)
+        assert doc['totals']['spot_discount'] == \
+            pytest.approx(ON_DEMAND / SPOT, abs=1e-3)
+        # Human-readable table renders too.
+        proc = subprocess.run(
+            [sys.executable, '-m', 'skypilot_tpu.observe', 'cost',
+             '--db', db, '--window', '3600'],
+            env=env, capture_output=True, text=True, cwd=REPO)
+        assert proc.returncode == 0, proc.stderr
+        assert 'serve' in proc.stdout
+        assert 'spot_discount' in proc.stdout
+
+
+@pytest.mark.usefixtures('observe_env')
+class TestRolloutDelegation:
+
+    def test_cost_per_sample_exact_legacy_shape(self):
+        """The rollout harness's cost_per_sample now delegates to the
+        CostMeter — key set, rates and rounding must reproduce the
+        RL_HARVEST_LAST_GOOD contract exactly."""
+        from skypilot_tpu.train.rollout import harness
+        doc = harness.cost_per_sample(1000, 3600.0, 7200.0,
+                                      workers_spot=True)
+        assert doc == {
+            'accelerator': 'v5litepod-8',
+            'workers_spot': True,
+            'learner_hourly_usd': ON_DEMAND,
+            'worker_hourly_usd': SPOT,
+            'learner_cost_usd': round(ON_DEMAND, 6),
+            'worker_cost_usd': round(2 * SPOT, 6),
+            'total_cost_usd': round(ON_DEMAND + 2 * SPOT, 6),
+            'cost_per_sample_usd': round(
+                (ON_DEMAND + 2 * SPOT) / 1000, 9),
+        }
+        control = harness.cost_per_sample(1000, 3600.0, 7200.0,
+                                          workers_spot=False)
+        assert control['worker_hourly_usd'] == ON_DEMAND
+        # The spot run is cheaper — the harvesting claim's arithmetic.
+        assert doc['total_cost_usd'] < control['total_cost_usd']

@@ -250,6 +250,33 @@ class TestFlapAndJournal:
         assert len(recov) == 1 and recov[0]['data']['was'] == (
             'hold_no_signal')
 
+    def test_cost_delta_annotates_adoption(self):
+        """A spec wired with a cost projector stamps the metered
+        $/hour delta onto the adoption event; a throwing or
+        nothing-priced projector degrades to no annotation, never to
+        a dead controller."""
+        probe = _Probe(value=0.5)
+        ctl = controller_lib.PoolController(_band_spec(
+            signal=probe, cost_delta=lambda old, new: (new - old) * 3.84))
+        ctl.evaluate(1000.0)
+        ctl.evaluate(1001.0)                  # adopts 1 → 2
+        adopt = journal.query(kind='elastic_decision', limit=1)[0]
+        assert adopt['data']['usd_per_hour_delta'] == pytest.approx(3.84)
+
+        def boom(old, new):
+            raise RuntimeError('no replicas priced')
+
+        probe2 = _Probe(value=0.5)
+        ctl2 = controller_lib.PoolController(_band_spec(
+            pool='serve', signal=probe2, cost_delta=boom))
+        ctl2.evaluate(1000.0)
+        ctl2.evaluate(1001.0)
+        adopt2 = [e for e in journal.query(kind='elastic_decision',
+                                           limit=10)
+                  if e['entity'] == 'elastic/serve'][0]
+        assert 'usd_per_hour_delta' not in adopt2['data']
+        assert ctl2.target == 2               # the decision still landed
+
     def test_target_gauge_tracks_pool(self):
         probe = _Probe(value=0.5)
         ctl = controller_lib.PoolController(_band_spec(signal=probe))

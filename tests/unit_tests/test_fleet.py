@@ -152,11 +152,12 @@ class TestTsdb:
         assert [r['value'] for r in left] == [6.0, 7.0, 8.0, 9.0]
         assert tsdb.gc_samples(max_age_seconds=0) == 4
         assert tsdb.query(name='m') == []
-        # Shared GC covers events + spans + samples in one call.
+        # Shared GC covers events + spans + samples + costs in one
+        # call.
         tsdb.insert_samples('svc/0', [('m', '', 1.0)],
                             ts=now - 10 * 24 * 3600)
         pruned = observe.gc()
-        assert set(pruned) == {'events', 'spans', 'samples'}
+        assert set(pruned) == {'events', 'spans', 'samples', 'costs'}
         assert pruned['samples'] == 1
 
 

@@ -362,6 +362,22 @@ class TestScorecard:
         assert card['offered']['by_class']
         assert card['requests'] == len(sched)
 
+    def test_scorecard_cost_section_is_passthrough(self):
+        """The economic plane rides the scorecard verbatim: report.py
+        never computes a dollar — every number comes priced from the
+        CostMeter's summary doc (absent when no meter ran)."""
+        profile = schedule_lib.PROFILES['smoke']
+        sched = schedule_lib.build_schedule(profile, seed=7)
+        summary = {'totals': {'usd': 3.84, 'spot_discount': 2.5,
+                              'cost_per_token_usd': 9.6e-05}}
+        card = report_lib.build_scorecard(
+            profile=profile, seed=7, schedule=sched, run=None,
+            cost=summary)
+        assert card['cost'] is summary
+        bare = report_lib.build_scorecard(
+            profile=profile, seed=7, schedule=sched, run=None)
+        assert 'cost' not in bare
+
 
 # ------------------------------------------- disaggregation evidence
 

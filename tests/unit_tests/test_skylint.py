@@ -924,6 +924,42 @@ class TestMetricDisciplineChecker:
         ''')
         assert _run(tmp_path, checks=['metric-discipline'])['total'] == 0
 
+    def test_cost_family_names_in_roster(self, tmp_path):
+        """The cost-attribution gauges follow the naming/label
+        contract: skytpu_cost_* with bounded declared label sets
+        (pool, price_class) lints clean; pricing dollars by replica
+        ENTITY (unbounded) is the cardinality mistake the checker
+        exists to catch."""
+        _write(tmp_path, 'serve/cost_ok.py', '''\
+            from skypilot_tpu.observe import metrics
+
+            _USD = metrics.gauge(
+                'skytpu_cost_usd_total', 'Metered dollars.',
+                labels={'pool': ('serve', 'decode'),
+                        'price_class': ('on_demand', 'spot')})
+            _CPT = metrics.gauge(
+                'skytpu_cost_per_token_usd', 'Join.',
+                labels={'pool': ('serve', 'decode')})
+
+            def publish(pool):
+                _USD.set(1.0, pool=pool, price_class='spot')
+                _CPT.set(0.001, pool=pool)
+        ''')
+        assert _run(tmp_path, checks=['metric-discipline'])['total'] == 0
+        _write(tmp_path, 'serve/cost_bad.py', '''\
+            from skypilot_tpu.observe import metrics
+
+            _BAD = metrics.gauge(
+                'skytpu_cost_usd_total', 'Per-replica dollars.',
+                labels={'entity': 'svc/1'})
+
+            def publish(entity):
+                _BAD.set(1.0, entity=f'{entity}')
+        ''')
+        report = _run(tmp_path, checks=['metric-discipline'])
+        assert ('metric-discipline:serve/cost_bad.py:'
+                'skytpu_cost_usd_total:labels' in _idents(report))
+
     def test_modules_not_touching_observe_exempt(self, tmp_path):
         # The keyed idiom + observe-import gate keeps unrelated .set()/
         # .format() call sites out of scope.

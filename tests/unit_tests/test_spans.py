@@ -140,10 +140,11 @@ class TestSpanMechanics:
         assert len(left) == 10
         assert all(s['name'].startswith('new.') for s in left)
         # The shared observe.gc() covers every journal-DB table
-        # (events + spans + the fleet scraper's samples) in one call.
+        # (events + spans + the fleet scraper's samples + the cost
+        # meter's accruals) in one call.
         from skypilot_tpu import observe
         pruned = observe.gc()
-        assert set(pruned) == {'events', 'spans', 'samples'}
+        assert set(pruned) == {'events', 'spans', 'samples', 'costs'}
 
     def test_chrome_export_merges_timeline(self, tmp_path, monkeypatch):
         tl_path = tmp_path / 'timeline.json'
